@@ -1,0 +1,29 @@
+"""llmd-tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the llm-d
+serving stack (reference: /root/reference, an umbrella repo whose component
+specs live in docs/architecture/**):
+
+- Engine: continuous-batching JAX model server with a paged KV cache held as
+  jax.Arrays, Pallas ragged paged attention, automatic prefix caching, and
+  pjit/shard_map parallelism over a TPU device mesh (TP/DP/EP).
+- EPP (endpoint picker): Filter->Score->Pick request scheduling, data layer,
+  flow control, precise KV-cache indexing -- the accelerator-agnostic control
+  plane, re-implemented natively (reference spec:
+  docs/architecture/core/router/epp/README.md).
+- KV transfer: ICI/DCN jax.Array KV shipper replacing NIXL
+  (reference spec: docs/architecture/advanced/disaggregation/operations-vllm.md).
+
+Package layout:
+  engine/    continuous batching, paged KV cache, sampling, model runner
+  models/    model families (Llama/Qwen dense, Mixtral/DeepSeek MoE)
+  ops/       Pallas TPU kernels + XLA fallbacks
+  parallel/  mesh construction, shardings, EP all-to-all
+  server/    OpenAI-compatible HTTP serving + metrics protocol
+  epp/       endpoint picker: scheduler, data layer, flow control, kv index
+  router/    standalone router proxy + P/D routing sidecar
+  kvtransfer/ P<->D KV-cache shipper (side channel, leases, pull model)
+  utils/     shared helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,153 @@
+"""Engine configuration.
+
+The reference configures its engine (vLLM) via CLI flags on the model-server
+Deployment (e.g. --tensor-parallel-size, --max-num-batched-tokens,
+--max-model-len, --block-size; see reference
+guides/pd-disaggregation/modelserver/tpu/v6/vllm/patch-decode.yaml and
+docs/architecture/core/model-servers.md:3-25). Here the same knobs are
+dataclasses consumed by the JAX engine; the serve CLI maps flag names 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only transformer.
+
+    Covers the dense Llama family (Llama-2/3, Qwen2) and MoE families
+    (Mixtral, DeepSeek-style) via ``num_experts``.
+    """
+
+    name: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int | None = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_model_len: int = 8192
+    dtype: str = "bfloat16"
+    tie_word_embeddings: bool = False
+    # Qwen2-style attention bias on QKV projections.
+    attention_bias: bool = False
+    # --- MoE (0 experts => dense MLP) ---
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int | None = None
+    # DeepSeek-style: first N layers use a dense MLP, the rest are MoE.
+    first_dense_layers: int = 0
+    # Shared expert intermediate size (DeepSeek V2/V3 style); 0 = none.
+    shared_expert_intermediate_size: int = 0
+
+    def __post_init__(self) -> None:
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+        if self.moe_intermediate_size is None:
+            self.moe_intermediate_size = self.intermediate_size
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    """Paged KV cache geometry.
+
+    The KV pool is a stack of jax.Arrays (one logical pool, layer-major)
+    holding ``num_blocks`` pages of ``page_size`` tokens each -- the TPU
+    analogue of vLLM's paged KV cache (reference
+    docs/architecture/core/model-servers.md:5-7). ``page_size`` defaults to
+    a lane-friendly 16 so (page, head_dim) tiles map onto (sublane, lane).
+    """
+
+    page_size: int = 16
+    num_blocks: int = 512
+    dtype: str = "bfloat16"
+    # Fraction of free HBM to use when num_blocks is derived automatically.
+    hbm_utilization: float = 0.9
+    enable_prefix_caching: bool = True
+
+    def max_pages_per_seq(self, max_model_len: int) -> int:
+        return math.ceil(max_model_len / self.page_size)
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Continuous batching knobs (vLLM flag names kept 1:1)."""
+
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 1024
+    # Chunked prefill: a long prompt is processed in chunks of at most this
+    # many tokens so decode seqs are never starved (reference agentic TPU
+    # patch-vllm.yaml:39 uses --max-num-batched-tokens=8192 @ 262144 ctx).
+    enable_chunked_prefill: bool = True
+    # Token-count buckets used to pad jitted step shapes (compile-once).
+    prefill_token_buckets: tuple[int, ...] = ()
+    decode_batch_buckets: tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Device-mesh parallelism.
+
+    The reference maps TP/DP/EP onto NCCL/NVSHMEM process groups
+    (SURVEY.md section 2.4); here they are axes of one jax.sharding.Mesh and
+    XLA inserts the collectives over ICI.
+    """
+
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    expert_parallel_size: int = 1  # folded over the same devices as tp*dp
+
+    @property
+    def world_size(self) -> int:
+        return self.tensor_parallel_size * self.data_parallel_size
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    seed: int = 0
+    # Path to HF-format weights (safetensors); None => deterministic random init.
+    weights_path: str | None = None
+    tokenizer_path: str | None = None
+    # KV transfer role for P/D disaggregation: None | kv_producer | kv_consumer
+    # | kv_both (reference tpu patch-decode.yaml:17-20 TPUConnector roles).
+    kv_role: str | None = None
+    kv_side_channel_port: int = 9600
+    kv_transfer_port: int = 9100
+    # ZMQ pub endpoint for KV events (BlockStored/...); None disables.
+    kv_events_endpoint: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def tiny_model_config(**overrides: Any) -> ModelConfig:
+    """A toy config small enough for CPU-mesh unit tests."""
+    base = dict(
+        name="tiny-llama",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10000.0,
+        max_model_len=128,
+        dtype="float32",
+    )
+    base.update(overrides)
+    return ModelConfig(**base)

@@ -1,0 +1,4 @@
+"""Engine: continuous batching, paged KV cache, model runner, sampling."""
+
+from llmd_tpu.engine.engine import LLMEngine  # noqa: F401
+from llmd_tpu.engine.request import Request, SamplingParams  # noqa: F401

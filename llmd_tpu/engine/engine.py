@@ -1,0 +1,198 @@
+"""LLMEngine: the continuous-batching serving engine.
+
+Plays the role vLLM plays in the reference stack (L3 of SURVEY.md's layer
+map): accepts requests, schedules them with chunked prefill + paged KV +
+automatic prefix caching, steps the jitted model, streams outputs, and
+exposes the queue/KV metrics the EPP scrapes
+(docs/architecture/core/model-servers.md:38-52).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import jax
+
+from llmd_tpu.config import EngineConfig
+from llmd_tpu.engine.kv_cache import KVEventSink, PageAllocator
+from llmd_tpu.engine.request import (
+    FinishReason,
+    Request,
+    RequestOutput,
+    SamplingParams,
+)
+from llmd_tpu.engine.runner import ModelRunner
+from llmd_tpu.engine.scheduler import EngineScheduler, ScheduledBatch
+from llmd_tpu.parallel.mesh import MeshContext, build_mesh
+
+
+@dataclass
+class EngineStats:
+    """The EPP metrics contract (model-servers.md:38-52)."""
+
+    num_waiting: int = 0
+    num_running: int = 0
+    kv_usage: float = 0.0
+    prefix_hit_ratio: float = 0.0
+    num_pages: int = 0
+    page_size: int = 0
+    # counters
+    prompt_tokens: int = 0
+    generation_tokens: int = 0
+    requests_finished: int = 0
+    preemptions: int = 0
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        mesh_ctx: MeshContext | None = None,
+        params: dict | None = None,
+        event_sink: KVEventSink | None = None,
+    ) -> None:
+        self.config = config
+        self.ctx = mesh_ctx or build_mesh(config.parallel)
+        self.allocator = PageAllocator(
+            num_pages=config.cache.num_blocks,
+            page_size=config.cache.page_size,
+            enable_prefix_caching=config.cache.enable_prefix_caching,
+            event_sink=event_sink,
+        )
+        self.scheduler = EngineScheduler(
+            config.scheduler, config.cache, self.allocator, config.model.max_model_len
+        )
+        self.runner = ModelRunner(config, self.ctx, params=params)
+        self.stats = EngineStats(
+            num_pages=config.cache.num_blocks, page_size=config.cache.page_size
+        )
+        self._counter = itertools.count()
+
+    # ------------------------------------------------------------------ #
+
+    def add_request(
+        self,
+        prompt_token_ids: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+        priority: int = 0,
+        kv_transfer_params: dict | None = None,
+    ) -> str:
+        if not prompt_token_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_token_ids) >= self.config.model.max_model_len:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} >= max_model_len "
+                f"{self.config.model.max_model_len}"
+            )
+        sched = self.config.scheduler
+        if (
+            not sched.enable_chunked_prefill
+            and len(prompt_token_ids) > sched.max_num_batched_tokens
+        ):
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} > max_num_batched_tokens "
+                f"{sched.max_num_batched_tokens} and chunked prefill is disabled"
+            )
+        rid = request_id or f"req-{next(self._counter)}-{uuid.uuid4().hex[:8]}"
+        req = Request(
+            request_id=rid,
+            prompt_token_ids=list(prompt_token_ids),
+            sampling=sampling or SamplingParams(),
+            priority=priority,
+            kv_transfer_params=kv_transfer_params,
+        )
+        self.scheduler.add_request(req)
+        return rid
+
+    def abort_request(self, request_id: str) -> bool:
+        return self.scheduler.abort_request(request_id) is not None
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # ------------------------------------------------------------------ #
+
+    def step(self) -> list[RequestOutput]:
+        batch: ScheduledBatch = self.scheduler.schedule()
+        if batch.is_empty:
+            return []
+        now = time.monotonic()
+        sampled: dict[str, int] = {}
+        logprobs: dict[str, float] = {}
+
+        for seq in batch.prefills:
+            res = self.runner.run_prefill(seq)
+            sampled[seq.request.request_id] = int(res.tokens[0])
+            logprobs[seq.request.request_id] = float(res.logprobs[0])
+            self.stats.prompt_tokens += seq.num_tokens
+        if batch.decodes:
+            res = self.runner.run_decode(batch.decodes)
+            for i, seq in enumerate(batch.decodes):
+                sampled[seq.request.request_id] = int(res.tokens[i])
+                logprobs[seq.request.request_id] = float(res.logprobs[i])
+
+        finished = self.scheduler.update_after_step(batch, sampled)
+
+        outputs: list[RequestOutput] = []
+        for seq in batch.seqs:
+            req = seq.request
+            produced = req.in_decode and sampled.get(req.request_id) is not None
+            if not produced:
+                continue
+            if req.first_token_time is None:
+                req.first_token_time = now
+            token = sampled[req.request_id]
+            if req.sampling.logprobs:
+                req.output_logprobs.append(logprobs[req.request_id])
+            self.stats.generation_tokens += 1
+            outputs.append(
+                RequestOutput(
+                    request_id=req.request_id,
+                    new_token_ids=[token],
+                    finished=req.is_finished,
+                    finish_reason=req.finish_reason,
+                    num_prompt_tokens=req.num_prompt_tokens - req.num_prior_output_tokens,
+                    num_output_tokens=req.total_output_tokens,
+                    num_cached_tokens=req.num_cached_tokens,
+                )
+            )
+        self.stats.requests_finished += len(finished)
+        self._refresh_gauges()
+        return outputs
+
+    def _refresh_gauges(self) -> None:
+        self.stats.num_waiting = self.scheduler.num_waiting
+        self.stats.num_running = self.scheduler.num_running
+        self.stats.kv_usage = self.allocator.usage()
+        self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
+        self.stats.preemptions = self.scheduler.num_preemptions
+
+    # ------------------------------------------------------------------ #
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        sampling: SamplingParams | list[SamplingParams] | None = None,
+        max_steps: int = 100_000,
+    ) -> dict[str, list[int]]:
+        """Offline batch API: run all prompts to completion."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling or SamplingParams()] * len(prompts)
+        if len(sampling) != len(prompts):
+            raise ValueError(
+                f"{len(prompts)} prompts but {len(sampling)} sampling params"
+            )
+        order: list[str] = []
+        for p, s in zip(prompts, sampling):
+            order.append(self.add_request(p, s))
+        done: dict[str, list[int]] = {rid: [] for rid in order}
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            for out in self.step():
+                done[out.request_id].extend(out.new_token_ids)
+        return {rid: done[rid] for rid in order}

@@ -1,0 +1,211 @@
+"""Paged KV cache: host-side page allocator with automatic prefix caching.
+
+TPU-first design: the device-side pool is ONE stacked jax.Array per engine
+(layer-major), so the per-layer cache slice inside ``lax.scan`` over layers is
+a cheap dynamic-index, and page writes are scatters with static shapes. The
+host side here manages page lifetimes: a free list, per-page refcounts, and a
+content-addressed index of full pages (hash-chained over token ids) giving
+automatic prefix caching -- the same chained-block-hash scheme the reference's
+KV-cache indexer keys on (docs/architecture/advanced/kv-management/
+kv-indexer.md:59-151) and vLLM-style APC semantics
+(docs/architecture/core/model-servers.md:5-7).
+
+Evicted-but-cached pages live in an LRU so a cache hit can resurrect them
+until they are actually reused for new data.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+from collections.abc import Iterable, Sequence
+
+# Sentinel parent hash for the first page of a sequence.
+_ROOT_HASH = b"llmd-root"
+
+
+def hash_page(parent_hash: bytes, token_ids: Sequence[int], extra: bytes = b"") -> bytes:
+    """Chained content hash of one full page.
+
+    ``extra`` folds in LoRA / multimodal / cache-salt identity, mirroring the
+    reference indexer's key-folding rules (kv-indexer.md:145-151).
+    """
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent_hash)
+    h.update(b"|")
+    h.update(b",".join(str(t).encode() for t in token_ids))
+    if extra:
+        h.update(b"#")
+        h.update(extra)
+    return h.digest()
+
+
+def page_hashes_for_tokens(
+    token_ids: Sequence[int], page_size: int, extra: bytes = b""
+) -> list[bytes]:
+    """Hashes of all *full* pages covering a token prefix."""
+    hashes: list[bytes] = []
+    parent = _ROOT_HASH
+    for start in range(0, len(token_ids) - page_size + 1, page_size):
+        parent = hash_page(parent, token_ids[start : start + page_size], extra)
+        hashes.append(parent)
+    return hashes
+
+
+@dataclasses.dataclass
+class PageMeta:
+    ref_count: int = 0
+    content_hash: bytes | None = None
+
+
+class KVEventSink:
+    """Interface for KV-event emission (BlockStored/BlockRemoved/Cleared).
+
+    The precise prefix-cache indexer subscribes to these (reference
+    kv-indexer.md:59-63). The default sink drops events; the engine installs
+    a ZMQ publisher when configured.
+    """
+
+    def blocks_stored(self, hashes: list[bytes], parent: bytes | None, token_ids: list[int]) -> None:
+        pass
+
+    def blocks_removed(self, hashes: list[bytes]) -> None:
+        pass
+
+    def all_cleared(self) -> None:
+        pass
+
+
+class PageAllocator:
+    """Refcounted page allocator with a content-addressed reuse index."""
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        enable_prefix_caching: bool = True,
+        event_sink: KVEventSink | None = None,
+    ) -> None:
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.event_sink = event_sink or KVEventSink()
+        self._meta = [PageMeta() for _ in range(num_pages)]
+        # Pages with ref_count == 0, LRU-ordered: left = oldest = evict first.
+        # Freed cached pages are appended right so hot content survives longest.
+        self._free: collections.OrderedDict[int, None] = collections.OrderedDict(
+            (i, None) for i in range(num_pages)
+        )
+        # content hash -> page id (only pages whose content is intact).
+        self._cached: dict[bytes, int] = {}
+        self.metrics_hits = 0
+        self.metrics_queries = 0
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
+    def usage(self) -> float:
+        return 1.0 - len(self._free) / self.num_pages
+
+    def lookup_cached_prefix(self, token_ids: Sequence[int], extra: bytes = b"") -> list[int]:
+        """Longest run of consecutive cached full pages for this prompt.
+
+        Returns the page ids (not yet referenced). Mirrors the reference
+        indexer's longest-consecutive-prefix scoring (kv-indexer.md:120-135).
+        """
+        if not self.enable_prefix_caching:
+            return []
+        pages: list[int] = []
+        for h in page_hashes_for_tokens(token_ids, self.page_size, extra):
+            self.metrics_queries += 1
+            pid = self._cached.get(h)
+            if pid is None:
+                break
+            self.metrics_hits += 1
+            pages.append(pid)
+        return pages
+
+    def touch(self, page_ids: Iterable[int]) -> None:
+        """Take a reference on cached pages (prefix-cache hit path)."""
+        for pid in page_ids:
+            meta = self._meta[pid]
+            if meta.ref_count == 0:
+                # Resurrect from the free LRU.
+                del self._free[pid]
+            meta.ref_count += 1
+
+    def allocate(self, n: int) -> list[int]:
+        """Allocate n fresh pages (ref=1), evicting cached content LRU-first."""
+        if n > len(self._free):
+            raise NoFreePagesError(n, len(self._free))
+        out: list[int] = []
+        for _ in range(n):
+            pid, _ = self._free.popitem(last=False)
+            meta = self._meta[pid]
+            if meta.content_hash is not None:
+                # Evict: the page is being reused for new content.
+                self._cached.pop(meta.content_hash, None)
+                self.event_sink.blocks_removed([meta.content_hash])
+                meta.content_hash = None
+            meta.ref_count = 1
+            out.append(pid)
+        return out
+
+    def commit_page(
+        self,
+        page_id: int,
+        content_hash: bytes,
+        token_ids: list[int],
+        parent: bytes | None,
+    ) -> int:
+        """Register a now-full page's content for reuse.
+
+        Returns the canonical page id: if another page already holds this
+        content, callers should deduplicate onto it (we keep it simple and
+        just register the new page if the hash is absent).
+        """
+        if not self.enable_prefix_caching:
+            return page_id
+        existing = self._cached.get(content_hash)
+        if existing is not None and existing != page_id:
+            return existing
+        self._cached[content_hash] = page_id
+        self._meta[page_id].content_hash = content_hash
+        self.event_sink.blocks_stored([content_hash], parent, token_ids)
+        return page_id
+
+    def free(self, page_ids: Iterable[int]) -> None:
+        for pid in page_ids:
+            meta = self._meta[pid]
+            if meta.ref_count <= 0:
+                raise AssertionError(f"double free of page {pid}")
+            meta.ref_count -= 1
+            if meta.ref_count == 0:
+                # Cached pages go to the LRU tail (evicted last); uncached
+                # pages to the head (reused first).
+                self._free[pid] = None
+                if meta.content_hash is None:
+                    self._free.move_to_end(pid, last=False)
+
+    def clear(self) -> None:
+        for h in list(self._cached):
+            self._cached.pop(h)
+        for meta in self._meta:
+            meta.content_hash = None
+        self.event_sink.all_cleared()
+
+    def hit_ratio(self) -> float:
+        if not self.metrics_queries:
+            return 0.0
+        return self.metrics_hits / self.metrics_queries
+
+
+class NoFreePagesError(RuntimeError):
+    def __init__(self, wanted: int, available: int) -> None:
+        super().__init__(f"wanted {wanted} KV pages, {available} free")
+        self.wanted = wanted
+        self.available = available

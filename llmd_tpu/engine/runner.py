@@ -1,0 +1,192 @@
+"""Model runner: owns device state (params + KV pool) and the jitted step.
+
+Everything under jit is traced once per shape bucket and cached
+(compiler-friendly static shapes -- no data-dependent Python control flow).
+The runner pads each step's work to the nearest bucket:
+
+- decode: batch of running seqs padded to a batch bucket, Q=1
+- prefill: one seq per call, chunk padded to a token bucket
+
+This is the classic split-step TPU schedule; the ragged Pallas kernel path
+(mixed prefill+decode in one launch) plugs in behind the same interface.
+
+KV pool: ONE jax.Array [L, pages, page, K, 2D] sharded over tp on the KV
+head axis, donated through the step so XLA updates it in place.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmd_tpu.config import EngineConfig
+from llmd_tpu.engine.sampler import SamplingInputs, sample_tokens
+from llmd_tpu.engine.scheduler import ScheduledSeq
+from llmd_tpu.models import llama
+from llmd_tpu.models.common import StepInput
+from llmd_tpu.parallel.mesh import KV_CACHE_SPEC, MeshContext, shard_params
+
+
+def _buckets(limit: int, start: int = 8) -> tuple[int, ...]:
+    out, b = [], start
+    while b < limit:
+        out.append(b)
+        b *= 2
+    out.append(limit)
+    return tuple(dict.fromkeys(out))
+
+
+def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class StepResult:
+    tokens: np.ndarray  # [B] sampled token per row
+    logprobs: np.ndarray  # [B]
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: EngineConfig,
+        mesh_ctx: MeshContext,
+        params: dict | None = None,
+    ) -> None:
+        self.config = config
+        self.cfg = config.model
+        self.ctx = mesh_ctx
+        self.max_pages = config.cache.max_pages_per_seq(self.cfg.max_model_len)
+        self.page = config.cache.page_size
+
+        if params is None:
+            params = llama.init_params(self.cfg, jax.random.key(config.seed))
+        self.params = shard_params(params, mesh_ctx)
+        self.kv_cache = self._alloc_kv()
+        self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
+
+        sched = config.scheduler
+        self.decode_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
+        self.prefill_buckets = sched.prefill_token_buckets or _buckets(
+            sched.max_num_batched_tokens, start=16
+        )
+        self._step = self._build_step()
+
+    # ------------------------------------------------------------------ #
+
+    def _alloc_kv(self) -> jax.Array:
+        c = self.config.cache
+        shape = (
+            self.cfg.num_layers,
+            c.num_blocks,
+            c.page_size,
+            self.cfg.num_kv_heads,
+            2 * self.cfg.head_dim,
+        )
+        return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*KV_CACHE_SPEC))
+
+    def kv_bytes(self) -> int:
+        return self.kv_cache.size * self.kv_cache.dtype.itemsize
+
+    def _build_step(self):
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, kv_cache, inp: StepInput, s: SamplingInputs):
+            hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg)
+            B = hidden.shape[0]
+            last = jnp.maximum(inp.query_lens - 1, 0)
+            h_last = hidden[jnp.arange(B), last]  # [B, H]
+            logits = llama.compute_logits(params, h_last, cfg)
+            tokens, logprobs = sample_tokens(logits, s)
+            return kv_cache, tokens, logprobs
+
+        return step
+
+    # ------------------------------------------------------------------ #
+    # host-side input prep
+
+    def _sampling_inputs(self, seqs: list[ScheduledSeq], B: int) -> SamplingInputs:
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        seeds = self._np_rng.integers(0, 2**32, size=B, dtype=np.uint32)
+        for i, s in enumerate(seqs):
+            sp = s.request.sampling
+            temp[i] = 0.0 if sp.greedy else sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            if sp.seed is not None:
+                # Deterministic per (request seed, output index): resubmitting
+                # the same seeded request reproduces its tokens regardless of
+                # batch-mates.
+                pos = s.request.total_output_tokens
+                seeds[i] = np.uint32((sp.seed * 1000003 + pos) & 0xFFFFFFFF)
+        return SamplingInputs(
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+            seeds=jnp.asarray(seeds),
+        )
+
+    def _page_table(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
+        pt = np.zeros((B, self.max_pages), np.int32)
+        for i, s in enumerate(seqs):
+            ids = s.request.block_ids
+            pt[i, : len(ids)] = ids
+        return pt
+
+    def run_decode(self, seqs: list[ScheduledSeq]) -> StepResult:
+        """One decode token for each running sequence."""
+        n = len(seqs)
+        B = pad_to_bucket(n, self.decode_buckets)
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        qlens = np.zeros(B, np.int32)
+        kvlens = np.zeros(B, np.int32)
+        for i, s in enumerate(seqs):
+            req = s.request
+            tokens[i, 0] = req.all_token_ids[req.num_computed_tokens]
+            positions[i, 0] = req.num_computed_tokens
+            qlens[i] = 1
+            kvlens[i] = req.num_computed_tokens + 1
+        inp = StepInput(
+            token_ids=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            query_lens=jnp.asarray(qlens),
+            kv_lens=jnp.asarray(kvlens),
+            page_table=jnp.asarray(self._page_table(seqs, B)),
+        )
+        self.kv_cache, tok, logp = self._step(
+            self.params, self.kv_cache, inp, self._sampling_inputs(seqs, B)
+        )
+        return StepResult(np.asarray(tok)[:n], np.asarray(logp)[:n])
+
+    def run_prefill(self, seq: ScheduledSeq) -> StepResult:
+        """One prompt chunk for one sequence (B=1, Q=bucket)."""
+        req = seq.request
+        start, n = req.num_computed_tokens, seq.num_tokens
+        Q = pad_to_bucket(n, self.prefill_buckets)
+        chunk = req.all_token_ids[start : start + n]
+        tokens = np.zeros((1, Q), np.int32)
+        tokens[0, :n] = chunk
+        positions = np.full((1, Q), start + max(n - 1, 0), np.int32)
+        positions[0, :n] = np.arange(start, start + n)
+        inp = StepInput(
+            token_ids=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            query_lens=jnp.asarray([n], np.int32),
+            kv_lens=jnp.asarray([start + n], np.int32),
+            page_table=jnp.asarray(self._page_table([seq], 1)),
+        )
+        self.kv_cache, tok, logp = self._step(
+            self.params, self.kv_cache, inp, self._sampling_inputs([seq], 1)
+        )
+        return StepResult(np.asarray(tok)[:1], np.asarray(logp)[:1])

@@ -1,0 +1,59 @@
+"""Token sampling on device: temperature / top-k / top-p / greedy.
+
+One jit-traced function over the whole batch; per-request knobs arrive as
+arrays so one compiled program serves any mix of greedy and sampled
+sequences (no recompilation per sampling config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SamplingInputs:
+    temperature: jax.Array  # [B] f32; <=1e-5 means greedy
+    top_k: jax.Array  # [B] i32; 0 disables
+    top_p: jax.Array  # [B] f32; 1.0 disables
+    # Per-row PRNG seed: rows with SamplingParams.seed get a deterministic
+    # seed derived from (seed, output position); others get engine-RNG draws.
+    seeds: jax.Array  # [B] u32
+
+
+def sample_tokens(
+    logits: jax.Array, s: SamplingInputs
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (token_ids [B] i32, logprobs [B] f32 of the chosen token)."""
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(s.temperature, 1e-5)[:, None]
+    scaled = logits / temp
+
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+    # top-k: keep values >= k-th largest (k=0 -> keep all).
+    k = jnp.where(s.top_k > 0, s.top_k, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus): smallest prefix of the sorted dist with mass >= top_p.
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    keep_sorted = (cum - probs_sorted) < s.top_p[:, None]  # always keeps rank 0
+    num_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1), 1)
+    p_thresh = jnp.take_along_axis(sorted_desc, (num_keep - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled >= p_thresh, scaled, -jnp.inf)
+
+    keys = jax.vmap(jax.random.key)(s.seeds)
+    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (V,), jnp.float32))(keys)
+    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1)
+
+    tokens = jnp.where(s.temperature <= 1e-5, greedy_tok, sampled_tok)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen_logp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    return tokens.astype(jnp.int32), chosen_logp

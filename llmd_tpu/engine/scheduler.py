@@ -1,0 +1,292 @@
+"""Continuous-batching scheduler with chunked prefill and prefix caching.
+
+This is the engine-side scheduler (the reference delegates it to vLLM's
+continuous batching; docs/architecture/core/model-servers.md:5-7), distinct
+from the EPP *request* scheduler in ``llmd_tpu.epp``. Every engine step it
+selects a token budget's worth of work: one token per running decode
+sequence, plus prompt chunks for waiting/prefilling sequences (chunked
+prefill so long prompts never starve decodes -- the reference's
+--max-num-batched-tokens / --long-prefill-token-threshold pattern,
+guides/agentic-serving/modelserver/tpu/vllm/patch-vllm.yaml:39).
+
+Preemption is recompute-style: when KV pages run out, the youngest running
+sequence is evicted, its pages freed, and it restarts from the waiting queue
+(its generated tokens are folded into the prompt).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+
+from llmd_tpu.config import CacheConfig, SchedulerConfig
+from llmd_tpu.engine.kv_cache import (
+    NoFreePagesError,
+    PageAllocator,
+    _ROOT_HASH,
+    hash_page,
+)
+from llmd_tpu.engine.request import FinishReason, Request, RequestStatus
+
+
+@dataclasses.dataclass
+class ScheduledSeq:
+    request: Request
+    num_tokens: int  # tokens to compute for this seq in this step
+
+    @property
+    def start_pos(self) -> int:
+        return self.request.num_computed_tokens
+
+
+@dataclasses.dataclass
+class ScheduledBatch:
+    prefills: list[ScheduledSeq]
+    decodes: list[ScheduledSeq]
+
+    @property
+    def seqs(self) -> list[ScheduledSeq]:
+        return self.prefills + self.decodes
+
+    @property
+    def total_tokens(self) -> int:
+        return sum(s.num_tokens for s in self.seqs)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.prefills and not self.decodes
+
+
+class EngineScheduler:
+    def __init__(
+        self,
+        scheduler_config: SchedulerConfig,
+        cache_config: CacheConfig,
+        allocator: PageAllocator,
+        max_model_len: int,
+    ) -> None:
+        self.config = scheduler_config
+        self.cache_config = cache_config
+        self.allocator = allocator
+        self.max_model_len = max_model_len
+        # Ordered by (-priority, arrival_time): higher priority first, FCFS
+        # within a priority class (the InferenceObjective priority semantics,
+        # reference docs/api-reference/*.md).
+        self.waiting: list[Request] = []
+        self.running: list[Request] = []
+        self.num_preemptions = 0
+        # request_id -> committed page hash chain tail + count
+        self._chain: dict[str, tuple[bytes, int]] = {}
+
+    # ------------------------------------------------------------------ #
+    # queue management
+
+    def add_request(self, request: Request) -> None:
+        request.status = RequestStatus.WAITING
+        bisect.insort(
+            self.waiting, request, key=lambda r: (-r.priority, r.arrival_time)
+        )
+
+    def abort_request(self, request_id: str) -> Request | None:
+        for req in self.running:
+            if req.request_id == request_id:
+                self._release(req)
+                self.running.remove(req)
+                req.finish(FinishReason.ABORT)
+                return req
+        for req in list(self.waiting):
+            if req.request_id == request_id:
+                self.waiting.remove(req)
+                self._release(req)
+                req.finish(FinishReason.ABORT)
+                return req
+        return None
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ------------------------------------------------------------------ #
+    # scheduling
+
+    def schedule(self) -> ScheduledBatch:
+        budget = self.config.max_num_batched_tokens
+        decodes: list[ScheduledSeq] = []
+        prefills: list[ScheduledSeq] = []
+        scheduled: set[str] = set()
+
+        # 1. Decodes: one token per running sequence already past its prompt.
+        #    Sequences still mid-prompt (chunked prefill in flight) are
+        #    handled in the prefill pass below.
+        for req in list(self.running):
+            if not req.in_decode or req.request_id in scheduled:
+                continue
+            if budget <= 0:
+                break
+            if not self._ensure_pages(req, 1):
+                # Never evict a sequence already placed in this step's batch:
+                # its pages would be freed while the runner still writes them.
+                if not self._preempt_for(req, exclude=scheduled):
+                    continue
+                if not self._ensure_pages(req, 1):
+                    continue
+            decodes.append(ScheduledSeq(req, 1))
+            scheduled.add(req.request_id)
+            budget -= 1
+
+        # 2. Continue chunked prefills of already-running sequences.
+        for req in self.running:
+            if req.in_decode or budget <= 0:
+                continue
+            chunk = min(req.num_prompt_tokens - req.num_computed_tokens, budget)
+            if chunk <= 0:
+                continue
+            if not self._ensure_pages(req, chunk):
+                continue
+            prefills.append(ScheduledSeq(req, chunk))
+            budget -= chunk
+
+        # 3. Admit waiting sequences FCFS (priority folded in by sort on add).
+        while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
+            req = self.waiting[0]
+            if req.num_computed_tokens == 0:
+                self._apply_prefix_cache(req)
+            remaining = req.num_prompt_tokens - req.num_computed_tokens
+            chunk = min(remaining, budget)
+            if chunk <= 0:
+                break
+            if not self.config.enable_chunked_prefill and chunk < remaining:
+                break  # whole-prompt admission only
+            if not self._ensure_pages(req, chunk):
+                break  # out of pages; retry next step
+            self.waiting.pop(0)
+            req.status = RequestStatus.RUNNING
+            self.running.append(req)
+            prefills.append(ScheduledSeq(req, chunk))
+            budget -= chunk
+
+        return ScheduledBatch(prefills=prefills, decodes=decodes)
+
+    def _apply_prefix_cache(self, req: Request) -> None:
+        """Reuse cached full pages covering the prompt prefix."""
+        if req.block_ids:
+            return
+        cached = self.allocator.lookup_cached_prefix(req.prompt_token_ids)
+        # Never satisfy the *entire* prompt from cache: the last token must be
+        # computed so the step emits logits for sampling.
+        max_cached = (req.num_prompt_tokens - 1) // self.allocator.page_size
+        cached = cached[:max_cached]
+        if not cached:
+            return
+        self.allocator.touch(cached)
+        req.block_ids.extend(cached)
+        n = len(cached)
+        req.num_cached_tokens = n * self.allocator.page_size
+        req.num_computed_tokens = req.num_cached_tokens
+        parent = _ROOT_HASH
+        for i in range(n):
+            parent = hash_page(
+                parent, req.prompt_token_ids[i * self.allocator.page_size : (i + 1) * self.allocator.page_size]
+            )
+        self._chain[req.request_id] = (parent, n)
+
+    def _ensure_pages(self, req: Request, new_tokens: int) -> bool:
+        need_slots = req.num_computed_tokens + new_tokens
+        need_pages = -(-need_slots // self.allocator.page_size)
+        missing = need_pages - len(req.block_ids)
+        if missing <= 0:
+            return True
+        try:
+            req.block_ids.extend(self.allocator.allocate(missing))
+            return True
+        except NoFreePagesError:
+            return False
+
+    def _preempt_for(self, req: Request, exclude: set[str] = frozenset()) -> bool:
+        """Evict the youngest other running sequence to recompute later."""
+        victims = [
+            r for r in self.running
+            if r is not req and r.request_id not in exclude
+        ]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: (r.priority * -1, r.arrival_time))
+        self._release(victim)
+        self.running.remove(victim)
+        # Fold generated tokens into the prompt and restart from scratch.
+        victim.num_prior_output_tokens += len(victim.output_token_ids)
+        victim.prompt_token_ids = victim.all_token_ids
+        victim.output_token_ids = []
+        self.num_preemptions += 1
+        victim.num_computed_tokens = 0
+        victim.num_cached_tokens = 0
+        victim.status = RequestStatus.PREEMPTED
+        # insort keeps the victim FCFS-ordered by its original arrival time
+        # within its priority class, so it resumes ahead of newer arrivals.
+        bisect.insort(
+            self.waiting, victim, key=lambda r: (-r.priority, r.arrival_time)
+        )
+        return True
+
+    def _release(self, req: Request) -> None:
+        if req.block_ids:
+            self.allocator.free(req.block_ids)
+            req.block_ids = []
+        self._chain.pop(req.request_id, None)
+
+    # ------------------------------------------------------------------ #
+    # post-step bookkeeping
+
+    def update_after_step(
+        self, batch: ScheduledBatch, sampled: dict[str, int]
+    ) -> list[Request]:
+        """Advance state after the device step; returns finished requests."""
+        finished: list[Request] = []
+        for seq in batch.seqs:
+            req = seq.request
+            req.num_computed_tokens += seq.num_tokens
+            if req.num_computed_tokens >= req.num_prompt_tokens:
+                token = sampled[req.request_id]
+                req.output_token_ids.append(token)
+                reason = self._check_stop(req, token)
+                if reason is not None:
+                    self._release(req)
+                    self.running.remove(req)
+                    req.finish(reason)
+                    finished.append(req)
+                    continue
+            self._commit_full_pages(req)
+        return finished
+
+    def _check_stop(self, req: Request, token: int) -> FinishReason | None:
+        s = req.sampling
+        if not s.ignore_eos and token in s.stop_token_ids:
+            return FinishReason.STOP
+        if req.total_output_tokens >= s.max_tokens:
+            return FinishReason.LENGTH
+        if req.num_tokens >= self.max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    def _commit_full_pages(self, req: Request) -> None:
+        """Register newly-completed full pages in the prefix index."""
+        page = self.allocator.page_size
+        parent, committed = self._chain.get(req.request_id, (_ROOT_HASH, 0))
+        # Only KV already computed counts; the just-sampled token's KV is not
+        # yet written (it is written when fed as input next step).
+        full = req.num_computed_tokens // page
+        tokens = req.all_token_ids
+        while committed < full:
+            chunk = tokens[committed * page : (committed + 1) * page]
+            h = hash_page(parent, chunk)
+            self.allocator.commit_page(req.block_ids[committed], h, chunk, parent)
+            parent = h
+            committed += 1
+        self._chain[req.request_id] = (parent, committed)

@@ -1,0 +1,4 @@
+"""Model families: dense Llama-class (Llama-2/3, Qwen2) and MoE
+(Mixtral, DeepSeek-style wide-EP)."""
+
+from llmd_tpu.models.registry import get_model_config, register_model  # noqa: F401

@@ -1,0 +1,65 @@
+"""Shared model building blocks (functional, jit-friendly)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from llmd_tpu.config import ModelConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StepInput:
+    """Device inputs for one forward step (static shapes per bucket).
+
+    token_ids:  [B, Q] input token ids (padded)
+    positions:  [B, Q] absolute positions (padded rows repeat last valid)
+    query_lens: [B] valid token count per row
+    kv_lens:    [B] total valid kv length per seq AFTER this step's writes
+    page_table: [B, max_pages] physical page ids
+    """
+
+    token_ids: jax.Array
+    positions: jax.Array
+    query_lens: jax.Array
+    kv_lens: jax.Array
+    page_table: jax.Array
+
+    @property
+    def valid(self) -> jax.Array:  # [B, Q] bool
+        B, Q = self.token_ids.shape
+        return jnp.arange(Q)[None, :] < self.query_lens[:, None]
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(dtype) * weight
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary embedding: [..., head_dim//2], f32."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate [B, Q, N, D] with tables [B, Q, half] (HF 'split-half' layout)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[..., None, :]  # broadcast over heads
+    s = sin[..., None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+def param_dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)

@@ -1,0 +1,126 @@
+"""Llama-class decoder (covers Llama-2/3, Qwen2, Mixtral/MoE via config).
+
+Functional, TPU-first: layer params are STACKED along a leading L axis and
+the forward pass is one ``lax.scan`` over layers -- one XLA while-loop body
+instead of L inlined layers, so compile time is O(1) in depth and the paged
+KV cache ([L, pages, page, K, 2D]) is scanned in lock-step.
+
+Reference parity: this is the model-execution role the reference delegates
+to vLLM (docs/architecture/core/model-servers.md:3-25); the MoE path is the
+wide-EP target (docs/architecture/foundations/wide-expert-parallelism.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from llmd_tpu.config import ModelConfig
+from llmd_tpu.models.common import StepInput, apply_rope, param_dtype, rms_norm, rope_tables
+from llmd_tpu.models.moe import moe_block
+from llmd_tpu.ops.paged_attention import paged_attention_xla, write_kv_pages
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    """Deterministic random init (used for tests/bench and as the template
+    for weight loading)."""
+    dt = param_dtype(cfg)
+    H, D = cfg.hidden_size, cfg.head_dim
+    Nq, K, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    F, V = cfg.intermediate_size, cfg.vocab_size
+
+    def mk(name: str, shape: tuple[int, ...], scale: float | None = None) -> jax.Array:
+        k = jax.random.fold_in(key, hash(name) % (2**31))
+        if scale is None:
+            scale = shape[-2] ** -0.5 if len(shape) >= 2 else 1.0
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    layers: dict[str, jax.Array] = {
+        "input_norm": jnp.ones((L, H), dt),
+        "post_norm": jnp.ones((L, H), dt),
+        "wq": mk("wq", (L, H, Nq * D)),
+        "wk": mk("wk", (L, H, K * D)),
+        "wv": mk("wv", (L, H, K * D)),
+        "wo": mk("wo", (L, Nq * D, H)),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, Nq * D), dt)
+        layers["bk"] = jnp.zeros((L, K * D), dt)
+        layers["bv"] = jnp.zeros((L, K * D), dt)
+    if cfg.is_moe:
+        E, Fm = cfg.num_experts, cfg.moe_intermediate_size
+        layers["router"] = mk("router", (L, H, E), scale=H**-0.5)
+        layers["we_gate"] = mk("we_gate", (L, E, H, Fm))
+        layers["we_up"] = mk("we_up", (L, E, H, Fm))
+        layers["we_down"] = mk("we_down", (L, E, Fm, H))
+        if cfg.shared_expert_intermediate_size:
+            Fs = cfg.shared_expert_intermediate_size
+            layers["ws_gate"] = mk("ws_gate", (L, H, Fs))
+            layers["ws_up"] = mk("ws_up", (L, H, Fs))
+            layers["ws_down"] = mk("ws_down", (L, Fs, H))
+    else:
+        layers["w_gate"] = mk("w_gate", (L, H, F))
+        layers["w_up"] = mk("w_up", (L, H, F))
+        layers["w_down"] = mk("w_down", (L, F, H))
+
+    params: dict = {
+        "embed": mk("embed", (V, H), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = mk("lm_head", (H, V))
+    return params
+
+
+def _mlp(h: jax.Array, lp: dict) -> jax.Array:
+    gate = jax.nn.silu(h @ lp["w_gate"])
+    return (gate * (h @ lp["w_up"])) @ lp["w_down"]
+
+
+def forward_hidden(
+    params: dict,
+    kv_cache: jax.Array,  # [L, pages, page, K, 2D]
+    inp: StepInput,
+    cfg: ModelConfig,
+) -> tuple[jax.Array, jax.Array]:
+    """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache)."""
+    B, Q = inp.token_ids.shape
+    D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    x = params["embed"][inp.token_ids]  # [B, Q, H]
+    cos, sin = rope_tables(inp.positions, D, cfg.rope_theta)
+    valid = inp.valid
+    sm_scale = D**-0.5
+
+    def layer_fn(x, scanned):
+        lp, cache = scanned
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = apply_rope(q.reshape(B, Q, Nq, D), cos, sin)
+        k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
+        v = v.reshape(B, Q, K, D)
+        cache = write_kv_pages(cache, k, v, inp.page_table, inp.positions, valid)
+        attn = paged_attention_xla(
+            q, cache, inp.page_table, inp.kv_lens, inp.positions, sm_scale
+        )
+        x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
+        h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            out = moe_block(h2, lp, cfg)
+        else:
+            out = _mlp(h2, lp)
+        return x + out, cache
+
+    hidden, new_cache = jax.lax.scan(layer_fn, x, (params["layers"], kv_cache))
+    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    return hidden, new_cache
+
+
+def compute_logits(params: dict, hidden: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Project hidden states [N, H] -> logits [N, V] (f32 for sampling)."""
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (hidden @ head).astype(jnp.float32)

@@ -1,0 +1,66 @@
+"""Mixture-of-experts block: router + expert FFNs.
+
+Wide-EP target (reference docs/architecture/foundations/
+wide-expert-parallelism.md:5-30): experts sharded over the flattened
+(dp, tp) mesh axes, dispatch/combine as all-to-all over ICI replacing the
+reference's DeepEP/NVSHMEM kernels.
+
+Two paths behind ``moe_block``:
+
+- dense combine (default inside jit): every token's hidden state is
+  contracted against ALL experts with a top-k one-hot combine weight. With
+  experts sharded over (dp, tp) XLA turns this into an all-gather of the
+  token batch onto the expert shards plus local GEMMs -- the
+  "high-throughput" shape of the reference's deepep_high_throughput mode.
+  Numerically exact; compute cost E/topk over-work, acceptable at small E
+  or big batches (prefill).
+- ``moe_block_ep`` (llmd_tpu.parallel.moe_ep): explicit shard_map
+  dispatch/combine with lax.all_to_all and per-expert grouped GEMM -- the
+  deepep_low_latency analogue for decode. Used when the caller runs inside
+  shard_map (wide-EP engine mode).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from llmd_tpu.config import ModelConfig
+
+
+def router_topk(
+    h: jax.Array, w_router: jax.Array, top_k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Softmax-then-topk routing (Mixtral-style, renormalized).
+
+    h: [T, H]; returns (weights [T, k] f32, expert_ids [T, k] i32).
+    """
+    logits = (h.astype(jnp.float32) @ w_router.astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, ids = jax.lax.top_k(probs, top_k)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, ids
+
+
+def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
+    """MoE FFN on [B, Q, H] -> [B, Q, H] (dense-combine path)."""
+    B, Q, H = h.shape
+    T = B * Q
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    ht = h.reshape(T, H)
+    weights, ids = router_topk(ht, lp["router"], k)
+    # combine[t, e] = sum_j weights[t, j] * (ids[t, j] == e)
+    combine = jnp.zeros((T, E), jnp.float32)
+    combine = combine.at[jnp.arange(T)[:, None], ids].add(weights)
+
+    # All experts on all tokens; contributions weighted by combine.
+    gate = jax.nn.silu(jnp.einsum("th,ehf->etf", ht, lp["we_gate"]))
+    up = jnp.einsum("th,ehf->etf", ht, lp["we_up"])
+    per_expert = jnp.einsum("etf,efh->eth", gate * up, lp["we_down"])  # [E,T,H]
+    out = jnp.einsum("eth,te->th", per_expert.astype(jnp.float32), combine)
+    out = out.astype(h.dtype)
+
+    if cfg.shared_expert_intermediate_size:
+        g = jax.nn.silu(ht @ lp["ws_gate"])
+        out = out + (g * (ht @ lp["ws_up"])) @ lp["ws_down"]
+    return out.reshape(B, Q, H)

@@ -1,0 +1,122 @@
+"""Named model configurations.
+
+Covers the model families the reference's guides deploy (SURVEY.md section 6
+/ BASELINE.json configs): Llama-3 (8B/70B), Qwen2/Qwen3-class dense,
+Mixtral 8x7B/8x22B and DeepSeek-style wide-EP MoE. Exact hyperparameters
+follow the public HF configs for each family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from llmd_tpu.config import ModelConfig, tiny_model_config
+
+_REGISTRY: dict[str, Callable[[], ModelConfig]] = {}
+
+
+def register_model(name: str):
+    def deco(fn: Callable[[], ModelConfig]):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]()
+    if not overrides:
+        return cfg
+    # Rebuild so derived fields (head_dim, moe_intermediate_size) are
+    # re-derived when their bases change, unless they were explicitly set.
+    kw = dataclasses.asdict(cfg)
+    if cfg.head_dim == cfg.hidden_size // cfg.num_heads and "head_dim" not in overrides:
+        kw["head_dim"] = None
+    if (
+        cfg.moe_intermediate_size == cfg.intermediate_size
+        and "moe_intermediate_size" not in overrides
+    ):
+        kw["moe_intermediate_size"] = None
+    kw.update(overrides)
+    return ModelConfig(**kw)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+@register_model("tiny")
+def _tiny() -> ModelConfig:
+    return tiny_model_config()
+
+
+@register_model("tiny-moe")
+def _tiny_moe() -> ModelConfig:
+    return tiny_model_config(
+        name="tiny-moe", num_experts=8, num_experts_per_tok=2,
+        moe_intermediate_size=64,
+    )
+
+
+@register_model("llama-3-8b")
+def _llama3_8b() -> ModelConfig:
+    return ModelConfig(
+        name="llama-3-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=500000.0, max_model_len=8192,
+    )
+
+
+@register_model("llama-3-70b")
+def _llama3_70b() -> ModelConfig:
+    return ModelConfig(
+        name="llama-3-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        rope_theta=500000.0, max_model_len=8192,
+    )
+
+
+@register_model("qwen2-72b")
+def _qwen2_72b() -> ModelConfig:
+    return ModelConfig(
+        name="qwen2-72b", vocab_size=152064, hidden_size=8192,
+        intermediate_size=29568, num_layers=80, num_heads=64, num_kv_heads=8,
+        rope_theta=1000000.0, max_model_len=32768, attention_bias=True,
+        rms_norm_eps=1e-6,
+    )
+
+
+@register_model("mixtral-8x7b")
+def _mixtral_8x7b() -> ModelConfig:
+    return ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=1000000.0, max_model_len=32768,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=14336,
+    )
+
+
+@register_model("mixtral-8x22b")
+def _mixtral_8x22b() -> ModelConfig:
+    return ModelConfig(
+        name="mixtral-8x22b", vocab_size=32768, hidden_size=6144,
+        intermediate_size=16384, num_layers=56, num_heads=48, num_kv_heads=8,
+        rope_theta=1000000.0, max_model_len=65536,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=16384,
+    )
+
+
+@register_model("deepseek-moe-wide")
+def _deepseek_wide() -> ModelConfig:
+    """DeepSeek-R1-class wide-EP shape (GQA stand-in for MLA; 256 experts,
+    top-8, shared expert) -- the BASELINE.json config-3 target geometry."""
+    return ModelConfig(
+        name="deepseek-moe-wide", vocab_size=129280, hidden_size=7168,
+        intermediate_size=18432, num_layers=61, num_heads=128, num_kv_heads=16,
+        head_dim=64,
+        rope_theta=10000.0, max_model_len=16384,
+        num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
+        shared_expert_intermediate_size=2048,
+    )

@@ -1,0 +1,1 @@
+"""TPU compute kernels (Pallas) and their XLA reference fallbacks."""

@@ -1,0 +1,93 @@
+"""Paged attention over a block (page) table.
+
+TPU equivalent of the reference's FlashInfer / ragged-paged-attention path
+(SURVEY.md N8: reference ships FlashInfer CUDA kernels, and the TPU images
+use Pallas ragged paged attention). Two implementations behind one
+interface:
+
+- ``paged_attention_xla``: pure-XLA reference implementation (gather pages,
+  masked softmax). Correct everywhere (CPU test mesh included); used as the
+  numerical oracle for the Pallas kernel and as the fallback path.
+- ``paged_attention`` in ``llmd_tpu.ops.ragged_paged_attention``:
+  the Pallas TPU kernel (flash-style online softmax over pages).
+
+Layout conventions (TPU-first):
+  kv_cache (one layer): [num_pages, page_size, num_kv_heads, 2*head_dim]
+      (K in [..., :head_dim], V in [..., head_dim:] -- fused so a page is one
+      contiguous DMA)
+  q:          [B, Q, num_q_heads, head_dim]
+  page_table: [B, max_pages] int32
+  kv_lens:    [B] int32, total valid kv tokens per seq AFTER this step's
+              writes (so causality is enforced via per-token positions).
+  positions:  [B, Q] int32 absolute position of each query token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def write_kv_pages(
+    kv_cache: jax.Array,  # [num_pages, page, K, 2D]
+    k: jax.Array,  # [B, Q, K, D]
+    v: jax.Array,  # [B, Q, K, D]
+    page_table: jax.Array,  # [B, max_pages]
+    positions: jax.Array,  # [B, Q]
+    valid: jax.Array,  # [B, Q] bool
+) -> jax.Array:
+    """Scatter this step's K/V into their cache slots.
+
+    Slot of token (b, i) = page_table[b, pos // page] * page + pos % page.
+    Invalid (padding) tokens scatter out-of-bounds and are dropped.
+    """
+    num_pages, page, K, D2 = kv_cache.shape
+    D = D2 // 2
+    kv = jnp.concatenate([k, v], axis=-1)  # [B, Q, K, 2D]
+    page_idx = positions // page
+    offset = positions % page
+    phys = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, Q]
+    slots = phys * page + offset
+    slots = jnp.where(valid, slots, num_pages * page)  # OOB => dropped
+    flat = kv_cache.reshape(num_pages * page, K, D2)
+    flat = flat.at[slots.reshape(-1)].set(
+        kv.reshape(-1, K, D2).astype(flat.dtype), mode="drop"
+    )
+    return flat.reshape(kv_cache.shape)
+
+
+def paged_attention_xla(
+    q: jax.Array,  # [B, Q, H, D]
+    kv_cache: jax.Array,  # [num_pages, page, K, 2D]
+    page_table: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B]
+    positions: jax.Array,  # [B, Q]
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Reference paged attention: gather the whole context, masked softmax."""
+    B, Q, H, D = q.shape
+    num_pages, page, K, D2 = kv_cache.shape
+    max_pages = page_table.shape[1]
+    S = max_pages * page
+    if sm_scale is None:
+        sm_scale = D ** -0.5
+
+    flat = kv_cache.reshape(num_pages * page, K, D2)
+    token_idx = page_table[:, :, None] * page + jnp.arange(page)[None, None, :]
+    token_idx = token_idx.reshape(B, S)
+    kv = flat[token_idx]  # [B, S, K, 2D]
+    k = kv[..., :D].astype(jnp.float32)
+    v = kv[..., D:].astype(jnp.float32)
+
+    group = H // K
+    qf = q.astype(jnp.float32).reshape(B, Q, K, group, D)
+    scores = jnp.einsum("bqkgd,bskd->bqkgs", qf, k) * sm_scale  # [B,Q,K,g,S]
+
+    key_pos = jnp.arange(S)[None, None, :]  # [1,1,S]
+    causal = key_pos <= positions[:, :, None]  # [B,Q,S]
+    in_ctx = key_pos < kv_lens[:, None, None]  # [B,1,S]
+    mask = (causal & in_ctx)[:, :, None, None, :]  # [B,Q,1,1,S]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bqkgs,bskd->bqkgd", probs, v)
+    return out.reshape(B, Q, H, D).astype(q.dtype)

@@ -1,0 +1,3 @@
+"""Mesh construction and sharding rules (TP/DP/EP over ICI)."""
+
+from llmd_tpu.parallel.mesh import MeshContext, build_mesh  # noqa: F401

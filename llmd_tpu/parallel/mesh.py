@@ -1,0 +1,143 @@
+"""Device mesh and sharding rules.
+
+The reference scales with NCCL/NVSHMEM process groups per parallelism kind
+(TP all-reduce, DP supervisor ranks, DeepEP all-to-all; SURVEY.md 2.4/2.5).
+TPU-native, all of them are axes of ONE ``jax.sharding.Mesh`` laid out over
+ICI, and XLA inserts the collectives:
+
+- axis "tp"  -- tensor parallelism: weight matrices sharded on the
+  head/ffn dimension; activations replicated; XLA emits psum over ICI where
+  the reference runs NCCL all-reduce.
+- axis "dp"  -- data parallelism for attention: the batch dimension is
+  sharded; KV caches are fully local to each dp group (the property wide-EP
+  exploits to avoid MLA KV replication, reference
+  docs/architecture/foundations/wide-expert-parallelism.md:5-30).
+- experts are sharded over BOTH axes flattened ("dp","tp") -- wide EP: every
+  chip owns E/world experts while attention runs DP x TP. The MoE layer uses
+  shard_map + lax.all_to_all where the reference dispatches DeepEP/NVSHMEM
+  kernels (wide-expert-parallelism.md:20-30).
+
+Mesh axis order is ("dp", "tp") with tp innermost so TP collectives ride the
+fastest ICI dimension on a real slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llmd_tpu.config import ParallelConfig
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+# Expert parallelism spans the flattened (dp, tp) axes.
+EP_AXES = (DP_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshContext:
+    mesh: Mesh
+    dp: int
+    tp: int
+
+    @property
+    def world(self) -> int:
+        return self.dp * self.tp
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def build_mesh(
+    parallel: ParallelConfig | None = None,
+    devices: list | None = None,
+) -> MeshContext:
+    """Build the (dp, tp) mesh.
+
+    With a TPU slice, jax.devices() ordering already follows the physical
+    torus; jax.make_mesh picks an ICI-friendly assignment.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if parallel is None:
+        parallel = ParallelConfig(
+            tensor_parallel_size=len(devices), data_parallel_size=1
+        )
+    dp, tp = parallel.data_parallel_size, parallel.tensor_parallel_size
+    if dp * tp > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {dp*tp} devices, have {len(devices)}")
+    devs = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    mesh = Mesh(devs, (DP_AXIS, TP_AXIS))
+    return MeshContext(mesh=mesh, dp=dp, tp=tp)
+
+
+# ----------------------------------------------------------------------- #
+# Sharding rules: map param-tree leaf names -> PartitionSpec.
+# Layer stacks carry a leading L dim, hence the leading None.
+
+PARAM_SPECS: dict[str, P] = {
+    # [V, H]: shard vocab so the embed gather load-balances over tp.
+    "embed": P(TP_AXIS, None),
+    "final_norm": P(),
+    # [H, V]: column-parallel; logits all-gathered on the vocab axis.
+    "lm_head": P(None, TP_AXIS),
+    # layers.* ([L, ...])
+    "input_norm": P(None, None),
+    "post_norm": P(None, None),
+    "wq": P(None, None, TP_AXIS),   # [L, H, Nq*D] head-sharded
+    "wk": P(None, None, TP_AXIS),
+    "wv": P(None, None, TP_AXIS),
+    "wo": P(None, TP_AXIS, None),   # [L, Nq*D, H] row-parallel -> psum
+    "bq": P(None, TP_AXIS),
+    "bk": P(None, TP_AXIS),
+    "bv": P(None, TP_AXIS),
+    "w_gate": P(None, None, TP_AXIS),  # [L, H, F]
+    "w_up": P(None, None, TP_AXIS),
+    "w_down": P(None, TP_AXIS, None),  # [L, F, H]
+    # MoE: experts sharded over the flattened (dp, tp) axes = wide EP.
+    "router": P(None, None, None),       # [L, H, E] replicated (tiny)
+    "we_gate": P(None, EP_AXES, None, None),  # [L, E, H, Fm]
+    "we_up": P(None, EP_AXES, None, None),
+    "we_down": P(None, EP_AXES, None, None),  # [L, E, Fm, H]
+    "ws_gate": P(None, None, TP_AXIS),   # shared expert, TP like dense mlp
+    "ws_up": P(None, None, TP_AXIS),
+    "ws_down": P(None, TP_AXIS, None),
+}
+
+# KV cache [L, num_pages, page, K, 2D]: shard kv heads over tp; each dp group
+# holds its own full pool (allocated per dp rank at the engine level).
+KV_CACHE_SPEC = P(None, None, None, TP_AXIS, None)
+
+
+def param_specs(params: dict) -> dict:
+    """PartitionSpec tree matching a model param tree."""
+
+    def spec_for(name: str) -> P:
+        if name not in PARAM_SPECS:
+            raise KeyError(f"no sharding rule for param {name!r}")
+        return PARAM_SPECS[name]
+
+    out: dict = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out[k] = {kk: spec_for(kk) for kk in v}
+        else:
+            out[k] = spec_for(k)
+    return out
+
+
+def shard_params(params: dict, ctx: MeshContext) -> dict:
+    specs = param_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, ctx.sharding(*s)),
+        params,
+        specs,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )

@@ -1,0 +1,28 @@
+"""Test fixture: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's CPU-backend test substitute (SURVEY.md section 4.5:
+the CPU vLLM overlay exercises the full stack without accelerators); a
+host-platform device count of 8 lets TP/DP/EP sharding tests run anywhere.
+
+XLA_FLAGS must be set before jax import; the platform override must go
+through jax.config (env JAX_PLATFORMS can be pinned by the host harness).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs

@@ -1,0 +1,169 @@
+"""End-to-end engine tests on the CPU mesh (tiny model).
+
+The key invariance test: chunked prefill + paged KV + prefix caching +
+preemption must all produce exactly the same greedy tokens as a
+one-shot whole-prompt run -- the paged machinery may never change numerics.
+"""
+
+import numpy as np
+import pytest
+
+from llmd_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams
+
+
+def make_engine(
+    tp=1, num_blocks=64, page=4, max_batched=64, max_seqs=8, seed=0, **model_kw
+) -> LLMEngine:
+    cfg = EngineConfig(
+        model=tiny_model_config(**model_kw),
+        cache=CacheConfig(page_size=page, num_blocks=num_blocks, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=max_seqs, max_num_batched_tokens=max_batched
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=tp),
+        seed=seed,
+    )
+    return LLMEngine(cfg)
+
+
+PROMPTS = [
+    [1, 5, 9, 13, 2, 8],
+    [3, 3, 7, 1],
+    [1, 5, 9, 13, 2, 8, 4, 4, 4, 4, 6, 6, 6, 6, 11],
+]
+
+
+def test_greedy_generation_basic():
+    eng = make_engine()
+    out = eng.generate(PROMPTS, SamplingParams(temperature=0.0, max_tokens=8))
+    assert len(out) == 3
+    for toks in out.values():
+        assert len(toks) == 8
+        assert all(0 <= t < 256 for t in toks)
+
+
+def test_chunked_prefill_matches_oneshot():
+    long_prompt = list(np.random.default_rng(0).integers(0, 256, size=50))
+    ref = make_engine(max_batched=128).generate(
+        [long_prompt], SamplingParams(temperature=0.0, max_tokens=6)
+    )
+    # chunk size 16 forces multi-step prefill
+    chunked = make_engine(max_batched=16).generate(
+        [long_prompt], SamplingParams(temperature=0.0, max_tokens=6)
+    )
+    assert list(ref.values())[0] == list(chunked.values())[0]
+
+
+def test_batched_matches_single():
+    params = SamplingParams(temperature=0.0, max_tokens=6)
+    together = make_engine().generate(PROMPTS, params)
+    for i, p in enumerate(PROMPTS):
+        alone = make_engine().generate([p], params)
+        assert list(alone.values())[0] == list(together.values())[i], f"prompt {i}"
+
+
+def test_prefix_cache_reuse_preserves_output():
+    eng = make_engine()
+    prompt = list(range(1, 41))  # 40 tokens = 10 full pages
+    params = SamplingParams(temperature=0.0, max_tokens=5)
+    first = eng.generate([prompt], params)
+    hits_before = eng.allocator.metrics_hits
+    second = eng.generate([prompt], params)
+    assert list(first.values())[0] == list(second.values())[0]
+    assert eng.allocator.metrics_hits > hits_before  # cache actually used
+    # a fresh engine (cold cache) agrees too
+    cold = make_engine().generate([prompt], params)
+    assert list(cold.values())[0] == list(second.values())[0]
+
+
+def test_preemption_under_page_pressure():
+    # 12 pages of 4 tokens = 48 slots for 3 seqs x (10 prompt + 12 out) = 66:
+    # forces preemption + recompute; outputs must still match the
+    # unconstrained engine.
+    params = SamplingParams(temperature=0.0, max_tokens=12)
+    prompts = [list(rng) for rng in (range(10), range(20, 30), range(40, 50))]
+    small = make_engine(num_blocks=12).generate(prompts, params)
+    big = make_engine(num_blocks=64).generate(prompts, params)
+    assert small == {k: v for k, v in zip(small.keys(), big.values())}
+
+
+def test_stop_token():
+    eng = make_engine()
+    probe = eng.generate(
+        [PROMPTS[0]], SamplingParams(temperature=0.0, max_tokens=4)
+    )
+    tokens = list(probe.values())[0]
+    stop = tokens[1]
+    eng2 = make_engine()
+    out = eng2.generate(
+        [PROMPTS[0]],
+        SamplingParams(temperature=0.0, max_tokens=4, stop_token_ids=(stop,)),
+    )
+    assert list(out.values())[0] == tokens[:2]
+
+
+def test_sampling_with_seed_changes_tokens():
+    params = SamplingParams(temperature=1.0, top_k=50, max_tokens=16)
+    a = make_engine(seed=0).generate([PROMPTS[0]], params)
+    b = make_engine(seed=1).generate([PROMPTS[0]], params)
+    # different engine seeds should (overwhelmingly) differ
+    assert list(a.values())[0] != list(b.values())[0]
+
+
+def test_per_request_seed_reproducible():
+    params = SamplingParams(temperature=1.0, max_tokens=12, seed=1234)
+    # different engine seeds + different batch-mates: seeded request must
+    # still reproduce exactly
+    # same weights (engine seed) but different batch-mates / row position:
+    # the seeded request must still reproduce exactly
+    a = make_engine(seed=0).generate([PROMPTS[0]], [params])
+    b = make_engine(seed=0).generate(
+        [PROMPTS[1], PROMPTS[0]], [SamplingParams(max_tokens=12), params]
+    )
+    assert list(a.values())[0] == list(b.values())[1]
+
+
+def test_priority_admission_order():
+    eng = make_engine(max_seqs=8)
+    low = eng.add_request(PROMPTS[0], SamplingParams(max_tokens=2), priority=0)
+    high = eng.add_request(PROMPTS[1], SamplingParams(max_tokens=2), priority=5)
+    assert eng.scheduler.waiting[0].request_id == high
+    assert eng.scheduler.waiting[1].request_id == low
+
+
+def test_unchunkable_prompt_rejected():
+    import pytest as _pytest
+
+    eng = make_engine(max_batched=16)
+    eng.config.scheduler.enable_chunked_prefill = False
+    with _pytest.raises(ValueError):
+        eng.add_request(list(range(1, 30)))
+
+
+def test_tp2_matches_tp1(devices):
+    params = SamplingParams(temperature=0.0, max_tokens=6)
+    tp1 = make_engine(tp=1).generate(PROMPTS, params)
+    tp2 = make_engine(tp=2).generate(PROMPTS, params)
+    assert list(tp1.values()) == list(tp2.values())
+
+
+def test_moe_engine_runs():
+    eng = make_engine(
+        name="tiny-moe", num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=32,
+    )
+    out = eng.generate(PROMPTS[:2], SamplingParams(temperature=0.0, max_tokens=4))
+    assert all(len(v) == 4 for v in out.values())
+
+
+def test_max_model_len_rejected():
+    eng = make_engine()
+    with pytest.raises(ValueError):
+        eng.add_request(list(range(200)))  # max_model_len=128

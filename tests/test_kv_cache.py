@@ -1,0 +1,64 @@
+"""Unit tests for the page allocator + prefix cache."""
+
+import pytest
+
+from llmd_tpu.engine.kv_cache import (
+    NoFreePagesError,
+    PageAllocator,
+    page_hashes_for_tokens,
+)
+
+
+def test_alloc_free_roundtrip():
+    a = PageAllocator(num_pages=8, page_size=4)
+    pages = a.allocate(5)
+    assert len(set(pages)) == 5
+    assert a.num_free_pages == 3
+    a.free(pages)
+    assert a.num_free_pages == 8
+
+
+def test_out_of_pages():
+    a = PageAllocator(num_pages=4, page_size=4)
+    a.allocate(4)
+    with pytest.raises(NoFreePagesError):
+        a.allocate(1)
+
+
+def test_hash_chain_is_positional():
+    h1 = page_hashes_for_tokens([1, 2, 3, 4, 5, 6, 7, 8], page_size=4)
+    h2 = page_hashes_for_tokens([9, 9, 9, 9, 5, 6, 7, 8], page_size=4)
+    assert len(h1) == 2
+    # same second-page tokens but different parent => different hash
+    assert h1[1] != h2[1]
+
+
+def test_prefix_reuse_and_refcount():
+    a = PageAllocator(num_pages=8, page_size=4)
+    tokens = list(range(12))
+    pages = a.allocate(3)
+    hashes = page_hashes_for_tokens(tokens, 4)
+    parent = None
+    for pid, h in zip(pages, hashes):
+        a.commit_page(pid, h, [], parent)
+        parent = h
+    a.free(pages)  # refcount 0 but content cached
+    hit = a.lookup_cached_prefix(tokens)
+    assert hit == pages
+    a.touch(hit)
+    assert a.num_free_pages == 5
+    # partial prefix match
+    hit2 = a.lookup_cached_prefix(tokens[:8] + [99, 99, 99, 99])
+    assert hit2 == pages[:2]
+
+
+def test_eviction_drops_cached_content():
+    a = PageAllocator(num_pages=2, page_size=4)
+    pages = a.allocate(2)
+    hashes = page_hashes_for_tokens(list(range(8)), 4)
+    a.commit_page(pages[0], hashes[0], [], None)
+    a.commit_page(pages[1], hashes[1], [], hashes[0])
+    a.free(pages)
+    # allocating reuses the cached pages and invalidates their content
+    a.allocate(2)
+    assert a.lookup_cached_prefix(list(range(8))) == []
