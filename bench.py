@@ -1,0 +1,73 @@
+"""Single-chip serving throughput benchmark (driver contract).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: offline continuous-batching decode of a Llama-3.2-3B-class model
+(bf16, random weights) on the available TPU chip -- batch 32, 128-token
+prompts, 64 output tokens each, greedy. End-to-end through LLMEngine
+(scheduler + paged KV + sampling included), so host overhead counts.
+
+vs_baseline: ratio against the reference's closest per-chip decode figure,
+~1,600 output tok/s per decode GPU (DeepSeek-R1 wide-EP on 32xH200,
+reference guides/wide-ep-lws/README.md:271; see BASELINE.md). Different
+model/chip class, so this is a tracking ratio, not a like-for-like claim.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REFERENCE_PER_CHIP_TOKS = 1600.0  # wide-ep-lws/README.md:271
+
+
+def main() -> None:
+    import numpy as np
+
+    from llmd_tpu.config import CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+    from llmd_tpu.models.registry import get_model_config
+
+    B, ISL, OSL = 128, 128, 64
+    model = get_model_config("llama-3.2-3b", max_model_len=512)
+    cfg = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=16, num_blocks=2048, dtype="bfloat16"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=B, max_num_batched_tokens=2048, decode_window=16
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=0,
+    )
+    engine = LLMEngine(cfg)
+    rng = np.random.default_rng(0)
+    sampling = SamplingParams(temperature=0.0, max_tokens=OSL, ignore_eos=True)
+
+    # Warmup run on throwaway prompts: triggers every compile the workload
+    # shape needs (batched prefill + fused decode windows).
+    warm = [list(rng.integers(1, model.vocab_size, size=ISL)) for _ in range(B)]
+    engine.generate(warm, sampling)
+
+    prompts = [list(rng.integers(1, model.vocab_size, size=ISL)) for _ in range(B)]
+    t0 = time.monotonic()
+    out = engine.generate(prompts, sampling)
+    dt = time.monotonic() - t0
+    total_out = sum(len(v) for v in out.values())
+    assert total_out == B * OSL, (total_out, B * OSL)
+    toks_per_s = total_out / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "output tokens/s/chip (llama-3.2-3b-class bf16, "
+                "B=128 128in/64out, single chip, e2e engine)",
+                "value": round(toks_per_s, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(toks_per_s / REFERENCE_PER_CHIP_TOKS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,11 @@ class SchedulerConfig:
     # Token-count buckets used to pad jitted step shapes (compile-once).
     prefill_token_buckets: tuple[int, ...] = ()
     decode_batch_buckets: tuple[int, ...] = ()
+    # Fused decode window: K decode iterations per jit call with on-device
+    # token feedback (host sees one transfer per window). 1 = step-per-token.
+    # Larger K amortizes dispatch latency at the cost of K-token streaming
+    # granularity and bounded overrun past stop tokens.
+    decode_window: int = 1
 
 
 @dataclasses.dataclass

@@ -121,38 +121,43 @@ class LLMEngine:
         if batch.is_empty:
             return []
         now = time.monotonic()
-        sampled: dict[str, int] = {}
-        logprobs: dict[str, float] = {}
+        sampled: dict[str, list[int]] = {}
+        logprobs: dict[str, list[float]] = {}
 
-        for seq in batch.prefills:
-            res = self.runner.run_prefill(seq)
-            sampled[seq.request.request_id] = int(res.tokens[0])
-            logprobs[seq.request.request_id] = float(res.logprobs[0])
-            self.stats.prompt_tokens += seq.num_tokens
+        if batch.prefills:
+            res = self.runner.run_prefill(batch.prefills)
+            for i, seq in enumerate(batch.prefills):
+                sampled[seq.request.request_id] = res.tokens[i].tolist()
+                logprobs[seq.request.request_id] = res.logprobs[i].tolist()
+                self.stats.prompt_tokens += seq.num_tokens
         if batch.decodes:
-            res = self.runner.run_decode(batch.decodes)
+            k = batch.decodes[0].num_tokens
+            res = self.runner.run_decode(batch.decodes, k_steps=k)
             for i, seq in enumerate(batch.decodes):
-                sampled[seq.request.request_id] = int(res.tokens[i])
-                logprobs[seq.request.request_id] = float(res.logprobs[i])
+                sampled[seq.request.request_id] = res.tokens[i].tolist()
+                logprobs[seq.request.request_id] = res.logprobs[i].tolist()
 
-        finished = self.scheduler.update_after_step(batch, sampled)
+        accepted = self.scheduler.update_after_step(batch, sampled)
 
         outputs: list[RequestOutput] = []
+        finished = 0
         for seq in batch.seqs:
             req = seq.request
-            produced = req.in_decode and sampled.get(req.request_id) is not None
-            if not produced:
+            new_tokens = accepted.get(req.request_id)
+            if not new_tokens:
                 continue
             if req.first_token_time is None:
                 req.first_token_time = now
-            token = sampled[req.request_id]
             if req.sampling.logprobs:
-                req.output_logprobs.append(logprobs[req.request_id])
-            self.stats.generation_tokens += 1
+                req.output_logprobs.extend(
+                    logprobs[req.request_id][: len(new_tokens)]
+                )
+            self.stats.generation_tokens += len(new_tokens)
+            finished += int(req.is_finished)
             outputs.append(
                 RequestOutput(
                     request_id=req.request_id,
-                    new_token_ids=[token],
+                    new_token_ids=new_tokens,
                     finished=req.is_finished,
                     finish_reason=req.finish_reason,
                     num_prompt_tokens=req.num_prompt_tokens - req.num_prior_output_tokens,
@@ -160,7 +165,7 @@ class LLMEngine:
                     num_cached_tokens=req.num_cached_tokens,
                 )
             )
-        self.stats.requests_finished += len(finished)
+        self.stats.requests_finished += finished
         self._refresh_gauges()
         return outputs
 

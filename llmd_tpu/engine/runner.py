@@ -1,17 +1,22 @@
-"""Model runner: owns device state (params + KV pool) and the jitted step.
+"""Model runner: owns device state (params + KV pool) and the jitted steps.
 
-Everything under jit is traced once per shape bucket and cached
-(compiler-friendly static shapes -- no data-dependent Python control flow).
-The runner pads each step's work to the nearest bucket:
+TPU-first scheduling shapes (everything static per bucket, traced once):
 
-- decode: batch of running seqs padded to a batch bucket, Q=1
-- prefill: one seq per call, chunk padded to a token bucket
-
-This is the classic split-step TPU schedule; the ragged Pallas kernel path
-(mixed prefill+decode in one launch) plugs in behind the same interface.
+- **batched prefill**: all scheduled prompt chunks run in ONE call
+  [B_bucket, Q_bucket] -- one weight read per step instead of one per
+  sequence (HBM bandwidth is the bottleneck; see SURVEY.md section 7
+  "hard parts").
+- **multi-step decode**: K decode iterations fused into one jit call with a
+  ``lax.fori_loop`` that feeds each sampled token back as the next input
+  ON DEVICE. The host gets one packed transfer per K tokens, which
+  amortizes dispatch/transfer latency (the reference fights the same battle
+  with --enable-dbo / DP supervisor batching; on a remote-dispatch TPU
+  runtime the roundtrip is the whole game).
+- stop conditions are reconciled on host AFTER the window: tokens past a
+  stop are discarded and never committed to the prefix cache.
 
 KV pool: ONE jax.Array [L, pages, page, K, 2D] sharded over tp on the KV
-head axis, donated through the step so XLA updates it in place.
+head axis, donated through every step so XLA updates it in place.
 """
 
 from __future__ import annotations
@@ -49,8 +54,10 @@ def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
 
 @dataclass
 class StepResult:
-    tokens: np.ndarray  # [B] sampled token per row
-    logprobs: np.ndarray  # [B]
+    """Sampled tokens for each row; [B, K] (K=1 for single-shot calls)."""
+
+    tokens: np.ndarray
+    logprobs: np.ndarray
 
 
 class ModelRunner:
@@ -73,11 +80,12 @@ class ModelRunner:
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
         sched = config.scheduler
-        self.decode_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
+        self.batch_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
         self.prefill_buckets = sched.prefill_token_buckets or _buckets(
             sched.max_num_batched_tokens, start=16
         )
-        self._step = self._build_step()
+        self._forward = self._build_forward()
+        self._multi = self._build_multi()
 
     # ------------------------------------------------------------------ #
 
@@ -95,29 +103,94 @@ class ModelRunner:
     def kv_bytes(self) -> int:
         return self.kv_cache.size * self.kv_cache.dtype.itemsize
 
-    def _build_step(self):
+    def _build_forward(self):
         cfg = self.cfg
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def step(params, kv_cache, inp: StepInput, s: SamplingInputs):
+        @functools.partial(
+            jax.jit, donate_argnums=(1,), static_argnames=("all_greedy",)
+        )
+        def fwd(params, kv_cache, inp: StepInput, s: SamplingInputs, all_greedy=False):
             hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg)
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
-            h_last = hidden[jnp.arange(B), last]  # [B, H]
+            h_last = hidden[jnp.arange(B), last]
             logits = llama.compute_logits(params, h_last, cfg)
-            tokens, logprobs = sample_tokens(logits, s)
-            return kv_cache, tokens, logprobs
+            tokens, logprobs = sample_tokens(logits, s, all_greedy)
+            # Pack into one array => one host transfer for the whole step.
+            packed = jnp.concatenate(
+                [tokens.astype(jnp.float32)[:, None], logprobs[:, None]], axis=1
+            )
+            return kv_cache, packed
 
-        return step
+        return fwd
+
+    def _build_multi(self):
+        cfg = self.cfg
+
+        @functools.partial(
+            jax.jit, donate_argnums=(1,), static_argnames=("k_steps", "all_greedy")
+        )
+        def multi(
+            params,
+            kv_cache,
+            first_token: jax.Array,  # [B]
+            start_pos: jax.Array,  # [B] position of first_token
+            page_table: jax.Array,  # [B, max_pages]
+            active: jax.Array,  # [B] bool (pad rows False)
+            temperature: jax.Array,
+            top_k: jax.Array,
+            top_p: jax.Array,
+            seeds: jax.Array,  # [B, K]
+            k_steps: int,
+            all_greedy: bool = False,
+        ):
+            B = first_token.shape[0]
+
+            def body(i, carry):
+                kv_cache, tok, out_t, out_l = carry
+                pos = start_pos + i
+                inp = StepInput(
+                    token_ids=tok[:, None],
+                    positions=pos[:, None],
+                    query_lens=jnp.where(active, 1, 0).astype(jnp.int32),
+                    kv_lens=jnp.where(active, pos + 1, 0).astype(jnp.int32),
+                    page_table=page_table,
+                )
+                hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg)
+                logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
+                s = SamplingInputs(
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    seeds=jax.lax.dynamic_index_in_dim(
+                        seeds, i, axis=1, keepdims=False
+                    ),
+                )
+                nxt, logp = sample_tokens(logits, s, all_greedy)
+                out_t = jax.lax.dynamic_update_index_in_dim(out_t, nxt, i, axis=1)
+                out_l = jax.lax.dynamic_update_index_in_dim(out_l, logp, i, axis=1)
+                return kv_cache, nxt, out_t, out_l
+
+            out_t = jnp.zeros((B, k_steps), jnp.int32)
+            out_l = jnp.zeros((B, k_steps), jnp.float32)
+            kv_cache, _, out_t, out_l = jax.lax.fori_loop(
+                0, k_steps, body, (kv_cache, first_token, out_t, out_l)
+            )
+            packed = jnp.concatenate(
+                [out_t.astype(jnp.float32), out_l], axis=1
+            )  # [B, 2K]
+            return kv_cache, packed
+
+        return multi
 
     # ------------------------------------------------------------------ #
     # host-side input prep
 
-    def _sampling_inputs(self, seqs: list[ScheduledSeq], B: int) -> SamplingInputs:
+    def _sampling_arrays(self, seqs: list[ScheduledSeq], B: int, K: int = 1):
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
-        seeds = self._np_rng.integers(0, 2**32, size=B, dtype=np.uint32)
+        seeds = self._np_rng.integers(0, 2**32, size=(B, K), dtype=np.uint32)
         for i, s in enumerate(seqs):
             sp = s.request.sampling
             temp[i] = 0.0 if sp.greedy else sp.temperature
@@ -126,14 +199,19 @@ class ModelRunner:
             if sp.seed is not None:
                 # Deterministic per (request seed, output index): resubmitting
                 # the same seeded request reproduces its tokens regardless of
-                # batch-mates.
+                # batch-mates or window size.
                 pos = s.request.total_output_tokens
-                seeds[i] = np.uint32((sp.seed * 1000003 + pos) & 0xFFFFFFFF)
+                for j in range(K):
+                    seeds[i, j] = np.uint32((sp.seed * 1000003 + pos + j) & 0xFFFFFFFF)
+        return temp, top_k, top_p, seeds
+
+    def _sampling_inputs(self, seqs, B) -> SamplingInputs:
+        temp, top_k, top_p, seeds = self._sampling_arrays(seqs, B, 1)
         return SamplingInputs(
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
-            seeds=jnp.asarray(seeds),
+            seeds=jnp.asarray(seeds[:, 0]),
         )
 
     def _page_table(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
@@ -143,20 +221,48 @@ class ModelRunner:
             pt[i, : len(ids)] = ids
         return pt
 
-    def run_decode(self, seqs: list[ScheduledSeq]) -> StepResult:
-        """One decode token for each running sequence."""
+    @staticmethod
+    def _unpack(packed: jax.Array, n: int, K: int = 1) -> StepResult:
+        arr = np.asarray(packed)  # the ONE host transfer
+        tokens = arr[:n, :K].astype(np.int32)
+        logprobs = arr[:n, K:].astype(np.float32)
+        return StepResult(tokens, logprobs)
+
+    # ------------------------------------------------------------------ #
+
+    def run_prefill(self, seqs: list[ScheduledSeq]) -> StepResult:
+        """All scheduled prompt chunks, batched by Q bucket.
+
+        Rows are grouped so a single long chunk doesn't pad every short
+        chunk up to its bucket (padded compute stays ~sum of real tokens,
+        not B_bucket x max_chunk).
+        """
+        groups: dict[int, list[int]] = {}
+        for i, s in enumerate(seqs):
+            groups.setdefault(pad_to_bucket(s.num_tokens, self.prefill_buckets), []).append(i)
+        tokens = np.zeros((len(seqs), 1), np.int32)
+        logprobs = np.zeros((len(seqs), 1), np.float32)
+        for q_bucket, idxs in sorted(groups.items()):
+            res = self._run_prefill_group([seqs[i] for i in idxs], q_bucket)
+            for row, i in enumerate(idxs):
+                tokens[i] = res.tokens[row]
+                logprobs[i] = res.logprobs[row]
+        return StepResult(tokens, logprobs)
+
+    def _run_prefill_group(self, seqs: list[ScheduledSeq], Q: int) -> StepResult:
         n = len(seqs)
-        B = pad_to_bucket(n, self.decode_buckets)
-        tokens = np.zeros((B, 1), np.int32)
-        positions = np.zeros((B, 1), np.int32)
+        B = pad_to_bucket(n, self.batch_buckets)
+        tokens = np.zeros((B, Q), np.int32)
+        positions = np.zeros((B, Q), np.int32)
         qlens = np.zeros(B, np.int32)
         kvlens = np.zeros(B, np.int32)
         for i, s in enumerate(seqs):
-            req = s.request
-            tokens[i, 0] = req.all_token_ids[req.num_computed_tokens]
-            positions[i, 0] = req.num_computed_tokens
-            qlens[i] = 1
-            kvlens[i] = req.num_computed_tokens + 1
+            req, start, m = s.request, s.start_pos, s.num_tokens
+            tokens[i, :m] = req.all_token_ids[start : start + m]
+            positions[i, :m] = np.arange(start, start + m)
+            positions[i, m:] = start + max(m - 1, 0)
+            qlens[i] = m
+            kvlens[i] = start + m
         inp = StepInput(
             token_ids=jnp.asarray(tokens),
             positions=jnp.asarray(positions),
@@ -164,29 +270,106 @@ class ModelRunner:
             kv_lens=jnp.asarray(kvlens),
             page_table=jnp.asarray(self._page_table(seqs, B)),
         )
-        self.kv_cache, tok, logp = self._step(
-            self.params, self.kv_cache, inp, self._sampling_inputs(seqs, B)
+        self.kv_cache, packed = self._forward(
+            self.params,
+            self.kv_cache,
+            inp,
+            self._sampling_inputs(seqs, B),
+            all_greedy=all(s.request.sampling.greedy for s in seqs),
         )
-        return StepResult(np.asarray(tok)[:n], np.asarray(logp)[:n])
+        return self._unpack(packed, n)
 
-    def run_prefill(self, seq: ScheduledSeq) -> StepResult:
-        """One prompt chunk for one sequence (B=1, Q=bucket)."""
-        req = seq.request
-        start, n = req.num_computed_tokens, seq.num_tokens
-        Q = pad_to_bucket(n, self.prefill_buckets)
-        chunk = req.all_token_ids[start : start + n]
-        tokens = np.zeros((1, Q), np.int32)
-        tokens[0, :n] = chunk
-        positions = np.full((1, Q), start + max(n - 1, 0), np.int32)
-        positions[0, :n] = np.arange(start, start + n)
+    def run_decode(self, seqs: list[ScheduledSeq], k_steps: int = 1) -> StepResult:
+        """K fused decode iterations for the running batch (K=1 = one token)."""
+        n = len(seqs)
+        B = pad_to_bucket(n, self.batch_buckets)
+        first = np.zeros(B, np.int32)
+        start = np.zeros(B, np.int32)
+        active = np.zeros(B, bool)
+        for i, s in enumerate(seqs):
+            req = s.request
+            first[i] = req.all_token_ids[req.num_computed_tokens]
+            start[i] = req.num_computed_tokens
+            active[i] = True
+        temp, top_k, top_p, seeds = self._sampling_arrays(seqs, B, k_steps)
+        self.kv_cache, packed = self._multi(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(first),
+            jnp.asarray(start),
+            jnp.asarray(self._page_table(seqs, B)),
+            jnp.asarray(active),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(seeds),
+            k_steps=k_steps,
+            all_greedy=all(s.request.sampling.greedy for s in seqs),
+        )
+        return self._unpack(packed, n, k_steps)
+
+    # ------------------------------------------------------------------ #
+
+    def warmup(
+        self,
+        prefill_shapes: list[tuple[int, int]] | None = None,
+        decode_shapes: list[tuple[int, int]] | None = None,
+    ) -> int:
+        """Precompile the (bucketed) shapes the scheduler will produce.
+
+        The reference faces the same startup-compile problem on TPU
+        (SKIP_JAX_PRECOMPILE + 240x30s startup probes, SURVEY.md 3.4); here
+        warmup is explicit. Defaults compile the largest prefill shape and
+        the largest decode batch at windows {1, decode_window}. Returns the
+        number of programs compiled.
+        """
+        sched = self.config.scheduler
+        if prefill_shapes is None:
+            prefill_shapes = [(self.batch_buckets[-1], self.prefill_buckets[-1])]
+        if decode_shapes is None:
+            windows = sorted({1, sched.decode_window})
+            decode_shapes = [(self.batch_buckets[-1], k) for k in windows]
+        count = 0
+        for B, Q in prefill_shapes:
+            for greedy in (True, False):
+                self._warm_prefill(B, Q, greedy)
+                count += 1
+        for B, K in decode_shapes:
+            for greedy in (True, False):
+                self._warm_decode(B, K, greedy)
+                count += 1
+        return count
+
+    def _warm_prefill(self, B: int, Q: int, all_greedy: bool = False) -> None:
         inp = StepInput(
-            token_ids=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            query_lens=jnp.asarray([n], np.int32),
-            kv_lens=jnp.asarray([start + n], np.int32),
-            page_table=jnp.asarray(self._page_table([seq], 1)),
+            token_ids=jnp.zeros((B, Q), jnp.int32),
+            positions=jnp.zeros((B, Q), jnp.int32),
+            query_lens=jnp.zeros(B, jnp.int32),
+            kv_lens=jnp.zeros(B, jnp.int32),
+            page_table=jnp.zeros((B, self.max_pages), jnp.int32),
         )
-        self.kv_cache, tok, logp = self._step(
-            self.params, self.kv_cache, inp, self._sampling_inputs([seq], 1)
+        s = SamplingInputs(
+            temperature=jnp.zeros(B, jnp.float32),
+            top_k=jnp.zeros(B, jnp.int32),
+            top_p=jnp.ones(B, jnp.float32),
+            seeds=jnp.zeros(B, jnp.uint32),
         )
-        return StepResult(np.asarray(tok)[:1], np.asarray(logp)[:1])
+        self.kv_cache, _ = self._forward(
+            self.params, self.kv_cache, inp, s, all_greedy=all_greedy
+        )
+
+    def _warm_decode(self, B: int, K: int, all_greedy: bool = False) -> None:
+        self.kv_cache, _ = self._multi(
+            self.params,
+            self.kv_cache,
+            jnp.zeros(B, jnp.int32),
+            jnp.zeros(B, jnp.int32),
+            jnp.zeros((B, self.max_pages), jnp.int32),
+            jnp.zeros(B, bool),
+            jnp.zeros(B, jnp.float32),
+            jnp.zeros(B, jnp.int32),
+            jnp.ones(B, jnp.float32),
+            jnp.zeros((B, K), jnp.uint32),
+            k_steps=K,
+            all_greedy=all_greedy,
+        )

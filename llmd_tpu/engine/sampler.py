@@ -25,12 +25,21 @@ class SamplingInputs:
 
 
 def sample_tokens(
-    logits: jax.Array, s: SamplingInputs
+    logits: jax.Array, s: SamplingInputs, all_greedy: bool = False
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (token_ids [B] i32, logprobs [B] f32 of the chosen token)."""
+    """Returns (token_ids [B] i32, logprobs [B] f32 of the chosen token).
+
+    ``all_greedy`` is a trace-time flag (the host knows the batch's sampling
+    mix): it elides the sort/top-k/top-p/gumbel pipeline entirely, which
+    matters at TPU vocab sizes (two [B, 128k] sorts per decode step).
+    """
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
     greedy_tok = jnp.argmax(logits, axis=-1)
+    if all_greedy:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        chosen = jnp.take_along_axis(logp, greedy_tok[:, None], axis=-1)[:, 0]
+        return greedy_tok.astype(jnp.int32), chosen
 
     temp = jnp.maximum(s.temperature, 1e-5)[:, None]
     scaled = logits / temp

@@ -122,28 +122,49 @@ class EngineScheduler:
         prefills: list[ScheduledSeq] = []
         scheduled: set[str] = set()
 
-        # 1. Decodes: one token per running sequence already past its prompt.
-        #    Sequences still mid-prompt (chunked prefill in flight) are
-        #    handled in the prefill pass below.
-        for req in list(self.running):
-            if not req.in_decode or req.request_id in scheduled:
-                continue
+        decoding = [r for r in self.running if r.in_decode]
+        mid_prefill = [r for r in self.running if not r.in_decode]
+
+        # Fused K-step decode windows apply whenever this step cannot make
+        # admission progress anyway (no admissible waiting request, no
+        # in-flight prompt chunks) -- in particular in the saturated regime
+        # (running == max_num_seqs with a backlog), which is exactly where
+        # the dispatch amortization pays off. Otherwise K=1 keeps admission
+        # latency at one step. K is uniform across the batch (one compiled
+        # program) and capped so no seq can run past max_model_len.
+        window = self.config.decode_window
+        can_admit = bool(self.waiting) and len(self.running) < self.config.max_num_seqs
+        k = 1
+        if window > 1 and decoding and not mid_prefill and not can_admit:
+            k = max(
+                1,
+                min(
+                    window,
+                    min(self.max_model_len - r.num_computed_tokens for r in decoding),
+                ),
+            )
+
+        # 1. Decodes claim pages FIRST: a running decode must never be
+        #    starved by prefill admission taking the last free pages.
+        for req in decoding:
+            if req.status is not RequestStatus.RUNNING or not req.in_decode:
+                continue  # reset by a preemption earlier in this loop
             if budget <= 0:
                 break
-            if not self._ensure_pages(req, 1):
+            if not self._ensure_pages(req, k):
                 # Never evict a sequence already placed in this step's batch:
                 # its pages would be freed while the runner still writes them.
                 if not self._preempt_for(req, exclude=scheduled):
                     continue
-                if not self._ensure_pages(req, 1):
+                if not self._ensure_pages(req, k):
                     continue
-            decodes.append(ScheduledSeq(req, 1))
+            decodes.append(ScheduledSeq(req, k))
             scheduled.add(req.request_id)
             budget -= 1
 
         # 2. Continue chunked prefills of already-running sequences.
-        for req in self.running:
-            if req.in_decode or budget <= 0:
+        for req in mid_prefill:
+            if req.status is not RequestStatus.RUNNING or budget <= 0:
                 continue
             chunk = min(req.num_prompt_tokens - req.num_computed_tokens, budget)
             if chunk <= 0:
@@ -151,9 +172,10 @@ class EngineScheduler:
             if not self._ensure_pages(req, chunk):
                 continue
             prefills.append(ScheduledSeq(req, chunk))
+            scheduled.add(req.request_id)
             budget -= chunk
 
-        # 3. Admit waiting sequences FCFS (priority folded in by sort on add).
+        # 3. Admit waiting sequences (priority order, FCFS within class).
         while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
             req = self.waiting[0]
             if req.num_computed_tokens == 0:
@@ -170,6 +192,7 @@ class EngineScheduler:
             req.status = RequestStatus.RUNNING
             self.running.append(req)
             prefills.append(ScheduledSeq(req, chunk))
+            scheduled.add(req.request_id)
             budget -= chunk
 
         return ScheduledBatch(prefills=prefills, decodes=decodes)
@@ -245,25 +268,52 @@ class EngineScheduler:
     # post-step bookkeeping
 
     def update_after_step(
-        self, batch: ScheduledBatch, sampled: dict[str, int]
-    ) -> list[Request]:
-        """Advance state after the device step; returns finished requests."""
-        finished: list[Request] = []
-        for seq in batch.seqs:
+        self, batch: ScheduledBatch, sampled: dict[str, list[int]]
+    ) -> dict[str, list[int]]:
+        """Advance state after the device step.
+
+        ``sampled`` maps request id -> the window of sampled tokens (length 1
+        for prefill/single-step rows, K for fused decode windows). Tokens
+        past a stop condition are discarded (their speculative KV writes sit
+        in pages that are freed with the request and never committed).
+        Returns the tokens actually accepted per request.
+        """
+        accepted: dict[str, list[int]] = {}
+        for seq in batch.prefills:
             req = seq.request
             req.num_computed_tokens += seq.num_tokens
-            if req.num_computed_tokens >= req.num_prompt_tokens:
-                token = sampled[req.request_id]
+            if req.in_decode:  # this chunk completed the prompt -> 1st token
+                token = sampled[req.request_id][0]
                 req.output_token_ids.append(token)
+                accepted[req.request_id] = [token]
                 reason = self._check_stop(req, token)
                 if reason is not None:
-                    self._release(req)
-                    self.running.remove(req)
-                    req.finish(reason)
-                    finished.append(req)
+                    self._finish(req, reason)
                     continue
             self._commit_full_pages(req)
-        return finished
+        for seq in batch.decodes:
+            req = seq.request
+            window = sampled[req.request_id]
+            acc: list[int] = []
+            reason = None
+            for token in window:
+                req.num_computed_tokens += 1
+                req.output_token_ids.append(token)
+                acc.append(token)
+                reason = self._check_stop(req, token)
+                if reason is not None:
+                    break
+            accepted[req.request_id] = acc
+            if reason is not None:
+                self._finish(req, reason)
+            else:
+                self._commit_full_pages(req)
+        return accepted
+
+    def _finish(self, req: Request, reason: FinishReason) -> None:
+        self._release(req)
+        self.running.remove(req)
+        req.finish(reason)
 
     def _check_stop(self, req: Request, token: int) -> FinishReason | None:
         s = req.sampling

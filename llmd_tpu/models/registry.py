@@ -60,6 +60,16 @@ def _tiny_moe() -> ModelConfig:
     )
 
 
+@register_model("llama-3.2-3b")
+def _llama32_3b() -> ModelConfig:
+    return ModelConfig(
+        name="llama-3.2-3b", vocab_size=128256, hidden_size=3072,
+        intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+        head_dim=128, rope_theta=500000.0, max_model_len=8192,
+        tie_word_embeddings=True,
+    )
+
+
 @register_model("llama-3-8b")
 def _llama3_8b() -> ModelConfig:
     return ModelConfig(

@@ -75,13 +75,17 @@ def paged_attention_xla(
     flat = kv_cache.reshape(num_pages * page, K, D2)
     token_idx = page_table[:, :, None] * page + jnp.arange(page)[None, None, :]
     token_idx = token_idx.reshape(B, S)
-    kv = flat[token_idx]  # [B, S, K, 2D]
-    k = kv[..., :D].astype(jnp.float32)
-    v = kv[..., D:].astype(jnp.float32)
+    kv = flat[token_idx]  # [B, S, K, 2D] in cache dtype (no f32 blow-up)
+    k = kv[..., :D]
+    v = kv[..., D:]
 
     group = H // K
-    qf = q.astype(jnp.float32).reshape(B, Q, K, group, D)
-    scores = jnp.einsum("bqkgd,bskd->bqkgs", qf, k) * sm_scale  # [B,Q,K,g,S]
+    qg = q.reshape(B, Q, K, group, D)
+    # Accumulate scores in f32 on the MXU while streaming bf16 operands.
+    scores = (
+        jnp.einsum("bqkgd,bskd->bqkgs", qg, k, preferred_element_type=jnp.float32)
+        * sm_scale
+    )
 
     key_pos = jnp.arange(S)[None, None, :]  # [1,1,S]
     causal = key_pos <= positions[:, :, None]  # [B,Q,S]
@@ -89,5 +93,10 @@ def paged_attention_xla(
     mask = (causal & in_ctx)[:, :, None, None, :]  # [B,Q,1,1,S]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bqkgs,bskd->bqkgd", probs, v)
+    out = jnp.einsum(
+        "bqkgs,bskd->bqkgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, Q, H, D).astype(q.dtype)

@@ -19,13 +19,15 @@ from llmd_tpu.engine import LLMEngine, SamplingParams
 
 
 def make_engine(
-    tp=1, num_blocks=64, page=4, max_batched=64, max_seqs=8, seed=0, **model_kw
+    tp=1, num_blocks=64, page=4, max_batched=64, max_seqs=8, seed=0, window=1,
+    **model_kw,
 ) -> LLMEngine:
     cfg = EngineConfig(
         model=tiny_model_config(**model_kw),
         cache=CacheConfig(page_size=page, num_blocks=num_blocks, dtype="float32"),
         scheduler=SchedulerConfig(
-            max_num_seqs=max_seqs, max_num_batched_tokens=max_batched
+            max_num_seqs=max_seqs, max_num_batched_tokens=max_batched,
+            decode_window=window,
         ),
         parallel=ParallelConfig(tensor_parallel_size=tp),
         seed=seed,
@@ -92,6 +94,33 @@ def test_preemption_under_page_pressure():
     small = make_engine(num_blocks=12).generate(prompts, params)
     big = make_engine(num_blocks=64).generate(prompts, params)
     assert small == {k: v for k, v in zip(small.keys(), big.values())}
+
+
+def test_decode_window_matches_single_step():
+    params = SamplingParams(temperature=0.0, max_tokens=11)
+    single = make_engine(window=1).generate(PROMPTS, params)
+    fused = make_engine(window=4).generate(PROMPTS, params)
+    assert list(single.values()) == list(fused.values())
+
+
+def test_decode_window_respects_stop_token():
+    probe = make_engine().generate(
+        [PROMPTS[0]], SamplingParams(temperature=0.0, max_tokens=8)
+    )
+    tokens = list(probe.values())[0]
+    stop = tokens[2]
+    out = make_engine(window=4).generate(
+        [PROMPTS[0]],
+        SamplingParams(temperature=0.0, max_tokens=8, stop_token_ids=(stop,)),
+    )
+    assert list(out.values())[0] == tokens[:3]
+
+
+def test_decode_window_seeded_reproducible():
+    p = SamplingParams(temperature=1.0, max_tokens=9, seed=77)
+    a = make_engine(window=1).generate([PROMPTS[0]], [p])
+    b = make_engine(window=3).generate([PROMPTS[0]], [p])
+    assert list(a.values())[0] == list(b.values())[0]
 
 
 def test_stop_token():
