@@ -15,8 +15,9 @@ TPU-first scheduling shapes (everything static per bucket, traced once):
 - stop conditions are reconciled on host AFTER the window: tokens past a
   stop are discarded and never committed to the prefix cache.
 
-KV pool: ONE jax.Array [L, pages, page, K, 2D] sharded over tp on the KV
-head axis, donated through every step so XLA updates it in place.
+KV pool: ONE jax.Array [L, pages, K, page, 2D] (head-major within a page so
+a (page, head) slab is one contiguous DMA), sharded over tp on the KV head
+axis, donated through every step so XLA updates it in place.
 """
 
 from __future__ import annotations
@@ -28,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llmd_tpu import ops
 from llmd_tpu.config import EngineConfig
 from llmd_tpu.engine.sampler import SamplingInputs, sample_tokens
 from llmd_tpu.engine.scheduler import ScheduledSeq
@@ -76,6 +78,7 @@ class ModelRunner:
         if params is None:
             params = llama.init_params(self.cfg, jax.random.key(config.seed))
         self.params = shard_params(params, mesh_ctx)
+        ops.set_world_size(mesh_ctx.world)
         self.kv_cache = self._alloc_kv()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
@@ -94,8 +97,8 @@ class ModelRunner:
         shape = (
             self.cfg.num_layers,
             c.num_blocks,
-            c.page_size,
             self.cfg.num_kv_heads,
+            c.page_size,
             2 * self.cfg.head_dim,
         )
         return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*KV_CACHE_SPEC))

@@ -3,7 +3,7 @@
 Functional, TPU-first: layer params are STACKED along a leading L axis and
 the forward pass is one ``lax.scan`` over layers -- one XLA while-loop body
 instead of L inlined layers, so compile time is O(1) in depth and the paged
-KV cache ([L, pages, page, K, 2D]) is scanned in lock-step.
+KV cache ([L, pages, K, page, 2D], head-major pages) is scanned in lock-step.
 
 Reference parity: this is the model-execution role the reference delegates
 to vLLM (docs/architecture/core/model-servers.md:3-25); the MoE path is the
@@ -12,13 +12,15 @@ wide-EP target (docs/architecture/foundations/wide-expert-parallelism.md).
 
 from __future__ import annotations
 
+import zlib
+
 import jax
 import jax.numpy as jnp
 
 from llmd_tpu.config import ModelConfig
 from llmd_tpu.models.common import StepInput, apply_rope, param_dtype, rms_norm, rope_tables
 from llmd_tpu.models.moe import moe_block
-from llmd_tpu.ops.paged_attention import paged_attention_xla, write_kv_pages
+from llmd_tpu.ops import paged_attention, write_kv_pages
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
@@ -30,7 +32,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     F, V = cfg.intermediate_size, cfg.vocab_size
 
     def mk(name: str, shape: tuple[int, ...], scale: float | None = None) -> jax.Array:
-        k = jax.random.fold_in(key, hash(name) % (2**31))
+        # zlib.crc32 is stable across processes (Python's hash() is salted).
+        k = jax.random.fold_in(key, zlib.crc32(name.encode()) % (2**31))
         if scale is None:
             scale = shape[-2] ** -0.5 if len(shape) >= 2 else 1.0
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
@@ -80,7 +83,7 @@ def _mlp(h: jax.Array, lp: dict) -> jax.Array:
 
 def forward_hidden(
     params: dict,
-    kv_cache: jax.Array,  # [L, pages, page, K, 2D]
+    kv_cache: jax.Array,  # [L, pages, K, page, 2D]
     inp: StepInput,
     cfg: ModelConfig,
 ) -> tuple[jax.Array, jax.Array]:
@@ -104,7 +107,7 @@ def forward_hidden(
         k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
         v = v.reshape(B, Q, K, D)
         cache = write_kv_pages(cache, k, v, inp.page_table, inp.positions, valid)
-        attn = paged_attention_xla(
+        attn = paged_attention(
             q, cache, inp.page_table, inp.kv_lens, inp.positions, sm_scale
         )
         x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]

@@ -1,1 +1,63 @@
-"""TPU compute kernels (Pallas) and their XLA reference fallbacks."""
+"""TPU compute kernels (Pallas) and their XLA reference fallbacks.
+
+``paged_attention`` dispatches at trace time: the Pallas decode kernel on
+TPU-class backends for Q=1 with tile-compatible geometry, the XLA gather
+fallback otherwise. Env LLMD_PALLAS=off disables the kernel; =interpret
+forces interpret mode (CPU parity testing).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from llmd_tpu.ops.paged_attention import paged_attention_xla, write_kv_pages  # noqa: F401
+from llmd_tpu.ops.ragged_paged_attention import decode_paged_attention
+
+_TPU_PLATFORMS = {"tpu", "axon"}
+
+# Devices the executing mesh spans; set by ModelRunner. The Pallas kernel has
+# no GSPMD partitioning rule yet, so it only dispatches for world_size == 1
+# (a sharded jit would otherwise all-gather the KV pool or fail to lower);
+# the shard_map-wrapped kernel for tp>1 is tracked future work.
+_WORLD_SIZE = 1
+
+
+def set_world_size(n: int) -> None:
+    global _WORLD_SIZE
+    _WORLD_SIZE = n
+
+
+def _mode() -> str:
+    return os.environ.get("LLMD_PALLAS", "auto")
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in _TPU_PLATFORMS
+    except Exception:
+        return False
+
+
+def paged_attention(q, kv_cache, page_table, kv_lens, positions, sm_scale=None):
+    mode = _mode()
+    num_pages, K, page, D2 = kv_cache.shape
+    D = q.shape[-1]
+    kernel_ok = (
+        q.shape[1] == 1
+        and D % 128 == 0
+        and page % 8 == 0
+        and D2 == 2 * D
+        and mode != "off"
+        and _WORLD_SIZE == 1
+    )
+    if kernel_ok and mode == "interpret":
+        return decode_paged_attention(
+            q, kv_cache, page_table, kv_lens, sm_scale=sm_scale, interpret=True
+        )
+    if kernel_ok and _on_tpu():
+        return decode_paged_attention(
+            q, kv_cache, page_table, kv_lens, sm_scale=sm_scale
+        )
+    return paged_attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)

@@ -12,9 +12,9 @@ interface:
   the Pallas TPU kernel (flash-style online softmax over pages).
 
 Layout conventions (TPU-first):
-  kv_cache (one layer): [num_pages, page_size, num_kv_heads, 2*head_dim]
-      (K in [..., :head_dim], V in [..., head_dim:] -- fused so a page is one
-      contiguous DMA)
+  kv_cache (one layer): [num_pages, num_kv_heads, page_size, 2*head_dim]
+      (K in [..., :head_dim], V in [..., head_dim:]; head-major within a
+      page so one (page, head) slab is a contiguous DMA)
   q:          [B, Q, num_q_heads, head_dim]
   page_table: [B, max_pages] int32
   kv_lens:    [B] int32, total valid kv tokens per seq AFTER this step's
@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 
 def write_kv_pages(
-    kv_cache: jax.Array,  # [num_pages, page, K, 2D]
+    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
     k: jax.Array,  # [B, Q, K, D]
     v: jax.Array,  # [B, Q, K, D]
     page_table: jax.Array,  # [B, max_pages]
@@ -38,27 +38,25 @@ def write_kv_pages(
 ) -> jax.Array:
     """Scatter this step's K/V into their cache slots.
 
-    Slot of token (b, i) = page_table[b, pos // page] * page + pos % page.
+    Token (b, i) lands at [page_table[b, pos // page], :, pos % page, :].
     Invalid (padding) tokens scatter out-of-bounds and are dropped.
     """
-    num_pages, page, K, D2 = kv_cache.shape
-    D = D2 // 2
+    num_pages, K, page, D2 = kv_cache.shape
     kv = jnp.concatenate([k, v], axis=-1)  # [B, Q, K, 2D]
     page_idx = positions // page
     offset = positions % page
     phys = jnp.take_along_axis(page_table, page_idx, axis=1)  # [B, Q]
-    slots = phys * page + offset
-    slots = jnp.where(valid, slots, num_pages * page)  # OOB => dropped
-    flat = kv_cache.reshape(num_pages * page, K, D2)
-    flat = flat.at[slots.reshape(-1)].set(
-        kv.reshape(-1, K, D2).astype(flat.dtype), mode="drop"
-    )
-    return flat.reshape(kv_cache.shape)
+    phys = jnp.where(valid, phys, num_pages)  # OOB => dropped
+    T = phys.size
+    kv_flat = kv.reshape(T, K, D2).astype(kv_cache.dtype)
+    return kv_cache.at[
+        phys.reshape(T, 1), jnp.arange(K)[None, :], offset.reshape(T, 1), :
+    ].set(kv_flat, mode="drop")
 
 
 def paged_attention_xla(
     q: jax.Array,  # [B, Q, H, D]
-    kv_cache: jax.Array,  # [num_pages, page, K, 2D]
+    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
     page_table: jax.Array,  # [B, max_pages]
     kv_lens: jax.Array,  # [B]
     positions: jax.Array,  # [B, Q]
@@ -66,16 +64,14 @@ def paged_attention_xla(
 ) -> jax.Array:
     """Reference paged attention: gather the whole context, masked softmax."""
     B, Q, H, D = q.shape
-    num_pages, page, K, D2 = kv_cache.shape
+    num_pages, K, page, D2 = kv_cache.shape
     max_pages = page_table.shape[1]
     S = max_pages * page
     if sm_scale is None:
         sm_scale = D ** -0.5
 
-    flat = kv_cache.reshape(num_pages * page, K, D2)
-    token_idx = page_table[:, :, None] * page + jnp.arange(page)[None, None, :]
-    token_idx = token_idx.reshape(B, S)
-    kv = flat[token_idx]  # [B, S, K, 2D] in cache dtype (no f32 blow-up)
+    kv = kv_cache[page_table]  # [B, max_pages, K, page, 2D]
+    kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, S, K, D2)
     k = kv[..., :D]
     v = kv[..., D:]
 

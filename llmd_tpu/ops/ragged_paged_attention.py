@@ -1,0 +1,200 @@
+"""Pallas TPU paged attention (decode path).
+
+TPU-native replacement for the reference's FlashInfer decode kernels
+(SURVEY.md N8; reference docker/Dockerfile.cuda:71-72). The XLA fallback in
+``paged_attention.py`` materializes the full padded context per layer; this
+kernel streams only the LIVE context pages HBM->VMEM (double-buffered manual
+DMAs, dynamic trip count = cdiv(kv_len, page)) and keeps a flash-style
+online-softmax accumulator in VMEM.
+
+Layout: kv_cache [num_pages, K, page, 2D] -- one page is a contiguous
+[K, page, 2D] slab, fetched in a single DMA per loop iteration. Grid is
+(B,): each program handles one sequence, looping its pages while the next
+page's DMA is in flight; all KV heads are processed per iteration as a
+K-batched MXU matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.0**30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, max_pages] i32
+    kv_lens_ref,  # [B] i32
+    # blocks
+    q_ref,  # [1, K, G, D] VMEM
+    kv_hbm_ref,  # [num_pages, K, page, 2D] in HBM (unblocked)
+    out_ref,  # [1, K, G, D] VMEM
+    # scratch
+    m_ref,  # [K, G, 128] f32
+    l_ref,  # [K, G, 128] f32
+    acc_ref,  # [K, G, D] f32
+    *,
+    page_size: int,
+    head_dim: int,
+    sm_scale: float,
+    pages_per_block: int,
+):
+    b = pl.program_id(0)
+    D = head_dim
+    K = q_ref.shape[1]
+    ppb = pages_per_block
+    S = ppb * page_size  # tokens per compute block
+    kv_len = kv_lens_ref[b]
+    n_blocks = (kv_len + S - 1) // S
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    n_live_pages = (kv_len + page_size - 1) // page_size
+
+    def body(buf, sem):
+        # buf: [2, K, S, 2D]; one DMA per page, ppb in flight per block.
+        # Pages past the live context (tail block) are never fetched.
+        def _dma(slot, i, j):
+            return pltpu.make_async_copy(
+                kv_hbm_ref.at[page_table_ref[b, i * ppb + j]],
+                buf.at[slot, :, pl.ds(j * page_size, page_size), :],
+                sem.at[slot, j],
+            )
+
+        def start_block(slot, i):
+            for j in range(ppb):  # static unroll
+
+                @pl.when(i * ppb + j < n_live_pages)
+                def _start():
+                    _dma(slot, i, j).start()
+
+        def wait_block(slot, i):
+            for j in range(ppb):
+
+                @pl.when(i * ppb + j < n_live_pages)
+                def _wait():
+                    _dma(slot, i, j).wait()
+
+        @pl.when(n_blocks > 0)
+        def _warmup():
+            start_block(0, 0)
+
+        def loop(i, _):
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_blocks)
+            def _prefetch():
+                start_block(jax.lax.rem(i + 1, 2), i + 1)
+
+            wait_block(slot, i)
+            kv = buf[slot]  # [K, S, 2D]
+            k = kv[:, :, :D]
+            v = kv[:, :, D:].astype(jnp.float32)
+            # Unfetched tail positions hold uninitialized VMEM; zero them so
+            # a stray NaN can't poison the (0-prob x v) accumulation.
+            pos_v = i * S + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+            v = jnp.where(pos_v < kv_len, v, 0.0)
+            q = q_ref[0]  # [K, G, D]
+            # K-batched (G, D) x (D, S) -> [K, G, S], f32 accumulate.
+            s = jax.lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            pos = i * S + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(pos < kv_len, s, NEG_INF)
+
+            m_prev = m_ref[:, :, :1]  # [K, G, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            probs = jnp.exp(s - m_new)  # [K, G, S]
+            l_ref[:, :, :1] = l_ref[:, :, :1] * alpha + jnp.sum(
+                probs, axis=2, keepdims=True
+            )
+            m_ref[:, :, :1] = m_new
+            pv = jax.lax.dot_general(
+                probs, v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # [K, G, D]
+            acc_ref[:] = acc_ref[:] * alpha + pv
+            return 0
+
+        jax.lax.fori_loop(0, n_blocks, loop, 0)
+
+    pl.run_scoped(
+        body,
+        buf=pltpu.VMEM(
+            (2, K, ppb * page_size, kv_hbm_ref.shape[-1]), kv_hbm_ref.dtype
+        ),
+        sem=pltpu.SemaphoreType.DMA((2, ppb)),
+    )
+
+    l = l_ref[:, :, :1]
+    l = jnp.where(l == 0.0, 1.0, l)
+    out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret", "pages_per_block")
+)
+def decode_paged_attention(
+    q: jax.Array,  # [B, 1, H, D]
+    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
+    page_table: jax.Array,  # [B, max_pages] i32
+    kv_lens: jax.Array,  # [B] i32
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    pages_per_block: int = 8,
+) -> jax.Array:
+    B, Q, H, D = q.shape
+    assert Q == 1, "decode kernel handles Q=1"
+    num_pages, K, page, D2 = kv_cache.shape
+    assert D2 == 2 * D
+    G = H // K
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    max_pages = page_table.shape[1]
+    if max_pages % pages_per_block:
+        # pad the table so block index arithmetic never reads out of bounds
+        pad = pages_per_block - max_pages % pages_per_block
+        page_table = jnp.pad(page_table, ((0, 0), (0, pad)))
+
+    qk = q.reshape(B, K, G, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, K, G, D), lambda b, pt, kl: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
+        ],
+        out_specs=pl.BlockSpec((1, K, G, D), lambda b, pt, kl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((K, G, 128), jnp.float32),
+            pltpu.VMEM((K, G, 128), jnp.float32),
+            pltpu.VMEM((K, G, D), jnp.float32),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            page_size=page,
+            head_dim=D,
+            sm_scale=sm_scale,
+            pages_per_block=pages_per_block,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+    out = kernel(page_table, kv_lens, qk, kv_cache)
+    return out.reshape(B, 1, H, D)

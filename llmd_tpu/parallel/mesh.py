@@ -111,9 +111,11 @@ PARAM_SPECS: dict[str, P] = {
     "ws_down": P(None, TP_AXIS, None),
 }
 
-# KV cache [L, num_pages, page, K, 2D]: shard kv heads over tp; each dp group
-# holds its own full pool (allocated per dp rank at the engine level).
-KV_CACHE_SPEC = P(None, None, None, TP_AXIS, None)
+# KV cache [L, num_pages, K, page, 2D] (head-major within a page so one
+# (page, head) DMA is contiguous for the Pallas kernel): shard kv heads over
+# tp; each dp group holds its own full pool (allocated per dp rank at the
+# engine level).
+KV_CACHE_SPEC = P(None, None, TP_AXIS, None, None)
 
 
 def param_specs(params: dict) -> dict:

@@ -109,11 +109,12 @@ def test_decode_window_respects_stop_token():
     )
     tokens = list(probe.values())[0]
     stop = tokens[2]
+    expected = tokens[: tokens.index(stop) + 1]  # first occurrence wins
     out = make_engine(window=4).generate(
         [PROMPTS[0]],
         SamplingParams(temperature=0.0, max_tokens=8, stop_token_ids=(stop,)),
     )
-    assert list(out.values())[0] == tokens[:3]
+    assert list(out.values())[0] == expected
 
 
 def test_decode_window_seeded_reproducible():
