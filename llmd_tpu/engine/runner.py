@@ -78,7 +78,6 @@ class ModelRunner:
         if params is None:
             params = llama.init_params(self.cfg, jax.random.key(config.seed))
         self.params = shard_params(params, mesh_ctx)
-        ops.set_world_size(mesh_ctx.world)
         self.kv_cache = self._alloc_kv()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
@@ -108,12 +107,13 @@ class ModelRunner:
 
     def _build_forward(self):
         cfg = self.cfg
+        world = self.ctx.world
 
         @functools.partial(
             jax.jit, donate_argnums=(1,), static_argnames=("all_greedy",)
         )
         def fwd(params, kv_cache, inp: StepInput, s: SamplingInputs, all_greedy=False):
-            hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg)
+            hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg, world)
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
             h_last = hidden[jnp.arange(B), last]
@@ -129,6 +129,7 @@ class ModelRunner:
 
     def _build_multi(self):
         cfg = self.cfg
+        world = self.ctx.world
 
         @functools.partial(
             jax.jit, donate_argnums=(1,), static_argnames=("k_steps", "all_greedy")
@@ -159,7 +160,7 @@ class ModelRunner:
                     kv_lens=jnp.where(active, pos + 1, 0).astype(jnp.int32),
                     page_table=page_table,
                 )
-                hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg)
+                hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg, world)
                 logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
                 s = SamplingInputs(
                     temperature=temperature,

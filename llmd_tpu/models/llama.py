@@ -86,6 +86,7 @@ def forward_hidden(
     kv_cache: jax.Array,  # [L, pages, K, page, 2D]
     inp: StepInput,
     cfg: ModelConfig,
+    world_size: int = 1,
 ) -> tuple[jax.Array, jax.Array]:
     """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache)."""
     B, Q = inp.token_ids.shape
@@ -108,7 +109,8 @@ def forward_hidden(
         v = v.reshape(B, Q, K, D)
         cache = write_kv_pages(cache, k, v, inp.page_table, inp.positions, valid)
         attn = paged_attention(
-            q, cache, inp.page_table, inp.kv_lens, inp.positions, sm_scale
+            q, cache, inp.page_table, inp.kv_lens, inp.positions, sm_scale,
+            world_size=world_size,
         )
         x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)

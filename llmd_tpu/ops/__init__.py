@@ -17,17 +17,6 @@ from llmd_tpu.ops.ragged_paged_attention import decode_paged_attention
 
 _TPU_PLATFORMS = {"tpu", "axon"}
 
-# Devices the executing mesh spans; set by ModelRunner. The Pallas kernel has
-# no GSPMD partitioning rule yet, so it only dispatches for world_size == 1
-# (a sharded jit would otherwise all-gather the KV pool or fail to lower);
-# the shard_map-wrapped kernel for tp>1 is tracked future work.
-_WORLD_SIZE = 1
-
-
-def set_world_size(n: int) -> None:
-    global _WORLD_SIZE
-    _WORLD_SIZE = n
-
 
 def _mode() -> str:
     return os.environ.get("LLMD_PALLAS", "auto")
@@ -40,7 +29,13 @@ def _on_tpu() -> bool:
         return False
 
 
-def paged_attention(q, kv_cache, page_table, kv_lens, positions, sm_scale=None):
+def paged_attention(
+    q, kv_cache, page_table, kv_lens, positions, sm_scale=None, world_size=1
+):
+    """``world_size`` is the device count of the executing mesh. The Pallas
+    kernel has no GSPMD partitioning rule yet, so it only dispatches for
+    world_size == 1 (a sharded jit would otherwise all-gather the KV pool or
+    fail to lower); the shard_map-wrapped kernel for tp>1 is future work."""
     mode = _mode()
     num_pages, K, page, D2 = kv_cache.shape
     D = q.shape[-1]
@@ -50,7 +45,7 @@ def paged_attention(q, kv_cache, page_table, kv_lens, positions, sm_scale=None):
         and page % 8 == 0
         and D2 == 2 * D
         and mode != "off"
-        and _WORLD_SIZE == 1
+        and world_size == 1
     )
     if kernel_ok and mode == "interpret":
         return decode_paged_attention(

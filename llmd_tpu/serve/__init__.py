@@ -1,0 +1,12 @@
+"""OpenAI-compatible HTTP serving layer for the TPU engine.
+
+Plays the role of vLLM's api_server in the reference stack: the model-server
+HTTP surface the router targets (reference
+docs/architecture/core/model-servers.md:38-100 — OpenAI API + Prometheus
+metrics protocol + /health).
+"""
+
+from llmd_tpu.serve.async_engine import AsyncEngine
+from llmd_tpu.serve.tokenizer import ByteTokenizer, load_tokenizer
+
+__all__ = ["AsyncEngine", "ByteTokenizer", "load_tokenizer"]

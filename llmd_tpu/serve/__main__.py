@@ -1,0 +1,106 @@
+"""`python -m llmd_tpu.serve` — the model-server entry point.
+
+Flag names mirror the vLLM flags the reference's deployment patches set
+(e.g. guides/pd-disaggregation/modelserver/tpu/v6/vllm/patch-decode.yaml:
+--tensor-parallel-size, --max-model-len, --block-size,
+--max-num-batched-tokens, --kv-transfer-config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def make_engine_config(args):
+    from llmd_tpu.config import (
+        CacheConfig,
+        EngineConfig,
+        ParallelConfig,
+        SchedulerConfig,
+    )
+    from llmd_tpu.models.registry import get_model_config
+
+    model = get_model_config(args.model, max_model_len=args.max_model_len)
+    kv_cfg = json.loads(args.kv_transfer_config) if args.kv_transfer_config else {}
+    return EngineConfig(
+        model=model,
+        cache=CacheConfig(
+            page_size=args.block_size,
+            num_blocks=args.num_gpu_blocks_override or 2048,
+            dtype=args.kv_cache_dtype,
+            enable_prefix_caching=not args.no_enable_prefix_caching,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=args.max_num_seqs,
+            max_num_batched_tokens=args.max_num_batched_tokens,
+            decode_window=args.decode_window,
+        ),
+        parallel=ParallelConfig(
+            tensor_parallel_size=args.tensor_parallel_size,
+            data_parallel_size=args.data_parallel_size,
+        ),
+        seed=args.seed,
+        weights_path=args.weights_path,
+        tokenizer_path=args.tokenizer,
+        kv_role=kv_cfg.get("kv_role"),
+        kv_side_channel_port=int(kv_cfg.get("side_channel_port", 9600)),
+        kv_transfer_port=int(kv_cfg.get("transfer_port", 9100)),
+        kv_events_endpoint=args.kv_events_endpoint,
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("llmd-tpu serve")
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--weights-path", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-model-len", type=int, default=8192)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-gpu-blocks-override", type=int, default=None)
+    p.add_argument("--kv-cache-dtype", default="bfloat16")
+    p.add_argument("--no-enable-prefix-caching", action="store_true")
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-num-batched-tokens", type=int, default=2048)
+    p.add_argument("--decode-window", type=int, default=1)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kv-transfer-config", default=None, help="JSON, vLLM-style")
+    p.add_argument("--kv-events-endpoint", default=None, help="ZMQ pub endpoint")
+    p.add_argument("--skip-warmup", action="store_true")
+    return p
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+
+    from aiohttp import web
+
+    from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import load_tokenizer
+
+    config = make_engine_config(args)
+    engine = LLMEngine(config)
+    if not args.skip_warmup:
+        n = engine.runner.warmup()
+        logging.info("warmup compiled %d programs", n)
+    tokenizer = load_tokenizer(args.tokenizer)
+    app = build_app(
+        AsyncEngine(engine),
+        tokenizer,
+        args.served_model_name or args.model,
+        config.model.max_model_len,
+    )
+    web.run_app(app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,379 @@
+"""aiohttp OpenAI-compatible API server over AsyncEngine.
+
+Surface (the model-server contract of the reference,
+docs/architecture/core/model-servers.md:38-100):
+  POST /v1/completions, /v1/chat/completions   (stream + non-stream)
+  GET  /v1/models, /health
+  GET  /metrics                                 (EPP scrape protocol)
+  POST /v1/completions/render, /v1/chat/completions/render, /tokenize
+       (the tokenizer surface the router's token-producer calls,
+        kv-indexer.md:104-113)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any
+
+import pydantic
+from aiohttp import web
+
+from llmd_tpu.engine.request import RequestOutput, SamplingParams
+from llmd_tpu.serve import protocol as P
+from llmd_tpu.serve.async_engine import AsyncEngine, EngineError, RequestFailed
+from llmd_tpu.serve.metrics import render_metrics
+
+log = logging.getLogger(__name__)
+
+ENGINE_KEY = web.AppKey("llmd_engine", AsyncEngine)
+TOK_KEY = web.AppKey("llmd_tokenizer", object)
+MODEL_KEY = web.AppKey("llmd_model_name", str)
+MAXLEN_KEY = web.AppKey("llmd_max_model_len", int)
+
+
+class Detokenizer:
+    """Incremental detokenization with stop-string scanning.
+
+    Decodes the full output each call and diffs against the previously
+    emitted text so multi-token/multi-byte characters stream correctly.
+    While stop strings are configured, the longest possible stop-string
+    prefix (max stop length - 1 chars) is held back from emission so a stop
+    match never requires retracting text already sent to the client; the
+    held-back tail is flushed with ``feed([], final=True)``.
+    """
+
+    def __init__(self, tokenizer, stops: list[str]) -> None:
+        self.tok = tokenizer
+        self.stops = stops
+        self._holdback = max((len(s) for s in stops), default=1) - 1
+        self.ids: list[int] = []
+        self.emitted = ""
+        self.stopped = False
+
+    def feed(self, new_ids: list[int], final: bool = False) -> str:
+        """Returns the text delta to emit; sets .stopped on a stop match."""
+        self.ids.extend(new_ids)
+        text = self.tok.decode(self.ids)
+        if text.endswith("�"):
+            # Incomplete UTF-8 sequence: hold back until it completes.
+            text = text[: text.rfind("�")]
+        if len(text) < len(self.emitted):
+            return ""
+        # Earliest occurrence across ALL stop strings wins.
+        idx = min(
+            (i for i in (text.find(s) for s in self.stops) if i != -1), default=-1
+        )
+        if idx != -1:
+            self.stopped = True
+            text = text[:idx]
+            final = True
+        if final or not self.stops:
+            limit = len(text)
+        else:
+            limit = max(len(self.emitted), len(text) - self._holdback)
+        delta = text[len(self.emitted) : limit]
+        self.emitted = text[:limit]
+        return delta
+
+
+def _tokenize_prompt(tokenizer, prompt) -> list[int]:
+    if isinstance(prompt, str):
+        return tokenizer.encode(prompt)
+    if isinstance(prompt, list):
+        if not prompt:
+            raise ValueError("empty prompt")
+        if isinstance(prompt[0], int):
+            return list(prompt)
+        if isinstance(prompt[0], str):
+            if len(prompt) != 1:
+                raise ValueError("batched prompts unsupported; send one request per prompt")
+            return tokenizer.encode(prompt[0])
+        if isinstance(prompt[0], list):
+            if len(prompt) != 1:
+                raise ValueError("batched prompts unsupported; send one request per prompt")
+            return list(prompt[0])
+    raise ValueError("invalid prompt type")
+
+
+def _chat_prompt_ids(tokenizer, messages: list[P.ChatMessage]) -> list[int]:
+    msgs = [m.model_dump() for m in messages]
+    ids = tokenizer.apply_chat_template(msgs, add_generation_prompt=True, tokenize=True)
+    return list(ids)
+
+
+def _error(status: int, message: str) -> web.Response:
+    return web.json_response(P.error_body(message, code=status), status=status)
+
+
+async def _collect(
+    engine: AsyncEngine,
+    rid: str,
+    prompt_ids: list[int],
+    sampling: SamplingParams,
+    detok: Detokenizer,
+    priority: int,
+    kv_transfer_params: dict | None,
+):
+    """Run to completion; returns (text, finish_reason, final RequestOutput)."""
+    finish = None
+    final: RequestOutput | None = None
+    async for out in engine.generate(rid, prompt_ids, sampling, priority, kv_transfer_params):
+        detok.feed(out.new_token_ids, final=out.finished)
+        final = out
+        if detok.stopped:
+            engine.abort(rid)
+            finish = "stop"
+            break
+        if out.finished:
+            finish = out.finish_reason.value if out.finish_reason else None
+    return detok.emitted, finish, final
+
+
+# --------------------------------------------------------------------- #
+# handlers
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    model = request.app[MODEL_KEY]
+    return web.json_response(
+        {
+            "object": "list",
+            "data": [
+                {
+                    "id": model,
+                    "object": "model",
+                    "created": int(time.time()),
+                    "owned_by": "llmd-tpu",
+                    "max_model_len": request.app[MAXLEN_KEY],
+                }
+            ],
+        }
+    )
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    return web.Response(
+        text=render_metrics(engine.stats, request.app[MODEL_KEY]),
+        content_type="text/plain",
+    )
+
+
+async def handle_tokenize(request: web.Request) -> web.Response:
+    tokenizer = request.app[TOK_KEY]
+    try:
+        body = await request.json()
+        if "messages" in body:
+            ids = _chat_prompt_ids(
+                tokenizer, [P.ChatMessage(**m) for m in body["messages"]]
+            )
+        else:
+            ids = _tokenize_prompt(tokenizer, body.get("prompt", ""))
+    except (json.JSONDecodeError, ValueError, TypeError, AttributeError,
+            pydantic.ValidationError) as e:
+        return _error(400, str(e))
+    return web.json_response({"tokens": ids, "count": len(ids)})
+
+
+async def handle_completions_render(request: web.Request) -> web.Response:
+    """vLLM-style render: return the token ids the engine would see."""
+    tokenizer = request.app[TOK_KEY]
+    try:
+        req = P.CompletionRequest(**await request.json())
+        ids = _tokenize_prompt(tokenizer, req.prompt)
+    except (ValueError, TypeError) as e:
+        return _error(400, str(e))
+    return web.json_response({"prompt_token_ids": ids, "model": req.model})
+
+
+async def handle_chat_render(request: web.Request) -> web.Response:
+    tokenizer = request.app[TOK_KEY]
+    try:
+        req = P.ChatCompletionRequest(**await request.json())
+        ids = _chat_prompt_ids(tokenizer, req.messages)
+    except (ValueError, TypeError) as e:
+        return _error(400, str(e))
+    return web.json_response({"prompt_token_ids": ids, "model": req.model})
+
+
+def _sse(data: dict) -> bytes:
+    return b"data: " + json.dumps(data, separators=(",", ":")).encode() + b"\n\n"
+
+
+async def _stream_response(
+    request: web.Request,
+    engine: AsyncEngine,
+    rid: str,
+    model: str,
+    prompt_ids: list[int],
+    sampling: SamplingParams,
+    detok: Detokenizer,
+    priority: int,
+    kv_transfer_params: dict | None,
+    chat: bool,
+) -> web.StreamResponse:
+    resp = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "x-request-id": rid,
+        }
+    )
+    await resp.prepare(request)
+    if chat:
+        await resp.write(_sse(P.chat_chunk(rid, model, {"role": "assistant"}, None)))
+    finish = None
+    n_out = 0
+    cached = 0
+    try:
+        async for out in engine.generate(rid, prompt_ids, sampling, priority, kv_transfer_params):
+            delta = detok.feed(out.new_token_ids, final=out.finished)
+            n_out = out.num_output_tokens
+            cached = out.num_cached_tokens
+            if detok.stopped:
+                engine.abort(rid)
+                finish = "stop"
+            elif out.finished:
+                finish = out.finish_reason.value if out.finish_reason else None
+            if delta:
+                chunk = (
+                    P.chat_chunk(rid, model, {"content": delta}, None)
+                    if chat
+                    else P.completion_chunk(rid, model, delta, None)
+                )
+                await resp.write(_sse(chunk))
+            if finish is not None:
+                break
+    except (RequestFailed, EngineError) as e:
+        code = 400 if isinstance(e, RequestFailed) else 500
+        await resp.write(_sse(P.error_body(str(e), code=code)))
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+    except (asyncio.CancelledError, ConnectionResetError):
+        engine.abort(rid)
+        raise
+    final = (
+        P.chat_chunk(rid, model, {}, finish)
+        if chat
+        else P.completion_chunk(rid, model, "", finish)
+    )
+    final["usage"] = P.usage_dict(len(prompt_ids), n_out, cached)
+    await resp.write(_sse(final))
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
+async def _handle_generate(request: web.Request, chat: bool) -> web.StreamResponse:
+    engine = request.app[ENGINE_KEY]
+    tokenizer = request.app[TOK_KEY]
+    model = request.app[MODEL_KEY]
+    max_len = request.app[MAXLEN_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error(400, f"invalid JSON: {e}")
+    try:
+        if chat:
+            req = P.ChatCompletionRequest(**body)
+            prompt_ids = _chat_prompt_ids(tokenizer, req.messages)
+            req_max = req.max_completion_tokens or req.max_tokens
+        else:
+            req = P.CompletionRequest(**body)
+            prompt_ids = _tokenize_prompt(tokenizer, req.prompt)
+            req_max = req.max_tokens
+    except (ValueError, TypeError, pydantic.ValidationError) as e:
+        return _error(400, str(e))
+    if req.n != 1:
+        return _error(400, "only n=1 is supported")
+    if len(prompt_ids) >= max_len:
+        return _error(400, f"prompt length {len(prompt_ids)} >= max_model_len {max_len}")
+    budget = max_len - len(prompt_ids)
+    max_tokens = min(req_max if req_max is not None else budget, budget)
+    eos = getattr(tokenizer, "eos_token_id", None)
+    sampling = P.to_sampling(req, eos, max_tokens)
+    rid = request.headers.get("x-request-id") or P.request_id(
+        "chatcmpl" if chat else "cmpl"
+    )
+    detok = Detokenizer(tokenizer, P.stop_strings(req.stop))
+
+    if req.stream:
+        return await _stream_response(
+            request, engine, rid, model, prompt_ids, sampling, detok,
+            req.priority, req.kv_transfer_params, chat,
+        )
+    try:
+        text, finish, final = await _collect(
+            engine, rid, prompt_ids, sampling, detok, req.priority, req.kv_transfer_params
+        )
+    except RequestFailed as e:
+        return _error(400, str(e))
+    except EngineError as e:
+        return web.json_response(
+            P.error_body(str(e), etype="internal_error", code=500), status=500
+        )
+    usage = P.usage_dict(
+        len(prompt_ids),
+        final.num_output_tokens if final else 0,
+        final.num_cached_tokens if final else 0,
+    )
+    kvp = final.kv_transfer_params if final else None
+    builder = P.chat_response if chat else P.completion_response
+    return web.json_response(
+        builder(rid, model, text, finish, usage, kvp),
+        headers={"x-request-id": rid},
+    )
+
+
+async def handle_completions(request: web.Request) -> web.StreamResponse:
+    return await _handle_generate(request, chat=False)
+
+
+async def handle_chat(request: web.Request) -> web.StreamResponse:
+    return await _handle_generate(request, chat=True)
+
+
+# --------------------------------------------------------------------- #
+
+
+def build_app(
+    engine: AsyncEngine,
+    tokenizer,
+    model_name: str,
+    max_model_len: int,
+    extra_routes: list | None = None,
+) -> web.Application:
+    app = web.Application()
+    app[ENGINE_KEY] = engine
+    app[TOK_KEY] = tokenizer
+    app[MODEL_KEY] = model_name
+    app[MAXLEN_KEY] = max_model_len
+    app.add_routes(
+        [
+            web.get("/health", handle_health),
+            web.get("/v1/models", handle_models),
+            web.get("/metrics", handle_metrics),
+            web.post("/tokenize", handle_tokenize),
+            web.post("/v1/completions", handle_completions),
+            web.post("/v1/chat/completions", handle_chat),
+            web.post("/v1/completions/render", handle_completions_render),
+            web.post("/v1/chat/completions/render", handle_chat_render),
+        ]
+    )
+    if extra_routes:
+        app.add_routes(extra_routes)
+
+    async def _start_engine(app: web.Application):
+        engine.start(asyncio.get_event_loop())
+        yield
+        engine.stop()
+
+    app.cleanup_ctx.append(_start_engine)
+    return app

@@ -1,0 +1,68 @@
+"""Prometheus metrics in the model-server protocol the router scrapes.
+
+The EPP↔engine metrics contract (reference
+docs/architecture/core/model-servers.md:38-52): TotalQueuedRequests,
+TotalRunningRequests, KVCacheUtilization (+ optional BlockSize /
+NumGPUBlocks), resolved through a per-engine metric-name mapping. We emit
+BOTH the vLLM names (so a stock llm-d EPP scrapes us unchanged with the
+vllm mapping) and `llmd:` canonical names.
+"""
+
+from __future__ import annotations
+
+from llmd_tpu.engine.engine import EngineStats
+
+
+def render_metrics(stats: EngineStats, model_name: str) -> str:
+    label = f'{{model_name="{model_name}"}}'
+    gauges = {
+        "num_requests_waiting": stats.num_waiting,
+        "num_requests_running": stats.num_running,
+        "gpu_cache_usage_perc": round(stats.kv_usage, 6),
+        "prefix_cache_hit_rate": round(stats.prefix_hit_ratio, 6),
+    }
+    counters = {
+        "prompt_tokens_total": stats.prompt_tokens,
+        "generation_tokens_total": stats.generation_tokens,
+        "request_success_total": stats.requests_finished,
+        "num_preemptions_total": stats.preemptions,
+    }
+    lines: list[str] = []
+    for family in ("vllm", "llmd"):
+        for name, v in gauges.items():
+            lines.append(f"# TYPE {family}:{name} gauge")
+            lines.append(f"{family}:{name}{label} {v}")
+        for name, v in counters.items():
+            lines.append(f"# TYPE {family}:{name} counter")
+            lines.append(f"{family}:{name}{label} {v}")
+        lines.append(f"# TYPE {family}:cache_config_info gauge")
+        lines.append(
+            f'{family}:cache_config_info{{block_size="{stats.page_size}",'
+            f'num_gpu_blocks="{stats.num_pages}",model_name="{model_name}"}} 1'
+        )
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Parse a Prometheus text page into {metric_name: value}.
+
+    Labels are dropped; repeated names keep the first sample (single-model
+    engines emit one series per name). This is the scrape-side half of the
+    metrics contract used by the EPP data layer.
+    """
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(None, 1)
+        except ValueError:
+            continue
+        name = name_part.split("{", 1)[0]
+        if name not in out:
+            try:
+                out[name] = float(value)
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,108 @@
+"""Tokenizer loading: HF tokenizers when available, byte-level fallback.
+
+The reference delegates tokenization to vLLM (and exposes it back to the
+router via the /v1/*/render endpoints, reference
+docs/architecture/advanced/kv-management/kv-indexer.md:104-113). Here the
+engine owns a tokenizer directly; the byte-level fallback keeps every test
+and random-weight deployment hermetic (no downloads).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+
+class ByteTokenizer:
+    """Deterministic UTF-8 byte tokenizer: id = byte + 3; 0/1/2 = pad/bos/eos.
+
+    Vocabulary of 259 fits any model config with vocab_size >= 259.
+    """
+
+    pad_token_id = 0
+    bos_token_id = 1
+    eos_token_id = 2
+    _OFFSET = 3
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self._OFFSET
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        ids = [b + self._OFFSET for b in text.encode("utf-8")]
+        if add_special_tokens:
+            ids = [self.bos_token_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(
+            i - self._OFFSET for i in ids if i >= self._OFFSET and i < 256 + self._OFFSET
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tokenize: bool = False,
+    ):
+        """Minimal role-tagged template (stable across processes)."""
+        parts = []
+        for m in messages:
+            content = m.get("content") or ""
+            if isinstance(content, list):  # OpenAI content-part arrays
+                content = "".join(
+                    p.get("text", "") for p in content if isinstance(p, dict)
+                )
+            parts.append(f"<|{m.get('role', 'user')}|>{content}</s>")
+        if add_generation_prompt:
+            parts.append("<|assistant|>")
+        text = "".join(parts)
+        if tokenize:
+            return self.encode(text)
+        return text
+
+
+class HFTokenizerWrapper:
+    """Uniform surface over a transformers tokenizer."""
+
+    def __init__(self, tok) -> None:
+        self._tok = tok
+        self.pad_token_id = tok.pad_token_id or 0
+        self.bos_token_id = tok.bos_token_id
+        self.eos_token_id = tok.eos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_special_tokens)
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def apply_chat_template(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tokenize: bool = False,
+    ):
+        try:
+            return self._tok.apply_chat_template(
+                messages,
+                add_generation_prompt=add_generation_prompt,
+                tokenize=tokenize,
+            )
+        except Exception:
+            fallback = ByteTokenizer()
+            text = fallback.apply_chat_template(messages, add_generation_prompt, False)
+            return self.encode(text) if tokenize else text
+
+
+def load_tokenizer(path: str | None):
+    """Load a tokenizer: HF (local path or hub name) or the byte fallback."""
+    if not path or path == "byte":
+        return ByteTokenizer()
+    from transformers import AutoTokenizer
+
+    return HFTokenizerWrapper(AutoTokenizer.from_pretrained(path))

@@ -1,0 +1,204 @@
+"""OpenAI API surface tests over a tiny CPU-mesh engine.
+
+Exercises the model-server contract the reference router depends on
+(docs/architecture/core/model-servers.md:38-100): completions (stream +
+non-stream), chat, models, health, metrics scrape, render/tokenize.
+"""
+
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config
+from llmd_tpu.engine import LLMEngine
+from llmd_tpu.serve.api import build_app
+from llmd_tpu.serve.async_engine import AsyncEngine
+from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+
+def make_engine(**model_overrides) -> LLMEngine:
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128, **model_overrides),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+    )
+    return LLMEngine(cfg)
+
+
+@pytest.fixture
+async def client():
+    engine = make_engine()
+    app = build_app(AsyncEngine(engine), ByteTokenizer(), "tiny", 128)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    yield c
+    await c.close()
+
+
+async def test_health_and_models(client):
+    r = await client.get("/health")
+    assert r.status == 200
+    r = await client.get("/v1/models")
+    data = await r.json()
+    assert data["data"][0]["id"] == "tiny"
+    assert data["data"][0]["max_model_len"] == 128
+
+
+async def test_completion_basic(client):
+    r = await client.post(
+        "/v1/completions",
+        json={"prompt": "hello world", "max_tokens": 8, "temperature": 0.0},
+    )
+    assert r.status == 200
+    data = await r.json()
+    assert data["object"] == "text_completion"
+    assert data["usage"]["completion_tokens"] >= 1
+    assert data["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+async def test_completion_token_ids_prompt(client):
+    r = await client.post(
+        "/v1/completions",
+        json={"prompt": [5, 6, 7, 8], "max_tokens": 4, "temperature": 0.0},
+    )
+    data = await r.json()
+    assert r.status == 200, data
+    assert data["usage"]["prompt_tokens"] == 4
+
+
+async def test_completion_streaming(client):
+    r = await client.post(
+        "/v1/completions",
+        json={"prompt": "abc", "max_tokens": 6, "temperature": 0.0, "stream": True},
+    )
+    assert r.status == 200
+    assert r.headers["Content-Type"].startswith("text/event-stream")
+    chunks = []
+    async for line in r.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: ") :]
+        if payload == "[DONE]":
+            break
+        chunks.append(json.loads(payload))
+    assert chunks, "no SSE chunks"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+    assert "usage" in chunks[-1]
+
+
+async def test_chat_completion(client):
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 5,
+            "temperature": 0.0,
+        },
+    )
+    assert r.status == 200
+    data = await r.json()
+    assert data["object"] == "chat.completion"
+    assert data["choices"][0]["message"]["role"] == "assistant"
+
+
+async def test_metrics_scrape(client):
+    await client.post(
+        "/v1/completions", json={"prompt": "xy", "max_tokens": 3, "temperature": 0.0}
+    )
+    r = await client.get("/metrics")
+    text = await r.text()
+    assert "vllm:num_requests_waiting" in text
+    assert "llmd:generation_tokens_total" in text
+    from llmd_tpu.serve.metrics import parse_prometheus
+
+    parsed = parse_prometheus(text)
+    assert parsed["vllm:generation_tokens_total"] >= 3
+
+
+async def test_render_endpoints(client):
+    r = await client.post("/v1/completions/render", json={"prompt": "hello"})
+    data = await r.json()
+    ids = data["prompt_token_ids"]
+    assert ids == ByteTokenizer().encode("hello")
+    r = await client.post(
+        "/v1/chat/completions/render",
+        json={"messages": [{"role": "user", "content": "hello"}]},
+    )
+    data = await r.json()
+    assert len(data["prompt_token_ids"]) > 5
+
+
+async def test_validation_errors(client):
+    r = await client.post("/v1/completions", json={"prompt": [], "max_tokens": 2})
+    assert r.status == 400
+    r = await client.post(
+        "/v1/completions", json={"prompt": "x" * 500, "max_tokens": 2}
+    )
+    assert r.status == 400
+    r = await client.post(
+        "/v1/completions", json={"prompt": "ok", "n": 3, "max_tokens": 2}
+    )
+    assert r.status == 400
+
+
+async def test_stop_token_ids(client):
+    # Greedy decoding with every possible token as a stop => stops at 1 token.
+    r = await client.post(
+        "/v1/completions",
+        json={
+            "prompt": "hello",
+            "max_tokens": 10,
+            "temperature": 0.0,
+            "stop_token_ids": list(range(512)),
+        },
+    )
+    data = await r.json()
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert data["usage"]["completion_tokens"] == 1
+
+
+def test_detokenizer_stop_holdback():
+    from llmd_tpu.serve.api import Detokenizer
+
+    tok = ByteTokenizer()
+    # "ab" is the stop; feed "x", "a", "b" one token at a time.
+    d = Detokenizer(tok, ["ab"])
+    deltas = [d.feed(tok.encode("x", add_special_tokens=False))]
+    deltas.append(d.feed(tok.encode("a", add_special_tokens=False)))
+    assert "a" not in "".join(deltas), "stop-prefix leaked to the stream"
+    deltas.append(d.feed(tok.encode("b", add_special_tokens=False)))
+    assert d.stopped
+    assert "".join(deltas) == "x"
+    # Earliest occurrence across stops wins, not first-in-list.
+    d2 = Detokenizer(tok, ["zzz", "c"])
+    d2.feed(tok.encode("abczzz", add_special_tokens=False), final=True)
+    assert d2.stopped and d2.emitted == "ab"
+    # Holdback is flushed when generation finishes without a stop match.
+    d3 = Detokenizer(tok, ["QQ"])
+    out = d3.feed(tok.encode("hel", add_special_tokens=False))
+    out += d3.feed(tok.encode("lo", add_special_tokens=False), final=True)
+    assert out == "hello"
+
+
+async def test_concurrent_requests(client):
+    import asyncio
+
+    async def one(i):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": f"prompt number {i}", "max_tokens": 4, "temperature": 0.0},
+        )
+        assert r.status == 200
+        return await r.json()
+
+    results = await asyncio.gather(*[one(i) for i in range(6)])
+    assert all(r["usage"]["completion_tokens"] >= 1 for r in results)
