@@ -1,0 +1,14 @@
+"""EPP (Endpoint Picker) — the llm-d routing brain, TPU-stack edition.
+
+Re-implements the reference's EPP architecture (reference
+docs/architecture/core/router/epp/README.md:33-101): Request Handler →
+Flow Control → Scheduler (Filter → Score → Pick) backed by a Data Layer of
+per-endpoint attributes. The reference runs this as an Envoy ext-proc gRPC
+server; here the same pipeline fronts an aiohttp reverse proxy (the
+standalone/no-Kubernetes deployment shape, guides/no-kubernetes-deployment/
+README.md:1-50), so one process is both L7 proxy and picker.
+"""
+
+from llmd_tpu.epp.types import Endpoint, LLMRequest, SchedulingResult
+
+__all__ = ["Endpoint", "LLMRequest", "SchedulingResult"]

@@ -1,0 +1,63 @@
+"""`python -m llmd_tpu.epp` — the router entry point.
+
+Standalone (no-Kubernetes) deployment: endpoints come from a JSON file
+watched for changes (the reference's `file-discovery` plugin,
+guides/no-kubernetes-deployment/README.md), the scheduler from an
+EndpointPickerConfig JSON (or the built-in optimized-baseline / pd preset).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("llmd-tpu router")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8800)
+    p.add_argument("--endpoints-file", required=True, help="JSON endpoints file")
+    p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
+    p.add_argument(
+        "--preset", default="default", choices=["default", "pd"],
+        help="built-in config preset when --config is not given",
+    )
+    p.add_argument("--scrape-interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    from aiohttp import web
+
+    from llmd_tpu.epp.config import (
+        DEFAULT_CONFIG,
+        PD_CONFIG,
+        build_flow_control,
+        build_scheduler,
+    )
+    from llmd_tpu.epp.datalayer import (
+        EndpointStore,
+        FileDiscoverySource,
+        MetricsCollector,
+    )
+    from llmd_tpu.epp.server import Router
+
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    else:
+        config = DEFAULT_CONFIG if args.preset == "default" else PD_CONFIG
+
+    store = EndpointStore()
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(config),
+        flow_control=build_flow_control(config),
+        collector=MetricsCollector(store, interval_s=args.scrape_interval),
+        discovery=FileDiscoverySource(store, args.endpoints_file),
+    )
+    web.run_app(router.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""EndpointPickerConfig: declarative router assembly.
+
+Mirrors the reference's `EndpointPickerConfig` YAML
+(docs/api-reference/endpointpickerconfig.md:11-75): `plugins` declare
+type/name/parameters, `schedulingProfiles` reference plugins with weights,
+`flowControl` declares bands + policies. Read once at startup. JSON/dict
+here (YAML loads to the same shape).
+
+Example:
+    {
+      "plugins": [
+        {"type": "queue-scorer", "name": "q"},
+        {"type": "prefix-cache-scorer", "name": "prefix",
+         "parameters": {"block_chars": 256}},
+        {"type": "max-score-picker", "name": "picker"}
+      ],
+      "schedulingProfiles": [
+        {"name": "default",
+         "plugins": [{"pluginRef": "q", "weight": 2},
+                     {"pluginRef": "prefix", "weight": 3},
+                     {"pluginRef": "picker"}]}
+      ],
+      "profileHandler": {"type": "single", "profile": "default"},
+      "flowControl": {"enabled": true, "fairness": "round-robin",
+                      "ordering": "fcfs", "maxInflight": 256,
+                      "bands": [{"priority": 0, "maxRequests": 1024,
+                                 "ttlSeconds": 60}]}
+    }
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# Importing these modules registers their plugins.
+import llmd_tpu.epp.filters  # noqa: F401
+import llmd_tpu.epp.scorers  # noqa: F401
+from llmd_tpu.epp.flow_control import BandConfig, FlowControl, SaturationDetector
+from llmd_tpu.epp.plugins import (
+    Filter,
+    Picker,
+    SchedulingProfile,
+    Scorer,
+    create_plugin,
+)
+from llmd_tpu.epp.scheduler import (
+    DisaggProfileHandler,
+    ProfileHandler,
+    Scheduler,
+    SingleProfileHandler,
+)
+
+DEFAULT_CONFIG: dict[str, Any] = {
+    # The optimized-baseline plugin set (reference
+    # guides/optimized-baseline/router/optimized-baseline.values.yaml:14-32).
+    "plugins": [
+        {"type": "healthy-filter", "name": "healthy"},
+        {"type": "queue-scorer", "name": "queue"},
+        {"type": "kv-cache-utilization-scorer", "name": "kv"},
+        {"type": "prefix-cache-scorer", "name": "prefix"},
+        {"type": "no-hit-lru-scorer", "name": "no-hit-lru"},
+        {"type": "max-score-picker", "name": "picker"},
+    ],
+    "schedulingProfiles": [
+        {
+            "name": "default",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "queue", "weight": 1.0},
+                {"pluginRef": "kv", "weight": 1.0},
+                {"pluginRef": "prefix", "weight": 3.0},
+                {"pluginRef": "no-hit-lru", "weight": 0.5},
+                {"pluginRef": "picker"},
+            ],
+        }
+    ],
+    "profileHandler": {"type": "single", "profile": "default"},
+    "flowControl": {"enabled": True, "maxInflight": 512},
+}
+
+# The P/D plugin config (reference
+# guides/pd-disaggregation/router/pd-disaggregation.values.yaml:11-42).
+PD_CONFIG: dict[str, Any] = {
+    "plugins": [
+        {"type": "healthy-filter", "name": "healthy"},
+        {"type": "decode-filter", "name": "decode"},
+        {"type": "prefill-filter", "name": "prefill"},
+        {"type": "queue-scorer", "name": "queue"},
+        {"type": "kv-cache-utilization-scorer", "name": "kv"},
+        {"type": "prefix-cache-scorer", "name": "prefix"},
+        {"type": "max-score-picker", "name": "picker"},
+    ],
+    "schedulingProfiles": [
+        {
+            "name": "decode",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "decode"},
+                {"pluginRef": "queue", "weight": 1.0},
+                {"pluginRef": "kv", "weight": 1.0},
+                {"pluginRef": "prefix", "weight": 3.0},
+                {"pluginRef": "picker"},
+            ],
+        },
+        {
+            "name": "prefill",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "prefill"},
+                {"pluginRef": "queue", "weight": 2.0},
+                {"pluginRef": "kv", "weight": 1.0},
+                {"pluginRef": "picker"},
+            ],
+        },
+    ],
+    "profileHandler": {
+        "type": "disagg",
+        "decodeProfile": "decode",
+        "prefillProfile": "prefill",
+        "thresholdTokens": 256,
+    },
+    "flowControl": {"enabled": True, "maxInflight": 512},
+}
+
+
+def build_scheduler(config: dict[str, Any]) -> Scheduler:
+    instances: dict[str, Any] = {}
+    for spec in config.get("plugins", []):
+        name = spec.get("name") or spec["type"]
+        instances[name] = create_plugin(spec["type"], **spec.get("parameters", {}))
+
+    profiles: dict[str, SchedulingProfile] = {}
+    for pspec in config.get("schedulingProfiles", []):
+        filters: list[Filter] = []
+        scorers: list[tuple[Scorer, float]] = []
+        picker: Picker | None = None
+        for ref in pspec.get("plugins", []):
+            plugin = instances[ref["pluginRef"]]
+            if isinstance(plugin, Filter):
+                filters.append(plugin)
+            elif isinstance(plugin, Scorer):
+                scorers.append((plugin, float(ref.get("weight", 1.0))))
+            elif isinstance(plugin, Picker):
+                picker = plugin
+            else:
+                raise TypeError(f"plugin {ref['pluginRef']} has unknown role")
+        profiles[pspec["name"]] = SchedulingProfile(
+            pspec["name"], filters, scorers, picker
+        )
+
+    hspec = config.get("profileHandler", {"type": "single"})
+    handler: ProfileHandler
+    if hspec.get("type") == "disagg":
+        handler = DisaggProfileHandler(
+            decode_profile=hspec.get("decodeProfile", "decode"),
+            prefill_profile=hspec.get("prefillProfile", "prefill"),
+            threshold_tokens=int(hspec.get("thresholdTokens", 256)),
+        )
+    else:
+        handler = SingleProfileHandler(
+            hspec.get("profile") or next(iter(profiles), "default")
+        )
+    return Scheduler(profiles, handler)
+
+
+def build_flow_control(config: dict[str, Any]) -> FlowControl:
+    fc = config.get("flowControl", {})
+    bands = [
+        BandConfig(
+            priority=int(b.get("priority", 0)),
+            max_requests=int(b.get("maxRequests", 1024)),
+            max_bytes=int(b.get("maxBytes", 1 << 30)),
+            ttl_s=float(b.get("ttlSeconds", 60.0)),
+        )
+        for b in fc.get("bands", [])
+    ] or None
+    saturation = SaturationDetector(
+        max_inflight=fc.get("maxInflight"),
+        max_kv_usage=fc.get("maxKvUsage"),
+        max_queue_depth=fc.get("maxQueueDepth"),
+    )
+    return FlowControl(
+        bands=bands,
+        fairness=fc.get("fairness", "round-robin"),
+        ordering=fc.get("ordering", "fcfs"),
+        saturation=saturation,
+        max_total_requests=int(fc.get("maxTotalRequests", 4096)),
+        enabled=bool(fc.get("enabled", True)),
+    )

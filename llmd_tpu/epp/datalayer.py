@@ -1,0 +1,251 @@
+"""Data Layer: endpoint discovery + per-endpoint attribute collection.
+
+Reference architecture (docs/architecture/core/router/epp/datalayer.md:49-91):
+Source → Extract → Attribute. Sources here:
+  - StaticSource / FileDiscoverySource (the no-Kubernetes `file-discovery`
+    plugin, guides/no-kubernetes-deployment/README.md:1-50) — watches an
+    endpoints file and reconciles the pool;
+  - MetricsCollector — polls each endpoint's /metrics on an interval
+    (hot loop #4 in SURVEY.md §3.1) and runs the core-metrics-extractor
+    name mapping (model-servers.md:38-52) into standard attributes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+import pathlib
+import time
+from typing import Callable
+
+import aiohttp
+
+from llmd_tpu.epp.types import (
+    BLOCK_SIZE,
+    KV_CACHE_USAGE,
+    NUM_BLOCKS,
+    PREFIX_HIT_RATIO,
+    RUNNING_REQUESTS,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+)
+from llmd_tpu.serve.metrics import parse_prometheus
+
+log = logging.getLogger(__name__)
+
+# Per-engine metric-name mapping (reference model-servers.md:38-52 requires a
+# mapping table per engine family, selected by the llm-d.ai/engine-type
+# label). Each entry: standard attr -> candidate metric names, first found wins.
+METRIC_MAPPINGS: dict[str, dict[str, list[str]]] = {
+    "vllm": {
+        WAITING_QUEUE_SIZE: ["vllm:num_requests_waiting"],
+        RUNNING_REQUESTS: ["vllm:num_requests_running"],
+        KV_CACHE_USAGE: ["vllm:gpu_cache_usage_perc", "vllm:kv_cache_usage_perc"],
+        PREFIX_HIT_RATIO: ["vllm:prefix_cache_hit_rate"],
+    },
+    "llmd": {
+        WAITING_QUEUE_SIZE: ["llmd:num_requests_waiting"],
+        RUNNING_REQUESTS: ["llmd:num_requests_running"],
+        KV_CACHE_USAGE: ["llmd:gpu_cache_usage_perc"],
+        PREFIX_HIT_RATIO: ["llmd:prefix_cache_hit_rate"],
+    },
+    "sglang": {
+        WAITING_QUEUE_SIZE: ["sglang:num_queue_reqs"],
+        RUNNING_REQUESTS: ["sglang:num_running_reqs"],
+        KV_CACHE_USAGE: ["sglang:token_usage"],
+    },
+}
+
+
+def extract_attrs(text: str, engine_type: str = "vllm") -> dict[str, float]:
+    """core-metrics-extractor: raw Prometheus page -> standard attrs."""
+    parsed = parse_prometheus(text)
+    mapping = METRIC_MAPPINGS.get(engine_type, METRIC_MAPPINGS["vllm"])
+    out: dict[str, float] = {}
+    for attr, names in mapping.items():
+        for n in names:
+            if n in parsed:
+                out[attr] = parsed[n]
+                break
+    # cache_config_info labels carry block geometry; parse_prometheus drops
+    # labels, so read them directly if present.
+    for fam in ("vllm", "llmd"):
+        key = f"{fam}:cache_config_info"
+        if key in parsed:
+            for line in text.splitlines():
+                if line.startswith(key + "{"):
+                    m = re.search(r'block_size="(\d+)"', line)
+                    if m:
+                        out[BLOCK_SIZE] = float(m.group(1))
+                    m = re.search(r'num_gpu_blocks="(\d+)"', line)
+                    if m:
+                        out[NUM_BLOCKS] = float(m.group(1))
+                    break
+            break
+    return out
+
+
+class EndpointStore:
+    """The EPP's pool view: address -> Endpoint. Single event loop, no locks."""
+
+    def __init__(self) -> None:
+        self._pods: dict[str, Endpoint] = {}
+        self._on_remove: list[Callable[[str], None]] = []
+
+    def on_remove(self, cb: Callable[[str], None]) -> None:
+        self._on_remove.append(cb)
+
+    def upsert(self, ep: Endpoint) -> Endpoint:
+        existing = self._pods.get(ep.address)
+        if existing is None:
+            self._pods[ep.address] = ep
+            return ep
+        existing.labels = ep.labels or existing.labels
+        existing.model = ep.model or existing.model
+        existing.last_seen = time.monotonic()
+        return existing
+
+    def remove(self, address: str) -> None:
+        if self._pods.pop(address, None) is not None:
+            for cb in self._on_remove:
+                cb(address)
+
+    def get(self, address: str) -> Endpoint | None:
+        return self._pods.get(address)
+
+    def list(self) -> list[Endpoint]:
+        return list(self._pods.values())
+
+    def reconcile(self, endpoints: list[Endpoint]) -> None:
+        want = {e.address for e in endpoints}
+        for addr in list(self._pods):
+            if addr not in want:
+                self.remove(addr)
+        for e in endpoints:
+            self.upsert(e)
+
+
+def parse_endpoints_config(data: dict) -> list[Endpoint]:
+    """Endpoints file schema: {"endpoints": [{"address": "...", "labels": {...},
+    "model": "..."}, ...]} (the file-discovery no-K8s analogue)."""
+    out = []
+    for item in data.get("endpoints", []):
+        if isinstance(item, str):
+            out.append(Endpoint(address=item))
+        else:
+            out.append(
+                Endpoint(
+                    address=item["address"],
+                    labels=dict(item.get("labels", {})),
+                    model=item.get("model"),
+                )
+            )
+    return out
+
+
+class FileDiscoverySource:
+    """Watch a JSON endpoints file; reconcile the store on mtime change."""
+
+    def __init__(self, store: EndpointStore, path: str, poll_s: float = 2.0) -> None:
+        self.store = store
+        self.path = pathlib.Path(path)
+        self.poll_s = poll_s
+        self._mtime = 0.0
+        self._task: asyncio.Task | None = None
+
+    def load_once(self) -> None:
+        data = json.loads(self.path.read_text())
+        self.store.reconcile(parse_endpoints_config(data))
+        self._mtime = self.path.stat().st_mtime
+
+    async def run(self) -> None:
+        while True:
+            try:
+                mtime = self.path.stat().st_mtime
+                if mtime != self._mtime:
+                    self.load_once()
+                    log.info("endpoints file reloaded: %d pods", len(self.store.list()))
+            except FileNotFoundError:
+                pass
+            except Exception:
+                log.exception("endpoints file reload failed")
+            await asyncio.sleep(self.poll_s)
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+
+class MetricsCollector:
+    """Polls every endpoint's /metrics; updates attrs + health.
+
+    An endpoint that fails ``unhealthy_after`` consecutive scrapes is marked
+    unhealthy (filtered out by healthy-filter) but kept in the pool — the
+    discovery source decides membership, the collector decides health.
+    """
+
+    def __init__(
+        self,
+        store: EndpointStore,
+        interval_s: float = 1.0,
+        timeout_s: float = 2.0,
+        unhealthy_after: int = 3,
+        engine_type_default: str = "vllm",
+    ) -> None:
+        self.store = store
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.unhealthy_after = unhealthy_after
+        self.engine_type_default = engine_type_default
+        self._fail_counts: dict[str, int] = {}
+        self._task: asyncio.Task | None = None
+        self._session: aiohttp.ClientSession | None = None
+
+    async def scrape_once(self) -> None:
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        pods = self.store.list()
+        await asyncio.gather(*(self._scrape(p) for p in pods), return_exceptions=True)
+
+    async def _scrape(self, pod: Endpoint) -> None:
+        try:
+            async with self._session.get(pod.url + "/metrics") as resp:
+                text = await resp.text()
+                if resp.status != 200:
+                    raise RuntimeError(f"scrape {resp.status}")
+        except Exception:
+            n = self._fail_counts.get(pod.address, 0) + 1
+            self._fail_counts[pod.address] = n
+            if n >= self.unhealthy_after:
+                pod.healthy = False
+            return
+        self._fail_counts[pod.address] = 0
+        pod.healthy = True
+        engine_type = pod.labels.get("llm-d.ai/engine-type", self.engine_type_default)
+        pod.attrs.update(extract_attrs(text, engine_type))
+        pod.last_seen = time.monotonic()
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.scrape_once()
+            except Exception:
+                log.exception("metrics scrape cycle failed")
+            await asyncio.sleep(self.interval_s)
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._session:
+            await self._session.close()
+            self._session = None

@@ -1,0 +1,77 @@
+"""Filter plugins (reference scheduling.md:77-83)."""
+
+from __future__ import annotations
+
+from llmd_tpu.epp.plugins import Filter, register
+from llmd_tpu.epp.types import (
+    KV_CACHE_USAGE,
+    ROLE_BOTH,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    Endpoint,
+    LLMRequest,
+)
+
+
+@register("label-selector-filter")
+class LabelSelectorFilter(Filter):
+    """Keep endpoints whose labels match all given key=value pairs."""
+
+    def __init__(self, **labels: str) -> None:
+        self.labels = labels
+
+    def filter(self, req: LLMRequest, pods: list[Endpoint]) -> list[Endpoint]:
+        return [
+            p
+            for p in pods
+            if all(p.labels.get(k) == v for k, v in self.labels.items())
+        ]
+
+
+@register("prefill-filter")
+class PrefillFilter(Filter):
+    """Endpoints able to run prefill (role prefill or prefill-decode)."""
+
+    def filter(self, req, pods):
+        return [p for p in pods if p.role in (ROLE_PREFILL, ROLE_BOTH)]
+
+
+@register("decode-filter")
+class DecodeFilter(Filter):
+    """Endpoints able to run decode (role decode or prefill-decode)."""
+
+    def filter(self, req, pods):
+        return [p for p in pods if p.role in (ROLE_DECODE, ROLE_BOTH)]
+
+
+@register("healthy-filter")
+class HealthyFilter(Filter):
+    def filter(self, req, pods):
+        return [p for p in pods if p.healthy]
+
+
+@register("model-filter")
+class ModelFilter(Filter):
+    """Keep endpoints serving the request's model (multi-model pools)."""
+
+    def filter(self, req, pods):
+        if not req.model:
+            return pods
+        return [p for p in pods if p.model in (None, req.model)]
+
+
+@register("kv-headroom-filter")
+class KVHeadroomFilter(Filter):
+    """Drop endpoints whose KV cache is above a utilization ceiling.
+
+    The load-gate half of the reference's prefix-cache-affinity filter
+    (scheduling.md:78-80): perfect cache affinity is worthless on a pod
+    that has no KV headroom to run the request.
+    """
+
+    def __init__(self, max_usage: float = 0.95) -> None:
+        self.max_usage = max_usage
+
+    def filter(self, req, pods):
+        kept = [p for p in pods if p.attr(KV_CACHE_USAGE) <= self.max_usage]
+        return kept or pods  # never filter to zero on load alone

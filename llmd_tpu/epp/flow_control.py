@@ -1,0 +1,294 @@
+"""Flow Control: fairness-aware, saturation-gated admission queues.
+
+Reference: docs/architecture/core/router/epp/flow-control.md —
+FlowKey=(FairnessID, Priority) queues grouped into priority bands
+(:27-41); a 3-tier dispatch cycle (strict priority band order → fairness
+policy across flows in the band → ordering policy within the flow,
+:197-254); a saturation-gated dispatch loop (:260-295); global + per-band
+capacity limits and TTL eviction (:293-359); and the outcome → HTTP mapping
+(429/503 + x-llm-d-request-dropped-reason, :369-409).
+
+Policies: fairness `round-robin` | `strict` (first flow always wins);
+ordering `fcfs` | `edf` (earliest deadline = arrival + TTFT SLO first).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from llmd_tpu.epp.types import LLMRequest
+
+log = logging.getLogger(__name__)
+
+
+class Outcome(enum.Enum):
+    DISPATCHED = "dispatched"
+    REJECTED_CAPACITY = "rejected-capacity"  # 429
+    EVICTED_TTL = "evicted-ttl"  # 503 retryable
+    EVICTED_SHUTDOWN = "evicted-shutdown"  # 503 retryable
+    REJECTED_OTHER = "rejected-other"  # 500
+
+
+# outcome -> (HTTP status, x-llm-d-request-dropped-reason)
+OUTCOME_HTTP = {
+    Outcome.REJECTED_CAPACITY: (429, "queue-full"),
+    Outcome.EVICTED_TTL: (503, "ttl-expired"),
+    Outcome.EVICTED_SHUTDOWN: (503, "shutting-down"),
+    Outcome.REJECTED_OTHER: (500, "internal"),
+}
+
+
+@dataclass
+class BandConfig:
+    """Capacity limits for one priority band (flow-control.md:293-312)."""
+
+    priority: int
+    max_requests: int = 1024
+    max_bytes: int = 1 << 30
+    ttl_s: float = 60.0
+
+
+@dataclass
+class _Item:
+    req: LLMRequest
+    bytes: int
+    future: asyncio.Future
+    enqueue_time: float = field(default_factory=time.monotonic)
+
+    @property
+    def deadline(self) -> float:
+        # EDF deadline: arrival + TTFT SLO (flow-control.md ordering edf);
+        # requests without an SLO sort last within the flow.
+        if self.req.ttft_slo_ms is not None:
+            return self.req.arrival_time + self.req.ttft_slo_ms / 1000.0
+        return float("inf")
+
+
+class SaturationDetector:
+    """Decides whether the backend pool can absorb another dispatch.
+
+    `concurrency` mode: global inflight cap. `utilization` mode: average
+    backend KV utilization / queue depth thresholds (flow-control.md
+    saturation detectors)."""
+
+    def __init__(
+        self,
+        max_inflight: int | None = None,
+        max_kv_usage: float | None = None,
+        max_queue_depth: float | None = None,
+        pool_stats: Callable[[], tuple[float, float]] | None = None,
+    ) -> None:
+        self.max_inflight = max_inflight
+        self.max_kv_usage = max_kv_usage
+        self.max_queue_depth = max_queue_depth
+        self.pool_stats = pool_stats  # () -> (avg_kv_usage, avg_queue_depth)
+        self.inflight = 0
+
+    def saturated(self) -> bool:
+        if self.max_inflight is not None and self.inflight >= self.max_inflight:
+            return True
+        if self.pool_stats is not None and (
+            self.max_kv_usage is not None or self.max_queue_depth is not None
+        ):
+            kv, depth = self.pool_stats()
+            if self.max_kv_usage is not None and kv >= self.max_kv_usage:
+                return True
+            if self.max_queue_depth is not None and depth >= self.max_queue_depth:
+                return True
+        return False
+
+
+class FlowControl:
+    """EnqueueAndWait + background dispatch loop (flow-control.md:260-295)."""
+
+    def __init__(
+        self,
+        bands: list[BandConfig] | None = None,
+        fairness: str = "round-robin",
+        ordering: str = "fcfs",
+        saturation: SaturationDetector | None = None,
+        max_total_requests: int = 4096,
+        poll_interval_s: float = 0.005,
+        enabled: bool = True,
+    ) -> None:
+        self.enabled = enabled
+        self.bands = {b.priority: b for b in (bands or [BandConfig(priority=0)])}
+        if fairness not in ("round-robin", "strict"):
+            raise ValueError(f"unknown fairness policy {fairness!r}")
+        if ordering not in ("fcfs", "edf"):
+            raise ValueError(f"unknown ordering policy {ordering!r}")
+        self.fairness = fairness
+        self.ordering = ordering
+        self.saturation = saturation or SaturationDetector()
+        self.max_total_requests = max_total_requests
+        self.poll_interval_s = poll_interval_s
+        # band priority -> flow id -> deque[_Item]
+        self._queues: dict[int, dict[str, collections.deque[_Item]]] = {}
+        # round-robin cursor per band
+        self._rr: dict[int, collections.deque[str]] = {}
+        self._total = 0
+        self._bytes: dict[int, int] = collections.defaultdict(int)
+        self._counts: dict[int, int] = collections.defaultdict(int)
+        self._event = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._draining = False
+        self.outcomes: collections.Counter = collections.Counter()
+
+    # ------------------------------------------------------------------ #
+
+    def band_for(self, priority: int) -> BandConfig:
+        """Unconfigured priorities get a default-capacity band AT their own
+        priority — never demoted below configured bands."""
+        band = self.bands.get(priority)
+        if band is None:
+            band = self.bands[priority] = BandConfig(priority=priority)
+        return band
+
+    def queue_depth(self) -> int:
+        return self._total
+
+    async def enqueue_and_wait(self, req: LLMRequest, nbytes: int = 0) -> Outcome:
+        """Park the caller until dispatched or dropped; returns the outcome."""
+        if not self.enabled:
+            return Outcome.DISPATCHED
+        if self._draining:
+            self.outcomes[Outcome.EVICTED_SHUTDOWN] += 1
+            return Outcome.EVICTED_SHUTDOWN
+        band = self.band_for(req.priority)
+        if (
+            self._total >= self.max_total_requests
+            or self._counts[band.priority] >= band.max_requests
+            or self._bytes[band.priority] + nbytes > band.max_bytes
+        ):
+            self.outcomes[Outcome.REJECTED_CAPACITY] += 1
+            return Outcome.REJECTED_CAPACITY
+        item = _Item(req, nbytes, asyncio.get_event_loop().create_future())
+        flows = self._queues.setdefault(band.priority, {})
+        flow = flows.get(req.fairness_id)
+        if flow is None:
+            flow = collections.deque()
+            flows[req.fairness_id] = flow
+            self._rr.setdefault(band.priority, collections.deque()).append(
+                req.fairness_id
+            )
+        flow.append(item)
+        self._total += 1
+        self._counts[band.priority] += 1
+        self._bytes[band.priority] += nbytes
+        self._event.set()
+        try:
+            outcome = await item.future
+        except asyncio.CancelledError:
+            # If the dispatcher already granted the slot, give it back —
+            # the caller will never reach its release().
+            if (
+                item.future.done()
+                and not item.future.cancelled()
+                and item.future.result() is Outcome.DISPATCHED
+            ):
+                self.release()
+            else:
+                item.future = None  # type: ignore  # mark dead; dispatch skips it
+            raise
+        self.outcomes[outcome] += 1
+        return outcome
+
+    def release(self) -> None:
+        """A dispatched request completed (frees inflight concurrency)."""
+        if not self.enabled:
+            return
+        self.saturation.inflight = max(0, self.saturation.inflight - 1)
+        self._event.set()
+
+    # ------------------------------------------------------------------ #
+    # dispatch cycle: strict band priority -> fairness -> ordering
+
+    def _next_item(self) -> _Item | None:
+        for prio in sorted(self._queues, reverse=True):  # higher = first
+            flows = self._queues[prio]
+            order = self._rr.get(prio, collections.deque())
+            if self.fairness == "strict":
+                candidates = sorted(order)
+            else:
+                candidates = list(order)
+            for flow_id in candidates:
+                flow = flows.get(flow_id)
+                if not flow:
+                    continue
+                if self.ordering == "edf":
+                    item = min(flow, key=lambda it: (it.deadline, it.enqueue_time))
+                    flow.remove(item)
+                else:
+                    item = flow.popleft()
+                self._pop_accounting(prio, item)
+                if self.fairness == "round-robin":
+                    order.rotate(-(candidates.index(flow_id) + 1))
+                return item
+        return None
+
+    def _pop_accounting(self, prio: int, item: _Item) -> None:
+        self._total -= 1
+        self._counts[prio] -= 1
+        self._bytes[prio] -= item.bytes
+        flows = self._queues[prio]
+        if not flows.get(item.req.fairness_id):
+            flows.pop(item.req.fairness_id, None)
+            try:
+                self._rr[prio].remove(item.req.fairness_id)
+            except ValueError:
+                pass
+
+    def _expire_ttls(self) -> None:
+        now = time.monotonic()
+        for prio, flows in list(self._queues.items()):
+            ttl = self.band_for(prio).ttl_s
+            for flow_id, flow in list(flows.items()):
+                while flow and now - flow[0].enqueue_time > ttl:
+                    item = flow.popleft()
+                    self._pop_accounting(prio, item)
+                    if item.future is not None and not item.future.done():
+                        item.future.set_result(Outcome.EVICTED_TTL)
+
+    async def _dispatch_loop(self) -> None:
+        while True:
+            self._expire_ttls()
+            if self._total == 0:
+                self._event.clear()
+                await self._event.wait()
+                continue
+            if self.saturation.saturated():
+                # Saturated: hold dispatch, poll (the reference's
+                # saturation-gated worker loop, flow-control.md:260-295).
+                await asyncio.sleep(self.poll_interval_s)
+                continue
+            item = self._next_item()
+            if item is None:
+                await asyncio.sleep(self.poll_interval_s)
+                continue
+            if item.future is None or item.future.done():
+                continue  # caller went away
+            self.saturation.inflight += 1
+            item.future.set_result(Outcome.DISPATCHED)
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._dispatch_loop())
+
+    async def drain(self) -> None:
+        """Graceful shutdown: evict queued requests with retryable 503
+        (flow-control.md:312,350)."""
+        self._draining = True
+        for prio, flows in list(self._queues.items()):
+            for flow in list(flows.values()):
+                while flow:
+                    item = flow.popleft()
+                    self._pop_accounting(prio, item)
+                    if item.future is not None and not item.future.done():
+                        item.future.set_result(Outcome.EVICTED_SHUTDOWN)
+        if self._task:
+            self._task.cancel()

@@ -1,0 +1,118 @@
+"""Request Handler: parser plugins + admitters + data producers.
+
+Reference: docs/architecture/core/router/epp/request-handling.md:50-86 —
+the `openai-parser` understands /v1/chat/completions, /v1/completions,
+/v1/embeddings; DataProducers annotate the request (prefix hashes, inflight
+load, predicted latency) before admission and scheduling; Admitters can
+reject up front. Header contract: docs/api-reference/epp-http-headers.md.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any
+
+from llmd_tpu.epp.types import (
+    HDR_FAIRNESS_ID,
+    HDR_OBJECTIVE,
+    HDR_TPOT_SLO,
+    HDR_TTFT_SLO,
+    LLMRequest,
+)
+
+GENERATE_PATHS = {
+    "/v1/completions",
+    "/v1/chat/completions",
+    "/v1/embeddings",
+    "/v1/conversations",
+    "/v1/responses",
+}
+
+
+class ParseError(ValueError):
+    pass
+
+
+def _messages_text(msgs: list) -> str:
+    parts = []
+    for m in msgs:
+        if not isinstance(m, dict):
+            continue
+        c = m.get("content") or ""
+        if isinstance(c, list):
+            c = "".join(p.get("text", "") for p in c if isinstance(p, dict))
+        parts.append(f"<|{m.get('role', 'user')}|>{c}")
+    return "".join(parts)
+
+
+def _prompt_from_body(path: str, body: dict) -> tuple[str, list[int] | None]:
+    """Extract the cache-relevant prompt text (and token ids if given)."""
+    if path.endswith("/chat/completions") or path.endswith("/conversations"):
+        return _messages_text(body.get("messages") or []), None
+    prompt = body.get("prompt") or body.get("input") or ""
+    if isinstance(prompt, list) and prompt and isinstance(prompt[0], dict):
+        # /v1/responses structured input: a list of message objects.
+        return _messages_text(prompt), None
+    if isinstance(prompt, list):
+        if prompt and isinstance(prompt[0], int):
+            return "", list(prompt)
+        if prompt and isinstance(prompt[0], str):
+            return prompt[0], None
+        if prompt and isinstance(prompt[0], list):
+            return "", list(prompt[0])
+        return "", None
+    return str(prompt), None
+
+
+def openai_parse(
+    path: str, headers: dict[str, str], raw_body: bytes
+) -> LLMRequest:
+    """The openai-parser: HTTP request -> LLMRequest."""
+    try:
+        body: dict[str, Any] = json.loads(raw_body) if raw_body else {}
+    except json.JSONDecodeError as e:
+        raise ParseError(f"invalid JSON body: {e}") from e
+    if not isinstance(body, dict):
+        raise ParseError("request body must be a JSON object")
+    prompt_text, prompt_ids = _prompt_from_body(path, body)
+    h = {k.lower(): v for k, v in headers.items()}
+
+    def _float_hdr(name: str) -> float | None:
+        v = h.get(name)
+        try:
+            return float(v) if v is not None else None
+        except ValueError:
+            return None
+
+    return LLMRequest(
+        request_id=h.get("x-request-id") or f"epp-{uuid.uuid4().hex}",
+        model=str(body.get("model") or ""),
+        prompt_text=prompt_text,
+        prompt_token_ids=prompt_ids,
+        headers=h,
+        body=body,
+        path=path,
+        streaming=bool(body.get("stream", False)),
+        priority=int(body.get("priority", 0) or 0),
+        fairness_id=h.get(HDR_FAIRNESS_ID, ""),
+        ttft_slo_ms=_float_hdr(HDR_TTFT_SLO),
+        tpot_slo_ms=_float_hdr(HDR_TPOT_SLO),
+    )
+
+
+class Admitter:
+    """Pre-queue admission check; return a reason string to reject."""
+
+    def admit(self, req: LLMRequest) -> str | None:
+        return None
+
+
+class MaxPromptAdmitter(Admitter):
+    def __init__(self, max_prompt_tokens: int = 1 << 20) -> None:
+        self.max_prompt_tokens = max_prompt_tokens
+
+    def admit(self, req: LLMRequest) -> str | None:
+        if req.approx_prompt_tokens > self.max_prompt_tokens:
+            return f"prompt exceeds {self.max_prompt_tokens} tokens"
+        return None

@@ -1,0 +1,147 @@
+"""Plugin framework: Filter → Scorer → Picker → ProfileHandler.
+
+The reference's scheduler runs per-profile plugin chains with weighted score
+summation (docs/architecture/core/router/epp/scheduling.md:44-118); plugins
+are declared by type/name/parameters in EndpointPickerConfig
+(docs/api-reference/endpointpickerconfig.md:11-75). Same model here: a
+registry keyed by plugin type name, instantiated from config dicts.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable
+
+from llmd_tpu.epp.types import Endpoint, LLMRequest, ProfileResult
+
+_REGISTRY: dict[str, Callable[..., Any]] = {}
+
+
+def register(type_name: str):
+    def deco(cls):
+        _REGISTRY[type_name] = cls
+        cls.plugin_type = type_name
+        return cls
+
+    return deco
+
+
+def create_plugin(type_name: str, **parameters):
+    if type_name not in _REGISTRY:
+        raise KeyError(f"unknown plugin type {type_name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[type_name](**parameters)
+
+
+def registered_plugins() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+class Filter:
+    """Drops endpoints that cannot serve the request."""
+
+    def filter(self, req: LLMRequest, pods: list[Endpoint]) -> list[Endpoint]:
+        raise NotImplementedError
+
+
+class Scorer:
+    """Scores each endpoint in [0, 1] (higher = better)."""
+
+    def score(self, req: LLMRequest, pods: list[Endpoint]) -> dict[str, float]:
+        raise NotImplementedError
+
+    def on_routed(self, req: LLMRequest, pod: Endpoint) -> None:
+        """Hook after the pick lands on ``pod`` (state-updating scorers)."""
+
+    def on_complete(self, req: LLMRequest, pod: Endpoint) -> None:
+        """Hook when the request finishes on ``pod``."""
+
+    def on_endpoint_removed(self, address: str) -> None:
+        """Hook when an endpoint leaves the pool (index cleanup)."""
+
+
+class Picker:
+    """Chooses one endpoint from the scored set."""
+
+    def pick(
+        self, req: LLMRequest, scored: dict[str, float], pods: list[Endpoint]
+    ) -> Endpoint | None:
+        raise NotImplementedError
+
+
+class SchedulingProfile:
+    """One filter→score→pick chain (scheduling.md:60-68)."""
+
+    def __init__(
+        self,
+        name: str,
+        filters: list[Filter] | None = None,
+        scorers: list[tuple[Scorer, float]] | None = None,
+        picker: Picker | None = None,
+    ) -> None:
+        self.name = name
+        self.filters = filters or []
+        self.scorers = scorers or []
+        self.picker = picker or MaxScorePicker()
+
+    def run(self, req: LLMRequest, pods: list[Endpoint]) -> ProfileResult:
+        for f in self.filters:
+            pods = f.filter(req, pods)
+            if not pods:
+                return ProfileResult(self.name, None)
+        totals: dict[str, float] = {p.address: 0.0 for p in pods}
+        for scorer, weight in self.scorers:
+            part = scorer.score(req, pods)
+            for addr in totals:
+                totals[addr] += weight * part.get(addr, 0.0)
+        chosen = self.picker.pick(req, totals, pods)
+        return ProfileResult(self.name, chosen, totals)
+
+    def notify_routed(self, req: LLMRequest, pod: Endpoint) -> None:
+        for scorer, _ in self.scorers:
+            scorer.on_routed(req, pod)
+
+    def notify_complete(self, req: LLMRequest, pod: Endpoint) -> None:
+        for scorer, _ in self.scorers:
+            scorer.on_complete(req, pod)
+
+
+# --------------------------------------------------------------------- #
+# Pickers (scheduling.md:104-108)
+
+
+@register("max-score-picker")
+class MaxScorePicker(Picker):
+    """Highest total score; ties broken randomly (the default picker)."""
+
+    def __init__(self, seed: int | None = None) -> None:
+        self._rng = random.Random(seed)
+
+    def pick(self, req, scored, pods):
+        if not pods:
+            return None
+        best = max(scored.get(p.address, 0.0) for p in pods)
+        top = [p for p in pods if scored.get(p.address, 0.0) >= best - 1e-12]
+        return self._rng.choice(top)
+
+
+@register("random-picker")
+class RandomPicker(Picker):
+    def __init__(self, seed: int | None = None) -> None:
+        self._rng = random.Random(seed)
+
+    def pick(self, req, scored, pods):
+        return self._rng.choice(pods) if pods else None
+
+
+@register("weighted-random-picker")
+class WeightedRandomPicker(Picker):
+    """Probability proportional to score (exploration-friendly)."""
+
+    def __init__(self, seed: int | None = None) -> None:
+        self._rng = random.Random(seed)
+
+    def pick(self, req, scored, pods):
+        if not pods:
+            return None
+        weights = [max(scored.get(p.address, 0.0), 0.0) + 1e-9 for p in pods]
+        return self._rng.choices(pods, weights=weights, k=1)[0]

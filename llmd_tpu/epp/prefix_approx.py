@@ -1,0 +1,87 @@
+"""Approximate prefix-cache index (EPP-side, no engine events needed).
+
+The reference's approximate prefix cache plugin
+(docs/architecture/advanced/kv-management/prefix-cache-aware-routing.md:18-29):
+prompts are chunked into fixed-size blocks hashed with a rolling chain; the
+EPP remembers which endpoint each block hash was last routed to (updated on
+its OWN routing decisions, not engine events) in an LRU, and scores
+endpoints by longest consecutive matched prefix. Works unmodified for chat
+payloads because the serialized prompt text is hashed, not token ids.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+
+
+def text_block_hashes(text: str, block_chars: int) -> list[bytes]:
+    """Chained hashes of fixed-char blocks of the prompt text."""
+    out: list[bytes] = []
+    parent = b"llmd-prefix-root"
+    for start in range(0, len(text) - block_chars + 1, block_chars):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent)
+        h.update(text[start : start + block_chars].encode("utf-8", "replace"))
+        parent = h.digest()
+        out.append(parent)
+    return out
+
+
+class ApproxPrefixIndex:
+    """LRU of block hash → {endpoint addresses that likely hold it}."""
+
+    def __init__(
+        self,
+        block_chars: int = 256,
+        max_entries: int = 500_000,
+        max_prefix_blocks: int = 1024,
+    ) -> None:
+        self.block_chars = block_chars
+        self.max_entries = max_entries
+        self.max_prefix_blocks = max_prefix_blocks
+        self._lru: collections.OrderedDict[bytes, set[str]] = collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def hashes(self, text: str) -> list[bytes]:
+        return text_block_hashes(text, self.block_chars)[: self.max_prefix_blocks]
+
+    def record_routed(self, hashes: list[bytes], address: str) -> None:
+        """Remember that this prompt's blocks now live on ``address``."""
+        for h in hashes:
+            entry = self._lru.get(h)
+            if entry is None:
+                entry = set()
+                self._lru[h] = entry
+            entry.add(address)
+            self._lru.move_to_end(h)
+        while len(self._lru) > self.max_entries:
+            self._lru.popitem(last=False)
+
+    def match_lengths(self, hashes: list[bytes]) -> dict[str, int]:
+        """Longest consecutive matched block count per endpoint address."""
+        out: dict[str, int] = {}
+        live: set[str] | None = None
+        for i, h in enumerate(hashes):
+            holders = self._lru.get(h)
+            if not holders:
+                break
+            self._lru.move_to_end(h)
+            live = set(holders) if live is None else live & holders
+            if not live:
+                break
+            for addr in live:
+                out[addr] = i + 1
+        return out
+
+    def evict_endpoint(self, address: str) -> None:
+        """Forget an endpoint (it left the pool or cleared its cache)."""
+        dead = []
+        for h, holders in self._lru.items():
+            holders.discard(address)
+            if not holders:
+                dead.append(h)
+        for h in dead:
+            del self._lru[h]

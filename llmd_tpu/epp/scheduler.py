@@ -1,0 +1,151 @@
+"""Request Scheduler: ProfileHandler → per-profile Filter/Score/Pick.
+
+Reference: docs/architecture/core/router/epp/scheduling.md:44-118. The
+ProfileHandler decides WHICH profiles run (single vs disagg prefill+decode);
+each SchedulingProfile runs its chain; ProcessResults assembles the
+SchedulingResult (primary destination + optional prefill endpoint for the
+P/D sidecar header).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from llmd_tpu.epp.plugins import SchedulingProfile
+from llmd_tpu.epp.types import (
+    Endpoint,
+    LLMRequest,
+    ProfileResult,
+    SchedulingResult,
+)
+
+log = logging.getLogger(__name__)
+
+
+class NoEndpointsError(RuntimeError):
+    """No endpoint survived filtering — maps to 503 at the HTTP edge."""
+
+
+class ProfileHandler:
+    """Picks profiles to run and assembles the result."""
+
+    def profiles_for(
+        self, req: LLMRequest, profiles: dict[str, SchedulingProfile]
+    ) -> list[str]:
+        raise NotImplementedError
+
+    def assemble(
+        self, req: LLMRequest, results: dict[str, ProfileResult]
+    ) -> SchedulingResult:
+        raise NotImplementedError
+
+
+class SingleProfileHandler(ProfileHandler):
+    """Default: run the sole profile; its pick is the destination
+    (scheduling.md:110-112)."""
+
+    def __init__(self, profile_name: str = "default") -> None:
+        self.profile_name = profile_name
+
+    def profiles_for(self, req, profiles):
+        return [self.profile_name]
+
+    def assemble(self, req, results):
+        r = results[self.profile_name]
+        if r.endpoint is None:
+            raise NoEndpointsError("no endpoint available")
+        return SchedulingResult(primary=r.endpoint, profiles=results)
+
+
+class DisaggProfileHandler(ProfileHandler):
+    """P/D disaggregation (scheduling.md:113-118 + disaggregation/README.md).
+
+    Runs the decode profile first, then the decider asks "is a separate
+    prefill worth it?" — long uncached prefills go to a prefill pod; short
+    or well-cached ones decode-only. The decode pick is always the primary
+    destination; the prefill pick rides the x-prefiller-host-port header.
+    """
+
+    def __init__(
+        self,
+        decode_profile: str = "decode",
+        prefill_profile: str = "prefill",
+        threshold_tokens: int = 256,
+    ) -> None:
+        self.decode_profile = decode_profile
+        self.prefill_profile = prefill_profile
+        self.threshold_tokens = threshold_tokens
+
+    def _wants_prefill(self, req: LLMRequest, decode: ProfileResult) -> bool:
+        # Decider: how much of the prompt is NOT already cached on the decode
+        # pod? (disaggregation/README.md:57-99). The decode profile's prefix
+        # match fraction lives in scratch (set by the prefix scorer).
+        uncached = req.approx_prompt_tokens
+        if decode.endpoint is not None:
+            frac = req.scratch.get("prefix_match_frac", {}).get(
+                decode.endpoint.address, 0.0
+            )
+            uncached = int(uncached * (1.0 - frac))
+        return uncached >= self.threshold_tokens
+
+    def profiles_for(self, req, profiles):
+        return [self.decode_profile, self.prefill_profile]
+
+    def assemble(self, req, results):
+        decode = results[self.decode_profile]
+        if decode.endpoint is None:
+            raise NoEndpointsError("no decode endpoint available")
+        prefill = results.get(self.prefill_profile)
+        prefill_ep: Endpoint | None = None
+        if (
+            prefill is not None
+            and prefill.endpoint is not None
+            and prefill.endpoint.address != decode.endpoint.address
+            and self._wants_prefill(req, decode)
+        ):
+            prefill_ep = prefill.endpoint
+        return SchedulingResult(
+            primary=decode.endpoint, prefill=prefill_ep, profiles=results
+        )
+
+
+class Scheduler:
+    """Runs the configured profiles over the current pod set."""
+
+    def __init__(
+        self,
+        profiles: dict[str, SchedulingProfile],
+        handler: ProfileHandler | None = None,
+    ) -> None:
+        self.profiles = profiles
+        self.handler = handler or SingleProfileHandler(next(iter(profiles)))
+
+    def schedule(self, req: LLMRequest, pods: list[Endpoint]) -> SchedulingResult:
+        if not pods:
+            raise NoEndpointsError("endpoint pool is empty")
+        results: dict[str, ProfileResult] = {}
+        for name in self.handler.profiles_for(req, self.profiles):
+            profile = self.profiles.get(name)
+            if profile is None:
+                continue
+            results[name] = profile.run(req, list(pods))
+        result = self.handler.assemble(req, results)
+        # notify state-updating scorers on the winning profile(s)
+        for name, pr in results.items():
+            if pr.endpoint is not None and (
+                pr.endpoint is result.primary or pr.endpoint is result.prefill
+            ):
+                self.profiles[name].notify_routed(req, pr.endpoint)
+        return result
+
+    def notify_complete(self, req: LLMRequest, pod: Endpoint) -> None:
+        for profile in self.profiles.values():
+            profile.notify_complete(req, pod)
+
+    def notify_endpoint_removed(self, address: str) -> None:
+        seen: set[int] = set()
+        for profile in self.profiles.values():
+            for scorer, _ in profile.scorers:
+                if id(scorer) not in seen:
+                    seen.add(id(scorer))
+                    scorer.on_endpoint_removed(address)

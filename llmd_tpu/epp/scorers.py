@@ -1,0 +1,185 @@
+"""Scorer plugins (reference scheduling.md:85-102).
+
+All scores are normalized to [0, 1], higher = better; profiles combine them
+with configured weights (scheduling.md:60-68).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from llmd_tpu.epp.plugins import Scorer, register
+from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex
+from llmd_tpu.epp.types import (
+    KV_CACHE_USAGE,
+    RUNNING_REQUESTS,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
+
+
+@register("queue-scorer")
+class QueueScorer(Scorer):
+    """Least waiting-queue depth wins (scheduling.md:94)."""
+
+    def score(self, req, pods):
+        qs = {p.address: p.attr(WAITING_QUEUE_SIZE) for p in pods}
+        worst = max(qs.values(), default=0.0)
+        if worst <= 0:
+            return {a: 1.0 for a in qs}
+        return {a: 1.0 - q / worst for a, q in qs.items()}
+
+
+@register("kv-cache-utilization-scorer")
+class KVCacheUtilizationScorer(Scorer):
+    """Free KV headroom wins (scheduling.md:92)."""
+
+    def score(self, req, pods):
+        return {p.address: max(0.0, 1.0 - p.attr(KV_CACHE_USAGE)) for p in pods}
+
+
+@register("running-requests-scorer")
+class RunningRequestsScorer(Scorer):
+    """Fewest running requests wins; blends the polled metric with the
+    EPP's own inflight count (fresher between scrapes)."""
+
+    def score(self, req, pods):
+        load = {
+            p.address: max(p.attr(RUNNING_REQUESTS), float(p.inflight)) for p in pods
+        }
+        worst = max(load.values(), default=0.0)
+        if worst <= 0:
+            return {a: 1.0 for a in load}
+        return {a: 1.0 - v / worst for a, v in load.items()}
+
+
+@register("token-load-scorer")
+class TokenLoadScorer(Scorer):
+    """Fewest in-flight routed tokens wins (scheduling.md:97 token-load)."""
+
+    def score(self, req, pods):
+        load = {p.address: float(p.inflight_tokens) for p in pods}
+        worst = max(load.values(), default=0.0)
+        if worst <= 0:
+            return {a: 1.0 for a in load}
+        return {a: 1.0 - v / worst for a, v in load.items()}
+
+
+@register("session-affinity-scorer")
+class SessionAffinityScorer(Scorer):
+    """Sticky routing by session: the pod that served this session's last
+    request scores 1 (scheduling.md:98). Session key = x-session-id header
+    or the fairness id."""
+
+    def __init__(self, max_sessions: int = 100_000, ttl_s: float = 3600.0) -> None:
+        self._lru: collections.OrderedDict[str, tuple[str, float]] = (
+            collections.OrderedDict()
+        )
+        self.max_sessions = max_sessions
+        self.ttl_s = ttl_s
+
+    @staticmethod
+    def _key(req: LLMRequest) -> str | None:
+        return req.headers.get("x-session-id") or req.fairness_id or None
+
+    def score(self, req, pods):
+        key = self._key(req)
+        if key is None:
+            return {p.address: 0.0 for p in pods}
+        entry = self._lru.get(key)
+        if entry is None or time.monotonic() - entry[1] > self.ttl_s:
+            return {p.address: 0.0 for p in pods}
+        return {p.address: 1.0 if p.address == entry[0] else 0.0 for p in pods}
+
+    def on_routed(self, req, pod):
+        key = self._key(req)
+        if key is None:
+            return
+        self._lru[key] = (pod.address, time.monotonic())
+        self._lru.move_to_end(key)
+        while len(self._lru) > self.max_sessions:
+            self._lru.popitem(last=False)
+
+
+@register("no-hit-lru-scorer")
+class NoHitLRUScorer(Scorer):
+    """For requests with NO prefix-cache hit anywhere: prefer the endpoint
+    least-recently chosen by this scorer, spreading cold prompts round-robin
+    instead of piling them on the momentarily-emptiest pod
+    (scheduling.md:99 no-hit-lru)."""
+
+    def __init__(self) -> None:
+        self._last_routed: dict[str, float] = {}
+
+    def score(self, req, pods):
+        # Only active when the prefix producer found no hit (scratch flag).
+        if req.scratch.get("prefix_hit", False):
+            return {p.address: 0.0 for p in pods}
+        ranked = sorted(pods, key=lambda p: self._last_routed.get(p.address, 0.0))
+        n = len(ranked)
+        if n <= 1:
+            return {p.address: 1.0 for p in ranked}
+        return {p.address: 1.0 - i / (n - 1) for i, p in enumerate(ranked)}
+
+    def on_routed(self, req, pod):
+        self._last_routed[pod.address] = time.monotonic()
+
+
+@register("prefix-cache-scorer")
+class PrefixCacheScorer(Scorer):
+    """Approximate prefix-affinity scoring (prefix-cache-aware-routing.md).
+
+    Score = matched-prefix blocks / total prompt blocks for each endpoint;
+    the index is updated on routing decisions. Sets scratch['prefix_hit']
+    for the no-hit-lru scorer pairing (scheduling.md:99).
+    """
+
+    def __init__(
+        self,
+        block_chars: int = 256,
+        max_entries: int = 500_000,
+        max_prefix_blocks: int = 1024,
+    ) -> None:
+        self.index = ApproxPrefixIndex(block_chars, max_entries, max_prefix_blocks)
+
+    def score(self, req, pods):
+        hashes = req.scratch.get("prefix_hashes")
+        if hashes is None:
+            hashes = self.index.hashes(req.prompt_text)
+            req.scratch["prefix_hashes"] = hashes
+        if not hashes:
+            req.scratch["prefix_hit"] = False
+            return {p.address: 0.0 for p in pods}
+        matches = self.index.match_lengths(hashes)
+        req.scratch["prefix_hit"] = bool(matches)
+        total = len(hashes)
+        scores = {p.address: matches.get(p.address, 0) / total for p in pods}
+        # Per-endpoint matched fraction for the disagg decider
+        # (scheduler.DisaggProfileHandler._wants_prefill).
+        req.scratch.setdefault("prefix_match_frac", {}).update(scores)
+        return scores
+
+    def on_routed(self, req, pod):
+        hashes = req.scratch.get("prefix_hashes")
+        if hashes:
+            self.index.record_routed(hashes, pod.address)
+
+    def on_endpoint_removed(self, address: str) -> None:
+        self.index.evict_endpoint(address)
+
+
+@register("lora-affinity-scorer")
+class LoraAffinityScorer(Scorer):
+    """Prefer endpoints that already have the request's adapter loaded
+    (scheduling.md:96). Adapter presence comes from the data layer attr
+    'LoadedAdapters' (list) refreshed by the metrics collector."""
+
+    def score(self, req, pods):
+        adapter = req.body.get("model") or req.model
+        out = {}
+        for p in pods:
+            loaded = p.attrs.get("LoadedAdapters") or []
+            out[p.address] = 1.0 if adapter in loaded else 0.0
+        return out

@@ -1,0 +1,367 @@
+"""The router process: aiohttp reverse proxy wired to the EPP pipeline.
+
+Request path (SURVEY.md §3.1 call stack): parse (openai-parser) →
+admitters → flow control EnqueueAndWait → data producers → scheduler
+(filter/score/pick) → proxy to the picked endpoint (streaming passthrough)
+→ response processors (latency sampling, inflight accounting, prefix-index
+update via scorer hooks). The reference splits proxy (Envoy) from picker
+(EPP ext-proc); standalone mode fuses them in one process, matching the
+no-Kubernetes deployment shape (guides/no-kubernetes-deployment/README.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.epp.datalayer import EndpointStore, FileDiscoverySource, MetricsCollector
+from llmd_tpu.epp.flow_control import OUTCOME_HTTP, FlowControl, Outcome
+from llmd_tpu.epp.handler import (
+    GENERATE_PATHS,
+    Admitter,
+    ParseError,
+    openai_parse,
+)
+from llmd_tpu.epp.scheduler import NoEndpointsError, Scheduler
+from llmd_tpu.epp.types import (
+    HDR_DROP_REASON,
+    HDR_PREFILLER,
+    KV_CACHE_USAGE,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
+
+log = logging.getLogger(__name__)
+
+HOP_HEADERS = {
+    "connection",
+    "keep-alive",
+    "transfer-encoding",
+    "te",
+    "upgrade",
+    "proxy-authorization",
+    "proxy-authenticate",
+    "host",
+    "content-length",
+}
+
+
+class RouterMetrics:
+    """EPP self-metrics (reference scheduling.md:161-191)."""
+
+    def __init__(self) -> None:
+        self.requests_total = 0
+        self.scheduling_attempts = 0
+        self.scheduling_errors = 0
+        self.proxy_errors = 0
+        self.ttft_sum = 0.0
+        self.ttft_count = 0
+        self.e2e_sum = 0.0
+        self.outcome_counts: collections.Counter = collections.Counter()
+
+    def render(self, store: EndpointStore, flow: FlowControl) -> str:
+        pods = store.list()
+        ready = sum(1 for p in pods if p.healthy)
+        avg_kv = sum(p.attr(KV_CACHE_USAGE) for p in pods) / max(len(pods), 1)
+        avg_q = sum(p.attr(WAITING_QUEUE_SIZE) for p in pods) / max(len(pods), 1)
+        lines = [
+            "# TYPE llm_d_epp_ready_endpoints gauge",
+            f"llm_d_epp_ready_endpoints {ready}",
+            "# TYPE llm_d_epp_pool_avg_kv_cache_utilization gauge",
+            f"llm_d_epp_pool_avg_kv_cache_utilization {avg_kv:.6f}",
+            "# TYPE llm_d_epp_pool_avg_queue_size gauge",
+            f"llm_d_epp_pool_avg_queue_size {avg_q:.6f}",
+            "# TYPE llm_d_epp_flow_control_queue_size gauge",
+            f"llm_d_epp_flow_control_queue_size {flow.queue_depth()}",
+            "# TYPE llm_d_epp_requests_total counter",
+            f"llm_d_epp_requests_total {self.requests_total}",
+            "# TYPE llm_d_epp_scheduling_attempts_total counter",
+            f"llm_d_epp_scheduling_attempts_total {self.scheduling_attempts}",
+            "# TYPE llm_d_epp_scheduling_errors_total counter",
+            f"llm_d_epp_scheduling_errors_total {self.scheduling_errors}",
+            "# TYPE llm_d_epp_proxy_errors_total counter",
+            f"llm_d_epp_proxy_errors_total {self.proxy_errors}",
+        ]
+        for oc, n in {**flow.outcomes, **self.outcome_counts}.items():
+            name = oc.value if isinstance(oc, Outcome) else str(oc)
+            lines.append(
+                f'llm_d_epp_flow_control_outcomes_total{{outcome="{name}"}} {n}'
+            )
+        if self.ttft_count:
+            lines += [
+                "# TYPE llm_d_epp_ttft_seconds_mean gauge",
+                f"llm_d_epp_ttft_seconds_mean {self.ttft_sum / self.ttft_count:.6f}",
+                "# TYPE llm_d_epp_e2e_seconds_mean gauge",
+                f"llm_d_epp_e2e_seconds_mean {self.e2e_sum / self.ttft_count:.6f}",
+            ]
+        return "\n".join(lines) + "\n"
+
+
+class Router:
+    def __init__(
+        self,
+        store: EndpointStore,
+        scheduler: Scheduler,
+        flow_control: FlowControl | None = None,
+        collector: MetricsCollector | None = None,
+        discovery: FileDiscoverySource | None = None,
+        admitters: list[Admitter] | None = None,
+        request_timeout_s: float = 600.0,
+        max_schedule_attempts: int = 2,
+    ) -> None:
+        self.store = store
+        self.scheduler = scheduler
+        self.flow = flow_control or FlowControl()
+        self.collector = collector
+        self.discovery = discovery
+        self.admitters = admitters or []
+        self.metrics = RouterMetrics()
+        self.request_timeout_s = request_timeout_s
+        self.max_schedule_attempts = max_schedule_attempts
+        self._session: aiohttp.ClientSession | None = None
+
+    # ------------------------------------------------------------------ #
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.request_timeout_s, sock_connect=5)
+            )
+        return self._session
+
+    def _pool_stats(self) -> tuple[float, float]:
+        pods = self.store.list()
+        if not pods:
+            return 1.0, float("inf")  # empty pool counts as saturated
+        kv = sum(p.attr(KV_CACHE_USAGE) for p in pods) / len(pods)
+        q = sum(p.attr(WAITING_QUEUE_SIZE) for p in pods) / len(pods)
+        return kv, q
+
+    # ------------------------------------------------------------------ #
+    # HTTP handlers
+
+    async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        self.metrics.requests_total += 1
+        raw = await request.read()
+        try:
+            req = openai_parse(request.path, dict(request.headers), raw)
+        except ParseError as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "invalid_request_error"}},
+                status=400,
+            )
+        for adm in self.admitters:
+            reason = adm.admit(req)
+            if reason is not None:
+                return web.json_response(
+                    {"error": {"message": reason, "type": "rejected"}},
+                    status=429,
+                    headers={HDR_DROP_REASON: reason},
+                )
+        outcome = await self.flow.enqueue_and_wait(req, nbytes=len(raw))
+        if outcome is not Outcome.DISPATCHED:
+            status, reason = OUTCOME_HTTP[outcome]
+            return web.json_response(
+                {"error": {"message": reason, "type": "flow-control"}},
+                status=status,
+                headers={HDR_DROP_REASON: reason, "retry-after": "1"},
+            )
+        try:
+            return await self._route_and_proxy(request, req, raw)
+        finally:
+            self.flow.release()
+
+    async def _route_and_proxy(
+        self, request: web.Request, req: LLMRequest, raw: bytes
+    ) -> web.StreamResponse:
+        tried: set[str] = set()
+        for attempt in range(self.max_schedule_attempts):
+            self.metrics.scheduling_attempts += 1
+            pods = [p for p in self.store.list() if p.address not in tried]
+            try:
+                result = self.scheduler.schedule(req, pods)
+            except NoEndpointsError as e:
+                self.metrics.scheduling_errors += 1
+                return web.json_response(
+                    {"error": {"message": str(e), "type": "no-endpoints"}},
+                    status=503,
+                    headers={HDR_DROP_REASON: "no-endpoints"},
+                )
+            pod = result.primary
+            tried.add(pod.address)
+            extra_headers = {}
+            prefill_pod = result.prefill
+            if prefill_pod is not None:
+                extra_headers[HDR_PREFILLER] = prefill_pod.address
+                # Prefill load rides for the duration of the proxied request
+                # (its prefill phase happens within it); released below.
+                prefill_pod.inflight_tokens += req.approx_prompt_tokens
+            try:
+                return await self._proxy(request, req, raw, pod, extra_headers)
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError):
+                self.metrics.proxy_errors += 1
+                pod.healthy = False
+                log.warning("proxy to %s failed (attempt %d)", pod.address, attempt + 1)
+                continue
+            finally:
+                if prefill_pod is not None:
+                    prefill_pod.inflight_tokens = max(
+                        0, prefill_pod.inflight_tokens - req.approx_prompt_tokens
+                    )
+        return web.json_response(
+            {"error": {"message": "all endpoints failed", "type": "proxy-error"}},
+            status=502,
+        )
+
+    async def _proxy(
+        self,
+        request: web.Request,
+        req: LLMRequest,
+        raw: bytes,
+        pod: Endpoint,
+        extra_headers: dict[str, str],
+    ) -> web.StreamResponse:
+        session = await self._client()
+        headers = {
+            k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS
+        }
+        headers["x-request-id"] = req.request_id
+        headers.update(extra_headers)
+        pod.inflight += 1
+        pod.inflight_tokens += req.approx_prompt_tokens
+        t0 = time.monotonic()
+        first_byte: float | None = None
+        try:
+            async with session.request(
+                request.method, pod.url + request.path_qs, data=raw, headers=headers
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        resp.headers[k] = v
+                resp.headers["x-llm-d-endpoint"] = pod.address
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    if first_byte is None:
+                        first_byte = time.monotonic()
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        finally:
+            pod.inflight = max(0, pod.inflight - 1)
+            pod.inflight_tokens = max(
+                0, pod.inflight_tokens - req.approx_prompt_tokens
+            )
+            now = time.monotonic()
+            if first_byte is not None:
+                self.metrics.ttft_count += 1
+                self.metrics.ttft_sum += first_byte - t0
+                self.metrics.e2e_sum += now - t0
+                # per-endpoint latency attrs for latency-aware scoring
+                pod.attrs["LastTTFT"] = first_byte - t0
+                pod.attrs["LastE2E"] = now - t0
+            self.scheduler.notify_complete(req, pod)
+
+    async def handle_passthrough(self, request: web.Request) -> web.StreamResponse:
+        """Non-generate paths (/v1/models, ...) go to any healthy endpoint."""
+        pods = [p for p in self.store.list() if p.healthy]
+        if not pods:
+            return web.json_response(
+                {"error": {"message": "no endpoints", "type": "no-endpoints"}},
+                status=503,
+            )
+        session = await self._client()
+        raw = await request.read()
+        headers = {
+            k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS
+        }
+        try:
+            async with session.request(
+                request.method, pods[0].url + request.path_qs, data=raw, headers=headers
+            ) as upstream:
+                body = await upstream.read()
+                resp = web.Response(status=upstream.status, body=body)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        resp.headers[k] = v
+                return resp
+        except (aiohttp.ClientConnectionError, asyncio.TimeoutError):
+            return web.json_response(
+                {"error": {"message": "upstream unreachable", "type": "proxy-error"}},
+                status=502,
+            )
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "endpoints": len(self.store.list())}
+        )
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.metrics.render(self.store, self.flow),
+            content_type="text/plain",
+        )
+
+    async def handle_endpoints(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "endpoints": [
+                    {
+                        "address": p.address,
+                        "labels": p.labels,
+                        "healthy": p.healthy,
+                        "inflight": p.inflight,
+                        "attrs": {k: v for k, v in p.attrs.items()},
+                    }
+                    for p in self.store.list()
+                ]
+            }
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        routes = [
+            web.get("/healthz", self.handle_health),
+            web.get("/metrics", self.handle_metrics),
+            web.get("/endpoints", self.handle_endpoints),
+        ]
+        for path in sorted(GENERATE_PATHS):
+            routes.append(web.post(path, self.handle_generate))
+        routes.append(web.route("*", "/{tail:.*}", self.handle_passthrough))
+        app.add_routes(routes)
+
+        async def _lifecycle(app: web.Application):
+            # Endpoint removal must purge scorer state (prefix index entries
+            # for a recycled host:port would fake cache affinity on a cold pod).
+            self.store.on_remove(self.scheduler.notify_endpoint_removed)
+            if self.discovery is not None:
+                try:
+                    self.discovery.load_once()
+                except FileNotFoundError:
+                    log.warning("endpoints file missing at startup")
+                self.discovery.start()
+            if self.collector is not None:
+                await self.collector.scrape_once()
+                self.collector.start()
+            if self.flow.saturation.pool_stats is None:
+                self.flow.saturation.pool_stats = self._pool_stats
+            self.flow.start()
+            yield
+            await self.flow.drain()
+            if self.collector is not None:
+                await self.collector.stop()
+            if self.discovery is not None:
+                self.discovery.stop()
+            if self._session is not None:
+                await self._session.close()
+
+        app.cleanup_ctx.append(_lifecycle)
+        return app

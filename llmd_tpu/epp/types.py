@@ -1,0 +1,126 @@
+"""Core EPP datatypes: endpoints, parsed requests, scheduling results.
+
+Attribute names follow the reference's standardized data-layer attributes
+(docs/architecture/core/router/epp/datalayer.md:49-91 — e.g.
+KVCacheUsagePercent, WaitingQueueSize) and the `x-llm-d-*` request header
+contract (docs/api-reference/epp-http-headers.md:10-44).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+# Standard attribute keys (datalayer core-metrics-extractor output).
+KV_CACHE_USAGE = "KVCacheUsagePercent"
+WAITING_QUEUE_SIZE = "WaitingQueueSize"
+RUNNING_REQUESTS = "RunningRequests"
+PREFIX_HIT_RATIO = "PrefixCacheHitRatio"
+BLOCK_SIZE = "BlockSize"
+NUM_BLOCKS = "NumBlocks"
+TOKENS_IN_FLIGHT = "TokensInFlight"
+
+# Pod role labels (reference disaggregation/README.md:95-99).
+ROLE_LABEL = "llm-d.ai/role"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_BOTH = "prefill-decode"
+
+# Request headers (reference docs/api-reference/epp-http-headers.md:10-25).
+HDR_OBJECTIVE = "x-llm-d-objective"
+HDR_FAIRNESS_ID = "x-llm-d-fairness-id"
+HDR_TTFT_SLO = "x-llm-d-slo-ttft-ms"
+HDR_TPOT_SLO = "x-llm-d-slo-tpot-ms"
+HDR_PREFILLER = "x-prefiller-host-port"
+HDR_DROP_REASON = "x-llm-d-request-dropped-reason"
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """One model-server endpoint (pod:port in the reference)."""
+
+    address: str  # "host:port"
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    model: str | None = None
+    # Data-layer attributes, refreshed by collectors (metrics poll, KV index).
+    attrs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    last_seen: float = dataclasses.field(default_factory=time.monotonic)
+    healthy: bool = True
+    # Requests routed here that have not yet completed (EPP-side view,
+    # fresher than the polled metrics — the inflight-load-producer).
+    inflight: int = 0
+    # Tokens routed here recently (token-load scoring).
+    inflight_tokens: int = 0
+
+    @property
+    def role(self) -> str:
+        return self.labels.get(ROLE_LABEL, ROLE_BOTH)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address}"
+
+    def attr(self, key: str, default: float = 0.0) -> float:
+        v = self.attrs.get(key)
+        return default if v is None else float(v)
+
+
+@dataclasses.dataclass
+class LLMRequest:
+    """A parsed inference request flowing through the EPP pipeline."""
+
+    request_id: str
+    model: str = ""
+    prompt_text: str = ""
+    prompt_token_ids: list[int] | None = None
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    body: dict[str, Any] = dataclasses.field(default_factory=dict)
+    path: str = "/v1/completions"
+    streaming: bool = False
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    # flow-control key parts
+    priority: int = 0
+    fairness_id: str = ""
+    # SLO objectives (ms) if provided
+    ttft_slo_ms: float | None = None
+    tpot_slo_ms: float | None = None
+    # predicted output length (latency predictor / heuristics)
+    predicted_output_tokens: int | None = None
+    # Scratch space for DataProducers (prefix hashes, predictions, ...).
+    scratch: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_prompt_chars(self) -> int:
+        return len(self.prompt_text)
+
+    @property
+    def approx_prompt_tokens(self) -> int:
+        if self.prompt_token_ids is not None:
+            return len(self.prompt_token_ids)
+        # Char-ratio approximation (reference
+        # prefix-cache-aware-routing.md:18-21): ~4 chars/token.
+        return max(1, len(self.prompt_text) // 4)
+
+
+@dataclasses.dataclass
+class ProfileResult:
+    """Outcome of one scheduling profile run."""
+
+    profile: str
+    endpoint: Endpoint | None
+    scores: dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SchedulingResult:
+    """The destination(s) picked for a request.
+
+    ``primary`` receives the request; ``prefill`` (if set) is advertised via
+    the x-prefiller-host-port header for the P/D sidecar two-phase flow
+    (reference disaggregation/README.md:57-99).
+    """
+
+    primary: Endpoint
+    prefill: Endpoint | None = None
+    profiles: dict[str, ProfileResult] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,162 @@
+"""E2E: client → Router (EPP pipeline) → two live engine servers.
+
+The SURVEY.md §7 step-2 milestone: full request path with load/prefix-aware
+routing over real HTTP, on the CPU mesh. Mirrors the reference's CPU-overlay
+composition test strategy (SURVEY.md §4.5).
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config
+from llmd_tpu.engine import LLMEngine
+from llmd_tpu.epp.config import DEFAULT_CONFIG, build_flow_control, build_scheduler
+from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+from llmd_tpu.epp.server import Router
+from llmd_tpu.epp.types import Endpoint
+from llmd_tpu.serve.api import build_app
+from llmd_tpu.serve.async_engine import AsyncEngine
+from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def make_engine_app():
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+    )
+    return build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 128)
+
+
+@pytest.fixture
+async def stack():
+    """Two engine servers + a router wired to them."""
+    servers = []
+    for _ in range(2):
+        s = TestServer(make_engine_app())
+        await s.start_server()
+        servers.append(s)
+
+    store = EndpointStore()
+    for s in servers:
+        store.upsert(Endpoint(address=f"{s.host}:{s.port}", labels={"llm-d.ai/engine-type": "llmd"}))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(DEFAULT_CONFIG),
+        flow_control=build_flow_control(DEFAULT_CONFIG),
+        collector=MetricsCollector(store, interval_s=0.2),
+    )
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    yield rc, router, servers
+    await rc.close()
+    for s in servers:
+        await s.close()
+
+
+async def test_routed_completion(stack):
+    rc, router, _ = stack
+    r = await rc.post(
+        "/v1/completions",
+        json={"prompt": "routing test", "max_tokens": 4, "temperature": 0.0},
+    )
+    assert r.status == 200
+    data = await r.json()
+    assert data["choices"][0]["text"] is not None
+    assert "x-llm-d-endpoint" in r.headers
+
+
+async def test_prefix_affinity_e2e(stack):
+    rc, router, _ = stack
+    prompt = "a shared conversation prefix " * 40
+    first = await rc.post(
+        "/v1/completions", json={"prompt": prompt, "max_tokens": 2, "temperature": 0.0}
+    )
+    ep1 = first.headers["x-llm-d-endpoint"]
+    for _ in range(3):
+        r = await rc.post(
+            "/v1/completions",
+            json={"prompt": prompt, "max_tokens": 2, "temperature": 0.0},
+        )
+        assert r.headers["x-llm-d-endpoint"] == ep1, "prefix affinity broken"
+
+
+async def test_streaming_through_router(stack):
+    rc, _, _ = stack
+    r = await rc.post(
+        "/v1/completions",
+        json={"prompt": "stream me", "max_tokens": 4, "temperature": 0.0, "stream": True},
+    )
+    assert r.status == 200
+    saw_done = False
+    async for line in r.content:
+        if line.strip() == b"data: [DONE]":
+            saw_done = True
+    assert saw_done
+
+
+async def test_metrics_scrape_updates_attrs(stack):
+    rc, router, _ = stack
+    await router.collector.scrape_once()
+    pods = router.store.list()
+    from llmd_tpu.epp.types import NUM_BLOCKS
+
+    assert all("KVCacheUsagePercent" in p.attrs for p in pods)
+    assert pods[0].attr(NUM_BLOCKS) == 128
+
+
+async def test_router_metrics_endpoint(stack):
+    rc, _, _ = stack
+    await rc.post(
+        "/v1/completions", json={"prompt": "m", "max_tokens": 2, "temperature": 0.0}
+    )
+    r = await rc.get("/metrics")
+    text = await r.text()
+    assert "llm_d_epp_ready_endpoints 2" in text
+    assert "llm_d_epp_requests_total" in text
+
+
+async def test_passthrough_models(stack):
+    rc, _, _ = stack
+    r = await rc.get("/v1/models")
+    assert r.status == 200
+    data = await r.json()
+    assert data["data"][0]["id"] == "tiny"
+
+
+async def test_endpoint_failure_reroutes(stack):
+    rc, router, servers = stack
+    # Kill one engine; router should mark it unhealthy and route to the other.
+    dead = f"{servers[0].host}:{servers[0].port}"
+    await servers[0].close()
+    ok = 0
+    for i in range(4):
+        r = await rc.post(
+            "/v1/completions",
+            json={"prompt": f"failover {i}", "max_tokens": 2, "temperature": 0.0},
+        )
+        if r.status == 200:
+            ok += 1
+            assert r.headers["x-llm-d-endpoint"] != dead
+    assert ok >= 3, "router failed to route around a dead endpoint"
+
+
+async def test_flow_control_rejects_on_capacity(stack):
+    rc, router, _ = stack
+    router.flow.max_total_requests = 0  # force capacity rejection
+    r = await rc.post(
+        "/v1/completions", json={"prompt": "x", "max_tokens": 2, "temperature": 0.0}
+    )
+    assert r.status == 429
+    assert r.headers.get("x-llm-d-request-dropped-reason") == "queue-full"
+    router.flow.max_total_requests = 4096

@@ -1,0 +1,283 @@
+"""EPP pipeline unit tests: scorers, filters, pickers, profiles, flow control.
+
+Covers the reference scheduler semantics (scheduling.md:44-118) and
+flow-control dispatch tiers (flow-control.md:197-254) without HTTP.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from llmd_tpu.epp.config import DEFAULT_CONFIG, PD_CONFIG, build_scheduler
+from llmd_tpu.epp.flow_control import (
+    BandConfig,
+    FlowControl,
+    Outcome,
+    SaturationDetector,
+)
+from llmd_tpu.epp.plugins import SchedulingProfile, create_plugin
+from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex
+from llmd_tpu.epp.types import (
+    KV_CACHE_USAGE,
+    ROLE_LABEL,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def mk_pods(n=3, **attrs):
+    return [Endpoint(address=f"10.0.0.{i}:8000", attrs=dict(attrs)) for i in range(n)]
+
+
+def mk_req(prompt="hello world " * 50, **kw):
+    return LLMRequest(request_id="r1", prompt_text=prompt, **kw)
+
+
+def test_queue_scorer_prefers_empty_queue():
+    pods = mk_pods(3)
+    pods[0].attrs[WAITING_QUEUE_SIZE] = 10
+    pods[1].attrs[WAITING_QUEUE_SIZE] = 0
+    pods[2].attrs[WAITING_QUEUE_SIZE] = 5
+    s = create_plugin("queue-scorer")
+    scores = s.score(mk_req(), pods)
+    assert scores[pods[1].address] == 1.0
+    assert scores[pods[0].address] == 0.0
+
+
+def test_kv_scorer():
+    pods = mk_pods(2)
+    pods[0].attrs[KV_CACHE_USAGE] = 0.9
+    pods[1].attrs[KV_CACHE_USAGE] = 0.1
+    s = create_plugin("kv-cache-utilization-scorer")
+    scores = s.score(mk_req(), pods)
+    assert scores[pods[1].address] > scores[pods[0].address]
+
+
+def test_role_filters():
+    pods = mk_pods(3)
+    pods[0].labels[ROLE_LABEL] = "prefill"
+    pods[1].labels[ROLE_LABEL] = "decode"
+    # pods[2] defaults to prefill-decode
+    prefill = create_plugin("prefill-filter").filter(mk_req(), pods)
+    decode = create_plugin("decode-filter").filter(mk_req(), pods)
+    assert {p.address for p in prefill} == {pods[0].address, pods[2].address}
+    assert {p.address for p in decode} == {pods[1].address, pods[2].address}
+
+
+def test_prefix_index_longest_consecutive():
+    idx = ApproxPrefixIndex(block_chars=4)
+    h = idx.hashes("aaaabbbbcccc")
+    idx.record_routed(h[:2], "podA")  # A holds blocks 0-1
+    idx.record_routed(h, "podB")  # B holds all 3
+    matches = idx.match_lengths(h)
+    assert matches["podA"] == 2
+    assert matches["podB"] == 3
+    # different text shares no blocks
+    assert idx.match_lengths(idx.hashes("zzzzyyyyxxxx")) == {}
+
+
+def test_prefix_scorer_affinity_via_profile():
+    sched = build_scheduler(DEFAULT_CONFIG)
+    pods = mk_pods(3)
+    prompt = "the quick brown fox " * 100
+    r1 = mk_req(prompt)
+    res1 = sched.schedule(r1, pods)
+    # Second identical prompt must land on the same pod (prefix affinity
+    # dominates with weight 3).
+    r2 = mk_req(prompt)
+    res2 = sched.schedule(r2, pods)
+    assert res2.primary.address == res1.primary.address
+
+
+def test_no_hit_lru_spreads_cold_prompts():
+    sched = build_scheduler(DEFAULT_CONFIG)
+    pods = mk_pods(3)
+    seen = set()
+    for i in range(3):
+        res = sched.schedule(mk_req(f"completely different prompt {i} " * 60), pods)
+        seen.add(res.primary.address)
+    assert len(seen) == 3, "cold prompts should spread across the pool"
+
+
+def test_disagg_handler_long_prompt_gets_prefill():
+    sched = build_scheduler(PD_CONFIG)
+    pods = mk_pods(4)
+    pods[0].labels[ROLE_LABEL] = "prefill"
+    pods[1].labels[ROLE_LABEL] = "prefill"
+    pods[2].labels[ROLE_LABEL] = "decode"
+    pods[3].labels[ROLE_LABEL] = "decode"
+    long_req = mk_req("x" * 8192)  # ~2048 approx tokens > 256 threshold
+    res = sched.schedule(long_req, pods)
+    assert res.primary.labels[ROLE_LABEL] == "decode"
+    assert res.prefill is not None
+    assert res.prefill.labels[ROLE_LABEL] == "prefill"
+    short_req = mk_req("short")
+    res = sched.schedule(short_req, pods)
+    assert res.prefill is None, "short prompts stay decode-only"
+
+
+def test_disagg_decider_skips_prefill_when_cached():
+    sched = build_scheduler(PD_CONFIG)
+    pods = mk_pods(2)
+    pods[0].labels[ROLE_LABEL] = "prefill"
+    pods[1].labels[ROLE_LABEL] = "decode"
+    prompt = "y" * 8192
+    first = sched.schedule(mk_req(prompt), pods)
+    assert first.prefill is not None, "cold long prompt should disaggregate"
+    # Same prompt again: its prefix is now indexed on the decode pod, so the
+    # decider must keep it decode-only (disaggregation/README.md:57-99).
+    again = sched.schedule(mk_req(prompt), pods)
+    assert again.primary.address == first.primary.address
+    assert again.prefill is None, "cached prompt must not be disaggregated"
+
+
+def test_responses_structured_input_parsing():
+    from llmd_tpu.epp.handler import openai_parse
+
+    body = json.dumps(
+        {"input": [{"role": "user", "content": "k" * 800}], "model": "m"}
+    ).encode()
+    req = openai_parse("/v1/responses", {}, body)
+    assert req.approx_prompt_tokens > 100, "structured input must count"
+
+
+def test_scheduler_empty_pool_raises():
+    from llmd_tpu.epp.scheduler import NoEndpointsError
+
+    sched = build_scheduler(DEFAULT_CONFIG)
+    with pytest.raises(NoEndpointsError):
+        sched.schedule(mk_req(), [])
+
+
+def test_weighted_random_picker_distribution():
+    picker = create_plugin("weighted-random-picker", seed=0)
+    pods = mk_pods(2)
+    scored = {pods[0].address: 0.9, pods[1].address: 0.1}
+    wins = sum(
+        1 for _ in range(200) if picker.pick(mk_req(), scored, pods) is pods[0]
+    )
+    assert wins > 140  # ~180 expected
+
+
+# --------------------------------------------------------------------- #
+# flow control
+
+
+async def test_flow_dispatch_and_priority():
+    fc = FlowControl(
+        bands=[BandConfig(priority=0), BandConfig(priority=10)],
+        saturation=SaturationDetector(max_inflight=1),
+    )
+    fc.start()
+    order = []
+
+    async def run(req):
+        out = await fc.enqueue_and_wait(req)
+        order.append(req.request_id)
+        return out
+
+    # Occupy the single slot.
+    first = asyncio.create_task(run(LLMRequest(request_id="warm", priority=0)))
+    await asyncio.sleep(0.05)
+    # Two queued: low priority first-in, high priority second-in.
+    low = asyncio.create_task(run(LLMRequest(request_id="low", priority=0)))
+    high = asyncio.create_task(run(LLMRequest(request_id="high", priority=10)))
+    await asyncio.sleep(0.05)
+    fc.release()  # free the slot -> dispatcher must pick HIGH first
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.gather(first, low, high)
+    assert order[0] == "warm"
+    assert order[1] == "high", f"priority band order violated: {order}"
+    await fc.drain()
+
+
+async def test_flow_capacity_rejection():
+    fc = FlowControl(
+        bands=[BandConfig(priority=0, max_requests=1)],
+        saturation=SaturationDetector(max_inflight=0),  # nothing dispatches
+    )
+    fc.start()
+    t1 = asyncio.create_task(fc.enqueue_and_wait(LLMRequest(request_id="a")))
+    await asyncio.sleep(0.02)
+    out = await fc.enqueue_and_wait(LLMRequest(request_id="b"))
+    assert out is Outcome.REJECTED_CAPACITY
+    await fc.drain()
+    assert await t1 is Outcome.EVICTED_SHUTDOWN
+
+
+async def test_flow_ttl_eviction():
+    fc = FlowControl(
+        bands=[BandConfig(priority=0, ttl_s=0.05)],
+        saturation=SaturationDetector(max_inflight=0),
+    )
+    fc.start()
+    out = await fc.enqueue_and_wait(LLMRequest(request_id="x"))
+    assert out is Outcome.EVICTED_TTL
+    await fc.drain()
+
+
+async def test_flow_unconfigured_priority_keeps_rank():
+    # priority 10 has no configured band but must still beat priority 0.
+    fc = FlowControl(saturation=SaturationDetector(max_inflight=1))
+    fc.start()
+    order = []
+
+    async def run(req):
+        await fc.enqueue_and_wait(req)
+        order.append(req.request_id)
+
+    warm = asyncio.create_task(run(LLMRequest(request_id="warm")))
+    await asyncio.sleep(0.05)
+    low = asyncio.create_task(run(LLMRequest(request_id="low", priority=0)))
+    high = asyncio.create_task(run(LLMRequest(request_id="high", priority=10)))
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.gather(warm, low, high)
+    assert order[1] == "high", order
+    await fc.drain()
+
+
+async def test_flow_disabled_passthrough():
+    fc = FlowControl(enabled=False, saturation=SaturationDetector(max_inflight=0))
+    out = await fc.enqueue_and_wait(LLMRequest(request_id="x"))
+    assert out is Outcome.DISPATCHED
+    fc.release()
+    assert fc.saturation.inflight == 0
+
+
+async def test_flow_round_robin_fairness():
+    fc = FlowControl(saturation=SaturationDetector(max_inflight=1))
+    fc.start()
+    order = []
+
+    async def run(rid, fid):
+        await fc.enqueue_and_wait(LLMRequest(request_id=rid, fairness_id=fid))
+        order.append(rid)
+
+    warm = asyncio.create_task(run("warm", "z"))
+    await asyncio.sleep(0.05)
+    tasks = [
+        asyncio.create_task(run("a1", "tenant-a")),
+        asyncio.create_task(run("a2", "tenant-a")),
+        asyncio.create_task(run("b1", "tenant-b")),
+    ]
+    await asyncio.sleep(0.05)
+    for _ in range(3):
+        fc.release()
+        await asyncio.sleep(0.05)
+    await asyncio.gather(warm, *tasks)
+    # round-robin: tenant-b's request must not go last
+    assert order.index("b1") < order.index("a2"), order
+    await fc.drain()
