@@ -131,8 +131,16 @@ class EngineConfig:
     # KV transfer role for P/D disaggregation: None | kv_producer | kv_consumer
     # | kv_both (reference tpu patch-decode.yaml:17-20 TPUConnector roles).
     kv_role: str | None = None
+    # Address advertised to consumers in kv_transfer_params (the pod IP in a
+    # cluster deployment). The reference's side-channel and transfer ports
+    # (TPU_SIDE_CHANNEL_PORT=9600 / TPU_KV_TRANSFER_PORT=9100) are folded
+    # into ONE port here; kv_side_channel_port is kept as an accepted alias
+    # for deployment-manifest compatibility but is not separately bound.
+    kv_host: str = "127.0.0.1"
     kv_side_channel_port: int = 9600
     kv_transfer_port: int = 9100
+    kv_lease_ms: int = 30_000  # operations-vllm.md:155-160
+    kv_load_failure_policy: str = "recompute"  # "recompute" | "fail"
     # ZMQ pub endpoint for KV events (BlockStored/...); None disables.
     kv_events_endpoint: str | None = None
 

@@ -71,6 +71,26 @@ class LLMEngine:
         )
         self._counter = itertools.count()
 
+        # P/D disaggregation: optional KV-transfer connector (reference
+        # TPUConnector roles, pd tpu patch-decode.yaml:17-20).
+        self.kv_connector = None
+        if config.kv_role:
+            from llmd_tpu.kvtransfer.connector import KVTransferConfig, TPUConnector
+
+            kv_cfg = KVTransferConfig(
+                role=config.kv_role,
+                host=config.kv_host,
+                port=config.kv_transfer_port,
+                lease_ms=config.kv_lease_ms,
+                load_failure_policy=config.kv_load_failure_policy,
+            )
+            self.kv_connector = TPUConnector(kv_cfg, self.runner, self.allocator)
+            self.scheduler.finish_hook = self._on_finish
+
+    def _on_finish(self, req) -> None:
+        if self.kv_connector is not None and self.kv_connector.wants_export(req):
+            req.export_params = self.kv_connector.export_finished(req)
+
     # ------------------------------------------------------------------ #
 
     def add_request(
@@ -98,6 +118,22 @@ class LLMEngine:
                 f"{sched.max_num_batched_tokens} and chunked prefill is disabled"
             )
         rid = request_id or f"req-{next(self._counter)}-{uuid.uuid4().hex[:8]}"
+        # P/D consumer: pull remote KV and seed the local prefix cache before
+        # the request is ever scheduled, so prefill becomes a cache hit. The
+        # async serving layer pre-fetches off-thread and hands the bundle in
+        # via "__pulled__"; the sync path fetches inline.
+        if self.kv_connector is not None and self.kv_connector.wants_import(
+            kv_transfer_params
+        ):
+            kv_transfer_params = dict(kv_transfer_params)
+            if "__pulled__" in kv_transfer_params:
+                bundle = kv_transfer_params.pop("__pulled__")
+            else:
+                bundle = self.kv_connector.fetch_remote_policy(
+                    list(prompt_token_ids), kv_transfer_params
+                )
+            if bundle is not None:
+                self.kv_connector.apply_bundle(list(prompt_token_ids), bundle)
         req = Request(
             request_id=rid,
             prompt_token_ids=list(prompt_token_ids),
@@ -163,6 +199,7 @@ class LLMEngine:
                     num_prompt_tokens=req.num_prompt_tokens - req.num_prior_output_tokens,
                     num_output_tokens=req.total_output_tokens,
                     num_cached_tokens=req.num_cached_tokens,
+                    kv_transfer_params=req.export_params,
                 )
             )
         self.stats.requests_finished += finished

@@ -129,6 +129,9 @@ class PageAllocator:
             pages.append(pid)
         return pages
 
+    def has_cached(self, content_hash: bytes) -> bool:
+        return content_hash in self._cached
+
     def touch(self, page_ids: Iterable[int]) -> None:
         """Take a reference on cached pages (prefix-cache hit path)."""
         for pid in page_ids:

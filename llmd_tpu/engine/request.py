@@ -79,6 +79,9 @@ class Request:
     finish_time: float | None = None
     # Per-step sampled logprob of each output token (if requested).
     output_logprobs: list[float] = dataclasses.field(default_factory=list)
+    # KV-transfer params produced at finish by a kv_producer engine
+    # (set by the connector's finish hook; echoed in RequestOutput).
+    export_params: dict[str, Any] | None = None
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -54,6 +54,18 @@ def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
     return buckets[-1]
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_kv(kv_cache: jax.Array, page_ids: jax.Array, vals: jax.Array) -> jax.Array:
+    """Write page bundles into the pool (consumer leg of a KV transfer)."""
+    return kv_cache.at[:, page_ids].set(vals)
+
+
+@jax.jit
+def _gather_kv(kv_cache: jax.Array, page_ids: jax.Array) -> jax.Array:
+    """Read page bundles from the pool (producer leg of a KV transfer)."""
+    return kv_cache[:, page_ids]
+
+
 @dataclass
 class StepResult:
     """Sampled tokens for each row; [B, K] (K=1 for single-shot calls)."""
@@ -231,6 +243,44 @@ class ModelRunner:
         tokens = arr[:n, :K].astype(np.int32)
         logprobs = arr[:n, K:].astype(np.float32)
         return StepResult(tokens, logprobs)
+
+    # ------------------------------------------------------------------ #
+    # KV page staging (the HBM<->host leg of the P/D transfer path;
+    # reference TPUConnectorHMA host-memory-assisted pattern)
+
+    def gather_pages(self, page_ids: list[int]) -> np.ndarray:
+        """Stage pages HBM -> host: returns [L, n, K, page, 2D] ndarray.
+
+        Page count is padded to a bucket (ids repeat the last page) so XLA
+        compiles one gather per bucket, not per transfer size.
+        """
+        n = len(page_ids)
+        bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
+        ids = np.asarray(page_ids, np.int32)
+        if bucket > n:
+            ids = np.concatenate([ids, np.full(bucket - n, ids[-1], np.int32)])
+        out = np.asarray(jax.device_get(_gather_kv(self.kv_cache, jnp.asarray(ids))))
+        return out[:, :n]
+
+    def scatter_pages(self, page_ids: list[int], pages: np.ndarray) -> None:
+        """Stage pages host -> HBM into the given physical page slots.
+
+        Pads the page count up to a bucket by repeating the last (id, value)
+        pair — a duplicate scatter of identical values is idempotent — so
+        XLA compiles one scatter program per bucket, not per transfer size.
+        """
+        n = len(page_ids)
+        if n == 0:
+            return
+        bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
+        ids = np.asarray(page_ids, np.int32)
+        if bucket > n:
+            ids = np.concatenate([ids, np.full(bucket - n, ids[-1], np.int32)])
+            pages = np.concatenate(
+                [pages, np.repeat(pages[:, -1:], bucket - n, axis=1)], axis=1
+            )
+        vals = jnp.asarray(pages, dtype=self.kv_cache.dtype)
+        self.kv_cache = _scatter_kv(self.kv_cache, jnp.asarray(ids), vals)
 
     # ------------------------------------------------------------------ #
 

@@ -77,6 +77,9 @@ class EngineScheduler:
         self.num_preemptions = 0
         # request_id -> committed page hash chain tail + count
         self._chain: dict[str, tuple[bytes, int]] = {}
+        # Called with the finished Request before its pages are released
+        # (P/D producer KV export point).
+        self.finish_hook = None
 
     # ------------------------------------------------------------------ #
     # queue management
@@ -311,6 +314,12 @@ class EngineScheduler:
         return accepted
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
+        # Commit computed full pages before release: the KV is valid, so
+        # future identical prompts (and P/D exports) can reuse it.
+        self._commit_full_pages(req)
+        if self.finish_hook is not None:
+            # P/D producer export runs here, while block_ids are live.
+            self.finish_hook(req)
         self._release(req)
         self.running.remove(req)
         req.finish(reason)

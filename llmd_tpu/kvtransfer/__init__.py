@@ -1,0 +1,21 @@
+"""KV-cache transfer layer: the framework's NIXL equivalent.
+
+Pull-model block shipping with lease + free-notify semantics
+(reference operations-vllm.md:18-47,155-160), implemented as a C++ core
+(llmd_tpu/native/kvship.cpp) with a pure-Python fallback speaking the same
+wire protocol.
+"""
+
+from llmd_tpu.kvtransfer.shipper import (  # noqa: F401
+    DEFAULT_LEASE_MS,
+    PullError,
+    ShipperServer,
+    free_notify,
+    pull,
+    renew,
+    stat,
+)
+from llmd_tpu.kvtransfer.connector import (  # noqa: F401
+    KVTransferConfig,
+    TPUConnector,
+)

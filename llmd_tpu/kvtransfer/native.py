@@ -1,0 +1,105 @@
+"""ctypes loader for the native kvship library, with lazy compilation.
+
+Builds llmd_tpu/native/libkvship.so with g++ on first use if missing (the
+image ships the toolchain; pybind11 is absent so the ABI is plain C).
+Returns None if the toolchain is unavailable — callers fall back to the
+pure-Python shipper, which speaks the identical wire protocol.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pathlib
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_SO = _NATIVE_DIR / "libkvship.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "kvship.cpp"
+    if not src.exists():
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+             "-pthread", "-o", str(_SO), str(src)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.warning("kvship native build failed, using Python fallback: %s", e)
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (
+            _SO.exists()
+            and (_NATIVE_DIR / "kvship.cpp").exists()
+            and _SO.stat().st_mtime < (_NATIVE_DIR / "kvship.cpp").stat().st_mtime
+        )
+        if (not _SO.exists() or stale) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+        except OSError as e:
+            log.warning("kvship load failed, using Python fallback: %s", e)
+            return None
+
+        lib.kvship_server_create.argtypes = [ctypes.c_uint16]
+        lib.kvship_server_create.restype = ctypes.c_void_p
+        lib.kvship_server_port.argtypes = [ctypes.c_void_p]
+        lib.kvship_server_port.restype = ctypes.c_int
+        lib.kvship_server_destroy.argtypes = [ctypes.c_void_p]
+        lib.kvship_server_destroy.restype = None
+        lib.kvship_register.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.kvship_register.restype = ctypes.c_int
+        lib.kvship_unregister.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kvship_unregister.restype = ctypes.c_int
+        lib.kvship_registered_bytes.argtypes = [ctypes.c_void_p]
+        lib.kvship_registered_bytes.restype = ctypes.c_uint64
+        lib.kvship_registered_count.argtypes = [ctypes.c_void_p]
+        lib.kvship_registered_count.restype = ctypes.c_uint64
+        lib.kvship_expired_count.argtypes = [ctypes.c_void_p]
+        lib.kvship_expired_count.restype = ctypes.c_uint64
+        lib.kvship_pull.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kvship_pull.restype = ctypes.c_int
+        lib.kvship_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.kvship_buf_free.restype = None
+        lib.kvship_free_notify.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+        ]
+        lib.kvship_free_notify.restype = ctypes.c_int
+        lib.kvship_renew.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.kvship_renew.restype = ctypes.c_int
+        lib.kvship_stat.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kvship_stat.restype = ctypes.c_int
+        _lib = lib
+        return _lib

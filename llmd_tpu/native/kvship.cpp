@@ -1,0 +1,431 @@
+// kvship: TPU-native KV-cache block shipper (the NIXL-equivalent transfer
+// core of the framework's P/D disaggregation path).
+//
+// Reference semantics being replicated (docs/architecture/advanced/
+// disaggregation/operations-vllm.md:18-47,155-160 in /root/reference):
+//   * pull model: the producer (prefill) registers KV bytes under a key and
+//     parks them; the consumer (decode) pulls them one-sided over the
+//     network whenever it is ready — the producer's engine loop is never
+//     involved in the transfer;
+//   * lease + free-notify: registered buffers carry a lease (default 30s);
+//     the consumer extends it with RENEW heartbeats and releases it with
+//     FREE when the pull landed; a reaper reclaims expired entries so a
+//     crashed consumer cannot leak producer memory.
+//
+// On TPU there is no GPUDirect-RDMA equivalent exposed to user code, so the
+// fast path is: JAX stages KV pages HBM->host (device_get), this library
+// ships host bytes over TCP (same-host loopback, ICI-adjacent DCN, or
+// cross-slice DCN), and JAX re-stages host->HBM (device_put) on the
+// consumer. This is the TPUConnector/TPUConnectorHMA pattern the reference
+// deploys on TPU (pd-disaggregation/modelserver/tpu/* patches,
+// TPU_KV_TRANSFER_PORT=9100 / TPU_SIDE_CHANNEL_PORT=9600); side channel and
+// data channel are folded into one length-prefixed protocol here.
+//
+// Exposed as a plain C ABI consumed from Python via ctypes (no pybind11 in
+// the image).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4B565348;  // "KVSH"
+
+enum Op : uint8_t { OP_PULL = 1, OP_FREE = 2, OP_RENEW = 3, OP_STAT = 4 };
+enum Status : uint8_t { ST_OK = 0, ST_NOT_FOUND = 1, ST_ERR = 2 };
+
+using Clock = std::chrono::steady_clock;
+
+struct Entry {
+  std::vector<uint8_t> data;
+  Clock::time_point deadline;
+};
+
+bool write_all(int fd, const void* buf, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool read_all(int fd, void* buf, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+class Server {
+ public:
+  explicit Server(uint16_t port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(port);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    reaper_thread_ = std::thread([this] { ReaperLoop(); });
+  }
+
+  ~Server() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (reaper_thread_.joinable()) reaper_thread_.join();
+    // Handler threads are detached. Force-shutdown every live connection so
+    // a handler blocked in recv wakes immediately, then wait (no timeout:
+    // post-shutdown the handlers exit promptly, and returning early would
+    // let a live handler dereference a freed Server).
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      for (int cfd : client_fds_) ::shutdown(cfd, SHUT_RDWR);
+    }
+    std::unique_lock<std::mutex> lk(workers_mu_);
+    workers_cv_.wait(lk, [this] { return active_workers_ == 0; });
+  }
+
+  int Register(const std::string& key, const uint8_t* data, uint64_t len,
+               uint64_t lease_ms) {
+    Entry e;
+    e.data.assign(data, data + len);
+    e.deadline = Clock::now() + std::chrono::milliseconds(lease_ms);
+    std::lock_guard<std::mutex> lk(mu_);
+    bytes_ += len;
+    auto it = entries_.find(key);
+    if (it != entries_.end()) bytes_ -= it->second.data.size();
+    entries_[key] = std::move(e);
+    cv_.notify_all();
+    return 0;
+  }
+
+  int Unregister(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = entries_.find(key);
+    if (it == entries_.end()) return 1;
+    bytes_ -= it->second.data.size();
+    entries_.erase(it);
+    return 0;
+  }
+
+  uint64_t RegisteredBytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return bytes_;
+  }
+
+  uint64_t RegisteredCount() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return entries_.size();
+  }
+
+  uint64_t Expired() { return expired_.load(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // Idle-connection bound so a silent peer can't pin a handler forever.
+      timeval tv{60, 0};
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      {
+        std::lock_guard<std::mutex> lk(workers_mu_);
+        ++active_workers_;
+        client_fds_.insert(fd);
+      }
+      std::thread([this, fd] {
+        Handle(fd);
+        std::lock_guard<std::mutex> lk(workers_mu_);
+        client_fds_.erase(fd);
+        ::close(fd);
+        --active_workers_;
+        workers_cv_.notify_all();
+      }).detach();
+    }
+  }
+
+  void Handle(int fd) {
+    for (;;) {
+      uint32_t magic;
+      uint8_t op;
+      uint16_t keylen;
+      if (!read_all(fd, &magic, 4) || magic != kMagic) return;
+      if (!read_all(fd, &op, 1) || !read_all(fd, &keylen, 2)) return;
+      std::string key(keylen, '\0');
+      if (keylen && !read_all(fd, &key[0], keylen)) return;
+      switch (op) {
+        case OP_PULL: {
+          std::vector<uint8_t> data;
+          uint8_t st = ST_NOT_FOUND;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            auto it = entries_.find(key);
+            if (it != entries_.end()) {
+              data = it->second.data;  // copy out so the lock isn't held on send
+              st = ST_OK;
+            }
+          }
+          uint64_t len = data.size();
+          if (!write_all(fd, &st, 1) || !write_all(fd, &len, 8)) return;
+          if (st == ST_OK && len && !write_all(fd, data.data(), len)) return;
+          break;
+        }
+        case OP_FREE: {
+          uint8_t st = Unregister(key) == 0 ? ST_OK : ST_NOT_FOUND;
+          uint64_t len = 0;
+          if (!write_all(fd, &st, 1) || !write_all(fd, &len, 8)) return;
+          break;
+        }
+        case OP_RENEW: {
+          uint64_t lease_ms;
+          if (!read_all(fd, &lease_ms, 8)) return;
+          uint8_t st = ST_NOT_FOUND;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            auto it = entries_.find(key);
+            if (it != entries_.end()) {
+              it->second.deadline =
+                  Clock::now() + std::chrono::milliseconds(lease_ms);
+              st = ST_OK;
+            }
+          }
+          uint64_t len = 0;
+          if (!write_all(fd, &st, 1) || !write_all(fd, &len, 8)) return;
+          break;
+        }
+        case OP_STAT: {
+          uint8_t st = ST_OK;
+          uint64_t len = 16;
+          uint64_t stat[2] = {RegisteredCount(), RegisteredBytes()};
+          if (!write_all(fd, &st, 1) || !write_all(fd, &len, 8) ||
+              !write_all(fd, stat, 16))
+            return;
+          break;
+        }
+        default:
+          return;
+      }
+    }
+  }
+
+  void ReaperLoop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!stopping_.load()) {
+      cv_.wait_for(lk, std::chrono::milliseconds(500));
+      if (stopping_.load()) break;
+      auto now = Clock::now();
+      for (auto it = entries_.begin(); it != entries_.end();) {
+        if (it->second.deadline <= now) {
+          bytes_ -= it->second.data.size();
+          it = entries_.erase(it);
+          expired_.fetch_add(1);
+        } else {
+          ++it;
+        }
+      }
+    }
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::thread reaper_thread_;
+  std::mutex workers_mu_;
+  std::condition_variable workers_cv_;
+  int active_workers_ = 0;
+  std::unordered_set<int> client_fds_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, Entry> entries_;
+  uint64_t bytes_ = 0;
+  std::atomic<uint64_t> expired_{0};
+};
+
+int Connect(const char* host, uint16_t port) {
+  // Resolve via getaddrinfo so k8s service DNS names and IPv6 literals work
+  // (not just dotted-quad IPv4).
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[8];
+  std::snprintf(portbuf, sizeof(portbuf), "%u", port);
+  if (::getaddrinfo(host, portbuf, &hints, &res) != 0 || !res) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    // Bound every client op (connect/send/recv) so a blackholed producer
+    // can never hang the calling engine thread; matches the Python
+    // fallback's 30s.
+    timeval tv{30, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// Issues one op and reads the response header (+payload for PULL/STAT).
+int RoundTrip(const char* host, uint16_t port, uint8_t op, const char* key,
+              uint64_t lease_ms, uint8_t** out, uint64_t* out_len) {
+  int fd = Connect(host, port);
+  if (fd < 0) return -1;
+  uint16_t keylen = static_cast<uint16_t>(std::strlen(key));
+  bool ok = write_all(fd, &kMagic, 4) && write_all(fd, &op, 1) &&
+            write_all(fd, &keylen, 2) && write_all(fd, key, keylen);
+  if (ok && op == OP_RENEW) ok = write_all(fd, &lease_ms, 8);
+  uint8_t st = ST_ERR;
+  uint64_t len = 0;
+  ok = ok && read_all(fd, &st, 1) && read_all(fd, &len, 8);
+  if (ok && len > 0) {
+    uint8_t* buf = static_cast<uint8_t*>(::malloc(len));
+    if (!buf || !read_all(fd, buf, len)) {
+      ::free(buf);
+      ok = false;
+    } else if (out) {
+      *out = buf;
+      if (out_len) *out_len = len;
+    } else {
+      ::free(buf);
+    }
+  } else if (out) {
+    *out = nullptr;
+    if (out_len) *out_len = 0;
+  }
+  ::close(fd);
+  if (!ok) return -1;
+  return st;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kvship_server_create(uint16_t port) {
+  Server* s = new Server(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int kvship_server_port(void* h) { return static_cast<Server*>(h)->port(); }
+
+void kvship_server_destroy(void* h) { delete static_cast<Server*>(h); }
+
+int kvship_register(void* h, const char* key, const uint8_t* data,
+                    uint64_t len, uint64_t lease_ms) {
+  return static_cast<Server*>(h)->Register(key, data, len, lease_ms);
+}
+
+int kvship_unregister(void* h, const char* key) {
+  return static_cast<Server*>(h)->Unregister(key);
+}
+
+uint64_t kvship_registered_bytes(void* h) {
+  return static_cast<Server*>(h)->RegisteredBytes();
+}
+
+uint64_t kvship_registered_count(void* h) {
+  return static_cast<Server*>(h)->RegisteredCount();
+}
+
+uint64_t kvship_expired_count(void* h) {
+  return static_cast<Server*>(h)->Expired();
+}
+
+// Returns: 0 OK (out/out_len set), 1 not found, 2 server error, -1 I/O error.
+int kvship_pull(const char* host, uint16_t port, const char* key,
+                uint8_t** out, uint64_t* out_len) {
+  return RoundTrip(host, port, OP_PULL, key, 0, out, out_len);
+}
+
+void kvship_buf_free(uint8_t* buf) { ::free(buf); }
+
+int kvship_free_notify(const char* host, uint16_t port, const char* key) {
+  return RoundTrip(host, port, OP_FREE, key, 0, nullptr, nullptr);
+}
+
+int kvship_renew(const char* host, uint16_t port, const char* key,
+                 uint64_t lease_ms) {
+  return RoundTrip(host, port, OP_RENEW, key, lease_ms, nullptr, nullptr);
+}
+
+// stat[0]=count stat[1]=bytes
+int kvship_stat(const char* host, uint16_t port, uint64_t* stat2) {
+  uint8_t* buf = nullptr;
+  uint64_t len = 0;
+  int st = RoundTrip(host, port, OP_STAT, "", 0, &buf, &len);
+  if (st == 0 && len == 16) {
+    std::memcpy(stat2, buf, 16);
+  } else if (st == 0) {
+    st = -1;
+  }
+  ::free(buf);
+  return st;
+}
+
+}  // extern "C"

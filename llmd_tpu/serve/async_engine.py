@@ -109,6 +109,20 @@ class AsyncEngine:
         kv_transfer_params: dict[str, Any] | None = None,
     ) -> AsyncIterator[RequestOutput]:
         """Async stream of incremental outputs until the request finishes."""
+        # P/D consumer: run the (potentially slow) remote-KV pull on an
+        # executor so it never blocks the engine step thread or the event
+        # loop; the engine thread only applies the pre-fetched bundle.
+        conn = getattr(self.engine, "kv_connector", None)
+        if conn is not None and conn.wants_import(kv_transfer_params):
+            loop = asyncio.get_running_loop()
+            try:
+                bundle = await loop.run_in_executor(
+                    None, conn.fetch_remote_policy,
+                    list(prompt_token_ids), kv_transfer_params,
+                )
+            except Exception as e:  # KVLoadError under policy='fail'
+                raise EngineError(f"remote KV load failed: {e}") from e
+            kv_transfer_params = {**kv_transfer_params, "__pulled__": bundle}
         q = self.submit(request_id, prompt_token_ids, sampling, priority, kv_transfer_params)
         try:
             while True:
