@@ -79,12 +79,17 @@ class PulledBundle:
     key: str
 
 
-def pack_pages(pages: np.ndarray) -> bytes:
-    """Serialize a [L, n, K, page, 2D] page bundle (raw bytes + header)."""
+def pack_header(pages: np.ndarray) -> bytes:
+    """Bundle header for a [L, n, K, page, 2D] page array."""
     dt = pages.dtype.str.encode()
     L, n, K, page, inner = pages.shape
-    hdr = _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner)
-    return hdr + dt + pages.tobytes()
+    return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
+
+
+def pack_pages(pages: np.ndarray) -> bytes:
+    """Full serialized bundle (tests / small payloads; the production path
+    registers header + raw buffer separately to avoid the concat copy)."""
+    return pack_header(pages) + pages.tobytes()
 
 
 def unpack_pages(blob: bytes) -> np.ndarray:
@@ -153,11 +158,15 @@ class TPUConnector:
         # Server-unique key: never the raw (client-controllable) request id,
         # so colliding x-request-id headers can't cross-wire two exports.
         key = f"{req.request_id}:{uuid.uuid4().hex[:12]}"
-        pages = self.runner.gather_pages(req.block_ids[:n_full])
-        blob = pack_pages(pages)
-        self.server.register(key, blob, self.cfg.lease_ms)
+        # The device_get runs on the engine thread by design: the pages must
+        # be read before the allocator can reuse them. Everything after is a
+        # single memcpy into the server's owning buffer (no Python-side
+        # concat of the payload).
+        pages = np.ascontiguousarray(self.runner.gather_pages(req.block_ids[:n_full]))
+        header = pack_header(pages)
+        self.server.register(key, pages, self.cfg.lease_ms, header=header)
         self.exported_requests += 1
-        self.exported_bytes += len(blob)
+        self.exported_bytes += len(header) + pages.nbytes
         return {
             "remote_host": self.cfg.host,
             "remote_port": self.server.port,
@@ -221,7 +230,9 @@ class TPUConnector:
         """
         try:
             return self.fetch_remote(prompt_token_ids, params)
-        except (PullError, OSError, ValueError, KeyError) as e:
+        except (PullError, OSError, ValueError, KeyError, TypeError, struct.error) as e:
+            # struct.error: truncated header; TypeError: garbage dtype string
+            # -- a corrupt/foreign bundle must hit the policy, not escape.
             self.import_failures += 1
             if self.cfg.load_failure_policy == "fail":
                 raise KVLoadError(str(e)) from e

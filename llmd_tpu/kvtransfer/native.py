@@ -72,6 +72,12 @@ def load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_uint64,
         ]
         lib.kvship_register.restype = ctypes.c_int
+        lib.kvship_register2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.kvship_register2.restype = ctypes.c_int
         lib.kvship_unregister.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.kvship_unregister.restype = ctypes.c_int
         lib.kvship_registered_bytes.argtypes = [ctypes.c_void_p]

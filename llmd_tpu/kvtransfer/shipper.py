@@ -57,14 +57,37 @@ class ShipperServer:
             self.port = self._py.port
             self.backend = "python"
 
-    def register(self, key: str, data: bytes, lease_ms: int = DEFAULT_LEASE_MS) -> None:
+    def register(
+        self,
+        key: str,
+        data,
+        lease_ms: int = DEFAULT_LEASE_MS,
+        header: bytes = b"",
+    ) -> None:
+        """Register a bundle as header+payload.
+
+        ``data`` is bytes or anything exposing a C-contiguous buffer (e.g. a
+        numpy array); the buffer-protocol path hands the raw pointer to the
+        native server, which makes the single owning copy — no Python-side
+        concat or intermediate copy of a multi-hundred-MB KV payload.
+        """
         if self._handle:
-            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-            self._native.kvship_register(
-                self._handle, key.encode(), buf, len(data), lease_ms
+            mv = memoryview(data).cast("B")
+            n = len(mv)
+            if mv.readonly:  # bytes path (tests / small payloads): copy
+                buf = (ctypes.c_uint8 * n).from_buffer_copy(mv)
+            else:  # numpy path: zero-copy view of the array's buffer
+                buf = (ctypes.c_uint8 * n).from_buffer(mv)
+            dptr = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+            hbuf = (ctypes.c_uint8 * max(len(header), 1)).from_buffer_copy(
+                header or b"\0"
+            )
+            hptr = ctypes.cast(hbuf, ctypes.POINTER(ctypes.c_uint8))
+            self._native.kvship_register2(
+                self._handle, key.encode(), hptr, len(header), dptr, n, lease_ms
             )
         else:
-            self._py.register(key, data, lease_ms)
+            self._py.register(key, header + bytes(data), lease_ms)
 
     def unregister(self, key: str) -> bool:
         if self._handle:

@@ -133,10 +133,14 @@ class Server {
     workers_cv_.wait(lk, [this] { return active_workers_ == 0; });
   }
 
-  int Register(const std::string& key, const uint8_t* data, uint64_t len,
-               uint64_t lease_ms) {
+  // Two-part register (header + payload) so callers can hand a raw device
+  // buffer without first concatenating it with its header on the Python side.
+  int Register(const std::string& key, const uint8_t* hdr, uint64_t hdr_len,
+               const uint8_t* data, uint64_t len, uint64_t lease_ms) {
     Entry e;
-    e.data.assign(data, data + len);
+    e.data.reserve(hdr_len + len);
+    e.data.insert(e.data.end(), hdr, hdr + hdr_len);
+    e.data.insert(e.data.end(), data, data + len);
     e.deadline = Clock::now() + std::chrono::milliseconds(lease_ms);
     std::lock_guard<std::mutex> lk(mu_);
     bytes_ += len;
@@ -378,7 +382,14 @@ void kvship_server_destroy(void* h) { delete static_cast<Server*>(h); }
 
 int kvship_register(void* h, const char* key, const uint8_t* data,
                     uint64_t len, uint64_t lease_ms) {
-  return static_cast<Server*>(h)->Register(key, data, len, lease_ms);
+  return static_cast<Server*>(h)->Register(key, nullptr, 0, data, len, lease_ms);
+}
+
+int kvship_register2(void* h, const char* key, const uint8_t* hdr,
+                     uint64_t hdr_len, const uint8_t* data, uint64_t len,
+                     uint64_t lease_ms) {
+  return static_cast<Server*>(h)->Register(key, hdr, hdr_len, data, len,
+                                           lease_ms);
 }
 
 int kvship_unregister(void* h, const char* key) {

@@ -1,0 +1,8 @@
+"""Routing sidecar: per-decode-pod proxy orchestrating the P/D two-phase flow.
+
+Re-implements the reference's llm-d-router-disagg-sidecar behavior
+(docs/architecture/advanced/disaggregation/README.md:104-131) for the
+TPU-native stack.
+"""
+
+from llmd_tpu.sidecar.proxy import SidecarConfig, build_sidecar_app  # noqa: F401

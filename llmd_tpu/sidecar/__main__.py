@@ -1,0 +1,45 @@
+"""CLI: python -m llmd_tpu.sidecar --port 8000 --vllm-port 8200 ...
+
+Flag names mirror the reference sidecar's
+(guides/recipes/modelserver/base/single-host/pd/vllm/patch-sidecar.yaml:9-16;
+wide-ep-lws/modelserver/gpu/vllm/base/decode.yaml:29-39).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from llmd_tpu.sidecar.proxy import SidecarConfig, run_sidecar
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("llmd-tpu routing sidecar")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--vllm-port", type=int, default=8200)
+    ap.add_argument("--data-parallel-size", type=int, default=1)
+    ap.add_argument(
+        "--kv-connector", default="tpu",
+        help="transfer protocol family (tpu = kvship pull model)",
+    )
+    ap.add_argument("--prefill-timeout", type=float, default=600.0)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    cfg = SidecarConfig(
+        port=args.port,
+        vllm_port=args.vllm_port,
+        data_parallel_size=args.data_parallel_size,
+        connector=args.kv_connector,
+        prefill_timeout_s=args.prefill_timeout,
+    )
+    asyncio.run(run_sidecar(cfg))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,237 @@
+"""The P/D routing sidecar proxy.
+
+Reference behavior (disaggregation/README.md:104-131; deployment shape
+guides/recipes/modelserver/base/single-host/pd/vllm/patch-sidecar.yaml):
+an init-container proxy on the decode pod's serving port. For each generate
+request carrying the ``x-prefiller-host-port`` header it runs the two-phase
+protocol:
+
+  1. send the request to the prefiller with ``max_tokens=1``, stream off and
+     ``kv_transfer_params: {"do_remote_decode": true}`` (the vLLM `nixlv2`
+     protocol shape, README.md:33-46);
+  2. capture ``kv_transfer_params`` from the prefill response and inject
+     them into the original request;
+  3. forward to the local engine; the consumer connector pulls the KV.
+
+A prefill server error falls back to decoder-only execution on the local
+engine (README.md:113-118). While the decode request is queued, the sidecar
+heartbeats the producer lease (renew at 2/3 lease, operations-vllm.md:
+155-160) so slow admission can't expire the transfer.
+
+DP-awareness (wide-ep decode.yaml:29-39): with ``--data-parallel-size=N``
+the sidecar listens on ``[port, port+N)`` and forwards rank ``i`` to local
+engine port ``vllm_port + i``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.epp.types import HDR_PREFILLER
+from llmd_tpu.kvtransfer import shipper as shipper_mod
+
+log = logging.getLogger(__name__)
+
+GENERATE_PATHS = {"/v1/completions", "/v1/chat/completions"}
+
+HOP_HEADERS = {
+    "connection", "keep-alive", "transfer-encoding", "te", "upgrade",
+    "proxy-authorization", "proxy-authenticate", "host", "content-length",
+}
+
+
+@dataclasses.dataclass
+class SidecarConfig:
+    port: int = 8000  # first listen port
+    vllm_port: int = 8200  # first local engine port
+    data_parallel_size: int = 1
+    connector: str = "tpu"  # transfer protocol family (tpu kvship)
+    prefill_timeout_s: float = 600.0
+    # lease renewal cadence; 2/3 of the reference's 30s default lease
+    heartbeat_s: float = 10.0
+
+
+def _fwd_headers(headers) -> dict[str, str]:
+    return {
+        k: v for k, v in headers.items()
+        if k.lower() not in HOP_HEADERS and k.lower() != HDR_PREFILLER
+    }
+
+
+class _LeaseHeartbeat:
+    """Renews the producer-side lease until the decode request lands."""
+
+    def __init__(self, params: dict, cadence_s: float) -> None:
+        self.params = params
+        self.cadence_s = cadence_s
+        self._task: asyncio.Task | None = None
+
+    async def _run(self) -> None:
+        host = self.params.get("remote_host")
+        port = int(self.params.get("remote_port", 0))
+        key = self.params.get("remote_key", "")
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.cadence_s)
+            ok = await loop.run_in_executor(
+                None, shipper_mod.renew, host, port, key
+            )
+            if not ok:
+                return  # entry gone (pulled+freed, or producer restarted)
+
+    def start(self) -> None:
+        if self.params.get("remote_host"):
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
+    """One sidecar app instance (one per DP rank listen port)."""
+
+    local_base = f"http://127.0.0.1:{cfg.vllm_port + rank}"
+
+    async def on_startup(app: web.Application) -> None:
+        app["session"] = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30)
+        )
+
+    async def on_cleanup(app: web.Application) -> None:
+        await app["session"].close()
+
+    async def handle(request: web.Request) -> web.StreamResponse:
+        session: aiohttp.ClientSession = request.app["session"]
+        prefiller = request.headers.get(HDR_PREFILLER)
+        if (
+            request.method == "POST"
+            and request.path in GENERATE_PATHS
+            and prefiller
+        ):
+            return await two_phase(request, session, prefiller)
+        return await passthrough(request, session)
+
+    async def passthrough(
+        request: web.Request, session: aiohttp.ClientSession
+    ) -> web.StreamResponse:
+        body = await request.read()
+        async with session.request(
+            request.method,
+            local_base + request.path_qs,
+            headers=_fwd_headers(request.headers),
+            data=body if body else None,
+        ) as upstream:
+            return await _relay(request, upstream)
+
+    async def two_phase(
+        request: web.Request, session: aiohttp.ClientSession, prefiller: str
+    ) -> web.StreamResponse:
+        try:
+            body = json.loads(await request.read())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response(
+                {"error": {"message": "invalid JSON body", "type": "invalid_request_error"}},
+                status=400,
+            )
+
+        params = await run_prefill(session, prefiller, request.path, body)
+        heartbeat = _LeaseHeartbeat(params or {}, cfg.heartbeat_s)
+        if params is not None:
+            body = dict(body)
+            body["kv_transfer_params"] = params
+            heartbeat.start()
+        try:
+            async with session.post(
+                local_base + request.path,
+                headers=_fwd_headers(request.headers),
+                json=body,
+            ) as upstream:
+                heartbeat.stop()  # decode accepted; consumer owns the pull
+                return await _relay(request, upstream)
+        finally:
+            heartbeat.stop()
+
+    async def run_prefill(
+        session: aiohttp.ClientSession, prefiller: str, path: str, body: dict
+    ) -> dict | None:
+        """Phase 1. Returns kv_transfer_params, or None => decoder-only."""
+        pre_body = dict(body)
+        pre_body["max_tokens"] = 1
+        pre_body.pop("max_completion_tokens", None)
+        pre_body["stream"] = False
+        pre_body["kv_transfer_params"] = {"do_remote_decode": True}
+        url = f"http://{prefiller}{path}"
+        try:
+            async with session.post(
+                url, json=pre_body,
+                timeout=aiohttp.ClientTimeout(total=cfg.prefill_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    text = await resp.text()
+                    log.warning(
+                        "prefill at %s failed (%d): %.200s -- decoder-only fallback",
+                        prefiller, resp.status, text,
+                    )
+                    return None
+                payload = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            log.warning(
+                "prefill at %s unreachable (%s) -- decoder-only fallback",
+                prefiller, e,
+            )
+            return None
+        params = payload.get("kv_transfer_params")
+        if not params:
+            log.warning(
+                "prefill at %s returned no kv_transfer_params -- decoder-only",
+                prefiller,
+            )
+        return params or None
+
+    async def _relay(
+        request: web.Request, upstream: aiohttp.ClientResponse
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(status=upstream.status)
+        for k, v in upstream.headers.items():
+            if k.lower() not in HOP_HEADERS:
+                resp.headers[k] = v
+        await resp.prepare(request)
+        async for chunk in upstream.content.iter_any():
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    app.router.add_route("*", "/{tail:.*}", handle)
+    return app
+
+
+async def run_sidecar(cfg: SidecarConfig) -> None:
+    """Serve all DP-rank listeners ([port, port+dp_size))."""
+    runners = []
+    for rank in range(cfg.data_parallel_size):
+        app = build_sidecar_app(cfg, rank)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", cfg.port + rank)
+        await site.start()
+        runners.append(runner)
+        log.info(
+            "sidecar rank %d: :%d -> 127.0.0.1:%d",
+            rank, cfg.port + rank, cfg.vllm_port + rank,
+        )
+    try:
+        await asyncio.Event().wait()
+    finally:
+        for r in runners:
+            await r.cleanup()
