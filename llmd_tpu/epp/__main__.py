@@ -21,8 +21,12 @@ def main(argv=None) -> None:
     p.add_argument("--endpoints-file", required=True, help="JSON endpoints file")
     p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
     p.add_argument(
-        "--preset", default="default", choices=["default", "pd"],
+        "--preset", default="default", choices=["default", "pd", "precise"],
         help="built-in config preset when --config is not given",
+    )
+    p.add_argument(
+        "--kv-events-port", type=int, default=5556,
+        help="default engine KV-event port for precise prefix routing",
     )
     p.add_argument("--scrape-interval", type=float, default=1.0)
     args = p.parse_args(argv)
@@ -32,6 +36,7 @@ def main(argv=None) -> None:
     from llmd_tpu.epp.config import (
         DEFAULT_CONFIG,
         PD_CONFIG,
+        PRECISE_CONFIG,
         build_flow_control,
         build_scheduler,
     )
@@ -46,7 +51,9 @@ def main(argv=None) -> None:
         with open(args.config) as f:
             config = json.load(f)
     else:
-        config = DEFAULT_CONFIG if args.preset == "default" else PD_CONFIG
+        config = {
+            "default": DEFAULT_CONFIG, "pd": PD_CONFIG, "precise": PRECISE_CONFIG,
+        }[args.preset]
 
     store = EndpointStore()
     router = Router(
@@ -56,6 +63,11 @@ def main(argv=None) -> None:
         collector=MetricsCollector(store, interval_s=args.scrape_interval),
         discovery=FileDiscoverySource(store, args.endpoints_file),
     )
+    # Wires token-producer + KV-event subscription iff the config declares
+    # a precise-prefix-cache-scorer (no-op otherwise).
+    from llmd_tpu.epp.precise_prefix import attach_precise_routing
+
+    attach_precise_routing(router, default_events_port=args.kv_events_port)
     web.run_app(router.build_app(), host=args.host, port=args.port)
 
 

@@ -34,6 +34,7 @@ from typing import Any
 
 # Importing these modules registers their plugins.
 import llmd_tpu.epp.filters  # noqa: F401
+import llmd_tpu.epp.precise_prefix  # noqa: F401
 import llmd_tpu.epp.scorers  # noqa: F401
 from llmd_tpu.epp.flow_control import BandConfig, FlowControl, SaturationDetector
 from llmd_tpu.epp.plugins import (
@@ -121,6 +122,51 @@ PD_CONFIG: dict[str, Any] = {
     },
     "flowControl": {"enabled": True, "maxInflight": 512},
 }
+
+
+# Precise prefix-cache routing plugin config (reference
+# guides/precise-prefix-cache-routing/router/*.values.yaml): the approximate
+# prefix scorer is replaced by the KV-event-indexed one; requires the
+# token-producer and a KVEventsSource wired to the pool (see
+# llmd_tpu.epp.precise_prefix.attach_precise_routing).
+PRECISE_CONFIG: dict[str, Any] = {
+    "plugins": [
+        {"type": "healthy-filter", "name": "healthy"},
+        {"type": "queue-scorer", "name": "queue"},
+        {"type": "kv-cache-utilization-scorer", "name": "kv"},
+        {"type": "precise-prefix-cache-scorer", "name": "precise-prefix"},
+        {"type": "max-score-picker", "name": "picker"},
+    ],
+    "schedulingProfiles": [
+        {
+            "name": "default",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "queue", "weight": 1.0},
+                {"pluginRef": "kv", "weight": 1.0},
+                {"pluginRef": "precise-prefix", "weight": 3.0},
+                {"pluginRef": "picker"},
+            ],
+        }
+    ],
+    "profileHandler": {"type": "single", "profile": "default"},
+    "flowControl": {"enabled": True, "maxInflight": 512},
+}
+
+
+def find_plugins(scheduler: Scheduler, cls: type) -> list[Any]:
+    """All plugin instances of a type across profiles (deduplicated)."""
+    seen: dict[int, Any] = {}
+    for profile in scheduler.profiles.values():
+        for f in profile.filters:
+            if isinstance(f, cls):
+                seen[id(f)] = f
+        for s, _ in profile.scorers:
+            if isinstance(s, cls):
+                seen[id(s)] = s
+        if isinstance(profile.picker, cls):
+            seen[id(profile.picker)] = profile.picker
+    return list(seen.values())
 
 
 def build_scheduler(config: dict[str, Any]) -> Scheduler:

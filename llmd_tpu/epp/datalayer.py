@@ -93,14 +93,20 @@ class EndpointStore:
     def __init__(self) -> None:
         self._pods: dict[str, Endpoint] = {}
         self._on_remove: list[Callable[[str], None]] = []
+        self._on_add: list[Callable[[Endpoint], None]] = []
 
     def on_remove(self, cb: Callable[[str], None]) -> None:
         self._on_remove.append(cb)
+
+    def on_add(self, cb: Callable[[Endpoint], None]) -> None:
+        self._on_add.append(cb)
 
     def upsert(self, ep: Endpoint) -> Endpoint:
         existing = self._pods.get(ep.address)
         if existing is None:
             self._pods[ep.address] = ep
+            for cb in self._on_add:
+                cb(ep)
             return ep
         existing.labels = ep.labels or existing.labels
         existing.model = ep.model or existing.model

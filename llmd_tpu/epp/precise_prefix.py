@@ -1,0 +1,213 @@
+"""Precise prefix-cache routing: token producer + event-indexed scorer.
+
+Reference pipeline (kv-indexer.md:104-135; SURVEY.md §3.5): on each request
+the `token-producer` tokenizes the prompt via an engine render endpoint
+(/tokenize here, matching vLLM's /v1/completions/render role), computes the
+chained block hashes — the SAME chain the engines commit pages under
+(llmd_tpu.engine.kv_cache.hash_page) — and the `precise-prefix-cache-scorer`
+scores endpoints by the KV-event index's weighted longest-consecutive-prefix
+(gpu=1.0 / cpu=0.8 tiers). After a pick, speculative entries with a 2s TTL
+co-route identical-prompt bursts (kv-indexer.md:137-143).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+
+import aiohttp
+
+from llmd_tpu.engine.kv_cache import page_hashes_for_tokens
+from llmd_tpu.epp.plugins import Scorer, register
+from llmd_tpu.epp.types import BLOCK_SIZE, Endpoint, LLMRequest
+from llmd_tpu.events.index import KVBlockIndex
+from llmd_tpu.events.subscriber import KVEventSubscriber
+
+log = logging.getLogger(__name__)
+
+# Pod label carrying the ZMQ event endpoint port (pod-discovery mode,
+# reference precise-prefix-cache-routing.values.yaml socketPort: 5556).
+KV_EVENTS_PORT_LABEL = "llm-d.ai/kv-events-port"
+DEFAULT_EVENTS_PORT = 5556
+
+SCRATCH_BLOCK_HASHES = "block_hashes"
+
+
+class TokenProducer:
+    """Async data producer: prompt text -> token ids -> block hashes.
+
+    Calls an engine's /tokenize endpoint (any healthy pod — the shared
+    render-service pattern, kv-indexer.md:104-113) with a small LRU so
+    bursts of identical prompts tokenize once.
+    """
+
+    def __init__(
+        self,
+        default_page_size: int = 16,
+        max_prefix_tokens: int = 262144,  # agentic ceiling (predicted-latency.values.yaml:24-33)
+        cache_size: int = 512,
+    ) -> None:
+        self.default_page_size = default_page_size
+        self.max_prefix_tokens = max_prefix_tokens
+        self._cache: collections.OrderedDict[tuple, list[str]] = collections.OrderedDict()
+        self.cache_size = cache_size
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            # Tokenization is in the admission hot path: keep the bound tight
+            # so one wedged pod cannot stall scheduling (fall back to
+            # approximate scoring instead).
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=1.0, sock_connect=0.5)
+            )
+        return self._session
+
+    def _page_size(self, pods: list[Endpoint]) -> int:
+        for p in pods:
+            bs = p.attr(BLOCK_SIZE)
+            if bs:
+                return int(bs)
+        return self.default_page_size
+
+    async def produce(self, req: LLMRequest, pods: list[Endpoint]) -> None:
+        if SCRATCH_BLOCK_HASHES in req.scratch or not pods:
+            return
+        page = self._page_size(pods)
+        token_ids = req.prompt_token_ids
+        if token_ids is None:
+            key = (hash(req.prompt_text), page)
+            cached = self._cache.get(key)
+            if cached is not None:
+                self._cache.move_to_end(key)
+                req.scratch[SCRATCH_BLOCK_HASHES] = cached
+                return
+            token_ids = await self._tokenize(req, pods)
+            if token_ids is None:
+                return  # no render endpoint reachable; precise scoring skipped
+        token_ids = token_ids[: self.max_prefix_tokens]
+        hashes = [h.hex() for h in page_hashes_for_tokens(token_ids, page)]
+        req.scratch[SCRATCH_BLOCK_HASHES] = hashes
+        if req.prompt_token_ids is None:
+            self._cache[(hash(req.prompt_text), page)] = hashes
+            while len(self._cache) > self.cache_size:
+                self._cache.popitem(last=False)
+
+    async def _tokenize(
+        self, req: LLMRequest, pods: list[Endpoint]
+    ) -> list[int] | None:
+        session = await self._client()
+        healthy = [p for p in pods if p.healthy] or pods
+        for pod in healthy[:2]:  # try at most two endpoints
+            try:
+                async with session.post(
+                    f"{pod.url}/tokenize",
+                    json={"prompt": req.prompt_text, "model": req.model},
+                ) as resp:
+                    if resp.status != 200:
+                        continue
+                    data = await resp.json()
+                    return list(data.get("tokens", []))
+            except (aiohttp.ClientError, TimeoutError, ValueError) as e:
+                log.debug("tokenize via %s failed: %s", pod.address, e)
+        return None
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+@register("precise-prefix-cache-scorer")
+class PrecisePrefixCacheScorer(Scorer):
+    """Scores endpoints from the KV-event block index.
+
+    Score = weighted longest consecutive prefix / total prompt blocks, so a
+    full hot-tier hit scores 1.0. Also publishes per-pod match fractions to
+    scratch['prefix_match_frac'] for the disagg decider (scheduler.py).
+    """
+
+    def __init__(
+        self,
+        index: KVBlockIndex | None = None,
+        max_blocks_per_pod: int = 131072,
+        speculative_ttl_s: float = 2.0,
+    ) -> None:
+        self.index = index or KVBlockIndex(
+            max_blocks_per_pod=max_blocks_per_pod,
+            speculative_ttl_s=speculative_ttl_s,
+        )
+
+    def score(self, req: LLMRequest, pods: list[Endpoint]) -> dict[str, float]:
+        hashes = req.scratch.get(SCRATCH_BLOCK_HASHES)
+        if not hashes:
+            return {p.address: 0.0 for p in pods}
+        raw = self.index.score(hashes, [p.address for p in pods])
+        n = len(hashes)
+        out = {addr: s / n for addr, s in raw.items()}
+        fracs = req.scratch.setdefault("prefix_match_frac", {})
+        for p in pods:
+            m = self.index.matched_pages(hashes, p.address) / n
+            fracs[p.address] = max(fracs.get(p.address, 0.0), m)
+        return out
+
+    def on_routed(self, req: LLMRequest, pod: Endpoint) -> None:
+        hashes = req.scratch.get(SCRATCH_BLOCK_HASHES)
+        if hashes:
+            self.index.insert_speculative(pod.address, hashes)
+
+    def on_endpoint_removed(self, address: str) -> None:
+        self.index.remove_pod(address)
+
+
+def attach_precise_routing(router, default_events_port: int = DEFAULT_EVENTS_PORT):
+    """Wire token-producer + KV-event subscription onto a built Router.
+
+    Finds the precise scorer instance(s) in the router's scheduler, attaches
+    a TokenProducer to the producer phase and a KVEventsSource to the pool.
+    Returns the KVEventsSource (caller owns close()) or None if the config
+    has no precise scorer.
+    """
+    from llmd_tpu.epp.config import find_plugins
+
+    scorers = find_plugins(router.scheduler, PrecisePrefixCacheScorer)
+    if not scorers:
+        return None
+    router.producers.append(TokenProducer())
+    source = KVEventsSource(
+        router.store, scorers[0].index, default_port=default_events_port
+    )
+    router.closables.append(source)
+    return source
+
+
+class KVEventsSource:
+    """Data-layer source wiring pool membership to the event subscriber.
+
+    The `endpoint-notification-source` of the reference data layer
+    (datalayer.md:49-91) in pod-discovery mode: on pod add, subscribe to its
+    event socket; on remove, drop its index entries.
+    """
+
+    def __init__(
+        self,
+        store,
+        index: KVBlockIndex,
+        default_port: int = DEFAULT_EVENTS_PORT,
+    ) -> None:
+        self.subscriber = KVEventSubscriber(index)
+        self.default_port = default_port
+        store.on_add(self._added)
+        store.on_remove(self.subscriber.remove_pod)
+        for ep in store.list():
+            self._added(ep)
+
+    def _added(self, ep: Endpoint) -> None:
+        endpoint = ep.labels.get("llm-d.ai/kv-events-endpoint")
+        if not endpoint:
+            host = ep.address.rsplit(":", 1)[0]
+            port = ep.labels.get(KV_EVENTS_PORT_LABEL, self.default_port)
+            endpoint = f"tcp://{host}:{port}"
+        self.subscriber.add_pod(ep.address, endpoint)
+
+    def close(self) -> None:
+        self.subscriber.close()

@@ -112,6 +112,7 @@ class Router:
         collector: MetricsCollector | None = None,
         discovery: FileDiscoverySource | None = None,
         admitters: list[Admitter] | None = None,
+        producers: list | None = None,
         request_timeout_s: float = 600.0,
         max_schedule_attempts: int = 2,
     ) -> None:
@@ -121,6 +122,12 @@ class Router:
         self.collector = collector
         self.discovery = discovery
         self.admitters = admitters or []
+        # Async DataProducers (request-handling.md:26-52): run after flow
+        # dispatch, before scheduling (token-producer, latency predictor...).
+        self.producers = producers or []
+        # Attached resources with close()/async close() (KV-event sources,
+        # predictor clients...); closed on app cleanup.
+        self.closables: list = []
         self.metrics = RouterMetrics()
         self.request_timeout_s = request_timeout_s
         self.max_schedule_attempts = max_schedule_attempts
@@ -172,6 +179,11 @@ class Router:
                 status=status,
                 headers={HDR_DROP_REASON: reason, "retry-after": "1"},
             )
+        for producer in self.producers:
+            try:
+                await producer.produce(req, self.store.list())
+            except Exception:
+                log.exception("data producer %s failed", type(producer).__name__)
         try:
             return await self._route_and_proxy(request, req, raw)
         finally:
@@ -362,6 +374,13 @@ class Router:
                 self.discovery.stop()
             if self._session is not None:
                 await self._session.close()
+            for res in self.producers + self.closables:
+                closer = getattr(res, "close", None)
+                if closer is None:
+                    continue
+                out = closer()
+                if asyncio.iscoroutine(out):
+                    await out
 
         app.cleanup_ctx.append(_lifecycle)
         return app

@@ -1,0 +1,13 @@
+"""KV-event plane: engine-side ZMQ publisher + EPP-side subscriber/index.
+
+Re-implements the reference's precise prefix-cache indexing pipeline
+(docs/architecture/advanced/kv-management/kv-indexer.md:59-151): engines
+publish BlockStored/BlockRemoved/AllBlocksCleared; each EPP replica
+subscribes to every pod (pod-discovery, active-active convergent) and
+maintains a chained block-hash -> pods index used by the
+precise-prefix-cache scorer.
+"""
+
+from llmd_tpu.events.index import KVBlockIndex, TIER_WEIGHTS  # noqa: F401
+from llmd_tpu.events.publisher import ZMQEventSink  # noqa: F401
+from llmd_tpu.events.subscriber import KVEventSubscriber  # noqa: F401

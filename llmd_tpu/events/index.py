@@ -1,0 +1,182 @@
+"""KV block index: chained block-hash -> pods, with tiers and speculation.
+
+Reference behavior (kv-indexer.md:91-143):
+  * block key -> set of pods holding it, each with a medium/tier;
+  * two-level in-memory LRU backend: a global hash map plus per-pod LRU
+    ordering with a capacity cap (evict oldest per pod);
+  * longest-consecutive-prefix scoring with tier weights (gpu=1.0, cpu=0.8,
+    kv-indexer.md:133);
+  * speculative indexing: after a routing decision the picked pod is
+    presumed to hold the prompt's blocks for a short TTL (2s,
+    kv-indexer.md:137-143) so bursts of identical prompts co-route before
+    the first BlockStored arrives.
+
+Thread-safety: one lock; subscriber threads write, scheduler reads.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+TIER_WEIGHTS = {"gpu": 1.0, "hbm": 1.0, "cpu": 0.8, "disk": 0.6}
+
+SPECULATIVE_TTL_S = 2.0
+
+
+class KVBlockIndex:
+    def __init__(
+        self,
+        max_blocks_per_pod: int = 131072,
+        speculative_ttl_s: float = SPECULATIVE_TTL_S,
+    ) -> None:
+        self.max_blocks_per_pod = max_blocks_per_pod
+        self.speculative_ttl_s = speculative_ttl_s
+        self._lock = threading.Lock()
+        # hash -> {pod -> tier}
+        self._blocks: dict[str, dict[str, str]] = {}
+        # pod -> LRU of its hashes (right = newest)
+        self._pod_lru: dict[str, collections.OrderedDict] = {}
+        # (pod) -> list of (deadline, hashes) speculative entries
+        self._spec: dict[str, dict[str, float]] = {}
+        self.metrics_events = 0
+        self.metrics_lookups = 0
+        self.metrics_hits = 0
+
+    # ------------------------------------------------------------------ #
+    # event application (subscriber threads)
+
+    def apply(self, pod: str, events: list[dict]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for ev in events:
+                self.metrics_events += 1
+                t = ev.get("type")
+                if t == "BlockStored":
+                    tier = ev.get("medium", "gpu")
+                    for h in ev.get("hashes", []):
+                        self._store_locked(pod, h, tier)
+                elif t == "BlockRemoved":
+                    for h in ev.get("hashes", []):
+                        self._remove_locked(pod, h)
+                elif t == "AllBlocksCleared":
+                    self._clear_pod_locked(pod)
+            # opportunistic speculative-entry expiry
+            spec = self._spec.get(pod)
+            if spec:
+                dead = [h for h, dl in spec.items() if dl <= now]
+                for h in dead:
+                    del spec[h]
+
+    def _store_locked(self, pod: str, h: str, tier: str) -> None:
+        self._blocks.setdefault(h, {})[pod] = tier
+        lru = self._pod_lru.setdefault(pod, collections.OrderedDict())
+        lru[h] = None
+        lru.move_to_end(h)
+        if len(lru) > self.max_blocks_per_pod:
+            old, _ = lru.popitem(last=False)
+            self._drop_locked(pod, old)
+
+    def _remove_locked(self, pod: str, h: str) -> None:
+        lru = self._pod_lru.get(pod)
+        if lru is not None:
+            lru.pop(h, None)
+        self._drop_locked(pod, h)
+
+    def _drop_locked(self, pod: str, h: str) -> None:
+        pods = self._blocks.get(h)
+        if pods is not None:
+            pods.pop(pod, None)
+            if not pods:
+                del self._blocks[h]
+
+    def _clear_pod_locked(self, pod: str) -> None:
+        lru = self._pod_lru.pop(pod, None)
+        if lru:
+            for h in lru:
+                self._drop_locked(pod, h)
+        self._spec.pop(pod, None)
+
+    def remove_pod(self, pod: str) -> None:
+        """Endpoint left the pool: drop everything it held."""
+        with self._lock:
+            self._clear_pod_locked(pod)
+
+    # ------------------------------------------------------------------ #
+    # speculative entries (scheduler thread, after a pick)
+
+    def insert_speculative(self, pod: str, hashes: list[str]) -> None:
+        deadline = time.monotonic() + self.speculative_ttl_s
+        with self._lock:
+            spec = self._spec.setdefault(pod, {})
+            for h in hashes:
+                spec[h] = deadline
+
+    # ------------------------------------------------------------------ #
+    # scoring (scheduler thread)
+
+    def _pod_has_locked(self, pod: str, h: str, now: float) -> str | None:
+        """Tier if the pod holds block h (confirmed or speculative)."""
+        pods = self._blocks.get(h)
+        if pods is not None and pod in pods:
+            return pods[pod]
+        spec = self._spec.get(pod)
+        if spec is not None:
+            dl = spec.get(h)
+            if dl is not None and dl > now:
+                return "gpu"  # speculative entries presume the hot tier
+        return None
+
+    def score(self, hashes: list[str], pods: list[str]) -> dict[str, float]:
+        """Weighted longest-consecutive-prefix per pod (kv-indexer.md:120-135).
+
+        Returns pod -> sum of tier weights over the longest run of leading
+        blocks the pod holds.
+        """
+        now = time.monotonic()
+        out: dict[str, float] = {}
+        with self._lock:
+            self.metrics_lookups += 1
+            hit = False
+            for pod in pods:
+                s = 0.0
+                for h in hashes:
+                    tier = self._pod_has_locked(pod, h, now)
+                    if tier is None:
+                        break
+                    s += TIER_WEIGHTS.get(tier, 0.5)
+                if s > 0.0:
+                    hit = True
+                out[pod] = s
+            if hit:
+                self.metrics_hits += 1
+        return out
+
+    def matched_pages(self, hashes: list[str], pod: str) -> int:
+        """Unweighted longest-consecutive-prefix length for one pod."""
+        now = time.monotonic()
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if self._pod_has_locked(pod, h, now) is None:
+                    break
+                n += 1
+        return n
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "blocks": len(self._blocks),
+                "pods": len(self._pod_lru),
+                "events": self.metrics_events,
+                "lookups": self.metrics_lookups,
+                "hits": self.metrics_hits,
+            }

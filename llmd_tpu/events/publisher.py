@@ -1,0 +1,121 @@
+"""Engine-side KV-event publisher (ZMQ PUB).
+
+Reference contract (kv-indexer.md:59-87): the engine emits `KVEvents` —
+BlockStored / BlockRemoved / AllBlocksCleared — on a ZMQ socket
+(kvEventsConfig socketPort 5556 in precise-prefix-cache-routing.values.yaml).
+Events are batched and sequence-numbered per topic so subscribers can detect
+gaps and resynchronize by dropping their view of the pod (convergence over
+exactness, matching the reference's active-active design, kv-indexer.md:98-101).
+
+Wire format: multipart [topic: utf8, seq: u64-be, payload: JSON]
+payload = {"events": [{"type": "BlockStored", "hashes": [hex...],
+                       "parent": hex|null, "tokens": [...], "medium": "gpu"},
+                      {"type": "BlockRemoved", "hashes": [hex...]},
+                      {"type": "AllBlocksCleared"}]}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import threading
+
+from llmd_tpu.engine.kv_cache import KVEventSink
+
+log = logging.getLogger(__name__)
+
+
+class ZMQEventSink(KVEventSink):
+    """Batched ZMQ publisher implementing the engine's KVEventSink."""
+
+    def __init__(
+        self,
+        endpoint: str = "tcp://*:5556",
+        topic: str = "kv-events",
+        flush_interval_s: float = 0.05,
+        max_batch: int = 256,
+        medium: str = "gpu",
+        pod: str = "",
+    ) -> None:
+        import zmq
+
+        self.topic = topic.encode()
+        self.medium = medium
+        # The pod's advertised serving address; subscribers attribute events
+        # to endpoints by this field (SUB sockets don't expose the sender).
+        self.pod = pod
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        # 0 linger: never block process shutdown on undelivered events.
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if endpoint.endswith(":0"):
+            port = self._sock.bind_to_random_port(endpoint[: endpoint.rfind(":")])
+            self.endpoint = endpoint[: endpoint.rfind(":") + 1] + str(port)
+        else:
+            self._sock.bind(endpoint)
+            self.endpoint = endpoint
+        self._seq = 0
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.max_batch = max_batch
+        self._flusher = threading.Thread(
+            target=self._flush_loop, args=(flush_interval_s,), daemon=True
+        )
+        self._flusher.start()
+
+    # -- KVEventSink interface (called from the engine thread) ---------- #
+
+    def blocks_stored(self, hashes, parent, token_ids) -> None:
+        self._append(
+            {
+                "type": "BlockStored",
+                "hashes": [h.hex() for h in hashes],
+                "parent": parent.hex() if parent else None,
+                "tokens": list(token_ids),
+                "medium": self.medium,
+            }
+        )
+
+    def blocks_removed(self, hashes) -> None:
+        self._append({"type": "BlockRemoved", "hashes": [h.hex() for h in hashes]})
+
+    def all_cleared(self) -> None:
+        self._append({"type": "AllBlocksCleared"})
+
+    # ------------------------------------------------------------------ #
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            self._buf.append(ev)
+            if len(self._buf) >= self.max_batch:
+                self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        if not self._buf:
+            return
+        batch, self._buf = self._buf, []
+        payload = json.dumps({"pod": self.pod, "events": batch}).encode()
+        seq = struct.pack(">Q", self._seq)
+        self._seq += 1
+        try:
+            self._sock.send_multipart([self.topic, seq, payload], copy=False)
+        except Exception as e:  # pragma: no cover - zmq failure is best-effort
+            log.warning("kv-event publish failed: %s", e)
+
+    def _flush_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            with self._lock:
+                self._publish_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._publish_locked()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._flusher.join(timeout=2)
+        with self._lock:
+            self._publish_locked()
+        self._sock.close(0)

@@ -1,0 +1,114 @@
+"""EPP-side KV-event subscriber (ZMQ SUB, pod-discovery mode).
+
+Each EPP replica independently subscribes to every pod's event socket
+(reference kv-indexer.md:59-87, active-active pod-discovery delivery,
+precise-prefix-cache-routing.values.yaml kvEventsConfig.podDiscoveryConfig).
+One SUB socket connects to all publishers; a poller thread applies batches
+to the KVBlockIndex. Per-topic sequence gaps (missed batches under
+slow-joiner or overload) resynchronize by clearing the pod's view — the
+index converges from subsequent BlockStored traffic, trading brief
+under-scoring for correctness (kv-indexer.md:98-101).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import threading
+
+from llmd_tpu.events.index import KVBlockIndex
+
+log = logging.getLogger(__name__)
+
+
+class KVEventSubscriber:
+    def __init__(self, index: KVBlockIndex, topic: str = "kv-events") -> None:
+        import zmq
+
+        self.index = index
+        self._zmq = zmq
+        self._ctx = zmq.Context.instance()
+        self._topic = topic
+        # endpoint zmq-address -> pod address (events attribute to pods)
+        self._pods: dict[str, str] = {}
+        self._seqs: dict[str, int] = {}
+        self._lock = threading.Lock()
+        # ZMQ sockets are NOT thread-safe: connect/disconnect are queued here
+        # and executed by the poller thread, which exclusively owns the
+        # socket (commands drain within one 100ms poll interval).
+        self._cmds: list[tuple[str, str]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def add_pod(self, pod_address: str, event_endpoint: str) -> None:
+        """Subscribe to a discovered pod's event socket."""
+        with self._lock:
+            if event_endpoint in self._pods:
+                return
+            self._pods[event_endpoint] = pod_address
+            self._cmds.append(("connect", event_endpoint))
+        log.info("kv-events: subscribing to %s (%s)", event_endpoint, pod_address)
+
+    def remove_pod(self, pod_address: str) -> None:
+        with self._lock:
+            eps = [ep for ep, pod in self._pods.items() if pod == pod_address]
+            for ep in eps:
+                del self._pods[ep]
+                self._cmds.append(("disconnect", ep))
+        self.index.remove_pod(pod_address)
+
+    # ------------------------------------------------------------------ #
+
+    def _loop(self) -> None:
+        sock = self._ctx.socket(self._zmq.SUB)
+        sock.setsockopt(self._zmq.LINGER, 0)
+        sock.setsockopt_string(self._zmq.SUBSCRIBE, self._topic)
+        poller = self._zmq.Poller()
+        poller.register(sock, self._zmq.POLLIN)
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    cmds, self._cmds = self._cmds, []
+                for op, ep in cmds:
+                    try:
+                        getattr(sock, op)(ep)
+                    except self._zmq.ZMQError as e:
+                        log.warning("kv-events %s %s failed: %s", op, ep, e)
+                try:
+                    if not dict(poller.poll(timeout=100)):
+                        continue
+                    parts = sock.recv_multipart(flags=self._zmq.NOBLOCK)
+                except self._zmq.ZMQError:
+                    continue
+                self._handle(parts)
+        finally:
+            sock.close(0)
+
+    def _handle(self, parts) -> None:
+        if len(parts) != 3:
+            return
+        _topic, seq_raw, payload = parts
+        try:
+            (seq,) = struct.unpack(">Q", seq_raw)
+            batch = json.loads(payload)
+        except (struct.error, json.JSONDecodeError):
+            return
+        # Publishers embed their advertised pod address in the payload
+        # (SUB sockets don't expose the sender).
+        pod = batch.get("pod")
+        if not pod:
+            return
+        last = self._seqs.get(pod)
+        if last is not None and seq != last + 1:
+            log.warning(
+                "kv-events: seq gap for %s (%d -> %d), resyncing", pod, last, seq
+            )
+            self.index.remove_pod(pod)
+        self._seqs[pod] = seq
+        self.index.apply(pod, batch.get("events", []))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)

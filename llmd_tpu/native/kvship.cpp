@@ -143,7 +143,7 @@ class Server {
     e.data.insert(e.data.end(), data, data + len);
     e.deadline = Clock::now() + std::chrono::milliseconds(lease_ms);
     std::lock_guard<std::mutex> lk(mu_);
-    bytes_ += len;
+    bytes_ += hdr_len + len;
     auto it = entries_.find(key);
     if (it != entries_.end()) bytes_ -= it->second.data.size();
     entries_[key] = std::move(e);

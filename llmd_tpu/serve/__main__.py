@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--kv-transfer-config", default=None, help="JSON, vLLM-style")
     p.add_argument("--kv-events-endpoint", default=None, help="ZMQ pub endpoint")
+    p.add_argument(
+        "--advertised-address", default=None,
+        help="host:port this pod is reachable at (pod IP in-cluster); used "
+        "to attribute KV events and kv-transfer params. Defaults to "
+        "host:port, which is wrong when binding 0.0.0.0.",
+    )
     p.add_argument("--skip-warmup", action="store_true")
     return p
 
@@ -88,7 +94,23 @@ def main(argv=None) -> None:
     from llmd_tpu.serve.tokenizer import load_tokenizer
 
     config = make_engine_config(args)
-    engine = LLMEngine(config)
+    advertised = args.advertised_address or f"{args.host}:{args.port}"
+    if advertised.startswith("0.0.0.0"):
+        logging.warning(
+            "advertised address %s binds all interfaces; set "
+            "--advertised-address to the pod IP or KV-event attribution "
+            "and P/D transfers will not resolve", advertised,
+        )
+    config.kv_host = advertised.rsplit(":", 1)[0]
+    event_sink = None
+    if config.kv_events_endpoint:
+        from llmd_tpu.events.publisher import ZMQEventSink
+
+        event_sink = ZMQEventSink(
+            endpoint=config.kv_events_endpoint,
+            pod=advertised,
+        )
+    engine = LLMEngine(config, event_sink=event_sink)
     if not args.skip_warmup:
         n = engine.runner.warmup()
         logging.info("warmup compiled %d programs", n)

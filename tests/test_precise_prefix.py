@@ -1,0 +1,233 @@
+"""Precise prefix-cache routing: index unit tests, ZMQ event plane, and
+router e2e with engine-published KV events (reference kv-indexer.md flow,
+SURVEY.md §3.5)."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config
+from llmd_tpu.engine import LLMEngine
+from llmd_tpu.engine.kv_cache import page_hashes_for_tokens
+from llmd_tpu.epp.config import PRECISE_CONFIG, build_flow_control, build_scheduler
+from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+from llmd_tpu.epp.precise_prefix import attach_precise_routing
+from llmd_tpu.epp.server import Router
+from llmd_tpu.epp.types import Endpoint
+from llmd_tpu.events.index import KVBlockIndex
+from llmd_tpu.events.publisher import ZMQEventSink
+from llmd_tpu.events.subscriber import KVEventSubscriber
+from llmd_tpu.serve.api import build_app
+from llmd_tpu.serve.async_engine import AsyncEngine
+from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+# --------------------------------------------------------------------------- #
+# index unit tests
+
+
+def _ev_stored(hashes, medium="gpu"):
+    return {"type": "BlockStored", "hashes": hashes, "parent": None,
+            "tokens": [], "medium": medium}
+
+
+def test_index_longest_consecutive_prefix():
+    idx = KVBlockIndex()
+    idx.apply("pod-a", [_ev_stored(["h1", "h2", "h3"])])
+    idx.apply("pod-b", [_ev_stored(["h1"])])
+    scores = idx.score(["h1", "h2", "h3", "h4"], ["pod-a", "pod-b", "pod-c"])
+    assert scores == {"pod-a": 3.0, "pod-b": 1.0, "pod-c": 0.0}
+    # consecutive-only: a hole stops the run
+    idx.apply("pod-c", [_ev_stored(["h1", "h3"])])
+    assert idx.score(["h1", "h2", "h3"], ["pod-c"])["pod-c"] == 1.0
+
+
+def test_index_tier_weights():
+    idx = KVBlockIndex()
+    idx.apply("pod-a", [_ev_stored(["h1"], medium="gpu"),
+                        _ev_stored(["h2"], medium="cpu")])
+    # gpu=1.0 + cpu=0.8 (kv-indexer.md:133)
+    assert idx.score(["h1", "h2"], ["pod-a"])["pod-a"] == pytest.approx(1.8)
+
+
+def test_index_remove_and_clear():
+    idx = KVBlockIndex()
+    idx.apply("pod-a", [_ev_stored(["h1", "h2"])])
+    idx.apply("pod-a", [{"type": "BlockRemoved", "hashes": ["h2"]}])
+    assert idx.score(["h1", "h2"], ["pod-a"])["pod-a"] == 1.0
+    idx.apply("pod-a", [{"type": "AllBlocksCleared"}])
+    assert idx.score(["h1"], ["pod-a"])["pod-a"] == 0.0
+    assert idx.size == 0
+
+
+def test_index_speculative_ttl():
+    idx = KVBlockIndex(speculative_ttl_s=0.2)
+    idx.insert_speculative("pod-a", ["h1", "h2"])
+    assert idx.score(["h1", "h2"], ["pod-a"])["pod-a"] == 2.0
+    time.sleep(0.25)
+    assert idx.score(["h1", "h2"], ["pod-a"])["pod-a"] == 0.0
+
+
+def test_index_per_pod_lru_cap():
+    idx = KVBlockIndex(max_blocks_per_pod=3)
+    idx.apply("pod-a", [_ev_stored([f"h{i}" for i in range(5)])])
+    # oldest two evicted
+    assert idx.score(["h0"], ["pod-a"])["pod-a"] == 0.0
+    assert idx.score(["h4"], ["pod-a"])["pod-a"] == 1.0
+
+
+# --------------------------------------------------------------------------- #
+# event plane (ZMQ pub/sub)
+
+
+def test_zmq_event_roundtrip():
+    sink = ZMQEventSink(endpoint="tcp://127.0.0.1:0", pod="pod-x:8000",
+                        flush_interval_s=0.02)
+    idx = KVBlockIndex()
+    sub = KVEventSubscriber(idx)
+    try:
+        sub.add_pod("pod-x:8000", sink.endpoint.replace("*", "127.0.0.1"))
+        time.sleep(0.3)  # SUB subscription propagation
+        sink.blocks_stored([b"\x01\x02", b"\x03\x04"], None, [1, 2, 3, 4])
+        sink.flush()
+        deadline = time.monotonic() + 3.0
+        want = ["0102", "0304"]
+        while time.monotonic() < deadline:
+            if idx.score(want, ["pod-x:8000"])["pod-x:8000"] == 2.0:
+                break
+            time.sleep(0.05)
+        assert idx.score(want, ["pod-x:8000"])["pod-x:8000"] == 2.0
+        # removal flows too
+        sink.blocks_removed([b"\x01\x02"])
+        sink.flush()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if idx.score(["0102"], ["pod-x:8000"])["pod-x:8000"] == 0.0:
+                break
+            time.sleep(0.05)
+        assert idx.score(["0102"], ["pod-x:8000"])["pod-x:8000"] == 0.0
+    finally:
+        sub.close()
+        sink.close()
+
+
+# --------------------------------------------------------------------------- #
+# e2e: engines publish events; router routes precisely
+
+
+def make_engine_with_events():
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+    )
+    sink = ZMQEventSink(endpoint="tcp://127.0.0.1:0", flush_interval_s=0.02)
+    return LLMEngine(cfg, event_sink=sink), sink
+
+
+@pytest.fixture
+async def precise_stack():
+    engines, sinks, servers = [], [], []
+    for _ in range(2):
+        eng, sink = make_engine_with_events()
+        srv = TestServer(build_app(AsyncEngine(eng), ByteTokenizer(), "tiny", 128))
+        await srv.start_server()
+        sink.pod = f"{srv.host}:{srv.port}"
+        engines.append(eng)
+        sinks.append(sink)
+        servers.append(srv)
+
+    store = EndpointStore()
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(PRECISE_CONFIG),
+        flow_control=build_flow_control(PRECISE_CONFIG),
+        collector=MetricsCollector(store, interval_s=0.2),
+    )
+    source = attach_precise_routing(router)
+    assert source is not None
+    for srv, sink in zip(servers, sinks):
+        store.upsert(
+            Endpoint(
+                address=f"{srv.host}:{srv.port}",
+                labels={
+                    "llm-d.ai/engine-type": "llmd",
+                    "llm-d.ai/kv-events-endpoint":
+                        sink.endpoint.replace("*", "127.0.0.1"),
+                },
+            )
+        )
+    await router.collector.scrape_once()  # BLOCK_SIZE attr for the producer
+    await asyncio.sleep(0.3)  # SUB propagation
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    yield rc, router, engines, servers
+    await rc.close()
+    source.close()
+    for producer in router.producers:
+        await producer.close()
+    for s in servers:
+        await s.close()
+    for sink in sinks:
+        sink.close()
+
+
+async def test_precise_routing_e2e(precise_stack):
+    rc, router, engines, servers = precise_stack
+    prompt = "precise routing needs a long shared prefix " * 2
+    r1 = await rc.post(
+        "/v1/completions", json={"prompt": prompt, "max_tokens": 4, "temperature": 0.0}
+    )
+    assert r1.status == 200
+    first = r1.headers["x-llm-d-endpoint"]
+
+    # Wait for the engine's BlockStored events to land in the index.
+    from llmd_tpu.epp.config import find_plugins
+    from llmd_tpu.epp.precise_prefix import PrecisePrefixCacheScorer
+
+    scorer = find_plugins(router.scheduler, PrecisePrefixCacheScorer)[0]
+    ids = ByteTokenizer().encode(prompt)
+    hashes = [h.hex() for h in page_hashes_for_tokens(ids, 4)]
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if scorer.index.score(hashes, [first])[first] > 0:
+            break
+        await asyncio.sleep(0.05)
+    matched = scorer.index.matched_pages(hashes, first)
+    assert matched > 0, "engine KV events never reached the index"
+
+    # Same prompt now routes to the same pod (confirmed index hit, not
+    # just speculation -- we waited past the request).
+    for _ in range(3):
+        r = await rc.post(
+            "/v1/completions",
+            json={"prompt": prompt, "max_tokens": 2, "temperature": 0.0},
+        )
+        assert r.headers["x-llm-d-endpoint"] == first
+    assert scorer.index.stats()["hits"] >= 3
+
+
+async def test_speculative_coroute_burst(precise_stack):
+    rc, router, _, _ = precise_stack
+    prompt = "burst of identical agentic prompts " * 2
+    # Fire concurrently: none has BlockStored yet; speculation must co-route.
+    rs = await asyncio.gather(
+        *[
+            rc.post(
+                "/v1/completions",
+                json={"prompt": prompt, "max_tokens": 2, "temperature": 0.0},
+            )
+            for _ in range(4)
+        ]
+    )
+    eps = {r.headers["x-llm-d-endpoint"] for r in rs}
+    assert len(eps) == 1, f"burst split across {eps}"
