@@ -119,6 +119,22 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass
+class OffloadConfig:
+    """Tiered KV offload (HBM -> host DRAM -> FS).
+
+    The reference's TPU tiering knobs (tiered-prefix-cache/README.md:41-48:
+    25000 CPU chunks ~= 780GB on v7): ``cpu_chunks`` caps the host page
+    cache; ``fs_dir`` enables the filesystem spill tier
+    (kv-offloader.md:120-134 persistence).
+    """
+
+    enabled: bool = True
+    cpu_chunks: int = 25_000
+    fs_dir: str | None = None
+    fs_max_pages: int = 100_000
+
+
+@dataclasses.dataclass
 class EngineConfig:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
@@ -143,6 +159,8 @@ class EngineConfig:
     kv_load_failure_policy: str = "recompute"  # "recompute" | "fail"
     # ZMQ pub endpoint for KV events (BlockStored/...); None disables.
     kv_events_endpoint: str | None = None
+    # Tiered KV offload; None disables.
+    offload: OffloadConfig | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -44,6 +44,11 @@ class EngineStats:
     generation_tokens: int = 0
     requests_finished: int = 0
     preemptions: int = 0
+    # tiered offload (kv-offloader metrics)
+    offload_pages: int = 0
+    offload_fs_pages: int = 0
+    offload_saves: int = 0
+    offload_restores: int = 0
 
 
 class LLMEngine:
@@ -56,6 +61,18 @@ class LLMEngine:
     ) -> None:
         self.config = config
         self.ctx = mesh_ctx or build_mesh(config.parallel)
+        # Tiered offload wraps the event sink (device evictions of host-held
+        # pages downgrade to cpu-tier stores instead of removals).
+        self._host_cache = None
+        if config.offload is not None and config.offload.enabled:
+            from llmd_tpu.kvtransfer.offload import HostKVCache, TieredEventSink
+
+            self._host_cache = HostKVCache(
+                max_pages=config.offload.cpu_chunks,
+                fs_dir=config.offload.fs_dir,
+                fs_max_pages=config.offload.fs_max_pages,
+            )
+            event_sink = TieredEventSink(event_sink or KVEventSink(), self._host_cache)
         self.allocator = PageAllocator(
             num_pages=config.cache.num_blocks,
             page_size=config.cache.page_size,
@@ -70,6 +87,16 @@ class LLMEngine:
             num_pages=config.cache.num_blocks, page_size=config.cache.page_size
         )
         self._counter = itertools.count()
+
+        # Tiered offload pump (save-on-commit / restore-on-prefill).
+        self.offloader = None
+        if self._host_cache is not None:
+            from llmd_tpu.kvtransfer.offload import OffloadConnector
+
+            self.offloader = OffloadConnector(
+                self.runner, self.allocator, self._host_cache
+            )
+            self.allocator.commit_hook = self.offloader.on_commit
 
         # P/D disaggregation: optional KV-transfer connector (reference
         # TPUConnector roles, pd tpu patch-decode.yaml:17-20).
@@ -134,6 +161,10 @@ class LLMEngine:
                 )
             if bundle is not None:
                 self.kv_connector.apply_bundle(list(prompt_token_ids), bundle)
+        # Tiered offload: pull host-cached pages extending the device prefix
+        # run back into HBM before scheduling (restore-on-prefill).
+        if self.offloader is not None:
+            self.offloader.restore_for_prompt(list(prompt_token_ids))
         req = Request(
             request_id=rid,
             prompt_token_ids=list(prompt_token_ids),
@@ -203,6 +234,9 @@ class LLMEngine:
                 )
             )
         self.stats.requests_finished += finished
+        if self.offloader is not None:
+            # One bucketed HBM->host gather for the step's committed pages.
+            self.offloader.flush()
         self._refresh_gauges()
         return outputs
 
@@ -212,6 +246,12 @@ class LLMEngine:
         self.stats.kv_usage = self.allocator.usage()
         self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
         self.stats.preemptions = self.scheduler.num_preemptions
+        if self._host_cache is not None:
+            hs = self._host_cache.stats()
+            self.stats.offload_pages = hs["pages"]
+            self.stats.offload_fs_pages = hs["fs_pages"]
+            self.stats.offload_saves = hs["saves"]
+            self.stats.offload_restores = hs["restores"]
 
     # ------------------------------------------------------------------ #
 

@@ -101,6 +101,8 @@ class PageAllocator:
         self._cached: dict[bytes, int] = {}
         self.metrics_hits = 0
         self.metrics_queries = 0
+        # Called on each newly registered full page (tiered offload pump).
+        self.commit_hook = None
 
     # ------------------------------------------------------------------ #
 
@@ -179,6 +181,8 @@ class PageAllocator:
         self._cached[content_hash] = page_id
         self._meta[page_id].content_hash = content_hash
         self.event_sink.blocks_stored([content_hash], parent, token_ids)
+        if self.commit_hook is not None:
+            self.commit_hook(page_id, content_hash)
         return page_id
 
     def free(self, page_ids: Iterable[int]) -> None:

@@ -98,11 +98,16 @@ class TokenProducer:
     ) -> list[int] | None:
         session = await self._client()
         healthy = [p for p in pods if p.healthy] or pods
+        # Chat requests must tokenize through the chat template (the engine
+        # commits pages under templated ids); /tokenize handles both forms.
+        if "messages" in req.body:
+            payload = {"messages": req.body["messages"], "model": req.model}
+        else:
+            payload = {"prompt": req.prompt_text, "model": req.model}
         for pod in healthy[:2]:  # try at most two endpoints
             try:
                 async with session.post(
-                    f"{pod.url}/tokenize",
-                    json={"prompt": req.prompt_text, "model": req.model},
+                    f"{pod.url}/tokenize", json=payload,
                 ) as resp:
                     if resp.status != 200:
                         continue
@@ -141,13 +146,13 @@ class PrecisePrefixCacheScorer(Scorer):
         hashes = req.scratch.get(SCRATCH_BLOCK_HASHES)
         if not hashes:
             return {p.address: 0.0 for p in pods}
-        raw = self.index.score(hashes, [p.address for p in pods])
+        detailed = self.index.score_detailed(hashes, [p.address for p in pods])
         n = len(hashes)
-        out = {addr: s / n for addr, s in raw.items()}
         fracs = req.scratch.setdefault("prefix_match_frac", {})
-        for p in pods:
-            m = self.index.matched_pages(hashes, p.address) / n
-            fracs[p.address] = max(fracs.get(p.address, 0.0), m)
+        out: dict[str, float] = {}
+        for addr, (s, matched) in detailed.items():
+            out[addr] = s / n
+            fracs[addr] = max(fracs.get(addr, 0.0), matched / n)
         return out
 
     def on_routed(self, req: LLMRequest, pod: Endpoint) -> None:

@@ -107,9 +107,15 @@ class KVBlockIndex:
     # speculative entries (scheduler thread, after a pick)
 
     def insert_speculative(self, pod: str, hashes: list[str]) -> None:
-        deadline = time.monotonic() + self.speculative_ttl_s
+        now = time.monotonic()
+        deadline = now + self.speculative_ttl_s
         with self._lock:
             spec = self._spec.setdefault(pod, {})
+            # Prune here too: pods that never publish events would otherwise
+            # accumulate expired entries forever (apply() never runs for them).
+            dead = [h for h, dl in spec.items() if dl <= now]
+            for h in dead:
+                del spec[h]
             for h in hashes:
                 spec[h] = deadline
 
@@ -129,26 +135,33 @@ class KVBlockIndex:
         return None
 
     def score(self, hashes: list[str], pods: list[str]) -> dict[str, float]:
-        """Weighted longest-consecutive-prefix per pod (kv-indexer.md:120-135).
+        """Weighted longest-consecutive-prefix per pod (kv-indexer.md:120-135)."""
+        return {p: s for p, (s, _) in self.score_detailed(hashes, pods).items()}
 
-        Returns pod -> sum of tier weights over the longest run of leading
-        blocks the pod holds.
+    def score_detailed(
+        self, hashes: list[str], pods: list[str]
+    ) -> dict[str, tuple[float, int]]:
+        """One walk per pod: (weighted score, matched page count).
+
+        Score = sum of tier weights over the longest run of leading blocks
+        the pod holds; count = that run's length.
         """
         now = time.monotonic()
-        out: dict[str, float] = {}
+        out: dict[str, tuple[float, int]] = {}
         with self._lock:
             self.metrics_lookups += 1
             hit = False
             for pod in pods:
-                s = 0.0
+                s, n = 0.0, 0
                 for h in hashes:
                     tier = self._pod_has_locked(pod, h, now)
                     if tier is None:
                         break
                     s += TIER_WEIGHTS.get(tier, 0.5)
-                if s > 0.0:
+                    n += 1
+                if n:
                     hit = True
-                out[pod] = s
+                out[pod] = (s, n)
             if hit:
                 self.metrics_hits += 1
         return out

@@ -1,0 +1,271 @@
+"""Tiered KV offload: HBM -> host-DRAM -> filesystem page cache.
+
+Re-implements the reference's offloading-connector / TPUOffloadConnector
+tiering (docs/architecture/advanced/kv-management/kv-offloader.md:15-21,
+70-134; TPU deployment shape tiered-prefix-cache/modelserver/tpu/base/
+vllm/patch-vllm.yaml:43,56-59 — HBM staging + 25000 CPU chunks):
+
+  * save-on-fill: every page committed to the device prefix cache is also
+    staged HBM -> host (one bucketed gather per engine step) and inserted
+    into a capacity-capped host LRU keyed by the page's chained content
+    hash;
+  * restore-on-prefill: before a request is scheduled, host-cached pages
+    extending the device cache's prefix run are staged host -> HBM and
+    committed, so the ordinary prefix-cache hit path picks them up (the
+    same cache-seeding move the P/D consumer uses);
+  * optional FS tier: host-evicted pages spill to files, reloaded on miss
+    (kv-offloader.md FS-backend persistence across restarts);
+  * tier-honest events: a wrapping KVEventSink downgrades device evictions
+    of host-held pages to BlockStored(medium="cpu") instead of removal, so
+    the precise prefix indexer scores the CPU tier at weight 0.8
+    (kv-indexer.md:133) rather than forgetting the pod.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import pathlib
+import threading
+
+import numpy as np
+
+from llmd_tpu.engine.kv_cache import KVEventSink, page_hashes_for_tokens
+
+log = logging.getLogger(__name__)
+
+
+class HostKVCache:
+    """Host-DRAM page store: content hash -> [L, K, page, 2D] ndarray.
+
+    LRU with a page-count cap (the reference's CPU chunk budget). Evictions
+    spill to the FS tier when configured. Thread-safe (engine thread saves,
+    lookups on engine thread; FS writes on a background thread).
+    """
+
+    def __init__(
+        self,
+        max_pages: int = 25_000,
+        fs_dir: str | None = None,
+        fs_max_pages: int = 100_000,
+    ) -> None:
+        self.max_pages = max_pages
+        self.fs_dir = pathlib.Path(fs_dir) if fs_dir else None
+        self.fs_max_pages = fs_max_pages
+        self._lock = threading.Lock()
+        self._pages: collections.OrderedDict[bytes, np.ndarray] = collections.OrderedDict()
+        self._fs_lru: collections.OrderedDict[bytes, None] = collections.OrderedDict()
+        if self.fs_dir is not None:
+            self.fs_dir.mkdir(parents=True, exist_ok=True)
+            for f in sorted(self.fs_dir.glob("*.npy")):
+                try:
+                    self._fs_lru[bytes.fromhex(f.stem)] = None
+                except ValueError:
+                    continue
+        self.saves = 0
+        self.restores = 0
+        self.fs_spills = 0
+        self.fs_loads = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pages)
+
+    def has(self, h: bytes) -> bool:
+        with self._lock:
+            return h in self._pages or h in self._fs_lru
+
+    def put(self, h: bytes, page: np.ndarray) -> None:
+        with self._lock:
+            if h in self._pages:
+                self._pages.move_to_end(h)
+                return
+            self._pages[h] = page
+            self.saves += 1
+            spill: list[tuple[bytes, np.ndarray]] = []
+            while len(self._pages) > self.max_pages:
+                old_h, old_p = self._pages.popitem(last=False)
+                spill.append((old_h, old_p))
+        for old_h, old_p in spill:
+            self._spill_fs(old_h, old_p)
+
+    def get(self, h: bytes) -> np.ndarray | None:
+        with self._lock:
+            page = self._pages.get(h)
+            if page is not None:
+                self._pages.move_to_end(h)
+                self.restores += 1
+                return page
+        page = self._load_fs(h)
+        if page is not None:
+            self.restores += 1
+        return page
+
+    # ------------------------------------------------------------------ #
+    # FS tier
+
+    def _path(self, h: bytes) -> pathlib.Path:
+        return self.fs_dir / f"{h.hex()}.npy"
+
+    def _spill_fs(self, h: bytes, page: np.ndarray) -> None:
+        if self.fs_dir is None:
+            return
+        try:
+            np.save(self._path(h), page)
+        except OSError as e:
+            log.warning("FS spill failed: %s", e)
+            return
+        with self._lock:
+            self._fs_lru[h] = None
+            self.fs_spills += 1
+            while len(self._fs_lru) > self.fs_max_pages:
+                old, _ = self._fs_lru.popitem(last=False)
+                try:
+                    self._path(old).unlink(missing_ok=True)
+                except OSError:
+                    pass
+
+    def _load_fs(self, h: bytes) -> np.ndarray | None:
+        if self.fs_dir is None:
+            return None
+        with self._lock:
+            if h not in self._fs_lru:
+                return None
+        try:
+            page = np.load(self._path(h))
+        except (OSError, ValueError):
+            with self._lock:
+                self._fs_lru.pop(h, None)
+            return None
+        with self._lock:
+            self.fs_loads += 1
+        return page
+
+    def drop(self, h: bytes) -> None:
+        with self._lock:
+            self._pages.pop(h, None)
+            had_fs = self._fs_lru.pop(h, None) is not None
+        if had_fs:
+            try:
+                self._path(h).unlink(missing_ok=True)
+            except OSError:
+                pass
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "pages": len(self._pages),
+                "fs_pages": len(self._fs_lru),
+                "saves": self.saves,
+                "restores": self.restores,
+                "fs_spills": self.fs_spills,
+                "fs_loads": self.fs_loads,
+            }
+
+
+class TieredEventSink(KVEventSink):
+    """Wraps the engine's event sink with tier-honest semantics.
+
+    Device eviction of a page the host tier still holds becomes
+    BlockStored(medium="cpu") — the pod can still serve it (at host-load
+    cost) so the indexer should score it at the cpu weight, not forget it.
+    """
+
+    def __init__(self, inner: KVEventSink, host: HostKVCache) -> None:
+        self.inner = inner
+        self.host = host
+
+    def blocks_stored(self, hashes, parent, token_ids) -> None:
+        self.inner.blocks_stored(hashes, parent, token_ids)
+
+    def blocks_removed(self, hashes) -> None:
+        gone: list = []
+        kept: list = []
+        for h in hashes:
+            (kept if self.host.has(h) else gone).append(h)
+        if gone:
+            self.inner.blocks_removed(gone)
+        if kept and hasattr(self.inner, "medium"):
+            prev, self.inner.medium = self.inner.medium, "cpu"
+            try:
+                self.inner.blocks_stored(kept, None, [])
+            finally:
+                self.inner.medium = prev
+        elif kept:
+            self.inner.blocks_stored(kept, None, [])
+
+    def all_cleared(self) -> None:
+        # Device cleared; host tier survives. Without per-block diffs the
+        # honest summary is: pod still (partially) holds content. Clear only
+        # if the host tier is empty.
+        if len(self.host) == 0:
+            self.inner.all_cleared()
+
+
+class OffloadConnector:
+    """Engine-side tiering pump: save committed pages, restore on prefill."""
+
+    def __init__(
+        self,
+        runner,
+        allocator,
+        host: HostKVCache,
+    ) -> None:
+        self.runner = runner
+        self.allocator = allocator
+        self.host = host
+        # (content_hash, page_id) committed this step, pending offload.
+        self._pending: list[tuple[bytes, int]] = []
+
+    # -- save path (engine thread) -------------------------------------- #
+
+    def on_commit(self, page_id: int, content_hash: bytes) -> None:
+        if not self.host.has(content_hash):
+            self._pending.append((content_hash, page_id))
+
+    def flush(self) -> None:
+        """One bucketed gather for all pages committed this step."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        pages = self.runner.gather_pages([pid for _, pid in pending])
+        for i, (h, _) in enumerate(pending):
+            self.host.put(h, np.ascontiguousarray(pages[:, i]))
+
+    # -- restore path (engine thread, before scheduling) ----------------- #
+
+    def restore_for_prompt(self, prompt_token_ids: list[int]) -> int:
+        """Seed the device prefix cache from the host tier.
+
+        Finds the longest run of leading full pages where device misses are
+        host hits, restores exactly the missing ones, commits them, and
+        releases the refs (cache-seeding). Returns pages restored.
+        """
+        page = self.allocator.page_size
+        hashes = page_hashes_for_tokens(prompt_token_ids, page)
+        if not hashes:
+            return 0
+        restore: list[tuple[int, bytes, np.ndarray]] = []  # (idx, hash, data)
+        for idx, h in enumerate(hashes):
+            if self.allocator.has_cached(h):
+                continue
+            data = self.host.get(h)
+            if data is None:
+                break  # chain broken: nothing past this point is usable
+            restore.append((idx, h, data))
+        if not restore:
+            return 0
+        from llmd_tpu.engine.kv_cache import NoFreePagesError
+
+        try:
+            page_ids = self.allocator.allocate(len(restore))
+        except NoFreePagesError:
+            return 0  # under pressure: recompute instead of thrashing
+        stacked = np.stack([d for _, _, d in restore], axis=1)
+        self.runner.scatter_pages(page_ids, stacked)
+        for pid, (idx, h, _) in zip(page_ids, restore):
+            chunk = prompt_token_ids[idx * page : (idx + 1) * page]
+            parent = hashes[idx - 1] if idx > 0 else None
+            self.allocator.commit_page(pid, h, chunk, parent)
+        self.allocator.free(page_ids)
+        return len(page_ids)

@@ -17,6 +17,7 @@ def make_engine_config(args):
     from llmd_tpu.config import (
         CacheConfig,
         EngineConfig,
+        OffloadConfig,
         ParallelConfig,
         SchedulerConfig,
     )
@@ -48,6 +49,14 @@ def make_engine_config(args):
         kv_side_channel_port=int(kv_cfg.get("side_channel_port", 9600)),
         kv_transfer_port=int(kv_cfg.get("transfer_port", 9100)),
         kv_events_endpoint=args.kv_events_endpoint,
+        offload=(
+            OffloadConfig(
+                cpu_chunks=args.kv_offload_chunks,
+                fs_dir=args.kv_offload_fs_dir,
+            )
+            if args.kv_offload_chunks
+            else None
+        ),
     )
 
 
@@ -78,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
         "to attribute KV events and kv-transfer params. Defaults to "
         "host:port, which is wrong when binding 0.0.0.0.",
     )
+    p.add_argument(
+        "--kv-offload-chunks", type=int, default=0,
+        help="host-DRAM KV page budget (0 disables tiered offload; the "
+        "reference TPU recipe uses 25000, tiered-prefix-cache/README.md:41-48)",
+    )
+    p.add_argument("--kv-offload-fs-dir", default=None, help="FS spill tier dir")
     p.add_argument("--skip-warmup", action="store_true")
     return p
 

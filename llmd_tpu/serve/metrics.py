@@ -21,11 +21,15 @@ def render_metrics(stats: EngineStats, model_name: str) -> str:
         "gpu_cache_usage_perc": round(stats.kv_usage, 6),
         "prefix_cache_hit_rate": round(stats.prefix_hit_ratio, 6),
     }
+    gauges["kv_offload_cpu_pages"] = stats.offload_pages
+    gauges["kv_offload_fs_pages"] = stats.offload_fs_pages
     counters = {
         "prompt_tokens_total": stats.prompt_tokens,
         "generation_tokens_total": stats.generation_tokens,
         "request_success_total": stats.requests_finished,
         "num_preemptions_total": stats.preemptions,
+        "kv_offload_saves_total": stats.offload_saves,
+        "kv_offload_restores_total": stats.offload_restores,
     }
     lines: list[str] = []
     for family in ("vllm", "llmd"):
