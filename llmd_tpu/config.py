@@ -112,6 +112,13 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     expert_parallel_size: int = 1  # folded over the same devices as tp*dp
+    # MoE execution path: "dense" = one-hot combine, XLA all-gathers expert
+    # shards (deepep_high_throughput analogue, good for prefill); "ep" =
+    # shard_map all-to-all dispatch/combine (deepep_low_latency analogue).
+    moe_backend: str = "dense"
+    # EP dispatch capacity factor (send slots per destination shard relative
+    # to a uniform split; tokens past capacity are dropped from the combine).
+    ep_capacity_factor: float = 2.0
 
     @property
     def world_size(self) -> int:

@@ -120,12 +120,19 @@ class ModelRunner:
     def _build_forward(self):
         cfg = self.cfg
         world = self.ctx.world
+        mesh = self.ctx.mesh
+        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        ep_capacity = self.config.parallel.ep_capacity_factor
 
         @functools.partial(
             jax.jit, donate_argnums=(1,), static_argnames=("all_greedy",)
         )
         def fwd(params, kv_cache, inp: StepInput, s: SamplingInputs, all_greedy=False):
-            hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg, world)
+            hidden, kv_cache = llama.forward_hidden(
+                params, kv_cache, inp, cfg, world,
+                mesh=mesh, moe_backend=moe_backend,
+                ep_capacity_factor=ep_capacity,
+            )
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
             h_last = hidden[jnp.arange(B), last]
@@ -142,6 +149,9 @@ class ModelRunner:
     def _build_multi(self):
         cfg = self.cfg
         world = self.ctx.world
+        mesh = self.ctx.mesh
+        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        ep_capacity = self.config.parallel.ep_capacity_factor
 
         @functools.partial(
             jax.jit, donate_argnums=(1,), static_argnames=("k_steps", "all_greedy")
@@ -172,7 +182,11 @@ class ModelRunner:
                     kv_lens=jnp.where(active, pos + 1, 0).astype(jnp.int32),
                     page_table=page_table,
                 )
-                hidden, kv_cache = llama.forward_hidden(params, kv_cache, inp, cfg, world)
+                hidden, kv_cache = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity,
+                )
                 logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
                 s = SamplingInputs(
                     temperature=temperature,

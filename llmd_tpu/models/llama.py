@@ -87,8 +87,14 @@ def forward_hidden(
     inp: StepInput,
     cfg: ModelConfig,
     world_size: int = 1,
+    mesh=None,
+    moe_backend: str = "dense",
+    ep_capacity_factor: float = 2.0,
 ) -> tuple[jax.Array, jax.Array]:
-    """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache)."""
+    """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache).
+
+    ``moe_backend="ep"`` routes MoE layers through the shard_map all-to-all
+    dispatch/combine (wide-EP; requires ``mesh``)."""
     B, Q = inp.token_ids.shape
     D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     x = params["embed"][inp.token_ids]  # [B, Q, H]
@@ -115,7 +121,14 @@ def forward_hidden(
         x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
-            out = moe_block(h2, lp, cfg)
+            if moe_backend == "ep":
+                from llmd_tpu.parallel.moe_ep import moe_block_ep
+
+                out = moe_block_ep(
+                    h2, lp, cfg, mesh, capacity_factor=ep_capacity_factor
+                )
+            else:
+                out = moe_block(h2, lp, cfg)
         else:
             out = _mlp(h2, lp)
         return x + out, cache

@@ -1,0 +1,158 @@
+"""Wide expert parallelism: shard_map dispatch/combine over ICI.
+
+The TPU-native replacement for the reference's DeepEP/NVSHMEM all-to-all
+kernels (docs/architecture/foundations/wide-expert-parallelism.md:20-30;
+`--all2all-backend deepep_low_latency|deepep_high_throughput`, wide-ep-lws
+decode.yaml:127): experts are sharded over the flattened (dp, tp) mesh axes,
+tokens are dispatched to their experts' shards with ONE ``lax.all_to_all``,
+computed locally, and combined back with a second all_to_all. XLA lowers
+both onto ICI; there is no NVSHMEM equivalent to manage.
+
+Shape discipline (XLA requires static shapes): dispatch is capacity-based
+GShard-style — each shard sends at most C token-slots to every other shard.
+Slots past capacity are dropped (their combine weight contributes zero), so
+``capacity_factor`` trades padding FLOPs against drop probability; tests and
+the decode path size C for zero drops, matching the numerics of the dense
+path exactly.
+
+Local expert compute uses a one-hot masked grouped contraction over the
+shard's E/W experts (E_loc is small in wide-EP: 256 experts / 64 chips = 4).
+A Pallas megablocks-style grouped GEMM is the planned upgrade for the MXU
+hot path (reference's DeepGEMM role, SURVEY.md N6).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from llmd_tpu.config import ModelConfig
+from llmd_tpu.models.moe import router_topk
+
+EP_SPEC = P(("dp", "tp"))
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def moe_block_ep(
+    h: jax.Array,  # [B, Q, H]
+    lp: dict,
+    cfg: ModelConfig,
+    mesh,
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """EP MoE on [B, Q, H]; call inside jit with params EP-sharded."""
+    B, Q, H = h.shape
+    axes = EP_SPEC[0]
+    W = math.prod(mesh.shape[a] for a in axes)
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    if E % W:
+        raise ValueError(f"num_experts {E} not divisible by EP world {W}")
+    T = B * Q
+    Tp = _round_up(T, W)
+    ht = h.reshape(T, H)
+    if Tp > T:
+        ht = jnp.concatenate([ht, jnp.zeros((Tp - T, H), h.dtype)], axis=0)
+
+    t_loc = Tp // W
+    # Per-shard send capacity to EACH destination shard. Zero-drop bound is
+    # t_loc * k (every local slot targets the same shard).
+    C = min(
+        _round_up(max(int(math.ceil(t_loc * k / W * capacity_factor)), 8), 8),
+        _round_up(t_loc * k, 8),
+    )
+
+    local = functools.partial(
+        _moe_ep_local, cfg=cfg, W=W, C=C, axes=axes
+    )
+    has_shared = bool(cfg.shared_expert_intermediate_size)
+    specs = dict(
+        router=P(None, None),
+        we_gate=P(("dp", "tp"), None, None),
+        we_up=P(("dp", "tp"), None, None),
+        we_down=P(("dp", "tp"), None, None),
+    )
+    args = [lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"]]
+    in_specs = [EP_SPEC, specs["router"], specs["we_gate"], specs["we_up"], specs["we_down"]]
+    if has_shared:
+        args += [lp["ws_gate"], lp["ws_up"], lp["ws_down"]]
+        in_specs += [P(None, None), P(None, None), P(None, None)]
+    out = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=EP_SPEC,
+        check_rep=False,
+    )(ht, *args)
+    return out[:T].reshape(B, Q, H)
+
+
+def _moe_ep_local(
+    ht, router, we_gate, we_up, we_down, *shared, cfg: ModelConfig, W: int, C: int, axes
+):
+    """Per-shard body: route -> dispatch a2a -> local experts -> combine a2a.
+
+    ht: [t, H] local tokens; we_*: [E_loc, ...] local experts.
+    """
+    t, H = ht.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    E_loc = E // W
+
+    weights, ids = router_topk(ht, router, k)  # [t, k]
+    flat_ids = ids.reshape(-1)  # [tk]
+    dest = flat_ids // E_loc  # destination shard per slot
+    e_local = flat_ids % E_loc  # expert index on that shard
+    tk = t * k
+
+    # Rank of each slot within its destination's send queue (stable order).
+    onehot_dest = jax.nn.one_hot(dest, W, dtype=jnp.int32)  # [tk, W]
+    rank = jnp.take_along_axis(
+        jnp.cumsum(onehot_dest, axis=0), dest[:, None], axis=1
+    )[:, 0] - 1  # [tk]
+    keep = rank < C
+    slot = jnp.where(keep, rank, C)  # overflow lands in a scratch slot
+
+    # Scatter into [W, C+1, ...] send buffers (scratch slot C dropped below).
+    src_tok = jnp.repeat(jnp.arange(t), k)
+    send_x = jnp.zeros((W, C + 1, H), ht.dtype).at[dest, slot].set(ht[src_tok])
+    send_e = jnp.zeros((W, C + 1), jnp.int32).at[dest, slot].set(e_local)
+    send_v = jnp.zeros((W, C + 1), jnp.bool_).at[dest, slot].set(keep)
+
+    # Dispatch: one ICI all-to-all (the deepep dispatch equivalent).
+    recv_x = jax.lax.all_to_all(send_x[:, :C], axes, 0, 0)  # [W, C, H]
+    recv_e = jax.lax.all_to_all(send_e[:, :C], axes, 0, 0)
+    recv_v = jax.lax.all_to_all(send_v[:, :C], axes, 0, 0)
+
+    xr = recv_x.reshape(W * C, H)
+    er = recv_e.reshape(W * C)
+    vr = recv_v.reshape(W * C)
+
+    # Local experts: one-hot masked grouped contraction over E_loc.
+    onehot_e = jax.nn.one_hot(er, E_loc, dtype=xr.dtype) * vr[:, None].astype(xr.dtype)
+    gate = jax.nn.silu(jnp.einsum("th,ehf->etf", xr, we_gate))
+    up = jnp.einsum("th,ehf->etf", xr, we_up)
+    per_e = jnp.einsum("etf,efh->eth", gate * up, we_down)  # [E_loc, WC, H]
+    yr = jnp.einsum("eth,te->th", per_e, onehot_e)  # [WC, H]
+
+    # Combine: reverse all-to-all returns each slot to its source shard.
+    back = jax.lax.all_to_all(yr.reshape(W, C, H), axes, 0, 0)  # [W, C, H]
+    back = jnp.concatenate([back, jnp.zeros((W, 1, H), back.dtype)], axis=1)
+
+    gathered = back[dest, slot]  # [tk, H]; scratch slot = zeros
+    w_flat = (weights.reshape(-1) * keep.astype(weights.dtype))[:, None]
+    y = jnp.sum(
+        (gathered.astype(jnp.float32) * w_flat).reshape(t, k, H), axis=1
+    ).astype(ht.dtype)
+
+    if shared:
+        ws_gate, ws_up, ws_down = shared
+        g = jax.nn.silu(ht @ ws_gate)
+        y = y + (g * (ht @ ws_up)) @ ws_down
+    return y

@@ -40,7 +40,10 @@ def make_engine_config(args):
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
-            data_parallel_size=args.data_parallel_size,
+            # Engine-process view: DP across processes is the supervisor's
+            # job; in-process the mesh is TP-only.
+            data_parallel_size=1,
+            moe_backend=args.moe_backend,
         ),
         seed=args.seed,
         weights_path=args.weights_path,
@@ -78,6 +81,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decode-window", type=int, default=1)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument(
+        "--data-parallel-rank", type=int, default=0,
+        help="this process's global DP rank (set by the DP supervisor)",
+    )
+    p.add_argument(
+        "--moe-backend", default="dense", choices=["dense", "ep"],
+        help="MoE path: dense combine or shard_map all-to-all (wide-EP)",
+    )
+    p.add_argument(
+        "--platform", default=None,
+        help="force a JAX platform (e.g. cpu for the sim backend)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--kv-transfer-config", default=None, help="JSON, vLLM-style")
     p.add_argument("--kv-events-endpoint", default=None, help="ZMQ pub endpoint")
@@ -100,6 +115,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
     args = build_parser().parse_args(argv)
+
+    if args.platform:
+        # Must run before any jax import; env alone is overridden by site
+        # customization on some hosts, so set the config too.
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     from aiohttp import web
 

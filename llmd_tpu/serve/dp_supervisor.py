@@ -1,0 +1,240 @@
+"""DP supervisor: manages per-rank engine processes on one host.
+
+Re-implements the reference's vLLM DP supervisor deployment shape
+(wide-ep-lws/modelserver/gpu/vllm/base/decode.yaml:101-121, 223-247):
+
+  * N local engine ranks, each an independent serving process listening on
+    ``port_base + i`` (the ``--data-parallel-multi-port-external-lb``
+    pattern — every rank is externally addressable and the EPP lists all
+    rank ports in targetPorts, wide-ep-lws.values.yaml:41-52);
+  * global rank = ``start_rank + i`` for multi-host DP
+    (``--data-parallel-start-rank`` math, decode.yaml:112);
+  * a supervisor health endpoint (reference :8208) aggregating rank health;
+  * restart policy: per-rank restart with backoff, or all-or-nothing
+    (the LWS semantics, docs/infrastructure/multi-node.md:5).
+
+On TPU each rank owns its chips via JAX process-local devices; the
+supervisor is deliberately engine-agnostic — it execs the serve CLI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import sys
+import time
+
+import aiohttp
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class DPConfig:
+    data_parallel_size: int = 1  # global DP world
+    data_parallel_size_local: int = 1  # ranks on this host
+    data_parallel_start_rank: int = 0
+    port_base: int = 8200
+    health_port: int = 8208
+    all_or_nothing: bool = False  # LWS-style: one rank dies => restart all
+    restart_backoff_s: float = 2.0
+    max_restarts: int = 10
+    engine_args: tuple[str, ...] = ()  # passed through to the serve CLI
+
+
+@dataclasses.dataclass
+class _Rank:
+    local_rank: int
+    global_rank: int
+    port: int
+    proc: asyncio.subprocess.Process | None = None
+    restarts: int = 0
+    started_at: float = 0.0
+
+
+class DPSupervisor:
+    def __init__(self, cfg: DPConfig) -> None:
+        if cfg.data_parallel_start_rank + cfg.data_parallel_size_local > cfg.data_parallel_size:
+            raise ValueError(
+                f"start rank {cfg.data_parallel_start_rank} + local "
+                f"{cfg.data_parallel_size_local} exceeds DP world "
+                f"{cfg.data_parallel_size}"
+            )
+        self.cfg = cfg
+        self.ranks = [
+            _Rank(
+                local_rank=i,
+                global_rank=cfg.data_parallel_start_rank + i,
+                port=cfg.port_base + i,
+            )
+            for i in range(cfg.data_parallel_size_local)
+        ]
+        self._stopping = False
+
+    # ------------------------------------------------------------------ #
+
+    def _cmd(self, rank: _Rank) -> list[str]:
+        return [
+            sys.executable, "-m", "llmd_tpu.serve",
+            "--port", str(rank.port),
+            "--data-parallel-rank", str(rank.global_rank),
+            "--data-parallel-size", str(self.cfg.data_parallel_size),
+            *self.cfg.engine_args,
+        ]
+
+    async def _spawn(self, rank: _Rank) -> None:
+        cmd = self._cmd(rank)
+        log.info("dp rank %d (global %d): %s", rank.local_rank, rank.global_rank,
+                 " ".join(cmd))
+        rank.proc = await asyncio.create_subprocess_exec(*cmd)
+        rank.started_at = time.monotonic()
+
+    async def _monitor(self) -> None:
+        """Restart dead ranks (or everything, in all-or-nothing mode)."""
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            for rank in self.ranks:
+                p = rank.proc
+                if p is None or p.returncode is None:
+                    continue
+                log.warning(
+                    "dp rank %d exited rc=%s", rank.local_rank, p.returncode
+                )
+                if self.cfg.all_or_nothing:
+                    log.warning("all-or-nothing: restarting every rank")
+                    await self._kill_all()
+                    for r in self.ranks:
+                        r.restarts += 1
+                    if any(r.restarts > self.cfg.max_restarts for r in self.ranks):
+                        raise RuntimeError("dp ranks exceeded max restarts")
+                    await asyncio.sleep(self.cfg.restart_backoff_s)
+                    for r in self.ranks:
+                        await self._spawn(r)
+                    break
+                rank.restarts += 1
+                if rank.restarts > self.cfg.max_restarts:
+                    raise RuntimeError(
+                        f"dp rank {rank.local_rank} exceeded max restarts"
+                    )
+                await asyncio.sleep(
+                    self.cfg.restart_backoff_s * min(rank.restarts, 5)
+                )
+                await self._spawn(rank)
+
+    async def _kill_all(self) -> None:
+        for rank in self.ranks:
+            if rank.proc is not None and rank.proc.returncode is None:
+                rank.proc.terminate()
+        for rank in self.ranks:
+            if rank.proc is not None:
+                try:
+                    await asyncio.wait_for(rank.proc.wait(), timeout=10)
+                except asyncio.TimeoutError:
+                    rank.proc.kill()
+                    await rank.proc.wait()
+
+    # ------------------------------------------------------------------ #
+    # health aggregation (reference supervisor health on :8208)
+
+    async def _rank_health(
+        self, session: aiohttp.ClientSession, rank: _Rank
+    ) -> dict:
+        alive = rank.proc is not None and rank.proc.returncode is None
+        healthy = False
+        if alive:
+            try:
+                async with session.get(
+                    f"http://127.0.0.1:{rank.port}/health",
+                    timeout=aiohttp.ClientTimeout(total=2),
+                ) as r:
+                    healthy = r.status == 200
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                healthy = False
+        return {
+            "local_rank": rank.local_rank,
+            "global_rank": rank.global_rank,
+            "port": rank.port,
+            "process_alive": alive,
+            "healthy": healthy,
+            "restarts": rank.restarts,
+        }
+
+    def build_health_app(self) -> web.Application:
+        async def on_startup(app):
+            app["session"] = aiohttp.ClientSession()
+
+        async def on_cleanup(app):
+            await app["session"].close()
+
+        async def health(request: web.Request) -> web.Response:
+            rs = await asyncio.gather(
+                *[self._rank_health(request.app["session"], r) for r in self.ranks]
+            )
+            ok = all(r["healthy"] for r in rs)
+            return web.json_response(
+                {"healthy": ok, "ranks": rs}, status=200 if ok else 503
+            )
+
+        app = web.Application()
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
+        app.router.add_get("/health", health)
+        app.router.add_get("/healthz", health)
+        return app
+
+    # ------------------------------------------------------------------ #
+
+    async def run(self) -> None:
+        for rank in self.ranks:
+            await self._spawn(rank)
+        runner = web.AppRunner(self.build_health_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", self.cfg.health_port)
+        await site.start()
+        try:
+            await self._monitor()
+        finally:
+            self._stopping = True
+            await self._kill_all()
+            await runner.cleanup()
+
+    async def stop(self) -> None:
+        self._stopping = True
+        await self._kill_all()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(
+        "llmd-tpu dp supervisor",
+        epilog="arguments after -- are passed to each rank's serve CLI",
+    )
+    ap.add_argument("--data-parallel-size", type=int, default=1)
+    ap.add_argument("--data-parallel-size-local", type=int, default=None)
+    ap.add_argument("--data-parallel-start-rank", type=int, default=0)
+    ap.add_argument("--port-base", type=int, default=8200)
+    ap.add_argument("--health-port", type=int, default=8208)
+    ap.add_argument("--all-or-nothing", action="store_true")
+    args, engine_args = ap.parse_known_args(argv)
+    if engine_args and engine_args[0] == "--":
+        engine_args = engine_args[1:]
+    cfg = DPConfig(
+        data_parallel_size=args.data_parallel_size,
+        data_parallel_size_local=(
+            args.data_parallel_size_local or args.data_parallel_size
+        ),
+        data_parallel_start_rank=args.data_parallel_start_rank,
+        port_base=args.port_base,
+        health_port=args.health_port,
+        all_or_nothing=args.all_or_nothing,
+        engine_args=tuple(engine_args),
+    )
+    asyncio.run(DPSupervisor(cfg).run())
+
+
+if __name__ == "__main__":
+    main()
