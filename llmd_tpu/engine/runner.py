@@ -35,7 +35,7 @@ from llmd_tpu.engine.sampler import SamplingInputs, sample_tokens
 from llmd_tpu.engine.scheduler import ScheduledSeq
 from llmd_tpu.models import llama
 from llmd_tpu.models.common import StepInput
-from llmd_tpu.parallel.mesh import KV_CACHE_SPEC, MeshContext, shard_params
+from llmd_tpu.parallel.mesh import MeshContext, kv_cache_spec, shard_params
 
 
 def _buckets(limit: int, start: int = 8) -> tuple[int, ...]:
@@ -112,7 +112,8 @@ class ModelRunner:
             c.page_size,
             2 * self.cfg.head_dim,
         )
-        return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*KV_CACHE_SPEC))
+        spec = kv_cache_spec(self.cfg.num_kv_heads, self.ctx.tp)
+        return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*spec))
 
     def kv_bytes(self) -> int:
         return self.kv_cache.size * self.kv_cache.dtype.itemsize

@@ -24,6 +24,7 @@ fastest ICI dimension on a real slice.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 import jax
 import numpy as np
@@ -116,6 +117,26 @@ PARAM_SPECS: dict[str, P] = {
 # tp; each dp group holds its own full pool (allocated per dp rank at the
 # engine level).
 KV_CACHE_SPEC = P(None, None, TP_AXIS, None, None)
+
+
+def kv_cache_spec(num_kv_heads: int, tp: int) -> P:
+    """KV-cache PartitionSpec, degrading gracefully for GQA.
+
+    When tp exceeds (or doesn't divide) the KV head count the heads are
+    replicated across the tp axis — same policy as the reference engine's
+    GQA handling where each TP rank holds a full KV head copy rather than
+    a fractional head. Under jit/GSPMD this is a layout choice only;
+    results are identical.
+    """
+    if num_kv_heads % tp == 0:
+        return KV_CACHE_SPEC
+    warnings.warn(
+        f"num_kv_heads={num_kv_heads} not divisible by tp={tp}: replicating "
+        f"the KV pool on every tp device ({tp}x the per-chip HBM of the "
+        "sharded layout). Pick tp <= num_kv_heads for production configs.",
+        stacklevel=2,
+    )
+    return P()
 
 
 def param_specs(params: dict) -> dict:
