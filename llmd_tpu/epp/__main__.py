@@ -21,12 +21,21 @@ def main(argv=None) -> None:
     p.add_argument("--endpoints-file", required=True, help="JSON endpoints file")
     p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
     p.add_argument(
-        "--preset", default="default", choices=["default", "pd", "precise"],
+        "--preset", default="default",
+        choices=["default", "pd", "precise", "predicted-latency"],
         help="built-in config preset when --config is not given",
     )
     p.add_argument(
         "--kv-events-port", type=int, default=5556,
         help="default engine KV-event port for precise prefix routing",
+    )
+    p.add_argument(
+        "--predictor-url", default=None,
+        help="prediction sidecar base URL (predicted-latency routing)",
+    )
+    p.add_argument(
+        "--trainer-url", default=None,
+        help="training sidecar base URL (predicted-latency routing)",
     )
     p.add_argument("--scrape-interval", type=float, default=1.0)
     args = p.parse_args(argv)
@@ -37,6 +46,7 @@ def main(argv=None) -> None:
         DEFAULT_CONFIG,
         PD_CONFIG,
         PRECISE_CONFIG,
+        PREDICTED_LATENCY_CONFIG,
         build_flow_control,
         build_scheduler,
     )
@@ -53,6 +63,7 @@ def main(argv=None) -> None:
     else:
         config = {
             "default": DEFAULT_CONFIG, "pd": PD_CONFIG, "precise": PRECISE_CONFIG,
+            "predicted-latency": PREDICTED_LATENCY_CONFIG,
         }[args.preset]
 
     store = EndpointStore()
@@ -68,6 +79,13 @@ def main(argv=None) -> None:
     from llmd_tpu.epp.precise_prefix import attach_precise_routing
 
     attach_precise_routing(router, default_events_port=args.kv_events_port)
+    # Wires the predictor producer + feedback + SLO admitter iff the config
+    # declares a latency-scorer or slo-headroom-tier filter (no-op otherwise).
+    from llmd_tpu.epp.predicted_latency import maybe_attach_predicted_latency
+
+    maybe_attach_predicted_latency(
+        router, predict_url=args.predictor_url, train_url=args.trainer_url
+    )
     web.run_app(router.build_app(), host=args.host, port=args.port)
 
 

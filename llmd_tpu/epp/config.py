@@ -35,6 +35,7 @@ from typing import Any
 # Importing these modules registers their plugins.
 import llmd_tpu.epp.filters  # noqa: F401
 import llmd_tpu.epp.precise_prefix  # noqa: F401
+import llmd_tpu.epp.predicted_latency  # noqa: F401
 import llmd_tpu.epp.scorers  # noqa: F401
 from llmd_tpu.epp.flow_control import BandConfig, FlowControl, SaturationDetector
 from llmd_tpu.epp.plugins import (
@@ -145,6 +146,41 @@ PRECISE_CONFIG: dict[str, Any] = {
                 {"pluginRef": "queue", "weight": 1.0},
                 {"pluginRef": "kv", "weight": 1.0},
                 {"pluginRef": "precise-prefix", "weight": 3.0},
+                {"pluginRef": "picker"},
+            ],
+        }
+    ],
+    "profileHandler": {"type": "single", "profile": "default"},
+    "flowControl": {"enabled": True, "maxInflight": 512},
+}
+
+
+# Predicted-latency routing plugin config (reference
+# guides/predicted-latency-routing/router/predicted-latency.values.yaml):
+# the latency scorer dominates, with the SLO headroom filter ahead of it;
+# wire a PredictedLatencyProducer + LatencySloAdmitter on the Router
+# (see llmd_tpu.epp.predicted_latency.attach_predicted_latency).
+PREDICTED_LATENCY_CONFIG: dict[str, Any] = {
+    "plugins": [
+        {"type": "healthy-filter", "name": "healthy"},
+        {"type": "slo-headroom-tier-filter", "name": "slo-tier"},
+        {"type": "latency-scorer", "name": "latency"},
+        {"type": "queue-scorer", "name": "queue"},
+        # maxPrefixTokensToMatch 262144 in the reference values; our index
+        # works in 256-char blocks -> 4096 blocks covers 262144 tokens.
+        {"type": "prefix-cache-scorer", "name": "prefix",
+         "parameters": {"max_prefix_blocks": 4096}},
+        {"type": "max-score-picker", "name": "picker"},
+    ],
+    "schedulingProfiles": [
+        {
+            "name": "default",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "slo-tier"},
+                {"pluginRef": "latency", "weight": 3.0},
+                {"pluginRef": "queue", "weight": 1.0},
+                {"pluginRef": "prefix", "weight": 2.0},
                 {"pluginRef": "picker"},
             ],
         }

@@ -1,0 +1,329 @@
+"""Predicted-latency routing: producer, scorer, SLO filter, SLO admitter.
+
+Reference behavior (docs/architecture/advanced/latency-predictor.md and
+guides/predicted-latency-routing): a `predicted-latency-producer` annotates
+every candidate endpoint with model-predicted TTFT/TPOT before scheduling;
+a latency scorer prefers low predicted latency; the `slo-headroom-tier`
+filter keeps endpoints whose predicted latency leaves headroom under the
+request's SLO (x-llm-d-slo-ttft-ms / x-llm-d-slo-tpot-ms headers); the
+`latency-slo-admitter` sheds low-priority requests whose SLO no endpoint
+can meet. Completed requests feed observed TTFT/TPOT back to the trainer —
+the continuous-retrain loop.
+
+The predictor itself may be in-process (a LatencyPredictor instance — the
+dev/no-K8s mode) or remote sidecars (llmd_tpu.predictor.server); both are
+behind PredictorClient.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Sequence
+
+import aiohttp
+
+from llmd_tpu.epp.handler import Admitter
+from llmd_tpu.epp.plugins import Filter, Scorer, register
+from llmd_tpu.epp.types import (
+    KV_CACHE_USAGE,
+    PREFIX_HIT_RATIO,
+    RUNNING_REQUESTS,
+    TOKENS_IN_FLIGHT,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
+from llmd_tpu.predictor.model import (
+    LatencyPredictor,
+    ttft_features,
+    tpot_features,
+)
+
+log = logging.getLogger("llmd.epp.latency")
+
+SCRATCH_TTFT = "predicted_ttft_ms"  # {addr: ms}
+SCRATCH_TPOT = "predicted_tpot_ms"  # {addr: ms}
+SCRATCH_FEATURES = "latency_features"  # {addr: (ttft_f, tpot_f)}
+
+
+def endpoint_features(
+    req: LLMRequest, pod: Endpoint
+) -> tuple[list[float], list[float]]:
+    """Feature vectors for scheduling ``req`` on ``pod`` right now.
+
+    prefix_match_frac comes from the prefix scorer's scratch when it ran
+    before the producer in the same scheduling pass; otherwise the polled
+    PrefixCacheHitRatio attribute approximates it.
+    """
+    prefix = req.scratch.get("prefix_match_frac", {}).get(
+        pod.address, pod.attr(PREFIX_HIT_RATIO)
+    )
+    tf = ttft_features(
+        kv_usage=pod.attr(KV_CACHE_USAGE),
+        waiting_queue=pod.attr(WAITING_QUEUE_SIZE),
+        running=pod.attr(RUNNING_REQUESTS) + pod.inflight,
+        input_tokens=req.approx_prompt_tokens,
+        prefix_hit_ratio=prefix,
+        tokens_in_flight=pod.attr(TOKENS_IN_FLIGHT, pod.inflight_tokens),
+    )
+    pf = tpot_features(
+        kv_usage=pod.attr(KV_CACHE_USAGE),
+        running=pod.attr(RUNNING_REQUESTS) + pod.inflight,
+        input_tokens=req.approx_prompt_tokens,
+        tokens_in_flight=pod.attr(TOKENS_IN_FLIGHT, pod.inflight_tokens),
+    )
+    return tf, pf
+
+
+class PredictorClient:
+    """In-process predictor, optionally backed by remote sidecars."""
+
+    def __init__(
+        self,
+        predictor: LatencyPredictor | None = None,
+        predict_url: str | None = None,
+        train_url: str | None = None,
+        timeout_s: float = 0.2,
+    ) -> None:
+        self.predictor = predictor or LatencyPredictor()
+        self.predict_url = predict_url
+        self.train_url = train_url
+        self.timeout_s = timeout_s
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        return self._session
+
+    async def predict(
+        self, ttft_f: Sequence[float], tpot_f: Sequence[float]
+    ) -> tuple[float, float]:
+        if self.predict_url:
+            try:
+                session = await self._client()
+                async with session.post(
+                    self.predict_url + "/v1/predict",
+                    json={"ttft_features": list(ttft_f), "tpot_features": list(tpot_f)},
+                ) as r:
+                    d = await r.json()
+                    return float(d["ttft_ms"]), float(d["tpot_ms"])
+            except Exception:
+                log.debug("remote predict failed; using local fallback")
+        return (
+            self.predictor.predict_ttft(ttft_f)[0],
+            self.predictor.predict_tpot(tpot_f)[0],
+        )
+
+    async def observe(
+        self,
+        ttft_f: Sequence[float],
+        ttft_ms: float | None,
+        tpot_f: Sequence[float],
+        tpot_ms: float | None,
+    ) -> None:
+        if ttft_ms is not None:
+            self.predictor.observe_ttft(ttft_f, ttft_ms)
+        if tpot_ms is not None:
+            self.predictor.observe_tpot(tpot_f, tpot_ms)
+        payload: dict = {}
+        if ttft_ms is not None:
+            payload["ttft"] = [{"features": list(ttft_f), "ms": ttft_ms}]
+        if tpot_ms is not None:
+            payload["tpot"] = [{"features": list(tpot_f), "ms": tpot_ms}]
+        if self.train_url and payload:
+            try:
+                session = await self._client()
+                async with session.post(
+                    self.train_url + "/v1/samples", json=payload
+                ) as r:
+                    await r.read()
+            except Exception:
+                log.debug("trainer sample post failed")
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class PredictedLatencyProducer:
+    """DataProducer: annotate req.scratch with per-endpoint predictions."""
+
+    def __init__(self, client: PredictorClient | None = None) -> None:
+        self.client = client or PredictorClient()
+
+    async def produce(self, req: LLMRequest, pods: list[Endpoint]) -> None:
+        feats = {p.address: endpoint_features(req, p) for p in pods}
+        # One concurrent round trip regardless of pool size (a degraded
+        # prediction sidecar must not add N x timeout to the critical path).
+        results = await asyncio.gather(
+            *(self.client.predict(tf, pf) for tf, pf in feats.values())
+        )
+        req.scratch[SCRATCH_TTFT] = {
+            a: t for a, (t, _) in zip(feats, results)
+        }
+        req.scratch[SCRATCH_TPOT] = {
+            a: p for a, (_, p) in zip(feats, results)
+        }
+        req.scratch[SCRATCH_FEATURES] = feats
+
+    async def on_complete(
+        self,
+        req: LLMRequest,
+        pod: Endpoint,
+        ttft_ms: float | None,
+        tpot_ms: float | None,
+    ) -> None:
+        """Completion observer: feed observed latencies back to training."""
+        feats = req.scratch.get(SCRATCH_FEATURES, {}).get(pod.address)
+        if feats is None:
+            tf, pf = endpoint_features(req, pod)
+        else:
+            tf, pf = feats
+        await self.client.observe(tf, ttft_ms, pf, tpot_ms)
+
+
+def _predicted(req: LLMRequest, pod: Endpoint) -> tuple[float, float]:
+    """Predicted (ttft_ms, tpot_ms), heuristic-computed if no producer ran."""
+    ttft = req.scratch.get(SCRATCH_TTFT, {}).get(pod.address)
+    tpot = req.scratch.get(SCRATCH_TPOT, {}).get(pod.address)
+    if ttft is None or tpot is None:
+        from llmd_tpu.predictor.model import heuristic_tpot_ms, heuristic_ttft_ms
+
+        tf, pf = endpoint_features(req, pod)
+        ttft = ttft if ttft is not None else heuristic_ttft_ms(tf)
+        tpot = tpot if tpot is not None else heuristic_tpot_ms(pf)
+    return float(ttft), float(tpot)
+
+
+@register("latency-scorer")
+class LatencyScorer(Scorer):
+    """Lower predicted latency -> higher score (normalized per request).
+
+    ttft_weight/tpot_weight blend the two objectives; streaming chat cares
+    about both, embeddings only about TTFT.
+    """
+
+    def __init__(self, ttft_weight: float = 1.0, tpot_weight: float = 1.0) -> None:
+        self.ttft_weight = ttft_weight
+        self.tpot_weight = tpot_weight
+
+    def score(self, req: LLMRequest, pods: list[Endpoint]) -> dict[str, float]:
+        costs: dict[str, float] = {}
+        for pod in pods:
+            ttft, tpot = _predicted(req, pod)
+            costs[pod.address] = self.ttft_weight * ttft + self.tpot_weight * tpot
+        worst = max(costs.values(), default=0.0)
+        if worst <= 0:
+            return {a: 1.0 for a in costs}
+        return {a: 1.0 - c / worst for a, c in costs.items()}
+
+
+@register("slo-headroom-tier-filter")
+class SloHeadroomTierFilter(Filter):
+    """Keep the best headroom tier among endpoints meeting the SLO.
+
+    Headroom = slo - predicted. Tiers of ``tier_ms`` width let load spread
+    within a tier instead of always dog-piling the single best endpoint
+    (reference scheduling.md:77-83 `slo-headroom-tier`). Requests without
+    SLO headers pass through unfiltered. If nobody meets the SLO the least
+    violating endpoint is kept (the admitter decides whether to shed).
+    """
+
+    def __init__(self, tier_ms: float = 50.0) -> None:
+        self.tier_ms = tier_ms
+
+    def filter(self, req: LLMRequest, pods: list[Endpoint]) -> list[Endpoint]:
+        if req.ttft_slo_ms is None and req.tpot_slo_ms is None:
+            return pods
+        headrooms: dict[str, float] = {}
+        for pod in pods:
+            ttft, tpot = _predicted(req, pod)
+            h = float("inf")
+            if req.ttft_slo_ms is not None:
+                h = min(h, req.ttft_slo_ms - ttft)
+            if req.tpot_slo_ms is not None:
+                h = min(h, req.tpot_slo_ms - tpot)
+            headrooms[pod.address] = h
+        meeting = [p for p in pods if headrooms[p.address] >= 0]
+        if not meeting:
+            best = max(pods, key=lambda p: headrooms[p.address], default=None)
+            return [best] if best else []
+        top = max(headrooms[p.address] for p in meeting)
+        return [p for p in meeting if headrooms[p.address] >= top - self.tier_ms]
+
+
+def maybe_attach_predicted_latency(
+    router, predict_url: str | None = None, train_url: str | None = None
+) -> PredictedLatencyProducer | None:
+    """attach_predicted_latency iff the scheduler config uses the feature."""
+    from llmd_tpu.epp.config import find_plugins
+
+    used = find_plugins(router.scheduler, LatencyScorer) + find_plugins(
+        router.scheduler, SloHeadroomTierFilter
+    )
+    if not used:
+        return None
+    return attach_predicted_latency(router, predict_url, train_url)
+
+
+def attach_predicted_latency(
+    router,
+    predict_url: str | None = None,
+    train_url: str | None = None,
+    slack: float = 1.5,
+) -> PredictedLatencyProducer:
+    """Wire the predicted-latency plane onto a built Router.
+
+    Adds the PredictedLatencyProducer to the producer phase, its training
+    feedback to the completion observers, and a LatencySloAdmitter in front
+    of flow control. Returns the producer (its .client owns the predictor).
+    """
+    client = PredictorClient(predict_url=predict_url, train_url=train_url)
+    producer = PredictedLatencyProducer(client)
+    router.producers.append(producer)
+    router.completion_observers.append(producer.on_complete)
+    router.admitters.append(LatencySloAdmitter(router.store, slack=slack))
+    router.closables.append(client)
+    return producer
+
+
+class LatencySloAdmitter(Admitter):
+    """Shed sheddable requests whose SLO no endpoint is predicted to meet.
+
+    Priority >= ``protected_priority`` is never shed (the reference admits
+    critical traffic regardless and lets flow-control arbitrate).
+    """
+
+    def __init__(
+        self,
+        store,
+        slack: float = 1.5,
+        protected_priority: int = 1,
+    ) -> None:
+        self.store = store
+        self.slack = slack
+        self.protected_priority = protected_priority
+
+    def admit(self, req: LLMRequest) -> str | None:
+        if req.priority >= self.protected_priority:
+            return None
+        if req.ttft_slo_ms is None and req.tpot_slo_ms is None:
+            return None
+        pods = [p for p in self.store.list() if p.healthy]
+        if not pods:
+            return None  # let the scheduler produce the 503
+        for pod in pods:
+            ttft, tpot = _predicted(req, pod)
+            ok = True
+            if req.ttft_slo_ms is not None and ttft > req.ttft_slo_ms * self.slack:
+                ok = False
+            if req.tpot_slo_ms is not None and tpot > req.tpot_slo_ms * self.slack:
+                ok = False
+            if ok:
+                return None
+        return "slo-unattainable"

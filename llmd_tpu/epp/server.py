@@ -132,6 +132,10 @@ class Router:
         self.request_timeout_s = request_timeout_s
         self.max_schedule_attempts = max_schedule_attempts
         self._session: aiohttp.ClientSession | None = None
+        # Async callbacks (req, pod, ttft_ms|None, tpot_ms|None) fired after
+        # each proxied request — the latency-predictor training feedback
+        # (reference latency-predictor.md: observed TTFT/TPOT per request).
+        self.completion_observers: list = []
 
     # ------------------------------------------------------------------ #
 
@@ -163,14 +167,6 @@ class Router:
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
                 status=400,
             )
-        for adm in self.admitters:
-            reason = adm.admit(req)
-            if reason is not None:
-                return web.json_response(
-                    {"error": {"message": reason, "type": "rejected"}},
-                    status=429,
-                    headers={HDR_DROP_REASON: reason},
-                )
         outcome = await self.flow.enqueue_and_wait(req, nbytes=len(raw))
         if outcome is not Outcome.DISPATCHED:
             status, reason = OUTCOME_HTTP[outcome]
@@ -179,12 +175,23 @@ class Router:
                 status=status,
                 headers={HDR_DROP_REASON: reason, "retry-after": "1"},
             )
-        for producer in self.producers:
-            try:
-                await producer.produce(req, self.store.list())
-            except Exception:
-                log.exception("data producer %s failed", type(producer).__name__)
         try:
+            # DataProducers run before Admitters (reference
+            # request-handling.md:26-52 / SURVEY.md §3.1 step 4) so admission
+            # decisions see prefix hashes and latency predictions.
+            for producer in self.producers:
+                try:
+                    await producer.produce(req, self.store.list())
+                except Exception:
+                    log.exception("data producer %s failed", type(producer).__name__)
+            for adm in self.admitters:
+                reason = adm.admit(req)
+                if reason is not None:
+                    return web.json_response(
+                        {"error": {"message": reason, "type": "rejected"}},
+                        status=429,
+                        headers={HDR_DROP_REASON: reason},
+                    )
             return await self._route_and_proxy(request, req, raw)
         finally:
             self.flow.release()
@@ -249,10 +256,15 @@ class Router:
         pod.inflight_tokens += req.approx_prompt_tokens
         t0 = time.monotonic()
         first_byte: float | None = None
+        last_byte: float | None = None
+        stream_tokens = 0
+        carry = b""  # partial SSE line across chunk boundaries
+        status = 0
         try:
             async with session.request(
                 request.method, pod.url + request.path_qs, data=raw, headers=headers
             ) as upstream:
+                status = upstream.status
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in HOP_HEADERS:
@@ -262,6 +274,18 @@ class Router:
                 async for chunk in upstream.content.iter_any():
                     if first_byte is None:
                         first_byte = time.monotonic()
+                    last_byte = time.monotonic()
+                    if req.streaming:
+                        # Count complete SSE data lines ("data: ..." at line
+                        # start — one frame ~ one sampled token batch); the
+                        # carry keeps counting exact across TCP chunk splits.
+                        lines = (carry + chunk).split(b"\n")
+                        carry = lines.pop()
+                        stream_tokens += sum(
+                            1
+                            for ln in lines
+                            if ln.startswith(b"data:") and b"[DONE]" not in ln
+                        )
                     await resp.write(chunk)
                 await resp.write_eof()
                 return resp
@@ -270,15 +294,30 @@ class Router:
             pod.inflight_tokens = max(
                 0, pod.inflight_tokens - req.approx_prompt_tokens
             )
+            if carry.startswith(b"data:") and b"[DONE]" not in carry:
+                stream_tokens += 1
             now = time.monotonic()
-            if first_byte is not None:
+            ttft_ms: float | None = None
+            tpot_ms: float | None = None
+            # Only successful responses produce latency observations: a pod
+            # fast-failing with 500s must not train/score as "fastest".
+            if first_byte is not None and 200 <= status < 400:
                 self.metrics.ttft_count += 1
                 self.metrics.ttft_sum += first_byte - t0
                 self.metrics.e2e_sum += now - t0
                 # per-endpoint latency attrs for latency-aware scoring
                 pod.attrs["LastTTFT"] = first_byte - t0
                 pod.attrs["LastE2E"] = now - t0
+                ttft_ms = (first_byte - t0) * 1000.0
+                if last_byte is not None and stream_tokens > 1:
+                    tpot_ms = (last_byte - first_byte) * 1000.0 / (stream_tokens - 1)
             self.scheduler.notify_complete(req, pod)
+            if ttft_ms is not None:
+                for obs in self.completion_observers:
+                    try:
+                        await obs(req, pod, ttft_ms, tpot_ms)
+                    except Exception:
+                        log.exception("completion observer failed")
 
     async def handle_passthrough(self, request: web.Request) -> web.StreamResponse:
         """Non-generate paths (/v1/models, ...) go to any healthy endpoint."""

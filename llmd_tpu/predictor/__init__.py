@@ -1,0 +1,13 @@
+from llmd_tpu.predictor.model import (
+    LatencyPredictor,
+    PredictorConfig,
+    ttft_features,
+    tpot_features,
+)
+
+__all__ = [
+    "LatencyPredictor",
+    "PredictorConfig",
+    "ttft_features",
+    "tpot_features",
+]

@@ -1,0 +1,257 @@
+"""Latency predictor core: stratified online regression for TTFT / TPOT.
+
+Re-implements the behavior of the reference's latency-predictor sidecars
+(reference docs/architecture/advanced/latency-predictor.md:20-100): models
+are trained continuously on completed requests, stratified into buckets by
+KV-cache utilization (10% steps) and prefix-cache hit ratio (0.25 steps) so
+each regime gets its own fit; prediction falls back to a documented
+heuristic whenever a bucket is cold or the model files are missing
+(latency-predictor.md's "heuristic fallback on outage").
+
+The reference trains XGBoost; this image has no XGBoost, so each bucket is
+an online ridge regression over the same feature vectors, updated with
+exponential decay — the continuous-retrain property (new traffic re-weights
+the fit) without a separate batch trainer. The HTTP split (one training
+server + N prediction servers sharing a model directory) is preserved in
+llmd_tpu.predictor.server; this module is the shared math.
+
+Feature vectors (fixed order; the EPP producer and the trainer must agree):
+
+  TTFT:  [kv_usage(0-1), waiting_queue, running, input_tokens,
+          prefix_hit_ratio(0-1), tokens_in_flight]
+  TPOT:  [kv_usage(0-1), running, input_tokens, tokens_in_flight]
+
+Targets are milliseconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import threading
+from typing import Sequence
+
+import numpy as np
+
+TTFT_DIM = 6
+TPOT_DIM = 4
+
+
+def ttft_features(
+    kv_usage: float,
+    waiting_queue: float,
+    running: float,
+    input_tokens: float,
+    prefix_hit_ratio: float,
+    tokens_in_flight: float,
+) -> list[float]:
+    return [
+        float(kv_usage),
+        float(waiting_queue),
+        float(running),
+        float(input_tokens),
+        float(prefix_hit_ratio),
+        float(tokens_in_flight),
+    ]
+
+
+def tpot_features(
+    kv_usage: float, running: float, input_tokens: float, tokens_in_flight: float
+) -> list[float]:
+    return [
+        float(kv_usage),
+        float(running),
+        float(input_tokens),
+        float(tokens_in_flight),
+    ]
+
+
+def heuristic_ttft_ms(f: Sequence[float]) -> float:
+    """Closed-form fallback (tunable): queueing + prefill compute terms."""
+    kv, queue, running, input_tokens, prefix_hit, _tif = f
+    prefill_tokens = input_tokens * max(0.0, 1.0 - prefix_hit)
+    return 20.0 + 0.06 * prefill_tokens + 40.0 * queue + 4.0 * running + 80.0 * kv
+
+
+def heuristic_tpot_ms(f: Sequence[float]) -> float:
+    kv, running, _input_tokens, tif = f
+    return 8.0 + 12.0 * kv + 0.25 * running + 0.0005 * tif
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictorConfig:
+    # Stratification steps (latency-predictor.md: 10% KV / 0.25 prefix-hit).
+    kv_bucket_step: float = 0.1
+    prefix_bucket_step: float = 0.25
+    # Ridge regularization and online decay (continuous retrain).
+    l2: float = 1.0
+    decay: float = 0.999
+    # A bucket predicts only after this many samples; below it the global
+    # fit is used, and below it again the heuristic.
+    min_bucket_samples: int = 20
+    min_global_samples: int = 50
+
+
+class _OnlineRidge:
+    """Accumulator-form ridge: A = decay-weighted X'X, b = X'y."""
+
+    def __init__(self, dim: int, l2: float, decay: float) -> None:
+        self.dim = dim
+        self.l2 = l2
+        self.decay = decay
+        # +1 for the intercept column.
+        self.A = np.zeros((dim + 1, dim + 1))
+        self.b = np.zeros(dim + 1)
+        self.count = 0.0
+        self._w: np.ndarray | None = None
+
+    def add(self, x: Sequence[float], y: float) -> None:
+        v = np.ones(self.dim + 1)
+        v[: self.dim] = x
+        self.A *= self.decay
+        self.b *= self.decay
+        self.count = self.count * self.decay + 1.0
+        self.A += np.outer(v, v)
+        self.b += v * y
+        self._w = None
+
+    def predict(self, x: Sequence[float]) -> float:
+        if self._w is None:
+            reg = self.l2 * np.eye(self.dim + 1)
+            reg[-1, -1] = 0.0  # don't penalize the intercept
+            self._w = np.linalg.solve(self.A + reg, self.b)
+        v = np.ones(self.dim + 1)
+        v[: self.dim] = x
+        return float(v @ self._w)
+
+    def to_dict(self) -> dict:
+        return {"A": self.A.tolist(), "b": self.b.tolist(), "count": self.count}
+
+    @classmethod
+    def from_dict(cls, d: dict, dim: int, l2: float, decay: float) -> "_OnlineRidge":
+        r = cls(dim, l2, decay)
+        r.A = np.asarray(d["A"], dtype=float)
+        r.b = np.asarray(d["b"], dtype=float)
+        r.count = float(d["count"])
+        return r
+
+
+class _StratifiedModel:
+    """Per-bucket ridges + a global ridge + heuristic fallback chain."""
+
+    def __init__(
+        self, dim: int, cfg: PredictorConfig, bucket_fn, heuristic_fn
+    ) -> None:
+        self.dim = dim
+        self.cfg = cfg
+        self.bucket_fn = bucket_fn
+        self.heuristic = heuristic_fn
+        self.buckets: dict[str, _OnlineRidge] = {}
+        self.global_fit = _OnlineRidge(dim, cfg.l2, cfg.decay)
+
+    def add(self, x: Sequence[float], y: float) -> None:
+        if len(x) != self.dim or not math.isfinite(y):
+            return
+        key = self.bucket_fn(x, self.cfg)
+        if key not in self.buckets:
+            self.buckets[key] = _OnlineRidge(self.dim, self.cfg.l2, self.cfg.decay)
+        self.buckets[key].add(x, y)
+        self.global_fit.add(x, y)
+
+    def predict(self, x: Sequence[float]) -> tuple[float, str]:
+        """Returns (ms, source) with source in {bucket, global, heuristic}."""
+        if len(x) == self.dim:
+            bucket = self.buckets.get(self.bucket_fn(x, self.cfg))
+            if bucket is not None and bucket.count >= self.cfg.min_bucket_samples:
+                p = bucket.predict(x)
+                if math.isfinite(p) and p > 0:
+                    return p, "bucket"
+            if self.global_fit.count >= self.cfg.min_global_samples:
+                p = self.global_fit.predict(x)
+                if math.isfinite(p) and p > 0:
+                    return p, "global"
+        return self.heuristic(x), "heuristic"
+
+    def to_dict(self) -> dict:
+        return {
+            "buckets": {k: v.to_dict() for k, v in self.buckets.items()},
+            "global": self.global_fit.to_dict(),
+        }
+
+    def load_dict(self, d: dict) -> None:
+        c = self.cfg
+        self.buckets = {
+            k: _OnlineRidge.from_dict(v, self.dim, c.l2, c.decay)
+            for k, v in d.get("buckets", {}).items()
+        }
+        self.global_fit = _OnlineRidge.from_dict(
+            d.get("global", _OnlineRidge(self.dim, c.l2, c.decay).to_dict()),
+            self.dim,
+            c.l2,
+            c.decay,
+        )
+
+
+def _ttft_bucket(x: Sequence[float], cfg: PredictorConfig) -> str:
+    kv = min(max(x[0], 0.0), 1.0)
+    prefix = min(max(x[4], 0.0), 1.0)
+    return f"kv{int(kv / cfg.kv_bucket_step)}-px{int(prefix / cfg.prefix_bucket_step)}"
+
+
+def _tpot_bucket(x: Sequence[float], cfg: PredictorConfig) -> str:
+    kv = min(max(x[0], 0.0), 1.0)
+    return f"kv{int(kv / cfg.kv_bucket_step)}"
+
+
+class LatencyPredictor:
+    """Thread-safe TTFT+TPOT predictor with JSON (de)serialization."""
+
+    def __init__(self, cfg: PredictorConfig | None = None) -> None:
+        self.cfg = cfg or PredictorConfig()
+        self._lock = threading.Lock()
+        self.ttft = _StratifiedModel(TTFT_DIM, self.cfg, _ttft_bucket, heuristic_ttft_ms)
+        self.tpot = _StratifiedModel(TPOT_DIM, self.cfg, _tpot_bucket, heuristic_tpot_ms)
+        self.samples_seen = 0
+
+    # -- training ------------------------------------------------------- #
+
+    def observe_ttft(self, features: Sequence[float], ttft_ms: float) -> None:
+        with self._lock:
+            self.ttft.add(features, ttft_ms)
+            self.samples_seen += 1
+
+    def observe_tpot(self, features: Sequence[float], tpot_ms: float) -> None:
+        with self._lock:
+            self.tpot.add(features, tpot_ms)
+            self.samples_seen += 1
+
+    # -- inference ------------------------------------------------------ #
+
+    def predict_ttft(self, features: Sequence[float]) -> tuple[float, str]:
+        with self._lock:
+            return self.ttft.predict(features)
+
+    def predict_tpot(self, features: Sequence[float]) -> tuple[float, str]:
+        with self._lock:
+            return self.tpot.predict(features)
+
+    # -- persistence (shared model volume between trainer and predictors) #
+
+    def dumps(self) -> str:
+        with self._lock:
+            return json.dumps(
+                {
+                    "version": 1,
+                    "samples_seen": self.samples_seen,
+                    "ttft": self.ttft.to_dict(),
+                    "tpot": self.tpot.to_dict(),
+                }
+            )
+
+    def loads(self, raw: str) -> None:
+        d = json.loads(raw)
+        with self._lock:
+            self.ttft.load_dict(d.get("ttft", {}))
+            self.tpot.load_dict(d.get("tpot", {}))
+            self.samples_seen = int(d.get("samples_seen", 0))

@@ -1,0 +1,211 @@
+"""Latency-predictor sidecar servers.
+
+Mirrors the reference's sidecar split (reference
+docs/architecture/advanced/latency-predictor.md:20-100): ONE training
+server ingests completed-request samples and periodically serializes the
+fitted models to a shared directory; N prediction servers poll that
+directory and serve low-latency /v1/predict calls (~300 QPS each in the
+reference; here a single aiohttp handler is far above that for the
+numpy-ridge models). If the model file is missing or stale the prediction
+server still answers — from the heuristic fallback chain inside
+LatencyPredictor.
+
+HTTP surface:
+  training server   POST /v1/samples   {"ttft": [{"features": [...], "ms": N}],
+                                        "tpot": [...]}
+                    GET  /v1/model-info
+  prediction server POST /v1/predict   {"ttft_features": [...],
+                                        "tpot_features": [...]}
+                    -> {"ttft_ms": N, "tpot_ms": N, "ttft_source": "...",
+                        "tpot_source": "..."}
+Both serve GET /healthz.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import tempfile
+
+from aiohttp import web
+
+from llmd_tpu.predictor.model import LatencyPredictor, PredictorConfig
+
+log = logging.getLogger("llmd.predictor")
+
+MODEL_FILE = "latency-model.json"
+
+
+class TrainingServer:
+    def __init__(
+        self,
+        model_dir: str,
+        cfg: PredictorConfig | None = None,
+        flush_interval_s: float = 5.0,
+    ) -> None:
+        self.model_dir = model_dir
+        self.predictor = LatencyPredictor(cfg)
+        self.flush_interval_s = flush_interval_s
+        self._dirty = False
+        self._task: asyncio.Task | None = None
+        os.makedirs(model_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+
+    def ingest(self, payload: dict) -> int:
+        n = 0
+        for s in payload.get("ttft", []):
+            self.predictor.observe_ttft(s["features"], float(s["ms"]))
+            n += 1
+        for s in payload.get("tpot", []):
+            self.predictor.observe_tpot(s["features"], float(s["ms"]))
+            n += 1
+        if n:
+            self._dirty = True
+        return n
+
+    def flush(self) -> None:
+        """Atomic write so prediction servers never read a torn file."""
+        raw = self.predictor.dumps()
+        fd, tmp = tempfile.mkstemp(dir=self.model_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(raw)
+            os.replace(tmp, os.path.join(self.model_dir, MODEL_FILE))
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self._dirty = False
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval_s)
+            if self._dirty:
+                try:
+                    self.flush()
+                except Exception:
+                    log.exception("model flush failed")
+
+    # ------------------------------------------------------------------ #
+
+    async def handle_samples(self, request: web.Request) -> web.Response:
+        payload = await request.json()
+        n = self.ingest(payload)
+        return web.json_response({"ingested": n})
+
+    async def handle_model_info(self, request: web.Request) -> web.Response:
+        p = self.predictor
+        return web.json_response(
+            {
+                "samples_seen": p.samples_seen,
+                "ttft_buckets": len(p.ttft.buckets),
+                "tpot_buckets": len(p.tpot.buckets),
+            }
+        )
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/samples", self.handle_samples)
+        app.router.add_get("/v1/model-info", self.handle_model_info)
+        app.router.add_get("/healthz", self.handle_health)
+
+        async def _lifecycle(app):
+            self._task = asyncio.ensure_future(self._flush_loop())
+            yield
+            self._task.cancel()
+            if self._dirty:
+                self.flush()
+
+        app.cleanup_ctx.append(_lifecycle)
+        return app
+
+
+class PredictionServer:
+    def __init__(
+        self,
+        model_dir: str,
+        cfg: PredictorConfig | None = None,
+        reload_interval_s: float = 5.0,
+    ) -> None:
+        self.model_dir = model_dir
+        self.predictor = LatencyPredictor(cfg)
+        self.reload_interval_s = reload_interval_s
+        self._mtime = 0.0
+        self._task: asyncio.Task | None = None
+
+    def reload_if_changed(self) -> bool:
+        path = os.path.join(self.model_dir, MODEL_FILE)
+        try:
+            mtime = os.stat(path).st_mtime
+        except FileNotFoundError:
+            return False
+        if mtime <= self._mtime:
+            return False
+        with open(path) as f:
+            self.predictor.loads(f.read())
+        self._mtime = mtime
+        return True
+
+    async def _reload_loop(self) -> None:
+        while True:
+            try:
+                if self.reload_if_changed():
+                    log.info("reloaded latency model (mtime %s)", self._mtime)
+            except Exception:
+                log.exception("model reload failed")
+            await asyncio.sleep(self.reload_interval_s)
+
+    async def handle_predict(self, request: web.Request) -> web.Response:
+        payload = await request.json()
+        out: dict = {}
+        tf = payload.get("ttft_features")
+        if tf is not None:
+            ms, src = self.predictor.predict_ttft(tf)
+            out["ttft_ms"], out["ttft_source"] = ms, src
+        pf = payload.get("tpot_features")
+        if pf is not None:
+            ms, src = self.predictor.predict_tpot(pf)
+            out["tpot_ms"], out["tpot_source"] = ms, src
+        return web.json_response(out)
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "model_mtime": self._mtime})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/predict", self.handle_predict)
+        app.router.add_get("/healthz", self.handle_health)
+
+        async def _lifecycle(app):
+            self.reload_if_changed()
+            self._task = asyncio.ensure_future(self._reload_loop())
+            yield
+            self._task.cancel()
+
+        app.cleanup_ctx.append(_lifecycle)
+        return app
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("llmd-tpu latency predictor sidecar")
+    ap.add_argument("role", choices=["train", "predict"])
+    ap.add_argument("--model-dir", default="/tmp/llmd-latency-models")
+    ap.add_argument("--port", type=int, default=8100)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = (
+        TrainingServer(args.model_dir)
+        if args.role == "train"
+        else PredictionServer(args.model_dir)
+    )
+    web.run_app(server.build_app(), port=args.port)
+
+
+if __name__ == "__main__":
+    main()

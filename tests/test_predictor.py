@@ -1,0 +1,226 @@
+"""Latency predictor tests: model math, sidecar servers, EPP integration.
+
+Covers the reference latency-predictor contract
+(docs/architecture/advanced/latency-predictor.md:20-100): stratified
+training, heuristic fallback when cold, trainer→shared-volume→predictor
+flow, and the predicted-latency routing plugins (scorer / SLO filter /
+admitter) plus the completion-feedback loop.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from llmd_tpu.epp.plugins import create_plugin
+from llmd_tpu.epp.predicted_latency import (
+    SCRATCH_TPOT,
+    SCRATCH_TTFT,
+    LatencySloAdmitter,
+    PredictedLatencyProducer,
+    PredictorClient,
+)
+from llmd_tpu.epp.types import (
+    KV_CACHE_USAGE,
+    RUNNING_REQUESTS,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
+from llmd_tpu.predictor.model import (
+    LatencyPredictor,
+    PredictorConfig,
+    ttft_features,
+    tpot_features,
+)
+from llmd_tpu.predictor.server import PredictionServer, TrainingServer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def synth_ttft(rng, n=400):
+    """Synthetic workload: ttft = 10 + 0.05*input*(1-prefix) + 30*queue."""
+    rows = []
+    for _ in range(n):
+        kv = rng.uniform(0, 1)
+        queue = rng.integers(0, 8)
+        running = rng.integers(0, 16)
+        inp = rng.integers(64, 4096)
+        prefix = rng.choice([0.0, 0.25, 0.5, 0.75, 1.0])
+        tif = rng.integers(0, 20000)
+        y = 10 + 0.05 * inp * (1 - prefix) + 30 * queue + rng.normal(0, 2)
+        rows.append((ttft_features(kv, queue, running, inp, prefix, tif), y))
+    return rows
+
+
+def test_model_learns_and_beats_heuristic():
+    rng = np.random.default_rng(0)
+    p = LatencyPredictor(PredictorConfig(min_bucket_samples=10))
+    rows = synth_ttft(rng)
+    cold_errs = [abs(p.predict_ttft(f)[0] - y) for f, y in rows[:50]]
+    for f, y in rows:
+        p.observe_ttft(f, y)
+    test_rows = synth_ttft(rng, n=100)
+    errs, sources = [], set()
+    for f, y in test_rows:
+        pred, src = p.predict_ttft(f)
+        errs.append(abs(pred - y))
+        sources.add(src)
+    assert np.mean(errs) < 25.0, f"trained MAE {np.mean(errs)} too high"
+    assert np.mean(errs) < np.mean(cold_errs)
+    assert "bucket" in sources or "global" in sources
+
+
+def test_cold_model_uses_heuristic():
+    p = LatencyPredictor()
+    ms, src = p.predict_ttft(ttft_features(0.5, 2, 4, 1000, 0.0, 0))
+    assert src == "heuristic" and ms > 0
+    ms, src = p.predict_tpot(tpot_features(0.5, 4, 1000, 0))
+    assert src == "heuristic" and ms > 0
+
+
+def test_serialization_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    trainer = TrainingServer(str(tmp_path))
+    for f, y in synth_ttft(rng):
+        trainer.predictor.observe_ttft(f, y)
+    trainer.flush()
+    pred = PredictionServer(str(tmp_path))
+    assert pred.reload_if_changed()
+    f = ttft_features(0.3, 1, 2, 512, 0.5, 100)
+    a = trainer.predictor.predict_ttft(f)
+    b = pred.predictor.predict_ttft(f)
+    assert a[1] == b[1] and abs(a[0] - b[0]) < 1e-6
+    # unchanged file -> no reload
+    assert not pred.reload_if_changed()
+
+
+async def test_sidecar_http_flow(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    trainer = TrainingServer(str(tmp_path), flush_interval_s=0.05)
+    tc = TestClient(TestServer(trainer.build_app()))
+    await tc.start_server()
+    rng = np.random.default_rng(2)
+    samples = [
+        {"features": f, "ms": y} for f, y in synth_ttft(rng, n=200)
+    ]
+    r = await tc.post("/v1/samples", json={"ttft": samples})
+    assert (await r.json())["ingested"] == 200
+    await asyncio.sleep(0.15)  # let the flush loop write
+
+    pred = PredictionServer(str(tmp_path), reload_interval_s=0.05)
+    pc = TestClient(TestServer(pred.build_app()))
+    await pc.start_server()
+    r = await pc.post(
+        "/v1/predict",
+        json={
+            "ttft_features": ttft_features(0.2, 1, 2, 1024, 0.0, 0),
+            "tpot_features": tpot_features(0.2, 2, 1024, 0),
+        },
+    )
+    d = await r.json()
+    assert d["ttft_ms"] > 0 and d["tpot_ms"] > 0
+    assert d["ttft_source"] in ("bucket", "global")
+    info = await (await tc.get("/v1/model-info")).json()
+    assert info["samples_seen"] == 200
+    await pc.close()
+    await tc.close()
+
+
+def mk_pod(addr, kv=0.1, queue=0, running=0):
+    return Endpoint(
+        address=addr,
+        attrs={KV_CACHE_USAGE: kv, WAITING_QUEUE_SIZE: queue, RUNNING_REQUESTS: running},
+    )
+
+
+async def test_producer_and_scorer_prefer_idle_pod():
+    producer = PredictedLatencyProducer()
+    idle = mk_pod("10.0.0.1:8000")
+    busy = mk_pod("10.0.0.2:8000", kv=0.9, queue=8, running=16)
+    req = LLMRequest(request_id="r", prompt_text="x" * 4000)
+    await producer.produce(req, [idle, busy])
+    assert req.scratch[SCRATCH_TTFT][idle.address] < req.scratch[SCRATCH_TTFT][busy.address]
+    scorer = create_plugin("latency-scorer")
+    scores = scorer.score(req, [idle, busy])
+    assert scores[idle.address] > scores[busy.address]
+
+
+async def test_slo_filter_and_admitter():
+    producer = PredictedLatencyProducer()
+    idle = mk_pod("10.0.0.1:8000")
+    busy = mk_pod("10.0.0.2:8000", kv=0.9, queue=20, running=32)
+    req = LLMRequest(request_id="r", prompt_text="x" * 400, ttft_slo_ms=200.0)
+    await producer.produce(req, [idle, busy])
+    f = create_plugin("slo-headroom-tier-filter")
+    kept = f.filter(req, [idle, busy])
+    assert idle in kept and busy not in kept
+    # no-SLO requests pass through
+    req2 = LLMRequest(request_id="r2", prompt_text="hi")
+    assert f.filter(req2, [idle, busy]) == [idle, busy]
+
+    class Store:
+        def __init__(self, pods):
+            self._pods = pods
+
+        def list(self):
+            return self._pods
+
+    adm = LatencySloAdmitter(Store([busy]), slack=1.0)
+    tight = LLMRequest(
+        request_id="r3", prompt_text="x" * 40000, ttft_slo_ms=1.0, priority=-1
+    )
+    assert adm.admit(tight) == "slo-unattainable"
+    # protected priority is never shed
+    crit = LLMRequest(
+        request_id="r4", prompt_text="x" * 40000, ttft_slo_ms=1.0, priority=1
+    )
+    assert adm.admit(crit) is None
+    # attainable SLO admitted
+    ok = LLMRequest(request_id="r5", prompt_text="hi", ttft_slo_ms=60000.0)
+    assert LatencySloAdmitter(Store([idle])).admit(ok) is None
+
+
+async def test_attach_predicted_latency_wires_router():
+    from llmd_tpu.epp.config import (
+        PREDICTED_LATENCY_CONFIG,
+        build_flow_control,
+        build_scheduler,
+    )
+    from llmd_tpu.epp.datalayer import EndpointStore
+    from llmd_tpu.epp.predicted_latency import attach_predicted_latency
+    from llmd_tpu.epp.server import Router
+
+    store = EndpointStore()
+    store.upsert(mk_pod("10.0.0.1:8000"))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(PREDICTED_LATENCY_CONFIG),
+        flow_control=build_flow_control(PREDICTED_LATENCY_CONFIG),
+    )
+    producer = attach_predicted_latency(router)
+    assert producer in router.producers
+    assert producer.on_complete in router.completion_observers
+    assert any(isinstance(a, LatencySloAdmitter) for a in router.admitters)
+    # the scheduler picks through the latency scorer without predictions
+    req = LLMRequest(request_id="r", prompt_text="hello")
+    result = router.scheduler.schedule(req, store.list())
+    assert result.primary.address == "10.0.0.1:8000"
+
+
+async def test_completion_feedback_trains_local_model():
+    client = PredictorClient()
+    producer = PredictedLatencyProducer(client)
+    pod = mk_pod("10.0.0.1:8000")
+    before = client.predictor.samples_seen
+    for i in range(5):
+        req = LLMRequest(request_id=f"r{i}", prompt_text="hello world")
+        await producer.produce(req, [pod])
+        await producer.on_complete(req, pod, ttft_ms=55.0, tpot_ms=9.0)
+    assert client.predictor.samples_seen == before + 10  # 5 ttft + 5 tpot
