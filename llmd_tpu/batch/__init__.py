@@ -1,0 +1,44 @@
+"""Batch plane: OpenAI-compatible Batch Gateway + Async Processor.
+
+Re-implements the reference's batch components TPU-side-agnostically
+(they sit above the engine):
+  - Batch Gateway (docs/architecture/advanced/batch/batch-gateway.md:12-87):
+    API server (/v1/files, /v1/batches), metadata store, SLO-priority queue,
+    file store, batch processor with two-level concurrency, crash recovery,
+    GC, tenant isolation.
+  - Async Processor (docs/architecture/advanced/batch/async-processor.md:5-39):
+    queue -> gate -> dispatch worker pool with deadline propagation and
+    exponential backoff.
+
+Backends: sqlite3 (stdlib) plays the PostgreSQL role for metadata and the
+Redis sorted-set role for the priority queue (single-node, durable);
+filesystem file store with tenant-hashed paths plays S3. Redis/S3 proper
+are multi-replica deployment options gated behind optional imports.
+"""
+
+from llmd_tpu.batch.store import BatchStore, FileStore, now_s
+from llmd_tpu.batch.gateway import build_gateway_app
+from llmd_tpu.batch.processor import BatchProcessor, ProcessorConfig
+from llmd_tpu.batch.asyncproc import (
+    AsyncProcessor,
+    AsyncProcessorConfig,
+    ConstantGate,
+    BudgetFileGate,
+    SaturationGate,
+    DeadlineQueue,
+)
+
+__all__ = [
+    "BatchStore",
+    "FileStore",
+    "now_s",
+    "build_gateway_app",
+    "BatchProcessor",
+    "ProcessorConfig",
+    "AsyncProcessor",
+    "AsyncProcessorConfig",
+    "ConstantGate",
+    "BudgetFileGate",
+    "SaturationGate",
+    "DeadlineQueue",
+]

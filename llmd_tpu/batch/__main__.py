@@ -1,0 +1,67 @@
+"""Run the Batch Gateway: API server + processor + GC in one process.
+
+    python -m llmd_tpu.batch --router-url http://localhost:8080 \
+        --port 8200 --data-dir /var/lib/llmd-batch
+
+Single-node deployment shape (sqlite metadata + FS file store). For
+multi-replica, run N API servers against shared storage and M processors;
+the queue claim UPDATE keeps job pickup exclusive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from pathlib import Path
+
+from aiohttp import web
+
+from llmd_tpu.batch.gateway import build_gateway_app
+from llmd_tpu.batch.processor import BatchProcessor, GarbageCollector, ProcessorConfig
+from llmd_tpu.batch.store import BatchStore, FileStore
+
+
+async def amain(args: argparse.Namespace) -> None:
+    data = Path(args.data_dir)
+    data.mkdir(parents=True, exist_ok=True)
+    store = BatchStore(data / "batch.db")
+    files = FileStore(data / "files")
+    app = build_gateway_app(store, files)
+    proc = BatchProcessor(
+        store, files,
+        ProcessorConfig(
+            router_url=args.router_url,
+            global_concurrency=args.global_concurrency,
+            per_model_concurrency=args.per_model_concurrency,
+        ),
+    )
+    gc = GarbageCollector(store, files)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port)
+    await site.start()
+    logging.info("batch gateway on %s:%d -> router %s",
+                 args.host, args.port, args.router_url)
+    try:
+        await asyncio.gather(proc.run(), gc.run())
+    finally:
+        await runner.cleanup()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="llmd-tpu batch gateway")
+    p.add_argument("--router-url", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--data-dir", default="/tmp/llmd-batch")
+    p.add_argument("--global-concurrency", type=int, default=64)
+    p.add_argument("--per-model-concurrency", type=int, default=16)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

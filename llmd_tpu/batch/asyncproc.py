@@ -1,0 +1,411 @@
+"""Async Processor: queue → gate → dispatch worker pool.
+
+Reference behavior (async-processor.md):
+  1. Poll — workers pull requests from one or more message queues.
+  2. Gate — each request passes a dispatch gate; closed gate (budget 0)
+     means wait.
+  3. Dispatch — HTTP to the router with deadline propagation.
+  4. Result — success lands on a result queue; retryable failure (429/5xx,
+     connection errors) re-queues with exponential backoff (base 2s, max
+     60s, jitter); fatal errors (4xx payload) are not retried.
+
+Gates (async-processor.md "Dispatch Gates"): `constant` (always open),
+`budget-file` (reads an externally-written budget number — the Redis-key
+budget gate, with the key on the filesystem so no Redis is required;
+a Redis backend can layer on the same interface), `saturation` (polls a
+/metrics endpoint and opens while a saturation gauge is below threshold —
+the prometheus-saturation gate), `budget-metrics` (capacity − inflight
+from downstream metrics — the prometheus-budget gate).
+
+Queue: DeadlineQueue, a priority queue ordered by deadline (the Redis
+sorted-set analogue) with optional sqlite persistence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import json
+import logging
+import random
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Awaitable, Callable
+
+import aiohttp
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(order=True)
+class QueuedRequest:
+    deadline: float
+    seq: int = field(compare=True)
+    payload: dict = field(compare=False, default_factory=dict)
+    url_path: str = field(compare=False, default="/v1/completions")
+    request_id: str = field(compare=False, default="")
+    attempts: int = field(compare=False, default=0)
+    not_before: float = field(compare=False, default=0.0)
+
+
+class DeadlineQueue:
+    """Deadline-ordered priority queue; optionally persisted to sqlite so
+    queued work survives restarts (the Redis sorted set is persisted too).
+    """
+
+    def __init__(self, db_path: str | Path | None = None) -> None:
+        self._heap: list[QueuedRequest] = []
+        self._seq = itertools.count()
+        self._cond = asyncio.Condition()
+        self._db: sqlite3.Connection | None = None
+        self._db_lock = threading.Lock()
+        if db_path is not None:
+            self._db = sqlite3.connect(str(db_path), check_same_thread=False)
+            with self._db_lock, self._db:
+                self._db.execute(
+                    "CREATE TABLE IF NOT EXISTS q (request_id TEXT PRIMARY "
+                    "KEY, deadline REAL, url_path TEXT, payload TEXT, "
+                    "attempts INTEGER)"
+                )
+            for row in self._db.execute("SELECT * FROM q"):
+                heapq.heappush(
+                    self._heap,
+                    QueuedRequest(
+                        deadline=row[1], seq=next(self._seq),
+                        payload=json.loads(row[3]), url_path=row[2],
+                        request_id=row[0], attempts=row[4],
+                    ),
+                )
+
+    def _persist(self, req: QueuedRequest) -> None:
+        if self._db is None:
+            return
+        with self._db_lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO q VALUES (?,?,?,?,?)",
+                (req.request_id, req.deadline, req.url_path,
+                 json.dumps(req.payload), req.attempts),
+            )
+
+    def _unpersist(self, request_id: str) -> None:
+        if self._db is None:
+            return
+        with self._db_lock, self._db:
+            self._db.execute("DELETE FROM q WHERE request_id=?", (request_id,))
+
+    async def put(
+        self,
+        payload: dict,
+        deadline: float,
+        url_path: str = "/v1/completions",
+        request_id: str = "",
+        attempts: int = 0,
+        not_before: float = 0.0,
+    ) -> None:
+        req = QueuedRequest(
+            deadline=deadline, seq=next(self._seq), payload=payload,
+            url_path=url_path, request_id=request_id or f"areq-{next(self._seq)}",
+            attempts=attempts, not_before=not_before,
+        )
+        self._persist(req)
+        async with self._cond:
+            heapq.heappush(self._heap, req)
+            self._cond.notify()
+
+    async def get(self) -> QueuedRequest:
+        """Earliest-deadline request whose backoff delay has elapsed."""
+        while True:
+            async with self._cond:
+                while not self._heap:
+                    await self._cond.wait()
+                now = time.monotonic()
+                ready = [r for r in self._heap if r.not_before <= now]
+                if ready:
+                    req = min(ready)
+                    self._heap.remove(req)
+                    heapq.heapify(self._heap)
+                    return req
+                wait = min(r.not_before for r in self._heap) - now
+            await asyncio.sleep(max(wait, 0.01))
+
+    def ack(self, req: QueuedRequest) -> None:
+        self._unpersist(req.request_id)
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+# ---- dispatch gates ----
+
+
+class ConstantGate:
+    """Always open (async-processor.md: `constant`)."""
+
+    async def acquire(self) -> None:
+        return None
+
+    def release(self) -> None:
+        return None
+
+
+class BudgetFileGate:
+    """External budget number in a file (the Redis-key budget gate shape:
+    an outside controller writes how many in-flight dispatches are allowed;
+    0 closes the gate)."""
+
+    def __init__(self, path: str | Path, poll_interval_s: float = 0.5) -> None:
+        self.path = Path(path)
+        self.poll_interval_s = poll_interval_s
+        self._inflight = 0
+
+    def _budget(self) -> int:
+        try:
+            return int(float(self.path.read_text().strip()))
+        except (OSError, ValueError):
+            return 0
+
+    async def acquire(self) -> None:
+        while self._inflight >= self._budget():
+            await asyncio.sleep(self.poll_interval_s)
+        self._inflight += 1
+
+    def release(self) -> None:
+        self._inflight = max(0, self._inflight - 1)
+
+
+async def _scrape_gauge(session: aiohttp.ClientSession, url: str,
+                        metric: str) -> float | None:
+    try:
+        async with session.get(url) as r:
+            text = await r.text()
+    except Exception:
+        return None
+    total, n = 0.0, 0
+    for line in text.splitlines():
+        if line.startswith(metric) and not line.startswith("#"):
+            try:
+                total += float(line.rsplit(None, 1)[-1])
+                n += 1
+            except ValueError:
+                continue
+    return (total / n) if n else None
+
+
+class SaturationGate:
+    """Open while a saturation gauge scraped from /metrics is below a
+    threshold (async-processor.md: `prometheus-saturation`). Fail-open on
+    scrape outage after `outage_grace_s` so a dead monitoring stack doesn't
+    wedge the batch plane."""
+
+    def __init__(
+        self,
+        metrics_url: str,
+        metric: str = "llmd_kv_cache_utilization",
+        threshold: float = 0.8,
+        poll_interval_s: float = 1.0,
+        outage_grace_s: float = 30.0,
+    ) -> None:
+        self.metrics_url = metrics_url
+        self.metric = metric
+        self.threshold = threshold
+        self.poll_interval_s = poll_interval_s
+        self.outage_grace_s = outage_grace_s
+        self._session: aiohttp.ClientSession | None = None
+        self._last_ok = time.monotonic()
+
+    async def acquire(self) -> None:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5)
+            )
+        while True:
+            val = await _scrape_gauge(self._session, self.metrics_url, self.metric)
+            now = time.monotonic()
+            if val is None:
+                if now - self._last_ok > self.outage_grace_s:
+                    return  # fail open
+            else:
+                self._last_ok = now
+                if val < self.threshold:
+                    return
+            await asyncio.sleep(self.poll_interval_s)
+
+    def release(self) -> None:
+        return None
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+
+class BudgetMetricsGate(SaturationGate):
+    """budget = capacity_metric − inflight_metric; dispatch while our own
+    in-flight count stays under it (async-processor.md: `prometheus-budget`).
+    """
+
+    def __init__(self, metrics_url: str,
+                 capacity_metric: str = "llmd_max_running_requests",
+                 inflight_metric: str = "llmd_running_requests",
+                 **kw) -> None:
+        super().__init__(metrics_url, metric=inflight_metric, **kw)
+        self.capacity_metric = capacity_metric
+        self._inflight = 0
+
+    async def acquire(self) -> None:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5)
+            )
+        while True:
+            cap = await _scrape_gauge(self._session, self.metrics_url,
+                                      self.capacity_metric)
+            used = await _scrape_gauge(self._session, self.metrics_url,
+                                       self.metric)
+            now = time.monotonic()
+            if cap is None or used is None:
+                if now - self._last_ok > self.outage_grace_s:
+                    self._inflight += 1
+                    return
+            else:
+                self._last_ok = now
+                if self._inflight < cap - used:
+                    self._inflight += 1
+                    return
+            await asyncio.sleep(self.poll_interval_s)
+
+    def release(self) -> None:
+        self._inflight = max(0, self._inflight - 1)
+
+
+# ---- the processor ----
+
+
+@dataclass
+class AsyncProcessorConfig:
+    router_url: str
+    workers: int = 8  # async-processor.md: default 8
+    backoff_base_s: float = 2.0
+    backoff_max_s: float = 60.0
+    max_attempts: int = 8
+    request_timeout_s: float = 300.0
+
+
+RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+
+class AsyncProcessor:
+    """Worker pool pulling from a DeadlineQueue through a gate."""
+
+    def __init__(
+        self,
+        queue: DeadlineQueue,
+        cfg: AsyncProcessorConfig,
+        gate=None,
+        on_result: Callable[[QueuedRequest, dict], Awaitable[None]] | None = None,
+    ) -> None:
+        self.queue = queue
+        self.cfg = cfg
+        self.gate = gate or ConstantGate()
+        self.on_result = on_result
+        self.results: asyncio.Queue = asyncio.Queue()
+        self._stop = asyncio.Event()
+        self._session: aiohttp.ClientSession | None = None
+        self.stats = {
+            "dispatched": 0, "succeeded": 0, "failed": 0, "retried": 0,
+            "deadline_exceeded": 0, "shedded": 0,
+        }
+
+    async def run(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.cfg.request_timeout_s)
+        )
+        workers = [
+            asyncio.create_task(self._worker(i)) for i in range(self.cfg.workers)
+        ]
+        await self._stop.wait()
+        for w in workers:
+            w.cancel()
+        await asyncio.gather(*workers, return_exceptions=True)
+        await self._session.close()
+        if hasattr(self.gate, "close"):
+            await self.gate.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def _worker(self, idx: int) -> None:
+        while True:
+            req = await self.queue.get()
+            # Deadline enforcement: abandon work that can't finish in time.
+            if time.time() >= req.deadline:
+                self.stats["deadline_exceeded"] += 1
+                self.queue.ack(req)
+                await self._emit(req, {"error": "deadline_exceeded"})
+                continue
+            await self.gate.acquire()
+            try:
+                await self._dispatch(req)
+            finally:
+                self.gate.release()
+
+    async def _dispatch(self, req: QueuedRequest) -> None:
+        url = self.cfg.router_url.rstrip("/") + req.url_path
+        remaining = max(req.deadline - time.time(), 0.1)
+        headers = {
+            # Deadline propagation to the router/engine.
+            "x-llm-d-deadline-ms": str(int(remaining * 1000)),
+            "x-request-id": req.request_id,
+        }
+        self.stats["dispatched"] += 1
+        try:
+            async with self._session.post(
+                url, json=req.payload, headers=headers,
+                timeout=aiohttp.ClientTimeout(total=remaining),
+            ) as r:
+                if r.status < 400:
+                    body = await r.json()
+                    self.stats["succeeded"] += 1
+                    self.queue.ack(req)
+                    await self._emit(req, {"status": r.status, "body": body})
+                    return
+                retryable = r.status in RETRYABLE_STATUSES
+                err = {"status": r.status, "body": (await r.text())[:1000]}
+        except asyncio.TimeoutError:
+            retryable, err = True, {"error": "timeout"}
+        except aiohttp.ClientError as e:
+            retryable, err = True, {"error": f"connection: {e}"}
+
+        if not retryable or req.attempts + 1 >= self.cfg.max_attempts:
+            self.stats["failed" if not retryable else "shedded"] += 1
+            self.queue.ack(req)
+            await self._emit(req, {"error": "fatal", **err})
+            return
+        # Exponential backoff with jitter: 2s -> 60s.
+        delay = min(
+            self.cfg.backoff_base_s * (2 ** req.attempts),
+            self.cfg.backoff_max_s,
+        ) * (0.5 + random.random())
+        self.stats["retried"] += 1
+        self.queue.ack(req)
+        await self.queue.put(
+            req.payload, req.deadline, req.url_path, req.request_id,
+            attempts=req.attempts + 1,
+            not_before=time.monotonic() + delay,
+        )
+
+    async def _emit(self, req: QueuedRequest, result: dict) -> None:
+        if self.on_result is not None:
+            await self.on_result(req, result)
+        else:
+            await self.results.put((req, result))
+
+    def metrics_text(self) -> str:
+        lines = [
+            f"llmd_async_{k}_total {v}" for k, v in self.stats.items()
+        ]
+        lines.append(f"llmd_async_queue_depth {len(self.queue)}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,263 @@
+"""Batch Gateway API server: OpenAI-compatible /v1/files + /v1/batches.
+
+Endpoint surface per batch-gateway.md "API Server":
+  POST /v1/files            upload JSONL input (multipart or raw body)
+  GET  /v1/files            list
+  GET  /v1/files/{id}       metadata
+  GET  /v1/files/{id}/content
+  DELETE /v1/files/{id}
+  POST /v1/batches          create job from an uploaded input file
+  GET  /v1/batches/{id}     status + request_counts + output file ids
+  POST /v1/batches/{id}/cancel
+  GET  /v1/batches          list
+
+Auth/tenancy: tenant id comes from a configurable header (default
+`x-llm-d-tenant`, falling back to "default") — the gateway authenticates,
+the inference route authorizes (batch-gateway.md "Authentication and
+Multi-Tenancy"). Every query is tenant-filtered; file content paths are
+tenant-hashed in the FileStore.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+
+from aiohttp import web
+
+from llmd_tpu.batch.store import TERMINAL, BatchStore, FileStore, now_s
+
+log = logging.getLogger(__name__)
+
+TENANT_HEADER = "x-llm-d-tenant"
+SUPPORTED_ENDPOINTS = ("/v1/completions", "/v1/chat/completions", "/v1/embeddings")
+MAX_FILE_BYTES = 512 * 1024 * 1024
+MAX_REQUESTS_PER_FILE = 50_000
+
+
+def _err(status: int, message: str, code: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": code}}, status=status
+    )
+
+
+def parse_completion_window(s: str | float | int) -> float:
+    """'24h' | '30m' | '90s' | number-of-seconds -> seconds."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    m = re.fullmatch(r"(\d+)([smhd])", s.strip())
+    if not m:
+        raise ValueError(f"bad completion_window {s!r}")
+    mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
+    return int(m.group(1)) * mult
+
+
+def validate_batch_lines(data: bytes, endpoint_hint: str | None = None) -> int:
+    """Validate JSONL input file; returns request count.
+
+    Each line must be {"custom_id": str, "method": "POST", "url": <supported
+    endpoint>, "body": {...}} with unique custom_ids (the OpenAI batch input
+    contract the reference gateway validates on upload).
+    """
+    count = 0
+    seen: set[str] = set()
+    for ln, raw in enumerate(data.splitlines(), 1):
+        if not raw.strip():
+            continue
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"line {ln}: not valid JSON ({e})") from None
+        cid = obj.get("custom_id")
+        if not isinstance(cid, str) or not cid:
+            raise ValueError(f"line {ln}: missing custom_id")
+        if cid in seen:
+            raise ValueError(f"line {ln}: duplicate custom_id {cid!r}")
+        seen.add(cid)
+        if obj.get("method", "POST") != "POST":
+            raise ValueError(f"line {ln}: method must be POST")
+        url = obj.get("url")
+        if url not in SUPPORTED_ENDPOINTS:
+            raise ValueError(
+                f"line {ln}: url {url!r} not in {SUPPORTED_ENDPOINTS}"
+            )
+        if endpoint_hint and url != endpoint_hint:
+            raise ValueError(
+                f"line {ln}: url {url!r} != batch endpoint {endpoint_hint!r}"
+            )
+        if not isinstance(obj.get("body"), dict):
+            raise ValueError(f"line {ln}: missing body object")
+        if count >= MAX_REQUESTS_PER_FILE:
+            raise ValueError(f"more than {MAX_REQUESTS_PER_FILE} requests")
+        count += 1
+    if count == 0:
+        raise ValueError("empty batch input file")
+    return count
+
+
+class Gateway:
+    def __init__(
+        self,
+        store: BatchStore,
+        files: FileStore,
+        tenant_header: str = TENANT_HEADER,
+        file_expiry_s: float | None = 30 * 86400,
+    ) -> None:
+        self.store = store
+        self.files = files
+        self.tenant_header = tenant_header
+        self.file_expiry_s = file_expiry_s
+
+    def _tenant(self, request: web.Request) -> str:
+        return request.headers.get(self.tenant_header, "default")
+
+    # ---- files ----
+
+    async def upload_file(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        filename, purpose, data = "upload.jsonl", "batch", b""
+        if request.content_type == "multipart/form-data":
+            async for part in await request.multipart():
+                if part.name == "file":
+                    filename = part.filename or filename
+                    data = await part.read(decode=False)
+                elif part.name == "purpose":
+                    purpose = (await part.text()).strip()
+        else:
+            data = await request.read()
+            purpose = request.query.get("purpose", "batch")
+            filename = request.query.get("filename", filename)
+        if len(data) > MAX_FILE_BYTES:
+            return _err(413, f"file exceeds {MAX_FILE_BYTES} bytes")
+        if purpose == "batch":
+            try:
+                validate_batch_lines(data)
+            except ValueError as e:
+                return _err(400, f"invalid batch input file: {e}")
+        expires = now_s() + self.file_expiry_s if self.file_expiry_s else None
+        meta = self.store.create_file(
+            tenant, filename, purpose, len(data), expires_at=expires
+        )
+        self.files.write(tenant, meta.id, data)
+        return web.json_response(meta.to_openai())
+
+    async def list_files(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        metas = self.store.list_files(tenant)
+        return web.json_response(
+            {"object": "list", "data": [m.to_openai() for m in metas]}
+        )
+
+    async def get_file(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        meta = self.store.get_file(tenant, request.match_info["id"])
+        if meta is None:
+            return _err(404, "file not found", "not_found_error")
+        return web.json_response(meta.to_openai())
+
+    async def file_content(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        fid = request.match_info["id"]
+        meta = self.store.get_file(tenant, fid)
+        if meta is None or not self.files.exists(tenant, fid):
+            return _err(404, "file not found", "not_found_error")
+        return web.Response(
+            body=self.files.read(tenant, fid),
+            content_type="application/jsonl",
+        )
+
+    async def delete_file(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        fid = request.match_info["id"]
+        if not self.store.delete_file(tenant, fid):
+            return _err(404, "file not found", "not_found_error")
+        self.files.delete(tenant, fid)
+        return web.json_response({"id": fid, "object": "file", "deleted": True})
+
+    # ---- batches ----
+
+    async def create_batch(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err(400, "body must be JSON")
+        input_file_id = body.get("input_file_id")
+        endpoint = body.get("endpoint")
+        if endpoint not in SUPPORTED_ENDPOINTS:
+            return _err(400, f"endpoint must be one of {SUPPORTED_ENDPOINTS}")
+        meta = self.store.get_file(tenant, input_file_id or "")
+        if meta is None:
+            return _err(404, f"input file {input_file_id!r} not found",
+                        "not_found_error")
+        try:
+            window = parse_completion_window(body.get("completion_window", "24h"))
+        except ValueError as e:
+            return _err(400, str(e))
+        job = self.store.create_batch(
+            tenant, endpoint, input_file_id, window,
+            metadata=body.get("metadata") or {},
+        )
+        return web.json_response(job.to_openai())
+
+    async def get_batch(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        job = self.store.get_batch(tenant, request.match_info["id"])
+        if job is None:
+            return _err(404, "batch not found", "not_found_error")
+        return web.json_response(job.to_openai())
+
+    async def list_batches(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        jobs = self.store.list_batches(tenant)
+        return web.json_response(
+            {"object": "list", "data": [j.to_openai() for j in jobs]}
+        )
+
+    async def cancel_batch(self, request: web.Request) -> web.Response:
+        tenant = self._tenant(request)
+        job = self.store.get_batch(tenant, request.match_info["id"])
+        if job is None:
+            return _err(404, "batch not found", "not_found_error")
+        if job.status in TERMINAL:
+            return _err(409, f"batch already {job.status}", "conflict_error")
+        if job.status in ("validating",):
+            # Not picked up yet: cancel immediately and drop from the queue.
+            self.store.remove_from_queue(job.id)
+            self.store.update_batch(
+                job.id, status="cancelled", cancelling_at=now_s(),
+                cancelled_at=now_s(),
+            )
+        else:
+            self.store.update_batch(job.id, cancelling_at=now_s(),
+                                    status="cancelling")
+            self.store.request_cancel(job.id)
+        job = self.store.get_batch(tenant, job.id)
+        return web.json_response(job.to_openai())
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "queue_depth": self.store.queue_depth()})
+
+
+def build_gateway_app(
+    store: BatchStore, files: FileStore, tenant_header: str = TENANT_HEADER
+) -> web.Application:
+    gw = Gateway(store, files, tenant_header)
+    app = web.Application(client_max_size=MAX_FILE_BYTES + 1024)
+    app["gateway"] = gw
+    app.add_routes(
+        [
+            web.post("/v1/files", gw.upload_file),
+            web.get("/v1/files", gw.list_files),
+            web.get("/v1/files/{id}", gw.get_file),
+            web.get("/v1/files/{id}/content", gw.file_content),
+            web.delete("/v1/files/{id}", gw.delete_file),
+            web.post("/v1/batches", gw.create_batch),
+            web.get("/v1/batches", gw.list_batches),
+            web.get("/v1/batches/{id}", gw.get_batch),
+            web.post("/v1/batches/{id}/cancel", gw.cancel_batch),
+            web.get("/health", gw.health),
+        ]
+    )
+    return app

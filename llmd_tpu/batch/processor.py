@@ -1,0 +1,324 @@
+"""Batch Processor: poll → ingest → execute → finalize, with crash recovery.
+
+Implements the reference's processor loop (batch-gateway.md "Batch
+Processor"):
+  1. Poll the SLO-priority queue for the next job.
+  2. Ingest the input JSONL — parse model ids, group requests by model,
+     build per-model execution plans.
+  3. Execute plans concurrently: per-model workers send individual
+     inference requests to the router under two-level concurrency control
+     (global cap + per-model cap) and append results to the output file.
+  4. Track progress and listen for cancellation events.
+  5. Finalize: register output/error files, flip terminal status.
+
+Crash recovery (batch-gateway.md "Crash Recovery"): on startup, scan for
+jobs left `in_progress` by a dead instance — if a partial output file
+exists, register it and mark the job failed; otherwise re-enqueue for a
+full retry. Recovery concurrency is capped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+from dataclasses import dataclass, field
+
+import aiohttp
+
+from llmd_tpu.batch.store import BatchStore, FileStore, now_s
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ProcessorConfig:
+    router_url: str  # base URL of the llm-d router (OpenAI surface)
+    global_concurrency: int = 64
+    per_model_concurrency: int = 16
+    recovery_concurrency: int = 4
+    poll_interval_s: float = 0.5
+    request_timeout_s: float = 600.0
+    # Headers forwarded verbatim from batch metadata to inference requests
+    # so the router can authorize the end user per-request.
+    passthrough_headers: tuple[str, ...] = ("authorization", "x-llm-d-fairness-id")
+
+
+@dataclass
+class _Plan:
+    model: str
+    lines: list[dict] = field(default_factory=list)
+
+
+class BatchProcessor:
+    def __init__(
+        self, store: BatchStore, files: FileStore, cfg: ProcessorConfig
+    ) -> None:
+        self.store = store
+        self.files = files
+        self.cfg = cfg
+        self.instance_id = f"proc-{uuid.uuid4().hex[:8]}"
+        self._global_sem = asyncio.Semaphore(cfg.global_concurrency)
+        self._session: aiohttp.ClientSession | None = None
+        self._stop = asyncio.Event()
+        self._tasks: set[asyncio.Task] = set()
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.cfg.request_timeout_s)
+            )
+        return self._session
+
+    # ---- lifecycle ----
+
+    async def run(self) -> None:
+        """Recovery scan, then the poll loop. Cancel-safe."""
+        await self.recover()
+        try:
+            while not self._stop.is_set():
+                job = self.store.pop_job(self.instance_id)
+                if job is None:
+                    try:
+                        await asyncio.wait_for(
+                            self._stop.wait(), self.cfg.poll_interval_s
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                await self.process_job(job.id)
+        finally:
+            if self._session and not self._session.closed:
+                await self._session.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def recover(self) -> None:
+        """Reference crash-recovery semantics, capped concurrency."""
+        stale = [
+            j for j in self.store.jobs_with_status("in_progress")
+            if j.owner != self.instance_id
+        ] + [
+            j for j in self.store.jobs_with_status("finalizing")
+            if j.owner != self.instance_id
+        ]
+        sem = asyncio.Semaphore(self.cfg.recovery_concurrency)
+
+        async def _one(job):
+            async with sem:
+                out_id = job.output_file_id
+                if out_id and self.files.exists(job.tenant, out_id):
+                    # Partial output survives: surface it, fail the job.
+                    nbytes = self.files.size(job.tenant, out_id)
+                    self.store.create_file(
+                        job.tenant, f"{job.id}_output.jsonl", "batch_output",
+                        nbytes, file_id=out_id,
+                    )
+                    self.store.update_batch(
+                        job.id, status="failed", failed_at=now_s(),
+                        errors=[{"code": "processor_crash",
+                                 "message": "processor crashed mid-job; "
+                                            "partial output preserved"}],
+                    )
+                    self.store.remove_from_queue(job.id)
+                    log.warning("recovered %s as failed (partial output)", job.id)
+                else:
+                    self.store.update_batch(
+                        job.id, status="validating", owner=None,
+                        completed=0, failed=0, output_file_id=None,
+                    )
+                    self.store.requeue_job(job.id, job.deadline)
+                    log.warning("re-enqueued crashed job %s", job.id)
+
+        await asyncio.gather(*map(_one, stale))
+
+    # ---- single job ----
+
+    async def process_job(self, batch_id: str) -> None:
+        job = self.store.get_batch(None, batch_id)
+        if job is None:
+            return
+        if job.cancel_requested or job.status == "cancelling":
+            self._finish_cancelled(batch_id)
+            return
+        if now_s() > job.deadline:
+            self.store.update_batch(batch_id, status="expired",
+                                    expired_at=now_s())
+            self.store.remove_from_queue(batch_id)
+            return
+
+        # Ingest: parse + group by model into execution plans.
+        try:
+            raw = self.files.read(job.tenant, job.input_file_id)
+        except FileNotFoundError:
+            self.store.update_batch(
+                batch_id, status="failed", failed_at=now_s(),
+                errors=[{"code": "input_missing",
+                         "message": "input file content not found"}],
+            )
+            self.store.remove_from_queue(batch_id)
+            return
+        plans: dict[str, _Plan] = {}
+        total = 0
+        for raw_line in raw.splitlines():
+            if not raw_line.strip():
+                continue
+            line = json.loads(raw_line)
+            model = line.get("body", {}).get("model", "")
+            plans.setdefault(model, _Plan(model)).lines.append(line)
+            total += 1
+
+        output_file_id = f"file-{uuid.uuid4().hex[:24]}"
+        self.store.update_batch(
+            batch_id, status="in_progress", in_progress_at=now_s(),
+            total=total, owner=self.instance_id, output_file_id=output_file_id,
+        )
+        cancel_ev = self.store.subscribe_cancel(batch_id)
+        out_lock = asyncio.Lock()
+
+        async def run_plan(plan: _Plan) -> None:
+            model_sem = asyncio.Semaphore(self.cfg.per_model_concurrency)
+
+            async def one(line: dict) -> None:
+                if cancel_ev.is_set():
+                    return
+                async with model_sem, self._global_sem:
+                    if cancel_ev.is_set():
+                        return
+                    rec = await self._dispatch(job, line)
+                    async with out_lock:
+                        self.files.append_line(
+                            job.tenant, output_file_id, json.dumps(rec)
+                        )
+                    ok = rec.get("error") is None and (
+                        rec["response"]["status_code"] < 400
+                    )
+                    self.store.add_progress(
+                        batch_id, completed=int(ok), failed=int(not ok)
+                    )
+
+            await asyncio.gather(*(one(l) for l in plan.lines))
+
+        # Per-model plans run concurrently (reference: per-model goroutines).
+        await asyncio.gather(*(run_plan(p) for p in plans.values()))
+        self.store.unsubscribe_cancel(batch_id)
+
+        # Finalize.
+        if self.files.exists(job.tenant, output_file_id):
+            nbytes = self.files.size(job.tenant, output_file_id)
+            self.store.create_file(
+                job.tenant, f"{batch_id}_output.jsonl", "batch_output",
+                nbytes, file_id=output_file_id,
+            )
+        else:
+            self.store.update_batch(batch_id, output_file_id=None)
+            output_file_id = None
+        if cancel_ev.is_set():
+            self._finish_cancelled(batch_id)
+            return
+        self.store.update_batch(
+            batch_id, status="finalizing", finalizing_at=now_s()
+        )
+        final = self.store.get_batch(None, batch_id)
+        self.store.update_batch(
+            batch_id,
+            status="completed" if final.failed < final.total else "failed",
+            completed_at=now_s(),
+        )
+        self.store.remove_from_queue(batch_id)
+        log.info("batch %s done: %d ok / %d failed / %d total",
+                 batch_id, final.completed, final.failed, final.total)
+
+    def _finish_cancelled(self, batch_id: str) -> None:
+        self.store.update_batch(
+            batch_id, status="cancelled", cancelled_at=now_s()
+        )
+        self.store.remove_from_queue(batch_id)
+
+    async def _dispatch(self, job, line: dict) -> dict:
+        """One inference request -> one output JSONL record."""
+        url = self.cfg.router_url.rstrip("/") + line["url"]
+        headers = {
+            h: v for h, v in (job.metadata.get("headers") or {}).items()
+            if h.lower() in self.cfg.passthrough_headers
+        }
+        headers["x-llm-d-tenant"] = job.tenant
+        rec = {
+            "id": f"batch_req_{uuid.uuid4().hex[:16]}",
+            "custom_id": line["custom_id"],
+            "response": None,
+            "error": None,
+        }
+        try:
+            sess = await self._client()
+            async with sess.post(url, json=line["body"], headers=headers) as r:
+                try:
+                    body = await r.json()
+                except Exception:
+                    body = {"raw": (await r.text())[:2000]}
+                rec["response"] = {
+                    "status_code": r.status,
+                    "request_id": r.headers.get("x-request-id", ""),
+                    "body": body,
+                }
+        except Exception as e:  # network-level failure
+            rec["response"] = {"status_code": 0, "request_id": "", "body": None}
+            rec["error"] = {"code": "connection_error", "message": str(e)[:500]}
+        return rec
+
+
+class GarbageCollector:
+    """Removes expired jobs + files on an interval, bounded deletions/cycle
+    (batch-gateway.md "Garbage Collector")."""
+
+    def __init__(
+        self,
+        store: BatchStore,
+        files: FileStore,
+        interval_s: float = 300.0,
+        max_deletions: int = 100,
+        retention_s: float = 7 * 86400,
+    ) -> None:
+        self.store = store
+        self.files = files
+        self.interval_s = interval_s
+        self.max_deletions = max_deletions
+        self.retention_s = retention_s
+        self._stop = asyncio.Event()
+
+    def collect_once(self, now: float | None = None) -> int:
+        now = now_s() if now is None else now
+        deleted = 0
+        for job in self.store.expired_jobs(now - self.retention_s,
+                                           limit=self.max_deletions):
+            for fid in (job.input_file_id, job.output_file_id,
+                        job.error_file_id):
+                if fid:
+                    self.files.delete(job.tenant, fid)
+                    self.store.delete_file(job.tenant, fid)
+            self.store.delete_batch(job.id)
+            deleted += 1
+        for meta in self.store.expired_files(now,
+                                             limit=self.max_deletions - deleted):
+            if deleted >= self.max_deletions:
+                break
+            self.files.delete(meta.tenant, meta.id)
+            self.store.delete_file(meta.tenant, meta.id)
+            deleted += 1
+        return deleted
+
+    async def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.collect_once()
+            except Exception:
+                log.exception("gc cycle failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,448 @@
+"""Batch Gateway storage layer: metadata DB, priority queue, file store.
+
+Reference storage split (batch-gateway.md "Storage Layer" table):
+  - jobs/files metadata: PostgreSQL (prod) -> sqlite3 here (stdlib, durable,
+    single-node; the schema/queries keep the same shape so a PG driver can
+    be swapped in).
+  - priority queue: Redis sorted set with SLO-based priority -> a sqlite
+    table ordered by (priority, enqueue time); pop is atomic via a claim
+    UPDATE.
+  - event channels: Redis pub/sub for cancellation -> in-process
+    asyncio subscriptions + a `cancel_requested` column so cancellation
+    survives restarts and crosses processes via polling.
+  - file storage: S3 or filesystem -> filesystem with tenant-hashed
+    directories (batch-gateway.md "File paths are hashed by tenant ID").
+
+All timestamps are unix seconds (ints in the OpenAI API surface).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from pathlib import Path
+
+__all__ = ["BatchStore", "FileStore", "FileMeta", "BatchJob", "now_s"]
+
+
+def now_s() -> float:
+    return time.time()
+
+
+def _new_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+# Terminal batch statuses (OpenAI Batch object `status` values).
+TERMINAL = frozenset({"completed", "failed", "expired", "cancelled"})
+
+
+@dataclass
+class FileMeta:
+    id: str
+    tenant: str
+    filename: str
+    purpose: str
+    bytes: int
+    created_at: float
+    expires_at: float | None = None
+
+    def to_openai(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "file",
+            "bytes": self.bytes,
+            "created_at": int(self.created_at),
+            "filename": self.filename,
+            "purpose": self.purpose,
+            **({"expires_at": int(self.expires_at)} if self.expires_at else {}),
+        }
+
+
+@dataclass
+class BatchJob:
+    id: str
+    tenant: str
+    endpoint: str
+    input_file_id: str
+    completion_window_s: float
+    status: str
+    created_at: float
+    metadata: dict
+    output_file_id: str | None = None
+    error_file_id: str | None = None
+    total: int = 0
+    completed: int = 0
+    failed: int = 0
+    in_progress_at: float | None = None
+    finalizing_at: float | None = None
+    completed_at: float | None = None
+    failed_at: float | None = None
+    expired_at: float | None = None
+    cancelling_at: float | None = None
+    cancelled_at: float | None = None
+    cancel_requested: bool = False
+    owner: str | None = None  # processor instance id while in_progress
+    errors: list | None = None
+
+    @property
+    def deadline(self) -> float:
+        return self.created_at + self.completion_window_s
+
+    def to_openai(self) -> dict:
+        def ts(v):
+            return int(v) if v else None
+
+        return {
+            "id": self.id,
+            "object": "batch",
+            "endpoint": self.endpoint,
+            "errors": {"object": "list", "data": self.errors or []},
+            "input_file_id": self.input_file_id,
+            "completion_window": f"{int(self.completion_window_s)}s",
+            "status": self.status,
+            "output_file_id": self.output_file_id,
+            "error_file_id": self.error_file_id,
+            "created_at": int(self.created_at),
+            "in_progress_at": ts(self.in_progress_at),
+            "expires_at": int(self.deadline),
+            "finalizing_at": ts(self.finalizing_at),
+            "completed_at": ts(self.completed_at),
+            "failed_at": ts(self.failed_at),
+            "expired_at": ts(self.expired_at),
+            "cancelling_at": ts(self.cancelling_at),
+            "cancelled_at": ts(self.cancelled_at),
+            "request_counts": {
+                "total": self.total,
+                "completed": self.completed,
+                "failed": self.failed,
+            },
+            "metadata": self.metadata or {},
+        }
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS files (
+    id TEXT PRIMARY KEY, tenant TEXT NOT NULL, filename TEXT, purpose TEXT,
+    bytes INTEGER, created_at REAL, expires_at REAL
+);
+CREATE TABLE IF NOT EXISTS batches (
+    id TEXT PRIMARY KEY, tenant TEXT NOT NULL, endpoint TEXT,
+    input_file_id TEXT, completion_window_s REAL, status TEXT,
+    created_at REAL, metadata TEXT, output_file_id TEXT, error_file_id TEXT,
+    total INTEGER DEFAULT 0, completed INTEGER DEFAULT 0,
+    failed INTEGER DEFAULT 0,
+    in_progress_at REAL, finalizing_at REAL, completed_at REAL,
+    failed_at REAL, expired_at REAL, cancelling_at REAL, cancelled_at REAL,
+    cancel_requested INTEGER DEFAULT 0, owner TEXT, errors TEXT
+);
+CREATE TABLE IF NOT EXISTS queue (
+    batch_id TEXT PRIMARY KEY, priority REAL, enqueued_at REAL,
+    claimed_by TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_batches_tenant ON batches (tenant, created_at);
+CREATE INDEX IF NOT EXISTS idx_queue_prio ON queue (priority, enqueued_at);
+"""
+
+_BATCH_COLS = (
+    "id tenant endpoint input_file_id completion_window_s status created_at "
+    "metadata output_file_id error_file_id total completed failed "
+    "in_progress_at finalizing_at completed_at failed_at expired_at "
+    "cancelling_at cancelled_at cancel_requested owner errors"
+).split()
+
+
+class BatchStore:
+    """Metadata + SLO-priority queue + cancellation events.
+
+    Thread-safe (one connection guarded by a lock; sqlite serializes writes
+    anyway). Queue priority = job deadline (created_at + completion_window),
+    i.e. earliest-deadline-first — the reference's "sorted set with
+    SLO-based priority".
+    """
+
+    def __init__(self, path: str | Path = ":memory:") -> None:
+        self._db = sqlite3.connect(str(path), check_same_thread=False)
+        self._db.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        with self._lock, self._db:
+            self._db.executescript(_SCHEMA)
+        # In-process cancellation fan-out (the Redis pub/sub analogue).
+        self._cancel_subs: dict[str, list[asyncio.Event]] = {}
+
+    # ---- files ----
+
+    def create_file(
+        self,
+        tenant: str,
+        filename: str,
+        purpose: str,
+        nbytes: int,
+        expires_at: float | None = None,
+        file_id: str | None = None,
+    ) -> FileMeta:
+        meta = FileMeta(
+            id=file_id or _new_id("file"),
+            tenant=tenant,
+            filename=filename,
+            purpose=purpose,
+            bytes=nbytes,
+            created_at=now_s(),
+            expires_at=expires_at,
+        )
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT INTO files VALUES (?,?,?,?,?,?,?)",
+                (meta.id, tenant, filename, purpose, nbytes, meta.created_at,
+                 expires_at),
+            )
+        return meta
+
+    def get_file(self, tenant: str, file_id: str) -> FileMeta | None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT * FROM files WHERE id=? AND tenant=?", (file_id, tenant)
+            ).fetchone()
+        return FileMeta(**dict(row)) if row else None
+
+    def list_files(self, tenant: str, limit: int = 100) -> list[FileMeta]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM files WHERE tenant=? ORDER BY created_at DESC "
+                "LIMIT ?",
+                (tenant, limit),
+            ).fetchall()
+        return [FileMeta(**dict(r)) for r in rows]
+
+    def delete_file(self, tenant: str, file_id: str) -> bool:
+        with self._lock, self._db:
+            cur = self._db.execute(
+                "DELETE FROM files WHERE id=? AND tenant=?", (file_id, tenant)
+            )
+        return cur.rowcount > 0
+
+    def expired_files(self, now: float, limit: int = 100) -> list[FileMeta]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM files WHERE expires_at IS NOT NULL AND "
+                "expires_at < ? LIMIT ?",
+                (now, limit),
+            ).fetchall()
+        return [FileMeta(**dict(r)) for r in rows]
+
+    # ---- batches ----
+
+    def create_batch(
+        self,
+        tenant: str,
+        endpoint: str,
+        input_file_id: str,
+        completion_window_s: float,
+        metadata: dict | None = None,
+    ) -> BatchJob:
+        job = BatchJob(
+            id=_new_id("batch"),
+            tenant=tenant,
+            endpoint=endpoint,
+            input_file_id=input_file_id,
+            completion_window_s=completion_window_s,
+            status="validating",
+            created_at=now_s(),
+            metadata=metadata or {},
+        )
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT INTO batches (id, tenant, endpoint, input_file_id, "
+                "completion_window_s, status, created_at, metadata) "
+                "VALUES (?,?,?,?,?,?,?,?)",
+                (job.id, tenant, endpoint, input_file_id, completion_window_s,
+                 "validating", job.created_at, json.dumps(job.metadata)),
+            )
+            # Enqueue with SLO priority = deadline (earliest first).
+            self._db.execute(
+                "INSERT INTO queue VALUES (?,?,?,NULL)",
+                (job.id, job.deadline, job.created_at),
+            )
+        return job
+
+    def _job_from_row(self, row: sqlite3.Row) -> BatchJob:
+        d = dict(row)
+        d["metadata"] = json.loads(d["metadata"] or "{}")
+        d["errors"] = json.loads(d["errors"]) if d["errors"] else None
+        d["cancel_requested"] = bool(d["cancel_requested"])
+        return BatchJob(**d)
+
+    def get_batch(self, tenant: str | None, batch_id: str) -> BatchJob | None:
+        q = "SELECT * FROM batches WHERE id=?"
+        args: tuple = (batch_id,)
+        if tenant is not None:  # tenant=None = internal (processor) access
+            q += " AND tenant=?"
+            args = (batch_id, tenant)
+        with self._lock:
+            row = self._db.execute(q, args).fetchone()
+        return self._job_from_row(row) if row else None
+
+    def list_batches(self, tenant: str, limit: int = 100) -> list[BatchJob]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM batches WHERE tenant=? ORDER BY created_at "
+                "DESC LIMIT ?",
+                (tenant, limit),
+            ).fetchall()
+        return [self._job_from_row(r) for r in rows]
+
+    def update_batch(self, batch_id: str, **fields) -> None:
+        if "metadata" in fields:
+            fields["metadata"] = json.dumps(fields["metadata"])
+        if "errors" in fields and fields["errors"] is not None:
+            fields["errors"] = json.dumps(fields["errors"])
+        cols = ", ".join(f"{k}=?" for k in fields)
+        with self._lock, self._db:
+            self._db.execute(
+                f"UPDATE batches SET {cols} WHERE id=?",
+                (*fields.values(), batch_id),
+            )
+
+    def add_progress(self, batch_id: str, completed: int = 0, failed: int = 0) -> None:
+        with self._lock, self._db:
+            self._db.execute(
+                "UPDATE batches SET completed=completed+?, failed=failed+? "
+                "WHERE id=?",
+                (completed, failed, batch_id),
+            )
+
+    def jobs_with_status(self, status: str) -> list[BatchJob]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM batches WHERE status=?", (status,)
+            ).fetchall()
+        return [self._job_from_row(r) for r in rows]
+
+    def expired_jobs(self, now: float, limit: int = 100) -> list[BatchJob]:
+        """Terminal jobs whose deadline passed `grace` ago — GC candidates."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM batches WHERE created_at + completion_window_s "
+                "< ? AND status IN ('completed','failed','expired','cancelled')"
+                " LIMIT ?",
+                (now, limit),
+            ).fetchall()
+        return [self._job_from_row(r) for r in rows]
+
+    def delete_batch(self, batch_id: str) -> None:
+        with self._lock, self._db:
+            self._db.execute("DELETE FROM batches WHERE id=?", (batch_id,))
+            self._db.execute("DELETE FROM queue WHERE batch_id=?", (batch_id,))
+
+    # ---- priority queue ----
+
+    def pop_job(self, owner: str) -> BatchJob | None:
+        """Atomically claim the highest-priority (earliest-deadline) job."""
+        with self._lock, self._db:
+            row = self._db.execute(
+                "SELECT batch_id FROM queue WHERE claimed_by IS NULL "
+                "ORDER BY priority, enqueued_at LIMIT 1"
+            ).fetchone()
+            if row is None:
+                return None
+            bid = row["batch_id"]
+            self._db.execute(
+                "UPDATE queue SET claimed_by=? WHERE batch_id=?", (owner, bid)
+            )
+        job = self.get_batch(None, bid)
+        return job
+
+    def requeue_job(self, batch_id: str, priority: float) -> None:
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO queue VALUES (?,?,?,NULL)",
+                (batch_id, priority, now_s()),
+            )
+
+    def remove_from_queue(self, batch_id: str) -> None:
+        with self._lock, self._db:
+            self._db.execute("DELETE FROM queue WHERE batch_id=?", (batch_id,))
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM queue WHERE claimed_by IS NULL"
+            ).fetchone()[0]
+
+    # ---- cancellation events (pub/sub analogue) ----
+
+    def request_cancel(self, batch_id: str) -> None:
+        self.update_batch(batch_id, cancel_requested=1)
+        for ev in self._cancel_subs.get(batch_id, []):
+            ev.set()
+
+    def subscribe_cancel(self, batch_id: str) -> asyncio.Event:
+        ev = asyncio.Event()
+        self._cancel_subs.setdefault(batch_id, []).append(ev)
+        # Replay: cancellation may have landed before the subscription
+        # (or in another process via the DB column).
+        job = self.get_batch(None, batch_id)
+        if job is not None and job.cancel_requested:
+            ev.set()
+        return ev
+
+    def unsubscribe_cancel(self, batch_id: str) -> None:
+        self._cancel_subs.pop(batch_id, None)
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+class FileStore:
+    """Filesystem file store with tenant-hashed paths.
+
+    batch-gateway.md "File paths are hashed by tenant ID to prevent
+    enumeration": content lives at <root>/<sha256(tenant)[:16]>/<file_id>.
+    S3 is the multi-replica option; this single-node FS layout mirrors the
+    reference's PVC mode.
+    """
+
+    def __init__(self, root: str | Path) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _dir(self, tenant: str) -> Path:
+        h = hashlib.sha256(tenant.encode()).hexdigest()[:16]
+        d = self.root / h
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def path(self, tenant: str, file_id: str) -> Path:
+        return self._dir(tenant) / file_id
+
+    def write(self, tenant: str, file_id: str, data: bytes) -> int:
+        p = self.path(tenant, file_id)
+        p.write_bytes(data)
+        return len(data)
+
+    def append_line(self, tenant: str, file_id: str, line: str) -> None:
+        with open(self.path(tenant, file_id), "a") as f:
+            f.write(line.rstrip("\n") + "\n")
+
+    def read(self, tenant: str, file_id: str) -> bytes:
+        return self.path(tenant, file_id).read_bytes()
+
+    def exists(self, tenant: str, file_id: str) -> bool:
+        return self.path(tenant, file_id).exists()
+
+    def size(self, tenant: str, file_id: str) -> int:
+        return self.path(tenant, file_id).stat().st_size
+
+    def delete(self, tenant: str, file_id: str) -> None:
+        try:
+            os.unlink(self.path(tenant, file_id))
+        except FileNotFoundError:
+            pass

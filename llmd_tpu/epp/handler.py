@@ -102,7 +102,16 @@ def openai_parse(
 
 
 class Admitter:
-    """Pre-queue admission check; return a reason string to reject."""
+    """Admission check; return a reason string to reject.
+
+    `needs_producers=False` admitters are cheap and run *before* the
+    flow-control queue, so doomed requests (e.g. oversized prompts) are
+    429'd immediately instead of consuming queue capacity and a dispatch
+    slot. Admitters that read DataProducer outputs (latency-slo-admitter)
+    set `needs_producers=True` and run post-dispatch.
+    """
+
+    needs_producers = False
 
     def admit(self, req: LLMRequest) -> str | None:
         return None

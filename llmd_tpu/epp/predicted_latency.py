@@ -267,6 +267,12 @@ def maybe_attach_predicted_latency(
         router.scheduler, SloHeadroomTierFilter
     )
     if not used:
+        if predict_url or train_url:
+            log.warning(
+                "--predictor-url/--trainer-url given but the scheduler "
+                "config has no latency-scorer or slo-headroom-tier-filter "
+                "plugin; predicted-latency routing is NOT active"
+            )
         return None
     return attach_predicted_latency(router, predict_url, train_url)
 
@@ -295,9 +301,14 @@ def attach_predicted_latency(
 class LatencySloAdmitter(Admitter):
     """Shed sheddable requests whose SLO no endpoint is predicted to meet.
 
+    Reads predicted-latency producer output, so it must run post-dispatch
+    (needs_producers=True).
+
     Priority >= ``protected_priority`` is never shed (the reference admits
     critical traffic regardless and lets flow-control arbitrate).
     """
+
+    needs_producers = True
 
     def __init__(
         self,

@@ -136,6 +136,15 @@ class Router:
         # each proxied request — the latency-predictor training feedback
         # (reference latency-predictor.md: observed TTFT/TPOT per request).
         self.completion_observers: list = []
+        # Strong refs to in-flight observer tasks (GC safety).
+        self._observer_tasks: set[asyncio.Task] = set()
+
+    async def _run_observers(self, req, pod, ttft_ms, tpot_ms) -> None:
+        for obs in self.completion_observers:
+            try:
+                await obs(req, pod, ttft_ms, tpot_ms)
+            except Exception:
+                log.exception("completion observer failed")
 
     # ------------------------------------------------------------------ #
 
@@ -167,6 +176,18 @@ class Router:
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
                 status=400,
             )
+        # Cheap admitters reject before the request can occupy queue
+        # capacity or a dispatch slot; producer-dependent admitters run
+        # after dispatch (below).
+        for adm in self.admitters:
+            if not adm.needs_producers:
+                reason = adm.admit(req)
+                if reason is not None:
+                    return web.json_response(
+                        {"error": {"message": reason, "type": "rejected"}},
+                        status=429,
+                        headers={HDR_DROP_REASON: reason},
+                    )
         outcome = await self.flow.enqueue_and_wait(req, nbytes=len(raw))
         if outcome is not Outcome.DISPATCHED:
             status, reason = OUTCOME_HTTP[outcome]
@@ -185,6 +206,8 @@ class Router:
                 except Exception:
                     log.exception("data producer %s failed", type(producer).__name__)
             for adm in self.admitters:
+                if not adm.needs_producers:
+                    continue
                 reason = adm.admit(req)
                 if reason is not None:
                     return web.json_response(
@@ -312,12 +335,14 @@ class Router:
                 if last_byte is not None and stream_tokens > 1:
                     tpot_ms = (last_byte - first_byte) * 1000.0 / (stream_tokens - 1)
             self.scheduler.notify_complete(req, pod)
-            if ttft_ms is not None:
-                for obs in self.completion_observers:
-                    try:
-                        await obs(req, pod, ttft_ms, tpot_ms)
-                    except Exception:
-                        log.exception("completion observer failed")
+            if ttft_ms is not None and self.completion_observers:
+                # Fire-and-forget: the response is already written; a slow
+                # trainer sidecar must not hold the flow-control slot.
+                t = asyncio.ensure_future(
+                    self._run_observers(req, pod, ttft_ms, tpot_ms)
+                )
+                self._observer_tasks.add(t)
+                t.add_done_callback(self._observer_tasks.discard)
 
     async def handle_passthrough(self, request: web.Request) -> web.StreamResponse:
         """Non-generate paths (/v1/models, ...) go to any healthy endpoint."""

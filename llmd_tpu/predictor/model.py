@@ -151,7 +151,11 @@ class _StratifiedModel:
         self.global_fit = _OnlineRidge(dim, cfg.l2, cfg.decay)
 
     def add(self, x: Sequence[float], y: float) -> None:
+        # A single NaN/inf feature would permanently poison the decayed
+        # A/b accumulators (engines do emit NaN gauges, e.g. hit-rate 0/0).
         if len(x) != self.dim or not math.isfinite(y):
+            return
+        if not all(math.isfinite(v) for v in x):
             return
         key = self.bucket_fn(x, self.cfg)
         if key not in self.buckets:
@@ -160,17 +164,24 @@ class _StratifiedModel:
         self.global_fit.add(x, y)
 
     def predict(self, x: Sequence[float]) -> tuple[float, str]:
-        """Returns (ms, source) with source in {bucket, global, heuristic}."""
-        if len(x) == self.dim:
-            bucket = self.buckets.get(self.bucket_fn(x, self.cfg))
-            if bucket is not None and bucket.count >= self.cfg.min_bucket_samples:
-                p = bucket.predict(x)
-                if math.isfinite(p) and p > 0:
-                    return p, "bucket"
-            if self.global_fit.count >= self.cfg.min_global_samples:
-                p = self.global_fit.predict(x)
-                if math.isfinite(p) and p > 0:
-                    return p, "global"
+        """Returns (ms, source) with source in {bucket, global, heuristic}.
+
+        Raises ValueError on a feature-dimension mismatch (version-skewed
+        caller) rather than handing a wrong-arity vector to the heuristic.
+        """
+        if len(x) != self.dim:
+            raise ValueError(
+                f"expected {self.dim} features, got {len(x)}"
+            )
+        bucket = self.buckets.get(self.bucket_fn(x, self.cfg))
+        if bucket is not None and bucket.count >= self.cfg.min_bucket_samples:
+            p = bucket.predict(x)
+            if math.isfinite(p) and p > 0:
+                return p, "bucket"
+        if self.global_fit.count >= self.cfg.min_global_samples:
+            p = self.global_fit.predict(x)
+            if math.isfinite(p) and p > 0:
+                return p, "global"
         return self.heuristic(x), "heuristic"
 
     def to_dict(self) -> dict:

@@ -162,14 +162,20 @@ class PredictionServer:
     async def handle_predict(self, request: web.Request) -> web.Response:
         payload = await request.json()
         out: dict = {}
-        tf = payload.get("ttft_features")
-        if tf is not None:
-            ms, src = self.predictor.predict_ttft(tf)
-            out["ttft_ms"], out["ttft_source"] = ms, src
-        pf = payload.get("tpot_features")
-        if pf is not None:
-            ms, src = self.predictor.predict_tpot(pf)
-            out["tpot_ms"], out["tpot_source"] = ms, src
+        try:
+            tf = payload.get("ttft_features")
+            if tf is not None:
+                ms, src = self.predictor.predict_ttft(tf)
+                out["ttft_ms"], out["ttft_source"] = ms, src
+            pf = payload.get("tpot_features")
+            if pf is not None:
+                ms, src = self.predictor.predict_tpot(pf)
+                out["tpot_ms"], out["tpot_source"] = ms, src
+        except (ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": str(e), "type": "invalid_request_error"}},
+                status=400,
+            )
         return web.json_response(out)
 
     async def handle_health(self, request: web.Request) -> web.Response:

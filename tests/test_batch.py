@@ -1,0 +1,360 @@
+"""Batch plane tests: gateway API, processor E2E, crash recovery, GC,
+tenant isolation, async processor gates/retries/deadlines.
+
+Mirrors the reference's component behaviors (batch-gateway.md,
+async-processor.md) against a stub router; one E2E runs against the real
+tiny engine to prove the full path.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.batch.asyncproc import (
+    AsyncProcessor,
+    AsyncProcessorConfig,
+    BudgetFileGate,
+    DeadlineQueue,
+    SaturationGate,
+)
+from llmd_tpu.batch.gateway import build_gateway_app, validate_batch_lines
+from llmd_tpu.batch.processor import BatchProcessor, GarbageCollector, ProcessorConfig
+from llmd_tpu.batch.store import BatchStore, FileStore
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def make_input(n=4, model="tiny"):
+    lines = [
+        json.dumps(
+            {
+                "custom_id": f"req-{i}",
+                "method": "POST",
+                "url": "/v1/completions",
+                "body": {"model": model, "prompt": f"p{i}", "max_tokens": 4},
+            }
+        )
+        for i in range(n)
+    ]
+    return ("\n".join(lines)).encode()
+
+
+@pytest.fixture
+def stores(tmp_path):
+    return BatchStore(":memory:"), FileStore(tmp_path / "files")
+
+
+@pytest.fixture
+async def gw(stores):
+    store, files = stores
+    c = TestClient(TestServer(build_gateway_app(store, files)))
+    await c.start_server()
+    yield c
+    await c.close()
+
+
+async def make_stub_router(handler=None):
+    """A stand-in engine endpoint: echoes a completion."""
+
+    async def default(request):
+        body = await request.json()
+        return web.json_response(
+            {"id": "cmpl-x", "model": body.get("model"),
+             "choices": [{"text": "ok", "index": 0}]}
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", handler or default)
+    srv = TestServer(app)
+    await srv.start_server()
+    return srv
+
+
+def test_validate_batch_lines():
+    assert validate_batch_lines(make_input(3)) == 3
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_batch_lines(make_input(1) + b"\n" + make_input(1))
+    with pytest.raises(ValueError, match="empty"):
+        validate_batch_lines(b"")
+    with pytest.raises(ValueError, match="custom_id"):
+        validate_batch_lines(b'{"method": "POST"}')
+
+
+async def test_file_upload_and_content(gw):
+    r = await gw.post("/v1/files", data=make_input(2))
+    assert r.status == 200
+    meta = await r.json()
+    assert meta["object"] == "file"
+    r = await gw.get(f"/v1/files/{meta['id']}/content")
+    assert (await r.read()) == make_input(2)
+    r = await gw.get("/v1/files")
+    assert len((await r.json())["data"]) == 1
+    r = await gw.delete(f"/v1/files/{meta['id']}")
+    assert (await r.json())["deleted"] is True
+    r = await gw.get(f"/v1/files/{meta['id']}")
+    assert r.status == 404
+
+
+async def test_bad_input_file_rejected(gw):
+    r = await gw.post("/v1/files", data=b'{"nope": 1}')
+    assert r.status == 400
+
+
+async def test_batch_e2e_stub_router(gw, stores):
+    store, files = stores
+    srv = await make_stub_router()
+    up = await (await gw.post("/v1/files", data=make_input(6))).json()
+    r = await gw.post(
+        "/v1/batches",
+        json={"input_file_id": up["id"], "endpoint": "/v1/completions",
+              "completion_window": "24h", "metadata": {"k": "v"}},
+    )
+    assert r.status == 200
+    job = await r.json()
+    assert job["status"] == "validating"
+
+    proc = BatchProcessor(
+        store, files, ProcessorConfig(router_url=str(srv.make_url("")))
+    )
+    claimed = store.pop_job(proc.instance_id)
+    await proc.process_job(claimed.id)
+
+    done = await (await gw.get(f"/v1/batches/{job['id']}")).json()
+    assert done["status"] == "completed"
+    assert done["request_counts"] == {"total": 6, "completed": 6, "failed": 0}
+    out = await gw.get(f"/v1/files/{done['output_file_id']}/content")
+    recs = [json.loads(l) for l in (await out.text()).splitlines()]
+    assert {r_["custom_id"] for r_ in recs} == {f"req-{i}" for i in range(6)}
+    assert all(r_["response"]["status_code"] == 200 for r_ in recs)
+    await srv.close()
+
+
+async def test_batch_partial_failure_counts(gw, stores):
+    store, files = stores
+
+    async def flaky(request):
+        body = await request.json()
+        if body["prompt"] == "p0":
+            return web.json_response({"error": "boom"}, status=400)
+        return web.json_response({"choices": []})
+
+    srv = await make_stub_router(flaky)
+    up = await (await gw.post("/v1/files", data=make_input(3))).json()
+    job = await (
+        await gw.post(
+            "/v1/batches",
+            json={"input_file_id": up["id"], "endpoint": "/v1/completions"},
+        )
+    ).json()
+    proc = BatchProcessor(store, files,
+                          ProcessorConfig(router_url=str(srv.make_url(""))))
+    await proc.process_job(store.pop_job(proc.instance_id).id)
+    done = await (await gw.get(f"/v1/batches/{job['id']}")).json()
+    assert done["status"] == "completed"  # partial failure still completes
+    assert done["request_counts"] == {"total": 3, "completed": 2, "failed": 1}
+    await srv.close()
+
+
+async def test_cancel_before_pickup(gw):
+    up = await (await gw.post("/v1/files", data=make_input(2))).json()
+    job = await (
+        await gw.post(
+            "/v1/batches",
+            json={"input_file_id": up["id"], "endpoint": "/v1/completions"},
+        )
+    ).json()
+    r = await gw.post(f"/v1/batches/{job['id']}/cancel")
+    assert (await r.json())["status"] == "cancelled"
+    # terminal: second cancel conflicts
+    r = await gw.post(f"/v1/batches/{job['id']}/cancel")
+    assert r.status == 409
+
+
+async def test_crash_recovery(stores, tmp_path):
+    store, files = stores
+    # Fabricate a job left in_progress by a dead instance.
+    store.create_file("default", "in.jsonl", "batch", 10, file_id="file-in")
+    files.write("default", "file-in", make_input(2))
+    job = store.create_batch("default", "/v1/completions", "file-in", 86400)
+    store.update_batch(job.id, status="in_progress", owner="proc-dead",
+                       output_file_id="file-out")
+    # Case 1: partial output exists -> failed + output registered.
+    files.write("default", "file-out", b'{"custom_id": "req-0"}\n')
+    proc = BatchProcessor(store, files, ProcessorConfig(router_url="http://x"))
+    await proc.recover()
+    j = store.get_batch(None, job.id)
+    assert j.status == "failed"
+    assert store.get_file("default", "file-out") is not None
+
+    # Case 2: no output -> re-enqueued for full retry.
+    job2 = store.create_batch("default", "/v1/completions", "file-in", 86400)
+    store.remove_from_queue(job2.id)
+    store.update_batch(job2.id, status="in_progress", owner="proc-dead")
+    await proc.recover()
+    j2 = store.get_batch(None, job2.id)
+    assert j2.status == "validating"
+    assert store.pop_job("me").id == job2.id
+
+
+async def test_tenant_isolation(gw):
+    up = await (
+        await gw.post("/v1/files", data=make_input(1),
+                      headers={"x-llm-d-tenant": "alice"})
+    ).json()
+    # bob can't see alice's file or batch
+    r = await gw.get(f"/v1/files/{up['id']}",
+                     headers={"x-llm-d-tenant": "bob"})
+    assert r.status == 404
+    r = await gw.post(
+        "/v1/batches",
+        json={"input_file_id": up["id"], "endpoint": "/v1/completions"},
+        headers={"x-llm-d-tenant": "bob"},
+    )
+    assert r.status == 404
+    job = await (
+        await gw.post(
+            "/v1/batches",
+            json={"input_file_id": up["id"], "endpoint": "/v1/completions"},
+            headers={"x-llm-d-tenant": "alice"},
+        )
+    ).json()
+    r = await gw.get(f"/v1/batches/{job['id']}",
+                     headers={"x-llm-d-tenant": "bob"})
+    assert r.status == 404
+
+
+async def test_gc(stores):
+    store, files = stores
+    store.create_file("t", "in.jsonl", "batch", 5, file_id="file-a")
+    files.write("t", "file-a", b"x")
+    job = store.create_batch("t", "/v1/completions", "file-a", 0.0)
+    store.update_batch(job.id, status="completed")
+    gc = GarbageCollector(store, files, retention_s=0.0)
+    assert gc.collect_once(now=time.time() + 1) >= 1
+    assert store.get_batch(None, job.id) is None
+    assert not files.exists("t", "file-a")
+
+
+# ---- async processor ----
+
+
+async def test_async_processor_success_and_retry(tmp_path):
+    calls = {"n": 0}
+
+    async def flaky(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return web.json_response({}, status=503)  # retryable once
+        return web.json_response({"ok": True})
+
+    srv = await make_stub_router(flaky)
+    q = DeadlineQueue()
+    proc = AsyncProcessor(
+        q,
+        AsyncProcessorConfig(router_url=str(srv.make_url("")), workers=2,
+                             backoff_base_s=0.01, backoff_max_s=0.05),
+    )
+    task = asyncio.create_task(proc.run())
+    await q.put({"prompt": "x"}, deadline=time.time() + 30)
+    req, result = await asyncio.wait_for(proc.results.get(), 10)
+    assert result["status"] == 200 and calls["n"] == 2
+    assert proc.stats["retried"] == 1
+    proc.stop()
+    await task
+    await srv.close()
+
+
+async def test_async_processor_deadline_exceeded():
+    q = DeadlineQueue()
+    proc = AsyncProcessor(
+        q, AsyncProcessorConfig(router_url="http://127.0.0.1:1", workers=1)
+    )
+    task = asyncio.create_task(proc.run())
+    await q.put({"prompt": "x"}, deadline=time.time() - 1)  # already expired
+    req, result = await asyncio.wait_for(proc.results.get(), 10)
+    assert result["error"] == "deadline_exceeded"
+    proc.stop()
+    await task
+
+
+async def test_async_processor_fatal_not_retried():
+    async def bad(request):
+        return web.json_response({"error": "bad request"}, status=400)
+
+    srv = await make_stub_router(bad)
+    q = DeadlineQueue()
+    proc = AsyncProcessor(
+        q, AsyncProcessorConfig(router_url=str(srv.make_url("")), workers=1)
+    )
+    task = asyncio.create_task(proc.run())
+    await q.put({"prompt": "x"}, deadline=time.time() + 30)
+    req, result = await asyncio.wait_for(proc.results.get(), 10)
+    assert result["error"] == "fatal" and proc.stats["retried"] == 0
+    proc.stop()
+    await task
+    await srv.close()
+
+
+async def test_budget_file_gate(tmp_path):
+    path = tmp_path / "budget"
+    path.write_text("0")
+    gate = BudgetFileGate(path, poll_interval_s=0.01)
+    acq = asyncio.create_task(gate.acquire())
+    await asyncio.sleep(0.05)
+    assert not acq.done()  # closed gate blocks
+    path.write_text("1")
+    await asyncio.wait_for(acq, 5)
+    # budget 1: second acquire blocks until release
+    acq2 = asyncio.create_task(gate.acquire())
+    await asyncio.sleep(0.05)
+    assert not acq2.done()
+    gate.release()
+    await asyncio.wait_for(acq2, 5)
+    acq2.cancel() if not acq2.done() else None
+
+
+async def test_saturation_gate():
+    sat = {"v": 0.95}
+
+    async def metrics(request):
+        return web.Response(
+            text=f"llmd_kv_cache_utilization {sat['v']}\n"
+        )
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    srv = TestServer(app)
+    await srv.start_server()
+    gate = SaturationGate(str(srv.make_url("/metrics")), threshold=0.8,
+                          poll_interval_s=0.01)
+    acq = asyncio.create_task(gate.acquire())
+    await asyncio.sleep(0.1)
+    assert not acq.done()  # saturated -> closed
+    sat["v"] = 0.5
+    await asyncio.wait_for(acq, 5)
+    await gate.close()
+    await srv.close()
+
+
+async def test_deadline_queue_persistence(tmp_path):
+    db = tmp_path / "q.db"
+    q = DeadlineQueue(db)
+    await q.put({"a": 1}, deadline=200.0, request_id="r2")
+    await q.put({"a": 0}, deadline=100.0, request_id="r1")
+    # restart: earliest deadline first, contents intact
+    q2 = DeadlineQueue(db)
+    assert len(q2) == 2
+    first = await q2.get()
+    assert first.request_id == "r1" and first.payload == {"a": 0}
+    q2.ack(first)
+    q3 = DeadlineQueue(db)
+    assert len(q3) == 1
