@@ -60,7 +60,9 @@ class DeadlineQueue:
     def __init__(self, db_path: str | Path | None = None) -> None:
         self._heap: list[QueuedRequest] = []
         self._seq = itertools.count()
-        self._cond = asyncio.Condition()
+        # put() replaces-and-sets this so every parked getter wakes and
+        # re-checks immediately — a backoff sleep must not delay fresh work.
+        self._new_item = asyncio.Event()
         self._db: sqlite3.Connection | None = None
         self._db_lock = threading.Lock()
         if db_path is not None:
@@ -112,25 +114,33 @@ class DeadlineQueue:
             attempts=attempts, not_before=not_before,
         )
         self._persist(req)
-        async with self._cond:
-            heapq.heappush(self._heap, req)
-            self._cond.notify()
+        heapq.heappush(self._heap, req)
+        ev, self._new_item = self._new_item, asyncio.Event()
+        ev.set()
 
     async def get(self) -> QueuedRequest:
-        """Earliest-deadline request whose backoff delay has elapsed."""
+        """Earliest-deadline request whose backoff delay has elapsed.
+
+        Single-threaded asyncio: heap mutations happen between awaits, so
+        no lock is needed; wakeups ride the put() event.
+        """
         while True:
-            async with self._cond:
-                while not self._heap:
-                    await self._cond.wait()
-                now = time.monotonic()
-                ready = [r for r in self._heap if r.not_before <= now]
-                if ready:
-                    req = min(ready)
-                    self._heap.remove(req)
-                    heapq.heapify(self._heap)
-                    return req
+            now = time.monotonic()
+            ready = [r for r in self._heap if r.not_before <= now]
+            if ready:
+                req = min(ready)
+                self._heap.remove(req)
+                heapq.heapify(self._heap)
+                return req
+            ev = self._new_item
+            if self._heap:
                 wait = min(r.not_before for r in self._heap) - now
-            await asyncio.sleep(max(wait, 0.01))
+                try:
+                    await asyncio.wait_for(ev.wait(), max(wait, 0.01))
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await ev.wait()
 
     def ack(self, req: QueuedRequest) -> None:
         self._unpersist(req.request_id)
@@ -340,17 +350,27 @@ class AsyncProcessor:
     async def _worker(self, idx: int) -> None:
         while True:
             req = await self.queue.get()
-            # Deadline enforcement: abandon work that can't finish in time.
-            if time.time() >= req.deadline:
-                self.stats["deadline_exceeded"] += 1
-                self.queue.ack(req)
-                await self._emit(req, {"error": "deadline_exceeded"})
-                continue
-            await self.gate.acquire()
             try:
-                await self._dispatch(req)
-            finally:
-                self.gate.release()
+                # Deadline enforcement: abandon work that can't finish.
+                if time.time() >= req.deadline:
+                    self.stats["deadline_exceeded"] += 1
+                    self.queue.ack(req)
+                    await self._emit(req, {"error": "deadline_exceeded"})
+                    continue
+                await self.gate.acquire()
+                try:
+                    await self._dispatch(req)
+                finally:
+                    self.gate.release()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # A single bad request/response must not shrink the pool.
+                log.exception("worker %d: dispatch of %s failed", idx,
+                              req.request_id)
+                self.stats["failed"] += 1
+                self.queue.ack(req)
+                await self._emit(req, {"error": "internal", "detail": "worker"})
 
     async def _dispatch(self, req: QueuedRequest) -> None:
         url = self.cfg.router_url.rstrip("/") + req.url_path
@@ -367,7 +387,10 @@ class AsyncProcessor:
                 timeout=aiohttp.ClientTimeout(total=remaining),
             ) as r:
                 if r.status < 400:
-                    body = await r.json()
+                    try:
+                        body = await r.json()
+                    except (json.JSONDecodeError, aiohttp.ContentTypeError):
+                        body = {"raw": (await r.text())[:2000]}
                     self.stats["succeeded"] += 1
                     self.queue.ack(req)
                     await self._emit(req, {"status": r.status, "body": body})

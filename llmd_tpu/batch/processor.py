@@ -27,7 +27,7 @@ from dataclasses import dataclass, field
 
 import aiohttp
 
-from llmd_tpu.batch.store import BatchStore, FileStore, now_s
+from llmd_tpu.batch.store import TERMINAL, BatchStore, FileStore, now_s
 
 log = logging.getLogger(__name__)
 
@@ -39,6 +39,9 @@ class ProcessorConfig:
     per_model_concurrency: int = 16
     recovery_concurrency: int = 4
     poll_interval_s: float = 0.5
+    # Liveness lease: processors heartbeat every lease/4 while executing a
+    # job; recovery reclaims only jobs whose heartbeat is older than this.
+    lease_s: float = 120.0
     request_timeout_s: float = 600.0
     # Headers forwarded verbatim from batch metadata to inference requests
     # so the router can authorize the end user per-request.
@@ -87,7 +90,19 @@ class BatchProcessor:
                     except asyncio.TimeoutError:
                         pass
                     continue
-                await self.process_job(job.id)
+                try:
+                    await self.process_job(job.id)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # A malformed job must not kill the processor loop.
+                    log.exception("job %s failed unexpectedly", job.id)
+                    self.store.update_batch(
+                        job.id, status="failed", failed_at=now_s(),
+                        errors=[{"code": "processor_error",
+                                 "message": "internal processing error"}],
+                    )
+                    self.store.remove_from_queue(job.id)
         finally:
             if self._session and not self._session.closed:
                 await self._session.close()
@@ -96,13 +111,19 @@ class BatchProcessor:
         self._stop.set()
 
     async def recover(self) -> None:
-        """Reference crash-recovery semantics, capped concurrency."""
+        """Reference crash-recovery semantics, capped concurrency.
+
+        Only reclaims jobs whose owner's heartbeat went stale — a live peer
+        processor (multi-processor deployment) keeps its lease fresh and is
+        left alone.
+        """
+        cutoff = now_s() - self.cfg.lease_s
         stale = [
-            j for j in self.store.jobs_with_status("in_progress")
+            j
+            for status in ("in_progress", "finalizing")
+            for j in self.store.jobs_with_status(status)
             if j.owner != self.instance_id
-        ] + [
-            j for j in self.store.jobs_with_status("finalizing")
-            if j.owner != self.instance_id
+            and (j.heartbeat_at is None or j.heartbeat_at < cutoff)
         ]
         sem = asyncio.Semaphore(self.cfg.recovery_concurrency)
 
@@ -140,6 +161,11 @@ class BatchProcessor:
         job = self.store.get_batch(None, batch_id)
         if job is None:
             return
+        if job.status in TERMINAL:
+            # e.g. cancelled via the gateway fast path between queue pop and
+            # here — must not resurrect a terminal job.
+            self.store.remove_from_queue(batch_id)
+            return
         if job.cancel_requested or job.status == "cancelling":
             self._finish_cancelled(batch_id)
             return
@@ -160,23 +186,48 @@ class BatchProcessor:
             )
             self.store.remove_from_queue(batch_id)
             return
+        # Re-validate at ingest: create_batch only checks the file exists;
+        # purpose!='batch' uploads skip the gateway-side format check.
         plans: dict[str, _Plan] = {}
         total = 0
-        for raw_line in raw.splitlines():
-            if not raw_line.strip():
-                continue
-            line = json.loads(raw_line)
-            model = line.get("body", {}).get("model", "")
-            plans.setdefault(model, _Plan(model)).lines.append(line)
-            total += 1
+        try:
+            for raw_line in raw.splitlines():
+                if not raw_line.strip():
+                    continue
+                line = json.loads(raw_line)
+                if not isinstance(line.get("custom_id"), str) or not isinstance(
+                    line.get("body"), dict
+                ) or not isinstance(line.get("url"), str):
+                    raise ValueError("line missing custom_id/url/body")
+                model = line.get("body", {}).get("model", "")
+                plans.setdefault(model, _Plan(model)).lines.append(line)
+                total += 1
+            if total == 0:
+                raise ValueError("empty input file")
+        except (json.JSONDecodeError, ValueError) as e:
+            self.store.update_batch(
+                batch_id, status="failed", failed_at=now_s(),
+                errors=[{"code": "invalid_input",
+                         "message": f"input file invalid: {e}"[:500]}],
+            )
+            self.store.remove_from_queue(batch_id)
+            return
 
         output_file_id = f"file-{uuid.uuid4().hex[:24]}"
         self.store.update_batch(
             batch_id, status="in_progress", in_progress_at=now_s(),
             total=total, owner=self.instance_id, output_file_id=output_file_id,
+            heartbeat_at=now_s(),
         )
         cancel_ev = self.store.subscribe_cancel(batch_id)
         out_lock = asyncio.Lock()
+
+        async def heartbeat() -> None:
+            while True:
+                await asyncio.sleep(self.cfg.lease_s / 4)
+                self.store.update_batch(batch_id, heartbeat_at=now_s())
+
+        hb_task = asyncio.create_task(heartbeat())
 
         async def run_plan(plan: _Plan) -> None:
             model_sem = asyncio.Semaphore(self.cfg.per_model_concurrency)
@@ -202,8 +253,11 @@ class BatchProcessor:
             await asyncio.gather(*(one(l) for l in plan.lines))
 
         # Per-model plans run concurrently (reference: per-model goroutines).
-        await asyncio.gather(*(run_plan(p) for p in plans.values()))
-        self.store.unsubscribe_cancel(batch_id)
+        try:
+            await asyncio.gather(*(run_plan(p) for p in plans.values()))
+        finally:
+            hb_task.cancel()
+            self.store.unsubscribe_cancel(batch_id)
 
         # Finalize.
         if self.files.exists(job.tenant, output_file_id):
@@ -293,8 +347,9 @@ class GarbageCollector:
         deleted = 0
         for job in self.store.expired_jobs(now - self.retention_s,
                                            limit=self.max_deletions):
-            for fid in (job.input_file_id, job.output_file_id,
-                        job.error_file_id):
+            # Only files this batch produced: the input file may be shared by
+            # other batches and has its own expires_at lifecycle.
+            for fid in (job.output_file_id, job.error_file_id):
                 if fid:
                     self.files.delete(job.tenant, fid)
                     self.store.delete_file(job.tenant, fid)

@@ -91,6 +91,9 @@ class BatchJob:
     cancel_requested: bool = False
     owner: str | None = None  # processor instance id while in_progress
     errors: list | None = None
+    # Liveness lease: the owning processor refreshes this while executing;
+    # recovery only reclaims jobs whose heartbeat went stale.
+    heartbeat_at: float | None = None
 
     @property
     def deadline(self) -> float:
@@ -141,7 +144,8 @@ CREATE TABLE IF NOT EXISTS batches (
     failed INTEGER DEFAULT 0,
     in_progress_at REAL, finalizing_at REAL, completed_at REAL,
     failed_at REAL, expired_at REAL, cancelling_at REAL, cancelled_at REAL,
-    cancel_requested INTEGER DEFAULT 0, owner TEXT, errors TEXT
+    cancel_requested INTEGER DEFAULT 0, owner TEXT, errors TEXT,
+    heartbeat_at REAL
 );
 CREATE TABLE IF NOT EXISTS queue (
     batch_id TEXT PRIMARY KEY, priority REAL, enqueued_at REAL,

@@ -1,0 +1,40 @@
+"""IPP — Inference Payload Processor: pool-level routing + payload plugins.
+
+Re-implements the reference IPP
+(docs/architecture/advanced/inference-payload-processing/README.md):
+a pluggable framework that inspects/mutates request and response payloads
+*before and after* routing decisions, sitting above the per-pool EPP:
+
+    IPP  — pool-level:     which InferencePool?
+    EPP  — endpoint-level: which pod within the pool?
+
+Pipeline order (README.md "Plugin Architecture"):
+    PreProcessing -> ProfilePicker -> profile request plugins
+        -> [pool router] -> profile response plugins -> PostProcessing
+
+The reference integrates with Envoy via ext-proc; this framework's proxy
+tier is the standalone aiohttp reverse proxy (like the EPP Router), so the
+IPP is an aiohttp front proxy that applies mutations and forwards to the
+selected pool's Router URL — same decision surface, one fewer process hop.
+Multi-model routing (guides/multi-model-routing/README.md): the
+`model-extractor` plugin reads the model from the body and sets
+`x-llm-d-model`; pool selection matches that header.
+"""
+
+from llmd_tpu.ipp.plugins import (
+    IPPContext,
+    IPPPlugin,
+    ipp_plugin,
+    build_ipp_plugin,
+)
+from llmd_tpu.ipp.server import IPPServer, PoolRoute, build_ipp_app
+
+__all__ = [
+    "IPPContext",
+    "IPPPlugin",
+    "ipp_plugin",
+    "build_ipp_plugin",
+    "IPPServer",
+    "PoolRoute",
+    "build_ipp_app",
+]

@@ -1,0 +1,50 @@
+"""Run the IPP front proxy.
+
+    python -m llmd_tpu.ipp --config ipp.yaml --port 8100
+
+Config: see llmd_tpu/ipp/server.py docstring. Minimal zero-config mode:
+`--pool URL` routes everything to one pool with model extraction only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from pathlib import Path
+
+from aiohttp import web
+
+from llmd_tpu.ipp.server import IPPServer, PoolRoute
+
+
+def load_config(path: str) -> dict:
+    text = Path(path).read_text()
+    try:
+        import yaml
+
+        return yaml.safe_load(text)
+    except ImportError:
+        return json.loads(text)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="llmd-tpu IPP front proxy")
+    p.add_argument("--config", help="YAML/JSON pipeline + pool config")
+    p.add_argument("--pool", help="single-pool shortcut: route all to URL")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    if args.config:
+        server = IPPServer.from_config(load_config(args.config))
+    elif args.pool:
+        server = IPPServer([PoolRoute("*", args.pool)])
+    else:
+        p.error("need --config or --pool")
+    web.run_app(server.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

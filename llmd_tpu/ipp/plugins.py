@@ -1,0 +1,277 @@
+"""IPP plugin framework: context, base class, registry, built-ins.
+
+Plugins are modular units performing one processing task
+(ipp README.md "Plugin Architecture"); profiles chain them; a profile
+picker selects the chain per request. Mutations accumulate on the
+IPPContext and the proxy applies them when forwarding.
+
+Built-ins:
+  model-extractor   read `model` from the JSON body -> x-llm-d-model header
+                    (the multi-model-routing use case)
+  model-rewrite     rename models (InferenceModelRewrite analogue,
+                    docs/api-reference/inferencemodelrewrite.md): header +
+                    body are both rewritten so the pool's engine sees the
+                    served name
+  header-setter     static header mutations
+  defaults-injector fill missing body fields (e.g. max_tokens cap)
+  guardrail         deny-pattern content filter -> immediate 403 response
+  usage-recorder    response plugin: accumulate token usage per model
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+_REGISTRY: dict[str, type] = {}
+
+
+def ipp_plugin(type_name: str):
+    def deco(cls):
+        cls.type_name = type_name
+        _REGISTRY[type_name] = cls
+        return cls
+
+    return deco
+
+
+def build_ipp_plugin(type_name: str, params: dict | None = None):
+    try:
+        cls = _REGISTRY[type_name]
+    except KeyError:
+        raise KeyError(
+            f"unknown IPP plugin {type_name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(**(params or {}))
+
+
+@dataclass
+class IPPContext:
+    """Mutable request/response state threaded through the pipeline."""
+
+    path: str
+    headers: dict[str, str]            # request headers (mutable)
+    body: dict | None                  # parsed JSON body, None if not JSON
+    body_mutated: bool = False
+    # Early response short-circuit (guardrails): (status, payload).
+    reject: tuple[int, dict] | None = None
+    # Response side (filled before response plugins run).
+    response_status: int = 0
+    response_headers: dict[str, str] = field(default_factory=dict)
+    response_body: dict | None = None
+    response_body_mutated: bool = False
+    # Plugin execution latency for /metrics (README.md "Monitoring").
+    plugin_latency_s: dict[str, float] = field(default_factory=dict)
+
+    @property
+    def model(self) -> str:
+        return self.headers.get("x-llm-d-model", "") or (
+            (self.body or {}).get("model", "") if self.body else ""
+        )
+
+    def set_body(self, body: dict) -> None:
+        self.body = body
+        self.body_mutated = True
+
+
+class IPPPlugin:
+    """Base: override either hook; return nothing, mutate ctx."""
+
+    type_name = "base"
+
+    def process_request(self, ctx: IPPContext) -> None:  # pragma: no cover
+        return None
+
+    def process_response(self, ctx: IPPContext) -> None:  # pragma: no cover
+        return None
+
+
+def run_request_plugins(plugins: list[IPPPlugin], ctx: IPPContext) -> None:
+    for p in plugins:
+        if ctx.reject is not None:
+            return
+        t0 = time.monotonic()
+        try:
+            p.process_request(ctx)
+        except Exception:
+            log.exception("IPP request plugin %s failed", p.type_name)
+        ctx.plugin_latency_s[p.type_name] = time.monotonic() - t0
+
+
+def run_response_plugins(plugins: list[IPPPlugin], ctx: IPPContext) -> None:
+    for p in plugins:
+        t0 = time.monotonic()
+        try:
+            p.process_response(ctx)
+        except Exception:
+            log.exception("IPP response plugin %s failed", p.type_name)
+        ctx.plugin_latency_s["resp:" + p.type_name] = time.monotonic() - t0
+
+
+# ---- built-ins ----
+
+
+@ipp_plugin("model-extractor")
+class ModelExtractor(IPPPlugin):
+    """Body `model` field -> x-llm-d-model header (+ optional default)."""
+
+    def __init__(self, default_model: str = "") -> None:
+        self.default_model = default_model
+
+    def process_request(self, ctx: IPPContext) -> None:
+        model = (ctx.body or {}).get("model") or self.default_model
+        if model:
+            ctx.headers["x-llm-d-model"] = model
+
+
+@ipp_plugin("model-rewrite")
+class ModelRewrite(IPPPlugin):
+    """Alias -> served-model mapping, rewriting header AND body."""
+
+    def __init__(self, rules: dict[str, str] | None = None) -> None:
+        self.rules = rules or {}
+
+    def process_request(self, ctx: IPPContext) -> None:
+        model = ctx.model
+        target = self.rules.get(model)
+        if target is None:
+            return
+        ctx.headers["x-llm-d-model"] = target
+        ctx.headers["x-llm-d-original-model"] = model
+        if ctx.body is not None and ctx.body.get("model") == model:
+            ctx.body["model"] = target
+            ctx.body_mutated = True
+
+    def process_response(self, ctx: IPPContext) -> None:
+        # Restore the client-facing name in the response body.
+        orig = ctx.headers.get("x-llm-d-original-model")
+        if orig and ctx.response_body and "model" in ctx.response_body:
+            ctx.response_body["model"] = orig
+            ctx.response_body_mutated = True
+
+
+@ipp_plugin("header-setter")
+class HeaderSetter(IPPPlugin):
+    def __init__(self, set: dict[str, str] | None = None,
+                 remove: list[str] | None = None) -> None:
+        self.set = set or {}
+        self.remove = [h.lower() for h in (remove or [])]
+
+    def process_request(self, ctx: IPPContext) -> None:
+        for h in self.remove:
+            ctx.headers.pop(h, None)
+        ctx.headers.update(self.set)
+
+
+@ipp_plugin("defaults-injector")
+class DefaultsInjector(IPPPlugin):
+    """Fill absent body fields; cap max_tokens if configured."""
+
+    def __init__(self, defaults: dict | None = None,
+                 max_tokens_cap: int | None = None) -> None:
+        self.defaults = defaults or {}
+        self.max_tokens_cap = max_tokens_cap
+
+    def process_request(self, ctx: IPPContext) -> None:
+        if ctx.body is None:
+            return
+        for k, v in self.defaults.items():
+            if k not in ctx.body:
+                ctx.body[k] = v
+                ctx.body_mutated = True
+        if self.max_tokens_cap is not None:
+            mt = ctx.body.get("max_tokens")
+            if mt is None or mt > self.max_tokens_cap:
+                ctx.body["max_tokens"] = self.max_tokens_cap
+                ctx.body_mutated = True
+
+
+@ipp_plugin("guardrail")
+class Guardrail(IPPPlugin):
+    """Deny-pattern filter over prompt/messages text -> 403 short-circuit.
+
+    FAIL-CLOSED: any error while scanning (malformed messages, unexpected
+    shapes) rejects the request — a security filter must not be crashable
+    into an open position.
+    """
+
+    def __init__(self, deny_patterns: list[str] | None = None) -> None:
+        self.patterns = [re.compile(p, re.I) for p in (deny_patterns or [])]
+
+    @staticmethod
+    def _texts(body: dict | None):
+        if not body:
+            return
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            yield prompt
+        elif isinstance(prompt, list):
+            yield from (p for p in prompt if isinstance(p, str))
+        messages = body.get("messages") or []
+        if not isinstance(messages, list):
+            raise ValueError("messages is not a list")
+        for m in messages:
+            if not isinstance(m, dict):
+                raise ValueError("message entry is not an object")
+            c = m.get("content")
+            if isinstance(c, str):
+                yield c
+            elif isinstance(c, list):
+                # OpenAI content-parts form: [{"type":"text","text":...},...]
+                for part in c:
+                    if not isinstance(part, dict):
+                        raise ValueError("content part is not an object")
+                    t = part.get("text")
+                    if isinstance(t, str):
+                        yield t
+
+    def process_request(self, ctx: IPPContext) -> None:
+        try:
+            for text in self._texts(ctx.body):
+                for pat in self.patterns:
+                    if pat.search(text):
+                        ctx.reject = (
+                            403,
+                            {"error": {
+                                "message": "request blocked by guardrail",
+                                "type": "guardrail_violation"}},
+                        )
+                        return
+        except Exception:
+            log.exception("guardrail scan failed; failing closed")
+            ctx.reject = (
+                400,
+                {"error": {"message": "request could not be scanned",
+                           "type": "guardrail_error"}},
+            )
+
+
+@ipp_plugin("usage-recorder")
+class UsageRecorder(IPPPlugin):
+    """Accumulates response `usage` per model (observability hook)."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, dict[str, int]] = {}
+
+    def process_response(self, ctx: IPPContext) -> None:
+        usage = (ctx.response_body or {}).get("usage")
+        if not isinstance(usage, dict):
+            return
+        t = self.totals.setdefault(
+            ctx.model, {"prompt_tokens": 0, "completion_tokens": 0}
+        )
+        for k in t:
+            t[k] += int(usage.get(k, 0) or 0)
+
+
+def _parse_body(raw: bytes) -> dict | None:
+    try:
+        obj = json.loads(raw)
+        return obj if isinstance(obj, dict) else None
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None

@@ -1,0 +1,250 @@
+"""IPP front proxy: profile-picked plugin pipelines + pool selection.
+
+Request flow (ipp README.md "Request Flow"): client -> IPP -> pipeline
+mutations -> pool Router (EPP) -> response plugins -> client. Pools are
+matched on the `x-llm-d-model` header set by the pipeline (multi-model
+routing: HTTPRoute header matching, guides/multi-model-routing — here a
+glob table since the proxy is in-process).
+
+Config shape (YAML):
+    profiles:
+      default:
+        request: [{type: model-extractor}, {type: guardrail, parameters: {...}}]
+        response: [{type: usage-recorder}]
+    profile_rules:            # ProfilePicker: first match wins
+      - {path_prefix: /v1/chat, profile: default}
+    pools:                    # first glob match on model wins
+      - {match: "qwen*", url: "http://qwen-pool:8000"}
+      - {match: "*", url: "http://default-pool:8000"}
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+from dataclasses import dataclass
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.ipp.plugins import (
+    IPPContext,
+    UsageRecorder,
+    _parse_body,
+    build_ipp_plugin,
+    run_request_plugins,
+    run_response_plugins,
+)
+
+log = logging.getLogger(__name__)
+
+HOP_HEADERS = frozenset(
+    {"host", "content-length", "transfer-encoding", "connection", "keep-alive"}
+)
+
+
+@dataclass
+class PoolRoute:
+    match: str  # fnmatch glob over the model name
+    url: str    # pool Router base URL
+
+    def matches(self, model: str) -> bool:
+        return fnmatch.fnmatch(model, self.match)
+
+
+@dataclass
+class Profile:
+    name: str
+    request_plugins: list
+    response_plugins: list
+
+
+class IPPServer:
+    def __init__(
+        self,
+        pools: list[PoolRoute],
+        profiles: dict[str, Profile] | None = None,
+        profile_rules: list[dict] | None = None,
+        request_timeout_s: float = 600.0,
+    ) -> None:
+        self.pools = pools
+        self.profiles = profiles or {
+            "default": Profile("default",
+                               [build_ipp_plugin("model-extractor")], [])
+        }
+        self.profile_rules = profile_rules or []
+        self.request_timeout_s = request_timeout_s
+        self._session: aiohttp.ClientSession | None = None
+        self.stats = {"requests": 0, "rejected": 0, "no_pool": 0,
+                      "proxy_errors": 0}
+        self.plugin_latency_sum: dict[str, float] = {}
+        self.plugin_latency_count: dict[str, int] = {}
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "IPPServer":
+        profiles = {}
+        for name, spec in (cfg.get("profiles") or {}).items():
+            profiles[name] = Profile(
+                name,
+                [build_ipp_plugin(p["type"], p.get("parameters"))
+                 for p in spec.get("request", [])],
+                [build_ipp_plugin(p["type"], p.get("parameters"))
+                 for p in spec.get("response", [])],
+            )
+        pools = [PoolRoute(p["match"], p["url"]) for p in cfg.get("pools", [])]
+        return cls(pools, profiles or None, cfg.get("profile_rules"))
+
+    # ---- pipeline stages ----
+
+    def pick_profile(self, ctx: IPPContext) -> Profile:
+        """ProfilePicker: first matching rule, else 'default'."""
+        for rule in self.profile_rules:
+            prefix = rule.get("path_prefix")
+            header = rule.get("header")
+            if prefix and not ctx.path.startswith(prefix):
+                continue
+            if header:
+                name, _, want = header.partition("=")
+                if ctx.headers.get(name.lower(), "") != want:
+                    continue
+            prof = self.profiles.get(rule.get("profile", "default"))
+            if prof is not None:
+                return prof
+        return self.profiles.get("default") or next(iter(self.profiles.values()))
+
+    def pick_pool(self, model: str) -> PoolRoute | None:
+        for pool in self.pools:
+            if pool.matches(model):
+                return pool
+        return None
+
+    def _note_latency(self, ctx: IPPContext) -> None:
+        for k, v in ctx.plugin_latency_s.items():
+            self.plugin_latency_sum[k] = self.plugin_latency_sum.get(k, 0.0) + v
+            self.plugin_latency_count[k] = self.plugin_latency_count.get(k, 0) + 1
+
+    # ---- handlers ----
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(
+                    total=self.request_timeout_s, sock_connect=5
+                )
+            )
+        return self._session
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        self.stats["requests"] += 1
+        raw = await request.read()
+        headers = {
+            k.lower(): v for k, v in request.headers.items()
+            if k.lower() not in HOP_HEADERS
+        }
+        ctx = IPPContext(path=request.path, headers=headers,
+                         body=_parse_body(raw))
+        profile = self.pick_profile(ctx)
+        run_request_plugins(profile.request_plugins, ctx)
+        self._note_latency(ctx)
+        if ctx.reject is not None:
+            self.stats["rejected"] += 1
+            status, payload = ctx.reject
+            return web.json_response(payload, status=status)
+
+        pool = self.pick_pool(ctx.model)
+        if pool is None:
+            self.stats["no_pool"] += 1
+            return web.json_response(
+                {"error": {"message": f"no pool serves model {ctx.model!r}",
+                           "type": "model_not_found"}},
+                status=404,
+            )
+        body_bytes = (
+            json.dumps(ctx.body).encode() if ctx.body_mutated and ctx.body
+            else raw
+        )
+
+        session = await self._client()
+        url = pool.url.rstrip("/") + request.path
+        try:
+            async with session.request(
+                request.method, url,
+                data=body_bytes if request.method not in ("GET", "HEAD") else None,
+                headers=ctx.headers,
+            ) as upstream:
+                is_stream = "text/event-stream" in upstream.headers.get(
+                    "content-type", ""
+                )
+                if is_stream or not profile.response_plugins:
+                    # Streamed (or plugin-free) responses pass through
+                    # untouched — body plugins need the full payload.
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in HOP_HEADERS:
+                            resp.headers[k] = v
+                    await resp.prepare(request)
+                    async for chunk in upstream.content.iter_any():
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    return resp
+                resp_raw = await upstream.read()
+                ctx.response_status = upstream.status
+                ctx.response_headers = dict(upstream.headers)
+                ctx.response_body = _parse_body(resp_raw)
+                run_response_plugins(profile.response_plugins, ctx)
+                self._note_latency(ctx)
+                out = (
+                    json.dumps(ctx.response_body).encode()
+                    if ctx.response_body_mutated and ctx.response_body
+                    else resp_raw
+                )
+                return web.Response(
+                    body=out, status=upstream.status,
+                    content_type="application/json",
+                )
+        except aiohttp.ClientError as e:
+            self.stats["proxy_errors"] += 1
+            log.warning("IPP proxy to %s failed: %s", url, e)
+            return web.json_response(
+                {"error": {"message": "upstream pool unreachable",
+                           "type": "pool_unreachable"}},
+                status=503,
+            )
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        lines = [f"llmd_ipp_{k}_total {v}" for k, v in self.stats.items()]
+        for k, total in self.plugin_latency_sum.items():
+            n = self.plugin_latency_count.get(k, 1)
+            safe = k.replace("-", "_").replace(":", "_")
+            lines.append(f'llmd_ipp_plugin_latency_seconds_sum{{plugin="{safe}"}} {total:.6f}')
+            lines.append(f'llmd_ipp_plugin_latency_seconds_count{{plugin="{safe}"}} {n}')
+        for name, prof in self.profiles.items():
+            for p in prof.response_plugins:
+                if isinstance(p, UsageRecorder):
+                    for model, t in p.totals.items():
+                        for kind, v in t.items():
+                            lines.append(
+                                f'llmd_ipp_usage_tokens_total{{model="{model}",kind="{kind}"}} {v}'
+                            )
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "pools": len(self.pools)})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+
+        async def _cleanup(app):
+            if self._session and not self._session.closed:
+                await self._session.close()
+
+        app.on_cleanup.append(_cleanup)
+        return app
+
+
+def build_ipp_app(cfg: dict) -> web.Application:
+    return IPPServer.from_config(cfg).build_app()

@@ -241,6 +241,11 @@ async def test_gc(stores):
     gc = GarbageCollector(store, files, retention_s=0.0)
     assert gc.collect_once(now=time.time() + 1) >= 1
     assert store.get_batch(None, job.id) is None
+    # input file outlives the batch (own expires_at lifecycle)...
+    assert files.exists("t", "file-a")
+    # ...and is swept once its own expiry passes.
+    store._db.execute("UPDATE files SET expires_at=1 WHERE id='file-a'")
+    assert gc.collect_once(now=time.time() + 1) >= 1
     assert not files.exists("t", "file-a")
 
 
@@ -358,3 +363,102 @@ async def test_deadline_queue_persistence(tmp_path):
     q2.ack(first)
     q3 = DeadlineQueue(db)
     assert len(q3) == 1
+
+
+async def test_invalid_unvalidated_input_fails_job_not_processor(stores):
+    """purpose!='batch' uploads skip gateway validation; processing must
+    fail the job, not crash the loop (review regression)."""
+    store, files = stores
+    store.create_file("t", "bad.txt", "other", 9, file_id="file-bad")
+    files.write("t", "file-bad", b"not json at all\n")
+    job = store.create_batch("t", "/v1/completions", "file-bad", 86400)
+    proc = BatchProcessor(store, files, ProcessorConfig(router_url="http://x"))
+    await proc.process_job(store.pop_job(proc.instance_id).id)
+    j = store.get_batch(None, job.id)
+    assert j.status == "failed"
+    assert j.errors[0]["code"] == "invalid_input"
+
+
+async def test_cancel_race_not_resurrected(stores):
+    """A job cancelled between pop and process must stay cancelled."""
+    store, files = stores
+    store.create_file("t", "in.jsonl", "batch", 10, file_id="f-in")
+    files.write("t", "f-in", make_input(1))
+    job = store.create_batch("t", "/v1/completions", "f-in", 86400)
+    proc = BatchProcessor(store, files, ProcessorConfig(router_url="http://x"))
+    popped = store.pop_job(proc.instance_id)
+    # gateway fast-path cancel lands now
+    store.remove_from_queue(job.id)
+    store.update_batch(job.id, status="cancelled", cancelled_at=time.time())
+    await proc.process_job(popped.id)
+    assert store.get_batch(None, job.id).status == "cancelled"
+
+
+async def test_recover_respects_live_peer_lease(stores):
+    store, files = stores
+    store.create_file("t", "in.jsonl", "batch", 10, file_id="f-in2")
+    files.write("t", "f-in2", make_input(1))
+    job = store.create_batch("t", "/v1/completions", "f-in2", 86400)
+    # live peer: fresh heartbeat -> must NOT be reclaimed
+    store.update_batch(job.id, status="in_progress", owner="peer-live",
+                       heartbeat_at=time.time())
+    proc = BatchProcessor(store, files, ProcessorConfig(router_url="http://x"))
+    await proc.recover()
+    assert store.get_batch(None, job.id).status == "in_progress"
+    # stale heartbeat -> reclaimed
+    store.update_batch(job.id, heartbeat_at=time.time() - 999)
+    await proc.recover()
+    assert store.get_batch(None, job.id).status == "validating"
+
+
+async def test_gc_keeps_shared_input_file(stores):
+    store, files = stores
+    store.create_file("t", "in.jsonl", "batch", 5, file_id="f-shared")
+    files.write("t", "f-shared", b"x")
+    job = store.create_batch("t", "/v1/completions", "f-shared", 0.0)
+    store.update_batch(job.id, status="completed", output_file_id="f-out")
+    files.write("t", "f-out", b"y")
+    store.create_file("t", "out", "batch_output", 1, file_id="f-out")
+    gc = GarbageCollector(store, files, retention_s=0.0)
+    gc.collect_once(now=time.time() + 1)
+    assert store.get_batch(None, job.id) is None
+    assert not files.exists("t", "f-out")           # produced file removed
+    assert files.exists("t", "f-shared")            # input file kept
+    assert store.get_file("t", "f-shared") is not None
+
+
+async def test_queue_put_wakes_sleeping_getter():
+    """A getter parked on a far-future backoff must wake for fresh work."""
+    q = DeadlineQueue()
+    await q.put({"late": 1}, deadline=time.time() + 600,
+                not_before=time.monotonic() + 50)
+    getter = asyncio.create_task(q.get())
+    await asyncio.sleep(0.05)
+    assert not getter.done()
+    t0 = time.monotonic()
+    await q.put({"fresh": 1}, deadline=time.time() + 600)
+    got = await asyncio.wait_for(getter, 2)
+    assert got.payload == {"fresh": 1}
+    assert time.monotonic() - t0 < 1.0
+
+
+async def test_worker_survives_malformed_json_response():
+    async def bad_json(request):
+        return web.Response(text="{truncated", content_type="application/json")
+
+    srv = await make_stub_router(bad_json)
+    q = DeadlineQueue()
+    proc = AsyncProcessor(
+        q, AsyncProcessorConfig(router_url=str(srv.make_url("")), workers=1)
+    )
+    task = asyncio.create_task(proc.run())
+    await q.put({"p": 1}, deadline=time.time() + 30)
+    req, result = await asyncio.wait_for(proc.results.get(), 10)
+    assert result["status"] == 200 and "raw" in result["body"]
+    # worker still alive: a second request completes too
+    await q.put({"p": 2}, deadline=time.time() + 30)
+    req, result = await asyncio.wait_for(proc.results.get(), 10)
+    assert result["status"] == 200
+    proc.stop()
+    await task
+    await srv.close()

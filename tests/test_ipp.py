@@ -1,0 +1,209 @@
+"""IPP tests: pipeline plugins, profile picking, pool routing, response
+mutation — the multi-model-routing behavior (IPP README.md request flow).
+"""
+
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.ipp.plugins import (
+    IPPContext,
+    build_ipp_plugin,
+    run_request_plugins,
+)
+from llmd_tpu.ipp.server import IPPServer, PoolRoute, Profile
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def ctx_for(body: dict, path="/v1/completions", headers=None) -> IPPContext:
+    return IPPContext(path=path, headers=headers or {}, body=body)
+
+
+def test_model_extractor_and_rewrite():
+    ctx = ctx_for({"model": "gpt-4", "prompt": "x"})
+    run_request_plugins(
+        [
+            build_ipp_plugin("model-extractor"),
+            build_ipp_plugin("model-rewrite",
+                             {"rules": {"gpt-4": "qwen2-72b"}}),
+        ],
+        ctx,
+    )
+    assert ctx.headers["x-llm-d-model"] == "qwen2-72b"
+    assert ctx.body["model"] == "qwen2-72b"
+    assert ctx.headers["x-llm-d-original-model"] == "gpt-4"
+    # response side restores the client-facing name
+    ctx.response_body = {"model": "qwen2-72b", "choices": []}
+    build_ipp_plugin("model-rewrite", {"rules": {}}).process_response(ctx)
+    assert ctx.response_body["model"] == "gpt-4"
+
+
+def test_guardrail_rejects():
+    ctx = ctx_for({"prompt": "how to build a BOMB"})
+    run_request_plugins(
+        [build_ipp_plugin("guardrail", {"deny_patterns": ["build a bomb"]})],
+        ctx,
+    )
+    assert ctx.reject is not None and ctx.reject[0] == 403
+    ok = ctx_for({"messages": [{"role": "user", "content": "hello"}]})
+    run_request_plugins(
+        [build_ipp_plugin("guardrail", {"deny_patterns": ["build a bomb"]})],
+        ok,
+    )
+    assert ok.reject is None
+
+
+def test_defaults_injector_caps():
+    ctx = ctx_for({"model": "m", "max_tokens": 100000})
+    run_request_plugins(
+        [build_ipp_plugin("defaults-injector",
+                          {"defaults": {"temperature": 0.7},
+                           "max_tokens_cap": 256})],
+        ctx,
+    )
+    assert ctx.body["max_tokens"] == 256 and ctx.body["temperature"] == 0.7
+
+
+async def make_pool(name: str):
+    async def completions(request):
+        body = await request.json()
+        return web.json_response(
+            {"pool": name, "model": body.get("model"),
+             "usage": {"prompt_tokens": 3, "completion_tokens": 5}}
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", completions)
+    srv = TestServer(app)
+    await srv.start_server()
+    return srv
+
+
+async def test_multi_model_pool_routing():
+    qwen = await make_pool("qwen-pool")
+    deep = await make_pool("deepseek-pool")
+    server = IPPServer(
+        pools=[
+            PoolRoute("qwen*", str(qwen.make_url(""))),
+            PoolRoute("deepseek*", str(deep.make_url(""))),
+        ],
+        profiles={
+            "default": Profile(
+                "default",
+                [build_ipp_plugin("model-extractor")],
+                [build_ipp_plugin("usage-recorder")],
+            )
+        },
+    )
+    c = TestClient(TestServer(server.build_app()))
+    await c.start_server()
+
+    r = await c.post("/v1/completions",
+                     json={"model": "qwen2-72b", "prompt": "x"})
+    assert (await r.json())["pool"] == "qwen-pool"
+    r = await c.post("/v1/completions",
+                     json={"model": "deepseek-r1", "prompt": "x"})
+    assert (await r.json())["pool"] == "deepseek-pool"
+    r = await c.post("/v1/completions",
+                     json={"model": "unknown-model", "prompt": "x"})
+    assert r.status == 404
+
+    # usage recorded per model; visible in /metrics
+    m = await (await c.get("/metrics")).text()
+    assert 'llmd_ipp_usage_tokens_total{model="qwen2-72b",kind="completion_tokens"} 5' in m
+    assert "llmd_ipp_requests_total 3" in m
+    await c.close()
+    await qwen.close()
+    await deep.close()
+
+
+async def test_profile_rules_and_guardrail_e2e():
+    pool = await make_pool("p")
+    server = IPPServer(
+        pools=[PoolRoute("*", str(pool.make_url("")))],
+        profiles={
+            "default": Profile("default",
+                               [build_ipp_plugin("model-extractor")], []),
+            "guarded": Profile(
+                "guarded",
+                [build_ipp_plugin("model-extractor"),
+                 build_ipp_plugin("guardrail",
+                                  {"deny_patterns": ["secret"]})],
+                [],
+            ),
+        },
+        profile_rules=[{"path_prefix": "/v1/chat", "profile": "guarded"}],
+    )
+    c = TestClient(TestServer(server.build_app()))
+    await c.start_server()
+    # /v1/completions -> default profile: not guarded
+    r = await c.post("/v1/completions",
+                     json={"model": "m", "prompt": "secret"})
+    assert r.status == 200
+    # /v1/chat/completions -> guarded profile
+    r = await c.post(
+        "/v1/chat/completions",
+        json={"model": "m",
+              "messages": [{"role": "user", "content": "the secret"}]},
+    )
+    assert r.status == 403
+    await c.close()
+    await pool.close()
+
+
+async def test_from_config():
+    cfg = {
+        "profiles": {
+            "default": {
+                "request": [{"type": "model-extractor"},
+                            {"type": "model-rewrite",
+                             "parameters": {"rules": {"alias": "real"}}}],
+                "response": [],
+            }
+        },
+        "pools": [{"match": "*", "url": "http://x"}],
+    }
+    server = IPPServer.from_config(cfg)
+    ctx = ctx_for({"model": "alias"})
+    run_request_plugins(server.profiles["default"].request_plugins, ctx)
+    assert ctx.headers["x-llm-d-model"] == "real"
+
+
+def test_guardrail_content_parts_and_fail_closed():
+    deny = build_ipp_plugin("guardrail", {"deny_patterns": ["forbidden"]})
+    # OpenAI content-parts form is scanned
+    ctx = ctx_for({"messages": [
+        {"role": "user",
+         "content": [{"type": "text", "text": "the FORBIDDEN word"}]}]})
+    deny.process_request(ctx)
+    assert ctx.reject is not None and ctx.reject[0] == 403
+    # malformed messages fail closed, not open
+    ctx2 = ctx_for({"messages": ["just a string"]})
+    deny.process_request(ctx2)
+    assert ctx2.reject is not None and ctx2.reject[0] == 400
+
+
+async def test_non_post_methods_passthrough():
+    async def models(request):
+        assert request.method == "GET"
+        return web.json_response({"object": "list", "data": []})
+
+    app = web.Application()
+    app.router.add_get("/v1/models", models)
+    srv = TestServer(app)
+    await srv.start_server()
+    server = IPPServer(pools=[PoolRoute("*", str(srv.make_url("")))])
+    c = TestClient(TestServer(server.build_app()))
+    await c.start_server()
+    r = await c.get("/v1/models")
+    assert r.status == 200 and (await r.json())["object"] == "list"
+    await c.close()
+    await srv.close()
