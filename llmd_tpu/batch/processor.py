@@ -195,6 +195,8 @@ class BatchProcessor:
                 if not raw_line.strip():
                     continue
                 line = json.loads(raw_line)
+                if not isinstance(line, dict):
+                    raise ValueError("line is not a JSON object")
                 if not isinstance(line.get("custom_id"), str) or not isinstance(
                     line.get("body"), dict
                 ) or not isinstance(line.get("url"), str):

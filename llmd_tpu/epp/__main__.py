@@ -73,6 +73,9 @@ def main(argv=None) -> None:
         flow_control=build_flow_control(config),
         collector=MetricsCollector(store, interval_s=args.scrape_interval),
         discovery=FileDiscoverySource(store, args.endpoints_file),
+        default_parser=config.get("requestHandler", {}).get(
+            "parser", "openai-parser"
+        ),
     )
     # Wires token-producer + KV-event subscription iff the config declares
     # a precise-prefix-cache-scorer (no-op otherwise).

@@ -29,9 +29,36 @@ GENERATE_PATHS = {
     "/v1/responses",
 }
 
+# vLLM gRPC surface (reference request-handling.md: `vllmgrpc-parser`
+# handles Generate/Embed, token-in/token-out only). We accept the
+# gRPC-JSON-transcoded form of those RPCs on these paths.
+VLLMGRPC_PATHS = {
+    "/vllm.Generation/Generate",
+    "/vllm.Generation/Embed",
+}
+
 
 class ParseError(ValueError):
     pass
+
+
+def _float_hdr(h: dict[str, str], name: str) -> float | None:
+    v = h.get(name)
+    try:
+        return float(v) if v is not None else None
+    except ValueError:
+        return None
+
+
+def _common_kwargs(h: dict[str, str]) -> dict[str, Any]:
+    """LLMRequest fields every parser derives from headers the same way."""
+    return {
+        "request_id": h.get("x-request-id") or f"epp-{uuid.uuid4().hex}",
+        "headers": h,
+        "fairness_id": h.get(HDR_FAIRNESS_ID, ""),
+        "ttft_slo_ms": _float_hdr(h, HDR_TTFT_SLO),
+        "tpot_slo_ms": _float_hdr(h, HDR_TPOT_SLO),
+    }
 
 
 def _messages_text(msgs: list) -> str:
@@ -77,28 +104,107 @@ def openai_parse(
         raise ParseError("request body must be a JSON object")
     prompt_text, prompt_ids = _prompt_from_body(path, body)
     h = {k.lower(): v for k, v in headers.items()}
-
-    def _float_hdr(name: str) -> float | None:
-        v = h.get(name)
-        try:
-            return float(v) if v is not None else None
-        except ValueError:
-            return None
-
+    try:
+        priority = int(body.get("priority", 0) or 0)
+    except (TypeError, ValueError) as e:
+        raise ParseError(f"priority must be an int: {e}") from e
     return LLMRequest(
-        request_id=h.get("x-request-id") or f"epp-{uuid.uuid4().hex}",
         model=str(body.get("model") or ""),
         prompt_text=prompt_text,
         prompt_token_ids=prompt_ids,
-        headers=h,
         body=body,
         path=path,
         streaming=bool(body.get("stream", False)),
-        priority=int(body.get("priority", 0) or 0),
-        fairness_id=h.get(HDR_FAIRNESS_ID, ""),
-        ttft_slo_ms=_float_hdr(HDR_TTFT_SLO),
-        tpot_slo_ms=_float_hdr(HDR_TPOT_SLO),
+        priority=priority,
+        **_common_kwargs(h),
     )
+
+
+def vllmgrpc_parse(
+    path: str, headers: dict[str, str], raw_body: bytes
+) -> LLMRequest:
+    """The vllmgrpc-parser: vLLM gRPC Generate/Embed (JSON-transcoded).
+
+    Token-in/token-out only (reference request-handling.md:50-86 — the
+    gRPC surface never carries prompt text), so prefix affinity runs on
+    ``prompt_token_ids`` directly and no tokenizer round-trip is needed.
+    """
+    try:
+        body: dict[str, Any] = json.loads(raw_body) if raw_body else {}
+    except json.JSONDecodeError as e:
+        raise ParseError(f"invalid JSON body: {e}") from e
+    if not isinstance(body, dict):
+        raise ParseError("request body must be a JSON object")
+    ids = body.get("prompt_token_ids") or body.get("token_ids") or []
+    if not isinstance(ids, list) or not all(isinstance(t, int) for t in ids):
+        raise ParseError("prompt_token_ids must be a list of ints")
+    params = body.get("sampling_params") or {}
+    if not isinstance(params, dict):
+        raise ParseError("sampling_params must be an object")
+    try:
+        priority = int(params.get("priority", 0) or 0)
+    except (TypeError, ValueError) as e:
+        raise ParseError(f"priority must be an int: {e}") from e
+    h = {k.lower(): v for k, v in headers.items()}
+    return LLMRequest(
+        model=str(body.get("model") or ""),
+        prompt_text="",
+        prompt_token_ids=list(ids),
+        body=body,
+        path=path,
+        streaming=bool(body.get("stream", False)),
+        priority=priority,
+        **_common_kwargs(h),
+    )
+
+
+def passthrough_parse(
+    path: str, headers: dict[str, str], raw_body: bytes
+) -> LLMRequest:
+    """The passthrough-parser: opaque body, headers-only routing.
+
+    For payloads the EPP must not interpret (reference
+    request-handling.md:50-86): model comes from the `x-llm-d-model`
+    header if present, prompt-aware plugins see an empty prompt, and the
+    body bytes are forwarded untouched.
+    """
+    h = {k.lower(): v for k, v in headers.items()}
+    try:
+        priority = int(h.get("x-llm-d-priority", 0) or 0)
+    except ValueError:
+        priority = 0
+    return LLMRequest(
+        model=h.get("x-llm-d-model", ""),
+        prompt_text="",
+        prompt_token_ids=None,
+        body={},
+        path=path,
+        streaming="text/event-stream" in h.get("accept", ""),
+        priority=priority,
+        **_common_kwargs(h),
+    )
+
+
+# Parser plugin registry (reference request-handling.md:50-55 names).
+PARSERS = {
+    "openai-parser": openai_parse,
+    "vllmgrpc-parser": vllmgrpc_parse,
+    "passthrough-parser": passthrough_parse,
+}
+
+
+def parse_request(
+    path: str,
+    headers: dict[str, str],
+    raw_body: bytes,
+    default_parser: str = "openai-parser",
+) -> LLMRequest:
+    """Dispatch to the parser owning this path (gRPC paths always win)."""
+    if path in VLLMGRPC_PATHS:
+        return vllmgrpc_parse(path, headers, raw_body)
+    if path in GENERATE_PATHS:
+        return openai_parse(path, headers, raw_body)
+    return PARSERS[default_parser](path, headers, raw_body)
 
 
 class Admitter:

@@ -23,9 +23,10 @@ from llmd_tpu.epp.datalayer import EndpointStore, FileDiscoverySource, MetricsCo
 from llmd_tpu.epp.flow_control import OUTCOME_HTTP, FlowControl, Outcome
 from llmd_tpu.epp.handler import (
     GENERATE_PATHS,
+    VLLMGRPC_PATHS,
     Admitter,
     ParseError,
-    openai_parse,
+    parse_request,
 )
 from llmd_tpu.epp.scheduler import NoEndpointsError, Scheduler
 from llmd_tpu.epp.types import (
@@ -115,6 +116,7 @@ class Router:
         producers: list | None = None,
         request_timeout_s: float = 600.0,
         max_schedule_attempts: int = 2,
+        default_parser: str = "openai-parser",
     ) -> None:
         self.store = store
         self.scheduler = scheduler
@@ -131,6 +133,10 @@ class Router:
         self.metrics = RouterMetrics()
         self.request_timeout_s = request_timeout_s
         self.max_schedule_attempts = max_schedule_attempts
+        # Parser for paths outside the OpenAI/vllm-gRPC sets
+        # ("passthrough-parser" routes opaque payloads through the
+        # scheduler instead of the unscored passthrough handler).
+        self.default_parser = default_parser
         self._session: aiohttp.ClientSession | None = None
         # Async callbacks (req, pod, ttft_ms|None, tpot_ms|None) fired after
         # each proxied request — the latency-predictor training feedback
@@ -170,7 +176,9 @@ class Router:
         self.metrics.requests_total += 1
         raw = await request.read()
         try:
-            req = openai_parse(request.path, dict(request.headers), raw)
+            req = parse_request(
+                request.path, dict(request.headers), raw, self.default_parser
+            )
         except ParseError as e:
             return web.json_response(
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
@@ -409,8 +417,11 @@ class Router:
             web.get("/metrics", self.handle_metrics),
             web.get("/endpoints", self.handle_endpoints),
         ]
-        for path in sorted(GENERATE_PATHS):
+        for path in sorted(GENERATE_PATHS | VLLMGRPC_PATHS):
             routes.append(web.post(path, self.handle_generate))
+        if self.default_parser == "passthrough-parser":
+            # Opaque payloads still get scheduled (headers-only routing).
+            routes.append(web.post("/{tail:.*}", self.handle_generate))
         routes.append(web.route("*", "/{tail:.*}", self.handle_passthrough))
         app.add_routes(routes)
 

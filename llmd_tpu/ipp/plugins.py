@@ -70,9 +70,12 @@ class IPPContext:
 
     @property
     def model(self) -> str:
-        return self.headers.get("x-llm-d-model", "") or (
+        # str() guards non-string JSON values ({"model": 123}) from
+        # reaching fnmatch / header forwarding.
+        v = self.headers.get("x-llm-d-model", "") or (
             (self.body or {}).get("model", "") if self.body else ""
         )
+        return str(v) if v is not None else ""
 
     def set_body(self, body: dict) -> None:
         self.body = body
@@ -126,7 +129,7 @@ class ModelExtractor(IPPPlugin):
     def process_request(self, ctx: IPPContext) -> None:
         model = (ctx.body or {}).get("model") or self.default_model
         if model:
-            ctx.headers["x-llm-d-model"] = model
+            ctx.headers["x-llm-d-model"] = str(model)
 
 
 @ipp_plugin("model-rewrite")

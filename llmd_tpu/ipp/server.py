@@ -20,6 +20,7 @@ Config shape (YAML):
 
 from __future__ import annotations
 
+import asyncio
 import fnmatch
 import json
 import logging
@@ -119,8 +120,12 @@ class IPPServer:
                 return pool
         return None
 
-    def _note_latency(self, ctx: IPPContext) -> None:
+    def _note_latency(self, ctx: IPPContext, response_only: bool = False) -> None:
+        # ctx.plugin_latency_s is cumulative across the request; the
+        # response-phase call must not re-count request-plugin entries.
         for k, v in ctx.plugin_latency_s.items():
+            if response_only != k.startswith("resp:"):
+                continue
             self.plugin_latency_sum[k] = self.plugin_latency_sum.get(k, 0.0) + v
             self.plugin_latency_count[k] = self.plugin_latency_count.get(k, 0) + 1
 
@@ -184,8 +189,15 @@ class IPPServer:
                         if k.lower() not in HOP_HEADERS:
                             resp.headers[k] = v
                     await resp.prepare(request)
-                    async for chunk in upstream.content.iter_any():
-                        await resp.write(chunk)
+                    try:
+                        async for chunk in upstream.content.iter_any():
+                            await resp.write(chunk)
+                    except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                        # Mid-stream upstream death: the response is already
+                        # prepared, so a shaped error body is impossible —
+                        # truncate cleanly instead of erroring twice.
+                        self.stats["proxy_errors"] += 1
+                        log.warning("IPP stream from %s died: %s", url, e)
                     await resp.write_eof()
                     return resp
                 resp_raw = await upstream.read()
@@ -193,7 +205,7 @@ class IPPServer:
                 ctx.response_headers = dict(upstream.headers)
                 ctx.response_body = _parse_body(resp_raw)
                 run_response_plugins(profile.response_plugins, ctx)
-                self._note_latency(ctx)
+                self._note_latency(ctx, response_only=True)
                 out = (
                     json.dumps(ctx.response_body).encode()
                     if ctx.response_body_mutated and ctx.response_body
@@ -203,7 +215,7 @@ class IPPServer:
                     body=out, status=upstream.status,
                     content_type="application/json",
                 )
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             self.stats["proxy_errors"] += 1
             log.warning("IPP proxy to %s failed: %s", url, e)
             return web.json_response(

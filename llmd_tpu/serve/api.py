@@ -332,6 +332,128 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     )
 
 
+async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
+    """vLLM gRPC Generate, JSON-transcoded: token-in / token-out.
+
+    The EPP's `vllmgrpc-parser` routes these (reference
+    request-handling.md:50-86); the engine surface never detokenizes —
+    clients own the tokenizer. Streamed form emits SSE frames of
+    {"token_ids": [...]}, final frame carries finish_reason + usage.
+    """
+    engine = request.app[ENGINE_KEY]
+    max_len = request.app[MAXLEN_KEY]
+    model = request.app[MODEL_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error(400, f"invalid JSON: {e}")
+    ids = body.get("prompt_token_ids") or body.get("token_ids") or []
+    if not isinstance(ids, list) or not all(isinstance(t, int) for t in ids):
+        return _error(400, "prompt_token_ids must be a list of ints")
+    if not ids:
+        return _error(400, "empty prompt_token_ids")
+    if len(ids) >= max_len:
+        return _error(400, f"prompt length {len(ids)} >= max_model_len {max_len}")
+    sp = body.get("sampling_params") or {}
+    if not isinstance(sp, dict):
+        return _error(400, "sampling_params must be an object")
+    budget = max_len - len(ids)
+    eos = getattr(request.app[TOK_KEY], "eos_token_id", None)
+    try:
+        stops = [int(t) for t in (sp.get("stop_token_ids") or [])]
+        if eos is not None and not sp.get("ignore_eos", False):
+            stops.append(int(eos))
+        sampling = SamplingParams(
+            max_tokens=min(int(sp.get("max_tokens", budget) or budget), budget),
+            temperature=float(sp.get("temperature", 1.0)),
+            top_k=int(sp.get("top_k", 0) or 0),
+            top_p=float(sp.get("top_p", 1.0)),
+            stop_token_ids=tuple(stops),
+            ignore_eos=bool(sp.get("ignore_eos", False)),
+            seed=sp.get("seed"),
+        )
+        priority = int(sp.get("priority", 0) or 0)
+    except (TypeError, ValueError) as e:
+        return _error(400, f"invalid sampling_params: {e}")
+    rid = request.headers.get("x-request-id") or P.request_id("grpcgen")
+    kvp = body.get("kv_transfer_params")
+
+    if body.get("stream", False):
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "x-request-id": rid,
+            }
+        )
+        await resp.prepare(request)
+        final = None
+        try:
+            async for out in engine.generate(rid, ids, sampling, priority, kvp):
+                final = out
+                if out.new_token_ids:
+                    await resp.write(_sse({"token_ids": list(out.new_token_ids)}))
+        except (RequestFailed, EngineError) as e:
+            code = 400 if isinstance(e, RequestFailed) else 500
+            await resp.write(_sse(P.error_body(str(e), code=code)))
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        except (asyncio.CancelledError, ConnectionResetError):
+            engine.abort(rid)
+            raise
+        await resp.write(
+            _sse(
+                {
+                    "finish_reason": (
+                        final.finish_reason.value
+                        if final is not None and final.finish_reason
+                        else None
+                    ),
+                    "usage": P.usage_dict(
+                        len(ids),
+                        final.num_output_tokens if final else 0,
+                        final.num_cached_tokens if final else 0,
+                    ),
+                }
+            )
+        )
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    out_ids: list[int] = []
+    final = None
+    try:
+        async for out in engine.generate(rid, ids, sampling, priority, kvp):
+            final = out
+            out_ids.extend(out.new_token_ids)
+    except RequestFailed as e:
+        return _error(400, str(e))
+    except EngineError as e:
+        return web.json_response(
+            P.error_body(str(e), etype="internal_error", code=500), status=500
+        )
+    return web.json_response(
+        {
+            "id": rid,
+            "model": model,
+            "token_ids": out_ids,
+            "finish_reason": (
+                final.finish_reason.value
+                if final is not None and final.finish_reason
+                else None
+            ),
+            "usage": P.usage_dict(
+                len(ids),
+                final.num_output_tokens if final else 0,
+                final.num_cached_tokens if final else 0,
+            ),
+            "kv_transfer_params": final.kv_transfer_params if final else None,
+        },
+        headers={"x-request-id": rid},
+    )
+
+
 async def handle_completions(request: web.Request) -> web.StreamResponse:
     return await _handle_generate(request, chat=False)
 
@@ -362,6 +484,7 @@ def build_app(
             web.get("/metrics", handle_metrics),
             web.post("/tokenize", handle_tokenize),
             web.post("/v1/completions", handle_completions),
+            web.post("/vllm.Generation/Generate", handle_grpc_generate),
             web.post("/v1/chat/completions", handle_chat),
             web.post("/v1/completions/render", handle_completions_render),
             web.post("/v1/chat/completions/render", handle_chat_render),

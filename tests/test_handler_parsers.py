@@ -1,0 +1,105 @@
+"""Request-handler parser plugins: openai / vllmgrpc / passthrough.
+
+Reference surface: docs/architecture/core/router/epp/request-handling.md:50-86
+names three parser plugins — `openai-parser`, `vllmgrpc-parser`
+(Generate/Embed, token-in/token-out only), `passthrough-parser`.
+"""
+
+import json
+
+import pytest
+
+from llmd_tpu.epp.handler import (
+    PARSERS,
+    ParseError,
+    openai_parse,
+    parse_request,
+    passthrough_parse,
+    vllmgrpc_parse,
+)
+
+
+def test_registry_names():
+    assert set(PARSERS) == {
+        "openai-parser",
+        "vllmgrpc-parser",
+        "passthrough-parser",
+    }
+
+
+def test_vllmgrpc_generate_tokens():
+    body = json.dumps(
+        {
+            "model": "m",
+            "prompt_token_ids": [1, 2, 3, 4],
+            "sampling_params": {"max_tokens": 8, "priority": 2},
+            "stream": True,
+        }
+    ).encode()
+    req = vllmgrpc_parse("/vllm.Generation/Generate", {}, body)
+    assert req.prompt_token_ids == [1, 2, 3, 4]
+    assert req.approx_prompt_tokens == 4
+    assert req.prompt_text == ""
+    assert req.model == "m"
+    assert req.streaming is True
+    assert req.priority == 2
+
+
+def test_vllmgrpc_rejects_text_prompt():
+    with pytest.raises(ParseError):
+        vllmgrpc_parse(
+            "/vllm.Generation/Generate",
+            {},
+            json.dumps({"prompt_token_ids": "not tokens"}).encode(),
+        )
+
+
+def test_vllmgrpc_slo_headers():
+    req = vllmgrpc_parse(
+        "/vllm.Generation/Generate",
+        {"X-LLM-D-SLO-TTFT-MS": "150", "x-llm-d-fairness-id": "t1"},
+        json.dumps({"token_ids": [5, 6]}).encode(),
+    )
+    assert req.ttft_slo_ms == 150.0
+    assert req.fairness_id == "t1"
+
+
+def test_passthrough_opaque_body():
+    raw = b"\x00\x01binary-not-json"
+    req = passthrough_parse(
+        "/custom/infer",
+        {"x-llm-d-model": "m2", "accept": "text/event-stream"},
+        raw,
+    )
+    assert req.model == "m2"
+    assert req.body == {}
+    assert req.prompt_text == ""
+    assert req.streaming is True
+
+
+def test_parse_request_dispatch():
+    oai = parse_request(
+        "/v1/completions", {}, json.dumps({"prompt": "hi", "model": "m"}).encode()
+    )
+    assert oai.prompt_text == "hi"
+    grpc = parse_request(
+        "/vllm.Generation/Embed", {}, json.dumps({"token_ids": [9]}).encode()
+    )
+    assert grpc.prompt_token_ids == [9]
+    # unknown path + passthrough default -> headers-only request
+    pt = parse_request("/x", {"x-llm-d-model": "m3"}, b"{}", "passthrough-parser")
+    assert pt.model == "m3"
+    # unknown path + openai default parses the JSON body
+    oai2 = parse_request("/x", {}, json.dumps({"prompt": "p"}).encode())
+    assert oai2.prompt_text == "p"
+
+
+def test_openai_parse_responses_structured_input():
+    body = json.dumps(
+        {
+            "model": "m",
+            "input": [{"role": "user", "content": "hello"}],
+        }
+    ).encode()
+    req = openai_parse("/v1/responses", {}, body)
+    assert "hello" in req.prompt_text
