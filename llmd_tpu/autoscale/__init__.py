@@ -1,0 +1,35 @@
+"""Workload Variant Autoscaler (WVA), TPU-native.
+
+Re-implements the reference WVA behavior
+(docs/architecture/advanced/autoscaling/hpa-wva.md:7-120): a 30s
+Collect -> Analyze -> Optimize -> Enforce pipeline producing per-variant
+desired replica counts, published as the `wva_desired_replicas` metric,
+with a separate 100ms scale-from-zero poller on the EPP flow-control
+queue. Variants are hardware/serving configurations of the same base
+model (e.g. v5e TP=4 vs v5p TP=8) with an associated cost; the optimizer
+scales up the cheapest variant and scales down the most expensive.
+"""
+
+from llmd_tpu.autoscale.types import (
+    PoolSnapshot,
+    ReplicaMetrics,
+    VariantDecision,
+    VariantSpec,
+)
+from llmd_tpu.autoscale.analyzers import (
+    SaturationPercentAnalyzer,
+    SaturationTokenAnalyzer,
+    SloQueueingAnalyzer,
+)
+from llmd_tpu.autoscale.engine import WvaEngine
+
+__all__ = [
+    "PoolSnapshot",
+    "ReplicaMetrics",
+    "VariantDecision",
+    "VariantSpec",
+    "SaturationPercentAnalyzer",
+    "SaturationTokenAnalyzer",
+    "SloQueueingAnalyzer",
+    "WvaEngine",
+]

@@ -1,0 +1,72 @@
+"""`python -m llmd_tpu.autoscale` — standalone WVA process.
+
+Points at a running router (the EPP), reads a variants config JSON, and
+serves `wva_desired_replicas` on /metrics for an HPA/KEDA-style consumer
+(or writes decisions to --decisions-file for a process manager).
+
+Variants config shape:
+    {
+      "model_id": "llama-3-8b",
+      "variants": [
+        {"name": "v5e-tp4", "cost": 1.0, "accelerator_units": 4,
+         "min_replicas": 0, "max_replicas": 8,
+         "max_batched_tokens": 8192, "max_num_seqs": 256},
+        {"name": "v5p-tp8", "cost": 2.6, "accelerator_units": 8}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("llmd-tpu wva")
+    p.add_argument("--router-url", required=True)
+    p.add_argument("--variants-config", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9200)
+    p.add_argument(
+        "--analyzer",
+        default="saturation-percentage-based",
+        choices=["saturation-percentage-based", "saturation-token-based", "slo"],
+    )
+    p.add_argument("--interval", type=float, default=30.0)
+    p.add_argument("--scale-to-zero", action="store_true")
+    p.add_argument("--retention-period", type=float, default=600.0)
+    p.add_argument("--target-ttft-ms", type=float, default=None)
+    p.add_argument("--target-itl-ms", type=float, default=None)
+    p.add_argument("--decisions-file", default=None)
+    args = p.parse_args(argv)
+
+    from aiohttp import web
+
+    from llmd_tpu.autoscale.engine import RouterCollector, WvaEngine, file_actuator
+    from llmd_tpu.autoscale.types import VariantSpec
+
+    with open(args.variants_config) as f:
+        cfg = json.load(f)
+    model_id = cfg["model_id"]
+    variants = {
+        model_id: [VariantSpec(**v) for v in cfg.get("variants", [])]
+    }
+    engine = WvaEngine(
+        collector=RouterCollector(
+            args.router_url, model_id, retention_s=args.retention_period
+        ),
+        variants=variants,
+        analyzer=args.analyzer,
+        interval_s=args.interval,
+        scale_to_zero=args.scale_to_zero,
+        slo_targets=(args.target_ttft_ms, args.target_itl_ms),
+        actuator=file_actuator(args.decisions_file) if args.decisions_file else None,
+    )
+    web.run_app(engine.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

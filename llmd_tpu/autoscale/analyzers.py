@@ -1,0 +1,353 @@
+"""WVA analyzers: saturation (percentage V1 / token V2) and SLO queueing.
+
+Reference behavior: hpa-wva.md "Saturation Analyzer" and "SLO Analyzer"
+sections. Analyzers quantify needed/spare capacity; they never scale
+directly — the optimizer turns signals into variant decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import statistics
+
+from llmd_tpu.autoscale.types import CapacitySignal, PoolSnapshot, ReplicaMetrics
+
+
+class SaturationPercentAnalyzer:
+    """V1 `saturation-percentage-based` (default).
+
+    A replica is saturated when KV usage >= kv_threshold (0.80) or queue
+    length >= queue_threshold (5). Scale-up triggers when average spare KV
+    capacity < kv_spare_trigger (0.10) OR average spare queue capacity <
+    queue_spare_trigger (3). Scale-down is safe only when >= 2 replicas are
+    non-saturated and a simulated N/(N-1) load redistribution still leaves
+    headroom. All scaling is blocked while any variant is transitioning
+    (desired != current).
+    """
+
+    def __init__(
+        self,
+        kv_threshold: float = 0.80,
+        queue_threshold: float = 5.0,
+        kv_spare_trigger: float = 0.10,
+        queue_spare_trigger: float = 3.0,
+    ) -> None:
+        self.kv_threshold = kv_threshold
+        self.queue_threshold = queue_threshold
+        self.kv_spare_trigger = kv_spare_trigger
+        self.queue_spare_trigger = queue_spare_trigger
+
+    def saturated(self, r: ReplicaMetrics) -> bool:
+        return r.kv_usage >= self.kv_threshold or r.queue_len >= self.queue_threshold
+
+    def analyze(self, snap: PoolSnapshot) -> CapacitySignal:
+        sig = CapacitySignal(model_id=snap.model_id, unit="replicas")
+        for variant, desired in snap.desired.items():
+            if desired != snap.current_count(variant):
+                sig.blocked = True
+                return sig
+        ready = [r for r in snap.replicas if r.ready]
+        if not ready:
+            # Nothing running: demand exists iff the EPP queue is non-empty
+            # (scale-from-zero also covers this on its fast path).
+            sig.required = 1.0 if snap.epp_queue_size > 0 else 0.0
+            return sig
+
+        avg_spare_kv = sum(
+            max(0.0, self.kv_threshold - r.kv_usage) for r in ready
+        ) / len(ready)
+        avg_spare_queue = sum(
+            max(0.0, self.queue_threshold - r.queue_len) for r in ready
+        ) / len(ready)
+        sig.priority = 1.0 - avg_spare_kv / max(self.kv_threshold, 1e-9)
+
+        if avg_spare_kv < self.kv_spare_trigger or avg_spare_queue < self.queue_spare_trigger:
+            sig.required = 1.0
+            return sig
+
+        non_saturated = [r for r in ready if not self.saturated(r)]
+        n = len(ready)
+        if len(non_saturated) >= 2 and n >= 2:
+            # Simulate removing one replica: remaining N-1 absorb its load.
+            redistributed_kv = sum(r.kv_usage for r in ready) / (n - 1)
+            redistributed_q = sum(r.queue_len for r in ready) / (n - 1)
+            if (
+                redistributed_kv <= self.kv_threshold - self.kv_spare_trigger
+                and redistributed_q <= self.queue_threshold - self.queue_spare_trigger
+            ):
+                sig.spare = 1.0
+        return sig
+
+
+@dataclasses.dataclass
+class _ComputeBoundHistory:
+    """Rolling window of observed compute-bound token capacity (k2),
+    bucketed by output-length workload class (reference: short < 100,
+    medium < 500, long >= 500 output tokens; window size 10)."""
+
+    window: int = 10
+    buckets: dict[str, list[float]] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def bucket(avg_output_tokens: float) -> str:
+        if avg_output_tokens < 100:
+            return "short"
+        if avg_output_tokens < 500:
+            return "medium"
+        return "long"
+
+    def observe(self, avg_output_tokens: float, k2: float) -> None:
+        b = self.buckets.setdefault(self.bucket(avg_output_tokens), [])
+        b.append(k2)
+        del b[: max(0, len(b) - self.window)]
+
+    def mean(self, avg_output_tokens: float) -> float | None:
+        b = self.buckets.get(self.bucket(avg_output_tokens))
+        return sum(b) / len(b) if b else None
+
+
+class SaturationTokenAnalyzer:
+    """V2 `saturation-token-based` (experimental in the reference).
+
+    Per-replica capacity = min(k1, k2) where k1 is the memory bound
+    (KV capacity tokens x kv_threshold) and k2 the compute bound resolved
+    through the priority chain observed -> historical -> derived-from-args
+    -> k1. Variant capacity aggregates by median across ready replicas and
+    is cached for zero-replica variants. Demand = tokens in use + queued
+    requests x avg input length, plus the EPP queue demand. Signals:
+    required = demand/scale_up_threshold - supply (positive => scale up),
+    spare = supply - demand/scale_down_boundary (positive => may scale
+    down). Defaults 0.85 / 0.70.
+    """
+
+    def __init__(
+        self,
+        kv_threshold: float = 0.80,
+        queue_threshold: float = 5.0,
+        scale_up_threshold: float = 0.85,
+        scale_down_boundary: float = 0.70,
+    ) -> None:
+        self.kv_threshold = kv_threshold
+        self.queue_threshold = queue_threshold
+        self.scale_up_threshold = scale_up_threshold
+        self.scale_down_boundary = scale_down_boundary
+        self._history: dict[str, _ComputeBoundHistory] = {}
+        # Last known per-replica capacity per variant — kept so variants at
+        # zero replicas still cost-compare accurately (reference "capacity
+        # knowledge is cached for zero-replica variants").
+        self.capacity_cache: dict[str, float] = {}
+
+    # ---- capacity model ----
+
+    @staticmethod
+    def derived_k2(
+        max_batched_tokens: float,
+        max_num_seqs: float,
+        avg_input_tokens: float,
+        avg_output_tokens: float,
+    ) -> float:
+        """Steady-state batching model: the decode batch sustains up to
+        max_num_seqs concurrent sequences, each holding in+out tokens of
+        KV, but the per-step token budget caps concurrency at
+        max_batched_tokens."""
+        avg_total = max(avg_input_tokens + avg_output_tokens, 1.0)
+        concurrent = min(max_num_seqs, max(max_batched_tokens, 1.0))
+        return concurrent * avg_total
+
+    def replica_capacity(self, r: ReplicaMetrics, spec=None) -> float:
+        k1 = r.kv_capacity_tokens * self.kv_threshold
+        hist = self._history.setdefault(r.variant, _ComputeBoundHistory())
+        if r.queue_len >= self.queue_threshold and r.tokens_in_use > 0:
+            k2 = r.tokens_in_use  # observed at saturation
+            hist.observe(r.avg_output_tokens, k2)
+        else:
+            k2 = hist.mean(r.avg_output_tokens)
+            if k2 is None and spec is not None:
+                k2 = self.derived_k2(
+                    spec.max_batched_tokens,
+                    spec.max_num_seqs,
+                    r.avg_input_tokens,
+                    r.avg_output_tokens,
+                )
+            if k2 is None:
+                k2 = k1  # memory-only fallback
+        return min(k1, k2) if k1 > 0 else k2
+
+    def variant_capacity(
+        self, variant: str, replicas: list[ReplicaMetrics], spec=None
+    ) -> float:
+        ready = [r for r in replicas if r.ready]
+        if not ready:
+            return self.capacity_cache.get(variant, 0.0)
+        cap = statistics.median(self.replica_capacity(r, spec) for r in ready)
+        self.capacity_cache[variant] = cap
+        return cap
+
+    # ---- demand model ----
+
+    @staticmethod
+    def replica_demand(r: ReplicaMetrics) -> float:
+        return r.tokens_in_use + r.queue_len * max(r.avg_input_tokens, 1.0)
+
+    def analyze(self, snap: PoolSnapshot, specs: dict | None = None) -> CapacitySignal:
+        specs = specs or {}
+        sig = CapacitySignal(model_id=snap.model_id, unit="tokens")
+        ready = [r for r in snap.replicas if r.ready]
+        # Aggregate per-variant (median) — also refreshes capacity_cache so
+        # zero-replica variants keep a capacity estimate.
+        supply = 0.0
+        for variant, reps in snap.by_variant().items():
+            live = [r for r in reps if r.ready]
+            if live:
+                supply += self.variant_capacity(
+                    variant, live, specs.get(variant)
+                ) * len(live)
+        avg_in = (
+            sum(r.avg_input_tokens for r in ready) / len(ready) if ready else 512.0
+        )
+        demand = sum(self.replica_demand(r) for r in ready)
+        demand += snap.epp_queue_size * max(avg_in, 1.0)
+        sig.required = max(0.0, demand / self.scale_up_threshold - supply)
+        sig.spare = max(0.0, supply - demand / max(self.scale_down_boundary, 1e-9))
+        sig.priority = demand / max(supply, 1.0)
+        return sig
+
+
+class KalmanFilter:
+    """Scalar-measurement Kalman filter over a small parameter vector.
+
+    State x (n,) is constant-velocity-free (random walk): predict keeps x,
+    P += Q; update with measurement z = h . x + noise.
+    """
+
+    def __init__(
+        self,
+        x0: list[float],
+        p0: float = 1.0,
+        process_var: float = 1e-6,
+        measurement_var: float = 1e-2,
+    ) -> None:
+        self.n = len(x0)
+        self.x = list(x0)
+        # Diagonal covariance is enough for this well-conditioned problem.
+        self.P = [p0] * self.n
+        self.q = process_var
+        self.r = measurement_var
+
+    def update(self, h: list[float], z: float) -> None:
+        for i in range(self.n):
+            self.P[i] += self.q
+        z_pred = sum(hi * xi for hi, xi in zip(h, self.x))
+        s = self.r + sum(h[i] * self.P[i] * h[i] for i in range(self.n))
+        if s <= 0:
+            return
+        y = z - z_pred
+        for i in range(self.n):
+            k = self.P[i] * h[i] / s
+            self.x[i] += k * y
+            self.P[i] *= 1.0 - k * h[i]
+
+
+class SloQueueingAnalyzer:
+    """SLO analyzer (experimental): Kalman-learned latency model + M/M/1
+    queueing capacity (reference hpa-wva.md "SLO Analyzer").
+
+    Learns alpha (baseline iteration overhead, ms), beta (per-token compute
+    ms), gamma (per-KV-token memory access ms) online from observed
+    TTFT/ITL snapshots, derives SLO targets (explicit or idle-latency x k),
+    then computes the max per-replica request rate whose M/M/1 queueing
+    wait keeps TTFT within target. Desired replicas = ceil(arrival rate /
+    max rate).
+    """
+
+    def __init__(
+        self,
+        target_ttft_ms: float | None = None,
+        target_itl_ms: float | None = None,
+        slo_multiplier: float = 3.0,
+    ) -> None:
+        self.target_ttft_ms = target_ttft_ms
+        self.target_itl_ms = target_itl_ms
+        self.k = slo_multiplier
+        # alpha ms, beta ms/token, gamma ms/kv-token
+        self.kf = KalmanFilter([10.0, 0.05, 1e-4], p0=100.0)
+
+    # ---- phase 1: online parameter learning ----
+
+    def observe(self, r: ReplicaMetrics) -> None:
+        if r.avg_itl_s > 0:
+            # ITL ~ alpha + beta*batch_tokens + gamma*kv_tokens_in_use
+            batch = max(r.running, 1.0)
+            self.kf.update([1.0, batch, r.tokens_in_use], r.avg_itl_s * 1e3)
+        if r.avg_ttft_s > 0 and r.queue_len < 1:
+            # Uncontended TTFT ~ alpha + beta*input_tokens (prefill pass)
+            self.kf.update(
+                [1.0, max(r.avg_input_tokens, 1.0), 0.0], r.avg_ttft_s * 1e3
+            )
+
+    @property
+    def alpha(self) -> float:
+        return self.kf.x[0]
+
+    @property
+    def beta(self) -> float:
+        return self.kf.x[1]
+
+    @property
+    def gamma(self) -> float:
+        return self.kf.x[2]
+
+    # ---- phase 2: SLO target determination ----
+
+    def idle_ttft_ms(self, avg_input_tokens: float) -> float:
+        return max(self.alpha + self.beta * max(avg_input_tokens, 1.0), 1e-3)
+
+    def targets(self, avg_input_tokens: float, observed_ttft_ms: float) -> float:
+        if self.target_ttft_ms is not None:
+            return self.target_ttft_ms
+        inferred = self.idle_ttft_ms(avg_input_tokens) * self.k
+        if inferred > 0:
+            return inferred
+        return min(observed_ttft_ms * 1.5, 60_000.0)  # fallback + cap
+
+    # ---- phase 3: capacity via M/M/1 ----
+
+    def max_rate_per_replica(self, avg_input_tokens: float, target_ttft_ms: float) -> float:
+        """Largest arrival rate lambda (req/s) with M/M/1 queueing wait
+        Wq = lambda / (mu (mu - lambda)) <= target - idle, i.e.
+        lambda = Wq mu^2 / (1 + Wq mu)."""
+        service_ms = self.idle_ttft_ms(avg_input_tokens)
+        mu = 1000.0 / service_ms  # req/s one replica serves sequentially
+        wq_s = max(target_ttft_ms - service_ms, 0.0) / 1000.0
+        if wq_s <= 0:
+            return mu * 0.5  # target at/below idle: cap utilization at 50%
+        return (wq_s * mu * mu) / (1.0 + wq_s * mu)
+
+    def analyze(self, snap: PoolSnapshot) -> CapacitySignal:
+        sig = CapacitySignal(model_id=snap.model_id, unit="replicas")
+        ready = [r for r in snap.replicas if r.ready]
+        for r in ready:
+            self.observe(r)
+        if not ready:
+            sig.required = 1.0 if snap.epp_queue_size > 0 else 0.0
+            return sig
+        total_rate = sum(r.arrival_rate for r in ready)
+        avg_in = sum(r.avg_input_tokens for r in ready) / len(ready)
+        observed_ttft_ms = (
+            sum(r.avg_ttft_s for r in ready) / len(ready)
+        ) * 1e3
+        target = self.targets(avg_in, observed_ttft_ms)
+        lam_max = self.max_rate_per_replica(avg_in, target)
+        needed = math.ceil(total_rate / max(lam_max, 1e-9)) if total_rate > 0 else 0
+        n = len(ready)
+        # ITL SLO: decode-time latency grows with batch size; an observed
+        # breach means the per-replica batch must shrink -> one more replica.
+        if self.target_itl_ms is not None:
+            itls = [r.avg_itl_s * 1e3 for r in ready if r.avg_itl_s > 0]
+            if itls and sum(itls) / len(itls) > self.target_itl_ms:
+                needed = max(needed, n + 1)
+        sig.required = float(max(0, needed - n))
+        sig.spare = float(max(0, n - max(needed, 1)))
+        sig.priority = total_rate / max(lam_max * n, 1e-9)
+        return sig

@@ -1,0 +1,373 @@
+"""WVA engine: collect/analyze/optimize/enforce loop + scale-from-zero.
+
+Reference: hpa-wva.md "Scaling Engine Architecture" — a 30s main loop
+writes variant decisions to an in-memory decision cache; an actuator
+publishes `wva_desired_replicas`; an independent 100ms poller on the EPP
+flow-control queue scales idle pools from zero without waiting for the
+main loop. Here the EPP is our Router (llmd_tpu.epp.server): the
+collector scrapes its /metrics + /endpoints and each engine's /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.autoscale.analyzers import (
+    SaturationPercentAnalyzer,
+    SaturationTokenAnalyzer,
+    SloQueueingAnalyzer,
+)
+from llmd_tpu.autoscale.optimizer import (
+    CostAwareOptimizer,
+    Enforcer,
+    tokens_to_replicas,
+)
+from llmd_tpu.autoscale.types import (
+    PoolSnapshot,
+    ReplicaMetrics,
+    VariantDecision,
+    VariantSpec,
+)
+from llmd_tpu.serve.metrics import parse_prometheus
+
+log = logging.getLogger(__name__)
+
+VARIANT_LABEL = "llm-d.ai/variant"
+
+
+class RouterCollector:
+    """Collect a PoolSnapshot from a Router's /endpoints + /metrics and the
+    engines' /metrics pages (reference 'Metric Collection': Prometheus
+    source + per-pool pod scraping source, folded into one HTTP scraper)."""
+
+    def __init__(
+        self,
+        router_url: str,
+        model_id: str,
+        retention_s: float = 600.0,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self.router_url = router_url.rstrip("/")
+        self.model_id = model_id
+        self.retention_s = retention_s
+        self.timeout_s = timeout_s
+        self._session: aiohttp.ClientSession | None = None
+        # counter deltas for rates / retention
+        self._last_requests_total: float | None = None
+        self._last_scrape_t: float | None = None
+        self._request_history: list[tuple[float, float]] = []  # (t, delta)
+        self._per_pod_prev: dict[str, dict[str, float]] = {}
+
+    async def _get(self, url: str) -> str:
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        async with self._session.get(url) as resp:
+            resp.raise_for_status()
+            return await resp.text()
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+    async def epp_queue_size(self) -> float:
+        """The scale-from-zero fast-path query."""
+        try:
+            m = parse_prometheus(await self._get(self.router_url + "/metrics"))
+        except Exception:
+            return 0.0
+        return m.get("llm_d_epp_flow_control_queue_size", 0.0)
+
+    async def collect(self) -> PoolSnapshot:
+        now = time.monotonic()
+        snap = PoolSnapshot(model_id=self.model_id)
+        try:
+            router_metrics = parse_prometheus(
+                await self._get(self.router_url + "/metrics")
+            )
+            endpoints = json.loads(
+                await self._get(self.router_url + "/endpoints")
+            )["endpoints"]
+        except Exception as e:
+            log.warning("WVA collect from router failed: %s", e)
+            return snap
+        snap.epp_queue_size = router_metrics.get(
+            "llm_d_epp_flow_control_queue_size", 0.0
+        )
+        total = router_metrics.get("llm_d_epp_requests_total", 0.0)
+        if self._last_requests_total is not None:
+            self._request_history.append(
+                (now, max(0.0, total - self._last_requests_total))
+            )
+        self._last_requests_total = total
+        self._request_history = [
+            (t, d) for t, d in self._request_history if now - t <= self.retention_s
+        ]
+        snap.recent_request_count = sum(d for _, d in self._request_history)
+
+        dt = (now - self._last_scrape_t) if self._last_scrape_t else 0.0
+        self._last_scrape_t = now
+        # Parallel per-pod scrapes: one wedged pod costs O(timeout), not
+        # O(n x timeout) — scale-up latency matters most when pods are sick.
+        snap.replicas = list(
+            await asyncio.gather(
+                *(self._scrape_pod(ep, dt) for ep in endpoints)
+            )
+        )
+        return snap
+
+    async def _scrape_pod(self, ep: dict, dt: float) -> ReplicaMetrics:
+        addr = ep["address"]
+        attrs = ep.get("attrs", {})
+        r = ReplicaMetrics(
+            variant=ep.get("labels", {}).get(VARIANT_LABEL, "default"),
+            address=addr,
+            ready=bool(ep.get("healthy", True)),
+        )
+        try:
+            m = parse_prometheus(await self._get(f"http://{addr}/metrics"))
+        except Exception:
+            r.ready = False
+            return r
+        r.kv_usage = m.get("vllm:gpu_cache_usage_perc", 0.0)
+        r.queue_len = m.get("vllm:num_requests_waiting", 0.0)
+        r.running = m.get("vllm:num_requests_running", 0.0)
+        prev = self._per_pod_prev.setdefault(addr, {})
+        prompt = m.get("vllm:prompt_tokens_total", 0.0)
+        gen = m.get("vllm:generation_tokens_total", 0.0)
+        done = m.get("vllm:request_success_total", 0.0)
+        d_done = max(0.0, done - prev.get("done", done))
+        if d_done > 0:
+            r.avg_input_tokens = max(
+                0.0, prompt - prev.get("prompt", prompt)
+            ) / d_done
+            r.avg_output_tokens = max(0.0, gen - prev.get("gen", gen)) / d_done
+        if dt > 0:
+            r.arrival_rate = d_done / dt
+        prev.update({"prompt": prompt, "gen": gen, "done": done})
+        # Cache geometry from the metrics contract (cache_config_info
+        # carries block_size/num_gpu_blocks as labels, which
+        # parse_prometheus drops; the EPP data layer extracts them into
+        # endpoint attrs — use those, else llmd gauges).
+        r.block_size = int(m.get("llmd:block_size", 16) or 16)
+        r.num_blocks = int(m.get("llmd:num_blocks", 0) or 0)
+        if r.num_blocks == 0:
+            r.block_size = int(attrs.get("BlockSize", r.block_size) or 16)
+            r.num_blocks = int(attrs.get("NumBlocks", 0) or 0)
+        # Router-observed latencies feed the SLO analyzer (LastTPOT is the
+        # per-output-token time, i.e. the ITL observation).
+        if attrs.get("LastTTFT"):
+            r.avg_ttft_s = float(attrs["LastTTFT"])
+        if attrs.get("LastTPOT"):
+            r.avg_itl_s = float(attrs["LastTPOT"])
+        return r
+
+
+class WvaEngine:
+    """The 30s pipeline + decision cache + scale-from-zero poller."""
+
+    def __init__(
+        self,
+        collector,
+        variants: dict[str, list[VariantSpec]],
+        analyzer: str = "saturation-percentage-based",
+        interval_s: float = 30.0,
+        scale_from_zero_interval_s: float = 0.1,
+        scale_to_zero: bool = False,
+        slo_targets: tuple[float | None, float | None] = (None, None),
+        actuator=None,
+    ) -> None:
+        self.collector = collector
+        self.variants = variants
+        self.interval_s = interval_s
+        self.sfz_interval_s = scale_from_zero_interval_s
+        self.optimizer = CostAwareOptimizer(variants)
+        self.enforcer = Enforcer(scale_to_zero=scale_to_zero)
+        self.analyzer_name = analyzer
+        self.v1 = SaturationPercentAnalyzer()
+        self.v2 = SaturationTokenAnalyzer()
+        self.slo = SloQueueingAnalyzer(
+            target_ttft_ms=slo_targets[0], target_itl_ms=slo_targets[1]
+        )
+        # decision cache: model_id -> {variant: desired}
+        self.decisions: dict[str, dict[str, int]] = {}
+        self.actuator = actuator
+        self.cycles = 0
+        self._tasks: list[asyncio.Task] = []
+
+    # ---- one pipeline cycle ----
+
+    async def run_cycle(self) -> list[VariantDecision]:
+        snap: PoolSnapshot = await self.collector.collect()
+        snap.desired = dict(self.decisions.get(snap.model_id, {}))
+        specs = self.variants.get(snap.model_id, [])
+        spec_by_name = {v.name: v for v in specs}
+
+        if self.analyzer_name == "saturation-token-based":
+            sig = self.v2.analyze(snap, spec_by_name)
+            # convert token signals to replica deltas via cheapest/most
+            # expensive variant capacity respectively
+            cheapest = min(specs, key=lambda v: v.cost) if specs else None
+            cap_up = (
+                self.v2.capacity_cache.get(cheapest.name, 0.0) if cheapest else 0.0
+            ) or max(self.v2.capacity_cache.values(), default=0.0)
+            if cap_up <= 0 and cheapest is not None:
+                cap_up = self.v2.derived_k2(
+                    cheapest.max_batched_tokens, cheapest.max_num_seqs, 512, 128
+                )
+            need = tokens_to_replicas(sig.required, cap_up)
+            free = tokens_to_replicas(max(0.0, sig.spare - cap_up), cap_up)
+        elif self.analyzer_name == "slo":
+            sig = self.slo.analyze(snap)
+            need, free = int(sig.required), int(sig.spare)
+        else:
+            sig = self.v1.analyze(snap)
+            need, free = int(sig.required), int(sig.spare)
+
+        decisions = self.optimizer.decide(snap, sig, need, free)
+        decisions = self.enforcer.enforce(snap, specs, decisions)
+        cache = self.decisions.setdefault(snap.model_id, {})
+        for d in decisions:
+            cache[d.variant] = d.desired_replicas
+        self.cycles += 1
+        if self.actuator is not None:
+            try:
+                out = self.actuator(decisions)
+                if asyncio.iscoroutine(out):
+                    await out
+            except Exception:
+                log.exception("WVA actuator failed")
+        return decisions
+
+    # ---- scale-from-zero fast path ----
+
+    async def scale_from_zero_once(self) -> bool:
+        for model_id, cache in self.decisions.items():
+            if any(v > 0 for v in cache.values()):
+                continue
+            q = await self.collector.epp_queue_size()
+            if q > 0:
+                specs = self.variants.get(model_id, [])
+                if not specs:
+                    continue
+                cheapest = min(specs, key=lambda v: v.cost)
+                cache[cheapest.name] = max(cache.get(cheapest.name, 0), 1)
+                log.info(
+                    "WVA scale-from-zero: %s -> 1 replica of %s (queue=%s)",
+                    model_id, cheapest.name, q,
+                )
+                if self.actuator is not None:
+                    out = self.actuator(
+                        [VariantDecision(model_id, cheapest.name, 1, "scale-from-zero")]
+                    )
+                    if asyncio.iscoroutine(out):
+                        await out
+                return True
+        return False
+
+    # ---- background loops ----
+
+    async def _main_loop(self) -> None:
+        while True:
+            try:
+                await self.run_cycle()
+            except Exception:
+                log.exception("WVA cycle failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def _sfz_loop(self) -> None:
+        while True:
+            try:
+                await self.scale_from_zero_once()
+            except Exception:
+                log.exception("WVA scale-from-zero poll failed")
+            await asyncio.sleep(self.sfz_interval_s)
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._main_loop()),
+            asyncio.ensure_future(self._sfz_loop()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        closer = getattr(self.collector, "close", None)
+        if closer is not None:
+            out = closer()
+            if asyncio.iscoroutine(out):
+                await out
+
+    # ---- metrics surface (the HPA external metric) ----
+
+    def render_metrics(self) -> str:
+        lines = ["# TYPE wva_desired_replicas gauge"]
+        for model_id, cache in sorted(self.decisions.items()):
+            for variant, n in sorted(cache.items()):
+                lines.append(
+                    f'wva_desired_replicas{{model_id="{model_id}",'
+                    f'variant_name="{variant}"}} {n}'
+                )
+        lines.append("# TYPE wva_cycles_total counter")
+        lines.append(f"wva_cycles_total {self.cycles}")
+        return "\n".join(lines) + "\n"
+
+    def build_app(self) -> web.Application:
+        async def metrics(_req: web.Request) -> web.Response:
+            return web.Response(
+                text=self.render_metrics(), content_type="text/plain"
+            )
+
+        async def healthz(_req: web.Request) -> web.Response:
+            return web.json_response({"status": "ok", "cycles": self.cycles})
+
+        async def desired(_req: web.Request) -> web.Response:
+            return web.json_response(self.decisions)
+
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/metrics", metrics),
+                web.get("/healthz", healthz),
+                web.get("/desired", desired),
+            ]
+        )
+
+        async def _lifecycle(app: web.Application):
+            self.start()
+            yield
+            await self.stop()
+
+        app.cleanup_ctx.append(_lifecycle)
+        return app
+
+
+def file_actuator(path: str):
+    """Actuator writing desired counts to a JSON file an external process
+    manager (or deployment tooling) realizes — the no-Kubernetes analogue
+    of patching a Deployment's replica count."""
+
+    def apply(decisions: list[VariantDecision]) -> None:
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            state = {}
+        for d in decisions:
+            state.setdefault(d.model_id, {})[d.variant] = d.desired_replicas
+        with open(path, "w") as f:
+            json.dump(state, f, indent=2)
+
+    return apply

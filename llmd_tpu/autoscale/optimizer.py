@@ -1,0 +1,178 @@
+"""WVA optimizer + enforcer (reference hpa-wva.md pipeline stages 3-4).
+
+Cost-aware (default): scale up the cheapest variant with headroom, scale
+down the most expensive; skip variants that are still transitioning.
+Limited mode (`enable_limiter`): fair-share a fixed accelerator budget
+across pools greedily by priority score. The enforcer applies
+scale-to-zero (idle over the retention window) or the >=1-replica floor.
+"""
+
+from __future__ import annotations
+
+import math
+
+from llmd_tpu.autoscale.types import (
+    CapacitySignal,
+    PoolSnapshot,
+    VariantDecision,
+    VariantSpec,
+)
+
+
+class CostAwareOptimizer:
+    def __init__(self, variants: dict[str, list[VariantSpec]]) -> None:
+        # model_id -> variant specs for its pool
+        self.variants = variants
+
+    def _counts(self, snap: PoolSnapshot) -> dict[str, int]:
+        counts = {v.name: 0 for v in self.variants.get(snap.model_id, [])}
+        for r in snap.replicas:
+            counts[r.variant] = counts.get(r.variant, 0) + 1
+        # A previous decision not yet realized keeps its target (pending
+        # replicas count toward capacity planning, reference "skipping
+        # variants with pending replicas").
+        for name, want in snap.desired.items():
+            counts[name] = max(counts.get(name, 0), want)
+        return counts
+
+    def decide(
+        self,
+        snap: PoolSnapshot,
+        sig: CapacitySignal,
+        replicas_needed: int,
+        replicas_freeable: int,
+    ) -> list[VariantDecision]:
+        specs = sorted(self.variants.get(snap.model_id, []), key=lambda v: v.cost)
+        if not specs:
+            return []
+        counts = self._counts(snap)
+        if sig.blocked:
+            return [
+                VariantDecision(snap.model_id, v.name, counts[v.name], "transitioning")
+                for v in specs
+            ]
+        for _ in range(max(0, replicas_needed)):
+            pending = {
+                name for name, want in snap.desired.items()
+                if want > snap.current_count(name)
+            }
+            for v in specs:  # cheapest first
+                if counts[v.name] < v.max_replicas and v.name not in pending:
+                    counts[v.name] += 1
+                    break
+        for _ in range(max(0, replicas_freeable)):
+            for v in reversed(specs):  # most expensive first
+                if counts[v.name] > v.min_replicas and counts[v.name] > 0:
+                    counts[v.name] -= 1
+                    break
+        return [
+            VariantDecision(snap.model_id, v.name, counts[v.name], "cost-aware")
+            for v in specs
+        ]
+
+
+class LimitedOptimizer(CostAwareOptimizer):
+    """Greedy-by-score fair sharing under a fixed accelerator budget."""
+
+    def __init__(
+        self, variants: dict[str, list[VariantSpec]], accelerator_budget: int
+    ) -> None:
+        super().__init__(variants)
+        self.budget = accelerator_budget
+
+    def decide_all(
+        self,
+        requests: list[tuple[PoolSnapshot, CapacitySignal, int, int]],
+    ) -> list[VariantDecision]:
+        # Start from cost-aware per-pool decisions, then trim lowest-priority
+        # pools until the accelerator budget is respected.
+        per_pool: list[tuple[float, PoolSnapshot, list[VariantDecision]]] = []
+        for snap, sig, need, free in requests:
+            per_pool.append((sig.priority, snap, self.decide(snap, sig, need, free)))
+
+        def units(decisions: list[VariantDecision], model_id: str) -> int:
+            spec_by_name = {
+                v.name: v for v in self.variants.get(model_id, [])
+            }
+            return sum(
+                d.desired_replicas * spec_by_name[d.variant].accelerator_units
+                for d in decisions
+                if d.variant in spec_by_name
+            )
+
+        total = sum(units(d, s.model_id) for _, s, d in per_pool)
+        if total <= self.budget:
+            return [d for _, _, ds in per_pool for d in ds]
+        # Trim from the lowest-priority pools first, never below min_replicas.
+        per_pool.sort(key=lambda t: t[0])
+        for _, snap, decisions in per_pool:
+            spec_by_name = {v.name: v for v in self.variants.get(snap.model_id, [])}
+            changed = True
+            while total > self.budget and changed:
+                changed = False
+                for d in sorted(
+                    decisions,
+                    key=lambda d: -spec_by_name[d.variant].cost,
+                ):
+                    floor = spec_by_name[d.variant].min_replicas
+                    if d.desired_replicas > floor:
+                        d.desired_replicas -= 1
+                        d.reason = "chip-limited"
+                        total -= spec_by_name[d.variant].accelerator_units
+                        changed = True
+                        break
+            if total <= self.budget:
+                break
+        return [d for _, _, ds in per_pool for d in ds]
+
+
+class Enforcer:
+    """Scale-to-zero / minimum-floor policy (reference pipeline stage 4)."""
+
+    def __init__(
+        self, scale_to_zero: bool = False, retention_ok_requests: float = 0.0
+    ) -> None:
+        self.scale_to_zero = scale_to_zero
+        self.retention_ok_requests = retention_ok_requests
+
+    def enforce(
+        self,
+        snap: PoolSnapshot,
+        specs: list[VariantSpec],
+        decisions: list[VariantDecision],
+    ) -> list[VariantDecision]:
+        if not decisions:
+            return decisions
+        any_min = any(v.min_replicas > 0 for v in specs)
+        spec_by_name = {v.name: v for v in specs}
+        for d in decisions:
+            v = spec_by_name.get(d.variant)
+            if v is not None:
+                d.desired_replicas = min(
+                    max(d.desired_replicas, v.min_replicas), v.max_replicas
+                )
+        if self.scale_to_zero and not any_min:
+            if (
+                snap.recent_request_count <= self.retention_ok_requests
+                and snap.epp_queue_size == 0
+            ):
+                for d in decisions:
+                    d.desired_replicas = 0
+                    d.reason = "scale-to-zero"
+                return decisions
+        if not self.scale_to_zero and all(d.desired_replicas == 0 for d in decisions):
+            cheapest = min(specs, key=lambda v: v.cost)
+            for d in decisions:
+                if d.variant == cheapest.name:
+                    d.desired_replicas = 1
+                    d.reason = "min-floor"
+        return decisions
+
+
+def tokens_to_replicas(
+    sig_tokens: float, per_replica_capacity: float
+) -> int:
+    """Convert a V2 token signal into replica counts."""
+    if sig_tokens <= 0 or per_replica_capacity <= 0:
+        return 0
+    return math.ceil(sig_tokens / per_replica_capacity)

@@ -1,0 +1,109 @@
+"""WVA datatypes: variants, per-replica metrics, pool snapshots, decisions.
+
+Reference: hpa-wva.md — a *variant* is one of multiple model servers in an
+InferencePool serving the same base model with different hardware/serving
+configuration and cost; WVA optimizes replica counts across variants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class VariantSpec:
+    """One autoscalable variant (the reference's VariantAutoscaling spec)."""
+
+    name: str
+    # Relative cost per replica-hour (the optimizer only compares ratios).
+    cost: float = 1.0
+    min_replicas: int = 0
+    max_replicas: int = 64
+    # Accelerator units one replica consumes (chip-limited fair sharing).
+    accelerator_units: int = 1
+    # Optional static capacity hint: output tokens/s one replica sustains
+    # (used by the token analyzer when no observation/history exists).
+    max_batched_tokens: int = 8192
+    max_num_seqs: int = 256
+
+    def __post_init__(self) -> None:
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"variant {self.name}: min_replicas > max_replicas"
+            )
+
+
+@dataclasses.dataclass
+class ReplicaMetrics:
+    """One replica's scraped state (reference 'Registered Queries' table)."""
+
+    variant: str
+    address: str = ""
+    ready: bool = True
+    kv_usage: float = 0.0          # vllm:gpu_cache_usage_perc, 0-1
+    queue_len: float = 0.0         # vllm:num_requests_waiting
+    running: float = 0.0           # vllm:num_requests_running
+    block_size: int = 16           # vllm:cache_config_info
+    num_blocks: int = 0
+    avg_input_tokens: float = 0.0
+    avg_output_tokens: float = 0.0
+    arrival_rate: float = 0.0      # req/s dispatched to this replica
+    avg_ttft_s: float = 0.0
+    avg_itl_s: float = 0.0
+
+    @property
+    def kv_capacity_tokens(self) -> float:
+        return float(self.block_size * self.num_blocks)
+
+    @property
+    def tokens_in_use(self) -> float:
+        return self.kv_usage * self.kv_capacity_tokens
+
+
+@dataclasses.dataclass
+class PoolSnapshot:
+    """Collected state for one InferencePool / base model at one instant."""
+
+    model_id: str
+    replicas: list[ReplicaMetrics] = dataclasses.field(default_factory=list)
+    # Desired (not yet actual) counts from the previous decision, used to
+    # detect transitioning variants (desired != current blocks V1 scaling).
+    desired: dict[str, int] = dataclasses.field(default_factory=dict)
+    # EPP-level demand queued upstream of any replica.
+    epp_queue_size: float = 0.0
+    epp_queue_bytes: float = 0.0
+    # Requests completed over the scale-to-zero retention window.
+    recent_request_count: float = 0.0
+
+    def by_variant(self) -> dict[str, list[ReplicaMetrics]]:
+        out: dict[str, list[ReplicaMetrics]] = {}
+        for r in self.replicas:
+            out.setdefault(r.variant, []).append(r)
+        return out
+
+    def current_count(self, variant: str) -> int:
+        return sum(1 for r in self.replicas if r.variant == variant)
+
+
+@dataclasses.dataclass
+class CapacitySignal:
+    """Analyzer output (reference pipeline stage 2): how much capacity is
+    needed (positive required) or can be freed (positive spare), plus a
+    priority score for chip-limited fair sharing."""
+
+    model_id: str
+    required: float = 0.0   # units depend on analyzer (replicas or tokens)
+    spare: float = 0.0
+    unit: str = "replicas"  # "replicas" (V1/SLO) or "tokens" (V2)
+    priority: float = 0.0
+    blocked: bool = False   # V1: a variant is transitioning; hold all scaling
+
+
+@dataclasses.dataclass
+class VariantDecision:
+    """Optimizer output: target replica count for one variant."""
+
+    model_id: str
+    variant: str
+    desired_replicas: int
+    reason: str = ""

@@ -342,6 +342,8 @@ class Router:
                 ttft_ms = (first_byte - t0) * 1000.0
                 if last_byte is not None and stream_tokens > 1:
                     tpot_ms = (last_byte - first_byte) * 1000.0 / (stream_tokens - 1)
+                    # Feeds the WVA SLO analyzer's ITL observations.
+                    pod.attrs["LastTPOT"] = tpot_ms / 1000.0
             self.scheduler.notify_complete(req, pod)
             if ttft_ms is not None and self.completion_observers:
                 # Fire-and-forget: the response is already written; a slow

@@ -347,6 +347,8 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         body = await request.json()
     except json.JSONDecodeError as e:
         return _error(400, f"invalid JSON: {e}")
+    if not isinstance(body, dict):
+        return _error(400, "request body must be a JSON object")
     ids = body.get("prompt_token_ids") or body.get("token_ids") or []
     if not isinstance(ids, list) or not all(isinstance(t, int) for t in ids):
         return _error(400, "prompt_token_ids must be a list of ints")
@@ -363,14 +365,19 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         stops = [int(t) for t in (sp.get("stop_token_ids") or [])]
         if eos is not None and not sp.get("ignore_eos", False):
             stops.append(int(eos))
+        req_max = sp.get("max_tokens")
+        max_tokens = budget if req_max is None else min(int(req_max), budget)
+        if max_tokens < 0:
+            return _error(400, "max_tokens must be >= 0")
+        seed = sp.get("seed")
         sampling = SamplingParams(
-            max_tokens=min(int(sp.get("max_tokens", budget) or budget), budget),
+            max_tokens=max_tokens,
             temperature=float(sp.get("temperature", 1.0)),
             top_k=int(sp.get("top_k", 0) or 0),
             top_p=float(sp.get("top_p", 1.0)),
             stop_token_ids=tuple(stops),
             ignore_eos=bool(sp.get("ignore_eos", False)),
-            seed=sp.get("seed"),
+            seed=None if seed is None else int(seed),
         )
         priority = int(sp.get("priority", 0) or 0)
     except (TypeError, ValueError) as e:

@@ -1,0 +1,353 @@
+"""WVA autoscaler: analyzers, optimizer, enforcer, engine loop.
+
+Reference behavior under test: hpa-wva.md — V1 percentage saturation
+(scale-up on spare-capacity triggers, N/(N-1) scale-down safety,
+transition blocking), V2 token capacity (k1/k2 bounds, priority chain),
+SLO queueing (Kalman learning + M/M/1 capacity), cost-aware optimization
+(cheapest up / most expensive down), scale-to-zero + scale-from-zero.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.autoscale.analyzers import (
+    KalmanFilter,
+    SaturationPercentAnalyzer,
+    SaturationTokenAnalyzer,
+    SloQueueingAnalyzer,
+)
+from llmd_tpu.autoscale.engine import WvaEngine, file_actuator
+from llmd_tpu.autoscale.optimizer import CostAwareOptimizer, Enforcer, LimitedOptimizer
+from llmd_tpu.autoscale.types import (
+    PoolSnapshot,
+    ReplicaMetrics,
+    VariantSpec,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def replica(variant="a", kv=0.5, q=0.0, blocks=1000, **kw):
+    return ReplicaMetrics(
+        variant=variant, kv_usage=kv, queue_len=q, num_blocks=blocks,
+        block_size=16, **kw,
+    )
+
+
+# ---------------------------------------------------------------- V1
+
+
+def test_v1_scale_up_on_kv_pressure():
+    a = SaturationPercentAnalyzer()
+    snap = PoolSnapshot("m", replicas=[replica(kv=0.78), replica(kv=0.75)])
+    sig = a.analyze(snap)
+    assert sig.required == 1.0 and sig.spare == 0.0
+
+
+def test_v1_scale_up_on_queue_pressure():
+    a = SaturationPercentAnalyzer()
+    snap = PoolSnapshot("m", replicas=[replica(q=4.0), replica(q=3.0)])
+    sig = a.analyze(snap)
+    assert sig.required == 1.0
+
+
+def test_v1_scale_down_with_headroom():
+    a = SaturationPercentAnalyzer()
+    # 3 idle replicas: removing one leaves 2 with plenty of headroom
+    snap = PoolSnapshot("m", replicas=[replica(kv=0.1), replica(kv=0.1), replica(kv=0.1)])
+    sig = a.analyze(snap)
+    assert sig.spare == 1.0 and sig.required == 0.0
+
+
+def test_v1_no_scale_down_when_redistribution_would_saturate():
+    a = SaturationPercentAnalyzer()
+    snap = PoolSnapshot("m", replicas=[replica(kv=0.6), replica(kv=0.6)])
+    sig = a.analyze(snap)
+    # redistributed = 1.2 > 0.7 -> not safe
+    assert sig.spare == 0.0
+
+
+def test_v1_blocked_while_transitioning():
+    a = SaturationPercentAnalyzer()
+    snap = PoolSnapshot(
+        "m", replicas=[replica(kv=0.79)], desired={"a": 2}
+    )
+    sig = a.analyze(snap)
+    assert sig.blocked
+
+
+def test_v1_empty_pool_requires_replica_iff_queued():
+    a = SaturationPercentAnalyzer()
+    assert a.analyze(PoolSnapshot("m")).required == 0.0
+    assert a.analyze(PoolSnapshot("m", epp_queue_size=3)).required == 1.0
+
+
+# ---------------------------------------------------------------- V2
+
+
+def test_v2_memory_bound_k1():
+    a = SaturationTokenAnalyzer()
+    r = replica(kv=0.1, blocks=1000)  # capacity 16000 tokens
+    cap = a.replica_capacity(r)
+    assert cap == pytest.approx(16000 * 0.80)
+
+
+def test_v2_observed_k2_under_queue_saturation():
+    a = SaturationTokenAnalyzer()
+    r = replica(kv=0.5, q=10, blocks=1000)  # in use: 8000
+    cap = a.replica_capacity(r)
+    assert cap == pytest.approx(8000)  # observed beats k1=12800
+
+
+def test_v2_historical_k2():
+    a = SaturationTokenAnalyzer()
+    sat = replica(kv=0.5, q=10, blocks=1000)
+    sat.avg_output_tokens = 50
+    a.replica_capacity(sat)  # records history (bucket: short)
+    idle = replica(kv=0.1, q=0, blocks=1000)
+    idle.avg_output_tokens = 60
+    assert a.replica_capacity(idle) == pytest.approx(8000)
+
+
+def test_v2_derived_k2_from_spec():
+    a = SaturationTokenAnalyzer()
+    spec = VariantSpec("a", max_batched_tokens=1024, max_num_seqs=8)
+    r = replica(kv=0.0, q=0, blocks=100000)
+    r.avg_input_tokens, r.avg_output_tokens = 100, 100
+    cap = a.replica_capacity(r, spec)
+    assert cap == pytest.approx(8 * 200)
+
+
+def test_v2_signals_scale_up():
+    a = SaturationTokenAnalyzer()
+    # one replica nearly full: demand ~ supply -> required > 0
+    r = replica(kv=0.79, q=8, blocks=1000)
+    r.avg_input_tokens = 500
+    snap = PoolSnapshot("m", replicas=[r], epp_queue_size=4)
+    sig = a.analyze(snap)
+    assert sig.required > 0 and sig.unit == "tokens"
+
+
+def test_v2_capacity_cached_for_zero_replicas():
+    a = SaturationTokenAnalyzer()
+    snap = PoolSnapshot("m", replicas=[replica(kv=0.2, blocks=1000)])
+    a.analyze(snap)
+    assert a.variant_capacity("a", []) > 0  # from cache
+
+
+# ---------------------------------------------------------------- Kalman / SLO
+
+
+def test_kalman_learns_linear_params():
+    kf = KalmanFilter([0.0, 0.0], p0=100.0, measurement_var=1e-4)
+    # z = 5 + 2*x
+    for x in [1, 3, 7, 2, 9, 4, 8, 5, 6, 10] * 5:
+        kf.update([1.0, float(x)], 5.0 + 2.0 * x)
+    assert kf.x[0] == pytest.approx(5.0, abs=0.2)
+    assert kf.x[1] == pytest.approx(2.0, abs=0.05)
+
+
+def test_slo_analyzer_learns_and_scales():
+    a = SloQueueingAnalyzer(target_ttft_ms=200.0)
+    # Synthetic hardware: alpha=20ms, beta=0.1ms/token -> idle TTFT for
+    # 500-token prompts = 70ms; mu ~ 14.3 req/s;
+    # Wq budget 130ms -> lam_max = Wq mu^2/(1+Wq mu) ~ 9.3 req/s/replica.
+    reps = []
+    for _ in range(4):
+        r = replica(kv=0.3, q=0, blocks=1000)
+        r.avg_input_tokens = 500.0
+        r.avg_ttft_s = (20.0 + 0.1 * 500) / 1000.0
+        r.avg_itl_s = (20.0 + 0.1 * 1) / 1000.0
+        r.running = 1.0
+        r.arrival_rate = 10.0  # 40 req/s total over 4 replicas
+        reps.append(r)
+    snap = PoolSnapshot("m", replicas=reps)
+    for _ in range(30):  # let the Kalman filter converge
+        sig = a.analyze(snap)
+    lam = a.max_rate_per_replica(500.0, 200.0)
+    assert 5.0 < lam < 14.0
+    # 40 req/s total needs ceil(40/lam) > 4 replicas -> required > 0
+    assert sig.required >= 1.0
+
+
+def test_slo_inferred_target_multiplier():
+    a = SloQueueingAnalyzer()  # no explicit target
+    a.kf.x = [10.0, 0.1, 0.0]
+    t = a.targets(avg_input_tokens=100.0, observed_ttft_ms=500.0)
+    assert t == pytest.approx((10 + 0.1 * 100) * 3.0)
+
+
+# ---------------------------------------------------------------- optimizer
+
+
+VARIANTS = {
+    "m": [
+        VariantSpec("cheap", cost=1.0, accelerator_units=4),
+        VariantSpec("pricey", cost=3.0, accelerator_units=8),
+    ]
+}
+
+
+def sig_for(snap, required=0.0, spare=0.0, blocked=False):
+    from llmd_tpu.autoscale.types import CapacitySignal
+
+    s = CapacitySignal(model_id=snap.model_id, required=required, spare=spare)
+    s.blocked = blocked
+    return s
+
+
+def test_optimizer_scales_up_cheapest():
+    opt = CostAwareOptimizer(VARIANTS)
+    snap = PoolSnapshot("m", replicas=[replica("pricey")])
+    ds = {d.variant: d for d in opt.decide(snap, sig_for(snap), 1, 0)}
+    assert ds["cheap"].desired_replicas == 1
+    assert ds["pricey"].desired_replicas == 1
+
+
+def test_optimizer_scales_down_most_expensive():
+    opt = CostAwareOptimizer(VARIANTS)
+    snap = PoolSnapshot("m", replicas=[replica("cheap"), replica("pricey")])
+    ds = {d.variant: d for d in opt.decide(snap, sig_for(snap), 0, 1)}
+    assert ds["pricey"].desired_replicas == 0
+    assert ds["cheap"].desired_replicas == 1
+
+
+def test_optimizer_skips_pending_variant_on_scale_up():
+    opt = CostAwareOptimizer(VARIANTS)
+    # cheap already has a pending replica (desired 2, current 1)
+    snap = PoolSnapshot("m", replicas=[replica("cheap")], desired={"cheap": 2})
+    ds = {d.variant: d for d in opt.decide(snap, sig_for(snap), 1, 0)}
+    assert ds["pricey"].desired_replicas == 1  # fell through to next variant
+
+
+def test_optimizer_blocked_keeps_counts():
+    opt = CostAwareOptimizer(VARIANTS)
+    snap = PoolSnapshot("m", replicas=[replica("cheap")])
+    ds = opt.decide(snap, sig_for(snap, blocked=True), 5, 0)
+    assert {d.variant: d.desired_replicas for d in ds} == {"cheap": 1, "pricey": 0}
+
+
+def test_limited_optimizer_respects_budget():
+    opt = LimitedOptimizer(VARIANTS, accelerator_budget=8)
+    snap = PoolSnapshot("m", replicas=[replica("cheap"), replica("pricey")])
+    # 1 cheap (4) + 1 pricey (8) = 12 units > budget 8 -> trim pricey
+    ds = opt.decide_all([(snap, sig_for(snap), 0, 0)])
+    by = {d.variant: d.desired_replicas for d in ds}
+    assert by["pricey"] == 0 and by["cheap"] == 1
+
+
+def test_enforcer_scale_to_zero_when_idle():
+    enf = Enforcer(scale_to_zero=True)
+    snap = PoolSnapshot("m", recent_request_count=0.0)
+    specs = VARIANTS["m"]
+    opt = CostAwareOptimizer(VARIANTS)
+    ds = enf.enforce(snap, specs, opt.decide(snap, sig_for(snap), 0, 0))
+    assert all(d.desired_replicas == 0 for d in ds)
+
+
+def test_enforcer_no_scale_to_zero_with_traffic_or_queue():
+    enf = Enforcer(scale_to_zero=True)
+    snap = PoolSnapshot(
+        "m", replicas=[replica("cheap")], recent_request_count=5.0
+    )
+    opt = CostAwareOptimizer(VARIANTS)
+    ds = enf.enforce(snap, VARIANTS["m"], opt.decide(snap, sig_for(snap), 0, 0))
+    assert any(d.desired_replicas > 0 for d in ds)
+
+
+def test_enforcer_min_floor_when_scale_to_zero_disabled():
+    enf = Enforcer(scale_to_zero=False)
+    snap = PoolSnapshot("m")
+    opt = CostAwareOptimizer(VARIANTS)
+    ds = enf.enforce(snap, VARIANTS["m"], opt.decide(snap, sig_for(snap), 0, 0))
+    by = {d.variant: d.desired_replicas for d in ds}
+    assert by["cheap"] == 1 and by["pricey"] == 0  # floor on the cheapest
+
+
+def test_enforcer_respects_min_replicas():
+    variants = {"m": [VariantSpec("a", min_replicas=2)]}
+    enf = Enforcer(scale_to_zero=True)
+    snap = PoolSnapshot("m", recent_request_count=0.0)
+    opt = CostAwareOptimizer(variants)
+    ds = enf.enforce(snap, variants["m"], opt.decide(snap, sig_for(snap), 0, 0))
+    assert ds[0].desired_replicas == 2  # min_replicas disables scale-to-zero
+
+
+# ---------------------------------------------------------------- engine
+
+
+class FakeCollector:
+    def __init__(self, snaps, queue=0.0):
+        self.snaps = list(snaps)
+        self.queue = queue
+
+    async def collect(self):
+        return self.snaps.pop(0) if len(self.snaps) > 1 else self.snaps[0]
+
+    async def epp_queue_size(self):
+        return self.queue
+
+
+async def test_engine_cycle_and_metrics():
+    snap = PoolSnapshot("m", replicas=[replica("cheap", kv=0.79)])
+    eng = WvaEngine(FakeCollector([snap]), VARIANTS)
+    ds = await eng.run_cycle()
+    by = {d.variant: d.desired_replicas for d in ds}
+    assert by["cheap"] == 2  # scale up cheapest on kv pressure
+    text = eng.render_metrics()
+    assert 'wva_desired_replicas{model_id="m",variant_name="cheap"} 2' in text
+
+
+async def test_engine_scale_from_zero():
+    eng = WvaEngine(
+        FakeCollector([PoolSnapshot("m")], queue=2.0),
+        VARIANTS,
+        scale_to_zero=True,
+    )
+    eng.decisions["m"] = {"cheap": 0, "pricey": 0}
+    fired = await eng.scale_from_zero_once()
+    assert fired and eng.decisions["m"]["cheap"] == 1
+
+
+async def test_engine_http_surface(tmp_path):
+    snap = PoolSnapshot("m", replicas=[replica("cheap", kv=0.5)])
+    path = str(tmp_path / "decisions.json")
+    eng = WvaEngine(
+        FakeCollector([snap]), VARIANTS, interval_s=0.05,
+        actuator=file_actuator(path),
+    )
+    client = TestClient(TestServer(eng.build_app()))
+    await client.start_server()
+    try:
+        await asyncio.sleep(0.2)  # let at least one cycle run
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        assert "wva_desired_replicas" in await resp.text()
+        resp = await client.get("/desired")
+        assert (await resp.json())["m"]["cheap"] >= 1
+        with open(path) as f:
+            assert json.load(f)["m"]["cheap"] >= 1
+    finally:
+        await client.close()
+
+
+def test_slo_itl_target_triggers_scale_up():
+    a = SloQueueingAnalyzer(target_ttft_ms=10_000.0, target_itl_ms=30.0)
+    reps = []
+    for _ in range(2):
+        r = replica(kv=0.3, blocks=1000)
+        r.avg_input_tokens = 100.0
+        r.avg_itl_s = 0.080  # 80ms observed ITL > 30ms target
+        r.running = 4.0
+        r.arrival_rate = 0.1
+        reps.append(r)
+    sig = a.analyze(PoolSnapshot("m", replicas=reps))
+    assert sig.required >= 1.0
