@@ -234,8 +234,10 @@ class KalmanFilter:
         self.P = [p0] * self.n
         self.q = process_var
         self.r = measurement_var
+        self.updates = 0
 
     def update(self, h: list[float], z: float) -> None:
+        self.updates += 1
         for i in range(self.n):
             self.P[i] += self.q
         z_pred = sum(hi * xi for hi, xi in zip(h, self.x))
@@ -306,10 +308,11 @@ class SloQueueingAnalyzer:
     def targets(self, avg_input_tokens: float, observed_ttft_ms: float) -> float:
         if self.target_ttft_ms is not None:
             return self.target_ttft_ms
-        inferred = self.idle_ttft_ms(avg_input_tokens) * self.k
-        if inferred > 0:
-            return inferred
-        return min(observed_ttft_ms * 1.5, 60_000.0)  # fallback + cap
+        if self.kf.updates == 0:
+            # Parameters still at priors: the inferred idle latency is
+            # meaningless — fall back to observed TTFT x 1.5 headroom.
+            return min(max(observed_ttft_ms, 1.0) * 1.5, 60_000.0)
+        return self.idle_ttft_ms(avg_input_tokens) * self.k
 
     # ---- phase 3: capacity via M/M/1 ----
 
@@ -333,14 +336,18 @@ class SloQueueingAnalyzer:
             sig.required = 1.0 if snap.epp_queue_size > 0 else 0.0
             return sig
         total_rate = sum(r.arrival_rate for r in ready)
+        n = len(ready)
+        if total_rate <= 0:
+            # No observed arrivals (first cycle after start, or a quiet
+            # window): no information — hold rather than free n-1 replicas.
+            return sig
         avg_in = sum(r.avg_input_tokens for r in ready) / len(ready)
         observed_ttft_ms = (
             sum(r.avg_ttft_s for r in ready) / len(ready)
         ) * 1e3
         target = self.targets(avg_in, observed_ttft_ms)
         lam_max = self.max_rate_per_replica(avg_in, target)
-        needed = math.ceil(total_rate / max(lam_max, 1e-9)) if total_rate > 0 else 0
-        n = len(ready)
+        needed = math.ceil(total_rate / max(lam_max, 1e-9))
         # ITL SLO: decode-time latency grows with batch size; an observed
         # breach means the per-replica batch must shrink -> one more replica.
         if self.target_itl_ms is not None:

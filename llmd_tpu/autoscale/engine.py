@@ -61,6 +61,7 @@ class RouterCollector:
         # counter deltas for rates / retention
         self._last_requests_total: float | None = None
         self._last_scrape_t: float | None = None
+        self._first_collect_t: float | None = None
         self._request_history: list[tuple[float, float]] = []  # (t, delta)
         self._per_pod_prev: dict[str, dict[str, float]] = {}
 
@@ -85,8 +86,13 @@ class RouterCollector:
             return 0.0
         return m.get("llm_d_epp_flow_control_queue_size", 0.0)
 
-    async def collect(self) -> PoolSnapshot:
+    async def collect(self) -> PoolSnapshot | None:
+        """None on router-scrape failure: the engine must skip the cycle
+        rather than treat an unreachable router as an idle pool (acting on
+        an empty snapshot would tear down a healthy loaded fleet)."""
         now = time.monotonic()
+        if self._first_collect_t is None:
+            self._first_collect_t = now
         snap = PoolSnapshot(model_id=self.model_id)
         try:
             router_metrics = parse_prometheus(
@@ -97,7 +103,7 @@ class RouterCollector:
             )["endpoints"]
         except Exception as e:
             log.warning("WVA collect from router failed: %s", e)
-            return snap
+            return None
         snap.epp_queue_size = router_metrics.get(
             "llm_d_epp_flow_control_queue_size", 0.0
         )
@@ -110,7 +116,13 @@ class RouterCollector:
         self._request_history = [
             (t, d) for t, d in self._request_history if now - t <= self.retention_s
         ]
-        snap.recent_request_count = sum(d for _, d in self._request_history)
+        # The retention window is only meaningful once we have observed it
+        # in full; before that, "0 requests" just means "recently started"
+        # and must not trigger scale-to-zero.
+        if now - self._first_collect_t >= self.retention_s:
+            snap.recent_request_count = sum(d for _, d in self._request_history)
+        else:
+            snap.recent_request_count = None
 
         dt = (now - self._last_scrape_t) if self._last_scrape_t else 0.0
         self._last_scrape_t = now
@@ -152,15 +164,11 @@ class RouterCollector:
         if dt > 0:
             r.arrival_rate = d_done / dt
         prev.update({"prompt": prompt, "gen": gen, "done": done})
-        # Cache geometry from the metrics contract (cache_config_info
-        # carries block_size/num_gpu_blocks as labels, which
-        # parse_prometheus drops; the EPP data layer extracts them into
-        # endpoint attrs — use those, else llmd gauges).
-        r.block_size = int(m.get("llmd:block_size", 16) or 16)
-        r.num_blocks = int(m.get("llmd:num_blocks", 0) or 0)
-        if r.num_blocks == 0:
-            r.block_size = int(attrs.get("BlockSize", r.block_size) or 16)
-            r.num_blocks = int(attrs.get("NumBlocks", 0) or 0)
+        # Cache geometry: cache_config_info carries block_size /
+        # num_gpu_blocks as labels, which parse_prometheus drops; the EPP
+        # data layer extracts them into endpoint attrs — read those.
+        r.block_size = int(attrs.get("BlockSize", 16) or 16)
+        r.num_blocks = int(attrs.get("NumBlocks", 0) or 0)
         # Router-observed latencies feed the SLO analyzer (LastTPOT is the
         # per-output-token time, i.e. the ITL observation).
         if attrs.get("LastTTFT"):
@@ -205,16 +213,23 @@ class WvaEngine:
     # ---- one pipeline cycle ----
 
     async def run_cycle(self) -> list[VariantDecision]:
-        snap: PoolSnapshot = await self.collector.collect()
+        snap: PoolSnapshot | None = await self.collector.collect()
+        if snap is None:
+            return []  # collection failed: hold state, never act blind
         snap.desired = dict(self.decisions.get(snap.model_id, {}))
         specs = self.variants.get(snap.model_id, [])
         spec_by_name = {v.name: v for v in specs}
 
         if self.analyzer_name == "saturation-token-based":
             sig = self.v2.analyze(snap, spec_by_name)
-            # convert token signals to replica deltas via cheapest/most
-            # expensive variant capacity respectively
+            # Token signals -> replica deltas. Scale-up lands on the
+            # cheapest variant, so size it by that variant's capacity;
+            # scale-down removes the most EXPENSIVE variant's replicas, so
+            # it must be sized by that (larger) capacity or the optimizer
+            # frees more supply than the spare signal covers and the pool
+            # oscillates.
             cheapest = min(specs, key=lambda v: v.cost) if specs else None
+            priciest = max(specs, key=lambda v: v.cost) if specs else None
             cap_up = (
                 self.v2.capacity_cache.get(cheapest.name, 0.0) if cheapest else 0.0
             ) or max(self.v2.capacity_cache.values(), default=0.0)
@@ -222,11 +237,18 @@ class WvaEngine:
                 cap_up = self.v2.derived_k2(
                     cheapest.max_batched_tokens, cheapest.max_num_seqs, 512, 128
                 )
+            cap_down = (
+                self.v2.capacity_cache.get(priciest.name, 0.0) if priciest else 0.0
+            ) or max(self.v2.capacity_cache.values(), default=cap_up)
             need = tokens_to_replicas(sig.required, cap_up)
-            free = tokens_to_replicas(max(0.0, sig.spare - cap_up), cap_up)
+            free = tokens_to_replicas(max(0.0, sig.spare - cap_down), cap_down)
+            # Scale-down is conservative: one replica per cycle (matches
+            # the V1 reference behavior; the next cycle re-evaluates).
+            free = min(free, 1)
         elif self.analyzer_name == "slo":
             sig = self.slo.analyze(snap)
-            need, free = int(sig.required), int(sig.spare)
+            # Scale-down hysteresis: at most one replica per cycle.
+            need, free = int(sig.required), min(int(sig.spare), 1)
         else:
             sig = self.v1.analyze(snap)
             need, free = int(sig.required), int(sig.spare)

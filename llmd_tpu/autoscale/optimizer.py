@@ -153,7 +153,8 @@ class Enforcer:
                 )
         if self.scale_to_zero and not any_min:
             if (
-                snap.recent_request_count <= self.retention_ok_requests
+                snap.recent_request_count is not None
+                and snap.recent_request_count <= self.retention_ok_requests
                 and snap.epp_queue_size == 0
             ):
                 for d in decisions:

@@ -73,7 +73,9 @@ class PoolSnapshot:
     epp_queue_size: float = 0.0
     epp_queue_bytes: float = 0.0
     # Requests completed over the scale-to-zero retention window.
-    recent_request_count: float = 0.0
+    # None = the window has not been fully observed yet (collector warm-up);
+    # scale-to-zero must not act on it.
+    recent_request_count: float | None = 0.0
 
     def by_variant(self) -> dict[str, list[ReplicaMetrics]]:
         out: dict[str, list[ReplicaMetrics]] = {}

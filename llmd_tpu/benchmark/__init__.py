@@ -1,0 +1,35 @@
+"""Benchmark harness (the reference's `llmdbenchmark` / inference-perf).
+
+Drives an OpenAI-compatible endpoint (engine or router) with declarative
+workload profiles — constant-rate open-loop stages or concurrency-bound
+closed-loop stages over random / shared-prefix / multi-turn-agentic data
+generators — records per-request lifecycle (TTFT, TPOT, E2E, tokens),
+and produces summary + per-stage reports (JSON and markdown).
+
+Reference shape: helpers/benchmark.md:25-90 and the
+guides/*/benchmark-templates/*.yaml workload profiles (load.type
+constant|concurrent, data.type random|shared_prefix|conversation_replay,
+report.request_lifecycle summary/per_stage/per_request).
+"""
+
+from llmd_tpu.benchmark.workload import (
+    Distribution,
+    Stage,
+    WorkloadSpec,
+    get_profile,
+    PROFILES,
+)
+from llmd_tpu.benchmark.loadgen import LoadGenerator, RequestRecord
+from llmd_tpu.benchmark.analysis import analyze, render_markdown
+
+__all__ = [
+    "Distribution",
+    "Stage",
+    "WorkloadSpec",
+    "get_profile",
+    "PROFILES",
+    "LoadGenerator",
+    "RequestRecord",
+    "analyze",
+    "render_markdown",
+]

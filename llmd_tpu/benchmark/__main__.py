@@ -1,0 +1,82 @@
+"""`python -m llmd_tpu.benchmark` — the benchmark CLI.
+
+The no-cluster analogue of the reference `llmdbenchmark run`
+(helpers/benchmark.md:66-90): point at an endpoint, pick a workload
+profile, get a JSON report (+ optional markdown analysis).
+
+Examples:
+    python -m llmd_tpu.benchmark --url http://localhost:8800 \
+        --model llama-3-8b --workload sanity
+    python -m llmd_tpu.benchmark --url http://localhost:8800 \
+        --model llama-3-8b --workload shared_prefix_synthetic \
+        --overrides prefix_tokens=4096 seed=13 --analyze -o results.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _parse_overrides(pairs: list[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        k, _, v = pair.partition("=")
+        if not _:
+            raise SystemExit(f"--overrides entries must be key=value, got {pair!r}")
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def main(argv=None) -> None:
+    from llmd_tpu.benchmark.analysis import analyze, render_markdown
+    from llmd_tpu.benchmark.loadgen import LoadGenerator
+    from llmd_tpu.benchmark.workload import PROFILES, get_profile
+
+    p = argparse.ArgumentParser("llmd-tpu benchmark")
+    p.add_argument("--url", required=True, help="endpoint base URL")
+    p.add_argument("--model", required=True)
+    p.add_argument("--workload", default="sanity", choices=sorted(PROFILES))
+    p.add_argument(
+        "--overrides", nargs="*", default=[],
+        help="workload field overrides, key=value (JSON values accepted)",
+    )
+    p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("-o", "--output", default=None, help="write JSON report here")
+    p.add_argument("--analyze", action="store_true", help="print markdown report")
+    args = p.parse_args(argv)
+
+    spec = get_profile(args.workload, **_parse_overrides(args.overrides))
+    gen = LoadGenerator(args.url, args.model, spec, args.request_timeout)
+    records = asyncio.run(gen.run())
+    report = analyze(records)
+    report["workload"] = spec.name
+    report["endpoint"] = args.url
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(report, f, indent=2)
+    if args.analyze:
+        print(render_markdown(report, title=f"{spec.name} @ {args.url}"))
+    else:
+        s = report["summary"]
+        print(json.dumps({
+            "workload": spec.name,
+            "requests": s["requests"],
+            "failed": s["failed"],
+            "req_per_s": round(s["request_throughput_rps"], 3),
+            "output_tok_per_s": round(s["output_tok_per_s"], 1),
+            "ttft_p50_s": round(s["ttft_s"]["p50"], 4),
+            "ttft_p99_s": round(s["ttft_s"]["p99"], 4),
+            "tpot_p50_ms": round(s["tpot_s"]["p50"] * 1e3, 2),
+        }))
+    if report["summary"]["failed"] and not report["summary"]["succeeded"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Result analysis: lifecycle summaries + per-stage breakdowns.
+
+Produces the metric set the reference guides publish in their
+benchmark-results tables (e.g. pd-disaggregation/README.md:600-615:
+mean/P50/P90/P95/P99 TTFT, TPOT/ITL, E2E, output tok/s, req/s,
+success/failure counts).
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any
+
+from llmd_tpu.benchmark.loadgen import RequestRecord
+
+
+def _pct(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def _dist(vals: list[float]) -> dict[str, float]:
+    if not vals:
+        return {"mean": 0.0, "p50": 0.0, "p90": 0.0, "p95": 0.0, "p99": 0.0}
+    s = sorted(vals)
+    return {
+        "mean": statistics.fmean(s),
+        "p50": _pct(s, 50),
+        "p90": _pct(s, 90),
+        "p95": _pct(s, 95),
+        "p99": _pct(s, 99),
+    }
+
+
+def analyze(records: list[RequestRecord]) -> dict[str, Any]:
+    ok = [r for r in records if r.ok]
+    failed = [r for r in records if not r.ok]
+    if records:
+        t0 = min(r.start_s for r in records)
+        t1 = max(
+            (r.start_s + (r.e2e_s or 0.0)) for r in records
+        )
+        wall = max(t1 - t0, 1e-9)
+    else:
+        wall = 1e-9
+    out_tokens = sum(r.output_tokens for r in ok)
+    summary = {
+        "requests": len(records),
+        "succeeded": len(ok),
+        "failed": len(failed),
+        "wall_s": wall,
+        "request_throughput_rps": len(ok) / wall,
+        "output_tokens": out_tokens,
+        "output_tok_per_s": out_tokens / wall,
+        "ttft_s": _dist([r.ttft_s for r in ok if r.ttft_s is not None]),
+        "tpot_s": _dist([r.tpot_s for r in ok if r.tpot_s is not None]),
+        "e2e_s": _dist([r.e2e_s for r in ok if r.e2e_s is not None]),
+    }
+    per_stage: dict[str, Any] = {}
+    for idx in sorted({r.stage for r in records}):
+        srecs = [r for r in ok if r.stage == idx]
+        if not srecs:
+            per_stage[str(idx)] = {"succeeded": 0}
+            continue
+        st0 = min(r.start_s for r in srecs)
+        st1 = max(r.start_s + (r.e2e_s or 0.0) for r in srecs)
+        sw = max(st1 - st0, 1e-9)
+        per_stage[str(idx)] = {
+            "succeeded": len(srecs),
+            "output_tok_per_s": sum(r.output_tokens for r in srecs) / sw,
+            "ttft_s": _dist([r.ttft_s for r in srecs if r.ttft_s is not None]),
+            "e2e_s": _dist([r.e2e_s for r in srecs if r.e2e_s is not None]),
+        }
+    errors: dict[str, int] = {}
+    for r in failed:
+        key = r.error or f"http_{r.status}"
+        errors[key] = errors.get(key, 0) + 1
+    return {"summary": summary, "per_stage": per_stage, "errors": errors}
+
+
+def render_markdown(report: dict[str, Any], title: str = "benchmark") -> str:
+    s = report["summary"]
+
+    def row(name: str, d: dict[str, float], scale: float = 1.0, unit: str = "s") -> str:
+        return (
+            f"| {name} | {d['mean']*scale:.3f} | {d['p50']*scale:.3f} | "
+            f"{d['p90']*scale:.3f} | {d['p95']*scale:.3f} | {d['p99']*scale:.3f} | {unit} |"
+        )
+
+    lines = [
+        f"# {title}",
+        "",
+        f"- requests: {s['requests']} (ok {s['succeeded']}, failed {s['failed']})",
+        f"- wall: {s['wall_s']:.1f}s",
+        f"- request throughput: {s['request_throughput_rps']:.2f} req/s",
+        f"- output token throughput: {s['output_tok_per_s']:.1f} tok/s",
+        "",
+        "| metric | mean | p50 | p90 | p95 | p99 | unit |",
+        "|---|---|---|---|---|---|---|",
+        row("TTFT", s["ttft_s"]),
+        row("TPOT", s["tpot_s"], 1000.0, "ms"),
+        row("E2E", s["e2e_s"]),
+    ]
+    if report.get("errors"):
+        lines += ["", "## Errors", ""]
+        for k, v in sorted(report["errors"].items()):
+            lines.append(f"- {k}: {v}")
+    if len(report.get("per_stage", {})) > 1:
+        lines += ["", "## Per stage", "", "| stage | ok | tok/s | TTFT p50 | TTFT p99 |", "|---|---|---|---|---|"]
+        for idx, st in report["per_stage"].items():
+            if st.get("succeeded"):
+                lines.append(
+                    f"| {idx} | {st['succeeded']} | {st['output_tok_per_s']:.1f} "
+                    f"| {st['ttft_s']['p50']:.3f} | {st['ttft_s']['p99']:.3f} |"
+                )
+            else:
+                lines.append(f"| {idx} | 0 | - | - | - |")
+    return "\n".join(lines) + "\n"

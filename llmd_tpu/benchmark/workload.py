@@ -1,0 +1,216 @@
+"""Workload profiles: declarative load + data generation specs.
+
+Mirrors the reference inference-perf profile fields
+(guides/pd-disaggregation/benchmark-templates/tpu.yaml: load.type
+constant with rate/duration stages; agentic guide.yaml: load.type
+concurrent with num_requests/concurrency_level stages, lognormal token
+distributions, shared system prompts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+
+
+@dataclasses.dataclass
+class Distribution:
+    """Token-count distribution: constant, uniform or lognormal."""
+
+    type: str = "constant"  # constant | uniform | lognormal
+    mean: float = 256.0
+    min: float = 1.0
+    max: float = 1_000_000.0
+    std_dev: float = 0.0
+
+    def sample(self, rng: random.Random) -> int:
+        if self.type == "constant" or self.std_dev <= 0:
+            v = self.mean
+        elif self.type == "uniform":
+            v = rng.uniform(self.min, self.max)
+        elif self.type == "lognormal":
+            # Parameterized by arithmetic mean/std of the underlying value
+            # (the reference profiles specify mean/std_dev in token units).
+            m, s = max(self.mean, 1e-9), max(self.std_dev, 1e-9)
+            sigma2 = math.log(1.0 + (s * s) / (m * m))
+            mu = math.log(m) - sigma2 / 2.0
+            v = rng.lognormvariate(mu, math.sqrt(sigma2))
+        else:
+            raise ValueError(f"unknown distribution type {self.type!r}")
+        return int(max(self.min, min(self.max, round(v))))
+
+
+@dataclasses.dataclass
+class Stage:
+    """One load stage.
+
+    Open-loop (reference load.type=constant): `rate` req/s for `duration`
+    seconds (Poisson arrivals). Closed-loop (load.type=concurrent):
+    `num_requests` total at `concurrency` in flight.
+    """
+
+    rate: float | None = None
+    duration_s: float | None = None
+    num_requests: int | None = None
+    concurrency: int | None = None
+
+    @property
+    def open_loop(self) -> bool:
+        return self.rate is not None
+
+
+@dataclasses.dataclass
+class WorkloadSpec:
+    name: str = "custom"
+    stages: list[Stage] = dataclasses.field(default_factory=list)
+    # data generation
+    data_type: str = "random"  # random | shared_prefix | conversation
+    input_tokens: Distribution = dataclasses.field(default_factory=Distribution)
+    output_tokens: Distribution = dataclasses.field(
+        default_factory=lambda: Distribution(mean=128)
+    )
+    # shared_prefix: `num_groups` distinct prefixes of `prefix_tokens`,
+    # each question continues one group's prefix (tiered/precise guides).
+    num_groups: int = 8
+    prefix_tokens: int = 1024
+    # conversation: multi-turn sessions re-sending accumulated context
+    # (agentic guide) — `turns` per conversation, shared system prompt.
+    turns: Distribution = dataclasses.field(
+        default_factory=lambda: Distribution(mean=4, min=1, max=64)
+    )
+    system_prompt_tokens: int = 512
+    streaming: bool = True
+    api: str = "completion"  # completion | chat
+    ignore_eos: bool = True
+    seed: int = 7
+
+    def total_planned_requests(self) -> int | None:
+        n = 0
+        for s in self.stages:
+            if s.num_requests is not None:
+                n += s.num_requests
+            elif s.rate is not None and s.duration_s is not None:
+                n += int(s.rate * s.duration_s)
+            else:
+                return None
+        return n
+
+
+# ---------------------------------------------------------------- prompts
+
+_WORDS = (
+    "alpha bravo charlie delta echo foxtrot golf hotel india juliet kilo "
+    "lima mike november oscar papa quebec romeo sierra tango uniform victor "
+    "whiskey xray yankee zulu".split()
+)
+
+
+def synth_text(rng: random.Random, n_tokens: int) -> str:
+    """~1 word ≈ 1 token for whitespace tokenizers; for BPE tokenizers the
+    EPP-side char-ratio heuristic (4 chars/token) also roughly holds."""
+    return " ".join(rng.choice(_WORDS) for _ in range(max(1, n_tokens)))
+
+
+class PromptSource:
+    """Stateful prompt generator for one workload run."""
+
+    def __init__(self, spec: WorkloadSpec) -> None:
+        self.spec = spec
+        self.rng = random.Random(spec.seed)
+        self._prefixes = [
+            synth_text(self.rng, spec.prefix_tokens)
+            for _ in range(max(1, spec.num_groups))
+        ]
+        self._system = synth_text(self.rng, spec.system_prompt_tokens)
+        # live conversations: list of (history_text, turns_left)
+        self._conversations: list[list] = []
+
+    def next_request(self) -> tuple[str, int]:
+        """Returns (prompt_text, max_tokens)."""
+        spec = self.spec
+        out_toks = spec.output_tokens.sample(self.rng)
+        isl = spec.input_tokens.sample(self.rng)
+        if spec.data_type == "random":
+            return synth_text(self.rng, isl), out_toks
+        if spec.data_type == "shared_prefix":
+            prefix = self.rng.choice(self._prefixes)
+            return prefix + " " + synth_text(self.rng, isl), out_toks
+        if spec.data_type == "conversation":
+            if not self._conversations or (
+                len(self._conversations) < 64 and self.rng.random() < 0.3
+            ):
+                turns = spec.turns.sample(self.rng)
+                self._conversations.append([self._system, turns])
+            conv = self.rng.choice(self._conversations)
+            conv[0] = conv[0] + " " + synth_text(self.rng, isl)
+            conv[1] -= 1
+            prompt = conv[0]
+            if conv[1] <= 0:
+                self._conversations.remove(conv)
+            return prompt, out_toks
+        raise ValueError(f"unknown data_type {spec.data_type!r}")
+
+
+# ---------------------------------------------------------------- profiles
+
+PROFILES: dict[str, WorkloadSpec] = {
+    # Smoke-level check (the reference "sanity" workload).
+    "sanity": WorkloadSpec(
+        name="sanity",
+        stages=[Stage(num_requests=8, concurrency=2)],
+        input_tokens=Distribution(mean=64, min=16, max=128),
+        output_tokens=Distribution(mean=32, min=8, max=64),
+    ),
+    # random_1k_1k_isl_osl (pd-disaggregation TPU template).
+    "random_1k_1k": WorkloadSpec(
+        name="random_1k_1k",
+        stages=[Stage(rate=1.0, duration_s=120.0)],
+        input_tokens=Distribution(mean=1024),
+        output_tokens=Distribution(mean=1024),
+    ),
+    # shared_prefix_synthetic (tiered/precise prefix-cache guides).
+    "shared_prefix_synthetic": WorkloadSpec(
+        name="shared_prefix_synthetic",
+        data_type="shared_prefix",
+        stages=[Stage(num_requests=64, concurrency=8)],
+        num_groups=8,
+        prefix_tokens=2048,
+        input_tokens=Distribution(mean=128, min=32, max=512),
+        output_tokens=Distribution(mean=128, min=16, max=256),
+    ),
+    # Agentic multi-turn sessions (agentic-serving guide, scaled down).
+    "agentic": WorkloadSpec(
+        name="agentic",
+        data_type="conversation",
+        stages=[Stage(num_requests=64, concurrency=8)],
+        system_prompt_tokens=1024,
+        turns=Distribution(type="lognormal", mean=6, std_dev=4, min=1, max=64),
+        input_tokens=Distribution(
+            type="lognormal", mean=256, std_dev=192, min=32, max=2048
+        ),
+        output_tokens=Distribution(
+            type="lognormal", mean=128, std_dev=96, min=16, max=1024
+        ),
+    ),
+    # Rate ladder (precise-prefix benchmark: rate 3 -> 60).
+    "rate_ladder": WorkloadSpec(
+        name="rate_ladder",
+        stages=[
+            Stage(rate=r, duration_s=30.0) for r in (1.0, 2.0, 4.0, 8.0)
+        ],
+        input_tokens=Distribution(mean=512),
+        output_tokens=Distribution(mean=128),
+    ),
+}
+
+
+def get_profile(name: str, **overrides) -> WorkloadSpec:
+    """Profile by name with per-run field overrides (the CLI
+    `--overrides key=value` mechanism)."""
+    spec = dataclasses.replace(PROFILES[name])
+    for k, v in overrides.items():
+        if not hasattr(spec, k):
+            raise KeyError(f"unknown workload field {k!r}")
+        setattr(spec, k, v)
+    return spec

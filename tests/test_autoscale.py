@@ -180,7 +180,10 @@ def test_slo_analyzer_learns_and_scales():
 
 def test_slo_inferred_target_multiplier():
     a = SloQueueingAnalyzer()  # no explicit target
+    # Before any Kalman update: observed-TTFT x 1.5 fallback.
+    assert a.targets(100.0, 500.0) == pytest.approx(750.0)
     a.kf.x = [10.0, 0.1, 0.0]
+    a.kf.updates = 5
     t = a.targets(avg_input_tokens=100.0, observed_ttft_ms=500.0)
     assert t == pytest.approx((10 + 0.1 * 100) * 3.0)
 
