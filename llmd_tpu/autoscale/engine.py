@@ -91,8 +91,6 @@ class RouterCollector:
         rather than treat an unreachable router as an idle pool (acting on
         an empty snapshot would tear down a healthy loaded fleet)."""
         now = time.monotonic()
-        if self._first_collect_t is None:
-            self._first_collect_t = now
         snap = PoolSnapshot(model_id=self.model_id)
         try:
             router_metrics = parse_prometheus(
@@ -104,6 +102,10 @@ class RouterCollector:
         except Exception as e:
             log.warning("WVA collect from router failed: %s", e)
             return None
+        # Warm-up clock starts at the first SUCCESSFUL scrape: a router
+        # outage must not age the retention window it never observed.
+        if self._first_collect_t is None:
+            self._first_collect_t = now
         snap.epp_queue_size = router_metrics.get(
             "llm_d_epp_flow_control_queue_size", 0.0
         )

@@ -98,18 +98,27 @@ class LoadGenerator:
                     rec.e2e_s = time.monotonic() - t0
                     return rec
                 if self.spec.streaming:
+                    # Chat streams open with a role-priming frame emitted
+                    # before any token is generated; TTFT must anchor on
+                    # the first CONTENT frame, and the role frame must not
+                    # count as an output token.
                     n_frames = 0
                     carry = b""
                     async for chunk in resp.content.iter_any():
-                        if rec.ttft_s is None:
-                            rec.ttft_s = time.monotonic() - t0
                         lines = (carry + chunk).split(b"\n")
                         carry = lines.pop()
-                        n_frames += sum(
-                            1
-                            for ln in lines
-                            if ln.startswith(b"data:") and b"[DONE]" not in ln
-                        )
+                        for ln in lines:
+                            if not ln.startswith(b"data:") or b"[DONE]" in ln:
+                                continue
+                            if (
+                                self.spec.api == "chat"
+                                and b'"content"' not in ln
+                                and n_frames == 0
+                            ):
+                                continue  # role-priming frame
+                            n_frames += 1
+                            if rec.ttft_s is None:
+                                rec.ttft_s = time.monotonic() - t0
                     rec.output_tokens = max(0, n_frames - 1)  # final frame = usage
                 else:
                     data = await resp.json()

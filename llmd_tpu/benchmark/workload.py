@@ -25,10 +25,12 @@ class Distribution:
     std_dev: float = 0.0
 
     def sample(self, rng: random.Random) -> int:
-        if self.type == "constant" or self.std_dev <= 0:
+        if self.type == "constant":
             v = self.mean
         elif self.type == "uniform":
             v = rng.uniform(self.min, self.max)
+        elif self.type == "lognormal" and self.std_dev <= 0:
+            v = self.mean
         elif self.type == "lognormal":
             # Parameterized by arithmetic mean/std of the underlying value
             # (the reference profiles specify mean/std_dev in token units).

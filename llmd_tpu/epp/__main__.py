@@ -38,7 +38,23 @@ def main(argv=None) -> None:
         help="training sidecar base URL (predicted-latency routing)",
     )
     p.add_argument("--scrape-interval", type=float, default=1.0)
+    p.add_argument(
+        "--otlp-traces-endpoint", default=None,
+        help="OTLP/HTTP collector base URL (e.g. http://otel:4318)",
+    )
+    p.add_argument("--trace-file", default=None, help="JSONL span log path")
+    p.add_argument("--trace-sample-ratio", type=float, default=0.1)
     args = p.parse_args(argv)
+
+    if args.otlp_traces_endpoint or args.trace_file:
+        from llmd_tpu.obs.tracing import configure_tracing
+
+        configure_tracing(
+            "llmd-router",
+            otlp_endpoint=args.otlp_traces_endpoint,
+            trace_file=args.trace_file,
+            sample_ratio=args.trace_sample_ratio,
+        )
 
     from aiohttp import web
 

@@ -29,6 +29,7 @@ from llmd_tpu.epp.handler import (
     parse_request,
 )
 from llmd_tpu.epp.scheduler import NoEndpointsError, Scheduler
+from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.epp.types import (
     HDR_DROP_REASON,
     HDR_PREFILLER,
@@ -184,6 +185,31 @@ class Router:
                 {"error": {"message": str(e), "type": "invalid_request_error"}},
                 status=400,
             )
+        # Root/continued span for the whole routed request (reference
+        # tracing.md: the EPP continues the proxy's traceparent; sampling
+        # is parent-based). The span travels in req.scratch so scheduling
+        # and proxying annotate it (P/D decision intelligence).
+        span = get_tracer().start_span(
+            "router.request",
+            traceparent=req.headers.get("traceparent"),
+            kind="SPAN_KIND_SERVER",
+        )
+        span.set("gen_ai.request.model", req.model)
+        span.set("http.route", req.path)
+        span.set("llm_d.request.priority", req.priority)
+        span.set("llm_d.request.prompt_tokens", req.approx_prompt_tokens)
+        req.scratch["span"] = span
+        try:
+            return await self._handle_generate_traced(request, req, raw, span)
+        except BaseException as e:
+            span.error(str(e))
+            raise
+        finally:
+            span.end()
+
+    async def _handle_generate_traced(
+        self, request: web.Request, req: LLMRequest, raw: bytes, span
+    ) -> web.StreamResponse:
         # Cheap admitters reject before the request can occupy queue
         # capacity or a dispatch slot; producer-dependent admitters run
         # after dispatch (below).
@@ -196,7 +222,10 @@ class Router:
                         status=429,
                         headers={HDR_DROP_REASON: reason},
                     )
+        t_enq = time.monotonic()
         outcome = await self.flow.enqueue_and_wait(req, nbytes=len(raw))
+        span.set("llm_d.flow_control.wait_s", time.monotonic() - t_enq)
+        span.set("llm_d.flow_control.outcome", str(outcome.value))
         if outcome is not Outcome.DISPATCHED:
             status, reason = OUTCOME_HTTP[outcome]
             return web.json_response(
@@ -245,6 +274,19 @@ class Router:
                 )
             pod = result.primary
             tried.add(pod.address)
+            span = req.scratch.get("span")
+            if span is not None:
+                span.set("llm_d.decision.endpoint", pod.address)
+                span.set(
+                    "llm_d.decision.prefill",
+                    result.prefill.address if result.prefill else "",
+                )
+                for pname, pres in result.profiles.items():
+                    if pres.endpoint is not None and pres.scores:
+                        span.set(
+                            f"llm_d.score.{pname}",
+                            round(pres.scores.get(pres.endpoint.address, 0.0), 4),
+                        )
             extra_headers = {}
             prefill_pod = result.prefill
             if prefill_pod is not None:
@@ -283,6 +325,9 @@ class Router:
         }
         headers["x-request-id"] = req.request_id
         headers.update(extra_headers)
+        span = req.scratch.get("span")
+        if span is not None and span.sampled:
+            headers["traceparent"] = span.traceparent
         pod.inflight += 1
         pod.inflight_tokens += req.approx_prompt_tokens
         t0 = time.monotonic()
@@ -332,6 +377,9 @@ class Router:
             tpot_ms: float | None = None
             # Only successful responses produce latency observations: a pod
             # fast-failing with 500s must not train/score as "fastest".
+            if span is not None and first_byte is not None:
+                span.set("llm_d.ttft_s", first_byte - t0)
+                span.set("http.status_code", status)
             if first_byte is not None and 200 <= status < 400:
                 self.metrics.ttft_count += 1
                 self.metrics.ttft_sum += first_byte - t0

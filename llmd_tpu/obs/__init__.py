@@ -1,0 +1,33 @@
+"""Observability: distributed tracing, dashboards, alert rules.
+
+Reference surface: docs/operations/observability/tracing.md:14-102 (OTel
+OTLP tracing across engine, sidecar, and EPP with
+parentbased_traceidratio sampling, default ratio 0.1) and
+proposals/distributed-tracing.md:60-111 (cache-hit attribution, P/D
+decision intelligence, bottleneck identification). The environment ships
+only the OTel *API*, so spans are produced by a lightweight in-house
+tracer speaking the OTLP/HTTP JSON encoding, with file and in-memory
+exporters for no-collector deployments and tests.
+"""
+
+from llmd_tpu.obs.tracing import (
+    FileExporter,
+    InMemoryExporter,
+    OtlpHttpExporter,
+    Span,
+    Tracer,
+    configure_tracing,
+    get_tracer,
+    parse_traceparent,
+)
+
+__all__ = [
+    "FileExporter",
+    "InMemoryExporter",
+    "OtlpHttpExporter",
+    "Span",
+    "Tracer",
+    "configure_tracing",
+    "get_tracer",
+    "parse_traceparent",
+]

@@ -109,6 +109,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--kv-offload-fs-dir", default=None, help="FS spill tier dir")
     p.add_argument("--skip-warmup", action="store_true")
+    p.add_argument(
+        "--otlp-traces-endpoint", default=None,
+        help="OTLP/HTTP collector base URL (e.g. http://otel:4318)",
+    )
+    p.add_argument("--trace-file", default=None, help="JSONL span log path")
+    p.add_argument("--trace-sample-ratio", type=float, default=0.1)
     return p
 
 
@@ -146,6 +152,15 @@ def main(argv=None) -> None:
         event_sink = ZMQEventSink(
             endpoint=config.kv_events_endpoint,
             pod=advertised,
+        )
+    if args.otlp_traces_endpoint or args.trace_file:
+        from llmd_tpu.obs.tracing import configure_tracing
+
+        configure_tracing(
+            "llmd-engine",
+            otlp_endpoint=args.otlp_traces_endpoint,
+            trace_file=args.trace_file,
+            sample_ratio=args.trace_sample_ratio,
         )
     engine = LLMEngine(config, event_sink=event_sink)
     if not args.skip_warmup:

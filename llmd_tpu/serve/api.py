@@ -22,6 +22,7 @@ import pydantic
 from aiohttp import web
 
 from llmd_tpu.engine.request import RequestOutput, SamplingParams
+from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.serve import protocol as P
 from llmd_tpu.serve.async_engine import AsyncEngine, EngineError, RequestFailed
 from llmd_tpu.serve.metrics import render_metrics
@@ -218,6 +219,7 @@ async def _stream_response(
     priority: int,
     kv_transfer_params: dict | None,
     chat: bool,
+    span=None,
 ) -> web.StreamResponse:
     resp = web.StreamResponse(
         headers={
@@ -259,6 +261,9 @@ async def _stream_response(
     except (asyncio.CancelledError, ConnectionResetError):
         engine.abort(rid)
         raise
+    if span is not None:
+        span.set("gen_ai.usage.completion_tokens", n_out)
+        span.set("llm_d.cache.hit_tokens", cached)
     final = (
         P.chat_chunk(rid, model, {}, finish)
         if chat
@@ -303,22 +308,51 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         "chatcmpl" if chat else "cmpl"
     )
     detok = Detokenizer(tokenizer, P.stop_strings(req.stop))
+    # Engine-side span continues the router's traceparent (reference
+    # tracing.md: per-hop spans; cache-hit attribution via cached tokens).
+    span = get_tracer().start_span(
+        "engine.generate",
+        traceparent=request.headers.get("traceparent"),
+        kind="SPAN_KIND_SERVER",
+    )
+    span.set("gen_ai.request.model", model)
+    span.set("gen_ai.usage.prompt_tokens", len(prompt_ids))
+    span.set("llm_d.request.streaming", bool(req.stream))
 
     if req.stream:
-        return await _stream_response(
-            request, engine, rid, model, prompt_ids, sampling, detok,
-            req.priority, req.kv_transfer_params, chat,
-        )
+        try:
+            return await _stream_response(
+                request, engine, rid, model, prompt_ids, sampling, detok,
+                req.priority, req.kv_transfer_params, chat, span,
+            )
+        except BaseException as e:
+            span.error(str(e))
+            raise
+        finally:
+            span.end()
     try:
         text, finish, final = await _collect(
             engine, rid, prompt_ids, sampling, detok, req.priority, req.kv_transfer_params
         )
     except RequestFailed as e:
+        span.error(str(e))
+        span.end()
         return _error(400, str(e))
     except EngineError as e:
+        span.error(str(e))
+        span.end()
         return web.json_response(
             P.error_body(str(e), etype="internal_error", code=500), status=500
         )
+    except BaseException as e:
+        # CancelledError on client disconnect etc.: the span for the
+        # anomalous request must still export.
+        span.error(str(e) or type(e).__name__)
+        span.end()
+        raise
+    span.set("gen_ai.usage.completion_tokens", final.num_output_tokens if final else 0)
+    span.set("llm_d.cache.hit_tokens", final.num_cached_tokens if final else 0)
+    span.end()
     usage = P.usage_dict(
         len(prompt_ids),
         final.num_output_tokens if final else 0,

@@ -25,7 +25,20 @@ def main() -> None:
     )
     ap.add_argument("--prefill-timeout", type=float, default=600.0)
     ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--otlp-traces-endpoint", default=None)
+    ap.add_argument("--trace-file", default=None)
+    ap.add_argument("--trace-sample-ratio", type=float, default=0.1)
     args = ap.parse_args()
+
+    if args.otlp_traces_endpoint or args.trace_file:
+        from llmd_tpu.obs.tracing import configure_tracing
+
+        configure_tracing(
+            "llmd-sidecar",
+            otlp_endpoint=args.otlp_traces_endpoint,
+            trace_file=args.trace_file,
+            sample_ratio=args.trace_sample_ratio,
+        )
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

@@ -35,6 +35,7 @@ from aiohttp import web
 
 from llmd_tpu.epp.types import HDR_PREFILLER
 from llmd_tpu.kvtransfer import shipper as shipper_mod
+from llmd_tpu.obs.tracing import get_tracer
 
 log = logging.getLogger(__name__)
 
@@ -142,22 +143,55 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
                 status=400,
             )
 
-        params = await run_prefill(session, prefiller, request.path, body)
-        heartbeat = _LeaseHeartbeat(params or {}, cfg.heartbeat_s)
-        if params is not None:
-            body = dict(body)
-            body["kv_transfer_params"] = params
-            heartbeat.start()
+        # P/D decision intelligence spans (reference
+        # proposals/distributed-tracing.md): one child span per phase so a
+        # trace shows prefill time vs KV-pull+decode time per request.
+        tracer = get_tracer()
+        root = tracer.start_span(
+            "sidecar.two_phase",
+            traceparent=request.headers.get("traceparent"),
+            kind="SPAN_KIND_SERVER",
+        )
+        root.set("llm_d.prefiller", prefiller)
+        heartbeat = None
+        dec_span = None
         try:
+            pre_span = tracer.start_span("sidecar.prefill", parent=root)
+            try:
+                params = await run_prefill(session, prefiller, request.path, body)
+                pre_span.set("llm_d.prefill.remote", params is not None)
+            except BaseException as e:
+                pre_span.error(str(e) or type(e).__name__)
+                raise
+            finally:
+                pre_span.end()
+            root.set("llm_d.decision.fallback_decoder_only", params is None)
+            heartbeat = _LeaseHeartbeat(params or {}, cfg.heartbeat_s)
+            if params is not None:
+                body = dict(body)
+                body["kv_transfer_params"] = params
+                heartbeat.start()
+            dec_span = tracer.start_span("sidecar.decode", parent=root)
+            headers = _fwd_headers(request.headers)
+            if dec_span.sampled:
+                headers["traceparent"] = dec_span.traceparent
             async with session.post(
                 local_base + request.path,
-                headers=_fwd_headers(request.headers),
+                headers=headers,
                 json=body,
             ) as upstream:
                 heartbeat.stop()  # decode accepted; consumer owns the pull
+                dec_span.set("http.status_code", upstream.status)
                 return await _relay(request, upstream)
+        except BaseException as e:
+            root.error(str(e) or type(e).__name__)
+            raise
         finally:
-            heartbeat.stop()
+            if heartbeat is not None:
+                heartbeat.stop()
+            if dec_span is not None:
+                dec_span.end()
+            root.end()
 
     async def run_prefill(
         session: aiohttp.ClientSession, prefiller: str, path: str, body: dict

@@ -1,0 +1,164 @@
+"""Tracing: W3C propagation, sampling, export, cross-layer trace linkage."""
+
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config
+from llmd_tpu.engine import LLMEngine
+from llmd_tpu.epp.config import DEFAULT_CONFIG, build_flow_control, build_scheduler
+from llmd_tpu.epp.datalayer import EndpointStore
+from llmd_tpu.epp.server import Router
+from llmd_tpu.epp.types import Endpoint
+from llmd_tpu.obs.tracing import (
+    FileExporter,
+    InMemoryExporter,
+    Tracer,
+    configure_tracing,
+    format_traceparent,
+    parse_traceparent,
+    reset_tracing,
+)
+from llmd_tpu.serve.api import build_app
+from llmd_tpu.serve.async_engine import AsyncEngine
+from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    reset_tracing()
+
+
+def test_traceparent_roundtrip():
+    tp = format_traceparent("ab" * 16, "cd" * 8, True)
+    parsed = parse_traceparent(tp)
+    assert parsed == ("ab" * 16, "cd" * 8, 1)
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+
+
+def test_sampling_ratio_extremes():
+    always = Tracer("t", InMemoryExporter(), sample_ratio=1.0)
+    never = Tracer("t", InMemoryExporter(), sample_ratio=0.0)
+    assert always.start_span("x").sampled
+    assert not never.start_span("x").sampled
+
+
+def test_parent_based_sampling_honors_parent_decision():
+    t = Tracer("t", InMemoryExporter(), sample_ratio=0.0)
+    # sampled parent forces sampling even at ratio 0
+    tp = format_traceparent("ab" * 16, "cd" * 8, True)
+    s = t.start_span("x", traceparent=tp)
+    assert s.sampled and s.trace_id == "ab" * 16 and s.parent_id == "cd" * 8
+    # unsampled parent suppresses even at ratio 1
+    t2 = Tracer("t", InMemoryExporter(), sample_ratio=1.0)
+    tp0 = format_traceparent("ab" * 16, "cd" * 8, False)
+    assert not t2.start_span("x", traceparent=tp0).sampled
+
+
+def test_span_export_and_otlp_shape():
+    exp = InMemoryExporter()
+    t = Tracer("svc", exp, sample_ratio=1.0)
+    with t.span("op", foo="bar") as s:
+        s.set("n", 3)
+        s.event("milestone", k=1)
+    assert len(exp.spans) == 1
+    otlp = exp.spans[0].to_otlp()
+    assert otlp["name"] == "op"
+    keys = {a["key"] for a in otlp["attributes"]}
+    assert {"foo", "n"} <= keys
+    assert otlp["events"][0]["name"] == "milestone"
+    assert otlp["status"]["code"] == "STATUS_CODE_OK"
+
+
+def test_span_error_status():
+    exp = InMemoryExporter()
+    t = Tracer("svc", exp, sample_ratio=1.0)
+    with pytest.raises(RuntimeError):
+        with t.span("op"):
+            raise RuntimeError("boom")
+    assert exp.spans[0].to_otlp()["status"]["code"] == "STATUS_CODE_ERROR"
+
+
+def test_file_exporter(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    t = Tracer("svc", FileExporter(path), sample_ratio=1.0)
+    with t.span("a"):
+        pass
+    with t.span("b"):
+        pass
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert [x["name"] for x in lines] == ["a", "b"]
+
+
+async def test_router_to_engine_trace_linkage():
+    """One client request produces router + engine spans in the same trace."""
+    exporter = InMemoryExporter()
+    configure_tracing("test", exporter=exporter, sample_ratio=1.0)
+
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64),
+    )
+    engine_app = build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 128)
+    es = TestServer(engine_app)
+    await es.start_server()
+
+    store = EndpointStore()
+    store.upsert(Endpoint(address=f"{es.host}:{es.port}"))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(DEFAULT_CONFIG),
+        flow_control=build_flow_control(DEFAULT_CONFIG),
+    )
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    try:
+        resp = await rc.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "hello", "max_tokens": 4},
+        )
+        assert resp.status == 200
+        by_name = {s.name: s for s in exporter.spans}
+        assert {"router.request", "engine.generate"} <= set(by_name)
+        r, e = by_name["router.request"], by_name["engine.generate"]
+        assert e.trace_id == r.trace_id  # same trace across the hop
+        assert e.parent_id == r.span_id  # engine child of router
+        attrs = r.attributes
+        assert attrs.get("llm_d.decision.endpoint") == f"{es.host}:{es.port}"
+        assert "llm_d.ttft_s" in attrs
+        assert "llm_d.cache.hit_tokens" in e.attributes
+    finally:
+        await rc.close()
+        await es.close()
+
+
+async def test_tracing_off_is_noop():
+    """Without configure_tracing the stack serves normally, no spans."""
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64),
+    )
+    app = build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 128)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "hello", "max_tokens": 4},
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
