@@ -1,0 +1,15 @@
+"""Encode-disaggregation: dedicated vision-encode workers + EC transfer.
+
+Reference: guides/multimodal-serving/README.md:33-50 — E-disaggregation
+offloads the vision encoder to a dedicated worker pool; downstream P/D
+workers pull the precomputed embeddings through the "EC connector"
+(NIXL dataplane + ZMQ control in the reference; here an HTTP pull plane
+over the same lease semantics as the KV shipper). The encoder itself is
+a JAX ViT (patch embed + transformer), jitted and shardable, so the
+heavy compute genuinely runs on the encode worker's chip.
+"""
+
+from llmd_tpu.encode.vision import VisionEncoderConfig, VisionEncoder
+from llmd_tpu.encode.ec_store import EcStore
+
+__all__ = ["VisionEncoder", "VisionEncoderConfig", "EcStore"]

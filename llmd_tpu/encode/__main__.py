@@ -1,0 +1,56 @@
+"""`python -m llmd_tpu.encode` — vision encode worker entry point."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("llmd-tpu encode worker")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--patch-size", type=int, default=14)
+    p.add_argument("--hidden-size", type=int, default=1024)
+    p.add_argument("--num-layers", type=int, default=12)
+    p.add_argument("--output-size", type=int, default=4096)
+    p.add_argument("--spatial-merge", type=int, default=2)
+    p.add_argument("--lease-seconds", type=float, default=60.0)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace-file", default=None)
+    p.add_argument("--otlp-traces-endpoint", default=None)
+    args = p.parse_args(argv)
+
+    if args.otlp_traces_endpoint or args.trace_file:
+        from llmd_tpu.obs.tracing import configure_tracing
+
+        configure_tracing(
+            "llmd-encode",
+            otlp_endpoint=args.otlp_traces_endpoint,
+            trace_file=args.trace_file,
+        )
+
+    from aiohttp import web
+
+    from llmd_tpu.encode.vision import VisionEncoderConfig
+    from llmd_tpu.encode.worker import EncodeWorker
+
+    cfg = VisionEncoderConfig(
+        image_size=args.image_size,
+        patch_size=args.patch_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        output_size=args.output_size,
+        spatial_merge=args.spatial_merge,
+    )
+    worker = EncodeWorker(
+        cfg, lease_s=args.lease_seconds, max_batch=args.max_batch, seed=args.seed
+    )
+    web.run_app(worker.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

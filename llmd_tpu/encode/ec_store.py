@@ -1,0 +1,93 @@
+"""EC (embedding-cache) store: lease-bounded embedding registry + pull API.
+
+The reference transfers encoder outputs to P/D workers over NIXL with
+ZMQ control ("EC Connector", multimodal-serving/README.md:44-46). The
+TPU-native equivalent keeps the same pull model and lease semantics as
+the KV shipper (operations-vllm.md:155-160): the encode worker
+registers embeddings under a content digest with a TTL lease; the
+consumer pulls them over HTTP and sends a free-notify; unpulled entries
+expire with the lease.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+
+
+class EcStore:
+    def __init__(self, lease_s: float = 60.0, max_entries: int = 4096) -> None:
+        self.lease_s = lease_s
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        # digest -> (expiry, dtype, shape, bytes)
+        self._entries: dict[str, tuple[float, str, tuple, bytes]] = {}
+        self.stats = {"puts": 0, "hits": 0, "misses": 0, "expired": 0, "freed": 0}
+
+    @staticmethod
+    def digest_of(data: bytes) -> str:
+        return hashlib.sha256(data).hexdigest()[:32]
+
+    def put(self, digest: str, emb: np.ndarray) -> None:
+        with self._lock:
+            self._gc_locked()
+            if len(self._entries) >= self.max_entries:
+                # evict the entry closest to expiry
+                oldest = min(self._entries.items(), key=lambda kv: kv[1][0])[0]
+                del self._entries[oldest]
+                self.stats["expired"] += 1
+            self._entries[digest] = (
+                time.monotonic() + self.lease_s,
+                str(emb.dtype),
+                tuple(emb.shape),
+                np.ascontiguousarray(emb).tobytes(),
+            )
+            self.stats["puts"] += 1
+
+    def get(self, digest: str, extend_lease: bool = True) -> np.ndarray | None:
+        with self._lock:
+            ent = self._entries.get(digest)
+            if ent is None:
+                self.stats["misses"] += 1
+                return None
+            expiry, dtype, shape, raw = ent
+            if expiry < time.monotonic():
+                del self._entries[digest]
+                self.stats["expired"] += 1
+                self.stats["misses"] += 1
+                return None
+            if extend_lease:
+                self._entries[digest] = (
+                    time.monotonic() + self.lease_s, dtype, shape, raw
+                )
+            self.stats["hits"] += 1
+        return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
+
+    def free(self, digest: str) -> bool:
+        """Consumer free-notify: the embedding was pulled and is owned
+        downstream; release producer memory immediately."""
+        with self._lock:
+            if digest in self._entries:
+                del self._entries[digest]
+                self.stats["freed"] += 1
+                return True
+        return False
+
+    def contains(self, digest: str) -> bool:
+        with self._lock:
+            ent = self._entries.get(digest)
+            return ent is not None and ent[0] >= time.monotonic()
+
+    def _gc_locked(self) -> None:
+        now = time.monotonic()
+        dead = [k for k, v in self._entries.items() if v[0] < now]
+        for k in dead:
+            del self._entries[k]
+        self.stats["expired"] += len(dead)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

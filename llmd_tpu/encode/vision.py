@@ -1,0 +1,165 @@
+"""JAX vision encoder: ViT patch embedding + transformer + projection.
+
+TPU-first design notes:
+- convolution-free patch embed (space-to-depth reshape + one matmul) so
+  the whole encoder is MXU matmuls;
+- fixed input resolution per compiled program (images are resized on
+  host) — no dynamic shapes under jit;
+- bf16 parameters/activations with f32 layernorm accumulation;
+- output projected to the language model's hidden size, one row per
+  visual token, matching the reference's ViT->LLM interface
+  (multimodal-serving/README.md:24-28).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionEncoderConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 12
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    # language-model hidden size the embeddings project into
+    output_size: int = 4096
+    # spatial merge: fold SxS patch grids into one output token
+    # (resolution -> token count control, the reference token-producer
+    # `estimate.dynamic.factor` analogue)
+    spatial_merge: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.grid * self.grid
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.num_patches // (self.spatial_merge**2)
+
+
+def init_params(cfg: VisionEncoderConfig, seed: int = 0) -> dict:
+    k = jax.random.PRNGKey(seed)
+    dt = jnp.dtype(cfg.dtype)
+    H, P = cfg.hidden_size, cfg.patch_size
+    mlp = int(cfg.hidden_size * cfg.mlp_ratio)
+    keys = jax.random.split(k, 4 + cfg.num_layers)
+
+    def dense(key, shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    params = {
+        "patch_proj": dense(keys[0], (P * P * 3, H)),
+        "pos_embed": dense(keys[1], (cfg.num_patches, H), scale=0.02),
+        "ln_f": jnp.ones((H,), dt),
+        "out_proj": dense(
+            keys[2], (H * cfg.spatial_merge**2, cfg.output_size)
+        ),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(keys[4 + i], 6)
+        params["layers"].append(
+            {
+                "ln1": jnp.ones((H,), dt),
+                "ln2": jnp.ones((H,), dt),
+                "qkv": dense(lk[0], (H, 3 * H)),
+                "attn_out": dense(lk[1], (H, H)),
+                "mlp_in": dense(lk[2], (H, mlp)),
+                "mlp_out": dense(lk[3], (mlp, H)),
+            }
+        )
+    # stack layers for lax.scan (single compiled block, XLA-friendly)
+    stacked = {
+        key: jnp.stack([lyr[key] for lyr in params["layers"]])
+        for key in params["layers"][0]
+    }
+    params["layers"] = stacked
+    return params
+
+
+def _ln(x: jax.Array, w: jax.Array) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w
+
+
+def encode_images(
+    params: dict, cfg: VisionEncoderConfig, pixels: jax.Array
+) -> jax.Array:
+    """pixels [B, S, S, 3] float in [0,1] -> embeddings
+    [B, tokens_per_image, output_size]."""
+    B = pixels.shape[0]
+    G, P = cfg.grid, cfg.patch_size
+    dt = jnp.dtype(cfg.dtype)
+    x = pixels.astype(dt)
+    # space-to-depth patchify: [B, G, P, G, P, 3] -> [B, G*G, P*P*3]
+    x = x.reshape(B, G, P, G, P, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(B, G * G, P * P * 3)
+    x = x @ params["patch_proj"] + params["pos_embed"][None]
+    nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+    def block(h, lyr):
+        y = _ln(h, lyr["ln1"])
+        qkv = (y @ lyr["qkv"]).reshape(B, -1, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = jnp.einsum("bqnd,bknd->bnqk", q, k) / math.sqrt(hd)
+        attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(dt)
+        y = jnp.einsum("bnqk,bknd->bqnd", attn, v).reshape(B, -1, cfg.hidden_size)
+        h = h + y @ lyr["attn_out"]
+        y = _ln(h, lyr["ln2"])
+        h = h + jax.nn.gelu(y @ lyr["mlp_in"]) @ lyr["mlp_out"]
+        return h, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _ln(x, params["ln_f"])
+    # spatial merge: [B, G, G, H] -> [B, G/m, G/m, m*m*H] -> project
+    m = cfg.spatial_merge
+    x = x.reshape(B, G, G, cfg.hidden_size)
+    x = x.reshape(B, G // m, m, G // m, m, cfg.hidden_size)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        B, cfg.tokens_per_image, m * m * cfg.hidden_size
+    )
+    return x @ params["out_proj"]
+
+
+class VisionEncoder:
+    """Host-facing encoder: resize + normalize on host, jitted ViT on device."""
+
+    def __init__(self, cfg: VisionEncoderConfig, seed: int = 0) -> None:
+        self.cfg = cfg
+        self.params = init_params(cfg, seed)
+        self._fn = jax.jit(lambda px: encode_images(self.params, cfg, px))
+
+    def preprocess(self, image) -> np.ndarray:
+        """PIL image -> [S, S, 3] float32 in [0,1]."""
+        s = self.cfg.image_size
+        img = image.convert("RGB").resize((s, s))
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    def encode(self, pixel_batch: np.ndarray) -> np.ndarray:
+        """[B, S, S, 3] -> [B, tokens_per_image, output_size] (host)."""
+        return np.asarray(self._fn(jnp.asarray(pixel_batch)))
+
+    @staticmethod
+    def estimate_tokens(
+        width: int, height: int, factor: int = 1024, cap: int = 16384
+    ) -> int:
+        """Resolution -> token estimate (the reference token-producer
+        `estimate: {mode: dynamic, dynamic: {factor: 1024}}`,
+        e-p-d-disaggregation.values.yaml:31-40): pixels / factor."""
+        return max(1, min(cap, (max(1, width) * max(1, height)) // factor))

@@ -1,0 +1,205 @@
+"""Encode worker: HTTP surface for the E tier.
+
+POST /v1/encode      {"images": [{"data": <base64 image bytes>} |
+                                 {"url": "data:...;base64,..."}]}
+                     -> {"items": [{"digest", "tokens", "shape", "dtype"}]}
+                     (encodes on the local chip, registers in the EC store)
+GET  /v1/ec/{digest} -> raw embedding bytes (x-ec-dtype/x-ec-shape headers)
+POST /v1/ec/{digest}/free  -> consumer free-notify (lease release)
+GET  /metrics, /health     -> EPP metrics contract (queue depth = inflight
+                              encode batches), role advertised as `encode`.
+
+The EPP's encode scheduling profile scores these workers by queue depth
+(reference e-p-d values: encode profile = encode-filter + queue-scorer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import binascii
+import io
+import logging
+
+import numpy as np
+from aiohttp import web
+
+from llmd_tpu.encode.ec_store import EcStore
+from llmd_tpu.encode.vision import VisionEncoder, VisionEncoderConfig
+from llmd_tpu.obs.tracing import get_tracer
+
+log = logging.getLogger(__name__)
+
+MAX_IMAGE_BYTES = 32 << 20
+
+
+def _decode_image_bytes(item: dict) -> bytes:
+    if "data" in item:
+        try:
+            return base64.b64decode(item["data"], validate=True)
+        except (binascii.Error, ValueError) as e:
+            raise ValueError(f"invalid base64 image data: {e}") from e
+    url = item.get("url", "")
+    if url.startswith("data:"):
+        _, _, payload = url.partition(",")
+        try:
+            return base64.b64decode(payload)
+        except (binascii.Error, ValueError) as e:
+            raise ValueError(f"invalid data URL: {e}") from e
+    raise ValueError(
+        "images must carry inline 'data' (base64) or a data: URL; "
+        "remote fetching is not supported on encode workers"
+    )
+
+
+class EncodeWorker:
+    def __init__(
+        self,
+        cfg: VisionEncoderConfig,
+        lease_s: float = 60.0,
+        max_batch: int = 8,
+        seed: int = 0,
+    ) -> None:
+        self.encoder = VisionEncoder(cfg, seed=seed)
+        self.store = EcStore(lease_s=lease_s)
+        self.max_batch = max_batch
+        self.inflight = 0
+        self.encoded_total = 0
+        self.cache_hits_total = 0
+        # Serialize device work; aiohttp handlers stay responsive.
+        self._device_lock = asyncio.Lock()
+
+    async def handle_encode(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        items = body.get("images")
+        if not isinstance(items, list) or not items:
+            return web.json_response({"error": "images must be a non-empty list"}, status=400)
+
+        from PIL import Image, UnidentifiedImageError
+
+        span = get_tracer().start_span(
+            "encode.batch",
+            traceparent=request.headers.get("traceparent"),
+            kind="SPAN_KIND_SERVER",
+        )
+        span.set("llm_d.encode.num_images", len(items))
+        self.inflight += 1
+        try:
+            digests: list[str] = []
+            to_encode: list[tuple[int, np.ndarray]] = []
+            batch_seen: set[str] = set()
+            for i, item in enumerate(items):
+                if not isinstance(item, dict):
+                    return web.json_response(
+                        {"error": f"images[{i}] must be an object"}, status=400
+                    )
+                try:
+                    raw = _decode_image_bytes(item)
+                except ValueError as e:
+                    return web.json_response({"error": str(e)}, status=400)
+                if len(raw) > MAX_IMAGE_BYTES:
+                    return web.json_response(
+                        {"error": f"images[{i}] exceeds {MAX_IMAGE_BYTES} bytes"},
+                        status=413,
+                    )
+                digest = EcStore.digest_of(raw)
+                digests.append(digest)
+                if self.store.contains(digest) or digest in batch_seen:
+                    self.cache_hits_total += 1
+                    continue
+                batch_seen.add(digest)
+                try:
+                    img = Image.open(io.BytesIO(raw))
+                    pixels = self.encoder.preprocess(img)
+                except (UnidentifiedImageError, OSError) as e:
+                    return web.json_response(
+                        {"error": f"images[{i}] undecodable: {e}"}, status=400
+                    )
+                to_encode.append((i, pixels))
+
+            span.set("llm_d.encode.cache_hits", len(items) - len(to_encode))
+            # batch through the device in chunks
+            async with self._device_lock:
+                for off in range(0, len(to_encode), self.max_batch):
+                    chunk = to_encode[off : off + self.max_batch]
+                    batch = np.stack([px for _, px in chunk])
+                    embs = await asyncio.to_thread(self.encoder.encode, batch)
+                    for (idx, _), emb in zip(chunk, embs):
+                        self.store.put(digests[idx], emb)
+                        self.encoded_total += 1
+            out = [
+                {
+                    "digest": d,
+                    "tokens": self.encoder.cfg.tokens_per_image,
+                    "shape": [
+                        self.encoder.cfg.tokens_per_image,
+                        self.encoder.cfg.output_size,
+                    ],
+                    "dtype": self.encoder.cfg.dtype,
+                }
+                for d in digests
+            ]
+            return web.json_response({"items": out})
+        except BaseException as e:
+            span.error(str(e) or type(e).__name__)
+            raise
+        finally:
+            self.inflight -= 1
+            span.end()
+
+    async def handle_pull(self, request: web.Request) -> web.Response:
+        emb = self.store.get(request.match_info["digest"])
+        if emb is None:
+            return web.json_response({"error": "unknown or expired digest"}, status=404)
+        return web.Response(
+            body=emb.tobytes(),
+            content_type="application/octet-stream",
+            headers={
+                "x-ec-dtype": str(emb.dtype),
+                "x-ec-shape": ",".join(map(str, emb.shape)),
+            },
+        )
+
+    async def handle_free(self, request: web.Request) -> web.Response:
+        freed = self.store.free(request.match_info["digest"])
+        return web.json_response({"freed": freed})
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "role": "encode"})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        # EPP metrics contract: the encode profile's queue-scorer reads
+        # WaitingQueueSize; report in-flight encode batches there.
+        lines = [
+            "# TYPE vllm:num_requests_waiting gauge",
+            f"vllm:num_requests_waiting {self.inflight}",
+            "# TYPE vllm:num_requests_running gauge",
+            f"vllm:num_requests_running {self.inflight}",
+            "# TYPE vllm:gpu_cache_usage_perc gauge",
+            f"vllm:gpu_cache_usage_perc {min(1.0, len(self.store) / self.store.max_entries):.6f}",
+            "# TYPE llmd:ec_entries gauge",
+            f"llmd:ec_entries {len(self.store)}",
+            "# TYPE llmd:ec_encoded_total counter",
+            f"llmd:ec_encoded_total {self.encoded_total}",
+            "# TYPE llmd:ec_cache_hits_total counter",
+            f"llmd:ec_cache_hits_total {self.cache_hits_total}",
+        ]
+        for k, v in self.store.stats.items():
+            lines.append(f"llmd:ec_store_{k}_total {v}")
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=MAX_IMAGE_BYTES * 4)
+        app.add_routes(
+            [
+                web.post("/v1/encode", self.handle_encode),
+                web.get("/v1/ec/{digest}", self.handle_pull),
+                web.post("/v1/ec/{digest}/free", self.handle_free),
+                web.get("/health", self.handle_health),
+                web.get("/metrics", self.handle_metrics),
+            ]
+        )
+        return app

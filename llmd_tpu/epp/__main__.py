@@ -22,7 +22,7 @@ def main(argv=None) -> None:
     p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
     p.add_argument(
         "--preset", default="default",
-        choices=["default", "pd", "precise", "predicted-latency"],
+        choices=["default", "pd", "epd", "precise", "predicted-latency"],
         help="built-in config preset when --config is not given",
     )
     p.add_argument(
@@ -60,6 +60,7 @@ def main(argv=None) -> None:
 
     from llmd_tpu.epp.config import (
         DEFAULT_CONFIG,
+        EPD_CONFIG,
         PD_CONFIG,
         PRECISE_CONFIG,
         PREDICTED_LATENCY_CONFIG,
@@ -78,7 +79,8 @@ def main(argv=None) -> None:
             config = json.load(f)
     else:
         config = {
-            "default": DEFAULT_CONFIG, "pd": PD_CONFIG, "precise": PRECISE_CONFIG,
+            "default": DEFAULT_CONFIG, "pd": PD_CONFIG, "epd": EPD_CONFIG,
+            "precise": PRECISE_CONFIG,
             "predicted-latency": PREDICTED_LATENCY_CONFIG,
         }[args.preset]
 

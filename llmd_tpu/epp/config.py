@@ -47,6 +47,7 @@ from llmd_tpu.epp.plugins import (
 )
 from llmd_tpu.epp.scheduler import (
     DisaggProfileHandler,
+    EpdProfileHandler,
     ProfileHandler,
     Scheduler,
     SingleProfileHandler,
@@ -155,6 +156,68 @@ PRECISE_CONFIG: dict[str, Any] = {
 }
 
 
+# E/P/D multimodal encode disaggregation (reference
+# guides/multimodal-serving/e-disaggregation/router/
+# e-p-d-disaggregation.values.yaml:13-60): an encode profile picks a
+# dedicated vision-encode worker by queue depth; prefill/decode profiles
+# as in P/D. Requests without media degrade to plain P/D.
+EPD_CONFIG: dict[str, Any] = {
+    "plugins": [
+        {"type": "healthy-filter", "name": "healthy"},
+        {"type": "encode-filter", "name": "encode-f"},
+        {"type": "decode-filter", "name": "decode-f"},
+        {"type": "prefill-filter", "name": "prefill-f"},
+        {"type": "queue-scorer", "name": "queue"},
+        {"type": "kv-cache-utilization-scorer", "name": "kv"},
+        {"type": "prefix-cache-scorer", "name": "prefix"},
+        {"type": "no-hit-lru-scorer", "name": "no-hit-lru"},
+        {"type": "max-score-picker", "name": "picker"},
+    ],
+    "schedulingProfiles": [
+        {
+            "name": "encode",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "encode-f"},
+                {"pluginRef": "queue", "weight": 2.0},
+                {"pluginRef": "picker"},
+            ],
+        },
+        {
+            "name": "decode",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "decode-f"},
+                {"pluginRef": "prefix", "weight": 3.0},
+                {"pluginRef": "queue", "weight": 2.0},
+                {"pluginRef": "kv", "weight": 2.0},
+                {"pluginRef": "no-hit-lru", "weight": 0.5},
+                {"pluginRef": "picker"},
+            ],
+        },
+        {
+            "name": "prefill",
+            "plugins": [
+                {"pluginRef": "healthy"},
+                {"pluginRef": "prefill-f"},
+                {"pluginRef": "prefix", "weight": 3.0},
+                {"pluginRef": "queue", "weight": 2.0},
+                {"pluginRef": "kv", "weight": 2.0},
+                {"pluginRef": "picker"},
+            ],
+        },
+    ],
+    "profileHandler": {
+        "type": "epd",
+        "encodeProfile": "encode",
+        "decodeProfile": "decode",
+        "prefillProfile": "prefill",
+        "thresholdTokens": 256,
+    },
+    "flowControl": {"enabled": True, "maxInflight": 512},
+}
+
+
 # Predicted-latency routing plugin config (reference
 # guides/predicted-latency-routing/router/predicted-latency.values.yaml):
 # the latency scorer dominates, with the SLO headroom filter ahead of it;
@@ -232,7 +295,14 @@ def build_scheduler(config: dict[str, Any]) -> Scheduler:
 
     hspec = config.get("profileHandler", {"type": "single"})
     handler: ProfileHandler
-    if hspec.get("type") == "disagg":
+    if hspec.get("type") == "epd":
+        handler = EpdProfileHandler(
+            encode_profile=hspec.get("encodeProfile", "encode"),
+            decode_profile=hspec.get("decodeProfile", "decode"),
+            prefill_profile=hspec.get("prefillProfile", "prefill"),
+            threshold_tokens=int(hspec.get("thresholdTokens", 256)),
+        )
+    elif hspec.get("type") == "disagg":
         handler = DisaggProfileHandler(
             decode_profile=hspec.get("decodeProfile", "decode"),
             prefill_profile=hspec.get("prefillProfile", "prefill"),

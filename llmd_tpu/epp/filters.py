@@ -7,6 +7,7 @@ from llmd_tpu.epp.types import (
     KV_CACHE_USAGE,
     ROLE_BOTH,
     ROLE_DECODE,
+    ROLE_ENCODE,
     ROLE_PREFILL,
     Endpoint,
     LLMRequest,
@@ -42,6 +43,15 @@ class DecodeFilter(Filter):
 
     def filter(self, req, pods):
         return [p for p in pods if p.role in (ROLE_DECODE, ROLE_BOTH)]
+
+
+@register("encode-filter")
+class EncodeFilter(Filter):
+    """Dedicated vision-encode workers (E/P/D multimodal disaggregation,
+    reference e-p-d-disaggregation.values.yaml encode profile)."""
+
+    def filter(self, req, pods):
+        return [p for p in pods if p.role == ROLE_ENCODE]
 
 
 @register("healthy-filter")

@@ -9,6 +9,7 @@ reject up front. Header contract: docs/api-reference/epp-http-headers.md.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import uuid
 from typing import Any
@@ -61,21 +62,71 @@ def _common_kwargs(h: dict[str, str]) -> dict[str, Any]:
     }
 
 
-def _messages_text(msgs: list) -> str:
+# Visual-token estimation defaults (reference token-producer `estimate`:
+# e-p-d-disaggregation.values.yaml:31-40 — defaultResolution 1280x720,
+# dynamic factor 1024 pixels/token).
+MM_DEFAULT_WIDTH = 1280
+MM_DEFAULT_HEIGHT = 720
+MM_PIXELS_PER_TOKEN = 1024
+MM_TOKEN_CAP = 16384
+
+
+def estimate_mm_tokens(item: dict) -> int:
+    w = int(item.get("width") or MM_DEFAULT_WIDTH)
+    h = int(item.get("height") or MM_DEFAULT_HEIGHT)
+    return max(1, min(MM_TOKEN_CAP, (w * h) // MM_PIXELS_PER_TOKEN))
+
+
+def _mm_ref(url: str) -> str:
+    """Stable content reference for an image URL / data URL. Folded into
+    the prompt text so prefix hashing distinguishes different images
+    (the reference's multimodal key folding, kv-indexer.md:145-151)."""
+    return hashlib.sha256(url.encode()).hexdigest()[:24]
+
+
+def _messages_text(msgs: list, mm_items: list[dict] | None = None) -> str:
     parts = []
     for m in msgs:
         if not isinstance(m, dict):
             continue
         c = m.get("content") or ""
         if isinstance(c, list):
-            c = "".join(p.get("text", "") for p in c if isinstance(p, dict))
+            buf = []
+            for p in c:
+                if not isinstance(p, dict):
+                    continue
+                if p.get("type") == "image_url" or "image_url" in p:
+                    url = (p.get("image_url") or {})
+                    url = url.get("url", "") if isinstance(url, dict) else str(url)
+                    ref = _mm_ref(url)
+                    buf.append(f"<|image:{ref}|>")
+                    if mm_items is not None:
+                        item = {"ref": ref, "url": url}
+                        for key in ("width", "height"):
+                            if isinstance(p.get(key), int):
+                                item[key] = p[key]
+                        mm_items.append(item)
+                else:
+                    buf.append(p.get("text", ""))
+            c = "".join(buf)
         parts.append(f"<|{m.get('role', 'user')}|>{c}")
     return "".join(parts)
 
 
-def _prompt_from_body(path: str, body: dict) -> tuple[str, list[int] | None]:
-    """Extract the cache-relevant prompt text (and token ids if given)."""
-    if path.endswith("/chat/completions") or path.endswith("/conversations"):
+def _prompt_from_body(
+    path: str, body: dict, mm_items: list[dict] | None = None
+) -> tuple[str, list[int] | None]:
+    """Extract the cache-relevant prompt text (and token ids if given).
+
+    mm_items are only collected for /chat/completions — the one generate
+    surface the sidecar's encode phase can ship — so the scheduler never
+    reserves an encode worker for a request that cannot reach it. Other
+    message-shaped paths still fold image markers into the prompt text
+    (prefix affinity) without scheduling an encode leg.
+    """
+    if path.endswith("/chat/completions"):
+        return _messages_text(body.get("messages") or [], mm_items), None
+    if path.endswith("/conversations"):
         return _messages_text(body.get("messages") or []), None
     prompt = body.get("prompt") or body.get("input") or ""
     if isinstance(prompt, list) and prompt and isinstance(prompt[0], dict):
@@ -102,7 +153,8 @@ def openai_parse(
         raise ParseError(f"invalid JSON body: {e}") from e
     if not isinstance(body, dict):
         raise ParseError("request body must be a JSON object")
-    prompt_text, prompt_ids = _prompt_from_body(path, body)
+    mm_items: list[dict] = []
+    prompt_text, prompt_ids = _prompt_from_body(path, body, mm_items)
     h = {k.lower(): v for k, v in headers.items()}
     try:
         priority = int(body.get("priority", 0) or 0)
@@ -116,6 +168,8 @@ def openai_parse(
         path=path,
         streaming=bool(body.get("stream", False)),
         priority=priority,
+        mm_items=mm_items,
+        mm_token_estimate=sum(estimate_mm_tokens(i) for i in mm_items),
         **_common_kwargs(h),
     )
 

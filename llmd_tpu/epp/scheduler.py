@@ -109,6 +109,42 @@ class DisaggProfileHandler(ProfileHandler):
         )
 
 
+class EpdProfileHandler(DisaggProfileHandler):
+    """E/P/D multimodal disaggregation (multimodal-serving/README.md:33-50
+    + e-p-d-disaggregation.values.yaml).
+
+    Adds an encode profile ahead of the P/D pair. The decider is the
+    reference's `always-disagg-multimodal-decider`: any request carrying
+    media always gets a dedicated encode worker (when one exists); the
+    pick rides the x-encoder-host-port header so the sidecar can ship
+    images to the E tier and forward embedding handles downstream.
+    Text-only requests degrade to plain P/D behavior.
+    """
+
+    def __init__(
+        self,
+        encode_profile: str = "encode",
+        decode_profile: str = "decode",
+        prefill_profile: str = "prefill",
+        threshold_tokens: int = 256,
+    ) -> None:
+        super().__init__(decode_profile, prefill_profile, threshold_tokens)
+        self.encode_profile = encode_profile
+
+    def profiles_for(self, req, profiles):
+        names = list(super().profiles_for(req, profiles))
+        if req.mm_items:  # always-disagg-multimodal-decider
+            names.insert(0, self.encode_profile)
+        return names
+
+    def assemble(self, req, results):
+        result = super().assemble(req, results)
+        enc = results.get(self.encode_profile)
+        if req.mm_items and enc is not None and enc.endpoint is not None:
+            result.encode = enc.endpoint
+        return result
+
+
 class Scheduler:
     """Runs the configured profiles over the current pod set."""
 
@@ -133,7 +169,9 @@ class Scheduler:
         # notify state-updating scorers on the winning profile(s)
         for name, pr in results.items():
             if pr.endpoint is not None and (
-                pr.endpoint is result.primary or pr.endpoint is result.prefill
+                pr.endpoint is result.primary
+                or pr.endpoint is result.prefill
+                or pr.endpoint is result.encode
             ):
                 self.profiles[name].notify_routed(req, pr.endpoint)
         return result

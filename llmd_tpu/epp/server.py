@@ -32,6 +32,7 @@ from llmd_tpu.epp.scheduler import NoEndpointsError, Scheduler
 from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.epp.types import (
     HDR_DROP_REASON,
+    HDR_ENCODER,
     HDR_PREFILLER,
     KV_CACHE_USAGE,
     WAITING_QUEUE_SIZE,
@@ -288,6 +289,10 @@ class Router:
                             round(pres.scores.get(pres.endpoint.address, 0.0), 4),
                         )
             extra_headers = {}
+            if result.encode is not None:
+                extra_headers[HDR_ENCODER] = result.encode.address
+                if span is not None:
+                    span.set("llm_d.decision.encode", result.encode.address)
             prefill_pod = result.prefill
             if prefill_pod is not None:
                 extra_headers[HDR_PREFILLER] = prefill_pod.address

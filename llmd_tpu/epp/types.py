@@ -21,11 +21,13 @@ BLOCK_SIZE = "BlockSize"
 NUM_BLOCKS = "NumBlocks"
 TOKENS_IN_FLIGHT = "TokensInFlight"
 
-# Pod role labels (reference disaggregation/README.md:95-99).
+# Pod role labels (reference disaggregation/README.md:95-99; encode tier
+# from multimodal-serving/e-disaggregation).
 ROLE_LABEL = "llm-d.ai/role"
 ROLE_PREFILL = "prefill"
 ROLE_DECODE = "decode"
 ROLE_BOTH = "prefill-decode"
+ROLE_ENCODE = "encode"
 
 # Request headers (reference docs/api-reference/epp-http-headers.md:10-25).
 HDR_OBJECTIVE = "x-llm-d-objective"
@@ -33,6 +35,7 @@ HDR_FAIRNESS_ID = "x-llm-d-fairness-id"
 HDR_TTFT_SLO = "x-llm-d-slo-ttft-ms"
 HDR_TPOT_SLO = "x-llm-d-slo-tpot-ms"
 HDR_PREFILLER = "x-prefiller-host-port"
+HDR_ENCODER = "x-encoder-host-port"
 HDR_DROP_REASON = "x-llm-d-request-dropped-reason"
 
 
@@ -87,6 +90,13 @@ class LLMRequest:
     tpot_slo_ms: float | None = None
     # predicted output length (latency predictor / heuristics)
     predicted_output_tokens: int | None = None
+    # Multimodal items (images) found in the request: each entry carries a
+    # content `ref` (digest of the inline data/URL) and optional
+    # width/height for token estimation (reference token-producer
+    # `estimate`, e-p-d-disaggregation.values.yaml:31-40).
+    mm_items: list[dict] = dataclasses.field(default_factory=list)
+    # Visual-token estimate summed over mm_items (set by the parser).
+    mm_token_estimate: int = 0
     # Scratch space for DataProducers (prefix hashes, predictions, ...).
     scratch: dict[str, Any] = dataclasses.field(default_factory=dict)
 
@@ -97,10 +107,10 @@ class LLMRequest:
     @property
     def approx_prompt_tokens(self) -> int:
         if self.prompt_token_ids is not None:
-            return len(self.prompt_token_ids)
+            return len(self.prompt_token_ids) + self.mm_token_estimate
         # Char-ratio approximation (reference
         # prefix-cache-aware-routing.md:18-21): ~4 chars/token.
-        return max(1, len(self.prompt_text) // 4)
+        return max(1, len(self.prompt_text) // 4) + self.mm_token_estimate
 
 
 @dataclasses.dataclass
@@ -123,4 +133,7 @@ class SchedulingResult:
 
     primary: Endpoint
     prefill: Endpoint | None = None
+    # Encode worker for E/P/D multimodal disaggregation, advertised via
+    # x-encoder-host-port (multimodal-serving/README.md:41-46).
+    encode: Endpoint | None = None
     profiles: dict[str, ProfileResult] = dataclasses.field(default_factory=dict)

@@ -16,6 +16,8 @@ import asyncio
 import json
 import logging
 import time
+
+import aiohttp
 from typing import Any
 
 import pydantic
@@ -33,6 +35,50 @@ ENGINE_KEY = web.AppKey("llmd_engine", AsyncEngine)
 TOK_KEY = web.AppKey("llmd_tokenizer", object)
 MODEL_KEY = web.AppKey("llmd_model_name", str)
 MAXLEN_KEY = web.AppKey("llmd_max_model_len", int)
+MM_SESSION_KEY = web.AppKey("llmd_mm_session", object)
+
+
+async def _resolve_ec_parts(request: web.Request, messages: list) -> int:
+    """E-disaggregation consumer side: pull EC embedding handles placed by
+    the sidecar (parts of type `ec_embedding`), free-notify the encode
+    worker, and substitute a digest-stable placeholder marker.
+
+    The pull + free exercises the full EC-connector lease lifecycle
+    (multimodal-serving/README.md:44-46). The pulled embeddings are the
+    injection point for a trained VLM checkpoint (soft tokens at the
+    placeholder positions); with random-init weights the engine consumes
+    the stable `<|image:digest|>` marker, which keeps prefix caching
+    content-correct across identical images.
+    """
+    pulled = 0
+    session = request.app.get(MM_SESSION_KEY)
+    for m in messages:
+        content = m.get("content") if isinstance(m, dict) else None
+        if not isinstance(content, list):
+            continue
+        for part in content:
+            if not (isinstance(part, dict) and part.get("type") == "ec_embedding"):
+                continue
+            ec = part.get("ec_embedding") or {}
+            host, digest = ec.get("host"), ec.get("digest", "")
+            if session is not None and host and digest:
+                try:
+                    async with session.get(
+                        f"http://{host}/v1/ec/{digest}"
+                    ) as resp:
+                        if resp.status == 200:
+                            await resp.read()
+                            pulled += 1
+                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    log.warning("EC pull %s/%s failed: %s", host, digest, e)
+            # No free-notify: EC entries are content-addressed and may be
+            # shared by concurrent requests and by the P and D engines of
+            # one request; the producer's lease (+ LRU) reclaims them.
+            # POST /v1/ec/{digest}/free remains for explicit invalidation.
+            part.clear()
+            part["type"] = "text"
+            part["text"] = f"<|image:{digest}|>"
+    return pulled
 
 
 class Detokenizer:
@@ -99,8 +145,11 @@ def _tokenize_prompt(tokenizer, prompt) -> list[int]:
     raise ValueError("invalid prompt type")
 
 
-def _chat_prompt_ids(tokenizer, messages: list[P.ChatMessage]) -> list[int]:
-    msgs = [m.model_dump() for m in messages]
+def _chat_prompt_ids(tokenizer, messages: list) -> list[int]:
+    """messages: ChatMessage models or plain dicts."""
+    msgs = [
+        m.model_dump() if isinstance(m, P.ChatMessage) else m for m in messages
+    ]
     ids = tokenizer.apply_chat_template(msgs, add_generation_prompt=True, tokenize=True)
     return list(ids)
 
@@ -288,7 +337,9 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     try:
         if chat:
             req = P.ChatCompletionRequest(**body)
-            prompt_ids = _chat_prompt_ids(tokenizer, req.messages)
+            msgs = [m.model_dump() for m in req.messages]
+            await _resolve_ec_parts(request, msgs)
+            prompt_ids = _chat_prompt_ids(tokenizer, msgs)
             req_max = req.max_completion_tokens or req.max_tokens
         else:
             req = P.CompletionRequest(**body)
@@ -536,7 +587,12 @@ def build_app(
 
     async def _start_engine(app: web.Application):
         engine.start(asyncio.get_event_loop())
+        # EC-connector pulls (E-disaggregation consumer side).
+        app[MM_SESSION_KEY] = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=60, sock_connect=5)
+        )
         yield
+        await app[MM_SESSION_KEY].close()
         engine.stop()
 
     app.cleanup_ctx.append(_start_engine)

@@ -33,7 +33,7 @@ import logging
 import aiohttp
 from aiohttp import web
 
-from llmd_tpu.epp.types import HDR_PREFILLER
+from llmd_tpu.epp.types import HDR_ENCODER, HDR_PREFILLER
 from llmd_tpu.kvtransfer import shipper as shipper_mod
 from llmd_tpu.obs.tracing import get_tracer
 
@@ -61,7 +61,8 @@ class SidecarConfig:
 def _fwd_headers(headers) -> dict[str, str]:
     return {
         k: v for k, v in headers.items()
-        if k.lower() not in HOP_HEADERS and k.lower() != HDR_PREFILLER
+        if k.lower() not in HOP_HEADERS
+        and k.lower() not in (HDR_PREFILLER, HDR_ENCODER)
     }
 
 
@@ -112,13 +113,100 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
     async def handle(request: web.Request) -> web.StreamResponse:
         session: aiohttp.ClientSession = request.app["session"]
         prefiller = request.headers.get(HDR_PREFILLER)
+        encoder = request.headers.get(HDR_ENCODER)
         if (
             request.method == "POST"
             and request.path in GENERATE_PATHS
-            and prefiller
+            and (prefiller or encoder)
         ):
-            return await two_phase(request, session, prefiller)
+            try:
+                body = json.loads(await request.read())
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return web.json_response(
+                    {"error": {"message": "invalid JSON body",
+                               "type": "invalid_request_error"}},
+                    status=400,
+                )
+            if encoder and isinstance(body, dict):
+                body = await run_encode(session, encoder, body, request)
+            if prefiller:
+                return await two_phase(request, session, prefiller, body)
+            # E-only (E/PD topology without a separate prefiller): forward
+            # the embedding-substituted body to the local engine.
+            async with session.post(
+                local_base + request.path,
+                headers=_fwd_headers(request.headers),
+                json=body,
+            ) as upstream:
+                return await _relay(request, upstream)
         return await passthrough(request, session)
+
+    async def run_encode(
+        session: aiohttp.ClientSession,
+        encoder: str,
+        body: dict,
+        request: web.Request,
+    ) -> dict:
+        """Phase 0 (E tier): ship inline images to the encode worker and
+        substitute EC embedding handles (multimodal-serving/README.md:41-46
+        steps 2-4). Failure falls back to local processing: the original
+        image parts are forwarded untouched."""
+        images: list[dict] = []
+        parts: list[dict] = []
+        for m in body.get("messages") or []:
+            content = m.get("content") if isinstance(m, dict) else None
+            if not isinstance(content, list):
+                continue
+            for part in content:
+                if isinstance(part, dict) and (
+                    part.get("type") == "image_url" or "image_url" in part
+                ):
+                    url = part.get("image_url")
+                    url = url.get("url", "") if isinstance(url, dict) else str(url)
+                    # Encode workers only accept inline payloads; leave
+                    # remote URLs for the engine so one of them cannot
+                    # 400 the whole batch.
+                    if not url.startswith("data:"):
+                        continue
+                    images.append({"url": url})
+                    parts.append(part)
+        if not images:
+            return body
+        span = get_tracer().start_span(
+            "sidecar.encode",
+            traceparent=request.headers.get("traceparent"),
+        )
+        span.set("llm_d.encode.worker", encoder)
+        span.set("llm_d.encode.num_images", len(images))
+        try:
+            async with session.post(
+                f"http://{encoder}/v1/encode", json={"images": images},
+                timeout=aiohttp.ClientTimeout(total=cfg.prefill_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    log.warning(
+                        "encode worker %s returned %d -- local fallback",
+                        encoder, resp.status,
+                    )
+                    span.error(f"encode status {resp.status}")
+                    return body
+                items = (await resp.json()).get("items", [])
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            log.warning("encode worker %s unreachable (%s) -- local fallback",
+                        encoder, e)
+            span.error(str(e))
+            return body
+        finally:
+            span.end()
+        if len(items) != len(parts):
+            log.warning("encode worker returned %d items for %d images",
+                        len(items), len(parts))
+            return body
+        for part, item in zip(parts, items):
+            part.clear()
+            part["type"] = "ec_embedding"
+            part["ec_embedding"] = {"host": encoder, **item}
+        return body
 
     async def passthrough(
         request: web.Request, session: aiohttp.ClientSession
@@ -133,16 +221,11 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
             return await _relay(request, upstream)
 
     async def two_phase(
-        request: web.Request, session: aiohttp.ClientSession, prefiller: str
+        request: web.Request,
+        session: aiohttp.ClientSession,
+        prefiller: str,
+        body: dict,
     ) -> web.StreamResponse:
-        try:
-            body = json.loads(await request.read())
-        except (json.JSONDecodeError, UnicodeDecodeError):
-            return web.json_response(
-                {"error": {"message": "invalid JSON body", "type": "invalid_request_error"}},
-                status=400,
-            )
-
         # P/D decision intelligence spans (reference
         # proposals/distributed-tracing.md): one child span per phase so a
         # trace shows prefill time vs KV-pull+decode time per request.

@@ -1,0 +1,289 @@
+"""E/P/D multimodal encode disaggregation: encoder, EC store, routing, E2E.
+
+Reference behavior: guides/multimodal-serving (encode workers, EC
+connector pull, always-disagg-multimodal-decider, encode-filter, token
+estimation) per SURVEY.md §2.4.
+"""
+
+import base64
+import io
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.encode.ec_store import EcStore
+from llmd_tpu.encode.vision import VisionEncoder, VisionEncoderConfig
+from llmd_tpu.encode.worker import EncodeWorker
+from llmd_tpu.epp.config import EPD_CONFIG, build_scheduler
+from llmd_tpu.epp.handler import estimate_mm_tokens, openai_parse
+from llmd_tpu.epp.types import (
+    ROLE_DECODE,
+    ROLE_ENCODE,
+    ROLE_LABEL,
+    ROLE_PREFILL,
+    Endpoint,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+TINY_CFG = VisionEncoderConfig(
+    image_size=28, patch_size=7, hidden_size=32, num_layers=2,
+    num_heads=4, output_size=64, spatial_merge=2,
+)
+
+
+def png_bytes(color=(255, 0, 0), size=(32, 24)) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def data_url(raw: bytes) -> str:
+    return "data:image/png;base64," + base64.b64encode(raw).decode()
+
+
+# ---------------------------------------------------------------- encoder
+
+
+def test_vision_encoder_shapes_and_determinism():
+    enc = VisionEncoder(TINY_CFG, seed=1)
+    # grid 4x4=16 patches, merge 2 -> 4 output tokens
+    assert TINY_CFG.tokens_per_image == 4
+    px = np.random.default_rng(0).random((2, 28, 28, 3), dtype=np.float32)
+    out1 = enc.encode(px)
+    out2 = enc.encode(px)
+    assert out1.shape == (2, 4, 64)
+    np.testing.assert_allclose(out1, out2)
+    # different images -> different embeddings
+    assert not np.allclose(out1[0], out1[1])
+
+
+def test_estimate_tokens_resolution_scaling():
+    assert VisionEncoder.estimate_tokens(1280, 720, factor=1024) == 900
+    assert VisionEncoder.estimate_tokens(1, 1) == 1
+    assert VisionEncoder.estimate_tokens(100000, 100000) == 16384  # capped
+
+
+# ---------------------------------------------------------------- EC store
+
+
+def test_ec_store_lifecycle():
+    store = EcStore(lease_s=60.0)
+    emb = np.arange(12, dtype=np.float32).reshape(3, 4)
+    store.put("d1", emb)
+    got = store.get("d1")
+    np.testing.assert_array_equal(got, emb)
+    assert store.free("d1") is True
+    assert store.get("d1") is None
+    assert store.stats["freed"] == 1
+
+
+def test_ec_store_lease_expiry(monkeypatch):
+    store = EcStore(lease_s=0.0)
+    store.put("d", np.zeros(2, np.float32))
+    import time
+
+    time.sleep(0.01)
+    assert store.get("d") is None
+    assert store.stats["expired"] >= 1
+
+
+# ---------------------------------------------------------------- worker
+
+
+async def test_encode_worker_http_roundtrip():
+    worker = EncodeWorker(TINY_CFG, max_batch=2)
+    client = TestClient(TestServer(worker.build_app()))
+    await client.start_server()
+    try:
+        raw = png_bytes()
+        resp = await client.post(
+            "/v1/encode",
+            json={"images": [{"data": base64.b64encode(raw).decode()},
+                             {"url": data_url(png_bytes(color=(0, 255, 0)))}]},
+        )
+        assert resp.status == 200
+        items = (await resp.json())["items"]
+        assert len(items) == 2 and items[0]["tokens"] == 4
+        # pull over the EC plane
+        pull = await client.get(f"/v1/ec/{items[0]['digest']}")
+        assert pull.status == 200
+        shape = tuple(int(x) for x in pull.headers["x-ec-shape"].split(","))
+        data = np.frombuffer(
+            await pull.read(), dtype=pull.headers["x-ec-dtype"]
+        ).reshape(shape)
+        assert data.shape == (4, 64)
+        # same image again: cache hit, no re-encode
+        before = worker.encoded_total
+        resp2 = await client.post(
+            "/v1/encode",
+            json={"images": [{"data": base64.b64encode(raw).decode()}]},
+        )
+        assert resp2.status == 200
+        assert worker.encoded_total == before
+        assert worker.cache_hits_total >= 1
+        # free-notify
+        free = await client.post(f"/v1/ec/{items[0]['digest']}/free")
+        assert (await free.json())["freed"] is True
+        # metrics surface
+        m = await (await client.get("/metrics")).text()
+        assert "llmd:ec_encoded_total" in m
+    finally:
+        await client.close()
+
+
+async def test_encode_worker_rejects_remote_urls_and_bad_data():
+    worker = EncodeWorker(TINY_CFG)
+    client = TestClient(TestServer(worker.build_app()))
+    await client.start_server()
+    try:
+        r = await client.post(
+            "/v1/encode", json={"images": [{"url": "http://example.com/x.png"}]}
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/encode", json={"images": [{"data": "!!!notbase64"}]}
+        )
+        assert r.status == 400
+        r = await client.post("/v1/encode", json={"images": []})
+        assert r.status == 400
+    finally:
+        await client.close()
+
+
+# ---------------------------------------------------------------- EPP
+
+
+def _mm_request_body():
+    return {
+        "model": "m",
+        "messages": [
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "describe"},
+                    {"type": "image_url",
+                     "image_url": {"url": "data:image/png;base64,AAAA"},
+                     "width": 2048, "height": 1024},
+                ],
+            }
+        ],
+    }
+
+
+def test_openai_parse_extracts_mm_items():
+    import json
+
+    req = openai_parse(
+        "/v1/chat/completions", {}, json.dumps(_mm_request_body()).encode()
+    )
+    assert len(req.mm_items) == 1
+    item = req.mm_items[0]
+    assert item["width"] == 2048
+    # token estimate: 2048*1024/1024 = 2048
+    assert estimate_mm_tokens(item) == 2048
+    assert req.mm_token_estimate == 2048
+    # digest folded into prompt text for prefix affinity
+    assert f"<|image:{item['ref']}|>" in req.prompt_text
+    # mm tokens included in load accounting
+    assert req.approx_prompt_tokens > 2048
+
+
+def test_epd_scheduler_routes_encode_prefill_decode():
+    import json
+
+    sched = build_scheduler(EPD_CONFIG)
+    pods = [
+        Endpoint(address="e:1", labels={ROLE_LABEL: ROLE_ENCODE}),
+        Endpoint(address="p:1", labels={ROLE_LABEL: ROLE_PREFILL}),
+        Endpoint(address="d:1", labels={ROLE_LABEL: ROLE_DECODE}),
+    ]
+    req = openai_parse(
+        "/v1/chat/completions", {}, json.dumps(_mm_request_body()).encode()
+    )
+    result = sched.schedule(req, pods)
+    assert result.primary.address == "d:1"
+    assert result.encode is not None and result.encode.address == "e:1"
+    assert result.prefill is not None and result.prefill.address == "p:1"
+
+    # text-only request: no encode leg
+    text_req = openai_parse(
+        "/v1/chat/completions", {},
+        json.dumps({"model": "m", "messages": [
+            {"role": "user", "content": "x" * 4096}]}).encode(),
+    )
+    r2 = sched.schedule(text_req, pods)
+    assert r2.encode is None and r2.primary.address == "d:1"
+
+
+# ---------------------------------------------------------------- E2E
+
+
+async def test_epd_e2e_through_sidecar():
+    """Sidecar ships images to the E worker, engine pulls + frees over EC."""
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+    from llmd_tpu.sidecar.proxy import SidecarConfig, build_sidecar_app
+
+    worker = EncodeWorker(TINY_CFG)
+    enc_server = TestServer(worker.build_app())
+    await enc_server.start_server()
+
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=256),
+        cache=CacheConfig(page_size=4, num_blocks=256, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=128),
+    )
+    engine_app = build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 256)
+    eng_server = TestServer(engine_app)
+    await eng_server.start_server()
+
+    side_cfg = SidecarConfig(vllm_port=eng_server.port)
+    sc = TestClient(TestServer(build_sidecar_app(side_cfg)))
+    await sc.start_server()
+    try:
+        body = {
+            "model": "tiny",
+            "max_tokens": 4,
+            "messages": [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": "what is this?"},
+                        {"type": "image_url",
+                         "image_url": {"url": data_url(png_bytes())}},
+                    ],
+                }
+            ],
+        }
+        resp = await sc.post(
+            "/v1/chat/completions",
+            json=body,
+            headers={"x-encoder-host-port": f"{enc_server.host}:{enc_server.port}"},
+        )
+        assert resp.status == 200, await resp.text()
+        out = await resp.json()
+        assert out["choices"][0]["message"]["content"] is not None
+        # the EC plane saw a put and a pull; no consumer free (entries are
+        # content-addressed and shared — the lease reclaims them)
+        assert worker.store.stats["puts"] == 1
+        assert worker.store.stats["hits"] >= 1
+        assert worker.store.stats["freed"] == 0
+    finally:
+        await sc.close()
+        await eng_server.close()
+        await enc_server.close()
