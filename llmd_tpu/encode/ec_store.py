@@ -76,10 +76,19 @@ class EcStore:
                 return True
         return False
 
-    def contains(self, digest: str) -> bool:
+    def contains(self, digest: str, extend_lease: bool = True) -> bool:
+        """Live-entry check. Extends the lease by default: a contains-hit
+        means a new consumer was just handed this digest, and it must
+        survive until that consumer pulls."""
         with self._lock:
             ent = self._entries.get(digest)
-            return ent is not None and ent[0] >= time.monotonic()
+            if ent is None or ent[0] < time.monotonic():
+                return False
+            if extend_lease:
+                self._entries[digest] = (
+                    time.monotonic() + self.lease_s, ent[1], ent[2], ent[3]
+                )
+            return True
 
     def _gc_locked(self) -> None:
         now = time.monotonic()

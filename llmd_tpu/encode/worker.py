@@ -121,13 +121,22 @@ class EncodeWorker:
                 to_encode.append((i, pixels))
 
             span.set("llm_d.encode.cache_hits", len(items) - len(to_encode))
-            # batch through the device in chunks
+            # Batch through the device in chunks PADDED to max_batch: XLA
+            # compiles one program per leading dimension, so a ragged final
+            # chunk would trigger a multi-second recompile while holding
+            # the device lock.
             async with self._device_lock:
                 for off in range(0, len(to_encode), self.max_batch):
                     chunk = to_encode[off : off + self.max_batch]
                     batch = np.stack([px for _, px in chunk])
+                    if len(chunk) < self.max_batch:
+                        pad = np.zeros(
+                            (self.max_batch - len(chunk),) + batch.shape[1:],
+                            batch.dtype,
+                        )
+                        batch = np.concatenate([batch, pad])
                     embs = await asyncio.to_thread(self.encoder.encode, batch)
-                    for (idx, _), emb in zip(chunk, embs):
+                    for (idx, _), emb in zip(chunk, embs[: len(chunk)]):
                         self.store.put(digests[idx], emb)
                         self.encoded_total += 1
             out = [

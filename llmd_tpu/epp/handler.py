@@ -100,7 +100,11 @@ def _messages_text(msgs: list, mm_items: list[dict] | None = None) -> str:
                     url = url.get("url", "") if isinstance(url, dict) else str(url)
                     ref = _mm_ref(url)
                     buf.append(f"<|image:{ref}|>")
-                    if mm_items is not None:
+                    # Only inline (data:) images count as schedulable mm
+                    # items: the encode tier cannot fetch remote URLs, so
+                    # reserving an encode worker for one wastes the slot.
+                    # Remote URLs still fold a marker for prefix affinity.
+                    if mm_items is not None and url.startswith("data:"):
                         item = {"ref": ref, "url": url}
                         for key in ("width", "height"):
                             if isinstance(p.get(key), int):

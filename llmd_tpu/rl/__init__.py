@@ -1,0 +1,16 @@
+"""RL rollout integration (the reference's verl integration).
+
+Reference: guides/rl/verl-integration.md:9-36 — replace the RL
+framework's least-requests rollout routing with this framework's
+scheduler engine, reused out-of-cluster: an `InferenceAgentLoopManager`
+routes every rollout request through the Filter/Score/Pick pipeline,
+and an `InflightStore` tracks per-worker load in real time to augment
+the slower polled metrics. Weight rollouts invalidate prefix-cache
+affinity (the reference's AllBlocksCleared on weight sync,
+kv-indexer.md:63).
+"""
+
+from llmd_tpu.rl.inflight import InflightStore
+from llmd_tpu.rl.agent_loop import InferenceAgentLoopManager, RolloutResult
+
+__all__ = ["InflightStore", "InferenceAgentLoopManager", "RolloutResult"]

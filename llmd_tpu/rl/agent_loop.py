@@ -1,0 +1,224 @@
+"""InferenceAgentLoopManager: scheduler-engine routing for RL rollouts.
+
+The reference's `PyInferenceAgentLoopManager` (verl-integration.md:9-36)
+replaces verl's least-requests load balancer: every rollout generation
+request runs through the production Filter/Score/Pick pipeline against
+the current worker set, with InflightStore supplying real-time load.
+This module is framework-agnostic: an RL trainer hands it worker
+addresses and calls `generate()` (or `acquire`/`release` for engines
+that stream through their own client); verl's AgentLoopManager hook
+would wrap these calls.
+
+Weight-sync handling: `notify_weights_updated()` clears prefix-cache
+affinity state, the analogue of the engines' `AllBlocksCleared` KV
+event on RL weight rollout (reference kv-indexer.md:63) — stale
+affinity would otherwise route for caches that no longer exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+import uuid
+
+import aiohttp
+
+from llmd_tpu.epp.config import DEFAULT_CONFIG, build_scheduler
+from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+from llmd_tpu.epp.scheduler import NoEndpointsError
+from llmd_tpu.epp.types import Endpoint, LLMRequest
+from llmd_tpu.rl.inflight import InflightStore
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RolloutResult:
+    request_id: str
+    worker: str
+    token_ids: list[int]
+    text: str
+    finish_reason: str | None
+    latency_s: float
+
+
+class InferenceAgentLoopManager:
+    """Routes rollout requests through the scheduler engine.
+
+    config: an EndpointPickerConfig dict (defaults to the
+    optimized-baseline plugin set). Workers register via `add_worker`
+    (address of an OpenAI-compatible engine).
+    """
+
+    def __init__(
+        self,
+        config: dict | None = None,
+        scrape_interval_s: float = 2.0,
+        request_timeout_s: float = 600.0,
+    ) -> None:
+        self.store = EndpointStore()
+        self.scheduler = build_scheduler(config or DEFAULT_CONFIG)
+        self.inflight = InflightStore()
+        self.collector = MetricsCollector(self.store, interval_s=scrape_interval_s)
+        self.request_timeout_s = request_timeout_s
+        self._session: aiohttp.ClientSession | None = None
+        self._started = False
+        self.weight_epoch = 0
+
+    # ------------------------------------------------------------ workers
+
+    def add_worker(self, address: str, labels: dict | None = None) -> None:
+        self.store.upsert(Endpoint(address=address, labels=labels or {}))
+
+    def remove_worker(self, address: str) -> None:
+        self.store.remove(address)
+        self.inflight.drop_worker(address)
+        self.scheduler.notify_endpoint_removed(address)
+
+    def workers(self) -> list[str]:
+        return [p.address for p in self.store.list()]
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=self.request_timeout_s, sock_connect=10
+            )
+        )
+        await self.collector.scrape_once()
+        self.collector.start()
+        self._started = True
+
+    async def close(self) -> None:
+        if not self._started:
+            return
+        await self.collector.stop()
+        if self._session is not None:
+            await self._session.close()
+        self._started = False
+
+    # ------------------------------------------------------------ routing
+
+    def _request_for(self, prompt, prompt_token_ids, request_id) -> LLMRequest:
+        return LLMRequest(
+            request_id=request_id,
+            prompt_text=prompt or "",
+            prompt_token_ids=prompt_token_ids,
+            path="/v1/completions",
+        )
+
+    def acquire_server(
+        self,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        request_id: str | None = None,
+    ) -> tuple[str, str]:
+        """Pick a worker for one rollout (the verl `_acquire_server`
+        analogue). Returns (worker_address, request_id); the caller MUST
+        pair it with `release_server` when the rollout finishes."""
+        rid = request_id or f"rollout-{uuid.uuid4().hex}"
+        req = self._request_for(prompt, prompt_token_ids, rid)
+        pods = self.store.list()
+        # Real-time inflight view: overlay onto endpoint state so scoring
+        # sees the rollout burst, not the last metrics poll.
+        for p in pods:
+            p.inflight = self.inflight.requests(p.address)
+            p.inflight_tokens = self.inflight.tokens(p.address)
+        result = self.scheduler.schedule(req, pods)
+        addr = result.primary.address
+        self.inflight.begin(addr, rid, req.approx_prompt_tokens)
+        return addr, rid
+
+    def release_server(self, address: str, request_id: str) -> None:
+        self.inflight.end(address, request_id)
+
+    # ------------------------------------------------------------ rollout
+
+    async def generate(
+        self,
+        prompt: str | None = None,
+        prompt_token_ids: list[int] | None = None,
+        sampling_params: dict | None = None,
+    ) -> RolloutResult:
+        """One rollout generation, scheduler-routed. Token-in/token-out
+        when `prompt_token_ids` is given (uses the engine's gRPC-transcoded
+        Generate surface); text completion otherwise."""
+        if not self._started:
+            await self.start()
+        sp = dict(sampling_params or {})
+        addr, rid = self.acquire_server(prompt, prompt_token_ids)
+        t0 = time.monotonic()
+        try:
+            if prompt_token_ids is not None:
+                payload = {
+                    "prompt_token_ids": prompt_token_ids,
+                    "sampling_params": sp,
+                }
+                url = f"http://{addr}/vllm.Generation/Generate"
+            else:
+                payload = {"prompt": prompt, **sp}
+                url = f"http://{addr}/v1/completions"
+            async with self._session.post(
+                url, json=payload, headers={"x-request-id": rid}
+            ) as resp:
+                data = await resp.json()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"worker {addr} returned {resp.status}: "
+                        f"{str(data)[:200]}"
+                    )
+        finally:
+            self.release_server(addr, rid)
+        if prompt_token_ids is not None:
+            return RolloutResult(
+                request_id=rid,
+                worker=addr,
+                token_ids=list(data.get("token_ids", [])),
+                text="",
+                finish_reason=data.get("finish_reason"),
+                latency_s=time.monotonic() - t0,
+            )
+        choice = (data.get("choices") or [{}])[0]
+        return RolloutResult(
+            request_id=rid,
+            worker=addr,
+            token_ids=[],
+            text=choice.get("text", ""),
+            finish_reason=choice.get("finish_reason"),
+            latency_s=time.monotonic() - t0,
+        )
+
+    async def generate_batch(
+        self,
+        prompts: list | None = None,
+        prompt_token_ids: list[list[int]] | None = None,
+        sampling_params: dict | None = None,
+    ) -> list[RolloutResult]:
+        """Fan a rollout batch across the worker pool concurrently —
+        the shape of one verl `generate_sequences` step."""
+        import asyncio
+
+        if prompt_token_ids is not None:
+            coros = [
+                self.generate(prompt_token_ids=ids, sampling_params=sampling_params)
+                for ids in prompt_token_ids
+            ]
+        else:
+            coros = [
+                self.generate(prompt=p, sampling_params=sampling_params)
+                for p in (prompts or [])
+            ]
+        return list(await asyncio.gather(*coros))
+
+    # ------------------------------------------------------------ weights
+
+    def notify_weights_updated(self) -> None:
+        """Weight rollout: all engine caches are invalid; clear prefix
+        affinity so routing doesn't chase dead caches (the reference
+        emits AllBlocksCleared from the engines, kv-indexer.md:63)."""
+        self.weight_epoch += 1
+        for p in self.store.list():
+            self.scheduler.notify_endpoint_removed(p.address)
+        log.info("weight epoch %d: prefix affinity cleared", self.weight_epoch)

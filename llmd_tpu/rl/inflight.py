@@ -1,0 +1,67 @@
+"""InflightStore: real-time per-worker request/token tracking.
+
+Reference verl-integration.md:11 — "An InflightStore tracks active
+requests per worker in real time, augmenting the slower
+Prometheus-based metrics to give the scheduler an accurate view of
+cluster load during rollout generation." Rollout bursts (hundreds of
+requests dispatched within one training step) would otherwise all land
+on whichever worker looked idle at the last metrics poll.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class InflightStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # address -> {"requests": n, "tokens": n, "started": {rid: t0}}
+        self._by_worker: dict[str, dict] = {}
+        self.completed_total = 0
+
+    def _w(self, address: str) -> dict:
+        return self._by_worker.setdefault(
+            address, {"requests": 0, "tokens": 0, "started": {}}
+        )
+
+    def begin(self, address: str, request_id: str, tokens: int) -> None:
+        with self._lock:
+            w = self._w(address)
+            w["requests"] += 1
+            w["tokens"] += tokens
+            w["started"][request_id] = (time.monotonic(), tokens)
+
+    def end(self, address: str, request_id: str) -> float | None:
+        """Returns the request's wall time, or None if unknown."""
+        with self._lock:
+            w = self._by_worker.get(address)
+            if w is None or request_id not in w["started"]:
+                return None
+            t0, tokens = w["started"].pop(request_id)
+            w["requests"] = max(0, w["requests"] - 1)
+            w["tokens"] = max(0, w["tokens"] - tokens)
+            self.completed_total += 1
+            return time.monotonic() - t0
+
+    def requests(self, address: str) -> int:
+        with self._lock:
+            w = self._by_worker.get(address)
+            return w["requests"] if w else 0
+
+    def tokens(self, address: str) -> int:
+        with self._lock:
+            w = self._by_worker.get(address)
+            return w["tokens"] if w else 0
+
+    def snapshot(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {
+                a: {"requests": w["requests"], "tokens": w["tokens"]}
+                for a, w in self._by_worker.items()
+            }
+
+    def drop_worker(self, address: str) -> None:
+        with self._lock:
+            self._by_worker.pop(address, None)

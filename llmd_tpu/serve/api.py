@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import re
 import time
 
 import aiohttp
@@ -36,6 +37,9 @@ TOK_KEY = web.AppKey("llmd_tokenizer", object)
 MODEL_KEY = web.AppKey("llmd_model_name", str)
 MAXLEN_KEY = web.AppKey("llmd_max_model_len", int)
 MM_SESSION_KEY = web.AppKey("llmd_mm_session", object)
+
+_EC_HOST_RE = re.compile(r"[A-Za-z0-9_.\-]{1,253}:\d{1,5}")
+_EC_DIGEST_RE = re.compile(r"[0-9a-f]{16,64}")
 
 
 async def _resolve_ec_parts(request: web.Request, messages: list) -> int:
@@ -60,7 +64,12 @@ async def _resolve_ec_parts(request: web.Request, messages: list) -> int:
             if not (isinstance(part, dict) and part.get("type") == "ec_embedding"):
                 continue
             ec = part.get("ec_embedding") or {}
-            host, digest = ec.get("host"), ec.get("digest", "")
+            host, digest = str(ec.get("host") or ""), str(ec.get("digest") or "")
+            # SSRF guard: these parts normally come from the sidecar, but a
+            # client can post them directly — only a bare host:port and a
+            # hex digest may be interpolated into the pull URL.
+            if not _EC_HOST_RE.fullmatch(host) or not _EC_DIGEST_RE.fullmatch(digest):
+                host = ""
             if session is not None and host and digest:
                 try:
                     async with session.get(

@@ -134,7 +134,7 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
             # E-only (E/PD topology without a separate prefiller): forward
             # the embedding-substituted body to the local engine.
             async with session.post(
-                local_base + request.path,
+                local_base + request.path_qs,
                 headers=_fwd_headers(request.headers),
                 json=body,
             ) as upstream:
