@@ -1,0 +1,25 @@
+"""IRO: Inference Resilience Operator — hardware-fault/engine coordination.
+
+Reference: proposals/inference-resilience-operator.md — an infrastructure
+recovery controller resolves hardware faults into RecoveryRequests
+(RESET_DEVICE | REBOOT_NODE | REPLACE_NODE); IRO sequences the
+engine-side response (pause/drain before or parallel with infra
+recovery, resume only after recovery is confirmed complete) and
+restores serving capacity. No-Kubernetes deployments use a watched
+JSON file in place of the CRD; the same reconciler drives both.
+"""
+
+from llmd_tpu.iro.types import Phase, RecoveryAction, RecoveryRequest
+from llmd_tpu.iro.adapter import EngineAdapter, HttpEngineAdapter
+from llmd_tpu.iro.reconciler import InferenceReconciler
+from llmd_tpu.iro.store import FileRecoveryStore
+
+__all__ = [
+    "Phase",
+    "RecoveryAction",
+    "RecoveryRequest",
+    "EngineAdapter",
+    "HttpEngineAdapter",
+    "InferenceReconciler",
+    "FileRecoveryStore",
+]

@@ -1,0 +1,56 @@
+"""`python -m llmd_tpu.iro` — the resilience operator process.
+
+    python -m llmd_tpu.iro \
+        --recovery-file /var/run/llmd/recovery.json \
+        --endpoints-file /var/run/llmd/endpoints.json
+
+The infrastructure recovery controller writes RecoveryRequests into
+--recovery-file and advances status.phase; this process sequences the
+engine side and edits --endpoints-file for REPLACE_NODE capacity
+changes (routers watching the file pick the change up immediately).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("llmd-tpu iro")
+    p.add_argument("--recovery-file", required=True)
+    p.add_argument("--endpoints-file", required=True)
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument(
+        "--drain-before-pause", action="store_true",
+        help="drain in-flight requests before pausing (graceful variant)",
+    )
+    p.add_argument("--drain-timeout", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    from llmd_tpu.iro.adapter import HttpEngineAdapter
+    from llmd_tpu.iro.reconciler import InferenceReconciler
+    from llmd_tpu.iro.store import FileRecoveryStore
+
+    rec = InferenceReconciler(
+        store=FileRecoveryStore(args.recovery_file),
+        adapter=HttpEngineAdapter(),
+        endpoints_file=args.endpoints_file,
+        poll_s=args.poll_interval,
+        drain_before_pause=args.drain_before_pause,
+        drain_timeout_s=args.drain_timeout,
+    )
+
+    async def _run() -> None:
+        try:
+            await rec.run()
+        finally:
+            await rec.stop()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""EngineAdapter: the swappable engine-specific surface.
+
+Reference: proposals/inference-resilience-operator.md — "All
+engine-specific logic is encapsulated in swappable EngineAdapter
+implementations." The llmd adapter drives the engine's /admin
+pause/resume/drain endpoints (llmd_tpu/serve/api.py).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import aiohttp
+
+log = logging.getLogger(__name__)
+
+
+class EngineAdapter:
+    """One adapter instance coordinates ALL engines of a serving group;
+    methods take the target engine's address."""
+
+    async def pause(self, address: str) -> bool:
+        raise NotImplementedError
+
+    async def resume(self, address: str) -> bool:
+        raise NotImplementedError
+
+    async def drain(self, address: str, timeout_s: float = 60.0) -> bool:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class HttpEngineAdapter(EngineAdapter):
+    """Adapter for this framework's engine (and any engine exposing the
+    same /admin surface)."""
+
+    def __init__(self, timeout_s: float = 120.0) -> None:
+        self.timeout_s = timeout_s
+        self._session: aiohttp.ClientSession | None = None
+
+    async def _s(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s, sock_connect=5)
+            )
+        return self._session
+
+    async def _post(self, address: str, path: str) -> bool:
+        try:
+            session = await self._s()
+            async with session.post(f"http://{address}{path}") as resp:
+                return resp.status < 300
+        except aiohttp.ClientError as e:
+            log.warning("engine %s %s failed: %s", address, path, e)
+            return False
+
+    async def pause(self, address: str) -> bool:
+        return await self._post(address, "/admin/pause")
+
+    async def resume(self, address: str) -> bool:
+        return await self._post(address, "/admin/resume")
+
+    async def drain(self, address: str, timeout_s: float = 60.0) -> bool:
+        try:
+            session = await self._s()
+            async with session.post(
+                f"http://{address}/admin/drain?timeout={timeout_s}",
+                timeout=aiohttp.ClientTimeout(total=timeout_s + 10),
+            ) as resp:
+                return resp.status == 200
+        except (aiohttp.ClientError, TimeoutError) as e:
+            log.warning("engine %s drain failed: %s", address, e)
+            return False
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

@@ -1,0 +1,201 @@
+"""InferenceReconciler: the IRO state machine.
+
+Sequencing contract (proposals/inference-resilience-operator.md Goals):
+IRO acts on the engine BEFORE or in parallel with infrastructure
+recovery, and resumes the engine only once recovery is confirmed
+complete. Tracks by requested action:
+
+  RESET_DEVICE  (A)  pause affected engines -> wait Completed -> resume
+  REBOOT_NODE   (B)  same sequencing, longer horizon
+  REPLACE_NODE  (C)  pause + remove the node's endpoints from the
+                     serving pool (routers stop sending traffic; the
+                     pool serves at reduced capacity) -> wait Completed
+                     -> restore endpoints + resume
+
+The rank topology map is the endpoints file: each endpoint's
+`llm-d.ai/node` label names its node; IRO edits that file for Track C
+(the no-K8s analogue of scaling the serving group).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+
+from llmd_tpu.iro.adapter import EngineAdapter
+from llmd_tpu.iro.store import FileRecoveryStore
+from llmd_tpu.iro.types import EngineState, Phase, RecoveryAction, RecoveryRequest
+
+log = logging.getLogger(__name__)
+
+NODE_LABEL = "llm-d.ai/node"
+
+
+class InferenceReconciler:
+    def __init__(
+        self,
+        store: FileRecoveryStore,
+        adapter: EngineAdapter,
+        endpoints_file: str,
+        poll_s: float = 1.0,
+        drain_before_pause: bool = False,
+        drain_timeout_s: float = 30.0,
+    ) -> None:
+        self.store = store
+        self.adapter = adapter
+        self.endpoints_file = endpoints_file
+        self.poll_s = poll_s
+        self.drain_before_pause = drain_before_pause
+        self.drain_timeout_s = drain_timeout_s
+        # name -> engine_state we last acted on (in-memory FSM position)
+        self._acted: dict[str, EngineState] = {}
+        # name -> endpoint dicts removed from the pool (Track C restore set)
+        self._removed: dict[str, list[dict]] = {}
+        self._task: asyncio.Task | None = None
+        self.cycles = 0
+
+    # ---------------------------------------------------------- topology
+
+    def _endpoints_raw(self) -> dict:
+        try:
+            with open(self.endpoints_file) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {"endpoints": []}
+
+    def _write_endpoints(self, raw: dict) -> None:
+        tmp = self.endpoints_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(raw, f, indent=2)
+        os.replace(tmp, self.endpoints_file)
+
+    def addresses_on_node(self, node: str) -> list[str]:
+        return [
+            e["address"]
+            for e in self._endpoints_raw().get("endpoints", [])
+            if e.get("labels", {}).get(NODE_LABEL) == node
+        ]
+
+    # ---------------------------------------------------------- actions
+
+    async def _pause_node(self, req: RecoveryRequest) -> bool:
+        addrs = self.addresses_on_node(req.node_name)
+        if not addrs:
+            log.warning(
+                "RecoveryRequest %s: no endpoints labeled %s=%s",
+                req.name, NODE_LABEL, req.node_name,
+            )
+        ok = True
+        for a in addrs:
+            if self.drain_before_pause:
+                await self.adapter.drain(a, self.drain_timeout_s)
+            ok = await self.adapter.pause(a) and ok
+        return ok
+
+    async def _resume_node(self, req: RecoveryRequest) -> bool:
+        ok = True
+        for a in self.addresses_on_node(req.node_name):
+            ok = await self.adapter.resume(a) and ok
+        return ok
+
+    def _scale_down_node(self, req: RecoveryRequest) -> None:
+        raw = self._endpoints_raw()
+        keep, removed = [], []
+        for e in raw.get("endpoints", []):
+            if e.get("labels", {}).get(NODE_LABEL) == req.node_name:
+                removed.append(e)
+            else:
+                keep.append(e)
+        if removed:
+            raw["endpoints"] = keep
+            self._write_endpoints(raw)
+            self._removed[req.name] = removed
+            log.info(
+                "RecoveryRequest %s: removed %d endpoints on node %s from pool",
+                req.name, len(removed), req.node_name,
+            )
+
+    def _scale_up_node(self, req: RecoveryRequest) -> None:
+        removed = self._removed.pop(req.name, [])
+        if not removed:
+            return
+        raw = self._endpoints_raw()
+        present = {e.get("address") for e in raw.get("endpoints", [])}
+        raw.setdefault("endpoints", []).extend(
+            e for e in removed if e.get("address") not in present
+        )
+        self._write_endpoints(raw)
+        log.info(
+            "RecoveryRequest %s: restored %d endpoints on node %s",
+            req.name, len(removed), req.node_name,
+        )
+
+    # ---------------------------------------------------------- FSM
+
+    async def reconcile_once(self) -> None:
+        self.cycles += 1
+        for req in self.store.list():
+            state = self._acted.get(req.name, req.engine_state or EngineState.NONE)
+            try:
+                await self._advance(req, state)
+            except Exception:
+                log.exception("RecoveryRequest %s reconcile failed", req.name)
+
+    async def _advance(self, req: RecoveryRequest, state: EngineState) -> None:
+        terminal = {EngineState.RESUMED, EngineState.FAILED}
+        if state in terminal:
+            return
+        if state is EngineState.NONE and req.phase in (
+            Phase.PENDING, Phase.IN_PROGRESS
+        ):
+            # Engine-before-infrastructure: quiesce as soon as the request
+            # exists, regardless of whether infra already started.
+            await self._pause_node(req)
+            if req.requested_action is RecoveryAction.REPLACE_NODE:
+                self._scale_down_node(req)
+                self._set(req, EngineState.SCALED_DOWN)
+            else:
+                self._set(req, EngineState.PAUSED)
+            return
+        if state in (EngineState.PAUSED, EngineState.SCALED_DOWN):
+            if req.phase is Phase.COMPLETED:
+                if state is EngineState.SCALED_DOWN:
+                    self._scale_up_node(req)
+                await self._resume_node(req)
+                self._set(req, EngineState.RESUMED)
+            elif req.phase is Phase.FAILED:
+                # Infra recovery failed: resume whatever still responds so
+                # the group serves at reduced capacity; Track C endpoints
+                # stay out of the pool (the node never came back).
+                if state is EngineState.PAUSED:
+                    await self._resume_node(req)
+                self._set(req, EngineState.FAILED)
+
+    def _set(self, req: RecoveryRequest, state: EngineState) -> None:
+        self._acted[req.name] = state
+        self.store.update_engine_state(req.name, state)
+        log.info("RecoveryRequest %s: engineState -> %s", req.name, state.value)
+
+    # ---------------------------------------------------------- loop
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.reconcile_once()
+            except Exception:
+                log.exception("IRO reconcile cycle failed")
+            await asyncio.sleep(self.poll_s)
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await self.adapter.close()

@@ -1,0 +1,58 @@
+"""FileRecoveryStore: the no-Kubernetes RecoveryRequest channel.
+
+The infrastructure recovery controller appends request objects to a
+JSON file (`{"requests": [...]}`) and advances `status.phase` as it
+executes the action; IRO reads the file and writes back only
+`status.engineState`. On Kubernetes the same reconciler would sit on a
+CRD watch instead — the store is the swapped layer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from llmd_tpu.iro.types import RecoveryRequest
+
+log = logging.getLogger(__name__)
+
+
+class FileRecoveryStore:
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def _read_raw(self) -> dict:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"requests": []}
+        except json.JSONDecodeError as e:
+            log.warning("recovery file %s unparseable: %s", self.path, e)
+            return {"requests": []}
+
+    def list(self) -> list[RecoveryRequest]:
+        out = []
+        for d in self._read_raw().get("requests", []):
+            try:
+                out.append(RecoveryRequest.from_dict(d))
+            except (ValueError, KeyError) as e:
+                log.warning("skipping malformed RecoveryRequest %r: %s", d, e)
+        return out
+
+    def update_engine_state(self, name: str, engine_state) -> None:
+        """Read-modify-write of OUR status field only (phase belongs to
+        the infrastructure controller and is preserved as-is)."""
+        raw = self._read_raw()
+        for d in raw.get("requests", []):
+            if str(d.get("name") or d.get("metadata", {}).get("name", "")) == name:
+                d.setdefault("status", {})["engineState"] = (
+                    engine_state.value
+                    if hasattr(engine_state, "value")
+                    else str(engine_state)
+                )
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(raw, f, indent=2)
+        os.replace(tmp, self.path)

@@ -1,0 +1,73 @@
+"""IRO datatypes: the RecoveryRequest contract.
+
+Field names follow the proposed CRD
+(proposals/inference-resilience-operator.md "Design Details"):
+nodeName, deviceID, errorCode, requestedAction, status.phase. IRO
+writes only its own engine-side state (engineState) — the
+infrastructure recovery controller owns `phase`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class RecoveryAction(str, enum.Enum):
+    RESET_DEVICE = "RESET_DEVICE"    # Track A: pause, reset, resume (seconds)
+    REBOOT_NODE = "REBOOT_NODE"      # Track B: pause, reboot, resume (minutes)
+    REPLACE_NODE = "REPLACE_NODE"    # Track C: pause, scale down, replace,
+    #                                  scale up (reduced capacity meanwhile)
+
+
+class Phase(str, enum.Enum):
+    PENDING = "Pending"
+    IN_PROGRESS = "InProgress"
+    COMPLETED = "Completed"
+    FAILED = "Failed"
+
+
+class EngineState(str, enum.Enum):
+    """IRO-owned status: where the engine-side sequencing stands."""
+
+    NONE = ""
+    PAUSED = "Paused"
+    SCALED_DOWN = "ScaledDown"
+    RESUMED = "Resumed"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class RecoveryRequest:
+    name: str
+    node_name: str
+    requested_action: RecoveryAction
+    device_id: str = ""
+    error_code: str = ""      # observability only; IRO does not interpret it
+    phase: Phase = Phase.PENDING
+    engine_state: EngineState = EngineState.NONE
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RecoveryRequest":
+        return cls(
+            name=str(d.get("name") or d.get("metadata", {}).get("name", "")),
+            node_name=str(d.get("nodeName", "")),
+            requested_action=RecoveryAction(d.get("requestedAction", "RESET_DEVICE")),
+            device_id=str(d.get("deviceID", "")),
+            error_code=str(d.get("errorCode", "")),
+            phase=Phase(d.get("status", {}).get("phase", "Pending")),
+            engine_state=EngineState(d.get("status", {}).get("engineState", "")),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "nodeName": self.node_name,
+            "deviceID": self.device_id,
+            "errorCode": self.error_code,
+            "requestedAction": self.requested_action.value,
+            "status": {
+                "phase": self.phase.value,
+                "engineState": self.engine_state.value,
+            },
+        }

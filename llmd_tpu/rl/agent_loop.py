@@ -102,9 +102,15 @@ class InferenceAgentLoopManager:
     # ------------------------------------------------------------ routing
 
     def _request_for(self, prompt, prompt_token_ids, request_id) -> LLMRequest:
+        text = prompt or ""
+        if not text and prompt_token_ids:
+            # Prefix-affinity scoring hashes prompt_text; token-only
+            # rollouts need a stable text key or shared-prefix batches
+            # spread instead of landing on the cached worker.
+            text = " ".join(map(str, prompt_token_ids))
         return LLMRequest(
             request_id=request_id,
-            prompt_text=prompt or "",
+            prompt_text=text,
             prompt_token_ids=prompt_token_ids,
             path="/v1/completions",
         )
@@ -163,12 +169,12 @@ class InferenceAgentLoopManager:
             async with self._session.post(
                 url, json=payload, headers={"x-request-id": rid}
             ) as resp:
-                data = await resp.json()
                 if resp.status != 200:
                     raise RuntimeError(
                         f"worker {addr} returned {resp.status}: "
-                        f"{str(data)[:200]}"
+                        f"{(await resp.text())[:200]}"
                     )
+                data = await resp.json()
         finally:
             self.release_server(addr, rid)
         if prompt_token_ids is not None:

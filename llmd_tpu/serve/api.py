@@ -13,8 +13,10 @@ docs/architecture/core/model-servers.md:38-100):
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import logging
+import os
 import re
 import time
 
@@ -555,6 +557,74 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
     )
 
 
+# --------------------------------------------------------------------- #
+# IRO engine-coordination surface (proposals/inference-resilience-operator.md:
+# pause/resume/drain called by the resilience operator's EngineAdapter
+# around infrastructure recovery actions).
+#
+# Auth: pause halts serving, so these must not be client-callable. With
+# LLMD_ADMIN_TOKEN set, requests need `x-admin-token` (or Bearer) to
+# match; without it, only loopback peers are accepted (the IRO runs on
+# the same host in no-K8s mode; on K8s, mount a token).
+
+
+def _admin_denied(request: web.Request) -> web.Response | None:
+    token = os.environ.get("LLMD_ADMIN_TOKEN", "")
+    if token:
+        given = request.headers.get("x-admin-token", "")
+        auth = request.headers.get("authorization", "")
+        if auth.lower().startswith("bearer "):
+            given = given or auth[7:]
+        if hmac.compare_digest(given, token):
+            return None
+        return _error(403, "admin token required")
+    peer = request.transport.get_extra_info("peername") if request.transport else None
+    host = peer[0] if isinstance(peer, (tuple, list)) and peer else ""
+    if host in ("127.0.0.1", "::1", "::ffff:127.0.0.1"):
+        return None
+    return _error(403, "admin surface is loopback-only without LLMD_ADMIN_TOKEN")
+
+
+async def handle_admin_pause(request: web.Request) -> web.Response:
+    denied = _admin_denied(request)
+    if denied is not None:
+        return denied
+    request.app[ENGINE_KEY].pause()
+    return web.json_response({"paused": True})
+
+
+async def handle_admin_resume(request: web.Request) -> web.Response:
+    denied = _admin_denied(request)
+    if denied is not None:
+        return denied
+    request.app[ENGINE_KEY].resume()
+    return web.json_response({"paused": False})
+
+
+async def handle_admin_drain(request: web.Request) -> web.Response:
+    denied = _admin_denied(request)
+    if denied is not None:
+        return denied
+    try:
+        timeout_s = float(request.query.get("timeout", 60.0))
+    except ValueError:
+        return _error(400, "timeout must be a number")
+    drained = await request.app[ENGINE_KEY].drain(timeout_s)
+    return web.json_response({"drained": drained}, status=200 if drained else 504)
+
+
+async def handle_admin_status(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    stats = engine.stats
+    return web.json_response(
+        {
+            "paused": engine.paused,
+            "running": stats.num_running,
+            "waiting": stats.num_waiting,
+        }
+    )
+
+
 async def handle_completions(request: web.Request) -> web.StreamResponse:
     return await _handle_generate(request, chat=False)
 
@@ -589,6 +659,10 @@ def build_app(
             web.post("/v1/chat/completions", handle_chat),
             web.post("/v1/completions/render", handle_completions_render),
             web.post("/v1/chat/completions/render", handle_chat_render),
+            web.post("/admin/pause", handle_admin_pause),
+            web.post("/admin/resume", handle_admin_resume),
+            web.post("/admin/drain", handle_admin_drain),
+            web.get("/admin/status", handle_admin_status),
         ]
     )
     if extra_routes:

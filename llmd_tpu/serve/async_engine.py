@@ -47,6 +47,11 @@ class AsyncEngine:
         self._inbox: list[_Pending] = []
         self._aborts: list[str] = []
         self._stop = False
+        # IRO pause gate (proposals/inference-resilience-operator.md): a
+        # paused engine stops stepping entirely — in-flight sequences stay
+        # scheduled with their KV intact and continue on resume. Used to
+        # quiesce the device before a RESET_DEVICE / REBOOT_NODE action.
+        self._paused = False
         self._loop: asyncio.AbstractEventLoop | None = None
         # request_id -> asyncio.Queue of RequestOutput | Exception
         self._subs: dict[str, asyncio.Queue] = {}
@@ -71,6 +76,36 @@ class AsyncEngine:
     @property
     def stats(self):
         return self.engine.stats
+
+    # ------------------------------------------------------------------ #
+    # IRO engine-coordination surface
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
+    def pause(self) -> None:
+        with self._lock:
+            self._paused = True
+            self._lock.notify_all()
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._lock.notify_all()
+
+    async def drain(self, timeout_s: float = 60.0) -> bool:
+        """Wait until no requests are in flight (queued or running).
+        New submissions keep being accepted; callers gate those upstream
+        (the router stops routing to a draining endpoint)."""
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while asyncio.get_running_loop().time() < deadline:
+            with self._lock:
+                idle = not self._inbox and not self.engine.has_work()
+            if idle:
+                return True
+            await asyncio.sleep(0.05)
+        return False
 
     # ------------------------------------------------------------------ #
 
@@ -156,11 +191,13 @@ class AsyncEngine:
     def _run(self) -> None:
         while True:
             with self._lock:
-                while (
-                    not self._stop
-                    and not self._inbox
-                    and not self._aborts
-                    and not self.engine.has_work()
+                while not self._stop and (
+                    self._paused
+                    or (
+                        not self._inbox
+                        and not self._aborts
+                        and not self.engine.has_work()
+                    )
                 ):
                     self._lock.wait()
                 if self._stop:

@@ -259,7 +259,7 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
             if dec_span.sampled:
                 headers["traceparent"] = dec_span.traceparent
             async with session.post(
-                local_base + request.path,
+                local_base + request.path_qs,
                 headers=headers,
                 json=body,
             ) as upstream:

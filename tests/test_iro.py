@@ -1,0 +1,201 @@
+"""IRO: engine pause/resume/drain surface + recovery state machine."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config
+from llmd_tpu.engine import LLMEngine
+from llmd_tpu.iro import (
+    FileRecoveryStore,
+    InferenceReconciler,
+    Phase,
+    RecoveryAction,
+)
+from llmd_tpu.iro.adapter import EngineAdapter, HttpEngineAdapter
+from llmd_tpu.iro.types import EngineState
+from llmd_tpu.serve.api import build_app
+from llmd_tpu.serve.async_engine import AsyncEngine
+from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def write_recovery(path, name, node, action, phase="Pending"):
+    try:
+        raw = json.load(open(path))
+    except (FileNotFoundError, json.JSONDecodeError):
+        raw = {"requests": []}
+    for r in raw["requests"]:
+        if r["name"] == name:
+            r["requestedAction"] = action
+            r.setdefault("status", {})["phase"] = phase
+            break
+    else:
+        raw["requests"].append(
+            {"name": name, "nodeName": node, "requestedAction": action,
+             "status": {"phase": phase}}
+        )
+    json.dump(raw, open(path, "w"))
+
+
+def write_endpoints(path, eps):
+    json.dump({"endpoints": eps}, open(path, "w"))
+
+
+class FakeAdapter(EngineAdapter):
+    def __init__(self):
+        self.calls = []
+
+    async def pause(self, address):
+        self.calls.append(("pause", address))
+        return True
+
+    async def resume(self, address):
+        self.calls.append(("resume", address))
+        return True
+
+    async def drain(self, address, timeout_s=60.0):
+        self.calls.append(("drain", address))
+        return True
+
+
+# ---------------------------------------------------------------- FSM
+
+
+async def test_track_a_reset_device(tmp_path):
+    rec_file = str(tmp_path / "recovery.json")
+    eps_file = str(tmp_path / "endpoints.json")
+    write_endpoints(eps_file, [
+        {"address": "a:1", "labels": {"llm-d.ai/node": "node1"}},
+        {"address": "b:1", "labels": {"llm-d.ai/node": "node2"}},
+    ])
+    adapter = FakeAdapter()
+    rec = InferenceReconciler(
+        FileRecoveryStore(rec_file), adapter, eps_file
+    )
+    write_recovery(rec_file, "rr1", "node1", "RESET_DEVICE")
+    await rec.reconcile_once()
+    # engine on node1 paused; node2 untouched
+    assert ("pause", "a:1") in adapter.calls
+    assert not any(a == "b:1" for _, a in adapter.calls)
+    st = json.load(open(rec_file))["requests"][0]["status"]
+    assert st["engineState"] == "Paused"
+    # infra still in progress: nothing new happens
+    write_recovery(rec_file, "rr1", "node1", "RESET_DEVICE", phase="InProgress")
+    await rec.reconcile_once()
+    assert ("resume", "a:1") not in adapter.calls
+    # infra completed: resume
+    write_recovery(rec_file, "rr1", "node1", "RESET_DEVICE", phase="Completed")
+    await rec.reconcile_once()
+    assert ("resume", "a:1") in adapter.calls
+    st = json.load(open(rec_file))["requests"][0]["status"]
+    assert st["engineState"] == "Resumed"
+    # terminal: further cycles are no-ops
+    n = len(adapter.calls)
+    await rec.reconcile_once()
+    assert len(adapter.calls) == n
+
+
+async def test_track_c_replace_node_scales_pool(tmp_path):
+    rec_file = str(tmp_path / "recovery.json")
+    eps_file = str(tmp_path / "endpoints.json")
+    write_endpoints(eps_file, [
+        {"address": "a:1", "labels": {"llm-d.ai/node": "node1"}},
+        {"address": "a:2", "labels": {"llm-d.ai/node": "node1"}},
+        {"address": "b:1", "labels": {"llm-d.ai/node": "node2"}},
+    ])
+    adapter = FakeAdapter()
+    rec = InferenceReconciler(FileRecoveryStore(rec_file), adapter, eps_file)
+    write_recovery(rec_file, "rr2", "node1", "REPLACE_NODE")
+    await rec.reconcile_once()
+    eps = json.load(open(eps_file))["endpoints"]
+    assert [e["address"] for e in eps] == ["b:1"]  # node1 removed from pool
+    st = json.load(open(rec_file))["requests"][0]["status"]
+    assert st["engineState"] == "ScaledDown"
+    # node replaced: endpoints restored, engines resumed
+    write_recovery(rec_file, "rr2", "node1", "REPLACE_NODE", phase="Completed")
+    await rec.reconcile_once()
+    eps = json.load(open(eps_file))["endpoints"]
+    assert {e["address"] for e in eps} == {"a:1", "a:2", "b:1"}
+    assert ("resume", "a:1") in adapter.calls and ("resume", "a:2") in adapter.calls
+
+
+async def test_infra_failure_resumes_at_reduced_capacity(tmp_path):
+    rec_file = str(tmp_path / "recovery.json")
+    eps_file = str(tmp_path / "endpoints.json")
+    write_endpoints(eps_file, [
+        {"address": "a:1", "labels": {"llm-d.ai/node": "node1"}},
+    ])
+    adapter = FakeAdapter()
+    rec = InferenceReconciler(FileRecoveryStore(rec_file), adapter, eps_file)
+    write_recovery(rec_file, "rr3", "node1", "REPLACE_NODE")
+    await rec.reconcile_once()
+    write_recovery(rec_file, "rr3", "node1", "REPLACE_NODE", phase="Failed")
+    await rec.reconcile_once()
+    # Track C failure: endpoints stay out (node is gone)
+    assert json.load(open(eps_file))["endpoints"] == []
+    st = json.load(open(rec_file))["requests"][0]["status"]
+    assert st["engineState"] == "Failed"
+
+
+# ---------------------------------------------------------------- engine surface
+
+
+def _engine_app():
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64),
+    )
+    return build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 128)
+
+
+async def test_admin_pause_blocks_generation_until_resume():
+    client = TestClient(TestServer(_engine_app()))
+    await client.start_server()
+    try:
+        resp = await client.post("/admin/pause")
+        assert (await resp.json())["paused"] is True
+        status = await (await client.get("/admin/status")).json()
+        assert status["paused"] is True
+
+        async def gen():
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "hello", "max_tokens": 4},
+            )
+            return r.status
+
+        task = asyncio.ensure_future(gen())
+        await asyncio.sleep(0.5)
+        assert not task.done()  # paused engine holds the request
+        await client.post("/admin/resume")
+        assert await asyncio.wait_for(task, timeout=60) == 200
+        # drain returns once idle
+        r = await client.post("/admin/drain?timeout=10")
+        assert (await r.json())["drained"] is True
+    finally:
+        await client.close()
+
+
+async def test_http_adapter_against_live_engine(tmp_path):
+    server = TestServer(_engine_app())
+    await server.start_server()
+    adapter = HttpEngineAdapter()
+    addr = f"{server.host}:{server.port}"
+    try:
+        assert await adapter.pause(addr) is True
+        assert await adapter.resume(addr) is True
+        assert await adapter.drain(addr, timeout_s=10) is True
+        assert await adapter.pause("127.0.0.1:1") is False  # unreachable
+    finally:
+        await adapter.close()
+        await server.close()
