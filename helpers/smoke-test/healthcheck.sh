@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Smoke test: /health, /v1/models, /v1/completions E2E with a latency
+# gate and JSON output (reference helpers/smoke-test/README.md:9-17).
+#
+# Usage: healthcheck.sh <base-url> <model> [max-latency-seconds]
+# Exit 0 when all checks pass, 1 otherwise. Prints one JSON object.
+set -u
+
+BASE_URL="${1:?usage: healthcheck.sh <base-url> <model> [max-latency-s]}"
+MODEL="${2:?usage: healthcheck.sh <base-url> <model> [max-latency-s]}"
+MAX_LATENCY_S="${3:-30}"
+
+fail=0
+health_ok=false
+models_ok=false
+completion_ok=false
+latency_ok=false
+latency_s=""
+
+# 1. health (router serves /healthz, engines /health — accept either)
+if curl -sf -m 10 "${BASE_URL}/health" > /dev/null 2>&1 \
+   || curl -sf -m 10 "${BASE_URL}/healthz" > /dev/null 2>&1; then
+  health_ok=true
+else
+  fail=1
+fi
+
+# 2. model listing contains the served model
+models_json="$(curl -sf -m 10 "${BASE_URL}/v1/models" 2>/dev/null)" || fail=1
+if printf '%s' "${models_json}" | grep -q "\"${MODEL}\""; then
+  models_ok=true
+else
+  fail=1
+fi
+
+# 3. one real completion under the latency gate
+start_ns=$(date +%s%N)
+resp="$(curl -sf -m "${MAX_LATENCY_S}" "${BASE_URL}/v1/completions" \
+  -H 'content-type: application/json' \
+  -d "{\"model\": \"${MODEL}\", \"prompt\": \"Hello\", \"max_tokens\": 8}" \
+  2>/dev/null)" || fail=1
+end_ns=$(date +%s%N)
+latency_s=$(awk "BEGIN {printf \"%.3f\", (${end_ns} - ${start_ns}) / 1e9}")
+
+if printf '%s' "${resp}" | grep -q '"text"'; then
+  completion_ok=true
+else
+  fail=1
+fi
+if awk "BEGIN {exit !(${latency_s} <= ${MAX_LATENCY_S})}"; then
+  latency_ok=true
+else
+  fail=1
+fi
+
+status=pass
+[ "${fail}" -ne 0 ] && status=fail
+cat <<EOF
+{"status": "${status}", "endpoint": "${BASE_URL}", "model": "${MODEL}",
+ "checks": {"health": ${health_ok}, "models": ${models_ok},
+            "completion": ${completion_ok}, "latency": ${latency_ok}},
+ "completion_latency_s": ${latency_s:-null},
+ "max_latency_s": ${MAX_LATENCY_S}}
+EOF
+exit "${fail}"

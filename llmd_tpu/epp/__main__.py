@@ -18,7 +18,17 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser("llmd-tpu router")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8800)
-    p.add_argument("--endpoints-file", required=True, help="JSON endpoints file")
+    p.add_argument(
+        "--endpoints-file", default=None,
+        help="JSON endpoints file (no-Kubernetes file-discovery)",
+    )
+    p.add_argument(
+        "--k8s-selector", default=None,
+        help="pod label selector for in-cluster discovery "
+        "(e.g. 'llm-d.ai/role in (decode,prefill-decode)')",
+    )
+    p.add_argument("--k8s-namespace", default=None)
+    p.add_argument("--k8s-target-port", type=int, default=8000)
     p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
     p.add_argument(
         "--preset", default="default",
@@ -84,13 +94,24 @@ def main(argv=None) -> None:
             "predicted-latency": PREDICTED_LATENCY_CONFIG,
         }[args.preset]
 
+    if not args.endpoints_file and not args.k8s_selector:
+        p.error("one of --endpoints-file or --k8s-selector is required")
+    if args.endpoints_file and args.k8s_selector:
+        # Both sources reconcile the store to THEIR full set, so running
+        # two would alternately wipe each other's endpoints every poll.
+        p.error("--endpoints-file and --k8s-selector are mutually exclusive")
+
     store = EndpointStore()
     router = Router(
         store=store,
         scheduler=build_scheduler(config),
         flow_control=build_flow_control(config),
         collector=MetricsCollector(store, interval_s=args.scrape_interval),
-        discovery=FileDiscoverySource(store, args.endpoints_file),
+        discovery=(
+            FileDiscoverySource(store, args.endpoints_file)
+            if args.endpoints_file
+            else None
+        ),
         default_parser=config.get("requestHandler", {}).get(
             "parser", "openai-parser"
         ),
@@ -107,7 +128,24 @@ def main(argv=None) -> None:
     maybe_attach_predicted_latency(
         router, predict_url=args.predictor_url, train_url=args.trainer_url
     )
-    web.run_app(router.build_app(), host=args.host, port=args.port)
+    app = router.build_app()
+    if args.k8s_selector:
+        from llmd_tpu.epp.k8s_discovery import K8sPodDiscoverySource
+
+        k8s = K8sPodDiscoverySource(
+            store,
+            label_selector=args.k8s_selector,
+            namespace=args.k8s_namespace,
+            target_port=args.k8s_target_port,
+            poll_s=args.scrape_interval,
+        )
+
+        async def _start_k8s(app):
+            k8s.start()
+
+        app.on_startup.append(_start_k8s)
+        router.closables.append(k8s)
+    web.run_app(app, host=args.host, port=args.port)
 
 
 if __name__ == "__main__":

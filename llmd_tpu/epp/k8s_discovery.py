@@ -1,0 +1,145 @@
+"""Kubernetes pod discovery for the data layer.
+
+The reference EPP's `k8s-notification-source` (datalayer.md:49-91)
+watches the InferencePool selector and joins pods on status Running
+(inferencepool.md:26-31, operations-vllm.md:49-53 — "no central
+bootstrap"). The kubernetes client package is not part of this image,
+so this source speaks to the API server directly over HTTPS using the
+in-cluster service-account credentials, polling the pod list with a
+label selector. Each Running+Ready pod becomes an Endpoint at
+`podIP:port`, carrying its labels (role, engine-type, node) into the
+scheduler's view.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import ssl
+import urllib.parse
+
+import aiohttp
+
+from llmd_tpu.epp.types import Endpoint
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sPodDiscoverySource:
+    def __init__(
+        self,
+        store,
+        label_selector: str,
+        namespace: str | None = None,
+        target_port: int = 8000,
+        api_server: str | None = None,
+        token_path: str = f"{SA_DIR}/token",
+        ca_path: str = f"{SA_DIR}/ca.crt",
+        namespace_path: str = f"{SA_DIR}/namespace",
+        poll_s: float = 2.0,
+        node_label: str = "llm-d.ai/node",
+    ) -> None:
+        self.store = store
+        self.label_selector = label_selector
+        self.target_port = target_port
+        self.api_server = api_server or "https://kubernetes.default.svc"
+        self.token_path = token_path
+        self.ca_path = ca_path
+        self.poll_s = poll_s
+        self.node_label = node_label
+        if namespace is None:
+            try:
+                with open(namespace_path) as f:
+                    namespace = f.read().strip()
+            except OSError:
+                namespace = "default"
+        self.namespace = namespace
+        self._session: aiohttp.ClientSession | None = None
+        self._task: asyncio.Task | None = None
+
+    def _token(self) -> str:
+        # Re-read per request: projected SA tokens rotate.
+        with open(self.token_path) as f:
+            return f.read().strip()
+
+    async def _client(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            try:
+                ctx = ssl.create_default_context(cafile=self.ca_path)
+            except (OSError, ssl.SSLError):
+                ctx = ssl.create_default_context()
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(ssl=ctx),
+                timeout=aiohttp.ClientTimeout(total=15),
+            )
+        return self._session
+
+    @staticmethod
+    def _pod_ready(pod: dict) -> bool:
+        status = pod.get("status", {})
+        if status.get("phase") != "Running" or not status.get("podIP"):
+            return False
+        if pod.get("metadata", {}).get("deletionTimestamp"):
+            return False  # terminating: stop routing immediately
+        for cond in status.get("conditions", []):
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
+
+    def _endpoint_for(self, pod: dict) -> Endpoint:
+        meta = pod.get("metadata", {})
+        labels = dict(meta.get("labels", {}))
+        node = pod.get("spec", {}).get("nodeName")
+        if node and self.node_label not in labels:
+            labels[self.node_label] = node
+        port = self.target_port
+        # honor a per-pod port annotation (DP external-LB rank ports)
+        ann = meta.get("annotations", {}).get("llm-d.ai/port")
+        if ann:
+            try:
+                port = int(ann)
+            except ValueError:
+                pass
+        return Endpoint(
+            address=f"{pod['status']['podIP']}:{port}", labels=labels
+        )
+
+    async def poll_once(self) -> list[Endpoint]:
+        session = await self._client()
+        qs = urllib.parse.urlencode({"labelSelector": self.label_selector})
+        url = f"{self.api_server}/api/v1/namespaces/{self.namespace}/pods?{qs}"
+        async with session.get(
+            url, headers={"authorization": f"Bearer {self._token()}"}
+        ) as resp:
+            resp.raise_for_status()
+            body = json.loads(await resp.text())
+        eps = [
+            self._endpoint_for(p)
+            for p in body.get("items", [])
+            if self._pod_ready(p)
+        ]
+        self.store.reconcile(eps)
+        return eps
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except Exception as e:
+                log.warning("k8s pod discovery poll failed: %s", e)
+            await asyncio.sleep(self.poll_s)
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def close(self) -> None:
+        self.stop()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

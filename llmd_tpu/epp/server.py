@@ -35,6 +35,7 @@ from llmd_tpu.epp.types import (
     HDR_ENCODER,
     HDR_PREFILLER,
     KV_CACHE_USAGE,
+    ROLE_ENCODE,
     WAITING_QUEUE_SIZE,
     Endpoint,
     LLMRequest,
@@ -408,8 +409,15 @@ class Router:
                 t.add_done_callback(self._observer_tasks.discard)
 
     async def handle_passthrough(self, request: web.Request) -> web.StreamResponse:
-        """Non-generate paths (/v1/models, ...) go to any healthy endpoint."""
-        pods = [p for p in self.store.list() if p.healthy]
+        """Non-generate paths (/v1/models, ...) go to any healthy endpoint.
+
+        Encode workers serve a different surface (/v1/encode, EC pulls) —
+        they cannot answer /v1/models and are skipped.
+        """
+        pods = [
+            p for p in self.store.list()
+            if p.healthy and p.role != ROLE_ENCODE
+        ]
         if not pods:
             return web.json_response(
                 {"error": {"message": "no endpoints", "type": "no-endpoints"}},

@@ -8,6 +8,7 @@ pause/resume/drain endpoints (llmd_tpu/serve/api.py).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 import aiohttp
@@ -52,7 +53,7 @@ class HttpEngineAdapter(EngineAdapter):
             session = await self._s()
             async with session.post(f"http://{address}{path}") as resp:
                 return resp.status < 300
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             log.warning("engine %s %s failed: %s", address, path, e)
             return False
 

@@ -81,26 +81,40 @@ class InferenceReconciler:
     # ---------------------------------------------------------- actions
 
     async def _pause_node(self, req: RecoveryRequest) -> bool:
+        """True when it is safe to report the node quiesced: no engines
+        to pause, or at least one pause acknowledged. All-pauses-failed
+        returns False — the caller retries rather than telling the infra
+        controller the device is quiet while engines still step on it.
+        (An engine the fault already killed cannot acknowledge; partial
+        success therefore proceeds.)"""
         addrs = self.addresses_on_node(req.node_name)
         if not addrs:
             log.warning(
                 "RecoveryRequest %s: no endpoints labeled %s=%s",
                 req.name, NODE_LABEL, req.node_name,
             )
-        ok = True
-        for a in addrs:
+            return True
+
+        async def quiesce(a: str) -> bool:
             if self.drain_before_pause:
                 await self.adapter.drain(a, self.drain_timeout_s)
-            ok = await self.adapter.pause(a) and ok
-        return ok
+            return await self.adapter.pause(a)
+
+        results = await asyncio.gather(*(quiesce(a) for a in addrs))
+        return any(results)
 
     async def _resume_node(self, req: RecoveryRequest) -> bool:
-        ok = True
-        for a in self.addresses_on_node(req.node_name):
-            ok = await self.adapter.resume(a) and ok
-        return ok
+        addrs = self.addresses_on_node(req.node_name)
+        if not addrs:
+            return True
+        results = await asyncio.gather(
+            *(self.adapter.resume(a) for a in addrs)
+        )
+        return all(results)
 
-    def _scale_down_node(self, req: RecoveryRequest) -> None:
+    def _scale_down_node(self, req: RecoveryRequest) -> list[dict]:
+        """Returns the removed endpoint objects; the caller persists them
+        in the request status so a restarted IRO can still restore them."""
         raw = self._endpoints_raw()
         keep, removed = [], []
         for e in raw.get("endpoints", []):
@@ -111,14 +125,16 @@ class InferenceReconciler:
         if removed:
             raw["endpoints"] = keep
             self._write_endpoints(raw)
-            self._removed[req.name] = removed
             log.info(
                 "RecoveryRequest %s: removed %d endpoints on node %s from pool",
                 req.name, len(removed), req.node_name,
             )
+        return removed
 
     def _scale_up_node(self, req: RecoveryRequest) -> None:
-        removed = self._removed.pop(req.name, [])
+        removed = self._removed.pop(req.name, None)
+        if removed is None:
+            removed = req.removed_endpoints  # restart: persisted set
         if not removed:
             return
         raw = self._endpoints_raw()
@@ -152,10 +168,22 @@ class InferenceReconciler:
         ):
             # Engine-before-infrastructure: quiesce as soon as the request
             # exists, regardless of whether infra already started.
-            await self._pause_node(req)
+            if not await self._pause_node(req):
+                # No engine acknowledged: do NOT report quiesced (the
+                # infra controller would start resetting a live device);
+                # stay in NONE and retry next cycle.
+                log.warning(
+                    "RecoveryRequest %s: pause not acknowledged, retrying",
+                    req.name,
+                )
+                return
             if req.requested_action is RecoveryAction.REPLACE_NODE:
-                self._scale_down_node(req)
-                self._set(req, EngineState.SCALED_DOWN)
+                removed = self._scale_down_node(req)
+                self._removed[req.name] = removed
+                self._set(
+                    req, EngineState.SCALED_DOWN,
+                    extra_status={"removedEndpoints": removed},
+                )
             else:
                 self._set(req, EngineState.PAUSED)
             return
@@ -173,9 +201,14 @@ class InferenceReconciler:
                     await self._resume_node(req)
                 self._set(req, EngineState.FAILED)
 
-    def _set(self, req: RecoveryRequest, state: EngineState) -> None:
+    def _set(
+        self,
+        req: RecoveryRequest,
+        state: EngineState,
+        extra_status: dict | None = None,
+    ) -> None:
         self._acted[req.name] = state
-        self.store.update_engine_state(req.name, state)
+        self.store.update_engine_state(req.name, state, extra_status)
         log.info("RecoveryRequest %s: engineState -> %s", req.name, state.value)
 
     # ---------------------------------------------------------- loop

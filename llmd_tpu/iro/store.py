@@ -9,6 +9,8 @@ CRD watch instead — the store is the swapped layer.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import logging
 import os
@@ -19,8 +21,22 @@ log = logging.getLogger(__name__)
 
 
 class FileRecoveryStore:
+    """All access goes through an flock on a sibling .lock file; the
+    infrastructure controller must take the same lock for its writes or
+    concurrent read-modify-write cycles lose each other's fields."""
+
     def __init__(self, path: str) -> None:
         self.path = path
+        self._lock_path = path + ".lock"
+
+    @contextlib.contextmanager
+    def _locked(self):
+        with open(self._lock_path, "a+") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
 
     def _read_raw(self) -> dict:
         try:
@@ -34,25 +50,35 @@ class FileRecoveryStore:
 
     def list(self) -> list[RecoveryRequest]:
         out = []
-        for d in self._read_raw().get("requests", []):
+        with self._locked():
+            raw = self._read_raw()
+        for d in raw.get("requests", []):
             try:
                 out.append(RecoveryRequest.from_dict(d))
             except (ValueError, KeyError) as e:
                 log.warning("skipping malformed RecoveryRequest %r: %s", d, e)
         return out
 
-    def update_engine_state(self, name: str, engine_state) -> None:
-        """Read-modify-write of OUR status field only (phase belongs to
-        the infrastructure controller and is preserved as-is)."""
-        raw = self._read_raw()
-        for d in raw.get("requests", []):
-            if str(d.get("name") or d.get("metadata", {}).get("name", "")) == name:
-                d.setdefault("status", {})["engineState"] = (
-                    engine_state.value
-                    if hasattr(engine_state, "value")
-                    else str(engine_state)
-                )
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(raw, f, indent=2)
-        os.replace(tmp, self.path)
+    def update_engine_state(
+        self, name: str, engine_state, extra_status: dict | None = None
+    ) -> None:
+        """Read-modify-write of OUR status fields only (phase belongs to
+        the infrastructure controller and is preserved as-is).
+        extra_status persists IRO bookkeeping that must survive restarts
+        (e.g. the Track C removed-endpoints restore set)."""
+        with self._locked():
+            raw = self._read_raw()
+            for d in raw.get("requests", []):
+                if str(d.get("name") or d.get("metadata", {}).get("name", "")) == name:
+                    status = d.setdefault("status", {})
+                    status["engineState"] = (
+                        engine_state.value
+                        if hasattr(engine_state, "value")
+                        else str(engine_state)
+                    )
+                    for k, v in (extra_status or {}).items():
+                        status[k] = v
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(raw, f, indent=2)
+            os.replace(tmp, self.path)

@@ -46,17 +46,22 @@ class RecoveryRequest:
     error_code: str = ""      # observability only; IRO does not interpret it
     phase: Phase = Phase.PENDING
     engine_state: EngineState = EngineState.NONE
+    # Track C bookkeeping persisted in status: the endpoints IRO removed
+    # from the pool, so a restarted IRO can still restore them.
+    removed_endpoints: list = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "RecoveryRequest":
+        status = d.get("status", {})
         return cls(
             name=str(d.get("name") or d.get("metadata", {}).get("name", "")),
             node_name=str(d.get("nodeName", "")),
             requested_action=RecoveryAction(d.get("requestedAction", "RESET_DEVICE")),
             device_id=str(d.get("deviceID", "")),
             error_code=str(d.get("errorCode", "")),
-            phase=Phase(d.get("status", {}).get("phase", "Pending")),
-            engine_state=EngineState(d.get("status", {}).get("engineState", "")),
+            phase=Phase(status.get("phase", "Pending")),
+            engine_state=EngineState(status.get("engineState", "")),
+            removed_endpoints=list(status.get("removedEndpoints", [])),
         )
 
     def to_dict(self) -> dict:
@@ -69,5 +74,6 @@ class RecoveryRequest:
             "status": {
                 "phase": self.phase.value,
                 "engineState": self.engine_state.value,
+                "removedEndpoints": self.removed_endpoints,
             },
         }

@@ -614,6 +614,9 @@ async def handle_admin_drain(request: web.Request) -> web.Response:
 
 
 async def handle_admin_status(request: web.Request) -> web.Response:
+    denied = _admin_denied(request)
+    if denied is not None:
+        return denied
     engine = request.app[ENGINE_KEY]
     stats = engine.stats
     return web.json_response(

@@ -199,3 +199,45 @@ async def test_http_adapter_against_live_engine(tmp_path):
     finally:
         await adapter.close()
         await server.close()
+
+
+async def test_track_c_restore_survives_iro_restart(tmp_path):
+    rec_file = str(tmp_path / "recovery.json")
+    eps_file = str(tmp_path / "endpoints.json")
+    write_endpoints(eps_file, [
+        {"address": "a:1", "labels": {"llm-d.ai/node": "node1"}},
+        {"address": "b:1", "labels": {"llm-d.ai/node": "node2"}},
+    ])
+    adapter = FakeAdapter()
+    rec = InferenceReconciler(FileRecoveryStore(rec_file), adapter, eps_file)
+    write_recovery(rec_file, "rr4", "node1", "REPLACE_NODE")
+    await rec.reconcile_once()
+    assert json.load(open(rec_file))["requests"][0]["status"]["removedEndpoints"]
+    # IRO restarts: fresh reconciler, empty in-memory state
+    rec2 = InferenceReconciler(FileRecoveryStore(rec_file), FakeAdapter(), eps_file)
+    write_recovery(rec_file, "rr4", "node1", "REPLACE_NODE", phase="Completed")
+    await rec2.reconcile_once()
+    eps = json.load(open(eps_file))["endpoints"]
+    assert {e["address"] for e in eps} == {"a:1", "b:1"}  # restored
+
+
+async def test_pause_not_acknowledged_retries(tmp_path):
+    class DeadAdapter(FakeAdapter):
+        async def pause(self, address):
+            self.calls.append(("pause", address))
+            return False
+
+    rec_file = str(tmp_path / "recovery.json")
+    eps_file = str(tmp_path / "endpoints.json")
+    write_endpoints(eps_file, [
+        {"address": "a:1", "labels": {"llm-d.ai/node": "node1"}},
+    ])
+    adapter = DeadAdapter()
+    rec = InferenceReconciler(FileRecoveryStore(rec_file), adapter, eps_file)
+    write_recovery(rec_file, "rr5", "node1", "RESET_DEVICE")
+    await rec.reconcile_once()
+    # not acknowledged: state stays NONE (no engineState written)
+    st = json.load(open(rec_file))["requests"][0].get("status", {})
+    assert st.get("engineState", "") == ""
+    await rec.reconcile_once()  # retried
+    assert adapter.calls.count(("pause", "a:1")) == 2
