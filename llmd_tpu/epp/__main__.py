@@ -29,6 +29,11 @@ def main(argv=None) -> None:
     )
     p.add_argument("--k8s-namespace", default=None)
     p.add_argument("--k8s-target-port", type=int, default=8000)
+    p.add_argument(
+        "--k8s-poll-interval", type=float, default=2.0,
+        help="pod LIST poll period (apiserver load; separate from the "
+        "per-endpoint metrics --scrape-interval)",
+    )
     p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
     p.add_argument(
         "--preset", default="default",
@@ -137,7 +142,7 @@ def main(argv=None) -> None:
             label_selector=args.k8s_selector,
             namespace=args.k8s_namespace,
             target_port=args.k8s_target_port,
-            poll_s=args.scrape_interval,
+            poll_s=args.k8s_poll_interval,
         )
 
         async def _start_k8s(app):

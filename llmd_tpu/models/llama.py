@@ -113,7 +113,10 @@ def forward_hidden(
         q = apply_rope(q.reshape(B, Q, Nq, D), cos, sin)
         k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
         v = v.reshape(B, Q, K, D)
-        cache = write_kv_pages(cache, k, v, inp.page_table, inp.positions, valid)
+        cache = write_kv_pages(
+            cache, k, v, inp.page_table, inp.positions, valid,
+            world_size=world_size,
+        )
         attn = paged_attention(
             q, cache, inp.page_table, inp.kv_lens, inp.positions, sm_scale,
             world_size=world_size,

@@ -11,8 +11,11 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 
-from llmd_tpu.ops.paged_attention import paged_attention_xla, write_kv_pages  # noqa: F401
+from llmd_tpu.ops.paged_attention import paged_attention_xla
+from llmd_tpu.ops.paged_attention import write_kv_pages as write_kv_pages_xla
+from llmd_tpu.ops.kv_write import write_kv_pages_decode
 from llmd_tpu.ops.ragged_paged_attention import decode_paged_attention
 
 _TPU_PLATFORMS = {"tpu", "axon"}
@@ -27,6 +30,38 @@ def _on_tpu() -> bool:
         return jax.devices()[0].platform in _TPU_PLATFORMS
     except Exception:
         return False
+
+
+def write_kv_pages(kv_cache, k, v, page_table, positions, valid, world_size=1):
+    """Scatter this step's K/V into the paged cache.
+
+    Decode (Q==1) on TPU uses the Pallas in-place kernel — the XLA
+    scatter copies the whole pool per step under lax.scan (~12ms/step
+    for a 2048-page 3B pool); the kernel DMAs only the written slabs.
+    Prefill and non-TPU paths keep the XLA scatter.
+    """
+    mode = _mode()
+    B, Q, K, D = k.shape
+    num_pages, Kc, page, D2 = kv_cache.shape
+    kernel_ok = (
+        Q == 1
+        and D2 == 2 * D
+        and D2 % 128 == 0
+        and page % 8 == 0  # VMEM sublane tiling for the page-slab scratch
+        and mode != "off"
+        and world_size == 1
+    )
+    if kernel_ok and (mode == "interpret" or _on_tpu()):
+        kv_new = jnp.concatenate([k, v], axis=-1).reshape(B, K, D2)
+        pos = positions[:, 0]
+        phys = jnp.take_along_axis(
+            page_table, (pos // page)[:, None], axis=1
+        )[:, 0]
+        return write_kv_pages_decode(
+            kv_cache, kv_new, phys, pos % page, valid[:, 0],
+            interpret=(mode == "interpret"),
+        )
+    return write_kv_pages_xla(kv_cache, k, v, page_table, positions, valid)
 
 
 def paged_attention(

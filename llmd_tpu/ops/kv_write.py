@@ -1,0 +1,113 @@
+"""Pallas in-place KV cache write (decode path).
+
+The XLA scatter in ``write_kv_pages`` is not in-place under ``lax.scan``:
+every decode step copies the ENTIRE per-layer KV pool (read+write), which
+measured ~12ms/step for a 2048-page llama-3B pool on v5e — about 40% of
+the decode step. This kernel aliases the cache HBM buffer into the
+output (``input_output_aliases``) and issues one small DMA per token
+(the [K, 1, 2D] slab at its page/offset), so per-step traffic is the
+actual KV bytes (~1MB) instead of the pool size (GBs).
+
+Used for Q==1 (decode); prefill keeps the XLA scatter, whose pool copy
+amortizes over thousands of tokens per dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _write_kernel(
+    # scalar prefetch
+    phys_ref,    # [T] i32 physical page per token
+    offset_ref,  # [T] i32 in-page slot per token
+    valid_ref,   # [T] i32 (0/1)
+    # blocks
+    kv_new_ref,  # [1, K, 1, 2D] VMEM (this token's K/V slab)
+    kv_hbm_ref,  # [num_pages, K, page, 2D] ANY (aliased into out)
+    out_ref,     # same buffer as kv_hbm_ref
+    # scratch
+    page_buf,    # [K, page, 2D] VMEM
+):
+    """Read-modify-write of the token's page: a direct single-row DMA into
+    HBM violates the (8,128) sublane tiling, so the whole [K, page, 2D]
+    slab (~64KB) rides through VMEM. Precondition: tokens in one grid
+    launch target distinct pages (decode: one token per sequence, and the
+    allocator never shares a page across sequences)."""
+    t = pl.program_id(0)
+
+    def body(sem_in, sem_out):
+        @pl.when(valid_ref[t] != 0)
+        def _write():
+            load = pltpu.make_async_copy(
+                kv_hbm_ref.at[phys_ref[t]], page_buf, sem_in
+            )
+            load.start()
+            load.wait()
+            # Masked select instead of a dynamic-index store: Mosaic cannot
+            # prove sublane alignment for a runtime page offset.
+            rows = jax.lax.broadcasted_iota(jnp.int32, page_buf.shape, 1)
+            page_buf[:] = jnp.where(
+                rows == offset_ref[t], kv_new_ref[0], page_buf[:]
+            )
+            store = pltpu.make_async_copy(
+                page_buf, out_ref.at[phys_ref[t]], sem_out
+            )
+            store.start()
+            store.wait()
+
+    pl.run_scoped(
+        body,
+        sem_in=pltpu.SemaphoreType.DMA,
+        sem_out=pltpu.SemaphoreType.DMA,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
+def write_kv_pages_decode(
+    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
+    kv_new: jax.Array,    # [T, K, 2D] (K then V halves on the last axis)
+    phys: jax.Array,      # [T] i32
+    offset: jax.Array,    # [T] i32
+    valid: jax.Array,     # [T] bool/i32
+    interpret: bool = False,
+) -> jax.Array:
+    T, K, D2 = kv_new.shape
+    num_pages, Kc, page, D2c = kv_cache.shape
+    assert (K, D2) == (Kc, D2c), (kv_new.shape, kv_cache.shape)
+    kv_new4 = kv_new.reshape(T, K, 1, D2).astype(kv_cache.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, K, 1, D2), lambda t, p, o, v: (t, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.VMEM((Kc, page, D2), kv_cache.dtype)],
+    )
+    kernel = pl.pallas_call(
+        _write_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        # operand index counts scalar-prefetch args first: 3 scalars,
+        # kv_new, then kv_cache at index 4 -> aliased to output 0.
+        input_output_aliases={4: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+    return kernel(
+        phys.astype(jnp.int32),
+        offset.astype(jnp.int32),
+        valid.astype(jnp.int32),
+        kv_new4,
+        kv_cache,
+    )

@@ -55,3 +55,26 @@ def test_write_then_read_roundtrip():
     # invalid writes are dropped
     cache2 = write_kv_pages(cache, k + 1, v + 1, pt, positions, jnp.zeros((B, 1), bool))
     np.testing.assert_array_equal(np.asarray(cache2), np.asarray(cache))
+
+
+def test_write_kv_pages_decode_kernel_parity(monkeypatch):
+    """Pallas in-place KV write (interpret mode) == XLA scatter."""
+    import numpy as np
+
+    from llmd_tpu import ops
+
+    monkeypatch.setenv("LLMD_PALLAS", "interpret")
+    B, K, D, page, num_pages, max_pages = 6, 2, 128, 8, 32, 4
+    rng = np.random.default_rng(3)
+    cache0 = jnp.asarray(rng.random((num_pages, K, page, 2 * D)), jnp.float32)
+    k = jnp.asarray(rng.random((B, 1, K, D)), jnp.float32)
+    v = jnp.asarray(rng.random((B, 1, K, D)), jnp.float32)
+    # disjoint per-seq pages (the allocator invariant the kernel relies on)
+    pt = jnp.asarray(
+        (np.arange(B * max_pages).reshape(B, max_pages) % num_pages).astype(np.int32)
+    )
+    positions = jnp.asarray(rng.integers(0, page * max_pages, (B, 1)).astype(np.int32))
+    valid = jnp.asarray(np.array([True] * 4 + [False] * 2).reshape(B, 1))
+    ref = write_kv_pages(cache0, k, v, pt, positions, valid)
+    got = ops.write_kv_pages(cache0 + 0, k, v, pt, positions, valid)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got))
