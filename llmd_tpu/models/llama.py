@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from llmd_tpu.config import ModelConfig
 from llmd_tpu.models.common import StepInput, apply_rope, param_dtype, rms_norm, rope_tables
 from llmd_tpu.models.moe import moe_block
-from llmd_tpu.ops import paged_attention, write_kv_pages
+from llmd_tpu.ops import paged_attention_full, write_kv_pages_full
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
@@ -102,8 +102,12 @@ def forward_hidden(
     valid = inp.valid
     sm_scale = D**-0.5
 
-    def layer_fn(x, scanned):
-        lp, cache = scanned
+    # The cache rides the scan CARRY (not xs/ys): the layer-indexed
+    # kernels write/read cache[layer] in place, so no pool-sized slice
+    # ever materializes (the xs/ys form copied the pool every layer).
+    def layer_fn(carry, scanned):
+        x, cache = carry
+        lp, layer_idx = scanned
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q = h @ lp["wq"]
         k = h @ lp["wk"]
@@ -113,13 +117,13 @@ def forward_hidden(
         q = apply_rope(q.reshape(B, Q, Nq, D), cos, sin)
         k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
         v = v.reshape(B, Q, K, D)
-        cache = write_kv_pages(
-            cache, k, v, inp.page_table, inp.positions, valid,
+        cache = write_kv_pages_full(
+            cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
             world_size=world_size,
         )
-        attn = paged_attention(
-            q, cache, inp.page_table, inp.kv_lens, inp.positions, sm_scale,
-            world_size=world_size,
+        attn = paged_attention_full(
+            q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
+            sm_scale, world_size=world_size,
         )
         x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
@@ -134,9 +138,13 @@ def forward_hidden(
                 out = moe_block(h2, lp, cfg)
         else:
             out = _mlp(h2, lp)
-        return x + out, cache
+        return (x + out, cache), None
 
-    hidden, new_cache = jax.lax.scan(layer_fn, x, (params["layers"], kv_cache))
+    (hidden, new_cache), _ = jax.lax.scan(
+        layer_fn,
+        (x, kv_cache),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     return hidden, new_cache
 

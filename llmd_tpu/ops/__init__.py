@@ -1,9 +1,11 @@
 """TPU compute kernels (Pallas) and their XLA reference fallbacks.
 
-``paged_attention`` dispatches at trace time: the Pallas decode kernel on
-TPU-class backends for Q=1 with tile-compatible geometry, the XLA gather
-fallback otherwise. Env LLMD_PALLAS=off disables the kernel; =interpret
-forces interpret mode (CPU parity testing).
+``paged_attention`` / ``write_kv_pages`` (and their layer-indexed
+``*_full`` variants for the scan-carry cache layout) dispatch at trace
+time: the Pallas decode kernels on TPU-class backends for Q=1 with
+tile-compatible geometry, the XLA fallbacks otherwise. Env
+LLMD_PALLAS=off disables the kernels; =interpret forces interpret mode
+(CPU parity testing).
 """
 
 from __future__ import annotations
@@ -15,8 +17,14 @@ import jax.numpy as jnp
 
 from llmd_tpu.ops.paged_attention import paged_attention_xla
 from llmd_tpu.ops.paged_attention import write_kv_pages as write_kv_pages_xla
-from llmd_tpu.ops.kv_write import write_kv_pages_decode
-from llmd_tpu.ops.ragged_paged_attention import decode_paged_attention
+from llmd_tpu.ops.kv_write import (
+    write_kv_pages_decode,
+    write_kv_pages_decode_full,
+)
+from llmd_tpu.ops.ragged_paged_attention import (
+    decode_paged_attention,
+    decode_paged_attention_full,
+)
 
 _TPU_PLATFORMS = {"tpu", "axon"}
 
@@ -32,36 +40,86 @@ def _on_tpu() -> bool:
         return False
 
 
-def write_kv_pages(kv_cache, k, v, page_table, positions, valid, world_size=1):
-    """Scatter this step's K/V into the paged cache.
+def _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d: bool) -> bool:
+    """Single source of truth for the kernel gates.
 
-    Decode (Q==1) on TPU uses the Pallas in-place kernel — the XLA
-    scatter copies the whole pool per step under lax.scan (~12ms/step
-    for a 2048-page 3B pool); the kernel DMAs only the written slabs.
-    Prefill and non-TPU paths keep the XLA scatter.
+    Common constraints: decode shape (Q==1), sublane-tiled pages
+    (page % 8), packed K/V halves (D2 == 2D), kernels enabled, and an
+    unsharded mesh (no GSPMD rule for the kernels yet).
+    ``need_lane_d``: the ATTENTION kernel matmuls over D, so D itself
+    must be lane-tiled (D % 128); the WRITE kernel only moves [.., D2]
+    slabs, so D2 % 128 suffices (head_dim-64 models keep the in-place
+    write).
     """
     mode = _mode()
-    B, Q, K, D = k.shape
-    num_pages, Kc, page, D2 = kv_cache.shape
-    kernel_ok = (
+    if not (
         Q == 1
+        and page % 8 == 0
         and D2 == 2 * D
         and D2 % 128 == 0
-        and page % 8 == 0  # VMEM sublane tiling for the page-slab scratch
         and mode != "off"
         and world_size == 1
-    )
-    if kernel_ok and (mode == "interpret" or _on_tpu()):
-        kv_new = jnp.concatenate([k, v], axis=-1).reshape(B, K, D2)
-        pos = positions[:, 0]
-        phys = jnp.take_along_axis(
-            page_table, (pos // page)[:, None], axis=1
-        )[:, 0]
+    ):
+        return False
+    if need_lane_d and D % 128 != 0:
+        return False
+    return mode == "interpret" or _on_tpu()
+
+
+def _interpret() -> bool:
+    return _mode() == "interpret"
+
+
+def _decode_write_prep(k, v, page_table, positions, page):
+    """[B,1,K,D] k/v -> (kv_new [B,K,2D], phys [B], offset [B])."""
+    B, _, K, D = k.shape
+    kv_new = jnp.concatenate([k, v], axis=-1).reshape(B, K, 2 * D)
+    pos = positions[:, 0]
+    phys = jnp.take_along_axis(page_table, (pos // page)[:, None], axis=1)[:, 0]
+    return kv_new, phys, pos % page
+
+
+def write_kv_pages(kv_cache, k, v, page_table, positions, valid, world_size=1):
+    """Scatter this step's K/V into the (single-layer) paged cache.
+
+    Decode (Q==1) on TPU uses the Pallas in-place kernel — the XLA
+    scatter copies the whole pool per step when the buffer is not
+    donated; the kernel DMAs only the written slabs. Prefill and
+    non-TPU paths keep the XLA scatter.
+    """
+    B, Q, K, D = k.shape
+    num_pages, Kc, page, D2 = kv_cache.shape
+    if _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d=False):
+        kv_new, phys, offset = _decode_write_prep(k, v, page_table, positions, page)
         return write_kv_pages_decode(
-            kv_cache, kv_new, phys, pos % page, valid[:, 0],
-            interpret=(mode == "interpret"),
+            kv_cache, kv_new, phys, offset, valid[:, 0], interpret=_interpret()
         )
     return write_kv_pages_xla(kv_cache, k, v, page_table, positions, valid)
+
+
+def write_kv_pages_full(
+    kv_cache_full, layer, k, v, page_table, positions, valid, world_size=1
+):
+    """Layer-indexed write on the FULL [L, ...] cache (scan-carry layout).
+
+    The whole point: a lax.scan over layers that slices the cache pays a
+    pool-sized copy per layer (slice + update, or xs->ys buffers); the
+    Pallas variant indexes [layer, page] inside the kernel so only the
+    written slabs move. Fallback (CPU / prefill / sharded): dynamic
+    slice + XLA scatter + dynamic update — the carry-update pattern XLA
+    optimizes in place where it can.
+    """
+    B, Q, K, D = k.shape
+    L, num_pages, Kc, page, D2 = kv_cache_full.shape
+    if _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d=False):
+        kv_new, phys, offset = _decode_write_prep(k, v, page_table, positions, page)
+        return write_kv_pages_decode_full(
+            kv_cache_full, kv_new, layer, phys, offset, valid[:, 0],
+            interpret=_interpret(),
+        )
+    sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
+    sl = write_kv_pages_xla(sl, k, v, page_table, positions, valid)
+    return jax.lax.dynamic_update_index_in_dim(kv_cache_full, sl, layer, 0)
 
 
 def paged_attention(
@@ -71,23 +129,28 @@ def paged_attention(
     kernel has no GSPMD partitioning rule yet, so it only dispatches for
     world_size == 1 (a sharded jit would otherwise all-gather the KV pool or
     fail to lower); the shard_map-wrapped kernel for tp>1 is future work."""
-    mode = _mode()
     num_pages, K, page, D2 = kv_cache.shape
     D = q.shape[-1]
-    kernel_ok = (
-        q.shape[1] == 1
-        and D % 128 == 0
-        and page % 8 == 0
-        and D2 == 2 * D
-        and mode != "off"
-        and world_size == 1
-    )
-    if kernel_ok and mode == "interpret":
+    if _dispatch_kernel(q.shape[1], page, D, D2, world_size, need_lane_d=True):
         return decode_paged_attention(
-            q, kv_cache, page_table, kv_lens, sm_scale=sm_scale, interpret=True
-        )
-    if kernel_ok and _on_tpu():
-        return decode_paged_attention(
-            q, kv_cache, page_table, kv_lens, sm_scale=sm_scale
+            q, kv_cache, page_table, kv_lens, sm_scale=sm_scale,
+            interpret=_interpret(),
         )
     return paged_attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)
+
+
+def paged_attention_full(
+    q, kv_cache_full, layer, page_table, kv_lens, positions,
+    sm_scale=None, world_size=1,
+):
+    """Layer-indexed attention on the FULL [L, ...] cache (see
+    write_kv_pages_full)."""
+    L, num_pages, K, page, D2 = kv_cache_full.shape
+    D = q.shape[-1]
+    if _dispatch_kernel(q.shape[1], page, D, D2, world_size, need_lane_d=True):
+        return decode_paged_attention_full(
+            q, kv_cache_full, layer, page_table, kv_lens, sm_scale=sm_scale,
+            interpret=_interpret(),
+        )
+    sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
+    return paged_attention_xla(q, sl, page_table, kv_lens, positions, sm_scale)

@@ -24,12 +24,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _write_kernel(
     # scalar prefetch
+    layer_ref,   # [1] i32 layer index (full-cache variant; [0] otherwise)
     phys_ref,    # [T] i32 physical page per token
     offset_ref,  # [T] i32 in-page slot per token
     valid_ref,   # [T] i32 (0/1)
     # blocks
     kv_new_ref,  # [1, K, 1, 2D] VMEM (this token's K/V slab)
-    kv_hbm_ref,  # [num_pages, K, page, 2D] ANY (aliased into out)
+    kv_hbm_ref,  # [(L,) num_pages, K, page, 2D] ANY (aliased into out)
     out_ref,     # same buffer as kv_hbm_ref
     # scratch
     page_buf,    # [K, page, 2D] VMEM
@@ -40,12 +41,15 @@ def _write_kernel(
     launch target distinct pages (decode: one token per sequence, and the
     allocator never shares a page across sequences)."""
     t = pl.program_id(0)
+    is_full = len(kv_hbm_ref.shape) == 5
+    src = kv_hbm_ref.at[layer_ref[0]] if is_full else kv_hbm_ref
+    dst = out_ref.at[layer_ref[0]] if is_full else out_ref
 
     def body(sem_in, sem_out):
         @pl.when(valid_ref[t] != 0)
         def _write():
             load = pltpu.make_async_copy(
-                kv_hbm_ref.at[phys_ref[t]], page_buf, sem_in
+                src.at[phys_ref[t]], page_buf, sem_in
             )
             load.start()
             load.wait()
@@ -56,7 +60,7 @@ def _write_kernel(
                 rows == offset_ref[t], kv_new_ref[0], page_buf[:]
             )
             store = pltpu.make_async_copy(
-                page_buf, out_ref.at[phys_ref[t]], sem_out
+                page_buf, dst.at[phys_ref[t]], sem_out
             )
             store.start()
             store.wait()
@@ -65,6 +69,41 @@ def _write_kernel(
         body,
         sem_in=pltpu.SemaphoreType.DMA,
         sem_out=pltpu.SemaphoreType.DMA,
+    )
+
+
+def _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret):
+    T, K = kv_new4.shape[0], kv_new4.shape[1]
+    page, D2 = kv_cache.shape[-2], kv_cache.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, K, 1, D2), lambda t, l, p, o, v: (t, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.VMEM((K, page, D2), kv_cache.dtype)],
+    )
+    kernel = pl.pallas_call(
+        _write_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        # operand index counts scalar-prefetch args first: 4 scalars,
+        # kv_new, then kv_cache at index 5 -> aliased to output 0.
+        input_output_aliases={5: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+    return kernel(
+        layer.astype(jnp.int32).reshape(1),
+        phys.astype(jnp.int32),
+        offset.astype(jnp.int32),
+        valid.astype(jnp.int32),
+        kv_new4,
+        kv_cache,
     )
 
 
@@ -81,33 +120,27 @@ def write_kv_pages_decode(
     num_pages, Kc, page, D2c = kv_cache.shape
     assert (K, D2) == (Kc, D2c), (kv_new.shape, kv_cache.shape)
     kv_new4 = kv_new.reshape(T, K, 1, D2).astype(kv_cache.dtype)
+    return _write_call(
+        kv_cache, kv_new4, jnp.zeros((1,), jnp.int32), phys, offset, valid,
+        interpret,
+    )
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(T,),
-        in_specs=[
-            pl.BlockSpec((1, K, 1, D2), lambda t, p, o, v: (t, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
-        scratch_shapes=[pltpu.VMEM((Kc, page, D2), kv_cache.dtype)],
-    )
-    kernel = pl.pallas_call(
-        _write_kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
-        # operand index counts scalar-prefetch args first: 3 scalars,
-        # kv_new, then kv_cache at index 4 -> aliased to output 0.
-        input_output_aliases={4: 0},
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-        ),
-        interpret=interpret,
-    )
-    return kernel(
-        phys.astype(jnp.int32),
-        offset.astype(jnp.int32),
-        valid.astype(jnp.int32),
-        kv_new4,
-        kv_cache,
-    )
+
+def write_kv_pages_decode_full(
+    kv_cache: jax.Array,  # [L, num_pages, K, page, 2D] (whole model)
+    kv_new: jax.Array,    # [T, K, 2D]
+    layer: jax.Array,     # scalar i32
+    phys: jax.Array,      # [T] i32
+    offset: jax.Array,    # [T] i32
+    valid: jax.Array,     # [T] bool/i32
+    interpret: bool = False,
+) -> jax.Array:
+    """Layer-indexed variant: writes into cache[layer] with the FULL cache
+    aliased in place, so a scan over layers never slices (and never
+    copies) the pool. Called under an enclosing jit (the engine's step
+    programs); the caller owns donation of the full cache."""
+    T, K, D2 = kv_new.shape
+    L, num_pages, Kc, page, D2c = kv_cache.shape
+    assert (K, D2) == (Kc, D2c), (kv_new.shape, kv_cache.shape)
+    kv_new4 = kv_new.reshape(T, K, 1, D2).astype(kv_cache.dtype)
+    return _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret)

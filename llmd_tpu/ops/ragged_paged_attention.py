@@ -28,11 +28,12 @@ NEG_INF = -2.0**30
 
 def _decode_kernel(
     # scalar prefetch
+    layer_ref,  # [1] i32 layer index (full-cache variant; [0] otherwise)
     page_table_ref,  # [B, max_pages] i32
     kv_lens_ref,  # [B] i32
     # blocks
     q_ref,  # [1, K, G, D] VMEM
-    kv_hbm_ref,  # [num_pages, K, page, 2D] in HBM (unblocked)
+    kv_hbm_full_ref,  # [(L,) num_pages, K, page, 2D] in HBM (unblocked)
     out_ref,  # [1, K, G, D] VMEM
     # scratch
     m_ref,  # [K, G, 128] f32
@@ -45,6 +46,11 @@ def _decode_kernel(
     pages_per_block: int,
 ):
     b = pl.program_id(0)
+    kv_hbm_ref = (
+        kv_hbm_full_ref.at[layer_ref[0]]
+        if len(kv_hbm_full_ref.shape) == 5
+        else kv_hbm_full_ref
+    )
     D = head_dim
     K = q_ref.shape[1]
     ppb = pages_per_block
@@ -140,21 +146,13 @@ def _decode_kernel(
     out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret", "pages_per_block")
-)
-def decode_paged_attention(
-    q: jax.Array,  # [B, 1, H, D]
-    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
-    page_table: jax.Array,  # [B, max_pages] i32
-    kv_lens: jax.Array,  # [B] i32
-    sm_scale: float | None = None,
-    interpret: bool = False,
-    pages_per_block: int = 8,
-) -> jax.Array:
+def _decode_call(
+    q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
+    pages_per_block,
+):
     B, Q, H, D = q.shape
     assert Q == 1, "decode kernel handles Q=1"
-    num_pages, K, page, D2 = kv_cache.shape
+    K, page, D2 = kv_cache.shape[-3], kv_cache.shape[-2], kv_cache.shape[-1]
     assert D2 == 2 * D
     G = H // K
     if sm_scale is None:
@@ -168,13 +166,13 @@ def decode_paged_attention(
     qk = q.reshape(B, K, G, D)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, K, G, D), lambda b, pt, kl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl: (b, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
         ],
-        out_specs=pl.BlockSpec((1, K, G, D), lambda b, pt, kl: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl: (b, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((K, G, 128), jnp.float32),
             pltpu.VMEM((K, G, 128), jnp.float32),
@@ -196,5 +194,42 @@ def decode_paged_attention(
         ),
         interpret=interpret,
     )
-    out = kernel(page_table, kv_lens, qk, kv_cache)
+    out = kernel(layer.astype(jnp.int32).reshape(1), page_table, kv_lens, qk, kv_cache)
     return out.reshape(B, 1, H, D)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "interpret", "pages_per_block")
+)
+def decode_paged_attention(
+    q: jax.Array,  # [B, 1, H, D]
+    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
+    page_table: jax.Array,  # [B, max_pages] i32
+    kv_lens: jax.Array,  # [B] i32
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    pages_per_block: int = 8,
+) -> jax.Array:
+    return _decode_call(
+        q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
+        sm_scale, interpret, pages_per_block,
+    )
+
+
+def decode_paged_attention_full(
+    q: jax.Array,  # [B, 1, H, D]
+    kv_cache: jax.Array,  # [L, num_pages, K, page, 2D] (whole model)
+    layer: jax.Array,  # scalar i32
+    page_table: jax.Array,
+    kv_lens: jax.Array,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    pages_per_block: int = 8,
+) -> jax.Array:
+    """Layer-indexed variant: reads cache[layer] pages directly from the
+    full-cache HBM ref — a scan over layers never materializes a
+    pool-sized slice."""
+    return _decode_call(
+        q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
+        pages_per_block,
+    )

@@ -78,3 +78,40 @@ def test_write_kv_pages_decode_kernel_parity(monkeypatch):
     ref = write_kv_pages(cache0, k, v, pt, positions, valid)
     got = ops.write_kv_pages(cache0 + 0, k, v, pt, positions, valid)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got))
+
+
+def test_full_cache_kernels_parity(monkeypatch):
+    """Layer-indexed Pallas variants (interpret mode) == per-layer XLA path,
+    and other layers stay untouched."""
+    import numpy as np
+
+    from llmd_tpu import ops
+
+    monkeypatch.setenv("LLMD_PALLAS", "interpret")
+    L, B, K, D, page, num_pages, max_pages = 3, 4, 2, 128, 8, 48, 4
+    rng = np.random.default_rng(9)
+    cache0 = jnp.asarray(rng.random((L, num_pages, K, page, 2 * D)), jnp.float32)
+    k = jnp.asarray(rng.random((B, 1, K, D)), jnp.float32)
+    v = jnp.asarray(rng.random((B, 1, K, D)), jnp.float32)
+    pt = jnp.asarray(
+        (np.arange(B * max_pages).reshape(B, max_pages) % num_pages).astype(np.int32)
+    )
+    positions = jnp.asarray(rng.integers(0, page * max_pages, (B, 1)).astype(np.int32))
+    valid = jnp.asarray(np.ones((B, 1), bool))
+    layer = jnp.asarray(1, jnp.int32)
+
+    got = ops.write_kv_pages_full(cache0 + 0, layer, k, v, pt, positions, valid)
+    ref_layer = write_kv_pages(cache0[1], k, v, pt, positions, valid)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref_layer))
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(cache0[0]))
+    np.testing.assert_allclose(np.asarray(got[2]), np.asarray(cache0[2]))
+
+    q = jnp.asarray(rng.random((B, 1, 2 * K, D)), jnp.float32)
+    kv_lens = jnp.asarray(rng.integers(1, page * max_pages, B).astype(np.int32))
+    attn_full = ops.paged_attention_full(
+        q, got, layer, pt, kv_lens, positions
+    )
+    attn_ref = paged_attention_xla(q, got[1], pt, kv_lens, positions)
+    np.testing.assert_allclose(
+        np.asarray(attn_full), np.asarray(attn_ref), rtol=2e-5, atol=2e-5
+    )
