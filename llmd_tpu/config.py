@@ -46,16 +46,54 @@ class ModelConfig:
     first_dense_layers: int = 0
     # Shared expert intermediate size (DeepSeek V2/V3 style); 0 = none.
     shared_expert_intermediate_size: int = 0
+    # --- MLA (multi-head latent attention, DeepSeek V2/V3/R1) ---
+    # kv_lora_rank > 0 switches attention to MLA: the KV cache stores one
+    # compressed latent per token (kv_lora_rank + qk_rope_head_dim wide)
+    # instead of per-head K/V — the memory win that makes wide-EP decode
+    # batches fit. q_lora_rank 0 = dense q projection (V2-Lite).
+    kv_lora_rank: int = 0
+    q_lora_rank: int = 0
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
 
     def __post_init__(self) -> None:
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
         if self.moe_intermediate_size is None:
             self.moe_intermediate_size = self.intermediate_size
+        if self.kv_lora_rank > 0 and self.attention_bias:
+            raise ValueError(
+                "attention_bias is not supported with MLA (kv_lora_rank > 0): "
+                "no known MLA architecture uses QKV biases and the MLA "
+                "forward would silently ignore them"
+            )
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def mla_latent_dim(self) -> int:
+        """Unpadded latent width cached per token."""
+        return self.kv_lora_rank + self.qk_rope_head_dim
+
+    @property
+    def kv_cache_heads(self) -> int:
+        """KV head count in the paged cache (MLA: one latent 'head')."""
+        return 1 if self.is_mla else self.num_kv_heads
+
+    @property
+    def kv_cache_entry_dim(self) -> int:
+        """Last-axis width of one cache row: 2*head_dim for K/V pairs,
+        the latent width padded to the 128 lane tiling for MLA."""
+        if self.is_mla:
+            return ((self.mla_latent_dim + 127) // 128) * 128
+        return 2 * self.head_dim
 
 
 @dataclasses.dataclass

@@ -108,11 +108,17 @@ class ModelRunner:
         shape = (
             self.cfg.num_layers,
             c.num_blocks,
-            self.cfg.num_kv_heads,
+            self.cfg.kv_cache_heads,  # MLA: one latent "head"
             c.page_size,
-            2 * self.cfg.head_dim,
+            self.cfg.kv_cache_entry_dim,
         )
-        spec = kv_cache_spec(self.cfg.num_kv_heads, self.ctx.tp)
+        if self.cfg.is_mla:
+            # The latent pool replicates across tp BY DESIGN: rows are a
+            # few hundred bytes and every head reads the same latent —
+            # not the GQA mis-configuration kv_cache_spec warns about.
+            spec = jax.sharding.PartitionSpec()
+        else:
+            spec = kv_cache_spec(self.cfg.kv_cache_heads, self.ctx.tp)
         return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*spec))
 
     def kv_bytes(self) -> int:

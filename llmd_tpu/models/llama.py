@@ -38,39 +38,67 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
             scale = shape[-2] ** -0.5 if len(shape) >= 2 else 1.0
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
 
-    layers: dict[str, jax.Array] = {
-        "input_norm": jnp.ones((L, H), dt),
-        "post_norm": jnp.ones((L, H), dt),
-        "wq": mk("wq", (L, H, Nq * D)),
-        "wk": mk("wk", (L, H, K * D)),
-        "wv": mk("wv", (L, H, K * D)),
-        "wo": mk("wo", (L, Nq * D, H)),
-    }
-    if cfg.attention_bias:
-        layers["bq"] = jnp.zeros((L, Nq * D), dt)
-        layers["bk"] = jnp.zeros((L, K * D), dt)
-        layers["bv"] = jnp.zeros((L, K * D), dt)
-    if cfg.is_moe:
-        E, Fm = cfg.num_experts, cfg.moe_intermediate_size
-        layers["router"] = mk("router", (L, H, E), scale=H**-0.5)
-        layers["we_gate"] = mk("we_gate", (L, E, H, Fm))
-        layers["we_up"] = mk("we_up", (L, E, H, Fm))
-        layers["we_down"] = mk("we_down", (L, E, Fm, H))
-        if cfg.shared_expert_intermediate_size:
-            Fs = cfg.shared_expert_intermediate_size
-            layers["ws_gate"] = mk("ws_gate", (L, H, Fs))
-            layers["ws_up"] = mk("ws_up", (L, H, Fs))
-            layers["ws_down"] = mk("ws_down", (L, Fs, H))
-    else:
-        layers["w_gate"] = mk("w_gate", (L, H, F))
-        layers["w_up"] = mk("w_up", (L, H, F))
-        layers["w_down"] = mk("w_down", (L, F, H))
+    def layer_stack(n: int, moe: bool, prefix: str = "") -> dict[str, jax.Array]:
+        """n stacked layers: attention (MLA or GQA) + dense-MLP or MoE."""
 
+        def mkp(name, shape, scale=None):
+            return mk(prefix + name, shape, scale)
+
+        layers: dict[str, jax.Array] = {
+            "input_norm": jnp.ones((n, H), dt),
+            "post_norm": jnp.ones((n, H), dt),
+        }
+        if cfg.is_mla:
+            nope, rope, vd = (
+                cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim,
+            )
+            rank = cfg.kv_lora_rank
+            layers["wkv_a"] = mkp("wkv_a", (n, H, rank + rope))
+            layers["kv_norm"] = jnp.ones((n, rank), dt)
+            layers["wkv_b"] = mkp("wkv_b", (n, rank, Nq * (nope + vd)))
+            layers["wo"] = mkp("wo", (n, Nq * vd, H))
+            if cfg.q_lora_rank > 0:
+                layers["wq_a"] = mkp("wq_a", (n, H, cfg.q_lora_rank))
+                layers["q_norm"] = jnp.ones((n, cfg.q_lora_rank), dt)
+                layers["wq_b"] = mkp(
+                    "wq_b", (n, cfg.q_lora_rank, Nq * (nope + rope))
+                )
+            else:
+                layers["wq"] = mkp("wq", (n, H, Nq * (nope + rope)))
+        else:
+            layers["wq"] = mkp("wq", (n, H, Nq * D))
+            layers["wk"] = mkp("wk", (n, H, K * D))
+            layers["wv"] = mkp("wv", (n, H, K * D))
+            layers["wo"] = mkp("wo", (n, Nq * D, H))
+        if cfg.attention_bias:
+            layers["bq"] = jnp.zeros((n, Nq * D), dt)
+            layers["bk"] = jnp.zeros((n, K * D), dt)
+            layers["bv"] = jnp.zeros((n, K * D), dt)
+        if moe:
+            E, Fm = cfg.num_experts, cfg.moe_intermediate_size
+            layers["router"] = mkp("router", (n, H, E), scale=H**-0.5)
+            layers["we_gate"] = mkp("we_gate", (n, E, H, Fm))
+            layers["we_up"] = mkp("we_up", (n, E, H, Fm))
+            layers["we_down"] = mkp("we_down", (n, E, Fm, H))
+            if cfg.shared_expert_intermediate_size:
+                Fs = cfg.shared_expert_intermediate_size
+                layers["ws_gate"] = mkp("ws_gate", (n, H, Fs))
+                layers["ws_up"] = mkp("ws_up", (n, H, Fs))
+                layers["ws_down"] = mkp("ws_down", (n, Fs, H))
+        else:
+            layers["w_gate"] = mkp("w_gate", (n, H, F))
+            layers["w_up"] = mkp("w_up", (n, H, F))
+            layers["w_down"] = mkp("w_down", (n, F, H))
+        return layers
+
+    n_dense = cfg.first_dense_layers if cfg.is_moe else 0
     params: dict = {
         "embed": mk("embed", (V, H), scale=0.02),
-        "layers": layers,
+        "layers": layer_stack(L - n_dense, moe=cfg.is_moe),
         "final_norm": jnp.ones((H,), dt),
     }
+    if n_dense:
+        params["dense_layers"] = layer_stack(n_dense, moe=False, prefix="dense_")
     if not cfg.tie_word_embeddings:
         params["lm_head"] = mk("lm_head", (H, V))
     return params
@@ -102,32 +130,35 @@ def forward_hidden(
     valid = inp.valid
     sm_scale = D**-0.5
 
-    # The cache rides the scan CARRY (not xs/ys): the layer-indexed
-    # kernels write/read cache[layer] in place, so no pool-sized slice
-    # ever materializes (the xs/ys form copied the pool every layer).
-    def layer_fn(carry, scanned):
-        x, cache = carry
-        lp, layer_idx = scanned
+    def layer_body(x, cache, lp, layer_idx, use_moe: bool):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
-        if cfg.attention_bias:
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = apply_rope(q.reshape(B, Q, Nq, D), cos, sin)
-        k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
-        v = v.reshape(B, Q, K, D)
-        cache = write_kv_pages_full(
-            cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
-            world_size=world_size,
-        )
-        attn = paged_attention_full(
-            q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
-            sm_scale, world_size=world_size,
-        )
-        x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
+        if cfg.is_mla:
+            from llmd_tpu.models.mla import mla_attention
+
+            attn_out, cache = mla_attention(
+                h, lp, cache, layer_idx, inp, cfg, world_size=world_size
+            )
+            x = x + attn_out
+        else:
+            q = h @ lp["wq"]
+            k = h @ lp["wk"]
+            v = h @ lp["wv"]
+            if cfg.attention_bias:
+                q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            q = apply_rope(q.reshape(B, Q, Nq, D), cos, sin)
+            k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
+            v = v.reshape(B, Q, K, D)
+            cache = write_kv_pages_full(
+                cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
+                world_size=world_size,
+            )
+            attn = paged_attention_full(
+                q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
+                sm_scale, world_size=world_size,
+            )
+            x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        if cfg.is_moe:
+        if use_moe:
             if moe_backend == "ep":
                 from llmd_tpu.parallel.moe_ep import moe_block_ep
 
@@ -138,12 +169,33 @@ def forward_hidden(
                 out = moe_block(h2, lp, cfg)
         else:
             out = _mlp(h2, lp)
-        return (x + out, cache), None
+        return x + out, cache
+
+    # DeepSeek-style dense prefix: the first N layers (N static, 1-3)
+    # run unrolled with their own dense-MLP weights; the homogeneous MoE
+    # (or dense) remainder rides ONE lax.scan with the cache as CARRY —
+    # the layer-indexed kernels write/read cache[layer] in place so no
+    # pool-sized slice ever materializes.
+    n_dense = cfg.first_dense_layers if cfg.is_moe else 0
+    for i in range(n_dense):
+        lp_i = jax.tree.map(lambda a: a[i], params["dense_layers"])
+        x, kv_cache = layer_body(
+            x, kv_cache, lp_i, jnp.int32(i), use_moe=False
+        )
+
+    def layer_fn(carry, scanned):
+        x, cache = carry
+        lp, layer_idx = scanned
+        x, cache = layer_body(x, cache, lp, layer_idx, use_moe=cfg.is_moe)
+        return (x, cache), None
 
     (hidden, new_cache), _ = jax.lax.scan(
         layer_fn,
         (x, kv_cache),
-        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+        (
+            params["layers"],
+            jnp.arange(n_dense, cfg.num_layers, dtype=jnp.int32),
+        ),
     )
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     return hidden, new_cache

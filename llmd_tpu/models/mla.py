@@ -1,0 +1,133 @@
+"""Multi-head latent attention (DeepSeek V2/V3/R1 family).
+
+The attention half of the DeepSeek architecture the reference's wide-EP
+guides deploy (SURVEY.md §2.4: DeepSeek-R1 on 16P+16D; wide-EP MoE
+lives in llmd_tpu/parallel/moe_ep.py — MLA is what makes its decode
+batches fit by caching one compressed latent per token).
+
+Projections (HF naming in comments):
+  q:  x -> [q_lora_rank] -> norm -> heads x (nope + rope)   (q_a/q_b)
+      or dense x -> heads x (nope + rope) when q_lora_rank == 0
+  kv: x -> [kv_lora_rank + rope]                            (kv_a)
+      latent = [rmsnorm(c_kv), rope(k_pe)]   <- THE CACHED ROW
+      kv_b: [kv_lora_rank] -> heads x (nope + v)
+Decode uses weight absorption: fold kv_b's key half into the query
+(q_eff = [q_nope @ W_uk, q_pe]) and its value half into the output
+(out = attn_latent @ W_uv), so attention itself never materializes
+per-head K/V — it runs against the latent cache directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from llmd_tpu.config import ModelConfig
+from llmd_tpu.models.common import StepInput, apply_rope, rms_norm, rope_tables
+from llmd_tpu.ops import mla_paged_attention_full, write_kv_pages_full
+
+
+def mla_attention(
+    h: jax.Array,          # [B, Q, H] (already input-normed)
+    lp: dict,              # this layer's params
+    cache: jax.Array,      # FULL [L, pages, 1, page, Dl]
+    layer_idx: jax.Array,  # scalar i32
+    inp: StepInput,
+    cfg: ModelConfig,
+    world_size: int = 1,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (attn output [B, Q, H_hidden], updated cache)."""
+    B, Q, _ = h.shape
+    nh = cfg.num_heads
+    nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    rank = cfg.kv_lora_rank
+    Dl = cfg.kv_cache_entry_dim
+    # MLA scales by the FULL qk head dim (nope + rope), not the latent.
+    sm_scale = (nope + rope) ** -0.5
+    cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta)
+
+    # ---- queries
+    if cfg.q_lora_rank > 0:
+        q = rms_norm(h @ lp["wq_a"], lp["q_norm"], cfg.rms_norm_eps) @ lp["wq_b"]
+    else:
+        q = h @ lp["wq"]
+    q = q.reshape(B, Q, nh, nope + rope)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    q_pe = apply_rope(q_pe, cos, sin)
+
+    # ---- latent (the cached row)
+    kv_a = h @ lp["wkv_a"]  # [B, Q, rank + rope]
+    c_kv = rms_norm(kv_a[..., :rank], lp["kv_norm"], cfg.rms_norm_eps)
+    k_pe = apply_rope(kv_a[..., None, rank:], cos, sin)[:, :, 0]  # shared head
+    latent = jnp.concatenate([c_kv, k_pe], axis=-1)
+    if Dl > rank + rope:
+        latent = jnp.pad(latent, ((0, 0), (0, 0), (0, Dl - rank - rope)))
+    # Write through the generic page writer: split the row into two
+    # halves posing as K/V — the writer just concatenates them back.
+    half = Dl // 2
+    lat4 = latent[:, :, None, :]  # [B, Q, 1, Dl]
+    cache = write_kv_pages_full(
+        cache, layer_idx, lat4[..., :half], lat4[..., half:],
+        inp.page_table, inp.positions, inp.valid, world_size=world_size,
+    )
+
+    # ---- absorption: W_uk [nh, rank, nope], W_uv [nh, rank, vd]
+    wkv_b = lp["wkv_b"].reshape(rank, nh, nope + vd)
+    w_uk = wkv_b[..., :nope].transpose(1, 0, 2)  # [nh, rank, nope]
+    w_uv = wkv_b[..., nope:].transpose(1, 0, 2)  # [nh, rank, vd]
+    q_eff_nope = jnp.einsum("bqhn,hrn->bqhr", q_nope, w_uk)
+    q_eff = jnp.concatenate([q_eff_nope, q_pe], axis=-1)  # [B, Q, nh, rank+rope]
+    if Dl > rank + rope:
+        q_eff = jnp.pad(q_eff, ((0, 0), (0, 0), (0, 0), (0, Dl - rank - rope)))
+
+    # ---- latent attention against cache[layer] (Pallas on TPU decode:
+    # streams live pages; never slices the pool)
+    out_lat = mla_paged_attention_full(
+        q_eff, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
+        rank=rank, sm_scale=sm_scale, world_size=world_size,
+    )  # [B, Q, nh, rank]
+    out = jnp.einsum("bqhr,hrv->bqhv", out_lat, w_uv)  # [B, Q, nh, vd]
+    return out.reshape(B, Q, nh * vd) @ lp["wo"], cache
+
+
+def mla_reference_attention(
+    h: jax.Array,
+    lp: dict,
+    inp: StepInput,
+    cfg: ModelConfig,
+    context_latent: jax.Array,  # [B, S, rank+rope] unnormalized? no: cached latents
+) -> jax.Array:
+    """Numerical oracle WITHOUT absorption: materialize per-head K/V from
+    the context latents and run standard masked attention. Used by tests
+    to validate the absorbed/paged path."""
+    B, Q, _ = h.shape
+    nh = cfg.num_heads
+    nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    rank = cfg.kv_lora_rank
+    sm_scale = (nope + rope) ** -0.5
+    cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta)
+
+    if cfg.q_lora_rank > 0:
+        q = rms_norm(h @ lp["wq_a"], lp["q_norm"], cfg.rms_norm_eps) @ lp["wq_b"]
+    else:
+        q = h @ lp["wq"]
+    q = q.reshape(B, Q, nh, nope + rope)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    q_pe = apply_rope(q_pe, cos, sin)
+
+    S = context_latent.shape[1]
+    c_kv = context_latent[..., :rank]          # already normed when cached
+    k_pe = context_latent[..., rank : rank + rope]
+    wkv_b = lp["wkv_b"].reshape(rank, nh, nope + vd)
+    k_nope = jnp.einsum("bsr,rhn->bshn", c_kv, wkv_b[..., :nope])
+    v = jnp.einsum("bsr,rhv->bshv", c_kv, wkv_b[..., nope:])
+    scores = (
+        jnp.einsum("bqhn,bshn->bhqs", q_nope, k_nope, preferred_element_type=jnp.float32)
+        + jnp.einsum("bqhr,bsr->bhqs", q_pe, k_pe, preferred_element_type=jnp.float32)
+    ) * sm_scale
+    key_pos = jnp.arange(S)[None, None, :]
+    mask = (key_pos <= inp.positions[:, :, None])[:, None, :, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqs,bshv->bqhv", probs, v)
+    return out.reshape(B, Q, nh * vd) @ lp["wo"]

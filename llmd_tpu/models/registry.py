@@ -60,6 +60,18 @@ def _tiny_moe() -> ModelConfig:
     )
 
 
+@register_model("tiny-mla")
+def _tiny_mla() -> ModelConfig:
+    """CPU-testable MLA+MoE shape (DeepSeek architecture in miniature)."""
+    return tiny_model_config(
+        name="tiny-mla", kv_lora_rank=32, q_lora_rank=24,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        shared_expert_intermediate_size=32, first_dense_layers=1,
+        num_layers=3,
+    )
+
+
 @register_model("llama-3.2-3b")
 def _llama32_3b() -> ModelConfig:
     return ModelConfig(
@@ -129,4 +141,35 @@ def _deepseek_wide() -> ModelConfig:
         rope_theta=10000.0, max_model_len=16384,
         num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
         shared_expert_intermediate_size=2048,
+    )
+
+
+@register_model("deepseek-v2-lite")
+def _deepseek_v2_lite() -> ModelConfig:
+    """DeepSeek-V2-Lite (HF deepseek-ai/DeepSeek-V2-Lite): MLA without a
+    query LoRA, 64 routed + 2 shared experts, first layer dense."""
+    return ModelConfig(
+        name="deepseek-v2-lite", vocab_size=102400, hidden_size=2048,
+        intermediate_size=10944, num_layers=27, num_heads=16,
+        num_kv_heads=16, rope_theta=10000.0, max_model_len=32768,
+        kv_lora_rank=512, q_lora_rank=0,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        num_experts=64, num_experts_per_tok=6, moe_intermediate_size=1408,
+        shared_expert_intermediate_size=2816, first_dense_layers=1,
+    )
+
+
+@register_model("deepseek-r1")
+def _deepseek_r1() -> ModelConfig:
+    """DeepSeek-V3/R1 (HF deepseek-ai/DeepSeek-R1): full MLA (q LoRA 1536,
+    kv latent 512+64), 256 routed + 1 shared expert, top-8, first 3 layers
+    dense -- the reference wide-EP headline model (SURVEY.md §3.3)."""
+    return ModelConfig(
+        name="deepseek-r1", vocab_size=129280, hidden_size=7168,
+        intermediate_size=18432, num_layers=61, num_heads=128,
+        num_kv_heads=128, rope_theta=10000.0, max_model_len=163840,
+        kv_lora_rank=512, q_lora_rank=1536,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
+        shared_expert_intermediate_size=2048, first_dense_layers=3,
     )

@@ -139,6 +139,41 @@ def paged_attention(
     return paged_attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)
 
 
+def mla_paged_attention_full(
+    q_eff, latent_cache_full, layer, page_table, kv_lens, positions,
+    rank, sm_scale, world_size=1,
+):
+    """Layer-indexed MLA latent attention on the FULL [L, ...] cache.
+
+    Pallas for decode (Q==1, lane-tiled latent width); XLA gather
+    fallback otherwise (prefill, CPU, sharded). Returns [B, Q, H, rank].
+    """
+    from llmd_tpu.ops.mla_attention import mla_paged_attention_xla
+    from llmd_tpu.ops.mla_decode import mla_decode_paged_attention_full
+
+    L, num_pages, one, page, Dl = latent_cache_full.shape
+    mode = _mode()
+    kernel_ok = (
+        q_eff.shape[1] == 1
+        and page % 8 == 0
+        and Dl % 128 == 0
+        and rank % 128 == 0
+        and mode != "off"
+        and world_size == 1
+    )
+    if kernel_ok and (mode == "interpret" or _on_tpu()):
+        return mla_decode_paged_attention_full(
+            q_eff, latent_cache_full, layer, page_table, kv_lens,
+            rank=rank, sm_scale=sm_scale, interpret=_interpret(),
+        )
+    sl = jax.lax.dynamic_index_in_dim(
+        latent_cache_full, layer, 0, keepdims=False
+    )
+    return mla_paged_attention_xla(
+        q_eff, sl, page_table, kv_lens, positions, rank=rank, sm_scale=sm_scale
+    )
+
+
 def paged_attention_full(
     q, kv_cache_full, layer, page_table, kv_lens, positions,
     sm_scale=None, world_size=1,

@@ -1,0 +1,57 @@
+"""Latent paged attention for MLA (DeepSeek V2/V3/R1-style).
+
+With weight absorption, MLA decode attention runs entirely in the
+compressed latent space: queries are projected to
+q_eff = [q_nope @ W_uk, q_pe] (per head), keys ARE the cached latents
+[c_kv, k_pe], and values are the first kv_lora_rank components of the
+same latent. One cache row serves every head — MQA with a wide head —
+so the pool stores latent_dim bytes/token instead of
+2 * num_kv_heads * head_dim (e.g. DeepSeek-V3: 576 vs 32768 per token).
+
+Layout matches the engine pool: latent_cache [num_pages, 1, page, Dl]
+(Dl = latent width padded to lane tiling; padding columns are zero and
+drop out of both the dot products and the value slice).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mla_paged_attention_xla(
+    q_eff: jax.Array,       # [B, Q, H, Dl] (zero-padded past latent_dim)
+    latent_cache: jax.Array,  # [num_pages, 1, page, Dl]
+    page_table: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,     # [B]
+    positions: jax.Array,   # [B, Q]
+    rank: int,              # kv_lora_rank: value = latent[..., :rank]
+    sm_scale: float,
+) -> jax.Array:
+    """Reference implementation: gather the context latents, masked
+    softmax, value contraction over the rank slice. Returns
+    [B, Q, H, rank]."""
+    B, Q, H, Dl = q_eff.shape
+    num_pages, one, page, Dlc = latent_cache.shape
+    assert Dl == Dlc, (Dl, Dlc)
+    S = page_table.shape[1] * page
+
+    lat = latent_cache[page_table]  # [B, max_pages, 1, page, Dl]
+    lat = lat.reshape(B, S, Dl)
+    scores = (
+        jnp.einsum("bqhd,bsd->bhqs", q_eff, lat, preferred_element_type=jnp.float32)
+        * sm_scale
+    )
+    key_pos = jnp.arange(S)[None, None, :]
+    causal = key_pos <= positions[:, :, None]          # [B, Q, S]
+    in_ctx = key_pos < kv_lens[:, None, None]          # [B, 1, S]
+    mask = (causal & in_ctx)[:, None, :, :]            # [B, 1, Q, S]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqs,bsr->bqhr",
+        probs.astype(lat.dtype),
+        lat[..., :rank],
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q_eff.dtype)

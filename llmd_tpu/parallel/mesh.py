@@ -110,6 +110,16 @@ PARAM_SPECS: dict[str, P] = {
     "ws_gate": P(None, None, TP_AXIS),   # shared expert, TP like dense mlp
     "ws_up": P(None, None, TP_AXIS),
     "ws_down": P(None, TP_AXIS, None),
+    # MLA (DeepSeek family): down-projections + latent norms replicated
+    # (latent is shared by all heads); per-head up-projections column-
+    # sharded, output row-parallel. The latent KV cache replicates across
+    # tp (kv_cache_heads == 1) — its small row width is the point.
+    "wkv_a": P(None, None, None),        # [L, H, rank+rope]
+    "kv_norm": P(None, None),
+    "wkv_b": P(None, None, TP_AXIS),     # [L, rank, nh*(nope+v)] head-sharded
+    "wq_a": P(None, None, None),         # [L, H, q_rank]
+    "q_norm": P(None, None),
+    "wq_b": P(None, None, TP_AXIS),      # [L, q_rank, nh*(nope+rope)]
 }
 
 # KV cache [L, num_pages, K, page, 2D] (head-major within a page so one
