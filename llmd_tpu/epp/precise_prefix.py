@@ -136,11 +136,39 @@ class PrecisePrefixCacheScorer(Scorer):
         index: KVBlockIndex | None = None,
         max_blocks_per_pod: int = 131072,
         speculative_ttl_s: float = 2.0,
+        backend: str = "lru",
+        redis_host: str = "127.0.0.1",
+        redis_port: int = 6379,
     ) -> None:
-        self.index = index or KVBlockIndex(
-            max_blocks_per_pod=max_blocks_per_pod,
-            speculative_ttl_s=speculative_ttl_s,
-        )
+        """backend: the reference's three indexer backends
+        (kv-indexer.md:59-151) — `lru` (in-memory two-level), `cost-aware`
+        (frequency-sketch eviction), `redis` (shared Redis/Valkey)."""
+        if index is None:
+            if backend == "redis":
+                from llmd_tpu.events.redis_index import RedisKVBlockIndex
+
+                index = RedisKVBlockIndex(
+                    host=redis_host, port=redis_port,
+                    speculative_ttl_s=speculative_ttl_s,
+                )
+            elif backend == "cost-aware":
+                from llmd_tpu.events.index import CostAwareKVBlockIndex
+
+                index = CostAwareKVBlockIndex(
+                    max_blocks_per_pod=max_blocks_per_pod,
+                    speculative_ttl_s=speculative_ttl_s,
+                )
+            elif backend == "lru":
+                index = KVBlockIndex(
+                    max_blocks_per_pod=max_blocks_per_pod,
+                    speculative_ttl_s=speculative_ttl_s,
+                )
+            else:
+                raise ValueError(
+                    f"unknown index backend {backend!r} "
+                    "(expected lru | cost-aware | redis)"
+                )
+        self.index = index
 
     def score(self, req: LLMRequest, pods: list[Endpoint]) -> dict[str, float]:
         hashes = req.scratch.get(SCRATCH_BLOCK_HASHES)
@@ -182,6 +210,27 @@ def attach_precise_routing(router, default_events_port: int = DEFAULT_EVENTS_POR
         router.store, scorers[0].index, default_port=default_events_port
     )
     router.closables.append(source)
+    router.closables.append(scorers[0].index)  # redis backend owns a socket
+
+    # Prefix-indexer self-metrics (reference scheduling.md:161-191:
+    # indexer size / hit ratio).
+    index = scorers[0].index
+
+    def render_index_metrics() -> str:
+        st = index.stats()
+        lines = [
+            "# TYPE llm_d_epp_prefix_index_blocks gauge",
+            f"llm_d_epp_prefix_index_blocks {st.get('blocks', 0)}",
+            "# TYPE llm_d_epp_prefix_index_events_total counter",
+            f"llm_d_epp_prefix_index_events_total {st.get('events', 0)}",
+            "# TYPE llm_d_epp_prefix_index_lookups_total counter",
+            f"llm_d_epp_prefix_index_lookups_total {st.get('lookups', 0)}",
+            "# TYPE llm_d_epp_prefix_index_hits_total counter",
+            f"llm_d_epp_prefix_index_hits_total {st.get('hits', 0)}",
+        ]
+        return "\n".join(lines)
+
+    router.metric_extras.append(render_index_metrics)
     return source
 
 

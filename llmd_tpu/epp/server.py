@@ -134,6 +134,9 @@ class Router:
         # predictor clients...); closed on app cleanup.
         self.closables: list = []
         self.metrics = RouterMetrics()
+        # Extra /metrics sections from attached subsystems (prefix index,
+        # predictors...): callables returning Prometheus text.
+        self.metric_extras: list = []
         self.request_timeout_s = request_timeout_s
         self.max_schedule_attempts = max_schedule_attempts
         # Parser for paths outside the OpenAI/vllm-gRPC sets
@@ -450,8 +453,14 @@ class Router:
         )
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        parts = [self.metrics.render(self.store, self.flow)]
+        for extra in self.metric_extras:
+            try:
+                parts.append(extra())
+            except Exception:
+                log.exception("extra metrics renderer failed")
         return web.Response(
-            text=self.metrics.render(self.store, self.flow),
+            text="\n".join(p.strip("\n") for p in parts) + "\n",
             content_type="text/plain",
         )
 

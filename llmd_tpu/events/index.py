@@ -24,6 +24,8 @@ TIER_WEIGHTS = {"gpu": 1.0, "hbm": 1.0, "cpu": 0.8, "disk": 0.6}
 
 SPECULATIVE_TTL_S = 2.0
 
+_HALVE_TABLE = bytes(v >> 1 for v in range(256))
+
 
 class KVBlockIndex:
     def __init__(
@@ -75,8 +77,12 @@ class KVBlockIndex:
         lru[h] = None
         lru.move_to_end(h)
         if len(lru) > self.max_blocks_per_pod:
-            old, _ = lru.popitem(last=False)
-            self._drop_locked(pod, old)
+            self._evict_one_locked(pod, lru)
+
+    def _evict_one_locked(self, pod: str, lru: collections.OrderedDict) -> None:
+        """Eviction policy hook: base class evicts the LRU entry."""
+        old, _ = lru.popitem(last=False)
+        self._drop_locked(pod, old)
 
     def _remove_locked(self, pod: str, h: str) -> None:
         lru = self._pod_lru.get(pod)
@@ -184,6 +190,9 @@ class KVBlockIndex:
         with self._lock:
             return len(self._blocks)
 
+    def close(self) -> None:
+        pass
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {
@@ -193,3 +202,78 @@ class KVBlockIndex:
                 "lookups": self.metrics_lookups,
                 "hits": self.metrics_hits,
             }
+
+
+class CostAwareKVBlockIndex(KVBlockIndex):
+    """Cost-aware backend (the reference's Ristretto option,
+    kv-indexer.md:59-151): a counting sketch estimates each block's
+    lookup frequency, and eviction removes the LEAST-FREQUENT of a
+    sample of the pod's oldest entries instead of the strict LRU head —
+    long-lived shared prefixes (system prompts) survive bursts of
+    one-shot traffic that would churn a pure LRU.
+
+    The sketch is a 4-bit count-min with periodic halving (TinyLFU
+    aging), so hot entries stay distinguishable without unbounded
+    counters.
+    """
+
+    SKETCH_BITS = 16  # 2**16 counters per row
+    ROWS = 4
+    MAX_COUNT = 15
+    SAMPLE = 8
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        import array
+
+        self._sketch = [
+            array.array("B", bytes(1 << self.SKETCH_BITS))
+            for _ in range(self.ROWS)
+        ]
+        self._ops = 0
+        # halve all counters every ~16x the per-pod capacity of touches
+        self._reset_every = 16 * max(self.max_blocks_per_pod, 1)
+
+    def _hashes_of(self, h: str) -> list[int]:
+        v = hash(h) & 0xFFFFFFFFFFFFFFFF
+        out = []
+        for r in range(self.ROWS):
+            out.append((v >> (r * self.SKETCH_BITS)) & ((1 << self.SKETCH_BITS) - 1))
+        return out
+
+    def _touch_locked(self, h: str) -> None:
+        self._ops += 1
+        for row, idx in zip(self._sketch, self._hashes_of(h)):
+            if row[idx] < self.MAX_COUNT:
+                row[idx] += 1
+        if self._ops >= self._reset_every:
+            self._ops = 0
+            # bytes.translate halves all 65536 counters per row in C —
+            # a Python loop here would stall scheduling under the lock.
+            for row in self._sketch:
+                row[:] = type(row)("B", bytes(row).translate(_HALVE_TABLE))
+
+    def _freq_locked(self, h: str) -> int:
+        return min(
+            row[idx] for row, idx in zip(self._sketch, self._hashes_of(h))
+        )
+
+    def _store_locked(self, pod: str, h: str, tier: str) -> None:
+        self._touch_locked(h)
+        super()._store_locked(pod, h, tier)
+
+    def _pod_has_locked(self, pod: str, h: str, now: float):
+        tier = super()._pod_has_locked(pod, h, now)
+        if tier is not None:
+            self._touch_locked(h)  # lookup hits drive frequency
+        return tier
+
+    def _evict_one_locked(self, pod: str, lru: collections.OrderedDict) -> None:
+        sample = []
+        for h in lru:  # oldest first
+            sample.append(h)
+            if len(sample) >= self.SAMPLE:
+                break
+        victim = min(sample, key=self._freq_locked)
+        lru.pop(victim, None)
+        self._drop_locked(pod, victim)

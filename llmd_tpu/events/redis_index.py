@@ -1,0 +1,281 @@
+"""Redis/Valkey-backed KV block index (the reference's third backend).
+
+kv-indexer.md:59-151 names Redis/Valkey as the shared-index option:
+every router replica reads/writes one external store, so replicas see a
+consistent index without per-replica event fan-in. No redis client
+library ships in this image, so this speaks RESP directly over a
+socket — a complete implementation against any real Redis/Valkey (and
+the in-process fake used by tests).
+
+Schema:
+  HSET kv:{hash} {pod} {tier}     BlockStored
+  HDEL kv:{hash} {pod}            BlockRemoved
+  SADD pod:{pod} {hash}           reverse index for AllBlocksCleared
+Speculative entries stay process-local (they exist to co-route bursts
+hitting THIS replica before events arrive; sharing them would defeat
+their 2s-TTL semantics).
+
+Scoring pipelines one HGETALL per prefix hash in a single round trip,
+then walks the run locally — one network RTT per scheduling decision.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+
+from llmd_tpu.events.index import SPECULATIVE_TTL_S, TIER_WEIGHTS
+
+log = logging.getLogger(__name__)
+
+
+class RespClient:
+    """Minimal RESP2 client: command pipelining over one socket.
+
+    Calls are SYNCHRONOUS; the scoring path runs on the router event
+    loop, so the timeout must stay short — an unreachable Redis costs at
+    most ~2x timeout_s per decision (attempt + one reconnect), and the
+    scorer degrades to zero scores rather than erroring (fail-open,
+    matching router FailOpen semantics)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 1.0) -> None:
+        self.addr = (host, port)
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, self.timeout_s)
+            self._sock.settimeout(self.timeout_s)
+            self._buf = b""
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    @staticmethod
+    def _encode(args: tuple) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        return b"".join(out)
+
+    def _read_line(self, sock: socket.socket) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n + 2 :]
+        return data
+
+    def _read_reply(self, sock: socket.socket):
+        line = self._read_line(sock)
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RuntimeError(f"redis error: {rest.decode()}")
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n == -1 else self._read_exact(sock, n)
+        if kind == b"*":
+            n = int(rest)
+            return None if n == -1 else [self._read_reply(sock) for _ in range(n)]
+        raise RuntimeError(f"unexpected RESP type {line!r}")
+
+    def pipeline(self, commands: list[tuple]) -> list:
+        """Send all commands in one write; read all replies."""
+        if not commands:
+            return []
+        with self._lock:
+            try:
+                sock = self._connect()
+                sock.sendall(b"".join(self._encode(c) for c in commands))
+                return [self._read_reply(sock) for _ in commands]
+            except (OSError, ConnectionError):
+                # one reconnect attempt (server restart, idle timeout)
+                self.close()
+                sock = self._connect()
+                sock.sendall(b"".join(self._encode(c) for c in commands))
+                return [self._read_reply(sock) for _ in commands]
+
+    def command(self, *args):
+        return self.pipeline([args])[0]
+
+
+class RedisKVBlockIndex:
+    """KVBlockIndex-compatible interface over a shared Redis/Valkey."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        speculative_ttl_s: float = SPECULATIVE_TTL_S,
+        key_prefix: str = "llmd",
+    ) -> None:
+        self.client = RespClient(host, port)
+        self.speculative_ttl_s = speculative_ttl_s
+        self.prefix = key_prefix
+        self._lock = threading.Lock()
+        self._spec: dict[str, dict[str, float]] = {}
+        self.metrics_events = 0
+        self.metrics_lookups = 0
+        self.metrics_hits = 0
+
+    def _bk(self, h: str) -> str:
+        return f"{self.prefix}:kv:{h}"
+
+    def _pk(self, pod: str) -> str:
+        return f"{self.prefix}:pod:{pod}"
+
+    # ---------------------------------------------------------- events
+
+    def apply(self, pod: str, events: list[dict]) -> None:
+        cmds: list[tuple] = []
+        for ev in events:
+            self.metrics_events += 1
+            t = ev.get("type")
+            if t == "BlockStored":
+                tier = ev.get("medium", "gpu")
+                for h in ev.get("hashes", []):
+                    cmds.append(("HSET", self._bk(h), pod, tier))
+                    cmds.append(("SADD", self._pk(pod), h))
+            elif t == "BlockRemoved":
+                for h in ev.get("hashes", []):
+                    cmds.append(("HDEL", self._bk(h), pod))
+                    cmds.append(("SREM", self._pk(pod), h))
+            elif t == "AllBlocksCleared":
+                # Strict event order: stores queued BEFORE the clear must
+                # land (and then be wiped) — flushing keeps a batch like
+                # [BlockStored h1, AllBlocksCleared] ending empty, exactly
+                # like the in-memory index.
+                if cmds:
+                    self.client.pipeline(cmds)
+                    cmds = []
+                self._clear_pod(pod)
+        if cmds:
+            self.client.pipeline(cmds)
+
+    def _clear_pod(self, pod: str) -> None:
+        hashes = self.client.command("SMEMBERS", self._pk(pod)) or []
+        cmds: list[tuple] = [("DEL", self._pk(pod))]
+        for h in hashes:
+            hs = h.decode() if isinstance(h, bytes) else h
+            cmds.append(("HDEL", self._bk(hs), pod))
+        self.client.pipeline(cmds)
+        with self._lock:
+            self._spec.pop(pod, None)
+
+    def remove_pod(self, pod: str) -> None:
+        self._clear_pod(pod)
+
+    # ---------------------------------------------------------- speculative
+
+    def insert_speculative(self, pod: str, hashes: list[str]) -> None:
+        now = time.monotonic()
+        deadline = now + self.speculative_ttl_s
+        with self._lock:
+            spec = self._spec.setdefault(pod, {})
+            for h in list(spec):
+                if spec[h] <= now:
+                    del spec[h]
+            for h in hashes:
+                spec[h] = deadline
+
+    # ---------------------------------------------------------- scoring
+
+    def score(self, hashes: list[str], pods: list[str]) -> dict[str, float]:
+        return {p: s for p, (s, _) in self.score_detailed(hashes, pods).items()}
+
+    def score_detailed(
+        self, hashes: list[str], pods: list[str]
+    ) -> dict[str, tuple[float, int]]:
+        self.metrics_lookups += 1
+        now = time.monotonic()
+        try:
+            replies = self.client.pipeline(
+                [("HGETALL", self._bk(h)) for h in hashes]
+            )
+        except (OSError, ConnectionError, RuntimeError) as e:
+            log.warning("redis index lookup failed (%s): scoring 0", e)
+            return {p: (0.0, 0) for p in pods}
+        # flatten [k1, v1, k2, v2, ...] -> per-hash {pod: tier}
+        holders: list[dict[str, str]] = []
+        for r in replies:
+            d: dict[str, str] = {}
+            items = r or []
+            for i in range(0, len(items), 2):
+                k = items[i].decode() if isinstance(items[i], bytes) else items[i]
+                v = (
+                    items[i + 1].decode()
+                    if isinstance(items[i + 1], bytes)
+                    else items[i + 1]
+                )
+                d[k] = v
+            holders.append(d)
+        out: dict[str, tuple[float, int]] = {}
+        hit = False
+        with self._lock:
+            for pod in pods:
+                spec = self._spec.get(pod, {})
+                s, n = 0.0, 0
+                for h, held in zip(hashes, holders):
+                    tier = held.get(pod)
+                    if tier is None and spec.get(h, 0.0) > now:
+                        tier = "gpu"
+                    if tier is None:
+                        break
+                    s += TIER_WEIGHTS.get(tier, 0.5)
+                    n += 1
+                if n:
+                    hit = True
+                out[pod] = (s, n)
+        if hit:
+            self.metrics_hits += 1
+        return out
+
+    def matched_pages(self, hashes: list[str], pod: str) -> int:
+        return self.score_detailed(hashes, [pod])[pod][1]
+
+    # ---------------------------------------------------------- misc
+
+    @property
+    def size(self) -> int:
+        # DBSIZE counts pod sets too; good enough for the size gauge.
+        try:
+            return int(self.client.command("DBSIZE"))
+        except Exception:
+            return 0
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "blocks": self.size,
+            "events": self.metrics_events,
+            "lookups": self.metrics_lookups,
+            "hits": self.metrics_hits,
+        }
+
+    def close(self) -> None:
+        self.client.close()

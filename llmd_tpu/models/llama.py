@@ -126,7 +126,10 @@ def forward_hidden(
     B, Q = inp.token_ids.shape
     D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     x = params["embed"][inp.token_ids]  # [B, Q, H]
-    cos, sin = rope_tables(inp.positions, D, cfg.rope_theta)
+    # one rope table for all layers (hoisted out of the scan); MLA rotates
+    # only its rope sub-dim
+    rope_dim = cfg.qk_rope_head_dim if cfg.is_mla else D
+    cos, sin = rope_tables(inp.positions, rope_dim, cfg.rope_theta)
     valid = inp.valid
     sm_scale = D**-0.5
 
@@ -136,7 +139,8 @@ def forward_hidden(
             from llmd_tpu.models.mla import mla_attention
 
             attn_out, cache = mla_attention(
-                h, lp, cache, layer_idx, inp, cfg, world_size=world_size
+                h, lp, cache, layer_idx, inp, cfg, cos, sin,
+                world_size=world_size,
             )
             x = x + attn_out
         else:

@@ -34,6 +34,8 @@ def mla_attention(
     layer_idx: jax.Array,  # scalar i32
     inp: StepInput,
     cfg: ModelConfig,
+    cos: jax.Array | None = None,  # rope tables for qk_rope_head_dim,
+    sin: jax.Array | None = None,  # hoisted out of the layer scan
     world_size: int = 1,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (attn output [B, Q, H_hidden], updated cache)."""
@@ -44,7 +46,8 @@ def mla_attention(
     Dl = cfg.kv_cache_entry_dim
     # MLA scales by the FULL qk head dim (nope + rope), not the latent.
     sm_scale = (nope + rope) ** -0.5
-    cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta)
+    if cos is None or sin is None:
+        cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta)
 
     # ---- queries
     if cfg.q_lora_rank > 0:

@@ -1,0 +1,225 @@
+"""KV block index backends: cost-aware eviction + Redis (RESP) backend."""
+
+import socket
+import threading
+
+import pytest
+
+from llmd_tpu.events.index import CostAwareKVBlockIndex, KVBlockIndex
+from llmd_tpu.events.redis_index import RedisKVBlockIndex, RespClient
+
+
+# ---------------------------------------------------------------- cost-aware
+
+
+def stored(hashes, medium="gpu"):
+    return [{"type": "BlockStored", "hashes": hashes, "medium": medium}]
+
+
+def test_cost_aware_matches_lru_semantics_under_capacity():
+    for cls in (KVBlockIndex, CostAwareKVBlockIndex):
+        idx = cls(max_blocks_per_pod=64)
+        idx.apply("p1", stored(["a", "b", "c"]))
+        idx.apply("p2", stored(["a"]))
+        assert idx.score(["a", "b", "c"], ["p1", "p2"]) == {"p1": 3.0, "p2": 1.0}
+        idx.apply("p1", [{"type": "BlockRemoved", "hashes": ["b"]}])
+        assert idx.score(["a", "b", "c"], ["p1"])["p1"] == 1.0  # run breaks at b
+
+
+def test_cost_aware_keeps_hot_blocks_under_eviction():
+    """A frequently-looked-up block survives eviction pressure that would
+    evict it under strict LRU (it is the oldest entry)."""
+    idx = CostAwareKVBlockIndex(max_blocks_per_pod=8)
+    idx.apply("p", stored(["hot"]))
+    for _ in range(10):  # lookups drive the frequency sketch
+        idx.score(["hot"], ["p"])
+    idx.apply("p", stored([f"cold{i}" for i in range(7)]))  # pod at capacity
+    idx.apply("p", stored(["new1", "new2"]))  # forces two evictions
+    assert idx.score(["hot"], ["p"])["p"] == 1.0  # hot survived
+    lru = KVBlockIndex(max_blocks_per_pod=8)
+    lru.apply("p", stored(["hot"]))
+    for _ in range(10):
+        lru.score(["hot"], ["p"])
+    lru.apply("p", stored([f"cold{i}" for i in range(7)]))
+    lru.apply("p", stored(["new1", "new2"]))
+    assert lru.score(["hot"], ["p"])["p"] == 0.0  # strict LRU evicted it
+
+
+# ---------------------------------------------------------------- fake redis
+
+
+class FakeRedis:
+    """In-process RESP2 server implementing the commands the index uses."""
+
+    def __init__(self):
+        self.hashes: dict[str, dict[str, str]] = {}
+        self.sets: dict[str, set] = {}
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn):
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, rest = buf.split(b"\r\n", 1)
+            buf = rest
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n + 2:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            data, buf = buf[:n], buf[n + 2:]
+            return data
+
+        try:
+            while True:
+                line = read_line()
+                assert line[:1] == b"*", line
+                argc = int(line[1:])
+                args = []
+                for _ in range(argc):
+                    ln = read_line()
+                    assert ln[:1] == b"$"
+                    args.append(read_exact(int(ln[1:])).decode())
+                conn.sendall(self._exec(args))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _exec(self, args) -> bytes:
+        cmd = args[0].upper()
+        if cmd == "HSET":
+            _, key, field, val = args
+            self.hashes.setdefault(key, {})[field] = val
+            return b":1\r\n"
+        if cmd == "HDEL":
+            _, key, field = args
+            n = 1 if self.hashes.get(key, {}).pop(field, None) is not None else 0
+            return b":%d\r\n" % n
+        if cmd == "HGETALL":
+            d = self.hashes.get(args[1], {})
+            out = [b"*%d\r\n" % (2 * len(d))]
+            for k, v in d.items():
+                out.append(b"$%d\r\n%s\r\n" % (len(k), k.encode()))
+                out.append(b"$%d\r\n%s\r\n" % (len(v), v.encode()))
+            return b"".join(out)
+        if cmd == "SADD":
+            _, key, member = args
+            self.sets.setdefault(key, set()).add(member)
+            return b":1\r\n"
+        if cmd == "SREM":
+            _, key, member = args
+            self.sets.get(key, set()).discard(member)
+            return b":1\r\n"
+        if cmd == "SMEMBERS":
+            members = sorted(self.sets.get(args[1], set()))
+            out = [b"*%d\r\n" % len(members)]
+            for m in members:
+                out.append(b"$%d\r\n%s\r\n" % (len(m), m.encode()))
+            return b"".join(out)
+        if cmd == "DEL":
+            self.sets.pop(args[1], None)
+            self.hashes.pop(args[1], None)
+            return b":1\r\n"
+        if cmd == "DBSIZE":
+            return b":%d\r\n" % (len(self.hashes) + len(self.sets))
+        return b"-ERR unknown command\r\n"
+
+    def close(self):
+        self._stop = True
+        self.sock.close()
+
+
+@pytest.fixture
+def fake_redis():
+    srv = FakeRedis()
+    yield srv
+    srv.close()
+
+
+def test_resp_client_pipeline(fake_redis):
+    c = RespClient("127.0.0.1", fake_redis.port)
+    replies = c.pipeline([
+        ("HSET", "k", "f", "v"),
+        ("HGETALL", "k"),
+        ("DBSIZE",),
+    ])
+    assert replies[0] == 1
+    assert replies[1] == [b"f", b"v"]
+    assert replies[2] == 1
+    c.close()
+
+
+def test_redis_index_behaves_like_memory_index(fake_redis):
+    idx = RedisKVBlockIndex(host="127.0.0.1", port=fake_redis.port)
+    try:
+        idx.apply("p1", stored(["a", "b"]) + stored(["c"], medium="cpu"))
+        idx.apply("p2", stored(["a"]))
+        scores = idx.score_detailed(["a", "b", "c", "d"], ["p1", "p2"])
+        assert scores["p1"] == (pytest.approx(2.8), 3)  # gpu+gpu+cpu(0.8)
+        assert scores["p2"] == (1.0, 1)
+        # removal breaks the run
+        idx.apply("p1", [{"type": "BlockRemoved", "hashes": ["b"]}])
+        assert idx.score(["a", "b", "c"], ["p1"])["p1"] == 1.0
+        # AllBlocksCleared wipes the pod everywhere
+        idx.apply("p1", [{"type": "AllBlocksCleared"}])
+        assert idx.score(["a", "c"], ["p1"])["p1"] == 0.0
+        assert idx.score(["a"], ["p2"])["p2"] == 1.0  # p2 untouched
+        # speculative entries are replica-local but score as hot tier
+        idx.insert_speculative("p2", ["x", "y"])
+        assert idx.score(["x", "y"], ["p2"])["p2"] == 2.0
+        assert idx.matched_pages(["a"], "p2") == 1
+        assert idx.stats()["events"] > 0
+    finally:
+        idx.close()
+
+
+def test_redis_index_shared_across_replicas(fake_redis):
+    """Two index instances (two router replicas) see each other's events —
+    the property the Redis backend exists for."""
+    a = RedisKVBlockIndex(host="127.0.0.1", port=fake_redis.port)
+    b = RedisKVBlockIndex(host="127.0.0.1", port=fake_redis.port)
+    try:
+        a.apply("pod", stored(["h1", "h2"]))
+        assert b.score(["h1", "h2"], ["pod"])["pod"] == 2.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_scorer_backend_selection():
+    from llmd_tpu.epp.precise_prefix import PrecisePrefixCacheScorer
+
+    assert isinstance(
+        PrecisePrefixCacheScorer(backend="cost-aware").index,
+        CostAwareKVBlockIndex,
+    )
+    assert isinstance(
+        PrecisePrefixCacheScorer(backend="lru").index, KVBlockIndex
+    )
+    with pytest.raises(ValueError):
+        PrecisePrefixCacheScorer(backend="nope")
