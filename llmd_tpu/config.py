@@ -38,6 +38,8 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     # Qwen2-style attention bias on QKV projections.
     attention_bias: bool = False
+    # Qwen3-style per-head RMS norm on Q and K (applied before RoPE).
+    qk_norm: bool = False
     # --- MoE (0 experts => dense MLP) ---
     num_experts: int = 0
     num_experts_per_tok: int = 2

@@ -40,9 +40,17 @@ class RespClient:
     scorer degrades to zero scores rather than erroring (fail-open,
     matching router FailOpen semantics)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 1.0) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 1.0,
+        down_cooldown_s: float = 5.0,
+    ) -> None:
         self.addr = (host, port)
         self.timeout_s = timeout_s
+        self.down_cooldown_s = down_cooldown_s
+        self._down_until = 0.0
         self._sock: socket.socket | None = None
         self._buf = b""
         self._lock = threading.Lock()
@@ -54,13 +62,16 @@ class RespClient:
             self._buf = b""
         return self._sock
 
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._close_locked()
 
     @staticmethod
     def _encode(args: tuple) -> bytes:
@@ -105,21 +116,54 @@ class RespClient:
             return None if n == -1 else [self._read_reply(sock) for _ in range(n)]
         raise RuntimeError(f"unexpected RESP type {line!r}")
 
+    def _read_all(self, sock: socket.socket, n: int) -> list:
+        """Read n replies keeping the stream in sync: an error REPLY
+        (-ERR...) consumes its line and is re-raised only after all
+        replies are drained; an I/O failure mid-read leaves unread
+        replies on the wire, so the socket is closed (a reused socket
+        would misattribute the leftovers to later commands)."""
+        replies = []
+        first_err: RuntimeError | None = None
+        try:
+            for _ in range(n):
+                try:
+                    replies.append(self._read_reply(sock))
+                except RuntimeError as e:
+                    replies.append(None)
+                    first_err = first_err or e
+        except (OSError, ConnectionError):
+            self._close_locked()
+            raise
+        if first_err is not None:
+            raise first_err
+        return replies
+
     def pipeline(self, commands: list[tuple]) -> list:
         """Send all commands in one write; read all replies."""
         if not commands:
             return []
+        now = time.monotonic()
         with self._lock:
+            if now < self._down_until:
+                raise ConnectionError("redis marked down (circuit open)")
+            payload = b"".join(self._encode(c) for c in commands)
             try:
-                sock = self._connect()
-                sock.sendall(b"".join(self._encode(c) for c in commands))
-                return [self._read_reply(sock) for _ in commands]
+                try:
+                    sock = self._connect()
+                    sock.sendall(payload)
+                except (OSError, ConnectionError):
+                    # one reconnect attempt (server restart, idle timeout)
+                    self._close_locked()
+                    sock = self._connect()
+                    sock.sendall(payload)
+                return self._read_all(sock, len(commands))
             except (OSError, ConnectionError):
-                # one reconnect attempt (server restart, idle timeout)
-                self.close()
-                sock = self._connect()
-                sock.sendall(b"".join(self._encode(c) for c in commands))
-                return [self._read_reply(sock) for _ in commands]
+                # Circuit-break: the caller runs on the router event loop;
+                # retrying the connect on every scheduling decision would
+                # stall the whole process for ~2x timeout per request.
+                self._close_locked()
+                self._down_until = time.monotonic() + self.down_cooldown_s
+                raise
 
     def command(self, *args):
         return self.pipeline([args])[0]
@@ -134,10 +178,18 @@ class RedisKVBlockIndex:
         port: int = 6379,
         speculative_ttl_s: float = SPECULATIVE_TTL_S,
         key_prefix: str = "llmd",
+        entry_ttl_s: int = 1200,
     ) -> None:
+        """entry_ttl_s: sliding expiry on every key touched by a store —
+        the shared store's safety net against pods that die while no
+        router observes it (their entries would otherwise advertise
+        caches forever and misroute warm traffic; the in-memory backend
+        has its per-pod capacity cap instead). Live pods keep refreshing
+        their keys through ongoing BlockStored traffic."""
         self.client = RespClient(host, port)
         self.speculative_ttl_s = speculative_ttl_s
         self.prefix = key_prefix
+        self.entry_ttl_s = int(entry_ttl_s)
         self._lock = threading.Lock()
         self._spec: dict[str, dict[str, float]] = {}
         self.metrics_events = 0
@@ -161,7 +213,10 @@ class RedisKVBlockIndex:
                 tier = ev.get("medium", "gpu")
                 for h in ev.get("hashes", []):
                     cmds.append(("HSET", self._bk(h), pod, tier))
+                    cmds.append(("EXPIRE", self._bk(h), self.entry_ttl_s))
                     cmds.append(("SADD", self._pk(pod), h))
+                if ev.get("hashes"):
+                    cmds.append(("EXPIRE", self._pk(pod), self.entry_ttl_s))
             elif t == "BlockRemoved":
                 for h in ev.get("hashes", []):
                     cmds.append(("HDEL", self._bk(h), pod))
@@ -189,7 +244,12 @@ class RedisKVBlockIndex:
             self._spec.pop(pod, None)
 
     def remove_pod(self, pod: str) -> None:
-        self._clear_pod(pod)
+        # Endpoint-store removal callback: a Redis outage here must not
+        # break pool reconciliation; the entry TTL reclaims eventually.
+        try:
+            self._clear_pod(pod)
+        except (OSError, ConnectionError, RuntimeError) as e:
+            log.warning("redis index clear for pod %s failed: %s", pod, e)
 
     # ---------------------------------------------------------- speculative
 

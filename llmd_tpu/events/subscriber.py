@@ -82,7 +82,13 @@ class KVEventSubscriber:
                     parts = sock.recv_multipart(flags=self._zmq.NOBLOCK)
                 except self._zmq.ZMQError:
                     continue
-                self._handle(parts)
+                try:
+                    self._handle(parts)
+                except Exception:
+                    # A backend hiccup (e.g. Redis outage in the shared
+                    # index) must not kill the poller thread — the index
+                    # would go silently stale forever.
+                    log.exception("kv-event batch failed; poller continues")
         finally:
             sock.close(0)
 

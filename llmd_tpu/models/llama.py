@@ -74,6 +74,9 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
             layers["bq"] = jnp.zeros((n, Nq * D), dt)
             layers["bk"] = jnp.zeros((n, K * D), dt)
             layers["bv"] = jnp.zeros((n, K * D), dt)
+        if cfg.qk_norm:
+            layers["attn_q_norm"] = jnp.ones((n, D), dt)
+            layers["attn_k_norm"] = jnp.ones((n, D), dt)
         if moe:
             E, Fm = cfg.num_experts, cfg.moe_intermediate_size
             layers["router"] = mkp("router", (n, H, E), scale=H**-0.5)
@@ -149,8 +152,13 @@ def forward_hidden(
             v = h @ lp["wv"]
             if cfg.attention_bias:
                 q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-            q = apply_rope(q.reshape(B, Q, Nq, D), cos, sin)
-            k = apply_rope(k.reshape(B, Q, K, D), cos, sin)
+            q = q.reshape(B, Q, Nq, D)
+            k = k.reshape(B, Q, K, D)
+            if cfg.qk_norm:  # Qwen3: per-head RMS norm before RoPE
+                q = rms_norm(q, lp["attn_q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, lp["attn_k_norm"], cfg.rms_norm_eps)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
             v = v.reshape(B, Q, K, D)
             cache = write_kv_pages_full(
                 cache, layer_idx, k, v, inp.page_table, inp.positions, valid,

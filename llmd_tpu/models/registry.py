@@ -110,6 +110,30 @@ def _qwen2_72b() -> ModelConfig:
     )
 
 
+@register_model("qwen3-32b")
+def _qwen3_32b() -> ModelConfig:
+    """Qwen3-32B (HF Qwen/Qwen3-32B) — the reference's prefix-cache and
+    tiered-offload benchmark model (SURVEY.md §6). QK-norm, no bias."""
+    return ModelConfig(
+        name="qwen3-32b", vocab_size=151936, hidden_size=5120,
+        intermediate_size=25600, num_layers=64, num_heads=64, num_kv_heads=8,
+        head_dim=128, rope_theta=1000000.0, max_model_len=40960,
+        qk_norm=True,
+    )
+
+
+@register_model("qwen3-30b-a3b")
+def _qwen3_30b_a3b() -> ModelConfig:
+    """Qwen3-30B-A3B (MoE): 128 experts, top-8, QK-norm."""
+    return ModelConfig(
+        name="qwen3-30b-a3b", vocab_size=151936, hidden_size=2048,
+        intermediate_size=6144, num_layers=48, num_heads=32, num_kv_heads=4,
+        head_dim=128, rope_theta=1000000.0, max_model_len=40960,
+        qk_norm=True,
+        num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768,
+    )
+
+
 @register_model("mixtral-8x7b")
 def _mixtral_8x7b() -> ModelConfig:
     return ModelConfig(

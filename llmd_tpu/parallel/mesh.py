@@ -99,6 +99,8 @@ PARAM_SPECS: dict[str, P] = {
     "bq": P(None, TP_AXIS),
     "bk": P(None, TP_AXIS),
     "bv": P(None, TP_AXIS),
+    "attn_q_norm": P(None, None),  # [L, D] per-head norm, replicated
+    "attn_k_norm": P(None, None),
     "w_gate": P(None, None, TP_AXIS),  # [L, H, F]
     "w_up": P(None, None, TP_AXIS),
     "w_down": P(None, TP_AXIS, None),  # [L, F, H]

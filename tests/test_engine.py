@@ -197,3 +197,32 @@ def test_max_model_len_rejected():
     eng = make_engine()
     with pytest.raises(ValueError):
         eng.add_request(list(range(200)))  # max_model_len=128
+
+
+def test_engine_qk_norm_generates():
+    """Qwen3-style QK-norm path: engine generates deterministically."""
+    from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+
+    model = tiny_model_config(name="tiny-qkn", qk_norm=True)
+    cfg = EngineConfig(
+        model=model,
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=64),
+    )
+    engine = LLMEngine(cfg)
+    out = engine.generate(
+        [[1, 2, 3, 4, 5]], SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    )
+    toks = list(out.values())[0]
+    assert len(toks) == 6
+    # qk-norm changes the function: outputs differ from the no-norm model
+    engine2 = LLMEngine(EngineConfig(
+        model=tiny_model_config(name="tiny-qkn"),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=64),
+    ))
+    out2 = engine2.generate(
+        [[1, 2, 3, 4, 5]], SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    )
+    assert list(out2.values())[0] != toks

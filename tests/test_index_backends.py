@@ -145,6 +145,8 @@ class FakeRedis:
             self.sets.pop(args[1], None)
             self.hashes.pop(args[1], None)
             return b":1\r\n"
+        if cmd == "EXPIRE":
+            return b":1\r\n"
         if cmd == "DBSIZE":
             return b":%d\r\n" % (len(self.hashes) + len(self.sets))
         return b"-ERR unknown command\r\n"
@@ -223,3 +225,20 @@ def test_scorer_backend_selection():
     )
     with pytest.raises(ValueError):
         PrecisePrefixCacheScorer(backend="nope")
+
+
+def test_redis_down_fails_open_and_circuit_breaks():
+    import time
+
+    idx = RedisKVBlockIndex(host="127.0.0.1", port=1)  # nothing listens
+    try:
+        t0 = time.monotonic()
+        assert idx.score(["a", "b"], ["p"]) == {"p": 0.0}  # fail-open zeros
+        first = time.monotonic() - t0
+        t0 = time.monotonic()
+        assert idx.score(["a"], ["p"]) == {"p": 0.0}
+        second = time.monotonic() - t0
+        assert second < 0.1  # circuit open: no second connect attempt
+        assert first < 5.0
+    finally:
+        idx.close()
