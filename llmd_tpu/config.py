@@ -40,6 +40,13 @@ class ModelConfig:
     attention_bias: bool = False
     # Qwen3-style per-head RMS norm on Q and K (applied before RoPE).
     qk_norm: bool = False
+    # --- multi-LoRA serving (reference model-servers.md:78-89) ---
+    # num_lora_adapters > 0 allocates that many adapter slots (rank
+    # lora_rank, applied to the q and v projections); slot 0 is reserved
+    # for "no adapter" (zero weights). Adapter NAMES live at the serving
+    # layer; the model only sees integer slot ids per sequence.
+    num_lora_adapters: int = 0
+    lora_rank: int = 16
     # --- MoE (0 experts => dense MLP) ---
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -69,6 +76,12 @@ class ModelConfig:
                 "attention_bias is not supported with MLA (kv_lora_rank > 0): "
                 "no known MLA architecture uses QKV biases and the MLA "
                 "forward would silently ignore them"
+            )
+        if self.kv_lora_rank > 0 and self.num_lora_adapters > 0:
+            raise ValueError(
+                "LoRA adapters are not supported on MLA models yet: the MLA "
+                "attention path would silently serve base-model outputs for "
+                "adapter requests"
             )
 
     @property

@@ -49,6 +49,10 @@ class EngineStats:
     offload_fs_pages: int = 0
     offload_saves: int = 0
     offload_restores: int = 0
+    # LoRA (reference model-servers.md:78-89 lora_requests_info)
+    max_lora: int = 0
+    running_lora_adapters: tuple = ()
+    waiting_lora_adapters: tuple = ()
 
 
 class LLMEngine:
@@ -127,9 +131,18 @@ class LLMEngine:
         request_id: str | None = None,
         priority: int = 0,
         kv_transfer_params: dict | None = None,
+        lora_id: int = 0,
+        lora_name: str = "",
     ) -> str:
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if lora_id and not (
+            0 < lora_id <= self.config.model.num_lora_adapters
+        ):
+            raise ValueError(
+                f"lora_id {lora_id} out of range "
+                f"(model has {self.config.model.num_lora_adapters} adapters)"
+            )
         if len(prompt_token_ids) >= self.config.model.max_model_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} >= max_model_len "
@@ -171,6 +184,8 @@ class LLMEngine:
             sampling=sampling or SamplingParams(),
             priority=priority,
             kv_transfer_params=kv_transfer_params,
+            lora_id=lora_id,
+            lora_name=lora_name,
         )
         self.scheduler.add_request(req)
         return rid
@@ -246,6 +261,14 @@ class LLMEngine:
         self.stats.kv_usage = self.allocator.usage()
         self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
         self.stats.preemptions = self.scheduler.num_preemptions
+        if self.config.model.num_lora_adapters:
+            self.stats.max_lora = self.config.model.num_lora_adapters
+            self.stats.running_lora_adapters = tuple(
+                sorted({r.lora_name for r in self.scheduler.running if r.lora_name})
+            )
+            self.stats.waiting_lora_adapters = tuple(
+                sorted({r.lora_name for r in self.scheduler.waiting if r.lora_name})
+            )
         if self._host_cache is not None:
             hs = self._host_cache.stats()
             self.stats.offload_pages = hs["pages"]

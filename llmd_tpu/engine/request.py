@@ -62,6 +62,11 @@ class Request:
     # (reference disaggregation/README.md:104-131); interpreted by the
     # kvtransfer connector, not the engine core.
     kv_transfer_params: dict[str, Any] | None = None
+    # LoRA adapter slot (0 = base model); set by the serving layer from
+    # the requested model name. The adapter NAME rides lora_name for the
+    # lora_requests_info metric.
+    lora_id: int = 0
+    lora_name: str = ""
 
     # --- mutable state ---
     status: RequestStatus = RequestStatus.WAITING

@@ -170,6 +170,7 @@ class ModelRunner:
             start_pos: jax.Array,  # [B] position of first_token
             page_table: jax.Array,  # [B, max_pages]
             active: jax.Array,  # [B] bool (pad rows False)
+            lora_ids,  # [B] i32 adapter slots, or None
             temperature: jax.Array,
             top_k: jax.Array,
             top_p: jax.Array,
@@ -188,6 +189,7 @@ class ModelRunner:
                     query_lens=jnp.where(active, 1, 0).astype(jnp.int32),
                     kv_lens=jnp.where(active, pos + 1, 0).astype(jnp.int32),
                     page_table=page_table,
+                    lora_ids=lora_ids,
                 )
                 hidden, kv_cache = llama.forward_hidden(
                     params, kv_cache, inp, cfg, world,
@@ -250,6 +252,15 @@ class ModelRunner:
             top_p=jnp.asarray(top_p),
             seeds=jnp.asarray(seeds[:, 0]),
         )
+
+    def _lora_ids(self, seqs: list[ScheduledSeq], B: int):
+        """[B] adapter slots, or None for non-LoRA models (stable pytree)."""
+        if not self.cfg.num_lora_adapters:
+            return None
+        ids = np.zeros(B, np.int32)
+        for i, s in enumerate(seqs):
+            ids[i] = s.request.lora_id
+        return jnp.asarray(ids)
 
     def _page_table(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
         pt = np.zeros((B, self.max_pages), np.int32)
@@ -344,6 +355,7 @@ class ModelRunner:
             query_lens=jnp.asarray(qlens),
             kv_lens=jnp.asarray(kvlens),
             page_table=jnp.asarray(self._page_table(seqs, B)),
+            lora_ids=self._lora_ids(seqs, B),
         )
         self.kv_cache, packed = self._forward(
             self.params,
@@ -374,6 +386,7 @@ class ModelRunner:
             jnp.asarray(start),
             jnp.asarray(self._page_table(seqs, B)),
             jnp.asarray(active),
+            self._lora_ids(seqs, B),
             jnp.asarray(temp),
             jnp.asarray(top_k),
             jnp.asarray(top_p),
@@ -422,6 +435,9 @@ class ModelRunner:
             query_lens=jnp.zeros(B, jnp.int32),
             kv_lens=jnp.zeros(B, jnp.int32),
             page_table=jnp.zeros((B, self.max_pages), jnp.int32),
+            lora_ids=(
+                jnp.zeros(B, jnp.int32) if self.cfg.num_lora_adapters else None
+            ),
         )
         s = SamplingInputs(
             temperature=jnp.zeros(B, jnp.float32),
@@ -441,6 +457,7 @@ class ModelRunner:
             jnp.zeros(B, jnp.int32),
             jnp.zeros((B, self.max_pages), jnp.int32),
             jnp.zeros(B, bool),
+            jnp.zeros(B, jnp.int32) if self.cfg.num_lora_adapters else None,
             jnp.zeros(B, jnp.float32),
             jnp.zeros(B, jnp.int32),
             jnp.ones(B, jnp.float32),

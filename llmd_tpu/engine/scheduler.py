@@ -200,11 +200,20 @@ class EngineScheduler:
 
         return ScheduledBatch(prefills=prefills, decodes=decodes)
 
+    @staticmethod
+    def _hash_extra(req: Request) -> bytes:
+        """Cache-identity discriminator: LoRA-adapted KV (v is adapted)
+        must never be shared across adapters or with the base model
+        (reference kv-indexer.md:145-151 key folding)."""
+        return f"lora:{req.lora_id}".encode() if req.lora_id else b""
+
     def _apply_prefix_cache(self, req: Request) -> None:
         """Reuse cached full pages covering the prompt prefix."""
         if req.block_ids:
             return
-        cached = self.allocator.lookup_cached_prefix(req.prompt_token_ids)
+        cached = self.allocator.lookup_cached_prefix(
+            req.prompt_token_ids, extra=self._hash_extra(req)
+        )
         # Never satisfy the *entire* prompt from cache: the last token must be
         # computed so the step emits logits for sampling.
         max_cached = (req.num_prompt_tokens - 1) // self.allocator.page_size
@@ -219,7 +228,9 @@ class EngineScheduler:
         parent = _ROOT_HASH
         for i in range(n):
             parent = hash_page(
-                parent, req.prompt_token_ids[i * self.allocator.page_size : (i + 1) * self.allocator.page_size]
+                parent,
+                req.prompt_token_ids[i * self.allocator.page_size : (i + 1) * self.allocator.page_size],
+                extra=self._hash_extra(req),
             )
         self._chain[req.request_id] = (parent, n)
 
@@ -344,7 +355,7 @@ class EngineScheduler:
         tokens = req.all_token_ids
         while committed < full:
             chunk = tokens[committed * page : (committed + 1) * page]
-            h = hash_page(parent, chunk)
+            h = hash_page(parent, chunk, extra=self._hash_extra(req))
             self.allocator.commit_page(req.block_ids[committed], h, chunk, parent)
             parent = h
             committed += 1

@@ -69,6 +69,22 @@ def extract_attrs(text: str, engine_type: str = "vllm") -> dict[str, float]:
             if n in parsed:
                 out[attr] = parsed[n]
                 break
+    # lora_requests_info labels carry adapter state (reference
+    # model-servers.md:78-89); feeds the lora-affinity scorer.
+    if "vllm:lora_requests_info" in parsed:
+        for line in text.splitlines():
+            if line.startswith("vllm:lora_requests_info{"):
+                m = re.search(r'running_lora_adapters="([^"]*)"', line)
+                if m:
+                    out["LoadedAdapters"] = [
+                        a.strip() for a in m.group(1).split(",") if a.strip()
+                    ]
+                m = re.search(r'waiting_lora_adapters="([^"]*)"', line)
+                if m:
+                    out["WaitingAdapters"] = [
+                        a.strip() for a in m.group(1).split(",") if a.strip()
+                    ]
+                break
     # cache_config_info labels carry block geometry; parse_prometheus drops
     # labels, so read them directly if present.
     for fam in ("vllm", "llmd"):

@@ -27,6 +27,10 @@ class StepInput:
     query_lens: jax.Array
     kv_lens: jax.Array
     page_table: jax.Array
+    # Per-sequence LoRA adapter slot ([B] i32, 0 = base model); None when
+    # the model has no adapters (keeps the pytree/compile cache stable
+    # for non-LoRA configs).
+    lora_ids: jax.Array | None = None
 
     @property
     def valid(self) -> jax.Array:  # [B, Q] bool

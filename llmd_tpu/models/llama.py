@@ -77,6 +77,15 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         if cfg.qk_norm:
             layers["attn_q_norm"] = jnp.ones((n, D), dt)
             layers["attn_k_norm"] = jnp.ones((n, D), dt)
+        if cfg.num_lora_adapters and not cfg.is_mla:
+            # Adapter slot 0 = base model (zeros); slots 1..A are live
+            # adapters on the q and v projections (the classic target set).
+            A1, r = cfg.num_lora_adapters + 1, cfg.lora_rank
+            mask = (jnp.arange(A1) > 0).astype(dt)[None, :, None, None]
+            layers["la_q"] = mk("la_q", (n, A1, H, r)) * mask
+            layers["lb_q"] = mk("lb_q", (n, A1, r, Nq * D)) * mask
+            layers["la_v"] = mk("la_v", (n, A1, H, r)) * mask
+            layers["lb_v"] = mk("lb_v", (n, A1, r, K * D)) * mask
         if moe:
             E, Fm = cfg.num_experts, cfg.moe_intermediate_size
             layers["router"] = mkp("router", (n, H, E), scale=H**-0.5)
@@ -152,6 +161,19 @@ def forward_hidden(
             v = h @ lp["wv"]
             if cfg.attention_bias:
                 q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            if cfg.num_lora_adapters and inp.lora_ids is not None:
+                # Per-sequence adapters: gather each row's A/B and apply
+                # x@A@B on q and v (batched einsum; slot 0 is zeros).
+                la_q = lp["la_q"][inp.lora_ids]  # [B, H, r]
+                lb_q = lp["lb_q"][inp.lora_ids]  # [B, r, Nq*D]
+                la_v = lp["la_v"][inp.lora_ids]
+                lb_v = lp["lb_v"][inp.lora_ids]
+                q = q + jnp.einsum(
+                    "bqr,brd->bqd", jnp.einsum("bqh,bhr->bqr", h, la_q), lb_q
+                )
+                v = v + jnp.einsum(
+                    "bqr,brd->bqd", jnp.einsum("bqh,bhr->bqr", h, la_v), lb_v
+                )
             q = q.reshape(B, Q, Nq, D)
             k = k.reshape(B, Q, K, D)
             if cfg.qk_norm:  # Qwen3: per-head RMS norm before RoPE

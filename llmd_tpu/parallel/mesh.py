@@ -101,6 +101,12 @@ PARAM_SPECS: dict[str, P] = {
     "bv": P(None, TP_AXIS),
     "attn_q_norm": P(None, None),  # [L, D] per-head norm, replicated
     "attn_k_norm": P(None, None),
+    # LoRA: down-projections replicated (rank is tiny), up-projections
+    # head-sharded like their base weights.
+    "la_q": P(None, None, None, None),       # [L, A+1, H, r]
+    "lb_q": P(None, None, None, TP_AXIS),    # [L, A+1, r, Nq*D]
+    "la_v": P(None, None, None, None),
+    "lb_v": P(None, None, None, TP_AXIS),    # [L, A+1, r, K*D]
     "w_gate": P(None, None, TP_AXIS),  # [L, H, F]
     "w_up": P(None, None, TP_AXIS),
     "w_down": P(None, TP_AXIS, None),  # [L, F, H]

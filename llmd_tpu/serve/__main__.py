@@ -13,6 +13,14 @@ import json
 import logging
 
 
+def parse_lora_adapters(spec: str | None) -> dict[str, int]:
+    """'a,b' -> {'a': 1, 'b': 2}; deduplicated, order-preserving."""
+    if not spec:
+        return {}
+    names = list(dict.fromkeys(n.strip() for n in spec.split(",") if n.strip()))
+    return {name: i + 1 for i, name in enumerate(names)}
+
+
 def make_engine_config(args):
     from llmd_tpu.config import (
         CacheConfig,
@@ -23,7 +31,12 @@ def make_engine_config(args):
     )
     from llmd_tpu.models.registry import get_model_config
 
-    model = get_model_config(args.model, max_model_len=args.max_model_len)
+    overrides = {"max_model_len": args.max_model_len}
+    adapters = parse_lora_adapters(args.lora_adapters)
+    if adapters:
+        overrides["num_lora_adapters"] = len(adapters)
+        overrides["lora_rank"] = args.lora_rank
+    model = get_model_config(args.model, **overrides)
     kv_cfg = json.loads(args.kv_transfer_config) if args.kv_transfer_config else {}
     return EngineConfig(
         model=model,
@@ -110,6 +123,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-offload-fs-dir", default=None, help="FS spill tier dir")
     p.add_argument("--skip-warmup", action="store_true")
     p.add_argument(
+        "--lora-adapters", default=None,
+        help="comma-separated adapter names to serve (each becomes a model "
+        "id; random-init weights of --lora-rank until checkpoint loading)",
+    )
+    p.add_argument("--lora-rank", type=int, default=16)
+    p.add_argument(
         "--otlp-traces-endpoint", default=None,
         help="OTLP/HTTP collector base URL (e.g. http://otel:4318)",
     )
@@ -167,11 +186,13 @@ def main(argv=None) -> None:
         n = engine.runner.warmup()
         logging.info("warmup compiled %d programs", n)
     tokenizer = load_tokenizer(args.tokenizer)
+    lora_adapters = parse_lora_adapters(args.lora_adapters) or None
     app = build_app(
         AsyncEngine(engine),
         tokenizer,
         args.served_model_name or args.model,
         config.model.max_model_len,
+        lora_adapters=lora_adapters,
     )
     web.run_app(app, host=args.host, port=args.port)
 

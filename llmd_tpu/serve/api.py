@@ -39,6 +39,8 @@ TOK_KEY = web.AppKey("llmd_tokenizer", object)
 MODEL_KEY = web.AppKey("llmd_model_name", str)
 MAXLEN_KEY = web.AppKey("llmd_max_model_len", int)
 MM_SESSION_KEY = web.AppKey("llmd_mm_session", object)
+# adapter name -> slot id (1-based; the base model is slot 0)
+LORA_KEY = web.AppKey("llmd_lora_adapters", dict)
 
 _EC_HOST_RE = re.compile(r"[A-Za-z0-9_.\-]{1,253}:\d{1,5}")
 _EC_DIGEST_RE = re.compile(r"[0-9a-f]{16,64}")
@@ -177,11 +179,14 @@ async def _collect(
     detok: Detokenizer,
     priority: int,
     kv_transfer_params: dict | None,
+    lora_id: int = 0,
+    lora_name: str = "",
 ):
     """Run to completion; returns (text, finish_reason, final RequestOutput)."""
     finish = None
     final: RequestOutput | None = None
-    async for out in engine.generate(rid, prompt_ids, sampling, priority, kv_transfer_params):
+    async for out in engine.generate(rid, prompt_ids, sampling, priority,
+                                     kv_transfer_params, lora_id, lora_name):
         detok.feed(out.new_token_ids, final=out.finished)
         final = out
         if detok.stopped:
@@ -203,18 +208,31 @@ async def handle_health(request: web.Request) -> web.Response:
 
 async def handle_models(request: web.Request) -> web.Response:
     model = request.app[MODEL_KEY]
+    entries = [
+        {
+            "id": model,
+            "object": "model",
+            "created": int(time.time()),
+            "owned_by": "llmd-tpu",
+            "max_model_len": request.app[MAXLEN_KEY],
+        }
+    ]
+    # LoRA adapters serve under their own model ids (vLLM convention).
+    for name in request.app.get(LORA_KEY) or {}:
+        entries.append(
+            {
+                "id": name,
+                "object": "model",
+                "created": int(time.time()),
+                "owned_by": "llmd-tpu",
+                "parent": model,
+                "max_model_len": request.app[MAXLEN_KEY],
+            }
+        )
     return web.json_response(
         {
             "object": "list",
-            "data": [
-                {
-                    "id": model,
-                    "object": "model",
-                    "created": int(time.time()),
-                    "owned_by": "llmd-tpu",
-                    "max_model_len": request.app[MAXLEN_KEY],
-                }
-            ],
+            "data": entries,
         }
     )
 
@@ -280,6 +298,8 @@ async def _stream_response(
     kv_transfer_params: dict | None,
     chat: bool,
     span=None,
+    lora_id: int = 0,
+    lora_name: str = "",
 ) -> web.StreamResponse:
     resp = web.StreamResponse(
         headers={
@@ -295,7 +315,8 @@ async def _stream_response(
     n_out = 0
     cached = 0
     try:
-        async for out in engine.generate(rid, prompt_ids, sampling, priority, kv_transfer_params):
+        async for out in engine.generate(rid, prompt_ids, sampling, priority,
+                                         kv_transfer_params, lora_id, lora_name):
             delta = detok.feed(out.new_token_ids, final=out.finished)
             n_out = out.num_output_tokens
             cached = out.num_cached_tokens
@@ -336,6 +357,23 @@ async def _stream_response(
     return resp
 
 
+class UnknownModelError(Exception):
+    pass
+
+
+def _resolve_lora(request: web.Request, model: str) -> tuple[int, str]:
+    """Model id -> (lora slot, adapter name). With adapters configured,
+    an id that is neither the base model nor an adapter is a client error
+    (adapters are advertised as distinct model ids; silently serving the
+    base for a typo'd name masks misconfiguration)."""
+    adapters = request.app.get(LORA_KEY) or {}
+    if model in adapters:
+        return adapters[model], model
+    if adapters and model and model != request.app[MODEL_KEY]:
+        raise UnknownModelError(model)
+    return 0, ""
+
+
 async def _handle_generate(request: web.Request, chat: bool) -> web.StreamResponse:
     engine = request.app[ENGINE_KEY]
     tokenizer = request.app[TOK_KEY]
@@ -369,6 +407,10 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     rid = request.headers.get("x-request-id") or P.request_id(
         "chatcmpl" if chat else "cmpl"
     )
+    try:
+        lora_id, lora_name = _resolve_lora(request, req.model)
+    except UnknownModelError:
+        return _error(404, f"model {req.model!r} not found")
     detok = Detokenizer(tokenizer, P.stop_strings(req.stop))
     # Engine-side span continues the router's traceparent (reference
     # tracing.md: per-hop spans; cache-hit attribution via cached tokens).
@@ -386,6 +428,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
             return await _stream_response(
                 request, engine, rid, model, prompt_ids, sampling, detok,
                 req.priority, req.kv_transfer_params, chat, span,
+                lora_id, lora_name,
             )
         except BaseException as e:
             span.error(str(e))
@@ -394,7 +437,8 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
             span.end()
     try:
         text, finish, final = await _collect(
-            engine, rid, prompt_ids, sampling, detok, req.priority, req.kv_transfer_params
+            engine, rid, prompt_ids, sampling, detok, req.priority,
+            req.kv_transfer_params, lora_id, lora_name,
         )
     except RequestFailed as e:
         span.error(str(e))
@@ -480,6 +524,10 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         return _error(400, f"invalid sampling_params: {e}")
     rid = request.headers.get("x-request-id") or P.request_id("grpcgen")
     kvp = body.get("kv_transfer_params")
+    try:
+        lora_id, lora_name = _resolve_lora(request, str(body.get("model") or ""))
+    except UnknownModelError as e:
+        return _error(404, f"model {e.args[0]!r} not found")
 
     if body.get("stream", False):
         resp = web.StreamResponse(
@@ -492,7 +540,8 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         await resp.prepare(request)
         final = None
         try:
-            async for out in engine.generate(rid, ids, sampling, priority, kvp):
+            async for out in engine.generate(rid, ids, sampling, priority, kvp,
+                                             lora_id, lora_name):
                 final = out
                 if out.new_token_ids:
                     await resp.write(_sse({"token_ids": list(out.new_token_ids)}))
@@ -527,7 +576,8 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
     out_ids: list[int] = []
     final = None
     try:
-        async for out in engine.generate(rid, ids, sampling, priority, kvp):
+        async for out in engine.generate(rid, ids, sampling, priority, kvp,
+                                         lora_id, lora_name):
             final = out
             out_ids.extend(out.new_token_ids)
     except RequestFailed as e:
@@ -645,12 +695,14 @@ def build_app(
     model_name: str,
     max_model_len: int,
     extra_routes: list | None = None,
+    lora_adapters: dict[str, int] | None = None,
 ) -> web.Application:
     app = web.Application()
     app[ENGINE_KEY] = engine
     app[TOK_KEY] = tokenizer
     app[MODEL_KEY] = model_name
     app[MAXLEN_KEY] = max_model_len
+    app[LORA_KEY] = dict(lora_adapters or {})
     app.add_routes(
         [
             web.get("/health", handle_health),

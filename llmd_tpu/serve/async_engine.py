@@ -28,6 +28,8 @@ class _Pending:
     sampling: SamplingParams
     priority: int = 0
     kv_transfer_params: dict[str, Any] | None = None
+    lora_id: int = 0
+    lora_name: str = ""
 
 
 class RequestFailed(Exception):
@@ -116,6 +118,8 @@ class AsyncEngine:
         sampling: SamplingParams,
         priority: int = 0,
         kv_transfer_params: dict[str, Any] | None = None,
+        lora_id: int = 0,
+        lora_name: str = "",
     ) -> asyncio.Queue:
         """Queue a request for the engine thread; returns its output queue."""
         q: asyncio.Queue = asyncio.Queue()
@@ -124,7 +128,8 @@ class AsyncEngine:
                 raise RequestFailed(f"duplicate request id {request_id}")
             self._subs[request_id] = q
             self._inbox.append(
-                _Pending(request_id, prompt_token_ids, sampling, priority, kv_transfer_params)
+                _Pending(request_id, prompt_token_ids, sampling, priority,
+                         kv_transfer_params, lora_id, lora_name)
             )
             self._lock.notify_all()
         return q
@@ -142,6 +147,8 @@ class AsyncEngine:
         sampling: SamplingParams,
         priority: int = 0,
         kv_transfer_params: dict[str, Any] | None = None,
+        lora_id: int = 0,
+        lora_name: str = "",
     ) -> AsyncIterator[RequestOutput]:
         """Async stream of incremental outputs until the request finishes."""
         # P/D consumer: run the (potentially slow) remote-KV pull on an
@@ -158,7 +165,8 @@ class AsyncEngine:
             except Exception as e:  # KVLoadError under policy='fail'
                 raise EngineError(f"remote KV load failed: {e}") from e
             kv_transfer_params = {**kv_transfer_params, "__pulled__": bundle}
-        q = self.submit(request_id, prompt_token_ids, sampling, priority, kv_transfer_params)
+        q = self.submit(request_id, prompt_token_ids, sampling, priority,
+                        kv_transfer_params, lora_id, lora_name)
         try:
             while True:
                 item = await q.get()
@@ -214,6 +222,8 @@ class AsyncEngine:
                         request_id=p.request_id,
                         priority=p.priority,
                         kv_transfer_params=p.kv_transfer_params,
+                        lora_id=p.lora_id,
+                        lora_name=p.lora_name,
                     )
                 except Exception as e:  # validation errors -> caller
                     self._deliver(p.request_id, RequestFailed(str(e)))

@@ -32,6 +32,18 @@ def render_metrics(stats: EngineStats, model_name: str) -> str:
         "kv_offload_restores_total": stats.offload_restores,
     }
     lines: list[str] = []
+    if stats.max_lora:
+        # reference model-servers.md:78-89: adapter state rides labels on
+        # a gauge named vllm:lora_requests_info.
+        running = ",".join(stats.running_lora_adapters)
+        waiting = ",".join(stats.waiting_lora_adapters)
+        lines.append("# TYPE vllm:lora_requests_info gauge")
+        lines.append(
+            f'vllm:lora_requests_info{{max_lora="{stats.max_lora}",'
+            f'running_lora_adapters="{running}",'
+            f'waiting_lora_adapters="{waiting}",'
+            f'model_name="{model_name}"}} 1'
+        )
     for family in ("vllm", "llmd"):
         for name, v in gauges.items():
             lines.append(f"# TYPE {family}:{name} gauge")
