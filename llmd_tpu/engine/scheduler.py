@@ -205,7 +205,11 @@ class EngineScheduler:
         """Cache-identity discriminator: LoRA-adapted KV (v is adapted)
         must never be shared across adapters or with the base model
         (reference kv-indexer.md:145-151 key folding)."""
-        return f"lora:{req.lora_id}".encode() if req.lora_id else b""
+        if not req.lora_id:
+            return b""
+        # Salt by NAME (stable across engine processes and the router's
+        # token-producer); slot ids are process-local.
+        return f"lora:{req.lora_name or req.lora_id}".encode()
 
     def _apply_prefix_cache(self, req: Request) -> None:
         """Reuse cached full pages covering the prompt prefix."""

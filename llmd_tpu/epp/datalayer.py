@@ -84,6 +84,11 @@ def extract_attrs(text: str, engine_type: str = "vllm") -> dict[str, float]:
                     out["WaitingAdapters"] = [
                         a.strip() for a in m.group(1).split(",") if a.strip()
                     ]
+                m = re.search(r'available_lora_adapters="([^"]*)"', line)
+                if m:
+                    out["AvailableAdapters"] = [
+                        a.strip() for a in m.group(1).split(",") if a.strip()
+                    ]
                 break
     # cache_config_info labels carry block geometry; parse_prometheus drops
     # labels, so read them directly if present.

@@ -76,7 +76,13 @@ class TokenProducer:
         page = self._page_size(pods)
         token_ids = req.prompt_token_ids
         if token_ids is None:
-            key = (hash(req.prompt_text), page)
+            extra0 = b""
+            if req.model:
+                for p in pods:
+                    if req.model in (p.attrs.get("AvailableAdapters") or ()):
+                        extra0 = f"lora:{req.model}".encode()
+                        break
+            key = (hash(req.prompt_text), page, extra0)
             cached = self._cache.get(key)
             if cached is not None:
                 self._cache.move_to_end(key)
@@ -86,10 +92,22 @@ class TokenProducer:
             if token_ids is None:
                 return  # no render endpoint reachable; precise scoring skipped
         token_ids = token_ids[: self.max_prefix_tokens]
-        hashes = [h.hex() for h in page_hashes_for_tokens(token_ids, page)]
+        # LoRA key folding (reference kv-indexer.md:145-151): engines salt
+        # adapter pages with `lora:<name>`; fold the same salt when the
+        # requested model id is a registered adapter on any pod, or
+        # unsalted hashes would (mis)match base-model pages.
+        extra = b""
+        if req.model:
+            for p in pods:
+                if req.model in (p.attrs.get("AvailableAdapters") or ()):
+                    extra = f"lora:{req.model}".encode()
+                    break
+        hashes = [
+            h.hex() for h in page_hashes_for_tokens(token_ids, page, extra)
+        ]
         req.scratch[SCRATCH_BLOCK_HASHES] = hashes
         if req.prompt_token_ids is None:
-            self._cache[(hash(req.prompt_text), page)] = hashes
+            self._cache[(hash(req.prompt_text), page, extra)] = hashes
             while len(self._cache) > self.cache_size:
                 self._cache.popitem(last=False)
 

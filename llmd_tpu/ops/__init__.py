@@ -15,7 +15,10 @@ import os
 import jax
 import jax.numpy as jnp
 
-from llmd_tpu.ops.paged_attention import paged_attention_xla
+from llmd_tpu.ops.paged_attention import (
+    paged_attention_xla,
+    paged_attention_xla_blocked,
+)
 from llmd_tpu.ops.paged_attention import write_kv_pages as write_kv_pages_xla
 from llmd_tpu.ops.kv_write import (
     write_kv_pages_decode,
@@ -68,6 +71,23 @@ def _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d: bool) -> bool:
 
 def _interpret() -> bool:
     return _mode() == "interpret"
+
+
+# Above this context size the dense XLA attention's [B, Q, .., S] score
+# tensor dominates memory (it grows as chunk x context); switch to the
+# blocked online-softmax form.
+_DENSE_XLA_MAX_S = 4096
+
+
+def _attention_xla(q, kv_slice, page_table, kv_lens, positions, sm_scale):
+    S = page_table.shape[1] * kv_slice.shape[-2]
+    if q.shape[1] > 1 and S > _DENSE_XLA_MAX_S:
+        return paged_attention_xla_blocked(
+            q, kv_slice, page_table, kv_lens, positions, sm_scale
+        )
+    return paged_attention_xla(
+        q, kv_slice, page_table, kv_lens, positions, sm_scale
+    )
 
 
 def _decode_write_prep(k, v, page_table, positions, page):
@@ -136,7 +156,7 @@ def paged_attention(
             q, kv_cache, page_table, kv_lens, sm_scale=sm_scale,
             interpret=_interpret(),
         )
-    return paged_attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)
+    return _attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)
 
 
 def mla_paged_attention_full(
@@ -188,4 +208,4 @@ def paged_attention_full(
             interpret=_interpret(),
         )
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
-    return paged_attention_xla(q, sl, page_table, kv_lens, positions, sm_scale)
+    return _attention_xla(q, sl, page_table, kv_lens, positions, sm_scale)

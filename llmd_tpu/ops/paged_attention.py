@@ -54,6 +54,84 @@ def write_kv_pages(
     ].set(kv_flat, mode="drop")
 
 
+def paged_attention_xla_blocked(
+    q: jax.Array,  # [B, Q, H, D]
+    kv_cache: jax.Array,  # [num_pages, K, page, 2D]
+    page_table: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B]
+    positions: jax.Array,  # [B, Q]
+    sm_scale: float | None = None,
+    block_pages: int = 32,
+) -> jax.Array:
+    """Flash-style blocked paged attention in plain XLA.
+
+    The dense path materializes [B, Q, K, G, S] scores — at 16k context
+    with an 8k prefill chunk that is a ~100GB tensor. This version scans
+    page blocks with an online-softmax carry (m, l, acc), so peak memory
+    is O(B * Q * block) regardless of context length. Used for long
+    contexts; the dense path remains the small-shape oracle.
+    """
+    B, Q, H, D = q.shape
+    num_pages, K, page, D2 = kv_cache.shape
+    max_pages = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    if max_pages % block_pages:
+        pad = block_pages - max_pages % block_pages
+        # repeat last page id: masked out by kv_lens anyway
+        page_table = jnp.concatenate(
+            [page_table, jnp.repeat(page_table[:, -1:], pad, axis=1)], axis=1
+        )
+        max_pages += pad
+    n_blocks = max_pages // block_pages
+    Sb = block_pages * page
+    G = H // K
+    qg = q.reshape(B, Q, K, G, D)
+
+    def body(carry, blk):
+        m, l, acc = carry
+        pt_blk = jax.lax.dynamic_slice_in_dim(
+            page_table, blk * block_pages, block_pages, axis=1
+        )  # [B, bp]
+        kv = kv_cache[pt_blk]  # [B, bp, K, page, 2D]
+        kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, Sb, K, D2)
+        k = kv[..., :D]
+        v = kv[..., D:]
+        s = (
+            jnp.einsum(
+                "bqkgd,bskd->bqkgs", qg, k, preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )  # [B, Q, K, G, Sb]
+        key_pos = blk * Sb + jnp.arange(Sb)[None, None, :]
+        causal = key_pos <= positions[:, :, None]
+        in_ctx = key_pos < kv_lens[:, None, None]
+        mask = (causal & in_ctx)[:, :, None, None, :]
+        s = jnp.where(mask, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # [B, Q, K, G]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        # fully-masked rows: m_new stays -1e30, p rows ~e^0=1 — zero them
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum(
+            "bqkgs,bskd->bqkgd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * alpha[..., None] + pv
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, Q, K, G), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, Q, K, G), jnp.float32)
+    acc0 = jnp.zeros((B, Q, K, G, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), jnp.arange(n_blocks, dtype=jnp.int32)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l[..., None]
+    return out.reshape(B, Q, H, D).astype(q.dtype)
+
+
 def paged_attention_xla(
     q: jax.Array,  # [B, Q, H, D]
     kv_cache: jax.Array,  # [num_pages, K, page, 2D]

@@ -14,14 +14,25 @@ import logging
 
 
 def parse_lora_adapters(spec: str | None) -> dict[str, int]:
-    """'a,b' -> {'a': 1, 'b': 2}; deduplicated, order-preserving."""
+    """'a,b' -> {'a': 1, 'b': 2}; deduplicated, order-preserving.
+
+    Names are restricted to Prometheus-label-safe characters: they are
+    interpolated into the lora_requests_info label values, and a quote
+    or backslash would corrupt the exposition page."""
     if not spec:
         return {}
+    import re
+
     names = list(dict.fromkeys(n.strip() for n in spec.split(",") if n.strip()))
+    for n in names:
+        if not re.fullmatch(r"[A-Za-z0-9._:/-]+", n):
+            raise ValueError(
+                f"invalid adapter name {n!r}: use letters, digits, ._:/-"
+            )
     return {name: i + 1 for i, name in enumerate(names)}
 
 
-def make_engine_config(args):
+def make_engine_config(args, lora_adapters=None):
     from llmd_tpu.config import (
         CacheConfig,
         EngineConfig,
@@ -32,9 +43,8 @@ def make_engine_config(args):
     from llmd_tpu.models.registry import get_model_config
 
     overrides = {"max_model_len": args.max_model_len}
-    adapters = parse_lora_adapters(args.lora_adapters)
-    if adapters:
-        overrides["num_lora_adapters"] = len(adapters)
+    if lora_adapters:
+        overrides["num_lora_adapters"] = len(lora_adapters)
         overrides["lora_rank"] = args.lora_rank
     model = get_model_config(args.model, **overrides)
     kv_cfg = json.loads(args.kv_transfer_config) if args.kv_transfer_config else {}
@@ -155,7 +165,8 @@ def main(argv=None) -> None:
     from llmd_tpu.serve.async_engine import AsyncEngine
     from llmd_tpu.serve.tokenizer import load_tokenizer
 
-    config = make_engine_config(args)
+    lora_adapters = parse_lora_adapters(args.lora_adapters) or None
+    config = make_engine_config(args, lora_adapters)
     advertised = args.advertised_address or f"{args.host}:{args.port}"
     if advertised.startswith("0.0.0.0"):
         logging.warning(
@@ -186,7 +197,6 @@ def main(argv=None) -> None:
         n = engine.runner.warmup()
         logging.info("warmup compiled %d programs", n)
     tokenizer = load_tokenizer(args.tokenizer)
-    lora_adapters = parse_lora_adapters(args.lora_adapters) or None
     app = build_app(
         AsyncEngine(engine),
         tokenizer,

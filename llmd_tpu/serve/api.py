@@ -240,7 +240,10 @@ async def handle_models(request: web.Request) -> web.Response:
 async def handle_metrics(request: web.Request) -> web.Response:
     engine = request.app[ENGINE_KEY]
     return web.Response(
-        text=render_metrics(engine.stats, request.app[MODEL_KEY]),
+        text=render_metrics(
+            engine.stats, request.app[MODEL_KEY],
+            request.app.get(LORA_KEY) or None,
+        ),
         content_type="text/plain",
     )
 
@@ -411,6 +414,8 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         lora_id, lora_name = _resolve_lora(request, req.model)
     except UnknownModelError:
         return _error(404, f"model {req.model!r} not found")
+    if lora_name:
+        model = lora_name  # responses echo the requested adapter id
     detok = Detokenizer(tokenizer, P.stop_strings(req.stop))
     # Engine-side span continues the router's traceparent (reference
     # tracing.md: per-hop spans; cache-hit attribution via cached tokens).

@@ -13,7 +13,9 @@ from __future__ import annotations
 from llmd_tpu.engine.engine import EngineStats
 
 
-def render_metrics(stats: EngineStats, model_name: str) -> str:
+def render_metrics(
+    stats: EngineStats, model_name: str, lora_adapters: dict | None = None
+) -> str:
     label = f'{{model_name="{model_name}"}}'
     gauges = {
         "num_requests_waiting": stats.num_waiting,
@@ -34,14 +36,19 @@ def render_metrics(stats: EngineStats, model_name: str) -> str:
     lines: list[str] = []
     if stats.max_lora:
         # reference model-servers.md:78-89: adapter state rides labels on
-        # a gauge named vllm:lora_requests_info.
+        # a gauge named vllm:lora_requests_info. available_lora_adapters
+        # is this framework's extension: the FULL registered set, so the
+        # router can fold adapter identity into prefix hashes even for
+        # adapters with nothing in flight.
         running = ",".join(stats.running_lora_adapters)
         waiting = ",".join(stats.waiting_lora_adapters)
+        available = ",".join(sorted(lora_adapters or ()))
         lines.append("# TYPE vllm:lora_requests_info gauge")
         lines.append(
             f'vllm:lora_requests_info{{max_lora="{stats.max_lora}",'
             f'running_lora_adapters="{running}",'
             f'waiting_lora_adapters="{waiting}",'
+            f'available_lora_adapters="{available}",'
             f'model_name="{model_name}"}} 1'
         )
     for family in ("vllm", "llmd"):

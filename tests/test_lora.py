@@ -72,7 +72,7 @@ def test_mixed_adapter_batch():
     sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
     prompt = list(range(1, 11))
     rids = {
-        engine.add_request(prompt, sp, lora_id=i, lora_name=f"ad{i}"): i
+        engine.add_request(prompt, sp, lora_id=i, lora_name=f"ad{i}" if i else ""): i
         for i in (0, 1, 2)
     }
     out = {}
@@ -137,9 +137,14 @@ def test_prefix_cache_isolated_per_adapter():
         return out[rid]
 
     base1 = gen(0)
-    hits_before = engine.allocator.hit_ratio()
+    # The invariant itself: base pages are findable with the base (empty)
+    # salt but NOT with an adapter salt — and vice versa after an adapter
+    # run. A shared page would show up under the other identity.
+    assert engine.allocator.lookup_cached_prefix(prompt) != []
+    assert engine.allocator.lookup_cached_prefix(prompt, extra=b"lora:1") == []
     a1_first = gen(1)   # must not reuse base pages
     a1_second = gen(1)  # same adapter: cache hit allowed, same output
+    assert engine.allocator.lookup_cached_prefix(prompt, extra=b"lora:1") != []
     base2 = gen(0)      # base unaffected by adapter pages
     assert a1_first == a1_second
     assert base2 == base1
