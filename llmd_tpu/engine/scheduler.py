@@ -208,8 +208,12 @@ class EngineScheduler:
         if not req.lora_id:
             return b""
         # Salt by NAME (stable across engine processes and the router's
-        # token-producer); slot ids are process-local.
-        return f"lora:{req.lora_name or req.lora_id}".encode()
+        # token-producer, which folds `lora:<model>`). Unnamed requests
+        # salt in a DISTINCT namespace: a digit-only adapter name must
+        # never collide with a raw slot id.
+        if req.lora_name:
+            return f"lora:{req.lora_name}".encode()
+        return f"lora-slot:{req.lora_id}".encode()
 
     def _apply_prefix_cache(self, req: Request) -> None:
         """Reuse cached full pages covering the prompt prefix."""

@@ -74,24 +74,6 @@ class TokenProducer:
         if SCRATCH_BLOCK_HASHES in req.scratch or not pods:
             return
         page = self._page_size(pods)
-        token_ids = req.prompt_token_ids
-        if token_ids is None:
-            extra0 = b""
-            if req.model:
-                for p in pods:
-                    if req.model in (p.attrs.get("AvailableAdapters") or ()):
-                        extra0 = f"lora:{req.model}".encode()
-                        break
-            key = (hash(req.prompt_text), page, extra0)
-            cached = self._cache.get(key)
-            if cached is not None:
-                self._cache.move_to_end(key)
-                req.scratch[SCRATCH_BLOCK_HASHES] = cached
-                return
-            token_ids = await self._tokenize(req, pods)
-            if token_ids is None:
-                return  # no render endpoint reachable; precise scoring skipped
-        token_ids = token_ids[: self.max_prefix_tokens]
         # LoRA key folding (reference kv-indexer.md:145-151): engines salt
         # adapter pages with `lora:<name>`; fold the same salt when the
         # requested model id is a registered adapter on any pod, or
@@ -102,6 +84,18 @@ class TokenProducer:
                 if req.model in (p.attrs.get("AvailableAdapters") or ()):
                     extra = f"lora:{req.model}".encode()
                     break
+        token_ids = req.prompt_token_ids
+        if token_ids is None:
+            key = (hash(req.prompt_text), page, extra)
+            cached = self._cache.get(key)
+            if cached is not None:
+                self._cache.move_to_end(key)
+                req.scratch[SCRATCH_BLOCK_HASHES] = cached
+                return
+            token_ids = await self._tokenize(req, pods)
+            if token_ids is None:
+                return  # no render endpoint reachable; precise scoring skipped
+        token_ids = token_ids[: self.max_prefix_tokens]
         hashes = [
             h.hex() for h in page_hashes_for_tokens(token_ids, page, extra)
         ]

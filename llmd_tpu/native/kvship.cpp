@@ -217,7 +217,11 @@ class Server {
           {
             std::lock_guard<std::mutex> lk(mu_);
             auto it = entries_.find(key);
-            if (it != entries_.end()) {
+            // Expiry is enforced at access time, not just by the 500ms
+            // reaper sweep: serving a pull after the lease lapsed would
+            // break the reclamation contract (the producer may already
+            // treat the pages as free).
+            if (it != entries_.end() && it->second.deadline > Clock::now()) {
               data = it->second.data;  // copy out so the lock isn't held on send
               st = ST_OK;
             }
@@ -240,7 +244,9 @@ class Server {
           {
             std::lock_guard<std::mutex> lk(mu_);
             auto it = entries_.find(key);
-            if (it != entries_.end()) {
+            // A lapsed lease cannot be resurrected: the producer may have
+            // reclaimed the pages between expiry and this heartbeat.
+            if (it != entries_.end() && it->second.deadline > Clock::now()) {
               it->second.deadline =
                   Clock::now() + std::chrono::milliseconds(lease_ms);
               st = ST_OK;

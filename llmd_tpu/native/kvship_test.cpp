@@ -1,0 +1,101 @@
+// Concurrency self-test for the kvship transfer core.
+//
+// Exercises the producer/consumer paths the Python tests cover, but with
+// genuine thread-level contention so TSAN/ASAN builds can catch data
+// races and lifetime bugs (SURVEY.md §5.2: the reference documents its
+// concurrency hazards instead of sanitizing them; this framework runs
+// sanitizers over the native transfer layer in CI).
+//
+// Build & run:  make test        (plain)
+//               make tsan        (ThreadSanitizer)
+//               make asan        (AddressSanitizer)
+
+#include <atomic>
+#include <chrono>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* kvship_server_create(uint16_t port);
+int kvship_server_port(void* h);
+void kvship_server_destroy(void* h);
+int kvship_register(void* h, const char* key, const uint8_t* data,
+                    uint64_t len, uint64_t lease_ms);
+int kvship_unregister(void* h, const char* key);
+uint64_t kvship_registered_bytes(void* h);
+uint64_t kvship_registered_count(void* h);
+int kvship_pull(const char* host, uint16_t port, const char* key,
+                uint8_t** out, uint64_t* out_len);
+void kvship_buf_free(uint8_t* buf);
+int kvship_free_notify(const char* host, uint16_t port, const char* key);
+int kvship_renew(const char* host, uint16_t port, const char* key,
+                 uint64_t lease_ms);
+}
+
+int main() {
+  void* srv = kvship_server_create(0);  // ephemeral port
+  assert(srv != nullptr);
+  const int port = kvship_server_port(srv);
+  assert(port > 0);
+
+  constexpr int kThreads = 8;
+  constexpr int kKeysPerThread = 24;
+  std::atomic<int> pulls_ok{0}, pulls_missing{0}, frees_ok{0};
+
+  // Producer threads register/unregister; consumer threads pull, renew
+  // and free-notify the same key space concurrently.
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&, t] {
+      char key[64];
+      std::vector<uint8_t> payload(4096, static_cast<uint8_t>(t));
+      for (int i = 0; i < kKeysPerThread; ++i) {
+        snprintf(key, sizeof(key), "k-%d-%d", t % 4, i);  // overlapping keys
+        if (t < 4) {  // producers
+          kvship_register(srv, key, payload.data(), payload.size(), 30000);
+          if (i % 3 == 0) kvship_unregister(srv, key);
+        } else {  // consumers
+          uint8_t* buf = nullptr;
+          uint64_t len = 0;
+          int rc = kvship_pull("127.0.0.1", static_cast<uint16_t>(port), key,
+                               &buf, &len);
+          if (rc == 0) {
+            assert(len == 4096);
+            pulls_ok.fetch_add(1);
+            kvship_buf_free(buf);
+            kvship_renew("127.0.0.1", static_cast<uint16_t>(port), key, 10000);
+            if (kvship_free_notify("127.0.0.1", static_cast<uint16_t>(port),
+                                   key) == 0)
+              frees_ok.fetch_add(1);
+          } else {
+            pulls_missing.fetch_add(1);
+          }
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+
+  // Lease expiry path: a short-lease key must disappear on its own.
+  const uint8_t tiny[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+  kvship_register(srv, "short-lease", tiny, sizeof(tiny), 50);
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  uint8_t* buf = nullptr;
+  uint64_t len = 0;
+  int rc = kvship_pull("127.0.0.1", static_cast<uint16_t>(port), "short-lease",
+                       &buf, &len);
+  assert(rc != 0 && "expired lease must not be pullable");
+
+  std::printf(
+      "kvship_test ok: pulls_ok=%d pulls_missing=%d frees_ok=%d "
+      "registered_count=%llu\n",
+      pulls_ok.load(), pulls_missing.load(), frees_ok.load(),
+      static_cast<unsigned long long>(kvship_registered_count(srv)));
+  kvship_server_destroy(srv);
+  return 0;
+}

@@ -141,10 +141,10 @@ def test_prefix_cache_isolated_per_adapter():
     # salt but NOT with an adapter salt — and vice versa after an adapter
     # run. A shared page would show up under the other identity.
     assert engine.allocator.lookup_cached_prefix(prompt) != []
-    assert engine.allocator.lookup_cached_prefix(prompt, extra=b"lora:1") == []
+    assert engine.allocator.lookup_cached_prefix(prompt, extra=b"lora-slot:1") == []
     a1_first = gen(1)   # must not reuse base pages
     a1_second = gen(1)  # same adapter: cache hit allowed, same output
-    assert engine.allocator.lookup_cached_prefix(prompt, extra=b"lora:1") != []
+    assert engine.allocator.lookup_cached_prefix(prompt, extra=b"lora-slot:1") != []
     base2 = gen(0)      # base unaffected by adapter pages
     assert a1_first == a1_second
     assert base2 == base1
