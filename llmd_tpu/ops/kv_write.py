@@ -32,8 +32,11 @@ def _write_kernel(
     kv_new_ref,  # [1, K, 1, 2D] VMEM (this token's K/V slab)
     kv_hbm_ref,  # [(L,) num_pages, K, page, 2D] ANY (aliased into out)
     out_ref,     # same buffer as kv_hbm_ref
-    # scratch
-    page_buf,    # [K, page, 2D] VMEM
+    # scratch (scratch_shapes buffers persist across grid steps — the
+    # documented substrate for cross-step software pipelines)
+    page_buf,    # [2, K, page, 2D] VMEM double buffer
+    sem_in,      # [2] DMA
+    sem_out,     # [2] DMA
 ):
     """Read-modify-write of the token's page: a direct single-row DMA into
     HBM violates the (8,128) sublane tiling, so the whole [K, page, 2D]
@@ -41,35 +44,51 @@ def _write_kernel(
     launch target distinct pages (decode: one token per sequence, and the
     allocator never shares a page across sequences)."""
     t = pl.program_id(0)
+    T = pl.num_programs(0)
     is_full = len(kv_hbm_ref.shape) == 5
     src = kv_hbm_ref.at[layer_ref[0]] if is_full else kv_hbm_ref
     dst = out_ref.at[layer_ref[0]] if is_full else out_ref
 
-    def body(sem_in, sem_out):
-        @pl.when(valid_ref[t] != 0)
-        def _write():
-            load = pltpu.make_async_copy(
-                src.at[phys_ref[t]], page_buf, sem_in
-            )
-            load.start()
-            load.wait()
-            # Masked select instead of a dynamic-index store: Mosaic cannot
-            # prove sublane alignment for a runtime page offset.
-            rows = jax.lax.broadcasted_iota(jnp.int32, page_buf.shape, 1)
-            page_buf[:] = jnp.where(
-                rows == offset_ref[t], kv_new_ref[0], page_buf[:]
-            )
-            store = pltpu.make_async_copy(
-                page_buf, dst.at[phys_ref[t]], sem_out
-            )
-            store.start()
-            store.wait()
+    # Software pipeline across grid steps (TPU grids run sequentially and
+    # scratch persists): step t waits on the load it started at t-1,
+    # modifies, stores, while t+1's load is already in flight. Each
+    # index's start and wait are gated on the SAME predicate
+    # (valid_ref[i]), so the semaphore protocol stays balanced while pad
+    # rows skip their page DMA entirely (a 64-row bucket with 2 live
+    # sequences would otherwise stream ~4MB/layer/step of discarded
+    # pages).
+    def load(i):
+        slot_i = jax.lax.rem(i, 2)
+        return pltpu.make_async_copy(
+            src.at[phys_ref[i]], page_buf.at[slot_i], sem_in.at[slot_i]
+        )
 
-    pl.run_scoped(
-        body,
-        sem_in=pltpu.SemaphoreType.DMA,
-        sem_out=pltpu.SemaphoreType.DMA,
-    )
+    @pl.when((t == 0) & (valid_ref[0] != 0))
+    def _warmup():
+        load(0).start()
+
+    @pl.when((t + 1 < T) & (valid_ref[jnp.minimum(t + 1, T - 1)] != 0))
+    def _prefetch():
+        load(t + 1).start()
+
+    slot = jax.lax.rem(t, 2)
+
+    @pl.when(valid_ref[t] != 0)
+    def _write():
+        load(t).wait()
+        # Masked select instead of a dynamic-index store: Mosaic cannot
+        # prove sublane alignment for a runtime page offset.
+        buf = page_buf.at[slot]
+        rows = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1)
+        buf[:] = jnp.where(rows == offset_ref[t], kv_new_ref[0], buf[:])
+        store = pltpu.make_async_copy(
+            buf, dst.at[phys_ref[t]], sem_out.at[slot]
+        )
+        store.start()
+        # The slot's next LOAD starts at t+1 (other slot) and t+2 (this
+        # slot); waiting here still overlaps this store with t+1's
+        # in-flight load.
+        store.wait()
 
 
 def _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret):
@@ -83,7 +102,11 @@ def _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret):
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
-        scratch_shapes=[pltpu.VMEM((K, page, D2), kv_cache.dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((2, K, page, D2), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
     )
     kernel = pl.pallas_call(
         _write_kernel,
