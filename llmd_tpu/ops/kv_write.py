@@ -36,7 +36,7 @@ def _write_kernel(
     # documented substrate for cross-step software pipelines)
     page_buf,    # [2, K, page, 2D] VMEM double buffer
     sem_in,      # [2] DMA
-    sem_out,     # [2] DMA
+    sem_out,     # scalar DMA (stores complete in-step; no second slot)
 ):
     """Read-modify-write of the token's page: a direct single-row DMA into
     HBM violates the (8,128) sublane tiling, so the whole [K, page, 2D]
@@ -81,9 +81,7 @@ def _write_kernel(
         buf = page_buf.at[slot]
         rows = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1)
         buf[:] = jnp.where(rows == offset_ref[t], kv_new_ref[0], buf[:])
-        store = pltpu.make_async_copy(
-            buf, dst.at[phys_ref[t]], sem_out.at[slot]
-        )
+        store = pltpu.make_async_copy(buf, dst.at[phys_ref[t]], sem_out)
         store.start()
         # The slot's next LOAD starts at t+1 (other slot) and t+2 (this
         # slot); waiting here still overlaps this store with t+1's
@@ -105,7 +103,7 @@ def _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret):
         scratch_shapes=[
             pltpu.VMEM((2, K, page, D2), kv_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
         ],
     )
     kernel = pl.pallas_call(
