@@ -82,6 +82,14 @@ class WorkloadSpec:
         default_factory=lambda: Distribution(mean=4, min=1, max=64)
     )
     system_prompt_tokens: int = 512
+    # History cap (tokens): long conversations keep the system prompt and
+    # slide the rest, like real agent frameworks (the reference profile's
+    # max_model_len knob). The cap converts to characters via
+    # chars_per_token: ~4 for BPE tokenizers; set ~1 (and/or a smaller
+    # cap) for byte-level tokenizers or the trimmed prompt still exceeds
+    # the server's max_model_len.
+    max_context_tokens: int = 8000
+    chars_per_token: float = 4.0
     streaming: bool = True
     api: str = "completion"  # completion | chat
     ignore_eos: bool = True
@@ -147,6 +155,13 @@ class PromptSource:
             conv = self.rng.choice(self._conversations)
             conv[0] = conv[0] + " " + synth_text(self.rng, isl)
             conv[1] -= 1
+            # sliding window: keep the shared system prompt + recent tail
+            max_chars = int(spec.max_context_tokens * spec.chars_per_token)
+            if len(conv[0]) > max_chars:
+                keep = max_chars - len(self._system)
+                # NB: [-keep:] with keep==0 would be [0:] — the WHOLE string
+                tail = conv[0][-keep:] if keep > 0 else ""
+                conv[0] = self._system + tail
             prompt = conv[0]
             if conv[1] <= 0:
                 self._conversations.remove(conv)
@@ -209,10 +224,15 @@ PROFILES: dict[str, WorkloadSpec] = {
 
 def get_profile(name: str, **overrides) -> WorkloadSpec:
     """Profile by name with per-run field overrides (the CLI
-    `--overrides key=value` mechanism)."""
+    `--overrides key=value` mechanism). Structured fields given as JSON
+    (stages, token distributions) are rebuilt into their dataclasses."""
     spec = dataclasses.replace(PROFILES[name])
     for k, v in overrides.items():
         if not hasattr(spec, k):
             raise KeyError(f"unknown workload field {k!r}")
+        if k == "stages" and isinstance(v, list):
+            v = [s if isinstance(s, Stage) else Stage(**s) for s in v]
+        elif isinstance(getattr(spec, k), Distribution) and isinstance(v, dict):
+            v = Distribution(**v)
         setattr(spec, k, v)
     return spec

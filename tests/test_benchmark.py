@@ -147,3 +147,30 @@ async def test_loadgen_nonstreaming_chat():
         assert all(r.output_tokens > 0 for r in ok)
     finally:
         await server.close()
+
+
+def test_conversation_history_slides_under_cap():
+    spec = get_profile("agentic", system_prompt_tokens=32, max_context_tokens=200)
+    src = PromptSource(spec)
+    for _ in range(200):
+        prompt, _ = src.next_request()
+        assert len(prompt) <= 200 * 4 + 64  # cap (+joiner slack)
+    # system prompt LONGER than the cap: history must still not grow
+    # unbounded (regression: [-0:] kept the whole string when keep == 0)
+    spec2 = get_profile("agentic", system_prompt_tokens=512, max_context_tokens=100)
+    src2 = PromptSource(spec2)
+    system_chars = len(src2._system)
+    for _ in range(100):
+        prompt, _ = src2.next_request()
+    assert len(prompt) <= system_chars + 16 * 1024 // 4  # one turn beyond system
+
+
+def test_stage_and_distribution_overrides_rebuild_dataclasses():
+    spec = get_profile(
+        "agentic",
+        stages=[{"num_requests": 4, "concurrency": 2}],
+        input_tokens={"type": "constant", "mean": 8},
+    )
+    assert isinstance(spec.stages[0], Stage)
+    assert spec.stages[0].num_requests == 4
+    assert isinstance(spec.input_tokens, Distribution)
