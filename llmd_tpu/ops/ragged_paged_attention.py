@@ -5,7 +5,9 @@ TPU-native replacement for the reference's FlashInfer decode kernels
 ``paged_attention.py`` materializes the full padded context per layer; this
 kernel streams only the LIVE context pages HBM->VMEM (double-buffered manual
 DMAs, dynamic trip count = cdiv(kv_len, page)) and keeps a flash-style
-online-softmax accumulator in VMEM.
+online-softmax accumulator in VMEM. pages_per_block=16 measured ~2% faster
+than 8 at short contexts (fewer loop trips) and keeps the per-slot VMEM
+buffer around 1MB for GQA geometries.
 
 Layout: kv_cache [num_pages, K, page, 2D] -- one page is a contiguous
 [K, page, 2D] slab, fetched in a single DMA per loop iteration. Grid is
@@ -208,7 +210,7 @@ def decode_paged_attention(
     kv_lens: jax.Array,  # [B] i32
     sm_scale: float | None = None,
     interpret: bool = False,
-    pages_per_block: int = 8,
+    pages_per_block: int = 16,
 ) -> jax.Array:
     return _decode_call(
         q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
@@ -224,7 +226,7 @@ def decode_paged_attention_full(
     kv_lens: jax.Array,
     sm_scale: float | None = None,
     interpret: bool = False,
-    pages_per_block: int = 8,
+    pages_per_block: int = 16,
 ) -> jax.Array:
     """Layer-indexed variant: reads cache[layer] pages directly from the
     full-cache HBM ref — a scan over layers never materializes a
