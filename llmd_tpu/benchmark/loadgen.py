@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import json
 import random
 import time
 
@@ -103,6 +104,7 @@ class LoadGenerator:
                     # the first CONTENT frame, and the role frame must not
                     # count as an output token.
                     n_frames = 0
+                    usage_tokens = None
                     carry = b""
                     async for chunk in resp.content.iter_any():
                         lines = (carry + chunk).split(b"\n")
@@ -110,6 +112,17 @@ class LoadGenerator:
                         for ln in lines:
                             if not ln.startswith(b"data:") or b"[DONE]" in ln:
                                 continue
+                            # The engine fuses up to decode_window tokens
+                            # per SSE frame, so frames undercount tokens:
+                            # trust the stream's usage frame and fall back
+                            # to frame counting only when usage is absent.
+                            if b'"usage"' in ln:
+                                try:
+                                    u = json.loads(ln[5:]).get("usage") or {}
+                                    if "completion_tokens" in u:
+                                        usage_tokens = u["completion_tokens"]
+                                except (json.JSONDecodeError, AttributeError):
+                                    pass
                             if (
                                 self.spec.api == "chat"
                                 and b'"content"' not in ln
@@ -119,7 +132,11 @@ class LoadGenerator:
                             n_frames += 1
                             if rec.ttft_s is None:
                                 rec.ttft_s = time.monotonic() - t0
-                    rec.output_tokens = max(0, n_frames - 1)  # final frame = usage
+                    rec.output_tokens = (
+                        usage_tokens
+                        if usage_tokens is not None
+                        else max(0, n_frames - 1)  # final frame = usage
+                    )
                 else:
                     data = await resp.json()
                     rec.ttft_s = time.monotonic() - t0

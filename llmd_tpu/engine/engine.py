@@ -193,6 +193,30 @@ class LLMEngine:
     def abort_request(self, request_id: str) -> bool:
         return self.scheduler.abort_request(request_id) is not None
 
+    def set_lora_weights(self, lora_id: int, weights: dict) -> None:
+        """Install trained adapter weights into slot ``lora_id``; until
+        then every slot serves exactly the base model (B init == 0).
+
+        Refuses while requests on that slot are in flight (their KV was
+        computed under the old weights — swap mid-decode would mix weight
+        versions silently). Device AND host/FS cached pages are cleared:
+        the reference's weight-rollout analog is the AllBlocksCleared KV
+        event (kv-indexer.md:63)."""
+        in_flight = [
+            r.request_id
+            for r in (*self.scheduler.running, *self.scheduler.waiting)
+            if r.lora_id == lora_id
+        ]
+        if in_flight:
+            raise RuntimeError(
+                f"cannot swap lora slot {lora_id} weights with "
+                f"{len(in_flight)} request(s) in flight (pause/drain first)"
+            )
+        self.runner.set_lora_weights(lora_id, weights)
+        self.allocator.clear()
+        if self._host_cache is not None:
+            self._host_cache.clear()
+
     def has_work(self) -> bool:
         return self.scheduler.has_work()
 

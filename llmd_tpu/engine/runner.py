@@ -124,6 +124,27 @@ class ModelRunner:
     def kv_bytes(self) -> int:
         return self.kv_cache.size * self.kv_cache.dtype.itemsize
 
+    def set_lora_weights(self, lora_id: int, weights: dict) -> None:
+        """Install adapter weights into slot ``lora_id`` (1-based).
+
+        ``weights`` maps any of la_q/lb_q/la_v/lb_v to stacked
+        ``[num_layers, ...]`` arrays matching the slot's shape. Slots
+        initialize with B == 0 (adapter == base model), so serving an
+        adapter name before its weights load is safe; this is the hook
+        checkpoint loading and dynamic adapter registration use.
+        """
+        if not (0 < lora_id <= self.cfg.num_lora_adapters):
+            raise ValueError(f"lora_id {lora_id} out of range")
+        layers = dict(self.params["layers"])
+        for k, v in weights.items():
+            if k not in ("la_q", "lb_q", "la_v", "lb_v"):
+                raise KeyError(f"unknown LoRA tensor {k!r}")
+            arr = layers[k]
+            layers[k] = arr.at[:, lora_id].set(
+                jnp.asarray(v, arr.dtype).reshape(arr.shape[0], *arr.shape[2:])
+            )
+        self.params = {**self.params, "layers": layers}
+
     def _build_forward(self):
         cfg = self.cfg
         world = self.ctx.world

@@ -36,6 +36,10 @@ HDR_TTFT_SLO = "x-llm-d-slo-ttft-ms"
 HDR_TPOT_SLO = "x-llm-d-slo-tpot-ms"
 HDR_PREFILLER = "x-prefiller-host-port"
 HDR_ENCODER = "x-encoder-host-port"
+# Sidecar -> engine only: the encoder host whose ec_embedding parts the
+# sidecar itself injected. The engine pulls EC handles from this host
+# alone; the sidecar strips any client-supplied copy of the header.
+HDR_EC_HOST = "x-llm-d-ec-host"
 HDR_DROP_REASON = "x-llm-d-request-dropped-reason"
 
 

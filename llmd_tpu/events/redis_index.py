@@ -44,12 +44,21 @@ class RespClient:
         self,
         host: str,
         port: int,
-        timeout_s: float = 1.0,
+        timeout_s: float = 0.25,
         down_cooldown_s: float = 5.0,
+        slow_threshold_s: float = 0.1,
+        slow_open_after: int = 3,
     ) -> None:
         self.addr = (host, port)
         self.timeout_s = timeout_s
         self.down_cooldown_s = down_cooldown_s
+        # Latency breaker: a slow-but-alive Redis never raises, so the
+        # error breaker alone would let every scheduling decision stall
+        # the event loop for up to ~2x timeout_s. N consecutive calls over
+        # the threshold open the circuit like an error does.
+        self.slow_threshold_s = slow_threshold_s
+        self.slow_open_after = slow_open_after
+        self._slow_streak = 0
         self._down_until = 0.0
         self._sock: socket.socket | None = None
         self._buf = b""
@@ -142,8 +151,10 @@ class RespClient:
         """Send all commands in one write; read all replies."""
         if not commands:
             return []
-        now = time.monotonic()
         with self._lock:
+            # Clock starts under the lock: waiting for a peer caller's
+            # round trip is not Redis latency and must not trip the breaker.
+            now = time.monotonic()
             if now < self._down_until:
                 raise ConnectionError("redis marked down (circuit open)")
             payload = b"".join(self._encode(c) for c in commands)
@@ -156,14 +167,29 @@ class RespClient:
                     self._close_locked()
                     sock = self._connect()
                     sock.sendall(payload)
-                return self._read_all(sock, len(commands))
+                replies = self._read_all(sock, len(commands))
             except (OSError, ConnectionError):
                 # Circuit-break: the caller runs on the router event loop;
                 # retrying the connect on every scheduling decision would
                 # stall the whole process for ~2x timeout per request.
                 self._close_locked()
                 self._down_until = time.monotonic() + self.down_cooldown_s
+                self._slow_streak = 0
                 raise
+            if time.monotonic() - now > self.slow_threshold_s:
+                self._slow_streak += 1
+                if self._slow_streak >= self.slow_open_after:
+                    self._down_until = time.monotonic() + self.down_cooldown_s
+                    self._slow_streak = 0
+                    log.warning(
+                        "redis slow (%d calls > %.0fms): circuit open %.1fs",
+                        self.slow_open_after,
+                        self.slow_threshold_s * 1e3,
+                        self.down_cooldown_s,
+                    )
+            else:
+                self._slow_streak = 0
+            return replies
 
     def command(self, *args):
         return self.pipeline([args])[0]

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 import aiohttp
 
@@ -51,7 +52,13 @@ class HttpEngineAdapter(EngineAdapter):
     async def _post(self, address: str, path: str) -> bool:
         try:
             session = await self._s()
-            async with session.post(f"http://{address}{path}") as resp:
+            # Engines deployed with LLMD_ADMIN_TOKEN reject unauthenticated
+            # admin calls; the operator mounts the same secret.
+            token = os.environ.get("LLMD_ADMIN_TOKEN", "")
+            headers = {"x-admin-token": token} if token else None
+            async with session.post(
+                f"http://{address}{path}", headers=headers
+            ) as resp:
                 return resp.status < 300
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             log.warning("engine %s %s failed: %s", address, path, e)

@@ -151,6 +151,18 @@ class HostKVCache:
             except OSError:
                 pass
 
+    def clear(self) -> None:
+        """Drop every tier (weight rollout: cached KV no longer matches)."""
+        with self._lock:
+            self._pages.clear()
+            fs = list(self._fs_lru)
+            self._fs_lru.clear()
+        for h in fs:
+            try:
+                self._path(h).unlink(missing_ok=True)
+            except OSError:
+                pass
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {

@@ -83,9 +83,12 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
             A1, r = cfg.num_lora_adapters + 1, cfg.lora_rank
             mask = (jnp.arange(A1) > 0).astype(dt)[None, :, None, None]
             layers["la_q"] = mk("la_q", (n, A1, H, r)) * mask
-            layers["lb_q"] = mk("lb_q", (n, A1, r, Nq * D)) * mask
             layers["la_v"] = mk("la_v", (n, A1, H, r)) * mask
-            layers["lb_v"] = mk("lb_v", (n, A1, r, K * D)) * mask
+            # Standard LoRA init: B starts at zero so every adapter slot is
+            # exactly the base model until real adapter weights are loaded
+            # (random B would perturb outputs for adapter-named requests).
+            layers["lb_q"] = jnp.zeros((n, A1, r, Nq * D), dt)
+            layers["lb_v"] = jnp.zeros((n, A1, r, K * D), dt)
         if moe:
             E, Fm = cfg.num_experts, cfg.moe_intermediate_size
             layers["router"] = mkp("router", (n, H, E), scale=H**-0.5)

@@ -27,6 +27,7 @@ import pydantic
 from aiohttp import web
 
 from llmd_tpu.engine.request import RequestOutput, SamplingParams
+from llmd_tpu.epp.types import HDR_EC_HOST
 from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.serve import protocol as P
 from llmd_tpu.serve.async_engine import AsyncEngine, EngineError, RequestFailed
@@ -60,6 +61,24 @@ async def _resolve_ec_parts(request: web.Request, messages: list) -> int:
     """
     pulled = 0
     session = request.app.get(MM_SESSION_KEY)
+    # SSRF guard. When LLMD_EC_ALLOWED_HOSTS is set it is authoritative:
+    # only those encoder hosts are ever pulled from, even with a vouching
+    # header (a direct-to-engine client can forge headers). Without the
+    # allowlist, trust the sidecar's x-llm-d-ec-host (the sidecar strips
+    # the client's copy) — this stops clients routed through the sidecar
+    # but NOT a caller with direct engine-port access; deployments where
+    # that matters must set the allowlist (and front encoders with a
+    # stable Service name) or network-police the engine port.
+    env_allowed = {
+        h.strip()
+        for h in os.environ.get("LLMD_EC_ALLOWED_HOSTS", "").split(",")
+        if h.strip()
+    }
+    if env_allowed:
+        allowed = env_allowed
+    else:
+        vouched = request.headers.get(HDR_EC_HOST, "")
+        allowed = {vouched} if vouched else set()
     for m in messages:
         content = m.get("content") if isinstance(m, dict) else None
         if not isinstance(content, list):
@@ -69,10 +88,11 @@ async def _resolve_ec_parts(request: web.Request, messages: list) -> int:
                 continue
             ec = part.get("ec_embedding") or {}
             host, digest = str(ec.get("host") or ""), str(ec.get("digest") or "")
-            # SSRF guard: these parts normally come from the sidecar, but a
-            # client can post them directly — only a bare host:port and a
-            # hex digest may be interpolated into the pull URL.
-            if not _EC_HOST_RE.fullmatch(host) or not _EC_DIGEST_RE.fullmatch(digest):
+            if (
+                host not in allowed
+                or not _EC_HOST_RE.fullmatch(host)
+                or not _EC_DIGEST_RE.fullmatch(digest)
+            ):
                 host = ""
             if session is not None and host and digest:
                 try:
@@ -625,6 +645,10 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
 
 def _admin_denied(request: web.Request) -> web.Response | None:
     token = os.environ.get("LLMD_ADMIN_TOKEN", "")
+    if token.startswith("REPLACE-ME"):
+        # The committed recipe placeholder is public knowledge — treating
+        # it as a valid credential would be worse than no token at all.
+        return _error(403, "placeholder admin token; set a real secret")
     if token:
         given = request.headers.get("x-admin-token", "")
         auth = request.headers.get("authorization", "")

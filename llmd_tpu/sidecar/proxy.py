@@ -33,7 +33,7 @@ import logging
 import aiohttp
 from aiohttp import web
 
-from llmd_tpu.epp.types import HDR_ENCODER, HDR_PREFILLER
+from llmd_tpu.epp.types import HDR_EC_HOST, HDR_ENCODER, HDR_PREFILLER
 from llmd_tpu.kvtransfer import shipper as shipper_mod
 from llmd_tpu.obs.tracing import get_tracer
 
@@ -62,8 +62,24 @@ def _fwd_headers(headers) -> dict[str, str]:
     return {
         k: v for k, v in headers.items()
         if k.lower() not in HOP_HEADERS
-        and k.lower() not in (HDR_PREFILLER, HDR_ENCODER)
+        and k.lower() not in (HDR_PREFILLER, HDR_ENCODER, HDR_EC_HOST)
     }
+
+
+def _strip_client_ec_parts(body: dict) -> None:
+    """Drop client-supplied ec_embedding parts before phase 0.
+
+    Only the sidecar may mint EC handles (it also vouches for their host
+    via the x-llm-d-ec-host header); a client-forged part would otherwise
+    make the engine issue a server-side GET to an attacker-chosen host."""
+    for m in body.get("messages") or []:
+        content = m.get("content") if isinstance(m, dict) else None
+        if not isinstance(content, list):
+            continue
+        content[:] = [
+            p for p in content
+            if not (isinstance(p, dict) and p.get("type") == "ec_embedding")
+        ]
 
 
 class _LeaseHeartbeat:
@@ -112,6 +128,15 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
 
     async def handle(request: web.Request) -> web.StreamResponse:
         session: aiohttp.ClientSession = request.app["session"]
+        # The sidecar is the pod's outward-facing port; the engine's admin
+        # surface (pause/drain/resume) must only be reachable by in-pod
+        # peers (IRO, operator exec) that talk to the engine port directly.
+        if request.path.startswith("/admin"):
+            return web.json_response(
+                {"error": {"message": "admin surface is not proxied",
+                           "type": "forbidden"}},
+                status=403,
+            )
         prefiller = request.headers.get(HDR_PREFILLER)
         encoder = request.headers.get(HDR_ENCODER)
         if (
@@ -127,15 +152,20 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
                                "type": "invalid_request_error"}},
                     status=400,
                 )
+            if isinstance(body, dict):
+                _strip_client_ec_parts(body)
             if encoder and isinstance(body, dict):
                 body = await run_encode(session, encoder, body, request)
             if prefiller:
                 return await two_phase(request, session, prefiller, body)
             # E-only (E/PD topology without a separate prefiller): forward
             # the embedding-substituted body to the local engine.
+            headers = _fwd_headers(request.headers)
+            if request.get("ec_host"):
+                headers[HDR_EC_HOST] = request["ec_host"]
             async with session.post(
                 local_base + request.path_qs,
-                headers=_fwd_headers(request.headers),
+                headers=headers,
                 json=body,
             ) as upstream:
                 return await _relay(request, upstream)
@@ -206,6 +236,9 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
             part.clear()
             part["type"] = "ec_embedding"
             part["ec_embedding"] = {"host": encoder, **item}
+        # Vouch for the injected host: the engine only pulls EC handles
+        # whose host matches the sidecar-set x-llm-d-ec-host header.
+        request["ec_host"] = encoder
         return body
 
     async def passthrough(
@@ -241,7 +274,10 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         try:
             pre_span = tracer.start_span("sidecar.prefill", parent=root)
             try:
-                params = await run_prefill(session, prefiller, request.path, body)
+                params = await run_prefill(
+                    session, prefiller, request.path, body,
+                    ec_host=request.get("ec_host"),
+                )
                 pre_span.set("llm_d.prefill.remote", params is not None)
             except BaseException as e:
                 pre_span.error(str(e) or type(e).__name__)
@@ -256,6 +292,8 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
                 heartbeat.start()
             dec_span = tracer.start_span("sidecar.decode", parent=root)
             headers = _fwd_headers(request.headers)
+            if request.get("ec_host"):
+                headers[HDR_EC_HOST] = request["ec_host"]
             if dec_span.sampled:
                 headers["traceparent"] = dec_span.traceparent
             async with session.post(
@@ -277,7 +315,8 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
             root.end()
 
     async def run_prefill(
-        session: aiohttp.ClientSession, prefiller: str, path: str, body: dict
+        session: aiohttp.ClientSession, prefiller: str, path: str, body: dict,
+        ec_host: str | None = None,
     ) -> dict | None:
         """Phase 1. Returns kv_transfer_params, or None => decoder-only."""
         pre_body = dict(body)
@@ -286,9 +325,10 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         pre_body["stream"] = False
         pre_body["kv_transfer_params"] = {"do_remote_decode": True}
         url = f"http://{prefiller}{path}"
+        headers = {HDR_EC_HOST: ec_host} if ec_host else None
         try:
             async with session.post(
-                url, json=pre_body,
+                url, json=pre_body, headers=headers,
                 timeout=aiohttp.ClientTimeout(total=cfg.prefill_timeout_s),
             ) as resp:
                 if resp.status != 200:

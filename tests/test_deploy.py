@@ -109,6 +109,32 @@ def test_recipe_yaml_parses_and_binds_roles():
             assert "llm-d.ai/role" in labels, name
 
 
+def test_kustomizations_resolve_under_load_restrictions():
+    """Emulate `kustomize build` resource resolution: every resources/
+    components entry must be (a) an existing file inside the kustomization
+    root (LoadRestrictionsRootOnly forbids `../file.yaml`) or (b) an
+    existing directory base carrying its own kustomization.yaml."""
+    yaml = pytest.importorskip("yaml")
+    kfiles = sorted(REPO.glob("deploy/**/kustomization.yaml"))
+    assert kfiles
+    for kf in kfiles:
+        root = kf.parent
+        with open(kf) as f:
+            doc = yaml.safe_load(f) or {}
+        for entry in (doc.get("resources") or []) + (doc.get("components") or []):
+            target = (root / entry).resolve()
+            if target.is_dir():
+                assert (target / "kustomization.yaml").is_file(), (
+                    f"{kf}: directory base {entry} has no kustomization.yaml"
+                )
+            else:
+                assert target.is_file(), f"{kf}: missing resource {entry}"
+                assert root.resolve() in target.parents, (
+                    f"{kf}: file resource {entry} escapes the kustomization "
+                    "root (kustomize LoadRestrictionsRootOnly would refuse it)"
+                )
+
+
 def test_observability_dashboards_parse():
     for path in sorted(REPO.glob("observability/**/*.json")):
         with open(path) as f:

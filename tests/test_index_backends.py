@@ -227,6 +227,37 @@ def test_scorer_backend_selection():
         PrecisePrefixCacheScorer(backend="nope")
 
 
+def test_resp_client_slow_calls_open_circuit(fake_redis):
+    """A slow-but-alive Redis must trip the breaker too: blocking socket
+    I/O on the scoring path runs on the router event loop, so consecutive
+    slow round-trips open the circuit like errors do."""
+    c = RespClient(
+        "127.0.0.1", fake_redis.port,
+        slow_threshold_s=0.0,  # every successful call counts as slow
+        slow_open_after=3,
+    )
+    try:
+        for _ in range(3):
+            c.pipeline([("HGETALL", "k")])
+        with pytest.raises(ConnectionError, match="circuit open"):
+            c.pipeline([("HGETALL", "k")])
+    finally:
+        c.close()
+
+
+def test_resp_client_fast_calls_reset_slow_streak(fake_redis):
+    c = RespClient(
+        "127.0.0.1", fake_redis.port,
+        slow_threshold_s=10.0,  # nothing is slow
+        slow_open_after=1,
+    )
+    try:
+        for _ in range(5):
+            assert c.pipeline([("HGETALL", "k")]) is not None
+    finally:
+        c.close()
+
+
 def test_redis_down_fails_open_and_circuit_breaks():
     import time
 

@@ -32,6 +32,21 @@ def _engine(n_adapters=2):
     return LLMEngine(cfg)
 
 
+def _install_adapters(engine, slots=(1, 2), scale=0.5):
+    """Load distinct nonzero B matrices into adapter slots.
+
+    Slots initialize as exact base-model identities (B == 0); real serving
+    loads trained adapters through the same set_lora_weights hook."""
+    layers = engine.runner.params["layers"]
+    for s in slots:
+        rng = np.random.default_rng(1000 + s)
+        weights = {}
+        for k in ("lb_q", "lb_v"):
+            shape = (layers[k].shape[0], *layers[k].shape[2:])
+            weights[k] = rng.normal(0.0, scale, shape).astype(np.float32)
+        engine.set_lora_weights(s, weights)
+
+
 def test_adapters_change_outputs_and_base_is_identity():
     engine = _engine()
     prompt = list(range(1, 13))
@@ -46,6 +61,9 @@ def test_adapters_change_outputs_and_base_is_identity():
         return out[rid]
 
     base = gen(0)
+    # Before weights load, every adapter slot IS the base model (B == 0).
+    assert gen(1) == base
+    _install_adapters(engine)
     a1 = gen(1)
     a2 = gen(2)
     # different adapters give different functions
@@ -69,6 +87,7 @@ def test_adapters_change_outputs_and_base_is_identity():
 def test_mixed_adapter_batch():
     """Different adapters in ONE batch each decode with their own weights."""
     engine = _engine()
+    _install_adapters(engine)
     sp = SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True)
     prompt = list(range(1, 11))
     rids = {
@@ -125,6 +144,7 @@ def test_prefix_cache_isolated_per_adapter():
     """Identical prompts under different adapters must NOT share KV pages
     (v is adapter-modified); same adapter still hits its own cache."""
     engine = _engine()
+    _install_adapters(engine)
     prompt = list(range(1, 21))
     sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True)
 

@@ -10,6 +10,7 @@ import io
 
 import numpy as np
 import pytest
+from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
 from llmd_tpu.encode.ec_store import EcStore
@@ -287,3 +288,68 @@ async def test_epd_e2e_through_sidecar():
         await sc.close()
         await eng_server.close()
         await enc_server.close()
+
+
+async def test_engine_ignores_unvouched_ec_hosts():
+    """SSRF guard: a client-forged ec_embedding part aimed at an arbitrary
+    host must not make the engine issue a server-side GET — only hosts the
+    sidecar vouched for (x-llm-d-ec-host) are pulled from."""
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    hits = []
+
+    async def probe(request):
+        hits.append(request.path)
+        return web.json_response({})
+
+    target = web.Application()
+    target.router.add_route("*", "/{tail:.*}", probe)
+    target_srv = TestServer(target)
+    await target_srv.start_server()
+
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=256),
+        cache=CacheConfig(page_size=4, num_blocks=256, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=128),
+    )
+    engine_app = build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 256)
+    ec = TestClient(TestServer(engine_app))
+    await ec.start_server()
+    try:
+        body = {
+            "model": "tiny",
+            "max_tokens": 2,
+            "messages": [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "ec_embedding",
+                         "ec_embedding": {
+                             "host": f"{target_srv.host}:{target_srv.port}",
+                             "digest": "ab" * 16,
+                         }},
+                    ],
+                }
+            ],
+        }
+        resp = await ec.post("/v1/chat/completions", json=body)
+        assert resp.status == 200, await resp.text()
+        assert hits == []  # no server-side request to the forged host
+
+        # The same part IS pulled once the host is vouched for.
+        resp = await ec.post(
+            "/v1/chat/completions",
+            json=body,
+            headers={"x-llm-d-ec-host": f"{target_srv.host}:{target_srv.port}"},
+        )
+        assert resp.status == 200, await resp.text()
+        assert hits  # vouched host was consulted
+    finally:
+        await ec.close()
+        await target_srv.close()

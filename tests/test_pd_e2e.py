@@ -164,3 +164,17 @@ async def test_short_prompt_skips_disagg(pd_stack):
     assert r.status == 200
     # Below thresholdTokens => no prefill phase, no transfer.
     assert prefill_engine.kv_connector.exported_requests == 0
+
+
+async def test_sidecar_refuses_admin_paths(pd_stack):
+    """The sidecar is the pod's outward port: /admin/* (pause|drain|resume)
+    must not be proxied to the engine (unauthenticated remote DoS)."""
+    _, _, decode_engine, _, sidecar_srv = pd_stack
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        for path in ("/admin/pause", "/admin/drain", "/admin/resume"):
+            async with s.post(
+                f"http://{sidecar_srv.host}:{sidecar_srv.port}{path}"
+            ) as r:
+                assert r.status == 403
