@@ -32,6 +32,10 @@ class ModelConfig:
     num_kv_heads: int = 8
     head_dim: int | None = None  # defaults to hidden_size // num_heads
     rope_theta: float = 500000.0
+    # HF-style rope_scaling dict (rope_type: llama3 | linear | default).
+    # Llama-3.1+ checkpoints ship llama3 frequency scaling; loading them
+    # without it silently degrades long-context quality.
+    rope_scaling: dict | None = None
     rms_norm_eps: float = 1e-5
     max_model_len: int = 8192
     dtype: str = "bfloat16"
@@ -51,6 +55,19 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_intermediate_size: int | None = None
+    # Router variants across the MoE families:
+    #   Mixtral/Qwen3Moe: softmax scores, plain top-k, renormalized.
+    #   DeepSeek-V2:      softmax, optionally group-limited top-k (max per
+    #                     group), usually NOT renormalized, scaled.
+    #   DeepSeek-V3/R1:   sigmoid scores + learned correction bias for
+    #                     selection (noaux_tc), top-2-sum group scores,
+    #                     renormalized, scaled.
+    router_scoring: str = "softmax"  # "softmax" | "sigmoid"
+    topk_method: str = "greedy"  # "greedy" | "group_max" | "group_top2"
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    n_group: int = 1
+    topk_group: int = 1
     # DeepSeek-style: first N layers use a dense MLP, the rest are MoE.
     first_dense_layers: int = 0
     # Shared expert intermediate size (DeepSeek V2/V3 style); 0 = none.

@@ -88,7 +88,12 @@ class ModelRunner:
         self.page = config.cache.page_size
 
         if params is None:
-            params = llama.init_params(self.cfg, jax.random.key(config.seed))
+            if config.weights_path:
+                from llmd_tpu.models.loader import load_params
+
+                params = load_params(self.cfg, config.weights_path)
+            else:
+                params = llama.init_params(self.cfg, jax.random.key(config.seed))
         self.params = shard_params(params, mesh_ctx)
         self.kv_cache = self._alloc_kv()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
@@ -127,14 +132,24 @@ class ModelRunner:
     def set_lora_weights(self, lora_id: int, weights: dict) -> None:
         """Install adapter weights into slot ``lora_id`` (1-based).
 
-        ``weights`` maps any of la_q/lb_q/la_v/lb_v to stacked
-        ``[num_layers, ...]`` arrays matching the slot's shape. Slots
+        ``weights`` maps la_q/lb_q/la_v/lb_v to stacked
+        ``[num_layers, ...]`` arrays matching the slot's shape; A and B
+        must be installed together per projection (setting only B would
+        silently compose with whatever A the slot holds — zeros on
+        checkpoint-loaded models, i.e. an identity adapter). Slots
         initialize with B == 0 (adapter == base model), so serving an
         adapter name before its weights load is safe; this is the hook
         checkpoint loading and dynamic adapter registration use.
         """
         if not (0 < lora_id <= self.cfg.num_lora_adapters):
             raise ValueError(f"lora_id {lora_id} out of range")
+        for a, b in (("la_q", "lb_q"), ("la_v", "lb_v")):
+            if (a in weights) != (b in weights):
+                raise ValueError(
+                    f"LoRA install must pair {a} with {b}: partial updates "
+                    "compose with stale/zero factors and silently serve the "
+                    "wrong adapter"
+                )
         layers = dict(self.params["layers"])
         for k, v in weights.items():
             if k not in ("la_q", "lb_q", "la_v", "lb_v"):

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -45,12 +46,110 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps)).astype(dtype) * weight
 
 
-def rope_tables(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
-    """cos/sin tables for rotary embedding: [..., head_dim//2], f32."""
+SUPPORTED_ROPE_TYPES = ("default", "linear", "llama3", "yarn")
+
+
+def rope_type(scaling: dict | None) -> str:
+    if not scaling:
+        return "default"
+    return scaling.get("rope_type") or scaling.get("type") or "default"
+
+
+def _yarn_mscale(scale: float, mscale: float = 1.0) -> float:
+    if scale <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
+def yarn_sm_scale_mult(scaling: dict | None) -> float:
+    """DeepSeek-style yarn splits the attention temperature correction:
+    with mscale_all_dim set, cos/sin stay (nearly) unscaled and the
+    softmax scale is multiplied by mscale^2 instead (HF DeepseekV3
+    Attention.__init__). 1.0 for every other rope config."""
+    if rope_type(scaling) != "yarn":
+        return 1.0
+    m_all = float(scaling.get("mscale_all_dim") or 0.0)
+    if not m_all:
+        return 1.0
+    m = _yarn_mscale(float(scaling["factor"]), m_all)
+    return m * m
+
+
+def _inv_freq_and_factor(
+    head_dim: int, theta: float, scaling: dict | None
+) -> tuple[jax.Array, float]:
+    """Inverse frequencies + cos/sin post-factor per HF rope_scaling.
+
+    llama3 (Llama-3.1+): low-frequency bands divided by `factor`, high
+    kept, smooth interpolation between (_compute_llama3_parameters).
+    yarn (DeepSeek V2/V3, long-context Qwen): NTK-by-parts interpolation
+    with linear ramp between beta_fast/beta_slow correction dims plus an
+    attention factor on cos/sin (_compute_yarn_parameters)."""
     half = head_dim // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    rt = rope_type(scaling)
+    if rt == "default":
+        return inv_freq, 1.0
+    factor = float(scaling["factor"])
+    if rt == "linear":
+        return inv_freq / factor, 1.0
+    if rt == "llama3":
+        low = float(scaling["low_freq_factor"])
+        high = float(scaling["high_freq_factor"])
+        orig = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * jnp.pi / inv_freq
+        scaled = jnp.where(wavelen > orig / low, inv_freq / factor, inv_freq)
+        smooth = (orig / wavelen - low) / (high - low)
+        smoothed = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+        is_medium = (wavelen >= orig / high) & (wavelen <= orig / low)
+        return jnp.where(is_medium, smoothed, scaled), 1.0
+    if rt == "yarn":
+        orig = float(
+            scaling.get("original_max_position_embeddings") or 0.0
+        ) or None
+        if orig is None:
+            raise ValueError("yarn rope_scaling needs original_max_position_embeddings")
+        attention_factor = scaling.get("attention_factor")
+        if attention_factor is None:
+            mscale = scaling.get("mscale")
+            m_all = scaling.get("mscale_all_dim")
+            if mscale and m_all:
+                attention_factor = _yarn_mscale(factor, float(mscale)) / _yarn_mscale(
+                    factor, float(m_all)
+                )
+            else:
+                attention_factor = _yarn_mscale(factor)
+        beta_fast = float(scaling.get("beta_fast") or 32)
+        beta_slow = float(scaling.get("beta_slow") or 1)
+
+        def correction_dim(rot: float) -> float:
+            return (head_dim * math.log(orig / (rot * 2 * math.pi))) / (
+                2 * math.log(theta)
+            )
+
+        low = max(math.floor(correction_dim(beta_fast)), 0)
+        high = min(math.ceil(correction_dim(beta_slow)), head_dim - 1)
+        ramp = jnp.clip(
+            (jnp.arange(half, dtype=jnp.float32) - low) / max(high - low, 1e-3),
+            0.0,
+            1.0,
+        )
+        extrapolation_factor = 1.0 - ramp
+        inv_freq = (
+            inv_freq / factor * ramp + inv_freq * extrapolation_factor
+        )
+        return inv_freq, float(attention_factor)
+    raise NotImplementedError(f"rope_scaling type {rt!r} not supported")
+
+
+def rope_tables(
+    positions: jax.Array, head_dim: int, theta: float,
+    scaling: dict | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary embedding: [..., head_dim//2], f32."""
+    inv_freq, factor = _inv_freq_and_factor(head_dim, theta, scaling)
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
-    return jnp.cos(angles), jnp.sin(angles)
+    return jnp.cos(angles) * factor, jnp.sin(angles) * factor
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:

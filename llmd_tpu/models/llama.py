@@ -92,6 +92,9 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         if moe:
             E, Fm = cfg.num_experts, cfg.moe_intermediate_size
             layers["router"] = mkp("router", (n, H, E), scale=H**-0.5)
+            if cfg.router_scoring == "sigmoid":
+                # V3-style selection-only correction bias (noaux_tc).
+                layers["router_bias"] = jnp.zeros((n, E), jnp.float32)
             layers["we_gate"] = mkp("we_gate", (n, E, H, Fm))
             layers["we_up"] = mkp("we_up", (n, E, H, Fm))
             layers["we_down"] = mkp("we_down", (n, E, Fm, H))
@@ -144,7 +147,7 @@ def forward_hidden(
     # one rope table for all layers (hoisted out of the scan); MLA rotates
     # only its rope sub-dim
     rope_dim = cfg.qk_rope_head_dim if cfg.is_mla else D
-    cos, sin = rope_tables(inp.positions, rope_dim, cfg.rope_theta)
+    cos, sin = rope_tables(inp.positions, rope_dim, cfg.rope_theta, cfg.rope_scaling)
     valid = inp.valid
     sm_scale = D**-0.5
 

@@ -1,0 +1,400 @@
+"""HF checkpoint loading: config.json -> ModelConfig, safetensors -> params.
+
+The serving framework must load trained checkpoints in the format the
+reference's deployment flow assumes (HF model directories; reference
+docs/architecture/core/model-servers.md:3-25, HF_TOKEN download flow in
+guides/pd-disaggregation/README.md:94-103). This module maps HF names and
+layouts onto this framework's stacked-layer param tree:
+
+  - HF linear weights are [out, in] and applied as x @ W.T; ours are
+    [in, out] applied as x @ W -> every projection transposes on load.
+  - HF stores one tensor per layer (model.layers.{i}.*); ours are stacked
+    along a leading L axis for the lax.scan over layers -> np.stack.
+  - DeepSeek-family checkpoints store rope dims interleaved (HF permutes
+    them at runtime, modeling_deepseek's q/k view(d//2, 2) transpose);
+    we bake the permutation into the loaded projections so the runtime
+    split-half `apply_rope` matches.
+
+Supported architectures: LlamaForCausalLM, Qwen2ForCausalLM,
+Qwen3ForCausalLM, MixtralForCausalLM, Qwen3MoeForCausalLM,
+DeepseekV2ForCausalLM, DeepseekV3ForCausalLM.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pathlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from llmd_tpu.config import ModelConfig
+
+log = logging.getLogger(__name__)
+
+_DENSE_ARCHS = {
+    "LlamaForCausalLM",
+    "MistralForCausalLM",
+    "Qwen2ForCausalLM",
+    "Qwen3ForCausalLM",
+}
+_MOE_ARCHS = {"MixtralForCausalLM", "Qwen3MoeForCausalLM"}
+_MLA_ARCHS = {"DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM"}
+SUPPORTED_ARCHS = _DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS
+
+
+def is_model_dir(path: str) -> bool:
+    p = pathlib.Path(path)
+    return p.is_dir() and (p / "config.json").is_file()
+
+
+def config_from_hf(model_dir: str, **overrides) -> ModelConfig:
+    """Build a ModelConfig from an HF model directory's config.json."""
+    p = pathlib.Path(model_dir)
+    with open(p / "config.json") as f:
+        hf = json.load(f)
+    archs = hf.get("architectures") or []
+    arch = archs[0] if archs else ""
+    if arch not in SUPPORTED_ARCHS:
+        raise ValueError(
+            f"unsupported architecture {arch!r} in {model_dir}; "
+            f"supported: {sorted(SUPPORTED_ARCHS)}"
+        )
+    # Fail fast on semantics we would otherwise silently get wrong.
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+        raise ValueError(
+            f"{arch} checkpoint uses sliding-window attention "
+            f"(sliding_window={hf['sliding_window']}), which this engine "
+            "does not implement — full attention past the window would "
+            "silently diverge from the trained model"
+        )
+    from llmd_tpu.models.common import SUPPORTED_ROPE_TYPES, rope_type
+
+    if rope_type(hf.get("rope_scaling")) not in SUPPORTED_ROPE_TYPES:
+        raise ValueError(
+            f"rope_scaling type {rope_type(hf.get('rope_scaling'))!r} "
+            f"not supported (have: {SUPPORTED_ROPE_TYPES})"
+        )
+    kw: dict = dict(
+        name=p.name or str(p),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=hf.get("rope_scaling"),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        max_model_len=int(hf.get("max_position_embeddings", 8192)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        # fp16 checkpoints run in bf16 on TPU (same exponent range as fp32;
+        # fp16's narrower range under/overflows in softmax/logits). Newer
+        # transformers writes the key as "dtype", older as "torch_dtype".
+        dtype={
+            "float32": "float32", "bfloat16": "bfloat16",
+        }.get(str(hf.get("dtype") or hf.get("torch_dtype")), "bfloat16"),
+    )
+    if arch == "Qwen2ForCausalLM":
+        # Qwen2 uses bias on the QKV projections (no config flag).
+        kw["attention_bias"] = True
+    else:
+        kw["attention_bias"] = bool(hf.get("attention_bias", False))
+    if arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM"):
+        kw["qk_norm"] = True
+    if arch == "MixtralForCausalLM":
+        kw.update(
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf["num_experts_per_tok"],
+            moe_intermediate_size=hf["intermediate_size"],
+        )
+    elif arch == "Qwen3MoeForCausalLM":
+        kw.update(
+            num_experts=hf["num_experts"],
+            num_experts_per_tok=hf["num_experts_per_tok"],
+            moe_intermediate_size=hf["moe_intermediate_size"],
+            norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        )
+    elif arch in _MLA_ARCHS:
+        if arch == "DeepseekV3ForCausalLM":
+            router_scoring, topk_method = "sigmoid", "group_top2"
+        else:
+            router_scoring = "softmax"
+            topk_method = {
+                "greedy": "greedy",
+                "group_limited_greedy": "group_max",
+            }[hf.get("topk_method", "greedy")]
+        kw.update(
+            kv_lora_rank=hf["kv_lora_rank"],
+            q_lora_rank=hf.get("q_lora_rank") or 0,
+            qk_nope_head_dim=hf["qk_nope_head_dim"],
+            qk_rope_head_dim=hf["qk_rope_head_dim"],
+            v_head_dim=hf["v_head_dim"],
+            num_experts=hf.get("n_routed_experts") or 0,
+            num_experts_per_tok=hf.get("num_experts_per_tok") or 2,
+            moe_intermediate_size=hf.get("moe_intermediate_size"),
+            first_dense_layers=hf.get("first_k_dense_replace", 0),
+            shared_expert_intermediate_size=(
+                (hf.get("n_shared_experts") or 0)
+                * (hf.get("moe_intermediate_size") or 0)
+            ),
+            router_scoring=router_scoring,
+            topk_method=topk_method,
+            norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+            routed_scaling_factor=float(hf.get("routed_scaling_factor", 1.0)),
+            n_group=hf.get("n_group") or 1,
+            topk_group=hf.get("topk_group") or 1,
+        )
+    kw.update(overrides)
+    return ModelConfig(**kw)
+
+
+class _Checkpoint:
+    """Name-indexed view over a directory of .safetensors shards."""
+
+    def __init__(self, model_dir: str) -> None:
+        from safetensors import safe_open
+
+        self.dir = pathlib.Path(model_dir)
+        files = sorted(self.dir.glob("*.safetensors"))
+        if not files:
+            raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+        self._open = safe_open
+        self._where: dict[str, pathlib.Path] = {}
+        self._handles: dict[pathlib.Path, object] = {}
+        for f in files:
+            h = safe_open(str(f), framework="np")
+            self._handles[f] = h
+            for name in h.keys():
+                self._where[name] = f
+        self.used: set[str] = set()
+
+    def names(self) -> set[str]:
+        return set(self._where)
+
+    def has(self, name: str) -> bool:
+        return name in self._where
+
+    def get(self, name: str) -> np.ndarray:
+        f = self._where.get(name)
+        if f is None:
+            raise KeyError(f"checkpoint tensor {name!r} not found")
+        self.used.add(name)
+        # framework="np" maps bf16 to ml_dtypes.bfloat16 (a jax dep).
+        return self._handles[f].get_tensor(name)
+
+
+def _interleave_to_half(w: np.ndarray, rope_dim: int, axis: int = -1) -> np.ndarray:
+    """Permute the trailing rope columns from interleaved (d0 d1 d0 d1 ...)
+    to split-half (evens | odds) layout — HF DeepSeek's runtime q/k
+    permutation, baked into the weights."""
+    assert axis == -1
+    head = w[..., : w.shape[-1] - rope_dim]
+    tail = w[..., w.shape[-1] - rope_dim :]
+    tail = np.concatenate([tail[..., 0::2], tail[..., 1::2]], axis=-1)
+    return np.concatenate([head, tail], axis=-1)
+
+
+def load_params(
+    cfg: ModelConfig, model_dir: str, dtype: str | None = None
+) -> dict:
+    """Load an HF checkpoint into this framework's stacked param tree.
+
+    Returns the same structure init_params produces (llmd_tpu/models/
+    llama.py). LoRA adapter slots (serving-time state, not checkpoint
+    weights) initialize empty: A random-free zeros => identity adapters.
+    """
+    ckpt = _Checkpoint(model_dir)
+    dt = np.dtype(jnp.dtype(dtype or cfg.dtype))
+    H, D = cfg.hidden_size, cfg.head_dim
+    Nq, K, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+
+    def get(name: str, transpose: bool = False) -> np.ndarray:
+        w = ckpt.get(name)
+        if transpose:
+            w = w.T
+        return np.ascontiguousarray(w).astype(dt)
+
+    def stack(names: list[str], transpose: bool = False) -> np.ndarray:
+        return np.stack([get(n, transpose) for n in names])
+
+    def proj(i: int, name: str) -> str:
+        return f"model.layers.{i}.{name}"
+
+    def layer_stack(layer_ids: list[int], moe: bool) -> dict[str, np.ndarray]:
+        layers: dict[str, np.ndarray] = {
+            "input_norm": stack([proj(i, "input_layernorm.weight") for i in layer_ids]),
+            "post_norm": stack(
+                [proj(i, "post_attention_layernorm.weight") for i in layer_ids]
+            ),
+        }
+        if cfg.is_mla:
+            rope = cfg.qk_rope_head_dim
+            nope = cfg.qk_nope_head_dim
+
+            def q_rows(w: np.ndarray) -> np.ndarray:
+                # [H_in, Nq*(nope+rope)]: permute each head's rope tail.
+                w = w.reshape(w.shape[0], Nq, nope + rope)
+                w = _interleave_to_half(w, rope)
+                return w.reshape(w.shape[0], Nq * (nope + rope))
+
+            layers["wkv_a"] = np.stack(
+                [
+                    _interleave_to_half(
+                        get(proj(i, "self_attn.kv_a_proj_with_mqa.weight"), True),
+                        rope,
+                    )
+                    for i in layer_ids
+                ]
+            )
+            layers["kv_norm"] = stack(
+                [proj(i, "self_attn.kv_a_layernorm.weight") for i in layer_ids]
+            )
+            layers["wkv_b"] = stack(
+                [proj(i, "self_attn.kv_b_proj.weight") for i in layer_ids], True
+            )
+            layers["wo"] = stack(
+                [proj(i, "self_attn.o_proj.weight") for i in layer_ids], True
+            )
+            if cfg.q_lora_rank > 0:
+                layers["wq_a"] = stack(
+                    [proj(i, "self_attn.q_a_proj.weight") for i in layer_ids], True
+                )
+                layers["q_norm"] = stack(
+                    [proj(i, "self_attn.q_a_layernorm.weight") for i in layer_ids]
+                )
+                layers["wq_b"] = np.stack(
+                    [
+                        q_rows(get(proj(i, "self_attn.q_b_proj.weight"), True))
+                        for i in layer_ids
+                    ]
+                )
+            else:
+                layers["wq"] = np.stack(
+                    [
+                        q_rows(get(proj(i, "self_attn.q_proj.weight"), True))
+                        for i in layer_ids
+                    ]
+                )
+        else:
+            layers["wq"] = stack(
+                [proj(i, "self_attn.q_proj.weight") for i in layer_ids], True
+            )
+            layers["wk"] = stack(
+                [proj(i, "self_attn.k_proj.weight") for i in layer_ids], True
+            )
+            layers["wv"] = stack(
+                [proj(i, "self_attn.v_proj.weight") for i in layer_ids], True
+            )
+            layers["wo"] = stack(
+                [proj(i, "self_attn.o_proj.weight") for i in layer_ids], True
+            )
+            if cfg.attention_bias:
+                layers["bq"] = stack(
+                    [proj(i, "self_attn.q_proj.bias") for i in layer_ids]
+                )
+                layers["bk"] = stack(
+                    [proj(i, "self_attn.k_proj.bias") for i in layer_ids]
+                )
+                layers["bv"] = stack(
+                    [proj(i, "self_attn.v_proj.bias") for i in layer_ids]
+                )
+            if cfg.qk_norm:
+                layers["attn_q_norm"] = stack(
+                    [proj(i, "self_attn.q_norm.weight") for i in layer_ids]
+                )
+                layers["attn_k_norm"] = stack(
+                    [proj(i, "self_attn.k_norm.weight") for i in layer_ids]
+                )
+        if cfg.num_lora_adapters and not cfg.is_mla:
+            # Serving-time adapter slots, not checkpoint weights: zeros
+            # everywhere => every slot is the base model until
+            # set_lora_weights installs a real adapter.
+            A1, r = cfg.num_lora_adapters + 1, cfg.lora_rank
+            n = len(layer_ids)
+            layers["la_q"] = np.zeros((n, A1, H, r), dt)
+            layers["la_v"] = np.zeros((n, A1, H, r), dt)
+            layers["lb_q"] = np.zeros((n, A1, r, Nq * D), dt)
+            layers["lb_v"] = np.zeros((n, A1, r, K * D), dt)
+        if moe:
+            E = cfg.num_experts
+            if ckpt.has(proj(layer_ids[0], "block_sparse_moe.gate.weight")):
+                # Mixtral naming: w1=gate, w3=up, w2=down
+                gate_name = "block_sparse_moe.gate.weight"
+                expert = "block_sparse_moe.experts.{e}.w{w}.weight"
+                enames = {"gate": "1", "up": "3", "down": "2"}
+
+                def ename(i, e, which):
+                    return proj(i, expert.format(e=e, w=enames[which]))
+            else:
+                gate_name = "mlp.gate.weight"
+
+                def ename(i, e, which):
+                    return proj(i, f"mlp.experts.{e}.{which}_proj.weight")
+
+            layers["router"] = stack(
+                [proj(i, gate_name) for i in layer_ids], True
+            )
+            bias_name = "mlp.gate.e_score_correction_bias"
+            if ckpt.has(proj(layer_ids[0], bias_name)):
+                layers["router_bias"] = np.stack(
+                    [ckpt.get(proj(i, bias_name)) for i in layer_ids]
+                ).astype(np.float32)
+            elif cfg.router_scoring == "sigmoid":
+                layers["router_bias"] = np.zeros(
+                    (len(layer_ids), cfg.num_experts), np.float32
+                )
+            for which, key in (("gate", "we_gate"), ("up", "we_up"), ("down", "we_down")):
+                layers[key] = np.stack(
+                    [
+                        np.stack([get(ename(i, e, which), True) for e in range(E)])
+                        for i in layer_ids
+                    ]
+                )
+            if cfg.shared_expert_intermediate_size:
+                for which, key in (
+                    ("gate", "ws_gate"), ("up", "ws_up"), ("down", "ws_down"),
+                ):
+                    layers[key] = stack(
+                        [
+                            proj(i, f"mlp.shared_experts.{which}_proj.weight")
+                            for i in layer_ids
+                        ],
+                        True,
+                    )
+        else:
+            layers["w_gate"] = stack(
+                [proj(i, "mlp.gate_proj.weight") for i in layer_ids], True
+            )
+            layers["w_up"] = stack(
+                [proj(i, "mlp.up_proj.weight") for i in layer_ids], True
+            )
+            layers["w_down"] = stack(
+                [proj(i, "mlp.down_proj.weight") for i in layer_ids], True
+            )
+        return layers
+
+    n_dense = cfg.first_dense_layers if cfg.is_moe else 0
+    params: dict = {
+        "embed": get("model.embed_tokens.weight"),
+        "layers": layer_stack(list(range(n_dense, L)), moe=cfg.is_moe),
+        "final_norm": get("model.norm.weight"),
+    }
+    if n_dense:
+        params["dense_layers"] = layer_stack(list(range(n_dense)), moe=False)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = get("lm_head.weight", transpose=True)
+
+    unused = {
+        n for n in ckpt.names() - ckpt.used
+        if not n.endswith((".inv_freq", "rotary_emb.inv_freq"))
+    }
+    if unused:
+        log.warning(
+            "checkpoint tensors not mapped (%d): %s%s",
+            len(unused), sorted(unused)[:8], " ..." if len(unused) > 8 else "",
+        )
+    return params

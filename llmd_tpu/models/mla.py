@@ -23,7 +23,9 @@ import jax
 import jax.numpy as jnp
 
 from llmd_tpu.config import ModelConfig
-from llmd_tpu.models.common import StepInput, apply_rope, rms_norm, rope_tables
+from llmd_tpu.models.common import (
+    StepInput, apply_rope, rms_norm, rope_tables, yarn_sm_scale_mult,
+)
 from llmd_tpu.ops import mla_paged_attention_full, write_kv_pages_full
 
 
@@ -44,10 +46,11 @@ def mla_attention(
     nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
     rank = cfg.kv_lora_rank
     Dl = cfg.kv_cache_entry_dim
-    # MLA scales by the FULL qk head dim (nope + rope), not the latent.
-    sm_scale = (nope + rope) ** -0.5
+    # MLA scales by the FULL qk head dim (nope + rope), not the latent;
+    # DeepSeek yarn folds its mscale^2 temperature correction in here.
+    sm_scale = (nope + rope) ** -0.5 * yarn_sm_scale_mult(cfg.rope_scaling)
     if cos is None or sin is None:
-        cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta)
+        cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta, cfg.rope_scaling)
 
     # ---- queries
     if cfg.q_lora_rank > 0:
@@ -107,8 +110,8 @@ def mla_reference_attention(
     nh = cfg.num_heads
     nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
     rank = cfg.kv_lora_rank
-    sm_scale = (nope + rope) ** -0.5
-    cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta)
+    sm_scale = (nope + rope) ** -0.5 * yarn_sm_scale_mult(cfg.rope_scaling)
+    cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta, cfg.rope_scaling)
 
     if cfg.q_lora_rank > 0:
         q = rms_norm(h @ lp["wq_a"], lp["q_norm"], cfg.rms_norm_eps) @ lp["wq_b"]

@@ -29,17 +29,54 @@ from llmd_tpu.config import ModelConfig
 
 
 def router_topk(
-    h: jax.Array, w_router: jax.Array, top_k: int
+    h: jax.Array,
+    w_router: jax.Array,
+    top_k: int,
+    cfg: ModelConfig | None = None,
+    bias: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Softmax-then-topk routing (Mixtral-style, renormalized).
+    """Top-k expert routing covering the deployed MoE families.
+
+    Default (cfg None): softmax-then-topk, renormalized (Mixtral-style).
+    With cfg: scoring (softmax | sigmoid+bias-corrected selection),
+    group-limited selection (DeepSeek V2 max-per-group / V3 top-2-sum),
+    optional renormalization and routed scaling — mirroring HF
+    DeepseekV2MoEGate / DeepseekV3TopkRouter semantics.
 
     h: [T, H]; returns (weights [T, k] f32, expert_ids [T, k] i32).
     """
     logits = (h.astype(jnp.float32) @ w_router.astype(jnp.float32))  # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    weights, ids = jax.lax.top_k(probs, top_k)
-    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
-    return weights, ids
+    if cfg is None:
+        probs = jax.nn.softmax(logits, axis=-1)
+        weights, ids = jax.lax.top_k(probs, top_k)
+        return weights / jnp.sum(weights, axis=-1, keepdims=True), ids
+
+    T, E = logits.shape
+    if cfg.router_scoring == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+    # Selection scores may differ from combine weights (V3's correction
+    # bias steers selection only; gathered weights stay uncorrected).
+    choice = scores if bias is None else scores + bias.astype(jnp.float32)
+    if cfg.topk_method in ("group_max", "group_top2") and cfg.n_group > 1:
+        g = cfg.n_group
+        grouped = choice.reshape(T, g, E // g)
+        if cfg.topk_method == "group_max":
+            group_scores = jnp.max(grouped, axis=-1)
+        else:  # top-2 sum per group (V3 noaux_tc)
+            group_scores = jnp.sum(jax.lax.top_k(grouped, 2)[0], axis=-1)
+        _, group_idx = jax.lax.top_k(group_scores, cfg.topk_group)
+        group_mask = jnp.zeros((T, g), bool).at[
+            jnp.arange(T)[:, None], group_idx
+        ].set(True)
+        mask = jnp.repeat(group_mask, E // g, axis=-1)
+        choice = jnp.where(mask, choice, 0.0 if cfg.router_scoring == "sigmoid" else -jnp.inf)
+    _, ids = jax.lax.top_k(choice, top_k)
+    weights = jnp.take_along_axis(scores, ids, axis=-1)
+    if cfg.norm_topk_prob:
+        weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
+    return weights * cfg.routed_scaling_factor, ids
 
 
 def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
@@ -48,7 +85,7 @@ def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     T = B * Q
     E, k = cfg.num_experts, cfg.num_experts_per_tok
     ht = h.reshape(T, H)
-    weights, ids = router_topk(ht, lp["router"], k)
+    weights, ids = router_topk(ht, lp["router"], k, cfg, lp.get("router_bias"))
     # combine[t, e] = sum_j weights[t, j] * (ids[t, j] == e)
     combine = jnp.zeros((T, E), jnp.float32)
     combine = combine.at[jnp.arange(T)[:, None], ids].add(weights)

@@ -112,6 +112,7 @@ PARAM_SPECS: dict[str, P] = {
     "w_down": P(None, TP_AXIS, None),  # [L, F, H]
     # MoE: experts sharded over the flattened (dp, tp) axes = wide EP.
     "router": P(None, None, None),       # [L, H, E] replicated (tiny)
+    "router_bias": P(None, None),        # [L, E] replicated (V3 noaux_tc)
     "we_gate": P(None, EP_AXES, None, None),  # [L, E, H, Fm]
     "we_up": P(None, EP_AXES, None, None),
     "we_down": P(None, EP_AXES, None, None),  # [L, E, Fm, H]

@@ -79,8 +79,12 @@ def moe_block_ep(
         we_up=P(("dp", "tp"), None, None),
         we_down=P(("dp", "tp"), None, None),
     )
-    args = [lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"]]
-    in_specs = [EP_SPEC, specs["router"], specs["we_gate"], specs["we_up"], specs["we_down"]]
+    bias = lp.get("router_bias")
+    if bias is None:
+        bias = jnp.zeros((E,), jnp.float32)
+    args = [lp["router"], bias, lp["we_gate"], lp["we_up"], lp["we_down"]]
+    in_specs = [EP_SPEC, specs["router"], P(None),
+                specs["we_gate"], specs["we_up"], specs["we_down"]]
     if has_shared:
         args += [lp["ws_gate"], lp["ws_up"], lp["ws_down"]]
         in_specs += [P(None, None), P(None, None), P(None, None)]
@@ -95,7 +99,8 @@ def moe_block_ep(
 
 
 def _moe_ep_local(
-    ht, router, we_gate, we_up, we_down, *shared, cfg: ModelConfig, W: int, C: int, axes
+    ht, router, router_bias, we_gate, we_up, we_down, *shared,
+    cfg: ModelConfig, W: int, C: int, axes
 ):
     """Per-shard body: route -> dispatch a2a -> local experts -> combine a2a.
 
@@ -105,7 +110,7 @@ def _moe_ep_local(
     E, k = cfg.num_experts, cfg.num_experts_per_tok
     E_loc = E // W
 
-    weights, ids = router_topk(ht, router, k)  # [t, k]
+    weights, ids = router_topk(ht, router, k, cfg, router_bias)  # [t, k]
     flat_ids = ids.reshape(-1)  # [tk]
     dest = flat_ids // E_loc  # destination shard per slot
     e_local = flat_ids % E_loc  # expert index on that shard

@@ -40,13 +40,27 @@ def make_engine_config(args, lora_adapters=None):
         ParallelConfig,
         SchedulerConfig,
     )
+    from llmd_tpu.models.loader import config_from_hf, is_model_dir
     from llmd_tpu.models.registry import get_model_config
 
-    overrides = {"max_model_len": args.max_model_len}
+    overrides = {}
+    if args.max_model_len is not None:
+        overrides["max_model_len"] = args.max_model_len
     if lora_adapters:
         overrides["num_lora_adapters"] = len(lora_adapters)
         overrides["lora_rank"] = args.lora_rank
-    model = get_model_config(args.model, **overrides)
+    weights_path = args.weights_path
+    tokenizer_path = args.tokenizer
+    if is_model_dir(args.model):
+        # --model <hf-dir>: architecture, weights, and tokenizer all come
+        # from the checkpoint directory (vLLM-style); max_model_len
+        # defaults to the checkpoint's max_position_embeddings.
+        model = config_from_hf(args.model, **overrides)
+        weights_path = weights_path or args.model
+        tokenizer_path = tokenizer_path or args.model
+    else:
+        overrides.setdefault("max_model_len", 8192)
+        model = get_model_config(args.model, **overrides)
     kv_cfg = json.loads(args.kv_transfer_config) if args.kv_transfer_config else {}
     return EngineConfig(
         model=model,
@@ -69,8 +83,8 @@ def make_engine_config(args, lora_adapters=None):
             moe_backend=args.moe_backend,
         ),
         seed=args.seed,
-        weights_path=args.weights_path,
-        tokenizer_path=args.tokenizer,
+        weights_path=weights_path,
+        tokenizer_path=tokenizer_path,
         kv_role=kv_cfg.get("kv_role"),
         kv_side_channel_port=int(kv_cfg.get("side_channel_port", 9600)),
         kv_transfer_port=int(kv_cfg.get("transfer_port", 9100)),
@@ -94,7 +108,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weights-path", default=None)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
-    p.add_argument("--max-model-len", type=int, default=8192)
+    p.add_argument(
+        "--max-model-len", type=int, default=None,
+        help="default: checkpoint max_position_embeddings (dir models) or 8192",
+    )
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-gpu-blocks-override", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="bfloat16")
@@ -196,7 +213,7 @@ def main(argv=None) -> None:
     if not args.skip_warmup:
         n = engine.runner.warmup()
         logging.info("warmup compiled %d programs", n)
-    tokenizer = load_tokenizer(args.tokenizer)
+    tokenizer = load_tokenizer(config.tokenizer_path)
     app = build_app(
         AsyncEngine(engine),
         tokenizer,

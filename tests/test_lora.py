@@ -33,15 +33,16 @@ def _engine(n_adapters=2):
 
 
 def _install_adapters(engine, slots=(1, 2), scale=0.5):
-    """Load distinct nonzero B matrices into adapter slots.
+    """Load distinct full A+B adapters into slots.
 
     Slots initialize as exact base-model identities (B == 0); real serving
-    loads trained adapters through the same set_lora_weights hook."""
+    loads trained adapters through the same set_lora_weights hook, which
+    requires A and B together per projection."""
     layers = engine.runner.params["layers"]
     for s in slots:
         rng = np.random.default_rng(1000 + s)
         weights = {}
-        for k in ("lb_q", "lb_v"):
+        for k in ("la_q", "lb_q", "la_v", "lb_v"):
             shape = (layers[k].shape[0], *layers[k].shape[2:])
             weights[k] = rng.normal(0.0, scale, shape).astype(np.float32)
         engine.set_lora_weights(s, weights)
@@ -106,6 +107,16 @@ def test_lora_id_validation():
     engine = _engine(n_adapters=1)
     with pytest.raises(ValueError):
         engine.add_request([1, 2, 3], lora_id=5)
+
+
+def test_set_lora_weights_requires_paired_factors():
+    """B without A composes with a zero/stale A and silently serves an
+    identity adapter; the install hook must reject partial updates."""
+    engine = _engine()
+    layers = engine.runner.params["layers"]
+    lb_q = np.zeros((layers["lb_q"].shape[0], *layers["lb_q"].shape[2:]), np.float32)
+    with pytest.raises(ValueError, match="pair"):
+        engine.set_lora_weights(1, {"lb_q": lb_q})
 
 
 async def test_serving_surface_and_metrics():

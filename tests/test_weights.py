@@ -1,0 +1,300 @@
+"""Golden checkpoint parity: HF transformers is the numerical oracle.
+
+Every other numerics test in this suite compares the framework against its
+own XLA oracle; this one anchors to a real implementation. For each
+supported architecture a tiny transformers model (random init) is saved to
+an HF model directory (config.json + safetensors), loaded through the
+framework's loader, and must reproduce transformers' greedy continuation
+exactly (fp32, CPU). That retires the silent-wrongness class the reference
+stack never hits because it serves vLLM directly: rope layout/scaling,
+QK-norm placement, GQA head mapping, router softmax order, weight
+transposes.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from llmd_tpu.engine import LLMEngine, SamplingParams
+from llmd_tpu.models.loader import config_from_hf, is_model_dir, load_params
+
+PROMPT = [3, 17, 91, 4, 55, 23, 7, 120, 9, 33, 61, 2]
+NEW_TOKENS = 16
+
+
+def _save_hf(model, tmp_path):
+    d = tmp_path / "ckpt"
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def _hf_greedy(model, prompt, n):
+    model.eval()
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor([prompt]),
+            max_new_tokens=n,
+            do_sample=False,
+            pad_token_id=0,
+        )
+    return out[0, len(prompt):].tolist()
+
+
+def _ours_greedy(model_dir, prompt, n, **cfg_overrides):
+    cfg = config_from_hf(model_dir, dtype="float32", **cfg_overrides)
+    engine = LLMEngine(
+        EngineConfig(
+            model=cfg,
+            cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=64),
+            weights_path=model_dir,
+        )
+    )
+    out = engine.generate(
+        [prompt], SamplingParams(temperature=0.0, max_tokens=n, ignore_eos=True)
+    )
+    return next(iter(out.values()))
+
+
+def test_llama_greedy_matches_transformers(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
+def test_llama_rope_scaling_llama3_matches_transformers(tmp_path):
+    """Llama-3.1-style llama3 rope scaling must reproduce HF frequencies."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=True,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    # Long prompt so scaled wavelengths actually differ from unscaled.
+    prompt = [int(x) for x in np.random.default_rng(2).integers(1, 255, 90)]
+    golden = _hf_greedy(model, prompt, NEW_TOKENS)
+    assert _ours_greedy(d, prompt, NEW_TOKENS) == golden
+
+
+def test_qwen2_bias_greedy_matches_transformers(tmp_path):
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
+def test_qwen3_qk_norm_greedy_matches_transformers(tmp_path):
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(3)
+    model = transformers.Qwen3ForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
+def test_mixtral_moe_greedy_matches_transformers(tmp_path):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
+def test_deepseek_v2_mla_greedy_matches_transformers(tmp_path):
+    """DeepSeek-V2 parity: MLA latent attention (with the interleaved-rope
+    weight permutation) + softmax group-limited router (group max,
+    unnormalized top-k weights — the V2 defaults)."""
+    hf_cfg = transformers.DeepseekV2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=24,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        topk_method="group_limited_greedy", n_group=2, topk_group=1,
+        norm_topk_prob=False, routed_scaling_factor=1.0,
+        first_k_dense_replace=1,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(5)
+    model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
+def test_deepseek_v3_moe_greedy_matches_transformers(tmp_path):
+    """Full DeepSeek-V3 shape: MLA + sigmoid noaux_tc router with
+    correction bias, group-limited top-k, shared expert, dense prefix."""
+    hf_cfg = transformers.DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=24,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=8, num_experts_per_tok=2,
+        n_group=2, topk_group=1, n_shared_experts=1,
+        norm_topk_prob=True, routed_scaling_factor=2.5,
+        first_k_dense_replace=1,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(6)
+    model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+    # Make the correction bias matter for selection.
+    with torch.no_grad():
+        for layer in model.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
+def test_deepseek_v3_yarn_mscale_matches_transformers(tmp_path):
+    """Real DeepSeek V2/V3 checkpoints ship yarn rope scaling; V3 splits
+    the temperature correction into an mscale^2 softmax-scale multiplier
+    (mscale_all_dim) rather than scaling cos/sin."""
+    hf_cfg = transformers.DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=24,
+        qk_rope_head_dim=8, qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, num_experts_per_tok=2,
+        n_group=1, topk_group=1, n_shared_experts=1,
+        norm_topk_prob=True, routed_scaling_factor=1.0,
+        first_k_dense_replace=1,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 64,
+            "beta_fast": 32, "beta_slow": 1,
+            "mscale": 0.707, "mscale_all_dim": 0.707,
+        },
+    )
+    torch.manual_seed(7)
+    model = transformers.DeepseekV3ForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    prompt = [int(x) for x in np.random.default_rng(8).integers(1, 255, 90)]
+    golden = _hf_greedy(model, prompt, NEW_TOKENS)
+    assert _ours_greedy(d, prompt, NEW_TOKENS) == golden
+
+
+def test_llama_yarn_matches_transformers(tmp_path):
+    """Plain yarn (no mscale split): attention factor scales cos/sin."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=True,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(8)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    prompt = [int(x) for x in np.random.default_rng(9).integers(1, 255, 90)]
+    golden = _hf_greedy(model, prompt, NEW_TOKENS)
+    assert _ours_greedy(d, prompt, NEW_TOKENS) == golden
+
+
+def test_loader_rejects_sliding_window_and_unknown_rope(tmp_path):
+    d = tmp_path / "m"
+    d.mkdir()
+    base = {
+        "architectures": ["MistralForCausalLM"], "vocab_size": 64,
+        "hidden_size": 32, "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "num_key_value_heads": 1,
+    }
+    (d / "config.json").write_text(json.dumps({**base, "sliding_window": 4096}))
+    with pytest.raises(ValueError, match="sliding-window"):
+        config_from_hf(str(d))
+    (d / "config.json").write_text(json.dumps({
+        **base, "rope_scaling": {"rope_type": "longrope", "factor": 2.0},
+    }))
+    with pytest.raises(ValueError, match="longrope"):
+        config_from_hf(str(d))
+
+
+def test_config_from_hf_maps_fields(tmp_path):
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({
+        "architectures": ["Qwen3ForCausalLM"],
+        "vocab_size": 1000, "hidden_size": 96, "intermediate_size": 256,
+        "num_hidden_layers": 3, "num_attention_heads": 6,
+        "num_key_value_heads": 2, "head_dim": 24, "rope_theta": 1e6,
+        "rms_norm_eps": 1e-6, "max_position_embeddings": 4096,
+        "tie_word_embeddings": True,
+    }))
+    cfg = config_from_hf(str(d))
+    assert is_model_dir(str(d))
+    assert cfg.qk_norm and cfg.head_dim == 24 and cfg.num_kv_heads == 2
+    assert cfg.tie_word_embeddings and cfg.max_model_len == 4096
+
+    (d / "config.json").write_text(json.dumps({
+        "architectures": ["FalconForCausalLM"], "vocab_size": 10,
+        "hidden_size": 8, "intermediate_size": 16, "num_hidden_layers": 1,
+        "num_attention_heads": 2,
+    }))
+    with pytest.raises(ValueError, match="unsupported architecture"):
+        config_from_hf(str(d))
+
+
+def test_loader_rejects_missing_tensors(tmp_path):
+    """A checkpoint missing mapped tensors must fail loudly, not serve
+    random weights for the holes."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        tie_word_embeddings=True,
+    )
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    cfg = config_from_hf(d, num_layers=2)  # claims one more layer than saved
+    with pytest.raises(KeyError, match="model.layers.1"):
+        load_params(cfg, d)
