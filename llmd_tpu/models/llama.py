@@ -158,7 +158,7 @@ def forward_hidden(
 
             attn_out, cache = mla_attention(
                 h, lp, cache, layer_idx, inp, cfg, cos, sin,
-                world_size=world_size,
+                world_size=world_size, mesh=mesh,
             )
             x = x + attn_out
         else:
@@ -190,11 +190,11 @@ def forward_hidden(
             v = v.reshape(B, Q, K, D)
             cache = write_kv_pages_full(
                 cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
-                world_size=world_size,
+                world_size=world_size, mesh=mesh,
             )
             attn = paged_attention_full(
                 q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
-                sm_scale, world_size=world_size,
+                sm_scale, world_size=world_size, mesh=mesh,
             )
             x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)

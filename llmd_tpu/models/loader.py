@@ -72,10 +72,19 @@ def config_from_hf(model_dir: str, **overrides) -> ModelConfig:
         )
     from llmd_tpu.models.common import SUPPORTED_ROPE_TYPES, rope_type
 
-    if rope_type(hf.get("rope_scaling")) not in SUPPORTED_ROPE_TYPES:
+    rope_scaling = hf.get("rope_scaling")
+    if rope_type(rope_scaling) not in SUPPORTED_ROPE_TYPES:
         raise ValueError(
-            f"rope_scaling type {rope_type(hf.get('rope_scaling'))!r} "
+            f"rope_scaling type {rope_type(rope_scaling)!r} "
             f"not supported (have: {SUPPORTED_ROPE_TYPES})"
+        )
+    if rope_type(rope_scaling) == "yarn":
+        # HF's _compute_yarn_parameters falls back to the model's
+        # max_position_embeddings when the original length is absent.
+        rope_scaling = dict(rope_scaling)
+        rope_scaling.setdefault(
+            "original_max_position_embeddings",
+            hf.get("max_position_embeddings", 8192),
         )
     kw: dict = dict(
         name=p.name or str(p),
@@ -87,7 +96,7 @@ def config_from_hf(model_dir: str, **overrides) -> ModelConfig:
         num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
         head_dim=hf.get("head_dim"),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
-        rope_scaling=hf.get("rope_scaling"),
+        rope_scaling=rope_scaling,
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         max_model_len=int(hf.get("max_position_embeddings", 8192)),
         tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),

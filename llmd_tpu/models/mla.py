@@ -39,6 +39,7 @@ def mla_attention(
     cos: jax.Array | None = None,  # rope tables for qk_rope_head_dim,
     sin: jax.Array | None = None,  # hoisted out of the layer scan
     world_size: int = 1,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (attn output [B, Q, H_hidden], updated cache)."""
     B, Q, _ = h.shape
@@ -75,6 +76,7 @@ def mla_attention(
     cache = write_kv_pages_full(
         cache, layer_idx, lat4[..., :half], lat4[..., half:],
         inp.page_table, inp.positions, inp.valid, world_size=world_size,
+        mesh=mesh,
     )
 
     # ---- absorption: W_uk [nh, rank, nope], W_uv [nh, rank, vd]
@@ -90,7 +92,7 @@ def mla_attention(
     # streams live pages; never slices the pool)
     out_lat = mla_paged_attention_full(
         q_eff, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
-        rank=rank, sm_scale=sm_scale, world_size=world_size,
+        rank=rank, sm_scale=sm_scale, world_size=world_size, mesh=mesh,
     )  # [B, Q, nh, rank]
     out = jnp.einsum("bqhr,hrv->bqhv", out_lat, w_uv)  # [B, Q, nh, vd]
     return out.reshape(B, Q, nh * vd) @ lp["wo"], cache

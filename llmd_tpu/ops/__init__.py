@@ -6,6 +6,19 @@ time: the Pallas decode kernels on TPU-class backends for Q=1 with
 tile-compatible geometry, the XLA fallbacks otherwise. Env
 LLMD_PALLAS=off disables the kernels; =interpret forces interpret mode
 (CPU parity testing).
+
+Sharded meshes (tp/dp > 1) run the SAME kernels per device under
+shard_map — the role FlashInfer plays under vLLM TP in the reference
+stack (docker/Dockerfile.cuda:71-72). Layout contract:
+
+  - q/attention-output heads shard over tp (they arrive sharded: wq/wo
+    are tp-sharded in PARAM_SPECS); the KV pool's kv-head axis shards
+    over tp when tp divides num_kv_heads (kv_cache_spec).
+  - the batch shards over dp for attention reads; KV WRITES replicate
+    the (tiny) per-step K/V slabs across dp so every dp replica of the
+    pool applies identical updates and replicas never diverge — the
+    pool itself is never partitioned over dp (each dp group keeps a
+    full copy, matching the engine's per-rank-pool design).
 """
 
 from __future__ import annotations
@@ -14,6 +27,8 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
 
 from llmd_tpu.ops.paged_attention import (
     paged_attention_xla,
@@ -43,34 +58,84 @@ def _on_tpu() -> bool:
         return False
 
 
-def _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d: bool) -> bool:
-    """Single source of truth for the kernel gates.
-
-    Common constraints: decode shape (Q==1), sublane-tiled pages
-    (page % 8), packed K/V halves (D2 == 2D), kernels enabled, and an
-    unsharded mesh (no GSPMD rule for the kernels yet).
-    ``need_lane_d``: the ATTENTION kernel matmuls over D, so D itself
-    must be lane-tiled (D % 128); the WRITE kernel only moves [.., D2]
-    slabs, so D2 % 128 suffices (head_dim-64 models keep the in-place
-    write).
-    """
-    mode = _mode()
-    if not (
-        Q == 1
-        and page % 8 == 0
-        and D2 == 2 * D
-        and D2 % 128 == 0
-        and mode != "off"
-        and world_size == 1
-    ):
-        return False
-    if need_lane_d and D % 128 != 0:
-        return False
-    return mode == "interpret" or _on_tpu()
-
-
 def _interpret() -> bool:
     return _mode() == "interpret"
+
+
+def _platform_ok() -> bool:
+    return _mode() == "interpret" or _on_tpu()
+
+
+def _mesh_dims(mesh) -> tuple[int, int] | None:
+    if mesh is None or not ({"dp", "tp"} <= set(mesh.axis_names)):
+        return None
+    return mesh.shape["dp"], mesh.shape["tp"]
+
+
+def _geometry_ok(Q, page, D, D2, need_lane_d: bool) -> bool:
+    """Per-shard kernel geometry: decode shape (Q==1), sublane-tiled pages
+    (page % 8), packed K/V halves (D2 == 2D). ``need_lane_d``: the
+    ATTENTION kernel matmuls over D, so D itself must be lane-tiled
+    (D % 128); the WRITE kernel only moves [.., D2] slabs, so D2 % 128
+    suffices (head_dim-64 models keep the in-place write)."""
+    if not (Q == 1 and page % 8 == 0 and D2 == 2 * D and D2 % 128 == 0):
+        return False
+    return not (need_lane_d and D % 128 != 0)
+
+
+def _mesh_plan(world_size, mesh, B=None, H=None, K=None) -> str:
+    """Shared tail of every dispatch decision once geometry/platform pass:
+    "direct" (single device), "shard" (per-device kernels under
+    shard_map), or "xla". Divisibility gates, each skipped when the axis
+    is irrelevant to the caller (None): tp | H (q heads stay local),
+    tp | K for K > 1 (the pool's kv-head axis is tp-sharded; K == 1 MLA
+    latent pools replicate), dp | B (batch rows split evenly — writes
+    replicate the batch instead and pass B=None)."""
+    if world_size == 1:
+        return "direct"
+    dims = _mesh_dims(mesh)
+    if dims is None:
+        return "xla"
+    dp, tp = dims
+    if H is not None and H % tp:
+        return "xla"
+    if K is not None and K > 1 and K % tp:
+        return "xla"
+    if B is not None and B % dp:
+        return "xla"
+    return "shard"
+
+
+def _plan(Q, page, D, D2, world_size, need_lane_d, mesh, B, H, K):
+    """Dense-kernel dispatch: geometry/platform gate, then _mesh_plan."""
+    if _mode() == "off" or not _geometry_ok(Q, page, D, D2, need_lane_d):
+        return "xla"
+    if not _platform_ok():
+        return "xla"
+    return _mesh_plan(world_size, mesh, B=B, H=H, K=K)
+
+
+def _plan_write(Q, page, D, D2, world_size, mesh):
+    """Write-kernel dispatch: no head/batch divisibility gates — the
+    sharded write replicates the batch across dp and _kv_head_axis
+    degrades to a replicated head axis when tp does not divide K."""
+    if _mode() == "off" or not _geometry_ok(Q, page, D, D2, need_lane_d=False):
+        return "xla"
+    if not _platform_ok():
+        return "xla"
+    return _mesh_plan(world_size, mesh)
+
+
+def _plan_mla(Q, page, Dl, rank, world_size, mesh, B, H):
+    """MLA attention dispatch: latent-width tiling instead of D2 == 2D;
+    the latent pool replicates over tp (K folds away)."""
+    if _mode() == "off" or not (
+        Q == 1 and page % 8 == 0 and Dl % 128 == 0 and rank % 128 == 0
+    ):
+        return "xla"
+    if not _platform_ok():
+        return "xla"
+    return _mesh_plan(world_size, mesh, B=B, H=H)
 
 
 # Above this context size the dense XLA attention's [B, Q, .., S] score
@@ -99,7 +164,51 @@ def _decode_write_prep(k, v, page_table, positions, page):
     return kv_new, phys, pos % page
 
 
-def write_kv_pages(kv_cache, k, v, page_table, positions, valid, world_size=1):
+def _kv_head_axis(K: int, tp: int) -> str | None:
+    # K == 1 (MLA latent pool) and non-dividing K keep the head axis
+    # replicated — matching kv_cache_spec's allocation-time policy.
+    return "tp" if tp > 1 and K > 1 and K % tp == 0 else None
+
+
+def _write_sharded(mesh, kv_cache, kv_new, layer, phys, offset, valid, full):
+    """Per-device in-place writes with the batch REPLICATED across dp:
+    the slabs are tiny (B x K x 2D), and identical writes on every dp
+    replica keep the un-partitioned pool consistent."""
+    K = kv_new.shape[1]
+    tp_k = _kv_head_axis(K, mesh.shape["tp"])
+    cache_spec = (
+        P(None, None, tp_k, None, None) if full else P(None, tp_k, None, None)
+    )
+    interpret = _interpret()
+
+    if full:
+
+        def local(cache, kv_new, layer, phys, offset, valid):
+            return write_kv_pages_decode_full(
+                cache, kv_new, layer, phys, offset, valid, interpret=interpret
+            )
+
+        args = (kv_cache, kv_new, layer, phys, offset, valid)
+        in_specs = (cache_spec, P(None, tp_k, None), P(), P(), P(), P())
+    else:
+
+        def local(cache, kv_new, phys, offset, valid):
+            return write_kv_pages_decode(
+                cache, kv_new, phys, offset, valid, interpret=interpret
+            )
+
+        args = (kv_cache, kv_new, phys, offset, valid)
+        in_specs = (cache_spec, P(None, tp_k, None), P(), P(), P())
+
+    return shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=cache_spec,
+        check_rep=False,
+    )(*args)
+
+
+def write_kv_pages(
+    kv_cache, k, v, page_table, positions, valid, world_size=1, mesh=None
+):
     """Scatter this step's K/V into the (single-layer) paged cache.
 
     Decode (Q==1) on TPU uses the Pallas in-place kernel — the XLA
@@ -109,33 +218,45 @@ def write_kv_pages(kv_cache, k, v, page_table, positions, valid, world_size=1):
     """
     B, Q, K, D = k.shape
     num_pages, Kc, page, D2 = kv_cache.shape
-    if _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d=False):
+    plan = _plan_write(Q, page, D, D2, world_size, mesh)
+    if plan != "xla":
         kv_new, phys, offset = _decode_write_prep(k, v, page_table, positions, page)
-        return write_kv_pages_decode(
-            kv_cache, kv_new, phys, offset, valid[:, 0], interpret=_interpret()
+        if plan == "direct":
+            return write_kv_pages_decode(
+                kv_cache, kv_new, phys, offset, valid[:, 0], interpret=_interpret()
+            )
+        return _write_sharded(
+            mesh, kv_cache, kv_new, None, phys, offset, valid[:, 0], full=False
         )
     return write_kv_pages_xla(kv_cache, k, v, page_table, positions, valid)
 
 
 def write_kv_pages_full(
-    kv_cache_full, layer, k, v, page_table, positions, valid, world_size=1
+    kv_cache_full, layer, k, v, page_table, positions, valid,
+    world_size=1, mesh=None,
 ):
     """Layer-indexed write on the FULL [L, ...] cache (scan-carry layout).
 
     The whole point: a lax.scan over layers that slices the cache pays a
     pool-sized copy per layer (slice + update, or xs->ys buffers); the
     Pallas variant indexes [layer, page] inside the kernel so only the
-    written slabs move. Fallback (CPU / prefill / sharded): dynamic
-    slice + XLA scatter + dynamic update — the carry-update pattern XLA
-    optimizes in place where it can.
+    written slabs move. Fallback (CPU / prefill / non-divisible
+    sharding): dynamic slice + XLA scatter + dynamic update — the
+    carry-update pattern XLA optimizes in place where it can.
     """
     B, Q, K, D = k.shape
     L, num_pages, Kc, page, D2 = kv_cache_full.shape
-    if _dispatch_kernel(Q, page, D, D2, world_size, need_lane_d=False):
+    plan = _plan_write(Q, page, D, D2, world_size, mesh)
+    if plan != "xla":
         kv_new, phys, offset = _decode_write_prep(k, v, page_table, positions, page)
-        return write_kv_pages_decode_full(
-            kv_cache_full, kv_new, layer, phys, offset, valid[:, 0],
-            interpret=_interpret(),
+        if plan == "direct":
+            return write_kv_pages_decode_full(
+                kv_cache_full, kv_new, layer, phys, offset, valid[:, 0],
+                interpret=_interpret(),
+            )
+        return _write_sharded(
+            mesh, kv_cache_full, kv_new, layer, phys, offset, valid[:, 0],
+            full=True,
         )
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
     sl = write_kv_pages_xla(sl, k, v, page_table, positions, valid)
@@ -143,49 +264,82 @@ def write_kv_pages_full(
 
 
 def paged_attention(
-    q, kv_cache, page_table, kv_lens, positions, sm_scale=None, world_size=1
+    q, kv_cache, page_table, kv_lens, positions, sm_scale=None,
+    world_size=1, mesh=None,
 ):
-    """``world_size`` is the device count of the executing mesh. The Pallas
-    kernel has no GSPMD partitioning rule yet, so it only dispatches for
-    world_size == 1 (a sharded jit would otherwise all-gather the KV pool or
-    fail to lower); the shard_map-wrapped kernel for tp>1 is future work."""
+    """Decode attention. Sharded meshes run the kernel per device under
+    shard_map: q/output heads over tp, batch over dp, pool heads over tp
+    (dp replicas of the pool read-only here)."""
     num_pages, K, page, D2 = kv_cache.shape
-    D = q.shape[-1]
-    if _dispatch_kernel(q.shape[1], page, D, D2, world_size, need_lane_d=True):
+    B, Q, H, D = q.shape
+    plan = _plan(Q, page, D, D2, world_size, True, mesh, B, H, K)
+    if plan == "direct":
         return decode_paged_attention(
             q, kv_cache, page_table, kv_lens, sm_scale=sm_scale,
             interpret=_interpret(),
         )
+    if plan == "shard":
+        tp_k = _kv_head_axis(K, mesh.shape["tp"])
+        interpret = _interpret()
+
+        def local(q, cache, pt, kl):
+            return decode_paged_attention(
+                q, cache, pt, kl, sm_scale=sm_scale, interpret=interpret
+            )
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                P("dp", None, "tp", None), P(None, tp_k, None, None),
+                P("dp", None), P("dp"),
+            ),
+            out_specs=P("dp", None, "tp", None),
+            check_rep=False,
+        )(q, kv_cache, page_table, kv_lens)
     return _attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)
 
 
 def mla_paged_attention_full(
     q_eff, latent_cache_full, layer, page_table, kv_lens, positions,
-    rank, sm_scale, world_size=1,
+    rank, sm_scale, world_size=1, mesh=None,
 ):
     """Layer-indexed MLA latent attention on the FULL [L, ...] cache.
 
-    Pallas for decode (Q==1, lane-tiled latent width); XLA gather
-    fallback otherwise (prefill, CPU, sharded). Returns [B, Q, H, rank].
+    Pallas for decode (Q==1, lane-tiled latent width); sharded meshes
+    split the query heads over tp and the batch over dp against the
+    replicated latent pool (rows are a few hundred bytes; every head
+    reads the same latent). XLA gather fallback otherwise.
     """
     from llmd_tpu.ops.mla_attention import mla_paged_attention_xla
     from llmd_tpu.ops.mla_decode import mla_decode_paged_attention_full
 
     L, num_pages, one, page, Dl = latent_cache_full.shape
-    mode = _mode()
-    kernel_ok = (
-        q_eff.shape[1] == 1
-        and page % 8 == 0
-        and Dl % 128 == 0
-        and rank % 128 == 0
-        and mode != "off"
-        and world_size == 1
-    )
-    if kernel_ok and (mode == "interpret" or _on_tpu()):
+    B, Q, H, _ = q_eff.shape
+    plan = _plan_mla(Q, page, Dl, rank, world_size, mesh, B, H)
+    if plan == "direct":
         return mla_decode_paged_attention_full(
             q_eff, latent_cache_full, layer, page_table, kv_lens,
             rank=rank, sm_scale=sm_scale, interpret=_interpret(),
         )
+    if plan == "shard":
+        interpret = _interpret()
+
+        def local(q_eff, cache, layer, pt, kl):
+            return mla_decode_paged_attention_full(
+                q_eff, cache, layer, pt, kl, rank=rank,
+                sm_scale=sm_scale, interpret=interpret,
+            )
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                P("dp", None, "tp", None),
+                P(None, None, None, None, None),
+                P(), P("dp", None), P("dp"),
+            ),
+            out_specs=P("dp", None, "tp", None),
+            check_rep=False,
+        )(q_eff, latent_cache_full, layer, page_table, kv_lens)
     sl = jax.lax.dynamic_index_in_dim(
         latent_cache_full, layer, 0, keepdims=False
     )
@@ -196,16 +350,35 @@ def mla_paged_attention_full(
 
 def paged_attention_full(
     q, kv_cache_full, layer, page_table, kv_lens, positions,
-    sm_scale=None, world_size=1,
+    sm_scale=None, world_size=1, mesh=None,
 ):
     """Layer-indexed attention on the FULL [L, ...] cache (see
     write_kv_pages_full)."""
     L, num_pages, K, page, D2 = kv_cache_full.shape
-    D = q.shape[-1]
-    if _dispatch_kernel(q.shape[1], page, D, D2, world_size, need_lane_d=True):
+    B, Q, H, D = q.shape
+    plan = _plan(Q, page, D, D2, world_size, True, mesh, B, H, K)
+    if plan == "direct":
         return decode_paged_attention_full(
             q, kv_cache_full, layer, page_table, kv_lens, sm_scale=sm_scale,
             interpret=_interpret(),
         )
+    if plan == "shard":
+        tp_k = _kv_head_axis(K, mesh.shape["tp"])
+        interpret = _interpret()
+
+        def local(q, cache, layer, pt, kl):
+            return decode_paged_attention_full(
+                q, cache, layer, pt, kl, sm_scale=sm_scale, interpret=interpret
+            )
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                P("dp", None, "tp", None), P(None, None, tp_k, None, None),
+                P(), P("dp", None), P("dp"),
+            ),
+            out_specs=P("dp", None, "tp", None),
+            check_rep=False,
+        )(q, kv_cache_full, layer, page_table, kv_lens)
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
     return _attention_xla(q, sl, page_table, kv_lens, positions, sm_scale)
