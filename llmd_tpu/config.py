@@ -182,10 +182,13 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     expert_parallel_size: int = 1  # folded over the same devices as tp*dp
-    # MoE execution path: "dense" = one-hot combine, XLA all-gathers expert
-    # shards (deepep_high_throughput analogue, good for prefill); "ep" =
-    # shard_map all-to-all dispatch/combine (deepep_low_latency analogue).
-    moe_backend: str = "dense"
+    # MoE execution path: "grouped" (default) = tokens sorted by expert
+    # feed Pallas/XLA grouped GEMMs so each expert multiplies only its
+    # routed rows (the DeepGEMM role); "dense" = one-hot combine running
+    # every expert on every token (numerics oracle, E/top_k extra FLOPs);
+    # "ep" = shard_map all-to-all dispatch/combine with grouped local
+    # expert compute (deepep_low_latency analogue for wide-EP).
+    moe_backend: str = "grouped"
     # EP dispatch capacity factor (send slots per destination shard relative
     # to a uniform split; tokens past capacity are dropped from the combine).
     ep_capacity_factor: float = 2.0

@@ -205,7 +205,16 @@ def forward_hidden(
                 out = moe_block_ep(
                     h2, lp, cfg, mesh, capacity_factor=ep_capacity_factor
                 )
+            elif moe_backend == "grouped" and world_size == 1:
+                from llmd_tpu.models.moe import moe_block_grouped
+
+                out = moe_block_grouped(h2, lp, cfg)
             else:
+                # Sharded jit without the EP backend: the dense combine is
+                # the only path GSPMD can partition (expert weights are
+                # EP-sharded; the grouped kernel has no partitioning rule
+                # — multi-device MoE should run moe_backend="ep", whose
+                # shard_map body uses the grouped GEMM locally).
                 out = moe_block(h2, lp, cfg)
         else:
             out = _mlp(h2, lp)

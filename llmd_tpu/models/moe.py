@@ -79,6 +79,33 @@ def router_topk(
     return weights * cfg.routed_scaling_factor, ids
 
 
+def shared_expert_ffn(ht: jax.Array, lp: dict) -> jax.Array:
+    """DeepSeek/Qwen2-MoE always-on shared expert (one place, three
+    backends: dense / grouped / EP)."""
+    g = jax.nn.silu(ht @ lp["ws_gate"])
+    return (g * (ht @ lp["ws_up"])) @ lp["ws_down"]
+
+
+def moe_block_grouped(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
+    """MoE FFN via grouped GEMM (DeepGEMM role): tokens sorted by expert,
+    each expert multiplies only its routed rows. Numerically equivalent to
+    the dense combine (same f32 weighted sum) at top_k/E of the FLOPs."""
+    from llmd_tpu.ops.grouped_gemm import moe_apply_grouped
+
+    B, Q, H = h.shape
+    T = B * Q
+    ht = h.reshape(T, H)
+    weights, ids = router_topk(
+        ht, lp["router"], cfg.num_experts_per_tok, cfg, lp.get("router_bias")
+    )
+    out = moe_apply_grouped(
+        ht, weights, ids, lp["we_gate"], lp["we_up"], lp["we_down"]
+    ).astype(h.dtype)
+    if cfg.shared_expert_intermediate_size:
+        out = out + shared_expert_ffn(ht, lp)
+    return out.reshape(B, Q, H)
+
+
 def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     """MoE FFN on [B, Q, H] -> [B, Q, H] (dense-combine path)."""
     B, Q, H = h.shape
@@ -98,6 +125,5 @@ def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     out = out.astype(h.dtype)
 
     if cfg.shared_expert_intermediate_size:
-        g = jax.nn.silu(ht @ lp["ws_gate"])
-        out = out + (g * (ht @ lp["ws_up"])) @ lp["ws_down"]
+        out = out + shared_expert_ffn(ht, lp)
     return out.reshape(B, Q, H)

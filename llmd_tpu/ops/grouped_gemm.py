@@ -1,0 +1,110 @@
+"""Grouped GEMM for MoE expert compute — the DeepGEMM role.
+
+The reference's wide-EP decode path routes MoE through DeepGEMM's masked
+grouped GEMMs (`--moe-backend deep_gemm`, guides/wide-ep-lws/modelserver/
+gpu/vllm/base/decode.yaml:128) so each expert multiplies ONLY its routed
+tokens. The TPU-native equivalent: tokens sorted by expert id feed a
+ragged/grouped matmul — jax's Pallas megablocks kernel (`megablox.gmm`)
+on TPU, `lax.ragged_dot` elsewhere — instead of the one-hot masked
+contraction that burns E/top_k redundant FLOPs.
+
+FLOPs per token: 3 * k * H * F (exactly the routed work) vs the dense
+combine's 3 * E * H * F.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_megablox(H: int, F: int) -> bool:
+    """megablox wants lane-tiled contraction/output dims; anything else
+    (tiny models) takes the XLA ragged_dot, which is correct everywhere.
+    LLMD_PALLAS=interpret forces the kernel in interpret mode so CPU CI
+    parity-tests the same glue (tiling, padding, sorting) TPUs run."""
+    mode = os.environ.get("LLMD_PALLAS", "auto")
+    if mode == "off":
+        return False
+    if H % 128 or F % 128:
+        return False
+    if mode == "interpret":
+        return True
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    return platform in ("tpu", "axon")
+
+
+def grouped_matmul(
+    x: jax.Array,            # [T, K_dim] tokens sorted by group
+    w: jax.Array,            # [G, K_dim, N]
+    group_sizes: jax.Array,  # [G] i32, sums to T
+) -> jax.Array:              # [T, N]
+    T, K_dim = x.shape
+    G, _, N = w.shape
+    if _use_megablox(K_dim, N):
+        from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm
+
+        # gmm requires m % tile_m == 0 and a sublane-aligned tile: pad rows
+        # up to the (8-aligned) tile. Pad rows are zero and land in the
+        # LAST group (group_sizes must sum to m); their zero outputs are
+        # sliced off below.
+        tm = min(128, -(-max(T, 1) // 8) * 8)
+        pad = (-T) % tm
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad, K_dim), x.dtype)], axis=0)
+            group_sizes = group_sizes.at[-1].add(pad)
+        out = gmm(
+            x, w, group_sizes.astype(jnp.int32),
+            preferred_element_type=jnp.float32,
+            tiling=(tm, 128, 128),
+            interpret=os.environ.get("LLMD_PALLAS") == "interpret",
+        )
+        return out[:T].astype(x.dtype)
+    return jax.lax.ragged_dot(
+        x, w, group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def expert_mlp_grouped(
+    x_sorted: jax.Array,     # [T', H] rows sorted by expert
+    group_sizes: jax.Array,  # [E]
+    we_gate: jax.Array,      # [E, H, F]
+    we_up: jax.Array,        # [E, H, F]
+    we_down: jax.Array,      # [E, F, H]
+) -> jax.Array:              # [T', H]
+    gate = jax.nn.silu(grouped_matmul(x_sorted, we_gate, group_sizes))
+    up = grouped_matmul(x_sorted, we_up, group_sizes)
+    return grouped_matmul((gate * up).astype(x_sorted.dtype), we_down, group_sizes)
+
+
+def moe_apply_grouped(
+    ht: jax.Array,       # [T, H]
+    weights: jax.Array,  # [T, k] f32 combine weights (scaled/normalized)
+    ids: jax.Array,      # [T, k] i32 expert ids
+    we_gate: jax.Array,
+    we_up: jax.Array,
+    we_down: jax.Array,
+) -> jax.Array:          # [T, H] f32
+    """Route -> sort-by-expert -> grouped MLP -> weighted unsort-combine."""
+    T, H = ht.shape
+    k = ids.shape[1]
+    E = we_gate.shape[0]
+    flat_ids = ids.reshape(-1)                       # [T*k]
+    order = jnp.argsort(flat_ids)                    # stable
+    tok = order // k                                 # source token per slot
+    xs = ht[tok]                                     # [T*k, H]
+    group_sizes = jnp.bincount(flat_ids, length=E)
+    ys = expert_mlp_grouped(xs, group_sizes, we_gate, we_up, we_down)
+    w_sorted = weights.reshape(-1)[order]
+    return (
+        jnp.zeros((T, H), jnp.float32)
+        .at[tok]
+        .add(ys.astype(jnp.float32) * w_sorted[:, None])
+    )

@@ -139,12 +139,21 @@ def _moe_ep_local(
     er = recv_e.reshape(W * C)
     vr = recv_v.reshape(W * C)
 
-    # Local experts: one-hot masked grouped contraction over E_loc.
-    onehot_e = jax.nn.one_hot(er, E_loc, dtype=xr.dtype) * vr[:, None].astype(xr.dtype)
-    gate = jax.nn.silu(jnp.einsum("th,ehf->etf", xr, we_gate))
-    up = jnp.einsum("th,ehf->etf", xr, we_up)
-    per_e = jnp.einsum("etf,efh->eth", gate * up, we_down)  # [E_loc, WC, H]
-    yr = jnp.einsum("eth,te->th", per_e, onehot_e)  # [WC, H]
+    # Local experts via grouped GEMM (DeepGEMM role): sort received slots
+    # by local expert id so each expert multiplies only its rows. Invalid
+    # slots carry zero inputs (the send buffers initialize to zero), so
+    # their MLP output is zero; the vr mask stays as belt-and-braces.
+    from llmd_tpu.ops.grouped_gemm import expert_mlp_grouped
+
+    order = jnp.argsort(er)
+    group_sizes = jnp.bincount(er, length=E_loc)
+    ys = expert_mlp_grouped(
+        xr[order], group_sizes, we_gate, we_up, we_down
+    )
+    yr = (
+        jnp.zeros_like(xr).at[order].set(ys)
+        * vr[:, None].astype(xr.dtype)
+    )
 
     # Combine: reverse all-to-all returns each slot to its source shard.
     back = jax.lax.all_to_all(yr.reshape(W, C, H), axes, 0, 0)  # [W, C, H]
@@ -157,7 +166,10 @@ def _moe_ep_local(
     ).astype(ht.dtype)
 
     if shared:
+        from llmd_tpu.models.moe import shared_expert_ffn
+
         ws_gate, ws_up, ws_down = shared
-        g = jax.nn.silu(ht @ ws_gate)
-        y = y + (g * (ht @ ws_up)) @ ws_down
+        y = y + shared_expert_ffn(
+            ht, {"ws_gate": ws_gate, "ws_up": ws_up, "ws_down": ws_down}
+        )
     return y

@@ -126,8 +126,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="this process's global DP rank (set by the DP supervisor)",
     )
     p.add_argument(
-        "--moe-backend", default="dense", choices=["dense", "ep"],
-        help="MoE path: dense combine or shard_map all-to-all (wide-EP)",
+        "--moe-backend", default="grouped", choices=["grouped", "dense", "ep"],
+        help="MoE path: grouped GEMM (DeepGEMM role, default), dense "
+             "combine (oracle), or shard_map all-to-all (wide-EP)",
     )
     p.add_argument(
         "--platform", default=None,
