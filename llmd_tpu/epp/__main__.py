@@ -54,6 +54,12 @@ def main(argv=None) -> None:
     )
     p.add_argument("--scrape-interval", type=float, default=1.0)
     p.add_argument(
+        "--ext-proc-port", type=int, default=None,
+        help="ALSO serve the Envoy ext-proc gRPC protocol on this port "
+        "(the reference EPP's primary deployment shape; the HTTP fused "
+        "proxy stays up for /metrics and no-Envoy clients)",
+    )
+    p.add_argument(
         "--otlp-traces-endpoint", default=None,
         help="OTLP/HTTP collector base URL (e.g. http://otel:4318)",
     )
@@ -150,6 +156,19 @@ def main(argv=None) -> None:
 
         app.on_startup.append(_start_k8s)
         router.closables.append(k8s)
+    if args.ext_proc_port is not None:
+        from llmd_tpu.epp.extproc import ExtProcServer
+
+        extproc = ExtProcServer(router, host=args.host, port=args.ext_proc_port)
+
+        async def _start_extproc(app):
+            await extproc.start()
+
+        async def _stop_extproc(app):
+            await extproc.stop()
+
+        app.on_startup.append(_start_extproc)
+        app.on_cleanup.append(_stop_extproc)
     web.run_app(app, host=args.host, port=args.port)
 
 

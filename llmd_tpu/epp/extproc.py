@@ -1,0 +1,273 @@
+"""Envoy ext-proc gRPC mode for the EPP.
+
+The reference EPP's primary deployment shape: an external-processor plugin
+behind Envoy / a K8s Gateway (docs/architecture/core/router/epp/
+README.md:11-18, proxy.md:16-26). Envoy parks the request and streams it
+over a bidirectional gRPC `Process` call; the EPP answers with header
+mutations naming the picked endpoint, and Envoy forwards the request
+itself. The fused reverse-proxy mode (epp/server.py) stays as the no-K8s
+shape; this module reuses its exact pipeline — parse -> admitters -> flow
+control -> data producers -> schedule — only the transport differs.
+
+Exchange per request (processing mode: request headers + BUFFERED body):
+
+  Envoy -> request_headers         (stash; CONTINUE)
+  Envoy -> request_body (eos)      (run pipeline; reply BodyResponse with
+                                    x-gateway-destination-endpoint +
+                                    x-llm-d-* header mutations and
+                                    clear_route_cache, or an
+                                    ImmediateResponse 429/503 with
+                                    x-llm-d-request-dropped-reason per
+                                    flow-control.md:369-409)
+  Envoy -> response_headers        (record status; CONTINUE)
+  stream end                       (release inflight accounting)
+
+Failure semantics (flow-control.md:345-359): pipeline errors abort the
+stream with a gRPC error — Envoy's `failure_mode_allow` then decides
+FailOpen (route unpicked) vs FailClose (reject). Explicit rejections
+(flow control, admitters) are ImmediateResponses, which Envoy returns to
+the client in BOTH failure modes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import grpc
+
+from llmd_tpu.epp import extproc_pb as pb
+from llmd_tpu.epp.flow_control import OUTCOME_HTTP, Outcome
+from llmd_tpu.epp.handler import ParseError, parse_request
+from llmd_tpu.epp.scheduler import NoEndpointsError
+from llmd_tpu.epp.types import HDR_DROP_REASON, HDR_ENCODER, HDR_PREFILLER
+from llmd_tpu.obs.tracing import get_tracer
+
+log = logging.getLogger(__name__)
+
+METHOD = "/envoy.service.ext_proc.v3.ExternalProcessor/Process"
+# The Gateway-API inference-extension destination header (GAIE protocol;
+# Envoy's original_dst cluster routes on it).
+HDR_DESTINATION = "x-gateway-destination-endpoint"
+HDR_ENDPOINT = "x-llm-d-endpoint"
+
+
+class ExtProcSession:
+    """One gRPC stream == one HTTP request being processed."""
+
+    def __init__(self, router) -> None:
+        self.router = router
+        self.headers: dict[str, str] = {}
+        self.body = bytearray()
+        self.req = None
+        self.pod = None
+        self.t_routed: float | None = None
+        self._flow_held = False
+
+    async def on_message(self, msg: pb.ProcessingRequest) -> bytes | None:
+        if msg.kind == "request_headers":
+            self.headers = msg.headers
+            if msg.end_of_stream:
+                # Bodyless request (GET /v1/models etc): route on headers.
+                return await self._route()
+            return pb.encode_common_response("request_headers")
+        if msg.kind == "request_body":
+            self.body.extend(msg.body)
+            if msg.end_of_stream:
+                return await self._route()
+            return None  # streamed chunk; wait for end_of_stream
+        if msg.kind == "response_headers":
+            status = msg.headers.get(":status", "")
+            if self.req is not None and self.pod is not None:
+                ttft_ms = None
+                if self.t_routed is not None and status.startswith("2"):
+                    ttft_ms = (time.monotonic() - self.t_routed) * 1e3
+                # Fire-and-forget like the fused proxy (server.py): a slow
+                # observer (predictor training POST) must not hold Envoy's
+                # response delivery.
+                task = asyncio.ensure_future(
+                    self.router._run_observers(self.req, self.pod, ttft_ms, None)
+                )
+                self.router._observer_tasks.add(task)
+                task.add_done_callback(self.router._observer_tasks.discard)
+            return pb.encode_common_response("response_headers")
+        if msg.kind in ("request_trailers", "response_trailers"):
+            return pb.encode_common_response(msg.kind)
+        if msg.kind == "response_body":
+            return pb.encode_common_response("response_body")
+        return None
+
+    def close(self) -> None:
+        """Stream end: release scheduling + flow-control accounting.
+
+        The flow slot is held for the whole stream (Envoy is proxying the
+        request until it closes), matching the fused proxy's release-in-
+        finally — releasing at schedule time would make the max_inflight
+        saturation gate count near-zero concurrency."""
+        if self._flow_held:
+            self._flow_held = False
+            self.router.flow.release()
+        if self.pod is not None:
+            self.pod.inflight = max(0, self.pod.inflight - 1)
+            if self.req is not None:
+                self.pod.inflight_tokens = max(
+                    0, self.pod.inflight_tokens - self.req.approx_prompt_tokens
+                )
+                self.router.scheduler.notify_complete(self.req, self.pod)
+            self.pod = None
+
+    # -------------------------------------------------------------- core
+
+    def _reject(self, status: int, reason: str) -> bytes:
+        return pb.encode_immediate_response(
+            status,
+            headers={HDR_DROP_REASON: reason},
+            body=(
+                b'{"error": {"message": "%s"}}' % reason.encode()
+            ),
+            details=reason,
+        )
+
+    async def _route(self) -> bytes:
+        router = self.router
+        router.metrics.requests_total += 1
+        path = self.headers.get(":path", "/v1/completions")
+        raw = bytes(self.body)
+        try:
+            req = parse_request(path, self.headers, raw, router.default_parser)
+        except ParseError as e:
+            return self._reject(400, str(e))
+        self.req = req
+        span = get_tracer().start_span(
+            "router.extproc",
+            traceparent=self.headers.get("traceparent"),
+            kind="SPAN_KIND_SERVER",
+        )
+        span.set("gen_ai.request.model", req.model)
+        req.scratch["span"] = span
+        try:
+            return await self._route_inner(req, raw, span)
+        finally:
+            span.end()
+
+    async def _route_inner(self, req, raw: bytes, span) -> bytes:
+        router = self.router
+        for adm in router.admitters:
+            if not adm.needs_producers:
+                reason = adm.admit(req)
+                if reason is not None:
+                    return self._reject(429, reason)
+        outcome = await router.flow.enqueue_and_wait(req, nbytes=len(raw))
+        span.set("llm_d.flow_control.outcome", str(outcome.value))
+        if outcome is not Outcome.DISPATCHED:
+            status, reason = OUTCOME_HTTP[outcome]
+            return self._reject(status, reason)
+        handed_off = False
+        try:
+            for producer in router.producers:
+                try:
+                    await producer.produce(req, router.store.list())
+                except Exception:
+                    log.exception(
+                        "data producer %s failed", type(producer).__name__
+                    )
+            for adm in router.admitters:
+                if adm.needs_producers:
+                    reason = adm.admit(req)
+                    if reason is not None:
+                        return self._reject(429, reason)
+            router.metrics.scheduling_attempts += 1
+            try:
+                result = router.scheduler.schedule(req, router.store.list())
+            except NoEndpointsError as e:
+                router.metrics.scheduling_errors += 1
+                return self._reject(503, f"no-endpoints: {e}")
+            pod = result.primary
+            span.set("llm_d.decision.endpoint", pod.address)
+            set_headers = {
+                HDR_DESTINATION: pod.address,
+                HDR_ENDPOINT: pod.address,
+                "x-request-id": req.request_id,
+            }
+            if result.prefill is not None:
+                set_headers[HDR_PREFILLER] = result.prefill.address
+            if result.encode is not None:
+                set_headers[HDR_ENCODER] = result.encode.address
+            # Scheduling + flow accounting mirrors the fused proxy: both
+            # held until stream close (Envoy owns the actual proxying).
+            pod.inflight += 1
+            pod.inflight_tokens += req.approx_prompt_tokens
+            self.pod = pod
+            self.t_routed = time.monotonic()
+            self._flow_held = True
+            handed_off = True
+            kind = "request_body" if self.body else "request_headers"
+            return pb.encode_common_response(
+                kind, set_headers=set_headers, clear_route_cache=True
+            )
+        finally:
+            if not handed_off:
+                router.flow.release()
+
+
+class ExtProcServer:
+    """grpc.aio server speaking the ext-proc protocol around a Router."""
+
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.router = router
+        self.host = host
+        self.port = port
+        self._server: grpc.aio.Server | None = None
+
+    async def _process(self, request_iterator, context):
+        session = ExtProcSession(self.router)
+        try:
+            async for raw in request_iterator:
+                msg = pb.parse_processing_request(raw)
+                if msg is None:
+                    continue
+                try:
+                    reply = await session.on_message(msg)
+                except Exception as e:  # pipeline failure -> FailOpen/Close
+                    log.exception("ext-proc pipeline error")
+                    await context.abort(
+                        grpc.StatusCode.INTERNAL, f"epp pipeline error: {e}"
+                    )
+                    return
+                if reply is not None:
+                    yield reply
+        finally:
+            session.close()
+
+    async def start(self) -> int:
+        handler = grpc.stream_stream_rpc_method_handler(
+            self._process,
+            request_deserializer=None,
+            response_serializer=None,
+        )
+        generic = grpc.method_handlers_generic_handler(
+            "envoy.service.ext_proc.v3.ExternalProcessor",
+            {"Process": handler},
+        )
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((generic,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self.router.flow.start()  # idempotent; gRPC-only deployments
+        await self._server.start()
+        log.info("ext-proc EPP listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+async def run_extproc(router, host: str, port: int) -> None:
+    server = ExtProcServer(router, host, port)
+    await server.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()

@@ -1,0 +1,302 @@
+"""Minimal protobuf codec for the Envoy ext-proc v3 protocol.
+
+The EPP's primary deployment shape is an Envoy external-processor gRPC
+plugin (reference docs/architecture/core/router/epp/README.md:11-18); the
+wire messages are `envoy.service.ext_proc.v3.ProcessingRequest/Response`.
+Envoy's proto tree is not vendored here, so this module hand-encodes the
+small field subset the endpoint-picking exchange uses. Field numbers
+follow the public proto (api/envoy/service/ext_proc/v3/
+external_processor.proto and envoy/config/core/v3/base.proto):
+
+ProcessingRequest:  request_headers=2, response_headers=3, request_body=4,
+                    response_body=5, request_trailers=6, response_trailers=7
+HttpHeaders:        headers(HeaderMap)=1, end_of_stream=3
+HeaderMap:          headers(repeated HeaderValue)=1
+HeaderValue:        key=1, value=2, raw_value=3
+HttpBody:           body=1, end_of_stream=2
+ProcessingResponse: request_headers(HeadersResponse)=1,
+                    response_headers=2, request_body(BodyResponse)=3,
+                    response_body=4, request_trailers=5,
+                    response_trailers=6, immediate_response=7
+HeadersResponse / BodyResponse: response(CommonResponse)=1
+CommonResponse:     status=1 (0=CONTINUE), header_mutation=2,
+                    clear_route_cache=5
+HeaderMutation:     set_headers(repeated HeaderValueOption)=1,
+                    remove_headers(repeated string)=2
+HeaderValueOption:  header(HeaderValue)=1, append_action=3
+                    (1=OVERWRITE_IF_EXISTS_OR_ADD)
+ImmediateResponse:  status(HttpStatus{code=1})=1, headers=2, body=3,
+                    details=5
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+# --------------------------------------------------------------- wire
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _varint_field(field: int, value: int) -> bytes:
+    if not value:
+        return b""
+    return _tag(field, 0) + _varint(value)
+
+
+def iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) — value is bytes for
+    length-delimited fields, int for varints; fixed fields are skipped."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+            yield field, wire, v
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos : pos + n]
+            pos += n
+        elif wire == 5:
+            pos += 4
+        elif wire == 1:
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+# --------------------------------------------------------------- decode
+
+
+def _parse_header_value(buf: bytes) -> tuple[str, str]:
+    key = value = ""
+    raw = b""
+    for field, _, v in iter_fields(buf):
+        if field == 1:
+            key = v.decode("utf-8", "replace")
+        elif field == 2:
+            value = v.decode("utf-8", "replace")
+        elif field == 3:
+            raw = v
+    return key, value or raw.decode("utf-8", "replace")
+
+
+def _parse_header_map(buf: bytes) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for field, _, v in iter_fields(buf):
+        if field == 1:
+            k, val = _parse_header_value(v)
+            out[k.lower()] = val
+    return out
+
+
+@dataclasses.dataclass
+class ProcessingRequest:
+    kind: str  # request_headers | response_headers | request_body |
+    #            response_body | request_trailers | response_trailers
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    body: bytes = b""
+    end_of_stream: bool = False
+
+
+_REQ_KINDS = {
+    2: "request_headers",
+    3: "response_headers",
+    4: "request_body",
+    5: "response_body",
+    6: "request_trailers",
+    7: "response_trailers",
+}
+
+
+def parse_processing_request(buf: bytes) -> ProcessingRequest | None:
+    for field, _, v in iter_fields(buf):
+        kind = _REQ_KINDS.get(field)
+        if kind is None:
+            continue
+        msg = ProcessingRequest(kind=kind)
+        if kind.endswith("headers") or kind.endswith("trailers"):
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 1:
+                    msg.headers = _parse_header_map(v2)
+                elif f2 == 3:
+                    msg.end_of_stream = bool(v2)
+        else:  # body
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 1:
+                    msg.body = v2
+                elif f2 == 2:
+                    msg.end_of_stream = bool(v2)
+        return msg
+    return None
+
+
+# --------------------------------------------------------------- encode
+
+
+def _header_value(key: str, value: str) -> bytes:
+    # Envoy requires raw_value for mutations (value is for display).
+    return _len_field(1, key.encode()) + _len_field(3, value.encode())
+
+
+def _header_mutation(set_headers: dict[str, str], remove: list[str]) -> bytes:
+    out = b""
+    for k, v in set_headers.items():
+        opt = _len_field(1, _header_value(k, v)) + _varint_field(3, 1)
+        out += _len_field(1, opt)
+    for k in remove:
+        out += _len_field(2, k.encode())
+    return out
+
+
+_RESP_FIELD = {
+    "request_headers": 1,
+    "response_headers": 2,
+    "request_body": 3,
+    "response_body": 4,
+    "request_trailers": 5,
+    "response_trailers": 6,
+}
+
+
+def encode_common_response(
+    kind: str,
+    set_headers: dict[str, str] | None = None,
+    remove_headers: list[str] | None = None,
+    clear_route_cache: bool = False,
+) -> bytes:
+    """ProcessingResponse{<kind>: {response: CommonResponse{CONTINUE,...}}}"""
+    common = b""
+    if set_headers or remove_headers:
+        common += _len_field(
+            2, _header_mutation(set_headers or {}, remove_headers or [])
+        )
+    if clear_route_cache:
+        common += _varint_field(5, 1)
+    inner = _len_field(1, common)
+    return _len_field(_RESP_FIELD[kind], inner)
+
+
+def encode_immediate_response(
+    status_code: int,
+    headers: dict[str, str] | None = None,
+    body: bytes = b"",
+    details: str = "",
+) -> bytes:
+    msg = _len_field(1, _varint_field(1, status_code) or _tag(1, 0) + b"\x00")
+    if headers:
+        msg += _len_field(2, _header_mutation(headers, []))
+    if body:
+        msg += _len_field(3, body)
+    if details:
+        msg += _len_field(5, details.encode())
+    return _len_field(7, msg)
+
+
+# ----------------------------------------------------- client-side helpers
+# (tests / the no-Envoy smoke client encode ProcessingRequests and decode
+# ProcessingResponses with these)
+
+
+def encode_request_headers(headers: dict[str, str], end_of_stream: bool = False) -> bytes:
+    hm = b"".join(_len_field(1, _header_value(k, v)) for k, v in headers.items())
+    inner = _len_field(1, hm) + _varint_field(3, int(end_of_stream))
+    return _len_field(2, inner)
+
+
+def encode_request_body(body: bytes, end_of_stream: bool = True) -> bytes:
+    inner = _len_field(1, body) + _varint_field(2, int(end_of_stream))
+    return _len_field(4, inner)
+
+
+def encode_response_headers(headers: dict[str, str]) -> bytes:
+    hm = b"".join(_len_field(1, _header_value(k, v)) for k, v in headers.items())
+    return _len_field(3, _len_field(1, hm))
+
+
+def encode_response_trailers() -> bytes:
+    return _len_field(7, b"")
+
+
+@dataclasses.dataclass
+class ProcessingResponse:
+    kind: str
+    set_headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    remove_headers: list[str] = dataclasses.field(default_factory=list)
+    immediate_status: int = 0
+    immediate_body: bytes = b""
+    immediate_details: str = ""
+
+
+def parse_processing_response(buf: bytes) -> ProcessingResponse | None:
+    kinds = {v: k for k, v in _RESP_FIELD.items()}
+    for field, _, v in iter_fields(buf):
+        if field in kinds:
+            msg = ProcessingResponse(kind=kinds[field])
+            for f2, _, v2 in iter_fields(v):  # CommonResponse wrapper
+                if f2 != 1:
+                    continue
+                for f3, _, v3 in iter_fields(v2):
+                    if f3 == 2:  # header_mutation
+                        for f4, _, v4 in iter_fields(v3):
+                            if f4 == 1:  # HeaderValueOption
+                                for f5, _, v5 in iter_fields(v4):
+                                    if f5 == 1:
+                                        k, val = _parse_header_value(v5)
+                                        msg.set_headers[k] = val
+                            elif f4 == 2:
+                                msg.remove_headers.append(v4.decode())
+            return msg
+        if field == 7:  # immediate_response
+            msg = ProcessingResponse(kind="immediate_response")
+            for f2, _, v2 in iter_fields(v):
+                if f2 == 1:
+                    for f3, _, v3 in iter_fields(v2):
+                        if f3 == 1:
+                            msg.immediate_status = v3
+                elif f2 == 2:
+                    for f4, _, v4 in iter_fields(v2):
+                        if f4 == 1:
+                            for f5, _, v5 in iter_fields(v4):
+                                if f5 == 1:
+                                    k, val = _parse_header_value(v5)
+                                    msg.set_headers[k] = val
+                elif f2 == 3:
+                    msg.immediate_body = v2
+                elif f2 == 5:
+                    msg.immediate_details = v2.decode()
+            return msg
+    return None

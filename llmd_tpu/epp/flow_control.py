@@ -277,7 +277,12 @@ class FlowControl:
             item.future.set_result(Outcome.DISPATCHED)
 
     def start(self) -> None:
-        self._task = asyncio.get_event_loop().create_task(self._dispatch_loop())
+        """Start the dispatch worker (idempotent: the fused HTTP app and
+        the ext-proc gRPC server may both run in one process)."""
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_event_loop().create_task(
+                self._dispatch_loop()
+            )
 
     async def drain(self) -> None:
         """Graceful shutdown: evict queued requests with retryable 503

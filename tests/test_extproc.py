@@ -1,0 +1,261 @@
+"""Envoy ext-proc gRPC mode: the endpoint-picking exchange over the real
+wire protocol (hand-encoded envoy.service.ext_proc.v3 messages through a
+real grpc channel), reusing the fused router's pipeline.
+
+Reference: docs/architecture/core/router/epp/README.md:11-18 (ext-proc is
+the EPP's primary transport), flow-control.md:345-409 (rejections map to
+ImmediateResponses; pipeline errors abort the stream so Envoy's
+FailOpen/FailClose policy decides)."""
+
+import asyncio
+import json
+
+import grpc
+import grpc.aio
+import pytest
+
+from llmd_tpu.epp import extproc_pb as pb
+from llmd_tpu.epp.config import DEFAULT_CONFIG, build_flow_control, build_scheduler
+from llmd_tpu.epp.datalayer import EndpointStore
+from llmd_tpu.epp.extproc import HDR_DESTINATION, METHOD, ExtProcServer
+from llmd_tpu.epp.server import Router
+from llmd_tpu.epp.types import HDR_DROP_REASON, Endpoint
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def make_router(flow_config=None, pods=2):
+    store = EndpointStore()
+    for i in range(pods):
+        store.upsert(Endpoint(
+            address=f"10.0.0.{i + 1}:8000",
+            labels={"llm-d.ai/engine-type": "llmd"},
+        ))
+    cfg = dict(DEFAULT_CONFIG)
+    if flow_config is not None:
+        cfg = {**cfg, "flowControl": flow_config}
+    return Router(
+        store=store,
+        scheduler=build_scheduler(cfg),
+        flow_control=build_flow_control(cfg),
+    )
+
+
+class ExtProcClient:
+    """Test client: raw-bytes bidirectional stream, like Envoy's."""
+
+    def __init__(self, port):
+        self.channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        self.call = self.channel.stream_stream(
+            METHOD, request_serializer=None, response_deserializer=None
+        )
+
+    async def roundtrip(self, *messages):
+        async def gen():
+            for m in messages:
+                yield m
+
+        out = []
+        async for raw in self.call(gen()):
+            out.append(pb.parse_processing_response(raw))
+        return out
+
+    async def close(self):
+        await self.channel.close()
+
+
+async def test_extproc_picks_endpoint_via_header_mutation():
+    router = make_router()
+    server = ExtProcServer(router)
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        body = json.dumps({
+            "model": "m", "prompt": "hello world", "max_tokens": 4,
+        }).encode()
+        replies = await client.roundtrip(
+            pb.encode_request_headers({
+                ":path": "/v1/completions", ":method": "POST",
+                "content-type": "application/json",
+            }),
+            pb.encode_request_body(body),
+            pb.encode_response_headers({":status": "200"}),
+        )
+        kinds = [r.kind for r in replies]
+        assert kinds == ["request_headers", "request_body", "response_headers"]
+        picked = replies[1].set_headers
+        addrs = {p.address for p in router.store.list()}
+        assert picked[HDR_DESTINATION] in addrs
+        assert picked["x-llm-d-endpoint"] == picked[HDR_DESTINATION]
+        assert picked["x-request-id"]
+    finally:
+        await client.close()
+        await server.stop()
+    # stream closed => inflight accounting released
+    assert all(p.inflight == 0 for p in router.store.list())
+
+
+async def test_extproc_holds_flow_slot_until_stream_close():
+    """The flow-control inflight slot must span the WHOLE stream (Envoy is
+    still proxying after the pick) — releasing at schedule time would make
+    the max_inflight saturation gate count near-zero concurrency."""
+    router = make_router()
+    server = ExtProcServer(router)
+    port = await server.start()
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    call = channel.stream_stream(METHOD)
+    try:
+        sent = asyncio.Queue()
+
+        async def gen():
+            while True:
+                m = await sent.get()
+                if m is None:
+                    return
+                yield m
+
+        stream = call(gen())
+        await sent.put(pb.encode_request_headers({":path": "/v1/completions"}))
+        await sent.put(pb.encode_request_body(json.dumps({
+            "model": "m", "prompt": "x", "max_tokens": 1,
+        }).encode()))
+        replies = [
+            pb.parse_processing_response(await stream.read()) for _ in range(2)
+        ]
+        assert replies[1].kind == "request_body"
+        # picked, Envoy now proxying: slot still held
+        assert router.flow.saturation.inflight == 1
+        await sent.put(None)  # client closes its side; stream ends
+        assert await stream.read() == grpc.aio.EOF
+        for _ in range(50):
+            if router.flow.saturation.inflight == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert router.flow.saturation.inflight == 0
+    finally:
+        await channel.close()
+        await server.stop()
+
+
+async def test_extproc_flow_control_rejection_is_immediate_response():
+    # Zero-capacity flow control band: every request rejected (429 family).
+    router = make_router(flow_config={
+        "bands": [{"priority": 0, "maxRequests": 0}],
+    })
+    server = ExtProcServer(router)
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        replies = await client.roundtrip(
+            pb.encode_request_headers({":path": "/v1/completions"}),
+            pb.encode_request_body(json.dumps({
+                "model": "m", "prompt": "x", "max_tokens": 1,
+            }).encode()),
+        )
+        assert replies[0].kind == "request_headers"
+        imm = replies[1]
+        assert imm.kind == "immediate_response"
+        assert imm.immediate_status in (429, 503)
+        assert HDR_DROP_REASON in imm.set_headers
+        assert imm.immediate_body  # JSON error body for the client
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_extproc_no_endpoints_rejects_503():
+    router = make_router(pods=0)
+    server = ExtProcServer(router)
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        replies = await client.roundtrip(
+            pb.encode_request_headers({":path": "/v1/completions"}),
+            pb.encode_request_body(json.dumps({
+                "model": "m", "prompt": "x", "max_tokens": 1,
+            }).encode()),
+        )
+        imm = replies[1]
+        assert imm.kind == "immediate_response"
+        assert imm.immediate_status == 503
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_extproc_pipeline_error_aborts_stream_for_failopen():
+    """Internal pipeline failures must ABORT the gRPC stream (not reply):
+    that is what lets Envoy's failure_mode_allow distinguish FailOpen
+    (route on without a pick) from FailClose (reject), reference
+    flow-control.md:345-359."""
+    router = make_router()
+
+    def boom(req, pods):
+        raise RuntimeError("scheduler exploded")
+
+    router.scheduler.schedule = boom
+    server = ExtProcServer(router)
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await client.roundtrip(
+                pb.encode_request_headers({":path": "/v1/completions"}),
+                pb.encode_request_body(json.dumps({
+                    "model": "m", "prompt": "x", "max_tokens": 1,
+                }).encode()),
+            )
+        assert err.value.code() == grpc.StatusCode.INTERNAL
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_extproc_parse_error_rejects_400():
+    router = make_router()
+    server = ExtProcServer(router)
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        replies = await client.roundtrip(
+            pb.encode_request_headers({":path": "/v1/completions"}),
+            pb.encode_request_body(b"{not json"),
+        )
+        assert replies[1].kind == "immediate_response"
+        assert replies[1].immediate_status == 400
+    finally:
+        await client.close()
+        await server.stop()
+
+
+def test_pb_roundtrip_wire_compat():
+    """Codec self-consistency + stable binary layout for the subset."""
+    enc = pb.encode_request_headers({":path": "/x", "a": "b"}, end_of_stream=True)
+    msg = pb.parse_processing_request(enc)
+    assert msg.kind == "request_headers"
+    assert msg.headers[":path"] == "/x" and msg.headers["a"] == "b"
+    assert msg.end_of_stream
+
+    enc = pb.encode_request_body(b"payload")
+    msg = pb.parse_processing_request(enc)
+    assert msg.kind == "request_body" and msg.body == b"payload"
+    assert msg.end_of_stream
+
+    out = pb.encode_common_response(
+        "request_body", set_headers={"x-dest": "1.2.3.4:8000"},
+        clear_route_cache=True,
+    )
+    resp = pb.parse_processing_response(out)
+    assert resp.kind == "request_body"
+    assert resp.set_headers == {"x-dest": "1.2.3.4:8000"}
+
+    out = pb.encode_immediate_response(429, headers={"x-r": "full"}, body=b"{}")
+    resp = pb.parse_processing_response(out)
+    assert resp.kind == "immediate_response"
+    assert resp.immediate_status == 429
+    assert resp.set_headers == {"x-r": "full"}
