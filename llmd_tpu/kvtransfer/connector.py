@@ -80,8 +80,13 @@ class PulledBundle:
 
 
 def pack_header(pages: np.ndarray) -> bytes:
-    """Bundle header for a [L, n, K, page, 2D] page array."""
-    dt = pages.dtype.str.encode()
+    """Bundle header for a [L, n, K, page, 2D] page array.
+
+    The dtype travels by NAME ('bfloat16', 'float32', ...): extension
+    dtypes like ml_dtypes.bfloat16 have an anonymous .str ('<V2') that
+    does not round-trip through np.dtype(), while np.dtype(name) resolves
+    both builtins and registered extension dtypes."""
+    dt = pages.dtype.name.encode()
     L, n, K, page, inner = pages.shape
     return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
 
@@ -164,7 +169,11 @@ class TPUConnector:
         # concat of the payload).
         pages = np.ascontiguousarray(self.runner.gather_pages(req.block_ids[:n_full]))
         header = pack_header(pages)
-        self.server.register(key, pages, self.cfg.lease_ms, header=header)
+        # Extension dtypes (bfloat16: isbuiltin == 2, "registered user
+        # type") don't expose the buffer protocol the zero-copy register
+        # path needs; a same-memory uint8 view does.
+        payload = pages if pages.dtype.isbuiltin == 1 else pages.view(np.uint8)
+        self.server.register(key, payload, self.cfg.lease_ms, header=header)
         self.exported_requests += 1
         self.exported_bytes += len(header) + pages.nbytes
         return {

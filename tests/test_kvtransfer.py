@@ -100,10 +100,10 @@ def test_pack_unpack_roundtrip():
 # P/D end-to-end through two engines
 
 
-def make_engine(kv_role=None, seed=0, page=4, num_blocks=64):
+def make_engine(kv_role=None, seed=0, page=4, num_blocks=64, dtype="float32"):
     cfg = EngineConfig(
-        model=tiny_model_config(),
-        cache=CacheConfig(page_size=page, num_blocks=num_blocks, dtype="float32"),
+        model=tiny_model_config(dtype=dtype),
+        cache=CacheConfig(page_size=page, num_blocks=num_blocks, dtype=dtype),
         scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
         parallel=ParallelConfig(tensor_parallel_size=1),
         seed=seed,
@@ -158,6 +158,32 @@ def test_pd_disagg_matches_aggregated():
         assert final.num_cached_tokens == 16
         assert consumer.kv_connector.imported_requests == 1
         assert producer.kv_connector.server.registered_count == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_pd_disagg_bfloat16_cache_transfers():
+    """bf16 (the production cache dtype) must export/pull byte-exact:
+    ml_dtypes arrays lack the buffer protocol, so the shipper moves a
+    uint8 view and the bundle header carries the dtype by name."""
+    ref_tokens, _ = _run(make_engine(dtype="bfloat16"), PROMPT, max_tokens=6)
+    producer = make_engine(kv_role="kv_producer", dtype="bfloat16")
+    consumer = make_engine(kv_role="kv_consumer", dtype="bfloat16")
+    try:
+        _, pre = _run(
+            producer, PROMPT, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        assert pre.kv_transfer_params is not None
+        assert producer.kv_connector.exported_requests == 1
+        toks, final = _run(
+            consumer, PROMPT, max_tokens=6,
+            kv_transfer_params=pre.kv_transfer_params,
+        )
+        assert toks == ref_tokens
+        assert consumer.kv_connector.imported_requests == 1
+        assert consumer.kv_connector.import_failures == 0
     finally:
         producer.kv_connector.close()
         consumer.kv_connector.close()
