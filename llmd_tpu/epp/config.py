@@ -91,6 +91,7 @@ PD_CONFIG: dict[str, Any] = {
         {"type": "queue-scorer", "name": "queue"},
         {"type": "kv-cache-utilization-scorer", "name": "kv"},
         {"type": "prefix-cache-scorer", "name": "prefix"},
+        {"type": "topology-affinity-scorer", "name": "topology"},
         {"type": "max-score-picker", "name": "picker"},
     ],
     "schedulingProfiles": [
@@ -112,6 +113,8 @@ PD_CONFIG: dict[str, Any] = {
                 {"pluginRef": "prefill"},
                 {"pluginRef": "queue", "weight": 2.0},
                 {"pluginRef": "kv", "weight": 1.0},
+                # Same-slice/host P->D pairing: KV rides ICI, not DCN.
+                {"pluginRef": "topology", "weight": 2.0},
                 {"pluginRef": "picker"},
             ],
         },

@@ -89,23 +89,51 @@ class K8sPodDiscoverySource:
                 return cond.get("status") == "True"
         return False
 
-    def _endpoint_for(self, pod: dict) -> Endpoint:
+    def _endpoints_for(self, pod: dict) -> list[Endpoint]:
         meta = pod.get("metadata", {})
         labels = dict(meta.get("labels", {}))
         node = pod.get("spec", {}).get("nodeName")
         if node and self.node_label not in labels:
             labels[self.node_label] = node
+        # Slice identity for topology-aware scoring: explicit llm-d.ai/slice
+        # wins; multi-host LWS pods derive it from their replica group
+        # (same group == same TPU slice, docs/infrastructure/multi-node.md).
+        if "llm-d.ai/slice" not in labels:
+            lws_name = labels.get("leaderworkerset.sigs.k8s.io/name")
+            group = labels.get("leaderworkerset.sigs.k8s.io/group-index")
+            if lws_name and group is not None:
+                labels["llm-d.ai/slice"] = f"{lws_name}-{group}"
+        annotations = meta.get("annotations", {})
         port = self.target_port
         # honor a per-pod port annotation (DP external-LB rank ports)
-        ann = meta.get("annotations", {}).get("llm-d.ai/port")
+        ann = annotations.get("llm-d.ai/port")
         if ann:
             try:
                 port = int(ann)
             except ValueError:
                 pass
-        return Endpoint(
-            address=f"{pod['status']['podIP']}:{port}", labels=labels
-        )
+        # DP multi-port external LB (reference wide-ep-lws.values.yaml:
+        # 41-52 lists every rank port in targetPorts): a pod annotated
+        # llm-d.ai/dp-size=N exposes N rank listeners on [port, port+N)
+        # and each becomes its OWN endpoint so the scheduler keeps a
+        # rank-level load view.
+        dp = 1
+        ann = annotations.get("llm-d.ai/dp-size")
+        if ann:
+            try:
+                dp = max(1, int(ann))
+            except ValueError:
+                pass
+        ip = pod["status"]["podIP"]
+        out = []
+        for rank in range(dp):
+            rank_labels = labels if dp == 1 else {
+                **labels, "llm-d.ai/dp-rank": str(rank),
+            }
+            out.append(
+                Endpoint(address=f"{ip}:{port + rank}", labels=rank_labels)
+            )
+        return out
 
     async def poll_once(self) -> list[Endpoint]:
         session = await self._client()
@@ -117,9 +145,10 @@ class K8sPodDiscoverySource:
             resp.raise_for_status()
             body = json.loads(await resp.text())
         eps = [
-            self._endpoint_for(p)
+            ep
             for p in body.get("items", [])
             if self._pod_ready(p)
+            for ep in self._endpoints_for(p)
         ]
         self.store.reconcile(eps)
         return eps

@@ -165,6 +165,13 @@ class Scheduler:
             if profile is None:
                 continue
             results[name] = profile.run(req, list(pods))
+            # Later profiles see earlier picks (DisaggProfileHandler runs
+            # decode first): the topology-affinity scorer anchors the
+            # prefill pick to the decode pod's slice/host so the P->D KV
+            # transfer rides ICI, not DCN.
+            req.scratch.setdefault("profile_picks", {})[name] = results[
+                name
+            ].endpoint
         result = self.handler.assemble(req, results)
         # notify state-updating scorers on the winning profile(s)
         for name, pr in results.items():

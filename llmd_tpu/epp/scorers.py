@@ -183,3 +183,48 @@ class LoraAffinityScorer(Scorer):
             loaded = p.attrs.get("LoadedAdapters") or []
             out[p.address] = 1.0 if adapter in loaded else 0.0
         return out
+
+
+@register("topology-affinity-scorer")
+class TopologyAffinityScorer(Scorer):
+    """TPU-slice-topology-aware pairing (north-star deliverable: prefix
+    and latency routing must become slice-topology aware).
+
+    Anchored on an earlier profile's pick in the same scheduling pass
+    (DisaggProfileHandler runs decode before prefill), endpoints score:
+    same host 1.0 > same slice 0.75 > elsewhere 0.0 — a same-slice P->D
+    pair ships KV over ICI; cross-slice pays DCN. Locality labels:
+    ``llm-d.ai/slice`` (set explicitly or derived by pod discovery from
+    the LeaderWorkerSet group) and ``llm-d.ai/node`` (folded in by
+    discovery from the pod's node).
+    """
+
+    SLICE_LABEL = "llm-d.ai/slice"
+    NODE_LABEL = "llm-d.ai/node"
+
+    def __init__(self, anchor_profiles: tuple = ("decode",)) -> None:
+        self.anchor_profiles = tuple(anchor_profiles)
+
+    def _anchor(self, req: LLMRequest) -> Endpoint | None:
+        picks = req.scratch.get("profile_picks", {})
+        for name in self.anchor_profiles:
+            ep = picks.get(name)
+            if ep is not None:
+                return ep
+        return None
+
+    def score(self, req, pods):
+        anchor = self._anchor(req)
+        if anchor is None:
+            return {p.address: 0.0 for p in pods}
+        a_node = anchor.labels.get(self.NODE_LABEL)
+        a_slice = anchor.labels.get(self.SLICE_LABEL)
+        out = {}
+        for p in pods:
+            if a_node and p.labels.get(self.NODE_LABEL) == a_node:
+                out[p.address] = 1.0
+            elif a_slice and p.labels.get(self.SLICE_LABEL) == a_slice:
+                out[p.address] = 0.75
+            else:
+                out[p.address] = 0.0
+        return out

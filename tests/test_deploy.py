@@ -22,12 +22,16 @@ def anyio_backend():
 
 
 def pod(name, ip, phase="Running", ready=True, labels=None, node="n1",
-        deleting=False, port_ann=None):
+        deleting=False, port_ann=None, dp_ann=None):
     meta = {"name": name, "labels": labels or {"llm-d.ai/role": "decode"}}
     if deleting:
         meta["deletionTimestamp"] = "2026-07-30T00:00:00Z"
-    if port_ann:
-        meta["annotations"] = {"llm-d.ai/port": port_ann}
+    if port_ann or dp_ann:
+        meta["annotations"] = {}
+        if port_ann:
+            meta["annotations"]["llm-d.ai/port"] = port_ann
+        if dp_ann:
+            meta["annotations"]["llm-d.ai/dp-size"] = dp_ann
     return {
         "metadata": meta,
         "spec": {"nodeName": node},
@@ -47,6 +51,14 @@ async def test_k8s_discovery_reconciles_ready_pods(tmp_path):
             pod("d3", "10.0.0.3", phase="Pending"),        # not running
             pod("d4", "10.0.0.4", deleting=True),          # terminating
             pod("d5", "10.0.0.5", port_ann="8205"),        # rank port
+            # DP multi-port external LB: one endpoint PER RANK port
+            pod("d6", "10.0.0.6", port_ann="8200", dp_ann="2"),
+            # LWS pod: slice identity derives from the replica group
+            pod("d7", "10.0.0.7", labels={
+                "llm-d.ai/role": "decode",
+                "leaderworkerset.sigs.k8s.io/name": "decode",
+                "leaderworkerset.sigs.k8s.io/group-index": "3",
+            }),
         ]
     }
     seen = {}
@@ -77,10 +89,17 @@ async def test_k8s_discovery_reconciles_ready_pods(tmp_path):
         assert seen["selector"] == "llm-d.ai/role in (decode)"
         assert seen["auth"] == "Bearer sekrit"
         addrs = {e.address for e in eps}
-        assert addrs == {"10.0.0.1:8000", "10.0.0.5:8205"}
+        assert addrs == {
+            "10.0.0.1:8000", "10.0.0.5:8205",
+            "10.0.0.6:8200", "10.0.0.6:8201", "10.0.0.7:8000",
+        }
         # node label folded in for IRO topology
         by_addr = {e.address: e for e in store.list()}
         assert by_addr["10.0.0.1:8000"].labels["llm-d.ai/node"] == "n1"
+        # per-rank endpoints carry their rank for observability
+        assert by_addr["10.0.0.6:8201"].labels["llm-d.ai/dp-rank"] == "1"
+        # LWS replica group -> slice identity for topology-aware scoring
+        assert by_addr["10.0.0.7:8000"].labels["llm-d.ai/slice"] == "decode-3"
         # removal: pod gone from the API -> gone from the store
         pods["items"] = [pod("d1", "10.0.0.1")]
         await src.poll_once()
@@ -102,9 +121,17 @@ def test_recipe_yaml_parses_and_binds_roles():
     kinds = {d.get("kind") for _, d in docs}
     assert {"Deployment", "Service", "Kustomization", "ScaledObject",
             "ServiceAccount", "Role", "RoleBinding", "ConfigMap"} <= kinds
-    # every modelserver-tier deployment advertises a role label
+    # every modelserver-tier deployment advertises a role label (other
+    # tiers — batch gateway, router — are not scheduled against)
     for name, d in docs:
         if d.get("kind") == "Deployment" and name.endswith("deployment.yaml"):
+            spec = d["spec"]["template"]["spec"]
+            args = " ".join(
+                " ".join(map(str, c.get("args", [])))
+                for c in spec.get("containers", [])
+            )
+            if "llmd_tpu.serve" not in args and "llmd_tpu.encode" not in args:
+                continue
             labels = d["spec"]["template"]["metadata"]["labels"]
             assert "llm-d.ai/role" in labels, name
 
@@ -133,6 +160,50 @@ def test_kustomizations_resolve_under_load_restrictions():
                     f"{kf}: file resource {entry} escapes the kustomization "
                     "root (kustomize LoadRestrictionsRootOnly would refuse it)"
                 )
+
+
+def test_flow_control_guide_config_builds():
+    """The flow-control guide's EndpointPickerConfig must build a real
+    scheduler + flow control (bands, fairness, ordering, saturation)."""
+    import json
+
+    from llmd_tpu.epp.config import build_flow_control, build_scheduler
+
+    with open(REPO / "deploy/guides/flow-control/config.json") as f:
+        cfg = json.load(f)
+    build_scheduler(cfg)
+    fc = build_flow_control(cfg)
+    assert fc.enabled and fc.bands and len(fc.bands) == 3
+    assert fc.saturation.max_inflight == 512
+
+
+def test_wide_ep_lws_guide_shape():
+    """LWS manifest: leader and worker templates agree on DP geometry and
+    the per-rank port annotation matches the supervisor's local size."""
+    yaml = pytest.importorskip("yaml")
+    with open(REPO / "deploy/guides/wide-ep-lws/decode-lws.yaml") as f:
+        lws = yaml.safe_load(f)
+    assert lws["kind"] == "LeaderWorkerSet"
+    tmpl = lws["spec"]["leaderWorkerTemplate"]
+    assert tmpl["restartPolicy"] == "RecreateGroupOnPodRestart"
+    for role in ("leaderTemplate", "workerTemplate"):
+        t = tmpl[role]
+        anns = t["metadata"]["annotations"]
+        dp = anns["llm-d.ai/dp-size"]
+        args = " ".join(t["spec"]["containers"][0]["args"])
+        assert f"--data-parallel-size-local {dp}" in args
+        assert "--data-parallel-start-rank" in args
+        assert "LWS_WORKER_INDEX" in args
+        # discovery must be told the rank port base — without the port
+        # annotation it would register ranks at target_port 8000..800N
+        # while the supervisor listens on 8200..820N
+        base = int(anns["llm-d.ai/port"])
+        assert f"--port-base {base}" in args
+        # every advertised rank port is declared on the container
+        ports = {
+            p["containerPort"] for p in t["spec"]["containers"][0]["ports"]
+        }
+        assert {base + i for i in range(int(dp))} <= ports
 
 
 def test_observability_dashboards_parse():

@@ -140,6 +140,34 @@ def test_disagg_decider_skips_prefill_when_cached():
     assert again.prefill is None, "cached prompt must not be disaggregated"
 
 
+def test_topology_affinity_pairs_prefill_with_decode_slice():
+    """North-star deliverable: P->D pairing prefers the decode pod's
+    slice (KV over ICI) and, above that, its host. The decode profile
+    runs first; its pick anchors the prefill profile's topology scorer."""
+    sched = build_scheduler(PD_CONFIG)
+    pods = mk_pods(5)
+    # decode pods on slice-a; prefill candidates across slices
+    pods[0].labels.update({ROLE_LABEL: "decode", "llm-d.ai/slice": "a",
+                           "llm-d.ai/node": "a-host0"})
+    pods[1].labels.update({ROLE_LABEL: "prefill", "llm-d.ai/slice": "b",
+                           "llm-d.ai/node": "b-host0"})
+    pods[2].labels.update({ROLE_LABEL: "prefill", "llm-d.ai/slice": "a",
+                           "llm-d.ai/node": "a-host1"})
+    pods[3].labels.update({ROLE_LABEL: "prefill", "llm-d.ai/slice": "c",
+                           "llm-d.ai/node": "c-host0"})
+    pods[4].labels.update({ROLE_LABEL: "prefill", "llm-d.ai/slice": "a",
+                           "llm-d.ai/node": "a-host0"})  # same HOST as decode
+    res = sched.schedule(mk_req("z" * 8192), pods)
+    assert res.primary is pods[0]
+    # same-host prefill wins over same-slice; off-slice never picked
+    assert res.prefill is pods[4]
+
+    # without the same-host candidate, same-slice wins
+    pods2 = [pods[0], pods[1], pods[2], pods[3]]
+    res = sched.schedule(mk_req("w" * 8192), pods2)
+    assert res.prefill is pods[2]
+
+
 def test_responses_structured_input_parsing():
     from llmd_tpu.epp.handler import openai_parse
 
