@@ -49,6 +49,12 @@ class EngineStats:
     offload_fs_pages: int = 0
     offload_saves: int = 0
     offload_restores: int = 0
+    # P/D KV transfer (reference operations-vllm.md transfer accounting)
+    kv_exported_requests: int = 0
+    kv_exported_bytes: int = 0
+    kv_imported_requests: int = 0
+    kv_imported_bytes: int = 0
+    kv_import_failures: int = 0
     # LoRA (reference model-servers.md:78-89 lora_requests_info)
     max_lora: int = 0
     running_lora_adapters: tuple = ()
@@ -299,6 +305,13 @@ class LLMEngine:
             self.stats.offload_fs_pages = hs["fs_pages"]
             self.stats.offload_saves = hs["saves"]
             self.stats.offload_restores = hs["restores"]
+        if self.kv_connector is not None:
+            cs = self.kv_connector.stats()
+            self.stats.kv_exported_requests = cs["exported_requests"]
+            self.stats.kv_exported_bytes = cs["exported_bytes"]
+            self.stats.kv_imported_requests = cs["imported_requests"]
+            self.stats.kv_imported_bytes = cs["imported_bytes"]
+            self.stats.kv_import_failures = cs["import_failures"]
 
     # ------------------------------------------------------------------ #
 

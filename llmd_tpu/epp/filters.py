@@ -2,7 +2,10 @@
 
 from __future__ import annotations
 
+import random
+
 from llmd_tpu.epp.plugins import Filter, register
+from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex, prompt_block_hashes
 from llmd_tpu.epp.types import (
     KV_CACHE_USAGE,
     ROLE_BOTH,
@@ -85,3 +88,86 @@ class KVHeadroomFilter(Filter):
     def filter(self, req, pods):
         kept = [p for p in pods if p.attr(KV_CACHE_USAGE) <= self.max_usage]
         return kept or pods  # never filter to zero on load alone
+
+
+@register("prefix-cache-affinity-filter")
+class PrefixCacheAffinityFilter(Filter):
+    """Epsilon-greedy sticky routing with a TTFT load gate
+    (reference scheduling.md:77-80).
+
+    Narrows candidates to "sticky" endpoints — those whose approximate
+    prefix-cache match fraction for this prompt clears ``sticky_threshold``
+    — so conversation turns keep landing where their KV lives. Two escape
+    hatches prevent stickiness from congesting hot pods:
+
+    * epsilon-greedy exploration: with probability ``epsilon`` the filter
+      passes the full pool through, letting load-based scorers migrate
+      traffic;
+    * TTFT load gate: when the sticky pods' last observed TTFT is more
+      than ``ttft_gate_factor`` times the non-sticky pods' (they are
+      "significantly slower"), stickiness breaks for this request.
+
+    Tracks its own approximate index via the on_routed filter hook —
+    independent of (and composable with) the prefix-cache scorer.
+    """
+
+    def __init__(
+        self,
+        sticky_threshold: float = 0.5,
+        epsilon: float = 0.05,
+        ttft_gate_factor: float = 2.0,
+        block_chars: int = 256,
+        max_entries: int = 500_000,
+        max_prefix_blocks: int = 1024,
+        seed: int | None = None,
+    ) -> None:
+        self.sticky_threshold = sticky_threshold
+        self.epsilon = epsilon
+        self.ttft_gate_factor = ttft_gate_factor
+        self.index = ApproxPrefixIndex(block_chars, max_entries, max_prefix_blocks)
+        self._rng = random.Random(seed)
+
+    @staticmethod
+    def _mean_ttft(pods: list[Endpoint]) -> float | None:
+        vals = [
+            p.attrs["LastTTFT"] for p in pods
+            if isinstance(p.attrs.get("LastTTFT"), (int, float))
+        ]
+        return sum(vals) / len(vals) if vals else None
+
+    def filter(self, req, pods):
+        hashes = prompt_block_hashes(req, self.index)
+        if not hashes:
+            return pods
+        matches = self.index.match_lengths(hashes)
+        total = len(hashes)
+        sticky = [
+            p for p in pods
+            if matches.get(p.address, 0) / total >= self.sticky_threshold
+        ]
+        if not sticky or len(sticky) == len(pods):
+            return pods
+        if self._rng.random() < self.epsilon:
+            return pods  # explore
+        # TTFT load gate: break stickiness when sticky pods are
+        # significantly slower than the alternatives.
+        sticky_addrs = {p.address for p in sticky}
+        others = [p for p in pods if p.address not in sticky_addrs]
+        t_sticky = self._mean_ttft(sticky)
+        t_others = self._mean_ttft(others)
+        if (
+            t_sticky is not None
+            and t_others is not None
+            and t_others > 0
+            and t_sticky > self.ttft_gate_factor * t_others
+        ):
+            return pods
+        return sticky
+
+    def on_routed(self, req, pod):
+        hashes = prompt_block_hashes(req, self.index)
+        if hashes:
+            self.index.record_routed(hashes, pod.address)
+
+    def on_endpoint_removed(self, address: str) -> None:
+        self.index.evict_endpoint(address)

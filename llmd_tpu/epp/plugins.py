@@ -42,6 +42,13 @@ class Filter:
     def filter(self, req: LLMRequest, pods: list[Endpoint]) -> list[Endpoint]:
         raise NotImplementedError
 
+    def on_routed(self, req: LLMRequest, pod: Endpoint) -> None:
+        """Hook after the pick lands on ``pod`` (state-tracking filters,
+        e.g. prefix-cache-affinity's own index)."""
+
+    def on_endpoint_removed(self, address: str) -> None:
+        """Hook when an endpoint leaves the pool (index cleanup)."""
+
 
 class Scorer:
     """Scores each endpoint in [0, 1] (higher = better)."""
@@ -97,6 +104,8 @@ class SchedulingProfile:
         return ProfileResult(self.name, chosen, totals)
 
     def notify_routed(self, req: LLMRequest, pod: Endpoint) -> None:
+        for f in self.filters:
+            f.on_routed(req, pod)
         for scorer, _ in self.scorers:
             scorer.on_routed(req, pod)
 

@@ -28,6 +28,17 @@ def text_block_hashes(text: str, block_chars: int) -> list[bytes]:
     return out
 
 
+def prompt_block_hashes(req, index: "ApproxPrefixIndex") -> list[bytes]:
+    """Per-request memoized prompt block hashes, keyed by block size so a
+    scorer and a filter with the same geometry hash the prompt ONCE."""
+    key = f"prefix_hashes:{index.block_chars}"
+    hashes = req.scratch.get(key)
+    if hashes is None:
+        hashes = index.hashes(req.prompt_text)
+        req.scratch[key] = hashes
+    return hashes
+
+
 class ApproxPrefixIndex:
     """LRU of block hash → {endpoint addresses that likely hold it}."""
 

@@ -190,7 +190,9 @@ class Scheduler:
     def notify_endpoint_removed(self, address: str) -> None:
         seen: set[int] = set()
         for profile in self.profiles.values():
-            for scorer, _ in profile.scorers:
-                if id(scorer) not in seen:
-                    seen.add(id(scorer))
-                    scorer.on_endpoint_removed(address)
+            for plugin in (
+                *(s for s, _ in profile.scorers), *profile.filters,
+            ):
+                if id(plugin) not in seen:
+                    seen.add(id(plugin))
+                    plugin.on_endpoint_removed(address)

@@ -10,7 +10,7 @@ import collections
 import time
 
 from llmd_tpu.epp.plugins import Scorer, register
-from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex
+from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex, prompt_block_hashes
 from llmd_tpu.epp.types import (
     KV_CACHE_USAGE,
     RUNNING_REQUESTS,
@@ -145,10 +145,7 @@ class PrefixCacheScorer(Scorer):
         self.index = ApproxPrefixIndex(block_chars, max_entries, max_prefix_blocks)
 
     def score(self, req, pods):
-        hashes = req.scratch.get("prefix_hashes")
-        if hashes is None:
-            hashes = self.index.hashes(req.prompt_text)
-            req.scratch["prefix_hashes"] = hashes
+        hashes = prompt_block_hashes(req, self.index)
         if not hashes:
             req.scratch["prefix_hit"] = False
             return {p.address: 0.0 for p in pods}
@@ -162,7 +159,7 @@ class PrefixCacheScorer(Scorer):
         return scores
 
     def on_routed(self, req, pod):
-        hashes = req.scratch.get("prefix_hashes")
+        hashes = prompt_block_hashes(req, self.index)
         if hashes:
             self.index.record_routed(hashes, pod.address)
 

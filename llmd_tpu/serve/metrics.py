@@ -32,6 +32,12 @@ def render_metrics(
         "num_preemptions_total": stats.preemptions,
         "kv_offload_saves_total": stats.offload_saves,
         "kv_offload_restores_total": stats.offload_restores,
+        # P/D transfer accounting (producer exports / consumer pulls)
+        "kv_transfer_exported_requests_total": stats.kv_exported_requests,
+        "kv_transfer_exported_bytes_total": stats.kv_exported_bytes,
+        "kv_transfer_imported_requests_total": stats.kv_imported_requests,
+        "kv_transfer_imported_bytes_total": stats.kv_imported_bytes,
+        "kv_transfer_import_failures_total": stats.kv_import_failures,
     }
     lines: list[str] = []
     if stats.max_lora:

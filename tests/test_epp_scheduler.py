@@ -186,6 +186,44 @@ def test_scheduler_empty_pool_raises():
         sched.schedule(mk_req(), [])
 
 
+def test_prefix_cache_affinity_filter_sticky_and_gates():
+    """Epsilon-greedy sticky filter (scheduling.md:77-80): narrows to the
+    endpoints holding the prompt's prefix; epsilon explores; the TTFT
+    load gate breaks stickiness when sticky pods run significantly slow."""
+    filt = create_plugin(
+        "prefix-cache-affinity-filter", epsilon=0.0, seed=0,
+        sticky_threshold=0.5,
+    )
+    pods = mk_pods(3)
+    prompt = "conversation history " * 100
+
+    # Cold: no index entries -> no narrowing.
+    req = mk_req(prompt)
+    assert filt.filter(req, pods) == pods
+    filt.on_routed(req, pods[1])  # the pick lands on pod 1
+
+    # Warm: the same prompt now narrows to the sticky pod.
+    req2 = mk_req(prompt + " next turn")
+    kept = filt.filter(req2, pods)
+    assert kept == [pods[1]]
+
+    # TTFT load gate: sticky pod significantly slower -> full pool again.
+    pods[1].attrs["LastTTFT"] = 2.0
+    pods[0].attrs["LastTTFT"] = 0.1
+    pods[2].attrs["LastTTFT"] = 0.1
+    req3 = mk_req(prompt + " another turn")
+    assert filt.filter(req3, pods) == pods
+
+    # Epsilon = 1.0 always explores even when sticky is healthy.
+    always_explore = create_plugin(
+        "prefix-cache-affinity-filter", epsilon=1.0, seed=0,
+    )
+    req4 = mk_req(prompt)
+    always_explore.filter(req4, pods)
+    always_explore.on_routed(req4, pods[0])
+    assert always_explore.filter(mk_req(prompt), pods) == pods
+
+
 def test_weighted_random_picker_distribution():
     picker = create_plugin("weighted-random-picker", seed=0)
     pods = mk_pods(2)
