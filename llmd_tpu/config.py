@@ -212,6 +212,11 @@ class OffloadConfig:
     cpu_chunks: int = 25_000
     fs_dir: str | None = None
     fs_max_pages: int = 100_000
+    # Cross-slice shared store (Mooncake-Store role, kv-offloader.md:
+    # 140-259): master URL enables the embedded-mode tier behind DRAM/FS.
+    store_master_url: str | None = None
+    store_segment_bytes: int = 8 << 30
+    store_data_port: int = 0  # kvship port serving this segment (0 = auto)
 
 
 @dataclasses.dataclass

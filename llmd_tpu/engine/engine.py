@@ -74,13 +74,24 @@ class LLMEngine:
         # Tiered offload wraps the event sink (device evictions of host-held
         # pages downgrade to cpu-tier stores instead of removals).
         self._host_cache = None
+        self._kvstore_client = None
         if config.offload is not None and config.offload.enabled:
             from llmd_tpu.kvtransfer.offload import HostKVCache, TieredEventSink
 
+            if config.offload.store_master_url:
+                from llmd_tpu.kvstore import CrossSliceStoreClient
+
+                self._kvstore_client = CrossSliceStoreClient(
+                    master_url=config.offload.store_master_url,
+                    advertised_host=config.kv_host,
+                    data_port=config.offload.store_data_port,
+                    segment_bytes=config.offload.store_segment_bytes,
+                )
             self._host_cache = HostKVCache(
                 max_pages=config.offload.cpu_chunks,
                 fs_dir=config.offload.fs_dir,
                 fs_max_pages=config.offload.fs_max_pages,
+                remote=self._kvstore_client,
             )
             event_sink = TieredEventSink(event_sink or KVEventSink(), self._host_cache)
         self.allocator = PageAllocator(
@@ -198,6 +209,13 @@ class LLMEngine:
 
     def abort_request(self, request_id: str) -> bool:
         return self.scheduler.abort_request(request_id) is not None
+
+    def close(self) -> None:
+        """Release network-facing resources (KV connector, store client)."""
+        if self.kv_connector is not None:
+            self.kv_connector.close()
+        if self._kvstore_client is not None:
+            self._kvstore_client.close()
 
     def set_lora_weights(self, lora_id: int, weights: dict) -> None:
         """Install trained adapter weights into slot ``lora_id``; until

@@ -29,9 +29,11 @@ def text_block_hashes(text: str, block_chars: int) -> list[bytes]:
 
 
 def prompt_block_hashes(req, index: "ApproxPrefixIndex") -> list[bytes]:
-    """Per-request memoized prompt block hashes, keyed by block size so a
-    scorer and a filter with the same geometry hash the prompt ONCE."""
-    key = f"prefix_hashes:{index.block_chars}"
+    """Per-request memoized prompt block hashes, keyed by the FULL hash
+    geometry (block size AND prefix cap — hashes() truncates to the cap,
+    so two plugins only share when both match). Same-geometry scorer +
+    filter hash the prompt once."""
+    key = f"prefix_hashes:{index.block_chars}:{index.max_prefix_blocks}"
     hashes = req.scratch.get(key)
     if hashes is None:
         hashes = index.hashes(req.prompt_text)

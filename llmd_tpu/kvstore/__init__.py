@@ -1,0 +1,10 @@
+"""Cross-slice shared KV cache store (the Mooncake-Store role).
+
+Master (metadata/leases/eviction/snapshots) + embedded segment clients
+whose bytes ride the kvship transfer plane. See master.py / client.py.
+"""
+
+from llmd_tpu.kvstore.client import CrossSliceStoreClient
+from llmd_tpu.kvstore.master import MasterState, build_app
+
+__all__ = ["CrossSliceStoreClient", "MasterState", "build_app"]

@@ -1,0 +1,246 @@
+"""Cross-slice KV store client: embedded segment owner + reader.
+
+The MooncakeStoreConnector/Client roles (reference kv-offloader.md:
+160-205) on this framework's transfer plane:
+
+  * every participating engine host owns a SEGMENT — object bytes
+    registered with its local kvship server (the Transfer-Engine role;
+    native C++ server when built) and announced to the master;
+  * readers ask the master where a key lives, then pull the bytes
+    peer-to-peer from the owning host's kvship server — the master never
+    touches data;
+  * the master's heartbeat reply carries eviction instructions
+    (watermark-driven LRU), which the owner applies to its local server.
+
+Synchronous HTTP (urllib) by design: callers are the offload pump
+threads, never the serving event loop. Store failures degrade to misses
+— the store is a cache tier, not a source of truth.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+from llmd_tpu.kvtransfer import shipper as shipper_mod
+
+log = logging.getLogger(__name__)
+
+# Objects are master-managed; the local kvship lease is just a safety net
+# against a dead master never evicting.
+_OBJECT_LEASE_MS = 24 * 3600 * 1000
+
+
+class CrossSliceStoreClient:
+    """Embedded-mode store participant (owner + reader in one)."""
+
+    def __init__(
+        self,
+        master_url: str,
+        advertised_host: str = "127.0.0.1",
+        data_port: int = 0,
+        segment_bytes: int = 1 << 30,
+        segment_id: str | None = None,
+        heartbeat_s: float = 2.0,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self.master_url = master_url.rstrip("/")
+        self.segment_id = segment_id or f"seg-{uuid.uuid4().hex[:12]}"
+        self.segment_bytes = segment_bytes
+        self.timeout_s = timeout_s
+        self.server = shipper_mod.ShipperServer(port=data_port)
+        self.address = f"{advertised_host}:{self.server.port}"
+        self.puts = 0
+        self.pulls = 0
+        self.pull_failures = 0
+        self.rejected_puts = 0
+        self.dropped_publishes = 0
+        self._local_keys: set[str] = set()
+        self._registered = False
+        self._stop = threading.Event()
+        # Read breaker: a slow/hung master or peer must not stall the
+        # engine thread's restore path on every prompt.
+        self._read_down_until = 0.0
+        self._read_cooldown_s = 10.0
+        self._hb = threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_s,), daemon=True
+        )
+        # Publications are fire-and-forget off the engine thread: a
+        # bounded queue feeds one publisher thread; overflow drops the
+        # publish (the store is a cache, the local tiers still hold it).
+        self._pub_queue: "queue.Queue[tuple[str, bytes] | None]" = queue.Queue(
+            maxsize=256
+        )
+        self._pub = threading.Thread(target=self._publish_loop, daemon=True)
+        self._register()
+        self._hb.start()
+        self._pub.start()
+
+    # ----------------------------------------------------------- http
+
+    def _call(self, path: str, body: dict | None = None, method: str = "POST"):
+        req = urllib.request.Request(
+            f"{self.master_url}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"content-type": "application/json"},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def _register(self) -> None:
+        try:
+            self._call("/v1/segments/register", {
+                "segment_id": self.segment_id,
+                "address": self.address,
+                "capacity_bytes": self.segment_bytes,
+            })
+            self._registered = True
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            log.warning("kvstore master unreachable at register: %s", e)
+
+    def _heartbeat_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                if not self._registered:
+                    self._register()
+                    continue
+                reply = self._call(
+                    "/v1/segments/heartbeat", {"segment_id": self.segment_id}
+                )
+                for key in reply.get("evict", []):
+                    self.server.unregister(key)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                log.debug("kvstore heartbeat failed: %s", e)
+                self._registered = False
+
+    def _publish_loop(self) -> None:
+        while True:
+            item = self._pub_queue.get()
+            try:
+                if item is None:
+                    return
+                self.put(*item)
+            finally:
+                self._pub_queue.task_done()
+
+    def flush_publishes(self, timeout_s: float = 10.0) -> None:
+        """Block until queued publications have been attempted (tests,
+        graceful shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        while not self._pub_queue.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    # ------------------------------------------------------------ api
+
+    def put_async(self, key: str, data: bytes) -> None:
+        """Queue a publication without blocking the caller (the engine
+        thread's offload flush). Overflow drops the publish."""
+        try:
+            self._pub_queue.put_nowait((key, data))
+        except queue.Full:
+            self.dropped_publishes += 1
+
+    def put(self, key: str, data: bytes) -> bool:
+        """Publish an object: bytes into the local kvship server, metadata
+        to the master. First copy wins cluster-wide; redundant copies are
+        dropped locally."""
+        if not self._registered:
+            return False
+        try:
+            self.server.register(key, data, lease_ms=_OBJECT_LEASE_MS)
+            reply = self._call("/v1/objects/put", {
+                "segment_id": self.segment_id,
+                "key": key,
+                "nbytes": len(data),
+            })
+            if not reply.get("accepted"):
+                self.server.unregister(key)
+                self.rejected_puts += 1
+                return False
+            self.puts += 1
+            self._local_keys.add(key)
+            return True
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            log.debug("kvstore put failed: %s", e)
+            self.server.unregister(key)
+            return False
+
+    def locate(self, keys: list[str]) -> dict[str, dict]:
+        try:
+            return self._call("/v1/objects/locate", {"keys": keys})["found"]
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            log.debug("kvstore locate failed: %s", e)
+            return {}
+
+    def get(self, key: str) -> bytes | None:
+        """Pull one object's bytes from whichever segment holds it.
+
+        Runs on the engine thread's restore path, so a misbehaving store
+        opens a read breaker instead of stalling every prompt."""
+        now = time.monotonic()
+        if now < self._read_down_until:
+            return None
+        t0 = now
+        loc = self.locate([key]).get(key)
+        if loc is None:
+            if time.monotonic() - t0 > self.timeout_s / 2:
+                self._read_down_until = time.monotonic() + self._read_cooldown_s
+            return None
+        host, _, port = loc["address"].rpartition(":")
+        try:
+            data = shipper_mod.pull(host, int(port), key)
+            self.pulls += 1
+            return data
+        except (shipper_mod.PullError, OSError) as e:
+            self.pull_failures += 1
+            self._read_down_until = time.monotonic() + self._read_cooldown_s
+            # Stale placement (owner restarted): the lease expiry on the
+            # master reclaims it.
+            log.debug("kvstore pull %s from %s failed: %s", key, loc, e)
+            return None
+
+    def clear_local(self) -> None:
+        """Withdraw every object this segment published (weight rollout:
+        cached KV no longer matches; content hashes do not encode weight
+        versions, so each participant must clear its own contribution)."""
+        keys, self._local_keys = list(self._local_keys), set()
+        for key in keys:
+            self.server.unregister(key)
+        if keys and self._registered:
+            try:
+                self._call("/v1/objects/remove", {
+                    "segment_id": self.segment_id, "keys": keys,
+                })
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                log.debug("kvstore clear_local failed: %s", e)
+
+    def stats(self) -> dict:
+        return {
+            "segment_id": self.segment_id,
+            "registered": self._registered,
+            "local_objects": self.server.registered_count,
+            "local_bytes": self.server.registered_bytes,
+            "puts": self.puts,
+            "pulls": self.pulls,
+            "pull_failures": self.pull_failures,
+            "rejected_puts": self.rejected_puts,
+            "dropped_publishes": self.dropped_publishes,
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._pub_queue.put(None)
+        self._pub.join(timeout=5.0)
+        self._hb.join(timeout=2.0)
+        try:
+            self._call(f"/v1/segments/{self.segment_id}", method="DELETE")
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pass
+        self.server.close()

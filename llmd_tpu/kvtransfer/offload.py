@@ -24,6 +24,7 @@ vllm/patch-vllm.yaml:43,56-59 — HBM staging + 25000 CPU chunks):
 from __future__ import annotations
 
 import collections
+import io
 import logging
 import pathlib
 import threading
@@ -48,10 +49,13 @@ class HostKVCache:
         max_pages: int = 25_000,
         fs_dir: str | None = None,
         fs_max_pages: int = 100_000,
+        remote=None,  # CrossSliceStoreClient: shared tier behind DRAM/FS
     ) -> None:
         self.max_pages = max_pages
         self.fs_dir = pathlib.Path(fs_dir) if fs_dir else None
         self.fs_max_pages = fs_max_pages
+        self.remote = remote
+        self.remote_hits = 0
         self._lock = threading.Lock()
         self._pages: collections.OrderedDict[bytes, np.ndarray] = collections.OrderedDict()
         self._fs_lru: collections.OrderedDict[bytes, None] = collections.OrderedDict()
@@ -75,7 +79,7 @@ class HostKVCache:
         with self._lock:
             return h in self._pages or h in self._fs_lru
 
-    def put(self, h: bytes, page: np.ndarray) -> None:
+    def put(self, h: bytes, page: np.ndarray, publish: bool = True) -> None:
         with self._lock:
             if h in self._pages:
                 self._pages.move_to_end(h)
@@ -88,6 +92,8 @@ class HostKVCache:
                 spill.append((old_h, old_p))
         for old_h, old_p in spill:
             self._spill_fs(old_h, old_p)
+        if publish:
+            self._publish_remote(h, page)
 
     def get(self, h: bytes) -> np.ndarray | None:
         with self._lock:
@@ -97,6 +103,8 @@ class HostKVCache:
                 self.restores += 1
                 return page
         page = self._load_fs(h)
+        if page is None:
+            page = self._load_remote(h)
         if page is not None:
             self.restores += 1
         return page
@@ -125,6 +133,34 @@ class HostKVCache:
                 except OSError:
                     pass
 
+    # ------------------------------------------------------------------ #
+    # Cross-slice shared tier (Mooncake-store role; llmd_tpu/kvstore)
+
+    def _load_remote(self, h: bytes) -> np.ndarray | None:
+        if self.remote is None:
+            return None
+        blob = self.remote.get(h.hex())
+        if blob is None:
+            return None
+        try:
+            page = np.load(io.BytesIO(blob), allow_pickle=False)
+        except (OSError, ValueError):
+            return None
+        with self._lock:
+            self.remote_hits += 1
+        # Promote into the local DRAM tier for subsequent hits.
+        self.put(h, page, publish=False)
+        return page
+
+    def _publish_remote(self, h: bytes, page: np.ndarray) -> None:
+        if self.remote is None:
+            return
+        buf = io.BytesIO()
+        np.save(buf, page, allow_pickle=False)
+        # Fire-and-forget: the caller is the engine thread's offload
+        # flush; the client's publisher thread does the HTTP.
+        self.remote.put_async(h.hex(), buf.getvalue())
+
     def _load_fs(self, h: bytes) -> np.ndarray | None:
         if self.fs_dir is None:
             return None
@@ -152,7 +188,9 @@ class HostKVCache:
                 pass
 
     def clear(self) -> None:
-        """Drop every tier (weight rollout: cached KV no longer matches)."""
+        """Drop every tier (weight rollout: cached KV no longer matches).
+        The cross-slice tier drops this host's contribution; other
+        participants clear their own on their rollout."""
         with self._lock:
             self._pages.clear()
             fs = list(self._fs_lru)
@@ -162,6 +200,8 @@ class HostKVCache:
                 self._path(h).unlink(missing_ok=True)
             except OSError:
                 pass
+        if self.remote is not None:
+            self.remote.clear_local()
 
     def stats(self) -> dict[str, int]:
         with self._lock:

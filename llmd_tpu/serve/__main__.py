@@ -93,6 +93,9 @@ def make_engine_config(args, lora_adapters=None):
             OffloadConfig(
                 cpu_chunks=args.kv_offload_chunks,
                 fs_dir=args.kv_offload_fs_dir,
+                store_master_url=args.kv_store_master_url,
+                store_segment_bytes=args.kv_store_segment_bytes,
+                store_data_port=args.kv_store_data_port,
             )
             if args.kv_offload_chunks
             else None
@@ -149,6 +152,16 @@ def build_parser() -> argparse.ArgumentParser:
         "reference TPU recipe uses 25000, tiered-prefix-cache/README.md:41-48)",
     )
     p.add_argument("--kv-offload-fs-dir", default=None, help="FS spill tier dir")
+    p.add_argument(
+        "--kv-store-master-url", default=None,
+        help="cross-slice KV store master URL (Mooncake-Store role); "
+        "enables the shared tier behind host-DRAM/FS",
+    )
+    p.add_argument(
+        "--kv-store-segment-bytes", type=int, default=8 << 30,
+        help="DRAM this host contributes to the shared pool",
+    )
+    p.add_argument("--kv-store-data-port", type=int, default=9200)
     p.add_argument("--skip-warmup", action="store_true")
     p.add_argument(
         "--lora-adapters", default=None,
@@ -222,6 +235,13 @@ def main(argv=None) -> None:
         config.model.max_model_len,
         lora_adapters=lora_adapters,
     )
+
+    async def _close_engine(app):
+        # Unregisters the KV-store segment (peers stop being routed to a
+        # dead address) and closes the transfer connector.
+        engine.close()
+
+    app.on_cleanup.append(_close_engine)
     web.run_app(app, host=args.host, port=args.port)
 
 

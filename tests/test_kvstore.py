@@ -1,0 +1,163 @@
+"""Cross-slice KV store (Mooncake-Store role): master metadata/eviction/
+snapshots, peer-to-peer pulls over the kvship plane, engine-level prefix
+reuse ACROSS engines that never exchanged a request."""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from llmd_tpu.kvstore.client import CrossSliceStoreClient
+from llmd_tpu.kvstore.master import MasterState, build_app
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+class MasterHarness:
+    """Master app on a background event loop so the synchronous client
+    (urllib, as used from offload pump threads) can call it."""
+
+    def __init__(self, state: MasterState):
+        self.state = state
+        self.loop = asyncio.new_event_loop()
+        self.url = None
+        self._started = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        self._started.wait(10)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+
+        async def start():
+            self.server = TestServer(build_app(self.state))
+            await self.server.start_server()
+            self.url = f"http://{self.server.host}:{self.server.port}"
+            self._started.set()
+
+        self.loop.run_until_complete(start())
+        self.loop.run_forever()
+
+    def close(self):
+        async def stop():
+            await self.server.close()
+
+        asyncio.run_coroutine_threadsafe(stop(), self.loop).result(10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+@pytest.fixture
+def master():
+    h = MasterHarness(MasterState())
+    yield h
+    h.close()
+
+
+def test_put_locate_pull_across_clients(master):
+    a = CrossSliceStoreClient(master.url, segment_bytes=1 << 20, heartbeat_s=0.2)
+    b = CrossSliceStoreClient(master.url, segment_bytes=1 << 20, heartbeat_s=0.2)
+    try:
+        assert a.put("obj1", b"hello kv bytes")
+        # duplicate publication from another segment: first copy wins
+        assert not b.put("obj1", b"hello kv bytes")
+        assert b.get("obj1") == b"hello kv bytes"  # p2p pull from a's segment
+        assert b.get("missing") is None
+        assert master.state.stats()["objects"] == 1
+    finally:
+        a.close()
+        b.close()
+    # owner shutdown drops its objects from the pool
+    assert master.state.stats()["objects"] == 0
+
+
+def test_watermark_eviction_reaches_owner(master):
+    master.state.high_watermark = 0.5
+    master.state.eviction_ratio = 0.5
+    master.state.lease_ttl_s = 0.0  # no read leases blocking eviction
+    c = CrossSliceStoreClient(master.url, segment_bytes=1000, heartbeat_s=0.1)
+    try:
+        for i in range(6):
+            assert c.put(f"k{i}", bytes(100))  # 600B > 50% of 1000B
+        st = master.state.stats()
+        assert st["evicted"] > 0
+        # heartbeat delivers the eviction list; the owner's local server
+        # drops the bytes
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if c.server.registered_count < 6:
+                break
+            time.sleep(0.05)
+        assert c.server.registered_count < 6
+        assert master.state.used <= master.state.capacity
+    finally:
+        c.close()
+
+
+def test_master_snapshot_recovers_metadata(tmp_path, master):
+    path = tmp_path / "snap.json"
+    master.state.snapshot_path = path
+    c = CrossSliceStoreClient(master.url, segment_bytes=1 << 20, heartbeat_s=0.2)
+    try:
+        assert c.put("persisted", b"x" * 64)
+        master.state.snapshot()
+        recovered = MasterState(snapshot_path=str(path))
+        assert "persisted" in recovered.objects
+        assert recovered.objects["persisted"].nbytes == 64
+        assert c.segment_id in recovered.segments
+    finally:
+        c.close()
+
+
+def test_engine_prefix_reuse_across_engines(master):
+    """The headline behavior (reference kv-offloader.md:146): engine B
+    reuses a prefix engine A computed, with no P/D pairing between them —
+    the pages travel through the shared store."""
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, OffloadConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+
+    def make_engine():
+        return LLMEngine(EngineConfig(
+            model=tiny_model_config(),
+            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64),
+            offload=OffloadConfig(
+                cpu_chunks=64, store_master_url=master.url,
+                store_segment_bytes=1 << 22,
+            ),
+        ))
+
+    prompt = list(range(1, 25))  # 24 tokens = 6 full pages
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+
+    eng_a = make_engine()
+    eng_b = None
+    try:
+        out_a = list(eng_a.generate([prompt], sp).values())[0]
+        # publications are async off the engine thread; drain the queue
+        eng_a._kvstore_client.flush_publishes()
+        assert eng_a._kvstore_client.puts > 0
+
+        # A stays in the pool (embedded mode: its DRAM IS the segment);
+        # B pulls A's pages peer-to-peer instead of recomputing.
+        eng_b = make_engine()
+        out_b = list(eng_b.generate([prompt], sp).values())[0]
+        assert out_b == out_a
+        assert eng_b._kvstore_client.pulls > 0
+        assert eng_b._host_cache.remote_hits > 0
+    finally:
+        eng_a.close()
+        if eng_b is not None:
+            eng_b.close()

@@ -140,6 +140,22 @@ def test_mixtral_moe_greedy_matches_transformers(tmp_path):
     assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
 
 
+def test_qwen3_moe_greedy_matches_transformers(tmp_path):
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rope_theta=10000.0,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+        norm_topk_prob=True, tie_word_embeddings=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+    )
+    torch.manual_seed(9)
+    model = transformers.Qwen3MoeForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    golden = _hf_greedy(model, PROMPT, NEW_TOKENS)
+    assert _ours_greedy(d, PROMPT, NEW_TOKENS) == golden
+
+
 def test_deepseek_v2_mla_greedy_matches_transformers(tmp_path):
     """DeepSeek-V2 parity: MLA latent attention (with the interleaved-rope
     weight permutation) + softmax group-limited router (group max,
