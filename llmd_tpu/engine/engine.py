@@ -10,6 +10,7 @@ exposes the queue/KV metrics the EPP scrapes
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -108,6 +109,7 @@ class LLMEngine:
             num_pages=config.cache.num_blocks, page_size=config.cache.page_size
         )
         self._counter = itertools.count()
+        self._embed_lock = threading.Lock()
 
         # Tiered offload pump (save-on-commit / restore-on-prefill).
         self.offloader = None
@@ -209,6 +211,16 @@ class LLMEngine:
 
     def abort_request(self, request_id: str) -> bool:
         return self.scheduler.abort_request(request_id) is not None
+
+    def embed(self, prompts: list[list[int]]):
+        """[n, H] mean-pooled L2-normalized embeddings (OpenAI
+        /v1/embeddings surface); independent of the serving KV cache.
+
+        Serialized: each call allocates a scratch KV pool, so unbounded
+        concurrency (N executor threads x multi-GB scratch) would OOM the
+        device under an embedding burst."""
+        with self._embed_lock:
+            return self.runner.run_embed(prompts)
 
     def close(self) -> None:
         """Release network-facing resources (KV connector, store client)."""

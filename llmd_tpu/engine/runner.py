@@ -352,6 +352,89 @@ class ModelRunner:
 
     # ------------------------------------------------------------------ #
 
+    def run_embed(self, prompts: list[list[int]]) -> np.ndarray:
+        """Mean-pooled, L2-normalized final hidden states: [n, H] f32.
+
+        The /v1/embeddings surface (OpenAI API; the reference's vllmgrpc
+        Embed verb, request-handling.md:50-86). Runs the decoder stack
+        over a throwaway KV scratch pool — embeddings never touch the
+        serving cache, so this is safe to run concurrently with the step
+        loop (params are read-only)."""
+        if not prompts:
+            return np.zeros((0, self.cfg.hidden_size), np.float32)
+        maxlen = max(len(p) for p in prompts)
+        limit = min(self.cfg.max_model_len, self.prefill_buckets[-1])
+        if maxlen > limit:
+            raise ValueError(
+                f"embedding input length {maxlen} exceeds the embed limit "
+                f"{limit} (min of max_model_len and max_num_batched_tokens)"
+            )
+        # Requests larger than one device batch run in slices.
+        max_b = self.batch_buckets[-1]
+        if len(prompts) > max_b:
+            return np.concatenate([
+                self.run_embed(prompts[i : i + max_b])
+                for i in range(0, len(prompts), max_b)
+            ])
+        n = len(prompts)
+        Q = pad_to_bucket(maxlen, self.prefill_buckets)
+        B = pad_to_bucket(n, self.batch_buckets)
+        page = self.page
+        pages_per_seq = -(-Q // page)
+        tokens = np.zeros((B, Q), np.int32)
+        positions = np.zeros((B, Q), np.int32)
+        qlens = np.zeros(B, np.int32)
+        for i, p in enumerate(prompts):
+            m = len(p)
+            tokens[i, :m] = p
+            positions[i, :m] = np.arange(m)
+            positions[i, m:] = max(m - 1, 0)
+            qlens[i] = m
+        page_table = np.arange(B * pages_per_seq, dtype=np.int32).reshape(
+            B, pages_per_seq
+        )
+        inp = StepInput(
+            token_ids=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            query_lens=jnp.asarray(qlens),
+            kv_lens=jnp.asarray(qlens),
+            page_table=jnp.asarray(page_table),
+            lora_ids=(
+                jnp.zeros(B, jnp.int32) if self.cfg.num_lora_adapters else None
+            ),
+        )
+        scratch = jnp.zeros(
+            (
+                self.cfg.num_layers, B * pages_per_seq,
+                self.kv_cache.shape[2], page, self.kv_cache.shape[4],
+            ),
+            self.kv_cache.dtype,
+        )
+        pooled = self._embed_fn(self.params, scratch, inp)
+        return np.asarray(pooled[:n])
+
+    @functools.cached_property
+    def _embed_fn(self):
+        cfg, world, mesh = self.cfg, self.ctx.world, self.ctx.mesh
+        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        ep_capacity = self.config.parallel.ep_capacity_factor
+
+        @jax.jit
+        def embed(params, scratch_kv, inp: StepInput):
+            hidden, _ = llama.forward_hidden(
+                params, scratch_kv, inp, cfg, world, mesh=mesh,
+                moe_backend=moe_backend, ep_capacity_factor=ep_capacity,
+            )
+            valid = inp.valid[..., None].astype(jnp.float32)  # [B, Q, 1]
+            summed = jnp.sum(hidden.astype(jnp.float32) * valid, axis=1)
+            denom = jnp.maximum(jnp.sum(valid, axis=1), 1.0)
+            mean = summed / denom
+            return mean / jnp.maximum(
+                jnp.linalg.norm(mean, axis=-1, keepdims=True), 1e-12
+            )
+
+        return embed
+
     def run_prefill(self, seqs: list[ScheduledSeq]) -> StepResult:
         """All scheduled prompt chunks, batched by Q bucket.
 

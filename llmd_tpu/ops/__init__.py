@@ -27,7 +27,7 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from llmd_tpu.ops.paged_attention import (
@@ -202,7 +202,7 @@ def _write_sharded(mesh, kv_cache, kv_new, layer, phys, offset, valid, full):
 
     return shard_map(
         local, mesh=mesh, in_specs=in_specs, out_specs=cache_spec,
-        check_rep=False,
+        check_vma=False,
     )(*args)
 
 
@@ -294,7 +294,7 @@ def paged_attention(
                 P("dp", None), P("dp"),
             ),
             out_specs=P("dp", None, "tp", None),
-            check_rep=False,
+            check_vma=False,
         )(q, kv_cache, page_table, kv_lens)
     return _attention_xla(q, kv_cache, page_table, kv_lens, positions, sm_scale)
 
@@ -338,7 +338,7 @@ def mla_paged_attention_full(
                 P(), P("dp", None), P("dp"),
             ),
             out_specs=P("dp", None, "tp", None),
-            check_rep=False,
+            check_vma=False,
         )(q_eff, latent_cache_full, layer, page_table, kv_lens)
     sl = jax.lax.dynamic_index_in_dim(
         latent_cache_full, layer, 0, keepdims=False
@@ -378,7 +378,7 @@ def paged_attention_full(
                 P(), P("dp", None), P("dp"),
             ),
             out_specs=P("dp", None, "tp", None),
-            check_rep=False,
+            check_vma=False,
         )(q, kv_cache_full, layer, page_table, kv_lens)
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
     return _attention_xla(q, sl, page_table, kv_lens, positions, sm_scale)

@@ -28,7 +28,7 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from llmd_tpu.config import ModelConfig
@@ -93,7 +93,7 @@ def moe_block_ep(
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=EP_SPEC,
-        check_rep=False,
+        check_vma=False,
     )(ht, *args)
     return out[:T].reshape(B, Q, H)
 

@@ -284,6 +284,57 @@ async def handle_tokenize(request: web.Request) -> web.Response:
     return web.json_response({"tokens": ids, "count": len(ids)})
 
 
+async def handle_embeddings(request: web.Request) -> web.Response:
+    """OpenAI /v1/embeddings: mean-pooled L2-normalized hidden states.
+
+    `input` accepts a string, a list of strings, a token array, or a list
+    of token arrays (the OpenAI surface; reference request-handling.md:
+    50-51 routes /embeddings, and the vllmgrpc parser's Embed verb is the
+    token-in form)."""
+    tokenizer = request.app[TOK_KEY]
+    engine: AsyncEngine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+        if not isinstance(body, dict):
+            return _error(400, "request body must be a JSON object")
+        raw = body.get("input")
+        if isinstance(raw, str):
+            items = [raw]
+        elif isinstance(raw, list) and raw and isinstance(raw[0], int):
+            items = [raw]
+        elif isinstance(raw, list):
+            items = raw
+        else:
+            return _error(400, "input must be a string, list of strings, "
+                               "or token array(s)")
+        prompts = []
+        for item in items:
+            if isinstance(item, str):
+                prompts.append(_tokenize_prompt(tokenizer, item))
+            elif isinstance(item, list) and all(isinstance(t, int) for t in item):
+                prompts.append(item)
+            else:
+                return _error(400, "mixed or invalid input items")
+        if not prompts or any(not p for p in prompts):
+            return _error(400, "empty input")
+    except (json.JSONDecodeError, ValueError, TypeError) as e:
+        return _error(400, str(e))
+    try:
+        vectors = await engine.embed(prompts)
+    except ValueError as e:  # over max_model_len
+        return _error(400, str(e))
+    total_tokens = sum(len(p) for p in prompts)
+    return web.json_response({
+        "object": "list",
+        "model": body.get("model") or request.app[MODEL_KEY],
+        "data": [
+            {"object": "embedding", "index": i, "embedding": row}
+            for i, row in enumerate(vectors.tolist())
+        ],
+        "usage": {"prompt_tokens": total_tokens, "total_tokens": total_tokens},
+    })
+
+
 async def handle_completions_render(request: web.Request) -> web.Response:
     """vLLM-style render: return the token ids the engine would see."""
     tokenizer = request.app[TOK_KEY]
@@ -495,6 +546,33 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         builder(rid, model, text, finish, usage, kvp),
         headers={"x-request-id": rid},
     )
+
+
+async def handle_grpc_embed(request: web.Request) -> web.Response:
+    """vLLM gRPC Embed, JSON-transcoded: token-in / vector-out."""
+    engine = request.app[ENGINE_KEY]
+    max_len = request.app[MAXLEN_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error(400, f"invalid JSON: {e}")
+    if not isinstance(body, dict):
+        return _error(400, "request body must be a JSON object")
+    ids = body.get("prompt_token_ids") or body.get("token_ids") or []
+    if not (isinstance(ids, list) and ids):
+        return _error(400, "prompt_token_ids must be a non-empty list")
+    # single token array or batch of arrays
+    prompts = ids if isinstance(ids[0], list) else [ids]
+    for p in prompts:
+        if not (isinstance(p, list) and p and all(isinstance(t, int) for t in p)):
+            return _error(400, "prompt_token_ids must be int token array(s)")
+        if len(p) > max_len:
+            return _error(400, f"prompt length {len(p)} > max_model_len {max_len}")
+    try:
+        vectors = await engine.embed(prompts)
+    except ValueError as e:  # over the embed batch-token limit
+        return _error(400, str(e))
+    return web.json_response({"embeddings": vectors.tolist()})
 
 
 async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
@@ -739,7 +817,9 @@ def build_app(
             web.get("/metrics", handle_metrics),
             web.post("/tokenize", handle_tokenize),
             web.post("/v1/completions", handle_completions),
+            web.post("/v1/embeddings", handle_embeddings),
             web.post("/vllm.Generation/Generate", handle_grpc_generate),
+            web.post("/vllm.Generation/Embed", handle_grpc_embed),
             web.post("/v1/chat/completions", handle_chat),
             web.post("/v1/completions/render", handle_completions_render),
             web.post("/v1/chat/completions/render", handle_chat_render),

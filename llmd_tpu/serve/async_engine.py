@@ -134,6 +134,13 @@ class AsyncEngine:
             self._lock.notify_all()
         return q
 
+    async def embed(self, prompts: list[list[int]]):
+        """Pooled embeddings off the event loop (the forward runs on an
+        executor thread; params are read-only so it coexists with the
+        step thread)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.engine.embed, prompts)
+
     def abort(self, request_id: str) -> None:
         with self._lock:
             self._subs.pop(request_id, None)

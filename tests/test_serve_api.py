@@ -202,3 +202,84 @@ async def test_concurrent_requests(client):
 
     results = await asyncio.gather(*[one(i) for i in range(6)])
     assert all(r["usage"]["completion_tokens"] >= 1 for r in results)
+
+
+async def test_embeddings_endpoint(client):
+    import math
+
+    # string input
+    r = await client.post("/v1/embeddings", json={"model": "tiny", "input": "hello world"})
+    assert r.status == 200, await r.text()
+    d = await r.json()
+    v1 = d["data"][0]["embedding"]
+    assert d["object"] == "list" and d["data"][0]["index"] == 0
+    tok = await client.post("/tokenize", json={"prompt": "hello world"})
+    assert d["usage"]["prompt_tokens"] == (await tok.json())["count"]
+    # unit norm
+    assert abs(math.sqrt(sum(x * x for x in v1)) - 1.0) < 1e-4
+
+    # deterministic + input-sensitive
+    r = await client.post("/v1/embeddings", json={"input": "hello world"})
+    assert (await r.json())["data"][0]["embedding"] == v1
+    r = await client.post("/v1/embeddings", json={"input": "different text"})
+    v2 = (await r.json())["data"][0]["embedding"]
+    assert v2 != v1
+
+    # batch of strings: rows match the single calls
+    r = await client.post(
+        "/v1/embeddings", json={"input": ["hello world", "different text"]}
+    )
+    d = await r.json()
+    assert len(d["data"]) == 2
+    import numpy as np
+
+    np.testing.assert_allclose(d["data"][0]["embedding"], v1, atol=1e-5)
+    np.testing.assert_allclose(d["data"][1]["embedding"], v2, atol=1e-5)
+
+    # token-array input == its string equivalent (tokenize first: the
+    # byte tokenizer may add special tokens)
+    ids = (await (await client.post(
+        "/tokenize", json={"prompt": "hello world"}
+    )).json())["tokens"]
+    r = await client.post("/v1/embeddings", json={"input": ids})
+    np.testing.assert_allclose(
+        (await r.json())["data"][0]["embedding"], v1, atol=1e-5
+    )
+
+    # validation
+    r = await client.post("/v1/embeddings", json={"input": []})
+    assert r.status == 400
+    r = await client.post("/v1/embeddings", json={"input": {"bad": 1}})
+    assert r.status == 400
+    r = await client.post("/v1/embeddings", json={"input": "x" * 4096})
+    assert r.status == 400  # over the embed length limit
+    r = await client.post("/v1/embeddings", json=[1, 2])  # non-object body
+    assert r.status == 400
+
+    # batches larger than max_num_seqs slice internally (engine max is 8)
+    r = await client.post(
+        "/v1/embeddings", json={"input": [f"text {i}" for i in range(11)]}
+    )
+    assert r.status == 200, await r.text()
+    d = await r.json()
+    assert len(d["data"]) == 11
+    r1 = await client.post("/v1/embeddings", json={"input": "text 9"})
+    np.testing.assert_allclose(
+        d["data"][9]["embedding"],
+        (await r1.json())["data"][0]["embedding"], atol=1e-5,
+    )
+
+
+async def test_grpc_embed_endpoint(client):
+    ids = [ord(c) for c in "token surface"]
+    r = await client.post("/vllm.Generation/Embed", json={"prompt_token_ids": ids})
+    assert r.status == 200, await r.text()
+    d = await r.json()
+    assert len(d["embeddings"]) == 1
+    # matches the OpenAI surface for the same tokens
+    r2 = await client.post("/v1/embeddings", json={"input": ids})
+    import numpy as np
+
+    np.testing.assert_allclose(
+        d["embeddings"][0], (await r2.json())["data"][0]["embedding"], atol=1e-5
+    )
