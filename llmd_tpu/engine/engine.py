@@ -212,15 +212,19 @@ class LLMEngine:
     def abort_request(self, request_id: str) -> bool:
         return self.scheduler.abort_request(request_id) is not None
 
-    def embed(self, prompts: list[list[int]]):
+    def embed(self, prompts: list[list[int]], lora_id: int = 0):
         """[n, H] mean-pooled L2-normalized embeddings (OpenAI
         /v1/embeddings surface); independent of the serving KV cache.
 
         Serialized: each call allocates a scratch KV pool, so unbounded
         concurrency (N executor threads x multi-GB scratch) would OOM the
         device under an embedding burst."""
+        if lora_id and not (
+            0 < lora_id <= self.config.model.num_lora_adapters
+        ):
+            raise ValueError(f"lora_id {lora_id} out of range")
         with self._embed_lock:
-            return self.runner.run_embed(prompts)
+            return self.runner.run_embed(prompts, lora_id=lora_id)
 
     def close(self) -> None:
         """Release network-facing resources (KV connector, store client)."""

@@ -352,7 +352,9 @@ class ModelRunner:
 
     # ------------------------------------------------------------------ #
 
-    def run_embed(self, prompts: list[list[int]]) -> np.ndarray:
+    def run_embed(
+        self, prompts: list[list[int]], lora_id: int = 0
+    ) -> np.ndarray:
         """Mean-pooled, L2-normalized final hidden states: [n, H] f32.
 
         The /v1/embeddings surface (OpenAI API; the reference's vllmgrpc
@@ -373,7 +375,7 @@ class ModelRunner:
         max_b = self.batch_buckets[-1]
         if len(prompts) > max_b:
             return np.concatenate([
-                self.run_embed(prompts[i : i + max_b])
+                self.run_embed(prompts[i : i + max_b], lora_id)
                 for i in range(0, len(prompts), max_b)
             ])
         n = len(prompts)
@@ -400,7 +402,9 @@ class ModelRunner:
             kv_lens=jnp.asarray(qlens),
             page_table=jnp.asarray(page_table),
             lora_ids=(
-                jnp.zeros(B, jnp.int32) if self.cfg.num_lora_adapters else None
+                jnp.full(B, lora_id, jnp.int32)
+                if self.cfg.num_lora_adapters
+                else None
             ),
         )
         scratch = jnp.zeros(
@@ -419,7 +423,7 @@ class ModelRunner:
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def embed(params, scratch_kv, inp: StepInput):
             hidden, _ = llama.forward_hidden(
                 params, scratch_kv, inp, cfg, world, mesh=mesh,

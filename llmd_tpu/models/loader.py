@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import pathlib
 
 import numpy as np
@@ -407,3 +408,74 @@ def load_params(
             len(unused), sorted(unused)[:8], " ..." if len(unused) > 8 else "",
         )
     return params
+
+
+def load_lora_adapter(cfg: ModelConfig, adapter_dir: str) -> dict:
+    """Load an HF PEFT LoRA adapter directory into set_lora_weights form.
+
+    Reads adapter_config.json + adapter_model.safetensors and returns
+    {la_q, lb_q, la_v, lb_v} stacked [num_layers, ...], with the PEFT
+    alpha/r scaling folded into B and ranks zero-padded up to the slot
+    rank (zero columns are exact no-ops). Only q_proj/v_proj targets are
+    servable (the slot layout); anything else raises rather than silently
+    serving a partial adapter.
+    """
+    p = pathlib.Path(adapter_dir)
+    with open(p / "adapter_config.json") as f:
+        acfg = json.load(f)
+    targets = set(acfg.get("target_modules") or [])
+    unsupported = targets - {"q_proj", "v_proj"}
+    if unsupported:
+        raise ValueError(
+            f"adapter targets unsupported modules {sorted(unsupported)}; "
+            "servable slots cover q_proj and v_proj"
+        )
+    if acfg.get("bias", "none") != "none":
+        raise ValueError(
+            f"adapter bias={acfg['bias']!r} is not servable (slots carry "
+            "A/B factors only); trained biases would silently drop"
+        )
+    r = int(acfg["r"])
+    if r > cfg.lora_rank:
+        raise ValueError(
+            f"adapter rank {r} > slot rank {cfg.lora_rank}; raise --lora-rank"
+        )
+    alpha = float(acfg.get("lora_alpha", r))
+    # rsLoRA stores alpha/sqrt(r) scaling semantics (PEFT use_rslora).
+    scale = alpha / math.sqrt(r) if acfg.get("use_rslora") else alpha / r
+    ckpt = _Checkpoint(str(p))
+    names = ckpt.names()
+
+    def find(layer: int, proj: str, half: str) -> str | None:
+        # PEFT names vary by wrapper depth; match on the stable suffix.
+        suffix = f"layers.{layer}.self_attn.{proj}.{half}.weight"
+        for n in names:
+            if n.endswith(suffix):
+                return n
+        return None
+
+    H, D = cfg.hidden_size, cfg.head_dim
+    Nq, K, L = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers
+    dt = np.dtype(jnp.dtype(cfg.dtype))
+    shapes = {
+        "la_q": (H, cfg.lora_rank), "lb_q": (cfg.lora_rank, Nq * D),
+        "la_v": (H, cfg.lora_rank), "lb_v": (cfg.lora_rank, K * D),
+    }
+    out = {k: np.zeros((L, *shape), dt) for k, shape in shapes.items()}
+    for layer in range(L):
+        for proj, a_key, b_key in (
+            ("q_proj", "la_q", "lb_q"), ("v_proj", "la_v", "lb_v"),
+        ):
+            if proj not in targets:
+                continue
+            a_name = find(layer, proj, "lora_A")
+            b_name = find(layer, proj, "lora_B")
+            if a_name is None or b_name is None:
+                raise KeyError(
+                    f"adapter missing lora_A/lora_B for layer {layer} {proj}"
+                )
+            a = ckpt.get(a_name)  # [r, H]
+            b = ckpt.get(b_name)  # [out, r]
+            out[a_key][layer, :, :r] = a.T.astype(dt)
+            out[b_key][layer, :r, :] = (b.T * scale).astype(dt)
+    return out

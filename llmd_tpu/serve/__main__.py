@@ -13,23 +13,42 @@ import json
 import logging
 
 
-def parse_lora_adapters(spec: str | None) -> dict[str, int]:
-    """'a,b' -> {'a': 1, 'b': 2}; deduplicated, order-preserving.
+def parse_lora_adapters(spec: str | None) -> dict[str, tuple[int, str | None]]:
+    """'a,b=/path' -> {'a': (1, None), 'b': (2, '/path')}.
 
-    Names are restricted to Prometheus-label-safe characters: they are
-    interpolated into the lora_requests_info label values, and a quote
-    or backslash would corrupt the exposition page."""
+    Deduplicated, order-preserving. A bare name reserves an empty slot
+    (identity adapter until weights install); `name=dir` loads an HF PEFT
+    adapter directory into the slot at startup. Names are restricted to
+    Prometheus-label-safe characters: they are interpolated into the
+    lora_requests_info label values, and a quote or backslash would
+    corrupt the exposition page."""
     if not spec:
         return {}
     import re
 
-    names = list(dict.fromkeys(n.strip() for n in spec.split(",") if n.strip()))
-    for n in names:
-        if not re.fullmatch(r"[A-Za-z0-9._:/-]+", n):
+    entries: dict[str, str | None] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, path = part.partition("=")
+        name = name.strip()
+        if not re.fullmatch(r"[A-Za-z0-9._:/-]+", name):
             raise ValueError(
-                f"invalid adapter name {n!r}: use letters, digits, ._:/-"
+                f"invalid adapter name {name!r}: use letters, digits, ._:/-"
             )
-    return {name: i + 1 for i, name in enumerate(names)}
+        path = path.strip() or None
+        if name in entries:
+            if entries[name] != path:
+                raise ValueError(
+                    f"adapter {name!r} listed twice with conflicting paths "
+                    f"({entries[name]!r} vs {path!r})"
+                )
+            continue
+        entries[name] = path
+    return {
+        name: (i + 1, path) for i, (name, path) in enumerate(entries.items())
+    }
 
 
 def make_engine_config(args, lora_adapters=None):
@@ -196,7 +215,12 @@ def main(argv=None) -> None:
     from llmd_tpu.serve.async_engine import AsyncEngine
     from llmd_tpu.serve.tokenizer import load_tokenizer
 
-    lora_adapters = parse_lora_adapters(args.lora_adapters) or None
+    adapter_specs = parse_lora_adapters(args.lora_adapters) or None
+    lora_adapters = (
+        {name: slot for name, (slot, _) in adapter_specs.items()}
+        if adapter_specs
+        else None
+    )
     config = make_engine_config(args, lora_adapters)
     advertised = args.advertised_address or f"{args.host}:{args.port}"
     if advertised.startswith("0.0.0.0"):
@@ -224,6 +248,15 @@ def main(argv=None) -> None:
             sample_ratio=args.trace_sample_ratio,
         )
     engine = LLMEngine(config, event_sink=event_sink)
+    for name, (slot, path) in (adapter_specs or {}).items():
+        if path:
+            from llmd_tpu.models.loader import load_lora_adapter
+
+            engine.set_lora_weights(
+                slot, load_lora_adapter(config.model, path)
+            )
+            logging.info("loaded LoRA adapter %r from %s into slot %d",
+                         name, path, slot)
     if not args.skip_warmup:
         n = engine.runner.warmup()
         logging.info("warmup compiled %d programs", n)

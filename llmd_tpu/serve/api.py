@@ -297,6 +297,13 @@ async def handle_embeddings(request: web.Request) -> web.Response:
         body = await request.json()
         if not isinstance(body, dict):
             return _error(400, "request body must be a JSON object")
+        # Same model-id discipline as the generate endpoints: adapter ids
+        # embed through their slot; unknown ids 404 rather than silently
+        # embedding with the base model.
+        try:
+            lora_id, _ = _resolve_lora(request, body.get("model") or "")
+        except UnknownModelError as e:
+            return _error(404, f"unknown model {e}")
         raw = body.get("input")
         if isinstance(raw, str):
             items = [raw]
@@ -320,7 +327,7 @@ async def handle_embeddings(request: web.Request) -> web.Response:
     except (json.JSONDecodeError, ValueError, TypeError) as e:
         return _error(400, str(e))
     try:
-        vectors = await engine.embed(prompts)
+        vectors = await engine.embed(prompts, lora_id)
     except ValueError as e:  # over max_model_len
         return _error(400, str(e))
     total_tokens = sum(len(p) for p in prompts)

@@ -10,6 +10,7 @@ blocks on device work.
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 import threading
 from dataclasses import dataclass, field
@@ -134,12 +135,14 @@ class AsyncEngine:
             self._lock.notify_all()
         return q
 
-    async def embed(self, prompts: list[list[int]]):
+    async def embed(self, prompts: list[list[int]], lora_id: int = 0):
         """Pooled embeddings off the event loop (the forward runs on an
         executor thread; params are read-only so it coexists with the
         step thread)."""
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.engine.embed, prompts)
+        return await loop.run_in_executor(
+            None, functools.partial(self.engine.embed, prompts, lora_id)
+        )
 
     def abort(self, request_id: str) -> None:
         with self._lock:

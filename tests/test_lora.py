@@ -215,5 +215,11 @@ async def test_unknown_model_404_when_adapters_configured():
 def test_parse_lora_adapters_dedup():
     from llmd_tpu.serve.__main__ import parse_lora_adapters
 
-    assert parse_lora_adapters("a, b ,a") == {"a": 1, "b": 2}
+    assert parse_lora_adapters("a, b ,a") == {"a": (1, None), "b": (2, None)}
     assert parse_lora_adapters(None) == {}
+    # name=dir form loads a PEFT adapter into the slot at startup
+    assert parse_lora_adapters("sql=/adapters/sql, chat") == {
+        "sql": (1, "/adapters/sql"), "chat": (2, None),
+    }
+    with pytest.raises(ValueError, match="invalid adapter name"):
+        parse_lora_adapters('bad"name')

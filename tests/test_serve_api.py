@@ -270,6 +270,25 @@ async def test_embeddings_endpoint(client):
     )
 
 
+async def test_embeddings_model_validation_with_adapters():
+    """Embeddings enforce the same model-id discipline as generation:
+    adapter ids embed through their slot, unknown ids 404."""
+    engine = make_engine(num_lora_adapters=1, lora_rank=4)
+    app = build_app(
+        AsyncEngine(engine), ByteTokenizer(), "tiny", 128,
+        lora_adapters={"ad": 1},
+    )
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    try:
+        r = await c.post("/v1/embeddings", json={"model": "typo", "input": "x"})
+        assert r.status == 404
+        r = await c.post("/v1/embeddings", json={"model": "ad", "input": "x"})
+        assert r.status == 200, await r.text()
+    finally:
+        await c.close()
+
+
 async def test_grpc_embed_endpoint(client):
     ids = [ord(c) for c in "token surface"]
     r = await client.post("/vllm.Generation/Embed", json={"prompt_token_ids": ids})

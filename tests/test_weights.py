@@ -276,6 +276,63 @@ def test_loader_rejects_sliding_window_and_unknown_rope(tmp_path):
         config_from_hf(str(d))
 
 
+def test_peft_lora_adapter_matches_merged_transformers(tmp_path):
+    """A real PEFT LoRA adapter served through an adapter slot must match
+    transformers with the adapter weights merged into the base model."""
+    peft = pytest.importorskip("peft")
+
+    from llmd_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from llmd_tpu.models.loader import load_lora_adapter
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(10)
+    base = transformers.LlamaForCausalLM(hf_cfg)
+    base_dir = _save_hf(base, tmp_path)
+    base_golden = _hf_greedy(base, PROMPT, NEW_TOKENS)
+
+    lcfg = peft.LoraConfig(
+        r=4, lora_alpha=8, target_modules=["q_proj", "v_proj"],
+        init_lora_weights=False,  # random A AND B: a live adapter
+    )
+    # Wrap the SAME base the engine will load (base_dir saved above).
+    wrapped = peft.get_peft_model(base, lcfg)
+    adapter_dir = tmp_path / "adapter"
+    wrapped.save_pretrained(adapter_dir)
+    golden = _hf_greedy(wrapped.merge_and_unload(), PROMPT, NEW_TOKENS)
+
+    cfg = config_from_hf(base_dir, dtype="float32",
+                         num_lora_adapters=1, lora_rank=4)
+    engine = LLMEngine(EngineConfig(
+        model=cfg,
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=64),
+        weights_path=base_dir,
+    ))
+    engine.set_lora_weights(1, load_lora_adapter(cfg, str(adapter_dir)))
+
+    def greedy(lora_id):
+        rid = engine.add_request(
+            list(PROMPT),
+            SamplingParams(temperature=0.0, max_tokens=NEW_TOKENS,
+                           ignore_eos=True),
+            lora_id=lora_id, lora_name="ad" if lora_id else "",
+        )
+        out = []
+        while engine.has_work():
+            for res in engine.step():
+                if res.request_id == rid:
+                    out.extend(res.new_token_ids)
+        return out
+
+    assert greedy(0) == base_golden  # base slot unaffected
+    assert greedy(1) == golden       # adapter slot == HF merged model
+
+
 def test_config_from_hf_maps_fields(tmp_path):
     d = tmp_path / "m"
     d.mkdir()
