@@ -108,12 +108,33 @@ class ModelRunner:
 
     # ------------------------------------------------------------------ #
 
+    @functools.cached_property
+    def kv_rep(self) -> int:
+        """KV-head replication factor for the pool's head axis.
+
+        When tp exceeds (but is a multiple of) the KV head count, each kv
+        head is stored tp/K times consecutively so the head axis shards
+        over tp: per-chip KV becomes pool/K instead of the full replicated
+        pool the plain spec degrades to (the reference's FlashInfer-under-
+        TP layouts make the same trade). GQA stays exact — q head h reads
+        expanded head h // (Nq / (K*rep)), which holds h's kv head."""
+        K, tp, Nq = self.cfg.kv_cache_heads, self.ctx.tp, self.cfg.num_heads
+        if (
+            not self.cfg.is_mla
+            and tp > 1
+            and K % tp != 0
+            and tp % K == 0
+            and Nq % tp == 0
+        ):
+            return tp // K
+        return 1
+
     def _alloc_kv(self) -> jax.Array:
         c = self.config.cache
         shape = (
             self.cfg.num_layers,
             c.num_blocks,
-            self.cfg.kv_cache_heads,  # MLA: one latent "head"
+            self.cfg.kv_cache_heads * self.kv_rep,  # MLA: one latent "head"
             c.page_size,
             self.cfg.kv_cache_entry_dim,
         )
@@ -123,7 +144,7 @@ class ModelRunner:
             # not the GQA mis-configuration kv_cache_spec warns about.
             spec = jax.sharding.PartitionSpec()
         else:
-            spec = kv_cache_spec(self.cfg.kv_cache_heads, self.ctx.tp)
+            spec = kv_cache_spec(shape[2], self.ctx.tp)
         return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*spec))
 
     def kv_bytes(self) -> int:
@@ -164,6 +185,7 @@ class ModelRunner:
         cfg = self.cfg
         world = self.ctx.world
         mesh = self.ctx.mesh
+        kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
 
@@ -174,7 +196,7 @@ class ModelRunner:
             hidden, kv_cache = llama.forward_hidden(
                 params, kv_cache, inp, cfg, world,
                 mesh=mesh, moe_backend=moe_backend,
-                ep_capacity_factor=ep_capacity,
+                ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
             )
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
@@ -193,6 +215,7 @@ class ModelRunner:
         cfg = self.cfg
         world = self.ctx.world
         mesh = self.ctx.mesh
+        kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
 
@@ -230,7 +253,7 @@ class ModelRunner:
                 hidden, kv_cache = llama.forward_hidden(
                     params, kv_cache, inp, cfg, world,
                     mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
                 )
                 logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
                 s = SamplingInputs(
@@ -328,7 +351,13 @@ class ModelRunner:
         if bucket > n:
             ids = np.concatenate([ids, np.full(bucket - n, ids[-1], np.int32)])
         out = np.asarray(jax.device_get(_gather_kv(self.kv_cache, jnp.asarray(ids))))
-        return out[:, :n]
+        out = out[:, :n]
+        if self.kv_rep > 1:
+            # Canonical transfer/offload format keeps the ORIGINAL heads:
+            # replicated copies are a local layout detail, and peers with
+            # different tp configs must interoperate byte-exact.
+            out = np.ascontiguousarray(out[:, :, :: self.kv_rep])
+        return out
 
     def scatter_pages(self, page_ids: list[int], pages: np.ndarray) -> None:
         """Stage pages host -> HBM into the given physical page slots.
@@ -340,6 +369,10 @@ class ModelRunner:
         n = len(page_ids)
         if n == 0:
             return
+        if self.kv_rep > 1:
+            # Expand canonical [.., K, ..] bundles to the local replicated
+            # head layout.
+            pages = np.repeat(pages, self.kv_rep, axis=2)
         bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
         ids = np.asarray(page_ids, np.int32)
         if bucket > n:
@@ -420,6 +453,7 @@ class ModelRunner:
     @functools.cached_property
     def _embed_fn(self):
         cfg, world, mesh = self.cfg, self.ctx.world, self.ctx.mesh
+        kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
 
@@ -428,6 +462,7 @@ class ModelRunner:
             hidden, _ = llama.forward_hidden(
                 params, scratch_kv, inp, cfg, world, mesh=mesh,
                 moe_backend=moe_backend, ep_capacity_factor=ep_capacity,
+                kv_rep=kv_rep,
             )
             valid = inp.valid[..., None].astype(jnp.float32)  # [B, Q, 1]
             summed = jnp.sum(hidden.astype(jnp.float32) * valid, axis=1)

@@ -129,18 +129,24 @@ def _mlp(h: jax.Array, lp: dict) -> jax.Array:
 
 def forward_hidden(
     params: dict,
-    kv_cache: jax.Array,  # [L, pages, K, page, 2D]
+    kv_cache: jax.Array,  # [L, pages, K * kv_rep, page, 2D]
     inp: StepInput,
     cfg: ModelConfig,
     world_size: int = 1,
     mesh=None,
     moe_backend: str = "dense",
     ep_capacity_factor: float = 2.0,
+    kv_rep: int = 1,
 ) -> tuple[jax.Array, jax.Array]:
     """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache).
 
     ``moe_backend="ep"`` routes MoE layers through the shard_map all-to-all
-    dispatch/combine (wide-EP; requires ``mesh``)."""
+    dispatch/combine (wide-EP; requires ``mesh``). ``kv_rep`` > 1 stores
+    each KV head ``kv_rep`` times consecutively so the pool's head axis
+    divides tp when num_kv_heads alone does not (tp > K): per-chip KV is
+    then pool/K instead of a full replicated pool. Attention grouping
+    stays exact — q head h reads expanded head h // (Nq / (K*kv_rep)),
+    which holds h's original kv head."""
     B, Q = inp.token_ids.shape
     D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     x = params["embed"][inp.token_ids]  # [B, Q, H]
@@ -188,6 +194,9 @@ def forward_hidden(
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             v = v.reshape(B, Q, K, D)
+            if kv_rep > 1:
+                k = jnp.repeat(k, kv_rep, axis=2)
+                v = jnp.repeat(v, kv_rep, axis=2)
             cache = write_kv_pages_full(
                 cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
                 world_size=world_size, mesh=mesh,

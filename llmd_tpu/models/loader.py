@@ -423,7 +423,10 @@ def load_lora_adapter(cfg: ModelConfig, adapter_dir: str) -> dict:
     p = pathlib.Path(adapter_dir)
     with open(p / "adapter_config.json") as f:
         acfg = json.load(f)
-    targets = set(acfg.get("target_modules") or [])
+    raw_targets = acfg.get("target_modules") or []
+    if isinstance(raw_targets, str):  # PEFT accepts a bare string/regex
+        raw_targets = [raw_targets]
+    targets = set(raw_targets)
     unsupported = targets - {"q_proj", "v_proj"}
     if unsupported:
         raise ValueError(
@@ -435,6 +438,14 @@ def load_lora_adapter(cfg: ModelConfig, adapter_dir: str) -> dict:
             f"adapter bias={acfg['bias']!r} is not servable (slots carry "
             "A/B factors only); trained biases would silently drop"
         )
+    # Anything that changes the math beyond plain scaled A/B must fail
+    # loudly rather than serve approximately-the-adapter.
+    for feature in ("use_dora", "modules_to_save", "alpha_pattern", "rank_pattern"):
+        if acfg.get(feature):
+            raise ValueError(
+                f"adapter uses {feature}={acfg[feature]!r}, which the slot "
+                "layout cannot represent; the adapter would serve wrong"
+            )
     r = int(acfg["r"])
     if r > cfg.lora_rank:
         raise ValueError(

@@ -565,6 +565,10 @@ async def handle_grpc_embed(request: web.Request) -> web.Response:
         return _error(400, f"invalid JSON: {e}")
     if not isinstance(body, dict):
         return _error(400, "request body must be a JSON object")
+    try:
+        lora_id, _ = _resolve_lora(request, str(body.get("model") or ""))
+    except UnknownModelError as e:
+        return _error(404, f"unknown model {e}")
     ids = body.get("prompt_token_ids") or body.get("token_ids") or []
     if not (isinstance(ids, list) and ids):
         return _error(400, "prompt_token_ids must be a non-empty list")
@@ -576,7 +580,7 @@ async def handle_grpc_embed(request: web.Request) -> web.Response:
         if len(p) > max_len:
             return _error(400, f"prompt length {len(p)} > max_model_len {max_len}")
     try:
-        vectors = await engine.embed(prompts)
+        vectors = await engine.embed(prompts, lora_id)
     except ValueError as e:  # over the embed batch-token limit
         return _error(400, str(e))
     return web.json_response({"embeddings": vectors.tolist()})

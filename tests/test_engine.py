@@ -256,3 +256,69 @@ def test_tp2_decode_runs_pallas_kernels_sharded(devices, monkeypatch):
     )
     assert list(ref.values()) == list(got.values())
     assert "shard" in plans  # the sharded kernel path actually ran
+
+
+def test_tp_exceeding_kv_heads_shards_via_replication(devices):
+    """tp > num_kv_heads: the pool stores each kv head tp/K times so the
+    head axis shards over tp (per-chip KV = pool/K, not a full replica),
+    and outputs match the unsharded engine exactly."""
+    kw = dict(num_heads=8, num_kv_heads=2, hidden_size=64,
+              intermediate_size=128)
+    params = SamplingParams(temperature=0.0, max_tokens=6)
+    ref = make_engine(tp=1, **kw).generate(PROMPTS, params)
+    eng = make_engine(tp=8, **kw)
+    assert eng.runner.kv_rep == 4
+    assert eng.runner.kv_cache.shape[2] == 8  # 2 kv heads x 4 copies
+    got = eng.generate(PROMPTS, params)
+    assert list(ref.values()) == list(got.values())
+
+
+def test_kv_rep_pd_transfer_interops_with_unsharded_producer(devices):
+    """P/D across different tp layouts: bundles travel in the canonical
+    original-head format, so a tp=1 producer feeds a kv-replicated
+    consumer byte-exact."""
+    kw = dict(num_heads=8, num_kv_heads=2, hidden_size=64,
+              intermediate_size=128)
+
+    def engine_with(tp, role):
+        cfg = EngineConfig(
+            model=tiny_model_config(**kw),
+            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+            parallel=ParallelConfig(tensor_parallel_size=tp),
+            kv_role=role,
+            kv_transfer_port=0,
+        )
+        return LLMEngine(cfg)
+
+    prompt = list(range(1, 18))
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    ref = make_engine(tp=1, **kw).generate([prompt], sp)
+
+    producer = engine_with(1, "kv_producer")
+    consumer = engine_with(8, "kv_consumer")
+    try:
+        assert consumer.runner.kv_rep == 4
+        rid = producer.add_request(
+            list(prompt), SamplingParams(temperature=0.0, max_tokens=1),
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        pre = None
+        while producer.has_work():
+            for out in producer.step():
+                if out.request_id == rid and out.finished:
+                    pre = out
+        rid = consumer.add_request(
+            list(prompt), sp, kv_transfer_params=pre.kv_transfer_params
+        )
+        toks = []
+        while consumer.has_work():
+            for out in consumer.step():
+                if out.request_id == rid:
+                    toks.extend(out.new_token_ids)
+        assert toks == list(ref.values())[0]
+        assert consumer.kv_connector.imported_requests == 1
+        assert consumer.kv_connector.import_failures == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
