@@ -13,6 +13,7 @@ docs/architecture/core/model-servers.md:38-100):
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import hmac
 import json
 import logging
@@ -477,8 +478,10 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
             req_max = req.max_tokens
     except (ValueError, TypeError, pydantic.ValidationError) as e:
         return _error(400, str(e))
-    if req.n != 1:
-        return _error(400, "only n=1 is supported")
+    if req.n < 1 or req.n > 16:
+        return _error(400, "n must be in [1, 16]")
+    if req.n != 1 and req.stream:
+        return _error(400, "streaming supports n=1 only")
     if len(prompt_ids) >= max_len:
         return _error(400, f"prompt length {len(prompt_ids)} >= max_model_len {max_len}")
     budget = max_len - len(prompt_ids)
@@ -519,10 +522,44 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         finally:
             span.end()
     try:
-        text, finish, final = await _collect(
-            engine, rid, prompt_ids, sampling, detok, req.priority,
-            req.kv_transfer_params, lora_id, lora_name,
-        )
+        if req.n == 1:
+            choices = [await _collect(
+                engine, rid, prompt_ids, sampling, detok, req.priority,
+                req.kv_transfer_params, lora_id, lora_name,
+            )]
+        else:
+            # n parallel samples share the prompt (and its cached prefix).
+            # With a seed set, choice i derives seed+i so the batch is
+            # reproducible; unseeded choices draw independent randomness.
+            # Greedy (temperature=0) necessarily yields identical choices,
+            # matching OpenAI semantics. Only choice 0 carries the remote
+            # KV pull (one transfer; siblings reuse the cached prefix or
+            # recompute locally).
+            async def one(i: int):
+                sp = (
+                    dataclasses.replace(sampling, seed=sampling.seed + i)
+                    if sampling.seed is not None
+                    else sampling
+                )
+                return await _collect(
+                    engine, f"{rid}-{i}", prompt_ids, sp,
+                    Detokenizer(tokenizer, P.stop_strings(req.stop)),
+                    req.priority,
+                    req.kv_transfer_params if i == 0 else None,
+                    lora_id, lora_name,
+                )
+
+            tasks = [asyncio.ensure_future(one(i)) for i in range(req.n)]
+            try:
+                choices = list(await asyncio.gather(*tasks))
+            except BaseException:
+                # First failure: stop the siblings (cancellation aborts
+                # their engine requests) and drain their exceptions.
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+        text, finish, final = choices[0]
     except RequestFailed as e:
         span.error(str(e))
         span.end()
@@ -539,20 +576,34 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         span.error(str(e) or type(e).__name__)
         span.end()
         raise
-    span.set("gen_ai.usage.completion_tokens", final.num_output_tokens if final else 0)
+    completion_tokens = sum(f.num_output_tokens for _, _, f in choices if f)
+    span.set("gen_ai.usage.completion_tokens", completion_tokens)
     span.set("llm_d.cache.hit_tokens", final.num_cached_tokens if final else 0)
     span.end()
     usage = P.usage_dict(
         len(prompt_ids),
-        final.num_output_tokens if final else 0,
+        completion_tokens,
         final.num_cached_tokens if final else 0,
     )
     kvp = final.kv_transfer_params if final else None
     builder = P.chat_response if chat else P.completion_response
-    return web.json_response(
-        builder(rid, model, text, finish, usage, kvp),
-        headers={"x-request-id": rid},
-    )
+    resp = builder(rid, model, text, finish, usage, kvp)
+    if req.n > 1:
+        tmpl = resp["choices"][0]
+        resp["choices"] = [
+            {
+                **tmpl,
+                "index": i,
+                **(
+                    {"message": {"role": "assistant", "content": txt}}
+                    if chat
+                    else {"text": txt}
+                ),
+                "finish_reason": fin,
+            }
+            for i, (txt, fin, _) in enumerate(choices)
+        ]
+    return web.json_response(resp, headers={"x-request-id": rid})
 
 
 async def handle_grpc_embed(request: web.Request) -> web.Response:

@@ -145,7 +145,7 @@ async def test_validation_errors(client):
     )
     assert r.status == 400
     r = await client.post(
-        "/v1/completions", json={"prompt": "ok", "n": 3, "max_tokens": 2}
+        "/v1/completions", json={"prompt": "ok", "n": 0, "max_tokens": 2}
     )
     assert r.status == 400
 
@@ -302,3 +302,52 @@ async def test_grpc_embed_endpoint(client):
     np.testing.assert_allclose(
         d["embeddings"][0], (await r2.json())["data"][0]["embedding"], atol=1e-5
     )
+
+
+async def test_completion_n_choices(client):
+    # n seeded samples: reproducible, indexed, usage sums choices
+    r = await client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "hello", "max_tokens": 5,
+        "n": 3, "temperature": 1.0, "seed": 42,
+    })
+    assert r.status == 200, await r.text()
+    d = await r.json()
+    assert [c["index"] for c in d["choices"]] == [0, 1, 2]
+    # usage sums ALL choices: at least 1 token each, at most max_tokens
+    assert 3 <= d["usage"]["completion_tokens"] <= 3 * 5
+    texts = [c["text"] for c in d["choices"]]
+    # seeded: same request reproduces the same choice set
+    r2 = await client.post("/v1/completions", json={
+        "model": "tiny", "prompt": "hello", "max_tokens": 5,
+        "n": 3, "temperature": 1.0, "seed": 42,
+    })
+    assert [c["text"] for c in (await r2.json())["choices"]] == texts
+    # seed+i derivation: choices differ from each other (overwhelmingly)
+    assert len(set(texts)) > 1
+
+    # greedy n: all choices identical (OpenAI semantics)
+    r = await client.post("/v1/completions", json={
+        "prompt": "hello", "max_tokens": 4, "n": 2, "temperature": 0.0,
+    })
+    d = await r.json()
+    assert d["choices"][0]["text"] == d["choices"][1]["text"]
+
+    # chat n
+    r = await client.post("/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "n": 2, "temperature": 1.0, "seed": 7,
+    })
+    assert r.status == 200, await r.text()
+    d = await r.json()
+    assert len(d["choices"]) == 2
+    assert all("content" in c["message"] for c in d["choices"])
+
+    # limits
+    r = await client.post("/v1/completions", json={
+        "prompt": "x", "n": 99,
+    })
+    assert r.status == 400
+    r = await client.post("/v1/completions", json={
+        "prompt": "x", "n": 2, "stream": True,
+    })
+    assert r.status == 400
