@@ -10,9 +10,10 @@ FailOpen/FailClose policy decides)."""
 import asyncio
 import json
 
-import grpc
-import grpc.aio
 import pytest
+
+grpc = pytest.importorskip("grpc")
+import grpc.aio  # noqa: E402
 
 from llmd_tpu.epp import extproc_pb as pb
 from llmd_tpu.epp.config import DEFAULT_CONFIG, build_flow_control, build_scheduler
