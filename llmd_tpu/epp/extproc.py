@@ -64,6 +64,7 @@ class ExtProcSession:
         self.pod = None
         self.t_routed: float | None = None
         self._flow_held = False
+        self._ok = False
 
     async def on_message(self, msg: pb.ProcessingRequest) -> bytes | None:
         if msg.kind == "request_headers":
@@ -82,7 +83,14 @@ class ExtProcSession:
             if self.req is not None and self.pod is not None:
                 ttft_ms = None
                 if self.t_routed is not None and status.startswith("2"):
-                    ttft_ms = (time.monotonic() - self.t_routed) * 1e3
+                    ttft_s = time.monotonic() - self.t_routed
+                    ttft_ms = ttft_s * 1e3
+                    # Mirror the fused proxy's accounting (server.py): the
+                    # latency-aware scorers and PrefixCacheAffinityFilter's
+                    # TTFT load gate read these attrs, and Envoy is the
+                    # EPP's primary deployment shape.
+                    self.pod.attrs["LastTTFT"] = ttft_s
+                    self._ok = True
                 # Fire-and-forget like the fused proxy (server.py): a slow
                 # observer (predictor training POST) must not hold Envoy's
                 # response delivery.
@@ -109,6 +117,10 @@ class ExtProcSession:
             self._flow_held = False
             self.router.flow.release()
         if self.pod is not None:
+            if self._ok and self.t_routed is not None:
+                # E2E closes when Envoy finishes proxying the stream —
+                # same point the fused proxy records it (server.py).
+                self.pod.attrs["LastE2E"] = time.monotonic() - self.t_routed
             self.pod.inflight = max(0, self.pod.inflight - 1)
             if self.req is not None:
                 self.pod.inflight_tokens = max(

@@ -24,7 +24,9 @@ CommonResponse:     status=1 (0=CONTINUE), header_mutation=2,
 HeaderMutation:     set_headers(repeated HeaderValueOption)=1,
                     remove_headers(repeated string)=2
 HeaderValueOption:  header(HeaderValue)=1, append_action=3
-                    (1=OVERWRITE_IF_EXISTS_OR_ADD)
+                    (2=OVERWRITE_IF_EXISTS_OR_ADD; 1 is ADD_IF_ABSENT,
+                    which would let a client-supplied routing header win
+                    over the EPP's pick — never use it for mutations)
 ImmediateResponse:  status(HttpStatus{code=1})=1, headers=2, body=3,
                     details=5
 """
@@ -175,7 +177,11 @@ def _header_value(key: str, value: str) -> bytes:
 def _header_mutation(set_headers: dict[str, str], remove: list[str]) -> bytes:
     out = b""
     for k, v in set_headers.items():
-        opt = _len_field(1, _header_value(k, v)) + _varint_field(3, 1)
+        # append_action=2 (OVERWRITE_IF_EXISTS_OR_ADD): the EPP's routing
+        # headers (x-gateway-destination-endpoint, x-request-id, P/D pairing)
+        # must replace any client-sent value, or a client could steer the
+        # request to an arbitrary host:port on the original_dst cluster.
+        opt = _len_field(1, _header_value(k, v)) + _varint_field(3, 2)
         out += _len_field(1, opt)
     for k in remove:
         out += _len_field(2, k.encode())

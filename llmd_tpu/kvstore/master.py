@@ -157,6 +157,21 @@ class MasterState:
             return False
         prev = self.objects.get(key)
         if prev is not None:
+            if prev.segment_id == segment_id:
+                # Idempotent re-put from the owning segment (page
+                # re-offloaded after local eviction while the registration
+                # outlived it): accepting keeps the caller from dropping
+                # the only live copy the master still points readers at.
+                # Treat it as a fresh store: MRU position + soft-pin
+                # refresh, or the just-rewritten copy would be the top
+                # eviction candidate.
+                now = time.monotonic()
+                prev.nbytes = nbytes
+                prev.stored_at = now
+                if soft_pin:
+                    prev.soft_pin_until = now + self.soft_pin_ttl_s
+                self.objects.move_to_end(key)
+                return True
             # First copy wins (content-addressed: replicas are identical);
             # the new copy is redundant, tell the caller to drop it.
             return False

@@ -260,3 +260,36 @@ def test_pb_roundtrip_wire_compat():
     assert resp.kind == "immediate_response"
     assert resp.immediate_status == 429
     assert resp.set_headers == {"x-r": "full"}
+
+
+def test_pb_header_mutation_overwrites_client_headers():
+    """Every HeaderValueOption must carry append_action=2
+    (OVERWRITE_IF_EXISTS_OR_ADD). With 1 (ADD_IF_ABSENT) a client-sent
+    x-gateway-destination-endpoint would win over the EPP's pick and
+    steer the request to an attacker-chosen host:port on the
+    original_dst cluster."""
+    out = pb.encode_common_response(
+        "request_body",
+        set_headers={"x-gateway-destination-endpoint": "10.0.0.1:8000"},
+    )
+    # Walk: ProcessingResponse -> BodyResponse(3) -> CommonResponse(1)
+    # -> header_mutation(2) -> HeaderValueOption(1) -> append_action(3).
+    actions = []
+
+    def walk_option(opt: bytes) -> None:
+        for f, w, v in pb.iter_fields(opt):
+            if f == 3 and w == 0:
+                actions.append(v)
+
+    for f, _, v in pb.iter_fields(out):
+        assert f == 3  # request_body BodyResponse
+        for f2, _, v2 in pb.iter_fields(v):
+            if f2 != 1:
+                continue
+            for f3, _, v3 in pb.iter_fields(v2):
+                if f3 != 2:
+                    continue
+                for f4, _, v4 in pb.iter_fields(v3):
+                    if f4 == 1:
+                        walk_option(v4)
+    assert actions == [2], actions

@@ -80,6 +80,26 @@ def test_put_locate_pull_across_clients(master):
     assert master.state.stats()["objects"] == 0
 
 
+def test_reput_from_owning_segment_is_idempotent(master):
+    """A page re-offloaded after local eviction (registration outlived the
+    master record's view) must NOT drop the only live copy: the master
+    accepts a re-put from the segment its record already points at."""
+    c = CrossSliceStoreClient(master.url, segment_bytes=1 << 20, heartbeat_s=0.2)
+    other = CrossSliceStoreClient(master.url, segment_bytes=1 << 20, heartbeat_s=0.2)
+    try:
+        assert c.put("obj", b"first copy")
+        # Same segment re-puts: accepted, bytes stay registered locally.
+        assert c.put("obj", b"first copy")
+        assert c.get("obj") == b"first copy"
+        assert master.state.stats()["objects"] == 1
+        # A different segment is still rejected (first copy wins).
+        assert not other.put("obj", b"first copy")
+        assert other.get("obj") == b"first copy"
+    finally:
+        c.close()
+        other.close()
+
+
 def test_watermark_eviction_reaches_owner(master):
     master.state.high_watermark = 0.5
     master.state.eviction_ratio = 0.5
