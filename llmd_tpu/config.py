@@ -39,6 +39,12 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     max_model_len: int = 8192
     dtype: str = "bfloat16"
+    # Weight quantization: None (full precision) | "int8" (symmetric
+    # per-output-channel weights + dynamic per-token activations, native
+    # int8 MXU matmuls — the TPU stand-in for the reference's FP8 DeepGEMM
+    # serving path, docker/Dockerfile.cuda:69-70). Norms, embeddings,
+    # routers, and biases stay full precision.
+    quantization: str | None = None
     tie_word_embeddings: bool = False
     # Qwen2-style attention bias on QKV projections.
     attention_bias: bool = False
@@ -84,6 +90,11 @@ class ModelConfig:
     v_head_dim: int = 128
 
     def __post_init__(self) -> None:
+        if self.quantization not in (None, "int8"):
+            raise ValueError(
+                f"quantization={self.quantization!r} not supported "
+                "(None or 'int8')"
+            )
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_heads
         if self.moe_intermediate_size is None:

@@ -166,3 +166,15 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 def param_dtype(cfg: ModelConfig):
     return jnp.dtype(cfg.dtype)
+
+
+def pdot(x: jax.Array, lp: dict, name: str) -> jax.Array:
+    """``x @ lp[name]``, transparently taking the int8 path when the param
+    tree carries a ``<name>_scale`` (see llmd_tpu.ops.quant): the weight
+    streams from HBM as int8 and multiplies on the MXU natively."""
+    scale = lp.get(name + "_scale")
+    if scale is None:
+        return x @ lp[name]
+    from llmd_tpu.ops.quant import qdot
+
+    return qdot(x, lp[name], scale)

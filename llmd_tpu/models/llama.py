@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 
 from llmd_tpu.config import ModelConfig
-from llmd_tpu.models.common import StepInput, apply_rope, param_dtype, rms_norm, rope_tables
+from llmd_tpu.models.common import (
+    StepInput, apply_rope, param_dtype, pdot, rms_norm, rope_tables,
+)
 from llmd_tpu.models.moe import moe_block
 from llmd_tpu.ops import paged_attention_full, write_kv_pages_full
 
@@ -119,12 +121,19 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         params["dense_layers"] = layer_stack(n_dense, moe=False, prefix="dense_")
     if not cfg.tie_word_embeddings:
         params["lm_head"] = mk("lm_head", (H, V))
+    if cfg.quantization == "int8":
+        from llmd_tpu.ops.quant import quantize_param_tree
+
+        # ONE jitted call with the bf16 tree donated: eager per-tensor
+        # quantization leaves the device arena fragmented enough that the
+        # first big prefill later OOMs (observed on v5e at 3B scale).
+        params = jax.jit(quantize_param_tree, donate_argnums=0)(params)
     return params
 
 
 def _mlp(h: jax.Array, lp: dict) -> jax.Array:
-    gate = jax.nn.silu(h @ lp["w_gate"])
-    return (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(pdot(h, lp, "w_gate"))
+    return pdot(gate * pdot(h, lp, "w_up"), lp, "w_down")
 
 
 def forward_hidden(
@@ -168,9 +177,9 @@ def forward_hidden(
             )
             x = x + attn_out
         else:
-            q = h @ lp["wq"]
-            k = h @ lp["wk"]
-            v = h @ lp["wv"]
+            q = pdot(h, lp, "wq")
+            k = pdot(h, lp, "wk")
+            v = pdot(h, lp, "wv")
             if cfg.attention_bias:
                 q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
             if cfg.num_lora_adapters and inp.lora_ids is not None:
@@ -205,7 +214,7 @@ def forward_hidden(
                 q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
                 sm_scale, world_size=world_size, mesh=mesh,
             )
-            x = x + attn.reshape(B, Q, Nq * D) @ lp["wo"]
+            x = x + pdot(attn.reshape(B, Q, Nq * D), lp, "wo")
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
         if use_moe:
             if moe_backend == "ep":
@@ -261,5 +270,6 @@ def forward_hidden(
 
 def compute_logits(params: dict, hidden: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Project hidden states [N, H] -> logits [N, V] (f32 for sampling)."""
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (hidden @ head).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        return (hidden @ params["embed"].T).astype(jnp.float32)
+    return pdot(hidden, params, "lm_head").astype(jnp.float32)

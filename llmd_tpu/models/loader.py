@@ -407,6 +407,14 @@ def load_params(
             "checkpoint tensors not mapped (%d): %s%s",
             len(unused), sorted(unused)[:8], " ..." if len(unused) > 8 else "",
         )
+    if cfg.quantization == "int8":
+        # Post-load quantization (the reference ships pre-quantized FP8
+        # checkpoints; TPU INT8 quantizes the bf16 checkpoint at load).
+        # Host-side numpy: the bf16 tree must never be materialized on one
+        # device — big models only fit AFTER tp-sharding the int8 leaves.
+        from llmd_tpu.ops.quant import quantize_param_tree_host
+
+        params = quantize_param_tree_host(params)
     return params
 
 

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from llmd_tpu.config import ModelConfig
 from llmd_tpu.models.common import (
-    StepInput, apply_rope, rms_norm, rope_tables, yarn_sm_scale_mult,
+    StepInput, apply_rope, pdot, rms_norm, rope_tables, yarn_sm_scale_mult,
 )
 from llmd_tpu.ops import mla_paged_attention_full, write_kv_pages_full
 
@@ -55,15 +55,18 @@ def mla_attention(
 
     # ---- queries
     if cfg.q_lora_rank > 0:
-        q = rms_norm(h @ lp["wq_a"], lp["q_norm"], cfg.rms_norm_eps) @ lp["wq_b"]
+        q = pdot(
+            rms_norm(pdot(h, lp, "wq_a"), lp["q_norm"], cfg.rms_norm_eps),
+            lp, "wq_b",
+        )
     else:
-        q = h @ lp["wq"]
+        q = pdot(h, lp, "wq")
     q = q.reshape(B, Q, nh, nope + rope)
     q_nope, q_pe = q[..., :nope], q[..., nope:]
     q_pe = apply_rope(q_pe, cos, sin)
 
     # ---- latent (the cached row)
-    kv_a = h @ lp["wkv_a"]  # [B, Q, rank + rope]
+    kv_a = pdot(h, lp, "wkv_a")  # [B, Q, rank + rope]
     c_kv = rms_norm(kv_a[..., :rank], lp["kv_norm"], cfg.rms_norm_eps)
     k_pe = apply_rope(kv_a[..., None, rank:], cos, sin)[:, :, 0]  # shared head
     latent = jnp.concatenate([c_kv, k_pe], axis=-1)
@@ -95,7 +98,7 @@ def mla_attention(
         rank=rank, sm_scale=sm_scale, world_size=world_size, mesh=mesh,
     )  # [B, Q, nh, rank]
     out = jnp.einsum("bqhr,hrv->bqhv", out_lat, w_uv)  # [B, Q, nh, vd]
-    return out.reshape(B, Q, nh * vd) @ lp["wo"], cache
+    return pdot(out.reshape(B, Q, nh * vd), lp, "wo"), cache
 
 
 def mla_reference_attention(

@@ -82,8 +82,17 @@ def router_topk(
 def shared_expert_ffn(ht: jax.Array, lp: dict) -> jax.Array:
     """DeepSeek/Qwen2-MoE always-on shared expert (one place, three
     backends: dense / grouped / EP)."""
-    g = jax.nn.silu(ht @ lp["ws_gate"])
-    return (g * (ht @ lp["ws_up"])) @ lp["ws_down"]
+    from llmd_tpu.models.common import pdot
+
+    g = jax.nn.silu(pdot(ht, lp, "ws_gate"))
+    return pdot(g * pdot(ht, lp, "ws_up"), lp, "ws_down")
+
+
+def _expert_scales(lp: dict) -> tuple | None:
+    """(gate, up, down) channel scales when the experts are int8."""
+    if "we_gate_scale" not in lp:
+        return None
+    return (lp["we_gate_scale"], lp["we_up_scale"], lp["we_down_scale"])
 
 
 def moe_block_grouped(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
@@ -99,7 +108,8 @@ def moe_block_grouped(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
         ht, lp["router"], cfg.num_experts_per_tok, cfg, lp.get("router_bias")
     )
     out = moe_apply_grouped(
-        ht, weights, ids, lp["we_gate"], lp["we_up"], lp["we_down"]
+        ht, weights, ids, lp["we_gate"], lp["we_up"], lp["we_down"],
+        scales=_expert_scales(lp),
     ).astype(h.dtype)
     if cfg.shared_expert_intermediate_size:
         out = out + shared_expert_ffn(ht, lp)
@@ -117,12 +127,30 @@ def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     combine = jnp.zeros((T, E), jnp.float32)
     combine = combine.at[jnp.arange(T)[:, None], ids].add(weights)
 
-    # All experts on all tokens; contributions weighted by combine.
-    gate = jax.nn.silu(jnp.einsum("th,ehf->etf", ht, lp["we_gate"]))
-    up = jnp.einsum("th,ehf->etf", ht, lp["we_up"])
-    per_expert = jnp.einsum("etf,efh->eth", gate * up, lp["we_down"])  # [E,T,H]
-    out = jnp.einsum("eth,te->th", per_expert.astype(jnp.float32), combine)
-    out = out.astype(h.dtype)
+    # All experts on all tokens, the combine folded into the down
+    # projection: weighting gate*up by combine[t, e] BEFORE contracting is
+    # linearly equivalent to weighting per-expert outputs after, but
+    # collapses combine+down-proj into ONE dot contracting {e, f}. With
+    # experts EP-sharded over (dp, tp), GSPMD partitions that as a local
+    # GEMM + psum over the expert axis; the old [E, T, H] per-expert
+    # intermediate instead forced an involuntary full rematerialization
+    # (all-gather of expert activations) every MoE layer.
+    we_gate, we_up, we_down = lp["we_gate"], lp["we_up"], lp["we_down"]
+    if "we_gate_scale" in lp:
+        # Dense combine is the numerics oracle / GSPMD-fallback path:
+        # dequantize in place (the serving int8 paths are grouped/EP).
+        from llmd_tpu.ops.quant import dequantize
+
+        we_gate = dequantize(we_gate, lp["we_gate_scale"], dtype=ht.dtype)
+        we_up = dequantize(we_up, lp["we_up_scale"], dtype=ht.dtype)
+        we_down = dequantize(we_down, lp["we_down_scale"], dtype=ht.dtype)
+    gate = jax.nn.silu(jnp.einsum("th,ehf->etf", ht, we_gate))
+    up = jnp.einsum("th,ehf->etf", ht, we_up)
+    act = gate * up * combine.T[:, :, None].astype(gate.dtype)
+    out = jnp.einsum(
+        "etf,efh->th", act, we_down,
+        preferred_element_type=jnp.float32,
+    ).astype(h.dtype)
 
     if cfg.shared_expert_intermediate_size:
         out = out + shared_expert_ffn(ht, lp)

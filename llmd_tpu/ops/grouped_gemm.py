@@ -75,10 +75,20 @@ def grouped_matmul(
 def expert_mlp_grouped(
     x_sorted: jax.Array,     # [T', H] rows sorted by expert
     group_sizes: jax.Array,  # [E]
-    we_gate: jax.Array,      # [E, H, F]
+    we_gate: jax.Array,      # [E, H, F] (bf16, or int8 with scales)
     we_up: jax.Array,        # [E, H, F]
     we_down: jax.Array,      # [E, F, H]
+    scales: tuple | None = None,  # int8 experts: (s_gate [E,F], s_up [E,F], s_down [E,H])
 ) -> jax.Array:              # [T', H]
+    if scales is not None:
+        from llmd_tpu.ops.quant import grouped_matmul_q
+
+        s_gate, s_up, s_down = scales
+        gate = jax.nn.silu(grouped_matmul_q(x_sorted, we_gate, s_gate, group_sizes))
+        up = grouped_matmul_q(x_sorted, we_up, s_up, group_sizes)
+        return grouped_matmul_q(
+            (gate * up).astype(x_sorted.dtype), we_down, s_down, group_sizes
+        )
     gate = jax.nn.silu(grouped_matmul(x_sorted, we_gate, group_sizes))
     up = grouped_matmul(x_sorted, we_up, group_sizes)
     return grouped_matmul((gate * up).astype(x_sorted.dtype), we_down, group_sizes)
@@ -91,6 +101,7 @@ def moe_apply_grouped(
     we_gate: jax.Array,
     we_up: jax.Array,
     we_down: jax.Array,
+    scales: tuple | None = None,
 ) -> jax.Array:          # [T, H] f32
     """Route -> sort-by-expert -> grouped MLP -> weighted unsort-combine."""
     T, H = ht.shape
@@ -101,7 +112,7 @@ def moe_apply_grouped(
     tok = order // k                                 # source token per slot
     xs = ht[tok]                                     # [T*k, H]
     group_sizes = jnp.bincount(flat_ids, length=E)
-    ys = expert_mlp_grouped(xs, group_sizes, we_gate, we_up, we_down)
+    ys = expert_mlp_grouped(xs, group_sizes, we_gate, we_up, we_down, scales=scales)
     w_sorted = weights.reshape(-1)[order]
     return (
         jnp.zeros((T, H), jnp.float32)

@@ -1,0 +1,159 @@
+"""INT8 weight quantization: per-channel scales, native int8 MXU matmuls.
+
+The reference serves its flagship DeepSeek-R1 path FP8 end-to-end (DeepGEMM
+`--moe-backend deep_gemm`, reference docker/Dockerfile.cuda:69-70; wide-ep
+decode.yaml:128) — quantized weights are how it reaches its headline
+tok/s/chip. TPU v5e/v6e have no FP8 MXU mode; the native low-precision
+path is INT8 (2x bf16 MXU throughput, half the HBM bytes — decode is
+weight-streaming bound, so bytes are the whole game).
+
+Scheme (standard W8A8 dynamic quantization):
+
+- weights: symmetric per-output-channel int8. ``w_q[..., i, o] =
+  round(w / s_w[o])`` with ``s_w = max|w|/127`` reduced over the
+  contraction axis. Scales live next to the weight in the param tree as
+  ``<name>_scale`` (f32), sharded like the weight's output axis.
+- activations: symmetric per-token (per-row) int8, quantized on the fly
+  (amax over the feature axis — a cheap VPU reduction XLA fuses).
+- matmul: ``int8 x int8 -> int32`` via ``lax.dot_general`` — one native
+  MXU pass — then one fused rescale ``int32 * s_a * s_w -> bf16``.
+
+Under tensor parallelism this is exact-by-construction: a row-parallel
+contraction computes the GLOBAL amax first (psum-max over the sharded
+feature axis, [*, 1] — negligible traffic), so every shard quantizes
+against the same scale and the int32 partials add correctly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-30
+
+
+def quantize_weight(w: jax.Array, contract_axis: int = -2) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization.
+
+    ``w`` is ``[..., I, O]`` with the contraction (input) axis at
+    ``contract_axis``; returns ``(q int8 same-shape, scale f32)`` where
+    ``scale`` is ``w.shape`` minus the contraction axis.
+    """
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=contract_axis)
+    scale = jnp.maximum(amax, _EPS) / 127.0
+    q = jnp.round(wf / jnp.expand_dims(scale, contract_axis))
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+# Param-tree leaves that quantize (all [..., I, O] matmul weights on the
+# serving hot path). Excluded on purpose: embed (gather table), norms,
+# router + bias (tiny, routing-accuracy sensitive), LoRA factors (tiny,
+# per-adapter), and MLA's wkv_b (re-sliced into absorbed W_uk/W_uv
+# einsums — per-channel scales don't survive the reshape).
+QUANT_NAMES = frozenset({
+    "wq", "wk", "wv", "wo",
+    "w_gate", "w_up", "w_down",
+    "ws_gate", "ws_up", "ws_down",
+    "we_gate", "we_up", "we_down",
+    "wq_a", "wq_b", "wkv_a",
+    "lm_head",
+})
+
+
+def quantize_param_tree(params: dict) -> dict:
+    """Quantize every QUANT_NAMES leaf in a model param tree, adding a
+    sibling ``<name>_scale`` f32 leaf (the layout pdot/shard_params read)."""
+    out: dict = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out[k] = quantize_param_tree(v)
+        elif k in QUANT_NAMES:
+            q, s = quantize_weight(v)
+            out[k] = q
+            out[k + "_scale"] = s
+        else:
+            out[k] = v
+    return out
+
+
+def quantize_param_tree_host(params: dict) -> dict:
+    """Numpy variant of quantize_param_tree for checkpoint loading: the
+    bf16 tree never touches a device, so models that only fit when
+    tp-sharded (the main audience for int8) quantize on host and then
+    shard the int8 leaves directly."""
+    import numpy as np
+
+    out: dict = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out[k] = quantize_param_tree_host(v)
+        elif k in QUANT_NAMES:
+            wf = np.asarray(v, dtype=np.float32)
+            amax = np.max(np.abs(wf), axis=-2)
+            scale = np.maximum(amax, _EPS) / 127.0
+            q = np.clip(
+                np.round(wf / np.expand_dims(scale, -2)), -127, 127
+            ).astype(np.int8)
+            out[k] = q
+            out[k + "_scale"] = scale.astype(np.float32)
+        else:
+            out[k] = v
+    return out
+
+
+def quantize_activations(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row (last-axis) dynamic int8: returns (x_q int8, scale [..., 1] f32)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, _EPS) / 127.0
+    xq = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return xq, scale
+
+
+def qdot(x: jax.Array, w_q: jax.Array, w_scale: jax.Array) -> jax.Array:
+    """``x @ dequant(w_q)`` without ever materializing the dequantized
+    weight: dynamic-quantize ``x`` per row, int8 MXU matmul, fused rescale.
+
+    x: [..., I] (any leading dims); w_q: int8 [I, O]; w_scale: f32 [O].
+    Returns [..., O] in x.dtype (f32 accumulation throughout).
+    """
+    xq, a_scale = quantize_activations(x)
+    acc = jax.lax.dot_general(
+        xq, w_q,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * a_scale * w_scale).astype(x.dtype)
+
+
+def dequantize(w_q: jax.Array, scale: jax.Array, contract_axis: int = -2,
+               dtype=jnp.bfloat16) -> jax.Array:
+    """Materialize the full-precision weight (oracle paths / tests only —
+    the serving matmuls go through qdot and never pay these bytes)."""
+    return (
+        w_q.astype(jnp.float32) * jnp.expand_dims(scale, contract_axis)
+    ).astype(dtype)
+
+
+def grouped_matmul_q(
+    x: jax.Array,            # [T, K_dim] rows sorted by group
+    w_q: jax.Array,          # int8 [G, K_dim, N]
+    w_scale: jax.Array,      # f32 [G, N]
+    group_sizes: jax.Array,  # [G] i32, sums to T
+) -> jax.Array:              # [T, N] in x.dtype
+    """Quantized grouped GEMM (the DeepGEMM-FP8 role on TPU): each group's
+    int8 expert weight multiplies only its routed rows via ragged_dot,
+    rescaled per row by (activation scale x its group's channel scales)."""
+    T = x.shape[0]
+    G = w_q.shape[0]
+    xq, a_scale = quantize_activations(x)
+    acc = jax.lax.ragged_dot(
+        xq, w_q, group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.int32,
+    )
+    gid = jnp.repeat(
+        jnp.arange(G, dtype=jnp.int32), group_sizes, total_repeat_length=T
+    )
+    out = acc.astype(jnp.float32) * a_scale * w_scale[gid]
+    return out.astype(x.dtype)

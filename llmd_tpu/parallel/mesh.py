@@ -162,6 +162,12 @@ def param_specs(params: dict) -> dict:
     """PartitionSpec tree matching a model param tree."""
 
     def spec_for(name: str) -> P:
+        if name.endswith("_scale"):
+            # int8 channel scales (llmd_tpu.ops.quant): the weight's shape
+            # minus its contraction (-2) axis, so the spec is the base
+            # weight's spec with that axis dropped.
+            base = spec_for(name[: -len("_scale")])
+            return P(*base[:-2], base[-1])
         if name not in PARAM_SPECS:
             raise KeyError(f"no sharding rule for param {name!r}")
         return PARAM_SPECS[name]
@@ -176,10 +182,27 @@ def param_specs(params: dict) -> dict:
 
 
 def shard_params(params: dict, ctx: MeshContext) -> dict:
+    """Place a param tree onto the mesh per PARAM_SPECS.
+
+    Single-process: plain device_put. Multi-host (jax.distributed world,
+    mesh spanning processes): every host holds the full tree on host
+    memory (deterministic init / every host reads the checkpoint — the
+    reference's LWS ranks do the same HF download per pod), and each
+    process contributes the shards its local devices own via
+    make_array_from_callback; no host ever transfers non-addressable data.
+    """
     specs = param_specs(params)
+    multihost = jax.process_count() > 1
+
+    def put(x, s):
+        sharding = ctx.sharding(*s)
+        if not multihost:
+            return jax.device_put(x, sharding)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx, arr=arr: arr[idx]
+        )
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, ctx.sharding(*s)),
-        params,
-        specs,
-        is_leaf=lambda x: not isinstance(x, dict),
+        put, params, specs, is_leaf=lambda x: not isinstance(x, dict)
     )

@@ -72,45 +72,53 @@ def moe_block_ep(
     local = functools.partial(
         _moe_ep_local, cfg=cfg, W=W, C=C, axes=axes
     )
-    has_shared = bool(cfg.shared_expert_intermediate_size)
-    specs = dict(
-        router=P(None, None),
-        we_gate=P(("dp", "tp"), None, None),
-        we_up=P(("dp", "tp"), None, None),
-        we_down=P(("dp", "tp"), None, None),
-    )
-    bias = lp.get("router_bias")
-    if bias is None:
-        bias = jnp.zeros((E,), jnp.float32)
-    args = [lp["router"], bias, lp["we_gate"], lp["we_up"], lp["we_down"]]
-    in_specs = [EP_SPEC, specs["router"], P(None),
-                specs["we_gate"], specs["we_up"], specs["we_down"]]
-    if has_shared:
-        args += [lp["ws_gate"], lp["ws_up"], lp["ws_down"]]
-        in_specs += [P(None, None), P(None, None), P(None, None)]
+    # Per-param specs: experts (and their int8 channel scales) sharded over
+    # the flattened EP axes; router + shared expert replicated. Passing a
+    # dict through shard_map keeps the bf16 and int8 layouts in one code
+    # path — the scale leaves just ride along when present.
+    ep = P(("dp", "tp"))
+    specs_by_name = {
+        "router": P(None, None), "router_bias": P(None),
+        "we_gate": P(ep[0], None, None), "we_up": P(ep[0], None, None),
+        "we_down": P(ep[0], None, None),
+        "we_gate_scale": P(ep[0], None), "we_up_scale": P(ep[0], None),
+        "we_down_scale": P(ep[0], None),
+        "ws_gate": P(None, None), "ws_up": P(None, None),
+        "ws_down": P(None, None),
+        "ws_gate_scale": P(None), "ws_up_scale": P(None),
+        "ws_down_scale": P(None),
+    }
+    sub = {k: lp[k] for k in specs_by_name if k in lp}
+    if not cfg.shared_expert_intermediate_size:
+        for k in list(sub):
+            if k.startswith("ws_"):
+                del sub[k]
+    if "router_bias" not in sub:
+        sub["router_bias"] = jnp.zeros((E,), jnp.float32)
     out = shard_map(
         local,
         mesh=mesh,
-        in_specs=tuple(in_specs),
+        in_specs=(EP_SPEC, {k: specs_by_name[k] for k in sub}),
         out_specs=EP_SPEC,
         check_vma=False,
-    )(ht, *args)
+    )(ht, sub)
     return out[:T].reshape(B, Q, H)
 
 
 def _moe_ep_local(
-    ht, router, router_bias, we_gate, we_up, we_down, *shared,
-    cfg: ModelConfig, W: int, C: int, axes
+    ht, p: dict, *, cfg: ModelConfig, W: int, C: int, axes
 ):
     """Per-shard body: route -> dispatch a2a -> local experts -> combine a2a.
 
-    ht: [t, H] local tokens; we_*: [E_loc, ...] local experts.
+    ht: [t, H] local tokens; p holds this shard's params (we_*: [E_loc, ...]
+    local experts, plus their channel scales when int8-quantized).
     """
     t, H = ht.shape
     E, k = cfg.num_experts, cfg.num_experts_per_tok
     E_loc = E // W
+    we_gate, we_up, we_down = p["we_gate"], p["we_up"], p["we_down"]
 
-    weights, ids = router_topk(ht, router, k, cfg, router_bias)  # [t, k]
+    weights, ids = router_topk(ht, p["router"], k, cfg, p["router_bias"])  # [t, k]
     flat_ids = ids.reshape(-1)  # [tk]
     dest = flat_ids // E_loc  # destination shard per slot
     e_local = flat_ids % E_loc  # expert index on that shard
@@ -147,8 +155,11 @@ def _moe_ep_local(
 
     order = jnp.argsort(er)
     group_sizes = jnp.bincount(er, length=E_loc)
+    scales = None
+    if "we_gate_scale" in p:
+        scales = (p["we_gate_scale"], p["we_up_scale"], p["we_down_scale"])
     ys = expert_mlp_grouped(
-        xr[order], group_sizes, we_gate, we_up, we_down
+        xr[order], group_sizes, we_gate, we_up, we_down, scales=scales
     )
     yr = (
         jnp.zeros_like(xr).at[order].set(ys)
@@ -165,11 +176,8 @@ def _moe_ep_local(
         (gathered.astype(jnp.float32) * w_flat).reshape(t, k, H), axis=1
     ).astype(ht.dtype)
 
-    if shared:
+    if "ws_gate" in p:
         from llmd_tpu.models.moe import shared_expert_ffn
 
-        ws_gate, ws_up, ws_down = shared
-        y = y + shared_expert_ffn(
-            ht, {"ws_gate": ws_gate, "ws_up": ws_up, "ws_down": ws_down}
-        )
+        y = y + shared_expert_ffn(ht, p)
     return y
