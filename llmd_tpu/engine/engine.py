@@ -71,6 +71,21 @@ class LLMEngine:
         event_sink: KVEventSink | None = None,
     ) -> None:
         self.config = config
+        import jax
+
+        if jax.process_count() > 1 and (
+            (config.offload is not None and config.offload.enabled)
+            or config.kv_role
+        ):
+            # Fail at startup, not mid-request: these features stage HBM
+            # pages through ONE host's process-local device path, which a
+            # leader-only dispatch over a multi-host mesh cannot do (the
+            # cross-slice KV store is the multi-host KV plane; see
+            # deploy/guides/wide-ep-lws/README.md scope notes).
+            raise NotImplementedError(
+                "kv_role / tiered offload are not supported in multi-host "
+                "mode; use the cross-slice KV store for the KV plane"
+            )
         self.ctx = mesh_ctx or build_mesh(config.parallel)
         # Tiered offload wraps the event sink (device evictions of host-held
         # pages downgrade to cpu-tier stores instead of removals).
@@ -227,7 +242,9 @@ class LLMEngine:
             return self.runner.run_embed(prompts, lora_id=lora_id)
 
     def close(self) -> None:
-        """Release network-facing resources (KV connector, store client)."""
+        """Release network-facing resources (KV connector, store client)
+        and, in a multi-host world, release the follower processes."""
+        self.runner.stop_followers()
         if self.kv_connector is not None:
             self.kv_connector.close()
         if self._kvstore_client is not None:

@@ -1,5 +1,14 @@
 """Model runner: owns device state (params + KV pool) and the jitted steps.
 
+Multi-host (reference wide-EP LWS shape, docs/infrastructure/
+multi-node.md:3-41): when ``jax.distributed`` is initialized with >1
+process, ONE runner spans the global mesh. The leader (process 0) runs the
+scheduler and broadcasts each step's host inputs (fixed-size header + array
+payload via ``multihost_utils.broadcast_one_to_all``); followers sit in
+``follower_loop`` mirroring every dispatch so all processes execute the
+same XLA programs in lockstep — the property a real LWS deployment relies
+on. Sampled tokens come back replicated so every host reads them locally.
+
 TPU-first scheduling shapes (everything static per bucket, traced once):
 
 - **batched prefill**: all scheduled prompt chunks run in ONE call
@@ -35,7 +44,12 @@ from llmd_tpu.engine.sampler import SamplingInputs, sample_tokens
 from llmd_tpu.engine.scheduler import ScheduledSeq
 from llmd_tpu.models import llama
 from llmd_tpu.models.common import StepInput
+from llmd_tpu.parallel import distributed as dist
 from llmd_tpu.parallel.mesh import MeshContext, kv_cache_spec, shard_params
+
+# Multi-host dispatch opcodes (fixed-size i32 header broadcast leader ->
+# followers before each step's array payload).
+_OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
 
 
 def _buckets(limit: int, start: int = 8) -> tuple[int, ...]:
@@ -96,6 +110,7 @@ class ModelRunner:
                 params = llama.init_params(self.cfg, jax.random.key(config.seed))
         self.params = shard_params(params, mesh_ctx)
         self.kv_cache = self._alloc_kv()
+        self._multihost = dist.is_multihost()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
         sched = config.scheduler
@@ -145,7 +160,15 @@ class ModelRunner:
             spec = jax.sharding.PartitionSpec()
         else:
             spec = kv_cache_spec(shape[2], self.ctx.tp)
-        return jnp.zeros(shape, jnp.dtype(c.dtype), device=self.ctx.sharding(*spec))
+        sharding = self.ctx.sharding(*spec)
+        if dist.is_multihost():
+            # Global pool spanning processes: allocate via a jitted zeros
+            # so no host ever materializes (or addresses) the full array.
+            dt = jnp.dtype(c.dtype)
+            return jax.jit(
+                lambda: jnp.zeros(shape, dt), out_shardings=sharding
+            )()
+        return jnp.zeros(shape, jnp.dtype(c.dtype), device=sharding)
 
     def kv_bytes(self) -> int:
         return self.kv_cache.size * self.kv_cache.dtype.itemsize
@@ -162,6 +185,7 @@ class ModelRunner:
         adapter name before its weights load is safe; this is the hook
         checkpoint loading and dynamic adapter registration use.
         """
+        self._require_single_host("set_lora_weights")
         if not (0 < lora_id <= self.cfg.num_lora_adapters):
             raise ValueError(f"lora_id {lora_id} out of range")
         for a, b in (("la_q", "lb_q"), ("la_v", "lb_v")):
@@ -181,6 +205,13 @@ class ModelRunner:
             )
         self.params = {**self.params, "layers": layers}
 
+    def _replicate_out(self, packed: jax.Array) -> jax.Array:
+        """Multi-host: pin the packed host transfer to full replication so
+        every process can read it locally (single-host: no-op)."""
+        if not dist.is_multihost():
+            return packed
+        return jax.lax.with_sharding_constraint(packed, self.ctx.replicated)
+
     def _build_forward(self):
         cfg = self.cfg
         world = self.ctx.world
@@ -188,6 +219,7 @@ class ModelRunner:
         kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
+        replicate = self._replicate_out
 
         @functools.partial(
             jax.jit, donate_argnums=(1,), static_argnames=("all_greedy",)
@@ -207,7 +239,7 @@ class ModelRunner:
             packed = jnp.concatenate(
                 [tokens.astype(jnp.float32)[:, None], logprobs[:, None]], axis=1
             )
-            return kv_cache, packed
+            return kv_cache, replicate(packed)
 
         return fwd
 
@@ -218,6 +250,7 @@ class ModelRunner:
         kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
+        replicate = self._replicate_out
 
         @functools.partial(
             jax.jit, donate_argnums=(1,), static_argnames=("k_steps", "all_greedy")
@@ -277,7 +310,7 @@ class ModelRunner:
             packed = jnp.concatenate(
                 [out_t.astype(jnp.float32), out_l], axis=1
             )  # [B, 2K]
-            return kv_cache, packed
+            return kv_cache, replicate(packed)
 
         return multi
 
@@ -303,23 +336,24 @@ class ModelRunner:
                     seeds[i, j] = np.uint32((sp.seed * 1000003 + pos + j) & 0xFFFFFFFF)
         return temp, top_k, top_p, seeds
 
-    def _sampling_inputs(self, seqs, B) -> SamplingInputs:
-        temp, top_k, top_p, seeds = self._sampling_arrays(seqs, B, 1)
-        return SamplingInputs(
-            temperature=jnp.asarray(temp),
-            top_k=jnp.asarray(top_k),
-            top_p=jnp.asarray(top_p),
-            seeds=jnp.asarray(seeds[:, 0]),
-        )
-
-    def _lora_ids(self, seqs: list[ScheduledSeq], B: int):
-        """[B] adapter slots, or None for non-LoRA models (stable pytree)."""
-        if not self.cfg.num_lora_adapters:
-            return None
+    def _lora_array(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
+        """[B] adapter slots (pad rows 0 = base model) for the payload."""
         ids = np.zeros(B, np.int32)
         for i, s in enumerate(seqs):
             ids[i] = s.request.lora_id
-        return jnp.asarray(ids)
+        return ids
+
+    def _require_single_host(self, what: str) -> None:
+        """Paths not mirrored to followers must refuse loudly in a
+        multi-host world: a leader-only device program whose shardings
+        span follower-owned devices would deadlock the whole group."""
+        if self._multihost:
+            raise NotImplementedError(
+                f"{what} is not supported in multi-host mode (only the "
+                "prefill/decode serving steps are broadcast to follower "
+                "processes; see deploy/guides/wide-ep-lws/README.md scope "
+                "notes)"
+            )
 
     def _page_table(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
         pt = np.zeros((B, self.max_pages), np.int32)
@@ -330,10 +364,133 @@ class ModelRunner:
 
     @staticmethod
     def _unpack(packed: jax.Array, n: int, K: int = 1) -> StepResult:
-        arr = np.asarray(packed)  # the ONE host transfer
+        arr = dist.replicated_to_host(packed)  # the ONE host transfer
         tokens = arr[:n, :K].astype(np.int32)
         logprobs = arr[:n, K:].astype(np.float32)
         return StepResult(tokens, logprobs)
+
+    # ------------------------------------------------------------------ #
+    # multi-host lockstep dispatch (leader broadcasts, followers mirror)
+
+    def _payload_spec(self, op: int, B: int, QK: int):
+        """(name, shape, dtype) tuple layout for one op's array payload —
+        the contract both sides of the broadcast derive independently."""
+        mp = self.max_pages
+        if op == _OP_PREFILL:
+            spec = [
+                ("tokens", (B, QK), np.int32),
+                ("positions", (B, QK), np.int32),
+                ("qlens", (B,), np.int32),
+                ("kvlens", (B,), np.int32),
+                ("page_table", (B, mp), np.int32),
+                ("temp", (B,), np.float32),
+                ("top_k", (B,), np.int32),
+                ("top_p", (B,), np.float32),
+                ("seeds", (B,), np.uint32),
+            ]
+        else:
+            spec = [
+                ("first", (B,), np.int32),
+                ("start", (B,), np.int32),
+                ("page_table", (B, mp), np.int32),
+                ("active", (B,), np.uint8),
+                ("temp", (B,), np.float32),
+                ("top_k", (B,), np.int32),
+                ("top_p", (B,), np.float32),
+                ("seeds", (B, QK), np.uint32),
+            ]
+        if self.cfg.num_lora_adapters:
+            spec.append(("lora", (B,), np.int32))
+        return spec
+
+    def _sync(self, op: int, B: int, QK: int, greedy: bool, arrays: dict) -> dict:
+        """Leader leg: broadcast header + payload; identity single-host."""
+        if not self._multihost:
+            return arrays
+        from jax.experimental import multihost_utils as mhu
+
+        mhu.broadcast_one_to_all(
+            np.asarray([op, B, QK, int(greedy)], np.int32), is_source=True
+        )
+        spec = self._payload_spec(op, B, QK)
+        payload = tuple(
+            np.ascontiguousarray(arrays[name]).astype(dt, copy=False)
+            for name, _, dt in spec
+        )
+        payload = mhu.broadcast_one_to_all(payload, is_source=True)
+        return {name: arr for (name, _, _), arr in zip(spec, payload)}
+
+    def follower_loop(self) -> None:
+        """Run on every non-leader process: mirror the leader's dispatches
+        until a stop is broadcast. Blocks for the life of the deployment."""
+        from jax.experimental import multihost_utils as mhu
+
+        assert self._multihost and not dist.is_leader(), (
+            "follower_loop is for non-leader processes of a multi-host world"
+        )
+        while True:
+            hdr = mhu.broadcast_one_to_all(
+                np.zeros(4, np.int32), is_source=False
+            )
+            op, B, QK, greedy = (int(v) for v in np.asarray(hdr))
+            if op == _OP_STOP:
+                return
+            spec = self._payload_spec(op, B, QK)
+            zeros = tuple(np.zeros(shp, dt) for _, shp, dt in spec)
+            payload = mhu.broadcast_one_to_all(zeros, is_source=False)
+            arrays = {name: arr for (name, _, _), arr in zip(spec, payload)}
+            if op == _OP_PREFILL:
+                self._exec_prefill(arrays, bool(greedy))
+            else:
+                self._exec_decode(arrays, QK, bool(greedy))
+
+    def stop_followers(self) -> None:
+        if self._multihost and dist.is_leader():
+            from jax.experimental import multihost_utils as mhu
+
+            mhu.broadcast_one_to_all(
+                np.asarray([_OP_STOP, 0, 0, 0], np.int32), is_source=True
+            )
+
+    def _exec_prefill(self, arrays: dict, all_greedy: bool) -> jax.Array:
+        inp = StepInput(
+            token_ids=jnp.asarray(arrays["tokens"]),
+            positions=jnp.asarray(arrays["positions"]),
+            query_lens=jnp.asarray(arrays["qlens"]),
+            kv_lens=jnp.asarray(arrays["kvlens"]),
+            page_table=jnp.asarray(arrays["page_table"]),
+            lora_ids=(
+                jnp.asarray(arrays["lora"]) if "lora" in arrays else None
+            ),
+        )
+        s = SamplingInputs(
+            temperature=jnp.asarray(arrays["temp"]),
+            top_k=jnp.asarray(arrays["top_k"]),
+            top_p=jnp.asarray(arrays["top_p"]),
+            seeds=jnp.asarray(arrays["seeds"]),
+        )
+        self.kv_cache, packed = self._forward(
+            self.params, self.kv_cache, inp, s, all_greedy=all_greedy
+        )
+        return packed
+
+    def _exec_decode(self, arrays: dict, K: int, all_greedy: bool) -> jax.Array:
+        self.kv_cache, packed = self._multi(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(arrays["first"]),
+            jnp.asarray(arrays["start"]),
+            jnp.asarray(arrays["page_table"]),
+            jnp.asarray(arrays["active"].astype(bool)),
+            jnp.asarray(arrays["lora"]) if "lora" in arrays else None,
+            jnp.asarray(arrays["temp"]),
+            jnp.asarray(arrays["top_k"]),
+            jnp.asarray(arrays["top_p"]),
+            jnp.asarray(arrays["seeds"]),
+            k_steps=K,
+            all_greedy=all_greedy,
+        )
+        return packed
 
     # ------------------------------------------------------------------ #
     # KV page staging (the HBM<->host leg of the P/D transfer path;
@@ -345,6 +502,7 @@ class ModelRunner:
         Page count is padded to a bucket (ids repeat the last page) so XLA
         compiles one gather per bucket, not per transfer size.
         """
+        self._require_single_host("gather_pages (P/D HBM staging)")
         n = len(page_ids)
         bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
         ids = np.asarray(page_ids, np.int32)
@@ -369,6 +527,7 @@ class ModelRunner:
         n = len(page_ids)
         if n == 0:
             return
+        self._require_single_host("scatter_pages (P/D HBM staging)")
         if self.kv_rep > 1:
             # Expand canonical [.., K, ..] bundles to the local replicated
             # head layout.
@@ -395,6 +554,7 @@ class ModelRunner:
         over a throwaway KV scratch pool — embeddings never touch the
         serving cache, so this is safe to run concurrently with the step
         loop (params are read-only)."""
+        self._require_single_host("run_embed (/v1/embeddings)")
         if not prompts:
             return np.zeros((0, self.cfg.hidden_size), np.float32)
         maxlen = max(len(p) for p in prompts)
@@ -507,21 +667,18 @@ class ModelRunner:
             positions[i, m:] = start + max(m - 1, 0)
             qlens[i] = m
             kvlens[i] = start + m
-        inp = StepInput(
-            token_ids=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            query_lens=jnp.asarray(qlens),
-            kv_lens=jnp.asarray(kvlens),
-            page_table=jnp.asarray(self._page_table(seqs, B)),
-            lora_ids=self._lora_ids(seqs, B),
-        )
-        self.kv_cache, packed = self._forward(
-            self.params,
-            self.kv_cache,
-            inp,
-            self._sampling_inputs(seqs, B),
-            all_greedy=all(s.request.sampling.greedy for s in seqs),
-        )
+        temp, top_k, top_p, seeds = self._sampling_arrays(seqs, B, 1)
+        arrays = {
+            "tokens": tokens, "positions": positions, "qlens": qlens,
+            "kvlens": kvlens, "page_table": self._page_table(seqs, B),
+            "temp": temp, "top_k": top_k, "top_p": top_p,
+            "seeds": seeds[:, 0],
+        }
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = self._lora_array(seqs, B)
+        all_greedy = all(s.request.sampling.greedy for s in seqs)
+        arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
+        packed = self._exec_prefill(arrays, all_greedy)
         return self._unpack(packed, n)
 
     def run_decode(self, seqs: list[ScheduledSeq], k_steps: int = 1) -> StepResult:
@@ -530,28 +687,23 @@ class ModelRunner:
         B = pad_to_bucket(n, self.batch_buckets)
         first = np.zeros(B, np.int32)
         start = np.zeros(B, np.int32)
-        active = np.zeros(B, bool)
+        active = np.zeros(B, np.uint8)
         for i, s in enumerate(seqs):
             req = s.request
             first[i] = req.all_token_ids[req.num_computed_tokens]
             start[i] = req.num_computed_tokens
-            active[i] = True
+            active[i] = 1
         temp, top_k, top_p, seeds = self._sampling_arrays(seqs, B, k_steps)
-        self.kv_cache, packed = self._multi(
-            self.params,
-            self.kv_cache,
-            jnp.asarray(first),
-            jnp.asarray(start),
-            jnp.asarray(self._page_table(seqs, B)),
-            jnp.asarray(active),
-            self._lora_ids(seqs, B),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(seeds),
-            k_steps=k_steps,
-            all_greedy=all(s.request.sampling.greedy for s in seqs),
-        )
+        arrays = {
+            "first": first, "start": start,
+            "page_table": self._page_table(seqs, B), "active": active,
+            "temp": temp, "top_k": top_k, "top_p": top_p, "seeds": seeds,
+        }
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = self._lora_array(seqs, B)
+        all_greedy = all(s.request.sampling.greedy for s in seqs)
+        arrays = self._sync(_OP_DECODE, B, k_steps, all_greedy, arrays)
+        packed = self._exec_decode(arrays, k_steps, all_greedy)
         return self._unpack(packed, n, k_steps)
 
     # ------------------------------------------------------------------ #
@@ -587,39 +739,34 @@ class ModelRunner:
         return count
 
     def _warm_prefill(self, B: int, Q: int, all_greedy: bool = False) -> None:
-        inp = StepInput(
-            token_ids=jnp.zeros((B, Q), jnp.int32),
-            positions=jnp.zeros((B, Q), jnp.int32),
-            query_lens=jnp.zeros(B, jnp.int32),
-            kv_lens=jnp.zeros(B, jnp.int32),
-            page_table=jnp.zeros((B, self.max_pages), jnp.int32),
-            lora_ids=(
-                jnp.zeros(B, jnp.int32) if self.cfg.num_lora_adapters else None
-            ),
-        )
-        s = SamplingInputs(
-            temperature=jnp.zeros(B, jnp.float32),
-            top_k=jnp.zeros(B, jnp.int32),
-            top_p=jnp.ones(B, jnp.float32),
-            seeds=jnp.zeros(B, jnp.uint32),
-        )
-        self.kv_cache, _ = self._forward(
-            self.params, self.kv_cache, inp, s, all_greedy=all_greedy
-        )
+        arrays = {
+            "tokens": np.zeros((B, Q), np.int32),
+            "positions": np.zeros((B, Q), np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "page_table": np.zeros((B, self.max_pages), np.int32),
+            "temp": np.zeros(B, np.float32),
+            "top_k": np.zeros(B, np.int32),
+            "top_p": np.ones(B, np.float32),
+            "seeds": np.zeros(B, np.uint32),
+        }
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = np.zeros(B, np.int32)
+        arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
+        self._exec_prefill(arrays, all_greedy)
 
     def _warm_decode(self, B: int, K: int, all_greedy: bool = False) -> None:
-        self.kv_cache, _ = self._multi(
-            self.params,
-            self.kv_cache,
-            jnp.zeros(B, jnp.int32),
-            jnp.zeros(B, jnp.int32),
-            jnp.zeros((B, self.max_pages), jnp.int32),
-            jnp.zeros(B, bool),
-            jnp.zeros(B, jnp.int32) if self.cfg.num_lora_adapters else None,
-            jnp.zeros(B, jnp.float32),
-            jnp.zeros(B, jnp.int32),
-            jnp.ones(B, jnp.float32),
-            jnp.zeros((B, K), jnp.uint32),
-            k_steps=K,
-            all_greedy=all_greedy,
-        )
+        arrays = {
+            "first": np.zeros(B, np.int32),
+            "start": np.zeros(B, np.int32),
+            "page_table": np.zeros((B, self.max_pages), np.int32),
+            "active": np.zeros(B, np.uint8),
+            "temp": np.zeros(B, np.float32),
+            "top_k": np.zeros(B, np.int32),
+            "top_p": np.ones(B, np.float32),
+            "seeds": np.zeros((B, K), np.uint32),
+        }
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = np.zeros(B, np.int32)
+        arrays = self._sync(_OP_DECODE, B, K, all_greedy, arrays)
+        self._exec_decode(arrays, K, all_greedy)

@@ -1,0 +1,135 @@
+"""Multi-host runtime: jax.distributed wiring + cross-host array helpers.
+
+The reference spans hosts with an LWS (LeaderWorkerSet) deployment: the
+leader address and worker index arrive via environment variables and every
+rank joins one NCCL/Gloo world (reference guides/wide-ep-lws/modelserver/
+gpu/vllm/base/decode.yaml:105-121 — ``--data-parallel-address
+$(LWS_LEADER_ADDRESS)``, start-rank math; docs/infrastructure/
+multi-node.md:3-41). TPU-native, the equivalent world is
+``jax.distributed.initialize``: every host process joins one JAX runtime,
+``jax.devices()`` becomes the GLOBAL device list, and one
+``jax.sharding.Mesh`` over it makes XLA insert ICI/DCN collectives —
+there are no per-kind process groups to manage.
+
+Environment contract (first match wins):
+
+  coordinator  LLMD_COORDINATOR | LWS_LEADER_ADDRESS (port appended if
+               bare host; default port 8476)
+  world size   LLMD_NUM_PROCESSES | LWS_GROUP_SIZE
+  process id   LLMD_PROCESS_ID | LWS_WORKER_INDEX
+
+``maybe_initialize()`` is a no-op when no coordinator is configured, so
+single-host paths never pay for it.
+
+Cross-host data movement for the serving loop:
+
+- ``host_local_to_global(x, sharding)``: every process contributes its
+  process-local numpy rows -> one global jax.Array (the step-input leg).
+- ``replicated_to_host(x)``: fetch a fully-replicated global array to host
+  numpy (the sampled-token leg; every process holds a full copy, so this
+  is local).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+DEFAULT_COORD_PORT = 8476
+
+_initialized = False
+
+
+def _env(*names: str) -> str | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def coordinator_address() -> str | None:
+    """Coordinator host:port from the env contract, or None."""
+    addr = _env("LLMD_COORDINATOR", "LWS_LEADER_ADDRESS")
+    if addr is None:
+        return None
+    if ":" not in addr:
+        addr = f"{addr}:{DEFAULT_COORD_PORT}"
+    return addr
+
+
+def maybe_initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the jax.distributed world if one is configured; else no-op.
+
+    Explicit arguments win over the environment. Returns True when
+    running multi-process (after initialization), False for the
+    single-process default. Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator = coordinator or coordinator_address()
+    if coordinator is None:
+        return False
+    if num_processes is None:
+        v = _env("LLMD_NUM_PROCESSES", "LWS_GROUP_SIZE")
+        num_processes = int(v) if v else None
+    if process_id is None:
+        v = _env("LLMD_PROCESS_ID", "LWS_WORKER_INDEX")
+        process_id = int(v) if v else None
+    log.info(
+        "jax.distributed.initialize coordinator=%s num_processes=%s "
+        "process_id=%s", coordinator, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "joined distributed world: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.local_devices()), len(jax.devices()),
+    )
+    return jax.process_count() > 1
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def is_leader() -> bool:
+    return jax.process_index() == 0
+
+
+def host_local_to_global(x: np.ndarray, sharding) -> jax.Array:
+    """Assemble a global array from per-process host data.
+
+    ``x`` must be the full GLOBAL logical value on every process (the
+    serving loop broadcasts step inputs so all hosts trace/launch the
+    same program); each process contributes the shards it can address.
+    Single-process, this degrades to a plain device_put.
+    """
+    if not is_multihost():
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
+def replicated_to_host(arr: jax.Array) -> np.ndarray:
+    """Global-replicated jax.Array -> host numpy (addressable everywhere)."""
+    if not is_multihost():
+        return np.asarray(arr)
+    # Every process owns a replica shard; read the first addressable one.
+    shard = arr.addressable_shards[0]
+    return np.asarray(shard.data)

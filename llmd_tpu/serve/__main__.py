@@ -62,9 +62,16 @@ def make_engine_config(args, lora_adapters=None):
     from llmd_tpu.models.loader import config_from_hf, is_model_dir
     from llmd_tpu.models.registry import get_model_config
 
+    def _multihost_world() -> bool:
+        import jax
+
+        return jax.process_count() > 1
+
     overrides = {}
     if args.max_model_len is not None:
         overrides["max_model_len"] = args.max_model_len
+    if args.quantization:
+        overrides["quantization"] = args.quantization
     if lora_adapters:
         overrides["num_lora_adapters"] = len(lora_adapters)
         overrides["lora_rank"] = args.lora_rank
@@ -96,9 +103,13 @@ def make_engine_config(args, lora_adapters=None):
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
-            # Engine-process view: DP across processes is the supervisor's
-            # job; in-process the mesh is TP-only.
-            data_parallel_size=1,
+            # Single-process: DP across processes is the supervisor's job,
+            # so the in-process mesh is TP-only. In a jax.distributed
+            # world (mode B) ONE engine owns the global (dp, tp) mesh and
+            # --data-parallel-size is a real mesh axis.
+            data_parallel_size=(
+                args.data_parallel_size if _multihost_world() else 1
+            ),
             moe_backend=args.moe_backend,
         ),
         seed=args.seed,
@@ -137,6 +148,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-gpu-blocks-override", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="bfloat16")
+    p.add_argument(
+        "--quantization", default=None, choices=["int8"],
+        help="weight quantization (int8 W8A8; the vLLM --quantization "
+        "role — the reference serves its headline path FP8)",
+    )
     p.add_argument("--no-enable-prefix-caching", action="store_true")
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=2048)
@@ -194,6 +210,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--trace-file", default=None, help="JSONL span log path")
     p.add_argument("--trace-sample-ratio", type=float, default=0.1)
+    # Multi-host: join a jax.distributed world (reference LWS leader/worker
+    # shape, --data-parallel-address $LWS_LEADER_ADDRESS; here the env
+    # contract LLMD_COORDINATOR/LWS_LEADER_ADDRESS + LWS_GROUP_SIZE +
+    # LWS_WORKER_INDEX also works without flags).
+    p.add_argument(
+        "--distributed-coordinator", default=None,
+        help="host:port of the jax.distributed coordinator (LWS leader)",
+    )
+    p.add_argument("--distributed-num-processes", type=int, default=None)
+    p.add_argument("--distributed-process-id", type=int, default=None)
     return p
 
 
@@ -211,9 +237,16 @@ def main(argv=None) -> None:
     from aiohttp import web
 
     from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.parallel import distributed as dist
     from llmd_tpu.serve.api import build_app
     from llmd_tpu.serve.async_engine import AsyncEngine
     from llmd_tpu.serve.tokenizer import load_tokenizer
+
+    multihost = dist.maybe_initialize(
+        coordinator=args.distributed_coordinator,
+        num_processes=args.distributed_num_processes,
+        process_id=args.distributed_process_id,
+    )
 
     adapter_specs = parse_lora_adapters(args.lora_adapters) or None
     lora_adapters = (
@@ -248,6 +281,18 @@ def main(argv=None) -> None:
             sample_ratio=args.trace_sample_ratio,
         )
     engine = LLMEngine(config, event_sink=event_sink)
+    if multihost and not dist.is_leader():
+        # Worker rank of a multi-host deployment: no HTTP frontend — mirror
+        # the leader's device dispatches until it broadcasts shutdown (the
+        # LWS worker role; the leader serves the API for the whole group).
+        import jax
+
+        logging.info(
+            "multi-host worker %d/%d: entering follower loop",
+            jax.process_index(), jax.process_count(),
+        )
+        engine.runner.follower_loop()
+        return
     for name, (slot, path) in (adapter_specs or {}).items():
         if path:
             from llmd_tpu.models.loader import load_lora_adapter

@@ -1,0 +1,136 @@
+"""Multi-host engine execution over jax.distributed (2 processes x 4
+virtual CPU devices = one 8-device world).
+
+The reference spans hosts with LWS leader/worker vLLM ranks over NCCL
+(docs/infrastructure/multi-node.md:3-41); here both processes join one
+``jax.distributed`` world, the leader runs the real LLMEngine (scheduler +
+paged KV + sampling) over the GLOBAL mesh, and the worker mirrors every
+dispatch through ``ModelRunner.follower_loop``. The leader's outputs must
+match a plain single-process engine bit-for-bit.
+
+These tests spawn subprocesses (jax.distributed cannot re-initialize in
+the pytest process) — the same worker body the serve CLI uses.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+    from llmd_tpu.parallel import distributed as dist
+
+    pid, nproc, port, quant = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    dist.maybe_initialize(
+        coordinator=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 8, jax.devices()
+
+    cfg = EngineConfig(
+        model=tiny_model_config(
+            num_kv_heads=4, num_heads=8,
+            quantization=quant if quant != "none" else None,
+        ),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=4, data_parallel_size=2),
+        offload=None,
+    )
+    engine = LLMEngine(cfg)
+    if not dist.is_leader():
+        engine.runner.follower_loop()
+        sys.exit(0)
+
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16]]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    out = engine.generate(prompts, sp)
+    engine.close()  # broadcasts shutdown to the follower
+    print("RESULT " + json.dumps(list(out.values())))
+""")
+
+
+def _single_process_reference(quant: str):
+    """Same engine single-process on the 8-device CPU mesh (in-process)."""
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+
+    cfg = EngineConfig(
+        model=tiny_model_config(
+            num_kv_heads=4, num_heads=8,
+            quantization=quant if quant != "none" else None,
+        ),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=4, data_parallel_size=2),
+        offload=None,
+    )
+    engine = LLMEngine(cfg)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16]]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    out = engine.generate(prompts, sp)
+    engine.close()
+    return list(out.values())
+
+
+def _run_multihost(quant: str) -> list:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        import os
+
+        env = dict(os.environ)
+        flags = env.get("XLA_FLAGS", "")
+        # Each process provides 4 of the 8 global devices.
+        flags = [f for f in flags.split() if "host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=4"]
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("LLMD_PALLAS", "interpret")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), "2", str(port), quant],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        ))
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out[-4000:]}"
+    result_lines = [
+        ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")
+    ]
+    assert result_lines, outs[0][-2000:]
+    return json.loads(result_lines[0][len("RESULT "):])
+
+
+@pytest.mark.parametrize("quant", ["none", "int8"])
+def test_multihost_engine_matches_single_process(quant):
+    """Leader+follower over jax.distributed == single-process engine,
+    for both full-precision and int8-quantized weights."""
+    multi = _run_multihost(quant)
+    single = _single_process_reference(quant)
+    assert multi == single, (multi, single)
