@@ -74,6 +74,17 @@ def _scatter_kv(kv_cache: jax.Array, page_ids: jax.Array, vals: jax.Array) -> ja
     return kv_cache.at[:, page_ids].set(vals)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("rep",))
+def _scatter_kv_rep(
+    kv_cache: jax.Array, page_ids: jax.Array, vals: jax.Array, rep: int = 1
+) -> jax.Array:
+    """Scatter canonical-head bundles already ON DEVICE (pipelined KV
+    import): the kv_rep head expansion happens device-side."""
+    if rep > 1:
+        vals = jnp.repeat(vals, rep, axis=2)
+    return kv_cache.at[:, page_ids].set(vals)
+
+
 @jax.jit
 def _gather_kv(kv_cache: jax.Array, page_ids: jax.Array) -> jax.Array:
     """Read page bundles from the pool (producer leg of a KV transfer)."""
@@ -495,6 +506,53 @@ class ModelRunner:
     # ------------------------------------------------------------------ #
     # KV page staging (the HBM<->host leg of the P/D transfer path;
     # reference TPUConnectorHMA host-memory-assisted pattern)
+
+    def snapshot_pages_device(self, page_ids: list[int], pad_to: int) -> jax.Array:
+        """On-device snapshot of pages (padded to ``pad_to`` by repeating
+        the last id): [L, pad_to, K, page, 2D] in CANONICAL heads.
+
+        Returns immediately (async dispatch) with an INDEPENDENT device
+        buffer — the engine may donate/mutate the pool right after; jax
+        sequences the enqueued gather before any later pool write. The
+        blocking host download happens later via ``download_pages`` on a
+        staging thread, off the engine thread and off the TTFT path.
+        """
+        # Fail HERE (engine thread, loudly), not on the staging thread
+        # where the consumer would silently burn its pull-wait deadline.
+        self._require_single_host("snapshot_pages_device (P/D staging)")
+        ids = np.asarray(page_ids, np.int32)
+        if pad_to > len(ids):
+            ids = np.concatenate(
+                [ids, np.full(pad_to - len(ids), ids[-1], np.int32)]
+            )
+        out = _gather_kv(self.kv_cache, jnp.asarray(ids))
+        if self.kv_rep > 1:
+            # Canonical transfer format keeps the ORIGINAL heads (peers
+            # with different tp interoperate byte-exact).
+            out = out[:, :, :: self.kv_rep]
+        return out
+
+    @staticmethod
+    def download_pages(snapshot: jax.Array) -> np.ndarray:
+        """Blocking HBM -> host download of a snapshot (staging thread)."""
+        return np.ascontiguousarray(jax.device_get(snapshot))
+
+    def upload_pages_device(self, pages: np.ndarray) -> jax.Array:
+        """Async host -> HBM upload of a canonical bundle (fetch thread:
+        creates an independent device array, touches no engine state, so
+        the upload overlaps later pulls and the producer's own staging)."""
+        return jnp.asarray(pages, dtype=self.kv_cache.dtype)
+
+    def scatter_pages_from_device(
+        self, page_ids: list[int], vals: jax.Array
+    ) -> None:
+        """Engine-thread leg of a pipelined import: device -> pool scatter
+        of an already-uploaded chunk (head expansion device-side)."""
+        self._require_single_host("scatter_pages_from_device (P/D staging)")
+        self.kv_cache = _scatter_kv_rep(
+            self.kv_cache, jnp.asarray(np.asarray(page_ids, np.int32)),
+            vals, rep=self.kv_rep,
+        )
 
     def gather_pages(self, page_ids: list[int]) -> np.ndarray:
         """Stage pages HBM -> host: returns [L, n, K, page, 2D] ndarray.

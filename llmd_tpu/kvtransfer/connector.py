@@ -31,6 +31,7 @@ import dataclasses
 import logging
 import struct
 import threading
+import time
 import uuid
 from typing import Any
 
@@ -53,6 +54,12 @@ class KVTransferConfig:
     port: int = 9100  # TPU_KV_TRANSFER_PORT; 0 = ephemeral
     lease_ms: int = DEFAULT_LEASE_MS
     load_failure_policy: str = "recompute"  # "recompute" | "fail"
+    # Pages per transfer chunk. Exports are staged HBM -> host and
+    # registered chunk-by-chunk on a background thread, so the producer's
+    # response (and the consumer's pull+upload pipeline) starts after the
+    # FIRST chunk instead of after the whole bundle; the consumer's
+    # device uploads then overlap the producer's remaining downloads.
+    chunk_pages: int = 8
 
     @property
     def is_producer(self) -> bool:
@@ -71,12 +78,45 @@ class KVLoadError(RuntimeError):
 class PulledBundle:
     """A fetched-and-validated KV bundle awaiting engine-thread apply."""
 
-    pages: np.ndarray  # [L, n_full, K, page, 2D]
+    pages: np.ndarray | None  # [L, n_full, K, page, 2D]; None => chunked
     hashes: list[bytes]  # chained content hashes, one per page
     nbytes: int
     host: str
     port: int
     key: str
+    keys: list[str] = dataclasses.field(default_factory=list)  # chunk keys
+    # Pipelined import: chunks already uploaded to device scratch by the
+    # fetch thread ([L, chunk_pages, K, page, 2D] each, canonical heads).
+    device_chunks: list = dataclasses.field(default_factory=list)
+    # Host-side chunk arrays (kept for the rare skip>0 fallback; the
+    # common pipelined apply reads only device_chunks).
+    np_chunks: list = dataclasses.field(default_factory=list)
+    chunk_pages: int = 0
+
+    def host_pages(self, n_full: int) -> np.ndarray:
+        """Materialize the [L, n_full, ...] host view (fallback path only
+        — this concat is deliberately NOT done on the fetch critical
+        path)."""
+        if self.pages is not None:
+            return self.pages
+        return np.concatenate(self.np_chunks, axis=1)[:, :n_full]
+
+
+def chunk_key(key: str, j: int) -> str:
+    """Shipper key of one export chunk (the ONE place the scheme lives:
+    producer registration, consumer pulls, free-notify, and the sidecar
+    heartbeat all derive from here)."""
+    return f"{key}:c{j}"
+
+
+def transfer_keys(params: dict) -> list[str]:
+    """Every shipper key a transfer's lease heartbeat must renew (chunked
+    exports register one key per chunk; legacy bundles just one)."""
+    key = params.get("remote_key", "")
+    n = int(params.get("num_chunks", 0) or 0)
+    if n <= 0:
+        return [key]
+    return [chunk_key(key, j) for j in range(n)]
 
 
 def pack_header(pages: np.ndarray) -> bytes:
@@ -134,6 +174,11 @@ class TPUConnector:
         self.imported_requests = 0
         self.imported_bytes = 0
         self.import_failures = 0
+        # last-transfer stage timings (ms) — the P/D TTFT budget, readable
+        # from stats()/bench without instrumentation hooks
+        self.last_stage_ms = 0.0   # producer: HBM->host downloads + register
+        self.last_fetch_ms = 0.0   # consumer: pull-wait + device uploads
+        self.last_apply_ms = 0.0   # consumer: device->pool scatters + commit
 
     # ------------------------------------------------------------------ #
     # producer side
@@ -151,6 +196,13 @@ class TPUConnector:
 
         Must run while ``req.block_ids`` is still live (the engine calls it
         from the scheduler's finish hook, before page release).
+
+        The engine thread only ENQUEUES on-device page snapshots (async,
+        independent buffers — the pool may be donated/reused right after);
+        the slow HBM -> host downloads + registrations run chunk-by-chunk
+        on a staging thread. The response therefore leaves after prefill
+        COMPUTE, and the consumer's pull/upload pipeline overlaps the
+        remaining downloads (pulls of not-yet-registered chunks wait).
         """
         page = self.allocator.page_size
         n_full = req.num_prompt_tokens // page
@@ -163,26 +215,50 @@ class TPUConnector:
         # Server-unique key: never the raw (client-controllable) request id,
         # so colliding x-request-id headers can't cross-wire two exports.
         key = f"{req.request_id}:{uuid.uuid4().hex[:12]}"
-        # The device_get runs on the engine thread by design: the pages must
-        # be read before the allocator can reuse them. Everything after is a
-        # single memcpy into the server's owning buffer (no Python-side
-        # concat of the payload).
-        pages = np.ascontiguousarray(self.runner.gather_pages(req.block_ids[:n_full]))
-        header = pack_header(pages)
-        # Extension dtypes (bfloat16: isbuiltin == 2, "registered user
-        # type") don't expose the buffer protocol the zero-copy register
-        # path needs; a same-memory uint8 view does.
-        payload = pages if pages.dtype.isbuiltin == 1 else pages.view(np.uint8)
-        self.server.register(key, payload, self.cfg.lease_ms, header=header)
+        cp = max(1, self.cfg.chunk_pages)
+        ids = list(req.block_ids[:n_full])
+        n_chunks = -(-n_full // cp)
+        snaps = [
+            self.runner.snapshot_pages_device(ids[j * cp : (j + 1) * cp], cp)
+            for j in range(n_chunks)
+        ]
+        threading.Thread(
+            target=self._stage_chunks, args=(key, snaps), daemon=True
+        ).start()
         self.exported_requests += 1
-        self.exported_bytes += len(header) + pages.nbytes
         return {
             "remote_host": self.cfg.host,
             "remote_port": self.server.port,
             "remote_key": key,
             "num_full_pages": n_full,
             "page_size": page,
+            "chunk_pages": cp,
+            "num_chunks": n_chunks,
         }
+
+    def _stage_chunks(self, key: str, snaps: list) -> None:
+        """Staging thread: download each snapshot and register it. A failed
+        download leaves later chunks unregistered; the consumer's pull wait
+        times out and its load-failure policy decides."""
+        t0 = time.monotonic()
+        try:
+            for j, snap in enumerate(snaps):
+                pages = self.runner.download_pages(snap)
+                header = pack_header(pages)
+                # Extension dtypes (bfloat16: isbuiltin == 2) don't expose
+                # the buffer protocol the zero-copy register path needs; a
+                # same-memory uint8 view does.
+                payload = (
+                    pages if pages.dtype.isbuiltin == 1 else pages.view(np.uint8)
+                )
+                self.server.register(
+                    chunk_key(key, j), payload, self.cfg.lease_ms, header=header
+                )
+                self.exported_bytes += len(header) + pages.nbytes
+        except Exception:
+            log.exception("KV export staging failed for %s", key)
+        finally:
+            self.last_stage_ms = (time.monotonic() - t0) * 1e3
 
     # ------------------------------------------------------------------ #
     # consumer side
@@ -191,11 +267,15 @@ class TPUConnector:
         return bool(self.cfg.is_consumer and params and params.get("remote_host"))
 
     def fetch_remote(self, prompt_token_ids: list[int], params: dict) -> PulledBundle:
-        """Network half of an import: pull + validate the bundle.
+        """Network half of an import: pull + validate + upload to device
+        scratch.
 
-        Thread-safe (touches no engine state) — the async serving layer runs
-        it on an executor so a slow producer never head-of-line-blocks the
-        engine step thread.
+        Thread-safe (creates independent device arrays, touches no engine
+        state) — the async serving layer runs it on an executor so a slow
+        producer never head-of-line-blocks the engine step thread. Chunked
+        exports pipeline: chunk j's (async) device upload overlaps the
+        pull of chunk j+1 AND the producer's remaining HBM -> host
+        downloads (pull_wait blocks until the producer registers each).
         """
         page = self.allocator.page_size
         if params.get("page_size") != page:
@@ -211,22 +291,55 @@ class TPUConnector:
                 f"{len(hashes)} full pages"
             )
         host, port, key = params["remote_host"], int(params["remote_port"]), params["remote_key"]
-        blob = shipper_mod.pull(host, port, key)
-        pages = unpack_pages(blob)
-        if pages.shape[1] != n_full:
-            raise ValueError(
-                f"bundle holds {pages.shape[1]} pages, expected {n_full}"
-            )
         want_dtype = np.dtype(self.runner.kv_cache.dtype)
-        if pages.dtype != want_dtype:
-            # Never silently cast transferred KV: the P/D invariance
-            # guarantee is byte-exact numerics.
-            raise ValueError(
-                f"KV dtype mismatch: producer {pages.dtype} vs consumer {want_dtype}"
+        n_chunks = int(params.get("num_chunks", 0) or 0)
+        if n_chunks <= 0:
+            # Legacy single-bundle producer.
+            blob = shipper_mod.pull(host, port, key)
+            pages = unpack_pages(blob)
+            if pages.shape[1] != n_full:
+                raise ValueError(
+                    f"bundle holds {pages.shape[1]} pages, expected {n_full}"
+                )
+            if pages.dtype != want_dtype:
+                # Never silently cast transferred KV: the P/D invariance
+                # guarantee is byte-exact numerics.
+                raise ValueError(
+                    f"KV dtype mismatch: producer {pages.dtype} "
+                    f"vs consumer {want_dtype}"
+                )
+            return PulledBundle(
+                pages=pages, hashes=hashes[:n_full], nbytes=len(blob),
+                host=host, port=port, key=key,
             )
+        cp = int(params["chunk_pages"])
+        if cp <= 0 or -(-n_full // cp) != n_chunks:
+            raise ValueError(
+                f"chunk geometry mismatch: {n_full} pages / {cp} per chunk "
+                f"!= {n_chunks} chunks"
+            )
+        deadline = time.monotonic() + min(self.cfg.lease_ms / 1e3, 20.0)
+        np_chunks, dev_chunks, nbytes = [], [], 0
+        for j in range(n_chunks):
+            blob = shipper_mod.pull_wait(host, port, chunk_key(key, j), deadline)
+            pages = unpack_pages(blob)
+            if pages.shape[1] != cp:
+                raise ValueError(
+                    f"chunk {j} holds {pages.shape[1]} pages, expected {cp}"
+                )
+            if pages.dtype != want_dtype:
+                raise ValueError(
+                    f"KV dtype mismatch: producer {pages.dtype} "
+                    f"vs consumer {want_dtype}"
+                )
+            np_chunks.append(pages)
+            dev_chunks.append(self.runner.upload_pages_device(pages))
+            nbytes += len(blob)
         return PulledBundle(
-            pages=pages, hashes=hashes[:n_full], nbytes=len(blob),
+            pages=None, hashes=hashes[:n_full], nbytes=nbytes,
             host=host, port=port, key=key,
+            keys=[chunk_key(key, j) for j in range(n_chunks)],
+            device_chunks=dev_chunks, np_chunks=np_chunks, chunk_pages=cp,
         )
 
     def fetch_remote_policy(
@@ -237,6 +350,7 @@ class TPUConnector:
         Returns None on policy='recompute' failure; raises KVLoadError on
         policy='fail' (operations-vllm.md:118-139).
         """
+        t0 = time.monotonic()
         try:
             return self.fetch_remote(prompt_token_ids, params)
         except (PullError, OSError, ValueError, KeyError, TypeError, struct.error) as e:
@@ -247,6 +361,8 @@ class TPUConnector:
                 raise KVLoadError(str(e)) from e
             log.warning("remote KV load failed, recomputing locally: %s", e)
             return None
+        finally:
+            self.last_fetch_ms = (time.monotonic() - t0) * 1e3
 
     def apply_bundle(
         self, prompt_token_ids: list[int], bundle: "PulledBundle"
@@ -259,6 +375,7 @@ class TPUConnector:
         """
         from llmd_tpu.engine.kv_cache import NoFreePagesError
 
+        t_apply = time.monotonic()
         page = self.allocator.page_size
         hashes = bundle.hashes
         n_full = len(hashes)
@@ -269,15 +386,28 @@ class TPUConnector:
             skip += 1
         adopted = 0
         if skip < n_full:
-            want = bundle.pages[:, skip:]
             try:
-                page_ids = self.allocator.allocate(want.shape[1])
+                page_ids = self.allocator.allocate(n_full - skip)
             except NoFreePagesError as e:
                 self.import_failures += 1
                 log.warning("no free pages for KV import, recomputing: %s", e)
                 self._notify_free_async(bundle)
                 return 0
-            self.runner.scatter_pages(page_ids, want)
+            if bundle.device_chunks and skip == 0:
+                # Pipelined path: chunks are already on device (uploaded by
+                # the fetch thread) — only fast device->pool scatters here.
+                cp = bundle.chunk_pages
+                for j, dev in enumerate(bundle.device_chunks):
+                    ids_j = page_ids[j * cp : (j + 1) * cp]
+                    if len(ids_j) < cp:
+                        # Producer padded the last chunk by repeating its
+                        # final page; aiming the pad slots at the last real
+                        # id makes the duplicate write idempotent.
+                        ids_j = ids_j + [ids_j[-1]] * (cp - len(ids_j))
+                    self.runner.scatter_pages_from_device(ids_j, dev)
+            else:
+                want = bundle.host_pages(n_full)[:, skip:]
+                self.runner.scatter_pages(page_ids, want)
             parent = None if skip == 0 else hashes[skip - 1]
             for i, pid in enumerate(page_ids):
                 idx = skip + i
@@ -291,6 +421,7 @@ class TPUConnector:
         self.imported_requests += 1
         self.imported_bytes += bundle.nbytes
         self._notify_free_async(bundle)
+        self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
         return adopted
 
     def import_for_prompt(self, prompt_token_ids: list[int], params: dict) -> int:
@@ -302,11 +433,13 @@ class TPUConnector:
 
     @staticmethod
     def _notify_free_async(bundle: "PulledBundle") -> None:
-        threading.Thread(
-            target=shipper_mod.free_notify,
-            args=(bundle.host, bundle.port, bundle.key),
-            daemon=True,
-        ).start()
+        keys = bundle.keys or [bundle.key]
+
+        def notify() -> None:
+            for k in keys:
+                shipper_mod.free_notify(bundle.host, bundle.port, k)
+
+        threading.Thread(target=notify, daemon=True).start()
 
     # ------------------------------------------------------------------ #
 
@@ -317,6 +450,9 @@ class TPUConnector:
             "imported_requests": self.imported_requests,
             "imported_bytes": self.imported_bytes,
             "import_failures": self.import_failures,
+            "last_stage_ms": round(self.last_stage_ms, 1),
+            "last_fetch_ms": round(self.last_fetch_ms, 1),
+            "last_apply_ms": round(self.last_apply_ms, 1),
         }
         if self.server is not None:
             out["registered_count"] = self.server.registered_count

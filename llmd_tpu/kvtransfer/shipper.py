@@ -28,7 +28,9 @@ DEFAULT_LEASE_MS = 30_000
 
 
 class PullError(RuntimeError):
-    pass
+    def __init__(self, msg: str, status: int = ST_ERR) -> None:
+        super().__init__(msg)
+        self.status = status
 
 
 # --------------------------------------------------------------------------- #
@@ -279,15 +281,34 @@ def pull(host: str, port: int, key: str) -> bytes:
             ctypes.byref(out), ctypes.byref(out_len),
         )
         if st != ST_OK:
-            raise PullError(f"pull {key!r} from {host}:{port} -> status {st}")
+            raise PullError(
+                f"pull {key!r} from {host}:{port} -> status {st}", status=st
+            )
         try:
             return ctypes.string_at(out, out_len.value)
         finally:
             lib.kvship_buf_free(out)
     st, payload = _py_roundtrip(host, port, OP_PULL, key)
     if st != ST_OK:
-        raise PullError(f"pull {key!r} from {host}:{port} -> status {st}")
+        raise PullError(
+            f"pull {key!r} from {host}:{port} -> status {st}", status=st
+        )
     return payload
+
+
+def pull_wait(
+    host: str, port: int, key: str, deadline: float, poll_s: float = 0.01
+) -> bytes:
+    """Pull, retrying while the key is NOT-YET-registered (a producer that
+    streams chunks as it stages them registers each one when its download
+    completes). Hard errors and the ``deadline`` (monotonic) abort."""
+    while True:
+        try:
+            return pull(host, port, key)
+        except PullError as e:
+            if e.status != ST_NOT_FOUND or time.monotonic() >= deadline:
+                raise
+        time.sleep(poll_s)
 
 
 def free_notify(host: str, port: int, key: str) -> bool:

@@ -91,17 +91,27 @@ class _LeaseHeartbeat:
         self._task: asyncio.Task | None = None
 
     async def _run(self) -> None:
+        from llmd_tpu.kvtransfer.connector import transfer_keys
+
         host = self.params.get("remote_host")
         port = int(self.params.get("remote_port", 0))
-        key = self.params.get("remote_key", "")
+        keys = transfer_keys(self.params)
         loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(self.cadence_s)
-            ok = await loop.run_in_executor(
-                None, shipper_mod.renew, host, port, key
-            )
+
+            def renew_all() -> bool:
+                # Chunked exports: every chunk key carries its own lease,
+                # so EVERY key must be renewed each cycle (a list, not a
+                # short-circuiting generator). Any still-alive entry keeps
+                # the heartbeat going — a chunk may be registered only
+                # after the first renew cycle.
+                results = [shipper_mod.renew(host, port, k) for k in keys]
+                return any(results)
+
+            ok = await loop.run_in_executor(None, renew_all)
             if not ok:
-                return  # entry gone (pulled+freed, or producer restarted)
+                return  # entries gone (pulled+freed, or producer restarted)
 
     def start(self) -> None:
         if self.params.get("remote_host"):

@@ -148,6 +148,13 @@ def test_pd_disagg_matches_aggregated():
         params = pre.kv_transfer_params
         assert params is not None
         assert params["num_full_pages"] == len(PROMPT) // 4
+        # Export staging runs on a background thread (the response leaves
+        # after prefill compute); wait for the registration to land.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if producer.kv_connector.server.registered_count == 1:
+                break
+            time.sleep(0.02)
         assert producer.kv_connector.server.registered_count == 1
 
         # Phase 2: decode with the captured params injected.
@@ -187,6 +194,67 @@ def test_pd_disagg_bfloat16_cache_transfers():
     finally:
         producer.kv_connector.close()
         consumer.kv_connector.close()
+
+
+def test_pd_multi_chunk_pipeline_matches_aggregated():
+    """A prompt spanning several transfer chunks (the pipelined export
+    path: background staging, per-chunk keys, device-side scatters) must
+    reproduce the aggregated engine exactly, including the padded tail
+    chunk."""
+    prompt = list(range(1, 45))  # 44 tokens, page=4 -> 11 full pages
+    ref_tokens, _ = _run(make_engine(), prompt, max_tokens=6)
+
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        _, pre = _run(
+            producer, prompt, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        assert params["num_full_pages"] == 11
+        assert params["num_chunks"] == 2  # 11 pages / 8 per chunk
+        assert params["chunk_pages"] == 8
+        toks, final = _run(
+            consumer, prompt, max_tokens=6, kv_transfer_params=params
+        )
+        assert toks == ref_tokens
+        assert consumer.kv_connector.imported_requests == 1
+        assert consumer.kv_connector.import_failures == 0
+        # free-notify covered every chunk key
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if producer.kv_connector.server.registered_count == 0:
+                break
+            time.sleep(0.02)
+        assert producer.kv_connector.server.registered_count == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_pull_wait_blocks_until_registered(server):
+    """pull_wait absorbs producer staging lag: the key appears mid-wait."""
+    import threading
+
+    from llmd_tpu.kvtransfer import shipper as shipper_mod
+
+    def late_register():
+        time.sleep(0.15)
+        server.register("late", b"chunk-bytes", 5_000)
+
+    threading.Thread(target=late_register, daemon=True).start()
+    t0 = time.monotonic()
+    blob = shipper_mod.pull_wait(
+        "127.0.0.1", server.port, "late", deadline=time.monotonic() + 5
+    )
+    assert blob == b"chunk-bytes"
+    assert time.monotonic() - t0 >= 0.1
+    # hard timeout on a key that never appears
+    with pytest.raises(shipper_mod.PullError):
+        shipper_mod.pull_wait(
+            "127.0.0.1", server.port, "never", deadline=time.monotonic() + 0.2
+        )
 
 
 def test_pd_consumer_recompute_fallback():
