@@ -2,25 +2,38 @@
 
 The reference EPP's primary deployment shape: an external-processor plugin
 behind Envoy / a K8s Gateway (docs/architecture/core/router/epp/
-README.md:11-18, proxy.md:16-26). Envoy parks the request and streams it
-over a bidirectional gRPC `Process` call; the EPP answers with header
-mutations naming the picked endpoint, and Envoy forwards the request
-itself. The fused reverse-proxy mode (epp/server.py) stays as the no-K8s
-shape; this module reuses its exact pipeline — parse -> admitters -> flow
-control -> data producers -> schedule — only the transport differs.
+README.md:11-18, proxy.md:16-26). Envoy streams the request over a
+bidirectional gRPC `Process` call; the EPP answers with header mutations
+naming the picked endpoint, and Envoy forwards the request itself. The
+fused reverse-proxy mode (epp/server.py) stays as the no-K8s shape; this
+module reuses its exact pipeline — parse -> admitters -> flow control ->
+data producers -> schedule — only the transport differs.
 
-Exchange per request (processing mode: request headers + BUFFERED body):
+Processing mode: FULL_DUPLEX_STREAMED both directions (the protocol the
+reference mandates for GAIE, epp/README.md:48-50). Per request:
 
-  Envoy -> request_headers         (stash; CONTINUE)
-  Envoy -> request_body (eos)      (run pipeline; reply BodyResponse with
-                                    x-gateway-destination-endpoint +
-                                    x-llm-d-* header mutations and
-                                    clear_route_cache, or an
-                                    ImmediateResponse 429/503 with
-                                    x-llm-d-request-dropped-reason per
-                                    flow-control.md:369-409)
-  Envoy -> response_headers        (record status; CONTINUE)
-  stream end                       (release inflight accounting)
+  Envoy -> request_headers             (held — no reply yet)
+  Envoy -> request_body chunk*         (accumulated; Envoy does not
+                                        forward a chunk until the EPP
+                                        hands it back, so the decision
+                                        gates the stream without BUFFERED
+                                        mode's full-body Envoy buffer)
+  [body complete] run pipeline;  reply HeadersResponse with
+                                 x-gateway-destination-endpoint +
+                                 x-llm-d-* mutations + clear_route_cache,
+                                 then one streamed BodyResponse per held
+                                 chunk — or an ImmediateResponse 429/503
+                                 with x-llm-d-request-dropped-reason per
+                                 flow-control.md:369-409
+  Envoy -> response_headers            (record TTFT; CONTINUE)
+  Envoy -> response_body chunk*        (streamed back immediately; SSE
+                                        usage frames are sampled for the
+                                        latency observers mid-stream,
+                                        request-handling.md:56-63)
+  stream end                           (release inflight accounting)
+
+A ``mode="buffered"`` fallback keeps the old BUFFERED exchange for Envoy
+configs that predate duplex streaming.
 
 Failure semantics (flow-control.md:345-359): pipeline errors abort the
 stream with a gRPC error — Envoy's `failure_mode_allow` then decides
@@ -54,10 +67,16 @@ HDR_ENDPOINT = "x-llm-d-endpoint"
 
 
 class ExtProcSession:
-    """One gRPC stream == one HTTP request being processed."""
+    """One gRPC stream == one HTTP request being processed.
 
-    def __init__(self, router) -> None:
+    ``on_message`` returns the (possibly empty) LIST of replies to send —
+    duplex streaming holds replies across messages (no reply for early
+    body chunks; headers-response + all held chunks after routing).
+    """
+
+    def __init__(self, router, mode: str = "streamed") -> None:
         self.router = router
+        self.mode = mode
         self.headers: dict[str, str] = {}
         self.body = bytearray()
         self.req = None
@@ -65,19 +84,46 @@ class ExtProcSession:
         self.t_routed: float | None = None
         self._flow_held = False
         self._ok = False
+        # streamed mode: request chunks held until the routing decision;
+        # _set_headers doubles as the routed/rejected discriminator.
+        self._held: list[tuple[bytes, bool]] = []
+        self._set_headers: dict[str, str] = {}
+        self._t_first_response: float | None = None
 
-    async def on_message(self, msg: pb.ProcessingRequest) -> bytes | None:
+    async def on_message(self, msg: pb.ProcessingRequest) -> list[bytes]:
         if msg.kind == "request_headers":
             self.headers = msg.headers
             if msg.end_of_stream:
                 # Bodyless request (GET /v1/models etc): route on headers.
-                return await self._route()
-            return pb.encode_common_response("request_headers")
+                return [await self._route()]
+            if self.mode == "buffered":
+                return [pb.encode_common_response("request_headers")]
+            return []  # duplex: headers response deferred until routed
         if msg.kind == "request_body":
             self.body.extend(msg.body)
+            if self.mode == "buffered":
+                if msg.end_of_stream:
+                    return [await self._route()]
+                return []
+            self._held.append((msg.body, msg.end_of_stream))
             if msg.end_of_stream:
-                return await self._route()
-            return None  # streamed chunk; wait for end_of_stream
+                decision = await self._route()
+                if not self._set_headers:
+                    # No routing mutations were produced: the decision is a
+                    # rejection (ImmediateResponse) — forward it as-is.
+                    return [decision]
+                out = [pb.encode_common_response(
+                    "request_headers",
+                    set_headers=self._set_headers,
+                    clear_route_cache=True,
+                )]
+                out.extend(
+                    pb.encode_streamed_body_response("request_body", chunk, eos)
+                    for chunk, eos in self._held
+                )
+                self._held.clear()
+                return out
+            return []  # hold the chunk; Envoy waits for the hand-back
         if msg.kind == "response_headers":
             status = msg.headers.get(":status", "")
             if self.req is not None and self.pod is not None:
@@ -90,6 +136,7 @@ class ExtProcSession:
                     # TTFT load gate read these attrs, and Envoy is the
                     # EPP's primary deployment shape.
                     self.pod.attrs["LastTTFT"] = ttft_s
+                    self._t_first_response = time.monotonic()
                     self._ok = True
                 # Fire-and-forget like the fused proxy (server.py): a slow
                 # observer (predictor training POST) must not hold Envoy's
@@ -99,12 +146,71 @@ class ExtProcSession:
                 )
                 self.router._observer_tasks.add(task)
                 task.add_done_callback(self.router._observer_tasks.discard)
-            return pb.encode_common_response("response_headers")
-        if msg.kind in ("request_trailers", "response_trailers"):
-            return pb.encode_common_response(msg.kind)
+            return [pb.encode_common_response("response_headers")]
+        if msg.kind == "request_trailers":
+            if (
+                self.mode == "streamed"
+                and self.req is None
+                and not self._set_headers
+                and (self.body or self.headers)
+            ):
+                # Trailer-terminated body: Envoy signals end-of-body via
+                # the trailers message (the last chunk has eos=false) —
+                # route NOW or the held chunks are never handed back and
+                # the request stalls until Envoy's message_timeout.
+                decision = await self._route()
+                if not self._set_headers:
+                    return [decision, pb.encode_common_response(msg.kind)]
+                out = [pb.encode_common_response(
+                    "request_headers",
+                    set_headers=self._set_headers,
+                    clear_route_cache=True,
+                )]
+                out.extend(
+                    pb.encode_streamed_body_response("request_body", chunk, eos)
+                    for chunk, eos in self._held
+                )
+                self._held.clear()
+                out.append(pb.encode_common_response(msg.kind))
+                return out
+            return [pb.encode_common_response(msg.kind)]
+        if msg.kind == "response_trailers":
+            return [pb.encode_common_response(msg.kind)]
         if msg.kind == "response_body":
-            return pb.encode_common_response("response_body")
-        return None
+            if self.mode == "buffered":
+                return [pb.encode_common_response("response_body")]
+            self._observe_response_chunk(msg.body)
+            # Stream the chunk straight back — response bodies are never
+            # held (TTFT/ITL pass through untouched).
+            return [pb.encode_streamed_body_response(
+                "response_body", msg.body, msg.end_of_stream
+            )]
+        return []
+
+    def _observe_response_chunk(self, chunk: bytes) -> None:
+        """Sample streamed SSE frames for usage mid-stream (the reference
+        samples usage/latency from streamed response bodies,
+        request-handling.md:56-63): completion token counts yield a live
+        LastTPOT for the latency-aware scorers — the same accounting the
+        fused proxy derives at stream end (server.py)."""
+        if self.pod is None or b'"usage"' not in chunk:
+            return
+        import json
+
+        for line in chunk.split(b"\n"):
+            if not line.startswith(b"data:") or b"[DONE]" in line:
+                continue
+            try:
+                usage = json.loads(line[5:].strip()).get("usage") or {}
+            except (ValueError, AttributeError):
+                continue
+            n_out = usage.get("completion_tokens")
+            if not n_out:
+                continue
+            self.pod.attrs["LastCompletionTokens"] = n_out
+            if self._t_first_response is not None and n_out >= 2:
+                decode_s = time.monotonic() - self._t_first_response
+                self.pod.attrs["LastTPOT"] = decode_s / (n_out - 1)
 
     def close(self) -> None:
         """Stream end: release scheduling + flow-control accounting.
@@ -132,6 +238,13 @@ class ExtProcSession:
     # -------------------------------------------------------------- core
 
     def _reject(self, status: int, reason: str) -> bytes:
+        # ImmediateResponse before any headers/body response has been
+        # returned. NOTE (duplex streaming): some Envoy builds refuse
+        # ImmediateResponse once request_body_mode is FULL_DUPLEX_STREAMED;
+        # there the stream error is surfaced per failure_mode_allow
+        # (FailClose still rejects the request, with a generic status). No
+        # CommonResponse encoding can carry a rejection in that protocol
+        # state, so this stays the best-effort encoding in both modes.
         return pb.encode_immediate_response(
             status,
             headers={HDR_DROP_REASON: reason},
@@ -206,6 +319,7 @@ class ExtProcSession:
                 set_headers[HDR_PREFILLER] = result.prefill.address
             if result.encode is not None:
                 set_headers[HDR_ENCODER] = result.encode.address
+            self._set_headers = set_headers
             # Scheduling + flow accounting mirrors the fused proxy: both
             # held until stream close (Envoy owns the actual proxying).
             pod.inflight += 1
@@ -224,30 +338,40 @@ class ExtProcSession:
 
 
 class ExtProcServer:
-    """grpc.aio server speaking the ext-proc protocol around a Router."""
+    """grpc.aio server speaking the ext-proc protocol around a Router.
 
-    def __init__(self, router, host: str = "127.0.0.1", port: int = 0) -> None:
+    ``mode``: "streamed" (FULL_DUPLEX_STREAMED, the GAIE default) or
+    "buffered" (legacy Envoy configs).
+    """
+
+    def __init__(
+        self, router, host: str = "127.0.0.1", port: int = 0,
+        mode: str = "streamed",
+    ) -> None:
+        if mode not in ("streamed", "buffered"):
+            raise ValueError(f"unknown ext-proc mode {mode!r}")
         self.router = router
         self.host = host
         self.port = port
+        self.mode = mode
         self._server: grpc.aio.Server | None = None
 
     async def _process(self, request_iterator, context):
-        session = ExtProcSession(self.router)
+        session = ExtProcSession(self.router, mode=self.mode)
         try:
             async for raw in request_iterator:
                 msg = pb.parse_processing_request(raw)
                 if msg is None:
                     continue
                 try:
-                    reply = await session.on_message(msg)
+                    replies = await session.on_message(msg)
                 except Exception as e:  # pipeline failure -> FailOpen/Close
                     log.exception("ext-proc pipeline error")
                     await context.abort(
                         grpc.StatusCode.INTERNAL, f"epp pipeline error: {e}"
                     )
                     return
-                if reply is not None:
+                for reply in replies:
                     yield reply
         finally:
             session.close()
@@ -276,8 +400,8 @@ class ExtProcServer:
             self._server = None
 
 
-async def run_extproc(router, host: str, port: int) -> None:
-    server = ExtProcServer(router, host, port)
+async def run_extproc(router, host: str, port: int, mode: str = "streamed") -> None:
+    server = ExtProcServer(router, host, port, mode=mode)
     await server.start()
     try:
         await asyncio.Event().wait()

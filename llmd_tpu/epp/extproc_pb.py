@@ -23,6 +23,8 @@ CommonResponse:     status=1 (0=CONTINUE), header_mutation=2,
                     clear_route_cache=5
 HeaderMutation:     set_headers(repeated HeaderValueOption)=1,
                     remove_headers(repeated string)=2
+BodyMutation:       body=1, clear_body=2, streamed_response=3
+StreamedBodyResponse: body=1, end_of_stream=2
 HeaderValueOption:  header(HeaderValue)=1, append_action=3
                     (2=OVERWRITE_IF_EXISTS_OR_ADD; 1 is ADD_IF_ABSENT,
                     which would let a client-supplied routing header win
@@ -216,6 +218,18 @@ def encode_common_response(
     return _len_field(_RESP_FIELD[kind], inner)
 
 
+def encode_streamed_body_response(
+    kind: str, body: bytes, end_of_stream: bool
+) -> bytes:
+    """FULL_DUPLEX_STREAMED chunk hand-back: the processor received a
+    streamed body chunk and returns it (possibly delayed until a routing
+    decision) via BodyMutation.streamed_response."""
+    streamed = _len_field(1, body) + _varint_field(2, int(end_of_stream))
+    common = _len_field(3, _len_field(3, streamed))  # body_mutation.streamed
+    inner = _len_field(1, common)
+    return _len_field(_RESP_FIELD[kind], inner)
+
+
 def encode_immediate_response(
     status_code: int,
     headers: dict[str, str] | None = None,
@@ -253,6 +267,15 @@ def encode_response_headers(headers: dict[str, str]) -> bytes:
     return _len_field(3, _len_field(1, hm))
 
 
+def encode_response_body(body: bytes, end_of_stream: bool = False) -> bytes:
+    inner = _len_field(1, body) + _varint_field(2, int(end_of_stream))
+    return _len_field(5, inner)
+
+
+def encode_request_trailers() -> bytes:
+    return _len_field(6, b"")
+
+
 def encode_response_trailers() -> bytes:
     return _len_field(7, b"")
 
@@ -265,6 +288,9 @@ class ProcessingResponse:
     immediate_status: int = 0
     immediate_body: bytes = b""
     immediate_details: str = ""
+    # FULL_DUPLEX_STREAMED: a handed-back body chunk.
+    body: bytes = b""
+    body_eos: bool = False
 
 
 def parse_processing_response(buf: bytes) -> ProcessingResponse | None:
@@ -285,6 +311,14 @@ def parse_processing_response(buf: bytes) -> ProcessingResponse | None:
                                         msg.set_headers[k] = val
                             elif f4 == 2:
                                 msg.remove_headers.append(v4.decode())
+                    elif f3 == 3:  # body_mutation
+                        for f4, _, v4 in iter_fields(v3):
+                            if f4 == 3:  # streamed_response
+                                for f5, _, v5 in iter_fields(v4):
+                                    if f5 == 1:
+                                        msg.body = v5
+                                    elif f5 == 2:
+                                        msg.body_eos = bool(v5)
             return msg
         if field == 7:  # immediate_response
             msg = ProcessingResponse(kind="immediate_response")

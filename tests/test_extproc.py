@@ -88,8 +88,13 @@ async def test_extproc_picks_endpoint_via_header_mutation():
             pb.encode_response_headers({":status": "200"}),
         )
         kinds = [r.kind for r in replies]
+        # FULL_DUPLEX_STREAMED: the headers response (deferred until the
+        # routing decision) carries the mutations; the body chunk is then
+        # handed back as a streamed response.
         assert kinds == ["request_headers", "request_body", "response_headers"]
-        picked = replies[1].set_headers
+        picked = replies[0].set_headers
+        assert replies[1].body  # held chunk handed back
+        assert replies[1].body_eos
         addrs = {p.address for p in router.store.list()}
         assert picked[HDR_DESTINATION] in addrs
         assert picked["x-llm-d-endpoint"] == picked[HDR_DESTINATION]
@@ -158,8 +163,7 @@ async def test_extproc_flow_control_rejection_is_immediate_response():
                 "model": "m", "prompt": "x", "max_tokens": 1,
             }).encode()),
         )
-        assert replies[0].kind == "request_headers"
-        imm = replies[1]
+        imm = replies[0]  # streamed mode: no reply precedes the rejection
         assert imm.kind == "immediate_response"
         assert imm.immediate_status in (429, 503)
         assert HDR_DROP_REASON in imm.set_headers
@@ -181,7 +185,7 @@ async def test_extproc_no_endpoints_rejects_503():
                 "model": "m", "prompt": "x", "max_tokens": 1,
             }).encode()),
         )
-        imm = replies[1]
+        imm = replies[0]
         assert imm.kind == "immediate_response"
         assert imm.immediate_status == 503
     finally:
@@ -227,8 +231,120 @@ async def test_extproc_parse_error_rejects_400():
             pb.encode_request_headers({":path": "/v1/completions"}),
             pb.encode_request_body(b"{not json"),
         )
-        assert replies[1].kind == "immediate_response"
-        assert replies[1].immediate_status == 400
+        assert replies[0].kind == "immediate_response"
+        assert replies[0].immediate_status == 400
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_extproc_streamed_chunked_request_and_response_bodies():
+    """FULL_DUPLEX_STREAMED both directions (reference epp/README.md:48-50):
+    request chunks are HELD (zero replies) until the body completes, then
+    the deferred headers response + every chunk come back in order; response
+    chunks stream straight through with mid-stream usage sampling."""
+    router = make_router()
+    server = ExtProcServer(router)
+    port = await server.start()
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    call = channel.stream_stream(METHOD)
+    try:
+        sent = asyncio.Queue()
+
+        async def gen():
+            while True:
+                m = await sent.get()
+                if m is None:
+                    return
+                yield m
+
+        stream = call(gen())
+        body = json.dumps({"model": "m", "prompt": "hello", "max_tokens": 2}).encode()
+        a, b, c = body[:10], body[10:20], body[20:]
+        await sent.put(pb.encode_request_headers({":path": "/v1/completions"}))
+        await sent.put(pb.encode_request_body(a, end_of_stream=False))
+        await sent.put(pb.encode_request_body(b, end_of_stream=False))
+        # Nothing may come back yet: chunks are held pending the decision.
+        # (A pending reader task, not wait_for — cancelling a grpc.aio
+        # read cancels the whole RPC.)
+        reader = asyncio.ensure_future(stream.read())
+        await asyncio.sleep(0.2)
+        assert not reader.done()
+        await sent.put(pb.encode_request_body(c, end_of_stream=True))
+        replies = [pb.parse_processing_response(await reader)] + [
+            pb.parse_processing_response(await stream.read()) for _ in range(3)
+        ]
+        assert replies[0].kind == "request_headers"
+        assert HDR_DESTINATION in replies[0].set_headers
+        assert [r.body for r in replies[1:]] == [a, b, c]
+        assert [r.body_eos for r in replies[1:]] == [False, False, True]
+
+        # response leg: streamed SSE frames pass through; usage sampled
+        await sent.put(pb.encode_response_headers({":status": "200"}))
+        hdr_reply = pb.parse_processing_response(await stream.read())
+        assert hdr_reply.kind == "response_headers"
+        sse = (
+            b'data: {"choices": [], "usage": {"completion_tokens": 7}}\n\n'
+        )
+        await sent.put(pb.encode_response_body(sse, end_of_stream=False))
+        chunk_reply = pb.parse_processing_response(await stream.read())
+        assert chunk_reply.kind == "response_body"
+        assert chunk_reply.body == sse
+        pod = next(
+            p for p in router.store.list()
+            if p.address == replies[0].set_headers[HDR_DESTINATION]
+        )
+        assert pod.attrs.get("LastCompletionTokens") == 7
+        await sent.put(None)
+        # Drain to EOF so the RPC completes before the loop tears down
+        # (a half-closed call fires grpc callbacks into a dead loop).
+        while await stream.read() != grpc.aio.EOF:
+            pass
+    finally:
+        await channel.close()
+        await server.stop()
+
+
+async def test_extproc_streamed_trailer_terminated_body_routes():
+    """With request_trailer_mode SEND, a trailer-carrying request ends its
+    body on the TRAILERS message (last chunk eos=false) — routing must
+    fire there or the held chunks never come back."""
+    router = make_router()
+    server = ExtProcServer(router)
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        body = json.dumps({"model": "m", "prompt": "x", "max_tokens": 1}).encode()
+        replies = await client.roundtrip(
+            pb.encode_request_headers({":path": "/v1/completions"}),
+            pb.encode_request_body(body, end_of_stream=False),
+            pb.encode_request_trailers(),
+        )
+        kinds = [r.kind for r in replies]
+        assert kinds == ["request_headers", "request_body", "request_trailers"]
+        assert HDR_DESTINATION in replies[0].set_headers
+        assert replies[1].body == body
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_extproc_buffered_mode_fallback():
+    """mode='buffered' keeps the legacy exchange for older Envoy configs:
+    immediate CONTINUE to headers, mutations on the body response."""
+    router = make_router()
+    server = ExtProcServer(router, mode="buffered")
+    port = await server.start()
+    client = ExtProcClient(port)
+    try:
+        replies = await client.roundtrip(
+            pb.encode_request_headers({":path": "/v1/completions"}),
+            pb.encode_request_body(json.dumps({
+                "model": "m", "prompt": "x", "max_tokens": 1,
+            }).encode()),
+        )
+        assert [r.kind for r in replies] == ["request_headers", "request_body"]
+        assert HDR_DESTINATION in replies[1].set_headers
     finally:
         await client.close()
         await server.stop()
