@@ -439,6 +439,139 @@ async def _stream_response(
     return resp
 
 
+async def _stream_response_multi(
+    request: web.Request,
+    engine: AsyncEngine,
+    rid: str,
+    model: str,
+    prompt_ids: list[int],
+    sampling: SamplingParams,
+    tokenizer,
+    stops: list[str],
+    n: int,
+    priority: int,
+    kv_transfer_params: dict | None,
+    chat: bool,
+    span=None,
+    lora_id: int = 0,
+    lora_name: str = "",
+) -> web.StreamResponse:
+    """SSE with n>1: one engine stream per choice, chunks multiplexed onto
+    the response with their choice index (OpenAI interleave semantics).
+    Choice i derives seed+i when seeded; only choice 0 carries the remote
+    KV pull — mirroring the non-streaming n>1 path."""
+    resp = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "x-request-id": rid,
+        }
+    )
+    await resp.prepare(request)
+    if chat:
+        for i in range(n):
+            await resp.write(_sse(
+                P.chat_chunk(rid, model, {"role": "assistant"}, None, index=i)
+            ))
+    queue: asyncio.Queue = asyncio.Queue()
+    totals = {"out": 0, "cached": 0}
+
+    async def pump(i: int) -> None:
+        sp = (
+            dataclasses.replace(sampling, seed=sampling.seed + i)
+            if sampling.seed is not None
+            else sampling
+        )
+        crid = f"{rid}-{i}"
+        detok = Detokenizer(tokenizer, stops)
+        terminal = False
+        try:
+            async for out in engine.generate(
+                crid, prompt_ids, sp, priority,
+                kv_transfer_params if i == 0 else None, lora_id, lora_name,
+            ):
+                delta = detok.feed(out.new_token_ids, final=out.finished)
+                finish = None
+                if detok.stopped:
+                    engine.abort(crid)
+                    finish = "stop"
+                elif out.finished:
+                    finish = (
+                        out.finish_reason.value if out.finish_reason else None
+                    )
+                if delta:
+                    await queue.put(("delta", i, delta))
+                if finish is not None or out.finished:
+                    totals["out"] += out.num_output_tokens
+                    totals["cached"] = max(
+                        totals["cached"], out.num_cached_tokens
+                    )
+                    terminal = True
+                    await queue.put(("finish", i, finish))
+                    return
+            # Generator exhausted without a finished output (defensive):
+            # still emit a terminal item or the consumer loop waits forever.
+            terminal = True
+            await queue.put(("finish", i, None))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # ANY pump failure must surface as a terminal item — a silent
+            # exit deadlocks the `while done < n` consumer.
+            if not terminal:
+                await queue.put(("error", i, e))
+
+    tasks = [asyncio.ensure_future(pump(i)) for i in range(n)]
+    done = 0
+    try:
+        while done < n:
+            kind, i, payload = await queue.get()
+            if kind == "error":
+                await resp.write(_sse(P.error_body(
+                    str(payload),
+                    code=400 if isinstance(payload, RequestFailed) else 500,
+                )))
+                await resp.write(b"data: [DONE]\n\n")
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                return resp
+            if kind == "delta":
+                chunk = (
+                    P.chat_chunk(rid, model, {"content": payload}, None, index=i)
+                    if chat
+                    else P.completion_chunk(rid, model, payload, None, index=i)
+                )
+            else:
+                done += 1
+                chunk = (
+                    P.chat_chunk(rid, model, {}, payload, index=i)
+                    if chat
+                    else P.completion_chunk(rid, model, "", payload, index=i)
+                )
+            await resp.write(_sse(chunk))
+    except (asyncio.CancelledError, ConnectionResetError):
+        for i in range(n):
+            engine.abort(f"{rid}-{i}")
+        for t in tasks:
+            t.cancel()
+        raise
+    if span is not None:
+        span.set("gen_ai.usage.completion_tokens", totals["out"])
+        span.set("llm_d.cache.hit_tokens", totals["cached"])
+    usage_chunk = {
+        "id": rid,
+        "object": "chat.completion.chunk" if chat else "text_completion",
+        "model": model,
+        "choices": [],
+        "usage": P.usage_dict(len(prompt_ids), totals["out"], totals["cached"]),
+    }
+    await resp.write(_sse(usage_chunk))
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
 class UnknownModelError(Exception):
     pass
 
@@ -480,8 +613,6 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         return _error(400, str(e))
     if req.n < 1 or req.n > 16:
         return _error(400, "n must be in [1, 16]")
-    if req.n != 1 and req.stream:
-        return _error(400, "streaming supports n=1 only")
     if len(prompt_ids) >= max_len:
         return _error(400, f"prompt length {len(prompt_ids)} >= max_model_len {max_len}")
     budget = max_len - len(prompt_ids)
@@ -511,6 +642,13 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
 
     if req.stream:
         try:
+            if req.n > 1:
+                return await _stream_response_multi(
+                    request, engine, rid, model, prompt_ids, sampling,
+                    tokenizer, P.stop_strings(req.stop), req.n,
+                    req.priority, req.kv_transfer_params, chat, span,
+                    lora_id, lora_name,
+                )
             return await _stream_response(
                 request, engine, rid, model, prompt_ids, sampling, detok,
                 req.priority, req.kv_transfer_params, chat, span,
@@ -858,6 +996,12 @@ async def handle_chat(request: web.Request) -> web.StreamResponse:
 # --------------------------------------------------------------------- #
 
 
+def _responses_routes() -> list:
+    from llmd_tpu.serve.responses import make_handlers
+
+    return make_handlers(ENGINE_KEY, TOK_KEY, MODEL_KEY, MAXLEN_KEY)
+
+
 def build_app(
     engine: AsyncEngine,
     tokenizer,
@@ -872,6 +1016,9 @@ def build_app(
     app[MODEL_KEY] = model_name
     app[MAXLEN_KEY] = max_model_len
     app[LORA_KEY] = dict(lora_adapters or {})
+    from llmd_tpu.serve.responses import STORE_KEY, ResponsesStore
+
+    app[STORE_KEY] = ResponsesStore()
     app.add_routes(
         [
             web.get("/health", handle_health),
@@ -885,6 +1032,7 @@ def build_app(
             web.post("/v1/chat/completions", handle_chat),
             web.post("/v1/completions/render", handle_completions_render),
             web.post("/v1/chat/completions/render", handle_chat_render),
+            *_responses_routes(),
             web.post("/admin/pause", handle_admin_pause),
             web.post("/admin/resume", handle_admin_resume),
             web.post("/admin/drain", handle_admin_drain),

@@ -156,27 +156,33 @@ def chat_response(
     return out
 
 
-def completion_chunk(rid: str, model: str, text: str, finish_reason: str | None) -> dict:
+def completion_chunk(
+    rid: str, model: str, text: str, finish_reason: str | None, index: int = 0
+) -> dict:
     return {
         "id": rid,
         "object": "text_completion",
         "created": int(time.time()),
         "model": model,
         "choices": [
-            {"index": 0, "text": text, "logprobs": None, "finish_reason": finish_reason}
+            {"index": index, "text": text, "logprobs": None,
+             "finish_reason": finish_reason}
         ],
     }
 
 
 def chat_chunk(
-    rid: str, model: str, delta: dict, finish_reason: str | None
+    rid: str, model: str, delta: dict, finish_reason: str | None,
+    index: int = 0,
 ) -> dict:
     return {
         "id": rid,
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [
+            {"index": index, "delta": delta, "finish_reason": finish_reason}
+        ],
     }
 
 

@@ -347,7 +347,170 @@ async def test_completion_n_choices(client):
         "prompt": "x", "n": 99,
     })
     assert r.status == 400
+    # streaming with n>1 is now a supported surface (interleaved SSE,
+    # covered by test_streaming_n_gt_1_interleaves_choices)
     r = await client.post("/v1/completions", json={
-        "prompt": "x", "n": 2, "stream": True,
+        "prompt": "x", "n": 2, "stream": True, "max_tokens": 2,
     })
-    assert r.status == 400
+    assert r.status == 200
+    async for _ in r.content:
+        pass
+
+
+async def test_streaming_n_gt_1_interleaves_choices(client):
+    """SSE with n>1 (reference capability the round-2 build rejected):
+    every choice index streams deltas and a finish chunk; the final frame
+    aggregates usage across choices."""
+    r = await client.post(
+        "/v1/completions",
+        json={"prompt": "abc", "max_tokens": 5, "temperature": 0.8,
+              "seed": 7, "n": 3, "stream": True},
+    )
+    assert r.status == 200
+    chunks = []
+    async for line in r.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            break
+        chunks.append(json.loads(payload))
+    indices = {c["choices"][0]["index"] for c in chunks if c.get("choices")}
+    assert indices == {0, 1, 2}
+    finishes = [
+        c["choices"][0] for c in chunks
+        if c.get("choices") and c["choices"][0].get("finish_reason")
+    ]
+    assert len(finishes) == 3
+    assert {f["index"] for f in finishes} == {0, 1, 2}
+    assert chunks[-1]["usage"]["completion_tokens"] == 15
+
+
+async def test_streaming_chat_n_gt_1(client):
+    r = await client.post(
+        "/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "hi"}],
+              "max_tokens": 3, "temperature": 0.9, "n": 2, "stream": True},
+    )
+    assert r.status == 200
+    roles, finishes = set(), set()
+    async for line in r.content:
+        line = line.decode().strip()
+        if not line.startswith("data: ") or line.endswith("[DONE]"):
+            continue
+        c = json.loads(line[len("data: "):])
+        for ch in c.get("choices", []):
+            if ch.get("delta", {}).get("role"):
+                roles.add(ch["index"])
+            if ch.get("finish_reason"):
+                finishes.add(ch["index"])
+    assert roles == {0, 1}
+    assert finishes == {0, 1}
+
+
+async def test_responses_create_retrieve_delete(client):
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "tiny", "input": "hello there",
+              "max_output_tokens": 6, "temperature": 0.0},
+    )
+    assert r.status == 200
+    data = await r.json()
+    assert data["object"] == "response"
+    assert data["status"] == "completed"
+    assert data["output"][0]["content"][0]["type"] == "output_text"
+    assert data["usage"]["output_tokens"] >= 1
+    rid = data["id"]
+
+    r = await client.get(f"/v1/responses/{rid}")
+    assert r.status == 200
+    assert (await r.json())["id"] == rid
+
+    r = await client.delete(f"/v1/responses/{rid}")
+    assert (await r.json())["deleted"] is True
+    r = await client.get(f"/v1/responses/{rid}")
+    assert r.status == 404
+
+
+async def test_responses_previous_response_chaining(client):
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "tiny", "input": "first turn", "max_output_tokens": 4,
+              "temperature": 0.0},
+    )
+    first = await r.json()
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "tiny", "input": "second turn",
+              "previous_response_id": first["id"],
+              "max_output_tokens": 4, "temperature": 0.0},
+    )
+    assert r.status == 200
+    second = await r.json()
+    # chained: the second request's input tokens include the first turn
+    assert second["usage"]["input_tokens"] > first["usage"]["input_tokens"]
+    # unknown previous id is a client error
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "tiny", "input": "x", "previous_response_id": "resp_nope"},
+    )
+    assert r.status == 404
+
+
+async def test_responses_streaming_events(client):
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "tiny", "input": "stream me",
+              "max_output_tokens": 5, "temperature": 0.0, "stream": True},
+    )
+    assert r.status == 200
+    events = []
+    cur_event = None
+    async for line in r.content:
+        line = line.decode().strip()
+        if line.startswith("event: "):
+            cur_event = line[len("event: "):]
+        elif line.startswith("data: ") and cur_event:
+            events.append((cur_event, json.loads(line[len("data: "):])))
+    names = [e for e, _ in events]
+    assert names[0] == "response.created"
+    assert "response.output_text.delta" in names
+    assert names[-1] == "response.completed"
+    final = events[-1][1]["response"]
+    assert final["status"] == "completed"
+    assert final["output"][0]["content"][0]["text"]
+
+
+async def test_conversations_flow(client):
+    r = await client.post("/v1/conversations", json={"metadata": {"t": "1"}})
+    conv = await r.json()
+    assert conv["object"] == "conversation"
+    cid = conv["id"]
+
+    r = await client.post(
+        f"/v1/conversations/{cid}/items",
+        json={"items": [{"type": "message", "role": "user",
+                         "content": "remember me"}]},
+    )
+    assert r.status == 200
+    r = await client.get(f"/v1/conversations/{cid}/items")
+    items = (await r.json())["data"]
+    assert items[0]["content"] == "remember me"
+
+    # a response in the conversation consumes + appends its turns
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "tiny", "input": "and this", "conversation": cid,
+              "max_output_tokens": 4, "temperature": 0.0},
+    )
+    assert r.status == 200
+    r = await client.get(f"/v1/conversations/{cid}/items")
+    items = (await r.json())["data"]
+    assert items[-1]["role"] == "assistant"
+    # unknown conversation 404s
+    r = await client.post(
+        "/v1/responses", json={"model": "tiny", "input": "x",
+                               "conversation": "conv_nope"},
+    )
+    assert r.status == 404
