@@ -27,12 +27,25 @@ def main(argv=None) -> None:
         help="pod label selector for in-cluster discovery "
         "(e.g. 'llm-d.ai/role in (decode,prefill-decode)')",
     )
+    p.add_argument(
+        "--inference-pool", default=None,
+        help="bind discovery to an InferencePool object: its "
+        "spec.selector + spec.targetPortNumber replace --k8s-selector/"
+        "--k8s-target-port (Gateway-API inference extension shape)",
+    )
     p.add_argument("--k8s-namespace", default=None)
     p.add_argument("--k8s-target-port", type=int, default=8000)
     p.add_argument(
+        "--k8s-discovery-mode", default="watch", choices=["watch", "poll"],
+        help="watch = LIST once + WATCH stream with resourceVersion "
+        "resume (sub-second endpoint joins, O(changes) API load); "
+        "poll = periodic LIST",
+    )
+    p.add_argument(
         "--k8s-poll-interval", type=float, default=2.0,
-        help="pod LIST poll period (apiserver load; separate from the "
-        "per-endpoint metrics --scrape-interval)",
+        help="pod LIST poll period in poll mode / watch-retry backoff "
+        "(apiserver load; separate from the per-endpoint metrics "
+        "--scrape-interval)",
     )
     p.add_argument("--config", default=None, help="EndpointPickerConfig JSON file")
     p.add_argument(
@@ -58,6 +71,11 @@ def main(argv=None) -> None:
         help="ALSO serve the Envoy ext-proc gRPC protocol on this port "
         "(the reference EPP's primary deployment shape; the HTTP fused "
         "proxy stays up for /metrics and no-Envoy clients)",
+    )
+    p.add_argument(
+        "--ext-proc-mode", default="streamed", choices=["streamed", "buffered"],
+        help="ext-proc body mode: streamed = FULL_DUPLEX_STREAMED (GAIE "
+        "protocol, default); buffered = legacy BUFFERED Envoy configs",
     )
     p.add_argument(
         "--otlp-traces-endpoint", default=None,
@@ -105,12 +123,15 @@ def main(argv=None) -> None:
             "predicted-latency": PREDICTED_LATENCY_CONFIG,
         }[args.preset]
 
-    if not args.endpoints_file and not args.k8s_selector:
-        p.error("one of --endpoints-file or --k8s-selector is required")
-    if args.endpoints_file and args.k8s_selector:
+    if not args.endpoints_file and not args.k8s_selector and not args.inference_pool:
+        p.error(
+            "one of --endpoints-file, --k8s-selector, or --inference-pool "
+            "is required"
+        )
+    if args.endpoints_file and (args.k8s_selector or args.inference_pool):
         # Both sources reconcile the store to THEIR full set, so running
         # two would alternately wipe each other's endpoints every poll.
-        p.error("--endpoints-file and --k8s-selector are mutually exclusive")
+        p.error("--endpoints-file excludes the k8s discovery flags")
 
     store = EndpointStore()
     router = Router(
@@ -140,18 +161,23 @@ def main(argv=None) -> None:
         router, predict_url=args.predictor_url, train_url=args.trainer_url
     )
     app = router.build_app()
-    if args.k8s_selector:
-        from llmd_tpu.epp.k8s_discovery import K8sPodDiscoverySource
+    if args.k8s_selector or args.inference_pool:
+        from llmd_tpu.epp.k8s_discovery import (
+            K8sPodDiscoverySource, resolve_inference_pool,
+        )
 
         k8s = K8sPodDiscoverySource(
             store,
-            label_selector=args.k8s_selector,
+            label_selector=args.k8s_selector or "",
             namespace=args.k8s_namespace,
             target_port=args.k8s_target_port,
             poll_s=args.k8s_poll_interval,
+            mode=args.k8s_discovery_mode,
         )
 
         async def _start_k8s(app):
+            if args.inference_pool:
+                await resolve_inference_pool(k8s, args.inference_pool)
             k8s.start()
 
         app.on_startup.append(_start_k8s)
@@ -159,7 +185,10 @@ def main(argv=None) -> None:
     if args.ext_proc_port is not None:
         from llmd_tpu.epp.extproc import ExtProcServer
 
-        extproc = ExtProcServer(router, host=args.host, port=args.ext_proc_port)
+        extproc = ExtProcServer(
+            router, host=args.host, port=args.ext_proc_port,
+            mode=args.ext_proc_mode,
+        )
 
         async def _start_extproc(app):
             await extproc.start()

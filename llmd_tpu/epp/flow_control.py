@@ -54,6 +54,15 @@ class BandConfig:
     ttl_s: float = 60.0
 
 
+# Default EDF budget for requests that carry NO TTFT SLO. An infinite
+# deadline starves no-SLO traffic whenever SLO-carrying flows keep queue
+# depth (they would sort first forever); a finite default keeps EDF's
+# urgency ordering while guaranteeing no-SLO requests age toward the
+# front (reference keeps fcfs/edf/slo-deadline distinct orderings —
+# flow-control.md; this matches slo-deadline's fallback behavior).
+DEFAULT_EDF_BUDGET_S = 30.0
+
+
 @dataclass
 class _Item:
     req: LLMRequest
@@ -64,10 +73,11 @@ class _Item:
     @property
     def deadline(self) -> float:
         # EDF deadline: arrival + TTFT SLO (flow-control.md ordering edf);
-        # requests without an SLO sort last within the flow.
+        # no-SLO requests get a finite default budget so they cannot be
+        # starved behind a continuous SLO-carrying stream.
         if self.req.ttft_slo_ms is not None:
             return self.req.arrival_time + self.req.ttft_slo_ms / 1000.0
-        return float("inf")
+        return self.req.arrival_time + DEFAULT_EDF_BUDGET_S
 
 
 class SaturationDetector:

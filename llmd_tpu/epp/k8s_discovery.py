@@ -5,10 +5,23 @@ watches the InferencePool selector and joins pods on status Running
 (inferencepool.md:26-31, operations-vllm.md:49-53 — "no central
 bootstrap"). The kubernetes client package is not part of this image,
 so this source speaks to the API server directly over HTTPS using the
-in-cluster service-account credentials, polling the pod list with a
-label selector. Each Running+Ready pod becomes an Endpoint at
-`podIP:port`, carrying its labels (role, engine-type, node) into the
-scheduler's view.
+in-cluster service-account credentials. Each Running+Ready pod becomes
+an Endpoint at `podIP:port`, carrying its labels (role, engine-type,
+node) into the scheduler's view.
+
+Default mode is a WATCH stream (the reference's notification semantics):
+one initial LIST seeds the store and captures its resourceVersion, then
+a chunked watch delivers ADDED/MODIFIED/DELETED events with sub-second
+endpoint-join latency and O(changes) API load. The stream resumes from
+the last seen resourceVersion after disconnects; a 410 Gone (expired
+version) falls back to a fresh LIST. ``mode="poll"`` keeps the simple
+list-polling behavior.
+
+The selector/port can come from an ``InferencePool`` object
+(inferencepool.md:26-37): ``resolve_inference_pool`` reads the CRD's
+``spec.selector`` + ``spec.targetPortNumber`` so the EPP binds to the
+pool resource a Gateway's HTTPRoute backendRef names
+(deploy/recipes/router/inferencepool-crd.yaml ships the CRD + example).
 """
 
 from __future__ import annotations
@@ -26,6 +39,43 @@ from llmd_tpu.epp.types import Endpoint
 log = logging.getLogger(__name__)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+INFERENCE_POOL_API = "apis/inference.networking.x-k8s.io/v1alpha2"
+
+
+class _WatchExpired(Exception):
+    """410 Gone: the watch resourceVersion left etcd's history window."""
+
+
+async def resolve_inference_pool(
+    source: "K8sPodDiscoverySource", name: str
+) -> None:
+    """Bind a discovery source to an InferencePool object: read the CRD's
+    spec.selector (matchLabels) + spec.targetPortNumber and install them
+    as the source's label selector / target port (inferencepool.md:26-37).
+    """
+    session = await source._client()
+    url = (
+        f"{source.api_server}/{INFERENCE_POOL_API}/namespaces/"
+        f"{source.namespace}/inferencepools/{name}"
+    )
+    async with session.get(
+        url, headers={"authorization": f"Bearer {source._token()}"}
+    ) as resp:
+        resp.raise_for_status()
+        pool = json.loads(await resp.text())
+    spec = pool.get("spec", {})
+    selector = spec.get("selector") or {}
+    match = selector.get("matchLabels") or selector  # both CRD shapes
+    if not match:
+        raise ValueError(f"InferencePool {name!r} has no selector")
+    source.label_selector = ",".join(f"{k}={v}" for k, v in sorted(match.items()))
+    port = spec.get("targetPortNumber") or spec.get("targetPort")
+    if port:
+        source.target_port = int(port)
+    log.info(
+        "bound to InferencePool %s: selector=%r port=%d",
+        name, source.label_selector, source.target_port,
+    )
 
 
 class K8sPodDiscoverySource:
@@ -41,7 +91,10 @@ class K8sPodDiscoverySource:
         namespace_path: str = f"{SA_DIR}/namespace",
         poll_s: float = 2.0,
         node_label: str = "llm-d.ai/node",
+        mode: str = "watch",
     ) -> None:
+        if mode not in ("watch", "poll"):
+            raise ValueError(f"unknown discovery mode {mode!r}")
         self.store = store
         self.label_selector = label_selector
         self.target_port = target_port
@@ -50,6 +103,10 @@ class K8sPodDiscoverySource:
         self.ca_path = ca_path
         self.poll_s = poll_s
         self.node_label = node_label
+        self.mode = mode
+        # watch state: pod name -> endpoints, and the resume version
+        self._pods: dict[str, list[Endpoint]] = {}
+        self._resource_version: str | None = None
         if namespace is None:
             try:
                 with open(namespace_path) as f:
@@ -153,13 +210,118 @@ class K8sPodDiscoverySource:
         self.store.reconcile(eps)
         return eps
 
+    # ------------------------------------------------------------- watch
+
+    async def list_once(self) -> None:
+        """Seed the store with a full LIST; remember its resourceVersion
+        as the watch resume point."""
+        session = await self._client()
+        qs = urllib.parse.urlencode({"labelSelector": self.label_selector})
+        url = f"{self.api_server}/api/v1/namespaces/{self.namespace}/pods?{qs}"
+        async with session.get(
+            url, headers={"authorization": f"Bearer {self._token()}"}
+        ) as resp:
+            resp.raise_for_status()
+            body = json.loads(await resp.text())
+        self._resource_version = body.get("metadata", {}).get("resourceVersion")
+        self._pods = {
+            p["metadata"]["name"]: (
+                self._endpoints_for(p) if self._pod_ready(p) else []
+            )
+            for p in body.get("items", [])
+        }
+        self._reconcile()
+
+    def _reconcile(self) -> None:
+        self.store.reconcile([ep for eps in self._pods.values() for ep in eps])
+
+    def _apply_event(self, event: dict) -> None:
+        etype = event.get("type")
+        obj = event.get("object") or {}
+        rv = obj.get("metadata", {}).get("resourceVersion")
+        if rv:
+            self._resource_version = rv
+        if etype == "BOOKMARK":
+            return
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            return
+        if etype == "DELETED":
+            self._pods.pop(name, None)
+        elif etype in ("ADDED", "MODIFIED"):
+            self._pods[name] = (
+                self._endpoints_for(obj) if self._pod_ready(obj) else []
+            )
+        else:
+            return
+        self._reconcile()
+
+    async def watch_once(self) -> None:
+        """One watch stream: apply events until the server closes it.
+
+        Raises _WatchExpired on 410 Gone (the resume version fell out of
+        etcd's window) so the caller re-lists.
+        """
+        session = await self._client()
+        params = {
+            "labelSelector": self.label_selector,
+            "watch": "1",
+            "allowWatchBookmarks": "true",
+        }
+        if self._resource_version:
+            params["resourceVersion"] = self._resource_version
+        qs = urllib.parse.urlencode(params)
+        url = f"{self.api_server}/api/v1/namespaces/{self.namespace}/pods?{qs}"
+        async with session.get(
+            url,
+            headers={"authorization": f"Bearer {self._token()}"},
+            timeout=aiohttp.ClientTimeout(total=None, sock_read=330),
+        ) as resp:
+            resp.raise_for_status()
+            # Manual line framing: StreamReader's line iterator enforces a
+            # ~64KB line limit, and one pod event (managedFields, volumes)
+            # can exceed it — which would demote every future watch into a
+            # 1s full-LIST loop. iter_any + a buffer has no such limit.
+            buf = b""
+            async for data in resp.content.iter_any():
+                buf += data
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        event = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if event.get("type") == "ERROR":
+                        code = (event.get("object") or {}).get("code")
+                        if code == 410:
+                            raise _WatchExpired()
+                        raise RuntimeError(f"watch error event: {event}")
+                    self._apply_event(event)
+
     async def run(self) -> None:
+        if self.mode == "poll":
+            while True:
+                try:
+                    await self.poll_once()
+                except Exception as e:
+                    log.warning("k8s pod discovery poll failed: %s", e)
+                await asyncio.sleep(self.poll_s)
         while True:
             try:
-                await self.poll_once()
+                if self._resource_version is None:
+                    await self.list_once()
+                await self.watch_once()
+                # clean server-side close: resume from the last version
+            except _WatchExpired:
+                log.info("watch resourceVersion expired; re-listing")
+                self._resource_version = None
             except Exception as e:
-                log.warning("k8s pod discovery poll failed: %s", e)
-            await asyncio.sleep(self.poll_s)
+                log.warning("k8s pod watch failed (%s); re-listing", e)
+                self._resource_version = None
+                await asyncio.sleep(min(self.poll_s, 1.0))
 
     def start(self) -> None:
         self._task = asyncio.get_event_loop().create_task(self.run())

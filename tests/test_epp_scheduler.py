@@ -315,6 +315,61 @@ async def test_flow_unconfigured_priority_keeps_rank():
     await fc.drain()
 
 
+async def test_flow_edf_no_slo_not_starved():
+    """EDF: a no-SLO request gets a FINITE default deadline (arrival +
+    DEFAULT_EDF_BUDGET_S) so an SLO-carrying stream cannot starve it —
+    once aged, it sorts ahead of fresher SLO requests whose deadlines
+    land later."""
+    import time as _time
+
+    from llmd_tpu.epp.flow_control import DEFAULT_EDF_BUDGET_S
+
+    fc = FlowControl(
+        ordering="edf", saturation=SaturationDetector(max_inflight=1)
+    )
+    fc.start()
+    order = []
+
+    async def run(req):
+        await fc.enqueue_and_wait(req)
+        order.append(req.request_id)
+
+    now = _time.monotonic()
+    warm = asyncio.create_task(run(LLMRequest(request_id="warm")))
+    await asyncio.sleep(0.05)
+    # Aged no-SLO request: deadline = (now - 25) + 30 = now + 5.
+    no_slo = asyncio.create_task(run(LLMRequest(
+        request_id="no-slo", arrival_time=now - (DEFAULT_EDF_BUDGET_S - 5),
+    )))
+    # Fresh SLO-carrying request with a 10 s budget: deadline = now + 10
+    # (later than the aged no-SLO's) — must NOT jump the queue.
+    slo = asyncio.create_task(run(LLMRequest(
+        request_id="slo", arrival_time=now, ttft_slo_ms=10_000,
+    )))
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.gather(warm, no_slo, slo)
+    assert order == ["warm", "no-slo", "slo"], order
+    fc.release()  # free the slot held by the last dispatch
+    # ...while a TIGHT SLO still wins over a fresh no-SLO request.
+    warm2 = asyncio.create_task(run(LLMRequest(request_id="warm2")))
+    await asyncio.sleep(0.05)
+    fresh_no_slo = asyncio.create_task(run(LLMRequest(request_id="fresh")))
+    tight = asyncio.create_task(run(LLMRequest(
+        request_id="tight", ttft_slo_ms=500,
+    )))
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.sleep(0.05)
+    fc.release()
+    await asyncio.gather(warm2, fresh_no_slo, tight)
+    assert order[-2:] == ["tight", "fresh"], order
+    fc.release()
+    await fc.drain()
+
+
 async def test_flow_disabled_passthrough():
     fc = FlowControl(enabled=False, saturation=SaturationDetector(max_inflight=0))
     out = await fc.enqueue_and_wait(LLMRequest(request_id="x"))

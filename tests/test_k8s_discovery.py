@@ -1,0 +1,219 @@
+"""Watch-based k8s pod discovery (reference k8s-notification-source,
+datalayer.md:49-91) + InferencePool binding (inferencepool.md:26-37),
+against a simulated API server: LIST seeding, chunked WATCH events,
+resourceVersion resume after stream close, 410 Gone -> re-list, and
+InferencePool selector/port resolution."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from llmd_tpu.epp.datalayer import EndpointStore
+from llmd_tpu.epp.k8s_discovery import (
+    K8sPodDiscoverySource, resolve_inference_pool,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def pod(name: str, ip: str, ready: bool = True, rv: str = "1") -> dict:
+    return {
+        "metadata": {
+            "name": name, "resourceVersion": rv,
+            "labels": {"llm-d.ai/role": "decode"},
+        },
+        "spec": {"nodeName": "node-1"},
+        "status": {
+            "phase": "Running",
+            "podIP": ip,
+            "conditions": [{"type": "Ready", "status": "True" if ready else "False"}],
+        },
+    }
+
+
+class FakeAPIServer:
+    """Enough of the pods API for list+watch: scripted watch streams."""
+
+    def __init__(self):
+        self.list_pods: list[dict] = []
+        self.list_rv = "10"
+        # each watch call consumes the next script: list of event dicts,
+        # or the string "410" to emit an expired error event
+        self.watch_scripts: list = []
+        self.watch_queries: list[dict] = []
+        self.list_calls = 0
+        app = web.Application()
+        app.router.add_get("/api/v1/namespaces/ns/pods", self.handle)
+        app.router.add_get(
+            "/apis/inference.networking.x-k8s.io/v1alpha2/namespaces/ns/"
+            "inferencepools/{name}", self.handle_pool,
+        )
+        self.server = TestServer(app)
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        if request.query.get("watch") != "1":
+            self.list_calls += 1
+            return web.json_response({
+                "metadata": {"resourceVersion": self.list_rv},
+                "items": self.list_pods,
+            })
+        self.watch_queries.append(dict(request.query))
+        script = self.watch_scripts.pop(0) if self.watch_scripts else []
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        if script == "410":
+            await resp.write(json.dumps({
+                "type": "ERROR",
+                "object": {"kind": "Status", "code": 410},
+            }).encode() + b"\n")
+        else:
+            for event in script:
+                await resp.write(json.dumps(event).encode() + b"\n")
+        await resp.write_eof()
+        return resp
+
+    async def handle_pool(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "spec": {
+                "selector": {"llm-d.ai/role": "decode", "app": "m"},
+                "targetPortNumber": 9001,
+            }
+        })
+
+    async def start(self):
+        await self.server.start_server()
+        return f"http://{self.server.host}:{self.server.port}"
+
+
+def make_source(store, url, tmp_path, **kw):
+    token = tmp_path / "token"
+    token.write_text("t0k3n")
+    return K8sPodDiscoverySource(
+        store,
+        label_selector="llm-d.ai/role=decode",
+        namespace="ns",
+        api_server=url,
+        token_path=str(token),
+        ca_path=str(tmp_path / "nope.crt"),
+        poll_s=0.05,
+        **kw,
+    )
+
+
+async def _wait_for(cond, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+async def test_watch_applies_events_and_resumes(tmp_path):
+    api = FakeAPIServer()
+    api.list_pods = [pod("a", "10.0.0.1", rv="9")]
+    api.watch_scripts = [
+        [
+            {"type": "ADDED", "object": pod("b", "10.0.0.2", rv="11")},
+            {"type": "MODIFIED", "object": pod("a", "10.0.0.1", ready=False, rv="12")},
+        ],
+        [],  # resumed stream (asserted via watch_queries)
+    ]
+    url = await api.start()
+    store = EndpointStore()
+    src = make_source(store, url, tmp_path)
+    task = asyncio.ensure_future(src.run())
+    try:
+        assert await _wait_for(
+            lambda: {e.address for e in store.list()} == {"10.0.0.2:8000"}
+        ), [e.address for e in store.list()]
+        # second watch resumed from the last event's resourceVersion
+        assert await _wait_for(lambda: len(api.watch_queries) >= 2)
+        assert api.watch_queries[1]["resourceVersion"] == "12"
+        assert api.list_calls == 1  # no re-list on clean close
+    finally:
+        task.cancel()
+        await src.close()
+        await api.server.close()
+
+
+async def test_watch_410_triggers_relist(tmp_path):
+    api = FakeAPIServer()
+    api.list_pods = [pod("a", "10.0.0.1")]
+    api.watch_scripts = ["410", []]
+    url = await api.start()
+    store = EndpointStore()
+    src = make_source(store, url, tmp_path)
+    task = asyncio.ensure_future(src.run())
+    try:
+        assert await _wait_for(lambda: api.list_calls >= 2)
+        assert {e.address for e in store.list()} == {"10.0.0.1:8000"}
+        # the post-410 watch starts from the fresh list's version
+        assert await _wait_for(lambda: len(api.watch_queries) >= 2)
+        assert api.watch_queries[1]["resourceVersion"] == api.list_rv
+    finally:
+        task.cancel()
+        await src.close()
+        await api.server.close()
+
+
+async def test_watch_delete_removes_endpoint(tmp_path):
+    api = FakeAPIServer()
+    api.list_pods = [pod("a", "10.0.0.1", rv="9"), pod("b", "10.0.0.2", rv="9")]
+    api.watch_scripts = [
+        [{"type": "DELETED", "object": pod("b", "10.0.0.2", rv="11")}],
+        [],
+    ]
+    url = await api.start()
+    store = EndpointStore()
+    src = make_source(store, url, tmp_path)
+    task = asyncio.ensure_future(src.run())
+    try:
+        assert await _wait_for(
+            lambda: {e.address for e in store.list()} == {"10.0.0.1:8000"}
+        )
+    finally:
+        task.cancel()
+        await src.close()
+        await api.server.close()
+
+
+async def test_inference_pool_binding(tmp_path):
+    api = FakeAPIServer()
+    url = await api.start()
+    store = EndpointStore()
+    src = make_source(store, url, tmp_path)
+    try:
+        await resolve_inference_pool(src, "llmd-decode-pool")
+        assert src.label_selector == "app=m,llm-d.ai/role=decode"
+        assert src.target_port == 9001
+    finally:
+        await src.close()
+        await api.server.close()
+
+
+async def test_poll_mode_still_works(tmp_path):
+    api = FakeAPIServer()
+    api.list_pods = [pod("a", "10.0.0.1")]
+    url = await api.start()
+    store = EndpointStore()
+    src = make_source(store, url, tmp_path, mode="poll")
+    task = asyncio.ensure_future(src.run())
+    try:
+        assert await _wait_for(
+            lambda: {e.address for e in store.list()} == {"10.0.0.1:8000"}
+        )
+        assert await _wait_for(lambda: api.list_calls >= 2)  # keeps polling
+        assert not api.watch_queries
+    finally:
+        task.cancel()
+        await src.close()
+        await api.server.close()

@@ -224,3 +224,16 @@ async def test_completion_feedback_trains_local_model():
         await producer.produce(req, [pod])
         await producer.on_complete(req, pod, ttft_ms=55.0, tpot_ms=9.0)
     assert client.predictor.samples_seen == before + 10  # 5 ttft + 5 tpot
+
+
+def test_predictor_accuracy_mape_gate():
+    """Accuracy gate against the reference's ~5% MAPE bar
+    (latency-predictor.md:58) on a mixed-regime synthetic trace
+    (nonlinear KV-congestion x prefix-hit ground truth + 5% observation
+    noise). The stratified ridge must land within 1.5x the bar for TTFT
+    and well under it for TPOT."""
+    from llmd_tpu.predictor.synth import run_accuracy_eval
+
+    res = run_accuracy_eval()
+    assert res["ttft_mape"] < 0.075, res
+    assert res["tpot_mape"] < 0.05, res
