@@ -50,6 +50,17 @@ class ModelConfig:
     attention_bias: bool = False
     # Qwen3-style per-head RMS norm on Q and K (applied before RoPE).
     qk_norm: bool = False
+    # --- sliding-window attention (gpt-oss / Mistral / long-context Qwen) ---
+    # sliding_window > 0 limits attention to the trailing N positions.
+    # Which layers it applies to follows the HF conventions:
+    #   layer_types set  -> per-layer "sliding_attention"/"full_attention"
+    #                       (gpt-oss alternating pattern)
+    #   max_window_layers >= 0 -> layers >= max_window_layers slide
+    #                       (Qwen2 use_sliding_window semantics)
+    #   neither          -> every layer slides (Mistral)
+    sliding_window: int = 0
+    layer_types: tuple | None = None
+    max_window_layers: int | None = None
     # --- multi-LoRA serving (reference model-servers.md:78-89) ---
     # num_lora_adapters > 0 allocates that many adapter slots (rank
     # lora_rank, applied to the q and v projections); slot 0 is reserved
@@ -99,6 +110,19 @@ class ModelConfig:
             self.head_dim = self.hidden_size // self.num_heads
         if self.moe_intermediate_size is None:
             self.moe_intermediate_size = self.intermediate_size
+        if self.layer_types is not None:
+            self.layer_types = tuple(self.layer_types)
+            if len(self.layer_types) != self.num_layers:
+                raise ValueError(
+                    f"layer_types has {len(self.layer_types)} entries for "
+                    f"{self.num_layers} layers"
+                )
+        if self.sliding_window > 0 and self.kv_lora_rank > 0:
+            raise ValueError(
+                "sliding_window is not supported with MLA (no known MLA "
+                "architecture slides; the latent path would silently attend "
+                "past the window)"
+            )
         if self.kv_lora_rank > 0 and self.attention_bias:
             raise ValueError(
                 "attention_bias is not supported with MLA (kv_lora_rank > 0): "
@@ -111,6 +135,24 @@ class ModelConfig:
                 "attention path would silently serve base-model outputs for "
                 "adapter requests"
             )
+
+    def window_for_layer(self, i: int) -> int:
+        """Attention window for layer ``i`` (0 = full attention)."""
+        if self.sliding_window <= 0:
+            return 0
+        if self.layer_types is not None:
+            return (
+                self.sliding_window
+                if self.layer_types[i] == "sliding_attention"
+                else 0
+            )
+        if self.max_window_layers is not None:
+            return self.sliding_window if i >= self.max_window_layers else 0
+        return self.sliding_window
+
+    @property
+    def layer_windows(self) -> tuple[int, ...]:
+        return tuple(self.window_for_layer(i) for i in range(self.num_layers))
 
     @property
     def is_moe(self) -> bool:

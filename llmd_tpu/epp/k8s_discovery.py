@@ -309,19 +309,29 @@ class K8sPodDiscoverySource:
                 except Exception as e:
                     log.warning("k8s pod discovery poll failed: %s", e)
                 await asyncio.sleep(self.poll_s)
+        import time as _time
+
         while True:
+            t0 = _time.monotonic()
             try:
                 if self._resource_version is None:
                     await self.list_once()
                 await self.watch_once()
-                # clean server-side close: resume from the last version
+                # Clean server-side close: resume from the last version.
+                # Guard against proxies that terminate streaming GETs
+                # instantly — back-to-back re-watches would storm the
+                # apiserver while everything looks healthy.
+                if _time.monotonic() - t0 < 1.0:
+                    await asyncio.sleep(min(self.poll_s, 1.0))
             except _WatchExpired:
                 log.info("watch resourceVersion expired; re-listing")
                 self._resource_version = None
             except Exception as e:
                 log.warning("k8s pod watch failed (%s); re-listing", e)
                 self._resource_version = None
-                await asyncio.sleep(min(self.poll_s, 1.0))
+                # Full poll_s backoff: each retry re-LISTs, and a 1 Hz
+                # LIST herd is worst exactly when the apiserver is sick.
+                await asyncio.sleep(self.poll_s)
 
     def start(self) -> None:
         self._task = asyncio.get_event_loop().create_task(self.run())

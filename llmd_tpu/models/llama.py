@@ -166,7 +166,7 @@ def forward_hidden(
     valid = inp.valid
     sm_scale = D**-0.5
 
-    def layer_body(x, cache, lp, layer_idx, use_moe: bool):
+    def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         if cfg.is_mla:
             from llmd_tpu.models.mla import mla_attention
@@ -212,7 +212,7 @@ def forward_hidden(
             )
             attn = paged_attention_full(
                 q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
-                sm_scale, world_size=world_size, mesh=mesh,
+                sm_scale, world_size=world_size, mesh=mesh, window=window,
             )
             x = x + pdot(attn.reshape(B, Q, Nq * D), lp, "wo")
         h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
@@ -244,26 +244,39 @@ def forward_hidden(
     # the layer-indexed kernels write/read cache[layer] in place so no
     # pool-sized slice ever materializes.
     n_dense = cfg.first_dense_layers if cfg.is_moe else 0
+    # Per-layer sliding windows (gpt-oss alternating / Qwen2 upper-layer /
+    # Mistral uniform patterns); None for full-attention models keeps the
+    # scan signature (and compile cache) unchanged.
+    sliding = cfg.sliding_window > 0 and not cfg.is_mla
+    windows = (
+        jnp.asarray(cfg.layer_windows, jnp.int32) if sliding else None
+    )
     for i in range(n_dense):
         lp_i = jax.tree.map(lambda a: a[i], params["dense_layers"])
         x, kv_cache = layer_body(
-            x, kv_cache, lp_i, jnp.int32(i), use_moe=False
+            x, kv_cache, lp_i, jnp.int32(i), use_moe=False,
+            window=None if windows is None else windows[i],
         )
 
     def layer_fn(carry, scanned):
         x, cache = carry
-        lp, layer_idx = scanned
-        x, cache = layer_body(x, cache, lp, layer_idx, use_moe=cfg.is_moe)
+        if windows is None:
+            lp, layer_idx = scanned
+            window = None
+        else:
+            lp, layer_idx, window = scanned
+        x, cache = layer_body(
+            x, cache, lp, layer_idx, use_moe=cfg.is_moe, window=window
+        )
         return (x, cache), None
 
-    (hidden, new_cache), _ = jax.lax.scan(
-        layer_fn,
-        (x, kv_cache),
-        (
-            params["layers"],
-            jnp.arange(n_dense, cfg.num_layers, dtype=jnp.int32),
-        ),
+    layer_ids = jnp.arange(n_dense, cfg.num_layers, dtype=jnp.int32)
+    scanned = (
+        (params["layers"], layer_ids)
+        if windows is None
+        else (params["layers"], layer_ids, windows[n_dense:])
     )
+    (hidden, new_cache), _ = jax.lax.scan(layer_fn, (x, kv_cache), scanned)
     hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     return hidden, new_cache
 

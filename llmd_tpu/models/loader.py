@@ -63,14 +63,6 @@ def config_from_hf(model_dir: str, **overrides) -> ModelConfig:
             f"unsupported architecture {arch!r} in {model_dir}; "
             f"supported: {sorted(SUPPORTED_ARCHS)}"
         )
-    # Fail fast on semantics we would otherwise silently get wrong.
-    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
-        raise ValueError(
-            f"{arch} checkpoint uses sliding-window attention "
-            f"(sliding_window={hf['sliding_window']}), which this engine "
-            "does not implement — full attention past the window would "
-            "silently diverge from the trained model"
-        )
     from llmd_tpu.models.common import SUPPORTED_ROPE_TYPES, rope_type
 
     rope_scaling = hf.get("rope_scaling")
@@ -108,6 +100,20 @@ def config_from_hf(model_dir: str, **overrides) -> ModelConfig:
             "float32": "float32", "bfloat16": "bfloat16",
         }.get(str(hf.get("dtype") or hf.get("torch_dtype")), "bfloat16"),
     )
+    # Sliding-window attention, in the HF conventions: Mistral-style
+    # uniform windows, Qwen2's use_sliding_window + max_window_layers
+    # (layers >= max_window_layers slide), and gpt-oss-style per-layer
+    # layer_types ("sliding_attention"/"full_attention").
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+        kw["sliding_window"] = int(hf["sliding_window"])
+        if hf.get("layer_types"):
+            kw["layer_types"] = tuple(hf["layer_types"])
+        elif "use_sliding_window" in hf:
+            # Qwen2-style config: layers >= max_window_layers slide. A
+            # checkpoint that omits the key inherits HF's class default
+            # (Qwen2Config: 28) — falling through to uniform windows here
+            # would silently slide layers the trained model didn't.
+            kw["max_window_layers"] = int(hf.get("max_window_layers", 28))
     if arch == "Qwen2ForCausalLM":
         # Qwen2 uses bias on the QKV projections (no config flag).
         kw["attention_bias"] = True

@@ -144,14 +144,16 @@ def _plan_mla(Q, page, Dl, rank, world_size, mesh, B, H):
 _DENSE_XLA_MAX_S = 4096
 
 
-def _attention_xla(q, kv_slice, page_table, kv_lens, positions, sm_scale):
+def _attention_xla(q, kv_slice, page_table, kv_lens, positions, sm_scale,
+                   window=None):
     S = page_table.shape[1] * kv_slice.shape[-2]
     if q.shape[1] > 1 and S > _DENSE_XLA_MAX_S:
         return paged_attention_xla_blocked(
-            q, kv_slice, page_table, kv_lens, positions, sm_scale
+            q, kv_slice, page_table, kv_lens, positions, sm_scale,
+            window=window,
         )
     return paged_attention_xla(
-        q, kv_slice, page_table, kv_lens, positions, sm_scale
+        q, kv_slice, page_table, kv_lens, positions, sm_scale, window=window
     )
 
 
@@ -350,35 +352,44 @@ def mla_paged_attention_full(
 
 def paged_attention_full(
     q, kv_cache_full, layer, page_table, kv_lens, positions,
-    sm_scale=None, world_size=1, mesh=None,
+    sm_scale=None, world_size=1, mesh=None, window=None,
 ):
     """Layer-indexed attention on the FULL [L, ...] cache (see
-    write_kv_pages_full)."""
+    write_kv_pages_full). ``window`` is an optional i32 scalar sliding
+    window (0/None = full attention; a traced per-layer value inside the
+    layer scan)."""
     L, num_pages, K, page, D2 = kv_cache_full.shape
     B, Q, H, D = q.shape
     plan = _plan(Q, page, D, D2, world_size, True, mesh, B, H, K)
+    if window is not None:
+        window = jnp.asarray(window, jnp.int32)
     if plan == "direct":
         return decode_paged_attention_full(
             q, kv_cache_full, layer, page_table, kv_lens, sm_scale=sm_scale,
-            interpret=_interpret(),
+            interpret=_interpret(), window=window,
         )
     if plan == "shard":
         tp_k = _kv_head_axis(K, mesh.shape["tp"])
         interpret = _interpret()
+        win = jnp.zeros((), jnp.int32) if window is None else window
+        use_win = window is not None
 
-        def local(q, cache, layer, pt, kl):
+        def local(q, cache, layer, pt, kl, win):
             return decode_paged_attention_full(
-                q, cache, layer, pt, kl, sm_scale=sm_scale, interpret=interpret
+                q, cache, layer, pt, kl, sm_scale=sm_scale,
+                interpret=interpret, window=win if use_win else None,
             )
 
         return shard_map(
             local, mesh=mesh,
             in_specs=(
                 P("dp", None, "tp", None), P(None, None, tp_k, None, None),
-                P(), P("dp", None), P("dp"),
+                P(), P("dp", None), P("dp"), P(),
             ),
             out_specs=P("dp", None, "tp", None),
             check_vma=False,
-        )(q, kv_cache_full, layer, page_table, kv_lens)
+        )(q, kv_cache_full, layer, page_table, kv_lens, win)
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
-    return _attention_xla(q, sl, page_table, kv_lens, positions, sm_scale)
+    return _attention_xla(
+        q, sl, page_table, kv_lens, positions, sm_scale, window=window
+    )

@@ -54,6 +54,18 @@ def write_kv_pages(
     ].set(kv_flat, mode="drop")
 
 
+def _window_mask(key_pos, positions, window):
+    """Sliding-window lower bound: key_pos > q_pos - window (no-op when
+    window <= 0). ``window`` may be a traced i32 scalar (per-layer value
+    inside the layer scan)."""
+    if window is None:
+        return jnp.bool_(True)
+    window = jnp.asarray(window, jnp.int32)
+    return jnp.where(
+        window > 0, key_pos > positions[:, :, None] - window, True
+    )
+
+
 def paged_attention_xla_blocked(
     q: jax.Array,  # [B, Q, H, D]
     kv_cache: jax.Array,  # [num_pages, K, page, 2D]
@@ -62,6 +74,7 @@ def paged_attention_xla_blocked(
     positions: jax.Array,  # [B, Q]
     sm_scale: float | None = None,
     block_pages: int = 32,
+    window=None,  # i32 scalar (0/None = full attention)
 ) -> jax.Array:
     """Flash-style blocked paged attention in plain XLA.
 
@@ -106,7 +119,9 @@ def paged_attention_xla_blocked(
         key_pos = blk * Sb + jnp.arange(Sb)[None, None, :]
         causal = key_pos <= positions[:, :, None]
         in_ctx = key_pos < kv_lens[:, None, None]
-        mask = (causal & in_ctx)[:, :, None, None, :]
+        mask = (causal & in_ctx & _window_mask(key_pos, positions, window))[
+            :, :, None, None, :
+        ]
         s = jnp.where(mask, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))  # [B, Q, K, G]
         alpha = jnp.exp(m - m_new)
@@ -139,6 +154,7 @@ def paged_attention_xla(
     kv_lens: jax.Array,  # [B]
     positions: jax.Array,  # [B, Q]
     sm_scale: float | None = None,
+    window=None,  # i32 scalar (0/None = full attention)
 ) -> jax.Array:
     """Reference paged attention: gather the whole context, masked softmax."""
     B, Q, H, D = q.shape
@@ -164,7 +180,9 @@ def paged_attention_xla(
     key_pos = jnp.arange(S)[None, None, :]  # [1,1,S]
     causal = key_pos <= positions[:, :, None]  # [B,Q,S]
     in_ctx = key_pos < kv_lens[:, None, None]  # [B,1,S]
-    mask = (causal & in_ctx)[:, :, None, None, :]  # [B,Q,1,1,S]
+    mask = (causal & in_ctx & _window_mask(key_pos, positions, window))[
+        :, :, None, None, :
+    ]  # [B,Q,1,1,S]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(

@@ -33,6 +33,7 @@ def _decode_kernel(
     layer_ref,  # [1] i32 layer index (full-cache variant; [0] otherwise)
     page_table_ref,  # [B, max_pages] i32
     kv_lens_ref,  # [B] i32
+    win_starts_ref,  # [B] i32 first attended position (sliding window; 0=full)
     # blocks
     q_ref,  # [1, K, G, D] VMEM
     kv_hbm_full_ref,  # [(L,) num_pages, K, page, 2D] in HBM (unblocked)
@@ -58,17 +59,21 @@ def _decode_kernel(
     ppb = pages_per_block
     S = ppb * page_size  # tokens per compute block
     kv_len = kv_lens_ref[b]
+    win_start = win_starts_ref[b]  # first position this query may attend
     n_blocks = (kv_len + S - 1) // S
+    blk_lo = win_start // S  # blocks fully before the window are skipped
 
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
     n_live_pages = (kv_len + page_size - 1) // page_size
+    first_live_page = win_start // page_size
 
     def body(buf, sem):
         # buf: [2, K, S, 2D]; one DMA per page, ppb in flight per block.
-        # Pages past the live context (tail block) are never fetched.
+        # Pages past the live context (tail block) — or wholly before the
+        # sliding window — are never fetched.
         def _dma(slot, i, j):
             return pltpu.make_async_copy(
                 kv_hbm_ref.at[page_table_ref[b, i * ppb + j]],
@@ -76,23 +81,27 @@ def _decode_kernel(
                 sem.at[slot, j],
             )
 
+        def _page_live(i, j):
+            p = i * ppb + j
+            return jnp.logical_and(p < n_live_pages, p >= first_live_page)
+
         def start_block(slot, i):
             for j in range(ppb):  # static unroll
 
-                @pl.when(i * ppb + j < n_live_pages)
+                @pl.when(_page_live(i, j))
                 def _start():
                     _dma(slot, i, j).start()
 
         def wait_block(slot, i):
             for j in range(ppb):
 
-                @pl.when(i * ppb + j < n_live_pages)
+                @pl.when(_page_live(i, j))
                 def _wait():
                     _dma(slot, i, j).wait()
 
-        @pl.when(n_blocks > 0)
+        @pl.when(n_blocks > blk_lo)
         def _warmup():
-            start_block(0, 0)
+            start_block(jax.lax.rem(blk_lo, 2), blk_lo)
 
         def loop(i, _):
             slot = jax.lax.rem(i, 2)
@@ -105,10 +114,12 @@ def _decode_kernel(
             kv = buf[slot]  # [K, S, 2D]
             k = kv[:, :, :D]
             v = kv[:, :, D:].astype(jnp.float32)
-            # Unfetched tail positions hold uninitialized VMEM; zero them so
-            # a stray NaN can't poison the (0-prob x v) accumulation.
+            # Unfetched positions (tail past kv_len, or pages before the
+            # window) hold uninitialized VMEM; zero them so a stray NaN
+            # can't poison the (0-prob x v) accumulation.
             pos_v = i * S + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
-            v = jnp.where(pos_v < kv_len, v, 0.0)
+            live_v = jnp.logical_and(pos_v < kv_len, pos_v >= win_start)
+            v = jnp.where(live_v, v, 0.0)
             q = q_ref[0]  # [K, G, D]
             # K-batched (G, D) x (D, S) -> [K, G, S], f32 accumulate.
             s = jax.lax.dot_general(
@@ -116,12 +127,14 @@ def _decode_kernel(
                 preferred_element_type=jnp.float32,
             ) * sm_scale
             pos = i * S + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            s = jnp.where(pos < kv_len, s, NEG_INF)
+            live = jnp.logical_and(pos < kv_len, pos >= win_start)
+            s = jnp.where(live, s, NEG_INF)
 
             m_prev = m_ref[:, :, :1]  # [K, G, 1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
             alpha = jnp.exp(m_prev - m_new)
             probs = jnp.exp(s - m_new)  # [K, G, S]
+            probs = jnp.where(live, probs, 0.0)
             l_ref[:, :, :1] = l_ref[:, :, :1] * alpha + jnp.sum(
                 probs, axis=2, keepdims=True
             )
@@ -133,7 +146,7 @@ def _decode_kernel(
             acc_ref[:] = acc_ref[:] * alpha + pv
             return 0
 
-        jax.lax.fori_loop(0, n_blocks, loop, 0)
+        jax.lax.fori_loop(blk_lo, n_blocks, loop, 0)
 
     pl.run_scoped(
         body,
@@ -150,7 +163,7 @@ def _decode_kernel(
 
 def _decode_call(
     q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
-    pages_per_block,
+    pages_per_block, window=None,
 ):
     B, Q, H, D = q.shape
     assert Q == 1, "decode kernel handles Q=1"
@@ -166,15 +179,27 @@ def _decode_call(
         page_table = jnp.pad(page_table, ((0, 0), (0, pad)))
 
     qk = q.reshape(B, K, G, D)
+    # Sliding window: the decode query sits at kv_len-1, so the first
+    # attended position is max(0, kv_len - window). window may be a traced
+    # per-layer scalar; window<=0 (or None) degrades to full attention.
+    if window is None:
+        win_starts = jnp.zeros_like(kv_lens)
+    else:
+        window = jnp.asarray(window, jnp.int32)
+        win_starts = jnp.where(
+            window > 0, jnp.maximum(kv_lens - window, 0), 0
+        ).astype(jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl, ws: (b, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
         ],
-        out_specs=pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, K, G, D), lambda b, l, pt, kl, ws: (b, 0, 0, 0)
+        ),
         scratch_shapes=[
             pltpu.VMEM((K, G, 128), jnp.float32),
             pltpu.VMEM((K, G, 128), jnp.float32),
@@ -196,7 +221,10 @@ def _decode_call(
         ),
         interpret=interpret,
     )
-    out = kernel(layer.astype(jnp.int32).reshape(1), page_table, kv_lens, qk, kv_cache)
+    out = kernel(
+        layer.astype(jnp.int32).reshape(1), page_table, kv_lens, win_starts,
+        qk, kv_cache,
+    )
     return out.reshape(B, 1, H, D)
 
 
@@ -211,10 +239,11 @@ def decode_paged_attention(
     sm_scale: float | None = None,
     interpret: bool = False,
     pages_per_block: int = 16,
+    window: jax.Array | None = None,
 ) -> jax.Array:
     return _decode_call(
         q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
-        sm_scale, interpret, pages_per_block,
+        sm_scale, interpret, pages_per_block, window=window,
     )
 
 
@@ -227,11 +256,12 @@ def decode_paged_attention_full(
     sm_scale: float | None = None,
     interpret: bool = False,
     pages_per_block: int = 16,
+    window: jax.Array | None = None,
 ) -> jax.Array:
     """Layer-indexed variant: reads cache[layer] pages directly from the
     full-cache HBM ref — a scan over layers never materializes a
     pool-sized slice."""
     return _decode_call(
         q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
-        pages_per_block,
+        pages_per_block, window=window,
     )

@@ -275,7 +275,31 @@ def test_llama_yarn_matches_transformers(tmp_path):
     assert _ours_greedy(d, prompt, NEW_TOKENS) == golden
 
 
-def test_loader_rejects_sliding_window_and_unknown_rope(tmp_path):
+@needs_torch
+def test_mistral_sliding_window_greedy_matches_transformers(tmp_path):
+    """Golden parity on a trained-shape sliding-window checkpoint (the
+    gpt-oss-class capability, reference pd-disaggregation/README.md:
+    600-615): a context several times the window must reproduce HF's
+    windowed attention token-for-token."""
+    window = 16
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        sliding_window=window, tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = transformers.MistralForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    prompt = [int(x) for x in np.random.default_rng(5).integers(1, 255, 56)]
+    golden = _hf_greedy(model, prompt, NEW_TOKENS)
+    assert _ours_greedy(d, prompt, NEW_TOKENS) == golden
+    # The window must be LIVE: full attention on the same weights diverges.
+    full = _ours_greedy(d, prompt, NEW_TOKENS, sliding_window=0)
+    assert full != golden, "56-token context, 16-token window: masks equal?"
+
+
+def test_loader_sliding_window_accepted_unknown_rope_rejected(tmp_path):
     d = tmp_path / "m"
     d.mkdir()
     base = {
@@ -283,9 +307,10 @@ def test_loader_rejects_sliding_window_and_unknown_rope(tmp_path):
         "hidden_size": 32, "intermediate_size": 64, "num_hidden_layers": 1,
         "num_attention_heads": 2, "num_key_value_heads": 1,
     }
+    # Sliding-window checkpoints now load (tests/test_sliding_window.py
+    # covers the attention semantics).
     (d / "config.json").write_text(json.dumps({**base, "sliding_window": 4096}))
-    with pytest.raises(ValueError, match="sliding-window"):
-        config_from_hf(str(d))
+    assert config_from_hf(str(d)).sliding_window == 4096
     (d / "config.json").write_text(json.dumps({
         **base, "rope_scaling": {"rope_type": "longrope", "factor": 2.0},
     }))
