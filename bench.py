@@ -278,7 +278,77 @@ def _run_part(part: str):
 
         res = run_accuracy_eval()
         return round(res["ttft_mape"], 4)
+    if part == "dbo":
+        return _bench_dbo_delta()
     raise KeyError(part)
+
+
+def _bench_dbo_delta():
+    """Dual-batch-overlap on/off wall-clock on the virtual 8-device CPU
+    mesh (the only multi-device substrate here; real-slice numbers come
+    from the same knob on hardware). Exactness is gated in
+    tests/test_wide_ep.py; this records the measured step-time ratio."""
+    import os
+
+    # Must precede the first jax import (fresh subprocess via --only dbo).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8".strip()
+        )
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from llmd_tpu.config import ParallelConfig, tiny_model_config
+    from llmd_tpu.models import llama
+    from llmd_tpu.models.common import StepInput
+    from llmd_tpu.parallel.mesh import build_mesh
+
+    cfg = tiny_model_config(
+        num_experts=8, num_experts_per_tok=2, hidden_size=128,
+        moe_intermediate_size=128, num_layers=4, num_heads=8, num_kv_heads=4,
+    )
+    ctx = build_mesh(ParallelConfig(tensor_parallel_size=4, data_parallel_size=2))
+    params = llama.init_params(cfg, jax.random.key(0))
+    B, page, max_pages = 8, 4, 8
+    kv = jnp.zeros(
+        (cfg.num_layers, B * max_pages, cfg.kv_cache_heads, page,
+         cfg.kv_cache_entry_dim), jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    inp = StepInput(
+        token_ids=jnp.asarray(rng.integers(1, 200, (B, 1)), jnp.int32),
+        positions=jnp.full((B, 1), 5, jnp.int32),
+        query_lens=jnp.ones(B, jnp.int32),
+        kv_lens=jnp.full(B, 6, jnp.int32),
+        page_table=jnp.arange(B * max_pages, dtype=jnp.int32).reshape(B, -1),
+    )
+
+    def step_time(dbo):
+        with ctx.mesh:
+            f = jax.jit(lambda p, kv: llama.forward_hidden(
+                p, kv, inp, cfg, ctx.world, mesh=ctx.mesh,
+                moe_backend="ep", ep_capacity_factor=8.0, dbo=dbo,
+            )[0])
+            f(params, kv).block_until_ready()
+            samples = []
+            for _ in range(10):
+                t0 = time.monotonic()
+                f(params, kv).block_until_ready()
+                samples.append(time.monotonic() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    off, on = step_time(False), step_time(True)
+    return {
+        "dbo_off_ms": round(off * 1e3, 2),
+        "dbo_on_ms": round(on * 1e3, 2),
+        "substrate": "8-dev virtual CPU mesh (dp2 x tp4, ep8)",
+    }
 
 
 def _part_in_subprocess(part: str):
@@ -329,6 +399,10 @@ def main() -> None:
         extras["predictor_ttft_mape"] = _part_in_subprocess("predictor")
     except Exception as e:  # pragma: no cover
         extras["predictor_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        extras["dbo"] = _part_in_subprocess("dbo")
+    except Exception as e:  # pragma: no cover
+        extras["dbo_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(
         json.dumps(

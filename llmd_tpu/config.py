@@ -245,6 +245,11 @@ class ParallelConfig:
     # EP dispatch capacity factor (send slots per destination shard relative
     # to a uniform split; tokens past capacity are dropped from the combine).
     ep_capacity_factor: float = 2.0
+    # Dual-batch overlap (the reference's --enable-dbo, wide-ep
+    # decode.yaml:125-126): split each step into two half-batch chains
+    # after the KV write so the EP all-to-all of one half overlaps the
+    # other half's attention compute. Exact numerics; needs an even batch.
+    enable_dbo: bool = False
 
     @property
     def world_size(self) -> int:

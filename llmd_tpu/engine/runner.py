@@ -230,6 +230,7 @@ class ModelRunner:
         kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
+        dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
 
         @functools.partial(
@@ -239,7 +240,7 @@ class ModelRunner:
             hidden, kv_cache = llama.forward_hidden(
                 params, kv_cache, inp, cfg, world,
                 mesh=mesh, moe_backend=moe_backend,
-                ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
+                ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
             )
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
@@ -261,6 +262,7 @@ class ModelRunner:
         kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
+        dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
 
         @functools.partial(
@@ -297,7 +299,7 @@ class ModelRunner:
                 hidden, kv_cache = llama.forward_hidden(
                     params, kv_cache, inp, cfg, world,
                     mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
                 )
                 logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
                 s = SamplingInputs(

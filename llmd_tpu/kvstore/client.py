@@ -114,6 +114,23 @@ class CrossSliceStoreClient:
                 reply = self._call(
                     "/v1/segments/heartbeat", {"segment_id": self.segment_id}
                 )
+                if reply.get("unknown_segment"):
+                    # Master restarted (or reaped us): the master's view
+                    # of this segment is EMPTY, so withdraw the local
+                    # shipper entries too — keeping them would pin
+                    # unlocatable bytes in DRAM for the object-lease TTL
+                    # and let the master overcommit an apparently-empty
+                    # segment. Fresh publications repopulate both sides.
+                    log.warning(
+                        "kvstore master no longer knows segment %s; "
+                        "dropping local objects and re-registering",
+                        self.segment_id,
+                    )
+                    keys, self._local_keys = list(self._local_keys), set()
+                    for key in keys:
+                        self.server.unregister(key)
+                    self._registered = False
+                    continue
                 for key in reply.get("evict", []):
                     self.server.unregister(key)
             except (urllib.error.URLError, OSError, TimeoutError) as e:

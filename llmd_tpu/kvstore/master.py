@@ -134,10 +134,14 @@ class MasterState:
             seg.used_bytes = 0
             seg.pending_evictions.clear()
 
-    def heartbeat(self, segment_id: str) -> list[str]:
+    def heartbeat(self, segment_id: str) -> list[str] | None:
+        """Returns the pending-eviction list, or None for an UNKNOWN
+        segment — the signal a cold-restarted master (or a reaped
+        registration) sends so the client re-registers instead of
+        heartbeating into the void forever."""
         seg = self.segments.get(segment_id)
         if seg is None:
-            return []
+            return None
         seg.last_heartbeat = time.monotonic()
         evict, seg.pending_evictions = seg.pending_evictions, []
         return evict
@@ -308,7 +312,10 @@ def build_app(
 
     async def heartbeat(request: web.Request) -> web.Response:
         b = await request.json()
-        return web.json_response({"evict": state.heartbeat(str(b["segment_id"]))})
+        evict = state.heartbeat(str(b["segment_id"]))
+        if evict is None:
+            return web.json_response({"unknown_segment": True, "evict": []})
+        return web.json_response({"evict": evict})
 
     async def unregister(request: web.Request) -> web.Response:
         state.remove_segment(request.match_info["sid"])

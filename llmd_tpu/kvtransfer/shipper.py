@@ -73,6 +73,11 @@ class ShipperServer:
         native server, which makes the single owning copy — no Python-side
         concat or intermediate copy of a multi-hundred-MB KV payload.
         """
+        if self._handle is None and self._py is None:
+            # Closed/crashed shipper: a clean error for the staging thread
+            # to log — NOT an AttributeError that could leak upward and
+            # take the engine step loop down with it.
+            raise RuntimeError("shipper server is closed")
         if self._handle:
             mv = memoryview(data).cast("B")
             n = len(mv)
@@ -94,25 +99,25 @@ class ShipperServer:
     def unregister(self, key: str) -> bool:
         if self._handle:
             return self._native.kvship_unregister(self._handle, key.encode()) == 0
-        return self._py.unregister(key)
+        return self._py.unregister(key) if self._py else False
 
     @property
     def registered_bytes(self) -> int:
         if self._handle:
             return self._native.kvship_registered_bytes(self._handle)
-        return self._py.registered_bytes
+        return self._py.registered_bytes if self._py else 0
 
     @property
     def registered_count(self) -> int:
         if self._handle:
             return self._native.kvship_registered_count(self._handle)
-        return self._py.registered_count
+        return self._py.registered_count if self._py else 0
 
     @property
     def expired_count(self) -> int:
         if self._handle:
             return self._native.kvship_expired_count(self._handle)
-        return self._py.expired_count
+        return self._py.expired_count if self._py else 0
 
     def close(self) -> None:
         if self._handle:

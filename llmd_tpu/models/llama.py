@@ -146,6 +146,7 @@ def forward_hidden(
     moe_backend: str = "dense",
     ep_capacity_factor: float = 2.0,
     kv_rep: int = 1,
+    dbo: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache).
 
@@ -155,7 +156,16 @@ def forward_hidden(
     divides tp when num_kv_heads alone does not (tp > K): per-chip KV is
     then pool/K instead of a full replicated pool. Attention grouping
     stays exact — q head h reads expanded head h // (Nq / (K*kv_rep)),
-    which holds h's original kv head."""
+    which holds h's original kv head.
+
+    ``dbo`` (dual-batch overlap — the reference's --enable-dbo for wide-EP
+    decode, wide-ep decode.yaml:125-126): each layer writes KV for the
+    FULL batch once, then runs the read-only attention + FFN pipeline as
+    two independent half-batch chains. Half 1's attention carries no data
+    dependency on half 0's MoE dispatch, so XLA's latency-hiding
+    scheduler can overlap the EP all-to-all of one half with the other
+    half's attention compute. Numerics are exact (same values, split
+    batch); requires an even batch."""
     B, Q = inp.token_ids.shape
     D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     x = params["embed"][inp.token_ids]  # [B, Q, H]
@@ -166,11 +176,63 @@ def forward_hidden(
     valid = inp.valid
     sm_scale = D**-0.5
 
+    # DBO also requires the HALF batch to stay dp-divisible, or the split
+    # would silently demote attention from the sharded Pallas kernel to
+    # the pool-slicing XLA fallback (ops._mesh_plan's B % dp gate) —
+    # slower and memory-hungrier, the opposite of the knob's intent.
+    _dp = mesh.shape["dp"] if mesh is not None and "dp" in mesh.axis_names else 1
+    use_dbo = bool(dbo) and B >= 2 and B % 2 == 0 and (B // 2) % _dp == 0
+    half = B // 2
+
+    def _ffn(h2, lp, use_moe: bool):
+        if use_moe:
+            if moe_backend == "ep":
+                from llmd_tpu.parallel.moe_ep import moe_block_ep
+
+                return moe_block_ep(
+                    h2, lp, cfg, mesh, capacity_factor=ep_capacity_factor
+                )
+            if moe_backend == "grouped" and world_size == 1:
+                from llmd_tpu.models.moe import moe_block_grouped
+
+                return moe_block_grouped(h2, lp, cfg)
+            # Sharded jit without the EP backend: the dense combine is
+            # the only path GSPMD can partition (expert weights are
+            # EP-sharded; the grouped kernel has no partitioning rule
+            # — multi-device MoE should run moe_backend="ep", whose
+            # shard_map body uses the grouped GEMM locally).
+            return moe_block(h2, lp, cfg)
+        return _mlp(h2, lp)
+
+    def _tail(x_sl, attn_sl, lp, use_moe):
+        """Post-attention chain of one (micro)batch slice: residual +
+        post-norm + FFN/MoE + residual."""
+        x_sl = x_sl + attn_sl
+        h2 = rms_norm(x_sl, lp["post_norm"], cfg.rms_norm_eps)
+        return x_sl + _ffn(h2, lp, use_moe)
+
     def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         if cfg.is_mla:
-            from llmd_tpu.models.mla import mla_attention
+            from llmd_tpu.models.mla import mla_attention, mla_read, mla_write
 
+            if use_dbo:
+                # DBO: one full-batch write, then two independent
+                # read-only half chains (attention -> MoE).
+                cache, q_eff = mla_write(
+                    h, lp, cache, layer_idx, inp, cfg, cos, sin,
+                    world_size=world_size, mesh=mesh,
+                )
+                outs = []
+                for sl in (slice(0, half), slice(half, B)):
+                    attn_sl = mla_read(
+                        q_eff[sl], lp, cache, layer_idx,
+                        inp.page_table[sl], inp.kv_lens[sl],
+                        inp.positions[sl], cfg,
+                        world_size=world_size, mesh=mesh,
+                    )
+                    outs.append(_tail(x[sl], attn_sl, lp, use_moe))
+                return jnp.concatenate(outs, axis=0), cache
             attn_out, cache = mla_attention(
                 h, lp, cache, layer_idx, inp, cfg, cos, sin,
                 world_size=world_size, mesh=mesh,
@@ -210,33 +272,26 @@ def forward_hidden(
                 cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
                 world_size=world_size, mesh=mesh,
             )
+            if use_dbo:
+                outs = []
+                for sl in (slice(0, half), slice(half, B)):
+                    attn_sl = paged_attention_full(
+                        q[sl], cache, layer_idx, inp.page_table[sl],
+                        inp.kv_lens[sl], inp.positions[sl], sm_scale,
+                        world_size=world_size, mesh=mesh, window=window,
+                    )
+                    attn_sl = pdot(
+                        attn_sl.reshape(half, Q, Nq * D), lp, "wo"
+                    )
+                    outs.append(_tail(x[sl], attn_sl, lp, use_moe))
+                return jnp.concatenate(outs, axis=0), cache
             attn = paged_attention_full(
                 q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
                 sm_scale, world_size=world_size, mesh=mesh, window=window,
             )
             x = x + pdot(attn.reshape(B, Q, Nq * D), lp, "wo")
-        h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        if use_moe:
-            if moe_backend == "ep":
-                from llmd_tpu.parallel.moe_ep import moe_block_ep
-
-                out = moe_block_ep(
-                    h2, lp, cfg, mesh, capacity_factor=ep_capacity_factor
-                )
-            elif moe_backend == "grouped" and world_size == 1:
-                from llmd_tpu.models.moe import moe_block_grouped
-
-                out = moe_block_grouped(h2, lp, cfg)
-            else:
-                # Sharded jit without the EP backend: the dense combine is
-                # the only path GSPMD can partition (expert weights are
-                # EP-sharded; the grouped kernel has no partitioning rule
-                # — multi-device MoE should run moe_backend="ep", whose
-                # shard_map body uses the grouped GEMM locally).
-                out = moe_block(h2, lp, cfg)
-        else:
-            out = _mlp(h2, lp)
-        return x + out, cache
+        # attention residual already applied above; _tail adds 0
+        return _tail(x, 0.0, lp, use_moe), cache
 
     # DeepSeek-style dense prefix: the first N layers (N static, 1-3)
     # run unrolled with their own dense-MLP weights; the homogeneous MoE

@@ -29,29 +29,28 @@ from llmd_tpu.models.common import (
 from llmd_tpu.ops import mla_paged_attention_full, write_kv_pages_full
 
 
-def mla_attention(
+def mla_write(
     h: jax.Array,          # [B, Q, H] (already input-normed)
     lp: dict,              # this layer's params
     cache: jax.Array,      # FULL [L, pages, 1, page, Dl]
     layer_idx: jax.Array,  # scalar i32
     inp: StepInput,
     cfg: ModelConfig,
-    cos: jax.Array | None = None,  # rope tables for qk_rope_head_dim,
-    sin: jax.Array | None = None,  # hoisted out of the layer scan
+    cos: jax.Array,
+    sin: jax.Array,
     world_size: int = 1,
     mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (attn output [B, Q, H_hidden], updated cache)."""
+    """Write phase: project + cache this step's latents; returns
+    (updated cache, absorbed effective queries q_eff [B, Q, nh, Dl]).
+
+    Split from the read phase so dual-batch-overlap can write the FULL
+    batch once and then run read-only attention per microbatch."""
     B, Q, _ = h.shape
     nh = cfg.num_heads
-    nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     rank = cfg.kv_lora_rank
     Dl = cfg.kv_cache_entry_dim
-    # MLA scales by the FULL qk head dim (nope + rope), not the latent;
-    # DeepSeek yarn folds its mscale^2 temperature correction in here.
-    sm_scale = (nope + rope) ** -0.5 * yarn_sm_scale_mult(cfg.rope_scaling)
-    if cos is None or sin is None:
-        cos, sin = rope_tables(inp.positions, rope, cfg.rope_theta, cfg.rope_scaling)
 
     # ---- queries
     if cfg.q_lora_rank > 0:
@@ -82,23 +81,77 @@ def mla_attention(
         mesh=mesh,
     )
 
-    # ---- absorption: W_uk [nh, rank, nope], W_uv [nh, rank, vd]
-    wkv_b = lp["wkv_b"].reshape(rank, nh, nope + vd)
+    # ---- absorption (query half): W_uk [nh, rank, nope]
+    wkv_b = lp["wkv_b"].reshape(rank, nh, nope + cfg.v_head_dim)
     w_uk = wkv_b[..., :nope].transpose(1, 0, 2)  # [nh, rank, nope]
-    w_uv = wkv_b[..., nope:].transpose(1, 0, 2)  # [nh, rank, vd]
     q_eff_nope = jnp.einsum("bqhn,hrn->bqhr", q_nope, w_uk)
     q_eff = jnp.concatenate([q_eff_nope, q_pe], axis=-1)  # [B, Q, nh, rank+rope]
     if Dl > rank + rope:
         q_eff = jnp.pad(q_eff, ((0, 0), (0, 0), (0, 0), (0, Dl - rank - rope)))
+    return cache, q_eff
 
-    # ---- latent attention against cache[layer] (Pallas on TPU decode:
-    # streams live pages; never slices the pool)
+
+def mla_read(
+    q_eff: jax.Array,      # [B, Q, nh, Dl]
+    lp: dict,
+    cache: jax.Array,
+    layer_idx: jax.Array,
+    page_table: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,     # [B]
+    positions: jax.Array,   # [B, Q]
+    cfg: ModelConfig,
+    world_size: int = 1,
+    mesh=None,
+) -> jax.Array:
+    """Read phase: latent attention against cache[layer] + value
+    absorption + output projection. Read-only on the cache — microbatches
+    of the same step run independently (the DBO property)."""
+    B, Q = q_eff.shape[:2]
+    nh = cfg.num_heads
+    nope, rope, vd = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    rank = cfg.kv_lora_rank
+    # MLA scales by the FULL qk head dim (nope + rope), not the latent;
+    # DeepSeek yarn folds its mscale^2 temperature correction in here.
+    sm_scale = (nope + rope) ** -0.5 * yarn_sm_scale_mult(cfg.rope_scaling)
+    wkv_b = lp["wkv_b"].reshape(rank, nh, nope + vd)
+    w_uv = wkv_b[..., nope:].transpose(1, 0, 2)  # [nh, rank, vd]
+    # ---- latent attention (Pallas on TPU decode: streams live pages;
+    # never slices the pool)
     out_lat = mla_paged_attention_full(
-        q_eff, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
+        q_eff, cache, layer_idx, page_table, kv_lens, positions,
         rank=rank, sm_scale=sm_scale, world_size=world_size, mesh=mesh,
     )  # [B, Q, nh, rank]
     out = jnp.einsum("bqhr,hrv->bqhv", out_lat, w_uv)  # [B, Q, nh, vd]
-    return pdot(out.reshape(B, Q, nh * vd), lp, "wo"), cache
+    return pdot(out.reshape(B, Q, nh * vd), lp, "wo")
+
+
+def mla_attention(
+    h: jax.Array,          # [B, Q, H] (already input-normed)
+    lp: dict,              # this layer's params
+    cache: jax.Array,      # FULL [L, pages, 1, page, Dl]
+    layer_idx: jax.Array,  # scalar i32
+    inp: StepInput,
+    cfg: ModelConfig,
+    cos: jax.Array | None = None,  # rope tables for qk_rope_head_dim,
+    sin: jax.Array | None = None,  # hoisted out of the layer scan
+    world_size: int = 1,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (attn output [B, Q, H_hidden], updated cache)."""
+    if cos is None or sin is None:
+        cos, sin = rope_tables(
+            inp.positions, cfg.qk_rope_head_dim, cfg.rope_theta,
+            cfg.rope_scaling,
+        )
+    cache, q_eff = mla_write(
+        h, lp, cache, layer_idx, inp, cfg, cos, sin,
+        world_size=world_size, mesh=mesh,
+    )
+    out = mla_read(
+        q_eff, lp, cache, layer_idx, inp.page_table, inp.kv_lens,
+        inp.positions, cfg, world_size=world_size, mesh=mesh,
+    )
+    return out, cache
 
 
 def mla_reference_attention(

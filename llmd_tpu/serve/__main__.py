@@ -111,6 +111,7 @@ def make_engine_config(args, lora_adapters=None):
                 args.data_parallel_size if _multihost_world() else 1
             ),
             moe_backend=args.moe_backend,
+            enable_dbo=args.enable_dbo,
         ),
         seed=args.seed,
         weights_path=weights_path,
@@ -148,6 +149,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-gpu-blocks-override", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="bfloat16")
+    p.add_argument(
+        "--enable-dbo", action="store_true",
+        help="dual-batch overlap: overlap the EP all-to-all of one half-"
+        "batch with the other half's attention (wide-EP decode; the vLLM "
+        "--enable-dbo role)",
+    )
     p.add_argument(
         "--quantization", default=None, choices=["int8"],
         help="weight quantization (int8 W8A8; the vLLM --quantization "

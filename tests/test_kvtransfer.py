@@ -257,6 +257,157 @@ def test_pull_wait_blocks_until_registered(server):
         )
 
 
+def test_producer_crash_mid_pull_recompute():
+    """Producer dies BETWEEN chunk pulls (crash-mid-transfer seam): the
+    consumer's load-failure policy degrades to local recompute and the
+    output still matches the aggregated engine."""
+    prompt = list(range(1, 45))  # 11 full pages -> 2 chunks
+    ref_tokens, _ = _run(make_engine(), prompt, max_tokens=5)
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        _, pre = _run(
+            producer, prompt, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        assert params["num_chunks"] == 2
+        # let staging finish, then crash the producer after the consumer's
+        # FIRST chunk pull
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            producer.kv_connector.server.registered_count < 2
+        ):
+            time.sleep(0.02)
+        orig_pull_wait = shipper_mod.pull_wait
+        calls = {"n": 0}
+
+        def crashing_pull_wait(host, port, key, deadline, poll_s=0.01):
+            blob = orig_pull_wait(host, port, key, deadline, poll_s)
+            calls["n"] += 1
+            if calls["n"] == 1:
+                producer.kv_connector.server.close()  # crash mid-transfer
+            return blob
+
+        shipper_mod.pull_wait = crashing_pull_wait
+        try:
+            toks, _ = _run(
+                consumer, prompt, max_tokens=5, kv_transfer_params=params
+            )
+        finally:
+            shipper_mod.pull_wait = orig_pull_wait
+        assert toks == ref_tokens  # recomputed locally, numerics intact
+        assert consumer.kv_connector.import_failures == 1
+        assert consumer.kv_connector.imported_requests == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_producer_crash_fail_policy_raises():
+    """Same seam with kv_load_failure_policy='fail' (the reference's
+    recommended strict mode, operations-vllm.md:118-139): the import
+    surfaces KVLoadError instead of silently recomputing."""
+    from llmd_tpu.kvtransfer.connector import KVLoadError
+
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    consumer.kv_connector.cfg.load_failure_policy = "fail"
+    consumer.kv_connector.cfg.lease_ms = 500  # short pull-wait deadline
+    try:
+        _, pre = _run(
+            producer, PROMPT, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        producer.kv_connector.server.close()  # crash before any pull
+        with pytest.raises(KVLoadError):
+            consumer.kv_connector.import_for_prompt(list(PROMPT), params)
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_lease_expiry_reclaims_export_and_consumer_recomputes():
+    """An export whose lease expires (decode never arrived / heartbeat
+    died) is reaped; a late consumer degrades to recompute with exact
+    numerics."""
+    ref_tokens, _ = _run(make_engine(), PROMPT, max_tokens=4)
+    producer = make_engine(kv_role="kv_producer")
+    producer.kv_connector.cfg.lease_ms = 200
+    consumer = make_engine(kv_role="kv_consumer")
+    consumer.kv_connector.cfg.lease_ms = 500  # short pull-wait deadline
+    try:
+        _, pre = _run(
+            producer, PROMPT, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            producer.kv_connector.server.registered_count == 0
+        ):
+            time.sleep(0.02)
+        # expire: the reaper reclaims the entry
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            producer.kv_connector.server.registered_count > 0
+        ):
+            time.sleep(0.05)
+        assert producer.kv_connector.server.registered_count == 0
+        assert producer.kv_connector.server.expired_count >= 1
+        toks, _ = _run(
+            consumer, PROMPT, max_tokens=4,
+            kv_transfer_params=pre.kv_transfer_params,
+        )
+        assert toks == ref_tokens
+        assert consumer.kv_connector.import_failures == 1
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_lease_renewal_keeps_chunked_export_alive():
+    """The sidecar-heartbeat seam at the wire level: renewing EVERY chunk
+    key (transfer_keys) holds a queued transfer past several base leases;
+    the pull then still succeeds."""
+    from llmd_tpu.kvtransfer.connector import transfer_keys
+
+    producer = make_engine(kv_role="kv_producer")
+    producer.kv_connector.cfg.lease_ms = 300
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        prompt = list(range(1, 45))  # 2 chunks
+        _, pre = _run(
+            producer, prompt, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        host, port = params["remote_host"], int(params["remote_port"])
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            producer.kv_connector.server.registered_count < 2
+        ):
+            time.sleep(0.02)
+        # hold for 4 base leases, renewing at ~1/3 lease cadence; EVERY
+        # chunk key must be renewed each cycle (a short-circuiting any()
+        # over a generator would let later chunks expire — the sidecar
+        # heartbeat bug class)
+        for _ in range(12):
+            time.sleep(0.1)
+            renewed = [
+                shipper_mod.renew(host, port, k, lease_ms=300)
+                for k in transfer_keys(params)
+            ]
+            assert all(renewed), renewed
+        assert producer.kv_connector.server.registered_count == 2
+        n = consumer.kv_connector.import_for_prompt(prompt, params)
+        assert n == 11  # every transferred page adopted
+        assert consumer.kv_connector.import_failures == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
 def test_pd_consumer_recompute_fallback():
     consumer = make_engine(kv_role="kv_consumer")
     try:

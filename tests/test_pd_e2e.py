@@ -166,6 +166,116 @@ async def test_short_prompt_skips_disagg(pd_stack):
     assert prefill_engine.kv_connector.exported_requests == 0
 
 
+@pytest.fixture
+async def pd_stack_short_lease():
+    """P/D stack with a 400ms producer lease and a fast-heartbeat sidecar
+    (cadence 1/4 lease) — the lease-expiry-while-queued seam."""
+    def mk(kv_role, lease_ms):
+        return LLMEngine(EngineConfig(
+            model=tiny_model_config(vocab_size=512, max_model_len=128),
+            cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+            kv_role=kv_role,
+            kv_transfer_port=0,
+            kv_lease_ms=lease_ms,
+        ))
+
+    prefill_engine = mk("kv_producer", 400)
+    decode_engine = mk("kv_consumer", 1500)  # pull-wait deadline 1.5s
+    decode_async = AsyncEngine(decode_engine)
+    prefill_srv = TestServer(make_engine_app(prefill_engine))
+    decode_srv = TestServer(
+        build_app(decode_async, ByteTokenizer(), "tiny", 128)
+    )
+    await prefill_srv.start_server()
+    await decode_srv.start_server()
+    sidecar_srv = TestServer(build_sidecar_app(
+        SidecarConfig(vllm_port=decode_srv.port, heartbeat_s=0.1), rank=0
+    ))
+    await sidecar_srv.start_server()
+    yield prefill_engine, decode_engine, decode_async, prefill_srv, sidecar_srv
+    for s in (prefill_srv, decode_srv, sidecar_srv):
+        await s.close()
+    for e in (prefill_engine, decode_engine):
+        if e.kv_connector:
+            e.kv_connector.close()
+
+
+async def test_pd_lease_expiry_while_queued_heartbeat_keeps_kv(
+    pd_stack_short_lease,
+):
+    """The decode engine is PAUSED while a request waits (simulated queue
+    delay of ~4x the base lease): the sidecar's lease heartbeat must keep
+    the exported KV alive so the late decode still imports it — the exact
+    scenario the heartbeat exists for (operations-vllm.md:155-160)."""
+    import asyncio
+
+    import aiohttp
+
+    (prefill_engine, decode_engine, decode_async, prefill_srv,
+     sidecar_srv) = pd_stack_short_lease
+    # Pause BEFORE the request: phase 2 will queue inside the decode engine.
+    decode_async.pause()
+    try:
+        async with aiohttp.ClientSession() as s:
+
+            async def request():
+                async with s.post(
+                    f"http://{sidecar_srv.host}:{sidecar_srv.port}/v1/completions",
+                    json={"prompt": PROMPT, "max_tokens": 3, "temperature": 0.0},
+                    headers={"x-prefiller-host-port":
+                             f"{prefill_srv.host}:{prefill_srv.port}"},
+                ) as r:
+                    return r.status, await r.json()
+
+            task = asyncio.ensure_future(request())
+            # hold paused for 4 base leases; the heartbeat (cadence 100ms)
+            # must keep renewing the chunk keys
+            await asyncio.sleep(1.6)
+            assert not task.done()
+            assert prefill_engine.kv_connector.server.registered_count >= 1, (
+                "lease expired while queued despite the sidecar heartbeat"
+            )
+            decode_async.resume()
+            status, data = await task
+        assert status == 200
+        assert decode_engine.kv_connector.imported_requests == 1
+        assert decode_engine.kv_connector.import_failures == 0
+    finally:
+        decode_async.resume()
+
+
+async def test_pd_export_staging_down_recompute_e2e(pd_stack_short_lease):
+    """The producer's kvship plane dies (server closed; engine HTTP still
+    up): phase 2's pull times out and the decode engine recomputes locally
+    — the request still succeeds with exact numerics."""
+    import aiohttp
+
+    prefill_engine, decode_engine, _, prefill_srv, sidecar_srv = (
+        pd_stack_short_lease
+    )
+    from llmd_tpu.engine import SamplingParams
+
+    agg = make_engine(None)
+    ids = ByteTokenizer().encode(PROMPT)
+    out = agg.generate([ids], SamplingParams(temperature=0.0, max_tokens=3))
+    text_agg = ByteTokenizer().decode(next(iter(out.values())))
+
+    prefill_engine.kv_connector.server.close()  # kvship plane down
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"http://{sidecar_srv.host}:{sidecar_srv.port}/v1/completions",
+            json={"prompt": PROMPT, "max_tokens": 3, "temperature": 0.0},
+            headers={"x-prefiller-host-port":
+                     f"{prefill_srv.host}:{prefill_srv.port}"},
+        ) as r:
+            assert r.status == 200
+            data = await r.json()
+    assert data["choices"][0]["text"] == text_agg
+    assert decode_engine.kv_connector.import_failures == 1
+    assert decode_engine.kv_connector.imported_requests == 0
+
+
 async def test_sidecar_refuses_admin_paths(pd_stack):
     """The sidecar is the pod's outward port: /admin/* (pause|drain|resume)
     must not be proxied to the engine (unauthenticated remote DoS)."""

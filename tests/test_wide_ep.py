@@ -273,3 +273,46 @@ async def test_dp_supervisor_spawns_and_restarts():
 @pytest.fixture
 def anyio_backend():
     return "asyncio"
+
+
+@pytest.mark.parametrize("family_kw", [
+    {},  # GQA + MoE
+    {"kv_lora_rank": 32, "q_lora_rank": 0, "qk_nope_head_dim": 16,
+     "qk_rope_head_dim": 8, "v_head_dim": 16, "first_dense_layers": 1},
+])
+def test_dbo_exactness_vs_single_chain(family_kw):
+    """Dual-batch overlap (--enable-dbo role): the two half-batch chains
+    must reproduce the single-chain forward EXACTLY — same ops on split
+    batches, no numerics drift — for both the GQA and MLA families on the
+    EP mesh."""
+    from llmd_tpu.models.common import StepInput
+
+    cfg = moe_config(num_layers=2, **family_kw)
+    ctx = build_mesh(ParallelConfig(tensor_parallel_size=4, data_parallel_size=2))
+    params = llama.init_params(cfg, jax.random.key(3))
+    B, Q, page, max_pages = 4, 1, 4, 8
+    kv = jnp.zeros(
+        (cfg.num_layers, B * max_pages, cfg.kv_cache_heads, page,
+         cfg.kv_cache_entry_dim),
+        jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    inp = StepInput(
+        token_ids=jnp.asarray(rng.integers(1, 200, (B, Q)), jnp.int32),
+        positions=jnp.full((B, Q), 5, jnp.int32),
+        query_lens=jnp.ones(B, jnp.int32),
+        kv_lens=jnp.full(B, 6, jnp.int32),
+        page_table=jnp.arange(B * max_pages, dtype=jnp.int32).reshape(B, -1),
+    )
+
+    def run(dbo):
+        with ctx.mesh:
+            h, _ = jax.jit(
+                lambda p, kv: llama.forward_hidden(
+                    p, kv, inp, cfg, ctx.world, mesh=ctx.mesh,
+                    moe_backend="ep", ep_capacity_factor=64.0, dbo=dbo,
+                )
+            )(params, kv)
+        return np.asarray(h)
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-5, atol=1e-5)
