@@ -1,0 +1,17 @@
+{{- define "llmd.name" -}}
+{{- .Release.Name | trunc 53 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "llmd.pool" -}}
+{{- default (printf "%s-pool" (include "llmd.name" .)) .Values.inferencePool.name -}}
+{{- end -}}
+
+{{- define "llmd.servedModel" -}}
+{{- default .Values.model.name .Values.model.servedName -}}
+{{- end -}}
+
+{{- define "llmd.labels" -}}
+app.kubernetes.io/name: llmd-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}

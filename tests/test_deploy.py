@@ -113,6 +113,8 @@ def test_recipe_yaml_parses_and_binds_roles():
     yaml = pytest.importorskip("yaml")
     docs = []
     for path in sorted(REPO.glob("deploy/**/*.yaml")):
+        if "templates" in path.parts:
+            continue  # Helm templates are Go templates, not plain YAML
         with open(path) as f:
             for doc in yaml.safe_load_all(f):
                 assert doc is None or isinstance(doc, dict), path
@@ -221,3 +223,41 @@ def test_smoke_test_script_shape():
     )
     assert out.returncode != 0  # usage error without args
     assert "usage" in (out.stderr + out.stdout)
+
+
+def test_gateway_recipes_and_helm_chart_shape():
+    """Six gateway-provider recipes + the Helm chart (reference ships the
+    same provider set, guides/recipes/gateway): every provider patches the
+    base Gateway's class; chart values/templates cover the three planes
+    and the InferencePool binding."""
+    import yaml
+
+    gw = REPO / "deploy" / "recipes" / "gateway"
+    providers = [
+        "istio", "kgateway", "agentgateway", "envoy-ai-gateway",
+        "gke-l7-rilb", "gke-l7-regional-external-managed",
+    ]
+    base = yaml.safe_load((gw / "base" / "gateway.yaml").read_text())
+    assert base["kind"] == "Gateway"
+    for p in providers:
+        k = yaml.safe_load((gw / p / "kustomization.yaml").read_text())
+        assert "../base" in k["resources"], p
+        patch_ops = yaml.safe_load(k["patches"][0]["patch"])
+        assert patch_ops[0]["path"] == "/spec/gatewayClassName", p
+        assert patch_ops[0]["value"], p
+
+    chart = REPO / "deploy" / "charts" / "llmd-tpu"
+    meta = yaml.safe_load((chart / "Chart.yaml").read_text())
+    assert meta["name"] == "llmd-tpu"
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    for plane in ("router", "decode", "prefill", "inferencePool", "httpRoute"):
+        assert plane in values, plane
+    templates = {p.name for p in (chart / "templates").iterdir()}
+    assert {"router.yaml", "modelserver.yaml", "inferencepool.yaml"} <= templates
+    # templates reference only declared values (cheap drift check)
+    import re
+
+    for t in ("router.yaml", "modelserver.yaml", "inferencepool.yaml"):
+        body = (chart / "templates" / t).read_text()
+        for ref in re.findall(r"\.Values\.([a-zA-Z]+)", body):
+            assert ref in values, f"{t} references undeclared values.{ref}"
