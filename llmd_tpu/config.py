@@ -48,6 +48,12 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     # Qwen2-style attention bias on QKV projections.
     attention_bias: bool = False
+    # gpt-oss extras: bias on the o projection too, and per-q-head
+    # attention SINKS — a learnable virtual-key logit appended to every
+    # softmax (its value contribution is zero, so it only absorbs
+    # probability mass).
+    attention_out_bias: bool = False
+    attention_sinks: bool = False
     # Qwen3-style per-head RMS norm on Q and K (applied before RoPE).
     qk_norm: bool = False
     # --- sliding-window attention (gpt-oss / Mistral / long-context Qwen) ---
@@ -81,6 +87,17 @@ class ModelConfig:
     #                     renormalized, scaled.
     router_scoring: str = "softmax"  # "softmax" | "sigmoid"
     topk_method: str = "greedy"  # "greedy" | "group_max" | "group_top2"
+    # gpt-oss: the router bias is part of the LOGITS (selection by
+    # logits+bias, weights = softmax over the selected logits — which our
+    # softmax-topk-renormalize already equals once the bias is folded in),
+    # unlike DeepSeek-V3's selection-only correction bias.
+    router_logit_bias: bool = False
+    # Expert MLP family: "silu" (Mixtral/Qwen/DeepSeek SwiGLU) or
+    # "swiglu_oss" (gpt-oss: interleaved-loaded gate/up WITH biases,
+    # gate clamped to [-inf, limit], up to [-limit, limit],
+    # glu = gate * sigmoid(alpha * gate), out = (up + 1) * glu).
+    moe_activation: str = "silu"
+    swiglu_limit: float = 7.0
     norm_topk_prob: bool = True
     routed_scaling_factor: float = 1.0
     n_group: int = 1

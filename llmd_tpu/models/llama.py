@@ -76,6 +76,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
             layers["bq"] = jnp.zeros((n, Nq * D), dt)
             layers["bk"] = jnp.zeros((n, K * D), dt)
             layers["bv"] = jnp.zeros((n, K * D), dt)
+        if cfg.attention_out_bias:
+            layers["bo"] = jnp.zeros((n, H), dt)
+        if cfg.attention_sinks:
+            layers["sinks"] = mk("sinks", (n, Nq), scale=1.0)
         if cfg.qk_norm:
             layers["attn_q_norm"] = jnp.ones((n, D), dt)
             layers["attn_k_norm"] = jnp.ones((n, D), dt)
@@ -94,12 +98,18 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         if moe:
             E, Fm = cfg.num_experts, cfg.moe_intermediate_size
             layers["router"] = mkp("router", (n, H, E), scale=H**-0.5)
-            if cfg.router_scoring == "sigmoid":
-                # V3-style selection-only correction bias (noaux_tc).
+            if cfg.router_scoring == "sigmoid" or cfg.router_logit_bias:
+                # V3-style selection-only correction bias (noaux_tc), or
+                # gpt-oss's real logit bias — either way the leaf must
+                # exist in the init tree (load_params' shape contract).
                 layers["router_bias"] = jnp.zeros((n, E), jnp.float32)
             layers["we_gate"] = mkp("we_gate", (n, E, H, Fm))
             layers["we_up"] = mkp("we_up", (n, E, H, Fm))
             layers["we_down"] = mkp("we_down", (n, E, Fm, H))
+            if cfg.moe_activation == "swiglu_oss":
+                layers["we_gate_b"] = jnp.zeros((n, E, Fm), dt)
+                layers["we_up_b"] = jnp.zeros((n, E, Fm), dt)
+                layers["we_down_b"] = jnp.zeros((n, E, H), dt)
             if cfg.shared_expert_intermediate_size:
                 Fs = cfg.shared_expert_intermediate_size
                 layers["ws_gate"] = mkp("ws_gate", (n, H, Fs))
@@ -272,6 +282,14 @@ def forward_hidden(
                 cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
                 world_size=world_size, mesh=mesh,
             )
+            sinks = lp.get("sinks")
+
+            def _project(attn_sl, n_rows):
+                out = pdot(attn_sl.reshape(n_rows, Q, Nq * D), lp, "wo")
+                if "bo" in lp:
+                    out = out + lp["bo"]
+                return out
+
             if use_dbo:
                 outs = []
                 for sl in (slice(0, half), slice(half, B)):
@@ -279,17 +297,16 @@ def forward_hidden(
                         q[sl], cache, layer_idx, inp.page_table[sl],
                         inp.kv_lens[sl], inp.positions[sl], sm_scale,
                         world_size=world_size, mesh=mesh, window=window,
+                        sinks=sinks,
                     )
-                    attn_sl = pdot(
-                        attn_sl.reshape(half, Q, Nq * D), lp, "wo"
-                    )
-                    outs.append(_tail(x[sl], attn_sl, lp, use_moe))
+                    outs.append(_tail(x[sl], _project(attn_sl, half), lp, use_moe))
                 return jnp.concatenate(outs, axis=0), cache
             attn = paged_attention_full(
                 q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
                 sm_scale, world_size=world_size, mesh=mesh, window=window,
+                sinks=sinks,
             )
-            x = x + pdot(attn.reshape(B, Q, Nq * D), lp, "wo")
+            x = x + _project(attn, B)
         # attention residual already applied above; _tail adds 0
         return _tail(x, 0.0, lp, use_moe), cache
 

@@ -41,7 +41,7 @@ _DENSE_ARCHS = {
     "Qwen2ForCausalLM",
     "Qwen3ForCausalLM",
 }
-_MOE_ARCHS = {"MixtralForCausalLM", "Qwen3MoeForCausalLM"}
+_MOE_ARCHS = {"MixtralForCausalLM", "Qwen3MoeForCausalLM", "GptOssForCausalLM"}
 _MLA_ARCHS = {"DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM"}
 SUPPORTED_ARCHS = _DENSE_ARCHS | _MOE_ARCHS | _MLA_ARCHS
 
@@ -133,6 +133,23 @@ def config_from_hf(model_dir: str, **overrides) -> ModelConfig:
             num_experts_per_tok=hf["num_experts_per_tok"],
             moe_intermediate_size=hf["moe_intermediate_size"],
             norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        )
+    elif arch == "GptOssForCausalLM":
+        kw.update(
+            # HF GptOssConfig defaults attention_bias to TRUE (unlike the
+            # shared path's False default): pin the same default for both
+            # the qkv and o biases so a config.json omitting the key
+            # doesn't silently drop the qkv biases.
+            attention_bias=bool(hf.get("attention_bias", True)),
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf["num_experts_per_tok"],
+            moe_intermediate_size=hf["intermediate_size"],
+            moe_activation="swiglu_oss",
+            swiglu_limit=float(hf.get("swiglu_limit") or 7.0),
+            router_logit_bias=True,
+            norm_topk_prob=True,  # softmax over the selected logits
+            attention_out_bias=bool(hf.get("attention_bias", True)),
+            attention_sinks=True,
         )
     elif arch in _MLA_ARCHS:
         if arch == "DeepseekV3ForCausalLM":
@@ -318,6 +335,14 @@ def load_params(
                 layers["bv"] = stack(
                     [proj(i, "self_attn.v_proj.bias") for i in layer_ids]
                 )
+            if cfg.attention_out_bias:
+                layers["bo"] = stack(
+                    [proj(i, "self_attn.o_proj.bias") for i in layer_ids]
+                )
+            if cfg.attention_sinks:
+                layers["sinks"] = np.stack(
+                    [ckpt.get(proj(i, "self_attn.sinks")) for i in layer_ids]
+                ).astype(np.float32)
             if cfg.qk_norm:
                 layers["attn_q_norm"] = stack(
                     [proj(i, "self_attn.q_norm.weight") for i in layer_ids]
@@ -335,7 +360,38 @@ def load_params(
             layers["la_v"] = np.zeros((n, A1, H, r), dt)
             layers["lb_q"] = np.zeros((n, A1, r, Nq * D), dt)
             layers["lb_v"] = np.zeros((n, A1, r, K * D), dt)
-        if moe:
+        if moe and ckpt.has(proj(layer_ids[0], "mlp.router.weight")):
+            # gpt-oss: the router is mlp.router (weight [E, H] + bias) and
+            # experts are FUSED per-layer parameter tensors (not Linear
+            # modules): gate_up_proj [E, H, 2F] with gate/up INTERLEAVED
+            # on the last axis (HF GptOssExperts: gate = [..., ::2]),
+            # plus per-expert biases, and down_proj [E, F, H] — already
+            # [in, out], so no transpose.
+            layers["router"] = stack(
+                [proj(i, "mlp.router.weight") for i in layer_ids], True
+            )
+            layers["router_bias"] = np.stack(
+                [ckpt.get(proj(i, "mlp.router.bias")) for i in layer_ids]
+            ).astype(np.float32)
+            gu = np.stack(
+                [ckpt.get(proj(i, "mlp.experts.gate_up_proj")) for i in layer_ids]
+            )  # [L, E, H, 2F]
+            gub = np.stack(
+                [ckpt.get(proj(i, "mlp.experts.gate_up_proj_bias"))
+                 for i in layer_ids]
+            )  # [L, E, 2F]
+            layers["we_gate"] = np.ascontiguousarray(gu[..., 0::2]).astype(dt)
+            layers["we_up"] = np.ascontiguousarray(gu[..., 1::2]).astype(dt)
+            layers["we_gate_b"] = np.ascontiguousarray(gub[..., 0::2]).astype(dt)
+            layers["we_up_b"] = np.ascontiguousarray(gub[..., 1::2]).astype(dt)
+            layers["we_down"] = np.stack(
+                [ckpt.get(proj(i, "mlp.experts.down_proj")) for i in layer_ids]
+            ).astype(dt)
+            layers["we_down_b"] = np.stack(
+                [ckpt.get(proj(i, "mlp.experts.down_proj_bias"))
+                 for i in layer_ids]
+            ).astype(dt)
+        elif moe:
             E = cfg.num_experts
             if ckpt.has(proj(layer_ids[0], "block_sparse_moe.gate.weight")):
                 # Mixtral naming: w1=gate, w3=up, w2=down

@@ -46,6 +46,13 @@ def router_topk(
     h: [T, H]; returns (weights [T, k] f32, expert_ids [T, k] i32).
     """
     logits = (h.astype(jnp.float32) @ w_router.astype(jnp.float32))  # [T, E]
+    if cfg is not None and cfg.router_logit_bias and bias is not None:
+        # gpt-oss: the bias is part of the LOGITS — selection by
+        # logits+bias AND weights from the (softmaxed) biased logits.
+        # Softmax-topk-renormalize below is exactly softmax over the
+        # selected biased logits, so fold it in and clear it.
+        logits = logits + bias.astype(jnp.float32)
+        bias = None
     if cfg is None:
         probs = jax.nn.softmax(logits, axis=-1)
         weights, ids = jax.lax.top_k(probs, top_k)
@@ -95,6 +102,28 @@ def _expert_scales(lp: dict) -> tuple | None:
     return (lp["we_gate_scale"], lp["we_up_scale"], lp["we_down_scale"])
 
 
+def _expert_biases(lp: dict) -> tuple | None:
+    """(gate, up, down) per-expert biases (gpt-oss experts carry them)."""
+    if "we_gate_b" not in lp:
+        return None
+    return (lp["we_gate_b"], lp["we_up_b"], lp["we_down_b"])
+
+
+def expert_glu(gate: jax.Array, up: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """The gated-unit nonlinearity per MoE family (pre-down-projection).
+
+    silu: silu(gate) * up (Mixtral/Qwen/DeepSeek). swiglu_oss (gpt-oss
+    GptOssExperts): gate clamped above, up clamped both sides,
+    glu = gate * sigmoid(1.702 * gate), combined as (up + 1) * glu.
+    """
+    if cfg.moe_activation == "swiglu_oss":
+        gate = jnp.minimum(gate, cfg.swiglu_limit)
+        up = jnp.clip(up, -cfg.swiglu_limit, cfg.swiglu_limit)
+        glu = gate * jax.nn.sigmoid(1.702 * gate)
+        return (up + 1.0) * glu
+    return jax.nn.silu(gate) * up
+
+
 def moe_block_grouped(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     """MoE FFN via grouped GEMM (DeepGEMM role): tokens sorted by expert,
     each expert multiplies only its routed rows. Numerically equivalent to
@@ -109,7 +138,7 @@ def moe_block_grouped(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
     )
     out = moe_apply_grouped(
         ht, weights, ids, lp["we_gate"], lp["we_up"], lp["we_down"],
-        scales=_expert_scales(lp),
+        scales=_expert_scales(lp), biases=_expert_biases(lp), cfg=cfg,
     ).astype(h.dtype)
     if cfg.shared_expert_intermediate_size:
         out = out + shared_expert_ffn(ht, lp)
@@ -144,13 +173,21 @@ def moe_block(h: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
         we_gate = dequantize(we_gate, lp["we_gate_scale"], dtype=ht.dtype)
         we_up = dequantize(we_up, lp["we_up_scale"], dtype=ht.dtype)
         we_down = dequantize(we_down, lp["we_down_scale"], dtype=ht.dtype)
-    gate = jax.nn.silu(jnp.einsum("th,ehf->etf", ht, we_gate))
+    gate = jnp.einsum("th,ehf->etf", ht, we_gate)
     up = jnp.einsum("th,ehf->etf", ht, we_up)
-    act = gate * up * combine.T[:, :, None].astype(gate.dtype)
+    biases = _expert_biases(lp)
+    if biases is not None:
+        gate = gate + biases[0][:, None, :]
+        up = up + biases[1][:, None, :]
+    act = expert_glu(gate, up, cfg) * combine.T[:, :, None].astype(gate.dtype)
     out = jnp.einsum(
         "etf,efh->th", act, we_down,
         preferred_element_type=jnp.float32,
-    ).astype(h.dtype)
+    )
+    if biases is not None:
+        # Per-expert down bias, weighted by each token's combine weight.
+        out = out + combine @ biases[2].astype(jnp.float32)
+    out = out.astype(h.dtype)
 
     if cfg.shared_expert_intermediate_size:
         out = out + shared_expert_ffn(ht, lp)

@@ -168,6 +168,33 @@ def _deepseek_wide() -> ModelConfig:
     )
 
 
+@register_model("gpt-oss-20b")
+def _gpt_oss_20b() -> ModelConfig:
+    """gpt-oss-20b (HF openai/gpt-oss-20b): alternating sliding/full
+    attention with per-head sinks, 32 experts top-4 with clamped-swiglu
+    biased experts, yarn rope — the reference's flagship P/D benchmark
+    model (guides/pd-disaggregation/README.md:600-615)."""
+    return ModelConfig(
+        name="gpt-oss-20b", vocab_size=201088, hidden_size=2880,
+        intermediate_size=2880, num_layers=24, num_heads=64,
+        num_kv_heads=8, head_dim=64, rope_theta=150000.0,
+        max_model_len=131072,
+        sliding_window=128,
+        layer_types=tuple(
+            "sliding_attention" if i % 2 == 0 else "full_attention"
+            for i in range(24)
+        ),
+        attention_bias=True, attention_out_bias=True, attention_sinks=True,
+        num_experts=32, num_experts_per_tok=4, moe_intermediate_size=2880,
+        moe_activation="swiglu_oss", router_logit_bias=True,
+        norm_topk_prob=True,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 32.0, "beta_fast": 32.0,
+            "beta_slow": 1.0, "original_max_position_embeddings": 4096,
+        },
+    )
+
+
 @register_model("deepseek-v2-lite")
 def _deepseek_v2_lite() -> ModelConfig:
     """DeepSeek-V2-Lite (HF deepseek-ai/DeepSeek-V2-Lite): MLA without a

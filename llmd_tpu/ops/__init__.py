@@ -145,15 +145,19 @@ _DENSE_XLA_MAX_S = 4096
 
 
 def _attention_xla(q, kv_slice, page_table, kv_lens, positions, sm_scale,
-                   window=None):
+                   window=None, sinks=None):
     S = page_table.shape[1] * kv_slice.shape[-2]
-    if q.shape[1] > 1 and S > _DENSE_XLA_MAX_S:
+    if S > _DENSE_XLA_MAX_S:
+        # The blocked online-softmax path handles Q==1 too — long-context
+        # DECODE through the XLA fallback (e.g. sink models) must not
+        # gather the whole padded context per step.
         return paged_attention_xla_blocked(
             q, kv_slice, page_table, kv_lens, positions, sm_scale,
-            window=window,
+            window=window, sinks=sinks,
         )
     return paged_attention_xla(
-        q, kv_slice, page_table, kv_lens, positions, sm_scale, window=window
+        q, kv_slice, page_table, kv_lens, positions, sm_scale, window=window,
+        sinks=sinks,
     )
 
 
@@ -352,7 +356,7 @@ def mla_paged_attention_full(
 
 def paged_attention_full(
     q, kv_cache_full, layer, page_table, kv_lens, positions,
-    sm_scale=None, world_size=1, mesh=None, window=None,
+    sm_scale=None, world_size=1, mesh=None, window=None, sinks=None,
 ):
     """Layer-indexed attention on the FULL [L, ...] cache (see
     write_kv_pages_full). ``window`` is an optional i32 scalar sliding
@@ -361,6 +365,10 @@ def paged_attention_full(
     L, num_pages, K, page, D2 = kv_cache_full.shape
     B, Q, H, D = q.shape
     plan = _plan(Q, page, D, D2, world_size, True, mesh, B, H, K)
+    if sinks is not None:
+        # Sink-carrying models (gpt-oss) run the XLA paths: the Pallas
+        # decode kernel does not yet fold the virtual-key logit.
+        plan = "xla"
     if window is not None:
         window = jnp.asarray(window, jnp.int32)
     if plan == "direct":
@@ -391,5 +399,6 @@ def paged_attention_full(
         )(q, kv_cache_full, layer, page_table, kv_lens, win)
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
     return _attention_xla(
-        q, sl, page_table, kv_lens, positions, sm_scale, window=window
+        q, sl, page_table, kv_lens, positions, sm_scale, window=window,
+        sinks=sinks,
     )

@@ -79,19 +79,37 @@ def expert_mlp_grouped(
     we_up: jax.Array,        # [E, H, F]
     we_down: jax.Array,      # [E, F, H]
     scales: tuple | None = None,  # int8 experts: (s_gate [E,F], s_up [E,F], s_down [E,H])
+    biases: tuple | None = None,  # gpt-oss experts: (b_gate [E,F], b_up [E,F], b_down [E,H])
+    cfg=None,                # ModelConfig for the activation family
 ) -> jax.Array:              # [T', H]
+    from llmd_tpu.models.moe import expert_glu
+
+    T = x_sorted.shape[0]
+    E = we_gate.shape[0]
     if scales is not None:
         from llmd_tpu.ops.quant import grouped_matmul_q
 
-        s_gate, s_up, s_down = scales
-        gate = jax.nn.silu(grouped_matmul_q(x_sorted, we_gate, s_gate, group_sizes))
-        up = grouped_matmul_q(x_sorted, we_up, s_up, group_sizes)
-        return grouped_matmul_q(
-            (gate * up).astype(x_sorted.dtype), we_down, s_down, group_sizes
+        mm = lambda x, w, s: grouped_matmul_q(x, w, s, group_sizes)  # noqa: E731
+    else:
+        mm = lambda x, w, s: grouped_matmul(x, w, group_sizes)  # noqa: E731
+    s_gate, s_up, s_down = scales if scales is not None else (None,) * 3
+    gate = mm(x_sorted, we_gate, s_gate)
+    up = mm(x_sorted, we_up, s_up)
+    gid = None
+    if biases is not None:
+        gid = jnp.repeat(
+            jnp.arange(E, dtype=jnp.int32), group_sizes, total_repeat_length=T
         )
-    gate = jax.nn.silu(grouped_matmul(x_sorted, we_gate, group_sizes))
-    up = grouped_matmul(x_sorted, we_up, group_sizes)
-    return grouped_matmul((gate * up).astype(x_sorted.dtype), we_down, group_sizes)
+        gate = gate + biases[0][gid]
+        up = up + biases[1][gid]
+    act = (
+        expert_glu(gate, up, cfg) if cfg is not None
+        else jax.nn.silu(gate) * up  # bare-array callers (tests)
+    )
+    out = mm(act.astype(x_sorted.dtype), we_down, s_down)
+    if biases is not None:
+        out = out + biases[2][gid].astype(out.dtype)
+    return out
 
 
 def moe_apply_grouped(
@@ -102,6 +120,8 @@ def moe_apply_grouped(
     we_up: jax.Array,
     we_down: jax.Array,
     scales: tuple | None = None,
+    biases: tuple | None = None,
+    cfg=None,
 ) -> jax.Array:          # [T, H] f32
     """Route -> sort-by-expert -> grouped MLP -> weighted unsort-combine."""
     T, H = ht.shape
@@ -112,7 +132,10 @@ def moe_apply_grouped(
     tok = order // k                                 # source token per slot
     xs = ht[tok]                                     # [T*k, H]
     group_sizes = jnp.bincount(flat_ids, length=E)
-    ys = expert_mlp_grouped(xs, group_sizes, we_gate, we_up, we_down, scales=scales)
+    ys = expert_mlp_grouped(
+        xs, group_sizes, we_gate, we_up, we_down, scales=scales,
+        biases=biases, cfg=cfg,
+    )
     w_sorted = weights.reshape(-1)[order]
     return (
         jnp.zeros((T, H), jnp.float32)

@@ -75,6 +75,7 @@ def paged_attention_xla_blocked(
     sm_scale: float | None = None,
     block_pages: int = 32,
     window=None,  # i32 scalar (0/None = full attention)
+    sinks=None,   # [H] per-q-head virtual-key logits (gpt-oss)
 ) -> jax.Array:
     """Flash-style blocked paged attention in plain XLA.
 
@@ -142,6 +143,14 @@ def paged_attention_xla_blocked(
     (m, l, acc), _ = jax.lax.scan(
         body, (m0, l0, acc0), jnp.arange(n_blocks, dtype=jnp.int32)
     )
+    if sinks is not None:
+        # The sink is one more (value-less) key: fold exp(sink) into the
+        # softmax denominator, rescaled into the online-softmax's running
+        # max frame (exactly HF's concat-then-drop formulation).
+        sk = sinks.astype(jnp.float32).reshape(K, G)[None, None, :, :]
+        m2 = jnp.maximum(m, sk)
+        l = l * jnp.exp(m - m2) + jnp.exp(sk - m2)
+        acc = acc * jnp.exp(m - m2)[..., None]
     l = jnp.where(l == 0.0, 1.0, l)
     out = acc / l[..., None]
     return out.reshape(B, Q, H, D).astype(q.dtype)
@@ -155,6 +164,7 @@ def paged_attention_xla(
     positions: jax.Array,  # [B, Q]
     sm_scale: float | None = None,
     window=None,  # i32 scalar (0/None = full attention)
+    sinks=None,   # [H] per-q-head virtual-key logits (gpt-oss)
 ) -> jax.Array:
     """Reference paged attention: gather the whole context, masked softmax."""
     B, Q, H, D = q.shape
@@ -184,7 +194,19 @@ def paged_attention_xla(
         :, :, None, None, :
     ]  # [B,Q,1,1,S]
     scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        # gpt-oss attention sinks: append the per-head sink logit as an
+        # extra (always-unmasked) column, softmax, then drop it — the
+        # sink only absorbs probability mass (HF eager_attention_forward).
+        sk = jnp.broadcast_to(
+            sinks.astype(scores.dtype).reshape(K, group)[None, None, :, :, None],
+            (B, Q, K, group, 1),
+        )
+        probs = jax.nn.softmax(
+            jnp.concatenate([scores, sk], axis=-1), axis=-1
+        )[..., :-1]
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bqkgs,bskd->bqkgd",
         probs.astype(v.dtype),

@@ -99,6 +99,8 @@ PARAM_SPECS: dict[str, P] = {
     "bq": P(None, TP_AXIS),
     "bk": P(None, TP_AXIS),
     "bv": P(None, TP_AXIS),
+    "bo": P(None, None),          # [L, H] row-parallel output, replicated
+    "sinks": P(None, TP_AXIS),    # [L, Nq] per-q-head sink logits
     "attn_q_norm": P(None, None),  # [L, D] per-head norm, replicated
     "attn_k_norm": P(None, None),
     # LoRA: down-projections replicated (rank is tiny), up-projections
@@ -116,6 +118,9 @@ PARAM_SPECS: dict[str, P] = {
     "we_gate": P(None, EP_AXES, None, None),  # [L, E, H, Fm]
     "we_up": P(None, EP_AXES, None, None),
     "we_down": P(None, EP_AXES, None, None),  # [L, E, Fm, H]
+    "we_gate_b": P(None, EP_AXES, None),      # gpt-oss expert biases
+    "we_up_b": P(None, EP_AXES, None),
+    "we_down_b": P(None, EP_AXES, None),
     "ws_gate": P(None, None, TP_AXIS),   # shared expert, TP like dense mlp
     "ws_up": P(None, None, TP_AXIS),
     "ws_down": P(None, TP_AXIS, None),

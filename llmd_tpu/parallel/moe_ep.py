@@ -83,6 +83,8 @@ def moe_block_ep(
         "we_down": P(ep[0], None, None),
         "we_gate_scale": P(ep[0], None), "we_up_scale": P(ep[0], None),
         "we_down_scale": P(ep[0], None),
+        "we_gate_b": P(ep[0], None), "we_up_b": P(ep[0], None),
+        "we_down_b": P(ep[0], None),
         "ws_gate": P(None, None), "ws_up": P(None, None),
         "ws_down": P(None, None),
         "ws_gate_scale": P(None), "ws_up_scale": P(None),
@@ -158,8 +160,12 @@ def _moe_ep_local(
     scales = None
     if "we_gate_scale" in p:
         scales = (p["we_gate_scale"], p["we_up_scale"], p["we_down_scale"])
+    biases = None
+    if "we_gate_b" in p:
+        biases = (p["we_gate_b"], p["we_up_b"], p["we_down_b"])
     ys = expert_mlp_grouped(
-        xr[order], group_sizes, we_gate, we_up, we_down, scales=scales
+        xr[order], group_sizes, we_gate, we_up, we_down, scales=scales,
+        biases=biases, cfg=cfg,
     )
     yr = (
         jnp.zeros_like(xr).at[order].set(ys)

@@ -276,6 +276,32 @@ def test_llama_yarn_matches_transformers(tmp_path):
 
 
 @needs_torch
+def test_gpt_oss_greedy_matches_transformers(tmp_path):
+    """gpt-oss — the reference's flagship P/D model family
+    (pd-disaggregation/README.md:600-615): attention sinks, alternating
+    sliding/full layers, qkv+o biases, clamped-swiglu biased experts with
+    interleaved fused gate_up weights, and topk-softmax logit-bias
+    routing must ALL reproduce transformers token-for-token."""
+    if not hasattr(transformers, "GptOssForCausalLM"):
+        pytest.skip("transformers too old for GptOss")
+    hf_cfg = transformers.GptOssConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+        num_local_experts=4, num_experts_per_tok=2, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        tie_word_embeddings=False, rope_scaling=None,
+    )
+    torch.manual_seed(11)
+    model = transformers.GptOssForCausalLM(hf_cfg)
+    d = _save_hf(model, tmp_path)
+    prompt = [int(x) for x in np.random.default_rng(9).integers(1, 255, 40)]
+    golden = _hf_greedy(model, prompt, NEW_TOKENS)
+    ours = _ours_greedy(d, prompt, NEW_TOKENS)
+    assert ours == golden
+
+
+@needs_torch
 def test_mistral_sliding_window_greedy_matches_transformers(tmp_path):
     """Golden parity on a trained-shape sliding-window checkpoint (the
     gpt-oss-class capability, reference pd-disaggregation/README.md:
