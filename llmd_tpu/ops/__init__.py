@@ -365,38 +365,39 @@ def paged_attention_full(
     L, num_pages, K, page, D2 = kv_cache_full.shape
     B, Q, H, D = q.shape
     plan = _plan(Q, page, D, D2, world_size, True, mesh, B, H, K)
-    if sinks is not None:
-        # Sink-carrying models (gpt-oss) run the XLA paths: the Pallas
-        # decode kernel does not yet fold the virtual-key logit.
-        plan = "xla"
     if window is not None:
         window = jnp.asarray(window, jnp.int32)
     if plan == "direct":
         return decode_paged_attention_full(
             q, kv_cache_full, layer, page_table, kv_lens, sm_scale=sm_scale,
-            interpret=_interpret(), window=window,
+            interpret=_interpret(), window=window, sinks=sinks,
         )
     if plan == "shard":
         tp_k = _kv_head_axis(K, mesh.shape["tp"])
         interpret = _interpret()
         win = jnp.zeros((), jnp.int32) if window is None else window
         use_win = window is not None
+        # Sinks are per-q-head: shard over tp with the q heads (zeros
+        # placeholder keeps the shard_map arity fixed when absent).
+        sk = jnp.zeros((H,), jnp.float32) if sinks is None else sinks
+        use_sinks = sinks is not None
 
-        def local(q, cache, layer, pt, kl, win):
+        def local(q, cache, layer, pt, kl, win, sk):
             return decode_paged_attention_full(
                 q, cache, layer, pt, kl, sm_scale=sm_scale,
                 interpret=interpret, window=win if use_win else None,
+                sinks=sk if use_sinks else None,
             )
 
         return shard_map(
             local, mesh=mesh,
             in_specs=(
                 P("dp", None, "tp", None), P(None, None, tp_k, None, None),
-                P(), P("dp", None), P("dp"), P(),
+                P(), P("dp", None), P("dp"), P(), P("tp"),
             ),
             out_specs=P("dp", None, "tp", None),
             check_vma=False,
-        )(q, kv_cache_full, layer, page_table, kv_lens, win)
+        )(q, kv_cache_full, layer, page_table, kv_lens, win, sk)
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
     return _attention_xla(
         q, sl, page_table, kv_lens, positions, sm_scale, window=window,

@@ -36,6 +36,7 @@ def _decode_kernel(
     win_starts_ref,  # [B] i32 first attended position (sliding window; 0=full)
     # blocks
     q_ref,  # [1, K, G, D] VMEM
+    sinks_ref,  # [K, G] f32 per-q-head sink logits (zeros when unused)
     kv_hbm_full_ref,  # [(L,) num_pages, K, page, 2D] in HBM (unblocked)
     out_ref,  # [1, K, G, D] VMEM
     # scratch
@@ -47,6 +48,7 @@ def _decode_kernel(
     head_dim: int,
     sm_scale: float,
     pages_per_block: int,
+    has_sinks: bool,
 ):
     b = pl.program_id(0)
     kv_hbm_ref = (
@@ -157,13 +159,22 @@ def _decode_kernel(
     )
 
     l = l_ref[:, :, :1]
+    if has_sinks:
+        # gpt-oss attention sink: one extra value-less key — fold
+        # exp(sink) into the denominator, rescaled into the running-max
+        # frame (exact concat-then-drop semantics).
+        m = m_ref[:, :, :1]
+        sk = sinks_ref[...][:, :, None]  # read the block, then broadcast
+        m2 = jnp.maximum(m, sk)
+        l = l * jnp.exp(m - m2) + jnp.exp(sk - m2)
+        acc_ref[:] = acc_ref[:] * jnp.exp(m - m2)
     l = jnp.where(l == 0.0, 1.0, l)
     out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
 
 
 def _decode_call(
     q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
-    pages_per_block, window=None,
+    pages_per_block, window=None, sinks=None,
 ):
     B, Q, H, D = q.shape
     assert Q == 1, "decode kernel handles Q=1"
@@ -190,11 +201,18 @@ def _decode_call(
             window > 0, jnp.maximum(kv_lens - window, 0), 0
         ).astype(jnp.int32)
 
+    if sinks is None:
+        sinks2d = jnp.zeros((K, G), jnp.float32)
+    else:
+        # q head h maps to (h // G, h % G) — same grouping as qk above.
+        sinks2d = sinks.astype(jnp.float32).reshape(K, G)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl, ws: (b, 0, 0, 0)),
+            pl.BlockSpec((K, G), lambda b, l, pt, kl, ws: (0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
         ],
         out_specs=pl.BlockSpec(
@@ -213,6 +231,7 @@ def _decode_call(
             head_dim=D,
             sm_scale=sm_scale,
             pages_per_block=pages_per_block,
+            has_sinks=sinks is not None,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
@@ -223,7 +242,7 @@ def _decode_call(
     )
     out = kernel(
         layer.astype(jnp.int32).reshape(1), page_table, kv_lens, win_starts,
-        qk, kv_cache,
+        qk, sinks2d, kv_cache,
     )
     return out.reshape(B, 1, H, D)
 
@@ -240,10 +259,11 @@ def decode_paged_attention(
     interpret: bool = False,
     pages_per_block: int = 16,
     window: jax.Array | None = None,
+    sinks: jax.Array | None = None,
 ) -> jax.Array:
     return _decode_call(
         q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
-        sm_scale, interpret, pages_per_block, window=window,
+        sm_scale, interpret, pages_per_block, window=window, sinks=sinks,
     )
 
 
@@ -257,11 +277,12 @@ def decode_paged_attention_full(
     interpret: bool = False,
     pages_per_block: int = 16,
     window: jax.Array | None = None,
+    sinks: jax.Array | None = None,
 ) -> jax.Array:
     """Layer-indexed variant: reads cache[layer] pages directly from the
     full-cache HBM ref — a scan over layers never materializes a
     pool-sized slice."""
     return _decode_call(
         q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
-        pages_per_block, window=window,
+        pages_per_block, window=window, sinks=sinks,
     )

@@ -178,3 +178,49 @@ def test_loader_accepts_sliding_window_configs(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps(hf))
     cfg = config_from_hf(str(tmp_path))
     assert cfg.layer_windows == (1024, 0, 1024, 0)
+
+
+def test_pallas_decode_sinks_matches_oracle():
+    """The decode kernel's sink epilogue (gpt-oss): exp(sink) folded into
+    the denominator must match the dense concat-then-drop oracle, alone
+    and combined with a sliding window."""
+    B, S, K, G, D, page = 2, 64, 2, 2, 128, 8
+    rng = jax.random.key(3)
+    kq, kk, kv_, ks = jax.random.split(rng, 4)
+    q = jax.random.normal(kq, (B, 1, K * G, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, K, D), jnp.float32)
+    v = jax.random.normal(kv_, (B, S, K, D), jnp.float32)
+    sinks = jax.random.normal(ks, (K * G,), jnp.float32) * 2.0
+    cache, pt = _build_cache(k, v, page)
+    kv_lens = jnp.asarray([S, S - 5], jnp.int32)
+    positions = (kv_lens - 1)[:, None]
+
+    def oracle(window):
+        qg = q.reshape(B, 1, K, G, D)
+        scores = jnp.einsum("bqkgd,bskd->bqkgs", qg, k) * (D ** -0.5)
+        key_pos = jnp.arange(S)[None, None, :]
+        mask = (key_pos <= positions[:, :, None]) & (
+            key_pos < kv_lens[:, None, None]
+        )
+        if window:
+            mask = mask & (key_pos > positions[:, :, None] - window)
+        scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+        sk = jnp.broadcast_to(
+            sinks.reshape(K, G)[None, None, :, :, None], (B, 1, K, G, 1)
+        )
+        probs = jax.nn.softmax(
+            jnp.concatenate([scores, sk], axis=-1), axis=-1
+        )[..., :-1]
+        out = jnp.einsum("bqkgs,bskd->bqkgd", probs, v)
+        return out.reshape(B, 1, K * G, D)
+
+    for window in (None, 20):
+        out = decode_paged_attention(
+            q, cache, pt, kv_lens, interpret=True, pages_per_block=2,
+            window=None if window is None else jnp.int32(window),
+            sinks=sinks,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(oracle(window)),
+            atol=2e-4, rtol=2e-4,
+        )
