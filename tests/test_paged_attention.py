@@ -290,3 +290,42 @@ def test_sharded_mla_latent_write_dispatches_kernel(monkeypatch):
     ref = write_kv_pages(cache0[0], k, v, pt, positions, valid)
     np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref))
     np.testing.assert_allclose(np.asarray(got[1]), np.asarray(cache0[1]))
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 2), (2, 4)])
+def test_sharded_decode_attention_with_sinks(monkeypatch, dp, tp):
+    """Sinks under shard_map: the P('tp') shard of the per-q-head sink
+    logits must align with each shard's local (K, G) head grouping —
+    a misalignment folds the WRONG head's sink into the denominator and
+    only shows up multichip."""
+    import numpy as np
+
+    from llmd_tpu import ops
+
+    monkeypatch.setenv("LLMD_PALLAS", "interpret")
+    mesh = _mesh(dp, tp)
+    world = dp * tp
+    L, B, K, D, page, num_pages, max_pages = 2, 4, 4, 128, 8, 64, 4
+    H = 8
+    rng = np.random.default_rng(17)
+    cache = jnp.asarray(rng.random((L, num_pages, K, page, 2 * D)), jnp.float32)
+    pt = jnp.asarray(
+        (np.arange(B * max_pages).reshape(B, max_pages) % num_pages).astype(np.int32)
+    )
+    kv_lens = jnp.asarray([5, 32, 17, 9], jnp.int32)
+    positions = (kv_lens - 1)[:, None]
+    q = jnp.asarray(rng.random((B, 1, H, D)), jnp.float32)
+    # DISTINCT per-head sinks: any head misalignment changes the result.
+    sinks = jnp.asarray(np.linspace(-2.0, 3.0, H), jnp.float32)
+    layer = jnp.asarray(1, jnp.int32)
+    ref = paged_attention_xla(
+        q, cache[1], pt, kv_lens, positions, sinks=sinks
+    )
+    got = jax.jit(
+        lambda *a: ops.paged_attention_full(
+            *a, world_size=world, mesh=mesh, sinks=sinks
+        )
+    )(q, cache, layer, pt, kv_lens, positions)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
