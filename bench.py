@@ -129,8 +129,11 @@ def bench_mla_moe():
     return total_out / dt
 
 
-async def _bench_pd_ttft():
-    """p50 TTFT through sidecar two-phase P->D with a real KV transfer."""
+async def _bench_pd_ttft(transfer_dtype: str = "auto"):
+    """p50 TTFT through sidecar two-phase P->D with a real KV transfer.
+
+    transfer_dtype="int8" measures the opt-in quantized transfer encoding
+    (half the staging bytes — the dominant cost on this tunnel)."""
     import numpy as np
     from aiohttp import ClientSession
     from aiohttp.test_utils import TestServer
@@ -158,6 +161,7 @@ async def _bench_pd_ttft():
             parallel=ParallelConfig(tensor_parallel_size=1),
             kv_role=role,
             kv_transfer_port=0,
+            kv_transfer_dtype=transfer_dtype,
         ))
 
     prefill = make_engine("kv_producer")
@@ -271,6 +275,9 @@ def _run_part(part: str):
     if part == "pd":
         p50, stages = asyncio.run(_bench_pd_ttft())
         return {"pd_ttft_p50_ms": round(p50, 1), "pd_stages": stages}
+    if part == "pd_int8":
+        p50, stages = asyncio.run(_bench_pd_ttft("int8"))
+        return {"pd_ttft_p50_int8_ms": round(p50, 1), "pd_int8_stages": stages}
     if part == "rtt":
         return round(measure_dispatch_rtt_ms(), 1)
     if part == "predictor":
@@ -393,6 +400,10 @@ def main() -> None:
         extras.update(_part_in_subprocess("pd"))
     except Exception as e:  # pragma: no cover
         extras["pd_ttft_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        extras.update(_part_in_subprocess("pd_int8"))
+    except Exception as e:  # pragma: no cover
+        extras["pd_int8_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         # Latency-predictor accuracy vs the reference's ~5% MAPE bar
         # (latency-predictor.md:58) on the synthetic mixed-regime trace.

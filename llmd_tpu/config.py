@@ -317,6 +317,11 @@ class EngineConfig:
     kv_transfer_port: int = 9100
     kv_lease_ms: int = 30_000  # operations-vllm.md:155-160
     kv_load_failure_policy: str = "recompute"  # "recompute" | "fail"
+    # P/D transfer encoding: "auto" = pool dtype, byte-exact (default);
+    # "int8" = per-row int8 + f16 scales quantized on device — halves both
+    # staging legs (the TTFT floor when staging-bandwidth-bound) at ~0.4%
+    # per-row error. Producer-side knob.
+    kv_transfer_dtype: str = "auto"
     # ZMQ pub endpoint for KV events (BlockStored/...); None disables.
     kv_events_endpoint: str | None = None
     # Tiered KV offload; None disables.

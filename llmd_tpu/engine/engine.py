@@ -148,6 +148,7 @@ class LLMEngine:
                 port=config.kv_transfer_port,
                 lease_ms=config.kv_lease_ms,
                 load_failure_policy=config.kv_load_failure_policy,
+                transfer_dtype=config.kv_transfer_dtype,
             )
             self.kv_connector = TPUConnector(kv_cfg, self.runner, self.allocator)
             self.scheduler.finish_hook = self._on_finish

@@ -60,6 +60,13 @@ class KVTransferConfig:
     # FIRST chunk instead of after the whole bundle; the consumer's
     # device uploads then overlap the producer's remaining downloads.
     chunk_pages: int = 8
+    # Transfer encoding: "auto" keeps the pool dtype byte-exact (the P/D
+    # invariance default); "int8" quantizes each (token, head) row to
+    # int8 + an f16 scale ON DEVICE before staging — both staging legs
+    # move half the bytes (the TTFT floor when staging-bandwidth-bound),
+    # at ~0.4% per-row error. Producer-driven; the consumer dequantizes
+    # into its pool dtype.
+    transfer_dtype: str = "auto"  # "auto" | "int8"
 
     @property
     def is_producer(self) -> bool:
@@ -96,10 +103,20 @@ class PulledBundle:
     def host_pages(self, n_full: int) -> np.ndarray:
         """Materialize the [L, n_full, ...] host view (fallback path only
         — this concat is deliberately NOT done on the fetch critical
-        path)."""
+        path). int8-transferred chunks dequantize on host here."""
         if self.pages is not None:
             return self.pages
-        return np.concatenate(self.np_chunks, axis=1)[:, :n_full]
+        def dequant(q8, scales):
+            *lead, d2 = q8.shape
+            qf = q8.astype(np.float32).reshape(*lead, 2, d2 // 2)
+            out = qf * scales[..., None].astype(np.float32)
+            return out.reshape(*lead, d2)
+
+        chunks = [
+            c if isinstance(c, np.ndarray) else dequant(*c)
+            for c in self.np_chunks
+        ]
+        return np.concatenate(chunks, axis=1)[:, :n_full]
 
 
 def chunk_key(key: str, j: int) -> str:
@@ -131,6 +148,39 @@ def pack_header(pages: np.ndarray) -> bytes:
     return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
 
 
+_Q8_PREFIX = "int8q:"
+
+
+def pack_header_q8(q8: np.ndarray, orig_dtype_name: str) -> bytes:
+    """Header for an int8-quantized bundle: dtype travels as
+    'int8q:<original>'; the f16 scales block follows the header (same
+    register call), and its size is derivable from the dims."""
+    dt = (_Q8_PREFIX + orig_dtype_name).encode()
+    L, n, K, page, inner = q8.shape
+    return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
+
+
+def unpack_pages_any(blob: bytes):
+    """Decode either wire form. Returns ("exact", pages) or
+    ("q8", q8, scales_f16, orig_dtype_name)."""
+    magic, ver, dlen, L, n, K, page, inner = _HDR.unpack_from(blob, 0)
+    if magic != _MAGIC or ver != 1:
+        raise PullError("bad KV bundle header")
+    off = _HDR.size + dlen
+    name = blob[_HDR.size : off].decode()
+    if not name.startswith(_Q8_PREFIX):
+        return ("exact", unpack_pages(blob))
+    orig = name[len(_Q8_PREFIX):]
+    n_rows = L * n * K * page
+    # 2 f16 scales per row: separate K-half and V-half quantization.
+    scales = np.frombuffer(blob, dtype=np.float16, offset=off, count=n_rows * 2)
+    scales = scales.reshape(L, n, K, page, 2)
+    q8 = np.frombuffer(
+        blob, dtype=np.int8, offset=off + n_rows * 4, count=n_rows * inner
+    ).reshape(L, n, K, page, inner)
+    return ("q8", q8, scales, orig)
+
+
 def pack_pages(pages: np.ndarray) -> bytes:
     """Full serialized bundle (tests / small payloads; the production path
     registers header + raw buffer separately to avoid the concat copy)."""
@@ -151,6 +201,13 @@ class TPUConnector:
     """Engine-side connector; one per engine process."""
 
     def __init__(self, cfg: KVTransferConfig, runner, allocator: PageAllocator) -> None:
+        if cfg.transfer_dtype not in ("auto", "int8"):
+            # A typo'd value would otherwise silently select the exact
+            # path and the expected bandwidth halving never materializes.
+            raise ValueError(
+                f"kv transfer_dtype {cfg.transfer_dtype!r} not supported "
+                "('auto' or 'int8')"
+            )
         self.cfg = cfg
         self.runner = runner
         self.allocator = allocator
@@ -218,10 +275,18 @@ class TPUConnector:
         cp = max(1, self.cfg.chunk_pages)
         ids = list(req.block_ids[:n_full])
         n_chunks = -(-n_full // cp)
-        snaps = [
-            self.runner.snapshot_pages_device(ids[j * cp : (j + 1) * cp], cp)
-            for j in range(n_chunks)
-        ]
+        if self.cfg.transfer_dtype == "int8":
+            snaps = [
+                self.runner.snapshot_pages_device_q8(
+                    ids[j * cp : (j + 1) * cp], cp
+                )
+                for j in range(n_chunks)
+            ]
+        else:
+            snaps = [
+                self.runner.snapshot_pages_device(ids[j * cp : (j + 1) * cp], cp)
+                for j in range(n_chunks)
+            ]
         threading.Thread(
             target=self._stage_chunks, args=(key, snaps), daemon=True
         ).start()
@@ -243,18 +308,27 @@ class TPUConnector:
         t0 = time.monotonic()
         try:
             for j, snap in enumerate(snaps):
-                pages = self.runner.download_pages(snap)
-                header = pack_header(pages)
-                # Extension dtypes (bfloat16: isbuiltin == 2) don't expose
-                # the buffer protocol the zero-copy register path needs; a
-                # same-memory uint8 view does.
-                payload = (
-                    pages if pages.dtype.isbuiltin == 1 else pages.view(np.uint8)
-                )
+                if isinstance(snap, tuple):  # int8 transfer: (q8, scales)
+                    q8, scales = (self.runner.download_pages(s) for s in snap)
+                    orig = np.dtype(self.runner.kv_cache.dtype).name
+                    # Scales ride in the header blob: one owning copy in
+                    # the shipper, no concat of the big int8 payload.
+                    header = pack_header_q8(q8, orig) + scales.tobytes()
+                    payload = q8
+                else:
+                    pages = self.runner.download_pages(snap)
+                    header = pack_header(pages)
+                    # Extension dtypes (bfloat16: isbuiltin == 2) don't
+                    # expose the buffer protocol the zero-copy register
+                    # path needs; a same-memory uint8 view does.
+                    payload = (
+                        pages if pages.dtype.isbuiltin == 1
+                        else pages.view(np.uint8)
+                    )
                 self.server.register(
                     chunk_key(key, j), payload, self.cfg.lease_ms, header=header
                 )
-                self.exported_bytes += len(header) + pages.nbytes
+                self.exported_bytes += len(header) + payload.nbytes
         except Exception:
             log.exception("KV export staging failed for %s", key)
         finally:
@@ -322,18 +396,31 @@ class TPUConnector:
         np_chunks, dev_chunks, nbytes = [], [], 0
         for j in range(n_chunks):
             blob = shipper_mod.pull_wait(host, port, chunk_key(key, j), deadline)
-            pages = unpack_pages(blob)
-            if pages.shape[1] != cp:
+            decoded = unpack_pages_any(blob)
+            payload = decoded[1]
+            if payload.shape[1] != cp:
                 raise ValueError(
-                    f"chunk {j} holds {pages.shape[1]} pages, expected {cp}"
+                    f"chunk {j} holds {payload.shape[1]} pages, expected {cp}"
                 )
-            if pages.dtype != want_dtype:
-                raise ValueError(
-                    f"KV dtype mismatch: producer {pages.dtype} "
-                    f"vs consumer {want_dtype}"
+            if decoded[0] == "q8":
+                # Already lossy, and dequantization targets the CONSUMER
+                # pool dtype — no producer-pool-dtype match required
+                # (heterogeneous-pool pairings are fine).
+                _, q8, scales, _orig = decoded
+                np_chunks.append((q8, scales))
+                dev_chunks.append(
+                    self.runner.upload_pages_device_q8(q8, scales)
                 )
-            np_chunks.append(pages)
-            dev_chunks.append(self.runner.upload_pages_device(pages))
+            else:
+                if payload.dtype != want_dtype:
+                    # The EXACT path's guarantee is byte-identical
+                    # numerics; silent casts would break it.
+                    raise ValueError(
+                        f"KV dtype mismatch: producer {payload.dtype} "
+                        f"vs consumer {want_dtype}"
+                    )
+                np_chunks.append(payload)
+                dev_chunks.append(self.runner.upload_pages_device(payload))
             nbytes += len(blob)
         return PulledBundle(
             pages=None, hashes=hashes[:n_full], nbytes=nbytes,

@@ -119,6 +119,7 @@ def make_engine_config(args, lora_adapters=None):
         kv_role=kv_cfg.get("kv_role"),
         kv_side_channel_port=int(kv_cfg.get("side_channel_port", 9600)),
         kv_transfer_port=int(kv_cfg.get("transfer_port", 9100)),
+        kv_transfer_dtype=str(kv_cfg.get("transfer_dtype", "auto")),
         kv_events_endpoint=args.kv_events_endpoint,
         offload=(
             OffloadConfig(
