@@ -208,6 +208,15 @@ class TPUConnector:
                 f"kv transfer_dtype {cfg.transfer_dtype!r} not supported "
                 "('auto' or 'int8')"
             )
+        if cfg.transfer_dtype == "int8" and runner.cfg.is_mla:
+            # The K|V midpoint half-split is wrong for MLA latent rows
+            # ([rank latent | rope] padded to 128 lanes): one shared amax
+            # would crush the smaller sub-block — refuse rather than
+            # silently degrade transferred-KV accuracy.
+            raise ValueError(
+                "kv transfer_dtype='int8' is not supported for MLA models "
+                "(latent rows need their own scale layout); use 'auto'"
+            )
         self.cfg = cfg
         self.runner = runner
         self.allocator = allocator
@@ -275,18 +284,14 @@ class TPUConnector:
         cp = max(1, self.cfg.chunk_pages)
         ids = list(req.block_ids[:n_full])
         n_chunks = -(-n_full // cp)
-        if self.cfg.transfer_dtype == "int8":
-            snaps = [
-                self.runner.snapshot_pages_device_q8(
-                    ids[j * cp : (j + 1) * cp], cp
-                )
-                for j in range(n_chunks)
-            ]
-        else:
-            snaps = [
-                self.runner.snapshot_pages_device(ids[j * cp : (j + 1) * cp], cp)
-                for j in range(n_chunks)
-            ]
+        snap_fn = (
+            self.runner.snapshot_pages_device_q8
+            if self.cfg.transfer_dtype == "int8"
+            else self.runner.snapshot_pages_device
+        )
+        snaps = [
+            snap_fn(ids[j * cp : (j + 1) * cp], cp) for j in range(n_chunks)
+        ]
         threading.Thread(
             target=self._stage_chunks, args=(key, snaps), daemon=True
         ).start()

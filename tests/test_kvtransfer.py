@@ -546,3 +546,22 @@ def test_pd_int8_transfer_page_accuracy():
     finally:
         producer.kv_connector.close()
         consumer.kv_connector.close()
+
+
+def test_pd_int8_transfer_rejects_mla():
+    """MLA latent rows don't fit the K|V half-split scale layout: int8
+    transfer must refuse at startup, not silently degrade accuracy."""
+    from llmd_tpu.config import EngineConfig
+
+    with pytest.raises(ValueError, match="MLA"):
+        LLMEngine(EngineConfig(
+            model=tiny_model_config(
+                kv_lora_rank=32, q_lora_rank=0, qk_nope_head_dim=16,
+                qk_rope_head_dim=8, v_head_dim=16,
+            ),
+            cache=CacheConfig(page_size=4, num_blocks=32, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=32),
+            kv_role="kv_producer",
+            kv_transfer_port=0,
+            kv_transfer_dtype="int8",
+        ))
