@@ -252,6 +252,13 @@ class ParallelConfig:
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     expert_parallel_size: int = 1  # folded over the same devices as tp*dp
+    # Fuse the q|k|v and gate|up projections into single matmuls when the
+    # layout allows (tp == 1, no LoRA, non-MLA): one activation
+    # quantization + one bigger MXU dot instead of three. Measured
+    # END-TO-END (back-to-back engine runs, llama-3.2-3b-class int8,
+    # B=128): 4113 -> 4280 tok/s (+4%). Lossless: per-output-channel int8
+    # scales concatenate exactly; bf16 concat is trivially exact.
+    fuse_projections: bool = True
     # MoE execution path: "grouped" (default) = tokens sorted by expert
     # feed Pallas/XLA grouped GEMMs so each expert multiplies only its
     # routed rows (the DeepGEMM role); "dense" = one-hot combine running

@@ -121,6 +121,32 @@ def _dequantize_rows_q8(
     return out.reshape(*lead, D2).astype(jnp.dtype(dtype_name))
 
 
+def _fuse_projection_tree(params: dict) -> dict:
+    """Pure tree transform behind ModelRunner._maybe_fuse (jitted there)."""
+
+    def fuse(d: dict, names: list[str], out_name: str) -> None:
+        if not all(n in d for n in names):
+            return
+        d[out_name] = jnp.concatenate([d[n] for n in names], axis=-1)
+        if all(f"{n}_scale" in d for n in names):
+            d[f"{out_name}_scale"] = jnp.concatenate(
+                [d[f"{n}_scale"] for n in names], axis=-1
+            )
+        for n in names:
+            d.pop(n, None)
+            d.pop(f"{n}_scale", None)
+
+    out = dict(params)
+    for key in ("layers", "dense_layers"):
+        if key not in out:
+            continue
+        d = dict(out[key])
+        fuse(d, ["wq", "wk", "wv"], "wqkv")
+        fuse(d, ["w_gate", "w_up"], "w_gu")
+        out[key] = d
+    return out
+
+
 @dataclass
 class StepResult:
     """Sampled tokens for each row; [B, K] (K=1 for single-shot calls)."""
@@ -149,6 +175,7 @@ class ModelRunner:
                 params = load_params(self.cfg, config.weights_path)
             else:
                 params = llama.init_params(self.cfg, jax.random.key(config.seed))
+        params = self._maybe_fuse(params)
         self.params = shard_params(params, mesh_ctx)
         self.kv_cache = self._alloc_kv()
         self._multihost = dist.is_multihost()
@@ -163,6 +190,33 @@ class ModelRunner:
         self._multi = self._build_multi()
 
     # ------------------------------------------------------------------ #
+
+    def _maybe_fuse(self, params: dict) -> dict:
+        """Fuse q|k|v and gate|up projections into single matmuls (one
+        activation quantization + one bigger MXU dot instead of three).
+
+        Lossless by construction: per-output-channel int8 scales (and
+        bf16 weights) concatenate exactly, so the fused dot equals the
+        separate dots bit-for-bit. Only when the layout allows: tp == 1
+        (the fused output axis cannot ride the per-projection TP shard),
+        no LoRA (adapters add to q/v, fine — but kept simple), non-MLA.
+
+        Runs as ONE jitted call with the unfused tree donated — eager
+        per-tensor concats would transiently double the projection
+        weights on device and fragment the arena (the same init-OOM
+        pattern the jitted quantize call avoids, models/llama.py).
+        """
+        cfg = self.cfg
+        if (
+            not self.config.parallel.fuse_projections
+            or self.ctx.tp > 1
+            or cfg.is_mla
+            or cfg.num_lora_adapters
+        ):
+            return params
+        return jax.jit(_fuse_projection_tree, donate_argnums=0)(
+            jax.tree.map(jnp.asarray, params)
+        )
 
     @functools.cached_property
     def kv_rep(self) -> int:

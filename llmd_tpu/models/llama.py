@@ -142,6 +142,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
 
 
 def _mlp(h: jax.Array, lp: dict) -> jax.Array:
+    if "w_gu" in lp:  # fused gate|up (runner._maybe_fuse; lossless)
+        gu = pdot(h, lp, "w_gu")
+        F = gu.shape[-1] // 2
+        return pdot(jax.nn.silu(gu[..., :F]) * gu[..., F:], lp, "w_down")
     gate = jax.nn.silu(pdot(h, lp, "w_gate"))
     return pdot(gate * pdot(h, lp, "w_up"), lp, "w_down")
 
@@ -249,9 +253,15 @@ def forward_hidden(
             )
             x = x + attn_out
         else:
-            q = pdot(h, lp, "wq")
-            k = pdot(h, lp, "wk")
-            v = pdot(h, lp, "wv")
+            if "wqkv" in lp:  # fused q|k|v (runner._maybe_fuse; lossless)
+                qkv = pdot(h, lp, "wqkv")
+                q = qkv[..., : Nq * D]
+                k = qkv[..., Nq * D : (Nq + K) * D]
+                v = qkv[..., (Nq + K) * D :]
+            else:
+                q = pdot(h, lp, "wq")
+                k = pdot(h, lp, "wk")
+                v = pdot(h, lp, "wv")
             if cfg.attention_bias:
                 q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
             if cfg.num_lora_adapters and inp.lora_ids is not None:

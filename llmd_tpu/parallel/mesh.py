@@ -93,6 +93,9 @@ PARAM_SPECS: dict[str, P] = {
     "input_norm": P(None, None),
     "post_norm": P(None, None),
     "wq": P(None, None, TP_AXIS),   # [L, H, Nq*D] head-sharded
+    # Fused projections (runner._maybe_fuse, tp == 1 only): replicated.
+    "wqkv": P(None, None, None),
+    "w_gu": P(None, None, None),
     "wk": P(None, None, TP_AXIS),
     "wv": P(None, None, TP_AXIS),
     "wo": P(None, TP_AXIS, None),   # [L, Nq*D, H] row-parallel -> psum

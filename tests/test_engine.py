@@ -322,3 +322,72 @@ def test_kv_rep_pd_transfer_interops_with_unsharded_producer(devices):
     finally:
         producer.kv_connector.close()
         consumer.kv_connector.close()
+
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("over", [
+    {},  # plain GQA
+    {"attention_bias": True, "qk_norm": True},  # Qwen-style extras
+    {"quantization": "int8"},  # int8 scales must concatenate losslessly
+])
+def test_fused_projections_match_unfused(over):
+    """fuse_projections is claimed lossless: greedy tokens with fusion on
+    must equal fusion off exactly, across bias/qk_norm/int8 variants; the
+    fused params must actually be fused (and only then)."""
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+
+    def gen(fuse):
+        eng = LLMEngine(EngineConfig(
+            model=tiny_model_config(num_heads=4, num_kv_heads=2, **over),
+            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=64),
+            parallel=ParallelConfig(tensor_parallel_size=1, fuse_projections=fuse),
+            offload=None,
+        ))
+        try:
+            fused_keys = "wqkv" in eng.runner.params["layers"]
+            assert fused_keys == fuse
+            sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+            return list(eng.generate([[1, 2, 3, 4, 5, 6]], sp).values())[0]
+        finally:
+            eng.close()
+
+    assert gen(True) == gen(False)
+
+
+def test_fused_projections_skip_guards(devices):
+    """tp > 1 / LoRA / MLA layouts must NOT fuse (the fused axis cannot
+    ride the per-projection TP shard; adapters and MLA keep their own
+    projection structure)."""
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine
+
+    cases = [
+        (dict(num_heads=4, num_kv_heads=2), dict(tensor_parallel_size=2)),
+        (dict(num_heads=4, num_kv_heads=2, num_lora_adapters=1),
+         dict(tensor_parallel_size=1)),
+        (dict(kv_lora_rank=32, q_lora_rank=0, qk_nope_head_dim=16,
+              qk_rope_head_dim=8, v_head_dim=16),
+         dict(tensor_parallel_size=1)),
+    ]
+    for model_over, par_over in cases:
+        eng = LLMEngine(EngineConfig(
+            model=tiny_model_config(**model_over),
+            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=64),
+            parallel=ParallelConfig(fuse_projections=True, **par_over),
+            offload=None,
+        ))
+        try:
+            assert "wqkv" not in eng.runner.params["layers"], (model_over, par_over)
+        finally:
+            eng.close()
