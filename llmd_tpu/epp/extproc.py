@@ -89,6 +89,9 @@ class ExtProcSession:
         self._held: list[tuple[bytes, bool]] = []
         self._set_headers: dict[str, str] = {}
         self._t_first_response: float | None = None
+        # SSE line reassembly across response_body chunks (a usage frame
+        # split over two ext-proc chunks must still be observed); bounded.
+        self._sse_tail = b""
 
     async def on_message(self, msg: pb.ProcessingRequest) -> list[bytes]:
         if msg.kind == "request_headers":
@@ -179,7 +182,7 @@ class ExtProcSession:
         if msg.kind == "response_body":
             if self.mode == "buffered":
                 return [pb.encode_common_response("response_body")]
-            self._observe_response_chunk(msg.body)
+            self._observe_response_chunk(msg.body, eos=msg.end_of_stream)
             # Stream the chunk straight back — response bodies are never
             # held (TTFT/ITL pass through untouched).
             return [pb.encode_streamed_body_response(
@@ -187,17 +190,30 @@ class ExtProcSession:
             )]
         return []
 
-    def _observe_response_chunk(self, chunk: bytes) -> None:
+    def _observe_response_chunk(self, chunk: bytes, eos: bool = False) -> None:
         """Sample streamed SSE frames for usage mid-stream (the reference
         samples usage/latency from streamed response bodies,
         request-handling.md:56-63): completion token counts yield a live
         LastTPOT for the latency-aware scorers — the same accounting the
         fused proxy derives at stream end (server.py)."""
-        if self.pod is None or b'"usage"' not in chunk:
+        if self.pod is None:
+            return
+        # Join with the held tail so a frame split across chunks parses
+        # once complete; the unterminated remainder carries over (bounded
+        # — a pathological never-newline stream can't grow it unbounded).
+        # At end-of-stream the tail is flushed as a final line: a last
+        # data frame without a terminating newline must still count.
+        buf = self._sse_tail + chunk
+        *lines, tail = buf.split(b"\n")
+        if eos and tail:
+            lines.append(tail)
+            tail = b""
+        self._sse_tail = tail[-8192:]
+        if b'"usage"' not in buf:
             return
         import json
 
-        for line in chunk.split(b"\n"):
+        for line in lines:
             if not line.startswith(b"data:") or b"[DONE]" in line:
                 continue
             try:

@@ -397,10 +397,21 @@ class TPUConnector:
                 f"chunk geometry mismatch: {n_full} pages / {cp} per chunk "
                 f"!= {n_chunks} chunks"
             )
-        deadline = time.monotonic() + min(self.cfg.lease_ms / 1e3, 20.0)
+        # Per-CHUNK deadline, reset on progress: a shared whole-bundle
+        # budget would let a large multi-chunk transfer over a slow link
+        # exhaust itself on later chunks and spuriously fall back to
+        # recompute even though the producer is healthy and advancing.
+        # Still bounded overall (2s/chunk of slack past the first wait) so
+        # a trickling producer can't hold the executor thread for
+        # n_chunks x 20s before the failure policy kicks in.
+        per_chunk_s = min(self.cfg.lease_ms / 1e3, 20.0)
+        hard_deadline = time.monotonic() + per_chunk_s + 2.0 * n_chunks
         np_chunks, dev_chunks, nbytes = [], [], 0
         for j in range(n_chunks):
-            blob = shipper_mod.pull_wait(host, port, chunk_key(key, j), deadline)
+            blob = shipper_mod.pull_wait(
+                host, port, chunk_key(key, j),
+                min(time.monotonic() + per_chunk_s, hard_deadline),
+            )
             decoded = unpack_pages_any(blob)
             payload = decoded[1]
             if payload.shape[1] != cp:

@@ -178,8 +178,12 @@ def forward_hidden(
     two independent half-batch chains. Half 1's attention carries no data
     dependency on half 0's MoE dispatch, so XLA's latency-hiding
     scheduler can overlap the EP all-to-all of one half with the other
-    half's attention compute. Numerics are exact (same values, split
-    batch); requires an even batch."""
+    half's attention compute. Half-batch EP calls get a doubled
+    capacity_factor so absolute per-expert capacity matches the full
+    batch; numerics are then exact unless EP capacity binds (a half's
+    routing demand is compared against full capacity separately, so DBO
+    can only drop FEWER tokens, never different ones below capacity).
+    Requires an even batch."""
     B, Q = inp.token_ids.shape
     D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     x = params["embed"][inp.token_ids]  # [B, Q, H]
@@ -198,13 +202,14 @@ def forward_hidden(
     use_dbo = bool(dbo) and B >= 2 and B % 2 == 0 and (B // 2) % _dp == 0
     half = B // 2
 
-    def _ffn(h2, lp, use_moe: bool):
+    def _ffn(h2, lp, use_moe: bool, cap_scale: float = 1.0):
         if use_moe:
             if moe_backend == "ep":
                 from llmd_tpu.parallel.moe_ep import moe_block_ep
 
                 return moe_block_ep(
-                    h2, lp, cfg, mesh, capacity_factor=ep_capacity_factor
+                    h2, lp, cfg, mesh,
+                    capacity_factor=ep_capacity_factor * cap_scale,
                 )
             if moe_backend == "grouped" and world_size == 1:
                 from llmd_tpu.models.moe import moe_block_grouped
@@ -218,12 +223,12 @@ def forward_hidden(
             return moe_block(h2, lp, cfg)
         return _mlp(h2, lp)
 
-    def _tail(x_sl, attn_sl, lp, use_moe):
+    def _tail(x_sl, attn_sl, lp, use_moe, cap_scale: float = 1.0):
         """Post-attention chain of one (micro)batch slice: residual +
         post-norm + FFN/MoE + residual."""
         x_sl = x_sl + attn_sl
         h2 = rms_norm(x_sl, lp["post_norm"], cfg.rms_norm_eps)
-        return x_sl + _ffn(h2, lp, use_moe)
+        return x_sl + _ffn(h2, lp, use_moe, cap_scale)
 
     def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
@@ -245,7 +250,7 @@ def forward_hidden(
                         inp.positions[sl], cfg,
                         world_size=world_size, mesh=mesh,
                     )
-                    outs.append(_tail(x[sl], attn_sl, lp, use_moe))
+                    outs.append(_tail(x[sl], attn_sl, lp, use_moe, 2.0))
                 return jnp.concatenate(outs, axis=0), cache
             attn_out, cache = mla_attention(
                 h, lp, cache, layer_idx, inp, cfg, cos, sin,
@@ -309,7 +314,9 @@ def forward_hidden(
                         world_size=world_size, mesh=mesh, window=window,
                         sinks=sinks,
                     )
-                    outs.append(_tail(x[sl], _project(attn_sl, half), lp, use_moe))
+                    outs.append(
+                        _tail(x[sl], _project(attn_sl, half), lp, use_moe, 2.0)
+                    )
                 return jnp.concatenate(outs, axis=0), cache
             attn = paged_attention_full(
                 q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,

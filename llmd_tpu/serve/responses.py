@@ -45,7 +45,8 @@ class ResponsesStore:
 
     def __init__(self, max_items: int = MAX_STORED) -> None:
         self.responses: collections.OrderedDict[str, dict] = collections.OrderedDict()
-        self.conversations: collections.OrderedDict[str, list] = collections.OrderedDict()
+        # cid -> {"items": [...], "created_at": int, "metadata": dict}
+        self.conversations: collections.OrderedDict[str, dict] = collections.OrderedDict()
         self.max_items = max_items
 
     def put_response(self, resp: dict, history: list[dict]) -> None:
@@ -62,14 +63,22 @@ class ResponsesStore:
 
     def new_conversation(self, metadata: dict | None) -> dict:
         cid = f"conv_{uuid.uuid4().hex}"
-        self.conversations[cid] = []
+        self.conversations[cid] = {
+            "items": [],
+            "created_at": int(time.time()),
+            "metadata": metadata or {},
+        }
         while len(self.conversations) > self.max_items:
             self.conversations.popitem(last=False)
+        return self.conversation_object(cid)
+
+    def conversation_object(self, cid: str) -> dict:
+        entry = self.conversations[cid]
         return {
             "id": cid,
             "object": "conversation",
-            "created_at": int(time.time()),
-            "metadata": metadata or {},
+            "created_at": entry["created_at"],
+            "metadata": entry["metadata"],
         }
 
 
@@ -153,28 +162,40 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
         except json.JSONDecodeError as e:
             return _err(400, f"invalid JSON: {e}")
 
-        messages: list[dict] = []
+        # ``context`` is the chainable conversation state (input/output
+        # items only). ``instructions`` are per-request and NOT carried
+        # over via previous_response_id (OpenAI Responses semantics): they
+        # join the prompt below but never the stored history.
+        context: list[dict] = []
         instructions = body.get("instructions")
-        if instructions:
-            messages.append({"role": "system", "content": instructions})
         conv_id = body.get("conversation")
         if isinstance(conv_id, dict):
             conv_id = conv_id.get("id")
-        if conv_id:
-            items = store.conversations.get(conv_id)
-            if items is None:
-                return _err(404, f"conversation {conv_id!r} not found")
-            messages.extend(items)
         prev = body.get("previous_response_id")
+        if conv_id and prev:
+            # Both sources would duplicate prior turns in the prompt;
+            # OpenAI rejects the combination the same way.
+            return _err(
+                400,
+                "previous_response_id and conversation are mutually exclusive",
+            )
+        if conv_id:
+            conv = store.conversations.get(conv_id)
+            if conv is None:
+                return _err(404, f"conversation {conv_id!r} not found")
+            context.extend(conv["items"])
         if prev:
             entry = store.get_response(prev)
             if entry is None:
                 return _err(404, f"previous response {prev!r} not found")
-            messages.extend(entry["history"])
+            context.extend(entry["history"])
         new_msgs = _input_to_messages(body.get("input"))
-        if not new_msgs and not messages:
+        if not new_msgs and not context:
             return _err(400, "input is required")
-        messages.extend(new_msgs)
+        context.extend(new_msgs)
+        messages = (
+            [{"role": "system", "content": instructions}] if instructions else []
+        ) + context
 
         from llmd_tpu.serve.api import Detokenizer, _chat_prompt_ids
 
@@ -185,6 +206,12 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
             )
         budget = max_len - len(prompt_ids)
         req_max = body.get("max_output_tokens")
+        if req_max is not None and (
+            not isinstance(req_max, int)
+            or isinstance(req_max, bool)
+            or req_max < 1
+        ):
+            return _err(400, "max_output_tokens must be a positive integer")
         max_tokens = min(req_max if req_max is not None else budget, budget)
         eos = getattr(tokenizer, "eos_token_id", None)
         from llmd_tpu.engine import SamplingParams
@@ -204,13 +231,13 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
             if body.get("store", True):
                 store.put_response(
                     resp_obj,
-                    messages + [{"role": "assistant", "content": text}],
+                    context + [{"role": "assistant", "content": text}],
                 )
             if conv_id is not None and conv_id in store.conversations:
                 # Append only THIS request's turns: prepended context from
                 # previous_response_id (or instructions) is per-request and
                 # must not leak into the conversation's stored items.
-                store.conversations[conv_id].extend(
+                store.conversations[conv_id]["items"].extend(
                     new_msgs + [{"role": "assistant", "content": text}]
                 )
 
@@ -305,7 +332,7 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
             body = {}
         conv = store.new_conversation(body.get("metadata"))
         for item in _input_to_messages(body.get("items")):
-            store.conversations[conv["id"]].append(item)
+            store.conversations[conv["id"]]["items"].append(item)
         return web.json_response(conv)
 
     async def get_conversation(request: web.Request) -> web.Response:
@@ -313,9 +340,7 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
         cid = request.match_info["cid"]
         if cid not in store.conversations:
             return _err(404, "conversation not found")
-        return web.json_response(
-            {"id": cid, "object": "conversation", "created_at": 0}
-        )
+        return web.json_response(store.conversation_object(cid))
 
     async def add_items(request: web.Request) -> web.Response:
         store: ResponsesStore = request.app[STORE_KEY]
@@ -327,11 +352,12 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
         except json.JSONDecodeError as e:
             return _err(400, f"invalid JSON: {e}")
         items = _input_to_messages(body.get("items"))
-        store.conversations[cid].extend(items)
+        store.conversations[cid]["items"].extend(items)
         return web.json_response({
             "object": "list",
             "data": [
-                {"type": "message", **m} for m in store.conversations[cid]
+                {"type": "message", **m}
+                for m in store.conversations[cid]["items"]
             ],
         })
 
@@ -343,7 +369,8 @@ def make_handlers(engine_key, tok_key, model_key, maxlen_key):
         return web.json_response({
             "object": "list",
             "data": [
-                {"type": "message", **m} for m in store.conversations[cid]
+                {"type": "message", **m}
+                for m in store.conversations[cid]["items"]
             ],
         })
 
