@@ -73,25 +73,20 @@ class LLMEngine:
         self.config = config
         import jax
 
-        if jax.process_count() > 1 and (
-            (config.offload is not None and config.offload.enabled)
-            or config.kv_role
-        ):
-            # Fail at startup, not mid-request: these features stage HBM
-            # pages through ONE host's process-local device path, which a
-            # leader-only dispatch over a multi-host mesh cannot do (the
-            # cross-slice KV store is the multi-host KV plane; see
-            # deploy/guides/wide-ep-lws/README.md scope notes).
-            raise NotImplementedError(
-                "kv_role / tiered offload are not supported in multi-host "
-                "mode; use the cross-slice KV store for the KV plane"
-            )
+        # Multi-host: staging programs (page gather/scatter) are lockstep-
+        # broadcast to every process by the runner, so P/D transfer and
+        # tiered offload compose with a multi-process mesh — the
+        # reference's flagship 16P+16D wide-EP topology does exactly this
+        # (wide-ep-lws/README.md + multi-node.md). The network-facing
+        # halves (shipper server, host cache, store client) live on the
+        # LEADER only; followers just mirror device programs.
+        follower = jax.process_count() > 1 and jax.process_index() != 0
         self.ctx = mesh_ctx or build_mesh(config.parallel)
         # Tiered offload wraps the event sink (device evictions of host-held
         # pages downgrade to cpu-tier stores instead of removals).
         self._host_cache = None
         self._kvstore_client = None
-        if config.offload is not None and config.offload.enabled:
+        if config.offload is not None and config.offload.enabled and not follower:
             from llmd_tpu.kvtransfer.offload import HostKVCache, TieredEventSink
 
             if config.offload.store_master_url:
@@ -139,7 +134,7 @@ class LLMEngine:
         # P/D disaggregation: optional KV-transfer connector (reference
         # TPUConnector roles, pd tpu patch-decode.yaml:17-20).
         self.kv_connector = None
-        if config.kv_role:
+        if config.kv_role and not follower:
             from llmd_tpu.kvtransfer.connector import KVTransferConfig, TPUConnector
 
             kv_cfg = KVTransferConfig(

@@ -48,8 +48,14 @@ from llmd_tpu.parallel import distributed as dist
 from llmd_tpu.parallel.mesh import MeshContext, kv_cache_spec, shard_params
 
 # Multi-host dispatch opcodes (fixed-size i32 header broadcast leader ->
-# followers before each step's array payload).
+# followers before each step's array payload). KV_GATHER/KV_SCATTER are
+# the staging legs of P/D transfer + tiered offload over a multi-process
+# mesh: every process dispatches the same SPMD gather/scatter program
+# (the gather all-gathers the tp-sharded head axis to a replicated
+# bundle the leader can stage; the scatter writes broadcast values into
+# each process's own pool shards).
 _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
+_OP_KV_GATHER, _OP_KV_SCATTER = 3, 4
 
 
 def _buckets(limit: int, start: int = 8) -> tuple[int, ...]:
@@ -66,6 +72,15 @@ def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+def _padded_ids(page_ids, pad_to: int) -> np.ndarray:
+    """[n] i32 ids padded to ``pad_to`` by repeating the last id (a
+    duplicate gather/scatter of the same page is idempotent)."""
+    ids = np.asarray(page_ids, np.int32)
+    if pad_to > len(ids):
+        ids = np.concatenate([ids, np.full(pad_to - len(ids), ids[-1], np.int32)])
+    return ids
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -412,6 +427,79 @@ class ModelRunner:
         return multi
 
     # ------------------------------------------------------------------ #
+    # multi-host KV staging programs (lockstep-dispatched on all procs)
+
+    @functools.cached_property
+    def _replicated_gather(self):
+        """Gather pages -> CANONICAL heads, output fully replicated: the
+        all-gather of the tp-sharded head axis rides ICI, after which the
+        leader's host download is a local replica read."""
+        rep = self.kv_rep
+
+        def gather(kv, ids):
+            out = kv[:, ids]
+            if rep > 1:
+                out = out[:, :, ::rep]
+            return out
+
+        return jax.jit(gather, out_shardings=self.ctx.replicated)
+
+    @functools.cached_property
+    def _replicated_gather_q8(self):
+        rep = self.kv_rep
+
+        def gather(kv, ids):
+            out = kv[:, ids]
+            if rep > 1:
+                out = out[:, :, ::rep]
+            return _quantize_rows_q8(out)
+
+        return jax.jit(
+            gather, out_shardings=(self.ctx.replicated, self.ctx.replicated)
+        )
+
+    @functools.cached_property
+    def _scatter_canonical(self):
+        """Scatter canonical-head bundles into the pool (head expansion
+        on device); every process writes its own shards of the result."""
+        rep = self.kv_rep
+
+        def scatter(kv, ids, vals):
+            if rep > 1:
+                vals = jnp.repeat(vals, rep, axis=2)
+            return kv.at[:, ids].set(vals)
+
+        return jax.jit(scatter, donate_argnums=(0,))
+
+    def _exec_kv_gather(self, arrays: dict, q8: bool):
+        fn = self._replicated_gather_q8 if q8 else self._replicated_gather
+        return fn(self.kv_cache, jnp.asarray(arrays["ids"]))
+
+    def _exec_kv_scatter(self, arrays: dict, n: int) -> None:
+        Kc = self.kv_cache.shape[2] // self.kv_rep
+        shape = (
+            self.cfg.num_layers, n, Kc, self.page, self.kv_cache.shape[4]
+        )
+        vals = np.frombuffer(
+            np.ascontiguousarray(arrays["vals_u8"]).data,
+            dtype=self.kv_cache.dtype,
+        ).reshape(shape)
+        self.kv_cache = self._scatter_canonical(
+            self.kv_cache, jnp.asarray(arrays["ids"]), jnp.asarray(vals)
+        )
+
+    def _kv_gather_lockstep(self, ids: np.ndarray, q8: bool):
+        """Leader leg of a multi-host page gather: broadcast the op so
+        every process dispatches the same program; return the (replicated)
+        result. Engine/leader thread only — the broadcast stream is
+        totally ordered by the single engine thread."""
+        assert dist.is_leader(), "KV staging ops originate on the leader"
+        arrays = self._sync(
+            _OP_KV_GATHER, len(ids), int(q8), False, {"ids": ids}
+        )
+        return self._exec_kv_gather(arrays, q8)
+
+    # ------------------------------------------------------------------ #
     # host-side input prep
 
     def _sampling_arrays(self, seqs: list[ScheduledSeq], B: int, K: int = 1):
@@ -446,10 +534,10 @@ class ModelRunner:
         span follower-owned devices would deadlock the whole group."""
         if self._multihost:
             raise NotImplementedError(
-                f"{what} is not supported in multi-host mode (only the "
-                "prefill/decode serving steps are broadcast to follower "
-                "processes; see deploy/guides/wide-ep-lws/README.md scope "
-                "notes)"
+                f"{what} is not supported in multi-host mode (the "
+                "prefill/decode serving steps and the KV staging ops are "
+                "broadcast to follower processes; see deploy/guides/"
+                "wide-ep-lws/README.md scope notes)"
             )
 
     def _page_table(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
@@ -471,7 +559,20 @@ class ModelRunner:
 
     def _payload_spec(self, op: int, B: int, QK: int):
         """(name, shape, dtype) tuple layout for one op's array payload —
-        the contract both sides of the broadcast derive independently."""
+        the contract both sides of the broadcast derive independently.
+
+        KV ops reuse the header slots: B carries the page count and QK the
+        q8 flag (gather). Scatter payload geometry derives from the pool
+        config both sides share."""
+        if op == _OP_KV_GATHER:
+            return [("ids", (B,), np.int32)]
+        if op == _OP_KV_SCATTER:
+            Kc = self.kv_cache.shape[2] // self.kv_rep
+            nbytes = (
+                self.cfg.num_layers * B * Kc * self.page
+                * self.kv_cache.shape[4] * self.kv_cache.dtype.itemsize
+            )
+            return [("ids", (B,), np.int32), ("vals_u8", (nbytes,), np.uint8)]
         mp = self.max_pages
         if op == _OP_PREFILL:
             spec = [
@@ -538,6 +639,13 @@ class ModelRunner:
             arrays = {name: arr for (name, _, _), arr in zip(spec, payload)}
             if op == _OP_PREFILL:
                 self._exec_prefill(arrays, bool(greedy))
+            elif op == _OP_KV_GATHER:
+                # Participate in the SPMD gather (the all-gather collective
+                # needs every process); the replicated result is dropped —
+                # only the leader stages it to the network.
+                self._exec_kv_gather(arrays, bool(QK))
+            elif op == _OP_KV_SCATTER:
+                self._exec_kv_scatter(arrays, B)
             else:
                 self._exec_decode(arrays, QK, bool(greedy))
 
@@ -602,15 +710,15 @@ class ModelRunner:
         sequences the enqueued gather before any later pool write. The
         blocking host download happens later via ``download_pages`` on a
         staging thread, off the engine thread and off the TTFT path.
+
+        Multi-host: the gather is lockstep-broadcast so every process
+        dispatches the same SPMD program; the output is fully replicated
+        (head-axis all-gather over ICI), so the later download is a local
+        replica read on the leader.
         """
-        # Fail HERE (engine thread, loudly), not on the staging thread
-        # where the consumer would silently burn its pull-wait deadline.
-        self._require_single_host("snapshot_pages_device (P/D staging)")
-        ids = np.asarray(page_ids, np.int32)
-        if pad_to > len(ids):
-            ids = np.concatenate(
-                [ids, np.full(pad_to - len(ids), ids[-1], np.int32)]
-            )
+        ids = _padded_ids(page_ids, pad_to)
+        if self._multihost:
+            return self._kv_gather_lockstep(ids, q8=False)
         out = _gather_kv(self.kv_cache, jnp.asarray(ids))
         if self.kv_rep > 1:
             # Canonical transfer format keeps the ORIGINAL heads (peers
@@ -628,11 +736,20 @@ class ModelRunner:
         [L, pad_to, K, page, 2] f16 (separate K/V half scales). Opt-in
         and lossy (~0.4% per-half rel-err); the default transfer dtype
         stays byte-exact."""
+        if self._multihost:
+            return self._kv_gather_lockstep(
+                _padded_ids(page_ids, pad_to), q8=True
+            )
         return _quantize_rows_q8(self.snapshot_pages_device(page_ids, pad_to))
 
     @staticmethod
     def download_pages(snapshot: jax.Array) -> np.ndarray:
-        """Blocking HBM -> host download of a snapshot (staging thread)."""
+        """Blocking HBM -> host download of a snapshot (staging thread).
+
+        Multi-host snapshots are fully replicated global arrays: the read
+        is a local replica fetch (no collective, safe off-thread)."""
+        if isinstance(snapshot, jax.Array) and not snapshot.is_fully_addressable:
+            return np.ascontiguousarray(snapshot.addressable_shards[0].data)
         return np.ascontiguousarray(jax.device_get(snapshot))
 
     def upload_pages_device(self, pages: np.ndarray) -> jax.Array:
@@ -668,12 +785,12 @@ class ModelRunner:
         Page count is padded to a bucket (ids repeat the last page) so XLA
         compiles one gather per bucket, not per transfer size.
         """
-        self._require_single_host("gather_pages (P/D HBM staging)")
         n = len(page_ids)
         bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
-        ids = np.asarray(page_ids, np.int32)
-        if bucket > n:
-            ids = np.concatenate([ids, np.full(bucket - n, ids[-1], np.int32)])
+        ids = _padded_ids(page_ids, bucket)
+        if self._multihost:
+            snap = self._kv_gather_lockstep(ids, q8=False)
+            return np.ascontiguousarray(self.download_pages(snap)[:, :n])
         out = np.asarray(jax.device_get(_gather_kv(self.kv_cache, jnp.asarray(ids))))
         out = out[:, :n]
         if self.kv_rep > 1:
@@ -693,11 +810,6 @@ class ModelRunner:
         n = len(page_ids)
         if n == 0:
             return
-        self._require_single_host("scatter_pages (P/D HBM staging)")
-        if self.kv_rep > 1:
-            # Expand canonical [.., K, ..] bundles to the local replicated
-            # head layout.
-            pages = np.repeat(pages, self.kv_rep, axis=2)
         bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
         ids = np.asarray(page_ids, np.int32)
         if bucket > n:
@@ -705,6 +817,23 @@ class ModelRunner:
             pages = np.concatenate(
                 [pages, np.repeat(pages[:, -1:], bucket - n, axis=1)], axis=1
             )
+        if self._multihost:
+            # Lockstep scatter: canonical-head values broadcast to every
+            # process (one collective), head expansion on device.
+            assert dist.is_leader(), "KV staging ops originate on the leader"
+            vals = np.ascontiguousarray(
+                np.asarray(pages).astype(self.kv_cache.dtype, copy=False)
+            )
+            arrays = self._sync(
+                _OP_KV_SCATTER, bucket, 0, False,
+                {"ids": ids, "vals_u8": vals.view(np.uint8).reshape(-1)},
+            )
+            self._exec_kv_scatter(arrays, bucket)
+            return
+        if self.kv_rep > 1:
+            # Expand canonical [.., K, ..] bundles to the local replicated
+            # head layout.
+            pages = np.repeat(pages, self.kv_rep, axis=2)
         vals = jnp.asarray(pages, dtype=self.kv_cache.dtype)
         self.kv_cache = _scatter_kv(self.kv_cache, jnp.asarray(ids), vals)
 

@@ -397,6 +397,11 @@ class TPUConnector:
                 f"chunk geometry mismatch: {n_full} pages / {cp} per chunk "
                 f"!= {n_chunks} chunks"
             )
+        # Multi-host consumer: the fetch executor thread must NOT touch
+        # device state (uploads to process-local scratch cannot feed the
+        # lockstep global-mesh scatter) — keep host chunks only; the
+        # engine thread's apply broadcasts one canonical scatter.
+        pipelined = not getattr(self.runner, "_multihost", False)
         # Per-CHUNK deadline, reset on progress: a shared whole-bundle
         # budget would let a large multi-chunk transfer over a slow link
         # exhaust itself on later chunks and spuriously fall back to
@@ -424,9 +429,10 @@ class TPUConnector:
                 # (heterogeneous-pool pairings are fine).
                 _, q8, scales, _orig = decoded
                 np_chunks.append((q8, scales))
-                dev_chunks.append(
-                    self.runner.upload_pages_device_q8(q8, scales)
-                )
+                if pipelined:
+                    dev_chunks.append(
+                        self.runner.upload_pages_device_q8(q8, scales)
+                    )
             else:
                 if payload.dtype != want_dtype:
                     # The EXACT path's guarantee is byte-identical
@@ -436,7 +442,8 @@ class TPUConnector:
                         f"vs consumer {want_dtype}"
                     )
                 np_chunks.append(payload)
-                dev_chunks.append(self.runner.upload_pages_device(payload))
+                if pipelined:
+                    dev_chunks.append(self.runner.upload_pages_device(payload))
             nbytes += len(blob)
         return PulledBundle(
             pages=None, hashes=hashes[:n_full], nbytes=nbytes,

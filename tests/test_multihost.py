@@ -134,3 +134,361 @@ def test_multihost_engine_matches_single_process(quant):
     multi = _run_multihost(quant)
     single = _single_process_reference(quant)
     assert multi == single, (multi, single)
+
+
+# --------------------------------------------------------------------- #
+# Multi-host P/D: a producer engine AND a consumer engine, EACH spanning
+# a 2-process jax.distributed world (4 subprocesses total). KV staging is
+# lockstep-broadcast (runner._OP_KV_GATHER/_OP_KV_SCATTER) so the
+# transfer composes with the multi-process mesh — the reference's
+# flagship multi-node P/D + wide-EP topology
+# (guides/wide-ep-lws/modelserver/gpu/vllm/base/decode.yaml:105-128).
+
+_PD_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+    from llmd_tpu.parallel import distributed as dist
+
+    role, pid, nproc, port, tmpdir, transfer_dtype = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5], sys.argv[6],
+    )
+    dist.maybe_initialize(
+        coordinator=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc
+
+    PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7]  # 3 full pages @4
+
+    def make_cfg(kv_role):
+        return EngineConfig(
+            model=tiny_model_config(num_kv_heads=4, num_heads=8),
+            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+            ),
+            parallel=ParallelConfig(
+                tensor_parallel_size=4, data_parallel_size=1
+            ),
+            kv_role=kv_role,
+            kv_transfer_port=0,
+            kv_transfer_dtype=transfer_dtype,
+            offload=None,
+        )
+
+    params_file = os.path.join(tmpdir, "params.json")
+    done_file = os.path.join(tmpdir, "done")
+
+    if role == "producer":
+        engine = LLMEngine(make_cfg("kv_producer"))
+        if not dist.is_leader():
+            engine.runner.follower_loop()
+            sys.exit(0)
+        engine.add_request(
+            PROMPT,
+            SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        exported = None
+        while engine.has_work():
+            for out in engine.step():
+                if out.kv_transfer_params:
+                    exported = out.kv_transfer_params
+        assert exported, "producer did not export KV"
+        tmp = params_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(exported, f)
+        os.rename(tmp, params_file)
+        deadline = time.monotonic() + 120
+        while not os.path.exists(done_file):
+            if time.monotonic() > deadline:
+                raise RuntimeError("consumer never finished")
+            time.sleep(0.1)
+        engine.close()
+        print("RESULT producer-ok")
+        sys.exit(0)
+
+    # consumer world: reference run first (local prefill), then import.
+    ref = LLMEngine(make_cfg(None))
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    if dist.is_leader():
+        ref_out = list(ref.generate([PROMPT], sp).values())[0]
+        ref.close()
+    else:
+        ref.runner.follower_loop()
+        ref_out = None
+    eng = LLMEngine(make_cfg("kv_consumer"))
+    if not dist.is_leader():
+        eng.runner.follower_loop()
+        sys.exit(0)
+    deadline = time.monotonic() + 120
+    while not os.path.exists(params_file):
+        if time.monotonic() > deadline:
+            raise RuntimeError("producer never exported")
+        time.sleep(0.1)
+    with open(params_file) as f:
+        params = json.load(f)
+    eng.add_request(PROMPT, sp, kv_transfer_params=params)
+    toks = []
+    while eng.has_work():
+        for o in eng.step():
+            toks.extend(o.new_token_ids)
+    assert eng.kv_connector.imported_requests == 1, eng.kv_connector.stats()
+    assert eng.kv_connector.import_failures == 0, eng.kv_connector.stats()
+    assert eng.kv_connector.imported_bytes > 0
+    with open(done_file, "w") as f:
+        f.write("ok")
+    eng.close()
+    assert toks == ref_out, (toks, ref_out)
+    print("RESULT " + json.dumps(toks))
+""")
+
+
+def _spawn_world(script, role, nproc, per_proc_devices, argv_extra):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(nproc):
+        import os
+
+        env = dict(os.environ)
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            flags
+            + [f"--xla_force_host_platform_device_count={per_proc_devices}"]
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("LLMD_PALLAS", "interpret")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script, role, str(pid), str(nproc),
+             str(port), *argv_extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        ))
+    return procs
+
+
+@pytest.mark.parametrize("transfer_dtype", ["auto", "int8"])
+def test_multihost_pd_transfer(tmp_path, transfer_dtype):
+    """Producer and consumer engines, each a 2-process world (tp=4 over
+    4 devices spanning the processes): decode consumes transferred KV
+    with token parity against a local-prefill reference run."""
+    producers = _spawn_world(
+        _PD_WORKER, "producer", 2, 2, [str(tmp_path), transfer_dtype]
+    )
+    consumers = _spawn_world(
+        _PD_WORKER, "consumer", 2, 2, [str(tmp_path), transfer_dtype]
+    )
+    outs = {}
+    for name, procs in (("producer", producers), ("consumer", consumers)):
+        for pid, p in enumerate(procs):
+            out, _ = p.communicate(timeout=600)
+            outs[(name, pid)] = out
+    for (name, pid), out in outs.items():
+        p = (producers if name == "producer" else consumers)[pid]
+        assert p.returncode == 0, f"{name}[{pid}] rc={p.returncode}:\n{out[-4000:]}"
+    assert any(
+        ln.startswith("RESULT [") for ln in outs[("consumer", 0)].splitlines()
+    ), outs[("consumer", 0)][-2000:]
+
+
+_OFFLOAD_WORKER = textwrap.dedent("""
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, OffloadConfig, ParallelConfig,
+        SchedulerConfig, tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+    from llmd_tpu.parallel import distributed as dist
+
+    # argv: role(ignored) pid nproc port
+    pid, nproc, port = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+    dist.maybe_initialize(
+        coordinator=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+    )
+    cfg = EngineConfig(
+        model=tiny_model_config(num_kv_heads=4, num_heads=8),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=4, data_parallel_size=1),
+        offload=OffloadConfig(enabled=True, cpu_chunks=64),
+    )
+    engine = LLMEngine(cfg)
+    if not dist.is_leader():
+        engine.runner.follower_loop()
+        sys.exit(0)
+    PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    first = list(engine.generate([PROMPT], sp).values())[0]
+    # Drop the device prefix cache; the host tier keeps the pages.
+    engine.allocator.clear()
+    second = list(engine.generate([PROMPT], sp).values())[0]
+    assert engine.stats.offload_restores > 0, engine.stats
+    assert first == second, (first, second)
+    engine.close()
+    print("RESULT " + json.dumps(first))
+""")
+
+
+# --------------------------------------------------------------------- #
+# Serving stack above a multi-host engine: the leader serves the OpenAI
+# HTTP API (AsyncEngine on its engine thread) AND an EPP router routes to
+# it, while the follower mirrors device dispatches — the piece between
+# runner-parity and the single-host E2E tests.
+
+_SERVE_WORKER = textwrap.dedent("""
+    import asyncio, json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.parallel import distributed as dist
+
+    # argv: role(ignored) pid nproc port
+    pid, nproc, port = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+    dist.maybe_initialize(
+        coordinator=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+    )
+    cfg = EngineConfig(
+        model=tiny_model_config(num_kv_heads=4, num_heads=8, vocab_size=512),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=4, data_parallel_size=2),
+        offload=None,
+    )
+    engine = LLMEngine(cfg)
+    if not dist.is_leader():
+        engine.runner.follower_loop()
+        sys.exit(0)
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from llmd_tpu.epp.config import (
+            DEFAULT_CONFIG, build_flow_control, build_scheduler,
+        )
+        from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+        from llmd_tpu.epp.server import Router
+        from llmd_tpu.epp.types import Endpoint
+        from llmd_tpu.serve.api import build_app
+        from llmd_tpu.serve.async_engine import AsyncEngine
+        from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+        srv = TestServer(
+            build_app(AsyncEngine(engine), ByteTokenizer(), "tiny", 128)
+        )
+        await srv.start_server()
+        store = EndpointStore()
+        store.upsert(Endpoint(
+            address=f"{srv.host}:{srv.port}",
+            labels={"llm-d.ai/engine-type": "llmd"},
+        ))
+        router = Router(
+            store=store,
+            scheduler=build_scheduler(DEFAULT_CONFIG),
+            flow_control=build_flow_control(DEFAULT_CONFIG),
+            collector=MetricsCollector(store, interval_s=0.2),
+        )
+        rc = TestClient(TestServer(router.build_app()))
+        await rc.start_server()
+        r = await rc.post("/v1/completions", json={
+            "prompt": "multihost stack", "max_tokens": 5, "temperature": 0.0,
+        })
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        assert "x-llm-d-endpoint" in r.headers
+        await rc.close()
+        await srv.close()
+        return data["choices"][0]["text"]
+
+    text = asyncio.run(main())
+    engine.close()
+    print("RESULT " + json.dumps(text))
+""")
+
+
+def test_multihost_serving_stack():
+    """OpenAI API + EPP router served off a 2-process engine: tokens come
+    out through the full stack and match the single-process stack."""
+    procs = _spawn_world(_SERVE_WORKER, "serve", 2, 4, [])
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out[-4000:]}"
+    lines = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
+    assert lines, outs[0][-2000:]
+    multi_text = json.loads(lines[0][len("RESULT "):])
+
+    # Single-process reference through the same HTTP stack.
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    cfg = EngineConfig(
+        model=tiny_model_config(num_kv_heads=4, num_heads=8, vocab_size=512),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=4, data_parallel_size=2),
+        offload=None,
+    )
+
+    async def single():
+        srv = TestClient(TestServer(
+            build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 128)
+        ))
+        await srv.start_server()
+        r = await srv.post("/v1/completions", json={
+            "prompt": "multihost stack", "max_tokens": 5, "temperature": 0.0,
+        })
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        await srv.close()
+        return data["choices"][0]["text"]
+
+    single_text = asyncio.run(single())
+    assert multi_text == single_text, (multi_text, single_text)
+
+
+def test_multihost_tiered_offload():
+    """Tiered offload over a 2-process mesh: pages staged HBM->host via
+    the lockstep gather, restored host->HBM via the lockstep scatter,
+    with decode-token parity between computed and restored KV."""
+    procs = _spawn_world(_OFFLOAD_WORKER, "offload", 2, 2, [])
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out[-4000:]}"
+    assert any(ln.startswith("RESULT [") for ln in outs[0].splitlines()), (
+        outs[0][-2000:]
+    )
