@@ -211,10 +211,19 @@ class CacheConfig:
 
     page_size: int = 16
     num_blocks: int = 512
+    # "bfloat16" / "float32", or "int8" for the quantized pool (per-row
+    # symmetric int8 data + f16 K/V-half scales, ops/quant_kv.py): HALF
+    # the KV bytes per page — double the pages per HBM byte, half the
+    # decode-attention read traffic. The reference's flagship path runs
+    # a quantized cache the same way (FP8 KV, Dockerfile.cuda:69-70).
     dtype: str = "bfloat16"
     # Fraction of free HBM to use when num_blocks is derived automatically.
     hbm_utilization: float = 0.9
     enable_prefix_caching: bool = True
+
+    @property
+    def quantized(self) -> bool:
+        return self.dtype == "int8"
 
     def max_pages_per_seq(self, max_model_len: int) -> int:
         return math.ceil(max_model_len / self.page_size)

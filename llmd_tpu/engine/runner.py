@@ -83,29 +83,6 @@ def _padded_ids(page_ids, pad_to: int) -> np.ndarray:
     return ids
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _scatter_kv(kv_cache: jax.Array, page_ids: jax.Array, vals: jax.Array) -> jax.Array:
-    """Write page bundles into the pool (consumer leg of a KV transfer)."""
-    return kv_cache.at[:, page_ids].set(vals)
-
-
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("rep",))
-def _scatter_kv_rep(
-    kv_cache: jax.Array, page_ids: jax.Array, vals: jax.Array, rep: int = 1
-) -> jax.Array:
-    """Scatter canonical-head bundles already ON DEVICE (pipelined KV
-    import): the kv_rep head expansion happens device-side."""
-    if rep > 1:
-        vals = jnp.repeat(vals, rep, axis=2)
-    return kv_cache.at[:, page_ids].set(vals)
-
-
-@jax.jit
-def _gather_kv(kv_cache: jax.Array, page_ids: jax.Array) -> jax.Array:
-    """Read page bundles from the pool (producer leg of a KV transfer)."""
-    return kv_cache[:, page_ids]
-
-
 @jax.jit
 def _quantize_rows_q8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Symmetric int8 with SEPARATE scales for the K and V halves of each
@@ -254,7 +231,7 @@ class ModelRunner:
             return tp // K
         return 1
 
-    def _alloc_kv(self) -> jax.Array:
+    def _alloc_kv(self):
         c = self.config.cache
         shape = (
             self.cfg.num_layers,
@@ -263,6 +240,14 @@ class ModelRunner:
             c.page_size,
             self.cfg.kv_cache_entry_dim,
         )
+        if c.quantized and self.cfg.is_mla:
+            # Latent rows ([rank | rope] padded to lanes) need their own
+            # scale layout; the K|V midpoint split is wrong for them —
+            # refuse rather than silently degrade accuracy (same policy
+            # as the int8 transfer encoding).
+            raise ValueError(
+                "kv cache dtype 'int8' is not supported for MLA models"
+            )
         if self.cfg.is_mla:
             # The latent pool replicates across tp BY DESIGN: rows are a
             # few hundred bytes and every head reads the same latent —
@@ -271,6 +256,28 @@ class ModelRunner:
         else:
             spec = kv_cache_spec(shape[2], self.ctx.tp)
         sharding = self.ctx.sharding(*spec)
+        if c.quantized:
+            # Int8 pool: (data i8, per-row K/V-half scales f32 in the
+            # PLANE layout [L, K, 2, P, page]) — see ops/quant_kv.py for
+            # the layout contract. Scales shard on the head axis (axis 1
+            # of the plane), mirroring the data pool's head sharding.
+            sshape = (shape[0], shape[2], 2, shape[1], shape[3])
+            sspec = jax.sharding.PartitionSpec(
+                None, spec[2], None, None, None
+            )
+            ssharding = self.ctx.sharding(*sspec)
+            if dist.is_multihost():
+                return jax.jit(
+                    lambda: (
+                        jnp.zeros(shape, jnp.int8),
+                        jnp.ones(sshape, jnp.float32),
+                    ),
+                    out_shardings=(sharding, ssharding),
+                )()
+            return (
+                jnp.zeros(shape, jnp.int8, device=sharding),
+                jnp.ones(sshape, jnp.float32, device=ssharding),
+            )
         if dist.is_multihost():
             # Global pool spanning processes: allocate via a jitted zeros
             # so no host ever materializes (or addresses) the full array.
@@ -280,8 +287,32 @@ class ModelRunner:
             )()
         return jnp.zeros(shape, jnp.dtype(c.dtype), device=sharding)
 
+    @property
+    def kv_quantized(self) -> bool:
+        return isinstance(self.kv_cache, tuple)
+
+    @property
+    def _kv_data(self) -> jax.Array:
+        return self.kv_cache[0] if self.kv_quantized else self.kv_cache
+
+    @property
+    def staging_dtype(self) -> np.dtype:
+        """Canonical dtype of dequantized staging bundles (transfer wire
+        'exact' form, offload host pages): the model compute dtype for
+        int8 pools, the pool dtype otherwise."""
+        if self.kv_quantized:
+            return np.dtype(jnp.dtype(self.cfg.dtype))
+        return np.dtype(self.kv_cache.dtype)
+
+    @property
+    def staging_dtype_name(self) -> str:
+        return self.staging_dtype.name
+
     def kv_bytes(self) -> int:
-        return self.kv_cache.size * self.kv_cache.dtype.itemsize
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self.kv_cache)
+        )
 
     def set_lora_weights(self, lora_id: int, weights: dict) -> None:
         """Install adapter weights into slot ``lora_id`` (1-based).
@@ -433,10 +464,22 @@ class ModelRunner:
     def _replicated_gather(self):
         """Gather pages -> CANONICAL heads, output fully replicated: the
         all-gather of the tp-sharded head axis rides ICI, after which the
-        leader's host download is a local replica read."""
+        leader's host download is a local replica read. Int8 pools
+        dequantize in-program to the staging dtype."""
         rep = self.kv_rep
+        dt = jnp.dtype(self.staging_dtype) if self.kv_quantized else None
 
         def gather(kv, ids):
+            if isinstance(kv, tuple):
+                from llmd_tpu.ops.quant_kv import (
+                    bundle_from_plane, dequantize_pages,
+                )
+
+                d = kv[0][:, ids]
+                s = bundle_from_plane(kv[1][:, :, :, ids])
+                if rep > 1:
+                    d, s = d[:, :, ::rep], s[:, :, ::rep]
+                return dequantize_pages(d, s, dt)
             out = kv[:, ids]
             if rep > 1:
                 out = out[:, :, ::rep]
@@ -446,28 +489,76 @@ class ModelRunner:
 
     @functools.cached_property
     def _replicated_gather_q8(self):
+        """Q8-wire gather: float pools quantize in-program; int8 pools
+        ship their bytes directly (lossless wrt the pool, half the
+        staging bytes, zero quantize work)."""
         rep = self.kv_rep
 
         def gather(kv, ids):
+            if isinstance(kv, tuple):
+                from llmd_tpu.ops.quant_kv import (
+                    bundle_from_plane, pool_scales_to_wire,
+                )
+
+                d = kv[0][:, ids]
+                s = bundle_from_plane(kv[1][:, :, :, ids])
+                if rep > 1:
+                    d, s = d[:, :, ::rep], s[:, :, ::rep]
+                # Pool scales are f32 ON the f16 grid — the wire's f16
+                # form is a lossless cast.
+                return d, pool_scales_to_wire(s).astype(jnp.float16)
             out = kv[:, ids]
             if rep > 1:
                 out = out[:, :, ::rep]
             return _quantize_rows_q8(out)
 
-        return jax.jit(
-            gather, out_shardings=(self.ctx.replicated, self.ctx.replicated)
-        )
+        return jax.jit(gather, out_shardings=self.ctx.replicated)
 
     @functools.cached_property
     def _scatter_canonical(self):
         """Scatter canonical-head bundles into the pool (head expansion
-        on device); every process writes its own shards of the result."""
+        on device); every process writes its own shards of the result.
+        Int8 pools quantize the incoming float rows in-program."""
         rep = self.kv_rep
 
         def scatter(kv, ids, vals):
             if rep > 1:
                 vals = jnp.repeat(vals, rep, axis=2)
+            if isinstance(kv, tuple):
+                from llmd_tpu.ops.quant_kv import (
+                    plane_from_bundle, quantize_pages,
+                )
+
+                d, s = quantize_pages(vals)
+                return (
+                    kv[0].at[:, ids].set(d),
+                    kv[1].at[:, :, :, ids].set(plane_from_bundle(s)),
+                )
             return kv.at[:, ids].set(vals)
+
+        return jax.jit(scatter, donate_argnums=(0,))
+
+    @functools.cached_property
+    def _scatter_q8_direct(self):
+        """Scatter a q8-wire bundle (q8 data + wire-layout scales)
+        straight into an int8 pool — no dequant/requant round trip."""
+        rep = self.kv_rep
+
+        def scatter(kv, ids, d, s_wire):
+            from llmd_tpu.ops.quant_kv import (
+                plane_from_bundle, wire_scales_to_pool,
+            )
+
+            s = wire_scales_to_pool(s_wire)  # bundle [L, n, K, 2, page]
+            if rep > 1:
+                d = jnp.repeat(d, rep, axis=2)
+                s = jnp.repeat(s, rep, axis=2)
+            return (
+                kv[0].at[:, ids].set(d),
+                kv[1].at[:, :, :, ids].set(
+                    plane_from_bundle(s).astype(kv[1].dtype)
+                ),
+            )
 
         return jax.jit(scatter, donate_argnums=(0,))
 
@@ -476,13 +567,12 @@ class ModelRunner:
         return fn(self.kv_cache, jnp.asarray(arrays["ids"]))
 
     def _exec_kv_scatter(self, arrays: dict, n: int) -> None:
-        Kc = self.kv_cache.shape[2] // self.kv_rep
-        shape = (
-            self.cfg.num_layers, n, Kc, self.page, self.kv_cache.shape[4]
-        )
+        data = self._kv_data
+        Kc = data.shape[2] // self.kv_rep
+        shape = (self.cfg.num_layers, n, Kc, self.page, data.shape[4])
         vals = np.frombuffer(
             np.ascontiguousarray(arrays["vals_u8"]).data,
-            dtype=self.kv_cache.dtype,
+            dtype=self.staging_dtype,
         ).reshape(shape)
         self.kv_cache = self._scatter_canonical(
             self.kv_cache, jnp.asarray(arrays["ids"]), jnp.asarray(vals)
@@ -567,10 +657,11 @@ class ModelRunner:
         if op == _OP_KV_GATHER:
             return [("ids", (B,), np.int32)]
         if op == _OP_KV_SCATTER:
-            Kc = self.kv_cache.shape[2] // self.kv_rep
+            data = self._kv_data
+            Kc = data.shape[2] // self.kv_rep
             nbytes = (
                 self.cfg.num_layers * B * Kc * self.page
-                * self.kv_cache.shape[4] * self.kv_cache.dtype.itemsize
+                * data.shape[4] * self.staging_dtype.itemsize
             )
             return [("ids", (B,), np.int32), ("vals_u8", (nbytes,), np.uint8)]
         mp = self.max_pages
@@ -719,12 +810,10 @@ class ModelRunner:
         ids = _padded_ids(page_ids, pad_to)
         if self._multihost:
             return self._kv_gather_lockstep(ids, q8=False)
-        out = _gather_kv(self.kv_cache, jnp.asarray(ids))
-        if self.kv_rep > 1:
-            # Canonical transfer format keeps the ORIGINAL heads (peers
-            # with different tp interoperate byte-exact).
-            out = out[:, :, :: self.kv_rep]
-        return out
+        # Canonical transfer format keeps the ORIGINAL heads (peers with
+        # different tp interoperate byte-exact); int8 pools dequantize
+        # in-program to the staging dtype.
+        return self._replicated_gather(self.kv_cache, jnp.asarray(ids))
 
     def snapshot_pages_device_q8(
         self, page_ids: list[int], pad_to: int
@@ -734,13 +823,13 @@ class ModelRunner:
         HBM -> host staging moves HALF the bytes. Returns (q8, scales)
         with q8 [L, pad_to, K, page, 2D] i8 and scales
         [L, pad_to, K, page, 2] f16 (separate K/V half scales). Opt-in
-        and lossy (~0.4% per-half rel-err); the default transfer dtype
-        stays byte-exact."""
+        and lossy (~0.4% per-half rel-err) for FLOAT pools; for int8
+        pools the pool bytes ship directly (lossless wrt the pool, no
+        quantize work). The default transfer dtype stays pool-exact."""
+        ids = _padded_ids(page_ids, pad_to)
         if self._multihost:
-            return self._kv_gather_lockstep(
-                _padded_ids(page_ids, pad_to), q8=True
-            )
-        return _quantize_rows_q8(self.snapshot_pages_device(page_ids, pad_to))
+            return self._kv_gather_lockstep(ids, q8=True)
+        return self._replicated_gather_q8(self.kv_cache, jnp.asarray(ids))
 
     @staticmethod
     def download_pages(snapshot: jax.Array) -> np.ndarray:
@@ -756,28 +845,33 @@ class ModelRunner:
         """Async host -> HBM upload of a canonical bundle (fetch thread:
         creates an independent device array, touches no engine state, so
         the upload overlaps later pulls and the producer's own staging)."""
-        return jnp.asarray(pages, dtype=self.kv_cache.dtype)
+        return jnp.asarray(pages, dtype=self.staging_dtype)
 
-    def upload_pages_device_q8(
-        self, q8: np.ndarray, scales: np.ndarray
-    ) -> jax.Array:
-        """Upload an int8-quantized bundle (half the host -> HBM bytes)
-        and dequantize ON DEVICE into the pool dtype."""
+    def upload_pages_device_q8(self, q8: np.ndarray, scales: np.ndarray):
+        """Upload an int8-quantized bundle (half the host -> HBM bytes).
+
+        Float pools dequantize ON DEVICE into the pool dtype; int8 pools
+        keep the wire form — (q8, wire scales) scatter straight into the
+        pool with no dequant/requant round trip."""
+        if self.kv_quantized:
+            return (jnp.asarray(q8), jnp.asarray(scales))
         return _dequantize_rows_q8(
-            jnp.asarray(q8), jnp.asarray(scales),
-            np.dtype(self.kv_cache.dtype).name,
+            jnp.asarray(q8), jnp.asarray(scales), self.staging_dtype_name
         )
 
-    def scatter_pages_from_device(
-        self, page_ids: list[int], vals: jax.Array
-    ) -> None:
+    def scatter_pages_from_device(self, page_ids: list[int], vals) -> None:
         """Engine-thread leg of a pipelined import: device -> pool scatter
-        of an already-uploaded chunk (head expansion device-side)."""
+        of an already-uploaded chunk (head expansion device-side).
+        ``vals`` is a float bundle, or (q8, wire scales) for int8
+        pools."""
         self._require_single_host("scatter_pages_from_device (P/D staging)")
-        self.kv_cache = _scatter_kv_rep(
-            self.kv_cache, jnp.asarray(np.asarray(page_ids, np.int32)),
-            vals, rep=self.kv_rep,
-        )
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        if isinstance(vals, tuple):
+            self.kv_cache = self._scatter_q8_direct(
+                self.kv_cache, ids, vals[0], vals[1]
+            )
+            return
+        self.kv_cache = self._scatter_canonical(self.kv_cache, ids, vals)
 
     def gather_pages(self, page_ids: list[int]) -> np.ndarray:
         """Stage pages HBM -> host: returns [L, n, K, page, 2D] ndarray.
@@ -788,17 +882,14 @@ class ModelRunner:
         n = len(page_ids)
         bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
         ids = _padded_ids(page_ids, bucket)
+        # Canonical (original-heads, dequantized) bundle either way:
+        # replicated copies are a local layout detail, and peers with
+        # different tp/pool-dtype configs must interoperate.
         if self._multihost:
             snap = self._kv_gather_lockstep(ids, q8=False)
-            return np.ascontiguousarray(self.download_pages(snap)[:, :n])
-        out = np.asarray(jax.device_get(_gather_kv(self.kv_cache, jnp.asarray(ids))))
-        out = out[:, :n]
-        if self.kv_rep > 1:
-            # Canonical transfer/offload format keeps the ORIGINAL heads:
-            # replicated copies are a local layout detail, and peers with
-            # different tp configs must interoperate byte-exact.
-            out = np.ascontiguousarray(out[:, :, :: self.kv_rep])
-        return out
+        else:
+            snap = self._replicated_gather(self.kv_cache, jnp.asarray(ids))
+        return np.ascontiguousarray(self.download_pages(snap)[:, :n])
 
     def scatter_pages(self, page_ids: list[int], pages: np.ndarray) -> None:
         """Stage pages host -> HBM into the given physical page slots.
@@ -819,10 +910,11 @@ class ModelRunner:
             )
         if self._multihost:
             # Lockstep scatter: canonical-head values broadcast to every
-            # process (one collective), head expansion on device.
+            # process (one collective), head expansion (and int8-pool
+            # quantization) on device.
             assert dist.is_leader(), "KV staging ops originate on the leader"
             vals = np.ascontiguousarray(
-                np.asarray(pages).astype(self.kv_cache.dtype, copy=False)
+                np.asarray(pages).astype(self.staging_dtype, copy=False)
             )
             arrays = self._sync(
                 _OP_KV_SCATTER, bucket, 0, False,
@@ -830,12 +922,10 @@ class ModelRunner:
             )
             self._exec_kv_scatter(arrays, bucket)
             return
-        if self.kv_rep > 1:
-            # Expand canonical [.., K, ..] bundles to the local replicated
-            # head layout.
-            pages = np.repeat(pages, self.kv_rep, axis=2)
-        vals = jnp.asarray(pages, dtype=self.kv_cache.dtype)
-        self.kv_cache = _scatter_kv(self.kv_cache, jnp.asarray(ids), vals)
+        vals = jnp.asarray(np.asarray(pages), dtype=self.staging_dtype)
+        self.kv_cache = self._scatter_canonical(
+            self.kv_cache, jnp.asarray(ids), vals
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -895,13 +985,20 @@ class ModelRunner:
                 else None
             ),
         )
-        scratch = jnp.zeros(
-            (
-                self.cfg.num_layers, B * pages_per_seq,
-                self.kv_cache.shape[2], page, self.kv_cache.shape[4],
-            ),
-            self.kv_cache.dtype,
+        data = self._kv_data
+        shape = (
+            self.cfg.num_layers, B * pages_per_seq,
+            data.shape[2], page, data.shape[4],
         )
+        if self.kv_quantized:
+            scratch = (
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones(
+                    (shape[0], shape[2], 2, shape[1], page), jnp.float32
+                ),
+            )
+        else:
+            scratch = jnp.zeros(shape, data.dtype)
         pooled = self._embed_fn(self.params, scratch, inp)
         return np.asarray(pooled[:n])
 

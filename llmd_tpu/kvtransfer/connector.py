@@ -284,9 +284,13 @@ class TPUConnector:
         cp = max(1, self.cfg.chunk_pages)
         ids = list(req.block_ids[:n_full])
         n_chunks = -(-n_full // cp)
+        # Int8 POOLS always ship the q8 wire form: the pool bytes go out
+        # directly — lossless wrt the pool, half the staging bytes, no
+        # quantize work. Float pools use it only when opted in.
         snap_fn = (
             self.runner.snapshot_pages_device_q8
             if self.cfg.transfer_dtype == "int8"
+            or getattr(self.runner, "kv_quantized", False)
             else self.runner.snapshot_pages_device
         )
         snaps = [
@@ -315,7 +319,7 @@ class TPUConnector:
             for j, snap in enumerate(snaps):
                 if isinstance(snap, tuple):  # int8 transfer: (q8, scales)
                     q8, scales = (self.runner.download_pages(s) for s in snap)
-                    orig = np.dtype(self.runner.kv_cache.dtype).name
+                    orig = self.runner.staging_dtype_name
                     # Scales ride in the header blob: one owning copy in
                     # the shipper, no concat of the big int8 payload.
                     header = pack_header_q8(q8, orig) + scales.tobytes()
@@ -370,7 +374,11 @@ class TPUConnector:
                 f"{len(hashes)} full pages"
             )
         host, port, key = params["remote_host"], int(params["remote_port"]), params["remote_key"]
-        want_dtype = np.dtype(self.runner.kv_cache.dtype)
+        want_dtype = np.dtype(self.runner.staging_dtype)
+        # Int8 pools re-quantize whatever arrives (the pool itself is the
+        # lossy step), so the byte-exact-dtype invariant only binds for
+        # float pools.
+        pool_quant = getattr(self.runner, "kv_quantized", False)
         n_chunks = int(params.get("num_chunks", 0) or 0)
         if n_chunks <= 0:
             # Legacy single-bundle producer.
@@ -380,7 +388,7 @@ class TPUConnector:
                 raise ValueError(
                     f"bundle holds {pages.shape[1]} pages, expected {n_full}"
                 )
-            if pages.dtype != want_dtype:
+            if pages.dtype != want_dtype and not pool_quant:
                 # Never silently cast transferred KV: the P/D invariance
                 # guarantee is byte-exact numerics.
                 raise ValueError(
@@ -434,9 +442,10 @@ class TPUConnector:
                         self.runner.upload_pages_device_q8(q8, scales)
                     )
             else:
-                if payload.dtype != want_dtype:
+                if payload.dtype != want_dtype and not pool_quant:
                     # The EXACT path's guarantee is byte-identical
-                    # numerics; silent casts would break it.
+                    # numerics; silent casts would break it. (Int8 pools
+                    # re-quantize on scatter — any float dtype works.)
                     raise ValueError(
                         f"KV dtype mismatch: producer {payload.dtype} "
                         f"vs consumer {want_dtype}"

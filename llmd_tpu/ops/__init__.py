@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from llmd_tpu.ops.paged_attention import (
     paged_attention_xla,
     paged_attention_xla_blocked,
+    scatter_kv_scales,
 )
 from llmd_tpu.ops.paged_attention import write_kv_pages as write_kv_pages_xla
 from llmd_tpu.ops.kv_write import (
@@ -144,8 +145,17 @@ def _plan_mla(Q, page, Dl, rank, world_size, mesh, B, H):
 _DENSE_XLA_MAX_S = 4096
 
 
+def _split_cache(kv_cache):
+    """(data, scales) view of a pool: int8 pools travel as a 2-tuple
+    (data i8, scales f16 — ops/quant_kv.py layout); float pools as a
+    bare array with scales None."""
+    if isinstance(kv_cache, tuple):
+        return kv_cache
+    return kv_cache, None
+
+
 def _attention_xla(q, kv_slice, page_table, kv_lens, positions, sm_scale,
-                   window=None, sinks=None):
+                   window=None, sinks=None, scales=None):
     S = page_table.shape[1] * kv_slice.shape[-2]
     if S > _DENSE_XLA_MAX_S:
         # The blocked online-softmax path handles Q==1 too — long-context
@@ -153,11 +163,11 @@ def _attention_xla(q, kv_slice, page_table, kv_lens, positions, sm_scale,
         # gather the whole padded context per step.
         return paged_attention_xla_blocked(
             q, kv_slice, page_table, kv_lens, positions, sm_scale,
-            window=window, sinks=sinks,
+            window=window, sinks=sinks, scales=scales,
         )
     return paged_attention_xla(
         q, kv_slice, page_table, kv_lens, positions, sm_scale, window=window,
-        sinks=sinks,
+        sinks=sinks, scales=scales,
     )
 
 
@@ -249,7 +259,29 @@ def write_kv_pages_full(
     written slabs move. Fallback (CPU / prefill / non-divisible
     sharding): dynamic slice + XLA scatter + dynamic update — the
     carry-update pattern XLA optimizes in place where it can.
+
+    Int8 pools (tuple cache): k/v rows quantize on device first; the
+    int8 data rides the same dispatch below (the Pallas kernel moves
+    HALF the bytes), and the tiny per-row scales scatter via XLA.
     """
+    kv_cache_full, kv_scales = _split_cache(kv_cache_full)
+    if kv_scales is not None:
+        from llmd_tpu.ops.quant_kv import quantize_kv_rows
+
+        k8, v8, srow = quantize_kv_rows(k, v)
+        data = write_kv_pages_full(
+            kv_cache_full, layer, k8, v8, page_table, positions, valid,
+            world_size=world_size, mesh=mesh,
+        )
+        # Slice + scatter + update-slice on the layer's scale plane: the
+        # full-array layer-indexed scatter reads cleaner but defeats
+        # XLA's in-place aliasing (the attention read is a second
+        # consumer), copying the whole scale plane per layer — measured
+        # 10x slower e2e. The slice form pays ~2 plane-slices per layer
+        # (~1/128 of the data bytes).
+        ssl = jax.lax.dynamic_index_in_dim(kv_scales, layer, 0, keepdims=False)
+        ssl = scatter_kv_scales(ssl, srow, page_table, positions, valid)
+        return (data, jax.lax.dynamic_update_index_in_dim(kv_scales, ssl, layer, 0))
     B, Q, K, D = k.shape
     L, num_pages, Kc, page, D2 = kv_cache_full.shape
     plan = _plan_write(Q, page, D, D2, world_size, mesh)
@@ -361,7 +393,10 @@ def paged_attention_full(
     """Layer-indexed attention on the FULL [L, ...] cache (see
     write_kv_pages_full). ``window`` is an optional i32 scalar sliding
     window (0/None = full attention; a traced per-layer value inside the
-    layer scan)."""
+    layer scan). Int8 pools (tuple cache) dequantize per row at the
+    read: the Pallas kernel DMAs half the HBM bytes and folds the scales
+    around its matmuls; the XLA fallback dequantizes gathered pages."""
+    kv_cache_full, kv_scales = _split_cache(kv_cache_full)
     L, num_pages, K, page, D2 = kv_cache_full.shape
     B, Q, H, D = q.shape
     plan = _plan(Q, page, D, D2, world_size, True, mesh, B, H, K)
@@ -371,6 +406,7 @@ def paged_attention_full(
         return decode_paged_attention_full(
             q, kv_cache_full, layer, page_table, kv_lens, sm_scale=sm_scale,
             interpret=_interpret(), window=window, sinks=sinks,
+            scales=kv_scales,
         )
     if plan == "shard":
         tp_k = _kv_head_axis(K, mesh.shape["tp"])
@@ -381,6 +417,26 @@ def paged_attention_full(
         # placeholder keeps the shard_map arity fixed when absent).
         sk = jnp.zeros((H,), jnp.float32) if sinks is None else sinks
         use_sinks = sinks is not None
+        if kv_scales is not None:
+            # Scales shard with the pool's head axis.
+
+            def local_q(q, cache, sc, layer, pt, kl, win, sk):
+                return decode_paged_attention_full(
+                    q, cache, layer, pt, kl, sm_scale=sm_scale,
+                    interpret=interpret, window=win if use_win else None,
+                    sinks=sk if use_sinks else None, scales=sc,
+                )
+
+            return shard_map(
+                local_q, mesh=mesh,
+                in_specs=(
+                    P("dp", None, "tp", None), P(None, None, tp_k, None, None),
+                    P(None, tp_k, None, None, None),
+                    P(), P("dp", None), P("dp"), P(), P("tp"),
+                ),
+                out_specs=P("dp", None, "tp", None),
+                check_vma=False,
+            )(q, kv_cache_full, kv_scales, layer, page_table, kv_lens, win, sk)
 
         def local(q, cache, layer, pt, kl, win, sk):
             return decode_paged_attention_full(
@@ -399,7 +455,11 @@ def paged_attention_full(
             check_vma=False,
         )(q, kv_cache_full, layer, page_table, kv_lens, win, sk)
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
+    ssl = (
+        None if kv_scales is None
+        else jax.lax.dynamic_index_in_dim(kv_scales, layer, 0, keepdims=False)
+    )
     return _attention_xla(
         q, sl, page_table, kv_lens, positions, sm_scale, window=window,
-        sinks=sinks,
+        sinks=sinks, scales=ssl,
     )

@@ -54,6 +54,44 @@ def write_kv_pages(
     ].set(kv_flat, mode="drop")
 
 
+def scatter_kv_scales(
+    scales: jax.Array,  # [K, 2, num_pages, page] f32 (one layer's PLANE)
+    srow: jax.Array,  # [B, Q, K, 2] per-row K/V-half scales
+    page_table: jax.Array,  # [B, max_pages]
+    positions: jax.Array,  # [B, Q]
+    valid: jax.Array,  # [B, Q] bool
+) -> jax.Array:
+    """Scatter this step's per-row scales into one layer's scale plane
+    (the tiny sibling of write_kv_pages; ~1/32 of the data bytes, so the
+    plain XLA scatter is fine even on the Pallas write path)."""
+    K, two, num_pages, page = scales.shape
+    page_idx = positions // page
+    offset = positions % page
+    phys = jnp.take_along_axis(page_table, page_idx, axis=1)
+    phys = jnp.where(valid, phys, num_pages)  # OOB => dropped
+    T = phys.size
+    # Advanced indices on axes (2, 3) are adjacent -> result dims sit in
+    # place: [K, 2, T].
+    vals = jnp.moveaxis(srow.reshape(T, K, 2), 0, 2).astype(scales.dtype)
+    return scales.at[
+        :, :, phys.reshape(T), offset.reshape(T)
+    ].set(vals, mode="drop")
+
+
+def _dequant_gathered(kv, scales, page_idx, D):
+    """Gathered int8 pages [B, n, K, page, 2D] + one layer's scale PLANE
+    [K, 2, P, page] with the same page indices [B, n] -> float32 k, v
+    [B, S, K, D] (S = n * page)."""
+    B, n, K, page, D2 = kv.shape
+    S = n * page
+    kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, S, K, D2).astype(jnp.float32)
+    g = scales[:, :, page_idx]  # [K, 2, B, n, page]
+    s = g.transpose(2, 3, 4, 0, 1).reshape(B, S, K, 2).astype(jnp.float32)
+    k = kv[..., :D] * s[..., 0:1]
+    v = kv[..., D:] * s[..., 1:2]
+    return k, v
+
+
 def _window_mask(key_pos, positions, window):
     """Sliding-window lower bound: key_pos > q_pos - window (no-op when
     window <= 0). ``window`` may be a traced i32 scalar (per-layer value
@@ -76,6 +114,7 @@ def paged_attention_xla_blocked(
     block_pages: int = 32,
     window=None,  # i32 scalar (0/None = full attention)
     sinks=None,   # [H] per-q-head virtual-key logits (gpt-oss)
+    scales=None,  # [K, 2, num_pages, page] f32: int8-pool scale plane
 ) -> jax.Array:
     """Flash-style blocked paged attention in plain XLA.
 
@@ -108,9 +147,12 @@ def paged_attention_xla_blocked(
             page_table, blk * block_pages, block_pages, axis=1
         )  # [B, bp]
         kv = kv_cache[pt_blk]  # [B, bp, K, page, 2D]
-        kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, Sb, K, D2)
-        k = kv[..., :D]
-        v = kv[..., D:]
+        if scales is not None:
+            k, v = _dequant_gathered(kv, scales, pt_blk, D)
+        else:
+            kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, Sb, K, D2)
+            k = kv[..., :D]
+            v = kv[..., D:]
         s = (
             jnp.einsum(
                 "bqkgd,bskd->bqkgs", qg, k, preferred_element_type=jnp.float32
@@ -165,6 +207,7 @@ def paged_attention_xla(
     sm_scale: float | None = None,
     window=None,  # i32 scalar (0/None = full attention)
     sinks=None,   # [H] per-q-head virtual-key logits (gpt-oss)
+    scales=None,  # [K, 2, num_pages, page] f32: int8-pool scale plane
 ) -> jax.Array:
     """Reference paged attention: gather the whole context, masked softmax."""
     B, Q, H, D = q.shape
@@ -175,9 +218,12 @@ def paged_attention_xla(
         sm_scale = D ** -0.5
 
     kv = kv_cache[page_table]  # [B, max_pages, K, page, 2D]
-    kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, S, K, D2)
-    k = kv[..., :D]
-    v = kv[..., D:]
+    if scales is not None:
+        k, v = _dequant_gathered(kv, scales, page_table, D)
+    else:
+        kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, S, K, D2)
+        k = kv[..., :D]
+        v = kv[..., D:]
 
     group = H // K
     qg = q.reshape(B, Q, K, group, D)

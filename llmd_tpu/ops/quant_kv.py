@@ -1,0 +1,111 @@
+"""INT8 KV-cache pool: quantization helpers and layout contract.
+
+The reference's flagship path runs a quantized cache end-to-end (FP8 KV in
+the deployed vLLM engine; FP8 DeepGEMM MoE — docker/Dockerfile.cuda:69-70).
+TPU-native the pool is symmetric int8 with per-(token, head) row scales,
+kept as a 2-tuple pytree alongside the data:
+
+Three layouts, one value set:
+
+  PLANE  (pool-resident) scales: [(L,) K, 2, num_pages, page] f32
+         — page axis NEXT TO the token axis, so the decode step's
+         per-layer gather through the page table moves [num_pages-slice,
+         page] = 2KB-contiguous chunks per (head, half) instead of
+         64-byte slivers (measured ~3x cheaper relayout); the head axis
+         leads so it TP-shards like the data pool's head axis.
+  BUNDLE (canonical gathered pages, staging/offload):
+         data [L, n, K, page, 2D] i8 + scales [L, n, K, 2, page]
+  WIRE   (transfer q8 encoding, kvtransfer/connector.py):
+         scales [L, n, K, page, 2] f16
+
+Scales are STORED f32 (Mosaic has no f16 type on TPU, and f32 scales are
+only 8B next to each 256B int8 row) but their VALUES live on the f16
+grid — quantization divides by the f16-rounded scale — so converting to
+the f16 transfer-wire form is lossless.
+
+Separate K/V half scales for the same reason as the transfer encoding
+(kvtransfer/connector.py): RoPE'd keys run ~an order of magnitude hotter
+than values; one shared amax would crush the value half to a few int8
+levels. Scales are rounded through f16 BEFORE quantizing so dequant uses
+the exact value quant divided by (no systematic rounding bias), which
+also makes dequantize -> requantize a lossless round trip (same grid).
+
+The fused weight-side W8A8 path lives in ops/quant.py; this module is the
+KV (activation-cache) side.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Pool storage dtype (f32: Mosaic-compatible); values sit on the f16
+# grid so the f16 wire encoding is a lossless cast.
+KV_SCALES_DTYPE = jnp.float32
+
+
+def quantize_kv_rows(k: jax.Array, v: jax.Array):
+    """Per-row symmetric int8 for this step's K/V slabs.
+
+    k, v: [..., D] float -> (k8 i8, v8 i8, scales [..., 2] f32 on the
+    f16 grid).
+    """
+
+    def one(x):
+        xf = x.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        scale = jnp.maximum(amax, 1e-30) / 127.0
+        # Quantize against the f16-ROUNDED scale — the exact value any
+        # f16 wire consumer will dequantize with.
+        scale = scale.astype(jnp.float16).astype(jnp.float32)
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        return q, scale[..., 0].astype(KV_SCALES_DTYPE)
+
+    k8, ks = one(k)
+    v8, vs = one(v)
+    return k8, v8, jnp.stack([ks, vs], axis=-1)
+
+
+def quantize_pages(pages: jax.Array):
+    """Canonical float pages [..., K, page, 2D] -> (data i8 same shape,
+    scales [..., K, 2, page] f32) in the BUNDLE layout."""
+    *lead, K, page, D2 = pages.shape
+    D = D2 // 2
+    k8, v8, srow = quantize_kv_rows(pages[..., :D], pages[..., D:])
+    data = jnp.concatenate([k8, v8], axis=-1)
+    # srow [..., K, page, 2] -> bundle layout [..., K, 2, page]
+    scales = jnp.swapaxes(srow, -1, -2)
+    return data, scales
+
+
+def dequantize_pages(data: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """Bundle-layout (data, scales) -> float pages [..., K, page, 2D]."""
+    D2 = data.shape[-1]
+    D = D2 // 2
+    srow = jnp.swapaxes(scales, -1, -2).astype(jnp.float32)  # [..., page, 2]
+    k = data[..., :D].astype(jnp.float32) * srow[..., 0:1]
+    v = data[..., D:].astype(jnp.float32) * srow[..., 1:2]
+    return jnp.concatenate([k, v], axis=-1).astype(dtype)
+
+
+def pool_scales_to_wire(scales: jax.Array) -> jax.Array:
+    """Bundle layout [..., K, 2, page] -> transfer-wire layout
+    [..., K, page, 2] (kvtransfer bundle scales order)."""
+    return jnp.swapaxes(scales, -1, -2)
+
+
+def wire_scales_to_pool(scales) -> jax.Array:
+    """Transfer-wire layout [..., K, page, 2] -> bundle layout."""
+    return jnp.swapaxes(jnp.asarray(scales), -1, -2)
+
+
+def plane_from_bundle(scales: jax.Array) -> jax.Array:
+    """Bundle scales [L, n, K, 2, page] -> plane layout
+    [L, K, 2, n, page] (the pool-resident arrangement)."""
+    return jnp.moveaxis(scales, 1, 3)
+
+
+def bundle_from_plane(scales: jax.Array) -> jax.Array:
+    """Plane scales [L, K, 2, n, page] -> bundle layout
+    [L, n, K, 2, page]."""
+    return jnp.moveaxis(scales, 3, 1)

@@ -34,22 +34,24 @@ def _decode_kernel(
     page_table_ref,  # [B, max_pages] i32
     kv_lens_ref,  # [B] i32
     win_starts_ref,  # [B] i32 first attended position (sliding window; 0=full)
-    # blocks
-    q_ref,  # [1, K, G, D] VMEM
-    sinks_ref,  # [K, G] f32 per-q-head sink logits (zeros when unused)
-    kv_hbm_full_ref,  # [(L,) num_pages, K, page, 2D] in HBM (unblocked)
-    out_ref,  # [1, K, G, D] VMEM
-    # scratch
-    m_ref,  # [K, G, 128] f32
-    l_ref,  # [K, G, 128] f32
-    acc_ref,  # [K, G, D] f32
-    *,
+    # blocks: q_ref, sinks_ref, kv_hbm_full_ref, [ks_ref, vs_ref when
+    # quant: [1, K, S_max] f32 per-row scales, pre-gathered+relayouted by
+    # XLA — scale slabs are too narrow (page=16 lanes) for Mosaic DMA
+    # alignment, and at 1/32 of the data bytes the XLA gather is cheap],
+    # out_ref — see _decode_call
+    *refs,
     page_size: int,
     head_dim: int,
     sm_scale: float,
     pages_per_block: int,
     has_sinks: bool,
+    quant: bool,
 ):
+    if quant:
+        (q_ref, sinks_ref, kv_hbm_full_ref, ks_ref, vs_ref, out_ref,
+         m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, sinks_ref, kv_hbm_full_ref, out_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     kv_hbm_ref = (
         kv_hbm_full_ref.at[layer_ref[0]]
@@ -116,18 +118,30 @@ def _decode_kernel(
             kv = buf[slot]  # [K, S, 2D]
             k = kv[:, :, :D]
             v = kv[:, :, D:].astype(jnp.float32)
+            q = q_ref[0]  # [K, G, D]
+            if quant:
+                # Row dequantization, factored around the matmuls:
+                # (q . k_i8) * ks == q . (k_i8 * ks), and v is scaled
+                # before the live-mask zeroing.
+                ks = ks_ref[0, :, pl.ds(i * S, S)]  # [K, S] f32
+                vs = vs_ref[0, :, pl.ds(i * S, S)]
+                k = k.astype(q.dtype)  # i8 -> exact in bf16/f32
+                v = v * vs[:, :, None]
             # Unfetched positions (tail past kv_len, or pages before the
             # window) hold uninitialized VMEM; zero them so a stray NaN
             # can't poison the (0-prob x v) accumulation.
             pos_v = i * S + jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
             live_v = jnp.logical_and(pos_v < kv_len, pos_v >= win_start)
             v = jnp.where(live_v, v, 0.0)
-            q = q_ref[0]  # [K, G, D]
             # K-batched (G, D) x (D, S) -> [K, G, S], f32 accumulate.
             s = jax.lax.dot_general(
                 q, k, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             ) * sm_scale
+            if quant:
+                # Dead-column scale values die in the live mask below
+                # (jnp.where does not propagate the unselected arm).
+                s = s * ks[:, None, :]
             pos = i * S + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
             live = jnp.logical_and(pos < kv_len, pos >= win_start)
             s = jnp.where(live, s, NEG_INF)
@@ -174,7 +188,7 @@ def _decode_kernel(
 
 def _decode_call(
     q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
-    pages_per_block, window=None, sinks=None,
+    pages_per_block, window=None, sinks=None, scales=None,
 ):
     B, Q, H, D = q.shape
     assert Q == 1, "decode kernel handles Q=1"
@@ -207,14 +221,35 @@ def _decode_call(
         # q head h maps to (h // G, h % G) — same grouping as qk above.
         sinks2d = sinks.astype(jnp.float32).reshape(K, G)
 
+    in_specs = [
+        pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl, ws: (b, 0, 0, 0)),
+        pl.BlockSpec((K, G), lambda b, l, pt, kl, ws: (0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
+    ]
+    operands = [qk, sinks2d, kv_cache]
+    if scales is not None:
+        # Per-row scales, pre-gathered for this batch's contexts and
+        # relayouted to lane-aligned [B, K, S_max] (page=16-wide slabs
+        # violate Mosaic's 128-lane DMA alignment; at 1/32 of the data
+        # bytes the XLA gather is cheap and fuses into the step).
+        lidx = jnp.asarray(layer, jnp.int32).reshape(-1)[0]
+        sl = (
+            jax.lax.dynamic_index_in_dim(scales, lidx, 0, keepdims=False)
+            if scales.ndim == 5 else scales
+        )  # [K, 2, P, page] plane
+        g = sl[:, :, page_table]  # [K, 2, B, mp, page]
+        mp = page_table.shape[1]
+        ksvs = jnp.moveaxis(g, 2, 0).reshape(B, K, 2, mp * page)
+        ksvs = ksvs.astype(jnp.float32)
+        sspec = pl.BlockSpec(
+            (1, K, mp * page), lambda b, l, pt, kl, ws: (b, 0, 0)
+        )
+        in_specs.extend([sspec, sspec])
+        operands.extend([ksvs[:, :, 0], ksvs[:, :, 1]])
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, K, G, D), lambda b, l, pt, kl, ws: (b, 0, 0, 0)),
-            pl.BlockSpec((K, G), lambda b, l, pt, kl, ws: (0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, K, G, D), lambda b, l, pt, kl, ws: (b, 0, 0, 0)
         ),
@@ -232,6 +267,7 @@ def _decode_call(
             sm_scale=sm_scale,
             pages_per_block=pages_per_block,
             has_sinks=sinks is not None,
+            quant=scales is not None,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
@@ -242,7 +278,7 @@ def _decode_call(
     )
     out = kernel(
         layer.astype(jnp.int32).reshape(1), page_table, kv_lens, win_starts,
-        qk, sinks2d, kv_cache,
+        *operands,
     )
     return out.reshape(B, 1, H, D)
 
@@ -260,10 +296,12 @@ def decode_paged_attention(
     pages_per_block: int = 16,
     window: jax.Array | None = None,
     sinks: jax.Array | None = None,
+    scales: jax.Array | None = None,  # [K, 2, num_pages, page] plane
 ) -> jax.Array:
     return _decode_call(
         q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
         sm_scale, interpret, pages_per_block, window=window, sinks=sinks,
+        scales=scales,
     )
 
 
@@ -278,11 +316,12 @@ def decode_paged_attention_full(
     pages_per_block: int = 16,
     window: jax.Array | None = None,
     sinks: jax.Array | None = None,
+    scales: jax.Array | None = None,  # [L, K, 2, num_pages, page]
 ) -> jax.Array:
     """Layer-indexed variant: reads cache[layer] pages directly from the
     full-cache HBM ref — a scan over layers never materializes a
     pool-sized slice."""
     return _decode_call(
         q, kv_cache, layer, page_table, kv_lens, sm_scale, interpret,
-        pages_per_block, window=window, sinks=sinks,
+        pages_per_block, window=window, sinks=sinks, scales=scales,
     )
