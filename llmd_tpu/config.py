@@ -338,6 +338,10 @@ class EngineConfig:
     # staging legs (the TTFT floor when staging-bandwidth-bound) at ~0.4%
     # per-row error. Producer-side knob.
     kv_transfer_dtype: str = "auto"
+    # Single-host xPyD fast path: consumers claim an in-process
+    # producer's device snapshots directly — no HBM->host staging, no
+    # wire bytes (the reference's single-host/pd deployment shape).
+    kv_local_fastpath: bool = True
     # ZMQ pub endpoint for KV events (BlockStored/...); None disables.
     kv_events_endpoint: str | None = None
     # Tiered KV offload; None disables.

@@ -144,6 +144,7 @@ class LLMEngine:
                 lease_ms=config.kv_lease_ms,
                 load_failure_policy=config.kv_load_failure_policy,
                 transfer_dtype=config.kv_transfer_dtype,
+                local_fastpath=config.kv_local_fastpath,
             )
             self.kv_connector = TPUConnector(kv_cfg, self.runner, self.allocator)
             self.scheduler.finish_hook = self._on_finish
@@ -222,6 +223,28 @@ class LLMEngine:
 
     def abort_request(self, request_id: str) -> bool:
         return self.scheduler.abort_request(request_id) is not None
+
+    def cached_prefix_pages(self, prompt_token_ids: list[int]) -> int:
+        """Leading FULL pages of this prompt already held locally (device
+        prefix cache or tiered host/FS cache — restore-on-prefill pulls
+        the latter in without a transfer). The P/D byte-diet probe: the
+        sidecar asks before phase 1 so the producer skips staging pages
+        the decode side already has (the reference's disagg decider asks
+        the same question, scheduling.md:113)."""
+        from llmd_tpu.engine.kv_cache import page_hashes_for_tokens
+
+        hashes = page_hashes_for_tokens(
+            list(prompt_token_ids), self.allocator.page_size
+        )
+        n = 0
+        for h in hashes:
+            if self.allocator.has_cached(h) or (
+                self._host_cache is not None and self._host_cache.has(h)
+            ):
+                n += 1
+            else:
+                break
+        return n
 
     def embed(self, prompts: list[list[int]], lora_id: int = 0):
         """[n, H] mean-pooled L2-normalized embeddings (OpenAI

@@ -534,7 +534,9 @@ class ModelRunner:
                     kv[0].at[:, ids].set(d),
                     kv[1].at[:, :, :, ids].set(plane_from_bundle(s)),
                 )
-            return kv.at[:, ids].set(vals)
+            # Heterogeneous-pool local claims (e.g. bf16 producer -> f32
+            # consumer) cast at the write.
+            return kv.at[:, ids].set(vals.astype(kv.dtype))
 
         return jax.jit(scatter, donate_argnums=(0,))
 
@@ -862,15 +864,21 @@ class ModelRunner:
     def scatter_pages_from_device(self, page_ids: list[int], vals) -> None:
         """Engine-thread leg of a pipelined import: device -> pool scatter
         of an already-uploaded chunk (head expansion device-side).
-        ``vals`` is a float bundle, or (q8, wire scales) for int8
-        pools."""
+        ``vals`` is a float bundle, or a (q8, wire scales) pair — int8
+        pools scatter the pair directly; float pools dequantize on
+        device first (the local fast path hands q8 device snapshots to
+        any consumer pool dtype)."""
         self._require_single_host("scatter_pages_from_device (P/D staging)")
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
         if isinstance(vals, tuple):
-            self.kv_cache = self._scatter_q8_direct(
-                self.kv_cache, ids, vals[0], vals[1]
+            if self.kv_quantized:
+                self.kv_cache = self._scatter_q8_direct(
+                    self.kv_cache, ids, vals[0], vals[1]
+                )
+                return
+            vals = _dequantize_rows_q8(
+                vals[0], vals[1], self.staging_dtype_name
             )
-            return
         self.kv_cache = self._scatter_canonical(self.kv_cache, ids, vals)
 
     def gather_pages(self, page_ids: list[int]) -> np.ndarray:

@@ -67,6 +67,9 @@ class KVTransferConfig:
     # at ~0.4% per-row error. Producer-driven; the consumer dequantizes
     # into its pool dtype.
     transfer_dtype: str = "auto"  # "auto" | "int8"
+    # Single-host xPyD: consumers claim in-process producers' device
+    # snapshots directly (no host staging, no wire bytes).
+    local_fastpath: bool = True
 
     @property
     def is_producer(self) -> bool:
@@ -95,28 +98,33 @@ class PulledBundle:
     # Pipelined import: chunks already uploaded to device scratch by the
     # fetch thread ([L, chunk_pages, K, page, 2D] each, canonical heads).
     device_chunks: list = dataclasses.field(default_factory=list)
-    # Host-side chunk arrays (kept for the rare skip>0 fallback; the
+    # Host-side chunk arrays (kept for the partial-overlap fallback; the
     # common pipelined apply reads only device_chunks).
     np_chunks: list = dataclasses.field(default_factory=list)
     chunk_pages: int = 0
+    # Prompt-page index of the first page in the first PULLED chunk
+    # (byte diet: producer-skipped pages + consumer-skipped chunks).
+    start_page: int = 0
+
+    @staticmethod
+    def _dequant_chunk(c) -> np.ndarray:
+        if isinstance(c, np.ndarray):
+            return c
+        q8, scales = c
+        *lead, d2 = q8.shape
+        qf = q8.astype(np.float32).reshape(*lead, 2, d2 // 2)
+        out = qf * scales[..., None].astype(np.float32)
+        return out.reshape(*lead, d2)
 
     def host_pages(self, n_full: int) -> np.ndarray:
-        """Materialize the [L, n_full, ...] host view (fallback path only
-        — this concat is deliberately NOT done on the fetch critical
-        path). int8-transferred chunks dequantize on host here."""
+        """Materialize the [L, n_full - start_page, ...] host view of the
+        PULLED pages (fallback path only — this concat is deliberately
+        NOT done on the fetch critical path). int8-transferred chunks
+        dequantize on host here."""
         if self.pages is not None:
             return self.pages
-        def dequant(q8, scales):
-            *lead, d2 = q8.shape
-            qf = q8.astype(np.float32).reshape(*lead, 2, d2 // 2)
-            out = qf * scales[..., None].astype(np.float32)
-            return out.reshape(*lead, d2)
-
-        chunks = [
-            c if isinstance(c, np.ndarray) else dequant(*c)
-            for c in self.np_chunks
-        ]
-        return np.concatenate(chunks, axis=1)[:, :n_full]
+        chunks = [self._dequant_chunk(c) for c in self.np_chunks]
+        return np.concatenate(chunks, axis=1)[:, : n_full - self.start_page]
 
 
 def chunk_key(key: str, j: int) -> str:
@@ -197,6 +205,26 @@ def unpack_pages(blob: bytes) -> np.ndarray:
     return arr.reshape(L, n, K, page, inner)
 
 
+# In-process producer registry (single-host xPyD fast path): a consumer
+# whose target (host, port) resolves to a producer connector in the SAME
+# process claims its device snapshots directly — no HBM->host staging, no
+# wire. The reference deploys single-host P/D as a first-class shape
+# (guides/recipes/modelserver/base/single-host/pd/) where NIXL takes the
+# same-node shortcut; TPU-first, the shortcut is a device-to-device copy
+# (and on a real multi-chip host, an ICI copy).
+_LOCAL_PRODUCERS: dict[int, "TPUConnector"] = {}
+_LOCAL_HOSTS = {"127.0.0.1", "localhost", "::1"}
+
+
+def _lookup_local(host: str, port: int) -> "TPUConnector | None":
+    conn = _LOCAL_PRODUCERS.get(port)
+    if conn is None:
+        return None
+    if host in _LOCAL_HOSTS or host == conn.cfg.host:
+        return conn
+    return None
+
+
 class TPUConnector:
     """Engine-side connector; one per engine process."""
 
@@ -234,12 +262,26 @@ class TPUConnector:
                 self.server.port,
                 self.server.backend,
             )
+        # Single-host xPyD fast path: pending device snapshots by key,
+        # claimable by an in-process consumer (see _LOCAL_PRODUCERS).
+        self._local_lock = threading.Lock()
+        self._local_exports: dict[str, tuple] = {}
+        self._local_claimed: set[str] = set()
+        self._staging_active: set[str] = set()
+        self._local_enabled = (
+            cfg.local_fastpath
+            and self.server is not None
+            and not getattr(runner, "_multihost", False)
+        )
+        if self._local_enabled:
+            _LOCAL_PRODUCERS[self.server.port] = self
         # transfer metrics
         self.exported_requests = 0
         self.exported_bytes = 0
         self.imported_requests = 0
         self.imported_bytes = 0
         self.import_failures = 0
+        self.local_imports = 0  # transfers served by the in-process path
         # last-transfer stage timings (ms) — the P/D TTFT budget, readable
         # from stats()/bench without instrumentation hooks
         self.last_stage_ms = 0.0   # producer: HBM->host downloads + register
@@ -269,6 +311,14 @@ class TPUConnector:
         on a staging thread. The response therefore leaves after prefill
         COMPUTE, and the consumer's pull/upload pipeline overlaps the
         remaining downloads (pulls of not-yet-registered chunks wait).
+
+        Prefix-cache-aware byte diet: ``skip_pages`` in the request's
+        kv_transfer_params (set by the sidecar after probing the decode
+        engine's prefix cache) drops the consumer's already-cached
+        leading pages from the export — the reference's disagg decider
+        asks the same "how much of the prompt is cached on D?" question
+        (scheduling.md:113). A fully-cached prompt exports ZERO chunks
+        (params still returned so the consumer accounts the transfer).
         """
         page = self.allocator.page_size
         n_full = req.num_prompt_tokens // page
@@ -278,12 +328,24 @@ class TPUConnector:
             or req.num_computed_tokens < n_full * page
         ):
             return None
+        skip = 0
+        if req.kv_transfer_params:
+            try:
+                skip = min(
+                    max(int(req.kv_transfer_params.get("skip_pages", 0) or 0), 0),
+                    n_full,
+                )
+            except (TypeError, ValueError):
+                # Client-controllable field reaching the scheduler finish
+                # hook: malformed values degrade to a full export, never
+                # crash the producer's step path.
+                skip = 0
         # Server-unique key: never the raw (client-controllable) request id,
         # so colliding x-request-id headers can't cross-wire two exports.
         key = f"{req.request_id}:{uuid.uuid4().hex[:12]}"
         cp = max(1, self.cfg.chunk_pages)
-        ids = list(req.block_ids[:n_full])
-        n_chunks = -(-n_full // cp)
+        ids = list(req.block_ids[skip:n_full])
+        n_chunks = -(-len(ids) // cp) if ids else 0
         # Int8 POOLS always ship the q8 wire form: the pool bytes go out
         # directly — lossless wrt the pool, half the staging bytes, no
         # quantize work. Float pools use it only when opted in.
@@ -296,9 +358,19 @@ class TPUConnector:
         snaps = [
             snap_fn(ids[j * cp : (j + 1) * cp], cp) for j in range(n_chunks)
         ]
-        threading.Thread(
-            target=self._stage_chunks, args=(key, snaps), daemon=True
-        ).start()
+        if snaps and self._local_enabled:
+            # Short retention: a legit in-process claim follows the
+            # prefill response within milliseconds; a CROSS-host consumer
+            # never claims, so pinning device snapshots for the full
+            # lease would be a real HBM tax per export.
+            deadline = time.monotonic() + min(self.cfg.lease_ms / 1e3, 5.0)
+            with self._local_lock:
+                self._prune_local_locked()
+                self._local_exports[key] = (deadline, snaps)
+        if snaps:
+            threading.Thread(
+                target=self._stage_chunks, args=(key, snaps), daemon=True
+            ).start()
         self.exported_requests += 1
         return {
             "remote_host": self.cfg.host,
@@ -308,15 +380,51 @@ class TPUConnector:
             "page_size": page,
             "chunk_pages": cp,
             "num_chunks": n_chunks,
+            # First exported page (pages [0, start_page) were declared
+            # cached on the consumer and are not staged).
+            "start_page": skip,
         }
+
+    # Cross-host consumers never claim; cap retained pending exports so a
+    # remote-only traffic burst bounds HBM at ~N snapshots until pruning.
+    _MAX_LOCAL_PENDING = 16
+
+    def _prune_local_locked(self) -> None:
+        now = time.monotonic()
+        for k in [k for k, (dl, _) in self._local_exports.items() if dl < now]:
+            del self._local_exports[k]
+        while len(self._local_exports) > self._MAX_LOCAL_PENDING:
+            self._local_exports.pop(next(iter(self._local_exports)))
+
+    def claim_local(self, key: str) -> list | None:
+        """In-process consumer leg of the single-host fast path: take the
+        pending device snapshots for ``key`` (stops any remaining host
+        staging; already-registered chunks are freed by the consumer's
+        ordinary free-notify). Entries live until claimed, expiry (5s),
+        or the pending cap evicts them."""
+        with self._local_lock:
+            self._prune_local_locked()
+            entry = self._local_exports.pop(key, None)
+            if entry is not None and key in self._staging_active:
+                # Marker only matters while the staging thread runs (it
+                # is the thread's early-exit signal); setting it for an
+                # already-finished key would leak the entry forever.
+                self._local_claimed.add(key)
+        return None if entry is None else entry[1]
 
     def _stage_chunks(self, key: str, snaps: list) -> None:
         """Staging thread: download each snapshot and register it. A failed
         download leaves later chunks unregistered; the consumer's pull wait
         times out and its load-failure policy decides."""
         t0 = time.monotonic()
+        with self._local_lock:
+            self._staging_active.add(key)
         try:
             for j, snap in enumerate(snaps):
+                if key in self._local_claimed:
+                    # An in-process consumer took the device path; the
+                    # remaining HBM->host downloads would be pure waste.
+                    break
                 if isinstance(snap, tuple):  # int8 transfer: (q8, scales)
                     q8, scales = (self.runner.download_pages(s) for s in snap)
                     orig = self.runner.staging_dtype_name
@@ -342,6 +450,12 @@ class TPUConnector:
             log.exception("KV export staging failed for %s", key)
         finally:
             self.last_stage_ms = (time.monotonic() - t0) * 1e3
+            with self._local_lock:
+                # The claim marker is only needed while this thread runs;
+                # the pending-export entry itself lives until claimed,
+                # expiry, or cap eviction (claim_local prunes).
+                self._staging_active.discard(key)
+                self._local_claimed.discard(key)
 
     # ------------------------------------------------------------------ #
     # consumer side
@@ -380,6 +494,16 @@ class TPUConnector:
         # float pools.
         pool_quant = getattr(self.runner, "kv_quantized", False)
         n_chunks = int(params.get("num_chunks", 0) or 0)
+        sp = int(params.get("start_page", 0) or 0)
+        if sp > n_full:
+            raise ValueError(f"start_page {sp} > num_full_pages {n_full}")
+        if n_chunks <= 0 and "start_page" in params:
+            # Byte-diet empty export: everything up to n_full was declared
+            # cached here; nothing to pull.
+            return PulledBundle(
+                pages=None, hashes=hashes[:n_full], nbytes=0,
+                host=host, port=port, key=key, start_page=n_full,
+            )
         if n_chunks <= 0:
             # Legacy single-bundle producer.
             blob = shipper_mod.pull(host, port, key)
@@ -400,11 +524,36 @@ class TPUConnector:
                 host=host, port=port, key=key,
             )
         cp = int(params["chunk_pages"])
-        if cp <= 0 or -(-n_full // cp) != n_chunks:
+        if cp <= 0 or -(-(n_full - sp) // cp) != n_chunks:
             raise ValueError(
-                f"chunk geometry mismatch: {n_full} pages / {cp} per chunk "
-                f"!= {n_chunks} chunks"
+                f"chunk geometry mismatch: {n_full - sp} pages / {cp} per "
+                f"chunk != {n_chunks} chunks"
             )
+        # Single-host xPyD fast path: an in-process producer's device
+        # snapshots are claimed directly — no host staging, no wire
+        # bytes (production shape: reference single-host/pd recipes; on
+        # a multi-chip host this is the ICI copy).
+        if self.cfg.local_fastpath and not getattr(self.runner, "_multihost", False):
+            producer = _lookup_local(host, port)
+            if producer is not None:
+                snaps = producer.claim_local(key)
+                if snaps is not None:
+                    self.local_imports += 1
+                    return PulledBundle(
+                        pages=None, hashes=hashes[:n_full], nbytes=0,
+                        host=host, port=port, key=key,
+                        keys=[chunk_key(key, j) for j in range(n_chunks)],
+                        device_chunks=snaps, np_chunks=[], chunk_pages=cp,
+                        start_page=sp,
+                    )
+        # Consumer-side byte diet: skip whole chunks the local prefix
+        # cache already holds (the producer may have exported more than
+        # needed — e.g. no probe ran, or the cache grew since).
+        skip0 = 0
+        while skip0 < n_full and self.allocator.has_cached(hashes[skip0]):
+            skip0 += 1
+        j0 = max(0, (skip0 - sp) // cp) if skip0 > sp else 0
+        start_page = sp + j0 * cp
         # Multi-host consumer: the fetch executor thread must NOT touch
         # device state (uploads to process-local scratch cannot feed the
         # lockstep global-mesh scatter) — keep host chunks only; the
@@ -420,7 +569,7 @@ class TPUConnector:
         per_chunk_s = min(self.cfg.lease_ms / 1e3, 20.0)
         hard_deadline = time.monotonic() + per_chunk_s + 2.0 * n_chunks
         np_chunks, dev_chunks, nbytes = [], [], 0
-        for j in range(n_chunks):
+        for j in range(j0, n_chunks):
             blob = shipper_mod.pull_wait(
                 host, port, chunk_key(key, j),
                 min(time.monotonic() + per_chunk_s, hard_deadline),
@@ -459,6 +608,7 @@ class TPUConnector:
             host=host, port=port, key=key,
             keys=[chunk_key(key, j) for j in range(n_chunks)],
             device_chunks=dev_chunks, np_chunks=np_chunks, chunk_pages=cp,
+            start_page=start_page,
         )
 
     def fetch_remote_policy(
@@ -499,10 +649,22 @@ class TPUConnector:
         hashes = bundle.hashes
         n_full = len(hashes)
         # Skip a leading run already cached locally (idempotent re-imports,
-        # shared prefixes). Only a prefix run is usable anyway.
+        # shared prefixes). Only a prefix run is usable anyway. Pages
+        # before start_page were never pulled (byte diet): if the cache
+        # evicted some of them since the probe, the import still lands
+        # correct content from start_page on (the chain below the missing
+        # page simply isn't reachable until recomputed — same degradation
+        # as any partial-prefix state).
         skip = 0
         while skip < n_full and self.allocator.has_cached(hashes[skip]):
             skip += 1
+        skip = max(skip, bundle.start_page)
+        if bundle.device_chunks and not bundle.np_chunks:
+            # Local-fastpath bundles keep no host chunks for the
+            # partial-overlap fallback; re-importing from start_page is
+            # correct regardless (duplicate hashes dedup at commit and
+            # the spare pages free right after).
+            skip = bundle.start_page
         adopted = 0
         if skip < n_full:
             try:
@@ -512,20 +674,37 @@ class TPUConnector:
                 log.warning("no free pages for KV import, recomputing: %s", e)
                 self._notify_free_async(bundle)
                 return 0
-            if bundle.device_chunks and skip == 0:
+            if bundle.device_chunks:
                 # Pipelined path: chunks are already on device (uploaded by
                 # the fetch thread) — only fast device->pool scatters here.
                 cp = bundle.chunk_pages
                 for j, dev in enumerate(bundle.device_chunks):
-                    ids_j = page_ids[j * cp : (j + 1) * cp]
-                    if len(ids_j) < cp:
-                        # Producer padded the last chunk by repeating its
-                        # final page; aiming the pad slots at the last real
-                        # id makes the duplicate write idempotent.
-                        ids_j = ids_j + [ids_j[-1]] * (cp - len(ids_j))
-                    self.runner.scatter_pages_from_device(ids_j, dev)
-            else:
-                want = bundle.host_pages(n_full)[:, skip:]
+                    p0 = bundle.start_page + j * cp
+                    if p0 + cp <= skip:
+                        continue  # wholly cached since the fetch decision
+                    if p0 >= skip:
+                        ids_j = page_ids[p0 - skip : p0 - skip + cp]
+                        if len(ids_j) < cp:
+                            # Producer padded the last chunk by repeating
+                            # its final page; aiming the pad slots at the
+                            # last real id makes the duplicate write
+                            # idempotent.
+                            ids_j = ids_j + [ids_j[-1]] * (cp - len(ids_j))
+                        self.runner.scatter_pages_from_device(ids_j, dev)
+                    else:
+                        # Partial overlap (cache grew between fetch and
+                        # apply): host-path scatter of the uncached tail.
+                        want = PulledBundle._dequant_chunk(
+                            bundle.np_chunks[j]
+                        )[:, skip - p0 :]
+                        take = min(p0 + cp, n_full) - skip
+                        self.runner.scatter_pages(
+                            page_ids[:take], want[:, :take]
+                        )
+            elif skip < n_full and (
+                bundle.pages is not None or bundle.np_chunks
+            ):
+                want = bundle.host_pages(n_full)[:, skip - bundle.start_page :]
                 self.runner.scatter_pages(page_ids, want)
             parent = None if skip == 0 else hashes[skip - 1]
             for i, pid in enumerate(page_ids):
@@ -569,6 +748,7 @@ class TPUConnector:
             "imported_requests": self.imported_requests,
             "imported_bytes": self.imported_bytes,
             "import_failures": self.import_failures,
+            "local_imports": self.local_imports,
             "last_stage_ms": round(self.last_stage_ms, 1),
             "last_fetch_ms": round(self.last_fetch_ms, 1),
             "last_apply_ms": round(self.last_apply_ms, 1),
@@ -581,5 +761,9 @@ class TPUConnector:
 
     def close(self) -> None:
         if self.server is not None:
+            if _LOCAL_PRODUCERS.get(self.server.port) is self:
+                del _LOCAL_PRODUCERS[self.server.port]
             self.server.close()
             self.server = None
+        with self._local_lock:
+            self._local_exports.clear()

@@ -343,6 +343,38 @@ async def handle_embeddings(request: web.Request) -> web.Response:
     })
 
 
+async def handle_cache_probe(request: web.Request) -> web.Response:
+    """POST /v1/cache/probe — the P/D byte-diet question: how many
+    leading FULL pages of this request's prompt are already cached here?
+
+    Accepts the same body shape as /v1/completions ("prompt") or
+    /v1/chat/completions ("messages"); the sidecar calls it on the local
+    decode engine before phase 1 so the prefiller can skip staging pages
+    the decode side already holds (reference disagg decider,
+    scheduling.md:113)."""
+    engine: AsyncEngine = request.app[ENGINE_KEY]
+    tokenizer = request.app[TOK_KEY]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error(400, f"invalid JSON: {e}")
+    try:
+        if body.get("messages") is not None:
+            ids = _chat_prompt_ids(tokenizer, body["messages"])
+        elif body.get("prompt") is not None:
+            ids = _tokenize_prompt(tokenizer, body["prompt"])
+        else:
+            return _error(400, "prompt or messages is required")
+    except (ValueError, TypeError) as e:
+        return _error(400, str(e))
+    eng = engine.engine
+    return web.json_response({
+        "cached_full_pages": eng.cached_prefix_pages(ids),
+        "page_size": eng.allocator.page_size,
+        "num_full_pages": len(ids) // eng.allocator.page_size,
+    })
+
+
 async def handle_completions_render(request: web.Request) -> web.Response:
     """vLLM-style render: return the token ids the engine would see."""
     tokenizer = request.app[TOK_KEY]
@@ -1032,6 +1064,7 @@ def build_app(
             web.post("/v1/chat/completions", handle_chat),
             web.post("/v1/completions/render", handle_completions_render),
             web.post("/v1/chat/completions/render", handle_chat_render),
+            web.post("/v1/cache/probe", handle_cache_probe),
             *_responses_routes(),
             web.post("/admin/pause", handle_admin_pause),
             web.post("/admin/resume", handle_admin_resume),

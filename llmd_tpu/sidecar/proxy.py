@@ -56,6 +56,12 @@ class SidecarConfig:
     prefill_timeout_s: float = 600.0
     # lease renewal cadence; 2/3 of the reference's 30s default lease
     heartbeat_s: float = 10.0
+    # P/D byte diet: probe the local decode engine's prefix cache before
+    # phase 1 and tell the prefiller to skip staging the cached pages
+    # (the reference decider's "how much of the prompt is cached on D?",
+    # scheduling.md:113). Probe failure degrades to a full transfer.
+    probe_prefix_cache: bool = True
+    probe_timeout_s: float = 2.0
 
 
 def _fwd_headers(headers) -> dict[str, str]:
@@ -282,11 +288,16 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         heartbeat = None
         dec_span = None
         try:
+            skip_pages = 0
+            if cfg.probe_prefix_cache:
+                skip_pages = await probe_cached_pages(session, body)
+                root.set("llm_d.decision.skip_pages", skip_pages)
             pre_span = tracer.start_span("sidecar.prefill", parent=root)
             try:
                 params = await run_prefill(
                     session, prefiller, request.path, body,
                     ec_host=request.get("ec_host"),
+                    skip_pages=skip_pages,
                 )
                 pre_span.set("llm_d.prefill.remote", params is not None)
             except BaseException as e:
@@ -324,16 +335,37 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
                 dec_span.end()
             root.end()
 
+    async def probe_cached_pages(
+        session: aiohttp.ClientSession, body: dict
+    ) -> int:
+        """Byte-diet phase 0: ask the LOCAL decode engine how many leading
+        full pages of this prompt it already caches; 0 on any failure
+        (full transfer, never an error)."""
+        try:
+            async with session.post(
+                local_base + "/v1/cache/probe", json=body,
+                timeout=aiohttp.ClientTimeout(total=cfg.probe_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    return 0
+                data = await resp.json()
+                return max(int(data.get("cached_full_pages", 0) or 0), 0)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return 0
+
     async def run_prefill(
         session: aiohttp.ClientSession, prefiller: str, path: str, body: dict,
         ec_host: str | None = None,
+        skip_pages: int = 0,
     ) -> dict | None:
         """Phase 1. Returns kv_transfer_params, or None => decoder-only."""
         pre_body = dict(body)
         pre_body["max_tokens"] = 1
         pre_body.pop("max_completion_tokens", None)
         pre_body["stream"] = False
-        pre_body["kv_transfer_params"] = {"do_remote_decode": True}
+        pre_body["kv_transfer_params"] = {
+            "do_remote_decode": True, "skip_pages": skip_pages,
+        }
         url = f"http://{prefiller}{path}"
         headers = {HDR_EC_HOST: ec_host} if ec_host else None
         try:

@@ -109,6 +109,10 @@ def make_engine(kv_role=None, seed=0, page=4, num_blocks=64, dtype="float32"):
         seed=seed,
         kv_role=kv_role,
         kv_transfer_port=0,  # ephemeral
+        # This module tests the WIRE protocol (both engines share the
+        # pytest process); the in-process device fast path is covered by
+        # tests/test_pd_e2e.py::test_pd_local_fastpath*.
+        kv_local_fastpath=False,
     )
     return LLMEngine(cfg)
 
@@ -469,6 +473,7 @@ def test_pd_int8_transfer_end_to_end():
             kv_role=role,
             kv_transfer_port=0,
             kv_transfer_dtype=dtype_,
+            kv_local_fastpath=False,
         )
         return LLMEngine(cfg)
 

@@ -29,13 +29,17 @@ def anyio_backend():
     return "asyncio"
 
 
-def make_engine(kv_role):
+def make_engine(kv_role, local_fastpath=False):
     cfg = EngineConfig(
         model=tiny_model_config(vocab_size=512, max_model_len=128),
         cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
         scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
         kv_role=kv_role,
         kv_transfer_port=0,
+        # This suite exercises the WIRE protocol; both engines share the
+        # test process, so the single-host fast path must be opted out
+        # (test_pd_local_fastpath covers it).
+        kv_local_fastpath=local_fastpath,
     )
     return LLMEngine(cfg)
 
@@ -126,6 +130,144 @@ async def test_pd_two_phase_flow(pd_stack):
     assert text_pd == text_agg
 
 
+async def test_pd_cached_prefix_byte_diet(pd_stack):
+    """The byte diet: a repeat request whose prompt the decode engine
+    already fully caches transfers ZERO KV bytes — the sidecar's probe
+    (/v1/cache/probe) tells the prefiller to skip staging everything
+    (reference disagg decider question, scheduling.md:113)."""
+    rc, prefill_engine, decode_engine, prefill_srv, sidecar_srv = pd_stack
+    body = {"prompt": PROMPT, "max_tokens": 6, "temperature": 0.0}
+    r1 = await rc.post("/v1/completions", json=body)
+    assert r1.status == 200
+    text1 = (await r1.json())["choices"][0]["text"]
+    bytes_after_1 = prefill_engine.kv_connector.exported_bytes
+    imported_after_1 = decode_engine.kv_connector.imported_bytes
+    assert bytes_after_1 > 0
+
+    r2 = await rc.post("/v1/completions", json=body)
+    assert r2.status == 200
+    text2 = (await r2.json())["choices"][0]["text"]
+    assert text2 == text1
+    # Second transfer staged and pulled NOTHING (empty export).
+    assert prefill_engine.kv_connector.exported_bytes == bytes_after_1
+    assert decode_engine.kv_connector.imported_bytes == imported_after_1
+    assert decode_engine.kv_connector.imported_requests == 2
+    assert decode_engine.kv_connector.import_failures == 0
+
+
+async def test_pd_partial_cached_prefix(pd_stack):
+    """A prompt sharing a prefix with an earlier one transfers only the
+    uncached tail pages (producer skips the probed prefix)."""
+    rc, prefill_engine, decode_engine, *_ = pd_stack
+    # Fine-grained chunks so the per-chunk padding doesn't mask the
+    # savings at this tiny prompt scale.
+    prefill_engine.kv_connector.cfg.chunk_pages = 2
+    r1 = await rc.post(
+        "/v1/completions",
+        json={"prompt": PROMPT, "max_tokens": 4, "temperature": 0.0},
+    )
+    assert r1.status == 200
+    bytes_after_1 = prefill_engine.kv_connector.exported_bytes
+    # Same leading text, longer tail: only tail pages should move.
+    r2 = await rc.post(
+        "/v1/completions",
+        json={
+            "prompt": PROMPT + " with a brand new suffix to extend it",
+            "max_tokens": 4, "temperature": 0.0,
+        },
+    )
+    assert r2.status == 200
+    delta = prefill_engine.kv_connector.exported_bytes - bytes_after_1
+    assert 0 < delta < bytes_after_1, (delta, bytes_after_1)
+    assert decode_engine.kv_connector.import_failures == 0
+
+
+async def test_pd_local_fastpath():
+    """Single-host xPyD: an in-process consumer claims the producer's
+    device snapshots directly — zero wire bytes, token parity, and the
+    producer's host staging stops early."""
+    import asyncio
+
+    from llmd_tpu.engine import SamplingParams
+
+    prod = make_engine("kv_producer", local_fastpath=True)
+    cons = make_engine("kv_consumer", local_fastpath=True)
+    ref = make_engine(None)
+    try:
+        prompt = list(range(1, 15))
+        prod.add_request(
+            prompt,
+            SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = None
+        while prod.has_work():
+            for o in prod.step():
+                if o.kv_transfer_params:
+                    params = o.kv_transfer_params
+        assert params
+        sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+        ref_out = list(ref.generate([prompt], sp).values())[0]
+        cons.add_request(prompt, sp, kv_transfer_params=params)
+        toks = []
+        while cons.has_work():
+            for o in cons.step():
+                toks.extend(o.new_token_ids)
+        assert toks == ref_out, (toks, ref_out)
+        st = cons.kv_connector.stats()
+        assert st["local_imports"] == 1, st
+        assert st["imported_bytes"] == 0, st
+        assert st["import_failures"] == 0, st
+        # Give the free-notify thread a beat, then confirm the producer
+        # dropped its pending device snapshots.
+        await asyncio.sleep(0.3)
+        assert not prod.kv_connector._local_exports
+    finally:
+        for e in (prod, cons, ref):
+            e.close()
+
+
+async def test_pd_local_fastpath_int8_wire_to_float_pool():
+    """Local claim of q8 device snapshots (int8 transfer encoding) into a
+    float consumer pool: on-device dequant, near-parity tokens."""
+    from llmd_tpu.engine import SamplingParams
+
+    prod_cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+        kv_role="kv_producer", kv_transfer_port=0,
+        kv_transfer_dtype="int8", kv_local_fastpath=True,
+    )
+    prod = LLMEngine(prod_cfg)
+    cons = make_engine("kv_consumer", local_fastpath=True)
+    try:
+        prompt = list(range(1, 15))
+        prod.add_request(
+            prompt,
+            SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = None
+        while prod.has_work():
+            for o in prod.step():
+                if o.kv_transfer_params:
+                    params = o.kv_transfer_params
+        sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+        cons.add_request(prompt, sp, kv_transfer_params=params)
+        toks = []
+        while cons.has_work():
+            for o in cons.step():
+                toks.extend(o.new_token_ids)
+        st = cons.kv_connector.stats()
+        assert st["local_imports"] == 1, st
+        assert st["import_failures"] == 0, st
+        assert len(toks) == 6
+    finally:
+        for e in (prod, cons):
+            e.close()
+
+
 async def test_pd_streaming(pd_stack):
     rc, prefill_engine, decode_engine, *_ = pd_stack
     r = await rc.post(
@@ -178,6 +320,9 @@ async def pd_stack_short_lease():
             kv_role=kv_role,
             kv_transfer_port=0,
             kv_lease_ms=lease_ms,
+            # Wire-failure seams under test: opt out of the in-process
+            # device fast path.
+            kv_local_fastpath=False,
         ))
 
     prefill_engine = mk("kv_producer", 400)
