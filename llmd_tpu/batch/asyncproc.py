@@ -432,3 +432,138 @@ class AsyncProcessor:
         ]
         lines.append(f"llmd_async_queue_depth {len(self.queue)}")
         return "\n".join(lines) + "\n"
+
+
+# ---- standalone deployment surface ----
+
+
+def build_asyncproc_app(queue: DeadlineQueue, proc: AsyncProcessor):
+    """Tiny HTTP surface for the standalone processor Deployment
+    (deploy/guides/asynchronous-processing): enqueue + stats."""
+    from aiohttp import web
+
+    async def enqueue(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"error": "body must be a JSON object"}, status=400
+            )
+        payload = body.get("payload")
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {"error": "payload (object) is required"}, status=400
+            )
+        try:
+            deadline_s = float(body.get("deadline_s", 600.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "deadline_s must be a number"}, status=400
+            )
+        rid = body.get("request_id") or ""
+        await queue.put(
+            payload,
+            deadline=time.time() + deadline_s,
+            url_path=body.get("url_path", "/v1/completions"),
+            request_id=rid,
+        )
+        return web.json_response({"queued": True, "depth": len(queue)})
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=proc.metrics_text())
+
+    app = web.Application()
+    app.router.add_post("/enqueue", enqueue)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def _build_gate(args):
+    if args.gate == "constant":
+        return ConstantGate()
+    if args.gate == "budget-file":
+        return BudgetFileGate(args.budget_file)
+    if args.gate == "saturation":
+        return SaturationGate(
+            args.metrics_url, threshold=args.gate_threshold
+        )
+    if args.gate == "budget":
+        return BudgetMetricsGate(args.metrics_url)
+    raise SystemExit(f"unknown gate {args.gate!r}")
+
+
+def main(argv=None) -> None:
+    """Standalone async processor: queue+gate+workers with an HTTP
+    enqueue surface; results append to a JSONL file."""
+    import argparse
+
+    from aiohttp import web
+
+    p = argparse.ArgumentParser(prog="llmd-asyncproc")
+    p.add_argument("--router-url", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8210)
+    p.add_argument("--queue-db", default=None,
+                   help="sqlite path; persisted queue survives restarts")
+    p.add_argument("--results-file", default=None, help="JSONL results log")
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument(
+        "--gate", default="constant",
+        choices=["constant", "budget-file", "saturation", "budget"],
+    )
+    p.add_argument("--gate-threshold", type=float, default=0.8)
+    p.add_argument("--budget-file", default=None)
+    p.add_argument("--metrics-url", default=None,
+                   help="router /metrics URL for the saturation/budget gates")
+    args = p.parse_args(argv)
+    if args.gate in ("saturation", "budget") and not args.metrics_url:
+        args.metrics_url = args.router_url.rstrip("/") + "/metrics"
+    if args.gate == "budget-file" and not args.budget_file:
+        p.error("--gate budget-file requires --budget-file")
+
+    logging.basicConfig(level=logging.INFO)
+    queue = DeadlineQueue(args.queue_db)
+
+    async def amain() -> None:
+        results_fh = open(args.results_file, "a") if args.results_file else None
+
+        async def on_result(req: QueuedRequest, result: dict) -> None:
+            if results_fh is not None:
+                line = json.dumps({"request_id": req.request_id, **result})
+
+                def write() -> None:
+                    results_fh.write(line + "\n")
+                    results_fh.flush()
+
+                # Off-loop: a slow results disk must not stall the worker
+                # pool / enqueue surface on every flush.
+                await asyncio.get_running_loop().run_in_executor(None, write)
+
+        proc = AsyncProcessor(
+            queue,
+            AsyncProcessorConfig(router_url=args.router_url,
+                                 workers=args.workers),
+            gate=_build_gate(args),
+            on_result=on_result if results_fh else None,
+        )
+        app = build_asyncproc_app(queue, proc)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, args.host, args.port).start()
+        log.info("asyncproc on %s:%d -> %s (gate=%s, %d workers)",
+                 args.host, args.port, args.router_url, args.gate,
+                 args.workers)
+        try:
+            await proc.run()
+        finally:
+            await runner.cleanup()
+            if results_fh is not None:
+                results_fh.close()
+
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -21,8 +21,10 @@ def main() -> None:
     ap.add_argument("--data-parallel-size", type=int, default=1)
     ap.add_argument(
         "--kv-connector", default="tpu",
-        help="transfer protocol family (tpu = kvship pull model)",
+        help="transfer protocol family: tpu/nixlv2 (two-phase kvship "
+        "pull) or sglang (concurrent bootstrap rendezvous)",
     )
+    ap.add_argument("--sglang-bootstrap-port", type=int, default=8998)
     ap.add_argument("--prefill-timeout", type=float, default=600.0)
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--otlp-traces-endpoint", default=None)
@@ -49,6 +51,7 @@ def main() -> None:
         vllm_port=args.vllm_port,
         data_parallel_size=args.data_parallel_size,
         connector=args.kv_connector,
+        sglang_bootstrap_port=args.sglang_bootstrap_port,
         prefill_timeout_s=args.prefill_timeout,
     )
     asyncio.run(run_sidecar(cfg))

@@ -52,7 +52,16 @@ class SidecarConfig:
     port: int = 8000  # first listen port
     vllm_port: int = 8200  # first local engine port
     data_parallel_size: int = 1
-    connector: str = "tpu"  # transfer protocol family (tpu kvship)
+    # Transfer protocol family the local model server speaks (reference
+    # --kv-connector=nixlv2|sglang, wide-ep decode.yaml:29-39):
+    #   "tpu" / "nixlv2": two-phase sequential — prefill with
+    #     max_tokens=1, capture kv_transfer_params, inject into decode.
+    #   "sglang": concurrent — inject bootstrap_host/port/room into BOTH
+    #     requests, fire prefill asynchronously (never cancelled), send
+    #     decode immediately; engines rendezvous out-of-band via the
+    #     bootstrap room (disaggregation/README.md:104-131).
+    connector: str = "tpu"
+    sglang_bootstrap_port: int = 8998
     prefill_timeout_s: float = 600.0
     # lease renewal cadence; 2/3 of the reference's 30s default lease
     heartbeat_s: float = 10.0
@@ -173,6 +182,10 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
             if encoder and isinstance(body, dict):
                 body = await run_encode(session, encoder, body, request)
             if prefiller:
+                if cfg.connector == "sglang":
+                    return await sglang_concurrent(
+                        request, session, prefiller, body
+                    )
                 return await two_phase(request, session, prefiller, body)
             # E-only (E/PD topology without a separate prefiller): forward
             # the embedding-substituted body to the local engine.
@@ -353,6 +366,76 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
             return 0
 
+    async def sglang_concurrent(
+        request: web.Request,
+        session: aiohttp.ClientSession,
+        prefiller: str,
+        body: dict,
+    ) -> web.StreamResponse:
+        """SGLang-protocol disaggregation: inject identical
+        bootstrap_host/port/room into BOTH requests, fire the prefill
+        asynchronously (detached — the reference runs it in a goroutine
+        under context.WithoutCancel so a fast decode can't cancel it),
+        and forward the decode immediately. The engines coordinate the
+        KV transfer out-of-band via the bootstrap room
+        (disaggregation/README.md:104-131)."""
+        import random
+
+        tracer = get_tracer()
+        root = tracer.start_span(
+            "sidecar.sglang_disagg",
+            traceparent=request.headers.get("traceparent"),
+            kind="SPAN_KIND_SERVER",
+        )
+        root.set("llm_d.prefiller", prefiller)
+        boot = {
+            "bootstrap_host": prefiller.rsplit(":", 1)[0],
+            "bootstrap_port": cfg.sglang_bootstrap_port,
+            "bootstrap_room": random.getrandbits(63),
+        }
+        root.set("llm_d.sglang.bootstrap_room", boot["bootstrap_room"])
+        pre_body = dict(body)
+        pre_body.update(boot)
+        pre_body["stream"] = False
+        dec_body = dict(body)
+        dec_body.update(boot)
+
+        async def fire_prefill() -> None:
+            try:
+                async with session.post(
+                    f"http://{prefiller}{request.path}", json=pre_body,
+                    timeout=aiohttp.ClientTimeout(total=cfg.prefill_timeout_s),
+                ) as resp:
+                    await resp.read()
+                    if resp.status != 200:
+                        log.warning(
+                            "sglang prefill at %s returned %d",
+                            prefiller, resp.status,
+                        )
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                log.warning("sglang prefill at %s failed: %s", prefiller, e)
+
+        # Detached: deliberately not awaited before the decode leg.
+        prefill_task = asyncio.get_running_loop().create_task(fire_prefill())
+        # Keep a reference so the task isn't garbage-collected mid-flight
+        # (set pre-created at app build — frozen apps refuse mutation).
+        request.app["sglang_tasks"].add(prefill_task)
+        prefill_task.add_done_callback(
+            request.app["sglang_tasks"].discard
+        )
+        try:
+            headers = _fwd_headers(request.headers)
+            async with session.post(
+                local_base + request.path_qs, headers=headers, json=dec_body,
+            ) as upstream:
+                root.set("http.status_code", upstream.status)
+                return await _relay(request, upstream)
+        except BaseException as e:
+            root.error(str(e) or type(e).__name__)
+            raise
+        finally:
+            root.end()
+
     async def run_prefill(
         session: aiohttp.ClientSession, prefiller: str, path: str, body: dict,
         ec_host: str | None = None,
@@ -409,6 +492,7 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         return resp
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["sglang_tasks"] = set()  # live detached prefill tasks (sglang mode)
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     app.router.add_route("*", "/{tail:.*}", handle)

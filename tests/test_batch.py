@@ -462,3 +462,63 @@ async def test_worker_survives_malformed_json_response():
     proc.stop()
     await task
     await srv.close()
+
+
+async def test_asyncproc_http_surface():
+    """The standalone processor's enqueue + metrics surface
+    (deploy/guides/asynchronous-processing): enqueue over HTTP, dispatch
+    to the router, counters reflect the outcome."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llmd_tpu.batch.asyncproc import (
+        AsyncProcessor,
+        AsyncProcessorConfig,
+        DeadlineQueue,
+        build_asyncproc_app,
+    )
+
+    served: list = []
+
+    async def completions(request: web.Request) -> web.Response:
+        served.append(await request.json())
+        return web.json_response({"choices": [{"text": "ok"}]})
+
+    router_app = web.Application()
+    router_app.router.add_post("/v1/completions", completions)
+    router = TestServer(router_app)
+    await router.start_server()
+
+    queue = DeadlineQueue()
+    proc = AsyncProcessor(
+        queue,
+        AsyncProcessorConfig(
+            router_url=f"http://{router.host}:{router.port}", workers=2
+        ),
+    )
+    run_task = asyncio.create_task(proc.run())
+    client = TestClient(TestServer(build_asyncproc_app(queue, proc)))
+    await client.start_server()
+    try:
+        r = await client.post("/enqueue", json={
+            "payload": {"prompt": "hi", "max_tokens": 2},
+            "deadline_s": 60,
+        })
+        assert r.status == 200
+        bad = await client.post("/enqueue", json={"payload": "notdict"})
+        assert bad.status == 400
+        for _ in range(100):
+            if proc.stats["succeeded"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert proc.stats["succeeded"] == 1, proc.stats
+        assert served and served[0]["prompt"] == "hi"
+        m = await client.get("/metrics")
+        text = await m.text()
+        assert "llmd_async_succeeded_total 1" in text
+        assert "llmd_async_queue_depth 0" in text
+    finally:
+        proc.stop()
+        await run_task
+        await client.close()
+        await router.close()

@@ -1,0 +1,140 @@
+"""helm-template golden render of the llmd-tpu chart (the sibling of the
+kustomize render checks in test_deploy.py).
+
+The reference CI helm-templates every router chart combination and
+server-side-dry-runs the output (.github/workflows/
+ci-kustomize-dry-run.yaml:79-160); with no helm binary in this image the
+test renders via tests/helm_mini.py and asserts object shape."""
+
+import copy
+import pathlib
+
+import yaml
+
+from tests.helm_mini import render_chart
+
+CHART = pathlib.Path(__file__).resolve().parents[1] / "deploy" / "charts" / "llmd-tpu"
+
+
+def _values(**overrides) -> dict:
+    vals = yaml.safe_load((CHART / "values.yaml").read_text())
+    for key, sub in overrides.items():
+        if isinstance(sub, dict):
+            node = vals.setdefault(key, {})
+            node.update(sub)
+        else:
+            vals[key] = sub
+    return copy.deepcopy(vals)
+
+
+def _by_kind(docs):
+    out = {}
+    for d in docs:
+        out.setdefault(d["kind"], []).append(d)
+    return out
+
+
+def test_default_render_shape():
+    docs = render_chart(CHART, _values(), release_name="demo")
+    kinds = _by_kind(docs)
+    # Three planes + binding objects.
+    deploys = {d["metadata"]["name"] for d in kinds["Deployment"]}
+    assert deploys == {"demo-router", "demo-decode", "demo-prefill"}
+    assert {d["metadata"]["name"] for d in kinds["InferencePool"]} == {"demo-pool"}
+    assert "HTTPRoute" in kinds
+    # Router flags include discovery via the pool.
+    router = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "demo-router"
+    )
+    args = router["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--inference-pool=demo-pool" in args
+    # No monitoring/tracing objects by default.
+    assert "PodMonitor" not in kinds
+    assert not any(a.startswith("--otlp-traces-endpoint") for a in args)
+    # Decode pod fronts with the sidecar, prefill does not.
+    decode = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "demo-decode"
+    )
+    prefill = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "demo-prefill"
+    )
+    decode_containers = {
+        c["name"] for c in decode["spec"]["template"]["spec"]["containers"]
+    }
+    prefill_containers = {
+        c["name"] for c in prefill["spec"]["template"]["spec"]["containers"]
+    }
+    assert "routing-sidecar" in decode_containers
+    assert "routing-sidecar" not in prefill_containers
+
+
+def test_monitoring_and_tracing_render():
+    docs = render_chart(
+        CHART,
+        _values(
+            monitoring={"enabled": True, "labels": {"release": "prom"}},
+            tracing={"enabled": True, "sampleRatio": 0.25},
+            router={"resources": {"requests": {"cpu": "2"}}},
+        ),
+        release_name="obs",
+    )
+    kinds = _by_kind(docs)
+    monitors = {d["metadata"]["name"] for d in kinds["PodMonitor"]}
+    assert monitors == {"obs-router", "obs-decode", "obs-prefill"}
+    for d in kinds["PodMonitor"]:
+        assert d["metadata"]["labels"]["release"] == "prom"
+        ep = d["spec"]["podMetricsEndpoints"][0]
+        assert ep["path"] == "/metrics"
+        assert ep["interval"] == "15s"
+    router = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "obs-router"
+    )
+    c = router["spec"]["template"]["spec"]["containers"][0]
+    assert "--trace-sample-ratio=0.25" in c["args"]
+    assert any(a.startswith("--otlp-traces-endpoint=") for a in c["args"])
+    assert c["resources"]["requests"]["cpu"] == "2"
+    # Engine tiers get the tracing flags too.
+    decode = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "obs-decode"
+    )
+    dargs = decode["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert any(a.startswith("--otlp-traces-endpoint=") for a in dargs)
+
+
+def test_plane_toggles():
+    docs = render_chart(
+        CHART,
+        _values(
+            prefill={"enabled": False},
+            sidecar={"enabled": False},
+            httpRoute={"create": False},
+        ),
+        release_name="d",
+    )
+    kinds = _by_kind(docs)
+    deploys = {d["metadata"]["name"] for d in kinds["Deployment"]}
+    assert deploys == {"d-router", "d-decode"}
+    assert "HTTPRoute" not in kinds
+    decode = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "d-decode"
+    )
+    names = {c["name"] for c in decode["spec"]["template"]["spec"]["containers"]}
+    assert "routing-sidecar" not in names
+
+
+def test_quantization_and_dbo_flags():
+    docs = render_chart(
+        CHART,
+        _values(
+            model={"quantization": "int8"},
+            decode={"enableDbo": True},
+        ),
+        release_name="q",
+    )
+    kinds = _by_kind(docs)
+    decode = next(
+        d for d in kinds["Deployment"] if d["metadata"]["name"] == "q-decode"
+    )
+    args = decode["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--quantization=int8" in args
+    assert "--enable-dbo" in args

@@ -241,6 +241,7 @@ async def test_pd_local_fastpath_int8_wire_to_float_pool():
     )
     prod = LLMEngine(prod_cfg)
     cons = make_engine("kv_consumer", local_fastpath=True)
+    ref = make_engine(None)
     try:
         prompt = list(range(1, 15))
         prod.add_request(
@@ -254,6 +255,7 @@ async def test_pd_local_fastpath_int8_wire_to_float_pool():
                 if o.kv_transfer_params:
                     params = o.kv_transfer_params
         sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+        ref_out = list(ref.generate([prompt], sp).values())[0]
         cons.add_request(prompt, sp, kv_transfer_params=params)
         toks = []
         while cons.has_work():
@@ -262,9 +264,13 @@ async def test_pd_local_fastpath_int8_wire_to_float_pool():
         st = cons.kv_connector.stats()
         assert st["local_imports"] == 1, st
         assert st["import_failures"] == 0, st
-        assert len(toks) == 6
+        # On-device q8 dequant into the float pool: ~0.4% per-row wire
+        # error, so near-parity with the aggregated reference — a garbage
+        # scatter would diverge immediately.
+        agree = sum(a == b for a, b in zip(toks, ref_out))
+        assert agree >= 5, (toks, ref_out)
     finally:
-        for e in (prod, cons):
+        for e in (prod, cons, ref):
             e.close()
 
 

@@ -1,0 +1,149 @@
+"""SGLang-protocol sidecar conformance (reference --kv-connector=sglang,
+disaggregation/README.md:104-131; wide-ep decode.yaml:29-39).
+
+A fake SGLang prefill server and a fake local decode server capture the
+request bodies; the sidecar must inject IDENTICAL bootstrap_host/port/room
+into both, fire the prefill concurrently (not gated on its completion),
+and relay the decode response.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu.epp.types import HDR_PREFILLER
+from llmd_tpu.sidecar.proxy import SidecarConfig, build_sidecar_app
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _capture_app(captured: list, name: str, delay_s: float = 0.0):
+    async def handler(request: web.Request) -> web.Response:
+        body = await request.json()
+        if delay_s:
+            await asyncio.sleep(delay_s)
+        captured.append((name, request.path, body))
+        return web.json_response({
+            "id": f"{name}-resp",
+            "choices": [{"text": f"from-{name}", "index": 0}],
+        })
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handler)
+    return app
+
+
+async def test_sglang_bootstrap_injection_both_legs():
+    captured: list = []
+    # Prefill is SLOW — the decode response must not wait for it.
+    prefill_srv = TestServer(_capture_app(captured, "prefill", delay_s=0.5))
+    decode_srv = TestServer(_capture_app(captured, "decode"))
+    await prefill_srv.start_server()
+    await decode_srv.start_server()
+    sidecar = TestClient(TestServer(build_sidecar_app(
+        SidecarConfig(
+            vllm_port=decode_srv.port, connector="sglang",
+            sglang_bootstrap_port=9876,
+        ),
+        rank=0,
+    )))
+    await sidecar.start_server()
+    try:
+        prefiller = f"{prefill_srv.host}:{prefill_srv.port}"
+        r = await sidecar.post(
+            "/v1/completions",
+            json={"prompt": "hello sglang", "max_tokens": 4, "stream": True},
+            headers={HDR_PREFILLER: prefiller},
+        )
+        assert r.status == 200
+        data = json.loads(await r.read())
+        # Client got the DECODE response, and got it before the slow
+        # prefill finished (decode captured first).
+        assert data["choices"][0]["text"] == "from-decode"
+        assert captured and captured[0][0] == "decode"
+        # Wait for the detached prefill to land.
+        for _ in range(50):
+            if len(captured) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(captured) == 2, captured
+        (_, dec_path, dec_body) = captured[0]
+        (_, pre_path, pre_body) = captured[1]
+        assert dec_path == pre_path == "/v1/completions"
+        # Identical bootstrap triplet on both legs.
+        for key in ("bootstrap_host", "bootstrap_port", "bootstrap_room"):
+            assert dec_body[key] == pre_body[key], key
+        assert dec_body["bootstrap_host"] == prefill_srv.host
+        assert dec_body["bootstrap_port"] == 9876
+        assert isinstance(dec_body["bootstrap_room"], int)
+        assert 0 <= dec_body["bootstrap_room"] < 2**63
+        # The prefill leg never streams; the decode leg keeps the
+        # client's own knobs.
+        assert pre_body["stream"] is False
+        assert dec_body["stream"] is True
+        assert pre_body["max_tokens"] == dec_body["max_tokens"] == 4
+    finally:
+        await sidecar.close()
+        await prefill_srv.close()
+        await decode_srv.close()
+
+
+async def test_sglang_rooms_unique_per_request():
+    captured: list = []
+    prefill_srv = TestServer(_capture_app(captured, "prefill"))
+    decode_srv = TestServer(_capture_app(captured, "decode"))
+    await prefill_srv.start_server()
+    await decode_srv.start_server()
+    sidecar = TestClient(TestServer(build_sidecar_app(
+        SidecarConfig(vllm_port=decode_srv.port, connector="sglang"), rank=0,
+    )))
+    await sidecar.start_server()
+    try:
+        prefiller = f"{prefill_srv.host}:{prefill_srv.port}"
+        rooms = set()
+        for _ in range(3):
+            r = await sidecar.post(
+                "/v1/completions",
+                json={"prompt": "x", "max_tokens": 1},
+                headers={HDR_PREFILLER: prefiller},
+            )
+            assert r.status == 200
+        for _ in range(50):
+            if len(captured) == 6:
+                break
+            await asyncio.sleep(0.05)
+        rooms = {body["bootstrap_room"] for _, _, body in captured}
+        assert len(rooms) == 3, rooms
+    finally:
+        await sidecar.close()
+        await prefill_srv.close()
+        await decode_srv.close()
+
+
+async def test_sglang_decoder_only_without_header():
+    """No x-prefiller-host-port: plain passthrough, no bootstrap fields."""
+    captured: list = []
+    decode_srv = TestServer(_capture_app(captured, "decode"))
+    await decode_srv.start_server()
+    sidecar = TestClient(TestServer(build_sidecar_app(
+        SidecarConfig(vllm_port=decode_srv.port, connector="sglang"), rank=0,
+    )))
+    await sidecar.start_server()
+    try:
+        r = await sidecar.post(
+            "/v1/completions", json={"prompt": "x", "max_tokens": 1}
+        )
+        assert r.status == 200
+        assert len(captured) == 1
+        assert "bootstrap_room" not in captured[0][2]
+    finally:
+        await sidecar.close()
+        await decode_srv.close()
