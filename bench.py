@@ -490,6 +490,10 @@ def _bench_dbo_delta():
         "dbo_off_ms": round(off * 1e3, 2),
         "dbo_on_ms": round(on * 1e3, 2),
         "substrate": "8-dev virtual CPU mesh (dp2 x tp4, ep8)",
+        # on > off here is EXPECTED, not a defect — the canonical
+        # explanation lives on ParallelConfig.enable_dbo (config.py);
+        # exactness is gated in tests/test_wide_ep.py.
+        "note": "dbo needs async ICI collectives; CPU mesh cannot overlap",
     }
 
 

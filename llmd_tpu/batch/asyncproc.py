@@ -28,6 +28,7 @@ import heapq
 import itertools
 import json
 import logging
+import math
 import random
 import sqlite3
 import threading
@@ -461,6 +462,12 @@ def build_asyncproc_app(queue: DeadlineQueue, proc: AsyncProcessor):
         except (TypeError, ValueError):
             return web.json_response(
                 {"error": "deadline_s must be a number"}, status=400
+            )
+        if not math.isfinite(deadline_s):
+            # json.loads accepts literal NaN/Infinity; a NaN deadline
+            # breaks the heap invariant for EVERY queued request.
+            return web.json_response(
+                {"error": "deadline_s must be finite"}, status=400
             )
         rid = body.get("request_id") or ""
         await queue.put(

@@ -281,7 +281,19 @@ class ParallelConfig:
     # Dual-batch overlap (the reference's --enable-dbo, wide-ep
     # decode.yaml:125-126): split each step into two half-batch chains
     # after the KV write so the EP all-to-all of one half overlaps the
-    # other half's attention compute. Exact numerics; needs an even batch.
+    # other half's attention compute. Needs an even batch; exact unless
+    # EP capacity binds (half-batch calls carry full-batch capacity).
+    #
+    # SUBSTRATE CONDITION: the win exists ONLY where collectives run
+    # asynchronously on a real inter-chip fabric (ICI/DCN) — XLA's
+    # latency-hiding scheduler then executes one half's all-to-all
+    # while the other half's attention computes. On the virtual CPU
+    # mesh there is nothing to hide (all "devices" share the host
+    # cores), so the split's fixed costs make steps ~1.6x SLOWER —
+    # bench.py's dbo extras record exactly that, and the runner warns
+    # when the flag is on without a TPU backend. Same story as the
+    # reference: --enable-dbo ships default-off and is enabled only on
+    # the multi-node GPU decode tier (decode.yaml:125-126).
     enable_dbo: bool = False
 
     @property

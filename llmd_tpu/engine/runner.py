@@ -32,6 +32,7 @@ axis, donated through every step so XLA updates it in place.
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass
 
 import jax
@@ -56,6 +57,8 @@ from llmd_tpu.parallel.mesh import MeshContext, kv_cache_spec, shard_params
 # each process's own pool shards).
 _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
 _OP_KV_GATHER, _OP_KV_SCATTER = 3, 4
+
+log = logging.getLogger(__name__)
 
 
 def _buckets(limit: int, start: int = 8) -> tuple[int, ...]:
@@ -173,6 +176,15 @@ class ModelRunner:
         self._multihost = dist.is_multihost()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
+        if config.parallel.enable_dbo and not ops._on_tpu():
+            # Never a silent regression: see ParallelConfig.enable_dbo
+            # for the full substrate condition.
+            log.warning(
+                "enable_dbo is ON without a TPU backend: dual-batch "
+                "overlap needs asynchronous ICI collectives to hide the "
+                "EP all-to-all; on a CPU mesh it SLOWS steps (bench.py "
+                "dbo extras; ParallelConfig.enable_dbo)"
+            )
         sched = config.scheduler
         self.batch_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
         self.prefill_buckets = sched.prefill_token_buckets or _buckets(
@@ -869,17 +881,25 @@ class ModelRunner:
         device first (the local fast path hands q8 device snapshots to
         any consumer pool dtype)."""
         self._require_single_host("scatter_pages_from_device (P/D staging)")
-        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        # Device chunks may come from ANOTHER engine's mesh (the local
+        # fast path claims the producer's snapshots; e.g. a tp=1
+        # producer feeding a tp=8 consumer): re-place them replicated on
+        # THIS runner's mesh so the donated-pool scatter sees consistent
+        # devices.
+        place = lambda x: jax.device_put(x, self.ctx.replicated)  # noqa: E731
+        ids = place(np.asarray(page_ids, np.int32))
         if isinstance(vals, tuple):
             if self.kv_quantized:
                 self.kv_cache = self._scatter_q8_direct(
-                    self.kv_cache, ids, vals[0], vals[1]
+                    self.kv_cache, ids, place(vals[0]), place(vals[1])
                 )
                 return
             vals = _dequantize_rows_q8(
                 vals[0], vals[1], self.staging_dtype_name
             )
-        self.kv_cache = self._scatter_canonical(self.kv_cache, ids, vals)
+        self.kv_cache = self._scatter_canonical(
+            self.kv_cache, ids, place(vals)
+        )
 
     def gather_pages(self, page_ids: list[int]) -> np.ndarray:
         """Stage pages HBM -> host: returns [L, n, K, page, 2D] ndarray.

@@ -149,6 +149,15 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         )
 
     async def on_cleanup(app: web.Application) -> None:
+        # Detached sglang prefills must not die with 'Session is closed':
+        # give in-flight ones a short grace, cancel stragglers, THEN
+        # close the shared session.
+        tasks = list(app["sglang_tasks"])
+        if tasks:
+            done, pending = await asyncio.wait(tasks, timeout=2.0)
+            for t in pending:
+                t.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
         await app["session"].close()
 
     async def handle(request: web.Request) -> web.StreamResponse:
@@ -400,10 +409,18 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         dec_body = dict(body)
         dec_body.update(boot)
 
+        # Encoder vouching survives the sglang path too: both legs carry
+        # the sidecar-set x-llm-d-ec-host header (the engine only pulls
+        # EC handles whose host matches it).
+        ec_headers = (
+            {HDR_EC_HOST: request["ec_host"]} if request.get("ec_host") else {}
+        )
+
         async def fire_prefill() -> None:
             try:
                 async with session.post(
                     f"http://{prefiller}{request.path}", json=pre_body,
+                    headers=ec_headers or None,
                     timeout=aiohttp.ClientTimeout(total=cfg.prefill_timeout_s),
                 ) as resp:
                     await resp.read()
@@ -412,7 +429,9 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
                             "sglang prefill at %s returned %d",
                             prefiller, resp.status,
                         )
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    RuntimeError) as e:
+                # RuntimeError: session closed mid-flight at shutdown.
                 log.warning("sglang prefill at %s failed: %s", prefiller, e)
 
         # Detached: deliberately not awaited before the decode leg.
@@ -425,6 +444,7 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         )
         try:
             headers = _fwd_headers(request.headers)
+            headers.update(ec_headers)
             async with session.post(
                 local_base + request.path_qs, headers=headers, json=dec_body,
             ) as upstream:

@@ -267,6 +267,7 @@ async def test_pd_local_fastpath_int8_wire_to_float_pool():
         # On-device q8 dequant into the float pool: ~0.4% per-row wire
         # error, so near-parity with the aggregated reference — a garbage
         # scatter would diverge immediately.
+        assert len(toks) == 6, toks
         agree = sum(a == b for a, b in zip(toks, ref_out))
         assert agree >= 5, (toks, ref_out)
     finally:
