@@ -18,10 +18,13 @@ extras (north-star shapes, BASELINE.json):
   weight_stream_gbps — effective weight-stream bandwidth of the bf16 run
                     (iterations/s x weight bytes): the roofline context
                     for a flat bf16 number.
-  kv_int8_tok_s_isl384_b128 / kv_bf16_tok_s_isl384_b96max — the int8 KV
-                    pool's capacity win at long context: 2x pages per
-                    HBM byte fits B=128 at ISL 384 where the bf16 pool
-                    tops out at B=96 (see bench_kv_int8_long_context).
+  kv_int8_tok_s_isl384_b128 / kv_bf16_tok_s_isl384_b96max — int8 KV
+                    pool at long context: 2x pages per HBM byte serves
+                    B=128 at ISL 384 where bf16 OOMs at compile; on this
+                    KV-read-bound chip that capacity does NOT raise
+                    tok/s (see bench_kv_int8_long_context for the
+                    honest framing; the pool's throughput win is
+                    pd_kvint8's wire TTFT).
   mla_moe_tok_s   — decode tok/s on a DeepSeek-V2-Lite-geometry MLA+MoE
                     model (depth cut to 8 to fit one chip's HBM), INT8
                     grouped-GEMM expert backend (the reference's FP8
@@ -157,17 +160,19 @@ def bench_mla_moe():
 
 
 def bench_kv_int8_long_context():
-    """The int8 KV pool's capacity story at long context (ISL 384 of a
-    512 window): B=128 needs 3,584 pages — the bf16 pool cannot fit that
-    next to the weights on this chip (compile-time OOM), the int8 pool
-    can. Reported: int8 pool at B=128 vs bf16 pool at its best feasible
-    batch (B=96, run as the separate kv_bf16_long part — one engine per
-    subprocess). Iso-batch the int8 pool is ~5% SLOWER here (int8 page
-    slabs pad to the (32,128) sublane tile, so the DMA byte savings do
-    not materialize at page_size=16; the scale plane adds overhead) —
-    the win is fitting 33% more sequences, worth ~+30% throughput.
-    Reference precedent: FP8 KV on the flagship path
-    (docker/Dockerfile.cuda:69-70)."""
+    """The int8 KV pool at long context (ISL 384 of a 512 window),
+    honestly framed. CAPACITY: B=128 needs 3,584 pages — the bf16 pool
+    cannot fit that next to the weights on this chip (compile-time OOM);
+    the int8 pool serves it. THROUGHPUT: on this KV-read-bound single
+    chip, tok/s saturates in B, so the extra batch does NOT raise
+    throughput — bf16 at its feasible B=96 (the kv_bf16_long part)
+    measures HIGHER than int8 at B=128 (int8 page slabs pad to the
+    (32,128) sublane tile so DMA bytes don't halve at page_size=16, and
+    the scale plane adds overhead). The int8 pool's measured THROUGHPUT
+    win is on the P/D wire instead (pd_kvint8: staging ships pool bytes
+    directly — no quantize pass, half the bytes both legs — cutting
+    wire TTFT ~34% vs the int8 transfer encoding alone). Reference
+    precedent: FP8 KV on the flagship path (Dockerfile.cuda:69-70)."""
     return {
         "kv_int8_tok_s_isl384_b128": _bench_long_ctx("int8", 128, 4096)
     }
@@ -196,7 +201,9 @@ def _bench_long_ctx(kv_dtype: str, B: int, blocks: int) -> float:
         model=model,
         cache=CacheConfig(page_size=16, num_blocks=blocks, dtype=kv_dtype),
         scheduler=SchedulerConfig(
-            max_num_seqs=B, max_num_batched_tokens=16384, decode_window=64
+            # One-shot prefill (B x ISL in a single dispatch) — the same
+            # tunnel-RTT-amortizing philosophy as the headline config.
+            max_num_seqs=B, max_num_batched_tokens=B * ISL, decode_window=64
         ),
         parallel=ParallelConfig(tensor_parallel_size=1),
         seed=0,

@@ -270,25 +270,21 @@ class ModelRunner:
         sharding = self.ctx.sharding(*spec)
         if c.quantized:
             # Int8 pool: (data i8, per-row K/V-half scales f32 in the
-            # PLANE layout [L, K, 2, P, page]) — see ops/quant_kv.py for
-            # the layout contract. Scales shard on the head axis (axis 1
-            # of the plane), mirroring the data pool's head sharding.
-            sshape = (shape[0], shape[2], 2, shape[1], shape[3])
-            sspec = jax.sharding.PartitionSpec(
-                None, spec[2], None, None, None
-            )
-            ssharding = self.ctx.sharding(*sspec)
+            # pool layout [L, P, K, 2, page]) — see ops/quant_kv.py for
+            # the layout contract. Scales share the data pool's head
+            # sharding (same axis position).
+            sshape = (shape[0], shape[1], shape[2], 2, shape[3])
             if dist.is_multihost():
                 return jax.jit(
                     lambda: (
                         jnp.zeros(shape, jnp.int8),
                         jnp.ones(sshape, jnp.float32),
                     ),
-                    out_shardings=(sharding, ssharding),
+                    out_shardings=(sharding, sharding),
                 )()
             return (
                 jnp.zeros(shape, jnp.int8, device=sharding),
-                jnp.ones(sshape, jnp.float32, device=ssharding),
+                jnp.ones(sshape, jnp.float32, device=sharding),
             )
         if dist.is_multihost():
             # Global pool spanning processes: allocate via a jitted zeros
@@ -483,12 +479,9 @@ class ModelRunner:
 
         def gather(kv, ids):
             if isinstance(kv, tuple):
-                from llmd_tpu.ops.quant_kv import (
-                    bundle_from_plane, dequantize_pages,
-                )
+                from llmd_tpu.ops.quant_kv import dequantize_pages
 
-                d = kv[0][:, ids]
-                s = bundle_from_plane(kv[1][:, :, :, ids])
+                d, s = kv[0][:, ids], kv[1][:, ids]
                 if rep > 1:
                     d, s = d[:, :, ::rep], s[:, :, ::rep]
                 return dequantize_pages(d, s, dt)
@@ -508,12 +501,9 @@ class ModelRunner:
 
         def gather(kv, ids):
             if isinstance(kv, tuple):
-                from llmd_tpu.ops.quant_kv import (
-                    bundle_from_plane, pool_scales_to_wire,
-                )
+                from llmd_tpu.ops.quant_kv import pool_scales_to_wire
 
-                d = kv[0][:, ids]
-                s = bundle_from_plane(kv[1][:, :, :, ids])
+                d, s = kv[0][:, ids], kv[1][:, ids]
                 if rep > 1:
                     d, s = d[:, :, ::rep], s[:, :, ::rep]
                 # Pool scales are f32 ON the f16 grid — the wire's f16
@@ -537,14 +527,12 @@ class ModelRunner:
             if rep > 1:
                 vals = jnp.repeat(vals, rep, axis=2)
             if isinstance(kv, tuple):
-                from llmd_tpu.ops.quant_kv import (
-                    plane_from_bundle, quantize_pages,
-                )
+                from llmd_tpu.ops.quant_kv import quantize_pages
 
                 d, s = quantize_pages(vals)
                 return (
                     kv[0].at[:, ids].set(d),
-                    kv[1].at[:, :, :, ids].set(plane_from_bundle(s)),
+                    kv[1].at[:, ids].set(s),
                 )
             # Heterogeneous-pool local claims (e.g. bf16 producer -> f32
             # consumer) cast at the write.
@@ -559,19 +547,15 @@ class ModelRunner:
         rep = self.kv_rep
 
         def scatter(kv, ids, d, s_wire):
-            from llmd_tpu.ops.quant_kv import (
-                plane_from_bundle, wire_scales_to_pool,
-            )
+            from llmd_tpu.ops.quant_kv import wire_scales_to_pool
 
-            s = wire_scales_to_pool(s_wire)  # bundle [L, n, K, 2, page]
+            s = wire_scales_to_pool(s_wire)  # [L, n, K, 2, page]
             if rep > 1:
                 d = jnp.repeat(d, rep, axis=2)
                 s = jnp.repeat(s, rep, axis=2)
             return (
                 kv[0].at[:, ids].set(d),
-                kv[1].at[:, :, :, ids].set(
-                    plane_from_bundle(s).astype(kv[1].dtype)
-                ),
+                kv[1].at[:, ids].set(s.astype(kv[1].dtype)),
             )
 
         return jax.jit(scatter, donate_argnums=(0,))
@@ -1021,9 +1005,7 @@ class ModelRunner:
         if self.kv_quantized:
             scratch = (
                 jnp.zeros(shape, jnp.int8),
-                jnp.ones(
-                    (shape[0], shape[2], 2, shape[1], page), jnp.float32
-                ),
+                jnp.ones((*shape[:3], 2, page), jnp.float32),
             )
         else:
             scratch = jnp.zeros(shape, data.dtype)

@@ -431,7 +431,7 @@ def paged_attention_full(
                 local_q, mesh=mesh,
                 in_specs=(
                     P("dp", None, "tp", None), P(None, None, tp_k, None, None),
-                    P(None, tp_k, None, None, None),
+                    P(None, None, tp_k, None, None),
                     P(), P("dp", None), P("dp"), P(), P("tp"),
                 ),
                 out_specs=P("dp", None, "tp", None),

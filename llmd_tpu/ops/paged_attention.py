@@ -55,38 +55,35 @@ def write_kv_pages(
 
 
 def scatter_kv_scales(
-    scales: jax.Array,  # [K, 2, num_pages, page] f32 (one layer's PLANE)
+    scales: jax.Array,  # [num_pages, K, 2, page] f32 (one layer)
     srow: jax.Array,  # [B, Q, K, 2] per-row K/V-half scales
     page_table: jax.Array,  # [B, max_pages]
     positions: jax.Array,  # [B, Q]
     valid: jax.Array,  # [B, Q] bool
 ) -> jax.Array:
-    """Scatter this step's per-row scales into one layer's scale plane
+    """Scatter this step's per-row scales into one layer's scale pool
     (the tiny sibling of write_kv_pages; ~1/32 of the data bytes, so the
     plain XLA scatter is fine even on the Pallas write path)."""
-    K, two, num_pages, page = scales.shape
+    num_pages, K, two, page = scales.shape
     page_idx = positions // page
     offset = positions % page
     phys = jnp.take_along_axis(page_table, page_idx, axis=1)
     phys = jnp.where(valid, phys, num_pages)  # OOB => dropped
     T = phys.size
-    # Advanced indices on axes (2, 3) are adjacent -> result dims sit in
-    # place: [K, 2, T].
-    vals = jnp.moveaxis(srow.reshape(T, K, 2), 0, 2).astype(scales.dtype)
     return scales.at[
-        :, :, phys.reshape(T), offset.reshape(T)
-    ].set(vals, mode="drop")
+        phys.reshape(T, 1), jnp.arange(K)[None, :], :, offset.reshape(T, 1)
+    ].set(srow.reshape(T, K, 2).astype(scales.dtype), mode="drop")
 
 
 def _dequant_gathered(kv, scales, page_idx, D):
-    """Gathered int8 pages [B, n, K, page, 2D] + one layer's scale PLANE
-    [K, 2, P, page] with the same page indices [B, n] -> float32 k, v
+    """Gathered int8 pages [B, n, K, page, 2D] + one layer's scale pool
+    [P, K, 2, page] with the same page indices [B, n] -> float32 k, v
     [B, S, K, D] (S = n * page)."""
     B, n, K, page, D2 = kv.shape
     S = n * page
     kv = kv.transpose(0, 1, 3, 2, 4).reshape(B, S, K, D2).astype(jnp.float32)
-    g = scales[:, :, page_idx]  # [K, 2, B, n, page]
-    s = g.transpose(2, 3, 4, 0, 1).reshape(B, S, K, 2).astype(jnp.float32)
+    g = scales[page_idx]  # [B, n, K, 2, page]
+    s = g.transpose(0, 1, 4, 2, 3).reshape(B, S, K, 2).astype(jnp.float32)
     k = kv[..., :D] * s[..., 0:1]
     v = kv[..., D:] * s[..., 1:2]
     return k, v
@@ -114,7 +111,7 @@ def paged_attention_xla_blocked(
     block_pages: int = 32,
     window=None,  # i32 scalar (0/None = full attention)
     sinks=None,   # [H] per-q-head virtual-key logits (gpt-oss)
-    scales=None,  # [K, 2, num_pages, page] f32: int8-pool scale plane
+    scales=None,  # [num_pages, K, 2, page] f32: int8-pool row scales
 ) -> jax.Array:
     """Flash-style blocked paged attention in plain XLA.
 
@@ -207,7 +204,7 @@ def paged_attention_xla(
     sm_scale: float | None = None,
     window=None,  # i32 scalar (0/None = full attention)
     sinks=None,   # [H] per-q-head virtual-key logits (gpt-oss)
-    scales=None,  # [K, 2, num_pages, page] f32: int8-pool scale plane
+    scales=None,  # [num_pages, K, 2, page] f32: int8-pool row scales
 ) -> jax.Array:
     """Reference paged attention: gather the whole context, masked softmax."""
     B, Q, H, D = q.shape

@@ -236,10 +236,10 @@ def _decode_call(
         sl = (
             jax.lax.dynamic_index_in_dim(scales, lidx, 0, keepdims=False)
             if scales.ndim == 5 else scales
-        )  # [K, 2, P, page] plane
-        g = sl[:, :, page_table]  # [K, 2, B, mp, page]
+        )  # [P, K, 2, page]
+        g = sl[page_table]  # [B, mp, K, 2, page]
         mp = page_table.shape[1]
-        ksvs = jnp.moveaxis(g, 2, 0).reshape(B, K, 2, mp * page)
+        ksvs = g.transpose(0, 2, 3, 1, 4).reshape(B, K, 2, mp * page)
         ksvs = ksvs.astype(jnp.float32)
         sspec = pl.BlockSpec(
             (1, K, mp * page), lambda b, l, pt, kl, ws: (b, 0, 0)
@@ -296,7 +296,7 @@ def decode_paged_attention(
     pages_per_block: int = 16,
     window: jax.Array | None = None,
     sinks: jax.Array | None = None,
-    scales: jax.Array | None = None,  # [K, 2, num_pages, page] plane
+    scales: jax.Array | None = None,  # [num_pages, K, 2, page]
 ) -> jax.Array:
     return _decode_call(
         q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
@@ -316,7 +316,7 @@ def decode_paged_attention_full(
     pages_per_block: int = 16,
     window: jax.Array | None = None,
     sinks: jax.Array | None = None,
-    scales: jax.Array | None = None,  # [L, K, 2, num_pages, page]
+    scales: jax.Array | None = None,  # [L, num_pages, K, 2, page]
 ) -> jax.Array:
     """Layer-indexed variant: reads cache[layer] pages directly from the
     full-cache HBM ref — a scan over layers never materializes a

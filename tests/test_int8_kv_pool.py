@@ -72,8 +72,9 @@ def _attention_inputs(B=2, K=2, G=2, page=8, n_pages=6, D=128, seed=0):
 
 
 def _plane(s):
-    """Single-layer bundle scales [P, K, 2, page] -> plane [K, 2, P, page]."""
-    return jnp.moveaxis(s, 0, 2)
+    """Single-layer pool scales are already [P, K, 2, page] (identity;
+    kept so the call sites read as 'pool layout goes here')."""
+    return s
 
 
 def test_xla_attention_quant_close_to_float():
