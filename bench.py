@@ -168,11 +168,12 @@ def bench_kv_int8_long_context():
     throughput — bf16 at its feasible B=96 (the kv_bf16_long part)
     measures HIGHER than int8 at B=128 (int8 page slabs pad to the
     (32,128) sublane tile so DMA bytes don't halve at page_size=16, and
-    the scale plane adds overhead). The int8 pool's measured THROUGHPUT
-    win is on the P/D wire instead (pd_kvint8: staging ships pool bytes
-    directly — no quantize pass, half the bytes both legs — cutting
-    wire TTFT ~34% vs the int8 transfer encoding alone). Reference
-    precedent: FP8 KV on the flagship path (Dockerfile.cuda:69-70)."""
+    the scale plane adds overhead). On the P/D wire the pool ships its
+    bytes directly (pd_kvint8: same half-bytes wire as the int8
+    transfer encoding, quantize pass skipped, consumer scatter without
+    dequant/requant); run-to-run tunnel variance dominates the two
+    int8 wire variants' ordering. Reference precedent: FP8 KV on the
+    flagship path (Dockerfile.cuda:69-70)."""
     return {
         "kv_int8_tok_s_isl384_b128": _bench_long_ctx("int8", 128, 4096)
     }

@@ -273,12 +273,12 @@ def write_kv_pages_full(
             kv_cache_full, layer, k8, v8, page_table, positions, valid,
             world_size=world_size, mesh=mesh,
         )
-        # Slice + scatter + update-slice on the layer's scale plane: the
-        # full-array layer-indexed scatter reads cleaner but defeats
-        # XLA's in-place aliasing (the attention read is a second
-        # consumer), copying the whole scale plane per layer — measured
-        # 10x slower e2e. The slice form pays ~2 plane-slices per layer
-        # (~1/128 of the data bytes).
+        # Slice + scatter + update-slice on the layer's scale pool
+        # ([P, K, 2, page]): the full-array layer-indexed scatter reads
+        # cleaner but defeats XLA's in-place aliasing (the attention
+        # read is a second consumer), copying the whole scale pool per
+        # layer — measured 10x slower e2e. The slice form pays ~2
+        # layer-slices per step (~1/128 of the data bytes).
         ssl = jax.lax.dynamic_index_in_dim(kv_scales, layer, 0, keepdims=False)
         ssl = scatter_kv_scales(ssl, srow, page_table, positions, valid)
         return (data, jax.lax.dynamic_update_index_in_dim(kv_scales, ssl, layer, 0))

@@ -71,19 +71,13 @@ def _attention_inputs(B=2, K=2, G=2, page=8, n_pages=6, D=128, seed=0):
     return q, jnp.asarray(pages), pt, kv_lens, positions
 
 
-def _plane(s):
-    """Single-layer pool scales are already [P, K, 2, page] (identity;
-    kept so the call sites read as 'pool layout goes here')."""
-    return s
-
-
 def test_xla_attention_quant_close_to_float():
     from llmd_tpu.ops.paged_attention import paged_attention_xla
 
     q, pages, pt, kv_lens, positions = _attention_inputs()
     ref = paged_attention_xla(q, pages, pt, kv_lens, positions)
     d, s = quantize_pages(pages)
-    out = paged_attention_xla(q, d, pt, kv_lens, positions, scales=_plane(s))
+    out = paged_attention_xla(q, d, pt, kv_lens, positions, scales=s)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=0.05, atol=0.05
     )
@@ -96,7 +90,7 @@ def test_pallas_kernel_quant_matches_xla_quant():
 
     q, pages, pt, kv_lens, positions = _attention_inputs(seed=3)
     d, s = quantize_pages(pages)
-    sp = _plane(s)
+    sp = s
     ref = paged_attention_xla(q, d, pt, kv_lens, positions, scales=sp)
     out = decode_paged_attention(
         q, d, pt, kv_lens, interpret=True, scales=sp
@@ -114,7 +108,7 @@ def test_blocked_xla_quant_matches_dense():
 
     q, pages, pt, kv_lens, positions = _attention_inputs(seed=4)
     d, s = quantize_pages(pages)
-    sp = _plane(s)
+    sp = s
     dense = paged_attention_xla(q, d, pt, kv_lens, positions, scales=sp)
     blocked = paged_attention_xla_blocked(
         q, d, pt, kv_lens, positions, block_pages=2, scales=sp
@@ -182,8 +176,8 @@ def test_engine_int8_pool_pallas_kernels(monkeypatch):
 
 
 def test_engine_int8_pool_sharded(monkeypatch):
-    """tp=4 x dp=2 mesh: the shard_map quant-attention branch (scales
-    plane sharded on its head axis) agrees with the float pool."""
+    """tp=4 x dp=2 mesh: the shard_map quant-attention branch (scale
+    pool sharded on its head axis) agrees with the float pool."""
     from llmd_tpu.config import ParallelConfig
 
     monkeypatch.setenv("LLMD_PALLAS", "interpret")

@@ -317,8 +317,11 @@ async def test_short_prompt_skips_disagg(pd_stack):
 
 @pytest.fixture
 async def pd_stack_short_lease():
-    """P/D stack with a 400ms producer lease and a fast-heartbeat sidecar
-    (cadence 1/4 lease) — the lease-expiry-while-queued seam."""
+    """P/D stack with an 800ms producer lease and a fast-heartbeat
+    sidecar (cadence 1/4 lease) — the lease-expiry-while-queued seam.
+    (Lease chosen load-tolerant: at 400ms the test flaked when the
+    1-core CI host was heavily contended — a stalled event loop missed
+    two 100ms heartbeats in a row.)"""
     def mk(kv_role, lease_ms):
         return LLMEngine(EngineConfig(
             model=tiny_model_config(vocab_size=512, max_model_len=128),
@@ -332,7 +335,7 @@ async def pd_stack_short_lease():
             kv_local_fastpath=False,
         ))
 
-    prefill_engine = mk("kv_producer", 400)
+    prefill_engine = mk("kv_producer", 800)
     decode_engine = mk("kv_consumer", 1500)  # pull-wait deadline 1.5s
     decode_async = AsyncEngine(decode_engine)
     prefill_srv = TestServer(make_engine_app(prefill_engine))
@@ -342,7 +345,7 @@ async def pd_stack_short_lease():
     await prefill_srv.start_server()
     await decode_srv.start_server()
     sidecar_srv = TestServer(build_sidecar_app(
-        SidecarConfig(vllm_port=decode_srv.port, heartbeat_s=0.1), rank=0
+        SidecarConfig(vllm_port=decode_srv.port, heartbeat_s=0.2), rank=0
     ))
     await sidecar_srv.start_server()
     yield prefill_engine, decode_engine, decode_async, prefill_srv, sidecar_srv
@@ -381,9 +384,9 @@ async def test_pd_lease_expiry_while_queued_heartbeat_keeps_kv(
                     return r.status, await r.json()
 
             task = asyncio.ensure_future(request())
-            # hold paused for 4 base leases; the heartbeat (cadence 100ms)
-            # must keep renewing the chunk keys
-            await asyncio.sleep(1.6)
+            # hold paused for 4 base leases; the heartbeat (cadence
+            # 200ms) must keep renewing the chunk keys
+            await asyncio.sleep(3.2)
             assert not task.done()
             assert prefill_engine.kv_connector.server.registered_count >= 1, (
                 "lease expired while queued despite the sidecar heartbeat"
