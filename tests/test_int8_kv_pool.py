@@ -1,7 +1,8 @@
 """INT8 KV-cache pool: quantization round trips, kernel/XLA parity, and
 engine/transfer/offload golden parity vs float pools.
 
-The pool is (int8 data, f16 per-row K/V-half scales) — ops/quant_kv.py.
+The pool is (int8 data, f32 per-row K/V-half scales on the f16 grid)
+— ops/quant_kv.py.
 Reference precedent: the flagship deployment runs a quantized cache
 end-to-end (FP8 KV; docker/Dockerfile.cuda:69-70).
 """
