@@ -227,6 +227,106 @@ def _bench_long_ctx(kv_dtype: str, B: int, blocks: int) -> float:
     return round(B * OSL / dt, 1)
 
 
+def bench_swa_ring(ring: bool):
+    """SWA ring pool (--kv-swa-ring; the reference's hybrid KV cache
+    manager role, pd patch-decode.yaml:19) on a gpt-oss-geometry proxy.
+
+    Two claims, measured separately because they have different honest
+    substrates: (1) tok/s ring-on vs ring-off on the SAME e2e workload —
+    the ring changes memory layout, not attention work (the window-skip
+    already avoids out-of-window reads either way). Measured on this
+    proxy: ~203-207 off vs ~185 on (reproducible ~10% overhead: two-pool
+    scan carries + the per-dispatch ring-view table). (2) per-sequence
+    KV bytes AT max_model_len — exact geometry math, where the ring's
+    win lives (sliding layers hold R pages instead of ctx/page): at the
+    real gpt-oss-20b shape (24 layers alternating at window 128, ctx
+    131072) the ratio is 0.508 — 6.0 -> 3.05 GB/seq. Like the int8
+    pool, the flag buys CAPACITY (2x the concurrent long sequences per
+    HBM byte), not single-batch speed.
+
+    The on/off runs live in SEPARATE bench parts (subprocesses): two
+    engines in one process RESOURCE_EXHAUST the tunnel chip (lagging
+    arena reclaim between engine lifetimes — same reason main() runs
+    every part in a subprocess)."""
+    import numpy as np
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        swa_ring_spec,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+    from llmd_tpu.models.registry import get_model_config
+
+    B, ISL, OSL = 32, 1024, 64
+    # Depth 4 + vocab 32768 + 8-row prefill dispatches: the 32-expert
+    # layers cost ~0.8G/layer int8 and the MoE prefill temps ~0.25M/token,
+    # so deeper/wider proxies RESOURCE_EXHAUST this 16G chip.
+    proxy = get_model_config(
+        "gpt-oss-20b", num_layers=4,
+        layer_types=tuple(
+            "sliding_attention" if i % 2 == 0 else "full_attention"
+            for i in range(4)
+        ),
+        max_model_len=8192, quantization="int8", vocab_size=32768,
+    )
+
+    def run(ring: bool):
+        cfg = EngineConfig(
+            model=proxy,
+            cache=CacheConfig(
+                page_size=16, num_blocks=2304, dtype="bfloat16",
+                swa_ring=ring,
+                # Ring-on force-disables prefix caching; the off run must
+                # match or its per-page hashing slows it and the A/B
+                # conflates two effects.
+                enable_prefix_caching=False,
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=B, max_num_batched_tokens=8 * ISL,
+                decode_window=64,
+            ),
+            parallel=ParallelConfig(tensor_parallel_size=1),
+            seed=0,
+        )
+        engine = LLMEngine(cfg)
+        rng = np.random.default_rng(2)
+        sp = SamplingParams(temperature=0.0, max_tokens=OSL, ignore_eos=True)
+        mk = lambda: [  # noqa: E731
+            list(rng.integers(1, proxy.vocab_size, size=ISL)) for _ in range(B)
+        ]
+        engine.generate(mk(), sp)
+        t0 = time.monotonic()
+        out = engine.generate(mk(), sp)
+        dt = time.monotonic() - t0
+        assert sum(len(v) for v in out.values()) == B * OSL
+        del engine
+        return round(B * OSL / dt, 1)
+
+    if not ring:
+        return {"swa_off_tok_s": run(False)}
+
+    # Exact per-seq KV bytes at max context, real gpt-oss-20b geometry.
+    model = get_model_config("gpt-oss-20b")
+    cache = CacheConfig(page_size=16, swa_ring=True)
+    sched = SchedulerConfig(max_num_seqs=1, max_num_batched_tokens=2048)
+    spec = swa_ring_spec(model, cache, sched)
+    page_bytes = (
+        model.kv_cache_heads * cache.page_size * model.kv_cache_entry_dim * 2
+    )
+    pages_full_len = model.max_model_len // cache.page_size
+    per_seq_off = pages_full_len * model.num_layers * page_bytes
+    per_seq_on = (
+        pages_full_len * len(spec.full_layers)
+        + spec.ring_pages * len(spec.swa_layers)
+    ) * page_bytes
+    return {
+        "swa_on_tok_s": run(True),
+        "gpt_oss_20b_kv_per_seq_at_131k_gb": round(per_seq_off / 2**30, 2),
+        "gpt_oss_20b_kv_per_seq_ring_gb": round(per_seq_on / 2**30, 2),
+        "kv_per_seq_ratio": round(per_seq_on / per_seq_off, 3),
+    }
+
+
 async def _bench_pd_ttft(
     transfer_dtype: str = "auto",
     kv_dtype: str = "bfloat16",
@@ -421,6 +521,10 @@ def _run_part(part: str):
         # producer stage nothing; near-zero transfer.
         p50, _ = asyncio.run(_bench_pd_ttft(cached_repeat=True))
         return {"pd_ttft_p50_cached_ms": round(p50, 1)}
+    if part == "swa_ring_off":
+        return bench_swa_ring(False)
+    if part == "swa_ring_on":
+        return bench_swa_ring(True)
     if part == "rtt":
         return round(measure_dispatch_rtt_ms(), 1)
     if part == "predictor":
@@ -551,6 +655,13 @@ def main() -> None:
             extras.update(_part_in_subprocess(part))
         except Exception as e:  # pragma: no cover
             extras[f"{part}_error"] = f"{type(e).__name__}: {e}"[:200]
+    swa = {}
+    for part in ("swa_ring_off", "swa_ring_on"):
+        try:
+            swa.update(_part_in_subprocess(part))
+        except Exception as e:  # pragma: no cover
+            swa[f"{part}_error"] = f"{type(e).__name__}: {e}"[:200]
+    extras["swa_ring"] = swa
     try:
         extras.update(_part_in_subprocess("pd"))
     except Exception as e:  # pragma: no cover

@@ -220,6 +220,23 @@ class CacheConfig:
     # Fraction of free HBM to use when num_blocks is derived automatically.
     hbm_utilization: float = 0.9
     enable_prefix_caching: bool = True
+    # Ring-buffer KV pages for sliding-window layers (the reference's
+    # hybrid KV cache manager, guides/pd-disaggregation/modelserver/gpu/
+    # vllm/base/patch-decode.yaml:19 --no-disable-hybrid-kv-cache-manager):
+    # sliding layers move to a SECOND, much smaller pool where each
+    # sequence holds a fixed ring of pages reused circularly, instead of
+    # full-length pages on every layer. For gpt-oss-class models (half the
+    # layers slide at window 128) this halves KV bytes per long sequence.
+    # Trade-off: automatic prefix caching is disabled while the ring is on
+    # (a cache hit would skip recomputing the sliding layers' in-window KV,
+    # which the transient per-sequence rings do not retain) — the capacity
+    # win is the point of the flag. Also mutually exclusive with P/D KV
+    # transfer and tiered offload for now (both move full-table pages).
+    swa_ring: bool = False
+    # Ring-pool page count; 0 = auto (max_num_seqs x ring_pages, sized so
+    # ring allocation can never fail while the engine is within
+    # max_num_seqs).
+    swa_blocks: int = 0
 
     @property
     def quantized(self) -> bool:
@@ -247,6 +264,69 @@ class SchedulerConfig:
     # Larger K amortizes dispatch latency at the cost of K-token streaming
     # granularity and bounded overrun past stop tokens.
     decode_window: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SwaRingSpec:
+    """Resolved geometry of the sliding-window ring pool.
+
+    ``ring_pages`` (R) is the per-sequence ring length. Sizing invariant:
+    within one engine step a sequence's sliding layers must hold every
+    position in ``[first_query - window, last_write]`` simultaneously —
+    the step WRITES its whole chunk before attention READS — so the live
+    span is at most ``window + chunk`` tokens and
+    ``R = ceil((window + chunk) / page) + 1`` (the +1 absorbs page-offset
+    straddle). Older logical pages alias onto overwritten ring slots and
+    are exactly the pages the attention kernels' window-skip never reads.
+    """
+
+    windows: tuple[int, ...]      # per-layer window (0 = full attention)
+    full_layers: tuple[int, ...]  # layer ids with full attention
+    swa_layers: tuple[int, ...]   # layer ids with a sliding window
+    ring_pages: int               # R: pages per sequence ring
+    num_swa_blocks: int           # ring-pool size (pages)
+    # Per-sequence prefill chunk cap the scheduler enforces while the
+    # ring is on (R is sized from it; chunking finer is always correct).
+    chunk_tokens: int
+
+
+# Per-seq prefill chunk cap that bounds the ring size independent of the
+# BATCH token budget (the reference caps long prefills the same way:
+# --long-prefill-token-threshold / --max-num-batched-tokens=8192 at 262k
+# context, guides/agentic-serving/modelserver/tpu/vllm/patch-vllm.yaml:39).
+_SWA_RING_CHUNK = 2048
+
+
+def swa_ring_spec(
+    model: "ModelConfig", cache: "CacheConfig", sched: "SchedulerConfig"
+) -> SwaRingSpec | None:
+    """Resolve the ring geometry, or None when the flag has no effect
+    (disabled, no sliding layers, MLA, or rings as large as full tables)."""
+    if not cache.swa_ring or model.sliding_window <= 0 or model.is_mla:
+        return None
+    windows = model.layer_windows
+    swa = tuple(i for i, w in enumerate(windows) if w > 0)
+    if not swa:
+        return None
+    full = tuple(i for i, w in enumerate(windows) if w == 0)
+    wmax = max(windows[i] for i in swa)
+    chunk = max(
+        min(_SWA_RING_CHUNK, sched.max_num_batched_tokens),
+        sched.decode_window,
+    )
+    ring = math.ceil((wmax + chunk) / cache.page_size) + 1
+    max_pages = cache.max_pages_per_seq(model.max_model_len)
+    if ring >= max_pages:
+        return None  # ring would be as large as the full table: no win
+    if cache.swa_blocks and cache.swa_blocks < ring:
+        # A pool smaller than ONE ring can never admit a sequence — that
+        # would livelock admission silently, not degrade it.
+        raise ValueError(
+            f"cache.swa_blocks={cache.swa_blocks} is smaller than one "
+            f"ring ({ring} pages); no sequence could ever be admitted"
+        )
+    blocks = cache.swa_blocks or sched.max_num_seqs * ring
+    return SwaRingSpec(windows, full, swa, ring, blocks, chunk)
 
 
 @dataclasses.dataclass

@@ -10,6 +10,7 @@ exposes the queue/KV metrics the EPP scrapes
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 import uuid
@@ -17,7 +18,7 @@ from dataclasses import dataclass, field
 
 import jax
 
-from llmd_tpu.config import EngineConfig
+from llmd_tpu.config import EngineConfig, swa_ring_spec
 from llmd_tpu.engine.kv_cache import KVEventSink, PageAllocator
 from llmd_tpu.engine.request import (
     FinishReason,
@@ -82,6 +83,40 @@ class LLMEngine:
         # LEADER only; followers just mirror device programs.
         follower = jax.process_count() > 1 and jax.process_index() != 0
         self.ctx = mesh_ctx or build_mesh(config.parallel)
+        # SWA ring (CacheConfig.swa_ring): sliding-window layers move to a
+        # fixed per-sequence page ring in their own pool. The ring content
+        # is transient per sequence, so features that assume full-table
+        # pages hold every layer's KV cannot compose with it (yet):
+        # automatic prefix caching is disabled (a hit would skip the
+        # sliding layers' in-window KV the rings don't retain), and P/D
+        # transfer / tiered offload are refused loudly below.
+        self._swa = swa_ring_spec(config.model, config.cache, config.scheduler)
+        if self._swa is not None:
+            if not config.scheduler.enable_chunked_prefill:
+                raise ValueError(
+                    "kv_swa_ring requires chunked prefill: a whole-prompt "
+                    "chunk can exceed the ring span the step-write/read "
+                    "invariant is sized for (SwaRingSpec.chunk_tokens)"
+                )
+            if config.kv_role:
+                raise ValueError(
+                    "kv_swa_ring does not compose with P/D KV transfer "
+                    "(kv_role): exported full-pool pages would lack the "
+                    "sliding layers' KV — disable one of the two"
+                )
+            if config.offload is not None and config.offload.enabled:
+                raise ValueError(
+                    "kv_swa_ring does not compose with tiered KV offload: "
+                    "host-cached pages would lack the sliding layers' KV "
+                    "— disable one of the two"
+                )
+        prefix_caching = config.cache.enable_prefix_caching
+        if self._swa is not None and prefix_caching:
+            logging.getLogger(__name__).info(
+                "kv_swa_ring: disabling automatic prefix caching (ring "
+                "pages do not retain reusable sliding-layer KV)"
+            )
+            prefix_caching = False
         # Tiered offload wraps the event sink (device evictions of host-held
         # pages downgrade to cpu-tier stores instead of removals).
         self._host_cache = None
@@ -108,13 +143,28 @@ class LLMEngine:
         self.allocator = PageAllocator(
             num_pages=config.cache.num_blocks,
             page_size=config.cache.page_size,
-            enable_prefix_caching=config.cache.enable_prefix_caching,
+            enable_prefix_caching=prefix_caching,
             event_sink=event_sink,
         )
-        self.scheduler = EngineScheduler(
-            config.scheduler, config.cache, self.allocator, config.model.max_model_len
+        self.swa_allocator = (
+            PageAllocator(
+                num_pages=self._swa.num_swa_blocks,
+                page_size=config.cache.page_size,
+                enable_prefix_caching=False,
+            )
+            if self._swa is not None
+            else None
         )
-        self.runner = ModelRunner(config, self.ctx, params=params)
+        self.scheduler = EngineScheduler(
+            config.scheduler, config.cache, self.allocator,
+            config.model.max_model_len,
+            swa_allocator=self.swa_allocator,
+            swa_ring_pages=self._swa.ring_pages if self._swa else 0,
+            swa_chunk_tokens=self._swa.chunk_tokens if self._swa else 0,
+        )
+        self.runner = ModelRunner(
+            config, self.ctx, params=params, swa_spec=self._swa
+        )
         self.stats = EngineStats(
             num_pages=config.cache.num_blocks, page_size=config.cache.page_size
         )

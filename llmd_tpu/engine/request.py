@@ -74,6 +74,13 @@ class Request:
     num_computed_tokens: int = 0
     # Physical page ids allocated to this sequence, in order.
     block_ids: list[int] = dataclasses.field(default_factory=list)
+    # Ring pages for sliding-window layers (CacheConfig.swa_ring): a fixed
+    # list of R pages from the ring pool, reused circularly — logical page
+    # l of this sequence lives at swa_block_ids[l % R] on sliding layers.
+    swa_block_ids: list[int] = dataclasses.field(default_factory=list)
+    # Memoized [max_pages] ring-view table row (immutable once the ring is
+    # allocated; invalidated whenever swa_block_ids is freed).
+    swa_table_row: Any = None
     # Number of prompt tokens satisfied from the prefix cache (skipped compute).
     num_cached_tokens: int = 0
     # Outputs generated before a recompute-preemption folded them into the

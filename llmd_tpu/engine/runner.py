@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from llmd_tpu import ops
-from llmd_tpu.config import EngineConfig
+from llmd_tpu.config import EngineConfig, swa_ring_spec
 from llmd_tpu.engine.sampler import SamplingInputs, sample_tokens
 from llmd_tpu.engine.scheduler import ScheduledSeq
 from llmd_tpu.models import llama
@@ -156,12 +156,17 @@ class ModelRunner:
         config: EngineConfig,
         mesh_ctx: MeshContext,
         params: dict | None = None,
+        swa_spec=None,
     ) -> None:
         self.config = config
         self.cfg = config.model
         self.ctx = mesh_ctx
         self.max_pages = config.cache.max_pages_per_seq(self.cfg.max_model_len)
         self.page = config.cache.page_size
+        # SWA ring geometry (CacheConfig.swa_ring). The ENGINE passes its
+        # resolved spec so allocator/scheduler and pool/table geometry
+        # share one source of truth; a standalone runner resolves its own.
+        self._swa_spec_arg = swa_spec
 
         if params is None:
             if config.weights_path:
@@ -172,7 +177,13 @@ class ModelRunner:
                 params = llama.init_params(self.cfg, jax.random.key(config.seed))
         params = self._maybe_fuse(params)
         self.params = shard_params(params, mesh_ctx)
+        # SWA ring (CacheConfig.swa_ring): sliding-window layers live in a
+        # second, smaller pool indexed through a ring-view page table.
+        self.swa = self._swa_spec_arg or swa_ring_spec(
+            self.cfg, config.cache, config.scheduler
+        )
         self.kv_cache = self._alloc_kv()
+        self.kv_swa = self._alloc_swa()
         self._multihost = dist.is_multihost()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
@@ -245,9 +256,23 @@ class ModelRunner:
 
     def _alloc_kv(self):
         c = self.config.cache
+        layers = (
+            len(self.swa.full_layers) if self.swa is not None
+            else self.cfg.num_layers
+        )
+        return self._alloc_pool(layers, c.num_blocks)
+
+    def _alloc_swa(self):
+        """The sliding-window ring pool (None unless swa_ring resolves)."""
+        if self.swa is None:
+            return None
+        return self._alloc_pool(len(self.swa.swa_layers), self.swa.num_swa_blocks)
+
+    def _alloc_pool(self, num_layers: int, num_blocks: int):
+        c = self.config.cache
         shape = (
-            self.cfg.num_layers,
-            c.num_blocks,
+            num_layers,
+            num_blocks,
             self.cfg.kv_cache_heads * self.kv_rep,  # MLA: one latent "head"
             c.page_size,
             self.cfg.kv_cache_entry_dim,
@@ -319,7 +344,7 @@ class ModelRunner:
     def kv_bytes(self) -> int:
         return sum(
             leaf.size * leaf.dtype.itemsize
-            for leaf in jax.tree.leaves(self.kv_cache)
+            for leaf in jax.tree.leaves((self.kv_cache, self.kv_swa))
         )
 
     def set_lora_weights(self, lora_id: int, weights: dict) -> None:
@@ -370,16 +395,28 @@ class ModelRunner:
         ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
+        ring = self.swa is not None
 
         @functools.partial(
-            jax.jit, donate_argnums=(1,), static_argnames=("all_greedy",)
+            jax.jit,
+            donate_argnums=(1, 2) if ring else (1,),
+            static_argnames=("all_greedy",),
         )
-        def fwd(params, kv_cache, inp: StepInput, s: SamplingInputs, all_greedy=False):
-            hidden, kv_cache = llama.forward_hidden(
-                params, kv_cache, inp, cfg, world,
-                mesh=mesh, moe_backend=moe_backend,
-                ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-            )
+        def fwd(params, kv_cache, kv_swa, inp: StepInput, s: SamplingInputs,
+                all_greedy=False):
+            if ring:
+                hidden, kv_cache, kv_swa = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                    kv_swa=kv_swa,
+                )
+            else:
+                hidden, kv_cache = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                )
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
             h_last = hidden[jnp.arange(B), last]
@@ -389,7 +426,7 @@ class ModelRunner:
             packed = jnp.concatenate(
                 [tokens.astype(jnp.float32)[:, None], logprobs[:, None]], axis=1
             )
-            return kv_cache, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed)
 
         return fwd
 
@@ -402,16 +439,21 @@ class ModelRunner:
         ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
+        ring = self.swa is not None
 
         @functools.partial(
-            jax.jit, donate_argnums=(1,), static_argnames=("k_steps", "all_greedy")
+            jax.jit,
+            donate_argnums=(1, 2) if ring else (1,),
+            static_argnames=("k_steps", "all_greedy"),
         )
         def multi(
             params,
             kv_cache,
+            kv_swa,  # ring pool (None unless swa_ring)
             first_token: jax.Array,  # [B]
             start_pos: jax.Array,  # [B] position of first_token
             page_table: jax.Array,  # [B, max_pages]
+            swa_table,  # [B, max_pages] ring view, or None
             active: jax.Array,  # [B] bool (pad rows False)
             lora_ids,  # [B] i32 adapter slots, or None
             temperature: jax.Array,
@@ -424,7 +466,7 @@ class ModelRunner:
             B = first_token.shape[0]
 
             def body(i, carry):
-                kv_cache, tok, out_t, out_l = carry
+                kv_cache, kv_swa, tok, out_t, out_l = carry
                 pos = start_pos + i
                 inp = StepInput(
                     token_ids=tok[:, None],
@@ -433,12 +475,21 @@ class ModelRunner:
                     kv_lens=jnp.where(active, pos + 1, 0).astype(jnp.int32),
                     page_table=page_table,
                     lora_ids=lora_ids,
+                    swa_page_table=swa_table,
                 )
-                hidden, kv_cache = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                )
+                if ring:
+                    hidden, kv_cache, kv_swa = llama.forward_hidden(
+                        params, kv_cache, inp, cfg, world,
+                        mesh=mesh, moe_backend=moe_backend,
+                        ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
+                        dbo=dbo, kv_swa=kv_swa,
+                    )
+                else:
+                    hidden, kv_cache = llama.forward_hidden(
+                        params, kv_cache, inp, cfg, world,
+                        mesh=mesh, moe_backend=moe_backend,
+                        ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                    )
                 logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
                 s = SamplingInputs(
                     temperature=temperature,
@@ -451,17 +502,17 @@ class ModelRunner:
                 nxt, logp = sample_tokens(logits, s, all_greedy)
                 out_t = jax.lax.dynamic_update_index_in_dim(out_t, nxt, i, axis=1)
                 out_l = jax.lax.dynamic_update_index_in_dim(out_l, logp, i, axis=1)
-                return kv_cache, nxt, out_t, out_l
+                return kv_cache, kv_swa, nxt, out_t, out_l
 
             out_t = jnp.zeros((B, k_steps), jnp.int32)
             out_l = jnp.zeros((B, k_steps), jnp.float32)
-            kv_cache, _, out_t, out_l = jax.lax.fori_loop(
-                0, k_steps, body, (kv_cache, first_token, out_t, out_l)
+            kv_cache, kv_swa, _, out_t, out_l = jax.lax.fori_loop(
+                0, k_steps, body, (kv_cache, kv_swa, first_token, out_t, out_l)
             )
             packed = jnp.concatenate(
                 [out_t.astype(jnp.float32), out_l], axis=1
             )  # [B, 2K]
-            return kv_cache, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed)
 
         return multi
 
@@ -635,6 +686,28 @@ class ModelRunner:
             pt[i, : len(ids)] = ids
         return pt
 
+    def _swa_table(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
+        """Ring-view table for sliding layers: logical page l of sequence
+        i maps to ring[l % R]. Same [B, max_pages] shape as the main table
+        so every kernel path is unchanged; the repeats past the window are
+        exactly the pages the window-skip never reads. Rows are immutable
+        once a sequence's ring is allocated, so they memoize on the
+        request (scheduler._release invalidates)."""
+        pt = np.zeros((B, self.max_pages), np.int32)
+        for i, s in enumerate(seqs):
+            req = s.request
+            ring = req.swa_block_ids
+            if not ring:
+                continue
+            row = req.swa_table_row
+            if row is None or len(row) != self.max_pages:
+                row = np.asarray(ring, np.int32)[
+                    np.arange(self.max_pages) % len(ring)
+                ]
+                req.swa_table_row = row
+            pt[i] = row
+        return pt
+
     @staticmethod
     def _unpack(packed: jax.Array, n: int, K: int = 1) -> StepResult:
         arr = dist.replicated_to_host(packed)  # the ONE host transfer
@@ -686,6 +759,10 @@ class ModelRunner:
                 ("top_p", (B,), np.float32),
                 ("seeds", (B, QK), np.uint32),
             ]
+        if self.swa is not None:
+            # Ring-view table for sliding layers; followers derive its
+            # presence from the shared engine config.
+            spec.append(("swa_table", (B, mp), np.int32))
         if self.cfg.num_lora_adapters:
             spec.append(("lora", (B,), np.int32))
         return spec
@@ -756,6 +833,10 @@ class ModelRunner:
             lora_ids=(
                 jnp.asarray(arrays["lora"]) if "lora" in arrays else None
             ),
+            swa_page_table=(
+                jnp.asarray(arrays["swa_table"])
+                if "swa_table" in arrays else None
+            ),
         )
         s = SamplingInputs(
             temperature=jnp.asarray(arrays["temp"]),
@@ -763,18 +844,24 @@ class ModelRunner:
             top_p=jnp.asarray(arrays["top_p"]),
             seeds=jnp.asarray(arrays["seeds"]),
         )
-        self.kv_cache, packed = self._forward(
-            self.params, self.kv_cache, inp, s, all_greedy=all_greedy
+        self.kv_cache, self.kv_swa, packed = self._forward(
+            self.params, self.kv_cache, self.kv_swa, inp, s,
+            all_greedy=all_greedy,
         )
         return packed
 
     def _exec_decode(self, arrays: dict, K: int, all_greedy: bool) -> jax.Array:
-        self.kv_cache, packed = self._multi(
+        self.kv_cache, self.kv_swa, packed = self._multi(
             self.params,
             self.kv_cache,
+            self.kv_swa,
             jnp.asarray(arrays["first"]),
             jnp.asarray(arrays["start"]),
             jnp.asarray(arrays["page_table"]),
+            (
+                jnp.asarray(arrays["swa_table"])
+                if "swa_table" in arrays else None
+            ),
             jnp.asarray(arrays["active"].astype(bool)),
             jnp.asarray(arrays["lora"]) if "lora" in arrays else None,
             jnp.asarray(arrays["temp"]),
@@ -985,31 +1072,44 @@ class ModelRunner:
         page_table = np.arange(B * pages_per_seq, dtype=np.int32).reshape(
             B, pages_per_seq
         )
+        pt = jnp.asarray(page_table)
         inp = StepInput(
             token_ids=jnp.asarray(tokens),
             positions=jnp.asarray(positions),
             query_lens=jnp.asarray(qlens),
             kv_lens=jnp.asarray(qlens),
-            page_table=jnp.asarray(page_table),
+            page_table=pt,
             lora_ids=(
                 jnp.full(B, lora_id, jnp.int32)
                 if self.cfg.num_lora_adapters
                 else None
             ),
+            # Embeds are one-shot: the sliding group can use a full-length
+            # identity view of its own scratch (no ring needed — the ring
+            # is just a table pattern).
+            swa_page_table=pt if self.swa is not None else None,
         )
         data = self._kv_data
-        shape = (
-            self.cfg.num_layers, B * pages_per_seq,
-            data.shape[2], page, data.shape[4],
-        )
-        if self.kv_quantized:
-            scratch = (
-                jnp.zeros(shape, jnp.int8),
-                jnp.ones((*shape[:3], 2, page), jnp.float32),
+
+        def scratch_pool(num_layers: int):
+            shape = (
+                num_layers, B * pages_per_seq, data.shape[2], page,
+                data.shape[4],
             )
+            if self.kv_quantized:
+                return (
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.ones((*shape[:3], 2, page), jnp.float32),
+                )
+            return jnp.zeros(shape, data.dtype)
+
+        if self.swa is not None:
+            scratch = scratch_pool(len(self.swa.full_layers))
+            scratch_swa = scratch_pool(len(self.swa.swa_layers))
         else:
-            scratch = jnp.zeros(shape, data.dtype)
-        pooled = self._embed_fn(self.params, scratch, inp)
+            scratch = scratch_pool(self.cfg.num_layers)
+            scratch_swa = None
+        pooled = self._embed_fn(self.params, scratch, scratch_swa, inp)
         return np.asarray(pooled[:n])
 
     @functools.cached_property
@@ -1018,14 +1118,22 @@ class ModelRunner:
         kv_rep = self.kv_rep
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
+        ring = self.swa is not None
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def embed(params, scratch_kv, inp: StepInput):
-            hidden, _ = llama.forward_hidden(
-                params, scratch_kv, inp, cfg, world, mesh=mesh,
-                moe_backend=moe_backend, ep_capacity_factor=ep_capacity,
-                kv_rep=kv_rep,
-            )
+        @functools.partial(jax.jit, donate_argnums=(1, 2) if ring else (1,))
+        def embed(params, scratch_kv, scratch_swa, inp: StepInput):
+            if ring:
+                hidden, _, _ = llama.forward_hidden(
+                    params, scratch_kv, inp, cfg, world, mesh=mesh,
+                    moe_backend=moe_backend, ep_capacity_factor=ep_capacity,
+                    kv_rep=kv_rep, kv_swa=scratch_swa,
+                )
+            else:
+                hidden, _ = llama.forward_hidden(
+                    params, scratch_kv, inp, cfg, world, mesh=mesh,
+                    moe_backend=moe_backend, ep_capacity_factor=ep_capacity,
+                    kv_rep=kv_rep,
+                )
             valid = inp.valid[..., None].astype(jnp.float32)  # [B, Q, 1]
             summed = jnp.sum(hidden.astype(jnp.float32) * valid, axis=1)
             denom = jnp.maximum(jnp.sum(valid, axis=1), 1.0)
@@ -1076,6 +1184,8 @@ class ModelRunner:
             "temp": temp, "top_k": top_k, "top_p": top_p,
             "seeds": seeds[:, 0],
         }
+        if self.swa is not None:
+            arrays["swa_table"] = self._swa_table(seqs, B)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = self._lora_array(seqs, B)
         all_greedy = all(s.request.sampling.greedy for s in seqs)
@@ -1101,6 +1211,8 @@ class ModelRunner:
             "page_table": self._page_table(seqs, B), "active": active,
             "temp": temp, "top_k": top_k, "top_p": top_p, "seeds": seeds,
         }
+        if self.swa is not None:
+            arrays["swa_table"] = self._swa_table(seqs, B)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = self._lora_array(seqs, B)
         all_greedy = all(s.request.sampling.greedy for s in seqs)
@@ -1152,6 +1264,8 @@ class ModelRunner:
             "top_p": np.ones(B, np.float32),
             "seeds": np.zeros(B, np.uint32),
         }
+        if self.swa is not None:
+            arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
@@ -1168,6 +1282,8 @@ class ModelRunner:
             "top_p": np.ones(B, np.float32),
             "seeds": np.zeros((B, K), np.uint32),
         }
+        if self.swa is not None:
+            arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         arrays = self._sync(_OP_DECODE, B, K, all_greedy, arrays)

@@ -64,10 +64,21 @@ class EngineScheduler:
         cache_config: CacheConfig,
         allocator: PageAllocator,
         max_model_len: int,
+        swa_allocator: PageAllocator | None = None,
+        swa_ring_pages: int = 0,
+        swa_chunk_tokens: int = 0,
     ) -> None:
         self.config = scheduler_config
         self.cache_config = cache_config
         self.allocator = allocator
+        # Ring pool for sliding-window layers (CacheConfig.swa_ring): each
+        # admitted sequence holds a fixed ring of ``swa_ring_pages`` pages
+        # reused circularly, independent of sequence length. Per-seq
+        # prefill chunks are capped at ``swa_chunk_tokens`` (the span R is
+        # sized for); the BATCH budget may be larger.
+        self.swa_allocator = swa_allocator
+        self.swa_ring_pages = swa_ring_pages
+        self.swa_chunk_tokens = swa_chunk_tokens
         self.max_model_len = max_model_len
         # Ordered by (-priority, arrival_time): higher priority first, FCFS
         # within a priority class (the InferenceObjective priority semantics,
@@ -170,6 +181,8 @@ class EngineScheduler:
             if req.status is not RequestStatus.RUNNING or budget <= 0:
                 continue
             chunk = min(req.num_prompt_tokens - req.num_computed_tokens, budget)
+            if self.swa_chunk_tokens:
+                chunk = min(chunk, self.swa_chunk_tokens)
             if chunk <= 0:
                 continue
             if not self._ensure_pages(req, chunk):
@@ -185,11 +198,23 @@ class EngineScheduler:
                 self._apply_prefix_cache(req)
             remaining = req.num_prompt_tokens - req.num_computed_tokens
             chunk = min(remaining, budget)
+            if self.swa_chunk_tokens:
+                chunk = min(chunk, self.swa_chunk_tokens)
             if chunk <= 0:
                 break
             if not self.config.enable_chunked_prefill and chunk < remaining:
                 break  # whole-prompt admission only
+            if not self._ensure_ring(req):
+                break  # out of ring pages; retry next step
             if not self._ensure_pages(req, chunk):
+                # Return the ring: a still-waiting request holding R ring
+                # pages would break the pool's sizing guarantee and could
+                # stall a higher-priority arrival's admission (nothing has
+                # been computed into it — freeing is always safe here).
+                if req.swa_block_ids:
+                    self.swa_allocator.free(req.swa_block_ids)
+                    req.swa_block_ids = []
+                    req.swa_table_row = None
                 break  # out of pages; retry next step
             self.waiting.pop(0)
             req.status = RequestStatus.RUNNING
@@ -242,6 +267,21 @@ class EngineScheduler:
             )
         self._chain[req.request_id] = (parent, n)
 
+    def _ensure_ring(self, req: Request) -> bool:
+        """Allocate the sequence's sliding-window ring (once, at admission).
+
+        The auto-sized ring pool (max_num_seqs x R) makes failure
+        impossible within max_num_seqs; an explicit smaller swa_blocks
+        turns shortage into a wait-for-next-step, like the main pool.
+        """
+        if self.swa_allocator is None or req.swa_block_ids:
+            return True
+        try:
+            req.swa_block_ids = self.swa_allocator.allocate(self.swa_ring_pages)
+            return True
+        except NoFreePagesError:
+            return False
+
     def _ensure_pages(self, req: Request, new_tokens: int) -> bool:
         need_slots = req.num_computed_tokens + new_tokens
         need_pages = -(-need_slots // self.allocator.page_size)
@@ -284,6 +324,10 @@ class EngineScheduler:
         if req.block_ids:
             self.allocator.free(req.block_ids)
             req.block_ids = []
+        if req.swa_block_ids:
+            self.swa_allocator.free(req.swa_block_ids)
+            req.swa_block_ids = []
+            req.swa_table_row = None
         self._chain.pop(req.request_id, None)
 
     # ------------------------------------------------------------------ #
@@ -355,6 +399,8 @@ class EngineScheduler:
 
     def _commit_full_pages(self, req: Request) -> None:
         """Register newly-completed full pages in the prefix index."""
+        if not self.allocator.enable_prefix_caching:
+            return  # commit_page would no-op; skip the hashing walk too
         page = self.allocator.page_size
         parent, committed = self._chain.get(req.request_id, (_ROOT_HASH, 0))
         # Only KV already computed counts; the just-sampled token's KV is not

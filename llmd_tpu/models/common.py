@@ -32,6 +32,10 @@ class StepInput:
     # the model has no adapters (keeps the pytree/compile cache stable
     # for non-LoRA configs).
     lora_ids: jax.Array | None = None
+    # Ring-view page table for sliding-window layers ([B, max_pages] i32,
+    # entries repeat modulo the per-sequence ring length); None unless the
+    # engine runs with CacheConfig.swa_ring.
+    swa_page_table: jax.Array | None = None
 
     @property
     def valid(self) -> jax.Array:  # [B, Q] bool

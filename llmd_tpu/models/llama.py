@@ -150,9 +150,23 @@ def _mlp(h: jax.Array, lp: dict) -> jax.Array:
     return pdot(gate * pdot(h, lp, "w_up"), lp, "w_down")
 
 
+def _scan_period(kinds: tuple[int, ...]) -> int | None:
+    """Smallest period c <= 4 of a layer-kind pattern (None if aperiodic).
+
+    gpt-oss alternates sliding/full every layer (c=2); periodic patterns
+    let the hybrid-pool scan run over CYCLES with the pool choice static
+    per sub-layer — no lax.cond, so XLA keeps both pool carries in place.
+    """
+    n = len(kinds)
+    for c in (2, 3, 4):
+        if n % c == 0 and n > c and all(kinds[i] == kinds[i % c] for i in range(n)):
+            return c
+    return None
+
+
 def forward_hidden(
     params: dict,
-    kv_cache: jax.Array,  # [L, pages, K * kv_rep, page, 2D]
+    kv_cache: jax.Array,  # [L_full, pages, K * kv_rep, page, 2D]
     inp: StepInput,
     cfg: ModelConfig,
     world_size: int = 1,
@@ -161,8 +175,17 @@ def forward_hidden(
     ep_capacity_factor: float = 2.0,
     kv_rep: int = 1,
     dbo: bool = False,
-) -> tuple[jax.Array, jax.Array]:
-    """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache).
+    kv_swa: jax.Array | None = None,
+):
+    """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache) —
+    or (hidden, new kv_cache, new kv_swa) when ``kv_swa`` is given.
+
+    ``kv_swa`` (CacheConfig.swa_ring) is a second, smaller pool holding
+    ONLY the sliding-window layers; those layers index it through
+    ``inp.swa_page_table``, the ring-view table whose entries repeat
+    modulo the per-sequence ring length. The attention kernels are
+    unchanged: their window-skip never reads logical pages older than the
+    window, which are exactly the ring slots that have been overwritten.
 
     ``moe_backend="ep"`` routes MoE layers through the shard_map all-to-all
     dispatch/combine (wide-EP; requires ``mesh``). ``kv_rep`` > 1 stores
@@ -230,7 +253,10 @@ def forward_hidden(
         h2 = rms_norm(x_sl, lp["post_norm"], cfg.rms_norm_eps)
         return x_sl + _ffn(h2, lp, use_moe, cap_scale)
 
-    def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None):
+    def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None,
+                   table=None):
+        if table is None:
+            table = inp.page_table
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         if cfg.is_mla:
             from llmd_tpu.models.mla import mla_attention, mla_read, mla_write
@@ -294,7 +320,7 @@ def forward_hidden(
                 k = jnp.repeat(k, kv_rep, axis=2)
                 v = jnp.repeat(v, kv_rep, axis=2)
             cache = write_kv_pages_full(
-                cache, layer_idx, k, v, inp.page_table, inp.positions, valid,
+                cache, layer_idx, k, v, table, inp.positions, valid,
                 world_size=world_size, mesh=mesh,
             )
             sinks = lp.get("sinks")
@@ -309,7 +335,7 @@ def forward_hidden(
                 outs = []
                 for sl in (slice(0, half), slice(half, B)):
                     attn_sl = paged_attention_full(
-                        q[sl], cache, layer_idx, inp.page_table[sl],
+                        q[sl], cache, layer_idx, table[sl],
                         inp.kv_lens[sl], inp.positions[sl], sm_scale,
                         world_size=world_size, mesh=mesh, window=window,
                         sinks=sinks,
@@ -319,7 +345,7 @@ def forward_hidden(
                     )
                 return jnp.concatenate(outs, axis=0), cache
             attn = paged_attention_full(
-                q, cache, layer_idx, inp.page_table, inp.kv_lens, inp.positions,
+                q, cache, layer_idx, table, inp.kv_lens, inp.positions,
                 sm_scale, world_size=world_size, mesh=mesh, window=window,
                 sinks=sinks,
             )
@@ -329,45 +355,117 @@ def forward_hidden(
 
     # DeepSeek-style dense prefix: the first N layers (N static, 1-3)
     # run unrolled with their own dense-MLP weights; the homogeneous MoE
-    # (or dense) remainder rides ONE lax.scan with the cache as CARRY —
-    # the layer-indexed kernels write/read cache[layer] in place so no
+    # (or dense) remainder rides lax.scan with the cache(s) as CARRY —
+    # the layer-indexed kernels write/read cache[plane] in place so no
     # pool-sized slice ever materializes.
     n_dense = cfg.first_dense_layers if cfg.is_moe else 0
     # Per-layer sliding windows (gpt-oss alternating / Qwen2 upper-layer /
     # Mistral uniform patterns); None for full-attention models keeps the
     # scan signature (and compile cache) unchanged.
     sliding = cfg.sliding_window > 0 and not cfg.is_mla
-    windows = (
-        jnp.asarray(cfg.layer_windows, jnp.int32) if sliding else None
-    )
+    win_static = cfg.layer_windows
+    windows = jnp.asarray(win_static, jnp.int32) if sliding else None
+    # Layer-group assignment. Without the ring every layer shares one pool
+    # and its plane is the global layer id; with it, sliding layers index
+    # their own pool (planes count within the group) via the ring table.
+    ring = kv_swa is not None and sliding
+    kinds = tuple(1 if (ring and w > 0) else 0 for w in win_static)
+    plane, counts = [], [0, 0]
+    for knd in kinds:
+        plane.append(counts[knd])
+        counts[knd] += 1
+    caches = [kv_cache, kv_swa]
+    tables = [inp.page_table, inp.swa_page_table]
+
     for i in range(n_dense):
         lp_i = jax.tree.map(lambda a: a[i], params["dense_layers"])
-        x, kv_cache = layer_body(
-            x, kv_cache, lp_i, jnp.int32(i), use_moe=False,
+        g = kinds[i]
+        x, caches[g] = layer_body(
+            x, caches[g], lp_i, jnp.int32(plane[i]), use_moe=False,
             window=None if windows is None else windows[i],
+            table=tables[g],
         )
 
-    def layer_fn(carry, scanned):
-        x, cache = carry
-        if windows is None:
-            lp, layer_idx = scanned
-            window = None
-        else:
-            lp, layer_idx, window = scanned
-        x, cache = layer_body(
-            x, cache, lp, layer_idx, use_moe=cfg.is_moe, window=window
-        )
-        return (x, cache), None
+    n_scan = cfg.num_layers - n_dense
+    scan_kinds = kinds[n_dense:]
+    plane_arr = jnp.asarray(plane[n_dense:], jnp.int32)
+    win_arr = windows[n_dense:] if windows is not None else None
+    lp_all = params["layers"]
 
-    layer_ids = jnp.arange(n_dense, cfg.num_layers, dtype=jnp.int32)
-    scanned = (
-        (params["layers"], layer_ids)
-        if windows is None
-        else (params["layers"], layer_ids, windows[n_dense:])
-    )
-    (hidden, new_cache), _ = jax.lax.scan(layer_fn, (x, kv_cache), scanned)
-    hidden = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
-    return hidden, new_cache
+    def scan_group(x, cache, table, lp, plane_ids, wins):
+        """One homogeneous run of layers sharing a pool/table."""
+
+        def fn(carry, scanned):
+            x, cache = carry
+            if wins is None:
+                lp_s, pid = scanned
+                w = None
+            else:
+                lp_s, pid, w = scanned
+            x, cache = layer_body(
+                x, cache, lp_s, pid, use_moe=cfg.is_moe, window=w, table=table
+            )
+            return (x, cache), None
+
+        scanned = (lp, plane_ids) if wins is None else (lp, plane_ids, wins)
+        (x, cache), _ = jax.lax.scan(fn, (x, cache), scanned)
+        return x, cache
+
+    if len(set(scan_kinds)) <= 1:
+        g = scan_kinds[0] if scan_kinds else 0
+        x, caches[g] = scan_group(
+            x, caches[g], tables[g], lp_all, plane_arr, win_arr
+        )
+    elif (c := _scan_period(scan_kinds)) is not None:
+        # Hybrid periodic pattern (gpt-oss alternating): scan over CYCLES
+        # of c layers; within a cycle the pool choice is static per
+        # sub-layer, so both pool carries update in place every step.
+        T = n_scan // c
+
+        def resh(a):
+            return a.reshape(T, c, *a.shape[1:])
+
+        cyc_scanned = (
+            jax.tree.map(resh, lp_all), resh(plane_arr), resh(win_arr)
+        )
+
+        def cyc(carry, scanned):
+            x, cf, cs = carry
+            cc = [cf, cs]
+            lp_c, plane_c, win_c = scanned
+            for j in range(c):
+                lp_s = jax.tree.map(lambda a: a[j], lp_c)
+                g = scan_kinds[j]  # periodic: same kind for every cycle
+                x, cc[g] = layer_body(
+                    x, cc[g], lp_s, plane_c[j], use_moe=cfg.is_moe,
+                    window=win_c[j] if g else None, table=tables[g],
+                )
+            return (x, cc[0], cc[1]), None
+
+        (x, caches[0], caches[1]), _ = jax.lax.scan(
+            cyc, (x, caches[0], caches[1]), cyc_scanned
+        )
+    else:
+        # Aperiodic hybrid (e.g. Qwen2 upper-layer sliding): contiguous
+        # homogeneous runs, one scan each.
+        off = 0
+        while off < n_scan:
+            g = scan_kinds[off]
+            ln = 1
+            while off + ln < n_scan and scan_kinds[off + ln] == g:
+                ln += 1
+            sl = slice(off, off + ln)
+            x, caches[g] = scan_group(
+                x, caches[g], tables[g],
+                jax.tree.map(lambda a: a[sl], lp_all),
+                plane_arr[sl], win_arr[sl] if g else None,
+            )
+            off += ln
+
+    hidden = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if kv_swa is None:
+        return hidden, caches[0]
+    return hidden, caches[0], caches[1]
 
 
 def compute_logits(params: dict, hidden: jax.Array, cfg: ModelConfig) -> jax.Array:

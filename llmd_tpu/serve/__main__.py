@@ -95,6 +95,7 @@ def make_engine_config(args, lora_adapters=None):
             num_blocks=args.num_gpu_blocks_override or 2048,
             dtype=args.kv_cache_dtype,
             enable_prefix_caching=not args.no_enable_prefix_caching,
+            swa_ring=args.kv_swa_ring,
         ),
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,
@@ -162,6 +163,15 @@ def build_parser() -> argparse.ArgumentParser:
         "role — the reference serves its headline path FP8)",
     )
     p.add_argument("--no-enable-prefix-caching", action="store_true")
+    p.add_argument(
+        "--kv-swa-ring", action="store_true",
+        help="ring-buffer KV pages for sliding-window layers (the "
+        "reference's hybrid KV cache manager role, pd patch-decode.yaml "
+        "--no-disable-hybrid-kv-cache-manager): sliding layers hold a "
+        "fixed per-sequence page ring instead of full-length pages — "
+        "~2x KV capacity on gpt-oss-class models; disables automatic "
+        "prefix caching while on",
+    )
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=2048)
     p.add_argument("--decode-window", type=int, default=1)

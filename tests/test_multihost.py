@@ -33,7 +33,7 @@ _WORKER = textwrap.dedent("""
     from llmd_tpu.engine import LLMEngine, SamplingParams
     from llmd_tpu.parallel import distributed as dist
 
-    pid, nproc, port, quant = (
+    pid, nproc, port, mode = (
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
     )
     dist.maybe_initialize(
@@ -42,12 +42,20 @@ _WORKER = textwrap.dedent("""
     assert jax.process_count() == nproc
     assert len(jax.devices()) == 8, jax.devices()
 
+    model_kw = dict(num_kv_heads=4, num_heads=8)
+    if mode == "int8":
+        model_kw["quantization"] = "int8"
+    if mode == "swa":  # sliding layers + ring pool over the broadcast path
+        model_kw.update(
+            num_layers=4, sliding_window=8,
+            layer_types=("sliding_attention", "full_attention") * 2,
+        )
     cfg = EngineConfig(
-        model=tiny_model_config(
-            num_kv_heads=4, num_heads=8,
-            quantization=quant if quant != "none" else None,
+        model=tiny_model_config(**model_kw),
+        cache=CacheConfig(
+            page_size=4, num_blocks=64, dtype="float32",
+            swa_ring=(mode == "swa"),
         ),
-        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
         scheduler=SchedulerConfig(
             max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
         ),
@@ -55,6 +63,8 @@ _WORKER = textwrap.dedent("""
         offload=None,
     )
     engine = LLMEngine(cfg)
+    if mode == "swa":
+        assert engine.runner.swa is not None
     if not dist.is_leader():
         engine.runner.follower_loop()
         sys.exit(0)
@@ -67,7 +77,7 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-def _single_process_reference(quant: str):
+def _single_process_reference(mode: str):
     """Same engine single-process on the 8-device CPU mesh (in-process)."""
     from llmd_tpu.config import (
         CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
@@ -75,12 +85,20 @@ def _single_process_reference(quant: str):
     )
     from llmd_tpu.engine import LLMEngine, SamplingParams
 
+    model_kw = dict(num_kv_heads=4, num_heads=8)
+    if mode == "int8":
+        model_kw["quantization"] = "int8"
+    if mode == "swa":
+        model_kw.update(
+            num_layers=4, sliding_window=8,
+            layer_types=("sliding_attention", "full_attention") * 2,
+        )
     cfg = EngineConfig(
-        model=tiny_model_config(
-            num_kv_heads=4, num_heads=8,
-            quantization=quant if quant != "none" else None,
+        model=tiny_model_config(**model_kw),
+        cache=CacheConfig(
+            page_size=4, num_blocks=64, dtype="float32",
+            swa_ring=(mode == "swa"),
         ),
-        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
         scheduler=SchedulerConfig(
             max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
         ),
@@ -127,12 +145,13 @@ def _run_multihost(quant: str) -> list:
     return json.loads(result_lines[0][len("RESULT "):])
 
 
-@pytest.mark.parametrize("quant", ["none", "int8"])
-def test_multihost_engine_matches_single_process(quant):
-    """Leader+follower over jax.distributed == single-process engine,
-    for both full-precision and int8-quantized weights."""
-    multi = _run_multihost(quant)
-    single = _single_process_reference(quant)
+@pytest.mark.parametrize("mode", ["none", "int8", "swa"])
+def test_multihost_engine_matches_single_process(mode):
+    """Leader+follower over jax.distributed == single-process engine:
+    full-precision, int8-quantized weights, and the SWA ring pool (whose
+    ring-view table rides the lockstep broadcast payload)."""
+    multi = _run_multihost(mode)
+    single = _single_process_reference(mode)
     assert multi == single, (multi, single)
 
 

@@ -1,0 +1,283 @@
+"""Ring-buffer KV pages for sliding-window layers (CacheConfig.swa_ring).
+
+The TPU-side analogue of the reference's hybrid KV cache manager
+(guides/pd-disaggregation/modelserver/gpu/vllm/base/patch-decode.yaml:19
+--no-disable-hybrid-kv-cache-manager): sliding layers hold a fixed ring of
+pages per sequence instead of full-length pages, roughly halving KV bytes
+for gpt-oss-class models (half the layers slide).
+
+Parity tests run generation PAST the ring length so logical pages alias
+onto overwritten ring slots — correctness then depends on the window mask
+excluding exactly the overwritten positions. Greedy float32 outputs must
+match the non-ring engine token for token.
+"""
+
+import numpy as np
+import pytest
+
+from llmd_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    swa_ring_spec,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams
+
+WINDOW = 8
+ALTERNATING = dict(
+    num_layers=4, num_heads=4, num_kv_heads=2,
+    sliding_window=WINDOW,
+    layer_types=(
+        "sliding_attention", "full_attention",
+        "sliding_attention", "full_attention",
+    ),
+)
+
+
+def _make_engine(cfg_over, ring, **kw):
+    cache_kw = kw.pop("cache_kw", {})
+    sched_kw = kw.pop("sched_kw", {})
+    parallel = kw.pop("parallel", None) or ParallelConfig()
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config(**cfg_over),
+        cache=CacheConfig(**{
+            "page_size": 4, "num_blocks": 64, "dtype": "float32",
+            "swa_ring": ring, **cache_kw,
+        }),
+        scheduler=SchedulerConfig(
+            **{"max_num_seqs": 4, "max_num_batched_tokens": 32, **sched_kw},
+        ),
+        parallel=parallel,
+        offload=None,
+    ))
+
+
+def _generate(eng, prompts, max_tokens=30):
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True)
+    return list(eng.generate(prompts, sp).values())
+
+
+def _parity(cfg_over, prompts, max_tokens=30, **kw):
+    """Greedy outputs must match between ring-on and ring-off engines."""
+    outs = {}
+    for ring in (False, True):
+        eng = _make_engine(cfg_over, ring, **kw)
+        try:
+            outs[ring] = _generate(eng, prompts, max_tokens)
+            if ring:
+                assert eng.runner.swa is not None, "ring did not resolve"
+                assert eng.runner.kv_swa is not None
+        finally:
+            eng.close()
+    assert outs[True] == outs[False]
+    return outs[True]
+
+
+# --------------------------------------------------------------------- #
+# spec resolution
+
+
+def test_ring_spec_geometry():
+    model = tiny_model_config(**ALTERNATING, max_model_len=256)
+    cache = CacheConfig(page_size=4, swa_ring=True)
+    sched = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=32)
+    spec = swa_ring_spec(model, cache, sched)
+    assert spec is not None
+    assert spec.full_layers == (1, 3) and spec.swa_layers == (0, 2)
+    # R = ceil((window + chunk) / page) + 1 = ceil(40/4) + 1 = 11
+    assert spec.ring_pages == 11
+    assert spec.num_swa_blocks == 4 * 11
+
+    # flag off / no sliding layers / ring as large as the table -> None
+    assert swa_ring_spec(model, CacheConfig(page_size=4), sched) is None
+    assert swa_ring_spec(tiny_model_config(), cache, sched) is None
+    short = tiny_model_config(**{**ALTERNATING, "max_model_len": 32})
+    assert swa_ring_spec(short, cache, sched) is None
+
+
+# --------------------------------------------------------------------- #
+# engine parity (generation wraps the ring)
+
+
+def test_parity_alternating_wraps_ring():
+    """gpt-oss pattern; 30 prompt + 30 decode = 60 tokens > 44-token ring
+    (the periodic cycle-scan path, c=2)."""
+    prompt = [(7 * i + 3) % 97 for i in range(30)]
+    out = _parity(ALTERNATING, [prompt], max_tokens=30)
+    assert len(out[0]) == 30
+
+
+def test_parity_uniform_sliding():
+    """Mistral pattern: every layer slides — the full-layer pool is empty
+    and the single-group scan runs entirely on the ring pool."""
+    over = dict(
+        num_layers=3, num_heads=4, num_kv_heads=2, sliding_window=WINDOW,
+    )
+    prompt = [(5 * i + 11) % 89 for i in range(26)]
+    _parity(over, [prompt], max_tokens=28)
+
+
+def test_parity_upper_layer_sliding():
+    """Qwen2 pattern (max_window_layers): aperiodic kinds -> the
+    contiguous-runs scan fallback."""
+    over = dict(
+        num_layers=4, num_heads=4, num_kv_heads=2, sliding_window=WINDOW,
+        max_window_layers=2,
+    )
+    prompt = [(3 * i + 17) % 83 for i in range(24)]
+    _parity(over, [prompt], max_tokens=30)
+
+
+def test_parity_batch_and_chunked_prefill():
+    """Several sequences of different lengths; prompts longer than the
+    token budget exercise chunked prefill against the ring."""
+    prompts = [
+        [(11 * i + 1) % 79 for i in range(54)],  # > 32-token budget
+        [(13 * i + 5) % 71 for i in range(9)],
+        [(17 * i + 7) % 61 for i in range(23)],
+    ]
+    _parity(ALTERNATING, prompts, max_tokens=20)
+
+
+def test_parity_fused_decode_window():
+    """K-step fused decode interleaves ring writes and windowed reads."""
+    prompt = [(19 * i + 2) % 67 for i in range(12)]
+    _parity(
+        ALTERNATING, [prompt], max_tokens=40,
+        sched_kw=dict(decode_window=4, max_num_seqs=1),
+    )
+
+
+def test_parity_sharded_tp2():
+    """tp=2 mesh: the ring pool shards its kv-head axis like the main
+    pool; sharded write/attention paths stay exact."""
+    prompt = [(23 * i + 9) % 59 for i in range(22)]
+    _parity(
+        ALTERNATING, [prompt], max_tokens=24,
+        parallel=ParallelConfig(tensor_parallel_size=2),
+    )
+
+
+def test_parity_with_sinks():
+    """gpt-oss proper: sinks + alternating sliding layers + ring."""
+    over = dict(**ALTERNATING, attention_sinks=True, attention_out_bias=True)
+    prompt = [(29 * i + 4) % 53 for i in range(20)]
+    _parity(over, [prompt], max_tokens=24)
+
+
+# --------------------------------------------------------------------- #
+# footprint and lifecycle
+
+
+def test_footprint_drops_for_long_context():
+    """With long max_model_len the ring pool is far smaller than the
+    full-length planes it replaces: for the alternating pattern (half the
+    layers slide) total KV bytes approach half."""
+    over = dict(**ALTERNATING, max_model_len=4096)
+    sized = dict(cache_kw=dict(num_blocks=1024))
+    off = _make_engine(over, False, **sized)
+    try:
+        bytes_off = off.runner.kv_bytes()
+    finally:
+        off.close()
+    on = _make_engine(over, True, **sized)
+    try:
+        bytes_on = on.runner.kv_bytes()
+        spec = on.runner.swa
+        # full pool keeps 2/4 layers; ring pool is 4 seqs x R pages
+        assert bytes_on < 0.6 * bytes_off, (bytes_on, bytes_off)
+        assert spec.num_swa_blocks < 1024
+    finally:
+        on.close()
+
+
+def test_ring_pages_released_on_finish_and_reuse():
+    eng = _make_engine(ALTERNATING, True)
+    try:
+        R = eng.runner.swa.ring_pages
+        for _ in range(3):
+            _generate(eng, [[1, 2, 3, 4, 5, 6, 7, 8]], max_tokens=6)
+            assert eng.swa_allocator.num_free_pages == eng.swa_allocator.num_pages
+        # mid-flight: exactly one ring held per running sequence
+        eng.add_request([9, 8, 7, 6, 5], SamplingParams(max_tokens=50, temperature=0.0, ignore_eos=True))
+        eng.step()
+        held = eng.swa_allocator.num_pages - eng.swa_allocator.num_free_pages
+        assert held == R
+    finally:
+        eng.close()
+
+
+def test_prefix_caching_disabled_with_ring():
+    eng = _make_engine(ALTERNATING, True)
+    try:
+        assert not eng.allocator.enable_prefix_caching
+        prompt = [(31 * i + 6) % 47 for i in range(20)]
+        first = _generate(eng, [prompt], max_tokens=10)
+        second = _generate(eng, [prompt], max_tokens=10)
+        assert first == second  # recompute path stays deterministic
+    finally:
+        eng.close()
+
+
+def test_composition_gates():
+    from llmd_tpu.config import OffloadConfig
+
+    base = dict(
+        model=tiny_model_config(**ALTERNATING),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32", swa_ring=True),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=32),
+    )
+    with pytest.raises(ValueError, match="kv_swa_ring"):
+        LLMEngine(EngineConfig(**base, kv_role="kv_producer", offload=None))
+    with pytest.raises(ValueError, match="kv_swa_ring"):
+        LLMEngine(EngineConfig(**base, offload=OffloadConfig(enabled=True)))
+
+
+def test_swa_blocks_smaller_than_one_ring_rejected():
+    """An explicit pool smaller than one ring would livelock admission
+    silently — it must be a config error instead."""
+    model = tiny_model_config(**ALTERNATING, max_model_len=256)
+    cache = CacheConfig(page_size=4, swa_ring=True, swa_blocks=8)
+    sched = SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=32)
+    with pytest.raises(ValueError, match="swa_blocks"):
+        swa_ring_spec(model, cache, sched)  # ring resolves to 11 > 8
+
+
+def test_failed_admission_returns_ring_pages():
+    """When ring allocation succeeds but main-pool pages are exhausted,
+    the still-waiting request must NOT keep its ring (a held ring could
+    stall a higher-priority arrival's admission)."""
+    # Main pool is tiny: the first request consumes nearly all pages.
+    eng = _make_engine(
+        ALTERNATING, True, cache_kw=dict(num_blocks=8),
+        sched_kw=dict(max_num_seqs=4),
+    )
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=64, ignore_eos=True)
+        eng.add_request([1, 2, 3, 4] * 6, sp)  # 24 toks -> 6 of 8 pages
+        eng.step()
+        free_before = eng.swa_allocator.num_free_pages
+        # Second request: ring allocates, pages fail -> ring must return.
+        eng.add_request([9, 8, 7, 6] * 5, sp)
+        eng.step()
+        waiting = list(eng.scheduler.waiting)
+        assert waiting and not waiting[0].swa_block_ids
+        held = free_before - eng.swa_allocator.num_free_pages
+        assert held == 0, f"waiting request still holds {held} ring pages"
+    finally:
+        eng.close()
+
+
+def test_ring_ignored_for_full_attention_models():
+    """swa_ring on a model without sliding layers is a no-op, not an
+    error (deploy configs can set it unconditionally)."""
+    eng = _make_engine(dict(num_layers=2, num_heads=4, num_kv_heads=2), True)
+    try:
+        assert eng.runner.swa is None and eng.runner.kv_swa is None
+        assert eng.allocator.enable_prefix_caching  # untouched
+        out = _generate(eng, [[1, 2, 3]], max_tokens=4)
+        assert len(out[0]) == 4
+    finally:
+        eng.close()
