@@ -230,12 +230,15 @@ class CacheConfig:
     # Trade-off: automatic prefix caching is disabled while the ring is on
     # (a cache hit would skip recomputing the sliding layers' in-window KV,
     # which the transient per-sequence rings do not retain) — the capacity
-    # win is the point of the flag. Also mutually exclusive with P/D KV
-    # transfer and tiered offload for now (both move full-table pages).
+    # win is the point of the flag. P/D KV transfer composes (ring
+    # producers export a sliding-layer section; ring consumers import via
+    # the request-preload path); tiered offload does not (host-cached
+    # pages would lack sliding-layer KV) and is refused loudly.
     swa_ring: bool = False
-    # Ring-pool page count; 0 = auto (max_num_seqs x ring_pages, sized so
-    # ring allocation can never fail while the engine is within
-    # max_num_seqs).
+    # Ring-pool page count; 0 = auto (max_num_seqs x ring_pages: one ring
+    # per possible running sequence; P/D preloads allocate extra rings at
+    # arrival and the scheduler reclaims waiting preloads' rings if the
+    # pool runs short, so admission never starves).
     swa_blocks: int = 0
 
     @property
@@ -288,6 +291,21 @@ class SwaRingSpec:
     # Per-sequence prefill chunk cap the scheduler enforces while the
     # ring is on (R is sized from it; chunking finer is always correct).
     chunk_tokens: int
+
+    def section(self, prompt_len: int, page_size: int) -> tuple[int, int, int]:
+        """Sliding-layer P/D export-section geometry: (n_pre, s0, count).
+
+        The ONE definition both transfer sides use (producer export and
+        consumer preload MUST agree byte-for-byte or the section lands at
+        the wrong ring slots). ``n_pre`` is the preloadable full-page
+        count (never the whole prompt — the last token is recomputed for
+        logits); the section spans logical pages [s0, n_pre), the window
+        before the continuation point.
+        """
+        n_pre = max(0, (prompt_len - 1) // page_size)
+        wmax = max(self.windows[i] for i in self.swa_layers)
+        s0 = max(0, (n_pre * page_size - wmax) // page_size)
+        return n_pre, s0, n_pre - s0
 
 
 # Per-seq prefill chunk cap that bounds the ring size independent of the

@@ -98,12 +98,6 @@ class LLMEngine:
                     "chunk can exceed the ring span the step-write/read "
                     "invariant is sized for (SwaRingSpec.chunk_tokens)"
                 )
-            if config.kv_role:
-                raise ValueError(
-                    "kv_swa_ring does not compose with P/D KV transfer "
-                    "(kv_role): exported full-pool pages would lack the "
-                    "sliding layers' KV — disable one of the two"
-                )
             if config.offload is not None and config.offload.enabled:
                 raise ValueError(
                     "kv_swa_ring does not compose with tiered KV offload: "
@@ -242,7 +236,11 @@ class LLMEngine:
         # P/D consumer: pull remote KV and seed the local prefix cache before
         # the request is ever scheduled, so prefill becomes a cache hit. The
         # async serving layer pre-fetches off-thread and hands the bundle in
-        # via "__pulled__"; the sync path fetches inline.
+        # via "__pulled__"; the sync path fetches inline. Ring engines
+        # (kv_swa_ring) have no prefix cache — their transfers land via
+        # the PRELOAD path: pages (full-group + a fresh ring holding the
+        # sliding-layer section) handed straight to the Request below.
+        preload = None
         if self.kv_connector is not None and self.kv_connector.wants_import(
             kv_transfer_params
         ):
@@ -254,7 +252,15 @@ class LLMEngine:
                     list(prompt_token_ids), kv_transfer_params
                 )
             if bundle is not None:
-                self.kv_connector.apply_bundle(list(prompt_token_ids), bundle)
+                if self._swa is not None:
+                    preload = self.kv_connector.apply_preload(
+                        list(prompt_token_ids), bundle,
+                        self.swa_allocator, self._swa.ring_pages,
+                    )
+                else:
+                    self.kv_connector.apply_bundle(
+                        list(prompt_token_ids), bundle
+                    )
         # Tiered offload: pull host-cached pages extending the device prefix
         # run back into HBM before scheduling (restore-on-prefill).
         if self.offloader is not None:
@@ -268,6 +274,14 @@ class LLMEngine:
             lora_id=lora_id,
             lora_name=lora_name,
         )
+        if preload is not None:
+            # Transferred KV handed straight to the request (ring mode):
+            # admission skips the preloaded prefix; only the recompute
+            # tail (at least the last token) is prefilled locally.
+            req.block_ids = list(preload["block_ids"])
+            req.swa_block_ids = list(preload["swa_block_ids"])
+            req.num_computed_tokens = preload["tokens"]
+            req.num_cached_tokens = preload["tokens"]
         self.scheduler.add_request(req)
         return rid
 

@@ -611,32 +611,47 @@ class ModelRunner:
 
         return jax.jit(scatter, donate_argnums=(0,))
 
-    def _exec_kv_gather(self, arrays: dict, q8: bool):
-        fn = self._replicated_gather_q8 if q8 else self._replicated_gather
-        return fn(self.kv_cache, jnp.asarray(arrays["ids"]))
+    def _pool(self, swa: bool):
+        """Select the staging target: the main pool or the SWA ring pool.
+        The staging programs themselves are pool-agnostic (the pool is an
+        argument), so both pools share them."""
+        return self.kv_swa if swa else self.kv_cache
 
-    def _exec_kv_scatter(self, arrays: dict, n: int) -> None:
-        data = self._kv_data
+    def _pool_data(self, swa: bool) -> jax.Array:
+        kv = self._pool(swa)
+        return kv[0] if isinstance(kv, tuple) else kv
+
+    def _exec_kv_gather(self, arrays: dict, q8: bool, swa: bool = False):
+        fn = self._replicated_gather_q8 if q8 else self._replicated_gather
+        return fn(self._pool(swa), jnp.asarray(arrays["ids"]))
+
+    def _exec_kv_scatter(self, arrays: dict, n: int, swa: bool = False) -> None:
+        data = self._pool_data(swa)
         Kc = data.shape[2] // self.kv_rep
-        shape = (self.cfg.num_layers, n, Kc, self.page, data.shape[4])
+        shape = (data.shape[0], n, Kc, self.page, data.shape[4])
         vals = np.frombuffer(
             np.ascontiguousarray(arrays["vals_u8"]).data,
             dtype=self.staging_dtype,
         ).reshape(shape)
-        self.kv_cache = self._scatter_canonical(
-            self.kv_cache, jnp.asarray(arrays["ids"]), jnp.asarray(vals)
+        out = self._scatter_canonical(
+            self._pool(swa), jnp.asarray(arrays["ids"]), jnp.asarray(vals)
         )
+        if swa:
+            self.kv_swa = out
+        else:
+            self.kv_cache = out
 
-    def _kv_gather_lockstep(self, ids: np.ndarray, q8: bool):
+    def _kv_gather_lockstep(self, ids: np.ndarray, q8: bool, swa: bool = False):
         """Leader leg of a multi-host page gather: broadcast the op so
         every process dispatches the same program; return the (replicated)
         result. Engine/leader thread only — the broadcast stream is
-        totally ordered by the single engine thread."""
+        totally ordered by the single engine thread. The header's 4th
+        slot carries the pool selector (main vs SWA ring) for KV ops."""
         assert dist.is_leader(), "KV staging ops originate on the leader"
         arrays = self._sync(
-            _OP_KV_GATHER, len(ids), int(q8), False, {"ids": ids}
+            _OP_KV_GATHER, len(ids), int(q8), bool(swa), {"ids": ids}
         )
-        return self._exec_kv_gather(arrays, q8)
+        return self._exec_kv_gather(arrays, q8, swa)
 
     # ------------------------------------------------------------------ #
     # host-side input prep
@@ -728,10 +743,13 @@ class ModelRunner:
         if op == _OP_KV_GATHER:
             return [("ids", (B,), np.int32)]
         if op == _OP_KV_SCATTER:
-            data = self._kv_data
+            # QK carries the pool selector (main vs SWA ring): the two
+            # pools have different layer counts, so the payload geometry
+            # both sides derive depends on it.
+            data = self._pool_data(bool(QK))
             Kc = data.shape[2] // self.kv_rep
             nbytes = (
-                self.cfg.num_layers * B * Kc * self.page
+                data.shape[0] * B * Kc * self.page
                 * data.shape[4] * self.staging_dtype.itemsize
             )
             return [("ids", (B,), np.int32), ("vals_u8", (nbytes,), np.uint8)]
@@ -808,10 +826,11 @@ class ModelRunner:
             elif op == _OP_KV_GATHER:
                 # Participate in the SPMD gather (the all-gather collective
                 # needs every process); the replicated result is dropped —
-                # only the leader stages it to the network.
-                self._exec_kv_gather(arrays, bool(QK))
+                # only the leader stages it to the network. ``greedy``
+                # carries the pool selector for KV ops.
+                self._exec_kv_gather(arrays, bool(QK), bool(greedy))
             elif op == _OP_KV_SCATTER:
-                self._exec_kv_scatter(arrays, B)
+                self._exec_kv_scatter(arrays, B, bool(QK))
             else:
                 self._exec_decode(arrays, QK, bool(greedy))
 
@@ -900,6 +919,18 @@ class ModelRunner:
         # in-program to the staging dtype.
         return self._replicated_gather(self.kv_cache, jnp.asarray(ids))
 
+    def snapshot_swa_pages_device(self, page_ids: list[int], pad_to: int) -> jax.Array:
+        """On-device snapshot of SWA RING pages (sliding-layer pool):
+        [L_swa, pad_to, K, page, 2D] canonical heads, dequantized to the
+        staging dtype for int8 pools. Same async-dispatch contract as
+        snapshot_pages_device; the P/D export of a ring engine ships the
+        trailing in-window ring pages through this."""
+        assert self.swa is not None, "no SWA ring pool on this runner"
+        ids = _padded_ids(page_ids, pad_to)
+        if self._multihost:
+            return self._kv_gather_lockstep(ids, q8=False, swa=True)
+        return self._replicated_gather(self.kv_swa, jnp.asarray(ids))
+
     def snapshot_pages_device_q8(
         self, page_ids: list[int], pad_to: int
     ) -> tuple[jax.Array, jax.Array]:
@@ -944,13 +975,15 @@ class ModelRunner:
             jnp.asarray(q8), jnp.asarray(scales), self.staging_dtype_name
         )
 
-    def scatter_pages_from_device(self, page_ids: list[int], vals) -> None:
+    def scatter_pages_from_device(
+        self, page_ids: list[int], vals, swa: bool = False
+    ) -> None:
         """Engine-thread leg of a pipelined import: device -> pool scatter
         of an already-uploaded chunk (head expansion device-side).
         ``vals`` is a float bundle, or a (q8, wire scales) pair — int8
         pools scatter the pair directly; float pools dequantize on
         device first (the local fast path hands q8 device snapshots to
-        any consumer pool dtype)."""
+        any consumer pool dtype). ``swa`` targets the SWA ring pool."""
         self._require_single_host("scatter_pages_from_device (P/D staging)")
         # Device chunks may come from ANOTHER engine's mesh (the local
         # fast path claims the producer's snapshots; e.g. a tp=1
@@ -961,16 +994,22 @@ class ModelRunner:
         ids = place(np.asarray(page_ids, np.int32))
         if isinstance(vals, tuple):
             if self.kv_quantized:
-                self.kv_cache = self._scatter_q8_direct(
-                    self.kv_cache, ids, place(vals[0]), place(vals[1])
+                out = self._scatter_q8_direct(
+                    self._pool(swa), ids, place(vals[0]), place(vals[1])
                 )
+                if swa:
+                    self.kv_swa = out
+                else:
+                    self.kv_cache = out
                 return
             vals = _dequantize_rows_q8(
                 vals[0], vals[1], self.staging_dtype_name
             )
-        self.kv_cache = self._scatter_canonical(
-            self.kv_cache, ids, place(vals)
-        )
+        out = self._scatter_canonical(self._pool(swa), ids, place(vals))
+        if swa:
+            self.kv_swa = out
+        else:
+            self.kv_cache = out
 
     def gather_pages(self, page_ids: list[int]) -> np.ndarray:
         """Stage pages HBM -> host: returns [L, n, K, page, 2D] ndarray.
@@ -990,8 +1029,11 @@ class ModelRunner:
             snap = self._replicated_gather(self.kv_cache, jnp.asarray(ids))
         return np.ascontiguousarray(self.download_pages(snap)[:, :n])
 
-    def scatter_pages(self, page_ids: list[int], pages: np.ndarray) -> None:
-        """Stage pages host -> HBM into the given physical page slots.
+    def scatter_pages(
+        self, page_ids: list[int], pages: np.ndarray, swa: bool = False
+    ) -> None:
+        """Stage pages host -> HBM into the given physical page slots
+        (``swa`` targets the SWA ring pool).
 
         Pads the page count up to a bucket by repeating the last (id, value)
         pair — a duplicate scatter of identical values is idempotent — so
@@ -1010,21 +1052,23 @@ class ModelRunner:
         if self._multihost:
             # Lockstep scatter: canonical-head values broadcast to every
             # process (one collective), head expansion (and int8-pool
-            # quantization) on device.
+            # quantization) on device. QK slot = pool selector.
             assert dist.is_leader(), "KV staging ops originate on the leader"
             vals = np.ascontiguousarray(
                 np.asarray(pages).astype(self.staging_dtype, copy=False)
             )
             arrays = self._sync(
-                _OP_KV_SCATTER, bucket, 0, False,
+                _OP_KV_SCATTER, bucket, int(swa), False,
                 {"ids": ids, "vals_u8": vals.view(np.uint8).reshape(-1)},
             )
-            self._exec_kv_scatter(arrays, bucket)
+            self._exec_kv_scatter(arrays, bucket, swa)
             return
         vals = jnp.asarray(np.asarray(pages), dtype=self.staging_dtype)
-        self.kv_cache = self._scatter_canonical(
-            self.kv_cache, jnp.asarray(ids), vals
-        )
+        out = self._scatter_canonical(self._pool(swa), jnp.asarray(ids), vals)
+        if swa:
+            self.kv_swa = out
+        else:
+            self.kv_cache = out
 
     # ------------------------------------------------------------------ #
 

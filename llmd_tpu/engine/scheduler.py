@@ -204,14 +204,18 @@ class EngineScheduler:
                 break
             if not self.config.enable_chunked_prefill and chunk < remaining:
                 break  # whole-prompt admission only
-            if not self._ensure_ring(req):
+            if not self._ensure_ring(req) and not (
+                self._reclaim_waiting_ring(req) and self._ensure_ring(req)
+            ):
                 break  # out of ring pages; retry next step
             if not self._ensure_pages(req, chunk):
                 # Return the ring: a still-waiting request holding R ring
                 # pages would break the pool's sizing guarantee and could
-                # stall a higher-priority arrival's admission (nothing has
-                # been computed into it — freeing is always safe here).
-                if req.swa_block_ids:
+                # stall a higher-priority arrival's admission. Safe only
+                # while nothing has been computed into it — a PRELOADED
+                # ring (P/D import, num_computed > 0) holds transferred
+                # sliding-layer KV and must be kept.
+                if req.swa_block_ids and req.num_computed_tokens == 0:
                     self.swa_allocator.free(req.swa_block_ids)
                     req.swa_block_ids = []
                     req.swa_table_row = None
@@ -270,9 +274,12 @@ class EngineScheduler:
     def _ensure_ring(self, req: Request) -> bool:
         """Allocate the sequence's sliding-window ring (once, at admission).
 
-        The auto-sized ring pool (max_num_seqs x R) makes failure
-        impossible within max_num_seqs; an explicit smaller swa_blocks
-        turns shortage into a wait-for-next-step, like the main pool.
+        The auto-sized ring pool (max_num_seqs x R) covers every RUNNING
+        sequence; P/D preloads additionally allocate rings at add_request
+        time (outside admission), so a burst of preloaded arrivals can
+        transiently exhaust the pool — _reclaim_waiting_ring keeps the
+        queue head admissible then. An explicit smaller swa_blocks turns
+        shortage into a wait-for-next-step, like the main pool.
         """
         if self.swa_allocator is None or req.swa_block_ids:
             return True
@@ -281,6 +288,25 @@ class EngineScheduler:
             return True
         except NoFreePagesError:
             return False
+
+    def _reclaim_waiting_ring(self, req: Request) -> bool:
+        """Downgrade the youngest preloaded WAITING request: free its ring
+        (and preloaded pages), resetting it to plain local recompute.
+
+        Without this, preloaded arrivals holding rings behind a ring-less
+        queue head would starve admission forever (nothing running, so no
+        ring would ever free) — correctness over the transfer savings.
+        """
+        for victim in reversed(self.waiting):
+            if victim is req or not victim.swa_block_ids:
+                continue
+            if victim.status is not RequestStatus.WAITING:
+                continue
+            self._release(victim)  # frees pages + ring
+            victim.num_computed_tokens = 0
+            victim.num_cached_tokens = 0
+            return True
+        return False
 
     def _ensure_pages(self, req: Request, new_tokens: int) -> bool:
         need_slots = req.num_computed_tokens + new_tokens

@@ -105,6 +105,14 @@ class PulledBundle:
     # Prompt-page index of the first page in the first PULLED chunk
     # (byte diet: producer-skipped pages + consumer-skipped chunks).
     start_page: int = 0
+    # Sliding-layer section of a ring export (kv_swa_ring): the trailing
+    # in-window ring pages [L_swa, swa_pages, K, page, 2D] and the logical
+    # prompt page the section starts at. host array, device snapshot
+    # (local fastpath), or None.
+    swa_pages_np: np.ndarray | None = None
+    swa_device: Any = None
+    swa_start_page: int = 0
+    swa_count: int = 0
 
     @staticmethod
     def _dequant_chunk(c) -> np.ndarray:
@@ -134,14 +142,23 @@ def chunk_key(key: str, j: int) -> str:
     return f"{key}:c{j}"
 
 
+def swa_key(key: str) -> str:
+    """Shipper key of a ring export's sliding-layer section (the trailing
+    in-window ring pages a kv_swa_ring producer ships alongside the
+    full-group chunks)."""
+    return f"{key}:swa"
+
+
 def transfer_keys(params: dict) -> list[str]:
     """Every shipper key a transfer's lease heartbeat must renew (chunked
-    exports register one key per chunk; legacy bundles just one)."""
+    exports register one key per chunk; legacy bundles just one; ring
+    exports add the sliding-layer section)."""
     key = params.get("remote_key", "")
     n = int(params.get("num_chunks", 0) or 0)
-    if n <= 0:
-        return [key]
-    return [chunk_key(key, j) for j in range(n)]
+    keys = [key] if n <= 0 else [chunk_key(key, j) for j in range(n)]
+    if int(params.get("swa_pages", 0) or 0) > 0:
+        keys.append(swa_key(key))
+    return keys
 
 
 def pack_header(pages: np.ndarray) -> bytes:
@@ -248,9 +265,15 @@ class TPUConnector:
         self.cfg = cfg
         self.runner = runner
         self.allocator = allocator
-        if cfg.is_consumer and not allocator.enable_prefix_caching:
+        if (
+            cfg.is_consumer
+            and not allocator.enable_prefix_caching
+            and getattr(runner, "swa", None) is None
+        ):
             # The import path lands remote KV as prefix-cache seeds; with
             # caching off every transfer would be paid for zero benefit.
+            # (Ring engines are the exception: they import through the
+            # PRELOAD path — pages handed straight to the request.)
             raise ValueError(
                 "kv_consumer role requires enable_prefix_caching=True"
             )
@@ -358,6 +381,27 @@ class TPUConnector:
         snaps = [
             snap_fn(ids[j * cp : (j + 1) * cp], cp) for j in range(n_chunks)
         ]
+        # Ring engines (kv_swa_ring) ship a sliding-layer SECTION: the
+        # trailing ring pages covering the window before the consumer's
+        # continuation point. Both sides derive the same geometry from
+        # (prompt_len, page, window): preload covers n_pre full pages
+        # (never the whole prompt — the last token must be recomputed for
+        # logits), and post-preload queries need sliding keys back to
+        # n_pre*page - window.
+        swa_snap, swa_s0, swa_n = None, 0, 0
+        spec = getattr(self.runner, "swa", None)
+        if spec is not None and req.swa_block_ids:
+            n_pre, swa_s0, swa_n = spec.section(req.num_prompt_tokens, page)
+            if swa_n > 0:
+                R = len(req.swa_block_ids)
+                ring_ids = [
+                    req.swa_block_ids[l % R] for l in range(swa_s0, n_pre)
+                ]
+                swa_snap = self.runner.snapshot_swa_pages_device(
+                    ring_ids, swa_n
+                )
+            else:
+                swa_s0, swa_n = 0, 0
         if snaps and self._local_enabled:
             # Short retention: a legit in-process claim follows the
             # prefill response within milliseconds; a CROSS-host consumer
@@ -366,10 +410,11 @@ class TPUConnector:
             deadline = time.monotonic() + min(self.cfg.lease_ms / 1e3, 5.0)
             with self._local_lock:
                 self._prune_local_locked()
-                self._local_exports[key] = (deadline, snaps)
-        if snaps:
+                self._local_exports[key] = (deadline, snaps, swa_snap)
+        if snaps or swa_snap is not None:
             threading.Thread(
-                target=self._stage_chunks, args=(key, snaps), daemon=True
+                target=self._stage_chunks, args=(key, snaps, swa_snap),
+                daemon=True,
             ).start()
         self.exported_requests += 1
         return {
@@ -383,6 +428,10 @@ class TPUConnector:
             # First exported page (pages [0, start_page) were declared
             # cached on the consumer and are not staged).
             "start_page": skip,
+            # Sliding-layer section geometry (0 pages = no section; a
+            # ring consumer refuses params without one).
+            "swa_pages": swa_n,
+            "swa_start_page": swa_s0,
         }
 
     # Cross-host consumers never claim; cap retained pending exports so a
@@ -391,17 +440,20 @@ class TPUConnector:
 
     def _prune_local_locked(self) -> None:
         now = time.monotonic()
-        for k in [k for k, (dl, _) in self._local_exports.items() if dl < now]:
+        for k in [
+            k for k, entry in self._local_exports.items() if entry[0] < now
+        ]:
             del self._local_exports[k]
         while len(self._local_exports) > self._MAX_LOCAL_PENDING:
             self._local_exports.pop(next(iter(self._local_exports)))
 
-    def claim_local(self, key: str) -> list | None:
+    def claim_local(self, key: str) -> tuple | None:
         """In-process consumer leg of the single-host fast path: take the
         pending device snapshots for ``key`` (stops any remaining host
         staging; already-registered chunks are freed by the consumer's
-        ordinary free-notify). Entries live until claimed, expiry (5s),
-        or the pending cap evicts them."""
+        ordinary free-notify). Returns (chunk snaps, swa snap or None).
+        Entries live until claimed, expiry (5s), or the pending cap
+        evicts them."""
         with self._local_lock:
             self._prune_local_locked()
             entry = self._local_exports.pop(key, None)
@@ -410,16 +462,29 @@ class TPUConnector:
                 # is the thread's early-exit signal); setting it for an
                 # already-finished key would leak the entry forever.
                 self._local_claimed.add(key)
-        return None if entry is None else entry[1]
+        return None if entry is None else (entry[1], entry[2])
 
-    def _stage_chunks(self, key: str, snaps: list) -> None:
+    def _stage_chunks(self, key: str, snaps: list, swa_snap=None) -> None:
         """Staging thread: download each snapshot and register it. A failed
         download leaves later chunks unregistered; the consumer's pull wait
-        times out and its load-failure policy decides."""
+        times out and its load-failure policy decides. The sliding-layer
+        section (tiny: <= a window's worth of ring pages) registers FIRST
+        so a ring consumer's final pull never waits on the big chunks."""
         t0 = time.monotonic()
         with self._local_lock:
             self._staging_active.add(key)
         try:
+            if swa_snap is not None and key not in self._local_claimed:
+                pages = self.runner.download_pages(swa_snap)
+                payload = (
+                    pages if pages.dtype.isbuiltin == 1
+                    else pages.view(np.uint8)
+                )
+                self.server.register(
+                    swa_key(key), payload, self.cfg.lease_ms,
+                    header=pack_header(pages),
+                )
+                self.exported_bytes += payload.nbytes
             for j, snap in enumerate(snaps):
                 if key in self._local_claimed:
                     # An in-process consumer took the device path; the
@@ -487,6 +552,28 @@ class TPUConnector:
                 f"producer sent {n_full} pages but prompt has only "
                 f"{len(hashes)} full pages"
             )
+        ring_mode = getattr(self.runner, "swa", None) is not None
+        n_swa = int(params.get("swa_pages", 0) or 0)
+        swa_sp = int(params.get("swa_start_page", 0) or 0)
+        if ring_mode and n_swa <= 0:
+            # A ring consumer cannot decode from full-group pages alone:
+            # the sliding layers' in-window KV must arrive too. Mixed-mode
+            # pairings (ring-off producer) are not supported — the policy
+            # decides (recompute/fail), never a silent wrong answer.
+            raise ValueError(
+                "kv_swa_ring consumer requires a sliding-layer section in "
+                "the export (pair it with a kv_swa_ring producer)"
+            )
+        if ring_mode and int(params.get("start_page", 0) or 0) > 0:
+            # Ring consumers have no prefix cache, so they never probe and
+            # never request a partial export; a nonzero skip (stale or
+            # hostile kv_transfer_params) would leave pages [0, skip)
+            # uninitialized while marked computed — refuse into the policy
+            # rather than silently decode garbage.
+            raise ValueError(
+                "kv_swa_ring consumer cannot use a partial export "
+                "(start_page > 0)"
+            )
         host, port, key = params["remote_host"], int(params["remote_port"]), params["remote_key"]
         want_dtype = np.dtype(self.runner.staging_dtype)
         # Int8 pools re-quantize whatever arrives (the pool itself is the
@@ -533,18 +620,28 @@ class TPUConnector:
         # snapshots are claimed directly — no host staging, no wire
         # bytes (production shape: reference single-host/pd recipes; on
         # a multi-chip host this is the ICI copy).
+        all_keys = [chunk_key(key, j) for j in range(n_chunks)]
+        if n_swa:
+            all_keys.append(swa_key(key))
         if self.cfg.local_fastpath and not getattr(self.runner, "_multihost", False):
             producer = _lookup_local(host, port)
             if producer is not None:
-                snaps = producer.claim_local(key)
-                if snaps is not None:
+                claimed = producer.claim_local(key)
+                if claimed is not None:
+                    snaps, swa_snap = claimed
+                    if ring_mode and swa_snap is None:
+                        raise ValueError(
+                            "local claim carried no sliding-layer snapshot"
+                        )
                     self.local_imports += 1
                     return PulledBundle(
                         pages=None, hashes=hashes[:n_full], nbytes=0,
                         host=host, port=port, key=key,
-                        keys=[chunk_key(key, j) for j in range(n_chunks)],
+                        keys=all_keys,
                         device_chunks=snaps, np_chunks=[], chunk_pages=cp,
                         start_page=sp,
+                        swa_device=swa_snap if ring_mode else None,
+                        swa_start_page=swa_sp, swa_count=n_swa,
                     )
         # Consumer-side byte diet: skip whole chunks the local prefix
         # cache already holds (the producer may have exported more than
@@ -567,8 +664,28 @@ class TPUConnector:
         # a trickling producer can't hold the executor thread for
         # n_chunks x 20s before the failure policy kicks in.
         per_chunk_s = min(self.cfg.lease_ms / 1e3, 20.0)
-        hard_deadline = time.monotonic() + per_chunk_s + 2.0 * n_chunks
+        hard_deadline = time.monotonic() + per_chunk_s + 2.0 * (n_chunks + 1)
         np_chunks, dev_chunks, nbytes = [], [], 0
+        swa_np = None
+        if ring_mode and n_swa:
+            # The sliding-layer section first: it registers first and is
+            # tiny, so a missing/expired export fails fast.
+            blob = shipper_mod.pull_wait(
+                host, port, swa_key(key),
+                min(time.monotonic() + per_chunk_s, hard_deadline),
+            )
+            swa_np = unpack_pages(blob)
+            if swa_np.shape[1] != n_swa:
+                raise ValueError(
+                    f"sliding section holds {swa_np.shape[1]} pages, "
+                    f"expected {n_swa}"
+                )
+            if swa_np.dtype != want_dtype and not pool_quant:
+                raise ValueError(
+                    f"sliding-section KV dtype mismatch: {swa_np.dtype} "
+                    f"vs consumer {want_dtype}"
+                )
+            nbytes += len(blob)
         for j in range(j0, n_chunks):
             blob = shipper_mod.pull_wait(
                 host, port, chunk_key(key, j),
@@ -606,9 +723,10 @@ class TPUConnector:
         return PulledBundle(
             pages=None, hashes=hashes[:n_full], nbytes=nbytes,
             host=host, port=port, key=key,
-            keys=[chunk_key(key, j) for j in range(n_chunks)],
+            keys=all_keys,
             device_chunks=dev_chunks, np_chunks=np_chunks, chunk_pages=cp,
             start_page=start_page,
+            swa_pages_np=swa_np, swa_start_page=swa_sp, swa_count=n_swa,
         )
 
     def fetch_remote_policy(
@@ -721,6 +839,118 @@ class TPUConnector:
         self._notify_free_async(bundle)
         self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
         return adopted
+
+    def apply_preload(
+        self,
+        prompt_token_ids: list[int],
+        bundle: "PulledBundle",
+        swa_allocator: PageAllocator,
+        ring_pages: int,
+    ) -> dict[str, Any] | None:
+        """Engine-thread half of a RING-mode import (kv_swa_ring).
+
+        With the ring on there is no prefix cache to seed, so the
+        transferred KV is handed straight to the request instead:
+        full-group pages land in freshly allocated (ref-held) main-pool
+        pages, the sliding-layer section lands in a freshly allocated
+        ring at the matching ring slots, and the caller constructs the
+        Request with these pages and num_computed_tokens pre-set — the
+        scheduler then prefills only the recompute tail. All-or-nothing:
+        any failure frees everything and returns None (local recompute),
+        mirroring apply_bundle's degradation.
+        """
+        from llmd_tpu.engine.kv_cache import NoFreePagesError
+
+        t_apply = time.monotonic()
+        page = self.allocator.page_size
+        n_full = len(bundle.hashes)
+        spec = self.runner.swa
+        # Shared geometry (SwaRingSpec.section): producer and consumer
+        # MUST derive the identical (n_pre, s0) from the prompt alone.
+        n_pre, _s0, _cnt = spec.section(len(prompt_token_ids), page)
+        n_pre = min(n_full, n_pre)
+        if (
+            n_pre <= 0
+            or bundle.swa_count <= 0
+            or bundle.start_page != 0  # partial exports rejected at fetch;
+            # defense in depth for hand-built bundles
+            or not (
+                bundle.swa_pages_np is not None
+                or bundle.swa_device is not None
+            )
+        ):
+            self._notify_free_async(bundle)
+            return None
+        page_ids: list[int] = []
+        ring_ids: list[int] = []
+        try:
+            # Land ALL exported pages, then hand the request only the
+            # first n_pre: chunk writes beyond the preload boundary (the
+            # producer may have exported one more page than we keep, plus
+            # its pad columns) land in real scratch slots instead of
+            # clobbering a kept page, and the spares free right after.
+            page_ids = self.allocator.allocate(n_full)
+            ring_ids = swa_allocator.allocate(ring_pages)
+            # Full-group content into the main pool.
+            if bundle.device_chunks:
+                cp = bundle.chunk_pages
+                for j, dev in enumerate(bundle.device_chunks):
+                    p0 = bundle.start_page + j * cp
+                    ids_j = page_ids[p0 : p0 + cp]
+                    if len(ids_j) < cp:
+                        # Producer-padded tail columns REPEAT the last
+                        # real page — aiming pad slots at it is idempotent
+                        # (same trick as apply_bundle).
+                        ids_j = ids_j + [ids_j[-1]] * (cp - len(ids_j))
+                    self.runner.scatter_pages_from_device(ids_j, dev)
+            elif bundle.pages is not None or bundle.np_chunks:
+                want = bundle.host_pages(n_full)
+                self.runner.scatter_pages(page_ids, want[:, : n_full])
+            else:
+                raise ValueError("preload bundle carries no full-group data")
+            # Sliding-layer section into the ring at matching slots:
+            # logical prompt page l lives at ring[l % R] — the same
+            # mapping the engine's ring-view table uses from here on.
+            n_swa = min(bundle.swa_count, n_pre - bundle.swa_start_page)
+            if n_swa <= 0:
+                raise ValueError(
+                    f"sliding section [{bundle.swa_start_page}, "
+                    f"+{bundle.swa_count}) misses the preload range "
+                    f"[0, {n_pre})"
+                )
+            swa_ids = [
+                ring_ids[(bundle.swa_start_page + i) % ring_pages]
+                for i in range(n_swa)
+            ]
+            if bundle.swa_device is not None:
+                self.runner.scatter_pages_from_device(
+                    swa_ids, bundle.swa_device, swa=True
+                )
+            else:
+                self.runner.scatter_pages(
+                    swa_ids, bundle.swa_pages_np[:, :n_swa], swa=True
+                )
+        except (NoFreePagesError, ValueError, KeyError, TypeError) as e:
+            self.import_failures += 1
+            log.warning("KV ring preload failed, recomputing locally: %s", e)
+            if page_ids:
+                self.allocator.free(page_ids)
+            if ring_ids:
+                swa_allocator.free(ring_ids)
+            self._notify_free_async(bundle)
+            return None
+        if len(page_ids) > n_pre:
+            self.allocator.free(page_ids[n_pre:])
+            page_ids = page_ids[:n_pre]
+        self.imported_requests += 1
+        self.imported_bytes += bundle.nbytes
+        self._notify_free_async(bundle)
+        self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
+        return {
+            "block_ids": page_ids,
+            "swa_block_ids": ring_ids,
+            "tokens": n_pre * page,
+        }
 
     def import_for_prompt(self, prompt_token_ids: list[int], params: dict) -> int:
         """Synchronous fetch + apply (offline engine path and tests)."""

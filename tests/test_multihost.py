@@ -175,7 +175,7 @@ _PD_WORKER = textwrap.dedent("""
     from llmd_tpu.engine import LLMEngine, SamplingParams
     from llmd_tpu.parallel import distributed as dist
 
-    role, pid, nproc, port, tmpdir, transfer_dtype = (
+    role, pid, nproc, port, tmpdir, mode = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
         sys.argv[5], sys.argv[6],
     )
@@ -186,10 +186,25 @@ _PD_WORKER = textwrap.dedent("""
 
     PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7]  # 3 full pages @4
 
+    # mode: a transfer dtype ("auto"/"int8"), or "swa" = sliding model
+    # with the ring pool on BOTH sides — multi-host P/D through the
+    # preload path, sliding section staged via the pool-flagged lockstep
+    # gather/scatter ops.
+    swa_ring = mode == "swa"
+    model_kw = dict(num_kv_heads=4, num_heads=8)
+    if swa_ring:
+        model_kw.update(
+            num_layers=4, sliding_window=8,
+            layer_types=("sliding_attention", "full_attention") * 2,
+        )
+
     def make_cfg(kv_role):
         return EngineConfig(
-            model=tiny_model_config(num_kv_heads=4, num_heads=8),
-            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            model=tiny_model_config(**model_kw),
+            cache=CacheConfig(
+                page_size=4, num_blocks=64, dtype="float32",
+                swa_ring=swa_ring,
+            ),
             scheduler=SchedulerConfig(
                 max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
             ),
@@ -198,7 +213,7 @@ _PD_WORKER = textwrap.dedent("""
             ),
             kv_role=kv_role,
             kv_transfer_port=0,
-            kv_transfer_dtype=transfer_dtype,
+            kv_transfer_dtype="auto" if swa_ring else mode,
             offload=None,
         )
 
@@ -298,11 +313,13 @@ def _spawn_world(script, role, nproc, per_proc_devices, argv_extra):
     return procs
 
 
-@pytest.mark.parametrize("transfer_dtype", ["auto", "int8"])
+@pytest.mark.parametrize("transfer_dtype", ["auto", "int8", "swa"])
 def test_multihost_pd_transfer(tmp_path, transfer_dtype):
     """Producer and consumer engines, each a 2-process world (tp=4 over
     4 devices spanning the processes): decode consumes transferred KV
-    with token parity against a local-prefill reference run."""
+    with token parity against a local-prefill reference run. The "swa"
+    mode runs the ring pool on both sides (sliding-section export +
+    request-preload import over the lockstep staging ops)."""
     producers = _spawn_world(
         _PD_WORKER, "producer", 2, 2, [str(tmp_path), transfer_dtype]
     )

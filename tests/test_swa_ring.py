@@ -230,9 +230,15 @@ def test_composition_gates():
         scheduler=SchedulerConfig(max_num_seqs=2, max_num_batched_tokens=32),
     )
     with pytest.raises(ValueError, match="kv_swa_ring"):
-        LLMEngine(EngineConfig(**base, kv_role="kv_producer", offload=None))
-    with pytest.raises(ValueError, match="kv_swa_ring"):
         LLMEngine(EngineConfig(**base, offload=OffloadConfig(enabled=True)))
+    # P/D transfer DOES compose (ring preload path) — construction works.
+    eng = LLMEngine(EngineConfig(
+        **base, kv_role="kv_producer", kv_transfer_port=0, offload=None,
+    ))
+    try:
+        assert eng.kv_connector is not None
+    finally:
+        eng.close()
 
 
 def test_swa_blocks_smaller_than_one_ring_rejected():
@@ -268,6 +274,206 @@ def test_failed_admission_returns_ring_pages():
         assert held == 0, f"waiting request still holds {held} ring pages"
     finally:
         eng.close()
+
+
+# --------------------------------------------------------------------- #
+# P/D transfer composition (the reference's gpt-oss P/D decode runs the
+# hybrid KV cache manager — ring + transfer together,
+# pd-disaggregation/modelserver/gpu/vllm/base/patch-decode.yaml:19)
+
+
+def _pd_engine(kv_role, local_fastpath=False):
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config(**ALTERNATING),
+        cache=CacheConfig(
+            page_size=4, num_blocks=64, dtype="float32", swa_ring=True,
+        ),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=32),
+        parallel=ParallelConfig(),
+        kv_role=kv_role,
+        kv_transfer_port=0,
+        kv_local_fastpath=local_fastpath,
+        offload=None,
+    ))
+
+
+def _pd_run(eng, prompt, max_tokens, kv_transfer_params=None):
+    rid = eng.add_request(
+        list(prompt),
+        SamplingParams(temperature=0.0, max_tokens=max_tokens, ignore_eos=True),
+        kv_transfer_params=kv_transfer_params,
+    )
+    outs, final = [], None
+    while eng.has_work():
+        for out in eng.step():
+            if out.request_id == rid:
+                outs.extend(out.new_token_ids)
+                if out.finished:
+                    final = out
+    return outs, final
+
+
+# 37 tokens: > the 8-token window, crosses page boundaries unaligned.
+_PD_PROMPT = [(41 * i + 3) % 61 for i in range(37)]
+
+
+@pytest.mark.parametrize("fastpath", [False, True])
+def test_pd_ring_matches_aggregated(fastpath):
+    """Producer ring engine -> consumer ring engine: the sliding-layer
+    section travels with the full-group chunks, the consumer preloads
+    the request directly (no prefix cache exists), and decode tokens
+    match a plain ring engine's — proof the transferred sliding KV is
+    read where the window needs it."""
+    import time as _time
+
+    ref = _pd_engine(None)
+    try:
+        ref_tokens, _ = _pd_run(ref, _PD_PROMPT, max_tokens=12)
+    finally:
+        ref.close()
+
+    producer = _pd_engine("kv_producer", local_fastpath=fastpath)
+    consumer = _pd_engine("kv_consumer", local_fastpath=fastpath)
+    try:
+        _, pre = _pd_run(
+            producer, _PD_PROMPT, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        assert params is not None
+        assert params["swa_pages"] > 0
+        # preload covers (37-1)//4 = 9 pages; the section spans the
+        # window before the continuation point: s0 = (9*4 - 8)//4 = 7.
+        assert params["num_full_pages"] == 9
+        assert params["swa_start_page"] == 7
+        if not fastpath:
+            deadline = _time.time() + 5
+            while _time.time() < deadline:
+                # chunks + the swa section must all register
+                if producer.kv_connector.server.registered_count >= 3:
+                    break
+                _time.sleep(0.02)
+        toks, final = _pd_run(
+            consumer, _PD_PROMPT, max_tokens=12, kv_transfer_params=params
+        )
+        assert toks == ref_tokens
+        assert final.num_cached_tokens == 36  # 9 preloaded pages
+        assert consumer.kv_connector.imported_requests == 1
+        assert consumer.kv_connector.import_failures == 0
+        if fastpath:
+            assert consumer.kv_connector.local_imports == 1
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_pd_ring_producer_down_recompute():
+    """Missing sliding section (export expired/unreachable) degrades to
+    local recompute under the default policy — never a wrong answer."""
+    ref = _pd_engine(None)
+    try:
+        ref_tokens, _ = _pd_run(ref, _PD_PROMPT, max_tokens=10)
+    finally:
+        ref.close()
+    consumer = _pd_engine("kv_consumer")
+    try:
+        params = {
+            "remote_host": "127.0.0.1", "remote_port": 1,  # nothing there
+            "remote_key": "gone", "num_full_pages": 9, "page_size": 4,
+            "chunk_pages": 8, "num_chunks": 2,
+            "swa_pages": 3, "swa_start_page": 7,
+        }
+        toks, _ = _pd_run(
+            consumer, _PD_PROMPT, max_tokens=10, kv_transfer_params=params
+        )
+        assert toks == ref_tokens
+        assert consumer.kv_connector.import_failures >= 1
+    finally:
+        consumer.kv_connector.close()
+
+
+def test_pd_ring_refuses_ringless_producer():
+    """A ring consumer handed params WITHOUT a sliding section (ring-off
+    producer) must hit the failure policy, not silently decode garbage."""
+    consumer = _pd_engine("kv_consumer")
+    try:
+        params = {
+            "remote_host": "127.0.0.1", "remote_port": 1,
+            "remote_key": "x", "num_full_pages": 9, "page_size": 4,
+            "chunk_pages": 8, "num_chunks": 2,
+        }
+        ref = _pd_engine(None)
+        try:
+            ref_tokens, _ = _pd_run(ref, _PD_PROMPT, max_tokens=6)
+        finally:
+            ref.close()
+        toks, _ = _pd_run(
+            consumer, _PD_PROMPT, max_tokens=6, kv_transfer_params=params
+        )
+        assert toks == ref_tokens  # recompute fallback
+        assert consumer.kv_connector.import_failures >= 1
+    finally:
+        consumer.kv_connector.close()
+
+
+def test_pd_ring_rejects_partial_export():
+    """start_page > 0 (stale/hostile skip_pages) must hit the failure
+    policy — pages [0, skip) would otherwise decode from uninitialized
+    KV with no error."""
+    consumer = _pd_engine("kv_consumer")
+    try:
+        with pytest.raises(ValueError, match="partial export"):
+            consumer.kv_connector.fetch_remote(
+                _PD_PROMPT,
+                {
+                    "remote_host": "127.0.0.1", "remote_port": 1,
+                    "remote_key": "x", "num_full_pages": 9, "page_size": 4,
+                    "chunk_pages": 8, "num_chunks": 2,
+                    "swa_pages": 2, "swa_start_page": 7, "start_page": 3,
+                },
+            )
+    finally:
+        consumer.kv_connector.close()
+
+
+def test_preloaded_waiters_cannot_starve_admission():
+    """Preloaded arrivals hold rings allocated outside admission; when
+    they exhaust the pool behind a ring-less queue head, the scheduler
+    reclaims the youngest preload's ring (downgrade to local recompute)
+    instead of livelocking."""
+    from llmd_tpu.engine.kv_cache import PageAllocator
+    from llmd_tpu.engine.request import Request
+    from llmd_tpu.engine.scheduler import EngineScheduler
+
+    page, R = 4, 5
+    alloc = PageAllocator(64, page, enable_prefix_caching=False)
+    swa_alloc = PageAllocator(2 * R, page, enable_prefix_caching=False)
+    sched = EngineScheduler(
+        SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=32),
+        CacheConfig(page_size=page, num_blocks=64),
+        alloc, max_model_len=128,
+        swa_allocator=swa_alloc, swa_ring_pages=R, swa_chunk_tokens=32,
+    )
+    head = Request(request_id="head", prompt_token_ids=[1] * 9)
+    sched.add_request(head)
+    # Two preloaded arrivals drain the 2R pool entirely.
+    preloaded = []
+    for i in range(2):
+        r = Request(request_id=f"pre{i}", prompt_token_ids=[2] * 9)
+        r.block_ids = alloc.allocate(2)
+        r.swa_block_ids = swa_alloc.allocate(R)
+        r.num_computed_tokens = 8
+        r.num_cached_tokens = 8
+        preloaded.append(r)
+        sched.add_request(r)
+    assert swa_alloc.num_free_pages == 0
+    batch = sched.schedule()
+    admitted = {s.request.request_id for s in batch.prefills}
+    assert "head" in admitted, admitted  # queue head got a reclaimed ring
+    # the youngest preload was downgraded to plain recompute
+    assert preloaded[1].swa_block_ids == [] or preloaded[0].swa_block_ids == []
+    downgraded = [r for r in preloaded if not r.swa_block_ids]
+    assert downgraded and all(r.num_computed_tokens == 0 for r in downgraded)
 
 
 def test_ring_ignored_for_full_attention_models():
