@@ -392,16 +392,25 @@ class TPUConnector:
         spec = getattr(self.runner, "swa", None)
         if spec is not None and req.swa_block_ids:
             n_pre, swa_s0, swa_n = spec.section(req.num_prompt_tokens, page)
-            if swa_n > 0:
-                R = len(req.swa_block_ids)
+            R = len(req.swa_block_ids)
+            # Staleness guard: the ring kept advancing during DECODE, and
+            # once any logical page >= s0 + R has been written, slot s0
+            # holds newer-position KV — exporting it would label wrong
+            # positions and the consumer would silently decode garbage.
+            # Normal producer requests are max_tokens=1 (the sidecar
+            # two-phase protocol) and never trip this; a client-driven
+            # long-decode export just omits the section, and the ring
+            # consumer's mixed-mode refusal degrades it to recompute.
+            highest_page = max(0, req.num_computed_tokens - 1) // page
+            if swa_n <= 0 or highest_page >= swa_s0 + R:
+                swa_s0, swa_n = 0, 0
+            else:
                 ring_ids = [
                     req.swa_block_ids[l % R] for l in range(swa_s0, n_pre)
                 ]
                 swa_snap = self.runner.snapshot_swa_pages_device(
                     ring_ids, swa_n
                 )
-            else:
-                swa_s0, swa_n = 0, 0
         if snaps and self._local_enabled:
             # Short retention: a legit in-process claim follows the
             # prefill response within milliseconds; a CROSS-host consumer
@@ -953,7 +962,20 @@ class TPUConnector:
         }
 
     def import_for_prompt(self, prompt_token_ids: list[int], params: dict) -> int:
-        """Synchronous fetch + apply (offline engine path and tests)."""
+        """Synchronous fetch + apply (offline engine path and tests).
+
+        Cache-seeding engines only: a ring engine (kv_swa_ring) has no
+        prefix cache, so apply_bundle would scatter-and-free unreachable
+        content while dropping the sliding section — refuse loudly and
+        point at the preload path instead of silently wasting a transfer.
+        """
+        if getattr(self.runner, "swa", None) is not None:
+            raise RuntimeError(
+                "ring engines (kv_swa_ring) import via "
+                "LLMEngine.add_request's preload path (apply_preload needs "
+                "the engine's ring allocator); import_for_prompt only "
+                "serves cache-seeding engines"
+            )
         bundle = self.fetch_remote_policy(prompt_token_ids, params)
         if bundle is None:
             return 0
