@@ -41,6 +41,11 @@ class EngineStats:
     prefix_hit_ratio: float = 0.0
     num_pages: int = 0
     page_size: int = 0
+    # SWA ring pool (kv_swa_ring): under P/D preload bursts the ring pool
+    # is the binding admission constraint, so it must be visible to
+    # utilization-based routing, not just the main pool.
+    swa_ring_usage: float = 0.0
+    swa_ring_pages: int = 0
     # counters
     prompt_tokens: int = 0
     generation_tokens: int = 0
@@ -423,6 +428,9 @@ class LLMEngine:
         self.stats.num_waiting = self.scheduler.num_waiting
         self.stats.num_running = self.scheduler.num_running
         self.stats.kv_usage = self.allocator.usage()
+        if self.swa_allocator is not None:
+            self.stats.swa_ring_usage = self.swa_allocator.usage()
+            self.stats.swa_ring_pages = self.swa_allocator.num_pages
         self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
         self.stats.preemptions = self.scheduler.num_preemptions
         if self.config.model.num_lora_adapters:

@@ -482,8 +482,15 @@ class TPUConnector:
         t0 = time.monotonic()
         with self._local_lock:
             self._staging_active.add(key)
+            swa_wanted = swa_snap is not None and (
+                key not in self._local_claimed
+            )
         try:
-            if swa_snap is not None and key not in self._local_claimed:
+            # A claim_local landing AFTER this check (during the download/
+            # register below) leaves the section blob registered; that is
+            # a benign leak bounded by the lease — free-notify or expiry
+            # reclaims it.
+            if swa_wanted:
                 pages = self.runner.download_pages(swa_snap)
                 payload = (
                     pages if pages.dtype.isbuiltin == 1
@@ -876,8 +883,11 @@ class TPUConnector:
         spec = self.runner.swa
         # Shared geometry (SwaRingSpec.section): producer and consumer
         # MUST derive the identical (n_pre, s0) from the prompt alone.
-        n_pre, _s0, _cnt = spec.section(len(prompt_token_ids), page)
-        n_pre = min(n_full, n_pre)
+        # n_pre/s0 are NOT clamped to the producer-declared page count —
+        # clamping would shift the consumer's window start and let a
+        # tampered num_full_pages slide a non-covering section past the
+        # geometry guard below (the guard instead refuses n_full < n_pre).
+        n_pre, s0, _cnt = spec.section(len(prompt_token_ids), page)
         if (
             n_pre <= 0
             or bundle.swa_count <= 0
@@ -893,6 +903,29 @@ class TPUConnector:
         page_ids: list[int] = []
         ring_ids: list[int] = []
         try:
+            # The section must MATCH the consumer-derived geometry, not
+            # merely overlap [0, n_pre): a stale/hostile swa_start_page
+            # != s0 or short swa_count would leave in-window ring slots
+            # zero-initialized (or alias two logical pages onto one ring
+            # slot when the span exceeds the ring) while
+            # num_computed_tokens says they're valid — silent garbage
+            # decode (same defense-in-depth as the start_page guard).
+            # Honest producers derive (s0, cnt) from the identical
+            # spec.section, so equality is the honest case, checked
+            # BEFORE any allocation/scatter work is spent.
+            if (
+                n_full < n_pre
+                or bundle.swa_start_page != s0
+                or bundle.swa_start_page + bundle.swa_count < n_pre
+                or n_pre - s0 <= 0
+                or n_pre - s0 > ring_pages
+            ):
+                raise ValueError(
+                    f"sliding section [{bundle.swa_start_page}, "
+                    f"+{bundle.swa_count}) over {n_full} pages does not "
+                    f"match the required window [{s0}, {n_pre}) "
+                    f"(ring {ring_pages} pages)"
+                )
             # Land ALL exported pages, then hand the request only the
             # first n_pre: chunk writes beyond the preload boundary (the
             # producer may have exported one more page than we keep, plus
@@ -921,12 +954,6 @@ class TPUConnector:
             # logical prompt page l lives at ring[l % R] — the same
             # mapping the engine's ring-view table uses from here on.
             n_swa = min(bundle.swa_count, n_pre - bundle.swa_start_page)
-            if n_swa <= 0:
-                raise ValueError(
-                    f"sliding section [{bundle.swa_start_page}, "
-                    f"+{bundle.swa_count}) misses the preload range "
-                    f"[0, {n_pre})"
-                )
             swa_ids = [
                 ring_ids[(bundle.swa_start_page + i) % ring_pages]
                 for i in range(n_swa)

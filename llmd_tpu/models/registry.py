@@ -60,6 +60,16 @@ def _tiny_moe() -> ModelConfig:
     )
 
 
+@register_model("tiny-swa")
+def _tiny_swa() -> ModelConfig:
+    """Alternating sliding/full layers in miniature (gpt-oss layout) —
+    the serving-level fixture for --kv-swa-ring and hybrid-APC paths."""
+    return tiny_model_config(
+        name="tiny-swa", sliding_window=64,
+        layer_types=("sliding_attention", "full_attention"),
+    )
+
+
 @register_model("tiny-mla")
 def _tiny_mla() -> ModelConfig:
     """CPU-testable MLA+MoE shape (DeepSeek architecture in miniature)."""

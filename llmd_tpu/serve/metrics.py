@@ -20,9 +20,21 @@ def render_metrics(
     gauges = {
         "num_requests_waiting": stats.num_waiting,
         "num_requests_running": stats.num_running,
-        "gpu_cache_usage_perc": round(stats.kv_usage, 6),
+        # Routing-visible utilization is the BINDING pool: with a SWA ring
+        # pool the ring (not the main table) is often the admission
+        # constraint under P/D preload bursts, and a scorer reading only
+        # main-pool usage would keep sending work to an exhausted engine.
+        "gpu_cache_usage_perc": round(
+            max(stats.kv_usage, stats.swa_ring_usage), 6
+        ),
         "prefix_cache_hit_rate": round(stats.prefix_hit_ratio, 6),
     }
+    if stats.swa_ring_pages:
+        gauges["swa_ring_usage_perc"] = round(stats.swa_ring_usage, 6)
+        gauges["swa_ring_pages"] = stats.swa_ring_pages
+        # Raw main-pool usage stays observable when the ring is busier
+        # (gpu_cache_usage_perc above collapses to the max of the two).
+        gauges["kv_main_usage_perc"] = round(stats.kv_usage, 6)
     gauges["kv_offload_cpu_pages"] = stats.offload_pages
     gauges["kv_offload_fs_pages"] = stats.offload_fs_pages
     counters = {

@@ -416,6 +416,53 @@ def test_pd_ring_refuses_ringless_producer():
         consumer.kv_connector.close()
 
 
+@pytest.mark.parametrize(
+    "tamper",
+    [
+        {"swa_start_page": 8},
+        {"swa_pages": 1},
+        {"num_full_pages": 8},
+        {"num_full_pages": 5},
+    ],
+    ids=[
+        "start-past-s0",
+        "count-short-of-n_pre",
+        "full-pages-clamps-window",
+        "full-pages-empties-section",
+    ],
+)
+def test_pd_ring_rejects_noncovering_section(tamper):
+    """A sliding section that merely OVERLAPS [0, n_pre) but does not
+    cover the consumer-derived window [s0, n_pre) — stale/hostile
+    swa_start_page > s0, or swa_count short of n_pre — must degrade to
+    recompute, never leave in-window ring slots zero-initialized while
+    num_computed_tokens claims them valid."""
+    ref = _pd_engine(None)
+    try:
+        ref_tokens, _ = _pd_run(ref, _PD_PROMPT, max_tokens=8)
+    finally:
+        ref.close()
+    producer = _pd_engine("kv_producer")
+    consumer = _pd_engine("kv_consumer")
+    try:
+        _, pre = _pd_run(
+            producer, _PD_PROMPT, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = dict(pre.kv_transfer_params)
+        assert params["swa_start_page"] == 7  # honest s0 for this prompt
+        params.update(tamper)
+        toks, final = _pd_run(
+            consumer, _PD_PROMPT, max_tokens=8, kv_transfer_params=params
+        )
+        assert toks == ref_tokens  # recompute fallback, not garbage
+        assert consumer.kv_connector.import_failures >= 1
+        assert consumer.kv_connector.imported_requests == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
 def test_pd_ring_rejects_partial_export():
     """start_page > 0 (stale/hostile skip_pages) must hit the failure
     policy — pages [0, skip) would otherwise decode from uninitialized
