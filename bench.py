@@ -460,6 +460,78 @@ async def _bench_pd_ttft(
     return ttfts[len(ttfts) // 2] * 1e3, stages
 
 
+def bench_env_probes() -> dict:
+    """Environment controls for the P/D wire numbers.
+
+    The wire TTFT rides three links whose day-to-day variance (the tunnel)
+    is otherwise indistinguishable from a code regression: raw TCP
+    loopback (the shipper's socket path), device->host staging (the
+    producer's download leg), and host->device staging (the consumer's
+    upload leg). Recording all three lets round-over-round wire numbers
+    be normalized against the substrate they ran on."""
+    import socket
+    import threading
+
+    import numpy as np
+
+    out = {}
+    # --- raw TCP loopback ---
+    total = 256 << 20
+    srv = socket.create_server(("127.0.0.1", 0))
+    got = threading.Event()
+
+    def sink():
+        conn, _ = srv.accept()
+        n = 0
+        while n < total:
+            b = conn.recv(1 << 20)
+            if not b:
+                break
+            n += len(b)
+        conn.close()
+        got.set()
+
+    threading.Thread(target=sink, daemon=True).start()
+    c = socket.create_connection(("127.0.0.1", srv.getsockname()[1]))
+    buf = b"\0" * (8 << 20)
+    t0 = time.monotonic()
+    for _ in range(total // len(buf)):
+        c.sendall(buf)
+    if got.wait(timeout=60):
+        out["loopback_gbps"] = round(
+            total / (time.monotonic() - t0) / 2**30, 2
+        )
+    else:
+        # A wedged sink must not record a plausible-but-wrong number —
+        # the probe exists to DISAMBIGUATE environment vs regression.
+        out["loopback_error"] = "sink did not drain within 60s"
+    c.close()
+    srv.close()
+
+    # --- device<->host staging (the tunnel's data plane) ---
+    import jax
+    import jax.numpy as jnp
+
+    x = np.zeros((16 << 20) // 4, np.float32)  # 16 MB
+    # The download probe must fetch DEVICE-COMPUTED data: a device_put
+    # array keeps a host mirror and device_get short-circuits to memcpy
+    # speed, reporting fantasy bandwidth.
+    make = jax.jit(lambda s: jnp.full(x.shape, 1.0, jnp.float32) * s)
+    h2d, d2h = [], []
+    for i in range(3):
+        t0 = time.monotonic()
+        jax.device_put(x).block_until_ready()
+        h2d.append(time.monotonic() - t0)
+        d = make(float(i))
+        d.block_until_ready()
+        t0 = time.monotonic()
+        np.asarray(jax.device_get(d))
+        d2h.append(time.monotonic() - t0)
+    out["host_to_device_gbps"] = round(x.nbytes / sorted(h2d)[1] / 2**30, 3)
+    out["device_to_host_gbps"] = round(x.nbytes / sorted(d2h)[1] / 2**30, 3)
+    return out
+
+
 def measure_dispatch_rtt_ms() -> float:
     """Median round-trip of a trivial compiled dispatch + host fetch.
 
@@ -523,13 +595,21 @@ def _run_part(part: str):
         # Single-host xPyD device fast path (reference single-host/pd
         # shape): consumer claims the producer's device snapshots — no
         # host staging, no wire.
-        p50, _ = asyncio.run(_bench_pd_ttft(local_fastpath=True))
-        return {"pd_ttft_p50_local_ms": round(p50, 1)}
+        p50, stages = asyncio.run(_bench_pd_ttft(local_fastpath=True))
+        return {
+            "pd_ttft_p50_local_ms": round(p50, 1),
+            "pd_local_stages": stages,
+        }
     if part == "pd_cached":
         # Byte-diet warm case: repeated prompt -> probe makes the
         # producer stage nothing; near-zero transfer.
-        p50, _ = asyncio.run(_bench_pd_ttft(cached_repeat=True))
-        return {"pd_ttft_p50_cached_ms": round(p50, 1)}
+        p50, stages = asyncio.run(_bench_pd_ttft(cached_repeat=True))
+        return {
+            "pd_ttft_p50_cached_ms": round(p50, 1),
+            "pd_cached_stages": stages,
+        }
+    if part == "env":
+        return bench_env_probes()
     if part == "swa_ring_off":
         return bench_swa_ring(False)
     if part == "swa_ring_on":
@@ -650,6 +730,10 @@ def main() -> None:
         extras["dispatch_rtt_ms"] = _part_in_subprocess("rtt")
     except Exception as e:  # pragma: no cover
         extras["dispatch_rtt_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        extras["env"] = _part_in_subprocess("env")
+    except Exception as e:  # pragma: no cover
+        extras["env_error"] = f"{type(e).__name__}: {e}"[:200]
     toks_per_s = _part_in_subprocess("dense_int8")
     try:
         extras.update(_part_in_subprocess("dense_bf16"))

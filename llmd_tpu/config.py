@@ -262,6 +262,11 @@ class SchedulerConfig:
     # Token-count buckets used to pad jitted step shapes (compile-once).
     prefill_token_buckets: tuple[int, ...] = ()
     decode_batch_buckets: tuple[int, ...] = ()
+    # Row buckets for PREFILL batches. Defaults to powers of two from 1
+    # (vs decode's from 8): a lone prefill — the P/D TTFT-critical shape —
+    # must not pad to 8 rows of max-chunk compute, while decode padding
+    # is cheap (decode steps are dispatch/RTT-bound, not FLOPs-bound).
+    prefill_batch_buckets: tuple[int, ...] = ()
     # Fused decode window: K decode iterations per jit call with on-device
     # token feedback (host sees one transfer per window). 1 = step-per-token.
     # Larger K amortizes dispatch latency at the cost of K-token streaming

@@ -376,7 +376,24 @@ class LLMEngine:
         logprobs: dict[str, list[float]] = {}
 
         if batch.prefills:
-            res = self.runner.run_prefill(batch.prefills)
+            # Eager-ACK: an export-only prefill's sampled token is thrown
+            # away by the routing sidecar (the two-phase protocol only
+            # consumes kv_transfer_params), so the producer's response
+            # does not wait for prefill compute or the token readback —
+            # device program order alone guarantees the KV snapshots the
+            # consumer pulls are valid. Cuts compute + one host RTT off
+            # the P/D TTFT critical path.
+            eager_ack = (
+                self.kv_connector is not None
+                and self.kv_connector.cfg.is_producer
+                and all(
+                    s.request.kv_transfer_params is not None
+                    and s.request.kv_transfer_params.get("do_remote_decode")
+                    and s.request.sampling.max_tokens == 1
+                    for s in batch.prefills
+                )
+            )
+            res = self.runner.run_prefill(batch.prefills, sync=not eager_ack)
             for i, seq in enumerate(batch.prefills):
                 sampled[seq.request.request_id] = res.tokens[i].tolist()
                 logprobs[seq.request.request_id] = res.logprobs[i].tolist()

@@ -198,6 +198,9 @@ class ModelRunner:
             )
         sched = config.scheduler
         self.batch_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
+        self.prefill_batch_buckets = (
+            sched.prefill_batch_buckets or _buckets(sched.max_num_seqs, start=1)
+        )
         self.prefill_buckets = sched.prefill_token_buckets or _buckets(
             sched.max_num_batched_tokens, start=16
         )
@@ -1188,12 +1191,22 @@ class ModelRunner:
 
         return embed
 
-    def run_prefill(self, seqs: list[ScheduledSeq]) -> StepResult:
+    def run_prefill(
+        self, seqs: list[ScheduledSeq], sync: bool = True
+    ) -> StepResult:
         """All scheduled prompt chunks, batched by Q bucket.
 
         Rows are grouped so a single long chunk doesn't pad every short
         chunk up to its bucket (padded compute stays ~sum of real tokens,
         not B_bucket x max_chunk).
+
+        ``sync=False`` is the P/D eager-ACK path: the forward is ENQUEUED
+        but the sampled token is never read back (zeros returned). Valid
+        only when no caller consumes the tokens — export-only prefills,
+        whose response the routing sidecar discards. Device program order
+        keeps the subsequently enqueued KV snapshots correct without any
+        host synchronization; a forward fault surfaces on the snapshot
+        consumers (staging download / consumer scatter) instead of here.
         """
         groups: dict[int, list[int]] = {}
         for i, s in enumerate(seqs):
@@ -1201,15 +1214,21 @@ class ModelRunner:
         tokens = np.zeros((len(seqs), 1), np.int32)
         logprobs = np.zeros((len(seqs), 1), np.float32)
         for q_bucket, idxs in sorted(groups.items()):
-            res = self._run_prefill_group([seqs[i] for i in idxs], q_bucket)
+            res = self._run_prefill_group(
+                [seqs[i] for i in idxs], q_bucket, sync=sync
+            )
+            if res is None:
+                continue
             for row, i in enumerate(idxs):
                 tokens[i] = res.tokens[row]
                 logprobs[i] = res.logprobs[row]
         return StepResult(tokens, logprobs)
 
-    def _run_prefill_group(self, seqs: list[ScheduledSeq], Q: int) -> StepResult:
+    def _run_prefill_group(
+        self, seqs: list[ScheduledSeq], Q: int, sync: bool = True
+    ) -> StepResult | None:
         n = len(seqs)
-        B = pad_to_bucket(n, self.batch_buckets)
+        B = pad_to_bucket(n, self.prefill_batch_buckets)
         tokens = np.zeros((B, Q), np.int32)
         positions = np.zeros((B, Q), np.int32)
         qlens = np.zeros(B, np.int32)
@@ -1235,6 +1254,8 @@ class ModelRunner:
         all_greedy = all(s.request.sampling.greedy for s in seqs)
         arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
         packed = self._exec_prefill(arrays, all_greedy)
+        if not sync:
+            return None  # eager-ACK: forward enqueued, token never fetched
         return self._unpack(packed, n)
 
     def run_decode(self, seqs: list[ScheduledSeq], k_steps: int = 1) -> StepResult:
@@ -1281,7 +1302,12 @@ class ModelRunner:
         """
         sched = self.config.scheduler
         if prefill_shapes is None:
-            prefill_shapes = [(self.batch_buckets[-1], self.prefill_buckets[-1])]
+            # The lone-prefill shape (B=1) is the P/D TTFT-critical one;
+            # compile it alongside the largest so the first single
+            # request never eats a compile.
+            prefill_shapes = [(self.prefill_batch_buckets[-1], self.prefill_buckets[-1])]
+            if self.prefill_batch_buckets[0] == 1:
+                prefill_shapes.append((1, self.prefill_buckets[-1]))
         if decode_shapes is None:
             windows = sorted({1, sched.decode_window})
             decode_shapes = [(self.batch_buckets[-1], k) for k in windows]

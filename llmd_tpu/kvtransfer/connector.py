@@ -33,6 +33,7 @@ import struct
 import threading
 import time
 import uuid
+import weakref
 from typing import Any
 
 import numpy as np
@@ -70,6 +71,14 @@ class KVTransferConfig:
     # Single-host xPyD: consumers claim in-process producers' device
     # snapshots directly (no host staging, no wire bytes).
     local_fastpath: bool = True
+    # With the fast path enabled, the staging thread grants an in-process
+    # consumer this long to claim the device snapshots before starting
+    # HBM->host downloads. A local claim lands within ~ms of the export;
+    # without the grace the thread races ahead and the first chunk's
+    # download (hundreds of ms of device-queue + host-link traffic)
+    # contends with the consumer's decode steps for pure waste. Remote
+    # consumers pay at most this delay on a multi-second staging path.
+    local_claim_grace_ms: int = 100
 
     @property
     def is_producer(self) -> bool:
@@ -230,6 +239,12 @@ def unpack_pages(blob: bytes) -> np.ndarray:
 # same-node shortcut; TPU-first, the shortcut is a device-to-device copy
 # (and on a real multi-chip host, an ICI copy).
 _LOCAL_PRODUCERS: dict[int, "TPUConnector"] = {}
+# Live in-process CONSUMER connectors (weak — a consumer dropped
+# without close() must not pin the grace forever). Producers consult
+# this before granting the local-claim grace: with no consumer in this
+# process, no claim can ever arrive, and delaying staging would tax
+# every remote pull for nothing.
+_LOCAL_CONSUMERS: "weakref.WeakSet[TPUConnector]" = weakref.WeakSet()
 _LOCAL_HOSTS = {"127.0.0.1", "localhost", "::1"}
 
 
@@ -288,6 +303,9 @@ class TPUConnector:
         # Single-host xPyD fast path: pending device snapshots by key,
         # claimable by an in-process consumer (see _LOCAL_PRODUCERS).
         self._local_lock = threading.Lock()
+        # Staging threads wait on this for the local-claim grace window;
+        # claim_local notifies so a claim releases the wait immediately.
+        self._local_cond = threading.Condition(self._local_lock)
         self._local_exports: dict[str, tuple] = {}
         self._local_claimed: set[str] = set()
         self._staging_active: set[str] = set()
@@ -298,6 +316,8 @@ class TPUConnector:
         )
         if self._local_enabled:
             _LOCAL_PRODUCERS[self.server.port] = self
+        if cfg.is_consumer and cfg.local_fastpath:
+            _LOCAL_CONSUMERS.add(self)
         # transfer metrics
         self.exported_requests = 0
         self.exported_bytes = 0
@@ -471,6 +491,7 @@ class TPUConnector:
                 # is the thread's early-exit signal); setting it for an
                 # already-finished key would leak the entry forever.
                 self._local_claimed.add(key)
+            self._local_cond.notify_all()
         return None if entry is None else (entry[1], entry[2])
 
     def _stage_chunks(self, key: str, snaps: list, swa_snap=None) -> None:
@@ -482,6 +503,25 @@ class TPUConnector:
         t0 = time.monotonic()
         with self._local_lock:
             self._staging_active.add(key)
+            if (
+                self._local_enabled
+                and self.cfg.local_claim_grace_ms > 0
+                and _LOCAL_CONSUMERS
+            ):
+                # Give an in-process consumer the grace window to claim
+                # before any HBM->host bytes move; a claim (or the entry
+                # disappearing via expiry/eviction) ends the wait early.
+                deadline = (
+                    time.monotonic() + self.cfg.local_claim_grace_ms / 1e3
+                )
+                while (
+                    key not in self._local_claimed
+                    and key in self._local_exports
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._local_cond.wait(remaining)
             swa_wanted = swa_snap is not None and (
                 key not in self._local_claimed
             )
@@ -1039,6 +1079,7 @@ class TPUConnector:
         return out
 
     def close(self) -> None:
+        _LOCAL_CONSUMERS.discard(self)
         if self.server is not None:
             if _LOCAL_PRODUCERS.get(self.server.port) is self:
                 del _LOCAL_PRODUCERS[self.server.port]

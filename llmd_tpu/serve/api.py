@@ -439,7 +439,12 @@ async def _stream_response(
                 finish = "stop"
             elif out.finished:
                 finish = out.finish_reason.value if out.finish_reason else None
-            if delta:
+            # Emit a chunk per engine output even when the detokenizer
+            # holds text back (incomplete UTF-8 / stop-string holdback):
+            # the empty delta is what tells a streaming client the first
+            # token EXISTS — without it, TTFT degrades to time-to-full-
+            # response whenever the text buffer never flushes early.
+            if delta or out.new_token_ids:
                 chunk = (
                     P.chat_chunk(rid, model, {"content": delta}, None)
                     if chat
@@ -531,7 +536,9 @@ async def _stream_response_multi(
                     finish = (
                         out.finish_reason.value if out.finish_reason else None
                     )
-                if delta:
+                # Empty deltas still signal token arrival (UTF-8 / stop
+                # holdback) — same TTFT honesty as the single-stream path.
+                if delta or out.new_token_ids:
                     await queue.put(("delta", i, delta))
                 if finish is not None or out.finished:
                     totals["out"] += out.num_output_tokens
