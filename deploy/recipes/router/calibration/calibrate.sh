@@ -1,0 +1,86 @@
+#!/usr/bin/env bash
+# Router calibration: find the pool's peak sustainable throughput and
+# the router-overhead share, producing the numbers the autoscaler and
+# flow-control thresholds should be set from.
+#
+# Role model: the reference's recipes/router/calibration/calibrate.sh
+# (independent implementation driving this repo's benchmark harness).
+#
+# Usage:
+#   ROUTER_URL=http://localhost:8800 MODEL=llama-3.2-3b ./calibrate.sh
+# Optional:
+#   ENGINE_URL  — a single engine's base URL; when set, the same ladder
+#                 runs engine-direct and the report includes the router
+#                 overhead delta (router p50 - engine p50).
+#   OUT_DIR     — report directory (default ./calibration-out)
+set -euo pipefail
+
+ROUTER_URL="${ROUTER_URL:?ROUTER_URL is required (router base URL, e.g. http://localhost:8800)}"
+MODEL="${MODEL:?MODEL is required (served model id)}"
+ENGINE_URL="${ENGINE_URL:-}"
+OUT_DIR="${OUT_DIR:-./calibration-out}"
+
+mkdir -p "${OUT_DIR}"
+
+echo "== calibration ladder via router: ${ROUTER_URL} =="
+python -m llmd_tpu.benchmark \
+  --url "${ROUTER_URL}" --model "${MODEL}" \
+  --workload rate_ladder \
+  --overrides 'stages=[{"rate":1,"duration_s":30},{"rate":2,"duration_s":30},{"rate":4,"duration_s":30},{"rate":8,"duration_s":30},{"rate":16,"duration_s":30},{"rate":32,"duration_s":30}]' \
+  -o "${OUT_DIR}/router.json" --analyze | tee "${OUT_DIR}/router.md"
+
+if [ -n "${ENGINE_URL}" ]; then
+  echo "== same ladder engine-direct: ${ENGINE_URL} =="
+  python -m llmd_tpu.benchmark \
+    --url "${ENGINE_URL}" --model "${MODEL}" \
+    --workload rate_ladder \
+    --overrides 'stages=[{"rate":1,"duration_s":30},{"rate":2,"duration_s":30},{"rate":4,"duration_s":30},{"rate":8,"duration_s":30},{"rate":16,"duration_s":30},{"rate":32,"duration_s":30}]' \
+    -o "${OUT_DIR}/engine.json" --analyze | tee "${OUT_DIR}/engine.md"
+fi
+
+python - "${OUT_DIR}" <<'EOF'
+import json, sys, pathlib
+out = pathlib.Path(sys.argv[1])
+LADDER = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0]   # keep in sync with the ladder above
+DUR = 30.0
+router = json.load(open(out / "router.json"))
+per_stage = router.get("per_stage", {})
+base_ttft = None
+knee = None
+for i, offered in enumerate(LADDER):
+    s = per_stage.get(str(i))
+    if not s or not s.get("succeeded"):
+        break
+    achieved = s["succeeded"] / DUR
+    p50 = s.get("ttft_s", {}).get("p50", 0.0)
+    if base_ttft is None:
+        base_ttft = p50 or 1e-3
+    # knee = last stage whose achieved goodput tracks the offered rate
+    # within 10% and whose p50 TTFT stayed under 4x the first stage's.
+    if achieved >= 0.9 * offered and p50 <= 4 * base_ttft:
+        knee = {"offered_rps": offered, "achieved_rps": round(achieved, 2),
+                "ttft_p50_s": round(p50, 4), "stage": i}
+peak = (knee or {}).get("achieved_rps") or 1.0
+report = {
+    "peak_sustainable_rps": (knee or {}).get("achieved_rps"),
+    "knee_stage": knee,
+    "recommended": {
+        # queue a couple seconds of peak rate before scaling out;
+        # bound admission at ~8s of peak before shedding.
+        "keda_queue_threshold": max(1, int(peak * 2)),
+        "flow_control_max_requests": max(8, int(peak * 8)),
+    },
+}
+eng = out / "engine.json"
+if eng.exists():
+    e = json.load(open(eng)).get("per_stage", {}).get("0", {})
+    r0 = per_stage.get("0", {})
+    if e.get("ttft_s") and r0.get("ttft_s"):
+        report["router_overhead_p50_ms"] = round(
+            (r0["ttft_s"]["p50"] - e["ttft_s"]["p50"]) * 1e3, 2
+        )
+json.dump(report, open(out / "calibration.json", "w"), indent=2)
+print(json.dumps(report, indent=2))
+EOF
+
+echo "report: ${OUT_DIR}/calibration.json"

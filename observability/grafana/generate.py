@@ -1,0 +1,396 @@
+#!/usr/bin/env python3
+"""Generate the Grafana dashboard bundle.
+
+One source of truth for panel layout/units/thresholds so the six
+dashboards stay consistent (the reference ships 28-39KB hand-built
+dashboards; here they are generated — edit THIS file, then run it:
+
+    python observability/grafana/generate.py
+
+Metric names come from the live exporters: llmd_tpu/serve/metrics.py
+(engine, vllm:/llmd: families), epp/server.py + epp/precise_prefix.py
+(llm_d_epp_*), autoscale/engine.py (wva_*), batch/asyncproc.py
+(llmd_async_*), kvstore/master.py (store stats).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+OUT = os.path.dirname(os.path.abspath(__file__)) + "/dashboards"
+
+_next_id = [0]
+
+
+def _id() -> int:
+    _next_id[0] += 1
+    return _next_id[0]
+
+
+def panel(title, exprs, *, kind="timeseries", w=8, h=7, unit=None,
+          desc=None, thresholds=None, legends=None, max1=False):
+    targets = []
+    for i, e in enumerate(exprs):
+        t = {"expr": e, "refId": chr(65 + i)}
+        if legends and i < len(legends):
+            t["legendFormat"] = legends[i]
+        targets.append(t)
+    p = {"type": kind, "title": title, "id": _id(), "targets": targets}
+    fc = {}
+    if unit:
+        fc["unit"] = unit
+    if max1:
+        fc["min"] = 0
+        fc["max"] = 1
+    if thresholds:
+        fc["thresholds"] = {
+            "mode": "absolute",
+            "steps": [{"color": c, "value": v} for v, c in thresholds],
+        }
+    if fc:
+        p["fieldConfig"] = {"defaults": fc}
+    if desc:
+        p["description"] = desc
+    p["_w"], p["_h"] = w, h
+    return p
+
+
+def row(title):
+    return {"type": "row", "title": title, "id": _id(), "_w": 24, "_h": 1}
+
+
+def dashboard(uid, title, comment, panels, links=()):
+    # flow layout: rows reset x; panels wrap at 24 cols
+    x = y = 0
+    row_h = 0
+    placed = []
+    for p in panels:
+        w, h = p.pop("_w"), p.pop("_h")
+        if p["type"] == "row" or x + w > 24:
+            x, y = 0, y + (row_h if row_h else 0)
+            row_h = 0
+        p["gridPos"] = {"x": x, "y": y, "w": w, "h": h}
+        x += w
+        row_h = max(row_h, h)
+        if p["type"] == "row":
+            x, y = 0, y + 1
+            row_h = 0
+        placed.append(p)
+    return {
+        "__comment": comment,
+        "title": f"llmd-tpu / {title}",
+        "uid": uid,
+        "schemaVersion": 39,
+        "editable": True,
+        "timezone": "browser",
+        "time": {"from": "now-1h", "to": "now"},
+        "refresh": "30s",
+        "tags": ["llmd-tpu"],
+        "links": [
+            {"type": "dashboards", "tags": ["llmd-tpu"], "title": "llmd-tpu",
+             "asDropdown": True, "includeVars": True}
+        ],
+        "templating": {"list": [{
+            "name": "model",
+            "label": "model",
+            "type": "query",
+            "datasource": None,
+            "query": "label_values(vllm:num_requests_running, model_name)",
+            "refresh": 2,
+            "includeAll": True,
+            "current": {"text": "All", "value": "$__all"},
+        }]},
+        "panels": placed,
+    }
+
+
+M = '{model_name=~"$model"}'
+
+DASHBOARDS = {}
+
+# ---------------------------------------------------------------- router
+DASHBOARDS["llmd-router-overview"] = dashboard(
+    "llmd-router-overview", "Router Overview",
+    "Router (EPP) overview — request flow, scheduling, flow control, "
+    "latency. Counterpart of the reference llm-d-vllm-overview dashboard "
+    "on this framework's llm_d_epp_* names (epp/server.py).",
+    [
+        panel("Ready endpoints", ["llm_d_epp_ready_endpoints"], kind="stat",
+              w=4, h=4, thresholds=[(None, "red"), (1, "green")],
+              desc="Pods passing discovery + scrape. 0 = the pool is dark."),
+        panel("Flow-control queue", ["llm_d_epp_flow_control_queue_size"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (64, "yellow"), (256, "red")],
+              desc="Requests parked by flow control. Sustained growth = "
+                   "saturated pool or too-strict bands; KEDA scales on this."),
+        panel("Request rate", ["rate(llm_d_epp_requests_total[5m])"],
+              kind="stat", w=4, h=4, unit="reqps"),
+        panel("Proxy errors /s", ["rate(llm_d_epp_proxy_errors_total[5m])"],
+              kind="stat", w=4, h=4, unit="reqps",
+              thresholds=[(None, "green"), (0.1, "red")]),
+        panel("Scheduling errors /s",
+              ["rate(llm_d_epp_scheduling_errors_total[5m])"],
+              kind="stat", w=4, h=4, unit="reqps",
+              thresholds=[(None, "green"), (0.01, "red")]),
+        panel("Mean TTFT (router-observed)",
+              ["llm_d_epp_ttft_seconds_mean"], kind="stat", w=4, h=4,
+              unit="s", thresholds=[(None, "green"), (0.2, "yellow"), (1, "red")]),
+        row("Pool state"),
+        panel("Pool avg KV utilization",
+              ["llm_d_epp_pool_avg_kv_cache_utilization"], unit="percentunit",
+              max1=True,
+              desc="Average of the pods' routing-visible utilization "
+                   "(binding pool: main KV table or SWA ring)."),
+        panel("Pool avg queue depth", ["llm_d_epp_pool_avg_queue_size"],
+              desc="Mean vllm:num_requests_waiting across pods; compare "
+                   "with per-pod drilldown to spot skew the scorers miss."),
+        panel("Scheduling throughput",
+              ["rate(llm_d_epp_scheduling_attempts_total[5m])",
+               "rate(llm_d_epp_requests_total[5m])"],
+              legends=["attempts/s", "requests/s"], unit="reqps",
+              desc="attempts > requests means retries after failed picks."),
+        row("Prefix index (precise routing)"),
+        panel("Index size", ["llm_d_epp_prefix_index_blocks"],
+              desc="Block-hash entries held; tracks the fleet's live KV."),
+        panel("Index hit ratio",
+              ["rate(llm_d_epp_prefix_index_hits_total[5m]) / "
+               "rate(llm_d_epp_prefix_index_lookups_total[5m])"],
+              unit="percentunit", max1=True,
+              desc="Lookups that found a longest-prefix owner. Low + "
+                   "repetitive workload = events not flowing (check ZMQ)."),
+        panel("KV events ingested /s",
+              ["rate(llm_d_epp_prefix_index_events_total[5m])"],
+              desc="BlockStored/Removed/Cleared stream rate from engines."),
+    ],
+)
+
+# ---------------------------------------------------------------- engine
+DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
+    "llmd-engine-kv-cache", "Engine & KV Cache",
+    "Per-engine serving + KV state in the EPP metrics protocol "
+    "(serve/metrics.py; reference model-servers.md:38-52).",
+    [
+        panel("Requests running", [f"vllm:num_requests_running{M}"],
+              kind="stat", w=4, h=4),
+        panel("Requests waiting", [f"vllm:num_requests_waiting{M}"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (8, "yellow"), (32, "red")]),
+        panel("KV utilization (binding)", [f"vllm:gpu_cache_usage_perc{M}"],
+              kind="stat", w=4, h=4, unit="percentunit",
+              thresholds=[(None, "green"), (0.8, "yellow"), (0.95, "red")],
+              desc="max(main pool, SWA ring) — what routing sees."),
+        panel("Prefix hit rate", [f"vllm:prefix_cache_hit_rate{M}"],
+              kind="stat", w=4, h=4, unit="percentunit"),
+        panel("Token throughput",
+              [f"rate(vllm:generation_tokens_total{M}[5m])",
+               f"rate(vllm:prompt_tokens_total{M}[5m])"],
+              legends=["generation tok/s", "prompt tok/s"], w=8, h=4),
+        row("KV pools"),
+        panel("Pool usage by tier",
+              [f"vllm:kv_main_usage_perc{M}", f"vllm:swa_ring_usage_perc{M}"],
+              legends=["main table", "SWA ring"], unit="percentunit", max1=True,
+              desc="Ring pool saturating first under P/D preload bursts is "
+                   "expected (it is the admission constraint)."),
+        panel("Offload tiers (pages)",
+              [f"vllm:kv_offload_cpu_pages{M}", f"vllm:kv_offload_fs_pages{M}"],
+              legends=["host DRAM", "filesystem"],
+              desc="Tiered offload residency; flat at max = tier full, "
+                   "oldest prefixes now evict for real."),
+        panel("Offload traffic /s",
+              [f"rate(vllm:kv_offload_saves_total{M}[5m])",
+               f"rate(vllm:kv_offload_restores_total{M}[5m])"],
+              legends=["saves/s", "restores/s"],
+              desc="restores ≫ saves = HBM too small for the working set; "
+                   "saves with zero restores = offload not earning its copies."),
+        row("Health"),
+        panel("Preemptions /s", [f"rate(vllm:num_preemptions_total{M}[5m])"],
+              thresholds=[(None, "green"), (0.5, "yellow"), (2, "red")],
+              desc="Scheduler evictions under pressure; sustained rate = "
+                   "raise blocks or lower max_num_seqs."),
+        panel("Requests finished /s",
+              [f"rate(vllm:request_success_total{M}[5m])"], unit="reqps"),
+        panel("LoRA adapters (running/waiting ride labels)",
+              [f"vllm:lora_requests_info{M}"], kind="table", h=6,
+              desc="Adapter state gauge; available_lora_adapters lists the "
+                   "full registered set for router affinity."),
+    ],
+)
+
+# ---------------------------------------------------------------- pd
+DASHBOARDS["llmd-pd-coordinator"] = dashboard(
+    "llmd-pd-coordinator", "P/D Transfer",
+    "Prefill/decode disaggregation: export/import flow, failure modes, "
+    "byte economics (kvtransfer/connector.py stats).",
+    [
+        panel("Exports /s",
+              [f"rate(vllm:kv_transfer_exported_requests_total{M}[5m])"],
+              kind="stat", w=4, h=4),
+        panel("Imports /s",
+              [f"rate(vllm:kv_transfer_imported_requests_total{M}[5m])"],
+              kind="stat", w=4, h=4),
+        panel("Import failures /s",
+              [f"rate(vllm:kv_transfer_import_failures_total{M}[5m])"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.01, "yellow"), (0.1, "red")],
+              desc="Failures degrade to local recompute (policy=recompute) "
+                   "— correct but slow; nonzero here is capacity silently "
+                   "moving back onto decode pods."),
+        panel("Export bandwidth",
+              [f"rate(vllm:kv_transfer_exported_bytes_total{M}[5m])"],
+              kind="stat", w=6, h=4, unit="Bps"),
+        panel("Import bandwidth",
+              [f"rate(vllm:kv_transfer_imported_bytes_total{M}[5m])"],
+              kind="stat", w=6, h=4, unit="Bps"),
+        row("Flow"),
+        panel("Transfer requests",
+              [f"rate(vllm:kv_transfer_exported_requests_total{M}[5m])",
+               f"rate(vllm:kv_transfer_imported_requests_total{M}[5m])",
+               f"rate(vllm:kv_transfer_import_failures_total{M}[5m])"],
+              legends=["exported/s", "imported/s", "failed/s"], w=12,
+              desc="exported ≈ imported in steady state; a widening gap = "
+                   "consumers falling back (check failures + lease expiry)."),
+        panel("Transfer bytes",
+              [f"rate(vllm:kv_transfer_exported_bytes_total{M}[5m])",
+               f"rate(vllm:kv_transfer_imported_bytes_total{M}[5m])"],
+              legends=["staged out B/s", "pulled in B/s"], unit="Bps", w=12,
+              desc="bytes/request far below (layers × tokens × entry bytes) "
+                   "= the probe byte-diet is working (cached prefixes skipped)."),
+        row("Decode-side effects"),
+        panel("Decode KV pressure",
+              [f"vllm:gpu_cache_usage_perc{M}", f"vllm:swa_ring_usage_perc{M}"],
+              legends=["binding pool", "SWA ring"], unit="percentunit",
+              max1=True, w=12,
+              desc="Preload bursts land pages ref-held before scheduling; "
+                   "ring exhaustion here throttles admission first."),
+        panel("Decode queue",
+              [f"vllm:num_requests_waiting{M}", f"vllm:num_requests_running{M}"],
+              legends=["waiting", "running"], w=12),
+    ],
+)
+
+# ---------------------------------------------------------------- autoscaler
+DASHBOARDS["llmd-autoscaler"] = dashboard(
+    "llmd-autoscaler", "Autoscaling (WVA + KEDA)",
+    "WVA decisions vs the signals driving them (autoscale/engine.py; "
+    "reference hpa-wva.md).",
+    [
+        panel("Desired replicas", ["wva_desired_replicas"], kind="stat",
+              w=6, h=4),
+        panel("WVA cycles /min", ["rate(wva_cycles_total[5m]) * 60"],
+              kind="stat", w=6, h=4,
+              desc="Collect→Analyze→Optimize→Enforce loop rate (2/min at "
+                   "the default 30 s interval). 0 = the loop is stuck."),
+        panel("Scale signal: queue", ["llm_d_epp_flow_control_queue_size",
+                                      "llm_d_epp_pool_avg_queue_size"],
+              legends=["flow-control queue", "pool avg engine queue"],
+              w=6, h=4),
+        panel("Scale signal: KV", ["llm_d_epp_pool_avg_kv_cache_utilization"],
+              unit="percentunit", max1=True, w=6, h=4),
+        row("Decisions vs load"),
+        panel("Replicas vs desired", ["wva_desired_replicas"],
+              w=12, desc="Overlay actual replica count from your K8s "
+                         "datasource (kube_deployment_status_replicas) to "
+                         "see enforcement lag."),
+        panel("Demand",
+              ["rate(llm_d_epp_requests_total[5m])",
+               "sum(rate(vllm:generation_tokens_total[5m]))"],
+              legends=["req/s", "gen tok/s"], w=12,
+              desc="V2 (token-based) analyzer follows the second series; "
+                   "V1 follows utilization; SLO follows observed TTFT."),
+    ],
+)
+
+# ---------------------------------------------------------------- failure
+DASHBOARDS["llmd-failure-saturation"] = dashboard(
+    "llmd-failure-saturation", "Failure & Saturation",
+    "Every 'is it broken or just busy' signal on one screen "
+    "(reference alerting.md roles).",
+    [
+        panel("Ready endpoints", ["llm_d_epp_ready_endpoints"], kind="stat",
+              w=4, h=4, thresholds=[(None, "red"), (1, "green")]),
+        panel("Proxy 5xx /s", ["rate(llm_d_epp_proxy_errors_total[5m])"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.1, "red")]),
+        panel("Scheduling errors /s",
+              ["rate(llm_d_epp_scheduling_errors_total[5m])"], kind="stat",
+              w=4, h=4, thresholds=[(None, "green"), (0.01, "red")]),
+        panel("KV import failures /s",
+              ["sum(rate(vllm:kv_transfer_import_failures_total[5m]))"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.01, "yellow"), (0.1, "red")]),
+        panel("Preemptions /s",
+              ["sum(rate(vllm:num_preemptions_total[5m]))"], kind="stat",
+              w=4, h=4, thresholds=[(None, "green"), (0.5, "yellow"), (2, "red")]),
+        panel("Async backoffs /s", ["rate(llmd_async_backoffs_total[5m])"],
+              kind="stat", w=4, h=4,
+              desc="Async-processor dispatch failures being retried "
+                   "(2s→60s exp backoff)."),
+        row("Saturation ladder"),
+        panel("Queue depths",
+              ["llm_d_epp_flow_control_queue_size",
+               "llm_d_epp_pool_avg_queue_size"],
+              legends=["router (flow control)", "engines (avg)"], w=12,
+              desc="Router queue grows only after engines saturate — if it "
+                   "grows while engine queues are empty, a band/limit is "
+                   "misconfigured, not capacity."),
+        panel("KV utilization",
+              ["llm_d_epp_pool_avg_kv_cache_utilization"], w=12,
+              unit="percentunit", max1=True,
+              thresholds=[(None, "green"), (0.85, "yellow"), (0.95, "red")]),
+        row("Capacity escape valves"),
+        panel("Offload restores /s (HBM relief)",
+              ["sum(rate(vllm:kv_offload_restores_total[5m]))"], w=8),
+        panel("Transfer fallbacks /s (recompute on decode)",
+              ["sum(rate(vllm:kv_transfer_import_failures_total[5m]))"], w=8),
+        panel("Throughput sanity",
+              ["sum(rate(vllm:generation_tokens_total[5m]))"], w=8,
+              desc="If this falls while queues grow, the fleet is losing "
+                   "capacity (failures), not gaining load."),
+    ],
+)
+
+# ---------------------------------------------------------------- drilldown
+DASHBOARDS["llmd-diagnostic-drilldown"] = dashboard(
+    "llmd-diagnostic-drilldown", "Diagnostic Drilldown",
+    "Per-pod skew hunting: every panel intentionally NOT aggregated "
+    "(reference diagnostic-drilldown role). Pair with the overview; "
+    "here series fan out per scraped instance.",
+    [
+        panel("Running per pod", [f"vllm:num_requests_running{M}"], w=12,
+              desc="One series per pod. Persistent skew with balanced "
+                   "scores = an affinity plugin pinning traffic."),
+        panel("Waiting per pod", [f"vllm:num_requests_waiting{M}"], w=12),
+        panel("KV per pod", [f"vllm:gpu_cache_usage_perc{M}"], w=12,
+              unit="percentunit", max1=True,
+              desc="One hot pod at 0.95 while others idle = prefix/session "
+                   "affinity outweighing load — expected for agentic "
+                   "workloads, a bug for uniform ones."),
+        panel("Prefix hit per pod", [f"vllm:prefix_cache_hit_rate{M}"], w=12,
+              unit="percentunit", max1=True),
+        panel("Gen tok/s per pod",
+              [f"rate(vllm:generation_tokens_total{M}[5m])"], w=12),
+        panel("Preemptions per pod",
+              [f"rate(vllm:num_preemptions_total{M}[5m])"], w=12),
+        panel("Transfer imports per pod",
+              [f"rate(vllm:kv_transfer_imported_requests_total{M}[5m])"],
+              w=12, desc="Decode pods only; a silent pod here while peers "
+                         "import = its sidecar or connector is down."),
+        panel("Offload restores per pod",
+              [f"rate(vllm:kv_offload_restores_total{M}[5m])"], w=12),
+    ],
+)
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    for uid, d in DASHBOARDS.items():
+        path = os.path.join(OUT, f"{uid}.json")
+        with open(path, "w") as f:
+            json.dump(d, f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"{path}: {len(d['panels'])} panels")
+
+
+if __name__ == "__main__":
+    main()

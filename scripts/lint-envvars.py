@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Lint shell scripts for undeclared environment-variable use.
+
+Contract in scripts/ENVVARS.md: an all-caps variable may be read only if
+the script (a) requires it with ``${VAR:?...}``, (b) defaults it with
+``${VAR:-...}`` / ``${VAR:=...}``, (c) assigns it first, or (d) declares
+it in an ``# env: VAR`` comment. Enforced in CI via
+tests/test_deploy.py::test_envvar_lint. (Role model: the reference's
+scripts/lint-envvars.py env-declaration lint; independent implementation.)
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+
+EXEMPT = {
+    "PATH", "HOME", "PWD", "OLDPWD", "TMPDIR", "USER", "SHELL", "LANG",
+    "LC_ALL", "TERM", "HOSTNAME", "RANDOM", "SECONDS", "LINENO", "OPTARG",
+    "OPTIND", "IFS", "EUID", "UID", "PPID", "BASH_SOURCE", "FUNCNAME",
+}
+
+USE_RE = re.compile(r"\$\{?([A-Z][A-Z0-9_]*)\b")
+DECL_RE = re.compile(r"^\s*#\s*env:\s*([A-Z0-9_ ,]+)")
+GUARD_RE = re.compile(r"\$\{([A-Z][A-Z0-9_]*)(:?[-=?+])")
+ASSIGN_RE = re.compile(r"^\s*(?:export\s+)?([A-Z][A-Z0-9_]*)=")
+FOR_RE = re.compile(r"\bfor\s+([A-Z][A-Z0-9_]*)\b")
+
+
+def lint_file(path: str) -> list[str]:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    declared: set[str] = set(EXEMPT)
+    # Pass 1: collect declarations anywhere in the file — a guard at the
+    # top blesses every later bare use of the same var.
+    for line in lines:
+        m = DECL_RE.match(line)
+        if m:
+            declared.update(v for v in re.split(r"[ ,]+", m.group(1)) if v)
+        for m in GUARD_RE.finditer(line):
+            declared.add(m.group(1))
+        m = ASSIGN_RE.match(line)
+        if m:
+            declared.add(m.group(1))
+        m = FOR_RE.search(line)
+        if m:
+            declared.add(m.group(1))
+    # Pass 2: flag bare uses of anything never declared.
+    errors = []
+    for i, line in enumerate(lines, 1):
+        code = line.split("#", 1)[0]  # ignore comments
+        for m in USE_RE.finditer(code):
+            var = m.group(1)
+            if var not in declared:
+                errors.append(
+                    f"{path}:{i}: {var} used without declaration/default "
+                    "(see scripts/ENVVARS.md)"
+                )
+                declared.add(var)  # one report per var per file
+    return errors
+
+
+def tracked_scripts() -> list[str]:
+    out = subprocess.run(
+        ["git", "ls-files", "*.sh"], capture_output=True, text=True
+    )
+    return [p for p in out.stdout.splitlines() if p]
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or tracked_scripts()
+    all_errors: list[str] = []
+    for p in paths:
+        all_errors.extend(lint_file(p))
+    for e in all_errors:
+        print(e)
+    print(f"lint-envvars: {len(paths)} script(s), {len(all_errors)} error(s)")
+    return 1 if all_errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -225,6 +225,33 @@ def test_smoke_test_script_shape():
     assert "usage" in (out.stderr + out.stdout)
 
 
+def test_envvar_lint():
+    """scripts/ENVVARS.md contract: every tracked shell script declares
+    its env-var surface (reference scripts/lint-envvars.py role)."""
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts/lint-envvars.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    # And the linter actually catches a violation (not a vacuous pass):
+    import os
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".sh", delete=False) as f:
+        f.write("#!/bin/bash\necho $UNDECLARED_THING\n")
+        bad = f.name
+    try:
+        out = subprocess.run(
+            [sys.executable, str(REPO / "scripts/lint-envvars.py"), bad],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 1 and "UNDECLARED_THING" in out.stdout
+    finally:
+        os.unlink(bad)
+
+
 def test_gateway_recipes_and_helm_chart_shape():
     """Six gateway-provider recipes + the Helm chart (reference ships the
     same provider set, guides/recipes/gateway): every provider patches the
