@@ -172,17 +172,20 @@ def bench_kv_int8_long_context():
     """The int8 KV pool at long context (ISL 384 of a 512 window),
     honestly framed. CAPACITY: B=128 needs 3,584 pages — the bf16 pool
     cannot fit that next to the weights on this chip (compile-time OOM);
-    the int8 pool serves it. THROUGHPUT: on this KV-read-bound single
-    chip, tok/s saturates in B, so the extra batch does NOT raise
-    throughput — bf16 at its feasible B=96 (the kv_bf16_long part)
-    measures HIGHER than int8 at B=128 (int8 page slabs pad to the
-    (32,128) sublane tile so DMA bytes don't halve at page_size=16, and
-    the scale plane adds overhead). On the P/D wire the pool ships its
-    bytes directly (pd_kvint8: same half-bytes wire as the int8
-    transfer encoding, quantize pass skipped, consumer scatter without
-    dequant/requant); run-to-run tunnel variance dominates the two
-    int8 wire variants' ordering. Reference precedent: FP8 KV on the
-    flagship path (Dockerfile.cuda:69-70)."""
+    the int8 pool serves it. THROUGHPUT (r5 rework, measured stage by
+    stage): the r4 deficit was the SCALE WRITE path, not the kernel or
+    the scale gather — the per-(token,head) scale scatter enumerated
+    T*K eight-byte updates (scatter cost is per-update, and a
+    const-scales probe showed kernel + gather are within noise of the
+    bf16 path). Prefill now scatters [K,2] windows per token and decode
+    rewrites whole [K,page,2] slabs; with that, decode at capacity
+    B=128 runs 0.192 ms/seq/tok vs bf16's 0.196 at its feasible B=96.
+    Residual at EQUAL B=96: ~10% — the quantize/dequant work an int8
+    pool inherently pays, which short-ISL prefill can't amortize. The
+    pool's wins: capacity (B=128 serves at all), long-OSL decode, and
+    the wire (pd_kvint8 ships pool bytes directly — half bytes, zero
+    quantize work). Reference precedent: FP8 KV on the flagship path
+    (Dockerfile.cuda:69-70)."""
     return {
         "kv_int8_tok_s_isl384_b128": _bench_long_ctx("int8", 128, 4096)
     }

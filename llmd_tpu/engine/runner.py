@@ -298,10 +298,10 @@ class ModelRunner:
         sharding = self.ctx.sharding(*spec)
         if c.quantized:
             # Int8 pool: (data i8, per-row K/V-half scales f32 in the
-            # pool layout [L, P, K, 2, page]) — see ops/quant_kv.py for
+            # pool layout [L, P, K, page, 2]) — see ops/quant_kv.py for
             # the layout contract. Scales share the data pool's head
             # sharding (same axis position).
-            sshape = (shape[0], shape[1], shape[2], 2, shape[3])
+            sshape = (shape[0], shape[1], shape[2], shape[3], 2)
             if dist.is_multihost():
                 return jax.jit(
                     lambda: (
@@ -603,7 +603,7 @@ class ModelRunner:
         def scatter(kv, ids, d, s_wire):
             from llmd_tpu.ops.quant_kv import wire_scales_to_pool
 
-            s = wire_scales_to_pool(s_wire)  # [L, n, K, 2, page]
+            s = wire_scales_to_pool(s_wire)  # [L, n, K, page, 2]
             if rep > 1:
                 d = jnp.repeat(d, rep, axis=2)
                 s = jnp.repeat(s, rep, axis=2)
@@ -1146,7 +1146,7 @@ class ModelRunner:
             if self.kv_quantized:
                 return (
                     jnp.zeros(shape, jnp.int8),
-                    jnp.ones((*shape[:3], 2, page), jnp.float32),
+                    jnp.ones((*shape[:3], page, 2), jnp.float32),
                 )
             return jnp.zeros(shape, data.dtype)
 

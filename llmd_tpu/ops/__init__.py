@@ -274,7 +274,7 @@ def write_kv_pages_full(
             world_size=world_size, mesh=mesh,
         )
         # Slice + scatter + update-slice on the layer's scale pool
-        # ([P, K, 2, page]): the full-array layer-indexed scatter reads
+        # ([P, K, page, 2]): the full-array layer-indexed scatter reads
         # cleaner but defeats XLA's in-place aliasing (the attention
         # read is a second consumer), copying the whole scale pool per
         # layer — measured 10x slower e2e. The slice form pays ~2

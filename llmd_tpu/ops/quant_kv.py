@@ -5,16 +5,23 @@ the deployed vLLM engine; FP8 DeepGEMM MoE — docker/Dockerfile.cuda:69-70).
 TPU-native the pool is symmetric int8 with per-(token, head) row scales,
 kept as a 2-tuple pytree alongside the data:
 
-Two layouts, one value set:
+One layout everywhere:
 
-  POOL/BUNDLE scales: [(L,) num_pages, K, 2, page] f32 — co-indexed
-         with the data pool's page axis (axis 1), head axis TP-sharded
-         like the data's. (A page-axis-last "plane" layout was tried
-         for cheaper decode-time gathers and measured WORSE e2e — its
-         strided per-token scatter dominates prefill: 2839 vs 3100
-         tok/s short-ctx and 1039 vs 1524 at ISL=384.)
-  WIRE   (transfer q8 encoding, kvtransfer/connector.py):
-         scales [L, n, K, page, 2] f16
+  POOL/BUNDLE/WIRE scales: [(L,) num_pages, K, page, 2] — co-indexed
+         with the data pool's page axis, head axis TP-sharded like the
+         data's; page-in-sublane, K/V-half-in-lane. This is (a) the
+         shape quantize_kv_rows emits natively, (b) a contiguous 8-byte
+         pair per (token, head) for the step's scale scatter, and (c)
+         DMA-able into the decode kernel with the exact access pattern
+         of the data pages (sublane offset j*page), which is what lets
+         the kernel fetch scales per page instead of XLA pre-gathering
+         the whole context's scales each layer. Pool stores f32; the
+         wire carries the same values as f16 (see below).
+         (Historical: a [.., K, 2, page] pool needed a Mosaic-
+         unsupported in-kernel relayout, forcing that pre-gather —
+         which cost more than int8's halved KV bytes saved, BENCH_r04;
+         and a page-axis-last "plane" layout was measured worse on the
+         prefill scatter side: 2839 vs 3100 tok/s short-ctx.)
 
 Scales are STORED f32 (Mosaic has no f16 type on TPU, and f32 scales are
 only 8B next to each 256B int8 row) but their VALUES live on the f16
@@ -54,9 +61,15 @@ def quantize_kv_rows(k: jax.Array, v: jax.Array):
         amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
         scale = jnp.maximum(amax, 1e-30) / 127.0
         # Quantize against the f16-ROUNDED scale — the exact value any
-        # f16 wire consumer will dequantize with.
+        # f16 wire consumer will dequantize with. One reciprocal per ROW
+        # then a multiply across D: a per-element divide was ~3% of the
+        # whole int8 prefill step. The reciprocal's rounding only
+        # perturbs which grid point a value lands on (<=0.5 ulp);
+        # dequant still uses the exact f16 scale.
         scale = scale.astype(jnp.float16).astype(jnp.float32)
-        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        q = jnp.clip(
+            jnp.round(xf * jnp.reciprocal(scale)), -127, 127
+        ).astype(jnp.int8)
         return q, scale[..., 0].astype(KV_SCALES_DTYPE)
 
     k8, ks = one(k)
@@ -66,32 +79,32 @@ def quantize_kv_rows(k: jax.Array, v: jax.Array):
 
 def quantize_pages(pages: jax.Array):
     """Canonical float pages [..., K, page, 2D] -> (data i8 same shape,
-    scales [..., K, 2, page] f32) in the BUNDLE layout."""
+    scales [..., K, page, 2] f32) in the shared layout."""
     *lead, K, page, D2 = pages.shape
     D = D2 // 2
     k8, v8, srow = quantize_kv_rows(pages[..., :D], pages[..., D:])
     data = jnp.concatenate([k8, v8], axis=-1)
-    # srow [..., K, page, 2] -> bundle layout [..., K, 2, page]
-    scales = jnp.swapaxes(srow, -1, -2)
-    return data, scales
+    return data, srow  # srow is already [..., K, page, 2]
 
 
 def dequantize_pages(data: jax.Array, scales: jax.Array, dtype) -> jax.Array:
-    """Bundle-layout (data, scales) -> float pages [..., K, page, 2D]."""
+    """(data, scales [..., K, page, 2]) -> float pages [..., K, page, 2D]."""
     D2 = data.shape[-1]
     D = D2 // 2
-    srow = jnp.swapaxes(scales, -1, -2).astype(jnp.float32)  # [..., page, 2]
+    srow = scales.astype(jnp.float32)  # [..., K, page, 2]
     k = data[..., :D].astype(jnp.float32) * srow[..., 0:1]
     v = data[..., D:].astype(jnp.float32) * srow[..., 1:2]
     return jnp.concatenate([k, v], axis=-1).astype(dtype)
 
 
 def pool_scales_to_wire(scales: jax.Array) -> jax.Array:
-    """Pool layout [..., K, 2, page] -> transfer-wire layout
-    [..., K, page, 2] (kvtransfer bundle scales order)."""
-    return jnp.swapaxes(scales, -1, -2)
+    """Pool and wire share one layout ([..., K, page, 2]); the wire
+    narrows to f16 at the call site. Kept as a named seam so a future
+    layout split only touches this pair."""
+    return scales
 
 
 def wire_scales_to_pool(scales) -> jax.Array:
-    """Transfer-wire layout [..., K, page, 2] -> pool layout."""
-    return jnp.swapaxes(jnp.asarray(scales), -1, -2)
+    """Wire -> pool: identity layout (values widen f16 -> f32 at the
+    call site)."""
+    return jnp.asarray(scales)

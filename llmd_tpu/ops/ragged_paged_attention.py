@@ -35,10 +35,11 @@ def _decode_kernel(
     kv_lens_ref,  # [B] i32
     win_starts_ref,  # [B] i32 first attended position (sliding window; 0=full)
     # blocks: q_ref, sinks_ref, kv_hbm_full_ref, [ks_ref, vs_ref when
-    # quant: [1, K, S_max] f32 per-row scales, pre-gathered+relayouted by
-    # XLA — scale slabs are too narrow (page=16 lanes) for Mosaic DMA
-    # alignment, and at 1/32 of the data bytes the XLA gather is cheap],
-    # out_ref — see _decode_call
+    # quant: [1, K, S_max] f32 per-row scales, gathered into lane-aligned
+    # form by XLA in _decode_call — Mosaic manual DMA requires a
+    # 128-aligned minor dim, which a page's [K, page, 2] scale slab (2
+    # lanes) can never satisfy, so the scales cannot ride per-page DMAs
+    # like the data], out_ref — see _decode_call
     *refs,
     page_size: int,
     head_dim: int,
@@ -119,14 +120,11 @@ def _decode_kernel(
             k = kv[:, :, :D]
             v = kv[:, :, D:].astype(jnp.float32)
             q = q_ref[0]  # [K, G, D]
+            ks = vs = None
             if quant:
-                # Row dequantization, factored around the matmuls:
-                # (q . k_i8) * ks == q . (k_i8 * ks), and v is scaled
-                # before the live-mask zeroing.
                 ks = ks_ref[0, :, pl.ds(i * S, S)]  # [K, S] f32
                 vs = vs_ref[0, :, pl.ds(i * S, S)]
                 k = k.astype(q.dtype)  # i8 -> exact in bf16/f32
-                v = v * vs[:, :, None]
             # Unfetched positions (tail past kv_len, or pages before the
             # window) hold uninitialized VMEM; zero them so a stray NaN
             # can't poison the (0-prob x v) accumulation.
@@ -139,6 +137,10 @@ def _decode_kernel(
                 preferred_element_type=jnp.float32,
             ) * sm_scale
             if quant:
+                # Row dequantization, factored around the matmuls on the
+                # small [K, G, S] plane: (q . k_i8) * ks == q . (k_i8 *
+                # ks); (probs * vs) . v_i8 == probs . (v_i8 * vs) — the
+                # [K, S, D] value plane is never touched by scales.
                 # Dead-column scale values die in the live mask below
                 # (jnp.where does not propagate the unselected arm).
                 s = s * ks[:, None, :]
@@ -155,8 +157,16 @@ def _decode_kernel(
                 probs, axis=2, keepdims=True
             )
             m_ref[:, :, :1] = m_new
+            # Dead-column vs values are DEFINED (the scale operand is a
+            # fully-copied XLA gather, not a manual DMA) but may be a
+            # pathological f16-overflow inf — 0-prob x inf = NaN, so
+            # re-mask after the multiply.
+            pv_probs = (
+                probs if not quant
+                else jnp.where(live, probs * vs[:, None, :], 0.0)
+            )
             pv = jax.lax.dot_general(
-                probs, v, (((2,), (1,)), ((0,), (0,))),
+                pv_probs, v, (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )  # [K, G, D]
             acc_ref[:] = acc_ref[:] * alpha + pv
@@ -228,18 +238,21 @@ def _decode_call(
     ]
     operands = [qk, sinks2d, kv_cache]
     if scales is not None:
-        # Per-row scales, pre-gathered for this batch's contexts and
-        # relayouted to lane-aligned [B, K, S_max] (page=16-wide slabs
-        # violate Mosaic's 128-lane DMA alignment; at 1/32 of the data
-        # bytes the XLA gather is cheap and fuses into the step).
+        # Per-row scales, gathered + relayouted to lane-aligned
+        # [B, K, S_max] by XLA. A per-page scale DMA inside the kernel
+        # (like the data pages) is structurally impossible: Mosaic
+        # requires a 128-aligned minor dim on manual copies and a page's
+        # scale slab is 2 lanes wide in every scatter-friendly layout —
+        # measured anyway via a const-scales probe: this gather is NOT
+        # the int8 decode cost (within noise of zero).
         lidx = jnp.asarray(layer, jnp.int32).reshape(-1)[0]
         sl = (
             jax.lax.dynamic_index_in_dim(scales, lidx, 0, keepdims=False)
             if scales.ndim == 5 else scales
-        )  # [P, K, 2, page]
-        g = sl[page_table]  # [B, mp, K, 2, page]
+        )  # [P, K, page, 2]
         mp = page_table.shape[1]
-        ksvs = g.transpose(0, 2, 3, 1, 4).reshape(B, K, 2, mp * page)
+        g = sl[page_table]  # [B, mp, K, page, 2]
+        ksvs = g.transpose(0, 2, 4, 1, 3).reshape(B, K, 2, mp * page)
         ksvs = ksvs.astype(jnp.float32)
         sspec = pl.BlockSpec(
             (1, K, mp * page), lambda b, l, pt, kl, ws: (b, 0, 0)
@@ -296,7 +309,7 @@ def decode_paged_attention(
     pages_per_block: int = 16,
     window: jax.Array | None = None,
     sinks: jax.Array | None = None,
-    scales: jax.Array | None = None,  # [num_pages, K, 2, page]
+    scales: jax.Array | None = None,  # [num_pages, K, page, 2]
 ) -> jax.Array:
     return _decode_call(
         q, kv_cache, jnp.zeros((1,), jnp.int32), page_table, kv_lens,
@@ -316,7 +329,7 @@ def decode_paged_attention_full(
     pages_per_block: int = 16,
     window: jax.Array | None = None,
     sinks: jax.Array | None = None,
-    scales: jax.Array | None = None,  # [L, num_pages, K, 2, page]
+    scales: jax.Array | None = None,  # [L, num_pages, K, page, 2]
 ) -> jax.Array:
     """Layer-indexed variant: reads cache[layer] pages directly from the
     full-cache HBM ref — a scan over layers never materializes a

@@ -50,11 +50,25 @@ def test_quantize_error_bound():
     assert np.all(err / amax < 0.01), (err / amax).max()
 
 
-def test_wire_layout_roundtrip():
+def test_wire_layout_contract():
+    """Pool and wire currently SHARE one layout ([..., K, page, 2]) —
+    the converter seam must be inverse AND the wire form must decode a
+    real quantized bundle back to the exact pool values (this second
+    check is what fails if the pair ever drifts one-sidedly; a bare
+    roundtrip of two identities can never fail)."""
     rng = np.random.default_rng(2)
-    s = rng.standard_normal((2, 3, 2, 2, 8)).astype(np.float16)
-    back = wire_scales_to_pool(pool_scales_to_wire(jnp.asarray(s)))
-    np.testing.assert_array_equal(np.asarray(back), s)
+    pages = (rng.standard_normal((2, 3, 2, 8, 64)) * 5).astype(np.float32)
+    d, s_pool = quantize_pages(jnp.asarray(pages))
+    wire = np.asarray(pool_scales_to_wire(s_pool)).astype(np.float16)
+    back = np.asarray(wire_scales_to_pool(jnp.asarray(wire)), np.float32)
+    # f16 wire carries the pool's values losslessly (f16-grid contract)
+    np.testing.assert_array_equal(back, np.asarray(s_pool))
+    # and dequantizing with the round-tripped scales reproduces the
+    # canonical dequant exactly
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_pages(d, jnp.asarray(back), jnp.float32)),
+        np.asarray(dequantize_pages(d, s_pool, jnp.float32)),
+    )
 
 
 def _attention_inputs(B=2, K=2, G=2, page=8, n_pages=6, D=128, seed=0):
