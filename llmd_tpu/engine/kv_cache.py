@@ -17,6 +17,8 @@ until they are actually reused for new data.
 from __future__ import annotations
 
 import collections
+import functools
+import threading
 import dataclasses
 import hashlib
 from collections.abc import Iterable, Sequence
@@ -77,6 +79,20 @@ class KVEventSink:
         pass
 
 
+
+def _locked(fn):
+    """Serialize an allocator method on the instance mutex (see
+    PageAllocator.__init__: the multi-host pipelined import calls in
+    from the fetch thread)."""
+
+    @functools.wraps(fn)
+    def inner(self, *a, **k):
+        with self._lock:
+            return fn(self, *a, **k)
+
+    return inner
+
+
 class PageAllocator:
     """Refcounted page allocator with a content-addressed reuse index."""
 
@@ -91,6 +107,11 @@ class PageAllocator:
         self.page_size = page_size
         self.enable_prefix_caching = enable_prefix_caching
         self.event_sink = event_sink or KVEventSink()
+        # Coarse mutex: the engine thread owns most calls, but the
+        # multi-host pipelined P/D import allocates/frees/scatters
+        # from the fetch executor thread (runner._dispatch_lock
+        # orders the device ops; this orders the host bookkeeping).
+        self._lock = threading.RLock()
         self._meta = [PageMeta() for _ in range(num_pages)]
         # Pages with ref_count == 0, LRU-ordered: left = oldest = evict first.
         # Freed cached pages are appended right so hot content survives longest.
@@ -113,6 +134,7 @@ class PageAllocator:
     def usage(self) -> float:
         return 1.0 - len(self._free) / self.num_pages
 
+    @_locked
     def lookup_cached_prefix(self, token_ids: Sequence[int], extra: bytes = b"") -> list[int]:
         """Longest run of consecutive cached full pages for this prompt.
 
@@ -131,9 +153,43 @@ class PageAllocator:
             pages.append(pid)
         return pages
 
+    @_locked
+    def allocate_with_floor(self, n: int, floor: int) -> list[int]:
+        """Allocate only if at least ``floor`` free pages REMAIN after —
+        atomically, so concurrent reservers (streamed-import fetch
+        threads) cannot jointly drain the decode headroom the floor
+        protects. Raises NoFreePagesError when the floor would be
+        breached."""
+        if len(self._free) - n < floor:
+            raise NoFreePagesError(n + floor, len(self._free))
+        return self.allocate(n)
+
+    @_locked
+    def lookup_and_touch_prefix(
+        self,
+        token_ids: Sequence[int],
+        extra: bytes = b"",
+        max_pages: int | None = None,
+    ) -> list[int]:
+        """Atomic lookup_cached_prefix + touch of (up to ``max_pages``
+        of) the hit run. The two-call form is NOT safe with concurrent
+        allocators: a ref-0 cached page found by lookup can be stolen by
+        a concurrent allocate() (e.g. the multi-host streamed-import
+        fetch thread) before touch() claims it — touch would then
+        ref-bump a page whose content is being overwritten, silently
+        attending over another request's KV."""
+        pages = self.lookup_cached_prefix(token_ids, extra=extra)
+        if max_pages is not None:
+            pages = pages[:max_pages]
+        if pages:
+            self.touch(pages)
+        return pages
+
+    @_locked
     def has_cached(self, content_hash: bytes) -> bool:
         return content_hash in self._cached
 
+    @_locked
     def touch(self, page_ids: Iterable[int]) -> None:
         """Take a reference on cached pages (prefix-cache hit path)."""
         for pid in page_ids:
@@ -143,6 +199,7 @@ class PageAllocator:
                 del self._free[pid]
             meta.ref_count += 1
 
+    @_locked
     def allocate(self, n: int) -> list[int]:
         """Allocate n fresh pages (ref=1), evicting cached content LRU-first."""
         if n > len(self._free):
@@ -160,6 +217,7 @@ class PageAllocator:
             out.append(pid)
         return out
 
+    @_locked
     def commit_page(
         self,
         page_id: int,
@@ -185,6 +243,7 @@ class PageAllocator:
             self.commit_hook(page_id, content_hash)
         return page_id
 
+    @_locked
     def free(self, page_ids: Iterable[int]) -> None:
         for pid in page_ids:
             meta = self._meta[pid]
@@ -198,6 +257,7 @@ class PageAllocator:
                 if meta.content_hash is None:
                     self._free.move_to_end(pid, last=False)
 
+    @_locked
     def clear(self) -> None:
         for h in list(self._cached):
             self._cached.pop(h)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -57,6 +58,7 @@ from llmd_tpu.parallel.mesh import MeshContext, kv_cache_spec, shard_params
 # each process's own pool shards).
 _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
 _OP_KV_GATHER, _OP_KV_SCATTER = 3, 4
+_OP_EMBED, _OP_LORA = 5, 6
 
 log = logging.getLogger(__name__)
 
@@ -185,6 +187,16 @@ class ModelRunner:
         self.kv_cache = self._alloc_kv()
         self.kv_swa = self._alloc_swa()
         self._multihost = dist.is_multihost()
+        # Serializes lockstep broadcast+dispatch pairs so NON-engine
+        # threads (P/D fetch staging, embeds, adapter installs) can
+        # originate ops: followers mirror in receive order, so each
+        # leader op must be broadcast AND dispatched atomically.
+        self._dispatch_lock = threading.RLock()
+        # Set by stop_followers: once _OP_STOP is broadcast the followers
+        # are gone, and any later lockstep broadcast (e.g. from an
+        # orphaned streamed-fetch thread) would block forever in a
+        # collective nobody answers — refuse loudly instead.
+        self._stopped = False
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
         if config.parallel.enable_dbo and not ops._on_tpu():
@@ -362,7 +374,6 @@ class ModelRunner:
         adapter name before its weights load is safe; this is the hook
         checkpoint loading and dynamic adapter registration use.
         """
-        self._require_single_host("set_lora_weights")
         if not (0 < lora_id <= self.cfg.num_lora_adapters):
             raise ValueError(f"lora_id {lora_id} out of range")
         for a, b in (("la_q", "lb_q"), ("la_v", "lb_v")):
@@ -372,10 +383,29 @@ class ModelRunner:
                     "compose with stale/zero factors and silently serve the "
                     "wrong adapter"
                 )
-        layers = dict(self.params["layers"])
-        for k, v in weights.items():
+        for k in weights:
             if k not in ("la_q", "lb_q", "la_v", "lb_v"):
                 raise KeyError(f"unknown LoRA tensor {k!r}")
+        # Multi-host: the per-slot update is a plain SPMD program over
+        # the sharded params — broadcast the factors (header: B carries
+        # a pair-presence bitmask, QK the slot id) and apply everywhere.
+        mask = (1 if "la_q" in weights else 0) | (2 if "la_v" in weights else 0)
+        layers = self.params["layers"]
+        arrays = {
+            # Normalized to the slot's (L, *factor) shape so the payload
+            # spec is derivable from the shared params structure.
+            k: np.ascontiguousarray(np.asarray(v, np.float32)).reshape(
+                layers[k].shape[0], *layers[k].shape[2:]
+            )
+            for k, v in weights.items()
+        }
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_LORA, mask, lora_id, False, arrays)
+            self._exec_lora(arrays, lora_id)
+
+    def _exec_lora(self, arrays: dict, lora_id: int) -> None:
+        layers = dict(self.params["layers"])
+        for k, v in arrays.items():
             arr = layers[k]
             layers[k] = arr.at[:, lora_id].set(
                 jnp.asarray(v, arr.dtype).reshape(arr.shape[0], *arr.shape[2:])
@@ -647,14 +677,16 @@ class ModelRunner:
     def _kv_gather_lockstep(self, ids: np.ndarray, q8: bool, swa: bool = False):
         """Leader leg of a multi-host page gather: broadcast the op so
         every process dispatches the same program; return the (replicated)
-        result. Engine/leader thread only — the broadcast stream is
-        totally ordered by the single engine thread. The header's 4th
-        slot carries the pool selector (main vs SWA ring) for KV ops."""
+        result. Any leader thread may call — the dispatch lock keeps each
+        broadcast+dispatch pair atomic in the totally ordered op stream.
+        The header's 4th slot carries the pool selector (main vs SWA
+        ring) for KV ops."""
         assert dist.is_leader(), "KV staging ops originate on the leader"
-        arrays = self._sync(
-            _OP_KV_GATHER, len(ids), int(q8), bool(swa), {"ids": ids}
-        )
-        return self._exec_kv_gather(arrays, q8, swa)
+        with self._dispatch_lock:
+            arrays = self._sync(
+                _OP_KV_GATHER, len(ids), int(q8), bool(swa), {"ids": ids}
+            )
+            return self._exec_kv_gather(arrays, q8, swa)
 
     # ------------------------------------------------------------------ #
     # host-side input prep
@@ -745,6 +777,23 @@ class ModelRunner:
         config both sides share."""
         if op == _OP_KV_GATHER:
             return [("ids", (B,), np.int32)]
+        if op == _OP_EMBED:
+            return [
+                ("tokens", (B, QK), np.int32),
+                ("positions", (B, QK), np.int32),
+                ("qlens", (B,), np.int32),
+            ]
+        if op == _OP_LORA:
+            # B slot = pair-presence bitmask (1: q pair, 2: v pair); the
+            # factor shapes derive from the shared params structure.
+            layers = self.params["layers"]
+            spec = []
+            for bit, a, b in ((1, "la_q", "lb_q"), (2, "la_v", "lb_v")):
+                if B & bit:
+                    for k in (a, b):
+                        s = layers[k].shape
+                        spec.append((k, (s[0], *s[2:]), np.float32))
+            return spec
         if op == _OP_KV_SCATTER:
             # QK carries the pool selector (main vs SWA ring): the two
             # pools have different layer counts, so the payload geometry
@@ -792,6 +841,11 @@ class ModelRunner:
         """Leader leg: broadcast header + payload; identity single-host."""
         if not self._multihost:
             return arrays
+        if self._stopped:
+            raise RuntimeError(
+                "lockstep dispatch after stop_followers: the follower "
+                "processes have exited and a broadcast would hang"
+            )
         from jax.experimental import multihost_utils as mhu
 
         mhu.broadcast_one_to_all(
@@ -834,6 +888,12 @@ class ModelRunner:
                 self._exec_kv_gather(arrays, bool(QK), bool(greedy))
             elif op == _OP_KV_SCATTER:
                 self._exec_kv_scatter(arrays, B, bool(QK))
+            elif op == _OP_EMBED:
+                # greedy slot carries the lora id; the replicated pooled
+                # output is only read on the leader.
+                self._exec_embed(arrays, greedy)
+            elif op == _OP_LORA:
+                self._exec_lora(arrays, QK)
             else:
                 self._exec_decode(arrays, QK, bool(greedy))
 
@@ -841,9 +901,13 @@ class ModelRunner:
         if self._multihost and dist.is_leader():
             from jax.experimental import multihost_utils as mhu
 
-            mhu.broadcast_one_to_all(
-                np.asarray([_OP_STOP, 0, 0, 0], np.int32), is_source=True
-            )
+            with self._dispatch_lock:
+                if self._stopped:
+                    return
+                self._stopped = True
+                mhu.broadcast_one_to_all(
+                    np.asarray([_OP_STOP, 0, 0, 0], np.int32), is_source=True
+                )
 
     def _exec_prefill(self, arrays: dict, all_greedy: bool) -> jax.Array:
         inp = StepInput(
@@ -1060,11 +1124,12 @@ class ModelRunner:
             vals = np.ascontiguousarray(
                 np.asarray(pages).astype(self.staging_dtype, copy=False)
             )
-            arrays = self._sync(
-                _OP_KV_SCATTER, bucket, int(swa), False,
-                {"ids": ids, "vals_u8": vals.view(np.uint8).reshape(-1)},
-            )
-            self._exec_kv_scatter(arrays, bucket, swa)
+            with self._dispatch_lock:
+                arrays = self._sync(
+                    _OP_KV_SCATTER, bucket, int(swa), False,
+                    {"ids": ids, "vals_u8": vals.view(np.uint8).reshape(-1)},
+                )
+                self._exec_kv_scatter(arrays, bucket, swa)
             return
         vals = jnp.asarray(np.asarray(pages), dtype=self.staging_dtype)
         out = self._scatter_canonical(self._pool(swa), jnp.asarray(ids), vals)
@@ -1085,7 +1150,6 @@ class ModelRunner:
         over a throwaway KV scratch pool — embeddings never touch the
         serving cache, so this is safe to run concurrently with the step
         loop (params are read-only)."""
-        self._require_single_host("run_embed (/v1/embeddings)")
         if not prompts:
             return np.zeros((0, self.cfg.hidden_size), np.float32)
         maxlen = max(len(p) for p in prompts)
@@ -1105,8 +1169,6 @@ class ModelRunner:
         n = len(prompts)
         Q = pad_to_bucket(maxlen, self.prefill_buckets)
         B = pad_to_bucket(n, self.batch_buckets)
-        page = self.page
-        pages_per_seq = -(-Q // page)
         tokens = np.zeros((B, Q), np.int32)
         positions = np.zeros((B, Q), np.int32)
         qlens = np.zeros(B, np.int32)
@@ -1116,15 +1178,38 @@ class ModelRunner:
             positions[i, :m] = np.arange(m)
             positions[i, m:] = max(m - 1, 0)
             qlens[i] = m
-        page_table = np.arange(B * pages_per_seq, dtype=np.int32).reshape(
-            B, pages_per_seq
+        arrays = {"tokens": tokens, "positions": positions, "qlens": qlens}
+        if self._multihost:
+            # A plain SPMD program like any step — broadcast the host
+            # inputs (lora_id rides the header's 4th slot) and dispatch
+            # on every process; the replicated output is read locally.
+            # The lock covers broadcast ordering only; single-host embeds
+            # run lock-free so an embed compile never stalls the step
+            # loop (params are read-only, scratch is program-internal).
+            with self._dispatch_lock:
+                arrays = self._sync(_OP_EMBED, B, Q, lora_id, arrays)
+                pooled = self._exec_embed(arrays, lora_id)
+        else:
+            pooled = self._exec_embed(arrays, lora_id)
+        return np.asarray(pooled[:n])
+
+    def _exec_embed(self, arrays: dict, lora_id: int) -> jax.Array:
+        B, Q = arrays["tokens"].shape
+        page = self.page
+        pages_per_seq = -(-Q // page)
+        # Page table / lora ids derive from (B, Q, lora_id) identically
+        # on every process — not broadcast.
+        pt = jnp.asarray(
+            np.arange(B * pages_per_seq, dtype=np.int32).reshape(
+                B, pages_per_seq
+            )
         )
-        pt = jnp.asarray(page_table)
+        qlens = jnp.asarray(arrays["qlens"])
         inp = StepInput(
-            token_ids=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            query_lens=jnp.asarray(qlens),
-            kv_lens=jnp.asarray(qlens),
+            token_ids=jnp.asarray(arrays["tokens"]),
+            positions=jnp.asarray(arrays["positions"]),
+            query_lens=qlens,
+            kv_lens=qlens,
             page_table=pt,
             lora_ids=(
                 jnp.full(B, lora_id, jnp.int32)
@@ -1136,28 +1221,7 @@ class ModelRunner:
             # is just a table pattern).
             swa_page_table=pt if self.swa is not None else None,
         )
-        data = self._kv_data
-
-        def scratch_pool(num_layers: int):
-            shape = (
-                num_layers, B * pages_per_seq, data.shape[2], page,
-                data.shape[4],
-            )
-            if self.kv_quantized:
-                return (
-                    jnp.zeros(shape, jnp.int8),
-                    jnp.ones((*shape[:3], page, 2), jnp.float32),
-                )
-            return jnp.zeros(shape, data.dtype)
-
-        if self.swa is not None:
-            scratch = scratch_pool(len(self.swa.full_layers))
-            scratch_swa = scratch_pool(len(self.swa.swa_layers))
-        else:
-            scratch = scratch_pool(self.cfg.num_layers)
-            scratch_swa = None
-        pooled = self._embed_fn(self.params, scratch, scratch_swa, inp)
-        return np.asarray(pooled[:n])
+        return self._embed_fn(self.params, inp)
 
     @functools.cached_property
     def _embed_fn(self):
@@ -1166,9 +1230,40 @@ class ModelRunner:
         moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
         ep_capacity = self.config.parallel.ep_capacity_factor
         ring = self.swa is not None
+        data_shape = self._kv_data.shape
+        data_dtype = self._kv_data.dtype
+        quantized = self.kv_quantized
+        page = self.page
+        swa = self.swa
+        num_layers = self.cfg.num_layers
+        replicate = self._replicate_out
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2) if ring else (1,))
-        def embed(params, scratch_kv, scratch_swa, inp: StepInput):
+        @jax.jit
+        def embed(params, inp: StepInput):
+            # Scratch pools are created INSIDE the jit (SPMD-consistent
+            # on a multi-host mesh; XLA also frees them at program end
+            # instead of holding host-side references).
+            B, Q = inp.token_ids.shape
+            pages_per_seq = -(-Q // page)
+
+            def scratch_pool(n_layers: int):
+                shape = (
+                    n_layers, B * pages_per_seq, data_shape[2], page,
+                    data_shape[4],
+                )
+                if quantized:
+                    return (
+                        jnp.zeros(shape, jnp.int8),
+                        jnp.ones((*shape[:3], page, 2), jnp.float32),
+                    )
+                return jnp.zeros(shape, data_dtype)
+
+            if ring:
+                scratch_kv = scratch_pool(len(swa.full_layers))
+                scratch_swa = scratch_pool(len(swa.swa_layers))
+            else:
+                scratch_kv = scratch_pool(num_layers)
+                scratch_swa = None
             if ring:
                 hidden, _, _ = llama.forward_hidden(
                     params, scratch_kv, inp, cfg, world, mesh=mesh,
@@ -1185,9 +1280,10 @@ class ModelRunner:
             summed = jnp.sum(hidden.astype(jnp.float32) * valid, axis=1)
             denom = jnp.maximum(jnp.sum(valid, axis=1), 1.0)
             mean = summed / denom
-            return mean / jnp.maximum(
+            out = mean / jnp.maximum(
                 jnp.linalg.norm(mean, axis=-1, keepdims=True), 1e-12
             )
+            return replicate(out)
 
         return embed
 
@@ -1252,8 +1348,9 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = self._lora_array(seqs, B)
         all_greedy = all(s.request.sampling.greedy for s in seqs)
-        arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
-        packed = self._exec_prefill(arrays, all_greedy)
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
+            packed = self._exec_prefill(arrays, all_greedy)
         if not sync:
             return None  # eager-ACK: forward enqueued, token never fetched
         return self._unpack(packed, n)
@@ -1281,8 +1378,9 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = self._lora_array(seqs, B)
         all_greedy = all(s.request.sampling.greedy for s in seqs)
-        arrays = self._sync(_OP_DECODE, B, k_steps, all_greedy, arrays)
-        packed = self._exec_decode(arrays, k_steps, all_greedy)
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_DECODE, B, k_steps, all_greedy, arrays)
+            packed = self._exec_decode(arrays, k_steps, all_greedy)
         return self._unpack(packed, n, k_steps)
 
     # ------------------------------------------------------------------ #
@@ -1338,8 +1436,9 @@ class ModelRunner:
             arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
-        arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
-        self._exec_prefill(arrays, all_greedy)
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
+            self._exec_prefill(arrays, all_greedy)
 
     def _warm_decode(self, B: int, K: int, all_greedy: bool = False) -> None:
         arrays = {
@@ -1356,5 +1455,6 @@ class ModelRunner:
             arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
-        arrays = self._sync(_OP_DECODE, B, K, all_greedy, arrays)
-        self._exec_decode(arrays, K, all_greedy)
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_DECODE, B, K, all_greedy, arrays)
+            self._exec_decode(arrays, K, all_greedy)

@@ -248,16 +248,18 @@ class EngineScheduler:
         """Reuse cached full pages covering the prompt prefix."""
         if req.block_ids:
             return
-        cached = self.allocator.lookup_cached_prefix(
-            req.prompt_token_ids, extra=self._hash_extra(req)
-        )
         # Never satisfy the *entire* prompt from cache: the last token must be
-        # computed so the step emits logits for sampling.
+        # computed so the step emits logits for sampling. Lookup + touch
+        # are one atomic allocator call: a concurrent allocate() (the
+        # multi-host streamed-import fetch thread) must not steal a
+        # ref-0 hit between the two.
         max_cached = (req.num_prompt_tokens - 1) // self.allocator.page_size
-        cached = cached[:max_cached]
+        cached = self.allocator.lookup_and_touch_prefix(
+            req.prompt_token_ids, extra=self._hash_extra(req),
+            max_pages=max_cached,
+        )
         if not cached:
             return
-        self.allocator.touch(cached)
         req.block_ids.extend(cached)
         n = len(cached)
         req.num_cached_tokens = n * self.allocator.page_size

@@ -93,6 +93,16 @@ class KVLoadError(RuntimeError):
     """Remote KV pull failed and policy is 'fail'."""
 
 
+def _pad_chunk_ids(ids: list[int], cp: int) -> list[int]:
+    """Pad a chunk's page-id slice to ``cp`` by repeating the last real
+    id: producers pad tail chunks by repeating the last real PAGE, so
+    aiming the pad slots at the same id makes the duplicate write
+    idempotent."""
+    if len(ids) < cp:
+        return ids + [ids[-1]] * (cp - len(ids))
+    return ids
+
+
 @dataclasses.dataclass
 class PulledBundle:
     """A fetched-and-validated KV bundle awaiting engine-thread apply."""
@@ -111,6 +121,11 @@ class PulledBundle:
     # common pipelined apply reads only device_chunks).
     np_chunks: list = dataclasses.field(default_factory=list)
     chunk_pages: int = 0
+    # Multi-host STREAMED import: pages pre-allocated by the fetch
+    # thread and already lockstep-scattered chunk-by-chunk as pulls
+    # landed (overlapping wire and broadcast legs); apply only commits
+    # hashes. Covers pages [start_page, start_page + len(stream_ids)).
+    stream_ids: list | None = None
     # Prompt-page index of the first page in the first PULLED chunk
     # (byte diet: producer-skipped pages + consumer-skipped chunks).
     start_page: int = 0
@@ -325,6 +340,7 @@ class TPUConnector:
         self.imported_bytes = 0
         self.import_failures = 0
         self.local_imports = 0  # transfers served by the in-process path
+        self.stream_imports = 0  # multi-host pipelined (streamed) imports
         # last-transfer stage timings (ms) — the P/D TTFT budget, readable
         # from stats()/bench without instrumentation hooks
         self.last_stage_ms = 0.0   # producer: HBM->host downloads + register
@@ -707,11 +723,34 @@ class TPUConnector:
             skip0 += 1
         j0 = max(0, (skip0 - sp) // cp) if skip0 > sp else 0
         start_page = sp + j0 * cp
-        # Multi-host consumer: the fetch executor thread must NOT touch
-        # device state (uploads to process-local scratch cannot feed the
-        # lockstep global-mesh scatter) — keep host chunks only; the
-        # engine thread's apply broadcasts one canonical scatter.
-        pipelined = not getattr(self.runner, "_multihost", False)
+        # Multi-host consumer: process-local device-scratch uploads
+        # cannot feed the lockstep global-mesh scatter, so the
+        # device_chunks pipeline stays single-host. The multi-host
+        # analog STREAMS instead: pages are allocated up front (the
+        # allocator is thread-safe) and each chunk broadcast-scatters as
+        # its pull lands — the runner's dispatch lock interleaves these
+        # ops safely with the engine's steps, so the wire pulls overlap
+        # the DCN broadcast + device scatter legs chunk by chunk.
+        multihost = getattr(self.runner, "_multihost", False)
+        pipelined = not multihost
+        stream_ids: list[int] | None = None
+        if multihost and not ring_mode:
+            from llmd_tpu.engine.kv_cache import NoFreePagesError
+
+            # Streaming reserves the pages for the WHOLE wire transfer
+            # (seconds on a slow link) — only do it with decode headroom
+            # left over, or the reservation starves the scheduler into
+            # preempting live requests to feed a not-yet-usable import.
+            # Check + allocate are one atomic allocator call: concurrent
+            # fetch threads must not jointly reserve past the floor.
+            need = n_full - start_page
+            headroom = max(self.allocator.num_pages // 8, 16)
+            try:
+                stream_ids = self.allocator.allocate_with_floor(
+                    need, headroom
+                )
+            except NoFreePagesError:
+                stream_ids = None  # buffered fallback under pressure
         # Per-CHUNK deadline, reset on progress: a shared whole-bundle
         # budget would let a large multi-chunk transfer over a slow link
         # exhaust itself on later chunks and spuriously fall back to
@@ -742,46 +781,74 @@ class TPUConnector:
                     f"vs consumer {want_dtype}"
                 )
             nbytes += len(blob)
-        for j in range(j0, n_chunks):
-            blob = shipper_mod.pull_wait(
-                host, port, chunk_key(key, j),
-                min(time.monotonic() + per_chunk_s, hard_deadline),
-            )
-            decoded = unpack_pages_any(blob)
-            payload = decoded[1]
-            if payload.shape[1] != cp:
-                raise ValueError(
-                    f"chunk {j} holds {payload.shape[1]} pages, expected {cp}"
+        try:
+            for j in range(j0, n_chunks):
+                blob = shipper_mod.pull_wait(
+                    host, port, chunk_key(key, j),
+                    min(time.monotonic() + per_chunk_s, hard_deadline),
                 )
-            if decoded[0] == "q8":
-                # Already lossy, and dequantization targets the CONSUMER
-                # pool dtype — no producer-pool-dtype match required
-                # (heterogeneous-pool pairings are fine).
-                _, q8, scales, _orig = decoded
-                np_chunks.append((q8, scales))
-                if pipelined:
-                    dev_chunks.append(
-                        self.runner.upload_pages_device_q8(q8, scales)
-                    )
-            else:
-                if payload.dtype != want_dtype and not pool_quant:
-                    # The EXACT path's guarantee is byte-identical
-                    # numerics; silent casts would break it. (Int8 pools
-                    # re-quantize on scatter — any float dtype works.)
+                decoded = unpack_pages_any(blob)
+                payload = decoded[1]
+                if payload.shape[1] != cp:
                     raise ValueError(
-                        f"KV dtype mismatch: producer {payload.dtype} "
-                        f"vs consumer {want_dtype}"
+                        f"chunk {j} holds {payload.shape[1]} pages, "
+                        f"expected {cp}"
                     )
-                np_chunks.append(payload)
-                if pipelined:
-                    dev_chunks.append(self.runner.upload_pages_device(payload))
-            nbytes += len(blob)
+                if decoded[0] == "q8":
+                    # Already lossy, and dequantization targets the
+                    # CONSUMER pool dtype — no producer-pool-dtype match
+                    # required (heterogeneous-pool pairings are fine).
+                    _, q8, scales, _orig = decoded
+                    chunk_entry = (q8, scales)
+                    if pipelined:
+                        dev_chunks.append(
+                            self.runner.upload_pages_device_q8(q8, scales)
+                        )
+                else:
+                    if payload.dtype != want_dtype and not pool_quant:
+                        # The EXACT path's guarantee is byte-identical
+                        # numerics; silent casts would break it. (Int8
+                        # pools re-quantize on scatter — any float dtype
+                        # works.)
+                        raise ValueError(
+                            f"KV dtype mismatch: producer {payload.dtype} "
+                            f"vs consumer {want_dtype}"
+                        )
+                    chunk_entry = payload
+                    if pipelined:
+                        dev_chunks.append(
+                            self.runner.upload_pages_device(payload)
+                        )
+                if stream_ids is not None:
+                    # Streamed multi-host leg: broadcast-scatter this
+                    # chunk now, while later chunks are still on the
+                    # wire, and do NOT retain a host copy (the streamed
+                    # apply never reads np_chunks; holding the whole
+                    # transfer in RAM would cost a bundle-sized buffer
+                    # for nothing). Pad slots repeat the last real id
+                    # (idempotent duplicate write). The broadcast rides
+                    # the staging dtype — a symmetric q8 form of
+                    # _OP_KV_SCATTER (matching the gather's q8 flag)
+                    # would halve the DCN bytes for q8 wire chunks and
+                    # is the known next step here.
+                    o0 = sp + j * cp - start_page
+                    ids_j = _pad_chunk_ids(stream_ids[o0 : o0 + cp], cp)
+                    self.runner.scatter_pages(
+                        ids_j, PulledBundle._dequant_chunk(chunk_entry)
+                    )
+                else:
+                    np_chunks.append(chunk_entry)
+                nbytes += len(blob)
+        except Exception:
+            if stream_ids is not None:
+                self.allocator.free(stream_ids)
+            raise
         return PulledBundle(
             pages=None, hashes=hashes[:n_full], nbytes=nbytes,
             host=host, port=port, key=key,
             keys=all_keys,
             device_chunks=dev_chunks, np_chunks=np_chunks, chunk_pages=cp,
-            start_page=start_page,
+            start_page=start_page, stream_ids=stream_ids,
             swa_pages_np=swa_np, swa_start_page=swa_sp, swa_count=n_swa,
         )
 
@@ -806,6 +873,14 @@ class TPUConnector:
             return None
         finally:
             self.last_fetch_ms = (time.monotonic() - t0) * 1e3
+
+    def release_bundle(self, bundle: "PulledBundle") -> None:
+        """Dispose of a fetched bundle that will never be applied: free
+        any stream-allocated pages and fire the producer free-notify."""
+        if bundle.stream_ids is not None:
+            self.allocator.free(bundle.stream_ids)
+            bundle.stream_ids = None
+        self._notify_free_async(bundle)
 
     def apply_bundle(
         self, prompt_token_ids: list[int], bundle: "PulledBundle"
@@ -833,6 +908,30 @@ class TPUConnector:
         while skip < n_full and self.allocator.has_cached(hashes[skip]):
             skip += 1
         skip = max(skip, bundle.start_page)
+        if bundle.stream_ids is not None:
+            # Streamed multi-host import: content already scattered by
+            # the fetch thread — commit the hash chain and release refs.
+            # Pages whose hash got cached since the fetch decision are
+            # duplicates; commit_page dedups onto the existing page and
+            # the spare frees with the rest.
+            parent = None if skip == 0 else hashes[skip - 1]
+            adopted = 0
+            for i, pid in enumerate(bundle.stream_ids):
+                idx = bundle.start_page + i
+                if idx >= n_full or idx < skip:
+                    continue
+                chunk = prompt_token_ids[idx * page : (idx + 1) * page]
+                self.allocator.commit_page(pid, hashes[idx], chunk, parent)
+                parent = hashes[idx]
+                adopted += 1
+            self.allocator.free(bundle.stream_ids)
+            bundle.stream_ids = None  # release_bundle stays idempotent
+            self.stream_imports += 1
+            self.imported_requests += 1
+            self.imported_bytes += bundle.nbytes
+            self._notify_free_async(bundle)
+            self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
+            return adopted
         if bundle.device_chunks and not bundle.np_chunks:
             # Local-fastpath bundles keep no host chunks for the
             # partial-overlap fallback; re-importing from start_page is
@@ -857,13 +956,9 @@ class TPUConnector:
                     if p0 + cp <= skip:
                         continue  # wholly cached since the fetch decision
                     if p0 >= skip:
-                        ids_j = page_ids[p0 - skip : p0 - skip + cp]
-                        if len(ids_j) < cp:
-                            # Producer padded the last chunk by repeating
-                            # its final page; aiming the pad slots at the
-                            # last real id makes the duplicate write
-                            # idempotent.
-                            ids_j = ids_j + [ids_j[-1]] * (cp - len(ids_j))
+                        ids_j = _pad_chunk_ids(
+                            page_ids[p0 - skip : p0 - skip + cp], cp
+                        )
                         self.runner.scatter_pages_from_device(ids_j, dev)
                     else:
                         # Partial overlap (cache grew between fetch and
@@ -978,12 +1073,7 @@ class TPUConnector:
                 cp = bundle.chunk_pages
                 for j, dev in enumerate(bundle.device_chunks):
                     p0 = bundle.start_page + j * cp
-                    ids_j = page_ids[p0 : p0 + cp]
-                    if len(ids_j) < cp:
-                        # Producer-padded tail columns REPEAT the last
-                        # real page — aiming pad slots at it is idempotent
-                        # (same trick as apply_bundle).
-                        ids_j = ids_j + [ids_j[-1]] * (cp - len(ids_j))
+                    ids_j = _pad_chunk_ids(page_ids[p0 : p0 + cp], cp)
                     self.runner.scatter_pages_from_device(ids_j, dev)
             elif bundle.pages is not None or bundle.np_chunks:
                 want = bundle.host_pages(n_full)
@@ -1068,6 +1158,7 @@ class TPUConnector:
             "imported_bytes": self.imported_bytes,
             "import_failures": self.import_failures,
             "local_imports": self.local_imports,
+            "stream_imports": self.stream_imports,
             "last_stage_ms": round(self.last_stage_ms, 1),
             "last_fetch_ms": round(self.last_fetch_ms, 1),
             "last_apply_ms": round(self.last_apply_ms, 1),

@@ -33,6 +33,19 @@ class _Pending:
     lora_name: str = ""
 
 
+def _release_pulled(engine, kv_transfer_params) -> None:
+    """Release a fetched-but-never-applied bundle riding in
+    ``kv_transfer_params["__pulled__"]``: a streamed multi-host fetch
+    pre-allocates pool pages that leak permanently unless every path
+    that drops the bundle before apply funnels through here."""
+    conn = getattr(engine, "kv_connector", None)
+    if conn is None or not kv_transfer_params:
+        return
+    b = kv_transfer_params.get("__pulled__")
+    if b is not None:
+        conn.release_bundle(b)
+
+
 class RequestFailed(Exception):
     """Client-side error (invalid request); maps to HTTP 400."""
 
@@ -59,6 +72,18 @@ class AsyncEngine:
         # request_id -> asyncio.Queue of RequestOutput | Exception
         self._subs: dict[str, asyncio.Queue] = {}
         self._thread: threading.Thread | None = None
+        # P/D fetch pool (see generate): owning the concurrent futures is
+        # what makes abandoned-fetch cleanup possible. Sized like the
+        # default loop executor — fetches block in pull_wait for long
+        # stretches, so a small cap would head-of-line-block TTFT under
+        # concurrent prefill handoffs.
+        import concurrent.futures
+        import os
+
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, (os.cpu_count() or 1) + 4),
+            thread_name_prefix="llmd-kv-fetch",
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -75,6 +100,7 @@ class AsyncEngine:
             self._lock.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        self._fetch_pool.shutdown(wait=False, cancel_futures=True)
 
     @property
     def stats(self):
@@ -166,17 +192,39 @@ class AsyncEngine:
         # loop; the engine thread only applies the pre-fetched bundle.
         conn = getattr(self.engine, "kv_connector", None)
         if conn is not None and conn.wants_import(kv_transfer_params):
-            loop = asyncio.get_running_loop()
+            # Submitted on OUR executor so the CONCURRENT future is in
+            # hand: cancelling the awaiting task cancels only the
+            # asyncio wrapper (which then DISCARDS the executor's real
+            # result), so cleanup must attach to the concurrent future —
+            # it alone still observes the fetched bundle whose streamed
+            # multi-host fetch pre-allocated pool pages.
+            cfut = self._fetch_pool.submit(
+                conn.fetch_remote_policy,
+                list(prompt_token_ids), kv_transfer_params,
+            )
             try:
-                bundle = await loop.run_in_executor(
-                    None, conn.fetch_remote_policy,
-                    list(prompt_token_ids), kv_transfer_params,
-                )
+                bundle = await asyncio.wrap_future(cfut)
+            except asyncio.CancelledError:
+
+                def _release(f):
+                    try:
+                        b = f.result()
+                    except BaseException:
+                        return  # fetch failed/cancelled: nothing to free
+                    _release_pulled(self.engine, {"__pulled__": b})
+
+                cfut.add_done_callback(_release)
+                raise
             except Exception as e:  # KVLoadError under policy='fail'
                 raise EngineError(f"remote KV load failed: {e}") from e
             kv_transfer_params = {**kv_transfer_params, "__pulled__": bundle}
-        q = self.submit(request_id, prompt_token_ids, sampling, priority,
-                        kv_transfer_params, lora_id, lora_name)
+        try:
+            q = self.submit(request_id, prompt_token_ids, sampling, priority,
+                            kv_transfer_params, lora_id, lora_name)
+        except Exception:
+            # A bundle that never reaches apply must release its pages.
+            _release_pulled(self.engine, kv_transfer_params)
+            raise
         try:
             while True:
                 item = await q.get()
@@ -236,6 +284,7 @@ class AsyncEngine:
                         lora_name=p.lora_name,
                     )
                 except Exception as e:  # validation errors -> caller
+                    _release_pulled(self.engine, p.kv_transfer_params)
                     self._deliver(p.request_id, RequestFailed(str(e)))
             if not self.engine.has_work():
                 continue

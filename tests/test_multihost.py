@@ -277,6 +277,10 @@ _PD_WORKER = textwrap.dedent("""
     assert eng.kv_connector.imported_requests == 1, eng.kv_connector.stats()
     assert eng.kv_connector.import_failures == 0, eng.kv_connector.stats()
     assert eng.kv_connector.imported_bytes > 0
+    if mode != "swa":
+        # Multi-host cache-seeding imports take the STREAMED path:
+        # chunks lockstep-scatter as pulls land (no buffered apply).
+        assert eng.kv_connector.stream_imports == 1, eng.kv_connector.stats()
     with open(done_file, "w") as f:
         f.write("ok")
     eng.close()
@@ -337,6 +341,91 @@ def test_multihost_pd_transfer(tmp_path, transfer_dtype):
     assert any(
         ln.startswith("RESULT [") for ln in outs[("consumer", 0)].splitlines()
     ), outs[("consumer", 0)][-2000:]
+
+
+_EMBED_LORA_WORKER = textwrap.dedent("""
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+    from llmd_tpu.parallel import distributed as dist
+
+    # argv: role(ignored) pid nproc port
+    pid, nproc, port = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+    dist.maybe_initialize(
+        coordinator=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+    )
+    cfg = EngineConfig(
+        model=tiny_model_config(
+            num_kv_heads=4, num_heads=8, num_lora_adapters=2, lora_rank=4
+        ),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, decode_window=4
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=4, data_parallel_size=2),
+        offload=None,
+    )
+    engine = LLMEngine(cfg)
+    if not dist.is_leader():
+        engine.runner.follower_loop()
+        sys.exit(0)
+
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    # 1. /v1/embeddings over the lockstep broadcast: plain SPMD program.
+    emb = engine.embed(prompts)
+    assert emb.shape == (2, cfg.model.hidden_size), emb.shape
+    norms = np.linalg.norm(emb, axis=-1)
+    assert np.allclose(norms, 1.0, atol=1e-3), norms
+
+    # 2. LoRA install broadcast to every process, then adapter-routed
+    #    generation: slot 1 must now differ from base (slot 0).
+    L = cfg.model.num_layers
+    layers = engine.runner.params["layers"]
+    rng = np.random.default_rng(0)
+    w = {
+        "la_q": rng.standard_normal(
+            (L, *layers["la_q"].shape[2:])).astype(np.float32) * 0.5,
+        "lb_q": rng.standard_normal(
+            (L, *layers["lb_q"].shape[2:])).astype(np.float32) * 0.5,
+    }
+    engine.runner.set_lora_weights(1, w)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    base = list(engine.generate([[5, 6, 7, 8]], sp).values())[0]
+    rid = engine.add_request(
+        [5, 6, 7, 8], sp, lora_id=1, lora_name="a1"
+    )
+    adapted = []
+    while engine.has_work():
+        for o in engine.step():
+            adapted.extend(o.new_token_ids)
+    engine.close()
+    print("RESULT " + json.dumps({"base": base, "adapted": adapted,
+                                  "differs": base != adapted}))
+""")
+
+
+def test_multihost_embed_and_lora():
+    """Multi-host embeddings + LoRA installs ride the lockstep broadcast
+    (the r4 refusals at runner.run_embed/set_lora_weights are gone):
+    embeds return unit-norm vectors, and an installed adapter changes
+    slot-routed generation while the base slot is untouched."""
+    procs = _spawn_world(_EMBED_LORA_WORKER, "x", 2, 4, [])
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out[-4000:]}"
+    line = [
+        ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")
+    ]
+    assert line, outs[0][-2000:]
+    res = json.loads(line[0][len("RESULT "):])
+    assert res["differs"], res
 
 
 _OFFLOAD_WORKER = textwrap.dedent("""
