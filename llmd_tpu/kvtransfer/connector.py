@@ -65,9 +65,13 @@ class KVTransferConfig:
     # invariance default); "int8" quantizes each (token, head) row to
     # int8 + an f16 scale ON DEVICE before staging — both staging legs
     # move half the bytes (the TTFT floor when staging-bandwidth-bound),
-    # at ~0.4% per-row error. Producer-driven; the consumer dequantizes
-    # into its pool dtype.
-    transfer_dtype: str = "auto"  # "auto" | "int8"
+    # at ~0.4% per-row error. "adaptive" measures both encodings on THIS
+    # link (per-chunk staging throughput in ORIGINAL bytes, EWMA, with
+    # periodic re-probes) and picks the faster per export: whether int8's
+    # halved bytes beat its quantize+scales overhead depends entirely on
+    # the link (BENCH r3 vs r4 measured opposite winners). Producer-
+    # driven; the consumer dequantizes into its pool dtype.
+    transfer_dtype: str = "auto"  # "auto" | "int8" | "adaptive"
     # Single-host xPyD: consumers claim in-process producers' device
     # snapshots directly (no host staging, no wire bytes).
     local_fastpath: bool = True
@@ -276,13 +280,24 @@ class TPUConnector:
     """Engine-side connector; one per engine process."""
 
     def __init__(self, cfg: KVTransferConfig, runner, allocator: PageAllocator) -> None:
-        if cfg.transfer_dtype not in ("auto", "int8"):
+        if cfg.transfer_dtype not in ("auto", "int8", "adaptive"):
             # A typo'd value would otherwise silently select the exact
             # path and the expected bandwidth halving never materializes.
             raise ValueError(
                 f"kv transfer_dtype {cfg.transfer_dtype!r} not supported "
-                "('auto' or 'int8')"
+                "('auto', 'int8', or 'adaptive')"
             )
+        if cfg.transfer_dtype == "adaptive" and runner.cfg.is_mla:
+            # q8's K|V midpoint scale split is wrong for MLA latent rows
+            # — same reason the explicit 'int8' below refuses. Adaptive
+            # degrades to the exact encoding, LOUDLY (the operator asked
+            # for link-measured convergence they will not get).
+            log.warning(
+                "transfer_dtype='adaptive' downgraded to 'auto' for an "
+                "MLA model: the q8 wire form is unsafe for latent rows, "
+                "so no encoding race will run"
+            )
+            cfg = dataclasses.replace(cfg, transfer_dtype="auto")
         if cfg.transfer_dtype == "int8" and runner.cfg.is_mla:
             # The K|V midpoint half-split is wrong for MLA latent rows
             # ([rank latent | rope] padded to 128 lanes): one shared amax
@@ -341,6 +356,10 @@ class TPUConnector:
         self.import_failures = 0
         self.local_imports = 0  # transfers served by the in-process path
         self.stream_imports = 0  # multi-host pipelined (streamed) imports
+        # Adaptive encoding: EWMA staging throughput per ORIGINAL byte
+        # for each wire form, learned from per-chunk stage timings.
+        self._enc_rate: dict[str, float | None] = {"exact": None, "q8": None}
+        self._adaptive_exports = 0
         # last-transfer stage timings (ms) — the P/D TTFT budget, readable
         # from stats()/bench without instrumentation hooks
         self.last_stage_ms = 0.0   # producer: HBM->host downloads + register
@@ -407,11 +426,19 @@ class TPUConnector:
         n_chunks = -(-len(ids) // cp) if ids else 0
         # Int8 POOLS always ship the q8 wire form: the pool bytes go out
         # directly — lossless wrt the pool, half the staging bytes, no
-        # quantize work. Float pools use it only when opted in.
+        # quantize work. Float pools use it when opted in ("int8") or
+        # when the adaptive picker has measured it faster on this link.
+        use_q8 = (
+            self.cfg.transfer_dtype == "int8"
+            or getattr(self.runner, "kv_quantized", False)
+            or (
+                self.cfg.transfer_dtype == "adaptive"
+                and self._adaptive_pick_q8()
+            )
+        )
         snap_fn = (
             self.runner.snapshot_pages_device_q8
-            if self.cfg.transfer_dtype == "int8"
-            or getattr(self.runner, "kv_quantized", False)
+            if use_q8
             else self.runner.snapshot_pages_device
         )
         snaps = [
@@ -557,18 +584,22 @@ class TPUConnector:
                     header=pack_header(pages),
                 )
                 self.exported_bytes += payload.nbytes
+            staging_itemsize = np.dtype(self.runner.staging_dtype).itemsize
             for j, snap in enumerate(snaps):
                 if key in self._local_claimed:
                     # An in-process consumer took the device path; the
                     # remaining HBM->host downloads would be pure waste.
                     break
-                if isinstance(snap, tuple):  # int8 transfer: (q8, scales)
+                t_chunk = time.monotonic()
+                is_q8 = isinstance(snap, tuple)
+                if is_q8:  # int8 transfer: (q8, scales)
                     q8, scales = (self.runner.download_pages(s) for s in snap)
                     orig = self.runner.staging_dtype_name
                     # Scales ride in the header blob: one owning copy in
                     # the shipper, no concat of the big int8 payload.
                     header = pack_header_q8(q8, orig) + scales.tobytes()
                     payload = q8
+                    orig_bytes = q8.nbytes * staging_itemsize
                 else:
                     pages = self.runner.download_pages(snap)
                     header = pack_header(pages)
@@ -579,8 +610,12 @@ class TPUConnector:
                         pages if pages.dtype.isbuiltin == 1
                         else pages.view(np.uint8)
                     )
+                    orig_bytes = payload.nbytes
                 self.server.register(
                     chunk_key(key, j), payload, self.cfg.lease_ms, header=header
+                )
+                self._observe_encoding(
+                    is_q8, orig_bytes, time.monotonic() - t_chunk
                 )
                 self.exported_bytes += len(header) + payload.nbytes
         except Exception:
@@ -738,19 +773,24 @@ class TPUConnector:
             from llmd_tpu.engine.kv_cache import NoFreePagesError
 
             # Streaming reserves the pages for the WHOLE wire transfer
-            # (seconds on a slow link) — only do it with decode headroom
-            # left over, or the reservation starves the scheduler into
-            # preempting live requests to feed a not-yet-usable import.
-            # Check + allocate are one atomic allocator call: concurrent
-            # fetch threads must not jointly reserve past the floor.
+            # (up to minutes on a slow link) — only do it with decode
+            # headroom left over, or the reservation starves the
+            # scheduler into preempting live requests to feed a
+            # not-yet-usable import. Check + allocate are one atomic
+            # allocator call (concurrent fetch threads must not jointly
+            # reserve past the floor), and a single import may pin at
+            # most a quarter of the pool: larger transfers take the
+            # buffered path, whose allocation lives only for the
+            # microseconds of apply.
             need = n_full - start_page
             headroom = max(self.allocator.num_pages // 8, 16)
-            try:
-                stream_ids = self.allocator.allocate_with_floor(
-                    need, headroom
-                )
-            except NoFreePagesError:
-                stream_ids = None  # buffered fallback under pressure
+            if need <= self.allocator.num_pages // 4:
+                try:
+                    stream_ids = self.allocator.allocate_with_floor(
+                        need, headroom
+                    )
+                except NoFreePagesError:
+                    stream_ids = None  # buffered fallback under pressure
         # Per-CHUNK deadline, reset on progress: a shared whole-bundle
         # budget would let a large multi-chunk transfer over a slow link
         # exhaust itself on later chunks and spuriously fall back to
@@ -873,6 +913,31 @@ class TPUConnector:
             return None
         finally:
             self.last_fetch_ms = (time.monotonic() - t0) * 1e3
+
+    def _adaptive_pick_q8(self) -> bool:
+        """Per-export encoding choice from measured link behavior.
+
+        Cold start alternates the two forms; once both have EWMA rates
+        (original bytes staged per second, so the q8 form's halved
+        payload and its quantize/scales overhead are both priced in),
+        the faster wins, with every 8th export re-probing the loser so
+        a drifting link can flip the decision."""
+        self._adaptive_exports += 1
+        exact, q8 = self._enc_rate["exact"], self._enc_rate["q8"]
+        if exact is None or q8 is None:
+            return self._adaptive_exports % 2 == 0
+        best_q8 = q8 > exact
+        if self._adaptive_exports % 8 == 0:
+            return not best_q8  # re-probe the loser
+        return best_q8
+
+    def _observe_encoding(self, q8: bool, orig_bytes: int, dt_s: float) -> None:
+        if dt_s <= 0 or orig_bytes <= 0:
+            return
+        key = "q8" if q8 else "exact"
+        rate = orig_bytes / dt_s
+        prev = self._enc_rate[key]
+        self._enc_rate[key] = rate if prev is None else 0.7 * prev + 0.3 * rate
 
     def release_bundle(self, bundle: "PulledBundle") -> None:
         """Dispose of a fetched bundle that will never be applied: free
@@ -1159,6 +1224,12 @@ class TPUConnector:
             "import_failures": self.import_failures,
             "local_imports": self.local_imports,
             "stream_imports": self.stream_imports,
+            "enc_rate_exact_mbps": round(
+                (self._enc_rate["exact"] or 0.0) / 2**20, 2
+            ),
+            "enc_rate_q8_mbps": round(
+                (self._enc_rate["q8"] or 0.0) / 2**20, 2
+            ),
             "last_stage_ms": round(self.last_stage_ms, 1),
             "last_fetch_ms": round(self.last_fetch_ms, 1),
             "last_apply_ms": round(self.last_apply_ms, 1),

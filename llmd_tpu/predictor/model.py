@@ -28,11 +28,14 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import math
 import threading
 from typing import Sequence
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 TTFT_DIM = 6
 TPOT_DIM = 4
@@ -91,6 +94,12 @@ class PredictorConfig:
     # fit is used, and below it again the heuristic.
     min_bucket_samples: int = 20
     min_global_samples: int = 50
+    # Fit log(latency): latencies are positive and multiplicative (a
+    # linear fit in ms-space extrapolates NEGATIVE under real traces'
+    # feature ranges, forcing heuristic fallbacks), and the router's
+    # accuracy bar is RELATIVE error (MAPE), which a log-space least
+    # squares optimizes directly.
+    log_space: bool = True
 
 
 class _OnlineRidge:
@@ -157,6 +166,8 @@ class _StratifiedModel:
             return
         if not all(math.isfinite(v) for v in x):
             return
+        if self.cfg.log_space:
+            y = math.log(max(y, 1e-3))
         key = self.bucket_fn(x, self.cfg)
         if key not in self.buckets:
             self.buckets[key] = _OnlineRidge(self.dim, self.cfg.l2, self.cfg.decay)
@@ -173,14 +184,23 @@ class _StratifiedModel:
             raise ValueError(
                 f"expected {self.dim} features, got {len(x)}"
             )
+        def ok(p: float) -> bool:
+            # exp() is always positive, so the old p > 0 guard is
+            # vacuous in log space; cap at an hour — anything above is
+            # a blown-up fit, not a latency.
+            return math.isfinite(p) and 0 < p < 3.6e6
+
+        def out(p: float) -> float:
+            return math.exp(min(p, 30.0)) if self.cfg.log_space else p
+
         bucket = self.buckets.get(self.bucket_fn(x, self.cfg))
         if bucket is not None and bucket.count >= self.cfg.min_bucket_samples:
-            p = bucket.predict(x)
-            if math.isfinite(p) and p > 0:
+            p = out(bucket.predict(x))
+            if ok(p):
                 return p, "bucket"
         if self.global_fit.count >= self.cfg.min_global_samples:
-            p = self.global_fit.predict(x)
-            if math.isfinite(p) and p > 0:
+            p = out(self.global_fit.predict(x))
+            if ok(p):
                 return p, "global"
         return self.heuristic(x), "heuristic"
 
@@ -253,7 +273,12 @@ class LatencyPredictor:
         with self._lock:
             return json.dumps(
                 {
-                    "version": 1,
+                    "version": 2,
+                    # Target space is part of the accumulator semantics:
+                    # a log-space reader exp()-ing ms-space accumulators
+                    # would serve ~e^30 ms predictions that pass every
+                    # finite/positive guard.
+                    "log_space": self.cfg.log_space,
                     "samples_seen": self.samples_seen,
                     "ttft": self.ttft.to_dict(),
                     "tpot": self.tpot.to_dict(),
@@ -262,6 +287,16 @@ class LatencyPredictor:
 
     def loads(self, raw: str) -> None:
         d = json.loads(raw)
+        if bool(d.get("log_space", False)) != self.cfg.log_space:
+            # Version-skewed trainer (shared model volume): starting
+            # cold (heuristic fallback until fresh samples arrive) beats
+            # serving garbage-scale predictions.
+            log.warning(
+                "discarding latency model with mismatched target space "
+                "(file log_space=%s, config log_space=%s)",
+                d.get("log_space", False), self.cfg.log_space,
+            )
+            return
         with self._lock:
             self.ttft.load_dict(d.get("ttft", {}))
             self.tpot.load_dict(d.get("tpot", {}))

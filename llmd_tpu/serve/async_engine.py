@@ -267,6 +267,11 @@ class AsyncEngine:
                 ):
                     self._lock.wait()
                 if self._stop:
+                    # Queued entries die with the loop — their fetched
+                    # bundles (stream-reserved pool pages) must not.
+                    for p in self._inbox:
+                        _release_pulled(self.engine, p.kv_transfer_params)
+                    self._inbox = []
                     return
                 pending, self._inbox = self._inbox, []
                 aborts, self._aborts = self._aborts, []

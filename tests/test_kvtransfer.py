@@ -20,7 +20,7 @@ from llmd_tpu.config import (
 )
 from llmd_tpu.engine import LLMEngine, SamplingParams
 from llmd_tpu.kvtransfer import shipper as shipper_mod
-from llmd_tpu.kvtransfer.connector import pack_pages, unpack_pages
+from llmd_tpu.kvtransfer.connector import TPUConnector, pack_pages, unpack_pages
 from llmd_tpu.kvtransfer.shipper import PullError, ShipperServer
 
 
@@ -570,3 +570,77 @@ def test_pd_int8_transfer_rejects_mla():
             kv_transfer_port=0,
             kv_transfer_dtype="int8",
         ))
+
+
+def test_adaptive_encoding_decision_logic():
+    """transfer_dtype='adaptive': the picker alternates while cold,
+    converges to the measured-faster encoding, and re-probes the loser
+    periodically so a drifting link can flip the choice."""
+    conn = TPUConnector.__new__(TPUConnector)
+    conn._enc_rate = {"exact": None, "q8": None}
+    conn._adaptive_exports = 0
+
+    # Cold: alternates so both forms get measured.
+    picks = [conn._adaptive_pick_q8() for _ in range(4)]
+    assert True in picks and False in picks
+
+    # Link where the exact form stages faster per ORIGINAL byte
+    # (q8's quantize overhead dominates the byte saving).
+    conn._observe_encoding(False, 100 << 20, 1.0)  # exact: 100 MB/s
+    conn._observe_encoding(True, 100 << 20, 2.0)   # q8:     50 MB/s
+    conn._adaptive_exports = 0
+    picks = [conn._adaptive_pick_q8() for _ in range(7)]
+    assert picks.count(False) == 7  # exact wins every non-probe turn
+    assert conn._adaptive_pick_q8() is True  # 8th = re-probe the loser
+
+    # Slow link: halved bytes dominate -> q8 flips to winner. EWMA must
+    # actually move on repeated observations.
+    for _ in range(12):
+        conn._observe_encoding(False, 10 << 20, 4.0)  # exact: 2.5 MB/s
+        conn._observe_encoding(True, 10 << 20, 1.0)   # q8:   10 MB/s
+    conn._adaptive_exports = 0
+    assert all(conn._adaptive_pick_q8() for _ in range(7))
+
+
+def test_pd_adaptive_transfer_end_to_end():
+    """transfer_dtype='adaptive' serves transfers correctly from the
+    first (cold, alternating) exports on, and learns per-encoding
+    staging rates as it goes."""
+    from llmd_tpu.config import EngineConfig
+
+    prompt = list(range(1, 45))
+
+    def mk(role, dtype_):
+        cfg = EngineConfig(
+            model=tiny_model_config(dtype="float32"),
+            cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+            kv_role=role,
+            kv_transfer_port=0,
+            kv_transfer_dtype=dtype_,
+            kv_local_fastpath=False,
+        )
+        return LLMEngine(cfg)
+
+    producer = mk("kv_producer", "adaptive")
+    consumer = mk("kv_consumer", "auto")
+    try:
+        for i in range(3):  # both encodings get exercised while cold
+            p = [t + i for t in prompt]
+            _, pre = _run(
+                producer, p, max_tokens=1,
+                kv_transfer_params={"do_remote_decode": True},
+            )
+            toks, final = _run(
+                consumer, p, max_tokens=4,
+                kv_transfer_params=pre.kv_transfer_params,
+            )
+            assert len(toks) == 4
+        assert consumer.kv_connector.imported_requests == 3
+        assert consumer.kv_connector.import_failures == 0
+        st = producer.kv_connector.stats()
+        assert st["enc_rate_exact_mbps"] > 0
+        assert st["enc_rate_q8_mbps"] > 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
