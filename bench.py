@@ -850,7 +850,11 @@ def _bench_dbo_delta():
         # on > off here is EXPECTED, not a defect — the canonical
         # explanation lives on ParallelConfig.enable_dbo (config.py);
         # exactness is gated in tests/test_wide_ep.py.
-        "note": "dbo needs async ICI collectives; CPU mesh cannot overlap",
+        "note": (
+            "profiled (docs/architecture/dbo.md): the split multiplies "
+            "a2a ops ~3.8x on the CPU mesh with nothing to hide behind; "
+            "flag is experimental, default off, gated on a real-slice win"
+        ),
     }
 
 

@@ -227,14 +227,22 @@ class CacheConfig:
     # sequence holds a fixed ring of pages reused circularly, instead of
     # full-length pages on every layer. For gpt-oss-class models (half the
     # layers slide at window 128) this halves KV bytes per long sequence.
-    # Trade-off: automatic prefix caching is disabled while the ring is on
-    # (a cache hit would skip recomputing the sliding layers' in-window KV,
-    # which the transient per-sequence rings do not retain) — the capacity
-    # win is the point of the flag. P/D KV transfer composes (ring
+    # Prefix caching becomes HYBRID while the ring is on: full-attention
+    # pages stay hashed/reusable, and a hit is taken only when a retained
+    # sliding-window section (swa_section_cache below) can seed the fresh
+    # ring — a bare full-pool hit would skip sliding-layer KV the
+    # transient rings don't hold. P/D KV transfer composes (ring
     # producers export a sliding-layer section; ring consumers import via
     # the request-preload path); tiered offload does not (host-cached
     # pages would lack sliding-layer KV) and is refused loudly.
     swa_ring: bool = False
+    # Hybrid prefix caching under the ring (the reference's hybrid KV
+    # cache manager semantics, pd gpu patch-decode.yaml:19): retain up to
+    # this many per-prefix sliding-window SECTIONS (each ~window/page + 1
+    # SWA-pool pages, captured at prefill completion) so a repeated
+    # prefix seeds a fresh ring from the retained section and skips the
+    # full prefill. 0 disables retention (ring hits then never shortcut).
+    swa_section_cache: int = 8
     # Ring-pool page count; 0 = auto (max_num_seqs x ring_pages: one ring
     # per possible running sequence; P/D preloads allocate extra rings at
     # arrival and the scheduler reclaims waiting preloads' rings if the
@@ -311,6 +319,12 @@ class SwaRingSpec:
         wmax = max(self.windows[i] for i in self.swa_layers)
         s0 = max(0, (n_pre * page_size - wmax) // page_size)
         return n_pre, s0, n_pre - s0
+
+    def max_section_pages(self, page_size: int) -> int:
+        """Upper bound of a section's page count (retention budgeting):
+        the window span plus one page of offset straddle."""
+        wmax = max(self.windows[i] for i in self.swa_layers)
+        return -(-wmax // page_size) + 1
 
 
 # Per-seq prefill chunk cap that bounds the ring size independent of the

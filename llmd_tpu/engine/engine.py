@@ -31,6 +31,120 @@ from llmd_tpu.engine.scheduler import EngineScheduler, ScheduledBatch
 from llmd_tpu.parallel.mesh import MeshContext, build_mesh
 
 
+class SwaSectionCache:
+    """Retained sliding-window sections for HYBRID prefix caching under
+    the SWA ring (the reference's hybrid KV-cache manager role, pd gpu
+    patch-decode.yaml:19).
+
+    Ring pages are transient per sequence, so a bare full-pool prefix
+    hit would skip sliding-layer KV that no longer exists. This cache
+    keeps, per recently-prefilled prefix, a COPY of the ring's
+    in-window section (the same [s0, n_pre) geometry the P/D transfer
+    ships — SwaRingSpec.section) in ref-held SWA-pool pages. On a
+    repeated prefix, a fresh ring is seeded from the section on device
+    and the request starts at num_computed = n_pre * page: exactly the
+    P/D preload path, sourced locally. LRU-capped; entries own their
+    pages and free them on eviction."""
+
+    def __init__(
+        self, swa_allocator, runner, capacity: int, page_budget: int
+    ) -> None:
+        import collections
+
+        self._alloc = swa_allocator
+        self._runner = runner
+        self.capacity = capacity
+        # Retention pages are PROVISIONED on top of the ring pool
+        # (engine sizing); this budget keeps retention from ever eating
+        # ring capacity even transiently.
+        self.page_budget = page_budget
+        self.retained_pages = 0
+        # key -> (s0, n_pre, [section page ids])
+        self._entries: "collections.OrderedDict[bytes, tuple]" = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.captures = 0
+
+    def capture(self, key: bytes, ring_ids: list[int], s0: int, n_pre: int) -> None:
+        """Copy ring slots [s0, n_pre) into retained pages (device op,
+        no host bytes). No-op if the key is already retained or the SWA
+        pool lacks headroom (a ring allocation must never fail because
+        retention hoarded pages)."""
+        from llmd_tpu.engine.kv_cache import NoFreePagesError
+
+        if self.capacity <= 0 or key in self._entries or n_pre <= s0:
+            return
+        cnt = n_pre - s0
+        R = len(ring_ids)
+        # Entry-count LRU + page budget, evicted BEFORE allocating so
+        # the budget invariant holds at the allocate call.
+        while self._entries and (
+            len(self._entries) >= self.capacity
+            or self.retained_pages + cnt > self.page_budget
+        ):
+            self.evict_one()
+        if self.retained_pages + cnt > self.page_budget:
+            return  # a single oversized section cannot fit the budget
+        try:
+            dst = self._alloc.allocate(cnt)
+        except NoFreePagesError:
+            # Pool transiently drained past the provisioned budget
+            # (preload bursts hold extra rings): skip this capture.
+            return
+        self.retained_pages += cnt
+        src = [ring_ids[l % R] for l in range(s0, n_pre)]
+        self._runner.copy_pages_on_device(src, dst, swa=True)
+        self._entries[key] = (s0, n_pre, dst)
+        self.captures += 1
+
+    def evict_one(self) -> bool:
+        """Free the LRU retained section (ring-pressure relief: a live
+        sequence's ring allocation outranks idle retention). Returns
+        True if an entry was freed."""
+        if not self._entries:
+            return False
+        _, (_, _, ids) = self._entries.popitem(last=False)
+        self._alloc.free(ids)
+        self.retained_pages -= len(ids)
+        return True
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def candidate_lengths(self, n_pre_max: int) -> list[int]:
+        """Retained entry lengths usable for a prompt whose own
+        preloadable span is ``n_pre_max`` pages, longest first: a
+        section captured at k <= n_pre_max pages holds the window before
+        continuation k*page, so an EXTENDED prompt sharing that prefix
+        can still skip its first k pages (the multi-turn grow case)."""
+        return sorted(
+            {e[1] for e in self._entries.values() if e[1] <= n_pre_max},
+            reverse=True,
+        )
+
+    def seed(self, key: bytes, ring_ids: list[int]) -> tuple[int, int] | None:
+        """Seed a freshly allocated ring from the retained section.
+        Returns (s0, n_pre) on success; None on miss."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        self._entries.move_to_end(key)
+        s0, n_pre, ids = entry
+        R = len(ring_ids)
+        dst = [ring_ids[(s0 + i) % R] for i in range(n_pre - s0)]
+        self._runner.copy_pages_on_device(ids, dst, swa=True)
+        self.hits += 1
+        return s0, n_pre
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "captures": self.captures,
+        }
+
+
 @dataclass
 class EngineStats:
     """The EPP metrics contract (model-servers.md:38-52)."""
@@ -46,6 +160,10 @@ class EngineStats:
     # utilization-based routing, not just the main pool.
     swa_ring_usage: float = 0.0
     swa_ring_pages: int = 0
+    # Hybrid-APC section retention (SwaSectionCache)
+    swa_sections: int = 0
+    swa_section_hits: int = 0
+    swa_section_captures: int = 0
     # counters
     prompt_tokens: int = 0
     generation_tokens: int = 0
@@ -89,12 +207,13 @@ class LLMEngine:
         follower = jax.process_count() > 1 and jax.process_index() != 0
         self.ctx = mesh_ctx or build_mesh(config.parallel)
         # SWA ring (CacheConfig.swa_ring): sliding-window layers move to a
-        # fixed per-sequence page ring in their own pool. The ring content
-        # is transient per sequence, so features that assume full-table
-        # pages hold every layer's KV cannot compose with it (yet):
-        # automatic prefix caching is disabled (a hit would skip the
-        # sliding layers' in-window KV the rings don't retain), and P/D
-        # transfer / tiered offload are refused loudly below.
+        # fixed per-sequence page ring in their own pool. Ring content is
+        # transient per sequence; prefix caching stays ON for the main
+        # (full-attention) pool and becomes HYBRID: hits are taken only
+        # when a retained sliding section can seed the fresh ring
+        # (SwaSectionCache — the reference's hybrid KV-cache manager
+        # role). Tiered offload still refuses (host-cached pages would
+        # lack sliding-layer KV).
         self._swa = swa_ring_spec(config.model, config.cache, config.scheduler)
         if self._swa is not None:
             if not config.scheduler.enable_chunked_prefill:
@@ -109,11 +228,24 @@ class LLMEngine:
                     "host-cached pages would lack the sliding layers' KV "
                     "— disable one of the two"
                 )
+        # HYBRID prefix caching under the ring: the main pool (full-
+        # attention layers) stays hashed/reusable; a hit is USABLE only
+        # when the retained sliding section (SwaSectionCache) can seed
+        # the fresh ring, so the scheduler's bare shortcut is disabled
+        # (scheduler._apply_prefix_cache) and hits happen at admission.
+        # With section retention off, hits are structurally impossible —
+        # downgrade APC entirely so the engine doesn't hash and
+        # advertise blocks (BlockStored events) a router would route to
+        # in vain.
         prefix_caching = config.cache.enable_prefix_caching
-        if self._swa is not None and prefix_caching:
+        if (
+            self._swa is not None
+            and prefix_caching
+            and config.cache.swa_section_cache <= 0
+        ):
             logging.getLogger(__name__).info(
-                "kv_swa_ring: disabling automatic prefix caching (ring "
-                "pages do not retain reusable sliding-layer KV)"
+                "kv_swa_ring with swa_section_cache=0: disabling prefix "
+                "caching (no retained sliding sections -> no usable hits)"
             )
             prefix_caching = False
         # Tiered offload wraps the event sink (device evictions of host-held
@@ -145,9 +277,23 @@ class LLMEngine:
             enable_prefix_caching=prefix_caching,
             event_sink=event_sink,
         )
+        # Hybrid-APC retention rides a PROVISIONED budget on top of the
+        # ring pool (the auto-sized pool is exactly max_num_seqs rings —
+        # retention must never eat ring capacity).
+        self._swa_retention_budget = 0
+        if (
+            self._swa is not None
+            and prefix_caching
+            and config.cache.swa_section_cache > 0
+        ):
+            self._swa_retention_budget = (
+                config.cache.swa_section_cache
+                * self._swa.max_section_pages(config.cache.page_size)
+            )
         self.swa_allocator = (
             PageAllocator(
-                num_pages=self._swa.num_swa_blocks,
+                num_pages=self._swa.num_swa_blocks
+                + self._swa_retention_budget,
                 page_size=config.cache.page_size,
                 enable_prefix_caching=False,
             )
@@ -164,6 +310,20 @@ class LLMEngine:
         self.runner = ModelRunner(
             config, self.ctx, params=params, swa_spec=self._swa
         )
+        # Hybrid-APC section retention (ring engines with APC on).
+        self._swa_sections = None
+        if (
+            self._swa is not None
+            and prefix_caching
+            and config.cache.swa_section_cache > 0
+        ):
+            self._swa_sections = SwaSectionCache(
+                self.swa_allocator, self.runner,
+                config.cache.swa_section_cache,
+                self._swa_retention_budget,
+            )
+            self.scheduler.prefill_complete_hook = self._capture_swa_section
+            self.scheduler.ring_pressure_hook = self._swa_sections.evict_one
         self.stats = EngineStats(
             num_pages=config.cache.num_blocks, page_size=config.cache.page_size
         )
@@ -201,6 +361,41 @@ class LLMEngine:
     def _on_finish(self, req) -> None:
         if self.kv_connector is not None and self.kv_connector.wants_export(req):
             req.export_params = self.kv_connector.export_finished(req)
+
+    def _section_key(self, prompt_token_ids: list[int], extra: bytes):
+        """(chain-hash key, n_pre, s0) of a prompt's retained section —
+        identical derivation on capture and seed, folding the same extra
+        (LoRA/multimodal) the full-pool page hashes fold."""
+        from llmd_tpu.engine.kv_cache import page_hashes_for_tokens
+
+        page = self.config.cache.page_size
+        n_pre, s0, _cnt = self._swa.section(len(prompt_token_ids), page)
+        if n_pre <= s0:
+            return None, 0, 0
+        hashes = page_hashes_for_tokens(
+            list(prompt_token_ids[: n_pre * page]), page, extra=extra
+        )
+        if len(hashes) < n_pre:
+            return None, 0, 0
+        return hashes[n_pre - 1], n_pre, s0
+
+    def _capture_swa_section(self, req) -> None:
+        """Scheduler hook at prompt completion: the ring still holds the
+        prompt's trailing window — retain a copy for later hybrid hits.
+        (At FINISH time the ring has advanced past the prompt, which is
+        why capture happens here, mirroring the P/D export's staleness
+        rule.)"""
+        try:
+            key, n_pre, s0 = self._section_key(
+                req.prompt_token_ids, self.scheduler.hash_extra(req)
+            )
+            if key is None or not req.swa_block_ids:
+                return
+            self._swa_sections.capture(key, req.swa_block_ids, s0, n_pre)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "swa section capture failed (serving unaffected)"
+            )
 
     # ------------------------------------------------------------------ #
 
@@ -287,8 +482,80 @@ class LLMEngine:
             req.swa_block_ids = list(preload["swa_block_ids"])
             req.num_computed_tokens = preload["tokens"]
             req.num_cached_tokens = preload["tokens"]
+        elif self._swa_sections is not None:
+            self._try_hybrid_ring_hit(req)
         self.scheduler.add_request(req)
         return rid
+
+    def _try_hybrid_ring_hit(self, req) -> None:
+        """Hybrid prefix hit under the ring: usable only when BOTH a
+        full-pool prefix run AND a retained sliding section exist for
+        the SAME span — then a fresh ring is seeded from the section
+        (device copy) and the request starts past that span, like a
+        locally-sourced P/D preload. Sections retained at SHORTER spans
+        serve extended prompts too (the multi-turn grow case): the
+        longest retained span covered by this prompt wins. Any miss,
+        allocation failure, or device error degrades to a normal full
+        prefill (resources released)."""
+        from llmd_tpu.engine.kv_cache import (
+            NoFreePagesError, page_hashes_for_tokens,
+        )
+
+        page = self.config.cache.page_size
+        n_pre, _s0, _cnt = self._swa.section(len(req.prompt_token_ids), page)
+        if n_pre <= 0:
+            return
+        # Candidate lengths need only n_pre — unique-prompt traffic (no
+        # usable retained span) exits before paying the hash walk.
+        lengths = self._swa_sections.candidate_lengths(n_pre)
+        if not lengths:
+            return
+        extra = self.scheduler.hash_extra(req)
+        # ONE hash walk serves both the section probes and the full-pool
+        # lookup (the prompt is hashed nowhere else on this path).
+        hashes = page_hashes_for_tokens(
+            list(req.prompt_token_ids[: n_pre * page]), page, extra=extra
+        )
+        for k in lengths:
+            key = hashes[k - 1]
+            if not self._swa_sections.has(key):
+                continue
+            # Probe without touching: failed candidates must not inflate
+            # hit metrics or refresh LRU recency of pages left unused.
+            if self.allocator.peek_hash_run(hashes[:k]) < k:
+                continue
+            cached = self.allocator.lookup_and_touch_hashes(hashes[:k])
+            if len(cached) < k:
+                # Raced an eviction between peek and touch.
+                if cached:
+                    self.allocator.free(cached)
+                continue
+            ring_ids: list[int] = []
+            try:
+                ring_ids = self.swa_allocator.allocate(self._swa.ring_pages)
+                if self._swa_sections.seed(key, ring_ids) is None:
+                    raise KeyError("section evicted between has() and seed()")
+            except Exception as e:
+                # Includes device/lockstep errors from the seed copy: a
+                # hit must never fail the request — release and prefill.
+                self.allocator.free(cached)
+                if ring_ids:
+                    self.swa_allocator.free(ring_ids)
+                if not isinstance(e, (NoFreePagesError, KeyError)):
+                    logging.getLogger(__name__).exception(
+                        "hybrid ring seed failed; recomputing locally"
+                    )
+                return
+            req.block_ids = cached
+            req.swa_block_ids = ring_ids
+            req.num_computed_tokens = k * page
+            req.num_cached_tokens = k * page
+            # Seed the commit chain past the hit (key IS hashes[k-1]) so
+            # finish does not re-hash and re-commit the cached prefix —
+            # duplicate BlockStored events would reach the router's
+            # indexer.
+            self.scheduler.seed_commit_chain(req, key, k)
+            return
 
     def abort_request(self, request_id: str) -> bool:
         return self.scheduler.abort_request(request_id) is not None
@@ -448,6 +715,11 @@ class LLMEngine:
         if self.swa_allocator is not None:
             self.stats.swa_ring_usage = self.swa_allocator.usage()
             self.stats.swa_ring_pages = self.swa_allocator.num_pages
+            if self._swa_sections is not None:
+                s = self._swa_sections.stats()
+                self.stats.swa_sections = s["entries"]
+                self.stats.swa_section_hits = s["hits"]
+                self.stats.swa_section_captures = s["captures"]
         self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
         self.stats.preemptions = self.scheduler.num_preemptions
         if self.config.model.num_lora_adapters:

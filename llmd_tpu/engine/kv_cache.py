@@ -154,6 +154,39 @@ class PageAllocator:
         return pages
 
     @_locked
+    def peek_hash_run(self, hashes) -> int:
+        """Length of the leading cached run for a pre-computed hash
+        chain — NO touch, NO metrics. Probe-only (hybrid-hit candidate
+        scans must not inflate prefix_cache_hit_rate or refresh LRU
+        recency of pages they end up not using)."""
+        n = 0
+        for h in hashes:
+            if h not in self._cached:
+                break
+            n += 1
+        return n
+
+    @_locked
+    def lookup_and_touch_hashes(self, hashes) -> list[int]:
+        """lookup_and_touch_prefix for a PRE-COMPUTED hash chain: the
+        leading run of cached pages for exactly these hashes, touched
+        atomically. Lets callers that already hold the chain (hybrid
+        SWA-ring hits) avoid re-hashing the prompt."""
+        if not self.enable_prefix_caching:
+            return []
+        pages: list[int] = []
+        for h in hashes:
+            self.metrics_queries += 1
+            pid = self._cached.get(h)
+            if pid is None:
+                break
+            self.metrics_hits += 1
+            pages.append(pid)
+        if pages:
+            self.touch(pages)
+        return pages
+
+    @_locked
     def allocate_with_floor(self, n: int, floor: int) -> list[int]:
         """Allocate only if at least ``floor`` free pages REMAIN after —
         atomically, so concurrent reservers (streamed-import fetch

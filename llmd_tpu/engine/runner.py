@@ -59,6 +59,7 @@ from llmd_tpu.parallel.mesh import MeshContext, kv_cache_spec, shard_params
 _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
 _OP_KV_GATHER, _OP_KV_SCATTER = 3, 4
 _OP_EMBED, _OP_LORA = 5, 6
+_OP_KV_COPY = 7
 
 log = logging.getLogger(__name__)
 
@@ -203,10 +204,12 @@ class ModelRunner:
             # Never a silent regression: see ParallelConfig.enable_dbo
             # for the full substrate condition.
             log.warning(
-                "enable_dbo is ON without a TPU backend: dual-batch "
-                "overlap needs asynchronous ICI collectives to hide the "
-                "EP all-to-all; on a CPU mesh it SLOWS steps (bench.py "
-                "dbo extras; ParallelConfig.enable_dbo)"
+                "enable_dbo is ON without a TPU backend: profiled on the "
+                "CPU mesh, the half-batch split MULTIPLIES all-to-all ops "
+                "~3.8x (2.4x collective device-time) with nothing to hide "
+                "them behind — steps run ~1.9x slower. EXPERIMENTAL: "
+                "enable only on a real multi-chip slice and trust the "
+                "bench delta (docs/architecture/dbo.md)"
             )
         sched = config.scheduler
         self.batch_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
@@ -654,6 +657,49 @@ class ModelRunner:
         kv = self._pool(swa)
         return kv[0] if isinstance(kv, tuple) else kv
 
+    @functools.cached_property
+    def _copy_pool_pages(self):
+        """Device-to-device page copy within one pool (hybrid-APC
+        sliding-section capture/seed; no host bytes move)."""
+
+        def copy(kv, src, dst):
+            if isinstance(kv, tuple):
+                return (
+                    kv[0].at[:, dst].set(kv[0][:, src]),
+                    kv[1].at[:, dst].set(kv[1][:, src]),
+                )
+            return kv.at[:, dst].set(kv[:, src])
+
+        return jax.jit(copy, donate_argnums=(0,))
+
+    def copy_pages_on_device(
+        self, src_ids: list[int], dst_ids: list[int], swa: bool = False
+    ) -> None:
+        """Copy pool pages src -> dst on device (lockstep in multi-host:
+        a plain SPMD program every process mirrors)."""
+        arrays = {
+            "src": np.asarray(src_ids, np.int32),
+            "dst": np.asarray(dst_ids, np.int32),
+        }
+        if self._multihost:
+            with self._dispatch_lock:
+                arrays = self._sync(
+                    _OP_KV_COPY, len(src_ids), int(swa), False, arrays
+                )
+                self._exec_kv_copy(arrays, swa)
+            return
+        self._exec_kv_copy(arrays, swa)
+
+    def _exec_kv_copy(self, arrays: dict, swa: bool) -> None:
+        out = self._copy_pool_pages(
+            self._pool(swa), jnp.asarray(arrays["src"]),
+            jnp.asarray(arrays["dst"]),
+        )
+        if swa:
+            self.kv_swa = out
+        else:
+            self.kv_cache = out
+
     def _exec_kv_gather(self, arrays: dict, q8: bool, swa: bool = False):
         fn = self._replicated_gather_q8 if q8 else self._replicated_gather
         return fn(self._pool(swa), jnp.asarray(arrays["ids"]))
@@ -777,6 +823,8 @@ class ModelRunner:
         config both sides share."""
         if op == _OP_KV_GATHER:
             return [("ids", (B,), np.int32)]
+        if op == _OP_KV_COPY:
+            return [("src", (B,), np.int32), ("dst", (B,), np.int32)]
         if op == _OP_EMBED:
             return [
                 ("tokens", (B, QK), np.int32),
@@ -888,6 +936,8 @@ class ModelRunner:
                 self._exec_kv_gather(arrays, bool(QK), bool(greedy))
             elif op == _OP_KV_SCATTER:
                 self._exec_kv_scatter(arrays, B, bool(QK))
+            elif op == _OP_KV_COPY:
+                self._exec_kv_copy(arrays, bool(QK))
             elif op == _OP_EMBED:
                 # greedy slot carries the lora id; the replicated pooled
                 # output is only read on the leader.

@@ -91,6 +91,14 @@ class EngineScheduler:
         # Called with the finished Request before its pages are released
         # (P/D producer KV export point).
         self.finish_hook = None
+        # Ring engines: called once when a request's prompt completes
+        # (the ring still holds the prompt's trailing window) — the
+        # hybrid-APC section capture point.
+        self.prefill_complete_hook = None
+        # Called when a ring allocation fails: frees idle retained
+        # sections (hybrid APC) so live sequences outrank retention.
+        # Returns True if anything was freed (retry the allocation).
+        self.ring_pressure_hook = None
 
     # ------------------------------------------------------------------ #
     # queue management
@@ -248,6 +256,13 @@ class EngineScheduler:
         """Reuse cached full pages covering the prompt prefix."""
         if req.block_ids:
             return
+        if self.swa_ring_pages:
+            # Ring engines do HYBRID hits at engine admission only: a
+            # full-pool hit is usable solely when a retained sliding
+            # section seeds the fresh ring (engine SwaSectionCache) —
+            # a bare full-pool shortcut here would skip sliding-layer
+            # KV the ring never got and silently decode garbage.
+            return
         # Never satisfy the *entire* prompt from cache: the last token must be
         # computed so the step emits logits for sampling. Lookup + touch
         # are one atomic allocator call: a concurrent allocate() (the
@@ -285,11 +300,17 @@ class EngineScheduler:
         """
         if self.swa_allocator is None or req.swa_block_ids:
             return True
-        try:
-            req.swa_block_ids = self.swa_allocator.allocate(self.swa_ring_pages)
-            return True
-        except NoFreePagesError:
-            return False
+        while True:
+            try:
+                req.swa_block_ids = self.swa_allocator.allocate(
+                    self.swa_ring_pages
+                )
+                return True
+            except NoFreePagesError:
+                # Idle retained sections (hybrid APC) yield to live
+                # sequences before admission gives up for this step.
+                if self.ring_pressure_hook is None or not self.ring_pressure_hook():
+                    return False
 
     def _reclaim_waiting_ring(self, req: Request) -> bool:
         """Downgrade the youngest preloaded WAITING request: free its ring
@@ -377,6 +398,10 @@ class EngineScheduler:
             req = seq.request
             req.num_computed_tokens += seq.num_tokens
             if req.in_decode:  # this chunk completed the prompt -> 1st token
+                if self.prefill_complete_hook is not None:
+                    # Hybrid-APC capture: the ring still holds the
+                    # prompt's trailing window right now.
+                    self.prefill_complete_hook(req)
                 token = sampled[req.request_id][0]
                 req.output_token_ids.append(token)
                 accepted[req.request_id] = [token]
@@ -424,6 +449,18 @@ class EngineScheduler:
         if req.num_tokens >= self.max_model_len:
             return FinishReason.LENGTH
         return None
+
+    def seed_commit_chain(self, req: Request, parent: bytes, committed: int) -> None:
+        """Mark the request's first ``committed`` pages as already in the
+        prefix index with ``parent`` as the chain head — the one
+        sanctioned way for admission-side hit paths (hybrid SWA-ring) to
+        keep _commit_full_pages from re-hashing and re-committing a
+        cached prefix."""
+        self._chain[req.request_id] = (parent, committed)
+
+    def hash_extra(self, req: Request) -> bytes:
+        """Public cache-identity discriminator (see _hash_extra)."""
+        return self._hash_extra(req)
 
     def _commit_full_pages(self, req: Request) -> None:
         """Register newly-completed full pages in the prefix index."""

@@ -433,6 +433,12 @@ class TPUConnector:
             or getattr(self.runner, "kv_quantized", False)
             or (
                 self.cfg.transfer_dtype == "adaptive"
+                # With an in-process consumer the export will be CLAIMED
+                # before staging: no wire bytes exist to save, the rate
+                # estimators never observe anything, and a q8 snapshot
+                # would be a pure accuracy loss on the device fast path —
+                # adaptive means exact here.
+                and not (self._local_enabled and _LOCAL_CONSUMERS)
                 and self._adaptive_pick_q8()
             )
         )

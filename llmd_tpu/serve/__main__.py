@@ -169,8 +169,10 @@ def build_parser() -> argparse.ArgumentParser:
         "reference's hybrid KV cache manager role, pd patch-decode.yaml "
         "--no-disable-hybrid-kv-cache-manager): sliding layers hold a "
         "fixed per-sequence page ring instead of full-length pages — "
-        "~2x KV capacity on gpt-oss-class models; disables automatic "
-        "prefix caching while on",
+        "~2x KV capacity on gpt-oss-class models. Prefix caching "
+        "becomes HYBRID: full-attention pages stay reusable, and a "
+        "repeated prefix hits when its retained sliding-window section "
+        "(CacheConfig.swa_section_cache) can seed the fresh ring",
     )
     p.add_argument("--max-num-seqs", type=int, default=64)
     p.add_argument("--max-num-batched-tokens", type=int, default=2048)

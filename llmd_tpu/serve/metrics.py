@@ -35,6 +35,8 @@ def render_metrics(
         # Raw main-pool usage stays observable when the ring is busier
         # (gpu_cache_usage_perc above collapses to the max of the two).
         gauges["kv_main_usage_perc"] = round(stats.kv_usage, 6)
+        # Hybrid-APC section retention
+        gauges["swa_sections"] = stats.swa_sections
     gauges["kv_offload_cpu_pages"] = stats.offload_pages
     gauges["kv_offload_fs_pages"] = stats.offload_fs_pages
     counters = {
@@ -51,6 +53,10 @@ def render_metrics(
         "kv_transfer_imported_bytes_total": stats.kv_imported_bytes,
         "kv_transfer_import_failures_total": stats.kv_import_failures,
     }
+    if stats.swa_ring_pages:
+        # Hybrid-APC section retention activity
+        counters["swa_section_hits_total"] = stats.swa_section_hits
+        counters["swa_section_captures_total"] = stats.swa_section_captures
     lines: list[str] = []
     if stats.max_lora:
         # reference model-servers.md:78-89: adapter state rides labels on

@@ -244,6 +244,12 @@ async def test_pd_local_fastpath_int8_wire_to_float_pool():
     ref = make_engine(None)
     try:
         prompt = list(range(1, 15))
+        sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+        # Reference (and its compile) runs BEFORE the export: pending
+        # local exports are retained only ~5s, and a cache-cold compile
+        # here under full-suite load can exceed that, flaking the claim
+        # into the wire path.
+        ref_out = list(ref.generate([prompt], sp).values())[0]
         prod.add_request(
             prompt,
             SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
@@ -254,8 +260,6 @@ async def test_pd_local_fastpath_int8_wire_to_float_pool():
             for o in prod.step():
                 if o.kv_transfer_params:
                     params = o.kv_transfer_params
-        sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
-        ref_out = list(ref.generate([prompt], sp).values())[0]
         cons.add_request(prompt, sp, kv_transfer_params=params)
         toks = []
         while cons.has_work():

@@ -235,7 +235,7 @@ async def test_dp_supervisor_spawns_and_restarts():
 
         async with aiohttp.ClientSession() as s:
             ok = False
-            for _ in range(50):
+            for _ in range(150):  # generous: 1-core host under full-suite load
                 await asyncio.sleep(0.2)
                 try:
                     async with s.get("http://127.0.0.1:9408/health") as r:
@@ -250,7 +250,7 @@ async def test_dp_supervisor_spawns_and_restarts():
             # Kill rank 0; the monitor must respawn it.
             sup.ranks[0].proc.terminate()
             recovered = False
-            for _ in range(50):
+            for _ in range(150):
                 await asyncio.sleep(0.2)
                 try:
                     async with s.get("http://127.0.0.1:9408/health") as r:
