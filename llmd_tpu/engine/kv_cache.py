@@ -134,6 +134,20 @@ class PageAllocator:
     def usage(self) -> float:
         return 1.0 - len(self._free) / self.num_pages
 
+    def _cached_run(self, hashes) -> list[int]:
+        """Leading cached run for a hash chain, with hit accounting —
+        the ONE walk every lookup variant delegates to (caller holds
+        the lock)."""
+        pages: list[int] = []
+        for h in hashes:
+            self.metrics_queries += 1
+            pid = self._cached.get(h)
+            if pid is None:
+                break
+            self.metrics_hits += 1
+            pages.append(pid)
+        return pages
+
     @_locked
     def lookup_cached_prefix(self, token_ids: Sequence[int], extra: bytes = b"") -> list[int]:
         """Longest run of consecutive cached full pages for this prompt.
@@ -143,15 +157,9 @@ class PageAllocator:
         """
         if not self.enable_prefix_caching:
             return []
-        pages: list[int] = []
-        for h in page_hashes_for_tokens(token_ids, self.page_size, extra):
-            self.metrics_queries += 1
-            pid = self._cached.get(h)
-            if pid is None:
-                break
-            self.metrics_hits += 1
-            pages.append(pid)
-        return pages
+        return self._cached_run(
+            page_hashes_for_tokens(token_ids, self.page_size, extra)
+        )
 
     @_locked
     def peek_hash_run(self, hashes) -> int:
@@ -174,14 +182,7 @@ class PageAllocator:
         SWA-ring hits) avoid re-hashing the prompt."""
         if not self.enable_prefix_caching:
             return []
-        pages: list[int] = []
-        for h in hashes:
-            self.metrics_queries += 1
-            pid = self._cached.get(h)
-            if pid is None:
-                break
-            self.metrics_hits += 1
-            pages.append(pid)
+        pages = self._cached_run(hashes)
         if pages:
             self.touch(pages)
         return pages
@@ -211,12 +212,10 @@ class PageAllocator:
         fetch thread) before touch() claims it — touch would then
         ref-bump a page whose content is being overwritten, silently
         attending over another request's KV."""
-        pages = self.lookup_cached_prefix(token_ids, extra=extra)
+        hashes = page_hashes_for_tokens(token_ids, self.page_size, extra)
         if max_pages is not None:
-            pages = pages[:max_pages]
-        if pages:
-            self.touch(pages)
-        return pages
+            hashes = hashes[:max_pages]
+        return self.lookup_and_touch_hashes(hashes)
 
     @_locked
     def has_cached(self, content_hash: bytes) -> bool:

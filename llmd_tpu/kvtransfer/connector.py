@@ -428,17 +428,26 @@ class TPUConnector:
         # directly — lossless wrt the pool, half the staging bytes, no
         # quantize work. Float pools use it when opted in ("int8") or
         # when the adaptive picker has measured it faster on this link.
+        # Adaptive single-host exports snapshot EXACT and decide the wire
+        # encoding on the STAGING thread per chunk (on-device quantize of
+        # the snapshot): a local claim then hands lossless device
+        # snapshots to the fast path, while remote pulls keep the full
+        # encoding race — per-request consumer locality is unknowable at
+        # export time, so the decision is deferred to the leg where it
+        # matters. Multi-host has no local fast path and no process-local
+        # staging-thread dispatch, so it picks at export via the
+        # lockstep q8 gather as before.
+        adaptive_stage = (
+            self.cfg.transfer_dtype == "adaptive"
+            and not getattr(self.runner, "kv_quantized", False)
+            and not getattr(self.runner, "_multihost", False)
+        )
         use_q8 = (
             self.cfg.transfer_dtype == "int8"
             or getattr(self.runner, "kv_quantized", False)
             or (
                 self.cfg.transfer_dtype == "adaptive"
-                # With an in-process consumer the export will be CLAIMED
-                # before staging: no wire bytes exist to save, the rate
-                # estimators never observe anything, and a q8 snapshot
-                # would be a pure accuracy loss on the device fast path —
-                # adaptive means exact here.
-                and not (self._local_enabled and _LOCAL_CONSUMERS)
+                and not adaptive_stage
                 and self._adaptive_pick_q8()
             )
         )
@@ -491,7 +500,8 @@ class TPUConnector:
                 self._local_exports[key] = (deadline, snaps, swa_snap)
         if snaps or swa_snap is not None:
             threading.Thread(
-                target=self._stage_chunks, args=(key, snaps, swa_snap),
+                target=self._stage_chunks,
+                args=(key, snaps, swa_snap, adaptive_stage),
                 daemon=True,
             ).start()
         self.exported_requests += 1
@@ -543,12 +553,20 @@ class TPUConnector:
             self._local_cond.notify_all()
         return None if entry is None else (entry[1], entry[2])
 
-    def _stage_chunks(self, key: str, snaps: list, swa_snap=None) -> None:
+    def _stage_chunks(
+        self, key: str, snaps: list, swa_snap=None,
+        adaptive_stage: bool = False,
+    ) -> None:
         """Staging thread: download each snapshot and register it. A failed
         download leaves later chunks unregistered; the consumer's pull wait
         times out and its load-failure policy decides. The sliding-layer
         section (tiny: <= a window's worth of ring pages) registers FIRST
-        so a ring consumer's final pull never waits on the big chunks."""
+        so a ring consumer's final pull never waits on the big chunks.
+
+        ``adaptive_stage``: snapshots are exact; this leg decides the
+        wire encoding per chunk, quantizing ON DEVICE when the measured
+        link favors q8 — so local claims stay lossless while remote
+        pulls keep the adaptive race."""
         t0 = time.monotonic()
         with self._local_lock:
             self._staging_active.add(key)
@@ -597,6 +615,15 @@ class TPUConnector:
                     # remaining HBM->host downloads would be pure waste.
                     break
                 t_chunk = time.monotonic()
+                if adaptive_stage and not isinstance(snap, tuple):
+                    if self._adaptive_pick_q8():
+                        # On-device row quantize of the exact snapshot
+                        # (same math as the q8 snapshot path), then the
+                        # halved download. Timed within the chunk so the
+                        # rate estimator prices the quantize in.
+                        from llmd_tpu.engine.runner import _quantize_rows_q8
+
+                        snap = _quantize_rows_q8(snap)
                 is_q8 = isinstance(snap, tuple)
                 if is_q8:  # int8 transfer: (q8, scales)
                     q8, scales = (self.runner.download_pages(s) for s in snap)
