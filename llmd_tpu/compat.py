@@ -1,0 +1,40 @@
+"""Version-compatibility shims for the installed JAX.
+
+The repo targets current JAX surface names; installs that predate a
+rename still work because every internal importer routes through this
+module (one place to delete when the floor version moves):
+
+- ``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+  top-level ``jax.shard_map`` export, renaming ``check_rep`` ->
+  ``check_vma`` on the way.
+- Pallas-TPU ``TPUCompilerParams`` was renamed ``CompilerParams``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6 top-level export)
+except ImportError:  # pragma: no cover - exercised on older installs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` with fallback to the pre-rename
+    ``TPUCompilerParams`` (identical fields)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pragma: no cover - exercised on older installs
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = ["shard_map", "pallas_tpu_compiler_params"]

@@ -280,6 +280,15 @@ class SchedulerConfig:
     # Larger K amortizes dispatch latency at the cost of K-token streaming
     # granularity and bounded overrun past stop tokens.
     decode_window: int = 1
+    # Async stepping (vLLM v1 --async-scheduling role): while step N
+    # executes on device, the scheduler speculatively builds step N+1
+    # against dispatched token counts; the engine blocks on N's single
+    # coalesced readback only after N+1 is staged, reconciling late
+    # EOS/max-tokens finishes by invalidating the affected staged rows.
+    # Outputs arrive one step late. Forced OFF for multi-host lockstep
+    # engines and P/D eager-ACK producers (their response-ordering
+    # guarantees assume the synchronous step shape).
+    async_scheduling: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -17,6 +17,7 @@ import uuid
 from dataclasses import dataclass, field
 
 import jax
+import numpy as np
 
 from llmd_tpu.config import EngineConfig, swa_ring_spec
 from llmd_tpu.engine.kv_cache import KVEventSink, PageAllocator
@@ -24,9 +25,16 @@ from llmd_tpu.engine.request import (
     FinishReason,
     Request,
     RequestOutput,
+    RequestStatus,
     SamplingParams,
 )
-from llmd_tpu.engine.runner import ModelRunner
+from llmd_tpu.engine.runner import (
+    ModelRunner,
+    PendingDecode,
+    PendingPrefill,
+    StagedDecode,
+    StepResult,
+)
 from llmd_tpu.engine.scheduler import EngineScheduler, ScheduledBatch
 from llmd_tpu.parallel.mesh import MeshContext, build_mesh
 
@@ -184,6 +192,29 @@ class EngineStats:
     max_lora: int = 0
     running_lora_adapters: tuple = ()
     waiting_lora_adapters: tuple = ()
+    # Step pipeline observability (async stepping, serve/metrics.py):
+    # the host gap is the per-step host time the device sits idle for —
+    # schedule + array build + dispatch + output assembly in sync mode,
+    # only the post-readback reconcile/patch in async mode (the rest
+    # overlaps device execution). Last value + running sum + step count
+    # so a scrape (or the bench) can read both a gauge and a mean.
+    engine_steps_total: int = 0
+    step_host_gap_ms: float = 0.0
+    step_host_gap_ms_total: float = 0.0
+    # Speculative rows invalidated by a late finish/abort at reconcile
+    # (EOS / stop token / max-tokens landed after the next batch was
+    # staged against the optimistic one-token-per-decode assumption).
+    async_rollbacks_total: int = 0
+
+
+@dataclass
+class _InflightStep:
+    """One dispatched-but-unread engine step (async stepping slot)."""
+
+    batch: ScheduledBatch
+    pending_prefill: PendingPrefill | None
+    pending_decode: PendingDecode | None
+    dispatch_time: float
 
 
 class LLMEngine:
@@ -357,6 +388,33 @@ class LLMEngine:
             )
             self.kv_connector = TPUConnector(kv_cfg, self.runner, self.allocator)
             self.scheduler.finish_hook = self._on_finish
+
+        # Async stepping (SchedulerConfig.async_scheduling): a two-slot
+        # pipeline — one batch executing on device while the next is
+        # speculatively scheduled and staged on host. Forced OFF where
+        # the synchronous step shape is itself a correctness contract:
+        # multi-host lockstep followers mirror a totally ordered op
+        # stream whose cadence the leader's sync step defines, and P/D
+        # eager-ACK producers answer before the readback on the promise
+        # that nothing was reordered around the enqueued KV snapshots.
+        self._async = bool(config.scheduler.async_scheduling)
+        if self._async and jax.process_count() > 1:
+            logging.getLogger(__name__).info(
+                "async_scheduling disabled: multi-host lockstep engines "
+                "keep the synchronous step shape"
+            )
+            self._async = False
+        if self._async and config.kv_role in ("kv_producer", "kv_both"):
+            logging.getLogger(__name__).info(
+                "async_scheduling disabled: P/D eager-ACK producers rely "
+                "on synchronous step ordering"
+            )
+            self._async = False
+        self._inflight: _InflightStep | None = None
+        # Aborts that arrived while their request was in flight: freeing
+        # the pages immediately would hand them to another sequence while
+        # the device still writes them — applied at the reconcile point.
+        self._deferred_aborts: set[str] = set()
 
     def _on_finish(self, req) -> None:
         if self.kv_connector is not None and self.kv_connector.wants_export(req):
@@ -558,6 +616,15 @@ class LLMEngine:
             return
 
     def abort_request(self, request_id: str) -> bool:
+        if self._inflight is not None and any(
+            s.request.request_id == request_id
+            for s in self._inflight.batch.seqs
+        ):
+            # In-flight sequence (async stepping): the dispatched device
+            # programs still write its pages — defer the abort to the
+            # reconcile point instead of freeing pages mid-write.
+            self._deferred_aborts.add(request_id)
+            return True
         return self.scheduler.abort_request(request_id) is not None
 
     def cached_prefix_pages(self, prompt_token_ids: list[int]) -> int:
@@ -630,50 +697,188 @@ class LLMEngine:
             self._host_cache.clear()
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        return self.scheduler.has_work() or self._inflight is not None
 
     # ------------------------------------------------------------------ #
 
     def step(self) -> list[RequestOutput]:
+        if self._async:
+            return self._step_async()
+        return self._step_sync()
+
+    def _step_sync(self) -> list[RequestOutput]:
+        t0 = time.monotonic()
         batch: ScheduledBatch = self.scheduler.schedule()
         if batch.is_empty:
             return []
         now = time.monotonic()
-        sampled: dict[str, list[int]] = {}
-        logprobs: dict[str, list[float]] = {}
-
-        if batch.prefills:
-            # Eager-ACK: an export-only prefill's sampled token is thrown
-            # away by the routing sidecar (the two-phase protocol only
-            # consumes kv_transfer_params), so the producer's response
-            # does not wait for prefill compute or the token readback —
-            # device program order alone guarantees the KV snapshots the
-            # consumer pulls are valid. Cuts compute + one host RTT off
-            # the P/D TTFT critical path.
-            eager_ack = (
-                self.kv_connector is not None
-                and self.kv_connector.cfg.is_producer
-                and all(
-                    s.request.kv_transfer_params is not None
-                    and s.request.kv_transfer_params.get("do_remote_decode")
-                    and s.request.sampling.max_tokens == 1
-                    for s in batch.prefills
-                )
+        # Eager-ACK: an export-only prefill's sampled token is thrown
+        # away by the routing sidecar (the two-phase protocol only
+        # consumes kv_transfer_params), so the producer's response
+        # does not wait for prefill compute or the token readback —
+        # device program order alone guarantees the KV snapshots the
+        # consumer pulls are valid. Cuts compute + one host RTT off
+        # the P/D TTFT critical path.
+        eager_ack = bool(batch.prefills) and (
+            self.kv_connector is not None
+            and self.kv_connector.cfg.is_producer
+            and all(
+                s.request.kv_transfer_params is not None
+                and s.request.kv_transfer_params.get("do_remote_decode")
+                and s.request.sampling.max_tokens == 1
+                for s in batch.prefills
             )
-            res = self.runner.run_prefill(batch.prefills, sync=not eager_ack)
-            for i, seq in enumerate(batch.prefills):
-                sampled[seq.request.request_id] = res.tokens[i].tolist()
-                logprobs[seq.request.request_id] = res.logprobs[i].tolist()
+        )
+        pend_p = pend_d = None
+        if batch.prefills:
+            pend_p = self.runner.dispatch_prefill(batch.prefills)
+            for seq in batch.prefills:
                 self.stats.prompt_tokens += seq.num_tokens
         if batch.decodes:
-            k = batch.decodes[0].num_tokens
-            res = self.runner.run_decode(batch.decodes, k_steps=k)
-            for i, seq in enumerate(batch.decodes):
-                sampled[seq.request.request_id] = res.tokens[i].tolist()
-                logprobs[seq.request.request_id] = res.logprobs[i].tolist()
-
+            pend_d = self.runner.dispatch_decode(
+                batch.decodes, k_steps=batch.decodes[0].num_tokens
+            )
+        self.scheduler.note_dispatch(batch)
+        t_dispatched = time.monotonic()
+        # One coalesced readback for the whole step (prefill bucket
+        # groups + the decode window come back in a single transfer).
+        pres, dres = self.runner.wait_step(
+            None if eager_ack else pend_p, pend_d
+        )
+        t_read = time.monotonic()
+        sampled, logprobs = self._collect(batch, pres, dres)
         accepted = self.scheduler.update_after_step(batch, sampled)
+        outputs = self._assemble_outputs(batch, accepted, logprobs, now)
+        if self.offloader is not None:
+            # One bucketed HBM->host gather for the step's committed pages.
+            self.offloader.flush()
+        self._finish_step((t_dispatched - t0) + (time.monotonic() - t_read))
+        return outputs
 
+    def _step_async(self) -> list[RequestOutput]:
+        """Two-slot pipelined step: while the in-flight batch executes on
+        device, schedule the next batch speculatively (each in-flight
+        decode assumed to land its tokens) and prestage its host arrays;
+        only then block on the in-flight readback. Late finishes
+        (EOS/stop token/max-tokens) invalidate their staged rows — the
+        released pages follow the recompute-preemption path — and
+        everything else dispatches immediately, so the host gap shrinks
+        to the reconcile/patch sliver. Outputs arrive one step late
+        (docs/architecture/async-scheduling.md)."""
+        inflight = self._inflight
+        if inflight is None:
+            batch = self.scheduler.schedule()
+            if batch.is_empty:
+                return []
+            self._dispatch_async(batch)
+            return []  # pipeline is one step deep: tokens land next call
+        # ---- overlapped host region: the device is executing N ----
+        staged = self.scheduler.schedule()  # speculative: pending counts
+        staged_dec: StagedDecode | None = None
+        if staged.decodes:
+            staged_dec = self.runner.stage_decode(
+                staged.decodes, k_steps=staged.decodes[0].num_tokens
+            )
+        # ---- block on step N's single coalesced readback ----
+        pres, dres = self.runner.wait_step(
+            inflight.pending_prefill, inflight.pending_decode
+        )
+        t_read = time.monotonic()
+        sampled, logprobs = self._collect(inflight.batch, pres, dres)
+        accepted = self.scheduler.update_after_step(inflight.batch, sampled)
+        self._inflight = None
+        for rid in sorted(self._deferred_aborts):
+            self.scheduler.abort_request(rid)
+        self._deferred_aborts.clear()
+        # ---- reconcile the speculative slot against late finishes ----
+        live_p = [
+            s for s in staged.prefills
+            if s.request.status is RequestStatus.RUNNING
+        ]
+        live_d = [
+            s for s in staged.decodes
+            if s.request.status is RequestStatus.RUNNING
+        ]
+        rolled = (len(staged.prefills) - len(live_p)) + (
+            len(staged.decodes) - len(live_d)
+        )
+        if rolled:
+            # Rolled-back rows already returned every page (speculative
+            # allocations included) via _finish/_release — the same
+            # release the recompute-preemption path uses.
+            self.stats.async_rollbacks_total += rolled
+            if len(live_d) != len(staged.decodes):
+                staged_dec = None  # row set changed: restage at dispatch
+            staged = ScheduledBatch(prefills=live_p, decodes=live_d)
+        if staged.is_empty and rolled and self.scheduler.has_work():
+            # The whole slot was invalidated; the freed pages/budget may
+            # admit different work now that nothing is pending.
+            staged = self.scheduler.schedule()
+            staged_dec = None
+        if not staged.is_empty:
+            self._dispatch_async(staged, staged_dec)
+        # Device idle ends at the re-dispatch above; output assembly and
+        # gauge refresh below overlap step N+1's execution.
+        host_gap = time.monotonic() - t_read
+        outputs = self._assemble_outputs(
+            inflight.batch, accepted, logprobs, inflight.dispatch_time
+        )
+        if self.offloader is not None:
+            self.offloader.flush()
+        self._finish_step(host_gap)
+        return outputs
+
+    def _dispatch_async(
+        self, batch: ScheduledBatch, staged_dec: StagedDecode | None = None
+    ) -> None:
+        now = time.monotonic()
+        pend_p = None
+        if batch.prefills:
+            pend_p = self.runner.dispatch_prefill(batch.prefills)
+            for seq in batch.prefills:
+                self.stats.prompt_tokens += seq.num_tokens
+        pend_d = None
+        if batch.decodes:
+            if staged_dec is None:
+                staged_dec = self.runner.stage_decode(
+                    batch.decodes, k_steps=batch.decodes[0].num_tokens
+                )
+            pend_d = self.runner.dispatch_staged_decode(staged_dec)
+        self.scheduler.note_dispatch(batch)
+        self._inflight = _InflightStep(batch, pend_p, pend_d, now)
+
+    def _collect(
+        self,
+        batch: ScheduledBatch,
+        pres: StepResult | None,
+        dres: StepResult | None,
+    ) -> tuple[dict[str, list[int]], dict[str, list[float]]]:
+        sampled: dict[str, list[int]] = {}
+        logprobs: dict[str, list[float]] = {}
+        if batch.prefills:
+            if pres is None:
+                # Eager-ACK: tokens were never fetched (the consumer
+                # discards them); zeros keep the bookkeeping uniform.
+                pres = StepResult(
+                    np.zeros((len(batch.prefills), 1), np.int32),
+                    np.zeros((len(batch.prefills), 1), np.float32),
+                )
+            for i, seq in enumerate(batch.prefills):
+                sampled[seq.request.request_id] = pres.tokens[i].tolist()
+                logprobs[seq.request.request_id] = pres.logprobs[i].tolist()
+        if batch.decodes and dres is not None:
+            for i, seq in enumerate(batch.decodes):
+                sampled[seq.request.request_id] = dres.tokens[i].tolist()
+                logprobs[seq.request.request_id] = dres.logprobs[i].tolist()
+        return sampled, logprobs
+
+    def _assemble_outputs(
+        self,
+        batch: ScheduledBatch,
+        accepted: dict[str, list[int]],
+        logprobs: dict[str, list[float]],
+        now: float,
+    ) -> list[RequestOutput]:
         outputs: list[RequestOutput] = []
         finished = 0
         for seq in batch.seqs:
@@ -702,11 +907,16 @@ class LLMEngine:
                 )
             )
         self.stats.requests_finished += finished
-        if self.offloader is not None:
-            # One bucketed HBM->host gather for the step's committed pages.
-            self.offloader.flush()
-        self._refresh_gauges()
         return outputs
+
+    def _finish_step(self, host_gap_s: float) -> None:
+        gap_ms = host_gap_s * 1e3
+        self.stats.engine_steps_total += 1
+        self.stats.step_host_gap_ms = round(gap_ms, 3)
+        self.stats.step_host_gap_ms_total = round(
+            self.stats.step_host_gap_ms_total + gap_ms, 3
+        )
+        self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
         self.stats.num_waiting = self.scheduler.num_waiting

@@ -81,6 +81,12 @@ class Request:
     # Memoized [max_pages] ring-view table row (immutable once the ring is
     # allocated; invalidated whenever swa_block_ids is freed).
     swa_table_row: Any = None
+    # Tokens dispatched to the device but not yet committed by a step
+    # readback (async stepping, SchedulerConfig.async_scheduling): the
+    # scheduler speculates the next batch against dispatched positions
+    # while the in-flight step executes. Always 0 in synchronous mode
+    # and between reconcile and the next dispatch.
+    num_pending_tokens: int = 0
     # Number of prompt tokens satisfied from the prefix cache (skipped compute).
     num_cached_tokens: int = 0
     # Outputs generated before a recompute-preemption folded them into the
@@ -114,6 +120,20 @@ class Request:
     @property
     def in_decode(self) -> bool:
         return self.num_computed_tokens >= self.num_prompt_tokens
+
+    @property
+    def num_dispatched_tokens(self) -> int:
+        """Committed + in-flight position: what the KV/pages will hold
+        once the dispatched step lands. The scheduler plans against THIS
+        (== num_computed_tokens whenever nothing is in flight)."""
+        return self.num_computed_tokens + self.num_pending_tokens
+
+    @property
+    def in_decode_dispatched(self) -> bool:
+        """in_decode once the in-flight step lands (async speculation:
+        a prompt-completing chunk in flight makes the seq decode-ready
+        for the next staged batch)."""
+        return self.num_dispatched_tokens >= self.num_prompt_tokens
 
     @property
     def is_finished(self) -> bool:

@@ -153,6 +153,42 @@ class StepResult:
     logprobs: np.ndarray
 
 
+@dataclass
+class PendingPrefill:
+    """Dispatched-but-unread prefill programs of one engine step: the
+    packed [B, 2] device outputs per Q-bucket group plus each group's
+    source row indices. ``wait_step`` folds every group into one
+    coalesced host transfer."""
+
+    entries: list[tuple[jax.Array, list[int]]]
+    n: int
+
+
+@dataclass
+class PendingDecode:
+    """Dispatched-but-unread decode program: packed [B, 2K] device
+    output awaiting the step's coalesced readback."""
+
+    packed: jax.Array
+    n: int
+    k: int
+
+
+@dataclass
+class StagedDecode:
+    """Host arrays for a decode dispatch built AHEAD of the tokens they
+    feed on (async stepping): everything shape- and page-dependent is
+    final at staging time; only ``first``/``start`` (and seeded rows'
+    seeds) depend on the previous step's readback and are filled by
+    ``dispatch_staged_decode`` right before dispatch."""
+
+    seqs: list[ScheduledSeq]
+    arrays: dict
+    B: int
+    k: int
+    all_greedy: bool
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -804,13 +840,6 @@ class ModelRunner:
             pt[i] = row
         return pt
 
-    @staticmethod
-    def _unpack(packed: jax.Array, n: int, K: int = 1) -> StepResult:
-        arr = dist.replicated_to_host(packed)  # the ONE host transfer
-        tokens = arr[:n, :K].astype(np.int32)
-        logprobs = arr[:n, K:].astype(np.float32)
-        return StepResult(tokens, logprobs)
-
     # ------------------------------------------------------------------ #
     # multi-host lockstep dispatch (leader broadcasts, followers mirror)
 
@@ -1340,11 +1369,7 @@ class ModelRunner:
     def run_prefill(
         self, seqs: list[ScheduledSeq], sync: bool = True
     ) -> StepResult:
-        """All scheduled prompt chunks, batched by Q bucket.
-
-        Rows are grouped so a single long chunk doesn't pad every short
-        chunk up to its bucket (padded compute stays ~sum of real tokens,
-        not B_bucket x max_chunk).
+        """Dispatch all scheduled prompt chunks and read the tokens back.
 
         ``sync=False`` is the P/D eager-ACK path: the forward is ENQUEUED
         but the sampled token is never read back (zeros returned). Valid
@@ -1354,25 +1379,39 @@ class ModelRunner:
         host synchronization; a forward fault surfaces on the snapshot
         consumers (staging download / consumer scatter) instead of here.
         """
+        pending = self.dispatch_prefill(seqs)
+        if not sync:
+            return StepResult(
+                np.zeros((len(seqs), 1), np.int32),
+                np.zeros((len(seqs), 1), np.float32),
+            )
+        res, _ = self.wait_step(pending, None)
+        return res
+
+    def dispatch_prefill(self, seqs: list[ScheduledSeq]) -> PendingPrefill:
+        """Enqueue all scheduled prompt chunks, batched by Q bucket; no
+        host readback (that is ``wait_step``'s single coalesced fetch).
+
+        Rows are grouped so a single long chunk doesn't pad every short
+        chunk up to its bucket (padded compute stays ~sum of real tokens,
+        not B_bucket x max_chunk).
+        """
         groups: dict[int, list[int]] = {}
         for i, s in enumerate(seqs):
-            groups.setdefault(pad_to_bucket(s.num_tokens, self.prefill_buckets), []).append(i)
-        tokens = np.zeros((len(seqs), 1), np.int32)
-        logprobs = np.zeros((len(seqs), 1), np.float32)
+            groups.setdefault(
+                pad_to_bucket(s.num_tokens, self.prefill_buckets), []
+            ).append(i)
+        entries = []
         for q_bucket, idxs in sorted(groups.items()):
-            res = self._run_prefill_group(
-                [seqs[i] for i in idxs], q_bucket, sync=sync
+            packed = self._dispatch_prefill_group(
+                [seqs[i] for i in idxs], q_bucket
             )
-            if res is None:
-                continue
-            for row, i in enumerate(idxs):
-                tokens[i] = res.tokens[row]
-                logprobs[i] = res.logprobs[row]
-        return StepResult(tokens, logprobs)
+            entries.append((packed, idxs))
+        return PendingPrefill(entries, len(seqs))
 
-    def _run_prefill_group(
-        self, seqs: list[ScheduledSeq], Q: int, sync: bool = True
-    ) -> StepResult | None:
+    def _dispatch_prefill_group(
+        self, seqs: list[ScheduledSeq], Q: int
+    ) -> jax.Array:
         n = len(seqs)
         B = pad_to_bucket(n, self.prefill_batch_buckets)
         tokens = np.zeros((B, Q), np.int32)
@@ -1400,38 +1439,132 @@ class ModelRunner:
         all_greedy = all(s.request.sampling.greedy for s in seqs)
         with self._dispatch_lock:
             arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
-            packed = self._exec_prefill(arrays, all_greedy)
-        if not sync:
-            return None  # eager-ACK: forward enqueued, token never fetched
-        return self._unpack(packed, n)
+            return self._exec_prefill(arrays, all_greedy)
 
     def run_decode(self, seqs: list[ScheduledSeq], k_steps: int = 1) -> StepResult:
         """K fused decode iterations for the running batch (K=1 = one token)."""
+        pending = self.dispatch_decode(seqs, k_steps)
+        _, res = self.wait_step(None, pending)
+        return res
+
+    def dispatch_decode(
+        self, seqs: list[ScheduledSeq], k_steps: int = 1
+    ) -> PendingDecode:
+        """Stage + enqueue the decode program; no host readback."""
+        return self.dispatch_staged_decode(self.stage_decode(seqs, k_steps))
+
+    def stage_decode(
+        self, seqs: list[ScheduledSeq], k_steps: int = 1
+    ) -> StagedDecode:
+        """Build the decode dispatch's host arrays AHEAD of the previous
+        step's readback (async stepping overlaps this with device
+        execution). The page/ring tables — the O(B x max_pages) cost —
+        are final here because the scheduler already allocated every page
+        the speculated tokens need; ``first``/``start`` and seeded rows'
+        seeds are filled at dispatch, once the tokens they depend on are
+        committed."""
         n = len(seqs)
         B = pad_to_bucket(n, self.batch_buckets)
-        first = np.zeros(B, np.int32)
-        start = np.zeros(B, np.int32)
         active = np.zeros(B, np.uint8)
+        active[:n] = 1
+        # Seeds are NOT drawn here: the stateful rng must be consumed at
+        # dispatch time in dispatch order, or async staging (which runs
+        # a step early and re-runs on a rollback restage) would shift
+        # the draw stream relative to a synchronous engine and break
+        # unseeded-sampling parity.
+        temp = np.zeros(B, np.float32)
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
         for i, s in enumerate(seqs):
-            req = s.request
-            first[i] = req.all_token_ids[req.num_computed_tokens]
-            start[i] = req.num_computed_tokens
-            active[i] = 1
-        temp, top_k, top_p, seeds = self._sampling_arrays(seqs, B, k_steps)
+            sp = s.request.sampling
+            temp[i] = 0.0 if sp.greedy else sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
         arrays = {
-            "first": first, "start": start,
+            "first": np.zeros(B, np.int32), "start": np.zeros(B, np.int32),
             "page_table": self._page_table(seqs, B), "active": active,
-            "temp": temp, "top_k": top_k, "top_p": top_p, "seeds": seeds,
+            "temp": temp, "top_k": top_k, "top_p": top_p,
+            "seeds": np.zeros((B, k_steps), np.uint32),
         }
         if self.swa is not None:
             arrays["swa_table"] = self._swa_table(seqs, B)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = self._lora_array(seqs, B)
         all_greedy = all(s.request.sampling.greedy for s in seqs)
+        return StagedDecode(list(seqs), arrays, B, k_steps, all_greedy)
+
+    def dispatch_staged_decode(self, staged: StagedDecode) -> PendingDecode:
+        """Fill the readback-dependent slots of a staged decode and
+        enqueue it. By dispatch time the previous step has committed, so
+        ``num_computed_tokens``/``all_token_ids`` hold exactly what a
+        synchronous engine would see here — async staging never changes
+        the dispatched bytes, only when the host work happened."""
+        first = staged.arrays["first"]
+        start = staged.arrays["start"]
+        # ONE [B, K] rng block per decode dispatch, drawn here so the
+        # stateful stream advances in dispatch order (byte-parity with a
+        # synchronous engine for unseeded sampling); explicitly seeded
+        # rows then overwrite theirs per (request seed, output index).
+        seeds = self._np_rng.integers(
+            0, 2**32, size=(staged.B, staged.k), dtype=np.uint32
+        )
+        staged.arrays["seeds"] = seeds
+        for i, s in enumerate(staged.seqs):
+            req = s.request
+            first[i] = req.all_token_ids[req.num_computed_tokens]
+            start[i] = req.num_computed_tokens
+            sp = req.sampling
+            if sp.seed is not None:
+                pos = req.total_output_tokens
+                for j in range(staged.k):
+                    seeds[i, j] = np.uint32(
+                        (sp.seed * 1000003 + pos + j) & 0xFFFFFFFF
+                    )
         with self._dispatch_lock:
-            arrays = self._sync(_OP_DECODE, B, k_steps, all_greedy, arrays)
-            packed = self._exec_decode(arrays, k_steps, all_greedy)
-        return self._unpack(packed, n, k_steps)
+            arrays = self._sync(
+                _OP_DECODE, staged.B, staged.k, staged.all_greedy,
+                staged.arrays,
+            )
+            packed = self._exec_decode(arrays, staged.k, staged.all_greedy)
+        return PendingDecode(packed, len(staged.seqs), staged.k)
+
+    def wait_step(
+        self,
+        prefill: PendingPrefill | None,
+        decode: PendingDecode | None,
+    ) -> tuple[StepResult | None, StepResult | None]:
+        """Block on one engine step's token readback: every dispatched
+        program's packed output comes back in a SINGLE coalesced
+        transfer (one host round-trip per step, however many prefill
+        bucket groups and decode windows the step dispatched)."""
+        packs: list[jax.Array] = []
+        if prefill is not None:
+            packs.extend(p for p, _ in prefill.entries)
+        if decode is not None:
+            packs.append(decode.packed)
+        if not packs:
+            return None, None
+        if dist.is_multihost():
+            hosts = [dist.replicated_to_host(p) for p in packs]
+        else:
+            hosts = [np.asarray(a) for a in jax.device_get(packs)]
+        pres = dres = None
+        if prefill is not None:
+            tokens = np.zeros((prefill.n, 1), np.int32)
+            logprobs = np.zeros((prefill.n, 1), np.float32)
+            for gi, (_, idxs) in enumerate(prefill.entries):
+                arr = hosts[gi]
+                for row, i in enumerate(idxs):
+                    tokens[i] = arr[row, :1].astype(np.int32)
+                    logprobs[i] = arr[row, 1:2]
+            pres = StepResult(tokens, logprobs)
+        if decode is not None:
+            arr = hosts[-1]
+            dres = StepResult(
+                arr[: decode.n, : decode.k].astype(np.int32),
+                arr[: decode.n, decode.k :].astype(np.float32),
+            )
+        return pres, dres
 
     # ------------------------------------------------------------------ #
 

@@ -99,6 +99,11 @@ class EngineScheduler:
         # sections (hybrid APC) so live sequences outrank retention.
         # Returns True if anything was freed (retry the allocation).
         self.ring_pressure_hook = None
+        # Async stepping: request ids whose pages the in-flight device
+        # programs still read/write — preemption must never evict them
+        # (their pages would be freed under the device's feet). Sync
+        # engines leave this empty.
+        self.protected: set[str] = set()
 
     # ------------------------------------------------------------------ #
     # queue management
@@ -139,13 +144,23 @@ class EngineScheduler:
     # scheduling
 
     def schedule(self) -> ScheduledBatch:
+        """Select the next batch.
+
+        All position math uses ``num_dispatched_tokens`` (committed +
+        in-flight), so the same code path serves both modes: in sync
+        engines nothing is ever pending and dispatched == computed; in
+        async engines this IS the speculative schedule — the next batch
+        is planned assuming every in-flight row lands its tokens, and a
+        late finish (EOS/max-tokens at reconcile) invalidates the
+        affected staged rows (engine-side rollback).
+        """
         budget = self.config.max_num_batched_tokens
         decodes: list[ScheduledSeq] = []
         prefills: list[ScheduledSeq] = []
         scheduled: set[str] = set()
 
-        decoding = [r for r in self.running if r.in_decode]
-        mid_prefill = [r for r in self.running if not r.in_decode]
+        decoding = [r for r in self.running if r.in_decode_dispatched]
+        mid_prefill = [r for r in self.running if not r.in_decode_dispatched]
 
         # Fused K-step decode windows apply whenever this step cannot make
         # admission progress anyway (no admissible waiting request, no
@@ -162,14 +177,20 @@ class EngineScheduler:
                 1,
                 min(
                     window,
-                    min(self.max_model_len - r.num_computed_tokens for r in decoding),
+                    min(
+                        self.max_model_len - r.num_dispatched_tokens
+                        for r in decoding
+                    ),
                 ),
             )
 
         # 1. Decodes claim pages FIRST: a running decode must never be
         #    starved by prefill admission taking the last free pages.
         for req in decoding:
-            if req.status is not RequestStatus.RUNNING or not req.in_decode:
+            if (
+                req.status is not RequestStatus.RUNNING
+                or not req.in_decode_dispatched
+            ):
                 continue  # reset by a preemption earlier in this loop
             if budget <= 0:
                 break
@@ -188,7 +209,9 @@ class EngineScheduler:
         for req in mid_prefill:
             if req.status is not RequestStatus.RUNNING or budget <= 0:
                 continue
-            chunk = min(req.num_prompt_tokens - req.num_computed_tokens, budget)
+            chunk = min(
+                req.num_prompt_tokens - req.num_dispatched_tokens, budget
+            )
             if self.swa_chunk_tokens:
                 chunk = min(chunk, self.swa_chunk_tokens)
             if chunk <= 0:
@@ -204,7 +227,7 @@ class EngineScheduler:
             req = self.waiting[0]
             if req.num_computed_tokens == 0:
                 self._apply_prefix_cache(req)
-            remaining = req.num_prompt_tokens - req.num_computed_tokens
+            remaining = req.num_prompt_tokens - req.num_dispatched_tokens
             chunk = min(remaining, budget)
             if self.swa_chunk_tokens:
                 chunk = min(chunk, self.swa_chunk_tokens)
@@ -332,7 +355,8 @@ class EngineScheduler:
         return False
 
     def _ensure_pages(self, req: Request, new_tokens: int) -> bool:
-        need_slots = req.num_computed_tokens + new_tokens
+        # Dispatched position: in-flight tokens already own their slots.
+        need_slots = req.num_dispatched_tokens + new_tokens
         need_pages = -(-need_slots // self.allocator.page_size)
         missing = need_pages - len(req.block_ids)
         if missing <= 0:
@@ -344,10 +368,17 @@ class EngineScheduler:
             return False
 
     def _preempt_for(self, req: Request, exclude: set[str] = frozenset()) -> bool:
-        """Evict the youngest other running sequence to recompute later."""
+        """Evict the youngest other running sequence to recompute later.
+
+        In-flight sequences (``protected``, async stepping) are never
+        victims: the dispatched device programs still read/write their
+        pages, and recompute-preemption frees those pages immediately.
+        """
         victims = [
             r for r in self.running
-            if r is not req and r.request_id not in exclude
+            if r is not req
+            and r.request_id not in exclude
+            and r.request_id not in self.protected
         ]
         if not victims:
             return False
@@ -370,6 +401,8 @@ class EngineScheduler:
         return True
 
     def _release(self, req: Request) -> None:
+        req.num_pending_tokens = 0
+        self.protected.discard(req.request_id)
         if req.block_ids:
             self.allocator.free(req.block_ids)
             req.block_ids = []
@@ -381,6 +414,24 @@ class EngineScheduler:
 
     # ------------------------------------------------------------------ #
     # post-step bookkeeping
+
+    def note_dispatch(self, batch: ScheduledBatch) -> None:
+        """Mark a dispatched batch's tokens as in flight (async stepping).
+
+        Until the readback commits them, scheduling proceeds against the
+        dispatched positions and the sequences are protected from
+        preemption. ``update_after_step`` is the matching commit (it
+        drains the pending counts); sync engines call both back to back,
+        so the window is empty there.
+        """
+        for seq in batch.seqs:
+            seq.request.num_pending_tokens += seq.num_tokens
+            self.protected.add(seq.request.request_id)
+
+    def _commit_pending(self, seq: ScheduledSeq) -> None:
+        req = seq.request
+        req.num_pending_tokens = max(0, req.num_pending_tokens - seq.num_tokens)
+        self.protected.discard(req.request_id)
 
     def update_after_step(
         self, batch: ScheduledBatch, sampled: dict[str, list[int]]
@@ -396,6 +447,7 @@ class EngineScheduler:
         accepted: dict[str, list[int]] = {}
         for seq in batch.prefills:
             req = seq.request
+            self._commit_pending(seq)
             req.num_computed_tokens += seq.num_tokens
             if req.in_decode:  # this chunk completed the prompt -> 1st token
                 if self.prefill_complete_hook is not None:
@@ -412,6 +464,7 @@ class EngineScheduler:
             self._commit_full_pages(req)
         for seq in batch.decodes:
             req = seq.request
+            self._commit_pending(seq)
             window = sampled[req.request_id]
             acc: list[int] = []
             reason = None

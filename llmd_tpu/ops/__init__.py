@@ -27,8 +27,9 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from llmd_tpu.compat import shard_map
 
 from llmd_tpu.ops.paged_attention import (
     paged_attention_xla,

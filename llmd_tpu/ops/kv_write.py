@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llmd_tpu.compat import pallas_tpu_compiler_params
+
 
 def _write_kernel(
     # scalar prefetch
@@ -113,7 +115,7 @@ def _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret):
         # operand index counts scalar-prefetch args first: 4 scalars,
         # kv_new, then kv_cache at index 5 -> aliased to output 0.
         input_output_aliases={5: 0},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

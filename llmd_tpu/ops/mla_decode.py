@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llmd_tpu.compat import pallas_tpu_compiler_params
+
 NEG_INF = -2.0**30
 
 
@@ -183,7 +185,7 @@ def mla_decode_paged_attention_full(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, rank), q_eff.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

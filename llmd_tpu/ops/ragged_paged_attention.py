@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llmd_tpu.compat import pallas_tpu_compiler_params
+
 NEG_INF = -2.0**30
 
 
@@ -284,7 +286,7 @@ def _decode_call(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -28,9 +28,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from llmd_tpu.compat import shard_map
 from llmd_tpu.config import ModelConfig
 from llmd_tpu.models.moe import router_topk
 

@@ -28,6 +28,11 @@ def render_metrics(
             max(stats.kv_usage, stats.swa_ring_usage), 6
         ),
         "prefix_cache_hit_rate": round(stats.prefix_hit_ratio, 6),
+        # Step-pipeline observability (async stepping): the per-step
+        # host time the device idles for. Async mode shrinks it to the
+        # reconcile/patch sliver; the *_total counters let a scraper (or
+        # bench.py --parts async_step) compute a mean over any interval.
+        "step_host_gap_ms": round(stats.step_host_gap_ms, 3),
     }
     if stats.swa_ring_pages:
         gauges["swa_ring_usage_perc"] = round(stats.swa_ring_usage, 6)
@@ -52,6 +57,10 @@ def render_metrics(
         "kv_transfer_imported_requests_total": stats.kv_imported_requests,
         "kv_transfer_imported_bytes_total": stats.kv_imported_bytes,
         "kv_transfer_import_failures_total": stats.kv_import_failures,
+        # Async stepping (speculate/rollback contract)
+        "engine_steps_total": stats.engine_steps_total,
+        "step_host_gap_ms_total": round(stats.step_host_gap_ms_total, 3),
+        "async_rollbacks_total": stats.async_rollbacks_total,
     }
     if stats.swa_ring_pages:
         # Hybrid-APC section retention activity

@@ -1,0 +1,244 @@
+"""Async stepping (SchedulerConfig.async_scheduling) tests.
+
+The contract (docs/architecture/async-scheduling.md): the two-slot
+pipeline — speculative scheduling against dispatched token counts, one
+coalesced readback per step, late-finish rollback — may change WHEN host
+work happens, never WHAT the engine emits. Every test here pins async
+mode to byte-identical token streams against the synchronous engine.
+"""
+
+import numpy as np
+import pytest
+
+from llmd_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams
+
+
+def make_engine(
+    async_mode=False, num_blocks=64, page=4, max_batched=64, max_seqs=8,
+    seed=0, window=1, **model_kw,
+) -> LLMEngine:
+    cfg = EngineConfig(
+        model=tiny_model_config(**model_kw),
+        cache=CacheConfig(page_size=page, num_blocks=num_blocks, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=max_seqs, max_num_batched_tokens=max_batched,
+            decode_window=window, async_scheduling=async_mode,
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=seed,
+    )
+    return LLMEngine(cfg)
+
+
+PROMPTS = [
+    [1, 5, 9, 13, 2, 8],
+    [3, 3, 7, 1],
+    [1, 5, 9, 13, 2, 8, 4, 4, 4, 4, 6, 6, 6, 6, 11],
+]
+
+
+def test_async_parity_basic():
+    params = SamplingParams(temperature=0.0, max_tokens=8)
+    sync = make_engine(False).generate(PROMPTS, params)
+    eng = make_engine(True)
+    asyn = eng.generate(PROMPTS, params)
+    assert list(sync.values()) == list(asyn.values())
+    # the pipeline drained: nothing left in flight, gauges populated
+    assert eng._inflight is None
+    assert eng.stats.engine_steps_total > 0
+
+
+def test_async_parity_mixed_prefill_decode_preemption():
+    """The acceptance workload: chunked prefill (long prompt > chunk),
+    interleaved decodes, and page pressure forcing recompute-preemption
+    — async must emit byte-identical streams."""
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(0, 256, size=50)),   # chunked across many steps
+        list(range(10)),
+        list(range(20, 30)),
+        list(range(40, 50)),
+    ]
+    params = [
+        SamplingParams(temperature=0.0, max_tokens=6),
+        SamplingParams(temperature=0.0, max_tokens=12),
+        SamplingParams(temperature=0.0, max_tokens=9),
+        SamplingParams(temperature=0.0, max_tokens=12),
+    ]
+    kw = dict(num_blocks=14, max_batched=16)  # tight pool -> preemption
+    sync = make_engine(False, **kw).generate(prompts, params)
+    eng = make_engine(True, **kw)
+    asyn = eng.generate(prompts, params)
+    assert list(sync.values()) == list(asyn.values())
+
+
+def test_async_parity_decode_window():
+    params = SamplingParams(temperature=0.0, max_tokens=11)
+    sync = make_engine(False, window=4).generate(PROMPTS, params)
+    asyn = make_engine(True, window=4).generate(PROMPTS, params)
+    assert list(sync.values()) == list(asyn.values())
+
+
+def test_async_parity_seeded_sampling():
+    """Seeded non-greedy rows reseed per (request seed, output index) at
+    dispatch — staging ahead must not perturb them."""
+    p = SamplingParams(temperature=1.0, max_tokens=9, seed=77)
+    sync = make_engine(False).generate([PROMPTS[0]], [p])
+    asyn = make_engine(True).generate([PROMPTS[0]], [p])
+    assert list(sync.values()) == list(asyn.values())
+
+
+def test_async_parity_unseeded_sampling():
+    """Unseeded temperature sampling consumes the engine's stateful rng:
+    seeds must be drawn at DISPATCH time in dispatch order (not at
+    staging, which runs a step early and re-runs on rollback restages),
+    so two same-seed engines agree across modes even with a chunked
+    prompt and rollbacks in the mix."""
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(0, 256, size=50)),
+        list(range(10)),
+        list(range(20, 30)),
+    ]
+    p = SamplingParams(temperature=1.0, max_tokens=6)
+    sync = make_engine(False, max_batched=16).generate(prompts, [p] * 3)
+    asyn = make_engine(True, max_batched=16).generate(prompts, [p] * 3)
+    assert list(sync.values()) == list(asyn.values())
+
+
+def test_async_rollback_on_eos():
+    """A speculated sequence that hits a stop token late: the staged row
+    is invalidated (counted), its pages return, and the stream matches
+    sync exactly."""
+    probe = make_engine(False).generate(
+        [PROMPTS[0]], SamplingParams(temperature=0.0, max_tokens=8)
+    )
+    tokens = list(probe.values())[0]
+    stop = tokens[2]
+    expected = tokens[: tokens.index(stop) + 1]
+    params = SamplingParams(
+        temperature=0.0, max_tokens=8, stop_token_ids=(stop,)
+    )
+    eng = make_engine(True)
+    out = eng.generate([PROMPTS[0]], params)
+    assert list(out.values())[0] == expected
+    # the EOS landed while the next step was already staged for this seq
+    assert eng.stats.async_rollbacks_total >= 1
+    # rollback returned every page: nothing leaked from the pool
+    assert eng.allocator.usage() == 0.0
+
+
+def test_async_rollback_on_max_tokens():
+    """LENGTH finishes always land one speculated step late in async
+    mode: each completed request must roll its staged row back."""
+    params = SamplingParams(temperature=0.0, max_tokens=5)
+    eng = make_engine(True)
+    sync = make_engine(False).generate(PROMPTS, params)
+    asyn = eng.generate(PROMPTS, params)
+    assert list(sync.values()) == list(asyn.values())
+    assert eng.stats.async_rollbacks_total >= len(PROMPTS)
+    assert eng.allocator.usage() == 0.0
+
+
+def test_async_rollback_stop_token_mid_batch():
+    """Stop token fires for ONE sequence of a batch while its mates keep
+    decoding: only that row rolls back; survivors' streams are
+    unperturbed (the staged batch is filtered, not discarded)."""
+    probe = make_engine(False).generate(
+        PROMPTS, SamplingParams(temperature=0.0, max_tokens=10)
+    )
+    vals = list(probe.values())
+    stop = vals[0][3]  # stops seq 0 early; mates may never emit it
+    params = SamplingParams(
+        temperature=0.0, max_tokens=10, stop_token_ids=(stop,)
+    )
+    sync = make_engine(False).generate(PROMPTS, params)
+    eng = make_engine(True)
+    asyn = eng.generate(PROMPTS, params)
+    assert list(sync.values()) == list(asyn.values())
+    assert eng.stats.async_rollbacks_total >= 1
+
+
+def test_async_host_gap_tracked():
+    eng = make_engine(True)
+    eng.generate(PROMPTS, SamplingParams(temperature=0.0, max_tokens=6))
+    assert eng.stats.engine_steps_total > 0
+    assert eng.stats.step_host_gap_ms_total >= 0.0
+    # the gauge surfaces through the metrics page
+    from llmd_tpu.serve.metrics import parse_prometheus, render_metrics
+
+    page = render_metrics(eng.stats, "tiny")
+    parsed = parse_prometheus(page)
+    assert "llmd:step_host_gap_ms" in parsed
+    assert "llmd:async_rollbacks_total" in parsed
+    assert parsed["llmd:engine_steps_total"] == eng.stats.engine_steps_total
+
+
+def test_async_deferred_abort_of_inflight_request():
+    """Aborting a request whose batch is in flight defers to the
+    reconcile point (pages freed only after the device stops writing
+    them); the other request keeps decoding to completion."""
+    eng = make_engine(True)
+    keep = eng.add_request(PROMPTS[0], SamplingParams(temperature=0.0, max_tokens=6))
+    victim = eng.add_request(PROMPTS[1], SamplingParams(temperature=0.0, max_tokens=6))
+    eng.step()  # primes the pipeline: both requests now in flight
+    assert eng.abort_request(victim)
+    got: dict[str, list[int]] = {keep: [], victim: []}
+    for _ in range(64):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            got[out.request_id].extend(out.new_token_ids)
+    ref = make_engine(False).generate(
+        [PROMPTS[0]], SamplingParams(temperature=0.0, max_tokens=6)
+    )
+    assert got[keep] == list(ref.values())[0]
+    assert len(got[victim]) <= 2  # nothing streamed past the abort window
+    assert eng.allocator.usage() == 0.0
+
+
+def test_async_forced_off_for_producer_role():
+    """P/D eager-ACK producers keep the synchronous step shape even when
+    the flag is on (response-ordering guarantee)."""
+    cfg = EngineConfig(
+        model=tiny_model_config(),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_num_batched_tokens=64, async_scheduling=True
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        kv_role="kv_producer",
+        kv_transfer_port=0,
+    )
+    eng = LLMEngine(cfg)
+    try:
+        assert eng._async is False
+    finally:
+        eng.close()
+
+
+def test_async_streams_one_step_late_then_drains():
+    """The first step primes the pipeline (no outputs); every token
+    still arrives, and has_work() stays true until the slot drains."""
+    eng = make_engine(True)
+    eng.add_request(PROMPTS[1], SamplingParams(temperature=0.0, max_tokens=4))
+    assert eng.step() == []  # prime: dispatch only
+    assert eng.has_work()  # in flight, even though queues may look empty
+    toks: list[int] = []
+    for _ in range(32):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            toks.extend(out.new_token_ids)
+    ref = make_engine(False).generate(
+        [PROMPTS[1]], SamplingParams(temperature=0.0, max_tokens=4)
+    )
+    assert toks == list(ref.values())[0]
+    assert eng._inflight is None

@@ -136,7 +136,10 @@ def test_stop_token():
         [PROMPTS[0]],
         SamplingParams(temperature=0.0, max_tokens=4, stop_token_ids=(stop,)),
     )
-    assert list(out.values())[0] == tokens[:2]
+    # First occurrence wins: if the greedy stream repeats the chosen
+    # token earlier than index 1 (numerics vary by backend), the engine
+    # rightly stops there.
+    assert list(out.values())[0] == tokens[: tokens.index(stop) + 1]
 
 
 def test_sampling_with_seed_changes_tokens():
