@@ -781,6 +781,8 @@ def _run_part(part: str):
         return _bench_dbo_delta()
     if part == "async_step":
         return bench_async_step()
+    if part == "spec_decode":
+        return bench_spec_decode()
     raise KeyError(part)
 
 
@@ -860,6 +862,135 @@ def bench_async_step():
             "transferable number"
         ),
     }
+
+
+def bench_spec_decode():
+    """Speculative decoding (SchedulerConfig.speculative_ngram) CPU-sim
+    microbench: n-gram prompt-lookup drafting + one-pass verification,
+    spec on/off over two workloads. ``repetitive`` (periodic prompts,
+    greedy decode — greedy tiny-model outputs loop, the prompt-lookup
+    sweet spot) records MEAN EMITTED TOKENS PER ROW-STEP (the
+    transferable number: on a memory-bound TPU decode, tokens/step IS
+    the speedup; the CPU sim is compute-bound, so wall-clock here
+    UNDERSTATES the win) and the draft acceptance rate. ``adversarial``
+    (random prompts, temperature sampling — incompressible output, no
+    n-gram ever accepted) pins the overhead of speculation that never
+    fires: proposer scans + draft-backoff bookkeeping, which must stay
+    within noise of the spec-off engine
+    (docs/architecture/speculative-decoding.md)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import statistics
+
+    import numpy as np
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+        tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+
+    B, ISL, OSL, K = 16, 64, 64, 4
+    model = tiny_model_config(max_model_len=256)
+
+    def make_engine(spec: bool) -> LLMEngine:
+        cfg = EngineConfig(
+            model=model,
+            cache=CacheConfig(page_size=16, num_blocks=512, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_num_seqs=B, max_num_batched_tokens=B * ISL,
+                speculative_ngram=spec, spec_ngram_k=K,
+                spec_ngram_min_match=2,
+            ),
+            parallel=ParallelConfig(tensor_parallel_size=1),
+            seed=0,
+        )
+        return LLMEngine(cfg)
+
+    def run(workload: str) -> dict:
+        rng = np.random.default_rng(0)
+        if workload == "repetitive":
+            sp = SamplingParams(
+                temperature=0.0, max_tokens=OSL, ignore_eos=True
+            )
+            mk = lambda: [  # noqa: E731
+                list(rng.integers(1, model.vocab_size, size=8)) * (ISL // 8)
+                for _ in range(B)
+            ]
+        else:
+            sp = SamplingParams(
+                temperature=1.0, max_tokens=OSL, ignore_eos=True
+            )
+            mk = lambda: [  # noqa: E731
+                list(rng.integers(1, model.vocab_size, size=ISL))
+                for _ in range(B)
+            ]
+        engines = {False: make_engine(False), True: make_engine(True)}
+        for eng in engines.values():  # warm, incl. mixed-split buckets
+            eng.generate(mk(), sp)
+            eng.generate(mk(), sp)
+        sch = engines[True].scheduler
+        sch.spec_accept_len_hist = [0] * (K + 1)
+        sch.spec_proposed_tokens = 0
+        sch.spec_accepted_tokens = 0
+        # PAIRED runs: each round feeds the same fresh prompt set to
+        # both engines back to back, so host drift (CI neighbors,
+        # thermal) cancels in the ratio instead of dominating it.
+        rates: dict[bool, list[float]] = {False: [], True: []}
+        steps: dict[bool, int] = {}
+        for _ in range(5):
+            prompts = mk()  # fresh: no prefix-cache pollution
+            for spec, eng in engines.items():
+                eng.stats.engine_steps_total = 0
+                t0 = time.monotonic()
+                out = eng.generate([list(p) for p in prompts], sp)
+                dt = time.monotonic() - t0
+                total = sum(len(v) for v in out.values())
+                assert total == B * OSL, (total, B * OSL)
+                rates[spec].append(total / dt)
+                steps[spec] = eng.stats.engine_steps_total
+        res = {
+            "spec_off": {
+                "tok_s": round(statistics.median(rates[False]), 1),
+                "steps": steps[False],
+            },
+            "spec_on": {
+                "tok_s": round(statistics.median(rates[True]), 1),
+                "steps": steps[True],
+            },
+            "tok_s_ratio": round(
+                statistics.median(
+                    on / off
+                    for off, on in zip(rates[False], rates[True])
+                ),
+                3,
+            ),
+        }
+        hist = sch.spec_accept_len_hist
+        rows = max(sum(hist), 1)
+        res["spec_on"]["accepted_len_hist"] = list(hist)
+        # Mean tokens emitted per (spec row, step): 1 committed sample +
+        # the accepted draft prefix. >1 means the weight read amortized
+        # over more than one token.
+        res["spec_on"]["mean_accepted_len"] = round(
+            1 + sum(j * c for j, c in enumerate(hist)) / rows, 3
+        )
+        res["spec_on"]["acceptance_rate"] = round(
+            sch.spec_accepted_tokens / max(sch.spec_proposed_tokens, 1), 3
+        )
+        return res
+
+    out: dict = {}
+    for workload in ("repetitive", "adversarial"):
+        out[workload] = run(workload)
+    out["substrate"] = (
+        "tiny model on CPU (compute-bound): mean_accepted_len and the "
+        "adversarial tok_s_ratio are the transferable numbers — "
+        "repetitive wall-clock UNDERSTATES the TPU win, where decode "
+        "steps are weight-read-bound and tokens/step is the speedup"
+    )
+    return out
 
 
 def _bench_dbo_delta():
@@ -962,7 +1093,7 @@ def _part_in_subprocess(part: str, retries: int = 1):
 
 # Parts whose substrate is the CPU sim (forced inside the part itself):
 # runnable in CI / under --skip-chip without a device or the tunnel.
-_CPU_PARTS = frozenset({"dbo", "async_step"})
+_CPU_PARTS = frozenset({"dbo", "async_step", "spec_decode"})
 
 # Every part main() can dispatch, in run order (also the validation set
 # for --parts: a typo'd name must fail fast, not silently run nothing).
@@ -970,7 +1101,7 @@ _ALL_PARTS = (
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
     "pd", "pd_int8", "pd_kvint8", "pd_local", "pd_cached", "pd_adaptive",
-    "predictor", "dbo", "async_step",
+    "predictor", "dbo", "async_step", "spec_decode",
 )
 
 
@@ -1081,6 +1212,8 @@ def main() -> None:
     run("dbo", set_key("dbo"))
     # Async stepping host-gap microbench (CPU-sim part).
     run("async_step", set_key("async_step"))
+    # Speculative decoding acceptance/overhead microbench (CPU-sim part).
+    run("spec_decode", set_key("spec_decode"))
 
     print(json.dumps(summary()))
     if "dense_int8" in attempted and state["value"] is None:

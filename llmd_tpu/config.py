@@ -289,6 +289,47 @@ class SchedulerConfig:
     # engines and P/D eager-ACK producers (their response-ordering
     # guarantees assume the synchronous step shape).
     async_scheduling: bool = False
+    # Model-free speculative decoding (prompt-lookup / n-gram drafting,
+    # Saxena 2023; verified Leviathan-style in one pass): each decode row
+    # drafts up to ``spec_ngram_k`` continuation tokens by matching the
+    # tail of its token history against its own prompt+output, and the
+    # runner scores all 1+k positions in ONE bucketed forward pass —
+    # amortizing the per-step weight read that makes decode memory-bound.
+    # Acceptance is exact: greedy rows accept while the draft equals the
+    # argmax; seeded rows accept while the draft equals the token the
+    # per-(seed, output-index) PRNG derivation samples — either way the
+    # emitted stream is byte-identical to a non-speculative engine.
+    # Rejected draft tokens' provisional KV writes are truncated before
+    # any page commit, so rejected content never enters the prefix-cache
+    # hash chain (docs/architecture/speculative-decoding.md).
+    speculative_ngram: bool = False
+    # Max draft tokens per row per step (the k in [B, 1+k] verify shapes;
+    # one traced shape family per engine).
+    spec_ngram_k: int = 4
+    # Minimum n-gram match length before a draft is proposed: higher
+    # values cut spurious drafts (wasted verify compute) on low-repetition
+    # traffic at the cost of missing short genuine repeats.
+    spec_ngram_min_match: int = 2
+
+    def __post_init__(self) -> None:
+        if self.speculative_ngram:
+            if self.spec_ngram_k < 1:
+                raise ValueError(
+                    f"spec_ngram_k={self.spec_ngram_k} must be >= 1 when "
+                    "speculative_ngram is enabled"
+                )
+            if self.spec_ngram_min_match < 1:
+                raise ValueError(
+                    f"spec_ngram_min_match={self.spec_ngram_min_match} "
+                    "must be >= 1"
+                )
+            if self.decode_window > 1:
+                raise ValueError(
+                    "speculative_ngram does not compose with "
+                    "decode_window > 1: both are multi-token-per-step "
+                    "mechanisms and the fused window would feed drafted "
+                    "tokens back as committed inputs"
+                )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -359,6 +400,9 @@ def swa_ring_spec(
     chunk = max(
         min(_SWA_RING_CHUNK, sched.max_num_batched_tokens),
         sched.decode_window,
+        # Speculative verify writes 1 + k provisional positions per row
+        # per step; the ring's write-span invariant must cover them.
+        (1 + sched.spec_ngram_k) if sched.speculative_ngram else 1,
     )
     ring = math.ceil((wmax + chunk) / cache.page_size) + 1
     max_pages = cache.max_pages_per_seq(model.max_model_len)

@@ -33,6 +33,7 @@ from llmd_tpu.engine.runner import (
     PendingDecode,
     PendingPrefill,
     StagedDecode,
+    StagedVerify,
     StepResult,
 )
 from llmd_tpu.engine.scheduler import EngineScheduler, ScheduledBatch
@@ -205,6 +206,17 @@ class EngineStats:
     # (EOS / stop token / max-tokens landed after the next batch was
     # staged against the optimistic one-token-per-decode assumption).
     async_rollbacks_total: int = 0
+    # Speculative decoding (SchedulerConfig.speculative_ngram; the
+    # propose/verify/accept contract in
+    # docs/architecture/speculative-decoding.md): draft tokens proposed
+    # and accepted across all verify steps, their ratio, and the
+    # accepted-draft-length histogram — index j counts (spec row, step)
+    # pairs that accepted exactly j draft tokens, so mean emitted
+    # tokens/row/step reads as 1 + sum(j * hist[j]) / sum(hist).
+    spec_proposed_tokens_total: int = 0
+    spec_accepted_tokens_total: int = 0
+    spec_acceptance_rate: float = 0.0
+    spec_accepted_len_hist: tuple = ()
 
 
 @dataclass
@@ -415,6 +427,19 @@ class LLMEngine:
         # the pages immediately would hand them to another sequence while
         # the device still writes them — applied at the reconcile point.
         self._deferred_aborts: set[str] = set()
+
+        # Speculative decoding (SchedulerConfig.speculative_ngram):
+        # model-free n-gram drafting + one-pass verification. The
+        # proposer is host-only; drafts are proposed at DISPATCH time
+        # from committed history (async staging runs a step early), and
+        # acceptance/rollback live in the scheduler's update loop.
+        self._spec_proposer = None
+        if config.scheduler.speculative_ngram:
+            from llmd_tpu.engine.spec import NgramProposer
+
+            self._spec_proposer = NgramProposer(
+                min_match=config.scheduler.spec_ngram_min_match
+            )
 
     def _on_finish(self, req) -> None:
         if self.kv_connector is not None and self.kv_connector.wants_export(req):
@@ -735,9 +760,7 @@ class LLMEngine:
             for seq in batch.prefills:
                 self.stats.prompt_tokens += seq.num_tokens
         if batch.decodes:
-            pend_d = self.runner.dispatch_decode(
-                batch.decodes, k_steps=batch.decodes[0].num_tokens
-            )
+            pend_d = self._dispatch_decodes(batch.decodes)
         self.scheduler.note_dispatch(batch)
         t_dispatched = time.monotonic()
         # One coalesced readback for the whole step (prefill bucket
@@ -774,11 +797,16 @@ class LLMEngine:
             return []  # pipeline is one step deep: tokens land next call
         # ---- overlapped host region: the device is executing N ----
         staged = self.scheduler.schedule()  # speculative: pending counts
-        staged_dec: StagedDecode | None = None
+        staged_dec: StagedDecode | StagedVerify | None = None
         if staged.decodes:
-            staged_dec = self.runner.stage_decode(
-                staged.decodes, k_steps=staged.decodes[0].num_tokens
-            )
+            if self._spec_proposer is not None:
+                # Spec mode stages the verify shape; tokens/drafts/seeds
+                # fill at dispatch, after step N's readback commits.
+                staged_dec = self.runner.stage_spec_verify(staged.decodes)
+            else:
+                staged_dec = self.runner.stage_decode(
+                    staged.decodes, k_steps=staged.decodes[0].num_tokens
+                )
         # ---- block on step N's single coalesced readback ----
         pres, dres = self.runner.wait_step(
             inflight.pending_prefill, inflight.pending_decode
@@ -829,7 +857,9 @@ class LLMEngine:
         return outputs
 
     def _dispatch_async(
-        self, batch: ScheduledBatch, staged_dec: StagedDecode | None = None
+        self,
+        batch: ScheduledBatch,
+        staged_dec: StagedDecode | StagedVerify | None = None,
     ) -> None:
         now = time.monotonic()
         pend_p = None
@@ -839,13 +869,68 @@ class LLMEngine:
                 self.stats.prompt_tokens += seq.num_tokens
         pend_d = None
         if batch.decodes:
-            if staged_dec is None:
-                staged_dec = self.runner.stage_decode(
-                    batch.decodes, k_steps=batch.decodes[0].num_tokens
-                )
-            pend_d = self.runner.dispatch_staged_decode(staged_dec)
+            pend_d = self._dispatch_decodes(batch.decodes, staged_dec)
         self.scheduler.note_dispatch(batch)
         self._inflight = _InflightStep(batch, pend_p, pend_d, now)
+
+    def _dispatch_decodes(
+        self,
+        decodes: list,
+        staged: StagedDecode | StagedVerify | None = None,
+    ) -> PendingDecode:
+        """Dispatch the step's decode rows: the speculative verify path
+        when drafting is on and any row drafted, the plain decode
+        program otherwise. ``staged`` reuses host arrays prebuilt by the
+        async pipeline when they still match the dispatch shape."""
+        if self._spec_proposer is not None:
+            self._propose_drafts(decodes)
+            drafted = sum(1 for s in decodes if s.draft_tokens)
+            if drafted == len(decodes):
+                if not isinstance(staged, StagedVerify):
+                    staged = self.runner.stage_spec_verify(decodes)
+                return self.runner.dispatch_staged_verify(staged)
+            if drafted == 0:
+                # No row drafted anything this step: the plain one-token
+                # decode program (no wasted verify columns — the
+                # adversarial-traffic guard). The rows stay speculative
+                # (draft_tokens == []), so acceptance accounting and
+                # page truncation still run.
+                return self.runner.dispatch_decode(decodes, k_steps=1)
+            # Mixed step: drafting rows verify, the rest decode plainly
+            # (two enqueues, one coalesced readback). The async-staged
+            # verify arrays covered the full row set, so they can't be
+            # reused here.
+            return self.runner.dispatch_spec_split(decodes)
+        if not isinstance(staged, StagedDecode):
+            staged = self.runner.stage_decode(
+                decodes, k_steps=decodes[0].num_tokens
+            )
+        return self.runner.dispatch_staged_decode(staged)
+
+    def _propose_drafts(self, decodes: list) -> None:
+        """Fill each speculative decode row's draft from COMMITTED
+        history, at dispatch time — async staging runs a step early,
+        where the history is stale and the tail token unknown. The cap
+        of num_tokens - 1 (the scheduler's planned width) guarantees the
+        draft never writes a slot that wasn't allocated, even when a
+        short acceptance left the row behind its planned position."""
+        max_len = self.config.model.max_model_len
+        for seq in decodes:
+            req = seq.request
+            # num_tokens == 1 rows were planned draft-less (max_model_len
+            # cap or draft backoff, scheduler._spec_eligible) — no
+            # proposer call, no verify columns.
+            cap = min(
+                seq.num_tokens - 1, max_len - req.num_computed_tokens - 1
+            )
+            if cap <= 0:
+                seq.draft_tokens = []
+                continue
+            if req.spec_gram_state is None:
+                req.spec_gram_state = self._spec_proposer.new_state()
+            seq.draft_tokens = self._spec_proposer.propose(
+                req.all_token_ids, cap, req.spec_gram_state
+            )
 
     def _collect(
         self,
@@ -868,8 +953,14 @@ class LLMEngine:
                 logprobs[seq.request.request_id] = pres.logprobs[i].tolist()
         if batch.decodes and dres is not None:
             for i, seq in enumerate(batch.decodes):
-                sampled[seq.request.request_id] = dres.tokens[i].tolist()
-                logprobs[seq.request.request_id] = dres.logprobs[i].tolist()
+                toks, lps = dres.tokens[i], dres.logprobs[i]
+                if seq.draft_tokens is not None:
+                    # Speculative row: only 1 + draft_len columns are
+                    # real; the rest are the verify shape's padding.
+                    m = 1 + len(seq.draft_tokens)
+                    toks, lps = toks[:m], lps[:m]
+                sampled[seq.request.request_id] = toks.tolist()
+                logprobs[seq.request.request_id] = lps.tolist()
         return sampled, logprobs
 
     def _assemble_outputs(
@@ -932,6 +1023,14 @@ class LLMEngine:
                 self.stats.swa_section_captures = s["captures"]
         self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
         self.stats.preemptions = self.scheduler.num_preemptions
+        if self.scheduler.spec_k:
+            sch = self.scheduler
+            self.stats.spec_proposed_tokens_total = sch.spec_proposed_tokens
+            self.stats.spec_accepted_tokens_total = sch.spec_accepted_tokens
+            self.stats.spec_acceptance_rate = round(
+                sch.spec_accepted_tokens / max(1, sch.spec_proposed_tokens), 6
+            )
+            self.stats.spec_accepted_len_hist = tuple(sch.spec_accept_len_hist)
         if self.config.model.num_lora_adapters:
             self.stats.max_lora = self.config.model.num_lora_adapters
             self.stats.running_lora_adapters = tuple(

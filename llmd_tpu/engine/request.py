@@ -92,6 +92,24 @@ class Request:
     # Outputs generated before a recompute-preemption folded them into the
     # prompt; counts toward max_tokens and reported output length.
     num_prior_output_tokens: int = 0
+    # Speculative decoding accounting (SchedulerConfig.speculative_ngram):
+    # draft tokens proposed for / accepted by this request across its
+    # verify steps. Purely observational — acceptance itself lives in the
+    # scheduler's update loop.
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    # Draft backoff state: consecutive fully-rejected drafts. The
+    # scheduler gates drafting eligibility on this against a GLOBAL
+    # step clock (scheduler.spec_step), so backed-off rows retry on the
+    # same aligned steps instead of smearing one drafting row across
+    # every step — low-repetition traffic then runs almost every step as
+    # a plain decode. Never affects WHAT is emitted (acceptance is exact
+    # either way), only whether a draft is attempted — parity untouched.
+    spec_consec_rejected: int = 0
+    # Incremental n-gram index over all_token_ids (NgramProposer state;
+    # valid across preemption because recompute folds output into the
+    # prompt without changing the token sequence).
+    spec_gram_state: Any = None
     finish_reason: FinishReason | None = None
     first_token_time: float | None = None
     finish_time: float | None = None

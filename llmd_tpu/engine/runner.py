@@ -60,6 +60,7 @@ _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
 _OP_KV_GATHER, _OP_KV_SCATTER = 3, 4
 _OP_EMBED, _OP_LORA = 5, 6
 _OP_KV_COPY = 7
+_OP_VERIFY = 8  # speculative-decoding verify step ([B, 1+k] positions)
 
 log = logging.getLogger(__name__)
 
@@ -166,12 +167,32 @@ class PendingPrefill:
 
 @dataclass
 class PendingDecode:
-    """Dispatched-but-unread decode program: packed [B, 2K] device
-    output awaiting the step's coalesced readback."""
+    """Dispatched-but-unread decode-side programs of one engine step,
+    awaiting the coalesced readback: (packed [B, 2K] device output,
+    source row indices, K) per program. Plain steps carry ONE entry; a
+    speculative step may SPLIT its rows between the verify program
+    (rows that drafted) and the plain one-token decode program (the
+    rest), so low-repetition traffic pays verify columns only for rows
+    that actually drafted."""
 
-    packed: jax.Array
+    entries: list[tuple[jax.Array, list[int], int]]
     n: int
-    k: int
+    k: int  # widest K across entries == the StepResult window width
+
+
+@dataclass
+class StagedVerify:
+    """Host arrays for a speculative verify dispatch built AHEAD of the
+    tokens (and drafts) they depend on: page/ring tables and sampling
+    knobs are final at staging time; tokens, positions, qlens, kvlens
+    and seeds are filled by ``dispatch_staged_verify`` once the previous
+    step's readback has committed and the drafts are proposed."""
+
+    seqs: list[ScheduledSeq]
+    arrays: dict
+    B: int
+    q: int  # 1 + spec_ngram_k (the verify shape family's static Q)
+    all_greedy: bool
 
 
 @dataclass
@@ -257,6 +278,14 @@ class ModelRunner:
         )
         self._forward = self._build_forward()
         self._multi = self._build_multi()
+        # Speculative decoding (SchedulerConfig.speculative_ngram): the
+        # verify step scores [B, 1 + spec_ngram_k] positions per decode
+        # row in one forward — its own traced shape family (Q static per
+        # engine, B over the decode batch buckets).
+        self.spec_q = (
+            1 + sched.spec_ngram_k if sched.speculative_ngram else 0
+        )
+        self._verify = self._build_verify() if self.spec_q else None
 
     # ------------------------------------------------------------------ #
 
@@ -501,6 +530,68 @@ class ModelRunner:
             return kv_cache, kv_swa, replicate(packed)
 
         return fwd
+
+    def _build_verify(self):
+        """Speculative verify: the prefill forward over [B, 1+k] rows
+        (chunked-prefill/ragged-paged-attention path — no new kernel,
+        just a new traced shape family), sampling at EVERY position
+        instead of only the last. Row i feeds [last committed token,
+        draft_0..draft_{m-1}] with per-row draft-length masks
+        (query_lens); position j's sample is the target token for output
+        index j, computed under the draft's context. KV for all 1+k
+        positions is written provisionally — the scheduler truncates
+        past the accepted prefix before any page commit."""
+        cfg = self.cfg
+        world = self.ctx.world
+        mesh = self.ctx.mesh
+        kv_rep = self.kv_rep
+        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        ep_capacity = self.config.parallel.ep_capacity_factor
+        dbo = self.config.parallel.enable_dbo
+        replicate = self._replicate_out
+        ring = self.swa is not None
+
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(1, 2) if ring else (1,),
+            static_argnames=("all_greedy",),
+        )
+        def verify(params, kv_cache, kv_swa, inp: StepInput, s: SamplingInputs,
+                   all_greedy=False):
+            if ring:
+                hidden, kv_cache, kv_swa = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                    kv_swa=kv_swa,
+                )
+            else:
+                hidden, kv_cache = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                )
+            B, Q, H = hidden.shape
+            logits = llama.compute_logits(params, hidden.reshape(B * Q, H), cfg)
+            flat = SamplingInputs(
+                temperature=jnp.repeat(s.temperature, Q),
+                top_k=jnp.repeat(s.top_k, Q),
+                top_p=jnp.repeat(s.top_p, Q),
+                seeds=s.seeds.reshape(B * Q),
+            )
+            tokens, logprobs = sample_tokens(logits, flat, all_greedy)
+            # Same packed [B, 2Q] layout as the fused decode window, so
+            # wait_step's coalesced readback handles both identically.
+            packed = jnp.concatenate(
+                [
+                    tokens.reshape(B, Q).astype(jnp.float32),
+                    logprobs.reshape(B, Q),
+                ],
+                axis=1,
+            )
+            return kv_cache, kv_swa, replicate(packed)
+
+        return verify
 
     def _build_multi(self):
         cfg = self.cfg
@@ -773,23 +864,46 @@ class ModelRunner:
     # ------------------------------------------------------------------ #
     # host-side input prep
 
-    def _sampling_arrays(self, seqs: list[ScheduledSeq], B: int, K: int = 1):
+    @staticmethod
+    def _overwrite_seeded_rows(
+        seeds: np.ndarray, seqs: list[ScheduledSeq], K: int
+    ) -> None:
+        """Deterministic per (request seed, output index): resubmitting
+        the same seeded request reproduces its tokens regardless of
+        batch-mates or window size. The ONE definition every dispatch
+        path uses — prefill, fused decode windows, and the speculative
+        verify step all must derive identical seeds, or seeded
+        speculative acceptance silently loses its byte-parity guarantee.
+        """
+        for i, s in enumerate(seqs):
+            sp = s.request.sampling
+            if sp.seed is not None:
+                pos = s.request.total_output_tokens
+                for j in range(K):
+                    seeds[i, j] = np.uint32(
+                        (sp.seed * 1000003 + pos + j) & 0xFFFFFFFF
+                    )
+
+    @staticmethod
+    def _sampling_knobs(seqs: list[ScheduledSeq], B: int):
+        """(temp, top_k, top_p) rows for a dispatch — shared by every
+        path that stages sampling inputs. Seeds are deliberately NOT
+        here: they come from the stateful rng, which must advance in
+        dispatch order only (see stage_decode)."""
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
-        seeds = self._np_rng.integers(0, 2**32, size=(B, K), dtype=np.uint32)
         for i, s in enumerate(seqs):
             sp = s.request.sampling
             temp[i] = 0.0 if sp.greedy else sp.temperature
             top_k[i] = sp.top_k
             top_p[i] = sp.top_p
-            if sp.seed is not None:
-                # Deterministic per (request seed, output index): resubmitting
-                # the same seeded request reproduces its tokens regardless of
-                # batch-mates or window size.
-                pos = s.request.total_output_tokens
-                for j in range(K):
-                    seeds[i, j] = np.uint32((sp.seed * 1000003 + pos + j) & 0xFFFFFFFF)
+        return temp, top_k, top_p
+
+    def _sampling_arrays(self, seqs: list[ScheduledSeq], B: int, K: int = 1):
+        temp, top_k, top_p = self._sampling_knobs(seqs, B)
+        seeds = self._np_rng.integers(0, 2**32, size=(B, K), dtype=np.uint32)
+        self._overwrite_seeded_rows(seeds, seqs, K)
         return temp, top_k, top_p, seeds
 
     def _lora_array(self, seqs: list[ScheduledSeq], B: int) -> np.ndarray:
@@ -883,7 +997,7 @@ class ModelRunner:
             )
             return [("ids", (B,), np.int32), ("vals_u8", (nbytes,), np.uint8)]
         mp = self.max_pages
-        if op == _OP_PREFILL:
+        if op in (_OP_PREFILL, _OP_VERIFY):
             spec = [
                 ("tokens", (B, QK), np.int32),
                 ("positions", (B, QK), np.int32),
@@ -893,7 +1007,10 @@ class ModelRunner:
                 ("temp", (B,), np.float32),
                 ("top_k", (B,), np.int32),
                 ("top_p", (B,), np.float32),
-                ("seeds", (B,), np.uint32),
+                # Verify samples at every position, so its seeds are
+                # per (row, position) — the one payload difference from
+                # the prefill family.
+                ("seeds", (B, QK) if op == _OP_VERIFY else (B,), np.uint32),
             ]
         else:
             spec = [
@@ -957,6 +1074,8 @@ class ModelRunner:
             arrays = {name: arr for (name, _, _), arr in zip(spec, payload)}
             if op == _OP_PREFILL:
                 self._exec_prefill(arrays, bool(greedy))
+            elif op == _OP_VERIFY:
+                self._exec_verify(arrays, bool(greedy))
             elif op == _OP_KV_GATHER:
                 # Participate in the SPMD gather (the all-gather collective
                 # needs every process); the replicated result is dropped —
@@ -1010,6 +1129,33 @@ class ModelRunner:
             seeds=jnp.asarray(arrays["seeds"]),
         )
         self.kv_cache, self.kv_swa, packed = self._forward(
+            self.params, self.kv_cache, self.kv_swa, inp, s,
+            all_greedy=all_greedy,
+        )
+        return packed
+
+    def _exec_verify(self, arrays: dict, all_greedy: bool) -> jax.Array:
+        inp = StepInput(
+            token_ids=jnp.asarray(arrays["tokens"]),
+            positions=jnp.asarray(arrays["positions"]),
+            query_lens=jnp.asarray(arrays["qlens"]),
+            kv_lens=jnp.asarray(arrays["kvlens"]),
+            page_table=jnp.asarray(arrays["page_table"]),
+            lora_ids=(
+                jnp.asarray(arrays["lora"]) if "lora" in arrays else None
+            ),
+            swa_page_table=(
+                jnp.asarray(arrays["swa_table"])
+                if "swa_table" in arrays else None
+            ),
+        )
+        s = SamplingInputs(
+            temperature=jnp.asarray(arrays["temp"]),
+            top_k=jnp.asarray(arrays["top_k"]),
+            top_p=jnp.asarray(arrays["top_p"]),
+            seeds=jnp.asarray(arrays["seeds"]),
+        )
+        self.kv_cache, self.kv_swa, packed = self._verify(
             self.params, self.kv_cache, self.kv_swa, inp, s,
             all_greedy=all_greedy,
         )
@@ -1472,14 +1618,7 @@ class ModelRunner:
         # a step early and re-runs on a rollback restage) would shift
         # the draw stream relative to a synchronous engine and break
         # unseeded-sampling parity.
-        temp = np.zeros(B, np.float32)
-        top_k = np.zeros(B, np.int32)
-        top_p = np.ones(B, np.float32)
-        for i, s in enumerate(seqs):
-            sp = s.request.sampling
-            temp[i] = 0.0 if sp.greedy else sp.temperature
-            top_k[i] = sp.top_k
-            top_p[i] = sp.top_p
+        temp, top_k, top_p = self._sampling_knobs(seqs, B)
         arrays = {
             "first": np.zeros(B, np.int32), "start": np.zeros(B, np.int32),
             "page_table": self._page_table(seqs, B), "active": active,
@@ -1513,20 +1652,107 @@ class ModelRunner:
             req = s.request
             first[i] = req.all_token_ids[req.num_computed_tokens]
             start[i] = req.num_computed_tokens
-            sp = req.sampling
-            if sp.seed is not None:
-                pos = req.total_output_tokens
-                for j in range(staged.k):
-                    seeds[i, j] = np.uint32(
-                        (sp.seed * 1000003 + pos + j) & 0xFFFFFFFF
-                    )
+        self._overwrite_seeded_rows(seeds, staged.seqs, staged.k)
         with self._dispatch_lock:
             arrays = self._sync(
                 _OP_DECODE, staged.B, staged.k, staged.all_greedy,
                 staged.arrays,
             )
             packed = self._exec_decode(arrays, staged.k, staged.all_greedy)
-        return PendingDecode(packed, len(staged.seqs), staged.k)
+        n = len(staged.seqs)
+        return PendingDecode([(packed, list(range(n)), staged.k)], n, staged.k)
+
+    def stage_spec_verify(self, seqs: list[ScheduledSeq]) -> StagedVerify:
+        """Build the verify dispatch's host arrays AHEAD of the previous
+        step's readback (async stepping). The page/ring tables are final
+        here — the scheduler already allocated pages for the
+        max-acceptance position of every row; tokens/positions/qlens/
+        kvlens (which depend on the committed position and the drafts
+        proposed from committed history) and seeds are filled at
+        dispatch."""
+        n = len(seqs)
+        # Prefill-style row buckets (powers of two from 1): a mixed step
+        # verifies only its drafting rows, often just one or two — padding
+        # those up to the decode batch buckets (from 8) would waste more
+        # verify columns than the drafts save.
+        B = pad_to_bucket(n, self.prefill_batch_buckets)
+        Q = self.spec_q
+        temp, top_k, top_p = self._sampling_knobs(seqs, B)
+        arrays = {
+            "tokens": np.zeros((B, Q), np.int32),
+            "positions": np.zeros((B, Q), np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "page_table": self._page_table(seqs, B),
+            "temp": temp, "top_k": top_k, "top_p": top_p,
+            "seeds": np.zeros((B, Q), np.uint32),
+        }
+        if self.swa is not None:
+            arrays["swa_table"] = self._swa_table(seqs, B)
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = self._lora_array(seqs, B)
+        all_greedy = all(s.request.sampling.greedy for s in seqs)
+        return StagedVerify(list(seqs), arrays, B, Q, all_greedy)
+
+    def dispatch_staged_verify(self, staged: StagedVerify) -> PendingDecode:
+        """Fill the readback/draft-dependent slots of a staged verify and
+        enqueue it. Each row feeds [next input token, draft...]; pad
+        positions repeat the last real position and are masked from KV
+        writes by query_lens (the prefill convention), so a short draft
+        can never deposit KV past its own columns."""
+        tokens = staged.arrays["tokens"]
+        positions = staged.arrays["positions"]
+        qlens = staged.arrays["qlens"]
+        kvlens = staged.arrays["kvlens"]
+        # ONE [B, Q] rng block per verify dispatch, drawn in dispatch
+        # order (see dispatch_staged_decode's seed-parity note); seeded
+        # rows overwrite theirs with the shared per-(request seed,
+        # output-index) derivation, which is what makes seeded
+        # acceptance exact.
+        seeds = self._np_rng.integers(
+            0, 2**32, size=(staged.B, staged.q), dtype=np.uint32
+        )
+        staged.arrays["seeds"] = seeds
+        for i, s in enumerate(staged.seqs):
+            req = s.request
+            nc = req.num_computed_tokens
+            draft = s.draft_tokens or []
+            m = 1 + len(draft)
+            tokens[i, :m] = [req.all_token_ids[nc], *draft]
+            tokens[i, m:] = 0
+            positions[i, :m] = np.arange(nc, nc + m)
+            positions[i, m:] = nc + m - 1
+            qlens[i] = m
+            kvlens[i] = nc + m
+        self._overwrite_seeded_rows(seeds, staged.seqs, staged.q)
+        with self._dispatch_lock:
+            arrays = self._sync(
+                _OP_VERIFY, staged.B, staged.q, staged.all_greedy,
+                staged.arrays,
+            )
+            packed = self._exec_verify(arrays, staged.all_greedy)
+        n = len(staged.seqs)
+        return PendingDecode([(packed, list(range(n)), staged.q)], n, staged.q)
+
+    def dispatch_spec_split(self, seqs: list[ScheduledSeq]) -> PendingDecode:
+        """Mixed speculative step: rows that drafted ride the verify
+        program, the rest ride the plain one-token decode program — two
+        enqueues, still ONE coalesced readback (both packed outputs join
+        wait_step's single transfer). Keeps non-drafting rows from
+        paying 1 + k verify columns for nothing."""
+        drafted = [i for i, s in enumerate(seqs) if s.draft_tokens]
+        plain = [i for i, s in enumerate(seqs) if not s.draft_tokens]
+        entries: list[tuple[jax.Array, list[int], int]] = []
+        pv = self.dispatch_staged_verify(
+            self.stage_spec_verify([seqs[i] for i in drafted])
+        )
+        entries.append((pv.entries[0][0], drafted, self.spec_q))
+        if plain:
+            pd = self.dispatch_staged_decode(
+                self.stage_decode([seqs[i] for i in plain], k_steps=1)
+            )
+            entries.append((pd.entries[0][0], plain, 1))
+        return PendingDecode(entries, len(seqs), self.spec_q)
 
     def wait_step(
         self,
@@ -1541,7 +1767,7 @@ class ModelRunner:
         if prefill is not None:
             packs.extend(p for p, _ in prefill.entries)
         if decode is not None:
-            packs.append(decode.packed)
+            packs.extend(p for p, _, _ in decode.entries)
         if not packs:
             return None, None
         if dist.is_multihost():
@@ -1549,6 +1775,7 @@ class ModelRunner:
         else:
             hosts = [np.asarray(a) for a in jax.device_get(packs)]
         pres = dres = None
+        base = 0
         if prefill is not None:
             tokens = np.zeros((prefill.n, 1), np.int32)
             logprobs = np.zeros((prefill.n, 1), np.float32)
@@ -1558,12 +1785,24 @@ class ModelRunner:
                     tokens[i] = arr[row, :1].astype(np.int32)
                     logprobs[i] = arr[row, 1:2]
             pres = StepResult(tokens, logprobs)
+            base = len(prefill.entries)
         if decode is not None:
-            arr = hosts[-1]
-            dres = StepResult(
-                arr[: decode.n, : decode.k].astype(np.int32),
-                arr[: decode.n, decode.k :].astype(np.float32),
-            )
+            K = decode.k
+            tokens = np.zeros((decode.n, K), np.int32)
+            logprobs = np.zeros((decode.n, K), np.float32)
+            for gi, (_, idxs, k) in enumerate(decode.entries):
+                arr = hosts[base + gi]
+                m = len(idxs)
+                if idxs == list(range(decode.n)):
+                    # Single whole-batch entry (the common, spec-off
+                    # case): one vectorized block copy.
+                    tokens[:, :k] = arr[:m, :k].astype(np.int32)
+                    logprobs[:, :k] = arr[:m, k : 2 * k]
+                else:
+                    rows = np.asarray(idxs, np.int64)
+                    tokens[rows, :k] = arr[:m, :k].astype(np.int32)
+                    logprobs[rows, :k] = arr[:m, k : 2 * k]
+            dres = StepResult(tokens, logprobs)
         return pres, dres
 
     # ------------------------------------------------------------------ #
@@ -1601,6 +1840,14 @@ class ModelRunner:
             for greedy in (True, False):
                 self._warm_decode(B, K, greedy)
                 count += 1
+        if self.spec_q:
+            # The speculative verify family: one Q (= 1 + spec_ngram_k)
+            # at the largest row bucket plus the lone-row shape (mixed
+            # steps often verify a single drafting row).
+            for B in {1, self.prefill_batch_buckets[-1]}:
+                for greedy in (True, False):
+                    self._warm_verify(B, greedy)
+                    count += 1
         return count
 
     def _warm_prefill(self, B: int, Q: int, all_greedy: bool = False) -> None:
@@ -1622,6 +1869,27 @@ class ModelRunner:
         with self._dispatch_lock:
             arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
             self._exec_prefill(arrays, all_greedy)
+
+    def _warm_verify(self, B: int, all_greedy: bool = False) -> None:
+        Q = self.spec_q
+        arrays = {
+            "tokens": np.zeros((B, Q), np.int32),
+            "positions": np.zeros((B, Q), np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "page_table": np.zeros((B, self.max_pages), np.int32),
+            "temp": np.zeros(B, np.float32),
+            "top_k": np.zeros(B, np.int32),
+            "top_p": np.ones(B, np.float32),
+            "seeds": np.zeros((B, Q), np.uint32),
+        }
+        if self.swa is not None:
+            arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = np.zeros(B, np.int32)
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_VERIFY, B, Q, all_greedy, arrays)
+            self._exec_verify(arrays, all_greedy)
 
     def _warm_decode(self, B: int, K: int, all_greedy: bool = False) -> None:
         arrays = {

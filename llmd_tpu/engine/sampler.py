@@ -3,6 +3,10 @@
 One jit-traced function over the whole batch; per-request knobs arrive as
 arrays so one compiled program serves any mix of greedy and sampled
 sequences (no recompilation per sampling config).
+
+Also hosts the speculative-decoding acceptance rule
+(``accept_draft_tokens``): the host-side half of the verify step that
+turns per-position target samples plus a draft into the emitted window.
 """
 
 from __future__ import annotations
@@ -66,3 +70,34 @@ def sample_tokens(
     logp = jax.nn.log_softmax(logits, axis=-1)
     chosen_logp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
     return tokens.astype(jnp.int32), chosen_logp
+
+
+def accept_draft_tokens(
+    draft: list[int], sampled: list[int]
+) -> tuple[list[int], int]:
+    """Speculative-decoding acceptance: longest draft prefix consistent
+    with the target distribution.
+
+    ``sampled[j]`` is the token the TARGET model samples at drafted
+    position j (greedy argmax, or the per-(seed, output-index) PRNG draw
+    for seeded rows) — computed in one verify pass whose position-j
+    context is ``draft[:j]``. That context is valid exactly while every
+    prior draft token matched its target sample, so the emitted window is
+    ``sampled[0 .. m]`` where m is the first mismatch (the target's
+    correction token lands for free at the mismatch position, and the
+    bonus sample at the end when the whole draft holds). Every emitted
+    token IS a target sample under a correct context, which is why
+    speculative streams are byte-identical to non-speculative ones for
+    greedy and seeded rows (Leviathan et al. 2023 specialized to
+    deterministic per-position sampling).
+
+    Returns (emitted window, number of draft tokens accepted).
+    """
+    emitted: list[int] = []
+    accepted = 0
+    for j, tok in enumerate(sampled):
+        emitted.append(int(tok))
+        if j >= len(draft) or draft[j] != tok:
+            break
+        accepted += 1
+    return emitted, accepted

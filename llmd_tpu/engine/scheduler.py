@@ -27,12 +27,20 @@ from llmd_tpu.engine.kv_cache import (
     hash_page,
 )
 from llmd_tpu.engine.request import FinishReason, Request, RequestStatus
+from llmd_tpu.engine.sampler import accept_draft_tokens
 
 
 @dataclasses.dataclass
 class ScheduledSeq:
     request: Request
     num_tokens: int  # tokens to compute for this seq in this step
+    # Speculative decoding: None for non-speculative rows; a (possibly
+    # empty) draft for spec decode rows. The scheduler PLANS with the
+    # max-acceptance count (num_tokens = 1 + spec_ngram_k, pages
+    # included) and the engine fills the actual draft at dispatch time
+    # from committed history — which is what lets async staging reuse
+    # its existing speculate/rollback machinery unchanged.
+    draft_tokens: list[int] | None = None
 
     @property
     def start_pos(self) -> int:
@@ -104,6 +112,25 @@ class EngineScheduler:
         # (their pages would be freed under the device's feet). Sync
         # engines leave this empty.
         self.protected: set[str] = set()
+        # Speculative decoding (SchedulerConfig.speculative_ngram):
+        # decode rows are planned at the max-acceptance token count
+        # (1 + spec_k) and the accepted prefix is resolved per row at
+        # update_after_step; the counters feed EngineStats / the bench.
+        self.spec_k = (
+            scheduler_config.spec_ngram_k
+            if scheduler_config.speculative_ngram else 0
+        )
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        # Accepted-draft-length histogram over spec decode rows: index j
+        # counts (row, step) pairs that accepted exactly j draft tokens.
+        self.spec_accept_len_hist = [0] * (self.spec_k + 1)
+        # Global draft-backoff clock: rows whose last drafts were fully
+        # rejected retry only on steps aligned to a power-of-two of this
+        # counter, so retries CLUSTER on the same steps (one mixed
+        # verify+decode step per retry wave) instead of every step
+        # paying the mixed-dispatch cost for one stray drafting row.
+        self.spec_step = 0
 
     # ------------------------------------------------------------------ #
     # queue management
@@ -184,6 +211,9 @@ class EngineScheduler:
                 ),
             )
 
+        if self.spec_k and decoding:
+            self.spec_step += 1
+
         # 1. Decodes claim pages FIRST: a running decode must never be
         #    starved by prefill admission taking the last free pages.
         for req in decoding:
@@ -194,16 +224,42 @@ class EngineScheduler:
                 continue  # reset by a preemption earlier in this loop
             if budget <= 0:
                 break
-            if not self._ensure_pages(req, k):
+            if self.spec_k:
+                # Speculative rows plan (budget, pages, pending counts)
+                # at the MAX-acceptance count; the actual draft — capped
+                # at num_tokens - 1 — is proposed at dispatch, so the
+                # planned slots always cover its provisional KV writes.
+                # Backed-off rows (consecutive full rejections) plan as
+                # plain 1-token rows until their aligned retry step.
+                k_row = 1
+                if self._spec_eligible(req):
+                    k_row += max(
+                        0,
+                        min(
+                            self.spec_k,
+                            self.max_model_len
+                            - req.num_dispatched_tokens - 1,
+                        ),
+                    )
+            else:
+                k_row = k
+            if not self._ensure_pages(req, k_row):
                 # Never evict a sequence already placed in this step's batch:
                 # its pages would be freed while the runner still writes them.
                 if not self._preempt_for(req, exclude=scheduled):
                     continue
-                if not self._ensure_pages(req, k):
+                if not self._ensure_pages(req, k_row):
                     continue
-            decodes.append(ScheduledSeq(req, k))
+            decodes.append(
+                ScheduledSeq(
+                    req, k_row, draft_tokens=[] if self.spec_k else None
+                )
+            )
             scheduled.add(req.request_id)
-            budget -= 1
+            # Drafted positions are real batch compute (the verify step
+            # scores 1 + draft tokens for the row), so speculative rows
+            # charge their planned width; plain decodes stay at 1.
+            budget -= k_row if self.spec_k else 1
 
         # 2. Continue chunked prefills of already-running sequences.
         for req in mid_prefill:
@@ -466,6 +522,31 @@ class EngineScheduler:
             req = seq.request
             self._commit_pending(seq)
             window = sampled[req.request_id]
+            if seq.draft_tokens:
+                # Speculative row: resolve the accepted prefix first
+                # (sampler.accept_draft_tokens), then run the emitted
+                # window through the SAME stop-check loop as a fused
+                # decode window — tokens past a stop (or past the first
+                # draft mismatch) are discarded and their provisional KV
+                # never counts as computed.
+                window, n_acc = accept_draft_tokens(seq.draft_tokens, window)
+                self.spec_proposed_tokens += len(seq.draft_tokens)
+                self.spec_accepted_tokens += n_acc
+                self.spec_accept_len_hist[n_acc] += 1
+                req.spec_drafted_tokens += len(seq.draft_tokens)
+                req.spec_accepted_tokens += n_acc
+                # Draft backoff: a fully-rejected draft suggests the
+                # n-gram matches are spurious (low-repetition output) —
+                # exponentially sparser aligned retries (_spec_eligible)
+                # cap the wasted verify columns.
+                if n_acc == 0:
+                    req.spec_consec_rejected += 1
+                else:
+                    req.spec_consec_rejected = 0
+            elif seq.draft_tokens is not None:
+                # Spec row that drafted nothing: one plain token, no
+                # provisional writes (and so nothing to truncate below).
+                self.spec_accept_len_hist[0] += 1
             acc: list[int] = []
             reason = None
             for token in window:
@@ -480,7 +561,47 @@ class EngineScheduler:
                 self._finish(req, reason)
             else:
                 self._commit_full_pages(req)
+                if seq.draft_tokens:
+                    # Only drafting rows made provisional KV writes;
+                    # draft-less rows hold at most one page of planned
+                    # headroom, which the next step reuses.
+                    self._truncate_spec_pages(req)
         return accepted
+
+    def _spec_eligible(self, req: Request) -> bool:
+        """Draft-backoff gate: after c consecutive fully-rejected drafts
+        a row retries only on steps where the global clock is a multiple
+        of 2^min(c+1, 8). The shared clock ALIGNS retries across rows —
+        low-repetition traffic converges to plain decode steps with one
+        clustered retry wave every few hundred steps, instead of every
+        step paying a mixed verify dispatch for one stray row. A single
+        accepted token resets the row to drafting every step."""
+        c = req.spec_consec_rejected
+        return c == 0 or self.spec_step % (1 << min(c + 1, 8)) == 0
+
+    def _truncate_spec_pages(self, req: Request) -> None:
+        """Return the pages a speculative row claimed past its accepted
+        prefix (the partial-rollback half of the propose/verify/accept
+        contract): rejected draft tokens' provisional KV writes sit in
+        slots >= num_computed_tokens, which by construction are never
+        committed (``_commit_full_pages`` stops at the computed-token
+        page floor) — freeing the trailing pages BEFORE any commit_page
+        call makes it structurally impossible for rejected content to
+        enter the prefix-cache hash chain.
+
+        Async engines keep the slots a staged-but-undispatched next
+        batch may already be planned against (its verify writes reach at
+        most num_dispatched + 1 + spec_k); sync engines have nothing in
+        flight here and keep exactly the computed span — the next
+        schedule's _ensure_pages re-extends as needed."""
+        page = self.allocator.page_size
+        slots = req.num_computed_tokens
+        if self.config.async_scheduling:
+            slots = req.num_dispatched_tokens + 1 + self.spec_k
+        keep = -(-slots // page)
+        if keep < len(req.block_ids):
+            self.allocator.free(req.block_ids[keep:])
+            del req.block_ids[keep:]
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
         # Commit computed full pages before release: the KV is valid, so

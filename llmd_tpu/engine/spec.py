@@ -1,0 +1,91 @@
+"""Model-free draft proposal for speculative decoding.
+
+Prompt-lookup / n-gram drafting (Saxena, "Prompt Lookup Decoding", 2023):
+the draft for a sequence comes from the sequence's OWN token history —
+match the trailing n-gram of prompt+output against earlier positions and
+propose the tokens that followed a match. No draft model, no extra
+weights, no device work: exactly the free lunch for the
+RAG/agentic/summarization workloads the llm-d reference stack routes,
+where outputs quote their inputs (and greedy decode loops quote
+themselves).
+
+Cost discipline: the proposer runs per decode row per engine step, so it
+must be O(new tokens) there, not O(history). Each request carries an
+incremental index of its min_match-gram end positions (history is
+append-only — preemption folds output into the prompt without changing
+the token sequence, so the index never invalidates); a proposal is one
+dict lookup plus a short scoring scan of the most recent candidates.
+Verification (ModelRunner's verify step) and acceptance (scheduler +
+sampler.accept_draft_tokens) own correctness; a bad draft costs only the
+wasted verify columns, never a wrong token.
+"""
+
+from __future__ import annotations
+
+
+class NgramProposer:
+    """Drafts up to ``k`` tokens by suffix n-gram lookup over the
+    sequence's own token history.
+
+    Candidates are every earlier end position of the trailing
+    ``min_match``-gram (a longer suffix match always contains a trailing
+    min_match match at the same end position, so the index misses
+    nothing). They are scored by backward extension length — a longer
+    matched context is likelier to predict the true continuation, which
+    is what acceptance length, the whole win, depends on — with full-k
+    continuations and recency as tiebreaks (a run of repeats always has
+    a near-tail match whose continuation is one token; the full window
+    behind it is the one that tracks the cycle).
+    """
+
+    # Candidate cap per proposal: periodic histories match at EVERY
+    # period offset; scoring the most recent few is enough (and keeps
+    # the host cost flat however long the sequence grows).
+    _MAX_CANDIDATES = 32
+
+    def __init__(self, min_match: int = 2, max_match: int = 8) -> None:
+        if min_match < 1:
+            raise ValueError(f"min_match={min_match} must be >= 1")
+        self.min_match = min_match
+        self.max_match = max(max_match, min_match)
+
+    @staticmethod
+    def new_state() -> dict:
+        """Fresh per-request index (held on Request.spec_gram_state):
+        {gram tuple -> [end positions]} plus the indexed-up-to mark."""
+        return {"idx": {}, "upto": 0}
+
+    def propose(self, tokens: list[int], k: int, state: dict | None = None) -> list[int]:
+        """Draft up to ``k`` continuation tokens for ``tokens`` (the full
+        committed prompt+output history). Returns [] when the trailing
+        min_match-gram never occurred earlier — drafting nothing is
+        free; drafting wrongly costs a verify column."""
+        n = len(tokens)
+        mm = self.min_match
+        if k <= 0 or n < mm + 1:
+            return []
+        if state is None:
+            state = self.new_state()
+        idx = state["idx"]
+        # Index the gram ENDING at each new position (end == n excluded:
+        # that is the suffix itself; it becomes a real candidate once
+        # later tokens append past it).
+        for e in range(max(state["upto"], mm), n):
+            idx.setdefault(tuple(tokens[e - mm : e]), []).append(e)
+        state["upto"] = n
+        ends = idx.get(tuple(tokens[n - mm :]))
+        if not ends:
+            return []
+        best_end, best_score = -1, None
+        for e in reversed(ends[-self._MAX_CANDIDATES :]):
+            ext = mm
+            while (
+                ext < self.max_match
+                and e > ext
+                and tokens[e - ext - 1] == tokens[n - ext - 1]
+            ):
+                ext += 1
+            score = (ext, e + k <= n)
+            if best_score is None or score > best_score:
+                best_score, best_end = score, e
+        return list(tokens[best_end : best_end + k])

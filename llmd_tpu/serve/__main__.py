@@ -102,6 +102,9 @@ def make_engine_config(args, lora_adapters=None):
             max_num_batched_tokens=args.max_num_batched_tokens,
             decode_window=args.decode_window,
             async_scheduling=args.async_scheduling,
+            speculative_ngram=args.speculative_ngram,
+            spec_ngram_k=args.spec_ngram_k,
+            spec_ngram_min_match=args.spec_ngram_min_match,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -185,6 +188,24 @@ def build_parser() -> argparse.ArgumentParser:
              "staged while the current one runs; tokens stream one step "
              "late. Auto-disabled for multi-host lockstep engines and "
              "P/D producers (docs/architecture/async-scheduling.md)",
+    )
+    p.add_argument(
+        "--speculative-ngram", action="store_true",
+        help="model-free speculative decoding: n-gram prompt-lookup "
+             "drafting verified in one [B, 1+k] pass. Token streams stay "
+             "byte-identical to the non-speculative engine for greedy "
+             "and seeded sampling "
+             "(docs/architecture/speculative-decoding.md)",
+    )
+    p.add_argument(
+        "--spec-ngram-k", type=int, default=4,
+        help="max draft tokens per sequence per step (the k in the "
+             "[B, 1+k] verify shape family)",
+    )
+    p.add_argument(
+        "--spec-ngram-min-match", type=int, default=2,
+        help="minimum trailing n-gram length that must recur in the "
+             "sequence's own history before a draft is proposed",
     )
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)

@@ -84,6 +84,46 @@ def render_metrics(
             f'available_lora_adapters="{available}",'
             f'model_name="{model_name}"}} 1'
         )
+    if stats.spec_accepted_len_hist:
+        # Speculative decoding (propose/verify/accept contract,
+        # docs/architecture/speculative-decoding.md + observability.md).
+        # llmd-family ONLY: these names are this engine's, not vLLM's
+        # (vLLM's spec-decode metrics are named differently), so they
+        # must not masquerade in the vllm: namespace a stock dashboard
+        # keys on.
+        lines.append("# TYPE llmd:spec_acceptance_rate gauge")
+        lines.append(
+            f"llmd:spec_acceptance_rate{label} "
+            f"{round(stats.spec_acceptance_rate, 6)}"
+        )
+        for name, v in (
+            ("spec_proposed_tokens_total", stats.spec_proposed_tokens_total),
+            ("spec_accepted_tokens_total", stats.spec_accepted_tokens_total),
+        ):
+            lines.append(f"# TYPE llmd:{name} counter")
+            lines.append(f"llmd:{name}{label} {v}")
+        # Per-step accepted-draft-length histogram (Prometheus histogram
+        # text form; one bucket per accepted length 0..k).
+        hist = stats.spec_accepted_len_hist
+        lines.append("# TYPE llmd:spec_accepted_len histogram")
+        cum = 0
+        for ln, cnt in enumerate(hist):
+            cum += cnt
+            lines.append(
+                f'llmd:spec_accepted_len_bucket{{le="{ln}",'
+                f'model_name="{model_name}"}} {cum}'
+            )
+        lines.append(
+            f'llmd:spec_accepted_len_bucket{{le="+Inf",'
+            f'model_name="{model_name}"}} {cum}'
+        )
+        total = sum(j * c for j, c in enumerate(hist))
+        lines.append(
+            f'llmd:spec_accepted_len_sum{{model_name="{model_name}"}} {total}'
+        )
+        lines.append(
+            f'llmd:spec_accepted_len_count{{model_name="{model_name}"}} {cum}'
+        )
     for family in ("vllm", "llmd"):
         for name, v in gauges.items():
             lines.append(f"# TYPE {family}:{name} gauge")

@@ -1,0 +1,360 @@
+"""Speculative decoding (SchedulerConfig.speculative_ngram) tests.
+
+The contract (docs/architecture/speculative-decoding.md): n-gram
+prompt-lookup drafting + one-pass verification may change how many
+tokens a step emits, never WHICH tokens — greedy and seeded streams are
+byte-identical to the non-speculative engine, across chunked prefill,
+preemption/recompute, prefix-cache hits, and async stepping. Rejected
+draft tokens' provisional KV writes are truncated before any page
+commit, so rejected content can never enter the prefix-cache hash chain
+(asserted here by walking the allocator's content index).
+"""
+
+import numpy as np
+import pytest
+
+from llmd_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams
+from llmd_tpu.engine.kv_cache import page_hashes_for_tokens
+from llmd_tpu.engine.sampler import accept_draft_tokens
+from llmd_tpu.engine.spec import NgramProposer
+
+
+def make_engine(
+    spec=False, async_mode=False, num_blocks=64, page=4, max_batched=64,
+    max_seqs=8, seed=0, k=4, min_match=2, prefix_caching=True, **model_kw,
+) -> LLMEngine:
+    cfg = EngineConfig(
+        model=tiny_model_config(**model_kw),
+        cache=CacheConfig(
+            page_size=page, num_blocks=num_blocks, dtype="float32",
+            enable_prefix_caching=prefix_caching,
+        ),
+        scheduler=SchedulerConfig(
+            max_num_seqs=max_seqs, max_num_batched_tokens=max_batched,
+            async_scheduling=async_mode, speculative_ngram=spec,
+            spec_ngram_k=k, spec_ngram_min_match=min_match,
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=seed,
+    )
+    return LLMEngine(cfg)
+
+
+# Periodic prompts drive the tiny model's greedy output into loops the
+# n-gram proposer latches onto — drafts genuinely fire AND genuinely
+# reject (the loop onset mispredicts), exercising both acceptance paths.
+PROMPTS = [
+    [1, 5, 9, 13] * 3,
+    [3, 3, 7, 1, 3, 3, 7, 1],
+    [1, 5, 9, 13, 2, 8, 4, 4, 4, 4, 6, 6, 6, 6, 11],
+]
+
+
+# --------------------------------------------------------------------- #
+# proposer unit behavior
+
+
+def test_proposer_drafts_periodic_continuation():
+    p = NgramProposer(min_match=2)
+    #       0  1  2  3  4  5  6  7
+    toks = [7, 8, 9, 7, 8, 9, 7, 8]
+    # suffix [7, 8] matched; the cycle continues with 9, 7, ...
+    assert p.propose(toks, 3) == [9, 7, 8]
+
+
+def test_proposer_no_match_returns_empty():
+    p = NgramProposer(min_match=2)
+    assert p.propose([1, 2, 3, 4, 5, 6], 4) == []
+    assert p.propose([1, 2], 4) == []  # too short
+    assert p.propose([7, 8, 9, 7, 8], 0) == []  # k == 0
+
+
+def test_proposer_prefers_longer_match_context():
+    p = NgramProposer(min_match=2)
+    # suffix ...[5, 1, 2]: both [1, 2] sites match at min length, but the
+    # site with the longer backward context ([5, 1, 2] at index 6..8)
+    # must win over the shorter one ([9, 1, 2] at 0..2).
+    toks = [9, 1, 2, 7, 7, 7, 5, 1, 2, 4, 4, 4, 5, 1, 2]
+    assert p.propose(toks, 2) == [4, 4]
+
+
+def test_proposer_incremental_state_matches_stateless():
+    p = NgramProposer(min_match=2)
+    rng = np.random.default_rng(0)
+    toks = list(rng.integers(0, 4, size=40))
+    st = p.new_state()
+    for n in range(3, len(toks) + 1):
+        assert p.propose(toks[:n], 3, st) == p.propose(toks[:n], 3)
+
+
+def test_accept_draft_tokens_rule():
+    # full acceptance: every draft token matched + the bonus sample
+    assert accept_draft_tokens([5, 6], [5, 6, 7]) == ([5, 6, 7], 2)
+    # first mismatch: the target's correction token ends the window
+    assert accept_draft_tokens([5, 6], [5, 9, 7]) == ([5, 9], 1)
+    assert accept_draft_tokens([5, 6], [4, 6, 7]) == ([4], 0)
+    # no draft: plain single sample
+    assert accept_draft_tokens([], [3]) == ([3], 0)
+
+
+# --------------------------------------------------------------------- #
+# parity: spec on == spec off, byte for byte
+
+
+def test_spec_parity_greedy():
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    base = make_engine(False).generate(PROMPTS, sp)
+    eng = make_engine(True)
+    spec = eng.generate(PROMPTS, sp)
+    assert list(base.values()) == list(spec.values())
+    # speculation actually engaged (drafts proposed and some accepted)
+    assert eng.scheduler.spec_proposed_tokens > 0
+    assert eng.scheduler.spec_accepted_tokens > 0
+    assert eng.allocator.usage() == 0.0
+
+
+def test_spec_parity_seeded_sampling():
+    """Seeded rows accept via the per-(seed, output-index) PRNG
+    derivation. Low temperature keeps the seeded output loop-prone so
+    drafts genuinely fire AND at least one accepts (hot sampling over a
+    256-vocab is incompressible — the proposer would simply never
+    match); the high-temperature case rides test_spec_parity_async's
+    seeded leg."""
+    sp = SamplingParams(temperature=0.3, max_tokens=16, seed=77, ignore_eos=True)
+    base = make_engine(False, seed=3).generate(PROMPTS, sp)
+    eng = make_engine(True, seed=3)
+    spec = eng.generate(PROMPTS, sp)
+    assert list(base.values()) == list(spec.values())
+    assert eng.scheduler.spec_proposed_tokens > 0
+    assert eng.scheduler.spec_accepted_tokens > 0
+
+
+def test_spec_parity_chunked_prefill_and_preemption():
+    """Tight pool + long periodic prompt: chunked prefill across steps
+    and recompute-preemption under page pressure, with drafts in
+    flight."""
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(0, 8, size=6)) * 8,  # 48 tokens, chunked
+        [5, 6, 7, 8] * 3,
+        [9, 1, 9, 1, 9, 1],
+        [2, 4, 2, 4, 2, 4, 2, 4],
+    ]
+    params = [
+        SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+        SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
+        SamplingParams(temperature=0.0, max_tokens=9, ignore_eos=True),
+        SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True),
+    ]
+    kw = dict(num_blocks=16, max_batched=16)  # tight pool -> preemption
+    base_eng = make_engine(False, **kw)
+    base = base_eng.generate([list(p) for p in prompts], params)
+    eng = make_engine(True, **kw)
+    spec = eng.generate([list(p) for p in prompts], params)
+    assert list(base.values()) == list(spec.values())
+    assert eng.allocator.usage() == 0.0
+
+
+def test_spec_parity_prefix_cache_hit():
+    """A repeated prompt admits from the prefix cache (fewer prefill
+    steps, decode starts mid-page) and must still stream identically."""
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    base_eng, eng = make_engine(False), make_engine(True)
+    first_b = base_eng.generate([PROMPTS[0]], sp)
+    first_s = eng.generate([PROMPTS[0]], sp)
+    assert list(first_b.values()) == list(first_s.values())
+    # second pass: prefix-cache hit on the prompt's full pages
+    second_b = base_eng.generate([PROMPTS[0]], sp)
+    second_s = eng.generate([PROMPTS[0]], sp)
+    assert list(second_b.values()) == list(second_s.values())
+    assert eng.allocator.metrics_hits > 0  # the hit actually happened
+
+
+def test_spec_parity_stop_token_mid_window():
+    """A stop token landing inside an accepted window must cut the
+    stream exactly where the baseline cuts it (overrun discarded)."""
+    probe = make_engine(False).generate(
+        [PROMPTS[1]], SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    )
+    tokens = list(probe.values())[0]
+    stop = tokens[5]
+    sp = SamplingParams(temperature=0.0, max_tokens=12, stop_token_ids=(stop,))
+    base = make_engine(False).generate([PROMPTS[1]], sp)
+    spec = make_engine(True).generate([PROMPTS[1]], sp)
+    assert list(base.values()) == list(spec.values())
+
+
+@pytest.mark.parametrize("seeded", [False, True])
+def test_spec_parity_async_scheduling(seeded):
+    """Spec composes with async stepping: the staged next batch is
+    planned against max-acceptance counts, and short acceptance lands as
+    a partial rollback — streams still byte-identical to the plain sync
+    engine, and LENGTH finishes still roll their staged rows back."""
+    if seeded:
+        sp = SamplingParams(temperature=1.0, max_tokens=14, seed=11, ignore_eos=True)
+    else:
+        sp = SamplingParams(temperature=0.0, max_tokens=14, ignore_eos=True)
+    base = make_engine(False).generate(PROMPTS, sp)
+    eng = make_engine(True, async_mode=True)
+    out = eng.generate(PROMPTS, sp)
+    assert list(base.values()) == list(out.values())
+    assert eng._inflight is None
+    # every request's LENGTH finish invalidated its staged row
+    assert eng.stats.async_rollbacks_total >= len(PROMPTS)
+    assert eng.allocator.usage() == 0.0
+
+
+def test_spec_async_equals_spec_sync():
+    """Same spec engine, async on vs off: identical streams AND identical
+    acceptance histograms (the pipeline changes when work happens, not
+    what is drafted/accepted)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=20, ignore_eos=True)
+    sync_eng = make_engine(True)
+    async_eng = make_engine(True, async_mode=True)
+    a = sync_eng.generate(PROMPTS, sp)
+    b = async_eng.generate(PROMPTS, sp)
+    assert list(a.values()) == list(b.values())
+    assert (
+        sync_eng.scheduler.spec_accept_len_hist
+        == async_eng.scheduler.spec_accept_len_hist
+    )
+
+
+def test_spec_parity_swa_ring():
+    """Spec composes with the SWA ring pool: rejected provisional writes
+    on sliding layers land in ring slots the real tokens re-write at the
+    same position before anything reads them (the ring's write-span
+    invariant is sized for 1 + k)."""
+    kw = dict(
+        num_layers=4, sliding_window=8,
+        layer_types=("sliding_attention", "full_attention") * 2,
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+
+    def make(spec):
+        cfg = EngineConfig(
+            model=tiny_model_config(**kw),
+            cache=CacheConfig(
+                page_size=4, num_blocks=64, dtype="float32", swa_ring=True
+            ),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_num_batched_tokens=64,
+                speculative_ngram=spec, spec_ngram_k=4,
+            ),
+            parallel=ParallelConfig(tensor_parallel_size=1),
+        )
+        return LLMEngine(cfg)
+
+    base = make(False).generate([list(p) for p in PROMPTS], sp)
+    eng = make(True)
+    assert eng.runner.swa is not None
+    spec = eng.generate([list(p) for p in PROMPTS], sp)
+    assert list(base.values()) == list(spec.values())
+
+
+# --------------------------------------------------------------------- #
+# the KV-provisional-write rule
+
+
+def _committed_hashes_are_subset_of_accepted(eng, streams, prompts):
+    """Every hash in the allocator's content index must re-derive from
+    some request's ACCEPTED prompt+output tokens — a committed page of
+    rejected draft content would fail this set check."""
+    page = eng.allocator.page_size
+    legit: set[bytes] = set()
+    for prompt, out in zip(prompts, streams):
+        legit.update(page_hashes_for_tokens(list(prompt) + list(out), page))
+    committed = set(eng.allocator._cached.keys())
+    assert committed, "no pages were committed: the walk proved nothing"
+    assert committed <= legit, (
+        f"{len(committed - legit)} committed page(s) hold content no "
+        "accepted token stream produced (rejected draft KV leaked into "
+        "the prefix-cache index)"
+    )
+
+
+@pytest.mark.parametrize("async_mode", [False, True])
+def test_rejected_drafts_never_enter_prefix_index(async_mode):
+    """Run a draft-heavy workload with small pages (rejections cross
+    page boundaries), then walk the allocator's hash map: every
+    committed page must re-derive from accepted tokens only."""
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    eng = make_engine(True, async_mode=async_mode, page=4, num_blocks=96)
+    streams = list(eng.generate(PROMPTS, sp).values())
+    sch = eng.scheduler
+    assert sch.spec_proposed_tokens > sch.spec_accepted_tokens > 0, (
+        "workload produced no rejections: the invariant wasn't exercised"
+    )
+    _committed_hashes_are_subset_of_accepted(eng, streams, PROMPTS)
+    assert eng.allocator.usage() == 0.0  # all pages returned
+
+
+def test_spec_truncation_returns_pages_sync():
+    """Sync engines truncate a drafting row's pages back to the computed
+    span every step: mid-run, no running request may hold pages past
+    ceil(computed / page) (the provisional-write span is transient)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    eng = make_engine(True, page=4)
+    for p in PROMPTS:
+        eng.add_request(list(p), sp)
+    saw_drafting_step = False
+    for _ in range(64):
+        if not eng.has_work():
+            break
+        eng.step()
+        if eng.scheduler.spec_proposed_tokens:
+            saw_drafting_step = True
+        for req in eng.scheduler.running:
+            if req.in_decode:
+                max_pages = -(-req.num_computed_tokens // 4)
+                assert len(req.block_ids) <= max_pages + 1, (
+                    req.request_id, req.num_computed_tokens,
+                    len(req.block_ids),
+                )
+    assert saw_drafting_step
+
+
+# --------------------------------------------------------------------- #
+# config / observability surfaces
+
+
+def test_spec_rejects_decode_window():
+    with pytest.raises(ValueError, match="decode_window"):
+        SchedulerConfig(speculative_ngram=True, decode_window=4)
+
+
+def test_spec_metrics_surface():
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    eng = make_engine(True)
+    eng.generate(PROMPTS, sp)
+    st = eng.stats
+    assert st.spec_proposed_tokens_total > 0
+    assert st.spec_accepted_tokens_total > 0
+    assert 0.0 < st.spec_acceptance_rate <= 1.0
+    assert sum(st.spec_accepted_len_hist) > 0
+    from llmd_tpu.serve.metrics import parse_prometheus, render_metrics
+
+    page = render_metrics(st, "tiny")
+    parsed = parse_prometheus(page)
+    assert parsed["llmd:spec_proposed_tokens_total"] == st.spec_proposed_tokens_total
+    assert parsed["llmd:spec_accepted_tokens_total"] == st.spec_accepted_tokens_total
+    assert "llmd:spec_acceptance_rate" in parsed
+    assert 'llmd:spec_accepted_len_bucket{le="+Inf"' in page
+    # per-request accounting rode along
+    assert "llmd:spec_accepted_len_sum" in page
+
+
+def test_spec_off_emits_no_spec_metrics():
+    eng = make_engine(False)
+    eng.generate([PROMPTS[0]], SamplingParams(temperature=0.0, max_tokens=4))
+    from llmd_tpu.serve.metrics import render_metrics
+
+    page = render_metrics(eng.stats, "tiny")
+    assert "spec_" not in page
