@@ -1,0 +1,16 @@
+"""AST-based invariant linter for the engine's unwritten rules.
+
+``python -m llmd_tpu.analysis`` runs every checker over the tree and
+exits nonzero on findings (docs/architecture/static-analysis.md).
+Stdlib-only by design: the CI lint job runs without jax installed.
+"""
+
+from llmd_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    Checker,
+    Finding,
+    Repo,
+    register,
+    rule_names,
+    run_analysis,
+)

@@ -1,0 +1,92 @@
+"""CLI: ``python -m llmd_tpu.analysis [paths...] [--json] [--rules ...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. With no paths the scan
+set is the llmd_tpu package plus the parity side inputs (observability
+assets, docs, tracked shell scripts) relative to --root (default: the
+current directory, i.e. run it from the repo root).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from llmd_tpu.analysis.core import (
+    CHECKERS,
+    render_human,
+    render_json,
+    rule_names,
+    run_analysis,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "python -m llmd_tpu.analysis",
+        description="repo invariant linter (static-analysis.md)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/directories to scan (default: the repo scan set)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated subset of rules to run",
+    )
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument(
+        "--root", default=".",
+        help="repo root findings are reported relative to (default: cwd)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    # Import for the registry side effect before --list-rules.
+    from llmd_tpu.analysis import checkers  # noqa: F401
+
+    if args.list_rules:
+        for name in sorted(rule_names()):
+            desc = (
+                CHECKERS[name].description
+                if name in CHECKERS
+                else "pragma hygiene (reason required, rule must exist)"
+            )
+            print(f"{name}: {desc}")
+        return 0
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    try:
+        findings, nfiles = run_analysis(
+            Path(args.root), args.paths or None, rules
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if nfiles == 0:
+        # An empty scan set means the invariant tier silently enforced
+        # NOTHING (wrong cwd/--root, moved package): fail loudly rather
+        # than return a green exit CI would trust.
+        print(
+            "error: scan set is empty — run from the repo root or pass "
+            "--root/paths (0 files means 0 invariants enforced)",
+            file=sys.stderr,
+        )
+        return 2
+    out = (
+        render_json(findings, nfiles)
+        if args.json
+        else render_human(findings, nfiles)
+    )
+    print(out)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,10 @@
+"""Checker registry: importing this package registers every checker."""
+
+from llmd_tpu.analysis.checkers import (  # noqa: F401
+    config_parity,
+    envvars,
+    host_sync,
+    lockstep,
+    metrics_parity,
+    trace,
+)

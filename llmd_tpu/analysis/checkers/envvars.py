@@ -1,0 +1,83 @@
+"""envvars: shell scripts declare their environment-variable surface.
+
+The contract from ``scripts/ENVVARS.md`` (previously enforced by the
+standalone ``scripts/lint-envvars.py``, now a thin shim over this
+checker): an all-caps variable may be read only if the script
+(a) requires it with ``${VAR:?...}``, (b) defaults it with
+``${VAR:-...}`` / ``${VAR:=...}``, (c) assigns it first, or
+(d) declares it in an ``# env: VAR`` comment. (Role model: the
+reference's scripts/lint-envvars.py env-declaration lint; independent
+implementation.)
+"""
+
+from __future__ import annotations
+
+import re
+
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+
+EXEMPT = {
+    "PATH", "HOME", "PWD", "OLDPWD", "TMPDIR", "USER", "SHELL", "LANG",
+    "LC_ALL", "TERM", "HOSTNAME", "RANDOM", "SECONDS", "LINENO", "OPTARG",
+    "OPTIND", "IFS", "EUID", "UID", "PPID", "BASH_SOURCE", "FUNCNAME",
+}
+
+USE_RE = re.compile(r"\$\{?([A-Z][A-Z0-9_]*)\b")
+DECL_RE = re.compile(r"^\s*#\s*env:\s*([A-Z0-9_ ,]+)")
+GUARD_RE = re.compile(r"\$\{([A-Z][A-Z0-9_]*)(:?[-=?+])")
+ASSIGN_RE = re.compile(r"^\s*(?:export\s+)?([A-Z][A-Z0-9_]*)=")
+FOR_RE = re.compile(r"\bfor\s+([A-Z][A-Z0-9_]*)\b")
+
+
+def lint_lines(lines: list[str]) -> list[tuple[int, str, str]]:
+    """(lineno, var, message) per undeclared use — the shared core both
+    the checker and the scripts/lint-envvars.py shim call."""
+    declared: set[str] = set(EXEMPT)
+    # Pass 1: collect declarations anywhere in the file — a guard at the
+    # top blesses every later bare use of the same var.
+    for line in lines:
+        m = DECL_RE.match(line)
+        if m:
+            declared.update(v for v in re.split(r"[ ,]+", m.group(1)) if v)
+        for m in GUARD_RE.finditer(line):
+            declared.add(m.group(1))
+        m = ASSIGN_RE.match(line)
+        if m:
+            declared.add(m.group(1))
+        m = FOR_RE.search(line)
+        if m:
+            declared.add(m.group(1))
+    # Pass 2: flag bare uses of anything never declared.
+    errors: list[tuple[int, str, str]] = []
+    for i, line in enumerate(lines, 1):
+        code = line.split("#", 1)[0]  # ignore comments
+        for m in USE_RE.finditer(code):
+            var = m.group(1)
+            if var not in declared:
+                errors.append((
+                    i, var,
+                    f"{var} used without declaration/default "
+                    "(see scripts/ENVVARS.md)",
+                ))
+                declared.add(var)  # one report per var per file
+    return errors
+
+
+@register
+class EnvvarsChecker(Checker):
+    name = "envvars"
+    description = (
+        "shell scripts declare every env var they read (guard, assign, "
+        "or `# env: VAR` comment; scripts/ENVVARS.md)"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in repo.files:
+            if not sf.path.endswith(".sh"):
+                continue
+            for line, _var, msg in lint_lines(sf.lines):
+                findings.append(
+                    Finding("envvars", "EV001", sf.path, line, msg)
+                )
+        return findings

@@ -1,0 +1,236 @@
+"""host-sync: no host↔device synchronization outside the declared
+readback sites.
+
+The async step pipeline (docs/architecture/async-scheduling.md) exists
+because each engine step makes exactly ONE coalesced host transfer —
+``ModelRunner.wait_step``. Any other sync in a hot-path module
+(``engine/``, ``ops/``, ``parallel/``) blocks the dispatching thread on
+device completion and silently re-serializes the pipeline. The rule
+flags the unambiguous sync primitives everywhere in hot-path modules,
+and host coercions (``int``/``float``/``np.asarray``/…) when the
+operand is provably a device array (annotated ``jax.Array`` or assigned
+from a ``jnp.*``/``jax.*`` call).
+
+Declared readback sites (everything else needs a pragma with a reason):
+
+- ``ModelRunner.wait_step`` — the per-step coalesced token readback.
+- ``ModelRunner.download_pages`` — KV staging download, runs on a
+  staging thread off the step loop by contract.
+- ``distributed.replicated_to_host`` — the multi-host local-replica
+  read ``wait_step`` delegates to.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+
+# (file basename, dotted qualname) pairs whose bodies may sync.
+ALLOWED_SITES = frozenset({
+    ("runner.py", "ModelRunner.wait_step"),
+    ("runner.py", "ModelRunner.download_pages"),
+    ("distributed.py", "replicated_to_host"),
+})
+
+_SYNC_PRIMITIVES = {
+    "device_get": ("HS001", "jax.device_get blocks on device completion"),
+    "block_until_ready": ("HS002", "block_until_ready is a host sync"),
+    "item": ("HS003", ".item() forces a device->host transfer"),
+}
+
+_COERCERS_NP = {"asarray", "array", "ascontiguousarray"}
+_COERCERS_BUILTIN = {"int", "float", "bool"}
+
+_DEVICE_ROOTS = {"jnp", "jax"}
+
+# jax.* calls whose results are HOST metadata, not device arrays
+# (coercing them costs nothing and must not trip the coercion rule).
+_HOST_RESULT_ATTRS = frozenset({
+    "devices", "local_devices", "device_count", "local_device_count",
+    "process_index", "process_count",
+})
+
+
+def _is_jax_array_annotation(node: ast.expr | None) -> bool:
+    """``jax.Array`` / ``jnp.ndarray`` / ``jax.Array | None``-style."""
+    if node is None:
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _is_jax_array_annotation(node.left) or _is_jax_array_annotation(
+            node.right
+        )
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return (node.value.id, node.attr) in (
+            ("jax", "Array"), ("jnp", "ndarray")
+        )
+    return False
+
+
+def _call_root(node: ast.expr) -> str | None:
+    """Leftmost Name of a dotted callee: ``jax.lax.foo`` -> ``jax``."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_device_call(node: ast.expr) -> bool:
+    """A call whose result lives on device: jnp.*, jax.* (minus the sync
+    primitives, which are host results and flagged separately)."""
+    if not isinstance(node, ast.Call):
+        return False
+    root = _call_root(node.func)
+    if root not in _DEVICE_ROOTS:
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr in (
+        {"device_get"} | _HOST_RESULT_ATTRS
+    ):
+        return False
+    return True
+
+
+class _FunctionScope:
+    def __init__(self, qualname: str) -> None:
+        self.qualname = qualname
+        self.device_names: set[str] = set()
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf) -> None:
+        self.sf = sf
+        self.stack: list[_FunctionScope] = []
+        self.findings: list[Finding] = []
+
+    # -------------------------------------------------------------- #
+
+    def _qual(self, name: str) -> str:
+        if self.stack:
+            return f"{self.stack[-1].qualname}.{name}"
+        return name
+
+    def _allowed(self) -> bool:
+        return any(
+            (self.sf.name, s.qualname) in ALLOWED_SITES for s in self.stack
+        )
+
+    def _device_like(self, node: ast.expr) -> bool:
+        """Conservatively: is this expression a device array?"""
+        # Peel subscripts/attribute reads: pooled[:n] is as device as pooled.
+        while isinstance(node, (ast.Subscript, ast.Starred)):
+            node = node.value
+        if isinstance(node, ast.Name):
+            return any(node.id in s.device_names for s in self.stack[-1:])
+        if _is_device_call(node):
+            return True
+        return False
+
+    def _flag(self, node: ast.AST, code: str, msg: str) -> None:
+        if self._allowed():
+            return
+        self.findings.append(Finding(
+            "host-sync", code, self.sf.path, node.lineno,
+            f"{msg} in hot-path module (declared readback sites: "
+            "ModelRunner.wait_step / download_pages / replicated_to_host; "
+            "pragma `# llmd: allow(host-sync) -- <reason>` if this read "
+            "is off the step loop by design)",
+        ))
+
+    # -------------------------------------------------------------- #
+
+    def _enter_function(self, node) -> None:
+        # Decorators evaluate in the enclosing scope.
+        for d in node.decorator_list:
+            self.visit(d)
+        scope = _FunctionScope(self._qual(node.name))
+        args = node.args
+        for a in (
+            *args.posonlyargs, *args.args, *args.kwonlyargs,
+            *( [args.vararg] if args.vararg else [] ),
+            *( [args.kwarg] if args.kwarg else [] ),
+        ):
+            if _is_jax_array_annotation(a.annotation):
+                scope.device_names.add(a.arg)
+        self.stack.append(scope)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_function(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        for d in node.decorator_list:
+            self.visit(d)
+        self.stack.append(_FunctionScope(self._qual(node.name)))
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.stack and _is_device_call(node.value):
+            for t in node.targets:
+                names = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for n in names:
+                    if isinstance(n, ast.Name):
+                        self.stack[-1].device_names.add(n.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "device_get" and _call_root(func) == "jax":
+                self._flag(node, *_SYNC_PRIMITIVES["device_get"])
+            elif func.attr == "block_until_ready" and (
+                # method form x.block_until_ready() OR the module-level
+                # jax.block_until_ready(x) spelling
+                not node.args or _call_root(func) == "jax"
+            ):
+                self._flag(node, *_SYNC_PRIMITIVES["block_until_ready"])
+            elif func.attr == "item" and not node.args:
+                self._flag(node, *_SYNC_PRIMITIVES["item"])
+            elif (
+                func.attr in _COERCERS_NP
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "np"
+                and node.args
+                and self._device_like(node.args[0])
+            ):
+                self._flag(
+                    node, "HS004",
+                    f"np.{func.attr} of a device array is a blocking "
+                    "device->host transfer",
+                )
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in _COERCERS_BUILTIN
+            and len(node.args) == 1
+            and self._device_like(node.args[0])
+        ):
+            self._flag(
+                node, "HS004",
+                f"{func.id}() of a device array is a blocking "
+                "device->host transfer",
+            )
+        self.generic_visit(node)
+
+
+@register
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    description = (
+        "host<->device syncs in engine/ops/parallel hot paths must flow "
+        "through the declared coalesced readback sites"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in repo.files:
+            if not sf.is_python or not sf.hot_path or sf.tree is None:
+                continue
+            v = _Visitor(sf)
+            v.visit(sf.tree)
+            findings.extend(v.findings)
+        return findings

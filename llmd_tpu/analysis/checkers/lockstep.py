@@ -1,0 +1,263 @@
+"""lockstep: every multi-host opcode has a follower dispatch arm.
+
+Multi-host engines run leader + followers in lockstep: the leader
+broadcasts a ``(op, B, QK, greedy)`` header (``ModelRunner._sync``) and
+every follower mirrors the dispatch in ``follower_loop``. An opcode
+added without a follower arm makes every follower dispatch the WRONG
+program (or none), desynchronizing the SPMD collective stream — the
+group deadlocks or silently corrupts state. ``_OP_VERIFY`` introduced
+exactly this hazard window; this checker closes it permanently.
+
+Applies to any module that defines module-level ``_OP_*`` constants and
+a ``follower_loop`` function. Rules:
+
+- LS001: an ``_OP_*`` opcode never compared against ``op`` inside
+  ``follower_loop`` (no follower dispatch arm).
+- LS002: the follower dispatch chain does not terminate in an ``else``
+  that raises — an unknown opcode would silently fall through (or run
+  whatever the final branch does).
+- LS003: an ``_OP_*`` opcode (other than ``_OP_STOP``, which rides a
+  raw header broadcast in ``stop_followers``) that no ``_sync`` call
+  site ever broadcasts — dead opcode, or a dispatch path bypassing the
+  broadcast.
+- LS004: a ``_sync`` call whose op argument is not a named ``_OP_*``
+  constant (magic-number dispatch defeats this checker).
+- LS005: a jitted step callable (an attribute ``__init__`` assigns from
+  a ``_build_*`` factory) invoked outside an ``_exec_*`` method — the
+  ``_exec_*`` family is what both the leader dispatch paths and the
+  follower arms share; a direct call bypasses the lockstep broadcast.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+
+
+def _module_opcodes(tree: ast.Module) -> dict[str, int]:
+    """{_OP_name: lineno} for module-level (possibly tuple) assignments."""
+    ops: dict[str, int] = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for t in stmt.targets:
+            names = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for n in names:
+                if isinstance(n, ast.Name) and n.id.startswith("_OP_"):
+                    ops[n.id] = stmt.lineno
+    return ops
+
+
+def _find_function(tree: ast.Module, name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == name:
+                return node
+    return None
+
+
+def _compared_ops(fn) -> set[str]:
+    """_OP_* names compared (==/!=/in) anywhere inside ``fn``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for expr in (node.left, *node.comparators):
+                exprs = (
+                    expr.elts if isinstance(expr, (ast.Tuple, ast.List))
+                    else [expr]
+                )
+                for e in exprs:
+                    if isinstance(e, ast.Name) and e.id.startswith("_OP_"):
+                        out.add(e.id)
+    return out
+
+
+def _dispatch_chain_has_else_raise(fn) -> bool:
+    """The longest if/elif chain comparing ``op`` must end in a raising
+    else. Short guard ifs (``if op == _OP_STOP: return``) are fine."""
+    best_len, best_tail = 0, None
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        # Walk this node's elif chain, counting op-comparisons.
+        length, cur = 0, node
+        while True:
+            if any(
+                isinstance(e, ast.Name) and e.id.startswith("_OP_")
+                for c in ast.walk(cur.test)
+                if isinstance(c, ast.Compare)
+                for e in (c.left, *c.comparators)
+            ):
+                length += 1
+            nxt = cur.orelse
+            if len(nxt) == 1 and isinstance(nxt[0], ast.If):
+                cur = nxt[0]
+                continue
+            break
+        if length > best_len:
+            best_len, best_tail = length, cur.orelse
+    if best_len <= 1:
+        return True  # no dispatch chain here (guard-only function)
+    return bool(best_tail) and any(
+        isinstance(n, ast.Raise)
+        for stmt in best_tail
+        for n in ast.walk(stmt)
+    )
+
+
+def _sync_op_args(tree: ast.Module) -> list[tuple[ast.expr, int]]:
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_sync"
+            and node.args
+        ):
+            out.append((node.args[0], node.lineno))
+    return out
+
+
+def _step_callables(tree: ast.Module) -> set[str]:
+    """Attributes the follower-loop class's __init__ assigns from a
+    self._build_*() factory call: the jitted step programs the lockstep
+    contract covers. Scoped to THAT class — another class's __init__
+    appearing first in the module must not hijack the search."""
+    init = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and any(
+            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and m.name == "follower_loop"
+            for m in node.body
+        ):
+            for m in node.body:
+                if isinstance(m, ast.FunctionDef) and m.name == "__init__":
+                    init = m
+            break
+    if init is None:
+        init = _find_function(tree, "__init__")
+    if init is None:
+        return set()
+    out: set[str] = set()
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign):
+            continue
+        has_build_call = any(
+            isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Attribute)
+            and c.func.attr.startswith("_build_")
+            for c in ast.walk(node.value)
+        )
+        if not has_build_call:
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out.add(t.attr)
+    return out
+
+
+@register
+class LockstepChecker(Checker):
+    name = "lockstep"
+    description = (
+        "every _OP_* opcode has a follower dispatch arm, is broadcast "
+        "via _sync, and the jitted steps stay behind _exec_*"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in repo.files:
+            if not sf.is_python or sf.tree is None:
+                continue
+            ops = _module_opcodes(sf.tree)
+            follower = _find_function(sf.tree, "follower_loop")
+            if not ops or follower is None:
+                continue
+            findings.extend(self._check_module(sf, ops, follower))
+        return findings
+
+    def _check_module(self, sf, ops, follower) -> list[Finding]:
+        findings: list[Finding] = []
+        handled = _compared_ops(follower)
+        for name, line in sorted(ops.items(), key=lambda kv: kv[1]):
+            if name not in handled:
+                findings.append(Finding(
+                    "lockstep", "LS001", sf.path, line,
+                    f"opcode {name} has no dispatch arm in follower_loop — "
+                    "followers would mirror the wrong program and "
+                    "desynchronize the lockstep collective stream",
+                ))
+        if not _dispatch_chain_has_else_raise(follower):
+            findings.append(Finding(
+                "lockstep", "LS002", sf.path, follower.lineno,
+                "follower_loop's dispatch chain must end in an else that "
+                "raises: an unrecognized opcode silently running the "
+                "fallthrough branch is exactly the multi-host hang this "
+                "rule exists to prevent",
+            ))
+        synced: set[str] = set()
+        for arg, line in _sync_op_args(sf.tree):
+            if isinstance(arg, ast.Name) and arg.id.startswith("_OP_"):
+                synced.add(arg.id)
+            else:
+                findings.append(Finding(
+                    "lockstep", "LS004", sf.path, line,
+                    "_sync op argument must be a named _OP_* constant "
+                    "(magic-number dispatch defeats exhaustiveness "
+                    "checking)",
+                ))
+        for name, line in sorted(ops.items(), key=lambda kv: kv[1]):
+            if name == "_OP_STOP" or name in synced:
+                continue
+            findings.append(Finding(
+                "lockstep", "LS003", sf.path, line,
+                f"opcode {name} is never broadcast via _sync — dead "
+                "opcode, or a leader path dispatching it without the "
+                "lockstep broadcast",
+            ))
+        step_attrs = _step_callables(sf.tree)
+        if step_attrs:
+            findings.extend(self._check_exec_only(sf, step_attrs))
+        return findings
+
+    def _check_exec_only(self, sf, step_attrs: set[str]) -> list[Finding]:
+        findings: list[Finding] = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.fn_stack: list[str] = []
+
+            def _enter(self, node) -> None:
+                self.fn_stack.append(node.name)
+                self.generic_visit(node)
+                self.fn_stack.pop()
+
+            visit_FunctionDef = visit_AsyncFunctionDef = _enter
+
+            def visit_Call(self, node: ast.Call) -> None:
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and f.attr in step_attrs
+                    and not any(
+                        name.startswith(("_exec_", "_build_", "__init__"))
+                        for name in self.fn_stack
+                    )
+                ):
+                    findings.append(Finding(
+                        "lockstep", "LS005", sf.path, node.lineno,
+                        f"jitted step self.{f.attr} called outside the "
+                        "_exec_* family — this bypasses the lockstep "
+                        "broadcast followers mirror",
+                    ))
+                self.generic_visit(node)
+
+        V().visit(sf.tree)
+        return findings

@@ -1,0 +1,201 @@
+"""metrics-parity: EngineStats ↔ /metrics exposition ↔ dashboards/docs.
+
+PR 2 showed how a 1.6k-line change lets the three surfaces drift: a
+counter lands on ``EngineStats``, the exposition page emits it, and no
+dashboard or doc ever mentions it (or a dashboard keys on a name the
+engine no longer emits — a silently-empty panel). This checker diffs
+the three surfaces; an orphan in ANY direction is a finding.
+
+Inputs (found by convention inside the scan set):
+
+- exposition: a ``metrics.py`` defining ``render_metrics`` — emitted
+  names are the ``gauges``/``counters`` dict keys + subscript
+  assignments, ``(name, stats.field)`` tuples, and ``vllm:``/``llmd:``
+  literals in the source.
+- stats: a module defining a class named ``EngineStats`` — its
+  dataclass fields.
+- dashboards/alerts: ``*.json``/``*.yaml`` under a path containing
+  ``observability`` — referenced names are the prefixed
+  ``vllm:name``/``llmd:name`` tokens.
+- docs: a markdown file named ``observability.md``.
+
+Names are canonicalized (family prefix stripped, histogram
+``_bucket``/``_sum``/``_count`` suffixes folded onto the base name).
+
+Rules: MP001 emitted-but-on-no-dashboard, MP002 emitted-but-
+undocumented, MP003 dashboard-references-unemitted, MP004 EngineStats
+field the exposition never reads.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+
+_PREFIXED = re.compile(r"\b(?:vllm|llmd):([a-z][a-z0-9_]*)")
+_HIST_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+
+# EngineStats fields that are inputs to emitted metrics rather than
+# metrics themselves (label payloads, histogram raw form).
+_STATS_LABEL_FIELDS = frozenset({
+    "running_lora_adapters", "waiting_lora_adapters",
+})
+
+
+def _canon(name: str) -> str:
+    return _HIST_SUFFIX.sub("", name)
+
+
+def _emitted_names(sf) -> dict[str, int]:
+    """{canonical metric name: lineno} emitted by render_metrics."""
+    out: dict[str, int] = {}
+
+    def add(name: str, line: int) -> None:
+        out.setdefault(_canon(name), line)
+
+    tree = sf.tree
+    if tree is not None:
+        for node in ast.walk(tree):
+            # gauges = {...} / counters = {...}
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+                targets = {
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                }
+                if targets & {"gauges", "counters"}:
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str
+                        ):
+                            add(k.value, k.lineno)
+            # gauges["x"] = ... / counters["x"] = ...
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in ("gauges", "counters")
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)
+                    ):
+                        add(t.slice.value, t.lineno)
+            # ("name", stats.field) emission tuples
+            if (
+                isinstance(node, ast.Tuple)
+                and len(node.elts) == 2
+                and isinstance(node.elts[0], ast.Constant)
+                and isinstance(node.elts[0].value, str)
+                and re.fullmatch(r"[a-z][a-z0-9_]*", node.elts[0].value or "")
+                and isinstance(node.elts[1], ast.Attribute)
+                and isinstance(node.elts[1].value, ast.Name)
+                and node.elts[1].value.id == "stats"
+            ):
+                add(node.elts[0].value, node.lineno)
+    for i, line in enumerate(sf.lines, 1):
+        for m in _PREFIXED.finditer(line):
+            add(m.group(1), i)
+        # f-string emission under both families: f"{family}:name..."
+        for m in re.finditer(r"\{family\}:([a-z][a-z0-9_]*)", line):
+            add(m.group(1), i)
+    return out
+
+
+def _stats_fields(repo: Repo) -> dict[str, tuple[str, int]]:
+    """{field: (path, lineno)} of the EngineStats dataclass."""
+    for sf in repo.files:
+        if not sf.is_python or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "EngineStats":
+                fields = {}
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        fields[stmt.target.id] = (sf.path, stmt.lineno)
+                return fields
+    return {}
+
+
+def _stats_reads(sf) -> set[str]:
+    if sf.tree is None:
+        return set()
+    return {
+        node.attr
+        for node in ast.walk(sf.tree)
+        if isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "stats"
+    }
+
+
+@register
+class MetricsParityChecker(Checker):
+    name = "metrics-parity"
+    description = (
+        "EngineStats fields, /metrics exposition names, and dashboard/"
+        "doc references must agree in all directions"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        metrics_files = [
+            sf for sf in repo.named("metrics.py")
+            if "def render_metrics" in sf.text
+        ]
+        if not metrics_files:
+            return []
+        msf = metrics_files[0]
+        emitted = _emitted_names(msf)
+
+        dash_files = [
+            sf for sf in repo.files
+            if "observability" in sf.path.split("/")
+            and (sf.path.endswith(".json") or sf.path.endswith(".yaml"))
+        ]
+        referenced: dict[str, str] = {}  # canon name -> first referencing file
+        for sf in dash_files:
+            for m in _PREFIXED.finditer(sf.text):
+                referenced.setdefault(_canon(m.group(1)), sf.path)
+
+        docs = [sf for sf in repo.named("observability.md")]
+        doc_text = docs[0].text if docs else None
+
+        findings: list[Finding] = []
+        for name, line in sorted(emitted.items()):
+            if dash_files and name not in referenced:
+                findings.append(Finding(
+                    "metrics-parity", "MP001", msf.path, line,
+                    f"metric {name!r} is emitted but referenced by no "
+                    "dashboard or alert under observability/ — unobserved "
+                    "telemetry rots; panel it or drop it",
+                ))
+            if doc_text is not None and not re.search(
+                rf"\b{re.escape(name)}\b", doc_text
+            ):
+                findings.append(Finding(
+                    "metrics-parity", "MP002", msf.path, line,
+                    f"metric {name!r} is emitted but not mentioned in "
+                    "observability.md's metric reference",
+                ))
+        for name, where in sorted(referenced.items()):
+            if name not in emitted:
+                findings.append(Finding(
+                    "metrics-parity", "MP003", where, 1,
+                    f"dashboard/alert references vllm:/llmd: metric "
+                    f"{name!r} which the engine exposition "
+                    "(serve/metrics.py) does not emit — the panel will "
+                    "render empty forever",
+                ))
+        fields = _stats_fields(repo)
+        if fields:
+            reads = _stats_reads(msf)
+            for field, (path, line) in sorted(fields.items()):
+                if field in reads or field in _STATS_LABEL_FIELDS:
+                    continue
+                findings.append(Finding(
+                    "metrics-parity", "MP004", path, line,
+                    f"EngineStats.{field} is never read by render_metrics "
+                    "— the stat is collected but unobservable",
+                ))
+        return findings

@@ -1,0 +1,290 @@
+"""Invariant-linter core: findings, pragmas, file model, checker registry.
+
+The engine's correctness rests on conventions no runtime test can see
+failing — device work must stay inside the bucketed traced shape
+families, host↔device syncs must flow through the declared coalesced
+readback sites, every lockstep opcode needs a follower dispatch arm,
+and metrics/flags/docs drift silently. Each convention is mechanized as
+a checker over the stdlib ``ast`` (plus plain text for the parity
+checkers); ``python -m llmd_tpu.analysis`` runs them all and exits
+nonzero on any finding (docs/architecture/static-analysis.md).
+
+Deliberately stdlib-only: the CI lint job runs this without jax (or any
+third-party package) installed.
+
+Suppression grammar — a finding on line L is suppressed by a pragma
+comment on line L or line L-1::
+
+    # llmd: allow(<rule>[, <rule>...]) -- <reason>
+
+The reason is mandatory: a pragma without one is itself a finding
+(``pragma/PRAGMA001``), as is a pragma naming an unknown rule
+(``pragma/PRAGMA002``). Unused pragmas are tolerated (a fix that
+removes the violation should not fail the build until the pragma is
+cleaned up).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import subprocess
+from pathlib import Path
+
+PRAGMA_RE = re.compile(
+    r"#\s*llmd:\s*allow\(\s*([a-z0-9_,\s-]+?)\s*\)\s*(?:--\s*(\S.*))?$"
+)
+
+# Directories whose Python modules sit on the per-step serving hot path:
+# the host-sync and trace-discipline rules apply only here.
+HOT_PATH_PARTS = frozenset({"engine", "ops", "parallel"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str  # checker name, e.g. "host-sync" (pragma key)
+    code: str  # stable per-finding id, e.g. "HS001"
+    path: str  # root-relative posix path
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}/{self.code}] {self.message}"
+
+
+class SourceFile:
+    """A scanned file: text, lines, lazy AST, and the pragma index."""
+
+    def __init__(self, root: Path, path: Path) -> None:
+        self.abspath = path
+        try:
+            self.path = path.relative_to(root).as_posix()
+        except ValueError:
+            # Explicit path outside --root (e.g. a scratch fixture):
+            # report it absolute rather than refusing to scan it.
+            self.path = path.as_posix()
+        try:
+            self.text = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            self.text = ""
+        self.lines = self.text.splitlines()
+        self._tree: ast.AST | None | bool = False  # False = not parsed yet
+        # line -> set of rule names allowed there; line 0 never matches.
+        self.pragmas: dict[int, set[str]] = {}
+        # (line, rules) per pragma COMMENT (for hygiene checks).
+        self.pragma_decls: list[tuple[int, set[str]]] = []
+        self.bad_pragmas: list[tuple[int, str]] = []  # (line, defect)
+        # Pragmas only mean something where `#` starts a comment; docs
+        # quoting pragma examples must not trip the hygiene rules.
+        suppressible = self.path.endswith((".py", ".sh"))
+        for i, line in enumerate(self.lines, 1):
+            if not suppressible:
+                break
+            m = PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if not m.group(2):
+                self.bad_pragmas.append(
+                    (i, "pragma has no reason (grammar: "
+                        "`# llmd: allow(<rule>) -- <reason>`)")
+                )
+            self.pragma_decls.append((i, rules))
+            # A pragma blesses its own line and the next one, so both
+            # trailing-comment and line-above placements work.
+            self.pragmas.setdefault(i, set()).update(rules)
+            self.pragmas.setdefault(i + 1, set()).update(rules)
+
+    @property
+    def is_python(self) -> bool:
+        return self.path.endswith(".py")
+
+    @property
+    def name(self) -> str:
+        return self.abspath.name
+
+    @property
+    def tree(self) -> ast.AST | None:
+        """Parsed module, or None when not Python / syntactically broken
+        (compileall stays the syntax floor; we don't double-report)."""
+        if self._tree is False:
+            self._tree = None
+            if self.is_python:
+                try:
+                    self._tree = ast.parse(self.text)
+                except SyntaxError:
+                    self._tree = None
+        return self._tree
+
+    def allows(self, rule: str, line: int) -> bool:
+        return rule in self.pragmas.get(line, ())
+
+    @property
+    def hot_path(self) -> bool:
+        return bool(HOT_PATH_PARTS.intersection(Path(self.path).parts))
+
+
+class Repo:
+    """The file set one analysis run sees."""
+
+    def __init__(self, root: Path, files: list[SourceFile]) -> None:
+        self.root = root
+        self.files = files
+
+    def named(self, name: str) -> list[SourceFile]:
+        return [f for f in self.files if f.name == name]
+
+
+class Checker:
+    """Base class; subclasses register with @register."""
+
+    name = "base"
+    description = ""
+
+    def run(self, repo: Repo) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+CHECKERS: dict[str, type[Checker]] = {}
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    assert cls.name not in CHECKERS, f"duplicate checker {cls.name}"
+    CHECKERS[cls.name] = cls
+    return cls
+
+
+def rule_names() -> set[str]:
+    return set(CHECKERS) | {"pragma"}
+
+
+# ------------------------------------------------------------------ #
+# file discovery
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules", ".venv"}
+
+# The default scan set: the Python package the AST rules cover, plus the
+# side inputs the parity checkers diff against.
+_DEFAULT_GLOBS = (
+    "llmd_tpu/**/*.py",
+    "observability/**/*.json",
+    "observability/**/*.yaml",
+    "docs/**/*.md",
+    "README.md",
+)
+
+
+def _tracked_shell_scripts(root: Path) -> list[Path]:
+    try:
+        out = subprocess.run(
+            ["git", "ls-files", "*.sh"], capture_output=True, text=True,
+            cwd=root,
+        )
+        paths = [root / p for p in out.stdout.splitlines() if p]
+        if paths:
+            return paths
+    except OSError:
+        pass
+    return [
+        p for p in root.rglob("*.sh")
+        if not _SKIP_DIRS.intersection(p.relative_to(root).parts)
+    ]
+
+
+def discover(root: Path, paths: list[str] | None = None) -> list[SourceFile]:
+    root = root.resolve()
+    found: list[Path] = []
+    if paths:
+        for raw in paths:
+            p = Path(raw)
+            if not p.is_absolute():
+                p = root / p
+            if p.is_dir():
+                for q in sorted(p.rglob("*")):
+                    if q.is_file() and q.suffix in (
+                        ".py", ".sh", ".json", ".yaml", ".md"
+                    ):
+                        found.append(q)
+            elif p.is_file():
+                found.append(p)
+    else:
+        for pattern in _DEFAULT_GLOBS:
+            found.extend(sorted(root.glob(pattern)))
+        found.extend(_tracked_shell_scripts(root))
+    out, seen = [], set()
+    for p in found:
+        p = p.resolve()
+        rel = p.relative_to(root).parts if root in p.parents or p == root else ()
+        if p in seen or _SKIP_DIRS.intersection(rel):
+            continue
+        seen.add(p)
+        out.append(SourceFile(root, p))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# run loop
+
+def run_analysis(
+    root: Path,
+    paths: list[str] | None = None,
+    rules: list[str] | None = None,
+) -> tuple[list[Finding], int]:
+    """Run the (selected) checkers over the scan set.
+
+    Returns (surviving findings, files scanned). Pragma suppression and
+    pragma hygiene are applied here so every checker gets them for free.
+    """
+    # Import for side effect: checker registration.
+    from llmd_tpu.analysis import checkers  # noqa: F401
+
+    repo = Repo(root.resolve(), discover(root, paths))
+    selected = sorted(rules) if rules else sorted(CHECKERS) + ["pragma"]
+    unknown = [r for r in selected if r not in CHECKERS and r != "pragma"]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+    findings: list[Finding] = []
+    for name in selected:
+        if name != "pragma":
+            findings.extend(CHECKERS[name]().run(repo))
+    by_path = {f.path: f for f in repo.files}
+    kept = [
+        f for f in findings
+        if f.path not in by_path or not by_path[f.path].allows(f.rule, f.line)
+    ]
+    if "pragma" in selected:
+        known = rule_names()
+        for sf in repo.files:
+            for line, defect in sf.bad_pragmas:
+                kept.append(Finding("pragma", "PRAGMA001", sf.path, line, defect))
+            for line, names in sf.pragma_decls:
+                for r in sorted(names - known):
+                    kept.append(Finding(
+                        "pragma", "PRAGMA002", sf.path, line,
+                        f"pragma allows unknown rule {r!r} "
+                        f"(known: {', '.join(sorted(known))})",
+                    ))
+    kept.sort(key=lambda f: (f.path, f.line, f.code))
+    return kept, len(repo.files)
+
+
+def render_human(findings: list[Finding], nfiles: int) -> str:
+    lines = [f.render() for f in findings]
+    lines.append(
+        f"llmd-analysis: {nfiles} file(s), {len(findings)} finding(s)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], nfiles: int) -> str:
+    return json.dumps(
+        {"files": nfiles, "findings": [f.to_dict() for f in findings]},
+        indent=2,
+    )

@@ -312,6 +312,9 @@ class ModelRunner:
             or cfg.num_lora_adapters
         ):
             return params
+        # Jitted only so the donated tree fuses in-place instead of
+        # transiently doubling HBM (see docstring).
+        # llmd: allow(trace-discipline) -- one-shot at __init__ weight load, never on the step path
         return jax.jit(_fuse_projection_tree, donate_argnums=0)(
             jax.tree.map(jnp.asarray, params)
         )
@@ -1092,8 +1095,19 @@ class ModelRunner:
                 self._exec_embed(arrays, greedy)
             elif op == _OP_LORA:
                 self._exec_lora(arrays, QK)
-            else:
+            elif op == _OP_DECODE:
                 self._exec_decode(arrays, QK, bool(greedy))
+            else:
+                # An unknown opcode means leader and follower disagree on
+                # the dispatch protocol (e.g. an opcode added without a
+                # follower arm): the follower would mirror the WRONG
+                # program and desynchronize the SPMD collective stream.
+                # Crash loudly instead of hanging the whole group.
+                raise RuntimeError(
+                    f"follower received unknown lockstep opcode {op}; "
+                    "leader and follower builds disagree on the dispatch "
+                    "protocol"
+                )
 
     def stop_followers(self) -> None:
         if self._multihost and dist.is_leader():

@@ -203,6 +203,54 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
               legends=["saves/s", "restores/s"],
               desc="restores ≫ saves = HBM too small for the working set; "
                    "saves with zero restores = offload not earning its copies."),
+        panel("SWA ring sections",
+              [f"vllm:swa_ring_pages{M}", f"llmd:swa_sections{M}"],
+              legends=["ring pool pages", "retained sections"],
+              desc="Ring-pool size and hybrid-APC sections retained "
+                   "(CacheConfig.swa_section_cache); sections pinned at "
+                   "the cap = retention budget is the prefix-reuse limit."),
+        panel("SWA section activity /s",
+              [f"rate(llmd:swa_section_hits_total{M}[5m])",
+               f"rate(llmd:swa_section_captures_total{M}[5m])"],
+              legends=["hits/s", "captures/s"],
+              desc="captures with zero hits = retention is paying copy "
+                   "cost for prefixes that never repeat."),
+        row("Step pipeline (async stepping)"),
+        panel("Host gap per step",
+              [f"llmd:step_host_gap_ms{M}",
+               f"rate(llmd:step_host_gap_ms_total{M}[5m]) / "
+               f"rate(llmd:engine_steps_total{M}[5m])"],
+              legends=["last step (ms)", "mean (5m)"], unit="ms",
+              desc="Per-step host time the device idles for. Async "
+                   "scheduling shrinks it to the reconcile sliver; a "
+                   "regression here re-serializes the pipeline "
+                   "(docs/architecture/async-scheduling.md)."),
+        panel("Engine steps /s", [f"rate(llmd:engine_steps_total{M}[5m])"],
+              desc="Step cadence; flat at 0 while requests run = the "
+                   "step loop is wedged."),
+        panel("Async rollbacks /s",
+              [f"rate(llmd:async_rollbacks_total{M}[5m])"],
+              thresholds=[(None, "green"), (5, "yellow")],
+              desc="Staged rows invalidated by late EOS/max-tokens "
+                   "finishes. A few per second is the async contract "
+                   "working; a surge means the speculate-ahead window "
+                   "mismatches the workload's stop behavior."),
+        row("Speculative decoding"),
+        panel("Draft acceptance", [f"llmd:spec_acceptance_rate{M}"],
+              unit="percentunit", max1=True,
+              desc="accepted/proposed draft tokens. Near 0 with drafting "
+                   "on = proposer overhead for nothing; raise "
+                   "--spec-ngram-min-match or turn speculation off."),
+        panel("Draft tokens /s",
+              [f"rate(llmd:spec_proposed_tokens_total{M}[5m])",
+               f"rate(llmd:spec_accepted_tokens_total{M}[5m])"],
+              legends=["proposed/s", "accepted/s"]),
+        panel("Mean emitted tokens per row-step",
+              [f"1 + rate(llmd:spec_accepted_len_sum{M}[5m]) / "
+               f"rate(llmd:spec_accepted_len_count{M}[5m])"],
+              desc="From the llmd:spec_accepted_len histogram; this IS "
+                   "the decode speedup on a weight-read-bound engine "
+                   "(observability.md)."),
         row("Health"),
         panel("Preemptions /s", [f"rate(vllm:num_preemptions_total{M}[5m])"],
               thresholds=[(None, "green"), (0.5, "yellow"), (2, "red")],
@@ -214,6 +262,10 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
               [f"vllm:lora_requests_info{M}"], kind="table", h=6,
               desc="Adapter state gauge; available_lora_adapters lists the "
                    "full registered set for router affinity."),
+        panel("Cache geometry (block_size / num_gpu_blocks ride labels)",
+              [f"vllm:cache_config_info{M}"], kind="table", h=6,
+              desc="The BlockSize/NumGPUBlocks half of the EPP metrics "
+                   "contract (model-servers.md:38-52)."),
     ],
 )
 

@@ -1,67 +1,38 @@
 #!/usr/bin/env python3
 """Lint shell scripts for undeclared environment-variable use.
 
-Contract in scripts/ENVVARS.md: an all-caps variable may be read only if
-the script (a) requires it with ``${VAR:?...}``, (b) defaults it with
-``${VAR:-...}`` / ``${VAR:=...}``, (c) assigns it first, or (d) declares
-it in an ``# env: VAR`` comment. Enforced in CI via
-tests/test_deploy.py::test_envvar_lint. (Role model: the reference's
-scripts/lint-envvars.py env-declaration lint; independent implementation.)
+Thin shim over the ``envvars`` checker of the invariant-linter
+framework (``llmd_tpu/analysis``; docs/architecture/static-analysis.md)
+— the rule logic, finding machinery, and pragma handling live there;
+this script keeps the original CLI contract for the existing CI step
+and ``tests/test_deploy.py::test_envvar_lint``.
+
+Contract in scripts/ENVVARS.md: an all-caps variable may be read only
+if the script (a) requires it with ``${VAR:?...}``, (b) defaults it
+with ``${VAR:-...}`` / ``${VAR:=...}``, (c) assigns it first, or
+(d) declares it in an ``# env: VAR`` comment. (Role model: the
+reference's scripts/lint-envvars.py env-declaration lint; independent
+implementation.)
 """
 
 from __future__ import annotations
 
-import re
+import pathlib
 import subprocess
 import sys
 
-EXEMPT = {
-    "PATH", "HOME", "PWD", "OLDPWD", "TMPDIR", "USER", "SHELL", "LANG",
-    "LC_ALL", "TERM", "HOSTNAME", "RANDOM", "SECONDS", "LINENO", "OPTARG",
-    "OPTIND", "IFS", "EUID", "UID", "PPID", "BASH_SOURCE", "FUNCNAME",
-}
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-USE_RE = re.compile(r"\$\{?([A-Z][A-Z0-9_]*)\b")
-DECL_RE = re.compile(r"^\s*#\s*env:\s*([A-Z0-9_ ,]+)")
-GUARD_RE = re.compile(r"\$\{([A-Z][A-Z0-9_]*)(:?[-=?+])")
-ASSIGN_RE = re.compile(r"^\s*(?:export\s+)?([A-Z][A-Z0-9_]*)=")
-FOR_RE = re.compile(r"\bfor\s+([A-Z][A-Z0-9_]*)\b")
+from llmd_tpu.analysis.checkers.envvars import lint_lines  # noqa: E402
 
 
 def lint_file(path: str) -> list[str]:
     try:
         with open(path, encoding="utf-8", errors="replace") as f:
-            lines = f.readlines()
+            lines = f.read().splitlines()
     except OSError as e:
         return [f"{path}: unreadable: {e}"]
-    declared: set[str] = set(EXEMPT)
-    # Pass 1: collect declarations anywhere in the file — a guard at the
-    # top blesses every later bare use of the same var.
-    for line in lines:
-        m = DECL_RE.match(line)
-        if m:
-            declared.update(v for v in re.split(r"[ ,]+", m.group(1)) if v)
-        for m in GUARD_RE.finditer(line):
-            declared.add(m.group(1))
-        m = ASSIGN_RE.match(line)
-        if m:
-            declared.add(m.group(1))
-        m = FOR_RE.search(line)
-        if m:
-            declared.add(m.group(1))
-    # Pass 2: flag bare uses of anything never declared.
-    errors = []
-    for i, line in enumerate(lines, 1):
-        code = line.split("#", 1)[0]  # ignore comments
-        for m in USE_RE.finditer(code):
-            var = m.group(1)
-            if var not in declared:
-                errors.append(
-                    f"{path}:{i}: {var} used without declaration/default "
-                    "(see scripts/ENVVARS.md)"
-                )
-                declared.add(var)  # one report per var per file
-    return errors
+    return [f"{path}:{i}: {msg}" for i, _var, msg in lint_lines(lines)]
 
 
 def tracked_scripts() -> list[str]:

@@ -1,0 +1,743 @@
+"""The invariant linter (llmd_tpu/analysis): every rule fires on a bad
+fixture AND stays quiet on a good one, pragma/allowlist behavior, and
+the tree-is-clean gate (docs/architecture/static-analysis.md).
+
+The acceptance-critical pins: deleting any follower dispatch arm for an
+_OP_* opcode makes the suite exit nonzero, and adding an unlisted
+jax.device_get in engine/ makes it exit nonzero.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from llmd_tpu.analysis import run_analysis
+
+REPO = Path(__file__).resolve().parent.parent
+RUNNER = REPO / "llmd_tpu/engine/runner.py"
+
+
+def check(tmp_path: Path, files: dict[str, str], rules: list[str]):
+    """Write a fixture tree and run the selected rules over it."""
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    findings, _ = run_analysis(tmp_path, [str(tmp_path)], rules)
+    return findings
+
+
+def codes(findings) -> set[str]:
+    return {f.code for f in findings}
+
+
+# ------------------------------------------------------------------ #
+# host-sync
+
+
+class TestHostSync:
+    def test_device_get_in_engine_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import jax
+
+                def read(x):
+                    return jax.device_get(x)
+            """,
+        }, ["host-sync"])
+        assert codes(fs) == {"HS001"}
+
+    def test_block_until_ready_and_item_fire(self, tmp_path):
+        fs = check(tmp_path, {
+            "ops/bad.py": """
+                def f(x):
+                    x.block_until_ready()
+                    return x.item()
+            """,
+        }, ["host-sync"])
+        assert codes(fs) == {"HS002", "HS003"}
+
+    def test_module_level_block_until_ready_fires(self, tmp_path):
+        # The function-form spelling, jax.block_until_ready(x).
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import jax
+
+                def f(x):
+                    return jax.block_until_ready(x)
+            """,
+        }, ["host-sync"])
+        assert codes(fs) == {"HS002"}
+
+    def test_coercion_of_device_array_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import jax
+                import jax.numpy as jnp
+                import numpy as np
+
+                def f(arr: jax.Array):
+                    y = jnp.exp(arr)
+                    a = np.asarray(y)       # device result
+                    b = int(arr)            # annotated device param
+                    c = float(y[0])         # subscript of device name
+                    return a, b, c
+            """,
+        }, ["host-sync"])
+        assert [f.code for f in fs] == ["HS004", "HS004", "HS004"]
+
+    def test_host_coercions_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/good.py": """
+                import jax
+                import numpy as np
+
+                def f(ids, n):
+                    pt = np.asarray(ids, np.int32)   # host list
+                    devs = np.asarray(jax.devices()[:n])  # host metadata
+                    return pt, devs, int(n)
+            """,
+        }, ["host-sync"])
+        assert fs == []
+
+    def test_outside_hot_path_stays_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "serve/fine.py": """
+                import jax
+
+                def read(x):
+                    return jax.device_get(x)
+            """,
+        }, ["host-sync"])
+        assert fs == []
+
+    def test_declared_readback_site_allowlisted(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/runner.py": """
+                import jax
+
+                class ModelRunner:
+                    def wait_step(self, packs):
+                        return jax.device_get(packs)
+
+                    def other(self, packs):
+                        return jax.device_get(packs)
+            """,
+        }, ["host-sync"])
+        # Two identical device_gets; only the one OUTSIDE wait_step fires.
+        assert len(fs) == 1 and fs[0].code == "HS001"
+        assert fs[0].line == 9  # the `other` method's call, not wait_step's
+
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import jax
+
+                def read(x):
+                    # llmd: allow(host-sync) -- admin surface, off the step loop
+                    return jax.device_get(x)
+            """,
+        }, ["host-sync"])
+        assert fs == []
+
+    def test_pragma_without_reason_is_a_finding(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import jax
+
+                def read(x):
+                    # llmd: allow(host-sync)
+                    return jax.device_get(x)
+            """,
+        }, ["host-sync", "pragma"])
+        assert codes(fs) == {"PRAGMA001"}
+
+    def test_pragma_unknown_rule_is_a_finding(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/x.py": """
+                # llmd: allow(no-such-rule) -- because
+                X = 1
+            """,
+        }, ["host-sync", "pragma"])
+        assert codes(fs) == {"PRAGMA002"}
+
+
+# ------------------------------------------------------------------ #
+# trace-discipline
+
+
+class TestTraceDiscipline:
+    def test_per_call_jit_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import jax
+
+                class R:
+                    def step(self, f, x):
+                        return jax.jit(f)(x)
+            """,
+        }, ["trace-discipline"])
+        assert codes(fs) == {"TD001"}
+
+    def test_construction_contexts_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/good.py": """
+                import functools
+                import jax
+
+                @jax.jit
+                def top(x):
+                    return x
+
+                class R:
+                    def __init__(self):
+                        self._fwd = self._build_forward()
+
+                    def _build_forward(self):
+                        return jax.jit(lambda x: x)
+
+                    def _alloc_pool(self):
+                        return jax.jit(lambda: 0)()
+
+                    @functools.cached_property
+                    def _gather(self):
+                        return jax.jit(lambda kv: kv)
+            """,
+        }, ["trace-discipline"])
+        assert fs == []
+
+    def test_static_argnames_mismatch_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import functools
+                import jax
+
+                @functools.partial(jax.jit, static_argnames=("no_such_arg",))
+                def f(x, flag=False):
+                    return x
+            """,
+        }, ["trace-discipline"])
+        assert codes(fs) == {"TD002"}
+
+    def test_donate_argnums_out_of_range_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                import functools
+                import jax
+
+                @functools.partial(jax.jit, donate_argnums=(3,))
+                def f(x, y):
+                    return x + y
+            """,
+        }, ["trace-discipline"])
+        assert codes(fs) == {"TD003"}
+
+    def test_valid_static_and_donate_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/good.py": """
+                import functools
+                import jax
+
+                @functools.partial(
+                    jax.jit, donate_argnums=(1, 2) if True else (1,),
+                    static_argnames=("all_greedy",),
+                )
+                def f(params, kv, swa, all_greedy=False):
+                    return kv
+            """,
+        }, ["trace-discipline"])
+        assert fs == []
+
+    def test_kwargs_only_partial_call_form_does_not_crash(self, tmp_path):
+        # partial(jax.jit, donate_argnums=0) as a call expression has no
+        # positional target to cross-check; must not IndexError.
+        fs = check(tmp_path, {
+            "engine/good.py": """
+                from functools import partial
+                import jax
+
+                class R:
+                    def _build_step(self, f):
+                        step = partial(jax.jit, donate_argnums=0)
+                        return step(f)
+            """,
+        }, ["trace-discipline"])
+        assert fs == []
+
+    def test_unbucketed_dispatch_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                _OP_PREFILL = 1
+
+                class R:
+                    def dispatch(self, seqs):
+                        B = len(seqs)   # ad-hoc shape
+                        return self._sync(_OP_PREFILL, B, 1, False, {})
+            """,
+        }, ["trace-discipline"])
+        assert codes(fs) == {"TD004"}
+
+    def test_unbucketed_async_dispatch_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/bad.py": """
+                _OP_DECODE = 2
+
+                class R:
+                    async def dispatch(self, seqs):
+                        B = len(seqs)   # ad-hoc shape, async path
+                        return self._sync(_OP_DECODE, B, 1, False, {})
+            """,
+        }, ["trace-discipline"])
+        assert codes(fs) == {"TD004"}
+
+    def test_bucketed_staged_and_warm_dispatches_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "engine/good.py": """
+                _OP_PREFILL, _OP_DECODE = 1, 2
+
+                def pad_to_bucket(n, buckets):
+                    return n
+
+                class StagedDecode:
+                    pass
+
+                class R:
+                    def dispatch(self, seqs):
+                        B = pad_to_bucket(len(seqs), (8,))
+                        return self._sync(_OP_PREFILL, B, 1, False, {})
+
+                    def dispatch_staged(self, staged: StagedDecode):
+                        return self._sync(_OP_DECODE, staged.B, 1, False, {})
+
+                    def _warm_decode(self, B):
+                        return self._sync(_OP_DECODE, B, 1, False, {})
+            """,
+        }, ["trace-discipline"])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# lockstep
+
+_MINI_RUNNER = """
+    _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2
+
+    class ModelRunner:
+        def __init__(self):
+            self._forward = self._build_forward()
+
+        def _build_forward(self):
+            return lambda: None
+
+        def _sync(self, op, B, QK, greedy, arrays):
+            return arrays
+
+        def dispatch_prefill(self):
+            return self._sync(_OP_PREFILL, 8, 1, False, {})
+
+        def dispatch_decode(self):
+            return self._sync(_OP_DECODE, 8, 1, False, {})
+
+        def _exec_prefill(self, arrays):
+            return self._forward()
+
+        def _exec_decode(self, arrays):
+            return self._forward()
+
+        def follower_loop(self):
+            while True:
+                op = self._recv()
+                if op == _OP_STOP:
+                    return
+                if op == _OP_PREFILL:
+                    self._exec_prefill({})
+                elif op == _OP_DECODE:
+                    self._exec_decode({})
+                else:
+                    raise RuntimeError(f"unknown opcode {op}")
+"""
+
+
+class TestLockstep:
+    def test_clean_mini_runner(self, tmp_path):
+        fs = check(tmp_path, {"engine/runner.py": _MINI_RUNNER}, ["lockstep"])
+        assert fs == []
+
+    def test_missing_follower_arm_fires(self, tmp_path):
+        src = _MINI_RUNNER.replace(
+            "                elif op == _OP_DECODE:\n"
+            "                    self._exec_decode({})\n", "")
+        fs = check(tmp_path, {"engine/runner.py": src}, ["lockstep"])
+        assert "LS001" in codes(fs)
+
+    def test_fallthrough_else_fires(self, tmp_path):
+        src = _MINI_RUNNER.replace(
+            "                else:\n"
+            '                    raise RuntimeError(f"unknown opcode {op}")\n',
+            "                else:\n"
+            "                    self._exec_decode({})\n")
+        fs = check(tmp_path, {"engine/runner.py": src}, ["lockstep"])
+        assert "LS002" in codes(fs)
+
+    def test_unbroadcast_opcode_fires(self, tmp_path):
+        src = _MINI_RUNNER.replace(
+            "    _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2",
+            "    _OP_STOP, _OP_PREFILL, _OP_DECODE = 0, 1, 2\n"
+            "    _OP_GHOST = 9",
+        )
+        fs = check(tmp_path, {"engine/runner.py": src}, ["lockstep"])
+        # No follower arm AND never broadcast.
+        assert codes(fs) == {"LS001", "LS003"}
+
+    def test_magic_number_sync_fires(self, tmp_path):
+        src = _MINI_RUNNER.replace(
+            "return self._sync(_OP_DECODE, 8, 1, False, {})",
+            "return self._sync(2, 8, 1, False, {})",
+        )
+        fs = check(tmp_path, {"engine/runner.py": src}, ["lockstep"])
+        assert "LS004" in codes(fs)
+        assert "LS003" in codes(fs)  # _OP_DECODE no longer broadcast
+
+    def test_step_callable_outside_exec_fires(self, tmp_path):
+        src = _MINI_RUNNER.replace(
+            "        def dispatch_decode(self):\n"
+            "            return self._sync(_OP_DECODE, 8, 1, False, {})",
+            "        def dispatch_decode(self):\n"
+            "            self._forward()  # bypasses the broadcast\n"
+            "            return self._sync(_OP_DECODE, 8, 1, False, {})",
+        )
+        fs = check(tmp_path, {"engine/runner.py": src}, ["lockstep"])
+        assert "LS005" in codes(fs)
+
+    def test_step_callables_bind_to_follower_loop_class(self, tmp_path):
+        # A helper class with its own __init__ ABOVE the runner must not
+        # hijack the _build_* attribute search LS005 depends on.
+        src = "    class Helper:\n        def __init__(self):\n" \
+              "            self.x = 1\n\n" + _MINI_RUNNER
+        bad = src.replace(
+            "        def dispatch_decode(self):\n"
+            "            return self._sync(_OP_DECODE, 8, 1, False, {})",
+            "        def dispatch_decode(self):\n"
+            "            self._forward()  # bypasses the broadcast\n"
+            "            return self._sync(_OP_DECODE, 8, 1, False, {})",
+        )
+        fs = check(tmp_path, {"engine/runner.py": bad}, ["lockstep"])
+        assert "LS005" in codes(fs)
+
+    def test_real_runner_missing_verify_arm_fails(self, tmp_path):
+        """Acceptance pin: deleting one follower dispatch arm from the
+        REAL runner makes the suite exit nonzero."""
+        src = RUNNER.read_text()
+        arm = (
+            "            elif op == _OP_VERIFY:\n"
+            "                self._exec_verify(arrays, bool(greedy))\n"
+        )
+        assert arm in src, "follower_loop layout changed; update this pin"
+        mutated = src.replace(arm, "")
+        (tmp_path / "engine").mkdir(parents=True)
+        (tmp_path / "engine/runner.py").write_text(mutated)
+        findings, _ = run_analysis(tmp_path, [str(tmp_path)], ["lockstep"])
+        assert any(
+            f.code == "LS001" and "_OP_VERIFY" in f.message for f in findings
+        )
+
+    def test_real_runner_is_clean(self):
+        findings, _ = run_analysis(REPO, [str(RUNNER)], ["lockstep"])
+        assert findings == []
+
+
+# ------------------------------------------------------------------ #
+# metrics-parity
+
+_METRICS_GOOD = {
+    "llmd_tpu/serve/metrics.py": """
+        def render_metrics(stats, model_name):
+            gauges = {"queue_depth": stats.queue_depth}
+            counters = {}
+            counters["steps_total"] = stats.steps_total
+            return gauges, counters
+    """,
+    "llmd_tpu/engine/stats.py": """
+        class EngineStats:
+            queue_depth: int = 0
+            steps_total: int = 0
+    """,
+    "observability/dash.json": json.dumps({
+        "panels": [{"targets": [
+            {"expr": "vllm:queue_depth"},
+            {"expr": "rate(llmd:steps_total[5m])"},
+        ]}],
+    }),
+    "docs/architecture/observability.md":
+        "`queue_depth` and `steps_total` are emitted.\n",
+}
+
+
+class TestMetricsParity:
+    def test_aligned_surfaces_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, dict(_METRICS_GOOD), ["metrics-parity"])
+        assert fs == []
+
+    def test_emitted_but_no_dashboard_fires(self, tmp_path):
+        files = dict(_METRICS_GOOD)
+        files["observability/dash.json"] = json.dumps({
+            "panels": [{"targets": [{"expr": "vllm:queue_depth"}]}],
+        })
+        fs = check(tmp_path, files, ["metrics-parity"])
+        assert codes(fs) == {"MP001"}
+
+    def test_emitted_but_undocumented_fires(self, tmp_path):
+        files = dict(_METRICS_GOOD)
+        files["docs/architecture/observability.md"] = "`queue_depth` only.\n"
+        fs = check(tmp_path, files, ["metrics-parity"])
+        assert codes(fs) == {"MP002"}
+
+    def test_dashboard_references_unemitted_fires(self, tmp_path):
+        files = dict(_METRICS_GOOD)
+        files["observability/dash.json"] = json.dumps({
+            "panels": [{"targets": [
+                {"expr": "vllm:queue_depth"},
+                {"expr": "rate(llmd:steps_total[5m])"},
+                {"expr": "vllm:renamed_away_total"},
+            ]}],
+        })
+        fs = check(tmp_path, files, ["metrics-parity"])
+        assert codes(fs) == {"MP003"}
+
+    def test_stats_field_never_exposed_fires(self, tmp_path):
+        files = dict(_METRICS_GOOD)
+        files["llmd_tpu/engine/stats.py"] = """
+            class EngineStats:
+                queue_depth: int = 0
+                steps_total: int = 0
+                silent_stat: int = 0
+        """
+        fs = check(tmp_path, files, ["metrics-parity"])
+        assert codes(fs) == {"MP004"}
+        assert any("silent_stat" in f.message for f in fs)
+
+    def test_histogram_suffixes_canonicalize(self, tmp_path):
+        files = dict(_METRICS_GOOD)
+        files["observability/dash.json"] = json.dumps({
+            "panels": [{"targets": [
+                {"expr": "vllm:queue_depth"},
+                # _sum/_count fold onto the emitted base name
+                {"expr": "llmd:steps_total_sum / llmd:steps_total_count"},
+            ]}],
+        })
+        fs = check(tmp_path, files, ["metrics-parity"])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# config-parity
+
+_CONFIG_GOOD = {
+    "llmd_tpu/config.py": """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class SchedulerConfig:
+            max_num_seqs: int = 64
+            page_size: int = 16
+
+        @dataclasses.dataclass
+        class EngineConfig:
+            seed: int = 0
+    """,
+    "llmd_tpu/serve/__main__.py": """
+        import argparse
+
+        def build_parser():  # EngineConfig consumer
+            p = argparse.ArgumentParser()
+            p.add_argument("--max-num-seqs", type=int, default=64)
+            p.add_argument("--block-size", type=int, default=16)
+            p.add_argument("--host", default="0.0.0.0")
+            return p
+    """,
+    "docs/flags.md": "`--max-num-seqs`, `--block-size`, `--host`.\n",
+}
+
+
+class TestConfigParity:
+    def test_aligned_stays_quiet(self, tmp_path):
+        fs = check(tmp_path, dict(_CONFIG_GOOD), ["config-parity"])
+        assert fs == []
+
+    def test_flag_without_field_fires(self, tmp_path):
+        files = dict(_CONFIG_GOOD)
+        files["llmd_tpu/serve/__main__.py"] = files[
+            "llmd_tpu/serve/__main__.py"
+        ].replace(
+            'p.add_argument("--max-num-seqs", type=int, default=64)',
+            'p.add_argument("--max-num-seqs", type=int, default=64)\n'
+            '            p.add_argument("--renamed-knob", type=int)',
+        )
+        files["docs/flags.md"] += "`--renamed-knob`.\n"
+        fs = check(tmp_path, files, ["config-parity"])
+        assert codes(fs) == {"CP001"}
+
+    def test_undocumented_flag_fires(self, tmp_path):
+        files = dict(_CONFIG_GOOD)
+        files["docs/flags.md"] = "`--max-num-seqs`, `--host` only.\n"
+        fs = check(tmp_path, files, ["config-parity"])
+        assert codes(fs) == {"CP003"}
+        assert any("--block-size" in f.message for f in fs)
+
+    def test_real_flag_map_targets_exist(self):
+        """CP002 guard on the live tree: every FLAG_FIELD_MAP target is
+        a real config.py field (a rename there must update the map)."""
+        findings, _ = run_analysis(
+            REPO,
+            [str(REPO / "llmd_tpu/serve/__main__.py"),
+             str(REPO / "llmd_tpu/config.py"),
+             str(REPO / "docs"), str(REPO / "README.md")],
+            ["config-parity"],
+        )
+        assert findings == []
+
+
+# ------------------------------------------------------------------ #
+# envvars (framework checker; the scripts/lint-envvars.py shim is
+# covered by tests/test_deploy.py::test_envvar_lint)
+
+
+class TestEnvvars:
+    def test_undeclared_use_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "deploy/bad.sh": """
+                #!/bin/bash
+                echo "$UNDECLARED_THING"
+            """,
+        }, ["envvars"])
+        assert codes(fs) == {"EV001"}
+
+    def test_declared_uses_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "deploy/good.sh": """
+                #!/bin/bash
+                # env: EXTRA_VAR
+                : "${REQUIRED:?usage}"
+                DEFAULTED="${DEFAULTED:-x}"
+                ASSIGNED=1
+                echo "$REQUIRED $DEFAULTED $ASSIGNED $EXTRA_VAR $HOME"
+            """,
+        }, ["envvars"])
+        assert fs == []
+
+    def test_pragma_in_markdown_is_inert(self, tmp_path):
+        # Docs may quote pragma examples (even malformed ones) without
+        # tripping the hygiene rules — `#` is not a comment in markdown.
+        fs = check(tmp_path, {
+            "docs/example.md":
+                "Bad form (missing reason):\n"
+                "`# llmd: allow(host-sync)`\n"
+                "`# llmd: allow(imaginary-rule) -- why`\n",
+        }, ["pragma"])
+        assert fs == []
+
+    def test_pragma_suppresses_in_shell(self, tmp_path):
+        fs = check(tmp_path, {
+            "deploy/bad.sh": """
+                #!/bin/bash
+                # llmd: allow(envvars) -- injected by the operator docs
+                echo "$OPERATOR_PROVIDED"
+            """,
+        }, ["envvars"])
+        assert fs == []
+
+
+# ------------------------------------------------------------------ #
+# the standing gate + CLI surface
+
+
+class TestTreeGate:
+    def test_tree_is_clean(self):
+        """THE gate: the repo's own invariants hold. A finding here means
+        either fix the violation or pragma it with a written reason."""
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--json"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        payload = json.loads(out.stdout)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert payload["findings"] == []
+        assert payload["files"] > 100  # the scan actually covered the tree
+
+    def test_cli_nonzero_on_findings(self, tmp_path):
+        (tmp_path / "engine").mkdir()
+        (tmp_path / "engine/bad.py").write_text(
+            "import jax\n\ndef f(x):\n    return jax.device_get(x)\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--json",
+             "--root", str(tmp_path), str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 1
+        payload = json.loads(out.stdout)
+        assert [f["code"] for f in payload["findings"]] == ["HS001"]
+
+    def test_cli_list_rules(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--list-rules"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0
+        for rule in (
+            "host-sync", "trace-discipline", "lockstep", "metrics-parity",
+            "config-parity", "envvars", "pragma",
+        ):
+            assert rule in out.stdout
+
+    def test_paths_outside_root_are_scanned_not_crashed(self, tmp_path):
+        outside = tmp_path / "elsewhere/engine"
+        outside.mkdir(parents=True)
+        (outside / "bad.py").write_text(
+            "import jax\n\ndef f(x):\n    return jax.device_get(x)\n"
+        )
+        root = tmp_path / "root"
+        root.mkdir()
+        findings, _ = run_analysis(root, [str(outside)], ["host-sync"])
+        assert [f.code for f in findings] == ["HS001"]
+        assert findings[0].path.startswith("/")  # reported absolute
+
+    def test_cli_unknown_rule_is_usage_error(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--rules", "nope"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 2
+        assert "unknown rule" in out.stderr
+
+    def test_cli_empty_scan_set_is_an_error(self, tmp_path):
+        """0 files scanned = 0 invariants enforced: a wrong cwd/--root
+        must fail loudly, not hand CI a green exit."""
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--root",
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 2
+        assert "scan set is empty" in out.stderr
+
+    def test_analysis_imports_without_jax(self):
+        """The CI lint job runs the suite with NO third-party packages:
+        importing the analyzer must not pull in jax/numpy/yaml."""
+        out = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys\n"
+                "import llmd_tpu.analysis.checkers\n"
+                "bad = [m for m in ('jax', 'numpy', 'yaml', 'aiohttp')\n"
+                "       if m in sys.modules]\n"
+                "assert not bad, bad\n"
+            )],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
