@@ -112,10 +112,14 @@ class LoadGenerator:
                         for ln in lines:
                             if not ln.startswith(b"data:") or b"[DONE]" in ln:
                                 continue
-                            # The engine fuses up to decode_window tokens
-                            # per SSE frame, so frames undercount tokens:
-                            # trust the stream's usage frame and fall back
-                            # to frame counting only when usage is absent.
+                            # The engine fuses multiple tokens per SSE
+                            # frame — up to decode_window for plain
+                            # fused windows, and up to window x (1 + k)
+                            # when speculative fused verify windows
+                            # accept a full draft — so frames undercount
+                            # tokens: trust the stream's usage frame and
+                            # fall back to frame counting only when
+                            # usage is absent.
                             if b'"usage"' in ln:
                                 try:
                                     u = json.loads(ln[5:]).get("usage") or {}

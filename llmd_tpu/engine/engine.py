@@ -34,6 +34,7 @@ from llmd_tpu.engine.runner import (
     PendingPrefill,
     StagedDecode,
     StagedVerify,
+    StagedVerifyWindow,
     StepResult,
 )
 from llmd_tpu.engine.scheduler import EngineScheduler, ScheduledBatch
@@ -217,6 +218,18 @@ class EngineStats:
     spec_accepted_tokens_total: int = 0
     spec_acceptance_rate: float = 0.0
     spec_accepted_len_hist: tuple = ()
+    # Fused verify windows (spec x decode_window composition): verify
+    # row-iterations executed inside fused windows, and windowed rows
+    # that went inactive (emission limit reached) before their window's
+    # last iteration.
+    spec_window_iters_total: int = 0
+    spec_window_early_exit_total: int = 0
+    # Decode-side device programs dispatched, and the ratio that is the
+    # fused-window headline: decode dispatches per generated token —
+    # fused decode windows and fused verify windows both push it down
+    # by amortizing dispatch RTT over more emitted tokens.
+    decode_dispatches_total: int = 0
+    dispatches_per_emitted_token: float = 0.0
 
 
 @dataclass
@@ -760,7 +773,7 @@ class LLMEngine:
             for seq in batch.prefills:
                 self.stats.prompt_tokens += seq.num_tokens
         if batch.decodes:
-            pend_d = self._dispatch_decodes(batch.decodes)
+            pend_d = self._dispatch_decodes(batch.decodes, batch.spec_window)
         self.scheduler.note_dispatch(batch)
         t_dispatched = time.monotonic()
         # One coalesced readback for the whole step (prefill bucket
@@ -797,12 +810,18 @@ class LLMEngine:
             return []  # pipeline is one step deep: tokens land next call
         # ---- overlapped host region: the device is executing N ----
         staged = self.scheduler.schedule()  # speculative: pending counts
-        staged_dec: StagedDecode | StagedVerify | None = None
+        staged_dec: StagedDecode | StagedVerify | StagedVerifyWindow | None = None
         if staged.decodes:
             if self._spec_proposer is not None:
-                # Spec mode stages the verify shape; tokens/drafts/seeds
-                # fill at dispatch, after step N's readback commits.
-                staged_dec = self.runner.stage_spec_verify(staged.decodes)
+                # Spec mode stages the verify(-window) shape; tokens,
+                # drafts and seeds fill at dispatch, after step N's
+                # readback commits.
+                if staged.spec_window > 1:
+                    staged_dec = self.runner.stage_spec_verify_window(
+                        staged.decodes, staged.spec_window
+                    )
+                else:
+                    staged_dec = self.runner.stage_spec_verify(staged.decodes)
             else:
                 staged_dec = self.runner.stage_decode(
                     staged.decodes, k_steps=staged.decodes[0].num_tokens
@@ -837,7 +856,14 @@ class LLMEngine:
             self.stats.async_rollbacks_total += rolled
             if len(live_d) != len(staged.decodes):
                 staged_dec = None  # row set changed: restage at dispatch
-            staged = ScheduledBatch(prefills=live_p, decodes=live_d)
+            # Surviving rows keep their planned widths/draft caps, so
+            # the reconciled batch must keep its window too — dropping
+            # to the default would send window-planned rows down the
+            # one-shot verify path, whose arrays are only 1+k wide.
+            staged = ScheduledBatch(
+                prefills=live_p, decodes=live_d,
+                spec_window=staged.spec_window,
+            )
         if staged.is_empty and rolled and self.scheduler.has_work():
             # The whole slot was invalidated; the freed pages/budget may
             # admit different work now that nothing is pending.
@@ -859,7 +885,7 @@ class LLMEngine:
     def _dispatch_async(
         self,
         batch: ScheduledBatch,
-        staged_dec: StagedDecode | StagedVerify | None = None,
+        staged_dec: StagedDecode | StagedVerify | StagedVerifyWindow | None = None,
     ) -> None:
         now = time.monotonic()
         pend_p = None
@@ -869,21 +895,73 @@ class LLMEngine:
                 self.stats.prompt_tokens += seq.num_tokens
         pend_d = None
         if batch.decodes:
-            pend_d = self._dispatch_decodes(batch.decodes, staged_dec)
+            pend_d = self._dispatch_decodes(
+                batch.decodes, batch.spec_window, staged_dec
+            )
         self.scheduler.note_dispatch(batch)
         self._inflight = _InflightStep(batch, pend_p, pend_d, now)
 
     def _dispatch_decodes(
         self,
         decodes: list,
-        staged: StagedDecode | StagedVerify | None = None,
+        spec_window: int = 1,
+        staged: StagedDecode | StagedVerify | StagedVerifyWindow | None = None,
     ) -> PendingDecode:
-        """Dispatch the step's decode rows: the speculative verify path
+        """Dispatch the step's decode rows: the fused verify window when
+        the scheduler picked one, the one-shot speculative verify path
         when drafting is on and any row drafted, the plain decode
         program otherwise. ``staged`` reuses host arrays prebuilt by the
-        async pipeline when they still match the dispatch shape."""
+        async pipeline when they still match the dispatch shape —
+        including SLICING the row-independent page-table/knob rows for
+        mixed-step subsets instead of restaging them in the blocking
+        host region."""
+        pend = self._dispatch_decode_programs(decodes, spec_window, staged)
+        self.stats.decode_dispatches_total += len(pend.entries)
+        return pend
+
+    def _dispatch_decode_programs(
+        self,
+        decodes: list,
+        spec_window: int,
+        staged: StagedDecode | StagedVerify | StagedVerifyWindow | None,
+    ) -> PendingDecode:
         if self._spec_proposer is not None:
             self._propose_drafts(decodes)
+            window_staged = (
+                isinstance(staged, StagedVerifyWindow)
+                and staged.window == spec_window
+                and len(staged.seqs) == len(decodes)
+                and all(a is b for a, b in zip(staged.seqs, decodes))
+            )
+            if spec_window > 1:
+                if any(s.draft_tokens for s in decodes):
+                    # Fused verify window: drafting AND non-drafting
+                    # rows ride the same program (query-length masking
+                    # degrades draft-less rows to one-token iterations
+                    # on device) — one dispatch, one readback per K
+                    # verify iterations.
+                    if not window_staged:
+                        staged = self.runner.stage_spec_verify_window(
+                            decodes, spec_window
+                        )
+                    return self.runner.dispatch_staged_verify_window(staged)
+                # NO row drafted this window: degrade to the plain fused
+                # decode program at the window depth — [B, 1] columns
+                # instead of [B, 1+k], so fully backed-off (adversarial)
+                # traffic keeps the window's dispatch amortization
+                # without paying idle verify columns. Depth stays at the
+                # WINDOW (the verify window's iteration count, and the
+                # proposer's probe cadence), capped by the smallest
+                # planned width so no row outruns its pages, then
+                # clamped DOWN to a warmed decode shape — an unwarmed K
+                # would block serving on a fresh XLA compile mid-step.
+                k = min(spec_window, min(s.num_tokens for s in decodes))
+                k = max(w for w in self.runner.decode_windows if w <= k)
+                if window_staged:
+                    return self.runner.dispatch_staged_decode(
+                        self.runner.degrade_staged_window(staged, k)
+                    )
+                return self.runner.dispatch_decode(decodes, k_steps=k)
             drafted = sum(1 for s in decodes if s.draft_tokens)
             if drafted == len(decodes):
                 if not isinstance(staged, StagedVerify):
@@ -897,10 +975,13 @@ class LLMEngine:
                 # page truncation still run.
                 return self.runner.dispatch_decode(decodes, k_steps=1)
             # Mixed step: drafting rows verify, the rest decode plainly
-            # (two enqueues, one coalesced readback). The async-staged
-            # verify arrays covered the full row set, so they can't be
-            # reused here.
-            return self.runner.dispatch_spec_split(decodes)
+            # (two enqueues, one coalesced readback). The prestaged
+            # full-batch verify arrays are reused by slicing their
+            # row-independent rows per subset.
+            return self.runner.dispatch_spec_split(
+                decodes,
+                staged if isinstance(staged, StagedVerify) else None,
+            )
         if not isinstance(staged, StagedDecode):
             staged = self.runner.stage_decode(
                 decodes, k_steps=decodes[0].num_tokens
@@ -911,18 +992,22 @@ class LLMEngine:
         """Fill each speculative decode row's draft from COMMITTED
         history, at dispatch time — async staging runs a step early,
         where the history is stale and the tail token unknown. The cap
-        of num_tokens - 1 (the scheduler's planned width) guarantees the
-        draft never writes a slot that wasn't allocated, even when a
-        short acceptance left the row behind its planned position."""
+        — spec_draft_cap (windowed rows: up to window x (1+k) - 1, 0
+        for backed-off rows) or num_tokens - 1 (the one-shot planned
+        width) — guarantees the draft never writes a slot that wasn't
+        allocated, even when a short acceptance left the row behind its
+        planned position."""
         max_len = self.config.model.max_model_len
         for seq in decodes:
             req = seq.request
-            # num_tokens == 1 rows were planned draft-less (max_model_len
-            # cap or draft backoff, scheduler._spec_eligible) — no
-            # proposer call, no verify columns.
-            cap = min(
-                seq.num_tokens - 1, max_len - req.num_computed_tokens - 1
+            # Rows planned draft-less (max_model_len cap or draft
+            # backoff, scheduler._spec_eligible) get no proposer call
+            # and no verify columns.
+            cap = (
+                seq.num_tokens - 1
+                if seq.spec_draft_cap is None else seq.spec_draft_cap
             )
+            cap = min(cap, max_len - req.num_computed_tokens - 1)
             if cap <= 0:
                 seq.draft_tokens = []
                 continue
@@ -954,9 +1039,22 @@ class LLMEngine:
         if batch.decodes and dres is not None:
             for i, seq in enumerate(batch.decodes):
                 toks, lps = dres.tokens[i], dres.logprobs[i]
-                if seq.draft_tokens is not None:
-                    # Speculative row: only 1 + draft_len columns are
-                    # real; the rest are the verify shape's padding.
+                if dres.meta is not None:
+                    # Fused verify window: the device already resolved
+                    # acceptance — the meta columns carry the emitted
+                    # count (plus drafted/accepted/iters for the
+                    # scheduler's accounting), and only that prefix of
+                    # the packed window is real.
+                    seq.device_accept = tuple(int(v) for v in dres.meta[i])
+                    m = int(dres.meta[i, 0])
+                    toks, lps = toks[:m], lps[:m]
+                elif seq.draft_tokens is not None and batch.spec_window == 1:
+                    # One-shot speculative row: only 1 + draft_len
+                    # columns are real; the rest are the verify shape's
+                    # padding. (A windowed batch that degraded to the
+                    # plain fused decode program keeps every column —
+                    # each fused iteration emitted one committed
+                    # sample.)
                     m = 1 + len(seq.draft_tokens)
                     toks, lps = toks[:m], lps[:m]
                 sampled[seq.request.request_id] = toks.tolist()
@@ -1031,6 +1129,13 @@ class LLMEngine:
                 sch.spec_accepted_tokens / max(1, sch.spec_proposed_tokens), 6
             )
             self.stats.spec_accepted_len_hist = tuple(sch.spec_accept_len_hist)
+            self.stats.spec_window_iters_total = sch.spec_window_iters
+            self.stats.spec_window_early_exit_total = sch.spec_window_early_exit
+        self.stats.dispatches_per_emitted_token = round(
+            self.stats.decode_dispatches_total
+            / max(1, self.stats.generation_tokens),
+            6,
+        )
         if self.config.model.num_lora_adapters:
             self.stats.max_lora = self.config.model.num_lora_adapters
             self.stats.running_lora_adapters = tuple(

@@ -105,6 +105,7 @@ def make_engine_config(args, lora_adapters=None):
             speculative_ngram=args.speculative_ngram,
             spec_ngram_k=args.spec_ngram_k,
             spec_ngram_min_match=args.spec_ngram_min_match,
+            spec_verify_window=args.spec_verify_window,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -206,6 +207,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--spec-ngram-min-match", type=int, default=2,
         help="minimum trailing n-gram length that must recur in the "
              "sequence's own history before a draft is proposed",
+    )
+    p.add_argument(
+        "--spec-verify-window", type=int, default=0,
+        help="max verify iterations fused into one dispatch when "
+             "--speculative-ngram composes with fused decode windows: "
+             "accept/reject runs ON DEVICE and the host pays one "
+             "round-trip per window. 0 (default) inherits "
+             "--decode-window; 1 pins one-shot verify steps "
+             "(docs/architecture/speculative-decoding.md)",
     )
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)

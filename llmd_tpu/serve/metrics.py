@@ -33,6 +33,13 @@ def render_metrics(
         # reconcile/patch sliver; the *_total counters let a scraper (or
         # bench.py --parts async_step) compute a mean over any interval.
         "step_host_gap_ms": round(stats.step_host_gap_ms, 3),
+        # Decode dispatches per generated token: the fused-window
+        # headline ratio — plain decode windows and fused verify
+        # windows both push it down by amortizing dispatch RTT over
+        # more emitted tokens per device program.
+        "dispatches_per_emitted_token": round(
+            stats.dispatches_per_emitted_token, 6
+        ),
     }
     if stats.swa_ring_pages:
         gauges["swa_ring_usage_perc"] = round(stats.swa_ring_usage, 6)
@@ -61,6 +68,7 @@ def render_metrics(
         "engine_steps_total": stats.engine_steps_total,
         "step_host_gap_ms_total": round(stats.step_host_gap_ms_total, 3),
         "async_rollbacks_total": stats.async_rollbacks_total,
+        "decode_dispatches_total": stats.decode_dispatches_total,
     }
     if stats.swa_ring_pages:
         # Hybrid-APC section retention activity
@@ -99,6 +107,15 @@ def render_metrics(
         for name, v in (
             ("spec_proposed_tokens_total", stats.spec_proposed_tokens_total),
             ("spec_accepted_tokens_total", stats.spec_accepted_tokens_total),
+            # Fused verify windows (spec x decode_window): verify
+            # row-iterations run inside fused windows, and windowed
+            # rows that hit their emission limit before the window's
+            # last iteration.
+            ("spec_window_iters_total", stats.spec_window_iters_total),
+            (
+                "spec_window_early_exit_total",
+                stats.spec_window_early_exit_total,
+            ),
         ):
             lines.append(f"# TYPE llmd:{name} counter")
             lines.append(f"llmd:{name}{label} {v}")

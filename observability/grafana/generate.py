@@ -235,6 +235,15 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
                    "finishes. A few per second is the async contract "
                    "working; a surge means the speculate-ahead window "
                    "mismatches the workload's stop behavior."),
+        panel("Dispatches per emitted token",
+              [f"llmd:dispatches_per_emitted_token{M}",
+               f"rate(llmd:decode_dispatches_total{M}[5m])"],
+              legends=["dispatches/token (lifetime)", "decode dispatches/s"],
+              desc="Decode device programs per generated token — the "
+                   "fused-window headline: plain decode windows and "
+                   "fused verify windows (speculative-decoding.md) both "
+                   "amortize dispatch RTT, pushing the ratio toward "
+                   "1/window x mean emitted per iteration."),
         row("Speculative decoding"),
         panel("Draft acceptance", [f"llmd:spec_acceptance_rate{M}"],
               unit="percentunit", max1=True,
@@ -251,6 +260,15 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
               desc="From the llmd:spec_accepted_len histogram; this IS "
                    "the decode speedup on a weight-read-bound engine "
                    "(observability.md)."),
+        panel("Fused verify window activity /s",
+              [f"rate(llmd:spec_window_iters_total{M}[5m])",
+               f"rate(llmd:spec_window_early_exit_total{M}[5m])"],
+              legends=["verify row-iterations/s", "early exits/s"],
+              desc="Verify iterations run inside fused windows "
+                   "(spec x decode_window composition) and windowed "
+                   "rows that hit their emission limit early. Zero "
+                   "iterations with the window on = every step degraded "
+                   "to plain decode (drafts never fire)."),
         row("Health"),
         panel("Preemptions /s", [f"rate(vllm:num_preemptions_total{M}[5m])"],
               thresholds=[(None, "green"), (0.5, "yellow"), (2, "red")],

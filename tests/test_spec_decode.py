@@ -28,7 +28,8 @@ from llmd_tpu.engine.spec import NgramProposer
 
 def make_engine(
     spec=False, async_mode=False, num_blocks=64, page=4, max_batched=64,
-    max_seqs=8, seed=0, k=4, min_match=2, prefix_caching=True, **model_kw,
+    max_seqs=8, seed=0, k=4, min_match=2, prefix_caching=True, window=1,
+    **model_kw,
 ) -> LLMEngine:
     cfg = EngineConfig(
         model=tiny_model_config(**model_kw),
@@ -40,6 +41,7 @@ def make_engine(
             max_num_seqs=max_seqs, max_num_batched_tokens=max_batched,
             async_scheduling=async_mode, speculative_ngram=spec,
             spec_ngram_k=k, spec_ngram_min_match=min_match,
+            decode_window=window,
         ),
         parallel=ParallelConfig(tensor_parallel_size=1),
         seed=seed,
@@ -322,12 +324,303 @@ def test_spec_truncation_returns_pages_sync():
 
 
 # --------------------------------------------------------------------- #
+# fused verify windows (spec x decode_window composition)
+
+
+@pytest.mark.parametrize("window", [2, 4])
+def test_spec_window_parity_greedy(window):
+    """The fused verify window changes how many host round-trips emit
+    the stream, never WHICH tokens: byte parity vs the spec-off engine
+    across window sizes, with windows actually engaging."""
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    base = make_engine(False).generate([list(p) for p in PROMPTS], sp)
+    eng = make_engine(True, window=window)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.scheduler.spec_window_iters > 0  # windows actually ran
+    assert eng.scheduler.spec_accepted_tokens > 0
+    assert eng.allocator.usage() == 0.0
+
+
+@pytest.mark.parametrize("window", [2, 4])
+def test_spec_window_parity_seeded(window):
+    """Seeded rows accept via the per-(seed, output-index) derivation
+    computed ON DEVICE (`sampler.spec_seed` inside the fori_loop body —
+    a row's output index mid-window depends on its own acceptance);
+    the stream must equal the spec-off engine's bit for bit. Long
+    enough outputs that decode spans several windows (a single window
+    would finish the request before any draft can fire)."""
+    sp = SamplingParams(temperature=0.3, max_tokens=40, seed=77, ignore_eos=True)
+    base = make_engine(False, seed=3, num_blocks=96).generate(
+        [list(p) for p in PROMPTS], sp
+    )
+    eng = make_engine(True, window=window, seed=3, num_blocks=96)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.scheduler.spec_window_iters > 0
+    assert eng.scheduler.spec_proposed_tokens > 0
+
+
+def test_spec_window_mid_rejection_truncation_invariant():
+    """Mid-window rejection: the device degrades the row to one-token
+    iterations and the host's `_truncate_spec_pages` frees everything
+    past the accepted span — the allocator's content index must hold
+    accepted content only, and no running row may retain pages past its
+    computed span between steps."""
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    eng = make_engine(True, window=4, page=4, num_blocks=96)
+    for p in PROMPTS:
+        eng.add_request(list(p), sp)
+    saw_window = False
+    streams: dict[str, list[int]] = {}
+    for _ in range(64):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            streams.setdefault(out.request_id, []).extend(out.new_token_ids)
+        if eng.scheduler.spec_window_iters:
+            saw_window = True
+        for req in eng.scheduler.running:
+            if req.in_decode:
+                max_pages = -(-req.num_computed_tokens // 4)
+                assert len(req.block_ids) <= max_pages + 1, (
+                    req.request_id, req.num_computed_tokens,
+                    len(req.block_ids),
+                )
+    assert saw_window
+    sch = eng.scheduler
+    assert sch.spec_proposed_tokens > sch.spec_accepted_tokens > 0, (
+        "workload produced no mid-window rejections: nothing was proved"
+    )
+    _committed_hashes_are_subset_of_accepted(
+        eng, list(streams.values()), PROMPTS
+    )
+    assert eng.allocator.usage() == 0.0
+
+
+def test_spec_window_preemption():
+    """Page pressure while planning a window's max-acceptance width
+    (window x (1+k) pages per row) triggers recompute-preemption inside
+    the window machinery; streams must still match the spec-off engine
+    run under the SAME pool."""
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    kw = dict(page=4, num_blocks=20, max_batched=64)
+    base = make_engine(False, **kw).generate([list(p) for p in PROMPTS], sp)
+    eng = make_engine(True, window=4, **kw)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.scheduler.num_preemptions > 0, (
+        "pool was not tight enough to exercise preemption"
+    )
+
+
+def test_spec_window_async_rollback():
+    """Fused verify windows compose with async stepping: the staged
+    batch plans window x (1+k) pending tokens per row, short acceptance
+    reconciles through the pending-count drain, and LENGTH finishes
+    invalidate staged rows through the rollback path."""
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    base = make_engine(False).generate([list(p) for p in PROMPTS], sp)
+    eng = make_engine(True, window=4, async_mode=True)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng._inflight is None
+    assert eng.scheduler.spec_window_iters > 0
+    assert eng.stats.async_rollbacks_total >= 1
+    assert eng.allocator.usage() == 0.0
+
+
+def test_spec_window_one_readback_per_window():
+    """THE point of the fusion: exactly one host readback per engine
+    step (a whole window of verify iterations rides one coalesced
+    transfer), and dispatches-per-emitted-token at window=4 is at most
+    half the window=1 value on this draft-friendly workload."""
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+
+    def run(window):
+        eng = make_engine(True, window=window)
+        calls = {"n": 0}
+        orig = eng.runner.wait_step
+        def counting(prefill, decode):
+            calls["n"] += 1
+            return orig(prefill, decode)
+        eng.runner.wait_step = counting
+        eng.generate([list(p) for p in PROMPTS], sp)
+        # one blocking readback per step, however many verify
+        # iterations (and prefill groups) the step fused
+        assert calls["n"] == eng.stats.engine_steps_total
+        return eng
+
+    w1 = run(1)
+    w4 = run(4)
+    assert w4.scheduler.spec_window_iters > 0
+    assert w1.stats.generation_tokens == w4.stats.generation_tokens
+    r1 = w1.stats.dispatches_per_emitted_token
+    r4 = w4.stats.dispatches_per_emitted_token
+    assert r4 <= 0.5 * r1, (r4, r1)
+
+
+def test_spec_window_metrics_surface():
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    eng = make_engine(True, window=4)
+    eng.generate([list(p) for p in PROMPTS], sp)
+    st = eng.stats
+    assert st.spec_window_iters_total > 0
+    assert st.decode_dispatches_total > 0
+    assert 0.0 < st.dispatches_per_emitted_token < 1.0
+    from llmd_tpu.serve.metrics import parse_prometheus, render_metrics
+
+    page = render_metrics(st, "tiny")
+    parsed = parse_prometheus(page)
+    assert parsed["llmd:spec_window_iters_total"] == st.spec_window_iters_total
+    assert (
+        parsed["llmd:spec_window_early_exit_total"]
+        == st.spec_window_early_exit_total
+    )
+    assert parsed["llmd:decode_dispatches_total"] == st.decode_dispatches_total
+    assert "llmd:dispatches_per_emitted_token" in parsed
+
+
+def test_spec_window_accept_len_hist_mean_is_exact():
+    """Windowed acceptance folds into the accepted-len histogram with
+    (count, sum) preserved: count equals the verify row-iterations run
+    and sum equals the accepted draft tokens, so the dashboard's
+    mean-emitted reading stays exact."""
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    eng = make_engine(True, window=4)
+    eng.generate([list(p) for p in PROMPTS], sp)
+    sch = eng.scheduler
+    hist = sch.spec_accept_len_hist
+    assert sum(j * c for j, c in enumerate(hist)) == sch.spec_accepted_tokens
+    # every hist count is a (row, iteration-or-step) sample; window rows
+    # contributed exactly their active iterations
+    assert sum(hist) >= sch.spec_window_iters > 0
+
+
+def test_spec_window_async_staggered_finishes():
+    """Async rollback inside window mode: a batch-mate finishing at
+    reconcile must NOT demote the surviving window-planned rows (widths
+    up to window x (1+k), pre-draft caps to match) onto the one-shot
+    verify path — whose arrays are only 1+k wide, so a windowed draft
+    overruns them. The reconciled batch must keep its window: every
+    reconcile-step dispatch whose surviving rows carry window-planned
+    widths must still see spec_window > 1. Staggered max_tokens force
+    rollbacks on several different steps."""
+    prompts = [list(p) for p in (PROMPTS * 2)]
+    params = [
+        SamplingParams(
+            temperature=0.0, max_tokens=8 + 3 * i, ignore_eos=True
+        )
+        for i in range(len(prompts))
+    ]
+    base = make_engine(False, num_blocks=128, max_seqs=8).generate(
+        [list(p) for p in prompts], list(params)
+    )
+    eng = make_engine(
+        True, window=4, async_mode=True, num_blocks=128, max_seqs=8
+    )
+    spec_k = eng.scheduler.spec_k
+    reconciled: list[tuple[int, int]] = []  # (spec_window, max planned)
+    seen = {"rollbacks": 0}
+    orig = eng._dispatch_async
+
+    def spy(batch, staged_dec=None):
+        if (
+            eng.stats.async_rollbacks_total > seen["rollbacks"]
+            and batch.decodes
+        ):
+            reconciled.append((
+                batch.spec_window,
+                max(s.num_tokens for s in batch.decodes),
+            ))
+        seen["rollbacks"] = eng.stats.async_rollbacks_total
+        return orig(batch, staged_dec)
+
+    eng._dispatch_async = spy
+    out = eng.generate([list(p) for p in prompts], list(params))
+    assert list(base.values()) == list(out.values())
+    assert eng.stats.async_rollbacks_total > 0
+    assert eng.scheduler.spec_window_iters > 0
+    survived_windowed = [
+        (w, width) for w, width in reconciled if width > 1 + spec_k
+    ]
+    assert survived_windowed, (
+        "no reconciled batch kept window-planned survivors: the "
+        "rollback-keeps-window path was never exercised", reconciled,
+    )
+    assert all(w > 1 for w, _ in survived_windowed), (
+        "a reconciled batch dropped its spec_window while its rows "
+        "kept window-planned widths", reconciled,
+    )
+    assert eng.allocator.usage() == 0.0
+
+
+def test_async_mixed_step_reuses_staged_arrays():
+    """Async+spec mixed steps (only SOME rows drafting at dispatch)
+    must SLICE the prestaged full-batch verify arrays by the subset
+    index sets instead of restaging inside the blocking host region —
+    and the sliced dispatch must stay byte-identical to the spec-off
+    engine."""
+    from llmd_tpu.engine.runner import ModelRunner
+
+    hits = {"verify": 0, "decode": 0}
+    orig_v = ModelRunner._subset_staged_verify
+    orig_d = ModelRunner._subset_staged_decode
+
+    def count_v(self, *a, **k):
+        hits["verify"] += 1
+        return orig_v(self, *a, **k)
+
+    def count_d(self, *a, **k):
+        hits["decode"] += 1
+        return orig_d(self, *a, **k)
+
+    # Mixed drafting needs rows that loop alongside rows that don't.
+    prompts = [list(p) for p in PROMPTS] + [[9, 9, 9, 1, 2, 3, 4, 5]]
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    base = make_engine(False, num_blocks=96).generate(
+        [list(p) for p in prompts], sp
+    )
+    eng = make_engine(True, async_mode=True, num_blocks=96)
+    try:
+        ModelRunner._subset_staged_verify = count_v
+        ModelRunner._subset_staged_decode = count_d
+        out = eng.generate([list(p) for p in prompts], sp)
+    finally:
+        ModelRunner._subset_staged_verify = orig_v
+        ModelRunner._subset_staged_decode = orig_d
+    assert list(base.values()) == list(out.values())
+    assert hits["verify"] > 0 and hits["decode"] > 0, (
+        "no mixed step reused the prestaged arrays: the slicing path "
+        "was never exercised", hits,
+    )
+
+
+# --------------------------------------------------------------------- #
 # config / observability surfaces
 
 
-def test_spec_rejects_decode_window():
-    with pytest.raises(ValueError, match="decode_window"):
-        SchedulerConfig(speculative_ngram=True, decode_window=4)
+def test_spec_window_config():
+    """The composition is accepted now; the window-aware validation
+    rejects knob combinations that could only misconfigure."""
+    cfg = SchedulerConfig(speculative_ngram=True, decode_window=4)
+    assert cfg.spec_window == 4
+    assert cfg.spec_window_set == (2, 4)
+    # explicit override decouples the verify window from decode_window
+    cfg = SchedulerConfig(
+        speculative_ngram=True, decode_window=8, spec_verify_window=2
+    )
+    assert cfg.spec_window == 2
+    assert SchedulerConfig(speculative_ngram=True).spec_window_set == ()
+    with pytest.raises(ValueError, match="spec_verify_window"):
+        SchedulerConfig(spec_verify_window=-1)
+    with pytest.raises(ValueError, match="speculative_ngram"):
+        SchedulerConfig(spec_verify_window=4)
+    with pytest.raises(ValueError, match="max_num_batched_tokens"):
+        SchedulerConfig(
+            speculative_ngram=True, decode_window=2, spec_ngram_k=4,
+            max_num_batched_tokens=8,
+        )
 
 
 def test_spec_metrics_surface():

@@ -447,6 +447,26 @@ class TestLockstep:
             f.code == "LS001" and "_OP_VERIFY" in f.message for f in findings
         )
 
+    def test_real_runner_missing_verify_window_arm_fails(self, tmp_path):
+        """Acceptance pin for the fused verify window's opcode: deleting
+        the _OP_VERIFY_WINDOW follower arm from the REAL runner must
+        fail the build (a follower would mirror the wrong program and
+        desynchronize the lockstep collective stream)."""
+        src = RUNNER.read_text()
+        arm = (
+            "            elif op == _OP_VERIFY_WINDOW:\n"
+            "                self._exec_verify_window(arrays, QK, bool(greedy))\n"
+        )
+        assert arm in src, "follower_loop layout changed; update this pin"
+        mutated = src.replace(arm, "")
+        (tmp_path / "engine").mkdir(parents=True)
+        (tmp_path / "engine/runner.py").write_text(mutated)
+        findings, _ = run_analysis(tmp_path, [str(tmp_path)], ["lockstep"])
+        assert any(
+            f.code == "LS001" and "_OP_VERIFY_WINDOW" in f.message
+            for f in findings
+        )
+
     def test_real_runner_is_clean(self):
         findings, _ = run_analysis(REPO, [str(RUNNER)], ["lockstep"])
         assert findings == []
