@@ -321,6 +321,17 @@ class SchedulerConfig:
     # matches a larger plain decode window). 1 pins the one-shot verify
     # path even when decode_window > 1.
     spec_verify_window: int = 0
+    # Unified single-dispatch step: pack an entire window=1 engine step —
+    # chunked-prefill token runs, plain decode rows, and one-shot
+    # [B, 1+k] verify rows — into ONE bucketed ragged program with one
+    # coalesced readback, where the split engine launches up to three
+    # (prefill groups, verify split, plain decode) plus one lockstep
+    # broadcast each on multi-host. Greedy and seeded streams stay
+    # byte-identical to the split engine; turning this off restores the
+    # per-family dispatch paths (the split fallback). Windowed programs
+    # (fused decode windows, fused verify windows) keep their own
+    # dispatch either way — they already amortize the round-trip.
+    unified_step: bool = True
 
     def __post_init__(self) -> None:
         if self.spec_verify_window < 0:

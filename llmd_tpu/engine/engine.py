@@ -32,7 +32,9 @@ from llmd_tpu.engine.runner import (
     ModelRunner,
     PendingDecode,
     PendingPrefill,
+    PendingUnified,
     StagedDecode,
+    StagedUnified,
     StagedVerify,
     StagedVerifyWindow,
     StepResult,
@@ -230,6 +232,17 @@ class EngineStats:
     # by amortizing dispatch RTT over more emitted tokens.
     decode_dispatches_total: int = 0
     dispatches_per_emitted_token: float = 0.0
+    # Unified single-dispatch steps (SchedulerConfig.unified_step): engine
+    # steps whose entire window=1 batch — prefill chunks + decode rows +
+    # one-shot verify rows — rode ONE ragged program. The family split of
+    # decode_dispatches_total: unified_steps_total of those dispatches
+    # came from the unified family, the rest from the split families.
+    unified_steps_total: int = 0
+    # EVERY device program engine steps dispatched (prefill bucket
+    # groups + decode-side programs + unified programs): the unified
+    # step's headline is step_dispatches_total / engine_steps_total
+    # falling toward 1.0 on mixed workloads.
+    step_dispatches_total: int = 0
 
 
 @dataclass
@@ -240,6 +253,7 @@ class _InflightStep:
     pending_prefill: PendingPrefill | None
     pending_decode: PendingDecode | None
     dispatch_time: float
+    pending_unified: PendingUnified | None = None
 
 
 class LLMEngine:
@@ -767,19 +781,24 @@ class LLMEngine:
                 for s in batch.prefills
             )
         )
-        pend_p = pend_d = None
-        if batch.prefills:
-            pend_p = self.runner.dispatch_prefill(batch.prefills)
-            for seq in batch.prefills:
-                self.stats.prompt_tokens += seq.num_tokens
-        if batch.decodes:
-            pend_d = self._dispatch_decodes(batch.decodes, batch.spec_window)
+        pend_p = pend_d = pend_u = None
+        if not eager_ack and self._unified_eligible(batch):
+            pend_u = self._dispatch_unified(batch, None)
+        else:
+            if batch.prefills:
+                pend_p = self.runner.dispatch_prefill(batch.prefills)
+                self.stats.step_dispatches_total += len(pend_p.entries)
+                for seq in batch.prefills:
+                    self.stats.prompt_tokens += seq.num_tokens
+            if batch.decodes:
+                pend_d = self._dispatch_decodes(batch.decodes, batch.spec_window)
         self.scheduler.note_dispatch(batch)
         t_dispatched = time.monotonic()
         # One coalesced readback for the whole step (prefill bucket
-        # groups + the decode window come back in a single transfer).
+        # groups + the decode window — or the one unified program —
+        # come back in a single transfer).
         pres, dres = self.runner.wait_step(
-            None if eager_ack else pend_p, pend_d
+            None if eager_ack else pend_p, pend_d, pend_u
         )
         t_read = time.monotonic()
         sampled, logprobs = self._collect(batch, pres, dres)
@@ -810,8 +829,20 @@ class LLMEngine:
             return []  # pipeline is one step deep: tokens land next call
         # ---- overlapped host region: the device is executing N ----
         staged = self.scheduler.schedule()  # speculative: pending counts
-        staged_dec: StagedDecode | StagedVerify | StagedVerifyWindow | None = None
-        if staged.decodes:
+        staged_dec: (
+            StagedDecode | StagedVerify | StagedVerifyWindow
+            | StagedUnified | None
+        ) = None
+        if self._unified_eligible(staged):
+            # Unified single-dispatch step: the row structure and the
+            # row-independent arrays (page/ring tables, knobs) prestage
+            # here; the packed stream, (start, qlen, kind) metadata,
+            # drafts and seeds fill at dispatch, after step N's
+            # readback commits.
+            staged_dec = self.runner.stage_unified(
+                staged.prefills, staged.decodes
+            )
+        elif staged.decodes:
             if self._spec_proposer is not None:
                 # Spec mode stages the verify(-window) shape; tokens,
                 # drafts and seeds fill at dispatch, after step N's
@@ -828,7 +859,8 @@ class LLMEngine:
                 )
         # ---- block on step N's single coalesced readback ----
         pres, dres = self.runner.wait_step(
-            inflight.pending_prefill, inflight.pending_decode
+            inflight.pending_prefill, inflight.pending_decode,
+            inflight.pending_unified,
         )
         t_read = time.monotonic()
         sampled, logprobs = self._collect(inflight.batch, pres, dres)
@@ -854,16 +886,31 @@ class LLMEngine:
             # allocations included) via _finish/_release — the same
             # release the recompute-preemption path uses.
             self.stats.async_rollbacks_total += rolled
-            if len(live_d) != len(staged.decodes):
-                staged_dec = None  # row set changed: restage at dispatch
             # Surviving rows keep their planned widths/draft caps, so
             # the reconciled batch must keep its window too — dropping
             # to the default would send window-planned rows down the
             # one-shot verify path, whose arrays are only 1+k wide.
-            staged = ScheduledBatch(
+            reconciled = ScheduledBatch(
                 prefills=live_p, decodes=live_d,
                 spec_window=staged.spec_window,
             )
+            if isinstance(staged_dec, StagedUnified):
+                # Unified prestage survives a rollback by SLICING the
+                # surviving rows' row-independent arrays out of the
+                # full-batch staging (_slice_staged_rows) — unless the
+                # reconciled step is no longer unified-shaped (e.g. it
+                # collapsed to a single program's worth of work).
+                if not reconciled.is_empty and self._unified_eligible(
+                    reconciled
+                ):
+                    staged_dec = self.runner.subset_staged_unified(
+                        staged_dec, live_p, live_d
+                    )
+                else:
+                    staged_dec = None
+            elif len(live_d) != len(staged.decodes):
+                staged_dec = None  # row set changed: restage at dispatch
+            staged = reconciled
         if staged.is_empty and rolled and self.scheduler.has_work():
             # The whole slot was invalidated; the freed pages/budget may
             # admit different work now that nothing is pending.
@@ -885,21 +932,87 @@ class LLMEngine:
     def _dispatch_async(
         self,
         batch: ScheduledBatch,
-        staged_dec: StagedDecode | StagedVerify | StagedVerifyWindow | None = None,
+        staged_dec: (
+            StagedDecode | StagedVerify | StagedVerifyWindow
+            | StagedUnified | None
+        ) = None,
     ) -> None:
         now = time.monotonic()
-        pend_p = None
-        if batch.prefills:
-            pend_p = self.runner.dispatch_prefill(batch.prefills)
-            for seq in batch.prefills:
-                self.stats.prompt_tokens += seq.num_tokens
-        pend_d = None
-        if batch.decodes:
-            pend_d = self._dispatch_decodes(
-                batch.decodes, batch.spec_window, staged_dec
+        pend_p = pend_d = pend_u = None
+        if self._unified_eligible(batch):
+            pend_u = self._dispatch_unified(
+                batch,
+                staged_dec if isinstance(staged_dec, StagedUnified) else None,
             )
+        else:
+            if batch.prefills:
+                pend_p = self.runner.dispatch_prefill(batch.prefills)
+                self.stats.step_dispatches_total += len(pend_p.entries)
+                for seq in batch.prefills:
+                    self.stats.prompt_tokens += seq.num_tokens
+            if batch.decodes:
+                pend_d = self._dispatch_decodes(
+                    batch.decodes, batch.spec_window,
+                    None if isinstance(staged_dec, StagedUnified)
+                    else staged_dec,
+                )
         self.scheduler.note_dispatch(batch)
-        self._inflight = _InflightStep(batch, pend_p, pend_d, now)
+        self._inflight = _InflightStep(batch, pend_p, pend_d, now, pend_u)
+
+    def _unified_eligible(self, batch: ScheduledBatch) -> bool:
+        """Does this batch ride the unified single-dispatch program?
+        Window=1 steps only (fused decode/verify windows keep their own
+        dispatch — they already amortize the round-trip), and only where
+        the split engine would launch MORE than one program: mixed
+        prefill+decode steps, or prefill-only steps spanning several Q
+        buckets. Pure-decode window=1 steps are already one dispatch
+        (mixed drafted/plain spec splits keep today's two-program path —
+        their staging shape depends on drafts only known at dispatch)."""
+        if self.runner._unified is None or batch.spec_window != 1:
+            return False
+        if not batch.prefills:
+            return False
+        if batch.decodes:
+            # A window=1 mixed step always has one-token decode rows in
+            # spec-off engines (the fused window only engages on pure-
+            # decode steps); guard anyway so an unexpected fused batch
+            # keeps its own program.
+            if self._spec_proposer is None and any(
+                s.num_tokens != 1 for s in batch.decodes
+            ):
+                return False
+            return True
+        return self.runner.prefill_group_count(batch.prefills) > 1
+
+    def _dispatch_unified(
+        self, batch: ScheduledBatch, staged: StagedUnified | None
+    ) -> PendingUnified:
+        """Dispatch the whole window=1 step as ONE ragged program (drafts
+        proposed first, exactly like the split paths). ``staged`` reuses
+        the async pipeline's prestaged arrays when the row set still
+        matches."""
+        if self._spec_proposer is not None and batch.decodes:
+            self._propose_drafts(batch.decodes)
+        reuse = (
+            staged is not None
+            and len(staged.prefills) == len(batch.prefills)
+            and len(staged.decodes) == len(batch.decodes)
+            and all(a is b for a, b in zip(staged.prefills, batch.prefills))
+            and all(a is b for a, b in zip(staged.decodes, batch.decodes))
+        )
+        if reuse:
+            pend_u = self.runner.dispatch_staged_unified(staged)
+        else:
+            pend_u = self.runner.dispatch_unified(
+                batch.prefills, batch.decodes
+            )
+        for seq in batch.prefills:
+            self.stats.prompt_tokens += seq.num_tokens
+        self.stats.unified_steps_total += 1
+        self.stats.step_dispatches_total += 1
+        if batch.decodes:
+            self.stats.decode_dispatches_total += 1
+        return pend_u
 
     def _dispatch_decodes(
         self,
@@ -917,6 +1030,7 @@ class LLMEngine:
         host region."""
         pend = self._dispatch_decode_programs(decodes, spec_window, staged)
         self.stats.decode_dispatches_total += len(pend.entries)
+        self.stats.step_dispatches_total += len(pend.entries)
         return pend
 
     def _dispatch_decode_programs(

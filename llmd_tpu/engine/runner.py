@@ -69,6 +69,28 @@ _OP_VERIFY = 8  # speculative-decoding verify step ([B, 1+k] positions)
 # Fused verify window: K verify iterations in one dispatch, accept/reject
 # and token feedback ON DEVICE (header QK slot carries the window size).
 _OP_VERIFY_WINDOW = 9
+# Unified single-dispatch step: an entire window=1 engine step — chunked-
+# prefill token runs, plain decode rows, and one-shot [B, 1+k] verify
+# rows — packed into ONE ragged program (header QK slot packs
+# (Q_bucket << 20) | T_bucket; the payload is a flat token stream plus
+# per-row (start, qlen, kind) metadata).
+_OP_UNIFIED = 10
+
+# Row kinds of the unified step's (start, qlen, kind) metadata. Only
+# verify-ness reaches the device (it selects the sample positions: verify
+# rows sample every position, the rest sample the last); the full kind is
+# broadcast anyway so followers and debugging tools see the same step
+# structure the leader staged.
+_KIND_PREFILL, _KIND_DECODE, _KIND_VERIFY = 0, 1, 2
+
+# Max tokens one unified row carries: prefill chunks longer than this are
+# split into consecutive sub-rows of the SAME sequence (each layer writes
+# the whole step's KV before attention reads, so a later sub-row attends
+# the earlier sub-rows' fresh KV — the chunked-prefill invariant, just
+# within one program). Bounds the [B, Q] padding a mixed step pays: a
+# decode row pads to the Q bucket, so Q must stay small relative to the
+# token stream, not grow to the largest chunk.
+_UNIFIED_ROW_TOKENS = 64
 
 log = logging.getLogger(__name__)
 
@@ -245,6 +267,48 @@ class StagedDecode:
     all_greedy: bool
 
 
+@dataclass
+class StagedUnified:
+    """Host arrays for a unified single-dispatch step built AHEAD of the
+    tokens/drafts they depend on (async prestaging): the ROW STRUCTURE
+    (prefill chunks split into <= _UNIFIED_ROW_TOKENS sub-rows, one row
+    per decode seq at its planned width) and everything row-dependent
+    but token-independent — page/ring tables, sampling knobs, lora
+    slots — are final at staging; the packed token stream, per-row
+    (start, qlen, kind) metadata and seeds are filled by
+    ``dispatch_staged_unified`` once the previous step's readback has
+    committed and any drafts are proposed."""
+
+    prefills: list[ScheduledSeq]
+    decodes: list[ScheduledSeq]
+    row_seqs: list[ScheduledSeq]  # one entry per unified row
+    row_off: list[int]  # prefill sub-row token offset within its chunk
+    row_plan: list[int]  # planned row width (actual qlen <= plan)
+    prefill_rows: list[int]  # row index of each prefill seq's LAST sub-row
+    decode_rows: list[int]  # row index of each decode seq
+    arrays: dict
+    B: int
+    Q: int  # static per-row column count (bucketed max row width)
+    T: int  # token-stream bucket (bucketed sum of planned widths)
+    S: int  # sample columns per row (spec_q on speculative engines, 1)
+    all_greedy: bool
+
+
+@dataclass
+class PendingUnified:
+    """One dispatched-but-unread unified step: the packed [B, 2S] device
+    output plus the row maps that split it back into prefill first-token
+    results and decode/verify windows at ``wait_step``'s single
+    coalesced readback."""
+
+    packed: jax.Array
+    S: int
+    prefill_rows: list[int]
+    decode_rows: list[int]
+    n_prefills: int
+    n_decodes: int
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -343,6 +407,25 @@ class ModelRunner:
             else sched.decode_window,
             *self.spec_windows,
         }))
+        # Unified single-dispatch step (SchedulerConfig.unified_step): one
+        # ragged program packs a whole window=1 step — prefill chunk
+        # runs, plain decode rows, one-shot verify rows. Sample columns
+        # per row: verify rows need spec_q, everything else 1.
+        self.unified_s = max(self.spec_q, 1)
+        # Per-row width cap (long chunks split into sub-rows); must cover
+        # the verify family's 1 + k columns.
+        self.unified_row_cap = max(_UNIFIED_ROW_TOKENS, self.unified_s)
+        self.unified_q_buckets = _buckets(self.unified_row_cap, start=8)
+        # Row-count bound: every scheduled seq is one row, plus at most
+        # budget // cap extra sub-rows from chunk splitting.
+        self.unified_row_buckets = _buckets(
+            sched.max_num_seqs
+            + sched.max_num_batched_tokens // self.unified_row_cap,
+            start=1,
+        )
+        self._unified = (
+            self._build_unified() if sched.unified_step else None
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -652,6 +735,129 @@ class ModelRunner:
             return kv_cache, kv_swa, replicate(packed)
 
         return verify
+
+    def _build_unified(self):
+        """Unified single-dispatch step: ONE ragged program for an entire
+        window=1 engine step. The host ships a packed token stream
+        ``[T]`` plus per-row (start, qlen, kind) metadata; the device
+        gathers it into the bucketed ``[B, Q]`` view and runs the SAME
+        prefill/ragged-paged-attention forward every other shape family
+        uses — chunked-prefill rows, plain decode rows (qlen 1), and
+        one-shot verify rows (qlen 1 + draft) side by side, masked by
+        ``query_lens`` exactly like the verify family's padding. Long
+        prefill chunks arrive pre-split into consecutive sub-rows of the
+        same sequence (each layer writes the whole step's KV before
+        attention reads, so later sub-rows attend earlier sub-rows'
+        fresh KV — the cross-step chunked-prefill invariant, inside one
+        program). Sampling gathers an ``[B, S]`` plane of positions
+        (verify rows: every draft position; all other rows: the last
+        valid position) so prefill-chunk first-tokens and decode/verify
+        tokens sample ON DEVICE in the same call, and the whole step
+        comes back as one packed ``[B, 2S]`` transfer — one dispatch,
+        one coalesced readback, where the split engine pays one per
+        program."""
+        cfg = self.cfg
+        world = self.ctx.world
+        mesh = self.ctx.mesh
+        kv_rep = self.kv_rep
+        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        ep_capacity = self.config.parallel.ep_capacity_factor
+        dbo = self.config.parallel.enable_dbo
+        replicate = self._replicate_out
+        ring = self.swa is not None
+        S = self.unified_s
+
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(1, 2) if ring else (1,),
+            static_argnames=("Q", "all_greedy"),
+        )
+        def unified(
+            params,
+            kv_cache,
+            kv_swa,  # ring pool (None unless swa_ring)
+            stream: jax.Array,  # [T] packed token stream
+            row_start: jax.Array,  # [B] row's offset into the stream
+            pos0: jax.Array,  # [B] absolute position of the row's first token
+            qlens: jax.Array,  # [B] valid token count per row
+            kvlens: jax.Array,  # [B] kv length after this row's writes
+            verify_row: jax.Array,  # [B] bool (kind == verify)
+            page_table: jax.Array,  # [B, max_pages]
+            swa_table,  # [B, max_pages] ring view, or None
+            lora_ids,  # [B] i32 adapter slots, or None
+            temperature: jax.Array,
+            top_k: jax.Array,
+            top_p: jax.Array,
+            seeds: jax.Array,  # [B, S]
+            Q: int,
+            all_greedy: bool = False,
+        ):
+            B = row_start.shape[0]
+            cols = jnp.arange(Q)
+            gidx = jnp.clip(
+                row_start[:, None] + cols[None, :], 0, stream.shape[0] - 1
+            )
+            tokens = jnp.where(
+                cols[None, :] < qlens[:, None], stream[gidx], 0
+            )
+            last = jnp.maximum(qlens - 1, 0)
+            # Pad columns repeat the last real position (the prefill
+            # convention every family shares).
+            positions = pos0[:, None] + jnp.minimum(
+                cols[None, :], last[:, None]
+            )
+            inp = StepInput(
+                token_ids=tokens,
+                positions=positions,
+                query_lens=qlens.astype(jnp.int32),
+                kv_lens=kvlens.astype(jnp.int32),
+                page_table=page_table,
+                lora_ids=lora_ids,
+                swa_page_table=swa_table,
+            )
+            if ring:
+                hidden, kv_cache, kv_swa = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                    kv_swa=kv_swa,
+                )
+            else:
+                hidden, kv_cache = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
+                )
+            H = hidden.shape[-1]
+            scols = jnp.arange(S)
+            # Verify rows sample every scored position (the one-shot
+            # verify layout); everything else samples its last valid
+            # position in column 0 (duplicate pad samples are dropped
+            # host-side).
+            samp = jnp.where(
+                verify_row[:, None],
+                jnp.minimum(scols[None, :], last[:, None]),
+                last[:, None],
+            )
+            h = hidden[jnp.arange(B)[:, None], samp]  # [B, S, H]
+            logits = llama.compute_logits(params, h.reshape(B * S, H), cfg)
+            flat = SamplingInputs(
+                temperature=jnp.repeat(temperature, S),
+                top_k=jnp.repeat(top_k, S),
+                top_p=jnp.repeat(top_p, S),
+                seeds=seeds.reshape(B * S),
+            )
+            tok, logp = sample_tokens(logits, flat, all_greedy)
+            packed = jnp.concatenate(
+                [
+                    tok.reshape(B, S).astype(jnp.float32),
+                    logp.reshape(B, S),
+                ],
+                axis=1,
+            )  # [B, 2S]
+            return kv_cache, kv_swa, replicate(packed)
+
+        return unified
 
     def _build_verify_window(self):
         """Fused verify window: ``window`` verify iterations in ONE jit
@@ -1298,6 +1504,25 @@ class ModelRunner:
                 ("seeded", (B,), np.uint8),
                 ("out0", (B,), np.int32),
             ]
+        elif op == _OP_UNIFIED:
+            # QK packs (Q_bucket << 20) | T_bucket: the follower needs
+            # BOTH the per-row column count and the token-stream length
+            # to derive the payload geometry; the sample width S derives
+            # from the shared engine config (spec_q or 1) on both sides.
+            t = QK & 0xFFFFF
+            spec = [
+                ("stream", (t,), np.int32),
+                ("row_start", (B,), np.int32),
+                ("pos0", (B,), np.int32),
+                ("qlens", (B,), np.int32),
+                ("kvlens", (B,), np.int32),
+                ("kind", (B,), np.uint8),
+                ("page_table", (B, mp), np.int32),
+                ("temp", (B,), np.float32),
+                ("top_k", (B,), np.int32),
+                ("top_p", (B,), np.float32),
+                ("seeds", (B, self.unified_s), np.uint32),
+            ]
         else:
             spec = [
                 ("first", (B,), np.int32),
@@ -1364,6 +1589,10 @@ class ModelRunner:
                 self._exec_verify(arrays, bool(greedy))
             elif op == _OP_VERIFY_WINDOW:
                 self._exec_verify_window(arrays, QK, bool(greedy))
+            elif op == _OP_UNIFIED:
+                # QK packs (Q_bucket << 20) | T_bucket; the exec only
+                # needs the static per-row column count.
+                self._exec_unified(arrays, QK >> 20, bool(greedy))
             elif op == _OP_KV_GATHER:
                 # Participate in the SPMD gather (the all-gather collective
                 # needs every process); the replicated result is dropped —
@@ -1456,6 +1685,32 @@ class ModelRunner:
         )
         self.kv_cache, self.kv_swa, packed = self._verify(
             self.params, self.kv_cache, self.kv_swa, inp, s,
+            all_greedy=all_greedy,
+        )
+        return packed
+
+    def _exec_unified(self, arrays: dict, Q: int, all_greedy: bool) -> jax.Array:
+        self.kv_cache, self.kv_swa, packed = self._unified(
+            self.params,
+            self.kv_cache,
+            self.kv_swa,
+            jnp.asarray(arrays["stream"]),
+            jnp.asarray(arrays["row_start"]),
+            jnp.asarray(arrays["pos0"]),
+            jnp.asarray(arrays["qlens"]),
+            jnp.asarray(arrays["kvlens"]),
+            jnp.asarray(arrays["kind"] == _KIND_VERIFY),
+            jnp.asarray(arrays["page_table"]),
+            (
+                jnp.asarray(arrays["swa_table"])
+                if "swa_table" in arrays else None
+            ),
+            jnp.asarray(arrays["lora"]) if "lora" in arrays else None,
+            jnp.asarray(arrays["temp"]),
+            jnp.asarray(arrays["top_k"]),
+            jnp.asarray(arrays["top_p"]),
+            jnp.asarray(arrays["seeds"]),
+            Q=Q,
             all_greedy=all_greedy,
         )
         return packed
@@ -2194,6 +2449,202 @@ class ModelRunner:
             entries.append((pd.entries[0][0], plain, 1, 0))
         return PendingDecode(entries, len(seqs), self.spec_q)
 
+    def stage_unified(
+        self, prefills: list[ScheduledSeq], decodes: list[ScheduledSeq]
+    ) -> StagedUnified:
+        """Build a unified step's host arrays AHEAD of the tokens/drafts
+        they depend on (async prestaging). The row structure — prefill
+        chunks split into <= ``unified_row_cap`` sub-rows, one row per
+        decode seq at its PLANNED width — is fixed by the schedule, so
+        the page/ring tables and sampling knobs (the O(rows x max_pages)
+        cost) are final here; the packed stream, per-row (start, qlen,
+        kind) metadata and seeds fill at dispatch."""
+        cap = self.unified_row_cap
+        row_seqs: list[ScheduledSeq] = []
+        row_off: list[int] = []
+        row_plan: list[int] = []
+        prefill_rows: list[int] = []
+        decode_rows: list[int] = []
+        for s in prefills:
+            off = 0
+            while True:
+                w = min(cap, s.num_tokens - off)
+                row_seqs.append(s)
+                row_off.append(off)
+                row_plan.append(w)
+                off += w
+                if off >= s.num_tokens:
+                    break
+            prefill_rows.append(len(row_seqs) - 1)
+        for s in decodes:
+            decode_rows.append(len(row_seqs))
+            row_seqs.append(s)
+            row_off.append(0)
+            row_plan.append(s.num_tokens)
+        n = len(row_seqs)
+        B = pad_to_bucket(n, self.unified_row_buckets)
+        Q = pad_to_bucket(max(row_plan), self.unified_q_buckets)
+        T = pad_to_bucket(sum(row_plan), self.prefill_buckets)
+        S = self.unified_s
+        temp, top_k, top_p = self._sampling_knobs(row_seqs, B)
+        arrays = {
+            "stream": np.zeros(T, np.int32),
+            "row_start": np.zeros(B, np.int32),
+            "pos0": np.zeros(B, np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "kind": np.zeros(B, np.uint8),
+            "page_table": self._page_table(row_seqs, B),
+            "temp": temp, "top_k": top_k, "top_p": top_p,
+            "seeds": np.zeros((B, S), np.uint32),
+        }
+        if self.swa is not None:
+            arrays["swa_table"] = self._swa_table(row_seqs, B)
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = self._lora_array(row_seqs, B)
+        all_greedy = all(s.request.sampling.greedy for s in row_seqs)
+        return StagedUnified(
+            list(prefills), list(decodes), row_seqs, row_off, row_plan,
+            prefill_rows, decode_rows, arrays, B, Q, T, S, all_greedy,
+        )
+
+    def dispatch_unified(
+        self, prefills: list[ScheduledSeq], decodes: list[ScheduledSeq]
+    ) -> PendingUnified:
+        """Stage + enqueue the whole window=1 step as ONE program."""
+        return self.dispatch_staged_unified(self.stage_unified(prefills, decodes))
+
+    def dispatch_staged_unified(self, staged: StagedUnified) -> PendingUnified:
+        """Fill the readback/draft-dependent slots of a staged unified
+        step and enqueue it: pack every row's actual tokens into the
+        flat stream (prefill sub-rows read their chunk slice; decode
+        rows feed [next committed token]; drafting rows feed
+        [next, draft...] and become verify-kind rows), then dispatch one
+        program. ONE [B, S] rng block per dispatch, drawn here so the
+        stateful stream advances in dispatch order; SEEDED rows
+        overwrite theirs per (request seed, output index), so column 0
+        of a seeded non-verify row equals the split engine's one-sample
+        seed exactly — greedy and seeded streams stay byte-identical to
+        the split engine. (Unseeded sampled rows draw from a
+        differently-shaped rng block than the split dispatches would,
+        so hot sampling is reproducible within a mode, not across the
+        unified/split switch — the same contract as spec on/off.)"""
+        a = staged.arrays
+        stream, row_start = a["stream"], a["row_start"]
+        pos0, qlens, kvlens = a["pos0"], a["qlens"], a["kvlens"]
+        kind = a["kind"]
+        a["seeds"] = self._np_rng.integers(
+            0, 2**32, size=(staged.B, staged.S), dtype=np.uint32
+        )
+        n_pre_rows = (
+            staged.prefill_rows[-1] + 1 if staged.prefill_rows else 0
+        )
+        t = 0
+        for r, (seq, off, _plan) in enumerate(
+            zip(staged.row_seqs, staged.row_off, staged.row_plan)
+        ):
+            req = seq.request
+            if r < n_pre_rows:
+                start = seq.start_pos + off
+                w = min(staged.row_plan[r], seq.num_tokens - off)
+                toks = req.all_token_ids[start : start + w]
+                kind[r] = _KIND_PREFILL
+            else:
+                nc = req.num_computed_tokens
+                start = nc
+                draft = seq.draft_tokens or []
+                if draft:
+                    toks = [req.all_token_ids[nc], *draft]
+                    kind[r] = _KIND_VERIFY
+                else:
+                    toks = [req.all_token_ids[nc]]
+                    kind[r] = _KIND_DECODE
+                w = len(toks)
+            stream[t : t + w] = toks
+            row_start[r] = t
+            pos0[r] = start
+            qlens[r] = w
+            kvlens[r] = start + w
+            t += w
+        self._overwrite_seeded_rows(a["seeds"], staged.row_seqs, staged.S)
+        with self._dispatch_lock:
+            arrays = self._sync(
+                _OP_UNIFIED, staged.B, (staged.Q << 20) | staged.T,
+                staged.all_greedy, a,
+            )
+            packed = self._exec_unified(arrays, staged.Q, staged.all_greedy)
+        return PendingUnified(
+            packed, staged.S, list(staged.prefill_rows),
+            list(staged.decode_rows), len(staged.prefills),
+            len(staged.decodes),
+        )
+
+    def subset_staged_unified(
+        self,
+        staged: StagedUnified,
+        live_p: list[ScheduledSeq],
+        live_d: list[ScheduledSeq],
+    ) -> StagedUnified:
+        """Derive a subset StagedUnified after an async rollback dropped
+        rows: the surviving rows' row-independent arrays (page/ring
+        tables, knobs, lora slots) are SLICED out of the prestaged
+        full-batch arrays via ``_slice_staged_rows`` — one vectorized
+        gather each — instead of re-walking the requests' block lists
+        inside the blocking host region; the dispatch-filled arrays
+        come back as fresh zeros."""
+        keep_of: dict[int, list[int]] = {}
+        for r, s in enumerate(staged.row_seqs):
+            keep_of.setdefault(id(s), []).append(r)
+        rows: list[int] = []
+        row_seqs: list[ScheduledSeq] = []
+        row_off: list[int] = []
+        row_plan: list[int] = []
+        prefill_rows: list[int] = []
+        decode_rows: list[int] = []
+        for s in live_p:
+            for r in keep_of[id(s)]:
+                rows.append(r)
+                row_seqs.append(s)
+                row_off.append(staged.row_off[r])
+                row_plan.append(staged.row_plan[r])
+            prefill_rows.append(len(rows) - 1)
+        for s in live_d:
+            r = keep_of[id(s)][0]
+            decode_rows.append(len(rows))
+            rows.append(r)
+            row_seqs.append(s)
+            row_off.append(0)
+            row_plan.append(staged.row_plan[r])
+        B = pad_to_bucket(len(rows), self.unified_row_buckets)
+        Q = pad_to_bucket(max(row_plan), self.unified_q_buckets)
+        T = pad_to_bucket(sum(row_plan), self.prefill_buckets)
+        S = staged.S
+        arrays = self._slice_staged_rows(
+            staged.arrays, rows, B, self._ROW_SLICE_NAMES
+        )
+        arrays.update({
+            "stream": np.zeros(T, np.int32),
+            "row_start": np.zeros(B, np.int32),
+            "pos0": np.zeros(B, np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "kind": np.zeros(B, np.uint8),
+            "seeds": np.zeros((B, S), np.uint32),
+        })
+        all_greedy = all(s.request.sampling.greedy for s in row_seqs)
+        return StagedUnified(
+            list(live_p), list(live_d), row_seqs, row_off, row_plan,
+            prefill_rows, decode_rows, arrays, B, Q, T, S, all_greedy,
+        )
+
+    def prefill_group_count(self, seqs: list[ScheduledSeq]) -> int:
+        """How many Q-bucket programs ``dispatch_prefill`` would enqueue
+        for these chunks — the engine's unified-step eligibility probe
+        (a single-group prefill-only step is already one dispatch)."""
+        return len({
+            pad_to_bucket(s.num_tokens, self.prefill_buckets) for s in seqs
+        })
+
     def stage_spec_verify_window(
         self, seqs: list[ScheduledSeq], window: int
     ) -> StagedVerifyWindow:
@@ -2281,16 +2732,21 @@ class ModelRunner:
         self,
         prefill: PendingPrefill | None,
         decode: PendingDecode | None,
+        unified: PendingUnified | None = None,
     ) -> tuple[StepResult | None, StepResult | None]:
         """Block on one engine step's token readback: every dispatched
         program's packed output comes back in a SINGLE coalesced
         transfer (one host round-trip per step, however many prefill
-        bucket groups and decode windows the step dispatched)."""
+        bucket groups and decode windows the step dispatched — or ONE
+        packed array for a unified single-dispatch step, split back into
+        prefill/decode results by its row maps)."""
         packs: list[jax.Array] = []
         if prefill is not None:
             packs.extend(p for p, _ in prefill.entries)
         if decode is not None:
             packs.extend(p for p, _, _, _ in decode.entries)
+        if unified is not None:
+            packs.append(unified.packed)
         if not packs:
             return None, None
         if dist.is_multihost():
@@ -2335,6 +2791,22 @@ class ModelRunner:
                     tokens[rows, :k] = arr[:m, mc : mc + k].astype(np.int32)
                     logprobs[rows, :k] = arr[:m, mc + k : mc + 2 * k]
             dres = StepResult(tokens, logprobs, meta)
+        if unified is not None:
+            arr = hosts[-1]
+            S = unified.S
+            if unified.n_prefills:
+                # A prefill seq's first-token sample sits in column 0 of
+                # its LAST sub-row (every sample column of a non-verify
+                # row is the last-position sample).
+                rows = np.asarray(unified.prefill_rows, np.int64)
+                pres = StepResult(
+                    arr[rows, :1].astype(np.int32), arr[rows, S : S + 1]
+                )
+            if unified.n_decodes:
+                rows = np.asarray(unified.decode_rows, np.int64)
+                dres = StepResult(
+                    arr[rows, :S].astype(np.int32), arr[rows, S : 2 * S]
+                )
         return pres, dres
 
     # ------------------------------------------------------------------ #
@@ -2389,7 +2861,44 @@ class ModelRunner:
             for greedy in (True, False):
                 self._warm_verify_window(self.batch_buckets[-1], w, greedy)
                 count += 1
+        if self._unified is not None:
+            # The unified mixed-step family at its largest row/column/
+            # stream buckets — the shape a saturated mixed step lands on.
+            for greedy in (True, False):
+                self._warm_unified(
+                    self.unified_row_buckets[-1],
+                    self.unified_q_buckets[-1],
+                    self.prefill_buckets[-1],
+                    greedy,
+                )
+                count += 1
         return count
+
+    def _warm_unified(
+        self, B: int, Q: int, T: int, all_greedy: bool = False
+    ) -> None:
+        arrays = {
+            "stream": np.zeros(T, np.int32),
+            "row_start": np.zeros(B, np.int32),
+            "pos0": np.zeros(B, np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "kind": np.zeros(B, np.uint8),
+            "page_table": np.zeros((B, self.max_pages), np.int32),
+            "temp": np.zeros(B, np.float32),
+            "top_k": np.zeros(B, np.int32),
+            "top_p": np.ones(B, np.float32),
+            "seeds": np.zeros((B, self.unified_s), np.uint32),
+        }
+        if self.swa is not None:
+            arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = np.zeros(B, np.int32)
+        with self._dispatch_lock:
+            arrays = self._sync(
+                _OP_UNIFIED, B, (Q << 20) | T, all_greedy, arrays
+            )
+            self._exec_unified(arrays, Q, all_greedy)
 
     def _warm_prefill(self, B: int, Q: int, all_greedy: bool = False) -> None:
         arrays = {

@@ -106,6 +106,7 @@ def make_engine_config(args, lora_adapters=None):
             spec_ngram_k=args.spec_ngram_k,
             spec_ngram_min_match=args.spec_ngram_min_match,
             spec_verify_window=args.spec_verify_window,
+            unified_step=args.unified_step,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -216,6 +217,15 @@ def build_parser() -> argparse.ArgumentParser:
              "round-trip per window. 0 (default) inherits "
              "--decode-window; 1 pins one-shot verify steps "
              "(docs/architecture/speculative-decoding.md)",
+    )
+    p.add_argument(
+        "--unified-step", action=argparse.BooleanOptionalAction, default=True,
+        help="pack each window=1 engine step (prefill chunks + decode "
+             "rows + one-shot verify rows) into ONE ragged device "
+             "program with one coalesced readback; --no-unified-step "
+             "restores the split per-family dispatch paths. Streams are "
+             "byte-identical either way for greedy and seeded sampling "
+             "(docs/architecture/async-scheduling.md)",
     )
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)

@@ -69,6 +69,12 @@ def render_metrics(
         "step_host_gap_ms_total": round(stats.step_host_gap_ms_total, 3),
         "async_rollbacks_total": stats.async_rollbacks_total,
         "decode_dispatches_total": stats.decode_dispatches_total,
+        # Unified single-dispatch steps (the family split of
+        # decode_dispatches_total) and EVERY program engine steps
+        # dispatched — step_dispatches_total / engine_steps_total is the
+        # unified step's dispatches-per-step headline.
+        "unified_steps_total": stats.unified_steps_total,
+        "step_dispatches_total": stats.step_dispatches_total,
     }
     if stats.swa_ring_pages:
         # Hybrid-APC section retention activity

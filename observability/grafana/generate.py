@@ -244,6 +244,18 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
                    "fused verify windows (speculative-decoding.md) both "
                    "amortize dispatch RTT, pushing the ratio toward "
                    "1/window x mean emitted per iteration."),
+        panel("Dispatches per step (unified step)",
+              [f"rate(llmd:step_dispatches_total{M}[5m]) / "
+               f"rate(llmd:engine_steps_total{M}[5m])",
+               f"rate(llmd:unified_steps_total{M}[5m])"],
+              legends=["device programs/step", "unified steps/s"],
+              desc="Device programs dispatched per engine step. The "
+                   "unified single-dispatch step (--unified-step) packs "
+                   "mixed prefill+decode+verify steps into ONE ragged "
+                   "program, pulling this toward 1.0; a rise with "
+                   "unified steps/s at zero means mixed traffic is "
+                   "paying the split engine's two-to-three dispatches "
+                   "(plus one lockstep broadcast each on multi-host)."),
         row("Speculative decoding"),
         panel("Draft acceptance", [f"llmd:spec_acceptance_rate{M}"],
               unit="percentunit", max1=True,

@@ -327,6 +327,157 @@ def test_kv_rep_pd_transfer_interops_with_unsharded_producer(devices):
         consumer.kv_connector.close()
 
 
+# --------------------------------------------------------------------- #
+# unified single-dispatch step (SchedulerConfig.unified_step): one ragged
+# program per window=1 step must change how many device programs a step
+# launches, never WHICH tokens it emits.
+
+
+def make_unified(unified, max_batched=16, num_blocks=64, seed=0, **kw):
+    cfg = EngineConfig(
+        model=tiny_model_config(),
+        cache=CacheConfig(page_size=4, num_blocks=num_blocks, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=8, max_num_batched_tokens=max_batched,
+            unified_step=unified, **kw,
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=seed,
+    )
+    return LLMEngine(cfg)
+
+
+# A long prompt (chunked across steps under the small budget) next to
+# short ones: once the short prompts decode, every remaining chunk step
+# is MIXED (prefill chunk + decode rows) — the unified program's case.
+MIXED_PROMPTS = [
+    list(np.random.default_rng(7).integers(0, 256, size=40)),
+    [3, 3, 7, 1],
+    [1, 5, 9, 13, 2, 8],
+    [9, 1, 9, 1, 9, 1, 2, 2],
+]
+
+
+def test_unified_vs_split_parity_mixed_chunked():
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    base = make_unified(False).generate([list(p) for p in MIXED_PROMPTS], sp)
+    eng = make_unified(True)
+    out = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.stats.unified_steps_total > 0  # mixed steps actually fused
+
+
+def test_unified_fewer_dispatches_same_stream():
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    split = make_unified(False)
+    base = split.generate([list(p) for p in MIXED_PROMPTS], sp)
+    eng = make_unified(True)
+    out = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.stats.engine_steps_total == split.stats.engine_steps_total
+    assert eng.stats.step_dispatches_total < split.stats.step_dispatches_total
+    assert eng.stats.unified_steps_total > 0
+
+
+def test_unified_vs_split_parity_preemption():
+    """Page pressure forces recompute-preemption mid-run; streams must
+    still match the split engine under the SAME tight pool."""
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    kw = dict(num_blocks=14, max_batched=16)
+    base_eng = make_unified(False, **kw)
+    base = base_eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    eng = make_unified(True, **kw)
+    out = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.scheduler.num_preemptions > 0, "pool not tight enough"
+    assert eng.stats.unified_steps_total > 0
+    assert eng.allocator.usage() == 0.0
+
+
+def test_unified_vs_split_parity_prefix_cache_hit():
+    """A repeated prompt admits from the prefix cache (decode starts
+    mid-page) and must still stream identically through unified steps."""
+    sp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
+    base_eng, eng = make_unified(False), make_unified(True)
+    first_b = base_eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    first_u = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(first_b.values()) == list(first_u.values())
+    second_b = base_eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    second_u = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(second_b.values()) == list(second_u.values())
+    assert eng.allocator.metrics_hits > 0  # the hit actually happened
+
+
+def test_unified_vs_split_parity_seeded_sampling():
+    """Seeded rows must reproduce byte-for-byte through the unified
+    sample plane (column 0 of a non-verify row carries exactly the seed
+    the split engine's one-sample dispatch would use)."""
+    sp = SamplingParams(temperature=1.0, max_tokens=12, seed=77, ignore_eos=True)
+    base = make_unified(False, seed=3).generate(
+        [list(p) for p in MIXED_PROMPTS], sp
+    )
+    eng = make_unified(True, seed=3)
+    out = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.stats.unified_steps_total > 0
+
+
+def test_unified_vs_split_parity_async_rollback():
+    """Unified prestaging composes with async stepping: staged unified
+    batches survive late-finish rollbacks (surviving rows sliced out of
+    the prestaged arrays) and streams stay byte-identical to the split
+    sync engine."""
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    base = make_unified(False).generate([list(p) for p in MIXED_PROMPTS], sp)
+    eng = make_unified(True, async_scheduling=True)
+    out = eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng._inflight is None
+    assert eng.stats.unified_steps_total > 0
+    assert eng.stats.async_rollbacks_total >= 1  # LENGTH finishes rolled back
+    assert eng.allocator.usage() == 0.0
+
+
+def test_unified_one_readback_per_step():
+    """One blocking host readback per engine step, however many prefill
+    chunks, decode rows (and on spec engines, verify rows) the unified
+    program packed."""
+    sp = SamplingParams(temperature=0.0, max_tokens=12, ignore_eos=True)
+    eng = make_unified(True)
+    calls = {"n": 0}
+    orig = eng.runner.wait_step
+
+    def counting(prefill, decode, unified=None):
+        calls["n"] += 1
+        return orig(prefill, decode, unified)
+
+    eng.runner.wait_step = counting
+    eng.generate([list(p) for p in MIXED_PROMPTS], sp)
+    assert eng.stats.unified_steps_total > 0
+    assert calls["n"] == eng.stats.engine_steps_total
+
+
+def test_unified_multi_group_prefill_collapses_to_one_dispatch():
+    """A prefill-only step whose chunks span several Q buckets (one
+    long + several short prompts under a large budget) rides ONE
+    unified program instead of one program per bucket group."""
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    prompts = [
+        list(np.random.default_rng(5).integers(0, 256, size=40)),
+        [3, 3, 7, 1],
+        [1, 5, 9, 13],
+    ]
+    split = make_unified(False, max_batched=64)
+    base = split.generate([list(p) for p in prompts], sp)
+    eng = make_unified(True, max_batched=64)
+    out = eng.generate([list(p) for p in prompts], sp)
+    assert list(base.values()) == list(out.values())
+    # step 1 (whole-batch prefill): split pays one program per Q bucket
+    # group, unified pays one.
+    assert eng.stats.unified_steps_total > 0
+    assert eng.stats.step_dispatches_total < split.stats.step_dispatches_total
+
+
 import pytest as _pytest
 
 

@@ -441,9 +441,9 @@ def test_spec_window_one_readback_per_window():
         eng = make_engine(True, window=window)
         calls = {"n": 0}
         orig = eng.runner.wait_step
-        def counting(prefill, decode):
+        def counting(prefill, decode, unified=None):
             calls["n"] += 1
-            return orig(prefill, decode)
+            return orig(prefill, decode, unified)
         eng.runner.wait_step = counting
         eng.generate([list(p) for p in PROMPTS], sp)
         # one blocking readback per step, however many verify
@@ -593,6 +593,184 @@ def test_async_mixed_step_reuses_staged_arrays():
     assert hits["verify"] > 0 and hits["decode"] > 0, (
         "no mixed step reused the prestaged arrays: the slicing path "
         "was never exercised", hits,
+    )
+
+
+# --------------------------------------------------------------------- #
+# unified single-dispatch step x speculative decoding: mixed steps pack
+# prefill chunks, one-shot [B, 1+k] verify rows and plain decode rows
+# into ONE program — acceptance, truncation and byte parity unchanged.
+
+# A long chunked prompt keeps prefill chunks arriving while the periodic
+# prompts decode WITH drafts in flight: the three-program split case
+# (prefill + verify + decode) the unified step collapses.
+UNIFIED_SPEC_PROMPTS = [
+    list(np.random.default_rng(3).integers(0, 8, size=6)) * 7,  # 42, chunked
+    *PROMPTS,
+]
+
+
+def make_unified_spec(unified, spec=True, async_mode=False, seed=0):
+    cfg = EngineConfig(
+        model=tiny_model_config(),
+        cache=CacheConfig(page_size=4, num_blocks=96, dtype="float32"),
+        scheduler=SchedulerConfig(
+            max_num_seqs=8, max_num_batched_tokens=16,
+            speculative_ngram=spec, spec_ngram_k=4, spec_ngram_min_match=2,
+            unified_step=unified, async_scheduling=async_mode,
+        ),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=seed,
+    )
+    return LLMEngine(cfg)
+
+
+def test_unified_spec_one_shot_parity_greedy():
+    """Unified spec steps (verify rows riding the unified program) vs
+    the fully split spec-off engine: byte-identical, with speculation
+    AND unified steps both actually engaging."""
+    sp = SamplingParams(temperature=0.0, max_tokens=20, ignore_eos=True)
+    base = make_unified_spec(False, spec=False).generate(
+        [list(p) for p in UNIFIED_SPEC_PROMPTS], sp
+    )
+    eng = make_unified_spec(True)
+    out = eng.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.stats.unified_steps_total > 0
+    assert eng.scheduler.spec_proposed_tokens > 0
+    assert eng.scheduler.spec_accepted_tokens > 0
+    assert eng.allocator.usage() == 0.0
+
+
+def test_unified_spec_equals_split_spec():
+    """Same spec engine, unified on vs off: identical streams AND
+    identical acceptance histograms (the unified program changes how
+    many dispatches a step pays, not what is drafted/accepted)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=20, ignore_eos=True)
+    split = make_unified_spec(False)
+    uni = make_unified_spec(True)
+    a = split.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+    b = uni.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+    assert list(a.values()) == list(b.values())
+    assert (
+        split.scheduler.spec_accept_len_hist
+        == uni.scheduler.spec_accept_len_hist
+    )
+    assert uni.stats.unified_steps_total > 0
+    assert uni.stats.step_dispatches_total < split.stats.step_dispatches_total
+
+
+def test_unified_spec_parity_seeded():
+    sp = SamplingParams(temperature=0.3, max_tokens=16, seed=77, ignore_eos=True)
+    base = make_unified_spec(False, spec=False, seed=3).generate(
+        [list(p) for p in UNIFIED_SPEC_PROMPTS], sp
+    )
+    eng = make_unified_spec(True, seed=3)
+    out = eng.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng.stats.unified_steps_total > 0
+    assert eng.scheduler.spec_proposed_tokens > 0
+
+
+def test_unified_spec_rejected_drafts_never_enter_prefix_index():
+    """The KV-provisional-write rule survives the unified program:
+    rejected draft content verified inside a unified step must never
+    reach the allocator's content index."""
+    sp = SamplingParams(temperature=0.0, max_tokens=20, ignore_eos=True)
+    eng = make_unified_spec(True)
+    streams = list(
+        eng.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp).values()
+    )
+    sch = eng.scheduler
+    assert sch.spec_proposed_tokens > sch.spec_accepted_tokens > 0, (
+        "workload produced no rejections: the invariant wasn't exercised"
+    )
+    assert eng.stats.unified_steps_total > 0
+    _committed_hashes_are_subset_of_accepted(
+        eng, streams, UNIFIED_SPEC_PROMPTS
+    )
+    assert eng.allocator.usage() == 0.0
+
+
+def test_unified_spec_async_rollback_parity():
+    """Unified prestaging x spec x async: staged unified batches plan
+    verify rows at max acceptance, late finishes roll staged rows back
+    (surviving rows sliced from the prestaged arrays), and the stream
+    stays byte-identical to the split sync spec-off engine."""
+    sp = SamplingParams(temperature=0.0, max_tokens=14, ignore_eos=True)
+    base = make_unified_spec(False, spec=False).generate(
+        [list(p) for p in UNIFIED_SPEC_PROMPTS], sp
+    )
+    eng = make_unified_spec(True, async_mode=True)
+    out = eng.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+    assert list(base.values()) == list(out.values())
+    assert eng._inflight is None
+    assert eng.stats.unified_steps_total > 0
+    assert eng.stats.async_rollbacks_total >= 1
+    assert eng.allocator.usage() == 0.0
+
+
+def test_unified_async_rollback_slices_staged_arrays():
+    """A rollback that drops rows from a staged unified batch must
+    SLICE the surviving rows' row-independent arrays out of the
+    prestaged staging (ModelRunner.subset_staged_unified over
+    _slice_staged_rows) instead of restaging in the blocking host
+    region — and the sliced dispatch must stay byte-identical."""
+    from llmd_tpu.engine.runner import ModelRunner
+
+    hits = {"subset": 0}
+    orig = ModelRunner.subset_staged_unified
+
+    def counting(self, *a, **k):
+        hits["subset"] += 1
+        return orig(self, *a, **k)
+
+    sp = SamplingParams(temperature=0.0, max_tokens=14, ignore_eos=True)
+    base = make_unified_spec(False, spec=False).generate(
+        [list(p) for p in UNIFIED_SPEC_PROMPTS], sp
+    )
+    eng = make_unified_spec(True, async_mode=True)
+    try:
+        ModelRunner.subset_staged_unified = counting
+        out = eng.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+    finally:
+        ModelRunner.subset_staged_unified = orig
+    assert list(base.values()) == list(out.values())
+    assert hits["subset"] > 0, (
+        "no rollback reused the staged unified arrays: the slicing "
+        "path was never exercised"
+    )
+    assert eng.stats.async_rollbacks_total > 0
+
+
+def test_unified_spec_one_readback_per_step():
+    """A mixed spec step — prefill chunk + verify rows + plain decode
+    rows, up to THREE programs on the split engine — still costs exactly
+    one blocking readback, and the unified engine dispatches fewer
+    programs for the same byte-identical stream."""
+    sp = SamplingParams(temperature=0.0, max_tokens=20, ignore_eos=True)
+
+    def run(unified):
+        eng = make_unified_spec(unified)
+        calls = {"n": 0}
+        orig = eng.runner.wait_step
+
+        def counting(prefill, decode, unified_pend=None):
+            calls["n"] += 1
+            return orig(prefill, decode, unified_pend)
+
+        eng.runner.wait_step = counting
+        out = eng.generate([list(p) for p in UNIFIED_SPEC_PROMPTS], sp)
+        assert calls["n"] == eng.stats.engine_steps_total
+        return eng, out
+
+    split_eng, split_out = run(False)
+    uni_eng, uni_out = run(True)
+    assert list(split_out.values()) == list(uni_out.values())
+    assert uni_eng.stats.unified_steps_total > 0
+    assert (
+        uni_eng.stats.step_dispatches_total
+        < split_eng.stats.step_dispatches_total
     )
 
 

@@ -467,6 +467,27 @@ class TestLockstep:
             for f in findings
         )
 
+    def test_real_runner_missing_unified_arm_fails(self, tmp_path):
+        """Acceptance pin for the unified single-dispatch step's opcode:
+        deleting the _OP_UNIFIED follower arm from the REAL runner must
+        fail the build — on a multi-host engine every mixed step rides
+        this opcode, so a follower without the arm desynchronizes the
+        lockstep collective stream on the FIRST mixed step."""
+        src = RUNNER.read_text()
+        arm = "            elif op == _OP_UNIFIED:\n"
+        assert arm in src, "follower_loop layout changed; update this pin"
+        lines = src.splitlines(keepends=True)
+        i = lines.index(arm)
+        # Drop the arm plus its body (comment + exec call).
+        del lines[i : i + 4]
+        (tmp_path / "engine").mkdir(parents=True)
+        (tmp_path / "engine/runner.py").write_text("".join(lines))
+        findings, _ = run_analysis(tmp_path, [str(tmp_path)], ["lockstep"])
+        assert any(
+            f.code == "LS001" and "_OP_UNIFIED" in f.message
+            for f in findings
+        )
+
     def test_real_runner_is_clean(self):
         findings, _ = run_analysis(REPO, [str(RUNNER)], ["lockstep"])
         assert findings == []
