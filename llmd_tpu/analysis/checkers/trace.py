@@ -20,9 +20,14 @@ Rules:
 - TD003: ``donate_argnums`` index out of range for the wrapped function.
 - TD004: a method dispatching a shape-family opcode (``_sync`` with
   ``_OP_PREFILL``/``_OP_DECODE``/``_OP_VERIFY``/``_OP_VERIFY_WINDOW``/
-  ``_OP_UNIFIED``/``_OP_EMBED``) that neither buckets its shapes
-  (``pad_to_bucket``) nor consumes a prestaged ``Staged*`` batch nor is
-  a declared warmup (``_warm_*``).
+  ``_OP_UNIFIED``/``_OP_FLAT``/``_OP_EMBED``) that neither buckets its
+  shapes (``pad_to_bucket``) nor consumes a prestaged ``Staged*`` batch
+  nor is a declared warmup (``_warm_*``). The flattened-token family
+  (``_OP_FLAT``) is shape-disciplined on its T axis alone: the stream
+  must ride the fine-grained flat T buckets (staging derives it via
+  ``pad_to_bucket`` over ``flat_t_buckets``) with the row-metadata
+  width FIXED — an ad-hoc stream length would compile a new program per
+  distinct step size, exactly what the one-shape-family design removes.
 """
 
 from __future__ import annotations
@@ -35,7 +40,7 @@ _CONSTRUCTION_PREFIXES = ("_build_", "_alloc_", "_warm_")
 _CONSTRUCTION_NAMES = {"__init__"}
 _SHAPE_FAMILY_OPS = {
     "_OP_PREFILL", "_OP_DECODE", "_OP_VERIFY", "_OP_VERIFY_WINDOW",
-    "_OP_UNIFIED", "_OP_EMBED",
+    "_OP_UNIFIED", "_OP_FLAT", "_OP_EMBED",
 }
 
 

@@ -332,6 +332,18 @@ class SchedulerConfig:
     # (fused decode windows, fused verify windows) keep their own
     # dispatch either way — they already amortize the round-trip.
     unified_step: bool = True
+    # Genuinely ragged flattened-token forward (`cu_q_lens`): the unified
+    # step runs over the PACKED token stream itself — a decode row costs
+    # 1 token, a verify row 1 + its own draft length (per-row adaptive
+    # verify depth), a prefill chunk its chunk length — instead of every
+    # row padding to the bucketed [B, Q] sub-row width. One flattened
+    # program (T-bucketed, 16-token granules) serves every window=1 step
+    # kind; greedy and seeded streams stay byte-identical to the
+    # bucketed unified step and the split engine. Turning this off
+    # restores the bucketed [B, Q] unified program. Effective only with
+    # unified_step on and a non-MLA model (MLA latent writes keep the
+    # bucketed layout).
+    ragged_qlens: bool = True
 
     def __post_init__(self) -> None:
         if self.spec_verify_window < 0:

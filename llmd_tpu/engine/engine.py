@@ -243,6 +243,21 @@ class EngineStats:
     # step's headline is step_dispatches_total / engine_steps_total
     # falling toward 1.0 on mixed workloads.
     step_dispatches_total: int = 0
+    # Padding efficiency (the flattened-token step's headline,
+    # SchedulerConfig.ragged_qlens): tokens the dispatched programs
+    # computed for real vs the pad lanes their traced shapes paid on
+    # top — the bucketed [B, Q] unified step pads every decode row to
+    # the sub-row Q bucket; the flat stream pads only to the 16-token
+    # T granule. padded / live is the padding-waste gauge.
+    live_tokens_total: int = 0
+    padded_tokens_total: int = 0
+    # Per-row verify depth histogram (speculative engines): index d
+    # counts decode rows dispatched with a 1 + draft width of exactly d
+    # tokens (backed-off rows: 1; hot-draft rows: up to 1 + spec_k,
+    # deeper windowed plans clamp to the top bucket). Two rows in
+    # DIFFERENT buckets on one step is the per-row adaptive depth the
+    # flattened step dispatches in one program.
+    spec_row_depth_hist: tuple = ()
 
 
 @dataclass
@@ -461,6 +476,9 @@ class LLMEngine:
         # from committed history (async staging runs a step early), and
         # acceptance/rollback live in the scheduler's update loop.
         self._spec_proposer = None
+        # Per-row verify depth histogram (index = 1 + draft width; see
+        # EngineStats.spec_row_depth_hist).
+        self._spec_row_depth = [0] * (2 + config.scheduler.spec_ngram_k)
         if config.scheduler.speculative_ngram:
             from llmd_tpu.engine.spec import NgramProposer
 
@@ -962,13 +980,33 @@ class LLMEngine:
     def _unified_eligible(self, batch: ScheduledBatch) -> bool:
         """Does this batch ride the unified single-dispatch program?
         Window=1 steps only (fused decode/verify windows keep their own
-        dispatch — they already amortize the round-trip), and only where
-        the split engine would launch MORE than one program: mixed
-        prefill+decode steps, or prefill-only steps spanning several Q
-        buckets. Pure-decode window=1 steps are already one dispatch
-        (mixed drafted/plain spec splits keep today's two-program path —
-        their staging shape depends on drafts only known at dispatch)."""
-        if self.runner._unified is None or batch.spec_window != 1:
+        dispatch — they already amortize the round-trip).
+
+        Flattened-token engines (`--ragged-qlens`): EVERY window=1 step
+        kind rides the ONE flat program — prefill-only, pure-decode,
+        mixed, and one-shot verify mixes (a mixed drafted/plain spec
+        step becomes one dispatch where the split path launched two,
+        with per-row adaptive verify depth via each row's own qlen).
+
+        Bucketed engines: only where the split engine would launch MORE
+        than one program — mixed prefill+decode steps, or prefill-only
+        steps spanning several Q buckets. Pure-decode window=1 steps
+        are already one dispatch (mixed drafted/plain spec splits keep
+        the two-program path — their staging shape depends on drafts
+        only known at dispatch)."""
+        if batch.spec_window != 1:
+            return False
+        if self.runner._flat is not None:
+            if batch.is_empty:
+                return False
+            # Fused decode windows (non-spec K>1 rows) keep their own
+            # dispatch — they already amortize the round-trip.
+            if self._spec_proposer is None and any(
+                s.num_tokens != 1 for s in batch.decodes
+            ):
+                return False
+            return True
+        if self.runner._unified is None:
             return False
         if not batch.prefills:
             return False
@@ -1130,6 +1168,11 @@ class LLMEngine:
             seq.draft_tokens = self._spec_proposer.propose(
                 req.all_token_ids, cap, req.spec_gram_state
             )
+        for seq in decodes:
+            depth = 1 + len(seq.draft_tokens or [])
+            self._spec_row_depth[
+                min(depth, len(self._spec_row_depth) - 1)
+            ] += 1
 
     def _collect(
         self,
@@ -1245,6 +1288,9 @@ class LLMEngine:
             self.stats.spec_accepted_len_hist = tuple(sch.spec_accept_len_hist)
             self.stats.spec_window_iters_total = sch.spec_window_iters
             self.stats.spec_window_early_exit_total = sch.spec_window_early_exit
+            self.stats.spec_row_depth_hist = tuple(self._spec_row_depth)
+        self.stats.live_tokens_total = self.runner.live_tokens_total
+        self.stats.padded_tokens_total = self.runner.padded_tokens_total
         self.stats.dispatches_per_emitted_token = round(
             self.stats.decode_dispatches_total
             / max(1, self.stats.generation_tokens),

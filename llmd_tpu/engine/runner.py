@@ -75,6 +75,13 @@ _OP_VERIFY_WINDOW = 9
 # (Q_bucket << 20) | T_bucket; the payload is a flat token stream plus
 # per-row (start, qlen, kind) metadata).
 _OP_UNIFIED = 10
+# Genuinely ragged flattened-token step (`--ragged-qlens`): the unified
+# step's forward runs over the PACKED [T_bucket] token stream itself
+# (cu_q_lens row offsets; per-token causality = position + 1) instead of
+# gathering into a padded [B, Q] view — a decode row costs ONE token, a
+# verify row 1 + its own draft length. Header QK carries T_bucket
+# directly (no Q packing: the flat family has no per-row column bucket).
+_OP_FLAT = 11
 
 # Row kinds of the unified step's (start, qlen, kind) metadata. Only
 # verify-ness reaches the device (it selects the sample positions: verify
@@ -292,6 +299,11 @@ class StagedUnified:
     T: int  # token-stream bucket (bucketed sum of planned widths)
     S: int  # sample columns per row (spec_q on speculative engines, 1)
     all_greedy: bool
+    # Flattened-token staging (`--ragged-qlens`): dispatch rides the
+    # _OP_FLAT program over the packed stream (B is the FIXED row-
+    # metadata width, T a fine-grained flat bucket) instead of the
+    # bucketed [B, Q] gather.
+    flat: bool = False
 
 
 @dataclass
@@ -426,6 +438,29 @@ class ModelRunner:
         self._unified = (
             self._build_unified() if sched.unified_step else None
         )
+        # Genuinely ragged flattened-token step (SchedulerConfig.
+        # ragged_qlens): the unified step's forward runs over the packed
+        # [T] token stream with cu_q_lens row offsets — no [B, Q]
+        # padding. ONE T-bucket dimension (16-token granules, so padding
+        # waste is bounded by 15 tokens/step) replaces the bucketed
+        # unified family's (rows x Q x T) cross-product; the row-
+        # metadata width is FIXED at the largest row bucket (metadata is
+        # O(rows), not O(tokens) — a few KB). MLA keeps the bucketed
+        # layout (latent writes have their own addressing).
+        self._flat = None
+        self.flat_rows = 0
+        self.flat_t_buckets: tuple[int, ...] = ()
+        if sched.unified_step and sched.ragged_qlens and not self.cfg.is_mla:
+            limit = sched.max_num_batched_tokens + max(self.unified_s, 1)
+            limit = -(-limit // 16) * 16
+            self.flat_t_buckets = tuple(range(16, limit + 1, 16))
+            self.flat_rows = self.unified_row_buckets[-1]
+            self._flat = self._build_flat()
+        # Padding-efficiency accounting (EngineStats padded/live tokens):
+        # every dispatch path adds its live token count and the padded
+        # compute width the traced shape actually paid for.
+        self.live_tokens_total = 0
+        self.padded_tokens_total = 0
 
     # ------------------------------------------------------------------ #
 
@@ -858,6 +893,130 @@ class ModelRunner:
             return kv_cache, kv_swa, replicate(packed)
 
         return unified
+
+    def _build_flat(self):
+        """Genuinely ragged flattened-token step (`cu_q_lens`): the SAME
+        engine step the bucketed unified program runs, but the forward
+        iterates the packed ``[T]`` token stream itself. The device
+        derives the per-token view from the per-row metadata — token t
+        belongs to the row whose ``[row_start, row_start + qlen)`` span
+        holds it (``searchsorted`` over the cu_q_lens ends; pad rows
+        carry ``row_start = total`` so the boundary array stays
+        monotonic), its position is ``pos0[row] + (t - row_start[row])``
+        and its causal horizon is ``position + 1`` — so a decode row
+        costs ONE token of the stream, a verify row ``1 + its own draft
+        length`` (per-row adaptive verify depth: hot-draft rows run deep
+        windows while backed-off rows run depth 1 in the same program),
+        and nothing pads to a per-row column bucket. KV lands through
+        the run-addressed flat write plan (same-page-safe Pallas writes
+        on TPU); sampling gathers each row's positions out of the packed
+        hidden stream and the step still comes back as ONE ``[B, 2S]``
+        transfer."""
+        cfg = self.cfg
+        world = self.ctx.world
+        mesh = self.ctx.mesh
+        kv_rep = self.kv_rep
+        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        ep_capacity = self.config.parallel.ep_capacity_factor
+        replicate = self._replicate_out
+        ring = self.swa is not None
+        S = self.unified_s
+
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(1, 2) if ring else (1,),
+            static_argnames=("all_greedy",),
+        )
+        def flat(
+            params,
+            kv_cache,
+            kv_swa,  # ring pool (None unless swa_ring)
+            stream: jax.Array,  # [T] packed token stream
+            row_start: jax.Array,  # [B] cu_q_lens offsets (pad rows: total)
+            pos0: jax.Array,  # [B] absolute position of the row's first token
+            qlens: jax.Array,  # [B] valid token count per row
+            verify_row: jax.Array,  # [B] bool (kind == verify)
+            page_table: jax.Array,  # [B, max_pages] COMPACT per-row table
+            swa_table,  # [B, max_pages] ring view, or None
+            lora_ids,  # [B] i32 adapter slots, or None
+            temperature: jax.Array,
+            top_k: jax.Array,
+            top_p: jax.Array,
+            seeds: jax.Array,  # [B, S]
+            wsrc: jax.Array,  # [R] flat-write run slab starts
+            woff: jax.Array,  # [R] first in-page slot per run
+            wcnt: jax.Array,  # [R] token count per run (0 = pad)
+            wphys: jax.Array,  # [R] physical page per run (main pool)
+            wphys_swa,  # [R] physical page per run (ring pool), or None
+            all_greedy: bool = False,
+        ):
+            T = stream.shape[0]
+            B = row_start.shape[0]
+            t = jnp.arange(T)
+            ends = row_start + qlens  # non-decreasing (pad rows = total)
+            row_of = jnp.clip(
+                jnp.searchsorted(ends, t, side="right"), 0, B - 1
+            ).astype(jnp.int32)
+            live = t < ends[-1]
+            local = t - row_start[row_of]
+            positions_t = jnp.where(live, pos0[row_of] + local, 0)
+            inp = StepInput(
+                token_ids=jnp.where(live, stream, 0)[:, None],
+                positions=positions_t[:, None],
+                query_lens=live.astype(jnp.int32),
+                # Per-token causal horizon derived from the packing:
+                # position + 1 — the whole causal mask the bucketed
+                # layout needed [B, Q] positions for.
+                kv_lens=jnp.where(live, positions_t + 1, 0).astype(jnp.int32),
+                page_table=page_table,
+                lora_ids=(
+                    lora_ids[row_of] if lora_ids is not None else None
+                ),
+                swa_page_table=swa_table,
+                token_rows=row_of,
+                flat_runs=((wsrc, woff, wcnt), wphys, wphys_swa),
+            )
+            if ring:
+                hidden, kv_cache, kv_swa = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
+                    kv_swa=kv_swa,
+                )
+            else:
+                hidden, kv_cache = llama.forward_hidden(
+                    params, kv_cache, inp, cfg, world,
+                    mesh=mesh, moe_backend=moe_backend,
+                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
+                )
+            H = hidden.shape[-1]
+            scols = jnp.arange(S)
+            last = jnp.maximum(qlens - 1, 0)
+            samp_local = jnp.where(
+                verify_row[:, None],
+                jnp.minimum(scols[None, :], last[:, None]),
+                last[:, None],
+            )  # [B, S] offsets within each row
+            flat_idx = jnp.clip(row_start[:, None] + samp_local, 0, T - 1)
+            h = hidden[flat_idx, 0]  # [B, S, H]
+            logits = llama.compute_logits(params, h.reshape(B * S, H), cfg)
+            flat_s = SamplingInputs(
+                temperature=jnp.repeat(temperature, S),
+                top_k=jnp.repeat(top_k, S),
+                top_p=jnp.repeat(top_p, S),
+                seeds=seeds.reshape(B * S),
+            )
+            tok, logp = sample_tokens(logits, flat_s, all_greedy)
+            packed = jnp.concatenate(
+                [
+                    tok.reshape(B, S).astype(jnp.float32),
+                    logp.reshape(B, S),
+                ],
+                axis=1,
+            )  # [B, 2S]
+            return kv_cache, kv_swa, replicate(packed)
+
+        return flat
 
     def _build_verify_window(self):
         """Fused verify window: ``window`` verify iterations in ONE jit
@@ -1523,6 +1682,36 @@ class ModelRunner:
                 ("top_p", (B,), np.float32),
                 ("seeds", (B, self.unified_s), np.uint32),
             ]
+        elif op == _OP_FLAT:
+            # Flattened-token step: QK carries T_bucket directly (the
+            # flat family has no per-row column bucket). The run-plan
+            # width derives from (B, T, page) identically on both sides:
+            # a row touching p pages emits p runs, and p <= (w-1)//page
+            # + 2 (the +2 covers the first page AND a mid-page start's
+            # extra straddle — a 2-token row starting at slot page-1
+            # already touches two pages), so the total is bounded by
+            # 2*B + ceil(T / page).
+            t = QK
+            rn = 2 * B + -(-t // self.page)
+            spec = [
+                ("stream", (t,), np.int32),
+                ("row_start", (B,), np.int32),
+                ("pos0", (B,), np.int32),
+                ("qlens", (B,), np.int32),
+                ("kvlens", (B,), np.int32),
+                ("kind", (B,), np.uint8),
+                ("page_table", (B, mp), np.int32),
+                ("temp", (B,), np.float32),
+                ("top_k", (B,), np.int32),
+                ("top_p", (B,), np.float32),
+                ("seeds", (B, self.unified_s), np.uint32),
+                ("wsrc", (rn,), np.int32),
+                ("woff", (rn,), np.int32),
+                ("wcnt", (rn,), np.int32),
+                ("wphys", (rn,), np.int32),
+            ]
+            if self.swa is not None:
+                spec.append(("wphys_swa", (rn,), np.int32))
         else:
             spec = [
                 ("first", (B,), np.int32),
@@ -1593,6 +1782,8 @@ class ModelRunner:
                 # QK packs (Q_bucket << 20) | T_bucket; the exec only
                 # needs the static per-row column count.
                 self._exec_unified(arrays, QK >> 20, bool(greedy))
+            elif op == _OP_FLAT:
+                self._exec_flat(arrays, bool(greedy))
             elif op == _OP_KV_GATHER:
                 # Participate in the SPMD gather (the all-gather collective
                 # needs every process); the replicated result is dropped —
@@ -1711,6 +1902,38 @@ class ModelRunner:
             jnp.asarray(arrays["top_p"]),
             jnp.asarray(arrays["seeds"]),
             Q=Q,
+            all_greedy=all_greedy,
+        )
+        return packed
+
+    def _exec_flat(self, arrays: dict, all_greedy: bool) -> jax.Array:
+        self.kv_cache, self.kv_swa, packed = self._flat(
+            self.params,
+            self.kv_cache,
+            self.kv_swa,
+            jnp.asarray(arrays["stream"]),
+            jnp.asarray(arrays["row_start"]),
+            jnp.asarray(arrays["pos0"]),
+            jnp.asarray(arrays["qlens"]),
+            jnp.asarray(arrays["kind"] == _KIND_VERIFY),
+            jnp.asarray(arrays["page_table"]),
+            (
+                jnp.asarray(arrays["swa_table"])
+                if "swa_table" in arrays else None
+            ),
+            jnp.asarray(arrays["lora"]) if "lora" in arrays else None,
+            jnp.asarray(arrays["temp"]),
+            jnp.asarray(arrays["top_k"]),
+            jnp.asarray(arrays["top_p"]),
+            jnp.asarray(arrays["seeds"]),
+            jnp.asarray(arrays["wsrc"]),
+            jnp.asarray(arrays["woff"]),
+            jnp.asarray(arrays["wcnt"]),
+            jnp.asarray(arrays["wphys"]),
+            (
+                jnp.asarray(arrays["wphys_swa"])
+                if "wphys_swa" in arrays else None
+            ),
             all_greedy=all_greedy,
         )
         return packed
@@ -2167,6 +2390,9 @@ class ModelRunner:
             arrays["swa_table"] = self._swa_table(seqs, B)
         if self.cfg.num_lora_adapters:
             arrays["lora"] = self._lora_array(seqs, B)
+        live = int(qlens.sum())
+        self.live_tokens_total += live
+        self.padded_tokens_total += B * Q - live
         all_greedy = all(s.request.sampling.greedy for s in seqs)
         with self._dispatch_lock:
             arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
@@ -2238,13 +2464,15 @@ class ModelRunner:
             first[i] = req.all_token_ids[req.num_computed_tokens]
             start[i] = req.num_computed_tokens
         self._overwrite_seeded_rows(seeds, staged.seqs, staged.k)
+        n = len(staged.seqs)
+        self.live_tokens_total += n * staged.k
+        self.padded_tokens_total += (staged.B - n) * staged.k
         with self._dispatch_lock:
             arrays = self._sync(
                 _OP_DECODE, staged.B, staged.k, staged.all_greedy,
                 staged.arrays,
             )
             packed = self._exec_decode(arrays, staged.k, staged.all_greedy)
-        n = len(staged.seqs)
         return PendingDecode(
             [(packed, list(range(n)), staged.k, 0)], n, staged.k
         )
@@ -2312,6 +2540,9 @@ class ModelRunner:
             qlens[i] = m
             kvlens[i] = nc + m
         self._overwrite_seeded_rows(seeds, staged.seqs, staged.q)
+        live = int(qlens.sum())
+        self.live_tokens_total += live
+        self.padded_tokens_total += staged.B * staged.q - live
         with self._dispatch_lock:
             arrays = self._sync(
                 _OP_VERIFY, staged.B, staged.q, staged.all_greedy,
@@ -2482,9 +2713,18 @@ class ModelRunner:
             row_off.append(0)
             row_plan.append(s.num_tokens)
         n = len(row_seqs)
-        B = pad_to_bucket(n, self.unified_row_buckets)
+        flat = self._flat is not None
+        if flat:
+            # Flattened-token staging: the row-metadata width is FIXED
+            # (one traced B — metadata is O(rows), a few KB) and the
+            # stream buckets over the fine-grained flat T set, so the
+            # shape family is the T axis alone.
+            B = self.flat_rows
+            T = pad_to_bucket(sum(row_plan), self.flat_t_buckets)
+        else:
+            B = pad_to_bucket(n, self.unified_row_buckets)
+            T = pad_to_bucket(sum(row_plan), self.prefill_buckets)
         Q = pad_to_bucket(max(row_plan), self.unified_q_buckets)
-        T = pad_to_bucket(sum(row_plan), self.prefill_buckets)
         S = self.unified_s
         temp, top_k, top_p = self._sampling_knobs(row_seqs, B)
         arrays = {
@@ -2506,6 +2746,7 @@ class ModelRunner:
         return StagedUnified(
             list(prefills), list(decodes), row_seqs, row_off, row_plan,
             prefill_rows, decode_rows, arrays, B, Q, T, S, all_greedy,
+            flat=flat,
         )
 
     def dispatch_unified(
@@ -2567,17 +2808,75 @@ class ModelRunner:
             kvlens[r] = start + w
             t += w
         self._overwrite_seeded_rows(a["seeds"], staged.row_seqs, staged.S)
-        with self._dispatch_lock:
-            arrays = self._sync(
-                _OP_UNIFIED, staged.B, (staged.Q << 20) | staged.T,
-                staged.all_greedy, a,
-            )
-            packed = self._exec_unified(arrays, staged.Q, staged.all_greedy)
+        self.live_tokens_total += t
+        if staged.flat:
+            # Pad rows carry row_start = total so the cu_q_lens boundary
+            # array the device searchsorts stays monotonic.
+            row_start[len(staged.row_seqs):] = t
+            self._fill_flat_runs(staged, a)
+            self.padded_tokens_total += staged.T - t
+            with self._dispatch_lock:
+                arrays = self._sync(
+                    _OP_FLAT, staged.B, staged.T, staged.all_greedy, a
+                )
+                packed = self._exec_flat(arrays, staged.all_greedy)
+        else:
+            self.padded_tokens_total += staged.B * staged.Q - t
+            with self._dispatch_lock:
+                arrays = self._sync(
+                    _OP_UNIFIED, staged.B, (staged.Q << 20) | staged.T,
+                    staged.all_greedy, a,
+                )
+                packed = self._exec_unified(
+                    arrays, staged.Q, staged.all_greedy
+                )
         return PendingUnified(
             packed, staged.S, list(staged.prefill_rows),
             list(staged.decode_rows), len(staged.prefills),
             len(staged.decodes),
         )
+
+    def _fill_flat_runs(self, staged: StagedUnified, a: dict) -> None:
+        """Host half of the flat KV-write plan: walk each row's token
+        span page by page and emit one run per (row, physical page) —
+        maximal spans of consecutive stream tokens landing in one page,
+        so runs target distinct pages (the Pallas write pipeline's
+        precondition). ``src`` is pre-shifted (page + t0 - off) so the
+        kernel's fixed-size slab DMA lands token t0+j at page row off+j.
+        The run width derives from (B, T, page) on both lockstep sides;
+        see the _OP_FLAT payload spec for the bound's derivation.
+        """
+        page = self.page
+        rn = 2 * staged.B + -(-staged.T // page)
+        wsrc = np.zeros(rn, np.int32)
+        woff = np.zeros(rn, np.int32)
+        wcnt = np.zeros(rn, np.int32)
+        wphys = np.zeros(rn, np.int32)
+        pt = a["page_table"]
+        st = a.get("swa_table")
+        wphys_swa = np.zeros(rn, np.int32) if st is not None else None
+        i = 0
+        for r in range(len(staged.row_seqs)):
+            t0 = int(a["row_start"][r])
+            p0 = int(a["pos0"][r])
+            w = int(a["qlens"][r])
+            consumed = 0
+            while consumed < w:
+                p = p0 + consumed
+                pg, o = p // page, p % page
+                take = min(page - o, w - consumed)
+                wsrc[i] = page + t0 + consumed - o
+                woff[i] = o
+                wcnt[i] = take
+                wphys[i] = pt[r, pg]
+                if wphys_swa is not None:
+                    wphys_swa[i] = st[r, pg]
+                i += 1
+                consumed += take
+        assert i <= rn, (i, rn)
+        a["wsrc"], a["woff"], a["wcnt"], a["wphys"] = wsrc, woff, wcnt, wphys
+        if wphys_swa is not None:
+            a["wphys_swa"] = wphys_swa
 
     def subset_staged_unified(
         self,
@@ -2615,9 +2914,13 @@ class ModelRunner:
             row_seqs.append(s)
             row_off.append(0)
             row_plan.append(staged.row_plan[r])
-        B = pad_to_bucket(len(rows), self.unified_row_buckets)
+        if staged.flat:
+            B = self.flat_rows
+            T = pad_to_bucket(sum(row_plan), self.flat_t_buckets)
+        else:
+            B = pad_to_bucket(len(rows), self.unified_row_buckets)
+            T = pad_to_bucket(sum(row_plan), self.prefill_buckets)
         Q = pad_to_bucket(max(row_plan), self.unified_q_buckets)
-        T = pad_to_bucket(sum(row_plan), self.prefill_buckets)
         S = staged.S
         arrays = self._slice_staged_rows(
             staged.arrays, rows, B, self._ROW_SLICE_NAMES
@@ -2635,6 +2938,7 @@ class ModelRunner:
         return StagedUnified(
             list(live_p), list(live_d), row_seqs, row_off, row_plan,
             prefill_rows, decode_rows, arrays, B, Q, T, S, all_greedy,
+            flat=staged.flat,
         )
 
     def prefill_group_count(self, seqs: list[ScheduledSeq]) -> int:
@@ -2716,6 +3020,14 @@ class ModelRunner:
             if sp.seed is not None:
                 seed_base[i] = np.uint32(sp.seed & 0xFFFFFFFF)
                 seeded[i] = 1
+        n = len(staged.seqs)
+        # Planned widths: actual emission is resolved on device, so the
+        # padding gauge charges the pad ROWS only (live rows' idle
+        # iterations are the window's own accounting).
+        self.live_tokens_total += n * staged.window * staged.q
+        self.padded_tokens_total += (
+            (staged.B - n) * staged.window * staged.q
+        )
         with self._dispatch_lock:
             arrays = self._sync(
                 _OP_VERIFY_WINDOW, staged.B, staged.window,
@@ -2724,7 +3036,6 @@ class ModelRunner:
             packed = self._exec_verify_window(
                 arrays, staged.window, staged.all_greedy
             )
-        n = len(staged.seqs)
         wmax = staged.window * staged.q
         return PendingDecode([(packed, list(range(n)), wmax, 4)], n, wmax)
 
@@ -2825,17 +3136,35 @@ class ModelRunner:
         number of programs compiled.
         """
         sched = self.config.scheduler
+        flat = self._flat is not None
         if prefill_shapes is None:
-            # The lone-prefill shape (B=1) is the P/D TTFT-critical one;
-            # compile it alongside the largest so the first single
-            # request never eats a compile.
-            prefill_shapes = [(self.prefill_batch_buckets[-1], self.prefill_buckets[-1])]
-            if self.prefill_batch_buckets[0] == 1:
-                prefill_shapes.append((1, self.prefill_buckets[-1]))
+            # With the flattened step on, EVERY window=1 step kind —
+            # prefill-only, pure-decode, mixed, one-shot verify — rides
+            # the ONE flat program, so the split prefill/verify families
+            # are reachable only through the P/D eager-ACK producer path
+            # (which keeps its own dispatch) and explicit API calls:
+            # warm them only where a producer role makes them hot.
+            if flat and not self.config.kv_role:
+                prefill_shapes = []
+            else:
+                # The lone-prefill shape (B=1) is the P/D TTFT-critical
+                # one; compile it alongside the largest so the first
+                # single request never eats a compile.
+                prefill_shapes = [
+                    (self.prefill_batch_buckets[-1], self.prefill_buckets[-1])
+                ]
+                if self.prefill_batch_buckets[0] == 1:
+                    prefill_shapes.append((1, self.prefill_buckets[-1]))
         if decode_shapes is None:
             decode_shapes = [
                 (self.batch_buckets[-1], k) for k in self.decode_windows
             ]
+            if flat and len(self.decode_windows) == 1:
+                # Window=1 decode steps ride the flat program; the plain
+                # decode family stays reachable only via explicit
+                # run_decode calls and the windowed degrade paths, which
+                # this engine (decode_windows == {1}) never takes.
+                decode_shapes = []
         count = 0
         for B, Q in prefill_shapes:
             for greedy in (True, False):
@@ -2845,10 +3174,11 @@ class ModelRunner:
             for greedy in (True, False):
                 self._warm_decode(B, K, greedy)
                 count += 1
-        if self.spec_q:
+        if self.spec_q and not flat:
             # The speculative verify family: one Q (= 1 + spec_ngram_k)
             # at the largest row bucket plus the lone-row shape (mixed
-            # steps often verify a single drafting row).
+            # steps often verify a single drafting row). The flat engine
+            # verifies inside the flat program instead.
             for B in {1, self.prefill_batch_buckets[-1]}:
                 for greedy in (True, False):
                     self._warm_verify(B, greedy)
@@ -2861,7 +3191,13 @@ class ModelRunner:
             for greedy in (True, False):
                 self._warm_verify_window(self.batch_buckets[-1], w, greedy)
                 count += 1
-        if self._unified is not None:
+        if flat:
+            # The flat family's one shape axis is T: warm the largest
+            # stream bucket (the saturated-step shape).
+            for greedy in (True, False):
+                self._warm_flat(self.flat_t_buckets[-1], greedy)
+                count += 1
+        elif self._unified is not None:
             # The unified mixed-step family at its largest row/column/
             # stream buckets — the shape a saturated mixed step lands on.
             for greedy in (True, False):
@@ -2873,6 +3209,56 @@ class ModelRunner:
                 )
                 count += 1
         return count
+
+    def window1_shape_families(self) -> int:
+        """Distinct (program, shape-bucket) combinations the engine can
+        dispatch for WINDOW=1 step kinds — prefill chunks, plain decode,
+        one-shot verify, mixed — i.e. the compile surface warmup and
+        serving draw from. The flattened-token step collapses the
+        bucketed (rows x Q x T) unified cross-product plus the split
+        prefill/verify families to the flat T axis alone."""
+        if self._flat is not None:
+            return len(self.flat_t_buckets)
+        n = len(self.prefill_batch_buckets) * len(self.prefill_buckets)
+        n += len(self.batch_buckets)  # plain decode at window 1
+        if self.spec_q:
+            n += len(self.prefill_batch_buckets)  # one-shot verify rows
+        if self._unified is not None:
+            n += (
+                len(self.unified_row_buckets)
+                * len(self.unified_q_buckets)
+                * len(self.prefill_buckets)
+            )
+        return n
+
+    def _warm_flat(self, T: int, all_greedy: bool = False) -> None:
+        B = self.flat_rows
+        rn = 2 * B + -(-T // self.page)
+        arrays = {
+            "stream": np.zeros(T, np.int32),
+            "row_start": np.zeros(B, np.int32),
+            "pos0": np.zeros(B, np.int32),
+            "qlens": np.zeros(B, np.int32),
+            "kvlens": np.zeros(B, np.int32),
+            "kind": np.zeros(B, np.uint8),
+            "page_table": np.zeros((B, self.max_pages), np.int32),
+            "temp": np.zeros(B, np.float32),
+            "top_k": np.zeros(B, np.int32),
+            "top_p": np.ones(B, np.float32),
+            "seeds": np.zeros((B, self.unified_s), np.uint32),
+            "wsrc": np.zeros(rn, np.int32),
+            "woff": np.zeros(rn, np.int32),
+            "wcnt": np.zeros(rn, np.int32),
+            "wphys": np.zeros(rn, np.int32),
+        }
+        if self.swa is not None:
+            arrays["swa_table"] = np.zeros((B, self.max_pages), np.int32)
+            arrays["wphys_swa"] = np.zeros(rn, np.int32)
+        if self.cfg.num_lora_adapters:
+            arrays["lora"] = np.zeros(B, np.int32)
+        with self._dispatch_lock:
+            arrays = self._sync(_OP_FLAT, B, T, all_greedy, arrays)
+            self._exec_flat(arrays, all_greedy)
 
     def _warm_unified(
         self, B: int, Q: int, T: int, all_greedy: bool = False

@@ -36,6 +36,22 @@ class StepInput:
     # entries repeat modulo the per-sequence ring length); None unless the
     # engine runs with CacheConfig.swa_ring.
     swa_page_table: jax.Array | None = None
+    # Flattened-token layout (`--ragged-qlens`): when set, the "batch"
+    # axis is a packed token stream — token_ids/positions are [T, 1],
+    # query_lens/kv_lens are per TOKEN (kv_len = position + 1, which IS
+    # the causal mask derived from cu_q_lens), and page_table stays the
+    # COMPACT per-row table [R, max_pages] indexed through this [T] i32
+    # token -> row map. None keeps the bucketed [B, Q] layout.
+    token_rows: jax.Array | None = None
+    # Run-addressed KV-write plan for the flattened layout:
+    # ((src, off, cnt), phys_main, phys_swa) where each run writes
+    # ``cnt`` consecutive stream tokens into one physical page at slots
+    # [off, off+cnt) — the same-page-safe addressing the Pallas write
+    # kernel needs (per-token decode writes would violate its
+    # distinct-pages pipeline precondition). ``src`` indexes the padded
+    # [K, T + 2*page, 2D] token slab (src = page + t0 - off, so slab row
+    # off+j holds token t0+j). phys_swa is None without a SWA ring.
+    flat_runs: tuple | None = None
 
     @property
     def valid(self) -> jax.Array:  # [B, Q] bool

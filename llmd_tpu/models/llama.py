@@ -22,7 +22,12 @@ from llmd_tpu.models.common import (
     StepInput, apply_rope, param_dtype, pdot, rms_norm, rope_tables,
 )
 from llmd_tpu.models.moe import moe_block
-from llmd_tpu.ops import paged_attention_full, write_kv_pages_full
+from llmd_tpu.ops import (
+    paged_attention_full,
+    paged_attention_full_flat,
+    write_kv_pages_full,
+    write_kv_pages_full_flat,
+)
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
@@ -222,7 +227,15 @@ def forward_hidden(
     # the pool-slicing XLA fallback (ops._mesh_plan's B % dp gate) —
     # slower and memory-hungrier, the opposite of the knob's intent.
     _dp = mesh.shape["dp"] if mesh is not None and "dp" in mesh.axis_names else 1
-    use_dbo = bool(dbo) and B >= 2 and B % 2 == 0 and (B // 2) % _dp == 0
+    # Flattened-token layout (inp.token_rows): the batch axis IS the
+    # packed token stream; attention/writes route through the cu_q_lens
+    # entry points below. DBO keeps the bucketed layout only (its
+    # half-batch table slicing assumes per-row tables).
+    flat = inp.token_rows is not None
+    use_dbo = (
+        bool(dbo) and not flat and B >= 2 and B % 2 == 0
+        and (B // 2) % _dp == 0
+    )
     half = B // 2
 
     def _ffn(h2, lp, use_moe: bool, cap_scale: float = 1.0):
@@ -254,7 +267,7 @@ def forward_hidden(
         return x_sl + _ffn(h2, lp, use_moe, cap_scale)
 
     def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None,
-                   table=None):
+                   table=None, run_phys=None):
         if table is None:
             table = inp.page_table
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
@@ -319,10 +332,20 @@ def forward_hidden(
             if kv_rep > 1:
                 k = jnp.repeat(k, kv_rep, axis=2)
                 v = jnp.repeat(v, kv_rep, axis=2)
-            cache = write_kv_pages_full(
-                cache, layer_idx, k, v, table, inp.positions, valid,
-                world_size=world_size, mesh=mesh,
-            )
+            if flat:
+                cache = write_kv_pages_full_flat(
+                    cache, layer_idx, k, v, table, inp.token_rows,
+                    inp.positions, valid,
+                    (*inp.flat_runs[0], run_phys)
+                    if inp.flat_runs is not None and run_phys is not None
+                    else None,
+                    world_size=world_size, mesh=mesh,
+                )
+            else:
+                cache = write_kv_pages_full(
+                    cache, layer_idx, k, v, table, inp.positions, valid,
+                    world_size=world_size, mesh=mesh,
+                )
             sinks = lp.get("sinks")
 
             def _project(attn_sl, n_rows):
@@ -344,11 +367,19 @@ def forward_hidden(
                         _tail(x[sl], _project(attn_sl, half), lp, use_moe, 2.0)
                     )
                 return jnp.concatenate(outs, axis=0), cache
-            attn = paged_attention_full(
-                q, cache, layer_idx, table, inp.kv_lens, inp.positions,
-                sm_scale, world_size=world_size, mesh=mesh, window=window,
-                sinks=sinks,
-            )
+            if flat:
+                attn = paged_attention_full_flat(
+                    q, cache, layer_idx, inp.token_rows, table,
+                    inp.kv_lens, inp.positions, sm_scale,
+                    world_size=world_size, mesh=mesh, window=window,
+                    sinks=sinks,
+                )
+            else:
+                attn = paged_attention_full(
+                    q, cache, layer_idx, table, inp.kv_lens, inp.positions,
+                    sm_scale, world_size=world_size, mesh=mesh, window=window,
+                    sinks=sinks,
+                )
             x = x + _project(attn, B)
         # attention residual already applied above; _tail adds 0
         return _tail(x, 0.0, lp, use_moe), cache
@@ -376,6 +407,11 @@ def forward_hidden(
         counts[knd] += 1
     caches = [kv_cache, kv_swa]
     tables = [inp.page_table, inp.swa_page_table]
+    # Flattened layout: the run plan shares (src, off, cnt) across pools;
+    # only the physical page per run differs (main table vs ring view).
+    run_physes = [None, None]
+    if flat and inp.flat_runs is not None:
+        run_physes = [inp.flat_runs[1], inp.flat_runs[2]]
 
     for i in range(n_dense):
         lp_i = jax.tree.map(lambda a: a[i], params["dense_layers"])
@@ -383,7 +419,7 @@ def forward_hidden(
         x, caches[g] = layer_body(
             x, caches[g], lp_i, jnp.int32(plane[i]), use_moe=False,
             window=None if windows is None else windows[i],
-            table=tables[g],
+            table=tables[g], run_phys=run_physes[g],
         )
 
     n_scan = cfg.num_layers - n_dense
@@ -392,7 +428,7 @@ def forward_hidden(
     win_arr = windows[n_dense:] if windows is not None else None
     lp_all = params["layers"]
 
-    def scan_group(x, cache, table, lp, plane_ids, wins):
+    def scan_group(x, cache, table, lp, plane_ids, wins, run_phys=None):
         """One homogeneous run of layers sharing a pool/table."""
 
         def fn(carry, scanned):
@@ -403,7 +439,8 @@ def forward_hidden(
             else:
                 lp_s, pid, w = scanned
             x, cache = layer_body(
-                x, cache, lp_s, pid, use_moe=cfg.is_moe, window=w, table=table
+                x, cache, lp_s, pid, use_moe=cfg.is_moe, window=w,
+                table=table, run_phys=run_phys,
             )
             return (x, cache), None
 
@@ -414,7 +451,8 @@ def forward_hidden(
     if len(set(scan_kinds)) <= 1:
         g = scan_kinds[0] if scan_kinds else 0
         x, caches[g] = scan_group(
-            x, caches[g], tables[g], lp_all, plane_arr, win_arr
+            x, caches[g], tables[g], lp_all, plane_arr, win_arr,
+            run_physes[g],
         )
     elif (c := _scan_period(scan_kinds)) is not None:
         # Hybrid periodic pattern (gpt-oss alternating): scan over CYCLES
@@ -439,6 +477,7 @@ def forward_hidden(
                 x, cc[g] = layer_body(
                     x, cc[g], lp_s, plane_c[j], use_moe=cfg.is_moe,
                     window=win_c[j] if g else None, table=tables[g],
+                    run_phys=run_physes[g],
                 )
             return (x, cc[0], cc[1]), None
 
@@ -459,6 +498,7 @@ def forward_hidden(
                 x, caches[g], tables[g],
                 jax.tree.map(lambda a: a[sl], lp_all),
                 plane_arr[sl], win_arr[sl] if g else None,
+                run_physes[g],
             )
             off += ln
 

@@ -35,15 +35,18 @@ from llmd_tpu.ops.paged_attention import (
     paged_attention_xla,
     paged_attention_xla_blocked,
     scatter_kv_scales,
+    scatter_kv_scales_flat,
 )
 from llmd_tpu.ops.paged_attention import write_kv_pages as write_kv_pages_xla
 from llmd_tpu.ops.kv_write import (
     write_kv_pages_decode,
     write_kv_pages_decode_full,
+    write_kv_pages_flat_full,
 )
 from llmd_tpu.ops.ragged_paged_attention import (
     decode_paged_attention,
     decode_paged_attention_full,
+    flat_paged_attention_full,
 )
 
 _TPU_PLATFORMS = {"tpu", "axon"}
@@ -300,6 +303,138 @@ def write_kv_pages_full(
     sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
     sl = write_kv_pages_xla(sl, k, v, page_table, positions, valid)
     return jax.lax.dynamic_update_index_in_dim(kv_cache_full, sl, layer, 0)
+
+
+def write_kv_pages_full_flat(
+    kv_cache_full, layer, k, v, page_table, rows, positions, valid, runs,
+    world_size=1, mesh=None,
+):
+    """Flattened-token (``cu_q_lens``) layer-indexed KV write: k/v arrive
+    as the packed ``[T, 1, K, D]`` token stream, ``page_table`` stays the
+    COMPACT per-row table indexed through ``rows`` ([T] token -> row),
+    and the TPU path lands the stream via run-addressed page
+    read-modify-writes (``runs`` = (src, off, cnt) + this pool's phys —
+    same-page-safe where the per-token decode kernel's pipeline is not).
+    XLA fallback: gather the per-token table rows, then the plain
+    scatter (distinct (page, slot) targets per live token).
+    """
+    kv_cache_full, kv_scales = _split_cache(kv_cache_full)
+    if kv_scales is not None:
+        from llmd_tpu.ops.quant_kv import quantize_kv_rows
+
+        k8, v8, srow = quantize_kv_rows(k, v)
+        data = write_kv_pages_full_flat(
+            kv_cache_full, layer, k8, v8, page_table, rows, positions,
+            valid, runs, world_size=world_size, mesh=mesh,
+        )
+        ssl = jax.lax.dynamic_index_in_dim(kv_scales, layer, 0, keepdims=False)
+        # Per-token enumerated scatter: the decode-path dense-slab form
+        # assumes one token per page, which the flattened stream breaks
+        # (a prefill chunk's tokens share pages).
+        ssl = scatter_kv_scales_flat(
+            ssl, srow, page_table, rows, positions, valid
+        )
+        return (data, jax.lax.dynamic_update_index_in_dim(kv_scales, ssl, layer, 0))
+    B, Q, K, D = k.shape
+    L, num_pages, Kc, page, D2 = kv_cache_full.shape
+    plan = _plan_write(Q, page, D, D2, world_size, mesh)
+    if plan != "xla" and runs is not None:
+        src, off, cnt, phys = runs
+        kv_new = jnp.concatenate([k, v], axis=-1).reshape(B, K, 2 * D)
+        if plan == "direct":
+            return write_kv_pages_flat_full(
+                kv_cache_full, kv_new, layer, src, phys, off, cnt,
+                interpret=_interpret(),
+            )
+        tp_k = _kv_head_axis(K, mesh.shape["tp"])
+        cache_spec = P(None, None, tp_k, None, None)
+        interpret = _interpret()
+
+        def local(cache, kv_new, layer, src, phys, off, cnt):
+            return write_kv_pages_flat_full(
+                cache, kv_new, layer, src, phys, off, cnt,
+                interpret=interpret,
+            )
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                cache_spec, P(None, tp_k, None), P(), P(), P(), P(), P(),
+            ),
+            out_specs=cache_spec,
+            check_vma=False,
+        )(kv_cache_full, kv_new, layer, src, phys, off, cnt)
+    pt_tok = page_table[rows]  # [T, max_pages]
+    sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
+    sl = write_kv_pages_xla(sl, k, v, pt_tok, positions, valid)
+    return jax.lax.dynamic_update_index_in_dim(kv_cache_full, sl, layer, 0)
+
+
+def paged_attention_full_flat(
+    q, kv_cache_full, layer, rows, page_table, kv_lens, positions,
+    sm_scale=None, world_size=1, mesh=None, window=None, sinks=None,
+):
+    """Flattened-token (``cu_q_lens``) layer-indexed attention: q is the
+    packed ``[T, 1, H, D]`` stream, ``kv_lens`` is per TOKEN (position +
+    1 — causality within a row derived from the packing), and the TPU
+    kernel iterates tokens against the compact per-row table through
+    its row-lookup prologue. XLA fallback gathers per-token table rows
+    and reuses the bucketed reference path."""
+    kv_cache_full, kv_scales = _split_cache(kv_cache_full)
+    L, num_pages, K, page, D2 = kv_cache_full.shape
+    T, Q, H, D = q.shape
+    plan = _plan(Q, page, D, D2, world_size, True, mesh, T, H, K)
+    if window is not None:
+        window = jnp.asarray(window, jnp.int32)
+    if plan == "direct":
+        return flat_paged_attention_full(
+            q, kv_cache_full, layer, rows, page_table, kv_lens,
+            sm_scale=sm_scale, interpret=_interpret(), window=window,
+            sinks=sinks, scales=kv_scales,
+        )
+    if plan == "shard":
+        tp_k = _kv_head_axis(K, mesh.shape["tp"])
+        interpret = _interpret()
+        win = jnp.zeros((), jnp.int32) if window is None else window
+        use_win = window is not None
+        sk = jnp.zeros((H,), jnp.float32) if sinks is None else sinks
+        use_sinks = sinks is not None
+        scale_spec = (
+            (P(None, None, tp_k, None, None),) if kv_scales is not None else ()
+        )
+        scale_arg = (kv_scales,) if kv_scales is not None else ()
+
+        def local(q, cache, layer, rows, pt, kl, win, sk, *sc):
+            return flat_paged_attention_full(
+                q, cache, layer, rows, pt, kl, sm_scale=sm_scale,
+                interpret=interpret, window=win if use_win else None,
+                sinks=sk if use_sinks else None,
+                scales=sc[0] if sc else None,
+            )
+
+        # The compact table stays REPLICATED: any token shard may
+        # reference any row; tokens (q/rows/kv_lens) split over dp.
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(
+                P("dp", None, "tp", None), P(None, None, tp_k, None, None),
+                P(), P("dp"), P(None, None), P("dp"), P(), P("tp"),
+                *scale_spec,
+            ),
+            out_specs=P("dp", None, "tp", None),
+            check_vma=False,
+        )(q, kv_cache_full, layer, rows, page_table, kv_lens, win, sk,
+          *scale_arg)
+    pt_tok = page_table[rows]  # [T, max_pages]
+    sl = jax.lax.dynamic_index_in_dim(kv_cache_full, layer, 0, keepdims=False)
+    ssl = (
+        None if kv_scales is None
+        else jax.lax.dynamic_index_in_dim(kv_scales, layer, 0, keepdims=False)
+    )
+    return _attention_xla(
+        q, sl, pt_tok, kv_lens, positions, sm_scale, window=window,
+        sinks=sinks, scales=ssl,
+    )
 
 
 def paged_attention(

@@ -91,6 +91,152 @@ def _write_kernel(
         store.wait()
 
 
+def _flat_write_kernel(
+    # scalar prefetch
+    layer_ref,  # [1] i32 layer index (full-cache variant; [0] otherwise)
+    src_ref,    # [R] i32 slab row of the run's first token (pre-shifted:
+                #     src = page + t0 - off, so slab row off+j = token t0+j)
+    phys_ref,   # [R] i32 physical page per run
+    off_ref,    # [R] i32 first in-page slot per run
+    cnt_ref,    # [R] i32 token count per run (0 = pad run, fully skipped)
+    # blocks
+    kv_new_ref,  # [K, Tp, 2D] ANY (whole step's token slab, page-padded)
+    kv_hbm_ref,  # [(L,) num_pages, K, page, 2D] ANY (aliased into out)
+    out_ref,     # same buffer as kv_hbm_ref
+    # scratch
+    page_buf,   # [2, K, page, 2D] VMEM double buffer (the target pages)
+    slab_buf,   # [2, K, page, 2D] VMEM double buffer (the token slabs)
+    sem_page,   # [2] DMA
+    sem_slab,   # [2] DMA
+    sem_out,    # scalar DMA
+):
+    """Flattened-token KV write, one RUN per grid step: a run is a
+    maximal span of consecutive stream tokens landing in one physical
+    page, so runs target DISTINCT pages by construction (the allocator
+    never shares a page across sequences, and within a row the run
+    covers every token the page receives) — which is what keeps the
+    cross-step software pipeline's prefetch safe where the per-token
+    decode kernel's same-page read-modify-writes would race it. The
+    token slab arrives page-padded and pre-shifted ([K, T + 2*page,
+    2D], run slab start = page + t0 - off), so the fixed-size slab DMA
+    lands token t0+j exactly at page row off+j with no in-kernel
+    gather."""
+    r = pl.program_id(0)
+    R = pl.num_programs(0)
+    page = page_buf.shape[2]
+    is_full = len(kv_hbm_ref.shape) == 5
+    src = kv_hbm_ref.at[layer_ref[0]] if is_full else kv_hbm_ref
+    dst = out_ref.at[layer_ref[0]] if is_full else out_ref
+
+    def load(i):
+        slot_i = jax.lax.rem(i, 2)
+        return (
+            pltpu.make_async_copy(
+                src.at[phys_ref[i]], page_buf.at[slot_i], sem_page.at[slot_i]
+            ),
+            pltpu.make_async_copy(
+                kv_new_ref.at[:, pl.ds(src_ref[i], page), :],
+                slab_buf.at[slot_i],
+                sem_slab.at[slot_i],
+            ),
+        )
+
+    @pl.when((r == 0) & (cnt_ref[0] != 0))
+    def _warmup():
+        for c in load(0):
+            c.start()
+
+    @pl.when((r + 1 < R) & (cnt_ref[jnp.minimum(r + 1, R - 1)] != 0))
+    def _prefetch():
+        for c in load(r + 1):
+            c.start()
+
+    slot = jax.lax.rem(r, 2)
+
+    @pl.when(cnt_ref[r] != 0)
+    def _write():
+        for c in load(r):
+            c.wait()
+        buf = page_buf.at[slot]
+        rows = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1)
+        hit = (rows >= off_ref[r]) & (rows < off_ref[r] + cnt_ref[r])
+        buf[:] = jnp.where(hit, slab_buf[slot], buf[:])
+        store = pltpu.make_async_copy(buf, dst.at[phys_ref[r]], sem_out)
+        store.start()
+        store.wait()
+
+
+def _flat_write_call(kv_cache, kv_new_t, layer, src, phys, offset, cnt, interpret):
+    K = kv_new_t.shape[0]
+    page, D2 = kv_cache.shape[-2], kv_cache.shape[-1]
+    R = src.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, K, page, D2), kv_cache.dtype),
+            pltpu.VMEM((2, K, page, D2), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = pl.pallas_call(
+        _flat_write_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        # operand index counts scalar-prefetch args first: 5 scalars,
+        # kv_new_t, then kv_cache at index 6 -> aliased to output 0.
+        input_output_aliases={6: 0},
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+    return kernel(
+        layer.astype(jnp.int32).reshape(1),
+        src.astype(jnp.int32),
+        phys.astype(jnp.int32),
+        offset.astype(jnp.int32),
+        cnt.astype(jnp.int32),
+        kv_new_t,
+        kv_cache,
+    )
+
+
+def write_kv_pages_flat_full(
+    kv_cache: jax.Array,  # [L, num_pages, K, page, 2D] (whole model)
+    kv_new: jax.Array,    # [T, K, 2D] packed token stream (K|V halves)
+    layer: jax.Array,     # scalar i32
+    src: jax.Array,       # [R] i32 slab start row (page + t0 - off)
+    phys: jax.Array,      # [R] i32 physical page per run
+    offset: jax.Array,    # [R] i32 first in-page slot per run
+    cnt: jax.Array,       # [R] i32 token count per run (0 = pad)
+    interpret: bool = False,
+) -> jax.Array:
+    """Layer-indexed flattened-token write: the whole step's packed token
+    stream lands through run-addressed page read-modify-writes (see
+    ``_flat_write_kernel``). The caller owns donation of the full cache
+    (called under the engine's jitted flat step program)."""
+    T, K, D2 = kv_new.shape
+    L, num_pages, Kc, page, D2c = kv_cache.shape
+    assert (K, D2) == (Kc, D2c), (kv_new.shape, kv_cache.shape)
+    # Head-major slab, padded one page on both ends so every pre-shifted
+    # run slice (src in [1, page + T]) stays in range.
+    kv_new_t = jnp.pad(
+        kv_new.transpose(1, 0, 2).astype(kv_cache.dtype),
+        ((0, 0), (page, page), (0, 0)),
+    )
+    return _flat_write_call(
+        kv_cache, kv_new_t, layer, src, phys, offset, cnt, interpret
+    )
+
+
 def _write_call(kv_cache, kv_new4, layer, phys, offset, valid, interpret):
     T, K = kv_new4.shape[0], kv_new4.shape[1]
     page, D2 = kv_cache.shape[-2], kv_cache.shape[-1]

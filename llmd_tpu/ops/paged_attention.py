@@ -101,6 +101,30 @@ def scatter_kv_scales(
     return scales.at[phys_f].set(slabs, mode="drop")
 
 
+def scatter_kv_scales_flat(
+    scales: jax.Array,  # [num_pages, K, page, 2] f32 (one layer)
+    srow: jax.Array,  # [T, 1, K, 2] per-token K/V-half scales
+    page_table: jax.Array,  # [R, max_pages] COMPACT per-row table
+    rows: jax.Array,  # [T] i32 token -> row
+    positions: jax.Array,  # [T, 1]
+    valid: jax.Array,  # [T, 1] bool
+) -> jax.Array:
+    """Flattened-token scale scatter: one enumerated (page, slot) write
+    per live token. The decode path's dense-slab form is WRONG here —
+    it assumes one token per page, and a gathered-slab update with
+    duplicate page indices drops all but one of a prefill chunk's
+    same-page tokens — while the enumerated targets are distinct by
+    construction (distinct (page, slot) per live token)."""
+    num_pages, K, page, two = scales.shape
+    T = rows.shape[0]
+    pos = positions[:, 0]
+    phys = page_table[rows, pos // page]
+    phys = jnp.where(valid[:, 0], phys, num_pages)  # OOB => dropped
+    return scales.at[phys, :, pos % page, :].set(
+        srow.reshape(T, K, 2).astype(scales.dtype), mode="drop"
+    )
+
+
 def _dequant_gathered(kv, scales, page_idx, D, dtype=jnp.bfloat16):
     """Gathered int8 pages [B, n, K, page, 2D] + one layer's scale pool
     [P, K, page, 2] with the same page indices [B, n] -> k, v

@@ -33,15 +33,9 @@ NEG_INF = -2.0**30
 def _decode_kernel(
     # scalar prefetch
     layer_ref,  # [1] i32 layer index (full-cache variant; [0] otherwise)
-    page_table_ref,  # [B, max_pages] i32
-    kv_lens_ref,  # [B] i32
-    win_starts_ref,  # [B] i32 first attended position (sliding window; 0=full)
-    # blocks: q_ref, sinks_ref, kv_hbm_full_ref, [ks_ref, vs_ref when
-    # quant: [1, K, S_max] f32 per-row scales, gathered into lane-aligned
-    # form by XLA in _decode_call — Mosaic manual DMA requires a
-    # 128-aligned minor dim, which a page's [K, page, 2] scale slab (2
-    # lanes) can never satisfy, so the scales cannot ride per-page DMAs
-    # like the data], out_ref — see _decode_call
+    # [rows_ref [T] i32 when row_lookup: the flattened-token layout's
+    # token -> page-table-row map — the row-lookup prologue that lets
+    # the grid iterate TOKENS against a compact [R, max_pages] table]
     *refs,
     page_size: int,
     head_dim: int,
@@ -49,13 +43,32 @@ def _decode_kernel(
     pages_per_block: int,
     has_sinks: bool,
     quant: bool,
+    row_lookup: bool = False,
 ):
+    # remaining scalar prefetch:
+    #   page_table_ref  [B|R, max_pages] i32
+    #   kv_lens_ref     [B] i32 (per token when row_lookup: position + 1,
+    #                   the causal mask derived from cu_q_lens)
+    #   win_starts_ref  [B] i32 first attended position (sliding; 0=full)
+    # blocks: q_ref, sinks_ref, kv_hbm_full_ref, [ks_ref, vs_ref when
+    # quant: [1, K, S_max] f16 per-row scales, gathered into lane-aligned
+    # form by XLA in _decode_call — Mosaic manual DMA requires a
+    # 128-aligned minor dim, which a page's [K, page, 2] scale slab (2
+    # lanes) can never satisfy, so the scales cannot ride per-page DMAs
+    # like the data], out_ref — see _decode_call
+    if row_lookup:
+        rows_ref, *refs = refs
+    page_table_ref, kv_lens_ref, win_starts_ref, *refs = refs
     if quant:
         (q_ref, sinks_ref, kv_hbm_full_ref, ks_ref, vs_ref, out_ref,
          m_ref, l_ref, acc_ref) = refs
     else:
         q_ref, sinks_ref, kv_hbm_full_ref, out_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
+    # Row-lookup prologue: program b handles TOKEN b; its pages live in
+    # the compact table's row rows_ref[b]. kv_lens/win_starts stay
+    # per-program (per token).
+    tr = rows_ref[b] if row_lookup else b
     kv_hbm_ref = (
         kv_hbm_full_ref.at[layer_ref[0]]
         if len(kv_hbm_full_ref.shape) == 5
@@ -83,7 +96,7 @@ def _decode_kernel(
         # sliding window — are never fetched.
         def _dma(slot, i, j):
             return pltpu.make_async_copy(
-                kv_hbm_ref.at[page_table_ref[b, i * ppb + j]],
+                kv_hbm_ref.at[page_table_ref[tr, i * ppb + j]],
                 buf.at[slot, :, pl.ds(j * page_size, page_size), :],
                 sem.at[slot, j],
             )
@@ -124,8 +137,12 @@ def _decode_kernel(
             q = q_ref[0]  # [K, G, D]
             ks = vs = None
             if quant:
-                ks = ks_ref[0, :, pl.ds(i * S, S)]  # [K, S] f32
-                vs = vs_ref[0, :, pl.ds(i * S, S)]
+                # Scales ride as f16 (they live on the f16 grid — see
+                # pool_scales_to_wire) and upcast here: HALF the
+                # per-block scale-plane bytes of the old f32 relayout,
+                # bit-identical math (f16 -> f32 widening is exact).
+                ks = ks_ref[0, :, pl.ds(i * S, S)].astype(jnp.float32)
+                vs = vs_ref[0, :, pl.ds(i * S, S)].astype(jnp.float32)
                 k = k.astype(q.dtype)  # i8 -> exact in bf16/f32
             # Unfetched positions (tail past kv_len, or pages before the
             # window) hold uninitialized VMEM; zero them so a stray NaN
@@ -253,9 +270,13 @@ def _decode_call(
             if scales.ndim == 5 else scales
         )  # [P, K, page, 2]
         mp = page_table.shape[1]
-        g = sl[page_table]  # [B, mp, K, page, 2]
+        # Cast BEFORE the gather: pool scales are f32 values ON the f16
+        # grid (quant_kv layout contract), so the f16 gather+relayout
+        # moves half the bytes of the old f32 form losslessly — this
+        # plane scales with max_pages, not the live context, which made
+        # it the widest int8-only HBM stream in the decode step.
+        g = sl.astype(jnp.float16)[page_table]  # [B, mp, K, page, 2]
         ksvs = g.transpose(0, 2, 4, 1, 3).reshape(B, K, 2, mp * page)
-        ksvs = ksvs.astype(jnp.float32)
         sspec = pl.BlockSpec(
             (1, K, mp * page), lambda b, l, pt, kl, ws: (b, 0, 0)
         )
@@ -318,6 +339,125 @@ def decode_paged_attention(
         sm_scale, interpret, pages_per_block, window=window, sinks=sinks,
         scales=scales,
     )
+
+
+def flat_paged_attention_full(
+    q: jax.Array,  # [T, 1, H, D] packed token-query stream
+    kv_cache: jax.Array,  # [L, num_pages, K, page, 2D] (whole model)
+    layer: jax.Array,  # scalar i32
+    rows: jax.Array,  # [T] i32 token -> page-table row (cu_q_lens lookup)
+    page_table: jax.Array,  # [R, max_pages] COMPACT per-row table
+    kv_lens: jax.Array,  # [T] i32 per-token: position + 1 (causal-in-row)
+    sm_scale: float | None = None,
+    interpret: bool = False,
+    pages_per_block: int = 16,
+    window: jax.Array | None = None,
+    sinks: jax.Array | None = None,
+    scales: jax.Array | None = None,  # [L, num_pages, K, page, 2]
+) -> jax.Array:
+    """Flattened-token (``cu_q_lens``) attention: the grid iterates the
+    packed TOKEN stream — program t streams exactly the pages token t's
+    row holds up to its own position (kv_len = pos + 1 IS the causal
+    mask within the row) — against the compact per-row table through a
+    scalar-prefetched row-lookup prologue, so no [T, max_pages]
+    per-token table is ever materialized for the data DMAs. Pure decode
+    rows cost ONE program; prefill-chunk tokens each stream their live
+    prefix (write-before-read per layer makes same-step earlier tokens'
+    fresh KV visible)."""
+    T, Q, H, D = q.shape
+    assert Q == 1, "flat attention takes the packed [T, 1, H, D] stream"
+    K, page, D2 = kv_cache.shape[-3], kv_cache.shape[-2], kv_cache.shape[-1]
+    assert D2 == 2 * D
+    G = H // K
+    if sm_scale is None:
+        sm_scale = D**-0.5
+    max_pages = page_table.shape[1]
+    if max_pages % pages_per_block:
+        pad = pages_per_block - max_pages % pages_per_block
+        page_table = jnp.pad(page_table, ((0, 0), (0, pad)))
+
+    qk = q.reshape(T, K, G, D)
+    if window is None:
+        win_starts = jnp.zeros_like(kv_lens)
+    else:
+        window = jnp.asarray(window, jnp.int32)
+        win_starts = jnp.where(
+            window > 0, jnp.maximum(kv_lens - window, 0), 0
+        ).astype(jnp.int32)
+    if sinks is None:
+        sinks2d = jnp.zeros((K, G), jnp.float32)
+    else:
+        sinks2d = sinks.astype(jnp.float32).reshape(K, G)
+
+    # 5 scalar prefetch args: layer, rows, page_table, kv_lens, win_starts.
+    in_specs = [
+        pl.BlockSpec((1, K, G, D), lambda b, l, r, pt, kl, ws: (b, 0, 0, 0)),
+        pl.BlockSpec((K, G), lambda b, l, r, pt, kl, ws: (0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; manual DMA
+    ]
+    operands = [qk, sinks2d, kv_cache]
+    if scales is not None:
+        # Per-ROW scale plane (scales cannot ride the page DMAs — see
+        # _decode_call): gathered ONCE per row ([R, K, mp*page], f16 on
+        # the wire, upcast in-kernel — lossless, half the bytes) and
+        # indexed through the scalar-prefetched row map in the
+        # BlockSpec, so a prefill chunk's tokens share one plane
+        # instead of duplicating it chunk-length times into a
+        # [T, max_pages, ...] intermediate.
+        lidx = jnp.asarray(layer, jnp.int32).reshape(-1)[0]
+        sl = (
+            jax.lax.dynamic_index_in_dim(scales, lidx, 0, keepdims=False)
+            if scales.ndim == 5 else scales
+        )
+        mp = page_table.shape[1]
+        R = page_table.shape[0]
+        g = sl.astype(jnp.float16)[page_table]  # [R, mp, K, page, 2]
+        ksvs = g.transpose(0, 2, 4, 1, 3).reshape(R, K, 2, mp * page)
+        sspec = pl.BlockSpec(
+            (1, K, mp * page), lambda b, l, r, pt, kl, ws: (r[b], 0, 0)
+        )
+        in_specs.extend([sspec, sspec])
+        operands.extend([ksvs[:, :, 0], ksvs[:, :, 1]])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(T,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, K, G, D), lambda b, l, r, pt, kl, ws: (b, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((K, G, 128), jnp.float32),
+            pltpu.VMEM((K, G, 128), jnp.float32),
+            pltpu.VMEM((K, G, D), jnp.float32),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            page_size=page,
+            head_dim=D,
+            sm_scale=sm_scale,
+            pages_per_block=pages_per_block,
+            has_sinks=sinks is not None,
+            quant=scales is not None,
+            row_lookup=True,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, K, G, D), q.dtype),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )
+    out = kernel(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        rows.astype(jnp.int32),
+        page_table,
+        kv_lens,
+        win_starts,
+        *operands,
+    )
+    return out.reshape(T, 1, H, D)
 
 
 def decode_paged_attention_full(

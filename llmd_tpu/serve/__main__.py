@@ -107,6 +107,7 @@ def make_engine_config(args, lora_adapters=None):
             spec_ngram_min_match=args.spec_ngram_min_match,
             spec_verify_window=args.spec_verify_window,
             unified_step=args.unified_step,
+            ragged_qlens=args.ragged_qlens,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -225,6 +226,17 @@ def build_parser() -> argparse.ArgumentParser:
              "program with one coalesced readback; --no-unified-step "
              "restores the split per-family dispatch paths. Streams are "
              "byte-identical either way for greedy and seeded sampling "
+             "(docs/architecture/async-scheduling.md)",
+    )
+    p.add_argument(
+        "--ragged-qlens", action=argparse.BooleanOptionalAction, default=True,
+        help="genuinely ragged flattened-token unified step (cu_q_lens): "
+             "the window=1 step runs over the packed token stream — a "
+             "decode row costs 1 token, a verify row 1 + its own draft "
+             "length (per-row adaptive verify depth) — instead of "
+             "padding every row to the bucketed [B, Q] sub-row width; "
+             "--no-ragged-qlens restores the bucketed unified program. "
+             "Greedy and seeded streams are byte-identical either way "
              "(docs/architecture/async-scheduling.md)",
     )
     p.add_argument("--tensor-parallel-size", type=int, default=1)

@@ -75,6 +75,12 @@ def render_metrics(
         # unified step's dispatches-per-step headline.
         "unified_steps_total": stats.unified_steps_total,
         "step_dispatches_total": stats.step_dispatches_total,
+        # Padding efficiency (flattened-token step, --ragged-qlens):
+        # tokens the dispatched programs computed for real vs the pad
+        # lanes the traced shapes paid on top; padded/live is the
+        # padding-waste gauge the ragged_step bench part bounds.
+        "live_tokens_total": stats.live_tokens_total,
+        "padded_tokens_total": stats.padded_tokens_total,
     }
     if stats.swa_ring_pages:
         # Hybrid-APC section retention activity
@@ -146,6 +152,31 @@ def render_metrics(
         )
         lines.append(
             f'llmd:spec_accepted_len_count{{model_name="{model_name}"}} {cum}'
+        )
+    if stats.spec_row_depth_hist:
+        # Per-row verify depth histogram (--ragged-qlens adaptive depth:
+        # bucket d counts decode rows dispatched at a 1 + draft width of
+        # exactly d tokens; two buckets populated on one step means two
+        # rows ran DIFFERENT verify depths in the same program).
+        hist = stats.spec_row_depth_hist
+        lines.append("# TYPE llmd:spec_row_depth histogram")
+        cum = 0
+        for d, cnt in enumerate(hist):
+            cum += cnt
+            lines.append(
+                f'llmd:spec_row_depth_bucket{{le="{d}",'
+                f'model_name="{model_name}"}} {cum}'
+            )
+        lines.append(
+            f'llmd:spec_row_depth_bucket{{le="+Inf",'
+            f'model_name="{model_name}"}} {cum}'
+        )
+        total = sum(d * c for d, c in enumerate(hist))
+        lines.append(
+            f'llmd:spec_row_depth_sum{{model_name="{model_name}"}} {total}'
+        )
+        lines.append(
+            f'llmd:spec_row_depth_count{{model_name="{model_name}"}} {cum}'
         )
     for family in ("vllm", "llmd"):
         for name, v in gauges.items():

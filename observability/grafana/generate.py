@@ -256,6 +256,18 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
                    "unified steps/s at zero means mixed traffic is "
                    "paying the split engine's two-to-three dispatches "
                    "(plus one lockstep broadcast each on multi-host)."),
+        panel("Padding efficiency (ragged qlens)",
+              [f"rate(llmd:padded_tokens_total{M}[5m]) / "
+               f"rate(llmd:live_tokens_total{M}[5m])",
+               f"rate(llmd:live_tokens_total{M}[5m])"],
+              legends=["padded/live token ratio", "live tokens/s"],
+              desc="Pad lanes the traced shapes paid per live token. "
+                   "The flattened-token step (--ragged-qlens) charges a "
+                   "decode row ONE stream token instead of a bucketed "
+                   "[B, Q] sub-row, bounding per-step waste at the "
+                   "16-token T-granule; a high ratio with ragged on "
+                   "means steps are too small for their granule, with "
+                   "ragged off it is the bucketed sub-row padding."),
         row("Speculative decoding"),
         panel("Draft acceptance", [f"llmd:spec_acceptance_rate{M}"],
               unit="percentunit", max1=True,
@@ -281,6 +293,15 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
                    "rows that hit their emission limit early. Zero "
                    "iterations with the window on = every step degraded "
                    "to plain decode (drafts never fire)."),
+        panel("Mean per-row verify depth",
+              [f"rate(llmd:spec_row_depth_sum{M}[5m]) / "
+               f"rate(llmd:spec_row_depth_count{M}[5m])"],
+              desc="Mean 1 + draft width rows were dispatched at (from "
+                   "the llmd:spec_row_depth histogram). With "
+                   "--ragged-qlens each row pays exactly its own depth "
+                   "in the flattened stream — hot-draft rows run deep "
+                   "while backed-off rows run depth 1 in the SAME "
+                   "program; stuck at 1 = drafting never engages."),
         row("Health"),
         panel("Preemptions /s", [f"rate(vllm:num_preemptions_total{M}[5m])"],
               thresholds=[(None, "green"), (0.5, "yellow"), (2, "red")],

@@ -29,6 +29,7 @@ from llmd_tpu.engine.spec import NgramProposer
 def make_engine(
     spec=False, async_mode=False, num_blocks=64, page=4, max_batched=64,
     max_seqs=8, seed=0, k=4, min_match=2, prefix_caching=True, window=1,
+    ragged=True,
     **model_kw,
 ) -> LLMEngine:
     cfg = EngineConfig(
@@ -41,7 +42,7 @@ def make_engine(
             max_num_seqs=max_seqs, max_num_batched_tokens=max_batched,
             async_scheduling=async_mode, speculative_ngram=spec,
             spec_ngram_k=k, spec_ngram_min_match=min_match,
-            decode_window=window,
+            decode_window=window, ragged_qlens=ragged,
         ),
         parallel=ParallelConfig(tensor_parallel_size=1),
         seed=seed,
@@ -581,7 +582,11 @@ def test_async_mixed_step_reuses_staged_arrays():
     base = make_engine(False, num_blocks=96).generate(
         [list(p) for p in prompts], sp
     )
-    eng = make_engine(True, async_mode=True, num_blocks=96)
+    # The flattened-token step (ragged_qlens, default) supersedes the
+    # verify/decode SPLIT on mixed spec steps — one flat dispatch, no
+    # subset slicing. The slicing path this test pins is the bucketed
+    # fallback's, so pin it there explicitly.
+    eng = make_engine(True, async_mode=True, num_blocks=96, ragged=False)
     try:
         ModelRunner._subset_staged_verify = count_v
         ModelRunner._subset_staged_decode = count_d

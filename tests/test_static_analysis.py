@@ -488,6 +488,26 @@ class TestLockstep:
             for f in findings
         )
 
+    def test_real_runner_missing_flat_arm_fails(self, tmp_path):
+        """Acceptance pin for the flattened-token step's opcode: with
+        --ragged-qlens on (the default) EVERY window=1 step rides
+        _OP_FLAT, so deleting its follower arm from the REAL runner must
+        fail the build — a follower without the arm desynchronizes the
+        lockstep collective stream on the first step."""
+        src = RUNNER.read_text()
+        arm = (
+            "            elif op == _OP_FLAT:\n"
+            "                self._exec_flat(arrays, bool(greedy))\n"
+        )
+        assert arm in src, "follower_loop layout changed; update this pin"
+        mutated = src.replace(arm, "")
+        (tmp_path / "engine").mkdir(parents=True)
+        (tmp_path / "engine/runner.py").write_text(mutated)
+        findings, _ = run_analysis(tmp_path, [str(tmp_path)], ["lockstep"])
+        assert any(
+            f.code == "LS001" and "_OP_FLAT" in f.message for f in findings
+        )
+
     def test_real_runner_is_clean(self):
         findings, _ = run_analysis(REPO, [str(RUNNER)], ["lockstep"])
         assert findings == []
