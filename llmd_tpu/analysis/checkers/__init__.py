@@ -3,6 +3,7 @@
 from llmd_tpu.analysis.checkers import (  # noqa: F401
     config_parity,
     envvars,
+    faults_discipline,
     host_sync,
     lockstep,
     metrics_parity,

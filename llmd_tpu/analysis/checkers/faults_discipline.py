@@ -1,0 +1,141 @@
+"""broad-except (faults discipline): no silent failure swallows on the
+serving stack.
+
+PR 7's fault matrix only proves the degradations it knows about; the
+degradations it can NEVER know about are the ones a broad ``except``
+invents ad hoc — catch everything, log (or not), carry on. Inside the
+serving-stack packages (``serve/``, ``engine/``, ``kvtransfer/``,
+``epp/``, ``kvstore/``) every handler broader than a named-exception
+tuple (bare ``except``, ``except Exception``, ``except BaseException``,
+or a tuple containing either) must do one of:
+
+- **re-raise** — the handler contains a ``raise`` (cleanup-then-
+  propagate is not a swallow);
+- **leave a metric trail** — the enclosing function assigns/increments
+  a failure-ish counter (a target whose dotted/subscript path contains
+  ``fail``/``failure``/``fallback``/``error``/``drop`` — the
+  ``*_failures_total`` family and its raw-field forms), so the SLO
+  layer can see the degradation happening;
+- **carry a pragma** — ``# llmd: allow(broad-except) -- <reason>`` on
+  the handler line (or the line above), for the genuinely-benign
+  best-effort paths (``__del__``, log-only observer hooks), with the
+  reason recorded.
+
+Rule: FD001.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+
+# Package directories on the serving path (matched against path parts,
+# so fixtures under tmp trees participate the same way).
+SCOPE_PARTS = frozenset({"serve", "engine", "kvtransfer", "epp", "kvstore"})
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+_FAILURE_RE = re.compile(r"(fail|failure|fallback|error|drop)", re.I)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD_NAMES:
+            return True
+    return False
+
+
+def _target_path(node: ast.expr) -> str:
+    """Flatten an assignment target into a dotted string for matching:
+    ``self.transfer_failures[("a", "b")]`` -> ``self.transfer_failures``."""
+    parts: list[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            break
+        else:
+            break
+    return ".".join(reversed(parts))
+
+
+def _has_failure_counter(fn: ast.AST) -> bool:
+    """Does this function body assign/increment a failure-ish counter?"""
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        for t in targets:
+            if _FAILURE_RE.search(_target_path(t)):
+                return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf) -> None:
+        self.sf = sf
+        self.fn_stack: list[ast.AST] = []
+        self.findings: list[Finding] = []
+
+    def _visit_fn(self, node) -> None:
+        self.fn_stack.append(node)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if _is_broad(node) and not _reraises(node):
+            fn = self.fn_stack[-1] if self.fn_stack else None
+            if fn is None or not _has_failure_counter(fn):
+                what = (
+                    "bare except" if node.type is None else
+                    "except broader than a named-exception tuple"
+                )
+                self.findings.append(Finding(
+                    "broad-except", "FD001", self.sf.path, node.lineno,
+                    f"{what} swallows failures invisibly on the serving "
+                    "stack: re-raise, increment a *_failures_total-style "
+                    "counter in this function, or pragma "
+                    "`# llmd: allow(broad-except) -- <reason>`",
+                ))
+        self.generic_visit(node)
+
+
+@register
+class BroadExceptChecker(Checker):
+    name = "broad-except"
+    description = (
+        "broad excepts in serve//engine//kvtransfer//epp//kvstore/ must "
+        "re-raise, leave a failure-counter trail, or carry a pragma"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in repo.files:
+            if not sf.is_python or sf.tree is None:
+                continue
+            if not SCOPE_PARTS.intersection(Path(sf.path).parts):
+                continue
+            v = _Visitor(sf)
+            v.visit(sf.tree)
+            findings.extend(v.findings)
+        return findings

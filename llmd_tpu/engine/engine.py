@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from llmd_tpu import faults
 from llmd_tpu.config import EngineConfig, swa_ring_spec
 from llmd_tpu.engine.kv_cache import KVEventSink, PageAllocator
 from llmd_tpu.engine.request import (
@@ -258,6 +259,16 @@ class EngineStats:
     # DIFFERENT buckets on one step is the per-row adaptive depth the
     # flattened step dispatches in one program.
     spec_row_depth_hist: tuple = ()
+    # Robustness trail (docs/architecture/fault-tolerance.md): watchdog
+    # trips on the step loop, CRC-rejected KV bundles, transfers that
+    # degraded to local recompute, and the per-(stage, policy)
+    # transfer-failure breakdown — a failure that leaves no metric
+    # trail is invisible to the SLO layer.
+    engine_watchdog_stalls_total: int = 0
+    kv_bundle_crc_failures_total: int = 0
+    kv_recompute_fallbacks_total: int = 0
+    # ((stage, policy), count) pairs; rendered as labeled series.
+    kv_transfer_failures: tuple = ()
 
 
 @dataclass
@@ -520,6 +531,7 @@ class LLMEngine:
             if key is None or not req.swa_block_ids:
                 return
             self._swa_sections.capture(key, req.swa_block_ids, s0, n_pre)
+        # llmd: allow(broad-except) -- best-effort section retention; a capture failure only costs a future cache hit
         except Exception:
             logging.getLogger(__name__).exception(
                 "swa section capture failed (serving unaffected)"
@@ -663,6 +675,7 @@ class LLMEngine:
                 ring_ids = self.swa_allocator.allocate(self._swa.ring_pages)
                 if self._swa_sections.seed(key, ring_ids) is None:
                     raise KeyError("section evicted between has() and seed()")
+            # llmd: allow(broad-except) -- a retained-section hit must never fail the request; degrades to a plain prefill
             except Exception as e:
                 # Includes device/lockstep errors from the seed copy: a
                 # hit must never fail the request — release and prefill.
@@ -772,6 +785,11 @@ class LLMEngine:
     # ------------------------------------------------------------------ #
 
     def step(self) -> list[RequestOutput]:
+        # Injection site: a wedged device program (engine.step.stall)
+        # stalls the whole step — the AsyncEngine watchdog's job is to
+        # notice, 503 /health and terminate in-flight streams. Unarmed
+        # this is one module-global None check.
+        faults.delay("engine.step.stall")
         if self._async:
             return self._step_async()
         return self._step_sync()
@@ -1317,6 +1335,13 @@ class LLMEngine:
             self.stats.kv_imported_requests = cs["imported_requests"]
             self.stats.kv_imported_bytes = cs["imported_bytes"]
             self.stats.kv_import_failures = cs["import_failures"]
+            self.stats.kv_bundle_crc_failures_total = cs["crc_failures"]
+            self.stats.kv_recompute_fallbacks_total = cs[
+                "recompute_fallbacks"
+            ]
+            self.stats.kv_transfer_failures = tuple(
+                sorted(cs["transfer_failures"].items())
+            )
 
     # ------------------------------------------------------------------ #
 

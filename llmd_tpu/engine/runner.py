@@ -31,9 +31,12 @@ axis, donated through every step so XLA updates it in place.
 
 from __future__ import annotations
 
+import concurrent.futures
 import functools
 import logging
+import os
 import threading
+import time
 from dataclasses import dataclass
 
 import jax
@@ -82,6 +85,10 @@ _OP_UNIFIED = 10
 # verify row 1 + its own draft length. Header QK carries T_bucket
 # directly (no Q packing: the flat family has no per-row column bucket).
 _OP_FLAT = 11
+# Lockstep liveness heartbeat: broadcast by an idle leader so followers'
+# bounded header wait can distinguish "leader idle" from "leader dead".
+# No device work — followers just absorb it and keep waiting.
+_OP_HEARTBEAT = 12
 
 # Row kinds of the unified step's (start, qlen, kind) metadata. Only
 # verify-ness reaches the device (it selects the sample positions: verify
@@ -366,6 +373,42 @@ class ModelRunner:
         # orphaned streamed-fetch thread) would block forever in a
         # collective nobody answers — refuse loudly instead.
         self._stopped = False
+        # Lockstep liveness: every collective leg runs under a bounded
+        # wait (LLMD_LOCKSTEP_TIMEOUT_S; 0 disables) so a dead peer is a
+        # loud RuntimeError within the budget instead of an infinite
+        # hang, and an idle leader heartbeats (_OP_HEARTBEAT) so the
+        # followers' bounded header wait can tell "idle leader" from
+        # "dead leader".
+        try:
+            self.lockstep_timeout_s = float(
+                os.environ.get("LLMD_LOCKSTEP_TIMEOUT_S", "300") or 0
+            )
+        except ValueError:
+            self.lockstep_timeout_s = 300.0
+        self._lockstep_pool = None
+        self._last_broadcast = 0.0
+        # The FIRST collective round carries cold-cache jit compiles and
+        # weight-load skew across hosts (the deploy startupProbe budgets
+        # hours for it) — bounding it would declare a healthy group dead
+        # mid-startup. The wait arms after one successful collective,
+        # mirroring the serving watchdog's first-step exemption.
+        self._lockstep_warmed = False
+        # Mid-serving compile grace: the first dispatch of each
+        # (op, B, QK) shape family jit-compiles on every host, and
+        # per-host persistent-cache skew (one host hits the cache,
+        # another compiles for minutes) can legitimately exceed the
+        # liveness budget long after startup. After a first-of-family
+        # dispatch each side grants its NEXT bounded wait one unbounded
+        # pass — the peer is compiling, not dead. Both sides see every
+        # header, so the seen-sets stay in sync.
+        self._lockstep_seen_shapes: set = set()
+        self._lockstep_compile_grace = False
+        self._hb_stop = threading.Event()
+        if self._multihost and dist.is_leader() and self.lockstep_timeout_s:
+            threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="llmd-lockstep-hb",
+            ).start()
         self._np_rng = np.random.default_rng(config.seed ^ 0x5EED)
 
         if config.parallel.enable_dbo and not ops._on_tpu():
@@ -1586,6 +1629,10 @@ class ModelRunner:
         KV ops reuse the header slots: B carries the page count and QK the
         q8 flag (gather). Scatter payload geometry derives from the pool
         config both sides share."""
+        if op == _OP_HEARTBEAT:
+            # Liveness tick only; a 1-slot dummy keeps the payload leg's
+            # pytree non-empty (both sides derive the same shape).
+            return [("hb", (1,), np.int32)]
         if op == _OP_KV_GATHER:
             return [("ids", (B,), np.int32)]
         if op == _OP_KV_COPY:
@@ -1731,6 +1778,75 @@ class ModelRunner:
             spec.append(("lora", (B,), np.int32))
         return spec
 
+    def _bounded(self, fn, what: str):
+        """Run one lockstep collective leg with a bounded wait.
+
+        ``broadcast_one_to_all`` blocks until EVERY process participates;
+        a dead/wedged peer turns that into an infinite hang that no
+        watchdog above can attribute. The collective runs on a dedicated
+        single worker thread and the caller waits at most
+        ``lockstep_timeout_s`` — on expiry the group is declared dead
+        with a loud RuntimeError (the step fails fast; the serving
+        watchdog then 503s /health and terminates streams). The worker
+        thread stays parked in the dead collective, which is fine: the
+        process is about to be restarted by the platform anyway.
+
+        Startup exemption: the first collective of a process runs
+        UNBOUNDED (cold-compile/weight-load skew legitimately exceeds
+        any liveness budget; the startup probe owns that phase), and the
+        wait arms once one collective has completed."""
+        timeout = self.lockstep_timeout_s
+        if not timeout or timeout <= 0:
+            return fn()
+        if not self._lockstep_warmed:
+            out = fn()
+            self._lockstep_warmed = True
+            return out
+        if self._lockstep_compile_grace:
+            # The previous dispatch opened a new shape family: the peer
+            # may be inside a legitimately-long jit compile of it, not
+            # dead. One unbounded pass, then the bound re-arms.
+            self._lockstep_compile_grace = False
+            return fn()
+        if self._lockstep_pool is None:
+            self._lockstep_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="llmd-lockstep"
+            )
+        fut = self._lockstep_pool.submit(fn)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            self._stopped = True  # no further broadcasts into a dead group
+            raise RuntimeError(
+                f"lockstep {what} did not complete within {timeout:.0f}s: "
+                "a peer process is dead or wedged (set "
+                "LLMD_LOCKSTEP_TIMEOUT_S to tune; 0 disables)"
+            ) from None
+
+    def _heartbeat_loop(self) -> None:
+        """Leader-side liveness ticks: when no real op has been broadcast
+        for a third of the lockstep budget, send _OP_HEARTBEAT so idle
+        followers' bounded header wait keeps getting fed."""
+        period = max(self.lockstep_timeout_s / 3.0, 1.0)
+        while not self._hb_stop.wait(period / 2):
+            if self._stopped:
+                return
+            if not self._lockstep_warmed:
+                continue  # startup phase: followers wait unbounded anyway
+            if time.monotonic() - self._last_broadcast < period:
+                continue
+            try:
+                with self._dispatch_lock:
+                    if self._stopped:
+                        return
+                    self._sync(
+                        _OP_HEARTBEAT, 0, 0, False,
+                        {"hb": np.zeros(1, np.int32)},
+                    )
+            except RuntimeError:
+                log.exception("lockstep heartbeat failed; group is dead")
+                return
+
     def _sync(self, op: int, B: int, QK: int, greedy: bool, arrays: dict) -> dict:
         """Leader leg: broadcast header + payload; identity single-host."""
         if not self._multihost:
@@ -1742,15 +1858,33 @@ class ModelRunner:
             )
         from jax.experimental import multihost_utils as mhu
 
-        mhu.broadcast_one_to_all(
-            np.asarray([op, B, QK, int(greedy)], np.int32), is_source=True
-        )
         spec = self._payload_spec(op, B, QK)
-        payload = tuple(
+        staged = tuple(
             np.ascontiguousarray(arrays[name]).astype(dt, copy=False)
             for name, _, dt in spec
         )
-        payload = mhu.broadcast_one_to_all(payload, is_source=True)
+
+        def _broadcast():
+            # Injection site: a stalled collective is indistinguishable
+            # from a dead peer — exactly what the bounded wait bounds.
+            from llmd_tpu import faults as _faults
+
+            _faults.delay("lockstep.sync.stall")
+            mhu.broadcast_one_to_all(
+                np.asarray([op, B, QK, int(greedy)], np.int32),
+                is_source=True,
+            )
+            return mhu.broadcast_one_to_all(staged, is_source=True)
+
+        payload = self._bounded(_broadcast, f"broadcast of op {op}")
+        self._last_broadcast = time.monotonic()
+        if op != _OP_HEARTBEAT:
+            shape_key = (op, B, QK, bool(greedy))
+            if shape_key not in self._lockstep_seen_shapes:
+                self._lockstep_seen_shapes.add(shape_key)
+                # Followers compile this family during their exec of
+                # this dispatch; the next broadcast must not bound it.
+                self._lockstep_compile_grace = True
         return {name: arr for (name, _, _), arr in zip(spec, payload)}
 
     def follower_loop(self) -> None:
@@ -1761,17 +1895,36 @@ class ModelRunner:
         assert self._multihost and not dist.is_leader(), (
             "follower_loop is for non-leader processes of a multi-host world"
         )
+        # With the leader heartbeating every timeout/3 when idle, a
+        # header wait past the full budget means the leader is dead —
+        # the follower raises loudly instead of hanging forever. The
+        # payload leg after a header is bounded the same way (a leader
+        # dying mid-broadcast must not wedge the group).
         while True:
-            hdr = mhu.broadcast_one_to_all(
-                np.zeros(4, np.int32), is_source=False
+            hdr = self._bounded(
+                lambda: mhu.broadcast_one_to_all(
+                    np.zeros(4, np.int32), is_source=False
+                ),
+                "header wait (leader liveness)",
             )
             op, B, QK, greedy = (int(v) for v in np.asarray(hdr))
             if op == _OP_STOP:
                 return
             spec = self._payload_spec(op, B, QK)
             zeros = tuple(np.zeros(shp, dt) for _, shp, dt in spec)
-            payload = mhu.broadcast_one_to_all(zeros, is_source=False)
+            payload = self._bounded(
+                lambda: mhu.broadcast_one_to_all(zeros, is_source=False),
+                f"payload wait for op {op}",
+            )
             arrays = {name: arr for (name, _, _), arr in zip(spec, payload)}
+            if op == _OP_HEARTBEAT:
+                continue  # liveness tick only; nothing to dispatch
+            shape_key = (op, B, QK, bool(greedy))
+            if shape_key not in self._lockstep_seen_shapes:
+                self._lockstep_seen_shapes.add(shape_key)
+                # The leader compiles this family during its own exec;
+                # the next header wait must not bound that compile.
+                self._lockstep_compile_grace = True
             if op == _OP_PREFILL:
                 self._exec_prefill(arrays, bool(greedy))
             elif op == _OP_VERIFY:
@@ -1822,6 +1975,7 @@ class ModelRunner:
                 if self._stopped:
                     return
                 self._stopped = True
+                self._hb_stop.set()
                 mhu.broadcast_one_to_all(
                     np.asarray([_OP_STOP, 0, 0, 0], np.int32), is_source=True
                 )

@@ -9,8 +9,12 @@ EndpointPickerConfig JSON (or the built-in optimized-baseline / pd preset).
 from __future__ import annotations
 
 import argparse
+import asyncio
+import contextlib
 import json
 import logging
+import os
+import signal
 
 
 def main(argv=None) -> None:
@@ -198,7 +202,41 @@ def main(argv=None) -> None:
 
         app.on_startup.append(_start_extproc)
         app.on_cleanup.append(_stop_extproc)
-    web.run_app(app, host=args.host, port=args.port)
+    asyncio.run(_serve(app, args.host, args.port, router))
+
+
+async def _serve(app, host: str, port: int, router) -> None:
+    """Run the app with a two-phase graceful shutdown.
+
+    ``web.run_app`` closes the listening socket before the app's
+    cleanup_ctx teardown runs, so flipping readiness there is invisible
+    — the gateway's probe sees connection-refused, not the graceful
+    503. Here SIGTERM/SIGINT first flips readiness WHILE the socket is
+    still serving, waits ``LLMD_EPP_DRAIN_GRACE_S`` (default 5s) for
+    the probe to observe it and routing to move away, and only then
+    tears the runner down (which drains flow control and evicts)."""
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logging.getLogger("llmd.epp").info("router serving on %s:%d", host, port)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def _on_signal() -> None:
+        router.begin_shutdown()
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, _on_signal)
+    await stop.wait()
+    grace = float(os.environ.get("LLMD_EPP_DRAIN_GRACE_S", "5"))
+    if grace > 0:
+        await asyncio.sleep(grace)
+    await runner.cleanup()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,66 @@
+"""Per-endpoint request-failure circuit breaker.
+
+The scrape-health window (MetricsCollector, 3 consecutive failed scrapes
+at the poll interval) takes seconds to mark a dead endpoint unhealthy —
+seconds during which the picker keeps sending real requests into
+connection-refused. Request outcomes are a faster signal: the proxy leg
+feeds every connect-refused/5xx into this breaker, which OPENS the
+endpoint after ``failure_threshold`` consecutive failures (default 2 —
+strictly faster than the 3-scrape window even if every scrape also
+fails) and releases it after ``cooldown_s`` into a half-open probe: the
+next request may try it, one more failure re-opens it immediately (the
+consecutive count survives the cooldown), one success resets it fully.
+
+State is address-keyed and time-based only — no background task, safe
+on the router's single event loop.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class EndpointCircuitBreaker:
+    def __init__(
+        self, failure_threshold: int = 2, cooldown_s: float = 10.0
+    ) -> None:
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._consecutive: dict[str, int] = {}
+        self._open_until: dict[str, float] = {}
+        self.trips_total = 0
+
+    def record_failure(self, address: str) -> None:
+        n = self._consecutive.get(address, 0) + 1
+        self._consecutive[address] = n
+        # Open only on the closed->open TRANSITION: several in-flight
+        # requests failing against one endpoint are ONE outage — extra
+        # failures must neither inflate trips_total (an alerting
+        # signal) nor keep pushing the cooldown window out.
+        if n >= self.failure_threshold and address not in self._open_until:
+            self._open_until[address] = time.monotonic() + self.cooldown_s
+            self.trips_total += 1
+
+    def record_success(self, address: str) -> None:
+        self._consecutive.pop(address, None)
+        self._open_until.pop(address, None)
+
+    def is_open(self, address: str) -> bool:
+        until = self._open_until.get(address)
+        if until is None:
+            return False
+        if time.monotonic() >= until:
+            # Cooldown elapsed: half-open. The consecutive count is left
+            # at/above threshold, so one probe failure re-opens at once.
+            self._open_until.pop(address, None)
+            return False
+        return True
+
+    def open_endpoints(self) -> list[str]:
+        now = time.monotonic()
+        return sorted(a for a, t in self._open_until.items() if t > now)
+
+    def forget(self, address: str) -> None:
+        """Endpoint left the pool: a recycled host:port must start clean."""
+        self._consecutive.pop(address, None)
+        self._open_until.pop(address, None)

@@ -22,6 +22,7 @@ from typing import Callable
 
 import aiohttp
 
+from llmd_tpu import faults
 from llmd_tpu.epp.types import (
     BLOCK_SIZE,
     KV_CACHE_USAGE,
@@ -196,6 +197,7 @@ class FileDiscoverySource:
                     log.info("endpoints file reloaded: %d pods", len(self.store.list()))
             except FileNotFoundError:
                 pass
+            # llmd: allow(broad-except) -- discovery loop guard: the pool keeps its last-good view until the next poll
             except Exception:
                 log.exception("endpoints file reload failed")
             await asyncio.sleep(self.poll_s)
@@ -243,6 +245,10 @@ class MetricsCollector:
 
     async def _scrape(self, pod: Endpoint) -> None:
         try:
+            # Injection site: a failing scrape feeds the consecutive-
+            # failure counter exactly like an unreachable endpoint.
+            if faults.fires("epp.scrape.fail", pod.address):
+                raise RuntimeError("injected epp.scrape.fail")
             async with self._session.get(pod.url + "/metrics") as resp:
                 text = await resp.text()
                 if resp.status != 200:
@@ -263,6 +269,7 @@ class MetricsCollector:
         while True:
             try:
                 await self.scrape_once()
+            # llmd: allow(broad-except) -- scrape loop guard: per-endpoint failures feed _fail_counts in _scrape; this only catches cycle-level bugs
             except Exception:
                 log.exception("metrics scrape cycle failed")
             await asyncio.sleep(self.interval_s)

@@ -381,6 +381,7 @@ class ExtProcServer:
                     continue
                 try:
                     replies = await session.on_message(msg)
+                # llmd: allow(broad-except) -- surfaced: the stream is aborted with StatusCode.INTERNAL (context.abort raises)
                 except Exception as e:  # pipeline failure -> FailOpen/Close
                     log.exception("ext-proc pipeline error")
                     await context.abort(

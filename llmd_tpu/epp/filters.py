@@ -57,10 +57,38 @@ class EncodeFilter(Filter):
         return [p for p in pods if p.role == ROLE_ENCODE]
 
 
+# Fail-open accounting: times the healthy-filter saw a wholly-unhealthy
+# pool and passed it through anyway. Module-global because filter plugin
+# instances are config-created and the router's /metrics renderer has no
+# handle on them.
+_fail_open_total = 0
+
+
+def note_fail_open() -> None:
+    global _fail_open_total
+    _fail_open_total += 1
+
+
+def fail_open_total() -> int:
+    return _fail_open_total
+
+
 @register("healthy-filter")
 class HealthyFilter(Filter):
+    """Keep healthy endpoints — failing OPEN when none are.
+
+    An all-unhealthy pool usually means the health DATA is bad (scrape
+    outage, collector restart), not that every replica is down; filtering
+    to zero candidates turns a telemetry gap into a guaranteed 503. Pass
+    the full pool through instead (scorers still order it) and count the
+    event so the condition is loud on /metrics rather than silent."""
+
     def filter(self, req, pods):
-        return [p for p in pods if p.healthy]
+        healthy = [p for p in pods if p.healthy]
+        if healthy or not pods:
+            return healthy
+        note_fail_open()
+        return pods
 
 
 @register("model-filter")

@@ -306,6 +306,7 @@ class K8sPodDiscoverySource:
             while True:
                 try:
                     await self.poll_once()
+                # llmd: allow(broad-except) -- discovery loop guard: retries next poll with the last-good pool intact
                 except Exception as e:
                     log.warning("k8s pod discovery poll failed: %s", e)
                 await asyncio.sleep(self.poll_s)
@@ -326,6 +327,7 @@ class K8sPodDiscoverySource:
             except _WatchExpired:
                 log.info("watch resourceVersion expired; re-listing")
                 self._resource_version = None
+            # llmd: allow(broad-except) -- watch loop guard: degrades to a full re-LIST after backoff
             except Exception as e:
                 log.warning("k8s pod watch failed (%s); re-listing", e)
                 self._resource_version = None

@@ -111,6 +111,7 @@ class PredictorClient:
                 ) as r:
                     d = await r.json()
                     return float(d["ttft_ms"]), float(d["tpot_ms"])
+            # llmd: allow(broad-except) -- degrades to the in-process predictor below; scoring never fails a request
             except Exception:
                 log.debug("remote predict failed; using local fallback")
         return (
@@ -141,6 +142,7 @@ class PredictorClient:
                     self.train_url + "/v1/samples", json=payload
                 ) as r:
                     await r.read()
+            # llmd: allow(broad-except) -- training feedback is best-effort; a lost sample costs model freshness only
             except Exception:
                 log.debug("trainer sample post failed")
 

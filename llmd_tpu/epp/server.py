@@ -19,6 +19,9 @@ import time
 import aiohttp
 from aiohttp import web
 
+from llmd_tpu import faults
+from llmd_tpu.epp import filters as filters_mod
+from llmd_tpu.epp.breaker import EndpointCircuitBreaker
 from llmd_tpu.epp.datalayer import EndpointStore, FileDiscoverySource, MetricsCollector
 from llmd_tpu.epp.flow_control import OUTCOME_HTTP, FlowControl, Outcome
 from llmd_tpu.epp.handler import (
@@ -56,6 +59,14 @@ HOP_HEADERS = {
 }
 
 
+class UpstreamServerError(RuntimeError):
+    """Picked endpoint answered 5xx: retryable on another replica."""
+
+    def __init__(self, status: int, body: str = "") -> None:
+        super().__init__(f"upstream returned {status}: {body}")
+        self.status = status
+
+
 class RouterMetrics:
     """EPP self-metrics (reference scheduling.md:161-191)."""
 
@@ -64,12 +75,18 @@ class RouterMetrics:
         self.scheduling_attempts = 0
         self.scheduling_errors = 0
         self.proxy_errors = 0
+        self.request_retries = 0
         self.ttft_sum = 0.0
         self.ttft_count = 0
         self.e2e_sum = 0.0
         self.outcome_counts: collections.Counter = collections.Counter()
 
-    def render(self, store: EndpointStore, flow: FlowControl) -> str:
+    def render(
+        self,
+        store: EndpointStore,
+        flow: FlowControl,
+        breaker: EndpointCircuitBreaker | None = None,
+    ) -> str:
         pods = store.list()
         ready = sum(1 for p in pods if p.healthy)
         avg_kv = sum(p.attr(KV_CACHE_USAGE) for p in pods) / max(len(pods), 1)
@@ -91,7 +108,19 @@ class RouterMetrics:
             f"llm_d_epp_scheduling_errors_total {self.scheduling_errors}",
             "# TYPE llm_d_epp_proxy_errors_total counter",
             f"llm_d_epp_proxy_errors_total {self.proxy_errors}",
+            "# TYPE llm_d_epp_request_retries_total counter",
+            f"llm_d_epp_request_retries_total {self.request_retries}",
+            "# TYPE llm_d_epp_fail_open_total counter",
+            f"llm_d_epp_fail_open_total {filters_mod.fail_open_total()}",
         ]
+        if breaker is not None:
+            lines.append("# TYPE llm_d_epp_circuit_open gauge")
+            for addr in breaker.open_endpoints():
+                lines.append(f'llm_d_epp_circuit_open{{endpoint="{addr}"}} 1')
+            lines.append("# TYPE llm_d_epp_circuit_trips_total counter")
+            lines.append(
+                f"llm_d_epp_circuit_trips_total {breaker.trips_total}"
+            )
         for oc, n in {**flow.outcomes, **self.outcome_counts}.items():
             name = oc.value if isinstance(oc, Outcome) else str(oc)
             lines.append(
@@ -118,8 +147,11 @@ class Router:
         admitters: list[Admitter] | None = None,
         producers: list | None = None,
         request_timeout_s: float = 600.0,
-        max_schedule_attempts: int = 2,
+        max_schedule_attempts: int = 3,
         default_parser: str = "openai-parser",
+        breaker: EndpointCircuitBreaker | None = None,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_cap_s: float = 1.0,
     ) -> None:
         self.store = store
         self.scheduler = scheduler
@@ -139,6 +171,14 @@ class Router:
         self.metric_extras: list = []
         self.request_timeout_s = request_timeout_s
         self.max_schedule_attempts = max_schedule_attempts
+        # Request-outcome circuit breaker (trips faster than the 3-scrape
+        # health window) + capped exponential backoff between re-picks.
+        self.breaker = breaker or EndpointCircuitBreaker()
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        # Readiness: flipped off FIRST on graceful shutdown so the
+        # gateway stops routing before flow control starts evicting.
+        self.ready = True
         # Parser for paths outside the OpenAI/vllm-gRPC sets
         # ("passthrough-parser" routes opaque payloads through the
         # scheduler instead of the unscored passthrough handler).
@@ -155,6 +195,7 @@ class Router:
         for obs in self.completion_observers:
             try:
                 await obs(req, pod, ttft_ms, tpot_ms)
+            # llmd: allow(broad-except) -- observers are fire-and-forget telemetry; the response is already written
             except Exception:
                 log.exception("completion observer failed")
 
@@ -245,6 +286,7 @@ class Router:
             for producer in self.producers:
                 try:
                     await producer.produce(req, self.store.list())
+                # llmd: allow(broad-except) -- producers enrich scheduling data; scoring degrades without it rather than failing the request
                 except Exception:
                     log.exception("data producer %s failed", type(producer).__name__)
             for adm in self.admitters:
@@ -268,6 +310,12 @@ class Router:
         for attempt in range(self.max_schedule_attempts):
             self.metrics.scheduling_attempts += 1
             pods = [p for p in self.store.list() if p.address not in tried]
+            # Skip open-circuit endpoints — unless that empties the pool:
+            # stale breaker state must degrade to trying, never turn a
+            # routable pool into a manufactured 503 while replicas idle.
+            closed = [p for p in pods if not self.breaker.is_open(p.address)]
+            if closed:
+                pods = closed
             try:
                 result = self.scheduler.schedule(req, pods)
             except NoEndpointsError as e:
@@ -304,11 +352,35 @@ class Router:
                 # (its prefill phase happens within it); released below.
                 prefill_pod.inflight_tokens += req.approx_prompt_tokens
             try:
-                return await self._proxy(request, req, raw, pod, extra_headers)
-            except (aiohttp.ClientConnectionError, asyncio.TimeoutError):
+                return await self._proxy(
+                    request, req, raw, pod, extra_headers,
+                    retry_5xx=attempt + 1 < self.max_schedule_attempts,
+                )
+            except (
+                aiohttp.ClientConnectionError,
+                asyncio.TimeoutError,
+                UpstreamServerError,
+            ) as e:
                 self.metrics.proxy_errors += 1
-                pod.healthy = False
-                log.warning("proxy to %s failed (attempt %d)", pod.address, attempt + 1)
+                self.breaker.record_failure(pod.address)
+                if not isinstance(e, UpstreamServerError):
+                    # The endpoint answered nothing at all — treat like a
+                    # failed scrape; a 5xx responder stays scrape-governed.
+                    pod.healthy = False
+                log.warning(
+                    "proxy to %s failed (attempt %d): %s",
+                    pod.address, attempt + 1, str(e) or type(e).__name__,
+                )
+                if attempt + 1 < self.max_schedule_attempts:
+                    self.metrics.request_retries += 1
+                    # Capped exponential backoff before the re-pick: a
+                    # refusing pool must not see a synchronized retry storm.
+                    await asyncio.sleep(
+                        min(
+                            self.retry_backoff_s * (2 ** attempt),
+                            self.retry_backoff_cap_s,
+                        )
+                    )
                 continue
             finally:
                 if prefill_pod is not None:
@@ -327,8 +399,16 @@ class Router:
         raw: bytes,
         pod: Endpoint,
         extra_headers: dict[str, str],
+        retry_5xx: bool = False,
     ) -> web.StreamResponse:
         session = await self._client()
+        # Injection site: the picked endpoint refuses the connection even
+        # though its scrape health looks fine — the re-pick + breaker
+        # path above is the degradation under test.
+        if faults.fires("epp.endpoint.refuse", pod.address):
+            raise aiohttp.ClientConnectionError(
+                f"injected epp.endpoint.refuse for {pod.address}"
+            )
         headers = {
             k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS
         }
@@ -350,6 +430,25 @@ class Router:
                 request.method, pod.url + request.path_qs, data=raw, headers=headers
             ) as upstream:
                 status = upstream.status
+                if status >= 500 and retry_5xx:
+                    # Nothing streamed to the client yet: surface the 5xx
+                    # to the retry loop so another replica gets the
+                    # request instead of the client eating this one's
+                    # failure. The LAST attempt streams the 5xx through.
+                    body = await upstream.read()
+                    raise UpstreamServerError(
+                        status, body[:200].decode("utf-8", "replace")
+                    )
+                if status < 500:
+                    self.breaker.record_success(pod.address)
+                else:
+                    # Last attempt (retry_5xx=False) streams the 5xx through
+                    # to the client, but the breaker still counts it — a
+                    # replica 500ing on every request must trip the circuit
+                    # even when retries are disabled (scrape health stays
+                    # green for a reachable-but-failing pod).
+                    self.metrics.proxy_errors += 1
+                    self.breaker.record_failure(pod.address)
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in HOP_HEADERS:
@@ -452,11 +551,28 @@ class Router:
             {"status": "ok", "endpoints": len(self.store.list())}
         )
 
+    async def handle_ready(self, request: web.Request) -> web.Response:
+        """Readiness (distinct from /healthz liveness): flips to 503 the
+        moment graceful shutdown begins, BEFORE flow control evicts, so
+        the gateway stops routing before the retryable 503s start."""
+        if not self.ready:
+            return web.json_response(
+                {"status": "draining"}, status=503
+            )
+        return web.json_response(
+            {"status": "ready", "endpoints": len(self.store.list())}
+        )
+
+    def begin_shutdown(self) -> None:
+        """Graceful-shutdown phase 1: unready first, evict second."""
+        self.ready = False
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        parts = [self.metrics.render(self.store, self.flow)]
+        parts = [self.metrics.render(self.store, self.flow, self.breaker)]
         for extra in self.metric_extras:
             try:
                 parts.append(extra())
+            # llmd: allow(broad-except) -- a broken metrics section must not take down the whole scrape page
             except Exception:
                 log.exception("extra metrics renderer failed")
         return web.Response(
@@ -486,6 +602,7 @@ class Router:
         app = web.Application()
         routes = [
             web.get("/healthz", self.handle_health),
+            web.get("/readyz", self.handle_ready),
             web.get("/metrics", self.handle_metrics),
             web.get("/endpoints", self.handle_endpoints),
         ]
@@ -501,6 +618,8 @@ class Router:
             # Endpoint removal must purge scorer state (prefix index entries
             # for a recycled host:port would fake cache affinity on a cold pod).
             self.store.on_remove(self.scheduler.notify_endpoint_removed)
+            # A recycled host:port must not inherit breaker state.
+            self.store.on_remove(self.breaker.forget)
             if self.discovery is not None:
                 try:
                     self.discovery.load_once()
@@ -514,6 +633,13 @@ class Router:
                 self.flow.saturation.pool_stats = self._pool_stats
             self.flow.start()
             yield
+            # Readiness drops BEFORE eviction. In a real deployment the
+            # SIGTERM handler (`__main__._serve`) already flipped this
+            # while the listen socket was still serving — by the time
+            # cleanup_ctx teardown runs, aiohttp has closed the socket —
+            # so this idempotent call is the fallback for embedded/test
+            # runners that tear the app down without the signal path.
+            self.begin_shutdown()
             await self.flow.drain()
             if self.collector is not None:
                 await self.collector.stop()

@@ -17,6 +17,7 @@ import logging
 import struct
 import threading
 
+from llmd_tpu import faults
 from llmd_tpu.events.index import KVBlockIndex
 
 log = logging.getLogger(__name__)
@@ -105,6 +106,13 @@ class KVEventSubscriber:
         # (SUB sockets don't expose the sender).
         pod = batch.get("pod")
         if not pod:
+            return
+        # Injection site: a dropped batch leaves _seqs untouched, so the
+        # NEXT batch presents a sequence gap and the resync path below
+        # (clear the pod's view, converge from subsequent BlockStored
+        # traffic) is what gets exercised — the same degradation a real
+        # lost ZMQ message produces.
+        if faults.fires("events.drop", pod):
             return
         last = self._seqs.get(pod)
         if last is not None and seq != last + 1:

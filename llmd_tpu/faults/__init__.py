@@ -1,0 +1,221 @@
+"""Deterministic fault injection for the serving stack.
+
+The stack's degradation paths (recompute on pull failure, miss on store
+timeout, re-pick on refused endpoints, resync on dropped KV events, the
+engine watchdog) are only real if something exercises them. This module
+makes failure a first-class, seedable input: a process-global
+:class:`FaultPlan` of scoped :class:`FaultSpec` entries, armed either
+programmatically (``faults.arm(plan)`` — the test-fixture path) or via
+the ``LLMD_FAULT_PLAN`` environment variable (JSON, read at import —
+the bench/CLI path). Injection sites threaded through the connector,
+kvstore client, event subscriber, EPP datalayer/router, sidecar proxy
+and engine step loop consult the plan through three tiny helpers:
+
+- :func:`fires` — boolean gate; the SITE raises its native exception
+  type (PullError, TimeoutError, ClientConnectionError, ...) so the
+  degradation under test is exactly the one production would take.
+- :func:`delay` — sleep ``delay_ms`` (stall/latency sites).
+- :func:`corrupt` — deterministically flip payload bytes (wire sites).
+
+Unarmed, every helper is a single module-global ``None`` check — no
+allocation, no lock, no branch on the plan contents — so the hot path
+pays nothing when no plan is armed (the default everywhere outside
+chaos tests and the ``fault_degrade`` bench part).
+
+Determinism: trigger selection is count-based (``after``/``times``)
+and, when probabilistic (``p < 1``), drawn from a ``random.Random``
+seeded from ``(plan.seed, site, match)`` — the same plan over the same
+call sequence injects the same faults, which is what lets the chaos
+matrix pin byte-identical degraded streams.
+
+Known sites (the catalog; docs/architecture/fault-tolerance.md carries
+the degradation contract per site):
+
+==========================  =================================================
+site                        effect at the injection point
+==========================  =================================================
+``kv.pull.drop``            connector chunk pull raises ``PullError``
+``kv.pull.delay_ms``        connector chunk pull sleeps ``delay_ms``
+``kv.bundle.corrupt``       pulled bundle bytes corrupted before decode
+``engine.step.stall``       ``LLMEngine.step`` sleeps ``delay_ms`` (wedge)
+``epp.scrape.fail``         EPP metrics scrape of one endpoint errors
+``epp.endpoint.refuse``     EPP proxy leg raises connection-refused
+``events.drop``             one KV-event batch is dropped (forces seq gap)
+``kvstore.get.timeout``     kvstore client HTTP call raises ``TimeoutError``
+``lockstep.sync.stall``     lockstep collective hangs past the bounded wait
+``sidecar.prefill.fail``    sidecar phase-1 prefill POST raises
+==========================  =================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import zlib
+
+SITES = frozenset({
+    "kv.pull.drop",
+    "kv.pull.delay_ms",
+    "kv.bundle.corrupt",
+    "engine.step.stall",
+    "epp.scrape.fail",
+    "epp.endpoint.refuse",
+    "events.drop",
+    "kvstore.get.timeout",
+    "lockstep.sync.stall",
+    "sidecar.prefill.fail",
+})
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scoped fault: site + selector + trigger window.
+
+    ``match`` is a substring selector against the site's context key
+    (request id, endpoint address, shipper key, ...); empty matches
+    every call. The spec fires on matching hits ``after < n <=
+    after + times`` (``times=None`` = unbounded), each firing gated by
+    ``p`` (seeded)."""
+
+    site: str
+    match: str = ""
+    times: int | None = 1
+    after: int = 0
+    p: float = 1.0
+    delay_ms: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} (known: {sorted(SITES)})"
+            )
+
+
+class FaultPlan:
+    """An armed set of fault specs with per-spec trigger accounting."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0) -> None:
+        self.specs = list(specs)
+        self.seed = seed
+        self.injected: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._hits = [0] * len(self.specs)
+        self._fired = [0] * len(self.specs)
+        # One seeded stream per spec, keyed by (seed, site, match) so a
+        # plan reordering does not reshuffle an unrelated spec's draws.
+        import random
+
+        self._rng = [
+            random.Random(
+                (seed << 1) ^ zlib.crc32(f"{s.site}|{s.match}".encode())
+            )
+            for s in self.specs
+        ]
+        # Sites with no spec never scan the spec list.
+        self._sites = frozenset(s.site for s in self.specs)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        """``{"seed": 0, "faults": [{"site": ..., "match": ...,
+        "times": ..., "after": ..., "p": ..., "delay_ms": ...}]}``"""
+        data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError("fault plan must be a JSON object")
+        specs = [FaultSpec(**f) for f in data.get("faults", [])]
+        return cls(specs, seed=int(data.get("seed", 0)))
+
+    def should_fire(self, site: str, key: str) -> FaultSpec | None:
+        if site not in self._sites:
+            return None
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if s.site != site or (s.match and s.match not in key):
+                    continue
+                self._hits[i] += 1
+                if self._hits[i] <= s.after:
+                    continue
+                if s.times is not None and self._fired[i] >= s.times:
+                    continue
+                if s.p < 1.0 and self._rng[i].random() >= s.p:
+                    continue
+                self._fired[i] += 1
+                self.injected[site] = self.injected.get(site, 0) + 1
+                return s
+        return None
+
+
+# The process-global plan. None (the default) is the zero-overhead
+# unarmed state: every helper below returns on one global read.
+_PLAN: FaultPlan | None = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` process-wide (test fixtures / bench legs)."""
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> FaultPlan | None:
+    return _PLAN
+
+
+def injected_counts() -> dict[str, int]:
+    """{site: injections so far}; empty when unarmed (metrics surface)."""
+    plan = _PLAN
+    return dict(plan.injected) if plan is not None else {}
+
+
+# ------------------------------------------------------------------ #
+# site helpers
+
+
+def fires(site: str, key: str = "") -> bool:
+    """True when an armed spec fires for (site, key). The call site
+    raises its native exception type so the production degradation path
+    is the one exercised."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.should_fire(site, key) is not None
+
+
+def delay(site: str, key: str = "") -> None:
+    """Sleep the firing spec's ``delay_ms`` (stall/latency sites)."""
+    plan = _PLAN
+    if plan is None:
+        return
+    spec = plan.should_fire(site, key)
+    if spec is not None and spec.delay_ms > 0:
+        time.sleep(spec.delay_ms / 1e3)
+
+
+def corrupt(site: str, data: bytes, key: str = "") -> bytes:
+    """Deterministically corrupt ``data`` when the spec fires: XOR the
+    middle byte (header-preserving for KV bundles, so the corruption is
+    exactly what a payload CRC must catch — not what a magic check
+    already would)."""
+    plan = _PLAN
+    if plan is None:
+        return data
+    if plan.should_fire(site, key) is None or not data:
+        return data
+    b = bytearray(data)
+    b[len(b) // 2] ^= 0xFF
+    return bytes(b)
+
+
+# Bench/CLI arming: a JSON plan in the environment is read once at
+# import. Tests use arm()/disarm() directly.
+_env_plan = os.environ.get("LLMD_FAULT_PLAN")
+if _env_plan:
+    arm(FaultPlan.from_json(_env_plan))
+del _env_plan

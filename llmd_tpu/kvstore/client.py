@@ -28,6 +28,7 @@ import urllib.error
 import urllib.request
 import uuid
 
+from llmd_tpu import faults
 from llmd_tpu.kvtransfer import shipper as shipper_mod
 
 log = logging.getLogger(__name__)
@@ -85,6 +86,11 @@ class CrossSliceStoreClient:
     # ----------------------------------------------------------- http
 
     def _call(self, path: str, body: dict | None = None, method: str = "POST"):
+        # Injection site: a hung/slow master degrades every caller to its
+        # documented fallback (reads -> miss, puts -> dropped publish,
+        # heartbeat -> deregistered), never an exception escaping.
+        if faults.fires("kvstore.get.timeout", path):
+            raise TimeoutError(f"injected kvstore.get.timeout at {path}")
         req = urllib.request.Request(
             f"{self.master_url}{path}",
             data=json.dumps(body).encode() if body is not None else None,

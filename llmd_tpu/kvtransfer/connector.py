@@ -27,17 +27,21 @@ inserts that never touch the jitted step.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
+import os
 import struct
 import threading
 import time
 import uuid
 import weakref
+import zlib
 from typing import Any
 
 import numpy as np
 
+from llmd_tpu import faults
 from llmd_tpu.engine.kv_cache import PageAllocator, page_hashes_for_tokens
 from llmd_tpu.kvtransfer import shipper as shipper_mod
 from llmd_tpu.kvtransfer.shipper import DEFAULT_LEASE_MS, PullError, ShipperServer
@@ -46,6 +50,10 @@ log = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<4sBHIIIII")  # magic, ver, dtype_len, L, n, K, page, inner
 _MAGIC = b"KVPG"
+# Version 2 appends a CRC32 of everything after the dtype name (scales +
+# payload for q8, payload for exact) right after the name; version-1
+# bundles (no CRC) still parse — header-versioned compatibility.
+_CRC = struct.Struct("<I")
 
 
 @dataclasses.dataclass
@@ -95,6 +103,14 @@ class KVTransferConfig:
 
 class KVLoadError(RuntimeError):
     """Remote KV pull failed and policy is 'fail'."""
+
+
+class KVCorruptionError(PullError):
+    """Bundle payload failed its CRC32 — corrupted in flight or at rest.
+
+    A PullError subclass so every existing policy path (recompute/fail)
+    treats it as a failed pull; the distinct type lets the connector
+    count CRC rejections separately (kv_bundle_crc_failures_total)."""
 
 
 def _pad_chunk_ids(ids: list[int], cp: int) -> list[int]:
@@ -189,40 +205,99 @@ def transfer_keys(params: dict) -> list[str]:
     return keys
 
 
-def pack_header(pages: np.ndarray) -> bytes:
+def payload_crc(*parts) -> int:
+    """CRC32 over the wire bytes after the dtype name (header-trailing
+    scales block first for q8, then the payload). Parts are bytes or
+    C-contiguous buffers (numpy arrays; bf16 callers pass a uint8 view,
+    same as the register path)."""
+    crc = 0
+    for p in parts:
+        crc = zlib.crc32(p, crc)
+    return crc
+
+
+def pack_header(pages: np.ndarray, crc: int | None = None) -> bytes:
     """Bundle header for a [L, n, K, page, 2D] page array.
 
     The dtype travels by NAME ('bfloat16', 'float32', ...): extension
     dtypes like ml_dtypes.bfloat16 have an anonymous .str ('<V2') that
     does not round-trip through np.dtype(), while np.dtype(name) resolves
-    both builtins and registered extension dtypes."""
+    both builtins and registered extension dtypes.
+
+    With ``crc`` (CRC32 of the payload bytes) the header is version 2 and
+    importers verify it; without, a version-1 header (legacy producers,
+    or every producer under the ``LLMD_KV_BUNDLE_COMPAT_V1`` rollout
+    pin — see ``_COMPAT_V1``)."""
     dt = pages.dtype.name.encode()
     L, n, K, page, inner = pages.shape
-    return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
+    if crc is None or _COMPAT_V1:
+        return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
+    return (
+        _HDR.pack(_MAGIC, 2, len(dt), L, n, K, page, inner)
+        + dt
+        + _CRC.pack(crc)
+    )
 
 
 _Q8_PREFIX = "int8q:"
 
+# Mixed-version rolling deploys: a not-yet-upgraded consumer rejects a
+# version-2 header outright ("bad KV bundle header"), which would turn
+# every P/D transfer into a recompute (or a hard failure under
+# load_failure_policy='fail') while prefill and decode pods roll
+# independently. Readers here accept both versions, so the safe order is
+# reader-first: upgrade consumers, then producers, then drop this pin.
+# Setting LLMD_KV_BUNDLE_COMPAT_V1=1 pins producers to the version-1
+# wire format (no CRC) for the transition window.
+_COMPAT_V1 = os.environ.get("LLMD_KV_BUNDLE_COMPAT_V1", "0") not in ("", "0")
 
-def pack_header_q8(q8: np.ndarray, orig_dtype_name: str) -> bytes:
+
+def pack_header_q8(
+    q8: np.ndarray, orig_dtype_name: str, crc: int | None = None
+) -> bytes:
     """Header for an int8-quantized bundle: dtype travels as
     'int8q:<original>'; the f16 scales block follows the header (same
-    register call), and its size is derivable from the dims."""
+    register call), and its size is derivable from the dims. A version-2
+    ``crc`` covers scales + payload (everything after the name); the
+    ``LLMD_KV_BUNDLE_COMPAT_V1`` rollout pin downgrades to version 1."""
     dt = (_Q8_PREFIX + orig_dtype_name).encode()
     L, n, K, page, inner = q8.shape
-    return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
+    if crc is None or _COMPAT_V1:
+        return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
+    return (
+        _HDR.pack(_MAGIC, 2, len(dt), L, n, K, page, inner)
+        + dt
+        + _CRC.pack(crc)
+    )
+
+
+def _payload_offset(blob: bytes, ver: int, dlen: int) -> int:
+    """Start of the post-name wire bytes; version 2 verifies the CRC
+    riding between the name and the payload before anything decodes."""
+    off = _HDR.size + dlen
+    if ver < 2:
+        return off
+    (want,) = _CRC.unpack_from(blob, off)
+    off += _CRC.size
+    got = zlib.crc32(memoryview(blob)[off:])
+    if got != want:
+        raise KVCorruptionError(
+            f"KV bundle CRC mismatch: header {want:#010x} vs payload "
+            f"{got:#010x} ({len(blob)} wire bytes)"
+        )
+    return off
 
 
 def unpack_pages_any(blob: bytes):
     """Decode either wire form. Returns ("exact", pages) or
     ("q8", q8, scales_f16, orig_dtype_name)."""
     magic, ver, dlen, L, n, K, page, inner = _HDR.unpack_from(blob, 0)
-    if magic != _MAGIC or ver != 1:
+    if magic != _MAGIC or ver not in (1, 2):
         raise PullError("bad KV bundle header")
-    off = _HDR.size + dlen
-    name = blob[_HDR.size : off].decode()
+    name = blob[_HDR.size : _HDR.size + dlen].decode()
     if not name.startswith(_Q8_PREFIX):
         return ("exact", unpack_pages(blob))
+    off = _payload_offset(blob, ver, dlen)
     orig = name[len(_Q8_PREFIX):]
     n_rows = L * n * K * page
     # 2 f16 scales per row: separate K-half and V-half quantization.
@@ -237,17 +312,31 @@ def unpack_pages_any(blob: bytes):
 def pack_pages(pages: np.ndarray) -> bytes:
     """Full serialized bundle (tests / small payloads; the production path
     registers header + raw buffer separately to avoid the concat copy)."""
-    return pack_header(pages) + pages.tobytes()
+    body = pages.tobytes()
+    return pack_header(pages, crc=zlib.crc32(body)) + body
 
 
 def unpack_pages(blob: bytes) -> np.ndarray:
     magic, ver, dlen, L, n, K, page, inner = _HDR.unpack_from(blob, 0)
-    if magic != _MAGIC or ver != 1:
+    if magic != _MAGIC or ver not in (1, 2):
         raise PullError("bad KV bundle header")
-    off = _HDR.size + dlen
-    dt = np.dtype(blob[_HDR.size : off].decode())
+    off = _payload_offset(blob, ver, dlen)
+    dt = np.dtype(blob[_HDR.size : _HDR.size + dlen].decode())
     arr = np.frombuffer(blob, dtype=dt, offset=off)
     return arr.reshape(L, n, K, page, inner)
+
+
+def _faulty_pull(host: str, port: int, key: str, deadline: float | None = None):
+    """Every consumer pull funnels through here: the kv.pull.* /
+    kv.bundle.corrupt injection sites wrap the real wire call."""
+    faults.delay("kv.pull.delay_ms", key)
+    if faults.fires("kv.pull.drop", key):
+        raise PullError(f"injected kv.pull.drop for {key!r}")
+    if deadline is None:
+        blob = shipper_mod.pull(host, port, key)
+    else:
+        blob = shipper_mod.pull_wait(host, port, key, deadline)
+    return faults.corrupt("kv.bundle.corrupt", blob, key)
 
 
 # In-process producer registry (single-host xPyD fast path): a consumer
@@ -356,6 +445,14 @@ class TPUConnector:
         self.import_failures = 0
         self.local_imports = 0  # transfers served by the in-process path
         self.stream_imports = 0  # multi-host pipelined (streamed) imports
+        # Failure trails (the SLO layer's view of degradation): every
+        # swallowed transfer failure lands in transfer_failures keyed by
+        # (stage, policy applied); CRC rejections and recompute
+        # fallbacks additionally count on their own so the dashboards
+        # can alert on silent-corruption and degraded-throughput rates.
+        self.crc_failures = 0
+        self.recompute_fallbacks = 0
+        self.transfer_failures: collections.Counter = collections.Counter()
         # Adaptive encoding: EWMA staging throughput per ORIGINAL byte
         # for each wire form, learned from per-chunk stage timings.
         self._enc_rate: dict[str, float | None] = {"exact": None, "q8": None}
@@ -605,7 +702,7 @@ class TPUConnector:
                 )
                 self.server.register(
                     swa_key(key), payload, self.cfg.lease_ms,
-                    header=pack_header(pages),
+                    header=pack_header(pages, crc=payload_crc(payload)),
                 )
                 self.exported_bytes += payload.nbytes
             staging_itemsize = np.dtype(self.runner.staging_dtype).itemsize
@@ -630,12 +727,17 @@ class TPUConnector:
                     orig = self.runner.staging_dtype_name
                     # Scales ride in the header blob: one owning copy in
                     # the shipper, no concat of the big int8 payload.
-                    header = pack_header_q8(q8, orig) + scales.tobytes()
+                    scales_b = scales.tobytes()
+                    header = (
+                        pack_header_q8(
+                            q8, orig, crc=payload_crc(scales_b, q8)
+                        )
+                        + scales_b
+                    )
                     payload = q8
                     orig_bytes = q8.nbytes * staging_itemsize
                 else:
                     pages = self.runner.download_pages(snap)
-                    header = pack_header(pages)
                     # Extension dtypes (bfloat16: isbuiltin == 2) don't
                     # expose the buffer protocol the zero-copy register
                     # path needs; a same-memory uint8 view does.
@@ -643,6 +745,7 @@ class TPUConnector:
                         pages if pages.dtype.isbuiltin == 1
                         else pages.view(np.uint8)
                     )
+                    header = pack_header(pages, crc=payload_crc(payload))
                     orig_bytes = payload.nbytes
                 self.server.register(
                     chunk_key(key, j), payload, self.cfg.lease_ms, header=header
@@ -652,6 +755,10 @@ class TPUConnector:
                 )
                 self.exported_bytes += len(header) + payload.nbytes
         except Exception:
+            # Abandoned export: the consumer's pull wait times out and
+            # ITS load-failure policy decides — but the producer-side
+            # failure must leave a metric trail, not just a log line.
+            self.transfer_failures[("export-staging", "abandon")] += 1
             log.exception("KV export staging failed for %s", key)
         finally:
             self.last_stage_ms = (time.monotonic() - t0) * 1e3
@@ -733,7 +840,7 @@ class TPUConnector:
             )
         if n_chunks <= 0:
             # Legacy single-bundle producer.
-            blob = shipper_mod.pull(host, port, key)
+            blob = _faulty_pull(host, port, key)
             pages = unpack_pages(blob)
             if pages.shape[1] != n_full:
                 raise ValueError(
@@ -838,7 +945,7 @@ class TPUConnector:
         if ring_mode and n_swa:
             # The sliding-layer section first: it registers first and is
             # tiny, so a missing/expired export fails fast.
-            blob = shipper_mod.pull_wait(
+            blob = _faulty_pull(
                 host, port, swa_key(key),
                 min(time.monotonic() + per_chunk_s, hard_deadline),
             )
@@ -856,7 +963,7 @@ class TPUConnector:
             nbytes += len(blob)
         try:
             for j in range(j0, n_chunks):
-                blob = shipper_mod.pull_wait(
+                blob = _faulty_pull(
                     host, port, chunk_key(key, j),
                     min(time.monotonic() + per_chunk_s, hard_deadline),
                 )
@@ -940,8 +1047,13 @@ class TPUConnector:
             # struct.error: truncated header; TypeError: garbage dtype string
             # -- a corrupt/foreign bundle must hit the policy, not escape.
             self.import_failures += 1
-            if self.cfg.load_failure_policy == "fail":
+            if isinstance(e, KVCorruptionError):
+                self.crc_failures += 1
+            policy = self.cfg.load_failure_policy
+            self.transfer_failures[("fetch", policy)] += 1
+            if policy == "fail":
                 raise KVLoadError(str(e)) from e
+            self.recompute_fallbacks += 1
             log.warning("remote KV load failed, recomputing locally: %s", e)
             return None
         finally:
@@ -1042,6 +1154,8 @@ class TPUConnector:
                 page_ids = self.allocator.allocate(n_full - skip)
             except NoFreePagesError as e:
                 self.import_failures += 1
+                self.transfer_failures[("apply", "recompute")] += 1
+                self.recompute_fallbacks += 1
                 log.warning("no free pages for KV import, recomputing: %s", e)
                 self._notify_free_async(bundle)
                 return 0
@@ -1196,6 +1310,8 @@ class TPUConnector:
                 )
         except (NoFreePagesError, ValueError, KeyError, TypeError) as e:
             self.import_failures += 1
+            self.transfer_failures[("preload", "recompute")] += 1
+            self.recompute_fallbacks += 1
             log.warning("KV ring preload failed, recomputing locally: %s", e)
             if page_ids:
                 self.allocator.free(page_ids)
@@ -1255,6 +1371,9 @@ class TPUConnector:
             "imported_requests": self.imported_requests,
             "imported_bytes": self.imported_bytes,
             "import_failures": self.import_failures,
+            "crc_failures": self.crc_failures,
+            "recompute_fallbacks": self.recompute_fallbacks,
+            "transfer_failures": dict(self.transfer_failures),
             "local_imports": self.local_imports,
             "stream_imports": self.stream_imports,
             "enc_rate_exact_mbps": round(

@@ -130,6 +130,7 @@ class ShipperServer:
     def __del__(self) -> None:  # best-effort
         try:
             self.close()
+        # llmd: allow(broad-except) -- __del__ during interpreter teardown; nothing to surface to
         except Exception:
             pass
 

@@ -31,7 +31,12 @@ from llmd_tpu.engine.request import RequestOutput, SamplingParams
 from llmd_tpu.epp.types import HDR_EC_HOST
 from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.serve import protocol as P
-from llmd_tpu.serve.async_engine import AsyncEngine, EngineError, RequestFailed
+from llmd_tpu.serve.async_engine import (
+    AsyncEngine,
+    DeadlineExceeded,
+    EngineError,
+    RequestFailed,
+)
 from llmd_tpu.serve.metrics import render_metrics
 
 log = logging.getLogger(__name__)
@@ -192,6 +197,16 @@ def _error(status: int, message: str) -> web.Response:
     return web.json_response(P.error_body(message, code=status), status=status)
 
 
+def _error_status(e: BaseException) -> int:
+    """Engine-exception -> HTTP status, shared by every generate surface
+    (streamed terminal frames and non-streaming bodies alike)."""
+    if isinstance(e, RequestFailed):
+        return 400
+    if isinstance(e, DeadlineExceeded):
+        return 504
+    return 500
+
+
 async def _collect(
     engine: AsyncEngine,
     rid: str,
@@ -202,12 +217,14 @@ async def _collect(
     kv_transfer_params: dict | None,
     lora_id: int = 0,
     lora_name: str = "",
+    deadline_s: float | None = None,
 ):
     """Run to completion; returns (text, finish_reason, final RequestOutput)."""
     finish = None
     final: RequestOutput | None = None
     async for out in engine.generate(rid, prompt_ids, sampling, priority,
-                                     kv_transfer_params, lora_id, lora_name):
+                                     kv_transfer_params, lora_id, lora_name,
+                                     deadline_s):
         detok.feed(out.new_token_ids, final=out.finished)
         final = out
         if detok.stopped:
@@ -223,8 +240,48 @@ async def _collect(
 # handlers
 
 
+def _request_deadline_s(request: web.Request) -> float | None:
+    """Per-request deadline: `x-request-deadline-s` header, falling back
+    to LLMD_REQUEST_DEADLINE_S. Malformed values degrade to no deadline
+    (a bad header must not reject a request the engine could serve)."""
+    raw = request.headers.get("x-request-deadline-s") or os.environ.get(
+        "LLMD_REQUEST_DEADLINE_S", ""
+    )
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
 async def handle_health(request: web.Request) -> web.Response:
+    # Liveness stays cheap — but a watchdog-stalled engine IS dead for
+    # serving purposes: flip 503 so the platform restarts/ejects us
+    # instead of routing into a wedge.
+    engine = request.app[ENGINE_KEY]
+    if engine.stalled:
+        return web.json_response(
+            {"status": "stalled", "watchdog_s": engine.watchdog_s},
+            status=503,
+        )
     return web.json_response({"status": "ok"})
+
+
+async def handle_ready(request: web.Request) -> web.Response:
+    """Readiness (engine warmed + watchdog fresh + not draining/paused):
+    the gateway's routing gate, distinct from /health liveness."""
+    engine = request.app[ENGINE_KEY]
+    if engine.ready:
+        return web.json_response({"status": "ready"})
+    return web.json_response(
+        {
+            "status": "not-ready",
+            "draining": engine.draining,
+            "paused": engine.paused,
+            "stalled": engine.stalled,
+        },
+        status=503,
+    )
 
 
 async def handle_models(request: web.Request) -> web.Response:
@@ -414,6 +471,7 @@ async def _stream_response(
     span=None,
     lora_id: int = 0,
     lora_name: str = "",
+    deadline_s: float | None = None,
 ) -> web.StreamResponse:
     resp = web.StreamResponse(
         headers={
@@ -430,7 +488,8 @@ async def _stream_response(
     cached = 0
     try:
         async for out in engine.generate(rid, prompt_ids, sampling, priority,
-                                         kv_transfer_params, lora_id, lora_name):
+                                         kv_transfer_params, lora_id, lora_name,
+                                         deadline_s):
             delta = detok.feed(out.new_token_ids, final=out.finished)
             n_out = out.num_output_tokens
             cached = out.num_cached_tokens
@@ -454,8 +513,9 @@ async def _stream_response(
             if finish is not None:
                 break
     except (RequestFailed, EngineError) as e:
-        code = 400 if isinstance(e, RequestFailed) else 500
-        await resp.write(_sse(P.error_body(str(e), code=code)))
+        # The stream is already committed: a terminal error frame (504
+        # for deadline, 500 engine, 400 client) instead of a hang.
+        await resp.write(_sse(P.error_body(str(e), code=_error_status(e))))
         await resp.write(b"data: [DONE]\n\n")
         return resp
     except (asyncio.CancelledError, ConnectionResetError):
@@ -492,6 +552,7 @@ async def _stream_response_multi(
     span=None,
     lora_id: int = 0,
     lora_name: str = "",
+    deadline_s: float | None = None,
 ) -> web.StreamResponse:
     """SSE with n>1: one engine stream per choice, chunks multiplexed onto
     the response with their choice index (OpenAI interleave semantics).
@@ -526,6 +587,7 @@ async def _stream_response_multi(
             async for out in engine.generate(
                 crid, prompt_ids, sp, priority,
                 kv_transfer_params if i == 0 else None, lora_id, lora_name,
+                deadline_s,
             ):
                 delta = detok.feed(out.new_token_ids, final=out.finished)
                 finish = None
@@ -554,6 +616,7 @@ async def _stream_response_multi(
             await queue.put(("finish", i, None))
         except asyncio.CancelledError:
             raise
+        # llmd: allow(broad-except) -- the failure IS surfaced: forwarded to the consumer loop as a terminal error item
         except Exception as e:
             # ANY pump failure must surface as a terminal item — a silent
             # exit deadlocks the `while done < n` consumer.
@@ -567,8 +630,7 @@ async def _stream_response_multi(
             kind, i, payload = await queue.get()
             if kind == "error":
                 await resp.write(_sse(P.error_body(
-                    str(payload),
-                    code=400 if isinstance(payload, RequestFailed) else 500,
+                    str(payload), code=_error_status(payload),
                 )))
                 await resp.write(b"data: [DONE]\n\n")
                 for t in tasks:
@@ -678,6 +740,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     span.set("gen_ai.request.model", model)
     span.set("gen_ai.usage.prompt_tokens", len(prompt_ids))
     span.set("llm_d.request.streaming", bool(req.stream))
+    deadline_s = _request_deadline_s(request)
 
     if req.stream:
         try:
@@ -686,12 +749,12 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
                     request, engine, rid, model, prompt_ids, sampling,
                     tokenizer, P.stop_strings(req.stop), req.n,
                     req.priority, req.kv_transfer_params, chat, span,
-                    lora_id, lora_name,
+                    lora_id, lora_name, deadline_s,
                 )
             return await _stream_response(
                 request, engine, rid, model, prompt_ids, sampling, detok,
                 req.priority, req.kv_transfer_params, chat, span,
-                lora_id, lora_name,
+                lora_id, lora_name, deadline_s,
             )
         except BaseException as e:
             span.error(str(e))
@@ -702,7 +765,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         if req.n == 1:
             choices = [await _collect(
                 engine, rid, prompt_ids, sampling, detok, req.priority,
-                req.kv_transfer_params, lora_id, lora_name,
+                req.kv_transfer_params, lora_id, lora_name, deadline_s,
             )]
         else:
             # n parallel samples share the prompt (and its cached prefix).
@@ -723,7 +786,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
                     Detokenizer(tokenizer, P.stop_strings(req.stop)),
                     req.priority,
                     req.kv_transfer_params if i == 0 else None,
-                    lora_id, lora_name,
+                    lora_id, lora_name, deadline_s,
                 )
 
             tasks = [asyncio.ensure_future(one(i)) for i in range(req.n)]
@@ -741,6 +804,12 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
         span.error(str(e))
         span.end()
         return _error(400, str(e))
+    except DeadlineExceeded as e:
+        span.error(str(e))
+        span.end()
+        return web.json_response(
+            P.error_body(str(e), etype="timeout_error", code=504), status=504
+        )
     except EngineError as e:
         span.error(str(e))
         span.end()
@@ -866,6 +935,7 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         return _error(400, f"invalid sampling_params: {e}")
     rid = request.headers.get("x-request-id") or P.request_id("grpcgen")
     kvp = body.get("kv_transfer_params")
+    deadline_s = _request_deadline_s(request)
     try:
         lora_id, lora_name = _resolve_lora(request, str(body.get("model") or ""))
     except UnknownModelError as e:
@@ -883,13 +953,12 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         final = None
         try:
             async for out in engine.generate(rid, ids, sampling, priority, kvp,
-                                             lora_id, lora_name):
+                                             lora_id, lora_name, deadline_s):
                 final = out
                 if out.new_token_ids:
                     await resp.write(_sse({"token_ids": list(out.new_token_ids)}))
         except (RequestFailed, EngineError) as e:
-            code = 400 if isinstance(e, RequestFailed) else 500
-            await resp.write(_sse(P.error_body(str(e), code=code)))
+            await resp.write(_sse(P.error_body(str(e), code=_error_status(e))))
             await resp.write(b"data: [DONE]\n\n")
             return resp
         except (asyncio.CancelledError, ConnectionResetError):
@@ -919,11 +988,15 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
     final = None
     try:
         async for out in engine.generate(rid, ids, sampling, priority, kvp,
-                                         lora_id, lora_name):
+                                         lora_id, lora_name, deadline_s):
             final = out
             out_ids.extend(out.new_token_ids)
     except RequestFailed as e:
         return _error(400, str(e))
+    except DeadlineExceeded as e:
+        return web.json_response(
+            P.error_body(str(e), etype="timeout_error", code=504), status=504
+        )
     except EngineError as e:
         return web.json_response(
             P.error_body(str(e), etype="internal_error", code=500), status=500
@@ -1061,6 +1134,7 @@ def build_app(
     app.add_routes(
         [
             web.get("/health", handle_health),
+            web.get("/ready", handle_ready),
             web.get("/v1/models", handle_models),
             web.get("/metrics", handle_metrics),
             web.post("/tokenize", handle_tokenize),

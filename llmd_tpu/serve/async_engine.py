@@ -12,7 +12,9 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
@@ -54,11 +56,48 @@ class EngineError(Exception):
     """Internal engine failure (device fault, compile error); maps to 500."""
 
 
+class DeadlineExceeded(EngineError):
+    """Per-request deadline elapsed before the stream finished; maps to
+    504 (non-streaming) or a terminal error frame (streaming)."""
+
+
+class WatchdogStalled(EngineError):
+    """The engine step loop blew past the watchdog budget: the device
+    program (or a collective peer) is wedged. In-flight streams get this
+    as a terminal frame instead of hanging forever."""
+
+
 class AsyncEngine:
     """Runs an LLMEngine on a background thread with an asyncio surface."""
 
-    def __init__(self, engine: LLMEngine) -> None:
+    def __init__(
+        self, engine: LLMEngine, watchdog_s: float | None = None
+    ) -> None:
         self.engine = engine
+        # Step watchdog: last-step-heartbeat liveness for the engine
+        # thread. A step outliving the budget means the device program
+        # (or a lockstep peer) is wedged — /health flips 503 and every
+        # in-flight stream gets a terminal WatchdogStalled frame instead
+        # of hanging until the client gives up. 0/None disables.
+        if watchdog_s is None:
+            try:
+                watchdog_s = float(
+                    os.environ.get("LLMD_STEP_WATCHDOG_S", "0") or 0
+                )
+            except ValueError:
+                watchdog_s = 0.0
+        self.watchdog_s = watchdog_s or 0.0
+        self._step_started: float | None = None
+        self.last_step_done = time.monotonic()
+        # The FIRST step carries jit compilation (seconds to minutes on a
+        # cold cache) — that's the startup probe's domain, not a wedge.
+        # The watchdog arms once one step has completed.
+        self._steps_done = 0
+        self._stall_flagged = False
+        self._watchdog_task: asyncio.Task | None = None
+        # Graceful-shutdown readiness: flipped by drain() so /ready goes
+        # 503 before the gateway sees connection errors.
+        self.draining = False
         self._lock = threading.Condition()
         self._inbox: list[_Pending] = []
         self._aborts: list[str] = []
@@ -78,7 +117,6 @@ class AsyncEngine:
         # stretches, so a small cap would head-of-line-block TTFT under
         # concurrent prefill handoffs.
         import concurrent.futures
-        import os
 
         self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=min(32, (os.cpu_count() or 1) + 4),
@@ -93,14 +131,74 @@ class AsyncEngine:
             target=self._run, name="llmd-engine", daemon=True
         )
         self._thread.start()
+        if self.watchdog_s and self._loop.is_running():
+            self._watchdog_task = self._loop.create_task(
+                self._watchdog_loop()
+            )
 
     def stop(self) -> None:
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            self._watchdog_task = None
         with self._lock:
             self._stop = True
             self._lock.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
         self._fetch_pool.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------------ #
+    # step watchdog (liveness for the engine thread)
+
+    @property
+    def stalled(self) -> bool:
+        """True while the current step has outlived the watchdog budget
+        (warmed engines only: the first step's jit compile is startup-
+        probe territory)."""
+        if not self.watchdog_s or not self._steps_done:
+            return False
+        t0 = self._step_started
+        return t0 is not None and time.monotonic() - t0 > self.watchdog_s
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs /health liveness): engine thread up, stepping
+        within budget, not paused, not draining."""
+        return (
+            self._thread is not None
+            and self._thread.is_alive()
+            and not self._paused
+            and not self.draining
+            and not self.stalled
+        )
+
+    async def _watchdog_loop(self) -> None:
+        period = max(self.watchdog_s / 4.0, 0.05)
+        while True:
+            await asyncio.sleep(period)
+            if not self.stalled:
+                continue
+            if not self._stall_flagged:
+                self._stall_flagged = True
+                self.engine.stats.engine_watchdog_stalls_total += 1
+                log.error(
+                    "engine step watchdog: step running > %.1fs; failing "
+                    "in-flight streams and turning /health 503",
+                    self.watchdog_s,
+                )
+            # Terminal frames for every in-flight stream; their engine-
+            # side sequences are queued for abort so a recovering thread
+            # doesn't keep burning device time on abandoned requests.
+            with self._lock:
+                subs, self._subs = dict(self._subs), {}
+                self._aborts.extend(subs)
+                self._lock.notify_all()
+            err = WatchdogStalled(
+                f"engine step exceeded the {self.watchdog_s}s watchdog "
+                "budget; the engine is wedged"
+            )
+            for q in subs.values():
+                q.put_nowait(err)
 
     @property
     def stats(self):
@@ -121,12 +219,15 @@ class AsyncEngine:
     def resume(self) -> None:
         with self._lock:
             self._paused = False
+            self.draining = False  # a resumed engine serves again
             self._lock.notify_all()
 
     async def drain(self, timeout_s: float = 60.0) -> bool:
         """Wait until no requests are in flight (queued or running).
         New submissions keep being accepted; callers gate those upstream
-        (the router stops routing to a draining endpoint)."""
+        — /ready flips 503 HERE so the gateway stops routing before the
+        engine goes away (resume() re-readies after maintenance)."""
+        self.draining = True
         deadline = asyncio.get_running_loop().time() + timeout_s
         while asyncio.get_running_loop().time() < deadline:
             with self._lock:
@@ -185,8 +286,17 @@ class AsyncEngine:
         kv_transfer_params: dict[str, Any] | None = None,
         lora_id: int = 0,
         lora_name: str = "",
+        deadline_s: float | None = None,
     ) -> AsyncIterator[RequestOutput]:
-        """Async stream of incremental outputs until the request finishes."""
+        """Async stream of incremental outputs until the request finishes.
+
+        ``deadline_s`` bounds the WHOLE request (fetch included): when it
+        elapses the stream raises :class:`DeadlineExceeded` and the
+        engine-side sequence is aborted — a wedged or starved engine can
+        slow requests down, but never hold a caller hostage."""
+        deadline = (
+            None if deadline_s is None else time.monotonic() + deadline_s
+        )
         # P/D consumer: run the (potentially slow) remote-KV pull on an
         # executor so it never blocks the engine step thread or the event
         # loop; the engine thread only applies the pre-fetched bundle.
@@ -202,18 +312,38 @@ class AsyncEngine:
                 conn.fetch_remote_policy,
                 list(prompt_token_ids), kv_transfer_params,
             )
+            def _release(f):
+                try:
+                    b = f.result()
+                # llmd: allow(broad-except) -- done-callback probe: a failed fetch has no bundle to release
+                except BaseException:
+                    return  # fetch failed/cancelled: nothing to free
+                _release_pulled(self.engine, {"__pulled__": b})
+
             try:
-                bundle = await asyncio.wrap_future(cfut)
-            except asyncio.CancelledError:
-
-                def _release(f):
+                if deadline is None:
+                    bundle = await asyncio.wrap_future(cfut)
+                else:
+                    # The deadline bounds the FETCH too: a slow/absent
+                    # producer must not hold the caller past it. The
+                    # executor's real fetch keeps running after the
+                    # timeout; the release callback frees its stream-
+                    # reserved pages when it eventually lands.
                     try:
-                        b = f.result()
-                    except BaseException:
-                        return  # fetch failed/cancelled: nothing to free
-                    _release_pulled(self.engine, {"__pulled__": b})
-
+                        bundle = await asyncio.wait_for(
+                            asyncio.wrap_future(cfut),
+                            max(deadline - time.monotonic(), 0.001),
+                        )
+                    except asyncio.TimeoutError:
+                        cfut.add_done_callback(_release)
+                        raise DeadlineExceeded(
+                            f"request deadline of {deadline_s}s exceeded "
+                            "during remote KV fetch"
+                        ) from None
+            except asyncio.CancelledError:
                 cfut.add_done_callback(_release)
+                raise
+            except DeadlineExceeded:
                 raise
             except Exception as e:  # KVLoadError under policy='fail'
                 raise EngineError(f"remote KV load failed: {e}") from e
@@ -227,7 +357,20 @@ class AsyncEngine:
             raise
         try:
             while True:
-                item = await q.get()
+                if deadline is None:
+                    item = await q.get()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise DeadlineExceeded(
+                            f"request deadline of {deadline_s}s exceeded"
+                        )
+                    try:
+                        item = await asyncio.wait_for(q.get(), remaining)
+                    except asyncio.TimeoutError:
+                        raise DeadlineExceeded(
+                            f"request deadline of {deadline_s}s exceeded"
+                        ) from None
                 if isinstance(item, Exception):
                     raise item
                 yield item
@@ -288,13 +431,17 @@ class AsyncEngine:
                         lora_id=p.lora_id,
                         lora_name=p.lora_name,
                     )
+                # llmd: allow(broad-except) -- surfaced: the caller receives it as a RequestFailed terminal item
                 except Exception as e:  # validation errors -> caller
                     _release_pulled(self.engine, p.kv_transfer_params)
                     self._deliver(p.request_id, RequestFailed(str(e)))
             if not self.engine.has_work():
                 continue
             try:
+                # Watchdog heartbeat brackets the one blocking call.
+                self._step_started = time.monotonic()
                 outputs = self.engine.step()
+            # llmd: allow(broad-except) -- surfaced: every subscriber receives the EngineError as a terminal item (HTTP 500)
             except Exception:
                 log.exception("engine step failed")
                 with self._lock:
@@ -302,5 +449,10 @@ class AsyncEngine:
                 for rid in subs:
                     self._deliver(rid, EngineError("engine step failed"))
                 continue
+            finally:
+                self._step_started = None
+                self.last_step_done = time.monotonic()
+                self._steps_done += 1
+                self._stall_flagged = False
             for out in outputs:
                 self._deliver(out.request_id, out)

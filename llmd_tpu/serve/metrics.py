@@ -10,6 +10,7 @@ vllm mapping) and `llmd:` canonical names.
 
 from __future__ import annotations
 
+from llmd_tpu import faults
 from llmd_tpu.engine.engine import EngineStats
 
 
@@ -81,12 +82,41 @@ def render_metrics(
         # padding-waste gauge the ragged_step bench part bounds.
         "live_tokens_total": stats.live_tokens_total,
         "padded_tokens_total": stats.padded_tokens_total,
+        # Robustness trail (docs/architecture/fault-tolerance.md):
+        # watchdog trips on the step loop, CRC-rejected bundles, and
+        # transfers that degraded to local recompute.
+        "engine_watchdog_stalls_total": stats.engine_watchdog_stalls_total,
+        "kv_bundle_crc_failures_total": stats.kv_bundle_crc_failures_total,
+        "kv_recompute_fallbacks_total": stats.kv_recompute_fallbacks_total,
     }
     if stats.swa_ring_pages:
         # Hybrid-APC section retention activity
         counters["swa_section_hits_total"] = stats.swa_section_hits
         counters["swa_section_captures_total"] = stats.swa_section_captures
     lines: list[str] = []
+    if stats.kv_transfer_failures:
+        # Per-(stage, policy) transfer-failure breakdown (llmd-family
+        # extension): which leg swallowed the failure and what
+        # degradation was applied — the detail behind
+        # kv_transfer_import_failures_total's flat count.
+        lines.append("# TYPE llmd:kv_transfer_failures_total counter")
+        for (stage, policy), n in stats.kv_transfer_failures:
+            lines.append(
+                f'llmd:kv_transfer_failures_total{{stage="{stage}",'
+                f'policy="{policy}",model_name="{model_name}"}} {n}'
+            )
+    injected = faults.injected_counts()
+    if injected:
+        # Only present while a fault plan is armed (chaos runs): how many
+        # injections each site actually delivered, so a matrix leg can
+        # assert its fault fired from the same surface it asserts the
+        # degradation on.
+        lines.append("# TYPE llmd:faults_injected_total counter")
+        for site, n in sorted(injected.items()):
+            lines.append(
+                f'llmd:faults_injected_total{{site="{site}",'
+                f'model_name="{model_name}"}} {n}'
+            )
     if stats.max_lora:
         # reference model-servers.md:78-89: adapter state rides labels on
         # a gauge named vllm:lora_requests_info. available_lora_adapters

@@ -33,6 +33,7 @@ import logging
 import aiohttp
 from aiohttp import web
 
+from llmd_tpu import faults
 from llmd_tpu.epp.types import HDR_EC_HOST, HDR_ENCODER, HDR_PREFILLER
 from llmd_tpu.kvtransfer import shipper as shipper_mod
 from llmd_tpu.obs.tracing import get_tracer
@@ -472,6 +473,12 @@ def build_sidecar_app(cfg: SidecarConfig, rank: int = 0) -> web.Application:
         url = f"http://{prefiller}{path}"
         headers = {HDR_EC_HOST: ec_host} if ec_host else None
         try:
+            # Injection site: an unreachable prefiller degrades to the
+            # decoder-only fallback below — same as production.
+            if faults.fires("sidecar.prefill.fail", prefiller):
+                raise aiohttp.ClientConnectionError(
+                    f"injected sidecar.prefill.fail for {prefiller}"
+                )
             async with session.post(
                 url, json=pre_body, headers=headers,
                 timeout=aiohttp.ClientTimeout(total=cfg.prefill_timeout_s),

@@ -450,6 +450,62 @@ DASHBOARDS["llmd-failure-saturation"] = dashboard(
               ["sum(rate(vllm:generation_tokens_total[5m]))"], w=8,
               desc="If this falls while queues grow, the fleet is losing "
                    "capacity (failures), not gaining load."),
+        row("Degradation trails (fault-tolerance.md)"),
+        panel("Engine watchdog stalls",
+              [f"llmd:engine_watchdog_stalls_total{M}"], kind="stat",
+              w=4, h=4, thresholds=[(None, "green"), (1, "red")],
+              desc="Step loop blew the watchdog budget: /health went 503 "
+                   "and in-flight streams were terminated. Any nonzero "
+                   "value is a wedged-device incident."),
+        panel("KV bundle CRC rejects /s",
+              [f"rate(llmd:kv_bundle_crc_failures_total{M}[5m])"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.001, "red")],
+              desc="Corrupt transfer payloads caught by the v2 header "
+                   "CRC32 and degraded to recompute instead of poisoning "
+                   "the pool. Nonzero = investigate the transfer plane."),
+        panel("Recompute fallbacks /s",
+              [f"rate(llmd:kv_recompute_fallbacks_total{M}[5m])"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.01, "yellow"), (0.1, "red")],
+              desc="Transfers that degraded to local prefill — correct "
+                   "but slow; sustained rate = P/D capacity silently "
+                   "shifting onto decode pods."),
+        panel("EPP request retries /s",
+              ["rate(llm_d_epp_request_retries_total[5m])"], kind="stat",
+              w=4, h=4, thresholds=[(None, "green"), (0.1, "yellow"),
+                                    (1, "red")],
+              desc="Re-picks after connect-refused/5xx from the picked "
+                   "endpoint (capped exponential backoff)."),
+        panel("EPP circuit trips /s",
+              ["rate(llm_d_epp_circuit_trips_total[5m])"], kind="stat",
+              w=4, h=4, thresholds=[(None, "green"), (0.01, "red")],
+              desc="Per-endpoint request-failure breakers opening (faster "
+                   "than the 3-scrape health window)."),
+        panel("EPP fail-open events /s",
+              ["rate(llm_d_epp_fail_open_total[5m])"], kind="stat",
+              w=4, h=4, thresholds=[(None, "green"), (0.001, "red")],
+              desc="healthy-filter saw a wholly-unhealthy pool and passed "
+                   "it through — usually a telemetry outage, not a fleet "
+                   "outage."),
+        panel("Transfer failures by stage/policy",
+              ["sum by (stage, policy) "
+               "(rate(llmd:kv_transfer_failures_total[5m]))"], w=8,
+              desc="Which transfer leg swallowed the failure (fetch / "
+                   "apply / preload / export-staging) and the degradation "
+                   "applied — the detail behind the flat import-failures "
+                   "count."),
+        panel("Open circuits", ["llm_d_epp_circuit_open"], kind="table",
+              h=6, w=8,
+              desc="Endpoints currently excluded by the request-failure "
+                   "breaker (endpoint label carries the address)."),
+        panel("Faults injected by site",
+              ["sum by (site) (llmd:faults_injected_total)"], kind="table",
+              h=6, w=8,
+              desc="Chaos-only series: present while an LLMD_FAULT_PLAN "
+                   "is armed (tests/test_faults.py, bench fault_degrade). "
+                   "Nonzero in production means a fault plan leaked into "
+                   "a serving process — page someone."),
     ],
 )
 

@@ -1,0 +1,898 @@
+"""Seeded chaos matrix: every fault × degradation pair, on real code.
+
+The fault-tolerance contract (docs/architecture/fault-tolerance.md):
+degradable faults (kv.pull.drop → recompute, kv.bundle.corrupt → CRC
+reject → recompute, epp.endpoint.refuse → re-pick, kvstore.get.timeout
+→ miss, events.drop → resync) lose ZERO requests and keep greedy
+streams byte-identical to the no-fault run; non-degradable faults
+(engine.step.stall past the watchdog, a dead lockstep peer) fail FAST
+with the right status instead of hanging. Each path's counter is
+asserted on the same /metrics surface production scrapes.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu import faults
+from llmd_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """No fault plan may leak into the rest of the suite."""
+    yield
+    faults.disarm()
+
+
+def plan(*specs, seed=0):
+    return faults.arm(faults.FaultPlan([faults.FaultSpec(**s) for s in specs],
+                                       seed=seed))
+
+
+# --------------------------------------------------------------------- #
+# the FaultPlan itself: scoping, trigger windows, determinism
+
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultSpec(site="kv.pull.dorp")
+
+
+def test_unarmed_helpers_are_noops():
+    faults.disarm()
+    assert faults.fires("kv.pull.drop", "any") is False
+    faults.delay("engine.step.stall")
+    assert faults.corrupt("kv.bundle.corrupt", b"abc") == b"abc"
+    assert faults.injected_counts() == {}
+
+
+def test_match_times_after_windows():
+    plan({"site": "kv.pull.drop", "match": "req-a", "times": 2, "after": 1})
+    assert not faults.fires("kv.pull.drop", "req-b:c0")   # selector miss
+    assert not faults.fires("kv.pull.drop", "req-a:c0")   # after=1 skip
+    assert faults.fires("kv.pull.drop", "req-a:c1")
+    assert faults.fires("kv.pull.drop", "req-a:c2")
+    assert not faults.fires("kv.pull.drop", "req-a:c3")   # times exhausted
+    assert faults.injected_counts() == {"kv.pull.drop": 2}
+
+
+def test_probability_draws_are_seed_deterministic():
+    def pattern(seed):
+        p = faults.FaultPlan(
+            [faults.FaultSpec(site="kv.pull.drop", p=0.3, times=None)],
+            seed=seed,
+        )
+        return [p.should_fire("kv.pull.drop", f"k{i}") is not None
+                for i in range(200)]
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b
+    assert a != c
+    assert 20 < sum(a) < 100  # ~30% of 200
+
+
+def test_from_json_roundtrip():
+    p = faults.FaultPlan.from_json(
+        '{"seed": 3, "faults": [{"site": "events.drop", "times": 1},'
+        ' {"site": "kv.pull.delay_ms", "delay_ms": 5, "p": 0.5}]}'
+    )
+    assert p.seed == 3 and len(p.specs) == 2
+    assert p.specs[1].delay_ms == 5
+
+
+def test_corrupt_is_deterministic():
+    plan({"site": "kv.bundle.corrupt", "times": None})
+    out1 = faults.corrupt("kv.bundle.corrupt", b"abcdef")
+    plan({"site": "kv.bundle.corrupt", "times": None})
+    out2 = faults.corrupt("kv.bundle.corrupt", b"abcdef")
+    assert out1 == out2 != b"abcdef"
+
+
+# --------------------------------------------------------------------- #
+# KV bundle CRC (header v2)
+
+
+def test_crc_rejects_corruption_and_v1_still_parses():
+    from llmd_tpu.kvtransfer.connector import (
+        KVCorruptionError,
+        pack_header,
+        pack_pages,
+        unpack_pages,
+        unpack_pages_any,
+    )
+    from llmd_tpu.kvtransfer.shipper import PullError
+
+    pages = np.random.default_rng(0).normal(
+        size=(2, 3, 2, 4, 16)
+    ).astype(np.float32)
+    blob = pack_pages(pages)  # v2: CRC-carrying
+    np.testing.assert_array_equal(unpack_pages(blob), pages)
+    # flip one payload byte mid-blob: magic/shape stay valid, CRC must
+    # catch it (this is exactly what faults.corrupt injects)
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(KVCorruptionError):
+        unpack_pages(bytes(bad))
+    with pytest.raises(PullError):  # subclass contract: policy path
+        unpack_pages_any(bytes(bad))
+    # legacy v1 (no CRC) still parses — header-versioned compatibility
+    v1 = pack_header(pages) + pages.tobytes()
+    np.testing.assert_array_equal(unpack_pages(v1), pages)
+
+
+def test_bundle_compat_v1_pin_downgrades_producer(monkeypatch):
+    """Reader-first rolling deploys: readers accept both header versions
+    but a NOT-yet-upgraded consumer rejects version 2 outright, so the
+    ``LLMD_KV_BUNDLE_COMPAT_V1`` pin lets producers stay on the version-1
+    wire format until every consumer has rolled."""
+    from llmd_tpu.kvtransfer import connector as C
+
+    pages = np.arange(2 * 1 * 2 * 4 * 8, dtype=np.float32).reshape(
+        2, 1, 2, 4, 8
+    )
+    body = pages.tobytes()
+    assert C.pack_header(pages, crc=C.payload_crc(body))[4] == 2
+    monkeypatch.setattr(C, "_COMPAT_V1", True)
+    hdr = C.pack_header(pages, crc=C.payload_crc(body))
+    assert hdr[4] == 1  # "<4sB...": byte 4 is the header version
+    np.testing.assert_array_equal(C.unpack_pages(hdr + body), pages)
+
+
+# --------------------------------------------------------------------- #
+# P/D transfer: drop / delay / corrupt all degrade to recompute with
+# byte-identical greedy streams
+
+
+def make_engine(kv_role=None, page=4, dtype="float32"):
+    cfg = EngineConfig(
+        model=tiny_model_config(dtype=dtype),
+        cache=CacheConfig(page_size=page, num_blocks=64, dtype=dtype),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=0,
+        kv_role=kv_role,
+        kv_transfer_port=0,
+        kv_local_fastpath=False,  # exercise the WIRE path the faults hit
+    )
+    return LLMEngine(cfg)
+
+
+PROMPT = [1, 5, 9, 13, 2, 8, 4, 4, 4, 4, 6, 6, 6, 6, 11, 7, 3, 2]
+
+
+def _run(eng, prompt, max_tokens, kv_transfer_params=None):
+    rid = eng.add_request(
+        list(prompt),
+        SamplingParams(temperature=0.0, max_tokens=max_tokens),
+        kv_transfer_params=kv_transfer_params,
+    )
+    outs, final = [], None
+    while eng.has_work():
+        for out in eng.step():
+            if out.request_id == rid:
+                outs.extend(out.new_token_ids)
+                if out.finished:
+                    final = out
+    return outs, final
+
+
+def _pd_params(producer):
+    _, pre = _run(
+        producer, PROMPT, max_tokens=1,
+        kv_transfer_params={"do_remote_decode": True},
+    )
+    assert pre.kv_transfer_params is not None
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if producer.kv_connector.server.registered_count >= 1:
+            break
+        time.sleep(0.02)
+    return pre.kv_transfer_params
+
+
+@pytest.mark.parametrize("spec, expect_crc", [
+    ({"site": "kv.pull.drop", "times": 1}, False),
+    ({"site": "kv.bundle.corrupt", "times": 1}, True),
+])
+def test_pull_fault_degrades_to_recompute_byte_identical(spec, expect_crc):
+    ref_tokens, _ = _run(make_engine(), PROMPT, max_tokens=8)
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        params = _pd_params(producer)
+        plan(spec)
+        toks, final = _run(consumer, PROMPT, max_tokens=8,
+                           kv_transfer_params=params)
+        # Degradation transparency: the stream is byte-identical and the
+        # request was NOT lost.
+        assert toks == ref_tokens
+        conn = consumer.kv_connector
+        assert conn.import_failures == 1
+        assert conn.recompute_fallbacks == 1
+        assert conn.transfer_failures[("fetch", "recompute")] == 1
+        assert conn.crc_failures == (1 if expect_crc else 0)
+        assert faults.injected_counts() == {spec["site"]: 1}
+        # ... and the trail reaches the production /metrics surface.
+        from llmd_tpu.serve.metrics import render_metrics
+
+        consumer._refresh_gauges()
+        page = render_metrics(consumer.stats, "tiny")
+        assert "llmd:kv_recompute_fallbacks_total" in page
+        assert 'llmd:kv_transfer_failures_total{stage="fetch"' in page
+        assert f'llmd:faults_injected_total{{site="{spec["site"]}"' in page
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_pull_delay_is_absorbed():
+    ref_tokens, _ = _run(make_engine(), PROMPT, max_tokens=6)
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        params = _pd_params(producer)
+        plan({"site": "kv.pull.delay_ms", "delay_ms": 80, "times": None})
+        toks, _ = _run(consumer, PROMPT, max_tokens=6,
+                       kv_transfer_params=params)
+        assert toks == ref_tokens
+        assert consumer.kv_connector.import_failures == 0
+        assert consumer.kv_connector.imported_requests == 1
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_pull_fault_policy_fail_surfaces():
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    consumer.kv_connector.cfg.load_failure_policy = "fail"
+    try:
+        params = _pd_params(producer)
+        plan({"site": "kv.pull.drop", "times": 1})
+        from llmd_tpu.kvtransfer.connector import KVLoadError
+
+        with pytest.raises(KVLoadError):
+            consumer.kv_connector.fetch_remote_policy(list(PROMPT), params)
+        assert consumer.kv_connector.transfer_failures[("fetch", "fail")] == 1
+        assert consumer.kv_connector.recompute_fallbacks == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+# --------------------------------------------------------------------- #
+# kvstore: injected master timeout degrades reads to misses
+
+
+def test_kvstore_get_timeout_degrades_to_miss():
+    from llmd_tpu.kvstore.client import CrossSliceStoreClient
+    from llmd_tpu.kvstore.master import MasterState, build_app as master_app
+
+    # master on a background loop (synchronous client under test)
+    loop = asyncio.new_event_loop()
+    runner_box = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            from aiohttp import web
+
+            runner = web.AppRunner(master_app(MasterState()))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runner_box["runner"] = runner
+            runner_box["port"] = site._server.sockets[0].getsockname()[1]
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while "port" not in runner_box and time.time() < deadline:
+        time.sleep(0.01)
+    url = f"http://127.0.0.1:{runner_box['port']}"
+    a = CrossSliceStoreClient(url, segment_bytes=1 << 20, heartbeat_s=5.0)
+    b = CrossSliceStoreClient(url, segment_bytes=1 << 20, heartbeat_s=5.0)
+    try:
+        assert a.put("obj", b"payload-bytes")
+        assert b.get("obj") == b"payload-bytes"  # sanity: store works
+        plan({"site": "kvstore.get.timeout", "match": "locate",
+              "times": None})
+        # Degradation: a miss (None), never an exception off the engine
+        # thread's restore path.
+        assert b.get("obj") is None
+        assert faults.injected_counts()["kvstore.get.timeout"] >= 1
+        faults.disarm()
+        assert b.get("obj") == b"payload-bytes"  # recovers immediately
+    finally:
+        a.close()
+        b.close()
+        loop.call_soon_threadsafe(loop.stop)
+
+
+# --------------------------------------------------------------------- #
+# KV events: a dropped batch forces a seq gap; the subscriber resyncs
+# and converges from subsequent traffic
+
+
+def test_events_drop_resyncs_and_converges():
+    zmq = pytest.importorskip("zmq")  # noqa: F841
+    from llmd_tpu.events.index import KVBlockIndex
+    from llmd_tpu.events.publisher import ZMQEventSink
+    from llmd_tpu.events.subscriber import KVEventSubscriber
+
+    sink = ZMQEventSink(endpoint="tcp://127.0.0.1:0", pod="pod-x:8000",
+                        flush_interval_s=0.02)
+    idx = KVBlockIndex()
+    sub = KVEventSubscriber(idx)
+
+    def score(h):
+        return idx.score([h], ["pod-x:8000"])["pod-x:8000"]
+
+    def wait_for(h, want, timeout=3.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if score(h) == want:
+                return True
+            time.sleep(0.05)
+        return score(h) == want
+
+    try:
+        sub.add_pod("pod-x:8000", sink.endpoint.replace("*", "127.0.0.1"))
+        time.sleep(0.3)  # SUB subscription propagation
+        sink.blocks_stored([b"\x01\x01"], None, [1, 2])
+        sink.flush()
+        assert wait_for("0101", 1.0)
+        # Batch 2 is lost in flight.
+        plan({"site": "events.drop", "times": 1})
+        sink.blocks_stored([b"\x02\x02"], None, [3, 4])
+        sink.flush()
+        time.sleep(0.3)
+        assert score("0202") == 0.0  # dropped, and no crash
+        # Batch 3 presents a seq gap -> the pod's view clears (0101 goes
+        # too: correctness over retention) and batch 3 applies.
+        sink.blocks_stored([b"\x03\x03"], None, [5, 6])
+        sink.flush()
+        assert wait_for("0303", 1.0)
+        assert score("0101") == 0.0
+        # Convergence: subsequent BlockStored traffic rebuilds the view.
+        sink.blocks_stored([b"\x01\x01", b"\x02\x02"], None, [1, 2, 3, 4])
+        sink.flush()
+        assert wait_for("0101", 1.0) and wait_for("0202", 1.0)
+        assert sub._thread.is_alive()
+        assert faults.injected_counts()["events.drop"] == 1
+    finally:
+        sub.close()
+        sink.close()
+
+
+# --------------------------------------------------------------------- #
+# lockstep liveness: the bounded collective raises within the budget
+
+
+def test_lockstep_bounded_wait_fails_fast():
+    from llmd_tpu.engine.runner import ModelRunner
+
+    class Stub:
+        lockstep_timeout_s = 0.25
+        _lockstep_pool = None
+        _stopped = False
+        _lockstep_warmed = True  # past the startup exemption
+        _lockstep_compile_grace = False
+
+    stub = Stub()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="lockstep .* did not complete"):
+        ModelRunner._bounded(stub, lambda: time.sleep(5), "test collective")
+    assert time.monotonic() - t0 < 2.0  # fast failure, not a 5s hang
+    assert stub._stopped  # group declared dead: no further broadcasts
+    # healthy collectives pass through (and the injected-stall site
+    # composes: an armed lockstep.sync.stall in the fn trips the wait)
+    stub2 = Stub()
+    assert ModelRunner._bounded(stub2, lambda: 42, "x") == 42
+    plan({"site": "lockstep.sync.stall", "delay_ms": 600, "times": 1})
+    stub3 = Stub()
+
+    def stalled_collective():
+        faults.delay("lockstep.sync.stall")
+        return 1
+
+    with pytest.raises(RuntimeError, match="lockstep"):
+        ModelRunner._bounded(stub3, stalled_collective, "stalled broadcast")
+
+
+def test_lockstep_bounded_wait_disabled_by_zero():
+    from llmd_tpu.engine.runner import ModelRunner
+
+    class Stub:
+        lockstep_timeout_s = 0.0
+        _lockstep_pool = None
+        _stopped = False
+        _lockstep_warmed = True
+        _lockstep_compile_grace = False
+
+    assert ModelRunner._bounded(Stub(), lambda: "ok", "x") == "ok"
+
+
+def test_lockstep_first_collective_is_startup_exempt():
+    """Cold-cache compile / weight-load skew makes the FIRST collective
+    legitimately slow: it runs unbounded; the wait arms after it."""
+    from llmd_tpu.engine.runner import ModelRunner
+
+    class Stub:
+        lockstep_timeout_s = 0.2
+        _lockstep_pool = None
+        _stopped = False
+        _lockstep_warmed = False
+        _lockstep_compile_grace = False
+
+    stub = Stub()
+    # Slower than the budget, but the startup exemption lets it finish.
+    assert ModelRunner._bounded(
+        stub, lambda: time.sleep(0.35) or "warm", "first collective"
+    ) == "warm"
+    assert stub._lockstep_warmed
+    # The SECOND slow collective is past the exemption: fails fast.
+    with pytest.raises(RuntimeError, match="lockstep"):
+        ModelRunner._bounded(stub, lambda: time.sleep(5), "second")
+
+
+def test_lockstep_compile_grace_allows_one_slow_wait():
+    """Mid-serving, the first dispatch of a shape family jit-compiles on
+    every host, and per-host persistent-cache skew can legitimately
+    exceed the liveness budget. The grace flag a new family sets lets
+    the NEXT wait run unbounded once; then the bound re-arms."""
+    from llmd_tpu.engine.runner import ModelRunner
+
+    class Stub:
+        lockstep_timeout_s = 0.2
+        _lockstep_pool = None
+        _stopped = False
+        _lockstep_warmed = True
+        _lockstep_compile_grace = True  # previous dispatch opened a family
+
+    stub = Stub()
+    assert ModelRunner._bounded(
+        stub, lambda: time.sleep(0.35) or "compiled", "post-compile wait"
+    ) == "compiled"
+    assert not stub._lockstep_compile_grace  # one-shot
+    with pytest.raises(RuntimeError, match="lockstep"):
+        ModelRunner._bounded(stub, lambda: time.sleep(5), "re-armed wait")
+
+
+# --------------------------------------------------------------------- #
+# serving layer: watchdog, deadlines, readiness (async)
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _tiny_serve_engine():
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+    )
+    return LLMEngine(cfg)
+
+
+@pytest.mark.anyio
+async def test_engine_step_stall_watchdog_fails_streams_and_health():
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    aeng = AsyncEngine(_tiny_serve_engine(), watchdog_s=0.3)
+    client = TestClient(TestServer(
+        build_app(aeng, ByteTokenizer(), "tiny", 128)
+    ))
+    await client.start_server()
+    try:
+        # warm: one request through, /health + /ready green
+        r = await client.post("/v1/completions", json={
+            "prompt": "warm", "max_tokens": 2, "temperature": 0.0})
+        assert r.status == 200
+        assert (await client.get("/health")).status == 200
+        assert (await client.get("/ready")).status == 200
+
+        plan({"site": "engine.step.stall", "delay_ms": 1500, "times": 1})
+        t0 = time.monotonic()
+        r = await client.post("/v1/completions", json={
+            "prompt": "wedge", "max_tokens": 4, "temperature": 0.0,
+            "stream": True})
+        body = ""
+        async for line in r.content:
+            body += line.decode()
+        elapsed = time.monotonic() - t0
+        # Terminal error frame within the budget, NOT a 1.5s hang.
+        assert "watchdog" in body and "[DONE]" in body
+        assert elapsed < 1.3, f"stream held {elapsed:.2f}s past the budget"
+        # Liveness + readiness both 503 while wedged.
+        assert (await client.get("/health")).status == 503
+        assert (await client.get("/ready")).status == 503
+        # After the stall clears, the engine recovers and the counter
+        # stays on /metrics.
+        await asyncio.sleep(1.4)
+        assert (await client.get("/health")).status == 200
+        metrics = await (await client.get("/metrics")).text()
+        assert "llmd:engine_watchdog_stalls_total" in metrics
+        line = [ln for ln in metrics.splitlines()
+                if ln.startswith("llmd:engine_watchdog_stalls_total")][0]
+        assert float(line.rsplit(None, 1)[1]) >= 1
+    finally:
+        await client.close()
+
+
+@pytest.mark.anyio
+async def test_request_deadline_maps_to_504():
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    aeng = AsyncEngine(_tiny_serve_engine())
+    client = TestClient(TestServer(
+        build_app(aeng, ByteTokenizer(), "tiny", 128)
+    ))
+    await client.start_server()
+    try:
+        plan({"site": "engine.step.stall", "delay_ms": 1200, "times": 1})
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "slow", "max_tokens": 4, "temperature": 0.0},
+            headers={"x-request-deadline-s": "0.25"},
+        )
+        assert r.status == 504
+        body = await r.json()
+        assert "deadline" in body["error"]["message"]
+    finally:
+        await client.close()
+
+
+@pytest.mark.anyio
+async def test_deadline_bounds_remote_kv_fetch():
+    """The deadline covers the P/D fetch leg too: a producer that never
+    registers its chunks must not hold the caller for the shipper's full
+    pull-wait budget (tens of seconds) before the 504."""
+    from llmd_tpu.kvtransfer.shipper import ShipperServer
+    from llmd_tpu.serve.async_engine import AsyncEngine, DeadlineExceeded
+
+    eng = make_engine(kv_role="kv_consumer")
+    aeng = AsyncEngine(eng)
+    aeng.start(asyncio.get_event_loop())
+    srv = ShipperServer(port=0)  # empty: every pull waits
+    params = {
+        "remote_host": "127.0.0.1", "remote_port": srv.port,
+        "remote_key": "never-registered", "num_full_pages": 4,
+        "page_size": 4, "chunk_pages": 8, "num_chunks": 1,
+        "start_page": 0,
+    }
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded, match="remote KV fetch"):
+            async for _ in aeng.generate(
+                "rid-fetch-deadline", list(PROMPT),
+                SamplingParams(temperature=0.0, max_tokens=2),
+                kv_transfer_params=params, deadline_s=0.3,
+            ):
+                pass
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        aeng.stop()
+        srv.close()
+        eng.kv_connector.close()
+
+
+@pytest.mark.anyio
+async def test_engine_ready_flips_on_pause_and_drain():
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    aeng = AsyncEngine(_tiny_serve_engine())
+    client = TestClient(TestServer(
+        build_app(aeng, ByteTokenizer(), "tiny", 128)
+    ))
+    await client.start_server()
+    try:
+        assert (await client.get("/ready")).status == 200
+        aeng.pause()
+        assert (await client.get("/ready")).status == 503
+        assert (await client.get("/health")).status == 200  # alive
+        aeng.resume()
+        assert (await client.get("/ready")).status == 200
+        # drain flips readiness FIRST (gateway stops routing), /health
+        # stays green throughout.
+        assert await aeng.drain(timeout_s=5)
+        assert aeng.draining
+        assert (await client.get("/ready")).status == 503
+        aeng.resume()
+        assert (await client.get("/ready")).status == 200
+    finally:
+        await client.close()
+
+
+# --------------------------------------------------------------------- #
+# EPP circuit breaker semantics (unit)
+
+
+def test_circuit_breaker_threshold_cooldown_halfopen(monkeypatch):
+    from llmd_tpu.epp import breaker as breaker_mod
+
+    now = [1000.0]
+    monkeypatch.setattr(breaker_mod.time, "monotonic", lambda: now[0])
+    b = breaker_mod.EndpointCircuitBreaker(
+        failure_threshold=2, cooldown_s=10.0
+    )
+    b.record_failure("a")
+    assert not b.is_open("a")          # below threshold
+    b.record_failure("a")
+    assert b.is_open("a")              # 2 consecutive -> open
+    assert b.trips_total == 1
+    assert b.open_endpoints() == ["a"]
+    now[0] += 11
+    assert not b.is_open("a")          # cooldown elapsed: half-open probe
+    b.record_failure("a")
+    assert b.is_open("a")              # one probe failure re-opens at once
+    now[0] += 11
+    b.record_success("a")
+    assert not b.is_open("a")
+    b.record_failure("a")
+    assert not b.is_open("a")          # success fully reset the count
+    b.record_failure("b")
+    b.forget("b")
+    b.record_failure("b")
+    assert not b.is_open("b")          # forget() cleared breaker state
+
+
+# --------------------------------------------------------------------- #
+# EPP: refuse -> re-pick + breaker; scrape-fail -> unhealthy; all
+# unhealthy -> fail open; /readyz flips before drain
+
+
+def _engine_app():
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    return build_app(
+        AsyncEngine(_tiny_serve_engine()), ByteTokenizer(), "tiny", 128
+    )
+
+
+@pytest.fixture
+async def stack():
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+    from llmd_tpu.epp.config import (
+        DEFAULT_CONFIG,
+        build_flow_control,
+        build_scheduler,
+    )
+    from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+    from llmd_tpu.epp.server import Router
+    from llmd_tpu.epp.types import Endpoint
+
+    servers = []
+    for _ in range(2):
+        s = TestServer(_engine_app())
+        await s.start_server()
+        servers.append(s)
+    store = EndpointStore()
+    for s in servers:
+        store.upsert(Endpoint(
+            address=f"{s.host}:{s.port}",
+            labels={"llm-d.ai/engine-type": "llmd"},
+        ))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(DEFAULT_CONFIG),
+        flow_control=build_flow_control(DEFAULT_CONFIG),
+        collector=MetricsCollector(store, interval_s=30.0),
+        retry_backoff_s=0.01,
+        # threshold 1 so ONE refused request deterministically trips the
+        # breaker (prefix affinity steers follow-ups to the healthy
+        # replica, so the default threshold of 2 would need the picker
+        # to choose the refusing endpoint twice — scheduling-dependent).
+        breaker=EndpointCircuitBreaker(failure_threshold=1, cooldown_s=30.0),
+    )
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    yield rc, router, servers
+    await rc.close()
+    for s in servers:
+        await s.close()
+
+
+@pytest.mark.anyio
+async def test_endpoint_refuse_repicks_byte_identical(stack):
+    rc, router, servers = stack
+    body = {"prompt": "refuse matrix", "max_tokens": 4, "temperature": 0.0}
+    baseline = (await (await rc.post("/v1/completions", json=body)).json())
+    addr0 = f"{servers[0].host}:{servers[0].port}"
+    plan({"site": "epp.endpoint.refuse", "match": addr0, "times": None})
+    for _ in range(4):
+        r = await rc.post("/v1/completions", json=body)
+        assert r.status == 200
+        assert r.headers["x-llm-d-endpoint"] != addr0
+        data = await r.json()
+        # Both engines share seed 0: the re-picked replica's greedy
+        # stream is byte-identical to the no-fault answer.
+        assert data["choices"][0]["text"] == baseline["choices"][0]["text"]
+    assert router.metrics.request_retries >= 1
+    # The refusal tripped the breaker (request-outcome signal, faster
+    # than the 3-scrape health window) and it shows on /metrics.
+    assert router.breaker.is_open(addr0)
+    metrics = await (await rc.get("/metrics")).text()
+    assert f'llm_d_epp_circuit_open{{endpoint="{addr0}"}} 1' in metrics
+    assert "llm_d_epp_request_retries_total" in metrics
+
+
+@pytest.mark.anyio
+async def test_scrape_fail_marks_unhealthy_then_pool_fails_open(stack):
+    rc, router, servers = stack
+    pods = router.store.list()
+    addr0 = pods[0].address
+    plan({"site": "epp.scrape.fail", "match": addr0, "times": None})
+    # Loop-until-unhealthy rather than exactly-N scrapes: a pre-armed
+    # in-flight background scrape may land a success after our first
+    # injected failure and reset the consecutive count.
+    deadline = time.monotonic() + 10
+    while router.store.get(addr0).healthy and time.monotonic() < deadline:
+        await router.collector.scrape_once()
+    assert not router.store.get(addr0).healthy
+    assert router.store.get(pods[1].address).healthy
+    # Now the WHOLE pool goes unhealthy: the healthy-filter must fail
+    # open to the full pool (never 0 candidates) and count the event.
+    plan({"site": "epp.scrape.fail", "times": None})
+    deadline = time.monotonic() + 10
+    while (
+        any(p.healthy for p in router.store.list())
+        and time.monotonic() < deadline
+    ):
+        await router.collector.scrape_once()
+    assert all(not p.healthy for p in router.store.list())
+    r = await rc.post("/v1/completions", json={
+        "prompt": "fail open", "max_tokens": 2, "temperature": 0.0})
+    assert r.status == 200
+    metrics = await (await rc.get("/metrics")).text()
+    line = [ln for ln in metrics.splitlines()
+            if ln.startswith("llm_d_epp_fail_open_total")][0]
+    assert float(line.rsplit(None, 1)[1]) >= 1
+
+
+@pytest.mark.anyio
+async def test_router_readyz_flips_before_drain(stack):
+    rc, router, _ = stack
+    assert (await rc.get("/readyz")).status == 200
+    assert (await rc.get("/healthz")).status == 200
+    router.begin_shutdown()
+    # Readiness drops (gateway stops routing) while liveness stays up.
+    assert (await rc.get("/readyz")).status == 503
+    assert (await rc.get("/healthz")).status == 200
+
+
+@pytest.mark.anyio
+async def test_final_attempt_5xx_still_counts_toward_breaker():
+    """A replica answering 500 on every request must trip the circuit
+    even with retries disabled (max_schedule_attempts=1): the last
+    attempt streams the 5xx through to the client, but the breaker
+    still records the failure — otherwise a reachable-but-failing pod
+    (scrape health green) keeps absorbing full traffic forever."""
+    from aiohttp import web
+
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+    from llmd_tpu.epp.config import (
+        DEFAULT_CONFIG,
+        build_flow_control,
+        build_scheduler,
+    )
+    from llmd_tpu.epp.datalayer import EndpointStore
+    from llmd_tpu.epp.server import Router
+    from llmd_tpu.epp.types import Endpoint
+
+    async def _always_500(request):
+        return web.json_response({"error": "boom"}, status=500)
+
+    app = web.Application()
+    app.router.add_post("/v1/completions", _always_500)
+    upstream = TestServer(app)
+    await upstream.start_server()
+    addr = f"{upstream.host}:{upstream.port}"
+    store = EndpointStore()
+    store.upsert(Endpoint(address=addr, labels={"llm-d.ai/engine-type": "llmd"}))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(DEFAULT_CONFIG),
+        flow_control=build_flow_control(DEFAULT_CONFIG),
+        max_schedule_attempts=1,
+        breaker=EndpointCircuitBreaker(failure_threshold=2, cooldown_s=30.0),
+    )
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    try:
+        for _ in range(2):
+            r = await rc.post(
+                "/v1/completions", json={"prompt": "x", "max_tokens": 1}
+            )
+            assert r.status == 500  # streamed through, not retried
+        assert router.breaker.is_open(addr)
+        assert router.metrics.proxy_errors == 2
+    finally:
+        await rc.close()
+        await upstream.close()
+
+
+def test_router_sigterm_flips_readyz_while_socket_serves(tmp_path):
+    """k8s graceful shutdown, end to end: SIGTERM must flip /readyz to
+    503 WHILE the listen socket is still serving (the cleanup_ctx
+    teardown runs only after aiohttp closes the socket, where the flip
+    is invisible to the gateway's probe — it would see
+    connection-refused, not the graceful 503)."""
+    import json
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import urllib.error
+    import urllib.request
+
+    ep_file = tmp_path / "endpoints.json"
+    ep_file.write_text(json.dumps({"endpoints": []}))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, LLMD_EPP_DRAIN_GRACE_S="3")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "llmd_tpu.epp",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--endpoints-file", str(ep_file)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    url = f"http://127.0.0.1:{port}/readyz"
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                assert urllib.request.urlopen(url, timeout=1).status == 200
+                break
+            except (OSError, AssertionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        proc.send_signal(signal.SIGTERM)
+        saw_503 = False
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(url, timeout=1)
+            except urllib.error.HTTPError as e:
+                saw_503 = e.code == 503
+                break
+            except OSError:
+                break  # socket already closed — the regression
+            time.sleep(0.1)
+        assert saw_503, "/readyz did not serve 503 during the drain grace"
+        assert proc.wait(timeout=20) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()

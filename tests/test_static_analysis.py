@@ -718,6 +718,106 @@ class TestEnvvars:
 # the standing gate + CLI surface
 
 
+class TestBroadExcept:
+    """faults discipline (PR 7): broad excepts on the serving stack must
+    re-raise, leave a failure-counter trail, or carry a pragma."""
+
+    def test_silent_swallow_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            "kvtransfer/bad.py": """
+                import logging
+
+                def stage(x):
+                    try:
+                        return x.download()
+                    except Exception:
+                        logging.getLogger(__name__).exception("oops")
+            """,
+        }, ["broad-except"])
+        assert codes(fs) == {"FD001"}
+
+    def test_bare_except_and_tuple_forms_fire(self, tmp_path):
+        fs = check(tmp_path, {
+            "serve/bad.py": """
+                def a(x):
+                    try:
+                        return x()
+                    except:
+                        pass
+
+                def b(x):
+                    try:
+                        return x()
+                    except (ValueError, Exception):
+                        pass
+            """,
+        }, ["broad-except"])
+        assert [f.code for f in fs] == ["FD001", "FD001"]
+
+    def test_reraise_counter_and_pragma_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "epp/good.py": """
+                class C:
+                    def reraises(self, x):
+                        try:
+                            return x()
+                        except Exception:
+                            self.cleanup()
+                            raise
+
+                    def counted(self, x):
+                        try:
+                            return x()
+                        except Exception:
+                            self.pull_failures += 1
+                            return None
+
+                    def counted_subscript(self, x):
+                        try:
+                            return x()
+                        except Exception:
+                            self.transfer_failures[("a", "b")] += 1
+                            return None
+
+                    def blessed(self, x):
+                        try:
+                            return x()
+                        # llmd: allow(broad-except) -- best-effort test path
+                        except Exception:
+                            return None
+            """,
+        }, ["broad-except"])
+        assert fs == []
+
+    def test_named_tuples_and_out_of_scope_dirs_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "kvstore/good.py": """
+                def f(x):
+                    try:
+                        return x()
+                    except (ValueError, OSError, TimeoutError):
+                        return None
+            """,
+            # autoscale/ is NOT a serving-stack scope dir
+            "autoscale/fine.py": """
+                def f(x):
+                    try:
+                        return x()
+                    except Exception:
+                        return None
+            """,
+        }, ["broad-except"])
+        assert fs == []
+
+    def test_real_serving_tree_is_clean(self):
+        findings, _ = run_analysis(REPO, [
+            str(REPO / "llmd_tpu/serve"), str(REPO / "llmd_tpu/engine"),
+            str(REPO / "llmd_tpu/kvtransfer"), str(REPO / "llmd_tpu/epp"),
+            str(REPO / "llmd_tpu/kvstore"),
+        ], ["broad-except"])
+        assert findings == []
+
+
 class TestTreeGate:
     def test_tree_is_clean(self):
         """THE gate: the repo's own invariants hold. A finding here means
@@ -753,7 +853,7 @@ class TestTreeGate:
         assert out.returncode == 0
         for rule in (
             "host-sync", "trace-discipline", "lockstep", "metrics-parity",
-            "config-parity", "envvars", "pragma",
+            "config-parity", "envvars", "broad-except", "pragma",
         ):
             assert rule in out.stdout
 
