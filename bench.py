@@ -57,6 +57,14 @@ extras (north-star shapes, BASELINE.json):
                     kv.pull.drop FaultPlan vs the clean run (target
                     ratio >= 0.9, recorded), with the recompute
                     fallback proven engaged and streams byte-identical.
+  fleet_soak      — fleet-scale chaos-soak CPU-sim part (fleet-soak.md):
+                    the replica-kill + steady scenarios over the REAL
+                    EPP/flow-control/breaker/autoscale stack on a
+                    virtual-time loop at reduced scale — zero requests
+                    lost to mid-stream crashes, bounded time-to-reroute,
+                    breaker-open visible, byte-identical scoreboards
+                    across two runs (the full >=10^4-QPS matrix runs in
+                    the CI `soak` job).
 """
 
 from __future__ import annotations
@@ -902,7 +910,65 @@ def _run_part(part: str):
         return bench_ragged_step()
     if part == "fault_degrade":
         return bench_fault_degrade()
+    if part == "fleet_soak":
+        return bench_fleet_soak()
     raise KeyError(part)
+
+
+def bench_fleet_soak():
+    """Fleet-scale chaos-soak CPU-sim part (fleet-soak.md): the
+    replica-kill and steady scenarios from the seeded matrix at reduced
+    scale (~2k QPS, the full >=10^4-QPS matrix runs in the CI `soak`
+    job), recording the fleet-level recovery scoreboard headline: zero
+    requests lost to the mid-stream crashes, bounded time-to-reroute,
+    breaker-open visible, p99 TTFT/TPOT bands — and the determinism
+    contract, proven by running the chaos scenario TWICE and comparing
+    scoreboard bytes. No chip, no jax: the simulator drives the real
+    EPP/flow-control/breaker/predictor/autoscale code on a virtual-time
+    event loop, so ~2 s of fleet time costs ~1 s of wall clock."""
+    from llmd_tpu.fleetsim.scenarios import SCENARIOS
+    from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+
+    scale = 0.2
+    t0 = time.monotonic()
+    kill_a = SCENARIOS["replica_kill"].build(0, scale).run()
+    kill_wall_s = time.monotonic() - t0
+    kill_b = SCENARIOS["replica_kill"].build(0, scale).run()
+    steady = SCENARIOS["steady"].build(0, scale).run()
+    return {
+        "qps_scale": scale,
+        "deterministic": (
+            to_canonical_json(kill_a) == to_canonical_json(kill_b)
+        ),
+        "zero_lost": (
+            kill_a["requests"]["lost"] == 0
+            and kill_a["requests"]["hung"] == 0
+        ),
+        "invariants_ok": bool(kill_a["ok"] and steady["ok"]),
+        "replica_kill": {
+            "requests": kill_a["trace"]["requests"],
+            "offered_qps": round(kill_a["trace"]["offered_qps"], 1),
+            "kills": len(kill_a["reroute"]["kills"]),
+            "breaker_trips": kill_a["breaker"]["trips_total"],
+            "time_to_reroute_s": round(
+                kill_a["reroute"]["time_to_reroute_s"], 4
+            ),
+            "p99_ttft_ms": round(kill_a["latency_ms"]["ttft"]["p99"], 2),
+            "stream_interrupted": kill_a["requests"]["outcomes"].get(
+                "stream-interrupted", 0
+            ),
+            "wall_s": round(kill_wall_s, 2),
+        },
+        "steady": {
+            "requests": steady["trace"]["requests"],
+            "offered_qps": round(steady["trace"]["offered_qps"], 1),
+            "p99_ttft_ms": round(steady["latency_ms"]["ttft"]["p99"], 2),
+            "p99_tpot_ms": round(steady["latency_ms"]["tpot"]["p99"], 2),
+            "jain_fairness": round(
+                steady["fairness"]["jain_completed"], 4
+            ),
+        },
+    }
 
 
 def bench_fault_degrade():
@@ -1766,7 +1832,7 @@ def _part_in_subprocess(part: str, retries: int = 0, timeout: float = 1800):
 # runnable in CI / under --skip-chip without a device or the tunnel.
 _CPU_PARTS = frozenset({
     "dbo", "async_step", "spec_decode", "spec_window", "unified_step",
-    "ragged_step", "fault_degrade",
+    "ragged_step", "fault_degrade", "fleet_soak",
 })
 
 # Every part main() can dispatch, in run order (also the validation set
@@ -1778,7 +1844,7 @@ _CPU_PARTS = frozenset({
 # driver's kill) lands, the summary already holds everything cheaper.
 _ALL_PARTS = (
     "ragged_step", "unified_step", "async_step", "spec_decode",
-    "spec_window", "dbo", "fault_degrade",
+    "spec_window", "dbo", "fault_degrade", "fleet_soak",
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
     "pd", "pd_int8", "pd_kvint8", "pd_local", "pd_cached", "pd_adaptive",
@@ -1915,6 +1981,7 @@ def main() -> None:
         "spec_window": (set_key("spec_window"), None),
         "dbo": (set_key("dbo"), None),
         "fault_degrade": (set_key("fault_degrade"), None),
+        "fleet_soak": (set_key("fleet_soak"), None),
         "rtt": (set_key("dispatch_rtt_ms"), None),
         "env": (set_key("env"), None),
         # The headline part now also carries the MFU/roofline context:

@@ -1,6 +1,7 @@
 """Checker registry: importing this package registers every checker."""
 
 from llmd_tpu.analysis.checkers import (  # noqa: F401
+    clock_discipline,
     config_parity,
     envvars,
     faults_discipline,

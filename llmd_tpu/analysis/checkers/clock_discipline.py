@@ -1,0 +1,102 @@
+"""direct-clock: the control stack reads time through the clock seam.
+
+The routing/control plane (``epp/``, ``autoscale/``, ``predictor/``)
+is driven by the fleet simulator (``fleetsim/``, included in scope)
+through a virtual-time event loop: every time-dependent decision —
+breaker cooldowns, flow-control TTLs and EDF deadlines, scrape
+freshness, session TTLs, WVA retention windows — must read
+:func:`llmd_tpu.clock.monotonic` (or an injected clock callable), never
+``time.time()`` / ``time.monotonic()`` directly. One stray direct call
+silently splits the plane between real and simulated time: the soak
+still *runs*, but cooldowns measured on the wall clock while sleeps run
+on virtual time makes recovery bounds meaningless and the scoreboard
+nondeterministic — a bug class invisible to runtime tests, which is why
+it is pinned statically.
+
+Flagged inside the scope dirs (call or bare reference, any import
+alias):
+
+- ``time.time`` / ``time.monotonic`` attribute access;
+- ``from time import time`` / ``from time import monotonic``.
+
+``time.sleep`` and friends stay legal — blocking is visible behavior,
+not a clock read (and async code paths use ``asyncio.sleep``, which the
+simulator virtualizes via the event loop). Genuinely wall-clock reads
+(none today) take ``# llmd: allow(direct-clock) -- <reason>``.
+
+Rule: CK001.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+
+SCOPE_PARTS = frozenset({"epp", "autoscale", "predictor", "fleetsim"})
+
+_CLOCK_ATTRS = frozenset({"time", "monotonic"})
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf) -> None:
+        self.sf = sf
+        self.findings: list[Finding] = []
+        # Local names bound to the stdlib time module ("time", "_time"...).
+        self.time_aliases: set[str] = set()
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "direct-clock", "CK001", self.sf.path, node.lineno,
+            f"{what} bypasses the clock seam: read "
+            "llmd_tpu.clock.monotonic() (or an injected clock callable) "
+            "so the fleet simulator can drive this code on virtual time, "
+            "or pragma `# llmd: allow(direct-clock) -- <reason>`",
+        ))
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "time":
+                self.time_aliases.add(alias.asname or "time")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name in _CLOCK_ATTRS:
+                    self._flag(
+                        node, f"`from time import {alias.name}`"
+                    )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id in self.time_aliases
+            and node.attr in _CLOCK_ATTRS
+        ):
+            self._flag(node, f"`{node.value.id}.{node.attr}`")
+        self.generic_visit(node)
+
+
+@register
+class ClockDisciplineChecker(Checker):
+    name = "direct-clock"
+    description = (
+        "epp//autoscale//predictor//fleetsim/ read time via the "
+        "llmd_tpu.clock seam (simulator-drivable), never time.time()/"
+        "time.monotonic() directly"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in repo.files:
+            if not sf.is_python or sf.tree is None:
+                continue
+            if not SCOPE_PARTS.intersection(Path(sf.path).parts):
+                continue
+            v = _Visitor(sf)
+            v.visit(sf.tree)
+            findings.extend(v.findings)
+        return findings

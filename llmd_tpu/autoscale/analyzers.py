@@ -22,7 +22,15 @@ class SaturationPercentAnalyzer:
     capacity < kv_spare_trigger (0.10) OR average spare queue capacity <
     queue_spare_trigger (3). Scale-down is safe only when >= 2 replicas are
     non-saturated and a simulated N/(N-1) load redistribution still leaves
-    headroom. All scaling is blocked while any variant is transitioning
+    headroom — AND that condition has held for ``down_stabilization_cycles``
+    consecutive cycles: queue depth polled at one instant is noisy
+    (momentarily-drained queues near a load peak read as spare capacity),
+    and acting on a single reading saw-tooths the fleet around rising load
+    — free a replica, rebuild the queue, scale it back. The fleet soak's
+    diurnal scenario exposed exactly that oscillation and gates it
+    (``direction_flips``); the stabilization window is the HPA-style fix.
+    Any cycle that is not scale-down-eligible (including scale-up) resets
+    the streak. All scaling is blocked while any variant is transitioning
     (desired != current).
     """
 
@@ -32,11 +40,14 @@ class SaturationPercentAnalyzer:
         queue_threshold: float = 5.0,
         kv_spare_trigger: float = 0.10,
         queue_spare_trigger: float = 3.0,
+        down_stabilization_cycles: int = 3,
     ) -> None:
         self.kv_threshold = kv_threshold
         self.queue_threshold = queue_threshold
         self.kv_spare_trigger = kv_spare_trigger
         self.queue_spare_trigger = queue_spare_trigger
+        self.down_stabilization_cycles = down_stabilization_cycles
+        self._down_streak = 0
 
     def saturated(self, r: ReplicaMetrics) -> bool:
         return r.kv_usage >= self.kv_threshold or r.queue_len >= self.queue_threshold
@@ -45,12 +56,18 @@ class SaturationPercentAnalyzer:
         sig = CapacitySignal(model_id=snap.model_id, unit="replicas")
         for variant, desired in snap.desired.items():
             if desired != snap.current_count(variant):
+                # Not scale-down-eligible, so the streak resets like any
+                # other ineligible cycle — a stale streak carried across
+                # a transition window would let a single momentarily-idle
+                # reading free a replica the instant the window closes.
                 sig.blocked = True
+                self._down_streak = 0
                 return sig
         ready = [r for r in snap.replicas if r.ready]
         if not ready:
             # Nothing running: demand exists iff the EPP queue is non-empty
             # (scale-from-zero also covers this on its fast path).
+            self._down_streak = 0
             sig.required = 1.0 if snap.epp_queue_size > 0 else 0.0
             return sig
 
@@ -63,9 +80,11 @@ class SaturationPercentAnalyzer:
         sig.priority = 1.0 - avg_spare_kv / max(self.kv_threshold, 1e-9)
 
         if avg_spare_kv < self.kv_spare_trigger or avg_spare_queue < self.queue_spare_trigger:
+            self._down_streak = 0
             sig.required = 1.0
             return sig
 
+        down_eligible = False
         non_saturated = [r for r in ready if not self.saturated(r)]
         n = len(ready)
         if len(non_saturated) >= 2 and n >= 2:
@@ -76,7 +95,14 @@ class SaturationPercentAnalyzer:
                 redistributed_kv <= self.kv_threshold - self.kv_spare_trigger
                 and redistributed_q <= self.queue_threshold - self.queue_spare_trigger
             ):
+                down_eligible = True
+        if down_eligible:
+            self._down_streak += 1
+            if self._down_streak >= self.down_stabilization_cycles:
+                self._down_streak = 0
                 sig.spare = 1.0
+        else:
+            self._down_streak = 0
         return sig
 
 

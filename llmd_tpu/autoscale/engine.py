@@ -13,10 +13,11 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import time
 
 import aiohttp
 from aiohttp import web
+
+from llmd_tpu import clock
 
 from llmd_tpu.autoscale.analyzers import (
     SaturationPercentAnalyzer,
@@ -90,7 +91,7 @@ class RouterCollector:
         """None on router-scrape failure: the engine must skip the cycle
         rather than treat an unreachable router as an idle pool (acting on
         an empty snapshot would tear down a healthy loaded fleet)."""
-        now = time.monotonic()
+        now = clock.monotonic()
         snap = PoolSnapshot(model_id=self.model_id)
         try:
             router_metrics = parse_prometheus(

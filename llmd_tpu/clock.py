@@ -1,0 +1,55 @@
+"""The routing/control stack's monotonic-clock seam.
+
+Every time-dependent decision in the EPP / autoscaler / predictor plane
+(breaker cooldowns, flow-control TTLs and EDF deadlines, scrape
+freshness, session-affinity TTLs, WVA retention windows) reads the
+clock through :func:`monotonic` instead of calling ``time.monotonic()``
+directly. In production the seam is a one-attribute indirection over
+``time.monotonic``; under the fleet simulator
+(:mod:`llmd_tpu.fleetsim`) the simulator installs its virtual-time
+event loop's clock, so minutes of fleet time elapse in CI milliseconds
+and the same trace + seed replays to a byte-identical scoreboard.
+
+The discipline is machine-checked: the ``direct-clock`` static-analysis
+rule (CK001) flags any ``time.time()`` / ``time.monotonic()`` reference
+inside ``epp/``, ``autoscale/``, ``predictor/`` or ``fleetsim/`` —
+a direct call there silently splits the control plane between real and
+simulated time, which is exactly the bug class that makes a soak
+nondeterministic.
+
+The seam is process-global on purpose: the control stack runs on one
+event loop, and the simulator owns the whole process while a scenario
+runs (it restores the real clock in a ``finally``). Engine/device code
+does NOT route through this seam — wall-clock there measures real
+hardware, which a simulator must never fake.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable
+
+_REAL: Callable[[], float] = _time.monotonic
+_impl: Callable[[], float] = _REAL
+
+
+def monotonic() -> float:
+    """Seconds on the installed monotonic clock (real by default)."""
+    return _impl()
+
+
+def install(fn: Callable[[], float]) -> None:
+    """Install a clock source (the fleet simulator's virtual time)."""
+    global _impl
+    _impl = fn
+
+
+def reset() -> None:
+    """Restore the real ``time.monotonic`` clock."""
+    global _impl
+    _impl = _REAL
+
+
+def installed() -> bool:
+    """True when a non-real clock source is active."""
+    return _impl is not _REAL

@@ -1,4 +1,22 @@
-"""Engine: continuous batching, paged KV cache, model runner, sampling."""
+"""Engine: continuous batching, paged KV cache, model runner, sampling.
 
-from llmd_tpu.engine.engine import LLMEngine  # noqa: F401
-from llmd_tpu.engine.request import Request, SamplingParams  # noqa: F401
+Exports resolve lazily (PEP 562): LLMEngine pulls jax at import, but
+accelerator-free consumers — the EPP's precise-prefix scorer reaches
+``llmd_tpu.engine.kv_cache.page_hashes_for_tokens`` (pure stdlib), and
+the fleet simulator imports the EPP config that registers it — must be
+able to touch the package without a jax install.
+"""
+
+__all__ = ["LLMEngine", "Request", "SamplingParams"]
+
+
+def __getattr__(name):
+    if name == "LLMEngine":
+        from llmd_tpu.engine.engine import LLMEngine
+
+        return LLMEngine
+    if name in ("Request", "SamplingParams"):
+        from llmd_tpu.engine import request
+
+        return getattr(request, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

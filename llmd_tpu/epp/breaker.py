@@ -7,60 +7,145 @@ connection-refused. Request outcomes are a faster signal: the proxy leg
 feeds every connect-refused/5xx into this breaker, which OPENS the
 endpoint after ``failure_threshold`` consecutive failures (default 2 —
 strictly faster than the 3-scrape window even if every scrape also
-fails) and releases it after ``cooldown_s`` into a half-open probe: the
-next request may try it, one more failure re-opens it immediately (the
-consecutive count survives the cooldown), one success resets it fully.
+fails) and releases it after ``cooldown_s`` into a half-open probe.
+
+Half-open admits exactly ONE probe, and the grant is claimed at
+DISPATCH time, not filter time: schedule-time ``is_open()`` is
+non-consuming (a half-open endpoint reads as a candidate until someone
+actually routes to it — filtering a candidate in and then scoring the
+request onto a different pod must not burn the probe and lock the
+endpoint out for another cooldown), while ``take_probe()`` — called by
+the proxy leg for the pod it is about to send to — claims the grant.
+The first ``take_probe()`` after the cooldown elapses wins; every
+other caller loses the race, and ``is_open()`` reads True for everyone
+while that probe is in flight — so a burst of concurrent requests
+arriving at cooldown expiry cannot stampede a recovering replica, and
+two concurrent probes can neither double-close nor double-trip the
+circuit. A probe failure re-opens immediately (the consecutive count
+survives the cooldown); a success resets fully. A probe that never
+resolves (its caller died) expires after another ``cooldown_s`` and
+the next ``take_probe()`` wins a fresh grant — an unresolved grant
+must not lock an endpoint out forever.
+
+Thresholds default from the environment so a chaos soak can sweep them
+without code changes: ``LLMD_EPP_BREAKER_THRESHOLD`` (consecutive
+failures to open, default 2) and ``LLMD_EPP_BREAKER_COOLDOWN_S``
+(open→half-open cooldown seconds, default 10).
 
 State is address-keyed and time-based only — no background task, safe
-on the router's single event loop.
+on the router's single event loop. Time comes from an injectable
+``clock`` (default :func:`llmd_tpu.clock.monotonic`) so the fleet
+simulator can drive cooldowns in virtual time.
 """
 
 from __future__ import annotations
 
-import time
+import os
+from typing import Callable
+
+from llmd_tpu import clock as _clock
+
+
+def _env_threshold() -> int:
+    return int(os.environ.get("LLMD_EPP_BREAKER_THRESHOLD", "2"))
+
+
+def _env_cooldown_s() -> float:
+    return float(os.environ.get("LLMD_EPP_BREAKER_COOLDOWN_S", "10.0"))
 
 
 class EndpointCircuitBreaker:
     def __init__(
-        self, failure_threshold: int = 2, cooldown_s: float = 10.0
+        self,
+        failure_threshold: int | None = None,
+        cooldown_s: float | None = None,
+        clock: Callable[[], float] | None = None,
     ) -> None:
-        self.failure_threshold = failure_threshold
-        self.cooldown_s = cooldown_s
+        self.failure_threshold = (
+            _env_threshold() if failure_threshold is None else failure_threshold
+        )
+        self.cooldown_s = _env_cooldown_s() if cooldown_s is None else cooldown_s
+        self._clock = clock or _clock.monotonic
         self._consecutive: dict[str, int] = {}
         self._open_until: dict[str, float] = {}
+        # address -> sim/real time the outstanding half-open probe was
+        # granted; present while exactly one probe is in flight.
+        self._probe_granted: dict[str, float] = {}
         self.trips_total = 0
 
     def record_failure(self, address: str) -> None:
         n = self._consecutive.get(address, 0) + 1
         self._consecutive[address] = n
+        # A failure resolves any outstanding half-open probe.
+        self._probe_granted.pop(address, None)
+        now = self._clock()
+        until = self._open_until.get(address)
+        if until is not None and now >= until:
+            # Half-open and the probe (or a straggler from before the
+            # trip) failed: re-open at once. This IS a transition
+            # (open -> half-open -> open), so it counts a trip.
+            self._open_until[address] = now + self.cooldown_s
+            self.trips_total += 1
+            return
         # Open only on the closed->open TRANSITION: several in-flight
         # requests failing against one endpoint are ONE outage — extra
         # failures must neither inflate trips_total (an alerting
         # signal) nor keep pushing the cooldown window out.
-        if n >= self.failure_threshold and address not in self._open_until:
-            self._open_until[address] = time.monotonic() + self.cooldown_s
+        if n >= self.failure_threshold and until is None:
+            self._open_until[address] = now + self.cooldown_s
             self.trips_total += 1
 
     def record_success(self, address: str) -> None:
         self._consecutive.pop(address, None)
         self._open_until.pop(address, None)
+        self._probe_granted.pop(address, None)
 
     def is_open(self, address: str) -> bool:
+        """Schedule-time filter: True while the endpoint must be held
+        out of the candidate set. Non-consuming — a half-open endpoint
+        stays a candidate (False) until some request claims the probe
+        via :meth:`take_probe` at dispatch, then reads True for
+        everyone else until that probe resolves or its grant expires."""
         until = self._open_until.get(address)
         if until is None:
             return False
-        if time.monotonic() >= until:
-            # Cooldown elapsed: half-open. The consecutive count is left
-            # at/above threshold, so one probe failure re-opens at once.
-            self._open_until.pop(address, None)
-            return False
-        return True
+        now = self._clock()
+        if now < until:
+            return True
+        granted = self._probe_granted.get(address)
+        return granted is not None and now - granted < self.cooldown_s
+
+    def take_probe(self, address: str) -> bool:
+        """Dispatch-time gate: claim the half-open single-probe grant
+        for the pod the caller is about to send to. True = send
+        (circuit closed, or this caller won the probe, or the circuit
+        is fully open — the open case is reachable only through the
+        fail-open filter branch when EVERY pool member is open, and
+        the breaker must degrade to trying, never manufacture a 503).
+        False = another probe is already in flight on this half-open
+        endpoint; skip the pod and re-pick."""
+        until = self._open_until.get(address)
+        if until is None:
+            return True
+        now = self._clock()
+        if now < until:
+            return True
+        # Cooldown elapsed: half-open. Grant exactly one probe; the
+        # grant resolves via record_success (closes) / record_failure
+        # (re-opens — the consecutive count is still at/above
+        # threshold) or expires after another cooldown.
+        granted = self._probe_granted.get(address)
+        if granted is None or now - granted >= self.cooldown_s:
+            self._probe_granted[address] = now
+            return True
+        return False
 
     def open_endpoints(self) -> list[str]:
-        now = time.monotonic()
+        now = self._clock()
         return sorted(a for a, t in self._open_until.items() if t > now)
 
     def forget(self, address: str) -> None:
         """Endpoint left the pool: a recycled host:port must start clean."""
         self._consecutive.pop(address, None)
         self._open_until.pop(address, None)
+        self._probe_granted.pop(address, None)

@@ -17,12 +17,11 @@ import json
 import logging
 import re
 import pathlib
-import time
 from typing import Callable
 
 import aiohttp
 
-from llmd_tpu import faults
+from llmd_tpu import clock, faults
 from llmd_tpu.epp.types import (
     BLOCK_SIZE,
     KV_CACHE_USAGE,
@@ -132,7 +131,7 @@ class EndpointStore:
             return ep
         existing.labels = ep.labels or existing.labels
         existing.model = ep.model or existing.model
-        existing.last_seen = time.monotonic()
+        existing.last_seen = clock.monotonic()
         return existing
 
     def remove(self, address: str) -> None:
@@ -236,12 +235,23 @@ class MetricsCollector:
         self._session: aiohttp.ClientSession | None = None
 
     async def scrape_once(self) -> None:
+        pods = self.store.list()
+        await asyncio.gather(*(self._scrape(p) for p in pods), return_exceptions=True)
+
+    async def _fetch(self, pod: Endpoint) -> str:
+        """The HTTP leg of one scrape, isolated so a virtual transport
+        (the fleet simulator's in-memory replicas) can substitute it
+        while the health-window accounting in _scrape stays the real
+        production code under test."""
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.timeout_s)
             )
-        pods = self.store.list()
-        await asyncio.gather(*(self._scrape(p) for p in pods), return_exceptions=True)
+        async with self._session.get(pod.url + "/metrics") as resp:
+            text = await resp.text()
+            if resp.status != 200:
+                raise RuntimeError(f"scrape {resp.status}")
+            return text
 
     async def _scrape(self, pod: Endpoint) -> None:
         try:
@@ -249,10 +259,7 @@ class MetricsCollector:
             # failure counter exactly like an unreachable endpoint.
             if faults.fires("epp.scrape.fail", pod.address):
                 raise RuntimeError("injected epp.scrape.fail")
-            async with self._session.get(pod.url + "/metrics") as resp:
-                text = await resp.text()
-                if resp.status != 200:
-                    raise RuntimeError(f"scrape {resp.status}")
+            text = await self._fetch(pod)
         except Exception:
             n = self._fail_counts.get(pod.address, 0) + 1
             self._fail_counts[pod.address] = n
@@ -263,7 +270,7 @@ class MetricsCollector:
         pod.healthy = True
         engine_type = pod.labels.get("llm-d.ai/engine-type", self.engine_type_default)
         pod.attrs.update(extract_attrs(text, engine_type))
-        pod.last_seen = time.monotonic()
+        pod.last_seen = clock.monotonic()
 
     async def run(self) -> None:
         while True:

@@ -46,10 +46,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 import grpc
 
+from llmd_tpu import clock
 from llmd_tpu.epp import extproc_pb as pb
 from llmd_tpu.epp.flow_control import OUTCOME_HTTP, Outcome
 from llmd_tpu.epp.handler import ParseError, parse_request
@@ -132,14 +132,14 @@ class ExtProcSession:
             if self.req is not None and self.pod is not None:
                 ttft_ms = None
                 if self.t_routed is not None and status.startswith("2"):
-                    ttft_s = time.monotonic() - self.t_routed
+                    ttft_s = clock.monotonic() - self.t_routed
                     ttft_ms = ttft_s * 1e3
                     # Mirror the fused proxy's accounting (server.py): the
                     # latency-aware scorers and PrefixCacheAffinityFilter's
                     # TTFT load gate read these attrs, and Envoy is the
                     # EPP's primary deployment shape.
                     self.pod.attrs["LastTTFT"] = ttft_s
-                    self._t_first_response = time.monotonic()
+                    self._t_first_response = clock.monotonic()
                     self._ok = True
                 # Fire-and-forget like the fused proxy (server.py): a slow
                 # observer (predictor training POST) must not hold Envoy's
@@ -225,7 +225,7 @@ class ExtProcSession:
                 continue
             self.pod.attrs["LastCompletionTokens"] = n_out
             if self._t_first_response is not None and n_out >= 2:
-                decode_s = time.monotonic() - self._t_first_response
+                decode_s = clock.monotonic() - self._t_first_response
                 self.pod.attrs["LastTPOT"] = decode_s / (n_out - 1)
 
     def close(self) -> None:
@@ -242,7 +242,7 @@ class ExtProcSession:
             if self._ok and self.t_routed is not None:
                 # E2E closes when Envoy finishes proxying the stream —
                 # same point the fused proxy records it (server.py).
-                self.pod.attrs["LastE2E"] = time.monotonic() - self.t_routed
+                self.pod.attrs["LastE2E"] = clock.monotonic() - self.t_routed
             self.pod.inflight = max(0, self.pod.inflight - 1)
             if self.req is not None:
                 self.pod.inflight_tokens = max(
@@ -341,7 +341,7 @@ class ExtProcSession:
             pod.inflight += 1
             pod.inflight_tokens += req.approx_prompt_tokens
             self.pod = pod
-            self.t_routed = time.monotonic()
+            self.t_routed = clock.monotonic()
             self._flow_held = True
             handed_off = True
             kind = "request_body" if self.body else "request_headers"

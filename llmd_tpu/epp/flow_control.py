@@ -18,10 +18,10 @@ import asyncio
 import collections
 import enum
 import logging
-import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from llmd_tpu import clock
 from llmd_tpu.epp.types import LLMRequest
 
 log = logging.getLogger(__name__)
@@ -68,7 +68,7 @@ class _Item:
     req: LLMRequest
     bytes: int
     future: asyncio.Future
-    enqueue_time: float = field(default_factory=time.monotonic)
+    enqueue_time: float = field(default_factory=clock.monotonic)
 
     @property
     def deadline(self) -> float:
@@ -255,7 +255,7 @@ class FlowControl:
                 pass
 
     def _expire_ttls(self) -> None:
-        now = time.monotonic()
+        now = clock.monotonic()
         for prio, flows in list(self._queues.items()):
             ttl = self.band_for(prio).ttl_s
             for flow_id, flow in list(flows.items()):

@@ -310,10 +310,10 @@ class K8sPodDiscoverySource:
                 except Exception as e:
                     log.warning("k8s pod discovery poll failed: %s", e)
                 await asyncio.sleep(self.poll_s)
-        import time as _time
+        from llmd_tpu import clock
 
         while True:
-            t0 = _time.monotonic()
+            t0 = clock.monotonic()
             try:
                 if self._resource_version is None:
                     await self.list_once()
@@ -322,7 +322,7 @@ class K8sPodDiscoverySource:
                 # Guard against proxies that terminate streaming GETs
                 # instantly — back-to-back re-watches would storm the
                 # apiserver while everything looks healthy.
-                if _time.monotonic() - t0 < 1.0:
+                if clock.monotonic() - t0 < 1.0:
                     await asyncio.sleep(min(self.poll_s, 1.0))
             except _WatchExpired:
                 log.info("watch resourceVersion expired; re-listing")

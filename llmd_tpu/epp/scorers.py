@@ -7,7 +7,8 @@ with configured weights (scheduling.md:60-68).
 from __future__ import annotations
 
 import collections
-import time
+
+from llmd_tpu import clock
 
 from llmd_tpu.epp.plugins import Scorer, register
 from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex, prompt_block_hashes
@@ -89,7 +90,7 @@ class SessionAffinityScorer(Scorer):
         if key is None:
             return {p.address: 0.0 for p in pods}
         entry = self._lru.get(key)
-        if entry is None or time.monotonic() - entry[1] > self.ttl_s:
+        if entry is None or clock.monotonic() - entry[1] > self.ttl_s:
             return {p.address: 0.0 for p in pods}
         return {p.address: 1.0 if p.address == entry[0] else 0.0 for p in pods}
 
@@ -97,7 +98,7 @@ class SessionAffinityScorer(Scorer):
         key = self._key(req)
         if key is None:
             return
-        self._lru[key] = (pod.address, time.monotonic())
+        self._lru[key] = (pod.address, clock.monotonic())
         self._lru.move_to_end(key)
         while len(self._lru) > self.max_sessions:
             self._lru.popitem(last=False)
@@ -124,7 +125,7 @@ class NoHitLRUScorer(Scorer):
         return {p.address: 1.0 - i / (n - 1) for i, p in enumerate(ranked)}
 
     def on_routed(self, req, pod):
-        self._last_routed[pod.address] = time.monotonic()
+        self._last_routed[pod.address] = clock.monotonic()
 
 
 @register("prefix-cache-scorer")

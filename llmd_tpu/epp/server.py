@@ -14,12 +14,13 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
-import time
+import os
+import random
 
 import aiohttp
 from aiohttp import web
 
-from llmd_tpu import faults
+from llmd_tpu import clock, faults
 from llmd_tpu.epp import filters as filters_mod
 from llmd_tpu.epp.breaker import EndpointCircuitBreaker
 from llmd_tpu.epp.datalayer import EndpointStore, FileDiscoverySource, MetricsCollector
@@ -65,6 +66,46 @@ class UpstreamServerError(RuntimeError):
     def __init__(self, status: int, body: str = "") -> None:
         super().__init__(f"upstream returned {status}: {body}")
         self.status = status
+
+
+def _env_backoff_s() -> float:
+    return float(os.environ.get("LLMD_EPP_RETRY_BACKOFF_S", "0.05"))
+
+
+def _env_backoff_cap_s() -> float:
+    return float(os.environ.get("LLMD_EPP_RETRY_BACKOFF_CAP_S", "1.0"))
+
+
+def backoff_delay(
+    prev_s: float, base_s: float, cap_s: float, rng: random.Random
+) -> float:
+    """Decorrelated-jitter retry backoff: ``min(cap, U(base, prev*3))``.
+
+    Capped exponential backoff with no jitter SYNCHRONIZES re-pick
+    storms: every request that failed against a dead replica in the
+    same instant sleeps the same deterministic series and lands on the
+    next pick together — the herd just moves. Decorrelated jitter keeps
+    the exponential envelope (the upper bound triples per attempt, so a
+    persistently-failing pool still backs off hard) while spreading each
+    retry uniformly over the window, so concurrent failures de-cohere
+    after one round. Pass the PREVIOUS returned delay back in as
+    ``prev_s`` (seed it with ``base_s`` before the first retry).
+
+    Shared by the router's retry loop and the fleet simulator's
+    transport driver — the soak exercises this exact function.
+    """
+    return min(cap_s, rng.uniform(base_s, max(prev_s * 3.0, base_s)))
+
+
+def eligible_pods(pods, tried: set, breaker: EndpointCircuitBreaker):
+    """Retry-attempt candidate set: drop already-tried endpoints, then
+    skip open-circuit ones — unless that empties the pool: stale breaker
+    state must degrade to trying, never turn a routable pool into a
+    manufactured 503 while replicas idle. (Shared with the fleet
+    simulator so the soak drives the identical schedule-time gate.)"""
+    pods = [p for p in pods if p.address not in tried]
+    closed = [p for p in pods if not breaker.is_open(p.address)]
+    return closed or pods
 
 
 class RouterMetrics:
@@ -150,8 +191,9 @@ class Router:
         max_schedule_attempts: int = 3,
         default_parser: str = "openai-parser",
         breaker: EndpointCircuitBreaker | None = None,
-        retry_backoff_s: float = 0.05,
-        retry_backoff_cap_s: float = 1.0,
+        retry_backoff_s: float | None = None,
+        retry_backoff_cap_s: float | None = None,
+        retry_rng: random.Random | None = None,
     ) -> None:
         self.store = store
         self.scheduler = scheduler
@@ -172,10 +214,20 @@ class Router:
         self.request_timeout_s = request_timeout_s
         self.max_schedule_attempts = max_schedule_attempts
         # Request-outcome circuit breaker (trips faster than the 3-scrape
-        # health window) + capped exponential backoff between re-picks.
+        # health window) + decorrelated-jitter backoff between re-picks
+        # (base/cap env-tunable: LLMD_EPP_RETRY_BACKOFF_S /
+        # LLMD_EPP_RETRY_BACKOFF_CAP_S; the rng is injectable so the
+        # fleet soak replays byte-identically).
         self.breaker = breaker or EndpointCircuitBreaker()
-        self.retry_backoff_s = retry_backoff_s
-        self.retry_backoff_cap_s = retry_backoff_cap_s
+        self.retry_backoff_s = (
+            _env_backoff_s() if retry_backoff_s is None else retry_backoff_s
+        )
+        self.retry_backoff_cap_s = (
+            _env_backoff_cap_s()
+            if retry_backoff_cap_s is None
+            else retry_backoff_cap_s
+        )
+        self._retry_rng = retry_rng or random.Random()
         # Readiness: flipped off FIRST on graceful shutdown so the
         # gateway stops routing before flow control starts evicting.
         self.ready = True
@@ -268,9 +320,9 @@ class Router:
                         status=429,
                         headers={HDR_DROP_REASON: reason},
                     )
-        t_enq = time.monotonic()
+        t_enq = clock.monotonic()
         outcome = await self.flow.enqueue_and_wait(req, nbytes=len(raw))
-        span.set("llm_d.flow_control.wait_s", time.monotonic() - t_enq)
+        span.set("llm_d.flow_control.wait_s", clock.monotonic() - t_enq)
         span.set("llm_d.flow_control.outcome", str(outcome.value))
         if outcome is not Outcome.DISPATCHED:
             status, reason = OUTCOME_HTTP[outcome]
@@ -307,15 +359,10 @@ class Router:
         self, request: web.Request, req: LLMRequest, raw: bytes
     ) -> web.StreamResponse:
         tried: set[str] = set()
+        prev_backoff = self.retry_backoff_s
         for attempt in range(self.max_schedule_attempts):
             self.metrics.scheduling_attempts += 1
-            pods = [p for p in self.store.list() if p.address not in tried]
-            # Skip open-circuit endpoints — unless that empties the pool:
-            # stale breaker state must degrade to trying, never turn a
-            # routable pool into a manufactured 503 while replicas idle.
-            closed = [p for p in pods if not self.breaker.is_open(p.address)]
-            if closed:
-                pods = closed
+            pods = eligible_pods(self.store.list(), tried, self.breaker)
             try:
                 result = self.scheduler.schedule(req, pods)
             except NoEndpointsError as e:
@@ -327,6 +374,12 @@ class Router:
                 )
             pod = result.primary
             tried.add(pod.address)
+            if not self.breaker.take_probe(pod.address):
+                # Half-open endpoint whose single probe is already in
+                # flight: losing the grant race is not an upstream
+                # failure — re-pick at once, no backoff, no breaker
+                # count.
+                continue
             span = req.scratch.get("span")
             if span is not None:
                 span.set("llm_d.decision.endpoint", pod.address)
@@ -373,14 +426,16 @@ class Router:
                 )
                 if attempt + 1 < self.max_schedule_attempts:
                     self.metrics.request_retries += 1
-                    # Capped exponential backoff before the re-pick: a
-                    # refusing pool must not see a synchronized retry storm.
-                    await asyncio.sleep(
-                        min(
-                            self.retry_backoff_s * (2 ** attempt),
-                            self.retry_backoff_cap_s,
-                        )
+                    # Decorrelated-jitter backoff before the re-pick: a
+                    # refusing pool must not see a synchronized retry
+                    # storm land on the next replica in lockstep.
+                    prev_backoff = backoff_delay(
+                        prev_backoff,
+                        self.retry_backoff_s,
+                        self.retry_backoff_cap_s,
+                        self._retry_rng,
                     )
+                    await asyncio.sleep(prev_backoff)
                 continue
             finally:
                 if prefill_pod is not None:
@@ -419,7 +474,7 @@ class Router:
             headers["traceparent"] = span.traceparent
         pod.inflight += 1
         pod.inflight_tokens += req.approx_prompt_tokens
-        t0 = time.monotonic()
+        t0 = clock.monotonic()
         first_byte: float | None = None
         last_byte: float | None = None
         stream_tokens = 0
@@ -457,8 +512,8 @@ class Router:
                 await resp.prepare(request)
                 async for chunk in upstream.content.iter_any():
                     if first_byte is None:
-                        first_byte = time.monotonic()
-                    last_byte = time.monotonic()
+                        first_byte = clock.monotonic()
+                    last_byte = clock.monotonic()
                     if req.streaming:
                         # Count complete SSE data lines ("data: ..." at line
                         # start — one frame ~ one sampled token batch); the
@@ -480,7 +535,7 @@ class Router:
             )
             if carry.startswith(b"data:") and b"[DONE]" not in carry:
                 stream_tokens += 1
-            now = time.monotonic()
+            now = clock.monotonic()
             ttft_ms: float | None = None
             tpot_ms: float | None = None
             # Only successful responses produce latency observations: a pod

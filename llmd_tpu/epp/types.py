@@ -9,8 +9,9 @@ contract (docs/api-reference/epp-http-headers.md:10-44).
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any
+
+from llmd_tpu import clock
 
 # Standard attribute keys (datalayer core-metrics-extractor output).
 KV_CACHE_USAGE = "KVCacheUsagePercent"
@@ -52,7 +53,7 @@ class Endpoint:
     model: str | None = None
     # Data-layer attributes, refreshed by collectors (metrics poll, KV index).
     attrs: dict[str, Any] = dataclasses.field(default_factory=dict)
-    last_seen: float = dataclasses.field(default_factory=time.monotonic)
+    last_seen: float = dataclasses.field(default_factory=clock.monotonic)
     healthy: bool = True
     # Requests routed here that have not yet completed (EPP-side view,
     # fresher than the polled metrics — the inflight-load-producer).
@@ -85,7 +86,7 @@ class LLMRequest:
     body: dict[str, Any] = dataclasses.field(default_factory=dict)
     path: str = "/v1/completions"
     streaming: bool = False
-    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    arrival_time: float = dataclasses.field(default_factory=clock.monotonic)
     # flow-control key parts
     priority: int = 0
     fairness_id: str = ""

@@ -44,7 +44,15 @@ site                        effect at the injection point
 ``kvstore.get.timeout``     kvstore client HTTP call raises ``TimeoutError``
 ``lockstep.sync.stall``     lockstep collective hangs past the bounded wait
 ``sidecar.prefill.fail``    sidecar phase-1 prefill POST raises
+``replica.crash``           fleet-sim replica dies (in-flight streams cut)
+``replica.brownout``        fleet-sim replica serves ``delay_ms`` slower
 ==========================  =================================================
+
+The two ``replica.*`` sites are FLEET-scoped: they are consulted by the
+fleet simulator's engine stubs (:mod:`llmd_tpu.fleetsim`), keyed by the
+replica address, so one seeded plan describes a whole-fleet chaos
+scenario (kill replica N mid-stream, brown out replica M per-request)
+alongside the per-component sites the production stack consults.
 """
 
 from __future__ import annotations
@@ -67,6 +75,8 @@ SITES = frozenset({
     "kvstore.get.timeout",
     "lockstep.sync.stall",
     "sidecar.prefill.fail",
+    "replica.crash",
+    "replica.brownout",
 })
 
 
@@ -188,14 +198,25 @@ def fires(site: str, key: str = "") -> bool:
     return plan.should_fire(site, key) is not None
 
 
-def delay(site: str, key: str = "") -> None:
-    """Sleep the firing spec's ``delay_ms`` (stall/latency sites)."""
+def delay_s(site: str, key: str = "") -> float:
+    """The firing spec's delay in SECONDS, without sleeping (0.0 when
+    nothing fires). Simulated-time callers (the fleet simulator's
+    replica stubs) advance their virtual clock by this instead of
+    blocking a real thread."""
     plan = _PLAN
     if plan is None:
-        return
+        return 0.0
     spec = plan.should_fire(site, key)
     if spec is not None and spec.delay_ms > 0:
-        time.sleep(spec.delay_ms / 1e3)
+        return spec.delay_ms / 1e3
+    return 0.0
+
+
+def delay(site: str, key: str = "") -> None:
+    """Sleep the firing spec's ``delay_ms`` (stall/latency sites)."""
+    dt = delay_s(site, key)
+    if dt > 0:
+        time.sleep(dt)
 
 
 def corrupt(site: str, data: bytes, key: str = "") -> bytes:

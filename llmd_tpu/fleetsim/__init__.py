@@ -1,0 +1,17 @@
+"""Fleet-scale chaos soak: a trace-driven closed-loop simulator that
+drives the REAL routing/control stack (EPP filter/score/pick, flow
+control, breaker, latency predictor, WVA autoscaler) through seeded
+failures on a virtual-time event loop, and gates fleet-level recovery
+invariants in CI. See docs/architecture/fleet-soak.md.
+
+Entry points:
+
+- ``python -m llmd_tpu.fleetsim --scenario replica_kill --out sb.json``
+- :func:`llmd_tpu.fleetsim.scenarios.SCENARIOS` — the seeded matrix
+- :class:`llmd_tpu.fleetsim.sim.FleetSim` — ad-hoc simulations
+"""
+
+from llmd_tpu.fleetsim.engines import ReplicaProfile, SimReplica  # noqa: F401
+from llmd_tpu.fleetsim.sim import AutoscaleConfig, FleetConfig, FleetSim  # noqa: F401
+from llmd_tpu.fleetsim.simloop import SimDeadlockError, SimEventLoop, run  # noqa: F401
+from llmd_tpu.fleetsim.traces import TraceRequest, generate, load_jsonl, save_jsonl  # noqa: F401

@@ -1,0 +1,268 @@
+"""Memory-speed engine-replica stubs parameterized from captured bench numbers.
+
+A :class:`SimReplica` is the serving-side half of the fleet simulator:
+it models one engine replica's queueing + continuous-batching dynamics
+(waiting queue, batch slots, KV occupancy, load-dependent TPOT) with a
+handful of floats, serves requests as virtual-time sleeps, and renders
+a real Prometheus ``/metrics`` page so the EPP's production
+``MetricsCollector``/``extract_attrs`` path scrapes it like any engine.
+
+The service model, deliberately simple and fully deterministic:
+
+- admission: FIFO wait for one of ``max_batch`` batch slots (the
+  waiting count is what the queue-scorer and flow-control saturation
+  see via scrape);
+- prefill: ``prompt_tokens / prefill_tok_s`` seconds to first token
+  (plus any armed ``replica.brownout`` delay, plus a recompute penalty
+  when a ``kv.pull.drop`` fault fires — the production degradation
+  contract for a dropped KV pull is local recompute, slower but
+  correct);
+- decode: per-token time is ``max(base_tpot, running / decode_tok_s)``
+  — at saturation the batch shares the replica's aggregate decode
+  throughput, under light load the single-sequence TPOT floor holds.
+
+Failure surface (consulted through PR 7's seeded FaultPlan):
+
+- ``replica.crash`` (fleet scope, fired by the simulator's chaos
+  ticker): :meth:`SimReplica.kill` fails every in-flight wait with
+  :class:`ReplicaDied` — mid-prefill requests look like a connection
+  reset before first byte (retryable), mid-decode requests like a cut
+  stream (surfaced, not retryable), exactly the split the router's
+  retry loop handles;
+- ``replica.brownout``: per-request extra latency (``delay_ms``);
+- new connections to a dead or draining replica raise
+  :class:`ReplicaUnreachable` (the simulator's connection-refused).
+
+Parameterization ties the stub to measured reality:
+:meth:`ReplicaProfile.from_bench` reads a captured ``BENCH_r0N.json``
+headline (output tok/s/chip — 4,914 in r4) for the decode rate and
+scales by a chip count; prefill throughput defaults to 4x the decode
+rate (prefill is the compute-bound, well-batched phase — an estimate,
+labeled as such; override per scenario when a captured prefill figure
+exists).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import pathlib
+
+import asyncio
+
+from llmd_tpu import faults
+
+
+class ReplicaUnreachable(ConnectionError):
+    """Connection refused: the replica is dead or draining."""
+
+
+class ReplicaDied(ConnectionError):
+    """The replica crashed while this request was in flight."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaProfile:
+    """One replica's capacity envelope (all rates per replica)."""
+
+    decode_tok_s: float = 4914.0  # BENCH_r04 headline, 1 chip
+    prefill_tok_s: float = 4914.0 * 4.0  # estimate: 4x decode (see module doc)
+    base_tpot_s: float = 0.005  # single-sequence TPOT floor
+    max_batch: int = 256  # concurrent decode slots (headline B)
+    kv_capacity_tokens: int = 2048 * 16  # pool pages x page size
+    startup_s: float = 2.0  # autoscale provisioning delay (sim time)
+    recompute_penalty: float = 1.0  # extra prefill fraction on kv.pull.drop
+
+    @classmethod
+    def from_bench(
+        cls, path: str | pathlib.Path | None = None, chips: int = 1, **overrides
+    ) -> "ReplicaProfile":
+        """Profile from a captured bench record's headline tok/s/chip.
+
+        Falls back to the class defaults (themselves the BENCH_r04
+        capture) when the record is missing/empty — CI must not depend
+        on which bench artifacts a checkout carries.
+        """
+        decode = cls.decode_tok_s
+        if path is not None:
+            try:
+                data = json.loads(pathlib.Path(path).read_text())
+                parsed = data.get("parsed") or data
+                value = float(parsed.get("value", 0.0))
+                if value > 0 and "tok/s" in str(parsed.get("unit", "tok/s")):
+                    decode = value
+            except (OSError, ValueError, KeyError):
+                pass
+        fields = {
+            "decode_tok_s": decode * chips,
+            "prefill_tok_s": decode * chips * 4.0,
+            "kv_capacity_tokens": cls.kv_capacity_tokens * chips,
+        }
+        fields.update(overrides)
+        return cls(**fields)
+
+
+class SimReplica:
+    """One simulated engine replica on the virtual-time loop."""
+
+    def __init__(
+        self, address: str, profile: ReplicaProfile, variant: str = "sim"
+    ) -> None:
+        self.address = address
+        self.profile = profile
+        self.variant = variant
+        self.alive = True
+        self.accepting = True  # False while draining out of the pool
+        self.waiting = 0
+        self.running = 0
+        self.kv_used_tokens = 0.0
+        self._free_slots = profile.max_batch
+        self._slot_waiters: collections.deque[asyncio.Future] = (
+            collections.deque()
+        )
+        # Every future an in-flight request is parked on; kill() fails
+        # them all so crashes cut streams instantly, not at timer
+        # expiry. Dict-as-ordered-set, NOT a set: kill() iterates this,
+        # and set order follows object addresses — which would deliver
+        # the crash in a different order every run and break the
+        # byte-identical-scoreboard contract.
+        self._inflight: dict[asyncio.Future, None] = {}
+        # Counters for the WVA collector / scoreboard.
+        self.arrived_total = 0
+        self.served_total = 0
+        self.prompt_tokens_total = 0
+        self.output_tokens_total = 0
+        self.recompute_fallbacks = 0
+
+    # ---- failure controls -------------------------------------------- #
+
+    def kill(self) -> None:
+        """Crash: cut every in-flight request and refuse new ones."""
+        self.alive = False
+        self.accepting = False
+        for fut in list(self._inflight):
+            if not fut.done():
+                fut.set_exception(ReplicaDied(self.address))
+
+    def drain(self) -> None:
+        """Scale-down: stop admitting; in-flight requests finish."""
+        self.accepting = False
+
+    # ---- internals --------------------------------------------------- #
+
+    async def _hold(self, dt: float) -> None:
+        """Virtual sleep that a kill() interrupts immediately.
+
+        The alive checks on entry and resume close a same-iteration
+        race: kill() can only fail futures that are not yet done, so a
+        request whose timer fired (or whose slot was transferred) in
+        the same event-loop iteration as the crash resumes normally —
+        without the re-check it would sleep out its remaining
+        prefill/decode and count as a completion served by a dead
+        replica, masking the stream-interrupted outcome the
+        replica-kill scenario measures."""
+        if not self.alive:
+            raise ReplicaDied(self.address)
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        handle = loop.call_later(
+            max(dt, 0.0), lambda: fut.done() or fut.set_result(None)
+        )
+        self._inflight[fut] = None
+        try:
+            await fut
+        finally:
+            self._inflight.pop(fut, None)
+            handle.cancel()
+        if not self.alive:
+            raise ReplicaDied(self.address)
+
+    async def _acquire_slot(self) -> None:
+        if not self.alive:
+            raise ReplicaDied(self.address)
+        if self._free_slots > 0:
+            self._free_slots -= 1
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._slot_waiters.append(fut)
+        self._inflight[fut] = None
+        try:
+            await fut  # the releaser transfers its slot to us
+        finally:
+            self._inflight.pop(fut, None)
+        if not self.alive:
+            # The transferred slot dies with the replica — a crashed
+            # stub's accounting is frozen, never reused.
+            raise ReplicaDied(self.address)
+
+    def _release_slot(self) -> None:
+        while self._slot_waiters:
+            fut = self._slot_waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self._free_slots += 1
+
+    # ---- the serving path -------------------------------------------- #
+
+    async def serve(self, request_id: str, prompt_tokens: int, output_tokens: int):
+        """Serve one request; async generator yielding once at first
+        token and returning at completion (the transport measures TTFT
+        and stream end from the yields, like SSE bytes on a socket).
+
+        Raises :class:`ReplicaUnreachable` before any byte when the
+        replica is down/draining, :class:`ReplicaDied` at whatever point
+        a crash lands.
+        """
+        if not self.alive or not self.accepting:
+            raise ReplicaUnreachable(self.address)
+        p = self.profile
+        self.arrived_total += 1
+        self.waiting += 1
+        try:
+            await self._acquire_slot()
+        finally:
+            self.waiting -= 1
+        self.running += 1
+        held_tokens = prompt_tokens + output_tokens
+        self.kv_used_tokens += held_tokens
+        try:
+            # Degradations the production stack contracts for: a dropped
+            # KV pull recomputes locally (slower prefill, correct
+            # output); a brownout serves every request delay_ms late.
+            prefill_s = prompt_tokens / p.prefill_tok_s
+            if faults.fires("kv.pull.drop", f"{self.address}|{request_id}"):
+                self.recompute_fallbacks += 1
+                prefill_s *= 1.0 + p.recompute_penalty
+            prefill_s += faults.delay_s("replica.brownout", self.address)
+            await self._hold(prefill_s)
+            yield "first-token"
+            if output_tokens > 1:
+                # Load-dependent TPOT, snapshotted at decode start: the
+                # batch shares the aggregate decode rate at saturation.
+                tpot = max(p.base_tpot_s, self.running / p.decode_tok_s)
+                await self._hold((output_tokens - 1) * tpot)
+            self.served_total += 1
+            self.prompt_tokens_total += prompt_tokens
+            self.output_tokens_total += output_tokens
+        finally:
+            self.running -= 1
+            self.kv_used_tokens -= held_tokens
+            self._release_slot()
+
+    # ---- the scrape surface ------------------------------------------ #
+
+    def metrics_text(self) -> str:
+        """A real Prometheus page for the production MetricsCollector
+        (llmd engine-family names — datalayer.METRIC_MAPPINGS)."""
+        cap = max(self.profile.kv_capacity_tokens, 1)
+        usage = min(self.kv_used_tokens / cap, 1.0)
+        return (
+            f"llmd:num_requests_waiting {self.waiting}\n"
+            f"llmd:num_requests_running {self.running}\n"
+            f"llmd:gpu_cache_usage_perc {usage:.6f}\n"
+            "llmd:prefix_cache_hit_rate 0.0\n"
+            f'llmd:cache_config_info{{block_size="16",'
+            f'num_gpu_blocks="{cap // 16}"}} 1\n'
+        )

@@ -1,0 +1,271 @@
+"""The chaos-soak scenario matrix: seeded scenarios with hard invariants.
+
+Each scenario is a factory: (seed, qps_scale) -> a fully-wired
+:class:`~llmd_tpu.fleetsim.sim.FleetSim` whose scoreboard carries
+pass/fail invariant results. The matrix is the CI `soak` job's contract
+(docs/architecture/fleet-soak.md carries the scenario -> invariant ->
+bound table; docs/architecture/fault-tolerance.md the fleet-level
+recovery contracts):
+
+========== ==========================================================
+steady      16 replicas, 10^4 QPS flat: SLO bands hold, zero lost,
+            four equal tenants complete fairly.
+burst       one tenant floods 5x over the middle of the window while
+            three light tenants keep steady rates: flow-control
+            fairness must keep the light tenants whole under pressure.
+diurnal     day-shaped rate over the WVA autoscaler: scale-up reacts
+            within bounded sim time, no decision oscillation, and the
+            trough tail scales to zero.
+replica_kill two replicas crash mid-stream at ~0.8 s under 10^4 QPS:
+            ZERO requests lost (re-picked or surfaced typed), breaker
+            opens for the dead addresses within the scrape window,
+            time-to-reroute bounded.
+brownout    one replica serves every request 200 ms slow: the scorers
+            steer load off it (its completed share falls well under
+            fair share) and fleet p99 stays bounded.
+all_flap    every scrape fails for the whole run: the healthy-filter
+            FAILS OPEN rather than 503ing a healthy fleet — requests
+            keep completing.
+========== ==========================================================
+
+Trace sizes are chosen so the full matrix runs in CI minutes while the
+kill/steady scenarios still exercise >= 10^4 simulated QPS (the
+acceptance bar); ``qps_scale`` lets tests and the bench part run the
+same scenarios at reduced scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from llmd_tpu.fleetsim import scoreboard as sb
+from llmd_tpu.fleetsim.engines import ReplicaProfile
+from llmd_tpu.fleetsim.sim import AutoscaleConfig, FleetConfig, FleetSim
+from llmd_tpu.fleetsim.traces import TraceRequest, generate
+
+# One simulated replica = one chip at the BENCH_r04 headline rate
+# (4,914 out tok/s); short outputs keep event counts CI-sized while the
+# arrival rate carries the 10^4 QPS bar.
+_PROFILE = ReplicaProfile()
+
+TENANTS_EQUAL = tuple((f"tenant-{i}", 1.0) for i in range(4))
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    build: Callable[[int, float], FleetSim]
+    description: str = ""
+
+
+def _kill_plan(addresses: list[str], tick_s: float, at_s: float) -> list[dict]:
+    """FaultPlan specs that crash ``addresses`` at ~``at_s`` sim time:
+    the chaos ticker consults replica.crash once per tick per replica,
+    so `after` ticks = a deterministic simulated kill time."""
+    after = max(0, round(at_s / tick_s) - 1)
+    return [
+        {"site": "replica.crash", "match": addr, "after": after, "times": 1}
+        for addr in addresses
+    ]
+
+
+def build_steady(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    # Offered rate targets >= 10^4 realized QPS (the acceptance bar);
+    # the generator is Poisson, so aim 5% above and gate the floor.
+    qps = 10_500.0 * qps_scale
+    duration = 1.6
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+    )
+    cfg = FleetConfig(replicas=max(2, round(20 * qps_scale)),
+                      profile=_PROFILE)
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("all_completed", sb.inv_all_completed(1.0)),
+        ("p99_ttft", sb.inv_p99_ttft_ms(500.0)),
+        ("p99_tpot", sb.inv_p99_tpot_ms(120.0)),
+        ("fairness", sb.inv_fairness_jain(0.95)),
+        ("offered_qps", sb.inv_min_offered_qps(10_000.0 * qps_scale)),
+    ]
+    return FleetSim(cfg, trace, seed=seed, scenario="steady",
+                    invariants=invariants)
+
+
+def build_burst(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    # One hog tenant at 4x the light tenants' rate, bursting 5x over
+    # the middle fifth: the capacity is sized so the burst saturates
+    # flow control and round-robin fairness has to defend the light
+    # tenants' dispatch share.
+    qps = 4_000.0 * qps_scale
+    duration = 2.5
+    tenants = (("hog", 4.0), ("light-0", 1.0), ("light-1", 1.0),
+               ("light-2", 1.0))
+    trace = generate(
+        "burst", qps=qps, duration_s=duration, seed=seed, tenants=tenants,
+        prompt_tokens=128, output_tokens=8, burst_factor=5.0,
+    )
+    cfg = FleetConfig(
+        replicas=max(2, round(10 * qps_scale)),
+        profile=_PROFILE,
+        # Tight inflight cap: the burst must QUEUE (where fairness
+        # policy acts), not fan straight out to idle replicas.
+        flow_max_inflight=max(64, round(2048 * qps_scale)),
+        flow_ttl_s=10.0,
+        grace_s=90.0,
+    )
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("light_tenants_whole",
+         sb.inv_tenant_completion(["light-0", "light-1", "light-2"], 0.98)),
+        ("p99_tpot", sb.inv_p99_tpot_ms(120.0)),
+    ]
+    return FleetSim(cfg, trace, seed=seed, scenario="burst",
+                    invariants=invariants)
+
+
+def build_diurnal(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    # Low-rate day curve over the REAL WVA pipeline: peak demand needs
+    # ~4 replicas, the trough needs zero. 40 s of fleet time.
+    qps = 400.0 * qps_scale
+    duration = 40.0
+    trace = generate(
+        "diurnal", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+        diurnal_floor=0.0,
+    )
+    cfg = FleetConfig(
+        replicas=1,
+        profile=dataclasses.replace(
+            _PROFILE,
+            decode_tok_s=_PROFILE.decode_tok_s / 4.0,
+            prefill_tok_s=_PROFILE.prefill_tok_s / 4.0,
+            max_batch=64,
+            startup_s=1.0,
+        ),
+        flow_ttl_s=20.0,
+        grace_s=120.0,
+        idle_tail_s=20.0,
+        autoscale=AutoscaleConfig(
+            interval_s=2.0,
+            scale_to_zero=True,
+            retention_s=8.0,
+            max_replicas=8,
+        ),
+    )
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("scale_up_reacts", sb.inv_scale_up_within_s(10.0)),
+        ("no_oscillation", sb.inv_no_oscillation(3)),
+        ("scale_to_zero", sb.inv_scale_to_zero),
+    ]
+    return FleetSim(cfg, trace, seed=seed, scenario="diurnal",
+                    invariants=invariants)
+
+
+def build_replica_kill(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    qps = 10_500.0 * qps_scale
+    duration = 1.6
+    n = max(3, round(20 * qps_scale))
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+    )
+    cfg = FleetConfig(replicas=n, profile=_PROFILE, grace_s=90.0)
+    killed = ["10.0.0.1:8000", "10.0.0.2:8000"]
+    plan = {
+        "seed": seed,
+        "faults": _kill_plan(killed, cfg.chaos_tick_s, at_s=0.8),
+    }
+    invariants = [
+        # THE acceptance bar: a replica death at 10^4 QPS costs bounded
+        # p99 and bounded reroute, and loses nothing.
+        ("zero_lost", sb.inv_zero_lost),
+        ("kills_fired", sb.inv_faults_fired("replica.crash", 2)),
+        ("breaker_opened", sb.inv_breaker_opened_for_kills),
+        ("time_to_reroute", sb.inv_time_to_reroute_s(1.0)),
+        ("p99_ttft", sb.inv_p99_ttft_ms(800.0)),
+        ("offered_qps", sb.inv_min_offered_qps(10_000.0 * qps_scale)),
+    ]
+    return FleetSim(cfg, trace, fault_plan=plan, seed=seed,
+                    scenario="replica_kill", invariants=invariants)
+
+
+def build_brownout(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    qps = 2_000.0 * qps_scale
+    duration = 2.0
+    n = max(3, round(6 * qps_scale))
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+    )
+    slow = "10.0.0.1:8000"
+    plan = {
+        "seed": seed,
+        "faults": [{
+            "site": "replica.brownout", "match": slow,
+            "times": None, "delay_ms": 200.0,
+        }],
+    }
+    cfg = FleetConfig(replicas=n, profile=_PROFILE, use_predictor=True,
+                      grace_s=90.0)
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("brownouts_fired", sb.inv_faults_fired("replica.brownout", 10)),
+        # Fair share would be 1/n; the queue/latency scorers must push
+        # the slow replica well under it.
+        ("steered_off_slow", sb.inv_brownout_steered(slow, 0.6 / n)),
+        ("p99_ttft", sb.inv_p99_ttft_ms(600.0)),
+    ]
+    return FleetSim(cfg, trace, fault_plan=plan, seed=seed,
+                    scenario="brownout", invariants=invariants)
+
+
+def build_all_flap(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    qps = 2_000.0 * qps_scale
+    duration = 2.0
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+    )
+    # Every scrape of every replica fails for the whole run: health
+    # DATA dies while the replicas stay fine — the telemetry-gap case
+    # the healthy-filter's fail-open exists for.
+    plan = {
+        "seed": seed,
+        "faults": [{"site": "epp.scrape.fail", "times": None, "p": 1.0}],
+    }
+    cfg = FleetConfig(replicas=max(2, round(5 * qps_scale)),
+                      profile=_PROFILE, grace_s=90.0)
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("scrapes_flapped", sb.inv_faults_fired("epp.scrape.fail", 10)),
+        ("fail_open_engaged", sb.inv_fail_open_engaged),
+        ("all_completed", sb.inv_all_completed(0.99)),
+    ]
+    return FleetSim(cfg, trace, fault_plan=plan, seed=seed,
+                    scenario="all_flap", invariants=invariants)
+
+
+SCENARIOS: dict[str, Scenario] = {
+    s.name: s
+    for s in [
+        Scenario("steady", build_steady,
+                 "flat 10^4 QPS, four tenants: SLO bands + fairness"),
+        Scenario("burst", build_burst,
+                 "hog tenant bursts 5x: flow-control fairness under "
+                 "pressure"),
+        Scenario("diurnal", build_diurnal,
+                 "day-shaped rate over the real WVA: bounded reaction, "
+                 "no oscillation, scale-to-zero"),
+        Scenario("replica_kill", build_replica_kill,
+                 "two crashes mid-stream at 10^4 QPS: zero lost, bounded "
+                 "reroute, breaker visible"),
+        Scenario("brownout", build_brownout,
+                 "one 200 ms-slow replica: load steered off it"),
+        Scenario("all_flap", build_all_flap,
+                 "all scrapes fail: healthy-filter fail-open keeps "
+                 "serving"),
+    ]
+}

@@ -1,0 +1,426 @@
+"""The soak scoreboard: deterministic per-scenario JSON + invariant gates.
+
+Every simulated request ends in exactly one recorded outcome —
+``completed``, a typed drop (flow-control outcome, ``no-endpoints``,
+``all-endpoints-failed``, ``stream-interrupted``) or ``hung`` (still
+pending when the scenario's grace window closed). ``hung`` existing as
+a category is the point: "zero requests lost to a killed replica" is
+asserted as ``hung == 0`` plus every arrival accounted for, not assumed.
+
+:meth:`Scoreboard.finalize` folds the per-request records plus the real
+components' own counters (breaker trips, healthy-filter fail-opens,
+``faults.injected_counts()``, WVA decision history) into one dict and
+evaluates the scenario's invariants into an ``invariants`` section.
+:func:`to_canonical_json` renders it byte-deterministically: floats
+rounded to 6 places, keys sorted, no wall-clock anywhere — the same
+trace + FaultPlan seed must produce the identical bytes across runs,
+and CI diffs exactly that.
+
+Latency percentiles are nearest-rank over the sorted sample list (no
+interpolation — interpolation invites float-order sensitivity for zero
+statistical benefit at soak sample counts).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+Invariant = Callable[[dict], str | None]  # None = holds, str = violation
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over pre-sorted values (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1, int(q * len(sorted_vals) + 0.5) - 1))
+    return sorted_vals[k]
+
+
+def jain_index(values: list[float]) -> float:
+    """Jain's fairness index: 1.0 = perfectly even, 1/n = one hog."""
+    if not values:
+        return 1.0
+    s = sum(values)
+    ss = sum(v * v for v in values)
+    if ss <= 0:
+        return 1.0
+    return (s * s) / (len(values) * ss)
+
+
+def _round(obj, places: int = 6):
+    if isinstance(obj, float):
+        return round(obj, places)
+    if isinstance(obj, dict):
+        return {k: _round(v, places) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_round(v, places) for v in obj]
+    return obj
+
+
+def to_canonical_json(board: dict) -> str:
+    """Byte-deterministic rendering (rounded floats, sorted keys)."""
+    return json.dumps(_round(board), sort_keys=True, indent=1) + "\n"
+
+
+class Scoreboard:
+    def __init__(self, scenario: str, seed: int) -> None:
+        self.scenario = scenario
+        self.seed = seed
+        self.arrived: dict[str, int] = {}  # tenant -> count
+        self.outcomes: dict[str, int] = {}
+        self.completed_per_tenant: dict[str, int] = {}
+        self.ttft_s: list[float] = []
+        self.tpot_ms: list[float] = []
+        self.ttft_per_tenant: dict[str, list[float]] = {}
+        self.completed_per_replica: dict[str, int] = {}
+        self.retries_total = 0
+        self.hung: list[str] = []
+        # chaos / recovery
+        self.kills: dict[str, float] = {}  # address -> sim kill time
+        self.breaker_open_after_kill_s: dict[str, float] = {}
+        self.reroute_latencies_s: list[float] = []
+        self.recompute_fallbacks = 0
+        # autoscale
+        self.autoscale_history: list[tuple[float, int]] = []  # (t, desired)
+        self.replicas_started: list[tuple[float, str]] = []
+        self.replicas_removed: list[tuple[float, str]] = []
+
+    # ---- recording ---------------------------------------------------- #
+
+    def record_arrival(self, tenant: str) -> None:
+        self.arrived[tenant] = self.arrived.get(tenant, 0) + 1
+
+    def record_outcome(self, tenant: str, outcome: str) -> None:
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        if outcome == "completed":
+            self.completed_per_tenant[tenant] = (
+                self.completed_per_tenant.get(tenant, 0) + 1
+            )
+
+    def record_completion(
+        self,
+        tenant: str,
+        address: str,
+        ttft_s: float,
+        tpot_ms: float | None,
+        retries: int,
+    ) -> None:
+        self.record_outcome(tenant, "completed")
+        self.ttft_s.append(ttft_s)
+        self.ttft_per_tenant.setdefault(tenant, []).append(ttft_s)
+        if tpot_ms is not None:
+            self.tpot_ms.append(tpot_ms)
+        self.completed_per_replica[address] = (
+            self.completed_per_replica.get(address, 0) + 1
+        )
+        self.retries_total += retries
+
+    def record_hung(self, request_id: str) -> None:
+        self.hung.append(request_id)
+        self.outcomes["hung"] = self.outcomes.get("hung", 0) + 1
+
+    def record_kill(self, address: str, t: float) -> None:
+        self.kills.setdefault(address, t)
+
+    def record_breaker_open(self, address: str, t: float) -> None:
+        kill_t = self.kills.get(address)
+        if kill_t is not None and address not in self.breaker_open_after_kill_s:
+            self.breaker_open_after_kill_s[address] = t - kill_t
+
+    def record_reroute(self, latency_s: float) -> None:
+        self.reroute_latencies_s.append(latency_s)
+
+    def record_autoscale(self, t: float, desired_total: int) -> None:
+        self.autoscale_history.append((t, desired_total))
+
+    # ---- finalize ----------------------------------------------------- #
+
+    def _direction_flips(self) -> int:
+        """Sign changes in the desired-replica delta series — the
+        oscillation gauge (a healthy controller ramps, holds, ramps
+        back; it does not saw-tooth)."""
+        deltas = [
+            b - a
+            for (_, a), (_, b) in zip(
+                self.autoscale_history, self.autoscale_history[1:]
+            )
+            if b != a
+        ]
+        flips = 0
+        for prev, cur in zip(deltas, deltas[1:]):
+            if (prev > 0) != (cur > 0):
+                flips += 1
+        return flips
+
+    def finalize(
+        self,
+        duration_s: float,
+        invariants: list[tuple[str, Invariant]],
+        fail_open_count: int = 0,
+        breaker_trips: int = 0,
+        breaker_opened: list[str] | None = None,
+        faults_injected: dict[str, int] | None = None,
+        recompute_fallbacks: int = 0,
+        extra: dict | None = None,
+    ) -> dict:
+        arrived_total = sum(self.arrived.values())
+        ttft_sorted = sorted(self.ttft_s)
+        tpot_sorted = sorted(self.tpot_ms)
+        completed = self.outcomes.get("completed", 0)
+        tenants = sorted(self.arrived)
+        per_tenant = {
+            t: {
+                "arrived": self.arrived.get(t, 0),
+                "completed": self.completed_per_tenant.get(t, 0),
+                "completion_ratio": (
+                    self.completed_per_tenant.get(t, 0)
+                    / max(self.arrived.get(t, 0), 1)
+                ),
+                "p99_ttft_ms": percentile(
+                    sorted(self.ttft_per_tenant.get(t, [])), 0.99
+                ) * 1e3,
+            }
+            for t in tenants
+        }
+        board = {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "trace": {
+                "requests": arrived_total,
+                "duration_s": duration_s,
+                "offered_qps": arrived_total / max(duration_s, 1e-9),
+            },
+            "requests": {
+                "outcomes": dict(sorted(self.outcomes.items())),
+                "accounted": sum(self.outcomes.values()),
+                "hung": len(self.hung),
+                # hung arrivals carry a "hung" outcome and so count as
+                # accounted; lost is strictly the UNaccounted remainder
+                # (fleet-soak.md's definition) — the two categories
+                # never overlap.
+                "lost": arrived_total - sum(self.outcomes.values()),
+                "retries_total": self.retries_total,
+            },
+            "latency_ms": {
+                "ttft": {
+                    "p50": percentile(ttft_sorted, 0.50) * 1e3,
+                    "p90": percentile(ttft_sorted, 0.90) * 1e3,
+                    "p99": percentile(ttft_sorted, 0.99) * 1e3,
+                    "max": (ttft_sorted[-1] if ttft_sorted else 0.0) * 1e3,
+                },
+                "tpot": {
+                    "p50": percentile(tpot_sorted, 0.50),
+                    "p99": percentile(tpot_sorted, 0.99),
+                },
+            },
+            "per_tenant": per_tenant,
+            "fairness": {
+                "jain_completed": jain_index(
+                    [float(per_tenant[t]["completed"]) for t in tenants]
+                ),
+                "min_completion_ratio": min(
+                    (per_tenant[t]["completion_ratio"] for t in tenants),
+                    default=1.0,
+                ),
+            },
+            "reroute": {
+                "kills": dict(sorted(self.kills.items())),
+                "breaker_open_after_kill_s": dict(
+                    sorted(self.breaker_open_after_kill_s.items())
+                ),
+                "time_to_reroute_s": (
+                    max(self.reroute_latencies_s)
+                    if self.reroute_latencies_s
+                    else 0.0
+                ),
+                "rerouted_requests": len(self.reroute_latencies_s),
+            },
+            "breaker": {
+                "trips_total": breaker_trips,
+                "opened": sorted(breaker_opened or []),
+            },
+            "fail_open_total": fail_open_count,
+            "faults_injected": dict(sorted((faults_injected or {}).items())),
+            "recompute_fallbacks": recompute_fallbacks,
+            "replicas": {
+                "completed_per_replica": dict(
+                    sorted(self.completed_per_replica.items())
+                ),
+            },
+            "autoscale": {
+                "history": [[t, n] for t, n in self.autoscale_history],
+                "direction_flips": self._direction_flips(),
+                "started": [[t, a] for t, a in self.replicas_started],
+                "removed": [[t, a] for t, a in self.replicas_removed],
+            },
+        }
+        if extra:
+            board.update(extra)
+        results = {}
+        for name, inv in invariants:
+            violation = inv(board)
+            results[name] = {
+                "ok": violation is None,
+                "detail": violation or "holds",
+            }
+        board["invariants"] = results
+        board["ok"] = all(r["ok"] for r in results.values())
+        return board
+
+
+# ---- invariant library ------------------------------------------------ #
+# Each factory returns a predicate over the finalized board dict; None
+# means the invariant holds, a string describes the violation. The
+# scenario matrix composes these (fleet-soak.md carries the contract
+# table: scenario -> invariant -> simulated-time bound -> metric).
+
+
+def inv_zero_lost(board: dict) -> str | None:
+    r = board["requests"]
+    if r["lost"] != 0 or r["hung"] != 0:
+        return f"lost={r['lost']} hung={r['hung']} (must both be 0)"
+    return None
+
+
+def inv_all_completed(min_ratio: float = 1.0) -> Invariant:
+    def check(board: dict) -> str | None:
+        done = board["requests"]["outcomes"].get("completed", 0)
+        total = board["trace"]["requests"]
+        if total and done / total < min_ratio:
+            return f"completed {done}/{total} < {min_ratio:.2f}"
+        return None
+    return check
+
+
+def inv_p99_ttft_ms(bound_ms: float) -> Invariant:
+    def check(board: dict) -> str | None:
+        p99 = board["latency_ms"]["ttft"]["p99"]
+        if p99 > bound_ms:
+            return f"p99 TTFT {p99:.1f}ms > {bound_ms}ms"
+        return None
+    return check
+
+
+def inv_p99_tpot_ms(bound_ms: float) -> Invariant:
+    def check(board: dict) -> str | None:
+        p99 = board["latency_ms"]["tpot"]["p99"]
+        if p99 > bound_ms:
+            return f"p99 TPOT {p99:.1f}ms > {bound_ms}ms"
+        return None
+    return check
+
+
+def inv_time_to_reroute_s(bound_s: float) -> Invariant:
+    def check(board: dict) -> str | None:
+        ttr = board["reroute"]["time_to_reroute_s"]
+        if ttr > bound_s:
+            return f"time-to-reroute {ttr:.3f}s > {bound_s}s"
+        if board["reroute"]["kills"] and not board["reroute"]["rerouted_requests"]:
+            return "replicas were killed but no request was rerouted"
+        return None
+    return check
+
+
+def inv_breaker_opened_for_kills(board: dict) -> str | None:
+    missing = [
+        a for a in board["reroute"]["kills"]
+        if a not in board["reroute"]["breaker_open_after_kill_s"]
+    ]
+    if missing:
+        return f"breaker never opened for killed replica(s): {missing}"
+    return None
+
+
+def inv_fail_open_engaged(board: dict) -> str | None:
+    if board["fail_open_total"] <= 0:
+        return "healthy-filter fail-open never engaged"
+    return None
+
+
+def inv_fairness_jain(min_index: float) -> Invariant:
+    def check(board: dict) -> str | None:
+        j = board["fairness"]["jain_completed"]
+        if j < min_index:
+            return f"Jain fairness {j:.3f} < {min_index}"
+        return None
+    return check
+
+
+def inv_tenant_completion(tenants: list[str], min_ratio: float) -> Invariant:
+    def check(board: dict) -> str | None:
+        for t in tenants:
+            pt = board["per_tenant"].get(t)
+            if pt is None:
+                return f"tenant {t} missing from scoreboard"
+            if pt["completion_ratio"] < min_ratio:
+                return (
+                    f"tenant {t} completion {pt['completion_ratio']:.3f} "
+                    f"< {min_ratio}"
+                )
+        return None
+    return check
+
+
+def inv_min_offered_qps(min_qps: float) -> Invariant:
+    def check(board: dict) -> str | None:
+        q = board["trace"]["offered_qps"]
+        if q < min_qps:
+            return f"offered {q:.0f} QPS < {min_qps:.0f}"
+        return None
+    return check
+
+
+def inv_scale_up_within_s(bound_s: float, after_t: float = 0.0) -> Invariant:
+    """Desired replicas must rise above the starting count within
+    ``bound_s`` of ``after_t`` (burst onset)."""
+    def check(board: dict) -> str | None:
+        hist = board["autoscale"]["history"]
+        if not hist:
+            return "no autoscale decisions recorded"
+        base = hist[0][1]
+        for t, n in hist:
+            if t >= after_t and n > base:
+                if t - after_t <= bound_s:
+                    return None
+                return f"first scale-up at {t:.1f}s > {after_t}+{bound_s}s"
+        return "never scaled up"
+    return check
+
+
+def inv_scale_to_zero(board: dict) -> str | None:
+    hist = board["autoscale"]["history"]
+    if not any(n == 0 for _, n in hist):
+        return "never scaled to zero during the idle tail"
+    return None
+
+
+def inv_no_oscillation(max_flips: int) -> Invariant:
+    def check(board: dict) -> str | None:
+        flips = board["autoscale"]["direction_flips"]
+        if flips > max_flips:
+            return f"{flips} scale-direction flips > {max_flips}"
+        return None
+    return check
+
+
+def inv_brownout_steered(address: str, max_share: float) -> Invariant:
+    """Routing must shift load off the browned-out replica: its share of
+    completions stays under ``max_share`` (fair share would be 1/N)."""
+    def check(board: dict) -> str | None:
+        per = board["replicas"]["completed_per_replica"]
+        total = sum(per.values())
+        share = per.get(address, 0) / max(total, 1)
+        if share > max_share:
+            return f"browned replica served {share:.3f} > {max_share}"
+        return None
+    return check
+
+
+def inv_faults_fired(site: str, at_least: int = 1) -> Invariant:
+    def check(board: dict) -> str | None:
+        n = board["faults_injected"].get(site, 0)
+        if n < at_least:
+            return f"fault {site} fired {n} < {at_least} times"
+        return None
+    return check

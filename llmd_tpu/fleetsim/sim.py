@@ -1,0 +1,573 @@
+"""FleetSim: drive the REAL routing/control stack through a simulated fleet.
+
+This is the closed loop the ROADMAP's "million-user fleet soak" item
+names. Everything decision-making is the production code, not a model
+of it:
+
+- scheduling — ``epp.config.build_scheduler`` over a real
+  EndpointPickerConfig dict: the same Filter→Score→Pick plugin chain,
+  registry and profile handler the router process assembles;
+- flow control — ``epp.config.build_flow_control``: real bands,
+  fairness/ordering policies, TTL eviction and the saturation-gated
+  dispatch loop, running as its own task on the virtual-time loop;
+- health — the production ``MetricsCollector`` scrape loop; the only
+  substitution is the HTTP leg (``_fetch`` returns the stub replica's
+  Prometheus page), so consecutive-failure counting, ``extract_attrs``
+  and the unhealthy window are the code under test;
+- retry/breaker — ``epp.server.eligible_pods`` +
+  ``epp.server.backoff_delay`` (decorrelated jitter) + the real
+  ``EndpointCircuitBreaker`` on the shared clock seam;
+- latency prediction — a real ``LatencyPredictor`` trained online from
+  simulated completions via the production
+  ``PredictedLatencyProducer``/``PredictorClient`` path (optional per
+  scenario);
+- autoscaling — the real ``WvaEngine`` pipeline (analyzer → optimizer →
+  enforcer) plus its scale-from-zero fast path, fed by a snapshot
+  collector over the stub fleet; its decisions materialize as replicas
+  with a provisioning delay.
+
+Only the engine replicas themselves and the proxy byte-shoveling are
+stubs (:mod:`llmd_tpu.fleetsim.engines`) — those are the parts whose
+behavior is captured by bench numbers, not routing logic.
+
+The request driver mirrors ``Router._route_and_proxy`` semantics
+exactly: tried-set exclusion, breaker gating with fail-open, re-pick on
+connection-class errors before first byte, typed surfacing of a stream
+cut after first byte, jittered backoff between attempts, breaker and
+health feedback, completion notifications to scorers and the predictor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import dataclasses
+import logging
+import random
+
+from llmd_tpu import clock, faults
+from llmd_tpu.autoscale.engine import WvaEngine
+from llmd_tpu.autoscale.types import PoolSnapshot, ReplicaMetrics, VariantSpec
+from llmd_tpu.epp import config as epp_config
+from llmd_tpu.epp import filters as filters_mod
+from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+from llmd_tpu.epp.flow_control import Outcome
+from llmd_tpu.epp.scheduler import NoEndpointsError
+from llmd_tpu.epp.server import backoff_delay, eligible_pods
+from llmd_tpu.epp.types import KV_CACHE_USAGE, WAITING_QUEUE_SIZE, Endpoint, LLMRequest
+from llmd_tpu.fleetsim import simloop
+from llmd_tpu.fleetsim.engines import (
+    ReplicaDied,
+    ReplicaProfile,
+    ReplicaUnreachable,
+    SimReplica,
+)
+from llmd_tpu.fleetsim.scoreboard import Scoreboard
+from llmd_tpu.fleetsim.traces import TraceRequest
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """WVA wiring for a scenario (None = fixed fleet)."""
+
+    analyzer: str = "saturation-percentage-based"
+    interval_s: float = 2.0
+    sfz_interval_s: float = 0.1
+    scale_to_zero: bool = False
+    retention_s: float = 10.0
+    min_replicas: int = 0
+    max_replicas: int = 64
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """One scenario's fleet + control-stack knobs."""
+
+    replicas: int = 4
+    profile: ReplicaProfile = dataclasses.field(default_factory=ReplicaProfile)
+    scheduler_config: dict | None = None  # EndpointPickerConfig dict
+    use_predictor: bool = False
+    flow_max_inflight: int = 4096
+    flow_ttl_s: float = 30.0
+    fairness: str = "round-robin"
+    max_schedule_attempts: int = 3
+    retry_backoff_s: float = 0.005
+    retry_backoff_cap_s: float = 0.25
+    breaker_threshold: int = 2
+    breaker_cooldown_s: float = 1.0
+    scrape_interval_s: float = 0.25
+    unhealthy_after: int = 3
+    chaos_tick_s: float = 0.05
+    grace_s: float = 60.0  # drain window after the last arrival
+    # Simulated idle time appended AFTER the last request drains, with
+    # the control loops still running — the window where scale-down /
+    # scale-to-zero behavior is observable. Free: it is virtual time.
+    idle_tail_s: float = 0.0
+    autoscale: AutoscaleConfig | None = None
+    model_id: str = "sim-model"
+
+
+def default_sim_config(
+    seed: int,
+    max_inflight: int = 4096,
+    ttl_s: float = 30.0,
+    fairness: str = "round-robin",
+    use_predictor: bool = False,
+) -> dict:
+    """The soak's EndpointPickerConfig: the production DEFAULT_CONFIG
+    plugin set with a seeded picker (deterministic tie-breaks) and,
+    optionally, the predicted-latency scorer in the chain."""
+    cfg = copy.deepcopy(epp_config.DEFAULT_CONFIG)
+    for p in cfg["plugins"]:
+        if p["type"] == "max-score-picker":
+            p["parameters"] = {"seed": seed}
+    if use_predictor:
+        cfg["plugins"].append({"type": "latency-scorer", "name": "latency"})
+        cfg["schedulingProfiles"][0]["plugins"].insert(
+            -1, {"pluginRef": "latency", "weight": 2.0}
+        )
+    cfg["flowControl"] = {
+        "enabled": True,
+        "maxInflight": max_inflight,
+        "fairness": fairness,
+        "bands": [{"priority": 0, "maxRequests": 65536,
+                   "ttlSeconds": ttl_s}],
+        "maxTotalRequests": 1 << 17,
+    }
+    return cfg
+
+
+class _SimCollector(MetricsCollector):
+    """Production scrape loop over the virtual transport: only the HTTP
+    GET is replaced; health windows and attr extraction are the real
+    datalayer code (including the epp.scrape.fail injection site)."""
+
+    def __init__(self, fleet: "FleetSim", **kw) -> None:
+        super().__init__(fleet.store, **kw)
+        self.fleet = fleet
+
+    async def _fetch(self, pod: Endpoint) -> str:
+        replica = self.fleet.replicas.get(pod.address)
+        if replica is None or not replica.alive:
+            raise ConnectionRefusedError(pod.address)
+        return replica.metrics_text()
+
+
+class _SimWvaCollector:
+    """PoolSnapshot source for the real WVA pipeline, mirroring
+    RouterCollector's delta/retention accounting over the stub fleet."""
+
+    def __init__(self, fleet: "FleetSim", retention_s: float) -> None:
+        self.fleet = fleet
+        self.retention_s = retention_s
+        self._prev_served: dict[str, float] = {}
+        self._last_t: float | None = None
+        self._first_t: float | None = None
+        self._history: list[tuple[float, float]] = []  # (t, completed delta)
+
+    async def epp_queue_size(self) -> float:
+        return float(self.fleet.flow.queue_depth())
+
+    async def collect(self) -> PoolSnapshot:
+        now = clock.monotonic()
+        if self._first_t is None:
+            self._first_t = now
+        dt = (now - self._last_t) if self._last_t is not None else 0.0
+        self._last_t = now
+        snap = PoolSnapshot(model_id=self.fleet.cfg.model_id)
+        snap.epp_queue_size = float(self.fleet.flow.queue_depth())
+        cycle_delta = 0.0
+        for pod in self.fleet.store.list():
+            rep = self.fleet.replicas.get(pod.address)
+            if rep is None:
+                continue
+            r = ReplicaMetrics(
+                variant=rep.variant,
+                address=rep.address,
+                ready=pod.healthy and rep.alive,
+                kv_usage=min(
+                    rep.kv_used_tokens / max(rep.profile.kv_capacity_tokens, 1),
+                    1.0,
+                ),
+                queue_len=float(rep.waiting),
+                running=float(rep.running),
+                block_size=16,
+                num_blocks=max(rep.profile.kv_capacity_tokens, 1) // 16,
+            )
+            served = float(rep.served_total)
+            d = served - self._prev_served.get(rep.address, served)
+            self._prev_served[rep.address] = served
+            cycle_delta += max(d, 0.0)
+            if d > 0:
+                r.avg_input_tokens = rep.prompt_tokens_total / max(
+                    rep.served_total, 1
+                )
+                r.avg_output_tokens = rep.output_tokens_total / max(
+                    rep.served_total, 1
+                )
+            if dt > 0:
+                r.arrival_rate = max(d, 0.0) / dt
+            if isinstance(pod.attrs.get("LastTTFT"), (int, float)):
+                r.avg_ttft_s = float(pod.attrs["LastTTFT"])
+            if isinstance(pod.attrs.get("LastTPOT"), (int, float)):
+                r.avg_itl_s = float(pod.attrs["LastTPOT"])
+            snap.replicas.append(r)
+        self._history.append((now, cycle_delta))
+        self._history = [
+            (t, d) for t, d in self._history if now - t <= self.retention_s
+        ]
+        if now - self._first_t >= self.retention_s:
+            snap.recent_request_count = sum(d for _, d in self._history)
+        else:
+            snap.recent_request_count = None
+        return snap
+
+
+class FleetSim:
+    """One scenario run: trace + fault plan in, scoreboard dict out."""
+
+    def __init__(
+        self,
+        cfg: FleetConfig,
+        trace: list[TraceRequest],
+        fault_plan: dict | None = None,
+        seed: int = 0,
+        scenario: str = "adhoc",
+        invariants: list | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.trace = sorted(trace, key=lambda r: (r.t, r.request_id))
+        self.fault_plan = fault_plan
+        self.seed = seed
+        self.scenario = scenario
+        self.invariants = invariants or []
+        self.board = Scoreboard(scenario, seed)
+        self.store = EndpointStore()
+        self.replicas: dict[str, SimReplica] = {}
+        sched_cfg = cfg.scheduler_config or default_sim_config(
+            seed,
+            max_inflight=cfg.flow_max_inflight,
+            ttl_s=cfg.flow_ttl_s,
+            fairness=cfg.fairness,
+            use_predictor=cfg.use_predictor,
+        )
+        self.scheduler = epp_config.build_scheduler(sched_cfg)
+        self.flow = epp_config.build_flow_control(sched_cfg)
+        self.breaker = EndpointCircuitBreaker(
+            cfg.breaker_threshold, cfg.breaker_cooldown_s
+        )
+        self._retry_rng = random.Random(seed ^ 0x5EED)
+        self.producers: list = []
+        if cfg.use_predictor:
+            from llmd_tpu.epp.predicted_latency import (
+                PredictedLatencyProducer,
+            )
+
+            self.producers.append(PredictedLatencyProducer())
+        self._next_replica = 0
+        self._pending_spawns = 0
+        self._tasks: list[tuple[asyncio.Task, TraceRequest]] = []
+        self._duration = max((r.t for r in self.trace), default=0.0)
+        self.wva: WvaEngine | None = None
+
+    # ---- fleet membership -------------------------------------------- #
+
+    def _add_replica(self) -> SimReplica:
+        addr = f"10.0.0.{self._next_replica}:8000"
+        self._next_replica += 1
+        rep = SimReplica(addr, self.cfg.profile)
+        self.replicas[addr] = rep
+        self.store.upsert(Endpoint(
+            address=addr,
+            labels={
+                "llm-d.ai/engine-type": "llmd",
+                "llm-d.ai/variant": "sim",
+            },
+        ))
+        self.board.replicas_started.append((clock.monotonic(), addr))
+        return rep
+
+    def _remove_replica(self, addr: str) -> None:
+        rep = self.replicas.get(addr)
+        if rep is not None:
+            rep.drain()
+        # Store removal fires scheduler.notify_endpoint_removed and
+        # breaker.forget via the same on_remove hooks the router wires.
+        self.store.remove(addr)
+        self.board.replicas_removed.append((clock.monotonic(), addr))
+
+    # ---- autoscale actuation ----------------------------------------- #
+
+    def _apply_decisions(self, decisions) -> None:
+        desired = sum(d.desired_replicas for d in decisions)
+        self.board.record_autoscale(clock.monotonic(), desired)
+        live = [
+            a for a in self.store.list()
+            if self.replicas.get(a.address) is not None
+            and self.replicas[a.address].alive
+        ]
+        current = len(live) + self._pending_spawns
+        loop = asyncio.get_event_loop()
+        for _ in range(max(0, desired - current)):
+            self._pending_spawns += 1
+
+            def _spawn() -> None:
+                self._pending_spawns -= 1
+                self._add_replica()
+
+            loop.call_later(self.cfg.profile.startup_s, _spawn)
+        for _ in range(max(0, current - desired)):
+            if not live:
+                break
+            pod = live.pop()  # newest registered goes first
+            self._remove_replica(pod.address)
+
+    # ---- chaos pump --------------------------------------------------- #
+
+    async def _chaos_ticker(self) -> None:
+        """Consults the fleet-scoped FaultPlan sites on a fixed virtual
+        cadence: spec trigger counts (after/times) translate to
+        deterministic simulated kill times."""
+        while True:
+            await asyncio.sleep(self.cfg.chaos_tick_s)
+            for pod in self.store.list():
+                rep = self.replicas.get(pod.address)
+                if rep is None or not rep.alive:
+                    continue
+                if faults.fires("replica.crash", pod.address):
+                    rep.kill()
+                    self.board.record_kill(pod.address, clock.monotonic())
+
+    # ---- the request path (mirrors Router._route_and_proxy) ----------- #
+
+    async def _handle(self, treq: TraceRequest) -> None:
+        # Unique prompt text: head identifies the request (so approx
+        # prefix hashing sees cold prompts, engaging no-hit-lru spread),
+        # padding makes approx_prompt_tokens track the trace's size.
+        pad = max(0, treq.prompt_tokens * 4 - len(treq.request_id) - 8)
+        req = LLMRequest(
+            request_id=treq.request_id,
+            model=self.cfg.model_id,
+            prompt_text=f"{treq.tenant}:{treq.request_id}:" + "x" * pad,
+            priority=treq.priority,
+            fairness_id=treq.tenant,
+            ttft_slo_ms=treq.ttft_slo_ms,
+        )
+        outcome = await self.flow.enqueue_and_wait(
+            req, nbytes=treq.prompt_tokens
+        )
+        if outcome is not Outcome.DISPATCHED:
+            self.board.record_outcome(treq.tenant, f"flow-{outcome.value}")
+            return
+        try:
+            for producer in self.producers:
+                await producer.produce(req, self.store.list())
+            await self._route(req, treq)
+        finally:
+            self.flow.release()
+
+    async def _route(self, req: LLMRequest, treq: TraceRequest) -> None:
+        tried: set[str] = set()
+        prev_backoff = self.cfg.retry_backoff_s
+        first_fail_after_kill: float | None = None
+        for attempt in range(self.cfg.max_schedule_attempts):
+            pods = eligible_pods(self.store.list(), tried, self.breaker)
+            try:
+                result = self.scheduler.schedule(req, pods)
+            except NoEndpointsError:
+                self.board.record_outcome(treq.tenant, "no-endpoints")
+                return
+            pod = result.primary
+            tried.add(pod.address)
+            if not self.breaker.take_probe(pod.address):
+                # Same dispatch-time gate as the router's proxy leg:
+                # a contested half-open probe means re-pick, not fail.
+                continue
+            replica = self.replicas.get(pod.address)
+            pod.inflight += 1
+            pod.inflight_tokens += req.approx_prompt_tokens
+            t0 = clock.monotonic()
+            first: float | None = None
+            try:
+                # The same injection site the router's proxy leg consults.
+                if replica is None or faults.fires(
+                    "epp.endpoint.refuse", pod.address
+                ):
+                    raise ReplicaUnreachable(pod.address)
+                async for _ in replica.serve(
+                    req.request_id, treq.prompt_tokens, treq.output_tokens
+                ):
+                    if first is None:
+                        first = clock.monotonic()
+                done = clock.monotonic()
+                self.breaker.record_success(pod.address)
+                ttft_s = (first if first is not None else done) - t0
+                tpot_ms = None
+                if treq.output_tokens > 1 and first is not None:
+                    tpot_ms = (done - first) * 1e3 / (treq.output_tokens - 1)
+                pod.attrs["LastTTFT"] = ttft_s
+                pod.attrs["LastE2E"] = done - t0
+                if tpot_ms is not None:
+                    pod.attrs["LastTPOT"] = tpot_ms / 1e3
+                self.scheduler.notify_complete(req, pod)
+                for producer in self.producers:
+                    await producer.on_complete(
+                        req, pod, ttft_s * 1e3, tpot_ms
+                    )
+                if first_fail_after_kill is not None and first is not None:
+                    self.board.record_reroute(first - first_fail_after_kill)
+                self.board.record_completion(
+                    treq.tenant, pod.address, ttft_s, tpot_ms, attempt
+                )
+                return
+            except (ReplicaUnreachable, ReplicaDied):
+                self.breaker.record_failure(pod.address)
+                if pod.address in self.board.kills and self.breaker.is_open(
+                    pod.address
+                ):
+                    self.board.record_breaker_open(
+                        pod.address, clock.monotonic()
+                    )
+                if first is not None:
+                    # Bytes already streamed: the router cannot replay
+                    # them — surface a typed stream error, never retry
+                    # into a duplicated prefix.
+                    self.board.record_outcome(
+                        treq.tenant, "stream-interrupted"
+                    )
+                    return
+                # Nothing streamed: treat like a failed scrape and
+                # re-pick (the production connection-error branch).
+                pod.healthy = False
+                if pod.address in self.board.kills and (
+                    first_fail_after_kill is None
+                ):
+                    first_fail_after_kill = clock.monotonic()
+                if attempt + 1 < self.cfg.max_schedule_attempts:
+                    prev_backoff = backoff_delay(
+                        prev_backoff,
+                        self.cfg.retry_backoff_s,
+                        self.cfg.retry_backoff_cap_s,
+                        self._retry_rng,
+                    )
+                    await asyncio.sleep(prev_backoff)
+            finally:
+                pod.inflight = max(0, pod.inflight - 1)
+                pod.inflight_tokens = max(
+                    0, pod.inflight_tokens - req.approx_prompt_tokens
+                )
+        self.board.record_outcome(treq.tenant, "all-endpoints-failed")
+
+    # ---- the run ------------------------------------------------------ #
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_event_loop()
+        for treq in self.trace:
+            delay = treq.t - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self.board.record_arrival(treq.tenant)
+            self._tasks.append(
+                (asyncio.ensure_future(self._handle(treq)), treq)
+            )
+
+    async def _run(self) -> dict:
+        fail_open_base = filters_mod.fail_open_total()
+        if self.fault_plan is not None:
+            faults.arm(faults.FaultPlan(
+                [faults.FaultSpec(**s) for s in
+                 self.fault_plan.get("faults", [])],
+                seed=int(self.fault_plan.get("seed", self.seed)),
+            ))
+        else:
+            faults.disarm()
+        collector = _SimCollector(
+            self,
+            interval_s=self.cfg.scrape_interval_s,
+            unhealthy_after=self.cfg.unhealthy_after,
+            engine_type_default="llmd",
+        )
+        wva_collector: _SimWvaCollector | None = None
+        try:
+            self.store.on_remove(self.scheduler.notify_endpoint_removed)
+            self.store.on_remove(self.breaker.forget)
+            for _ in range(self.cfg.replicas):
+                self._add_replica()
+            self.board.replicas_started.clear()  # the seed fleet is free
+            if self.flow.saturation.pool_stats is None:
+                self.flow.saturation.pool_stats = self._pool_stats
+            await collector.scrape_once()
+            collector.start()
+            self.flow.start()
+            chaos = asyncio.ensure_future(self._chaos_ticker())
+            if self.cfg.autoscale is not None:
+                asc = self.cfg.autoscale
+                wva_collector = _SimWvaCollector(self, asc.retention_s)
+                self.wva = WvaEngine(
+                    wva_collector,
+                    {self.cfg.model_id: [VariantSpec(
+                        name="sim",
+                        cost=1.0,
+                        min_replicas=asc.min_replicas,
+                        max_replicas=asc.max_replicas,
+                        max_batched_tokens=2048,
+                        max_num_seqs=self.cfg.profile.max_batch,
+                    )]},
+                    analyzer=asc.analyzer,
+                    interval_s=asc.interval_s,
+                    scale_from_zero_interval_s=asc.sfz_interval_s,
+                    scale_to_zero=asc.scale_to_zero,
+                    actuator=self._apply_decisions,
+                )
+                self.wva.start()
+            await self._pump()
+            if self._tasks:
+                done, pending = await asyncio.wait(
+                    [t for t, _ in self._tasks],
+                    timeout=self.cfg.grace_s,
+                )
+                for task, treq in self._tasks:
+                    if task in pending:
+                        self.board.record_hung(treq.request_id)
+                        task.cancel()
+                    elif task.done() and not task.cancelled():
+                        exc = task.exception()
+                        if exc is not None:
+                            raise exc
+            if self.cfg.idle_tail_s > 0:
+                await asyncio.sleep(self.cfg.idle_tail_s)
+            chaos.cancel()
+            if self.wva is not None:
+                await self.wva.stop()
+            await collector.stop()
+            await self.flow.drain()
+            injected = faults.injected_counts()
+        finally:
+            faults.disarm()
+        recompute = sum(
+            r.recompute_fallbacks for r in self.replicas.values()
+        )
+        return self.board.finalize(
+            duration_s=max(self._duration, 1e-9),
+            invariants=self.invariants,
+            fail_open_count=filters_mod.fail_open_total() - fail_open_base,
+            breaker_trips=self.breaker.trips_total,
+            breaker_opened=sorted(self.board.breaker_open_after_kill_s),
+            faults_injected=injected,
+            recompute_fallbacks=recompute,
+        )
+
+    def _pool_stats(self) -> tuple[float, float]:
+        pods = self.store.list()
+        if not pods:
+            return 1.0, float("inf")  # empty pool counts as saturated
+        kv = sum(p.attr(KV_CACHE_USAGE) for p in pods) / len(pods)
+        q = sum(p.attr(WAITING_QUEUE_SIZE) for p in pods) / len(pods)
+        return kv, q
+
+    def run(self) -> dict:
+        """Execute the scenario on a fresh virtual-time loop."""
+        return simloop.run(self._run())

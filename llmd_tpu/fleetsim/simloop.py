@@ -1,0 +1,121 @@
+"""Virtual-time asyncio event loop: minutes of fleet time in CI seconds.
+
+The fleet simulator's core trick is that it drives the REAL control
+stack — flow control's dispatch loop, the metrics collector's scrape
+cadence, the WVA's 30 s pipeline, retry backoffs — through ordinary
+``asyncio.sleep`` calls, on an event loop whose clock is simulated:
+
+- :class:`SimEventLoop` overrides ``time()`` to return a virtual clock
+  that starts at 0.0, and wraps its selector so that a positive select
+  timeout (i.e. "nothing runnable until the next timer") ADVANCES the
+  virtual clock to that timer instead of blocking the thread. Every
+  scheduled callback still fires in exactly the order and at exactly
+  the (virtual) times real asyncio would run them — the interleaving
+  semantics are asyncio's own, only the waiting is erased.
+- The control stack reads time through :mod:`llmd_tpu.clock`;
+  :func:`run` installs ``loop.time`` there for the duration of the
+  simulation, so breaker cooldowns, flow-control TTLs/EDF deadlines and
+  scrape freshness all live on the same virtual axis as the sleeps.
+
+Determinism: the ready queue is FIFO and the timer heap is keyed on
+(virtual when, schedule order), both fully determined by the program —
+no wall clock, no thread scheduling, no I/O readiness races (the
+simulator performs no real I/O). The same trace + seed therefore
+replays to a byte-identical scoreboard, which CI asserts.
+
+Deadlock detection is free: real asyncio would block in ``select(None)``
+forever when nothing is ready, nothing is scheduled and no I/O can
+arrive. In a simulation that state means some coroutine is waiting on
+an event nobody will ever set — a HUNG request, exactly the failure
+class the soak exists to catch — so the loop raises
+:class:`SimDeadlockError` instead of hanging CI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Coroutine
+
+from llmd_tpu import clock
+
+
+class SimDeadlockError(RuntimeError):
+    """The simulation has runnable future but no timer and no ready
+    callback: some coroutine waits on an event that can never fire."""
+
+
+class _InstantSelector:
+    """Selector proxy: positive timeouts become virtual-clock advances.
+
+    asyncio's ``_run_once`` computes ``timeout = next_timer_when -
+    loop.time()`` and blocks in ``selector.select(timeout)``. With no
+    real I/O registered beyond the loop's internal self-pipe, that block
+    is pure waiting — so advance the virtual clock by ``timeout`` and
+    poll (timeout 0) instead.
+    """
+
+    def __init__(self, inner, loop: "SimEventLoop") -> None:
+        self._inner = inner
+        self._loop = loop
+
+    def select(self, timeout=None):
+        if timeout is None:
+            # No ready callbacks, no scheduled timers, not stopping:
+            # real asyncio would block forever here.
+            raise SimDeadlockError(
+                "simulation deadlock: no runnable callback, no scheduled "
+                "timer — a coroutine is awaiting an event that can never "
+                "fire (a hung request or an un-cancelled waiter)"
+            )
+        if timeout > 0:
+            self._loop.advance(timeout)
+        return self._inner.select(0)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class SimEventLoop(asyncio.SelectorEventLoop):
+    """A selector event loop on simulated time (starts at 0.0)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._sim_now = 0.0
+        self._selector = _InstantSelector(self._selector, self)
+
+    def time(self) -> float:
+        return self._sim_now
+
+    def advance(self, dt: float) -> None:
+        """Jump the virtual clock forward by ``dt`` seconds."""
+        if dt > 0:
+            self._sim_now += dt
+
+
+def run(main: Coroutine, install_clock: bool = True) -> Any:
+    """``asyncio.run`` on a fresh :class:`SimEventLoop`.
+
+    Installs the loop's virtual clock into :mod:`llmd_tpu.clock` for the
+    duration (restored in a ``finally``), cancels leftover tasks on the
+    way out, and returns the coroutine's result.
+    """
+    loop = SimEventLoop()
+    try:
+        if install_clock:
+            clock.install(loop.time)
+        asyncio.set_event_loop(loop)
+        return loop.run_until_complete(main)
+    finally:
+        if install_clock:
+            clock.reset()
+        try:
+            pending = asyncio.all_tasks(loop)
+            for task in pending:
+                task.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()

@@ -1,0 +1,136 @@
+"""Seeded trace generation + JSONL replay for the fleet soak.
+
+A trace is a list of :class:`TraceRequest` arrivals on the simulator's
+virtual time axis (seconds from scenario start). Two sources:
+
+- :func:`generate` — a seeded inhomogeneous-Poisson generator with
+  three production-shaped rate curves (``steady``, ``burst``,
+  ``diurnal``) and a weighted multi-tenant mix. The same (kind, qps,
+  duration, seed, …) arguments always produce the identical trace —
+  the first link in the byte-identical-scoreboard chain.
+- :func:`load_jsonl` / :func:`save_jsonl` — the replay format: one JSON
+  object per line, fields exactly the :class:`TraceRequest` fields with
+  ``null`` for an absent SLO. Captured production traces (or hand-built
+  regression traces) replay through the same pipeline as generated
+  ones.
+
+JSONL line schema (documented in docs/architecture/fleet-soak.md)::
+
+    {"t": 0.0132, "request_id": "r000001", "tenant": "tenant-0",
+     "prompt_tokens": 128, "output_tokens": 8, "priority": 0,
+     "ttft_slo_ms": null}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import pathlib
+import random
+from typing import Iterable, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceRequest:
+    """One arrival in the replayed fleet workload."""
+
+    t: float  # arrival, seconds of sim time from scenario start
+    request_id: str
+    tenant: str = "tenant-0"
+    prompt_tokens: int = 128
+    output_tokens: int = 8
+    priority: int = 0
+    ttft_slo_ms: float | None = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def save_jsonl(path: str | pathlib.Path, reqs: Iterable[TraceRequest]) -> None:
+    with open(path, "w") as f:
+        for r in reqs:
+            f.write(json.dumps(r.to_dict(), sort_keys=True) + "\n")
+
+
+def load_jsonl(path: str | pathlib.Path) -> list[TraceRequest]:
+    out: list[TraceRequest] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            out.append(TraceRequest(**d))
+    out.sort(key=lambda r: r.t)
+    return out
+
+
+# ---- rate curves ------------------------------------------------------ #
+
+
+def _rate(kind: str, qps: float, t: float, duration_s: float,
+          burst_factor: float, diurnal_floor: float) -> float:
+    if kind == "steady":
+        return qps
+    if kind == "burst":
+        # A burst_factor x spike over the middle fifth of the window:
+        # flow control must absorb the spike, fairness must hold inside it.
+        lo, hi = 0.4 * duration_s, 0.6 * duration_s
+        return qps * burst_factor if lo <= t < hi else qps
+    if kind == "diurnal":
+        # One full day-shaped cycle across the window, troughing near
+        # the floor (scale-to-zero territory) and peaking at qps.
+        phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / duration_s))
+        return qps * (diurnal_floor + (1.0 - diurnal_floor) * phase)
+    raise ValueError(f"unknown trace kind {kind!r} (steady|burst|diurnal)")
+
+
+def generate(
+    kind: str = "steady",
+    qps: float = 1000.0,
+    duration_s: float = 2.0,
+    seed: int = 0,
+    tenants: Sequence[tuple[str, float]] = (("tenant-0", 1.0),),
+    prompt_tokens: int = 128,
+    output_tokens: int = 8,
+    token_jitter: float = 0.25,
+    burst_factor: float = 5.0,
+    diurnal_floor: float = 0.02,
+    ttft_slo_ms: float | None = None,
+) -> list[TraceRequest]:
+    """Seeded inhomogeneous-Poisson arrivals with a weighted tenant mix.
+
+    Arrivals are drawn by thinning: candidates at the curve's peak rate,
+    each kept with probability ``rate(t)/peak`` — exact for an
+    inhomogeneous Poisson process and correct through zero-rate troughs
+    (a gap-sampler at the local rate would jump clean over them).
+    Per-request token counts jitter uniformly within ``±token_jitter``
+    of the means, so the fleet sees realistically ragged work, not a
+    metronome.
+    """
+    rng = random.Random(seed)
+    names = [t for t, _ in tenants]
+    weights = [w for _, w in tenants]
+    peak = qps * (burst_factor if kind == "burst" else 1.0)
+    out: list[TraceRequest] = []
+    t = 0.0
+    i = 0
+    while True:
+        t += rng.expovariate(max(peak, 1e-6))
+        if t >= duration_s:
+            break
+        rate = _rate(kind, qps, t, duration_s, burst_factor, diurnal_floor)
+        if rng.random() >= rate / peak:
+            continue
+        jit = 1.0 + token_jitter * (2.0 * rng.random() - 1.0)
+        out.append(TraceRequest(
+            t=t,
+            request_id=f"r{i:06d}",
+            tenant=rng.choices(names, weights=weights, k=1)[0],
+            prompt_tokens=max(1, round(prompt_tokens * jit)),
+            output_tokens=max(1, round(output_tokens * jit)),
+            ttft_slo_ms=ttft_slo_ms,
+        ))
+        i += 1
+    return out

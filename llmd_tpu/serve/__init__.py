@@ -6,7 +6,22 @@ docs/architecture/core/model-servers.md:38-100 — OpenAI API + Prometheus
 metrics protocol + /health).
 """
 
-from llmd_tpu.serve.async_engine import AsyncEngine
-from llmd_tpu.serve.tokenizer import ByteTokenizer, load_tokenizer
+# Lazy (PEP 562): AsyncEngine pulls the whole jax engine at import.
+# Accelerator-free consumers — the EPP data layer and the fleet
+# simulator's control-plane imports reach llmd_tpu.serve.metrics
+# (parse_prometheus, pure stdlib) — must not pay for (or require) jax
+# just to touch the package.
 
 __all__ = ["AsyncEngine", "ByteTokenizer", "load_tokenizer"]
+
+
+def __getattr__(name):
+    if name == "AsyncEngine":
+        from llmd_tpu.serve.async_engine import AsyncEngine
+
+        return AsyncEngine
+    if name in ("ByteTokenizer", "load_tokenizer"):
+        from llmd_tpu.serve import tokenizer
+
+        return getattr(tokenizer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

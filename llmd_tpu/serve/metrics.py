@@ -10,8 +10,16 @@ vllm mapping) and `llmd:` canonical names.
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 from llmd_tpu import faults
-from llmd_tpu.engine.engine import EngineStats
+
+if TYPE_CHECKING:
+    # Annotation-only: importing EngineStats at runtime drags the whole
+    # jax engine in, and this module's scrape-side half
+    # (parse_prometheus) serves accelerator-free consumers — the EPP
+    # data layer and the fleet simulator's control-plane imports.
+    from llmd_tpu.engine.engine import EngineStats
 
 
 def render_metrics(

@@ -60,11 +60,32 @@ def test_v1_scale_up_on_queue_pressure():
 
 
 def test_v1_scale_down_with_headroom():
-    a = SaturationPercentAnalyzer()
-    # 3 idle replicas: removing one leaves 2 with plenty of headroom
+    a = SaturationPercentAnalyzer(down_stabilization_cycles=3)
+    # 3 idle replicas: removing one leaves 2 with plenty of headroom —
+    # but only after the condition HOLDS for the stabilization window
+    # (one instantaneous headroom reading near a load peak must not
+    # free a replica; the fleet soak's diurnal scenario gates the
+    # oscillation this prevents).
     snap = PoolSnapshot("m", replicas=[replica(kv=0.1), replica(kv=0.1), replica(kv=0.1)])
+    for _ in range(2):
+        sig = a.analyze(snap)
+        assert sig.spare == 0.0 and sig.required == 0.0
     sig = a.analyze(snap)
     assert sig.spare == 1.0 and sig.required == 0.0
+    # The streak consumed itself: the next window starts from zero.
+    assert a.analyze(snap).spare == 0.0
+
+
+def test_v1_scale_down_streak_resets_on_pressure():
+    a = SaturationPercentAnalyzer(down_stabilization_cycles=2)
+    idle = PoolSnapshot(
+        "m", replicas=[replica(kv=0.1), replica(kv=0.1), replica(kv=0.1)]
+    )
+    loaded = PoolSnapshot("m", replicas=[replica(q=4.0), replica(q=3.0)])
+    assert a.analyze(idle).spare == 0.0  # streak 1/2
+    assert a.analyze(loaded).required == 1.0  # pressure: streak resets
+    assert a.analyze(idle).spare == 0.0  # streak 1/2 again, not 2/2
+    assert a.analyze(idle).spare == 1.0
 
 
 def test_v1_no_scale_down_when_redistribution_would_saturate():

@@ -634,13 +634,12 @@ async def test_engine_ready_flips_on_pause_and_drain():
 # EPP circuit breaker semantics (unit)
 
 
-def test_circuit_breaker_threshold_cooldown_halfopen(monkeypatch):
-    from llmd_tpu.epp import breaker as breaker_mod
+def test_circuit_breaker_threshold_cooldown_halfopen():
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
 
     now = [1000.0]
-    monkeypatch.setattr(breaker_mod.time, "monotonic", lambda: now[0])
-    b = breaker_mod.EndpointCircuitBreaker(
-        failure_threshold=2, cooldown_s=10.0
+    b = EndpointCircuitBreaker(
+        failure_threshold=2, cooldown_s=10.0, clock=lambda: now[0]
     )
     b.record_failure("a")
     assert not b.is_open("a")          # below threshold
@@ -649,9 +648,10 @@ def test_circuit_breaker_threshold_cooldown_halfopen(monkeypatch):
     assert b.trips_total == 1
     assert b.open_endpoints() == ["a"]
     now[0] += 11
-    assert not b.is_open("a")          # cooldown elapsed: half-open probe
+    assert not b.is_open("a")          # cooldown elapsed: candidate again
     b.record_failure("a")
     assert b.is_open("a")              # one probe failure re-opens at once
+    assert b.trips_total == 2          # open->half-open->open transition
     now[0] += 11
     b.record_success("a")
     assert not b.is_open("a")
@@ -661,6 +661,94 @@ def test_circuit_breaker_threshold_cooldown_halfopen(monkeypatch):
     b.forget("b")
     b.record_failure("b")
     assert not b.is_open("b")          # forget() cleared breaker state
+
+
+def test_circuit_breaker_halfopen_single_probe_concurrency():
+    """Two concurrent probes during half-open must not race: exactly
+    one dispatch wins the probe grant, and its resolution can neither
+    double-close nor double-trip the circuit. Schedule-time is_open()
+    is NON-consuming: filtering a half-open endpoint into the
+    candidate set and then routing elsewhere must not burn the grant
+    (that would exclude a recovered replica for another full cooldown
+    per wasted filter pass)."""
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+
+    now = [0.0]
+    b = EndpointCircuitBreaker(
+        failure_threshold=2, cooldown_s=10.0, clock=lambda: now[0]
+    )
+    b.record_failure("a")
+    b.record_failure("a")
+    assert b.is_open("a") and b.trips_total == 1
+    assert b.take_probe("a")           # fully open: fail-open dispatch allowed
+    now[0] += 11.0
+    # Filter passes never consume the grant...
+    assert not b.is_open("a")
+    assert not b.is_open("a")
+    # ...dispatch does: the FIRST take_probe wins the single probe; a
+    # concurrent dispatch is held out, and filtering reads True while
+    # the probe is in flight.
+    assert b.take_probe("a")
+    assert not b.take_probe("a")
+    assert b.is_open("a")
+    # Probe FAILS (plus a straggler failure from an old in-flight
+    # request): re-opens exactly once — one extra trip, cooldown not
+    # pushed out by the straggler.
+    b.record_failure("a")
+    b.record_failure("a")
+    assert b.trips_total == 2
+    assert b.is_open("a")
+    until_after = b._open_until["a"]
+    assert until_after == now[0] + 10.0
+    # Next half-open: probe SUCCEEDS; a second concurrent success is a
+    # no-op (no double-close weirdness, state fully reset once).
+    now[0] += 11.0
+    assert b.take_probe("a")           # the probe grant
+    b.record_success("a")
+    b.record_success("a")
+    assert not b.is_open("a")
+    assert b.trips_total == 2
+    # Fully closed again: one failure is below threshold.
+    b.record_failure("a")
+    assert not b.is_open("a")
+
+
+def test_circuit_breaker_unresolved_probe_expires():
+    """A granted probe whose caller never reports back (re-scored onto
+    another pod, caller died) must not lock the endpoint out: the grant
+    expires after another cooldown and a fresh probe is allowed."""
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+
+    now = [0.0]
+    b = EndpointCircuitBreaker(
+        failure_threshold=1, cooldown_s=5.0, clock=lambda: now[0]
+    )
+    b.record_failure("a")
+    now[0] += 6.0
+    assert b.take_probe("a")           # probe granted, never resolved
+    assert b.is_open("a")              # held while the grant is fresh
+    assert not b.take_probe("a")
+    now[0] += 5.0
+    assert not b.is_open("a")          # grant expired: a candidate again
+    assert b.take_probe("a")           # ...and a fresh probe to claim
+
+
+def test_circuit_breaker_env_configurable(monkeypatch):
+    """LLMD_EPP_BREAKER_* env defaults let the soak sweep thresholds
+    without code changes; explicit arguments still win."""
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+
+    monkeypatch.setenv("LLMD_EPP_BREAKER_THRESHOLD", "5")
+    monkeypatch.setenv("LLMD_EPP_BREAKER_COOLDOWN_S", "42.5")
+    b = EndpointCircuitBreaker()
+    assert b.failure_threshold == 5
+    assert b.cooldown_s == 42.5
+    explicit = EndpointCircuitBreaker(failure_threshold=1, cooldown_s=2.0)
+    assert explicit.failure_threshold == 1
+    assert explicit.cooldown_s == 2.0
+    monkeypatch.delenv("LLMD_EPP_BREAKER_THRESHOLD")
+    monkeypatch.delenv("LLMD_EPP_BREAKER_COOLDOWN_S")
+    assert EndpointCircuitBreaker().failure_threshold == 2
 
 
 # --------------------------------------------------------------------- #

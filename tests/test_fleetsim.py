@@ -1,0 +1,314 @@
+"""Fleet-scale chaos soak (llmd_tpu/fleetsim): the virtual-time loop,
+the seeded trace generator + JSONL replay, and the scenario matrix's
+recovery invariants at test scale — plus the retry-jitter and
+eligible-pods helpers the simulator shares with the production router.
+
+The acceptance-critical pins: the same trace + FaultPlan seed yields a
+BYTE-IDENTICAL scoreboard across two runs; a replica-kill scenario
+loses zero requests, reroutes within bound, and shows the breaker
+opening; a hung request is surfaced as `hung`, never silently dropped.
+(CI's `soak` job runs the same matrix at full >=10^4-QPS scale.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+import time as _wall
+
+import pytest
+
+from llmd_tpu import clock
+from llmd_tpu.fleetsim import simloop, traces
+from llmd_tpu.fleetsim.scenarios import SCENARIOS
+from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+from llmd_tpu.fleetsim.sim import FleetConfig, FleetSim
+from llmd_tpu.fleetsim.engines import ReplicaProfile
+
+
+# ------------------------------------------------------------------ #
+# virtual-time loop
+
+
+def test_simloop_virtual_sleeps_order_and_speed():
+    async def main():
+        order = []
+
+        async def sleeper(name, dt):
+            await asyncio.sleep(dt)
+            order.append((name, asyncio.get_event_loop().time()))
+
+        await asyncio.gather(
+            sleeper("b", 120.0), sleeper("a", 60.0), sleeper("c", 3600.0)
+        )
+        return order
+
+    t0 = _wall.monotonic()
+    order = simloop.run(main())
+    wall = _wall.monotonic() - t0
+    assert [n for n, _ in order] == ["a", "b", "c"]
+    assert [t for _, t in order] == [60.0, 120.0, 3600.0]
+    assert wall < 5.0  # an hour of fleet time in real seconds
+
+
+def test_simloop_installs_and_restores_clock_seam():
+    async def main():
+        await asyncio.sleep(42.0)
+        return clock.monotonic()
+
+    assert not clock.installed()
+    assert simloop.run(main()) == 42.0
+    assert not clock.installed()
+
+
+def test_simloop_detects_deadlock_instead_of_hanging():
+    async def dead():
+        await asyncio.get_event_loop().create_future()
+
+    with pytest.raises(simloop.SimDeadlockError):
+        simloop.run(dead())
+
+
+# ------------------------------------------------------------------ #
+# traces
+
+
+def test_trace_generator_is_seeded_and_shapes_rates():
+    a = traces.generate("steady", qps=500, duration_s=2.0, seed=7)
+    b = traces.generate("steady", qps=500, duration_s=2.0, seed=7)
+    assert a == b
+    assert traces.generate("steady", qps=500, duration_s=2.0, seed=8) != a
+    assert 700 <= len(a) <= 1300  # ~1000 arrivals, Poisson slack
+    # Burst: the middle fifth runs ~5x the edges.
+    burst = traces.generate("burst", qps=500, duration_s=2.0, seed=7,
+                            burst_factor=5.0)
+    mid = sum(1 for r in burst if 0.8 <= r.t < 1.2)
+    edge = sum(1 for r in burst if r.t < 0.4)
+    assert mid > 2.5 * edge
+    # Diurnal: troughs at the edges actually reach (near) zero rate.
+    di = traces.generate("diurnal", qps=500, duration_s=10.0, seed=7,
+                         diurnal_floor=0.0)
+    assert di, "thinning must survive zero-rate troughs"
+    head = sum(1 for r in di if r.t < 1.0)
+    peak = sum(1 for r in di if 4.5 <= r.t < 5.5)
+    assert peak > 3 * max(head, 1)
+
+
+def test_trace_jsonl_round_trip(tmp_path):
+    trace = traces.generate(
+        "steady", qps=200, duration_s=0.5, seed=3,
+        tenants=(("a", 1.0), ("b", 2.0)), ttft_slo_ms=250.0,
+    )
+    p = tmp_path / "trace.jsonl"
+    traces.save_jsonl(p, trace)
+    loaded = traces.load_jsonl(p)
+    assert loaded == sorted(trace, key=lambda r: r.t)
+    assert loaded[0].ttft_slo_ms == 250.0
+
+
+# ------------------------------------------------------------------ #
+# the router-shared helpers (satellite: decorrelated jitter)
+
+
+def test_backoff_delay_decorrelated_jitter_bounds():
+    from llmd_tpu.epp.server import backoff_delay
+
+    rng = random.Random(0)
+    base, cap = 0.05, 1.0
+    prev = base
+    seen = []
+    for _ in range(50):
+        prev = backoff_delay(prev, base, cap, rng)
+        assert base <= prev <= cap
+        seen.append(prev)
+    # Jitter actually spreads (not the old deterministic doubling series).
+    assert len({round(s, 6) for s in seen}) > 10
+    # Seeded determinism: the soak replays the same delays.
+    rng2 = random.Random(0)
+    prev2, seen2 = base, []
+    for _ in range(50):
+        prev2 = backoff_delay(prev2, base, cap, rng2)
+        seen2.append(prev2)
+    assert seen == seen2
+
+
+def test_router_retry_backoff_env_defaults(monkeypatch):
+    from llmd_tpu.epp.scheduler import Scheduler, SingleProfileHandler
+    from llmd_tpu.epp.plugins import SchedulingProfile
+    from llmd_tpu.epp.datalayer import EndpointStore
+    from llmd_tpu.epp.server import Router
+
+    monkeypatch.setenv("LLMD_EPP_RETRY_BACKOFF_S", "0.125")
+    monkeypatch.setenv("LLMD_EPP_RETRY_BACKOFF_CAP_S", "2.5")
+    scheduler = Scheduler(
+        {"default": SchedulingProfile("default")}, SingleProfileHandler()
+    )
+    r = Router(EndpointStore(), scheduler)
+    assert r.retry_backoff_s == 0.125
+    assert r.retry_backoff_cap_s == 2.5
+    explicit = Router(
+        EndpointStore(), scheduler, retry_backoff_s=0.01,
+        retry_backoff_cap_s=0.2,
+    )
+    assert explicit.retry_backoff_s == 0.01
+    assert explicit.retry_backoff_cap_s == 0.2
+
+
+def test_eligible_pods_fail_open_on_all_open_breakers():
+    from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+    from llmd_tpu.epp.server import eligible_pods
+    from llmd_tpu.epp.types import Endpoint
+
+    now = [0.0]
+    b = EndpointCircuitBreaker(
+        failure_threshold=1, cooldown_s=10.0, clock=lambda: now[0]
+    )
+    pods = [Endpoint(address=f"p{i}") for i in range(3)]
+    b.record_failure("p0")
+    kept = eligible_pods(pods, set(), b)
+    assert [p.address for p in kept] == ["p1", "p2"]
+    b.record_failure("p1")
+    b.record_failure("p2")
+    # Every circuit open: degrade to trying rather than a manufactured 503.
+    kept = eligible_pods(pods, set(), b)
+    assert [p.address for p in kept] == ["p0", "p1", "p2"]
+    # Tried-set exclusion composes.
+    kept = eligible_pods(pods, {"p0"}, b)
+    assert [p.address for p in kept] == ["p1", "p2"]
+
+
+# ------------------------------------------------------------------ #
+# the scenario matrix at test scale
+
+
+def _run(name: str, scale: float, seed: int = 0) -> dict:
+    return SCENARIOS[name].build(seed, scale).run()
+
+
+def test_steady_scenario_holds_slos_and_loses_nothing():
+    board = _run("steady", 0.05)
+    assert board["ok"], board["invariants"]
+    assert board["requests"]["lost"] == 0
+    assert board["requests"]["hung"] == 0
+    assert board["latency_ms"]["ttft"]["p99"] > 0
+
+
+def test_scoreboard_is_byte_identical_across_runs():
+    """THE determinism bar: same trace + FaultPlan seed, byte-identical
+    scoreboard JSON — run the CHAOS scenario twice in one process."""
+    a = to_canonical_json(_run("replica_kill", 0.1))
+    b = to_canonical_json(_run("replica_kill", 0.1))
+    assert a == b
+    # And the seed actually matters (the matrix is not constant-output).
+    c = to_canonical_json(_run("replica_kill", 0.1, seed=1))
+    assert c != a
+
+
+def test_replica_kill_zero_lost_bounded_reroute_breaker_visible():
+    board = _run("replica_kill", 0.2)
+    assert board["ok"], board["invariants"]
+    # Two replicas really died, mid-run.
+    assert board["faults_injected"]["replica.crash"] == 2
+    assert len(board["reroute"]["kills"]) == 2
+    # Every in-flight request on the dead replicas was re-picked or
+    # surfaced typed — none hung, none lost.
+    assert board["requests"]["lost"] == 0
+    assert board["requests"]["hung"] == 0
+    outcomes = board["requests"]["outcomes"]
+    accounted = sum(outcomes.values())
+    assert accounted == board["trace"]["requests"]
+    # The kill is VISIBLE: breaker opened for both addresses within the
+    # cooldown-free fast path, and reroutes were recorded and bounded.
+    assert set(board["reroute"]["breaker_open_after_kill_s"]) == set(
+        board["reroute"]["kills"]
+    )
+    assert board["breaker"]["trips_total"] >= 2
+    assert board["reroute"]["rerouted_requests"] >= 1
+    assert 0 < board["reroute"]["time_to_reroute_s"] <= 1.0
+
+
+def test_burst_fairness_defends_light_tenants():
+    board = _run("burst", 0.1)
+    assert board["ok"], board["invariants"]
+    for t in ("light-0", "light-1", "light-2"):
+        assert board["per_tenant"][t]["completion_ratio"] >= 0.98
+
+
+def test_brownout_steers_load_off_slow_replica():
+    board = _run("brownout", 0.5)
+    assert board["ok"], board["invariants"]
+    per = board["replicas"]["completed_per_replica"]
+    slow = per.get("10.0.0.1:8000", 0)
+    others = [n for a, n in per.items() if a != "10.0.0.1:8000"]
+    assert slow < min(others)
+
+
+def test_all_flap_fails_open_and_keeps_serving():
+    board = _run("all_flap", 0.2)
+    assert board["ok"], board["invariants"]
+    assert board["fail_open_total"] > 0
+    assert board["requests"]["outcomes"]["completed"] >= (
+        0.99 * board["trace"]["requests"]
+    )
+
+
+def test_diurnal_autoscale_reacts_without_oscillation():
+    board = _run("diurnal", 1.0)
+    assert board["ok"], board["invariants"]
+    hist = board["autoscale"]["history"]
+    assert max(n for _, n in hist) >= 2  # scaled up for the peak
+    assert hist[-1][1] == 0  # scaled to zero in the idle tail
+    assert board["autoscale"]["direction_flips"] <= 3
+
+
+def test_hung_requests_are_surfaced_not_lost():
+    """A replica that never finishes within the grace window produces a
+    `hung` record and fails zero_lost — the invariant can actually fire."""
+    from llmd_tpu.fleetsim import scoreboard as sb
+
+    profile = ReplicaProfile(
+        decode_tok_s=0.001, prefill_tok_s=0.001, base_tpot_s=10_000.0,
+        max_batch=4,
+    )
+    cfg = FleetConfig(replicas=1, profile=profile, grace_s=5.0)
+    trace = traces.generate("steady", qps=20, duration_s=0.2, seed=0)
+    board = FleetSim(
+        cfg, trace, seed=0, scenario="hung-test",
+        invariants=[("zero_lost", sb.inv_zero_lost)],
+    ).run()
+    assert board["requests"]["hung"] == len(trace)
+    # Hung arrivals are ACCOUNTED (the "hung" outcome), not lost — the
+    # two categories never double-count a request.
+    assert board["requests"]["lost"] == 0
+    assert board["requests"]["accounted"] == len(trace)
+    assert not board["ok"]
+    assert not board["invariants"]["zero_lost"]["ok"]
+
+
+def test_trace_replay_reproduces_generated_run(tmp_path):
+    """Replaying a saved JSONL trace yields the same scoreboard as the
+    generated trace it came from (the replay path is not a fork)."""
+    fleet = SCENARIOS["steady"].build(0, 0.02)
+    p = tmp_path / "t.jsonl"
+    traces.save_jsonl(p, fleet.trace)
+    a = to_canonical_json(fleet.run())
+    fleet2 = SCENARIOS["steady"].build(0, 0.02)
+    fleet2.trace = traces.load_jsonl(p)
+    b = to_canonical_json(fleet2.run())
+    assert a == b
+
+
+def test_replica_profile_from_bench(tmp_path):
+    missing = ReplicaProfile.from_bench(tmp_path / "nope.json", chips=2)
+    assert missing.decode_tok_s == pytest.approx(2 * 4914.0)
+    rec = tmp_path / "BENCH.json"
+    rec.write_text(
+        '{"parsed": {"value": 5000.0, "unit": "tok/s/chip"}}'
+    )
+    p = ReplicaProfile.from_bench(rec, chips=4)
+    assert p.decode_tok_s == pytest.approx(20_000.0)
+    assert p.prefill_tok_s == pytest.approx(80_000.0)
+    # dataclasses.replace-style overrides win
+    q = ReplicaProfile.from_bench(rec, chips=1, max_batch=16)
+    assert q.max_batch == 16 and dataclasses.asdict(q)["decode_tok_s"] == 5000.0

@@ -818,6 +818,85 @@ class TestBroadExcept:
         assert findings == []
 
 
+class TestDirectClock:
+    """clock discipline (fleet soak): the control stack reads time via
+    the llmd_tpu.clock seam so the simulator can drive it on virtual
+    time — direct time.time()/time.monotonic() in scope dirs fires."""
+
+    def test_direct_calls_fire(self, tmp_path):
+        fs = check(tmp_path, {
+            "epp/bad.py": """
+                import time
+
+                def deadline():
+                    return time.monotonic() + 10.0
+
+                def stamp():
+                    return time.time()
+            """,
+        }, ["direct-clock"])
+        assert [f.code for f in fs] == ["CK001", "CK001"]
+
+    def test_alias_and_reference_forms_fire(self, tmp_path):
+        fs = check(tmp_path, {
+            # an aliased import and a bare function REFERENCE (e.g. a
+            # dataclass default_factory) both split the clock plane
+            "autoscale/bad.py": """
+                import time as _time
+                import dataclasses
+
+                @dataclasses.dataclass
+                class S:
+                    t: float = dataclasses.field(default_factory=_time.monotonic)
+            """,
+            "predictor/bad.py": """
+                from time import monotonic
+
+                def now():
+                    return monotonic()
+            """,
+        }, ["direct-clock"])
+        assert [f.code for f in fs] == ["CK001", "CK001"]
+
+    def test_seam_sleep_and_out_of_scope_stay_quiet(self, tmp_path):
+        fs = check(tmp_path, {
+            "epp/good.py": """
+                import time
+
+                from llmd_tpu import clock
+
+                def deadline():
+                    return clock.monotonic() + 10.0
+
+                def backoff():
+                    time.sleep(0.1)  # blocking is visible, not a clock read
+            """,
+            # engine/ is hot-path scope, not control-plane scope
+            "engine/fine.py": """
+                import time
+
+                def stamp():
+                    return time.monotonic()
+            """,
+            "fleetsim/blessed.py": """
+                import time
+
+                def wall():
+                    # llmd: allow(direct-clock) -- wall time of the run itself
+                    return time.monotonic()
+            """,
+        }, ["direct-clock"])
+        assert fs == []
+
+    def test_real_control_tree_is_clean(self):
+        findings, _ = run_analysis(REPO, [
+            str(REPO / "llmd_tpu/epp"), str(REPO / "llmd_tpu/autoscale"),
+            str(REPO / "llmd_tpu/predictor"),
+            str(REPO / "llmd_tpu/fleetsim"),
+        ], ["direct-clock"])
+        assert findings == []
+
+
 class TestTreeGate:
     def test_tree_is_clean(self):
         """THE gate: the repo's own invariants hold. A finding here means
@@ -853,7 +932,8 @@ class TestTreeGate:
         assert out.returncode == 0
         for rule in (
             "host-sync", "trace-discipline", "lockstep", "metrics-parity",
-            "config-parity", "envvars", "broad-except", "pragma",
+            "config-parity", "envvars", "broad-except", "direct-clock",
+            "pragma",
         ):
             assert rule in out.stdout
 
