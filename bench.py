@@ -65,6 +65,13 @@ extras (north-star shapes, BASELINE.json):
                     breaker-open visible, byte-identical scoreboards
                     across two runs (the full >=10^4-QPS matrix runs in
                     the CI `soak` job).
+  kv_federation   — cross-replica KV-federation CPU-sim part
+                    (kv-federation.md): the kv_federation fleetsim
+                    scenario federated vs cold (store tier disabled) on
+                    the same trace — recompute_avoided_ratio (> 0, the
+                    fleet-wide reuse headline), exact virtual-time
+                    federated-vs-cold p50 TTFT ratio, byte-identical
+                    scoreboards across two federated runs.
 """
 
 from __future__ import annotations
@@ -912,6 +919,8 @@ def _run_part(part: str):
         return bench_fault_degrade()
     if part == "fleet_soak":
         return bench_fleet_soak()
+    if part == "kv_federation":
+        return bench_kv_federation()
     raise KeyError(part)
 
 
@@ -968,6 +977,62 @@ def bench_fleet_soak():
                 steady["fairness"]["jain_completed"], 4
             ),
         },
+    }
+
+
+def bench_kv_federation():
+    """Cross-replica KV-federation CPU-sim part (kv-federation.md): the
+    kv_federation fleetsim scenario — overlapping-tenant shared
+    prefixes, tight per-replica caches, seeded store-leg pull drops —
+    run FEDERATED (simulated store tier armed) and COLD (store
+    disabled, every shared prefix re-prefills), on the same trace and
+    seed. Virtual time is deterministic, so the TTFT comparison is
+    exact, not wall-clock noise: the headline is the fraction of
+    offered shared-prefix tokens the store erased
+    (recompute_avoided_ratio) and the federated-vs-cold p50 TTFT
+    ratio. Determinism is proven by running the federated leg twice
+    and comparing scoreboard bytes."""
+    from llmd_tpu.fleetsim.scenarios import build_kv_federation
+    from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+
+    scale = 0.5
+    seed = 0
+    fed_sim = build_kv_federation(seed, scale, store=True)
+    offered_prefix_tokens = sum(r.prefix_tokens for r in fed_sim.trace)
+    fed = fed_sim.run()
+    fed_b = build_kv_federation(seed, scale, store=True).run()
+    cold = build_kv_federation(seed, scale, store=False).run()
+    kf = fed["kv_federation"]
+    avoided = kf["recompute_avoided_tokens"]
+    return {
+        "qps_scale": scale,
+        "deterministic": (
+            to_canonical_json(fed) == to_canonical_json(fed_b)
+        ),
+        "invariants_ok": bool(fed["ok"] and cold["ok"]),
+        "zero_lost": (
+            fed["requests"]["lost"] == 0 and cold["requests"]["lost"] == 0
+        ),
+        "offered_prefix_tokens": offered_prefix_tokens,
+        "recompute_avoided_tokens": avoided,
+        # the summary-check headline: > 0 means fleet-wide reuse is real
+        "recompute_avoided_ratio": round(
+            avoided / max(1, offered_prefix_tokens), 4
+        ),
+        "store": kf["store"],
+        "store_published": kf["store_published"],
+        "store_hits": kf["store_hits"],
+        "local_prefix_hits": kf["local_prefix_hits"],
+        "dropped_pulls": kf["store"]["dropped_pulls"],
+        "p50_ttft_ms": {
+            "federated": round(fed["latency_ms"]["ttft"]["p50"], 2),
+            "cold": round(cold["latency_ms"]["ttft"]["p50"], 2),
+        },
+        # deterministic virtual time: federated prefill must be cheaper
+        "ttft_ratio_fed_vs_cold": round(
+            fed["latency_ms"]["ttft"]["p50"]
+            / max(1e-9, cold["latency_ms"]["ttft"]["p50"]), 4
+        ),
     }
 
 
@@ -1832,7 +1897,7 @@ def _part_in_subprocess(part: str, retries: int = 0, timeout: float = 1800):
 # runnable in CI / under --skip-chip without a device or the tunnel.
 _CPU_PARTS = frozenset({
     "dbo", "async_step", "spec_decode", "spec_window", "unified_step",
-    "ragged_step", "fault_degrade", "fleet_soak",
+    "ragged_step", "fault_degrade", "fleet_soak", "kv_federation",
 })
 
 # Every part main() can dispatch, in run order (also the validation set
@@ -1844,7 +1909,7 @@ _CPU_PARTS = frozenset({
 # driver's kill) lands, the summary already holds everything cheaper.
 _ALL_PARTS = (
     "ragged_step", "unified_step", "async_step", "spec_decode",
-    "spec_window", "dbo", "fault_degrade", "fleet_soak",
+    "spec_window", "dbo", "fault_degrade", "fleet_soak", "kv_federation",
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
     "pd", "pd_int8", "pd_kvint8", "pd_local", "pd_cached", "pd_adaptive",
@@ -1982,6 +2047,7 @@ def main() -> None:
         "dbo": (set_key("dbo"), None),
         "fault_degrade": (set_key("fault_degrade"), None),
         "fleet_soak": (set_key("fleet_soak"), None),
+        "kv_federation": (set_key("kv_federation"), None),
         "rtt": (set_key("dispatch_rtt_ms"), None),
         "env": (set_key("env"), None),
         # The headline part now also carries the MFU/roofline context:

@@ -39,6 +39,8 @@ FLAG_FIELD_MAP = {
     "kv_store_master_url": "store_master_url",
     "kv_store_segment_bytes": "store_segment_bytes",
     "kv_store_data_port": "store_data_port",
+    "kv_publish_policy": "publish_policy",
+    "kv_publish_min_hits": "publish_min_hits",
     "lora_adapters": "num_lora_adapters",
     "kv_transfer_config": "kv_role",
 }

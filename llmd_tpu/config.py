@@ -575,6 +575,15 @@ class OffloadConfig:
     store_master_url: str | None = None
     store_segment_bytes: int = 8 << 30
     store_data_port: int = 0  # kvship port serving this segment (0 = auto)
+    # Federation publish policy (docs/architecture/kv-federation.md):
+    # "save" publishes every host-tier save (eager, the small-fleet
+    # default — publish bandwidth is free next to a re-prefill);
+    # "evict-hot" publishes only pages the device cache evicted after
+    # >= publish_min_hits distinct uses (the Mooncake-shaped policy for
+    # fleets where save-rate x replica-count would swamp the store);
+    # "off" keeps the store read-only on this replica.
+    publish_policy: str = "save"
+    publish_min_hits: int = 2
 
 
 @dataclasses.dataclass

@@ -187,6 +187,17 @@ class EngineStats:
     offload_fs_pages: int = 0
     offload_saves: int = 0
     offload_restores: int = 0
+    # Cross-replica KV federation (docs/architecture/kv-federation.md):
+    # the store client's read path (peer pulls / failed pulls / locate
+    # misses), master-accepted publications from this replica, pages
+    # this replica fetched from the store, and the prompt tokens whose
+    # re-prefill those committed pages avoided.
+    kvstore_pulls: int = 0
+    kvstore_pull_failures: int = 0
+    kvstore_misses: int = 0
+    kv_federation_published: int = 0
+    kv_federation_hits: int = 0
+    recompute_avoided_tokens: int = 0
     # P/D KV transfer (reference operations-vllm.md transfer accounting)
     kv_exported_requests: int = 0
     kv_exported_bytes: int = 0
@@ -348,10 +359,12 @@ class LLMEngine:
         # pages downgrade to cpu-tier stores instead of removals).
         self._host_cache = None
         self._kvstore_client = None
+        self._federation = None
         if config.offload is not None and config.offload.enabled and not follower:
             from llmd_tpu.kvtransfer.offload import HostKVCache, TieredEventSink
 
             if config.offload.store_master_url:
+                from llmd_tpu.federation import KVFederation
                 from llmd_tpu.kvstore import CrossSliceStoreClient
 
                 self._kvstore_client = CrossSliceStoreClient(
@@ -360,13 +373,22 @@ class LLMEngine:
                     data_port=config.offload.store_data_port,
                     segment_bytes=config.offload.store_segment_bytes,
                 )
+                self._federation = KVFederation(
+                    self._kvstore_client,
+                    publish_policy=config.offload.publish_policy,
+                    publish_min_hits=config.offload.publish_min_hits,
+                )
             self._host_cache = HostKVCache(
                 max_pages=config.offload.cpu_chunks,
                 fs_dir=config.offload.fs_dir,
                 fs_max_pages=config.offload.fs_max_pages,
-                remote=self._kvstore_client,
+                federation=self._federation,
             )
             event_sink = TieredEventSink(event_sink or KVEventSink(), self._host_cache)
+            if self._federation is not None:
+                # Accepted publications advertise the store tier
+                # (BlockStored medium="store") through the same sink.
+                self._federation.event_sink = event_sink
         self.allocator = PageAllocator(
             num_pages=config.cache.num_blocks,
             page_size=config.cache.page_size,
@@ -1328,6 +1350,19 @@ class LLMEngine:
             self.stats.offload_fs_pages = hs["fs_pages"]
             self.stats.offload_saves = hs["saves"]
             self.stats.offload_restores = hs["restores"]
+        if self._kvstore_client is not None:
+            ks = self._kvstore_client.stats()
+            self.stats.kvstore_pulls = ks["pulls"]
+            self.stats.kvstore_pull_failures = ks["pull_failures"]
+            self.stats.kvstore_misses = ks["misses"]
+        if self._federation is not None:
+            fs = self._federation.stats()
+            self.stats.kv_federation_published = fs["published"]
+            self.stats.kv_federation_hits = fs["hits"]
+        if self.offloader is not None:
+            self.stats.recompute_avoided_tokens = (
+                self.offloader.recompute_avoided_tokens
+            )
         if self.kv_connector is not None:
             cs = self.kv_connector.stats()
             self.stats.kv_exported_requests = cs["exported_requests"]

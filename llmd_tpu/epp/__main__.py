@@ -62,6 +62,13 @@ def main(argv=None) -> None:
         help="default engine KV-event port for precise prefix routing",
     )
     p.add_argument(
+        "--prefix-tier-weights", default=None,
+        help="prefix-index tier weight overrides, 'tier=w,...' (e.g. "
+        "'cpu=0.7,store=0.4'); same syntax as LLMD_PREFIX_TIER_WEIGHTS "
+        "and takes precedence over it (kv-federation.md tri-state "
+        "scoring)",
+    )
+    p.add_argument(
         "--predictor-url", default=None,
         help="prediction sidecar base URL (predicted-latency routing)",
     )
@@ -156,7 +163,11 @@ def main(argv=None) -> None:
     # a precise-prefix-cache-scorer (no-op otherwise).
     from llmd_tpu.epp.precise_prefix import attach_precise_routing
 
-    attach_precise_routing(router, default_events_port=args.kv_events_port)
+    attach_precise_routing(
+        router,
+        default_events_port=args.kv_events_port,
+        tier_weights=args.prefix_tier_weights,
+    )
     # Wires the predictor producer + feedback + SLO admitter iff the config
     # declares a latency-scorer or slo-headroom-tier filter (no-op otherwise).
     from llmd_tpu.epp.predicted_latency import maybe_attach_predicted_latency

@@ -8,6 +8,13 @@ chained block hashes — the SAME chain the engines commit pages under
 scores endpoints by the KV-event index's weighted longest-consecutive-prefix
 (gpu=1.0 / cpu=0.8 tiers). After a pick, speculative entries with a 2s TTL
 co-route identical-prompt bursts (kv-indexer.md:137-143).
+
+With KV federation (docs/architecture/kv-federation.md) the scorecard is
+TRI-STATE: blocks published to the fleet-wide store score the `store`
+weight (default 0.5, `LLMD_PREFIX_TIER_WEIGHTS`/`tier_weights`) on EVERY
+endpoint, so the scheduler can prefer a cold-but-idle replica plus a
+store fetch over queueing behind the one replica holding the prefix —
+the fetch-on-miss leg then pulls the pages instead of re-prefilling.
 """
 
 from __future__ import annotations
@@ -151,10 +158,16 @@ class PrecisePrefixCacheScorer(Scorer):
         backend: str = "lru",
         redis_host: str = "127.0.0.1",
         redis_port: int = 6379,
+        tier_weights: dict | None = None,
     ) -> None:
         """backend: the reference's three indexer backends
         (kv-indexer.md:59-151) — `lru` (in-memory two-level), `cost-aware`
-        (frequency-sketch eviction), `redis` (shared Redis/Valkey)."""
+        (frequency-sketch eviction), `redis` (shared Redis/Valkey).
+
+        tier_weights: per-deployment overrides of the tri-state weight
+        table (kv-federation.md), e.g. ``{"store": 0.4}`` — layered over
+        the defaults and the ``LLMD_PREFIX_TIER_WEIGHTS`` env
+        (EndpointPickerConfig: ``"parameters": {"tier_weights": ...}``)."""
         if index is None:
             if backend == "redis":
                 from llmd_tpu.events.redis_index import RedisKVBlockIndex
@@ -162,6 +175,7 @@ class PrecisePrefixCacheScorer(Scorer):
                 index = RedisKVBlockIndex(
                     host=redis_host, port=redis_port,
                     speculative_ttl_s=speculative_ttl_s,
+                    tier_weights=tier_weights,
                 )
             elif backend == "cost-aware":
                 from llmd_tpu.events.index import CostAwareKVBlockIndex
@@ -169,11 +183,13 @@ class PrecisePrefixCacheScorer(Scorer):
                 index = CostAwareKVBlockIndex(
                     max_blocks_per_pod=max_blocks_per_pod,
                     speculative_ttl_s=speculative_ttl_s,
+                    tier_weights=tier_weights,
                 )
             elif backend == "lru":
                 index = KVBlockIndex(
                     max_blocks_per_pod=max_blocks_per_pod,
                     speculative_ttl_s=speculative_ttl_s,
+                    tier_weights=tier_weights,
                 )
             else:
                 raise ValueError(
@@ -204,19 +220,31 @@ class PrecisePrefixCacheScorer(Scorer):
         self.index.remove_pod(address)
 
 
-def attach_precise_routing(router, default_events_port: int = DEFAULT_EVENTS_PORT):
+def attach_precise_routing(
+    router,
+    default_events_port: int = DEFAULT_EVENTS_PORT,
+    tier_weights: str | None = None,
+):
     """Wire token-producer + KV-event subscription onto a built Router.
 
     Finds the precise scorer instance(s) in the router's scheduler, attaches
     a TokenProducer to the producer phase and a KVEventsSource to the pool.
     Returns the KVEventsSource (caller owns close()) or None if the config
     has no precise scorer.
+
+    ``tier_weights``: raw ``tier=w,...`` overrides from the router's
+    ``--prefix-tier-weights`` flag, layered OVER whatever the index was
+    constructed with (defaults < env < scorer config < flag).
     """
     from llmd_tpu.epp.config import find_plugins
+    from llmd_tpu.events.index import parse_tier_weights
 
     scorers = find_plugins(router.scheduler, PrecisePrefixCacheScorer)
     if not scorers:
         return None
+    if tier_weights:
+        for scorer in scorers:
+            scorer.index.tier_weights.update(parse_tier_weights(tier_weights))
     router.producers.append(TokenProducer())
     source = KVEventsSource(
         router.store, scorers[0].index, default_port=default_events_port
@@ -239,6 +267,10 @@ def attach_precise_routing(router, default_events_port: int = DEFAULT_EVENTS_POR
             f"llm_d_epp_prefix_index_lookups_total {st.get('lookups', 0)}",
             "# TYPE llm_d_epp_prefix_index_hits_total counter",
             f"llm_d_epp_prefix_index_hits_total {st.get('hits', 0)}",
+            # Federation visibility: blocks the index knows to be one
+            # fetch away in the fleet-wide store (kv-federation.md).
+            "# TYPE llm_d_epp_prefix_index_store_blocks gauge",
+            f"llm_d_epp_prefix_index_store_blocks {st.get('store_blocks', 0)}",
         ]
         return "\n".join(lines)
 

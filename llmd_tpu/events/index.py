@@ -11,18 +11,82 @@ Reference behavior (kv-indexer.md:91-143):
     kv-indexer.md:137-143) so bursts of identical prompts co-route before
     the first BlockStored arrives.
 
+Federation extension (docs/architecture/kv-federation.md): a
+``BlockStored(medium="store")`` event means the publishing pod placed
+the block in the FLEET-WIDE store — one peer-to-peer fetch away from
+EVERY pod. Scoring becomes tri-state: a pod that holds a block scores
+its resident tier (gpu/cpu), a pod that does not scores the ``store``
+weight when any pod published it, and only blocks in neither state
+break the consecutive-prefix walk (they would be recomputed). The
+weight table is configurable per deployment via
+``LLMD_PREFIX_TIER_WEIGHTS`` (e.g. ``"cpu=0.7,store=0.4"``) or the
+scorer's ``tier_weights`` parameter — store fetch cost relative to
+recompute varies with interconnect and model size.
+
 Thread-safety: one lock; subscriber threads write, scheduler reads.
 """
 
 from __future__ import annotations
 
 import collections
+import logging
+import os
 import threading
 import time
 
-TIER_WEIGHTS = {"gpu": 1.0, "hbm": 1.0, "cpu": 0.8, "disk": 0.6}
+log = logging.getLogger(__name__)
+
+# The default weight table: resident tiers from kv-indexer.md:133, the
+# store tier between "resident on CPU" and "worthless" — a fetch beats a
+# re-prefill but loses to a local copy.
+DEFAULT_TIER_WEIGHTS = {
+    "gpu": 1.0, "hbm": 1.0, "cpu": 0.8, "disk": 0.6, "store": 0.5,
+}
+# Back-compat alias (importers predating the configurable table).
+TIER_WEIGHTS = DEFAULT_TIER_WEIGHTS
+
+TIER_WEIGHTS_ENV = "LLMD_PREFIX_TIER_WEIGHTS"
+
+# Reserved holder for fleet-global store copies: a BlockStored
+# (medium="store") event books under this pseudo-pod rather than the
+# publishing pod, so the publication never DOWNGRADES the publisher's
+# own resident-tier entry (the publisher still holds the page in a
+# host tier) and the store copy outlives the publisher's evictions.
+STORE_POD = "!store"
 
 SPECULATIVE_TTL_S = 2.0
+
+
+def parse_tier_weights(raw: str) -> dict[str, float]:
+    """Parse ``tier=weight,...`` overrides (the shared syntax of
+    ``LLMD_PREFIX_TIER_WEIGHTS`` and the router's
+    ``--prefix-tier-weights`` flag). Unparseable entries are logged and
+    skipped — a typo must not zero the scorer."""
+    weights: dict[str, float] = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        tier, sep, value = item.partition("=")
+        try:
+            if not sep:
+                raise ValueError("missing '='")
+            weights[tier.strip()] = float(value)
+        except ValueError as e:
+            log.warning(
+                "%s: ignoring entry %r (%s)", TIER_WEIGHTS_ENV, item, e
+            )
+    return weights
+
+
+def tier_weights_from_env(raw: str | None = None) -> dict[str, float]:
+    """The deployment's weight table: defaults overlaid with the
+    ``LLMD_PREFIX_TIER_WEIGHTS`` env (``tier=weight,...``)."""
+    weights = dict(DEFAULT_TIER_WEIGHTS)
+    if raw is None:
+        raw = os.environ.get(TIER_WEIGHTS_ENV, "")
+    weights.update(parse_tier_weights(raw))
+    return weights
 
 _HALVE_TABLE = bytes(v >> 1 for v in range(256))
 
@@ -32,9 +96,13 @@ class KVBlockIndex:
         self,
         max_blocks_per_pod: int = 131072,
         speculative_ttl_s: float = SPECULATIVE_TTL_S,
+        tier_weights: dict[str, float] | None = None,
     ) -> None:
         self.max_blocks_per_pod = max_blocks_per_pod
         self.speculative_ttl_s = speculative_ttl_s
+        self.tier_weights = tier_weights_from_env()
+        if tier_weights:
+            self.tier_weights.update(tier_weights)
         self._lock = threading.Lock()
         # hash -> {pod -> tier}
         self._blocks: dict[str, dict[str, str]] = {}
@@ -57,11 +125,18 @@ class KVBlockIndex:
                 t = ev.get("type")
                 if t == "BlockStored":
                     tier = ev.get("medium", "gpu")
+                    holder = STORE_POD if tier == "store" else pod
                     for h in ev.get("hashes", []):
-                        self._store_locked(pod, h, tier)
+                        self._store_locked(holder, h, tier)
                 elif t == "BlockRemoved":
+                    # A store-tier removal withdraws the fleet-global
+                    # copy (master eviction reached the owner), not the
+                    # emitting pod's resident entry.
+                    holder = (
+                        STORE_POD if ev.get("medium") == "store" else pod
+                    )
                     for h in ev.get("hashes", []):
-                        self._remove_locked(pod, h)
+                        self._remove_locked(holder, h)
                 elif t == "AllBlocksCleared":
                     self._clear_pod_locked(pod)
             # opportunistic speculative-entry expiry
@@ -71,12 +146,19 @@ class KVBlockIndex:
                 for h in dead:
                     del spec[h]
 
+    def _pod_cap(self, pod: str) -> int:
+        """The STORE_POD bucket aggregates the WHOLE fleet's
+        publications, not one pod's cache — give it headroom over the
+        per-pod cap so fleet-scale store inventories don't LRU out
+        still-valid claims."""
+        return self.max_blocks_per_pod * (8 if pod == STORE_POD else 1)
+
     def _store_locked(self, pod: str, h: str, tier: str) -> None:
         self._blocks.setdefault(h, {})[pod] = tier
         lru = self._pod_lru.setdefault(pod, collections.OrderedDict())
         lru[h] = None
         lru.move_to_end(h)
-        if len(lru) > self.max_blocks_per_pod:
+        if len(lru) > self._pod_cap(pod):
             self._evict_one_locked(pod, lru)
 
     def _evict_one_locked(self, pod: str, lru: collections.OrderedDict) -> None:
@@ -140,6 +222,19 @@ class KVBlockIndex:
                 return "gpu"  # speculative entries presume the hot tier
         return None
 
+    def _tier_for_locked(self, pod: str, h: str, now: float) -> str | None:
+        """Tri-state tier (kv-federation.md): resident-on-pod beats
+        speculative beats one-fetch-away-in-store; None = recompute."""
+        tier = self._pod_has_locked(pod, h, now)
+        if tier is not None:
+            return tier
+        pods = self._blocks.get(h)
+        if pods is not None and "store" in pods.values():
+            # Published to the fleet-wide store: any pod can pull it
+            # peer-to-peer instead of re-prefilling.
+            return "store"
+        return None
+
     def score(self, hashes: list[str], pods: list[str]) -> dict[str, float]:
         """Weighted longest-consecutive-prefix per pod (kv-indexer.md:120-135)."""
         return {p: s for p, (s, _) in self.score_detailed(hashes, pods).items()}
@@ -160,10 +255,10 @@ class KVBlockIndex:
             for pod in pods:
                 s, n = 0.0, 0
                 for h in hashes:
-                    tier = self._pod_has_locked(pod, h, now)
+                    tier = self._tier_for_locked(pod, h, now)
                     if tier is None:
                         break
-                    s += TIER_WEIGHTS.get(tier, 0.5)
+                    s += self.tier_weights.get(tier, 0.5)
                     n += 1
                 if n:
                     hit = True
@@ -173,12 +268,14 @@ class KVBlockIndex:
         return out
 
     def matched_pages(self, hashes: list[str], pod: str) -> int:
-        """Unweighted longest-consecutive-prefix length for one pod."""
+        """Unweighted longest-consecutive-prefix length for one pod
+        (store-fetchable blocks count: they land via fetch-on-miss, not
+        recompute, so admission treats them like a cache hit)."""
         now = time.monotonic()
         n = 0
         with self._lock:
             for h in hashes:
-                if self._pod_has_locked(pod, h, now) is None:
+                if self._tier_for_locked(pod, h, now) is None:
                     break
                 n += 1
         return n
@@ -201,6 +298,10 @@ class KVBlockIndex:
                 "events": self.metrics_events,
                 "lookups": self.metrics_lookups,
                 "hits": self.metrics_hits,
+                "store_blocks": sum(
+                    1 for holders in self._blocks.values()
+                    if "store" in holders.values()
+                ),
             }
 
 

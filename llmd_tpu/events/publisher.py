@@ -79,7 +79,16 @@ class ZMQEventSink(KVEventSink):
         )
 
     def blocks_removed(self, hashes) -> None:
-        self._append({"type": "BlockRemoved", "hashes": [h.hex() for h in hashes]})
+        self._append(
+            {
+                "type": "BlockRemoved",
+                "hashes": [h.hex() for h in hashes],
+                # medium matters only for store-tier withdrawals
+                # (kv-federation.md): resident removals clear the pod's
+                # entry regardless of tier.
+                "medium": self.medium,
+            }
+        )
 
     def all_cleared(self) -> None:
         self._append({"type": "AllBlocksCleared"})

@@ -26,7 +26,11 @@ import socket
 import threading
 import time
 
-from llmd_tpu.events.index import SPECULATIVE_TTL_S, TIER_WEIGHTS
+from llmd_tpu.events.index import (
+    SPECULATIVE_TTL_S,
+    STORE_POD,
+    tier_weights_from_env,
+)
 
 log = logging.getLogger(__name__)
 
@@ -205,6 +209,7 @@ class RedisKVBlockIndex:
         speculative_ttl_s: float = SPECULATIVE_TTL_S,
         key_prefix: str = "llmd",
         entry_ttl_s: int = 1200,
+        tier_weights: dict[str, float] | None = None,
     ) -> None:
         """entry_ttl_s: sliding expiry on every key touched by a store —
         the shared store's safety net against pods that die while no
@@ -216,6 +221,9 @@ class RedisKVBlockIndex:
         self.speculative_ttl_s = speculative_ttl_s
         self.prefix = key_prefix
         self.entry_ttl_s = int(entry_ttl_s)
+        self.tier_weights = tier_weights_from_env()
+        if tier_weights:
+            self.tier_weights.update(tier_weights)
         self._lock = threading.Lock()
         self._spec: dict[str, dict[str, float]] = {}
         self.metrics_events = 0
@@ -237,16 +245,23 @@ class RedisKVBlockIndex:
             t = ev.get("type")
             if t == "BlockStored":
                 tier = ev.get("medium", "gpu")
+                # Fleet-global store copies book under the reserved
+                # pseudo-pod (see events.index.STORE_POD): the
+                # publication must not downgrade the publisher's own
+                # resident-tier entry.
+                holder = STORE_POD if tier == "store" else pod
                 for h in ev.get("hashes", []):
-                    cmds.append(("HSET", self._bk(h), pod, tier))
+                    cmds.append(("HSET", self._bk(h), holder, tier))
                     cmds.append(("EXPIRE", self._bk(h), self.entry_ttl_s))
-                    cmds.append(("SADD", self._pk(pod), h))
+                    cmds.append(("SADD", self._pk(holder), h))
                 if ev.get("hashes"):
-                    cmds.append(("EXPIRE", self._pk(pod), self.entry_ttl_s))
+                    cmds.append(("EXPIRE", self._pk(holder), self.entry_ttl_s))
             elif t == "BlockRemoved":
+                # store-tier removals withdraw the fleet-global copy
+                holder = STORE_POD if ev.get("medium") == "store" else pod
                 for h in ev.get("hashes", []):
-                    cmds.append(("HDEL", self._bk(h), pod))
-                    cmds.append(("SREM", self._pk(pod), h))
+                    cmds.append(("HDEL", self._bk(h), holder))
+                    cmds.append(("SREM", self._pk(holder), h))
             elif t == "AllBlocksCleared":
                 # Strict event order: stores queued BEFORE the clear must
                 # land (and then be wiped) — flushing keeps a batch like
@@ -331,9 +346,13 @@ class RedisKVBlockIndex:
                     tier = held.get(pod)
                     if tier is None and spec.get(h, 0.0) > now:
                         tier = "gpu"
+                    if tier is None and "store" in held.values():
+                        # Fleet-wide store copy (kv-federation.md): one
+                        # fetch away from every pod.
+                        tier = "store"
                     if tier is None:
                         break
-                    s += TIER_WEIGHTS.get(tier, 0.5)
+                    s += self.tier_weights.get(tier, 0.5)
                     n += 1
                 if n:
                     hit = True

@@ -1,0 +1,17 @@
+"""Cross-replica KV federation (docs/architecture/kv-federation.md).
+
+Glues the per-replica pieces — tiered offload, cross-slice kvstore,
+KV-event prefix index, precise-prefix scorer — into one fleet-wide
+prefix cache: publish-on-evict, store-aware tri-state routing,
+fetch-on-miss.
+"""
+
+from llmd_tpu.federation.core import (  # noqa: F401
+    PUBLISH_POLICIES,
+    KVFederation,
+)
+from llmd_tpu.federation.wire import (  # noqa: F401
+    PageDecodeError,
+    decode_page,
+    encode_page,
+)

@@ -1,0 +1,260 @@
+"""Cross-replica KV federation: the glue between four existing pieces.
+
+The offload tier (:mod:`llmd_tpu.kvtransfer.offload`), the
+Mooncake-class cross-slice store (:mod:`llmd_tpu.kvstore`), the KV-event
+prefix index (:mod:`llmd_tpu.events.index`) and the precise-prefix
+scorer (:mod:`llmd_tpu.epp.precise_prefix`) each work per-replica; this
+module turns them into ONE fleet-wide prefix cache
+(docs/architecture/kv-federation.md):
+
+- **publish-on-evict** — when the device cache evicts a page the host
+  tier still holds, a hotness gate (``publish_min_hits`` distinct uses
+  of the page's hash chain) decides whether the page is worth a global
+  copy; hot pages are CRC-framed (:mod:`llmd_tpu.federation.wire`),
+  registered with the local kvship shipper and ``PUT`` to the master
+  off-thread. Once the master ACCEPTS the copy, a
+  ``BlockStored(medium="store")`` event teaches the prefix index the
+  third tier. The eager ``save`` policy (publish every host save, the
+  pre-federation behavior) remains available for small fleets where
+  publish bandwidth is free.
+- **fetch-on-miss** — the engine's restore-on-prefill path consults
+  :meth:`KVFederation.fetch` for hash-chain pages that extend the local
+  prefix run: locate at the master, pull peer-to-peer from the owning
+  segment's shipper, CRC-verify, and hand the page to the ordinary
+  cache-seeding commit. Every failure mode (master timeout, locate
+  miss, ``PullError``, CRC reject, injected ``kv.pull.drop``) returns
+  ``None`` — the caller's existing recompute policy is the degradation,
+  never an exception up the admission path.
+
+Counter surface (rendered on ``/metrics`` via
+``EngineStats``/``serve/metrics.py``): ``kv_federation_published_total``
+(master-accepted publications), ``kv_federation_hits_total`` (pages
+pulled from the store), plus the store client's
+``kvstore_pulls/pull_failures/misses``. The recompute-avoided token
+count lives with the offload connector, which knows the page size and
+whether fetched pages actually committed.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+
+import numpy as np
+
+from llmd_tpu import faults
+from llmd_tpu.federation.wire import PageDecodeError, decode_page, encode_page
+
+log = logging.getLogger(__name__)
+
+PUBLISH_POLICIES = ("save", "evict-hot", "off")
+
+
+class KVFederation:
+    """One engine's membership in the fleet-wide prefix cache.
+
+    Owns the publish policy and the fetch path; the store client
+    (:class:`llmd_tpu.kvstore.client.CrossSliceStoreClient`) owns the
+    wire. ``event_sink`` is attached by the engine once the tiered sink
+    exists — publications confirmed before that are counted but not
+    advertised (the index converges from later traffic).
+
+    Thread model: ``touch``/``fetch``/``publish`` run on the engine
+    thread; ``_on_published`` runs on the client's publisher thread.
+    The shared hotness/published books sit behind one lock; event
+    emission happens outside it (the ZMQ sink has its own lock).
+    """
+
+    def __init__(
+        self,
+        client,
+        publish_policy: str = "save",
+        publish_min_hits: int = 2,
+        hot_track_max: int = 65536,
+    ) -> None:
+        if publish_policy not in PUBLISH_POLICIES:
+            raise ValueError(
+                f"unknown publish policy {publish_policy!r} "
+                f"(expected one of {PUBLISH_POLICIES})"
+            )
+        self.client = client
+        self.publish_policy = publish_policy
+        self.publish_min_hits = max(1, publish_min_hits)
+        self.event_sink = None  # TieredEventSink, attached by the engine
+        self._lock = threading.Lock()
+        # hash -> distinct-use count, LRU-bounded (the hotness book).
+        self._touches: collections.OrderedDict[bytes, int] = (
+            collections.OrderedDict()
+        )
+        self._hot_track_max = hot_track_max
+        # Keys already handed to the publisher (bounded): the master
+        # dedups anyway (first copy wins), this just keeps a hot page
+        # that keeps getting device-evicted from re-serializing itself
+        # into the publish queue every time.
+        self._enqueued: collections.OrderedDict[str, None] = (
+            collections.OrderedDict()
+        )
+        self.publish_requests = 0  # pages handed to the publisher
+        self.published = 0  # publications the master accepted
+        self.publish_failures = 0  # publications that did not land
+        self.hits = 0  # pages fetched from the store
+        self.crc_failures = 0  # pulled blobs rejected by the CRC
+        client.on_published = self._on_published
+        client.on_publish_failed = self._on_publish_failed
+        client.on_evicted = self._on_store_evicted
+
+    # ---------------------------------------------------------- hotness
+
+    def touch(self, h: bytes) -> None:
+        """Record one use of a page hash (host-tier save/hit or a
+        device-cache prefix hit seen by the restore walk)."""
+        with self._lock:
+            n = self._touches.pop(h, 0)
+            self._touches[h] = n + 1
+            while len(self._touches) > self._hot_track_max:
+                self._touches.popitem(last=False)
+
+    def is_hot(self, h: bytes) -> bool:
+        with self._lock:
+            return self._touches.get(h, 0) >= self.publish_min_hits
+
+    # ---------------------------------------------------------- publish
+
+    def on_save(self, h: bytes, page: np.ndarray) -> None:
+        """Host-tier save hook (save-on-fill). Eager ``save`` policy
+        publishes everything; ``evict-hot`` waits for the eviction."""
+        self.touch(h)
+        if self.publish_policy == "save":
+            self.publish(h, page)
+
+    def wants_publish_on_evict(self, h: bytes) -> bool:
+        """The hotness gate, checked BEFORE the caller pays to
+        materialize the page bytes (possibly an FS load)."""
+        if self.publish_policy != "evict-hot":
+            return False
+        with self._lock:
+            if h.hex() in self._enqueued:
+                return False
+            return self._touches.get(h, 0) >= self.publish_min_hits
+
+    def _mark_enqueued(self, key: str) -> bool:
+        with self._lock:
+            if key in self._enqueued:
+                return False
+            self._enqueued[key] = None
+            while len(self._enqueued) > self._hot_track_max:
+                self._enqueued.popitem(last=False)
+            self.publish_requests += 1
+            return True
+
+    def publish(self, h: bytes, page: np.ndarray) -> None:
+        """Hand one page to the store's publisher thread (never blocks
+        the engine thread; queue overflow drops the publish)."""
+        key = h.hex()
+        if self._mark_enqueued(key):
+            self.client.put_async(key, encode_page(page))
+
+    def publish_deferred(self, h: bytes, loader) -> None:
+        """Evict-path publish: ``loader`` (zero-arg, returns the page
+        array or None) runs on the client's publisher thread, so the
+        engine thread pays neither the possible FS load nor the
+        serialization — eviction bursts land exactly when the engine is
+        under memory pressure."""
+        key = h.hex()
+        if not self._mark_enqueued(key):
+            return
+
+        def blob():
+            page = loader()
+            return None if page is None else encode_page(page)
+
+        self.client.put_async(key, blob)
+
+    def _on_published(self, key: str) -> None:
+        """Publisher-thread callback: the master accepted our copy —
+        advertise the store tier to the prefix index."""
+        with self._lock:
+            self.published += 1
+            sink = self.event_sink
+        if sink is not None:
+            try:
+                sink.stored_with_medium([bytes.fromhex(key)], "store")
+            # llmd: allow(broad-except) -- publisher thread must survive any sink failure
+            except Exception as e:
+                log.warning("store-tier event emit failed: %s", e)
+
+    def _on_publish_failed(self, key: str) -> None:
+        """The publication did not land (master down, queue overflow,
+        page gone before the deferred load ran): forget the enqueued
+        mark so a later save/evict retries once the store recovers.
+        Rejected puts (another segment already owns the copy) do NOT
+        come through here — for those the mark correctly suppresses
+        re-serialization."""
+        with self._lock:
+            self.publish_failures += 1
+            self._enqueued.pop(key, None)
+
+    def _on_store_evicted(self, key: str) -> None:
+        """Heartbeat-thread callback: the master's watermark eviction
+        reclaimed our copy — withdraw the store-tier advertisement so
+        routing stops scoring a copy that no longer exists, and unmark
+        the key so a future hot eviction can re-publish it."""
+        with self._lock:
+            self._enqueued.pop(key, None)
+            sink = self.event_sink
+        if sink is not None:
+            try:
+                sink.removed_with_medium([bytes.fromhex(key)], "store")
+            # llmd: allow(broad-except) -- heartbeat thread must survive any sink failure
+            except Exception as e:
+                log.warning("store-tier removal emit failed: %s", e)
+
+    # ------------------------------------------------------------ fetch
+
+    def fetch(self, h: bytes) -> np.ndarray | None:
+        """Fetch-on-miss: one page from whichever segment holds it.
+
+        Returns None on ANY failure — the caller recomputes. Counted
+        here: successful store hits and CRC rejects; the client counts
+        pulls / pull failures / locate misses."""
+        key = h.hex()
+        # The store leg of the kv.pull.drop site (fault-tolerance.md):
+        # a dropped federated pull degrades to recompute exactly like a
+        # dropped P/D pull.
+        if faults.fires("kv.pull.drop", f"store|{key}"):
+            return None
+        blob = self.client.get(key)
+        if blob is None:
+            return None
+        blob = faults.corrupt("kv.bundle.corrupt", blob, f"store|{key}")
+        try:
+            page = decode_page(blob)
+        except PageDecodeError as e:
+            with self._lock:
+                self.crc_failures += 1
+            log.warning("federated page %s rejected: %s", key[:16], e)
+            return None
+        with self._lock:
+            self.hits += 1
+        return page
+
+    # ------------------------------------------------------------ misc
+
+    def clear_local(self) -> None:
+        """Weight rollout: withdraw this replica's store contribution
+        and forget the hotness book (hashes no longer match)."""
+        with self._lock:
+            self._touches.clear()
+            self._enqueued.clear()
+        self.client.clear_local()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "publish_policy": self.publish_policy,
+                "publish_requests": self.publish_requests,
+                "published": self.published,
+                "hits": self.hits,
+                "crc_failures": self.crc_failures,
+            }

@@ -1,0 +1,57 @@
+"""Page framing for the federation store tier: CRC-guarded npy payloads.
+
+Objects in the cross-slice store travel host→host over the kvship
+transfer plane and may outlive the publishing process by hours (the
+master's soft-pin TTL is 30 minutes). The local tiers get away with
+trusting their own memory; a federated pull cannot — a corrupt page
+committed into the prefix cache would silently poison every request
+that hits it. So every published page rides a tiny header:
+
+    magic "KVF1" | crc32(payload) u32-le | npy payload
+
+Decode verifies the CRC before numpy ever parses the payload; a
+mismatch (or a foreign/old-format blob) raises :class:`PageDecodeError`
+and the caller degrades to the recompute policy — the same contract the
+P/D connector's version-2 bundle CRC enforces on the transfer leg
+(docs/architecture/fault-tolerance.md).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = b"KVF1"
+_HEADER = struct.Struct("<4sI")
+
+
+class PageDecodeError(ValueError):
+    """Blob failed the CRC or did not parse as a page."""
+
+
+def encode_page(page: np.ndarray) -> bytes:
+    """Frame one host-tier page for publication."""
+    buf = io.BytesIO()
+    np.save(buf, page, allow_pickle=False)
+    payload = buf.getvalue()
+    return _HEADER.pack(MAGIC, zlib.crc32(payload)) + payload
+
+
+def decode_page(blob: bytes) -> np.ndarray:
+    """Verify and parse a pulled page. Raises PageDecodeError on any
+    corruption — callers degrade to recompute, never commit the page."""
+    if len(blob) < _HEADER.size:
+        raise PageDecodeError(f"short blob ({len(blob)}B)")
+    magic, crc = _HEADER.unpack_from(blob)
+    payload = blob[_HEADER.size:]
+    if magic != MAGIC:
+        raise PageDecodeError(f"bad magic {magic!r}")
+    if zlib.crc32(payload) != crc:
+        raise PageDecodeError("payload CRC mismatch")
+    try:
+        return np.load(io.BytesIO(payload), allow_pickle=False)
+    except (OSError, ValueError) as e:
+        raise PageDecodeError(f"npy parse failed: {e}") from e

@@ -58,6 +58,82 @@ class ReplicaUnreachable(ConnectionError):
     """Connection refused: the replica is dead or draining."""
 
 
+@dataclasses.dataclass(frozen=True)
+class StoreProfile:
+    """The fleet-wide KV store's fetch-cost envelope
+    (docs/architecture/kv-federation.md).
+
+    ``fetch_tok_s`` is the peer-to-peer pull bandwidth in prefix tokens
+    per second. The default derives from the same captured decode rate
+    the replica profile uses (BENCH_r04): a store fetch moves bytes
+    host-to-host over the kvship plane — wire-bandwidth-bound, faster
+    than recomputing the prefix but slower than a local restore. 16x
+    decode (4x the prefill estimate) is the labeled estimate; override
+    per scenario when a captured fetch figure exists. ``fetch_rtt_s``
+    is the per-pull fixed cost (locate at the master + connection
+    setup)."""
+
+    fetch_tok_s: float = 4914.0 * 16.0
+    fetch_rtt_s: float = 0.002
+
+    @classmethod
+    def from_bench(
+        cls, path: str | pathlib.Path | None = None, **overrides
+    ) -> "StoreProfile":
+        """Derive the fetch rate from the same captured bench headline
+        ReplicaProfile.from_bench reads (falls back to the BENCH_r04
+        default when the record is missing)."""
+        decode = ReplicaProfile.from_bench(path).decode_tok_s
+        fields = {"fetch_tok_s": decode * 16.0}
+        fields.update(overrides)
+        return cls(**fields)
+
+
+class SimKVStore:
+    """The fleet-wide prefix store, stubbed at the federation contract:
+    membership (which prefix groups have a published copy) plus fetch
+    cost (:class:`StoreProfile`). The real subsystem's master/segment/
+    shipper mechanics are covered by tests/test_kvstore.py and
+    tests/test_kv_federation.py; what the fleet simulation needs is the
+    ROUTING-VISIBLE behavior — publish once, fetch from anywhere,
+    degrade to recompute on a dropped pull — deterministically."""
+
+    def __init__(self, profile: StoreProfile | None = None) -> None:
+        self.profile = profile or StoreProfile()
+        self._groups: set[str] = set()
+        self.publishes = 0
+        self.hits = 0
+        self.misses = 0
+        self.dropped_pulls = 0
+
+    def has(self, group: str) -> bool:
+        if group in self._groups:
+            return True
+        self.misses += 1
+        return False
+
+    def publish(self, group: str) -> None:
+        """First copy wins (the master's dedup): a re-publish from a
+        second replica is a no-op, exactly like a rejected put."""
+        if group not in self._groups:
+            self._groups.add(group)
+            self.publishes += 1
+
+    def fetch_s(self, tokens: int) -> float:
+        """Virtual seconds one pull of ``tokens`` prefix tokens costs."""
+        self.hits += 1
+        return self.profile.fetch_rtt_s + tokens / self.profile.fetch_tok_s
+
+    def stats(self) -> dict:
+        return {
+            "groups": len(self._groups),
+            "publishes": self.publishes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "dropped_pulls": self.dropped_pulls,
+        }
+
+
 class ReplicaDied(ConnectionError):
     """The replica crashed while this request was in flight."""
 
@@ -107,11 +183,30 @@ class SimReplica:
     """One simulated engine replica on the virtual-time loop."""
 
     def __init__(
-        self, address: str, profile: ReplicaProfile, variant: str = "sim"
+        self,
+        address: str,
+        profile: ReplicaProfile,
+        variant: str = "sim",
+        kv_store: SimKVStore | None = None,
+        prefix_cache_groups: int = 8,
     ) -> None:
         self.address = address
         self.profile = profile
         self.variant = variant
+        # Federation tier (kv-federation.md): the fleet-shared store and
+        # a bounded local prefix cache (LRU over prefix groups — the
+        # stub's whole-prefix stand-in for the page-granular device/host
+        # tiers). Eviction from the bounded cache is what makes the
+        # store earn its copies even on a single replica.
+        self.kv_store = kv_store
+        self.prefix_cache_groups = prefix_cache_groups
+        self._prefix_cache: collections.OrderedDict[str, None] = (
+            collections.OrderedDict()
+        )
+        self.prefix_local_hits = 0
+        self.store_hits = 0
+        self.store_published = 0
+        self.recompute_avoided_tokens = 0
         self.alive = True
         self.accepting = True  # False while draining out of the pool
         self.waiting = 0
@@ -206,7 +301,59 @@ class SimReplica:
 
     # ---- the serving path -------------------------------------------- #
 
-    async def serve(self, request_id: str, prompt_tokens: int, output_tokens: int):
+    def _prefix_cache_put(self, group: str) -> None:
+        self._prefix_cache.pop(group, None)
+        self._prefix_cache[group] = None
+        while len(self._prefix_cache) > self.prefix_cache_groups:
+            self._prefix_cache.popitem(last=False)
+
+    def _plan_prefill(
+        self, request_id: str, prompt_tokens: int,
+        prefix_group: str | None, prefix_tokens: int,
+    ) -> tuple[float, str | None]:
+        """Tri-state prefill cost (kv-federation.md): local prefix hit
+        beats a store fetch beats recompute. Returns (prefill seconds,
+        group to publish after the compute lands — None when no publish
+        is due)."""
+        p = self.profile
+        full_s = prompt_tokens / p.prefill_tok_s
+        if (
+            self.kv_store is None
+            or prefix_group is None
+            or prefix_tokens <= 0
+        ):
+            return full_s, None
+        rest_s = (prompt_tokens - prefix_tokens) / p.prefill_tok_s
+        if prefix_group in self._prefix_cache:
+            self._prefix_cache.move_to_end(prefix_group)
+            self.prefix_local_hits += 1
+            return rest_s, None
+        if self.kv_store.has(prefix_group):
+            # The store leg of the kv.pull.drop site: a dropped
+            # federated pull degrades to recompute, exactly like a
+            # dropped P/D pull (fault-tolerance.md).
+            if faults.fires(
+                "kv.pull.drop", f"store|{self.address}|{request_id}"
+            ):
+                self.kv_store.dropped_pulls += 1
+                self.recompute_fallbacks += 1
+                return full_s * (1.0 + p.recompute_penalty), None
+            self.store_hits += 1
+            self.recompute_avoided_tokens += prefix_tokens
+            return self.kv_store.fetch_s(prefix_tokens) + rest_s, None
+        # Neither tier holds it: recompute the whole prompt and publish
+        # the prefix once the pages exist (the eager save policy —
+        # deterministic, no hotness bookkeeping in the stub).
+        return full_s, prefix_group
+
+    async def serve(
+        self,
+        request_id: str,
+        prompt_tokens: int,
+        output_tokens: int,
+        prefix_group: str | None = None,
+        prefix_tokens: int = 0,
+    ):
         """Serve one request; async generator yielding once at first
         token and returning at completion (the transport measures TTFT
         and stream end from the yields, like SSE bytes on a socket).
@@ -231,12 +378,22 @@ class SimReplica:
             # Degradations the production stack contracts for: a dropped
             # KV pull recomputes locally (slower prefill, correct
             # output); a brownout serves every request delay_ms late.
-            prefill_s = prompt_tokens / p.prefill_tok_s
+            prefill_s, publish_group = self._plan_prefill(
+                request_id, prompt_tokens, prefix_group, prefix_tokens
+            )
             if faults.fires("kv.pull.drop", f"{self.address}|{request_id}"):
                 self.recompute_fallbacks += 1
                 prefill_s *= 1.0 + p.recompute_penalty
             prefill_s += faults.delay_s("replica.brownout", self.address)
             await self._hold(prefill_s)
+            if prefix_group is not None and self.kv_store is not None:
+                # The prefix pages exist now: they enter the local cache,
+                # and a freshly-computed group earns the fleet its first
+                # store copy (publish-on-fill; the master dedups).
+                self._prefix_cache_put(prefix_group)
+                if publish_group is not None:
+                    self.kv_store.publish(publish_group)
+                    self.store_published += 1
             yield "first-token"
             if output_tokens > 1:
                 # Load-dependent TPOT, snapshotted at decode start: the

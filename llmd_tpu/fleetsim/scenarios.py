@@ -26,6 +26,11 @@ brownout    one replica serves every request 200 ms slow: the scorers
 all_flap    every scrape fails for the whole run: the healthy-filter
             FAILS OPEN rather than 503ing a healthy fleet — requests
             keep completing.
+kv_federation overlapping-tenant shared prefixes over a fleet with the
+            simulated store tier armed (kv-federation.md): fresh
+            prefixes publish, peers fetch instead of re-prefilling,
+            recompute_avoided_tokens > 0, seeded store-leg pull drops
+            degrade to recompute, zero lost.
 ========== ==========================================================
 
 Trace sizes are chosen so the full matrix runs in CI minutes while the
@@ -40,7 +45,7 @@ import dataclasses
 from typing import Callable
 
 from llmd_tpu.fleetsim import scoreboard as sb
-from llmd_tpu.fleetsim.engines import ReplicaProfile
+from llmd_tpu.fleetsim.engines import ReplicaProfile, StoreProfile
 from llmd_tpu.fleetsim.sim import AutoscaleConfig, FleetConfig, FleetSim
 from llmd_tpu.fleetsim.traces import TraceRequest, generate
 
@@ -248,6 +253,57 @@ def build_all_flap(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
                     scenario="all_flap", invariants=invariants)
 
 
+def build_kv_federation(
+    seed: int = 0, qps_scale: float = 1.0, store: bool = True
+) -> FleetSim:
+    # Overlapping tenants: every tenant draws from the SAME Zipf-ish
+    # pool of 16 shared prefixes (256-token system prompts over a
+    # ragged unique tail), so identical prefixes land on different
+    # replicas. The per-replica prefix cache holds only 2 groups —
+    # eviction pressure is the point: a prefix computed (then evicted)
+    # on replica A must come back through the store on replica B, not
+    # through a fleet-wide re-prefill.
+    qps = 2_000.0 * qps_scale
+    duration = 2.0
+    n = max(3, round(6 * qps_scale))
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=256, output_tokens=8,
+        prefix_groups=16, prefix_frac=0.5,
+    )
+    # A seeded drop on the STORE leg only (match="store|"): dropped
+    # federated pulls must degrade to recompute — slower, never wrong,
+    # never lost (fault-tolerance.md).
+    plan = {
+        "seed": seed,
+        "faults": [{
+            "site": "kv.pull.drop", "match": "store|", "p": 0.05,
+            "times": None,
+        }],
+    } if store else None
+    cfg = FleetConfig(
+        replicas=n,
+        profile=_PROFILE,
+        kv_store=StoreProfile.from_bench() if store else None,
+        prefix_cache_groups=2,
+        grace_s=90.0,
+    )
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("all_completed", sb.inv_all_completed(1.0)),
+    ]
+    if store:
+        invariants += [
+            # THE federation bar: fleet-wide reuse actually happened.
+            ("recompute_avoided", sb.inv_recompute_avoided(1)),
+            ("store_flow", sb.inv_store_flow(1, 1)),
+            ("store_drops_fired", sb.inv_faults_fired("kv.pull.drop", 1)),
+            ("p99_ttft", sb.inv_p99_ttft_ms(600.0)),
+        ]
+    return FleetSim(cfg, trace, fault_plan=plan, seed=seed,
+                    scenario="kv_federation", invariants=invariants)
+
+
 SCENARIOS: dict[str, Scenario] = {
     s.name: s
     for s in [
@@ -267,5 +323,9 @@ SCENARIOS: dict[str, Scenario] = {
         Scenario("all_flap", build_all_flap,
                  "all scrapes fail: healthy-filter fail-open keeps "
                  "serving"),
+        Scenario("kv_federation", build_kv_federation,
+                 "shared prefixes through the store tier: publish + "
+                 "fetch-on-miss avoid fleet-wide recompute, drops "
+                 "degrade"),
     ]
 }

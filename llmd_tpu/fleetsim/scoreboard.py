@@ -417,6 +417,36 @@ def inv_brownout_steered(address: str, max_share: float) -> Invariant:
     return check
 
 
+def inv_recompute_avoided(min_tokens: int = 1) -> Invariant:
+    """The federation's headline (kv-federation.md): at least
+    ``min_tokens`` prompt tokens were served by store fetches instead
+    of fleet-wide re-prefill."""
+    def check(board: dict) -> str | None:
+        fed = board.get("kv_federation")
+        if fed is None:
+            return "scoreboard carries no kv_federation section"
+        got = fed["recompute_avoided_tokens"]
+        if got < min_tokens:
+            return f"recompute_avoided_tokens {got} < {min_tokens}"
+        return None
+    return check
+
+
+def inv_store_flow(min_published: int = 1, min_hits: int = 1) -> Invariant:
+    """Both federation legs engaged: replicas published prefixes to the
+    store AND peers fetched them back."""
+    def check(board: dict) -> str | None:
+        fed = board.get("kv_federation")
+        if fed is None:
+            return "scoreboard carries no kv_federation section"
+        if fed["store_published"] < min_published:
+            return f"store_published {fed['store_published']} < {min_published}"
+        if fed["store_hits"] < min_hits:
+            return f"store_hits {fed['store_hits']} < {min_hits}"
+        return None
+    return check
+
+
 def inv_faults_fired(site: str, at_least: int = 1) -> Invariant:
     def check(board: dict) -> str | None:
         n = board["faults_injected"].get(site, 0)

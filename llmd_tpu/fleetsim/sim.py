@@ -61,7 +61,9 @@ from llmd_tpu.fleetsim.engines import (
     ReplicaDied,
     ReplicaProfile,
     ReplicaUnreachable,
+    SimKVStore,
     SimReplica,
+    StoreProfile,
 )
 from llmd_tpu.fleetsim.scoreboard import Scoreboard
 from llmd_tpu.fleetsim.traces import TraceRequest
@@ -102,6 +104,12 @@ class FleetConfig:
     unhealthy_after: int = 3
     chaos_tick_s: float = 0.05
     grace_s: float = 60.0  # drain window after the last arrival
+    # KV federation (kv-federation.md): a StoreProfile arms the
+    # fleet-wide prefix store — replicas publish freshly-computed
+    # shared prefixes and fetch peers' copies instead of re-prefilling
+    # (None = no store, the pre-federation fleet).
+    kv_store: StoreProfile | None = None
+    prefix_cache_groups: int = 8  # per-replica local prefix-cache LRU cap
     # Simulated idle time appended AFTER the last request drains, with
     # the control loops still running — the window where scale-down /
     # scale-to-zero behavior is observable. Free: it is virtual time.
@@ -247,6 +255,9 @@ class FleetSim:
         self.board = Scoreboard(scenario, seed)
         self.store = EndpointStore()
         self.replicas: dict[str, SimReplica] = {}
+        self.kv_store = (
+            SimKVStore(cfg.kv_store) if cfg.kv_store is not None else None
+        )
         sched_cfg = cfg.scheduler_config or default_sim_config(
             seed,
             max_inflight=cfg.flow_max_inflight,
@@ -278,7 +289,11 @@ class FleetSim:
     def _add_replica(self) -> SimReplica:
         addr = f"10.0.0.{self._next_replica}:8000"
         self._next_replica += 1
-        rep = SimReplica(addr, self.cfg.profile)
+        rep = SimReplica(
+            addr, self.cfg.profile,
+            kv_store=self.kv_store,
+            prefix_cache_groups=self.cfg.prefix_cache_groups,
+        )
         self.replicas[addr] = rep
         self.store.upsert(Endpoint(
             address=addr,
@@ -343,15 +358,30 @@ class FleetSim:
 
     # ---- the request path (mirrors Router._route_and_proxy) ----------- #
 
+    def _prompt_text(self, treq: TraceRequest) -> str:
+        """Unique prompt text: head identifies the request (so approx
+        prefix hashing sees cold prompts, engaging no-hit-lru spread),
+        padding makes approx_prompt_tokens track the trace's size.
+        Shared-prefix requests instead lead with their group id padded
+        to the prefix length, so the router's approximate prefix
+        scorer sees EXACTLY the overlap the store tier models."""
+        total = treq.prompt_tokens * 4
+        if treq.prefix_group and treq.prefix_tokens > 0:
+            head_len = min(total, treq.prefix_tokens * 4)
+            head = (treq.prefix_group + ":") * (
+                head_len // (len(treq.prefix_group) + 1) + 1
+            )
+            tail = f"{treq.tenant}:{treq.request_id}:"
+            pad = max(0, total - head_len - len(tail))
+            return head[:head_len] + tail + "x" * pad
+        pad = max(0, total - len(treq.request_id) - 8)
+        return f"{treq.tenant}:{treq.request_id}:" + "x" * pad
+
     async def _handle(self, treq: TraceRequest) -> None:
-        # Unique prompt text: head identifies the request (so approx
-        # prefix hashing sees cold prompts, engaging no-hit-lru spread),
-        # padding makes approx_prompt_tokens track the trace's size.
-        pad = max(0, treq.prompt_tokens * 4 - len(treq.request_id) - 8)
         req = LLMRequest(
             request_id=treq.request_id,
             model=self.cfg.model_id,
-            prompt_text=f"{treq.tenant}:{treq.request_id}:" + "x" * pad,
+            prompt_text=self._prompt_text(treq),
             priority=treq.priority,
             fairness_id=treq.tenant,
             ttft_slo_ms=treq.ttft_slo_ms,
@@ -398,7 +428,9 @@ class FleetSim:
                 ):
                     raise ReplicaUnreachable(pod.address)
                 async for _ in replica.serve(
-                    req.request_id, treq.prompt_tokens, treq.output_tokens
+                    req.request_id, treq.prompt_tokens, treq.output_tokens,
+                    prefix_group=treq.prefix_group,
+                    prefix_tokens=treq.prefix_tokens,
                 ):
                     if first is None:
                         first = clock.monotonic()
@@ -550,6 +582,18 @@ class FleetSim:
         recompute = sum(
             r.recompute_fallbacks for r in self.replicas.values()
         )
+        extra = None
+        if self.kv_store is not None:
+            reps = list(self.replicas.values())
+            extra = {"kv_federation": {
+                "store": self.kv_store.stats(),
+                "recompute_avoided_tokens": sum(
+                    r.recompute_avoided_tokens for r in reps
+                ),
+                "store_hits": sum(r.store_hits for r in reps),
+                "store_published": sum(r.store_published for r in reps),
+                "local_prefix_hits": sum(r.prefix_local_hits for r in reps),
+            }}
         return self.board.finalize(
             duration_s=max(self._duration, 1e-9),
             invariants=self.invariants,
@@ -558,6 +602,7 @@ class FleetSim:
             breaker_opened=sorted(self.board.breaker_open_after_kill_s),
             faults_injected=injected,
             recompute_fallbacks=recompute,
+            extra=extra,
         )
 
     def _pool_stats(self) -> tuple[float, float]:

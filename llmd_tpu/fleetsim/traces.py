@@ -18,7 +18,12 @@ JSONL line schema (documented in docs/architecture/fleet-soak.md)::
 
     {"t": 0.0132, "request_id": "r000001", "tenant": "tenant-0",
      "prompt_tokens": 128, "output_tokens": 8, "priority": 0,
-     "ttft_slo_ms": null}
+     "ttft_slo_ms": null, "prefix_group": "g001", "prefix_tokens": 128}
+
+``prefix_group``/``prefix_tokens`` (optional, defaulting to no shared
+prefix) mark the shared-prefix identity the kv_federation scenario
+publishes and fetches through the simulated store; traces predating
+the fields replay unchanged.
 """
 
 from __future__ import annotations
@@ -42,6 +47,12 @@ class TraceRequest:
     output_tokens: int = 8
     priority: int = 0
     ttft_slo_ms: float | None = None
+    # Shared-prefix identity for the KV-federation scenario
+    # (kv-federation.md): requests carrying the same group share their
+    # first ``prefix_tokens`` tokens — the unit the simulated store
+    # publishes and fetches. None = a fully unique prompt.
+    prefix_group: str | None = None
+    prefix_tokens: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -98,6 +109,8 @@ def generate(
     burst_factor: float = 5.0,
     diurnal_floor: float = 0.02,
     ttft_slo_ms: float | None = None,
+    prefix_groups: int = 0,
+    prefix_frac: float = 0.5,
 ) -> list[TraceRequest]:
     """Seeded inhomogeneous-Poisson arrivals with a weighted tenant mix.
 
@@ -108,6 +121,14 @@ def generate(
     Per-request token counts jitter uniformly within ``±token_jitter``
     of the means, so the fleet sees realistically ragged work, not a
     metronome.
+
+    ``prefix_groups > 0`` gives every request a shared-prefix identity
+    drawn Zipf-ish from that many groups (group k at weight 1/(k+1) —
+    a few hot system prompts, a long warm tail), INDEPENDENT of the
+    tenant draw, so the same prefix recurs across tenants — the
+    overlapping-tenant workload whose fleet-wide recompute the KV
+    federation exists to erase. ``prefix_frac`` of each prompt is the
+    shared prefix.
     """
     rng = random.Random(seed)
     names = [t for t, _ in tenants]
@@ -124,13 +145,24 @@ def generate(
         if rng.random() >= rate / peak:
             continue
         jit = 1.0 + token_jitter * (2.0 * rng.random() - 1.0)
+        n_prompt = max(1, round(prompt_tokens * jit))
+        group, n_prefix = None, 0
+        if prefix_groups > 0:
+            k = rng.choices(
+                range(prefix_groups),
+                weights=[1.0 / (j + 1) for j in range(prefix_groups)],
+            )[0]
+            group = f"g{k:03d}"
+            n_prefix = min(n_prompt, max(1, round(prompt_tokens * prefix_frac)))
         out.append(TraceRequest(
             t=t,
             request_id=f"r{i:06d}",
             tenant=rng.choices(names, weights=weights, k=1)[0],
-            prompt_tokens=max(1, round(prompt_tokens * jit)),
+            prompt_tokens=n_prompt,
             output_tokens=max(1, round(output_tokens * jit)),
             ttft_slo_ms=ttft_slo_ms,
+            prefix_group=group,
+            prefix_tokens=n_prefix,
         ))
         i += 1
     return out

@@ -60,8 +60,21 @@ class CrossSliceStoreClient:
         self.puts = 0
         self.pulls = 0
         self.pull_failures = 0
+        self.misses = 0
         self.rejected_puts = 0
         self.dropped_publishes = 0
+        # Federation hooks (llmd_tpu/federation/core.py). on_published:
+        # called (from the publisher thread) with the key of every
+        # publication the master ACCEPTED. on_publish_failed: the
+        # publication did NOT land (master down, queue overflow) — the
+        # federation unmarks the key so a later save/evict retries;
+        # rejected puts (another segment won) are terminal, not
+        # failures. on_evicted: the master's watermark eviction reached
+        # this owner — the store copy is gone, withdraw its
+        # advertisement.
+        self.on_published = None
+        self.on_publish_failed = None
+        self.on_evicted = None
         self._local_keys: set[str] = set()
         self._registered = False
         self._stop = threading.Event()
@@ -75,7 +88,8 @@ class CrossSliceStoreClient:
         # Publications are fire-and-forget off the engine thread: a
         # bounded queue feeds one publisher thread; overflow drops the
         # publish (the store is a cache, the local tiers still hold it).
-        self._pub_queue: "queue.Queue[tuple[str, bytes] | None]" = queue.Queue(
+        # items: (key, bytes | zero-arg loader) — see put_async
+        self._pub_queue: "queue.Queue[tuple[str, object] | None]" = queue.Queue(
             maxsize=256
         )
         self._pub = threading.Thread(target=self._publish_loop, daemon=True)
@@ -139,6 +153,9 @@ class CrossSliceStoreClient:
                     continue
                 for key in reply.get("evict", []):
                     self.server.unregister(key)
+                    self._local_keys.discard(key)
+                    if self.on_evicted is not None:
+                        self.on_evicted(key)
             except (urllib.error.URLError, OSError, TimeoutError) as e:
                 log.debug("kvstore heartbeat failed: %s", e)
                 self._registered = False
@@ -162,19 +179,34 @@ class CrossSliceStoreClient:
 
     # ------------------------------------------------------------ api
 
-    def put_async(self, key: str, data: bytes) -> None:
+    def _publish_failed(self, key: str) -> None:
+        if self.on_publish_failed is not None:
+            self.on_publish_failed(key)
+
+    def put_async(self, key: str, data) -> None:
         """Queue a publication without blocking the caller (the engine
-        thread's offload flush). Overflow drops the publish."""
+        thread's offload flush). ``data`` is the object bytes, or a
+        zero-arg callable the publisher thread invokes to materialize
+        them (the evict-path publish defers its FS load + serialization
+        here). Overflow drops the publish."""
         try:
             self._pub_queue.put_nowait((key, data))
         except queue.Full:
             self.dropped_publishes += 1
+            self._publish_failed(key)
 
-    def put(self, key: str, data: bytes) -> bool:
+    def put(self, key: str, data) -> bool:
         """Publish an object: bytes into the local kvship server, metadata
         to the master. First copy wins cluster-wide; redundant copies are
         dropped locally."""
+        if callable(data):
+            data = data()  # deferred materialization (publisher thread)
+            if data is None:
+                # The page left every local tier before the publish ran.
+                self._publish_failed(key)
+                return False
         if not self._registered:
+            self._publish_failed(key)
             return False
         try:
             self.server.register(key, data, lease_ms=_OBJECT_LEASE_MS)
@@ -189,10 +221,13 @@ class CrossSliceStoreClient:
                 return False
             self.puts += 1
             self._local_keys.add(key)
+            if self.on_published is not None:
+                self.on_published(key)
             return True
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             log.debug("kvstore put failed: %s", e)
             self.server.unregister(key)
+            self._publish_failed(key)
             return False
 
     def locate(self, keys: list[str]) -> dict[str, dict]:
@@ -209,10 +244,12 @@ class CrossSliceStoreClient:
         opens a read breaker instead of stalling every prompt."""
         now = time.monotonic()
         if now < self._read_down_until:
+            self.misses += 1
             return None
         t0 = now
         loc = self.locate([key]).get(key)
         if loc is None:
+            self.misses += 1
             if time.monotonic() - t0 > self.timeout_s / 2:
                 self._read_down_until = time.monotonic() + self._read_cooldown_s
             return None
@@ -253,6 +290,7 @@ class CrossSliceStoreClient:
             "puts": self.puts,
             "pulls": self.pulls,
             "pull_failures": self.pull_failures,
+            "misses": self.misses,
             "rejected_puts": self.rejected_puts,
             "dropped_publishes": self.dropped_publishes,
         }

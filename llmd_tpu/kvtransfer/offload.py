@@ -18,13 +18,17 @@ vllm/patch-vllm.yaml:43,56-59 — HBM staging + 25000 CPU chunks):
   * tier-honest events: a wrapping KVEventSink downgrades device evictions
     of host-held pages to BlockStored(medium="cpu") instead of removal, so
     the precise prefix indexer scores the CPU tier at weight 0.8
-    (kv-indexer.md:133) rather than forgetting the pod.
+    (kv-indexer.md:133) rather than forgetting the pod;
+  * federation tier (docs/architecture/kv-federation.md): behind DRAM/FS
+    sits the fleet-wide store — ``KVFederation`` decides which pages earn
+    a global copy (publish-on-evict hotness gate, or the eager save
+    policy) and serves fetch-on-miss for hash-chain pages no local tier
+    holds; the device eviction hook below is the publish trigger.
 """
 
 from __future__ import annotations
 
 import collections
-import io
 import logging
 import pathlib
 import threading
@@ -49,12 +53,12 @@ class HostKVCache:
         max_pages: int = 25_000,
         fs_dir: str | None = None,
         fs_max_pages: int = 100_000,
-        remote=None,  # CrossSliceStoreClient: shared tier behind DRAM/FS
+        federation=None,  # KVFederation: fleet-wide tier behind DRAM/FS
     ) -> None:
         self.max_pages = max_pages
         self.fs_dir = pathlib.Path(fs_dir) if fs_dir else None
         self.fs_max_pages = fs_max_pages
-        self.remote = remote
+        self.federation = federation
         self.remote_hits = 0
         self._lock = threading.Lock()
         self._pages: collections.OrderedDict[bytes, np.ndarray] = collections.OrderedDict()
@@ -83,31 +87,78 @@ class HostKVCache:
         with self._lock:
             if h in self._pages:
                 self._pages.move_to_end(h)
-                return
-            self._pages[h] = page
-            self.saves += 1
+                re_save = True
+            else:
+                self._pages[h] = page
+                self.saves += 1
+                re_save = False
             spill: list[tuple[bytes, np.ndarray]] = []
             while len(self._pages) > self.max_pages:
                 old_h, old_p = self._pages.popitem(last=False)
                 spill.append((old_h, old_p))
         for old_h, old_p in spill:
             self._spill_fs(old_h, old_p)
-        if publish:
-            self._publish_remote(h, page)
+        if self.federation is not None:
+            if re_save:
+                # Same content re-saved: a reuse signal for the
+                # publish-on-evict hotness gate, not a new copy.
+                self.federation.touch(h)
+            elif publish:
+                self.federation.on_save(h, page)
 
     def get(self, h: bytes) -> np.ndarray | None:
+        page, _ = self.get_tagged(h)
+        return page
+
+    def get_tagged(self, h: bytes) -> tuple[np.ndarray | None, str | None]:
+        """Fetch a page plus the tier that served it (``dram`` | ``fs``
+        | ``store`` | None) — the restore path scores store-served
+        pages as recompute avoided (kv-federation.md)."""
         with self._lock:
             page = self._pages.get(h)
             if page is not None:
                 self._pages.move_to_end(h)
                 self.restores += 1
-                return page
+                if self.federation is not None:
+                    self.federation.touch(h)
+                return page, "dram"
         page = self._load_fs(h)
-        if page is None:
-            page = self._load_remote(h)
         if page is not None:
             self.restores += 1
-        return page
+            if self.federation is not None:
+                self.federation.touch(h)
+            return page, "fs"
+        page = self._load_remote(h)
+        if page is not None:
+            self.restores += 1
+            return page, "store"
+        return None, None
+
+    def note_use(self, h: bytes) -> None:
+        """Device-cache prefix hit observed by the restore walk: feed
+        the federation hotness book (the device tier never calls
+        get())."""
+        if self.federation is not None:
+            self.federation.touch(h)
+
+    def publish_evicted(self, h: bytes) -> None:
+        """Publish-on-evict hook (TieredEventSink.blocks_removed): the
+        device cache just evicted a page this host still holds. The
+        hotness gate runs here on the engine thread; the page bytes are
+        materialized (possibly an FS load) and serialized on the
+        store's publisher thread (publish_deferred), so an eviction
+        burst — which lands exactly when the engine is under memory
+        pressure — costs the engine thread nothing per page."""
+        fed = self.federation
+        if fed is None or not fed.wants_publish_on_evict(h):
+            return
+
+        def loader():
+            with self._lock:
+                page = self._pages.get(h)
+            return page if page is not None else self._load_fs(h)
+
+        fed.publish_deferred(h, loader)
 
     # ------------------------------------------------------------------ #
     # FS tier
@@ -134,32 +185,19 @@ class HostKVCache:
                     pass
 
     # ------------------------------------------------------------------ #
-    # Cross-slice shared tier (Mooncake-store role; llmd_tpu/kvstore)
+    # Federation tier (fleet-wide store; llmd_tpu/federation)
 
     def _load_remote(self, h: bytes) -> np.ndarray | None:
-        if self.remote is None:
+        if self.federation is None:
             return None
-        blob = self.remote.get(h.hex())
-        if blob is None:
-            return None
-        try:
-            page = np.load(io.BytesIO(blob), allow_pickle=False)
-        except (OSError, ValueError):
+        page = self.federation.fetch(h)
+        if page is None:
             return None
         with self._lock:
             self.remote_hits += 1
         # Promote into the local DRAM tier for subsequent hits.
         self.put(h, page, publish=False)
         return page
-
-    def _publish_remote(self, h: bytes, page: np.ndarray) -> None:
-        if self.remote is None:
-            return
-        buf = io.BytesIO()
-        np.save(buf, page, allow_pickle=False)
-        # Fire-and-forget: the caller is the engine thread's offload
-        # flush; the client's publisher thread does the HTTP.
-        self.remote.put_async(h.hex(), buf.getvalue())
 
     def _load_fs(self, h: bytes) -> np.ndarray | None:
         if self.fs_dir is None:
@@ -200,8 +238,8 @@ class HostKVCache:
                 self._path(h).unlink(missing_ok=True)
             except OSError:
                 pass
-        if self.remote is not None:
-            self.remote.clear_local()
+        if self.federation is not None:
+            self.federation.clear_local()
 
     def stats(self) -> dict[str, int]:
         with self._lock:
@@ -226,9 +264,44 @@ class TieredEventSink(KVEventSink):
     def __init__(self, inner: KVEventSink, host: HostKVCache) -> None:
         self.inner = inner
         self.host = host
+        # Serializes the inner sink's medium juggle: the engine thread
+        # (device evictions -> cpu) and the federation publisher thread
+        # (accepted publications -> store) both re-label through it.
+        self._medium_lock = threading.Lock()
 
     def blocks_stored(self, hashes, parent, token_ids) -> None:
-        self.inner.blocks_stored(hashes, parent, token_ids)
+        # Under the medium lock: the federation's publisher thread swaps
+        # inner.medium mid-emit (stored_with_medium); an unlocked pass
+        # here could label fresh device commits with the swapped tier.
+        with self._medium_lock:
+            self.inner.blocks_stored(hashes, parent, token_ids)
+
+    def _with_medium(self, medium: str, emit) -> None:
+        with self._medium_lock:
+            if hasattr(self.inner, "medium"):
+                prev, self.inner.medium = self.inner.medium, medium
+                try:
+                    emit()
+                finally:
+                    self.inner.medium = prev
+            else:
+                emit()
+
+    def stored_with_medium(self, hashes, medium: str) -> None:
+        """Emit BlockStored under an explicit tier label (cpu for
+        downgraded device evictions, store for accepted federation
+        publications). Thread-safe."""
+        self._with_medium(
+            medium, lambda: self.inner.blocks_stored(hashes, None, [])
+        )
+
+    def removed_with_medium(self, hashes, medium: str) -> None:
+        """Emit BlockRemoved under an explicit tier label — the
+        federation's withdrawal of a store copy the master evicted
+        (kv-federation.md staleness bound). Thread-safe."""
+        self._with_medium(
+            medium, lambda: self.inner.blocks_removed(hashes)
+        )
 
     def blocks_removed(self, hashes) -> None:
         gone: list = []
@@ -237,14 +310,13 @@ class TieredEventSink(KVEventSink):
             (kept if self.host.has(h) else gone).append(h)
         if gone:
             self.inner.blocks_removed(gone)
-        if kept and hasattr(self.inner, "medium"):
-            prev, self.inner.medium = self.inner.medium, "cpu"
-            try:
-                self.inner.blocks_stored(kept, None, [])
-            finally:
-                self.inner.medium = prev
-        elif kept:
-            self.inner.blocks_stored(kept, None, [])
+        if kept:
+            # Publish-on-evict trigger (kv-federation.md): the page
+            # just left HBM but survives in a host tier — the hotness
+            # gate decides whether it earns a fleet-wide copy.
+            for h in kept:
+                self.host.publish_evicted(h)
+            self.stored_with_medium(kept, "cpu")
 
     def all_cleared(self) -> None:
         # Device cleared; host tier survives. Without per-block diffs the
@@ -268,6 +340,11 @@ class OffloadConnector:
         self.host = host
         # (content_hash, page_id) committed this step, pending offload.
         self._pending: list[tuple[bytes, int]] = []
+        # Federation accounting (kv-federation.md): prompt tokens whose
+        # prefill was served by pages pulled from the fleet-wide store
+        # and committed — the recompute the federation avoided.
+        self.recompute_avoided_tokens = 0
+        self.store_pages_committed = 0
 
     # -- save path (engine thread) -------------------------------------- #
 
@@ -298,12 +375,18 @@ class OffloadConnector:
         if not hashes:
             return 0
         restore: list[tuple[int, bytes, np.ndarray]] = []  # (idx, hash, data)
+        store_pages = 0
         for idx, h in enumerate(hashes):
             if self.allocator.has_cached(h):
+                # Device-resident prefix hit: a reuse signal for the
+                # publish-on-evict hotness gate.
+                self.host.note_use(h)
                 continue
-            data = self.host.get(h)
+            data, tier = self.host.get_tagged(h)
             if data is None:
                 break  # chain broken: nothing past this point is usable
+            if tier == "store":
+                store_pages += 1
             restore.append((idx, h, data))
         if not restore:
             return 0
@@ -320,4 +403,10 @@ class OffloadConnector:
             parent = hashes[idx - 1] if idx > 0 else None
             self.allocator.commit_page(pid, h, chunk, parent)
         self.allocator.free(page_ids)
+        if store_pages:
+            # Counted only after the commit actually landed: these
+            # tokens' prefill now rides the prefix cache instead of a
+            # fleet-wide re-prefill.
+            self.store_pages_committed += store_pages
+            self.recompute_avoided_tokens += store_pages * page
         return len(page_ids)

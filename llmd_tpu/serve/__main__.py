@@ -136,6 +136,8 @@ def make_engine_config(args, lora_adapters=None):
                 store_master_url=args.kv_store_master_url,
                 store_segment_bytes=args.kv_store_segment_bytes,
                 store_data_port=args.kv_store_data_port,
+                publish_policy=args.kv_publish_policy,
+                publish_min_hits=args.kv_publish_min_hits,
             )
             if args.kv_offload_chunks
             else None
@@ -279,6 +281,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="DRAM this host contributes to the shared pool",
     )
     p.add_argument("--kv-store-data-port", type=int, default=9200)
+    p.add_argument(
+        "--kv-publish-policy", default="save",
+        choices=["save", "evict-hot", "off"],
+        help="federation publish policy (kv-federation.md): save = "
+        "publish every host-tier save (eager); evict-hot = publish only "
+        "device-evicted pages used >= --kv-publish-min-hits times; off = "
+        "read-only store participation",
+    )
+    p.add_argument(
+        "--kv-publish-min-hits", type=int, default=2,
+        help="hotness gate for --kv-publish-policy evict-hot: distinct "
+        "uses of a page's hash chain before eviction earns a store copy",
+    )
     p.add_argument("--skip-warmup", action="store_true")
     p.add_argument(
         "--lora-adapters", default=None,

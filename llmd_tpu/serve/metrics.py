@@ -67,6 +67,17 @@ def render_metrics(
         "num_preemptions_total": stats.preemptions,
         "kv_offload_saves_total": stats.offload_saves,
         "kv_offload_restores_total": stats.offload_restores,
+        # Cross-replica KV federation (kv-federation.md): store-client
+        # reads (peer pulls / failures / locate misses), publications
+        # the master accepted, pages fetched from the store, and the
+        # prompt tokens whose fleet-wide re-prefill those pages avoided
+        # — the federation's headline counter.
+        "kvstore_pulls_total": stats.kvstore_pulls,
+        "kvstore_pull_failures_total": stats.kvstore_pull_failures,
+        "kvstore_misses_total": stats.kvstore_misses,
+        "kv_federation_published_total": stats.kv_federation_published,
+        "kv_federation_hits_total": stats.kv_federation_hits,
+        "recompute_avoided_tokens_total": stats.recompute_avoided_tokens,
         # P/D transfer accounting (producer exports / consumer pulls)
         "kv_transfer_exported_requests_total": stats.kv_exported_requests,
         "kv_transfer_exported_bytes_total": stats.kv_exported_bytes,

@@ -162,6 +162,12 @@ DASHBOARDS["llmd-router-overview"] = dashboard(
         panel("KV events ingested /s",
               ["rate(llm_d_epp_prefix_index_events_total[5m])"],
               desc="BlockStored/Removed/Cleared stream rate from engines."),
+        panel("Store-fetchable blocks",
+              ["llm_d_epp_prefix_index_store_blocks"],
+              desc="Blocks the index knows to be one fetch away in the "
+                   "fleet-wide store — the tri-state scoring tier "
+                   "(docs/architecture/kv-federation.md). Zero with "
+                   "federation on = publications not reaching the index."),
     ],
 )
 
@@ -215,6 +221,32 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
               legends=["hits/s", "captures/s"],
               desc="captures with zero hits = retention is paying copy "
                    "cost for prefixes that never repeat."),
+        row("KV federation (fleet-wide store)"),
+        panel("Recompute avoided tok/s",
+              [f"rate(llmd:recompute_avoided_tokens_total{M}[5m])",
+               f"rate(vllm:prompt_tokens_total{M}[5m])"],
+              legends=["avoided tok/s", "prompt tok/s"],
+              desc="Prompt tokens served by store-fetched pages instead "
+                   "of fleet-wide re-prefill — the federation headline "
+                   "(docs/architecture/kv-federation.md); read against "
+                   "total prompt throughput."),
+        panel("Federation flow /s",
+              [f"rate(llmd:kv_federation_published_total{M}[5m])",
+               f"rate(llmd:kv_federation_hits_total{M}[5m])"],
+              legends=["published/s", "store hits/s"],
+              desc="Publications the master accepted vs pages pulled "
+                   "back. Publishes with zero hits fleet-wide = the "
+                   "store is not earning its copies (raise the hotness "
+                   "gate); hits on this replica come from peers."),
+        panel("Store client reads /s",
+              [f"rate(llmd:kvstore_pulls_total{M}[5m])",
+               f"rate(llmd:kvstore_pull_failures_total{M}[5m])",
+               f"rate(llmd:kvstore_misses_total{M}[5m])"],
+              legends=["pulls/s", "pull failures/s", "misses/s"],
+              desc="Peer-to-peer read path. Failures degrade to "
+                   "recompute (never an error upstream); a miss burst "
+                   "with the master down rides the read breaker's "
+                   "cooldown."),
         row("Step pipeline (async stepping)"),
         panel("Host gap per step",
               [f"llmd:step_host_gap_ms{M}",

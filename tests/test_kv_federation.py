@@ -1,0 +1,496 @@
+"""Cross-replica KV federation e2e (docs/architecture/kv-federation.md).
+
+The headline contract: a prefix computed (then device-evicted) on
+replica A is reused on replica B through the fleet-wide store — B's
+prefill rides a peer-to-peer fetch instead of a re-prefill, the output
+stream stays byte-identical to the recompute path, and every failure
+mode on the store leg (dropped pull, master timeout, corrupt blob)
+degrades to the ordinary recompute policy with its counter visible on
+the same /metrics page production scrapes.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from llmd_tpu import faults
+from llmd_tpu.config import (
+    CacheConfig,
+    EngineConfig,
+    OffloadConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams
+from llmd_tpu.federation import KVFederation, PageDecodeError, decode_page, encode_page
+from llmd_tpu.kvtransfer.offload import HostKVCache
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm()
+
+
+def plan(*specs, seed=0):
+    return faults.arm(faults.FaultPlan([faults.FaultSpec(**s) for s in specs],
+                                       seed=seed))
+
+
+# --------------------------------------------------------------------- #
+# wire format
+
+
+def test_wire_roundtrip():
+    page = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    blob = encode_page(page)
+    np.testing.assert_array_equal(decode_page(blob), page)
+
+
+def test_wire_rejects_corruption():
+    page = np.ones((1, 2, 2, 4), np.float32)
+    blob = bytearray(encode_page(page))
+    blob[-3] ^= 0xFF  # flip a payload byte: CRC must catch it
+    with pytest.raises(PageDecodeError, match="CRC"):
+        decode_page(bytes(blob))
+    with pytest.raises(PageDecodeError, match="magic"):
+        decode_page(b"XXXX" + bytes(blob)[4:])
+    with pytest.raises(PageDecodeError, match="short"):
+        decode_page(b"KV")
+
+
+# --------------------------------------------------------------------- #
+# publish policies (fake client: no sockets, deterministic)
+
+
+class FakeStoreClient:
+    def __init__(self, fail_puts=False):
+        self.blobs: dict[str, bytes] = {}
+        self.fail_puts = fail_puts
+        self.on_published = None
+        self.on_publish_failed = None
+        self.on_evicted = None
+
+    def put_async(self, key, blob):
+        if callable(blob):
+            blob = blob()  # deferred materialization, like the real client
+        if blob is None or self.fail_puts:
+            if self.on_publish_failed is not None:
+                self.on_publish_failed(key)
+            return
+        self.blobs[key] = blob
+        if self.on_published is not None:
+            self.on_published(key)
+
+    def get(self, key):
+        return self.blobs.get(key)
+
+    def clear_local(self):
+        self.blobs.clear()
+
+
+def _page(v):
+    return np.full((1, 2, 2, 4), v, np.float32)
+
+
+def test_eager_save_policy_publishes_every_save():
+    fed = KVFederation(FakeStoreClient(), publish_policy="save")
+    host = HostKVCache(max_pages=8, federation=fed)
+    host.put(b"\x01", _page(1))
+    assert fed.client.blobs  # published on first save
+    assert fed.published == 1
+
+
+def test_evict_hot_gate_requires_hits():
+    fed = KVFederation(
+        FakeStoreClient(), publish_policy="evict-hot", publish_min_hits=2
+    )
+    host = HostKVCache(max_pages=8, federation=fed)
+    host.put(b"\x01", _page(1))  # one use: cold
+    assert not fed.client.blobs
+    host.publish_evicted(b"\x01")  # eviction of a cold page: no publish
+    assert not fed.client.blobs
+    assert host.get(b"\x01") is not None  # second distinct use: hot
+    host.publish_evicted(b"\x01")
+    assert list(fed.client.blobs) == [b"\x01".hex()]
+    assert fed.published == 1
+    # re-eviction of an already-enqueued page does not re-serialize
+    host.publish_evicted(b"\x01")
+    assert fed.publish_requests == 1
+
+
+def test_off_policy_never_publishes_but_fetches():
+    client = FakeStoreClient()
+    client.blobs[b"\x07".hex()] = encode_page(_page(7))
+    fed = KVFederation(client, publish_policy="off")
+    host = HostKVCache(max_pages=8, federation=fed)
+    host.put(b"\x01", _page(1))
+    host.publish_evicted(b"\x01")
+    assert b"\x01".hex() not in client.blobs
+    # read participation stays on: fetch-on-miss still serves
+    got, tier = host.get_tagged(b"\x07")
+    np.testing.assert_array_equal(got, _page(7))
+    assert tier == "store"
+    assert fed.hits == 1
+
+
+def test_fetch_rejects_corrupt_blob_and_degrades():
+    client = FakeStoreClient()
+    client.blobs[b"\x07".hex()] = b"KVF1" + b"\x00" * 40  # garbage
+    fed = KVFederation(client, publish_policy="off")
+    assert fed.fetch(b"\x07") is None  # degrade, never raise
+    assert fed.crc_failures == 1
+    assert fed.hits == 0
+
+
+def test_unknown_publish_policy_rejected():
+    with pytest.raises(ValueError, match="unknown publish policy"):
+        KVFederation(FakeStoreClient(), publish_policy="always")
+
+
+def test_publish_failure_unmarks_for_retry():
+    """A failed publication (master down) must not permanently suppress
+    the page: the enqueued mark clears so a later save retries."""
+    client = FakeStoreClient(fail_puts=True)
+    fed = KVFederation(client, publish_policy="save")
+    fed.publish(b"\x01", _page(1))
+    assert fed.publish_failures == 1 and not client.blobs
+    client.fail_puts = False  # master recovers
+    fed.publish(b"\x01", _page(1))
+    assert fed.publish_requests == 2
+    assert list(client.blobs) == [b"\x01".hex()]
+    assert fed.published == 1
+
+
+def test_store_eviction_withdraws_and_allows_republish():
+    """The master's watermark eviction reaching the owner clears the
+    enqueued mark (a future hot eviction re-publishes) and emits a
+    store-tier withdrawal through the sink."""
+    client = FakeStoreClient()
+    fed = KVFederation(client, publish_policy="save")
+
+    emitted = []
+
+    class SinkSpy:
+        def removed_with_medium(self, hashes, medium):
+            emitted.append((hashes, medium))
+
+    fed.event_sink = SinkSpy()
+    fed.publish(b"\x01", _page(1))
+    assert fed.published == 1
+    client.on_evicted(b"\x01".hex())  # master watermark eviction
+    assert emitted == [([b"\x01"], "store")]
+    fed.publish(b"\x01", _page(1))  # hot again: re-publish allowed
+    assert fed.publish_requests == 2
+
+
+# --------------------------------------------------------------------- #
+# tri-state prefix scoring (kv-federation.md leg 2)
+
+
+def _stored(hashes, medium="gpu"):
+    return [{"type": "BlockStored", "hashes": hashes, "medium": medium}]
+
+
+def test_index_scores_store_tier_on_every_pod():
+    from llmd_tpu.events.index import KVBlockIndex
+
+    idx = KVBlockIndex()
+    idx.apply("pod-a", _stored(["h1", "h2"]))
+    idx.apply("pod-a", _stored(["h1", "h2"], medium="store"))
+    scores = idx.score(["h1", "h2"], ["pod-a", "pod-b"])
+    assert scores["pod-a"] == pytest.approx(2.0)  # resident beats store
+    assert scores["pod-b"] == pytest.approx(1.0)  # 2 blocks x 0.5
+    # store-fetchable blocks extend the admission prefix walk too
+    assert idx.matched_pages(["h1", "h2"], "pod-b") == 2
+    assert idx.stats()["store_blocks"] == 2
+
+
+def test_index_recompute_breaks_the_walk():
+    from llmd_tpu.events.index import KVBlockIndex
+
+    idx = KVBlockIndex()
+    idx.apply("pod-a", _stored(["h1"], medium="store"))
+    idx.apply("pod-a", _stored(["h3"], medium="store"))
+    # h2 is in no tier: the consecutive walk stops, h3 cannot count
+    assert idx.score(["h1", "h2", "h3"], ["pod-b"])["pod-b"] == (
+        pytest.approx(0.5)
+    )
+
+
+def test_index_store_removal_withdraws_fleet_copy():
+    from llmd_tpu.events.index import KVBlockIndex
+
+    idx = KVBlockIndex()
+    idx.apply("pod-a", _stored(["h1"]))
+    idx.apply("pod-a", _stored(["h1"], medium="store"))
+    # master evicted the store copy: the owner withdraws it — the
+    # fleet-global claim goes, pod-a's own residency stays
+    idx.apply(
+        "pod-a",
+        [{"type": "BlockRemoved", "hashes": ["h1"], "medium": "store"}],
+    )
+    scores = idx.score(["h1"], ["pod-a", "pod-b"])
+    assert scores["pod-a"] == pytest.approx(1.0)
+    assert scores["pod-b"] == 0.0
+    assert idx.stats()["store_blocks"] == 0
+
+
+def test_tier_weights_env_and_param_override(monkeypatch):
+    from llmd_tpu.events.index import (
+        DEFAULT_TIER_WEIGHTS,
+        KVBlockIndex,
+        parse_tier_weights,
+        tier_weights_from_env,
+    )
+
+    assert DEFAULT_TIER_WEIGHTS["store"] == 0.5
+    assert parse_tier_weights("cpu=0.7, store=0.4") == {
+        "cpu": 0.7, "store": 0.4,
+    }
+    # a typo'd entry is skipped, never zeroes the table
+    assert parse_tier_weights("storeX0.4,=,gpu=0.9") == {"gpu": 0.9}
+    monkeypatch.setenv("LLMD_PREFIX_TIER_WEIGHTS", "store=0.3")
+    assert tier_weights_from_env()["store"] == 0.3
+    idx = KVBlockIndex()
+    assert idx.tier_weights["store"] == 0.3  # env applies
+    idx = KVBlockIndex(tier_weights={"store": 0.25})
+    assert idx.tier_weights["store"] == 0.25  # param beats env
+    idx.apply("pod-a", _stored(["h1"], medium="store"))
+    assert idx.score(["h1"], ["pod-b"])["pod-b"] == pytest.approx(0.25)
+
+
+def test_scorer_flag_overrides_reach_the_index():
+    from llmd_tpu.epp.precise_prefix import PrecisePrefixCacheScorer
+
+    scorer = PrecisePrefixCacheScorer(tier_weights={"store": 0.4})
+    assert scorer.index.tier_weights["store"] == 0.4
+
+
+# --------------------------------------------------------------------- #
+# engine e2e through a real master (evict → publish → fetch-on-miss)
+
+
+class MasterHarness:
+    """Master app on a background loop so the synchronous store client
+    (urllib, called from engine threads) can reach it."""
+
+    def __init__(self):
+        from aiohttp.test_utils import TestServer
+
+        from llmd_tpu.kvstore.master import MasterState, build_app
+
+        self.state = MasterState()
+        self.loop = asyncio.new_event_loop()
+        self.url = None
+        self._started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self.loop)
+
+            async def start():
+                self.server = TestServer(build_app(self.state))
+                await self.server.start_server()
+                self.url = f"http://{self.server.host}:{self.server.port}"
+                self._started.set()
+
+            self.loop.run_until_complete(start())
+            self.loop.run_forever()
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+        self._started.wait(10)
+
+    def close(self):
+        async def stop():
+            await self.server.close()
+
+        asyncio.run_coroutine_threadsafe(stop(), self.loop).result(10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+@pytest.fixture
+def master():
+    h = MasterHarness()
+    yield h
+    h.close()
+
+
+PROMPT = list(range(1, 33))  # 32 tokens = 8 full pages @ page_size 4
+
+
+def make_engine(master_url=None, publish_policy="save", num_blocks=64):
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config(),
+        cache=CacheConfig(page_size=4, num_blocks=num_blocks, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64),
+        offload=OffloadConfig(
+            cpu_chunks=256,
+            store_master_url=master_url,
+            store_segment_bytes=1 << 22,
+            publish_policy=publish_policy,
+        ),
+    ))
+
+
+def _generate(eng, prompt, n=4):
+    out = eng.generate(
+        [list(prompt)],
+        SamplingParams(temperature=0.0, max_tokens=n, ignore_eos=True),
+    )
+    return next(iter(out.values()))
+
+
+def _thrash(eng, n=10):
+    rng = np.random.default_rng(0)
+    for _ in range(n):
+        junk = [int(t) for t in rng.integers(40, 250, size=40)]
+        _generate(eng, junk, n=1)
+
+
+def test_evict_hot_publish_then_peer_fetch_byte_identical(master):
+    """The acceptance headline: evict-hot on A publishes the twice-used
+    prefix; B — which never exchanged a request with A — serves the
+    same prompt through store fetches, byte-identical to recompute."""
+    # Recompute reference: no store anywhere near this engine.
+    ref_eng = make_engine()
+    ref = _generate(ref_eng, PROMPT)
+    ref_eng.close()
+
+    eng_a = make_engine(master.url, publish_policy="evict-hot")
+    eng_b = None
+    try:
+        out_a = _generate(eng_a, PROMPT)
+        assert out_a == ref
+        # nothing published yet: evict-hot waits for the eviction
+        eng_a._kvstore_client.flush_publishes()
+        assert eng_a._kvstore_client.puts == 0
+        _generate(eng_a, PROMPT)  # second distinct use: the chain is hot
+        _thrash(eng_a)  # device eviction triggers publish-on-evict
+        eng_a._kvstore_client.flush_publishes()
+        assert eng_a._kvstore_client.puts > 0
+        assert eng_a._federation.published > 0
+
+        # B: fresh engine, same master, nothing local. Its restore path
+        # must pull A's pages peer-to-peer and commit them.
+        eng_b = make_engine(master.url)
+        out_b = _generate(eng_b, PROMPT)
+        assert out_b == ref  # byte-identical vs recompute
+        assert eng_b._kvstore_client.pulls > 0
+        assert eng_b._federation.hits > 0
+        assert eng_b.offloader.recompute_avoided_tokens > 0
+
+        # the counters production scrapes, on the rendered page
+        from llmd_tpu.serve.metrics import render_metrics
+
+        eng_b._refresh_gauges()
+        text = render_metrics(eng_b.stats, "tiny")
+        assert "llmd:kvstore_pulls_total" in text
+        assert "llmd:kv_federation_hits_total" in text
+        for line in text.splitlines():
+            if line.startswith("llmd:recompute_avoided_tokens_total"):
+                assert float(line.split()[-1]) > 0
+                break
+        else:
+            pytest.fail("recompute_avoided_tokens_total not rendered")
+    finally:
+        eng_a.close()
+        if eng_b is not None:
+            eng_b.close()
+
+
+def test_store_pull_drop_degrades_to_recompute(master):
+    """PR 7 fault plan on the store leg: kv.pull.drop scoped to
+    federated pulls forces B back to recompute — same bytes, zero
+    federation hits, the drop counted."""
+    eng_a = make_engine(master.url)
+    eng_b = None
+    try:
+        ref = _generate(eng_a, PROMPT)
+        eng_a._kvstore_client.flush_publishes()
+        assert eng_a._kvstore_client.puts > 0
+
+        plan({"site": "kv.pull.drop", "match": "store|", "times": None})
+        eng_b = make_engine(master.url)
+        out_b = _generate(eng_b, PROMPT)
+        assert out_b == ref  # recompute is correct, just slower
+        assert eng_b._federation.hits == 0
+        assert eng_b.offloader.recompute_avoided_tokens == 0
+        assert faults.injected_counts()["kv.pull.drop"] >= 1
+
+        # degradation recovers the moment the fault clears
+        faults.disarm()
+        eng_b2 = make_engine(master.url)
+        try:
+            assert _generate(eng_b2, PROMPT) == ref
+            assert eng_b2._federation.hits > 0
+        finally:
+            eng_b2.close()
+    finally:
+        eng_a.close()
+        if eng_b is not None:
+            eng_b.close()
+
+
+def test_kvstore_timeout_degrades_to_recompute(master):
+    """Master unreachable mid-run (kvstore.get.timeout): fetch-on-miss
+    degrades to a miss + recompute; the read path never raises into
+    the admission path."""
+    eng_a = make_engine(master.url)
+    eng_b = None
+    try:
+        ref = _generate(eng_a, PROMPT)
+        eng_a._kvstore_client.flush_publishes()
+
+        plan({"site": "kvstore.get.timeout", "match": "locate",
+              "times": None})
+        eng_b = make_engine(master.url)
+        out_b = _generate(eng_b, PROMPT)
+        assert out_b == ref
+        assert eng_b._federation.hits == 0
+        assert eng_b._kvstore_client.misses > 0
+        assert faults.injected_counts()["kvstore.get.timeout"] >= 1
+
+        from llmd_tpu.serve.metrics import render_metrics
+
+        eng_b._refresh_gauges()
+        text = render_metrics(eng_b.stats, "tiny")
+        for line in text.splitlines():
+            if line.startswith("llmd:kvstore_misses_total"):
+                assert float(line.split()[-1]) > 0
+                break
+        else:
+            pytest.fail("kvstore_misses_total not rendered")
+    finally:
+        eng_a.close()
+        if eng_b is not None:
+            eng_b.close()
+
+
+# --------------------------------------------------------------------- #
+# fleetsim scenario (kv-federation.md leg 4)
+
+
+def test_fleetsim_kv_federation_scenario_deterministic():
+    import json
+
+    from llmd_tpu.fleetsim.scenarios import SCENARIOS
+
+    s = SCENARIOS["kv_federation"]
+    a = s.build(0, 0.5).run()
+    b = s.build(0, 0.5).run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    fed = a["kv_federation"]
+    assert fed["recompute_avoided_tokens"] > 0
+    assert fed["store_published"] >= 1 and fed["store_hits"] >= 1
+    assert a["requests"]["lost"] == 0
