@@ -10,7 +10,9 @@ from llmd_tpu.analysis.core import (  # noqa: F401
     Checker,
     Finding,
     Repo,
+    changed_paths,
     register,
     rule_names,
     run_analysis,
+    run_analysis_details,
 )

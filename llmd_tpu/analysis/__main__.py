@@ -4,6 +4,15 @@ Exit codes: 0 clean, 1 findings, 2 usage error. With no paths the scan
 set is the llmd_tpu package plus the parity side inputs (observability
 assets, docs, tracked shell scripts) relative to --root (default: the
 current directory, i.e. run it from the repo root).
+
+CI surfaces: ``--sarif <path>`` additionally writes SARIF 2.1.0 (stable
+per-finding rule ids) for PR annotation; ``--changed-only [BASE]``
+scopes the scan to ``git diff BASE`` paths (default HEAD; plus staged
+and untracked) so the annotation pass stays cheap — whole-tree parity
+rules want the full default scan, so the gating run stays unscoped;
+``--report-unused-pragmas`` lists ``# llmd: allow(...)`` pragmas that
+no longer suppress anything (exit 0 either way: a non-blocking hygiene
+report, since an unused pragma means the violation was FIXED).
 """
 
 from __future__ import annotations
@@ -14,10 +23,12 @@ from pathlib import Path
 
 from llmd_tpu.analysis.core import (
     CHECKERS,
+    changed_paths,
     render_human,
     render_json,
+    render_sarif,
     rule_names,
-    run_analysis,
+    run_analysis_details,
 )
 
 
@@ -31,6 +42,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="files/directories to scan (default: the repo scan set)",
     )
     p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument(
+        "--sarif", metavar="PATH", default=None,
+        help="also write findings as SARIF 2.1.0 to PATH (CI PR "
+        "annotation; stdout output is unaffected)",
+    )
+    p.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="BASE",
+        help="scan only paths changed vs BASE (git diff + staged + "
+        "untracked; default BASE: HEAD). An empty diff exits 0.",
+    )
+    p.add_argument(
+        "--report-unused-pragmas", action="store_true",
+        help="list `# llmd: allow(...)` pragmas that suppressed nothing "
+        "this pass (standalone non-blocking mode: always exits 0; "
+        "mutually exclusive with --json/--sarif)",
+    )
     p.add_argument(
         "--rules", default=None,
         help="comma-separated subset of rules to run",
@@ -62,10 +90,38 @@ def main(argv: list[str] | None = None) -> int:
         if args.rules
         else None
     )
-    try:
-        findings, nfiles = run_analysis(
-            Path(args.root), args.paths or None, rules
+    if args.report_unused_pragmas and (args.json or args.sarif):
+        # The hygiene mode replaces the findings report AND the
+        # exit-1 gate (always 0 by contract): combining it with the
+        # machine outputs would silently discard real findings.
+        print(
+            "error: --report-unused-pragmas is a standalone mode "
+            "(always exit 0) — run it as its own step, not with "
+            "--json/--sarif", file=sys.stderr,
         )
+        return 2
+    paths = args.paths or None
+    root = Path(args.root)
+    try:
+        if args.changed_only is not None:
+            if paths:
+                print(
+                    "error: --changed-only and explicit paths are "
+                    "mutually exclusive", file=sys.stderr,
+                )
+                return 2
+            paths = changed_paths(root.resolve(), args.changed_only)
+            if not paths:
+                if args.sarif:
+                    # The promised SARIF doc must exist (empty) even on
+                    # an empty diff — a CI upload/ingest step fails on a
+                    # missing path, or worse ingests a stale file.
+                    Path(args.sarif).write_text(
+                        render_sarif([]), encoding="utf-8"
+                    )
+                print("llmd-analysis: no changed files; nothing to scan")
+                return 0
+        findings, nfiles, unused = run_analysis_details(root, paths, rules)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -79,6 +135,21 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.sarif:
+        Path(args.sarif).write_text(
+            render_sarif(findings), encoding="utf-8"
+        )
+    if args.report_unused_pragmas:
+        for path, line, rule in unused:
+            print(
+                f"{path}:{line}: unused pragma `allow({rule})` — the "
+                "violation it blessed is gone; delete the pragma"
+            )
+        print(
+            f"llmd-analysis: {nfiles} file(s), "
+            f"{len(unused)} unused pragma(s)"
+        )
+        return 0
     out = (
         render_json(findings, nfiles)
         if args.json

@@ -2,6 +2,7 @@
 
 from llmd_tpu.analysis.checkers import (  # noqa: F401
     clock_discipline,
+    concurrency,
     config_parity,
     envvars,
     faults_discipline,

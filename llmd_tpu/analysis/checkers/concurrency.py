@@ -1,0 +1,663 @@
+"""concurrency: guarded-by, lock order, async/blocking discipline.
+
+The serving stack mixes engine/publisher/staging threads,
+``threading.Lock``-guarded state, and asyncio loops — and the last two
+review passes each caught a real concurrency bug by hand (set-ordering
+nondeterminism breaking byte-identical scoreboards; ``BlockStored``
+emits racing the publisher-thread medium swap outside the sink lock).
+These rules mechanize that review, in the mold of Clang's thread-safety
+(``guarded_by``) analysis; the runtime half lives in
+:mod:`llmd_tpu.analysis.sanitize` (the lock sanitizer, armed by
+``LLMD_LOCKSAN=1``).
+
+Rules
+-----
+
+CC001 **guarded-by** — an attribute whose ``__init__`` assignment
+carries the annotation (same line or the line above)::
+
+    self._buf = []  # llmd: guarded_by(_lock)
+
+may only be read or written while the named guard is held: lexically
+inside ``with self._lock:`` (or a ``with`` on a ``threading.Condition``
+the ``__init__`` built over that same lock), inside ``__init__`` itself,
+inside a method whose name ends in ``_locked`` (the tree's
+called-with-lock-held convention — the *caller* of a ``*_locked``
+helper is checked instead), or inside a method decorated ``@_locked``
+(the tree's acquire-around-the-whole-method decorator, which takes
+``self._lock`` — so the decorator counts as holding ``_lock``).
+
+CC002 **lock-order** — the whole-tree lock-acquisition graph: nesting
+``with`` blocks on two lock-ish objects adds the edge *outer → inner*,
+and a method that calls a sibling method while holding a lock inherits
+the callee's first-level acquisitions (one level of intra-class call
+edges, no transitive closure). Any cycle in the global graph is a
+potential deadlock: two threads walking the cycle from different entry
+points block each other forever. Findings attribute every edge of the
+cycle.
+
+CC003 **no-await-under-lock / no-block-in-async** — inside ``async
+def`` in the event-loop packages (``epp/``, ``serve/``, ``batch/``,
+``fleetsim/``): no ``await`` while a ``threading`` lock is held (the
+loop thread parks on the await with the lock held; every other thread
+— including the one that would let the awaited thing complete — then
+blocks on the lock: instant deadlock potential), no ``time.sleep``
+(blocks the whole loop; use ``asyncio.sleep``), and no bare
+``lock.acquire()`` (a contended acquire blocks the loop; take the lock
+in a ``with`` around straight-line code instead).
+
+CC004 **cross-thread loop calls** — ``loop.call_soon(...)`` /
+``loop.create_task(...)`` / ``asyncio.ensure_future(...)`` from a
+thread-target function (anything passed as ``Thread(target=...)``, or
+a helper such a function calls — one level, same class) corrupts the
+loop's internals: only ``call_soon_threadsafe`` /
+``run_coroutine_threadsafe`` are loop-thread-safe entry points.
+
+Lock-ish heuristic: a ``with`` item (or ``acquire()`` receiver) whose
+final name component matches ``lock|cond|mutex`` (case-insensitive).
+That is what the tree's naming convention already guarantees; an
+object that IS a lock but dodges the name dodges the rules, which is
+the acceptable failure direction (under- not over-flagging).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from llmd_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Repo,
+    _python_comment_lines,
+    register,
+)
+
+# CC003 scope: packages whose async defs run on serving event loops.
+ASYNC_SCOPE_PARTS = frozenset({"epp", "serve", "batch", "fleetsim"})
+
+_LOCKISH_RE = re.compile(r"(lock|cond|mutex)", re.I)
+
+GUARDED_BY_RE = re.compile(r"#\s*llmd:\s*guarded_by\(\s*([A-Za-z_][\w]*)\s*\)")
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+_UNSAFE_LOOP_CALLS = frozenset({"call_soon", "create_task", "ensure_future"})
+
+
+def _lockish_name(expr: ast.expr) -> str | None:
+    """``self._lock`` -> ``_lock``; ``_lock`` -> ``_lock``; else None.
+    Only lock-ish final components qualify."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    return name if _LOCKISH_RE.search(name) else None
+
+
+def _self_attr(expr: ast.expr) -> str | None:
+    """``self.X`` -> ``X`` (else None)."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+# ------------------------------------------------------------------ #
+# per-class model
+
+
+class _ClassInfo:
+    def __init__(
+        self, sf, node: ast.ClassDef,
+        comments: dict[int, str] | None = None,
+    ) -> None:
+        self.sf = sf
+        self.node = node
+        # line -> comment token (tokenize): grammar quoted inside a
+        # string literal must not mint a phantom guarded attribute.
+        # None = file didn't tokenize; raw-line regex fallback.
+        self.comments = comments
+        self.name = node.name
+        # guarded attr -> (guard attr, annotation line)
+        self.guarded: dict[str, tuple[str, int]] = {}
+        # condition attr -> underlying lock attr (Condition(self._lock))
+        self.cond_alias: dict[str, str] = {}
+        self.methods: dict[str, ast.AST] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        init = self.methods.get("__init__")
+        if init is not None:
+            self._scan_init(init)
+        # *_locked method -> guards its body needs (from the guarded
+        # attrs it touches): the CALLER must hold these at the call.
+        self.locked_needs: dict[str, set[str]] = {}
+        for name, fn in self.methods.items():
+            if not name.endswith("_locked"):
+                continue
+            needs: set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Attribute):
+                    attr = _self_attr(sub)
+                    if attr in self.guarded:
+                        needs.add(self.guarded[attr][0])
+            if needs:
+                self.locked_needs[name] = needs
+
+    def _scan_init(self, init: ast.AST) -> None:
+        for stmt in ast.walk(init):
+            if isinstance(stmt, ast.Assign):
+                tnodes = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                tnodes = [stmt.target]
+            else:
+                continue
+            targets = [
+                a for t in tnodes if (a := _self_attr(t)) is not None
+            ]
+            if not targets:
+                continue
+            # Condition alias: self._cond = threading.Condition(self._lock)
+            v = stmt.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, (ast.Attribute, ast.Name))
+                and (
+                    v.func.attr
+                    if isinstance(v.func, ast.Attribute)
+                    else v.func.id
+                )
+                == "Condition"
+                and v.args
+            ):
+                inner = _self_attr(v.args[0])
+                if inner is not None:
+                    for t in targets:
+                        self.cond_alias[t] = inner
+            for line in (stmt.lineno, stmt.lineno - 1):
+                raw = (
+                    self.sf.lines[line - 1]
+                    if 0 < line <= len(self.sf.lines)
+                    else ""
+                )
+                if line != stmt.lineno and not raw.lstrip().startswith("#"):
+                    # The line above only annotates as a standalone
+                    # comment — a trailing annotation up there belongs
+                    # to THAT line's assignment, not this one.
+                    continue
+                hay = (
+                    self.comments.get(line, "")
+                    if self.comments is not None
+                    else raw
+                )
+                m = GUARDED_BY_RE.search(hay)
+                if m:
+                    for t in targets:
+                        self.guarded[t] = (m.group(1), stmt.lineno)
+                    break
+
+    def guards_satisfying(self, guard: str) -> set[str]:
+        """Holding any of these attrs counts as holding ``guard``."""
+        out = {guard}
+        for cond, lock in self.cond_alias.items():
+            if lock == guard:
+                out.add(cond)
+        return out
+
+
+def _classes(sf) -> list[_ClassInfo]:
+    if sf.tree is None:
+        return []
+    comments = _python_comment_lines(sf.text)
+    return [
+        _ClassInfo(sf, n, comments)
+        for n in ast.walk(sf.tree)
+        if isinstance(n, ast.ClassDef)
+    ]
+
+
+# ------------------------------------------------------------------ #
+# CC001 guarded-by
+
+
+class _GuardedVisitor(ast.NodeVisitor):
+    """Walk one method tracking the lexically-held guard set."""
+
+    def __init__(self, checker, ci: _ClassInfo, method: ast.AST) -> None:
+        self.checker = checker
+        self.ci = ci
+        self.method = method
+        self.held: list[str] = []  # stack of held self-attr names
+        # @_locked decorator: the whole body runs under self._lock.
+        for dec in getattr(method, "decorator_list", ()):
+            name = (
+                dec.id if isinstance(dec, ast.Name)
+                else dec.attr if isinstance(dec, ast.Attribute) else ""
+            )
+            if name == "_locked":
+                self.held.append("_lock")
+
+    def run(self) -> None:
+        for stmt in self.method.body:  # type: ignore[attr-defined]
+            self.visit(stmt)
+
+    def _visit_with(self, node) -> None:
+        pushed = 0
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and _LOCKISH_RE.search(attr):
+                self.held.append(attr)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - pushed : len(self.held)]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr in self.ci.guarded:
+            guard, _ = self.ci.guarded[attr]
+            ok = set(self.held) & self.ci.guards_satisfying(guard)
+            if not ok:
+                self.checker._finding(
+                    self.ci.sf, "CC001", node.lineno,
+                    f"{self.ci.name}.{attr} is annotated "
+                    f"guarded_by({guard}) but accessed in "
+                    f"{self.method.name} without holding self.{guard} "
+                    "(wrap in `with self." + guard + ":`, rename the "
+                    "method `*_locked` if callers hold it, or pragma "
+                    "`# llmd: allow(concurrency) -- <reason>`)",
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # Calling a *_locked sibling transfers the obligation here: the
+        # helper's body is exempt because ITS caller holds the guard.
+        callee = _self_attr(node.func)
+        needs = self.ci.locked_needs.get(callee or "")
+        if needs:
+            held: set[str] = set()
+            for g in self.held:
+                held |= {
+                    guard
+                    for guard in needs
+                    if g in self.ci.guards_satisfying(guard)
+                }
+            missing = needs - held
+            if missing:
+                self.checker._finding(
+                    self.ci.sf, "CC001", node.lineno,
+                    f"call to {self.ci.name}.{callee} from "
+                    f"{self.method.name} without holding "
+                    f"{sorted('self.' + m for m in missing)} — *_locked "
+                    "helpers run with their caller's lock held by "
+                    "contract",
+                )
+        self.generic_visit(node)
+
+
+# ------------------------------------------------------------------ #
+# CC002 lock-order graph
+
+
+class _AcqVisitor(ast.NodeVisitor):
+    """Collect (outer-held stack, acquired lock, call sites) per method."""
+
+    def __init__(self) -> None:
+        self.held: list[str] = []
+        # edges within this method: (outer, inner, line)
+        self.edges: list[tuple[str, str, int]] = []
+        # locks acquired at top level (no outer held): [(lock, line)]
+        self.first_acquitions: list[tuple[str, int]] = []
+        # sibling calls: (held-at-call-site tuple, callee name, line)
+        self.calls: list[tuple[tuple[str, ...], str, int]] = []
+
+    def _visit_with(self, node) -> None:
+        pushed = 0
+        for item in node.items:
+            name = _lockish_name(item.context_expr)
+            if name is not None:
+                if self.held:
+                    self.edges.append((self.held[-1], name, node.lineno))
+                else:
+                    self.first_acquitions.append((name, node.lineno))
+                self.held.append(name)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - pushed : len(self.held)]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = _self_attr(node.func)
+        if callee is not None:
+            self.calls.append((tuple(self.held), callee, node.lineno))
+        self.generic_visit(node)
+
+
+def _lock_order_edges(ci: _ClassInfo) -> list[tuple[str, str, int, str]]:
+    """(outer, inner, line, method) edges for one class: nested withs
+    plus one level of intra-class call edges."""
+    per_method: dict[str, _AcqVisitor] = {}
+    for name, fn in ci.methods.items():
+        v = _AcqVisitor()
+        for dec in getattr(fn, "decorator_list", ()):
+            dname = (
+                dec.id if isinstance(dec, ast.Name)
+                else dec.attr if isinstance(dec, ast.Attribute) else ""
+            )
+            if dname == "_locked":
+                # @_locked acquires self._lock around the whole body.
+                v.first_acquitions.append(("_lock", fn.lineno))
+                v.held.append("_lock")
+        for stmt in fn.body:  # type: ignore[attr-defined]
+            v.visit(stmt)
+        v.held.clear()
+        per_method[name] = v
+    edges: list[tuple[str, str, int, str]] = []
+    for name, v in per_method.items():
+        for outer, inner, line in v.edges:
+            edges.append((outer, inner, line, name))
+        # One level of call edges: while holding L, calling a sibling
+        # that first-acquires M adds L -> M.
+        for held, callee, line in v.calls:
+            if not held:
+                continue
+            cv = per_method.get(callee)
+            if cv is None:
+                continue
+            for inner, _ in cv.first_acquitions:
+                edges.append((held[-1], inner, line, name))
+    return edges
+
+
+def _find_cycles(
+    graph: dict[str, set[str]],
+) -> list[list[str]]:
+    """Simple DFS cycle enumeration; each cycle reported once, rotated
+    to start at its smallest node (the graph is tiny — lock attrs)."""
+    cycles: set[tuple[str, ...]] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    i = path.index(min(path))
+                    cycles.add(tuple(path[i:] + path[:i]))
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+    return [list(c) for c in sorted(cycles)]
+
+
+# ------------------------------------------------------------------ #
+# CC003 async blocking
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walk one async def body (not nested defs)."""
+
+    def __init__(self, checker, sf, fn) -> None:
+        self.checker = checker
+        self.sf = sf
+        self.fn = fn
+        self.held: list[str] = []  # sync-with lock-ish stack
+
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self.visit(stmt)
+
+    # Nested defs run elsewhere (executor threads, callbacks): their
+    # bodies are not this event-loop coroutine's straight line.
+    def visit_FunctionDef(self, node) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        pass
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            if _lockish_name(item.context_expr) is not None:
+                self.held.append(_lockish_name(item.context_expr))
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - pushed : len(self.held)]
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self.held:
+            self.checker._finding(
+                self.sf, "CC003", node.lineno,
+                f"await while holding threading lock `{self.held[-1]}` "
+                f"in async {self.fn.name}: the loop thread parks on the "
+                "await with the lock held and every other thread blocks "
+                "behind it — restructure so the lock covers only "
+                "straight-line code",
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "sleep"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "time"
+        ):
+            self.checker._finding(
+                self.sf, "CC003", node.lineno,
+                f"time.sleep in async {self.fn.name} blocks the whole "
+                "event loop: use `await asyncio.sleep(...)`",
+            )
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "acquire"
+            and _lockish_name(f.value) is not None
+        ):
+            self.checker._finding(
+                self.sf, "CC003", node.lineno,
+                f"bare `{_lockish_name(f.value)}.acquire()` in async "
+                f"{self.fn.name} can block the event loop on contention: "
+                "hold the lock in a `with` around straight-line code",
+            )
+        self.generic_visit(node)
+
+    def generic_visit(self, node) -> None:
+        # Awaited lock-ish acquires (asyncio primitives) are fine; the
+        # Await visitor above sees them first only when a threading lock
+        # is already held, which is the actual hazard.
+        super().generic_visit(node)
+
+
+# ------------------------------------------------------------------ #
+# CC004 cross-thread loop calls
+
+
+def _thread_target_names(tree: ast.AST) -> set[str]:
+    """Function/method names passed as Thread(target=...) anywhere in
+    the module (matched by name: `self._run`, `run`, `module_fn`)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = (
+            node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        )
+        if fname != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            t = kw.value
+            if isinstance(t, ast.Attribute):
+                out.add(t.attr)
+            elif isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+class _LoopCallVisitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.unsafe: list[tuple[str, int]] = []  # (call name, line)
+        self.sibling_calls: set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _UNSAFE_LOOP_CALLS:
+                # loop.create_task / loop.call_soon / asyncio.ensure_future;
+                # exclude x.call_soon_threadsafe (different attr already).
+                recv = f.value
+                recv_name = (
+                    recv.attr if isinstance(recv, ast.Attribute)
+                    else recv.id if isinstance(recv, ast.Name) else ""
+                )
+                # tg.create_task (TaskGroup) only exists inside async
+                # defs, which are not thread targets; loop-ish or
+                # asyncio receivers are the hazard.
+                if f.attr == "ensure_future" or "loop" in recv_name.lower() \
+                        or recv_name == "asyncio":
+                    self.unsafe.append((f"{recv_name}.{f.attr}", node.lineno))
+            sib = _self_attr(f)
+            if sib is not None:
+                self.sibling_calls.add(sib)
+        elif isinstance(f, ast.Name) and f.id in _UNSAFE_LOOP_CALLS:
+            self.unsafe.append((f.id, node.lineno))
+        self.generic_visit(node)
+
+
+# ------------------------------------------------------------------ #
+
+
+@register
+class ConcurrencyChecker(Checker):
+    name = "concurrency"
+    description = (
+        "guarded_by annotations hold (CC001), the whole-tree lock-order "
+        "graph is acyclic (CC002), async defs in epp//serve//batch//"
+        "fleetsim/ never block or await under a threading lock (CC003), "
+        "and thread-target functions touch event loops only through "
+        "*_threadsafe entry points (CC004)"
+    )
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+
+    def _finding(self, sf, code: str, line: int, msg: str) -> None:
+        self.findings.append(Finding(self.name, code, sf.path, line, msg))
+
+    def run(self, repo: Repo) -> list[Finding]:
+        self.findings = []
+        # node -> {inner}, plus attribution: (outer, inner) -> (sf, line)
+        graph: dict[str, set[str]] = {}
+        edge_site: dict[tuple[str, str], tuple] = {}
+        for sf in repo.files:
+            if not sf.is_python or sf.tree is None:
+                continue
+            parts = set(Path(sf.path).parts)
+            classes = _classes(sf)
+            # CC001
+            for ci in classes:
+                if not ci.guarded:
+                    continue
+                for mname, fn in ci.methods.items():
+                    if mname == "__init__" or mname.endswith("_locked"):
+                        continue
+                    _GuardedVisitor(self, ci, fn).run()
+            # CC002: accumulate the whole-tree graph. Node identity is
+            # (module-qualified class, lock attr): a cycle is only a
+            # deadlock when the SAME locks are reachable in both orders.
+            for ci in classes:
+                mod = sf.path
+                for outer, inner, line, method in _lock_order_edges(ci):
+                    a = f"{mod}::{ci.name}.{outer}"
+                    b = f"{mod}::{ci.name}.{inner}"
+                    if a == b:
+                        continue  # RLock re-entry, not an order edge
+                    graph.setdefault(a, set()).add(b)
+                    edge_site.setdefault((a, b), (sf, line, method))
+            # CC003
+            if parts & ASYNC_SCOPE_PARTS:
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, ast.AsyncFunctionDef):
+                        _AsyncBodyVisitor(self, sf, node).run()
+            # CC004
+            targets = _thread_target_names(sf.tree)
+            if targets:
+                self._check_loop_calls(sf, targets)
+        # CC002 cycle detection over the accumulated graph.
+        for cycle in _find_cycles(graph):
+            pretty = " -> ".join(
+                n.split("::", 1)[1] for n in cycle + [cycle[0]]
+            )
+            for i, node in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                sf, line, method = edge_site[(node, nxt)]
+                self._finding(
+                    sf, "CC002", line,
+                    f"lock-order cycle (potential deadlock): {pretty} — "
+                    f"this edge acquired in {method}; pick one global "
+                    "order (or drop one lock) so every thread nests "
+                    "these locks the same way",
+                )
+        self.findings.sort(key=lambda f: (f.path, f.line, f.code))
+        return self.findings
+
+    def _check_loop_calls(self, sf, targets: set[str]) -> None:
+        """CC004 over one module: thread-target functions (plus the
+        same-class helpers they call, one level) must not touch a loop
+        except through *_threadsafe."""
+        # name -> list of function nodes (methods may repeat names
+        # across classes; check per class to keep call edges honest).
+        scopes: list[dict[str, ast.AST]] = []
+        module_fns: dict[str, ast.AST] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                module_fns[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                scopes.append({
+                    n.name: n
+                    for n in node.body
+                    if isinstance(n, ast.FunctionDef)
+                })
+        scopes.append(module_fns)
+        for fns in scopes:
+            hit = targets & set(fns)
+            if not hit:
+                continue
+            checked: set[str] = set()
+            frontier = set(hit)
+            depth = 0
+            while frontier and depth <= 1:
+                next_frontier: set[str] = set()
+                for name in sorted(frontier):
+                    if name in checked or name not in fns:
+                        continue
+                    checked.add(name)
+                    v = _LoopCallVisitor()
+                    v.visit(fns[name])
+                    for call, line in v.unsafe:
+                        self._finding(
+                            sf, "CC004", line,
+                            f"`{call}` reached from thread-target "
+                            f"function {name}: event loops are not "
+                            "thread-safe — use call_soon_threadsafe / "
+                            "run_coroutine_threadsafe from other threads",
+                        )
+                    next_frontier |= v.sibling_calls
+                frontier = next_frontier - checked
+                depth += 1

@@ -33,8 +33,15 @@ from pathlib import Path
 from llmd_tpu.analysis.core import Checker, Finding, Repo, register
 
 # Package directories on the serving path (matched against path parts,
-# so fixtures under tmp trees participate the same way).
-SCOPE_PARTS = frozenset({"serve", "engine", "kvtransfer", "epp", "kvstore"})
+# so fixtures under tmp trees participate the same way). federation/
+# and events/ joined with the concurrency rules: their publisher/
+# subscriber threads are exactly where a swallowed failure goes
+# permanently dark (the unused-pragma report caught federation/ pragmas
+# blessing a rule that never ran there).
+SCOPE_PARTS = frozenset({
+    "serve", "engine", "kvtransfer", "epp", "kvstore", "federation",
+    "events",
+})
 
 _BROAD_NAMES = {"Exception", "BaseException"}
 

@@ -1,7 +1,7 @@
 """lockstep: every multi-host opcode has a follower dispatch arm.
 
 Multi-host engines run leader + followers in lockstep: the leader
-broadcasts a ``(op, B, QK, greedy)`` header (``ModelRunner._sync``) and
+broadcasts a ``(op, B, QK, greedy)`` header (``ModelRunner._sync_locked``) and
 every follower mirrors the dispatch in ``follower_loop``. An opcode
 added without a follower arm makes every follower dispatch the WRONG
 program (or none), desynchronizing the SPMD collective stream — the
@@ -17,10 +17,10 @@ a ``follower_loop`` function. Rules:
   that raises — an unknown opcode would silently fall through (or run
   whatever the final branch does).
 - LS003: an ``_OP_*`` opcode (other than ``_OP_STOP``, which rides a
-  raw header broadcast in ``stop_followers``) that no ``_sync`` call
+  raw header broadcast in ``stop_followers``) that no ``_sync_locked`` call
   site ever broadcasts — dead opcode, or a dispatch path bypassing the
   broadcast.
-- LS004: a ``_sync`` call whose op argument is not a named ``_OP_*``
+- LS004: a ``_sync_locked`` call whose op argument is not a named ``_OP_*``
   constant (magic-number dispatch defeats this checker).
 - LS005: a jitted step callable (an attribute ``__init__`` assigns from
   a ``_build_*`` factory) invoked outside an ``_exec_*`` method — the
@@ -112,7 +112,7 @@ def _sync_op_args(tree: ast.Module) -> list[tuple[ast.expr, int]]:
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "_sync"
+            and node.func.attr in ("_sync", "_sync_locked")
             and node.args
         ):
             out.append((node.args[0], node.lineno))
@@ -166,7 +166,7 @@ class LockstepChecker(Checker):
     name = "lockstep"
     description = (
         "every _OP_* opcode has a follower dispatch arm, is broadcast "
-        "via _sync, and the jitted steps stay behind _exec_*"
+        "via _sync_locked, and the jitted steps stay behind _exec_*"
     )
 
     def run(self, repo: Repo) -> list[Finding]:
@@ -207,7 +207,7 @@ class LockstepChecker(Checker):
             else:
                 findings.append(Finding(
                     "lockstep", "LS004", sf.path, line,
-                    "_sync op argument must be a named _OP_* constant "
+                    "_sync_locked op argument must be a named _OP_* constant "
                     "(magic-number dispatch defeats exhaustiveness "
                     "checking)",
                 ))
@@ -216,7 +216,7 @@ class LockstepChecker(Checker):
                 continue
             findings.append(Finding(
                 "lockstep", "LS003", sf.path, line,
-                f"opcode {name} is never broadcast via _sync — dead "
+                f"opcode {name} is never broadcast via _sync_locked — dead "
                 "opcode, or a leader path dispatching it without the "
                 "lockstep broadcast",
             ))

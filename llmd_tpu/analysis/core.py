@@ -28,9 +28,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import re
 import subprocess
+import tokenize
 from pathlib import Path
 
 PRAGMA_RE = re.compile(
@@ -40,6 +42,21 @@ PRAGMA_RE = re.compile(
 # Directories whose Python modules sit on the per-step serving hot path:
 # the host-sync and trace-discipline rules apply only here.
 HOT_PATH_PARTS = frozenset({"engine", "ops", "parallel"})
+
+
+def _python_comment_lines(text: str) -> dict[int, str] | None:
+    """line -> comment token text, via tokenize — so a pragma quoted in
+    a string literal is not a pragma. None when the file doesn't
+    tokenize (broken syntax; the per-line regex fallback applies, and
+    compileall owns reporting the breakage)."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,11 +99,21 @@ class SourceFile:
         self.pragma_decls: list[tuple[int, set[str]]] = []
         self.bad_pragmas: list[tuple[int, str]] = []  # (line, defect)
         # Pragmas only mean something where `#` starts a comment; docs
-        # quoting pragma examples must not trip the hygiene rules.
+        # quoting pragma examples must not trip the hygiene rules, and
+        # neither must pragma grammar quoted inside Python STRING
+        # literals (checker messages teach the grammar) — for .py files
+        # only real COMMENT tokens count.
         suppressible = self.path.endswith((".py", ".sh"))
+        comment_lines = (
+            _python_comment_lines(self.text)
+            if suppressible and self.is_python
+            else None
+        )
         for i, line in enumerate(self.lines, 1):
             if not suppressible:
                 break
+            if comment_lines is not None:
+                line = comment_lines.get(i, "")
             m = PRAGMA_RE.search(line)
             if not m:
                 continue
@@ -242,6 +269,21 @@ def run_analysis(
     Returns (surviving findings, files scanned). Pragma suppression and
     pragma hygiene are applied here so every checker gets them for free.
     """
+    findings, nfiles, _ = run_analysis_details(root, paths, rules)
+    return findings, nfiles
+
+
+def run_analysis_details(
+    root: Path,
+    paths: list[str] | None = None,
+    rules: list[str] | None = None,
+) -> tuple[list[Finding], int, list[tuple[str, int, str]]]:
+    """:func:`run_analysis` plus the unused-pragma ledger: every
+    ``# llmd: allow(...)`` declaration among whose named rules at least
+    one RAN this pass yet suppressed no finding, as
+    ``(path, line, rule)`` triples — the ``--report-unused-pragmas``
+    hygiene surface (a pragma that no longer suppresses anything is a
+    stale claim about the code next to it)."""
     # Import for side effect: checker registration.
     from llmd_tpu.analysis import checkers  # noqa: F401
 
@@ -250,15 +292,34 @@ def run_analysis(
     unknown = [r for r in selected if r not in CHECKERS and r != "pragma"]
     if unknown:
         raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+    ran = {r for r in selected if r != "pragma"}
     findings: list[Finding] = []
     for name in selected:
         if name != "pragma":
             findings.extend(CHECKERS[name]().run(repo))
     by_path = {f.path: f for f in repo.files}
-    kept = [
-        f for f in findings
-        if f.path not in by_path or not by_path[f.path].allows(f.rule, f.line)
-    ]
+    kept: list[Finding] = []
+    # path -> {(pragma line, rule)} that suppressed at least one finding.
+    used_by_path: dict[str, set[tuple[int, str]]] = {}
+    for f in findings:
+        sf = by_path.get(f.path)
+        if sf is None or not sf.allows(f.rule, f.line):
+            kept.append(f)
+        else:
+            # A finding at line L is blessed by a pragma at L or L-1.
+            hits = used_by_path.setdefault(f.path, set())
+            for pline in (f.line, f.line - 1):
+                if f.rule in sf.pragmas.get(pline, ()) and any(
+                    dl == pline for dl, _ in sf.pragma_decls
+                ):
+                    hits.add((pline, f.rule))
+    unused: list[tuple[str, int, str]] = []
+    for sf in repo.files:
+        hits = used_by_path.get(sf.path, set())
+        for line, names in sf.pragma_decls:
+            for r in sorted(names & ran):
+                if (line, r) not in hits:
+                    unused.append((sf.path, line, r))
     if "pragma" in selected:
         known = rule_names()
         for sf in repo.files:
@@ -272,7 +333,64 @@ def run_analysis(
                         f"(known: {', '.join(sorted(known))})",
                     ))
     kept.sort(key=lambda f: (f.path, f.line, f.code))
-    return kept, len(repo.files)
+    unused.sort()
+    return kept, len(repo.files), unused
+
+
+def changed_paths(root: Path, base: str = "HEAD") -> list[str]:
+    """The ``--changed-only`` scan set: paths touched vs ``base`` (plus
+    staged and untracked files), so CI can annotate just a PR's diff.
+    Missing git / not a repo raises ValueError — silently scanning
+    nothing would hand CI a hollow green exit."""
+    out: list[str] = []
+    try:
+        # git prints paths relative to the repo TOPLEVEL regardless of
+        # cwd; resolve against it, or a --root pointing at a
+        # subdirectory would silently drop every changed file and hand
+        # CI exactly the hollow green exit this function guards against.
+        tl = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, cwd=root,
+        )
+        if tl.returncode != 0:
+            raise ValueError(
+                "--changed-only: `git rev-parse --show-toplevel` "
+                "failed: " + tl.stderr.strip()
+            )
+        toplevel = Path(tl.stdout.strip())
+        for args in (
+            ["git", "diff", "--name-only", base],
+            ["git", "diff", "--name-only", "--cached"],
+            # --full-name: ls-files prints cwd-relative paths (unlike
+            # diff's toplevel-relative), which would mis-root untracked
+            # files when --root is a repo subdirectory.
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--full-name"],
+        ):
+            r = subprocess.run(
+                args, capture_output=True, text=True, cwd=root,
+            )
+            if r.returncode != 0:
+                raise ValueError(
+                    f"--changed-only: `{' '.join(args)}` failed: "
+                    + r.stderr.strip()
+                )
+            out.extend(p for p in r.stdout.splitlines() if p)
+    except OSError as e:
+        raise ValueError(f"--changed-only needs git: {e}") from e
+    root = root.resolve()
+    seen: set[Path] = set()
+    kept: list[str] = []
+    for p in out:
+        full = (toplevel / p).resolve()
+        if not full.is_file() or full in seen:
+            continue
+        seen.add(full)
+        try:
+            kept.append(str(full.relative_to(root)))
+        except ValueError:
+            continue  # changed, but outside --root: not in scope
+    return kept
 
 
 def render_human(findings: list[Finding], nfiles: int) -> str:
@@ -288,3 +406,73 @@ def render_json(findings: list[Finding], nfiles: int) -> str:
         {"files": nfiles, "findings": [f.to_dict() for f in findings]},
         indent=2,
     )
+
+
+_SARIF_HELP_URI = (
+    "https://github.com/llm-d/llmd-tpu/blob/main/docs/architecture/"
+    "static-analysis.md"
+)
+
+
+def render_sarif(findings: list[Finding]) -> str:
+    """SARIF 2.1.0 for PR annotation: one run, one rule per stable
+    per-finding code (``HS001``/``CC002``/...), file+line locations.
+    The rule metadata carries the checker name (the pragma key) so an
+    annotation tells the reader how to suppress as well as what broke."""
+    # Import for side effect: checker registration (descriptions).
+    from llmd_tpu.analysis import checkers  # noqa: F401
+
+    rules: dict[str, dict] = {}
+    results: list[dict] = []
+    for f in findings:
+        if f.code not in rules:
+            desc = (
+                CHECKERS[f.rule].description
+                if f.rule in CHECKERS
+                else "pragma hygiene (reason required, rule must exist)"
+            )
+            rules[f.code] = {
+                "id": f.code,
+                "name": f.rule,
+                "shortDescription": {"text": f"{f.rule}: {desc}"},
+                "helpUri": _SARIF_HELP_URI,
+                "properties": {"pragma": f"# llmd: allow({f.rule}) -- "},
+            }
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f"[{f.rule}/{f.code}] {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        })
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "llmd-analysis",
+                    "informationUri": _SARIF_HELP_URI,
+                    "rules": [rules[k] for k in sorted(rules)],
+                },
+            },
+            # No "uri": the SARIF 2.1.0 unknown-base convention — the
+            # consumer supplies the checkout root. A concrete file:///
+            # here would make spec-conforming tools resolve every
+            # location against the filesystem root.
+            "originalUriBaseIds": {
+                "SRCROOT": {
+                    "description": {"text": "repository root"},
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,479 @@
+"""Runtime lock sanitizer: the dynamic leg of the concurrency rules.
+
+The static checker (:mod:`llmd_tpu.analysis.checkers.concurrency`) sees
+lexical ``with`` nesting and one level of call edges; this module sees
+what actually happened at runtime — in the mold of ThreadSanitizer's
+dynamic lock-order (deadlock) detection. Armed (``LLMD_LOCKSAN=1``; the
+``tests/conftest.py`` fixture arms it for the whole session), it
+replaces ``threading.Lock`` / ``threading.RLock`` with instrumented
+wrappers that
+
+- record each acquisition's thread and stack (bounded) and maintain a
+  per-thread held-lock stack;
+- maintain the GLOBAL lock-order graph (edges held → acquired across
+  all threads, per lock *instance*) and flag the first cycle — two
+  threads that ever nest the same two locks in opposite orders can
+  deadlock on the right interleaving, whether or not this run hit it;
+- flag a sanitized lock still held when an asyncio callback returns
+  control to the event loop (``Handle._run`` wrap): a lock held across
+  an ``await`` serializes the loop against every thread contending for
+  that lock — the runtime twin of rule CC003.
+
+Violations are recorded (``drain_violations``) and — for lock-order
+cycles, detected synchronously in the acquiring thread — raised as
+:class:`LockOrderError` so the test fails at the acquisition site. The
+conftest fixture additionally fails any test on whose watch a violation
+was recorded (background threads and swallowed exceptions included) and
+renders the report (nodes, edges, violations, peak held depth) to
+``LLMD_LOCKSAN_REPORT`` at session teardown.
+
+Locks created BEFORE arming (import-time module locks) are not
+instrumented; the serving stack's locks are created in ``__init__``
+methods during tests, which is the coverage that matters. Stdlib locks
+created while armed (``queue.Queue``, executors) participate too —
+they are real locks in the same graph.
+"""
+
+from __future__ import annotations
+
+import _thread
+import itertools
+import json
+import os
+import threading
+import traceback
+
+__all__ = [
+    "LockOrderError",
+    "HeldAcrossAwaitError",
+    "arm",
+    "disarm",
+    "armed",
+    "drain_violations",
+    "violations",
+    "report",
+    "write_report",
+]
+
+_STACK_DEPTH = 12
+
+
+class LockOrderError(AssertionError):
+    """A lock acquisition closed a cycle in the global lock-order graph."""
+
+
+class HeldAcrossAwaitError(AssertionError):
+    """A sanitized lock was still held when an asyncio callback yielded
+    control back to the event loop."""
+
+
+def _own_frame(f) -> bool:
+    return "sanitize" in f.filename and "analysis" in f.filename
+
+
+def _site() -> str:
+    """Creation/acquisition site: innermost non-sanitizer frame."""
+    for f in reversed(traceback.extract_stack()):
+        if not _own_frame(f):
+            return f"{f.filename}:{f.lineno}"
+    return "<unknown>"
+
+
+def _stack() -> list[str]:
+    frames = [f for f in traceback.extract_stack() if not _own_frame(f)]
+    return [
+        f"{f.filename}:{f.lineno} in {f.name}"
+        for f in frames[-_STACK_DEPTH:]
+    ]
+
+
+class _State:
+    """Global sanitizer state. Internal synchronization uses a RAW
+    ``_thread`` lock — the sanitizer must never instrument itself."""
+
+    def __init__(self) -> None:
+        self.mu = _thread.allocate_lock()
+        self.tls = threading.local()
+        # lock token -> creation site (node names for the report).
+        # Tokens are monotonic per-instance ids (never reused), NOT
+        # id(): a freed lock's address can be recycled for a new lock,
+        # and an id-keyed graph would alias the new lock onto the dead
+        # lock's edges — a spurious, nondeterministic cycle report.
+        self.names: dict[int, str] = {}
+        # lock-order graph over lock tokens: a -> {b}
+        self.graph: dict[int, set[int]] = {}
+        # (a, b) -> (thread name, stack) of the first time we saw it
+        self.edge_sites: dict[tuple[int, int], tuple[str, list[str]]] = {}
+        # Pending (drained per-test by the conftest gate) vs. the
+        # session-cumulative log the teardown report renders — draining
+        # for per-test blame must not empty the uploaded artifact.
+        self.violations: list[dict] = []
+        self.all_violations: list[dict] = []
+        self.max_held = 0
+        self.locks_created = 0
+        self.acquisitions = 0
+
+    # -- per-thread held stack: list of [lock_token, recursion_count] - #
+
+    def held(self) -> list:
+        h = getattr(self.tls, "held", None)
+        if h is None:
+            h = self.tls.held = []
+        return h
+
+    def held_ids(self) -> list[int]:
+        return [e[0] for e in self.held()]
+
+    # -- events ------------------------------------------------------- #
+
+    def on_create(self, lock, kind: str) -> None:
+        with self.mu:
+            self.locks_created += 1
+            self.names[lock._tok] = f"{kind}@{_site()}"
+
+    def on_acquired(self, lock) -> str | None:
+        """Post-acquire bookkeeping + cycle check. Returns a violation
+        message when this acquisition closed a cycle in the global
+        lock-order graph (the wrapper releases and raises — raising
+        with the lock still held would wedge ``with`` callers)."""
+        held = self.held()
+        lid = lock._tok
+        for e in held:
+            if e[0] == lid:  # RLock re-entry: no new edges
+                e[1] += 1
+                return None
+        cycle_with = None
+        with self.mu:
+            self.acquisitions += 1
+            held_ids = [e[0] for e in held]
+            # Would held -> lid close a cycle? (lid already reaches a
+            # held lock in the established graph.) Check BEFORE adding:
+            # the graph stays acyclic, so one inversion reports every
+            # time it happens without poisoning the established order.
+            if held_ids:
+                seen: set[int] = set()
+                frontier = list(self.graph.get(lid, ()))
+                while frontier:
+                    n = frontier.pop()
+                    if n in seen:
+                        continue
+                    seen.add(n)
+                    if n in held_ids:
+                        cycle_with = n
+                        break
+                    frontier.extend(self.graph.get(n, ()))
+            if cycle_with is None:
+                for a in held_ids:
+                    self.graph.setdefault(a, set()).add(lid)
+                    self.edge_sites.setdefault(
+                        (a, lid),
+                        (threading.current_thread().name, _stack()),
+                    )
+            else:
+                v = {
+                    "kind": "lock-order-cycle",
+                    "thread": threading.current_thread().name,
+                    "acquired": self.names.get(lid, str(lid)),
+                    "while_holding": [
+                        self.names.get(h, str(h)) for h in held_ids
+                    ],
+                    "reverse_edge_thread": self.edge_sites.get(
+                        (lid, cycle_with), ("?", []),
+                    )[0],
+                    "stack": _stack(),
+                }
+                self.violations.append(v)
+                self.all_violations.append(v)
+        if cycle_with is not None:
+            return (
+                f"lock-order cycle: acquiring {v['acquired']} while "
+                f"holding {v['while_holding']} — the opposite nesting "
+                f"was seen on thread {v['reverse_edge_thread']!r}"
+            )
+        held.append([lid, 1])
+        if len(held) > self.max_held:
+            self.max_held = len(held)
+        return None
+
+    def on_released(self, lock) -> None:
+        held = self.held()
+        lid = lock._tok
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == lid:
+                held[i][1] -= 1
+                if held[i][1] <= 0:
+                    del held[i]
+                return
+
+    def on_loop_boundary(self, before_ids: set[int], what: str) -> None:
+        leaked = [e for e in self.held() if e[0] not in before_ids]
+        if not leaked:
+            return
+        with self.mu:
+            v = {
+                "kind": "held-across-await",
+                "thread": threading.current_thread().name,
+                "locks": [
+                    self.names.get(e[0], str(e[0])) for e in leaked
+                ],
+                "callback": what,
+                "stack": _stack(),
+            }
+            self.violations.append(v)
+            self.all_violations.append(v)
+
+
+_state: _State | None = None
+_orig: dict[str, object] = {}
+# Thread-safe in CPython (C-level next); survives disarm/re-arm cycles
+# so tokens stay unique across _State generations too.
+_tok_counter = itertools.count(1)
+
+
+# ------------------------------------------------------------------ #
+# the instrumented wrapper
+
+
+class SanLock:
+    """Instrumented stand-in for ``threading.Lock`` / ``RLock``.
+
+    Supports the full lock protocol plus the private RLock methods
+    ``threading.Condition`` captures (``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned``), so Conditions built over a
+    sanitized lock keep exact held-set bookkeeping across ``wait()``.
+    """
+
+    __slots__ = ("_inner", "_kind", "_tok")
+
+    def __init__(self, inner, kind: str) -> None:
+        self._inner = inner
+        self._kind = kind
+        # Monotonic, never-reused identity (id() can be recycled after
+        # GC, aliasing a new lock onto a dead lock's graph edges).
+        self._tok = next(_tok_counter)
+        st = _state
+        if st is not None:
+            st.on_create(self, kind)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        st = _state
+        if got and st is not None:
+            msg = st.on_acquired(self)
+            if msg is not None:
+                # Release before raising: a raise out of __enter__ means
+                # __exit__ never runs, and a still-held lock would wedge
+                # every other contender behind the violation.
+                self._inner.release()
+                raise LockOrderError(msg)
+        return got
+
+    # Condition passes blocking positionally or not at all; RLock's
+    # C implementation also accepts keyword form — both covered above.
+
+    def release(self) -> None:
+        st = _state
+        if st is not None:
+            st.on_released(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        if hasattr(self._inner, "locked"):
+            return self._inner.locked()
+        # _thread.RLock grows .locked() only in 3.14: probe instead —
+        # owned by us, or contended by someone, both mean locked.
+        if self._inner._is_owned():
+            return True
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:
+        # stdlib modules (concurrent.futures) reinit module locks in
+        # forked children; delegate so a sanitized lock forks cleanly.
+        self._inner._at_fork_reinit()
+
+    # -- Condition integration (RLock protocol) ------------------------ #
+
+    def _release_save(self):
+        st = _state
+        if st is not None:
+            # Fully releases regardless of recursion depth: drop the
+            # whole held entry, restore on _acquire_restore.
+            held = st.held()
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] == self._tok:
+                    saved_count = held[i][1]
+                    del held[i]
+                    break
+            else:
+                saved_count = 1
+        else:
+            saved_count = 1
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), saved_count)
+        self._inner.release()
+        return (None, saved_count)
+
+    def _acquire_restore(self, saved) -> None:
+        inner_saved, count = saved
+        if inner_saved is not None:
+            self._inner._acquire_restore(inner_saved)
+        else:
+            self._inner.acquire()
+        st = _state
+        if st is not None:
+            msg = st.on_acquired(self)
+            held = st.held()
+            if msg is not None:
+                # Condition re-acquire closed a cycle: record stands
+                # (conftest fails the test), but wait() must return
+                # with the lock held and counted — never raise here.
+                held.append([self._tok, count])
+            elif held and held[-1][0] == self._tok:
+                held[-1][1] = count
+
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # Plain-lock heuristic, mirroring threading.Condition's own.
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        st = _state
+        name = st.names.get(self._tok, "?") if st is not None else "?"
+        return f"<SanLock {self._kind} {name}>"
+
+
+def _san_lock():
+    return SanLock(_orig["Lock"](), "Lock")
+
+
+def _san_rlock():
+    return SanLock(_orig["RLock"](), "RLock")
+
+
+# ------------------------------------------------------------------ #
+# asyncio boundary: a sanitized lock held when a loop callback returns
+# is a lock held across an await (or leaked from a callback) — the
+# event loop thread now owns a threading lock while parked.
+
+
+def _wrap_handle_run(orig_run):
+    def _san_run(handle):
+        st = _state
+        if st is None:
+            return orig_run(handle)
+        before = {e[0] for e in st.held()}
+        try:
+            return orig_run(handle)
+        finally:
+            st.on_loop_boundary(before, repr(handle))
+
+    return _san_run
+
+
+# ------------------------------------------------------------------ #
+# public surface
+
+
+def armed() -> bool:
+    return _state is not None
+
+
+def arm() -> None:
+    """Instrument lock creation + the asyncio callback boundary.
+    Idempotent. Locks created while disarmed stay uninstrumented."""
+    global _state
+    if _state is not None:
+        return
+    import asyncio.events
+
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    _orig["Handle._run"] = asyncio.events.Handle._run
+    _state = _State()
+    threading.Lock = _san_lock
+    threading.RLock = _san_rlock
+    asyncio.events.Handle._run = _wrap_handle_run(
+        asyncio.events.Handle._run
+    )
+
+
+def disarm() -> None:
+    """Restore the originals. Already-created SanLocks keep working
+    (their hooks no-op once ``_state`` is gone)."""
+    global _state
+    if _state is None:
+        return
+    import asyncio.events
+
+    threading.Lock = _orig.pop("Lock")
+    threading.RLock = _orig.pop("RLock")
+    asyncio.events.Handle._run = _orig.pop("Handle._run")
+    _state = None
+
+
+def violations() -> list[dict]:
+    if _state is None:
+        return []
+    with _state.mu:
+        return list(_state.violations)
+
+
+def drain_violations() -> list[dict]:
+    """Return and clear recorded violations (per-test accounting)."""
+    if _state is None:
+        return []
+    with _state.mu:
+        out, _state.violations = _state.violations, []
+        return out
+
+
+def report() -> dict:
+    """The teardown report: nodes, edges (with first-seen thread),
+    violations, and aggregate counters."""
+    if _state is None:
+        return {"armed": False}
+    with _state.mu:
+        names = dict(_state.names)
+        edges = [
+            {
+                "outer": names.get(a, str(a)),
+                "inner": names.get(b, str(b)),
+                "thread": _state.edge_sites.get((a, b), ("?",))[0],
+            }
+            for a, targets in sorted(_state.graph.items())
+            for b in sorted(targets)
+        ]
+        return {
+            "armed": True,
+            "locks_created": _state.locks_created,
+            "acquisitions": _state.acquisitions,
+            "max_held_depth": _state.max_held,
+            "edges": edges,
+            # Session-cumulative: per-test draining (the conftest gate's
+            # blame accounting) must not empty the uploaded artifact.
+            "violations": list(_state.all_violations),
+        }
+
+
+def write_report(path: str | None = None) -> str:
+    path = path or os.environ.get(
+        "LLMD_LOCKSAN_REPORT", "locksan_report.json"
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=2, default=str)
+    return path

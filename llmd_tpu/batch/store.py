@@ -173,12 +173,13 @@ class BatchStore:
     """
 
     def __init__(self, path: str | Path = ":memory:") -> None:
-        self._db = sqlite3.connect(str(path), check_same_thread=False)
+        self._db = sqlite3.connect(str(path), check_same_thread=False)  # llmd: guarded_by(_lock)
         self._db.row_factory = sqlite3.Row
         self._lock = threading.Lock()
         with self._lock, self._db:
             self._db.executescript(_SCHEMA)
         # In-process cancellation fan-out (the Redis pub/sub analogue).
+        # Event-loop-thread owned (asyncio.Events): no lock needed.
         self._cancel_subs: dict[str, list[asyncio.Event]] = {}
 
     # ---- files ----

@@ -24,8 +24,8 @@ class EcStore:
         self.max_entries = max_entries
         self._lock = threading.Lock()
         # digest -> (expiry, dtype, shape, bytes)
-        self._entries: dict[str, tuple[float, str, tuple, bytes]] = {}
-        self.stats = {"puts": 0, "hits": 0, "misses": 0, "expired": 0, "freed": 0}
+        self._entries: dict[str, tuple[float, str, tuple, bytes]] = {}  # llmd: guarded_by(_lock)
+        self.stats = {"puts": 0, "hits": 0, "misses": 0, "expired": 0, "freed": 0}  # llmd: guarded_by(_lock)
 
     @staticmethod
     def digest_of(data: bytes) -> str:

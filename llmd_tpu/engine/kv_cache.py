@@ -112,16 +112,17 @@ class PageAllocator:
         # from the fetch executor thread (runner._dispatch_lock
         # orders the device ops; this orders the host bookkeeping).
         self._lock = threading.RLock()
-        self._meta = [PageMeta() for _ in range(num_pages)]
+        self._meta = [PageMeta() for _ in range(num_pages)]  # llmd: guarded_by(_lock)
         # Pages with ref_count == 0, LRU-ordered: left = oldest = evict first.
         # Freed cached pages are appended right so hot content survives longest.
+        # llmd: guarded_by(_lock)
         self._free: collections.OrderedDict[int, None] = collections.OrderedDict(
             (i, None) for i in range(num_pages)
         )
         # content hash -> page id (only pages whose content is intact).
-        self._cached: dict[bytes, int] = {}
-        self.metrics_hits = 0
-        self.metrics_queries = 0
+        self._cached: dict[bytes, int] = {}  # llmd: guarded_by(_lock)
+        self.metrics_hits = 0  # llmd: guarded_by(_lock)
+        self.metrics_queries = 0  # llmd: guarded_by(_lock)
         # Called on each newly registered full page (tiered offload pump).
         self.commit_hook = None
 
@@ -129,12 +130,14 @@ class PageAllocator:
 
     @property
     def num_free_pages(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     def usage(self) -> float:
-        return 1.0 - len(self._free) / self.num_pages
+        with self._lock:
+            return 1.0 - len(self._free) / self.num_pages
 
-    def _cached_run(self, hashes) -> list[int]:
+    def _cached_run_locked(self, hashes) -> list[int]:
         """Leading cached run for a hash chain, with hit accounting —
         the ONE walk every lookup variant delegates to (caller holds
         the lock)."""
@@ -157,7 +160,7 @@ class PageAllocator:
         """
         if not self.enable_prefix_caching:
             return []
-        return self._cached_run(
+        return self._cached_run_locked(
             page_hashes_for_tokens(token_ids, self.page_size, extra)
         )
 
@@ -182,7 +185,7 @@ class PageAllocator:
         SWA-ring hits) avoid re-hashing the prompt."""
         if not self.enable_prefix_caching:
             return []
-        pages = self._cached_run(hashes)
+        pages = self._cached_run_locked(hashes)
         if pages:
             self.touch(pages)
         return pages
@@ -297,6 +300,7 @@ class PageAllocator:
             meta.content_hash = None
         self.event_sink.all_cleared()
 
+    @_locked
     def hit_ratio(self) -> float:
         if not self.metrics_queries:
             return 0.0

@@ -372,7 +372,7 @@ class ModelRunner:
         # are gone, and any later lockstep broadcast (e.g. from an
         # orphaned streamed-fetch thread) would block forever in a
         # collective nobody answers — refuse loudly instead.
-        self._stopped = False
+        self._stopped = False  # llmd: guarded_by(_dispatch_lock)
         # Lockstep liveness: every collective leg runs under a bounded
         # wait (LLMD_LOCKSTEP_TIMEOUT_S; 0 disables) so a dead peer is a
         # loud RuntimeError within the budget instead of an infinite
@@ -689,7 +689,7 @@ class ModelRunner:
             for k, v in weights.items()
         }
         with self._dispatch_lock:
-            arrays = self._sync(_OP_LORA, mask, lora_id, False, arrays)
+            arrays = self._sync_locked(_OP_LORA, mask, lora_id, False, arrays)
             self._exec_lora(arrays, lora_id)
 
     def _exec_lora(self, arrays: dict, lora_id: int) -> None:
@@ -1475,7 +1475,7 @@ class ModelRunner:
         }
         if self._multihost:
             with self._dispatch_lock:
-                arrays = self._sync(
+                arrays = self._sync_locked(
                     _OP_KV_COPY, len(src_ids), int(swa), False, arrays
                 )
                 self._exec_kv_copy(arrays, swa)
@@ -1521,7 +1521,7 @@ class ModelRunner:
         ring) for KV ops."""
         assert dist.is_leader(), "KV staging ops originate on the leader"
         with self._dispatch_lock:
-            arrays = self._sync(
+            arrays = self._sync_locked(
                 _OP_KV_GATHER, len(ids), int(q8), bool(swa), {"ids": ids}
             )
             return self._exec_kv_gather(arrays, q8, swa)
@@ -1816,6 +1816,7 @@ class ModelRunner:
         try:
             return fut.result(timeout)
         except concurrent.futures.TimeoutError:
+            # llmd: allow(concurrency) -- one-way latch (False->True only): leader legs hold the dispatch lock already; the follower mirror loop is its process's sole lockstep thread
             self._stopped = True  # no further broadcasts into a dead group
             raise RuntimeError(
                 f"lockstep {what} did not complete within {timeout:.0f}s: "
@@ -1829,6 +1830,7 @@ class ModelRunner:
         followers' bounded header wait keeps getting fed."""
         period = max(self.lockstep_timeout_s / 3.0, 1.0)
         while not self._hb_stop.wait(period / 2):
+            # llmd: allow(concurrency) -- double-checked peek: re-read under the dispatch lock below before broadcasting; a stale False only costs one loop turn
             if self._stopped:
                 return
             if not self._lockstep_warmed:
@@ -1839,7 +1841,7 @@ class ModelRunner:
                 with self._dispatch_lock:
                     if self._stopped:
                         return
-                    self._sync(
+                    self._sync_locked(
                         _OP_HEARTBEAT, 0, 0, False,
                         {"hb": np.zeros(1, np.int32)},
                     )
@@ -1847,7 +1849,7 @@ class ModelRunner:
                 log.exception("lockstep heartbeat failed; group is dead")
                 return
 
-    def _sync(self, op: int, B: int, QK: int, greedy: bool, arrays: dict) -> dict:
+    def _sync_locked(self, op: int, B: int, QK: int, greedy: bool, arrays: dict) -> dict:
         """Leader leg: broadcast header + payload; identity single-host."""
         if not self._multihost:
             return arrays
@@ -2312,7 +2314,7 @@ class ModelRunner:
                 np.asarray(pages).astype(self.staging_dtype, copy=False)
             )
             with self._dispatch_lock:
-                arrays = self._sync(
+                arrays = self._sync_locked(
                     _OP_KV_SCATTER, bucket, int(swa), False,
                     {"ids": ids, "vals_u8": vals.view(np.uint8).reshape(-1)},
                 )
@@ -2374,7 +2376,7 @@ class ModelRunner:
             # run lock-free so an embed compile never stalls the step
             # loop (params are read-only, scratch is program-internal).
             with self._dispatch_lock:
-                arrays = self._sync(_OP_EMBED, B, Q, lora_id, arrays)
+                arrays = self._sync_locked(_OP_EMBED, B, Q, lora_id, arrays)
                 pooled = self._exec_embed(arrays, lora_id)
         else:
             pooled = self._exec_embed(arrays, lora_id)
@@ -2549,7 +2551,7 @@ class ModelRunner:
         self.padded_tokens_total += B * Q - live
         all_greedy = all(s.request.sampling.greedy for s in seqs)
         with self._dispatch_lock:
-            arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
+            arrays = self._sync_locked(_OP_PREFILL, B, Q, all_greedy, arrays)
             return self._exec_prefill(arrays, all_greedy)
 
     def run_decode(self, seqs: list[ScheduledSeq], k_steps: int = 1) -> StepResult:
@@ -2622,7 +2624,7 @@ class ModelRunner:
         self.live_tokens_total += n * staged.k
         self.padded_tokens_total += (staged.B - n) * staged.k
         with self._dispatch_lock:
-            arrays = self._sync(
+            arrays = self._sync_locked(
                 _OP_DECODE, staged.B, staged.k, staged.all_greedy,
                 staged.arrays,
             )
@@ -2698,7 +2700,7 @@ class ModelRunner:
         self.live_tokens_total += live
         self.padded_tokens_total += staged.B * staged.q - live
         with self._dispatch_lock:
-            arrays = self._sync(
+            arrays = self._sync_locked(
                 _OP_VERIFY, staged.B, staged.q, staged.all_greedy,
                 staged.arrays,
             )
@@ -2970,14 +2972,14 @@ class ModelRunner:
             self._fill_flat_runs(staged, a)
             self.padded_tokens_total += staged.T - t
             with self._dispatch_lock:
-                arrays = self._sync(
+                arrays = self._sync_locked(
                     _OP_FLAT, staged.B, staged.T, staged.all_greedy, a
                 )
                 packed = self._exec_flat(arrays, staged.all_greedy)
         else:
             self.padded_tokens_total += staged.B * staged.Q - t
             with self._dispatch_lock:
-                arrays = self._sync(
+                arrays = self._sync_locked(
                     _OP_UNIFIED, staged.B, (staged.Q << 20) | staged.T,
                     staged.all_greedy, a,
                 )
@@ -3183,7 +3185,7 @@ class ModelRunner:
             (staged.B - n) * staged.window * staged.q
         )
         with self._dispatch_lock:
-            arrays = self._sync(
+            arrays = self._sync_locked(
                 _OP_VERIFY_WINDOW, staged.B, staged.window,
                 staged.all_greedy, arrays,
             )
@@ -3411,7 +3413,7 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         with self._dispatch_lock:
-            arrays = self._sync(_OP_FLAT, B, T, all_greedy, arrays)
+            arrays = self._sync_locked(_OP_FLAT, B, T, all_greedy, arrays)
             self._exec_flat(arrays, all_greedy)
 
     def _warm_unified(
@@ -3435,7 +3437,7 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         with self._dispatch_lock:
-            arrays = self._sync(
+            arrays = self._sync_locked(
                 _OP_UNIFIED, B, (Q << 20) | T, all_greedy, arrays
             )
             self._exec_unified(arrays, Q, all_greedy)
@@ -3457,7 +3459,7 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         with self._dispatch_lock:
-            arrays = self._sync(_OP_PREFILL, B, Q, all_greedy, arrays)
+            arrays = self._sync_locked(_OP_PREFILL, B, Q, all_greedy, arrays)
             self._exec_prefill(arrays, all_greedy)
 
     def _warm_verify(self, B: int, all_greedy: bool = False) -> None:
@@ -3478,7 +3480,7 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         with self._dispatch_lock:
-            arrays = self._sync(_OP_VERIFY, B, Q, all_greedy, arrays)
+            arrays = self._sync_locked(_OP_VERIFY, B, Q, all_greedy, arrays)
             self._exec_verify(arrays, all_greedy)
 
     def _warm_verify_window(
@@ -3506,7 +3508,7 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         with self._dispatch_lock:
-            arrays = self._sync(_OP_VERIFY_WINDOW, B, window, all_greedy, arrays)
+            arrays = self._sync_locked(_OP_VERIFY_WINDOW, B, window, all_greedy, arrays)
             self._exec_verify_window(arrays, window, all_greedy)
 
     def _warm_decode(self, B: int, K: int, all_greedy: bool = False) -> None:
@@ -3525,5 +3527,5 @@ class ModelRunner:
         if self.cfg.num_lora_adapters:
             arrays["lora"] = np.zeros(B, np.int32)
         with self._dispatch_lock:
-            arrays = self._sync(_OP_DECODE, B, K, all_greedy, arrays)
+            arrays = self._sync_locked(_OP_DECODE, B, K, all_greedy, arrays)
             self._exec_decode(arrays, K, all_greedy)

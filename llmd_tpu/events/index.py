@@ -105,14 +105,14 @@ class KVBlockIndex:
             self.tier_weights.update(tier_weights)
         self._lock = threading.Lock()
         # hash -> {pod -> tier}
-        self._blocks: dict[str, dict[str, str]] = {}
+        self._blocks: dict[str, dict[str, str]] = {}  # llmd: guarded_by(_lock)
         # pod -> LRU of its hashes (right = newest)
-        self._pod_lru: dict[str, collections.OrderedDict] = {}
+        self._pod_lru: dict[str, collections.OrderedDict] = {}  # llmd: guarded_by(_lock)
         # (pod) -> list of (deadline, hashes) speculative entries
-        self._spec: dict[str, dict[str, float]] = {}
-        self.metrics_events = 0
-        self.metrics_lookups = 0
-        self.metrics_hits = 0
+        self._spec: dict[str, dict[str, float]] = {}  # llmd: guarded_by(_lock)
+        self.metrics_events = 0  # llmd: guarded_by(_lock)
+        self.metrics_lookups = 0  # llmd: guarded_by(_lock)
+        self.metrics_hits = 0  # llmd: guarded_by(_lock)
 
     # ------------------------------------------------------------------ #
     # event application (subscriber threads)
@@ -327,11 +327,11 @@ class CostAwareKVBlockIndex(KVBlockIndex):
         super().__init__(*args, **kwargs)
         import array
 
-        self._sketch = [
+        self._sketch = [  # llmd: guarded_by(_lock)
             array.array("B", bytes(1 << self.SKETCH_BITS))
             for _ in range(self.ROWS)
         ]
-        self._ops = 0
+        self._ops = 0  # llmd: guarded_by(_lock)
         # halve all counters every ~16x the per-pod capacity of touches
         self._reset_every = 16 * max(self.max_blocks_per_pod, 1)
 

@@ -55,8 +55,10 @@ class ZMQEventSink(KVEventSink):
         else:
             self._sock.bind(endpoint)
             self.endpoint = endpoint
-        self._seq = 0
-        self._buf: list[dict] = []
+        self._seq = 0  # llmd: guarded_by(_lock)
+        self._buf: list[dict] = []  # llmd: guarded_by(_lock)
+        # batches the PUB socket refused
+        self.publish_failures = 0  # llmd: guarded_by(_lock)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.max_batch = max_batch
@@ -111,6 +113,9 @@ class ZMQEventSink(KVEventSink):
         try:
             self._sock.send_multipart([self.topic, seq, payload], copy=False)
         except Exception as e:  # pragma: no cover - zmq failure is best-effort
+            # Subscribers see the seq gap and resync; the counter is the
+            # publisher-side trail that the gap was OUR send failing.
+            self.publish_failures += 1
             log.warning("kv-event publish failed: %s", e)
 
     def _flush_loop(self, interval: float) -> None:

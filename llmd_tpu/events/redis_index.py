@@ -62,13 +62,13 @@ class RespClient:
         # the threshold open the circuit like an error does.
         self.slow_threshold_s = slow_threshold_s
         self.slow_open_after = slow_open_after
-        self._slow_streak = 0
-        self._down_until = 0.0
-        self._sock: socket.socket | None = None
-        self._buf = b""
+        self._slow_streak = 0  # llmd: guarded_by(_lock)
+        self._down_until = 0.0  # llmd: guarded_by(_lock)
+        self._sock: socket.socket | None = None  # llmd: guarded_by(_lock)
+        self._buf = b""  # llmd: guarded_by(_lock)
         self._lock = threading.Lock()
 
-    def _connect(self) -> socket.socket:
+    def _connect_locked(self) -> socket.socket:
         if self._sock is None:
             self._sock = socket.create_connection(self.addr, self.timeout_s)
             self._sock.settimeout(self.timeout_s)
@@ -94,7 +94,7 @@ class RespClient:
             out.append(b"$%d\r\n%s\r\n" % (len(b), b))
         return b"".join(out)
 
-    def _read_line(self, sock: socket.socket) -> bytes:
+    def _read_line_locked(self, sock: socket.socket) -> bytes:
         while b"\r\n" not in self._buf:
             chunk = sock.recv(65536)
             if not chunk:
@@ -103,7 +103,7 @@ class RespClient:
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
 
-    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
+    def _read_exact_locked(self, sock: socket.socket, n: int) -> bytes:
         while len(self._buf) < n + 2:
             chunk = sock.recv(65536)
             if not chunk:
@@ -112,8 +112,8 @@ class RespClient:
         data, self._buf = self._buf[:n], self._buf[n + 2 :]
         return data
 
-    def _read_reply(self, sock: socket.socket):
-        line = self._read_line(sock)
+    def _read_reply_locked(self, sock: socket.socket):
+        line = self._read_line_locked(sock)
         kind, rest = line[:1], line[1:]
         if kind == b"+":
             return rest.decode()
@@ -123,13 +123,13 @@ class RespClient:
             return int(rest)
         if kind == b"$":
             n = int(rest)
-            return None if n == -1 else self._read_exact(sock, n)
+            return None if n == -1 else self._read_exact_locked(sock, n)
         if kind == b"*":
             n = int(rest)
-            return None if n == -1 else [self._read_reply(sock) for _ in range(n)]
+            return None if n == -1 else [self._read_reply_locked(sock) for _ in range(n)]
         raise RuntimeError(f"unexpected RESP type {line!r}")
 
-    def _read_all(self, sock: socket.socket, n: int) -> list:
+    def _read_all_locked(self, sock: socket.socket, n: int) -> list:
         """Read n replies keeping the stream in sync: an error REPLY
         (-ERR...) consumes its line and is re-raised only after all
         replies are drained; an I/O failure mid-read leaves unread
@@ -140,7 +140,7 @@ class RespClient:
         try:
             for _ in range(n):
                 try:
-                    replies.append(self._read_reply(sock))
+                    replies.append(self._read_reply_locked(sock))
                 except RuntimeError as e:
                     replies.append(None)
                     first_err = first_err or e
@@ -164,14 +164,14 @@ class RespClient:
             payload = b"".join(self._encode(c) for c in commands)
             try:
                 try:
-                    sock = self._connect()
+                    sock = self._connect_locked()
                     sock.sendall(payload)
                 except (OSError, ConnectionError):
                     # one reconnect attempt (server restart, idle timeout)
                     self._close_locked()
-                    sock = self._connect()
+                    sock = self._connect_locked()
                     sock.sendall(payload)
-                replies = self._read_all(sock, len(commands))
+                replies = self._read_all_locked(sock, len(commands))
             except (OSError, ConnectionError):
                 # Circuit-break: the caller runs on the router event loop;
                 # retrying the connect on every scheduling decision would
@@ -225,10 +225,10 @@ class RedisKVBlockIndex:
         if tier_weights:
             self.tier_weights.update(tier_weights)
         self._lock = threading.Lock()
-        self._spec: dict[str, dict[str, float]] = {}
-        self.metrics_events = 0
-        self.metrics_lookups = 0
-        self.metrics_hits = 0
+        self._spec: dict[str, dict[str, float]] = {}  # llmd: guarded_by(_lock)
+        self.metrics_events = 0  # llmd: guarded_by(_lock)
+        self.metrics_lookups = 0  # llmd: guarded_by(_lock)
+        self.metrics_hits = 0  # llmd: guarded_by(_lock)
 
     def _bk(self, h: str) -> str:
         return f"{self.prefix}:kv:{h}"
@@ -239,9 +239,14 @@ class RedisKVBlockIndex:
     # ---------------------------------------------------------- events
 
     def apply(self, pod: str, events: list[dict]) -> None:
+        # The poller thread applies while scheduler threads score: the
+        # counters share one lock with _spec (the in-memory backend
+        # counts under its lock for the same reason — unlocked `+=`
+        # loses updates between the read and the write-back).
+        with self._lock:
+            self.metrics_events += len(events)
         cmds: list[tuple] = []
         for ev in events:
-            self.metrics_events += 1
             t = ev.get("type")
             if t == "BlockStored":
                 tier = ev.get("medium", "gpu")
@@ -313,7 +318,8 @@ class RedisKVBlockIndex:
     def score_detailed(
         self, hashes: list[str], pods: list[str]
     ) -> dict[str, tuple[float, int]]:
-        self.metrics_lookups += 1
+        with self._lock:
+            self.metrics_lookups += 1
         now = time.monotonic()
         try:
             replies = self.client.pipeline(
@@ -357,8 +363,8 @@ class RedisKVBlockIndex:
                 if n:
                     hit = True
                 out[pod] = (s, n)
-        if hit:
-            self.metrics_hits += 1
+            if hit:
+                self.metrics_hits += 1
         return out
 
     def matched_pages(self, hashes: list[str], pod: str) -> int:
@@ -371,16 +377,19 @@ class RedisKVBlockIndex:
         # DBSIZE counts pod sets too; good enough for the size gauge.
         try:
             return int(self.client.command("DBSIZE"))
+        # llmd: allow(broad-except) -- size gauge probe: a down Redis reads as 0; apply() owns surfacing the outage
         except Exception:
             return 0
 
     def stats(self) -> dict[str, int]:
-        return {
-            "blocks": self.size,
-            "events": self.metrics_events,
-            "lookups": self.metrics_lookups,
-            "hits": self.metrics_hits,
-        }
+        blocks = self.size  # network probe: outside the lock
+        with self._lock:
+            return {
+                "blocks": blocks,
+                "events": self.metrics_events,
+                "lookups": self.metrics_lookups,
+                "hits": self.metrics_hits,
+            }
 
     def close(self) -> None:
         self.client.close()

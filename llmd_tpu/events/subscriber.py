@@ -32,13 +32,15 @@ class KVEventSubscriber:
         self._ctx = zmq.Context.instance()
         self._topic = topic
         # endpoint zmq-address -> pod address (events attribute to pods)
-        self._pods: dict[str, str] = {}
+        self._pods: dict[str, str] = {}  # llmd: guarded_by(_lock)
+        # Poller-thread-owned (single writer/reader): no lock needed.
         self._seqs: dict[str, int] = {}
+        self.batch_failures = 0  # batches whose apply raised (poller survives)
         self._lock = threading.Lock()
         # ZMQ sockets are NOT thread-safe: connect/disconnect are queued here
         # and executed by the poller thread, which exclusively owns the
         # socket (commands drain within one 100ms poll interval).
-        self._cmds: list[tuple[str, str]] = []
+        self._cmds: list[tuple[str, str]] = []  # llmd: guarded_by(_lock)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -89,6 +91,7 @@ class KVEventSubscriber:
                     # A backend hiccup (e.g. Redis outage in the shared
                     # index) must not kill the poller thread — the index
                     # would go silently stale forever.
+                    self.batch_failures += 1
                     log.exception("kv-event batch failed; poller continues")
         finally:
             sock.close(0)

@@ -110,10 +110,10 @@ class FaultPlan:
     def __init__(self, specs: list[FaultSpec], seed: int = 0) -> None:
         self.specs = list(specs)
         self.seed = seed
-        self.injected: dict[str, int] = {}
+        self.injected: dict[str, int] = {}  # llmd: guarded_by(_lock)
         self._lock = threading.Lock()
-        self._hits = [0] * len(self.specs)
-        self._fired = [0] * len(self.specs)
+        self._hits = [0] * len(self.specs)  # llmd: guarded_by(_lock)
+        self._fired = [0] * len(self.specs)  # llmd: guarded_by(_lock)
         # One seeded stream per spec, keyed by (seed, site, match) so a
         # plan reordering does not reshuffle an unrelated spec's draws.
         import random

@@ -84,6 +84,7 @@ class KVFederation:
         self.event_sink = None  # TieredEventSink, attached by the engine
         self._lock = threading.Lock()
         # hash -> distinct-use count, LRU-bounded (the hotness book).
+        # llmd: guarded_by(_lock)
         self._touches: collections.OrderedDict[bytes, int] = (
             collections.OrderedDict()
         )
@@ -92,14 +93,20 @@ class KVFederation:
         # dedups anyway (first copy wins), this just keeps a hot page
         # that keeps getting device-evicted from re-serializing itself
         # into the publish queue every time.
+        # llmd: guarded_by(_lock)
         self._enqueued: collections.OrderedDict[str, None] = (
             collections.OrderedDict()
         )
-        self.publish_requests = 0  # pages handed to the publisher
-        self.published = 0  # publications the master accepted
-        self.publish_failures = 0  # publications that did not land
-        self.hits = 0  # pages fetched from the store
-        self.crc_failures = 0  # pulled blobs rejected by the CRC
+        # pages handed to the publisher
+        self.publish_requests = 0  # llmd: guarded_by(_lock)
+        # publications the master accepted
+        self.published = 0  # llmd: guarded_by(_lock)
+        # publications that did not land
+        self.publish_failures = 0  # llmd: guarded_by(_lock)
+        # pages fetched from the store
+        self.hits = 0  # llmd: guarded_by(_lock)
+        # pulled blobs rejected by the CRC
+        self.crc_failures = 0  # llmd: guarded_by(_lock)
         client.on_published = self._on_published
         client.on_publish_failed = self._on_publish_failed
         client.on_evicted = self._on_store_evicted

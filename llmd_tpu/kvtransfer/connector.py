@@ -425,9 +425,9 @@ class TPUConnector:
         # Staging threads wait on this for the local-claim grace window;
         # claim_local notifies so a claim releases the wait immediately.
         self._local_cond = threading.Condition(self._local_lock)
-        self._local_exports: dict[str, tuple] = {}
-        self._local_claimed: set[str] = set()
-        self._staging_active: set[str] = set()
+        self._local_exports: dict[str, tuple] = {}  # llmd: guarded_by(_local_lock)
+        self._local_claimed: set[str] = set()  # llmd: guarded_by(_local_lock)
+        self._staging_active: set[str] = set()  # llmd: guarded_by(_local_lock)
         self._local_enabled = (
             cfg.local_fastpath
             and self.server is not None
@@ -439,7 +439,8 @@ class TPUConnector:
             _LOCAL_CONSUMERS.add(self)
         # transfer metrics
         self.exported_requests = 0
-        self.exported_bytes = 0
+        # Incremented from concurrent per-export staging threads.
+        self.exported_bytes = 0  # llmd: guarded_by(_local_lock)
         self.imported_requests = 0
         self.imported_bytes = 0
         self.import_failures = 0
@@ -455,8 +456,9 @@ class TPUConnector:
         self.transfer_failures: collections.Counter = collections.Counter()
         # Adaptive encoding: EWMA staging throughput per ORIGINAL byte
         # for each wire form, learned from per-chunk stage timings.
-        self._enc_rate: dict[str, float | None] = {"exact": None, "q8": None}
-        self._adaptive_exports = 0
+        # Concurrent staging threads (one per export) share these.
+        self._enc_rate: dict[str, float | None] = {"exact": None, "q8": None}  # llmd: guarded_by(_local_lock)
+        self._adaptive_exports = 0  # llmd: guarded_by(_local_lock)
         # last-transfer stage timings (ms) — the P/D TTFT budget, readable
         # from stats()/bench without instrumentation hooks
         self.last_stage_ms = 0.0   # producer: HBM->host downloads + register
@@ -704,9 +706,11 @@ class TPUConnector:
                     swa_key(key), payload, self.cfg.lease_ms,
                     header=pack_header(pages, crc=payload_crc(payload)),
                 )
-                self.exported_bytes += payload.nbytes
+                with self._local_lock:
+                    self.exported_bytes += payload.nbytes
             staging_itemsize = np.dtype(self.runner.staging_dtype).itemsize
             for j, snap in enumerate(snaps):
+                # llmd: allow(concurrency) -- intentional lock-free peek: a claim landing mid-check only costs one extra chunk download (benign, bounded by the lease); taking the lock per chunk would serialize staging against the claim path
                 if key in self._local_claimed:
                     # An in-process consumer took the device path; the
                     # remaining HBM->host downloads would be pure waste.
@@ -753,12 +757,16 @@ class TPUConnector:
                 self._observe_encoding(
                     is_q8, orig_bytes, time.monotonic() - t_chunk
                 )
-                self.exported_bytes += len(header) + payload.nbytes
+                with self._local_lock:
+                    self.exported_bytes += len(header) + payload.nbytes
         except Exception:
             # Abandoned export: the consumer's pull wait times out and
             # ITS load-failure policy decides — but the producer-side
             # failure must leave a metric trail, not just a log line.
-            self.transfer_failures[("export-staging", "abandon")] += 1
+            # Same-key increments race between concurrent staging
+            # threads (engine-thread sites touch disjoint keys).
+            with self._local_lock:
+                self.transfer_failures[("export-staging", "abandon")] += 1
             log.exception("KV export staging failed for %s", key)
         finally:
             self.last_stage_ms = (time.monotonic() - t0) * 1e3
@@ -1066,23 +1074,36 @@ class TPUConnector:
         (original bytes staged per second, so the q8 form's halved
         payload and its quantize/scales overhead are both priced in),
         the faster wins, with every 8th export re-probing the loser so
-        a drifting link can flip the decision."""
-        self._adaptive_exports += 1
-        exact, q8 = self._enc_rate["exact"], self._enc_rate["q8"]
-        if exact is None or q8 is None:
-            return self._adaptive_exports % 2 == 0
-        best_q8 = q8 > exact
-        if self._adaptive_exports % 8 == 0:
-            return not best_q8  # re-probe the loser
-        return best_q8
+        a drifting link can flip the decision.
+
+        Concurrent staging threads share the estimator state, so both
+        the pick and the observe run under the local lock (off the
+        engine thread; the lock covers dict reads, never the staging
+        I/O itself)."""
+        with self._local_lock:
+            self._adaptive_exports += 1
+            exact, q8 = self._enc_rate["exact"], self._enc_rate["q8"]
+            if exact is None or q8 is None:
+                return self._adaptive_exports % 2 == 0
+            best_q8 = q8 > exact
+            if self._adaptive_exports % 8 == 0:
+                return not best_q8  # re-probe the loser
+            return best_q8
 
     def _observe_encoding(self, q8: bool, orig_bytes: int, dt_s: float) -> None:
         if dt_s <= 0 or orig_bytes <= 0:
             return
         key = "q8" if q8 else "exact"
         rate = orig_bytes / dt_s
-        prev = self._enc_rate[key]
-        self._enc_rate[key] = rate if prev is None else 0.7 * prev + 0.3 * rate
+        with self._local_lock:
+            prev = self._enc_rate[key]
+            self._enc_rate[key] = (
+                rate if prev is None else 0.7 * prev + 0.3 * rate
+            )
+
+    def _enc_rate_snapshot(self, key: str) -> float | None:
+        with self._local_lock:
+            return self._enc_rate[key]
 
     def release_bundle(self, bundle: "PulledBundle") -> None:
         """Dispose of a fetched bundle that will never be applied: free
@@ -1365,9 +1386,11 @@ class TPUConnector:
     # ------------------------------------------------------------------ #
 
     def stats(self) -> dict[str, int]:
+        with self._local_lock:
+            exported_bytes = self.exported_bytes
         out = {
             "exported_requests": self.exported_requests,
-            "exported_bytes": self.exported_bytes,
+            "exported_bytes": exported_bytes,
             "imported_requests": self.imported_requests,
             "imported_bytes": self.imported_bytes,
             "import_failures": self.import_failures,
@@ -1377,10 +1400,10 @@ class TPUConnector:
             "local_imports": self.local_imports,
             "stream_imports": self.stream_imports,
             "enc_rate_exact_mbps": round(
-                (self._enc_rate["exact"] or 0.0) / 2**20, 2
+                (self._enc_rate_snapshot("exact") or 0.0) / 2**20, 2
             ),
             "enc_rate_q8_mbps": round(
-                (self._enc_rate["q8"] or 0.0) / 2**20, 2
+                (self._enc_rate_snapshot("q8") or 0.0) / 2**20, 2
             ),
             "last_stage_ms": round(self.last_stage_ms, 1),
             "last_fetch_ms": round(self.last_fetch_ms, 1),

@@ -59,10 +59,10 @@ class HostKVCache:
         self.fs_dir = pathlib.Path(fs_dir) if fs_dir else None
         self.fs_max_pages = fs_max_pages
         self.federation = federation
-        self.remote_hits = 0
+        self.remote_hits = 0  # llmd: guarded_by(_lock)
         self._lock = threading.Lock()
-        self._pages: collections.OrderedDict[bytes, np.ndarray] = collections.OrderedDict()
-        self._fs_lru: collections.OrderedDict[bytes, None] = collections.OrderedDict()
+        self._pages: collections.OrderedDict[bytes, np.ndarray] = collections.OrderedDict()  # llmd: guarded_by(_lock)
+        self._fs_lru: collections.OrderedDict[bytes, None] = collections.OrderedDict()  # llmd: guarded_by(_lock)
         if self.fs_dir is not None:
             self.fs_dir.mkdir(parents=True, exist_ok=True)
             for f in sorted(self.fs_dir.glob("*.npy")):
@@ -70,10 +70,10 @@ class HostKVCache:
                     self._fs_lru[bytes.fromhex(f.stem)] = None
                 except ValueError:
                     continue
-        self.saves = 0
-        self.restores = 0
-        self.fs_spills = 0
-        self.fs_loads = 0
+        self.saves = 0  # llmd: guarded_by(_lock)
+        self.restores = 0  # llmd: guarded_by(_lock)
+        self.fs_spills = 0  # llmd: guarded_by(_lock)
+        self.fs_loads = 0  # llmd: guarded_by(_lock)
 
     def __len__(self) -> int:
         with self._lock:
@@ -124,13 +124,15 @@ class HostKVCache:
                 return page, "dram"
         page = self._load_fs(h)
         if page is not None:
-            self.restores += 1
+            with self._lock:
+                self.restores += 1
             if self.federation is not None:
                 self.federation.touch(h)
             return page, "fs"
         page = self._load_remote(h)
         if page is not None:
-            self.restores += 1
+            with self._lock:
+                self.restores += 1
             return page, "store"
         return None, None
 

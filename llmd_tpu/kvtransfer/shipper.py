@@ -139,9 +139,9 @@ class _PyServer:
     """Pure-Python registry + threaded TCP server (protocol-identical)."""
 
     def __init__(self, port: int) -> None:
-        self._entries: dict[str, tuple[bytes, float]] = {}
+        self._entries: dict[str, tuple[bytes, float]] = {}  # llmd: guarded_by(_lock)
         self._lock = threading.Lock()
-        self.expired_count = 0
+        self.expired_count = 0  # llmd: guarded_by(_lock)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):

@@ -211,7 +211,7 @@ class OtlpHttpExporter:
         self.flush_s = flush_s
         self.max_batch = max_batch
         self.timeout_s = timeout_s
-        self._buf: list[dict] = []
+        self._buf: list[dict] = []  # llmd: guarded_by(_lock)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)

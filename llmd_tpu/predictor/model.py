@@ -241,9 +241,9 @@ class LatencyPredictor:
     def __init__(self, cfg: PredictorConfig | None = None) -> None:
         self.cfg = cfg or PredictorConfig()
         self._lock = threading.Lock()
-        self.ttft = _StratifiedModel(TTFT_DIM, self.cfg, _ttft_bucket, heuristic_ttft_ms)
-        self.tpot = _StratifiedModel(TPOT_DIM, self.cfg, _tpot_bucket, heuristic_tpot_ms)
-        self.samples_seen = 0
+        self.ttft = _StratifiedModel(TTFT_DIM, self.cfg, _ttft_bucket, heuristic_ttft_ms)  # llmd: guarded_by(_lock)
+        self.tpot = _StratifiedModel(TPOT_DIM, self.cfg, _tpot_bucket, heuristic_tpot_ms)  # llmd: guarded_by(_lock)
+        self.samples_seen = 0  # llmd: guarded_by(_lock)
 
     # -- training ------------------------------------------------------- #
 

@@ -18,17 +18,17 @@ class InflightStore:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         # address -> {"requests": n, "tokens": n, "started": {rid: t0}}
-        self._by_worker: dict[str, dict] = {}
-        self.completed_total = 0
+        self._by_worker: dict[str, dict] = {}  # llmd: guarded_by(_lock)
+        self.completed_total = 0  # llmd: guarded_by(_lock)
 
-    def _w(self, address: str) -> dict:
+    def _w_locked(self, address: str) -> dict:
         return self._by_worker.setdefault(
             address, {"requests": 0, "tokens": 0, "started": {}}
         )
 
     def begin(self, address: str, request_id: str, tokens: int) -> None:
         with self._lock:
-            w = self._w(address)
+            w = self._w_locked(address)
             w["requests"] += 1
             w["tokens"] += tokens
             w["started"][request_id] = (time.monotonic(), tokens)

@@ -99,17 +99,17 @@ class AsyncEngine:
         # 503 before the gateway sees connection errors.
         self.draining = False
         self._lock = threading.Condition()
-        self._inbox: list[_Pending] = []
-        self._aborts: list[str] = []
-        self._stop = False
+        self._inbox: list[_Pending] = []  # llmd: guarded_by(_lock)
+        self._aborts: list[str] = []  # llmd: guarded_by(_lock)
+        self._stop = False  # llmd: guarded_by(_lock)
         # IRO pause gate (proposals/inference-resilience-operator.md): a
         # paused engine stops stepping entirely — in-flight sequences stay
         # scheduled with their KV intact and continue on resume. Used to
         # quiesce the device before a RESET_DEVICE / REBOOT_NODE action.
-        self._paused = False
+        self._paused = False  # llmd: guarded_by(_lock)
         self._loop: asyncio.AbstractEventLoop | None = None
         # request_id -> asyncio.Queue of RequestOutput | Exception
-        self._subs: dict[str, asyncio.Queue] = {}
+        self._subs: dict[str, asyncio.Queue] = {}  # llmd: guarded_by(_lock)
         self._thread: threading.Thread | None = None
         # P/D fetch pool (see generate): owning the concurrent futures is
         # what makes abandoned-fetch cleanup possible. Sized like the
@@ -167,6 +167,7 @@ class AsyncEngine:
         return (
             self._thread is not None
             and self._thread.is_alive()
+            # llmd: allow(concurrency) -- single atomic bool read for a health probe; a probe racing pause() legitimately reports either state
             and not self._paused
             and not self.draining
             and not self.stalled
@@ -209,6 +210,7 @@ class AsyncEngine:
 
     @property
     def paused(self) -> bool:
+        # llmd: allow(concurrency) -- single atomic bool read; IRO polls this, and racing a concurrent pause() legitimately returns either side
         return self._paused
 
     def pause(self) -> None:
@@ -389,11 +391,18 @@ class AsyncEngine:
     # ------------------------------------------------------------------ #
 
     def _deliver(self, request_id: str, item) -> None:
-        q = self._subs.get(request_id)
-        if q is None:
-            return
-        if isinstance(item, RequestOutput) and item.finished:
-            self._subs.pop(request_id, None)
+        # Engine-thread side of the _subs registry. The get/pop pair
+        # must hold the lock: the loop thread concurrently registers
+        # (submit), deregisters-and-aborts (generate's finally, with an
+        # identity check this pop must be ordered against), and swaps
+        # the whole dict (watchdog) — an unlocked pop here could race a
+        # same-id resubmit and silently drop the NEW stream's queue.
+        with self._lock:
+            q = self._subs.get(request_id)
+            if q is None:
+                return
+            if isinstance(item, RequestOutput) and item.finished:
+                self._subs.pop(request_id, None)
         assert self._loop is not None
         self._loop.call_soon_threadsafe(q.put_nowait, item)
 

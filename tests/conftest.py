@@ -20,6 +20,44 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# ------------------------------------------------------------------ #
+# Lock sanitizer (docs/architecture/static-analysis.md): LLMD_LOCKSAN=1
+# arms the instrumented lock wrappers for the whole session — every
+# threading.Lock/RLock created from here on records acquisition stacks,
+# feeds the global lock-order graph, and flags locks held across an
+# asyncio callback boundary. Armed HERE (after the jax import) so jax's
+# import-time internals stay raw while every llmd_tpu lock — created in
+# __init__ methods during tests — is instrumented.
+
+_LOCKSAN = os.environ.get("LLMD_LOCKSAN") == "1"
+if _LOCKSAN:
+    from llmd_tpu.analysis import sanitize as _sanitize
+
+    _sanitize.arm()
+
+
+@pytest.fixture(autouse=True)
+def _locksan_gate():
+    """Fail the test on whose watch the sanitizer recorded a violation —
+    including ones raised on background threads and swallowed there."""
+    if not _LOCKSAN:
+        yield
+        return
+    _sanitize.drain_violations()  # never blame this test for leftovers
+    yield
+    vs = _sanitize.drain_violations()
+    assert not vs, (
+        "lock sanitizer violations during this test: "
+        + "; ".join(f"{v['kind']} ({v.get('locks') or v.get('acquired')})"
+                    for v in vs)
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _LOCKSAN:
+        path = _sanitize.write_report()
+        print(f"\nlocksan: report written to {path}")
+
 
 @pytest.fixture(scope="session")
 def devices():

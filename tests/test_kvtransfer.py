@@ -6,6 +6,7 @@ engine would (cache-seeded remote KV may never change numerics), while
 actually hitting the transferred pages.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -577,6 +578,7 @@ def test_adaptive_encoding_decision_logic():
     converges to the measured-faster encoding, and re-probes the loser
     periodically so a drifting link can flip the choice."""
     conn = TPUConnector.__new__(TPUConnector)
+    conn._local_lock = threading.Lock()  # pick/observe run under it
     conn._enc_rate = {"exact": None, "q8": None}
     conn._adaptive_exports = 0
 

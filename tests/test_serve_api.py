@@ -514,3 +514,69 @@ async def test_conversations_flow(client):
                                "conversation": "conv_nope"},
     )
     assert r.status == 404
+
+
+def test_deliver_is_atomic_against_same_id_reregistration():
+    """Regression: _deliver (engine thread) must hold the lock across
+    its get/pop of _subs. Unlocked, a loop-thread abort+resubmit of the
+    same request id could interleave between the get and the pop, and
+    the pop would silently drop the NEW stream's queue — the resubmitted
+    request would hang forever. Surfaced by the CC001 guarded-by triage
+    (static-analysis.md)."""
+    import asyncio
+    import threading
+
+    from llmd_tpu.engine.request import RequestOutput
+
+    class _StubEngine:
+        stats = None
+
+        def has_work(self):
+            return False
+
+    inst = AsyncEngine(_StubEngine())
+    loop = asyncio.new_event_loop()
+    try:
+        inst._loop = loop
+        rid = "req-1"
+        inst.submit(rid, [1, 2, 3], None)
+
+        windows = threading.Event()   # _deliver is inside its window
+        resubmitted = threading.Event()
+
+        class _RacingDict(dict):
+            def get(self, k, default=None):
+                out = dict.get(self, k, default)
+                if k == rid and not windows.is_set():
+                    windows.set()
+                    # Give the racer the whole window between the get
+                    # and the pop. With _deliver holding the lock the
+                    # racer stays blocked and this times out; unlocked,
+                    # the racer swaps in the new queue mid-window.
+                    resubmitted.wait(0.3)
+                return out
+
+        with inst._lock:
+            inst._subs = _RacingDict(inst._subs)
+
+        def racer():
+            windows.wait(2)
+            inst.abort(rid)            # client disconnected...
+            inst.submit(rid, [4], None)  # ...and retried with the same id
+            resubmitted.set()
+
+        t = threading.Thread(target=racer)
+        t.start()
+        final = RequestOutput(
+            request_id=rid, new_token_ids=[7], finished=True,
+            finish_reason="stop", num_prompt_tokens=3, num_output_tokens=1,
+        )
+        inst._deliver(rid, final)  # engine-thread side
+        t.join(timeout=5)
+        assert resubmitted.is_set()
+        # The resubmitted stream's queue must have survived the pop.
+        with inst._lock:
+            assert rid in inst._subs
+    finally:
+        inst._fetch_pool.shutdown(wait=False, cancel_futures=True)
+        loop.close()
