@@ -72,6 +72,14 @@ extras (north-star shapes, BASELINE.json):
                     fleet-wide reuse headline), exact virtual-time
                     federated-vs-cold p50 TTFT ratio, byte-identical
                     scoreboards across two federated runs.
+  batch_backfill  — batch serving tier CPU-sim part
+                    (batch-processing.md): the batch_backfill fleetsim
+                    scenario batch-on vs no-batch on the same diurnal
+                    interactive trace — batch tok/s harvested from
+                    trough capacity, trough-utilization lift, backlog
+                    drained, and the interactive p99 TTFT on/off ratio
+                    (the zero-regression headline), byte-identical
+                    scoreboards across two batch-on runs.
 """
 
 from __future__ import annotations
@@ -921,6 +929,8 @@ def _run_part(part: str):
         return bench_fleet_soak()
     if part == "kv_federation":
         return bench_kv_federation()
+    if part == "batch_backfill":
+        return bench_batch_backfill()
     raise KeyError(part)
 
 
@@ -1033,6 +1043,71 @@ def bench_kv_federation():
             fed["latency_ms"]["ttft"]["p50"]
             / max(1e-9, cold["latency_ms"]["ttft"]["p50"]), 4
         ),
+    }
+
+
+def bench_batch_backfill():
+    """Batch serving tier CPU-sim part (batch-processing.md): the
+    batch_backfill fleetsim scenario run BATCH-ON (standing offline
+    queue at BATCH_PRIORITY riding the real flow-control band, the
+    production chain's batch-saturation-filter, and the replicas'
+    backfill path, with the WVA flooring the fleet on the backlog) and
+    NO-BATCH (same diurnal interactive trace, utilization sampler
+    armed) — virtual time, so the comparison is exact. Headlines: batch
+    tok/s harvested from trough capacity, the trough-utilization lift
+    over the no-batch baseline, backlog drained to zero, and the
+    interactive p99 TTFT on/off ratio — the zero-interactive-regression
+    bar the CI summary asserts. Determinism proven by running the
+    batch-on leg twice and comparing scoreboard bytes."""
+    from llmd_tpu.fleetsim.scenarios import build_batch_backfill
+    from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+
+    scale = 0.5
+    seed = 0
+    t0 = time.monotonic()
+    on = build_batch_backfill(seed, scale, batch=True).run()
+    wall_s = time.monotonic() - t0
+    on_b = build_batch_backfill(seed, scale, batch=True).run()
+    off = build_batch_backfill(seed, scale, batch=False).run()
+    bt = on["batch"]
+    # Harvested-token rate over the window the jobs actually drained in
+    # (virtual seconds — the deterministic "batch tok/s" headline).
+    drain_span = max(bt["last_drain_t"], 1e-9)
+    p99_on = on["latency_ms"]["ttft"]["p99"]
+    p99_off = off["latency_ms"]["ttft"]["p99"]
+    return {
+        "qps_scale": scale,
+        "deterministic": (
+            to_canonical_json(on) == to_canonical_json(on_b)
+        ),
+        "invariants_ok": bool(on["ok"] and off["ok"]),
+        "zero_lost": (
+            on["requests"]["lost"] == 0 and on["requests"]["hung"] == 0
+        ),
+        "jobs": bt["enqueued"],
+        "backlog_drained": bt["outstanding"] == 0 and bt["hung"] == 0,
+        "backlog_monotone": bt["backlog_monotone_after_peak"],
+        "watermark_retries": bt["retries"],
+        "harvested_tokens": bt["harvested_tokens"],
+        "batch_tok_s_harvested": round(
+            bt["harvested_tokens"] / drain_span, 1
+        ),
+        "trough_utilization": {
+            "batch_on": round(
+                on["utilization"]["trough_utilization"], 4
+            ),
+            "no_batch": round(
+                off["utilization"]["trough_utilization"], 4
+            ),
+        },
+        "interactive_p99_ttft_ms": {
+            "batch_on": round(p99_on, 2),
+            "no_batch": round(p99_off, 2),
+        },
+        # the summary-check headline: backfill must cost interactive
+        # latency nothing (ratio ~1.0 in exact virtual time)
+        "p99_ratio_on_vs_off": round(p99_on / max(1e-9, p99_off), 4),
+        "wall_s": round(wall_s, 2),
     }
 
 
@@ -1898,6 +1973,7 @@ def _part_in_subprocess(part: str, retries: int = 0, timeout: float = 1800):
 _CPU_PARTS = frozenset({
     "dbo", "async_step", "spec_decode", "spec_window", "unified_step",
     "ragged_step", "fault_degrade", "fleet_soak", "kv_federation",
+    "batch_backfill",
 })
 
 # Every part main() can dispatch, in run order (also the validation set
@@ -1910,6 +1986,7 @@ _CPU_PARTS = frozenset({
 _ALL_PARTS = (
     "ragged_step", "unified_step", "async_step", "spec_decode",
     "spec_window", "dbo", "fault_degrade", "fleet_soak", "kv_federation",
+    "batch_backfill",
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
     "pd", "pd_int8", "pd_kvint8", "pd_local", "pd_cached", "pd_adaptive",
@@ -2048,6 +2125,7 @@ def main() -> None:
         "fault_degrade": (set_key("fault_degrade"), None),
         "fleet_soak": (set_key("fleet_soak"), None),
         "kv_federation": (set_key("kv_federation"), None),
+        "batch_backfill": (set_key("batch_backfill"), None),
         "rtt": (set_key("dispatch_rtt_ms"), None),
         "env": (set_key("env"), None),
         # The headline part now also carries the MFU/roofline context:

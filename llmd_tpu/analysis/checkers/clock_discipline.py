@@ -1,12 +1,15 @@
 """direct-clock: the control stack reads time through the clock seam.
 
-The routing/control plane (``epp/``, ``autoscale/``, ``predictor/``)
-is driven by the fleet simulator (``fleetsim/``, included in scope)
-through a virtual-time event loop: every time-dependent decision —
-breaker cooldowns, flow-control TTLs and EDF deadlines, scrape
-freshness, session TTLs, WVA retention windows — must read
-:func:`llmd_tpu.clock.monotonic` (or an injected clock callable), never
-``time.time()`` / ``time.monotonic()`` directly. One stray direct call
+The routing/control plane (``epp/``, ``autoscale/``, ``predictor/``,
+``batch/``) is driven by the fleet simulator (``fleetsim/``, included
+in scope) through a virtual-time event loop: every time-dependent
+decision — breaker cooldowns, flow-control TTLs and EDF deadlines,
+scrape freshness, session TTLs, WVA retention windows, batch job
+deadlines/timestamps and gate freshness — must read
+:func:`llmd_tpu.clock.monotonic` (wall-clock unix-seconds semantics:
+:func:`llmd_tpu.clock.time`, the batch plane's timestamp seam) or an
+injected clock callable, never ``time.time()`` / ``time.monotonic()``
+directly. One stray direct call
 silently splits the plane between real and simulated time: the soak
 still *runs*, but cooldowns measured on the wall clock while sleeps run
 on virtual time makes recovery bounds meaningless and the scoreboard
@@ -34,7 +37,9 @@ from pathlib import Path
 
 from llmd_tpu.analysis.core import Checker, Finding, Repo, register
 
-SCOPE_PARTS = frozenset({"epp", "autoscale", "predictor", "fleetsim"})
+SCOPE_PARTS = frozenset(
+    {"epp", "autoscale", "predictor", "fleetsim", "batch"}
+)
 
 _CLOCK_ATTRS = frozenset({"time", "monotonic"})
 

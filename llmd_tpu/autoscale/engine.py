@@ -154,6 +154,9 @@ class RouterCollector:
         r.kv_usage = m.get("vllm:gpu_cache_usage_perc", 0.0)
         r.queue_len = m.get("vllm:num_requests_waiting", 0.0)
         r.running = m.get("vllm:num_requests_running", 0.0)
+        # Batch tier: engine-side backlog is deferrable demand (floor,
+        # never scale-up — docs/architecture/batch-processing.md).
+        r.batch_backlog = m.get("vllm:batch_backlog_jobs", 0.0)
         prev = self._per_pod_prev.setdefault(addr, {})
         prompt = m.get("vllm:prompt_tokens_total", 0.0)
         gen = m.get("vllm:generation_tokens_total", 0.0)
@@ -194,6 +197,7 @@ class WvaEngine:
         scale_to_zero: bool = False,
         slo_targets: tuple[float | None, float | None] = (None, None),
         actuator=None,
+        batch_floor_replicas: int = 1,
     ) -> None:
         self.collector = collector
         self.variants = variants
@@ -207,6 +211,9 @@ class WvaEngine:
         self.slo = SloQueueingAnalyzer(
             target_ttft_ms=slo_targets[0], target_itl_ms=slo_targets[1]
         )
+        # Batch-backlog floor (docs/architecture/batch-processing.md):
+        # minimum fleet size while batch work is queued; 0 disables.
+        self.batch_floor_replicas = batch_floor_replicas
         # decision cache: model_id -> {variant: desired}
         self.decisions: dict[str, dict[str, int]] = {}
         self.actuator = actuator
@@ -258,6 +265,7 @@ class WvaEngine:
 
         decisions = self.optimizer.decide(snap, sig, need, free)
         decisions = self.enforcer.enforce(snap, specs, decisions)
+        decisions = self._apply_batch_floor(snap, specs, decisions)
         cache = self.decisions.setdefault(snap.model_id, {})
         for d in decisions:
             cache[d.variant] = d.desired_replicas
@@ -269,6 +277,45 @@ class WvaEngine:
                     await out
             except Exception:
                 log.exception("WVA actuator failed")
+        return decisions
+
+    def _apply_batch_floor(self, snap, specs, decisions):
+        """Batch backlog is DEFERRABLE demand
+        (docs/architecture/batch-processing.md): while any batch work is
+        queued, the fleet is floored at ``batch_floor_replicas`` (the
+        trough drains the backlog through the backfill band instead of
+        scaling toward zero) — but backlog NEVER scales the fleet UP
+        beyond that floor: offline work has no latency SLO to buy
+        capacity for, it waits for interactive troughs. Applied after
+        the enforcer so scale-to-zero is overridden, not bypassed."""
+        if self.batch_floor_replicas <= 0 or snap.batch_backlog <= 0:
+            return decisions
+        total = sum(d.desired_replicas for d in decisions)
+        if not decisions:
+            total = sum(self.decisions.get(snap.model_id, {}).values())
+        if total >= self.batch_floor_replicas or not specs:
+            return decisions
+        cheapest = min(specs, key=lambda v: v.cost)
+        bumped = False
+        for d in decisions:
+            if d.variant == cheapest.name:
+                d.desired_replicas = max(
+                    d.desired_replicas,
+                    self.batch_floor_replicas - (total - d.desired_replicas),
+                )
+                d.reason = (d.reason + "; " if d.reason else "") + (
+                    "batch-backlog-floor"
+                )
+                bumped = True
+                break
+        if not bumped:
+            decisions = list(decisions) + [
+                VariantDecision(
+                    snap.model_id, cheapest.name,
+                    self.batch_floor_replicas - total,
+                    "batch-backlog-floor",
+                )
+            ]
         return decisions
 
     # ---- scale-from-zero fast path ----

@@ -50,6 +50,10 @@ class ReplicaMetrics:
     arrival_rate: float = 0.0      # req/s dispatched to this replica
     avg_ttft_s: float = 0.0
     avg_itl_s: float = 0.0
+    # Batch serving tier (docs/architecture/batch-processing.md):
+    # waiting batch-band rows on this replica (vllm:batch_backlog_jobs)
+    # — deferrable demand the WVA floors on instead of scaling up for.
+    batch_backlog: float = 0.0
 
     @property
     def kv_capacity_tokens(self) -> float:
@@ -76,6 +80,21 @@ class PoolSnapshot:
     # None = the window has not been fully observed yet (collector warm-up);
     # scale-to-zero must not act on it.
     recent_request_count: float | None = 0.0
+    # Batch backlog queued UPSTREAM of the replicas (gateway/flow-control
+    # side); per-replica backlogs ride ReplicaMetrics.batch_backlog.
+    batch_backlog_upstream: float = 0.0
+
+    @property
+    def batch_backlog(self) -> float:
+        """Total deferrable batch demand visible to scaling decisions:
+        upstream queue plus every replica's engine-side backlog. While
+        this is positive the WVA floors the fleet at one replica (the
+        trough drains offline work instead of scaling to zero) but
+        never scales UP for it — batch is deferrable by definition
+        (docs/architecture/batch-processing.md)."""
+        return self.batch_backlog_upstream + sum(
+            r.batch_backlog for r in self.replicas
+        )
 
     def by_variant(self) -> dict[str, list[ReplicaMetrics]]:
         out: dict[str, list[ReplicaMetrics]] = {}

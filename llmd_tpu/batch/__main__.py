@@ -12,7 +12,10 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import logging
+import os
+import signal
 from pathlib import Path
 
 from aiohttp import web
@@ -23,6 +26,12 @@ from llmd_tpu.batch.store import BatchStore, FileStore
 
 
 async def amain(args: argparse.Namespace) -> None:
+    """Serve with the PR 7 probe contract (mirrors epp/__main__._serve):
+    SIGTERM/SIGINT flips /readyz to 503 WHILE the socket still serves
+    (so the platform probe observes it and new jobs route away), stops
+    the processor from claiming new queue jobs, lets the in-flight
+    job's rows finish, waits ``LLMD_BATCH_DRAIN_GRACE_S`` (default 5s)
+    for routing to move, and only then tears the runner down."""
     data = Path(args.data_dir)
     data.mkdir(parents=True, exist_ok=True)
     store = BatchStore(data / "batch.db")
@@ -43,10 +52,44 @@ async def amain(args: argparse.Namespace) -> None:
     await site.start()
     logging.info("batch gateway on %s:%d -> router %s",
                  args.host, args.port, args.router_url)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def _on_signal() -> None:
+        # Phase 1: flip readiness + stop accepting new work, socket up.
+        app["gateway"].begin_drain()
+        proc.stop()  # finishes the in-flight job's rows, then exits
+        gc.stop()
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, _on_signal)
+    worker = asyncio.gather(proc.run(), gc.run())
+    stopper = asyncio.ensure_future(stop.wait())
     try:
-        await asyncio.gather(proc.run(), gc.run())
+        # A worker crash must propagate (readiness staying green over a
+        # dead processor would silently strand every queued job): wait
+        # for EITHER the signal or the worker ending, and re-raise the
+        # latter's exception immediately.
+        await asyncio.wait(
+            [stopper, worker], return_when=asyncio.FIRST_COMPLETED
+        )
+        if worker.done():
+            worker.result()  # raises if proc.run()/gc.run() failed
+        # Phase 2: in-flight rows drain (proc.run returns only after the
+        # current job completes), then the probe-visibility grace.
+        await worker
+        grace = float(os.environ.get("LLMD_BATCH_DRAIN_GRACE_S", "5"))
+        if grace > 0:
+            await asyncio.sleep(grace)
     finally:
+        stopper.cancel()
+        worker.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await worker
         await runner.cleanup()
+        store.close()
 
 
 def main() -> None:

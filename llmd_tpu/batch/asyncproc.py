@@ -32,12 +32,13 @@ import math
 import random
 import sqlite3
 import threading
-import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Awaitable, Callable
 
 import aiohttp
+
+from llmd_tpu import clock
 
 log = logging.getLogger(__name__)
 
@@ -59,12 +60,15 @@ class DeadlineQueue:
     """
 
     def __init__(self, db_path: str | Path | None = None) -> None:
+        # Event-loop-thread owned (single-threaded asyncio: mutations
+        # happen between awaits) — no lock; the DB connection below is
+        # the one cross-thread surface (sqlite check_same_thread=False).
         self._heap: list[QueuedRequest] = []
         self._seq = itertools.count()
         # put() replaces-and-sets this so every parked getter wakes and
         # re-checks immediately — a backoff sleep must not delay fresh work.
         self._new_item = asyncio.Event()
-        self._db: sqlite3.Connection | None = None
+        self._db: sqlite3.Connection | None = None  # llmd: guarded_by(_db_lock)
         self._db_lock = threading.Lock()
         if db_path is not None:
             self._db = sqlite3.connect(str(db_path), check_same_thread=False)
@@ -85,20 +89,24 @@ class DeadlineQueue:
                 )
 
     def _persist(self, req: QueuedRequest) -> None:
-        if self._db is None:
-            return
-        with self._db_lock, self._db:
-            self._db.execute(
-                "INSERT OR REPLACE INTO q VALUES (?,?,?,?,?)",
-                (req.request_id, req.deadline, req.url_path,
-                 json.dumps(req.payload), req.attempts),
-            )
+        with self._db_lock:
+            if self._db is None:
+                return
+            with self._db:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO q VALUES (?,?,?,?,?)",
+                    (req.request_id, req.deadline, req.url_path,
+                     json.dumps(req.payload), req.attempts),
+                )
 
     def _unpersist(self, request_id: str) -> None:
-        if self._db is None:
-            return
-        with self._db_lock, self._db:
-            self._db.execute("DELETE FROM q WHERE request_id=?", (request_id,))
+        with self._db_lock:
+            if self._db is None:
+                return
+            with self._db:
+                self._db.execute(
+                    "DELETE FROM q WHERE request_id=?", (request_id,)
+                )
 
     async def put(
         self,
@@ -126,7 +134,7 @@ class DeadlineQueue:
         no lock is needed; wakeups ride the put() event.
         """
         while True:
-            now = time.monotonic()
+            now = clock.monotonic()
             ready = [r for r in self._heap if r.not_before <= now]
             if ready:
                 req = min(ready)
@@ -171,6 +179,8 @@ class BudgetFileGate:
     def __init__(self, path: str | Path, poll_interval_s: float = 0.5) -> None:
         self.path = Path(path)
         self.poll_interval_s = poll_interval_s
+        # Event-loop-thread owned (acquire/release run on the worker
+        # pool's loop; increments sit between awaits) — no lock.
         self._inflight = 0
 
     def _budget(self) -> int:
@@ -225,8 +235,10 @@ class SaturationGate:
         self.threshold = threshold
         self.poll_interval_s = poll_interval_s
         self.outage_grace_s = outage_grace_s
+        # Event-loop-thread owned (every acquire() runs on the worker
+        # pool's loop; the session is created lazily there) — no lock.
         self._session: aiohttp.ClientSession | None = None
-        self._last_ok = time.monotonic()
+        self._last_ok = clock.monotonic()
 
     async def acquire(self) -> None:
         if self._session is None or self._session.closed:
@@ -235,7 +247,7 @@ class SaturationGate:
             )
         while True:
             val = await _scrape_gauge(self._session, self.metrics_url, self.metric)
-            now = time.monotonic()
+            now = clock.monotonic()
             if val is None:
                 if now - self._last_ok > self.outage_grace_s:
                     return  # fail open
@@ -276,7 +288,7 @@ class BudgetMetricsGate(SaturationGate):
                                       self.capacity_metric)
             used = await _scrape_gauge(self._session, self.metrics_url,
                                        self.metric)
-            now = time.monotonic()
+            now = clock.monotonic()
             if cap is None or used is None:
                 if now - self._last_ok > self.outage_grace_s:
                     self._inflight += 1
@@ -353,7 +365,7 @@ class AsyncProcessor:
             req = await self.queue.get()
             try:
                 # Deadline enforcement: abandon work that can't finish.
-                if time.time() >= req.deadline:
+                if clock.time() >= req.deadline:
                     self.stats["deadline_exceeded"] += 1
                     self.queue.ack(req)
                     await self._emit(req, {"error": "deadline_exceeded"})
@@ -375,7 +387,7 @@ class AsyncProcessor:
 
     async def _dispatch(self, req: QueuedRequest) -> None:
         url = self.cfg.router_url.rstrip("/") + req.url_path
-        remaining = max(req.deadline - time.time(), 0.1)
+        remaining = max(req.deadline - clock.time(), 0.1)
         headers = {
             # Deadline propagation to the router/engine.
             "x-llm-d-deadline-ms": str(int(remaining * 1000)),
@@ -418,7 +430,7 @@ class AsyncProcessor:
         await self.queue.put(
             req.payload, req.deadline, req.url_path, req.request_id,
             attempts=req.attempts + 1,
-            not_before=time.monotonic() + delay,
+            not_before=clock.monotonic() + delay,
         )
 
     async def _emit(self, req: QueuedRequest, result: dict) -> None:
@@ -472,7 +484,7 @@ def build_asyncproc_app(queue: DeadlineQueue, proc: AsyncProcessor):
         rid = body.get("request_id") or ""
         await queue.put(
             payload,
-            deadline=time.time() + deadline_s,
+            deadline=clock.time() + deadline_s,
             url_path=body.get("url_path", "/v1/completions"),
             request_id=rid,
         )

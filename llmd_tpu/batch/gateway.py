@@ -108,6 +108,22 @@ class Gateway:
         self.files = files
         self.tenant_header = tenant_header
         self.file_expiry_s = file_expiry_s
+        # Probe contract (mirrors epp/__main__._serve): /health stays
+        # liveness (200 while the process works), /readyz flips 503 the
+        # moment drain begins — WHILE the socket still serves — so the
+        # platform's readiness probe observes it and routes new work
+        # away before teardown. Draining also refuses new uploads and
+        # batch creations with a retryable 503.
+        self.draining = False
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def _refuse_draining(self) -> web.Response | None:
+        if self.draining:
+            return _err(503, "gateway draining; retry another replica",
+                        "shutting_down")
+        return None
 
     def _tenant(self, request: web.Request) -> str:
         return request.headers.get(self.tenant_header, "default")
@@ -115,6 +131,9 @@ class Gateway:
     # ---- files ----
 
     async def upload_file(self, request: web.Request) -> web.Response:
+        refused = self._refuse_draining()
+        if refused is not None:
+            return refused
         tenant = self._tenant(request)
         filename, purpose, data = "upload.jsonl", "batch", b""
         if request.content_type == "multipart/form-data":
@@ -178,6 +197,9 @@ class Gateway:
     # ---- batches ----
 
     async def create_batch(self, request: web.Request) -> web.Response:
+        refused = self._refuse_draining()
+        if refused is not None:
+            return refused
         tenant = self._tenant(request)
         try:
             body = await request.json()
@@ -237,7 +259,20 @@ class Gateway:
         return web.json_response(job.to_openai())
 
     async def health(self, request: web.Request) -> web.Response:
+        # Liveness: 200 even while draining (the process is healthy; it
+        # is readiness that must flip — restarting a draining pod would
+        # abandon its in-flight rows).
         return web.json_response({"status": "ok", "queue_depth": self.store.queue_depth()})
+
+    async def readyz(self, request: web.Request) -> web.Response:
+        if self.draining:
+            return web.json_response(
+                {"status": "draining"}, status=503,
+                headers={"retry-after": "1"},
+            )
+        return web.json_response(
+            {"status": "ready", "queue_depth": self.store.queue_depth()}
+        )
 
 
 def build_gateway_app(
@@ -258,6 +293,7 @@ def build_gateway_app(
             web.get("/v1/batches/{id}", gw.get_batch),
             web.post("/v1/batches/{id}/cancel", gw.cancel_batch),
             web.get("/health", gw.health),
+            web.get("/readyz", gw.readyz),
         ]
     )
     return app

@@ -46,6 +46,14 @@ class ProcessorConfig:
     # Headers forwarded verbatim from batch metadata to inference requests
     # so the router can authorize the end user per-request.
     passthrough_headers: tuple[str, ...] = ("authorization", "x-llm-d-fairness-id")
+    # Watermark-admission retry (docs/architecture/batch-processing.md):
+    # the EPP's batch-saturation-filter answers 503 while no replica has
+    # headroom — batch work WAITS for a trough instead of displacing
+    # interactive traffic, so retryable statuses re-offer the line with
+    # exponential backoff, bounded by the job's completion deadline.
+    dispatch_max_attempts: int = 6
+    dispatch_backoff_base_s: float = 1.0
+    dispatch_backoff_max_s: float = 30.0
 
 
 @dataclass
@@ -294,35 +302,67 @@ class BatchProcessor:
         self.store.remove_from_queue(batch_id)
 
     async def _dispatch(self, job, line: dict) -> dict:
-        """One inference request -> one output JSONL record."""
+        """One inference request -> one output JSONL record.
+
+        Every request carries ``x-llmd-priority: batch``: the EPP clamps
+        it to the backfill band (flow-control band below every
+        interactive priority, watermark admission via the
+        batch-saturation-filter) and the engine scheduler backfills it
+        into idle step headroom — the router's 503 while no replica has
+        headroom is an expected WAIT signal, retried with bounded
+        exponential backoff until the job deadline.
+        """
         url = self.cfg.router_url.rstrip("/") + line["url"]
         headers = {
             h: v for h, v in (job.metadata.get("headers") or {}).items()
             if h.lower() in self.cfg.passthrough_headers
         }
         headers["x-llm-d-tenant"] = job.tenant
+        headers["x-llmd-priority"] = "batch"
         rec = {
             "id": f"batch_req_{uuid.uuid4().hex[:16]}",
             "custom_id": line["custom_id"],
             "response": None,
             "error": None,
         }
-        try:
-            sess = await self._client()
-            async with sess.post(url, json=line["body"], headers=headers) as r:
-                try:
-                    body = await r.json()
-                except Exception:
-                    body = {"raw": (await r.text())[:2000]}
+        retryable = frozenset({429, 500, 502, 503, 504})
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                sess = await self._client()
+                async with sess.post(
+                    url, json=line["body"], headers=headers
+                ) as r:
+                    try:
+                        body = await r.json()
+                    except Exception:
+                        body = {"raw": (await r.text())[:2000]}
+                    rec["response"] = {
+                        "status_code": r.status,
+                        "request_id": r.headers.get("x-request-id", ""),
+                        "body": body,
+                    }
+                    rec["error"] = None
+                    if r.status not in retryable:
+                        return rec
+            except Exception as e:  # network-level failure
                 rec["response"] = {
-                    "status_code": r.status,
-                    "request_id": r.headers.get("x-request-id", ""),
-                    "body": body,
+                    "status_code": 0, "request_id": "", "body": None,
                 }
-        except Exception as e:  # network-level failure
-            rec["response"] = {"status_code": 0, "request_id": "", "body": None}
-            rec["error"] = {"code": "connection_error", "message": str(e)[:500]}
-        return rec
+                rec["error"] = {
+                    "code": "connection_error", "message": str(e)[:500],
+                }
+            delay = min(
+                self.cfg.dispatch_backoff_base_s * (2 ** (attempt - 1)),
+                self.cfg.dispatch_backoff_max_s,
+            )
+            if (
+                attempt >= self.cfg.dispatch_max_attempts
+                or now_s() + delay >= job.deadline
+            ):
+                return rec  # out of budget: surface the last outcome
+            await asyncio.sleep(delay)
 
 
 class GarbageCollector:

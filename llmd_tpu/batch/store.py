@@ -24,16 +24,20 @@ import json
 import os
 import sqlite3
 import threading
-import time
 import uuid
 from dataclasses import dataclass
 from pathlib import Path
+
+from llmd_tpu import clock
 
 __all__ = ["BatchStore", "FileStore", "FileMeta", "BatchJob", "now_s"]
 
 
 def now_s() -> float:
-    return time.time()
+    """Unix-seconds wall clock through the llmd_tpu.clock seam: batch
+    timestamps/deadlines replay on the fleet simulator's virtual axis
+    (CK001 covers batch/ — no direct time.time() here)."""
+    return clock.time()
 
 
 def _new_id(prefix: str) -> str:

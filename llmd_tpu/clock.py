@@ -12,7 +12,9 @@ and the same trace + seed replays to a byte-identical scoreboard.
 
 The discipline is machine-checked: the ``direct-clock`` static-analysis
 rule (CK001) flags any ``time.time()`` / ``time.monotonic()`` reference
-inside ``epp/``, ``autoscale/``, ``predictor/`` or ``fleetsim/`` —
+inside ``epp/``, ``autoscale/``, ``predictor/``, ``batch/`` (whose
+unix-seconds timestamps/deadlines read the :func:`time` wall seam) or
+``fleetsim/`` —
 a direct call there silently splits the control plane between real and
 simulated time, which is exactly the bug class that makes a soak
 nondeterministic.
@@ -32,10 +34,23 @@ from typing import Callable
 _REAL: Callable[[], float] = _time.monotonic
 _impl: Callable[[], float] = _REAL
 
+_REAL_WALL: Callable[[], float] = _time.time
+_impl_wall: Callable[[], float] = _REAL_WALL
+
 
 def monotonic() -> float:
     """Seconds on the installed monotonic clock (real by default)."""
     return _impl()
+
+
+def time() -> float:
+    """Seconds on the installed WALL clock (real ``time.time`` by
+    default). The batch plane's timestamp seam: OpenAI Batch object
+    timestamps, job deadlines and queue priorities are unix-seconds
+    semantics, so they read this rather than :func:`monotonic` — and
+    the fleet simulator installs its virtual axis here too (epoch 0),
+    so batch deadlines and GC cycles replay deterministically."""
+    return _impl_wall()
 
 
 def install(fn: Callable[[], float]) -> None:
@@ -44,12 +59,19 @@ def install(fn: Callable[[], float]) -> None:
     _impl = fn
 
 
+def install_wall(fn: Callable[[], float]) -> None:
+    """Install a wall-clock source (virtual epoch under the simulator)."""
+    global _impl_wall
+    _impl_wall = fn
+
+
 def reset() -> None:
-    """Restore the real ``time.monotonic`` clock."""
-    global _impl
+    """Restore the real ``time.monotonic`` / ``time.time`` clocks."""
+    global _impl, _impl_wall
     _impl = _REAL
+    _impl_wall = _REAL_WALL
 
 
 def installed() -> bool:
     """True when a non-real clock source is active."""
-    return _impl is not _REAL
+    return _impl is not _REAL or _impl_wall is not _REAL_WALL

@@ -344,8 +344,38 @@ class SchedulerConfig:
     # unified_step on and a non-MLA model (MLA latent writes keep the
     # bucketed layout).
     ragged_qlens: bool = True
+    # Batch serving tier (docs/architecture/batch-processing.md): requests
+    # at or below PriorityClass.BATCH ride the SAME continuous batch at a
+    # strictly-backfill discipline — they only consume the token-budget /
+    # page headroom interactive rows left unused this step, never
+    # displace an interactive admission, and are the first
+    # recompute-preemption victims the moment interactive load returns
+    # (interactive streams stay byte-identical batch-on vs batch-off).
+    # Off = batch-priority rows degrade to plain low-priority rows (no
+    # backfill discipline, no interactive-pressure preemption).
+    batch_backfill: bool = True
+    # Cap on concurrently RUNNING batch-band rows (0 = no dedicated cap:
+    # batch may fill whatever max_num_seqs slots interactive left idle —
+    # interactive admission reclaims them by preemption either way).
+    batch_max_seqs: int = 0
+    # Engine-side admission watermark: new batch rows are admitted only
+    # while main-pool KV utilization is at or below this fraction, so
+    # backfill never pushes the pool into the preemption regime that
+    # would thrash interactive rows (the EPP applies the same watermark
+    # fleet-side in its batch-saturation-filter).
+    batch_kv_watermark: float = 0.85
 
     def __post_init__(self) -> None:
+        if not (0.0 < self.batch_kv_watermark <= 1.0):
+            raise ValueError(
+                f"batch_kv_watermark={self.batch_kv_watermark} must be in "
+                "(0, 1] (fraction of KV pool utilization)"
+            )
+        if self.batch_max_seqs < 0:
+            raise ValueError(
+                f"batch_max_seqs={self.batch_max_seqs} must be >= 0 "
+                "(0 = no dedicated cap)"
+            )
         if self.spec_verify_window < 0:
             raise ValueError(
                 f"spec_verify_window={self.spec_verify_window} must be >= 0 "

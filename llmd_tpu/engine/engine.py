@@ -270,6 +270,16 @@ class EngineStats:
     # DIFFERENT buckets on one step is the per-row adaptive depth the
     # flattened step dispatches in one program.
     spec_row_depth_hist: tuple = ()
+    # Batch serving tier (docs/architecture/batch-processing.md): the
+    # backfill band's observability contract — waiting batch-band rows
+    # (the engine-side backlog the WVA counts as deferrable demand),
+    # tokens computed for batch rows, batch rows recompute-preempted
+    # when interactive load returned, and the fraction of the LAST
+    # step's token budget the band backfilled.
+    batch_backlog_jobs: int = 0
+    batch_tokens: int = 0
+    batch_preemptions: int = 0
+    batch_backfill_utilization: float = 0.0
     # Robustness trail (docs/architecture/fault-tolerance.md): watchdog
     # trips on the step loop, CRC-rejected KV bundles, transfers that
     # degraded to local recompute, and the per-(stage, policy)
@@ -1318,6 +1328,16 @@ class LLMEngine:
                 self.stats.swa_section_captures = s["captures"]
         self.stats.prefix_hit_ratio = self.allocator.hit_ratio()
         self.stats.preemptions = self.scheduler.num_preemptions
+        self.stats.batch_backlog_jobs = sum(
+            1 for r in self.scheduler.waiting if r.is_batch
+        )
+        self.stats.batch_tokens = self.scheduler.batch_tokens
+        self.stats.batch_preemptions = self.scheduler.num_batch_preemptions
+        self.stats.batch_backfill_utilization = round(
+            self.scheduler.last_batch_backfill_tokens
+            / max(1, self.config.scheduler.max_num_batched_tokens),
+            6,
+        )
         if self.scheduler.spec_k:
             sch = self.scheduler
             self.stats.spec_proposed_tokens_total = sch.spec_proposed_tokens

@@ -44,6 +44,25 @@ class RequestStatus(enum.Enum):
     FINISHED = enum.auto()
 
 
+class PriorityClass(enum.IntEnum):
+    """Serving bands on the ONE continuous batch.
+
+    ``priority`` stays a free integer (higher schedules first, FCFS
+    within a value); the class boundary is the contract: any request at
+    or below ``BATCH`` rides the offline backfill band — it only
+    consumes token-budget/page headroom interactive rows left unused
+    this step, never displaces an interactive admission, and is the
+    first recompute-preemption victim the moment interactive load
+    returns (docs/architecture/batch-processing.md). The serving layer
+    maps the ``x-llmd-priority: batch`` header here; the EPP's
+    batch-saturation-filter keys on the same boundary
+    (llmd_tpu.epp.types.BATCH_PRIORITY — kept numerically identical,
+    pinned by test)."""
+
+    INTERACTIVE = 0
+    BATCH = -100
+
+
 @dataclasses.dataclass
 class Request:
     """One inflight sequence.
@@ -118,6 +137,11 @@ class Request:
     # KV-transfer params produced at finish by a kv_producer engine
     # (set by the connector's finish hook; echoed in RequestOutput).
     export_params: dict[str, Any] | None = None
+
+    @property
+    def is_batch(self) -> bool:
+        """True when this request rides the offline backfill band."""
+        return self.priority <= PriorityClass.BATCH
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -160,6 +160,16 @@ class EngineScheduler:
         # verify+decode step per retry wave) instead of every step
         # paying the mixed-dispatch cost for one stray drafting row.
         self.spec_step = 0
+        # Batch serving tier (SchedulerConfig.batch_backfill;
+        # docs/architecture/batch-processing.md): rows at or below
+        # PriorityClass.BATCH backfill headroom only. Counters feed
+        # EngineStats (batch_tokens_total / batch_preemptions_total /
+        # batch_backfill_utilization).
+        self.batch_tokens = 0
+        self.num_batch_preemptions = 0
+        # Batch tokens the LAST schedule() planned (the per-step
+        # backfill-utilization gauge's numerator).
+        self.last_batch_backfill_tokens = 0
 
     # ------------------------------------------------------------------ #
     # queue management
@@ -217,6 +227,21 @@ class EngineScheduler:
 
         decoding = [r for r in self.running if r.in_decode_dispatched]
         mid_prefill = [r for r in self.running if not r.in_decode_dispatched]
+        # Batch band (PriorityClass.BATCH, SchedulerConfig.batch_backfill):
+        # batch rows are split OUT of the interactive phases and only
+        # backfill whatever budget/pages those phases leave — the
+        # interactive half of this method never sees them, which is what
+        # makes interactive streams byte-identical batch-on vs batch-off.
+        batch_decoding: list[Request] = []
+        batch_prefill: list[Request] = []
+        if self._batch_band:
+            batch_decoding = [r for r in decoding if r.is_batch]
+            batch_prefill = [r for r in mid_prefill if r.is_batch]
+            if batch_decoding:
+                decoding = [r for r in decoding if not r.is_batch]
+            if batch_prefill:
+                mid_prefill = [r for r in mid_prefill if not r.is_batch]
+        in_backfill = bool(batch_decoding or batch_prefill)
 
         # Fused K-step decode windows apply whenever this step cannot make
         # admission progress anyway (no admissible waiting request, no
@@ -225,6 +250,10 @@ class EngineScheduler:
         # the dispatch amortization pays off. Otherwise K=1 keeps admission
         # latency at one step. K is uniform across the batch (one compiled
         # program) and capped so no seq can run past max_model_len.
+        # Batch-backfill steps pin K=1: batch rows ride the same program
+        # at one-token width (a K-token fused commitment would have to be
+        # unwound the moment interactive load preempts them), and a
+        # uniform-K dispatch cannot mix widths.
         window = self.config.decode_window
         can_admit = bool(self.waiting) and len(self.running) < self.config.max_num_seqs
         k = 1
@@ -238,13 +267,19 @@ class EngineScheduler:
             # Degrading the window instead of dropping rows keeps tail
             # rows from starving behind budget-hungry window peers; no
             # candidate fitting means one-shot verify steps as before.
-            if self.spec_windows and decoding and not mid_prefill and not can_admit:
+            if (
+                self.spec_windows and decoding and not mid_prefill
+                and not can_admit and not in_backfill
+            ):
                 per_batch = (1 + self.spec_k) * len(decoding)
                 for w in reversed(self.spec_windows):
                     if w * per_batch <= budget:
                         spec_w = w
                         break
-        elif window > 1 and decoding and not mid_prefill and not can_admit:
+        elif (
+            window > 1 and decoding and not mid_prefill and not can_admit
+            and not in_backfill
+        ):
             k = max(
                 1,
                 min(
@@ -339,8 +374,26 @@ class EngineScheduler:
             budget -= chunk
 
         # 3. Admit waiting sequences (priority order, FCFS within class).
-        while self.waiting and budget > 0 and len(self.running) < self.config.max_num_seqs:
+        #    Interactive only: batch-band heads defer to the backfill
+        #    phase below, and an interactive head blocked on slots or
+        #    pages reclaims them from RUNNING batch rows first (the
+        #    "preempted the moment interactive load returns" half of the
+        #    backfill contract — recompute-preemption frees the victims'
+        #    provisional pages immediately).
+        while self.waiting and budget > 0:
             req = self.waiting[0]
+            if self._batch_band and req.is_batch:
+                break  # backfill phase owns batch admission
+            if len(self.running) >= self.config.max_num_seqs:
+                # A running batch row's slot yields to an interactive
+                # admission; without batch victims the step is full.
+                if not (
+                    self._batch_band
+                    and self._preempt_for(req, exclude=scheduled,
+                                          batch_only=True)
+                ):
+                    break
+                continue
             if req.num_computed_tokens == 0:
                 self._apply_prefix_cache(req)
             remaining = req.num_prompt_tokens - req.num_dispatched_tokens
@@ -355,7 +408,7 @@ class EngineScheduler:
                 self._reclaim_waiting_ring(req) and self._ensure_ring(req)
             ):
                 break  # out of ring pages; retry next step
-            if not self._ensure_pages(req, chunk):
+            if not self._ensure_pages_reclaiming_batch(req, chunk, scheduled):
                 # Return the ring: a still-waiting request holding R ring
                 # pages would break the pool's sizing guarantee and could
                 # stall a higher-priority arrival's admission. Safe only
@@ -374,9 +427,147 @@ class EngineScheduler:
             scheduled.add(req.request_id)
             budget -= chunk
 
+        # 4. Batch backfill: rows at or below PriorityClass.BATCH harvest
+        #    whatever token budget and pages the interactive phases left.
+        if self._batch_band and budget > 0:
+            budget = self._schedule_batch_backfill(
+                batch_decoding, batch_prefill, decodes, prefills,
+                scheduled, budget,
+            )
+        self.last_batch_backfill_tokens = sum(
+            s.num_tokens
+            for s in (*prefills, *decodes)
+            if s.request.is_batch
+        )
+
         return ScheduledBatch(
             prefills=prefills, decodes=decodes, spec_window=spec_w
         )
+
+    @property
+    def _batch_band(self) -> bool:
+        return self.config.batch_backfill
+
+    def _ensure_pages_reclaiming_batch(
+        self, req: Request, new_tokens: int, exclude: set[str]
+    ) -> bool:
+        """_ensure_pages for an INTERACTIVE request, reclaiming pages
+        from running batch rows (recompute-preemption, youngest first)
+        until the allocation fits or no batch victim remains. With no
+        batch rows running this is exactly _ensure_pages."""
+        while not self._ensure_pages(req, new_tokens):
+            if not (
+                self._batch_band
+                and self._preempt_for(req, exclude=exclude, batch_only=True)
+            ):
+                return False
+        return True
+
+    def _schedule_batch_backfill(
+        self,
+        batch_decoding: list[Request],
+        batch_prefill: list[Request],
+        decodes: list[ScheduledSeq],
+        prefills: list[ScheduledSeq],
+        scheduled: set[str],
+        budget: int,
+    ) -> int:
+        """The batch band's whole step, run strictly AFTER the
+        interactive phases (docs/architecture/batch-processing.md):
+
+        - running batch decodes ride the same dispatch at one-token
+          width (never drafting, never windowed — a wider commitment
+          would have to be unwound at the next interactive preemption);
+        - batch prefill chunks continue with leftover budget;
+        - NEW batch rows are admitted only while no interactive request
+          is blocked at the queue head, main-pool utilization is at or
+          below batch_kv_watermark, and the batch_max_seqs cap (if any)
+          has headroom.
+
+        Page pressure inside the band preempts OTHER batch rows only —
+        an interactive row is never a victim of batch work."""
+        for req in batch_decoding:
+            if (
+                req.status is not RequestStatus.RUNNING
+                or not req.in_decode_dispatched
+            ):
+                continue  # reset by a preemption earlier in this pass
+            if budget <= 0:
+                break
+            if not self._ensure_pages(req, 1):
+                if not self._preempt_for(req, exclude=scheduled,
+                                         batch_only=True):
+                    continue
+                if not self._ensure_pages(req, 1):
+                    continue
+            decodes.append(
+                ScheduledSeq(
+                    req, 1,
+                    # Spec engines: batch rows stay draft-less (cap 0)
+                    # so acceptance accounting runs but no provisional
+                    # verify columns are ever planned for them.
+                    draft_tokens=[] if self.spec_k else None,
+                    spec_draft_cap=0 if self.spec_k else None,
+                )
+            )
+            scheduled.add(req.request_id)
+            budget -= 1
+        for req in batch_prefill:
+            if req.status is not RequestStatus.RUNNING or budget <= 0:
+                continue
+            chunk = min(
+                req.num_prompt_tokens - req.num_dispatched_tokens, budget
+            )
+            if self.swa_chunk_tokens:
+                chunk = min(chunk, self.swa_chunk_tokens)
+            if chunk <= 0:
+                continue
+            if not self._ensure_pages(req, chunk):
+                continue  # wait for headroom; batch never preempts upward
+            prefills.append(ScheduledSeq(req, chunk))
+            scheduled.add(req.request_id)
+            budget -= chunk
+        while (
+            self.waiting
+            and budget > 0
+            and len(self.running) < self.config.max_num_seqs
+            and self.waiting[0].is_batch
+        ):
+            if (
+                self.config.batch_max_seqs
+                and sum(1 for r in self.running if r.is_batch)
+                >= self.config.batch_max_seqs
+            ):
+                break
+            if self.allocator.usage() > self.config.batch_kv_watermark:
+                break  # pool too hot: admitting would enter the
+                # preemption regime interactive rows pay for
+            req = self.waiting[0]
+            if req.num_computed_tokens == 0:
+                self._apply_prefix_cache(req)
+            remaining = req.num_prompt_tokens - req.num_dispatched_tokens
+            chunk = min(remaining, budget)
+            if self.swa_chunk_tokens:
+                chunk = min(chunk, self.swa_chunk_tokens)
+            if chunk <= 0:
+                break
+            if not self.config.enable_chunked_prefill and chunk < remaining:
+                break  # whole-prompt admission only
+            if not self._ensure_ring(req):
+                break  # rings are interactive capacity: never reclaimed
+            if not self._ensure_pages(req, chunk):
+                if req.swa_block_ids and req.num_computed_tokens == 0:
+                    self.swa_allocator.free(req.swa_block_ids)
+                    req.swa_block_ids = []
+                    req.swa_table_row = None
+                break  # out of pages; retry next step
+            self.waiting.pop(0)
+            req.status = RequestStatus.RUNNING
+            self.running.append(req)
+            prefills.append(ScheduledSeq(req, chunk))
+            scheduled.add(req.request_id)
+            budget -= chunk
+        return budget
 
     @staticmethod
     def _hash_extra(req: Request) -> bytes:
@@ -485,8 +676,19 @@ class EngineScheduler:
         except NoFreePagesError:
             return False
 
-    def _preempt_for(self, req: Request, exclude: set[str] = frozenset()) -> bool:
+    def _preempt_for(
+        self,
+        req: Request,
+        exclude: set[str] = frozenset(),
+        batch_only: bool = False,
+    ) -> bool:
         """Evict the youngest other running sequence to recompute later.
+
+        Victim order is (lowest priority, youngest) — batch-band rows
+        are therefore always reclaimed before any interactive row.
+        ``batch_only`` restricts the victim set to the batch band (the
+        interactive-pressure reclaim path: interactive admission must
+        never evict interactive work just to make room for itself).
 
         In-flight sequences (``protected``, async stepping) are never
         victims: the dispatched device programs still read/write their
@@ -497,10 +699,13 @@ class EngineScheduler:
             if r is not req
             and r.request_id not in exclude
             and r.request_id not in self.protected
+            and (not batch_only or r.is_batch)
         ]
         if not victims:
             return False
         victim = max(victims, key=lambda r: (r.priority * -1, r.arrival_time))
+        if victim.is_batch:
+            self.num_batch_preemptions += 1
         self._release(victim)
         self.running.remove(victim)
         # Fold generated tokens into the prompt and restart from scratch.
@@ -567,6 +772,8 @@ class EngineScheduler:
             req = seq.request
             self._commit_pending(seq)
             req.num_computed_tokens += seq.num_tokens
+            if req.is_batch:
+                self.batch_tokens += seq.num_tokens
             if req.in_decode:  # this chunk completed the prompt -> 1st token
                 if self.prefill_complete_hook is not None:
                     # Hybrid-APC capture: the ring still holds the
@@ -655,6 +862,8 @@ class EngineScheduler:
                 if reason is not None:
                     break
             accepted[req.request_id] = acc
+            if req.is_batch:
+                self.batch_tokens += len(acc)
             if reason is not None:
                 self._finish(req, reason)
             else:

@@ -58,6 +58,11 @@ DEFAULT_CONFIG: dict[str, Any] = {
     # guides/optimized-baseline/router/optimized-baseline.values.yaml:14-32).
     "plugins": [
         {"type": "healthy-filter", "name": "healthy"},
+        # Batch tier watermark admission: batch-band requests
+        # (x-llmd-priority: batch) are admitted only on replicas with
+        # real headroom; interactive requests pass through untouched
+        # (docs/architecture/batch-processing.md).
+        {"type": "batch-saturation-filter", "name": "batch-gate"},
         {"type": "queue-scorer", "name": "queue"},
         {"type": "kv-cache-utilization-scorer", "name": "kv"},
         {"type": "prefix-cache-scorer", "name": "prefix"},
@@ -69,6 +74,7 @@ DEFAULT_CONFIG: dict[str, Any] = {
             "name": "default",
             "plugins": [
                 {"pluginRef": "healthy"},
+                {"pluginRef": "batch-gate"},
                 {"pluginRef": "queue", "weight": 1.0},
                 {"pluginRef": "kv", "weight": 1.0},
                 {"pluginRef": "prefix", "weight": 3.0},

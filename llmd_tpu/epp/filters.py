@@ -7,11 +7,13 @@ import random
 from llmd_tpu.epp.plugins import Filter, register
 from llmd_tpu.epp.prefix_approx import ApproxPrefixIndex, prompt_block_hashes
 from llmd_tpu.epp.types import (
+    BATCH_PRIORITY,
     KV_CACHE_USAGE,
     ROLE_BOTH,
     ROLE_DECODE,
     ROLE_ENCODE,
     ROLE_PREFILL,
+    WAITING_QUEUE_SIZE,
     Endpoint,
     LLMRequest,
 )
@@ -116,6 +118,45 @@ class KVHeadroomFilter(Filter):
     def filter(self, req, pods):
         kept = [p for p in pods if p.attr(KV_CACHE_USAGE) <= self.max_usage]
         return kept or pods  # never filter to zero on load alone
+
+
+@register("batch-saturation-filter")
+class BatchSaturationFilter(Filter):
+    """Admit batch-band work only on replicas below a saturation
+    watermark (docs/architecture/batch-processing.md).
+
+    The router-side half of the backfill contract: a request at or
+    below BATCH_PRIORITY (the `x-llmd-priority: batch` band) may only
+    land on replicas with real headroom — KV utilization under
+    ``max_kv_usage`` AND waiting queue at or under ``max_waiting`` —
+    so offline work soaks idle decode capacity instead of queueing
+    behind interactive traffic on a busy pod. Interactive requests
+    pass through untouched.
+
+    Unlike the healthy/KV-headroom filters this one DOES filter to
+    zero on purpose: an empty candidate set turns into a retryable
+    503 at the router, and the batch processor's backoff loop
+    (batch/processor.py) re-offers the job — batch work WAITS for
+    headroom, it never displaces. Same watermark shape as the
+    SaturationGate the async processor polls (batch/asyncproc.py),
+    applied per-endpoint at pick time instead of pool-wide at
+    dispatch time.
+    """
+
+    def __init__(
+        self, max_kv_usage: float = 0.8, max_waiting: float = 0.0
+    ) -> None:
+        self.max_kv_usage = max_kv_usage
+        self.max_waiting = max_waiting
+
+    def filter(self, req, pods):
+        if req.priority > BATCH_PRIORITY:
+            return pods
+        return [
+            p for p in pods
+            if p.attr(KV_CACHE_USAGE) <= self.max_kv_usage
+            and p.attr(WAITING_QUEUE_SIZE) <= self.max_waiting
+        ]
 
 
 @register("prefix-cache-affinity-filter")

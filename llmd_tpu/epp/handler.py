@@ -15,8 +15,10 @@ import uuid
 from typing import Any
 
 from llmd_tpu.epp.types import (
+    BATCH_PRIORITY,
     HDR_FAIRNESS_ID,
     HDR_OBJECTIVE,
+    HDR_PRIORITY,
     HDR_TPOT_SLO,
     HDR_TTFT_SLO,
     LLMRequest,
@@ -49,6 +51,18 @@ def _float_hdr(h: dict[str, str], name: str) -> float | None:
         return float(v) if v is not None else None
     except ValueError:
         return None
+
+
+def _band_priority(h: dict[str, str], priority: int) -> int:
+    """Fold the batch-band header into the parsed priority: the batch
+    processor marks offline work with `x-llmd-priority: batch`
+    (docs/architecture/batch-processing.md), which clamps the request
+    to the backfill band regardless of the body's integer — batch work
+    must never smuggle itself into an interactive flow-control band by
+    omitting the field. Other header values are ignored."""
+    if h.get(HDR_PRIORITY, "").strip().lower() == "batch":
+        return min(priority, BATCH_PRIORITY)
+    return priority
 
 
 def _common_kwargs(h: dict[str, str]) -> dict[str, Any]:
@@ -171,7 +185,7 @@ def openai_parse(
         body=body,
         path=path,
         streaming=bool(body.get("stream", False)),
-        priority=priority,
+        priority=_band_priority(h, priority),
         mm_items=mm_items,
         mm_token_estimate=sum(estimate_mm_tokens(i) for i in mm_items),
         **_common_kwargs(h),
@@ -211,7 +225,7 @@ def vllmgrpc_parse(
         body=body,
         path=path,
         streaming=bool(body.get("stream", False)),
-        priority=priority,
+        priority=_band_priority(h, priority),
         **_common_kwargs(h),
     )
 
@@ -238,7 +252,7 @@ def passthrough_parse(
         body={},
         path=path,
         streaming="text/event-stream" in h.get("accept", ""),
-        priority=priority,
+        priority=_band_priority(h, priority),
         **_common_kwargs(h),
     )
 

@@ -42,6 +42,18 @@ HDR_ENCODER = "x-encoder-host-port"
 # alone; the sidecar strips any client-supplied copy of the header.
 HDR_EC_HOST = "x-llm-d-ec-host"
 HDR_DROP_REASON = "x-llm-d-request-dropped-reason"
+# Batch serving tier (docs/architecture/batch-processing.md): the batch
+# processor marks offline work with this header; parsers clamp such
+# requests to the backfill band.
+HDR_PRIORITY = "x-llmd-priority"
+# The backfill band's priority ceiling. Kept numerically identical to
+# llmd_tpu.engine.request.PriorityClass.BATCH (pinned by test) but
+# duplicated here so the EPP stays importable without the engine
+# package: requests at or below this ride the batch band — a dedicated
+# flow-control band below every interactive priority, the EPP's
+# batch-saturation-filter, and the engine scheduler's backfill-only
+# discipline.
+BATCH_PRIORITY = -100
 
 
 @dataclasses.dataclass

@@ -229,6 +229,22 @@ class SimReplica:
         self.prompt_tokens_total = 0
         self.output_tokens_total = 0
         self.recompute_fallbacks = 0
+        # Batch serving tier (docs/architecture/batch-processing.md):
+        # backfill rows ride their own accounting so interactive service
+        # times never read batch state — the stub models the engine
+        # contract (byte-identical interactive streams batch-on vs
+        # batch-off) by construction. Batch rows DO hold KV and share
+        # the decode rate, so scrapes and the EPP's saturation watermark
+        # see them.
+        self.batch_running = 0
+        self.batch_served_total = 0
+        self.batch_tokens_total = 0
+        # KV held by in-flight batch rows: part of kv_used_tokens (the
+        # scrape/EPP-visible pressure that gates watermark admission)
+        # but SUBTRACTED from the WVA collector's utilization signal —
+        # batch demand is deferrable and must never drive scale-up
+        # (docs/architecture/batch-processing.md).
+        self.batch_kv_held = 0.0
 
     # ---- failure controls -------------------------------------------- #
 
@@ -345,6 +361,47 @@ class SimReplica:
         # the prefix once the pages exist (the eager save policy —
         # deterministic, no hotness bookkeeping in the stub).
         return full_s, prefix_group
+
+    async def serve_batch(
+        self, request_id: str, prompt_tokens: int, output_tokens: int
+    ):
+        """Serve one BATCH-band request (offline backfill): same
+        yield-at-first-token generator shape as :meth:`serve`, but the
+        row never takes an interactive batch slot and is metered at
+        LEFTOVER capacity — prefill throughput scales with the
+        interactive batch's idle fraction, decode TPOT shares the
+        aggregate rate with everything running. Interactive rows never
+        read batch state, so their latencies are independent of the
+        backfill by construction (the engine-level byte-parity
+        contract, docs/architecture/batch-processing.md). Crashes cut
+        batch streams exactly like interactive ones."""
+        if not self.alive or not self.accepting:
+            raise ReplicaUnreachable(self.address)
+        p = self.profile
+        self.batch_running += 1
+        held_tokens = prompt_tokens + output_tokens
+        self.kv_used_tokens += held_tokens
+        self.batch_kv_held += held_tokens
+        try:
+            # Backfill prefill: only the idle fraction of the step is
+            # harvestable (snapshot at admission; 5% floor keeps a
+            # saturated replica from stalling the row forever — the EPP
+            # watermark should have kept it away anyway).
+            headroom = max(0.05, 1.0 - self.running / p.max_batch)
+            await self._hold(prompt_tokens / (p.prefill_tok_s * headroom))
+            yield "first-token"
+            if output_tokens > 1:
+                tpot = max(
+                    p.base_tpot_s,
+                    (self.running + self.batch_running) / p.decode_tok_s,
+                )
+                await self._hold((output_tokens - 1) * tpot)
+            self.batch_served_total += 1
+            self.batch_tokens_total += output_tokens
+        finally:
+            self.batch_running -= 1
+            self.kv_used_tokens -= held_tokens
+            self.batch_kv_held -= held_tokens
 
     async def serve(
         self,

@@ -85,6 +85,19 @@ class Scoreboard:
         self.autoscale_history: list[tuple[float, int]] = []  # (t, desired)
         self.replicas_started: list[tuple[float, str]] = []
         self.replicas_removed: list[tuple[float, str]] = []
+        # batch tier (docs/architecture/batch-processing.md) — separate
+        # from the interactive records so offline work never distorts
+        # the interactive QPS/latency/zero-lost accounting.
+        self.batch_enqueued = 0
+        self.batch_completed = 0
+        self.batch_failed = 0
+        self.batch_retries = 0
+        self.batch_hung: list[str] = []
+        self.batch_harvested_tokens = 0
+        self.batch_completed_per_replica: dict[str, int] = {}
+        self.batch_last_drain_t = 0.0
+        # (t, fleet decode utilization, batch backlog, live replicas)
+        self.util_series: list[tuple[float, float, int, int]] = []
 
     # ---- recording ---------------------------------------------------- #
 
@@ -133,6 +146,35 @@ class Scoreboard:
 
     def record_autoscale(self, t: float, desired_total: int) -> None:
         self.autoscale_history.append((t, desired_total))
+
+    # ---- batch tier ---------------------------------------------------- #
+
+    def record_batch_enqueued(self) -> None:
+        self.batch_enqueued += 1
+
+    def record_batch_completion(
+        self, address: str, output_tokens: int, t: float
+    ) -> None:
+        self.batch_completed += 1
+        self.batch_harvested_tokens += output_tokens
+        self.batch_completed_per_replica[address] = (
+            self.batch_completed_per_replica.get(address, 0) + 1
+        )
+        self.batch_last_drain_t = max(self.batch_last_drain_t, t)
+
+    def record_batch_failed(self, reason: str) -> None:
+        self.batch_failed += 1
+
+    def record_batch_retry(self) -> None:
+        self.batch_retries += 1
+
+    def record_batch_hung(self, request_id: str) -> None:
+        self.batch_hung.append(request_id)
+
+    def record_util_sample(
+        self, t: float, util: float, backlog: int, replicas: int
+    ) -> None:
+        self.util_series.append((t, util, backlog, replicas))
 
     # ---- finalize ----------------------------------------------------- #
 
@@ -255,6 +297,55 @@ class Scoreboard:
                 "removed": [[t, a] for t, a in self.replicas_removed],
             },
         }
+        if self.util_series:
+            # Trough window: the diurnal rate curve troughs at the tail
+            # of the window (cosine phase), so trough utilization is the
+            # mean over samples past 70% of the trace — the capacity
+            # interactive traffic abandons and backfill must soak. The
+            # section exists on the no-batch baseline leg too
+            # (FleetConfig.sample_util), which is what makes the
+            # floor-raised comparison measurable.
+            trough_t = 0.7 * duration_s
+            trough = [
+                u for t, u, _, _ in self.util_series
+                if trough_t <= t <= duration_s
+            ]
+            board["utilization"] = {
+                "trough_utilization": (
+                    sum(trough) / len(trough) if trough else 0.0
+                ),
+                "series": [
+                    [t, u, b, n] for t, u, b, n in self.util_series
+                ],
+            }
+        if self.batch_enqueued:
+            backlog_peak_i = 0
+            backlogs = [b for _, _, b, _ in self.util_series]
+            if backlogs:
+                backlog_peak_i = backlogs.index(max(backlogs))
+            monotone = all(
+                a >= b
+                for a, b in zip(
+                    backlogs[backlog_peak_i:], backlogs[backlog_peak_i + 1:]
+                )
+            )
+            board["batch"] = {
+                "enqueued": self.batch_enqueued,
+                "completed": self.batch_completed,
+                "failed": self.batch_failed,
+                "outstanding": (
+                    self.batch_enqueued - self.batch_completed
+                    - self.batch_failed
+                ),
+                "hung": len(self.batch_hung),
+                "retries": self.batch_retries,
+                "harvested_tokens": self.batch_harvested_tokens,
+                "last_drain_t": self.batch_last_drain_t,
+                "completed_per_replica": dict(
+                    sorted(self.batch_completed_per_replica.items())
+                ),
+                "backlog_monotone_after_peak": monotone,
+            }
         if extra:
             board.update(extra)
         results = {}
@@ -443,6 +534,58 @@ def inv_store_flow(min_published: int = 1, min_hits: int = 1) -> Invariant:
             return f"store_published {fed['store_published']} < {min_published}"
         if fed["store_hits"] < min_hits:
             return f"store_hits {fed['store_hits']} < {min_hits}"
+        return None
+    return check
+
+
+def inv_batch_drained(board: dict) -> str | None:
+    """THE backfill bar (docs/architecture/batch-processing.md): every
+    queued offline job completed through interactive troughs — nothing
+    outstanding, nothing hung, and the backlog only fell once the
+    standing queue was fully enqueued (monotone drain)."""
+    b = board.get("batch")
+    if b is None:
+        return "scoreboard carries no batch section"
+    if b["outstanding"] != 0 or b["hung"] != 0 or b["failed"] != 0:
+        return (
+            f"batch backlog not drained: outstanding={b['outstanding']} "
+            f"hung={b['hung']} failed={b['failed']}"
+        )
+    if not b["backlog_monotone_after_peak"]:
+        return "batch backlog rose after the standing queue was enqueued"
+    return None
+
+
+def inv_batch_harvest(min_tokens: int) -> Invariant:
+    """Backfill actually harvested capacity: at least ``min_tokens``
+    offline output tokens were generated."""
+    def check(board: dict) -> str | None:
+        b = board.get("batch")
+        if b is None:
+            return "scoreboard carries no batch section"
+        if b["harvested_tokens"] < min_tokens:
+            return (
+                f"harvested {b['harvested_tokens']} batch tokens "
+                f"< {min_tokens}"
+            )
+        return None
+    return check
+
+
+def inv_trough_util(min_util: float) -> Invariant:
+    """The utilization-floor bar: mean fleet decode utilization over the
+    trough window ([70%, 100%] of the trace span, where the diurnal
+    curve bottoms out) stays at or above ``min_util`` — capacity
+    interactive traffic abandoned that backfill soaked instead. The
+    no-batch baseline sits near zero there (the bench part records
+    both)."""
+    def check(board: dict) -> str | None:
+        u = board.get("utilization")
+        if u is None:
+            return "scoreboard carries no utilization section"
+        v = u["trough_utilization"]
+        if v < min_util:
+            return f"trough utilization {v:.3f} < {min_util}"
         return None
     return check
 

@@ -55,7 +55,13 @@ from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
 from llmd_tpu.epp.flow_control import Outcome
 from llmd_tpu.epp.scheduler import NoEndpointsError
 from llmd_tpu.epp.server import backoff_delay, eligible_pods
-from llmd_tpu.epp.types import KV_CACHE_USAGE, WAITING_QUEUE_SIZE, Endpoint, LLMRequest
+from llmd_tpu.epp.types import (
+    BATCH_PRIORITY,
+    KV_CACHE_USAGE,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
 from llmd_tpu.fleetsim import simloop
 from llmd_tpu.fleetsim.engines import (
     ReplicaDied,
@@ -116,6 +122,31 @@ class FleetConfig:
     idle_tail_s: float = 0.0
     autoscale: AutoscaleConfig | None = None
     model_id: str = "sim-model"
+    # Batch serving tier (docs/architecture/batch-processing.md): a
+    # standing queue of ``batch_jobs`` offline requests enqueued at
+    # t≈0 at BATCH_PRIORITY. They ride the REAL pipeline — flow-control
+    # band below every interactive priority, the production plugin
+    # chain (whose batch-saturation-filter admits them only on replicas
+    # below the watermark), the breaker — and are served by the
+    # replicas' backfill path. A router 503 (no replica below the
+    # watermark) re-offers the job after ``batch_retry_s``: batch work
+    # WAITS for troughs, it never displaces.
+    batch_jobs: int = 0
+    batch_prompt_tokens: int = 64
+    batch_output_tokens: int = 256
+    batch_retry_s: float = 1.0
+    # Per-job enqueue stagger: a standing queue drips in over
+    # jobs x stagger seconds, so later arrivals observe the saturation
+    # the earlier ones created and the watermark admission path is
+    # actually exercised (retries > 0 in the scoreboard).
+    batch_arrival_stagger_s: float = 0.1
+    # Fleet-utilization sampling cadence (armed with batch_jobs > 0 or
+    # sample_util): feeds the scoreboard's utilization/backlog series,
+    # which the trough-utilization-floor and monotone-drain invariants
+    # gate. ``sample_util`` arms the sampler without a batch queue —
+    # the no-batch baseline leg the bench part compares against.
+    util_sample_s: float = 0.5
+    sample_util: bool = False
 
 
 def default_sim_config(
@@ -187,6 +218,10 @@ class _SimWvaCollector:
         self._last_t = now
         snap = PoolSnapshot(model_id=self.fleet.cfg.model_id)
         snap.epp_queue_size = float(self.fleet.flow.queue_depth())
+        # Batch backlog = deferrable demand: the WVA floors the fleet on
+        # it instead of scaling to zero mid-drain, and never scales UP
+        # for it (docs/architecture/batch-processing.md).
+        snap.batch_backlog_upstream = float(self.fleet.batch_outstanding())
         cycle_delta = 0.0
         for pod in self.fleet.store.list():
             rep = self.fleet.replicas.get(pod.address)
@@ -196,10 +231,16 @@ class _SimWvaCollector:
                 variant=rep.variant,
                 address=rep.address,
                 ready=pod.healthy and rep.alive,
+                # Batch-held KV is excluded from the SCALING signal:
+                # backfill pressure is deferrable demand (floor, never
+                # scale-up) — the scrape/EPP surface still sees the
+                # full usage for watermark admission.
                 kv_usage=min(
-                    rep.kv_used_tokens / max(rep.profile.kv_capacity_tokens, 1),
+                    max(0.0, rep.kv_used_tokens - rep.batch_kv_held)
+                    / max(rep.profile.kv_capacity_tokens, 1),
                     1.0,
                 ),
+                batch_backlog=float(rep.batch_running),
                 queue_len=float(rep.waiting),
                 running=float(rep.running),
                 block_size=16,
@@ -283,6 +324,21 @@ class FleetSim:
         self._tasks: list[tuple[asyncio.Task, TraceRequest]] = []
         self._duration = max((r.t for r in self.trace), default=0.0)
         self.wva: WvaEngine | None = None
+        # Batch tier: the standing offline queue (separate from the
+        # interactive trace so interactive accounting — zero_lost, QPS,
+        # latency percentiles — stays untouched by offline work).
+        self.batch_trace: list[TraceRequest] = [
+            TraceRequest(
+                t=i * cfg.batch_arrival_stagger_s,
+                request_id=f"batch-{i:05d}",
+                tenant="batch",
+                prompt_tokens=cfg.batch_prompt_tokens,
+                output_tokens=cfg.batch_output_tokens,
+                priority=BATCH_PRIORITY,
+            )
+            for i in range(cfg.batch_jobs)
+        ]
+        self._batch_tasks: list[tuple[asyncio.Task, TraceRequest]] = []
 
     # ---- fleet membership -------------------------------------------- #
 
@@ -493,6 +549,116 @@ class FleetSim:
                 )
         self.board.record_outcome(treq.tenant, "all-endpoints-failed")
 
+    # ---- the batch tier (offline backfill) ---------------------------- #
+
+    def batch_outstanding(self) -> int:
+        """Jobs enqueued but not yet terminally completed/failed — the
+        backlog the WVA counts as deferrable demand."""
+        b = self.board
+        return b.batch_enqueued - b.batch_completed - b.batch_failed
+
+    async def _route_batch(self, req: LLMRequest, treq: TraceRequest) -> bool:
+        """One offer of a batch job to the fleet through the REAL
+        scheduler (the production chain's batch-saturation-filter gates
+        it by watermark). False = nothing below the watermark / the pick
+        failed — the caller re-offers after a backoff; offline jobs are
+        idempotent, so a cut stream simply retries whole."""
+        pods = eligible_pods(self.store.list(), set(), self.breaker)
+        try:
+            result = self.scheduler.schedule(req, pods)
+        except NoEndpointsError:
+            return False
+        pod = result.primary
+        if not self.breaker.take_probe(pod.address):
+            return False
+        replica = self.replicas.get(pod.address)
+        pod.inflight += 1
+        pod.inflight_tokens += req.approx_prompt_tokens
+        try:
+            if replica is None:
+                raise ReplicaUnreachable(pod.address)
+            async for _ in replica.serve_batch(
+                req.request_id, treq.prompt_tokens, treq.output_tokens
+            ):
+                pass
+            self.breaker.record_success(pod.address)
+            self.board.record_batch_completion(
+                pod.address, treq.output_tokens, clock.monotonic()
+            )
+            return True
+        except (ReplicaUnreachable, ReplicaDied):
+            self.breaker.record_failure(pod.address)
+            return False
+        finally:
+            pod.inflight = max(0, pod.inflight - 1)
+            pod.inflight_tokens = max(
+                0, pod.inflight_tokens - req.approx_prompt_tokens
+            )
+
+    async def _handle_batch(self, treq: TraceRequest) -> None:
+        self.board.record_batch_enqueued()
+        attempts = 0
+        while True:
+            req = LLMRequest(
+                request_id=f"{treq.request_id}-a{attempts}",
+                model=self.cfg.model_id,
+                prompt_text=self._prompt_text(treq),
+                priority=treq.priority,
+                fairness_id=treq.tenant,
+            )
+            outcome = await self.flow.enqueue_and_wait(
+                req, nbytes=treq.prompt_tokens
+            )
+            if outcome is Outcome.DISPATCHED:
+                try:
+                    if await self._route_batch(req, treq):
+                        return
+                finally:
+                    self.flow.release()
+            elif outcome is Outcome.EVICTED_SHUTDOWN:
+                self.board.record_batch_failed(outcome.value)
+                return
+            # capacity-rejected / TTL-evicted / above-watermark: the job
+            # stays in the backlog and re-offers after the backoff.
+            attempts += 1
+            self.board.record_batch_retry()
+            await asyncio.sleep(self.cfg.batch_retry_s)
+
+    async def _pump_batch(self) -> None:
+        loop = asyncio.get_event_loop()
+        for treq in self.batch_trace:
+            delay = treq.t - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self._batch_tasks.append(
+                (asyncio.ensure_future(self._handle_batch(treq)), treq)
+            )
+
+    async def _util_ticker(self) -> None:
+        """Samples fleet decode utilization (interactive + batch output
+        tokens served per unit of live decode capacity) and the batch
+        backlog — the series behind the trough-utilization-floor and
+        monotone-drain invariants."""
+        prev = 0.0
+        while True:
+            await asyncio.sleep(self.cfg.util_sample_s)
+            reps = [r for r in self.replicas.values() if r.alive]
+            served = sum(
+                r.output_tokens_total + r.batch_tokens_total
+                for r in self.replicas.values()
+            )
+            cap = (
+                max(1, len(reps))
+                * self.cfg.profile.decode_tok_s
+                * self.cfg.util_sample_s
+            )
+            util = max(0.0, served - prev) / cap
+            prev = served
+            self.board.record_util_sample(
+                clock.monotonic(), util, self.batch_outstanding(),
+                len(reps),
+            )
+
     # ---- the run ------------------------------------------------------ #
 
     async def _pump(self) -> None:
@@ -535,6 +701,11 @@ class FleetSim:
             collector.start()
             self.flow.start()
             chaos = asyncio.ensure_future(self._chaos_ticker())
+            batch_pump = util_task = None
+            if self.cfg.batch_jobs or self.cfg.sample_util:
+                util_task = asyncio.ensure_future(self._util_ticker())
+            if self.cfg.batch_jobs:
+                batch_pump = asyncio.ensure_future(self._pump_batch())
             if self.cfg.autoscale is not None:
                 asc = self.cfg.autoscale
                 wva_collector = _SimWvaCollector(self, asc.retention_s)
@@ -569,9 +740,26 @@ class FleetSim:
                         exc = task.exception()
                         if exc is not None:
                             raise exc
+            if batch_pump is not None:
+                await batch_pump
+            if self._batch_tasks:
+                done, pending = await asyncio.wait(
+                    [t for t, _ in self._batch_tasks],
+                    timeout=self.cfg.grace_s,
+                )
+                for task, treq in self._batch_tasks:
+                    if task in pending:
+                        self.board.record_batch_hung(treq.request_id)
+                        task.cancel()
+                    elif task.done() and not task.cancelled():
+                        exc = task.exception()
+                        if exc is not None:
+                            raise exc
             if self.cfg.idle_tail_s > 0:
                 await asyncio.sleep(self.cfg.idle_tail_s)
             chaos.cancel()
+            if util_task is not None:
+                util_task.cancel()
             if self.wva is not None:
                 await self.wva.stop()
             await collector.stop()

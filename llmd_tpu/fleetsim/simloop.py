@@ -103,6 +103,9 @@ def run(main: Coroutine, install_clock: bool = True) -> Any:
     try:
         if install_clock:
             clock.install(loop.time)
+            # The wall seam rides the same virtual axis (epoch 0): batch
+            # deadlines/timestamps then replay deterministically too.
+            clock.install_wall(loop.time)
         asyncio.set_event_loop(loop)
         return loop.run_until_complete(main)
     finally:

@@ -108,6 +108,9 @@ def make_engine_config(args, lora_adapters=None):
             spec_verify_window=args.spec_verify_window,
             unified_step=args.unified_step,
             ragged_qlens=args.ragged_qlens,
+            batch_backfill=args.batch_backfill,
+            batch_max_seqs=args.batch_max_seqs,
+            batch_kv_watermark=args.batch_kv_watermark,
         ),
         parallel=ParallelConfig(
             tensor_parallel_size=args.tensor_parallel_size,
@@ -240,6 +243,33 @@ def build_parser() -> argparse.ArgumentParser:
              "--no-ragged-qlens restores the bucketed unified program. "
              "Greedy and seeded streams are byte-identical either way "
              "(docs/architecture/async-scheduling.md)",
+    )
+    p.add_argument(
+        "--batch-backfill", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="batch serving tier: requests at or below "
+             "PriorityClass.BATCH (the x-llmd-priority: batch header) "
+             "ride the SAME continuous batch but only backfill "
+             "token-budget/page headroom interactive rows left unused, "
+             "never displace an interactive admission, and are "
+             "recompute-preempted the moment interactive load returns; "
+             "interactive streams stay byte-identical batch-on vs "
+             "batch-off. --no-batch-backfill degrades batch-priority "
+             "rows to plain low-priority rows "
+             "(docs/architecture/batch-processing.md)",
+    )
+    p.add_argument(
+        "--batch-max-seqs", type=int, default=0,
+        help="cap on concurrently RUNNING batch-band rows (0 = no "
+             "dedicated cap: batch may fill whatever --max-num-seqs "
+             "slots interactive left idle)",
+    )
+    p.add_argument(
+        "--batch-kv-watermark", type=float, default=0.85,
+        help="admit new batch-band rows only while main-pool KV "
+             "utilization is at or below this fraction, so backfill "
+             "never pushes the pool into the preemption regime "
+             "interactive rows pay for",
     )
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)

@@ -27,8 +27,8 @@ from typing import Any
 import pydantic
 from aiohttp import web
 
-from llmd_tpu.engine.request import RequestOutput, SamplingParams
-from llmd_tpu.epp.types import HDR_EC_HOST
+from llmd_tpu.engine.request import PriorityClass, RequestOutput, SamplingParams
+from llmd_tpu.epp.types import HDR_EC_HOST, HDR_PRIORITY
 from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.serve import protocol as P
 from llmd_tpu.serve.async_engine import (
@@ -252,6 +252,20 @@ def _request_deadline_s(request: web.Request) -> float | None:
     except ValueError:
         return None
     return v if v > 0 else None
+
+
+def _effective_priority(request: web.Request, body_priority: int) -> int:
+    """Fold the batch-band header into the request's priority.
+
+    `x-llmd-priority: batch` (sent by the batch processor,
+    docs/architecture/batch-processing.md) clamps the request to the
+    offline backfill band (PriorityClass.BATCH) regardless of what the
+    body claimed — a batch job must never smuggle itself into the
+    interactive band by omitting the body field. Other header values
+    are ignored (the body integer stands)."""
+    if request.headers.get(HDR_PRIORITY, "").strip().lower() == "batch":
+        return min(int(body_priority), int(PriorityClass.BATCH))
+    return int(body_priority)
 
 
 async def handle_health(request: web.Request) -> web.Response:
@@ -741,6 +755,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     span.set("gen_ai.usage.prompt_tokens", len(prompt_ids))
     span.set("llm_d.request.streaming", bool(req.stream))
     deadline_s = _request_deadline_s(request)
+    priority = _effective_priority(request, req.priority)
 
     if req.stream:
         try:
@@ -748,12 +763,12 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
                 return await _stream_response_multi(
                     request, engine, rid, model, prompt_ids, sampling,
                     tokenizer, P.stop_strings(req.stop), req.n,
-                    req.priority, req.kv_transfer_params, chat, span,
+                    priority, req.kv_transfer_params, chat, span,
                     lora_id, lora_name, deadline_s,
                 )
             return await _stream_response(
                 request, engine, rid, model, prompt_ids, sampling, detok,
-                req.priority, req.kv_transfer_params, chat, span,
+                priority, req.kv_transfer_params, chat, span,
                 lora_id, lora_name, deadline_s,
             )
         except BaseException as e:
@@ -764,7 +779,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     try:
         if req.n == 1:
             choices = [await _collect(
-                engine, rid, prompt_ids, sampling, detok, req.priority,
+                engine, rid, prompt_ids, sampling, detok, priority,
                 req.kv_transfer_params, lora_id, lora_name, deadline_s,
             )]
         else:
@@ -784,7 +799,7 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
                 return await _collect(
                     engine, f"{rid}-{i}", prompt_ids, sp,
                     Detokenizer(tokenizer, P.stop_strings(req.stop)),
-                    req.priority,
+                    priority,
                     req.kv_transfer_params if i == 0 else None,
                     lora_id, lora_name, deadline_s,
                 )

@@ -50,6 +50,14 @@ def render_metrics(
             stats.dispatches_per_emitted_token, 6
         ),
     }
+    # Batch serving tier (docs/architecture/batch-processing.md): the
+    # backfill band's scrape surface — backlog is what the WVA counts as
+    # deferrable demand (floor-not-scale-up), utilization is the LAST
+    # step's budget fraction the band harvested.
+    gauges["batch_backlog_jobs"] = stats.batch_backlog_jobs
+    gauges["batch_backfill_utilization"] = round(
+        stats.batch_backfill_utilization, 6
+    )
     if stats.swa_ring_pages:
         gauges["swa_ring_usage_perc"] = round(stats.swa_ring_usage, 6)
         gauges["swa_ring_pages"] = stats.swa_ring_pages
@@ -67,6 +75,10 @@ def render_metrics(
         "num_preemptions_total": stats.preemptions,
         "kv_offload_saves_total": stats.offload_saves,
         "kv_offload_restores_total": stats.offload_restores,
+        # Batch tier counters: tokens the band backfilled and batch rows
+        # recompute-preempted when interactive load returned.
+        "batch_tokens_total": stats.batch_tokens,
+        "batch_preemptions_total": stats.batch_preemptions,
         # Cross-replica KV federation (kv-federation.md): store-client
         # reads (peer pulls / failures / locate misses), publications
         # the master accepted, pages fetched from the store, and the

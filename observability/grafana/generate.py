@@ -334,6 +334,39 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
                    "in the flattened stream — hot-draft rows run deep "
                    "while backed-off rows run depth 1 in the SAME "
                    "program; stuck at 1 = drafting never engages."),
+        row("Batch tier (offline backfill)"),
+        panel("Batch backlog (jobs)",
+              [f"llmd:batch_backlog_jobs{M}"],
+              thresholds=[(None, "green"), (1000, "yellow")],
+              desc="Waiting batch-band rows — the deferrable demand the "
+                   "WVA floors the fleet on instead of scaling up for "
+                   "(docs/architecture/batch-processing.md). Growing "
+                   "through troughs = backfill is not draining (check "
+                   "the EPP batch-saturation-filter watermark)."),
+        panel("Batch harvest tok/s",
+              [f"rate(llmd:batch_tokens_total{M}[5m])",
+               f"rate(vllm:generation_tokens_total{M}[5m])"],
+              legends=["batch tok/s", "all gen tok/s"],
+              desc="Tokens the backfill band computed vs total "
+                   "generation — the utilization the batch tier "
+                   "harvests from idle decode capacity at zero "
+                   "interactive cost."),
+        panel("Backfill utilization (last step)",
+              [f"llmd:batch_backfill_utilization{M}"],
+              unit="percentunit", max1=True,
+              desc="Fraction of the last step's token budget backfilled "
+                   "by batch rows. High through interactive peaks means "
+                   "the watermark is too loose; zero with a backlog "
+                   "means interactive traffic leaves no headroom (as "
+                   "designed) or admission is wedged."),
+        panel("Batch preemptions /s",
+              [f"rate(llmd:batch_preemptions_total{M}[5m])"],
+              thresholds=[(None, "green"), (5, "yellow")],
+              desc="Batch rows recompute-preempted the moment "
+                   "interactive load returned — the contract working. "
+                   "A sustained surge means batch admission is fighting "
+                   "interactive arrivals (lower --batch-kv-watermark or "
+                   "--batch-max-seqs)."),
         row("Health"),
         panel("Preemptions /s", [f"rate(vllm:num_preemptions_total{M}[5m])"],
               thresholds=[(None, "green"), (0.5, "yellow"), (2, "red")],

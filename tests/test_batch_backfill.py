@@ -1,0 +1,510 @@
+"""Batch serving tier (docs/architecture/batch-processing.md): the
+PriorityClass.BATCH backfill band across all four layers.
+
+The acceptance-critical pins:
+
+- ENGINE: interactive token streams are BYTE-IDENTICAL batch-on vs
+  batch-off (greedy and seeded) — backfill may harvest headroom, never
+  change interactive numerics or scheduling outcomes;
+- scheduler discipline: batch rows only consume leftover token budget,
+  never displace an interactive admission, are recompute-preempted the
+  moment interactive load returns, and never evict interactive rows;
+- EPP: the batch-saturation-filter admits batch work only on replicas
+  below the watermark; the x-llmd-priority header clamps to the band;
+- WVA: batch backlog floors the fleet (deferrable demand), never
+  scales it up;
+- fleetsim: the batch_backfill scenario is byte-deterministic and its
+  invariants (drain, utilization floor, interactive p99) hold.
+"""
+
+import asyncio
+
+import pytest
+
+from llmd_tpu.config import CacheConfig, SchedulerConfig
+from llmd_tpu.engine.kv_cache import PageAllocator
+from llmd_tpu.engine.request import (
+    PriorityClass,
+    Request,
+    RequestStatus,
+    SamplingParams,
+)
+from llmd_tpu.engine.scheduler import EngineScheduler
+from llmd_tpu.epp.types import (
+    BATCH_PRIORITY,
+    KV_CACHE_USAGE,
+    WAITING_QUEUE_SIZE,
+    Endpoint,
+    LLMRequest,
+)
+
+BATCH = int(PriorityClass.BATCH)
+
+
+def test_priority_class_matches_epp_constant():
+    """The engine band boundary and the EPP's accelerator-free copy must
+    stay numerically identical (both layers gate on it)."""
+    assert BATCH_PRIORITY == int(PriorityClass.BATCH)
+    assert Request("r", [1], priority=BATCH).is_batch
+    assert not Request("r", [1], priority=BATCH + 1).is_batch
+
+
+# ------------------------------------------------------------------ #
+# scheduler discipline (jax-free: host-side scheduler + allocator)
+
+
+def make_sched(
+    max_seqs=4, budget=16, pages=16, page=4, max_model_len=128, **kw
+) -> EngineScheduler:
+    sc = SchedulerConfig(
+        max_num_seqs=max_seqs, max_num_batched_tokens=budget, **kw
+    )
+    cc = CacheConfig(page_size=page, num_blocks=pages)
+    alloc = PageAllocator(
+        num_pages=pages, page_size=page, enable_prefix_caching=False
+    )
+    return EngineScheduler(sc, cc, alloc, max_model_len=max_model_len)
+
+
+def req(rid, n=4, priority=0, max_tokens=64) -> Request:
+    return Request(
+        request_id=rid,
+        prompt_token_ids=list(range(1, n + 1)),
+        sampling=SamplingParams(max_tokens=max_tokens, ignore_eos=True),
+        priority=priority,
+    )
+
+
+def step(sched, token=7):
+    batch = sched.schedule()
+    sampled = {s.request.request_id: [token] for s in batch.seqs}
+    sched.update_after_step(batch, sampled)
+    return batch
+
+
+def test_batch_backfills_leftover_budget_only():
+    sched = make_sched(budget=8)
+    sched.add_request(req("i0", n=8))
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    b1 = sched.schedule()
+    # The interactive prompt consumes the whole budget: no batch row.
+    assert [s.request.request_id for s in b1.prefills] == ["i0"]
+    assert sched.last_batch_backfill_tokens == 0
+    sched.update_after_step(b1, {"i0": [7]})
+    # Next step: i0 decodes (1 token), 7 tokens of headroom -> b0 rides.
+    b2 = sched.schedule()
+    ids = {s.request.request_id for s in b2.seqs}
+    assert ids == {"i0", "b0"}
+    assert sched.last_batch_backfill_tokens == 4  # b0's whole prompt
+
+
+def test_batch_never_displaces_blocked_interactive_head():
+    # i0 runs and holds pages; interactive i1 needs more pages than
+    # remain; batch b0 queued behind it could fit a small chunk — but
+    # admitting it would consume pages the blocked interactive head is
+    # waiting for.
+    sched = make_sched(pages=4, page=4, budget=64)
+    sched.add_request(req("i0", n=8))
+    step(sched)  # i0 fully prefilled (2 pages), now decoding
+    sched.add_request(req("i1", n=12))  # needs 3 pages; 2 remain
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    b = sched.schedule()
+    scheduled = {s.request.request_id for s in b.seqs}
+    assert "b0" not in scheduled
+    assert "i1" not in scheduled  # blocked on pages, retries next step
+
+
+def test_interactive_admission_preempts_batch_slots():
+    sched = make_sched(max_seqs=2, budget=64)
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    sched.add_request(req("b1", n=4, priority=BATCH))
+    step(sched)  # both batch rows admitted into the 2 slots
+    assert sched.num_running == 2
+    sched.add_request(req("i0", n=4))
+    b = sched.schedule()
+    assert "i0" in {s.request.request_id for s in b.prefills}
+    assert sched.num_batch_preemptions == 1
+    # The victim went back to waiting via recompute-preemption.
+    preempted = [r for r in sched.waiting if r.is_batch]
+    assert len(preempted) == 1
+    assert preempted[0].status is RequestStatus.PREEMPTED
+    assert preempted[0].block_ids == []  # provisional pages freed
+
+
+def test_interactive_page_pressure_reclaims_batch_first():
+    # Fill the pool with one interactive and one batch sequence (7-token
+    # prompts: their next decode slots still fit their 2nd pages), then
+    # admit an interactive that needs the batch row's pages.
+    sched = make_sched(pages=4, page=4, budget=64, max_seqs=4)
+    sched.add_request(req("i0", n=7))   # 2 pages
+    sched.add_request(req("b0", n=7, priority=BATCH))  # 2 pages
+    step(sched)
+    assert sched.num_running == 2
+    sched.add_request(req("i1", n=8))   # needs 2 pages; 0 free
+    b = sched.schedule()
+    assert "i1" in {s.request.request_id for s in b.prefills}
+    assert sched.num_batch_preemptions == 1
+    # The interactive i0 was never the victim.
+    assert all(
+        r.request_id != "i0" for r in sched.waiting
+    ) and any(r.request_id == "i0" for r in sched.running)
+
+
+def test_batch_never_preempts_interactive():
+    # Pool-full growth: as both rows decode past their pages, EVERY
+    # eviction victim must be the batch row — page pressure created by
+    # (or for) batch work never costs an interactive sequence.
+    sched = make_sched(pages=4, page=4, budget=64, max_seqs=4)
+    sched.add_request(req("b0", n=7, priority=BATCH))  # 2 pages
+    step(sched)  # b0 running (decode next)
+    sched.add_request(req("i0", n=7))  # 2 pages -> pool full
+    for _ in range(8):
+        step(sched)
+    # Any preemption that happened reclaimed the BATCH row only, and
+    # the interactive row rode through untouched.
+    assert sched.num_preemptions == sched.num_batch_preemptions
+    assert any(
+        r.request_id == "i0" and r.status is RequestStatus.RUNNING
+        for r in sched.running
+    )
+
+
+def test_batch_admission_respects_kv_watermark():
+    sched = make_sched(pages=8, page=4, budget=64, batch_kv_watermark=0.5)
+    sched.add_request(req("i0", n=20))  # 5 of 8 pages -> usage 0.625
+    step(sched)
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    b = sched.schedule()
+    assert "b0" not in {s.request.request_id for s in b.seqs}
+
+
+def test_batch_max_seqs_cap():
+    sched = make_sched(max_seqs=4, budget=64, batch_max_seqs=1)
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    sched.add_request(req("b1", n=4, priority=BATCH))
+    b = sched.schedule()
+    assert [s.request.request_id for s in b.prefills] == ["b0"]
+
+
+def test_backfill_regime_pins_fused_windows_to_one():
+    sched = make_sched(budget=64, decode_window=4)
+    sched.add_request(req("i0", n=4))
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    step(sched)  # both prefilled
+    b = sched.schedule()  # pure-decode step, no waiting
+    assert b.decodes and all(s.num_tokens == 1 for s in b.decodes)
+    # Without batch rows the same shape fuses the window.
+    sched2 = make_sched(budget=64, decode_window=4)
+    sched2.add_request(req("i0", n=4))
+    step(sched2)
+    b2 = sched2.schedule()
+    assert b2.decodes and b2.decodes[0].num_tokens == 4
+
+
+def test_batch_token_accounting():
+    sched = make_sched(budget=64)
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    step(sched)       # prefill: 4 batch tokens
+    step(sched)       # decode: 1 batch token
+    assert sched.batch_tokens == 5
+    assert sched.last_batch_backfill_tokens == 1
+
+
+def test_no_batch_band_flag_degrades_to_plain_priority():
+    sched = make_sched(max_seqs=2, budget=64, batch_backfill=False)
+    sched.add_request(req("b0", n=4, priority=BATCH))
+    b = sched.schedule()
+    # Plain low-priority admission: the head is admitted normally.
+    assert [s.request.request_id for s in b.prefills] == ["b0"]
+
+
+# ------------------------------------------------------------------ #
+# engine-level byte parity (the tentpole contract)
+
+
+def _run_interactive(with_batch: bool, sampling: SamplingParams):
+    from tests.test_engine import make_engine
+
+    eng = make_engine(num_blocks=64, max_batched=16, max_seqs=8)
+    prompts = [[1, 5, 9, 13, 2, 8], [3, 3, 7, 1], [9, 2, 9, 2, 9, 2, 5]]
+    rids = [eng.add_request(p, sampling) for p in prompts]
+    if with_batch:
+        for i in range(3):
+            eng.add_request(
+                [2 + i, 4, 6, 8],
+                SamplingParams(
+                    temperature=0.0, max_tokens=10, ignore_eos=True
+                ),
+                priority=BATCH,
+            )
+    outs: dict = {}
+    for _ in range(2000):
+        if not eng.has_work():
+            break
+        for out in eng.step():
+            outs.setdefault(out.request_id, []).extend(out.new_token_ids)
+    assert not eng.has_work()
+    if with_batch:
+        # The batch rows actually ran (the comparison is not vacuous).
+        assert eng.scheduler.batch_tokens > 0
+    return [outs[r] for r in rids]
+
+
+@pytest.mark.parametrize(
+    "sampling",
+    [
+        SamplingParams(temperature=0.0, max_tokens=8),
+        SamplingParams(temperature=0.9, max_tokens=8, seed=1234),
+    ],
+    ids=["greedy", "seeded"],
+)
+def test_interactive_streams_byte_identical_with_batch_load(sampling):
+    """THE engine acceptance bar: adding batch-band rows to the SAME
+    continuous batch changes nothing about interactive outputs."""
+    assert _run_interactive(False, sampling) == _run_interactive(
+        True, sampling
+    )
+
+
+def test_engine_stats_and_metrics_surface():
+    from tests.test_engine import make_engine
+
+    from llmd_tpu.serve.metrics import render_metrics
+
+    eng = make_engine(num_blocks=64, max_batched=16, max_seqs=8)
+    eng.add_request(
+        [1, 2, 3, 4],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True),
+        priority=BATCH,
+    )
+    eng.add_request([5, 6, 7], SamplingParams(temperature=0.0, max_tokens=4))
+    while eng.has_work():
+        eng.step()
+    assert eng.stats.batch_tokens > 0
+    assert eng.stats.batch_backlog_jobs == 0  # drained
+    text = render_metrics(eng.stats, "tiny")
+    for name in (
+        "vllm:batch_backlog_jobs",
+        "llmd:batch_tokens_total",
+        "llmd:batch_preemptions_total",
+        "llmd:batch_backfill_utilization",
+    ):
+        assert name in text, name
+
+
+# ------------------------------------------------------------------ #
+# EPP: header clamp + watermark filter
+
+
+def test_openai_parser_clamps_batch_header_to_band():
+    from llmd_tpu.epp.handler import openai_parse
+
+    r = openai_parse(
+        "/v1/completions",
+        {"x-llmd-priority": "batch"},
+        b'{"model": "m", "prompt": "hi"}',
+    )
+    assert r.priority == BATCH_PRIORITY
+    # A body priority BELOW the band is kept (min, not overwrite)...
+    r2 = openai_parse(
+        "/v1/completions",
+        {"x-llmd-priority": "batch"},
+        b'{"model": "m", "prompt": "hi", "priority": -500}',
+    )
+    assert r2.priority == -500
+    # ...and without the header the body integer stands.
+    r3 = openai_parse(
+        "/v1/completions", {}, b'{"model": "m", "prompt": "hi"}'
+    )
+    assert r3.priority == 0
+
+
+def test_serve_api_effective_priority_header():
+    from aiohttp.test_utils import make_mocked_request
+
+    from llmd_tpu.serve.api import _effective_priority
+
+    r = make_mocked_request(
+        "POST", "/v1/completions", headers={"x-llmd-priority": "batch"}
+    )
+    assert _effective_priority(r, 0) == BATCH
+    assert _effective_priority(r, -500) == -500
+    plain = make_mocked_request("POST", "/v1/completions")
+    assert _effective_priority(plain, 3) == 3
+
+
+def test_batch_saturation_filter_watermark():
+    from llmd_tpu.epp.filters import BatchSaturationFilter
+
+    cold = Endpoint(
+        address="cold:8000",
+        attrs={KV_CACHE_USAGE: 0.2, WAITING_QUEUE_SIZE: 0.0},
+    )
+    hot = Endpoint(
+        address="hot:8000",
+        attrs={KV_CACHE_USAGE: 0.9, WAITING_QUEUE_SIZE: 0.0},
+    )
+    queued = Endpoint(
+        address="queued:8000",
+        attrs={KV_CACHE_USAGE: 0.2, WAITING_QUEUE_SIZE: 3.0},
+    )
+    pods = [cold, hot, queued]
+    f = BatchSaturationFilter(max_kv_usage=0.8, max_waiting=0.0)
+    batch_req = LLMRequest(request_id="b", priority=BATCH_PRIORITY)
+    assert f.filter(batch_req, pods) == [cold]
+    # Interactive traffic passes through untouched.
+    inter = LLMRequest(request_id="i", priority=0)
+    assert f.filter(inter, pods) == pods
+    # Every replica above the watermark: batch WAITS (empty -> 503 ->
+    # the processor's backoff loop re-offers), it never displaces.
+    assert f.filter(batch_req, [hot, queued]) == []
+
+
+def test_default_config_chain_carries_batch_gate():
+    from llmd_tpu.epp.config import DEFAULT_CONFIG, build_scheduler, find_plugins
+    from llmd_tpu.epp.filters import BatchSaturationFilter
+
+    sched = build_scheduler(DEFAULT_CONFIG)
+    assert find_plugins(sched, BatchSaturationFilter)
+
+
+# ------------------------------------------------------------------ #
+# WVA: backlog floors the fleet, never scales it up
+
+
+class _StubCollector:
+    def __init__(self, backlog: float) -> None:
+        self.backlog = backlog
+
+    async def collect(self):
+        from llmd_tpu.autoscale.types import PoolSnapshot
+
+        snap = PoolSnapshot(model_id="m")
+        snap.batch_backlog_upstream = self.backlog
+        snap.recent_request_count = 0.0
+        return snap
+
+    async def epp_queue_size(self) -> float:
+        return 0.0
+
+
+def _wva_cycle(backlog: float):
+    from llmd_tpu.autoscale.engine import WvaEngine
+    from llmd_tpu.autoscale.types import VariantSpec
+
+    eng = WvaEngine(
+        _StubCollector(backlog),
+        {"m": [VariantSpec(name="v", cost=1.0)]},
+        scale_to_zero=True,
+    )
+    return asyncio.run(eng.run_cycle()), eng
+
+
+def test_wva_batch_backlog_floors_fleet():
+    decisions, eng = _wva_cycle(backlog=12.0)
+    assert sum(d.desired_replicas for d in decisions) == 1
+    assert any("batch-backlog-floor" in d.reason for d in decisions)
+    # Floor only — backlog never scales the fleet UP past it.
+    assert max(d.desired_replicas for d in decisions) == 1
+
+
+def test_wva_no_backlog_allows_zero():
+    decisions, eng = _wva_cycle(backlog=0.0)
+    assert sum(d.desired_replicas for d in decisions) == 0
+
+
+def test_pool_snapshot_batch_backlog_sums_tiers():
+    from llmd_tpu.autoscale.types import PoolSnapshot, ReplicaMetrics
+
+    snap = PoolSnapshot(model_id="m")
+    snap.batch_backlog_upstream = 3.0
+    snap.replicas = [
+        ReplicaMetrics(variant="v", batch_backlog=2.0),
+        ReplicaMetrics(variant="v", batch_backlog=1.0),
+    ]
+    assert snap.batch_backlog == 6.0
+
+
+# ------------------------------------------------------------------ #
+# batch gateway probe contract (/health vs /readyz + drain)
+
+
+@pytest.mark.anyio
+async def test_gateway_probe_contract(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llmd_tpu.batch.gateway import build_gateway_app
+    from llmd_tpu.batch.store import BatchStore, FileStore
+
+    store, files = BatchStore(":memory:"), FileStore(tmp_path / "f")
+    app = build_gateway_app(store, files)
+    c = TestClient(TestServer(app))
+    await c.start_server()
+    try:
+        assert (await c.get("/readyz")).status == 200
+        assert (await c.get("/health")).status == 200
+        up = await c.post("/v1/files", data=_jsonl_one())
+        assert up.status == 200
+        meta = await up.json()
+        app["gateway"].begin_drain()
+        # Readiness flips while the socket still serves...
+        assert (await c.get("/readyz")).status == 503
+        # ...liveness stays green (restarting would abandon work)...
+        assert (await c.get("/health")).status == 200
+        # ...new jobs are refused retryably...
+        assert (await c.post("/v1/files", data=_jsonl_one())).status == 503
+        r = await c.post(
+            "/v1/batches",
+            json={"input_file_id": meta["id"],
+                  "endpoint": "/v1/completions"},
+        )
+        assert r.status == 503
+        # ...and reads still work through the drain.
+        assert (await c.get(f"/v1/files/{meta['id']}")).status == 200
+    finally:
+        await c.close()
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def _jsonl_one() -> bytes:
+    import json
+
+    return json.dumps({
+        "custom_id": "r0", "method": "POST", "url": "/v1/completions",
+        "body": {"model": "m", "prompt": "p"},
+    }).encode()
+
+
+# ------------------------------------------------------------------ #
+# fleetsim: the batch_backfill scenario
+
+
+def test_batch_backfill_scenario_invariants_and_determinism():
+    from llmd_tpu.fleetsim.scenarios import SCENARIOS, build_batch_backfill
+    from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+
+    a = SCENARIOS["batch_backfill"].build(0, 0.25).run()
+    b = SCENARIOS["batch_backfill"].build(0, 0.25).run()
+    assert to_canonical_json(a) == to_canonical_json(b)
+    assert a["ok"], a["invariants"]
+    bt = a["batch"]
+    assert bt["outstanding"] == 0 and bt["hung"] == 0
+    assert bt["backlog_monotone_after_peak"]
+    assert bt["harvested_tokens"] >= bt["enqueued"] * 200
+    # The no-batch baseline leg: same interactive trace, lower trough
+    # utilization, and (nothing deferring the trough) scale-to-zero.
+    base = build_batch_backfill(0, 0.25, batch=False).run()
+    assert base["ok"], base["invariants"]
+    assert "batch" not in base
+    assert (
+        a["utilization"]["trough_utilization"]
+        > base["utilization"]["trough_utilization"]
+    )
+    # Interactive latency within noise of the baseline (virtual time).
+    p99_on = a["latency_ms"]["ttft"]["p99"]
+    p99_off = base["latency_ms"]["ttft"]["p99"]
+    assert p99_on <= max(p99_off * 1.1, p99_off + 50.0)
