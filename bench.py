@@ -72,6 +72,14 @@ extras (north-star shapes, BASELINE.json):
                     fleet-wide reuse headline), exact virtual-time
                     federated-vs-cold p50 TTFT ratio, byte-identical
                     scoreboards across two federated runs.
+  stream_resume   — mid-stream failover CPU-sim part (fault-
+                    tolerance.md stream continuation contract): the
+                    replica_kill fleetsim scenario (store tier armed)
+                    — kill-at-p50 resume TTFT vs the deterministic
+                    cold-recompute cost, zero client-visible stream
+                    failures, stitched streams byte-identical, plus
+                    the router_soak leg driving the REAL aiohttp
+                    router's resume path over loopback sockets.
   batch_backfill  — batch serving tier CPU-sim part
                     (batch-processing.md): the batch_backfill fleetsim
                     scenario batch-on vs no-batch on the same diurnal
@@ -929,6 +937,8 @@ def _run_part(part: str):
         return bench_fleet_soak()
     if part == "kv_federation":
         return bench_kv_federation()
+    if part == "stream_resume":
+        return bench_stream_resume()
     if part == "batch_backfill":
         return bench_batch_backfill()
     raise KeyError(part)
@@ -1043,6 +1053,72 @@ def bench_kv_federation():
             fed["latency_ms"]["ttft"]["p50"]
             / max(1e-9, cold["latency_ms"]["ttft"]["p50"]), 4
         ),
+    }
+
+
+def bench_stream_resume():
+    """Mid-stream failover CPU-sim part (fault-tolerance.md, stream
+    continuation contract): the replica_kill fleetsim scenario — two
+    replicas crashed mid-stream with the federation store tier armed —
+    at reduced scale. Virtual time is deterministic, so the headline
+    comparison is exact: p50 TTFT of resumed legs (store fetch of the
+    replayed prefix + tail prefill) vs the deterministic cost of
+    recomputing prompt + delivered history cold. Gates: resumes > 0,
+    ZERO client-visible stream failures, stitched streams byte-identical
+    to the uninterrupted expectation (parity), determinism across two
+    runs — plus a router_soak leg driving the REAL epp/server.py aiohttp
+    router's proxy/resume path over loopback sockets on the virtual
+    loop (content gates only; real I/O is not byte-compared)."""
+    from llmd_tpu.fleetsim.scenarios import SCENARIOS
+    from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+
+    scale = 0.25
+    t0 = time.monotonic()
+    a = SCENARIOS["replica_kill"].build(0, scale).run()
+    kill_wall_s = time.monotonic() - t0
+    b = SCENARIOS["replica_kill"].build(0, scale).run()
+    sc = a["stream_continuation"]
+    router = SCENARIOS["router_soak"].build(0, 1.0).run()
+    rsc = router["stream_continuation"]
+    return {
+        "qps_scale": scale,
+        "deterministic": to_canonical_json(a) == to_canonical_json(b),
+        "invariants_ok": bool(a["ok"] and router["ok"]),
+        "zero_lost": (
+            a["requests"]["lost"] == 0 and a["requests"]["hung"] == 0
+        ),
+        "kills": len(a["reroute"]["kills"]),
+        "mid_stream_failures": sc["mid_stream_failures"],
+        "resumes": sc["resumes"],
+        "resume_replayed_tokens": sc["resume_replayed_tokens"],
+        # THE acceptance gates: nothing client-visible, streams whole.
+        "client_visible_stream_failures": (
+            sc["interrupted"]
+            + a["requests"]["outcomes"].get("stream-corrupt", 0)
+        ),
+        "parity_failures": sc["parity_failures"],
+        # kill-at-p50 headline: resume TTFT must be store-fetch-bound,
+        # not recompute-bound.
+        "resume_ttft_p50_ms": round(sc["resume_ttft_p50_ms"], 3),
+        "cold_recompute_ttft_p50_ms": round(
+            sc["cold_recompute_ttft_p50_ms"], 3
+        ),
+        "resume_vs_cold_ratio": round(
+            sc["resume_ttft_p50_ms"]
+            / max(1e-9, sc["cold_recompute_ttft_p50_ms"]), 4
+        ),
+        "wall_s": round(kill_wall_s, 2),
+        # The REAL router leg: the production proxy detected the cuts,
+        # fed the breaker, and replayed the history end to end.
+        "router_soak": {
+            "requests": router["trace"]["requests"],
+            "kills": len(router["reroute"]["kills"]),
+            "mid_stream_failures": rsc["mid_stream_failures"],
+            "resumes": rsc["resumes"],
+            "parity_failures": rsc["parity_failures"],
+            "client_visible_stream_failures": rsc["interrupted"],
+            "invariants_ok": bool(router["ok"]),
+        },
     }
 
 
@@ -1973,7 +2049,7 @@ def _part_in_subprocess(part: str, retries: int = 0, timeout: float = 1800):
 _CPU_PARTS = frozenset({
     "dbo", "async_step", "spec_decode", "spec_window", "unified_step",
     "ragged_step", "fault_degrade", "fleet_soak", "kv_federation",
-    "batch_backfill",
+    "stream_resume", "batch_backfill",
 })
 
 # Every part main() can dispatch, in run order (also the validation set
@@ -1986,7 +2062,7 @@ _CPU_PARTS = frozenset({
 _ALL_PARTS = (
     "ragged_step", "unified_step", "async_step", "spec_decode",
     "spec_window", "dbo", "fault_degrade", "fleet_soak", "kv_federation",
-    "batch_backfill",
+    "stream_resume", "batch_backfill",
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
     "pd", "pd_int8", "pd_kvint8", "pd_local", "pd_cached", "pd_adaptive",
@@ -2125,6 +2201,7 @@ def main() -> None:
         "fault_degrade": (set_key("fault_degrade"), None),
         "fleet_soak": (set_key("fleet_soak"), None),
         "kv_federation": (set_key("kv_federation"), None),
+        "stream_resume": (set_key("stream_resume"), None),
         "batch_backfill": (set_key("batch_backfill"), None),
         "rtt": (set_key("dispatch_rtt_ms"), None),
         "env": (set_key("env"), None),

@@ -290,6 +290,16 @@ class EngineStats:
     kv_recompute_fallbacks_total: int = 0
     # ((stage, policy), count) pairs; rendered as labeled series.
     kv_transfer_failures: tuple = ()
+    # Mid-stream failover (docs/architecture/fault-tolerance.md, stream
+    # continuation contract): requests admitted as RESUMES (prefill of
+    # an already-delivered prefix continuing at the exact next output
+    # position), the delivered tokens those admissions replayed as
+    # committed prefix, and resume requests the serving layer REJECTED
+    # (invalid history / unsupported shape) — a rejected resume is a
+    # client-visible stream failure upstream, so it must leave a trail.
+    stream_resumes_total: int = 0
+    resume_replayed_tokens_total: int = 0
+    stream_resume_failures_total: int = 0
 
 
 @dataclass
@@ -580,9 +590,18 @@ class LLMEngine:
         kv_transfer_params: dict | None = None,
         lora_id: int = 0,
         lora_name: str = "",
+        resume_output_tokens: int = 0,
     ) -> str:
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if resume_output_tokens and not (
+            0 < resume_output_tokens < len(prompt_token_ids)
+        ):
+            raise ValueError(
+                f"resume_output_tokens {resume_output_tokens} must leave a "
+                f"non-empty prompt head (prompt carries "
+                f"{len(prompt_token_ids)} tokens)"
+            )
         if lora_id and not (
             0 < lora_id <= self.config.model.num_lora_adapters
         ):
@@ -646,6 +665,18 @@ class LLMEngine:
             lora_id=lora_id,
             lora_name=lora_name,
         )
+        if resume_output_tokens:
+            # Mid-stream failover resume: the prompt's TAIL is output the
+            # client already received from a dead replica. Admitting it
+            # through the recompute-preemption seam (delivered history
+            # folded into the prompt, num_prior_output_tokens carrying
+            # the output position) makes the continuation byte-identical
+            # by construction: the seeded sampler derives per-(seed,
+            # total_output_tokens) and the LENGTH check counts prior
+            # output toward max_tokens.
+            req.num_prior_output_tokens = resume_output_tokens
+            self.stats.stream_resumes_total += 1
+            self.stats.resume_replayed_tokens_total += resume_output_tokens
         if preload is not None:
             # Transferred KV handed straight to the request (ring mode):
             # admission skips the preloaded prefix; only the recompute

@@ -78,6 +78,14 @@ def main(argv=None) -> None:
     )
     p.add_argument("--scrape-interval", type=float, default=1.0)
     p.add_argument(
+        "--max-resumes", type=int, default=None,
+        help="mid-stream failover budget: how many times one request's "
+        "cut stream may resume on a fresh replica before the failure "
+        "surfaces to the client (default LLMD_EPP_MAX_RESUMES or 2; "
+        "0 disables resume — mid-stream failures still feed the "
+        "circuit breaker)",
+    )
+    p.add_argument(
         "--ext-proc-port", type=int, default=None,
         help="ALSO serve the Envoy ext-proc gRPC protocol on this port "
         "(the reference EPP's primary deployment shape; the HTTP fused "
@@ -158,6 +166,7 @@ def main(argv=None) -> None:
         default_parser=config.get("requestHandler", {}).get(
             "parser", "openai-parser"
         ),
+        max_resumes=args.max_resumes,
     )
     # Wires token-producer + KV-event subscription iff the config declares
     # a precise-prefix-cache-scorer (no-op otherwise).

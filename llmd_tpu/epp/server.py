@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import json
 import logging
 import os
 import random
@@ -38,6 +39,8 @@ from llmd_tpu.epp.types import (
     HDR_DROP_REASON,
     HDR_ENCODER,
     HDR_PREFILLER,
+    HDR_RESUME,
+    HDR_STREAM_TOKENS,
     KV_CACHE_USAGE,
     ROLE_ENCODE,
     WAITING_QUEUE_SIZE,
@@ -66,6 +69,129 @@ class UpstreamServerError(RuntimeError):
     def __init__(self, status: int, body: str = "") -> None:
         super().__init__(f"upstream returned {status}: {body}")
         self.status = status
+
+
+class MidStreamFailure(RuntimeError):
+    """The upstream died AFTER its stream was committed to the client
+    (connection reset / truncated payload / timeout past first byte).
+    The bytes already forwarded cannot be replayed on a plain re-pick —
+    recovery is the stream-continuation protocol
+    (docs/architecture/fault-tolerance.md): re-pick excluding the dead
+    endpoint and resume with the accumulated token history."""
+
+    def __init__(self, cause: BaseException) -> None:
+        super().__init__(
+            f"mid-stream upstream failure: {str(cause) or type(cause).__name__}"
+        )
+        self.cause = cause
+
+
+class ClientDisconnected(RuntimeError):
+    """The CLIENT went away mid-stream (its write side reset). Not an
+    upstream failure: it must neither feed the breaker nor trigger a
+    resume — there is nobody left to stream to."""
+
+
+class ResumeRejected(RuntimeError):
+    """A resume leg was refused by the upstream with a non-retryable
+    status: the terminal error is surfaced to the client faithfully."""
+
+    def __init__(self, status: int, body: str = "") -> None:
+        super().__init__(f"resume rejected with {status}: {body}")
+        self.status = status
+
+
+class _StreamState:
+    """Client-stream continuity across upstream attempts.
+
+    Holds the ONE prepared client response a streaming request writes
+    through (legs after the first graft onto it), the line-reassembly
+    carry, and — when resume is armed (``accumulate``) — the token
+    history parsed out of the frames' ``token_ids``. On the OpenAI
+    surface the field is a router-requested ANNOTATION
+    (:data:`~llmd_tpu.epp.types.HDR_STREAM_TOKENS`) and is stripped
+    before bytes reach the client (``strip=True``); on the vllmgrpc
+    surface ``token_ids`` IS the stream payload — it is read but
+    forwarded untouched. Only COMPLETE SSE lines are forwarded: a frame
+    truncated by a crash never reaches the client, so the delivered
+    history is exactly ``tokens``."""
+
+    def __init__(self, accumulate: bool, strip: bool = True) -> None:
+        self.accumulate = accumulate
+        self.strip = strip
+        self.resp: web.StreamResponse | None = None
+        self.tokens: list[int] = []
+        self.carry = b""
+        self.frames = 0  # complete data frames forwarded (all legs)
+        self.done_sent = False  # [DONE] forwarded: the stream is whole
+        # True once a replay leg has been issued: every subsequent
+        # upstream request carries HDR_RESUME so the engine grafts onto
+        # the open client stream (no re-emitted preambles) even when the
+        # accumulated history is still empty.
+        self.resuming = False
+
+    @property
+    def streamed(self) -> bool:
+        """Bytes are committed to the client (prepared + written)."""
+        return self.resp is not None
+
+    def ingest(self, chunk: bytes) -> tuple[bytes, int]:
+        """Split ``chunk`` into complete lines, strip ``token_ids`` from
+        data frames (accumulating them as the resume history), and
+        return (bytes to forward, complete data frames seen)."""
+        lines = (self.carry + chunk).split(b"\n")
+        self.carry = lines.pop()
+        if not lines:
+            return b"", 0
+        n_data = 0
+        out: list[bytes] = []
+        for ln in lines:
+            if ln.startswith(b"data:"):
+                # Exact-match terminator: generated TEXT may legally
+                # contain the substring "[DONE]" inside a JSON frame —
+                # only the bare sentinel line ends the stream.
+                if ln.strip() == b"data: [DONE]":
+                    self.done_sent = True
+                else:
+                    n_data += 1
+                    if self.accumulate and b"token_ids" in ln:
+                        ln = self._strip_tokens(ln)
+            out.append(ln)
+        self.frames += n_data
+        return b"\n".join(out) + b"\n", n_data
+
+    def _strip_tokens(self, line: bytes) -> bytes:
+        try:
+            obj = json.loads(line[5:])
+        except ValueError:
+            return line
+        if not isinstance(obj, dict) or "token_ids" not in obj:
+            return line
+        toks = obj.pop("token_ids")
+        if isinstance(toks, list):
+            self.tokens.extend(int(t) for t in toks)
+        if not self.strip:
+            # vllmgrpc: token_ids is the payload, not an annotation —
+            # the client must receive the original bytes.
+            return line
+        # The engine emits frames with compact separators; re-dumping
+        # with the same separators keeps the client bytes identical to
+        # a never-annotated stream.
+        return b"data: " + json.dumps(obj, separators=(",", ":")).encode()
+
+    def flush(self) -> bytes:
+        """Trailing partial line at clean stream end (non-SSE bodies
+        routed through a streaming request, bodies without a final
+        newline): forward it verbatim."""
+        tail, self.carry = self.carry, b""
+        return tail
+
+
+def _env_max_resumes() -> int:
+    try:
+        return int(os.environ.get("LLMD_EPP_MAX_RESUMES", "2"))
+    except ValueError:
+        return 2
 
 
 def _env_backoff_s() -> float:
@@ -117,6 +243,15 @@ class RouterMetrics:
         self.scheduling_errors = 0
         self.proxy_errors = 0
         self.request_retries = 0
+        # Mid-stream failover (the stream-continuation contract,
+        # docs/architecture/fault-tolerance.md): upstream failures after
+        # first byte, successful resume re-picks, delivered tokens
+        # replayed as resume history, and streams that exhausted the
+        # resume budget (the client saw a terminal error frame).
+        self.mid_stream_failures = 0
+        self.stream_resumes = 0
+        self.resume_replayed_tokens = 0
+        self.stream_resume_failures = 0
         self.ttft_sum = 0.0
         self.ttft_count = 0
         self.e2e_sum = 0.0
@@ -151,6 +286,14 @@ class RouterMetrics:
             f"llm_d_epp_proxy_errors_total {self.proxy_errors}",
             "# TYPE llm_d_epp_request_retries_total counter",
             f"llm_d_epp_request_retries_total {self.request_retries}",
+            "# TYPE llm_d_epp_mid_stream_failures_total counter",
+            f"llm_d_epp_mid_stream_failures_total {self.mid_stream_failures}",
+            "# TYPE llm_d_epp_stream_resumes_total counter",
+            f"llm_d_epp_stream_resumes_total {self.stream_resumes}",
+            "# TYPE llm_d_epp_resume_replayed_tokens_total counter",
+            f"llm_d_epp_resume_replayed_tokens_total {self.resume_replayed_tokens}",
+            "# TYPE llm_d_epp_stream_resume_failures_total counter",
+            f"llm_d_epp_stream_resume_failures_total {self.stream_resume_failures}",
             "# TYPE llm_d_epp_fail_open_total counter",
             f"llm_d_epp_fail_open_total {filters_mod.fail_open_total()}",
         ]
@@ -194,6 +337,7 @@ class Router:
         retry_backoff_s: float | None = None,
         retry_backoff_cap_s: float | None = None,
         retry_rng: random.Random | None = None,
+        max_resumes: int | None = None,
     ) -> None:
         self.store = store
         self.scheduler = scheduler
@@ -228,6 +372,14 @@ class Router:
             else retry_backoff_cap_s
         )
         self._retry_rng = retry_rng or random.Random()
+        # Mid-stream failover budget: how many times ONE request's cut
+        # stream may be resumed on a fresh replica before the failure is
+        # surfaced to the client (LLMD_EPP_MAX_RESUMES; 0 disables
+        # resume — mid-stream failures then terminate the stream with a
+        # faithful error frame but still feed the breaker).
+        self.max_resumes = (
+            _env_max_resumes() if max_resumes is None else max_resumes
+        )
         # Readiness: flipped off FIRST on graceful shutdown so the
         # gateway stops routing before flow control starts evicting.
         self.ready = True
@@ -252,6 +404,30 @@ class Router:
                 log.exception("completion observer failed")
 
     # ------------------------------------------------------------------ #
+
+    @staticmethod
+    async def _error_body(upstream) -> str:
+        """Best-effort snippet of an upstream error body: a connection
+        cut mid-read of a 5xx body must not crash the retry path — the
+        status alone is enough to act on."""
+        try:
+            body = await upstream.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            return "<body unavailable: connection cut>"
+        return body[:200].decode("utf-8", "replace")
+
+    @staticmethod
+    async def _client_write(resp: web.StreamResponse, data: bytes) -> None:
+        """Write to the CLIENT side of the proxy, converting transport
+        failures to :class:`ClientDisconnected` so they can never be
+        mistaken for upstream death (aiohttp >= 3.10 raises
+        `ClientConnectionResetError` — a ClientError — for writes to a
+        closed client transport, which would otherwise match the
+        upstream-failure handlers and feed a healthy pod's breaker)."""
+        try:
+            await resp.write(data)
+        except (ConnectionResetError, aiohttp.ClientConnectionError) as e:
+            raise ClientDisconnected(str(e)) from e
 
     async def _client(self) -> aiohttp.ClientSession:
         if self._session is None:
@@ -355,18 +531,101 @@ class Router:
         finally:
             self.flow.release()
 
+    def _resume_armed(self, req: LLMRequest) -> bool:
+        """Resume applies to streaming generate requests the router can
+        REPLAY: a parsed JSON body (the openai/vllmgrpc surfaces — the
+        passthrough parser carries opaque bytes) with a single choice
+        (n > 1 interleaves choices the router cannot attribute)."""
+        if self.max_resumes <= 0 or not req.streaming:
+            return False
+        if not isinstance(req.body, dict) or not req.body:
+            return False
+        if req.path not in GENERATE_PATHS | VLLMGRPC_PATHS:
+            return False
+        try:
+            if int(req.body.get("n") or 1) != 1:
+                return False
+        except (TypeError, ValueError):
+            return False
+        return True
+
+    def _request_deadline(self, request: web.Request) -> float | None:
+        """Monotonic deadline from `x-request-deadline-s` (the same
+        header the engine enforces): the resume loop must not keep a
+        client past its own budget."""
+        try:
+            v = float(request.headers.get("x-request-deadline-s", ""))
+        except ValueError:
+            return None
+        return clock.monotonic() + v if v > 0 else None
+
+    async def _fail_stream(
+        self, state: _StreamState, message: str, code: int
+    ) -> web.StreamResponse:
+        """Terminal error frame on an already-committed client stream:
+        the contract when recovery is exhausted — the client sees the
+        failure faithfully, as a frame, never a silent truncation."""
+        self.metrics.stream_resume_failures += 1
+        assert state.resp is not None
+        try:
+            await state.resp.write(
+                b"data: "
+                + json.dumps(
+                    {"error": {"message": message, "type": "upstream_error",
+                               "code": code}},
+                    separators=(",", ":"),
+                ).encode()
+                + b"\n\n"
+            )
+            await state.resp.write(b"data: [DONE]\n\n")
+            await state.resp.write_eof()
+        except (ConnectionResetError, RuntimeError,
+                aiohttp.ClientConnectionError):
+            pass  # the client went away too; nothing left to tell it
+        return state.resp
+
+    def _resume_body(self, req: LLMRequest, state: _StreamState) -> bytes:
+        """The replay request: the original parsed body plus the
+        delivered history — admitted downstream as prefill of committed
+        prefix, continuing at the exact next output position."""
+        return json.dumps(
+            {**req.body, "resume_token_ids": list(state.tokens)}
+        ).encode()
+
     async def _route_and_proxy(
         self, request: web.Request, req: LLMRequest, raw: bytes
     ) -> web.StreamResponse:
         tried: set[str] = set()
         prev_backoff = self.retry_backoff_s
-        for attempt in range(self.max_schedule_attempts):
+        state: _StreamState | None = None
+        if req.streaming:
+            # OpenAI frames need the HDR_STREAM_TOKENS annotation
+            # (stripped before the client); vllmgrpc frames carry
+            # token_ids natively and must reach the client untouched.
+            state = _StreamState(
+                self._resume_armed(req),
+                strip=req.path not in VLLMGRPC_PATHS,
+            )
+            if state.accumulate:
+                # A client-initiated resume already carries history: the
+                # next replay must extend it, not restart from it.
+                prior = req.body.get("resume_token_ids") or []
+                if isinstance(prior, list) and all(
+                    isinstance(t, int) for t in prior
+                ):
+                    state.tokens.extend(prior)
+        deadline = self._request_deadline(request)
+        pre_failures = 0  # pre-stream failures (connect / 5xx before bytes)
+        resumes = 0  # mid-stream continuations used
+        while True:
             self.metrics.scheduling_attempts += 1
             pods = eligible_pods(self.store.list(), tried, self.breaker)
             try:
                 result = self.scheduler.schedule(req, pods)
             except NoEndpointsError as e:
                 self.metrics.scheduling_errors += 1
+                if state is not None and state.streamed:
+                    return await self._fail_stream(state, str(e), 503)
                 return web.json_response(
                     {"error": {"message": str(e), "type": "no-endpoints"}},
                     status=503,
@@ -407,7 +666,8 @@ class Router:
             try:
                 return await self._proxy(
                     request, req, raw, pod, extra_headers,
-                    retry_5xx=attempt + 1 < self.max_schedule_attempts,
+                    retry_5xx=pre_failures + 1 < self.max_schedule_attempts,
+                    state=state,
                 )
             except (
                 aiohttp.ClientConnectionError,
@@ -422,26 +682,92 @@ class Router:
                     pod.healthy = False
                 log.warning(
                     "proxy to %s failed (attempt %d): %s",
-                    pod.address, attempt + 1, str(e) or type(e).__name__,
+                    pod.address, pre_failures + 1, str(e) or type(e).__name__,
                 )
-                if attempt + 1 < self.max_schedule_attempts:
-                    self.metrics.request_retries += 1
-                    # Decorrelated-jitter backoff before the re-pick: a
-                    # refusing pool must not see a synchronized retry
-                    # storm land on the next replica in lockstep.
-                    prev_backoff = backoff_delay(
-                        prev_backoff,
-                        self.retry_backoff_s,
-                        self.retry_backoff_cap_s,
-                        self._retry_rng,
+                pre_failures += 1
+                if pre_failures >= self.max_schedule_attempts:
+                    break
+                self.metrics.request_retries += 1
+                # Decorrelated-jitter backoff before the re-pick: a
+                # refusing pool must not see a synchronized retry
+                # storm land on the next replica in lockstep.
+                prev_backoff = backoff_delay(
+                    prev_backoff,
+                    self.retry_backoff_s,
+                    self.retry_backoff_cap_s,
+                    self._retry_rng,
+                )
+                await asyncio.sleep(prev_backoff)
+                continue
+            except ResumeRejected as e:
+                # The upstream refused the REPLAY itself (4xx): another
+                # replica would refuse the same body — surface it.
+                self.metrics.proxy_errors += 1
+                assert state is not None
+                return await self._fail_stream(state, str(e), e.status)
+            except MidStreamFailure as e:
+                # Bytes already reached the client. The dead endpoint
+                # feeds the breaker EVEN when resume is off — a replica
+                # that dies mid-stream on every request must trip the
+                # circuit, not hide behind its streamed-200 status line.
+                self.metrics.proxy_errors += 1
+                self.metrics.mid_stream_failures += 1
+                self.breaker.record_failure(pod.address)
+                pod.healthy = False
+                if state is None:
+                    # Non-streaming body cut mid-transfer: nothing can be
+                    # replayed onto a half-written JSON body — the
+                    # breaker is fed (above) and the truncation surfaces
+                    # as an aborted transfer.
+                    raise e.cause from e
+                log.warning(
+                    "mid-stream failure on %s after %d frames: %s",
+                    pod.address, state.frames, str(e.cause) or repr(e.cause),
+                )
+                if not state.accumulate or resumes >= self.max_resumes:
+                    return await self._fail_stream(
+                        state,
+                        f"upstream stream failed and resume budget "
+                        f"exhausted ({resumes}/{self.max_resumes}): "
+                        f"{e.cause!r}",
+                        502,
                     )
-                    await asyncio.sleep(prev_backoff)
+                if deadline is not None and clock.monotonic() >= deadline:
+                    return await self._fail_stream(
+                        state,
+                        "request deadline exceeded during stream resume",
+                        504,
+                    )
+                resumes += 1
+                self.metrics.stream_resumes += 1
+                self.metrics.resume_replayed_tokens += len(state.tokens)
+                if span is not None:
+                    span.set("llm_d.resume.count", resumes)
+                    span.set("llm_d.resume.tokens", len(state.tokens))
+                # Replay with the accumulated history: re-pick from the
+                # WHOLE pool minus the dead endpoint (endpoints tried
+                # pre-stream served nothing and remain candidates). The
+                # dead leg's partial line is dropped — it was never
+                # forwarded, and it must not prefix the next leg's bytes.
+                state.carry = b""
+                state.resuming = True
+                raw = self._resume_body(req, state)
+                tried = {pod.address}
+                prev_backoff = backoff_delay(
+                    prev_backoff,
+                    self.retry_backoff_s,
+                    self.retry_backoff_cap_s,
+                    self._retry_rng,
+                )
+                await asyncio.sleep(prev_backoff)
                 continue
             finally:
                 if prefill_pod is not None:
                     prefill_pod.inflight_tokens = max(
                         0, prefill_pod.inflight_tokens - req.approx_prompt_tokens
                     )
+        if state is not None and state.streamed:
+            return await self._fail_stream(state, "all endpoints failed", 502)
         return web.json_response(
             {"error": {"message": "all endpoints failed", "type": "proxy-error"}},
             status=502,
@@ -455,6 +781,7 @@ class Router:
         pod: Endpoint,
         extra_headers: dict[str, str],
         retry_5xx: bool = False,
+        state: _StreamState | None = None,
     ) -> web.StreamResponse:
         session = await self._client()
         # Injection site: the picked endpoint refuses the connection even
@@ -464,11 +791,30 @@ class Router:
             raise aiohttp.ClientConnectionError(
                 f"injected epp.endpoint.refuse for {pod.address}"
             )
+        # Router-internal protocol headers: client copies are stripped
+        # (the HDR_EC_HOST precedent) — a client asking the engine for
+        # token annotations through the router would otherwise receive
+        # internal frames the router only strips when resume is armed.
+        # (Case-insensitive: aiohttp preserves the client's casing.)
+        dropped = HOP_HEADERS | {HDR_STREAM_TOKENS, HDR_RESUME}
         headers = {
-            k: v for k, v in request.headers.items() if k.lower() not in HOP_HEADERS
+            k: v for k, v in request.headers.items()
+            if k.lower() not in dropped
         }
         headers["x-request-id"] = req.request_id
         headers.update(extra_headers)
+        if state is not None and state.accumulate and state.strip:
+            # OpenAI surface: ask the engine to annotate delta frames
+            # with raw token ids (stripped below) — the resume history a
+            # replica death makes the router replay. vllmgrpc frames
+            # carry token_ids natively; no annotation needed.
+            headers[HDR_STREAM_TOKENS] = "1"
+        if state is not None and state.resuming:
+            # Replay leg: the client stream is already open — the engine
+            # must graft (no re-emitted chat role preamble), even when
+            # the accumulated history is still empty (death between the
+            # preamble and the first token frame).
+            headers[HDR_RESUME] = "1"
         span = req.scratch.get("span")
         if span is not None and span.sampled:
             headers["traceparent"] = span.traceparent
@@ -478,63 +824,116 @@ class Router:
         first_byte: float | None = None
         last_byte: float | None = None
         stream_tokens = 0
-        carry = b""  # partial SSE line across chunk boundaries
         status = 0
         try:
             async with session.request(
                 request.method, pod.url + request.path_qs, data=raw, headers=headers
             ) as upstream:
                 status = upstream.status
-                if status >= 500 and retry_5xx:
-                    # Nothing streamed to the client yet: surface the 5xx
-                    # to the retry loop so another replica gets the
-                    # request instead of the client eating this one's
-                    # failure. The LAST attempt streams the 5xx through.
-                    body = await upstream.read()
-                    raise UpstreamServerError(
-                        status, body[:200].decode("utf-8", "replace")
-                    )
-                if status < 500:
+                if state is not None and state.streamed:
+                    # Resume leg grafting onto the committed client
+                    # stream: there is no fresh response to carry an
+                    # upstream error, so a 5xx re-picks (the caller's
+                    # pre-stream budget) and any other non-200 surfaces
+                    # as the terminal frame.
+                    if status >= 500:
+                        raise UpstreamServerError(
+                            status, await self._error_body(upstream)
+                        )
+                    if status != 200:
+                        raise ResumeRejected(
+                            status, await self._error_body(upstream)
+                        )
                     self.breaker.record_success(pod.address)
+                    resp = state.resp
                 else:
-                    # Last attempt (retry_5xx=False) streams the 5xx through
-                    # to the client, but the breaker still counts it — a
-                    # replica 500ing on every request must trip the circuit
-                    # even when retries are disabled (scrape health stays
-                    # green for a reachable-but-failing pod).
-                    self.metrics.proxy_errors += 1
-                    self.breaker.record_failure(pod.address)
-                resp = web.StreamResponse(status=upstream.status)
-                for k, v in upstream.headers.items():
-                    if k.lower() not in HOP_HEADERS:
-                        resp.headers[k] = v
-                resp.headers["x-llm-d-endpoint"] = pod.address
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_any():
+                    if status >= 500 and retry_5xx:
+                        # Nothing streamed to the client yet: surface the
+                        # 5xx to the retry loop so another replica gets
+                        # the request instead of the client eating this
+                        # one's failure. The LAST attempt streams the 5xx
+                        # through.
+                        raise UpstreamServerError(
+                            status, await self._error_body(upstream)
+                        )
+                    if status < 500:
+                        self.breaker.record_success(pod.address)
+                    else:
+                        # Last attempt (retry_5xx=False) streams the 5xx
+                        # through to the client, but the breaker still
+                        # counts it — a replica 500ing on every request
+                        # must trip the circuit even when retries are
+                        # disabled (scrape health stays green for a
+                        # reachable-but-failing pod).
+                        self.metrics.proxy_errors += 1
+                        self.breaker.record_failure(pod.address)
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in HOP_HEADERS:
+                            resp.headers[k] = v
+                    resp.headers["x-llm-d-endpoint"] = pod.address
+                    await resp.prepare(request)
+                    if state is not None:
+                        state.resp = resp
+                # The upstream READ is the only leg whose failures mean
+                # "the replica died" — the CLIENT-side writes sit outside
+                # the guard, wrapped as ClientDisconnected, so a client
+                # closing its tab mid-stream never feeds the breaker or
+                # triggers replay generations nobody will read.
+                aiter = upstream.content.iter_any().__aiter__()
+                while True:
+                    try:
+                        chunk = await aiter.__anext__()
+                    except StopAsyncIteration:
+                        break
+                    except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                        # The upstream died after committing the stream.
+                        # A whole stream ([DONE] forwarded) torn down
+                        # uncleanly is complete, and a cut NON-200 body
+                        # (e.g. a last-attempt 5xx streamed through,
+                        # already breaker-counted above) is delivered
+                        # truncated — grafting resume frames onto an
+                        # error response would corrupt it. Only a cut
+                        # 200 stream missing its terminator is a
+                        # failure the continuation protocol handles.
+                        if status == 200 and (
+                            state is None or not state.done_sent
+                        ):
+                            raise MidStreamFailure(e) from e
+                        break
                     if first_byte is None:
                         first_byte = clock.monotonic()
                     last_byte = clock.monotonic()
-                    if req.streaming:
-                        # Count complete SSE data lines ("data: ..." at line
-                        # start — one frame ~ one sampled token batch); the
-                        # carry keeps counting exact across TCP chunk splits.
-                        lines = (carry + chunk).split(b"\n")
-                        carry = lines.pop()
-                        stream_tokens += sum(
-                            1
-                            for ln in lines
-                            if ln.startswith(b"data:") and b"[DONE]" not in ln
-                        )
-                    await resp.write(chunk)
-                await resp.write_eof()
+                    if state is not None:
+                        # Complete-line forwarding: data frames are
+                        # counted (one frame ~ one sampled token
+                        # batch), token annotations accumulate into
+                        # the resume history, and a frame truncated
+                        # by a crash never reaches the client.
+                        out, n_data = state.ingest(chunk)
+                        stream_tokens += n_data
+                        if out:
+                            await self._client_write(resp, out)
+                    else:
+                        await self._client_write(resp, chunk)
+                tail = state.flush() if state is not None else b""
+                if tail:
+                    if (
+                        tail.startswith(b"data:")
+                        and tail.strip() != b"data: [DONE]"
+                    ):
+                        stream_tokens += 1
+                    await self._client_write(resp, tail)
+                try:
+                    await resp.write_eof()
+                except (ConnectionResetError, aiohttp.ClientConnectionError) as e:
+                    raise ClientDisconnected(str(e)) from e
                 return resp
         finally:
             pod.inflight = max(0, pod.inflight - 1)
             pod.inflight_tokens = max(
                 0, pod.inflight_tokens - req.approx_prompt_tokens
             )
-            if carry.startswith(b"data:") and b"[DONE]" not in carry:
-                stream_tokens += 1
             now = clock.monotonic()
             ttft_ms: float | None = None
             tpot_ms: float | None = None

@@ -42,6 +42,19 @@ HDR_ENCODER = "x-encoder-host-port"
 # alone; the sidecar strips any client-supplied copy of the header.
 HDR_EC_HOST = "x-llm-d-ec-host"
 HDR_DROP_REASON = "x-llm-d-request-dropped-reason"
+# Mid-stream failover (docs/architecture/fault-tolerance.md): the router
+# sets this on proxied streaming requests so the engine annotates every
+# SSE delta frame with its raw token ids ("token_ids") — the accumulated
+# history the router replays as `resume_token_ids` when the upstream
+# dies mid-stream. The router strips the field before frames reach the
+# client.
+HDR_STREAM_TOKENS = "x-llmd-stream-tokens"
+# Marks a router-issued REPLAY leg of a cut stream (set alongside the
+# resume_token_ids body field, including when the history is still
+# empty — e.g. the upstream died after the chat role preamble but
+# before the first token): the engine grafts onto the already-open
+# client stream and must not re-emit stream preambles.
+HDR_RESUME = "x-llmd-resume"
 # Batch serving tier (docs/architecture/batch-processing.md): the batch
 # processor marks offline work with this header; parsers clamp such
 # requests to the backfill band.

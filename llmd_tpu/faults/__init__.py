@@ -44,6 +44,7 @@ site                        effect at the injection point
 ``kvstore.get.timeout``     kvstore client HTTP call raises ``TimeoutError``
 ``lockstep.sync.stall``     lockstep collective hangs past the bounded wait
 ``sidecar.prefill.fail``    sidecar phase-1 prefill POST raises
+``serve.stream.cut``        engine SSE stream's transport severed mid-flight
 ``replica.crash``           fleet-sim replica dies (in-flight streams cut)
 ``replica.brownout``        fleet-sim replica serves ``delay_ms`` slower
 ==========================  =================================================
@@ -75,6 +76,7 @@ SITES = frozenset({
     "kvstore.get.timeout",
     "lockstep.sync.stall",
     "sidecar.prefill.fail",
+    "serve.stream.cut",
     "replica.crash",
     "replica.brownout",
 })

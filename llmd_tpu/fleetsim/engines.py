@@ -27,8 +27,10 @@ Failure surface (consulted through PR 7's seeded FaultPlan):
   ticker): :meth:`SimReplica.kill` fails every in-flight wait with
   :class:`ReplicaDied` — mid-prefill requests look like a connection
   reset before first byte (retryable), mid-decode requests like a cut
-  stream (surfaced, not retryable), exactly the split the router's
-  retry loop handles;
+  stream, which the driver RESUMES on a fresh replica with the
+  delivered token history (the stream-continuation protocol,
+  fault-tolerance.md) — exactly the split the router's retry/resume
+  loop handles;
 - ``replica.brownout``: per-request extra latency (``delay_ms``);
 - new connections to a dead or draining replica raise
   :class:`ReplicaUnreachable` (the simulator's connection-refused).
@@ -48,10 +50,27 @@ import collections
 import dataclasses
 import json
 import pathlib
+import zlib
 
 import asyncio
 
 from llmd_tpu import faults
+
+
+def stream_token(request_id: str, index: int) -> int:
+    """The deterministic token at output position ``index`` of request
+    ``request_id``: replicas are position-addressable generators (the
+    sim's stand-in for the engine's per-(seed, output-index) PRNG
+    derivation), so a resumed stream is byte-identical to an
+    uninterrupted one EXACTLY when the continuation starts at the right
+    position — the stitched-stream parity gate checks real content, not
+    bookkeeping."""
+    return zlib.crc32(f"{request_id}:{index}".encode()) & 0xFFFF
+
+
+def expected_stream(request_id: str, output_tokens: int) -> list[int]:
+    """The uninterrupted baseline a stitched client stream must equal."""
+    return [stream_token(request_id, i) for i in range(output_tokens)]
 
 
 class ReplicaUnreachable(ConnectionError):
@@ -410,10 +429,20 @@ class SimReplica:
         output_tokens: int,
         prefix_group: str | None = None,
         prefix_tokens: int = 0,
+        resume_tokens: int = 0,
     ):
-        """Serve one request; async generator yielding once at first
-        token and returning at completion (the transport measures TTFT
-        and stream end from the yields, like SSE bytes on a socket).
+        """Serve one request; async generator yielding LISTS of token
+        values (:func:`stream_token`) — the first list at first-token
+        time, then decode chunks — and returning at completion (the
+        transport measures TTFT and stream end from the yields, like SSE
+        frames on a socket).
+
+        ``resume_tokens`` is the mid-stream failover contract
+        (fault-tolerance.md): the first ``resume_tokens`` output
+        positions were already delivered by a dead replica; they are
+        admitted as prefill of committed prefix — costed like prompt
+        (the shared ``prefix_group`` still takes the store-fetch fast
+        path) — and generation continues at position ``resume_tokens``.
 
         Raises :class:`ReplicaUnreachable` before any byte when the
         replica is down/draining, :class:`ReplicaDied` at whatever point
@@ -435,8 +464,11 @@ class SimReplica:
             # Degradations the production stack contracts for: a dropped
             # KV pull recomputes locally (slower prefill, correct
             # output); a brownout serves every request delay_ms late.
+            # A resume leg prefills the delivered history too — that is
+            # the replayed-prefix cost the store fetch keeps bounded.
             prefill_s, publish_group = self._plan_prefill(
-                request_id, prompt_tokens, prefix_group, prefix_tokens
+                request_id, prompt_tokens + resume_tokens,
+                prefix_group, prefix_tokens,
             )
             if faults.fires("kv.pull.drop", f"{self.address}|{request_id}"):
                 self.recompute_fallbacks += 1
@@ -451,15 +483,28 @@ class SimReplica:
                 if publish_group is not None:
                     self.kv_store.publish(publish_group)
                     self.store_published += 1
-            yield "first-token"
-            if output_tokens > 1:
+            pos = resume_tokens
+            yield [stream_token(request_id, pos)]
+            pos += 1
+            if pos < output_tokens:
                 # Load-dependent TPOT, snapshotted at decode start: the
                 # batch shares the aggregate decode rate at saturation.
+                # Decode streams in chunks (not one whole-tail sleep) so
+                # a crash lands MID-stream at a token position — the
+                # delivered-prefix accounting the resume protocol rides.
                 tpot = max(p.base_tpot_s, self.running / p.decode_tok_s)
-                await self._hold((output_tokens - 1) * tpot)
+                chunk = max(1, output_tokens // 4)
+                while pos < output_tokens:
+                    n = min(chunk, output_tokens - pos)
+                    await self._hold(n * tpot)
+                    yield [
+                        stream_token(request_id, i)
+                        for i in range(pos, pos + n)
+                    ]
+                    pos += n
             self.served_total += 1
             self.prompt_tokens_total += prompt_tokens
-            self.output_tokens_total += output_tokens
+            self.output_tokens_total += output_tokens - resume_tokens
         finally:
             self.running -= 1
             self.kv_used_tokens -= held_tokens

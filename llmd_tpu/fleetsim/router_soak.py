@@ -1,0 +1,376 @@
+"""Router soak: the REAL ``epp/server.py`` aiohttp router under chaos.
+
+The pure-simulation scenarios (:mod:`llmd_tpu.fleetsim.sim`) MIRROR the
+router's ``_route_and_proxy`` semantics; this scenario removes the
+mirror. The production :class:`~llmd_tpu.epp.server.Router` — parser,
+flow control, scheduler plugin chain, breaker, decorrelated-jitter
+retry, the proxy byte loop, and the mid-stream resume protocol — serves
+real HTTP over loopback sockets ON the virtual-time loop
+(:class:`~llmd_tpu.fleetsim.simloop.SimEventLoop` treats socket I/O as
+instantaneous in virtual time; pacing comes from virtual timers). The
+production ``MetricsCollector`` scrapes the replicas' real ``/metrics``
+pages over the same sockets. Only the engines are stubs:
+:class:`StubReplicaServer` speaks the OpenAI SSE surface with
+position-addressable token streams (:func:`~.engines.stream_token`),
+honors the ``resume_token_ids`` replay contract and the
+``x-llmd-stream-tokens`` annotation header, and can be killed
+mid-stream — severing live transports exactly like a crashed engine.
+
+Gates (content invariants, not byte-compared scoreboards — kernel-side
+socket readiness ordering is outside the program): kills fired, ZERO
+client-visible stream failures, resumes > 0 through the real proxy leg,
+and every stitched client stream byte-identical to the uninterrupted
+expectation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+
+from aiohttp import web
+
+from llmd_tpu import clock, faults
+from llmd_tpu.epp import config as epp_config
+from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+from llmd_tpu.epp.server import Router
+from llmd_tpu.epp.types import HDR_STREAM_TOKENS, Endpoint
+from llmd_tpu.fleetsim import simloop
+from llmd_tpu.fleetsim.engines import expected_stream, stream_token
+from llmd_tpu.fleetsim.scoreboard import Scoreboard
+from llmd_tpu.fleetsim.sim import default_sim_config
+from llmd_tpu.fleetsim.traces import TraceRequest, generate
+
+log = logging.getLogger(__name__)
+
+
+class StubReplicaServer:
+    """One engine replica as a real aiohttp server on a loopback port.
+
+    Implements just enough of the model-server contract for the router
+    path under test: ``POST /v1/completions`` (streaming SSE, token ids
+    annotated under the :data:`HDR_STREAM_TOKENS` contract, the
+    ``resume_token_ids`` replay admission) and ``GET /metrics`` (the
+    llmd engine family the production collector parses). Token values
+    are position-addressable (:func:`stream_token`), so a resume that
+    continues at the wrong output position corrupts the stitched stream
+    — which the driver's parity gate catches.
+    """
+
+    def __init__(self, name: str, tpot_s: float = 0.004,
+                 prefill_s: float = 0.01) -> None:
+        self.name = name
+        self.tpot_s = tpot_s
+        self.prefill_s = prefill_s
+        self.alive = True
+        self.running = 0
+        self.served_total = 0
+        self.resumes_served = 0
+        self._transports: set = set()
+        self._runner: web.AppRunner | None = None
+        self.address = ""  # host:port once started
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.add_routes([
+            web.post("/v1/completions", self.handle_completions),
+            web.get("/metrics", self.handle_metrics),
+        ])
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.address = f"127.0.0.1:{port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    def kill(self) -> None:
+        """Crash: sever every live stream's transport (no SSE
+        terminator — the router's upstream read loop sees a truncated
+        payload, the mid-stream failure shape) and refuse new work."""
+        self.alive = False
+        for tr in list(self._transports):
+            tr.close()
+
+    # ---- handlers ----------------------------------------------------- #
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        if not self.alive:
+            raise web.HTTPServiceUnavailable(text="replica dead")
+        body = await request.json()
+        rid = request.headers.get("x-request-id", "anon")
+        max_tokens = int(body.get("max_tokens", 8))
+        resume = list(body.get("resume_token_ids") or [])
+        annotate = request.headers.get(HDR_STREAM_TOKENS, "") == "1"
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"}
+        )
+        await resp.prepare(request)
+        if request.transport is not None:
+            self._transports.add(request.transport)
+        self.running += 1
+        try:
+            # Prefill pace (virtual time), then one token per frame.
+            await asyncio.sleep(self.prefill_s)
+            for i in range(len(resume), max_tokens):
+                if i > len(resume):
+                    await asyncio.sleep(self.tpot_s)
+                if not self.alive:
+                    # Crash landed between frames: stop emitting; the
+                    # severed transport surfaces the cut downstream.
+                    return resp
+                tok = stream_token(rid, i)
+                frame = {
+                    "id": rid,
+                    "object": "text_completion",
+                    "choices": [{"index": 0, "text": f"{tok:04x} ",
+                                 "finish_reason": None}],
+                }
+                if annotate:
+                    frame["token_ids"] = [tok]
+                await resp.write(
+                    b"data: "
+                    + json.dumps(frame, separators=(",", ":")).encode()
+                    + b"\n\n"
+                )
+            final = {
+                "id": rid,
+                "object": "text_completion",
+                "choices": [{"index": 0, "text": "",
+                             "finish_reason": "length"}],
+                "usage": {"prompt_tokens": 0,
+                          "completion_tokens": max_tokens},
+            }
+            await resp.write(
+                b"data: " + json.dumps(final, separators=(",", ":")).encode()
+                + b"\n\n"
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            self.served_total += 1
+            if resume:
+                self.resumes_served += 1
+            return resp
+        except (ConnectionResetError, RuntimeError):
+            # Client (the router) went away or our transport was
+            # severed by kill(): nothing further to write.
+            return resp
+        finally:
+            self.running -= 1
+            if request.transport is not None:
+                self._transports.discard(request.transport)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=(
+                f"llmd:num_requests_waiting 0\n"
+                f"llmd:num_requests_running {self.running}\n"
+                f"llmd:gpu_cache_usage_perc 0.05\n"
+                "llmd:prefix_cache_hit_rate 0.0\n"
+                'llmd:cache_config_info{block_size="16",'
+                'num_gpu_blocks="2048"} 1\n'
+            ),
+            content_type="text/plain",
+        )
+
+
+class RouterSoak:
+    """One router-soak run: real Router + stub HTTP replicas + chaos."""
+
+    def __init__(
+        self,
+        trace: list[TraceRequest],
+        replicas: int = 3,
+        kill_at_s: float = 0.5,
+        kills: int = 1,
+        max_resumes: int = 2,
+        seed: int = 0,
+        scenario: str = "router_soak",
+        invariants: list | None = None,
+        grace_s: float = 60.0,
+    ) -> None:
+        self.trace = sorted(trace, key=lambda r: (r.t, r.request_id))
+        self.n_replicas = replicas
+        self.kill_at_s = kill_at_s
+        self.kills = kills
+        self.max_resumes = max_resumes
+        self.seed = seed
+        self.invariants = invariants or []
+        self.grace_s = grace_s
+        self.board = Scoreboard(scenario, seed)
+        self._duration = max((r.t for r in self.trace), default=0.0)
+
+    async def _drive_request(self, session, base, treq: TraceRequest) -> None:
+        body = {
+            "model": "sim",
+            "prompt": f"{treq.tenant}:{treq.request_id}:" + "x" * 64,
+            "max_tokens": treq.output_tokens,
+            "stream": True,
+            "temperature": 0.0,
+        }
+        t0 = clock.monotonic()
+        first: float | None = None
+        tokens: list[int] = []
+        err = None
+        try:
+            async with session.post(
+                f"{base}/v1/completions", json=body,
+                headers={"x-request-id": treq.request_id},
+            ) as r:
+                if r.status != 200:
+                    self.board.record_outcome(
+                        treq.tenant, f"http-{r.status}"
+                    )
+                    return
+                carry = b""
+                async for chunk in r.content.iter_any():
+                    if first is None:
+                        first = clock.monotonic()
+                    lines = (carry + chunk).split(b"\n")
+                    carry = lines.pop()
+                    for ln in lines:
+                        if not ln.startswith(b"data: ") or b"[DONE]" in ln:
+                            continue
+                        d = json.loads(ln[6:])
+                        if "error" in d:
+                            err = d["error"]
+                            continue
+                        assert "token_ids" not in d, (
+                            "router leaked token annotations to the client"
+                        )
+                        text = (d.get("choices") or [{}])[0].get("text") or ""
+                        tokens.extend(
+                            int(t, 16) for t in text.split() if t
+                        )
+        except (OSError, asyncio.TimeoutError, json.JSONDecodeError) as e:
+            self.board.record_outcome(treq.tenant, "client-error")
+            log.warning("client leg failed for %s: %r", treq.request_id, e)
+            return
+        if err is not None:
+            self.board.record_outcome(treq.tenant, "stream-interrupted")
+            return
+        if tokens != expected_stream(treq.request_id, treq.output_tokens):
+            self.board.record_parity_failure(treq.request_id)
+            self.board.record_outcome(treq.tenant, "stream-corrupt")
+            return
+        done = clock.monotonic()
+        ttft = (first if first is not None else done) - t0
+        tpot_ms = None
+        if treq.output_tokens > 1 and first is not None:
+            tpot_ms = (done - first) * 1e3 / (treq.output_tokens - 1)
+        self.board.record_completion(treq.tenant, "router", ttft, tpot_ms, 0)
+
+    async def _run(self) -> dict:
+        import aiohttp
+
+        faults.disarm()
+        replicas = [
+            StubReplicaServer(f"stub-{i}") for i in range(self.n_replicas)
+        ]
+        for rep in replicas:
+            await rep.start()
+        store = EndpointStore()
+        for rep in replicas:
+            store.upsert(Endpoint(
+                address=rep.address,
+                labels={"llm-d.ai/engine-type": "llmd"},
+            ))
+        cfg = default_sim_config(self.seed)
+        router = Router(
+            store=store,
+            scheduler=epp_config.build_scheduler(cfg),
+            flow_control=epp_config.build_flow_control(cfg),
+            collector=MetricsCollector(store, interval_s=0.25),
+            retry_backoff_s=0.005,
+            retry_backoff_cap_s=0.25,
+            retry_rng=random.Random(self.seed ^ 0x5EED),
+            max_resumes=self.max_resumes,
+        )
+        runner = web.AppRunner(router.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300, sock_connect=30)
+        )
+        tasks: list[tuple[asyncio.Task, TraceRequest]] = []
+
+        async def chaos() -> None:
+            await asyncio.sleep(self.kill_at_s)
+            for rep in replicas[: self.kills]:
+                rep.kill()
+                self.board.record_kill(rep.address, clock.monotonic())
+
+        chaos_task = asyncio.ensure_future(chaos())
+        try:
+            loop = asyncio.get_event_loop()
+            for treq in self.trace:
+                delay = treq.t - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                self.board.record_arrival(treq.tenant)
+                tasks.append((
+                    asyncio.ensure_future(
+                        self._drive_request(session, base, treq)
+                    ),
+                    treq,
+                ))
+            if tasks:
+                done, pending = await asyncio.wait(
+                    [t for t, _ in tasks], timeout=self.grace_s
+                )
+                for task, treq in tasks:
+                    if task in pending:
+                        self.board.record_hung(treq.request_id)
+                        task.cancel()
+                    elif task.done() and not task.cancelled():
+                        exc = task.exception()
+                        if exc is not None:
+                            raise exc
+        finally:
+            chaos_task.cancel()
+            await session.close()
+            await runner.cleanup()
+            for rep in replicas:
+                await rep.stop()
+        # The router's OWN counters are the soak's resume evidence: the
+        # production proxy leg detected the cut, fed the breaker, and
+        # replayed the history.
+        m = router.metrics
+        self.board.mid_stream_failures = m.mid_stream_failures
+        self.board.stream_resumes = m.stream_resumes
+        self.board.resume_replayed_tokens = m.resume_replayed_tokens
+        for addr in self.board.kills:
+            if router.breaker.is_open(addr) or addr in (
+                router.breaker.open_endpoints()
+            ):
+                self.board.record_breaker_open(addr, clock.monotonic())
+        return self.board.finalize(
+            duration_s=max(self._duration, 1e-9),
+            invariants=self.invariants,
+            breaker_trips=router.breaker.trips_total,
+            breaker_opened=sorted(router.breaker.open_endpoints()),
+            extra={
+                "router": {
+                    "mid_stream_failures": m.mid_stream_failures,
+                    "stream_resumes": m.stream_resumes,
+                    "resume_replayed_tokens": m.resume_replayed_tokens,
+                    "stream_resume_failures": m.stream_resume_failures,
+                    "proxy_errors": m.proxy_errors,
+                    "resumes_served_by_stubs": sum(
+                        r.resumes_served for r in replicas
+                    ),
+                },
+            },
+        )
+
+    def run(self) -> dict:
+        return simloop.run(self._run())

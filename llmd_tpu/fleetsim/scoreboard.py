@@ -2,8 +2,8 @@
 
 Every simulated request ends in exactly one recorded outcome —
 ``completed``, a typed drop (flow-control outcome, ``no-endpoints``,
-``all-endpoints-failed``, ``stream-interrupted``) or ``hung`` (still
-pending when the scenario's grace window closed). ``hung`` existing as
+``all-endpoints-failed``, ``stream-interrupted``, ``stream-corrupt``)
+or ``hung`` (still pending when the scenario's grace window closed). ``hung`` existing as
 a category is the point: "zero requests lost to a killed replica" is
 asserted as ``hung == 0`` plus every arrival accounted for, not assumed.
 
@@ -81,6 +81,19 @@ class Scoreboard:
         self.breaker_open_after_kill_s: dict[str, float] = {}
         self.reroute_latencies_s: list[float] = []
         self.recompute_fallbacks = 0
+        # Mid-stream failover (the stream-continuation contract,
+        # docs/architecture/fault-tolerance.md): upstream streams cut
+        # after first byte, successful resumes, tokens replayed as
+        # committed prefix, stitched streams that did NOT match the
+        # uninterrupted expectation (must stay 0), and per-resume TTFT
+        # next to its deterministic cold-recompute estimate — the
+        # store-fetch-bound-vs-recompute-bound gate.
+        self.mid_stream_failures = 0
+        self.stream_resumes = 0
+        self.resume_replayed_tokens = 0
+        self.stream_parity_failures = 0
+        self.resume_ttft_s: list[float] = []
+        self.resume_cold_ttft_s: list[float] = []
         # autoscale
         self.autoscale_history: list[tuple[float, int]] = []  # (t, desired)
         self.replicas_started: list[tuple[float, str]] = []
@@ -143,6 +156,23 @@ class Scoreboard:
 
     def record_reroute(self, latency_s: float) -> None:
         self.reroute_latencies_s.append(latency_s)
+
+    def record_mid_stream_failure(self) -> None:
+        self.mid_stream_failures += 1
+
+    def record_resume(self, replayed_tokens: int) -> None:
+        self.stream_resumes += 1
+        self.resume_replayed_tokens += replayed_tokens
+
+    def record_resume_ttft(self, ttft_s: float, cold_estimate_s: float) -> None:
+        """First token of a resumed leg (pause the client saw) next to
+        what a full recompute of prompt + delivered history would have
+        cost on the same profile."""
+        self.resume_ttft_s.append(ttft_s)
+        self.resume_cold_ttft_s.append(cold_estimate_s)
+
+    def record_parity_failure(self, request_id: str) -> None:
+        self.stream_parity_failures += 1
 
     def record_autoscale(self, t: float, desired_total: int) -> None:
         self.autoscale_history.append((t, desired_total))
@@ -277,6 +307,19 @@ class Scoreboard:
                     else 0.0
                 ),
                 "rerouted_requests": len(self.reroute_latencies_s),
+            },
+            "stream_continuation": {
+                "mid_stream_failures": self.mid_stream_failures,
+                "resumes": self.stream_resumes,
+                "resume_replayed_tokens": self.resume_replayed_tokens,
+                "parity_failures": self.stream_parity_failures,
+                "interrupted": self.outcomes.get("stream-interrupted", 0),
+                "resume_ttft_p50_ms": percentile(
+                    sorted(self.resume_ttft_s), 0.50
+                ) * 1e3,
+                "cold_recompute_ttft_p50_ms": percentile(
+                    sorted(self.resume_cold_ttft_s), 0.50
+                ) * 1e3,
             },
             "breaker": {
                 "trips_total": breaker_trips,
@@ -590,10 +633,66 @@ def inv_trough_util(min_util: float) -> Invariant:
     return check
 
 
+def inv_stream_continuation(min_resumes: int = 1) -> Invariant:
+    """THE failover bar (replica_kill's tightened gate): a mid-stream
+    replica death is never client-visible — no ``stream-interrupted`` or
+    ``stream-corrupt`` outcomes, no parity failures — AND at least
+    ``min_resumes`` streams actually continued on a fresh replica (the
+    zero-visible claim is vacuous if nothing was ever cut)."""
+    def check(board: dict) -> str | None:
+        sc = board.get("stream_continuation")
+        if sc is None:
+            return "scoreboard carries no stream_continuation section"
+        visible = (
+            sc["interrupted"]
+            + board["requests"]["outcomes"].get("stream-corrupt", 0)
+        )
+        if visible:
+            return f"{visible} client-visible stream failure(s)"
+        if sc["parity_failures"]:
+            return (
+                f"{sc['parity_failures']} resumed stream(s) diverged from "
+                "the uninterrupted expectation"
+            )
+        if sc["resumes"] < min_resumes:
+            return f"resumes {sc['resumes']} < {min_resumes}"
+        return None
+    return check
+
+
+def inv_resume_ttft_vs_cold(board: dict) -> str | None:
+    """Resume must be store-fetch-bound, not recompute-bound: p50 TTFT
+    of resumed legs beats the p50 deterministic cost of recomputing
+    prompt + delivered history from scratch (kv-federation.md gives the
+    fast path; requires the scenario to arm the store tier)."""
+    sc = board.get("stream_continuation")
+    if sc is None:
+        return "scoreboard carries no stream_continuation section"
+    if not sc["resumes"]:
+        return "no resumes recorded to compare"
+    if sc["resume_ttft_p50_ms"] >= sc["cold_recompute_ttft_p50_ms"]:
+        return (
+            f"resume p50 TTFT {sc['resume_ttft_p50_ms']:.2f}ms >= cold "
+            f"recompute p50 {sc['cold_recompute_ttft_p50_ms']:.2f}ms"
+        )
+    return None
+
+
 def inv_faults_fired(site: str, at_least: int = 1) -> Invariant:
     def check(board: dict) -> str | None:
         n = board["faults_injected"].get(site, 0)
         if n < at_least:
             return f"fault {site} fired {n} < {at_least} times"
+        return None
+    return check
+
+
+def inv_kills_recorded(at_least: int = 1) -> Invariant:
+    """Replica kills driven OUTSIDE the FaultPlan (the router-soak's
+    direct chaos task) still must provably have happened."""
+    def check(board: dict) -> str | None:
+        n = len(board["reroute"]["kills"])
+        if n < at_least:
+            return f"{n} replica kill(s) recorded < {at_least}"
         return None
     return check

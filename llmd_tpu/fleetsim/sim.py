@@ -70,6 +70,7 @@ from llmd_tpu.fleetsim.engines import (
     SimKVStore,
     SimReplica,
     StoreProfile,
+    expected_stream,
 )
 from llmd_tpu.fleetsim.scoreboard import Scoreboard
 from llmd_tpu.fleetsim.traces import TraceRequest
@@ -106,6 +107,11 @@ class FleetConfig:
     retry_backoff_cap_s: float = 0.25
     breaker_threshold: int = 2
     breaker_cooldown_s: float = 1.0
+    # Mid-stream failover budget (the router's max_resumes knob,
+    # fault-tolerance.md): how many times one cut stream may continue
+    # on a fresh replica before the failure is client-visible
+    # ("stream-interrupted"). 0 = the pre-failover router.
+    max_resumes: int = 2
     scrape_interval_s: float = 0.25
     unhealthy_after: int = 3
     chaos_tick_s: float = 0.05
@@ -116,6 +122,14 @@ class FleetConfig:
     # (None = no store, the pre-federation fleet).
     kv_store: StoreProfile | None = None
     prefix_cache_groups: int = 8  # per-replica local prefix-cache LRU cap
+    # Whether shared-prefix groups are VISIBLE to the router's
+    # approximate prefix scorer (group-id-led prompt text). True is the
+    # kv_federation scenario's subject — cache-affinity routing vs the
+    # store tier. False keeps routing load-spread while replicas still
+    # share prefixes through the store: the replica_kill shape, where
+    # Zipf-hot group affinity at 10^4 QPS would drown the failover
+    # signal in hot-replica queueing.
+    prefix_affinity_text: bool = True
     # Simulated idle time appended AFTER the last request drains, with
     # the control loops still running — the window where scale-down /
     # scale-to-zero behavior is observable. Free: it is virtual time.
@@ -422,7 +436,11 @@ class FleetSim:
         to the prefix length, so the router's approximate prefix
         scorer sees EXACTLY the overlap the store tier models."""
         total = treq.prompt_tokens * 4
-        if treq.prefix_group and treq.prefix_tokens > 0:
+        if (
+            treq.prefix_group
+            and treq.prefix_tokens > 0
+            and self.cfg.prefix_affinity_text
+        ):
             head_len = min(total, treq.prefix_tokens * 4)
             head = (treq.prefix_group + ":") * (
                 head_len // (len(treq.prefix_group) + 1) + 1
@@ -459,12 +477,22 @@ class FleetSim:
         tried: set[str] = set()
         prev_backoff = self.cfg.retry_backoff_s
         first_fail_after_kill: float | None = None
-        for attempt in range(self.cfg.max_schedule_attempts):
+        t_arrival = clock.monotonic()
+        client_first: float | None = None  # first byte the CLIENT saw
+        delivered: list[int] = []  # stitched client stream (all legs)
+        pre_failures = 0
+        resumes = 0
+        resume_pending = False  # next first-byte measures the resume TTFT
+        resume_cold_s = 0.0
+        while True:
             pods = eligible_pods(self.store.list(), tried, self.breaker)
             try:
                 result = self.scheduler.schedule(req, pods)
             except NoEndpointsError:
-                self.board.record_outcome(treq.tenant, "no-endpoints")
+                self.board.record_outcome(
+                    treq.tenant,
+                    "stream-interrupted" if delivered else "no-endpoints",
+                )
                 return
             pod = result.primary
             tried.add(pod.address)
@@ -483,20 +511,41 @@ class FleetSim:
                     "epp.endpoint.refuse", pod.address
                 ):
                     raise ReplicaUnreachable(pod.address)
-                async for _ in replica.serve(
+                async for toks in replica.serve(
                     req.request_id, treq.prompt_tokens, treq.output_tokens,
                     prefix_group=treq.prefix_group,
                     prefix_tokens=treq.prefix_tokens,
+                    resume_tokens=len(delivered),
                 ):
                     if first is None:
                         first = clock.monotonic()
+                        if client_first is None:
+                            client_first = first
+                        if resume_pending:
+                            # Continuation TTFT measured from the leg's
+                            # dispatch (the jittered backoff is protocol
+                            # overhead both sides of the comparison
+                            # would pay): store-fetch + tail prefill vs
+                            # the deterministic full-recompute cost of
+                            # prompt + delivered history.
+                            self.board.record_resume_ttft(
+                                first - t0, resume_cold_s
+                            )
+                            resume_pending = False
+                    delivered.extend(toks)
                 done = clock.monotonic()
                 self.breaker.record_success(pod.address)
-                ttft_s = (first if first is not None else done) - t0
+                ttft_s = (
+                    client_first if client_first is not None else done
+                ) - t_arrival
                 tpot_ms = None
-                if treq.output_tokens > 1 and first is not None:
-                    tpot_ms = (done - first) * 1e3 / (treq.output_tokens - 1)
-                pod.attrs["LastTTFT"] = ttft_s
+                if treq.output_tokens > 1 and client_first is not None:
+                    tpot_ms = (
+                        (done - client_first) * 1e3 / (treq.output_tokens - 1)
+                    )
+                pod.attrs["LastTTFT"] = (
+                    first if first is not None else done
+                ) - t0
                 pod.attrs["LastE2E"] = done - t0
                 if tpot_ms is not None:
                     pod.attrs["LastTPOT"] = tpot_ms / 1e3
@@ -507,8 +556,19 @@ class FleetSim:
                     )
                 if first_fail_after_kill is not None and first is not None:
                     self.board.record_reroute(first - first_fail_after_kill)
+                # Stitched-stream parity: the client's accumulated
+                # tokens must equal the uninterrupted baseline — a
+                # resume that restarted at the wrong position is
+                # CORRUPTION, not recovery, and counts client-visible.
+                if delivered != expected_stream(
+                    req.request_id, treq.output_tokens
+                ):
+                    self.board.record_parity_failure(req.request_id)
+                    self.board.record_outcome(treq.tenant, "stream-corrupt")
+                    return
                 self.board.record_completion(
-                    treq.tenant, pod.address, ttft_s, tpot_ms, attempt
+                    treq.tenant, pod.address, ttft_s, tpot_ms,
+                    pre_failures + resumes,
                 )
                 return
             except (ReplicaUnreachable, ReplicaDied):
@@ -519,22 +579,36 @@ class FleetSim:
                     self.board.record_breaker_open(
                         pod.address, clock.monotonic()
                     )
-                if first is not None:
-                    # Bytes already streamed: the router cannot replay
-                    # them — surface a typed stream error, never retry
-                    # into a duplicated prefix.
-                    self.board.record_outcome(
-                        treq.tenant, "stream-interrupted"
-                    )
-                    return
-                # Nothing streamed: treat like a failed scrape and
-                # re-pick (the production connection-error branch).
-                pod.healthy = False
-                if pod.address in self.board.kills and (
-                    first_fail_after_kill is None
-                ):
-                    first_fail_after_kill = clock.monotonic()
-                if attempt + 1 < self.cfg.max_schedule_attempts:
+                if first is not None or delivered:
+                    # Bytes already reached the client. The continuation
+                    # protocol (fault-tolerance.md) replays the
+                    # delivered history on a fresh replica — the client
+                    # sees a pause, not an error — bounded by the
+                    # max_resumes budget.
+                    if first is not None:
+                        self.board.record_mid_stream_failure()
+                        if resumes >= self.cfg.max_resumes:
+                            self.board.record_outcome(
+                                treq.tenant, "stream-interrupted"
+                            )
+                            return
+                        resumes += 1
+                        self.board.record_resume(len(delivered))
+                        tried = {pod.address}
+                        resume_pending = True
+                        resume_cold_s = (
+                            treq.prompt_tokens + len(delivered)
+                        ) / self.cfg.profile.prefill_tok_s
+                    elif pre_failures + 1 >= self.cfg.max_schedule_attempts:
+                        # A resume leg that failed before its first
+                        # byte ran out of pre-stream budget.
+                        self.board.record_outcome(
+                            treq.tenant, "stream-interrupted"
+                        )
+                        return
+                    else:
+                        pre_failures += 1
+                    pod.healthy = False
                     prev_backoff = backoff_delay(
                         prev_backoff,
                         self.cfg.retry_backoff_s,
@@ -542,6 +616,24 @@ class FleetSim:
                         self._retry_rng,
                     )
                     await asyncio.sleep(prev_backoff)
+                    continue
+                # Nothing streamed: treat like a failed scrape and
+                # re-pick (the production connection-error branch).
+                pod.healthy = False
+                if pod.address in self.board.kills and (
+                    first_fail_after_kill is None
+                ):
+                    first_fail_after_kill = clock.monotonic()
+                pre_failures += 1
+                if pre_failures >= self.cfg.max_schedule_attempts:
+                    break
+                prev_backoff = backoff_delay(
+                    prev_backoff,
+                    self.cfg.retry_backoff_s,
+                    self.cfg.retry_backoff_cap_s,
+                    self._retry_rng,
+                )
+                await asyncio.sleep(prev_backoff)
             finally:
                 pod.inflight = max(0, pod.inflight - 1)
                 pod.inflight_tokens = max(

@@ -27,8 +27,14 @@ from typing import Any
 import pydantic
 from aiohttp import web
 
+from llmd_tpu import faults
 from llmd_tpu.engine.request import PriorityClass, RequestOutput, SamplingParams
-from llmd_tpu.epp.types import HDR_EC_HOST, HDR_PRIORITY
+from llmd_tpu.epp.types import (
+    HDR_EC_HOST,
+    HDR_PRIORITY,
+    HDR_RESUME,
+    HDR_STREAM_TOKENS,
+)
 from llmd_tpu.obs.tracing import get_tracer
 from llmd_tpu.serve import protocol as P
 from llmd_tpu.serve.async_engine import (
@@ -218,13 +224,14 @@ async def _collect(
     lora_id: int = 0,
     lora_name: str = "",
     deadline_s: float | None = None,
+    resume_output_tokens: int = 0,
 ):
     """Run to completion; returns (text, finish_reason, final RequestOutput)."""
     finish = None
     final: RequestOutput | None = None
     async for out in engine.generate(rid, prompt_ids, sampling, priority,
                                      kv_transfer_params, lora_id, lora_name,
-                                     deadline_s):
+                                     deadline_s, resume_output_tokens):
         detok.feed(out.new_token_ids, final=out.finished)
         final = out
         if detok.stopped:
@@ -486,6 +493,9 @@ async def _stream_response(
     lora_id: int = 0,
     lora_name: str = "",
     deadline_s: float | None = None,
+    resume_output_tokens: int = 0,
+    stream_token_ids: bool = False,
+    resume_leg: bool = False,
 ) -> web.StreamResponse:
     resp = web.StreamResponse(
         headers={
@@ -495,15 +505,19 @@ async def _stream_response(
         }
     )
     await resp.prepare(request)
-    if chat:
+    if chat and not resume_leg:
+        # A resume leg continues an already-opened client stream: the
+        # role preamble went out with the first leg. `resume_leg` covers
+        # the empty-history replay too (HDR_RESUME: the upstream died
+        # after the preamble but before the first token frame).
         await resp.write(_sse(P.chat_chunk(rid, model, {"role": "assistant"}, None)))
     finish = None
-    n_out = 0
+    n_out = resume_output_tokens
     cached = 0
     try:
         async for out in engine.generate(rid, prompt_ids, sampling, priority,
                                          kv_transfer_params, lora_id, lora_name,
-                                         deadline_s):
+                                         deadline_s, resume_output_tokens):
             delta = detok.feed(out.new_token_ids, final=out.finished)
             n_out = out.num_output_tokens
             cached = out.num_cached_tokens
@@ -523,7 +537,21 @@ async def _stream_response(
                     if chat
                     else P.completion_chunk(rid, model, delta, None)
                 )
+                if stream_token_ids:
+                    # Raw token ids ride the frame for the router's
+                    # resume history (HDR_STREAM_TOKENS contract); the
+                    # router strips them before the client sees bytes.
+                    chunk["token_ids"] = list(out.new_token_ids)
                 await resp.write(_sse(chunk))
+                # Injection site: the replica "dies" mid-stream — the
+                # transport is severed without an SSE terminator, which
+                # is exactly what a crashed engine looks like to the
+                # router's upstream read loop.
+                if faults.fires("serve.stream.cut", rid):
+                    engine.abort(rid)
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
             if finish is not None:
                 break
     except (RequestFailed, EngineError) as e:
@@ -543,7 +571,9 @@ async def _stream_response(
         if chat
         else P.completion_chunk(rid, model, "", finish)
     )
-    final["usage"] = P.usage_dict(len(prompt_ids), n_out, cached)
+    final["usage"] = P.usage_dict(
+        len(prompt_ids) - resume_output_tokens, n_out, cached
+    )
     await resp.write(_sse(final))
     await resp.write(b"data: [DONE]\n\n")
     await resp.write_eof()
@@ -687,6 +717,78 @@ async def _stream_response_multi(
     return resp
 
 
+def _validate_resume(resume_ids, max_tokens: int, n: int = 1) -> str | None:
+    """Shared resume-admission validation for every generate surface
+    (OpenAI + vllmgrpc): None = admissible, else the 400 message. The
+    caller counts `stream_resume_failures_total` on rejection."""
+    if n != 1:
+        return "resume_token_ids requires n == 1"
+    if not (
+        isinstance(resume_ids, list)
+        and all(isinstance(t, int) and 0 <= t for t in resume_ids)
+    ):
+        return "resume_token_ids must be non-negative token ids"
+    if len(resume_ids) > max_tokens:
+        return (
+            f"resume history of {len(resume_ids)} tokens exceeds the "
+            f"request's max_tokens {max_tokens}"
+        )
+    return None
+
+
+def _resume_finished(
+    prompt_len: int,
+    resume_ids: list[int],
+    sampling: SamplingParams,
+    max_len: int,
+) -> str | None:
+    """Finish reason already reached by the DELIVERED history — the dead
+    replica emitted the terminal token but its finish frame was lost.
+    Mirrors the engine's stop-check order (stop token, then length)."""
+    if (
+        not sampling.ignore_eos
+        and resume_ids
+        and resume_ids[-1] in sampling.stop_token_ids
+    ):
+        return "stop"
+    if len(resume_ids) >= sampling.max_tokens:
+        return "length"
+    if prompt_len + len(resume_ids) >= max_len:
+        return "length"
+    return None
+
+
+async def _finish_only_stream(
+    request: web.Request,
+    rid: str,
+    model: str,
+    chat: bool,
+    finish: str,
+    usage: dict,
+) -> web.StreamResponse:
+    """Resume leg with nothing left to generate: only the terminal frame
+    (+ usage + [DONE]) was lost with the dead replica — emit exactly
+    that, so the stitched client stream matches an uninterrupted one."""
+    resp = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "x-request-id": rid,
+        }
+    )
+    await resp.prepare(request)
+    final = (
+        P.chat_chunk(rid, model, {}, finish)
+        if chat
+        else P.completion_chunk(rid, model, "", finish)
+    )
+    final["usage"] = usage
+    await resp.write(_sse(final))
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
 class UnknownModelError(Exception):
     pass
 
@@ -737,6 +839,16 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     rid = request.headers.get("x-request-id") or P.request_id(
         "chatcmpl" if chat else "cmpl"
     )
+    # Mid-stream failover resume (docs/architecture/fault-tolerance.md):
+    # the delivered history becomes committed prefix; the response
+    # carries ONLY the continuation, starting at the exact next output
+    # position (byte-identical for greedy and seeded streams).
+    resume_ids = list(req.resume_token_ids or [])
+    if resume_ids:
+        reject = _validate_resume(resume_ids, max_tokens, req.n)
+        if reject is not None:
+            engine.stats.stream_resume_failures_total += 1
+            return _error(400, reject)
     try:
         lora_id, lora_name = _resolve_lora(request, req.model)
     except UnknownModelError:
@@ -756,6 +868,36 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     span.set("llm_d.request.streaming", bool(req.stream))
     deadline_s = _request_deadline_s(request)
     priority = _effective_priority(request, req.priority)
+    stream_token_ids = request.headers.get(HDR_STREAM_TOKENS, "") == "1"
+    resume_leg = bool(resume_ids) or (
+        request.headers.get(HDR_RESUME, "") == "1"
+    )
+
+    engine_prompt_ids = prompt_ids
+    resume_text_base = 0
+    if resume_ids:
+        span.set("llm_d.resume.tokens", len(resume_ids))
+        fin = _resume_finished(len(prompt_ids), resume_ids, sampling, max_len)
+        # Replaying the history through a fresh detokenizer reproduces
+        # the exact text the first leg emitted (decode-then-diff is
+        # deterministic), so deltas continue mid-UTF-8 and mid-holdback.
+        detok.feed(resume_ids, final=fin is not None)
+        if fin is None and detok.stopped:
+            fin = "stop"  # history ends exactly on a stop string
+        resume_text_base = len(detok.emitted)
+        if fin is not None:
+            span.end()
+            usage = P.usage_dict(len(prompt_ids), len(resume_ids))
+            if req.stream:
+                return await _finish_only_stream(
+                    request, rid, model, chat, fin, usage
+                )
+            builder = P.chat_response if chat else P.completion_response
+            return web.json_response(
+                builder(rid, model, "", fin, usage),
+                headers={"x-request-id": rid},
+            )
+        engine_prompt_ids = prompt_ids + resume_ids
 
     if req.stream:
         try:
@@ -767,9 +909,12 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
                     lora_id, lora_name, deadline_s,
                 )
             return await _stream_response(
-                request, engine, rid, model, prompt_ids, sampling, detok,
-                priority, req.kv_transfer_params, chat, span,
+                request, engine, rid, model, engine_prompt_ids, sampling,
+                detok, priority, req.kv_transfer_params, chat, span,
                 lora_id, lora_name, deadline_s,
+                resume_output_tokens=len(resume_ids),
+                stream_token_ids=stream_token_ids,
+                resume_leg=resume_leg,
             )
         except BaseException as e:
             span.error(str(e))
@@ -779,8 +924,9 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
     try:
         if req.n == 1:
             choices = [await _collect(
-                engine, rid, prompt_ids, sampling, detok, priority,
+                engine, rid, engine_prompt_ids, sampling, detok, priority,
                 req.kv_transfer_params, lora_id, lora_name, deadline_s,
+                resume_output_tokens=len(resume_ids),
             )]
         else:
             # n parallel samples share the prompt (and its cached prefix).
@@ -815,6 +961,10 @@ async def _handle_generate(request: web.Request, chat: bool) -> web.StreamRespon
                 await asyncio.gather(*tasks, return_exceptions=True)
                 raise
         text, finish, final = choices[0]
+        if resume_text_base:
+            # The response body carries ONLY the continuation; the
+            # client already holds the replayed history's text.
+            text = text[resume_text_base:]
     except RequestFailed as e:
         span.error(str(e))
         span.end()
@@ -955,6 +1105,36 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         lora_id, lora_name = _resolve_lora(request, str(body.get("model") or ""))
     except UnknownModelError as e:
         return _error(404, f"model {e.args[0]!r} not found")
+    resume_ids = body.get("resume_token_ids") or []
+    if resume_ids:
+        reject = _validate_resume(resume_ids, sampling.max_tokens)
+        if reject is not None:
+            engine.stats.stream_resume_failures_total += 1
+            return _error(400, reject)
+        fin = _resume_finished(len(ids), resume_ids, sampling, max_len)
+        if fin is not None:
+            usage = P.usage_dict(len(ids), len(resume_ids))
+            if body.get("stream", False):
+                resp = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                        "x-request-id": rid,
+                    }
+                )
+                await resp.prepare(request)
+                await resp.write(_sse({"finish_reason": fin, "usage": usage}))
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            return web.json_response(
+                {"id": rid, "model": model, "token_ids": [],
+                 "finish_reason": fin, "usage": usage,
+                 "kv_transfer_params": None},
+                headers={"x-request-id": rid},
+            )
+        ids = ids + resume_ids
+    n_resume = len(resume_ids)
 
     if body.get("stream", False):
         resp = web.StreamResponse(
@@ -968,10 +1148,17 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
         final = None
         try:
             async for out in engine.generate(rid, ids, sampling, priority, kvp,
-                                             lora_id, lora_name, deadline_s):
+                                             lora_id, lora_name, deadline_s,
+                                             n_resume):
                 final = out
                 if out.new_token_ids:
                     await resp.write(_sse({"token_ids": list(out.new_token_ids)}))
+                    # Same mid-stream kill site as the OpenAI surface.
+                    if faults.fires("serve.stream.cut", rid):
+                        engine.abort(rid)
+                        if request.transport is not None:
+                            request.transport.close()
+                        return resp
         except (RequestFailed, EngineError) as e:
             await resp.write(_sse(P.error_body(str(e), code=_error_status(e))))
             await resp.write(b"data: [DONE]\n\n")
@@ -988,8 +1175,8 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
                         else None
                     ),
                     "usage": P.usage_dict(
-                        len(ids),
-                        final.num_output_tokens if final else 0,
+                        len(ids) - n_resume,
+                        final.num_output_tokens if final else n_resume,
                         final.num_cached_tokens if final else 0,
                     ),
                 }
@@ -1003,7 +1190,8 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
     final = None
     try:
         async for out in engine.generate(rid, ids, sampling, priority, kvp,
-                                         lora_id, lora_name, deadline_s):
+                                         lora_id, lora_name, deadline_s,
+                                         n_resume):
             final = out
             out_ids.extend(out.new_token_ids)
     except RequestFailed as e:
@@ -1027,8 +1215,8 @@ async def handle_grpc_generate(request: web.Request) -> web.StreamResponse:
                 else None
             ),
             "usage": P.usage_dict(
-                len(ids),
-                final.num_output_tokens if final else 0,
+                len(ids) - n_resume,
+                final.num_output_tokens if final else n_resume,
                 final.num_cached_tokens if final else 0,
             ),
             "kv_transfer_params": final.kv_transfer_params if final else None,

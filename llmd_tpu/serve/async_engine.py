@@ -33,6 +33,10 @@ class _Pending:
     kv_transfer_params: dict[str, Any] | None = None
     lora_id: int = 0
     lora_name: str = ""
+    # Mid-stream failover: the prompt's last N tokens are output already
+    # delivered to the client by a dead replica; generation continues at
+    # output position N (docs/architecture/fault-tolerance.md).
+    resume_output_tokens: int = 0
 
 
 def _release_pulled(engine, kv_transfer_params) -> None:
@@ -250,6 +254,7 @@ class AsyncEngine:
         kv_transfer_params: dict[str, Any] | None = None,
         lora_id: int = 0,
         lora_name: str = "",
+        resume_output_tokens: int = 0,
     ) -> asyncio.Queue:
         """Queue a request for the engine thread; returns its output queue."""
         q: asyncio.Queue = asyncio.Queue()
@@ -259,7 +264,8 @@ class AsyncEngine:
             self._subs[request_id] = q
             self._inbox.append(
                 _Pending(request_id, prompt_token_ids, sampling, priority,
-                         kv_transfer_params, lora_id, lora_name)
+                         kv_transfer_params, lora_id, lora_name,
+                         resume_output_tokens)
             )
             self._lock.notify_all()
         return q
@@ -289,6 +295,7 @@ class AsyncEngine:
         lora_id: int = 0,
         lora_name: str = "",
         deadline_s: float | None = None,
+        resume_output_tokens: int = 0,
     ) -> AsyncIterator[RequestOutput]:
         """Async stream of incremental outputs until the request finishes.
 
@@ -352,7 +359,8 @@ class AsyncEngine:
             kv_transfer_params = {**kv_transfer_params, "__pulled__": bundle}
         try:
             q = self.submit(request_id, prompt_token_ids, sampling, priority,
-                            kv_transfer_params, lora_id, lora_name)
+                            kv_transfer_params, lora_id, lora_name,
+                            resume_output_tokens)
         except Exception:
             # A bundle that never reaches apply must release its pages.
             _release_pulled(self.engine, kv_transfer_params)
@@ -439,6 +447,7 @@ class AsyncEngine:
                         kv_transfer_params=p.kv_transfer_params,
                         lora_id=p.lora_id,
                         lora_name=p.lora_name,
+                        resume_output_tokens=p.resume_output_tokens,
                     )
                 # llmd: allow(broad-except) -- surfaced: the caller receives it as a RequestFailed terminal item
                 except Exception as e:  # validation errors -> caller

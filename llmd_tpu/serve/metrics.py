@@ -119,6 +119,13 @@ def render_metrics(
         "engine_watchdog_stalls_total": stats.engine_watchdog_stalls_total,
         "kv_bundle_crc_failures_total": stats.kv_bundle_crc_failures_total,
         "kv_recompute_fallbacks_total": stats.kv_recompute_fallbacks_total,
+        # Mid-stream failover (the stream-continuation contract,
+        # fault-tolerance.md): resume admissions, the delivered tokens
+        # they replayed as committed prefix, and resume requests the
+        # serving layer rejected.
+        "stream_resumes_total": stats.stream_resumes_total,
+        "resume_replayed_tokens_total": stats.resume_replayed_tokens_total,
+        "stream_resume_failures_total": stats.stream_resume_failures_total,
     }
     if stats.swa_ring_pages:
         # Hybrid-APC section retention activity

@@ -39,6 +39,12 @@ class CompletionRequest(_Base):
     priority: int = 0
     stop_token_ids: list[int] | None = None
     kv_transfer_params: dict[str, Any] | None = None
+    # Mid-stream failover resume (docs/architecture/fault-tolerance.md):
+    # output tokens a previous replica already delivered for this exact
+    # request. The engine admits them as committed prefix and continues
+    # generation at the next output position; the response carries ONLY
+    # the continuation.
+    resume_token_ids: list[int] | None = None
 
 
 class ChatMessage(_Base):
@@ -64,6 +70,8 @@ class ChatCompletionRequest(_Base):
     priority: int = 0
     stop_token_ids: list[int] | None = None
     kv_transfer_params: dict[str, Any] | None = None
+    # Mid-stream failover resume: see CompletionRequest.resume_token_ids.
+    resume_token_ids: list[int] | None = None
 
 
 def stop_strings(stop: Union[str, list[str], None]) -> list[str]:

@@ -553,6 +553,41 @@ DASHBOARDS["llmd-failure-saturation"] = dashboard(
               desc="healthy-filter saw a wholly-unhealthy pool and passed "
                    "it through — usually a telemetry outage, not a fleet "
                    "outage."),
+        row("Stream continuation (fault-tolerance.md)"),
+        panel("Mid-stream upstream failures /s",
+              ["rate(llm_d_epp_mid_stream_failures_total[5m])"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.01, "yellow"), (0.1, "red")],
+              desc="Upstream streams cut after first byte (replica death "
+                   "mid-decode). Each one either resumes transparently or "
+                   "surfaces a terminal error frame."),
+        panel("Stream resumes /s",
+              ["rate(llm_d_epp_stream_resumes_total[5m])",
+               f"rate(llmd:stream_resumes_total{M}[5m])"],
+              legends=["router re-picks", "engine resume admissions"],
+              w=8, h=4,
+              desc="Cut streams continued on a fresh replica: the router "
+                   "replays the delivered history; the engine admits it "
+                   "as prefill of committed prefix and continues at the "
+                   "exact next output position."),
+        panel("Resume replayed tokens /s",
+              ["rate(llm_d_epp_resume_replayed_tokens_total[5m])",
+               f"rate(llmd:resume_replayed_tokens_total{M}[5m])"],
+              legends=["router", "engine"], w=8, h=4,
+              desc="Delivered-history tokens re-admitted as committed "
+                   "prefix. Store/prefix-cache hits keep this cheap — "
+                   "resume TTFT should be store-fetch-bound, not "
+                   "recompute-bound (kv-federation.md)."),
+        panel("Stream resume failures",
+              ["llm_d_epp_stream_resume_failures_total",
+               f"llmd:stream_resume_failures_total{M}"],
+              legends=["router (budget/deadline exhausted)",
+                       "engine (rejected resume)"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (1, "red")],
+              desc="Client-visible stream failures: the resume budget or "
+                   "deadline ran out (router) or the replay was rejected "
+                   "(engine). The fleet target is zero."),
         panel("Transfer failures by stage/policy",
               ["sum by (stage, policy) "
                "(rate(llmd:kv_transfer_failures_total[5m]))"], w=8,

@@ -1,0 +1,752 @@
+"""Mid-stream failover: transparent request resume across replica death.
+
+The stream-continuation contract (docs/architecture/fault-tolerance.md):
+
+- serve/engine admit a RESUME — a request carrying the output history a
+  dead replica already delivered — as prefill of committed prefix and
+  continue at the exact next output position, byte-identical for greedy
+  AND seeded streams (kill at token 1, mid-stream, last token);
+- the router detects a mid-stream upstream failure, feeds the circuit
+  breaker (EVEN with resume disabled — the PR 7 gap), re-picks
+  excluding the dead endpoint, and replays with the accumulated prefix
+  so the client sees a pause, not an error — bounded by ``max_resumes``
+  and the per-request deadline, with the terminal error surfaced
+  faithfully when exhausted.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmd_tpu import faults
+from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+from llmd_tpu.epp.config import DEFAULT_CONFIG, build_flow_control, build_scheduler
+from llmd_tpu.epp.datalayer import EndpointStore, MetricsCollector
+from llmd_tpu.epp.server import Router, _StreamState
+from llmd_tpu.epp.types import Endpoint
+
+pytestmark = pytest.mark.anyio
+
+
+@pytest.fixture(scope="module")
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+def make_engine_app():
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    cfg = EngineConfig(
+        model=tiny_model_config(vocab_size=512, max_model_len=128),
+        cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+    )
+    return build_app(AsyncEngine(LLMEngine(cfg)), ByteTokenizer(), "tiny", 128)
+
+
+async def read_stream(resp):
+    """Parse an SSE completion stream into (tokens, text, finish, error,
+    usage). ``tokens`` come from `token_ids` annotations when present."""
+    tokens, text, finish, err, usage = [], "", None, None, None
+    async for line in resp.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            break
+        d = json.loads(payload)
+        if "error" in d:
+            err = d["error"]
+            continue
+        tokens.extend(d.get("token_ids") or [])
+        ch = d.get("choices") or [{}]
+        text += ch[0].get("text") or ""
+        if ch[0].get("finish_reason"):
+            finish = ch[0]["finish_reason"]
+        if d.get("usage"):
+            usage = d["usage"]
+    return tokens, text, finish, err, usage
+
+
+# --------------------------------------------------------------------- #
+# serve/engine: resume admission parity (two engines, direct)
+
+
+@pytest.fixture(scope="module")
+async def engines():
+    a = TestClient(TestServer(make_engine_app()))
+    b = TestClient(TestServer(make_engine_app()))
+    await a.start_server()
+    await b.start_server()
+    yield a, b
+    await a.close()
+    await b.close()
+
+
+async def _baseline(client, body):
+    r = await client.post(
+        "/v1/completions", json=body, headers={"x-llmd-stream-tokens": "1"}
+    )
+    assert r.status == 200, await r.text()
+    return await read_stream(r)
+
+
+@pytest.mark.parametrize("seed,temp", [(None, 0.0), (11, 0.8)])
+async def test_resume_byte_parity_all_cut_points(engines, seed, temp):
+    """Greedy and seeded streams killed at token 1, mid-stream, and at
+    the last token resume on a SECOND engine byte-identically: stitched
+    tokens, text, finish reason, and usage all match the uninterrupted
+    baseline."""
+    a, b = engines
+    body = {
+        "prompt": "resume parity matrix", "max_tokens": 12,
+        "temperature": temp, "stream": True,
+    }
+    if seed is not None:
+        body["seed"] = seed
+    toks, text, fin, err, usage = await _baseline(a, body)
+    assert err is None and len(toks) == 12 and fin == "length"
+    for cut in (1, len(toks) // 2, len(toks) - 1, len(toks)):
+        rbody = {**body, "resume_token_ids": toks[:cut]}
+        r = await b.post(
+            "/v1/completions", json=rbody,
+            headers={"x-llmd-stream-tokens": "1"},
+        )
+        assert r.status == 200, await r.text()
+        rt, rx, rf, rerr, rusage = await read_stream(r)
+        assert rerr is None
+        assert toks[:cut] + rt == toks, f"cut={cut}: token stream diverged"
+        assert text.endswith(rx) and text[: len(text) - len(rx)] + rx == text
+        assert rf == fin
+        assert rusage["completion_tokens"] == usage["completion_tokens"]
+        assert rusage["prompt_tokens"] == usage["prompt_tokens"]
+
+
+async def test_resume_nonstreaming_continuation(engines):
+    """The non-streaming surface carries only the continuation text and
+    full-request usage."""
+    a, b = engines
+    body = {"prompt": "nonstream resume", "max_tokens": 8, "temperature": 0.0}
+    toks, text, fin, _, usage = await _baseline(
+        a, {**body, "stream": True}
+    )
+    r = await b.post(
+        "/v1/completions", json={**body, "resume_token_ids": toks[:3]}
+    )
+    assert r.status == 200
+    d = await r.json()
+    # The body carries only the continuation (the byte-level split is
+    # pinned by the streaming matrix above; this surface may decode to
+    # empty text when the tail is partial UTF-8).
+    assert text.endswith(d["choices"][0]["text"])
+    assert d["choices"][0]["finish_reason"] == fin
+    assert d["usage"]["completion_tokens"] == usage["completion_tokens"]
+    assert d["usage"]["prompt_tokens"] == usage["prompt_tokens"]
+
+
+async def test_resume_after_stop_token_finishes_immediately(engines):
+    """History ending on a stop token (the dead replica emitted the
+    terminal token; its finish frame was lost) finishes 'stop' without
+    touching the engine."""
+    a, b = engines
+    body = {"prompt": "stop resume", "max_tokens": 12, "temperature": 0.0,
+            "stream": True}
+    toks, _, fin, _, _ = await _baseline(a, body)
+    stop_tok = toks[4]
+    sbody = {**body, "stop_token_ids": [stop_tok]}
+    st, _, sf, _, susage = await _baseline(a, sbody)
+    assert sf == "stop" and st[-1] == stop_tok
+    r = await b.post(
+        "/v1/completions", json={**sbody, "resume_token_ids": st},
+        headers={"x-llmd-stream-tokens": "1"},
+    )
+    rt, rx, rf, rerr, rusage = await read_stream(r)
+    assert rerr is None and rt == [] and rx == ""
+    assert rf == "stop"
+    assert rusage["completion_tokens"] == susage["completion_tokens"]
+
+
+async def test_resume_grpc_surface_parity(engines):
+    """Token-in/token-out surface: same replay contract."""
+    a, b = engines
+    ids = [7, 8, 9, 10, 11]
+    body = {"prompt_token_ids": ids,
+            "sampling_params": {"max_tokens": 10, "temperature": 0.0,
+                                "ignore_eos": True}}
+    r = await a.post("/vllm.Generation/Generate", json=body)
+    base = await r.json()
+    assert len(base["token_ids"]) == 10, base
+    r = await b.post(
+        "/vllm.Generation/Generate",
+        json={**body, "resume_token_ids": base["token_ids"][:4]},
+    )
+    d = await r.json()
+    assert base["token_ids"][:4] + d["token_ids"] == base["token_ids"]
+    assert d["finish_reason"] == base["finish_reason"]
+    assert d["usage"] == base["usage"]
+    # Full history: only the lost terminal frame is re-emitted.
+    r = await b.post(
+        "/vllm.Generation/Generate",
+        json={**body, "resume_token_ids": base["token_ids"]},
+    )
+    d = await r.json()
+    assert d["token_ids"] == [] and d["finish_reason"] == "length"
+
+
+async def test_resume_validation_rejections_count(engines):
+    a, _ = engines
+    app = a.server.app
+    from llmd_tpu.serve.api import ENGINE_KEY
+
+    stats = app[ENGINE_KEY].stats
+    before = stats.stream_resume_failures_total
+    r = await a.post("/v1/completions", json={
+        "prompt": "x", "max_tokens": 4, "n": 2, "resume_token_ids": [1],
+    })
+    assert r.status == 400
+    r = await a.post("/v1/completions", json={
+        "prompt": "x", "max_tokens": 2, "resume_token_ids": [1, 2, 3],
+    })
+    assert r.status == 400
+    assert stats.stream_resume_failures_total == before + 2
+
+
+async def test_resume_admission_counts_engine_metrics(engines):
+    a, b = engines
+    body = {"prompt": "metrics resume", "max_tokens": 6,
+            "temperature": 0.0, "stream": True}
+    toks, _, _, _, _ = await _baseline(a, body)
+    from llmd_tpu.serve.api import ENGINE_KEY
+
+    stats = b.server.app[ENGINE_KEY].stats
+    r0, t0 = stats.stream_resumes_total, stats.resume_replayed_tokens_total
+    r = await b.post(
+        "/v1/completions", json={**body, "resume_token_ids": toks[:2]}
+    )
+    await read_stream(r)
+    assert stats.stream_resumes_total == r0 + 1
+    assert stats.resume_replayed_tokens_total == t0 + 2
+    page = await (await b.get("/metrics")).text()
+    assert "llmd:stream_resumes_total" in page
+    assert "llmd:resume_replayed_tokens_total" in page
+    assert "llmd:stream_resume_failures_total" in page
+
+
+# --------------------------------------------------------------------- #
+# router: transparent failover over real engines
+
+
+@pytest.fixture
+async def routed(engines):
+    a, b = engines
+    store = EndpointStore()
+    for c in (a, b):
+        store.upsert(Endpoint(
+            address=f"{c.server.host}:{c.server.port}",
+            labels={"llm-d.ai/engine-type": "llmd"},
+        ))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(DEFAULT_CONFIG),
+        flow_control=build_flow_control(DEFAULT_CONFIG),
+        collector=MetricsCollector(store, interval_s=0.2),
+        max_resumes=2,
+        retry_backoff_s=0.001,
+        retry_backoff_cap_s=0.01,
+    )
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    yield rc, router
+    await rc.close()
+
+
+@pytest.mark.parametrize("seed,temp", [(None, 0.0), (23, 0.7)])
+async def test_router_transparent_resume_byte_identical(routed, seed, temp):
+    """A replica dying mid-stream behind the router is INVISIBLE to the
+    client: the stitched stream equals the no-fault baseline, greedy and
+    seeded."""
+    rc, router = routed
+    body = {"prompt": f"router failover {seed}", "max_tokens": 10,
+            "temperature": temp, "stream": True}
+    if seed is not None:
+        body["seed"] = seed
+    r = await rc.post("/v1/completions", json=body)
+    bt, bx, bf, berr, busage = await read_stream(r)
+    assert berr is None and bf == "length"
+    assert bt == [], "token annotations must never reach the client"
+    before = router.metrics.stream_resumes
+    faults.arm(faults.FaultPlan(
+        [faults.FaultSpec(site="serve.stream.cut", after=2, times=1)],
+        seed=3,
+    ))
+    r = await rc.post("/v1/completions", json=body)
+    t, x, f, err, usage = await read_stream(r)
+    faults.disarm()
+    assert err is None
+    assert (x, f) == (bx, bf), "resumed stream diverged from baseline"
+    assert usage["completion_tokens"] == busage["completion_tokens"]
+    assert router.metrics.stream_resumes == before + 1
+
+
+async def test_router_resume_disabled_feeds_breaker(routed):
+    """THE PR 7 regression: a mid-stream disconnect must count as a
+    breaker failure even when resume is disabled — and the client gets
+    a faithful terminal error frame, not a silent truncation."""
+    rc, router = routed
+    router.max_resumes = 0
+    router.breaker = EndpointCircuitBreaker(failure_threshold=1,
+                                            cooldown_s=60.0)
+    body = {"prompt": "breaker regression", "max_tokens": 10,
+            "temperature": 0.0, "stream": True}
+    faults.arm(faults.FaultPlan(
+        [faults.FaultSpec(site="serve.stream.cut", after=2, times=1)],
+        seed=5,
+    ))
+    r = await rc.post("/v1/completions", json=body)
+    _, _, _, err, _ = await read_stream(r)
+    faults.disarm()
+    assert err is not None and err["code"] == 502
+    assert router.breaker.trips_total == 1
+    assert len(router.breaker.open_endpoints()) == 1
+    page = await (await rc.get("/metrics")).text()
+    assert "llm_d_epp_mid_stream_failures_total 1" in page
+    assert "llm_d_epp_stream_resume_failures_total 1" in page
+    assert "llm_d_epp_circuit_open" in page
+
+
+async def test_router_resume_exhausted_surfaces_terminal_error(routed):
+    """EVERY replica dies mid-stream repeatedly: the resume budget runs
+    out and the terminal error frame carries the real cause."""
+    rc, router = routed
+    assert router.max_resumes == 2
+    faults.arm(faults.FaultPlan(
+        [faults.FaultSpec(site="serve.stream.cut", after=1, times=None)],
+        seed=7,
+    ))
+    r = await rc.post("/v1/completions", json={
+        "prompt": "exhaustion", "max_tokens": 10, "temperature": 0.0,
+        "stream": True,
+    })
+    _, _, _, err, _ = await read_stream(r)
+    faults.disarm()
+    assert err is not None and err["code"] == 502
+    assert "resume budget" in err["message"]
+    assert router.metrics.stream_resumes == 2
+    assert router.metrics.stream_resume_failures == 1
+
+
+# --------------------------------------------------------------------- #
+# router unit legs over a scripted upstream (deterministic timing)
+
+
+class ScriptedUpstream:
+    """An upstream whose streaming behavior is fully scripted: emit N
+    frames (optionally slowly), then die / finish / reject resumes."""
+
+    def __init__(self, frames=3, die=True, frame_sleep=0.0,
+                 reject_resume=False, total=8, die_mid_frame=False):
+        self.frames = frames
+        self.die = die
+        self.frame_sleep = frame_sleep
+        self.reject_resume = reject_resume
+        self.total = total
+        self.die_mid_frame = die_mid_frame
+        self.requests: list[dict] = []
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        self.requests.append(body)
+        resume = list(body.get("resume_token_ids") or [])
+        if resume and self.reject_resume:
+            return web.json_response({"error": {"message": "no resume"}},
+                                     status=422)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        start = len(resume)
+        emitted = 0
+        for i in range(start, self.total):
+            if self.die and emitted >= self.frames:
+                if self.die_mid_frame and not resume:
+                    # Crash inside a frame: a truncated half-line is on
+                    # the wire when the transport dies.
+                    await resp.write(b'data: {"choices":[{"index":0,"te')
+                request.transport.close()
+                return resp
+            if self.frame_sleep:
+                await asyncio.sleep(self.frame_sleep)
+            await resp.write(
+                b"data: " + json.dumps(
+                    {"choices": [{"index": 0, "text": f"t{i} ",
+                                  "finish_reason": None}],
+                     "token_ids": [100 + i]},
+                    separators=(",", ":"),
+                ).encode() + b"\n\n")
+            emitted += 1
+        await resp.write(
+            b"data: " + json.dumps(
+                {"choices": [{"index": 0, "text": "",
+                              "finish_reason": "length"}]},
+                separators=(",", ":"),
+            ).encode() + b"\n\n")
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+
+async def _scripted_router(upstreams, **router_kw):
+    servers = []
+    store = EndpointStore()
+    for u in upstreams:
+        app = web.Application()
+        app.add_routes([web.post("/v1/completions", u.handle)])
+        s = TestServer(app)
+        await s.start_server()
+        servers.append(s)
+        store.upsert(Endpoint(address=f"{s.host}:{s.port}",
+                              labels={"llm-d.ai/engine-type": "llmd"}))
+    router = Router(
+        store=store,
+        scheduler=build_scheduler(DEFAULT_CONFIG),
+        flow_control=build_flow_control(DEFAULT_CONFIG),
+        retry_backoff_s=0.001,
+        retry_backoff_cap_s=0.01,
+        **router_kw,
+    )
+    rc = TestClient(TestServer(router.build_app()))
+    await rc.start_server()
+    return rc, router, servers
+
+
+async def test_router_deadline_bounds_resume():
+    """A cut past the request deadline is NOT resumed: the terminal
+    frame is a 504, surfaced faithfully."""
+    u = ScriptedUpstream(frames=3, die=True, frame_sleep=0.02)
+    rc, router, servers = await _scripted_router([u, u], max_resumes=2)
+    try:
+        r = await rc.post(
+            "/v1/completions",
+            json={"prompt": "deadline", "max_tokens": 8, "stream": True},
+            headers={"x-request-deadline-s": "0.03"},
+        )
+        _, _, _, err, _ = await read_stream(r)
+        assert err is not None and err["code"] == 504
+        assert "deadline" in err["message"]
+        assert router.metrics.stream_resumes == 0
+        assert router.metrics.mid_stream_failures == 1
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
+
+
+async def test_router_resume_replays_accumulated_prefix():
+    """The replay body carries exactly the delivered history, and the
+    stitched stream covers every position once."""
+    u = ScriptedUpstream(frames=3, die=True)
+    u2 = ScriptedUpstream(frames=99, die=False)
+    rc, router, servers = await _scripted_router([u, u2], max_resumes=2)
+    try:
+        r = await rc.post("/v1/completions", json={
+            "prompt": "prefix replay", "max_tokens": 8, "stream": True,
+        })
+        _, text, fin, err, _ = await read_stream(r)
+        assert err is None and fin == "length"
+        assert text == "".join(f"t{i} " for i in range(8))
+        resumed = [b for b in u.requests + u2.requests
+                   if b.get("resume_token_ids")]
+        assert len(resumed) == 1
+        assert resumed[0]["resume_token_ids"] == [100, 101, 102]
+        assert router.metrics.resume_replayed_tokens == 3
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
+
+
+async def test_router_resume_rejected_surfaces_status():
+    """An upstream 4xx on the REPLAY leg is terminal (another replica
+    would refuse the same body) and carries the upstream status."""
+    u = ScriptedUpstream(frames=2, die=True, reject_resume=True)
+    rc, router, servers = await _scripted_router(
+        [u, u], max_resumes=2,
+    )
+    try:
+        r = await rc.post("/v1/completions", json={
+            "prompt": "reject", "max_tokens": 8, "stream": True,
+        })
+        _, _, _, err, _ = await read_stream(r)
+        assert err is not None and err["code"] == 422
+        assert router.metrics.stream_resume_failures == 1
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
+
+
+async def test_router_mid_frame_cut_drops_stale_carry():
+    """An upstream dying INSIDE a frame leaves a truncated half-line in
+    the reassembly carry: it must be dropped at resume, never prefixed
+    onto the continuation's first frame."""
+    u = ScriptedUpstream(frames=3, die=True, die_mid_frame=True)
+    u2 = ScriptedUpstream(frames=99, die=False)
+    rc, router, servers = await _scripted_router([u, u2], max_resumes=2)
+    try:
+        r = await rc.post("/v1/completions", json={
+            "prompt": "mid frame cut", "max_tokens": 8, "stream": True,
+        })
+        _, text, fin, err, _ = await read_stream(r)
+        assert err is None and fin == "length"
+        assert text == "".join(f"t{i} " for i in range(8))
+        assert router.metrics.stream_resumes == 1
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
+
+
+async def test_router_extends_client_supplied_resume_history():
+    """A client-initiated resume (body already carries resume_token_ids)
+    that is itself cut mid-stream must replay the FULL history — client
+    history + this session's accumulated tokens — not restart from the
+    session's tokens alone."""
+    u = ScriptedUpstream(frames=2, die=True)
+    u2 = ScriptedUpstream(frames=99, die=False)
+    rc, router, servers = await _scripted_router([u, u2], max_resumes=2)
+    try:
+        r = await rc.post("/v1/completions", json={
+            "prompt": "client resume", "max_tokens": 8, "stream": True,
+            "resume_token_ids": [100, 101],
+        })
+        _, text, fin, err, _ = await read_stream(r)
+        assert err is None and fin == "length"
+        # Leg 1 continues at position 2; the client receives 2..7 only.
+        assert text == "".join(f"t{i} " for i in range(2, 8))
+        replay = [b for b in u.requests + u2.requests
+                  if len(b.get("resume_token_ids") or []) > 2]
+        assert len(replay) == 1
+        assert replay[0]["resume_token_ids"] == [100, 101, 102, 103]
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
+
+
+async def test_router_grpc_stream_tokens_reach_client(routed):
+    """vllmgrpc surface: token_ids IS the payload — the resume-armed
+    router must forward it untouched, and a mid-stream kill must still
+    resume byte-identically."""
+    rc, router = routed
+    body = {"prompt_token_ids": [5, 6, 7, 8],
+            "sampling_params": {"max_tokens": 8, "temperature": 0.0,
+                                "ignore_eos": True},
+            "stream": True}
+
+    async def grpc_tokens():
+        r = await rc.post("/vllm.Generation/Generate", json=body)
+        assert r.status == 200, await r.text()
+        toks, fin, err = [], None, None
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            d = json.loads(line[6:])
+            if "error" in d:
+                err = d["error"]
+            toks.extend(d.get("token_ids") or [])
+            if d.get("finish_reason"):
+                fin = d["finish_reason"]
+        return toks, fin, err
+
+    base, bfin, berr = await grpc_tokens()
+    assert berr is None and len(base) == 8, (base, berr)
+    faults.arm(faults.FaultPlan(
+        [faults.FaultSpec(site="serve.stream.cut", after=2, times=1)],
+        seed=9,
+    ))
+    toks, fin, err = await grpc_tokens()
+    faults.disarm()
+    assert err is None
+    assert toks == base and fin == bfin
+
+
+async def test_router_client_disconnect_is_not_an_upstream_failure():
+    """A client closing its connection mid-stream must NOT feed the
+    breaker, mark the (healthy) upstream unhealthy, or trigger replay
+    generations nobody will read."""
+    u = ScriptedUpstream(frames=99, die=False, frame_sleep=0.02, total=32)
+    rc, router, servers = await _scripted_router([u], max_resumes=2)
+    try:
+        resp = await rc.post("/v1/completions", json={
+            "prompt": "impatient client", "max_tokens": 32, "stream": True,
+        })
+        # Read a couple of frames, then walk away mid-stream.
+        await resp.content.read(64)
+        resp.close()
+        await asyncio.sleep(0.2)  # let the proxy observe the reset
+        assert router.metrics.mid_stream_failures == 0
+        assert router.metrics.stream_resumes == 0
+        assert router.breaker.open_endpoints() == []
+        assert all(p.healthy for p in router.store.list())
+        # The upstream saw exactly one request — no replays.
+        assert len(u.requests) == 1
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
+
+
+async def test_serve_resume_header_suppresses_chat_preamble(engines):
+    """HDR_RESUME grafts onto an open client stream: no role preamble,
+    even when the replayed history is empty (death between the preamble
+    and the first token frame)."""
+    a, _ = engines
+    body = {"messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0.0, "stream": True}
+    r = await a.post("/v1/chat/completions", json=body)
+    frames = []
+    async for line in r.content:
+        line = line.decode().strip()
+        if line.startswith("data: ") and line != "data: [DONE]":
+            frames.append(json.loads(line[6:]))
+    assert frames[0]["choices"][0]["delta"] == {"role": "assistant"}
+    r = await a.post("/v1/chat/completions", json=body,
+                     headers={"x-llmd-resume": "1"})
+    frames = []
+    async for line in r.content:
+        line = line.decode().strip()
+        if line.startswith("data: ") and line != "data: [DONE]":
+            frames.append(json.loads(line[6:]))
+    assert all(
+        f["choices"][0]["delta"] != {"role": "assistant"} for f in frames
+    ), "replay leg re-emitted the role preamble"
+
+
+async def test_router_strips_client_supplied_annotation_header(routed):
+    """x-llmd-stream-tokens is router-internal: a client sending it
+    through the router (resume disabled, so nothing would strip the
+    annotations) must not receive token_ids frames."""
+    rc, router = routed
+    router.max_resumes = 0
+    r = await rc.post(
+        "/v1/completions",
+        json={"prompt": "header strip", "max_tokens": 4,
+              "temperature": 0.0, "stream": True},
+        headers={"X-Llmd-Stream-Tokens": "1"},
+    )
+    toks, _, _, err, _ = await read_stream(r)
+    assert err is None
+    assert toks == [], "internal token annotations leaked to the client"
+
+
+# --------------------------------------------------------------------- #
+# _StreamState unit behavior
+
+
+def test_stream_state_strips_annotations_across_chunk_splits():
+    st = _StreamState(accumulate=True)
+    frame = (b'data: {"choices":[{"index":0,"text":"a"}],'
+             b'"token_ids":[1,2]}\n\n')
+    out = b""
+    for i in range(0, len(frame), 7):  # adversarial 7-byte TCP chunks
+        got, _ = st.ingest(frame[i:i + 7])
+        out += got
+    out += st.flush()
+    assert st.tokens == [1, 2]
+    assert b"token_ids" not in out
+    assert json.loads(out.split(b"data: ")[1].split(b"\n")[0]) == {
+        "choices": [{"index": 0, "text": "a"}]
+    }
+
+
+def test_stream_state_holds_back_partial_frames():
+    st = _StreamState(accumulate=True)
+    got, n = st.ingest(b'data: {"token_ids":[9],"choices":[')
+    assert got == b"" and n == 0 and st.tokens == []
+    got, n = st.ingest(b'{"index":0,"text":"x"}]}\n')
+    assert n == 1 and st.tokens == [9] and got.startswith(b"data: ")
+
+
+def test_stream_state_passthrough_untouched_without_accumulate():
+    st = _StreamState(accumulate=False)
+    frame = b'data: {"anything": [1,2 , 3]}\ndata: [DONE]\n\n'
+    got, n = st.ingest(frame)
+    assert got == frame and n == 1 and st.done_sent
+    assert st.tokens == []
+
+
+def test_stream_state_done_in_generated_text_is_not_a_terminator():
+    """Only the bare `data: [DONE]` sentinel ends the stream: generated
+    text containing the literal substring must still be counted,
+    stripped, and accumulated — and must not mark the stream whole."""
+    st = _StreamState(accumulate=True)
+    frame = (b'data: {"choices":[{"index":0,"text":"say [DONE] now"}],'
+             b'"token_ids":[7]}\n\n')
+    got, n = st.ingest(frame)
+    assert n == 1 and st.tokens == [7]
+    assert not st.done_sent
+    assert b"token_ids" not in got and b"say [DONE] now" in got
+    got, n = st.ingest(b"data: [DONE]\n\n")
+    assert st.done_sent and n == 0 and got == b"data: [DONE]\n\n"
+
+
+async def test_router_cut_5xx_body_is_not_resumed():
+    """A last-attempt 5xx streamed through and cut mid-body is delivered
+    truncated — never grafted with resume frames, never double-counted
+    by the breaker, and a cut 5xx on a retryable attempt re-picks
+    without crashing on the unreadable error body."""
+
+    class Dying5xx:
+        def __init__(self):
+            self.requests = 0
+
+        async def handle(self, request: web.Request) -> web.StreamResponse:
+            self.requests += 1
+            await request.read()
+            resp = web.StreamResponse(status=503)
+            await resp.prepare(request)
+            await resp.write(b'{"error": {"message": "dy')
+            request.transport.close()
+            return resp
+
+    u = Dying5xx()
+    # Three endpoints sharing the dying handler: the first two attempts
+    # re-pick (UpstreamServerError, unreadable body handled), the third
+    # is the last attempt and streams the cut 5xx through.
+    rc, router, servers = await _scripted_router([u, u, u], max_resumes=2)
+    try:
+        r = await rc.post("/v1/completions", json={
+            "prompt": "cut 5xx", "max_tokens": 8, "stream": True,
+        })
+        assert r.status == 503
+        # The truncated error body is delivered as-is: no resume frames
+        # grafted after it, no terminal SSE machinery on an error leg.
+        body = await r.read()
+        assert body == b'{"error": {"message": "dy'
+        assert router.metrics.stream_resumes == 0
+        assert router.metrics.mid_stream_failures == 0
+        # Retried attempts saw the 5xx (unreadable body handled), and
+        # each attempt fed the breaker EXACTLY once — the cut body must
+        # not double-count through the mid-stream handler.
+        assert u.requests == 3
+        assert sorted(router.breaker._consecutive.values()) == [1, 1, 1]
+    finally:
+        await rc.close()
+        for s in servers:
+            await s.close()
