@@ -88,6 +88,14 @@ extras (north-star shapes, BASELINE.json):
                     drained, and the interactive p99 TTFT on/off ratio
                     (the zero-regression headline), byte-identical
                     scoreboards across two batch-on runs.
+  lora_pool       — multi-tenant LoRA CPU-sim part
+                    (multi-tenant-lora.md): a real-engine 2-slot paged
+                    adapter pool under mixed-tenant churn vs a
+                    single-adapter baseline (cold-load TTFT ratio,
+                    eviction counts, resident-vs-cold byte parity
+                    greedy+seeded), plus the lora_tenant fleetsim
+                    scenario affinity-routed vs adapter-blind — the
+                    exact virtual-time resident-hit-ratio lift.
 """
 
 from __future__ import annotations
@@ -941,6 +949,8 @@ def _run_part(part: str):
         return bench_stream_resume()
     if part == "batch_backfill":
         return bench_batch_backfill()
+    if part == "lora_pool":
+        return bench_lora_pool()
     raise KeyError(part)
 
 
@@ -1184,6 +1194,169 @@ def bench_batch_backfill():
         # latency nothing (ratio ~1.0 in exact virtual time)
         "p99_ratio_on_vs_off": round(p99_on / max(1e-9, p99_off), 4),
         "wall_s": round(wall_s, 2),
+    }
+
+
+def bench_lora_pool():
+    """Multi-tenant LoRA CPU-sim part (multi-tenant-lora.md): two legs.
+
+    ENGINE leg — a real engine with a 2-slot paged adapter pool over a
+    6-tenant registry serves a mixed-tenant round-robin workload (every
+    request a different tenant: worst-case churn) vs the same request
+    count on ONE adapter (all-resident baseline); headline is the
+    throughput ratio and the cold-vs-resident first-request latency
+    ratio (both recorded, not asserted — CPU wall clock is noisy),
+    plus the cold-load/eviction counts and resident-vs-cold byte
+    parity (greedy + seeded) — the CI summary check asserts those.
+
+    FLEET leg — the lora_tenant fleetsim scenario (192 Zipf tenants,
+    32-slot pools) run affinity-routed vs adapter-blind on the same
+    trace; virtual time, so the resident-hit-ratio lift and cold-stall
+    comparison are exact. Determinism proven by running the affinity
+    leg twice and comparing scoreboard bytes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from llmd_tpu.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, tiny_model_config,
+    )
+    from llmd_tpu.engine import LLMEngine, SamplingParams
+
+    N_REQ, ISL, OSL, TENANTS = 18, 16, 8, 6
+
+    def make_engine():
+        return LLMEngine(EngineConfig(
+            model=tiny_model_config(
+                name="tiny-lora", num_lora_adapters=2, lora_rank=4,
+                lora_dynamic=True,
+            ),
+            cache=CacheConfig(page_size=4, num_blocks=256, dtype="float32"),
+            scheduler=SchedulerConfig(
+                max_num_seqs=8, max_num_batched_tokens=64
+            ),
+            seed=0,
+        ))
+
+    def adapter_weights(engine, seed):
+        layers = engine.runner.params["layers"]
+        rng = np.random.default_rng(seed)
+        return {
+            k: rng.normal(
+                0.0, 0.5, (layers[k].shape[0], *layers[k].shape[2:])
+            ).astype(np.float32)
+            for k in ("la_q", "lb_q", "la_v", "lb_v")
+        }
+
+    names = [f"tenant-{i}" for i in range(TENANTS)]
+
+    def run_one(eng, name, seed=None, prompt=None):
+        rid = eng.add_request(
+            prompt or list(range(2, 2 + ISL)),
+            SamplingParams(
+                temperature=0.0 if seed is None else 0.8,
+                max_tokens=OSL, ignore_eos=True, seed=seed,
+            ),
+            lora_name=name,
+        )
+        outs = []
+        while eng.has_work():
+            for out in eng.step():
+                if out.request_id == rid:
+                    outs.extend(out.new_token_ids)
+        return outs
+
+    def leg(mixed: bool) -> dict:
+        eng = make_engine()
+        for i, n in enumerate(names):
+            eng.load_adapter(n, weights=adapter_weights(eng, 100 + i))
+        run_one(eng, names[0])  # warm the step shapes off the clock
+        t0 = time.monotonic()
+        for i in range(N_REQ):
+            run_one(eng, names[i % TENANTS] if mixed else names[0])
+        dt = time.monotonic() - t0
+        pc = eng.adapter_pool.counters()
+        return {"tok_s": N_REQ * OSL / dt, **pc}
+
+    single = leg(mixed=False)
+    mixed = leg(mixed=True)
+
+    # Cold-vs-resident TTFT ratio + byte parity: engine A serves the
+    # adapter resident; engine B must first evict it, then cold-load it
+    # back for the timed request. Same weights, byte-identical streams.
+    streams = {}
+    lat = {}
+    for mode in ("resident", "cold"):
+        eng = make_engine()
+        eng.load_adapter("x", weights=adapter_weights(eng, 7))
+        run_one(eng, "x")  # warm shapes + make x resident
+        if mode == "cold":
+            eng.load_adapter("y", weights=adapter_weights(eng, 8))
+            eng.load_adapter("z", weights=adapter_weights(eng, 9))
+            run_one(eng, "y")
+            run_one(eng, "z")
+            assert eng.adapter_pool.slot_of("x") is None
+        t0 = time.monotonic()
+        greedy = run_one(eng, "x", prompt=list(range(3, 3 + ISL)))
+        lat[mode] = time.monotonic() - t0
+        seeded = run_one(eng, "x", seed=1234, prompt=list(range(3, 3 + ISL)))
+        streams[mode] = (greedy, seeded)
+
+    from llmd_tpu.fleetsim.scenarios import build_lora_tenant
+    from llmd_tpu.fleetsim.scoreboard import to_canonical_json
+
+    scale = 0.5
+    aff = build_lora_tenant(0, scale, affinity=True).run()
+    aff_b = build_lora_tenant(0, scale, affinity=True).run()
+    blind = build_lora_tenant(0, scale, affinity=False).run()
+    return {
+        "engine": {
+            "tenants": TENANTS,
+            "pool_slots": 2,
+            "single_adapter_tok_s": round(single["tok_s"], 1),
+            "mixed_tenant_tok_s": round(mixed["tok_s"], 1),
+            # worst-case churn cost (recorded; CPU wall clock is noisy)
+            "mixed_vs_single_ratio": round(
+                mixed["tok_s"] / max(single["tok_s"], 1e-9), 3
+            ),
+            "cold_loads": mixed["cold_loads"],
+            "evictions": mixed["evictions"],
+            "cold_ttft_ms": round(lat["cold"] * 1e3, 1),
+            "resident_ttft_ms": round(lat["resident"] * 1e3, 1),
+            "cold_ttft_ratio": round(
+                lat["cold"] / max(lat["resident"], 1e-9), 3
+            ),
+            # THE parity bar: resident and cold-loaded streams are
+            # byte-identical, greedy and seeded.
+            "outputs_identical": streams["resident"] == streams["cold"],
+        },
+        "fleet": {
+            "qps_scale": scale,
+            "deterministic": (
+                to_canonical_json(aff) == to_canonical_json(aff_b)
+            ),
+            "invariants_ok": bool(aff["ok"] and blind["ok"]),
+            "zero_lost": (
+                aff["requests"]["lost"] == 0
+                and aff["requests"]["hung"] == 0
+            ),
+            "adapters": aff["lora"]["adapters"],
+            "affinity_hit_ratio": round(aff["lora"]["hit_ratio"], 4),
+            "blind_hit_ratio": round(blind["lora"]["hit_ratio"], 4),
+            # exact virtual-time lift of residency-aware routing
+            "hit_ratio_lift": round(
+                aff["lora"]["hit_ratio"]
+                / max(blind["lora"]["hit_ratio"], 1e-9), 4
+            ),
+            "cold_loads": aff["lora"]["cold_loads"],
+            "evictions": aff["lora"]["evictions"],
+            "pinned_evictions": aff["lora"]["pinned_evictions"],
+            "cold_stall_p50_ms": round(
+                aff["lora"]["cold_stall_p50_ms"], 2
+            ),
+        },
     }
 
 
@@ -2049,7 +2222,7 @@ def _part_in_subprocess(part: str, retries: int = 0, timeout: float = 1800):
 _CPU_PARTS = frozenset({
     "dbo", "async_step", "spec_decode", "spec_window", "unified_step",
     "ragged_step", "fault_degrade", "fleet_soak", "kv_federation",
-    "stream_resume", "batch_backfill",
+    "stream_resume", "batch_backfill", "lora_pool",
 })
 
 # Every part main() can dispatch, in run order (also the validation set
@@ -2062,7 +2235,7 @@ _CPU_PARTS = frozenset({
 _ALL_PARTS = (
     "ragged_step", "unified_step", "async_step", "spec_decode",
     "spec_window", "dbo", "fault_degrade", "fleet_soak", "kv_federation",
-    "stream_resume", "batch_backfill",
+    "stream_resume", "batch_backfill", "lora_pool",
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
     "pd", "pd_int8", "pd_kvint8", "pd_local", "pd_cached", "pd_adaptive",
@@ -2203,6 +2376,7 @@ def main() -> None:
         "kv_federation": (set_key("kv_federation"), None),
         "stream_resume": (set_key("stream_resume"), None),
         "batch_backfill": (set_key("batch_backfill"), None),
+        "lora_pool": (set_key("lora_pool"), None),
         "rtt": (set_key("dispatch_rtt_ms"), None),
         "env": (set_key("env"), None),
         # The headline part now also carries the MFU/roofline context:

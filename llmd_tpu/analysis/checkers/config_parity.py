@@ -42,6 +42,7 @@ FLAG_FIELD_MAP = {
     "kv_publish_policy": "publish_policy",
     "kv_publish_min_hits": "publish_min_hits",
     "lora_adapters": "num_lora_adapters",
+    "lora_pool_slots": "lora_dynamic",
     "kv_transfer_config": "kv_role",
 }
 

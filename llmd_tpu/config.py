@@ -74,6 +74,12 @@ class ModelConfig:
     # layer; the model only sees integer slot ids per sequence.
     num_lora_adapters: int = 0
     lora_rank: int = 16
+    # lora_dynamic turns the fixed slots into a PAGED ADAPTER POOL
+    # (docs/architecture/multi-tenant-lora.md): num_lora_adapters bounds
+    # only HBM residency; the serving registry (/v1/load_lora_adapter)
+    # is unbounded, with LRU eviction of idle adapters and cold loads
+    # parked at step boundaries instead of stalling the batch.
+    lora_dynamic: bool = False
     # --- MoE (0 experts => dense MLP) ---
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -145,6 +151,10 @@ class ModelConfig:
                 "attention_bias is not supported with MLA (kv_lora_rank > 0): "
                 "no known MLA architecture uses QKV biases and the MLA "
                 "forward would silently ignore them"
+            )
+        if self.lora_dynamic and self.num_lora_adapters <= 0:
+            raise ValueError(
+                "lora_dynamic needs num_lora_adapters > 0 pool slots"
             )
         if self.kv_lora_rank > 0 and self.num_lora_adapters > 0:
             raise ValueError(

@@ -208,6 +208,18 @@ class EngineStats:
     max_lora: int = 0
     running_lora_adapters: tuple = ()
     waiting_lora_adapters: tuple = ()
+    # Multi-tenant paged adapter pool (multi-tenant-lora.md): adapters
+    # resident in HBM slots right now, idle residents LRU-evicted for
+    # incoming tenants, requests that had to wait for a cold weight
+    # install, and /v1/load_lora_adapter fetches that failed (surfaced
+    # as 4xx). resident/available ride lora_requests_info labels so the
+    # EPP's tri-state LoraAffinityScorer can route on residency.
+    lora_pool_resident_adapters: int = 0
+    lora_pool_evictions_total: int = 0
+    lora_cold_loads_total: int = 0
+    lora_load_failures_total: int = 0
+    resident_lora_adapters: tuple = ()
+    available_lora_adapters: tuple = ()
     # Step pipeline observability (async stepping, serve/metrics.py):
     # the host gap is the per-step host time the device sits idle for —
     # schedule + array build + dispatch + output assembly in sync mode,
@@ -465,8 +477,36 @@ class LLMEngine:
         self.stats = EngineStats(
             num_pages=config.cache.num_blocks, page_size=config.cache.page_size
         )
+        # Static surface of the adapter contract: present from the first
+        # scrape, not the first step (load failures can precede steps).
+        self.stats.max_lora = config.model.num_lora_adapters
         self._counter = itertools.count()
         self._embed_lock = threading.Lock()
+
+        # Multi-tenant LoRA (docs/architecture/multi-tenant-lora.md): a
+        # paged adapter pool — num_lora_adapters HBM slots over an
+        # unbounded host-RAM registry. Requests naming a non-resident
+        # adapter PARK in _lora_parked (the loading queue) and are
+        # admitted at a step boundary once their weights install; the
+        # batch never stalls on a tenant miss. Slot installs ride the
+        # runner's _OP_LORA lockstep broadcast, so multi-host replicas
+        # flip residency atomically.
+        self.adapter_registry = None
+        self.adapter_pool = None
+        self._lora_parked: list = []
+        # Terminal ABORT outputs for parked rows whose adapter vanished
+        # (defensive; drained into the next step's return).
+        self._lora_failed_outputs: list[RequestOutput] = []
+        if config.model.lora_dynamic and not follower:
+            from llmd_tpu.lora import AdapterPool, AdapterRegistry
+
+            self.adapter_registry = AdapterRegistry()
+            self.adapter_pool = AdapterPool(
+                self.adapter_registry,
+                install=self.runner.set_lora_weights,
+                num_slots=config.model.num_lora_adapters,
+                pinned=self._adapter_pinned,
+            )
 
         # Tiered offload pump (save-on-commit / restore-on-prefill).
         self.offloader = None
@@ -602,6 +642,63 @@ class LLMEngine:
                 f"non-empty prompt head (prompt carries "
                 f"{len(prompt_token_ids)} tokens)"
             )
+        park_adapter = False
+        lora_lease = ""
+        if lora_name and self.adapter_pool is not None:
+            # Dynamic pool path: names resolve to slots HERE (the serving
+            # layer no longer owns a fixed name->slot map). Resident
+            # adapters ride their slot; registered-but-cold adapters park
+            # in the loading queue; unknown names are a client error.
+            # acquire() holds an admission lease so a concurrent install
+            # (load API prefetch / embed cold load) cannot evict the slot
+            # before this row is visible to the pinned scan.
+            slot = self.adapter_pool.acquire(lora_name)
+            if slot is not None:
+                lora_id = slot
+                lora_lease = lora_name
+            elif self.adapter_registry.has(lora_name):
+                lora_id = 0  # assigned when the cold load installs
+                park_adapter = True
+            else:
+                raise ValueError(
+                    f"unknown lora_name {lora_name!r} (loaded adapters: "
+                    f"{self.adapter_registry.names()})"
+                )
+        elif lora_name and not lora_id:
+            # Static path: the serving layer maps names to slots before
+            # add_request — a name arriving WITHOUT a slot is exactly the
+            # silent-base-model bug this guard exists for.
+            raise ValueError(
+                f"unknown lora_name {lora_name!r} (this engine serves "
+                f"{self.config.model.num_lora_adapters} fixed adapter "
+                "slot(s); map the name to its slot id, or enable the "
+                "dynamic pool with lora_dynamic)"
+            )
+        try:
+            return self._admit_request(
+                prompt_token_ids, sampling, request_id, priority,
+                kv_transfer_params, lora_id, lora_name,
+                resume_output_tokens, park_adapter,
+            )
+        finally:
+            # The admission lease only bridges the resolve->admitted
+            # window; from here the scheduler-list pinned scan (or the
+            # parked queue) carries the pin.
+            if lora_lease:
+                self.adapter_pool.release_acquire(lora_lease)
+
+    def _admit_request(
+        self,
+        prompt_token_ids: list[int],
+        sampling: SamplingParams | None,
+        request_id: str | None,
+        priority: int,
+        kv_transfer_params: dict | None,
+        lora_id: int,
+        lora_name: str,
+        resume_output_tokens: int,
+        park_adapter: bool,
+    ) -> str:
         if lora_id and not (
             0 < lora_id <= self.config.model.num_lora_adapters
         ):
@@ -685,8 +782,17 @@ class LLMEngine:
             req.swa_block_ids = list(preload["swa_block_ids"])
             req.num_computed_tokens = preload["tokens"]
             req.num_cached_tokens = preload["tokens"]
-        elif self._swa_sections is not None:
+        elif self._swa_sections is not None and not park_adapter:
+            # (Parked requests skip the hybrid probe: their cache salt
+            # needs the slot id the cold load has not assigned yet.)
             self._try_hybrid_ring_hit(req)
+        if park_adapter:
+            # Loading queue (multi-tenant-lora.md): the request waits for
+            # its adapter's cold load — admitted by _admit_cold_loads at
+            # a step boundary with its assigned slot. The batch keeps
+            # serving resident tenants meanwhile.
+            self._lora_parked.append(req)
+            return rid
         self.scheduler.add_request(req)
         return rid
 
@@ -762,6 +868,12 @@ class LLMEngine:
             return
 
     def abort_request(self, request_id: str) -> bool:
+        for i, r in enumerate(self._lora_parked):
+            if r.request_id == request_id:
+                # Parked in the adapter loading queue: never scheduled,
+                # nothing on device to reconcile.
+                del self._lora_parked[i]
+                return True
         if self._inflight is not None and any(
             s.request.request_id == request_id
             for s in self._inflight.batch.seqs
@@ -795,19 +907,51 @@ class LLMEngine:
                 break
         return n
 
-    def embed(self, prompts: list[list[int]], lora_id: int = 0):
+    def embed(
+        self, prompts: list[list[int]], lora_id: int = 0, lora_name: str = ""
+    ):
         """[n, H] mean-pooled L2-normalized embeddings (OpenAI
         /v1/embeddings surface); independent of the serving KV cache.
 
         Serialized: each call allocates a scratch KV pool, so unbounded
         concurrency (N executor threads x multi-GB scratch) would OOM the
         device under an embedding burst."""
-        if lora_id and not (
-            0 < lora_id <= self.config.model.num_lora_adapters
-        ):
-            raise ValueError(f"lora_id {lora_id} out of range")
-        with self._embed_lock:
-            return self.runner.run_embed(prompts, lora_id=lora_id)
+        lease = ""
+        if lora_name and self.adapter_pool is not None:
+            # Embeddings have no loading queue (one-shot forward): make
+            # the adapter resident now — the same install path a cold
+            # generate pays at its step boundary — and hold the
+            # admission lease across the WHOLE forward: embeds create
+            # no scheduler row, so without the lease a concurrent cold
+            # load could evict the slot and swap in another tenant's
+            # weights mid-embed.
+            for _ in range(3):
+                slot = self.adapter_pool.acquire(lora_name)
+                if slot is not None:
+                    break
+                if not self.adapter_registry.has(lora_name):
+                    raise ValueError(
+                        f"unknown lora_name {lora_name!r} (loaded "
+                        f"adapters: {self.adapter_registry.names()})"
+                    )
+                self.adapter_pool.install_cold(lora_name)
+            else:
+                raise ValueError(
+                    f"adapter {lora_name!r} cannot become resident: "
+                    "every pool slot is pinned by in-flight requests"
+                )
+            lora_id = slot
+            lease = lora_name
+        try:
+            if lora_id and not (
+                0 < lora_id <= self.config.model.num_lora_adapters
+            ):
+                raise ValueError(f"lora_id {lora_id} out of range")
+            with self._embed_lock:
+                return self.runner.run_embed(prompts, lora_id=lora_id)
+        finally:
+            if lease:
+                self.adapter_pool.release_acquire(lease)
 
     def close(self) -> None:
         """Release network-facing resources (KV connector, store client)
@@ -842,8 +986,192 @@ class LLMEngine:
         if self._host_cache is not None:
             self._host_cache.clear()
 
+    # ------------------------------------------------------------------ #
+    # multi-tenant adapter pool (docs/architecture/multi-tenant-lora.md)
+
+    def _adapter_pinned(self, name: str) -> bool:
+        """Pin-while-referenced: an adapter named by any running or
+        queued row must keep its slot — the forward reads slot weights
+        every step, and displacing a referenced tenant would silently
+        mix weight versions mid-stream. (The same scheduler-list scan
+        set_lora_weights uses for its in-flight refusal.)"""
+        return any(
+            r.lora_name == name
+            for r in (*self.scheduler.running, *self.scheduler.waiting)
+        )
+
+    def _lora_rows_inflight(self, name: str) -> int:
+        return sum(
+            1
+            for r in (
+                *self.scheduler.running,
+                *self.scheduler.waiting,
+                *self._lora_parked,
+            )
+            if r.lora_name == name
+        )
+
+    def _normalize_adapter_weights(self, weights: dict) -> dict:
+        """Slot-form factor tensors with ABSENT pairs zero-filled: a
+        pool install must fully overwrite the evicted tenant's slot, or
+        a q-only adapter would silently compose with the previous
+        resident's v factors."""
+        import numpy as np
+
+        from llmd_tpu.lora.source import FACTOR_KEYS
+
+        layers = self.runner.params["layers"]
+        out = {}
+        for k in FACTOR_KEYS:
+            shape = (layers[k].shape[0], *layers[k].shape[2:])
+            if k in weights:
+                out[k] = np.ascontiguousarray(
+                    np.asarray(weights[k], np.float32)
+                ).reshape(shape)
+            else:
+                out[k] = np.zeros(shape, np.float32)
+        return out
+
+    def load_adapter(
+        self, name: str, source: str = "", weights: dict | None = None
+    ) -> None:
+        """Register ``name`` in the serving registry (the
+        ``/v1/load_lora_adapter`` contract): fetch + decode its weights
+        (CRC-framed for URL/kvstore sources), then eagerly install into
+        a FREE pool slot when one exists — otherwise the adapter stays
+        one cold load away. Any failure raises without touching the
+        registry; the caller surfaces a counted 4xx."""
+        if self.adapter_pool is None:
+            raise RuntimeError(
+                "dynamic adapter serving is disabled "
+                "(ModelConfig.lora_dynamic / --lora-pool-slots)"
+            )
+        if weights is None:
+            from llmd_tpu.lora import AdapterFetchError, fetch_adapter
+
+            try:
+                weights = fetch_adapter(
+                    source,
+                    name=name,
+                    model_cfg=self.config.model,
+                    kvstore_get=(
+                        self._kvstore_client.get
+                        if self._kvstore_client is not None
+                        else None
+                    ),
+                )
+            except (AdapterFetchError, ValueError):
+                self.stats.lora_load_failures_total += 1
+                raise
+        weights = self._normalize_adapter_weights(weights)
+        _, stale_cache = self.adapter_registry.register(name, weights, source)
+        if stale_cache:
+            # The name was previously served with DIFFERENT weights:
+            # its name-salted prefix pages are stale. Same blast radius
+            # as a static weight swap (AllBlocksCleared analog).
+            self.allocator.clear()
+            if self._host_cache is not None:
+                self._host_cache.clear()
+        self.adapter_pool.install_prefetch(name)
+        self._refresh_lora_stats()
+
+    def _refresh_lora_stats(self) -> None:
+        """Registry/residency stats refresh OUTSIDE the step loop too:
+        an idle engine that just loaded adapters must advertise them on
+        the next scrape (the tri-state scorer routes on these labels),
+        not after its first generate request."""
+        if self.adapter_pool is None:
+            return
+        pc = self.adapter_pool.counters()
+        self.stats.lora_pool_resident_adapters = pc["resident"]
+        self.stats.lora_pool_evictions_total = pc["evictions"]
+        self.stats.lora_cold_loads_total = pc["cold_loads"]
+        self.stats.resident_lora_adapters = tuple(
+            self.adapter_pool.resident_names()
+        )
+        self.stats.available_lora_adapters = tuple(
+            self.adapter_registry.names()
+        )
+
+    def unload_adapter(self, name: str) -> None:
+        """Unregister ``name`` and release its slot
+        (``/v1/unload_lora_adapter``). Refuses while any row references
+        the adapter — mirroring set_lora_weights' in-flight refusal."""
+        if self.adapter_pool is None:
+            raise RuntimeError("dynamic adapter serving is disabled")
+        if not self.adapter_registry.has(name):
+            raise KeyError(
+                f"adapter {name!r} is not loaded "
+                f"(loaded: {self.adapter_registry.names()})"
+            )
+        n = self._lora_rows_inflight(name)
+        if n:
+            raise RuntimeError(
+                f"cannot unload adapter {name!r} with {n} request(s) in "
+                "flight (drain first)"
+            )
+        # remove() re-checks references UNDER the pool lock (admission
+        # leases + the pinned scan), so a request admitted between the
+        # friendly count above and here still refuses — a freed slot is
+        # never reused under a live row.
+        self.adapter_pool.remove(name)
+        self.adapter_registry.unregister(name)
+        self._refresh_lora_stats()
+
+    def _admit_cold_loads(self) -> None:
+        """Drain the adapter loading queue at a step boundary: install
+        the head request's adapter (evicting an idle LRU resident when
+        no slot is free) and admit every parked row for it. Stops when
+        every slot is pinned by in-flight rows — backpressure, the
+        parked rows wait for capacity."""
+        while self._lora_parked:
+            name = self._lora_parked[0].lora_name
+            slot = self.adapter_pool.slot_of(name)
+            if slot is None:
+                rec = self.adapter_registry.get(name)
+                if rec is None:
+                    # Unloaded while parked (unload refuses this; purely
+                    # defensive): fail the rows rather than hang them —
+                    # a terminal ABORT output rides the step's return so
+                    # subscribers see a finished stream, never silence.
+                    failed = [
+                        r for r in self._lora_parked if r.lora_name == name
+                    ]
+                    self._lora_parked = [
+                        r for r in self._lora_parked if r.lora_name != name
+                    ]
+                    for r in failed:
+                        self._lora_failed_outputs.append(RequestOutput(
+                            request_id=r.request_id,
+                            new_token_ids=[],
+                            finished=True,
+                            finish_reason=FinishReason.ABORT,
+                            num_prompt_tokens=len(r.prompt_token_ids),
+                            num_output_tokens=0,
+                        ))
+                    logging.getLogger(__name__).error(
+                        "adapter %r vanished with %d parked request(s); "
+                        "aborted", name, len(failed),
+                    )
+                    continue
+                slot = self.adapter_pool.install_cold(name)
+                if slot is None:
+                    return  # every slot pinned; keep waiting
+            still = []
+            for req in self._lora_parked:
+                if req.lora_name == name:
+                    req.lora_id = slot
+                    self.scheduler.add_request(req)
+                else:
+                    still.append(req)
+            self._lora_parked = still
+
     def has_work(self) -> bool:
-        return self.scheduler.has_work() or self._inflight is not None
+        return (
+            self.scheduler.has_work()
+            or self._inflight is not None
+            or bool(self._lora_parked)
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -853,9 +1181,13 @@ class LLMEngine:
         # notice, 503 /health and terminate in-flight streams. Unarmed
         # this is one module-global None check.
         faults.delay("engine.step.stall")
-        if self._async:
-            return self._step_async()
-        return self._step_sync()
+        if self._lora_parked:
+            self._admit_cold_loads()
+        outputs = self._step_async() if self._async else self._step_sync()
+        if self._lora_failed_outputs:
+            outputs = [*self._lora_failed_outputs, *outputs]
+            self._lora_failed_outputs = []
+        return outputs
 
     def _step_sync(self) -> list[RequestOutput]:
         t0 = time.monotonic()
@@ -1395,6 +1727,16 @@ class LLMEngine:
             self.stats.waiting_lora_adapters = tuple(
                 sorted({r.lora_name for r in self.scheduler.waiting if r.lora_name})
             )
+            if self.adapter_pool is not None:
+                # Paged pool observability (multi-tenant-lora.md): the
+                # waiting list also counts rows PARKED on cold loads —
+                # they are queued demand the routing layer must see.
+                if self._lora_parked:
+                    self.stats.waiting_lora_adapters = tuple(sorted(
+                        set(self.stats.waiting_lora_adapters)
+                        | {r.lora_name for r in self._lora_parked}
+                    ))
+                self._refresh_lora_stats()
         if self._host_cache is not None:
             hs = self._host_cache.stats()
             self.stats.offload_pages = hs["pages"]

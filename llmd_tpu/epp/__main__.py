@@ -69,6 +69,15 @@ def main(argv=None) -> None:
         "scoring)",
     )
     p.add_argument(
+        "--lora-tier-weights", default=None,
+        help="adapter-residency tier weight overrides for the "
+        "lora-affinity scorer, 'tier=w,...' (tiers: resident, "
+        "registered, cold — e.g. 'registered=0.6'); same syntax as "
+        "LLMD_LORA_TIER_WEIGHTS and takes precedence over it "
+        "(docs/architecture/multi-tenant-lora.md tri-state residency "
+        "scoring)",
+    )
+    p.add_argument(
         "--predictor-url", default=None,
         help="prediction sidecar base URL (predicted-latency routing)",
     )
@@ -177,6 +186,18 @@ def main(argv=None) -> None:
         default_events_port=args.kv_events_port,
         tier_weights=args.prefix_tier_weights,
     )
+    if args.lora_tier_weights:
+        # Flag-level overrides land on every lora-affinity scorer in the
+        # chain (defaults < env < scorer config < flag — the same
+        # precedence ladder as --prefix-tier-weights).
+        from llmd_tpu.epp.config import find_plugins
+        from llmd_tpu.epp.scorers import LoraAffinityScorer
+        from llmd_tpu.events.index import parse_tier_weights
+
+        for scorer in find_plugins(router.scheduler, LoraAffinityScorer):
+            scorer.tier_weights.update(
+                parse_tier_weights(args.lora_tier_weights)
+            )
     # Wires the predictor producer + feedback + SLO admitter iff the config
     # declares a latency-scorer or slo-headroom-tier filter (no-op otherwise).
     from llmd_tpu.epp.predicted_latency import maybe_attach_predicted_latency

@@ -89,6 +89,14 @@ def extract_attrs(text: str, engine_type: str = "vllm") -> dict[str, float]:
                     out["AvailableAdapters"] = [
                         a.strip() for a in m.group(1).split(",") if a.strip()
                     ]
+                # Paged-pool residency (multi-tenant-lora.md): the HBM
+                # working set the tri-state lora-affinity scorer routes
+                # on; static engines emit their full slot map here.
+                m = re.search(r'resident_lora_adapters="([^"]*)"', line)
+                if m:
+                    out["ResidentAdapters"] = [
+                        a.strip() for a in m.group(1).split(",") if a.strip()
+                    ]
                 break
     # cache_config_info labels carry block geometry; parse_prometheus drops
     # labels, so read them directly if present.

@@ -168,18 +168,77 @@ class PrefixCacheScorer(Scorer):
         self.index.evict_endpoint(address)
 
 
+# Tri-state adapter residency weights (multi-tenant-lora.md), exactly
+# parallel to the prefix index's resident/store/recompute table
+# (kv-federation.md): a replica holding the adapter in an HBM slot
+# serves it at full speed; one holding it only in the host-RAM registry
+# pays a cold slot install; one that never loaded it pays the full
+# fetch + install (and, pool-full, queueing behind pinned slots).
+DEFAULT_LORA_TIER_WEIGHTS = {
+    "resident": 1.0,
+    "registered": 0.5,
+    "cold": 0.0,
+}
+
+LORA_TIER_WEIGHTS_ENV = "LLMD_LORA_TIER_WEIGHTS"
+
+
+def lora_tier_weights_from_env(raw: str | None = None) -> dict[str, float]:
+    """The deployment's adapter-residency weight table: defaults
+    overlaid with ``LLMD_LORA_TIER_WEIGHTS`` (``tier=weight,...`` — the
+    same syntax as ``LLMD_PREFIX_TIER_WEIGHTS``)."""
+    import os
+
+    from llmd_tpu.events.index import parse_tier_weights
+
+    weights = dict(DEFAULT_LORA_TIER_WEIGHTS)
+    if raw is None:
+        raw = os.environ.get(LORA_TIER_WEIGHTS_ENV, "")
+    if raw:
+        weights.update(parse_tier_weights(raw))
+    return weights
+
+
 @register("lora-affinity-scorer")
 class LoraAffinityScorer(Scorer):
-    """Prefer endpoints that already have the request's adapter loaded
-    (scheduling.md:96). Adapter presence comes from the data layer attr
-    'LoadedAdapters' (list) refreshed by the metrics collector."""
+    """Tri-state adapter-residency scoring (scheduling.md:96 +
+    docs/architecture/multi-tenant-lora.md): resident HBM slot >
+    one-install-away in the replica's adapter registry > cold load.
+
+    Residency comes from the ``resident_lora_adapters`` /
+    ``available_lora_adapters`` labels of ``vllm:lora_requests_info``
+    (data-layer attrs ``ResidentAdapters`` / ``AvailableAdapters``,
+    refreshed by the metrics collector). Engines predating the paged
+    pool emit no resident label; their running/waiting
+    (``LoadedAdapters``) list stands in for residency. Weights are
+    configurable per deployment: defaults < ``LLMD_LORA_TIER_WEIGHTS``
+    env < scorer ``tier_weights`` parameters < the router's
+    ``--lora-tier-weights`` flag."""
+
+    def __init__(self, tier_weights: dict | None = None) -> None:
+        self.tier_weights = lora_tier_weights_from_env()
+        if tier_weights:
+            self.tier_weights.update(
+                {k: float(v) for k, v in tier_weights.items()}
+            )
 
     def score(self, req, pods):
         adapter = req.body.get("model") or req.model
+        w = self.tier_weights
         out = {}
         for p in pods:
-            loaded = p.attrs.get("LoadedAdapters") or []
-            out[p.address] = 1.0 if adapter in loaded else 0.0
+            resident = (
+                p.attrs.get("ResidentAdapters")
+                or p.attrs.get("LoadedAdapters")
+                or []
+            )
+            available = p.attrs.get("AvailableAdapters") or []
+            if adapter in resident:
+                out[p.address] = w["resident"]
+            elif adapter in available:
+                out[p.address] = w["registered"]
+            else:
+                out[p.address] = w["cold"]
         return out
 
 

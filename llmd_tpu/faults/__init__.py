@@ -47,6 +47,8 @@ site                        effect at the injection point
 ``serve.stream.cut``        engine SSE stream's transport severed mid-flight
 ``replica.crash``           fleet-sim replica dies (in-flight streams cut)
 ``replica.brownout``        fleet-sim replica serves ``delay_ms`` slower
+``lora.load.fail``          adapter weight fetch raises ``AdapterFetchError``
+``lora.fetch.delay_ms``     adapter weight fetch sleeps ``delay_ms``
 ==========================  =================================================
 
 The two ``replica.*`` sites are FLEET-scoped: they are consulted by the
@@ -79,6 +81,8 @@ SITES = frozenset({
     "serve.stream.cut",
     "replica.crash",
     "replica.brownout",
+    "lora.load.fail",
+    "lora.fetch.delay_ms",
 })
 
 

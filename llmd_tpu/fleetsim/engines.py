@@ -158,6 +158,26 @@ class ReplicaDied(ConnectionError):
 
 
 @dataclasses.dataclass(frozen=True)
+class LoraPoolProfile:
+    """One replica's paged-adapter-pool envelope
+    (docs/architecture/multi-tenant-lora.md).
+
+    ``slots`` is the HBM residency bound (the engine's
+    ``--lora-pool-slots``); ``load_s`` the cold-load cost — adapter
+    store fetch + slot install + lockstep broadcast — a request pays
+    when its adapter is not resident. Every adapter in the scenario's
+    universe is REGISTERED (one fetch away in the adapter store) on
+    every replica, which is what makes residency the routing-visible
+    differentiator. ``wait_tick_s`` is the poll cadence a cold load
+    parked behind a fully-pinned pool re-checks at (the sim analog of
+    the engine's step-boundary loading queue)."""
+
+    slots: int = 8
+    load_s: float = 0.05
+    wait_tick_s: float = 0.005
+
+
+@dataclasses.dataclass(frozen=True)
 class ReplicaProfile:
     """One replica's capacity envelope (all rates per replica)."""
 
@@ -208,10 +228,29 @@ class SimReplica:
         variant: str = "sim",
         kv_store: SimKVStore | None = None,
         prefix_cache_groups: int = 8,
+        lora: LoraPoolProfile | None = None,
+        lora_universe: tuple = (),
     ) -> None:
         self.address = address
         self.profile = profile
         self.variant = variant
+        # Paged adapter pool (multi-tenant-lora.md): LRU residency over
+        # `lora.slots` HBM slots with pin-while-referenced semantics —
+        # the stub's whole-adapter stand-in for the engine's
+        # AdapterPool. `lora_universe` is the registered set every
+        # replica advertises as one-fetch-away.
+        self.lora = lora
+        self.lora_universe = tuple(lora_universe)
+        self._lora_resident: collections.OrderedDict[str, None] = (
+            collections.OrderedDict()
+        )
+        self._lora_refs: collections.Counter = collections.Counter()
+        self._lora_ready_t: dict[str, float] = {}
+        self.lora_hits = 0
+        self.lora_cold_loads = 0
+        self.lora_evictions = 0
+        self.lora_pinned_evictions = 0  # MUST stay 0: the no-thrash gate
+        self.lora_cold_stall_s: list[float] = []
         # Federation tier (kv-federation.md): the fleet-shared store and
         # a bounded local prefix cache (LRU over prefix groups — the
         # stub's whole-prefix stand-in for the page-granular device/host
@@ -334,6 +373,72 @@ class SimReplica:
                 return
         self._free_slots += 1
 
+    # ---- the adapter pool (multi-tenant-lora.md) ---------------------- #
+
+    async def _acquire_adapter(self, adapter: str) -> None:
+        """Make ``adapter`` resident and pin it for this request.
+
+        Resident hit: free. Cold: the request stalls for the load cost
+        (fetch + slot install), evicting the LRU idle resident when no
+        slot is free — NEVER a pinned one (a referenced slot's weights
+        are read by the forward every step); with every slot pinned the
+        load parks and re-checks each tick, the sim analog of the
+        engine's step-boundary loading queue. A peer arriving during an
+        install waits out the remaining install time only."""
+        loop = asyncio.get_event_loop()
+        assert self.lora is not None
+        if adapter in self._lora_resident:
+            self._lora_resident.move_to_end(adapter)
+            self._lora_refs[adapter] += 1
+            self.lora_hits += 1
+            # Ride out a still-landing install (peer cold load).
+            remaining = self._lora_ready_t.get(adapter, 0.0) - loop.time()
+            if remaining > 0:
+                await self._hold(remaining)
+            return
+        t0 = loop.time()
+        self.lora_cold_loads += 1
+        while True:
+            if adapter in self._lora_resident:
+                # A peer's install landed while this request waited.
+                self._lora_resident.move_to_end(adapter)
+                self._lora_refs[adapter] += 1
+                remaining = (
+                    self._lora_ready_t.get(adapter, 0.0) - loop.time()
+                )
+                if remaining > 0:
+                    await self._hold(remaining)
+                break
+            if len(self._lora_resident) >= self.lora.slots:
+                victim = next(
+                    (
+                        name for name in self._lora_resident
+                        if self._lora_refs[name] == 0
+                    ),
+                    None,
+                )
+                if victim is None:
+                    # Every slot pinned: park (backpressure, not thrash).
+                    await self._hold(self.lora.wait_tick_s)
+                    continue
+                if self._lora_refs[victim] > 0:  # structurally unreachable
+                    self.lora_pinned_evictions += 1
+                del self._lora_resident[victim]
+                self._lora_ready_t.pop(victim, None)
+                self.lora_evictions += 1
+            # Reserve the slot (pinned through the install), then pay
+            # the load; peers see ready_t and wait out the remainder.
+            self._lora_resident[adapter] = None
+            self._lora_refs[adapter] += 1
+            self._lora_ready_t[adapter] = loop.time() + self.lora.load_s
+            await self._hold(self.lora.load_s)
+            break
+        self.lora_cold_stall_s.append(loop.time() - t0)
+
+    def _release_adapter(self, adapter: str) -> None:
+        if self._lora_refs[adapter] > 0:
+            self._lora_refs[adapter] -= 1
+
     # ---- the serving path -------------------------------------------- #
 
     def _prefix_cache_put(self, group: str) -> None:
@@ -430,6 +535,7 @@ class SimReplica:
         prefix_group: str | None = None,
         prefix_tokens: int = 0,
         resume_tokens: int = 0,
+        adapter: str | None = None,
     ):
         """Serve one request; async generator yielding LISTS of token
         values (:func:`stream_token`) — the first list at first-token
@@ -460,7 +566,15 @@ class SimReplica:
         self.running += 1
         held_tokens = prompt_tokens + output_tokens
         self.kv_used_tokens += held_tokens
+        lora_acquired = False
         try:
+            if adapter is not None and self.lora is not None:
+                # Adapter residency before any token: a cold load's
+                # fetch+install stall is a TTFT component, exactly like
+                # the engine's parked loading queue. (A crash mid-
+                # acquire leaves the dead replica's accounting frozen.)
+                await self._acquire_adapter(adapter)
+                lora_acquired = True
             # Degradations the production stack contracts for: a dropped
             # KV pull recomputes locally (slower prefill, correct
             # output); a brownout serves every request delay_ms late.
@@ -506,6 +620,8 @@ class SimReplica:
             self.prompt_tokens_total += prompt_tokens
             self.output_tokens_total += output_tokens - resume_tokens
         finally:
+            if lora_acquired:
+                self._release_adapter(adapter)
             self.running -= 1
             self.kv_used_tokens -= held_tokens
             self._release_slot()
@@ -517,7 +633,7 @@ class SimReplica:
         (llmd engine-family names — datalayer.METRIC_MAPPINGS)."""
         cap = max(self.profile.kv_capacity_tokens, 1)
         usage = min(self.kv_used_tokens / cap, 1.0)
-        return (
+        text = (
             f"llmd:num_requests_waiting {self.waiting}\n"
             f"llmd:num_requests_running {self.running}\n"
             f"llmd:gpu_cache_usage_perc {usage:.6f}\n"
@@ -525,3 +641,18 @@ class SimReplica:
             f'llmd:cache_config_info{{block_size="16",'
             f'num_gpu_blocks="{cap // 16}"}} 1\n'
         )
+        if self.lora is not None:
+            # The engine's adapter-residency surface, verbatim: the
+            # production extract_attrs parses these labels into
+            # ResidentAdapters/AvailableAdapters for the tri-state
+            # lora-affinity scorer (multi-tenant-lora.md).
+            resident = ",".join(self._lora_resident)
+            available = ",".join(self.lora_universe)
+            text += (
+                "# TYPE vllm:lora_requests_info gauge\n"
+                f'vllm:lora_requests_info{{max_lora="{self.lora.slots}",'
+                'running_lora_adapters="",waiting_lora_adapters="",'
+                f'available_lora_adapters="{available}",'
+                f'resident_lora_adapters="{resident}"}} 1\n'
+            )
+        return text

@@ -60,7 +60,11 @@ import dataclasses
 from typing import Callable
 
 from llmd_tpu.fleetsim import scoreboard as sb
-from llmd_tpu.fleetsim.engines import ReplicaProfile, StoreProfile
+from llmd_tpu.fleetsim.engines import (
+    LoraPoolProfile,
+    ReplicaProfile,
+    StoreProfile,
+)
 from llmd_tpu.fleetsim.sim import AutoscaleConfig, FleetConfig, FleetSim
 from llmd_tpu.fleetsim.traces import TraceRequest, generate
 
@@ -416,6 +420,58 @@ def build_batch_backfill(
                     invariants=invariants)
 
 
+def build_lora_tenant(
+    seed: int = 0, qps_scale: float = 1.0, affinity: bool = True
+) -> FleetSim:
+    # The multi-tenant LoRA acceptance scenario
+    # (docs/architecture/multi-tenant-lora.md): 192 tenants, one
+    # adapter each, Zipf popularity (a few hot tenants, a long warm
+    # tail), over replicas whose paged adapter pools hold 32 slots —
+    # fleet-wide residency capacity far below the tenant count, so
+    # WHERE a tenant's requests land decides whether they pay a cold
+    # load. The tri-state lora-affinity scorer routes on the residency
+    # the production MetricsCollector scrapes off the replicas'
+    # lora_requests_info labels; gates: resident-hit ratio floor (the
+    # blind baseline sits far lower — the bench part and CI compare
+    # the two exactly), bounded cold-load stall, cold loads AND LRU
+    # evictions provably engaged, and ZERO pinned-slot evictions.
+    # ``affinity=False`` builds the identical fleet with the scorer
+    # out of the chain — the adapter-blind baseline.
+    qps = 1_500.0 * qps_scale
+    duration = 2.0
+    n = max(3, round(6 * qps_scale))
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        prompt_tokens=128, output_tokens=8, adapters=192,
+    )
+    # Per-replica slots ~ universe / replicas: under affinity routing
+    # each replica's tenant partition FITS its pool (near-full
+    # residency); under blind routing every replica is reached by the
+    # whole universe and LRU-churns. Slots stay far below the 192
+    # tenants either way.
+    cfg = FleetConfig(
+        replicas=n,
+        profile=_PROFILE,
+        lora=LoraPoolProfile(slots=32, load_s=0.05),
+        lora_affinity=affinity,
+        grace_s=90.0,
+    )
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("all_completed", sb.inv_all_completed(1.0)),
+        ("lora_flow", sb.inv_lora_flow(1, 1)),
+        ("no_pinned_eviction", sb.inv_no_pinned_eviction),
+        ("cold_stall_bounded", sb.inv_lora_cold_stall_ms(250.0)),
+        ("p99_ttft", sb.inv_p99_ttft_ms(800.0)),
+    ]
+    if affinity:
+        # The blind baseline cannot hold this floor: residency-aware
+        # routing is what keeps hot tenants resident somewhere.
+        invariants.append(("hit_ratio", sb.inv_lora_hit_ratio(0.55)))
+    return FleetSim(cfg, trace, seed=seed, scenario="lora_tenant",
+                    invariants=invariants)
+
+
 def build_router_soak(seed: int = 0, qps_scale: float = 1.0):
     # The REAL epp/server.py aiohttp router in-process on the virtual
     # loop (fleetsim.router_soak): loopback sockets, production parser/
@@ -471,6 +527,10 @@ SCENARIOS: dict[str, Scenario] = {
                  "diurnal interactive + standing batch queue: backlog "
                  "drains through troughs at watermark admission, "
                  "utilization floor raised, interactive p99 held"),
+        Scenario("lora_tenant", build_lora_tenant,
+                 "192 Zipf tenants over 32-slot adapter pools: "
+                 "residency-affinity routing holds the hit-ratio floor, "
+                 "cold loads bounded, pinned slots never evicted"),
         Scenario("router_soak", build_router_soak,
                  "REAL aiohttp router over loopback on the virtual "
                  "loop: mid-stream kills resume through the production "

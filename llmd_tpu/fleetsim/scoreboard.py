@@ -678,6 +678,68 @@ def inv_resume_ttft_vs_cold(board: dict) -> str | None:
     return None
 
 
+def inv_lora_hit_ratio(min_ratio: float) -> Invariant:
+    """THE adapter-affinity bar (multi-tenant-lora.md): the fraction of
+    adapter requests finding their adapter already resident must hold
+    ``min_ratio`` — with pool capacity far below tenant count, only
+    residency-aware routing keeps this high."""
+    def check(board: dict) -> str | None:
+        lo = board.get("lora")
+        if lo is None:
+            return "scoreboard carries no lora section"
+        if lo["hit_ratio"] < min_ratio:
+            return f"resident-hit ratio {lo['hit_ratio']:.3f} < {min_ratio}"
+        return None
+    return check
+
+
+def inv_lora_flow(min_cold_loads: int = 1, min_evictions: int = 1) -> Invariant:
+    """The pool's churn legs actually engaged: adapters cold-loaded into
+    slots AND idle residents were LRU-evicted for incoming tenants (a
+    registry smaller than the fleet's slot capacity would make the
+    hit-ratio gate vacuous)."""
+    def check(board: dict) -> str | None:
+        lo = board.get("lora")
+        if lo is None:
+            return "scoreboard carries no lora section"
+        if lo["cold_loads"] < min_cold_loads:
+            return f"cold_loads {lo['cold_loads']} < {min_cold_loads}"
+        if lo["evictions"] < min_evictions:
+            return f"evictions {lo['evictions']} < {min_evictions}"
+        return None
+    return check
+
+
+def inv_no_pinned_eviction(board: dict) -> str | None:
+    """The no-thrash contract: a slot referenced by an in-flight row is
+    NEVER evicted — displacing a referenced tenant would mix weight
+    versions mid-stream."""
+    lo = board.get("lora")
+    if lo is None:
+        return "scoreboard carries no lora section"
+    if lo["pinned_evictions"] != 0:
+        return f"{lo['pinned_evictions']} pinned slot(s) were evicted"
+    return None
+
+
+def inv_lora_cold_stall_ms(bound_p50_ms: float) -> Invariant:
+    """Bounded cold-load TTFT: the p50 stall a cold-adapter request
+    pays (fetch + install + any wait for an evictable slot) stays
+    within ``bound_p50_ms`` — cold loads are a bounded tax, not a
+    convoy."""
+    def check(board: dict) -> str | None:
+        lo = board.get("lora")
+        if lo is None:
+            return "scoreboard carries no lora section"
+        if lo["cold_loads"] and lo["cold_stall_p50_ms"] > bound_p50_ms:
+            return (
+                f"cold-load stall p50 {lo['cold_stall_p50_ms']:.1f}ms "
+                f"> {bound_p50_ms}ms"
+            )
+        return None
+    return check
+
+
 def inv_faults_fired(site: str, at_least: int = 1) -> Invariant:
     def check(board: dict) -> str | None:
         n = board["faults_injected"].get(site, 0)

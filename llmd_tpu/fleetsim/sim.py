@@ -64,6 +64,7 @@ from llmd_tpu.epp.types import (
 )
 from llmd_tpu.fleetsim import simloop
 from llmd_tpu.fleetsim.engines import (
+    LoraPoolProfile,
     ReplicaDied,
     ReplicaProfile,
     ReplicaUnreachable,
@@ -161,6 +162,15 @@ class FleetConfig:
     # the no-batch baseline leg the bench part compares against.
     util_sample_s: float = 0.5
     sample_util: bool = False
+    # Multi-tenant LoRA (multi-tenant-lora.md): a LoraPoolProfile arms
+    # every replica's paged adapter pool (trace requests carrying an
+    # ``adapter`` stall on cold loads, LRU-evict idle residents, and
+    # advertise residency on the scrape page); ``lora_affinity`` puts
+    # the tri-state lora-affinity scorer in the plugin chain — False is
+    # the adapter-blind baseline the hit-ratio lift is measured
+    # against.
+    lora: LoraPoolProfile | None = None
+    lora_affinity: bool = True
 
 
 def default_sim_config(
@@ -169,10 +179,12 @@ def default_sim_config(
     ttl_s: float = 30.0,
     fairness: str = "round-robin",
     use_predictor: bool = False,
+    lora_affinity: bool = False,
 ) -> dict:
     """The soak's EndpointPickerConfig: the production DEFAULT_CONFIG
     plugin set with a seeded picker (deterministic tie-breaks) and,
-    optionally, the predicted-latency scorer in the chain."""
+    optionally, the predicted-latency and/or lora-affinity scorers in
+    the chain."""
     cfg = copy.deepcopy(epp_config.DEFAULT_CONFIG)
     for p in cfg["plugins"]:
         if p["type"] == "max-score-picker":
@@ -181,6 +193,16 @@ def default_sim_config(
         cfg["plugins"].append({"type": "latency-scorer", "name": "latency"})
         cfg["schedulingProfiles"][0]["plugins"].insert(
             -1, {"pluginRef": "latency", "weight": 2.0}
+        )
+    if lora_affinity:
+        # The production tri-state residency scorer
+        # (multi-tenant-lora.md), fed by the replicas' real scrape
+        # pages through extract_attrs.
+        cfg["plugins"].append(
+            {"type": "lora-affinity-scorer", "name": "lora"}
+        )
+        cfg["schedulingProfiles"][0]["plugins"].insert(
+            -1, {"pluginRef": "lora", "weight": 2.0}
         )
     cfg["flowControl"] = {
         "enabled": True,
@@ -313,12 +335,19 @@ class FleetSim:
         self.kv_store = (
             SimKVStore(cfg.kv_store) if cfg.kv_store is not None else None
         )
+        # Adapter universe: every adapter the trace names, registered
+        # ("one fetch away") on every replica — residency is the only
+        # routing differentiator, exactly the pool's contract.
+        self.adapter_universe = tuple(sorted(
+            {r.adapter for r in trace if r.adapter is not None}
+        ))
         sched_cfg = cfg.scheduler_config or default_sim_config(
             seed,
             max_inflight=cfg.flow_max_inflight,
             ttl_s=cfg.flow_ttl_s,
             fairness=cfg.fairness,
             use_predictor=cfg.use_predictor,
+            lora_affinity=cfg.lora is not None and cfg.lora_affinity,
         )
         self.scheduler = epp_config.build_scheduler(sched_cfg)
         self.flow = epp_config.build_flow_control(sched_cfg)
@@ -363,6 +392,8 @@ class FleetSim:
             addr, self.cfg.profile,
             kv_store=self.kv_store,
             prefix_cache_groups=self.cfg.prefix_cache_groups,
+            lora=self.cfg.lora,
+            lora_universe=self.adapter_universe,
         )
         self.replicas[addr] = rep
         self.store.upsert(Endpoint(
@@ -459,6 +490,11 @@ class FleetSim:
             priority=treq.priority,
             fairness_id=treq.tenant,
             ttft_slo_ms=treq.ttft_slo_ms,
+            # Adapter requests name their adapter as the model id (the
+            # vLLM convention the lora-affinity scorer keys on).
+            body=(
+                {"model": treq.adapter} if treq.adapter is not None else {}
+            ),
         )
         outcome = await self.flow.enqueue_and_wait(
             req, nbytes=treq.prompt_tokens
@@ -516,6 +552,7 @@ class FleetSim:
                     prefix_group=treq.prefix_group,
                     prefix_tokens=treq.prefix_tokens,
                     resume_tokens=len(delivered),
+                    adapter=treq.adapter,
                 ):
                     if first is None:
                         first = clock.monotonic()
@@ -863,9 +900,34 @@ class FleetSim:
             r.recompute_fallbacks for r in self.replicas.values()
         )
         extra = None
+        if self.cfg.lora is not None:
+            from llmd_tpu.fleetsim.scoreboard import percentile
+
+            reps = list(self.replicas.values())
+            hits = sum(r.lora_hits for r in reps)
+            cold = sum(r.lora_cold_loads for r in reps)
+            stalls = sorted(
+                s for r in reps for s in r.lora_cold_stall_s
+            )
+            extra = {"lora": {
+                "adapters": len(self.adapter_universe),
+                "pool_slots": self.cfg.lora.slots,
+                "resident_hits": hits,
+                "cold_loads": cold,
+                "evictions": sum(r.lora_evictions for r in reps),
+                "pinned_evictions": sum(
+                    r.lora_pinned_evictions for r in reps
+                ),
+                # THE affinity headline: the fraction of adapter
+                # requests that found their adapter already resident.
+                "hit_ratio": hits / max(hits + cold, 1),
+                "cold_stall_p50_ms": percentile(stalls, 0.50) * 1e3,
+                "cold_stall_p99_ms": percentile(stalls, 0.99) * 1e3,
+            }}
         if self.kv_store is not None:
             reps = list(self.replicas.values())
-            extra = {"kv_federation": {
+            extra = dict(extra or {})
+            extra["kv_federation"] = {
                 "store": self.kv_store.stats(),
                 "recompute_avoided_tokens": sum(
                     r.recompute_avoided_tokens for r in reps
@@ -873,7 +935,7 @@ class FleetSim:
                 "store_hits": sum(r.store_hits for r in reps),
                 "store_published": sum(r.store_published for r in reps),
                 "local_prefix_hits": sum(r.prefix_local_hits for r in reps),
-            }}
+            }
         return self.board.finalize(
             duration_s=max(self._duration, 1e-9),
             invariants=self.invariants,

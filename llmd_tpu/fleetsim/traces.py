@@ -53,6 +53,11 @@ class TraceRequest:
     # publishes and fetches. None = a fully unique prompt.
     prefix_group: str | None = None
     prefix_tokens: int = 0
+    # Tenant adapter identity for the multi-LoRA scenario
+    # (multi-tenant-lora.md): the LoRA adapter this request serves
+    # under — the unit the replicas' paged adapter pools make resident
+    # and the lora-affinity scorer routes on. None = base model.
+    adapter: str | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -111,6 +116,7 @@ def generate(
     ttft_slo_ms: float | None = None,
     prefix_groups: int = 0,
     prefix_frac: float = 0.5,
+    adapters: int = 0,
 ) -> list[TraceRequest]:
     """Seeded inhomogeneous-Poisson arrivals with a weighted tenant mix.
 
@@ -129,6 +135,15 @@ def generate(
     overlapping-tenant workload whose fleet-wide recompute the KV
     federation exists to erase. ``prefix_frac`` of each prompt is the
     shared prefix.
+
+    ``adapters > 0`` is the multi-tenant LoRA axis
+    (multi-tenant-lora.md): each request serves under adapter ``k``
+    drawn Zipf-ish (weight 1/(k+1) — a few hot tenants, a long warm
+    tail) from that many tenant adapters, and the TENANT becomes the
+    adapter's owner (``tenant-<k>``) — hundreds of tenants, one
+    adapter each, exactly the fleet shape whose residency the paged
+    adapter pool and the lora-affinity scorer manage. The ``tenants``
+    mix is ignored in this mode.
     """
     rng = random.Random(seed)
     names = [t for t, _ in tenants]
@@ -154,15 +169,24 @@ def generate(
             )[0]
             group = f"g{k:03d}"
             n_prefix = min(n_prompt, max(1, round(prompt_tokens * prefix_frac)))
+        adapter, tenant = None, None
+        if adapters > 0:
+            k = rng.choices(
+                range(adapters),
+                weights=[1.0 / (j + 1) for j in range(adapters)],
+            )[0]
+            adapter = f"a{k:03d}"
+            tenant = f"tenant-{k:03d}"
         out.append(TraceRequest(
             t=t,
             request_id=f"r{i:06d}",
-            tenant=rng.choices(names, weights=weights, k=1)[0],
+            tenant=tenant or rng.choices(names, weights=weights, k=1)[0],
             prompt_tokens=n_prompt,
             output_tokens=max(1, round(output_tokens * jit)),
             ttft_slo_ms=ttft_slo_ms,
             prefix_group=group,
             prefix_tokens=n_prefix,
+            adapter=adapter,
         ))
         i += 1
     return out

@@ -1,0 +1,33 @@
+"""Multi-tenant LoRA serving subsystem (docs/architecture/multi-tenant-lora.md).
+
+The S-LoRA-shaped split the blueprint's model-server pillar names
+(model-servers.md:78-89): a fixed number of HBM adapter *slots*
+(:class:`~llmd_tpu.lora.pool.AdapterPool`) decoupled from an unbounded
+host-RAM *registry* (:class:`~llmd_tpu.lora.registry.AdapterRegistry`),
+with CRC-framed weight fetch from file/URL/kvstore sources
+(:mod:`llmd_tpu.lora.source`). Per-row slot indirection (the engine's
+existing ``lora_ids`` row metadata) keeps the single-dispatch
+mixed-adapter forward untouched, so resident and cold-loaded adapters
+produce byte-identical streams.
+"""
+
+from llmd_tpu.lora.pool import AdapterPool
+from llmd_tpu.lora.registry import AdapterRecord, AdapterRegistry
+from llmd_tpu.lora.source import (
+    AdapterDecodeError,
+    AdapterFetchError,
+    decode_adapter,
+    encode_adapter,
+    fetch_adapter,
+)
+
+__all__ = [
+    "AdapterPool",
+    "AdapterRecord",
+    "AdapterRegistry",
+    "AdapterDecodeError",
+    "AdapterFetchError",
+    "decode_adapter",
+    "encode_adapter",
+    "fetch_adapter",
+]

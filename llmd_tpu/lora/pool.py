@@ -1,0 +1,207 @@
+"""The paged adapter pool: a fixed set of HBM rank-``r`` slots over an
+unbounded registry (docs/architecture/multi-tenant-lora.md).
+
+The KV-pool mold applied to adapter weights: ``num_slots`` device slots
+(the build-time ``num_lora_adapters`` allocation, slot ids 1-based)
+hold the RESIDENT working set; the registry holds every loadable
+adapter. Residency is LRU with **pin-while-referenced** semantics — a
+slot referenced by any running or queued row is never evicted (the
+``pinned`` callback scans the scheduler's running+waiting lists, the
+same seam ``set_lora_weights`` uses) — and a cold adapter's weights
+install at a step boundary, so the continuous batch never stalls on a
+tenant miss.
+
+Requests see only per-row slot ids (``lora_ids`` row metadata): the
+single-dispatch mixed-adapter forward is untouched, and because the
+prefix cache salts adapter pages by NAME (not slot), slot reuse across
+tenants is cache-safe and an adapter's pages survive its own eviction.
+
+Thread model: the engine thread resolves and drains the loading queue;
+the serving layer's load/unload executor threads register,
+prefetch-install (free slots only) and remove; the embed path may also
+cold-install. All pool state is guarded by one lock, and the races
+that makes possible are each closed structurally: admission leases
+(:meth:`acquire`) pin a name from slot resolution until its row is
+visible to the pinned scan, the eviction scan honors leases + pins
+under the lock, duplicate concurrent installs of one name return the
+winner's slot and refund the loser's (never leaking capacity), and
+:meth:`remove` re-checks references under the lock. Device slot writes
+happen OUTSIDE the lock (the runner's dispatch lock serializes device
+work) with the slot reserved, and residency publishes only after the
+weights landed.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable
+
+from llmd_tpu.lora.registry import AdapterRegistry
+
+
+class AdapterPool:
+    def __init__(
+        self,
+        registry: AdapterRegistry,
+        install: Callable[[int, dict], None],
+        num_slots: int,
+        pinned: Callable[[str], bool] | None = None,
+    ) -> None:
+        if num_slots <= 0:
+            raise ValueError("AdapterPool needs at least one slot")
+        self.registry = registry
+        self.num_slots = num_slots
+        self._install_fn = install
+        self._pinned = pinned or (lambda name: False)
+        self._lock = threading.Lock()
+        # name -> slot id of RESIDENT adapters (publishes post-install).
+        self._slot_of: dict[str, int] = {}  # llmd: guarded_by(_lock)
+        # Residency recency, least-recent first (eviction scan order).
+        self._lru: collections.OrderedDict[str, None] = (
+            collections.OrderedDict()
+        )  # llmd: guarded_by(_lock)
+        self._free: list[int] = list(range(1, num_slots + 1))  # llmd: guarded_by(_lock)
+        self._evictions = 0  # llmd: guarded_by(_lock)
+        self._cold_loads = 0  # llmd: guarded_by(_lock)
+        # Admission leases: names resolved by add_request whose rows are
+        # not yet visible to the scheduler-list pinned scan. The
+        # eviction scan treats a leased name as pinned, closing the
+        # resolve->admit window against a concurrent install.
+        self._acquiring: dict[str, int] = {}  # llmd: guarded_by(_lock)
+
+    # ---- read surface ------------------------------------------------- #
+
+    def slot_of(self, name: str) -> int | None:
+        with self._lock:
+            return self._slot_of.get(name)
+
+    def acquire(self, name: str) -> int | None:
+        """Resolve ``name`` to its resident slot AND hold an admission
+        lease pinning it until :meth:`release_acquire` — bracket the
+        window between slot resolution and the row landing where the
+        pinned scan sees it. None = not resident (no lease taken)."""
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                return None
+            self._lru.move_to_end(name)
+            self._acquiring[name] = self._acquiring.get(name, 0) + 1
+            return slot
+
+    def release_acquire(self, name: str) -> None:
+        with self._lock:
+            n = self._acquiring.get(name, 0) - 1
+            if n <= 0:
+                self._acquiring.pop(name, None)
+            else:
+                self._acquiring[name] = n
+
+    def touch(self, name: str) -> None:
+        """Bump residency recency (a request arrived for ``name``)."""
+        with self._lock:
+            if name in self._lru:
+                self._lru.move_to_end(name)
+
+    def resident_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._slot_of)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "resident": len(self._slot_of),
+                "evictions": self._evictions,
+                "cold_loads": self._cold_loads,
+            }
+
+    # ---- install / evict ---------------------------------------------- #
+
+    def _take_slot_locked(self, allow_evict: bool) -> int | None:
+        if self._free:
+            return self._free.pop()
+        if not allow_evict:
+            return None
+        # Least-recently-used resident adapter with no referencing row.
+        # Pinned slots are skipped outright: the forward reads slot
+        # weights every step, so displacing a referenced tenant would
+        # silently mix weight versions mid-stream.
+        for name in self._lru:
+            if name in self._acquiring or self._pinned(name):
+                continue
+            slot = self._slot_of.pop(name)
+            del self._lru[name]
+            self._evictions += 1
+            return slot
+        return None
+
+    def _install(self, name: str, allow_evict: bool) -> int | None:
+        rec = self.registry.get(name)
+        if rec is None:
+            raise KeyError(f"adapter {name!r} is not registered")
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is not None:
+                self._lru.move_to_end(name)
+                return slot
+            slot = self._take_slot_locked(allow_evict)
+            if slot is None:
+                return None
+        try:
+            self._install_fn(slot, rec.weights)
+        except BaseException:
+            with self._lock:
+                self._free.append(slot)
+            raise
+        with self._lock:
+            existing = self._slot_of.get(name)
+            if existing is not None:
+                # A concurrent install of the same name won the publish
+                # (prefetch racing a cold load): keep the winner's slot
+                # and RETURN ours to the free list — overwriting the
+                # mapping would leak a slot out of both _free and
+                # _slot_of, permanently shrinking the pool. The
+                # duplicate device write was the same weights; harmless.
+                self._free.append(slot)
+                self._lru.move_to_end(name)
+                return existing
+            self._slot_of[name] = slot
+            self._lru[name] = None
+            self._lru.move_to_end(name)
+            return slot
+
+    def install_cold(self, name: str) -> int | None:
+        """Engine-thread cold load (the loading queue drains through
+        here at step boundaries): evicts an idle LRU resident when no
+        slot is free. None = every slot is pinned — the caller keeps
+        the request parked; backpressure, not an error."""
+        slot = self._install(name, allow_evict=True)
+        if slot is not None:
+            with self._lock:
+                self._cold_loads += 1
+        return slot
+
+    def install_prefetch(self, name: str) -> int | None:
+        """Eager residency at load-API time, FREE slots only (no
+        eviction off the engine thread). None = pool full; the adapter
+        stays one cold load away."""
+        return self._install(name, allow_evict=False)
+
+    def remove(self, name: str) -> bool:
+        """Unload: release the adapter's slot. The reference re-check
+        runs UNDER the pool lock — any row for ``name`` is either still
+        holding its admission lease (``_acquiring``) or already visible
+        to the pinned scan, so a caller's earlier in-flight check
+        cannot race a concurrent admission into freeing a live slot."""
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                return False
+            if name in self._acquiring or self._pinned(name):
+                raise RuntimeError(
+                    f"cannot remove adapter {name!r}: request(s) in flight"
+                )
+            del self._slot_of[name]
+            self._lru.pop(name, None)
+            self._free.append(slot)
+            return True

@@ -1,0 +1,89 @@
+"""The unbounded adapter registry: every loadable adapter, in host RAM.
+
+The registry is the "one-fetch-away" tier of the tri-state residency
+model (docs/architecture/multi-tenant-lora.md): an adapter registered
+here is servable — a request naming it parks in the pool's loading
+queue and its weights install into an HBM slot at the next step
+boundary — but costs a cold load until the pool makes it resident.
+Registration is what ``/v1/load_lora_adapter`` does; the build-time
+slot count bounds only RESIDENCY, never the registry.
+
+A name's weights are immutable while registered (re-registering a live
+name is an error, matching the vLLM load API contract). Unregistering
+leaves a CRC tombstone so a later re-registration under the same name
+with DIFFERENT weights is detected: name-salted prefix pages from the
+old weights would otherwise serve stale KV
+(``EngineScheduler._hash_extra`` salts by adapter NAME).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from llmd_tpu.lora.source import weights_crc
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterRecord:
+    """One registered adapter: slot-form factor tensors + identity."""
+
+    name: str
+    weights: dict
+    crc: int
+    source: str = ""
+
+
+class AdapterRegistry:
+    """Thread-safe name -> :class:`AdapterRecord` map (the serving
+    layer registers from executor threads while the engine thread
+    resolves and installs)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: dict[str, AdapterRecord] = {}  # llmd: guarded_by(_lock)
+        # CRC tombstones of unregistered names (stale-page detection).
+        self._tombstones: dict[str, int] = {}  # llmd: guarded_by(_lock)
+
+    def register(
+        self, name: str, weights: dict, source: str = ""
+    ) -> tuple[AdapterRecord, bool]:
+        """Register ``name``. Returns ``(record, stale_cache)`` where
+        ``stale_cache`` is True when the name was previously served with
+        DIFFERENT weights — the caller must drop name-salted cached
+        pages before any request hits them."""
+        crc = weights_crc(weights)
+        with self._lock:
+            if name in self._records:
+                raise ValueError(
+                    f"adapter {name!r} is already loaded; unload it first"
+                )
+            rec = AdapterRecord(name=name, weights=dict(weights), crc=crc,
+                                source=source)
+            self._records[name] = rec
+            old = self._tombstones.pop(name, None)
+            return rec, old is not None and old != crc
+
+    def unregister(self, name: str) -> AdapterRecord:
+        with self._lock:
+            rec = self._records.pop(name, None)
+            if rec is None:
+                raise KeyError(name)
+            self._tombstones[name] = rec.crc
+            return rec
+
+    def get(self, name: str) -> AdapterRecord | None:
+        with self._lock:
+            return self._records.get(name)
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._records
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._records)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)

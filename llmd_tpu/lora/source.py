@@ -1,0 +1,186 @@
+"""Adapter weight sources: CRC-framed fetch from file / URL / kvstore.
+
+Adapter weights travel the same wire discipline as KV bundles and
+federation pages (docs/architecture/fault-tolerance.md): a tiny framed
+header guards the payload so a corrupt blob is rejected before numpy
+ever parses it, and the caller degrades to a counted client error —
+never a wedged batch::
+
+    magic "LORA1" | crc32(payload) u32-le | npz payload
+
+``payload`` is an uncompressed ``np.savez`` archive of the slot-form
+factor tensors (``la_q``/``lb_q``/``la_v``/``lb_v``, each stacked
+``[num_layers, ...]``).
+
+Fetch legs (the ``/v1/load_lora_adapter`` path) consult two injection
+sites from the seeded FaultPlan (:mod:`llmd_tpu.faults`):
+
+- ``lora.fetch.delay_ms`` — the fetch sleeps (slow adapter store);
+- ``lora.load.fail`` — the fetch raises :class:`AdapterFetchError`.
+
+The degradation contract: one retry, then the failure surfaces as a
+counted 4xx on the load API (``lora_load_failures_total``); base-model
+rows and already-resident adapters are never affected.
+"""
+
+from __future__ import annotations
+
+import io
+import pathlib
+import struct
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+
+from llmd_tpu import faults
+
+MAGIC = b"LORA1"
+_HEADER = struct.Struct("<5sI")
+
+# The slot-form tensor keys (runner.set_lora_weights contract). A and B
+# install together per projection; absent pairs are zero-filled by the
+# engine before registration so a pool install fully overwrites the
+# evicted tenant's slot.
+FACTOR_KEYS = ("la_q", "lb_q", "la_v", "lb_v")
+
+
+class AdapterDecodeError(ValueError):
+    """Framed adapter blob failed the CRC or did not parse."""
+
+
+class AdapterFetchError(Exception):
+    """Adapter weights could not be fetched from their source."""
+
+
+def encode_adapter(weights: dict) -> bytes:
+    """Frame an adapter's factor tensors for the wire/kvstore."""
+    unknown = set(weights) - set(FACTOR_KEYS)
+    if unknown:
+        raise ValueError(f"unknown adapter tensors {sorted(unknown)}")
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v, np.float32) for k, v in weights.items()})
+    payload = buf.getvalue()
+    return _HEADER.pack(MAGIC, zlib.crc32(payload)) + payload
+
+
+def decode_adapter(blob: bytes) -> dict:
+    """Verify and parse a framed adapter blob. Raises
+    :class:`AdapterDecodeError` on any corruption — the caller surfaces
+    a load failure, never installs a half-parsed adapter."""
+    if len(blob) < _HEADER.size:
+        raise AdapterDecodeError(f"short blob ({len(blob)}B)")
+    magic, crc = _HEADER.unpack_from(blob)
+    payload = blob[_HEADER.size:]
+    if magic != MAGIC:
+        raise AdapterDecodeError(f"bad magic {magic!r}")
+    if zlib.crc32(payload) != crc:
+        raise AdapterDecodeError("payload CRC mismatch")
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as npz:
+            out = {k: np.asarray(npz[k]) for k in npz.files}
+    except (OSError, ValueError, zlib.error) as e:
+        raise AdapterDecodeError(f"npz parse failed: {e}") from e
+    unknown = set(out) - set(FACTOR_KEYS)
+    if unknown:
+        raise AdapterDecodeError(f"unknown adapter tensors {sorted(unknown)}")
+    if not out:
+        raise AdapterDecodeError("empty adapter archive")
+    return out
+
+
+def weights_crc(weights: dict) -> int:
+    """Stable identity of a weights payload: the CRC of its canonical
+    frame. Used to detect a name being re-registered with DIFFERENT
+    weights after an unload (stale name-salted prefix pages must be
+    dropped then — same weights keep their cache)."""
+    crc = 0
+    for k in sorted(weights):
+        arr = np.ascontiguousarray(np.asarray(weights[k], np.float32))
+        crc = zlib.crc32(arr.tobytes(), zlib.crc32(k.encode(), crc))
+    return crc
+
+
+def _fetch_once(
+    source: str,
+    model_cfg=None,
+    kvstore_get=None,
+    timeout_s: float = 10.0,
+) -> dict:
+    if source.startswith(("http://", "https://")):
+        try:
+            with urllib.request.urlopen(source, timeout=timeout_s) as resp:
+                blob = resp.read()
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise AdapterFetchError(f"URL fetch {source!r} failed: {e}") from e
+        return decode_adapter(blob)
+    if source.startswith("kvstore://"):
+        if kvstore_get is None:
+            raise AdapterFetchError(
+                f"source {source!r} needs a kvstore client "
+                "(--kv-store-master-url)"
+            )
+        blob = kvstore_get(source[len("kvstore://"):])
+        if blob is None:
+            raise AdapterFetchError(f"kvstore object {source!r} not found")
+        return decode_adapter(bytes(blob))
+    p = pathlib.Path(source)
+    if p.is_dir():
+        # HF PEFT adapter directory: the startup-loading path, reused.
+        from llmd_tpu.models.loader import load_lora_adapter
+
+        if model_cfg is None:
+            raise AdapterFetchError(
+                f"PEFT directory {source!r} needs the model config"
+            )
+        try:
+            return load_lora_adapter(model_cfg, source)
+        except (OSError, ValueError, KeyError) as e:
+            raise AdapterFetchError(
+                f"PEFT adapter {source!r} rejected: {e}"
+            ) from e
+    if p.is_file():
+        try:
+            return decode_adapter(p.read_bytes())
+        except OSError as e:
+            raise AdapterFetchError(f"read {source!r} failed: {e}") from e
+    raise AdapterFetchError(f"adapter source {source!r} not found")
+
+
+def fetch_adapter(
+    source: str,
+    *,
+    name: str = "",
+    model_cfg=None,
+    kvstore_get=None,
+    timeout_s: float = 10.0,
+    retries: int = 1,
+) -> dict:
+    """Fetch + decode adapter weights from ``source`` (PEFT directory,
+    framed ``.lora`` file, ``http(s)://`` URL, or ``kvstore://<key>``).
+
+    One transient failure is retried (``retries``); persistent failure
+    raises :class:`AdapterFetchError` for the serving layer to surface
+    as a counted 4xx. Decode errors (CRC/parse) are NOT retried — a
+    corrupt object stays corrupt."""
+    key = f"{name}|{source}"
+    last: Exception | None = None
+    for _ in range(1 + max(0, retries)):
+        # Injection sites (fault-tolerance.md site catalog): a slow or
+        # failing adapter store must degrade on the load API, never
+        # wedge the engine batch serving resident adapters.
+        faults.delay("lora.fetch.delay_ms", key)
+        if faults.fires("lora.load.fail", key):
+            last = AdapterFetchError(f"injected lora.load.fail for {key!r}")
+            continue
+        try:
+            return _fetch_once(
+                source, model_cfg=model_cfg, kvstore_get=kvstore_get,
+                timeout_s=timeout_s,
+            )
+        except AdapterDecodeError:
+            raise
+        except AdapterFetchError as e:
+            last = e
+    raise last if last is not None else AdapterFetchError(source)

@@ -72,7 +72,15 @@ def make_engine_config(args, lora_adapters=None):
         overrides["max_model_len"] = args.max_model_len
     if args.quantization:
         overrides["quantization"] = args.quantization
-    if lora_adapters:
+    if getattr(args, "lora_pool_slots", 0):
+        # Paged adapter pool (docs/architecture/multi-tenant-lora.md):
+        # the slot count bounds HBM residency only; the servable set is
+        # the runtime registry (/v1/load_lora_adapter), seeded from any
+        # --lora-adapters entries at startup.
+        overrides["num_lora_adapters"] = args.lora_pool_slots
+        overrides["lora_rank"] = args.lora_rank
+        overrides["lora_dynamic"] = True
+    elif lora_adapters:
         overrides["num_lora_adapters"] = len(lora_adapters)
         overrides["lora_rank"] = args.lora_rank
     weights_path = args.weights_path
@@ -332,6 +340,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--lora-rank", type=int, default=16)
     p.add_argument(
+        "--lora-pool-slots", type=int, default=0,
+        help="paged adapter pool: N HBM rank-(--lora-rank) slots over an "
+        "UNBOUNDED runtime adapter registry "
+        "(/v1/load_lora_adapter + /v1/unload_lora_adapter, the vLLM "
+        "dynamic-LoRA contract) — idle residents are LRU-evicted for "
+        "incoming tenants, slots referenced by in-flight rows are "
+        "pinned, and a request naming a cold adapter parks in a "
+        "loading queue instead of stalling the batch. 0 (default) "
+        "keeps the fixed build-time --lora-adapters slot mapping "
+        "(docs/architecture/multi-tenant-lora.md)",
+    )
+    p.add_argument(
         "--otlp-traces-endpoint", default=None,
         help="OTLP/HTTP collector base URL (e.g. http://otel:4318)",
     )
@@ -420,15 +440,28 @@ def main(argv=None) -> None:
         )
         engine.runner.follower_loop()
         return
-    for name, (slot, path) in (adapter_specs or {}).items():
-        if path:
-            from llmd_tpu.models.loader import load_lora_adapter
+    if args.lora_pool_slots:
+        # Dynamic pool: --lora-adapters entries seed the runtime
+        # registry (bare names register identity adapters until weights
+        # load through the API); names resolve engine-side thereafter.
+        for name, (_slot, path) in (adapter_specs or {}).items():
+            if path:
+                engine.load_adapter(name, path)
+            else:
+                engine.load_adapter(name, weights={})
+            logging.info("registered LoRA adapter %r (source=%s)",
+                         name, path or "<identity>")
+        lora_adapters = None
+    else:
+        for name, (slot, path) in (adapter_specs or {}).items():
+            if path:
+                from llmd_tpu.models.loader import load_lora_adapter
 
-            engine.set_lora_weights(
-                slot, load_lora_adapter(config.model, path)
-            )
-            logging.info("loaded LoRA adapter %r from %s into slot %d",
-                         name, path, slot)
+                engine.set_lora_weights(
+                    slot, load_lora_adapter(config.model, path)
+                )
+                logging.info("loaded LoRA adapter %r from %s into slot %d",
+                             name, path, slot)
     if not args.skip_warmup:
         n = engine.runner.warmup()
         logging.info("warmup compiled %d programs", n)

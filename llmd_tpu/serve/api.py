@@ -317,7 +317,15 @@ async def handle_models(request: web.Request) -> web.Response:
         }
     ]
     # LoRA adapters serve under their own model ids (vLLM convention).
-    for name in request.app.get(LORA_KEY) or {}:
+    # Dynamic-pool engines list the runtime registry (load/unload moves
+    # this set); static engines the build-time map.
+    registry = _adapter_registry(request)
+    names = (
+        registry.names()
+        if registry is not None
+        else list(request.app.get(LORA_KEY) or {})
+    )
+    for name in names:
         entries.append(
             {
                 "id": name,
@@ -380,7 +388,9 @@ async def handle_embeddings(request: web.Request) -> web.Response:
         # embed through their slot; unknown ids 404 rather than silently
         # embedding with the base model.
         try:
-            lora_id, _ = _resolve_lora(request, body.get("model") or "")
+            lora_id, lora_name = _resolve_lora(
+                request, body.get("model") or ""
+            )
         except UnknownModelError as e:
             return _error(404, f"unknown model {e}")
         raw = body.get("input")
@@ -406,7 +416,7 @@ async def handle_embeddings(request: web.Request) -> web.Response:
     except (json.JSONDecodeError, ValueError, TypeError) as e:
         return _error(400, str(e))
     try:
-        vectors = await engine.embed(prompts, lora_id)
+        vectors = await engine.embed(prompts, lora_id, lora_name)
     except ValueError as e:  # over max_model_len
         return _error(400, str(e))
     total_tokens = sum(len(p) for p in prompts)
@@ -793,15 +803,31 @@ class UnknownModelError(Exception):
     pass
 
 
+def _adapter_registry(request: web.Request):
+    """The engine's DYNAMIC adapter registry (paged-pool engines,
+    docs/architecture/multi-tenant-lora.md), or None on static/no-LoRA
+    engines."""
+    engine = request.app.get(ENGINE_KEY)
+    return getattr(getattr(engine, "engine", None), "adapter_registry", None)
+
+
 def _resolve_lora(request: web.Request, model: str) -> tuple[int, str]:
     """Model id -> (lora slot, adapter name). With adapters configured,
     an id that is neither the base model nor an adapter is a client error
     (adapters are advertised as distinct model ids; silently serving the
-    base for a typo'd name masks misconfiguration)."""
+    base for a typo'd name masks misconfiguration).
+
+    Dynamic-pool engines resolve by NAME: the engine owns the name->slot
+    map (residency moves at runtime), so the returned slot is 0 and the
+    name alone rides to add_request."""
     adapters = request.app.get(LORA_KEY) or {}
     if model in adapters:
         return adapters[model], model
-    if adapters and model and model != request.app[MODEL_KEY]:
+    registry = _adapter_registry(request)
+    if registry is not None and model and registry.has(model):
+        return 0, model
+    known = bool(adapters) or (registry is not None and len(registry))
+    if known and model and model != request.app[MODEL_KEY]:
         raise UnknownModelError(model)
     return 0, ""
 
@@ -1028,7 +1054,9 @@ async def handle_grpc_embed(request: web.Request) -> web.Response:
     if not isinstance(body, dict):
         return _error(400, "request body must be a JSON object")
     try:
-        lora_id, _ = _resolve_lora(request, str(body.get("model") or ""))
+        lora_id, lora_name = _resolve_lora(
+            request, str(body.get("model") or "")
+        )
     except UnknownModelError as e:
         return _error(404, f"unknown model {e}")
     ids = body.get("prompt_token_ids") or body.get("token_ids") or []
@@ -1042,7 +1070,7 @@ async def handle_grpc_embed(request: web.Request) -> web.Response:
         if len(p) > max_len:
             return _error(400, f"prompt length {len(p)} > max_model_len {max_len}")
     try:
-        vectors = await engine.embed(prompts, lora_id)
+        vectors = await engine.embed(prompts, lora_id, lora_name)
     except ValueError as e:  # over the embed batch-token limit
         return _error(400, str(e))
     return web.json_response({"embeddings": vectors.tolist()})
@@ -1300,6 +1328,96 @@ async def handle_admin_status(request: web.Request) -> web.Response:
     )
 
 
+# --------------------------------------------------------------------- #
+# Runtime adapter load/unload (the vLLM dynamic-LoRA contract;
+# docs/architecture/multi-tenant-lora.md). Registration is unbounded —
+# the paged pool bounds HBM residency, not the servable set. Loads are
+# lockstep-broadcast slot installs, so multi-host replicas flip
+# atomically; a failed fetch degrades to a counted 4xx
+# (lora_load_failures_total), never a wedged batch.
+
+_LORA_NAME_RE = re.compile(r"[A-Za-z0-9._:/-]+")
+
+
+async def handle_load_lora_adapter(request: web.Request) -> web.Response:
+    engine: AsyncEngine = request.app[ENGINE_KEY]
+    if _adapter_registry(request) is None:
+        return _error(
+            400,
+            "dynamic adapter serving is disabled (start the server with "
+            "--lora-pool-slots)",
+        )
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error(400, f"invalid JSON: {e}")
+    if not isinstance(body, dict):
+        return _error(400, "request body must be a JSON object")
+    name = str(body.get("lora_name") or "")
+    source = str(
+        body.get("lora_path") or body.get("lora_url") or body.get("source")
+        or ""
+    )
+    if not name or not _LORA_NAME_RE.fullmatch(name):
+        # Names interpolate into Prometheus label values and model ids.
+        return _error(
+            400, f"invalid lora_name {name!r}: use letters, digits, ._:/-"
+        )
+    if name == request.app[MODEL_KEY]:
+        return _error(400, f"lora_name {name!r} shadows the base model id")
+    if not source:
+        return _error(
+            400, "lora_path (or lora_url / source) is required"
+        )
+    from llmd_tpu.lora import AdapterFetchError
+
+    try:
+        await engine.load_adapter(name, source)
+    except (AdapterFetchError, ValueError) as e:
+        # Fetch/decode/duplicate failures are CLIENT errors: counted
+        # (lora_load_failures_total covers the fetch leg) and surfaced;
+        # base-model rows and resident adapters are untouched.
+        return _error(400, str(e))
+    except RuntimeError as e:  # dynamic serving disabled
+        return _error(400, str(e))
+    return web.json_response(
+        {
+            "status": "ok",
+            "message": f"Success: LoRA adapter '{name}' added successfully",
+            "lora_name": name,
+        }
+    )
+
+
+async def handle_unload_lora_adapter(request: web.Request) -> web.Response:
+    engine: AsyncEngine = request.app[ENGINE_KEY]
+    if _adapter_registry(request) is None:
+        return _error(400, "dynamic adapter serving is disabled")
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return _error(400, f"invalid JSON: {e}")
+    if not isinstance(body, dict):
+        return _error(400, "request body must be a JSON object")
+    name = str(body.get("lora_name") or "")
+    if not name:
+        return _error(400, "lora_name is required")
+    try:
+        await engine.unload_adapter(name)
+    except KeyError as e:
+        return _error(404, str(e.args[0]) if e.args else name)
+    except RuntimeError as e:
+        # In-flight rows reference the adapter: conflict, retry later.
+        return _error(409, str(e))
+    return web.json_response(
+        {
+            "status": "ok",
+            "message": f"Success: LoRA adapter '{name}' removed successfully",
+            "lora_name": name,
+        }
+    )
+
+
 async def handle_completions(request: web.Request) -> web.StreamResponse:
     return await _handle_generate(request, chat=False)
 
@@ -1349,6 +1467,8 @@ def build_app(
             web.post("/v1/completions/render", handle_completions_render),
             web.post("/v1/chat/completions/render", handle_chat_render),
             web.post("/v1/cache/probe", handle_cache_probe),
+            web.post("/v1/load_lora_adapter", handle_load_lora_adapter),
+            web.post("/v1/unload_lora_adapter", handle_unload_lora_adapter),
             *_responses_routes(),
             web.post("/admin/pause", handle_admin_pause),
             web.post("/admin/resume", handle_admin_resume),

@@ -270,13 +270,35 @@ class AsyncEngine:
             self._lock.notify_all()
         return q
 
-    async def embed(self, prompts: list[list[int]], lora_id: int = 0):
+    async def embed(
+        self,
+        prompts: list[list[int]],
+        lora_id: int = 0,
+        lora_name: str = "",
+    ):
         """Pooled embeddings off the event loop (the forward runs on an
         executor thread; params are read-only so it coexists with the
         step thread)."""
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            None, functools.partial(self.engine.embed, prompts, lora_id)
+            None,
+            functools.partial(self.engine.embed, prompts, lora_id, lora_name),
+        )
+
+    async def load_adapter(self, name: str, source: str = "") -> None:
+        """Runtime adapter registration (/v1/load_lora_adapter): the
+        fetch + lockstep slot install run on an executor thread — the
+        event loop and the step thread never block on the weight
+        transfer (docs/architecture/multi-tenant-lora.md)."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, functools.partial(self.engine.load_adapter, name, source)
+        )
+
+    async def unload_adapter(self, name: str) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, functools.partial(self.engine.unload_adapter, name)
         )
 
     def abort(self, request_id: str) -> None:

@@ -158,20 +158,37 @@ def render_metrics(
     if stats.max_lora:
         # reference model-servers.md:78-89: adapter state rides labels on
         # a gauge named vllm:lora_requests_info. available_lora_adapters
-        # is this framework's extension: the FULL registered set, so the
-        # router can fold adapter identity into prefix hashes even for
-        # adapters with nothing in flight.
+        # is this framework's extension: the FULL registered set — the
+        # DYNAMIC registry on paged-pool engines (runtime load/unload),
+        # falling back to the build-time static map — so the router can
+        # fold adapter identity into prefix hashes even for adapters
+        # with nothing in flight. resident_lora_adapters is the HBM
+        # working set the tri-state LoraAffinityScorer routes on
+        # (docs/architecture/multi-tenant-lora.md).
         running = ",".join(stats.running_lora_adapters)
         waiting = ",".join(stats.waiting_lora_adapters)
-        available = ",".join(sorted(lora_adapters or ()))
+        available = ",".join(
+            stats.available_lora_adapters or sorted(lora_adapters or ())
+        )
+        resident = ",".join(stats.resident_lora_adapters) or available
         lines.append("# TYPE vllm:lora_requests_info gauge")
         lines.append(
             f'vllm:lora_requests_info{{max_lora="{stats.max_lora}",'
             f'running_lora_adapters="{running}",'
             f'waiting_lora_adapters="{waiting}",'
             f'available_lora_adapters="{available}",'
+            f'resident_lora_adapters="{resident}",'
             f'model_name="{model_name}"}} 1'
         )
+        # Paged adapter pool (multi-tenant-lora.md): HBM residency vs
+        # the unbounded registry — evictions, cold-load waits, and load
+        # API failures are the thrash/degradation trail.
+        gauges["lora_pool_resident_adapters"] = (
+            stats.lora_pool_resident_adapters
+        )
+        counters["lora_pool_evictions_total"] = stats.lora_pool_evictions_total
+        counters["lora_cold_loads_total"] = stats.lora_cold_loads_total
+        counters["lora_load_failures_total"] = stats.lora_load_failures_total
     if stats.spec_accepted_len_hist:
         # Speculative decoding (propose/verify/accept contract,
         # docs/architecture/speculative-decoding.md + observability.md).

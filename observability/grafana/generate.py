@@ -374,10 +374,38 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
                    "raise blocks or lower max_num_seqs."),
         panel("Requests finished /s",
               [f"rate(vllm:request_success_total{M}[5m])"], unit="reqps"),
-        panel("LoRA adapters (running/waiting ride labels)",
+        row("Adapter pool"),
+        panel("LoRA adapters (running/waiting/resident ride labels)",
               [f"vllm:lora_requests_info{M}"], kind="table", h=6,
               desc="Adapter state gauge; available_lora_adapters lists the "
-                   "full registered set for router affinity."),
+                   "DYNAMIC registry (runtime load/unload), "
+                   "resident_lora_adapters the HBM working set the "
+                   "tri-state lora-affinity scorer routes on "
+                   "(docs/architecture/multi-tenant-lora.md)."),
+        panel("Resident adapters",
+              [f"llmd:lora_pool_resident_adapters{M}"], kind="stat",
+              w=4, h=6,
+              desc="Adapters holding an HBM pool slot right now "
+                   "(bounded by --lora-pool-slots; the registry is "
+                   "unbounded)."),
+        panel("Adapter pool churn /s",
+              [f"rate(llmd:lora_cold_loads_total{M}[5m])",
+               f"rate(llmd:lora_pool_evictions_total{M}[5m])"],
+              legends=["cold loads/s", "evictions/s"],
+              thresholds=[(None, "green"), (5, "yellow")],
+              desc="Cold loads (requests parked for a slot install) and "
+                   "LRU evictions of idle residents. Sustained high "
+                   "churn = the tenant working set exceeds pool "
+                   "capacity — raise --lora-pool-slots or tighten "
+                   "router adapter affinity (LLMD_LORA_TIER_WEIGHTS)."),
+        panel("Adapter load failures /s",
+              [f"rate(llmd:lora_load_failures_total{M}[5m])"],
+              kind="stat", w=4, h=6,
+              thresholds=[(None, "green"), (0.01, "red")],
+              desc="/v1/load_lora_adapter fetches that failed after "
+                   "retry (surfaced 4xx): the adapter store is "
+                   "unreachable or serving corrupt blobs — base-model "
+                   "and resident-adapter serving is unaffected."),
         panel("Cache geometry (block_size / num_gpu_blocks ride labels)",
               [f"vllm:cache_config_info{M}"], kind="table", h=6,
               desc="The BlockSize/NumGPUBlocks half of the EPP metrics "

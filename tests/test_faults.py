@@ -984,3 +984,108 @@ def test_router_sigterm_flips_readyz_while_socket_serves(tmp_path):
         if proc.poll() is None:
             proc.kill()
         proc.wait()
+
+
+# --------------------------------------------------------------------- #
+# multi-tenant LoRA adapter fetch leg (docs/architecture/multi-tenant-lora.md)
+
+
+def _lora_engine(slots=2):
+    from llmd_tpu.config import tiny_model_config
+
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config(
+            name="tiny-lora", num_lora_adapters=slots, lora_rank=4,
+            lora_dynamic=True,
+        ),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=64),
+    ))
+
+
+def _framed_adapter(engine, path, seed=7):
+    from llmd_tpu.lora import encode_adapter
+
+    layers = engine.runner.params["layers"]
+    rng = np.random.default_rng(seed)
+    weights = {
+        k: rng.normal(0.0, 0.5, (layers[k].shape[0], *layers[k].shape[2:]))
+        .astype(np.float32)
+        for k in ("la_q", "lb_q", "la_v", "lb_v")
+    }
+    path.write_bytes(encode_adapter(weights))
+    return weights
+
+
+def test_lora_load_fail_single_fault_retried(tmp_path):
+    """One injected fetch failure: the retry leg absorbs it — the load
+    succeeds and the failure never reaches the client."""
+    engine = _lora_engine()
+    blob = tmp_path / "a.lora"
+    _framed_adapter(engine, blob)
+    plan({"site": "lora.load.fail", "times": 1})
+    engine.load_adapter("a", source=str(blob))
+    assert faults.injected_counts()["lora.load.fail"] == 1
+    assert engine.adapter_registry.names() == ["a"]
+    assert engine.stats.lora_load_failures_total == 0
+
+
+@pytest.mark.anyio
+async def test_lora_load_fail_persistent_surfaces_4xx(tmp_path):
+    """Persistent fetch failure: retry exhausts, the load API surfaces
+    a counted 4xx, and base-model rows are unaffected throughout."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llmd_tpu.serve.api import build_app
+    from llmd_tpu.serve.async_engine import AsyncEngine
+    from llmd_tpu.serve.tokenizer import ByteTokenizer
+
+    engine = _lora_engine()
+    blob = tmp_path / "a.lora"
+    _framed_adapter(engine, blob)
+    plan({"site": "lora.load.fail", "times": None})
+    app = build_app(AsyncEngine(engine), ByteTokenizer(), "tiny-lora", 128)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        r = await client.post(
+            "/v1/load_lora_adapter",
+            json={"lora_name": "a", "lora_path": str(blob)},
+        )
+        assert r.status == 400
+        assert "lora.load.fail" in (await r.json())["error"]["message"]
+        # Counted on the same /metrics surface production scrapes.
+        text = await (await client.get("/metrics")).text()
+        assert "llmd:lora_load_failures_total" in text
+        assert engine.stats.lora_load_failures_total == 1
+        assert faults.injected_counts()["lora.load.fail"] >= 2  # retried
+        # Base-model serving is untouched by the failing adapter store.
+        r = await client.post(
+            "/v1/completions",
+            json={"model": "tiny-lora", "prompt": "hello", "max_tokens": 4},
+        )
+        assert r.status == 200
+        assert engine.adapter_registry.names() == []
+    finally:
+        await client.close()
+
+
+def test_lora_fetch_delay_absorbed(tmp_path):
+    """lora.fetch.delay_ms stalls only the fetch leg: the load lands
+    late but correct, and serving under the adapter works."""
+    engine = _lora_engine()
+    blob = tmp_path / "a.lora"
+    _framed_adapter(engine, blob)
+    plan({"site": "lora.fetch.delay_ms", "times": 1, "delay_ms": 30.0})
+    t0 = time.monotonic()
+    engine.load_adapter("a", source=str(blob))
+    assert time.monotonic() - t0 >= 0.03
+    assert faults.injected_counts()["lora.fetch.delay_ms"] == 1
+    sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True)
+    rid = engine.add_request([1, 2, 3, 4], sp, lora_name="a")
+    out = []
+    while engine.has_work():
+        for res in engine.step():
+            if res.request_id == rid:
+                out.extend(res.new_token_ids)
+    assert len(out) == 3
