@@ -7,6 +7,7 @@ from llmd_tpu.analysis.checkers import (  # noqa: F401
     envvars,
     faults_discipline,
     host_sync,
+    lifecycle,
     lockstep,
     metrics_parity,
     trace,

@@ -1,0 +1,1068 @@
+"""lifecycle: resource acquire/release discipline (RL001-RL003).
+
+The last four review passes each hand-caught a leaked *handle*: the
+AdapterPool duplicate-install race leaked a slot out of both ``_free``
+and ``_slot_of`` (PR 13), the endpoint breaker burned one half-open
+probe grant per cooldown window (PR 8), and the spec-decode rollback
+needed ``_truncate_spec_pages`` invariants to keep provisional KV pages
+from escaping (PR 2/4). These rules mechanize that review the way the
+``concurrency`` rules mechanized the lock review; the runtime half is
+the leak sanitizer in :mod:`llmd_tpu.analysis.sanitize`
+(``LLMD_LEAKSAN=1``).
+
+Protocol declaration — on the owning class (the resource manager), a
+comment on the ``class`` line or the line(s) directly above::
+
+    # llmd: resource(pages, recv=alloc, acquire=allocate|touch:arg,
+    #                release=free, transfer=commit_page)
+
+- ``recv=`` — ``|``-separated substrings; a call site participates only
+  when the receiver's final name component contains one (case-
+  insensitive), or the receiver is ``self`` inside the declaring class.
+  Guards generic method names (``free``, ``acquire``) against unrelated
+  classes.
+- ``acquire=`` — methods that mint a handle. Default handle is the
+  return value (``:ret``); ``:arg`` / ``:argN`` declares the N-th
+  positional argument (1-based) as the handle instead (lease-style
+  protocols key the handle on the *name* passed in).
+- ``release=`` / ``transfer=`` — methods that end a handle's life
+  (refund vs. publish-to-owned-state). Handle is the first positional
+  argument unless ``:argN`` says otherwise.
+
+Ownership handoffs out of the checked scope are declared, not guessed::
+
+    self._entries = {}  # llmd: owns(pages)     (attribute is a root)
+    # llmd: transfers(pages)                    (on a def: ownership
+    def steal(self, ids): ...                    crosses this boundary)
+
+Storing a handle into an ``owns``-annotated attribute (assignment,
+subscript, or a mutator call such as ``.append``/``.extend``), passing
+it by matching keyword to any constructor/call, passing it to a
+``transfers``-marked callee, or returning it from a ``transfers``-marked
+function all count as release-equivalent handoffs.
+
+Rules
+-----
+
+RL001 **release-on-all-paths** — every acquisition must reach a
+release, transfer, or declared handoff on every exit path. A ``return``
+or ``raise`` with a live handle, a loop iteration that ends with one, a
+reacquire over one, and an exception-capable call between acquire and
+release with no covering ``finally`` (or broad ``except`` that
+releases) are all findings — reported once per acquisition, AT the
+acquisition line, so one pragma covers the site.
+
+RL002 **release-pairing** — double-release of the same handle variable
+on one path, and release of a variable that was only *peeked* (assigned
+from a non-acquire method of the same resource manager, e.g.
+``slot_of``): flow-insensitive per-function pairing over the handle
+variable.
+
+RL003 **escaping-handle** — a handle stored into state that is not
+``owns``-annotated, or returned from a function that is not
+``transfers``-marked, silently moves ownership outside the checked
+scope; the leak just happens later, somewhere the checker cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from llmd_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Repo,
+    _python_comment_lines,
+    register,
+)
+
+# Matched against the comment BLOCK around a class def joined into one
+# line (continuation lines stripped of their leading `#`), so the
+# declaration may wrap across comment lines; `)` never appears inside
+# the grammar's values, so the first close-paren ends it.
+RESOURCE_RE = re.compile(
+    r"llmd:\s*resource\(\s*([a-z0-9_-]+)\s*(?:,\s*([^)]*?))?\s*\)"
+)
+OWNS_RE = re.compile(r"#\s*llmd:\s*owns\(\s*([a-z0-9_,\s-]+?)\s*\)")
+TRANSFERS_RE = re.compile(r"#\s*llmd:\s*transfers\(\s*([a-z0-9_,\s-]+?)\s*\)")
+
+# Calls that cannot plausibly raise mid-protocol (the exception-edge
+# check ignores them): builtins plus the no-fail container mutators.
+_SAFE_CALLS = frozenset({
+    "len", "int", "str", "float", "bool", "list", "dict", "set", "tuple",
+    "sorted", "min", "max", "sum", "enumerate", "zip", "range", "repr",
+    "isinstance", "getattr", "hasattr", "print", "id", "iter", "next",
+    "abs", "round", "frozenset",
+})
+_SAFE_METHODS = frozenset({
+    "append", "extend", "pop", "popleft", "popitem", "add", "discard",
+    "remove", "clear", "update", "get", "items", "keys", "values",
+    "move_to_end", "setdefault", "insert", "count", "index", "copy",
+    "join", "split", "strip", "encode", "decode", "format", "startswith",
+    "endswith", "lower", "upper", "debug", "info", "warning", "error",
+    "monotonic", "perf_counter", "time",
+})
+# Mutator methods through which a handle lands in an owns-annotated
+# container attribute.
+_OWNS_MUTATORS = frozenset({
+    "append", "extend", "add", "update", "insert", "setdefault", "put",
+})
+
+
+def _name_chain(expr: ast.expr) -> str | None:
+    """``pod.address`` -> "pod.address", ``x`` -> "x" (depth <= 2 so
+    handle keys stay stable; deeper chains are not tracked)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return f"{expr.value.id}.{expr.attr}"
+    return None
+
+
+def _recv_name(expr: ast.expr) -> str | None:
+    """Final name component of a call receiver (``self.adapter_pool``
+    -> ``adapter_pool``; ``self`` -> ``self``)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+class _Protocol:
+    def __init__(self, name: str, cls: str, path: str, line: int) -> None:
+        self.name = name
+        self.cls = cls
+        self.path = path
+        self.line = line
+        self.recv: list[str] = []
+        self.acquire: dict[str, object] = {}  # method -> "ret" | int (1-based)
+        self.release: dict[str, int] = {}
+        self.transfer: dict[str, int] = {}
+
+    @property
+    def methods(self) -> set[str]:
+        return set(self.acquire) | set(self.release) | set(self.transfer)
+
+    def recv_matches(self, recv: str | None, in_owner_class: bool) -> bool:
+        if recv == "self" or recv == "cls":
+            return in_owner_class
+        if not self.recv:
+            return True
+        if recv is None:
+            return False
+        low = recv.lower()
+        return any(hint in low for hint in self.recv)
+
+
+def _parse_methods(raw: str, default_mode) -> dict:
+    out: dict = {}
+    for tok in raw.split("|"):
+        tok = tok.strip()
+        if not tok:
+            continue
+        mode = default_mode
+        if ":" in tok:
+            tok, suffix = tok.split(":", 1)
+            if suffix == "ret":
+                mode = "ret"
+            elif suffix == "arg":
+                mode = 1
+            elif suffix.startswith("arg"):
+                mode = int(suffix[3:])
+        out[tok] = mode
+    return out
+
+
+class _Registry:
+    """Tree-wide protocol / owns / transfers declarations."""
+
+    def __init__(self) -> None:
+        self.protocols: list[_Protocol] = []
+        # method name -> [protocols declaring it] (for call matching)
+        self.by_method: dict[str, list[_Protocol]] = {}
+        # attribute name -> resources it is an ownership root for
+        self.owns: dict[str, set[str]] = {}
+        # function/method NAME -> resources whose ownership crosses it
+        self.transfers: dict[str, set[str]] = {}
+
+    def add_protocol(self, p: _Protocol) -> None:
+        self.protocols.append(p)
+        for m in p.methods:
+            self.by_method.setdefault(m, []).append(p)
+
+    def match_call(
+        self, call: ast.Call, in_class: str | None
+    ) -> tuple[_Protocol, str, object] | None:
+        """(protocol, kind, mode) for a protocol-method call, else None.
+        kind in {"acquire", "release", "transfer"}."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        mname = call.func.attr
+        cands = self.by_method.get(mname)
+        if not cands:
+            return None
+        recv = _recv_name(call.func.value)
+        for p in cands:
+            if not p.recv_matches(recv, in_class == p.cls):
+                continue
+            if mname in p.acquire:
+                return p, "acquire", p.acquire[mname]
+            if mname in p.release:
+                return p, "release", p.release[mname]
+            return p, "transfer", p.transfer[mname]
+        return None
+
+    def peek_call(self, call: ast.Call, in_class: str | None) -> str | None:
+        """Resource name when ``call`` is a recv-matched call to a
+        NON-acquire method of a manager (a peek like ``slot_of``):
+        releasing its result is RL002's release-without-acquire."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        mname = call.func.attr
+        recv = _recv_name(call.func.value)
+        for p in self.protocols:
+            if mname in p.methods:
+                continue
+            # Only confidently-owned receivers count (a recv hint must
+            # match; bare self/unhinted receivers are too ambiguous).
+            if recv is not None and recv not in ("self", "cls") and p.recv \
+                    and any(h in recv.lower() for h in p.recv):
+                return p.name
+        return None
+
+
+def build_registry(repo: Repo) -> tuple[_Registry, list[Finding]]:
+    reg = _Registry()
+    findings: list[Finding] = []
+    for sf in repo.files:
+        if not sf.is_python or sf.tree is None:
+            continue
+        comments = _python_comment_lines(sf.text) or {}
+
+        def comment_at(line: int) -> str:
+            if comments:
+                return comments.get(line, "")
+            return sf.lines[line - 1] if 0 < line <= len(sf.lines) else ""
+
+        def decl_comments(node) -> list[tuple[int, str]]:
+            """Comment on the def/class line plus up to 3 consecutive
+            comment lines directly above (skipping decorators)."""
+            out = [(node.lineno, comment_at(node.lineno))]
+            top = min(
+                [node.lineno]
+                + [d.lineno for d in getattr(node, "decorator_list", ())]
+            )
+            for back in range(1, 4):
+                line = top - back
+                raw = sf.lines[line - 1] if 0 < line <= len(sf.lines) else ""
+                if not raw.lstrip().startswith("#"):
+                    break
+                out.append((line, comment_at(line)))
+            return out
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                # Join the comment block (lines above + class line, in
+                # source order, continuation `#` stripped) so wrapped
+                # declarations — the form the docs' grammar examples
+                # use — parse identically to single-line ones.
+                block = sorted(decl_comments(node))
+                joined = " ".join(
+                    text.lstrip("#").strip() for _, text in block if text
+                )
+                for m in RESOURCE_RE.finditer(joined):
+                    line = next(
+                        (ln for ln, text in block if m.group(1) in text
+                         and "resource" in text),
+                        node.lineno,
+                    )
+                    p = _Protocol(m.group(1), node.name, sf.path, line)
+                    for part in (m.group(2) or "").split(","):
+                        part = part.strip()
+                        if not part or "=" not in part:
+                            continue
+                        key, _, val = part.partition("=")
+                        key = key.strip()
+                        if key == "recv":
+                            p.recv = [
+                                v.strip().lower()
+                                for v in val.split("|") if v.strip()
+                            ]
+                        elif key == "acquire":
+                            p.acquire = _parse_methods(val, "ret")
+                        elif key == "release":
+                            p.release = _parse_methods(val, 1)
+                        elif key == "transfer":
+                            p.transfer = _parse_methods(val, 1)
+                    if not p.acquire:
+                        findings.append(Finding(
+                            "release-on-all-paths", "RL001", sf.path, line,
+                            f"resource({p.name}) declares no acquire= "
+                            "methods — the protocol is unenforceable",
+                        ))
+                        continue
+                    reg.add_protocol(p)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for _, text in decl_comments(node):
+                    m = TRANSFERS_RE.search(text)
+                    if m:
+                        reg.transfers.setdefault(node.name, set()).update(
+                            r.strip() for r in m.group(1).split(",")
+                            if r.strip()
+                        )
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                for line in (node.lineno, node.lineno - 1):
+                    raw = (
+                        sf.lines[line - 1]
+                        if 0 < line <= len(sf.lines) else ""
+                    )
+                    if line != node.lineno and not raw.lstrip().startswith("#"):
+                        continue
+                    m = OWNS_RE.search(comment_at(line))
+                    if not m:
+                        continue
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    names = {
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    }
+                    for t in targets:
+                        attr = (
+                            t.attr if isinstance(t, ast.Attribute)
+                            else t.id if isinstance(t, ast.Name) else None
+                        )
+                        if attr:
+                            reg.owns.setdefault(attr, set()).update(names)
+                    break
+    return reg, findings
+
+
+# ------------------------------------------------------------------ #
+# per-function handle-flow walk
+
+
+class _Handle:
+    __slots__ = ("resource", "line", "guard", "release_lines", "reported")
+
+    def __init__(self, resource: str, line: int) -> None:
+        self.resource = resource
+        self.line = line
+        self.guard: str | None = None  # result var gating an :arg acquire
+        self.release_lines: list[int] = []
+        self.reported: set[str] = set()  # codes already filed
+
+
+class _FnWalker:
+    """Walk one function body threading (env, dead) through branches.
+
+    env: handle-key -> _Handle for LIVE handles on the current path.
+    dead: handle-key -> (handle, kind) after release/transfer/handoff.
+    peeked: var -> resource (assigned from a manager peek method).
+    """
+
+    def __init__(
+        self, an: "_Analysis", sf, fn, cls_name: str | None,
+        exempt: set[str] | None = None,
+    ) -> None:
+        self.an = an
+        self.sf = sf
+        self.fn = fn
+        self.cls = cls_name
+        # Resources whose protocol THIS method implements: a protocol
+        # method's body is exempt from its own resource's rules (it IS
+        # the implementation) but fully checked for every other
+        # resource it uses (apply_bundle releases `bundles` yet must
+        # still balance the `pages` it allocates).
+        self.exempt = exempt or set()
+        self.peeked: dict[str, str] = {}
+        self.transfers = an.reg.transfers.get(fn.name, set())
+        # Stack of protector frames: (finally-set, handler-set) of
+        # (key, resource) released there. finally covers every exit;
+        # a broad except handler covers only exception edges.
+        self.protectors: list[tuple[set, set]] = []
+
+    # ---- findings ---------------------------------------------------- #
+
+    def _file(self, rule: str, code: str, line: int, msg: str) -> None:
+        self.an.findings.append(Finding(rule, code, self.sf.path, line, msg))
+
+    def leak(self, h: _Handle, why: str) -> None:
+        """RL001, once per acquisition, at the acquisition line."""
+        if "RL001" in h.reported:
+            return
+        h.reported.add("RL001")
+        partial = (
+            f" (released at line {h.release_lines[0]} on another path)"
+            if h.release_lines else ""
+        )
+        self._file(
+            "release-on-all-paths", "RL001", h.line,
+            f"{h.resource} handle acquired here {why}{partial} — release "
+            "or transfer it on every exit path (try/finally, a declared "
+            "handoff into `# llmd: owns(...)` state, or a "
+            "`# llmd: transfers(...)` boundary)",
+        )
+
+    # ---- helpers ----------------------------------------------------- #
+
+    def protected(self, env, h: _Handle, exc: bool = True) -> bool:
+        """A handle is protected when ANY of its live aliases is
+        released in an enclosing finally (every exit) or — for
+        exception edges only — a broad except handler."""
+        keys = [k for k, v in env.items() if v is h]
+        for fin, handler in self.protectors:
+            for k in keys:
+                if (k, h.resource) in fin:
+                    return True
+                if exc and (k, h.resource) in handler:
+                    return True
+        return False
+
+    def _match(self, call: ast.Call):
+        """match_call filtered by this method's own-protocol exemption."""
+        hit = self.an.reg.match_call(call, self.cls)
+        if hit is not None and hit[0].name in self.exempt:
+            return None
+        return hit
+
+    def _release_keys_in(self, stmts) -> set[tuple[str, str]]:
+        """(handle-key, resource) pairs a finally/except body releases,
+        transfers, or hands off — the exception-edge protectors."""
+        out: set[tuple[str, str]] = set()
+        for stmt in stmts:
+            for call in (
+                n for n in ast.walk(stmt) if isinstance(n, ast.Call)
+            ):
+                hit = self._match(call)
+                if hit is not None and hit[1] in ("release", "transfer"):
+                    idx = hit[2] if isinstance(hit[2], int) else 1
+                    if len(call.args) >= idx:
+                        key = _name_chain(call.args[idx - 1])
+                        if key:
+                            out.add((key, hit[0].name))
+                    continue
+                for res in self.an.reg.transfers.get(
+                    call.func.attr if isinstance(call.func, ast.Attribute)
+                    else call.func.id if isinstance(call.func, ast.Name)
+                    else "", ()
+                ):
+                    for a in call.args:
+                        key = _name_chain(a)
+                        if key:
+                            out.add((key, res))
+        return out
+
+    def _bind(self, env, dead, key: str, h: _Handle) -> None:
+        if key in env and env[key] is not h:
+            self.leak(env[key], f"is overwritten at line {h.line} while "
+                                "still live")
+        env[key] = h
+        dead.pop(key, None)
+        self.peeked.pop(key, None)
+
+    def _kill(self, env, dead, h: _Handle, kind: str, line: int) -> None:
+        """Release/transfer/handoff: drop every alias of ``h``."""
+        h.release_lines.append(line)
+        for k in [k for k, v in env.items() if v is h]:
+            del env[k]
+            dead[k] = (h, kind)
+
+    def _narrow(self, env, test: ast.expr, branch_true: bool) -> None:
+        """Guard narrowing: in the branch where the acquire provably
+        failed (`x is None`, `not x` / falsy), the handle never existed."""
+        def drop(var: str, when_true: bool) -> None:
+            if when_true != branch_true:
+                return
+            doomed = {
+                id(h) for k, h in env.items()
+                if k == var or h.guard == var
+            }
+            for k in [k for k, h in env.items() if id(h) in doomed]:
+                del env[k]
+
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            key = _name_chain(test.left)
+            is_none = (
+                isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None
+            )
+            if key and is_none:
+                if isinstance(test.ops[0], ast.Is):
+                    drop(key, True)
+                elif isinstance(test.ops[0], ast.IsNot):
+                    drop(key, False)
+        elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            key = _name_chain(test.operand)
+            if key:
+                drop(key, True)
+        else:
+            key = _name_chain(test)
+            if key:
+                drop(key, False)
+
+    # ---- call classification ----------------------------------------- #
+
+    def _handle_args(self, call: ast.Call, env) -> list[tuple[str, _Handle]]:
+        out = []
+        for a in call.args + [kw.value for kw in call.keywords]:
+            key = _name_chain(a)
+            if key and key in env:
+                out.append((key, env[key]))
+        return out
+
+    def _callee_name(self, call: ast.Call) -> str | None:
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        return None
+
+    def _owns_mutation_attr(self, call: ast.Call) -> str | None:
+        """``x.<attr>.append(...)`` -> attr when attr is owns-annotated."""
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _OWNS_MUTATORS
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr in self.an.reg.owns
+        ):
+            return f.value.attr
+        return None
+
+    def _risky(self, call: ast.Call) -> bool:
+        if self._match(call) is not None:
+            return False
+        name = self._callee_name(call)
+        if name in _SAFE_CALLS or name in _SAFE_METHODS:
+            return False
+        return True
+
+    def process_calls(self, stmt, env, dead, consumed: set[int]) -> None:
+        """Generic pass: nested acquires, transfers-callees, handoffs
+        into owns state, and the exception-edge check — over every call
+        in the statement not already consumed by the specific forms."""
+        calls = [n for n in ast.walk(stmt) if isinstance(n, ast.Call)]
+        for call in calls:
+            if id(call) in consumed:
+                continue
+            # handoff: mutator on an owns attribute consuming handles
+            # (or direct acquire-call arguments).
+            owns_attr = self._owns_mutation_attr(call)
+            if owns_attr is not None:
+                owned = self.an.reg.owns[owns_attr]
+                for key, h in self._handle_args(call, env):
+                    if h.resource in owned:
+                        self._kill(env, dead, h, "handoff", call.lineno)
+                for a in call.args:
+                    if isinstance(a, ast.Call):
+                        hit = self._match(a)
+                        if hit is not None and hit[1] == "acquire":
+                            consumed.add(id(a))  # acquired-and-stored
+            # handoff: keyword matching an owns attribute (dataclass /
+            # constructor fields), e.g. Bundle(stream_ids=ids).
+            for kw in call.keywords:
+                if kw.arg and kw.arg in self.an.reg.owns:
+                    key = _name_chain(kw.value)
+                    if key and key in env and (
+                        env[key].resource in self.an.reg.owns[kw.arg]
+                    ):
+                        self._kill(env, dead, env[key], "handoff", call.lineno)
+                    if isinstance(kw.value, ast.Call):
+                        hit = self._match(kw.value)
+                        if hit is not None and hit[1] == "acquire":
+                            consumed.add(id(kw.value))
+            # handoff: transfers-marked callee consumes handle args.
+            callee = self._callee_name(call)
+            for res in self.an.reg.transfers.get(callee or "", ()):
+                for key, h in self._handle_args(call, env):
+                    if h.resource == res:
+                        self._kill(env, dead, h, "handoff", call.lineno)
+        for call in calls:
+            if id(call) in consumed:
+                continue
+            hit = self._match(call)
+            if hit is None:
+                continue
+            p, kind, mode = hit
+            consumed.add(id(call))
+            if kind == "acquire":
+                if mode == "ret":
+                    h = _Handle(p.name, call.lineno)
+                    self.leak(h, "but the result is discarded")
+                elif isinstance(mode, int) and len(call.args) >= mode:
+                    key = _name_chain(call.args[mode - 1])
+                    if key:
+                        self._bind(env, dead, key,
+                                   _Handle(p.name, call.lineno))
+            else:
+                idx = mode if isinstance(mode, int) else 1
+                if len(call.args) < idx:
+                    continue
+                key = _name_chain(call.args[idx - 1])
+                if key is None:
+                    continue
+                if key in env and env[key].resource == p.name:
+                    self._kill(env, dead, env[key],
+                               "released" if kind == "release" else
+                               "transferred", call.lineno)
+                elif key in dead and dead[key][1] == "released" \
+                        and kind == "release":
+                    self._file(
+                        "release-pairing", "RL002", call.lineno,
+                        f"double release of {p.name} handle `{key}` — "
+                        f"already released at line "
+                        f"{dead[key][0].release_lines[0]} on this path",
+                    )
+                elif self.peeked.get(key) == p.name and kind == "release":
+                    self._file(
+                        "release-pairing", "RL002", call.lineno,
+                        f"release of {p.name} handle `{key}` that was "
+                        "only peeked (assigned from a non-acquire "
+                        "manager method), never acquired on this path",
+                    )
+        # Exception-edge: any risky call with live, unprotected handles
+        # acquired on an EARLIER line (same-statement acquisition is the
+        # acquire itself).
+        for call in calls:
+            if id(call) not in consumed and self._risky(call):
+                seen: set[int] = set()
+                for key, h in list(env.items()):
+                    if id(h) in seen:
+                        continue
+                    seen.add(id(h))
+                    if h.line < stmt.lineno and not self.protected(env, h):
+                        self.leak(
+                            h,
+                            f"crosses an exception-capable call at line "
+                            f"{call.lineno} with no covering finally",
+                        )
+                break
+
+    # ---- statement walk ---------------------------------------------- #
+
+    def walk_body(self, stmts, env, dead) -> bool:
+        """Returns True when control cannot fall off the end."""
+        terminated = False
+        for stmt in stmts:
+            if terminated:
+                break
+            terminated = self.walk_stmt(stmt, env, dead)
+        return terminated
+
+    def walk_stmt(self, stmt, env, dead) -> bool:
+        reg = self.an.reg
+        consumed: set[int] = set()
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            tname = (
+                targets[0].id
+                if len(targets) == 1 and isinstance(targets[0], ast.Name)
+                else None
+            )
+            just_peeked = None
+            if isinstance(value, ast.Call):
+                hit = self._match(value)
+                if hit is not None and hit[1] == "acquire":
+                    consumed.add(id(value))
+                    p, _, mode = hit
+                    h = _Handle(p.name, value.lineno)
+                    if mode == "ret":
+                        if tname is not None:
+                            self._bind(env, dead, tname, h)
+                        else:
+                            # stored straight into state
+                            self._store(targets[0], h, env, dead,
+                                        fresh=True)
+                    elif isinstance(mode, int) and len(value.args) >= mode:
+                        key = _name_chain(value.args[mode - 1])
+                        if key:
+                            h.guard = tname
+                            self._bind(env, dead, key, h)
+                elif hit is None and tname is not None:
+                    res = reg.peek_call(value, self.cls)
+                    if res is not None and res not in self.exempt:
+                        self.peeked[tname] = res
+                        just_peeked = tname
+            elif tname is not None and isinstance(value, ast.Name) \
+                    and value.id in env:
+                # alias: both names refer to the same live handle
+                env[tname] = env[value.id]
+                dead.pop(tname, None)
+                self.process_calls(stmt, env, dead, consumed)
+                return False
+            # stores of live handles into attributes / subscripts
+            if value is not None:
+                vkeys = [
+                    _name_chain(v)
+                    for v in ([value] + (
+                        list(value.elts)
+                        if isinstance(value, (ast.Tuple, ast.List)) else []
+                    ))
+                ]
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        for vk in vkeys:
+                            if vk and vk in env:
+                                self._store_into(t, env[vk], env, dead)
+            # plain rebind of a tracked name to something else
+            if tname is not None and not (
+                isinstance(value, ast.Call) and id(value) in consumed
+            ):
+                if tname in env and not (
+                    isinstance(value, ast.Name) and value.id in env
+                    and env[value.id] is env[tname]
+                ):
+                    # rebound away: the alias is gone (under-flag —
+                    # other aliases may still release it)
+                    h = env.pop(tname)
+                    if h.guard == tname:
+                        h.guard = None
+                dead.pop(tname, None)
+                if tname != just_peeked:
+                    self.peeked.pop(tname, None)
+            self.process_calls(stmt, env, dead, consumed)
+            return False
+        if isinstance(stmt, ast.Expr):
+            self.process_calls(stmt, env, dead, consumed)
+            return False
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._handle_return_value(stmt, env, dead, consumed)
+            self.process_calls(stmt, env, dead, consumed)
+            self._exit(env, f"but not released on the return at line "
+                            f"{stmt.lineno}")
+            return True
+        if isinstance(stmt, ast.Raise):
+            self.process_calls(stmt, env, dead, consumed)
+            self._exit(env, f"but not released on the raise at line "
+                            f"{stmt.lineno}", exc=True)
+            return True
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(stmt, ast.If):
+            # A (possibly negated) acquire call used AS the test belongs
+            # to _apply_test — consume it so the generic pass does not
+            # also bind the handle on the failure branch.
+            tcall = stmt.test
+            if isinstance(tcall, ast.UnaryOp) and isinstance(
+                tcall.op, ast.Not
+            ):
+                tcall = tcall.operand
+            if isinstance(tcall, ast.Call):
+                hit = self._match(tcall)
+                if hit is not None and hit[1] == "acquire":
+                    consumed.add(id(tcall))
+            self.process_calls(stmt.test, env, dead, consumed)
+            env_t, dead_t = dict(env), dict(dead)
+            env_f, dead_f = dict(env), dict(dead)
+            self._apply_test(stmt.test, env_t, env_f, dead_t, dead_f)
+            term_t = self.walk_body(stmt.body, env_t, dead_t)
+            term_f = self.walk_body(stmt.orelse, env_f, dead_f)
+            self._merge(env, dead, [
+                (env_t, dead_t, term_t), (env_f, dead_f, term_f)
+            ])
+            return term_t and term_f
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                self.process_calls(stmt.test, env, dead, consumed)
+            else:
+                self.process_calls(stmt.iter, env, dead, consumed)
+            env_b, dead_b = dict(env), dict(dead)
+            term = self.walk_body(stmt.body, env_b, dead_b)
+            if not term:
+                for key, h in env_b.items():
+                    if key not in env and not self.protected(env_b, h):
+                        self.leak(h, "but a loop iteration can end with "
+                                     "it still live")
+            # after the loop: keep the pre-loop view, honoring releases
+            # the body performed (under-flag: the body may run 0 times)
+            for key in list(env):
+                if key not in env_b and key in dead_b:
+                    dead[key] = dead_b[key]
+                    del env[key]
+            self.walk_body(stmt.orelse, env, dead)
+            return False
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if isinstance(item.context_expr, ast.Call):
+                    hit = self._match(item.context_expr)
+                    if hit is not None and hit[1] == "acquire":
+                        # context-manager form: release is structural
+                        consumed.add(id(item.context_expr))
+                self.process_calls(item.context_expr, env, dead, consumed)
+            return self.walk_body(stmt.body, env, dead)
+        if isinstance(stmt, ast.Try):
+            fin = self._release_keys_in(stmt.finalbody)
+            handler_rel: set = set()
+            for handler in stmt.handlers:
+                if handler.type is None or (
+                    isinstance(handler.type, ast.Name)
+                    and handler.type.id in ("Exception", "BaseException")
+                ) or (
+                    isinstance(handler.type, ast.Tuple)
+                ):
+                    handler_rel |= self._release_keys_in(handler.body)
+            self.protectors.append((fin, handler_rel))
+            env_entry, dead_entry = dict(env), dict(dead)
+            term_b = self.walk_body(stmt.body, env, dead)
+            term_b = self.walk_body(stmt.orelse, env, dead) or term_b
+            self.protectors.pop()
+            branches = [(env, dead, term_b)]
+            for handler in stmt.handlers:
+                env_h, dead_h = dict(env_entry), dict(dead_entry)
+                term_h = self.walk_body(handler.body, env_h, dead_h)
+                branches.append((env_h, dead_h, term_h))
+            merged_env: dict = {}
+            merged_dead: dict = {}
+            self._merge(merged_env, merged_dead, branches)
+            env.clear(); env.update(merged_env)
+            dead.clear(); dead.update(merged_dead)
+            term = all(t for _, _, t in branches)
+            if stmt.finalbody:
+                term_f = self.walk_body(stmt.finalbody, env, dead)
+                term = term or term_f
+            return term
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.an.walk_function(self.sf, stmt, self.cls, self.exempt)
+            return False
+        if isinstance(stmt, ast.ClassDef):
+            return False
+        self.process_calls(stmt, env, dead, consumed)
+        return False
+
+    def _apply_test(self, test, env_t, env_f, dead_t, dead_f) -> None:
+        # acquire call used directly as a condition (`if take_probe(x):`
+        # / `if not take_probe(x):`): the handle exists only in the
+        # branch where the call returned truthy.
+        call, negate = test, False
+        if isinstance(call, ast.UnaryOp) and isinstance(call.op, ast.Not):
+            call, negate = call.operand, True
+        if isinstance(call, ast.Call):
+            hit = self._match(call)
+            if hit is not None and hit[1] == "acquire":
+                p, _, mode = hit
+                if isinstance(mode, int) and len(call.args) >= mode:
+                    key = _name_chain(call.args[mode - 1])
+                    if key:
+                        h = _Handle(p.name, call.lineno)
+                        target = env_f if negate else env_t
+                        other_dead = dead_t if negate else dead_f
+                        target[key] = h
+                        other_dead.pop(key, None)
+                return
+        self._narrow(env_t, test, branch_true=True)
+        self._narrow(env_f, test, branch_true=False)
+
+    def _merge(self, env, dead, branches) -> None:
+        """Live-on-any-surviving-path semantics."""
+        live = [(e, d) for e, d, term in branches if not term]
+        env.clear()
+        dead.clear()
+        for e, d in live:
+            for k, v in d.items():
+                dead.setdefault(k, v)
+        for e, d in live:
+            for k, h in e.items():
+                env[k] = h
+                dead.pop(k, None)
+
+    def _store(self, target, h: _Handle, env, dead, fresh=False) -> None:
+        self._store_into(target, h, env, dead)
+
+    def _store_into(self, target, h: _Handle, env, dead) -> None:
+        """Assignment of a live handle into an attribute/subscript:
+        a declared handoff when the attribute is owns-annotated for the
+        handle's resource, an RL003 escape otherwise."""
+        attr = None
+        t = target
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute):
+            attr = t.attr
+        elif isinstance(t, ast.Name):
+            attr = t.id
+        owned = self.an.reg.owns.get(attr or "", ())
+        if h.resource in owned:
+            self._kill(env, dead, h, "handoff", target.lineno)
+            return
+        if "RL003" not in h.reported:
+            h.reported.add("RL003")
+            self._file(
+                "escaping-handle", "RL003", target.lineno,
+                f"{h.resource} handle (acquired at line {h.line}) stored "
+                f"into `{attr}`, which is not annotated "
+                f"`# llmd: owns({h.resource})` — ownership escapes the "
+                "checked scope",
+            )
+        # escaped: stop tracking so the site gets exactly one finding
+        self._kill(env, dead, h, "escaped", target.lineno)
+
+    def _handle_return_value(self, stmt, env, dead, consumed) -> None:
+        value = stmt.value
+        if isinstance(value, (ast.Tuple, ast.List)):
+            vals = list(value.elts)
+        elif isinstance(value, ast.Dict):
+            vals = list(value.values)
+        else:
+            vals = [value]
+        for v in vals:
+            key = _name_chain(v)
+            h = env.get(key) if key else None
+            if h is None and isinstance(v, ast.Call):
+                hit = self._match(v)
+                if hit is not None and hit[1] == "acquire" \
+                        and hit[2] == "ret":
+                    consumed.add(id(v))
+                    h = _Handle(hit[0].name, v.lineno)
+                    env["<ret>"] = h
+                    key = "<ret>"
+            if h is None:
+                continue
+            if h.resource in self.transfers:
+                self._kill(env, dead, h, "handoff", stmt.lineno)
+            elif "RL003" not in h.reported:
+                h.reported.add("RL003")
+                h.reported.add("RL001")  # the return IS the leak site
+                self._file(
+                    "escaping-handle", "RL003", stmt.lineno,
+                    f"{h.resource} handle (acquired at line {h.line}) "
+                    f"returned from {self.fn.name}, which is not marked "
+                    f"`# llmd: transfers({h.resource})` — callers cannot "
+                    "know they now own it",
+                )
+                self._kill(env, dead, h, "escaped", stmt.lineno)
+
+    def _exit(self, env, why: str, exc: bool = False) -> None:
+        seen: set[int] = set()
+        for key, h in list(env.items()):
+            if id(h) in seen or self.protected(env, h, exc=exc):
+                continue
+            seen.add(id(h))
+            self.leak(h, why)
+
+
+# ------------------------------------------------------------------ #
+# analysis cache (three checkers share one pass)
+
+
+class _Analysis:
+    def __init__(self, repo: Repo) -> None:
+        self.findings: list[Finding] = []
+        self.reg, reg_findings = build_registry(repo)
+        self.findings.extend(reg_findings)
+        self._widen_registry(repo)
+        for sf in repo.files:
+            if not sf.is_python or sf.tree is None:
+                continue
+            self._walk_module(sf)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.code))
+
+    def _widen_registry(self, repo: Repo) -> None:
+        """A scoped scan (--changed-only, explicit paths) must still see
+        protocol/owns/transfers declarations living in UNCHANGED files —
+        a changed caller of PageAllocator.allocate is checkable only if
+        the allocator's annotation is in the registry. Declarations are
+        re-discovered from the default scan set under repo.root; the
+        scoped files alone decide WHERE findings are reported."""
+        from llmd_tpu.analysis.core import discover
+
+        known = {sf.path for sf in repo.files}
+        extra = [
+            sf for sf in discover(repo.root)
+            if sf.is_python and sf.path not in known
+        ]
+        if not extra:
+            return
+        wide, _ = build_registry(Repo(repo.root, extra))
+        seen = {(p.path, p.line) for p in self.reg.protocols}
+        for p in wide.protocols:
+            if (p.path, p.line) not in seen:
+                self.reg.add_protocol(p)
+        for attr, names in wide.owns.items():
+            self.reg.owns.setdefault(attr, set()).update(names)
+        for fn, names in wide.transfers.items():
+            self.reg.transfers.setdefault(fn, set()).update(names)
+
+    def _walk_module(self, sf) -> None:
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                own = [
+                    q for q in self.reg.protocols
+                    if q.cls == node.name and q.path == sf.path
+                ]
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        # A protocol method IS its resource's
+                        # implementation — exempt from that ONE
+                        # resource's rules, fully checked for every
+                        # other resource it uses (apply_bundle releases
+                        # `bundles` but must still balance `pages`).
+                        exempt = {
+                            q.name for q in own if item.name in q.methods
+                        }
+                        self.walk_function(sf, item, node.name, exempt)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.walk_function(sf, node, None)
+
+    def walk_function(self, sf, fn, cls_name, exempt=None) -> None:
+        w = _FnWalker(self, sf, fn, cls_name, exempt)
+        env: dict = {}
+        dead: dict = {}
+        terminated = w.walk_body(fn.body, env, dead)
+        if not terminated:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            w._exit(env, f"but still live when {fn.name} falls off the "
+                         f"end (line {end})")
+
+
+def _analysis_for(repo: Repo) -> _Analysis:
+    cached = getattr(repo, "_lifecycle_analysis", None)
+    if cached is None:
+        cached = repo._lifecycle_analysis = _Analysis(repo)
+    return cached
+
+
+class _LifecycleRule(Checker):
+    def run(self, repo: Repo) -> list[Finding]:
+        return [
+            f for f in _analysis_for(repo).findings if f.rule == self.name
+        ]
+
+
+@register
+class ReleaseOnAllPaths(_LifecycleRule):
+    name = "release-on-all-paths"
+    description = (
+        "every declared-resource acquisition reaches a release/transfer "
+        "or annotated handoff on every exit path, incl. exception edges "
+        "(RL001)"
+    )
+
+
+@register
+class ReleasePairing(_LifecycleRule):
+    name = "release-pairing"
+    description = (
+        "no double-release and no release of a merely-peeked handle "
+        "for declared resource protocols (RL002)"
+    )
+
+
+@register
+class EscapingHandle(_LifecycleRule):
+    name = "escaping-handle"
+    description = (
+        "handles stored into non-`owns` state or returned without a "
+        "`transfers` marker leak ownership out of the checked scope "
+        "(RL003)"
+    )

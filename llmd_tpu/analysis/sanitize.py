@@ -37,11 +37,13 @@ they are real locks in the same graph.
 from __future__ import annotations
 
 import _thread
+import functools
 import itertools
 import json
 import os
 import threading
 import traceback
+import weakref
 
 __all__ = [
     "LockOrderError",
@@ -53,6 +55,18 @@ __all__ = [
     "violations",
     "report",
     "write_report",
+    # leak sanitizer (LLMD_LEAKSAN)
+    "LeakError",
+    "leaksan_register",
+    "arm_leaksan",
+    "disarm_leaksan",
+    "leaksan_armed",
+    "leaksan_set_test",
+    "leaksan_outstanding",
+    "leaksan_check_test",
+    "leaksan_drain_violations",
+    "leaksan_report",
+    "write_leaksan_report",
 ]
 
 _STACK_DEPTH = 12
@@ -476,4 +490,452 @@ def write_report(path: str | None = None) -> str:
     )
     with open(path, "w", encoding="utf-8") as f:
         json.dump(report(), f, indent=2, default=str)
+    return path
+
+
+# ================================================================== #
+# Runtime LEAK sanitizer (LLMD_LEAKSAN): the dynamic leg of the
+# resource-lifecycle rules (RL001-RL003). The static checker proves
+# acquire/release pairing lexically; this module mirrors what actually
+# happened — every handle a registered resource manager hands out
+# (KV pages, adapter slots, admission leases, half-open probe grants,
+# flow-control admission tokens, staged KV bundles) is tracked in a
+# per-instance outstanding map with a bounded acquisition backtrace and
+# the pytest nodeid on whose watch it was acquired (background threads
+# included). The conftest gate asserts zero newly-outstanding handles
+# at every test teardown and the session renders a cumulative JSON
+# report (LLMD_LEAKSAN_REPORT, default leaksan_report.json).
+#
+# Managers self-describe at import time via :func:`leaksan_register`
+# (the runtime twin of their `# llmd: resource(...)` annotation); the
+# registration is a no-op until :func:`arm_leaksan` wraps the named
+# methods. Modes:
+#   counted — refcount-style handles (pages, slots, leases): each
+#             acquire +1, each release -1; below zero is a
+#             double-release violation.
+#   set     — idempotent grants (probe grants, staged bundles): acquire
+#             marks outstanding, re-acquire refreshes, release of an
+#             unknown handle is quiet (success on a closed circuit /
+#             idempotent release_bundle are normal).
+#   anon    — handleless tokens (flow-control admission): acquire
+#             pushes a synthetic token, release pops LIFO; popping an
+#             empty stack is a violation.
+# `transfer` methods move a handle from outstanding to a transferred
+# set (slot published into residency): quiet, and a later release of a
+# transferred handle (unload refunding a resident slot) is quiet too.
+
+
+class LeakError(AssertionError):
+    """A registered resource manager leaked handles on a test's watch."""
+
+
+class _Spec:
+    __slots__ = (
+        "cls", "resource", "mode", "acquire", "release", "transfer",
+        "live", "wrapped",
+    )
+
+    def __init__(self, cls, resource, mode, acquire, release, transfer,
+                 live) -> None:
+        self.cls = cls
+        self.resource = resource
+        self.mode = mode
+        self.acquire = acquire or {}
+        self.release = release or {}
+        self.transfer = transfer or {}
+        self.live = live
+        self.wrapped: dict[str, object] = {}
+
+
+_LEAKSAN_SPECS: list[_Spec] = []
+_leak_state = None
+_instance_tok = itertools.count(1)
+
+
+class _LeakState:
+    """Global leak-sanitizer state (raw _thread lock: the sanitizer
+    must never instrument or contend with itself)."""
+
+    def __init__(self) -> None:
+        self.mu = _thread.allocate_lock()
+        self.current_test = "<no-test>"
+        # instance token -> weakref (purge callback drops the records:
+        # handles die with their manager).
+        self.instances: dict[int, weakref.ref] = {}
+        self.instance_meta: dict[int, str] = {}  # token -> "Cls@site"
+        # (token, resource, handle) -> record dict
+        self.outstanding: dict[tuple, dict] = {}
+        # (token, resource) -> set of transferred handles
+        self.transferred: dict[tuple, set] = {}
+        # (token, resource) -> list of anon-token records (LIFO)
+        self.anon: dict[tuple, list] = {}
+        self.violations: list[dict] = []
+        self.all_violations: list[dict] = []
+        self.counters: dict[str, dict] = {}
+        # Running per-resource outstanding totals (records + anon
+        # stacks): peak tracking must be O(1) per acquisition, not a
+        # scan of every outstanding record under the global lock.
+        self.live: dict[str, int] = {}
+        self.leaks_by_test: dict[str, int] = {}
+
+    def _purge(self, tok: int) -> None:
+        with self.mu:
+            self.instances.pop(tok, None)
+            self.instance_meta.pop(tok, None)
+            for key in [k for k in self.outstanding if k[0] == tok]:
+                res = key[1]
+                self.live[res] = (
+                    self.live.get(res, 0) - self.outstanding[key]["count"]
+                )
+                del self.outstanding[key]
+            for key in [k for k in self.transferred if k[0] == tok]:
+                del self.transferred[key]
+            for key in [k for k in self.anon if k[0] == tok]:
+                res = key[1]
+                self.live[res] = self.live.get(res, 0) - len(self.anon[key])
+                del self.anon[key]
+
+    def token_of(self, obj) -> int:
+        tok = getattr(obj, "_leaksan_tok", None)
+        if tok is None:
+            tok = next(_instance_tok)
+            try:
+                object.__setattr__(obj, "_leaksan_tok", tok)
+            except (AttributeError, TypeError):
+                return -1  # slots-only object: untracked
+            with self.mu:
+                try:
+                    self.instances[tok] = weakref.ref(
+                        obj, lambda _r, t=tok: self._purge(t)
+                    )
+                except TypeError:
+                    pass  # not weakref-able: records live for the session
+                self.instance_meta[tok] = (
+                    f"{type(obj).__name__}@{_site()}"
+                )
+        return tok
+
+    def counter(self, resource: str) -> dict:
+        c = self.counters.get(resource)
+        if c is None:
+            c = self.counters[resource] = {
+                "acquired": 0, "released": 0, "transferred": 0,
+                "peak_outstanding": 0,
+            }
+        return c
+
+    # -- events -------------------------------------------------------- #
+
+    def on_acquire(self, spec: _Spec, obj, handles) -> None:
+        tok = self.token_of(obj)
+        with self.mu:
+            c = self.counter(spec.resource)
+            res = spec.resource
+            for h in handles:
+                c["acquired"] += 1
+                if spec.mode == "anon":
+                    self.anon.setdefault((tok, res), []).append({
+                        "stack": _stack(),
+                        "test": self.current_test,
+                        "thread": threading.current_thread().name,
+                    })
+                    self.live[res] = self.live.get(res, 0) + 1
+                    continue
+                self.transferred.get((tok, res), set()).discard(h)
+                key = (tok, res, h)
+                rec = self.outstanding.get(key)
+                if rec is None or spec.mode == "set":
+                    if rec is None:
+                        self.live[res] = self.live.get(res, 0) + 1
+                    # set-mode re-acquire replaces (refreshes) the
+                    # record: net outstanding unchanged.
+                    self.outstanding[key] = {
+                        "count": 1,
+                        "stack": _stack(),
+                        "test": self.current_test,
+                        "thread": threading.current_thread().name,
+                    }
+                else:
+                    rec["count"] += 1
+                    rec["stack"] = _stack()
+                    self.live[res] = self.live.get(res, 0) + 1
+            c["peak_outstanding"] = max(
+                c["peak_outstanding"], self.live.get(res, 0)
+            )
+
+    def on_release(self, spec: _Spec, obj, handles, kind: str) -> None:
+        tok = self.token_of(obj)
+        with self.mu:
+            c = self.counter(spec.resource)
+            for h in handles:
+                if spec.mode == "anon":
+                    stackq = self.anon.get((tok, spec.resource))
+                    if stackq:
+                        stackq.pop()
+                        c["released"] += 1
+                        self.live[spec.resource] = (
+                            self.live.get(spec.resource, 0) - 1
+                        )
+                    else:
+                        self._violate({
+                            "kind": "release-without-acquire",
+                            "resource": spec.resource,
+                            "manager": self.instance_meta.get(tok, "?"),
+                            "handle": None,
+                            "test": self.current_test,
+                            "thread": threading.current_thread().name,
+                            "stack": _stack(),
+                        })
+                    continue
+                key = (tok, spec.resource, h)
+                rec = self.outstanding.get(key)
+                if rec is not None:
+                    if kind == "transfer":
+                        c["transferred"] += 1
+                        self.live[spec.resource] = (
+                            self.live.get(spec.resource, 0) - rec["count"]
+                        )
+                        del self.outstanding[key]
+                        self.transferred.setdefault(
+                            (tok, spec.resource), set()
+                        ).add(h)
+                        continue
+                    c["released"] += 1
+                    rec["count"] -= 1
+                    self.live[spec.resource] = (
+                        self.live.get(spec.resource, 0) - 1
+                    )
+                    if rec["count"] <= 0:
+                        del self.outstanding[key]
+                    continue
+                if h in self.transferred.get((tok, spec.resource), ()):
+                    # releasing a previously-published handle (unload of
+                    # a resident slot): a legitimate lifecycle arc.
+                    if kind == "release":
+                        self.transferred[(tok, spec.resource)].discard(h)
+                        c["released"] += 1
+                    continue
+                if spec.mode == "set" or kind == "transfer":
+                    continue  # idempotent grants: quiet
+                self._violate({
+                    "kind": "double-release",
+                    "resource": spec.resource,
+                    "manager": self.instance_meta.get(tok, "?"),
+                    "handle": repr(h),
+                    "test": self.current_test,
+                    "thread": threading.current_thread().name,
+                    "stack": _stack(),
+                })
+
+    def _violate(self, v: dict) -> None:
+        self.violations.append(v)
+        self.all_violations.append(v)
+
+
+def _leak_wrap(spec: _Spec, method: str, kind: str, extract):
+    orig = getattr(spec.cls, method)
+
+    @functools.wraps(orig)
+    def wrapper(self, *a, **k):
+        result = orig(self, *a, **k)
+        st = _leak_state
+        if st is not None:
+            try:
+                handles = list(extract(self, a, k, result) or ())
+            except Exception:
+                handles = []
+            if handles:
+                if kind == "acquire":
+                    st.on_acquire(spec, self, handles)
+                else:
+                    st.on_release(spec, self, handles, kind)
+        return result
+
+    wrapper._leaksan_orig = orig
+    return wrapper
+
+
+def leaksan_register(
+    cls,
+    resource: str,
+    *,
+    mode: str = "counted",
+    acquire=None,
+    release=None,
+    transfer=None,
+    live=None,
+) -> None:
+    """Declare a resource manager class for the leak sanitizer (the
+    runtime twin of its ``# llmd: resource(...)`` annotation).
+
+    ``acquire``/``release``/``transfer`` map method names to extractors
+    ``fn(self, args, kwargs, result) -> iterable-of-handles`` (return
+    an empty iterable for "this call minted/ended nothing"). ``live``
+    is an optional ``fn(self, handle) -> bool`` teardown filter for
+    protocols with designed expiry (probe grants)."""
+    spec = _Spec(cls, resource, mode, acquire, release, transfer, live)
+    _LEAKSAN_SPECS.append(spec)
+    if _leak_state is not None:
+        _instrument(spec)
+
+
+def _instrument(spec: _Spec) -> None:
+    if spec.wrapped:
+        return
+    for kind, table in (
+        ("acquire", spec.acquire),
+        ("release", spec.release),
+        ("transfer", spec.transfer),
+    ):
+        for method, extract in table.items():
+            spec.wrapped[method] = getattr(spec.cls, method)
+            setattr(spec.cls, method, _leak_wrap(spec, method, kind, extract))
+
+
+def leaksan_armed() -> bool:
+    return _leak_state is not None
+
+
+def arm_leaksan() -> None:
+    """Wrap every registered manager's protocol methods. Idempotent;
+    managers registered after arming are instrumented on registration."""
+    global _leak_state
+    if _leak_state is not None:
+        return
+    _leak_state = _LeakState()
+    for spec in _LEAKSAN_SPECS:
+        _instrument(spec)
+
+
+def disarm_leaksan() -> None:
+    global _leak_state
+    if _leak_state is None:
+        return
+    for spec in _LEAKSAN_SPECS:
+        for method, orig in spec.wrapped.items():
+            setattr(spec.cls, method, orig)
+        spec.wrapped.clear()
+    _leak_state = None
+
+
+def leaksan_set_test(nodeid: str) -> None:
+    st = _leak_state
+    if st is not None:
+        with st.mu:
+            st.current_test = nodeid
+
+
+def _live_records(st: _LeakState):
+    """(key, record, spec-live-filtered) snapshot under the lock."""
+    live_by_cls = {
+        (id(s.cls), s.resource): s.live for s in _LEAKSAN_SPECS if s.live
+    }
+    out = []
+    for key, rec in list(st.outstanding.items()):
+        tok, resource, handle = key
+        ref = st.instances.get(tok)
+        obj = ref() if ref is not None else None
+        if obj is not None:
+            live = live_by_cls.get((id(type(obj)), resource))
+            if live is not None:
+                try:
+                    if not live(obj, handle):
+                        st.live[resource] = (
+                            st.live.get(resource, 0) - rec["count"]
+                        )
+                        del st.outstanding[key]
+                        continue
+                except Exception:
+                    pass
+        out.append((key, rec))
+    for key, stackq in st.anon.items():
+        tok, resource = key
+        for rec in stackq:
+            out.append(((tok, resource, None), rec))
+    return out
+
+
+def leaksan_outstanding() -> list[dict]:
+    """Snapshot of currently-outstanding handles (live managers only,
+    designed-expiry grants filtered)."""
+    st = _leak_state
+    if st is None:
+        return []
+    import gc
+
+    gc.collect()  # dead managers must not count as leaks
+    with st.mu:
+        return [
+            {
+                "resource": key[1],
+                "manager": st.instance_meta.get(key[0], "?"),
+                "handle": repr(key[2]),
+                "count": rec.get("count", 1),
+                "test": rec["test"],
+                "thread": rec["thread"],
+                "stack": rec["stack"],
+            }
+            for key, rec in _live_records(st)
+        ]
+
+
+def leaksan_check_test(nodeid: str, record: bool = False) -> list[dict]:
+    """Handles acquired on ``nodeid``'s watch and still outstanding —
+    the per-test teardown gate (background threads included).
+
+    ``record=True`` (the conftest gate) additionally charges the leaks
+    to the session report's per-test blame ledger; mid-test probes
+    (regression pins asserting a handle IS outstanding right now) leave
+    the ledger alone so the uploaded artifact only blames real
+    teardown-time leaks."""
+    leaks = [r for r in leaksan_outstanding() if r["test"] == nodeid]
+    st = _leak_state
+    if record and st is not None and leaks:
+        with st.mu:
+            st.leaks_by_test[nodeid] = (
+                st.leaks_by_test.get(nodeid, 0) + len(leaks)
+            )
+    return leaks
+
+
+def leaksan_drain_violations() -> list[dict]:
+    st = _leak_state
+    if st is None:
+        return []
+    with st.mu:
+        out, st.violations = st.violations, []
+        return out
+
+
+def leaksan_report() -> dict:
+    """Session-cumulative report: per-resource counters, violations,
+    per-test leak blame, and whatever is still outstanding now."""
+    st = _leak_state
+    if st is None:
+        return {"armed": False}
+    outstanding = leaksan_outstanding()
+    with st.mu:
+        return {
+            "armed": True,
+            "resources": {
+                res: dict(c, outstanding=sum(
+                    r["count"] for r in outstanding if r["resource"] == res
+                ))
+                for res, c in sorted(st.counters.items())
+            },
+            "outstanding": outstanding,
+            "outstanding_total": sum(r["count"] for r in outstanding),
+            # Session-cumulative: the per-test drain (conftest blame
+            # accounting) must not empty the uploaded artifact.
+            "violations": list(st.all_violations),
+            "leaks_by_test": dict(st.leaks_by_test),
+        }
+
+
+def write_leaksan_report(path: str | None = None) -> str:
+    path = path or os.environ.get(
+        "LLMD_LEAKSAN_REPORT", "leaksan_report.json"
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(leaksan_report(), f, indent=2, default=str)
     return path

@@ -73,6 +73,7 @@ class SwaSectionCache:
         self.page_budget = page_budget
         self.retained_pages = 0
         # key -> (s0, n_pre, [section page ids])
+        # llmd: owns(pages)
         self._entries: "collections.OrderedDict[bytes, tuple]" = (
             collections.OrderedDict()
         )
@@ -107,7 +108,14 @@ class SwaSectionCache:
             return
         self.retained_pages += cnt
         src = [ring_ids[l % R] for l in range(s0, n_pre)]
-        self._runner.copy_pages_on_device(src, dst, swa=True)
+        try:
+            self._runner.copy_pages_on_device(src, dst, swa=True)
+        except BaseException:
+            # A failed device copy must refund the retained pages, or
+            # the ring pool permanently shrinks by `cnt` on every retry.
+            self.retained_pages -= cnt
+            self._alloc.free(dst)
+            raise
         self._entries[key] = (s0, n_pre, dst)
         self.captures += 1
 

@@ -93,6 +93,13 @@ def _locked(fn):
     return inner
 
 
+# The resource-lifecycle contract (static-analysis.md): every page
+# reference minted by an acquire method below must be freed, committed
+# into annotated owner state (`# llmd: owns(pages)`), or cross a
+# declared `# llmd: transfers(pages)` boundary. The runtime twin
+# (LLMD_LEAKSAN=1) mirrors the refcounts per page with acquisition
+# backtraces and asserts zero outstanding at test teardown.
+# llmd: resource(pages, recv=alloc, acquire=allocate|allocate_with_floor|touch:arg|lookup_and_touch_prefix|lookup_and_touch_hashes, release=free, transfer=commit_page)
 class PageAllocator:
     """Refcounted page allocator with a content-addressed reuse index."""
 
@@ -312,3 +319,19 @@ class NoFreePagesError(RuntimeError):
         super().__init__(f"wanted {wanted} KV pages, {available} free")
         self.wanted = wanted
         self.available = available
+
+
+# Runtime twin of the `# llmd: resource(pages, ...)` annotation above:
+# with LLMD_LEAKSAN=1 every page reference is mirrored per allocator
+# with an acquisition backtrace, and the conftest gate asserts zero
+# outstanding refs at test teardown (static-analysis.md).
+from llmd_tpu.analysis import sanitize as _sanitize
+
+_sanitize.leaksan_register(
+    PageAllocator, "pages",
+    acquire={
+        "allocate": lambda self, a, k, r: r,
+        "touch": lambda self, a, k, r: list(a[0]) if a else [],
+    },
+    release={"free": lambda self, a, k, r: list(a[0]) if a else []},
+)

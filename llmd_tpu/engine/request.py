@@ -91,12 +91,14 @@ class Request:
     status: RequestStatus = RequestStatus.WAITING
     output_token_ids: list[int] = dataclasses.field(default_factory=list)
     num_computed_tokens: int = 0
-    # Physical page ids allocated to this sequence, in order.
-    block_ids: list[int] = dataclasses.field(default_factory=list)
+    # Physical page ids allocated to this sequence, in order. The
+    # request is an ownership root for its pages: the scheduler's
+    # _release/_truncate paths free from here (static-analysis.md).
+    block_ids: list[int] = dataclasses.field(default_factory=list)  # llmd: owns(pages)
     # Ring pages for sliding-window layers (CacheConfig.swa_ring): a fixed
     # list of R pages from the ring pool, reused circularly — logical page
     # l of this sequence lives at swa_block_ids[l % R] on sliding layers.
-    swa_block_ids: list[int] = dataclasses.field(default_factory=list)
+    swa_block_ids: list[int] = dataclasses.field(default_factory=list)  # llmd: owns(pages)
     # Memoized [max_pages] ring-view table row (immutable once the ring is
     # allocated; invalidated whenever swa_block_ids is freed).
     swa_table_row: Any = None

@@ -54,6 +54,12 @@ def _env_cooldown_s() -> float:
     return float(os.environ.get("LLMD_EPP_BREAKER_COOLDOWN_S", "10.0"))
 
 
+# Probe-grant lifecycle (static-analysis.md): a half-open grant claimed
+# by take_probe must resolve through record_success / record_failure /
+# forget — an unresolved grant locks the endpoint out for a full extra
+# cooldown (the PR 8 bug class). Expiry after cooldown_s is the
+# designed backstop, not a release path callers may lean on.
+# llmd: resource(probes, recv=breaker, acquire=take_probe:arg, release=record_success|record_failure|forget)
 class EndpointCircuitBreaker:
     def __init__(
         self,
@@ -149,3 +155,38 @@ class EndpointCircuitBreaker:
         self._consecutive.pop(address, None)
         self._open_until.pop(address, None)
         self._probe_granted.pop(address, None)
+
+
+# Runtime twin of the `# llmd: resource(probes, ...)` annotation
+# (static-analysis.md): LLMD_LEAKSAN=1 tracks each claimed half-open
+# grant until it resolves; `live` honors the designed cooldown expiry
+# so an expired grant is a released one, not a leak.
+from llmd_tpu.analysis import sanitize as _sanitize
+
+_sanitize.leaksan_register(
+    EndpointCircuitBreaker, "probes", mode="set",
+    acquire={
+        "take_probe": lambda self, a, k, r: (
+            [a[0]] if (r and a[0] in self._probe_granted) else []
+        ),
+    },
+    # A resolution method only releases the grant it actually cleared:
+    # checking post-state (not the method's name) means a breaker that
+    # CLAIMS to resolve but leaves the grant behind is caught as a leak
+    # — the exact PR 8 regression the mutation pin re-introduces.
+    release={
+        "record_success": lambda self, a, k, r: (
+            [a[0]] if a[0] not in self._probe_granted else []
+        ),
+        "record_failure": lambda self, a, k, r: (
+            [a[0]] if a[0] not in self._probe_granted else []
+        ),
+        "forget": lambda self, a, k, r: (
+            [a[0]] if a[0] not in self._probe_granted else []
+        ),
+    },
+    live=lambda self, h: (
+        h in self._probe_granted
+        and (self._clock() - self._probe_granted[h]) < self.cooldown_s
+    ),
+)

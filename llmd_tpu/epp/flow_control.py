@@ -209,6 +209,14 @@ class FlowControl:
         self.outcomes[outcome] += 1
         return outcome
 
+    def _grant(self, item: _Item) -> None:
+        """Hand a parked caller its admission token: the request owns
+        one unit of inflight concurrency from here until release().
+        The explicit method is the leak-sanitizer seam — LLMD_LEAKSAN=1
+        counts grants against releases per FlowControl instance."""
+        self.saturation.inflight += 1
+        item.future.set_result(Outcome.DISPATCHED)
+
     def release(self) -> None:
         """A dispatched request completed (frees inflight concurrency)."""
         if not self.enabled:
@@ -283,8 +291,7 @@ class FlowControl:
                 continue
             if item.future is None or item.future.done():
                 continue  # caller went away
-            self.saturation.inflight += 1
-            item.future.set_result(Outcome.DISPATCHED)
+            self._grant(item)
 
     def start(self) -> None:
         """Start the dispatch worker (idempotent: the fused HTTP app and
@@ -307,3 +314,19 @@ class FlowControl:
                         item.future.set_result(Outcome.EVICTED_SHUTDOWN)
         if self._task:
             self._task.cancel()
+
+
+# Leak-sanitizer registration (static-analysis.md): admission tokens
+# are anonymous — the dispatcher's _grant pushes one, the caller's
+# release() pops one — so LLMD_LEAKSAN counts them LIFO per instance;
+# a release with no grant outstanding is a violation, and grants still
+# outstanding at test teardown carry the granting backtrace.
+from llmd_tpu.analysis import sanitize as _sanitize
+
+_sanitize.leaksan_register(
+    FlowControl, "tokens", mode="anon",
+    acquire={"_grant": lambda self, a, k, r: [None]},
+    release={
+        "release": lambda self, a, k, r: [None] if self.enabled else [],
+    },
+)

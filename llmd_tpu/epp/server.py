@@ -633,6 +633,7 @@ class Router:
                 )
             pod = result.primary
             tried.add(pod.address)
+            # llmd: allow(release-on-all-paths) -- the claimed grant resolves inside _proxy: record_success on the response path, record_failure on 5xx/refusal (the except arm here covers the transport-error edge)
             if not self.breaker.take_probe(pod.address):
                 # Half-open endpoint whose single probe is already in
                 # flight: losing the grant race is not an upstream

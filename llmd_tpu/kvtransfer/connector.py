@@ -145,7 +145,9 @@ class PulledBundle:
     # thread and already lockstep-scattered chunk-by-chunk as pulls
     # landed (overlapping wire and broadcast legs); apply only commits
     # hashes. Covers pages [start_page, start_page + len(stream_ids)).
-    stream_ids: list | None = None
+    # The bundle is the ownership root until apply_bundle/release_bundle
+    # frees the refs (the leak sanitizer tracks fetched bundles too).
+    stream_ids: list | None = None  # llmd: owns(pages)
     # Prompt-page index of the first page in the first PULLED chunk
     # (byte diet: producer-skipped pages + consumer-skipped chunks).
     start_page: int = 0
@@ -365,6 +367,13 @@ def _lookup_local(host: str, port: int) -> "TPUConnector | None":
     return None
 
 
+# Bundle lifecycle (static-analysis.md): a fetched bundle stages pages
+# (host chunks, device scratch, or stream-reserved pool pages) until
+# exactly one of apply_bundle / apply_preload / release_bundle disposes
+# of it — dropping a bundle on the floor strands the producer's lease
+# and any stream-reserved pages. The leak sanitizer tracks outstanding
+# bundles per connector with fetch backtraces.
+# llmd: resource(bundles, recv=connector, acquire=fetch_remote|fetch_remote_policy, release=apply_bundle:arg2|apply_preload:arg2|release_bundle)
 class TPUConnector:
     """Engine-side connector; one per engine process."""
 
@@ -950,26 +959,31 @@ class TPUConnector:
         hard_deadline = time.monotonic() + per_chunk_s + 2.0 * (n_chunks + 1)
         np_chunks, dev_chunks, nbytes = [], [], 0
         swa_np = None
-        if ring_mode and n_swa:
-            # The sliding-layer section first: it registers first and is
-            # tiny, so a missing/expired export fails fast.
-            blob = _faulty_pull(
-                host, port, swa_key(key),
-                min(time.monotonic() + per_chunk_s, hard_deadline),
-            )
-            swa_np = unpack_pages(blob)
-            if swa_np.shape[1] != n_swa:
-                raise ValueError(
-                    f"sliding section holds {swa_np.shape[1]} pages, "
-                    f"expected {n_swa}"
-                )
-            if swa_np.dtype != want_dtype and not pool_quant:
-                raise ValueError(
-                    f"sliding-section KV dtype mismatch: {swa_np.dtype} "
-                    f"vs consumer {want_dtype}"
-                )
-            nbytes += len(blob)
+        # ONE protected region from here: every raise between the
+        # stream-page reservation above and the bundle handoff below
+        # must refund the reserved pages (the lifecycle checker pins
+        # this — a leaked reservation permanently shrinks the decode
+        # pool by up to a quarter).
         try:
+            if ring_mode and n_swa:
+                # The sliding-layer section first: it registers first
+                # and is tiny, so a missing/expired export fails fast.
+                blob = _faulty_pull(
+                    host, port, swa_key(key),
+                    min(time.monotonic() + per_chunk_s, hard_deadline),
+                )
+                swa_np = unpack_pages(blob)
+                if swa_np.shape[1] != n_swa:
+                    raise ValueError(
+                        f"sliding section holds {swa_np.shape[1]} pages, "
+                        f"expected {n_swa}"
+                    )
+                if swa_np.dtype != want_dtype and not pool_quant:
+                    raise ValueError(
+                        f"sliding-section KV dtype mismatch: "
+                        f"{swa_np.dtype} vs consumer {want_dtype}"
+                    )
+                nbytes += len(blob)
             for j in range(j0, n_chunks):
                 blob = _faulty_pull(
                     host, port, chunk_key(key, j),
@@ -1180,43 +1194,53 @@ class TPUConnector:
                 log.warning("no free pages for KV import, recomputing: %s", e)
                 self._notify_free_async(bundle)
                 return 0
-            if bundle.device_chunks:
-                # Pipelined path: chunks are already on device (uploaded by
-                # the fetch thread) — only fast device->pool scatters here.
-                cp = bundle.chunk_pages
-                for j, dev in enumerate(bundle.device_chunks):
-                    p0 = bundle.start_page + j * cp
-                    if p0 + cp <= skip:
-                        continue  # wholly cached since the fetch decision
-                    if p0 >= skip:
-                        ids_j = _pad_chunk_ids(
-                            page_ids[p0 - skip : p0 - skip + cp], cp
-                        )
-                        self.runner.scatter_pages_from_device(ids_j, dev)
-                    else:
-                        # Partial overlap (cache grew between fetch and
-                        # apply): host-path scatter of the uncached tail.
-                        want = PulledBundle._dequant_chunk(
-                            bundle.np_chunks[j]
-                        )[:, skip - p0 :]
-                        take = min(p0 + cp, n_full) - skip
-                        self.runner.scatter_pages(
-                            page_ids[:take], want[:, :take]
-                        )
-            elif skip < n_full and (
-                bundle.pages is not None or bundle.np_chunks
-            ):
-                want = bundle.host_pages(n_full)[:, skip - bundle.start_page :]
-                self.runner.scatter_pages(page_ids, want)
-            parent = None if skip == 0 else hashes[skip - 1]
-            for i, pid in enumerate(page_ids):
-                idx = skip + i
-                chunk = prompt_token_ids[idx * page : (idx + 1) * page]
-                self.allocator.commit_page(pid, hashes[idx], chunk, parent)
-                parent = hashes[idx]
-            # Drop our references: pages stay cached (ref 0) for the
-            # prefix-cache hit when this request is scheduled.
-            self.allocator.free(page_ids)
+            try:
+                if bundle.device_chunks:
+                    # Pipelined path: chunks are already on device
+                    # (uploaded by the fetch thread) — only fast
+                    # device->pool scatters here.
+                    cp = bundle.chunk_pages
+                    for j, dev in enumerate(bundle.device_chunks):
+                        p0 = bundle.start_page + j * cp
+                        if p0 + cp <= skip:
+                            continue  # wholly cached since the fetch
+                        if p0 >= skip:
+                            ids_j = _pad_chunk_ids(
+                                page_ids[p0 - skip : p0 - skip + cp], cp
+                            )
+                            self.runner.scatter_pages_from_device(ids_j, dev)
+                        else:
+                            # Partial overlap (cache grew between fetch
+                            # and apply): host-path scatter of the
+                            # uncached tail.
+                            want = PulledBundle._dequant_chunk(
+                                bundle.np_chunks[j]
+                            )[:, skip - p0 :]
+                            take = min(p0 + cp, n_full) - skip
+                            self.runner.scatter_pages(
+                                page_ids[:take], want[:, :take]
+                            )
+                elif skip < n_full and (
+                    bundle.pages is not None or bundle.np_chunks
+                ):
+                    want = bundle.host_pages(n_full)[
+                        :, skip - bundle.start_page :
+                    ]
+                    self.runner.scatter_pages(page_ids, want)
+                parent = None if skip == 0 else hashes[skip - 1]
+                for i, pid in enumerate(page_ids):
+                    idx = skip + i
+                    chunk = prompt_token_ids[idx * page : (idx + 1) * page]
+                    self.allocator.commit_page(
+                        pid, hashes[idx], chunk, parent
+                    )
+                    parent = hashes[idx]
+            finally:
+                # Drop our references: pages stay cached (ref 0) for the
+                # prefix-cache hit when this request is scheduled — and
+                # a mid-scatter failure must refund them rather than
+                # bleed the pool one failed import at a time.
+                self.allocator.free(page_ids)
             adopted = len(page_ids)
         self.imported_requests += 1
         self.imported_bytes += bundle.nbytes
@@ -1224,6 +1248,7 @@ class TPUConnector:
         self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
         return adopted
 
+    # llmd: transfers(pages)
     def apply_preload(
         self,
         prompt_token_ids: list[int],
@@ -1299,7 +1324,9 @@ class TPUConnector:
             # producer may have exported one more page than we keep, plus
             # its pad columns) land in real scratch slots instead of
             # clobbering a kept page, and the spares free right after.
+            # llmd: allow(release-on-all-paths) -- every raise through the scatters refunds via the except arm; past it the tail is counter bumps + the free-notify daemon-thread spawn, and ownership then passes to the caller in the returned preload dict (this def is a transfers(pages) boundary)
             page_ids = self.allocator.allocate(n_full)
+            # llmd: allow(release-on-all-paths) -- same contract as page_ids one line up: except-arm refund, then ownership rides the returned preload dict
             ring_ids = swa_allocator.allocate(ring_pages)
             # Full-group content into the main pool.
             if bundle.device_chunks:
@@ -1424,3 +1451,25 @@ class TPUConnector:
             self.server = None
         with self._local_lock:
             self._local_exports.clear()
+
+
+# Runtime twin of the `# llmd: resource(bundles, ...)` annotation
+# (static-analysis.md): LLMD_LEAKSAN=1 tracks each fetched bundle from
+# fetch_remote until exactly one of apply_bundle / apply_preload /
+# release_bundle disposes of it (idempotent re-release is quiet by
+# design — release_bundle nulls stream_ids).
+from llmd_tpu.analysis import sanitize as _sanitize
+
+_sanitize.leaksan_register(
+    TPUConnector, "bundles", mode="set",
+    acquire={
+        "fetch_remote": lambda self, a, k, r: (
+            [id(r)] if r is not None else []
+        ),
+    },
+    release={
+        "apply_bundle": lambda self, a, k, r: [id(a[1])],
+        "apply_preload": lambda self, a, k, r: [id(a[1])],
+        "release_bundle": lambda self, a, k, r: [id(a[0])],
+    },
+)

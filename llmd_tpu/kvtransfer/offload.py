@@ -398,13 +398,18 @@ class OffloadConnector:
             page_ids = self.allocator.allocate(len(restore))
         except NoFreePagesError:
             return 0  # under pressure: recompute instead of thrashing
-        stacked = np.stack([d for _, _, d in restore], axis=1)
-        self.runner.scatter_pages(page_ids, stacked)
-        for pid, (idx, h, _) in zip(page_ids, restore):
-            chunk = prompt_token_ids[idx * page : (idx + 1) * page]
-            parent = hashes[idx - 1] if idx > 0 else None
-            self.allocator.commit_page(pid, h, chunk, parent)
-        self.allocator.free(page_ids)
+        try:
+            stacked = np.stack([d for _, _, d in restore], axis=1)
+            self.runner.scatter_pages(page_ids, stacked)
+            for pid, (idx, h, _) in zip(page_ids, restore):
+                chunk = prompt_token_ids[idx * page : (idx + 1) * page]
+                parent = hashes[idx - 1] if idx > 0 else None
+                self.allocator.commit_page(pid, h, chunk, parent)
+        finally:
+            # Drop our references even when the scatter/commit raises:
+            # a failed restore must degrade to recompute, not bleed the
+            # decode pool one restore attempt at a time.
+            self.allocator.free(page_ids)
         if store_pages:
             # Counted only after the commit actually landed: these
             # tokens' prefill now rides the prefix cache instead of a

@@ -40,6 +40,14 @@ from typing import Callable
 from llmd_tpu.lora.registry import AdapterRegistry
 
 
+# Slot lifecycle (static-analysis.md): a slot leaves `_free` only
+# through `_take_slot_locked` and must come back through
+# `_refund_slot_locked` or publish into residency through
+# `_publish_slot_locked` on EVERY path — the PR 13 duplicate-install
+# race leaked a slot out of both `_free` and `_slot_of` exactly here.
+# Admission leases bracket the resolve->admitted window per name.
+# llmd: resource(slots, recv=pool, acquire=_take_slot_locked, release=_refund_slot_locked, transfer=_publish_slot_locked:arg2)
+# llmd: resource(leases, recv=pool, acquire=acquire:arg, release=release_acquire)
 class AdapterPool:
     def __init__(
         self,
@@ -135,6 +143,18 @@ class AdapterPool:
             return slot
         return None
 
+    def _refund_slot_locked(self, slot: int) -> None:
+        """Return an in-flight slot to the free list (install failed or
+        lost the duplicate-install publish race). Caller holds _lock."""
+        self._free.append(slot)
+
+    def _publish_slot_locked(self, name: str, slot: int) -> None:
+        """Publish an installed slot into residency. Caller holds
+        _lock; the slot's in-flight ownership ends here."""
+        self._slot_of[name] = slot
+        self._lru[name] = None
+        self._lru.move_to_end(name)
+
     def _install(self, name: str, allow_evict: bool) -> int | None:
         rec = self.registry.get(name)
         if rec is None:
@@ -151,7 +171,7 @@ class AdapterPool:
             self._install_fn(slot, rec.weights)
         except BaseException:
             with self._lock:
-                self._free.append(slot)
+                self._refund_slot_locked(slot)
             raise
         with self._lock:
             existing = self._slot_of.get(name)
@@ -162,12 +182,10 @@ class AdapterPool:
                 # mapping would leak a slot out of both _free and
                 # _slot_of, permanently shrinking the pool. The
                 # duplicate device write was the same weights; harmless.
-                self._free.append(slot)
+                self._refund_slot_locked(slot)
                 self._lru.move_to_end(name)
                 return existing
-            self._slot_of[name] = slot
-            self._lru[name] = None
-            self._lru.move_to_end(name)
+            self._publish_slot_locked(name, slot)
             return slot
 
     def install_cold(self, name: str) -> int | None:
@@ -203,5 +221,31 @@ class AdapterPool:
                 )
             del self._slot_of[name]
             self._lru.pop(name, None)
-            self._free.append(slot)
+            self._refund_slot_locked(slot)
             return True
+
+
+# Runtime twins of the `# llmd: resource(slots|leases, ...)` protocols
+# (static-analysis.md): LLMD_LEAKSAN=1 tracks every in-flight slot from
+# _take_slot_locked until refund or publish — the PR 13 duplicate-
+# install race is exactly a slot that reaches neither — and every
+# admission lease from acquire() until release_acquire().
+from llmd_tpu.analysis import sanitize as _sanitize
+
+_sanitize.leaksan_register(
+    AdapterPool, "slots",
+    acquire={
+        "_take_slot_locked": lambda self, a, k, r: (
+            [r] if r is not None else []
+        ),
+    },
+    release={"_refund_slot_locked": lambda self, a, k, r: [a[0]]},
+    transfer={"_publish_slot_locked": lambda self, a, k, r: [a[1]]},
+)
+_sanitize.leaksan_register(
+    AdapterPool, "leases",
+    acquire={
+        "acquire": lambda self, a, k, r: [a[0]] if r is not None else [],
+    },
+    release={"release_acquire": lambda self, a, k, r: [a[0]]},
+)

@@ -1089,3 +1089,77 @@ def test_lora_fetch_delay_absorbed(tmp_path):
             if res.request_id == rid:
                 out.extend(res.new_token_ids)
     assert len(out) == 3
+
+
+# --------------------------------------------------------------------- #
+# resource-lifecycle regression pin (static-analysis.md, LLMD_LEAKSAN):
+# the PR 8 seam — every claimed half-open probe grant must RESOLVE
+# (record_success / record_failure / forget) or expire; an unresolved
+# grant burns the cooldown window's single probe and locks the endpoint
+# out for another full cooldown.
+
+
+from pathlib import Path
+
+from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# The shared `leaksan` fixture lives in conftest.py.
+
+
+def _half_open_breaker(cls, now):
+    b = cls(failure_threshold=1, cooldown_s=10.0, clock=lambda: now[0])
+    b.record_failure("a")   # trips open
+    now[0] += 11.0          # cooldown elapsed: half-open
+    return b
+
+
+def test_probe_grant_resolution_leak_free_under_sanitizer(leaksan):
+    """The fixed breaker: a claimed grant resolves on failure AND on
+    success, and an abandoned grant expires after another cooldown —
+    zero outstanding grants every way the protocol can end."""
+    leaksan.leaksan_set_test("pin::probe-grant")
+    now = [1000.0]
+    b = _half_open_breaker(EndpointCircuitBreaker, now)
+    assert b.take_probe("a")                       # grant claimed
+    assert len(leaksan.leaksan_check_test("pin::probe-grant")) == 1
+    b.record_failure("a")                          # probe failed: resolved
+    assert leaksan.leaksan_check_test("pin::probe-grant") == []
+    now[0] += 11.0
+    assert b.take_probe("a")
+    b.record_success("a")                          # probe won: resolved
+    assert leaksan.leaksan_check_test("pin::probe-grant") == []
+    b.record_failure("a")                          # re-trip; abandon probe
+    now[0] += 11.0
+    assert b.take_probe("a")                       # claimed, never resolved
+    now[0] += 11.0                                 # designed expiry
+    assert leaksan.leaksan_check_test("pin::probe-grant") == []
+    assert b.take_probe("a")                       # fresh grant claimable
+
+
+def test_probe_grant_burned_by_unresolving_failure_caught(leaksan):
+    """Mutation pin: re-introduce the historical bug — record_failure
+    NOT resolving the outstanding half-open grant — and the sanitizer
+    must hold the burned grant outstanding on the test's watch."""
+    src = (REPO_ROOT / "llmd_tpu/epp/breaker.py").read_text()
+    mutated = src.replace(
+        "        # A failure resolves any outstanding half-open probe.\n"
+        "        self._probe_granted.pop(address, None)\n",
+        "",
+    )
+    assert mutated != src, "mutation target drifted; update the pin"
+    ns: dict = {}
+    exec(compile(mutated, "mutated_breaker.py", "exec"), ns)  # registers
+    MutBreaker = ns["EndpointCircuitBreaker"]
+
+    leaksan.leaksan_set_test("pin::probe-grant-mutated")
+    now = [1000.0]
+    b = _half_open_breaker(MutBreaker, now)
+    assert b.take_probe("a")     # grant claimed
+    b.record_failure("a")        # the bug: grant NOT resolved
+    leaks = leaksan.leaksan_check_test("pin::probe-grant-mutated")
+    assert len(leaks) == 1
+    assert leaks[0]["resource"] == "probes"
+    assert leaks[0]["stack"]

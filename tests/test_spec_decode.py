@@ -834,3 +834,69 @@ def test_spec_off_emits_no_spec_metrics():
 
     page = render_metrics(eng.stats, "tiny")
     assert "spec_" not in page
+
+
+# --------------------------------------------------------------------- #
+# resource-lifecycle regression pin (static-analysis.md, LLMD_LEAKSAN):
+# the PR 2/4 seam — rejected draft tokens' provisional pages must be
+# RETURNED by _truncate_spec_pages, not merely dropped from the request.
+
+
+# The shared `leaksan` fixture lives in conftest.py.
+
+
+def _run_spec_workload(window=4):
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True)
+    eng = make_engine(True, page=4, window=window)
+    for p in PROMPTS:
+        eng.add_request(list(p), sp)
+    saw_spec = False
+    for _ in range(128):
+        if not eng.has_work():
+            break
+        eng.step()
+        if eng.scheduler.spec_proposed_tokens:
+            saw_spec = True
+    assert not eng.has_work()
+    assert saw_spec
+    return eng
+
+
+def test_spec_truncation_leak_free_under_sanitizer(leaksan):
+    """Mid-window rejections truncate provisional pages back through
+    allocator.free: a full spec workload ends with ZERO outstanding
+    page refs on the engine's allocator."""
+    leaksan.leaksan_set_test("pin::spec-truncate")
+    _run_spec_workload()
+    assert leaksan.leaksan_check_test("pin::spec-truncate") == []
+
+
+def test_spec_truncation_drop_without_free_caught(leaksan, monkeypatch):
+    """Mutation pin: re-introduce the historical rollback bug —
+    _truncate_spec_pages dropping the trailing pages from the request
+    WITHOUT refunding them — and the sanitizer must name the leaked
+    pages (with acquisition backtraces) instead of the pool silently
+    shrinking on every rejected draft."""
+    from llmd_tpu.engine.scheduler import EngineScheduler
+
+    def leaky_truncate(self, req):
+        page = self.allocator.page_size
+        slots = req.num_computed_tokens
+        if self.config.async_scheduling:
+            slots = req.num_dispatched_tokens + self.spec_plan_max
+        keep = -(-slots // page)
+        if keep < len(req.block_ids):
+            del req.block_ids[keep:]  # dropped, never freed: the bug
+
+    monkeypatch.setattr(
+        EngineScheduler, "_truncate_spec_pages", leaky_truncate
+    )
+    leaksan.leaksan_set_test("pin::spec-truncate-mutated")
+    eng = _run_spec_workload()
+    leaks = leaksan.leaksan_check_test("pin::spec-truncate-mutated")
+    assert leaks, "mutated rollback leaked no pages — pin has drifted"
+    assert {r["resource"] for r in leaks} == {"pages"}
+    assert all(r["stack"] for r in leaks)
+    # and the pool really did shrink: the leaked refs are gone from the
+    # free list even though every request finished
+    assert eng.scheduler.allocator.num_free_pages < 64

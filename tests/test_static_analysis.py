@@ -1817,3 +1817,617 @@ class TestLockSanitizer:
         assert [v["kind"] for v in san.drain_violations()] == [
             "lock-order-cycle"
         ]
+
+
+# ------------------------------------------------------------------ #
+# resource lifecycle (RL001-RL003)
+
+
+LIFECYCLE_RULES = [
+    "release-on-all-paths", "release-pairing", "escaping-handle",
+]
+
+# A minimal declared protocol every fixture below shares: mirrors the
+# real PageAllocator annotation shape (ret-handle acquire, arg-handle
+# touch, arg release) plus an owns-annotated request field.
+PROTO = """
+    # llmd: resource(pages, recv=alloc, acquire=allocate|touch:arg, release=free, transfer=commit_page)
+    class PageAllocator:
+        def allocate(self, n): ...
+        def touch(self, ids): ...
+        def free(self, ids): ...
+        def commit_page(self, pid, h): ...
+        def peek(self, h): ...
+
+
+    class Req:
+        def __init__(self):
+            self.block_ids = []  # llmd: owns(pages)
+"""
+
+
+class TestLifecycleRules:
+    def _run(self, tmp_path, body: str):
+        return check(
+            tmp_path, {"engine/m.py": PROTO + body}, LIFECYCLE_RULES
+        )
+
+    def test_leak_on_return_fires_at_acquire_line(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def f(alloc, n):
+        pages = alloc.allocate(n)
+        if n > 2:
+            return None
+        alloc.free(pages)
+""")
+        assert codes(fs) == {"RL001"}
+        # Reported AT the acquisition so one pragma covers the site.
+        assert "alloc.allocate" in (
+            "\\n".join(open(str(tmp_path / "engine/m.py")).readlines()[
+                fs[0].line - 1 : fs[0].line
+            ])
+        )
+
+    def test_exception_edge_without_finally_fires(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def f(alloc, runner, n):
+        pages = alloc.allocate(n)
+        runner.scatter(pages)
+        alloc.free(pages)
+""")
+        assert codes(fs) == {"RL001"}
+        assert "exception-capable call" in fs[0].message
+
+    def test_try_finally_and_except_refund_stay_quiet(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def f(alloc, runner, n):
+        pages = alloc.allocate(n)
+        try:
+            runner.scatter(pages)
+        finally:
+            alloc.free(pages)
+
+    def g(alloc, runner, n):
+        slot = alloc.allocate(n)
+        try:
+            runner.install(slot)
+        except BaseException:
+            alloc.free(slot)
+            raise
+        alloc.commit_page(slot, n)
+""")
+        assert fs == []
+
+    def test_handoff_into_owns_state_stays_quiet(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def assign(alloc, req, n):
+        req.block_ids = alloc.allocate(n)
+
+    def extend(alloc, req, n):
+        req.block_ids.extend(alloc.allocate(n))
+
+    def kwarg(alloc, n):
+        return Req(block_ids=alloc.allocate(n))
+""")
+        assert fs == []
+
+    def test_transfers_marked_return_and_callee_stay_quiet(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    # llmd: transfers(pages)
+    def mint(alloc, n):
+        return alloc.allocate(n)
+
+    def consume(alloc, n):
+        pages = alloc.allocate(n)
+        mint_sink(pages)
+
+    # llmd: transfers(pages)
+    def mint_sink(pages): ...
+""")
+        assert fs == []
+
+    def test_discarded_result_and_loop_leak_fire(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def discard(alloc, n):
+        alloc.allocate(n)
+
+    def loop(alloc, items):
+        for it in items:
+            pages = alloc.allocate(it)
+""")
+        assert [f.code for f in fs] == ["RL001", "RL001"]
+
+    def test_guard_narrowing_stays_quiet(self, tmp_path):
+        # acquire:arg protocols returning None/False mean NOT acquired:
+        # the failure branch owes no release.
+        fs = self._run(tmp_path, """
+
+    def f(alloc, ids, ok):
+        alloc.touch(ids)
+        if not ok:
+            release_elsewhere(ids)
+            return None
+        alloc.free(ids)
+""")
+        assert codes(fs) == {"RL001"}  # release_elsewhere is not a release
+
+    def test_double_release_fires_disjoint_branches_quiet(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def bad(alloc, n):
+        pages = alloc.allocate(n)
+        alloc.free(pages)
+        alloc.free(pages)
+
+    def good(alloc, n, cond):
+        pages = alloc.allocate(n)
+        if cond:
+            alloc.free(pages)
+        else:
+            alloc.free(pages)
+""")
+        assert codes(fs) == {"RL002"}
+        assert len(fs) == 1
+
+    def test_peeked_release_fires(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def bad(alloc, h):
+        pages = alloc.peek(h)
+        alloc.free(pages)
+""")
+        assert codes(fs) == {"RL002"}
+        assert "peeked" in fs[0].message
+
+    def test_escape_to_unannotated_state_fires(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def stash(alloc, obj, n):
+        obj.scratch = alloc.allocate(n)
+
+    def ret(alloc, n):
+        return alloc.allocate(n)
+""")
+        assert [f.code for f in fs] == ["RL003", "RL003"]
+
+    def test_recv_filter_keeps_foreign_free_quiet(self, tmp_path):
+        # encode/worker.py-style: store.free() is a different protocol's
+        # name on a receiver the recv= hint rejects.
+        fs = self._run(tmp_path, """
+
+    def f(store, digest):
+        return store.free(digest)
+
+    def g(federation, h):
+        federation.touch(h)
+""")
+        assert fs == []
+
+    def test_pragma_suppresses_with_reason(self, tmp_path):
+        fs = self._run(tmp_path, """
+
+    def f(alloc, n):
+        # llmd: allow(release-on-all-paths) -- resolved by the response path
+        pages = alloc.allocate(n)
+        send(pages)
+""")
+        assert fs == []
+
+    def test_wrapped_multiline_declaration_parses(self, tmp_path):
+        # The docs' grammar examples wrap the declaration across
+        # comment lines; a wrapped form must enforce identically to the
+        # single-line form (a silently-unparsed protocol is zero
+        # enforcement with no signal).
+        fs = check(tmp_path, {"engine/m.py": """
+            # llmd: resource(pages, recv=alloc, acquire=allocate|touch:arg,
+            #                release=free, transfer=commit_page)
+            class PageAllocator:
+                def allocate(self, n): ...
+                def touch(self, ids): ...
+                def free(self, ids): ...
+                def commit_page(self, pid): ...
+
+
+            def leak(alloc, n):
+                pages = alloc.allocate(n)
+                return None
+        """}, LIFECYCLE_RULES)
+        assert codes(fs) == {"RL001"}
+
+    def test_protocol_without_acquire_is_a_finding(self, tmp_path):
+        fs = check(tmp_path, {"engine/m.py": """
+            # llmd: resource(widgets, release=free)
+            class W:
+                def free(self, x): ...
+        """}, LIFECYCLE_RULES)
+        assert codes(fs) == {"RL001"}
+        assert "unenforceable" in fs[0].message
+
+
+class TestLifecycleRealTree:
+    def test_real_tree_is_clean(self):
+        findings, _ = run_analysis(
+            REPO, [str(REPO / "llmd_tpu")], LIFECYCLE_RULES
+        )
+        assert findings == []
+
+    def test_pr13_slot_leak_mutation_fails_statically(self, tmp_path):
+        """THE mutation pin: re-introducing the PR 13 AdapterPool slot
+        leak — the duplicate-install loser keeping the winner's mapping
+        but never refunding its own slot — must turn the build red."""
+        src = (REPO / "llmd_tpu/lora/pool.py").read_text()
+        mutated = src.replace(
+            "                self._refund_slot_locked(slot)\n"
+            "                self._lru.move_to_end(name)\n"
+            "                return existing\n",
+            "                self._lru.move_to_end(name)\n"
+            "                return existing\n",
+        )
+        assert mutated != src, "mutation target drifted; update the pin"
+        (tmp_path / "lora").mkdir()
+        # Strip the import-time leaksan registration: the mutated copy
+        # is static-analysis input, not an importable module.
+        mutated = mutated[: mutated.index(
+            "from llmd_tpu.analysis import sanitize"
+        )]
+        (tmp_path / "lora/pool.py").write_text(mutated)
+        findings, _ = run_analysis(
+            tmp_path, [str(tmp_path)], LIFECYCLE_RULES
+        )
+        assert "RL001" in {f.code for f in findings}
+
+    def test_stale_lifecycle_pragma_is_reported(self, tmp_path):
+        """--report-unused-pragmas covers the RL rules: an allow() whose
+        violation was fixed shows up in the hygiene report."""
+        (tmp_path / "engine").mkdir()
+        (tmp_path / "engine/m.py").write_text(textwrap.dedent("""
+            def f(x):
+                # llmd: allow(release-on-all-paths) -- nothing here needs it
+                return x
+        """))
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis",
+             "--report-unused-pragmas",
+             "--root", str(tmp_path), str(tmp_path / "engine")],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0
+        assert "unused pragma `allow(release-on-all-paths)`" in out.stdout
+
+    def test_rl_rules_carry_pragma_keys_in_sarif(self, tmp_path):
+        (tmp_path / "engine").mkdir()
+        (tmp_path / "engine/m.py").write_text(textwrap.dedent("""
+            # llmd: resource(pages, recv=alloc, acquire=allocate, release=free)
+            class A:
+                def allocate(self, n): ...
+                def free(self, ids): ...
+
+            def f(alloc, n):
+                return alloc.allocate(n)
+        """))
+        sarif_path = tmp_path / "out.sarif"
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--json",
+             "--sarif", str(sarif_path),
+             "--root", str(tmp_path), str(tmp_path / "engine")],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 1
+        doc = json.loads(sarif_path.read_text())
+        rules = {
+            r["id"]: r for r in doc["runs"][0]["tool"]["driver"]["rules"]
+        }
+        assert "RL003" in rules
+        assert rules["RL003"]["properties"]["pragma"].startswith(
+            "# llmd: allow(escaping-handle)"
+        )
+
+
+# ------------------------------------------------------------------ #
+# runtime leak sanitizer (LLMD_LEAKSAN)
+
+
+class _ToyPool:
+    """Minimal counted-protocol manager for sanitizer units."""
+
+    def __init__(self) -> None:
+        self.next = 0
+
+    def take(self):
+        self.next += 1
+        return self.next
+
+    def give(self, h):
+        pass
+
+    def publish(self, h):
+        pass
+
+
+class _ToyGate:
+    """Minimal anon-protocol manager (flow-token shape)."""
+
+    def grant(self):
+        pass
+
+    def release(self):
+        pass
+
+
+_TOYS_REGISTERED = False
+
+
+def _register_toys(sanitize):
+    global _TOYS_REGISTERED
+    if _TOYS_REGISTERED:
+        return
+    _TOYS_REGISTERED = True
+    sanitize.leaksan_register(
+        _ToyPool, "toys",
+        acquire={"take": lambda self, a, k, r: [r]},
+        release={"give": lambda self, a, k, r: [a[0]]},
+        transfer={"publish": lambda self, a, k, r: [a[0]]},
+    )
+    sanitize.leaksan_register(
+        _ToyGate, "gates", mode="anon",
+        acquire={"grant": lambda self, a, k, r: [None]},
+        release={"release": lambda self, a, k, r: [None]},
+    )
+
+
+class TestLeakSanitizer:
+    @pytest.fixture
+    def san(self):
+        from llmd_tpu.analysis import sanitize
+
+        _register_toys(sanitize)
+        was_armed = sanitize.leaksan_armed()
+        if not was_armed:
+            sanitize.arm_leaksan()
+        sanitize.leaksan_set_test("<unit>")
+        sanitize.leaksan_drain_violations()
+        try:
+            yield sanitize
+        finally:
+            sanitize.leaksan_drain_violations()
+            if not was_armed:
+                sanitize.disarm_leaksan()
+
+    def test_leak_detected_with_backtrace(self, san):
+        san.leaksan_set_test("t::leak")
+        pool = _ToyPool()
+        h = pool.take()
+        leaks = san.leaksan_check_test("t::leak")
+        assert len(leaks) == 1
+        rec = leaks[0]
+        assert rec["resource"] == "toys"
+        assert rec["test"] == "t::leak"
+        # the acquisition backtrace points at the take() call above
+        assert any("test_static_analysis" in fr for fr in rec["stack"])
+        pool.give(h)
+        assert san.leaksan_check_test("t::leak") == []
+
+    def test_release_and_transfer_are_quiet(self, san):
+        san.leaksan_set_test("t::quiet")
+        pool = _ToyPool()
+        pool.give(pool.take())      # acquire -> release
+        pool.publish(pool.take())   # acquire -> transfer (publish)
+        assert san.leaksan_check_test("t::quiet") == []
+        assert san.leaksan_drain_violations() == []
+        # releasing a previously-published handle (unload of a resident
+        # slot) is a legitimate arc, not a double release
+        pool.give(2)
+        assert san.leaksan_drain_violations() == []
+
+    def test_double_release_caught(self, san):
+        pool = _ToyPool()
+        h = pool.take()
+        pool.give(h)
+        pool.give(h)
+        vs = san.leaksan_drain_violations()
+        assert [v["kind"] for v in vs] == ["double-release"]
+        assert vs[0]["resource"] == "toys"
+
+    def test_anon_tokens_pair_and_underflow_is_violation(self, san):
+        san.leaksan_set_test("t::anon")
+        gate = _ToyGate()
+        gate.grant()
+        gate.release()
+        assert san.leaksan_check_test("t::anon") == []
+        gate.release()
+        vs = san.leaksan_drain_violations()
+        assert [v["kind"] for v in vs] == ["release-without-acquire"]
+        gate.grant()
+        assert len(san.leaksan_check_test("t::anon")) == 1
+        gate.release()
+
+    def test_background_thread_leak_attributed_to_test(self, san):
+        import threading
+
+        san.leaksan_set_test("t::bg")
+        pool = _ToyPool()
+        t = threading.Thread(target=pool.take)
+        t.start()
+        t.join()
+        leaks = san.leaksan_check_test("t::bg")
+        assert len(leaks) == 1
+        assert leaks[0]["test"] == "t::bg"
+        assert leaks[0]["thread"] != "MainThread"
+        pool.give(1)
+
+    def test_dead_manager_handles_are_not_leaks(self, san):
+        san.leaksan_set_test("t::dead")
+        pool = _ToyPool()
+        pool.take()
+        del pool
+        assert san.leaksan_check_test("t::dead") == []
+
+    def test_probe_grant_expiry_is_release_not_leak(self, san):
+        from llmd_tpu.epp.breaker import EndpointCircuitBreaker
+
+        san.leaksan_set_test("t::probe")
+        now = [0.0]
+        b = EndpointCircuitBreaker(
+            failure_threshold=1, cooldown_s=5.0, clock=lambda: now[0]
+        )
+        b.record_failure("a")          # trips open
+        now[0] = 6.0                   # half-open
+        assert b.take_probe("a")       # grant claimed
+        assert len(san.leaksan_check_test("t::probe")) == 1
+        now[0] = 20.0                  # grant expired: designed release
+        assert san.leaksan_check_test("t::probe") == []
+
+    def test_report_shape_and_session_cumulative(self, san, tmp_path):
+        pool = _ToyPool()
+        h = pool.take()
+        pool.give(h)
+        pool.give(h)                      # violation
+        san.leaksan_drain_violations()    # per-test drain...
+        rep = san.leaksan_report()
+        assert rep["armed"] is True
+        toys = rep["resources"]["toys"]
+        assert toys["acquired"] >= 1 and toys["released"] >= 1
+        assert toys["peak_outstanding"] >= 1
+        # ...must NOT empty the session-cumulative artifact
+        assert any(
+            v["kind"] == "double-release" for v in rep["violations"]
+        )
+        path = tmp_path / "leaksan.json"
+        assert san.write_leaksan_report(str(path)) == str(path)
+        assert json.loads(path.read_text())["armed"] is True
+
+    def test_pool_duplicate_install_race_stays_leak_free(self, san):
+        """The PR 13 seam under the sanitizer: a prefetch racing a cold
+        load of the same name must refund the loser's slot — free +
+        resident must re-account for every slot, nothing outstanding."""
+        import threading
+
+        from llmd_tpu.lora.pool import AdapterPool
+
+        class _Reg:
+            def get(self, name):
+                class Rec:
+                    weights = {}
+                return Rec()
+
+        san.leaksan_set_test("t::race")
+        barrier = threading.Barrier(2)
+
+        def install(slot, weights):
+            try:
+                barrier.wait(timeout=5)  # both takers hold a slot here
+            except threading.BrokenBarrierError:
+                pass
+
+        pool = AdapterPool(_Reg(), install, num_slots=4)
+        threads = [
+            threading.Thread(target=pool.install_cold, args=("same",))
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert pool.slot_of("same") is not None
+        # conservation: every slot is free or resident, none in flight
+        assert len(pool._free) + len(pool._slot_of) == 4
+        assert san.leaksan_check_test("t::race") == []
+
+    def test_pr13_slot_leak_mutation_caught_at_runtime(self, san):
+        """Runtime mutation pin: execute pool.py with the loser-refund
+        line deleted and drive the duplicate-install race — the leaked
+        slot must surface as an outstanding `slots` handle."""
+        import threading
+
+        src = (REPO / "llmd_tpu/lora/pool.py").read_text()
+        mutated = src.replace(
+            "                self._refund_slot_locked(slot)\n"
+            "                self._lru.move_to_end(name)\n"
+            "                return existing\n",
+            "                self._lru.move_to_end(name)\n"
+            "                return existing\n",
+        )
+        assert mutated != src, "mutation target drifted; update the pin"
+        ns: dict = {}
+        exec(compile(mutated, "mutated_pool.py", "exec"), ns)  # registers
+        MutPool = ns["AdapterPool"]
+
+        class _Reg:
+            def get(self, name):
+                class Rec:
+                    weights = {}
+                return Rec()
+
+        san.leaksan_set_test("t::mutated-race")
+        barrier = threading.Barrier(2)
+
+        def install(slot, weights):
+            try:
+                barrier.wait(timeout=5)
+            except threading.BrokenBarrierError:
+                pass
+
+        pool = MutPool(_Reg(), install, num_slots=4)
+        threads = [
+            threading.Thread(target=pool.install_cold, args=("same",))
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # the historical bug: one slot vanished from both books...
+        assert len(pool._free) + len(pool._slot_of) == 3
+        # ...and the sanitizer names it, with the acquisition backtrace
+        leaks = san.leaksan_check_test("t::mutated-race")
+        assert len(leaks) == 1
+        assert leaks[0]["resource"] == "slots"
+        assert leaks[0]["stack"]
+
+    def test_changed_only_sees_protocols_from_unchanged_files(self, tmp_path):
+        """--changed-only scopes WHERE findings are reported, not which
+        protocol declarations exist: a changed caller of a manager whose
+        `# llmd: resource(...)` lives in an UNCHANGED file is still
+        checked against it."""
+        subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+        subprocess.run(
+            ["git", "config", "user.email", "t@t"], cwd=tmp_path, check=True
+        )
+        subprocess.run(
+            ["git", "config", "user.name", "t"], cwd=tmp_path, check=True
+        )
+        (tmp_path / "llmd_tpu").mkdir()
+        (tmp_path / "llmd_tpu/mgr.py").write_text(textwrap.dedent("""
+            # llmd: resource(pages, recv=alloc, acquire=allocate, release=free)
+            class PageAllocator:
+                def allocate(self, n): ...
+                def free(self, ids): ...
+        """))
+        subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+        subprocess.run(
+            ["git", "commit", "-qm", "seed"], cwd=tmp_path, check=True
+        )
+        # The NEW (untracked => in the changed set) file leaks a handle.
+        (tmp_path / "llmd_tpu/user.py").write_text(textwrap.dedent("""
+            def f(alloc, n):
+                pages = alloc.allocate(n)
+                if n:
+                    return None
+                alloc.free(pages)
+        """))
+        out = subprocess.run(
+            [sys.executable, "-m", "llmd_tpu.analysis", "--json",
+             "--changed-only", "--root", str(tmp_path),
+             "--rules", ",".join(LIFECYCLE_RULES)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 1, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert [f["code"] for f in payload["findings"]] == ["RL001"]
+        assert payload["findings"][0]["path"] == "llmd_tpu/user.py"
