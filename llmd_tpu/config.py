@@ -658,6 +658,14 @@ class EngineConfig:
     # producer's device snapshots directly — no HBM->host staging, no
     # wire bytes (the reference's single-host/pd deployment shape).
     kv_local_fastpath: bool = True
+    # Layer-streamed P/D transfer (the v3 group-framed wire): exports
+    # split into this many contiguous layer groups shipped group-major;
+    # the consumer pipelines fetch -> CRC -> scatter per group and the
+    # decode-side request is schedulable once group 0 is resident.
+    # Clamped to the model's layer count; 1 disables (v2 chunk framing).
+    # The LLMD_KV_STREAM_COMPAT_V2 / LLMD_KV_BUNDLE_COMPAT_V1 pins and
+    # multi-host lockstep runners force 1.
+    kv_stream_groups: int = 4
     # ZMQ pub endpoint for KV events (BlockStored/...); None disables.
     kv_events_endpoint: str | None = None
     # Tiered KV offload; None disables.

@@ -212,6 +212,16 @@ class EngineStats:
     kv_imported_requests: int = 0
     kv_imported_bytes: int = 0
     kv_import_failures: int = 0
+    # Layer-streamed transfer (the v3 group-framed wire): (layer-group x
+    # chunk) cells landed by streamed imports, and the last import's
+    # first-group latency — the admission-gate leg of the pipeline
+    # waterfall (kv-cache.md "layer-streamed import").
+    kv_stream_groups_total: int = 0
+    kv_stream_first_group_ms: float = 0.0
+    # Publish-budget pacing (LLMD_KV_PUBLISH_BYTES_PER_S): bytes the
+    # federation publisher delayed to keep publish-on-evict bursts off
+    # the transfer NIC (kv-federation.md).
+    kv_publish_paced_bytes_total: int = 0
     # LoRA (reference model-servers.md:78-89 lora_requests_info)
     max_lora: int = 0
     running_lora_adapters: tuple = ()
@@ -505,6 +515,15 @@ class LLMEngine:
         # Terminal ABORT outputs for parked rows whose adapter vanished
         # (defensive; drained into the next step's return).
         self._lora_failed_outputs: list[RequestOutput] = []
+        # Group-streamed KV imports (docs/architecture/kv-cache.md
+        # "layer-streamed import"): requests whose transferred KV is
+        # still on the wire park here — admitted by _admit_kv_streams at
+        # a step boundary once the stream resolves (apply on success,
+        # plain recompute on failure). The serving layer submits them as
+        # soon as the FIRST layer group is resident, so admission,
+        # scheduling, and host staging all overlap the remaining wire
+        # transfer. Entries: (Request, KVStreamHandle).
+        self._kv_parked: list = []
         if config.model.lora_dynamic and not follower:
             from llmd_tpu.lora import AdapterPool, AdapterRegistry
 
@@ -540,6 +559,7 @@ class LLMEngine:
                 load_failure_policy=config.kv_load_failure_policy,
                 transfer_dtype=config.kv_transfer_dtype,
                 local_fastpath=config.kv_local_fastpath,
+                stream_groups=config.kv_stream_groups,
             )
             self.kv_connector = TPUConnector(kv_cfg, self.runner, self.allocator)
             self.scheduler.finish_hook = self._on_finish
@@ -737,29 +757,39 @@ class LLMEngine:
         # the PRELOAD path: pages (full-group + a fresh ring holding the
         # sliding-layer section) handed straight to the Request below.
         preload = None
+        kv_stream = None
         if self.kv_connector is not None and self.kv_connector.wants_import(
             kv_transfer_params
         ):
             kv_transfer_params = dict(kv_transfer_params)
-            if "__pulled__" in kv_transfer_params:
-                bundle = kv_transfer_params.pop("__pulled__")
-            else:
-                bundle = self.kv_connector.fetch_remote_policy(
-                    list(prompt_token_ids), kv_transfer_params
-                )
-            if bundle is not None:
-                if self._swa is not None:
-                    preload = self.kv_connector.apply_preload(
-                        list(prompt_token_ids), bundle,
-                        self.swa_allocator, self._swa.ring_pages,
-                    )
+            # Group-streamed import (v3 wire): the serving layer submits
+            # at first-group-resident with the in-flight handle; the
+            # request PARKS below and _admit_kv_streams finalizes at a
+            # step boundary — admission/scheduling overlap the rest of
+            # the wire transfer.
+            kv_stream = kv_transfer_params.pop("__stream__", None)
+            if kv_stream is None:
+                if "__pulled__" in kv_transfer_params:
+                    bundle = kv_transfer_params.pop("__pulled__")
                 else:
-                    self.kv_connector.apply_bundle(
-                        list(prompt_token_ids), bundle
+                    bundle = self.kv_connector.fetch_remote_policy(
+                        list(prompt_token_ids), kv_transfer_params
                     )
+                if bundle is not None:
+                    if self._swa is not None:
+                        preload = self.kv_connector.apply_preload(
+                            list(prompt_token_ids), bundle,
+                            self.swa_allocator, self._swa.ring_pages,
+                        )
+                    else:
+                        self.kv_connector.apply_bundle(
+                            list(prompt_token_ids), bundle
+                        )
         # Tiered offload: pull host-cached pages extending the device prefix
         # run back into HBM before scheduling (restore-on-prefill).
-        if self.offloader is not None:
+        # (Streamed imports defer this to finalize: the transferred pages
+        # land first, then the host tiers only fill what is left.)
+        if self.offloader is not None and kv_stream is None:
             self.offloader.restore_for_prompt(list(prompt_token_ids))
         req = Request(
             request_id=rid,
@@ -790,10 +820,19 @@ class LLMEngine:
             req.swa_block_ids = list(preload["swa_block_ids"])
             req.num_computed_tokens = preload["tokens"]
             req.num_cached_tokens = preload["tokens"]
-        elif self._swa_sections is not None and not park_adapter:
+        elif (
+            self._swa_sections is not None
+            and not park_adapter
+            and kv_stream is None
+        ):
             # (Parked requests skip the hybrid probe: their cache salt
             # needs the slot id the cold load has not assigned yet.)
             self._try_hybrid_ring_hit(req)
+        if kv_stream is not None:
+            # Waiting on the group stream: schedulable the moment the
+            # import resolves (apply on success, recompute on failure).
+            self._kv_parked.append((req, kv_stream, park_adapter))
+            return rid
         if park_adapter:
             # Loading queue (multi-tenant-lora.md): the request waits for
             # its adapter's cold load — admitted by _admit_cold_loads at
@@ -881,6 +920,14 @@ class LLMEngine:
                 # Parked in the adapter loading queue: never scheduled,
                 # nothing on device to reconcile.
                 del self._lora_parked[i]
+                return True
+        for i, (r, handle, _pa) in enumerate(self._kv_parked):
+            if r.request_id == request_id:
+                # Parked on a group stream: abandon() releases the
+                # fetched bundle (stream-reserved pages included) from
+                # whichever side of the fetch-thread race holds it.
+                del self._kv_parked[i]
+                handle.abandon()
                 return True
         if self._inflight is not None and any(
             s.request.request_id == request_id
@@ -1174,11 +1221,60 @@ class LLMEngine:
                     still.append(req)
             self._lora_parked = still
 
+    def _admit_kv_streams(self) -> None:
+        """Drain resolved group-streamed imports at a step boundary.
+
+        A landed bundle applies (hash-chain commit only — the fetch
+        thread already scattered every group into pool pages) and the
+        request goes to the scheduler, where the prefill is now a
+        prefix-cache hit; a failed stream admits as a plain local
+        recompute (the PR 7 degradation contract, byte-identical
+        either way). When streams are the ONLY pending work, block
+        briefly on the oldest handle so the step loop wakes the instant
+        it resolves instead of busy-spinning."""
+        while True:
+            still: list = []
+            admitted = False
+            for req, handle, park_adapter in self._kv_parked:
+                if not handle.done.is_set():
+                    still.append((req, handle, park_adapter))
+                    continue
+                bundle = handle.take()
+                if bundle is not None:
+                    self.kv_connector.apply_bundle(
+                        list(req.prompt_token_ids), bundle
+                    )
+                elif self.offloader is not None:
+                    # Stream failed: give the host tiers their usual
+                    # restore-on-prefill shot before the recompute.
+                    self.offloader.restore_for_prompt(
+                        list(req.prompt_token_ids)
+                    )
+                if park_adapter:
+                    self._lora_parked.append(req)
+                else:
+                    self.scheduler.add_request(req)
+                admitted = True
+            self._kv_parked = still
+            if admitted or not still:
+                return
+            if (
+                self._inflight is not None
+                or self.scheduler.has_work()
+                or self._lora_parked
+            ):
+                return  # other work to run; re-check next step
+            # Idle except for in-flight streams: wait on the oldest —
+            # bounded so the serving loop still sees aborts promptly.
+            if not still[0][1].done.wait(0.05):
+                return
+
     def has_work(self) -> bool:
         return (
             self.scheduler.has_work()
             or self._inflight is not None
             or bool(self._lora_parked)
+            or bool(self._kv_parked)
         )
 
     # ------------------------------------------------------------------ #
@@ -1189,6 +1285,8 @@ class LLMEngine:
         # notice, 503 /health and terminate in-flight streams. Unarmed
         # this is one module-global None check.
         faults.delay("engine.step.stall")
+        if self._kv_parked:
+            self._admit_kv_streams()
         if self._lora_parked:
             self._admit_cold_loads()
         outputs = self._step_async() if self._async else self._step_sync()
@@ -1756,6 +1854,9 @@ class LLMEngine:
             self.stats.kvstore_pulls = ks["pulls"]
             self.stats.kvstore_pull_failures = ks["pull_failures"]
             self.stats.kvstore_misses = ks["misses"]
+            self.stats.kv_publish_paced_bytes_total = ks.get(
+                "paced_publish_bytes", 0
+            )
         if self._federation is not None:
             fs = self._federation.stats()
             self.stats.kv_federation_published = fs["published"]
@@ -1771,6 +1872,8 @@ class LLMEngine:
             self.stats.kv_imported_requests = cs["imported_requests"]
             self.stats.kv_imported_bytes = cs["imported_bytes"]
             self.stats.kv_import_failures = cs["import_failures"]
+            self.stats.kv_stream_groups_total = cs["stream_groups_total"]
+            self.stats.kv_stream_first_group_ms = cs["last_first_group_ms"]
             self.stats.kv_bundle_crc_failures_total = cs["crc_failures"]
             self.stats.kv_recompute_fallbacks_total = cs[
                 "recompute_fallbacks"

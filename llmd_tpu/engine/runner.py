@@ -1439,6 +1439,101 @@ class ModelRunner:
 
         return jax.jit(scatter, donate_argnums=(0,))
 
+    # ------------------------------------------------------------------ #
+    # layer-group staging programs (the v3 group-framed KV transfer:
+    # docs/architecture/kv-cache.md "layer-streamed import"). The layer
+    # index rides as a TRACED [Lg] array, so one program per (Lg, page
+    # count) shape family serves every group offset — not one per l0.
+
+    @functools.cached_property
+    def _replicated_gather_group(self):
+        """Layer-sliced gather -> canonical heads, fully replicated:
+        [Lg, n, K, page, 2D] of layers ``l_ids``."""
+        rep = self.kv_rep
+        dt = jnp.dtype(self.staging_dtype) if self.kv_quantized else None
+
+        def gather(kv, l_ids, ids):
+            li = l_ids[:, None]
+            if isinstance(kv, tuple):
+                from llmd_tpu.ops.quant_kv import dequantize_pages
+
+                d, s = kv[0][li, ids[None, :]], kv[1][li, ids[None, :]]
+                if rep > 1:
+                    d, s = d[:, :, ::rep], s[:, :, ::rep]
+                return dequantize_pages(d, s, dt)
+            out = kv[li, ids[None, :]]
+            if rep > 1:
+                out = out[:, :, ::rep]
+            return out
+
+        return jax.jit(gather, out_shardings=self.ctx.replicated)
+
+    @functools.cached_property
+    def _replicated_gather_group_q8(self):
+        """Layer-sliced q8-wire gather (the grouped twin of
+        :attr:`_replicated_gather_q8`)."""
+        rep = self.kv_rep
+
+        def gather(kv, l_ids, ids):
+            li = l_ids[:, None]
+            if isinstance(kv, tuple):
+                from llmd_tpu.ops.quant_kv import pool_scales_to_wire
+
+                d, s = kv[0][li, ids[None, :]], kv[1][li, ids[None, :]]
+                if rep > 1:
+                    d, s = d[:, :, ::rep], s[:, :, ::rep]
+                return d, pool_scales_to_wire(s).astype(jnp.float16)
+            out = kv[li, ids[None, :]]
+            if rep > 1:
+                out = out[:, :, ::rep]
+            return _quantize_rows_q8(out)
+
+        return jax.jit(gather, out_shardings=self.ctx.replicated)
+
+    @functools.cached_property
+    def _scatter_canonical_group(self):
+        """Layer-sliced scatter of a canonical [Lg, n, ...] bundle into
+        pool layers ``l_ids`` (the grouped twin of
+        :attr:`_scatter_canonical`). Int8 pools quantize in-program."""
+        rep = self.kv_rep
+
+        def scatter(kv, l_ids, ids, vals):
+            li = l_ids[:, None]
+            if rep > 1:
+                vals = jnp.repeat(vals, rep, axis=2)
+            if isinstance(kv, tuple):
+                from llmd_tpu.ops.quant_kv import quantize_pages
+
+                d, s = quantize_pages(vals)
+                return (
+                    kv[0].at[li, ids[None, :]].set(d),
+                    kv[1].at[li, ids[None, :]].set(s),
+                )
+            return kv.at[li, ids[None, :]].set(vals.astype(kv.dtype))
+
+        return jax.jit(scatter, donate_argnums=(0,))
+
+    @functools.cached_property
+    def _scatter_q8_direct_group(self):
+        """Layer-sliced q8-wire scatter into an int8 pool (the grouped
+        twin of :attr:`_scatter_q8_direct`)."""
+        rep = self.kv_rep
+
+        def scatter(kv, l_ids, ids, d, s_wire):
+            from llmd_tpu.ops.quant_kv import wire_scales_to_pool
+
+            li = l_ids[:, None]
+            s = wire_scales_to_pool(s_wire)  # [Lg, n, K, page, 2]
+            if rep > 1:
+                d = jnp.repeat(d, rep, axis=2)
+                s = jnp.repeat(s, rep, axis=2)
+            return (
+                kv[0].at[li, ids[None, :]].set(d),
+                kv[1].at[li, ids[None, :]].set(s.astype(kv[1].dtype)),
+            )
+
+        return jax.jit(scatter, donate_argnums=(0,))
+
     def _pool(self, swa: bool):
         """Select the staging target: the main pool or the SWA ring pool.
         The staging programs themselves are pool-agnostic (the pool is an
@@ -2152,7 +2247,12 @@ class ModelRunner:
     # KV page staging (the HBM<->host leg of the P/D transfer path;
     # reference TPUConnectorHMA host-memory-assisted pattern)
 
-    def snapshot_pages_device(self, page_ids: list[int], pad_to: int) -> jax.Array:
+    def snapshot_pages_device(
+        self,
+        page_ids: list[int],
+        pad_to: int,
+        layers: tuple[int, int] | None = None,
+    ) -> jax.Array:
         """On-device snapshot of pages (padded to ``pad_to`` by repeating
         the last id): [L, pad_to, K, page, 2D] in CANONICAL heads.
 
@@ -2162,6 +2262,11 @@ class ModelRunner:
         blocking host download happens later via ``download_pages`` on a
         staging thread, off the engine thread and off the TTFT path.
 
+        ``layers=(l0, Lg)`` snapshots only that layer slice ([Lg, ...]) —
+        the v3 group-framed transfer's per-layer-group export unit
+        (single-host only; multi-host producers stay on the monolithic
+        lockstep gather).
+
         Multi-host: the gather is lockstep-broadcast so every process
         dispatches the same SPMD program; the output is fully replicated
         (head-axis all-gather over ICI), so the later download is a local
@@ -2169,10 +2274,18 @@ class ModelRunner:
         """
         ids = _padded_ids(page_ids, pad_to)
         if self._multihost:
+            assert layers is None, "layer-group staging is single-host only"
             return self._kv_gather_lockstep(ids, q8=False)
         # Canonical transfer format keeps the ORIGINAL heads (peers with
         # different tp interoperate byte-exact); int8 pools dequantize
         # in-program to the staging dtype.
+        if layers is not None:
+            l0, lg = layers
+            return self._replicated_gather_group(
+                self.kv_cache,
+                jnp.arange(l0, l0 + lg, dtype=jnp.int32),
+                jnp.asarray(ids),
+            )
         return self._replicated_gather(self.kv_cache, jnp.asarray(ids))
 
     def snapshot_swa_pages_device(self, page_ids: list[int], pad_to: int) -> jax.Array:
@@ -2188,7 +2301,10 @@ class ModelRunner:
         return self._replicated_gather(self.kv_swa, jnp.asarray(ids))
 
     def snapshot_pages_device_q8(
-        self, page_ids: list[int], pad_to: int
+        self,
+        page_ids: list[int],
+        pad_to: int,
+        layers: tuple[int, int] | None = None,
     ) -> tuple[jax.Array, jax.Array]:
         """INT8-quantized snapshot for the transfer plane: per-(token,
         head)-row symmetric int8 + f16 scales, computed ON DEVICE so the
@@ -2200,7 +2316,15 @@ class ModelRunner:
         quantize work). The default transfer dtype stays pool-exact."""
         ids = _padded_ids(page_ids, pad_to)
         if self._multihost:
+            assert layers is None, "layer-group staging is single-host only"
             return self._kv_gather_lockstep(ids, q8=True)
+        if layers is not None:
+            l0, lg = layers
+            return self._replicated_gather_group_q8(
+                self.kv_cache,
+                jnp.arange(l0, l0 + lg, dtype=jnp.int32),
+                jnp.asarray(ids),
+            )
         return self._replicated_gather_q8(self.kv_cache, jnp.asarray(ids))
 
     @staticmethod
@@ -2232,14 +2356,24 @@ class ModelRunner:
         )
 
     def scatter_pages_from_device(
-        self, page_ids: list[int], vals, swa: bool = False
+        self,
+        page_ids: list[int],
+        vals,
+        swa: bool = False,
+        layers: tuple[int, int] | None = None,
     ) -> None:
-        """Engine-thread leg of a pipelined import: device -> pool scatter
-        of an already-uploaded chunk (head expansion device-side).
-        ``vals`` is a float bundle, or a (q8, wire scales) pair — int8
-        pools scatter the pair directly; float pools dequantize on
-        device first (the local fast path hands q8 device snapshots to
-        any consumer pool dtype). ``swa`` targets the SWA ring pool."""
+        """Device -> pool scatter of an already-uploaded chunk (head
+        expansion device-side). ``vals`` is a float bundle, or a
+        (q8, wire scales) pair — int8 pools scatter the pair directly;
+        float pools dequantize on device first (the local fast path hands
+        q8 device snapshots to any consumer pool dtype). ``swa`` targets
+        the SWA ring pool; ``layers=(l0, Lg)`` writes only that layer
+        slice (the v3 group-streamed import).
+
+        Thread-safe: the whole pool read-modify-write runs under the
+        dispatch lock, so the streamed import's FETCH-thread scatters
+        interleave with (never tear) the engine thread's step dispatches
+        — the same discipline the multi-host streamed path rides."""
         self._require_single_host("scatter_pages_from_device (P/D staging)")
         # Device chunks may come from ANOTHER engine's mesh (the local
         # fast path claims the producer's snapshots; e.g. a tp=1
@@ -2248,24 +2382,42 @@ class ModelRunner:
         # devices.
         place = lambda x: jax.device_put(x, self.ctx.replicated)  # noqa: E731
         ids = place(np.asarray(page_ids, np.int32))
-        if isinstance(vals, tuple):
-            if self.kv_quantized:
-                out = self._scatter_q8_direct(
-                    self._pool(swa), ids, place(vals[0]), place(vals[1])
-                )
-                if swa:
-                    self.kv_swa = out
-                else:
-                    self.kv_cache = out
-                return
-            vals = _dequantize_rows_q8(
-                vals[0], vals[1], self.staging_dtype_name
+        l_ids = (
+            None if layers is None
+            else place(
+                np.arange(layers[0], layers[0] + layers[1], dtype=np.int32)
             )
-        out = self._scatter_canonical(self._pool(swa), ids, place(vals))
-        if swa:
-            self.kv_swa = out
-        else:
-            self.kv_cache = out
+        )
+        with self._dispatch_lock:
+            if isinstance(vals, tuple):
+                if self.kv_quantized:
+                    if l_ids is not None:
+                        out = self._scatter_q8_direct_group(
+                            self._pool(swa), l_ids, ids,
+                            place(vals[0]), place(vals[1]),
+                        )
+                    else:
+                        out = self._scatter_q8_direct(
+                            self._pool(swa), ids, place(vals[0]), place(vals[1])
+                        )
+                    if swa:
+                        self.kv_swa = out
+                    else:
+                        self.kv_cache = out
+                    return
+                vals = _dequantize_rows_q8(
+                    vals[0], vals[1], self.staging_dtype_name
+                )
+            if l_ids is not None:
+                out = self._scatter_canonical_group(
+                    self._pool(swa), l_ids, ids, place(vals)
+                )
+            else:
+                out = self._scatter_canonical(self._pool(swa), ids, place(vals))
+            if swa:
+                self.kv_swa = out
+            else:
+                self.kv_cache = out
 
     def gather_pages(self, page_ids: list[int]) -> np.ndarray:
         """Stage pages HBM -> host: returns [L, n, K, page, 2D] ndarray.
@@ -2286,14 +2438,25 @@ class ModelRunner:
         return np.ascontiguousarray(self.download_pages(snap)[:, :n])
 
     def scatter_pages(
-        self, page_ids: list[int], pages: np.ndarray, swa: bool = False
+        self,
+        page_ids: list[int],
+        pages: np.ndarray,
+        swa: bool = False,
+        layers: tuple[int, int] | None = None,
     ) -> None:
         """Stage pages host -> HBM into the given physical page slots
-        (``swa`` targets the SWA ring pool).
+        (``swa`` targets the SWA ring pool; ``layers=(l0, Lg)`` writes
+        only that layer slice of the pool — the v3 group-streamed
+        import's per-cell write, single-host only).
 
         Pads the page count up to a bucket by repeating the last (id, value)
         pair — a duplicate scatter of identical values is idempotent — so
         XLA compiles one scatter program per bucket, not per transfer size.
+
+        Thread-safe on the single-host path: the pool read-modify-write
+        holds the dispatch lock, so streamed-import fetch threads and the
+        engine step thread interleave safely (multi-host already
+        serialized through the lockstep dispatch).
         """
         n = len(page_ids)
         if n == 0:
@@ -2306,6 +2469,7 @@ class ModelRunner:
                 [pages, np.repeat(pages[:, -1:], bucket - n, axis=1)], axis=1
             )
         if self._multihost:
+            assert layers is None, "layer-group staging is single-host only"
             # Lockstep scatter: canonical-head values broadcast to every
             # process (one collective), head expansion (and int8-pool
             # quantization) on device. QK slot = pool selector.
@@ -2321,11 +2485,23 @@ class ModelRunner:
                 self._exec_kv_scatter(arrays, bucket, swa)
             return
         vals = jnp.asarray(np.asarray(pages), dtype=self.staging_dtype)
-        out = self._scatter_canonical(self._pool(swa), jnp.asarray(ids), vals)
-        if swa:
-            self.kv_swa = out
-        else:
-            self.kv_cache = out
+        with self._dispatch_lock:
+            if layers is not None:
+                l0, lg = layers
+                out = self._scatter_canonical_group(
+                    self._pool(swa),
+                    jnp.arange(l0, l0 + lg, dtype=jnp.int32),
+                    jnp.asarray(ids),
+                    vals,
+                )
+            else:
+                out = self._scatter_canonical(
+                    self._pool(swa), jnp.asarray(ids), vals
+                )
+            if swa:
+                self.kv_swa = out
+            else:
+                self.kv_cache = out
 
     # ------------------------------------------------------------------ #
 

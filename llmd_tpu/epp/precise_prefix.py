@@ -202,13 +202,31 @@ class PrecisePrefixCacheScorer(Scorer):
         hashes = req.scratch.get(SCRATCH_BLOCK_HASHES)
         if not hashes:
             return {p.address: 0.0 for p in pods}
-        detailed = self.index.score_detailed(hashes, [p.address for p in pods])
+        # The predicted-latency producer may have walked THIS index for
+        # the same request already (store-aware admission); reuse its
+        # result instead of paying the O(pods x hashes) walk twice per
+        # scheduling pass. Keyed by index identity so a second scorer
+        # over a different index never reuses the wrong walk.
+        cached = req.scratch.get(f"prefix_detailed:{id(self.index)}")
+        if cached is not None and all(p.address in cached for p in pods):
+            detailed = {p.address: cached[p.address] for p in pods}
+        else:
+            detailed = self.index.score_detailed(
+                hashes, [p.address for p in pods]
+            )
         n = len(hashes)
         fracs = req.scratch.setdefault("prefix_match_frac", {})
+        weighted = req.scratch.setdefault("prefix_weighted_frac", {})
         out: dict[str, float] = {}
         for addr, (s, matched) in detailed.items():
             out[addr] = s / n
             fracs[addr] = max(fracs.get(addr, 0.0), matched / n)
+            # Store-aware admission (kv-federation.md): the WEIGHTED
+            # fraction charges a store-fetchable prefix at its tier
+            # weight (default 0.5) — less than a recompute (0), more
+            # than resident (1) — and is what the latency predictor's
+            # prefix feature should see instead of the flat match count.
+            weighted[addr] = max(weighted.get(addr, 0.0), s / n)
         return out
 
     def on_routed(self, req: LLMRequest, pod: Endpoint) -> None:

@@ -52,12 +52,18 @@ def endpoint_features(
 ) -> tuple[list[float], list[float]]:
     """Feature vectors for scheduling ``req`` on ``pod`` right now.
 
-    prefix_match_frac comes from the prefix scorer's scratch when it ran
-    before the producer in the same scheduling pass; otherwise the polled
-    PrefixCacheHitRatio attribute approximates it.
+    The prefix feature prefers the TIER-WEIGHTED fraction
+    (prefix_weighted_frac — store-fetchable blocks charged at the store
+    tier weight, kv-federation.md) over the flat match count, so the
+    latency estimate charges a store-fetchable prefix less than a
+    recompute but more than a resident hit; it falls back to
+    prefix_match_frac, then to the polled PrefixCacheHitRatio attribute.
     """
-    prefix = req.scratch.get("prefix_match_frac", {}).get(
-        pod.address, pod.attr(PREFIX_HIT_RATIO)
+    prefix = req.scratch.get("prefix_weighted_frac", {}).get(
+        pod.address,
+        req.scratch.get("prefix_match_frac", {}).get(
+            pod.address, pod.attr(PREFIX_HIT_RATIO)
+        ),
     )
     tf = ttft_features(
         kv_usage=pod.attr(KV_CACHE_USAGE),
@@ -153,12 +159,48 @@ class PredictorClient:
 
 
 class PredictedLatencyProducer:
-    """DataProducer: annotate req.scratch with per-endpoint predictions."""
+    """DataProducer: annotate req.scratch with per-endpoint predictions.
 
-    def __init__(self, client: PredictorClient | None = None) -> None:
+    ``prefix_index``: the precise-prefix KV-event index, when the
+    deployment runs one. The producer scores the tri-state
+    (resident / store-fetchable / recompute) weighted prefix fraction
+    BEFORE predicting, so the SLO admitter — which runs off these
+    predictions ahead of the scorer phase — charges a store-fetchable
+    prefix less than a recompute (kv-federation.md store-aware
+    admission)."""
+
+    def __init__(
+        self,
+        client: PredictorClient | None = None,
+        prefix_index=None,
+    ) -> None:
         self.client = client or PredictorClient()
+        self.prefix_index = prefix_index
+
+    def _seed_weighted_prefix(
+        self, req: LLMRequest, pods: list[Endpoint]
+    ) -> None:
+        from llmd_tpu.epp.precise_prefix import SCRATCH_BLOCK_HASHES
+
+        hashes = req.scratch.get(SCRATCH_BLOCK_HASHES)
+        if not hashes or self.prefix_index is None:
+            return
+        weighted = req.scratch.setdefault("prefix_weighted_frac", {})
+        fracs = req.scratch.setdefault("prefix_match_frac", {})
+        detailed = self.prefix_index.score_detailed(
+            hashes, [p.address for p in pods]
+        )
+        # Stash the raw walk for the precise scorer (same index, same
+        # request): the scheduling pass pays the O(pods x hashes) index
+        # walk ONCE, not once per plugin.
+        req.scratch[f"prefix_detailed:{id(self.prefix_index)}"] = detailed
+        n = len(hashes)
+        for addr, (s, matched) in detailed.items():
+            weighted[addr] = max(weighted.get(addr, 0.0), s / n)
+            fracs[addr] = max(fracs.get(addr, 0.0), matched / n)
 
     async def produce(self, req: LLMRequest, pods: list[Endpoint]) -> None:
+        self._seed_weighted_prefix(req, pods)
         feats = {p.address: endpoint_features(req, p) for p in pods}
         # One concurrent round trip regardless of pool size (a degraded
         # prediction sidecar must not add N x timeout to the critical path).
@@ -290,9 +332,19 @@ def attach_predicted_latency(
     Adds the PredictedLatencyProducer to the producer phase, its training
     feedback to the completion observers, and a LatencySloAdmitter in front
     of flow control. Returns the producer (its .client owns the predictor).
+
+    When the scheduler also runs a precise-prefix scorer, its KV-event
+    index is handed to the producer so the admitter's latency estimate
+    is tri-state-aware (store-aware admission, kv-federation.md).
     """
+    from llmd_tpu.epp.config import find_plugins
+    from llmd_tpu.epp.precise_prefix import PrecisePrefixCacheScorer
+
+    precise = find_plugins(router.scheduler, PrecisePrefixCacheScorer)
     client = PredictorClient(predict_url=predict_url, train_url=train_url)
-    producer = PredictedLatencyProducer(client)
+    producer = PredictedLatencyProducer(
+        client, prefix_index=precise[0].index if precise else None
+    )
     router.producers.append(producer)
     router.completion_observers.append(producer.on_complete)
     router.admitters.append(LatencySloAdmitter(router.store, slack=slack))

@@ -246,6 +246,49 @@ class KVFederation:
             self.hits += 1
         return page
 
+    def fetch_many(self, hs: list[bytes]) -> dict[bytes, "np.ndarray"]:
+        """Batched fetch-on-miss: every store-held page of a prefix run
+        in ONE store round trip (one master locate + one pipelined
+        kvship pull per owning segment — the group framing of the store
+        leg). Per-page failures (drop, CRC reject, absent) just leave
+        that page out of the result; the caller's chain walk stops at
+        the first gap and recomputes from there."""
+        out: dict[bytes, np.ndarray] = {}
+        if not hs:
+            return out
+        keys = []
+        for h in hs:
+            key = h.hex()
+            # Per-page drop site: a dropped federated pull degrades that
+            # page to recompute exactly like the sequential path.
+            if faults.fires("kv.pull.drop", f"store|{key}"):
+                continue
+            keys.append(key)
+        getter = getattr(self.client, "get_many", None)
+        if getter is None:  # minimal/store-stub clients
+            blobs = {}
+            for key in keys:
+                blob = self.client.get(key)
+                if blob is not None:
+                    blobs[key] = blob
+        else:
+            blobs = getter(keys)
+        for key, blob in blobs.items():
+            if blob is None:
+                continue
+            blob = faults.corrupt("kv.bundle.corrupt", blob, f"store|{key}")
+            try:
+                page = decode_page(blob)
+            except PageDecodeError as e:
+                with self._lock:
+                    self.crc_failures += 1
+                log.warning("federated page %s rejected: %s", key[:16], e)
+                continue
+            with self._lock:
+                self.hits += 1
+            out[bytes.fromhex(key)] = page
+        return out
+
     # ------------------------------------------------------------ misc
 
     def clear_local(self) -> None:

@@ -158,6 +158,91 @@ class ReplicaDied(ConnectionError):
 
 
 @dataclasses.dataclass(frozen=True)
+class PDTransferProfile:
+    """Two-tier P→D disaggregation envelope (kv-cache.md
+    "layer-streamed import"): decode replicas hand every prompt to a
+    shared prefill tier and import the KV over a transfer leg with real
+    latency + bandwidth.
+
+    ``stage_tok_s`` is the producer's HBM→host staging rate,
+    ``transfer_tok_s`` the wire rate, ``transfer_rtt_s`` the per-import
+    fixed cost. ``stream_groups`` models the v3 group-framed wire: the
+    stage and ship legs pipeline per layer group — import time drops
+    from the additive stage+ship to first-group + max(stage, ship) of
+    the remainder — and the decode side becomes schedulable at
+    first-group-resident. ``stream_groups=1`` is the monolithic (v2)
+    baseline. A seeded ``kv.pull.drop`` matching ``pd|...`` mid-stream
+    degrades that import to a full local recompute on the decode
+    replica — slower, never wrong."""
+
+    prefill_replicas: int = 2
+    prefill_tok_s: float = 4914.0 * 4.0
+    stage_tok_s: float = 4914.0 * 24.0
+    transfer_tok_s: float = 4914.0 * 16.0
+    transfer_rtt_s: float = 0.01
+    stream_groups: int = 4
+
+    def import_s(self, tokens: int) -> float:
+        """Virtual seconds one KV import occupies end to end: the
+        stage/ship pipeline over ``stream_groups`` equal layer groups
+        (G=1 degenerates to the additive serial path)."""
+        stage = tokens / self.stage_tok_s
+        ship = tokens / self.transfer_tok_s
+        g = max(1, self.stream_groups)
+        return self.transfer_rtt_s + (stage + ship) / g + (
+            max(stage, ship) * (g - 1) / g
+        )
+
+    def first_group_s(self, tokens: int) -> float:
+        """Seconds until group 0 is resident on the decode side — the
+        admission gate the streamed import opens early."""
+        stage = tokens / self.stage_tok_s
+        ship = tokens / self.transfer_tok_s
+        g = max(1, self.stream_groups)
+        return self.transfer_rtt_s + (stage + ship) / g
+
+
+class SimPrefillTier:
+    """The shared P tier of a disaggregated fleet: FIFO prefill slots
+    (one per prefill replica) each serving at the profile rate. Decode
+    replicas hold a slot for the duration of their prompt's prefill;
+    the tier itself never crashes (the scenario's failure surface is
+    the TRANSFER leg — decode-replica kills are replica_kill's
+    subject)."""
+
+    def __init__(self, profile: PDTransferProfile) -> None:
+        self.profile = profile
+        self._free = max(1, profile.prefill_replicas)
+        self._waiters: collections.deque[asyncio.Future] = (
+            collections.deque()
+        )
+        self.prefills = 0
+        self.prefill_tokens = 0
+
+    async def acquire(self) -> None:
+        if self._free > 0:
+            self._free -= 1
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters.append(fut)
+        await fut  # the releaser transfers its slot
+
+    def release(self) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self._free += 1
+
+    def stats(self) -> dict:
+        return {
+            "prefills": self.prefills,
+            "prefill_tokens": self.prefill_tokens,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
 class LoraPoolProfile:
     """One replica's paged-adapter-pool envelope
     (docs/architecture/multi-tenant-lora.md).
@@ -230,10 +315,20 @@ class SimReplica:
         prefix_cache_groups: int = 8,
         lora: LoraPoolProfile | None = None,
         lora_universe: tuple = (),
+        pd_tier: "SimPrefillTier | None" = None,
     ) -> None:
         self.address = address
         self.profile = profile
         self.variant = variant
+        # Two-tier P→D serving (kv-cache.md): every prompt prefills on
+        # the shared tier and imports KV over the transfer leg; seeded
+        # mid-stream drops degrade that import to local recompute.
+        self.pd_tier = pd_tier
+        self.pd_imports = 0
+        self.pd_drops = 0
+        self.pd_recomputes = 0
+        self.pd_import_s: list[float] = []
+        self.pd_first_group_s: list[float] = []
         # Paged adapter pool (multi-tenant-lora.md): LRU residency over
         # `lora.slots` HBM slots with pin-while-referenced semantics —
         # the stub's whole-adapter stand-in for the engine's
@@ -486,6 +581,48 @@ class SimReplica:
         # deterministic, no hotness bookkeeping in the stub).
         return full_s, prefix_group
 
+    async def _serve_pd_prefill(
+        self, request_id: str, prompt_tokens: int
+    ) -> None:
+        """The disaggregated prefill leg: prompt prefills on the shared
+        P tier, then the KV imports over the transfer leg (the
+        group-streamed stage/ship pipeline — PDTransferProfile). A
+        seeded ``kv.pull.drop`` matching ``pd|<addr>|<rid>|g<G>`` fired
+        against ANY group mid-stream degrades the whole import to a
+        full local recompute on this decode replica: slower, never
+        wrong, never lost."""
+        tier = self.pd_tier
+        pd = tier.profile
+        await tier.acquire()
+        try:
+            # The P tier's compute (FIFO slot per prefill replica).
+            await self._hold(prompt_tokens / pd.prefill_tok_s)
+            tier.prefills += 1
+            tier.prefill_tokens += prompt_tokens
+        finally:
+            tier.release()
+        dropped = any(
+            faults.fires(
+                "kv.pull.drop", f"pd|{self.address}|{request_id}|g{g}"
+            )
+            for g in range(max(1, pd.stream_groups))
+        )
+        if dropped:
+            self.pd_drops += 1
+            self.pd_recomputes += 1
+            self.recompute_fallbacks += 1
+            # Mid-stream failure: the decode side falls back to
+            # prefilling the whole prompt itself at ITS prefill rate.
+            await self._hold(
+                prompt_tokens / self.profile.prefill_tok_s
+            )
+            return
+        import_s = pd.import_s(prompt_tokens)
+        self.pd_imports += 1
+        self.pd_import_s.append(import_s)
+        self.pd_first_group_s.append(pd.first_group_s(prompt_tokens))
+        await self._hold(import_s)
+
     async def serve_batch(
         self, request_id: str, prompt_tokens: int, output_tokens: int
     ):
@@ -580,15 +717,25 @@ class SimReplica:
             # output); a brownout serves every request delay_ms late.
             # A resume leg prefills the delivered history too — that is
             # the replayed-prefix cost the store fetch keeps bounded.
-            prefill_s, publish_group = self._plan_prefill(
-                request_id, prompt_tokens + resume_tokens,
-                prefix_group, prefix_tokens,
-            )
-            if faults.fires("kv.pull.drop", f"{self.address}|{request_id}"):
-                self.recompute_fallbacks += 1
-                prefill_s *= 1.0 + p.recompute_penalty
-            prefill_s += faults.delay_s("replica.brownout", self.address)
-            await self._hold(prefill_s)
+            publish_group = None
+            if self.pd_tier is not None:
+                await self._serve_pd_prefill(
+                    request_id, prompt_tokens + resume_tokens
+                )
+            else:
+                prefill_s, publish_group = self._plan_prefill(
+                    request_id, prompt_tokens + resume_tokens,
+                    prefix_group, prefix_tokens,
+                )
+                if faults.fires(
+                    "kv.pull.drop", f"{self.address}|{request_id}"
+                ):
+                    self.recompute_fallbacks += 1
+                    prefill_s *= 1.0 + p.recompute_penalty
+                prefill_s += faults.delay_s(
+                    "replica.brownout", self.address
+                )
+                await self._hold(prefill_s)
             if prefix_group is not None and self.kv_store is not None:
                 # The prefix pages exist now: they enter the local cache,
                 # and a freshly-computed group earns the fleet its first

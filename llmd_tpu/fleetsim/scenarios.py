@@ -46,6 +46,12 @@ router_soak the REAL epp/server.py aiohttp router over loopback
             production proxy/resume leg, stitched client streams
             byte-identical, zero visible failures. Real I/O — gated on
             content invariants, excluded from the byte-compare.
+pd_transfer two-tier P→D fleet (fleet-soak follow-up (b)): prompts
+            prefill on a shared P tier, KV imports over a transfer leg
+            with real RTT/bandwidth, group-streamed so stage/ship
+            pipeline and decode admits at first-group-resident; seeded
+            kv.pull.drop mid-stream degrades each hit import to local
+            recompute — never lost, never corrupt, byte-deterministic.
 ========== ==========================================================
 
 Trace sizes are chosen so the full matrix runs in CI minutes while the
@@ -62,6 +68,7 @@ from typing import Callable
 from llmd_tpu.fleetsim import scoreboard as sb
 from llmd_tpu.fleetsim.engines import (
     LoraPoolProfile,
+    PDTransferProfile,
     ReplicaProfile,
     StoreProfile,
 )
@@ -472,6 +479,60 @@ def build_lora_tenant(
                     invariants=invariants)
 
 
+def build_pd_transfer(seed: int = 0, qps_scale: float = 1.0) -> FleetSim:
+    # Disaggregated serving under soak (ROADMAP fleet-soak follow-up
+    # (b); kv-cache.md "layer-streamed import"): a two-tier P→D fleet —
+    # every decode replica's prompts prefill on a shared 4-slot P tier
+    # and the KV imports over a transfer leg with real RTT + bandwidth,
+    # group-streamed (stream_groups=4) so the stage/ship legs pipeline
+    # and the decode side admits at first-group-resident. A seeded 1%-
+    # per-group kv.pull.drop (~4% of imports; match "pd|" — the
+    # transfer leg only)
+    # lands mid-stream and MUST degrade each hit import to a full local
+    # recompute: slower, never wrong, never lost. Gates: both pipeline
+    # legs engaged (imports AND recomputes > 0, drops fired), the
+    # streamed admission gate strictly ahead of the full import, p99
+    # TTFT bounded, zero lost, byte-deterministic in the soak matrix.
+    qps = 2_000.0 * qps_scale
+    duration = 2.0
+    n = max(3, round(6 * qps_scale))
+    trace = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+    )
+    # p = 1% per GROUP: with 4 groups ≈ 4% of imports hit a mid-stream
+    # drop — enough to prove the degradation path at every scale
+    # without recompute load dominating the latency gates.
+    plan = {
+        "seed": seed,
+        "faults": [{
+            "site": "kv.pull.drop", "match": "pd|", "p": 0.01,
+            "times": None,
+        }],
+    }
+    cfg = FleetConfig(
+        replicas=n,
+        profile=_PROFILE,
+        pd=PDTransferProfile(
+            # P-tier capacity tracks offered prefill demand (~80%
+            # utilized at every qps_scale), mirroring the decode tier.
+            prefill_replicas=max(2, round(16 * qps_scale)),
+            prefill_tok_s=_PROFILE.prefill_tok_s,
+            stream_groups=4,
+        ),
+        grace_s=90.0,
+    )
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("all_completed", sb.inv_all_completed(1.0)),
+        ("pd_flow", sb.inv_pd_transfer(1, 1)),
+        ("drops_fired", sb.inv_faults_fired("kv.pull.drop", 1)),
+        ("p99_ttft", sb.inv_p99_ttft_ms(800.0)),
+    ]
+    return FleetSim(cfg, trace, fault_plan=plan, seed=seed,
+                    scenario="pd_transfer", invariants=invariants)
+
+
 def build_router_soak(seed: int = 0, qps_scale: float = 1.0):
     # The REAL epp/server.py aiohttp router in-process on the virtual
     # loop (fleetsim.router_soak): loopback sockets, production parser/
@@ -531,6 +592,11 @@ SCENARIOS: dict[str, Scenario] = {
                  "192 Zipf tenants over 32-slot adapter pools: "
                  "residency-affinity routing holds the hit-ratio floor, "
                  "cold loads bounded, pinned slots never evicted"),
+        Scenario("pd_transfer", build_pd_transfer,
+                 "two-tier P→D fleet with a real transfer leg: "
+                 "group-streamed imports pipeline stage/ship, seeded "
+                 "mid-stream drops degrade to recompute, first-group "
+                 "admission strictly ahead of the full import"),
         Scenario("router_soak", build_router_soak,
                  "REAL aiohttp router over loopback on the virtual "
                  "loop: mid-stream kills resume through the production "

@@ -581,6 +581,34 @@ def inv_store_flow(min_published: int = 1, min_hits: int = 1) -> Invariant:
     return check
 
 
+def inv_pd_transfer(
+    min_imports: int = 1, min_recomputes: int = 1
+) -> Invariant:
+    """The two-tier P→D pipeline engaged end to end: prompts imported
+    KV over the transfer leg AND seeded mid-stream drops provably
+    degraded to local recompute (never a lost or corrupt stream — those
+    are gated by zero_lost/parity alongside). Also pins the streamed
+    admission gate: first-group p50 strictly below the full-import p50
+    (with stream_groups > 1 the wire opens the gate early)."""
+    def check(board: dict) -> str | None:
+        pd = board.get("pd_transfer")
+        if pd is None:
+            return "scoreboard carries no pd_transfer section"
+        if pd["imports"] < min_imports:
+            return f"pd imports {pd['imports']} < {min_imports}"
+        if pd["recomputes"] < min_recomputes:
+            return f"pd recomputes {pd['recomputes']} < {min_recomputes}"
+        if pd["stream_groups"] > 1 and not (
+            pd["first_group_p50_ms"] < pd["import_p50_ms"]
+        ):
+            return (
+                f"first-group p50 {pd['first_group_p50_ms']} ms not "
+                f"below import p50 {pd['import_p50_ms']} ms"
+            )
+        return None
+    return check
+
+
 def inv_batch_drained(board: dict) -> str | None:
     """THE backfill bar (docs/architecture/batch-processing.md): every
     queued offline job completed through interactive troughs — nothing

@@ -65,10 +65,12 @@ from llmd_tpu.epp.types import (
 from llmd_tpu.fleetsim import simloop
 from llmd_tpu.fleetsim.engines import (
     LoraPoolProfile,
+    PDTransferProfile,
     ReplicaDied,
     ReplicaProfile,
     ReplicaUnreachable,
     SimKVStore,
+    SimPrefillTier,
     SimReplica,
     StoreProfile,
     expected_stream,
@@ -171,6 +173,13 @@ class FleetConfig:
     # against.
     lora: LoraPoolProfile | None = None
     lora_affinity: bool = True
+    # Disaggregated P→D serving (kv-cache.md "layer-streamed import"):
+    # a PDTransferProfile arms the two-tier shape — every decode
+    # replica's prompts prefill on a shared P tier and the KV imports
+    # over a transfer leg with real latency/bandwidth, group-streamed
+    # per the profile; seeded kv.pull.drop (match "pd|") mid-stream
+    # degrades that import to a full local recompute.
+    pd: "PDTransferProfile | None" = None
 
 
 def default_sim_config(
@@ -335,6 +344,9 @@ class FleetSim:
         self.kv_store = (
             SimKVStore(cfg.kv_store) if cfg.kv_store is not None else None
         )
+        self.pd_tier = (
+            SimPrefillTier(cfg.pd) if cfg.pd is not None else None
+        )
         # Adapter universe: every adapter the trace names, registered
         # ("one fetch away") on every replica — residency is the only
         # routing differentiator, exactly the pool's contract.
@@ -394,6 +406,7 @@ class FleetSim:
             prefix_cache_groups=self.cfg.prefix_cache_groups,
             lora=self.cfg.lora,
             lora_universe=self.adapter_universe,
+            pd_tier=self.pd_tier,
         )
         self.replicas[addr] = rep
         self.store.upsert(Endpoint(
@@ -924,6 +937,28 @@ class FleetSim:
                 "cold_stall_p50_ms": percentile(stalls, 0.50) * 1e3,
                 "cold_stall_p99_ms": percentile(stalls, 0.99) * 1e3,
             }}
+        if self.pd_tier is not None:
+            from llmd_tpu.fleetsim.scoreboard import percentile
+
+            reps = list(self.replicas.values())
+            extra = dict(extra or {})
+            imports = sorted(
+                s for r in reps for s in r.pd_import_s
+            )
+            firsts = sorted(
+                s for r in reps for s in r.pd_first_group_s
+            )
+            extra["pd_transfer"] = {
+                "prefill_tier": self.pd_tier.stats(),
+                "imports": sum(r.pd_imports for r in reps),
+                "drops": sum(r.pd_drops for r in reps),
+                "recomputes": sum(r.pd_recomputes for r in reps),
+                "stream_groups": self.cfg.pd.stream_groups,
+                "import_p50_ms": percentile(imports, 0.50) * 1e3,
+                # The admission gate the streamed wire opens early —
+                # the serial TTFT leg, far under the full import.
+                "first_group_p50_ms": percentile(firsts, 0.50) * 1e3,
+            }
         if self.kv_store is not None:
             reps = list(self.replicas.values())
             extra = dict(extra or {})

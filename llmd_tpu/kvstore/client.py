@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -63,6 +64,18 @@ class CrossSliceStoreClient:
         self.misses = 0
         self.rejected_puts = 0
         self.dropped_publishes = 0
+        self.locate_calls = 0  # master round-trips (batched reads = 1/run)
+        # Publish-budget pacing (kv-federation.md): a bytes/s cap on the
+        # publisher thread so publish-on-evict bursts — which land
+        # exactly when the engine is under memory pressure — cannot
+        # starve the transfer NIC the P/D + store-fetch legs ride.
+        # Token bucket with a one-second burst allowance; 0 = unpaced.
+        self.publish_bytes_per_s = float(
+            os.environ.get("LLMD_KV_PUBLISH_BYTES_PER_S", "0") or 0
+        )
+        self.paced_publish_bytes = 0  # bytes the pacer delayed
+        self._pace_tokens = self.publish_bytes_per_s
+        self._pace_t = time.monotonic()
         # Federation hooks (llmd_tpu/federation/core.py). on_published:
         # called (from the publisher thread) with the key of every
         # publication the master ACCEPTED. on_publish_failed: the
@@ -195,6 +208,25 @@ class CrossSliceStoreClient:
             self.dropped_publishes += 1
             self._publish_failed(key)
 
+    def _pace_publish(self, nbytes: int) -> None:
+        """Publisher-thread token bucket: block until the publish budget
+        (LLMD_KV_PUBLISH_BYTES_PER_S) covers ``nbytes``. Runs ONLY on
+        the publisher thread — the engine thread's put_async never
+        blocks; overflow still just drops (the queue bounds memory, the
+        pacer bounds NIC share)."""
+        rate = self.publish_bytes_per_s
+        if rate <= 0 or nbytes <= 0:
+            return
+        now = time.monotonic()
+        self._pace_tokens = min(
+            rate, self._pace_tokens + (now - self._pace_t) * rate
+        )
+        self._pace_t = now
+        self._pace_tokens -= nbytes
+        if self._pace_tokens < 0:
+            self.paced_publish_bytes += nbytes
+            time.sleep(-self._pace_tokens / rate)
+
     def put(self, key: str, data) -> bool:
         """Publish an object: bytes into the local kvship server, metadata
         to the master. First copy wins cluster-wide; redundant copies are
@@ -208,6 +240,7 @@ class CrossSliceStoreClient:
         if not self._registered:
             self._publish_failed(key)
             return False
+        self._pace_publish(len(data))
         try:
             self.server.register(key, data, lease_ms=_OBJECT_LEASE_MS)
             reply = self._call("/v1/objects/put", {
@@ -231,11 +264,59 @@ class CrossSliceStoreClient:
             return False
 
     def locate(self, keys: list[str]) -> dict[str, dict]:
+        self.locate_calls += 1
         try:
             return self._call("/v1/objects/locate", {"keys": keys})["found"]
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             log.debug("kvstore locate failed: %s", e)
             return {}
+
+    def get_many(self, keys: list[str]) -> dict[str, bytes]:
+        """Batched read: ONE master locate for every key, then one
+        pipelined kvship pull per owning segment (shipper.pull_many) —
+        a whole prefix run's store fetch costs one locate + one
+        connection per owner instead of a locate + connect per page.
+        Absent/failed keys are simply missing from the result (the
+        caller's recompute policy is the degradation, as ever)."""
+        out: dict[str, bytes] = {}
+        if not keys:
+            return out
+        now = time.monotonic()
+        if now < self._read_down_until:
+            self.misses += len(keys)
+            return out
+        t0 = now
+        loc = self.locate(keys)
+        by_owner: dict[str, list[str]] = {}
+        for key in keys:
+            entry = loc.get(key)
+            if entry is None:
+                self.misses += 1
+                continue
+            by_owner.setdefault(entry["address"], []).append(key)
+        if not by_owner:
+            if time.monotonic() - t0 > self.timeout_s / 2:
+                self._read_down_until = (
+                    time.monotonic() + self._read_cooldown_s
+                )
+            return out
+        for addr, owner_keys in by_owner.items():
+            host, _, port = addr.rpartition(":")
+            try:
+                got = shipper_mod.pull_many(host, int(port), owner_keys)
+            except (shipper_mod.PullError, OSError) as e:
+                self.pull_failures += len(owner_keys)
+                self._read_down_until = (
+                    time.monotonic() + self._read_cooldown_s
+                )
+                log.debug(
+                    "kvstore batched pull from %s failed: %s", addr, e
+                )
+                continue
+            self.pulls += len(got)
+            self.misses += len(owner_keys) - len(got)
+            out.update(got)
+        return out
 
     def get(self, key: str) -> bytes | None:
         """Pull one object's bytes from whichever segment holds it.
@@ -293,6 +374,8 @@ class CrossSliceStoreClient:
             "misses": self.misses,
             "rejected_puts": self.rejected_puts,
             "dropped_publishes": self.dropped_publishes,
+            "locate_calls": self.locate_calls,
+            "paced_publish_bytes": self.paced_publish_bytes,
         }
 
     def close(self) -> None:

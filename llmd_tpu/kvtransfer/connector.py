@@ -54,6 +54,14 @@ _MAGIC = b"KVPG"
 # payload for q8, payload for exact) right after the name; version-1
 # bundles (no CRC) still parse — header-versioned compatibility.
 _CRC = struct.Struct("<I")
+# Version 3 (the group-framed stream wire): between the dtype name and
+# the CRC rides a group extension — (group_idx, num_groups, layer0) —
+# and the header's L field carries the layers in THIS group only. The
+# CRC coverage is unchanged (everything after it: scales + payload).
+# Readers here accept v1/v2/v3; the LLMD_KV_STREAM_COMPAT_V2 pin keeps
+# producers on the v2 monolithic-layer framing for reader-first rolling
+# deploys (same discipline as LLMD_KV_BUNDLE_COMPAT_V1).
+_GRP = struct.Struct("<HHH")
 
 
 @dataclasses.dataclass
@@ -91,6 +99,18 @@ class KVTransferConfig:
     # contends with the consumer's decode steps for pure waste. Remote
     # consumers pay at most this delay on a multi-second staging path.
     local_claim_grace_ms: int = 100
+    # Layer-streamed transfer (the v3 group-framed wire): exports split
+    # into this many contiguous layer groups, staged and shipped
+    # group-major so the consumer's import pipelines per group —
+    # fetch -> CRC -> scatter of group g overlaps the wire transfer of
+    # g+1, pages are batch-allocated once up front, and the decode-side
+    # request becomes schedulable as soon as group 0 is resident
+    # (docs/architecture/kv-cache.md "layer-streamed import"). Clamped
+    # to the model's layer count; 1 (or the LLMD_KV_STREAM_COMPAT_V2 /
+    # LLMD_KV_BUNDLE_COMPAT_V1 pins, or a multi-host runner — its
+    # lockstep gather stays monolithic) disables grouping and restores
+    # the v2 chunk framing byte-for-byte.
+    stream_groups: int = 4
 
     @property
     def is_producer(self) -> bool:
@@ -188,6 +208,30 @@ def chunk_key(key: str, j: int) -> str:
     return f"{key}:c{j}"
 
 
+def group_key(key: str, g: int, j: int) -> str:
+    """Shipper key of one (layer-group, page-chunk) CELL of a v3
+    group-framed export. Group-major registration order (g0c0, g0c1, ...,
+    g1c0, ...) is the streaming contract: the consumer pulls in the same
+    order and becomes schedulable once group 0 is resident."""
+    return f"{key}:g{g}:c{j}"
+
+
+def layer_groups(num_layers: int, groups: int) -> list[tuple[int, int]]:
+    """The (layer0, n_layers) split of ``num_layers`` into ``groups``
+    contiguous groups — derived IDENTICALLY by producer and consumer
+    from (L, num_groups) alone, so the wire never has to carry a layer
+    map. Uneven splits front-load the remainder (first groups one layer
+    larger), keeping group 0 — the admission gate — never the runt."""
+    groups = max(1, min(groups, num_layers))
+    base, rem = divmod(num_layers, groups)
+    out, l0 = [], 0
+    for g in range(groups):
+        lg = base + (1 if g < rem else 0)
+        out.append((l0, lg))
+        l0 += lg
+    return out
+
+
 def swa_key(key: str) -> str:
     """Shipper key of a ring export's sliding-layer section (the trailing
     in-window ring pages a kv_swa_ring producer ships alongside the
@@ -197,11 +241,20 @@ def swa_key(key: str) -> str:
 
 def transfer_keys(params: dict) -> list[str]:
     """Every shipper key a transfer's lease heartbeat must renew (chunked
-    exports register one key per chunk; legacy bundles just one; ring
-    exports add the sliding-layer section)."""
+    exports register one key per chunk; group-framed exports one per
+    (layer-group, chunk) cell; legacy bundles just one; ring exports add
+    the sliding-layer section)."""
     key = params.get("remote_key", "")
     n = int(params.get("num_chunks", 0) or 0)
-    keys = [key] if n <= 0 else [chunk_key(key, j) for j in range(n)]
+    ng = int(params.get("num_groups", 0) or 0)
+    if n <= 0:
+        keys = [key]
+    elif ng > 1:
+        keys = [
+            group_key(key, g, j) for g in range(ng) for j in range(n)
+        ]
+    else:
+        keys = [chunk_key(key, j) for j in range(n)]
     if int(params.get("swa_pages", 0) or 0) > 0:
         keys.append(swa_key(key))
     return keys
@@ -218,7 +271,11 @@ def payload_crc(*parts) -> int:
     return crc
 
 
-def pack_header(pages: np.ndarray, crc: int | None = None) -> bytes:
+def pack_header(
+    pages: np.ndarray,
+    crc: int | None = None,
+    group: tuple[int, int, int] | None = None,
+) -> bytes:
     """Bundle header for a [L, n, K, page, 2D] page array.
 
     The dtype travels by NAME ('bfloat16', 'float32', ...): extension
@@ -229,9 +286,19 @@ def pack_header(pages: np.ndarray, crc: int | None = None) -> bytes:
     With ``crc`` (CRC32 of the payload bytes) the header is version 2 and
     importers verify it; without, a version-1 header (legacy producers,
     or every producer under the ``LLMD_KV_BUNDLE_COMPAT_V1`` rollout
-    pin — see ``_COMPAT_V1``)."""
+    pin — see ``_COMPAT_V1``). ``group=(g, num_groups, layer0)`` makes a
+    version-3 group-framed header: the L dim is this group's layer count
+    and the group extension rides between the name and the CRC."""
     dt = pages.dtype.name.encode()
     L, n, K, page, inner = pages.shape
+    if group is not None:
+        assert crc is not None, "group-framed headers always carry a CRC"
+        return (
+            _HDR.pack(_MAGIC, 3, len(dt), L, n, K, page, inner)
+            + dt
+            + _GRP.pack(*group)
+            + _CRC.pack(crc)
+        )
     if crc is None or _COMPAT_V1:
         return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
     return (
@@ -253,17 +320,38 @@ _Q8_PREFIX = "int8q:"
 # wire format (no CRC) for the transition window.
 _COMPAT_V1 = os.environ.get("LLMD_KV_BUNDLE_COMPAT_V1", "0") not in ("", "0")
 
+# Same reader-first discipline for the v3 group-framed stream wire: a
+# not-yet-upgraded consumer knows nothing of group keys and would time
+# out pulling `key:c0` from a streaming producer (degrading every
+# transfer to recompute). LLMD_KV_STREAM_COMPAT_V2=1 pins producers to
+# the v2 monolithic-layer chunk framing until every consumer is
+# upgraded; the v1 pin implies it (v1 has no CRC, v3 requires one).
+_COMPAT_V2 = os.environ.get("LLMD_KV_STREAM_COMPAT_V2", "0") not in ("", "0")
+
 
 def pack_header_q8(
-    q8: np.ndarray, orig_dtype_name: str, crc: int | None = None
+    q8: np.ndarray,
+    orig_dtype_name: str,
+    crc: int | None = None,
+    group: tuple[int, int, int] | None = None,
 ) -> bytes:
     """Header for an int8-quantized bundle: dtype travels as
     'int8q:<original>'; the f16 scales block follows the header (same
     register call), and its size is derivable from the dims. A version-2
     ``crc`` covers scales + payload (everything after the name); the
-    ``LLMD_KV_BUNDLE_COMPAT_V1`` rollout pin downgrades to version 1."""
+    ``LLMD_KV_BUNDLE_COMPAT_V1`` rollout pin downgrades to version 1.
+    ``group`` makes a version-3 group-framed header (see
+    :func:`pack_header`)."""
     dt = (_Q8_PREFIX + orig_dtype_name).encode()
     L, n, K, page, inner = q8.shape
+    if group is not None:
+        assert crc is not None, "group-framed headers always carry a CRC"
+        return (
+            _HDR.pack(_MAGIC, 3, len(dt), L, n, K, page, inner)
+            + dt
+            + _GRP.pack(*group)
+            + _CRC.pack(crc)
+        )
     if crc is None or _COMPAT_V1:
         return _HDR.pack(_MAGIC, 1, len(dt), L, n, K, page, inner) + dt
     return (
@@ -274,11 +362,14 @@ def pack_header_q8(
 
 
 def _payload_offset(blob: bytes, ver: int, dlen: int) -> int:
-    """Start of the post-name wire bytes; version 2 verifies the CRC
-    riding between the name and the payload before anything decodes."""
+    """Start of the post-name wire bytes; versions 2+ verify the CRC
+    riding between the name (and, v3, the group extension) and the
+    payload before anything decodes."""
     off = _HDR.size + dlen
     if ver < 2:
         return off
+    if ver >= 3:
+        off += _GRP.size
     (want,) = _CRC.unpack_from(blob, off)
     off += _CRC.size
     got = zlib.crc32(memoryview(blob)[off:])
@@ -290,11 +381,24 @@ def _payload_offset(blob: bytes, ver: int, dlen: int) -> int:
     return off
 
 
+def bundle_group_info(blob: bytes) -> tuple[int, int, int]:
+    """(group_idx, num_groups, layer0) of a wire blob — (0, 1, 0) for
+    the pre-v3 monolithic-layer forms."""
+    magic, ver, dlen, *_rest = _HDR.unpack_from(blob, 0)
+    if magic != _MAGIC:
+        raise PullError("bad KV bundle header")
+    if ver < 3:
+        return (0, 1, 0)
+    return _GRP.unpack_from(blob, _HDR.size + dlen)
+
+
 def unpack_pages_any(blob: bytes):
     """Decode either wire form. Returns ("exact", pages) or
-    ("q8", q8, scales_f16, orig_dtype_name)."""
+    ("q8", q8, scales_f16, orig_dtype_name). v3 group-framed cells
+    decode the same way (their L dim is the group's layer count; use
+    :func:`bundle_group_info` for the framing)."""
     magic, ver, dlen, L, n, K, page, inner = _HDR.unpack_from(blob, 0)
-    if magic != _MAGIC or ver not in (1, 2):
+    if magic != _MAGIC or ver not in (1, 2, 3):
         raise PullError("bad KV bundle header")
     name = blob[_HDR.size : _HDR.size + dlen].decode()
     if not name.startswith(_Q8_PREFIX):
@@ -320,7 +424,7 @@ def pack_pages(pages: np.ndarray) -> bytes:
 
 def unpack_pages(blob: bytes) -> np.ndarray:
     magic, ver, dlen, L, n, K, page, inner = _HDR.unpack_from(blob, 0)
-    if magic != _MAGIC or ver not in (1, 2):
+    if magic != _MAGIC or ver not in (1, 2, 3):
         raise PullError("bad KV bundle header")
     off = _payload_offset(blob, ver, dlen)
     dt = np.dtype(blob[_HDR.size : _HDR.size + dlen].decode())
@@ -365,6 +469,87 @@ def _lookup_local(host: str, port: int) -> "TPUConnector | None":
     if host in _LOCAL_HOSTS or host == conn.cfg.host:
         return conn
     return None
+
+
+class KVStreamHandle:
+    """Progress of one in-flight group-streamed import (consumer side).
+
+    The serving layer submits the request to the engine as soon as
+    :attr:`first_group` fires (the admission seam: a request whose KV is
+    group-streaming is schedulable once its first layer group is
+    resident); the engine parks it and finalizes — apply on success,
+    recompute on failure — when :attr:`done` fires. Exactly one of
+    take()/abandon() disposes of the fetched bundle: take() hands it to
+    the engine's apply, abandon() (request aborted / serving layer died)
+    releases it, whichever side loses the race.
+    """
+
+    def __init__(self, connector: "TPUConnector", params: dict) -> None:
+        self.connector = connector
+        self.params = params
+        self.first_group = threading.Event()
+        self.done = threading.Event()
+        self._lock = threading.Lock()
+        self._bundle: "PulledBundle | None" = None  # llmd: guarded_by(_lock)
+        self._abandoned = False  # llmd: guarded_by(_lock)
+        self.error: str | None = None
+        self.t0 = time.monotonic()
+        self.first_group_ms = 0.0
+        # Optional admission signal for async serving layers: assigned
+        # BEFORE the fetch is submitted (never mutated after), invoked
+        # once from the fetch thread at first-group time — so the event
+        # loop can await an asyncio.Event instead of parking an executor
+        # thread on wait_admittable for the whole wire transfer.
+        self.on_first_group = None
+
+    def mark_first_group(self) -> None:
+        if not self.first_group.is_set():
+            self.first_group_ms = (time.monotonic() - self.t0) * 1e3
+            self.first_group.set()
+            cb = self.on_first_group
+            if cb is not None:
+                try:
+                    cb()
+                except RuntimeError:
+                    pass  # event loop already closed (shutdown race)
+
+    def resolve(self, bundle: "PulledBundle") -> None:
+        """Fetch-thread success: publish the bundle (or release it if
+        the request was abandoned while the stream was in flight)."""
+        release = None
+        with self._lock:
+            if self._abandoned:
+                release = bundle
+            else:
+                self._bundle = bundle
+        self.mark_first_group()
+        self.done.set()
+        if release is not None:
+            self.connector.release_bundle(release)
+
+    def fail(self, error: str) -> None:
+        """Fetch-thread failure: the parked request degrades to local
+        recompute (policy='recompute') — waiters wake either way."""
+        self.error = error
+        self.mark_first_group()
+        self.done.set()
+
+    def take(self) -> "PulledBundle | None":
+        with self._lock:
+            bundle, self._bundle = self._bundle, None
+            return bundle
+
+    def abandon(self) -> None:
+        with self._lock:
+            self._abandoned = True
+            bundle, self._bundle = self._bundle, None
+        if bundle is not None:
+            self.connector.release_bundle(bundle)
+
+    def wait_admittable(self, timeout: float | None = None) -> bool:
+        """Block (executor thread) until the import is admittable —
+        first group resident, or resolved either way."""
+        return self.first_group.wait(timeout)
 
 
 # Bundle lifecycle (static-analysis.md): a fetched bundle stages pages
@@ -454,7 +639,16 @@ class TPUConnector:
         self.imported_bytes = 0
         self.import_failures = 0
         self.local_imports = 0  # transfers served by the in-process path
-        self.stream_imports = 0  # multi-host pipelined (streamed) imports
+        self.stream_imports = 0  # pipelined (streamed) imports
+        # v3 group-framed stream: cells (layer-group x chunk) landed on
+        # the consumer + the last import/export's first-group latency
+        # (the admission-gate leg of the pipeline waterfall).
+        self.stream_groups_total = 0  # llmd: guarded_by(_local_lock)
+        self.last_first_group_ms = 0.0
+        # Milestone timestamps (monotonic) of the LAST import — the
+        # bench waterfall telescopes over these, so the per-stage splits
+        # provably sum to the measured total.
+        self.last_timeline: dict[str, float] = {}
         # Failure trails (the SLO layer's view of degradation): every
         # swallowed transfer failure lands in transfer_failures keyed by
         # (stage, policy applied); CRC rejections and recompute
@@ -473,6 +667,35 @@ class TPUConnector:
         self.last_stage_ms = 0.0   # producer: HBM->host downloads + register
         self.last_fetch_ms = 0.0   # consumer: pull-wait + device uploads
         self.last_apply_ms = 0.0   # consumer: device->pool scatters + commit
+
+    # ------------------------------------------------------------------ #
+    # layer-group plan (shared by both roles)
+
+    @property
+    def _pool_layers(self) -> int:
+        """Layer count of the runner's FULL-ATTENTION pool (the unit the
+        transfer moves; ring engines ship sliding layers separately)."""
+        spec = getattr(self.runner, "swa", None)
+        if spec is not None:
+            return len(spec.full_layers)
+        return self.runner.cfg.num_layers
+
+    def _group_plan(self, n_groups: int | None = None) -> list[tuple[int, int]]:
+        """The (layer0, n_layers) split this connector stages/imports.
+
+        Producer: from its own config (the compat pins and multi-host —
+        whose lockstep gather is monolithic — force a single group).
+        Consumer: pass the producer-declared ``num_groups``; both sides
+        derive the identical split from (L, num_groups) alone."""
+        if n_groups is None:
+            n_groups = self.cfg.stream_groups
+            if (
+                _COMPAT_V1
+                or _COMPAT_V2
+                or getattr(self.runner, "_multihost", False)
+            ):
+                n_groups = 1
+        return layer_groups(self._pool_layers, max(1, n_groups))
 
     # ------------------------------------------------------------------ #
     # producer side
@@ -564,8 +787,22 @@ class TPUConnector:
             if use_q8
             else self.runner.snapshot_pages_device
         )
-        snaps = [
-            snap_fn(ids[j * cp : (j + 1) * cp], cp) for j in range(n_chunks)
+        # v3 layer-group framing: one snapshot CELL per (group, chunk),
+        # enqueued GROUP-MAJOR so the staging thread registers group 0
+        # across all pages first — the consumer's admission gate. A
+        # single-group plan degrades to the v2 chunk framing exactly.
+        plan = self._group_plan()
+        n_groups = len(plan)
+        cells = [
+            (
+                g, l0, lg, j,
+                snap_fn(
+                    ids[j * cp : (j + 1) * cp], cp,
+                    layers=(l0, lg) if n_groups > 1 else None,
+                ),
+            )
+            for g, (l0, lg) in enumerate(plan)
+            for j in range(n_chunks)
         ]
         # Ring engines (kv_swa_ring) ship a sliding-layer SECTION: the
         # trailing ring pages covering the window before the consumer's
@@ -597,7 +834,7 @@ class TPUConnector:
                 swa_snap = self.runner.snapshot_swa_pages_device(
                     ring_ids, swa_n
                 )
-        if snaps and self._local_enabled:
+        if cells and self._local_enabled:
             # Short retention: a legit in-process claim follows the
             # prefill response within milliseconds; a CROSS-host consumer
             # never claims, so pinning device snapshots for the full
@@ -605,15 +842,17 @@ class TPUConnector:
             deadline = time.monotonic() + min(self.cfg.lease_ms / 1e3, 5.0)
             with self._local_lock:
                 self._prune_local_locked()
-                self._local_exports[key] = (deadline, snaps, swa_snap)
-        if snaps or swa_snap is not None:
+                self._local_exports[key] = (
+                    deadline, cells, swa_snap, n_groups
+                )
+        if cells or swa_snap is not None:
             threading.Thread(
                 target=self._stage_chunks,
-                args=(key, snaps, swa_snap, adaptive_stage),
+                args=(key, cells, swa_snap, adaptive_stage, n_groups),
                 daemon=True,
             ).start()
         self.exported_requests += 1
-        return {
+        params_out = {
             "remote_host": self.cfg.host,
             "remote_port": self.server.port,
             "remote_key": key,
@@ -629,6 +868,11 @@ class TPUConnector:
             "swa_pages": swa_n,
             "swa_start_page": swa_s0,
         }
+        if n_groups > 1:
+            # v3 group-framed stream: the consumer derives the identical
+            # layer split from (its own L, num_groups) via layer_groups.
+            params_out["num_groups"] = n_groups
+        return params_out
 
     # Cross-host consumers never claim; cap retained pending exports so a
     # remote-only traffic burst bounds HBM at ~N snapshots until pruning.
@@ -647,9 +891,9 @@ class TPUConnector:
         """In-process consumer leg of the single-host fast path: take the
         pending device snapshots for ``key`` (stops any remaining host
         staging; already-registered chunks are freed by the consumer's
-        ordinary free-notify). Returns (chunk snaps, swa snap or None).
-        Entries live until claimed, expiry (5s), or the pending cap
-        evicts them."""
+        ordinary free-notify). Returns (snapshot cells, swa snap or
+        None, num_groups). Entries live until claimed, expiry (5s), or
+        the pending cap evicts them."""
         with self._local_lock:
             self._prune_local_locked()
             entry = self._local_exports.pop(key, None)
@@ -659,20 +903,28 @@ class TPUConnector:
                 # already-finished key would leak the entry forever.
                 self._local_claimed.add(key)
             self._local_cond.notify_all()
-        return None if entry is None else (entry[1], entry[2])
+        return None if entry is None else (entry[1], entry[2], entry[3])
 
     def _stage_chunks(
-        self, key: str, snaps: list, swa_snap=None,
-        adaptive_stage: bool = False,
+        self, key: str, cells: list, swa_snap=None,
+        adaptive_stage: bool = False, n_groups: int = 1,
     ) -> None:
-        """Staging thread: download each snapshot and register it. A failed
-        download leaves later chunks unregistered; the consumer's pull wait
-        times out and its load-failure policy decides. The sliding-layer
-        section (tiny: <= a window's worth of ring pages) registers FIRST
-        so a ring consumer's final pull never waits on the big chunks.
+        """Staging thread: download each snapshot cell and register it.
+        A failed download leaves later cells unregistered; the consumer's
+        pull wait times out and its load-failure policy decides. The
+        sliding-layer section (tiny: <= a window's worth of ring pages)
+        registers FIRST so a ring consumer's final pull never waits on
+        the big chunks.
+
+        ``cells`` are (group, layer0, n_layers, chunk, snapshot) tuples
+        in GROUP-MAJOR order; with ``n_groups > 1`` each registers under
+        its group key with a v3 group-framed header (the consumer's
+        import pipeline starts at group 0), otherwise under the legacy
+        chunk key with the v2 frame — byte-identical to the pre-stream
+        wire.
 
         ``adaptive_stage``: snapshots are exact; this leg decides the
-        wire encoding per chunk, quantizing ON DEVICE when the measured
+        wire encoding per cell, quantizing ON DEVICE when the measured
         link favors q8 — so local claims stay lossless while remote
         pulls keep the adaptive race."""
         t0 = time.monotonic()
@@ -718,7 +970,7 @@ class TPUConnector:
                 with self._local_lock:
                     self.exported_bytes += payload.nbytes
             staging_itemsize = np.dtype(self.runner.staging_dtype).itemsize
-            for j, snap in enumerate(snaps):
+            for g, _l0, _lg, j, snap in cells:
                 # llmd: allow(concurrency) -- intentional lock-free peek: a claim landing mid-check only costs one extra chunk download (benign, bounded by the lease); taking the lock per chunk would serialize staging against the claim path
                 if key in self._local_claimed:
                     # An in-process consumer took the device path; the
@@ -735,6 +987,7 @@ class TPUConnector:
 
                         snap = _quantize_rows_q8(snap)
                 is_q8 = isinstance(snap, tuple)
+                grp = (g, n_groups, _l0) if n_groups > 1 else None
                 if is_q8:  # int8 transfer: (q8, scales)
                     q8, scales = (self.runner.download_pages(s) for s in snap)
                     orig = self.runner.staging_dtype_name
@@ -743,7 +996,8 @@ class TPUConnector:
                     scales_b = scales.tobytes()
                     header = (
                         pack_header_q8(
-                            q8, orig, crc=payload_crc(scales_b, q8)
+                            q8, orig, crc=payload_crc(scales_b, q8),
+                            group=grp,
                         )
                         + scales_b
                     )
@@ -758,11 +1012,24 @@ class TPUConnector:
                         pages if pages.dtype.isbuiltin == 1
                         else pages.view(np.uint8)
                     )
-                    header = pack_header(pages, crc=payload_crc(payload))
+                    header = pack_header(
+                        pages, crc=payload_crc(payload), group=grp
+                    )
                     orig_bytes = payload.nbytes
-                self.server.register(
-                    chunk_key(key, j), payload, self.cfg.lease_ms, header=header
+                cell_key = (
+                    group_key(key, g, j) if n_groups > 1
+                    else chunk_key(key, j)
                 )
+                self.server.register(
+                    cell_key, payload, self.cfg.lease_ms, header=header
+                )
+                if g == 0 and j == (len(cells) // n_groups) - 1:
+                    # Group 0 fully shipped: the consumer's admission
+                    # gate opens here — the producer-side half of the
+                    # first-group latency.
+                    self.last_first_group_ms = (
+                        (time.monotonic() - t0) * 1e3
+                    )
                 self._observe_encoding(
                     is_q8, orig_bytes, time.monotonic() - t_chunk
                 )
@@ -792,16 +1059,32 @@ class TPUConnector:
     def wants_import(self, params: dict | None) -> bool:
         return bool(self.cfg.is_consumer and params and params.get("remote_host"))
 
-    def fetch_remote(self, prompt_token_ids: list[int], params: dict) -> PulledBundle:
-        """Network half of an import: pull + validate + upload to device
-        scratch.
+    def fetch_remote(
+        self,
+        prompt_token_ids: list[int],
+        params: dict,
+        handle: "KVStreamHandle | None" = None,
+    ) -> PulledBundle:
+        """Network half of an import: pull + validate + land on device.
 
-        Thread-safe (creates independent device arrays, touches no engine
-        state) — the async serving layer runs it on an executor so a slow
-        producer never head-of-line-blocks the engine step thread. Chunked
-        exports pipeline: chunk j's (async) device upload overlaps the
-        pull of chunk j+1 AND the producer's remaining HBM -> host
-        downloads (pull_wait blocks until the producer registers each).
+        Thread-safe (device writes ride the runner's dispatch lock,
+        independent arrays otherwise) — the async serving layer runs it
+        on an executor so a slow producer never head-of-line-blocks the
+        engine step thread. Chunked exports pipeline: chunk j's (async)
+        device upload overlaps the pull of chunk j+1 AND the producer's
+        remaining HBM -> host downloads (pull_wait blocks until the
+        producer registers each).
+
+        v3 group-framed exports (params["num_groups"] > 1) STREAM:
+        pool pages are batch-allocated once up front, each
+        (layer-group, chunk) cell is pulled, CRC-checked, and scattered
+        straight into the pool on THIS thread while later cells are
+        still on the wire, and ``handle`` (when given) is signalled as
+        soon as group 0 is resident — the engine's admission gate. The
+        returned bundle then only commits hashes at apply. Allocation
+        pressure (or a ring/multi-host consumer) degrades to the
+        buffered path: cells are reassembled into full-layer chunks and
+        applied exactly like a v2 import.
         """
         page = self.allocator.page_size
         if params.get("page_size") != page:
@@ -880,29 +1163,49 @@ class TPUConnector:
                 f"chunk geometry mismatch: {n_full - sp} pages / {cp} per "
                 f"chunk != {n_chunks} chunks"
             )
+        n_groups = int(params.get("num_groups", 1) or 1)
+        grouped = n_groups > 1
+        multihost = getattr(self.runner, "_multihost", False)
+        self.last_timeline = {"fetch_start": time.monotonic()}
         # Single-host xPyD fast path: an in-process producer's device
         # snapshots are claimed directly — no host staging, no wire
         # bytes (production shape: reference single-host/pd recipes; on
         # a multi-chip host this is the ICI copy).
-        all_keys = [chunk_key(key, j) for j in range(n_chunks)]
-        if n_swa:
-            all_keys.append(swa_key(key))
-        if self.cfg.local_fastpath and not getattr(self.runner, "_multihost", False):
+        all_keys = transfer_keys(params)
+        if self.cfg.local_fastpath and not multihost:
             producer = _lookup_local(host, port)
             if producer is not None:
                 claimed = producer.claim_local(key)
                 if claimed is not None:
-                    snaps, swa_snap = claimed
+                    cells, swa_snap, _ng = claimed
                     if ring_mode and swa_snap is None:
                         raise ValueError(
                             "local claim carried no sliding-layer snapshot"
                         )
                     self.local_imports += 1
+                    if grouped and not ring_mode:
+                        # Group-streamed local claim: scatter every cell
+                        # into batch-allocated pool pages NOW (device-to-
+                        # device copies on this thread); apply is just
+                        # the hash-chain commit. Allocation pressure
+                        # degrades to the apply-side scatter below.
+                        bundle = self._claim_streamed(
+                            cells, hashes, n_full, sp, cp,
+                            host, port, key, all_keys, handle,
+                        )
+                        if bundle is not None:
+                            return bundle
+                    dev_cells = (
+                        [(j, l0, lg, snap) for _g, l0, lg, j, snap in cells]
+                        if grouped
+                        else [snap for _g, _l0, _lg, _j, snap in cells]
+                    )
                     return PulledBundle(
                         pages=None, hashes=hashes[:n_full], nbytes=0,
                         host=host, port=port, key=key,
                         keys=all_keys,
-                        device_chunks=snaps, np_chunks=[], chunk_pages=cp,
+                        device_chunks=dev_cells, np_chunks=[],
+                        chunk_pages=cp,
                         start_page=sp,
                         swa_device=swa_snap if ring_mode else None,
                         swa_start_page=swa_sp, swa_count=n_swa,
@@ -915,6 +1218,15 @@ class TPUConnector:
             skip0 += 1
         j0 = max(0, (skip0 - sp) // cp) if skip0 > sp else 0
         start_page = sp + j0 * cp
+        if grouped:
+            # v3 group-framed wire: per-cell pull -> CRC -> scatter
+            # pipeline (single-host streams into batch-allocated pages;
+            # ring/multi-host consumers reassemble full-layer chunks).
+            return self._fetch_grouped_wire(
+                params, hashes, n_full, sp, cp, n_chunks, j0, n_groups,
+                host, port, key, all_keys, ring_mode, n_swa, swa_sp,
+                want_dtype, pool_quant, handle,
+            )
         # Multi-host consumer: process-local device-scratch uploads
         # cannot feed the lockstep global-mesh scatter, so the
         # device_chunks pipeline stays single-host. The multi-host
@@ -923,7 +1235,6 @@ class TPUConnector:
         # its pull lands — the runner's dispatch lock interleaves these
         # ops safely with the engine's steps, so the wire pulls overlap
         # the DCN broadcast + device scatter legs chunk by chunk.
-        multihost = getattr(self.runner, "_multihost", False)
         pipelined = not multihost
         stream_ids: list[int] | None = None
         if multihost and not ring_mode:
@@ -1054,17 +1365,257 @@ class TPUConnector:
             swa_pages_np=swa_np, swa_start_page=swa_sp, swa_count=n_swa,
         )
 
+    def _note_first_group(self, handle: "KVStreamHandle | None") -> None:
+        """Group 0 is resident: stamp the admission-gate milestone and
+        wake the serving layer's admittable-waiter."""
+        now = time.monotonic()
+        self.last_timeline.setdefault("first_group", now)
+        t0 = self.last_timeline.get("fetch_start", now)
+        self.last_first_group_ms = (now - t0) * 1e3
+        if handle is not None:
+            handle.mark_first_group()
+
+    # llmd: transfers(pages)
+    def _stream_alloc(self, need: int) -> list[int] | None:
+        """Batch page allocation for a streamed import — ONCE up front,
+        never per chunk. Reserved for the whole wire transfer, so only
+        with decode headroom left over (floor) and never more than a
+        quarter of the pool; None = take the buffered path instead.
+        Callers own the returned ids (they ride into the bundle's
+        stream_ids, whose apply/release frees them)."""
+        from llmd_tpu.engine.kv_cache import NoFreePagesError
+
+        if need <= 0:
+            return []
+        headroom = max(self.allocator.num_pages // 8, 16)
+        if need > self.allocator.num_pages // 4:
+            return None
+        try:
+            return self.allocator.allocate_with_floor(need, headroom)
+        except NoFreePagesError:
+            return None  # buffered fallback under pressure
+
+    def _claim_streamed(
+        self, cells, hashes, n_full, sp, cp,
+        host, port, key, all_keys, handle,
+    ) -> "PulledBundle | None":
+        """Group-streamed LOCAL claim: scatter every claimed device cell
+        into batch-allocated pool pages on the fetch thread (device-to-
+        device copies under the dispatch lock), so apply is just the
+        hash-chain commit. None = allocation pressure; the caller falls
+        back to apply-side scatters."""
+        stream_ids = self._stream_alloc(n_full - sp)
+        if stream_ids is None:
+            return None
+        n_chunks = (
+            max(j for _g, _l0, _lg, j, _s in cells) + 1 if cells else 0
+        )
+        try:
+            for g, l0, lg, j, snap in cells:
+                o0 = j * cp
+                ids_j = _pad_chunk_ids(stream_ids[o0 : o0 + cp], cp)
+                self.runner.scatter_pages_from_device(
+                    ids_j, snap, layers=(l0, lg)
+                )
+                with self._local_lock:
+                    self.stream_groups_total += 1
+                if g == 0 and j == n_chunks - 1:
+                    self._note_first_group(handle)
+        except Exception:
+            self.allocator.free(stream_ids)
+            raise
+        self.last_timeline["fetch_done"] = time.monotonic()
+        return PulledBundle(
+            pages=None, hashes=hashes[:n_full], nbytes=0,
+            host=host, port=port, key=key, keys=all_keys,
+            chunk_pages=cp, start_page=sp, stream_ids=stream_ids,
+        )
+
+    def _fetch_grouped_wire(
+        self, params, hashes, n_full, sp, cp, n_chunks, j0, n_groups,
+        host, port, key, all_keys, ring_mode, n_swa, swa_sp,
+        want_dtype, pool_quant, handle,
+    ) -> "PulledBundle":
+        """Wire leg of a v3 group-framed import.
+
+        Single-host (non-ring): pages batch-allocated once up front,
+        then every (group, chunk) cell pulls, CRC-verifies, and scatters
+        its layer slice straight into the pool while later cells are
+        still on the wire — group 0's completion opens the admission
+        gate. Ring / multi-host consumers (and allocation pressure)
+        reassemble full-layer chunks instead and apply exactly like a
+        v2 import."""
+        plan = self._group_plan(n_groups)
+        multihost = getattr(self.runner, "_multihost", False)
+        start_page = sp + j0 * cp
+        streamed = not ring_mode and not multihost
+        stream_ids = (
+            self._stream_alloc(n_full - start_page) if streamed else None
+        )
+        # Per-CELL deadline, reset on progress (same contract as the v2
+        # chunk loop), bounded overall by 2s of slack per cell.
+        per_chunk_s = min(self.cfg.lease_ms / 1e3, 20.0)
+        n_cells = n_groups * max(n_chunks - j0, 0)
+        hard_deadline = time.monotonic() + per_chunk_s + 2.0 * (n_cells + 1)
+        np_bufs: dict[int, np.ndarray] = {}
+        nbytes = 0
+        swa_np = None
+        # ONE protected region: every raise between the stream-page
+        # reservation above and the bundle handoff below must refund the
+        # reserved pages (a leaked reservation permanently shrinks the
+        # decode pool by up to a quarter).
+        try:
+            if ring_mode and n_swa:
+                # The sliding-layer section first: it registers first
+                # and is tiny, so a missing/expired export fails fast.
+                blob = _faulty_pull(
+                    host, port, swa_key(key),
+                    min(time.monotonic() + per_chunk_s, hard_deadline),
+                )
+                swa_np = unpack_pages(blob)
+                if swa_np.shape[1] != n_swa:
+                    raise ValueError(
+                        f"sliding section holds {swa_np.shape[1]} pages, "
+                        f"expected {n_swa}"
+                    )
+                if swa_np.dtype != want_dtype and not pool_quant:
+                    raise ValueError(
+                        f"sliding-section KV dtype mismatch: "
+                        f"{swa_np.dtype} vs consumer {want_dtype}"
+                    )
+                nbytes += len(blob)
+            for g, (l0, lg) in enumerate(plan):
+                for j in range(j0, n_chunks):
+                    blob = _faulty_pull(
+                        host, port, group_key(key, g, j),
+                        min(time.monotonic() + per_chunk_s, hard_deadline),
+                    )
+                    decoded = unpack_pages_any(blob)
+                    payload = decoded[1]
+                    gi, gn, gl0 = bundle_group_info(blob)
+                    if (gi, gn, gl0) != (g, n_groups, l0):
+                        raise ValueError(
+                            f"group frame mismatch at cell g{g}c{j}: wire "
+                            f"says (group {gi}/{gn}, layer0 {gl0}), "
+                            f"expected (group {g}/{n_groups}, layer0 {l0})"
+                        )
+                    if payload.shape[0] != lg or payload.shape[1] != cp:
+                        raise ValueError(
+                            f"cell g{g}c{j} holds {payload.shape[0]}x"
+                            f"{payload.shape[1]} layers x pages, expected "
+                            f"{lg}x{cp}"
+                        )
+                    direct_q8 = decoded[0] == "q8" and pool_quant
+                    if decoded[0] == "q8" and not direct_q8:
+                        # Already lossy; dequantization targets the
+                        # consumer pool dtype (heterogeneous pairings OK).
+                        vals = PulledBundle._dequant_chunk(
+                            (decoded[1], decoded[2])
+                        )
+                    elif decoded[0] == "q8":
+                        vals = None  # int8 pool: wire pair goes direct
+                    else:
+                        if payload.dtype != want_dtype and not pool_quant:
+                            raise ValueError(
+                                f"KV dtype mismatch: producer "
+                                f"{payload.dtype} vs consumer {want_dtype}"
+                            )
+                        vals = payload
+                    if stream_ids is not None:
+                        o0 = sp + j * cp - start_page
+                        ids_j = _pad_chunk_ids(stream_ids[o0 : o0 + cp], cp)
+                        if direct_q8:
+                            # Int8 pool + q8 wire: the pool bytes ship
+                            # and land DIRECTLY — a dequant/requant
+                            # round trip would cost a rounding flip and
+                            # break the lossless-wrt-pool contract.
+                            self.runner.scatter_pages_from_device(
+                                ids_j, (decoded[1], decoded[2]),
+                                layers=(l0, lg),
+                            )
+                        else:
+                            self.runner.scatter_pages(
+                                ids_j, vals, layers=(l0, lg)
+                            )
+                    else:
+                        if vals is None:
+                            # Buffered reassembly has no layer-sliced
+                            # direct write; dequant like the legacy
+                            # host path (requant at scatter — same
+                            # behavior as a v2 buffered import).
+                            vals = PulledBundle._dequant_chunk(
+                                (decoded[1], decoded[2])
+                            )
+                        buf = np_bufs.get(j)
+                        if buf is None:
+                            # Full-layer reassembly buffer. float32 holds
+                            # every staging dtype exactly (bf16/f16 are
+                            # strict subsets), so the scatter's cast back
+                            # to the pool dtype stays byte-identical.
+                            _, _, K, pg, inner = payload.shape
+                            buf = np.empty(
+                                (self._pool_layers, cp, K, pg, inner),
+                                dtype=np.float32,
+                            )
+                            np_bufs[j] = buf
+                        buf[l0 : l0 + lg] = np.asarray(vals).astype(
+                            np.float32, copy=False
+                        )
+                    with self._local_lock:
+                        self.stream_groups_total += 1
+                    nbytes += len(blob)
+                if g == 0:
+                    self._note_first_group(handle)
+        except Exception:
+            if stream_ids is not None:
+                self.allocator.free(stream_ids)
+            raise
+        self.last_timeline["fetch_done"] = time.monotonic()
+        np_chunks = [np_bufs[j] for j in sorted(np_bufs)]
+        return PulledBundle(
+            pages=None, hashes=hashes[:n_full], nbytes=nbytes,
+            host=host, port=port, key=key, keys=all_keys,
+            np_chunks=np_chunks, chunk_pages=cp,
+            start_page=start_page, stream_ids=stream_ids,
+            swa_pages_np=swa_np, swa_start_page=swa_sp, swa_count=n_swa,
+        )
+
+    def streaming_import(self, params: dict | None) -> bool:
+        """True when ``params`` describe a v3 group-framed import THIS
+        consumer can admit early (first-group admission seam): grouped
+        wire, cache-seeding (non-ring) single-host consumer, recompute
+        policy (policy='fail' keeps the synchronous surface so the
+        serving layer can still 500 the request)."""
+        return bool(
+            self.wants_import(params)
+            and int(params.get("num_groups", 1) or 1) > 1
+            and getattr(self.runner, "swa", None) is None
+            and not getattr(self.runner, "_multihost", False)
+            and self.cfg.load_failure_policy == "recompute"
+        )
+
+    def make_stream_handle(self, params: dict) -> "KVStreamHandle":
+        return KVStreamHandle(self, params)
+
     def fetch_remote_policy(
-        self, prompt_token_ids: list[int], params: dict
+        self,
+        prompt_token_ids: list[int],
+        params: dict,
+        handle: "KVStreamHandle | None" = None,
     ) -> "PulledBundle | None":
         """fetch_remote with the load-failure policy applied.
 
         Returns None on policy='recompute' failure; raises KVLoadError on
-        policy='fail' (operations-vllm.md:118-139).
-        """
+        policy='fail' (operations-vllm.md:118-139). With ``handle`` the
+        outcome is ALSO published through it — success hands the bundle
+        to whoever wins the take()/abandon() race, failure wakes the
+        parked request into local recompute."""
         t0 = time.monotonic()
         try:
-            return self.fetch_remote(prompt_token_ids, params)
+            bundle = self.fetch_remote(prompt_token_ids, params, handle)
+            if handle is not None:
+                handle.resolve(bundle)
+            return bundle
         except (PullError, OSError, ValueError, KeyError, TypeError, struct.error) as e:
             # struct.error: truncated header; TypeError: garbage dtype string
             # -- a corrupt/foreign bundle must hit the policy, not escape.
@@ -1073,6 +1624,8 @@ class TPUConnector:
                 self.crc_failures += 1
             policy = self.cfg.load_failure_policy
             self.transfer_failures[("fetch", policy)] += 1
+            if handle is not None:
+                handle.fail(str(e))
             if policy == "fail":
                 raise KVLoadError(str(e)) from e
             self.recompute_fallbacks += 1
@@ -1080,6 +1633,8 @@ class TPUConnector:
             return None
         finally:
             self.last_fetch_ms = (time.monotonic() - t0) * 1e3
+            self.last_timeline.setdefault("fetch_start", t0)
+            self.last_timeline["fetch_done"] = time.monotonic()
 
     def _adaptive_pick_q8(self) -> bool:
         """Per-export encoding choice from measured link behavior.
@@ -1176,6 +1731,7 @@ class TPUConnector:
             self.imported_bytes += bundle.nbytes
             self._notify_free_async(bundle)
             self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
+            self.last_timeline["apply_done"] = time.monotonic()
             return adopted
         if bundle.device_chunks and not bundle.np_chunks:
             # Local-fastpath bundles keep no host chunks for the
@@ -1198,9 +1754,17 @@ class TPUConnector:
                 if bundle.device_chunks:
                     # Pipelined path: chunks are already on device
                     # (uploaded by the fetch thread) — only fast
-                    # device->pool scatters here.
+                    # device->pool scatters here. Grouped claim cells
+                    # ride as (chunk, layer0, n_layers, dev) tuples and
+                    # scatter their layer slice; legacy entries are
+                    # whole-layer chunks keyed by position.
                     cp = bundle.chunk_pages
-                    for j, dev in enumerate(bundle.device_chunks):
+                    for idx, entry in enumerate(bundle.device_chunks):
+                        if isinstance(entry, tuple) and len(entry) == 4:
+                            j, l0, lg, dev = entry
+                            layers = (l0, lg)
+                        else:
+                            j, dev, layers = idx, entry, None
                         p0 = bundle.start_page + j * cp
                         if p0 + cp <= skip:
                             continue  # wholly cached since the fetch
@@ -1208,7 +1772,9 @@ class TPUConnector:
                             ids_j = _pad_chunk_ids(
                                 page_ids[p0 - skip : p0 - skip + cp], cp
                             )
-                            self.runner.scatter_pages_from_device(ids_j, dev)
+                            self.runner.scatter_pages_from_device(
+                                ids_j, dev, layers=layers
+                            )
                         else:
                             # Partial overlap (cache grew between fetch
                             # and apply): host-path scatter of the
@@ -1246,6 +1812,7 @@ class TPUConnector:
         self.imported_bytes += bundle.nbytes
         self._notify_free_async(bundle)
         self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
+        self.last_timeline["apply_done"] = time.monotonic()
         return adopted
 
     # llmd: transfers(pages)
@@ -1328,13 +1895,22 @@ class TPUConnector:
             page_ids = self.allocator.allocate(n_full)
             # llmd: allow(release-on-all-paths) -- same contract as page_ids one line up: except-arm refund, then ownership rides the returned preload dict
             ring_ids = swa_allocator.allocate(ring_pages)
-            # Full-group content into the main pool.
+            # Full-group content into the main pool (grouped claim
+            # cells carry their layer slice; legacy entries are
+            # whole-layer chunks keyed by position).
             if bundle.device_chunks:
                 cp = bundle.chunk_pages
-                for j, dev in enumerate(bundle.device_chunks):
+                for idx, entry in enumerate(bundle.device_chunks):
+                    if isinstance(entry, tuple) and len(entry) == 4:
+                        j, l0, lg, dev = entry
+                        layers = (l0, lg)
+                    else:
+                        j, dev, layers = idx, entry, None
                     p0 = bundle.start_page + j * cp
                     ids_j = _pad_chunk_ids(page_ids[p0 : p0 + cp], cp)
-                    self.runner.scatter_pages_from_device(ids_j, dev)
+                    self.runner.scatter_pages_from_device(
+                        ids_j, dev, layers=layers
+                    )
             elif bundle.pages is not None or bundle.np_chunks:
                 want = bundle.host_pages(n_full)
                 self.runner.scatter_pages(page_ids, want[:, : n_full])
@@ -1374,6 +1950,7 @@ class TPUConnector:
         self.imported_bytes += bundle.nbytes
         self._notify_free_async(bundle)
         self.last_apply_ms = (time.monotonic() - t_apply) * 1e3
+        self.last_timeline["apply_done"] = time.monotonic()
         return {
             "block_ids": page_ids,
             "swa_block_ids": ring_ids,
@@ -1415,7 +1992,10 @@ class TPUConnector:
     def stats(self) -> dict[str, int]:
         with self._local_lock:
             exported_bytes = self.exported_bytes
+            stream_groups_total = self.stream_groups_total
         out = {
+            "stream_groups_total": stream_groups_total,
+            "last_first_group_ms": round(self.last_first_group_ms, 2),
             "exported_requests": self.exported_requests,
             "exported_bytes": exported_bytes,
             "imported_requests": self.imported_requests,

@@ -110,10 +110,15 @@ class HostKVCache:
         page, _ = self.get_tagged(h)
         return page
 
-    def get_tagged(self, h: bytes) -> tuple[np.ndarray | None, str | None]:
+    def get_tagged(
+        self, h: bytes, store: bool = True
+    ) -> tuple[np.ndarray | None, str | None]:
         """Fetch a page plus the tier that served it (``dram`` | ``fs``
         | ``store`` | None) — the restore path scores store-served
-        pages as recompute avoided (kv-federation.md)."""
+        pages as recompute avoided (kv-federation.md). ``store=False``
+        stops at the local tiers (the batched restore walk fetches the
+        store leg in one shot via :meth:`fetch_store_many` instead of a
+        round trip per page)."""
         with self._lock:
             page = self._pages.get(h)
             if page is not None:
@@ -129,12 +134,34 @@ class HostKVCache:
             if self.federation is not None:
                 self.federation.touch(h)
             return page, "fs"
+        if not store:
+            return None, None
         page = self._load_remote(h)
         if page is not None:
             with self._lock:
                 self.restores += 1
             return page, "store"
         return None, None
+
+    def fetch_store_many(self, hs: list[bytes]) -> dict[bytes, np.ndarray]:
+        """Batched store leg of a restore walk: ONE federation round
+        trip for every candidate hash (PR 9 follow-up — each store
+        block used to be its own locate + GET). Fetched pages promote
+        into the DRAM tier; ``restores`` counts only pages the caller
+        actually consumes (see :meth:`note_store_restore`)."""
+        if self.federation is None or not hs:
+            return {}
+        pages = self.federation.fetch_many(list(hs))
+        for h, page in pages.items():
+            with self._lock:
+                self.remote_hits += 1
+            self.put(h, page, publish=False)
+        return pages
+
+    def note_store_restore(self) -> None:
+        """Count one batched-store page actually restored to device."""
+        with self._lock:
+            self.restores += 1
 
     def note_use(self, h: bytes) -> None:
         """Device-cache prefix hit observed by the restore walk: feed
@@ -378,15 +405,44 @@ class OffloadConnector:
             return 0
         restore: list[tuple[int, bytes, np.ndarray]] = []  # (idx, hash, data)
         store_pages = 0
+        # Batched store leg (PR 9 follow-up): the first local-tier miss
+        # fetches the REST of the chain from the federation in one
+        # round trip (one locate + one pipelined pull per owner)
+        # instead of a GET per page; the walk then consumes fetched
+        # pages until the first real gap.
+        store_batch: dict[bytes, np.ndarray] = {}
+        store_batched = False
         for idx, h in enumerate(hashes):
             if self.allocator.has_cached(h):
                 # Device-resident prefix hit: a reuse signal for the
                 # publish-on-evict hotness gate.
                 self.host.note_use(h)
                 continue
-            data, tier = self.host.get_tagged(h)
+            if store_batched and h in store_batch:
+                # Batch-fetched pages count as store-served even though
+                # fetch_store_many already promoted them to DRAM — the
+                # promotion is an artifact of THIS walk, not a prior hit.
+                data, tier = store_batch[h], "store"
+                self.host.note_store_restore()
+            else:
+                data, tier = self.host.get_tagged(h, store=False)
             if data is None:
-                break  # chain broken: nothing past this point is usable
+                if not store_batched:
+                    # Only hashes no LOCAL tier holds go in the batch —
+                    # fetching locally-resident pages would waste store
+                    # bandwidth and mislabel their tier.
+                    store_batched = True
+                    store_batch = self.host.fetch_store_many([
+                        h2 for h2 in hashes[idx:]
+                        if not self.allocator.has_cached(h2)
+                        and not self.host.has(h2)
+                    ])
+                    data = store_batch.get(h)
+                    if data is not None:
+                        tier = "store"
+                        self.host.note_store_restore()
+                if data is None:
+                    break  # chain broken: nothing past here is usable
             if tier == "store":
                 store_pages += 1
             restore.append((idx, h, data))

@@ -302,6 +302,58 @@ def pull(host: str, port: int, key: str) -> bytes:
     return payload
 
 
+def pull_many(host: str, port: int, keys: list[str]) -> dict[str, bytes]:
+    """Pull several bundles over ONE connection (pipelined requests).
+
+    The federation restore path fetches every store-held page of a
+    prefix run in one shot: one TCP connect + N request/response rounds
+    on the same socket instead of N fresh connections (the per-page GET
+    was the dominant fixed cost of a multi-page store hit). Keys the
+    server does not hold are simply absent from the result; transport
+    errors raise PullError (the caller's miss/degrade policy decides).
+
+    Speaks the standard per-request wire protocol, so it works against
+    both the python and native servers (their handlers loop on the
+    connection); if the peer closes between requests, the remaining keys
+    fall back to one-shot pulls.
+    """
+    out: dict[str, bytes] = {}
+    if not keys:
+        return out
+    remaining = list(keys)
+    try:
+        with socket.create_connection((host, port), timeout=30.0) as sock:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while remaining:
+                key = remaining[0]
+                kb = key.encode()
+                sock.sendall(
+                    struct.pack("<IBH", MAGIC, OP_PULL, len(kb)) + kb
+                )
+                hdr = _recv_exact(sock, 9)
+                if hdr is None:
+                    raise ConnectionError("peer closed mid-batch")
+                st, length = struct.unpack("<BQ", hdr)
+                payload = b""
+                if length:
+                    payload = _recv_exact(sock, length)
+                    if payload is None:
+                        raise ConnectionError("peer closed mid-payload")
+                if st == ST_OK:
+                    out[key] = payload
+                remaining.pop(0)
+    except (ConnectionError, OSError):
+        # Mixed/native deployments that close per request: finish the
+        # remainder as ordinary one-shot pulls (absent keys stay absent).
+        for key in remaining:
+            try:
+                out[key] = pull(host, port, key)
+            except PullError as e:
+                if e.status != ST_NOT_FOUND:
+                    raise
+    return out
+
+
 def pull_wait(
     host: str, port: int, key: str, deadline: float, poll_s: float = 0.01
 ) -> bytes:

@@ -139,6 +139,7 @@ def make_engine_config(args, lora_adapters=None):
         kv_side_channel_port=int(kv_cfg.get("side_channel_port", 9600)),
         kv_transfer_port=int(kv_cfg.get("transfer_port", 9100)),
         kv_transfer_dtype=str(kv_cfg.get("transfer_dtype", "auto")),
+        kv_stream_groups=int(kv_cfg.get("stream_groups", 4)),
         kv_events_endpoint=args.kv_events_endpoint,
         offload=(
             OffloadConfig(

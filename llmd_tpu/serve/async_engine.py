@@ -41,7 +41,8 @@ class _Pending:
 
 def _release_pulled(engine, kv_transfer_params) -> None:
     """Release a fetched-but-never-applied bundle riding in
-    ``kv_transfer_params["__pulled__"]``: a streamed multi-host fetch
+    ``kv_transfer_params["__pulled__"]`` (or abandon an in-flight
+    group-stream handle in ``"__stream__"``): a streamed fetch
     pre-allocates pool pages that leak permanently unless every path
     that drops the bundle before apply funnels through here."""
     conn = getattr(engine, "kv_connector", None)
@@ -50,6 +51,9 @@ def _release_pulled(engine, kv_transfer_params) -> None:
     b = kv_transfer_params.get("__pulled__")
     if b is not None:
         conn.release_bundle(b)
+    handle = kv_transfer_params.get("__stream__")
+    if handle is not None:
+        handle.abandon()
 
 
 class RequestFailed(Exception):
@@ -332,7 +336,62 @@ class AsyncEngine:
         # executor so it never blocks the engine step thread or the event
         # loop; the engine thread only applies the pre-fetched bundle.
         conn = getattr(self.engine, "kv_connector", None)
-        if conn is not None and conn.wants_import(kv_transfer_params):
+        if conn is not None and conn.streaming_import(kv_transfer_params):
+            # Group-streamed import (v3 wire): the fetch thread scatters
+            # each layer group into batch-allocated pool pages as it
+            # lands; submit the request the moment the FIRST group is
+            # resident, so engine admission, scheduling, and host
+            # staging overlap the rest of the wire transfer. The engine
+            # parks the request and finalizes when the stream resolves
+            # (apply on success, local recompute on failure).
+            handle = conn.make_stream_handle(kv_transfer_params)
+            loop = asyncio.get_running_loop()
+            admittable = asyncio.Event()
+            # Signal the loop directly from the fetch thread: no thread
+            # is parked for the wait, so a burst of concurrent streamed
+            # imports cannot exhaust the default executor.
+            handle.on_first_group = functools.partial(
+                loop.call_soon_threadsafe, admittable.set
+            )
+
+            def _fetch_streamed() -> None:
+                try:
+                    conn.fetch_remote_policy(
+                        list(prompt_token_ids), kv_transfer_params, handle
+                    )
+                finally:
+                    # Policy='recompute' never raises, but an unexpected
+                    # failure mode must not leave the parked request
+                    # waiting forever — fail() degrades it to recompute.
+                    if not handle.done.is_set():
+                        handle.fail("streamed fetch died unresolved")
+
+            self._fetch_pool.submit(_fetch_streamed)
+            try:
+                if deadline is None:
+                    await admittable.wait()
+                else:
+                    try:
+                        await asyncio.wait_for(
+                            admittable.wait(),
+                            max(deadline - time.monotonic(), 0.001),
+                        )
+                    except asyncio.TimeoutError:
+                        pass  # surfaced via the is_set() check below
+            except asyncio.CancelledError:
+                handle.abandon()
+                raise
+            if not handle.first_group.is_set():
+                # Deadline elapsed before the first group landed; the
+                # fetch keeps running and the abandon hook frees its
+                # stream-reserved pages whenever it resolves.
+                handle.abandon()
+                raise DeadlineExceeded(
+                    f"request deadline of {deadline_s}s exceeded during "
+                    "remote KV stream"
+                )
+            kv_transfer_params = {**kv_transfer_params, "__stream__": handle}
+        elif conn is not None and conn.wants_import(kv_transfer_params):
             # Submitted on OUR executor so the CONCURRENT future is in
             # hand: cancelling the awaiting task cancels only the
             # asyncio wrapper (which then DISCARDS the executor's real

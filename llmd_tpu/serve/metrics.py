@@ -68,6 +68,11 @@ def render_metrics(
         gauges["swa_sections"] = stats.swa_sections
     gauges["kv_offload_cpu_pages"] = stats.offload_pages
     gauges["kv_offload_fs_pages"] = stats.offload_fs_pages
+    # Last streamed import's first-group latency: the admission-gate
+    # leg of the layer-streamed transfer waterfall (kv-cache.md).
+    gauges["kv_stream_first_group_ms"] = round(
+        stats.kv_stream_first_group_ms, 2
+    )
     counters = {
         "prompt_tokens_total": stats.prompt_tokens,
         "generation_tokens_total": stats.generation_tokens,
@@ -96,6 +101,12 @@ def render_metrics(
         "kv_transfer_imported_requests_total": stats.kv_imported_requests,
         "kv_transfer_imported_bytes_total": stats.kv_imported_bytes,
         "kv_transfer_import_failures_total": stats.kv_import_failures,
+        # Layer-streamed transfer (the v3 group-framed wire): streamed
+        # (layer-group x chunk) cells landed on this consumer.
+        "kv_stream_groups_total": stats.kv_stream_groups_total,
+        # Publish-budget pacing (LLMD_KV_PUBLISH_BYTES_PER_S): bytes the
+        # federation publisher delayed to protect the transfer NIC.
+        "kv_publish_paced_bytes_total": stats.kv_publish_paced_bytes_total,
         # Async stepping (speculate/rollback contract)
         "engine_steps_total": stats.engine_steps_total,
         "step_host_gap_ms_total": round(stats.step_host_gap_ms_total, 3),

@@ -438,6 +438,28 @@ DASHBOARDS["llmd-pd-coordinator"] = dashboard(
         panel("Import bandwidth",
               [f"rate(vllm:kv_transfer_imported_bytes_total{M}[5m])"],
               kind="stat", w=6, h=4, unit="Bps"),
+        row("Layer-streamed import (v3 group wire)"),
+        panel("Streamed cells /s",
+              [f"rate(vllm:kv_stream_groups_total{M}[5m])"],
+              kind="stat", w=6, h=4,
+              desc="(layer-group × chunk) cells landed by group-streamed "
+                   "imports; zero with P/D traffic flowing means "
+                   "transfers fell back to the monolithic v2 wire "
+                   "(compat pin, multi-host, or ring consumers)."),
+        panel("First-group latency (ms)",
+              [f"vllm:kv_stream_first_group_ms{M}"],
+              kind="stat", w=6, h=4,
+              desc="Last streamed import's admission-gate wait: the "
+                   "decode request is schedulable once group 0 is "
+                   "resident, so this — not the full transfer — is the "
+                   "serial TTFT leg."),
+        panel("Publish pacing (B/s delayed)",
+              [f"rate(vllm:kv_publish_paced_bytes_total{M}[5m])"],
+              kind="stat", w=6, h=4,
+              desc="Bytes the federation publisher held back under the "
+                   "LLMD_KV_PUBLISH_BYTES_PER_S budget. Persistently "
+                   "high = publish demand exceeds the NIC share; raise "
+                   "the hotness gate or the budget."),
         row("Flow"),
         panel("Transfer requests",
               [f"rate(vllm:kv_transfer_exported_requests_total{M}[5m])",

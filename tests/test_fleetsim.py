@@ -331,6 +331,34 @@ def test_diurnal_autoscale_reacts_without_oscillation():
     assert board["autoscale"]["direction_flips"] <= 3
 
 
+def test_pd_transfer_two_tier_pipeline_and_drop_degradation():
+    """Disaggregated P→D under soak (kv-cache.md layer-streamed
+    import): prompts prefill on the shared P tier and import KV over
+    the group-streamed transfer leg; seeded mid-stream kv.pull.drop
+    degrades each hit import to a full local recompute — slower, never
+    lost, never corrupt — and the streamed admission gate (first-group
+    p50) sits strictly below the full-import p50."""
+    board = _run("pd_transfer", 0.25, seed=3)
+    assert board["ok"], board["invariants"]
+    pt = board["pd_transfer"]
+    assert pt["imports"] >= 1
+    assert pt["recomputes"] >= 1
+    assert pt["drops"] == pt["recomputes"]
+    assert board["faults_injected"].get("kv.pull.drop", 0) >= 1
+    assert pt["first_group_p50_ms"] < pt["import_p50_ms"]
+    assert pt["prefill_tier"]["prefills"] >= board["requests"][
+        "outcomes"
+    ].get("completed", 0)
+    assert board["requests"]["lost"] == 0
+    assert board["requests"]["hung"] == 0
+
+
+def test_pd_transfer_scoreboard_byte_identical():
+    a = to_canonical_json(_run("pd_transfer", 0.1))
+    b = to_canonical_json(_run("pd_transfer", 0.1))
+    assert a == b
+
+
 def test_hung_requests_are_surfaced_not_lost():
     """A replica that never finishes within the grace window produces a
     `hung` record and fails zero_lost — the invariant can actually fire."""

@@ -1,0 +1,610 @@
+"""Layer-streamed KV transfer (the v3 group-framed wire).
+
+Covers the streamed-import contract end to end (kv-cache.md
+"layer-streamed import"):
+
+- wire framing: layer_groups split, v3 header round trip + group info +
+  CRC rejection, v2-reader compat pin (LLMD_KV_STREAM_COMPAT_V2);
+- streamed-vs-monolithic BYTE-IDENTICAL token streams, greedy and
+  seeded, across float32 / bfloat16 / int8 pools and SWA-ring engines;
+- per-group mid-stream faults (drop, corrupt, producer-vanished
+  timeout) degrading to local recompute with the counter trail on the
+  rendered /metrics page;
+- the first-group admission seam: a request parked on an in-flight
+  stream admits when the stream resolves, aborting it releases the
+  batch-allocated pages;
+- the PR 9 follow-ups riding the same pull path: batched store fetches
+  (ONE locate + ONE pipelined kvship pull per prefix run) and
+  publish-budget pacing (LLMD_KV_PUBLISH_BYTES_PER_S).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_platforms", "cpu")
+
+from llmd_tpu.config import (  # noqa: E402
+    CacheConfig,
+    EngineConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine import LLMEngine, SamplingParams  # noqa: E402
+from llmd_tpu.kvtransfer import connector as connector_mod  # noqa: E402
+from llmd_tpu.kvtransfer.connector import (  # noqa: E402
+    KVCorruptionError,
+    bundle_group_info,
+    group_key,
+    layer_groups,
+    pack_header,
+    payload_crc,
+    transfer_keys,
+    unpack_pages,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+# --------------------------------------------------------------------- #
+# wire framing
+
+
+def test_layer_groups_split_shapes():
+    assert layer_groups(8, 4) == [(0, 2), (2, 2), (4, 2), (6, 2)]
+    assert layer_groups(7, 3) == [(0, 3), (3, 2), (5, 2)]  # front-loaded
+    assert layer_groups(2, 4) == [(0, 1), (1, 1)]  # clamped to L
+    assert layer_groups(5, 1) == [(0, 5)]
+    # contiguous cover, always
+    for L in range(1, 12):
+        for g in range(1, 6):
+            plan = layer_groups(L, g)
+            assert plan[0][0] == 0
+            assert sum(lg for _, lg in plan) == L
+            for (a0, alg), (b0, _) in zip(plan, plan[1:]):
+                assert a0 + alg == b0
+
+
+def test_v3_header_roundtrip_group_info_and_crc():
+    pages = np.arange(2 * 3 * 2 * 4 * 8, dtype=np.float32).reshape(
+        2, 3, 2, 4, 8
+    )
+    body = pages.tobytes()
+    hdr = pack_header(pages, crc=payload_crc(pages), group=(1, 4, 2))
+    blob = hdr + body
+    assert bundle_group_info(blob) == (1, 4, 2)
+    np.testing.assert_array_equal(unpack_pages(blob), pages)
+    # v1/v2 blobs report the monolithic frame
+    v2 = pack_header(pages, crc=payload_crc(pages)) + body
+    assert bundle_group_info(v2) == (0, 1, 0)
+    # a flipped payload byte must be caught by the CRC, not decoded
+    corrupt = bytearray(blob)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(KVCorruptionError):
+        unpack_pages(bytes(corrupt))
+
+
+def test_transfer_keys_enumerates_group_cells():
+    params = {"remote_key": "k", "num_chunks": 2, "num_groups": 3}
+    assert transfer_keys(params) == [
+        group_key("k", g, j) for g in range(3) for j in range(2)
+    ]
+    params["swa_pages"] = 1
+    assert transfer_keys(params)[-1] == "k:swa"
+    # legacy (no num_groups): chunk keys exactly as before
+    assert transfer_keys({"remote_key": "k", "num_chunks": 2}) == [
+        "k:c0", "k:c1"
+    ]
+
+
+# --------------------------------------------------------------------- #
+# engine P/D parity
+
+
+def make_engine(
+    kv_role=None,
+    dtype="float32",
+    stream_groups=4,
+    layers=4,
+    local_fastpath=False,
+    seed=0,
+):
+    model_dtype = "float32" if dtype == "int8" else dtype
+    return LLMEngine(EngineConfig(
+        model=tiny_model_config(num_layers=layers, dtype=model_dtype),
+        cache=CacheConfig(page_size=4, num_blocks=64, dtype=dtype),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_num_batched_tokens=64),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=seed,
+        kv_role=kv_role,
+        kv_transfer_port=0,
+        kv_local_fastpath=local_fastpath,
+        kv_stream_groups=stream_groups,
+    ))
+
+
+PROMPT = [1, 5, 9, 13, 2, 8, 4, 4, 4, 4, 6, 6, 6, 6, 11, 7, 3, 2]
+LONG_PROMPT = list(range(1, 45))  # 11 full pages -> 2 chunks per group
+
+
+def _run(eng, prompt, max_tokens, kv_transfer_params=None, sampling=None):
+    sp = sampling or SamplingParams(temperature=0.0, max_tokens=max_tokens)
+    rid = eng.add_request(
+        list(prompt), sp, kv_transfer_params=kv_transfer_params
+    )
+    outs, final = [], None
+    while eng.has_work():
+        for out in eng.step():
+            if out.request_id == rid:
+                outs.extend(out.new_token_ids)
+                if out.finished:
+                    final = out
+    return outs, final
+
+
+def _pd_pair(prompt, max_tokens, sampling=None, **kw):
+    """Run the two-phase P/D leg; returns (consumer tokens, consumer)."""
+    producer = make_engine(kv_role="kv_producer", **kw)
+    consumer = make_engine(kv_role="kv_consumer", **kw)
+    try:
+        _, pre = _run(
+            producer, prompt, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        assert pre.kv_transfer_params is not None
+        toks, _final = _run(
+            consumer, prompt, max_tokens,
+            kv_transfer_params=pre.kv_transfer_params,
+            sampling=sampling,
+        )
+        stats = consumer.kv_connector.stats()
+        return toks, pre.kv_transfer_params, stats
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_streamed_vs_monolithic_byte_identical_greedy(dtype):
+    ref_eng = make_engine(dtype=dtype)
+    ref, _ = _run(ref_eng, LONG_PROMPT, 8)
+
+    streamed, params, st = _pd_pair(LONG_PROMPT, 8, dtype=dtype)
+    mono, mparams, mst = _pd_pair(
+        LONG_PROMPT, 8, dtype=dtype, stream_groups=1
+    )
+    assert params.get("num_groups", 1) > 1
+    assert "num_groups" not in mparams
+    # grouped wire really streamed: cells landed, pages pre-allocated
+    assert st["stream_groups_total"] >= params["num_groups"]
+    assert st["last_first_group_ms"] > 0
+    assert mst["stream_groups_total"] == 0
+    # THE parity bar: streamed == monolithic == aggregated, byte for byte
+    assert streamed == mono == ref
+
+
+def test_streamed_vs_monolithic_byte_identical_seeded():
+    sp = SamplingParams(temperature=0.8, top_p=0.9, seed=1234, max_tokens=8)
+    ref_eng = make_engine()
+    ref, _ = _run(ref_eng, LONG_PROMPT, 8, sampling=sp)
+    streamed, _, _ = _pd_pair(LONG_PROMPT, 8, sampling=sp)
+    mono, _, _ = _pd_pair(LONG_PROMPT, 8, sampling=sp, stream_groups=1)
+    assert streamed == mono == ref
+
+
+def test_streamed_local_fastpath_byte_identical():
+    """Grouped local claim: cells scatter device-to-device into
+    batch-allocated pages on the fetch path; apply only commits."""
+    ref_eng = make_engine()
+    ref, _ = _run(ref_eng, LONG_PROMPT, 8)
+    toks, params, st = _pd_pair(LONG_PROMPT, 8, local_fastpath=True)
+    assert toks == ref
+    assert params.get("num_groups", 1) > 1
+    assert st["stream_groups_total"] >= 1
+
+
+def test_streamed_swa_ring_byte_identical():
+    """Ring engines under the grouped wire: full-group cells reassemble
+    into full-layer chunks for the preload path; the sliding-layer
+    section rides un-grouped. Streams match a plain ring engine's."""
+    from tests.test_swa_ring import _pd_engine, _pd_run, _PD_PROMPT
+
+    ref = _pd_engine(None)
+    try:
+        ref_tokens, _ = _pd_run(ref, _PD_PROMPT, max_tokens=12)
+    finally:
+        ref.close()
+    producer = _pd_engine("kv_producer")
+    consumer = _pd_engine("kv_consumer")
+    try:
+        assert producer.kv_connector.cfg.stream_groups > 1  # default on
+        _, pre = _pd_run(
+            producer, _PD_PROMPT, max_tokens=1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        assert params.get("num_groups", 1) > 1
+        toks, _ = _pd_run(
+            consumer, _PD_PROMPT, max_tokens=12, kv_transfer_params=params
+        )
+        assert toks == ref_tokens
+        assert consumer.kv_connector.imported_requests == 1
+        assert consumer.kv_connector.import_failures == 0
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_compat_v2_pin_restores_monolithic_wire(monkeypatch):
+    """LLMD_KV_STREAM_COMPAT_V2=1 (reader-first rolling deploys): the
+    producer ships the v2 chunk framing byte-for-byte — chunk keys, no
+    num_groups, version-2 headers a pre-stream reader parses."""
+    monkeypatch.setattr(connector_mod, "_COMPAT_V2", True)
+    producer = make_engine(kv_role="kv_producer")
+    try:
+        _, pre = _run(
+            producer, LONG_PROMPT, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        assert "num_groups" not in params
+        assert transfer_keys(params) == [
+            f"{params['remote_key']}:c0", f"{params['remote_key']}:c1"
+        ]
+        # the registered blob parses with the plain v2 reader
+        from llmd_tpu.kvtransfer import shipper as shipper_mod
+
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            producer.kv_connector.server.registered_count < 2
+        ):
+            time.sleep(0.02)
+        blob = shipper_mod.pull(
+            "127.0.0.1", producer.kv_connector.server.port,
+            f"{params['remote_key']}:c0",
+        )
+        assert bundle_group_info(blob) == (0, 1, 0)
+        pages = unpack_pages(blob)
+        assert pages.shape[0] == 4  # all layers, one frame
+    finally:
+        producer.kv_connector.close()
+
+
+# --------------------------------------------------------------------- #
+# per-group mid-stream faults -> recompute
+
+
+@pytest.mark.parametrize("spec, expect_crc", [
+    # group 1 (mid-stream): the import already scattered group 0 into
+    # its batch-allocated pages — the failure must refund them all.
+    ({"site": "kv.pull.drop", "match": ":g1:", "times": 1}, False),
+    ({"site": "kv.bundle.corrupt", "match": ":g1:", "times": 1}, True),
+])
+def test_mid_stream_group_fault_degrades_to_recompute(spec, expect_crc):
+    from llmd_tpu import faults
+
+    ref_eng = make_engine()
+    ref, _ = _run(ref_eng, LONG_PROMPT, 8)
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        _, pre = _run(
+            producer, LONG_PROMPT, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        free_before = consumer.allocator.num_free_pages
+        faults.arm(faults.FaultPlan([faults.FaultSpec(**spec)], seed=3))
+        toks, _ = _run(
+            consumer, LONG_PROMPT, 8,
+            kv_transfer_params=pre.kv_transfer_params,
+        )
+        assert toks == ref  # byte-identical through the recompute
+        conn = consumer.kv_connector
+        assert conn.import_failures == 1
+        assert conn.recompute_fallbacks == 1
+        assert conn.crc_failures == (1 if expect_crc else 0)
+        assert faults.injected_counts() == {spec["site"]: 1}
+        # mid-stream failure refunded the whole batch allocation (the
+        # request's own pages were released at finish; the pool is back
+        # to its pre-import level)
+        assert consumer.allocator.num_free_pages == free_before
+        # ... and the trail reaches the production /metrics surface.
+        from llmd_tpu.serve.metrics import render_metrics
+
+        consumer._refresh_gauges()
+        page = render_metrics(consumer.stats, "tiny")
+        assert "llmd:kv_recompute_fallbacks_total" in page
+        assert 'llmd:kv_transfer_failures_total{stage="fetch"' in page
+        if expect_crc:
+            for line in page.splitlines():
+                if line.startswith("llmd:kv_bundle_crc_failures_total"):
+                    assert float(line.split()[-1]) == 1
+                    break
+            else:
+                pytest.fail("crc failure counter not rendered")
+    finally:
+        faults.disarm()
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_mid_stream_timeout_vanished_group_degrades():
+    """A producer that dies after shipping group 0 (its later cells
+    never register): the consumer's per-cell deadline expires and the
+    import degrades to recompute — no hang, pages refunded."""
+    ref_eng = make_engine()
+    ref, _ = _run(ref_eng, LONG_PROMPT, 8)
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    consumer.kv_connector.cfg.lease_ms = 400  # short per-cell deadline
+    try:
+        _, pre = _run(
+            producer, LONG_PROMPT, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        n_cells = len(transfer_keys(params))
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            producer.kv_connector.server.registered_count < n_cells
+        ):
+            time.sleep(0.02)
+        # the producer "dies": every cell PAST group 0 vanishes
+        for key in transfer_keys(params):
+            if ":g0:" not in key:
+                producer.kv_connector.server.unregister(key)
+        free_before = consumer.allocator.num_free_pages
+        t0 = time.monotonic()
+        toks, _ = _run(
+            consumer, LONG_PROMPT, 8, kv_transfer_params=params
+        )
+        assert toks == ref
+        assert time.monotonic() - t0 < 30  # bounded, not a hang
+        assert consumer.kv_connector.import_failures == 1
+        assert consumer.kv_connector.recompute_fallbacks == 1
+        assert consumer.allocator.num_free_pages == free_before
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+# --------------------------------------------------------------------- #
+# the first-group admission seam
+
+
+def test_stream_handle_parks_then_admits_byte_identical():
+    """The engine-side admission seam in isolation: a request parked on
+    an in-flight stream is NOT schedulable (steps run other work), and
+    admits with its prefix applied the moment the stream resolves."""
+    ref_eng = make_engine()
+    ref, _ = _run(ref_eng, LONG_PROMPT, 8)
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        _, pre = _run(
+            producer, LONG_PROMPT, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        conn = consumer.kv_connector
+        assert conn.streaming_import(params)
+        handle = conn.make_stream_handle(params)
+        fetcher = threading.Thread(
+            target=conn.fetch_remote_policy,
+            args=(list(LONG_PROMPT), params, handle),
+            daemon=True,
+        )
+        fetcher.start()
+        assert handle.wait_admittable(10.0)
+        rid = consumer.add_request(
+            list(LONG_PROMPT),
+            SamplingParams(temperature=0.0, max_tokens=8),
+            kv_transfer_params={**params, "__stream__": handle},
+        )
+        assert consumer.has_work()
+        outs, final = [], None
+        deadline = time.time() + 30
+        while consumer.has_work() and time.time() < deadline:
+            for out in consumer.step():
+                if out.request_id == rid:
+                    outs.extend(out.new_token_ids)
+                    if out.finished:
+                        final = out
+        assert final is not None and outs == ref
+        # the streamed prefix really applied (prefill was a cache hit)
+        assert final.num_cached_tokens >= 4
+        assert conn.stream_imports == 1
+        fetcher.join(timeout=5)
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+def test_abort_while_parked_releases_stream_pages():
+    producer = make_engine(kv_role="kv_producer")
+    consumer = make_engine(kv_role="kv_consumer")
+    try:
+        _, pre = _run(
+            producer, LONG_PROMPT, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        params = pre.kv_transfer_params
+        conn = consumer.kv_connector
+        free_before = consumer.allocator.num_free_pages
+        handle = conn.make_stream_handle(params)
+        gate = threading.Event()
+
+        def fetch():
+            gate.wait(10)
+            conn.fetch_remote_policy(list(LONG_PROMPT), params, handle)
+
+        t = threading.Thread(target=fetch, daemon=True)
+        t.start()
+        rid = consumer.add_request(
+            list(LONG_PROMPT),
+            SamplingParams(temperature=0.0, max_tokens=8),
+            kv_transfer_params={**params, "__stream__": handle},
+        )
+        assert consumer.abort_request(rid)
+        assert not consumer.has_work()
+        gate.set()  # the fetch lands AFTER the abort
+        t.join(timeout=10)
+        assert handle.done.wait(10)
+        # whichever side won the race, the bundle (and its stream-
+        # reserved pages) was released — cached pages hold refs of 0,
+        # so every page is free again
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            consumer.allocator.num_free_pages != free_before
+        ):
+            time.sleep(0.02)
+        assert consumer.allocator.num_free_pages == free_before
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
+
+
+# --------------------------------------------------------------------- #
+# PR 9 follow-ups on the same pull path
+
+
+def test_pull_many_one_connection(monkeypatch):
+    from llmd_tpu.kvtransfer import shipper as shipper_mod
+
+    server = shipper_mod.ShipperServer(0)
+    try:
+        for i in range(5):
+            server.register(f"k{i}", f"v{i}".encode(), 5_000)
+        connects = 0
+        real = shipper_mod.socket.create_connection
+
+        def counting(*a, **kw):
+            nonlocal connects
+            connects += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(
+            shipper_mod.socket, "create_connection", counting
+        )
+        got = shipper_mod.pull_many(
+            "127.0.0.1", server.port, [f"k{i}" for i in range(5)] + ["nope"]
+        )
+        assert got == {f"k{i}": f"v{i}".encode() for i in range(5)}
+        assert connects == 1  # ONE connection for the whole batch
+    finally:
+        server.close()
+
+
+def test_federation_restore_batches_store_fetches(monkeypatch):
+    """PR 9 follow-up: a multi-page store-served prefix run costs ONE
+    master locate + ONE pipelined kvship pull — not a round trip per
+    page (counted, the regression this test pins)."""
+    from tests.test_kv_federation import (
+        MasterHarness, make_engine as fed_engine, _generate,
+    )
+    from llmd_tpu.kvtransfer import shipper as shipper_mod
+
+    master = MasterHarness()
+    eng_a = fed_engine(master.url)
+    eng_b = None
+    try:
+        prompt = list(range(1, 33))  # 8 full pages
+        ref = _generate(eng_a, prompt)
+        eng_a._kvstore_client.flush_publishes()
+        assert eng_a._kvstore_client.puts >= 8
+
+        eng_b = fed_engine(master.url)
+        locate_before = eng_b._kvstore_client.locate_calls
+        pull_many_calls = 0
+        real_pull_many = shipper_mod.pull_many
+
+        def counting(host, port, keys):
+            nonlocal pull_many_calls
+            pull_many_calls += 1
+            return real_pull_many(host, port, keys)
+
+        monkeypatch.setattr(shipper_mod, "pull_many", counting)
+        out_b = _generate(eng_b, prompt)
+        assert out_b == ref
+        assert eng_b._federation.hits >= 8
+        # THE round-trip bar: one locate, one batched pull, for the
+        # whole 8-page prefix run.
+        assert eng_b._kvstore_client.locate_calls - locate_before == 1
+        assert pull_many_calls == 1
+        assert eng_b.offloader.recompute_avoided_tokens >= 8 * 4
+    finally:
+        eng_a.close()
+        if eng_b is not None:
+            eng_b.close()
+        master.close()
+
+
+def test_publish_budget_pacing(monkeypatch):
+    """LLMD_KV_PUBLISH_BYTES_PER_S: the publisher thread's token bucket
+    delays publications past the budget (counted) without touching the
+    engine-thread enqueue path."""
+    from tests.test_kv_federation import MasterHarness
+    from llmd_tpu.kvstore.client import CrossSliceStoreClient
+
+    master = MasterHarness()
+    monkeypatch.setenv("LLMD_KV_PUBLISH_BYTES_PER_S", "1000000")
+    client = CrossSliceStoreClient(master.url, segment_id="pace-test")
+    try:
+        assert client.publish_bytes_per_s == 1_000_000
+        blob = b"x" * 600_000
+        t0 = time.monotonic()
+        client.put_async("a", blob)
+        client.put_async("b", blob)
+        client.flush_publishes()
+        deadline = time.time() + 10
+        while time.time() < deadline and client.puts < 2:
+            time.sleep(0.01)
+        elapsed = time.monotonic() - t0
+        assert client.puts == 2
+        # the second 600 kB put overdrew the 1 MB/s bucket: ~0.2 s of
+        # pacing, counted
+        assert client.paced_publish_bytes >= 600_000
+        assert elapsed >= 0.1
+        # the counter reaches stats (the engine pump's source)
+        assert client.stats()["paced_publish_bytes"] >= 600_000
+    finally:
+        client.close()
+        master.close()
+
+
+def test_metrics_surface_for_stream_counters():
+    """kv_stream_groups_total / kv_stream_first_group_ms reach the
+    rendered /metrics page through the engine stats pump."""
+    toks_ignored, params, _ = _pd_pair(LONG_PROMPT, 4)
+    consumer = make_engine(kv_role="kv_consumer")
+    producer = make_engine(kv_role="kv_producer")
+    try:
+        _, pre = _run(
+            producer, LONG_PROMPT, 1,
+            kv_transfer_params={"do_remote_decode": True},
+        )
+        _run(
+            consumer, LONG_PROMPT, 4,
+            kv_transfer_params=pre.kv_transfer_params,
+        )
+        from llmd_tpu.serve.metrics import render_metrics
+
+        consumer._refresh_gauges()
+        page = render_metrics(consumer.stats, "tiny")
+        for line in page.splitlines():
+            if line.startswith("llmd:kv_stream_groups_total"):
+                assert float(line.split()[-1]) >= 1
+                break
+        else:
+            pytest.fail("kv_stream_groups_total not rendered")
+        assert "vllm:kv_stream_first_group_ms" in page
+        assert "llmd:kv_publish_paced_bytes_total" in page
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()

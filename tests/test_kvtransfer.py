@@ -154,13 +154,18 @@ def test_pd_disagg_matches_aggregated():
         assert params is not None
         assert params["num_full_pages"] == len(PROMPT) // 4
         # Export staging runs on a background thread (the response leaves
-        # after prefill compute); wait for the registration to land.
+        # after prefill compute); wait for every (layer-group, chunk)
+        # cell's registration to land (v3 group framing: transfer_keys
+        # is the single source of the key scheme).
+        from llmd_tpu.kvtransfer.connector import transfer_keys
+
+        n_cells = len(transfer_keys(params))
         deadline = time.time() + 5
         while time.time() < deadline:
-            if producer.kv_connector.server.registered_count == 1:
+            if producer.kv_connector.server.registered_count == n_cells:
                 break
             time.sleep(0.02)
-        assert producer.kv_connector.server.registered_count == 1
+        assert producer.kv_connector.server.registered_count == n_cells
 
         # Phase 2: decode with the captured params injected.
         toks, final = _run(consumer, PROMPT, max_tokens=8, kv_transfer_params=params)
@@ -388,9 +393,12 @@ def test_lease_renewal_keeps_chunked_export_alive():
         )
         params = pre.kv_transfer_params
         host, port = params["remote_host"], int(params["remote_port"])
+        # v3 group framing: one shipper entry per (layer-group, chunk)
+        # cell — transfer_keys is the single source of the key scheme.
+        n_cells = len(transfer_keys(params))
         deadline = time.time() + 5
         while time.time() < deadline and (
-            producer.kv_connector.server.registered_count < 2
+            producer.kv_connector.server.registered_count < n_cells
         ):
             time.sleep(0.02)
         # hold for 4 base leases, renewing at ~1/3 lease cadence; EVERY
@@ -404,7 +412,7 @@ def test_lease_renewal_keeps_chunked_export_alive():
                 for k in transfer_keys(params)
             ]
             assert all(renewed), renewed
-        assert producer.kv_connector.server.registered_count == 2
+        assert producer.kv_connector.server.registered_count == n_cells
         n = consumer.kv_connector.import_for_prompt(prompt, params)
         assert n == 11  # every transferred page adopted
         assert consumer.kv_connector.import_failures == 0
@@ -511,6 +519,11 @@ def test_pd_int8_transfer_page_accuracy():
     and compare the dequantized pages to the producer's exact pages."""
     producer = make_engine(kv_role="kv_producer")
     producer.kv_connector.cfg.transfer_dtype = "int8"
+    # Monolithic v2 wire: this test inspects the fetched bundle's HOST
+    # view directly, which a group-streamed fetch never materializes
+    # (cells scatter straight into pool pages). Grouped int8 accuracy
+    # is covered by the streamed-parity tests in test_kv_stream.py.
+    producer.kv_connector.cfg.stream_groups = 1
     consumer = make_engine(kv_role="kv_consumer")
     try:
         prompt = list(range(1, 30))  # 7 full pages
