@@ -96,6 +96,18 @@ extras (north-star shapes, BASELINE.json):
                     greedy+seeded), plus the lora_tenant fleetsim
                     scenario affinity-routed vs adapter-blind — the
                     exact virtual-time resident-hit-ratio lift.
+  moe_ep          — wide-EP dispatch-path CPU-sim part (wide-ep.md):
+                    the real moe_block_ep census on the 8-device
+                    virtual mesh — hot-expert required capacity and
+                    drops before vs after the real EPLB placement,
+                    AdaptiveCapacity converging below static 2.0 at
+                    zero drops (fewer padded slots, smaller a2a
+                    payload), and the expert_skew fleetsim scenario's
+                    EPLB-on-vs-identity comparison at reduced scale.
+  moe_overlap     — microbatched overlapped expert dispatch on/off
+                    step time on the virtual CPU mesh; byte-identity
+                    gated in tests, flag default off, graduates on a
+                    real-slice win (same contract as dbo).
   pd_stream       — layer-streamed disaggregated TTFT CPU-sim part
                     (kv-cache.md "layer-streamed import"): the full
                     sidecar two-phase P->D stack at a CPU-compilable
@@ -1008,6 +1020,10 @@ def _run_part(part: str):
         return out
     if part == "dbo":
         return _bench_dbo_delta()
+    if part == "moe_ep":
+        return _bench_moe_ep()
+    if part == "moe_overlap":
+        return _bench_moe_overlap()
     if part == "async_step":
         return bench_async_step()
     if part == "spec_decode":
@@ -2340,6 +2356,229 @@ def _bench_dbo_delta():
     }
 
 
+def _moe_ep_mesh():
+    """8-device virtual CPU mesh + tiny EP-MoE geometry shared by the
+    moe_ep / moe_overlap parts (fresh subprocess via --only, so the
+    device-count flag can still land before the first jax import)."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=8".strip()
+        )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from llmd_tpu.config import ParallelConfig, tiny_model_config
+    from llmd_tpu.models import llama
+    from llmd_tpu.parallel.mesh import build_mesh
+
+    cfg = tiny_model_config(
+        num_experts=8, num_experts_per_tok=2, hidden_size=128,
+        moe_intermediate_size=64, num_layers=1, num_heads=8, num_kv_heads=4,
+    )
+    ctx = build_mesh(ParallelConfig(data_parallel_size=8))
+    lp = {
+        k: v[0]
+        for k, v in llama.init_params(cfg, jax.random.key(0))["layers"].items()
+        if k.startswith(("router", "we_", "ws_"))
+    }
+    return cfg, ctx, lp
+
+
+def _bench_moe_ep():
+    """Wide-EP dispatch-path CPU-sim part (wide-ep.md /
+    wide-ep-perf-model.md): the three legs the perf model prices, all
+    measured through the REAL ``moe_block_ep`` census on the 8-device
+    virtual mesh (numerics/byte-identity are gated in
+    tests/test_wide_ep.py; this records the payload/skew/drop counts
+    the model predicts).
+
+    HOT-EXPERT leg — a worst-case router (every token to experts 0+1)
+    vs the same batch after the real EPLB placement
+    (``compute_placement`` on the measured census, redundancy 1):
+    per-destination required capacity_factor and dropped slots at
+    static C=2.0, before vs after balancing — the factor-of-W/k skew
+    EPLB erases.
+
+    ADAPTIVE leg — a naturally-imbalanced router: the AdaptiveCapacity
+    ladder converges on the observed demand and ships strictly fewer
+    padded slots (and a2a payload bytes, 2 x W x C x H x 4 per
+    microbatch both directions) than static 2.0 — both legs at ZERO
+    dropped slots (the CI summary asserts this).
+
+    FLEET leg — the expert_skew fleetsim scenario EPLB-on vs
+    identity-layout on the same seeded Zipf trace: exact virtual-time
+    dropped-slot and mean-shard-skew comparison plus the tail-TPOT
+    ratio."""
+    cfg, ctx, lp = _moe_ep_mesh()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from llmd_tpu.parallel.eplb import AdaptiveCapacity, compute_placement
+    from llmd_tpu.parallel.moe_ep import _capacity, moe_block_ep
+
+    E, H, k = cfg.num_experts, cfg.hidden_size, cfg.num_experts_per_tok
+    W = ctx.world
+    B, T = 8, 64  # 512 tokens -> t*k/W = 128 per destination at balance
+    h = jax.random.normal(jax.random.key(1), (B, T, H), jnp.float32)
+
+    def census_of(lp, factor, placement=None, hh=None):
+        with ctx.mesh:
+            _, census = jax.jit(lambda h, lp: moe_block_ep(
+                h, lp, cfg, ctx.mesh, capacity_factor=factor,
+                placement=placement, emit_census=True,
+            ))(h if hh is None else hh, lp)
+        return np.asarray(census)
+
+    # HOT-EXPERT leg: zeroed router logits tie every score, so top-k
+    # routes every token to logical experts 0 and 1 — the two hottest
+    # destinations take W/k = 4x the balanced flow.
+    lp_hot = dict(lp)
+    lp_hot["router"] = jnp.zeros_like(lp["router"])
+    hot = census_of(lp_hot, 2.0)
+    counts = hot[:E]
+    pl = compute_placement(counts, world=W, redundancy=1)
+    tables = {
+        "phys_to_logical": jnp.asarray(pl.phys_to_logical),
+        "replicas": jnp.asarray(pl.replicas),
+        "n_replicas": jnp.asarray(pl.n_replicas),
+    }
+    # Physical expert weights = logical gathered through the placement
+    # (the runner's we_* leaf remap at the step boundary).
+    lp_bal = {
+        k2: (jnp.take(v, tables["phys_to_logical"], axis=0)
+             if k2.startswith("we_") else v)
+        for k2, v in lp_hot.items()
+    }
+    balanced = census_of(lp_bal, 2.0, placement=tables)
+
+    # ADAPTIVE leg: the natural (mildly imbalanced) router, balanced by
+    # its own EPLB placement — the deployment shape. Feed the measured
+    # required factor to the ladder until the down-hysteresis clears,
+    # then price the padded slots / a2a bytes each factor ships.
+    # Serving-sized batch: per-destination demand noise shrinks with
+    # sample count, which is what lets the ladder settle under 2.0.
+    Tb = 256
+    h_big = jax.random.normal(jax.random.key(2), (B, Tb, H), jnp.float32)
+    nat = census_of(lp, 8.0, hh=h_big)  # lossless probe: read true demand
+    pl_nat = compute_placement(nat[:E], world=W, redundancy=1)
+    tables_nat = {
+        "phys_to_logical": jnp.asarray(pl_nat.phys_to_logical),
+        "replicas": jnp.asarray(pl_nat.replicas),
+        "n_replicas": jnp.asarray(pl_nat.n_replicas),
+    }
+    lp_nat = {
+        k2: (jnp.take(v, tables_nat["phys_to_logical"], axis=0)
+             if k2.startswith("we_") else v)
+        for k2, v in lp.items()
+    }
+    need = float(census_of(lp_nat, 8.0, placement=tables_nat, hh=h_big)[E + 1])
+    ladder = AdaptiveCapacity(base=2.0)
+    factor = 2.0
+    for _ in range(3 * ladder.hold_steps):
+        nxt = ladder.observe(need)
+        if nxt is not None:
+            factor = nxt
+    t_loc = B * Tb // W
+    c_static, c_adapt = _capacity(t_loc, k, W, 2.0), _capacity(t_loc, k, W, factor)
+    a2a_bytes = lambda c: 2 * W * c * H * 4  # noqa: E731  dispatch + combine
+    drops_static = float(
+        census_of(lp_nat, 2.0, placement=tables_nat, hh=h_big)[E]
+    )
+    drops_adapt = float(
+        census_of(lp_nat, factor, placement=tables_nat, hh=h_big)[E]
+    )
+
+    # FLEET leg at reduced scale (the full-scale matrix runs in CI).
+    from llmd_tpu.fleetsim.scenarios import build_expert_skew
+
+    on = build_expert_skew(0, 0.25, eplb=True).run()
+    off = build_expert_skew(0, 0.25, eplb=False).run()
+
+    return {
+        "geometry": f"E{E} k{k} over {W} EP shards, {B * T} tokens/step",
+        "hot_required_factor": round(float(hot[E + 1]), 3),
+        "hot_dropped_slots_static2": int(hot[E]),
+        "eplb_required_factor": round(float(balanced[E + 1]), 3),
+        "eplb_dropped_slots_static2": int(balanced[E]),
+        "expert_counts_skew": round(
+            float(counts.max() / max(counts.mean(), 1e-9)), 3
+        ),
+        "adaptive_factor": factor,
+        "adaptive_required": round(need, 3),
+        "padded_slots_static2": W * c_static,
+        "padded_slots_adaptive": W * c_adapt,
+        "a2a_mb_static2": round(a2a_bytes(c_static) / 2**20, 3),
+        "a2a_mb_adaptive": round(a2a_bytes(c_adapt) / 2**20, 3),
+        "dropped_slots_static2": drops_static,
+        "dropped_slots_adaptive": drops_adapt,
+        "fleet_dropped_on_vs_off": [
+            on["expert_skew"]["dropped_slots"],
+            off["expert_skew"]["dropped_slots"],
+        ],
+        "fleet_mean_skew_on_vs_off": [
+            on["expert_skew"]["mean_shard_skew"],
+            off["expert_skew"]["mean_shard_skew"],
+        ],
+        "fleet_tpot_p99_ratio": round(
+            on["latency_ms"]["tpot"]["p99"] / off["latency_ms"]["tpot"]["p99"],
+            3,
+        ),
+    }
+
+
+def _bench_moe_overlap():
+    """Microbatched overlapped expert dispatch on/off step time on the
+    8-device virtual CPU mesh (wide-ep.md "overlapped dispatch").
+    Byte-identity of the microbatched path is gated in
+    tests/test_wide_ep.py; this records the measured ratio. Same
+    graduation contract as DBO: the flag is experimental and default
+    OFF until a real TPU slice shows overlap >= 2 step time strictly
+    below overlap = 0 at serving batch — the falsifiable gate; on the
+    CPU mesh the extra a2a dispatches have nothing to hide behind, so
+    on > off here is EXPECTED, not a defect."""
+    cfg, ctx, lp = _moe_ep_mesh()
+    import jax
+    import jax.numpy as jnp
+
+    from llmd_tpu.parallel.moe_ep import moe_block_ep
+
+    h = jax.random.normal(
+        jax.random.key(1), (8, 64, cfg.hidden_size), jnp.float32
+    )
+
+    def step_time(overlap):
+        with ctx.mesh:
+            f = jax.jit(lambda h, lp: moe_block_ep(
+                h, lp, cfg, ctx.mesh, capacity_factor=2.0, overlap=overlap,
+            ))
+            f(h, lp).block_until_ready()
+            samples = []
+            for _ in range(10):
+                t0 = time.monotonic()
+                f(h, lp).block_until_ready()
+                samples.append(time.monotonic() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    off, on = step_time(0), step_time(2)
+    return {
+        "overlap_off_ms": round(off * 1e3, 2),
+        "overlap2_ms": round(on * 1e3, 2),
+        "substrate": "8-dev virtual CPU mesh (dp8, ep8)",
+        "note": (
+            "byte-identical microbatched dispatch "
+            "(tests/test_wide_ep.py); experimental, default off, "
+            "graduates on a real-slice overlap-on win at serving batch"
+        ),
+    }
+
+
 def _atomic_write_json(path: str, obj) -> None:
     """Write JSON via tmp + rename: a SIGKILL mid-write must never leave
     a torn/unparseable file (the partial stream IS the crash record)."""
@@ -2383,6 +2622,7 @@ _CPU_PARTS = frozenset({
     "dbo", "async_step", "spec_decode", "spec_window", "unified_step",
     "ragged_step", "fault_degrade", "fleet_soak", "kv_federation",
     "stream_resume", "batch_backfill", "lora_pool", "pd_stream",
+    "moe_ep", "moe_overlap",
 })
 
 # Every part main() can dispatch, in run order (also the validation set
@@ -2394,7 +2634,8 @@ _CPU_PARTS = frozenset({
 # driver's kill) lands, the summary already holds everything cheaper.
 _ALL_PARTS = (
     "ragged_step", "unified_step", "async_step", "spec_decode",
-    "spec_window", "dbo", "fault_degrade", "fleet_soak", "kv_federation",
+    "spec_window", "dbo", "moe_ep", "moe_overlap", "fault_degrade",
+    "fleet_soak", "kv_federation",
     "stream_resume", "batch_backfill", "lora_pool", "pd_stream",
     "rtt", "env", "dense_int8", "dense_bf16", "mla_moe",
     "kv_int8_long", "kv_bf16_long", "swa_ring_off", "swa_ring_on",
@@ -2531,6 +2772,8 @@ def main() -> None:
         "spec_decode": (set_key("spec_decode"), None),
         "spec_window": (set_key("spec_window"), None),
         "dbo": (set_key("dbo"), None),
+        "moe_ep": (set_key("moe_ep"), None),
+        "moe_overlap": (set_key("moe_overlap"), None),
         "fault_degrade": (set_key("fault_degrade"), None),
         "fleet_soak": (set_key("fleet_soak"), None),
         "kv_federation": (set_key("kv_federation"), None),

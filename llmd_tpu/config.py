@@ -573,6 +573,37 @@ class ParallelConfig:
     # EP dispatch capacity factor (send slots per destination shard relative
     # to a uniform split; tokens past capacity are dropped from the combine).
     ep_capacity_factor: float = 2.0
+    # Skew-proof capacity: adapt ep_capacity_factor online from the
+    # census's observed per-step max dispatch demand (EMA + hysteresis,
+    # quantized onto eplb.AdaptiveCapacity.LADDER so recompiles stay
+    # rare). Steps UP immediately when a step drops tokens; steps DOWN
+    # only after a sustained run of low-skew steps. Each change rebuilds
+    # the jitted forward programs at the new static capacity.
+    ep_capacity_adaptive: bool = False
+    # Microbatched overlapped EP dispatch (moe_ep.moe_block_ep overlap):
+    # split each MoE layer's dispatch->grouped-GEMM->combine chain into N
+    # independent microbatches so XLA's latency-hiding scheduler can issue
+    # microbatch i+1's all-to-all while microbatch i's expert matmul still
+    # runs. Byte-identical to the monolithic path at zero-drop capacity
+    # (tests/test_wide_ep.py pins it).
+    #
+    # SUBSTRATE CONDITION (same graduate gate as enable_dbo): the overlap
+    # only pays where collectives run asynchronously on a real ICI
+    # fabric; on the virtual CPU mesh the extra a2a launches are pure
+    # overhead — bench.py's moe_ep part records the on/off step-time
+    # delta, and the flag graduates to default-on only when a real-slice
+    # bench shows a win (docs/architecture/dbo.md discipline). 0/1 = off.
+    moe_overlap: int = 0
+    # EPLB (DeepSeek-V3 expert placement load balancing,
+    # llmd_tpu.parallel.eplb): every eplb_interval_steps engine steps,
+    # recompute the expert->shard placement from the census's measured
+    # per-expert routed-token counts and remap the we_* param leaves at
+    # the step boundary. 0 disables (the identity contiguous layout).
+    eplb_interval_steps: int = 0
+    # Extra physical expert slots PER SHARD for EPLB redundancy: the
+    # hottest experts are replicated into these slots so their traffic
+    # splits across shards (E_phys = E + world * eplb_redundancy).
+    eplb_redundancy: int = 0
     # Dual-batch overlap (the reference's --enable-dbo, wide-ep
     # decode.yaml:125-126): split each step into two half-batch chains
     # after the KV write so the EP all-to-all of one half overlaps the

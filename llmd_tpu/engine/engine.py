@@ -330,6 +330,21 @@ class EngineStats:
     stream_resumes_total: int = 0
     resume_replayed_tokens_total: int = 0
     stream_resume_failures_total: int = 0
+    # Wide-EP MoE (docs/architecture/wide-ep.md): the per-expert load
+    # census drained from the runner each step. moe_expert_tokens is the
+    # cumulative routed-token count per LOGICAL expert (rendered as the
+    # moe_expert_tokens_total labeled series — the EPLB control loop's
+    # input); dropped slots are valid (token, expert) assignments that
+    # lost the capacity race; peak demand is the largest observed
+    # per-destination dispatch demand as a capacity-factor multiple (the
+    # adaptive controller's input: >1.0 means the static factor would
+    # have dropped); capacity_factor is the LIVE factor the compiled
+    # programs were traced at; rebalances counts EPLB placements applied.
+    moe_expert_tokens: tuple = ()
+    moe_dropped_slots_total: int = 0
+    moe_peak_demand: float = 0.0
+    moe_capacity_factor: float = 0.0
+    moe_rebalances_total: int = 0
 
 
 @dataclass
@@ -606,6 +621,40 @@ class LLMEngine:
             self._spec_proposer = NgramProposer(
                 min_match=config.scheduler.spec_ngram_min_match
             )
+
+        # Wide-EP MoE control loops (docs/architecture/wide-ep.md): the
+        # runner accumulates a device-side census ([E] routed tokens per
+        # logical expert, dropped slots, peak dispatch demand); the engine
+        # drains it at step boundaries and feeds two slow controllers —
+        # adaptive capacity (ep_capacity_adaptive) and EPLB placement
+        # (eplb_interval_steps). Both act through runner methods that
+        # rebuild the compiled programs, so they only ever fire between
+        # steps. EPLB is leader-only single-host (the remap gather is a
+        # host-driven reshard).
+        pc = config.parallel
+        self._moe_active = self.runner._moe_census is not None
+        self._moe_expert_tokens = (
+            np.zeros(config.model.num_experts, np.int64)
+            if self._moe_active else None
+        )
+        self._adaptive_cap = None
+        if self._moe_active and pc.ep_capacity_adaptive:
+            from llmd_tpu.parallel.eplb import AdaptiveCapacity
+
+            self._adaptive_cap = AdaptiveCapacity(base=pc.ep_capacity_factor)
+        self._eplb_interval = (
+            int(pc.eplb_interval_steps)
+            if self._moe_active and jax.process_count() == 1 else 0
+        )
+        self._eplb_redundancy = int(pc.eplb_redundancy)
+        self._eplb_next = self._eplb_interval
+        self._eplb_window_base = (
+            np.zeros(config.model.num_experts, np.int64)
+            if self._eplb_interval else None
+        )
+        if self._moe_active:
+            self.stats.moe_expert_tokens = (0,) * config.model.num_experts
+            self.stats.moe_capacity_factor = self.runner.ep_capacity
 
     def _on_finish(self, req) -> None:
         if self.kv_connector is not None and self.kv_connector.wants_export(req):
@@ -1781,7 +1830,54 @@ class LLMEngine:
         self.stats.step_host_gap_ms_total = round(
             self.stats.step_host_gap_ms_total + gap_ms, 3
         )
+        self._moe_tick()
         self._refresh_gauges()
+
+    def _moe_tick(self) -> None:
+        """Drain the wide-EP census and run the slow control loops.
+
+        Per step: fold routed-token counts / dropped slots / peak demand
+        into EngineStats, and let the adaptive-capacity controller move
+        the live factor (hysteresis lives in AdaptiveCapacity, so
+        retrace-causing moves are rare and deliberate). Every
+        eplb_interval_steps: compute a fresh expert->shard placement from
+        the loads observed SINCE the last rebalance (not all-time — the
+        balancer must track drift, not history) and apply it at this
+        step boundary."""
+        if not self._moe_active:
+            return
+        census = self.runner.drain_moe_census()
+        if census is None:
+            return
+        E = self.config.model.num_experts
+        self._moe_expert_tokens += census[:E].astype(np.int64)
+        self.stats.moe_expert_tokens = tuple(
+            int(v) for v in self._moe_expert_tokens
+        )
+        self.stats.moe_dropped_slots_total += int(census[E])
+        need = float(census[E + 1])
+        if need > self.stats.moe_peak_demand:
+            self.stats.moe_peak_demand = round(need, 4)
+        if self._adaptive_cap is not None:
+            factor = self._adaptive_cap.observe(need)
+            if factor is not None:
+                self.runner.set_ep_capacity(factor)
+        self.stats.moe_capacity_factor = self.runner.ep_capacity
+        steps = self.stats.engine_steps_total
+        if self._eplb_interval and steps >= self._eplb_next:
+            self._eplb_next = steps + self._eplb_interval
+            window = self._moe_expert_tokens - self._eplb_window_base
+            if window.sum() > 0:
+                from llmd_tpu.parallel.eplb import compute_placement
+
+                placement = compute_placement(
+                    window,
+                    world=self.ctx.world,
+                    redundancy=self._eplb_redundancy,
+                )
+                self.runner.apply_expert_placement(placement)
+                self.stats.moe_rebalances_total += 1
+                self._eplb_window_base = self._moe_expert_tokens.copy()
 
     def _refresh_gauges(self) -> None:
         self.stats.num_waiting = self.scheduler.num_waiting

@@ -355,6 +355,31 @@ class ModelRunner:
                 params = llama.init_params(self.cfg, jax.random.key(config.seed))
         params = self._maybe_fuse(params)
         self.params = shard_params(params, mesh_ctx)
+        # Wide-EP MoE live state. ep_capacity is the LIVE capacity factor
+        # (the adaptive controller may move it; every change rebuilds the
+        # jitted programs so each compiled family sees exactly one static
+        # capacity). The census buffer is the [E+2] accumulator
+        # (moe_ep.CENSUS layout: per-expert routed tokens, dropped slots,
+        # max dispatch demand) threaded through every forward and drained
+        # by the engine's stats refresh — no extra per-step host
+        # transfer beyond the read the stats path already does.
+        pc = config.parallel
+        self.ep_capacity = float(pc.ep_capacity_factor)
+        self._ep_active = bool(self.cfg.is_moe) and pc.moe_backend == "ep"
+        self.moe_overlap = int(pc.moe_overlap) if self._ep_active else 0
+        self._moe_census = None
+        if self._ep_active:
+            from llmd_tpu.parallel.moe_ep import census_size
+
+            self._moe_census = jax.device_put(
+                np.zeros(census_size(self.cfg), np.float32),
+                mesh_ctx.replicated,
+            )
+        # Pristine logical [L, E, ...] expert leaves, stashed on first
+        # EPLB remap so later placements regather from the un-replicated
+        # originals; the host-side Placement mirrors params["moe_placement"].
+        self._logical_experts: dict | None = None
+        self.moe_placement = None
         # SWA ring (CacheConfig.swa_ring): sliding-window layers live in a
         # second, smaller pool indexed through a ring-view page table.
         self.swa = self._swa_spec_arg or swa_ring_spec(
@@ -422,6 +447,17 @@ class ModelRunner:
                 "enable only on a real multi-chip slice and trust the "
                 "bench delta (docs/architecture/dbo.md)"
             )
+        if self.moe_overlap > 1 and not ops._on_tpu():
+            # Same substrate condition as DBO: see ParallelConfig.
+            # moe_overlap and the bench moe_ep part's on/off delta.
+            log.warning(
+                "moe_overlap=%d without a TPU backend: the microbatched "
+                "EP dispatch only pays where the all-to-all runs "
+                "asynchronously on a real ICI fabric; on the CPU mesh the "
+                "extra collective launches are pure overhead. EXPERIMENTAL: "
+                "graduate via the bench moe_ep part on a real slice "
+                "(docs/architecture/wide-ep.md)", self.moe_overlap,
+            )
         sched = config.scheduler
         self.batch_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
         self.prefill_batch_buckets = (
@@ -430,6 +466,19 @@ class ModelRunner:
         self.prefill_buckets = sched.prefill_token_buckets or _buckets(
             sched.max_num_batched_tokens, start=16
         )
+        self._build_programs()
+        # Padding-efficiency accounting (EngineStats padded/live tokens):
+        # every dispatch path adds its live token count and the padded
+        # compute width the traced shape actually paid for.
+        self.live_tokens_total = 0
+        self.padded_tokens_total = 0
+
+    def _build_programs(self) -> None:
+        """(Re)build every jitted forward program. Called at init and
+        whenever a trace-time MoE static changes — an adaptive
+        ep_capacity step or an EPLB remap (the we_* leaves change shape)
+        — so no compiled family ever runs a stale capacity/placement."""
+        sched = self.config.scheduler
         self._forward = self._build_forward()
         self._multi = self._build_multi()
         # Speculative decoding (SchedulerConfig.speculative_ngram): the
@@ -499,11 +548,88 @@ class ModelRunner:
             self.flat_t_buckets = tuple(range(16, limit + 1, 16))
             self.flat_rows = self.unified_row_buckets[-1]
             self._flat = self._build_flat()
-        # Padding-efficiency accounting (EngineStats padded/live tokens):
-        # every dispatch path adds its live token count and the padded
-        # compute width the traced shape actually paid for.
-        self.live_tokens_total = 0
-        self.padded_tokens_total = 0
+
+    # ------------------------------------------------------------------ #
+    # Wide-EP MoE control plane (census drain, adaptive capacity, EPLB)
+
+    # Expert param leaves remapped by an EPLB placement (present subset
+    # only: bf16 weights, int8 channel scales, gpt-oss biases).
+    _EXPERT_LEAVES = (
+        "we_gate", "we_up", "we_down",
+        "we_gate_scale", "we_up_scale", "we_down_scale",
+        "we_gate_b", "we_up_b", "we_down_b",
+    )
+
+    def drain_moe_census(self) -> np.ndarray | None:
+        """Read-and-reset the MoE census accumulator ([E+2] f32: routed
+        tokens per logical expert, dropped slots, max dispatch demand as
+        a capacity-factor multiple). Called by the engine's stats refresh
+        once per step — the read rides the sync the stats path already
+        does."""
+        if self._moe_census is None:
+            return None
+        from llmd_tpu.parallel.distributed import replicated_to_host
+
+        out = np.asarray(replicated_to_host(self._moe_census))
+        self._moe_census = jax.device_put(
+            np.zeros_like(out), self.ctx.replicated
+        )
+        return out
+
+    def set_ep_capacity(self, factor: float) -> None:
+        """Move the live EP capacity factor (adaptive controller step).
+        Rebuilds the jitted programs: capacity is a trace-time static, so
+        every compiled family must re-trace at the new value."""
+        if float(factor) == self.ep_capacity:
+            return
+        self.ep_capacity = float(factor)
+        self._build_programs()
+
+    def apply_expert_placement(self, placement) -> None:
+        """Install an EPLB placement (parallel.eplb.Placement) at a step
+        boundary: regather the ``we_*`` leaves from the pristine logical
+        layout into the physical one ([L, E_phys, ...], hot experts
+        replicated), publish the routing tables into
+        ``params["moe_placement"]`` (the router maps logical ids through
+        them inside moe_block_ep), and rebuild the programs — the leaf
+        shapes changed, so every family re-traces exactly once per
+        placement epoch."""
+        if not self._ep_active:
+            raise RuntimeError("EPLB requires moe_backend='ep'")
+        self._require_single_host("apply_expert_placement (EPLB)")
+        from llmd_tpu.parallel.mesh import param_specs
+
+        layers = dict(self.params["layers"])
+        names = [k for k in self._EXPERT_LEAVES if k in layers]
+        if self._logical_experts is None:
+            self._logical_experts = {k: layers[k] for k in names}
+        idx = jnp.asarray(placement.phys_to_logical, jnp.int32)
+        specs = param_specs({k: self._logical_experts[k] for k in names})
+        with self._dispatch_lock:
+            for k in names:
+                # llmd: allow(trace-discipline) -- control-plane only: runs once per EPLB placement epoch (eplb_interval_steps), never on the step path; out_shardings is per-leaf so the gather lands sharded without a host roundtrip
+                gather = jax.jit(
+                    lambda w, i: jnp.take(w, i, axis=1),
+                    out_shardings=self.ctx.sharding(*specs[k]),
+                )
+                layers[k] = gather(self._logical_experts[k], idx)
+            tables = {
+                "phys_to_logical": placement.phys_to_logical,
+                "replicas": placement.replicas,
+                "n_replicas": placement.n_replicas,
+            }
+            self.params = {
+                **self.params,
+                "layers": layers,
+                "moe_placement": {
+                    k: jax.device_put(
+                        np.asarray(v, np.int32), self.ctx.replicated
+                    )
+                    for k, v in tables.items()
+                },
+            }
+            self.moe_placement = placement
+            self._build_programs()
 
     # ------------------------------------------------------------------ #
 
@@ -708,13 +834,42 @@ class ModelRunner:
             return packed
         return jax.lax.with_sharding_constraint(packed, self.ctx.replicated)
 
+    def _fwd_hidden(self, params, kv_cache, kv_swa, inp, census, dbo=False):
+        """llama.forward_hidden under this runner's trace-time MoE/EP
+        statics (live ep_capacity, moe_overlap, the EPLB placement riding
+        in ``params["moe_placement"]``), threading the census accumulator
+        when armed. Returns (hidden, kv_cache, kv_swa, census) uniformly
+        so every builder shares one call shape. Builders are recreated by
+        _build_programs whenever a static here changes, so each compiled
+        family sees exactly one value."""
+        cfg = self.cfg
+        moe_backend = (
+            self.config.parallel.moe_backend if cfg.is_moe else "dense"
+        )
+        kw = {}
+        ring = self.swa is not None
+        if ring:
+            kw["kv_swa"] = kv_swa
+        if census is not None:
+            kw["moe_census"] = census
+        out = llama.forward_hidden(
+            params, kv_cache, inp, cfg, self.ctx.world,
+            mesh=self.ctx.mesh, moe_backend=moe_backend,
+            ep_capacity_factor=self.ep_capacity, kv_rep=self.kv_rep,
+            dbo=dbo, moe_overlap=self.moe_overlap,
+            moe_placement=params.get("moe_placement"),
+            **kw,
+        )
+        if census is not None:
+            census = out[-1]
+            out = out[:-1]
+        hidden, kv_cache = out[0], out[1]
+        if ring:
+            kv_swa = out[2]
+        return hidden, kv_cache, kv_swa, census
+
     def _build_forward(self):
         cfg = self.cfg
-        world = self.ctx.world
-        mesh = self.ctx.mesh
-        kv_rep = self.kv_rep
-        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
-        ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
         ring = self.swa is not None
@@ -725,20 +880,10 @@ class ModelRunner:
             static_argnames=("all_greedy",),
         )
         def fwd(params, kv_cache, kv_swa, inp: StepInput, s: SamplingInputs,
-                all_greedy=False):
-            if ring:
-                hidden, kv_cache, kv_swa = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                    kv_swa=kv_swa,
-                )
-            else:
-                hidden, kv_cache = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                )
+                census=None, all_greedy=False):
+            hidden, kv_cache, kv_swa, census = self._fwd_hidden(
+                params, kv_cache, kv_swa, inp, census, dbo=dbo
+            )
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
             h_last = hidden[jnp.arange(B), last]
@@ -748,7 +893,7 @@ class ModelRunner:
             packed = jnp.concatenate(
                 [tokens.astype(jnp.float32)[:, None], logprobs[:, None]], axis=1
             )
-            return kv_cache, kv_swa, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed), census
 
         return fwd
 
@@ -763,11 +908,6 @@ class ModelRunner:
         positions is written provisionally — the scheduler truncates
         past the accepted prefix before any page commit."""
         cfg = self.cfg
-        world = self.ctx.world
-        mesh = self.ctx.mesh
-        kv_rep = self.kv_rep
-        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
-        ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
         ring = self.swa is not None
@@ -778,20 +918,10 @@ class ModelRunner:
             static_argnames=("all_greedy",),
         )
         def verify(params, kv_cache, kv_swa, inp: StepInput, s: SamplingInputs,
-                   all_greedy=False):
-            if ring:
-                hidden, kv_cache, kv_swa = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                    kv_swa=kv_swa,
-                )
-            else:
-                hidden, kv_cache = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                )
+                   census=None, all_greedy=False):
+            hidden, kv_cache, kv_swa, census = self._fwd_hidden(
+                params, kv_cache, kv_swa, inp, census, dbo=dbo
+            )
             B, Q, H = hidden.shape
             logits = llama.compute_logits(params, hidden.reshape(B * Q, H), cfg)
             flat = SamplingInputs(
@@ -810,7 +940,7 @@ class ModelRunner:
                 ],
                 axis=1,
             )
-            return kv_cache, kv_swa, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed), census
 
         return verify
 
@@ -835,11 +965,6 @@ class ModelRunner:
         one coalesced readback, where the split engine pays one per
         program."""
         cfg = self.cfg
-        world = self.ctx.world
-        mesh = self.ctx.mesh
-        kv_rep = self.kv_rep
-        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
-        ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
         ring = self.swa is not None
@@ -867,7 +992,8 @@ class ModelRunner:
             top_k: jax.Array,
             top_p: jax.Array,
             seeds: jax.Array,  # [B, S]
-            Q: int,
+            census=None,  # [E+2] MoE census accumulator, or None
+            Q: int = 0,
             all_greedy: bool = False,
         ):
             B = row_start.shape[0]
@@ -893,19 +1019,9 @@ class ModelRunner:
                 lora_ids=lora_ids,
                 swa_page_table=swa_table,
             )
-            if ring:
-                hidden, kv_cache, kv_swa = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                    kv_swa=kv_swa,
-                )
-            else:
-                hidden, kv_cache = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                )
+            hidden, kv_cache, kv_swa, census = self._fwd_hidden(
+                params, kv_cache, kv_swa, inp, census, dbo=dbo
+            )
             H = hidden.shape[-1]
             scols = jnp.arange(S)
             # Verify rows sample every scored position (the one-shot
@@ -933,7 +1049,7 @@ class ModelRunner:
                 ],
                 axis=1,
             )  # [B, 2S]
-            return kv_cache, kv_swa, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed), census
 
         return unified
 
@@ -956,11 +1072,6 @@ class ModelRunner:
         hidden stream and the step still comes back as ONE ``[B, 2S]``
         transfer."""
         cfg = self.cfg
-        world = self.ctx.world
-        mesh = self.ctx.mesh
-        kv_rep = self.kv_rep
-        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
-        ep_capacity = self.config.parallel.ep_capacity_factor
         replicate = self._replicate_out
         ring = self.swa is not None
         S = self.unified_s
@@ -991,6 +1102,7 @@ class ModelRunner:
             wcnt: jax.Array,  # [R] token count per run (0 = pad)
             wphys: jax.Array,  # [R] physical page per run (main pool)
             wphys_swa,  # [R] physical page per run (ring pool), or None
+            census=None,  # [E+2] MoE census accumulator, or None
             all_greedy: bool = False,
         ):
             T = stream.shape[0]
@@ -1019,19 +1131,9 @@ class ModelRunner:
                 token_rows=row_of,
                 flat_runs=((wsrc, woff, wcnt), wphys, wphys_swa),
             )
-            if ring:
-                hidden, kv_cache, kv_swa = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
-                    kv_swa=kv_swa,
-                )
-            else:
-                hidden, kv_cache = llama.forward_hidden(
-                    params, kv_cache, inp, cfg, world,
-                    mesh=mesh, moe_backend=moe_backend,
-                    ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
-                )
+            hidden, kv_cache, kv_swa, census = self._fwd_hidden(
+                params, kv_cache, kv_swa, inp, census
+            )
             H = hidden.shape[-1]
             scols = jnp.arange(S)
             last = jnp.maximum(qlens - 1, 0)
@@ -1057,7 +1159,7 @@ class ModelRunner:
                 ],
                 axis=1,
             )  # [B, 2S]
-            return kv_cache, kv_swa, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed), census
 
         return flat
 
@@ -1082,11 +1184,6 @@ class ModelRunner:
         accepted/iters-active) + window x (1+k) token and logprob
         columns — ONE host round-trip per K verify iterations."""
         cfg = self.cfg
-        world = self.ctx.world
-        mesh = self.ctx.mesh
-        kv_rep = self.kv_rep
-        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
-        ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
         ring = self.swa is not None
@@ -1118,7 +1215,8 @@ class ModelRunner:
             seed_base: jax.Array,  # [B] u32 request seed (seeded rows)
             seeded: jax.Array,  # [B] bool
             out0: jax.Array,  # [B] output index of the first emission
-            window: int,
+            census=None,  # [E+2] MoE census accumulator, or None
+            window: int = 1,
             all_greedy: bool = False,
         ):
             B = first_token.shape[0]
@@ -1127,7 +1225,7 @@ class ModelRunner:
             dcols = jnp.arange(k)
 
             def body(t, carry):
-                (kv_cache, kv_swa, tok, pos, emitted, dptr, alive,
+                (kv_cache, kv_swa, census, tok, pos, emitted, dptr, alive,
                  drafted, accepted, iters, out_t, out_l) = carry
                 rem = limit - emitted
                 row_on = active & (rem > 0)
@@ -1162,20 +1260,9 @@ class ModelRunner:
                     lora_ids=lora_ids,
                     swa_page_table=swa_table,
                 )
-                if ring:
-                    hidden, kv_cache, kv_swa = llama.forward_hidden(
-                        params, kv_cache, inp, cfg, world,
-                        mesh=mesh, moe_backend=moe_backend,
-                        ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
-                        dbo=dbo, kv_swa=kv_swa,
-                    )
-                else:
-                    hidden, kv_cache = llama.forward_hidden(
-                        params, kv_cache, inp, cfg, world,
-                        mesh=mesh, moe_backend=moe_backend,
-                        ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
-                        dbo=dbo,
-                    )
+                hidden, kv_cache, kv_swa, census = self._fwd_hidden(
+                    params, kv_cache, kv_swa, inp, census, dbo=dbo
+                )
                 H = hidden.shape[-1]
                 logits = llama.compute_logits(
                     params, hidden.reshape(B * Q, H), cfg
@@ -1236,35 +1323,31 @@ class ModelRunner:
                 drafted = drafted + dlen
                 accepted = accepted + n_acc
                 iters = iters + row_on.astype(jnp.int32)
-                return (kv_cache, kv_swa, tok, pos, emitted, dptr, alive,
-                        drafted, accepted, iters, out_t, out_l)
+                return (kv_cache, kv_swa, census, tok, pos, emitted, dptr,
+                        alive, drafted, accepted, iters, out_t, out_l)
 
             zeros = jnp.zeros(B, jnp.int32)
             carry = (
-                kv_cache, kv_swa, first_token, start_pos, zeros, zeros,
-                jnp.ones(B, bool), zeros, zeros, zeros,
+                kv_cache, kv_swa, census, first_token, start_pos, zeros,
+                zeros, jnp.ones(B, bool), zeros, zeros, zeros,
                 jnp.zeros((B, Wmax), jnp.int32),
                 jnp.zeros((B, Wmax), jnp.float32),
             )
-            (kv_cache, kv_swa, _, _, emitted, _, _, drafted, accepted,
-             iters, out_t, out_l) = jax.lax.fori_loop(0, window, body, carry)
+            (kv_cache, kv_swa, census, _, _, emitted, _, _, drafted,
+             accepted, iters, out_t, out_l) = jax.lax.fori_loop(
+                 0, window, body, carry)
             meta = jnp.stack(
                 [emitted, drafted, accepted, iters], axis=1
             ).astype(jnp.float32)
             packed = jnp.concatenate(
                 [meta, out_t.astype(jnp.float32), out_l], axis=1
             )  # [B, 4 + 2*Wmax]
-            return kv_cache, kv_swa, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed), census
 
         return verify_window
 
     def _build_multi(self):
         cfg = self.cfg
-        world = self.ctx.world
-        mesh = self.ctx.mesh
-        kv_rep = self.kv_rep
-        moe_backend = self.config.parallel.moe_backend if cfg.is_moe else "dense"
-        ep_capacity = self.config.parallel.ep_capacity_factor
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
         ring = self.swa is not None
@@ -1288,13 +1371,14 @@ class ModelRunner:
             top_k: jax.Array,
             top_p: jax.Array,
             seeds: jax.Array,  # [B, K]
-            k_steps: int,
+            census=None,  # [E+2] MoE census accumulator, or None
+            k_steps: int = 1,
             all_greedy: bool = False,
         ):
             B = first_token.shape[0]
 
             def body(i, carry):
-                kv_cache, kv_swa, tok, out_t, out_l = carry
+                kv_cache, kv_swa, census, tok, out_t, out_l = carry
                 pos = start_pos + i
                 inp = StepInput(
                     token_ids=tok[:, None],
@@ -1305,19 +1389,9 @@ class ModelRunner:
                     lora_ids=lora_ids,
                     swa_page_table=swa_table,
                 )
-                if ring:
-                    hidden, kv_cache, kv_swa = llama.forward_hidden(
-                        params, kv_cache, inp, cfg, world,
-                        mesh=mesh, moe_backend=moe_backend,
-                        ep_capacity_factor=ep_capacity, kv_rep=kv_rep,
-                        dbo=dbo, kv_swa=kv_swa,
-                    )
-                else:
-                    hidden, kv_cache = llama.forward_hidden(
-                        params, kv_cache, inp, cfg, world,
-                        mesh=mesh, moe_backend=moe_backend,
-                        ep_capacity_factor=ep_capacity, kv_rep=kv_rep, dbo=dbo,
-                    )
+                hidden, kv_cache, kv_swa, census = self._fwd_hidden(
+                    params, kv_cache, kv_swa, inp, census, dbo=dbo
+                )
                 logits = llama.compute_logits(params, hidden[:, 0, :], cfg)
                 s = SamplingInputs(
                     temperature=temperature,
@@ -1330,17 +1404,18 @@ class ModelRunner:
                 nxt, logp = sample_tokens(logits, s, all_greedy)
                 out_t = jax.lax.dynamic_update_index_in_dim(out_t, nxt, i, axis=1)
                 out_l = jax.lax.dynamic_update_index_in_dim(out_l, logp, i, axis=1)
-                return kv_cache, kv_swa, nxt, out_t, out_l
+                return kv_cache, kv_swa, census, nxt, out_t, out_l
 
             out_t = jnp.zeros((B, k_steps), jnp.int32)
             out_l = jnp.zeros((B, k_steps), jnp.float32)
-            kv_cache, kv_swa, _, out_t, out_l = jax.lax.fori_loop(
-                0, k_steps, body, (kv_cache, kv_swa, first_token, out_t, out_l)
+            kv_cache, kv_swa, census, _, out_t, out_l = jax.lax.fori_loop(
+                0, k_steps, body,
+                (kv_cache, kv_swa, census, first_token, out_t, out_l),
             )
             packed = jnp.concatenate(
                 [out_t.astype(jnp.float32), out_l], axis=1
             )  # [B, 2K]
-            return kv_cache, kv_swa, replicate(packed)
+            return kv_cache, kv_swa, replicate(packed), census
 
         return multi
 
@@ -2098,9 +2173,9 @@ class ModelRunner:
             top_p=jnp.asarray(arrays["top_p"]),
             seeds=jnp.asarray(arrays["seeds"]),
         )
-        self.kv_cache, self.kv_swa, packed = self._forward(
+        self.kv_cache, self.kv_swa, packed, self._moe_census = self._forward(
             self.params, self.kv_cache, self.kv_swa, inp, s,
-            all_greedy=all_greedy,
+            census=self._moe_census, all_greedy=all_greedy,
         )
         return packed
 
@@ -2125,14 +2200,14 @@ class ModelRunner:
             top_p=jnp.asarray(arrays["top_p"]),
             seeds=jnp.asarray(arrays["seeds"]),
         )
-        self.kv_cache, self.kv_swa, packed = self._verify(
+        self.kv_cache, self.kv_swa, packed, self._moe_census = self._verify(
             self.params, self.kv_cache, self.kv_swa, inp, s,
-            all_greedy=all_greedy,
+            census=self._moe_census, all_greedy=all_greedy,
         )
         return packed
 
     def _exec_unified(self, arrays: dict, Q: int, all_greedy: bool) -> jax.Array:
-        self.kv_cache, self.kv_swa, packed = self._unified(
+        self.kv_cache, self.kv_swa, packed, self._moe_census = self._unified(
             self.params,
             self.kv_cache,
             self.kv_swa,
@@ -2152,13 +2227,14 @@ class ModelRunner:
             jnp.asarray(arrays["top_k"]),
             jnp.asarray(arrays["top_p"]),
             jnp.asarray(arrays["seeds"]),
+            census=self._moe_census,
             Q=Q,
             all_greedy=all_greedy,
         )
         return packed
 
     def _exec_flat(self, arrays: dict, all_greedy: bool) -> jax.Array:
-        self.kv_cache, self.kv_swa, packed = self._flat(
+        self.kv_cache, self.kv_swa, packed, self._moe_census = self._flat(
             self.params,
             self.kv_cache,
             self.kv_swa,
@@ -2185,6 +2261,7 @@ class ModelRunner:
                 jnp.asarray(arrays["wphys_swa"])
                 if "wphys_swa" in arrays else None
             ),
+            census=self._moe_census,
             all_greedy=all_greedy,
         )
         return packed
@@ -2192,7 +2269,8 @@ class ModelRunner:
     def _exec_verify_window(
         self, arrays: dict, window: int, all_greedy: bool
     ) -> jax.Array:
-        self.kv_cache, self.kv_swa, packed = self._verify_window(
+        (self.kv_cache, self.kv_swa, packed,
+         self._moe_census) = self._verify_window(
             self.params,
             self.kv_cache,
             self.kv_swa,
@@ -2215,13 +2293,14 @@ class ModelRunner:
             jnp.asarray(arrays["seed_base"]),
             jnp.asarray(arrays["seeded"].astype(bool)),
             jnp.asarray(arrays["out0"]),
+            census=self._moe_census,
             window=window,
             all_greedy=all_greedy,
         )
         return packed
 
     def _exec_decode(self, arrays: dict, K: int, all_greedy: bool) -> jax.Array:
-        self.kv_cache, self.kv_swa, packed = self._multi(
+        self.kv_cache, self.kv_swa, packed, self._moe_census = self._multi(
             self.params,
             self.kv_cache,
             self.kv_swa,
@@ -2238,6 +2317,7 @@ class ModelRunner:
             jnp.asarray(arrays["top_k"]),
             jnp.asarray(arrays["top_p"]),
             jnp.asarray(arrays["seeds"]),
+            census=self._moe_census,
             k_steps=K,
             all_greedy=all_greedy,
         )

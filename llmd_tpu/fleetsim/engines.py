@@ -263,6 +263,47 @@ class LoraPoolProfile:
 
 
 @dataclasses.dataclass(frozen=True)
+class MoEProfile:
+    """One replica's wide-EP MoE decode envelope
+    (docs/architecture/wide-ep.md).
+
+    Each request carries a dominant routed ``expert`` (the trace's
+    Zipf popularity draw); the replica accumulates a decayed per-expert
+    load window and its decode TPOT stretches by the EP dispatch skew —
+    max/mean per-SHARD load under the current placement — because the
+    synchronous all-to-all step is gated by the hottest shard's grouped
+    GEMM. Tokens routed to an expert whose per-replica-slot load
+    exceeds ``capacity_factor`` x the mean slot load overflow the
+    GShard capacity ``C`` and are counted as DROPPED slots.
+
+    Every ``eplb_interval_s`` of virtual time the replica's control
+    loop runs the REAL :func:`llmd_tpu.parallel.eplb.compute_placement`
+    (deterministic numpy — the same host-side balancer the engine's
+    slow loop calls) over the observed window when EPLB is on; with
+    EPLB off the identity placement (contiguous logical layout) is
+    pinned for the whole run — the baseline leg the scenario's gates
+    compare against."""
+
+    num_experts: int = 32
+    world: int = 8  # EP shards the experts are sharded over
+    # Spare replica slots per shard: under the default Zipf popularity
+    # the hottest expert carries ~25% of the flow, so equalizing slot
+    # loads takes ~load/max_slot ≈ 8 replicas of it — two spares per
+    # shard is the budget that lets the greedy balancer get the max
+    # slot load down to ~1.7x the mean (where capacity_factor clears).
+    redundancy: int = 2
+    capacity_factor: float = 1.75  # GShard C as a multiple of mean slot load
+    # The device path's minimum-capacity round-up (moe_ep.py sizes
+    # C = max(ceil(t*k/W * factor), 8) rounded up to 8): an absolute
+    # token floor under the per-slot cap, so a cold expert catching one
+    # extra request doesn't register as overflow — only structural
+    # overload (a hot expert pinned to too few slots) drops.
+    capacity_floor: float = 8.0
+    eplb_interval_s: float = 0.25  # control-loop cadence (virtual time)
+    warmup_s: float = 0.02  # first control tick (the loop runs from step 0)
+
+
+@dataclasses.dataclass(frozen=True)
 class ReplicaProfile:
     """One replica's capacity envelope (all rates per replica)."""
 
@@ -316,6 +357,8 @@ class SimReplica:
         lora: LoraPoolProfile | None = None,
         lora_universe: tuple = (),
         pd_tier: "SimPrefillTier | None" = None,
+        moe: MoEProfile | None = None,
+        moe_eplb: bool = True,
     ) -> None:
         self.address = address
         self.profile = profile
@@ -360,6 +403,27 @@ class SimReplica:
         self.store_hits = 0
         self.store_published = 0
         self.recompute_avoided_tokens = 0
+        # Wide-EP MoE (docs/architecture/wide-ep.md): decayed
+        # per-expert load window, the current expert→shard placement
+        # (identity until the EPLB control loop's first tick), and the
+        # skew/drop counters the scoreboard's expert_skew section and
+        # the EPLB-on-vs-off gates read.
+        self.moe = moe
+        self.moe_eplb = moe_eplb
+        self.moe_routed_tokens = 0
+        self.moe_dropped_slots = 0
+        self.moe_rebalances = 0
+        self.moe_peak_skew = 1.0
+        self.moe_skew_sum = 0.0
+        self.moe_skew_n = 0
+        if moe is not None:
+            from llmd_tpu.parallel.eplb import identity_placement
+
+            self._moe_window = [0.0] * moe.num_experts
+            self._moe_placement = identity_placement(
+                moe.num_experts, moe.world
+            )
+            self._moe_next_tick: float | None = None
         self.alive = True
         self.accepting = True  # False while draining out of the pool
         self.waiting = 0
@@ -530,6 +594,63 @@ class SimReplica:
             break
         self.lora_cold_stall_s.append(loop.time() - t0)
 
+    # ---- wide-EP MoE dispatch (docs/architecture/wide-ep.md) ---------- #
+
+    def _moe_dispatch(self, expert: int, tokens: int) -> float:
+        """Account ``tokens`` routed to logical ``expert`` and return
+        this request's decode-TPOT multiplier.
+
+        The synchronous EP all-to-all step is gated by the hottest
+        shard's grouped GEMM, so TPOT stretches by the max/mean
+        per-shard load skew under the CURRENT placement. Tokens to an
+        expert whose per-replica-slot load exceeds ``capacity_factor``
+        x the mean slot load overflow the GShard capacity and the
+        excess fraction is counted as dropped slots. The EPLB control
+        loop ticks every ``eplb_interval_s`` of virtual time: real
+        :func:`compute_placement` over the decayed window when EPLB is
+        on, the pinned identity layout when off.
+        """
+        m = self.moe
+        e = expert % m.num_experts
+        w = self._moe_window
+        w[e] += float(tokens)
+        self.moe_routed_tokens += tokens
+        now = asyncio.get_running_loop().time()
+        if self._moe_next_tick is None:
+            # Warmup tick: the first placement lands once a sliver of
+            # traffic has been observed, then every eplb_interval_s.
+            self._moe_next_tick = now + min(m.eplb_interval_s, m.warmup_s)
+        elif now >= self._moe_next_tick:
+            self._moe_next_tick = now + m.eplb_interval_s
+            if self.moe_eplb and sum(w) > 0:
+                from llmd_tpu.parallel.eplb import compute_placement
+
+                self._moe_placement = compute_placement(
+                    w, world=m.world, redundancy=m.redundancy,
+                )
+                self.moe_rebalances += 1
+            # Decay, don't reset: the window tracks the recent expert
+            # mix without the post-tick skew estimate restarting from a
+            # single sample.
+            for j in range(len(w)):
+                w[j] *= 0.5
+        pl = self._moe_placement
+        shard = pl.shard_loads(w)
+        mean = float(shard.mean())
+        skew = float(shard.max()) / mean if mean > 0 else 1.0
+        self.moe_skew_sum += skew
+        self.moe_skew_n += 1
+        if skew > self.moe_peak_skew:
+            self.moe_peak_skew = skew
+        # Capacity overflow: load on the expert's slot above C spills
+        # the excess fraction of this request's routed tokens.
+        mean_slot = sum(w) / pl.num_physical
+        slot_load = w[e] / max(int(pl.n_replicas[e]), 1)
+        cap = m.capacity_factor * mean_slot + m.capacity_floor
+        if mean_slot > 0 and slot_load > cap:
+            self.moe_dropped_slots += int(tokens * (1.0 - cap / slot_load))
+        return skew
+
     def _release_adapter(self, adapter: str) -> None:
         if self._lora_refs[adapter] > 0:
             self._lora_refs[adapter] -= 1
@@ -673,6 +794,7 @@ class SimReplica:
         prefix_tokens: int = 0,
         resume_tokens: int = 0,
         adapter: str | None = None,
+        expert: int | None = None,
     ):
         """Serve one request; async generator yielding LISTS of token
         values (:func:`stream_token`) — the first list at first-token
@@ -754,6 +876,10 @@ class SimReplica:
                 # a crash lands MID-stream at a token position — the
                 # delivered-prefix accounting the resume protocol rides.
                 tpot = max(p.base_tpot_s, self.running / p.decode_tok_s)
+                if self.moe is not None and expert is not None:
+                    # Wide-EP dispatch skew: the step is gated by the
+                    # hottest shard under the current placement.
+                    tpot *= self._moe_dispatch(expert, output_tokens)
                 chunk = max(1, output_tokens // 4)
                 while pos < output_tokens:
                     n = min(chunk, output_tokens - pos)

@@ -609,6 +609,52 @@ def inv_pd_transfer(
     return check
 
 
+def inv_expert_balance(
+    max_mean_skew: float, max_dropped_frac: float
+) -> Invariant:
+    """THE wide-EP bar (docs/architecture/wide-ep.md): under a Zipf
+    expert-popularity trace the run-long mean per-shard load skew must
+    stay under ``max_mean_skew`` and capacity-dropped slots under
+    ``max_dropped_frac`` of all routed tokens. The identity-placement
+    baseline leg blows through both (the scenario's off leg and the
+    CI summary compare the two exactly) — only EPLB replication +
+    repacking of the hot experts holds them."""
+    def check(board: dict) -> str | None:
+        es = board.get("expert_skew")
+        if es is None:
+            return "scoreboard carries no expert_skew section"
+        if es["mean_shard_skew"] > max_mean_skew:
+            return (
+                f"mean shard skew {es['mean_shard_skew']:.3f} > "
+                f"{max_mean_skew}"
+            )
+        frac = es["dropped_slots"] / max(es["routed_tokens"], 1)
+        if frac > max_dropped_frac:
+            return (
+                f"dropped-slot fraction {frac:.4f} "
+                f"({es['dropped_slots']}/{es['routed_tokens']}) > "
+                f"{max_dropped_frac}"
+            )
+        return None
+    return check
+
+
+def inv_eplb_engaged(min_rebalances: int = 1) -> Invariant:
+    """The balancer provably ran: at least ``min_rebalances`` EPLB
+    placement recomputations across the fleet (a balance gate is
+    vacuous if the control loop never ticked)."""
+    def check(board: dict) -> str | None:
+        es = board.get("expert_skew")
+        if es is None:
+            return "scoreboard carries no expert_skew section"
+        if not es["eplb"]:
+            return "EPLB is off in this leg"
+        if es["rebalances"] < min_rebalances:
+            return f"rebalances {es['rebalances']} < {min_rebalances}"
+        return None
+    return check
+
+
 def inv_batch_drained(board: dict) -> str | None:
     """THE backfill bar (docs/architecture/batch-processing.md): every
     queued offline job completed through interactive troughs — nothing

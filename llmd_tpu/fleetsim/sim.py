@@ -65,6 +65,7 @@ from llmd_tpu.epp.types import (
 from llmd_tpu.fleetsim import simloop
 from llmd_tpu.fleetsim.engines import (
     LoraPoolProfile,
+    MoEProfile,
     PDTransferProfile,
     ReplicaDied,
     ReplicaProfile,
@@ -180,6 +181,16 @@ class FleetConfig:
     # per the profile; seeded kv.pull.drop (match "pd|") mid-stream
     # degrades that import to a full local recompute.
     pd: "PDTransferProfile | None" = None
+    # Wide-EP MoE (docs/architecture/wide-ep.md): a MoEProfile arms
+    # every replica's expert-dispatch model — trace requests carrying
+    # an ``expert`` skew the per-shard load under the current
+    # placement, stretching decode TPOT and overflowing the GShard
+    # capacity into dropped slots; ``moe_eplb`` runs the real EPLB
+    # balancer on each replica's control-loop tick — False pins the
+    # identity layout, the hot-shard baseline the scenario's gates
+    # compare against.
+    moe: "MoEProfile | None" = None
+    moe_eplb: bool = True
 
 
 def default_sim_config(
@@ -407,6 +418,8 @@ class FleetSim:
             lora=self.cfg.lora,
             lora_universe=self.adapter_universe,
             pd_tier=self.pd_tier,
+            moe=self.cfg.moe,
+            moe_eplb=self.cfg.moe_eplb,
         )
         self.replicas[addr] = rep
         self.store.upsert(Endpoint(
@@ -566,6 +579,7 @@ class FleetSim:
                     prefix_tokens=treq.prefix_tokens,
                     resume_tokens=len(delivered),
                     adapter=treq.adapter,
+                    expert=treq.expert,
                 ):
                     if first is None:
                         first = clock.monotonic()
@@ -958,6 +972,30 @@ class FleetSim:
                 # The admission gate the streamed wire opens early —
                 # the serial TTFT leg, far under the full import.
                 "first_group_p50_ms": percentile(firsts, 0.50) * 1e3,
+            }
+        if self.cfg.moe is not None:
+            reps = list(self.replicas.values())
+            extra = dict(extra or {})
+            n = sum(r.moe_skew_n for r in reps)
+            extra["expert_skew"] = {
+                "experts": self.cfg.moe.num_experts,
+                "ep_world": self.cfg.moe.world,
+                "eplb": self.cfg.moe_eplb,
+                "routed_tokens": sum(r.moe_routed_tokens for r in reps),
+                # Capacity overflow under the run's placements — the
+                # skew-proof-capacity headline the EPLB leg must beat
+                # the identity-layout leg on.
+                "dropped_slots": sum(r.moe_dropped_slots for r in reps),
+                "rebalances": sum(r.moe_rebalances for r in reps),
+                # max/mean per-shard load, sampled at every dispatch:
+                # the peak includes the pre-first-rebalance window, the
+                # mean is the run-long balance the gates bound.
+                "peak_shard_skew": round(
+                    max((r.moe_peak_skew for r in reps), default=1.0), 4
+                ),
+                "mean_shard_skew": round(
+                    sum(r.moe_skew_sum for r in reps) / n, 4
+                ) if n else 1.0,
             }
         if self.kv_store is not None:
             reps = list(self.replicas.values())

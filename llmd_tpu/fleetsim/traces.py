@@ -58,6 +58,12 @@ class TraceRequest:
     # under — the unit the replicas' paged adapter pools make resident
     # and the lora-affinity scorer routes on. None = base model.
     adapter: str | None = None
+    # Dominant routed expert for the wide-EP MoE scenario
+    # (docs/architecture/wide-ep.md): the logical expert this request's
+    # decode tokens predominantly route to — the per-request stand-in
+    # for the engine's per-token top-k draw, and the load the EPLB
+    # placement balances across EP shards. None = dense / no MoE axis.
+    expert: int | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -117,6 +123,7 @@ def generate(
     prefix_groups: int = 0,
     prefix_frac: float = 0.5,
     adapters: int = 0,
+    experts: int = 0,
 ) -> list[TraceRequest]:
     """Seeded inhomogeneous-Poisson arrivals with a weighted tenant mix.
 
@@ -144,6 +151,13 @@ def generate(
     adapter each, exactly the fleet shape whose residency the paged
     adapter pool and the lora-affinity scorer manage. The ``tenants``
     mix is ignored in this mode.
+
+    ``experts > 0`` is the wide-EP MoE axis (wide-ep.md): each request
+    gets a dominant routed expert drawn Zipf-ish (weight 1/(k+1)) from
+    that many logical experts — the skewed expert-popularity curve
+    production routers actually see, under which a static contiguous
+    expert layout piles the hot experts onto one EP shard while the
+    EPLB placement spreads them. Independent of the tenant draw.
     """
     rng = random.Random(seed)
     names = [t for t, _ in tenants]
@@ -177,6 +191,12 @@ def generate(
             )[0]
             adapter = f"a{k:03d}"
             tenant = f"tenant-{k:03d}"
+        expert = None
+        if experts > 0:
+            expert = rng.choices(
+                range(experts),
+                weights=[1.0 / (j + 1) for j in range(experts)],
+            )[0]
         out.append(TraceRequest(
             t=t,
             request_id=f"r{i:06d}",
@@ -187,6 +207,7 @@ def generate(
             prefix_group=group,
             prefix_tokens=n_prefix,
             adapter=adapter,
+            expert=expert,
         ))
         i += 1
     return out

@@ -181,9 +181,21 @@ def forward_hidden(
     kv_rep: int = 1,
     dbo: bool = False,
     kv_swa: jax.Array | None = None,
+    moe_overlap: int = 0,
+    moe_placement: dict | None = None,
+    moe_census: jax.Array | None = None,
 ):
     """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache) —
     or (hidden, new kv_cache, new kv_swa) when ``kv_swa`` is given.
+    When ``moe_census`` (the runner's [E+2] accumulator) is given, the
+    updated census is appended to the return tuple.
+
+    ``moe_overlap``/``moe_placement``/``moe_census`` plumb the wide-EP
+    perf layers into ``moe_block_ep`` (parallel/moe_ep.py): microbatched
+    overlapped dispatch, the EPLB physical-placement tables, and the
+    per-expert routed-token / dropped-slot / dispatch-demand stats vector
+    (merged across layers as a scan output: counts add, demand maxes).
+    All three are no-ops unless ``moe_backend == "ep"``.
 
     ``kv_swa`` (CacheConfig.swa_ring) is a second, smaller pool holding
     ONLY the sliding-window layers; those layers index it through
@@ -238,36 +250,57 @@ def forward_hidden(
     )
     half = B // 2
 
+    use_census = moe_census is not None and cfg.is_moe and moe_backend == "ep"
+
+    def _census_merge(a, b):
+        # Census layout (moe_ep): counts in [:-1] add, the max-demand
+        # element in [-1] maxes.
+        return jnp.concatenate([a[:-1] + b[:-1], jnp.maximum(a[-1:], b[-1:])])
+
     def _ffn(h2, lp, use_moe: bool, cap_scale: float = 1.0):
+        """FFN/MoE of one slice; returns (y, census_delta | None)."""
         if use_moe:
             if moe_backend == "ep":
                 from llmd_tpu.parallel.moe_ep import moe_block_ep
 
-                return moe_block_ep(
+                out = moe_block_ep(
                     h2, lp, cfg, mesh,
                     capacity_factor=ep_capacity_factor * cap_scale,
+                    overlap=moe_overlap, placement=moe_placement,
+                    emit_census=use_census,
                 )
+                return out if use_census else (out, None)
             if moe_backend == "grouped" and world_size == 1:
                 from llmd_tpu.models.moe import moe_block_grouped
 
-                return moe_block_grouped(h2, lp, cfg)
+                return moe_block_grouped(h2, lp, cfg), None
             # Sharded jit without the EP backend: the dense combine is
             # the only path GSPMD can partition (expert weights are
             # EP-sharded; the grouped kernel has no partitioning rule
             # — multi-device MoE should run moe_backend="ep", whose
             # shard_map body uses the grouped GEMM locally).
-            return moe_block(h2, lp, cfg)
-        return _mlp(h2, lp)
+            return moe_block(h2, lp, cfg), None
+        return _mlp(h2, lp), None
 
     def _tail(x_sl, attn_sl, lp, use_moe, cap_scale: float = 1.0):
         """Post-attention chain of one (micro)batch slice: residual +
-        post-norm + FFN/MoE + residual."""
+        post-norm + FFN/MoE + residual. Returns (x, census_delta)."""
         x_sl = x_sl + attn_sl
         h2 = rms_norm(x_sl, lp["post_norm"], cfg.rms_norm_eps)
-        return x_sl + _ffn(h2, lp, use_moe, cap_scale)
+        y, cd = _ffn(h2, lp, use_moe, cap_scale)
+        return x_sl + y, cd
+
+    def _tails_dbo(pairs):
+        """Concatenate DBO half-chain _tail results; merge census deltas."""
+        xs, cds = zip(*pairs)
+        cd = cds[0]
+        for c in cds[1:]:
+            cd = c if cd is None else _census_merge(cd, c)
+        return jnp.concatenate(xs, axis=0), cd
 
     def layer_body(x, cache, lp, layer_idx, use_moe: bool, window=None,
                    table=None, run_phys=None):
+        """One decoder layer; returns (x, cache, census_delta | None)."""
         if table is None:
             table = inp.page_table
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
@@ -290,7 +323,8 @@ def forward_hidden(
                         world_size=world_size, mesh=mesh,
                     )
                     outs.append(_tail(x[sl], attn_sl, lp, use_moe, 2.0))
-                return jnp.concatenate(outs, axis=0), cache
+                x2, cd = _tails_dbo(outs)
+                return x2, cache, cd
             attn_out, cache = mla_attention(
                 h, lp, cache, layer_idx, inp, cfg, cos, sin,
                 world_size=world_size, mesh=mesh,
@@ -366,7 +400,8 @@ def forward_hidden(
                     outs.append(
                         _tail(x[sl], _project(attn_sl, half), lp, use_moe, 2.0)
                     )
-                return jnp.concatenate(outs, axis=0), cache
+                x2, cd = _tails_dbo(outs)
+                return x2, cache, cd
             if flat:
                 attn = paged_attention_full_flat(
                     q, cache, layer_idx, inp.token_rows, table,
@@ -382,7 +417,8 @@ def forward_hidden(
                 )
             x = x + _project(attn, B)
         # attention residual already applied above; _tail adds 0
-        return _tail(x, 0.0, lp, use_moe), cache
+        x, cd = _tail(x, 0.0, lp, use_moe)
+        return x, cache, cd
 
     # DeepSeek-style dense prefix: the first N layers (N static, 1-3)
     # run unrolled with their own dense-MLP weights; the homogeneous MoE
@@ -413,10 +449,12 @@ def forward_hidden(
     if flat and inp.flat_runs is not None:
         run_physes = [inp.flat_runs[1], inp.flat_runs[2]]
 
+    census = moe_census if use_census else None
+
     for i in range(n_dense):
         lp_i = jax.tree.map(lambda a: a[i], params["dense_layers"])
         g = kinds[i]
-        x, caches[g] = layer_body(
+        x, caches[g], _ = layer_body(
             x, caches[g], lp_i, jnp.int32(plane[i]), use_moe=False,
             window=None if windows is None else windows[i],
             table=tables[g], run_phys=run_physes[g],
@@ -428,8 +466,20 @@ def forward_hidden(
     win_arr = windows[n_dense:] if windows is not None else None
     lp_all = params["layers"]
 
-    def scan_group(x, cache, table, lp, plane_ids, wins, run_phys=None):
-        """One homogeneous run of layers sharing a pool/table."""
+    def _reduce_census(stacked):
+        """Reduce per-layer census deltas [n, E+2] into the accumulator:
+        counts sum over layers; the demand element takes the max."""
+        return jnp.concatenate([
+            jnp.sum(stacked[:, :-1], axis=0),
+            jnp.max(stacked[:, -1:], axis=0),
+        ])
+
+    def scan_group(x, cache, census, table, lp, plane_ids, wins,
+                   run_phys=None):
+        """One homogeneous run of layers sharing a pool/table. The census
+        delta rides the scan as a per-layer OUTPUT (stacked then reduced)
+        so the carry signature — and the compile cache — only changes
+        when the census is actually armed."""
 
         def fn(carry, scanned):
             x, cache = carry
@@ -438,20 +488,22 @@ def forward_hidden(
                 w = None
             else:
                 lp_s, pid, w = scanned
-            x, cache = layer_body(
+            x, cache, cd = layer_body(
                 x, cache, lp_s, pid, use_moe=cfg.is_moe, window=w,
                 table=table, run_phys=run_phys,
             )
-            return (x, cache), None
+            return (x, cache), cd
 
         scanned = (lp, plane_ids) if wins is None else (lp, plane_ids, wins)
-        (x, cache), _ = jax.lax.scan(fn, (x, cache), scanned)
-        return x, cache
+        (x, cache), cds = jax.lax.scan(fn, (x, cache), scanned)
+        if census is not None and cds is not None:
+            census = _census_merge(census, _reduce_census(cds))
+        return x, cache, census
 
     if len(set(scan_kinds)) <= 1:
         g = scan_kinds[0] if scan_kinds else 0
-        x, caches[g] = scan_group(
-            x, caches[g], tables[g], lp_all, plane_arr, win_arr,
+        x, caches[g], census = scan_group(
+            x, caches[g], census, tables[g], lp_all, plane_arr, win_arr,
             run_physes[g],
         )
     elif (c := _scan_period(scan_kinds)) is not None:
@@ -471,19 +523,24 @@ def forward_hidden(
             x, cf, cs = carry
             cc = [cf, cs]
             lp_c, plane_c, win_c = scanned
+            cd_cyc = None
             for j in range(c):
                 lp_s = jax.tree.map(lambda a: a[j], lp_c)
                 g = scan_kinds[j]  # periodic: same kind for every cycle
-                x, cc[g] = layer_body(
+                x, cc[g], cd = layer_body(
                     x, cc[g], lp_s, plane_c[j], use_moe=cfg.is_moe,
                     window=win_c[j] if g else None, table=tables[g],
                     run_phys=run_physes[g],
                 )
-            return (x, cc[0], cc[1]), None
+                if cd is not None:
+                    cd_cyc = cd if cd_cyc is None else _census_merge(cd_cyc, cd)
+            return (x, cc[0], cc[1]), cd_cyc
 
-        (x, caches[0], caches[1]), _ = jax.lax.scan(
+        (x, caches[0], caches[1]), cds = jax.lax.scan(
             cyc, (x, caches[0], caches[1]), cyc_scanned
         )
+        if census is not None and cds is not None:
+            census = _census_merge(census, _reduce_census(cds))
     else:
         # Aperiodic hybrid (e.g. Qwen2 upper-layer sliding): contiguous
         # homogeneous runs, one scan each.
@@ -494,8 +551,8 @@ def forward_hidden(
             while off + ln < n_scan and scan_kinds[off + ln] == g:
                 ln += 1
             sl = slice(off, off + ln)
-            x, caches[g] = scan_group(
-                x, caches[g], tables[g],
+            x, caches[g], census = scan_group(
+                x, caches[g], census, tables[g],
                 jax.tree.map(lambda a: a[sl], lp_all),
                 plane_arr[sl], win_arr[sl] if g else None,
                 run_physes[g],
@@ -503,9 +560,14 @@ def forward_hidden(
             off += ln
 
     hidden = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    if kv_swa is None:
-        return hidden, caches[0]
-    return hidden, caches[0], caches[1]
+    out = (hidden, caches[0]) if kv_swa is None else (
+        hidden, caches[0], caches[1]
+    )
+    if moe_census is not None:
+        # Non-EP/non-MoE callers that still pass an accumulator get it
+        # back unchanged — the runner's plumbing stays uniform.
+        out = (*out, census if use_census else moe_census)
+    return out
 
 
 def compute_logits(params: dict, hidden: jax.Array, cfg: ModelConfig) -> jax.Array:

@@ -128,7 +128,11 @@ def moe_apply_grouped(
     k = ids.shape[1]
     E = we_gate.shape[0]
     flat_ids = ids.reshape(-1)                       # [T*k]
-    order = jnp.argsort(flat_ids)                    # stable
+    # Explicitly stable: equal expert ids keep token order, so the sorted
+    # row layout — and the f32 scatter-add accumulation order below — is
+    # deterministic across backends (XLA's default sort is NOT guaranteed
+    # stable everywhere; tests/test_wide_ep.py pins this).
+    order = jnp.argsort(flat_ids, stable=True)
     tok = order // k                                 # source token per slot
     xs = ht[tok]                                     # [T*k, H]
     group_sizes = jnp.bincount(flat_ids, length=E)

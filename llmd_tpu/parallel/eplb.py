@@ -1,0 +1,236 @@
+"""EPLB — expert placement load balancing for wide-EP MoE.
+
+DeepSeek-V3 serves its 256-expert MoE with an *expert placement load
+balancer*: per-expert routed-token counts are measured online, the
+hottest experts are replicated into spare "redundancy" slots, and the
+(replica-split) experts are packed onto shards so every shard sees the
+same expected token flow. Balanced placement is what makes the GShard
+capacity-based dispatch cheap — the per-destination capacity ``C`` can
+track the *mean* load instead of the worst-case hot shard, which shrinks
+both the all-to-all payload (W x C x H bytes) and the padded grouped-GEMM
+rows by the same factor.
+
+This module is the host-side control plane:
+
+- :func:`compute_placement` turns a measured per-expert load vector into
+  a physical layout (greedy replicate-hottest + LPT shard packing).
+- :class:`Placement` carries the tables the device path needs —
+  ``phys_to_logical`` drives the ``we_*`` param-leaf remap (a gather at a
+  counted step boundary), ``replicas``/``n_replicas`` drive the router's
+  logical→physical id mapping inside ``moe_block_ep``.
+- :class:`AdaptiveCapacity` is the companion controller for the
+  skew-proof capacity factor: an EMA of the observed per-step max
+  dispatch demand, quantized onto a small ladder (bounding recompiles)
+  with hysteresis on the way down.
+
+Everything here is deterministic numpy — the same load vector always
+produces the same placement, which the fleetsim byte-identity gates and
+the multi-host SPMD contract both rely on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """Expert → physical-slot layout for one EP world.
+
+    ``E_phys = world * slots_per_shard`` physical expert slots; slot
+    ``p`` lives on shard ``p // slots_per_shard`` and holds logical
+    expert ``phys_to_logical[p]``. A logical expert with ``n_replicas``
+    > 1 appears on that many *distinct* shards; the router spreads its
+    tokens across ``replicas[e, :n_replicas[e]]`` round-robin.
+    """
+
+    phys_to_logical: np.ndarray  # [E_phys] i32
+    replicas: np.ndarray         # [E, R_max] i32 physical slot ids (padded
+                                 # by repeating the first replica)
+    n_replicas: np.ndarray       # [E] i32
+    world: int
+
+    @property
+    def num_physical(self) -> int:
+        return int(self.phys_to_logical.shape[0])
+
+    @property
+    def slots_per_shard(self) -> int:
+        return self.num_physical // self.world
+
+    def shard_loads(self, loads: np.ndarray) -> np.ndarray:
+        """Expected per-shard token flow under this placement: each
+        expert's load splits evenly over its replicas."""
+        share = np.asarray(loads, np.float64) / np.maximum(self.n_replicas, 1)
+        per_slot = share[self.phys_to_logical]
+        return per_slot.reshape(self.world, self.slots_per_shard).sum(axis=1)
+
+
+def identity_placement(num_experts: int, world: int) -> Placement:
+    """The implicit contiguous layout (expert e on shard e // (E/W))."""
+    e = np.arange(num_experts, dtype=np.int32)
+    return Placement(
+        phys_to_logical=e,
+        replicas=e[:, None].copy(),
+        n_replicas=np.ones(num_experts, np.int32),
+        world=world,
+    )
+
+
+def compute_placement(
+    loads: np.ndarray,
+    world: int,
+    redundancy: int = 0,
+) -> Placement:
+    """EPLB placement from a measured per-expert load vector.
+
+    ``redundancy`` is the number of EXTRA physical slots per shard, so
+    ``E_phys = E + world * redundancy`` and every shard holds exactly
+    ``E/world + redundancy`` slots (the static shape the EP shard_map
+    needs). Two greedy passes:
+
+    1. Replicate: hand each spare slot to the expert with the highest
+       per-replica load (``loads[e] / replicas[e]``) — DeepSeek-V3's
+       redundant-experts rule.
+    2. Pack: LPT (longest-processing-time) assignment of the replica
+       units onto shards, hottest first, onto the least-loaded shard
+       with a free slot — preferring shards that don't already host the
+       same expert so replicas actually split traffic.
+
+    Deterministic: ties break toward the lower expert id / shard id.
+    """
+    loads = np.asarray(loads, np.float64)
+    E = int(loads.shape[0])
+    if E % world:
+        raise ValueError(f"num_experts {E} not divisible by world {world}")
+    if redundancy < 0:
+        raise ValueError("redundancy must be >= 0")
+    slots = E // world + redundancy
+    reps = np.ones(E, np.int64)
+    for _ in range(world * redundancy):
+        # argmax of per-replica load; ties -> lowest id (np.argmax rule).
+        reps[int(np.argmax(loads / reps))] += 1
+
+    # Replica units, hottest first (stable sort, so equal-load units keep
+    # expert-id order and the layout is reproducible).
+    unit_expert = np.repeat(np.arange(E), reps)
+    unit_load = loads[unit_expert] / reps[unit_expert]
+    order = np.argsort(-unit_load, kind="stable")
+
+    shard_load = np.zeros(world, np.float64)
+    shard_free = np.full(world, slots, np.int64)
+    shard_slots: list[list[int]] = [[] for _ in range(world)]
+    hosts: list[set[int]] = [set() for _ in range(world)]
+    for u in order:
+        e = int(unit_expert[u])
+        cand = [w for w in range(world) if shard_free[w] > 0 and e not in hosts[w]]
+        if not cand:  # more replicas than shards can distinctly host
+            cand = [w for w in range(world) if shard_free[w] > 0]
+        w = min(cand, key=lambda i: (shard_load[i], i))
+        shard_slots[w].append(e)
+        hosts[w].add(e)
+        shard_free[w] -= 1
+        shard_load[w] += float(unit_load[u])
+
+    phys = np.empty(world * slots, np.int32)
+    for w in range(world):
+        row = sorted(shard_slots[w])  # stable within-shard order
+        phys[w * slots : (w + 1) * slots] = row
+
+    r_max = int(reps.max())
+    replicas = np.zeros((E, r_max), np.int32)
+    n_replicas = np.zeros(E, np.int32)
+    for p, e in enumerate(phys):
+        replicas[e, n_replicas[e]] = p
+        n_replicas[e] += 1
+    # Pad unused replica columns by repeating the first replica so a
+    # gather with any index < r_max stays in-placement.
+    for e in range(E):
+        replicas[e, n_replicas[e]:] = replicas[e, 0]
+    return Placement(
+        phys_to_logical=phys,
+        replicas=replicas,
+        n_replicas=n_replicas,
+        world=world,
+    )
+
+
+def skew(loads: np.ndarray) -> float:
+    """max/mean load ratio; 1.0 is perfectly balanced."""
+    loads = np.asarray(loads, np.float64)
+    mean = float(loads.mean())
+    return float(loads.max()) / mean if mean > 0 else 1.0
+
+
+class AdaptiveCapacity:
+    """Skew-proof ``capacity_factor`` controller.
+
+    The EP dispatch pads every shard's send buffer to capacity
+    ``C = ceil(T*k/W * factor)``; a static factor must be provisioned for
+    the worst skew ever seen, so balanced steps ship mostly padding. This
+    controller tracks the *observed* per-step demand — ``moe_block_ep``'s
+    census reports ``max_demand / (T*k/W)``, i.e. the factor that step
+    actually needed — and quantizes an EMA of it onto a small ladder:
+
+    - UP immediately: a step whose demand exceeds the current factor
+      dropped tokens; jump straight to the rung covering it (headroom
+      included) so drops never persist.
+    - DOWN with hysteresis: only after ``hold_steps`` consecutive steps
+      whose target rung sits below the current one — routing noise must
+      not thrash the jit cache (every factor change recompiles the
+      forward programs).
+
+    ``observe`` returns the new factor when it changes, else None.
+    """
+
+    LADDER = (1.0, 1.25, 1.5, 1.75, 2.0, 2.5, 3.0, 4.0)
+
+    def __init__(
+        self,
+        base: float = 2.0,
+        ema: float = 0.25,
+        headroom: float = 1.2,
+        hold_steps: int = 32,
+        ladder: tuple = LADDER,
+    ) -> None:
+        self.ladder = tuple(sorted(ladder))
+        self.factor = self._rung(base)
+        self.ema = float(ema)
+        self.headroom = float(headroom)
+        self.hold_steps = int(hold_steps)
+        self._ema_demand: float | None = None
+        self._below = 0
+
+    def _rung(self, x: float) -> float:
+        for r in self.ladder:
+            if r >= x - 1e-9:
+                return r
+        return self.ladder[-1]
+
+    def observe(self, required: float) -> float | None:
+        """Feed one step's observed demand factor (census max element)."""
+        required = float(required)
+        if required <= 0:  # idle step: no routed tokens, no signal
+            return None
+        if self._ema_demand is None:
+            self._ema_demand = required
+        else:
+            self._ema_demand += self.ema * (required - self._ema_demand)
+        target = self._rung(max(self._ema_demand, required) * self.headroom)
+        if required > self.factor:  # dropped tokens this step: react NOW
+            self._below = 0
+            if target > self.factor:
+                self.factor = target
+                return self.factor
+            return None
+        if target < self.factor:
+            self._below += 1
+            if self._below >= self.hold_steps:
+                self._below = 0
+                self.factor = target
+                return self.factor
+        else:
+            self._below = 0
+        return None

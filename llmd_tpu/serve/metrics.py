@@ -154,6 +154,22 @@ def render_metrics(
                 f'llmd:kv_transfer_failures_total{{stage="{stage}",'
                 f'policy="{policy}",model_name="{model_name}"}} {n}'
             )
+    if stats.moe_expert_tokens:
+        # Wide-EP MoE (docs/architecture/wide-ep.md): per-logical-expert
+        # routed-token counts — the EPLB control loop's input, and the
+        # skew panel's series. llmd-family only (vLLM has no per-expert
+        # load contract). Dropped slots and the live/peak capacity
+        # numbers ride the flat namespaces below.
+        lines.append("# TYPE llmd:moe_expert_tokens_total counter")
+        for e, n in enumerate(stats.moe_expert_tokens):
+            lines.append(
+                f'llmd:moe_expert_tokens_total{{expert="{e}",'
+                f'model_name="{model_name}"}} {n}'
+            )
+        gauges["moe_capacity_factor"] = round(stats.moe_capacity_factor, 4)
+        gauges["moe_peak_demand"] = round(stats.moe_peak_demand, 4)
+        counters["moe_dropped_slots_total"] = stats.moe_dropped_slots_total
+        counters["moe_rebalances_total"] = stats.moe_rebalances_total
     injected = faults.injected_counts()
     if injected:
         # Only present while a fault plan is armed (chaos runs): how many

@@ -487,6 +487,84 @@ DASHBOARDS["llmd-pd-coordinator"] = dashboard(
     ],
 )
 
+# ---------------------------------------------------------------- wide-EP
+DASHBOARDS["llmd-wide-ep"] = dashboard(
+    "llmd-wide-ep", "Wide-EP MoE",
+    "Wide expert parallelism (docs/architecture/wide-ep.md): per-expert "
+    "routed-token flow, EP dispatch balance, capacity drops, and the "
+    "EPLB/adaptive-capacity control loops (engine census -> "
+    "serve/metrics.py).",
+    [
+        panel("Capacity factor",
+              [f"vllm:moe_capacity_factor{M}"],
+              kind="stat", w=4, h=4,
+              desc="Live GShard capacity_factor (the AdaptiveCapacity "
+                   "ladder rung when ep_capacity_adaptive is on, the "
+                   "static config otherwise). Every change recompiles "
+                   "the forward programs — it should move rarely."),
+        panel("Peak required factor",
+              [f"vllm:moe_peak_demand{M}"],
+              kind="stat", w=4, h=4,
+              desc="High-water per-destination dispatch demand, in "
+                   "capacity_factor units (census element E+1). "
+                   "Persistently above the live capacity factor means "
+                   "tokens are overflowing C — check dropped slots."),
+        panel("Dropped slots /s",
+              [f"rate(llmd:moe_dropped_slots_total{M}[5m])"],
+              kind="stat", w=4, h=4,
+              thresholds=[(None, "green"), (0.1, "yellow"), (10, "red")],
+              desc="Valid routed tokens that overflowed the capacity "
+                   "bound and were dropped (residual-only via the MoE "
+                   "block's skip connection — degraded quality, not an "
+                   "error). Nonzero steady-state = raise capacity or "
+                   "fix placement."),
+        panel("EPLB rebalances",
+              [f"increase(llmd:moe_rebalances_total{M}[1h])"],
+              kind="stat", w=4, h=4,
+              desc="Expert-placement recomputations applied at step "
+                   "boundaries over the last hour. Zero with visible "
+                   "skew below = the control loop is disarmed "
+                   "(eplb_interval_steps=0) or multi-host."),
+        panel("Expert load skew (max/mean)",
+              [f"max(rate(llmd:moe_expert_tokens_total{M}[5m])) / "
+               f"avg(rate(llmd:moe_expert_tokens_total{M}[5m]))"],
+              kind="stat", w=8, h=4,
+              thresholds=[(None, "green"), (2.0, "yellow"), (4.0, "red")],
+              desc="Hot-expert ratio over the logical experts. The EP "
+                   "step is gated by the hottest shard, so sustained "
+                   "skew here is the direct tax EPLB placement exists "
+                   "to remove (DeepSeek-V3-style replicate + repack)."),
+        row("Per-expert routed flow"),
+        panel("Routed tokens /s by expert",
+              [f"rate(llmd:moe_expert_tokens_total{M}[5m])"],
+              legends=["expert {{expert}}"], w=24, h=8,
+              desc="Census counts per LOGICAL expert (valid routed "
+                   "token slots, k slots per token). The Zipf shape of "
+                   "this fan is the input the EPLB control loop "
+                   "balances; after a rebalance the per-SHARD flow "
+                   "evens out while this per-expert fan keeps its "
+                   "popularity curve."),
+        row("Dispatch economics"),
+        panel("Drops vs rebalances",
+              [f"rate(llmd:moe_dropped_slots_total{M}[5m])",
+               f"rate(llmd:moe_rebalances_total{M}[5m])"],
+              legends=["dropped slots/s", "rebalances/s"], w=12,
+              desc="Drops spiking between rebalances = the placement "
+                   "is going stale faster than eplb_interval_steps; "
+                   "drops surviving rebalances = capacity_factor too "
+                   "tight for the residual skew."),
+        panel("Required vs provisioned capacity",
+              [f"vllm:moe_peak_demand{M}",
+               f"vllm:moe_capacity_factor{M}"],
+              legends=["peak required", "provisioned"], w=12,
+              desc="Padded a2a payload scales with the provisioned "
+                   "factor (2 x W x C x H bytes per microbatch): the "
+                   "gap between these lines is pure padding — the "
+                   "adaptive ladder closes it from above at zero "
+                   "drops (wide-ep-perf-model.md)."),
+    ],
+)
+
 # ---------------------------------------------------------------- autoscaler
 DASHBOARDS["llmd-autoscaler"] = dashboard(
     "llmd-autoscaler", "Autoscaling (WVA + KEDA)",

@@ -359,6 +359,39 @@ def test_pd_transfer_scoreboard_byte_identical():
     assert a == b
 
 
+def test_expert_skew_eplb_beats_identity_placement():
+    """Wide-EP MoE under Zipf expert popularity (wide-ep.md): the EPLB
+    leg holds its balance invariants, and against the identity-layout
+    baseline on the SAME seeded trace (exact virtual time) it is
+    STRICTLY better on every headline axis — dropped slots, mean shard
+    skew, and tail decode TPOT — because replicating + repacking the
+    hot experts is the only thing that changed."""
+    from llmd_tpu.fleetsim.scenarios import build_expert_skew
+
+    on = _run("expert_skew", 0.25)
+    assert on["ok"], on["invariants"]
+    es = on["expert_skew"]
+    assert es["eplb"] and es["rebalances"] >= 1
+    off = build_expert_skew(0, 0.25, eplb=False).run()
+    eo = off["expert_skew"]
+    assert not eo["eplb"] and eo["rebalances"] == 0
+    assert es["routed_tokens"] == eo["routed_tokens"]  # same trace
+    assert es["dropped_slots"] < eo["dropped_slots"]
+    assert es["mean_shard_skew"] < eo["mean_shard_skew"]
+    assert (on["latency_ms"]["tpot"]["p99"]
+            < off["latency_ms"]["tpot"]["p99"])
+    assert (on["latency_ms"]["tpot"]["p50"]
+            < off["latency_ms"]["tpot"]["p50"])
+    assert on["requests"]["lost"] == 0
+    assert off["requests"]["lost"] == 0
+
+
+def test_expert_skew_scoreboard_byte_identical():
+    a = to_canonical_json(_run("expert_skew", 0.1))
+    b = to_canonical_json(_run("expert_skew", 0.1))
+    assert a == b
+
+
 def test_hung_requests_are_surfaced_not_lost():
     """A replica that never finishes within the grace window produces a
     `hung` record and fails zero_lost — the invariant can actually fire."""

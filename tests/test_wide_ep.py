@@ -151,7 +151,7 @@ def test_ep_capacity_drop_is_bounded_not_catastrophic():
     assert np.isfinite(np.asarray(out)).all()
 
 
-def make_engine(moe_backend, dp=1, tp=1, seed=0):
+def make_engine(moe_backend, dp=1, tp=1, seed=0, **pkw):
     cfg = EngineConfig(
         model=moe_config(),
         cache=CacheConfig(page_size=4, num_blocks=128, dtype="float32"),
@@ -160,7 +160,8 @@ def make_engine(moe_backend, dp=1, tp=1, seed=0):
             tensor_parallel_size=tp,
             data_parallel_size=dp,
             moe_backend=moe_backend,
-            ep_capacity_factor=64.0,
+            ep_capacity_factor=pkw.pop("ep_capacity_factor", 64.0),
+            **pkw,
         ),
         seed=seed,
     )
@@ -193,6 +194,265 @@ def test_engine_ep_matches_dense_greedy():
     out_d = dense.generate([list(p) for p in PROMPTS], sp)
     out_e = ep.generate([list(p) for p in PROMPTS], sp)
     assert list(out_d.values()) == list(out_e.values())
+
+
+# --------------------------------------------------------------------------- #
+# Overlapped dispatch, EPLB placement, census, adaptive capacity
+
+
+def test_moe_overlap_byte_identical():
+    """Microbatched overlapped dispatch must be BYTE-identical to the
+    monolithic path at zero-drop capacity: the router runs once on the
+    full slab, grouped-GEMM rows are row-independent, and each token's
+    combine sums its own k slots in fixed order — splitting the batch
+    changes scheduling freedom, never numerics."""
+    cfg = moe_config()
+    ctx = build_mesh(ParallelConfig(tensor_parallel_size=1, data_parallel_size=8))
+    lp = _layer_params(cfg, jax.random.key(10))
+    h = jax.random.normal(jax.random.key(11), (4, 16, cfg.hidden_size), jnp.float32)
+
+    def run(overlap):
+        with ctx.mesh:
+            return np.asarray(jax.jit(
+                lambda h, lp: moe_block_ep(
+                    h, lp, cfg, ctx.mesh, capacity_factor=64.0, overlap=overlap
+                )
+            )(h, lp))
+
+    base = run(0)
+    for n in (2, 4):
+        got = run(n)
+        assert (got == base).all(), f"overlap={n} diverged from monolithic path"
+
+
+def test_eplb_placement_matches_dense():
+    """Remapped physical layout (hot expert replicated, round-robin
+    replica spreading) computes the same function as the dense combine."""
+    from llmd_tpu.parallel.eplb import compute_placement
+
+    cfg = moe_config()
+    ctx = build_mesh(ParallelConfig(tensor_parallel_size=1, data_parallel_size=8))
+    lp = _layer_params(cfg, jax.random.key(12))
+    h = jax.random.normal(jax.random.key(13), (2, 12, cfg.hidden_size), jnp.float32)
+    dense = jax.jit(lambda h, lp: moe_block(h, lp, cfg))(h, lp)
+
+    loads = np.array([100, 3, 5, 60, 2, 1, 9, 4], np.float64)
+    pl = compute_placement(loads, world=8, redundancy=1)
+    lp2 = dict(lp)
+    for name in ("we_gate", "we_up", "we_down"):
+        lp2[name] = jnp.asarray(np.asarray(lp[name])[pl.phys_to_logical])
+    place = {
+        "phys_to_logical": jnp.asarray(pl.phys_to_logical),
+        "replicas": jnp.asarray(pl.replicas),
+        "n_replicas": jnp.asarray(pl.n_replicas),
+    }
+    with ctx.mesh:
+        ep = jax.jit(lambda h, lp: moe_block_ep(
+            h, lp, cfg, ctx.mesh, capacity_factor=64.0, placement=place
+        ))(h, lp2)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ep), rtol=2e-4, atol=2e-4)
+
+
+def test_compute_placement_balances_and_is_deterministic():
+    from llmd_tpu.parallel.eplb import (
+        compute_placement, identity_placement, skew,
+    )
+
+    loads = np.array([1000, 10, 10, 10, 10, 10, 10, 10], np.float64)
+    pl = compute_placement(loads, world=4, redundancy=1)
+    ident = identity_placement(8, world=4)
+    # Balanced placement must strictly beat the contiguous layout on the
+    # expected per-shard flow.
+    assert skew(pl.shard_loads(loads)) < skew(ident.shard_loads(loads))
+    # Shape discipline: E + world*redundancy slots, every expert placed.
+    assert pl.num_physical == 12 and pl.slots_per_shard == 3
+    assert set(pl.phys_to_logical.tolist()) == set(range(8))
+    # The hot expert got the spare slots; replicas land on DISTINCT
+    # shards (up to world) so round-robin spreading actually splits flow.
+    assert pl.n_replicas[0] > 1
+    for e in range(8):
+        n = int(pl.n_replicas[e])
+        shards = {int(s) // pl.slots_per_shard for s in pl.replicas[e, :n]}
+        assert len(shards) == min(n, 4)
+    # Same loads -> same placement (the fleetsim byte-identity contract).
+    pl2 = compute_placement(loads, world=4, redundancy=1)
+    np.testing.assert_array_equal(pl.phys_to_logical, pl2.phys_to_logical)
+    np.testing.assert_array_equal(pl.replicas, pl2.replicas)
+
+
+def test_census_counts_match_router_oracle():
+    """Census [0:E] == bincount of the dense router's top-k ids over the
+    REAL tokens (pad rows masked out); zero drops at ample capacity."""
+    from llmd_tpu.models.moe import router_topk
+
+    cfg = moe_config()
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    ctx = build_mesh(ParallelConfig(tensor_parallel_size=1, data_parallel_size=8))
+    lp = _layer_params(cfg, jax.random.key(14))
+    h = jax.random.normal(jax.random.key(15), (3, 7, cfg.hidden_size), jnp.float32)
+    with ctx.mesh:
+        y, census = jax.jit(lambda h, lp: moe_block_ep(
+            h, lp, cfg, ctx.mesh, capacity_factor=64.0, emit_census=True
+        ))(h, lp)
+    census = np.asarray(census)
+    _, ids = jax.jit(lambda ht: router_topk(
+        ht, lp["router"], k, cfg, jnp.zeros((E,), jnp.float32)
+    ))(h.reshape(-1, cfg.hidden_size))
+    oracle = np.bincount(np.asarray(ids).reshape(-1), minlength=E)
+    np.testing.assert_array_equal(census[:E].astype(np.int64), oracle)
+    assert census[E] == 0.0  # no drops at capacity 64
+    assert census[E + 1] > 0.0  # demand element always populated
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_census_counts_drops_at_tight_capacity():
+    """Force total skew (constant router logits -> every token picks
+    experts 0 and 1): dropped slots and the required-factor element must
+    report the overload exactly, not silently zero it."""
+    cfg = moe_config()
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    W = 8
+    ctx = build_mesh(ParallelConfig(tensor_parallel_size=1, data_parallel_size=W))
+    lp = _layer_params(cfg, jax.random.key(16))
+    lp["router"] = jnp.zeros_like(lp["router"])  # uniform logits: ties -> 0,1
+    h = jax.random.normal(jax.random.key(17), (8, 16, cfg.hidden_size), jnp.float32)
+    T = 8 * 16  # t_loc = 16 per shard, tk = 32
+    with ctx.mesh:
+        _, census = jax.jit(lambda h, lp: moe_block_ep(
+            h, lp, cfg, ctx.mesh, capacity_factor=0.5, emit_census=True
+        ))(h, lp)
+    census = np.asarray(census)
+    # C = max(ceil(32/8 * 0.5), 8) = 8; each shard sends 16 slots to each
+    # of experts 0 and 1 -> 8 dropped per (shard, expert).
+    assert census[0] == T and census[1] == T
+    assert census[E] == W * 2 * 8
+    # Required factor: demand 16 over the zero-skew share 32/8 = 4.0.
+    np.testing.assert_allclose(census[E + 1], 4.0)
+
+
+def test_expert_sort_stability_pinned():
+    """The expert sorts feeding grouped GEMMs must be EXPLICITLY stable
+    (XLA's default sort is not guaranteed stable on every backend, and an
+    unstable tie-break reorders f32 accumulation): pin both call sites,
+    and pin that tie-heavy routing is bitwise deterministic."""
+    import inspect
+
+    from llmd_tpu.ops import grouped_gemm
+    from llmd_tpu.parallel import moe_ep as mep
+
+    assert "argsort(er, stable=True)" in inspect.getsource(mep)
+    assert "argsort(flat_ids, stable=True)" in inspect.getsource(grouped_gemm)
+
+    # Behavioral half: every slot ties on expert id; two fresh jit
+    # compilations must agree bitwise.
+    rng = np.random.default_rng(3)
+    T, H, E = 33, 16, 4
+    ht = jnp.asarray(rng.standard_normal((T, H)), jnp.float32)
+    ids = jnp.zeros((T, 2), jnp.int32)  # all routed to expert 0
+    w = jnp.full((T, 2), 0.5, jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((E, H, 8)), jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((E, H, 8)), jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((E, 8, H)), jnp.float32)
+    f = lambda: jax.jit(grouped_gemm.moe_apply_grouped)(ht, w, ids, wg, wu, wd)  # noqa: E731
+    np.testing.assert_array_equal(np.asarray(f()), np.asarray(f()))
+
+
+@pytest.mark.parametrize("pallas", ["off", "interpret"])
+def test_int8_grouped_parity_imbalanced(monkeypatch, pallas):
+    """int8 grouped_matmul_q tracks the bf16 grouped path under heavily
+    imbalanced group sizes (empty group, 1-row group, fat group) — the
+    per-group channel scales must follow rows through the ragged layout.
+    interpret mode runs the bf16 side through the megablox kernel glue."""
+    from llmd_tpu.ops.grouped_gemm import grouped_matmul
+    from llmd_tpu.ops.quant import grouped_matmul_q, quantize_weight
+
+    monkeypatch.setenv("LLMD_PALLAS", pallas)
+    rng = np.random.default_rng(11)
+    G, K_dim, N = 4, 128, 128
+    sizes = np.array([0, 90, 1, 37], np.int32)
+    T = int(sizes.sum())
+    x = jnp.asarray(rng.standard_normal((T, K_dim)) * 0.5, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((G, K_dim, N)) * 0.2, jnp.float32)
+    wq, ws = quantize_weight(w)
+    gs = jnp.asarray(sizes)
+    ref = np.asarray(grouped_matmul(x, w, gs))
+    got = np.asarray(grouped_matmul_q(x, wq, ws, gs))
+    # w8a8 dynamic quantization error bound, not exactness: per-element
+    # error scales with the row's activation amax and the channel scale.
+    assert np.max(np.abs(got - ref)) < 0.35
+    assert np.mean(np.abs(got - ref)) < 0.05
+
+
+def test_adaptive_capacity_controller():
+    from llmd_tpu.parallel.eplb import AdaptiveCapacity
+
+    ac = AdaptiveCapacity(base=2.0, hold_steps=3)
+    assert ac.factor == 2.0
+    # Overload (demand 2.6 > factor 2.0 => that step dropped): jump NOW,
+    # with headroom (2.6 * 1.2 = 3.12 -> rung 4.0).
+    assert ac.observe(2.6) == 4.0
+    # Calm traffic steps DOWN only after hold_steps consecutive
+    # below-target observations (jit-cache hysteresis).
+    assert ac.observe(1.0) is None
+    assert ac.observe(1.0) is None
+    f = ac.observe(1.0)
+    assert f is not None and f < 4.0
+    # Idle steps (no routed tokens) carry no signal.
+    assert ac.observe(0.0) is None
+    # The ladder bounds the reachable factors.
+    assert ac.factor in AdaptiveCapacity.LADDER
+
+
+def test_engine_ep_census_and_metrics():
+    """End to end: the runner's device census drains into EngineStats and
+    renders as the moe_expert_tokens_total labeled series."""
+    from llmd_tpu.serve.metrics import render_metrics
+
+    eng = make_engine("ep", dp=8)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert all(len(v) for v in out.values())
+    st = eng.stats
+    assert len(st.moe_expert_tokens) == 8
+    assert sum(st.moe_expert_tokens) > 0
+    assert st.moe_dropped_slots_total == 0  # capacity 64 never drops
+    assert st.moe_peak_demand > 0
+    assert st.moe_capacity_factor == 64.0
+    text = render_metrics(st, "m")
+    assert 'llmd:moe_expert_tokens_total{expert="0"' in text
+    assert "llmd:moe_dropped_slots_total" in text
+    assert "llmd:moe_capacity_factor" in text
+
+
+def test_engine_eplb_rebalance_preserves_outputs():
+    """The EPLB control loop fires mid-generation (interval 2 steps,
+    redundancy 1) and must not change a single sampled token: replicas
+    carry identical weights, so the remap moves work, not numerics."""
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    base = make_engine("ep", dp=8)
+    out_base = base.generate([list(p) for p in PROMPTS], sp)
+
+    eng = make_engine("ep", dp=8, eplb_interval_steps=2, eplb_redundancy=1)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert eng.stats.moe_rebalances_total >= 1
+    assert list(out.values()) == list(out_base.values())
+    # The physical layout really changed shape: 8 + 8*1 slots.
+    assert eng.runner.moe_placement is not None
+    assert eng.runner.moe_placement.num_physical == 16
+
+
+def test_engine_ep_adaptive_capacity():
+    """ep_capacity_adaptive: the controller lands the live factor on the
+    ladder and the engine keeps generating across the retrace."""
+    from llmd_tpu.parallel.eplb import AdaptiveCapacity
+
+    eng = make_engine(
+        "ep", dp=8, ep_capacity_factor=2.0, ep_capacity_adaptive=True
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+    out = eng.generate([list(p) for p in PROMPTS], sp)
+    assert all(len(v) for v in out.values())
+    assert eng.stats.moe_capacity_factor in AdaptiveCapacity.LADDER
 
 
 # --------------------------------------------------------------------------- #
