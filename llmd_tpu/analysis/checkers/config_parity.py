@@ -41,6 +41,8 @@ FLAG_FIELD_MAP = {
     "kv_store_data_port": "store_data_port",
     "kv_publish_policy": "publish_policy",
     "kv_publish_min_hits": "publish_min_hits",
+    "kv_decode_paging": "decode_paging",
+    "kv_pager_horizon_tokens": "pager_horizon_tokens",
     "lora_adapters": "num_lora_adapters",
     "lora_pool_slots": "lora_dynamic",
     "kv_transfer_config": "kv_role",

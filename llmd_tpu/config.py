@@ -621,6 +621,38 @@ class ParallelConfig:
     # reference: --enable-dbo ships default-off and is enabled only on
     # the multi-node GPU decode tier (decode.yaml:125-126).
     enable_dbo: bool = False
+    # Context-parallel ring prefill (Ring Attention, Liu et al.): a long
+    # prompt's chunk is sharded across the mesh "dp" axis and attention
+    # runs as a ring — fresh K/V blocks rotate via jax.lax.ppermute over
+    # ICI while each shard folds online-softmax partials, with causal
+    # block skipping (~half the ring work). Must equal
+    # data_parallel_size when > 1 (the ring rides the dp axis, which
+    # idles during a lone long prefill anyway since B=1 never
+    # dp-shards). 1 disables. Non-MLA models only; tolerance-pinned
+    # against the monolithic chunked-prefill path by
+    # tests/test_ring_prefill.py.
+    cp_prefill: int = 1
+    # Prefill rows shorter than this keep the monolithic path even when
+    # cp_prefill > 1: tiny chunks are dispatch-bound and the ring's
+    # collective latency would dominate.
+    cp_prefill_min_tokens: int = 512
+
+    def __post_init__(self) -> None:
+        if self.cp_prefill < 1:
+            raise ValueError(
+                f"cp_prefill={self.cp_prefill} must be >= 1 (1 disables)"
+            )
+        if self.cp_prefill > 1 and self.cp_prefill != self.data_parallel_size:
+            raise ValueError(
+                f"cp_prefill={self.cp_prefill} must equal "
+                f"data_parallel_size={self.data_parallel_size}: the ring "
+                "shards the chunk's query axis over the mesh dp axis"
+            )
+        if self.cp_prefill_min_tokens < 1:
+            raise ValueError(
+                f"cp_prefill_min_tokens={self.cp_prefill_min_tokens} "
+                "must be >= 1"
+            )
 
     @property
     def world_size(self) -> int:
@@ -655,6 +687,20 @@ class OffloadConfig:
     # "off" keeps the store read-only on this replica.
     publish_policy: str = "save"
     publish_min_hits: int = 2
+    # Decode-time KV paging (docs/architecture/long-context.md): cold
+    # page-ranges of a LIVE decode sequence — wholly below the attention
+    # window minus the prefetch horizon — spill to the host tier and
+    # their HBM pages are freed, bounding resident HBM per sequence by
+    # window + horizon instead of context length. Pages stream back over
+    # the group-framed scatter wire before the window reaches them; a
+    # wire/tier failure refunds the sequence to recompute (byte-identical
+    # output either way). Requires the offload tier, prefix caching, an
+    # all-sliding-window model, and a single-host engine.
+    decode_paging: bool = False
+    # Prefetch horizon in tokens: pages within window + horizon of the
+    # decode frontier stay resident; the pager restores a parked
+    # sequence's pages down to this watermark before it is schedulable.
+    pager_horizon_tokens: int = 256
 
 
 @dataclasses.dataclass

@@ -345,6 +345,15 @@ class EngineStats:
     moe_peak_demand: float = 0.0
     moe_capacity_factor: float = 0.0
     moe_rebalances_total: int = 0
+    # Million-token context tier (docs/architecture/long-context.md):
+    # bytes of live-sequence KV spilled to the host tier by the decode
+    # pager, restores that were NOT fully pre-staged when the sequence
+    # needed them (the pager's miss signal — late prefetches serialize a
+    # host->HBM wait onto the decode path), and ring collective steps
+    # the context-parallel prefill dispatched (cp per cp-prefill call).
+    kv_paged_out_bytes: int = 0
+    kv_pager_prefetch_late_total: int = 0
+    cp_ring_steps_total: int = 0
 
 
 @dataclass
@@ -559,6 +568,38 @@ class LLMEngine:
                 self.runner, self.allocator, self._host_cache
             )
             self.allocator.commit_hook = self.offloader.on_commit
+
+        # Decode-time KV pager (OffloadConfig.decode_paging): spills cold
+        # page ranges of live long-context sequences through the offload
+        # tier and streams the attention window back ahead of resume, so
+        # resident HBM per sequence is bounded by window + horizon, not
+        # context length (docs/architecture/long-context.md).
+        self.pager = None
+        if (
+            self.offloader is not None
+            and config.offload.decode_paging
+            and not follower
+        ):
+            windows = config.model.layer_windows
+            if not windows or min(windows) <= 0:
+                raise ValueError(
+                    "offload.decode_paging requires every layer to be "
+                    "sliding-window: a full-attention layer reads "
+                    "arbitrarily far back, so no page is ever cold"
+                )
+            self.runner._require_single_host("decode-time KV paging")
+            from llmd_tpu.engine.pager import KVPager
+
+            self.pager = KVPager(
+                self.runner,
+                self.scheduler,
+                self.allocator,
+                self._host_cache,
+                window=max(windows),
+                horizon=config.offload.pager_horizon_tokens,
+                stream_groups=config.kv_stream_groups,
+            )
+            self.scheduler.park_hook = self.pager.park
 
         # P/D disaggregation: optional KV-transfer connector (reference
         # TPUConnector roles, pd tpu patch-decode.yaml:17-20).
@@ -1338,6 +1379,11 @@ class LLMEngine:
             self._admit_kv_streams()
         if self._lora_parked:
             self._admit_cold_loads()
+        if self.pager is not None:
+            # Restore parked attention windows before scheduling — a
+            # still-pending fetch leaves the request fetch-pending (a
+            # wait state the scheduler skips, not a fault).
+            self.pager.pump(self.scheduler.waiting)
         outputs = self._step_async() if self._async else self._step_sync()
         if self._lora_failed_outputs:
             outputs = [*self._lora_failed_outputs, *outputs]
@@ -1393,6 +1439,9 @@ class LLMEngine:
         if self.offloader is not None:
             # One bucketed HBM->host gather for the step's committed pages.
             self.offloader.flush()
+        if self.pager is not None:
+            # Spill pages that fell below the window + prefetch horizon.
+            self.pager.tick(self.scheduler.running)
         self._finish_step((t_dispatched - t0) + (time.monotonic() - t_read))
         return outputs
 
@@ -1512,6 +1561,10 @@ class LLMEngine:
         )
         if self.offloader is not None:
             self.offloader.flush()
+        if self.pager is not None:
+            # Protected (in-flight) rows are skipped inside the tick, so
+            # the staged batch's page tables stay valid.
+            self.pager.tick(self.scheduler.running)
         self._finish_step(host_gap)
         return outputs
 
@@ -1563,6 +1616,18 @@ class LLMEngine:
         the two-program path — their staging shape depends on drafts
         only known at dispatch)."""
         if batch.spec_window != 1:
+            return False
+        if (
+            self.runner.cp_prefill
+            and batch.prefills
+            and any(
+                s.num_tokens >= max(self.runner.cp_min_tokens,
+                                    self.runner.cp_prefill)
+                for s in batch.prefills
+            )
+        ):
+            # Context-parallel ring prefill lives in the split _forward
+            # family only; long chunks divert so they ride it.
             return False
         if self.runner._flat is not None:
             if batch.is_empty:
@@ -1961,6 +2026,12 @@ class LLMEngine:
             self.stats.recompute_avoided_tokens = (
                 self.offloader.recompute_avoided_tokens
             )
+        if self.pager is not None:
+            self.stats.kv_paged_out_bytes = self.pager.paged_out_bytes
+            self.stats.kv_pager_prefetch_late_total = (
+                self.pager.prefetch_late_total
+            )
+        self.stats.cp_ring_steps_total = self.runner.cp_ring_steps_total
         if self.kv_connector is not None:
             cs = self.kv_connector.stats()
             self.stats.kv_exported_requests = cs["exported_requests"]

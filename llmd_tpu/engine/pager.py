@@ -1,0 +1,272 @@
+"""Decode-time KV paging: bound resident HBM by the attention window.
+
+For sliding-window models every attention read of a live sequence is
+masked to the trailing ``window`` positions, yet the paged KV of a
+million-token context keeps EVERY page resident for the sequence's whole
+lifetime. The pager closes that gap (OffloadConfig.decode_paging):
+
+- **Spill tick** — each step, pages of a running sequence that lie
+  wholly below ``num_computed - (window + horizon)`` are copied to the
+  tiered host cache (kvtransfer/offload.py) keyed by the same chained
+  content hash the prefix index uses, then their HBM pages are freed.
+  The stale physical ids stay in ``Request.block_ids`` (the logical page
+  list must keep its length); every kernel read of those positions is
+  window-masked, and the scheduler's release/truncate paths skip
+  ``paged_out`` indexes. Resident HBM per sequence is then bounded by
+  window + horizon + chunk, not by context length.
+
+- **Park** — a preemption victim's computed KV is hosted and ALL its
+  pages freed (``Scheduler.park_hook``); it re-queues with
+  ``num_computed`` preserved instead of recomputing from zero.
+
+- **Pump (restore)** — before each schedule, parked requests at the
+  head of the queue get the attention window streamed back from the
+  host tier into freshly allocated pages over the group-framed scatter
+  leg (``scatter_pages(..., layers=)``, the v3 wire's per-cell write).
+  While the fetch is in flight the scheduler treats the request as
+  fetch-pending — a wait state, not a fault. A host-tier miss (the
+  cache evicted the page under pressure) *refunds to recompute*: the
+  request falls back to the plain recompute-preemption path, byte-
+  identical to a never-parked preemption.
+
+The fetch-horizon math: with page size P, window W and horizon H, a
+sequence at position c needs pages ``[(c - W - H) // P, ...]`` resident;
+everything below is spill-eligible, and a restore stages exactly that
+range. H buys slack so decode never catches up with a page boundary
+before the next tick (docs/architecture/long-context.md).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from llmd_tpu.engine.kv_cache import (
+    _ROOT_HASH, NoFreePagesError, page_hashes_for_tokens,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from llmd_tpu.engine.request import Request
+
+logger = logging.getLogger(__name__)
+
+
+class KVPager:
+    """Spill/park/restore pump for decode-time KV paging.
+
+    Requires: tiered offload enabled, every layer sliding-window
+    (a full-attention layer reads arbitrarily far back, so nothing is
+    ever cold), SWA ring OFF (the ring pool is its own window-bounding
+    mechanism), single host (the group-framed scatter leg is
+    leader-local). The engine checks those gates before constructing.
+    """
+
+    def __init__(
+        self,
+        runner,
+        scheduler,
+        allocator,
+        host_cache,
+        *,
+        window: int,
+        horizon: int,
+        stream_groups: int = 1,
+    ) -> None:
+        self.runner = runner
+        self.sched = scheduler
+        self.allocator = allocator
+        self.host = host_cache
+        self.page = allocator.page_size
+        self.window = int(window)
+        self.horizon = int(horizon)
+        self.keep_tokens = self.window + self.horizon
+        self.stream_groups = max(1, int(stream_groups))
+        # --- observability (EngineStats / Prometheus) ---
+        self.paged_out_bytes = 0
+        self.pages_spilled_total = 0
+        self.pages_restored_total = 0
+        self.prefetch_late_total = 0
+        self.parks_total = 0
+        self.refunds_total = 0
+
+    # ------------------------------------------------------------------ #
+    # hashing
+
+    def _hashes(self, req: Request, upto: int) -> list[bytes]:
+        """Chained content hashes of the first ``upto`` pages — identical
+        to the prefix index's keys, so pager-hosted pages double as
+        restore_for_prompt hits for future identical prompts."""
+        return page_hashes_for_tokens(
+            req.all_token_ids[: upto * self.page],
+            self.page,
+            extra=self.sched.hash_extra(req),
+        )
+
+    # ------------------------------------------------------------------ #
+    # spill tick
+
+    def tick(self, running: list[Request]) -> None:
+        """Spill cold page ranges of live sequences to the host tier.
+
+        A page is cold when every one of its positions is below the
+        window + prefetch horizon of the sequence's computed frontier.
+        In-flight (protected) sequences are skipped — the dispatched
+        device programs still hold their page tables.
+        """
+        for req in running:
+            if req.request_id in self.sched.protected:
+                continue
+            lo_page = (req.num_computed_tokens - self.keep_tokens) // self.page
+            if lo_page <= 0:
+                continue
+            lo_page = min(lo_page, len(req.block_ids))
+            idxs = [i for i in range(lo_page) if i not in req.paged_out]
+            if not idxs:
+                continue
+            hashes = self._hashes(req, lo_page)
+            self._spill(req, idxs, hashes)
+            # Advance the commit chain past the spilled range:
+            # _commit_full_pages at finish must never touch the stale
+            # ids (the allocator may have recycled those pages). The
+            # spilled range is contiguous from 0, so seeding is sound;
+            # a prefix-cache hit may already have seeded further.
+            _, committed = self.sched.commit_chain_state(req)
+            if committed < lo_page:
+                self.sched.seed_commit_chain(req, hashes[lo_page - 1], lo_page)
+
+    def _spill(self, req: Request, idxs: list[int], hashes: list[bytes]) -> None:
+        """Host-copy then free the given resident page indexes."""
+        ids = [req.block_ids[i] for i in idxs]
+        pages = self.runner.gather_pages(ids)  # [L, n, K, page, 2D]
+        for j, i in enumerate(idxs):
+            self.host.put(hashes[i], np.ascontiguousarray(pages[:, j]))
+            req.paged_out[i] = hashes[i]
+        self.allocator.free(ids)
+        self.pages_spilled_total += len(idxs)
+        self.paged_out_bytes += pages.nbytes
+
+    # ------------------------------------------------------------------ #
+    # park (Scheduler.park_hook)
+
+    def park(self, req: Request) -> int:
+        """Preemption-victim hook: host the computed KV, free all pages.
+
+        Returns the token count preserved (page-aligned, always leaving
+        at least one token to recompute so resume has a chunk to
+        dispatch), or 0 when nothing is worth parking — the scheduler
+        then falls through to plain recompute-preemption.
+        """
+        total = req.num_tokens
+        bp = min(req.num_computed_tokens // self.page, (total - 1) // self.page)
+        bp = min(bp, len(req.block_ids))
+        if bp <= 0:
+            return 0
+        hashes = self._hashes(req, bp)
+        need = [
+            i for i in range(bp)
+            if i not in req.paged_out and not self.host.has(hashes[i])
+        ]
+        if need:
+            self._spill(req, need, hashes)
+        # Everything still resident (hosted-but-not-yet-freed committed
+        # pages, plus the partial frontier beyond bp whose tokens will
+        # be recomputed) goes back to the allocator.
+        ids = [b for i, b in enumerate(req.block_ids) if i not in req.paged_out]
+        if ids:
+            self.allocator.free(ids)
+        req.block_ids = []
+        req.paged_out = {i: hashes[i] for i in range(bp)}
+        # Seed the commit chain so finish-time commits start past the
+        # parked range (those pages live in the host tier, not HBM).
+        self.sched.seed_commit_chain(req, hashes[bp - 1], bp)
+        req.kv_fetch_pending = True
+        self.parks_total += 1
+        return bp * self.page
+
+    # ------------------------------------------------------------------ #
+    # restore pump
+
+    def pump(self, waiting: list[Request]) -> None:
+        """Stream attention windows back for parked requests.
+
+        Called before each schedule(). Only the restore of the trailing
+        window + horizon is staged — pages below it stay in the host
+        tier (``paged_out``), exactly the spill tick's steady state, so
+        resume residency equals live-decode residency.
+        """
+        for req in list(waiting):
+            if req.kv_fetch_pending:
+                self._restore(req)
+
+    def _restore(self, req: Request) -> None:
+        kept = req.num_computed_tokens
+        bp = kept // self.page
+        lo = max(0, kept - self.keep_tokens) // self.page
+        idxs = list(range(lo, bp))
+        if not idxs:
+            req.kv_fetch_pending = False
+            return
+        pages = []
+        for i in idxs:
+            h = req.paged_out.get(i)
+            got, tier = (None, None) if h is None else self.host.get_tagged(h)
+            if got is None:
+                # Host tier dropped the page under pressure (or the park
+                # bookkeeping is gone): refund to recompute — the wire
+                # failed, compute did not.
+                self._refund(req)
+                return
+            if tier != "dram":
+                # The page was not pre-staged in DRAM: the fetch arrived
+                # late relative to the prefetch horizon.
+                self.prefetch_late_total += 1
+            pages.append(got)
+        try:
+            # llmd: allow(release-on-all-paths) -- every raise through the scatters frees via the except arm; past it ownership hands off into req.block_ids (owns(pages)) through the list concat below, which the handle-flow walk cannot see through
+            new_ids = self.allocator.allocate(len(idxs))
+        except NoFreePagesError:
+            return  # still fetch-pending; retried next step
+        try:
+            arr = np.stack(pages, axis=1)  # [L, n, K, page, 2D]
+            # Group-framed write-back: layer-sliced scatters ride the
+            # same per-cell pool write as the v3 streamed import.
+            num_layers = arr.shape[0]
+            groups = min(self.stream_groups, num_layers)
+            base, rem = divmod(num_layers, groups)
+            l0 = 0
+            for g in range(groups):
+                span = base + (1 if g < rem else 0)
+                if span == 0:
+                    continue
+                self.runner.scatter_pages(
+                    new_ids, arr[l0 : l0 + span], layers=(l0, span)
+                )
+                l0 += span
+        except Exception:
+            self.allocator.free(new_ids)
+            raise
+        req.block_ids = [0] * lo + list(new_ids)
+        for i in idxs:
+            req.paged_out.pop(i, None)
+        req.kv_fetch_pending = False
+        self.pages_restored_total += len(idxs)
+
+    def _refund(self, req: Request) -> None:
+        """Fall back to recompute-from-zero (wire failure semantics)."""
+        ids = [b for i, b in enumerate(req.block_ids) if i not in req.paged_out]
+        if ids:
+            self.allocator.free(ids)
+        req.block_ids = []
+        req.paged_out.clear()
+        req.kv_fetch_pending = False
+        req.num_computed_tokens = 0
+        req.num_cached_tokens = 0
+        # Reset the commit chain: nothing is committed any more.
+        self.sched.seed_commit_chain(req, _ROOT_HASH, 0)
+        self.refunds_total += 1
+        logger.info(
+            "kv pager refund: %s recomputes from zero (host tier miss)",
+            req.request_id,
+        )

@@ -110,6 +110,17 @@ class Request:
     num_pending_tokens: int = 0
     # Number of prompt tokens satisfied from the prefix cache (skipped compute).
     num_cached_tokens: int = 0
+    # Decode-time KV paging (OffloadConfig.decode_paging): logical page
+    # index -> content hash of pages whose HBM copy was released to the
+    # host tier. A stale physical id may linger in block_ids at these
+    # indexes — every attention read below the sliding window is masked,
+    # and _release skips them when freeing.
+    paged_out: dict[int, bytes] = dataclasses.field(default_factory=dict)
+    # Parked by the pager: committed KV lives in the host tier and the
+    # scheduler must not re-admit this request until the pager has
+    # streamed the attention window back into freshly allocated pages
+    # (fetch-pending is a wait state, not a fault).
+    kv_fetch_pending: bool = False
     # Outputs generated before a recompute-preemption folded them into the
     # prompt; counts toward max_tokens and reported output length.
     num_prior_output_tokens: int = 0

@@ -89,6 +89,13 @@ _OP_FLAT = 11
 # bounded header wait can distinguish "leader idle" from "leader dead".
 # No device work — followers just absorb it and keep waiting.
 _OP_HEARTBEAT = 12
+# Symmetric int8-wire scatter (the q8 twin of _OP_KV_SCATTER): the leader
+# broadcasts (i8 data + f16 K/V-half scales) instead of canonical staging
+# bytes — HALF the DCN bytes per imported page, so multi-host streamed
+# imports ride the same wire saving the q8 gather already gives exports.
+# Int8 pools scatter the wire form directly; float pools dequantize on
+# device.
+_OP_KV_SCATTER_Q8 = 13
 
 # Row kinds of the unified step's (start, qlen, kind) metadata. Only
 # verify-ness reaches the device (it selects the sample positions: verify
@@ -458,6 +465,21 @@ class ModelRunner:
                 "graduate via the bench moe_ep part on a real slice "
                 "(docs/architecture/wide-ep.md)", self.moe_overlap,
             )
+        # Context-parallel ring prefill (ParallelConfig.cp_prefill): armed
+        # only for non-MLA models on a mesh whose dp axis matches the cp
+        # degree (the config validates cp == data_parallel_size). The
+        # dedicated _forward_cp family serves chunk widths divisible by
+        # cp and at least cp_prefill_min_tokens; everything else keeps
+        # the monolithic program.
+        self.cp_prefill = (
+            int(pc.cp_prefill)
+            if pc.cp_prefill > 1 and not self.cfg.is_mla
+            else 0
+        )
+        self.cp_min_tokens = int(pc.cp_prefill_min_tokens)
+        # Ring collective steps dispatched (cp per cp-prefill call);
+        # drained into EngineStats.cp_ring_steps_total.
+        self.cp_ring_steps_total = 0
         sched = config.scheduler
         self.batch_buckets = sched.decode_batch_buckets or _buckets(sched.max_num_seqs)
         self.prefill_batch_buckets = (
@@ -480,6 +502,9 @@ class ModelRunner:
         — so no compiled family ever runs a stale capacity/placement."""
         sched = self.config.scheduler
         self._forward = self._build_forward()
+        self._forward_cp = (
+            self._build_forward(cp=self.cp_prefill) if self.cp_prefill else None
+        )
         self._multi = self._build_multi()
         # Speculative decoding (SchedulerConfig.speculative_ngram): the
         # verify step scores [B, 1 + spec_ngram_k] positions per decode
@@ -834,7 +859,8 @@ class ModelRunner:
             return packed
         return jax.lax.with_sharding_constraint(packed, self.ctx.replicated)
 
-    def _fwd_hidden(self, params, kv_cache, kv_swa, inp, census, dbo=False):
+    def _fwd_hidden(self, params, kv_cache, kv_swa, inp, census, dbo=False,
+                    cp=0):
         """llama.forward_hidden under this runner's trace-time MoE/EP
         statics (live ep_capacity, moe_overlap, the EPLB placement riding
         in ``params["moe_placement"]``), threading the census accumulator
@@ -858,6 +884,7 @@ class ModelRunner:
             ep_capacity_factor=self.ep_capacity, kv_rep=self.kv_rep,
             dbo=dbo, moe_overlap=self.moe_overlap,
             moe_placement=params.get("moe_placement"),
+            cp_prefill=cp,
             **kw,
         )
         if census is not None:
@@ -868,7 +895,11 @@ class ModelRunner:
             kv_swa = out[2]
         return hidden, kv_cache, kv_swa, census
 
-    def _build_forward(self):
+    def _build_forward(self, cp: int = 0):
+        """The prefill/one-shot-step program. ``cp`` > 1 builds the
+        context-parallel ring variant (ops/ring_attention.py): same call
+        shape, attention sharded over the mesh dp axis — a separate
+        compiled family the dispatcher selects by chunk width."""
         cfg = self.cfg
         dbo = self.config.parallel.enable_dbo
         replicate = self._replicate_out
@@ -882,7 +913,7 @@ class ModelRunner:
         def fwd(params, kv_cache, kv_swa, inp: StepInput, s: SamplingInputs,
                 census=None, all_greedy=False):
             hidden, kv_cache, kv_swa, census = self._fwd_hidden(
-                params, kv_cache, kv_swa, inp, census, dbo=dbo
+                params, kv_cache, kv_swa, inp, census, dbo=dbo, cp=cp
             )
             B = hidden.shape[0]
             last = jnp.maximum(inp.query_lens - 1, 0)
@@ -1682,6 +1713,20 @@ class ModelRunner:
         else:
             self.kv_cache = out
 
+    def _exec_kv_scatter_q8(self, arrays: dict, swa: bool = False) -> None:
+        ids = jnp.asarray(arrays["ids"])
+        q8 = jnp.asarray(arrays["q8"])
+        scales = jnp.asarray(arrays["scales"])
+        if self.kv_quantized:
+            out = self._scatter_q8_direct(self._pool(swa), ids, q8, scales)
+        else:
+            vals = _dequantize_rows_q8(q8, scales, self.staging_dtype_name)
+            out = self._scatter_canonical(self._pool(swa), ids, vals)
+        if swa:
+            self.kv_swa = out
+        else:
+            self.kv_cache = out
+
     def _kv_gather_lockstep(self, ids: np.ndarray, q8: bool, swa: bool = False):
         """Leader leg of a multi-host page gather: broadcast the op so
         every process dispatches the same program; return the (replicated)
@@ -1835,6 +1880,18 @@ class ModelRunner:
                 * data.shape[4] * self.staging_dtype.itemsize
             )
             return [("ids", (B,), np.int32), ("vals_u8", (nbytes,), np.uint8)]
+        if op == _OP_KV_SCATTER_Q8:
+            # Same header contract as _OP_KV_SCATTER (B = padded page
+            # count, QK = pool selector); the payload is the q8 wire
+            # form — i8 rows + f16 per-(token, head) K/V-half scales.
+            data = self._pool_data(bool(QK))
+            Kc = data.shape[2] // self.kv_rep
+            L, D2 = data.shape[0], data.shape[4]
+            return [
+                ("ids", (B,), np.int32),
+                ("q8", (L, B, Kc, self.page, D2), np.int8),
+                ("scales", (L, B, Kc, self.page, 2), np.float16),
+            ]
         mp = self.max_pages
         if op in (_OP_PREFILL, _OP_VERIFY):
             spec = [
@@ -2117,6 +2174,8 @@ class ModelRunner:
                 self._exec_kv_gather(arrays, bool(QK), bool(greedy))
             elif op == _OP_KV_SCATTER:
                 self._exec_kv_scatter(arrays, B, bool(QK))
+            elif op == _OP_KV_SCATTER_Q8:
+                self._exec_kv_scatter_q8(arrays, bool(QK))
             elif op == _OP_KV_COPY:
                 self._exec_kv_copy(arrays, bool(QK))
             elif op == _OP_EMBED:
@@ -2173,7 +2232,18 @@ class ModelRunner:
             top_p=jnp.asarray(arrays["top_p"]),
             seeds=jnp.asarray(arrays["seeds"]),
         )
-        self.kv_cache, self.kv_swa, packed, self._moe_census = self._forward(
+        # Program selection is shape-deterministic (Q rides the lockstep
+        # broadcast), so leader and followers always pick the same family.
+        Q = arrays["tokens"].shape[1]
+        fwd = self._forward
+        if (
+            self._forward_cp is not None
+            and Q % self.cp_prefill == 0
+            and Q >= max(self.cp_min_tokens, self.cp_prefill)
+        ):
+            fwd = self._forward_cp
+            self.cp_ring_steps_total += self.cp_prefill
+        self.kv_cache, self.kv_swa, packed, self._moe_census = fwd(
             self.params, self.kv_cache, self.kv_swa, inp, s,
             census=self._moe_census, all_greedy=all_greedy,
         )
@@ -2582,6 +2652,51 @@ class ModelRunner:
                 self.kv_swa = out
             else:
                 self.kv_cache = out
+
+    def scatter_pages_q8(
+        self,
+        page_ids: list[int],
+        q8: np.ndarray,
+        scales: np.ndarray,
+        swa: bool = False,
+    ) -> None:
+        """Stage an int8-wire bundle host -> HBM (the symmetric twin of
+        the q8 gather): (q8 [L, n, K, page, 2D] i8, scales
+        [L, n, K, page, 2] f16) canonical heads. Multi-host broadcasts
+        the wire form — HALF the DCN bytes of the canonical
+        _OP_KV_SCATTER leg — with head expansion (and for float pools
+        the dequant) on every process's device. Same bucket-padding and
+        locking discipline as :meth:`scatter_pages`."""
+        n = len(page_ids)
+        if n == 0:
+            return
+        bucket = pad_to_bucket(n, _buckets(max(self.config.cache.num_blocks, n)))
+        ids = np.asarray(page_ids, np.int32)
+        q8 = np.asarray(q8)
+        scales = np.asarray(scales)
+        if bucket > n:
+            ids = np.concatenate([ids, np.full(bucket - n, ids[-1], np.int32)])
+            q8 = np.concatenate(
+                [q8, np.repeat(q8[:, -1:], bucket - n, axis=1)], axis=1
+            )
+            scales = np.concatenate(
+                [scales, np.repeat(scales[:, -1:], bucket - n, axis=1)], axis=1
+            )
+        arrays = {
+            "ids": ids,
+            "q8": np.ascontiguousarray(q8, np.int8),
+            "scales": np.ascontiguousarray(scales, np.float16),
+        }
+        if self._multihost:
+            assert dist.is_leader(), "KV staging ops originate on the leader"
+            with self._dispatch_lock:
+                arrays = self._sync_locked(
+                    _OP_KV_SCATTER_Q8, bucket, int(swa), False, arrays
+                )
+                self._exec_kv_scatter_q8(arrays, swa)
+            return
+        with self._dispatch_lock:
+            self._exec_kv_scatter_q8(arrays, swa)
 
     # ------------------------------------------------------------------ #
 

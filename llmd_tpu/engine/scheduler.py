@@ -123,6 +123,12 @@ class EngineScheduler:
         # sections (hybrid APC) so live sequences outrank retention.
         # Returns True if anything was freed (retry the allocation).
         self.ring_pressure_hook = None
+        # Decode-time KV pager (engine/pager.py): called with a
+        # preemption victim before the recompute release. Returns the
+        # number of tokens preserved in the host tier (the victim
+        # resumes from there instead of recomputing from zero), or 0
+        # when the victim was not parked (fall through to recompute).
+        self.park_hook = None
         # Async stepping: request ids whose pages the in-flight device
         # programs still read/write — preemption must never evict them
         # (their pages would be freed under the device's feet). Sync
@@ -382,6 +388,12 @@ class EngineScheduler:
         #    provisional pages immediately).
         while self.waiting and budget > 0:
             req = self.waiting[0]
+            if req.kv_fetch_pending:
+                # Parked by the pager and its attention window is not
+                # yet resident again — a wait state, not a fault. The
+                # pager's pump retries the restore each step; admission
+                # stays FCFS behind it.
+                break
             if self._batch_band and req.is_batch:
                 break  # backfill phase owns batch admission
             if len(self.running) >= self.config.max_num_seqs:
@@ -706,15 +718,25 @@ class EngineScheduler:
         victim = max(victims, key=lambda r: (r.priority * -1, r.arrival_time))
         if victim.is_batch:
             self.num_batch_preemptions += 1
-        self._release(victim)
+        kept = self.park_hook(victim) if self.park_hook is not None else 0
+        if kept:
+            # Parked: the pager already hosted the committed KV and
+            # released the HBM pages; only queue bookkeeping remains.
+            # Resume streams the attention window back instead of
+            # recomputing the whole prefix.
+            victim.num_pending_tokens = 0
+            self.protected.discard(victim.request_id)
+        else:
+            self._release(victim)
         self.running.remove(victim)
-        # Fold generated tokens into the prompt and restart from scratch.
+        # Fold generated tokens into the prompt and restart from scratch
+        # (or, when parked, from the pager's preserved prefix).
         victim.num_prior_output_tokens += len(victim.output_token_ids)
         victim.prompt_token_ids = victim.all_token_ids
         victim.output_token_ids = []
         self.num_preemptions += 1
-        victim.num_computed_tokens = 0
-        victim.num_cached_tokens = 0
+        victim.num_computed_tokens = kept
+        victim.num_cached_tokens = kept
         victim.status = RequestStatus.PREEMPTED
         # insort keeps the victim FCFS-ordered by its original arrival time
         # within its priority class, so it resumes ahead of newer arrivals.
@@ -727,8 +749,19 @@ class EngineScheduler:
         req.num_pending_tokens = 0
         self.protected.discard(req.request_id)
         if req.block_ids:
-            self.allocator.free(req.block_ids)
+            # Paged-out indexes hold stale ids — the pager freed (and the
+            # allocator may have recycled) those pages when it spilled
+            # them to the host tier; freeing again would corrupt another
+            # sequence's pages.
+            ids = [
+                b for i, b in enumerate(req.block_ids)
+                if i not in req.paged_out
+            ]
+            if ids:
+                self.allocator.free(ids)
             req.block_ids = []
+        req.paged_out.clear()
+        req.kv_fetch_pending = False
         if req.swa_block_ids:
             self.swa_allocator.free(req.swa_block_ids)
             req.swa_block_ids = []
@@ -949,6 +982,12 @@ class EngineScheduler:
     def hash_extra(self, req: Request) -> bytes:
         """Public cache-identity discriminator (see _hash_extra)."""
         return self._hash_extra(req)
+
+    def commit_chain_state(self, req: Request) -> tuple[bytes, int]:
+        """(chain tail hash, committed page count) — the pager consults
+        this before seeding past a spilled range so it never regresses a
+        prefix-cache-seeded chain."""
+        return self._chain.get(req.request_id, (_ROOT_HASH, 0))
 
     def _commit_full_pages(self, req: Request) -> None:
         """Register newly-completed full pages in the prefix index."""

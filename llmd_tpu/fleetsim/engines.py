@@ -314,6 +314,19 @@ class ReplicaProfile:
     kv_capacity_tokens: int = 2048 * 16  # pool pages x page size
     startup_s: float = 2.0  # autoscale provisioning delay (sim time)
     recompute_penalty: float = 1.0  # extra prefill fraction on kv.pull.drop
+    # Million-token context tier (docs/architecture/long-context.md).
+    # ``cp_degree > 1`` models context-parallel ring prefill: prompts at
+    # or above ``long_prompt_tokens`` prefill with their chunks sharded
+    # over the mesh sequence axis, so TTFT scales down ~cp_degree x (the
+    # K/V ring rotation rides ICI and is not the bottleneck at these
+    # chunk sizes). ``kv_window_tokens > 0`` models decode-time KV
+    # paging: a sequence's resident HBM is bounded by the attention
+    # window — everything colder spills to the host tier and is counted
+    # in ``kv_paged_out_tokens`` — so a 1M-token document holds window
+    # bytes, not context bytes, against ``kv_capacity_tokens``.
+    cp_degree: int = 1
+    long_prompt_tokens: int = 0  # 0 = no prompt rides the ring
+    kv_window_tokens: int = 0  # 0 = full context resident (no pager)
 
     @classmethod
     def from_bench(
@@ -424,6 +437,14 @@ class SimReplica:
                 moe.num_experts, moe.world
             )
             self._moe_next_tick: float | None = None
+        # Million-token context tier (long-context.md): ring-prefill and
+        # pager engagement counters for the scoreboard's long_context
+        # section — documents that rode the cp ring, tokens whose KV was
+        # paged out of HBM, and the replica's peak resident KV (the
+        # bound the kv_peak gate holds against capacity).
+        self.cp_ring_prefills = 0
+        self.kv_paged_out_tokens = 0
+        self.kv_peak_tokens = 0.0
         self.alive = True
         self.accepting = True  # False while draining out of the pool
         self.waiting = 0
@@ -824,7 +845,14 @@ class SimReplica:
             self.waiting -= 1
         self.running += 1
         held_tokens = prompt_tokens + output_tokens
+        if 0 < p.kv_window_tokens < held_tokens:
+            # Decode-time KV paging: only the attention window stays
+            # resident; the cold remainder spills to the host tier.
+            self.kv_paged_out_tokens += held_tokens - p.kv_window_tokens
+            held_tokens = p.kv_window_tokens
         self.kv_used_tokens += held_tokens
+        if self.kv_used_tokens > self.kv_peak_tokens:
+            self.kv_peak_tokens = self.kv_used_tokens
         lora_acquired = False
         try:
             if adapter is not None and self.lora is not None:
@@ -849,6 +877,17 @@ class SimReplica:
                     request_id, prompt_tokens + resume_tokens,
                     prefix_group, prefix_tokens,
                 )
+                if (
+                    p.cp_degree > 1
+                    and p.long_prompt_tokens > 0
+                    and prompt_tokens + resume_tokens
+                    >= p.long_prompt_tokens
+                ):
+                    # Context-parallel ring prefill: the document's
+                    # chunks shard over the sequence axis, so time to
+                    # first token divides by the cp degree.
+                    prefill_s /= p.cp_degree
+                    self.cp_ring_prefills += 1
                 if faults.fires(
                     "kv.pull.drop", f"{self.address}|{request_id}"
                 ):

@@ -40,6 +40,14 @@ batch_backfill diurnal interactive traffic plus a standing offline
             drained through the troughs (WVA floors the fleet on the
             backlog instead of scaling to zero), trough utilization
             floor raised, interactive zero-lost and p99 TTFT held.
+long_context steady chat traffic plus a wave of 1M-token document
+            jobs (long-context.md): documents prefill through the
+            context-parallel ring tier (TTFT / cp_degree) and decode
+            under the KV pager (resident HBM bounded by the attention
+            window) — chat-tenant p99 TTFT and fleet TPOT must hold
+            THROUGH the wave, every document completes, the ring and
+            the pager provably engaged, and no replica's resident KV
+            ever exceeds its pool capacity.
 router_soak the REAL epp/server.py aiohttp router over loopback
             sockets on the virtual loop (fleet-soak follow-up (a)):
             mid-stream kills of stub HTTP replicas resume through the
@@ -598,6 +606,70 @@ def build_expert_skew(
                     invariants=invariants)
 
 
+def build_long_context(
+    seed: int = 0, qps_scale: float = 1.0, cp: bool = True
+) -> FleetSim:
+    # The million-token-context acceptance scenario
+    # (docs/architecture/long-context.md): four chat tenants at steady
+    # rate PLUS a mid-window wave of 1M-token document jobs on a
+    # long-context tier — replicas sized as an 8-chip slice whose
+    # profile arms both tentpoles: ring prefill (cp_degree=8, so a
+    # document's TTFT is its monolithic prefill / 8) and the decode-time
+    # KV pager (kv_window_tokens bounds each sequence's resident HBM;
+    # the ~15/16 of a document's KV beyond the window spills to the
+    # host tier). Gates: chat-tenant p99 TTFT and fleet p99 TPOT hold
+    # THROUGH the wave, every document completes, ring + pager provably
+    # engaged, and peak resident KV never exceeds pool capacity — which
+    # a windowless fleet (15 M resident tokens vs a 262 k pool) could
+    # not hold. ``cp=False`` keeps the pager but pins the monolithic
+    # prefill path — the TTFT baseline the bench part compares.
+    qps = 2_000.0 * qps_scale
+    duration = 2.0
+    n = max(3, round(6 * qps_scale))
+    chat = generate(
+        "steady", qps=qps, duration_s=duration, seed=seed,
+        tenants=TENANTS_EQUAL, prompt_tokens=128, output_tokens=8,
+    )
+    doc_tokens = 1_048_576
+    docs = [
+        TraceRequest(
+            t=0.3 + 0.1 * i, request_id=f"doc-{i:03d}", tenant="docs",
+            prompt_tokens=doc_tokens, output_tokens=16,
+        )
+        for i in range(6)
+    ]
+    profile = dataclasses.replace(
+        _PROFILE,
+        # An 8-chip long-context slice: rates and pool scale with chips.
+        prefill_tok_s=_PROFILE.prefill_tok_s * 16.0,
+        decode_tok_s=_PROFILE.decode_tok_s * 8.0,
+        kv_capacity_tokens=_PROFILE.kv_capacity_tokens * 8,
+        cp_degree=8 if cp else 1,
+        long_prompt_tokens=32_768,
+        kv_window_tokens=65_536,
+    )
+    cfg = FleetConfig(replicas=n, profile=profile, grace_s=90.0)
+    chat_tenants = [t for t, _ in TENANTS_EQUAL]
+    invariants = [
+        ("zero_lost", sb.inv_zero_lost),
+        ("all_completed", sb.inv_all_completed(1.0)),
+        ("docs_completed", sb.inv_tenant_completion(["docs"], 1.0)),
+        # Chat must not feel the document wave: per-tenant band, because
+        # the global percentile legitimately carries the documents' long
+        # (ring-compressed) prefills.
+        ("chat_p99_ttft", sb.inv_tenant_p99_ttft_ms(chat_tenants, 600.0)),
+        ("p99_tpot", sb.inv_p99_tpot_ms(120.0)),
+        ("kv_paged_out", sb.inv_kv_paged_out(doc_tokens)),
+        ("kv_peak_bounded", sb.inv_kv_peak_bounded),
+    ]
+    if cp:
+        invariants.append(
+            ("ring_engaged", sb.inv_cp_ring_engaged(len(docs)))
+        )
+    return FleetSim(cfg, chat + docs, seed=seed, scenario="long_context",
+                    invariants=invariants)
+
+
 def build_router_soak(seed: int = 0, qps_scale: float = 1.0):
     # The REAL epp/server.py aiohttp router in-process on the virtual
     # loop (fleetsim.router_soak): loopback sockets, production parser/
@@ -666,6 +738,11 @@ SCENARIOS: dict[str, Scenario] = {
                  "wide-EP MoE under Zipf expert popularity: the real "
                  "EPLB balancer holds shard skew and dropped slots "
                  "that the static identity layout provably cannot"),
+        Scenario("long_context", build_long_context,
+                 "steady chat + a 1M-token document wave: ring prefill "
+                 "compresses document TTFT, the KV pager bounds "
+                 "resident HBM by the attention window, chat p99 holds "
+                 "through the wave"),
         Scenario("router_soak", build_router_soak,
                  "REAL aiohttp router over loopback on the virtual "
                  "loop: mid-stream kills resume through the production "

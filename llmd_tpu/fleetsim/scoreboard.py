@@ -814,6 +814,71 @@ def inv_lora_cold_stall_ms(bound_p50_ms: float) -> Invariant:
     return check
 
 
+def inv_tenant_p99_ttft_ms(tenants: list[str], bound_ms: float) -> Invariant:
+    """Per-tenant TTFT band: each named tenant's p99 TTFT stays under
+    ``bound_ms`` — the long_context scenario's chat gate, where the
+    GLOBAL percentile would be dominated by the document wave's
+    legitimately long prefills."""
+    def check(board: dict) -> str | None:
+        for t in tenants:
+            pt = board["per_tenant"].get(t)
+            if pt is None:
+                return f"tenant {t} missing from scoreboard"
+            if pt["p99_ttft_ms"] > bound_ms:
+                return (
+                    f"tenant {t} p99 TTFT {pt['p99_ttft_ms']:.1f}ms "
+                    f"> {bound_ms}ms"
+                )
+        return None
+    return check
+
+
+def inv_cp_ring_engaged(min_prefills: int = 1) -> Invariant:
+    """The context-parallel tier provably ran: at least ``min_prefills``
+    long prompts prefilled through the ring schedule (the TTFT gate is
+    vacuous if every document took the monolithic path)."""
+    def check(board: dict) -> str | None:
+        lc = board.get("long_context")
+        if lc is None:
+            return "scoreboard carries no long_context section"
+        if lc["cp_ring_prefills"] < min_prefills:
+            return f"cp_ring_prefills {lc['cp_ring_prefills']} < {min_prefills}"
+        return None
+    return check
+
+
+def inv_kv_paged_out(min_tokens: int = 1) -> Invariant:
+    """The decode-time pager provably spilled: at least ``min_tokens``
+    of KV left HBM for the host tier — without this the kv_peak bound
+    would hold trivially on a fleet whose contexts simply fit."""
+    def check(board: dict) -> str | None:
+        lc = board.get("long_context")
+        if lc is None:
+            return "scoreboard carries no long_context section"
+        if lc["kv_paged_out_tokens"] < min_tokens:
+            return (
+                f"kv_paged_out_tokens {lc['kv_paged_out_tokens']} "
+                f"< {min_tokens}"
+            )
+        return None
+    return check
+
+
+def inv_kv_peak_bounded(board: dict) -> str | None:
+    """THE residency bar (long-context.md): no replica's resident KV
+    ever exceeded its pool capacity — million-token documents hold
+    window bytes, not context bytes."""
+    lc = board.get("long_context")
+    if lc is None:
+        return "scoreboard carries no long_context section"
+    if lc["peak_kv_tokens"] > lc["kv_capacity_tokens"]:
+        return (
+            f"peak resident KV {lc['peak_kv_tokens']:.0f} tokens > "
+            f"capacity {lc['kv_capacity_tokens']}"
+        )
+    return None
+
+
 def inv_faults_fired(site: str, at_least: int = 1) -> Invariant:
     def check(board: dict) -> str | None:
         n = board["faults_injected"].get(site, 0)

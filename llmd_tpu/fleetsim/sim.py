@@ -997,6 +997,29 @@ class FleetSim:
                     sum(r.moe_skew_sum for r in reps) / n, 4
                 ) if n else 1.0,
             }
+        if (
+            self.cfg.profile.cp_degree > 1
+            or self.cfg.profile.kv_window_tokens > 0
+        ):
+            reps = list(self.replicas.values())
+            extra = dict(extra or {})
+            extra["long_context"] = {
+                "cp_degree": self.cfg.profile.cp_degree,
+                "kv_window_tokens": self.cfg.profile.kv_window_tokens,
+                "kv_capacity_tokens": self.cfg.profile.kv_capacity_tokens,
+                "cp_ring_prefills": sum(r.cp_ring_prefills for r in reps),
+                # Pager engagement + the residency headline: tokens whose
+                # KV spilled to the host tier, and the worst any
+                # replica's resident KV ever got (the kv_peak gate holds
+                # this against capacity — window bytes, not context
+                # bytes).
+                "kv_paged_out_tokens": sum(
+                    r.kv_paged_out_tokens for r in reps
+                ),
+                "peak_kv_tokens": max(
+                    (r.kv_peak_tokens for r in reps), default=0.0
+                ),
+            }
         if self.kv_store is not None:
             reps = list(self.replicas.values())
             extra = dict(extra or {})

@@ -1339,16 +1339,19 @@ class TPUConnector:
                     # apply never reads np_chunks; holding the whole
                     # transfer in RAM would cost a bundle-sized buffer
                     # for nothing). Pad slots repeat the last real id
-                    # (idempotent duplicate write). The broadcast rides
-                    # the staging dtype — a symmetric q8 form of
-                    # _OP_KV_SCATTER (matching the gather's q8 flag)
-                    # would halve the DCN bytes for q8 wire chunks and
-                    # is the known next step here.
+                    # (idempotent duplicate write). q8 wire chunks ride
+                    # the symmetric _OP_KV_SCATTER_Q8 broadcast — half
+                    # the DCN bytes per page, dequant (or direct int8
+                    # write) on every process's device; exact chunks
+                    # keep the staging-dtype broadcast.
                     o0 = sp + j * cp - start_page
                     ids_j = _pad_chunk_ids(stream_ids[o0 : o0 + cp], cp)
-                    self.runner.scatter_pages(
-                        ids_j, PulledBundle._dequant_chunk(chunk_entry)
-                    )
+                    if isinstance(chunk_entry, tuple):
+                        self.runner.scatter_pages_q8(
+                            ids_j, chunk_entry[0], chunk_entry[1]
+                        )
+                    else:
+                        self.runner.scatter_pages(ids_j, chunk_entry)
                 else:
                     np_chunks.append(chunk_entry)
                 nbytes += len(blob)

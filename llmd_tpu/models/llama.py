@@ -28,6 +28,7 @@ from llmd_tpu.ops import (
     write_kv_pages_full,
     write_kv_pages_full_flat,
 )
+from llmd_tpu.ops.ring_attention import ring_prefill_attention_full
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
@@ -184,6 +185,7 @@ def forward_hidden(
     moe_overlap: int = 0,
     moe_placement: dict | None = None,
     moe_census: jax.Array | None = None,
+    cp_prefill: int = 0,
 ):
     """Run the decoder stack; returns (hidden [B, Q, H], new kv_cache) —
     or (hidden, new kv_cache, new kv_swa) when ``kv_swa`` is given.
@@ -223,7 +225,16 @@ def forward_hidden(
     batch; numerics are then exact unless EP capacity binds (a half's
     routing demand is compared against full capacity separately, so DBO
     can only drop FEWER tokens, never different ones below capacity).
-    Requires an even batch."""
+    Requires an even batch.
+
+    ``cp_prefill`` > 1 (ParallelConfig.cp_prefill) runs each layer's
+    attention as a context-parallel ring over the mesh "dp" axis
+    (ops/ring_attention.py): the chunk's query rows and fresh K/V shard
+    contiguously across dp, K/V blocks rotate via ppermute while every
+    shard folds online-softmax partials, and the committed prefix is
+    read from the post-write pool — tolerance-equal to the monolithic
+    path. Only engaged for the bucketed non-DBO layout with Q divisible
+    by cp (the runner compiles a dedicated prefill program for it)."""
     B, Q = inp.token_ids.shape
     D, Nq, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     x = params["embed"][inp.token_ids]  # [B, Q, H]
@@ -249,6 +260,10 @@ def forward_hidden(
         and (B // 2) % _dp == 0
     )
     half = B // 2
+    cp_ring = (
+        cp_prefill > 1 and mesh is not None and not flat and not use_dbo
+        and not cfg.is_mla and Q % cp_prefill == 0
+    )
 
     use_census = moe_census is not None and cfg.is_moe and moe_backend == "ep"
 
@@ -408,6 +423,12 @@ def forward_hidden(
                     inp.kv_lens, inp.positions, sm_scale,
                     world_size=world_size, mesh=mesh, window=window,
                     sinks=sinks,
+                )
+            elif cp_ring:
+                attn = ring_prefill_attention_full(
+                    q, cache, layer_idx, k, v, table, inp.kv_lens,
+                    inp.positions, valid, sm_scale, mesh=mesh,
+                    cp=cp_prefill, window=window, sinks=sinks,
                 )
             else:
                 attn = paged_attention_full(

@@ -131,6 +131,10 @@ def make_engine_config(args, lora_adapters=None):
             ),
             moe_backend=args.moe_backend,
             enable_dbo=args.enable_dbo,
+            cp_prefill=(
+                args.cp_prefill if _multihost_world() else 1
+            ),
+            cp_prefill_min_tokens=args.cp_prefill_min_tokens,
         ),
         seed=args.seed,
         weights_path=weights_path,
@@ -150,6 +154,8 @@ def make_engine_config(args, lora_adapters=None):
                 store_data_port=args.kv_store_data_port,
                 publish_policy=args.kv_publish_policy,
                 publish_min_hits=args.kv_publish_min_hits,
+                decode_paging=args.kv_decode_paging,
+                pager_horizon_tokens=args.kv_pager_horizon_tokens,
             )
             if args.kv_offload_chunks
             else None
@@ -290,6 +296,31 @@ def build_parser() -> argparse.ArgumentParser:
         "--moe-backend", default="grouped", choices=["grouped", "dense", "ep"],
         help="MoE path: grouped GEMM (DeepGEMM role, default), dense "
              "combine (oracle), or shard_map all-to-all (wide-EP)",
+    )
+    p.add_argument(
+        "--cp-prefill", type=int, default=1,
+        help="context-parallel ring prefill degree (long-context.md): "
+        "shard long prompts' chunks over the dp mesh axis and compute "
+        "attention as a ppermute ring; must equal --data-parallel-size "
+        "(1 disables; forced to 1 outside a jax.distributed world, "
+        "like DP itself)",
+    )
+    p.add_argument(
+        "--cp-prefill-min-tokens", type=int, default=512,
+        help="smallest chunk that rides the ring — shorter chunks are "
+        "dispatch-bound and take the monolithic arm",
+    )
+    p.add_argument(
+        "--kv-decode-paging", action="store_true",
+        help="decode-time KV pager (long-context.md): spill live-"
+        "sequence pages below the attention window to the offload tier "
+        "and stream them back ahead of the window; requires "
+        "--kv-offload-chunks and a sliding-window model",
+    )
+    p.add_argument(
+        "--kv-pager-horizon-tokens", type=int, default=256,
+        help="prefetch horizon the pager keeps resident beyond the "
+        "attention window",
     )
     p.add_argument(
         "--platform", default=None,

@@ -68,6 +68,9 @@ def render_metrics(
         gauges["swa_sections"] = stats.swa_sections
     gauges["kv_offload_cpu_pages"] = stats.offload_pages
     gauges["kv_offload_fs_pages"] = stats.offload_fs_pages
+    # Decode-pager residency (long-context.md): LIVE-sequence bytes in
+    # the offload tier — falls as windows stream back, so a gauge.
+    gauges["kv_paged_out_bytes"] = stats.kv_paged_out_bytes
     # Last streamed import's first-group latency: the admission-gate
     # leg of the layer-streamed transfer waterfall (kv-cache.md).
     gauges["kv_stream_first_group_ms"] = round(
@@ -107,6 +110,11 @@ def render_metrics(
         # Publish-budget pacing (LLMD_KV_PUBLISH_BYTES_PER_S): bytes the
         # federation publisher delayed to protect the transfer NIC.
         "kv_publish_paced_bytes_total": stats.kv_publish_paced_bytes_total,
+        # Million-token context tier (docs/architecture/long-context.md):
+        # late pager window fetches and ring collective steps from
+        # context-parallel prefill (paged-out residency is a gauge above).
+        "kv_pager_prefetch_late_total": stats.kv_pager_prefetch_late_total,
+        "cp_ring_steps_total": stats.cp_ring_steps_total,
         # Async stepping (speculate/rollback contract)
         "engine_steps_total": stats.engine_steps_total,
         "step_host_gap_ms_total": round(stats.step_host_gap_ms_total, 3),

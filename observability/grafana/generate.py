@@ -221,6 +221,27 @@ DASHBOARDS["llmd-engine-kv-cache"] = dashboard(
               legends=["hits/s", "captures/s"],
               desc="captures with zero hits = retention is paying copy "
                    "cost for prefixes that never repeat."),
+        row("Million-token context tier (long-context.md)"),
+        panel("Ring prefill steps /s",
+              [f"rate(llmd:cp_ring_steps_total{M}[5m])"],
+              legends=["ring steps/s"],
+              desc="Context-parallel prefill collective steps "
+                   "(ops/ring_attention.py). Zero with cp_prefill > 1 "
+                   "configured = prompts never clear "
+                   "cp_prefill_min_tokens, the ring is not engaging."),
+        panel("Pager residency (spilled bytes)",
+              [f"llmd:kv_paged_out_bytes{M}"],
+              legends=["paged-out bytes"], unit="bytes",
+              desc="Decode-time pager: live-sequence KV resident in the "
+                   "offload tier instead of HBM. Growing with flat pool "
+                   "usage is the tier working; zero under long-context "
+                   "load = decode_paging off or windows too wide."),
+        panel("Late window fetches /s",
+              [f"rate(llmd:kv_pager_prefetch_late_total{M}[5m])"],
+              legends=["late fetches/s"],
+              desc="Window restores that finished after the request "
+                   "could have run — sustained rate means "
+                   "pager_horizon_tokens is too small for the wire."),
         row("KV federation (fleet-wide store)"),
         panel("Recompute avoided tok/s",
               [f"rate(llmd:recompute_avoided_tokens_total{M}[5m])",

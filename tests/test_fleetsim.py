@@ -392,6 +392,43 @@ def test_expert_skew_scoreboard_byte_identical():
     assert a == b
 
 
+def test_long_context_ring_prefill_shields_chat_and_bounds_kv():
+    """Million-token context tier (long-context.md): a wave of 1M-token
+    documents lands on a chat fleet.  With context-parallel ring prefill
+    the docs finish ~cp_degree faster, the decode-time pager keeps
+    resident KV under the HBM cap (the raw wave would not fit), and chat
+    p99 TTFT stays inside its band through the wave.  The cp=1 baseline
+    on the SAME trace shows the ring is what bought the doc TTFT."""
+    from llmd_tpu.fleetsim.scenarios import build_long_context
+
+    on = _run("long_context", 0.25)
+    assert on["ok"], on["invariants"]
+    lc = on["long_context"]
+    assert lc["cp_degree"] > 1
+    assert lc["cp_ring_prefills"] == 6  # every document rode the ring
+    # Pager spilled more than one full document past the window...
+    assert lc["kv_paged_out_tokens"] > 1_000_000
+    # ...and the resident working set never exceeded HBM capacity,
+    # which a single unwindowed 1M-token doc alone would blow through.
+    assert lc["peak_kv_tokens"] <= lc["kv_capacity_tokens"]
+    assert lc["kv_window_tokens"] < 1_048_576
+
+    off = build_long_context(0, 0.25, cp=False).run()
+    lo = off["long_context"]
+    assert lo["cp_degree"] == 1 and lo["cp_ring_prefills"] == 0
+    on_doc = on["per_tenant"]["docs"]["p99_ttft_ms"]
+    off_doc = off["per_tenant"]["docs"]["p99_ttft_ms"]
+    assert on_doc < off_doc / 2  # ring prefill, not noise
+    assert on["requests"]["lost"] == 0
+    assert off["requests"]["lost"] == 0
+
+
+def test_long_context_scoreboard_byte_identical():
+    a = to_canonical_json(_run("long_context", 0.1))
+    b = to_canonical_json(_run("long_context", 0.1))
+    assert a == b
+
+
 def test_hung_requests_are_surfaced_not_lost():
     """A replica that never finishes within the grace window produces a
     `hung` record and fails zero_lost — the invariant can actually fire."""

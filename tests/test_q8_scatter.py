@@ -1,0 +1,89 @@
+"""Symmetric q8 KV scatter (runner.scatter_pages_q8 / _OP_KV_SCATTER_Q8).
+
+The import twin of the q8 gather wire: a (q8, wire-scales) bundle lands
+host -> HBM without the consumer ever materializing the f32 bundle on
+the wire (multi-host broadcasts ride HALF the DCN bytes of the canonical
+_OP_KV_SCATTER leg). Float pools dequantize on device; int8 pools take
+the bundle byte-direct. The full lockstep leg is exercised by
+test_multihost_pd_transfer[int8] where the backend supports it.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from llmd_tpu.config import (
+    CacheConfig, EngineConfig, ParallelConfig, SchedulerConfig,
+    tiny_model_config,
+)
+from llmd_tpu.engine.engine import LLMEngine
+from llmd_tpu.engine.runner import _dequantize_rows_q8, _quantize_rows_q8
+
+rng = np.random.default_rng(0)
+
+
+def make_engine(dtype="float32"):
+    cfg = EngineConfig(
+        model=tiny_model_config(),
+        cache=CacheConfig(page_size=4, num_blocks=32, dtype=dtype),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_num_batched_tokens=32),
+        parallel=ParallelConfig(tensor_parallel_size=1),
+        seed=0,
+    )
+    return LLMEngine(cfg)
+
+
+def _wire_bundle(runner, n):
+    """A synthetic q8 wire bundle shaped like the producer's gather."""
+    L, _, K, page, D2 = runner.gather_pages([0]).shape
+    pages = rng.standard_normal((L, n, K, page, D2)).astype(np.float32)
+    q8, scales = _quantize_rows_q8(jnp.asarray(pages))
+    return np.asarray(q8), np.asarray(scales)
+
+
+def test_q8_scatter_float_pool_matches_dequant():
+    eng = make_engine("float32")
+    ids = [3, 7, 11]
+    q8, scales = _wire_bundle(eng.runner, len(ids))
+    eng.runner.scatter_pages_q8(ids, q8, scales)
+    got = eng.runner.gather_pages(ids)
+    want = np.asarray(
+        _dequantize_rows_q8(jnp.asarray(q8), jnp.asarray(scales), "float32")
+    )
+    np.testing.assert_allclose(got, want, atol=1e-6, rtol=0)
+
+
+def test_q8_scatter_matches_canonical_scatter():
+    """scatter_pages_q8(bundle) == scatter_pages(dequant(bundle)): the
+    wire halving must not change a single pool byte."""
+    a, b = make_engine("float32"), make_engine("float32")
+    ids = [1, 2, 9, 13]
+    q8, scales = _wire_bundle(a.runner, len(ids))
+    a.runner.scatter_pages_q8(ids, q8, scales)
+    b.runner.scatter_pages(
+        ids,
+        np.asarray(
+            _dequantize_rows_q8(jnp.asarray(q8), jnp.asarray(scales), "float32")
+        ),
+    )
+    np.testing.assert_array_equal(
+        a.runner.gather_pages(ids), b.runner.gather_pages(ids)
+    )
+
+
+def test_q8_scatter_int8_pool_direct():
+    """Int8 pools take the wire bundle without a dequant/requant round
+    trip: a re-gather reproduces the same dequantized rows."""
+    eng = make_engine("int8")
+    ids = [5, 6]
+    q8, scales = _wire_bundle(eng.runner, len(ids))
+    eng.runner.scatter_pages_q8(ids, q8, scales)
+    got = eng.runner.gather_pages(ids)
+    want = np.asarray(
+        _dequantize_rows_q8(
+            jnp.asarray(q8), jnp.asarray(scales),
+            eng.runner.staging_dtype_name,
+        )
+    )
+    np.testing.assert_allclose(
+        got.astype(np.float32), want.astype(np.float32), atol=2e-2, rtol=0
+    )
