@@ -150,11 +150,17 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(unused)} unused pragma(s)"
         )
         return 0
-    out = (
-        render_json(findings, nfiles)
-        if args.json
-        else render_human(findings, nfiles)
-    )
+    if args.json:
+        from llmd_tpu.analysis import manifests
+
+        deploy_objects = (
+            len(manifests.render_corpus(root.resolve()).objects)
+            if manifests.load_yaml() is not None
+            else None
+        )
+        out = render_json(findings, nfiles, deploy_objects)
+    else:
+        out = render_human(findings, nfiles)
     print(out)
     return 1 if findings else 0
 

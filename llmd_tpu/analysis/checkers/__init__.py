@@ -4,6 +4,7 @@ from llmd_tpu.analysis.checkers import (  # noqa: F401
     clock_discipline,
     concurrency,
     config_parity,
+    deploy_parity,
     envvars,
     faults_discipline,
     host_sync,

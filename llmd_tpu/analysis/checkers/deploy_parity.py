@@ -1,0 +1,801 @@
+"""deploy-parity: the rendered deploy surface ↔ the code it deploys.
+
+The manifests under ``deploy/`` ARE the product surface, and every
+probe path, container flag, env var, and port in them names something
+the Python tree must actually provide. Nothing fails at render time
+when they drift — a readiness probe against a route the binary never
+registered just marks the pod unready forever, a misspelled flag
+aborts at pod start, an env var nobody reads is dead configuration.
+This checker renders the whole surface via
+:mod:`llmd_tpu.analysis.manifests` (every kustomize root + the Helm
+chart values matrix) and diffs the resolved objects against the code
+inventories the other parity checkers already trust: ``add_argument``
+flags, aiohttp GET routes, and env-var string constants.
+
+Rules:
+
+- DP001 **schema-shape** — the stdlib kubeconform stand-in: every
+  object's kind is in the registry with the right apiVersion and its
+  required fields present; Deployment selectors match their template
+  labels; no duplicate (kind, name) within a unit; no duplicate
+  container port names/numbers in a pod; render failures (a patch
+  whose target moved, an unparseable template) are DP001 findings too.
+- DP002 **flag-parity** — every ``--flag`` a container passes to an
+  ``llmd_tpu.*`` module must exist in that module's CLI (dotted-file
+  modules also accept their package ``__main__`` flags — the
+  dp_supervisor hands post-``--`` args to serve).
+- DP003 **env-parity** — both directions: every ``LLMD_*``/``VLLM_*``
+  var a manifest sets must be read somewhere in the Python tree, and
+  every such var the code reads must be settable/visible somewhere
+  outside it (a manifest env stanza, docs, or a shell script) —
+  orphans are configuration knowledge that exists only in the source.
+- DP004 **probe-parity** — httpGet probe paths must be routes the
+  target module actually serves (engine ``/ready``, routers
+  ``/readyz`` — docs/architecture/fault-tolerance.md's probe
+  contract); readiness must use the module's readiness route when it
+  has one; probe ports must resolve to declared container ports (or a
+  ``--port``/``--health-port`` arg); and the primary container of a
+  routed pod (role-labeled or Service-backed) must declare liveness
+  and readiness probes at all.
+- DP005 **port/scrape-parity** — Service targetPort ↔ containerPort ↔
+  ``--port`` arg; PodMonitor endpoints and ``prometheus.io/*`` scrape
+  annotations must point at a declared port on a container whose
+  module serves ``/metrics``.
+
+Suppression uses the same pragma grammar as everywhere else, as a YAML
+comment on the offending line or the line above::
+
+    # llmd: allow(deploy-parity) -- <reason>
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from llmd_tpu.analysis import manifests
+from llmd_tpu.analysis.core import Checker, Finding, Repo, register
+from llmd_tpu.analysis.manifests import source_line
+
+# kind -> (accepted apiVersions, required top-level dotted field paths).
+# The stdlib kubeconform stand-in: enough schema to catch a pasted-in
+# object with the wrong group or a gutted spec.
+KIND_REGISTRY: dict[str, tuple[set[str], tuple[str, ...]]] = {
+    "Deployment": ({"apps/v1"}, ("spec.selector", "spec.template")),
+    "Service": ({"v1"}, ("spec.ports",)),
+    "ConfigMap": ({"v1"}, ()),
+    "Secret": ({"v1"}, ()),
+    "ServiceAccount": ({"v1"}, ()),
+    "Namespace": ({"v1"}, ()),
+    "PersistentVolumeClaim": ({"v1"}, ("spec.accessModes",)),
+    "Role": ({"rbac.authorization.k8s.io/v1"}, ("rules",)),
+    "RoleBinding": (
+        {"rbac.authorization.k8s.io/v1"}, ("roleRef", "subjects"),
+    ),
+    "LeaderWorkerSet": (
+        {"leaderworkerset.x-k8s.io/v1"}, ("spec.leaderWorkerTemplate",),
+    ),
+    "Gateway": (
+        {"gateway.networking.k8s.io/v1", "gateway.networking.k8s.io/v1beta1"},
+        ("spec.gatewayClassName", "spec.listeners"),
+    ),
+    "HTTPRoute": (
+        {"gateway.networking.k8s.io/v1", "gateway.networking.k8s.io/v1beta1"},
+        ("spec.rules",),
+    ),
+    "InferencePool": (
+        {"inference.networking.x-k8s.io/v1alpha2"},
+        ("spec.selector", "spec.targetPortNumber"),
+    ),
+    "PodMonitor": (
+        {"monitoring.coreos.com/v1"},
+        ("spec.selector", "spec.podMetricsEndpoints"),
+    ),
+    "ScaledObject": ({"keda.sh/v1alpha1"}, ("spec.scaleTargetRef",)),
+    "CustomResourceDefinition": (
+        {"apiextensions.k8s.io/v1"},
+        ("spec.group", "spec.names", "spec.versions"),
+    ),
+    "DestinationRule": (
+        {"networking.istio.io/v1beta1", "networking.istio.io/v1"},
+        ("spec.host",),
+    ),
+}
+
+# Role-label values the EPP's k8s-selectors route to. Pods carrying
+# other roles (e.g. decode-worker follower ranks, which serve no HTTP)
+# are not admission-gated, so probes are validated but not required.
+ROUTED_ROLES = frozenset({"prefill", "decode", "prefill-decode", "encode"})
+
+ROLE_LABEL = "llm-d.ai/role"
+
+_FLAG_RE = re.compile(r"--[A-Za-z][A-Za-z0-9-]*")
+_MODULE_RE = re.compile(r"\bllmd_tpu(?:\.[A-Za-z_]\w*)+")
+_ENV_VAR_RE = re.compile(r"\b(?:LLMD|VLLM)_[A-Z0-9_]+\b")
+
+WORKLOAD_KINDS = ("Deployment", "LeaderWorkerSet", "StatefulSet", "DaemonSet")
+
+
+# ------------------------------------------------------------------ #
+# code inventories (built from the scan set, like config-parity, so
+# fixture trees and --changed-only behave consistently)
+
+
+def _module_of_path(path: str) -> str | None:
+    parts = Path(path).parts
+    if "llmd_tpu" not in parts:
+        return None
+    i = parts.index("llmd_tpu")
+    rel = parts[i:]
+    if not rel[-1].endswith(".py"):
+        return None
+    if rel[-1] == "__main__.py" or rel[-1] == "__init__.py":
+        rel = rel[:-1]
+    else:
+        rel = rel[:-1] + (rel[-1][:-3],)
+    return ".".join(rel)
+
+
+def _package_of(module: str) -> str:
+    return ".".join(module.split(".")[:2])
+
+
+def _flag_inventory(repo: Repo) -> dict[str, set[str]]:
+    """module -> {--flag} from every add_argument call in the tree."""
+    inv: dict[str, set[str]] = {}
+    for sf in repo.files:
+        if not sf.is_python or "add_argument" not in sf.text:
+            continue
+        mod = _module_of_path(sf.path)
+        if mod is None or sf.tree is None:
+            continue
+        flags = inv.setdefault(mod, set())
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+            ):
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ) and arg.value.startswith("--"):
+                        flags.add(arg.value)
+    return inv
+
+
+def _endpoint_inventory(repo: Repo) -> dict[str, set[str]]:
+    """package -> {GET route path} from web.get()/add_get() calls."""
+    inv: dict[str, set[str]] = {}
+    for sf in repo.files:
+        if not sf.is_python or sf.tree is None:
+            continue
+        mod = _module_of_path(sf.path)
+        if mod is None:
+            continue
+        pkg = _package_of(mod)
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "add_get")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("/")
+            ):
+                inv.setdefault(pkg, set()).add(node.args[0].value)
+    return inv
+
+
+def _env_read_inventory(repo: Repo) -> dict[str, tuple[str, int]]:
+    """LLMD_*/VLLM_* string constants in the Python tree (exact-match
+    constants are programmatic uses: environ.get, _env fallbacks, env
+    dict keys — prose in docstrings never matches exactly). The
+    linter's own package is excluded: rule text and exempt lists name
+    vars without reading them."""
+    out: dict[str, tuple[str, int]] = {}
+    for sf in repo.files:
+        if not sf.is_python or sf.tree is None:
+            continue
+        parts = Path(sf.path).parts
+        if "llmd_tpu" not in parts or "analysis" in parts:
+            continue
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and re.fullmatch(r"(?:LLMD|VLLM)_[A-Z0-9_]+", node.value)
+            ):
+                out.setdefault(node.value, (sf.path, node.lineno))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# manifest walking
+
+
+def _pod_templates(obj: dict) -> list[dict]:
+    spec = obj.get("spec") or {}
+    out = []
+    if obj.get("kind") in WORKLOAD_KINDS and isinstance(
+        spec.get("template"), dict
+    ):
+        out.append(spec["template"])
+    lwt = spec.get("leaderWorkerTemplate") or {}
+    for key in ("leaderTemplate", "workerTemplate"):
+        if isinstance(lwt.get(key), dict):
+            out.append(lwt[key])
+    return out
+
+
+def _tmpl_labels(tmpl: dict) -> dict:
+    return (tmpl.get("metadata") or {}).get("labels") or {}
+
+
+def _containers(tmpl: dict, init: bool = False) -> list[dict]:
+    spec = tmpl.get("spec") or {}
+    key = "initContainers" if init else "containers"
+    return [c for c in spec.get(key) or [] if isinstance(c, dict)]
+
+
+def _command_text(c: dict) -> str:
+    toks = list(c.get("command") or []) + list(c.get("args") or [])
+    return " ".join(str(t) for t in toks)
+
+
+def _container_module(c: dict) -> str | None:
+    m = _MODULE_RE.search(_command_text(c))
+    return m.group(0) if m else None
+
+
+def _container_ports(c: dict) -> tuple[dict[str, int], set[int]]:
+    names: dict[str, int] = {}
+    numbers: set[int] = set()
+    for p in c.get("ports") or []:
+        if not isinstance(p, dict):
+            continue
+        num = p.get("containerPort")
+        if isinstance(num, int):
+            numbers.add(num)
+            if p.get("name"):
+                names[str(p["name"])] = num
+    return names, numbers
+
+
+def _arg_ports(text: str) -> set[int]:
+    out = set()
+    for m in re.finditer(r"--(?:port|health-port)[= ](\d+)", text):
+        out.add(int(m.group(1)))
+    return out
+
+
+def _get_path(obj: dict, dotted: str):
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _selected(selector: dict, labels: dict) -> bool:
+    return bool(selector) and all(
+        labels.get(k) == v for k, v in selector.items()
+    )
+
+
+@register
+class DeployParityChecker(Checker):
+    name = "deploy-parity"
+    description = (
+        "rendered deploy/ + chart objects match the code they deploy: "
+        "schema shape (DP001), container flags exist in the module CLI "
+        "(DP002), env vars are read in-tree and settable somewhere "
+        "(DP003), probes hit real routes on declared ports (DP004), "
+        "Service/scrape ports line up with containerPorts and --port "
+        "(DP005)"
+    )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        if manifests.load_yaml() is None:
+            return []  # render layer gated off without pyyaml
+        corpus = manifests.render_corpus(repo.root)
+        if not corpus.objects and not corpus.errors:
+            return []
+        self._by_path = {sf.path: sf for sf in repo.files}
+        self._seen: set[tuple] = set()
+        self._findings: list[Finding] = []
+        flags = _flag_inventory(repo)
+        endpoints = _endpoint_inventory(repo)
+
+        for src, msg in corpus.errors:
+            self._emit("DP001", src, 1, f"deploy surface unrenderable: {msg}")
+
+        by_unit = corpus.by_unit()
+        for unit, ros in by_unit.items():
+            self._check_unit_schema(unit, ros)
+            services = [
+                ro for ro in ros if ro.obj.get("kind") == "Service"
+            ]
+            for ro in ros:
+                for tmpl in _pod_templates(ro.obj):
+                    self._check_pod(
+                        ro, tmpl, services, flags, endpoints,
+                    )
+            self._check_services(unit, ros)
+            self._check_monitors(unit, ros, endpoints)
+
+        self._check_env_parity(repo, corpus)
+        return self._findings
+
+    # -- plumbing -------------------------------------------------- #
+
+    def _emit(self, code: str, src: str, line: int, msg: str) -> None:
+        """Anchor a finding to a scanned source file; findings in files
+        outside the scan set are dropped (--changed-only semantics)."""
+        if src not in self._by_path:
+            return
+        key = (code, src, line, msg)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self._findings.append(Finding("deploy-parity", code, src, line, msg))
+
+    def _anchor(self, ro: manifests.RenderedObject, needle: str) -> int:
+        sf = self._by_path.get(ro.source)
+        return source_line(sf.text, needle) if sf else 1
+
+    # -- DP001 ----------------------------------------------------- #
+
+    def _check_unit_schema(
+        self, unit: str, ros: list[manifests.RenderedObject]
+    ) -> None:
+        names: dict[tuple[str, str], str] = {}
+        for ro in ros:
+            obj = ro.obj
+            kind = obj.get("kind")
+            name = (obj.get("metadata") or {}).get("name")
+            if not kind or not isinstance(kind, str):
+                self._emit("DP001", ro.source, 1, "object without a kind")
+                continue
+            line = self._anchor(ro, f"name: {name}" if name else kind)
+            if not name:
+                self._emit(
+                    "DP001", ro.source, line,
+                    f"{kind} object has no metadata.name",
+                )
+            reg = KIND_REGISTRY.get(kind)
+            if reg is None:
+                self._emit(
+                    "DP001", ro.source, line,
+                    f"unknown kind {kind!r}: not in the deploy-parity "
+                    "kind/apiVersion registry (add it with its required "
+                    "fields if the kind is intentional)",
+                )
+                continue
+            versions, required = reg
+            api = obj.get("apiVersion")
+            if api not in versions:
+                self._emit(
+                    "DP001", ro.source, line,
+                    f"{kind}/{name}: apiVersion {api!r} is not the "
+                    f"registered {sorted(versions)}",
+                )
+            for dotted in required:
+                if _get_path(obj, dotted) is None:
+                    self._emit(
+                        "DP001", ro.source, line,
+                        f"{kind}/{name}: required field {dotted} missing",
+                    )
+            if name:
+                key = (kind, str(name))
+                if key in names and names[key] == unit:
+                    self._emit(
+                        "DP001", ro.source, line,
+                        f"duplicate {kind}/{name} in unit {unit}",
+                    )
+                names[key] = unit
+            if kind == "Deployment":
+                sel = _get_path(obj, "spec.selector.matchLabels") or {}
+                tmpls = _pod_templates(obj)
+                labels = _tmpl_labels(tmpls[0]) if tmpls else {}
+                for k, v in sel.items():
+                    if labels.get(k) != v:
+                        self._emit(
+                            "DP001", ro.source, line,
+                            f"Deployment/{name}: selector {k}={v} does "
+                            "not match the pod template labels — the "
+                            "deployment can never adopt its own pods",
+                        )
+            for tmpl in _pod_templates(obj):
+                for c in _containers(tmpl):
+                    pnames: set[str] = set()
+                    pnums: set[int] = set()
+                    for p in c.get("ports") or []:
+                        if not isinstance(p, dict):
+                            continue
+                        num = p.get("containerPort")
+                        pname = p.get("name")
+                        if isinstance(num, int):
+                            if num in pnums:
+                                self._emit(
+                                    "DP001", ro.source,
+                                    self._anchor(ro, str(num)),
+                                    f"{kind}/{name} container "
+                                    f"{c.get('name')}: duplicate "
+                                    f"containerPort {num}",
+                                )
+                            pnums.add(num)
+                        if pname:
+                            if pname in pnames:
+                                self._emit(
+                                    "DP001", ro.source,
+                                    self._anchor(ro, str(pname)),
+                                    f"{kind}/{name} container "
+                                    f"{c.get('name')}: duplicate port "
+                                    f"name {pname!r}",
+                                )
+                            pnames.add(str(pname))
+
+    # -- DP002 + DP004 (per pod) ----------------------------------- #
+
+    def _check_pod(
+        self,
+        ro: manifests.RenderedObject,
+        tmpl: dict,
+        services: list[manifests.RenderedObject],
+        flags: dict[str, set[str]],
+        endpoints: dict[str, set[str]],
+    ) -> None:
+        labels = _tmpl_labels(tmpl)
+        routed = labels.get(ROLE_LABEL) in ROUTED_ROLES or any(
+            _selected((s.obj.get("spec") or {}).get("selector") or {}, labels)
+            for s in services
+        )
+        primary_claimed = False
+        for c in _containers(tmpl):
+            text = _command_text(c)
+            module = _container_module(c)
+            if module is None:
+                continue
+            # DP002: every flag must exist in the module CLI (plus the
+            # package __main__'s for dotted file modules: dp_supervisor
+            # forwards post-`--` args to serve).
+            allowed = set(flags.get(module, ()))
+            if module.count(".") >= 2:
+                allowed |= flags.get(_package_of(module), set())
+            if flags and (module in flags or _package_of(module) in flags):
+                for flag in sorted(set(_FLAG_RE.findall(text))):
+                    if flag not in allowed:
+                        self._emit(
+                            "DP002", ro.source, self._anchor(ro, flag),
+                            f"container {c.get('name')} passes {flag} "
+                            f"but {module} declares no such flag — it "
+                            "aborts at pod start",
+                        )
+            eps = endpoints.get(_package_of(module), set())
+            self._check_probes(
+                ro, c, module, eps, text,
+                required=(
+                    routed and not primary_claimed and bool(eps)
+                ),
+            )
+            if routed and eps and not primary_claimed:
+                primary_claimed = True
+
+    def _check_probes(
+        self,
+        ro: manifests.RenderedObject,
+        c: dict,
+        module: str,
+        eps: set[str],
+        text: str,
+        required: bool,
+    ) -> None:
+        names, numbers = _container_ports(c)
+        argports = _arg_ports(text)
+        ready_ep = (
+            "/ready" if "/ready" in eps
+            else "/readyz" if "/readyz" in eps
+            else None
+        )
+        live_ep = next(
+            (p for p in ("/health", "/healthz") if p in eps),
+            sorted(eps)[0] if eps else "",
+        )
+        for probe_key in ("livenessProbe", "readinessProbe", "startupProbe"):
+            probe = c.get(probe_key)
+            if not isinstance(probe, dict):
+                if required and probe_key in (
+                    "livenessProbe", "readinessProbe"
+                ):
+                    self._emit(
+                        "DP004", ro.source,
+                        self._anchor(ro, f"name: {c.get('name')}"),
+                        f"routed container {c.get('name')} ({module}) "
+                        f"has no {probe_key} — "
+                        + (
+                            "the router admits traffic to pods that "
+                            "never proved ready"
+                            if probe_key == "readinessProbe"
+                            else "a wedged process is never restarted"
+                        )
+                        + "; probe " + (
+                            (ready_ep or live_ep)
+                            if probe_key == "readinessProbe"
+                            else live_ep
+                        ),
+                    )
+                continue
+            http = probe.get("httpGet")
+            if not isinstance(http, dict):
+                continue
+            path = http.get("path")
+            if eps and path not in eps:
+                self._emit(
+                    "DP004", ro.source, self._anchor(ro, f"path: {path}"),
+                    f"{probe_key} of container {c.get('name')} probes "
+                    f"{path} but {module} serves only "
+                    f"{', '.join(sorted(eps))} — the probe can never "
+                    "succeed",
+                )
+            elif (
+                probe_key == "readinessProbe"
+                and ready_ep is not None
+                and path != ready_ep
+            ):
+                self._emit(
+                    "DP004", ro.source, self._anchor(ro, f"path: {path}"),
+                    f"readinessProbe of container {c.get('name')} probes "
+                    f"{path}, but {module} has the dedicated readiness "
+                    f"route {ready_ep} (fault-tolerance.md probe "
+                    "contract) — liveness-style paths report alive, "
+                    "not ready-to-admit",
+                )
+            port = http.get("port")
+            if isinstance(port, str):
+                if names and port not in names:
+                    self._emit(
+                        "DP004", ro.source,
+                        self._anchor(ro, f"port: {port}"),
+                        f"{probe_key} of container {c.get('name')} "
+                        f"targets port name {port!r} which is not a "
+                        "declared containerPort name "
+                        f"({', '.join(sorted(names)) or 'none'})",
+                    )
+            elif isinstance(port, int):
+                if (numbers or argports) and port not in (
+                    numbers | argports
+                ):
+                    self._emit(
+                        "DP004", ro.source,
+                        self._anchor(ro, f"port: {port}"),
+                        f"{probe_key} of container {c.get('name')} "
+                        f"targets port {port}, matching no declared "
+                        "containerPort or --port/--health-port arg",
+                    )
+
+    # -- DP005 ------------------------------------------------------ #
+
+    def _check_services(
+        self, unit: str, ros: list[manifests.RenderedObject]
+    ) -> None:
+        tmpl_index = []
+        for ro in ros:
+            for tmpl in _pod_templates(ro.obj):
+                tmpl_index.append((ro, tmpl))
+        for ro in ros:
+            obj = ro.obj
+            if obj.get("kind") != "Service":
+                continue
+            spec = obj.get("spec") or {}
+            selector = spec.get("selector") or {}
+            if not selector:
+                continue
+            name = (obj.get("metadata") or {}).get("name")
+            matched = [
+                (wro, tmpl) for wro, tmpl in tmpl_index
+                if _selected(selector, _tmpl_labels(tmpl))
+            ]
+            if not matched:
+                self._emit(
+                    "DP005", ro.source,
+                    self._anchor(ro, f"name: {name}"),
+                    f"Service/{name}: selector "
+                    f"{selector} matches no pod template in unit "
+                    f"{unit} — the Service has no endpoints",
+                )
+                continue
+            port_names: set[str] = set()
+            port_numbers: set[int] = set()
+            for _, tmpl in matched:
+                for c in _containers(tmpl):
+                    cn, cnum = _container_ports(c)
+                    port_names |= set(cn)
+                    port_numbers |= cnum
+            for p in spec.get("ports") or []:
+                if not isinstance(p, dict):
+                    continue
+                target = p.get("targetPort", p.get("port"))
+                if isinstance(target, str) and target not in port_names:
+                    self._emit(
+                        "DP005", ro.source,
+                        self._anchor(ro, str(target)),
+                        f"Service/{name}: targetPort {target!r} names "
+                        "no containerPort on the selected pods "
+                        f"({', '.join(sorted(port_names)) or 'none'})",
+                    )
+                elif (
+                    isinstance(target, int)
+                    and port_numbers
+                    and target not in port_numbers
+                ):
+                    self._emit(
+                        "DP005", ro.source,
+                        self._anchor(ro, str(target)),
+                        f"Service/{name}: targetPort {target} matches "
+                        "no containerPort on the selected pods "
+                        f"({sorted(port_numbers)})",
+                    )
+        # --port/--health-port ↔ containerPort on every container that
+        # declares ports.
+        for ro in ros:
+            for tmpl in _pod_templates(ro.obj):
+                for c in _containers(tmpl):
+                    if _container_module(c) is None:
+                        continue
+                    _, numbers = _container_ports(c)
+                    if not numbers:
+                        continue
+                    for port in sorted(_arg_ports(_command_text(c))):
+                        if port not in numbers:
+                            self._emit(
+                                "DP005", ro.source,
+                                self._anchor(ro, str(port)),
+                                f"container {c.get('name')} listens on "
+                                f"--port/--health-port {port} but "
+                                "declares containerPorts "
+                                f"{sorted(numbers)} — the Service/probe "
+                                "plumbing can't reach it",
+                            )
+        # prometheus.io scrape annotations.
+        for ro in ros:
+            for tmpl in _pod_templates(ro.obj):
+                ann = (tmpl.get("metadata") or {}).get("annotations") or {}
+                if str(ann.get("prometheus.io/scrape")).lower() != "true":
+                    continue
+                sport = ann.get("prometheus.io/port")
+                spath = ann.get("prometheus.io/path", "/metrics")
+                numbers: set[int] = set()
+                for c in _containers(tmpl):
+                    _, cnum = _container_ports(c)
+                    numbers |= cnum
+                line = self._anchor(ro, "prometheus.io/")
+                try:
+                    pnum = int(sport)
+                except (TypeError, ValueError):
+                    pnum = None
+                if pnum is None or (numbers and pnum not in numbers):
+                    self._emit(
+                        "DP005", ro.source, line,
+                        f"prometheus.io/scrape points at port {sport!r} "
+                        "which is no declared containerPort "
+                        f"({sorted(numbers)})",
+                    )
+                if spath != "/metrics":
+                    self._emit(
+                        "DP005", ro.source, line,
+                        f"prometheus.io/path {spath!r}: the in-tree "
+                        "servers export /metrics only",
+                    )
+
+    def _check_monitors(
+        self,
+        unit: str,
+        ros: list[manifests.RenderedObject],
+        endpoints: dict[str, set[str]],
+    ) -> None:
+        tmpls = [
+            tmpl for ro in ros for tmpl in _pod_templates(ro.obj)
+        ]
+        for ro in ros:
+            obj = ro.obj
+            if obj.get("kind") != "PodMonitor":
+                continue
+            name = (obj.get("metadata") or {}).get("name")
+            spec = obj.get("spec") or {}
+            sel = _get_path(obj, "spec.selector.matchLabels") or {}
+            matched = [
+                t for t in tmpls if _selected(sel, _tmpl_labels(t))
+            ]
+            line = self._anchor(ro, f"name: {name}")
+            if sel and not matched:
+                self._emit(
+                    "DP005", ro.source, line,
+                    f"PodMonitor/{name}: selector matches no pod "
+                    f"template in unit {unit} — nothing gets scraped",
+                )
+                continue
+            for ep in spec.get("podMetricsEndpoints") or []:
+                if not isinstance(ep, dict):
+                    continue
+                pname = ep.get("port")
+                path = ep.get("path", "/metrics")
+                owners = [
+                    c
+                    for t in matched
+                    for c in _containers(t)
+                    if pname in _container_ports(c)[0]
+                ]
+                if pname and not owners:
+                    self._emit(
+                        "DP005", ro.source,
+                        self._anchor(ro, str(pname)),
+                        f"PodMonitor/{name}: endpoint port {pname!r} "
+                        "names no containerPort on the matched pods",
+                    )
+                    continue
+                for c in owners:
+                    module = _container_module(c)
+                    if module is None:
+                        continue
+                    eps = endpoints.get(_package_of(module), set())
+                    if eps and path not in eps:
+                        self._emit(
+                            "DP005", ro.source,
+                            self._anchor(ro, str(path)),
+                            f"PodMonitor/{name}: scrapes {path} but "
+                            f"{module} serves only "
+                            f"{', '.join(sorted(eps))}",
+                        )
+
+    # -- DP003 ------------------------------------------------------ #
+
+    def _check_env_parity(
+        self, repo: Repo, corpus: manifests.Corpus
+    ) -> None:
+        code_env = _env_read_inventory(repo)
+        has_python = any(
+            sf.is_python and "llmd_tpu" in Path(sf.path).parts
+            for sf in repo.files
+        )
+        # Direction 1: every LLMD_/VLLM_ var a manifest sets is read.
+        manifest_vars: set[str] = set()
+        for ro in corpus.objects:
+            for tmpl in _pod_templates(ro.obj):
+                for c in _containers(tmpl) + _containers(tmpl, init=True):
+                    for env in c.get("env") or []:
+                        if not isinstance(env, dict):
+                            continue
+                        var = str(env.get("name", ""))
+                        if not _ENV_VAR_RE.fullmatch(var):
+                            continue
+                        manifest_vars.add(var)
+                        if has_python and var not in code_env:
+                            self._emit(
+                                "DP003", ro.source,
+                                self._anchor(ro, var),
+                                f"manifest sets {var} but nothing in "
+                                "the Python tree reads it — dead "
+                                "configuration",
+                            )
+        # Direction 2: every var the code reads is settable/documented
+        # somewhere outside the Python tree.
+        other_text = "\n".join(
+            sf.text for sf in repo.files
+            if sf.path.endswith((".md", ".sh", ".yaml"))
+        )
+        if not other_text:
+            return
+        visible = set(_ENV_VAR_RE.findall(other_text)) | manifest_vars
+        for var, (path, line) in sorted(code_env.items()):
+            if var not in visible:
+                self._emit(
+                    "DP003", path, line,
+                    f"{var} is read here but set nowhere: no manifest "
+                    "env stanza, doc, or script mentions it — operators "
+                    "cannot discover it (document it or wire it into a "
+                    "manifest)",
+                )

@@ -8,6 +8,13 @@ checker): an all-caps variable may be read only if the script
 (d) declares it in an ``# env: VAR`` comment. (Role model: the
 reference's scripts/lint-envvars.py env-declaration lint; independent
 implementation.)
+
+Scope: ``*.sh`` files, plus the shell embedded in ``deploy/**/*.yaml``
+(``sh -c`` container blocks). The shell regexes are YAML-safe by
+construction — Kubernetes' own ``$(VAR)`` substitution syntax never
+matches ``$VAR``/``${VAR}`` shell reads, so a manifest with no
+embedded shell produces no findings — which lets the whole file run
+through :func:`lint_lines` with real line numbers.
 """
 
 from __future__ import annotations
@@ -74,7 +81,13 @@ class EnvvarsChecker(Checker):
     def run(self, repo: Repo) -> list[Finding]:
         findings: list[Finding] = []
         for sf in repo.files:
-            if not sf.path.endswith(".sh"):
+            if not (
+                sf.path.endswith(".sh")
+                or (
+                    sf.path.endswith((".yaml", ".yml"))
+                    and sf.path.startswith("deploy/")
+                )
+            ):
                 continue
             for line, _var, msg in lint_lines(sf.lines):
                 findings.append(

@@ -102,8 +102,10 @@ class SourceFile:
         # quoting pragma examples must not trip the hygiene rules, and
         # neither must pragma grammar quoted inside Python STRING
         # literals (checker messages teach the grammar) — for .py files
-        # only real COMMENT tokens count.
-        suppressible = self.path.endswith((".py", ".sh"))
+        # only real COMMENT tokens count. YAML joined the suppressible
+        # set with the deploy-parity rules: `# llmd: allow(...)` works
+        # as a YAML comment on the offending line or the line above.
+        suppressible = self.path.endswith((".py", ".sh", ".yaml"))
         comment_lines = (
             _python_comment_lines(self.text)
             if suppressible and self.is_python
@@ -203,6 +205,7 @@ _DEFAULT_GLOBS = (
     "llmd_tpu/**/*.py",
     "observability/**/*.json",
     "observability/**/*.yaml",
+    "deploy/**/*.yaml",
     "docs/**/*.md",
     "README.md",
 )
@@ -401,11 +404,19 @@ def render_human(findings: list[Finding], nfiles: int) -> str:
     return "\n".join(lines)
 
 
-def render_json(findings: list[Finding], nfiles: int) -> str:
-    return json.dumps(
-        {"files": nfiles, "findings": [f.to_dict() for f in findings]},
-        indent=2,
-    )
+def render_json(
+    findings: list[Finding], nfiles: int,
+    deploy_objects: int | None = None,
+) -> str:
+    doc: dict = {"files": nfiles}
+    if deploy_objects is not None:
+        # How many resolved Kubernetes objects the deploy-parity render
+        # layer produced (kustomize roots + chart values matrix) — the
+        # CI lint job pins this above a floor so an import failure in
+        # the render layer can't silently shrink the checked surface.
+        doc["deploy_objects"] = deploy_objects
+    doc["findings"] = [f.to_dict() for f in findings]
+    return json.dumps(doc, indent=2)
 
 
 _SARIF_HELP_URI = (

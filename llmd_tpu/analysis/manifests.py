@@ -1,0 +1,519 @@
+"""Render layer: the deploy/ tree as one corpus of resolved objects.
+
+The deployment manifests are the product surface, but the linter's AST
+rules stop at the Python boundary — probes, flags, env vars, and ports
+in ``deploy/`` drift silently against the code they deploy. This module
+closes the render gap: every kustomize base/overlay/component under
+``deploy/`` is resolved (resources, components, inline JSON6902 and
+strategic-merge patches, configMapGenerator, nameSuffix, labels with
+includeSelectors — exactly the feature set the tree uses), and the
+``deploy/charts/llmd-tpu`` Helm chart is rendered across a values
+matrix mirroring the CI combinations, into one list of
+:class:`RenderedObject` that ``checkers/deploy_parity.py`` walks.
+
+Each object remembers its *source file* (root-relative) so findings
+anchor to the line a human would edit, and its *unit* (the
+kustomization root or chart variant) so duplicate-name checks don't
+fire across independent overlays that intentionally share a base.
+
+Render failures (a patch whose target moved, a template that no longer
+parses) are collected as corpus errors, not exceptions — drift in the
+render inputs is itself a finding, reported by DP001.
+
+Stdlib + pyyaml only; pyyaml is gated so importing the analysis package
+never needs it. Without pyyaml the corpus is empty and carries one
+error saying so.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import re
+from pathlib import Path
+
+from llmd_tpu.analysis import helm_mini
+from llmd_tpu.analysis.helm_mini import Renderer
+
+# pyyaml binds lazily (the tree gate pins that importing the analysis
+# package pulls in no third-party modules); None until first render.
+yaml = None
+
+
+def load_yaml():
+    """Bind pyyaml on first use; returns the module or None."""
+    global yaml
+    if yaml is None:
+        yaml = helm_mini.load_yaml()
+    return yaml
+
+# Chart values matrix: the combinations the reference CI helm-templates
+# (mirrors tests/test_helm_template.py so the checked surface is the
+# tested surface).
+CHART_VALUES_MATRIX = (
+    ("default", {}),
+    ("observability", {
+        "monitoring": {"enabled": True, "labels": {"release": "prom"}},
+        "tracing": {"enabled": True, "sampleRatio": 0.25},
+    }),
+    ("minimal", {
+        "prefill": {"enabled": False},
+        "sidecar": {"enabled": False},
+        "httpRoute": {"create": False},
+    }),
+    ("quantized", {
+        "model": {"quantization": "int8"},
+        "decode": {"enableDbo": True},
+    }),
+)
+
+
+@dataclasses.dataclass
+class RenderedObject:
+    """One resolved Kubernetes object with provenance."""
+
+    obj: dict
+    unit: str    # kustomization root dir or "chart:<variant>"
+    source: str  # root-relative path of the file to anchor findings to
+
+
+@dataclasses.dataclass
+class Corpus:
+    objects: list[RenderedObject]
+    units: list[str]
+    errors: list[tuple[str, str]]  # (source path, message)
+
+    def by_unit(self) -> dict[str, list[RenderedObject]]:
+        out: dict[str, list[RenderedObject]] = {}
+        for ro in self.objects:
+            out.setdefault(ro.unit, []).append(ro)
+        return out
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+# ------------------------------------------------------------------ #
+# kustomize
+
+
+def _json6902(obj: dict, ops: list[dict]) -> None:
+    """Apply an RFC 6902 op list (the add/replace/remove subset the
+    tree uses). Raises on a path that doesn't resolve — a patch whose
+    target moved is drift, surfaced as a corpus error by the caller."""
+    for op in ops:
+        segs = [
+            s.replace("~1", "/").replace("~0", "~")
+            for s in str(op["path"]).split("/")[1:]
+        ]
+        parent = obj
+        for s in segs[:-1]:
+            parent = parent[int(s)] if isinstance(parent, list) else parent[s]
+        last = segs[-1]
+        kind = op["op"]
+        if kind == "add":
+            if isinstance(parent, list):
+                if last == "-":
+                    parent.append(op["value"])
+                else:
+                    parent.insert(int(last), op["value"])
+            else:
+                parent[last] = op["value"]
+        elif kind == "replace":
+            if isinstance(parent, list):
+                parent[int(last)] = op["value"]  # raises on bad index
+            else:
+                if last not in parent:
+                    raise KeyError(f"replace target {op['path']!r} absent")
+                parent[last] = op["value"]
+        elif kind == "remove":
+            if isinstance(parent, list):
+                del parent[int(last)]
+            else:
+                del parent[last]
+        else:
+            raise ValueError(f"unsupported JSON6902 op {kind!r}")
+
+
+def _strategic_merge(base: dict, patch: dict) -> None:
+    """Strategic-merge: dicts merge recursively; lists of named objects
+    (containers, ports, env) merge by ``name``; scalar lists replace."""
+    for k, v in patch.items():
+        cur = base.get(k)
+        if isinstance(v, dict) and isinstance(cur, dict):
+            _strategic_merge(cur, v)
+        elif (
+            isinstance(v, list) and isinstance(cur, list)
+            and all(isinstance(e, dict) and "name" in e for e in v)
+            and all(isinstance(e, dict) and "name" in e for e in cur)
+        ):
+            by_name = {e["name"]: e for e in cur}
+            for e in v:
+                if e["name"] in by_name:
+                    _strategic_merge(by_name[e["name"]], e)
+                else:
+                    cur.append(e)
+        else:
+            base[k] = v
+
+
+def _target_matches(target: dict, obj: dict) -> bool:
+    if target.get("kind") and obj.get("kind") != target["kind"]:
+        return False
+    name = (obj.get("metadata") or {}).get("name")
+    if target.get("name") and name != target["name"]:
+        return False
+    return True
+
+
+def _pod_template_paths(obj: dict) -> list[dict]:
+    """The pod template metadata-bearing dicts of a workload object."""
+    out = []
+    spec = obj.get("spec") or {}
+    if isinstance(spec.get("template"), dict):
+        out.append(spec["template"])
+    lwt = spec.get("leaderWorkerTemplate") or {}
+    for key in ("leaderTemplate", "workerTemplate"):
+        if isinstance(lwt.get(key), dict):
+            out.append(lwt[key])
+    return out
+
+
+def _apply_labels(
+    objs: list[RenderedObject], pairs: dict, include_selectors: bool
+) -> None:
+    for ro in objs:
+        obj = ro.obj
+        obj.setdefault("metadata", {}).setdefault("labels", {}).update(pairs)
+        for tmpl in _pod_template_paths(obj):
+            tmpl.setdefault("metadata", {}).setdefault(
+                "labels", {}
+            ).update(pairs)
+        if not include_selectors:
+            continue
+        spec = obj.get("spec") or {}
+        sel = spec.get("selector")
+        if obj.get("kind") == "Service" and isinstance(sel, dict):
+            sel.update(pairs)
+        elif isinstance(sel, dict) and isinstance(
+            sel.get("matchLabels"), dict
+        ):
+            sel["matchLabels"].update(pairs)
+
+
+def _load_docs(path: Path, root: Path, unit: str, errors: list,
+               consumed: set[Path] | None = None) -> list[RenderedObject]:
+    if consumed is not None:
+        consumed.add(path.resolve())
+    try:
+        docs = list(yaml.safe_load_all(path.read_text(encoding="utf-8")))
+    except Exception as e:
+        errors.append((_rel(path, root), f"YAML parse failed: {e}"))
+        return []
+    out = []
+    for doc in docs:
+        if isinstance(doc, dict) and doc:
+            out.append(RenderedObject(doc, unit, _rel(path, root)))
+        elif doc is not None:
+            errors.append(
+                (_rel(path, root), "top-level YAML document is not a mapping")
+            )
+    return out
+
+
+def build_kustomization(
+    kdir: Path, root: Path, errors: list, unit: str | None = None,
+    consumed: set[Path] | None = None,
+) -> list[RenderedObject]:
+    """Resolve one kustomization directory to its object list."""
+    kdir = kdir.resolve()
+    unit = unit or _rel(kdir, root)
+    kfile = kdir / "kustomization.yaml"
+    if consumed is not None:
+        consumed.add(kfile.resolve())
+    try:
+        spec = yaml.safe_load(kfile.read_text(encoding="utf-8")) or {}
+    except Exception as e:
+        errors.append((_rel(kfile, root), f"YAML parse failed: {e}"))
+        return []
+
+    objs: list[RenderedObject] = []
+    for res in spec.get("resources") or []:
+        p = (kdir / res).resolve()
+        if p.is_dir():
+            objs.extend(build_kustomization(
+                p, root, errors, unit=unit, consumed=consumed,
+            ))
+        elif p.is_file():
+            objs.extend(_load_docs(p, root, unit, errors, consumed))
+        else:
+            errors.append(
+                (_rel(kfile, root), f"resource {res!r} does not exist")
+            )
+
+    # Components contribute their own resources and apply their patches
+    # to the accumulated set.
+    for comp in spec.get("components") or []:
+        p = (kdir / comp).resolve()
+        if not p.is_dir():
+            errors.append(
+                (_rel(kfile, root), f"component {comp!r} does not exist")
+            )
+            continue
+        cobjs, cspec = [], {}
+        if consumed is not None:
+            consumed.add((p / "kustomization.yaml").resolve())
+        try:
+            cspec = yaml.safe_load(
+                (p / "kustomization.yaml").read_text(encoding="utf-8")
+            ) or {}
+        except Exception as e:
+            errors.append(
+                (_rel(p / "kustomization.yaml", root),
+                 f"YAML parse failed: {e}")
+            )
+        for res in cspec.get("resources") or []:
+            rp = (p / res).resolve()
+            if rp.is_dir():
+                cobjs.extend(build_kustomization(
+                    rp, root, errors, unit=unit, consumed=consumed,
+                ))
+            else:
+                cobjs.extend(_load_docs(rp, root, unit, errors, consumed))
+        objs.extend(cobjs)
+        _apply_patches(
+            cspec.get("patches") or [], p, objs, root, errors, consumed,
+        )
+
+    for gen in spec.get("configMapGenerator") or []:
+        data = {}
+        for fname in gen.get("files") or []:
+            fp = kdir / fname
+            try:
+                data[Path(fname).name] = fp.read_text(encoding="utf-8")
+            except OSError as e:
+                errors.append(
+                    (_rel(kfile, root),
+                     f"configMapGenerator file {fname!r}: {e}")
+                )
+        for lit in gen.get("literals") or []:
+            key, _, val = str(lit).partition("=")
+            data[key] = val
+        objs.append(RenderedObject(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": gen.get("name", "")},
+                "data": data,
+            },
+            unit, _rel(kfile, root),
+        ))
+
+    _apply_patches(
+        spec.get("patches") or [], kdir, objs, root, errors, consumed,
+    )
+
+    suffix = spec.get("nameSuffix")
+    if suffix:
+        for ro in objs:
+            md = ro.obj.setdefault("metadata", {})
+            md["name"] = f"{md.get('name', '')}{suffix}"
+    for entry in spec.get("labels") or []:
+        _apply_labels(
+            objs, entry.get("pairs") or {},
+            bool(entry.get("includeSelectors")),
+        )
+    return objs
+
+
+def _apply_patches(
+    patches: list, kdir: Path, objs: list[RenderedObject],
+    root: Path, errors: list, consumed: set[Path] | None = None,
+) -> None:
+    for pat in patches:
+        target = pat.get("target") or {}
+        src = _rel(kdir / "kustomization.yaml", root)
+        if "path" in pat:
+            if consumed is not None:
+                consumed.add((kdir / pat["path"]).resolve())
+            try:
+                body = yaml.safe_load(
+                    (kdir / pat["path"]).read_text(encoding="utf-8")
+                )
+            except Exception as e:
+                errors.append((src, f"patch {pat['path']!r}: {e}"))
+                continue
+        else:
+            try:
+                body = yaml.safe_load(pat.get("patch") or "")
+            except Exception as e:
+                errors.append((src, f"inline patch parse failed: {e}"))
+                continue
+        if not target and isinstance(body, dict):
+            target = {
+                "kind": body.get("kind"),
+                "name": (body.get("metadata") or {}).get("name"),
+            }
+        hit = False
+        for ro in objs:
+            if not _target_matches(target, ro.obj):
+                continue
+            hit = True
+            try:
+                if isinstance(body, list):
+                    _json6902(ro.obj, body)
+                elif isinstance(body, dict):
+                    _strategic_merge(ro.obj, copy.deepcopy(body))
+            except (KeyError, IndexError, TypeError, ValueError) as e:
+                errors.append((
+                    src,
+                    f"patch targeting {target.get('kind')}/"
+                    f"{target.get('name')} failed to apply: {e!r} — the "
+                    "patched path no longer exists in the base",
+                ))
+        if not hit:
+            errors.append((
+                src,
+                f"patch target {target.get('kind')}/{target.get('name')} "
+                "matches no rendered object",
+            ))
+
+
+# ------------------------------------------------------------------ #
+# helm chart
+
+
+def _merged_values(base: dict, overrides: dict) -> dict:
+    vals = copy.deepcopy(base)
+    for key, sub in overrides.items():
+        if isinstance(sub, dict):
+            node = vals.setdefault(key, {})
+            node.update(copy.deepcopy(sub))
+        else:
+            vals[key] = sub
+    return vals
+
+
+def render_chart_unit(
+    chart_dir: Path, values: dict, release: str, variant: str,
+    root: Path, errors: list,
+) -> list[RenderedObject]:
+    """Render one values-matrix entry, per template file so every
+    object anchors to the template a human would edit."""
+    out: list[RenderedObject] = []
+    r = Renderer(values, release)
+    helpers = chart_dir / "templates" / "_helpers.tpl"
+    if helpers.exists():
+        r.render(helpers.read_text(encoding="utf-8"))
+    for tpl in sorted((chart_dir / "templates").glob("*.yaml")):
+        src = _rel(tpl, root)
+        try:
+            text = r.render(tpl.read_text(encoding="utf-8"))
+            docs = list(yaml.safe_load_all(text))
+        except Exception as e:
+            errors.append((src, f"chart render ({variant}) failed: {e!r}"))
+            continue
+        for doc in docs:
+            if isinstance(doc, dict) and doc:
+                out.append(RenderedObject(doc, f"chart:{variant}", src))
+    return out
+
+
+# ------------------------------------------------------------------ #
+# corpus
+
+_CACHE: dict[str, Corpus] = {}
+
+
+def kustomization_roots(root: Path) -> list[Path]:
+    """Every kustomization dir under deploy/ that is a Kustomization
+    (Components render only inside their includers)."""
+    roots = []
+    for kfile in sorted((root / "deploy").rglob("kustomization.yaml")):
+        try:
+            spec = yaml.safe_load(kfile.read_text(encoding="utf-8")) or {}
+        except Exception:
+            continue
+        if spec.get("kind") != "Component":
+            roots.append(kfile.parent)
+    return roots
+
+
+def render_corpus(root: Path) -> Corpus:
+    """The whole deploy surface, cached per root so the checker and the
+    CLI's object count share one render."""
+    root = Path(root).resolve()
+    key = str(root)
+    if key in _CACHE:
+        return _CACHE[key]
+    objects: list[RenderedObject] = []
+    errors: list[tuple[str, str]] = []
+    units: list[str] = []
+    if load_yaml() is None:
+        corpus = Corpus([], [], [("deploy/", "pyyaml unavailable: deploy "
+                                  "corpus not rendered")])
+        _CACHE[key] = corpus
+        return corpus
+    consumed: set[Path] = set()
+    if (root / "deploy").is_dir():
+        for kdir in kustomization_roots(root):
+            unit = _rel(kdir, root)
+            units.append(unit)
+            objects.extend(build_kustomization(
+                kdir, root, errors, consumed=consumed,
+            ))
+        # Standalone manifests no kustomization references (swap-in
+        # alternatives kept next to their recipes) still join the
+        # corpus — "render every manifest" includes the spares. Only
+        # docs that look like Kubernetes objects count: recipe dirs
+        # also hold non-manifest YAML (benchmark workload specs).
+        for path in sorted((root / "deploy").rglob("*.yaml")):
+            rp = path.resolve()
+            if rp in consumed or path.name == "kustomization.yaml":
+                continue
+            if "charts" in path.relative_to(root).parts:
+                continue
+            unit = f"file:{_rel(path, root)}"
+            side_errors: list[tuple[str, str]] = []
+            loaded = [
+                ro for ro in _load_docs(path, root, unit, side_errors)
+                if "kind" in ro.obj or "apiVersion" in ro.obj
+            ]
+            if loaded:
+                units.append(unit)
+                objects.extend(loaded)
+                errors.extend(side_errors)
+    chart = root / "deploy" / "charts" / "llmd-tpu"
+    if chart.is_dir():
+        try:
+            base_values = yaml.safe_load(
+                (chart / "values.yaml").read_text(encoding="utf-8")
+            ) or {}
+        except Exception as e:
+            errors.append((_rel(chart / "values.yaml", root),
+                           f"values.yaml parse failed: {e}"))
+            base_values = {}
+        for variant, overrides in CHART_VALUES_MATRIX:
+            units.append(f"chart:{variant}")
+            objects.extend(render_chart_unit(
+                chart, _merged_values(base_values, overrides),
+                "demo", variant, root, errors,
+            ))
+    corpus = Corpus(objects, units, errors)
+    _CACHE[key] = corpus
+    return corpus
+
+
+def source_line(sf_text: str, needle: str) -> int:
+    """Best-effort line anchor: first line of the source file containing
+    the needle (a flag, path, or name the finding is about); 1 if the
+    needle isn't literally present (e.g. rendered through a template)."""
+    if needle:
+        for i, line in enumerate(sf_text.splitlines(), 1):
+            if needle in line:
+                return i
+    return 1

@@ -1,337 +1,9 @@
-"""Minimal helm-template renderer for the chart's Go-template subset.
+"""Shim: the mini helm renderer moved into the analysis package so the
+deploy-parity checker can render the chart's values matrix. Tests keep
+importing from here."""
 
-The reference CI validates every chart combination with ``helm template``
-against a kind cluster (.github/workflows/ci-kustomize-dry-run.yaml:79-160).
-This image has no helm binary, so the render test brings its own renderer
-covering exactly the constructs the chart uses: actions with whitespace
-control, if/else, with, range-over-list, define/include, variables
-(``$x :=``), pipelines, and the sprig-ish functions (default, printf,
-trunc, trimSuffix, index, list, dict, eq, and, not, toYaml, nindent,
-indent, quote). Unknown constructs raise — template drift fails the test
-instead of silently rendering garbage.
-"""
-
-from __future__ import annotations
-
-import re
-
-import yaml
-
-_ACTION = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
-_TOKEN = re.compile(r'"(?:[^"\\]|\\.)*"|\(|\)|\||[^\s()|]+')
-
-
-class Scope:
-    def __init__(self, root, dot, variables):
-        self.root = root  # the $ context
-        self.dot = dot  # the . context
-        self.vars = variables  # $name -> value
-
-
-def _split_nodes(src: str):
-    """Template source -> list of ("text", s) / ("action", body) nodes with
-    whitespace control applied."""
-    nodes = []
-    pos = 0
-    for m in _ACTION.finditer(src):
-        text = src[pos : m.start()]
-        if m.group(1) == "-":
-            # helm's "-" trims ALL preceding whitespace incl. newlines
-            text = text.rstrip()
-        nodes.append(("text", text))
-        nodes.append(("action", m.group(2), m.group(3) == "-"))
-        pos = m.end()
-    nodes.append(("text", src[pos:]))
-    # apply trailing trim markers
-    out = []
-    trim_next = False
-    for n in nodes:
-        if n[0] == "text":
-            s = n[1]
-            if trim_next:
-                s = s.lstrip()
-                trim_next = False
-            out.append(("text", s))
-        else:
-            out.append(("action", n[1]))
-            trim_next = n[2]
-    return out
-
-
-class _Parser:
-    """Builds a nested tree of blocks from the flat node list."""
-
-    def __init__(self, nodes):
-        self.nodes = nodes
-        self.i = 0
-
-    def parse(self, until=None):
-        tree = []
-        while self.i < len(self.nodes):
-            kind, payload = self.nodes[self.i][0], self.nodes[self.i][1]
-            self.i += 1
-            if kind == "text":
-                tree.append(("text", payload))
-                continue
-            head = payload.split(None, 1)[0] if payload else ""
-            if head in ("end", "else") and until:
-                return tree, head
-            if head == "if":
-                body, tail = self.parse(until=True)
-                else_body = []
-                if tail == "else":
-                    else_body, tail = self.parse(until=True)
-                assert tail == "end", payload
-                tree.append(("if", payload[2:].strip(), body, else_body))
-            elif head == "range":
-                body, tail = self.parse(until=True)
-                assert tail == "end"
-                tree.append(("range", payload[5:].strip(), body))
-            elif head == "with":
-                body, tail = self.parse(until=True)
-                else_body = []
-                if tail == "else":
-                    else_body, tail = self.parse(until=True)
-                assert tail == "end"
-                tree.append(("with", payload[4:].strip(), body, else_body))
-            elif head == "define":
-                name = payload.split(None, 1)[1].strip().strip('"')
-                body, tail = self.parse(until=True)
-                assert tail == "end"
-                tree.append(("define", name, body))
-            else:
-                tree.append(("expr", payload))
-        if until:
-            raise SyntaxError("unclosed block")
-        return tree, None
-
-
-class Renderer:
-    def __init__(self, values: dict, release_name: str = "test"):
-        self.defines: dict[str, list] = {}
-        self.root = {
-            "Values": values,
-            "Release": {"Name": release_name, "Service": "Helm"},
-            "Chart": {"Name": "llmd-tpu"},
-        }
-
-    # -- expression evaluation ---------------------------------------- #
-
-    def _resolve_path(self, base, path: str):
-        cur = base
-        for part in [p for p in path.split(".") if p]:
-            if cur is None:
-                return None
-            if isinstance(cur, dict):
-                cur = cur.get(part)
-            else:
-                cur = getattr(cur, part, None)
-        return cur
-
-    def _eval_tokens(self, toks: list, scope: Scope):
-        """Evaluate one pipeline segment (function call or primary)."""
-        if not toks:
-            return None
-        if len(toks) == 1:
-            return self._primary(toks[0], scope)
-        fn, args = toks[0], toks[1:]
-        return self._call(fn, [self._primary(a, scope) for a in args], scope)
-
-    def _primary(self, tok, scope: Scope):
-        if isinstance(tok, list):  # parenthesized subexpression
-            return self._pipeline(tok, scope)
-        if tok.startswith('"'):
-            return tok[1:-1].encode().decode("unicode_escape")
-        if re.fullmatch(r"-?\d+", tok):
-            return int(tok)
-        if re.fullmatch(r"-?\d*\.\d+", tok):
-            return float(tok)
-        if tok == ".":
-            return scope.dot
-        if tok == "$":
-            return self.root
-        if tok.startswith("$"):
-            name, _, rest = tok[1:].partition(".")
-            if name == "" :
-                return self._resolve_path(self.root, rest)
-            base = scope.vars[name]
-            return self._resolve_path(base, rest) if rest else base
-        if tok.startswith("."):
-            return self._resolve_path(scope.dot, tok[1:])
-        # bare function with no args (e.g. in a pipe)
-        return self._call(tok, [], scope)
-
-    def _call(self, fn: str, args: list, scope: Scope):
-        if fn == "include":
-            name, ctx = args[0], args[1]
-            return self._render_tree(
-                self.defines[name], Scope(self.root, ctx, dict(scope.vars))
-            )
-        if fn == "default":
-            d, v = args[0], args[1] if len(args) > 1 else None
-            return v if v not in (None, "", 0, {}, []) else d
-        if fn == "printf":
-            return args[0] % tuple(args[1:])
-        if fn == "trunc":
-            n, s = args[0], args[1]
-            return str(s)[:n]
-        if fn == "trimSuffix":
-            suf, s = args[0], args[1]
-            return str(s)[: -len(suf)] if str(s).endswith(suf) else str(s)
-        if fn == "quote":
-            return '"%s"' % args[0]
-        if fn == "index":
-            cur = args[0]
-            for k in args[1:]:
-                cur = cur[k]
-            return cur
-        if fn == "list":
-            return list(args)
-        if fn == "dict":
-            return {args[i]: args[i + 1] for i in range(0, len(args), 2)}
-        if fn == "eq":
-            return all(a == args[0] for a in args[1:])
-        if fn == "ne":
-            return args[0] != args[1]
-        if fn == "and":
-            out = True
-            for a in args:
-                out = a
-                if not self._truthy(a):
-                    return a
-            return out
-        if fn == "or":
-            for a in args:
-                if self._truthy(a):
-                    return a
-            return args[-1] if args else None
-        if fn == "not":
-            return not self._truthy(args[0])
-        if fn == "toYaml":
-            return yaml.safe_dump(args[0], default_flow_style=False).rstrip()
-        if fn == "nindent":
-            n, s = args[0], str(args[1])
-            pad = " " * n
-            return "\n" + "\n".join(
-                pad + ln if ln else ln for ln in s.splitlines()
-            )
-        if fn == "indent":
-            n, s = args[0], str(args[1])
-            pad = " " * n
-            return "\n".join(pad + ln if ln else ln for ln in s.splitlines())
-        raise NameError(f"unsupported template function {fn!r}")
-
-    @staticmethod
-    def _truthy(v) -> bool:
-        return bool(v) and v != 0
-
-    def _tokenize(self, expr: str):
-        """Flat tokens -> nested lists for parentheses."""
-        flat = _TOKEN.findall(expr)
-        def build(i):
-            out = []
-            while i < len(flat):
-                t = flat[i]
-                if t == "(":
-                    sub, i = build(i + 1)
-                    out.append(sub)
-                elif t == ")":
-                    return out, i
-                else:
-                    out.append(t)
-                i += 1
-            return out, i
-        tree, _ = build(0)
-        return tree
-
-    def _pipeline(self, toks: list, scope: Scope):
-        # split on "|"
-        segments, cur = [], []
-        for t in toks:
-            if t == "|":
-                segments.append(cur)
-                cur = []
-            else:
-                cur.append(t)
-        segments.append(cur)
-        val = self._eval_tokens(segments[0], scope)
-        for seg in segments[1:]:
-            fn, args = seg[0], [self._primary(a, scope) for a in seg[1:]]
-            val = self._call(fn, args + [val], scope)
-        return val
-
-    def eval_expr(self, expr: str, scope: Scope):
-        # variable assignment: $x := pipeline
-        m = re.match(r"^\$(\w+)\s*:=\s*(.*)$", expr, re.S)
-        if m:
-            scope.vars[m.group(1)] = self._pipeline(
-                self._tokenize(m.group(2)), scope
-            )
-            return ""
-        return self._pipeline(self._tokenize(expr), scope)
-
-    # -- tree rendering ------------------------------------------------ #
-
-    def _render_tree(self, tree: list, scope: Scope) -> str:
-        out = []
-        for node in tree:
-            kind = node[0]
-            if kind == "text":
-                out.append(node[1])
-            elif kind == "expr":
-                v = self.eval_expr(node[1], scope)
-                out.append("" if v is None else str(v))
-            elif kind == "if":
-                cond = self.eval_expr(node[1], scope)
-                branch = node[2] if self._truthy(cond) else node[3]
-                out.append(self._render_tree(branch, scope))
-            elif kind == "with":
-                v = self.eval_expr(node[1], scope)
-                if self._truthy(v):
-                    out.append(self._render_tree(
-                        node[2], Scope(self.root, v, dict(scope.vars))
-                    ))
-                else:
-                    out.append(self._render_tree(node[3], scope))
-            elif kind == "range":
-                body_expr = node[1]
-                m = re.match(r"^\$(\w+)\s*:=\s*(.*)$", body_expr, re.S)
-                if m:
-                    items = self._pipeline(self._tokenize(m.group(2)), scope)
-                    for item in items or []:
-                        s2 = Scope(self.root, scope.dot, dict(scope.vars))
-                        s2.vars[m.group(1)] = item
-                        out.append(self._render_tree(node[2], s2))
-                else:
-                    items = self.eval_expr(body_expr, scope)
-                    for item in items or []:
-                        out.append(self._render_tree(
-                            node[2], Scope(self.root, item, dict(scope.vars))
-                        ))
-            elif kind == "define":
-                self.defines[node[1]] = node[2]
-        return "".join(out)
-
-    def render(self, src: str) -> str:
-        tree, _ = _Parser(_split_nodes(src)).parse()
-        scope = Scope(self.root, self.root, {})
-        return self._render_tree(tree, scope)
-
-
-def render_chart(chart_dir, values: dict, release_name: str = "test") -> list:
-    """helm-template the chart: returns the parsed YAML docs of every
-    rendered template (helpers first so defines register)."""
-    from pathlib import Path
-
-    chart_dir = Path(chart_dir)
-    r = Renderer(values, release_name)
-    helpers = chart_dir / "templates" / "_helpers.tpl"
-    if helpers.exists():
-        r.render(helpers.read_text())
-    docs = []
-    for tpl in sorted((chart_dir / "templates").glob("*.yaml")):
-        text = r.render(tpl.read_text())
-        for doc in yaml.safe_load_all(text):
-            if doc:
-                docs.append(doc)
-    return docs
+from llmd_tpu.analysis.helm_mini import (  # noqa: F401
+    Renderer,
+    Scope,
+    render_chart,
+)

@@ -1,0 +1,530 @@
+"""deploy-parity (llmd_tpu/analysis/checkers/deploy_parity.py): every
+DP rule fires on a bad fixture AND stays quiet on a good one, the
+render layer resolves kustomize overlays and the chart matrix, YAML
+pragma suppression works, and the real tree is clean.
+
+The acceptance-critical pins: the real deploy/ + chart surface renders
+(>= 40 objects) with zero DP findings, and breaking the readiness path
+in deploy/recipes/modelserver/base/deployment.yaml turns the suite red.
+"""
+
+from __future__ import annotations
+
+import shutil
+import textwrap
+from pathlib import Path
+
+import pytest
+
+pytest.importorskip("yaml")
+
+from llmd_tpu.analysis import manifests, run_analysis
+from llmd_tpu.analysis.core import run_analysis_details
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def check(tmp_path: Path, files: dict[str, str], rules=("deploy-parity",)):
+    """Write a fixture tree and run the selected rules over it."""
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    findings, _ = run_analysis(tmp_path, [str(tmp_path)], list(rules))
+    return findings
+
+
+def codes(findings) -> set[str]:
+    return {f.code for f in findings}
+
+
+# A minimal deployable module: CLI flags + aiohttp-style GET routes the
+# inventories pick up (parsed, never imported).
+WIDGET_MAIN = """
+    import argparse
+
+    def build(web):
+        p = argparse.ArgumentParser()
+        p.add_argument("--port")
+        p.add_argument("--config")
+        app = web.Application()
+        app.router.add_get("/healthz", None)
+        app.router.add_get("/readyz", None)
+        app.router.add_get("/metrics", None)
+        return p, app
+"""
+
+GOOD_DEPLOYMENT = """
+    apiVersion: apps/v1
+    kind: Deployment
+    metadata:
+      name: widget
+      labels: {app: widget}
+    spec:
+      selector:
+        matchLabels: {app: widget}
+      template:
+        metadata:
+          labels: {app: widget}
+        spec:
+          containers:
+            - name: widget
+              image: llmd-tpu:latest
+              args: [llmd_tpu.widget, --port=9000]
+              ports:
+                - {name: http, containerPort: 9000}
+              livenessProbe:
+                httpGet: {path: /healthz, port: http}
+              readinessProbe:
+                httpGet: {path: /readyz, port: http}
+    ---
+    apiVersion: v1
+    kind: Service
+    metadata:
+      name: widget
+    spec:
+      selector: {app: widget}
+      ports:
+        - {name: http, port: 80, targetPort: http}
+"""
+
+
+def good_tree() -> dict[str, str]:
+    return {
+        "llmd_tpu/widget/__main__.py": WIDGET_MAIN,
+        "deploy/app/deployment.yaml": GOOD_DEPLOYMENT,
+    }
+
+
+# ------------------------------------------------------------------ #
+# the render layer
+
+
+class TestRenderLayer:
+    def test_kustomize_overlay_patch_and_suffix(self, tmp_path):
+        for rel, content in {
+            "deploy/base/deployment.yaml": GOOD_DEPLOYMENT,
+            "deploy/base/kustomization.yaml": """
+                resources: [deployment.yaml]
+            """,
+            "deploy/overlays/tuned/kustomization.yaml": """
+                resources: [../../base]
+                nameSuffix: -tuned
+                patches:
+                  - target: {kind: Deployment, name: widget}
+                    patch: |-
+                      - op: replace
+                        path: /spec/template/spec/containers/0/args/1
+                        value: --port=9100
+                      - op: replace
+                        path: /spec/template/spec/containers/0/ports/0/containerPort
+                        value: 9100
+            """,
+        }.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(content))
+        corpus = manifests.render_corpus(tmp_path.resolve())
+        assert not corpus.errors
+        tuned = [
+            ro for ro in corpus.objects
+            if ro.obj.get("kind") == "Deployment"
+            and ro.obj["metadata"]["name"] == "widget-tuned"
+        ]
+        assert len(tuned) == 1
+        c = tuned[0].obj["spec"]["template"]["spec"]["containers"][0]
+        assert "--port=9100" in c["args"]
+        assert c["ports"][0]["containerPort"] == 9100
+
+    def test_unrenderable_patch_is_a_dp001(self, tmp_path):
+        fs = check(tmp_path, {
+            **good_tree(),
+            "deploy/base/deployment.yaml": GOOD_DEPLOYMENT,
+            "deploy/base/kustomization.yaml": """
+                resources: [deployment.yaml]
+                patches:
+                  - target: {kind: Deployment, name: gone}
+                    patch: |-
+                      - op: remove
+                        path: /spec/template
+            """,
+        })
+        assert any(
+            f.code == "DP001" and "unrenderable" in f.message for f in fs
+        )
+
+
+# ------------------------------------------------------------------ #
+# DP001 schema-shape
+
+
+class TestDP001:
+    def test_good_tree_is_clean(self, tmp_path):
+        assert check(tmp_path, good_tree()) == []
+
+    def test_wrong_api_version_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("apiVersion: apps/v1\n", "apiVersion: apps/v1beta1\n")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP001" and "apiVersion" in f.message for f in fs
+        )
+
+    def test_unknown_kind_fires(self, tmp_path):
+        fs = check(tmp_path, {
+            **good_tree(),
+            "deploy/app/extra.yaml": """
+                apiVersion: example.com/v1
+                kind: FrobnicationPolicy
+                metadata: {name: x}
+            """,
+        })
+        assert any(
+            f.code == "DP001" and "unknown kind" in f.message for f in fs
+        )
+
+    def test_selector_template_mismatch_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("matchLabels: {app: widget}", "matchLabels: {app: gadget}")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP001" and "selector" in f.message for f in fs
+        )
+
+    def test_duplicate_port_name_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "- {name: http, containerPort: 9000}",
+            "- {name: http, containerPort: 9000}\n"
+            "                - {name: http, containerPort: 9001}",
+        )
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP001" and "duplicate port name" in f.message
+            for f in fs
+        )
+
+
+# ------------------------------------------------------------------ #
+# DP002 flag-parity
+
+
+class TestDP002:
+    def test_unknown_flag_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("--port=9000", "--port=9000, --bogus-knob=1")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP002" and "--bogus-knob" in f.message for f in fs
+        )
+
+    def test_dotted_module_unions_package_main_flags(self, tmp_path):
+        # The dp_supervisor pattern: llmd_tpu.widget.sub declares only
+        # --local but forwards the rest to the package __main__ CLI, so
+        # --port (declared there) must not fire.
+        files = good_tree()
+        files["llmd_tpu/widget/sub.py"] = """
+            import argparse
+
+            def build():
+                p = argparse.ArgumentParser()
+                p.add_argument("--local")
+                return p
+        """
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "args: [llmd_tpu.widget, --port=9000]",
+            "args: [llmd_tpu.widget.sub, --local=1, --port=9000]",
+        )
+        fs = check(tmp_path, files)
+        assert not [f for f in fs if f.code == "DP002"]
+
+
+# ------------------------------------------------------------------ #
+# DP003 env-parity
+
+
+class TestDP003:
+    def test_manifest_var_nobody_reads_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "image: llmd-tpu:latest",
+            "image: llmd-tpu:latest\n"
+            "              env:\n"
+            "                - {name: LLMD_UNKNOWN_KNOB, value: 'on'}",
+        )
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP003" and "LLMD_UNKNOWN_KNOB" in f.message
+            for f in fs
+        )
+
+    def test_code_var_settable_nowhere_fires(self, tmp_path):
+        files = good_tree()
+        files["llmd_tpu/widget/knobs.py"] = """
+            import os
+
+            def mode():
+                return os.environ.get("LLMD_SECRET_TOGGLE")
+        """
+        fs = check(tmp_path, files)
+        orphan = [
+            f for f in fs
+            if f.code == "DP003" and "LLMD_SECRET_TOGGLE" in f.message
+        ]
+        assert orphan and orphan[0].path == "llmd_tpu/widget/knobs.py"
+
+    def test_var_set_and_read_is_clean(self, tmp_path):
+        files = good_tree()
+        files["llmd_tpu/widget/knobs.py"] = """
+            import os
+
+            def mode():
+                return os.environ.get("LLMD_WIDGET_MODE")
+        """
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "image: llmd-tpu:latest",
+            "image: llmd-tpu:latest\n"
+            "              env:\n"
+            "                - {name: LLMD_WIDGET_MODE, value: fast}",
+        )
+        assert check(tmp_path, files) == []
+
+
+# ------------------------------------------------------------------ #
+# DP004 probe-parity
+
+
+class TestDP004:
+    def test_probe_path_module_never_serves_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("path: /healthz", "path: /health")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP004" and "can never succeed" in f.message
+            for f in fs
+        )
+
+    def test_readiness_on_liveness_path_fires(self, tmp_path):
+        # /healthz IS served, but the module has a dedicated /readyz —
+        # the fault-tolerance.md contract says readiness must use it.
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "readinessProbe:\n                httpGet: {path: /readyz",
+            "readinessProbe:\n                httpGet: {path: /healthz",
+        )
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP004" and "dedicated readiness" in f.message
+            for f in fs
+        )
+
+    def test_routed_pod_without_readiness_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "              readinessProbe:\n"
+            "                httpGet: {path: /readyz, port: http}\n",
+            "",
+        )
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP004" and "no readinessProbe" in f.message
+            for f in fs
+        )
+
+    def test_probe_port_name_undeclared_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("{path: /readyz, port: http}", "{path: /readyz, port: api}")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP004" and "port name" in f.message for f in fs
+        )
+
+    def test_yaml_pragma_suppresses(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "httpGet: {path: /healthz, port: http}",
+            "# llmd: allow(deploy-parity) -- exercising pragma grammar\n"
+            "                httpGet: {path: /health, port: http}",
+        )
+        assert check(tmp_path, files) == []
+
+    def test_yaml_pragma_without_reason_is_pragma001(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "httpGet: {path: /healthz, port: http}",
+            "# llmd: allow(deploy-parity)\n"
+            "                httpGet: {path: /health, port: http}",
+        )
+        fs = check(tmp_path, files, rules=("deploy-parity", "pragma"))
+        assert "PRAGMA001" in codes(fs)
+
+    def test_unused_yaml_pragma_lands_in_ledger(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "httpGet: {path: /healthz, port: http}",
+            "# llmd: allow(deploy-parity) -- nothing to suppress here\n"
+            "                httpGet: {path: /healthz, port: http}",
+        )
+        for rel, content in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(content))
+        findings, _, unused = run_analysis_details(
+            tmp_path, [str(tmp_path)], ["deploy-parity"]
+        )
+        assert findings == []
+        assert [
+            (path, rule) for path, _, rule in unused
+        ] == [("deploy/app/deployment.yaml", "deploy-parity")]
+
+
+# ------------------------------------------------------------------ #
+# DP005 port/scrape-parity
+
+
+class TestDP005:
+    def test_service_targetport_names_nothing_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("targetPort: http", "targetPort: api")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP005" and "targetPort" in f.message for f in fs
+        )
+
+    def test_service_selecting_nothing_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("selector: {app: widget}", "selector: {app: gadget}")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP005" and "no endpoints" in f.message for f in fs
+        )
+
+    def test_port_arg_off_declared_ports_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace("--port=9000", "--port=9100")
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP005" and "--port" in f.message for f in fs
+        )
+
+    def test_scrape_annotation_port_off_pod_fires(self, tmp_path):
+        files = good_tree()
+        files["deploy/app/deployment.yaml"] = files[
+            "deploy/app/deployment.yaml"
+        ].replace(
+            "        metadata:\n          labels: {app: widget}",
+            "        metadata:\n"
+            "          labels: {app: widget}\n"
+            "          annotations:\n"
+            "            prometheus.io/scrape: 'true'\n"
+            "            prometheus.io/port: '9999'",
+        )
+        fs = check(tmp_path, files)
+        assert any(
+            f.code == "DP005" and "prometheus.io/scrape" in f.message
+            for f in fs
+        )
+
+
+# ------------------------------------------------------------------ #
+# changed-only / scoped-scan semantics
+
+
+def test_yaml_only_scan_still_schema_checks(tmp_path):
+    # --changed-only hands the checker just the touched YAML: the code
+    # inventories gate off, but schema-shape still fires.
+    files = good_tree()
+    files["deploy/app/deployment.yaml"] = files[
+        "deploy/app/deployment.yaml"
+    ].replace("apiVersion: apps/v1\n", "apiVersion: apps/v1beta1\n")
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    findings, _ = run_analysis(
+        tmp_path, [str(tmp_path / "deploy/app/deployment.yaml")],
+        ["deploy-parity"],
+    )
+    assert any(
+        f.code == "DP001" and "apiVersion" in f.message for f in findings
+    )
+    assert not [f for f in findings if f.code in ("DP002", "DP004")]
+
+
+# ------------------------------------------------------------------ #
+# the real tree
+
+
+class TestRealTree:
+    def test_real_tree_is_clean(self):
+        findings, nfiles = run_analysis(REPO, None, ["deploy-parity"])
+        assert nfiles > 0
+        assert findings == [], [
+            f"{f.path}:{f.line} {f.code} {f.message}" for f in findings
+        ]
+
+    def test_real_corpus_renders_whole_surface(self):
+        corpus = manifests.render_corpus(REPO)
+        assert corpus.errors == []
+        assert len(corpus.objects) >= 40
+        kinds = {ro.obj.get("kind") for ro in corpus.objects}
+        # The chart matrix and the kustomize roots both contributed.
+        assert {"Deployment", "Service", "LeaderWorkerSet"} <= kinds
+        units = {ro.unit for ro in corpus.objects}
+        assert any(u.startswith("chart:") for u in units)
+        # kustomize roots are unit-named by their directory.
+        assert "deploy/recipes/modelserver/base" in units
+        assert any(u.startswith("file:") for u in units)
+
+    def test_mutated_readiness_path_goes_red(self, tmp_path):
+        # The acceptance mutation pin: break the modelserver readiness
+        # path in a copy of the tree and the suite must fail.
+        for sub in ("llmd_tpu", "deploy"):
+            shutil.copytree(
+                REPO / sub, tmp_path / sub,
+                ignore=shutil.ignore_patterns("__pycache__"),
+            )
+        target = tmp_path / "deploy/recipes/modelserver/base/deployment.yaml"
+        text = target.read_text()
+        assert "path: /ready\n" in text
+        target.write_text(text.replace("path: /ready\n", "path: /not-ready\n"))
+        findings, _ = run_analysis(
+            tmp_path, [str(tmp_path)], ["deploy-parity"]
+        )
+        hits = [f for f in findings if f.code == "DP004"]
+        assert hits, "mutated readiness path must produce a DP004"
+        assert any("/not-ready" in f.message for f in hits)

@@ -409,3 +409,80 @@ def test_pb_header_mutation_overwrites_client_headers():
                     if f4 == 1:
                         walk_option(v4)
     assert actions == [2], actions
+
+
+def _golden_frames():
+    frames = {}
+    fixture = __file__.rsplit("/", 1)[0] + "/fixtures/extproc_golden.hex"
+    with open(fixture, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, hexbytes = line.split()
+            frames[name] = bytes.fromhex(hexbytes)
+    return frames
+
+
+def test_pb_golden_wire_fixture():
+    """Interop pin: frozen Envoy ext-proc wire bytes
+    (tests/fixtures/extproc_golden.hex, verified field-by-field against
+    the public proto) must decode to the expected structures AND the
+    codec must reproduce them byte-exactly. A red here means the codec
+    drifted off the wire contract — fix the codec, do not regenerate
+    the fixture from it."""
+    g = _golden_frames()
+
+    # Envoy -> EPP direction: parse semantics.
+    msg = pb.parse_processing_request(g["request_headers"])
+    assert msg.kind == "request_headers"
+    assert msg.headers == {
+        ":method": "POST",
+        ":path": "/v1/completions",
+        "x-request-id": "req-1",
+    }
+    assert not msg.end_of_stream
+
+    msg = pb.parse_processing_request(g["request_body_eos"])
+    assert msg.kind == "request_body"
+    assert json.loads(msg.body) == {"model": "m", "prompt": "x"}
+    assert msg.end_of_stream
+
+    msg = pb.parse_processing_request(g["response_trailers"])
+    assert msg.kind == "response_trailers"
+
+    # ...and the client-side helpers must emit the exact same bytes
+    # (the no-Envoy smoke client speaks this direction).
+    assert pb.encode_request_headers({
+        ":method": "POST", ":path": "/v1/completions",
+        "x-request-id": "req-1",
+    }) == g["request_headers"]
+    assert pb.encode_request_body(
+        b'{"model": "m", "prompt": "x"}'
+    ) == g["request_body_eos"]
+    assert pb.encode_response_trailers() == g["response_trailers"]
+
+    # EPP -> Envoy direction: byte-exact emission (what Envoy ingests).
+    assert pb.encode_common_response(
+        "request_body",
+        set_headers={"x-gateway-destination-endpoint": "10.0.0.1:8200"},
+        clear_route_cache=True,
+    ) == g["pick_response"]
+    assert pb.encode_immediate_response(
+        503, headers={"x-llmd-drop-reason": "saturated"},
+        body=b'{"error":"no ready endpoints"}', details="no-endpoints",
+    ) == g["shed_response"]
+    assert pb.encode_streamed_body_response(
+        "response_body", b'data: {"choices":[]}\n\n', end_of_stream=False,
+    ) == g["streamed_chunk"]
+
+    # The pick frame also parses back with the mutation intact.
+    resp = pb.parse_processing_response(g["pick_response"])
+    assert resp.kind == "request_body"
+    assert resp.set_headers == {
+        "x-gateway-destination-endpoint": "10.0.0.1:8200"
+    }
+    resp = pb.parse_processing_response(g["shed_response"])
+    assert resp.kind == "immediate_response"
+    assert resp.immediate_status == 503
+    assert resp.immediate_details == "no-endpoints"
